@@ -1,0 +1,79 @@
+"""Configuration semantics ≈ reference TestConfiguration
+(src/test/org/apache/hadoop/conf/TestConfiguration.java): layering,
+overrides, substitution, typed getters."""
+
+import json
+
+from tpumr.core.configuration import Configuration
+
+
+def test_layering_and_override():
+    conf = Configuration(load_defaults=False)
+    conf.add_resource({"a": "1", "b": "base"})
+    conf.add_resource({"b": "override"})
+    assert conf.get("a") == "1"
+    assert conf.get("b") == "override"
+    conf.set("b", "explicit")
+    assert conf.get("b") == "explicit"
+    conf.unset("b")
+    assert conf.get("b") is None
+
+
+def test_variable_expansion(monkeypatch):
+    conf = Configuration(load_defaults=False)
+    conf.set("base.dir", "/data")
+    conf.set("job.dir", "${base.dir}/jobs/${job.id}")
+    conf.set("job.id", "job_001")
+    assert conf.get("job.dir") == "/data/jobs/job_001"
+    monkeypatch.setenv("TPUMR_TEST_HOME", "/home/x")
+    conf.set("from.env", "${TPUMR_TEST_HOME}/y")
+    assert conf.get("from.env") == "/home/x/y"
+
+
+def test_typed_getters():
+    conf = Configuration(load_defaults=False)
+    conf.set("i", "42")
+    conf.set("f", "2.5")
+    conf.set("t", "true")
+    conf.set("n", "no")
+    conf.set("list", "a, b ,c")
+    conf.set("size", "64m")
+    assert conf.get_int("i") == 42
+    assert conf.get_int("missing", 7) == 7
+    assert conf.get_float("f") == 2.5
+    assert conf.get_boolean("t") is True
+    assert conf.get_boolean("n") is False
+    assert conf.get_boolean("missing", True) is True
+    assert conf.get_strings("list") == ["a", "b", "c"]
+    assert conf.get_size("size") == 64 * 1024 * 1024
+
+
+def test_file_resource(tmp_path):
+    p = tmp_path / "site.json"
+    p.write_text(json.dumps({"x.y": "zzz", "n": 3}))
+    conf = Configuration(load_defaults=False)
+    conf.add_resource(str(p))
+    assert conf.get("x.y") == "zzz"
+    assert conf.get_int("n") == 3
+
+
+def test_deprecation_mapping():
+    conf = Configuration(load_defaults=False)
+    conf.add_deprecation("mapred.old.key", "tpumr.new.key")
+    conf.set("mapred.old.key", "v")
+    assert conf.get("tpumr.new.key") == "v"
+
+
+def test_copy_isolation():
+    a = Configuration(load_defaults=False)
+    a.set("k", "1")
+    b = a.copy()
+    b.set("k", "2")
+    assert a.get("k") == "1"
+    assert b.get("k") == "2"
+
+
+def test_get_class():
+    conf = Configuration(load_defaults=False)
+    conf.set("cls", "tpumr.core.configuration.Configuration")
+    assert conf.get_class("cls") is Configuration

@@ -1,0 +1,95 @@
+"""FileSystem SPI tests ≈ reference fs tests (src/test/org/apache/hadoop/fs/:
+TestLocalFileSystem, TestPath, TestGlobPaths)."""
+
+import pytest
+
+from tpumr.fs import (
+    FileSystem, InMemoryFileSystem, LocalFileSystem, Path, get_filesystem,
+)
+
+
+def test_path_parsing():
+    p = Path("mem://cluster/a/b/../c")
+    assert p.scheme == "mem"
+    assert p.authority == "cluster"
+    assert p.path == "/a/c"
+    assert str(p) == "mem://cluster/a/c"
+    assert p.name == "c"
+    assert p.parent.path == "/a"
+    assert Path("/x//y/./z").path == "/x/y/z"
+    assert Path("/a", "b").path == "/a/b"
+
+
+@pytest.fixture(params=["mem", "local"])
+def fs_and_root(request, tmp_path):
+    if request.param == "mem":
+        return InMemoryFileSystem(), "/root"
+    return LocalFileSystem(), str(tmp_path)
+
+
+def test_fs_contract(fs_and_root):
+    fs, root = fs_and_root
+    f = f"{root}/dir/file.txt"
+    fs.write_bytes(f, b"hello world")
+    assert fs.exists(f)
+    assert fs.read_bytes(f) == b"hello world"
+    st = fs.get_status(f)
+    assert st.length == 11 and not st.is_dir
+
+    # listing
+    fs.write_bytes(f"{root}/dir/other.txt", b"x")
+    names = [s.path.name for s in fs.list_status(f"{root}/dir")]
+    assert names == ["file.txt", "other.txt"]
+
+    # rename
+    assert fs.rename(f, f"{root}/dir/renamed.txt")
+    assert not fs.exists(f)
+    assert fs.read_bytes(f"{root}/dir/renamed.txt") == b"hello world"
+
+    # delete
+    assert fs.delete(f"{root}/dir/renamed.txt")
+    assert not fs.exists(f"{root}/dir/renamed.txt")
+
+    # mkdirs + recursive delete
+    fs.mkdirs(f"{root}/deep/a/b")
+    assert fs.exists(f"{root}/deep/a/b")
+    fs.write_bytes(f"{root}/deep/a/b/f", b"1")
+    assert fs.delete(f"{root}/deep", recursive=True)
+    assert not fs.exists(f"{root}/deep/a/b/f")
+
+
+def test_fs_glob(fs_and_root):
+    fs, root = fs_and_root
+    for name in ["part-00000", "part-00001", "_SUCCESS", "log.txt"]:
+        fs.write_bytes(f"{root}/out/{name}", b"d")
+    parts = fs.glob_status(f"{root}/out/part-*")
+    assert [s.path.name for s in parts] == ["part-00000", "part-00001"]
+
+
+def test_fs_dispatch():
+    fs = get_filesystem("mem:///x")
+    assert isinstance(fs, InMemoryFileSystem)
+    assert get_filesystem("mem:///y") is fs  # cached per scheme+authority
+    assert isinstance(get_filesystem("/local/path"), LocalFileSystem)
+    FileSystem.clear_cache()
+    assert get_filesystem("mem:///x") is not fs
+
+
+def test_mem_block_locations():
+    fs = InMemoryFileSystem()
+    fs.write_bytes("/data/big", b"x" * 100)
+    locs = fs.get_block_locations("/data/big", 0, 100)
+    assert locs and all(loc.hosts for loc in locs)
+    # deterministic
+    locs2 = fs.get_block_locations("/data/big", 0, 100)
+    assert [loc.hosts for loc in locs] == [loc.hosts for loc in locs2]
+
+
+def test_rename_directory_mem():
+    fs = InMemoryFileSystem()
+    fs.write_bytes("/a/x/1", b"1")
+    fs.write_bytes("/a/x/2", b"2")
+    assert fs.rename("/a/x", "/b/y")
+    assert fs.read_bytes("/b/y/1") == b"1"
+    assert fs.read_bytes("/b/y/2") == b"2"
+    assert not fs.exists("/a/x/1")

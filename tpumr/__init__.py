@@ -1,0 +1,30 @@
+"""tpumr — a TPU-native distributed MapReduce framework.
+
+A ground-up re-design of the capabilities of ``millecker/hadoop-1.0.3-gpu``
+(Apache Hadoop 1.0.3 + Shirahata et al. hybrid CPU/GPU map-task scheduling)
+for TPU hardware:
+
+- the Java control plane (JobTracker/TaskTracker/heartbeats) becomes a Python
+  control plane with the same contracts (dual slot pools, profiling-driven
+  hybrid scheduler, pluggable scheduler SPI, counters/history);
+- the C++/CUDA "pipes" per-record socket data path becomes an in-process
+  JAX/XLA/Pallas map runner that stages whole InputSplits into HBM;
+- host-level TCP shuffle keeps a host path, plus an on-device bucketed
+  all-to-all over ICI for kernel-mapped jobs.
+
+Package layout (≈ reference layers, SURVEY.md §1):
+
+- ``tpumr.core``     — config, counters, progress, metrics (≈ L1 common)
+- ``tpumr.io``       — record serialization, SequenceFile/IFile (≈ L1 io)
+- ``tpumr.fs``       — FileSystem SPI: local, in-memory, DFS-lite (≈ L1/L3)
+- ``tpumr.ipc``      — framed RPC, versioned protocols (≈ L2)
+- ``tpumr.parallel`` — mesh, collectives, device shuffle (new: ICI data plane)
+- ``tpumr.ops``      — Pallas/JAX map kernels (replaces user CUDA binaries)
+- ``tpumr.mapred``   — job/task runtime, schedulers, trackers (≈ L4-L7)
+- ``tpumr.models``   — example jobs: wordcount, pi, kmeans, terasort… (≈ L8)
+- ``tpumr.utils``    — reflection, shell, net topology helpers
+"""
+
+__version__ = "0.1.0"
+
+VERSION_STRING = "1.0.3-tpu"  # ≈ build.xml:31 version 1.0.3-gpu

@@ -1,0 +1,5 @@
+from tpumr.core.configuration import Configuration
+from tpumr.core.counters import Counter, CounterGroup, Counters
+from tpumr.core.progress import Progress
+
+__all__ = ["Configuration", "Counter", "CounterGroup", "Counters", "Progress"]

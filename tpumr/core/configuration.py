@@ -1,0 +1,239 @@
+"""Layered key/value configuration.
+
+TPU-era equivalent of ``org.apache.hadoop.conf.Configuration``
+(reference: src/core/org/apache/hadoop/conf/Configuration.java, 1455 LoC):
+resources are layered in addition order, later layers override earlier ones,
+explicit ``set()`` overrides all resources, values support ``${var}``
+expansion against other keys and environment variables, and typed getters
+parse on read. Resources here are dicts / JSON / TOML files instead of the
+reference's XML, but the semantics (layering, expansion, final-ish defaults)
+are the same.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import re
+from typing import Any, Callable, Iterator, Mapping
+
+_VAR_PAT = re.compile(r"\$\{([^}$\s]+)\}")
+_MAX_SUBST = 20  # Configuration.java caps substitution depth the same way
+
+_TRUE = {"true", "yes", "on", "1"}
+_FALSE = {"false", "no", "off", "0"}
+
+# size suffixes for get_memory-style keys (e.g. "100m" in io.sort.mb-like keys)
+_SIZE_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+class Configuration:
+    """Layered configuration with variable expansion and typed getters."""
+
+    #: process-wide default resources added to every new Configuration
+    #: (≈ Configuration.addDefaultResource for core-default.xml etc.)
+    _default_resources: list[Mapping[str, Any]] = []
+
+    def __init__(self, other: "Configuration | None" = None,
+                 load_defaults: bool = True) -> None:
+        self._resources: list[dict[str, Any]] = []
+        self._overlay: dict[str, Any] = {}   # explicit set() wins over resources
+        self._deprecations: dict[str, str] = {}
+        if other is not None:
+            self._resources = [dict(r) for r in other._resources]
+            self._overlay = dict(other._overlay)
+            self._deprecations = dict(other._deprecations)
+        elif load_defaults:
+            for res in Configuration._default_resources:
+                self._resources.append(dict(res))
+
+    # ------------------------------------------------------------------ setup
+
+    @classmethod
+    def add_default_resource(cls, resource: Mapping[str, Any]) -> None:
+        cls._default_resources.append(dict(resource))
+
+    def add_resource(self, resource: "Mapping[str, Any] | str") -> None:
+        """Add a resource layer: a dict, or a path to a .json/.toml file."""
+        if isinstance(resource, str):
+            self._resources.append(self._load_file(resource))
+        else:
+            self._resources.append(dict(resource))
+
+    @staticmethod
+    def _load_file(path: str) -> dict[str, Any]:
+        with open(path, "rb") as f:
+            data = f.read()
+        if path.endswith(".toml"):
+            import tomllib
+            raw = tomllib.loads(data.decode("utf-8"))
+            # flatten nested tables into dotted keys
+            flat: dict[str, Any] = {}
+
+            def walk(prefix: str, node: Any) -> None:
+                if isinstance(node, dict):
+                    for k, v in node.items():
+                        walk(f"{prefix}.{k}" if prefix else k, v)
+                else:
+                    flat[prefix] = node
+
+            walk("", raw)
+            return flat
+        return json.loads(data.decode("utf-8"))
+
+    def add_deprecation(self, old_key: str, new_key: str) -> None:
+        self._deprecations[old_key] = new_key
+
+    # ------------------------------------------------------------------ access
+
+    def _translate(self, key: str) -> str:
+        seen = set()
+        while key in self._deprecations and key not in seen:
+            seen.add(key)
+            key = self._deprecations[key]
+        return key
+
+    def _raw(self, key: str) -> Any:
+        key = self._translate(key)
+        if key in self._overlay:
+            return self._overlay[key]
+        for res in reversed(self._resources):
+            if key in res:
+                return res[key]
+        raise KeyError(key)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            val = self._raw(key)
+        except KeyError:
+            return default
+        if isinstance(val, str):
+            return self._substitute(val)
+        return val
+
+    def _substitute(self, val: str) -> str:
+        for _ in range(_MAX_SUBST):
+            m = _VAR_PAT.search(val)
+            if m is None:
+                return val
+            name = m.group(1)
+            try:
+                rep = self._raw(name)
+            except KeyError:
+                rep = os.environ.get(name)
+            if rep is None:
+                return val  # unresolvable — leave literally, like the reference
+            val = val[: m.start()] + str(rep) + val[m.end():]
+        return val
+
+    def set(self, key: str, value: Any) -> None:
+        self._overlay[self._translate(key)] = value
+
+    def set_if_unset(self, key: str, value: Any) -> None:
+        if self.get(key) is None:
+            self.set(key, value)
+
+    def unset(self, key: str) -> None:
+        key = self._translate(key)
+        self._overlay.pop(key, None)
+        for res in self._resources:
+            res.pop(key, None)
+
+    def __contains__(self, key: str) -> bool:
+        return self.get(key) is not None
+
+    # typed getters (≈ Configuration.getInt/getLong/getFloat/getBoolean/...)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return int(v)
+        if isinstance(v, str):
+            s = v.strip()
+            # decimal by default (leading zeros OK); 0x/0o/0b prefixes honored
+            return int(s, 0) if s[1:2] in ("x", "o", "b") and s[:1] == "0" else int(s, 10)
+        return int(v)
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.get(key)
+        return default if v is None else float(v)
+
+    def get_boolean(self, key: str, default: bool = False) -> bool:
+        v = self.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        s = str(v).strip().lower()
+        if s in _TRUE:
+            return True
+        if s in _FALSE:
+            return False
+        return default
+
+    def get_strings(self, key: str, default: list[str] | None = None) -> list[str]:
+        v = self.get(key)
+        if v is None:
+            return list(default or [])
+        if isinstance(v, (list, tuple)):
+            return [str(x) for x in v]
+        return [s.strip() for s in str(v).split(",") if s.strip()]
+
+    def get_size(self, key: str, default: int = 0) -> int:
+        """Parse '64m'/'1g' style sizes into bytes."""
+        v = self.get(key)
+        if v is None:
+            return default
+        if isinstance(v, (int, float)):
+            return int(v)
+        s = str(v).strip().lower()
+        if s and s[-1] in _SIZE_SUFFIX:
+            return int(float(s[:-1]) * _SIZE_SUFFIX[s[-1]])
+        return int(float(s))
+
+    def get_class(self, key: str, default: type | None = None) -> type | None:
+        """Resolve a dotted class name (≈ Configuration.getClass via
+        ReflectionUtils)."""
+        from tpumr.utils.reflection import resolve_class
+        v = self.get(key)
+        if v is None:
+            return default
+        if isinstance(v, type):
+            return v
+        return resolve_class(str(v))
+
+    def set_class(self, key: str, cls: type) -> None:
+        from tpumr.utils.reflection import class_name
+        self.set(key, class_name(cls))
+
+    # ------------------------------------------------------------------ misc
+
+    def keys(self) -> list[str]:
+        out: dict[str, None] = {}
+        for res in self._resources:
+            out.update(dict.fromkeys(res))
+        out.update(dict.fromkeys(self._overlay))
+        return list(out)
+
+    def __iter__(self) -> Iterator[tuple[str, Any]]:
+        for k in self.keys():
+            yield k, self.get(k)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {k: self.get(k) for k in self.keys()}
+
+    def copy(self) -> "Configuration":
+        return copy.deepcopy(self)
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Configuration({len(self)} keys, {len(self._resources)} resources)"

@@ -1,0 +1,147 @@
+"""Hierarchical job counters.
+
+≈ ``org.apache.hadoop.mapred.Counters`` (reference:
+src/mapred/org/apache/hadoop/mapred/Counters.java): named groups of named
+counters, incremented by tasks, serialized in every heartbeat, and summed
+job-wide. The TPU build additionally makes backend placement a first-class
+counter group (the reference's GPU observability was log-only — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+
+class TaskCounter:
+    """Framework counter names (≈ Task.Counter enum)."""
+    MAP_INPUT_RECORDS = "MAP_INPUT_RECORDS"
+    MAP_OUTPUT_RECORDS = "MAP_OUTPUT_RECORDS"
+    MAP_INPUT_BYTES = "MAP_INPUT_BYTES"
+    MAP_OUTPUT_BYTES = "MAP_OUTPUT_BYTES"
+    COMBINE_INPUT_RECORDS = "COMBINE_INPUT_RECORDS"
+    COMBINE_OUTPUT_RECORDS = "COMBINE_OUTPUT_RECORDS"
+    REDUCE_INPUT_GROUPS = "REDUCE_INPUT_GROUPS"
+    REDUCE_INPUT_RECORDS = "REDUCE_INPUT_RECORDS"
+    REDUCE_OUTPUT_RECORDS = "REDUCE_OUTPUT_RECORDS"
+    REDUCE_SHUFFLE_BYTES = "REDUCE_SHUFFLE_BYTES"
+    SPILLED_RECORDS = "SPILLED_RECORDS"
+    FRAMEWORK_GROUP = "tpumr.TaskCounter"
+
+
+class BackendCounter:
+    """New in the TPU build: per-backend placement/runtime counters."""
+    CPU_MAP_TASKS = "CPU_MAP_TASKS"
+    TPU_MAP_TASKS = "TPU_MAP_TASKS"
+    CPU_MAP_MILLIS = "CPU_MAP_MILLIS"
+    TPU_MAP_MILLIS = "TPU_MAP_MILLIS"
+    TPU_DEVICE_BYTES_STAGED = "TPU_DEVICE_BYTES_STAGED"
+    GROUP = "tpumr.BackendCounter"
+
+
+class JobCounter:
+    LAUNCHED_MAP_TASKS = "LAUNCHED_MAP_TASKS"
+    LAUNCHED_REDUCE_TASKS = "LAUNCHED_REDUCE_TASKS"
+    DATA_LOCAL_MAPS = "DATA_LOCAL_MAPS"
+    RACK_LOCAL_MAPS = "RACK_LOCAL_MAPS"
+    FAILED_MAP_TASKS = "FAILED_MAP_TASKS"
+    FAILED_REDUCE_TASKS = "FAILED_REDUCE_TASKS"
+    SPECULATIVE_MAPS = "SPECULATIVE_MAPS"
+    GROUP = "tpumr.JobCounter"
+
+
+class Counter:
+    __slots__ = ("name", "display_name", "_value", "_lock")
+
+    def __init__(self, name: str, display_name: str | None = None,
+                 value: int = 0) -> None:
+        self.name = name
+        self.display_name = display_name or name
+        self._value = int(value)
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def increment(self, amount: int = 1) -> None:
+        with self._lock:
+            self._value += amount
+
+    def set_value(self, value: int) -> None:
+        with self._lock:
+            self._value = int(value)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Counter({self.name}={self._value})"
+
+
+class CounterGroup:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._counters: dict[str, Counter] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = Counter(name)
+        return c
+
+    def __iter__(self) -> Iterator[Counter]:
+        return iter(self._counters.values())
+
+    def __len__(self) -> int:
+        return len(self._counters)
+
+    def merge(self, other: "CounterGroup") -> None:
+        for c in other:
+            self.counter(c.name).increment(c.value)
+
+
+class Counters:
+    """Thread-safe counter set: group → name → value."""
+
+    def __init__(self) -> None:
+        self._groups: dict[str, CounterGroup] = {}
+        self._lock = threading.Lock()
+
+    def group(self, name: str) -> CounterGroup:
+        with self._lock:
+            g = self._groups.get(name)
+            if g is None:
+                g = self._groups[name] = CounterGroup(name)
+            return g
+
+    def counter(self, group: str, name: str) -> Counter:
+        return self.group(group).counter(name)
+
+    def incr(self, group: str, name: str, amount: int = 1) -> None:
+        self.counter(group, name).increment(amount)
+
+    def value(self, group: str, name: str) -> int:
+        return self.counter(group, name).value
+
+    def __iter__(self) -> Iterator[CounterGroup]:
+        return iter(list(self._groups.values()))
+
+    def merge(self, other: "Counters") -> None:
+        """Sum another counter set into this one (≈ Counters.incrAllCounters)."""
+        for g in other:
+            self.group(g.name).merge(g)
+
+    # wire format (heartbeats / history)
+
+    def to_dict(self) -> dict[str, dict[str, int]]:
+        return {g.name: {c.name: c.value for c in g} for g in self}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, dict[str, int]]) -> "Counters":
+        out = cls()
+        for gname, cs in d.items():
+            for cname, v in cs.items():
+                out.counter(gname, cname).set_value(v)
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover
+        total = sum(len(g) for g in self)
+        return f"Counters({len(self._groups)} groups, {total} counters)"

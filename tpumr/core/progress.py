@@ -1,0 +1,43 @@
+"""Weighted phase/progress tree ≈ ``org.apache.hadoop.util.Progress``
+(reference: src/core/org/apache/hadoop/util/Progress.java): a node's progress
+is its own fraction if it is a leaf, else progress of completed children plus
+the current child's fractional contribution.
+"""
+
+from __future__ import annotations
+
+
+class Progress:
+    def __init__(self, status: str = "") -> None:
+        self.status = status
+        self._children: list[Progress] = []
+        self._current = 0
+        self._progress = 0.0
+
+    def add_phase(self, status: str = "") -> "Progress":
+        child = Progress(status)
+        self._children.append(child)
+        return child
+
+    def start_next_phase(self) -> None:
+        if self._current < len(self._children) - 1:
+            self._current += 1
+
+    def phase(self) -> "Progress":
+        return self._children[self._current] if self._children else self
+
+    def set(self, progress: float) -> None:
+        self._progress = min(1.0, max(0.0, progress))
+
+    def complete(self) -> None:
+        self._progress = 1.0
+        if self._children:
+            self._current = len(self._children) - 1
+            for c in self._children:
+                c.complete()
+
+    def get(self) -> float:
+        if not self._children:
+            return self._progress
+        done = sum(1.0 for c in self._children[: self._current])
+        return (done + self._children[self._current].get()) / len(self._children)

@@ -1,0 +1,10 @@
+from tpumr.fs.filesystem import (
+    FileSystem, FileStatus, BlockLocation, Path, get_filesystem,
+)
+from tpumr.fs.local import LocalFileSystem
+from tpumr.fs.inmem import InMemoryFileSystem
+
+__all__ = [
+    "FileSystem", "FileStatus", "BlockLocation", "Path", "get_filesystem",
+    "LocalFileSystem", "InMemoryFileSystem",
+]

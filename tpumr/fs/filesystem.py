@@ -1,0 +1,224 @@
+"""FileSystem SPI.
+
+≈ ``org.apache.hadoop.fs.FileSystem`` (reference: src/core/org/apache/hadoop/
+fs/FileSystem.java, 1701 LoC): a scheme-dispatched abstract filesystem with
+create/open/rename/delete/listStatus/globStatus, file status metadata, and
+block-location hints that feed locality-aware task placement
+(FileInputFormat.getSplits → JobInProgress locality caches). Implementations
+in-tree: local (``file:``), in-memory (``mem:``, ≈ the test RAM FS) and the
+DFS-lite replicated block store (``tdfs:``, tpumr.fs.dfs).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import posixpath
+import re
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, Callable
+
+
+class Path:
+    """Scheme-qualified path: ``scheme://authority/path`` or bare ``/path``.
+
+    ≈ org.apache.hadoop.fs.Path — purely syntactic; normalization collapses
+    '.' and '..' and duplicate slashes.
+    """
+
+    __slots__ = ("scheme", "authority", "path")
+
+    def __init__(self, s: "str | Path", child: str | None = None) -> None:
+        if isinstance(s, Path):
+            self.scheme, self.authority, self.path = s.scheme, s.authority, s.path
+        else:
+            m = re.match(r"^([A-Za-z][A-Za-z0-9+.-]*)://([^/]*)(/.*|$)", s)
+            if m:
+                self.scheme = m.group(1)
+                self.authority = m.group(2)
+                self.path = posixpath.normpath(m.group(3) or "/")
+            else:
+                self.scheme = ""
+                self.authority = ""
+                self.path = posixpath.normpath(s) if s else "/"
+        if child is not None:
+            self.path = posixpath.normpath(posixpath.join(self.path, child))
+
+    def __str__(self) -> str:
+        if self.scheme:
+            return f"{self.scheme}://{self.authority}{self.path}"
+        return self.path
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Path({str(self)!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Path) and str(self) == str(other)
+
+    def __hash__(self) -> int:
+        return hash(str(self))
+
+    def __lt__(self, other: "Path") -> bool:
+        return str(self) < str(other)
+
+    @property
+    def name(self) -> str:
+        return posixpath.basename(self.path)
+
+    @property
+    def parent(self) -> "Path":
+        p = Path(self)
+        p.path = posixpath.dirname(self.path) or "/"
+        return p
+
+    def child(self, name: str) -> "Path":
+        return Path(str(self), name)
+
+
+@dataclass
+class FileStatus:
+    """≈ org.apache.hadoop.fs.FileStatus."""
+    path: Path
+    length: int = 0
+    is_dir: bool = False
+    replication: int = 1
+    block_size: int = 64 * 1024 * 1024
+    mtime: float = field(default_factory=time.time)
+    owner: str = ""
+
+
+@dataclass
+class BlockLocation:
+    """≈ org.apache.hadoop.fs.BlockLocation — locality hints for splits."""
+    hosts: list[str]
+    offset: int
+    length: int
+
+
+class FileSystem(ABC):
+    """Abstract filesystem; subclasses register a URI scheme."""
+
+    scheme: str = ""
+    _registry: dict[str, "Callable[[Any], FileSystem]"] = {}
+    _cache: dict[str, "FileSystem"] = {}
+
+    # ------------------------------------------------------------ dispatch
+
+    @classmethod
+    def register(cls, scheme: str, factory: "Callable[[Any], FileSystem]") -> None:
+        cls._registry[scheme] = factory
+
+    @classmethod
+    def get(cls, uri: "str | Path", conf: Any = None) -> "FileSystem":
+        p = Path(uri) if not isinstance(uri, Path) else uri
+        scheme = p.scheme or (conf.get("fs.default.name", "file") if conf is not None else "file")
+        scheme = Path(scheme).scheme or scheme  # allow full default URIs
+        key = f"{scheme}://{p.authority}"
+        fs = cls._cache.get(key)
+        if fs is None:
+            factory = cls._registry.get(scheme)
+            if factory is None:
+                raise ValueError(f"no FileSystem for scheme {scheme!r}; "
+                                 f"registered: {sorted(cls._registry)}")
+            fs = factory(conf)
+            cls._cache[key] = fs
+        return fs
+
+    @classmethod
+    def clear_cache(cls) -> None:
+        cls._cache.clear()
+
+    # ------------------------------------------------------------ contract
+
+    @abstractmethod
+    def open(self, path: "str | Path") -> BinaryIO: ...
+
+    @abstractmethod
+    def create(self, path: "str | Path", overwrite: bool = True) -> BinaryIO: ...
+
+    @abstractmethod
+    def append(self, path: "str | Path") -> BinaryIO: ...
+
+    @abstractmethod
+    def exists(self, path: "str | Path") -> bool: ...
+
+    @abstractmethod
+    def get_status(self, path: "str | Path") -> FileStatus: ...
+
+    @abstractmethod
+    def list_status(self, path: "str | Path") -> list[FileStatus]: ...
+
+    @abstractmethod
+    def mkdirs(self, path: "str | Path") -> bool: ...
+
+    @abstractmethod
+    def delete(self, path: "str | Path", recursive: bool = False) -> bool: ...
+
+    @abstractmethod
+    def rename(self, src: "str | Path", dst: "str | Path") -> bool: ...
+
+    # ------------------------------------------------------------ defaults
+
+    def get_block_locations(self, path: "str | Path", offset: int,
+                            length: int) -> list[BlockLocation]:
+        """Default: single localhost block (local FSes have no placement)."""
+        return [BlockLocation(["localhost"], offset, length)]
+
+    def glob_status(self, pattern: "str | Path") -> list[FileStatus]:
+        """Glob on the final path component(s) (≈ FileSystem.globStatus —
+        supports * ? [] on each component)."""
+        pat = Path(pattern)
+        comps = [c for c in pat.path.split("/") if c]
+        base = Path(str(pat))
+        base.path = "/"
+        candidates = [base]
+        for comp in comps:
+            nxt: list[Path] = []
+            if re.search(r"[*?\[]", comp):
+                for c in candidates:
+                    if not self.exists(c) or not self.get_status(c).is_dir:
+                        continue
+                    for st in self.list_status(c):
+                        if fnmatch.fnmatchcase(st.path.name, comp):
+                            nxt.append(st.path)
+            else:
+                for c in candidates:
+                    nxt.append(c.child(comp))
+            candidates = nxt
+        return sorted((self.get_status(c) for c in candidates if self.exists(c)),
+                      key=lambda s: str(s.path))
+
+    # convenience
+
+    def read_bytes(self, path: "str | Path") -> bytes:
+        with self.open(path) as f:
+            return f.read()
+
+    def write_bytes(self, path: "str | Path", data: bytes) -> None:
+        with self.create(path) as f:
+            f.write(data)
+
+    def list_files(self, path: "str | Path", recursive: bool = False) -> list[FileStatus]:
+        out: list[FileStatus] = []
+        for st in self.list_status(path):
+            if st.is_dir:
+                if recursive:
+                    out.extend(self.list_files(st.path, True))
+            else:
+                out.append(st)
+        return out
+
+    def copy(self, src: "str | Path", dst_fs: "FileSystem", dst: "str | Path") -> None:
+        dst_fs.write_bytes(dst, self.read_bytes(src))
+
+    def content_length(self, path: "str | Path") -> int:
+        """Total bytes under path (file or directory tree)."""
+        st = self.get_status(path)
+        if not st.is_dir:
+            return st.length
+        return sum(f.length for f in self.list_files(path, recursive=True))
+
+
+def get_filesystem(uri: "str | Path", conf: Any = None) -> FileSystem:
+    return FileSystem.get(uri, conf)

@@ -1,0 +1,186 @@
+"""In-memory filesystem for tests and mini-clusters.
+
+≈ the role of the reference's test-time simulated storage (MiniDFSCluster's
+simulated data dirs, src/test/org/apache/hadoop/hdfs/MiniDFSCluster.java):
+a process-local FS with fake block locations so locality-aware scheduling is
+exercisable without disks or daemons.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from io import BytesIO
+from typing import Any, BinaryIO
+
+from tpumr.fs.filesystem import BlockLocation, FileStatus, FileSystem, Path
+
+
+class _MemWriter(BytesIO):
+    def __init__(self, fs: "InMemoryFileSystem", key: str) -> None:
+        super().__init__()
+        self._fs = fs
+        self._key = key
+
+    def close(self) -> None:
+        with self._fs._lock:
+            self._fs._files[self._key] = (self.getvalue(), time.time())
+        super().close()
+
+
+class InMemoryFileSystem(FileSystem):
+    scheme = "mem"
+
+    #: fake hosts assigned round-robin per block for locality tests
+    fake_hosts: list[str] = ["host0", "host1", "host2"]
+    block_size = 4 * 1024 * 1024
+
+    def __init__(self, conf: Any = None) -> None:
+        self.conf = conf
+        self._files: dict[str, tuple[bytes, float]] = {}
+        self._dirs: set[str] = {"/"}
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def _key(path: "str | Path") -> str:
+        return Path(path).path
+
+    def open(self, path: "str | Path") -> BinaryIO:
+        with self._lock:
+            ent = self._files.get(self._key(path))
+        if ent is None:
+            raise FileNotFoundError(str(path))
+        return BytesIO(ent[0])
+
+    def create(self, path: "str | Path", overwrite: bool = True) -> BinaryIO:
+        k = self._key(path)
+        with self._lock:
+            if not overwrite and k in self._files:
+                raise FileExistsError(k)
+            # implicit parent dirs
+            parts = k.split("/")
+            for i in range(1, len(parts)):
+                self._dirs.add("/".join(parts[:i]) or "/")
+        return _MemWriter(self, k)
+
+    def append(self, path: "str | Path") -> BinaryIO:
+        k = self._key(path)
+        w = _MemWriter(self, k)
+        with self._lock:
+            if k in self._files:
+                w.write(self._files[k][0])
+        return w
+
+    def exists(self, path: "str | Path") -> bool:
+        k = self._key(path)
+        with self._lock:
+            return k in self._files or k in self._dirs
+
+    def get_status(self, path: "str | Path") -> FileStatus:
+        k = self._key(path)
+        with self._lock:
+            if k in self._files:
+                data, mtime = self._files[k]
+                return FileStatus(Path(f"mem://{k}"), length=len(data),
+                                  is_dir=False, mtime=mtime,
+                                  block_size=self.block_size)
+            if k in self._dirs:
+                return FileStatus(Path(f"mem://{k}"), is_dir=True)
+        raise FileNotFoundError(str(path))
+
+    def list_status(self, path: "str | Path") -> list[FileStatus]:
+        k = self._key(path).rstrip("/") or "/"
+        prefix = k if k.endswith("/") else k + "/"
+        if k == "/":
+            prefix = "/"
+        seen: dict[str, FileStatus] = {}
+        with self._lock:
+            names = list(self._files) + list(self._dirs)
+        for name in names:
+            if name == k or not name.startswith(prefix):
+                continue
+            rest = name[len(prefix):]
+            child = rest.split("/", 1)[0]
+            cpath = prefix + child
+            if cpath not in seen:
+                seen[cpath] = self.get_status(cpath)
+        return sorted(seen.values(), key=lambda s: str(s.path))
+
+    def mkdirs(self, path: "str | Path") -> bool:
+        k = self._key(path)
+        with self._lock:
+            parts = k.split("/")
+            for i in range(1, len(parts) + 1):
+                self._dirs.add("/".join(parts[:i]) or "/")
+        return True
+
+    def delete(self, path: "str | Path", recursive: bool = False) -> bool:
+        k = self._key(path)
+        with self._lock:
+            if k in self._files:
+                del self._files[k]
+                return True
+            if k in self._dirs:
+                children = [f for f in self._files if f.startswith(k + "/")]
+                subdirs = [d for d in self._dirs if d.startswith(k + "/")]
+                if (children or subdirs) and not recursive:
+                    raise OSError(f"directory not empty: {k}")
+                for f in children:
+                    del self._files[f]
+                for d in subdirs:
+                    self._dirs.discard(d)
+                self._dirs.discard(k)
+                return True
+        return False
+
+    def rename(self, src: "str | Path", dst: "str | Path") -> bool:
+        s, d = self._key(src), self._key(dst)
+        with self._lock:
+            if s in self._files:
+                self._files[d] = self._files.pop(s)
+                parts = d.split("/")
+                for i in range(1, len(parts)):
+                    self._dirs.add("/".join(parts[:i]) or "/")
+                return True
+            if s in self._dirs:
+                moves = [(f, d + f[len(s):]) for f in list(self._files)
+                         if f.startswith(s + "/")]
+                for old, new in moves:
+                    self._files[new] = self._files.pop(old)
+                dmoves = [(x, d + x[len(s):]) for x in list(self._dirs)
+                          if x.startswith(s + "/")]
+                for old, new in dmoves:
+                    self._dirs.discard(old)
+                    self._dirs.add(new)
+                self._dirs.discard(s)
+                self._dirs.add(d)
+                parts = d.split("/")
+                for i in range(1, len(parts)):
+                    self._dirs.add("/".join(parts[:i]) or "/")
+                return True
+        return False
+
+    def get_block_locations(self, path: "str | Path", offset: int,
+                            length: int) -> list[BlockLocation]:
+        """Fake block→host placement: block i of a file lives on
+        fake_hosts[(crc32(path)+i) % len] — deterministic across processes,
+        exercisable by locality tests (≈ MiniDFSCluster rack/host ctor args)."""
+        import zlib
+        key = self._key(path)
+        base = zlib.crc32(key.encode())
+        with self._lock:
+            ent = self._files.get(key)
+        file_len = len(ent[0]) if ent is not None else offset + length
+        end = min(offset + length, file_len)
+        out = []
+        bs = self.block_size
+        pos = (offset // bs) * bs
+        while pos < end:
+            idx = pos // bs
+            host = self.fake_hosts[(base + idx) % len(self.fake_hosts)]
+            out.append(BlockLocation([host], pos, min(bs, end - pos)))
+            pos += bs
+        return out or [BlockLocation([self.fake_hosts[base % len(self.fake_hosts)]], offset, 0)]
+
+
+FileSystem.register("mem", InMemoryFileSystem)

@@ -1,0 +1,79 @@
+"""Local filesystem ≈ ``org.apache.hadoop.fs.RawLocalFileSystem``
+(reference: src/core/org/apache/hadoop/fs/RawLocalFileSystem.java). Checksum
+wrapping (ChecksumFileSystem) is intentionally not replicated — modern local
+storage and the DFS-lite layer carry their own integrity checks.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, BinaryIO
+
+from tpumr.fs.filesystem import FileStatus, FileSystem, Path
+
+
+class LocalFileSystem(FileSystem):
+    scheme = "file"
+
+    def __init__(self, conf: Any = None) -> None:
+        self.conf = conf
+
+    @staticmethod
+    def _local(path: "str | Path") -> str:
+        return Path(path).path
+
+    def open(self, path: "str | Path") -> BinaryIO:
+        return open(self._local(path), "rb")
+
+    def create(self, path: "str | Path", overwrite: bool = True) -> BinaryIO:
+        p = self._local(path)
+        if not overwrite and os.path.exists(p):
+            raise FileExistsError(p)
+        os.makedirs(os.path.dirname(p) or "/", exist_ok=True)
+        return open(p, "wb")
+
+    def append(self, path: "str | Path") -> BinaryIO:
+        return open(self._local(path), "ab")
+
+    def exists(self, path: "str | Path") -> bool:
+        return os.path.exists(self._local(path))
+
+    def get_status(self, path: "str | Path") -> FileStatus:
+        p = self._local(path)
+        st = os.stat(p)
+        return FileStatus(path=Path(f"file://{p}"), length=st.st_size,
+                          is_dir=os.path.isdir(p), mtime=st.st_mtime)
+
+    def list_status(self, path: "str | Path") -> list[FileStatus]:
+        p = self._local(path)
+        return [self.get_status(Path(f"file://{p}").child(name))
+                for name in sorted(os.listdir(p))]
+
+    def mkdirs(self, path: "str | Path") -> bool:
+        os.makedirs(self._local(path), exist_ok=True)
+        return True
+
+    def delete(self, path: "str | Path", recursive: bool = False) -> bool:
+        p = self._local(path)
+        if not os.path.exists(p):
+            return False
+        if os.path.isdir(p):
+            if recursive:
+                shutil.rmtree(p)
+            else:
+                os.rmdir(p)
+        else:
+            os.remove(p)
+        return True
+
+    def rename(self, src: "str | Path", dst: "str | Path") -> bool:
+        s, d = self._local(src), self._local(dst)
+        if not os.path.exists(s):
+            return False
+        os.makedirs(os.path.dirname(d) or "/", exist_ok=True)
+        os.replace(s, d)
+        return True
+
+
+FileSystem.register("file", LocalFileSystem)

@@ -1,0 +1,11 @@
+from tpumr.io.writable import (
+    write_vint, read_vint, encode_kv, decode_kv,
+    serialize, deserialize, RawBytesComparator,
+)
+from tpumr.io.recordbatch import RecordBatch, DenseBatch
+
+__all__ = [
+    "write_vint", "read_vint", "encode_kv", "decode_kv",
+    "serialize", "deserialize", "RawBytesComparator",
+    "RecordBatch", "DenseBatch",
+]

@@ -1,0 +1,126 @@
+"""Compression codec framework.
+
+≈ ``org.apache.hadoop.io.compress`` (reference: src/core/org/apache/hadoop/
+io/compress/ + JNI zlib/snappy in src/native/): pluggable codecs addressed by
+name / file extension, used by SequenceFile blocks, IFile spill segments and
+shuffle transfers. Python's zlib/gzip/bz2/lzma stand in for the JNI codecs; a
+snappy codec is registered only if the optional module is importable.
+"""
+
+from __future__ import annotations
+
+import bz2
+import gzip
+import lzma
+import zlib
+
+
+class CompressionCodec:
+    name = "none"
+    extension = ""
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes) -> bytes:
+        return data
+
+
+class ZlibCodec(CompressionCodec):
+    """≈ DefaultCodec/zlib (src/native/.../zlib/ZlibCompressor.c)."""
+    name = "zlib"
+    extension = ".deflate"
+
+    def __init__(self, level: int = 6) -> None:
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes) -> bytes:
+        return zlib.decompress(data)
+
+
+class GzipCodec(CompressionCodec):
+    name = "gzip"
+    extension = ".gz"
+
+    def compress(self, data: bytes) -> bytes:
+        return gzip.compress(data)
+
+    def decompress(self, data: bytes) -> bytes:
+        return gzip.decompress(data)
+
+
+class Bzip2Codec(CompressionCodec):
+    name = "bzip2"
+    extension = ".bz2"
+
+    def compress(self, data: bytes) -> bytes:
+        return bz2.compress(data)
+
+    def decompress(self, data: bytes) -> bytes:
+        return bz2.decompress(data)
+
+
+class LzmaCodec(CompressionCodec):
+    name = "lzma"
+    extension = ".xz"
+
+    def compress(self, data: bytes) -> bytes:
+        return lzma.compress(data)
+
+    def decompress(self, data: bytes) -> bytes:
+        return lzma.decompress(data)
+
+
+class NullCodec(CompressionCodec):
+    name = "none"
+
+
+_REGISTRY: dict[str, type[CompressionCodec]] = {
+    "none": NullCodec,
+    "zlib": ZlibCodec,
+    "default": ZlibCodec,
+    "gzip": GzipCodec,
+    "bzip2": Bzip2Codec,
+    "lzma": LzmaCodec,
+}
+
+try:  # optional, mirrors the reference's build-time snappy gate
+    import snappy as _snappy  # type: ignore
+
+    class SnappyCodec(CompressionCodec):
+        name = "snappy"
+        extension = ".snappy"
+
+        def compress(self, data: bytes) -> bytes:
+            return _snappy.compress(data)
+
+        def decompress(self, data: bytes) -> bytes:
+            return _snappy.decompress(data)
+
+    _REGISTRY["snappy"] = SnappyCodec
+except ImportError:
+    pass
+
+
+def get_codec(name: str | None) -> CompressionCodec:
+    if not name:
+        return NullCodec()
+    cls = _REGISTRY.get(name.lower())
+    if cls is None:
+        raise ValueError(f"unknown codec {name!r}; have {sorted(_REGISTRY)}")
+    return cls()
+
+
+def codec_for_path(path: str) -> CompressionCodec | None:
+    """Pick a codec by file extension (≈ CompressionCodecFactory)."""
+    for cls in _REGISTRY.values():
+        if cls.extension and path.endswith(cls.extension):
+            return cls()
+    return None
+
+
+def register_codec(cls: type[CompressionCodec]) -> None:
+    _REGISTRY[cls.name] = cls

@@ -1,0 +1,192 @@
+"""Binary record serialization.
+
+TPU-era stand-in for the reference's ``Writable`` machinery
+(src/core/org/apache/hadoop/io/ — IntWritable, LongWritable, Text,
+BytesWritable, WritableComparator…): a compact self-describing binary codec
+for the Python value types jobs exchange, plus raw byte-wise comparators for
+sort order (≈ WritableComparator.compareBytes). Unlike the reference we do
+NOT serialize per record on the device path — device jobs use
+``tpumr.io.recordbatch`` columnar batches; this codec is for container files,
+shuffle frames and RPC payloads.
+"""
+
+from __future__ import annotations
+
+import struct
+from io import BytesIO
+from typing import Any, BinaryIO
+
+import numpy as np
+
+# ------------------------------------------------------------------ varints
+# Unsigned LEB128 (different encoding than WritableUtils.writeVInt, same role)
+
+
+def write_vint(out: BinaryIO, value: int) -> None:
+    if value < 0:
+        raise ValueError("write_vint takes unsigned values; use zigzag first")
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.write(bytes((b | 0x80,)))
+        else:
+            out.write(bytes((b,)))
+            return
+
+
+def read_vint(inp: BinaryIO) -> int:
+    shift = 0
+    result = 0
+    while True:
+        raw = inp.read(1)
+        if not raw:
+            raise EOFError("EOF inside vint")
+        b = raw[0]
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result
+        shift += 7
+
+
+def zigzag(v: int) -> int:
+    return (v << 1) if v >= 0 else ((-v) << 1) - 1
+
+
+def unzigzag(v: int) -> int:
+    return (v >> 1) if not v & 1 else -((v + 1) >> 1)
+
+
+# ------------------------------------------------------------------ typed codec
+
+_T_NULL = 0
+_T_BYTES = 1
+_T_TEXT = 2
+_T_INT = 3      # zigzag varint
+_T_FLOAT = 4    # float64 BE
+_T_BOOL_T = 5
+_T_BOOL_F = 6
+_T_LIST = 7
+_T_NDARRAY = 8  # dtype-str, shape, raw bytes
+_T_DICT = 9
+
+
+def serialize(obj: Any, out: BinaryIO | None = None) -> bytes | None:
+    """Encode a value to the typed binary format."""
+    buf = out or BytesIO()
+    _write(buf, obj)
+    if out is None:
+        return buf.getvalue()  # type: ignore[union-attr]
+    return None
+
+
+def _write(out: BinaryIO, obj: Any) -> None:
+    if obj is None:
+        out.write(bytes((_T_NULL,)))
+    elif isinstance(obj, bool):
+        out.write(bytes((_T_BOOL_T if obj else _T_BOOL_F,)))
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        b = bytes(obj)
+        out.write(bytes((_T_BYTES,)))
+        write_vint(out, len(b))
+        out.write(b)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.write(bytes((_T_TEXT,)))
+        write_vint(out, len(b))
+        out.write(b)
+    elif isinstance(obj, (int, np.integer)):
+        out.write(bytes((_T_INT,)))
+        write_vint(out, zigzag(int(obj)))
+    elif isinstance(obj, (float, np.floating)):
+        out.write(bytes((_T_FLOAT,)))
+        out.write(struct.pack(">d", float(obj)))
+    elif isinstance(obj, np.ndarray):
+        out.write(bytes((_T_NDARRAY,)))
+        dt = obj.dtype.str.encode()
+        write_vint(out, len(dt))
+        out.write(dt)
+        write_vint(out, obj.ndim)
+        for d in obj.shape:
+            write_vint(out, d)
+        raw = np.ascontiguousarray(obj).tobytes()
+        write_vint(out, len(raw))
+        out.write(raw)
+    elif isinstance(obj, (list, tuple)):
+        out.write(bytes((_T_LIST,)))
+        write_vint(out, len(obj))
+        for item in obj:
+            _write(out, item)
+    elif isinstance(obj, dict):
+        out.write(bytes((_T_DICT,)))
+        write_vint(out, len(obj))
+        for k, v in obj.items():
+            _write(out, k)
+            _write(out, v)
+    else:
+        raise TypeError(f"unserializable type {type(obj)!r}")
+
+
+def deserialize(data: "bytes | BinaryIO") -> Any:
+    inp = BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
+    return _read(inp)
+
+
+def _read(inp: BinaryIO) -> Any:
+    raw = inp.read(1)
+    if not raw:
+        raise EOFError("EOF at value tag")
+    tag = raw[0]
+    if tag == _T_NULL:
+        return None
+    if tag == _T_BOOL_T:
+        return True
+    if tag == _T_BOOL_F:
+        return False
+    if tag == _T_BYTES:
+        return inp.read(read_vint(inp))
+    if tag == _T_TEXT:
+        return inp.read(read_vint(inp)).decode("utf-8")
+    if tag == _T_INT:
+        return unzigzag(read_vint(inp))
+    if tag == _T_FLOAT:
+        return struct.unpack(">d", inp.read(8))[0]
+    if tag == _T_NDARRAY:
+        dt = np.dtype(inp.read(read_vint(inp)).decode())
+        ndim = read_vint(inp)
+        shape = tuple(read_vint(inp) for _ in range(ndim))
+        raw_bytes = inp.read(read_vint(inp))
+        return np.frombuffer(raw_bytes, dtype=dt).reshape(shape).copy()
+    if tag == _T_LIST:
+        return [_read(inp) for _ in range(read_vint(inp))]
+    if tag == _T_DICT:
+        n = read_vint(inp)
+        return {_read(inp): _read(inp) for _ in range(n)}
+    raise ValueError(f"bad type tag {tag}")
+
+
+# ------------------------------------------------------------------ kv frames
+
+
+def encode_kv(key: Any, value: Any) -> tuple[bytes, bytes]:
+    """Serialize a key/value pair to raw bytes (sortable for keys via
+    RawBytesComparator when keys share a type)."""
+    return serialize(key), serialize(value)  # type: ignore[return-value]
+
+
+def decode_kv(kbytes: bytes, vbytes: bytes) -> tuple[Any, Any]:
+    return deserialize(kbytes), deserialize(vbytes)
+
+
+class RawBytesComparator:
+    """Byte-wise lexicographic comparator ≈ WritableComparator.compareBytes
+    (src/core/org/apache/hadoop/io/WritableComparator.java). Python bytes
+    compare lexicographically natively; this class exists as the SPI seam for
+    custom raw comparators (JobConf.setOutputKeyComparatorClass)."""
+
+    def compare(self, a: bytes, b: bytes) -> int:
+        return (a > b) - (a < b)
+
+    def sort_key(self, a: bytes) -> Any:
+        """Key-extractor form used by Python sorts."""
+        return a

@@ -1,0 +1,3 @@
+from tpumr.utils.reflection import resolve_class, new_instance
+
+__all__ = ["resolve_class", "new_instance"]

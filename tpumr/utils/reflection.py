@@ -1,0 +1,46 @@
+"""Class resolution helpers ≈ ``org.apache.hadoop.util.ReflectionUtils``
+(reference: src/core/org/apache/hadoop/util/ReflectionUtils.java): turn dotted
+class names from configuration into classes and construct configured
+instances.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+
+def resolve_class(name: str) -> type:
+    """Resolve 'pkg.mod.Class' or 'pkg.mod.Outer.Inner' to the class object."""
+    parts = name.split(".")
+    for split in range(len(parts) - 1, 0, -1):
+        mod_name = ".".join(parts[:split])
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError:
+            continue
+        obj: Any = mod
+        try:
+            for attr in parts[split:]:
+                obj = getattr(obj, attr)
+        except AttributeError:
+            continue
+        if isinstance(obj, type):
+            return obj
+        raise TypeError(f"{name} resolved to non-class {obj!r}")
+    raise ImportError(f"cannot resolve class {name!r}")
+
+
+def new_instance(cls: "type | str", conf: Any = None) -> Any:
+    """Instantiate, passing conf if the class accepts it (≈
+    ReflectionUtils.newInstance + setConf on Configurable)."""
+    if isinstance(cls, str):
+        cls = resolve_class(cls)
+    obj = cls()
+    if conf is not None and hasattr(obj, "set_conf"):
+        obj.set_conf(conf)
+    return obj
+
+
+def class_name(cls: type) -> str:
+    return f"{cls.__module__}.{cls.__qualname__}"
