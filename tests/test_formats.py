@@ -1,0 +1,146 @@
+"""Input/output format tests ≈ reference TestTextInputFormat,
+TestSequenceFileInputFormat, TestFileOutputCommitter."""
+
+import numpy as np
+
+from tpumr.fs import get_filesystem
+from tpumr.io import sequencefile
+from tpumr.mapred.input_formats import (
+    CombineFileInputFormat, DenseInputFormat, NLineInputFormat,
+    SequenceFileInputFormat, TextInputFormat,
+)
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.output_formats import FileOutputCommitter
+from tpumr.mapred.split import FileSplit
+
+
+def _conf(**kv):
+    conf = JobConf()
+    conf.set("fs.default.name", "mem:///")
+    for k, v in kv.items():
+        conf.set(k.replace("_", "."), v)
+    return conf
+
+
+def test_text_splits_cover_all_lines():
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    lines = [f"line number {i}".encode() for i in range(1000)]
+    fs.write_bytes("/in/data.txt", b"\n".join(lines) + b"\n")
+    conf.set_input_paths("mem:///in")
+    fmt = TextInputFormat()
+    splits = fmt.get_splits(conf, 7)
+    assert len(splits) > 1
+    got = []
+    for s in splits:
+        got.extend(v for _, v in fmt.get_record_reader(s, conf))
+    assert len(got) == 1000
+    assert sorted(got) == sorted(line.decode() for line in lines)
+
+
+def test_text_split_boundary_ownership():
+    """A line crossing a split boundary is read by exactly one split."""
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    data = b"aaaa\nbbbbbbbbbb\ncc\ndddddd\n"
+    fs.write_bytes("/in/x.txt", data)
+    conf.set_input_paths("mem:///in/x.txt")
+    fmt = TextInputFormat()
+    # force splits at awkward boundaries
+    for cut in range(1, len(data) - 1):
+        s1 = FileSplit([], "mem:///in/x.txt", 0, cut)
+        s2 = FileSplit([], "mem:///in/x.txt", cut, len(data) - cut)
+        vals = [v for _, v in fmt.get_record_reader(s1, conf)]
+        vals += [v for _, v in fmt.get_record_reader(s2, conf)]
+        assert vals == ["aaaa", "bbbbbbbbbb", "cc", "dddddd"], f"cut={cut}"
+
+
+def test_nline_input_format():
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/in/n.txt", b"".join(f"r{i}\n".encode() for i in range(10)))
+    conf.set_input_paths("mem:///in/n.txt")
+    conf.set("mapred.line.input.format.linespermap", 3)
+    fmt = NLineInputFormat()
+    splits = fmt.get_splits(conf, 1)
+    assert len(splits) == 4  # 3+3+3+1
+    sizes = [len(list(fmt.get_record_reader(s, conf))) for s in splits]
+    assert sizes == [3, 3, 3, 1]
+
+
+def test_sequencefile_input_format():
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    with fs.create("/in/data.seq") as f:
+        w = sequencefile.Writer(f, block_records=10)
+        for i in range(500):
+            w.append(i, f"value-{i}")
+        w.close()
+    conf.set_input_paths("mem:///in/data.seq")
+    conf.set("mapred.min.split.size", 1)
+    fmt = SequenceFileInputFormat()
+    splits = fmt.get_splits(conf, 5)
+    got = []
+    for s in splits:
+        got.extend(fmt.get_record_reader(s, conf))
+    assert len(got) == 500
+    assert sorted(k for k, _ in got) == list(range(500))
+
+
+def test_combine_input_format():
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    for i in range(20):
+        fs.write_bytes(f"/many/f{i:02d}.txt", f"data{i}\n".encode())
+    conf.set_input_paths("mem:///many")
+    conf.set("mapred.max.split.size", 30)
+    fmt = CombineFileInputFormat()
+    splits = fmt.get_splits(conf, 1)
+    assert 1 < len(splits) < 20
+    got = [v for s in splits for _, v in fmt.get_record_reader(s, conf)]
+    assert len(got) == 20
+
+
+def test_dense_input_format():
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    arr = np.arange(40, dtype=np.float32).reshape(10, 4)
+    import io
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    fs.write_bytes("/dense/pts.npy", buf.getvalue())
+    conf.set_input_paths("mem:///dense/pts.npy")
+    conf.set("tpumr.dense.split.rows", 4)
+    fmt = DenseInputFormat()
+    splits = fmt.get_splits(conf, 1)
+    assert [s.num_rows for s in splits] == [4, 4, 2]
+    batch = fmt.read_batch(splits[1], conf)
+    np.testing.assert_array_equal(batch.values, arr[4:8])
+    assert batch.ids.tolist() == [4, 5, 6, 7]
+    # CPU fallback reader
+    rows = list(fmt.get_record_reader(splits[2], conf))
+    assert rows[0][0] == 8 and rows[1][0] == 9
+
+
+def test_output_committer_speculative_and_abort():
+    conf = _conf()
+    conf.set("mapred.output.dir", "mem:///out")
+    fs = get_filesystem("mem:///")
+    c = FileOutputCommitter(conf)
+    c.setup_job()
+    # two speculative attempts of the same task write the same file name
+    wd0 = c.setup_task("attempt_x_0001_r_000000_0")
+    wd1 = c.setup_task("attempt_x_0001_r_000000_1")
+    fs.write_bytes(f"{wd0}/part-00000", b"winner")
+    fs.write_bytes(f"{wd1}/part-00000", b"loser")
+    c.commit_task("attempt_x_0001_r_000000_0")
+    c.commit_task("attempt_x_0001_r_000000_1")  # duplicate is dropped
+    assert fs.read_bytes("mem:///out/part-00000") == b"winner"
+    # aborted attempt leaves nothing
+    wd2 = c.setup_task("attempt_x_0001_r_000001_0")
+    fs.write_bytes(f"{wd2}/part-00001", b"junk")
+    c.abort_task("attempt_x_0001_r_000001_0")
+    c.commit_job()
+    names = [s.path.name for s in fs.list_files("mem:///out")]
+    assert "part-00001" not in names
+    assert "_SUCCESS" in names

@@ -1,0 +1,173 @@
+"""End-to-end single-process jobs ≈ the reference's LocalJobRunner tier +
+MapOutputBuffer spill semantics (SURVEY.md §4.3, MapTask.java:1396)."""
+
+import pytest
+
+from tpumr.core.counters import JobCounter, TaskCounter
+from tpumr.fs import get_filesystem
+from tpumr.mapred import JobConf, Mapper, Reducer, run_job
+from tpumr.mapred.api import RawComparator, Reporter
+from tpumr.mapred.map_task import MapOutputBuffer
+
+
+class WordCountMapper(Mapper):
+    def map(self, key, value, output, reporter):
+        for w in value.split():
+            output.collect(w, 1)
+
+
+class SumReducer(Reducer):
+    def reduce(self, key, values, output, reporter):
+        output.collect(key, sum(values))
+
+
+TEXT = """the quick brown fox
+jumps over the lazy dog
+the dog barks
+"""
+
+
+def _wordcount_conf(reduces=2, **extra):
+    conf = JobConf()
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/in/text.txt", TEXT.encode() * 50)
+    conf.set_input_paths("mem:///in")
+    conf.set_output_path("mem:///out")
+    conf.set_mapper_class(WordCountMapper)
+    conf.set_reducer_class(SumReducer)
+    conf.set_num_reduce_tasks(reduces)
+    conf.set("mapred.map.tasks", 4)
+    conf.set("mapred.min.split.size", 1)
+    for k, v in extra.items():
+        conf.set(k, v)
+    return conf
+
+
+def _read_output(path="mem:///out"):
+    fs = get_filesystem("mem:///")
+    out = {}
+    for st in fs.list_files(path):
+        if st.path.name.startswith("part-"):
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                k, v = line.split("\t")
+                assert k not in out, f"duplicate key {k} across partitions"
+                out[k] = int(v)
+    return out
+
+
+def test_wordcount_end_to_end():
+    result = run_job(_wordcount_conf())
+    assert result.successful
+    out = _read_output()
+    assert out["the"] == 150
+    assert out["dog"] == 100
+    assert out["fox"] == 50
+    assert result.num_maps >= 2
+    c = result.counters
+    assert c.value(TaskCounter.FRAMEWORK_GROUP, TaskCounter.MAP_INPUT_RECORDS) == 150
+    assert c.value(JobCounter.GROUP, JobCounter.LAUNCHED_MAP_TASKS) == result.num_maps
+    assert c.value(JobCounter.GROUP, JobCounter.LAUNCHED_REDUCE_TASKS) == 2
+
+
+def test_wordcount_with_combiner_and_spills():
+    conf = _wordcount_conf(reduces=1)
+    conf.set_combiner_class(SumReducer)
+    conf.set("io.sort.mb", 1)
+    conf.set("io.sort.spill.percent", 0.0001)  # force many spills
+    result = run_job(conf)
+    assert result.successful
+    out = _read_output()
+    assert out["the"] == 150
+    spilled = result.counters.value(TaskCounter.FRAMEWORK_GROUP,
+                                    TaskCounter.SPILLED_RECORDS)
+    assert spilled > 0
+    combined = result.counters.value(TaskCounter.FRAMEWORK_GROUP,
+                                     TaskCounter.COMBINE_INPUT_RECORDS)
+    assert combined > 0
+
+
+def test_wordcount_parallel_maps():
+    conf = _wordcount_conf(reduces=2)
+    conf.set("mapred.local.map.tasks.maximum", 4)
+    result = run_job(conf)
+    assert result.successful
+    assert _read_output()["the"] == 150
+
+
+def test_map_only_job():
+    class UpperMapper(Mapper):
+        def map(self, key, value, output, reporter):
+            output.collect(None, value.upper())
+
+    conf = JobConf()
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/in/t.txt", b"hello\nworld\n")
+    conf.set_input_paths("mem:///in")
+    conf.set_output_path("mem:///out-maponly")
+    conf.set_mapper_class(UpperMapper)
+    conf.set_num_reduce_tasks(0)
+    result = run_job(conf)
+    assert result.successful
+    data = b"".join(fs.read_bytes(s.path)
+                    for s in fs.list_files("mem:///out-maponly")
+                    if s.path.name.startswith("part-"))
+    assert data == b"HELLO\nWORLD\n"
+
+
+def test_output_exists_refused():
+    conf = _wordcount_conf()
+    assert run_job(conf).successful
+    with pytest.raises(FileExistsError):
+        run_job(_wordcount_conf())
+
+
+def test_reduce_output_sorted_within_partition():
+    conf = _wordcount_conf(reduces=1)
+    run_job(conf)
+    fs = get_filesystem("mem:///")
+    lines = fs.read_bytes("mem:///out/part-00000").decode().splitlines()
+    keys = [ln.split("\t")[0] for ln in lines]
+    assert keys == sorted(keys)
+
+
+def test_map_output_buffer_raw_comparator():
+    """Byte keys + RawComparator keep byte-lexicographic order."""
+    conf = JobConf()
+    conf.set_output_key_comparator_class(RawComparator)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        buf = MapOutputBuffer(conf, 1, d, Reporter())
+        for k in [b"zz", b"aa", b"mm"]:
+            buf.collect(k, b"v")
+        path, index = buf.flush()
+        from tpumr.io import ifile
+        from tpumr.io.writable import deserialize
+        with open(path, "rb") as f:
+            keys = [deserialize(k) for k, _ in ifile.read_partition(f, index, 0)]
+        assert keys == [b"aa", b"mm", b"zz"]
+
+
+def test_secondary_sort_grouping():
+    """Composite keys (k, sub) sort by tuple order; grouping is exact-key —
+    the seam secondary sort rides on."""
+
+    class EmitPairs(Mapper):
+        def map(self, key, value, output, reporter):
+            k, sub, v = value.split(",")
+            output.collect((k, int(sub)), v)
+
+    class ConcatReducer(Reducer):
+        def reduce(self, key, values, output, reporter):
+            output.collect(f"{key[0]}#{key[1]}", "|".join(values))
+
+    conf = JobConf()
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/in2/p.txt", b"b,2,x\na,1,y\nb,1,z\na,1,w\n")
+    conf.set_input_paths("mem:///in2")
+    conf.set_output_path("mem:///out2")
+    conf.set_mapper_class(EmitPairs)
+    conf.set_reducer_class(ConcatReducer)
+    conf.set_num_reduce_tasks(1)
+    run_job(conf)
+    lines = get_filesystem("mem:///").read_bytes("mem:///out2/part-00000").decode().splitlines()
+    assert lines == ["a#1\ty|w", "b#1\tz", "b#2\tx"]

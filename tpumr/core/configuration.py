@@ -206,8 +206,15 @@ class Configuration:
         return resolve_class(str(v))
 
     def set_class(self, key: str, cls: type) -> None:
-        from tpumr.utils.reflection import class_name
-        self.set(key, class_name(cls))
+        from tpumr.utils.reflection import class_name, resolve_class
+        name = class_name(cls)
+        try:
+            importable = resolve_class(name) is cls
+        except (ImportError, TypeError):
+            importable = False
+        # dotted name when round-trippable (wire-safe for job submission);
+        # the class object itself otherwise (in-process local jobs only)
+        self.set(key, name if importable else cls)
 
     # ------------------------------------------------------------------ misc
 

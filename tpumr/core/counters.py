@@ -80,12 +80,14 @@ class CounterGroup:
     def __init__(self, name: str) -> None:
         self.name = name
         self._counters: dict[str, Counter] = {}
+        self._lock = threading.Lock()
 
     def counter(self, name: str) -> Counter:
-        c = self._counters.get(name)
-        if c is None:
-            c = self._counters[name] = Counter(name)
-        return c
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
 
     def __iter__(self) -> Iterator[Counter]:
         return iter(self._counters.values())

@@ -120,8 +120,30 @@ class Reader:
         for k, v in self.iter_raw():
             yield deserialize(k), deserialize(v)
 
-    def iter_raw(self) -> Iterator[tuple[bytes, bytes]]:
+    def iter_range(self, start: int, end: int) -> Iterator[tuple[Any, Any]]:
+        """Records of the split [start, end): from the first sync at/after
+        ``start`` up to the first sync at/after ``end`` (the split-reader
+        contract of SequenceFileRecordReader — every record is read by
+        exactly one of a set of covering splits)."""
+        if end <= self._header_end:
+            # the header's trailing sync marker is the file's first boundary:
+            # a split ending at/inside the header owns nothing (its successor
+            # starting there syncs to header_end and owns the first block)
+            return
+        if not self.sync(start):
+            return
+        if start > self._header_end:
+            # boundary = position of the 4-byte escape preceding the marker we
+            # landed on; if it is already past `end` this split owns nothing
+            boundary = self._in.tell() - SYNC_SIZE - 4
+            if boundary >= end:
+                return
+        for k, v in self.iter_raw(end=end):
+            yield deserialize(k), deserialize(v)
+
+    def iter_raw(self, end: int | None = None) -> Iterator[tuple[bytes, bytes]]:
         while True:
+            pos = self._in.tell()
             raw = self._in.read(4)
             if len(raw) < 4:
                 return
@@ -130,6 +152,8 @@ class Reader:
                 marker = self._in.read(SYNC_SIZE)
                 if marker != self._sync:
                     raise IOError("corrupt file: bad sync marker")
+                if end is not None and pos >= end:
+                    return
                 continue
             payload = self._in.read(length)
             if len(payload) < length:
@@ -149,7 +173,12 @@ class Reader:
         if pos <= self._header_end:
             self._in.seek(self._header_end)
             return True
-        self._in.seek(pos)
+        # Boundary identity is the 4-byte escape position: a marker "belongs"
+        # to pos iff its escape starts at >= pos, i.e. the marker pattern
+        # itself starts at >= pos+4. Scanning from pos+4 keeps this side
+        # consistent with iter_raw's end-side rule (escape pos >= end), so
+        # adjacent splits never double-own the 4-byte escape window.
+        self._in.seek(pos + 4)
         # scan for the 16-byte marker
         window = self._in.read(SYNC_SIZE)
         if len(window) < SYNC_SIZE:

@@ -1,0 +1,13 @@
+from tpumr.mapred.ids import JobID, TaskAttemptID, TaskID
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.api import (
+    Mapper, Reducer, Partitioner, HashPartitioner, Reporter, OutputCollector,
+)
+from tpumr.mapred.split import InputSplit, FileSplit
+from tpumr.mapred.local_runner import LocalJobRunner, run_job
+
+__all__ = [
+    "JobID", "TaskID", "TaskAttemptID", "JobConf",
+    "Mapper", "Reducer", "Partitioner", "HashPartitioner", "Reporter",
+    "OutputCollector", "InputSplit", "FileSplit", "LocalJobRunner", "run_job",
+]

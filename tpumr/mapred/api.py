@@ -1,0 +1,180 @@
+"""User-facing MapReduce API.
+
+≈ the reference's old API (``org.apache.hadoop.mapred.{Mapper,Reducer,
+MapRunnable,MapRunner,Partitioner,Reporter,OutputCollector}``). The
+class-based contract is kept — configure/map|reduce/close lifecycle,
+OutputCollector + Reporter threaded through — because the hybrid scheduler
+and the TPU runner select *runners* around it exactly like the reference
+selects PipesMapRunner vs PipesGPUMapRunner (mapred/MapTask.java:433-438).
+
+Device-kernel jobs don't subclass Mapper: they name a registered kernel
+(JobConf.set_map_kernel) and the TPU map runner (tpumr.mapred.tpu_runner)
+consumes whole batches. A Mapper subclass remains the CPU fallback for the
+same job, which is what makes hybrid CPU/TPU assignment meaningful.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+from tpumr.core.counters import Counters
+from tpumr.io.writable import deserialize, serialize
+
+
+class Reporter:
+    """≈ org.apache.hadoop.mapred.Reporter: progress + status + counters."""
+
+    def __init__(self, counters: Counters | None = None,
+                 on_progress: Callable[[float], None] | None = None) -> None:
+        self.counters = counters or Counters()
+        self._on_progress = on_progress
+        self.status = ""
+
+    def set_status(self, status: str) -> None:
+        self.status = status
+
+    def progress(self, fraction: float | None = None) -> None:
+        if self._on_progress is not None and fraction is not None:
+            self._on_progress(fraction)
+
+    def incr_counter(self, group: str, name: str, amount: int = 1) -> None:
+        self.counters.incr(group, name, amount)
+
+
+class OutputCollector:
+    """≈ org.apache.hadoop.mapred.OutputCollector."""
+
+    def __init__(self, fn: Callable[[Any, Any], None]) -> None:
+        self._fn = fn
+
+    def collect(self, key: Any, value: Any) -> None:
+        self._fn(key, value)
+
+    __call__ = collect
+
+
+class JobConfigurable:
+    def configure(self, conf: Any) -> None:  # ≈ JobConfigurable.configure
+        pass
+
+    def close(self) -> None:  # ≈ Closeable.close
+        pass
+
+
+class Mapper(JobConfigurable):
+    """≈ org.apache.hadoop.mapred.Mapper: map(key, value, output, reporter)."""
+
+    def map(self, key: Any, value: Any, output: OutputCollector,
+            reporter: Reporter) -> None:
+        raise NotImplementedError
+
+
+class Reducer(JobConfigurable):
+    """≈ org.apache.hadoop.mapred.Reducer:
+    reduce(key, values_iterator, output, reporter)."""
+
+    def reduce(self, key: Any, values: Iterator[Any], output: OutputCollector,
+               reporter: Reporter) -> None:
+        raise NotImplementedError
+
+
+class IdentityMapper(Mapper):
+    """≈ mapred/lib/IdentityMapper.java."""
+
+    def map(self, key, value, output, reporter):
+        output.collect(key, value)
+
+
+class IdentityReducer(Reducer):
+    """≈ mapred/lib/IdentityReducer.java."""
+
+    def reduce(self, key, values, output, reporter):
+        for v in values:
+            output.collect(key, v)
+
+
+class Partitioner(JobConfigurable):
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        raise NotImplementedError
+
+
+class HashPartitioner(Partitioner):
+    """≈ mapred/lib/HashPartitioner.java: (hash & MAX) % n — here a stable
+    digest of the serialized key (Python's hash() is process-randomized, and
+    partition choice must agree across hosts)."""
+
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        import zlib
+        return zlib.crc32(serialize(key)) % num_partitions
+
+
+class KeyFieldBasedPartitioner(Partitioner):
+    """≈ mapred/lib/KeyFieldBasedPartitioner.java (simplified): partitions on
+    the first ``num_fields`` tab-separated fields of a text key."""
+
+    def __init__(self, num_fields: int = 1, separator: str = "\t") -> None:
+        self.num_fields = num_fields
+        self.separator = separator
+
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        import zlib
+        s = key if isinstance(key, str) else str(key)
+        prefix = self.separator.join(s.split(self.separator)[: self.num_fields])
+        return zlib.crc32(prefix.encode()) % num_partitions
+
+
+# ------------------------------------------------------------ comparators
+
+
+class DeserializingComparator:
+    """Default sort order: natural Python ordering of the deserialized key
+    (≈ WritableComparable.compareTo on typed keys)."""
+
+    def sort_key(self, kbytes: bytes) -> Any:
+        return deserialize(kbytes)
+
+
+class RawComparator:
+    """Byte-lexicographic raw order (≈ WritableComparator.compareBytes) —
+    correct for keys whose serialized form sorts like the logical key
+    (e.g. fixed-width byte keys: terasort)."""
+
+    def sort_key(self, kbytes: bytes) -> Any:
+        return kbytes
+
+
+# ------------------------------------------------------------ map runners
+
+
+class MapRunnable(JobConfigurable):
+    """≈ org.apache.hadoop.mapred.MapRunnable. The reference grew a 4-arg
+    GPU overload run(input, output, reporter, runOnGPU)
+    (mapred/MapRunnable.java:50-53); here device placement arrives via
+    ``task_ctx`` so every runner sees the same signature."""
+
+    def run(self, reader: Any, output: OutputCollector, reporter: Reporter,
+            task_ctx: Any = None) -> None:
+        raise NotImplementedError
+
+
+class MapRunner(MapRunnable):
+    """Default record-loop runner ≈ mapred/MapRunner.java:71-92."""
+
+    def __init__(self, mapper: Mapper | None = None) -> None:
+        self.mapper = mapper
+        self.conf = None
+
+    def configure(self, conf: Any) -> None:
+        self.conf = conf
+        if self.mapper is None:
+            from tpumr.utils.reflection import new_instance
+            cls = conf.get_mapper_class() or IdentityMapper
+            self.mapper = new_instance(cls, conf)
+
+    def run(self, reader, output, reporter, task_ctx=None) -> None:
+        assert self.mapper is not None
+        try:
+            for key, value in reader:
+                self.mapper.map(key, value, output, reporter)
+        finally:
+            self.mapper.close()

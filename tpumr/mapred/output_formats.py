@@ -1,0 +1,172 @@
+"""Output formats + the two-phase output commit protocol.
+
+≈ ``org.apache.hadoop.mapred.{OutputFormat,TextOutputFormat,
+SequenceFileOutputFormat,FileOutputCommitter}``. The commit protocol is the
+reference's (FileOutputCommitter semantics, gated by the tracker's
+CommitTaskAction, mapred/TaskTracker.java:1725-1731): tasks write to
+``$out/_temporary/<attempt>/``; a successful attempt's dir is atomically
+promoted into ``$out``; job commit writes ``_SUCCESS`` and removes
+``_temporary`` — so re-executed/speculative attempts never corrupt output.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpumr.fs.filesystem import FileSystem, Path
+from tpumr.io import sequencefile
+
+TEMP_DIR = "_temporary"
+SUCCESS_MARKER = "_SUCCESS"
+
+
+def part_name(partition: int, prefix: str = "part") -> str:
+    return f"{prefix}-{partition:05d}"
+
+
+class RecordWriter:
+    def write(self, key: Any, value: Any) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class OutputFormat:
+    def get_record_writer(self, conf: Any, work_dir: str,
+                          partition: int) -> RecordWriter:
+        raise NotImplementedError
+
+    def check_output_specs(self, conf: Any) -> None:
+        """≈ OutputFormat.checkOutputSpecs: refuse to clobber existing
+        output (FileOutputFormat throws FileAlreadyExistsException)."""
+        out = conf.get("mapred.output.dir")
+        if not out:
+            raise ValueError("mapred.output.dir not set")
+        fs = FileSystem.get(out, conf)
+        # any non-empty existing output dir is refused — including leftovers
+        # of a crashed run (FileOutputFormat.checkOutputSpecs throws
+        # FileAlreadyExistsException on mere existence; we allow an empty dir)
+        if fs.exists(out) and (not fs.get_status(out).is_dir
+                               or fs.list_status(out)):
+            raise FileExistsError(f"output directory already exists: {out}")
+
+
+class _TextWriter(RecordWriter):
+    def __init__(self, stream, separator: str = "\t") -> None:
+        self._f = stream
+        self._sep = separator.encode()
+
+    def write(self, key: Any, value: Any) -> None:
+        def enc(x: Any) -> bytes:
+            if isinstance(x, bytes):
+                return x
+            return str(x).encode("utf-8")
+        if key is None:
+            self._f.write(enc(value) + b"\n")
+        else:
+            self._f.write(enc(key) + self._sep + enc(value) + b"\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class TextOutputFormat(OutputFormat):
+    """≈ org.apache.hadoop.mapred.TextOutputFormat: key<TAB>value lines."""
+
+    def get_record_writer(self, conf, work_dir, partition):
+        fs = FileSystem.get(work_dir, conf)
+        sep = conf.get("mapred.textoutputformat.separator", "\t")
+        f = fs.create(Path(work_dir).child(part_name(partition)))
+        return _TextWriter(f, sep)
+
+
+class _SeqWriter(RecordWriter):
+    def __init__(self, stream, codec: str) -> None:
+        self._f = stream
+        self._w = sequencefile.Writer(stream, codec=codec)
+
+    def write(self, key: Any, value: Any) -> None:
+        self._w.append(key, value)
+
+    def close(self) -> None:
+        self._w.close()
+        self._f.close()
+
+
+class SequenceFileOutputFormat(OutputFormat):
+    def get_record_writer(self, conf, work_dir, partition):
+        fs = FileSystem.get(work_dir, conf)
+        codec = conf.get("mapred.output.compression.codec", "none") \
+            if conf.get_boolean("mapred.output.compress", False) else "none"
+        f = fs.create(Path(work_dir).child(part_name(partition)))
+        return _SeqWriter(f, codec)
+
+
+class _NullWriter(RecordWriter):
+    def write(self, key: Any, value: Any) -> None:
+        pass
+
+
+class NullOutputFormat(OutputFormat):
+    """≈ mapred/lib/NullOutputFormat.java — discards output."""
+
+    def get_record_writer(self, conf, work_dir, partition):
+        return _NullWriter()
+
+    def check_output_specs(self, conf) -> None:
+        pass
+
+
+class FileOutputCommitter:
+    """≈ org.apache.hadoop.mapred.FileOutputCommitter."""
+
+    def __init__(self, conf: Any) -> None:
+        self.out = conf.get("mapred.output.dir")
+        self.fs = FileSystem.get(self.out, conf) if self.out else None
+        self.conf = conf
+
+    # job lifecycle
+
+    def setup_job(self) -> None:
+        if self.fs:
+            self.fs.mkdirs(Path(self.out).child(TEMP_DIR))
+
+    def commit_job(self) -> None:
+        if self.fs:
+            self.fs.delete(Path(self.out).child(TEMP_DIR), recursive=True)
+            self.fs.write_bytes(Path(self.out).child(SUCCESS_MARKER), b"")
+
+    def abort_job(self) -> None:
+        if self.fs:
+            self.fs.delete(Path(self.out).child(TEMP_DIR), recursive=True)
+
+    # task lifecycle
+
+    def work_dir(self, attempt_id: str) -> str:
+        return str(Path(self.out).child(TEMP_DIR).child(str(attempt_id)))
+
+    def setup_task(self, attempt_id: str) -> str:
+        wd = self.work_dir(attempt_id)
+        self.fs.mkdirs(wd)
+        return wd
+
+    def needs_commit(self, attempt_id: str) -> bool:
+        wd = self.work_dir(attempt_id)
+        return self.fs.exists(wd) and bool(self.fs.list_files(wd))
+
+    def commit_task(self, attempt_id: str) -> None:
+        """Promote the attempt dir's files into $out (first writer wins per
+        name — speculative duplicates are dropped, matching the reference's
+        single-CommitTaskAction gate)."""
+        wd = self.work_dir(attempt_id)
+        if not self.fs.exists(wd):
+            return
+        for st in self.fs.list_files(wd, recursive=True):
+            dst = Path(self.out).child(st.path.name)
+            if not self.fs.exists(dst):
+                self.fs.rename(st.path, dst)
+        self.fs.delete(wd, recursive=True)
+
+    def abort_task(self, attempt_id: str) -> None:
+        self.fs.delete(self.work_dir(attempt_id), recursive=True)

@@ -1,0 +1,73 @@
+"""Input splits ≈ ``org.apache.hadoop.mapred.InputSplit`` / ``FileSplit``
+(reference: src/mapred/org/apache/hadoop/mapred/FileSplit.java): a byte range
+of a file plus locality hints; computed by the InputFormat at submit time
+(JobClient.writeSplits, mapred/JobClient.java:973-981) and shipped to map
+tasks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class InputSplit:
+    locations: list[str] = field(default_factory=list)
+
+    @property
+    def length(self) -> int:
+        return 0
+
+    def describe(self) -> str:
+        return repr(self)
+
+    # wire form for submission/staging
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"type": f"{type(self).__module__}.{type(self).__qualname__}",
+                **self.__dict__}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "InputSplit":
+        from tpumr.utils.reflection import resolve_class
+        d = dict(d)
+        cls = resolve_class(d.pop("type"))
+        return cls(**d)
+
+
+@dataclass
+class FileSplit(InputSplit):
+    path: str = ""
+    start: int = 0
+    split_length: int = 0
+
+    @property
+    def length(self) -> int:
+        return self.split_length
+
+    def describe(self) -> str:
+        return f"{self.path}:{self.start}+{self.split_length}"
+
+
+@dataclass
+class DenseSplit(InputSplit):
+    """A row range of a dense numeric dataset (K-Means points, matmul blocks):
+    the unit the TPU runner stages into HBM in one transfer. ``path`` points
+    at a .npy file; rows [row_start, row_start+num_rows). dtype/cols/
+    data_offset are captured from the npy header at split time so readers can
+    seek straight to the byte range without reparsing the file."""
+    path: str = ""
+    row_start: int = 0
+    num_rows: int = 0
+    row_bytes: int = 0
+    dtype: str = "<f4"
+    cols: int = 1
+    data_offset: int = 0
+
+    @property
+    def length(self) -> int:
+        return self.num_rows * self.row_bytes
+
+    def describe(self) -> str:
+        return f"{self.path}[rows {self.row_start}+{self.num_rows}]"

@@ -1,0 +1,140 @@
+"""Task model: the unit of scheduled work, with accelerator placement.
+
+≈ ``org.apache.hadoop.mapred.{Task,TaskStatus,TaskReport}``. The fields that
+define the reference's GPU delta are carried 1:1 as TPU fields:
+
+- ``Task.runOnGPU`` / ``Task.GPUDeviceId`` (mapred/Task.java:169-170,
+  serialized :438-439/:464-465) → :attr:`Task.run_on_tpu` /
+  :attr:`Task.tpu_device_id` — set by the scheduler at assign time, shipped
+  to the node runner, and used to select the map runner
+  (mapred/MapTask.java:433-438).
+- ``TaskStatus`` GPU fields (mapred/TaskStatus.java:66-67,390-395) →
+  :class:`TaskStatus` — reported in every heartbeat so the master can
+  attribute runtimes per backend (the hybrid scheduler's profiling input).
+- ``TaskReport`` GPU fields (mapred/TaskReport.java:49,102-114), stamped by
+  the JobTracker at assign time (mapred/JobTracker.java:3414-3433).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tpumr.mapred.ids import TaskAttemptID, TaskID
+
+
+class TaskState:
+    UNASSIGNED = "UNASSIGNED"
+    RUNNING = "RUNNING"
+    COMMIT_PENDING = "COMMIT_PENDING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+    TERMINAL = {SUCCEEDED, FAILED, KILLED}
+
+
+class TaskPhase:
+    STARTING = "STARTING"
+    MAP = "MAP"
+    SHUFFLE = "SHUFFLE"
+    SORT = "SORT"
+    REDUCE = "REDUCE"
+    CLEANUP = "CLEANUP"
+
+
+@dataclass
+class Task:
+    """A scheduled task attempt, shipped master → node runner."""
+
+    attempt_id: TaskAttemptID
+    partition: int                 # map: split index; reduce: partition index
+    num_reduces: int = 1
+    split: dict | None = None      # InputSplit.to_dict() for maps
+    num_maps: int = 0              # for reduces: how many map outputs to fetch
+    # --- accelerator placement (≈ Task.java:169-170) ---
+    run_on_tpu: bool = False
+    tpu_device_id: int = -1
+
+    @property
+    def is_map(self) -> bool:
+        return self.attempt_id.task.is_map
+
+    @property
+    def task_id(self) -> TaskID:
+        return self.attempt_id.task
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "attempt_id": str(self.attempt_id),
+            "partition": self.partition,
+            "num_reduces": self.num_reduces,
+            "split": self.split,
+            "num_maps": self.num_maps,
+            "run_on_tpu": self.run_on_tpu,
+            "tpu_device_id": self.tpu_device_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Task":
+        return cls(attempt_id=TaskAttemptID.parse(d["attempt_id"]),
+                   partition=d["partition"], num_reduces=d["num_reduces"],
+                   split=d.get("split"), num_maps=d.get("num_maps", 0),
+                   run_on_tpu=d.get("run_on_tpu", False),
+                   tpu_device_id=d.get("tpu_device_id", -1))
+
+
+@dataclass
+class TaskStatus:
+    """Per-attempt status, carried in heartbeats (≈ TaskStatus.java with the
+    GPU fields of :66-67 and factory overloads :475-491)."""
+
+    attempt_id: TaskAttemptID
+    is_map: bool = True
+    state: str = TaskState.RUNNING
+    progress: float = 0.0
+    phase: str = TaskPhase.STARTING
+    start_time: float = field(default_factory=time.time)
+    finish_time: float = 0.0
+    diagnostics: str = ""
+    counters: dict = field(default_factory=dict)
+    # --- accelerator placement ---
+    run_on_tpu: bool = False
+    tpu_device_id: int = -1
+
+    @property
+    def runtime(self) -> float:
+        """Wall-clock seconds (finish-start) — the hybrid scheduler's
+        profiling signal (JobInProgress.getCPU/GPUMapTaskMeanTime inputs,
+        mapred/JobInProgress.java:527-565)."""
+        end = self.finish_time or time.time()
+        return max(0.0, end - self.start_time)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dict(self.__dict__)
+        d["attempt_id"] = str(self.attempt_id)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TaskStatus":
+        d = dict(d)
+        d["attempt_id"] = TaskAttemptID.parse(d["attempt_id"])
+        return cls(**d)
+
+
+@dataclass
+class TaskReport:
+    """Client-visible per-task report (≈ TaskReport.java:49,102-114 — the
+    JobTracker stamps TPU placement at assign time,
+    JobTracker.java:3414-3433 'NEW BLOCK')."""
+
+    task_id: TaskID
+    state: str = TaskState.UNASSIGNED
+    progress: float = 0.0
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    successful_attempt: str = ""
+    diagnostics: list[str] = field(default_factory=list)
+    run_on_tpu: bool = False
+    tpu_device_id: int = -1

@@ -1,0 +1,21 @@
+"""TPU map runner — placeholder until the device path lands (stage 3).
+
+Replaces the reference's PipesGPUMapRunner (mapred/pipes/
+PipesGPUMapRunner.java:40-118): instead of forking a CUDA binary and
+streaming records over a socket, the runner stages the whole split into HBM
+and executes the mapper as a JAX/Pallas kernel.
+"""
+
+from __future__ import annotations
+
+from tpumr.mapred.api import MapRunnable
+
+
+class TpuMapRunner(MapRunnable):
+    def configure(self, conf) -> None:
+        self.conf = conf
+
+    def run(self, reader, output, reporter, task_ctx=None) -> None:
+        raise NotImplementedError(
+            "TPU map runner arrives with tpumr.ops (stage 3); "
+            "set tpumr.map.kernel and use a registered kernel mapper")

@@ -37,8 +37,11 @@ def new_instance(cls: "type | str", conf: Any = None) -> Any:
     if isinstance(cls, str):
         cls = resolve_class(cls)
     obj = cls()
-    if conf is not None and hasattr(obj, "set_conf"):
-        obj.set_conf(conf)
+    if conf is not None:
+        if hasattr(obj, "configure"):       # JobConfigurable.configure
+            obj.configure(conf)
+        elif hasattr(obj, "set_conf"):      # Configurable.setConf
+            obj.set_conf(conf)
     return obj
 
 
