@@ -13,6 +13,12 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# the axon TPU plugin overrides JAX_PLATFORMS at import; the config update
+# after import reliably pins tests to the virtual 8-device CPU mesh
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from tpumr.fs.filesystem import FileSystem  # noqa: E402

@@ -1,0 +1,113 @@
+"""Kernel mapper tests: numeric parity vs numpy references, Pallas interpret
+mode on CPU (real-TPU execution is exercised by bench.py on hardware)."""
+
+import numpy as np
+import pytest
+
+from tpumr.io.recordbatch import DenseBatch, RecordBatch
+from tpumr.mapred.jobconf import JobConf
+from tpumr.ops import get_kernel, kernels
+from tpumr.ops.kmeans import assign_and_partials, pallas_assign
+
+
+def _np_assign(points, centroids):
+    d2 = ((points[:, None, :] - centroids[None, :, :]) ** 2).sum(-1)
+    return d2.argmin(1)
+
+
+def test_registry_lists_builtins():
+    names = kernels()
+    for expected in ["kmeans-assign", "matmul-block", "pi-sampler",
+                     "wordcount", "grep"]:
+        assert expected in names
+    with pytest.raises(KeyError):
+        get_kernel("nope")
+
+
+def test_kmeans_assign_jax_matches_numpy():
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(257, 5)).astype(np.float32)
+    cents = rng.normal(size=(7, 5)).astype(np.float32)
+    assign, sums, counts = assign_and_partials(pts, cents, use_pallas=False)
+    expect = _np_assign(pts, cents)
+    np.testing.assert_array_equal(np.asarray(assign), expect)
+    assert int(np.asarray(counts).sum()) == 257
+    for c in range(7):
+        mask = expect == c
+        if mask.any():
+            np.testing.assert_allclose(np.asarray(sums)[c], pts[mask].sum(0),
+                                       rtol=1e-4)
+
+
+def test_kmeans_pallas_interpret_matches_numpy():
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(100, 3)).astype(np.float32)
+    cents = rng.normal(size=(5, 3)).astype(np.float32)
+    out = np.asarray(pallas_assign(pts, cents, block_n=32, interpret=True))
+    np.testing.assert_array_equal(out, _np_assign(pts, cents))
+
+
+def test_kmeans_kernel_mapper_partials(tmp_path):
+    from tpumr.ops.kmeans import clear_centroid_cache
+    clear_centroid_cache()
+    rng = np.random.default_rng(2)
+    pts = rng.normal(size=(64, 4)).astype(np.float32)
+    cents = rng.normal(size=(3, 4)).astype(np.float32)
+    cpath = tmp_path / "c.npy"
+    np.save(cpath, cents)
+    conf = JobConf()
+    conf.set("tpumr.kmeans.centroids", f"file://{cpath}")
+    kernel = get_kernel("kmeans-assign")
+    out = dict(kernel.map_batch(DenseBatch(pts, np.arange(64)), conf, None))
+    expect = _np_assign(pts, cents)
+    total = 0
+    for cid, (s, n) in out.items():
+        mask = expect == cid
+        assert n == mask.sum()
+        np.testing.assert_allclose(s, pts[mask].sum(0), rtol=1e-4)
+        total += n
+    assert total == 64
+
+
+def test_matmul_kernel(tmp_path):
+    from tpumr.ops.matmul import clear_b_cache
+    clear_b_cache()
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(16, 8)).astype(np.float32)
+    b = rng.normal(size=(8, 12)).astype(np.float32)
+    np.save(tmp_path / "b.npy", b)
+    conf = JobConf()
+    conf.set("tpumr.matmul.b", f"file://{tmp_path}/b.npy")
+    conf.set("tpumr.matmul.bf16", False)
+    kernel = get_kernel("matmul-block")
+    [(row0, c)] = list(kernel.map_batch(
+        DenseBatch(a, np.arange(100, 116)), conf, None))
+    assert row0 == 100
+    np.testing.assert_allclose(c, a @ b, rtol=1e-4)
+
+
+def test_pi_kernel_reasonable():
+    conf = JobConf()
+    kernel = get_kernel("pi-sampler")
+    batch = RecordBatch.from_values([b"1 20000", b"2 20000"])
+    out = dict(kernel.map_batch(batch, conf, None))
+    assert out["total"] == 40000
+    pi = 4.0 * out["inside"] / out["total"]
+    assert abs(pi - np.pi) < 0.05
+
+
+def test_wordcount_kernel_matches_split():
+    text = ["the quick brown fox", "the lazy dog", "", "fox    fox"]
+    batch = RecordBatch.from_values([t.encode() for t in text])
+    out = dict(get_kernel("wordcount").map_batch(batch, JobConf(), None))
+    assert out == {"the": 2, "quick": 1, "brown": 1, "fox": 3,
+                   "lazy": 1, "dog": 1}
+
+
+def test_grep_kernel():
+    conf = JobConf()
+    conf.set("tpumr.grep.pattern", r"err[a-z]+")
+    batch = RecordBatch.from_values([b"error here", b"no match",
+                                     b"errand and error"])
+    out = dict(get_kernel("grep").map_batch(batch, conf, None))
+    assert out == {"error": 2, "errand": 1}

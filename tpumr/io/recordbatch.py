@@ -97,6 +97,10 @@ class RecordBatch:
         return int(self.key_data.nbytes + self.value_data.nbytes
                    + self.key_offsets.nbytes + self.value_offsets.nbytes)
 
+    @property
+    def value_lengths(self) -> np.ndarray:
+        return (self.value_offsets[1:] - self.value_offsets[:-1]).astype(np.int32)
+
     # ------------------------------------------------------------ device views
 
     def padded_values(self, width: int, fill: int = 0) -> tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,47 @@
+"""Grep batch mapper ≈ the reference Grep example (src/examples/org/apache/
+hadoop/examples/Grep.java: map extracts regex matches, emits (match, 1);
+reduce sums). The batch path regex-scans the split in one pass."""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Iterable
+
+from tpumr.mapred.api import Mapper
+from tpumr.ops.registry import KernelMapper, register_kernel
+
+
+def _pattern(conf) -> "tuple[re.Pattern[bytes], int]":
+    pat = conf.get("tpumr.grep.pattern")
+    if not pat:
+        raise ValueError("tpumr.grep.pattern not set")
+    group = conf.get_int("tpumr.grep.group", 0)
+    return re.compile(pat.encode()), group
+
+
+class GrepCpuMapper(Mapper):
+    def configure(self, conf) -> None:
+        self._re, self._group = _pattern(conf)
+
+    def map(self, key, value, output, reporter):
+        data = value.encode() if isinstance(value, str) else value
+        for m in self._re.finditer(data):
+            output.collect(m.group(self._group).decode("utf-8", "replace"), 1)
+
+
+class GrepKernel(KernelMapper):
+    name = "grep"
+    cpu_mapper_class = GrepCpuMapper
+
+    def map_batch(self, batch, conf, task) -> Iterable[tuple]:
+        regex, group = _pattern(conf)
+        counts: Counter = Counter()
+        for i in range(batch.num_records):
+            for m in regex.finditer(batch.value(i)):
+                counts[m.group(group)] += 1
+        for match, n in counts.items():
+            yield match.decode("utf-8", errors="replace"), n
+
+
+register_kernel(GrepKernel())

@@ -1,0 +1,164 @@
+"""K-Means map kernels: nearest-centroid assignment + device-side partial
+aggregation.
+
+The flagship workload (BASELINE.json north star: 100M points, ≥5× CPU-only).
+The reference ran K-Means as a CUDA pipes binary fed one point per socket
+record (the Shirahata paper's job; conf/mapred-site.xml pins 1 line per map).
+Here the whole split is staged as a ``DenseBatch`` and:
+
+- distances are one MXU matmul: ``d²(x,c) = |x|² - 2x·cᵀ + |c|²``;
+- the per-cluster partial sums are a second MXU matmul
+  (``one_hotᵀ @ points``), so a map task emits k tiny records — the
+  all-reduce over centroids rides the shuffle, not per-point traffic;
+- a Pallas kernel fuses the distance + argmin for the assign step (used on
+  TPU; a jitted XLA path is numerically identical and runs anywhere).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from tpumr.mapred.api import Mapper
+from tpumr.ops.registry import KernelMapper, register_kernel
+
+_BIG = 1e30
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ----------------------------------------------------------------- XLA path
+
+
+@jax.jit
+def _assign_and_partials_jax(points, centroids):
+    x2 = jnp.sum(points * points, axis=1, keepdims=True)
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    d2 = x2 - 2.0 * jnp.dot(points, centroids.T,
+                            preferred_element_type=jnp.float32) + c2[None, :]
+    assign = jnp.argmin(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, centroids.shape[0], dtype=points.dtype)
+    sums = jnp.dot(onehot.T, points, preferred_element_type=jnp.float32)
+    counts = jnp.sum(onehot, axis=0).astype(jnp.int32)
+    return assign.astype(jnp.int32), sums, counts
+
+
+# ----------------------------------------------------------------- Pallas
+
+
+def _assign_kernel(pts_ref, cent_ref, out_ref):
+    pts = pts_ref[:]                      # [bn, d_p] VMEM
+    cents = cent_ref[:]                   # [k_p, d_p] VMEM
+    d2 = (jnp.sum(pts * pts, axis=1, keepdims=True)
+          - 2.0 * jnp.dot(pts, cents.T, preferred_element_type=jnp.float32)
+          + jnp.sum(cents * cents, axis=1)[None, :])
+    out_ref[:] = jnp.argmin(d2, axis=1).astype(jnp.int32).reshape(-1, 1)
+
+
+def pallas_assign(points: Any, centroids: Any, block_n: int = 2048,
+                  interpret: bool = False):
+    """Fused distance+argmin assign step as a Pallas TPU kernel. Inputs are
+    padded to MXU-friendly tiles: feature dim to a multiple of 128 lanes,
+    centroid rows to a multiple of 8 sublanes (padded rows pushed far away so
+    argmin ignores them)."""
+    n, d = points.shape
+    k = centroids.shape[0]
+    d_p = _round_up(max(d, 128), 128)
+    k_p = _round_up(max(k, 8), 8)
+    bn = min(block_n, _round_up(n, 8))
+    n_p = _round_up(n, bn)
+
+    pts = jnp.zeros((n_p, d_p), jnp.float32).at[:n, :d].set(points)
+    cents = jnp.zeros((k_p, d_p), jnp.float32).at[:k, :d].set(centroids)
+    if k_p > k:
+        # push padding centroids far away in a dimension real points are 0 in
+        cents = cents.at[k:, :].set(jnp.sqrt(_BIG))
+
+    out = pl.pallas_call(
+        _assign_kernel,
+        grid=(n_p // bn,),
+        in_specs=[pl.BlockSpec((bn, d_p), lambda i: (i, 0)),
+                  pl.BlockSpec((k_p, d_p), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_p, 1), jnp.int32),
+        interpret=interpret,
+    )(pts, cents)
+    return out[:n, 0]
+
+
+def assign_and_partials(points, centroids, use_pallas: "bool | None" = None,
+                        interpret: bool = False):
+    """(assignments [n] i32, partial sums [k,d] f32, counts [k] i32)."""
+    points = jnp.asarray(points, jnp.float32)
+    centroids = jnp.asarray(centroids, jnp.float32)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        assign = pallas_assign(points, centroids, interpret=interpret)
+        onehot = jax.nn.one_hot(assign, centroids.shape[0], dtype=jnp.float32)
+        sums = jnp.dot(onehot.T, points, preferred_element_type=jnp.float32)
+        counts = jnp.sum(onehot, axis=0).astype(jnp.int32)
+        return assign, sums, counts
+    return _assign_and_partials_jax(points, centroids)
+
+
+# ----------------------------------------------------------------- mapper
+
+
+_centroid_cache: dict[str, np.ndarray] = {}
+
+
+def _load_centroids(conf) -> np.ndarray:
+    from tpumr.fs.filesystem import FileSystem
+    from tpumr.mapred.input_formats import load_dense
+    path = conf.get("tpumr.kmeans.centroids")
+    if not path:
+        raise ValueError("tpumr.kmeans.centroids not set (path to .npy)")
+    cached = _centroid_cache.get(path)
+    if cached is None:
+        fs = FileSystem.get(path, conf)
+        cached = _centroid_cache[path] = load_dense(fs, path).astype(np.float32)
+    return cached
+
+
+def clear_centroid_cache() -> None:
+    """Iterative drivers rewrite the centroid file between rounds."""
+    _centroid_cache.clear()
+
+
+class KMeansCpuMapper(Mapper):
+    """CPU-slot mapper for the same job: per-record nearest centroid in
+    numpy — deliberately the 'slow backend' the hybrid scheduler profiles
+    against (≈ running the CPU pipes binary)."""
+
+    def configure(self, conf) -> None:
+        self._centroids = _load_centroids(conf)
+
+    def map(self, key, row, output, reporter):
+        c = self._centroids
+        d2 = ((c - np.asarray(row)[None, :]) ** 2).sum(axis=1)
+        cid = int(np.argmin(d2))
+        output.collect(cid, (np.asarray(row, np.float32), 1))
+
+
+class KMeansAssignKernel(KernelMapper):
+    name = "kmeans-assign"
+    cpu_mapper_class = KMeansCpuMapper
+
+    def map_batch(self, batch, conf, task) -> Iterable[tuple]:
+        centroids = _load_centroids(conf)
+        _assign, sums, counts = assign_and_partials(batch.values, centroids)
+        sums = np.asarray(sums)
+        counts = np.asarray(counts)
+        for cid in range(centroids.shape[0]):
+            if counts[cid] > 0:
+                yield int(cid), (sums[cid], int(counts[cid]))
+
+
+register_kernel(KMeansAssignKernel())

@@ -1,0 +1,62 @@
+"""Monte-Carlo π estimation map kernel.
+
+≈ ``PiEstimator`` (reference: src/examples/org/apache/hadoop/examples/
+PiEstimator.java, 353 LoC — halton-sequence sampling, one map per (offset,
+size) pair). Each input record is ``"<seed> <num_samples>"``; the kernel
+draws the whole sample block on device and reduces to two counters — the
+map's output is 2 records regardless of sample count.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumr.mapred.api import Mapper
+from tpumr.ops.registry import KernelMapper, register_kernel
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _count_inside(seed: int, n: int):
+    key = jax.random.key(seed)
+    pts = jax.random.uniform(key, (n, 2), dtype=jnp.float32)
+    # int32: per-call n is bounded far below 2^31; totals accumulate in Python
+    return jnp.sum(jnp.sum(pts * pts, axis=1) <= 1.0).astype(jnp.int32)
+
+
+def _parse(value) -> tuple[int, int]:
+    s = value.decode() if isinstance(value, (bytes, bytearray)) else str(value)
+    seed_s, n_s = s.split()
+    return int(seed_s), int(n_s)
+
+
+class PiCpuMapper(Mapper):
+    def map(self, key, value, output, reporter):
+        seed, n = _parse(value)
+        rng = np.random.default_rng(seed)
+        pts = rng.random((n, 2), dtype=np.float32)
+        inside = int(((pts * pts).sum(axis=1) <= 1.0).sum())
+        output.collect("inside", inside)
+        output.collect("total", n)
+
+
+class PiSamplerKernel(KernelMapper):
+    name = "pi-sampler"
+    cpu_mapper_class = PiCpuMapper
+
+    def map_batch(self, batch, conf, task) -> Iterable[tuple]:
+        inside = 0
+        total = 0
+        for i in range(batch.num_records):
+            seed, n = _parse(batch.value(i))
+            inside += int(_count_inside(seed, n))
+            total += n
+        yield "inside", inside
+        yield "total", total
+
+
+register_kernel(PiSamplerKernel())

@@ -1,0 +1,58 @@
+"""Kernel-mapper registry.
+
+≈ the role of DistributedCache executable slots in the reference
+(mapred/pipes/Submitter.java:349-379: CPU binary → cache[0], GPU binary →
+cache[1]): jobs name their accelerator mapper; the node runner resolves it at
+launch. Names are strings in job conf (``tpumr.map.kernel``) so submission
+stays wire-serializable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+
+class KernelMapper:
+    """A whole-batch device mapper.
+
+    Contract: ``map_batch(batch, conf, task)`` consumes a staged
+    :class:`~tpumr.io.recordbatch.DenseBatch` or
+    :class:`~tpumr.io.recordbatch.RecordBatch` and returns an iterable of
+    (key, value) records — typically FEW records, because the kernel
+    aggregates on device (per-split partial sums, counts, blocks). This is
+    the designed-in advantage over the reference's per-record socket protocol
+    (BinaryProtocol MAP_ITEM hot loop, PipesGPUMapRunner.java:97-107): output
+    leaves the device pre-combined.
+    """
+
+    #: registry name
+    name: str = ""
+
+    def map_batch(self, batch: Any, conf: Any, task: Any) -> Iterable[tuple]:
+        raise NotImplementedError
+
+    # optional: kernels can advertise a CPU mapper class for the hybrid
+    # scheduler's CPU slots (same job, both backends)
+    cpu_mapper_class: type | None = None
+
+
+_REGISTRY: dict[str, KernelMapper] = {}
+
+
+def register_kernel(kernel: KernelMapper) -> KernelMapper:
+    if not kernel.name:
+        raise ValueError("kernel needs a name")
+    _REGISTRY[kernel.name] = kernel
+    return kernel
+
+
+def get_kernel(name: str) -> KernelMapper:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"no kernel mapper {name!r}; registered: "
+                       f"{sorted(_REGISTRY)}") from None
+
+
+def kernels() -> list[str]:
+    return sorted(_REGISTRY)
