@@ -1,0 +1,31 @@
+"""Device-mesh parallelism — the ICI/DCN data plane.
+
+The reference's distributed data plane is host-level TCP (SURVEY.md §5:
+Hadoop IPC, HTTP shuffle servlet TaskTracker.java:4050 ↔ ReduceCopier
+fetchers ReduceTask.java:659, DN→DN streaming). The TPU rebuild keeps a host
+RPC control plane (tpumr.ipc) but moves the data plane onto XLA collectives
+over the chip interconnect:
+
+- ``mesh``        — jax.sharding.Mesh construction + sharding helpers
+- ``collectives`` — psum/all_gather/all_to_all/reduce_scatter/ppermute
+  wrappers under shard_map
+- ``shuffle``     — the MapReduce shuffle as a bucketed/padded on-device
+  all-to-all (static shapes for XLA; overflow detected and surfaced)
+- ``seqmap``      — record-axis (sequence) parallel map + ring primitives
+
+Map each reference parallelism strategy (SURVEY.md §2.5) to a mesh concept:
+input-split data parallelism → sharding over the 'data' axis; partition
+parallelism (shuffle) → all_to_all over ICI; heterogeneous CPU/GPU → the
+hybrid scheduler (tpumr.mapred.scheduler) + these device paths.
+"""
+
+from tpumr.parallel.mesh import (
+    make_mesh, shard_over, replicate, local_device_count,
+)
+from tpumr.parallel.shuffle import shuffle_dense, ShuffleResult
+from tpumr.parallel.seqmap import sequence_parallel_map, ring_pass
+
+__all__ = [
+    "make_mesh", "shard_over", "replicate", "local_device_count",
+    "shuffle_dense", "ShuffleResult", "sequence_parallel_map", "ring_pass",
+]

@@ -1,0 +1,71 @@
+"""Collective wrappers under shard_map.
+
+≈ the reference's three TCP transports (SURVEY.md §5 'Distributed
+communication backend') re-based onto XLA collectives: aggregation that rode
+the HTTP shuffle + reduce now rides ``psum``/``reduce_scatter``; side-file
+broadcast rides ``all_gather``; neighbor pipelines ride ``ppermute``. These
+are thin, named-axis-explicit wrappers so runtime code doesn't import lax
+directly and tests can exercise every collective on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def psum(x, axis_name: str = "data"):
+    return lax.psum(x, axis_name)
+
+def pmean(x, axis_name: str = "data"):
+    return lax.pmean(x, axis_name)
+
+def pmax(x, axis_name: str = "data"):
+    return lax.pmax(x, axis_name)
+
+def all_gather(x, axis_name: str = "data", axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+def reduce_scatter(x, axis_name: str = "data", scatter_dim: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dim,
+                            tiled=True)
+
+def all_to_all(x, axis_name: str = "data", split_axis: int = 0,
+               concat_axis: int = 0):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis)
+
+def ppermute_ring(x, axis_name: str = "data", shift: int = 1):
+    """Rotate shards around the ring by ``shift`` (ICI neighbor transfer)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+def axis_index(axis_name: str = "data"):
+    return lax.axis_index(axis_name)
+
+def axis_size(axis_name: str = "data"):
+    return lax.axis_size(axis_name)
+
+
+def map_reduce(mesh: Mesh, local_fn: Callable[[Any], Any],
+               axis_name: str = "data", in_dim: int = 0) -> Callable:
+    """Build a jitted SPMD map+all-reduce: each device applies ``local_fn``
+    to its shard and the pytree of results is summed over the mesh — the
+    device-native form of map → combine → reduce for commutative aggregation
+    (K-Means partial sums, counters, histograms). Every device returns the
+    full reduced result (replicated out-spec)."""
+    in_spec = P(*([axis_name] if in_dim == 0 else
+                  [None] * in_dim + [axis_name]))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(in_spec,), out_specs=P())
+    def step(shard):
+        return jax.tree.map(lambda v: lax.psum(v, axis_name),
+                            local_fn(shard))
+
+    return jax.jit(step)

@@ -1,0 +1,68 @@
+"""Mesh construction + sharding helpers.
+
+The TPU analog of the reference's cluster topology (NetworkTopology rack
+awareness, src/core/org/apache/hadoop/net/): where Hadoop places tasks near
+HDFS blocks, the device layer places array shards over a
+``jax.sharding.Mesh`` and lets XLA insert collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def local_device_count() -> int:
+    return len(jax.local_devices())
+
+
+def make_mesh(n_devices: int | None = None,
+              axis_names: Sequence[str] = ("data",),
+              shape: Sequence[int] | None = None,
+              devices: list | None = None) -> Mesh:
+    """Build a mesh over the first ``n_devices`` devices (default: all).
+    ``shape`` reshapes devices over multiple named axes, e.g.
+    shape=(4, 2), axis_names=('data', 'model')."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"asked for {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    if shape is None:
+        shape = (len(devs),)
+    total = int(np.prod(shape))
+    if total > len(devs):
+        raise ValueError(f"mesh shape {shape} needs {total} devices, "
+                         f"have {len(devs)}")
+    arr = np.array(devs[:total], dtype=object).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def shard_over(mesh: Mesh, array, axis_name: str = "data", dim: int = 0):
+    """Place an array sharded along ``dim`` over mesh axis ``axis_name``
+    (≈ distributing input splits across trackers). Pads are the caller's
+    job — the leading dim must divide evenly."""
+    spec = [None] * np.ndim(array)
+    spec[dim] = axis_name
+    return jax.device_put(array, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(mesh: Mesh, array):
+    """Replicate across the mesh (≈ DistributedCache side files: centroids,
+    the B matrix, broadcast job conf)."""
+    return jax.device_put(array, NamedSharding(mesh, P()))
+
+
+def pad_to_multiple(array: np.ndarray, multiple: int, axis: int = 0,
+                    fill=0) -> tuple[np.ndarray, int]:
+    """Pad ``axis`` up to a multiple; returns (padded, original_length)."""
+    n = array.shape[axis]
+    target = -(-n // multiple) * multiple
+    if target == n:
+        return array, n
+    widths = [(0, 0)] * array.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(array, widths, constant_values=fill), n

@@ -1,0 +1,78 @@
+"""Record-axis (sequence) parallel map + ring primitives.
+
+The reference has no sequence dimension (SURVEY.md §5 'Long-context': its
+scaling knobs are split size and NLineInputFormat). The TPU framework's
+equivalent axis — documented as new design, not a port — is sharding one
+huge InputSplit across chips along the record axis and running the map
+kernel under shard_map, with ring (ppermute) transfers for anything that
+needs neighbor context: the same mechanics ring attention uses for long
+sequences, applied to record streams.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def sequence_parallel_map(mesh: Mesh, fn: Callable[[Any], Any],
+                          axis_name: str = "data") -> Callable:
+    """Jitted SPMD map: each chip applies ``fn`` to its record shard, output
+    stays sharded (embarrassingly parallel — zero communication). The
+    device-native form of 'one InputSplit per tracker slot'."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axis_name),
+             out_specs=P(axis_name))
+    def step(shard):
+        return fn(shard)
+
+    return jax.jit(step)
+
+
+def ring_pass(mesh: Mesh, axis_name: str = "data") -> Callable:
+    """Jitted one-hop ring rotation of shards (chip i's shard moves to chip
+    i+1). Building block for ring-structured scans over the record axis."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axis_name),
+             out_specs=P(axis_name))
+    def step(shard):
+        n = lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return lax.ppermute(shard, axis_name, perm)
+
+    return jax.jit(step)
+
+
+def ring_scan_map(mesh: Mesh,
+                  fn: Callable[[Any, Any, Any], Any],
+                  axis_name: str = "data") -> Callable:
+    """Ring-structured full pass: every chip sees every shard once, combining
+    with ``fn(state, visiting_shard, hop_index)``. After n_dev hops each chip
+    has folded the ENTIRE record axis into its state while only ever holding
+    one remote shard — the constant-memory access pattern of ring attention
+    (SNIPPETS/PAPERS: ring collective pattern), here for record streams
+    (global top-k, streaming joins, windowed aggregation).
+    """
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(axis_name), P(axis_name)), out_specs=P(axis_name))
+    def step(init_state, my_shard):
+        n = lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def body(carry, hop):
+            state, visiting = carry
+            state = fn(state, visiting, hop)
+            visiting = lax.ppermute(visiting, axis_name, perm)
+            return (state, visiting), None
+
+        (state, _), _ = lax.scan(body, (init_state, my_shard),
+                                 jnp.arange(n))
+        return state
+
+    return jax.jit(step)
