@@ -1,0 +1,204 @@
+"""Mini-cluster integration ≈ TestMiniMRWithDFS: real master + trackers +
+RPC + heartbeats + shuffle in one process (SURVEY.md §4.2)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tpumr.core.counters import BackendCounter
+from tpumr.fs import get_filesystem
+from tpumr.mapred.job_client import JobClient
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.mini_cluster import MiniMRCluster
+
+
+
+
+class WordCountMapper:
+    def configure(self, conf):
+        pass
+
+    def map(self, key, value, output, reporter):
+        for w in value.split():
+            output.collect(w, 1)
+
+    def close(self):
+        pass
+
+
+class SumReducer:
+    def configure(self, conf):
+        pass
+
+    def reduce(self, key, values, output, reporter):
+        output.collect(key, sum(values))
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniMRCluster(num_trackers=2, cpu_slots=2, tpu_slots=1) as c:
+        yield c
+
+
+def test_distributed_wordcount(cluster):
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/dist/in.txt", b"alpha beta\nbeta gamma\n" * 200)
+    conf = cluster.create_job_conf()
+    conf.set_input_paths("mem:///dist/in.txt")
+    conf.set_output_path("mem:///dist/out")
+    conf.set_class("mapred.mapper.class", WordCountMapper)
+    conf.set_class("mapred.reducer.class", SumReducer)
+    conf.set_num_reduce_tasks(2)
+    conf.set("mapred.map.tasks", 3)
+    conf.set("mapred.min.split.size", 1)
+
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+    out = {}
+    for st in fs.list_files("mem:///dist/out"):
+        if st.path.name.startswith("part-"):
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                k, v = line.split("\t")
+                out[k] = int(v)
+    assert out == {"alpha": "200" and 200, "beta": 400, "gamma": 200}
+
+
+def test_hybrid_job_uses_both_backends(cluster):
+    """A kernel-equipped job on a cluster with CPU and TPU slots lands maps
+    on BOTH pools (the heterogeneous-parallelism contract, SURVEY.md §2.5.3)
+    and every TPU attempt carries a concrete device id."""
+    from tpumr.ops.kmeans import clear_centroid_cache
+    clear_centroid_cache()
+    fs = get_filesystem("mem:///")
+    import io
+    rng = np.random.default_rng(0)
+    buf = io.BytesIO()
+    np.save(buf, rng.normal(size=(400, 4)).astype(np.float32))
+    fs.write_bytes("/hyb/points.npy", buf.getvalue())
+    buf = io.BytesIO()
+    np.save(buf, rng.normal(size=(3, 4)).astype(np.float32))
+    fs.write_bytes("/hyb/cents.npy", buf.getvalue())
+
+    conf = cluster.create_job_conf()
+    conf.set_input_paths("mem:///hyb/points.npy")
+    conf.set_output_path("mem:///hyb/out")
+    conf.set("mapred.input.format.class",
+             "tpumr.mapred.input_formats.DenseInputFormat")
+    conf.set("tpumr.dense.split.rows", 25)  # 16 splits
+    conf.set("tpumr.kmeans.centroids", "mem:///hyb/cents.npy")
+    conf.set("tpumr.map.kernel", "kmeans-assign")
+    conf.set("mapred.mapper.class", "tpumr.ops.kmeans.KMeansCpuMapper")
+    conf.set("mapred.reducer.class",
+             "tests.test_mini_cluster.CentroidReducer")
+    conf.set_num_reduce_tasks(1)
+
+    client = JobClient(conf)
+    running = client.submit_job(conf)
+    st = running.wait_for_completion(timeout=60)
+    assert st["state"] == "SUCCEEDED", st
+    assert st["finished_tpu_maps"] > 0, st
+    assert st["finished_cpu_maps"] > 0, st
+    assert st["finished_tpu_maps"] + st["finished_cpu_maps"] == 16
+    # device ids stamped on TPU task reports (JobTracker.java:3414-3433)
+    reports = running.task_reports("map")
+    tpu_reports = [r for r in reports if r["run_on_tpu"]]
+    assert tpu_reports and all(r["tpu_device_id"] >= 0 for r in tpu_reports)
+    # profiling means recorded per backend
+    assert st["cpu_map_mean_time"] > 0
+    assert st["tpu_map_mean_time"] > 0
+
+
+class CentroidReducer:
+    def configure(self, conf):
+        pass
+
+    def reduce(self, key, values, output, reporter):
+        total, n = None, 0
+        for s, c in values:
+            total = s if total is None else total + s
+            n += c
+        output.collect(key, (total / max(1, n)).tolist())
+
+    def close(self):
+        pass
+
+
+def test_heartbeat_dedupe_replays_actions():
+    """A duplicate heartbeat (lost response) must replay the SAME actions,
+    not assign new work (JobTracker.java:3336-3375)."""
+    from tpumr.mapred.jobtracker import JobMaster
+    conf = JobConf()
+    master = JobMaster(conf)
+    try:
+        status = {"tracker_name": "t1", "host": "h", "shuffle_port": 1,
+                  "max_cpu_map_slots": 2, "max_tpu_map_slots": 0,
+                  "max_reduce_slots": 1, "count_cpu_map_tasks": 0,
+                  "count_tpu_map_tasks": 0, "count_reduce_tasks": 0,
+                  "available_tpu_devices": [], "task_statuses": []}
+        master.submit_job({"mapred.reduce.tasks": 0}, [{"locations": []},
+                                                       {"locations": []}])
+        r1 = master.heartbeat(status, True, True, 0)
+        launches1 = [a for a in r1["actions"] if a["type"] == "launch"]
+        assert len(launches1) == 2
+        # duplicate with the same response_id → identical replay
+        r2 = master.heartbeat(status, False, True, 0)
+        assert r2["actions"] == r1["actions"]
+        # advancing the id gets fresh (empty — no pending maps) actions
+        r3 = master.heartbeat(status, False, True, r1["response_id"])
+        assert [a for a in r3["actions"] if a["type"] == "launch"] == []
+    finally:
+        master.stop()
+
+
+def test_unknown_tracker_told_to_reinit():
+    from tpumr.mapred.jobtracker import JobMaster
+    master = JobMaster(JobConf())
+    try:
+        status = {"tracker_name": "ghost", "host": "h", "shuffle_port": 1,
+                  "max_cpu_map_slots": 1, "max_tpu_map_slots": 0,
+                  "max_reduce_slots": 0, "count_cpu_map_tasks": 0,
+                  "count_tpu_map_tasks": 0, "count_reduce_tasks": 0,
+                  "available_tpu_devices": [], "task_statuses": []}
+        resp = master.heartbeat(status, False, True, 5)
+        assert resp["actions"] == [{"type": "reinit"}]
+    finally:
+        master.stop()
+
+
+def test_commit_gate_first_wins():
+    from tpumr.mapred.jobtracker import JobMaster
+    master = JobMaster(JobConf())
+    try:
+        assert master.can_commit("task_x_0001_r_000000", "attempt_a")
+        assert not master.can_commit("task_x_0001_r_000000", "attempt_b")
+        assert master.can_commit("task_x_0001_r_000000", "attempt_a")
+    finally:
+        master.stop()
+
+
+def test_failing_job_reports_failure(cluster):
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/fail/in.txt", b"x\n")
+    conf = cluster.create_job_conf()
+    conf.set_input_paths("mem:///fail/in.txt")
+    conf.set_output_path("mem:///fail/out")
+    conf.set("mapred.mapper.class", "tests.test_mini_cluster.BoomMapper")
+    conf.set("mapred.map.max.attempts", 2)
+    conf.set_num_reduce_tasks(0)
+    with pytest.raises(RuntimeError, match="FAILED"):
+        JobClient(conf).run_job(conf)
+
+
+class BoomMapper:
+    def configure(self, conf):
+        pass
+
+    def map(self, key, value, output, reporter):
+        raise RuntimeError("kaboom")
+
+    def close(self):
+        pass

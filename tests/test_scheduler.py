@@ -1,0 +1,221 @@
+"""Hybrid scheduler unit tests against fakes — the seam the reference tests
+the same way (TestJobQueueTaskScheduler.java:33 drives the scheduler against
+FakeTaskTrackerManager :114; SURVEY.md §4.1). Deterministic: no daemons, no
+clocks — runtimes injected via TaskStatus timestamps."""
+
+import time
+
+from tpumr.mapred.ids import JobID
+from tpumr.mapred.job_in_progress import JobInProgress
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.scheduler import HybridQueueScheduler
+from tpumr.mapred.task import TaskState, TaskStatus
+
+
+class FakeManager:
+    """≈ FakeTaskTrackerManager."""
+
+    def __init__(self, jobs, n_trackers=1):
+        self._jobs = jobs
+        self._n = n_trackers
+
+    def running_jobs(self):
+        return self._jobs
+
+    def num_trackers(self):
+        return self._n
+
+    def total_slots(self):
+        return {"cpu": 3 * self._n, "tpu": 1 * self._n, "reduce": 2 * self._n}
+
+
+def make_job(n_maps=8, n_reduces=1, kernel=True, optional=False, job_num=1,
+             hosts=None):
+    conf = {"mapred.reduce.tasks": n_reduces,
+            "mapred.reduce.slowstart.completed.maps": 0.0}
+    if kernel:
+        conf["tpumr.map.kernel"] = "kmeans-assign"
+    if optional:
+        conf["mapred.jobtracker.map.optionalscheduling"] = True
+    splits = [{"locations": (hosts or [])} for _ in range(n_maps)]
+    return JobInProgress(JobID("test", job_num), conf, splits)
+
+
+def tracker_status(cpu=3, tpu=1, reduce=2, run_cpu=0, run_tpu=0, run_red=0,
+                   devices=None, host="host0"):
+    return {
+        "tracker_name": "tracker_0", "host": host, "shuffle_port": 0,
+        "max_cpu_map_slots": cpu, "max_tpu_map_slots": tpu,
+        "max_reduce_slots": reduce,
+        "count_cpu_map_tasks": run_cpu, "count_tpu_map_tasks": run_tpu,
+        "count_reduce_tasks": run_red,
+        "available_tpu_devices": devices if devices is not None
+        else [True] * tpu,
+    }
+
+
+def make_scheduler(jobs, n_trackers=1, **conf_kv):
+    sched = HybridQueueScheduler()
+    conf = JobConf()
+    for k, v in conf_kv.items():
+        conf.set(k, v)
+    sched.configure(conf)
+    sched.set_manager(FakeManager(jobs, n_trackers))
+    return sched
+
+
+def finish_map(job, task, runtime, on_tpu):
+    now = time.time()
+    st = TaskStatus(attempt_id=task.attempt_id, is_map=True,
+                    state=TaskState.SUCCEEDED, start_time=now - runtime,
+                    finish_time=now, run_on_tpu=on_tpu,
+                    tpu_device_id=task.tpu_device_id)
+    job.update_task_status(st, "h:0")
+
+
+def test_fills_both_pools_with_device_ids():
+    job = make_job(n_maps=8)
+    sched = make_scheduler([job])
+    tasks = sched.assign_tasks(tracker_status(cpu=3, tpu=2,
+                                              devices=[True, True]))
+    tpu_tasks = [t for t in tasks if t.run_on_tpu]
+    cpu_tasks = [t for t in tasks if t.is_map and not t.run_on_tpu]
+    reduce_tasks = [t for t in tasks if not t.is_map]
+    assert len(tpu_tasks) == 2
+    assert sorted(t.tpu_device_id for t in tpu_tasks) == [0, 1]
+    assert len(cpu_tasks) == 3
+    assert len(reduce_tasks) == 1  # at most one reduce per heartbeat
+
+
+def test_kernel_gate_blocks_tpu_assignment():
+    """Jobs without a device kernel never get TPU slots
+    (≈ hadoop.pipes.gpu.executable gate, JobQueueTaskScheduler.java:342-347)."""
+    job = make_job(kernel=False)
+    sched = make_scheduler([job])
+    tasks = sched.assign_tasks(tracker_status())
+    assert all(not t.run_on_tpu for t in tasks)
+    assert len([t for t in tasks if t.is_map]) == 3  # CPU pass still runs
+
+
+def test_no_free_device_no_tpu_task():
+    job = make_job()
+    sched = make_scheduler([job])
+    tasks = sched.assign_tasks(tracker_status(tpu=1, devices=[False]))
+    assert all(not t.run_on_tpu for t in tasks)
+
+
+def test_optional_scheduling_starves_cpu_when_load_fits_tpu():
+    """The Shirahata rule (:290-291): with optionalscheduling and
+    pending_load < accel × tpu_capacity × n_trackers, skip the CPU pass."""
+    job = make_job(n_maps=20, optional=True)
+    # profile: CPU maps take 10s, TPU maps 1s → accel = 10
+    for on_tpu, runtime in [(False, 10.0), (True, 1.0)]:
+        t = job.obtain_new_map_task("host0", run_on_tpu=on_tpu,
+                                    tpu_device_id=0 if on_tpu else -1)
+        finish_map(job, t, runtime, on_tpu)
+    assert job.acceleration_factor() == 10.0
+
+    sched = make_scheduler([job], n_trackers=2)
+    # pending = 18 < 10 × 1 × 2 = 20 → CPU starved
+    tasks = sched.assign_tasks(tracker_status())
+    assert [t.run_on_tpu for t in tasks if t.is_map] == [True]
+
+    # without profile data (fresh job) CPU is NOT starved
+    fresh = make_job(n_maps=20, optional=True, job_num=2)
+    sched2 = make_scheduler([fresh], n_trackers=2)
+    tasks2 = sched2.assign_tasks(tracker_status())
+    assert len([t for t in tasks2 if t.is_map and not t.run_on_tpu]) == 3
+
+
+def test_optional_scheduling_keeps_cpu_under_heavy_load():
+    job = make_job(n_maps=500, optional=True)
+    for on_tpu, runtime in [(False, 10.0), (True, 1.0)]:
+        t = job.obtain_new_map_task("host0", run_on_tpu=on_tpu,
+                                    tpu_device_id=0 if on_tpu else -1)
+        finish_map(job, t, runtime, on_tpu)
+    sched = make_scheduler([job], n_trackers=2)
+    # pending 498 >= 10 × 1 × 2 → CPU pass runs
+    tasks = sched.assign_tasks(tracker_status())
+    assert len([t for t in tasks if t.is_map and not t.run_on_tpu]) == 3
+
+
+def test_minimize_mode_puts_everything_on_tpu_when_faster():
+    """The implemented f(x,y) minimization (reference's commented-out
+    :181-219): 8 pending maps, TPU 10× faster, 1 TPU slot → optimum is
+    x=0 CPU tasks (8×1s on TPU beats any CPU share at 10s each)."""
+    job = make_job(n_maps=10)
+    for on_tpu, runtime in [(False, 10.0), (True, 1.0)]:
+        t = job.obtain_new_map_task("host0", run_on_tpu=on_tpu,
+                                    tpu_device_id=0 if on_tpu else -1)
+        finish_map(job, t, runtime, on_tpu)
+    sched = make_scheduler([job], **{"tpumr.scheduler.mode": "minimize"})
+    tasks = sched.assign_tasks(tracker_status())
+    assert [t.run_on_tpu for t in tasks if t.is_map] == [True]
+
+    # inverse profile: CPU faster → CPU pass fills all slots
+    job2 = make_job(n_maps=10, job_num=2)
+    for on_tpu, runtime in [(False, 1.0), (True, 10.0)]:
+        t = job2.obtain_new_map_task("host0", run_on_tpu=on_tpu,
+                                     tpu_device_id=0 if on_tpu else -1)
+        finish_map(job2, t, runtime, on_tpu)
+    sched2 = make_scheduler([job2], **{"tpumr.scheduler.mode": "minimize"})
+    tasks2 = sched2.assign_tasks(tracker_status())
+    cpu_maps = [t for t in tasks2 if t.is_map and not t.run_on_tpu]
+    assert len(cpu_maps) == 3
+
+
+def test_locality_preference():
+    job = make_job(n_maps=4, hosts=["far"])
+    job.host_cache = {"host0": {2}, "far": {0, 1, 3}}
+    sched = make_scheduler([job])
+    tasks = sched.assign_tasks(tracker_status(cpu=1, tpu=0, host="host0"))
+    assert tasks[0].partition == 2  # node-local split chosen first
+
+
+def test_fifo_across_jobs():
+    j1 = make_job(n_maps=2, job_num=1, kernel=False)
+    j2 = make_job(n_maps=8, job_num=2, kernel=False)
+    sched = make_scheduler([j1, j2])
+    tasks = sched.assign_tasks(tracker_status(cpu=4, tpu=0))
+    # j1 exhausted first, then j2
+    jobs_in_order = [str(t.attempt_id.task.job) for t in tasks if t.is_map]
+    assert jobs_in_order[:2] == ["job_test_0001"] * 2
+    assert all(j == "job_test_0002" for j in jobs_in_order[2:])
+
+
+def test_failure_requeues_and_eventually_fails_job():
+    job = make_job(n_maps=1, kernel=False)
+    for attempt in range(4):
+        t = job.obtain_new_map_task("h", run_on_tpu=False)
+        assert t is not None and t.attempt_id.attempt == attempt
+        st = TaskStatus(attempt_id=t.attempt_id, is_map=True,
+                        state=TaskState.FAILED, diagnostics="boom")
+        job.update_task_status(st, "h:0")
+    assert job.state == "FAILED"
+    assert "4 times" in job.error
+
+
+def test_speculative_duplicate_success_ignored():
+    job = make_job(n_maps=1, n_reduces=0, kernel=False)
+    t0 = job.obtain_new_map_task("h", run_on_tpu=False)
+    # second (speculative) attempt of same task
+    tip = job.maps[0]
+    a1 = tip.new_attempt()
+    finish_map(job, t0, 1.0, False)
+    assert job.finished_maps == 1
+    st = TaskStatus(attempt_id=a1, is_map=True, state=TaskState.SUCCEEDED)
+    job.update_task_status(st, "h:0")
+    assert job.finished_maps == 1  # not double counted
+    assert job.state == "SUCCEEDED"
+
+
+def test_lost_tracker_requeues_completed_maps():
+    job = make_job(n_maps=2, n_reduces=1, kernel=False)
+    t0 = job.obtain_new_map_task("h", run_on_tpu=False)
+    finish_map(job, t0, 1.0, False)
+    assert job.finished_maps == 1
+    aid = job.maps[0].successful_attempt
+    job.requeue_lost_attempts([aid])
+    assert job.finished_maps == 0
+    assert job.pending_map_count() == 2
+    assert not job.completion_events

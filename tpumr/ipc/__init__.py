@@ -1,0 +1,3 @@
+from tpumr.ipc.rpc import RpcServer, RpcClient, RpcError, get_proxy
+
+__all__ = ["RpcServer", "RpcClient", "RpcError", "get_proxy"]

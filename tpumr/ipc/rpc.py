@@ -1,0 +1,237 @@
+"""Host-level RPC — the control plane transport.
+
+≈ Hadoop IPC (reference: src/core/org/apache/hadoop/ipc/ — NIO reactor
+``Server.java`` :279 Listener/:320 Reader/:1350 Handler pool/:583 Responder,
+connection-cached ``Client.java``, dynamic-proxy ``RPC.java:203,355``).
+Re-designed, not translated: a threaded TCP server with length-prefixed
+frames carrying the framework's own typed binary codec (so ndarrays/bytes
+ride RPC natively — no JSON detours), a connection-cached thread-safe
+client, and duck-typed proxies. The versioned-protocol handshake is kept:
+proxies check ``get_protocol_version`` against the expected version at
+creation (≈ VersionedProtocol, InterTrackerProtocol versionID 29,
+InterTrackerProtocol.java:75).
+
+Data-plane traffic does NOT go through here on TPU paths — that's
+tpumr.parallel (ICI collectives); this carries heartbeats, submissions,
+umbilical status and the host-shuffle fallback.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import traceback
+from typing import Any
+
+from tpumr.io.writable import deserialize, serialize
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 30
+
+
+class RpcError(RuntimeError):
+    """Remote exception surfaced locally (≈ RemoteException)."""
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = serialize(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_read_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    return deserialize(_read_exact(sock, length))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: RpcServer = self.server  # type: ignore[assignment]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                req = _recv_frame(sock)
+                # client-side reconnect retries resend the same (cid, id):
+                # replay the cached response instead of re-executing, so
+                # non-idempotent methods (submit_job) never run twice
+                dedupe_key = (req.get("cid"), req.get("id"))
+                if req.get("cid") is not None:
+                    cached = server.response_cache_get(dedupe_key)
+                    if cached is not None:
+                        _send_frame(sock, cached)
+                        continue
+                resp: dict[str, Any] = {"id": req.get("id")}
+                try:
+                    method = server.lookup(req["method"])
+                    resp["result"] = method(*req.get("params", []))
+                except Exception as e:  # noqa: BLE001 — remote surface
+                    resp["error"] = f"{type(e).__name__}: {e}"
+                    resp["traceback"] = traceback.format_exc(limit=8)
+                if req.get("cid") is not None:
+                    server.response_cache_put(dedupe_key, resp)
+                _send_frame(sock, resp)
+        except (ConnectionError, OSError):
+            return
+
+
+class _ThreadingServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class RpcServer:
+    """Exposes public methods of a handler object (and optional extra named
+    protocols) over TCP."""
+
+    RESPONSE_CACHE_SIZE = 2048
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self._handlers: dict[str, Any] = {"": handler}
+        self._server = _ThreadingServer((host, port), _Handler)
+        # expose hooks on the socketserver instance for _Handler
+        self._server.lookup = self.lookup  # type: ignore[attr-defined]
+        self._server.response_cache_get = self.response_cache_get  # type: ignore[attr-defined]
+        self._server.response_cache_put = self.response_cache_put  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+        self._resp_cache: "dict[tuple, Any]" = {}
+        self._resp_cache_lock = threading.Lock()
+
+    def response_cache_get(self, key: tuple) -> Any | None:
+        with self._resp_cache_lock:
+            return self._resp_cache.get(key)
+
+    def response_cache_put(self, key: tuple, resp: Any) -> None:
+        with self._resp_cache_lock:
+            if len(self._resp_cache) >= self.RESPONSE_CACHE_SIZE:
+                # drop oldest half (insertion-ordered dict)
+                for k in list(self._resp_cache)[: self.RESPONSE_CACHE_SIZE // 2]:
+                    del self._resp_cache[k]
+            self._resp_cache[key] = resp
+
+    def add_protocol(self, name: str, handler: Any) -> None:
+        self._handlers[name] = handler
+
+    def lookup(self, method: str):
+        ns, _, name = method.rpartition(".")
+        handler = self._handlers.get(ns)
+        if handler is None or name.startswith("_"):
+            raise AttributeError(f"no such method {method!r}")
+        fn = getattr(handler, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError(f"no such method {method!r}")
+        return fn
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address  # type: ignore[return-value]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="rpc-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        # shutdown() blocks forever if serve_forever never ran — only call
+        # it when start() actually happened
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """Connection-cached, thread-safe client (one socket; calls serialized —
+    fan-out callers hold one client per target like the reference's
+    per-connection multiplexing without the async responder)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._id = 0
+        import uuid
+        self._cid = uuid.uuid4().hex  # pairs with server response cache
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def call(self, method: str, *params: Any) -> Any:
+        with self._lock:
+            self._id += 1
+            req = {"id": self._id, "cid": self._cid, "method": method,
+                   "params": list(params)}
+            try:
+                sock = self._connect()
+                _send_frame(sock, req)
+                resp = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                # one reconnect attempt (server restart / idle drop)
+                self.close_locked()
+                sock = self._connect()
+                _send_frame(sock, req)
+                resp = _recv_frame(sock)
+        if "error" in resp:
+            raise RpcError(resp["error"] + "\n[remote] " +
+                           resp.get("traceback", ""))
+        return resp.get("result")
+
+    def close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self.close_locked()
+
+
+class _Proxy:
+    def __init__(self, client: RpcClient, namespace: str = "") -> None:
+        self._client = client
+        self._ns = namespace
+
+    def __getattr__(self, name: str):
+        method = f"{self._ns}.{name}" if self._ns else name
+        return lambda *params: self._client.call(method, *params)
+
+
+def get_proxy(host: str, port: int, protocol_version: int | None = None,
+              namespace: str = "", timeout: float = 30.0) -> Any:
+    """Create a method proxy; verifies the protocol version handshake when
+    ``protocol_version`` is given (≈ RPC.getProxy + VersionedProtocol)."""
+    client = RpcClient(host, port, timeout=timeout)
+    proxy = _Proxy(client, namespace)
+    if protocol_version is not None:
+        remote = proxy.get_protocol_version()
+        if remote != protocol_version:
+            raise RpcError(f"protocol version mismatch: client "
+                           f"{protocol_version}, server {remote}")
+    return proxy

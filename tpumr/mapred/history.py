@@ -1,0 +1,65 @@
+"""Job history — structured event log.
+
+≈ ``org.apache.hadoop.mapred.JobHistory`` (reference: src/mapred/org/apache/
+hadoop/mapred/JobHistory.java, 2703 LoC — field-encoded line format parsed
+by HistoryViewer/rumen). Re-designed as JSON-lines per job under
+``tpumr.history.dir`` (one self-describing event per line), which serves the
+same consumers: post-hoc job analysis, the web status JSON, and recovery
+replay. Backend placement is a first-class field on every task event —
+the reference's GPU observability was log-grep only (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+
+class JobHistory:
+    def __init__(self, conf: Any) -> None:
+        self.dir = conf.get("tpumr.history.dir") if conf else None
+        self._lock = threading.Lock()
+
+    def _write(self, job_id: str, event: dict) -> None:
+        if not self.dir:
+            return
+        os.makedirs(self.dir, exist_ok=True)
+        event["ts"] = time.time()
+        with self._lock:
+            with open(os.path.join(self.dir, f"{job_id}.jsonl"), "a") as f:
+                f.write(json.dumps(event) + "\n")
+
+    def job_submitted(self, jip: Any) -> None:
+        self._write(str(jip.job_id), {
+            "event": "JOB_SUBMITTED",
+            "job_id": str(jip.job_id),
+            "job_name": jip.conf.get("mapred.job.name", ""),
+            "num_maps": jip.num_maps,
+            "num_reduces": jip.num_reduces,
+            "kernel": jip.conf.get("tpumr.map.kernel"),
+        })
+
+    def job_finished(self, jip: Any) -> None:
+        self._write(str(jip.job_id), {
+            "event": "JOB_FINISHED",
+            "job_id": str(jip.job_id),
+            "state": jip.state,
+            "wall_time": (jip.finish_time or time.time()) - jip.start_time,
+            "finished_cpu_maps": jip.finished_cpu_maps,
+            "finished_tpu_maps": jip.finished_tpu_maps,
+            "cpu_map_mean_time": jip.cpu_map_mean_time(),
+            "tpu_map_mean_time": jip.tpu_map_mean_time(),
+            "acceleration_factor": jip.acceleration_factor(),
+            "error": jip.error,
+        })
+
+    def task_event(self, job_id: str, event: str, **fields: Any) -> None:
+        self._write(job_id, {"event": event, **fields})
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]

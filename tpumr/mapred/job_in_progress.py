@@ -1,0 +1,356 @@
+"""Per-job task bookkeeping + per-backend runtime profiling.
+
+≈ ``org.apache.hadoop.mapred.JobInProgress`` (reference: src/mapred/org/
+apache/hadoop/mapred/JobInProgress.java, 3713 LoC). The pieces that matter
+to the hybrid scheduler are carried exactly:
+
+- ``finishedCPUMapTasks`` / ``finishedGPUMapTasks`` counters
+  (JobInProgress.java:115-116, incremented :2779-2784) →
+  :attr:`finished_cpu_maps` / :attr:`finished_tpu_maps`;
+- ``getCPUMapTaskMeanTime()`` / ``getGPUMapTaskMeanTime()``
+  (:527-565) → :meth:`cpu_map_mean_time` / :meth:`tpu_map_mean_time` —
+  kept as RUNNING sums + EWMA instead of the reference's per-heartbeat
+  O(tasks) recomputation over all TaskReports (the control-plane hot-loop
+  cost called out in SURVEY.md §3.2; semantics preserved, cost O(1));
+- locality caches (node → pending maps) feeding
+  ``obtainNewNodeLocalMapTask`` / ``obtainNewNonLocalMapTask``;
+- the reference decrements BOTH backend counters on a failed map
+  (JobInProgress.java:3156-3159) — a quirk, not intent; here a failure
+  decrements only the backend the attempt ran on (divergence documented).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tpumr.core.counters import Counters
+from tpumr.mapred.ids import JobID, TaskAttemptID, TaskID
+from tpumr.mapred.task import Task, TaskReport, TaskState, TaskStatus
+
+
+class JobState:
+    PREP = "PREP"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+    TERMINAL = {SUCCEEDED, FAILED, KILLED}
+
+
+@dataclass
+class TaskInProgress:
+    """≈ mapred/TaskInProgress.java (condensed): one logical task, its
+    attempts and state."""
+
+    task_id: TaskID
+    partition: int
+    split: dict | None = None
+    state: str = "pending"            # pending | running | succeeded | failed
+    attempts: dict[str, TaskStatus] = field(default_factory=dict)
+    next_attempt: int = 0
+    failures: int = 0
+    successful_attempt: str = ""
+    report: TaskReport = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.report is None:
+            self.report = TaskReport(self.task_id)
+
+    def new_attempt(self) -> TaskAttemptID:
+        a = TaskAttemptID(self.task_id, self.next_attempt)
+        self.next_attempt += 1
+        return a
+
+    @property
+    def is_map(self) -> bool:
+        return self.task_id.is_map
+
+    def running_attempts(self) -> list[TaskStatus]:
+        return [s for s in self.attempts.values()
+                if s.state == TaskState.RUNNING]
+
+
+class JobInProgress:
+    def __init__(self, job_id: JobID, conf_dict: dict, splits: list[dict],
+                 tracker_addr_of: Any = None) -> None:
+        self.job_id = job_id
+        self.conf = dict(conf_dict)
+        self.num_reduces = int(self.conf.get("mapred.reduce.tasks", 1))
+        self.state = JobState.RUNNING
+        self.start_time = time.time()
+        self.finish_time = 0.0
+        self.counters = Counters()
+        self.lock = threading.RLock()
+        self.max_map_attempts = int(self.conf.get("mapred.map.max.attempts", 4))
+        self.max_reduce_attempts = int(self.conf.get("mapred.reduce.max.attempts", 4))
+        self.slowstart = float(self.conf.get(
+            "mapred.reduce.slowstart.completed.maps", 0.05))
+        self.speculative = bool(self.conf.get("mapred.speculative.execution", True))
+        self.error = ""
+
+        self.maps = [TaskInProgress(TaskID(job_id, True, i), i, split=s)
+                     for i, s in enumerate(splits)]
+        self.reduces = [TaskInProgress(TaskID(job_id, False, r), r)
+                        for r in range(self.num_reduces)]
+        # locality cache host -> set(map idx) (≈ nonRunningMapCache)
+        self.host_cache: dict[str, set[int]] = {}
+        for i, s in enumerate(splits):
+            for h in (s or {}).get("locations", []) or []:
+                self.host_cache.setdefault(h, set()).add(i)
+        self._pending_maps = set(range(len(self.maps)))
+        self._pending_reduces = set(range(self.num_reduces))
+        self.finished_maps = 0
+        self.finished_reduces = 0
+        # --- per-backend profiling (running sums, O(1) per update) ---
+        self.finished_cpu_maps = 0
+        self.finished_tpu_maps = 0
+        self._cpu_time_sum = 0.0
+        self._tpu_time_sum = 0.0
+        self._ewma_alpha = float(self.conf.get("tpumr.profile.ewma", 0.0))
+        self._cpu_ewma = 0.0
+        self._tpu_ewma = 0.0
+        # completion events for reduce fetchers (≈ TaskCompletionEvents)
+        self.completion_events: list[dict] = []
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def num_maps(self) -> int:
+        return len(self.maps)
+
+    def pending_map_count(self) -> int:
+        return len(self._pending_maps)
+
+    def pending_reduce_count(self) -> int:
+        return len(self._pending_reduces)
+
+    def has_kernel(self) -> bool:
+        """≈ the hadoop.pipes.gpu.executable gate
+        (JobQueueTaskScheduler.java:342-347): only kernel-equipped jobs are
+        eligible for TPU slots."""
+        return bool(self.conf.get("tpumr.map.kernel"))
+
+    def cpu_map_mean_time(self) -> float:
+        """Mean CPU map runtime (0.0 when no data — matching the reference's
+        'returns 0 until first completion' behavior that makes the scheduler
+        fall back to unconditional assignment)."""
+        if self._ewma_alpha and self._cpu_ewma:
+            return self._cpu_ewma
+        return self._cpu_time_sum / self.finished_cpu_maps \
+            if self.finished_cpu_maps else 0.0
+
+    def tpu_map_mean_time(self) -> float:
+        if self._ewma_alpha and self._tpu_ewma:
+            return self._tpu_ewma
+        return self._tpu_time_sum / self.finished_tpu_maps \
+            if self.finished_tpu_maps else 0.0
+
+    def acceleration_factor(self) -> float:
+        """cpuMean / tpuMean (JobQueueTaskScheduler.java:175-178); 1.0 until
+        both backends have profile data."""
+        cpu, tpu = self.cpu_map_mean_time(), self.tpu_map_mean_time()
+        if cpu > 0 and tpu > 0:
+            return cpu / tpu
+        return 1.0
+
+    def map_progress(self) -> float:
+        if not self.maps:
+            return 1.0
+        running = sum(max((s.progress for s in t.running_attempts()),
+                          default=0.0)
+                      for t in self.maps if t.state == "running")
+        return min(1.0, (self.finished_maps + running) / len(self.maps))
+
+    def reduce_progress(self) -> float:
+        if not self.reduces:
+            return 1.0
+        return self.finished_reduces / len(self.reduces)
+
+    # ------------------------------------------------------------ obtain
+
+    def obtain_new_map_task(self, host: str, run_on_tpu: bool,
+                            tpu_device_id: int = -1) -> Task | None:
+        """Locality-preferring map assignment ≈ obtainNewNodeLocalMapTask →
+        obtainNewNonLocalMapTask (selection path of
+        JobQueueTaskScheduler.java:306-317)."""
+        with self.lock:
+            if self.state != JobState.RUNNING or not self._pending_maps:
+                return None
+            local = self.host_cache.get(host, set()) & self._pending_maps
+            idx = min(local) if local else min(self._pending_maps)
+            self._pending_maps.discard(idx)
+            tip = self.maps[idx]
+            tip.state = "running"
+            attempt = tip.new_attempt()
+            tip.report.state = TaskState.RUNNING
+            tip.report.start_time = tip.report.start_time or time.time()
+            # stamp placement on the report ≈ JobTracker.java:3414-3433
+            tip.report.run_on_tpu = run_on_tpu
+            tip.report.tpu_device_id = tpu_device_id
+            return Task(attempt, partition=idx, num_reduces=self.num_reduces,
+                        split=tip.split, num_maps=len(self.maps),
+                        run_on_tpu=run_on_tpu, tpu_device_id=tpu_device_id)
+
+    def obtain_new_reduce_task(self, host: str) -> Task | None:
+        with self.lock:
+            if self.state != JobState.RUNNING or not self._pending_reduces:
+                return None
+            # slowstart gate ≈ JobInProgress.scheduleReduces
+            if self.finished_maps < self.slowstart * max(1, len(self.maps)):
+                return None
+            idx = min(self._pending_reduces)
+            self._pending_reduces.discard(idx)
+            tip = self.reduces[idx]
+            tip.state = "running"
+            attempt = tip.new_attempt()
+            tip.report.state = TaskState.RUNNING
+            tip.report.start_time = tip.report.start_time or time.time()
+            return Task(attempt, partition=idx, num_reduces=self.num_reduces,
+                        num_maps=len(self.maps))
+
+    # ------------------------------------------------------------ updates
+
+    def update_task_status(self, status: TaskStatus,
+                           tracker_shuffle_addr: str = "") -> None:
+        with self.lock:
+            tip = self._tip_of(status.attempt_id.task)
+            if tip is None:
+                return
+            tip.attempts[str(status.attempt_id)] = status
+            tip.report.progress = max(tip.report.progress, status.progress)
+            if status.state == TaskState.SUCCEEDED:
+                self._on_success(tip, status, tracker_shuffle_addr)
+            elif status.state in (TaskState.FAILED, TaskState.KILLED):
+                self._on_failure(tip, status)
+
+    def _tip_of(self, task_id: TaskID) -> TaskInProgress | None:
+        arr = self.maps if task_id.is_map else self.reduces
+        return arr[task_id.id] if task_id.id < len(arr) else None
+
+    def _on_success(self, tip: TaskInProgress, status: TaskStatus,
+                    shuffle_addr: str) -> None:
+        if tip.state == "succeeded":
+            return  # a speculative duplicate — first completion wins
+        tip.state = "succeeded"
+        tip.successful_attempt = str(status.attempt_id)
+        tip.report.state = TaskState.SUCCEEDED
+        tip.report.progress = 1.0
+        tip.report.finish_time = status.finish_time or time.time()
+        tip.report.successful_attempt = str(status.attempt_id)
+        if status.counters:
+            self.counters.merge(Counters.from_dict(status.counters))
+        if tip.is_map:
+            self.finished_maps += 1
+            runtime = status.runtime
+            if status.run_on_tpu:
+                self.finished_tpu_maps += 1
+                self._tpu_time_sum += runtime
+                if self._ewma_alpha:
+                    a = self._ewma_alpha
+                    self._tpu_ewma = (runtime if not self._tpu_ewma
+                                      else a * runtime + (1 - a) * self._tpu_ewma)
+            else:
+                self.finished_cpu_maps += 1
+                self._cpu_time_sum += runtime
+                if self._ewma_alpha:
+                    a = self._ewma_alpha
+                    self._cpu_ewma = (runtime if not self._cpu_ewma
+                                      else a * runtime + (1 - a) * self._cpu_ewma)
+            self.completion_events.append({
+                "map_index": tip.partition,
+                "attempt_id": str(status.attempt_id),
+                "shuffle_addr": shuffle_addr,
+            })
+        else:
+            self.finished_reduces += 1
+        if (self.finished_maps == len(self.maps)
+                and self.finished_reduces == len(self.reduces)):
+            self.state = JobState.SUCCEEDED
+            self.finish_time = time.time()
+
+    def _on_failure(self, tip: TaskInProgress, status: TaskStatus) -> None:
+        if tip.state == "succeeded":
+            return
+        if status.state == TaskState.FAILED:
+            # KILLED attempts (lost trackers, job kills, lost commit races)
+            # do NOT count toward the attempt limit — only real failures do
+            # (Hadoop excludes killed attempts the same way)
+            tip.failures += 1
+        limit = self.max_map_attempts if tip.is_map else self.max_reduce_attempts
+        if status.state == TaskState.FAILED and tip.failures >= limit:
+            self.state = JobState.FAILED
+            self.finish_time = time.time()
+            self.error = (f"task {tip.task_id} failed {tip.failures} times; "
+                          f"last: {status.diagnostics}")
+            return
+        # re-queue (≈ lost/failed task re-execution)
+        tip.state = "pending"
+        if tip.is_map:
+            self._pending_maps.add(tip.partition)
+        else:
+            self._pending_reduces.add(tip.partition)
+
+    def requeue_lost_attempts(self, attempt_ids: list[str]) -> None:
+        """Tracker lost (≈ JobTracker.lostTaskTracker): running attempts on
+        it are killed and their tasks re-queued; completed MAPS are also
+        re-queued because their outputs lived on the lost tracker — unless
+        the job has no reduces (reference semantics)."""
+        with self.lock:
+            for aid in attempt_ids:
+                attempt = TaskAttemptID.parse(aid)
+                tip = self._tip_of(attempt.task)
+                if tip is None:
+                    continue
+                st = tip.attempts.get(aid)
+                if st is not None and st.state == TaskState.RUNNING:
+                    st.state = TaskState.KILLED
+                    self._on_failure(tip, st)
+                elif (tip.is_map and tip.state == "succeeded"
+                      and tip.successful_attempt == aid
+                      and self.num_reduces > 0
+                      and self.state == JobState.RUNNING):
+                    tip.state = "pending"
+                    tip.successful_attempt = ""
+                    self.finished_maps -= 1
+                    # unwind the backend profile so the re-run isn't
+                    # double-counted in the hybrid scheduler's means
+                    if st is not None and st.is_map:
+                        if st.run_on_tpu:
+                            self.finished_tpu_maps -= 1
+                            self._tpu_time_sum -= st.runtime
+                        else:
+                            self.finished_cpu_maps -= 1
+                            self._cpu_time_sum -= st.runtime
+                    self._pending_maps.add(tip.partition)
+                    self.completion_events = [
+                        e for e in self.completion_events
+                        if e["attempt_id"] != aid]
+
+    def kill(self) -> None:
+        with self.lock:
+            if self.state not in JobState.TERMINAL:
+                self.state = JobState.KILLED
+                self.finish_time = time.time()
+
+    # ------------------------------------------------------------ wire
+
+    def status_dict(self) -> dict:
+        with self.lock:
+            return {
+                "job_id": str(self.job_id),
+                "state": self.state,
+                "map_progress": self.map_progress(),
+                "reduce_progress": self.reduce_progress(),
+                "finished_maps": self.finished_maps,
+                "finished_tpu_maps": self.finished_tpu_maps,
+                "finished_cpu_maps": self.finished_cpu_maps,
+                "num_maps": len(self.maps),
+                "num_reduces": len(self.reduces),
+                "cpu_map_mean_time": self.cpu_map_mean_time(),
+                "tpu_map_mean_time": self.tpu_map_mean_time(),
+                "acceleration_factor": self.acceleration_factor(),
+                "error": self.error,
+            }

@@ -1,0 +1,306 @@
+"""JobMaster — the cluster master daemon.
+
+≈ ``org.apache.hadoop.mapred.JobTracker`` (reference: src/mapred/org/apache/
+hadoop/mapred/JobTracker.java, 5405 LoC): job registry + tracker registry +
+the heartbeat endpoint. Reproduced contracts:
+
+- heartbeat dedupe by response id: a tracker retrying a lost response gets
+  the PREVIOUS actions replayed, never double-assigned work
+  (JobTracker.java:3336-3375);
+- unknown/expired trackers are told to reinitialize
+  (ReinitTrackerAction, :3358);
+- scheduler delegation at :3405 → ``TaskScheduler.assign_tasks``;
+- TaskReport placement stamping at assign time (:3414-3433) — done inside
+  JobInProgress.obtain_new_map_task here;
+- tracker liveness by heartbeat lease (ExpireTrackers) → lost trackers'
+  running attempts killed and completed map outputs re-queued
+  (lostTaskTracker);
+- per-tracker fault counting + blacklisting (faultyTrackers, :3330-3333);
+- the commit gate: first attempt to ask wins the right to promote its
+  output (≈ CommitTaskAction gating, TaskTracker.java:1725-1731).
+
+Structural divergence (by design, SURVEY.md §3.2): no global synchronized
+heartbeat monitor around O(jobs×tasks) recomputation — job profiling uses
+O(1) running sums and the master lock only guards registries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from tpumr.ipc.rpc import RpcServer
+from tpumr.mapred.history import JobHistory
+from tpumr.mapred.ids import JobID
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.job_in_progress import JobInProgress, JobState
+from tpumr.mapred.scheduler import HybridQueueScheduler, TaskScheduler
+from tpumr.mapred.task import TaskState, TaskStatus
+from tpumr.utils.reflection import new_instance
+
+#: ≈ InterTrackerProtocol versionID 29 (InterTrackerProtocol.java:75)
+PROTOCOL_VERSION = 29
+
+
+class _TrackerInfo:
+    def __init__(self, status: dict) -> None:
+        self.status = status
+        self.last_seen = time.time()
+        self.failures = 0
+        self.blacklisted = False
+
+    @property
+    def name(self) -> str:
+        return self.status["tracker_name"]
+
+
+class JobMaster:
+    def __init__(self, conf: Any, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.conf = conf
+        self.lock = threading.RLock()
+        self.jobs: dict[str, JobInProgress] = {}
+        self.trackers: dict[str, _TrackerInfo] = {}
+        self._last_response: dict[str, tuple[int, list]] = {}
+        self._commit_grants: dict[str, str] = {}   # task_id -> attempt_id
+        self._next_job = 0
+        self.cluster_id = time.strftime("%Y%m%d%H%M")
+        self.expiry_s = conf.get_int("tpumr.tracker.expiry.ms", 10_000) / 1000.0
+        self.blacklist_faults = conf.get_int("tpumr.tracker.max.faults", 4)
+        sched_cls = conf.get_class("mapred.jobtracker.taskScheduler",
+                                   HybridQueueScheduler)
+        self.scheduler: TaskScheduler = new_instance(sched_cls, conf)
+        self.scheduler.set_manager(self)
+        self.history = JobHistory(conf)
+        self._server = RpcServer(self, host=host, port=port)
+        self._stop = threading.Event()
+        self._expire_thread = threading.Thread(
+            target=self._expire_loop, name="expire-trackers", daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "JobMaster":
+        self._server.start()
+        self._expire_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.address
+
+    # ------------------------------------------------------------ SPI seams
+
+    def running_jobs(self) -> list[JobInProgress]:
+        with self.lock:
+            return [j for j in self.jobs.values()
+                    if j.state == JobState.RUNNING]
+
+    def num_trackers(self) -> int:
+        with self.lock:
+            return len([t for t in self.trackers.values()
+                        if not t.blacklisted]) or 1
+
+    def total_slots(self) -> dict:
+        with self.lock:
+            out = {"cpu": 0, "tpu": 0, "reduce": 0}
+            for t in self.trackers.values():
+                out["cpu"] += t.status.get("max_cpu_map_slots", 0)
+                out["tpu"] += t.status.get("max_tpu_map_slots", 0)
+                out["reduce"] += t.status.get("max_reduce_slots", 0)
+            return out
+
+    # ------------------------------------------------------------ RPC: jobs
+
+    def get_protocol_version(self) -> int:
+        return PROTOCOL_VERSION
+
+    def submit_job(self, conf_dict: dict, splits: list) -> str:
+        with self.lock:
+            self._next_job += 1
+            job_id = JobID(self.cluster_id, self._next_job)
+            jip = JobInProgress(job_id, conf_dict, splits)
+            self.jobs[str(job_id)] = jip
+            self.history.job_submitted(jip)
+            return str(job_id)
+
+    def get_job_status(self, job_id: str) -> dict:
+        jip = self._job(job_id)
+        return jip.status_dict()
+
+    def get_counters(self, job_id: str) -> dict:
+        return self._job(job_id).counters.to_dict()
+
+    def get_task_reports(self, job_id: str, kind: str = "map") -> list:
+        jip = self._job(job_id)
+        tips = jip.maps if kind == "map" else jip.reduces
+        return [{
+            "task_id": str(t.task_id), "state": t.report.state,
+            "progress": t.report.progress,
+            "start_time": t.report.start_time,
+            "finish_time": t.report.finish_time,
+            "run_on_tpu": t.report.run_on_tpu,
+            "tpu_device_id": t.report.tpu_device_id,
+            "successful_attempt": t.report.successful_attempt,
+        } for t in tips]
+
+    def kill_job(self, job_id: str) -> bool:
+        jip = self._job(job_id)
+        jip.kill()
+        self._finalize_job(jip)
+        return True
+
+    def _finalize_job(self, jip: JobInProgress) -> None:
+        """Job-level output commit/abort + history. The reference runs this
+        as a cleanup TASK on a tracker (getSetupAndCleanupTasks,
+        JobTracker.java:3398); master-side finalization is a deliberate
+        simplification — the output FS is shared, the work is two renames."""
+        try:
+            from tpumr.mapred.output_formats import FileOutputCommitter
+            conf = JobConf()
+            for k, v in jip.conf.items():
+                conf.set(k, v)
+            if conf.get("mapred.output.dir"):
+                committer = FileOutputCommitter(conf)
+                if jip.state == JobState.SUCCEEDED:
+                    committer.commit_job()
+                else:
+                    committer.abort_job()
+        except Exception as e:  # noqa: BLE001
+            jip.error = jip.error or f"job finalization failed: {e}"
+        self.history.job_finished(jip)
+
+    def get_map_completion_events(self, job_id: str, from_index: int = 0,
+                                  max_events: int = 10_000) -> list:
+        jip = self._job(job_id)
+        with jip.lock:
+            return jip.completion_events[from_index: from_index + max_events]
+
+    def get_job_conf(self, job_id: str) -> dict:
+        return dict(self._job(job_id).conf)
+
+    def _job(self, job_id: str) -> JobInProgress:
+        with self.lock:
+            jip = self.jobs.get(job_id)
+        if jip is None:
+            raise KeyError(f"unknown job {job_id}")
+        return jip
+
+    # ------------------------------------------------------------ RPC: commit
+
+    def can_commit(self, task_id: str, attempt_id: str) -> bool:
+        """First asker wins (≈ the single CommitTaskAction per task). Grants
+        are revoked when the granted attempt fails or its tracker is lost,
+        so re-runs can commit."""
+        with self.lock:
+            granted = self._commit_grants.setdefault(task_id, attempt_id)
+            return granted == attempt_id
+
+    def _revoke_commit(self, task_id: str, attempt_id: str) -> None:
+        with self.lock:
+            if self._commit_grants.get(task_id) == attempt_id:
+                del self._commit_grants[task_id]
+
+    # ------------------------------------------------------------ RPC: heartbeat
+
+    def heartbeat(self, status: dict, initial_contact: bool,
+                  ask_for_new_task: bool, response_id: int) -> dict:
+        name = status["tracker_name"]
+        with self.lock:
+            info = self.trackers.get(name)
+            if info is None and not initial_contact:
+                # ≈ ReinitTrackerAction (JobTracker.java:3358): we don't know
+                # this tracker (expired or master restarted) — reset it
+                return {"response_id": response_id, "actions":
+                        [{"type": "reinit"}]}
+            if info is None:
+                info = self.trackers[name] = _TrackerInfo(status)
+            info.status = status
+            info.last_seen = time.time()
+
+            # Fold in task statuses FIRST — even when this turns out to be a
+            # replayed heartbeat. The tracker drops terminal statuses after
+            # any delivered response, so a completion carried on a retry
+            # would otherwise be lost forever.
+            shuffle_addr = status.get("shuffle_addr") or \
+                f"{status.get('host', '')}:{status.get('shuffle_port', 0)}"
+            for sd in status.get("task_statuses", []):
+                ts = TaskStatus.from_dict(sd)
+                job_id = str(ts.attempt_id.task.job)
+                jip = self.jobs.get(job_id)
+                if jip is not None:
+                    before = jip.state
+                    jip.update_task_status(ts, shuffle_addr)
+                    if ts.state in (TaskState.FAILED, TaskState.KILLED):
+                        # a dead attempt must not keep the commit grant —
+                        # otherwise its re-run is denied commit and output
+                        # is silently lost
+                        self._revoke_commit(str(ts.attempt_id.task),
+                                            str(ts.attempt_id))
+                    if ts.state == "FAILED":
+                        info.failures += 1
+                        if info.failures >= self.blacklist_faults:
+                            info.blacklisted = True
+                    if before == JobState.RUNNING and \
+                            jip.state in JobState.TERMINAL:
+                        self._finalize_job(jip)
+
+            # Normal case: the tracker echoes the response id we last sent
+            # (last[0] == response_id). A MISMATCH means our response was
+            # lost in flight — replay the stored actions rather than
+            # assigning duplicate work (JobTracker.java:3336-3375).
+            last = self._last_response.get(name)
+            if last is not None and last[0] != response_id and not initial_contact:
+                return {"response_id": last[0], "actions": last[1]}
+
+            actions: list[dict] = []
+            # kill actions for tasks of dead jobs
+            from tpumr.mapred.ids import TaskAttemptID
+            for sd in status.get("task_statuses", []):
+                aid = sd["attempt_id"]
+                job_id = str(TaskAttemptID.parse(aid).task.job)
+                jip = self.jobs.get(job_id)
+                if jip is not None and jip.state in JobState.TERMINAL \
+                        and sd["state"] == "RUNNING":
+                    actions.append({"type": "kill_task", "attempt_id": aid})
+
+            if ask_for_new_task and not info.blacklisted:
+                for task in self.scheduler.assign_tasks(status):
+                    actions.append({"type": "launch",
+                                    "job_id": str(task.attempt_id.task.job),
+                                    "task": task.to_dict()})
+
+            response_id += 1
+            self._last_response[name] = (response_id, actions)
+            return {"response_id": response_id, "actions": actions}
+
+    # ------------------------------------------------------------ expiry
+
+    def _expire_loop(self) -> None:
+        while not self._stop.wait(min(1.0, self.expiry_s / 3)):
+            now = time.time()
+            with self.lock:
+                lost = [n for n, t in self.trackers.items()
+                        if now - t.last_seen > self.expiry_s]
+                for name in lost:
+                    info = self.trackers.pop(name)
+                    self._last_response.pop(name, None)
+                    attempts = [sd["attempt_id"] for sd in
+                                info.status.get("task_statuses", [])]
+                    addr = (f"{info.status.get('host', '')}:"
+                            f"{info.status.get('shuffle_port', 0)}")
+                    # also re-queue completed maps whose outputs lived there
+                    for jip in self.jobs.values():
+                        with jip.lock:
+                            owned = [e["attempt_id"]
+                                     for e in jip.completion_events
+                                     if e["shuffle_addr"] == addr]
+                        jip.requeue_lost_attempts(attempts + owned)
+                    from tpumr.mapred.ids import TaskAttemptID
+                    for aid in attempts:
+                        self._revoke_commit(str(TaskAttemptID.parse(aid).task),
+                                            aid)

@@ -1,0 +1,201 @@
+"""Task schedulers: the pluggable SPI + the hybrid CPU/TPU scheduler.
+
+≈ ``org.apache.hadoop.mapred.TaskScheduler`` (SPI) and the GPU-modified
+``JobQueueTaskScheduler`` (reference: src/mapred/org/apache/hadoop/mapred/
+JobQueueTaskScheduler.java, 628 LoC — the Shirahata et al. hybrid
+scheduler, SURVEY.md §2.1). The algorithm is ported faithfully:
+
+- per-job mean CPU/TPU map runtimes → ``accelerationFactor = cpuMean/tpuMean``
+  (:127-178);
+- **optional scheduling** (:78, :290-291): when
+  ``mapred.jobtracker.map.optionalscheduling`` is on and the remaining map
+  load fits the accelerator capacity
+  (``pendingMapLoad < accelFactor × tpuCapacity × numTrackers``), the CPU
+  pass is SKIPPED — work converges onto the faster backend;
+- the TPU pass requires the job to have a device kernel (≈ the
+  ``hadoop.pipes.gpu.executable`` gate :342-347) and assigns a concrete free
+  device id per task (:355-361), consuming device availability locally
+  within the same heartbeat (:373-378);
+- at most ONE reduce task per heartbeat (:527-560);
+- the reference's commented-out load-split minimization ``f(x,y) =
+  max(⌈x/n_cpu⌉·t_cpu, ⌈y/n_tpu⌉·t_tpu)`` (:181-219) is implemented here as
+  a selectable mode (``tpumr.scheduler.mode = minimize``) instead of dead
+  code.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Protocol
+
+from tpumr.mapred.job_in_progress import JobInProgress, JobState
+from tpumr.mapred.task import Task
+
+
+class TaskTrackerManager(Protocol):
+    """What a scheduler needs from the master (≈ mapred/TaskTrackerManager
+    interface — the seam the reference's scheduler unit tests fake)."""
+
+    def running_jobs(self) -> list[JobInProgress]: ...
+    def num_trackers(self) -> int: ...
+    def total_slots(self) -> dict: ...   # {"cpu": n, "tpu": n, "reduce": n}
+
+
+class TaskScheduler:
+    """SPI ≈ mapred/TaskScheduler.java — pluggable via
+    ``mapred.jobtracker.taskScheduler``."""
+
+    def __init__(self) -> None:
+        self.manager: TaskTrackerManager | None = None
+        self.conf: Any = None
+
+    def set_manager(self, manager: TaskTrackerManager) -> None:
+        self.manager = manager
+
+    def configure(self, conf: Any) -> None:
+        self.conf = conf
+
+    def assign_tasks(self, tracker_status: dict) -> list[Task]:
+        raise NotImplementedError
+
+
+def _free_tpu_devices(tracker_status: dict) -> list[int]:
+    """Free physical device ids, recomputed from running task statuses each
+    heartbeat (≈ TaskTrackerStatus.availableGPUDevices(),
+    TaskTrackerStatus.java:536-550 — inferred, not leased)."""
+    avail = tracker_status.get("available_tpu_devices")
+    if avail is None:
+        avail = [True] * int(tracker_status.get("max_tpu_map_slots", 0))
+    return [i for i, free in enumerate(avail) if free]
+
+
+class HybridQueueScheduler(TaskScheduler):
+    """FIFO job queue + Shirahata hybrid CPU/TPU map placement."""
+
+    def assign_tasks(self, tts: dict) -> list[Task]:
+        assert self.manager is not None
+        jobs = [j for j in self.manager.running_jobs()
+                if j.state == JobState.RUNNING]
+        if not jobs:
+            return []
+        n_trackers = max(1, self.manager.num_trackers())
+        host = tts.get("host", "")
+
+        max_cpu = int(tts.get("max_cpu_map_slots", 0))
+        max_tpu = int(tts.get("max_tpu_map_slots", 0))
+        max_red = int(tts.get("max_reduce_slots", 0))
+        run_cpu = int(tts.get("count_cpu_map_tasks", 0))
+        run_tpu = int(tts.get("count_tpu_map_tasks", 0))
+        run_red = int(tts.get("count_reduce_tasks", 0))
+        free_cpu = max(0, max_cpu - run_cpu)
+        free_tpu = max(0, max_tpu - run_tpu)
+        free_red = max(0, max_red - run_red)
+        free_devices = _free_tpu_devices(tts)
+
+        # cluster-wide pending load + profile scan (:127-178) — cheap here:
+        # per-job O(1) running sums instead of per-report recomputation
+        pending_map_load = sum(j.pending_map_count() for j in jobs)
+        assigned: list[Task] = []
+
+        mode = str(self.conf.get("tpumr.scheduler.mode", "shirahata")) \
+            if self.conf else "shirahata"
+
+        # ---- per-JOB CPU budgets (a starved hybrid job must not block CPU
+        # slots for kernel-less jobs that can only ever run on CPU)
+        cpu_budget: dict[str, int] = {}
+        for job in jobs:
+            jid = str(job.job_id)
+            cpu_budget[jid] = free_cpu
+            if not job.has_kernel():
+                continue
+            accel = job.acceleration_factor()
+            if mode == "minimize":
+                cpu_budget[jid] = self._minimize_cpu_share(
+                    job, free_cpu, max_tpu * n_trackers)
+            elif (self._optional_scheduling(job)
+                    and job.pending_map_count() < accel * max_tpu * n_trackers):
+                # optional scheduling: starve THIS job's CPU share so its
+                # remaining maps converge to the accelerator (:290-327)
+                cpu_budget[jid] = 0
+
+        # ---- TPU pass first (reference order fills GPU after CPU; filling
+        # the scarcer, faster pool first avoids giving a map to a CPU slot
+        # that a free device could have taken in the same heartbeat)
+        for _ in range(free_tpu):
+            if not free_devices:
+                break
+            task = None
+            for job in jobs:
+                if not job.has_kernel():
+                    continue  # ≈ gpu-executable gate (:342-347)
+                device = free_devices[0]
+                task = job.obtain_new_map_task(host, run_on_tpu=True,
+                                               tpu_device_id=device)
+                if task is not None:
+                    free_devices.pop(0)  # consume locally (:373-378)
+                    break
+            if task is None:
+                break
+            assigned.append(task)
+            pending_map_load -= 1
+
+        # ---- CPU pass (:290-327)
+        for _ in range(free_cpu):
+            task = None
+            for job in jobs:
+                jid = str(job.job_id)
+                if cpu_budget.get(jid, 0) <= 0:
+                    continue
+                task = job.obtain_new_map_task(host, run_on_tpu=False)
+                if task is not None:
+                    cpu_budget[jid] -= 1
+                    break
+            if task is None:
+                break
+            assigned.append(task)
+            pending_map_load -= 1
+
+        # ---- reduce pass: at most one per heartbeat (:527-560)
+        if free_red > 0:
+            for job in jobs:
+                task = job.obtain_new_reduce_task(host)
+                if task is not None:
+                    assigned.append(task)
+                    break
+
+        return assigned
+
+    def _optional_scheduling(self, job: JobInProgress) -> bool:
+        return bool(job.conf.get("mapred.jobtracker.map.optionalscheduling",
+                                 False))
+
+    def _minimize_cpu_share(self, job: JobInProgress, n_cpu: int,
+                            n_tpu_total: int) -> int:
+        """Implemented form of the commented-out minimization
+        (JobQueueTaskScheduler.java:181-219): choose the CPU share x of the
+        pending maps minimizing
+        ``f(x, y) = max(⌈x/n_cpu⌉·t_cpu, ⌈y/n_tpu⌉·t_tpu)``; returns how
+        many CPU slots are worth filling this heartbeat (0 when the optimum
+        puts everything on TPU)."""
+        pending = job.pending_map_count()
+        t_cpu = job.cpu_map_mean_time()
+        t_tpu = job.tpu_map_mean_time()
+        if pending == 0 or t_cpu <= 0 or t_tpu <= 0 or n_tpu_total == 0:
+            return n_cpu  # no profile yet: behave like plain FIFO
+        best_x, best_f = 0, math.inf
+        for x in range(pending + 1):
+            y = pending - x
+            f = max(math.ceil(x / max(1, n_cpu)) * t_cpu,
+                    math.ceil(y / n_tpu_total) * t_tpu)
+            if f < best_f:
+                best_x, best_f = x, f
+        return min(n_cpu, best_x)
+
+
+class FifoScheduler(HybridQueueScheduler):
+    """Plain FIFO: hybrid logic off — every map is a CPU map unless the
+    tracker has TPU slots and the job a kernel (no starvation, no
+    minimization). Mirrors stock JobQueueTaskScheduler behavior."""
+
+    def _optional_scheduling(self, job: JobInProgress) -> bool:
+        return False

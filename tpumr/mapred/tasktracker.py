@@ -1,0 +1,384 @@
+"""NodeRunner — the per-host worker daemon.
+
+≈ ``org.apache.hadoop.mapred.TaskTracker`` (reference: src/mapred/org/
+apache/hadoop/mapred/TaskTracker.java, 4636 LoC). Reproduced contracts:
+
+- the heartbeat loop (offerService :1706-1775 / transmitHeartBeat
+  :1789-1860): status with BOTH pool maxima, ``ask_for_new_task`` when
+  either pool has room (:1841-1844), response-id resend protocol;
+- **dual slot pools** (:331-333, :1427-1432): separate CPU and TPU map slot
+  maxima; the launcher gates each task on the pool matching its
+  ``run_on_tpu`` flag (TaskLauncher :2502-2628) and frees the right pool on
+  completion/kill (:3401-3402);
+- per-device accounting: free TPU device ids derived from running task
+  statuses (availableGPUDevices, TaskTrackerStatus.java:536-550) and
+  shipped in every heartbeat;
+- the shuffle server role (MapOutputServlet :4050): map outputs are served
+  per (job, map, partition) over the tracker's RPC port;
+- task execution in-process on threads (the reference forks child JVMs via
+  TaskRunner/JvmManager — an explicit re-design: kernels must share the
+  host process to share the JAX runtime and HBM split cache; subprocess
+  isolation remains available through the pipes/streaming tier).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+import traceback
+from typing import Any
+
+from tpumr.core.counters import Counters
+from tpumr.io import ifile
+from tpumr.ipc.rpc import RpcClient, RpcServer
+from tpumr.mapred.api import Reporter
+from tpumr.mapred.ids import TaskAttemptID
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.jobtracker import PROTOCOL_VERSION
+from tpumr.mapred.map_task import run_map_task
+from tpumr.mapred.output_formats import FileOutputCommitter
+from tpumr.mapred.reduce_task import run_reduce_task
+from tpumr.mapred.task import Task, TaskPhase, TaskState, TaskStatus
+
+
+def _resolvable(host: str) -> bool:
+    import socket
+    try:
+        socket.getaddrinfo(host, None)
+        return True
+    except OSError:
+        return False
+
+
+class NodeRunner:
+    def __init__(self, master_host: str, master_port: int, conf: JobConf,
+                 name: str | None = None, host: str = "127.0.0.1",
+                 n_tpu_devices: int | None = None,
+                 bind_host: str | None = None) -> None:
+        self.conf = conf
+        #: locality name reported to the scheduler (may be a fake topology
+        #: name ≈ MiniMRCluster hosts ctor args)
+        self.host = host
+        #: routable address the RPC/shuffle server binds and advertises
+        self.bind_host = bind_host or ("127.0.0.1" if host and not
+                                       _resolvable(host) else host)
+        self.name = name or f"tracker_{host}_{id(self) & 0xffff}"
+        self.master = RpcClient(master_host, master_port)
+        remote_version = self.master.call("get_protocol_version")
+        if remote_version != PROTOCOL_VERSION:
+            raise RuntimeError(f"master protocol {remote_version} != "
+                               f"{PROTOCOL_VERSION}")
+
+        self.max_cpu_map_slots = conf.max_cpu_map_slots
+        self.max_tpu_map_slots = conf.max_tpu_map_slots
+        self.max_reduce_slots = conf.max_reduce_slots
+        self.n_tpu_devices = (n_tpu_devices if n_tpu_devices is not None
+                              else max(1, self.max_tpu_map_slots))
+        self.heartbeat_s = conf.get_int("tpumr.heartbeat.interval.ms", 1000) / 1000.0
+
+        self.lock = threading.RLock()
+        self.running: dict[str, TaskStatus] = {}      # attempt -> status
+        self.running_tasks: dict[str, Task] = {}
+        self._kill_requested: set[str] = set()
+        self.map_outputs: dict[tuple[str, int], tuple[str, dict]] = {}
+        self.job_confs: dict[str, JobConf] = {}
+        self.local_root = tempfile.mkdtemp(prefix=f"tpumr-{self.name}-")
+        self._response_id = 0
+        self._initial_contact = True
+        self._stop = threading.Event()
+        self._hb_count = 0
+        # per-pool gating ≈ TaskLauncher's numCPUFreeSlots/numGPUFreeSlots
+        # wait loops (TaskTracker.java:2502-2628): even if the master ever
+        # over-assigns, a task blocks until ITS pool has a slot
+        self._cpu_sem = threading.Semaphore(max(1, self.max_cpu_map_slots))
+        self._tpu_sem = threading.Semaphore(max(1, self.max_tpu_map_slots))
+        self._red_sem = threading.Semaphore(max(1, self.max_reduce_slots))
+
+        # shuffle server = this tracker's RPC surface (MapOutputServlet role)
+        self._server = RpcServer(self, host=self.bind_host, port=0)
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           name=f"{self.name}-heartbeat",
+                                           daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "NodeRunner":
+        self._server.start()
+        self._hb_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()
+        shutil.rmtree(self.local_root, ignore_errors=True)
+
+    @property
+    def shuffle_port(self) -> int:
+        return self._server.port
+
+    # ------------------------------------------------------------ status
+
+    def _counts(self) -> tuple[int, int, int]:
+        cpu = tpu = red = 0
+        for aid, st in self.running.items():
+            if st.state != TaskState.RUNNING:
+                continue
+            if st.is_map:
+                if st.run_on_tpu:
+                    tpu += 1
+                else:
+                    cpu += 1
+            else:
+                red += 1
+        return cpu, tpu, red
+
+    def _available_tpu_devices(self) -> list[bool]:
+        """free[i] derived from running task statuses each heartbeat
+        (≈ TaskTrackerStatus.availableGPUDevices, :536-550)."""
+        free = [True] * self.n_tpu_devices
+        for st in self.running.values():
+            if (st.state == TaskState.RUNNING and st.run_on_tpu
+                    and 0 <= st.tpu_device_id < self.n_tpu_devices):
+                free[st.tpu_device_id] = False
+        return free
+
+    def _status_dict(self) -> dict:
+        with self.lock:
+            cpu, tpu, red = self._counts()
+            statuses = [st.to_dict() for st in self.running.values()]
+            return {
+                "tracker_name": self.name,
+                "host": self.host,
+                "shuffle_addr": f"{self.bind_host}:{self.shuffle_port}",
+                "shuffle_port": self.shuffle_port,
+                "max_cpu_map_slots": self.max_cpu_map_slots,
+                "max_tpu_map_slots": self.max_tpu_map_slots,
+                "max_reduce_slots": self.max_reduce_slots,
+                "count_cpu_map_tasks": cpu,
+                "count_tpu_map_tasks": tpu,
+                "count_reduce_tasks": red,
+                "available_tpu_devices": self._available_tpu_devices(),
+                "task_statuses": statuses,
+            }
+
+    # ------------------------------------------------------------ heartbeat
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._heartbeat_once()
+            except Exception:
+                # master briefly unreachable — keep trying (lease semantics)
+                time.sleep(self.heartbeat_s)
+            self._stop.wait(self.heartbeat_s)
+
+    def _heartbeat_once(self) -> None:
+        status = self._status_dict()
+        cpu, tpu, red = (status["count_cpu_map_tasks"],
+                         status["count_tpu_map_tasks"],
+                         status["count_reduce_tasks"])
+        # ask if ANY pool has room (TaskTracker.java:1841-1844)
+        ask = (cpu < self.max_cpu_map_slots or tpu < self.max_tpu_map_slots
+               or red < self.max_reduce_slots)
+        resp = self.master.call("heartbeat", status, self._initial_contact,
+                                ask, self._response_id)
+        self._initial_contact = False
+        self._response_id = resp["response_id"]
+        with self.lock:
+            # Drop only statuses whose SENT snapshot was terminal — a task
+            # that finished while the RPC was in flight was reported as
+            # RUNNING, so it must survive until the next heartbeat or the
+            # master never learns it completed.
+            sent_terminal = {sd["attempt_id"]
+                             for sd in status.get("task_statuses", [])
+                             if sd["state"] in TaskState.TERMINAL}
+            for aid in sent_terminal:
+                self.running.pop(aid, None)
+                self.running_tasks.pop(aid, None)
+        for action in resp["actions"]:
+            self._apply_action(action)
+        self._hb_count += 1
+        if self._hb_count % 20 == 0:
+            self._cleanup_finished_jobs()
+
+    def _cleanup_finished_jobs(self) -> None:
+        """Drop map outputs + cached confs of terminal jobs (≈ the
+        KillJobAction-driven purge of job-local dirs)."""
+        with self.lock:
+            job_ids = {j for j, _ in self.map_outputs} | set(self.job_confs)
+        for job_id in job_ids:
+            try:
+                st = self.master.call("get_job_status", job_id)
+            except Exception:
+                continue
+            if st["state"] in ("SUCCEEDED", "FAILED", "KILLED"):
+                with self.lock:
+                    self.map_outputs = {k: v for k, v in
+                                        self.map_outputs.items()
+                                        if k[0] != job_id}
+                    self.job_confs.pop(job_id, None)
+                shutil.rmtree(os.path.join(self.local_root, job_id),
+                              ignore_errors=True)
+
+    def _apply_action(self, action: dict) -> None:
+        kind = action.get("type")
+        if kind == "launch":
+            task = Task.from_dict(action["task"])
+            self._launch(action["job_id"], task)
+        elif kind == "kill_task":
+            with self.lock:
+                self._kill_requested.add(action["attempt_id"])
+        elif kind == "reinit":
+            # ≈ ReinitTrackerAction: drop local state, re-register
+            with self.lock:
+                self.running.clear()
+                self.running_tasks.clear()
+                self._initial_contact = True
+                self._response_id = 0
+
+    # ------------------------------------------------------------ execution
+
+    def _job_conf(self, job_id: str) -> JobConf:
+        with self.lock:
+            jc = self.job_confs.get(job_id)
+        if jc is None:
+            conf_dict = self.master.call("get_job_conf", job_id)
+            jc = JobConf()
+            for k, v in conf_dict.items():
+                jc.set(k, v)
+            with self.lock:
+                self.job_confs[job_id] = jc
+        return jc
+
+    def _launch(self, job_id: str, task: Task) -> None:
+        aid = str(task.attempt_id)
+        status = TaskStatus(attempt_id=task.attempt_id, is_map=task.is_map,
+                            state=TaskState.RUNNING,
+                            phase=TaskPhase.MAP if task.is_map
+                            else TaskPhase.SHUFFLE,
+                            run_on_tpu=task.run_on_tpu,
+                            tpu_device_id=task.tpu_device_id)
+        with self.lock:
+            self.running[aid] = status
+            self.running_tasks[aid] = task
+        t = threading.Thread(target=self._run_task,
+                             args=(job_id, task, status),
+                             name=f"task-{aid}", daemon=True)
+        t.start()
+
+    def _run_task(self, job_id: str, task: Task, status: TaskStatus) -> None:
+        aid = str(task.attempt_id)
+        reporter = Reporter()
+        sem = (self._red_sem if not task.is_map
+               else self._tpu_sem if task.run_on_tpu else self._cpu_sem)
+        sem.acquire()
+        try:
+            self._run_task_inner(job_id, task, status, reporter)
+        finally:
+            sem.release()  # ≈ addFreeSlots on done/kill (:3401-3402)
+
+    def _run_task_inner(self, job_id: str, task: Task, status: TaskStatus,
+                        reporter: Reporter) -> None:
+        aid = str(task.attempt_id)
+        try:
+            conf = self._job_conf(job_id)
+            committed = True
+            if task.is_map:
+                local_dir = os.path.join(self.local_root, job_id, aid)
+                out = run_map_task(conf, task, local_dir, reporter,
+                                   status=status)
+                with self.lock:
+                    if out[0]:
+                        self.map_outputs[(job_id, task.partition)] = out
+                if task.num_reduces == 0:
+                    committed = self._commit(conf, task)
+            else:
+                status.phase = TaskPhase.SHUFFLE
+                fetch = self._remote_fetch_factory(job_id, task)
+                run_reduce_task(conf, task, fetch, reporter)
+                status.phase = TaskPhase.REDUCE
+                committed = self._commit(conf, task)
+            status.counters = reporter.counters.to_dict()
+            status.progress = 1.0
+            status.finish_time = time.time()
+            with self.lock:
+                killed = aid in self._kill_requested
+            if not committed:
+                status.diagnostics = "commit denied: another attempt won"
+                status.state = TaskState.KILLED
+            else:
+                status.state = (TaskState.KILLED if killed
+                                else TaskState.SUCCEEDED)
+        except Exception as e:  # noqa: BLE001 — task failure is data
+            status.diagnostics = f"{type(e).__name__}: {e}\n" + \
+                traceback.format_exc(limit=8)
+            status.finish_time = time.time()
+            status.state = TaskState.FAILED
+
+    def _commit(self, conf: JobConf, task: Task) -> bool:
+        """Output promotion gated by the master (≈ COMMIT_PENDING →
+        CommitTaskAction). Returns False when the grant went to another
+        attempt — the caller must report this attempt KILLED, not SUCCEEDED
+        (its output was discarded)."""
+        committer = FileOutputCommitter(conf)
+        aid = str(task.attempt_id)
+        if not committer.needs_commit(aid):
+            return True
+        if self.master.call("can_commit", str(task.task_id), aid):
+            committer.commit_task(aid)
+            return True
+        committer.abort_task(aid)
+        return False
+
+    # ------------------------------------------------------------ shuffle
+
+    def get_map_output(self, job_id: str, map_index: int,
+                       partition: int) -> dict:
+        """Serve one partition segment (≈ MapOutputServlet,
+        TaskTracker.java:4050): raw length-prefixed (possibly compressed)
+        bytes straight off the spill file + the codec name."""
+        with self.lock:
+            ent = self.map_outputs.get((job_id, map_index))
+        if ent is None:
+            raise KeyError(f"no map output for {job_id} map {map_index}")
+        path, index = ent
+        with open(path, "rb") as f:
+            data = ifile.partition_bytes(f, index, partition)
+        return {"data": data, "codec": index.get("codec", "none")}
+
+    def _remote_fetch_factory(self, job_id: str, task: Task):
+        """Parallel-capable fetch ≈ ReduceCopier.MapOutputCopier: resolves
+        map locations from completion events, pulls each segment over the
+        source tracker's RPC."""
+        events: dict[int, dict] = {}
+        seen = [0]  # incremental cursor into the master's event list
+        clients: dict[str, RpcClient] = {}
+        poll_s = self.conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0
+        deadline = time.time() + self.conf.get_int(
+            "tpumr.shuffle.timeout.ms", 600_000) / 1000.0
+
+        def fetch(map_index: int, partition: int):
+            while map_index not in events:
+                fresh = self.master.call("get_map_completion_events",
+                                         job_id, seen[0])
+                seen[0] += len(fresh)
+                for e in fresh:
+                    events[e["map_index"]] = e
+                if map_index in events:
+                    break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"map {map_index} output never became available")
+                time.sleep(poll_s)
+            addr = events[map_index]["shuffle_addr"]
+            host, port = addr.rsplit(":", 1)
+            cli = clients.get(addr)
+            if cli is None:
+                cli = clients[addr] = RpcClient(host, int(port))
+            out = cli.call("get_map_output", job_id, map_index, partition)
+            return ifile.iter_transferred_segment(out["data"], out["codec"])
+
+        return fetch
