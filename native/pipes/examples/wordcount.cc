@@ -1,0 +1,73 @@
+// Pipes wordcount — the canonical external-binary example.
+// ≈ the reference pipes demo (src/examples/pipes/impl/wordcount-simple.cc),
+// written against the tpumr C++ API. An accelerator build of this binary
+// would read its device id from argv[1] (≈ Application.java:178-181); here
+// we just report the binding so the dual-executable path is observable.
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "../tpumr_pipes.hh"
+
+using tpumr::pipes::Factory;
+using tpumr::pipes::Mapper;
+using tpumr::pipes::Reducer;
+using tpumr::pipes::TaskContext;
+
+class WordCountMapper : public Mapper {
+ public:
+  explicit WordCountMapper(TaskContext& ctx) {
+    inputWords_ = ctx.getCounter("WordCount", "INPUT_WORDS");
+  }
+  void map(TaskContext& ctx) {
+    const std::string& line = ctx.getInputValue();
+    size_t i = 0;
+    int n = 0;
+    while (i < line.size()) {
+      while (i < line.size() && isspace(static_cast<unsigned char>(line[i])))
+        i++;
+      size_t start = i;
+      while (i < line.size() && !isspace(static_cast<unsigned char>(line[i])))
+        i++;
+      if (i > start) {
+        ctx.emit(line.substr(start, i - start), "1");
+        n++;
+      }
+    }
+    if (n) ctx.incrementCounter(inputWords_, uint64_t(n));
+  }
+
+ private:
+  int inputWords_;
+};
+
+class SumReducer : public Reducer {
+ public:
+  explicit SumReducer(TaskContext&) {}
+  void reduce(TaskContext& ctx) {
+    long long sum = 0;
+    while (ctx.nextValue())
+      sum += atoll(ctx.getInputValue().c_str());
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%lld", sum);
+    ctx.emit(ctx.getInputKey(), buf);
+  }
+};
+
+class WordCountFactory : public Factory {
+ public:
+  Mapper* createMapper(TaskContext& ctx) const {
+    return new WordCountMapper(ctx);
+  }
+  Reducer* createReducer(TaskContext& ctx) const {
+    return new SumReducer(ctx);
+  }
+};
+
+int main(int argc, char** argv) {
+  if (argc > 1)  // accelerator launch: device id as argv[1]
+    fprintf(stderr, "wordcount: bound to device %s\n", argv[1]);
+  WordCountFactory factory;
+  return tpumr::pipes::runTask(factory);
+}

@@ -1,0 +1,431 @@
+// tpumr pipes — C++ child runtime: socket transport, framed varint
+// protocol, HMAC-SHA1 authentication, task event loop.
+//
+// ≈ the reference child runtime (src/c++/pipes/impl/HadoopPipes.cc:296 —
+// protocol binding — and :475-546 — the event loop), re-designed around the
+// tpumr wire format (unsigned LEB128 varints, length-prefixed bytes,
+// big-endian IEEE doubles; codes in tpumr/pipes/protocol.py).
+
+#include "tpumr_pipes.hh"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+#include <vector>
+
+namespace tpumr {
+namespace pipes {
+
+// ------------------------------------------------------------------ codes
+enum Downward {
+  START = 0, SET_JOB_CONF = 1, SET_INPUT_TYPES = 2, RUN_MAP = 3,
+  MAP_ITEM = 4, RUN_REDUCE = 5, REDUCE_KEY = 6, REDUCE_VALUE = 7,
+  CLOSE = 8, ABORT = 9, AUTHENTICATION_REQ = 10,
+};
+enum Upward {
+  OUTPUT = 50, PARTITIONED_OUTPUT = 51, STATUS = 52, PROGRESS = 53,
+  DONE = 54, REGISTER_COUNTER = 55, INCREMENT_COUNTER = 56,
+  AUTHENTICATION_RESP = 57,
+};
+static const uint64_t PROTOCOL_VERSION = 0;
+
+// ------------------------------------------------------------------ sha1
+// Compact SHA-1 (FIPS 180-1) for the auth handshake only — the data plane
+// never hashes.
+struct Sha1 {
+  uint32_t h[5];
+  uint64_t len;
+  unsigned char buf[64];
+  size_t fill;
+
+  Sha1() { reset(); }
+  void reset() {
+    h[0] = 0x67452301; h[1] = 0xEFCDAB89; h[2] = 0x98BADCFE;
+    h[3] = 0x10325476; h[4] = 0xC3D2E1F0;
+    len = 0; fill = 0;
+  }
+  static uint32_t rol(uint32_t x, int n) {
+    return (x << n) | (x >> (32 - n));
+  }
+  void block(const unsigned char* p) {
+    uint32_t w[80];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 80; i++)
+      w[i] = rol(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+    for (int i = 0; i < 80; i++) {
+      uint32_t f, k;
+      if (i < 20)      { f = (b & c) | (~b & d);            k = 0x5A827999; }
+      else if (i < 40) { f = b ^ c ^ d;                     k = 0x6ED9EBA1; }
+      else if (i < 60) { f = (b & c) | (b & d) | (c & d);   k = 0x8F1BBCDC; }
+      else             { f = b ^ c ^ d;                     k = 0xCA62C1D6; }
+      uint32_t t = rol(a, 5) + f + e + k + w[i];
+      e = d; d = c; c = rol(b, 30); b = a; a = t;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d; h[4] += e;
+  }
+  void update(const void* data, size_t n) {
+    const unsigned char* p = static_cast<const unsigned char*>(data);
+    len += n;
+    while (n > 0) {
+      size_t take = 64 - fill;
+      if (take > n) take = n;
+      memcpy(buf + fill, p, take);
+      fill += take; p += take; n -= take;
+      if (fill == 64) { block(buf); fill = 0; }
+    }
+  }
+  void final(unsigned char out[20]) {
+    uint64_t bits = len * 8;
+    unsigned char pad = 0x80;
+    update(&pad, 1);
+    unsigned char zero = 0;
+    while (fill != 56) update(&zero, 1);
+    unsigned char lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = (bits >> (56 - 8 * i)) & 0xFF;
+    update(lenb, 8);
+    for (int i = 0; i < 5; i++) {
+      out[4 * i] = (h[i] >> 24) & 0xFF;
+      out[4 * i + 1] = (h[i] >> 16) & 0xFF;
+      out[4 * i + 2] = (h[i] >> 8) & 0xFF;
+      out[4 * i + 3] = h[i] & 0xFF;
+    }
+  }
+};
+
+static std::string hmacSha1Hex(const std::string& key,
+                               const std::string& msg) {
+  unsigned char k[64];
+  memset(k, 0, sizeof(k));
+  if (key.size() > 64) {
+    Sha1 s; s.update(key.data(), key.size());
+    unsigned char d[20]; s.final(d);
+    memcpy(k, d, 20);
+  } else {
+    memcpy(k, key.data(), key.size());
+  }
+  unsigned char ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) { ipad[i] = k[i] ^ 0x36; opad[i] = k[i] ^ 0x5C; }
+  Sha1 inner; inner.update(ipad, 64); inner.update(msg.data(), msg.size());
+  unsigned char id[20]; inner.final(id);
+  Sha1 outer; outer.update(opad, 64); outer.update(id, 20);
+  unsigned char od[20]; outer.final(od);
+  static const char* hex = "0123456789abcdef";
+  std::string out(40, '0');
+  for (int i = 0; i < 20; i++) {
+    out[2 * i] = hex[od[i] >> 4];
+    out[2 * i + 1] = hex[od[i] & 0xF];
+  }
+  return out;
+}
+
+// ------------------------------------------------------------------ stream
+class SocketStream {
+ public:
+  explicit SocketStream(int fd) : fd_(fd), rpos_(0), rlen_(0) {}
+
+  uint64_t readVarint() {
+    uint64_t result = 0;
+    int shift = 0;
+    for (;;) {
+      int b = readByte();
+      result |= uint64_t(b & 0x7F) << shift;
+      if (!(b & 0x80)) return result;
+      shift += 7;
+      if (shift > 63) throw std::runtime_error("varint too long");
+    }
+  }
+  std::string readBytes() {
+    uint64_t n = readVarint();
+    std::string out(n, '\0');
+    readFully(&out[0], n);
+    return out;
+  }
+  double readDouble() {
+    unsigned char b[8];
+    readFully(reinterpret_cast<char*>(b), 8);
+    uint64_t bits = 0;
+    for (int i = 0; i < 8; i++) bits = (bits << 8) | b[i];
+    double d;
+    memcpy(&d, &bits, 8);
+    return d;
+  }
+
+  void writeVarint(uint64_t n) {
+    unsigned char tmp[10];
+    int len = 0;
+    do {
+      unsigned char b = n & 0x7F;
+      n >>= 7;
+      if (n) b |= 0x80;
+      tmp[len++] = b;
+    } while (n);
+    wbuf_.insert(wbuf_.end(), tmp, tmp + len);
+  }
+  void writeBytes(const std::string& s) {
+    writeVarint(s.size());
+    wbuf_.insert(wbuf_.end(), s.begin(), s.end());
+  }
+  void writeDouble(double d) {
+    uint64_t bits;
+    memcpy(&bits, &d, 8);
+    for (int i = 7; i >= 0; i--)
+      wbuf_.push_back(static_cast<char>((bits >> (8 * i)) & 0xFF));
+  }
+  void flush() {
+    size_t off = 0;
+    while (off < wbuf_.size()) {
+      ssize_t n = ::write(fd_, wbuf_.data() + off, wbuf_.size() - off);
+      if (n <= 0) throw std::runtime_error("pipes socket write failed");
+      off += size_t(n);
+    }
+    wbuf_.clear();
+  }
+  // bounded buffering: emit-heavy tasks must stream, not accumulate the
+  // whole task output in memory
+  void maybeFlush() {
+    if (wbuf_.size() >= 64 * 1024) flush();
+  }
+
+ private:
+  int readByte() {
+    if (rpos_ == rlen_) {
+      ssize_t n = ::read(fd_, rbuf_, sizeof(rbuf_));
+      if (n <= 0) throw std::runtime_error("pipes socket closed");
+      rlen_ = size_t(n);
+      rpos_ = 0;
+    }
+    return static_cast<unsigned char>(rbuf_[rpos_++]);
+  }
+  void readFully(char* dst, size_t n) {
+    for (size_t i = 0; i < n; i++)
+      dst[i] = static_cast<char>(readByte());
+  }
+
+  int fd_;
+  char rbuf_[65536];
+  size_t rpos_, rlen_;
+  std::vector<char> wbuf_;
+};
+
+// ------------------------------------------------------------------ conf
+bool JobConf::hasKey(const std::string& key) const {
+  return items.count(key) != 0;
+}
+const std::string& JobConf::get(const std::string& key) const {
+  static const std::string empty;
+  std::map<std::string, std::string>::const_iterator it = items.find(key);
+  return it == items.end() ? empty : it->second;
+}
+int JobConf::getInt(const std::string& key, int def) const {
+  return hasKey(key) ? atoi(get(key).c_str()) : def;
+}
+float JobConf::getFloat(const std::string& key, float def) const {
+  return hasKey(key) ? float(atof(get(key).c_str())) : def;
+}
+bool JobConf::getBoolean(const std::string& key, bool def) const {
+  if (!hasKey(key)) return def;
+  const std::string& v = get(key);
+  return v == "true" || v == "True" || v == "1";
+}
+
+// ------------------------------------------------------------------ loop
+class TaskRunner : public TaskContext {
+ public:
+  TaskRunner(const Factory& factory, SocketStream& io)
+      : factory_(factory), io_(io), nextCounter_(0),
+        havePendingKey_(false), closed_(false) {}
+
+  int run() {
+    std::unique_ptr<Mapper> mapper;
+    std::unique_ptr<Reducer> reducer;
+    for (;;) {
+      uint64_t code = io_.readVarint();
+      if (code == START) {
+        if (io_.readVarint() != PROTOCOL_VERSION)
+          throw std::runtime_error("protocol version mismatch");
+      } else if (code == SET_JOB_CONF) {
+        uint64_t n = io_.readVarint();
+        for (uint64_t i = 0; i < n; i++) {
+          std::string k = io_.readBytes();
+          conf_.items[k] = io_.readBytes();
+        }
+      } else if (code == SET_INPUT_TYPES) {
+        io_.readBytes();
+        io_.readBytes();
+      } else if (code == RUN_MAP) {
+        split_ = io_.readBytes();
+        io_.readVarint();  // num reduces
+        io_.readVarint();  // piped input
+        mapper.reset(factory_.createMapper(*this));
+      } else if (code == MAP_ITEM) {
+        key_ = io_.readBytes();
+        value_ = io_.readBytes();
+        mapper->map(*this);
+      } else if (code == RUN_REDUCE) {
+        io_.readVarint();  // partition
+        io_.readVarint();  // piped output
+        reducer.reset(factory_.createReducer(*this));
+      } else if (code == REDUCE_KEY) {
+        pendingKey_ = io_.readBytes();
+        havePendingKey_ = true;
+        while (havePendingKey_ && !closed_) {
+          key_ = pendingKey_;
+          havePendingKey_ = false;
+          reducer->reduce(*this);
+          while (nextValue()) {}  // drain unconsumed values
+        }
+        if (closed_) break;
+      } else if (code == CLOSE) {
+        break;
+      } else if (code == ABORT) {
+        return 1;
+      } else {
+        throw std::runtime_error("unknown downward opcode");
+      }
+    }
+    if (mapper.get()) mapper->close();
+    if (reducer.get()) reducer->close();
+    io_.writeVarint(DONE);
+    io_.flush();
+    return 0;
+  }
+
+  void authenticate(const std::string& secret) {
+    if (io_.readVarint() != AUTHENTICATION_REQ)
+      throw std::runtime_error("expected auth request");
+    std::string digest = io_.readBytes();
+    std::string challenge = io_.readBytes();
+    if (digest != hmacSha1Hex(secret, "CLIENT-AUTH"))
+      throw std::runtime_error("framework failed authentication");
+    io_.writeVarint(AUTHENTICATION_RESP);
+    io_.writeBytes(hmacSha1Hex(secret, challenge));
+    io_.flush();
+  }
+
+  // -------------------------------------------------- TaskContext
+  const JobConf* getJobConf() { return &conf_; }
+  const std::string& getInputKey() { return key_; }
+  const std::string& getInputValue() { return value_; }
+  const std::string& getInputSplit() { return split_; }
+  void emit(const std::string& key, const std::string& value) {
+    io_.writeVarint(OUTPUT);
+    io_.writeBytes(key);
+    io_.writeBytes(value);
+    io_.maybeFlush();
+  }
+  void partitionedEmit(int partition, const std::string& key,
+                       const std::string& value) {
+    io_.writeVarint(PARTITIONED_OUTPUT);
+    io_.writeVarint(uint64_t(partition));
+    io_.writeBytes(key);
+    io_.writeBytes(value);
+    io_.maybeFlush();
+  }
+  void progress(double value) {
+    io_.writeVarint(PROGRESS);
+    io_.writeDouble(value);
+    io_.flush();
+  }
+  void setStatus(const std::string& status) {
+    io_.writeVarint(STATUS);
+    io_.writeBytes(status);
+    io_.flush();
+  }
+  int getCounter(const std::string& group, const std::string& name) {
+    int id = nextCounter_++;
+    io_.writeVarint(REGISTER_COUNTER);
+    io_.writeVarint(uint64_t(id));
+    io_.writeBytes(group);
+    io_.writeBytes(name);
+    return id;
+  }
+  void incrementCounter(int counterId, uint64_t amount) {
+    io_.writeVarint(INCREMENT_COUNTER);
+    io_.writeVarint(uint64_t(counterId));
+    io_.writeVarint(amount);
+    io_.maybeFlush();
+  }
+  bool nextValue() {
+    if (havePendingKey_ || closed_) return false;
+    uint64_t code = io_.readVarint();
+    if (code == REDUCE_VALUE) {
+      value_ = io_.readBytes();
+      return true;
+    }
+    if (code == REDUCE_KEY) {
+      pendingKey_ = io_.readBytes();
+      havePendingKey_ = true;
+      return false;
+    }
+    if (code == CLOSE) {
+      closed_ = true;
+      return false;
+    }
+    throw std::runtime_error("unexpected opcode inside reduce");
+  }
+
+ private:
+  const Factory& factory_;
+  SocketStream& io_;
+  JobConf conf_;
+  std::string key_, value_, split_, pendingKey_;
+  int nextCounter_;
+  bool havePendingKey_, closed_;
+};
+
+static std::string hexDecode(const std::string& hex) {
+  std::string out;
+  for (size_t i = 0; i + 1 < hex.size(); i += 2) {
+    char buf[3] = {hex[i], hex[i + 1], 0};
+    out.push_back(static_cast<char>(strtol(buf, NULL, 16)));
+  }
+  return out;
+}
+
+int runTask(const Factory& factory) {
+  const char* portEnv = getenv("TPUMR_PIPES_COMMAND_PORT");
+  const char* secretEnv = getenv("TPUMR_PIPES_SHARED_SECRET");
+  if (!portEnv || !secretEnv) {
+    fprintf(stderr, "tpumr-pipes: missing TPUMR_PIPES_COMMAND_PORT / "
+                    "TPUMR_PIPES_SHARED_SECRET\n");
+    return 2;
+  }
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); return 2; }
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(atoi(portEnv)));
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) < 0) {
+    perror("connect");
+    close(fd);
+    return 2;
+  }
+  int rc = 1;
+  try {
+    SocketStream io(fd);
+    TaskRunner runner(factory, io);
+    runner.authenticate(hexDecode(secretEnv));
+    rc = runner.run();
+  } catch (const std::exception& e) {
+    fprintf(stderr, "tpumr-pipes: %s\n", e.what());
+    rc = 1;
+  }
+  close(fd);
+  return rc;
+}
+
+}  // namespace pipes
+}  // namespace tpumr

@@ -1,0 +1,77 @@
+// tpumr pipes — C++ child-side user API.
+//
+// ≈ the reference C++ pipes API (src/c++/pipes/api/hadoop/Pipes.hh:46-247:
+// JobConf / TaskContext / Mapper / Reducer / Factory / runTask). A pipes
+// executable links this library, defines a Factory, and calls
+// tpumr::pipes::runTask(factory). The framework (tpumr.pipes.application)
+// launches the binary and speaks the framed varint protocol over a loopback
+// socket; an accelerator task receives its device id as argv[1]
+// (≈ Application.java:178-181).
+#ifndef TPUMR_PIPES_HH
+#define TPUMR_PIPES_HH
+
+#include <cstdint>
+#include <map>
+#include <string>
+
+namespace tpumr {
+namespace pipes {
+
+class JobConf {
+ public:
+  bool hasKey(const std::string& key) const;
+  const std::string& get(const std::string& key) const;
+  int getInt(const std::string& key, int def = 0) const;
+  float getFloat(const std::string& key, float def = 0.0f) const;
+  bool getBoolean(const std::string& key, bool def = false) const;
+  std::map<std::string, std::string> items;
+};
+
+class TaskContext {
+ public:
+  virtual ~TaskContext() {}
+  virtual const JobConf* getJobConf() = 0;
+  virtual const std::string& getInputKey() = 0;
+  virtual const std::string& getInputValue() = 0;
+  virtual const std::string& getInputSplit() = 0;
+  virtual void emit(const std::string& key, const std::string& value) = 0;
+  virtual void partitionedEmit(int partition, const std::string& key,
+                               const std::string& value) = 0;
+  virtual void progress(double value) = 0;
+  virtual void setStatus(const std::string& status) = 0;
+  virtual int getCounter(const std::string& group,
+                         const std::string& name) = 0;
+  virtual void incrementCounter(int counterId, uint64_t amount) = 0;
+  // reduce side: advance the value cursor; false at end of key group
+  virtual bool nextValue() = 0;
+};
+
+class Mapper {
+ public:
+  virtual ~Mapper() {}
+  virtual void map(TaskContext& context) = 0;
+  virtual void close() {}
+};
+
+class Reducer {
+ public:
+  virtual ~Reducer() {}
+  // called once per key group; iterate values with context.nextValue()
+  virtual void reduce(TaskContext& context) = 0;
+  virtual void close() {}
+};
+
+class Factory {
+ public:
+  virtual ~Factory() {}
+  virtual Mapper* createMapper(TaskContext& context) const = 0;
+  virtual Reducer* createReducer(TaskContext& context) const = 0;
+};
+
+// Child entry point (≈ HadoopPipes::runTask). Returns the process exit code.
+int runTask(const Factory& factory);
+
+}  // namespace pipes
+}  // namespace tpumr
+
+#endif  // TPUMR_PIPES_HH

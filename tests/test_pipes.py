@@ -1,0 +1,210 @@
+"""Pipes tier tests ≈ src/test/org/apache/hadoop/mapred/pipes/TestPipes.java:
+external executables (Python and C++) speaking the binary protocol, dual
+CPU/TPU executable selection, counters/partitioned output over the uplink."""
+
+import io
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tpumr.fs import get_filesystem
+from tpumr.mapred.jobconf import JobConf
+from tpumr.pipes import Submitter
+from tpumr.pipes import protocol as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_script(path: str, body: str) -> str:
+    with open(path, "w") as f:
+        f.write(f"#!{sys.executable}\nimport sys\n"
+                f"sys.path.insert(0, {REPO!r})\n" + textwrap.dedent(body))
+    os.chmod(path, 0o755)
+    return path
+
+
+WORDCOUNT = """
+    from tpumr.pipes import child
+
+    class M(child.Mapper):
+        def __init__(self, ctx):
+            self.words = ctx.get_counter("WordCount", "INPUT_WORDS")
+            self.ctx = ctx
+
+        def map(self, ctx):
+            toks = ctx.input_value.split()
+            for w in toks:
+                ctx.emit(w, b"1")
+            ctx.increment_counter(self.words, len(toks))
+
+    class R(child.Reducer):
+        def reduce(self, ctx):
+            total = 0
+            while ctx.next_value():
+                total += int(ctx.input_value)
+            ctx.emit(ctx.input_key, str(total))
+
+    class F(child.Factory):
+        def create_mapper(self, ctx):
+            return M(ctx)
+
+        def create_reducer(self, ctx):
+            return R()
+
+    raise SystemExit(child.run_task(F()))
+"""
+
+DEVICE_PROBE = """
+    from tpumr.pipes import child
+
+    device = sys.argv[1] if len(sys.argv) > 1 else "none"
+
+    class M(child.Mapper):
+        def map(self, ctx):
+            ctx.emit(ctx.input_value, "dev=" + device)
+
+    class R(child.Reducer):
+        def reduce(self, ctx):
+            while ctx.next_value():
+                ctx.emit(ctx.input_key, ctx.input_value)
+
+    class F(child.Factory):
+        def create_mapper(self, ctx):
+            return M()
+
+        def create_reducer(self, ctx):
+            return R()
+
+    raise SystemExit(child.run_task(F()))
+"""
+
+
+def _read_output(fs, out_dir):
+    merged = {}
+    for st in fs.list_files(out_dir):
+        if st.path.name.startswith("part-"):
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                k, _, v = line.partition("\t")
+                merged[k] = v
+    return merged
+
+
+def test_varint_roundtrip():
+    buf = io.BytesIO()
+    for n in (0, 1, 127, 128, 300, 2**21, 2**40):
+        P.write_varint(buf, n)
+    buf.seek(0)
+    for n in (0, 1, 127, 128, 300, 2**21, 2**40):
+        assert P.read_varint(buf) == n
+
+
+def test_pipes_wordcount_python_child(tmp_path):
+    prog = _write_script(str(tmp_path / "wc.py"), WORDCOUNT)
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/pipes/in.txt", b"a b a\nc b a\n" * 30)
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///pipes/in.txt")
+    conf.set_output_path("mem:///pipes/out")
+    conf.set_num_reduce_tasks(1)
+    conf.set("tpumr.cache.dir", str(tmp_path / "cache"))
+    Submitter.set_executable(conf, prog)
+    result = Submitter.run_job(conf)
+    assert result.successful
+    out = _read_output(fs, "mem:///pipes/out")
+    assert out == {"a": "90", "b": "60", "c": "30"}
+    # child counters reached the framework (REGISTER/INCREMENT_COUNTER)
+    assert result.counters.value("WordCount", "INPUT_WORDS") == 180
+
+
+def test_pipes_dual_executable_tpu_selection(tmp_path):
+    """run_on_tpu picks cache slot 1 and passes the device id as argv[1]
+    (Application.java:162-181 semantics)."""
+    cpu = _write_script(str(tmp_path / "cpu.py"), DEVICE_PROBE)
+    tpu = _write_script(str(tmp_path / "tpu.py"), DEVICE_PROBE)
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/dual/in.txt", b"r1\nr2\n")
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///dual/in.txt")
+    conf.set_output_path("mem:///dual/out")
+    conf.set_num_reduce_tasks(1)
+    conf.set("tpumr.cache.dir", str(tmp_path / "cache"))
+    conf.set("tpumr.local.run.on.tpu", True)
+    Submitter.set_executable(conf, cpu)
+    Submitter.set_tpu_executable(conf, tpu)
+    result = Submitter.run_job(conf)
+    assert result.successful
+    out = _read_output(fs, "mem:///dual/out")
+    # device id 0 (the local runner's TPU slot) arrived as argv[1]
+    assert out == {"r1": "dev=0", "r2": "dev=0"}
+
+
+def test_pipes_distributed_hybrid(tmp_path):
+    """Dual-executable pipes job on a real mini-cluster: the TPU pipes
+    executable makes the job accelerator-eligible (the
+    hadoop.pipes.gpu.executable gate) and TPU attempts run slot-1 binaries
+    with device ids."""
+    from tpumr.mapred.job_client import JobClient
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+
+    cpu = _write_script(str(tmp_path / "cpu.py"), DEVICE_PROBE)
+    tpu = _write_script(str(tmp_path / "tpu.py"), DEVICE_PROBE)
+    fs = get_filesystem("mem:///")
+    data = "".join(f"rec{i:03d}\n" for i in range(12)).encode()
+    fs.write_bytes("/dh/in.txt", data)
+
+    with MiniMRCluster(num_trackers=1, cpu_slots=1, tpu_slots=1) as cluster:
+        conf = cluster.create_job_conf()
+        conf.set_input_paths("mem:///dh/in.txt")
+        conf.set_output_path("mem:///dh/out")
+        conf.set_num_reduce_tasks(1)
+        conf.set("mapred.map.tasks", 6)
+        conf.set("mapred.min.split.size", 1)
+        from tpumr.pipes.submitter import setup_pipes_job
+        Submitter.set_executable(conf, cpu)
+        Submitter.set_tpu_executable(conf, tpu)
+        setup_pipes_job(conf)
+        client = JobClient(conf)
+        running = client.submit_job(conf)
+        st = running.wait_for_completion(timeout=120)
+        assert st["state"] == "SUCCEEDED", st
+        assert st["finished_tpu_maps"] > 0, st
+        out = _read_output(fs, "mem:///dh/out")
+        assert len(out) == 12
+        assert any(v.startswith("dev=") and v != "dev=none"
+                   for v in out.values())
+
+
+@pytest.fixture(scope="module")
+def cpp_wordcount():
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    native = os.path.join(REPO, "native", "pipes")
+    build = subprocess.run(["make", "-C", native], capture_output=True,
+                           text=True)
+    if build.returncode != 0:
+        pytest.fail(f"native pipes build failed:\n{build.stderr}")
+    return os.path.join(native, "build", "wordcount")
+
+
+def test_pipes_wordcount_cpp_child(cpp_wordcount, tmp_path):
+    """The C++ child runtime end-to-end (≈ TestPipes with the C++ demos)."""
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/cpp/in.txt", b"tpu mxu tpu\nici mxu tpu\n" * 10)
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///cpp/in.txt")
+    conf.set_output_path("mem:///cpp/out")
+    conf.set_num_reduce_tasks(1)
+    conf.set("tpumr.cache.dir", str(tmp_path / "cache"))
+    Submitter.set_executable(conf, cpp_wordcount)
+    result = Submitter.run_job(conf)
+    assert result.successful
+    out = _read_output(fs, "mem:///cpp/out")
+    assert out == {"tpu": "30", "mxu": "20", "ici": "10"}
+    assert result.counters.value("WordCount", "INPUT_WORDS") == 60

@@ -1,0 +1,117 @@
+"""DistributedCache — per-job file localization with ref-counting.
+
+≈ ``org.apache.hadoop.filecache.{DistributedCache,
+TrackerDistributedCacheManager}`` (reference: src/mapred/org/apache/hadoop/
+mapred/filecache/, ~2k LoC). The contract that matters to the pipes tier is
+the *ordered* cache-file list: the dual-executable submission puts the CPU
+binary at index 0 and the accelerator binary at index 1
+(Submitter.java:349-379), and the Application picks
+``localCacheFiles[runOnGPU ? 1 : 0]`` (Application.java:162-172). That
+ordering is preserved bit-for-bit here (TPU instead of GPU).
+
+Re-design notes: localization is content-addressed (sha256 of source path +
+mtime + size) into a shared cache root; per-job ref counts release entries
+when the job's working state is purged; executables keep their exec bit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import stat
+import threading
+from typing import Any
+
+#: conf key holding the ordered, comma-separated cache file list
+#: (≈ mapred.cache.files). Entries may carry a ``#linkname`` fragment.
+CACHE_FILES_KEY = "mapred.cache.files"
+#: entries marked executable (localized with the exec bit set)
+CACHE_EXECUTABLES_KEY = "tpumr.cache.executables"
+
+_lock = threading.Lock()
+#: (cache_root, digest) -> set of job ids holding a reference. Job-granular
+#: (not per-attempt): localization runs once per task attempt but a job
+#: holds exactly one reference, released when the tracker purges the job.
+_refs: dict[tuple[str, str], set[str]] = {}
+
+
+def add_cache_file(conf: Any, path: str, link: str | None = None,
+                   executable: bool = False) -> None:
+    """Append one file to the job's ordered cache list
+    (≈ DistributedCache.addCacheFile)."""
+    entry = f"{path}#{link}" if link else path
+    cur = conf.get(CACHE_FILES_KEY, "") or ""
+    conf.set(CACHE_FILES_KEY, f"{cur},{entry}" if cur else entry)
+    if executable:
+        ex = conf.get(CACHE_EXECUTABLES_KEY, "") or ""
+        conf.set(CACHE_EXECUTABLES_KEY, f"{ex},{entry}" if ex else entry)
+
+
+def get_cache_files(conf: Any) -> list[str]:
+    raw = conf.get(CACHE_FILES_KEY, "") or ""
+    return [e for e in raw.split(",") if e]
+
+
+def _split_entry(entry: str) -> tuple[str, str]:
+    if "#" in entry:
+        path, link = entry.rsplit("#", 1)
+    else:
+        path, link = entry, os.path.basename(entry)
+    return path, link
+
+
+def _digest(path: str) -> str:
+    st = os.stat(path)
+    h = hashlib.sha256(
+        f"{os.path.abspath(path)}|{st.st_mtime_ns}|{st.st_size}".encode())
+    return h.hexdigest()[:24]
+
+
+def get_local_cache_files(conf: Any, cache_root: str,
+                          job_id: str = "") -> list[str]:
+    """Localize the job's cache files (idempotent) and return their local
+    paths IN LIST ORDER — the ordering contract the pipes dual-executable
+    selection depends on (Application.java:162-172)."""
+    out: list[str] = []
+    executables = set(conf.get(CACHE_EXECUTABLES_KEY, "").split(","))
+    os.makedirs(cache_root, exist_ok=True)
+    for entry in get_cache_files(conf):
+        path, link = _split_entry(entry)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"cache file missing: {path}")
+        d = _digest(path)
+        local_dir = os.path.join(cache_root, d)
+        local = os.path.join(local_dir, link)
+        with _lock:
+            if not os.path.exists(local):
+                os.makedirs(local_dir, exist_ok=True)
+                tmp = local + ".tmp"
+                shutil.copy2(path, tmp)
+                os.replace(tmp, local)
+            if entry in executables:
+                os.chmod(local, os.stat(local).st_mode | stat.S_IXUSR
+                         | stat.S_IXGRP)
+            _refs.setdefault((cache_root, d), set()).add(job_id)
+        out.append(local)
+    return out
+
+
+def release_job(conf: Any, cache_root: str, job_id: str = "") -> None:
+    """Drop the job's references; entries with no remaining holders are
+    deleted (≈ TrackerDistributedCacheManager.releaseCache)."""
+    for entry in get_cache_files(conf):
+        path, _ = _split_entry(entry)
+        try:
+            d = _digest(path)
+        except OSError:
+            continue
+        with _lock:
+            key = (cache_root, d)
+            holders = _refs.get(key)
+            if holders is not None:
+                holders.discard(job_id)
+                if not holders:
+                    _refs.pop(key, None)
+                    shutil.rmtree(os.path.join(cache_root, d),
+                                  ignore_errors=True)

@@ -129,9 +129,10 @@ class JobInProgress:
 
     def has_kernel(self) -> bool:
         """≈ the hadoop.pipes.gpu.executable gate
-        (JobQueueTaskScheduler.java:342-347): only kernel-equipped jobs are
-        eligible for TPU slots."""
-        return bool(self.conf.get("tpumr.map.kernel"))
+        (JobQueueTaskScheduler.java:342-347): only jobs with a device kernel
+        OR a TPU pipes executable are eligible for TPU slots."""
+        return bool(self.conf.get("tpumr.map.kernel")
+                    or self.conf.get("tpumr.pipes.tpu.executable"))
 
     def cpu_map_mean_time(self) -> float:
         """Mean CPU map runtime (0.0 when no data — matching the reference's

@@ -74,7 +74,8 @@ class LocalJobRunner:
         committer.setup_job()
 
         run_on_tpu = (conf.get_boolean("tpumr.local.run.on.tpu", False)
-                      and conf.get_map_kernel() is not None)
+                      and (conf.get_map_kernel() is not None
+                           or bool(conf.get("tpumr.pipes.tpu.executable"))))
 
         # ---- map phase
         map_outputs: list[tuple[str, dict] | None] = [None] * len(splits)

@@ -219,7 +219,11 @@ class NodeRunner:
                     self.map_outputs = {k: v for k, v in
                                         self.map_outputs.items()
                                         if k[0] != job_id}
-                    self.job_confs.pop(job_id, None)
+                    jc = self.job_confs.pop(job_id, None)
+                if jc is not None:
+                    from tpumr.mapred import filecache
+                    filecache.release_job(
+                        jc, os.path.join(self.local_root, "cache"), job_id)
                 shutil.rmtree(os.path.join(self.local_root, job_id),
                               ignore_errors=True)
 
@@ -249,6 +253,9 @@ class NodeRunner:
             jc = JobConf()
             for k, v in conf_dict.items():
                 jc.set(k, v)
+            # tracker-local cache root for DistributedCache localization
+            jc.set("tpumr.cache.dir", os.path.join(self.local_root, "cache"))
+            jc.set("tpumr.job.id", job_id)
             with self.lock:
                 self.job_confs[job_id] = jc
         return jc

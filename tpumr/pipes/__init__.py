@@ -1,0 +1,18 @@
+"""External-process task tier ("pipes").
+
+≈ the reference's pipes mechanism (src/mapred/org/apache/hadoop/mapred/
+pipes/, 2210 LoC Java + src/c++/pipes, 1.7k C++): user-supplied binaries run
+map/reduce logic in a child process speaking a framed binary protocol over a
+loopback socket, with *dual* CPU/accelerator executables selected per task —
+the path the reference uses to reach CUDA, kept here as the
+bring-your-own-binary compatibility tier next to the in-process JAX/Pallas
+map runner (tpumr.mapred.tpu_runner), which is the TPU-native replacement.
+"""
+
+from tpumr.pipes.application import Application
+from tpumr.pipes.runner import (PipesMapRunner, PipesReducer,
+                                PipesTPUMapRunner)
+from tpumr.pipes.submitter import Submitter, setup_pipes_job
+
+__all__ = ["Application", "PipesMapRunner", "PipesTPUMapRunner",
+           "PipesReducer", "Submitter", "setup_pipes_job"]

@@ -1,0 +1,211 @@
+"""Application — spawn and talk to one pipes child process.
+
+≈ ``org.apache.hadoop.mapred.pipes.Application`` (reference: src/mapred/org/
+apache/hadoop/mapred/pipes/Application.java:108-215). Reproduced contracts:
+
+- executable selection from the ordered cache list:
+  ``localCacheFiles[runOnTPU ? 1 : 0]`` (Application.java:162-172);
+- the accelerator task appends its device id as ``argv[1]`` so the child can
+  bind the device (Application.java:178-181 — the CUDA child did
+  ``cudaSetDevice(argv[1])``; a TPU child pins its chip the same way);
+- server-socket handshake: framework listens, child connects back using the
+  port from its environment (≈ ``hadoop.pipes.command.port``), then mutual
+  HMAC challenge/response (Application.java:138-215,
+  BinaryProtocol.java:264-299);
+- an upward message pump feeding OutputCollector/Reporter, with
+  REGISTER_COUNTER / INCREMENT_COUNTER bridged to real counters
+  (OutputHandler role).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import socket
+import subprocess
+import threading
+from typing import Any
+
+from tpumr.pipes import protocol as P
+
+#: child environment variable names (≈ hadoop.pipes.command.port /
+#: hadoop.pipes.shared.secret, exported through TaskRunner's child env)
+ENV_PORT = "TPUMR_PIPES_COMMAND_PORT"
+ENV_SECRET = "TPUMR_PIPES_SHARED_SECRET"
+
+
+class PipesChildError(RuntimeError):
+    pass
+
+
+class Application:
+    """One pipes child process plus its protocol connection."""
+
+    def __init__(self, conf: Any, executable: str, output: Any,
+                 reporter: Any, run_on_tpu: bool = False,
+                 tpu_device_id: int = -1, keep_child_output: bool = True,
+                 connect_timeout: float = 30.0) -> None:
+        self.conf = conf
+        self.output = output
+        self.reporter = reporter
+        self.done = threading.Event()
+        self.child_error: str | None = None
+        self._counters: dict[int, tuple[str, str]] = {}
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        listener.settimeout(connect_timeout)
+        port = listener.getsockname()[1]
+
+        secret = secrets.token_bytes(16)
+        self._secret = secret
+        env = dict(os.environ)
+        env[ENV_PORT] = str(port)
+        env[ENV_SECRET] = secret.hex()
+
+        cmd = [executable]
+        if run_on_tpu:
+            # device id as argv[1] ≈ Application.java:178-181
+            cmd.append(str(tpu_device_id))
+        stderr = None if keep_child_output else subprocess.DEVNULL
+        try:
+            self.process = subprocess.Popen(
+                cmd, env=env, stdin=subprocess.DEVNULL, stderr=stderr)
+        except OSError as e:
+            listener.close()
+            raise PipesChildError(f"cannot exec {executable}: {e}") from e
+        try:
+            self.sock, _ = listener.accept()
+        except socket.timeout:
+            self.process.kill()
+            raise PipesChildError(
+                f"pipes child {executable} never connected back "
+                f"(rc={self.process.poll()})")
+        finally:
+            listener.close()
+
+        self._rfile = self.sock.makefile("rb")
+        self._wfile = self.sock.makefile("wb")
+        self.downlink = P.DownwardProtocol(self._wfile)
+        try:
+            self._authenticate()
+        except Exception:
+            self.cleanup(kill=True)
+            raise
+        self._pump = threading.Thread(target=self._uplink_loop,
+                                      name="pipes-uplink", daemon=True)
+        self._pump.start()
+
+    # ------------------------------------------------------------ handshake
+
+    def _authenticate(self) -> None:
+        """Mutual authentication: we prove knowledge of the secret by
+        digesting a fixed password message; the child proves it by digesting
+        our random challenge (≈ Application.java:138-215)."""
+        challenge = secrets.token_hex(10).encode("ascii")
+        digest = P.create_digest(self._secret, b"CLIENT-AUTH")
+        self.downlink.authenticate(digest, challenge)
+        code = P.read_varint(self._rfile)
+        if code != P.AUTHENTICATION_RESP:
+            raise PipesChildError(f"expected auth response, got code {code}")
+        resp = P.read_bytes(self._rfile)
+        expect = P.create_digest(self._secret, challenge)
+        if resp != expect:
+            raise PipesChildError("pipes child failed authentication")
+
+    # ------------------------------------------------------------ uplink
+
+    def _uplink_loop(self) -> None:
+        """≈ OutputHandler + BinaryProtocol.UplinkReaderThread."""
+        try:
+            while True:
+                code = P.read_varint(self._rfile)
+                if code == P.OUTPUT:
+                    k = P.read_bytes(self._rfile)
+                    v = P.read_bytes(self._rfile)
+                    self.output.collect(k, v)
+                elif code == P.PARTITIONED_OUTPUT:
+                    part = P.read_varint(self._rfile)
+                    k = P.read_bytes(self._rfile)
+                    v = P.read_bytes(self._rfile)
+                    self.output.partitioned_collect(part, k, v)
+                elif code == P.STATUS:
+                    self.reporter.set_status(P.read_str(self._rfile))
+                elif code == P.PROGRESS:
+                    self.reporter.progress(P.read_double(self._rfile))
+                elif code == P.REGISTER_COUNTER:
+                    cid = P.read_varint(self._rfile)
+                    group = P.read_str(self._rfile)
+                    name = P.read_str(self._rfile)
+                    self._counters[cid] = (group, name)
+                elif code == P.INCREMENT_COUNTER:
+                    cid = P.read_varint(self._rfile)
+                    amount = P.read_varint(self._rfile)
+                    group, name = self._counters.get(
+                        cid, ("Pipes", f"counter-{cid}"))
+                    self.reporter.incr_counter(group, name, amount)
+                elif code == P.DONE:
+                    self.done.set()
+                    return
+                else:
+                    raise PipesChildError(f"unknown upward code {code}")
+        except (EOFError, OSError) as e:
+            if not self.done.is_set():
+                self.child_error = f"pipes child died mid-task: {e}"
+                self.done.set()
+        except Exception as e:  # noqa: BLE001 — protocol or collector error:
+            # the pump must never die silently or wait_for_finish blocks
+            # until the task timeout with the real cause lost
+            if not self.done.is_set():
+                self.child_error = f"pipes uplink failed: " \
+                                   f"{type(e).__name__}: {e}"
+                self.done.set()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def wait_for_finish(self, timeout: float | None = None) -> None:
+        conf_timeout = None
+        if timeout is None and self.conf is not None:
+            ms = int(self.conf.get("mapred.task.timeout", 600_000) or 0)
+            conf_timeout = ms / 1000.0 if ms > 0 else None
+        if not self.done.wait(timeout if timeout is not None
+                              else conf_timeout):
+            self.abort()
+            raise PipesChildError("pipes child timed out")
+        if self.child_error:
+            self.cleanup(kill=True)
+            raise PipesChildError(self.child_error)
+        rc = self.process.wait(timeout=30)
+        if rc != 0:
+            raise PipesChildError(f"pipes child exited rc={rc}")
+
+    def abort(self) -> None:
+        try:
+            self.downlink.abort()
+        except OSError:
+            pass
+        self.cleanup(kill=True)
+
+    def cleanup(self, kill: bool = False) -> None:
+        if kill and self.process.poll() is None:
+            self.process.kill()
+        try:
+            self._rfile.close()
+            self._wfile.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def select_executable(conf: Any, cache_root: str, run_on_tpu: bool) -> str:
+    """The dual-executable pick: localized cache list index 1 for the
+    accelerator, 0 for CPU (Application.java:162-172). Falls back to slot 0
+    when the job shipped only one binary."""
+    from tpumr.mapred import filecache
+    files = filecache.get_local_cache_files(
+        conf, cache_root, job_id=str(conf.get("tpumr.job.id", "") or ""))
+    if not files:
+        raise PipesChildError("pipes job has no cached executables")
+    idx = 1 if run_on_tpu and len(files) > 1 else 0
+    return files[idx]

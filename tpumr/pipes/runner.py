@@ -1,0 +1,181 @@
+"""Pipes map runners + reducer — the framework side of external tasks.
+
+≈ ``PipesMapRunner`` / ``PipesGPUMapRunner`` / ``PipesReducer`` /
+``PipesPartitioner`` (reference: src/mapred/org/apache/hadoop/mapred/pipes/).
+``PipesTPUMapRunner`` is the accelerator twin selected when the task carries
+``run_on_tpu`` (≈ PipesGPUMapRunner.java:40-118, chosen at
+MapTask.java:433-438): it launches the job's *second* cached executable and
+hands it the task's device id — the TPU rename of the CUDA launch path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import zlib
+from typing import Any
+
+from tpumr.mapred.api import (MapRunnable, OutputCollector, Partitioner,
+                              Reducer, Reporter)
+from tpumr.pipes.application import Application, select_executable
+
+
+def encode(obj: Any) -> bytes:
+    """Framework value → child bytes: bytes pass through, everything else is
+    its UTF-8 text form (the child sees what a Text writable would carry)."""
+    if isinstance(obj, bytes):
+        return obj
+    return str(obj).encode("utf-8")
+
+
+def decode(data: bytes) -> Any:
+    """Child bytes → framework value: UTF-8 text when possible (so outputs
+    stay human-readable through TextOutputFormat), raw bytes otherwise."""
+    try:
+        return data.decode("utf-8")
+    except UnicodeDecodeError:
+        return data
+
+
+def _cache_root(conf: Any) -> str:
+    root = conf.get("tpumr.cache.dir")
+    if not root:
+        import os
+        import tempfile
+        root = os.path.join(tempfile.gettempdir(), "tpumr-cache")
+        conf.set("tpumr.cache.dir", root)
+    return root
+
+
+def _wire_conf_items(conf: Any) -> dict:
+    return {k: v for k, v in conf
+            if isinstance(v, (str, int, float, bool)) or v is None}
+
+
+class _ChildPartitionStash(threading.local):
+    value: int | None = None
+
+
+_stash = _ChildPartitionStash()
+
+
+class PipesPartitioner(Partitioner):
+    """≈ pipes/PipesPartitioner.java: when the child computed the partition
+    itself (PARTITIONED_OUTPUT), return that cached value; otherwise hash."""
+
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        part = _stash.value
+        if part is not None:
+            _stash.value = None
+            return part % num_partitions
+        return zlib.crc32(encode(key)) % num_partitions
+
+
+class _UplinkCollector:
+    """Bridges upward OUTPUT/PARTITIONED_OUTPUT into the task's collector
+    (≈ pipes/OutputHandler.java)."""
+
+    def __init__(self, output: OutputCollector) -> None:
+        self._output = output
+
+    def collect(self, kb: bytes, vb: bytes) -> None:
+        self._output.collect(decode(kb), decode(vb))
+
+    def partitioned_collect(self, part: int, kb: bytes, vb: bytes) -> None:
+        _stash.value = part
+        try:
+            self._output.collect(decode(kb), decode(vb))
+        finally:
+            _stash.value = None
+
+
+class PipesMapRunner(MapRunnable):
+    """Stream the split's records to the CPU child executable
+    (≈ pipes/PipesMapRunner.java)."""
+
+    RUN_ON_TPU = False
+
+    def __init__(self) -> None:
+        self.conf: Any = None
+
+    def configure(self, conf: Any) -> None:
+        self.conf = conf
+
+    def run(self, reader, output, reporter, task_ctx=None) -> None:
+        conf = self.conf
+        run_on_tpu = self.RUN_ON_TPU or bool(
+            getattr(task_ctx, "run_on_tpu", False))
+        device = getattr(task_ctx, "tpu_device_id", -1)
+        executable = select_executable(conf, _cache_root(conf), run_on_tpu)
+        num_reduces = int(conf.get("mapred.reduce.tasks", 1))
+        app = Application(conf, executable, _UplinkCollector(output),
+                          reporter, run_on_tpu=run_on_tpu,
+                          tpu_device_id=device)
+        try:
+            down = app.downlink
+            down.start()
+            down.set_job_conf(_wire_conf_items(conf))
+            split = getattr(task_ctx, "split", None) or {}
+            down.run_map(json.dumps(split).encode("utf-8"), num_reduces,
+                         piped_input=True)
+            # per-record downlink hot loop ≈ PipesMapRunner.java:97-107 —
+            # kept for compatibility; the TPU-native path avoids it entirely
+            # by running the map as a kernel in-process (tpu_runner)
+            for key, value in reader:
+                down.map_item(encode(key), encode(value))
+            down.close()
+            app.wait_for_finish()
+        except Exception:
+            app.cleanup(kill=True)
+            raise
+        finally:
+            app.cleanup()
+
+
+class PipesTPUMapRunner(PipesMapRunner):
+    """The accelerator-side runner (≈ PipesGPUMapRunner.java:40-118): same
+    record loop, but the child is the job's TPU executable launched with its
+    assigned device id as argv[1] (Application.java:162-181)."""
+
+    RUN_ON_TPU = True
+
+
+class PipesReducer(Reducer):
+    """≈ pipes/PipesReducer.java: lazily starts the child on the first key,
+    then streams REDUCE_KEY/REDUCE_VALUE frames; DONE/commit on close."""
+
+    def __init__(self) -> None:
+        self.conf: Any = None
+        self._app: Application | None = None
+
+    def configure(self, conf: Any) -> None:
+        self.conf = conf
+
+    def _ensure_app(self, output: OutputCollector,
+                    reporter: Reporter) -> Application:
+        if self._app is None:
+            executable = select_executable(self.conf,
+                                           _cache_root(self.conf), False)
+            self._app = Application(self.conf, executable,
+                                    _UplinkCollector(output), reporter)
+            down = self._app.downlink
+            down.start()
+            down.set_job_conf(_wire_conf_items(self.conf))
+            down.run_reduce(0, piped_output=True)
+        return self._app
+
+    def reduce(self, key, values, output, reporter) -> None:
+        app = self._ensure_app(output, reporter)
+        app.downlink.reduce_key(encode(key))
+        for v in values:
+            app.downlink.reduce_value(encode(v))
+
+    def close(self) -> None:
+        if self._app is None:
+            return
+        try:
+            self._app.downlink.close()
+            self._app.wait_for_finish()
+        finally:
+            self._app.cleanup()
+            self._app = None

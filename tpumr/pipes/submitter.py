@@ -1,0 +1,110 @@
+"""Pipes job submission — dual CPU/TPU executables.
+
+≈ ``org.apache.hadoop.mapred.pipes.Submitter`` (reference: src/mapred/org/
+apache/hadoop/mapred/pipes/Submitter.java). Reproduced contracts:
+
+- conf keys ``tpumr.pipes.executable`` / ``tpumr.pipes.tpu.executable``
+  (≈ ``hadoop.pipes.executable`` :104 / ``hadoop.pipes.gpu.executable``
+  :110-119 — the key the hybrid scheduler gates accelerator eligibility on,
+  JobQueueTaskScheduler.java:342-347);
+- cache layout: CPU binary at slot 0, TPU binary at slot 1
+  (setupPipesJob, Submitter.java:349-379);
+- CLI: ``-program`` / ``-tpubin`` (≈ ``-gpubin`` :527-528) / ``-input`` /
+  ``-output`` / ``-reduces`` / ``-jobconf``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from tpumr.mapred import filecache
+from tpumr.mapred.jobconf import JobConf
+
+EXECUTABLE_KEY = "tpumr.pipes.executable"
+TPU_EXECUTABLE_KEY = "tpumr.pipes.tpu.executable"
+
+
+class Submitter:
+    @staticmethod
+    def set_executable(conf: Any, path: str) -> None:
+        conf.set(EXECUTABLE_KEY, path)
+
+    @staticmethod
+    def get_executable(conf: Any) -> str | None:
+        return conf.get(EXECUTABLE_KEY)
+
+    @staticmethod
+    def set_tpu_executable(conf: Any, path: str) -> None:
+        """≈ Submitter.setGPUExecutable (Submitter.java:110-119)."""
+        conf.set(TPU_EXECUTABLE_KEY, path)
+
+    @staticmethod
+    def get_tpu_executable(conf: Any) -> str | None:
+        return conf.get(TPU_EXECUTABLE_KEY)
+
+    @staticmethod
+    def run_job(conf: JobConf):
+        setup_pipes_job(conf)
+        from tpumr.mapred.job_client import JobClient
+        return JobClient(conf).run_job(conf)
+
+
+def setup_pipes_job(conf: JobConf) -> None:
+    """Wire runners + cache the executables in slot order
+    (≈ Submitter.setupPipesJob, Submitter.java:291-380)."""
+    from tpumr.pipes.runner import (PipesMapRunner, PipesPartitioner,
+                                    PipesReducer, PipesTPUMapRunner)
+    cpu_bin = Submitter.get_executable(conf)
+    tpu_bin = Submitter.get_tpu_executable(conf)
+    if not cpu_bin:
+        raise ValueError(f"pipes job needs {EXECUTABLE_KEY}")
+    if not os.path.exists(cpu_bin):
+        raise FileNotFoundError(cpu_bin)
+
+    conf.set_map_runner_class(PipesMapRunner)
+    conf.set_tpu_map_runner_class(PipesTPUMapRunner)
+    if conf.get_reducer_class() is None and conf.num_reduce_tasks > 0:
+        conf.set_reducer_class(PipesReducer)
+    if conf.get("mapred.partitioner.class") is None:
+        conf.set_partitioner_class(PipesPartitioner)
+
+    # ordered cache: CPU at 0, TPU at 1 (Submitter.java:349-379)
+    if not conf.get(filecache.CACHE_FILES_KEY):
+        filecache.add_cache_file(conf, cpu_bin, link="pipes-cpu-bin",
+                                 executable=True)
+        if tpu_bin:
+            if not os.path.exists(tpu_bin):
+                raise FileNotFoundError(tpu_bin)
+            filecache.add_cache_file(conf, tpu_bin, link="pipes-tpu-bin",
+                                     executable=True)
+
+
+def main(argv: list[str]) -> int:
+    """CLI ≈ Submitter.main (Submitter.java:420-540)."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="tpumr pipes")
+    ap.add_argument("-input", dest="input", required=True)
+    ap.add_argument("-output", dest="output", required=True)
+    ap.add_argument("-program", dest="program", required=True,
+                    help="CPU executable")
+    ap.add_argument("-tpubin", dest="tpubin", default=None,
+                    help="TPU executable (≈ -gpubin)")
+    ap.add_argument("-reduces", dest="reduces", type=int, default=1)
+    ap.add_argument("-jobconf", dest="jobconf", action="append", default=[],
+                    help="k=v[,k=v...]")
+    args = ap.parse_args(argv)
+
+    conf = JobConf()
+    conf.set_input_paths(*args.input.split(","))
+    conf.set_output_path(args.output)
+    conf.set_num_reduce_tasks(args.reduces)
+    Submitter.set_executable(conf, args.program)
+    if args.tpubin:
+        Submitter.set_tpu_executable(conf, args.tpubin)
+    for chunk in args.jobconf:
+        for kv in chunk.split(","):
+            k, _, v = kv.partition("=")
+            conf.set(k.strip(), v.strip())
+    result = Submitter.run_job(conf)
+    return 0 if result.successful else 1
