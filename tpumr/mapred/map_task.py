@@ -39,8 +39,12 @@ class MapOutputBuffer:
         self.reporter = reporter
         self.partitioner = new_instance(conf.get_partitioner_class(), conf)
         self.comparator = conf.get_output_key_comparator()
-        comb_cls = conf.get_combiner_class()
-        self.combiner = new_instance(comb_cls, conf) if comb_cls else None
+        # combiner is instantiated per spill and closed after each combine
+        # round (Hadoop semantics: CombinerRunner creates it per use) — this
+        # also lets subprocess-backed combiners (StreamCombiner) finish their
+        # child deterministically
+        self.combiner_cls = conf.get_combiner_class()
+        self.combiner = self.combiner_cls  # truthiness gate for callers
         self.codec = conf.compress_map_output
         self._buf: list[tuple[int, bytes, bytes]] = []
         self._bytes = 0
@@ -121,18 +125,22 @@ class MapOutputBuffer:
         out: list[tuple[bytes, bytes]] = []
         collector = OutputCollector(
             lambda k, v: out.append((serialize(k), serialize(v))))
+        combiner = new_instance(self.combiner_cls, self.conf)
         i = 0
         sk = self.comparator.sort_key
         n_in = len(records)
-        while i < n_in:
-            j = i
-            key_sk = sk(records[i][0])
-            while j < n_in and sk(records[j][0]) == key_sk:
-                j += 1
-            key = deserialize(records[i][0])
-            values = (deserialize(records[t][1]) for t in range(i, j))
-            self.combiner.reduce(key, values, collector, self.reporter)
-            i = j
+        try:
+            while i < n_in:
+                j = i
+                key_sk = sk(records[i][0])
+                while j < n_in and sk(records[j][0]) == key_sk:
+                    j += 1
+                key = deserialize(records[i][0])
+                values = (deserialize(records[t][1]) for t in range(i, j))
+                combiner.reduce(key, values, collector, self.reporter)
+                i = j
+        finally:
+            combiner.close()
         self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                                    TaskCounter.COMBINE_INPUT_RECORDS, n_in)
         self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,

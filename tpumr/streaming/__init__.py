@@ -1,0 +1,15 @@
+"""Streaming tier: script mappers/reducers over stdin/stdout.
+
+≈ the reference's contrib streaming (src/contrib/streaming/.../
+PipeMapRed.java:50 and friends): any executable that reads
+tab-separated key/value lines on stdin and writes them on stdout can be a
+mapper or reducer. The stderr side-channel (``reporter:counter:...`` /
+``reporter:status:...``) is carried over unchanged.
+"""
+
+from tpumr.streaming.pipe_runner import (StreamCombiner, StreamMapRunner,
+                                         StreamReducer)
+from tpumr.streaming.stream_job import StreamJob, setup_stream_job
+
+__all__ = ["StreamMapRunner", "StreamReducer", "StreamCombiner",
+           "StreamJob", "setup_stream_job"]

@@ -1,0 +1,78 @@
+"""Streaming job builder + CLI.
+
+≈ ``org.apache.hadoop.streaming.StreamJob`` (reference: src/contrib/
+streaming/src/java/org/apache/hadoop/streaming/StreamJob.java): translate
+``-mapper/-reducer/-combiner/-input/-output/-file`` options into a job conf
+wired to the subprocess runners.
+"""
+
+from __future__ import annotations
+
+from tpumr.mapred.jobconf import JobConf
+
+
+def setup_stream_job(conf: JobConf, mapper: str | None = None,
+                     reducer: str | None = None,
+                     combiner: str | None = None) -> None:
+    from tpumr.streaming.pipe_runner import (StreamCombiner, StreamMapRunner,
+                                             StreamReducer)
+    if mapper:
+        conf.set("stream.map.command", mapper)
+        conf.set_map_runner_class(StreamMapRunner)
+    if reducer:
+        conf.set("stream.reduce.command", reducer)
+        conf.set_reducer_class(StreamReducer)
+    if combiner:
+        conf.set("stream.combine.command", combiner)
+        conf.set_combiner_class(StreamCombiner)
+
+
+class StreamJob:
+    """Programmatic builder ≈ StreamJob.createJob."""
+
+    def __init__(self) -> None:
+        self.conf = JobConf()
+
+    def set_mapper(self, cmd: str) -> "StreamJob":
+        setup_stream_job(self.conf, mapper=cmd)
+        return self
+
+    def set_reducer(self, cmd: str) -> "StreamJob":
+        setup_stream_job(self.conf, reducer=cmd)
+        return self
+
+    def set_combiner(self, cmd: str) -> "StreamJob":
+        setup_stream_job(self.conf, combiner=cmd)
+        return self
+
+    def run(self):
+        from tpumr.mapred.job_client import JobClient
+        return JobClient(self.conf).run_job(self.conf)
+
+
+def main(argv: list[str]) -> int:
+    """CLI ≈ bin/hadoop jar hadoop-streaming.jar …"""
+    import argparse
+    ap = argparse.ArgumentParser(prog="tpumr streaming")
+    ap.add_argument("-input", dest="input", required=True, action="append")
+    ap.add_argument("-output", dest="output", required=True)
+    ap.add_argument("-mapper", dest="mapper", default=None)
+    ap.add_argument("-reducer", dest="reducer", default=None)
+    ap.add_argument("-combiner", dest="combiner", default=None)
+    ap.add_argument("-numReduceTasks", dest="reduces", type=int, default=1)
+    ap.add_argument("-jobconf", "-D", dest="jobconf", action="append",
+                    default=[])
+    args = ap.parse_args(argv)
+
+    conf = JobConf()
+    conf.set_input_paths(*args.input)
+    conf.set_output_path(args.output)
+    conf.set_num_reduce_tasks(args.reduces)
+    for kv in args.jobconf:
+        k, _, v = kv.partition("=")
+        conf.set(k.strip(), v.strip())
+    setup_stream_job(conf, mapper=args.mapper, reducer=args.reducer,
+                     combiner=args.combiner)
+    from tpumr.mapred.job_client import JobClient
+    result = JobClient(conf).run_job(conf)
+    return 0 if result.successful else 1
