@@ -1,0 +1,26 @@
+"""tdfs — the replicated block store (DFS).
+
+≈ the reference's HDFS layer (src/hdfs/org/apache/hadoop/hdfs/, 53k LoC Java
+— SURVEY.md §2.3), re-designed small: a NameNode (namespace + block map +
+leases + replication monitor + safemode, journaled by an edit log with
+image checkpoints), DataNodes (checksummed block files, heartbeats, block
+reports, pipelined writes), a DFSClient (write pipeline with failover,
+replica-failover reads), a FileSystem SPI binding (scheme ``tdfs://``), a
+Balancer, and a MiniDFSCluster test harness.
+
+Design notes vs the reference: block transfer rides the framework RPC codec
+(one hop per pipeline stage) instead of a bespoke streaming protocol;
+metadata ops journal JSON lines instead of binary FSEditLog records. The
+*contracts* — single-writer leases, write pipeline, block reports rebuilding
+locations, safemode until block threshold, re-replication on DataNode death,
+checkpoint = image + replayed edits — are the reference's.
+"""
+
+from tpumr.dfs.client import DFSClient
+from tpumr.dfs.datanode import DataNode
+from tpumr.dfs.namenode import NameNode
+from tpumr.dfs.dfs_filesystem import DistributedFileSystem
+from tpumr.dfs.mini_cluster import MiniDFSCluster
+
+__all__ = ["DFSClient", "DataNode", "NameNode", "DistributedFileSystem",
+           "MiniDFSCluster"]

@@ -1,0 +1,248 @@
+"""DFSClient — write pipeline + replica-failover reads + lease renewal.
+
+≈ ``org.apache.hadoop.hdfs.DFSClient`` (reference: hdfs/DFSClient.java,
+3958 LoC). Contracts reproduced:
+
+- writes buffer client-side and ship full blocks down a DataNode pipeline;
+  a failed pipeline abandons the block, re-requests targets excluding the
+  bad node, and retries (DFSOutputStream.processDatanodeError);
+- reads fetch the block map once, then fail over across replicas on
+  IOError/checksum mismatch (DFSInputStream.chooseDataNode + seekToNewSource);
+- a background thread renews the client lease while files are open for
+  write (LeaseRenewer).
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import uuid
+from typing import Any
+
+from tpumr.ipc.rpc import RpcClient, RpcError
+
+
+class DFSClient:
+    def __init__(self, host: str, port: int, conf: Any = None) -> None:
+        self.conf = conf
+        self.nn = RpcClient(host, int(port))
+        self.name = f"TDFSClient_{uuid.uuid4().hex[:12]}"
+        self._dn_clients: dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+        self._open_writes = 0
+        self._renewer: threading.Thread | None = None
+        self._stop_renew = threading.Event()
+
+    # ------------------------------------------------------------ dn plumbing
+
+    def _dn(self, addr: str) -> RpcClient:
+        with self._lock:
+            cli = self._dn_clients.get(addr)
+            if cli is None:
+                host, port = addr.rsplit(":", 1)
+                cli = self._dn_clients[addr] = RpcClient(host, int(port))
+            return cli
+
+    # ------------------------------------------------------------ lease
+
+    def _writer_opened(self) -> None:
+        with self._lock:
+            self._open_writes += 1
+            if self._renewer is None:
+                self._stop_renew.clear()
+                self._renewer = threading.Thread(
+                    target=self._renew_loop, name="lease-renewer",
+                    daemon=True)
+                self._renewer.start()
+
+    def _writer_closed(self) -> None:
+        with self._lock:
+            self._open_writes = max(0, self._open_writes - 1)
+            if self._open_writes == 0:
+                self._stop_renew.set()
+                self._renewer = None
+
+    def _renew_loop(self) -> None:
+        period = 5.0
+        if self.conf is not None:
+            period = float(self.conf.get("tdfs.lease.hard.limit.s", 60)) / 4
+        while not self._stop_renew.wait(period):
+            try:
+                self.nn.call("renew_lease", self.name)
+            except RpcError:
+                pass
+
+    # ------------------------------------------------------------ write
+
+    def create(self, path: str, overwrite: bool = True,
+               replication: int | None = None,
+               block_size: int | None = None) -> "_DFSOutputStream":
+        meta = self.nn.call("create", path, self.name, replication,
+                            block_size, overwrite)
+        self._writer_opened()
+        return _DFSOutputStream(self, path, meta["block_size"])
+
+    # ------------------------------------------------------------ read
+
+    def open(self, path: str) -> io.BufferedReader:
+        blocks = self.nn.call("get_block_locations", path)
+        return io.BufferedReader(_DFSInputStream(self, blocks))
+
+    # ------------------------------------------------------------ namespace
+
+    def mkdirs(self, path: str) -> bool:
+        return self.nn.call("mkdirs", path)
+
+    def delete(self, path: str, recursive: bool = True) -> bool:
+        return self.nn.call("delete", path, recursive)
+
+    def rename(self, src: str, dst: str) -> bool:
+        return self.nn.call("rename", src, dst)
+
+    def exists(self, path: str) -> bool:
+        return self.nn.call("exists", path)
+
+    def get_status(self, path: str) -> dict:
+        return self.nn.call("get_status", path)
+
+    def list_status(self, path: str) -> list[dict]:
+        return self.nn.call("list_status", path)
+
+    def set_replication(self, path: str, replication: int) -> bool:
+        return self.nn.call("set_replication", path, replication)
+
+    def datanode_report(self) -> list[dict]:
+        return self.nn.call("datanode_report")
+
+
+class _DFSOutputStream(io.RawIOBase):
+    """Buffer → block pipeline writer (≈ DFSOutputStream)."""
+
+    MAX_BLOCK_RETRIES = 3
+
+    def __init__(self, client: DFSClient, path: str, block_size: int) -> None:
+        self.client = client
+        self.path = path
+        self.block_size = block_size
+        self._buf = bytearray()
+        self._prev_block_size = -1
+        self._closed = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, data: bytes) -> int:  # type: ignore[override]
+        self._buf.extend(data)
+        while len(self._buf) >= self.block_size:
+            chunk = bytes(self._buf[: self.block_size])
+            del self._buf[: self.block_size]
+            self._flush_block(chunk)
+        return len(data)
+
+    def _flush_block(self, data: bytes) -> None:
+        excluded: list[str] = []
+        last_err: Exception | None = None
+        for _ in range(self.MAX_BLOCK_RETRIES):
+            alloc = self.client.nn.call("add_block", self.path,
+                                        self.client.name,
+                                        self._prev_block_size, excluded)
+            bid, targets = alloc["block_id"], alloc["targets"]
+            # prev size is journaled now; next add_block must not re-log it
+            self._prev_block_size = -1
+            try:
+                self.client._dn(targets[0]).call(
+                    "write_block", bid, data, targets[1:])
+                self._prev_block_size = len(data)
+                return
+            except Exception as e:  # noqa: BLE001 — pipeline failure
+                last_err = e
+                excluded.append(targets[0])
+                self.client.nn.call("abandon_block", self.path,
+                                    self.client.name, bid)
+        raise IOError(f"write pipeline failed for {self.path} after "
+                      f"{self.MAX_BLOCK_RETRIES} attempts: {last_err}")
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            last_size = -1
+            if self._buf:
+                data = bytes(self._buf)
+                self._buf.clear()
+                self._flush_block(data)
+                last_size = len(data)
+            elif self._prev_block_size >= 0:
+                last_size = self._prev_block_size
+            self.client.nn.call("complete", self.path, self.client.name,
+                                last_size)
+        finally:
+            self.client._writer_closed()
+            super().close()
+
+
+class _DFSInputStream(io.RawIOBase):
+    """Positioned reads over the block map with replica failover
+    (≈ DFSInputStream)."""
+
+    def __init__(self, client: DFSClient, blocks: list[dict]) -> None:
+        self.client = client
+        self.blocks = blocks
+        self.length = sum(b["size"] for b in blocks)
+        self.pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, offset: int, whence: int = io.SEEK_SET) -> int:
+        if whence == io.SEEK_SET:
+            self.pos = offset
+        elif whence == io.SEEK_CUR:
+            self.pos += offset
+        else:
+            self.pos = self.length + offset
+        return self.pos
+
+    def tell(self) -> int:
+        return self.pos
+
+    def readinto(self, b: bytearray) -> int:  # type: ignore[override]
+        if self.pos >= self.length:
+            return 0
+        want = min(len(b), self.length - self.pos)
+        out = self._pread(self.pos, want)
+        b[: len(out)] = out
+        self.pos += len(out)
+        return len(out)
+
+    def _pread(self, pos: int, length: int) -> bytes:
+        chunks: list[bytes] = []
+        offset = 0
+        for blk in self.blocks:
+            size = blk["size"]
+            if pos >= offset + size:
+                offset += size
+                continue
+            if pos + length <= offset:
+                break
+            lo = max(pos, offset) - offset
+            hi = min(pos + length, offset + size) - offset
+            chunks.append(self._read_replica(blk, lo, hi - lo))
+            offset += size
+        return b"".join(chunks)
+
+    def _read_replica(self, blk: dict, offset: int, length: int) -> bytes:
+        last_err: Exception | None = None
+        for addr in blk["locations"]:
+            try:
+                return self.client._dn(addr).call(
+                    "read_block", blk["block_id"], offset, length)
+            except Exception as e:  # noqa: BLE001 — dead/corrupt replica
+                last_err = e
+                continue
+        raise IOError(f"all replicas failed for block {blk['block_id']} "
+                      f"(locations {blk['locations']}): {last_err}")

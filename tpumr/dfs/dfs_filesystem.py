@@ -1,0 +1,88 @@
+"""FileSystem SPI binding for tdfs:// URIs.
+
+≈ ``org.apache.hadoop.hdfs.DistributedFileSystem`` (reference: hdfs/
+DistributedFileSystem.java): the thin adapter from the FS contract to the
+DFSClient, including block-location hints that drive locality-aware split
+placement (FileInputFormat.getSplits → JobInProgress host caches)."""
+
+from __future__ import annotations
+
+from typing import Any, BinaryIO
+
+from tpumr.dfs.client import DFSClient
+from tpumr.fs.filesystem import (BlockLocation, FileStatus, FileSystem,
+                                 Path)
+
+
+class DistributedFileSystem(FileSystem):
+    scheme = "tdfs"
+
+    def __init__(self, conf: Any = None, authority: str = "") -> None:
+        if not authority and conf is not None:
+            authority = Path(conf.get("fs.default.name", "")).authority
+        if not authority:
+            raise ValueError("tdfs URI needs an authority (tdfs://host:port/)")
+        host, port = authority.rsplit(":", 1)
+        self.client = DFSClient(host, int(port), conf)
+        self.authority = authority
+
+    def _p(self, path: "str | Path") -> str:
+        return Path(path).path
+
+    def _q(self, path: str) -> Path:
+        return Path(f"tdfs://{self.authority}{path}")
+
+    def open(self, path: "str | Path") -> BinaryIO:
+        return self.client.open(self._p(path))
+
+    def create(self, path: "str | Path", overwrite: bool = True) -> BinaryIO:
+        return self.client.create(self._p(path), overwrite=overwrite)
+
+    def append(self, path: "str | Path") -> BinaryIO:
+        raise NotImplementedError("tdfs append not supported (files are "
+                                  "write-once, reference 1.0.3 semantics "
+                                  "with dfs.support.append default false)")
+
+    def exists(self, path: "str | Path") -> bool:
+        return self.client.exists(self._p(path))
+
+    def get_status(self, path: "str | Path") -> FileStatus:
+        st = self.client.get_status(self._p(path))
+        return FileStatus(path=self._q(st["path"]), length=st["length"],
+                          is_dir=st["is_dir"],
+                          replication=st.get("replication", 1),
+                          block_size=st.get("block_size", 0),
+                          mtime=st.get("mtime", 0.0))
+
+    def list_status(self, path: "str | Path") -> list[FileStatus]:
+        return [FileStatus(path=self._q(st["path"]), length=st["length"],
+                           is_dir=st["is_dir"],
+                           replication=st.get("replication", 1),
+                           block_size=st.get("block_size", 0),
+                           mtime=st.get("mtime", 0.0))
+                for st in self.client.list_status(self._p(path))]
+
+    def mkdirs(self, path: "str | Path") -> bool:
+        return self.client.mkdirs(self._p(path))
+
+    def delete(self, path: "str | Path", recursive: bool = False) -> bool:
+        return self.client.delete(self._p(path), recursive)
+
+    def rename(self, src: "str | Path", dst: "str | Path") -> bool:
+        return self.client.rename(self._p(src), self._p(dst))
+
+    def get_block_locations(self, path: "str | Path", offset: int,
+                            length: int) -> list[BlockLocation]:
+        blocks = self.client.nn.call("get_block_locations", self._p(path))
+        out: list[BlockLocation] = []
+        pos = 0
+        for blk in blocks:
+            size = blk["size"]
+            if pos + size > offset and pos < offset + length:
+                hosts = [a.rsplit(":", 1)[0] for a in blk["locations"]]
+                out.append(BlockLocation(hosts, pos, size))
+            pos += size
+        return out
+
+
+FileSystem.register("tdfs", DistributedFileSystem)

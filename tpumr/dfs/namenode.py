@@ -1,0 +1,683 @@
+"""NameNode — namespace + block management master.
+
+≈ ``org.apache.hadoop.hdfs.server.namenode.{NameNode,FSNamesystem}``
+(reference: FSNamesystem.java, 5907 LoC; NameNode.java RPC front). Contracts
+reproduced:
+
+- flat namespace of files/dirs; files are ordered block lists; every
+  mutation journals to the edit log BEFORE applying (editlog.py);
+- single-writer leases: create() grants the lease, concurrent creates fail
+  (AlreadyBeingCreatedException semantics); expired leases are recovered by
+  finalizing the file with its reported blocks (LeaseManager);
+- block locations are NOT persisted — rebuilt from DataNode block reports
+  (BlocksMap + processReport semantics);
+- safemode on startup until a threshold fraction of known blocks have a
+  reported replica (``dfs.safemode.threshold.pct``, FSNamesystem.SafeModeInfo);
+- heartbeat-lease liveness for DataNodes; a dead DataNode's replicas go
+  under-replicated and the replication monitor schedules re-replication on
+  surviving nodes (heartbeatCheck + ReplicationMonitor → DNA_TRANSFER /
+  DNA_INVALIDATE commands piggybacked on heartbeats);
+- write-path placement excludes client-reported bad nodes (abandonBlock +
+  excludedNodes on addBlock).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any
+
+from tpumr.dfs.editlog import FSEditLog, FSImage, checkpoint
+from tpumr.ipc.rpc import RpcServer
+
+#: ≈ ClientProtocol.versionID (hdfs/protocol/ClientProtocol.java)
+PROTOCOL_VERSION = 61
+
+
+class SafeModeError(RuntimeError):
+    pass
+
+
+class LeaseError(RuntimeError):
+    pass
+
+
+def _now() -> float:
+    return time.time()
+
+
+class FSNamesystem:
+    """Namespace + block map + leases. All public mutators journal first."""
+
+    def __init__(self, name_dir: str, conf: Any) -> None:
+        self.conf = conf
+        self.name_dir = name_dir
+        self.lock = threading.RLock()
+        self.default_replication = int(conf.get("dfs.replication", 3))
+        self.default_block_size = int(conf.get("dfs.block.size",
+                                               8 * 1024 * 1024))
+        self.safemode_threshold = float(conf.get("dfs.safemode.threshold.pct",
+                                                 0.999))
+        self.lease_hard_limit = float(conf.get("tdfs.lease.hard.limit.s", 60))
+
+        # persisted state: namespace + counters (image ∪ edits)
+        self.namespace, self.counters = FSImage.load(name_dir)
+        for op in FSEditLog.replay(name_dir):
+            self.apply_op(self.namespace, self.counters, op)
+        self.counters.setdefault("next_block", 1)
+        self.counters.setdefault("gen", 1)
+        if "/" not in self.namespace:
+            self.namespace["/"] = {"type": "dir", "mtime": _now()}
+        self.edits = FSEditLog(name_dir)
+
+        # volatile state, rebuilt at runtime
+        self.block_locations: dict[int, set[str]] = {}   # bid -> {dn addr}
+        self.block_sizes: dict[int, int] = {}            # reported sizes
+        self.datanodes: dict[str, dict] = {}             # addr -> info
+        self.commands: dict[str, list[dict]] = {}        # addr -> pending
+        self.leases: dict[str, dict] = {}                # client -> lease
+
+        self.total_known_blocks = sum(
+            len(i.get("blocks", [])) for i in self.namespace.values()
+            if i.get("type") == "file")
+        self.safemode = self.total_known_blocks > 0
+
+    # ------------------------------------------------------------ journal
+
+    @staticmethod
+    def apply_op(namespace: dict, counters: dict, op: dict) -> None:
+        """Replay one journaled op onto a bare namespace. Shared by startup
+        replay and checkpoint merge (editlog.checkpoint)."""
+        kind = op["op"]
+        p = op.get("path")
+        if kind == "mkdir":
+            namespace[p] = {"type": "dir", "mtime": op["t"]}
+        elif kind == "create":
+            namespace[p] = {"type": "file", "blocks": [], "uc": True,
+                            "replication": op["r"], "block_size": op["bs"],
+                            "mtime": op["t"], "client": op.get("c", "")}
+        elif kind == "add_block":
+            namespace[p]["blocks"].append([op["bid"], 0])
+        elif kind == "block_size":
+            for b in namespace[p]["blocks"]:
+                if b[0] == op["bid"]:
+                    b[1] = op["size"]
+        elif kind == "abandon":
+            namespace[p]["blocks"] = [b for b in namespace[p]["blocks"]
+                                      if b[0] != op["bid"]]
+        elif kind == "close":
+            inode = namespace[p]
+            inode["uc"] = False
+            inode.pop("client", None)
+            if "sizes" in op:
+                for b in inode["blocks"]:
+                    b[1] = op["sizes"].get(str(b[0]), b[1])
+        elif kind == "rename":
+            dst = op["dst"]
+            moved = [(k, v) for k, v in namespace.items()
+                     if k == p or k.startswith(p.rstrip("/") + "/")]
+            for k, v in moved:
+                del namespace[k]
+                namespace[dst + k[len(p):]] = v
+        elif kind == "delete":
+            for k in [k for k in namespace
+                      if k == p or k.startswith(p.rstrip("/") + "/")]:
+                del namespace[k]
+        elif kind == "set_repl":
+            namespace[p]["replication"] = op["r"]
+        elif kind == "counters":
+            counters.update(op["values"])
+
+    def _log(self, op: dict) -> None:
+        self.edits.log(op)
+
+    # ------------------------------------------------------------ helpers
+
+    def _check_safemode(self) -> None:
+        if self.safemode:
+            raise SafeModeError(
+                "NameNode is in safe mode: "
+                f"{self._reported_fraction():.3f} of "
+                f"{self.total_known_blocks} blocks reported "
+                f"(threshold {self.safemode_threshold})")
+
+    def _reported_fraction(self) -> float:
+        if self.total_known_blocks == 0:
+            return 1.0
+        reported = sum(1 for i in self.namespace.values()
+                       if i.get("type") == "file"
+                       for b in i.get("blocks", [])
+                       if self.block_locations.get(b[0]))
+        return reported / self.total_known_blocks
+
+    def _maybe_leave_safemode(self) -> None:
+        if self.safemode and \
+                self._reported_fraction() >= self.safemode_threshold:
+            self.safemode = False
+
+    def _ensure_parents(self, path: str) -> None:
+        parts = [p for p in path.split("/") if p]
+        cur = ""
+        for part in parts[:-1]:
+            cur += "/" + part
+            inode = self.namespace.get(cur)
+            if inode is None:
+                self._log({"op": "mkdir", "path": cur, "t": _now()})
+                self.namespace[cur] = {"type": "dir", "mtime": _now()}
+            elif inode["type"] != "dir":
+                raise NotADirectoryError(cur)
+
+    def _inode(self, path: str) -> dict:
+        inode = self.namespace.get(path)
+        if inode is None:
+            raise FileNotFoundError(path)
+        return inode
+
+    # ------------------------------------------------------------ client ops
+
+    def create(self, path: str, client: str, replication: int | None,
+               block_size: int | None, overwrite: bool) -> dict:
+        with self.lock:
+            self._check_safemode()
+            existing = self.namespace.get(path)
+            if existing is not None:
+                if existing["type"] == "dir":
+                    raise IsADirectoryError(path)
+                if existing.get("uc"):
+                    raise LeaseError(
+                        f"{path} already being created by "
+                        f"{existing.get('client')}")
+                if not overwrite:
+                    raise FileExistsError(path)
+                self.delete(path)
+            self._ensure_parents(path)
+            r = replication or self.default_replication
+            bs = block_size or self.default_block_size
+            op = {"op": "create", "path": path, "r": r, "bs": bs,
+                  "t": _now(), "c": client}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            lease = self.leases.setdefault(
+                client, {"paths": set(), "renewed": _now()})
+            lease["paths"].add(path)
+            lease["renewed"] = _now()
+            return {"replication": r, "block_size": bs}
+
+    def add_block(self, path: str, client: str,
+                  prev_block_size: int = -1,
+                  excluded: list[str] | None = None) -> dict:
+        with self.lock:
+            self._check_safemode()
+            inode = self._inode(path)
+            if not inode.get("uc") or inode.get("client") != client:
+                raise LeaseError(f"{client} does not hold the lease on {path}")
+            if inode["blocks"] and prev_block_size >= 0:
+                bid = inode["blocks"][-1][0]
+                op = {"op": "block_size", "path": path, "bid": bid,
+                      "size": prev_block_size}
+                self._log(op)
+                self.apply_op(self.namespace, self.counters, op)
+            bid = self.counters["next_block"]
+            gen = self.counters["gen"]
+            self.counters["next_block"] = bid + 1
+            self._log({"op": "counters", "values":
+                       {"next_block": bid + 1, "gen": gen}})
+            targets = self._choose_targets(inode["replication"],
+                                           set(excluded or []))
+            if not targets:
+                raise IOError("no DataNodes available for replication")
+            op = {"op": "add_block", "path": path, "bid": bid}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            return {"block_id": bid, "gen": gen, "targets": targets}
+
+    def abandon_block(self, path: str, client: str, block_id: int) -> None:
+        """Client hit a pipeline failure: drop the block and let it retry
+        (≈ ClientProtocol.abandonBlock)."""
+        with self.lock:
+            op = {"op": "abandon", "path": path, "bid": block_id}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+
+    def complete(self, path: str, client: str, last_block_size: int) -> None:
+        with self.lock:
+            inode = self._inode(path)
+            if not inode.get("uc") or inode.get("client") != client:
+                raise LeaseError(f"{client} does not hold the lease on {path}")
+            sizes = {}
+            if inode["blocks"] and last_block_size >= 0:
+                sizes[str(inode["blocks"][-1][0])] = last_block_size
+            op = {"op": "close", "path": path, "sizes": sizes}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            self.total_known_blocks += len(inode["blocks"])
+            lease = self.leases.get(client)
+            if lease:
+                lease["paths"].discard(path)
+
+    def renew_lease(self, client: str) -> None:
+        with self.lock:
+            lease = self.leases.get(client)
+            if lease:
+                lease["renewed"] = _now()
+
+    def get_block_locations(self, path: str) -> list[dict]:
+        with self.lock:
+            inode = self._inode(path)
+            if inode["type"] != "file":
+                raise IsADirectoryError(path)
+            out = []
+            for bid, size in inode["blocks"]:
+                locs = sorted(self.block_locations.get(bid, ()))
+                out.append({"block_id": bid,
+                            "size": self.block_sizes.get(bid, size),
+                            "locations": locs})
+            return out
+
+    # ------------------------------------------------------------ namespace
+
+    def mkdirs(self, path: str) -> bool:
+        with self.lock:
+            self._check_safemode()
+            if path in self.namespace:
+                return self.namespace[path]["type"] == "dir"
+            self._ensure_parents(path + "/x")
+            op = {"op": "mkdir", "path": path, "t": _now()}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            return True
+
+    def delete(self, path: str, recursive: bool = True) -> bool:
+        with self.lock:
+            self._check_safemode()
+            inode = self.namespace.get(path)
+            if inode is None:
+                return False
+            children = [k for k in self.namespace
+                        if k.startswith(path.rstrip("/") + "/")]
+            if inode["type"] == "dir" and children and not recursive:
+                raise OSError(f"{path} is a non-empty directory")
+            # schedule replica invalidation on the owning DataNodes
+            doomed: list[int] = []
+            for k in children + [path]:
+                node = self.namespace.get(k, {})
+                if node.get("type") == "file":
+                    doomed.extend(b[0] for b in node.get("blocks", []))
+            op = {"op": "delete", "path": path}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            for bid in doomed:
+                for addr in self.block_locations.pop(bid, set()):
+                    self.commands.setdefault(addr, []).append(
+                        {"type": "delete", "block_id": bid})
+                self.block_sizes.pop(bid, None)
+                self.total_known_blocks = max(0, self.total_known_blocks - 1)
+            return True
+
+    def rename(self, src: str, dst: str) -> bool:
+        with self.lock:
+            self._check_safemode()
+            if src not in self.namespace:
+                return False
+            if dst in self.namespace and self.namespace[dst]["type"] == "dir":
+                dst = dst.rstrip("/") + "/" + src.rsplit("/", 1)[-1]
+            if dst in self.namespace:
+                return False
+            self._ensure_parents(dst)
+            op = {"op": "rename", "path": src, "dst": dst}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            return True
+
+    def set_replication(self, path: str, replication: int) -> bool:
+        with self.lock:
+            self._check_safemode()
+            inode = self._inode(path)
+            if inode["type"] != "file":
+                return False
+            op = {"op": "set_repl", "path": path, "r": replication}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            return True
+
+    def get_status(self, path: str) -> dict:
+        with self.lock:
+            inode = self._inode(path)
+            if inode["type"] == "dir":
+                return {"path": path, "is_dir": True, "length": 0,
+                        "mtime": inode.get("mtime", 0)}
+            length = sum(self.block_sizes.get(bid, size)
+                         for bid, size in inode["blocks"])
+            return {"path": path, "is_dir": False, "length": length,
+                    "replication": inode["replication"],
+                    "block_size": inode["block_size"],
+                    "mtime": inode.get("mtime", 0),
+                    "under_construction": bool(inode.get("uc"))}
+
+    def list_status(self, path: str) -> list[dict]:
+        with self.lock:
+            inode = self._inode(path)
+            if inode["type"] != "dir":
+                return [self.get_status(path)]
+            prefix = path.rstrip("/") + "/"
+            names = {k for k in self.namespace
+                     if k.startswith(prefix) and k != path
+                     and "/" not in k[len(prefix):]}
+            return [self.get_status(k) for k in sorted(names)]
+
+    def exists(self, path: str) -> bool:
+        with self.lock:
+            return path in self.namespace
+
+    # ------------------------------------------------------------ datanodes
+
+    def register_datanode(self, addr: str, capacity: int) -> None:
+        with self.lock:
+            self.datanodes[addr] = {"addr": addr, "capacity": capacity,
+                                    "used": 0, "last_seen": _now(),
+                                    "blocks": 0}
+            self.commands.setdefault(addr, [])
+
+    def dn_heartbeat(self, addr: str, used: int, capacity: int,
+                     block_count: int) -> list[dict]:
+        with self.lock:
+            info = self.datanodes.get(addr)
+            if info is None:
+                # unknown (expired / NN restarted): tell it to re-register
+                # and send a fresh block report (≈ DNA_REGISTER)
+                return [{"type": "register"}]
+            info.update(used=used, capacity=capacity, last_seen=_now(),
+                        blocks=block_count)
+            cmds = self.commands.get(addr, [])
+            self.commands[addr] = []
+            return cmds
+
+    def block_report(self, addr: str, blocks: list[list[int]]) -> list[int]:
+        """Full report: rebuild this node's locations; returns block ids the
+        node should delete (orphans of deleted files)."""
+        with self.lock:
+            known = {bid for i in self.namespace.values()
+                     if i.get("type") == "file"
+                     for bid, _ in i.get("blocks", [])}
+            invalid: list[int] = []
+            for locs in self.block_locations.values():
+                locs.discard(addr)
+            for bid, size in blocks:
+                if bid in known:
+                    self.block_locations.setdefault(bid, set()).add(addr)
+                    self.block_sizes[bid] = size
+                else:
+                    invalid.append(bid)
+            self._maybe_leave_safemode()
+            return invalid
+
+    def block_received(self, addr: str, block_id: int, size: int) -> None:
+        with self.lock:
+            self.block_locations.setdefault(block_id, set()).add(addr)
+            self.block_sizes[block_id] = size
+            self._maybe_leave_safemode()
+
+    def _choose_targets(self, replication: int,
+                        excluded: set[str]) -> list[str]:
+        """Placement: least-used live nodes first, capped at cluster size
+        (the reference's rack-aware chooseTarget collapses to spread-by-load
+        on a flat topology)."""
+        live = [a for a, d in self.datanodes.items() if a not in excluded]
+        live.sort(key=lambda a: (self.datanodes[a]["used"], random.random()))
+        return live[:replication]
+
+    # ------------------------------------------------------------ monitors
+
+    def heartbeat_check(self, expiry_s: float) -> None:
+        """Remove dead DataNodes; their replicas become under-replicated
+        (≈ FSNamesystem.heartbeatCheck → removeDatanode)."""
+        with self.lock:
+            now = _now()
+            dead = [a for a, d in self.datanodes.items()
+                    if now - d["last_seen"] > expiry_s]
+            for addr in dead:
+                del self.datanodes[addr]
+                self.commands.pop(addr, None)
+                for locs in self.block_locations.values():
+                    locs.discard(addr)
+
+    def replication_check(self) -> int:
+        """One ReplicationMonitor sweep: schedule copies for
+        under-replicated finalized blocks, deletes for over-replicated.
+        Returns the number of commands scheduled."""
+        with self.lock:
+            if self.safemode or not self.datanodes:
+                return 0
+            scheduled = 0
+            for path, inode in self.namespace.items():
+                if inode.get("type") != "file" or inode.get("uc"):
+                    continue
+                want = min(inode["replication"], len(self.datanodes))
+                for bid, _ in inode["blocks"]:
+                    locs = {a for a in self.block_locations.get(bid, set())
+                            if a in self.datanodes}
+                    if 0 < len(locs) < want:
+                        targets = self._choose_targets(
+                            want - len(locs), excluded=locs)
+                        if targets:
+                            src = sorted(locs)[0]
+                            self.commands.setdefault(src, []).append(
+                                {"type": "replicate", "block_id": bid,
+                                 "targets": targets})
+                            scheduled += 1
+                    elif len(locs) > want:
+                        for addr in sorted(locs)[want:]:
+                            self.commands.setdefault(addr, []).append(
+                                {"type": "delete", "block_id": bid})
+                            self.block_locations[bid].discard(addr)
+                            scheduled += 1
+            return scheduled
+
+    def lease_check(self) -> None:
+        """Expire hard-limit leases: finalize the file with whatever blocks
+        were reported (lease recovery, simplified)."""
+        with self.lock:
+            now = _now()
+            for client, lease in list(self.leases.items()):
+                if now - lease["renewed"] <= self.lease_hard_limit:
+                    continue
+                for path in list(lease["paths"]):
+                    inode = self.namespace.get(path)
+                    if inode is None or not inode.get("uc"):
+                        continue
+                    op = {"op": "close", "path": path, "sizes": {
+                        str(bid): self.block_sizes.get(bid, size)
+                        for bid, size in inode["blocks"]}}
+                    self._log(op)
+                    self.apply_op(self.namespace, self.counters, op)
+                    self.total_known_blocks += len(inode["blocks"])
+                del self.leases[client]
+
+    # ------------------------------------------------------------ admin
+
+    def save_namespace(self) -> None:
+        """Checkpoint in place (image ∪ edits → image; truncate edits)."""
+        with self.lock:
+            self.edits.close()
+            checkpoint(self.name_dir, self.apply_op)
+            self.edits = FSEditLog(self.name_dir)
+
+    def get_name_state(self) -> dict:
+        """Secondary checkpoint fetch (≈ GetImageServlet): returns the
+        current image + edits and ROLLS the journal, so edits after this
+        point replay cleanly on top of the merged image the secondary will
+        upload."""
+        import os
+        from tpumr.dfs.editlog import EDITS_NAME, IMAGE_NAME
+        with self.lock:
+            image = b"{}"
+            img_path = os.path.join(self.name_dir, IMAGE_NAME)
+            if os.path.exists(img_path):
+                with open(img_path, "rb") as f:
+                    image = f.read()
+            with open(os.path.join(self.name_dir, EDITS_NAME), "rb") as f:
+                edits = f.read()
+            self.edits.roll()
+            return {"image": image, "edits": edits}
+
+    def put_image(self, image: bytes) -> None:
+        """Secondary checkpoint upload (≈ putFSImage + rollFSImage)."""
+        import os
+        from tpumr.dfs.editlog import IMAGE_NAME
+        with self.lock:
+            tmp = os.path.join(self.name_dir, IMAGE_NAME + ".ckpt")
+            with open(tmp, "wb") as f:
+                f.write(image)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.name_dir, IMAGE_NAME))
+
+    def get_blocks(self, addr: str, max_blocks: int = 16) -> list[dict]:
+        """Blocks hosted on one DataNode (≈ NamenodeProtocol.getBlocks —
+        the balancer's feed)."""
+        with self.lock:
+            out = []
+            for bid, locs in self.block_locations.items():
+                if addr in locs:
+                    out.append({"block_id": bid,
+                                "size": self.block_sizes.get(bid, 0),
+                                "locations": sorted(locs)})
+                    if len(out) >= max_blocks:
+                        break
+            return out
+
+    def remove_replica(self, addr: str, block_id: int) -> None:
+        """Drop one replica (balancer move completion): forget the location
+        and tell the node to delete its copy."""
+        with self.lock:
+            self.block_locations.get(block_id, set()).discard(addr)
+            self.commands.setdefault(addr, []).append(
+                {"type": "delete", "block_id": block_id})
+
+    def datanode_report(self) -> list[dict]:
+        with self.lock:
+            return [dict(d) for d in self.datanodes.values()]
+
+
+class NameNode:
+    """RPC daemon front (≈ NameNode.java): hosts the namesystem plus the
+    monitor threads (heartbeat expiry, replication, lease recovery)."""
+
+    def __init__(self, name_dir: str, conf: Any, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.conf = conf
+        self.ns = FSNamesystem(name_dir, conf)
+        self.dn_expiry_s = float(conf.get("tdfs.datanode.expiry.s", 10))
+        self._server = RpcServer(self, host=host, port=port)
+        self._stop = threading.Event()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="nn-monitors", daemon=True)
+
+    def start(self) -> "NameNode":
+        self._server.start()
+        self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()
+        self.ns.edits.close()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.address
+
+    def _monitor_loop(self) -> None:
+        interval = float(self.conf.get("tdfs.replication.interval.s", 1.0))
+        while not self._stop.wait(interval):
+            try:
+                self.ns.heartbeat_check(self.dn_expiry_s)
+                self.ns.replication_check()
+                self.ns.lease_check()
+            except Exception:  # noqa: BLE001 — monitors must survive
+                pass
+
+    # ------------------------------------------------------------ RPC surface
+    # thin delegation so the RPC registry exposes exactly the protocol
+
+    def get_protocol_version(self) -> int:
+        return PROTOCOL_VERSION
+
+    def create(self, path, client, replication=None, block_size=None,
+               overwrite=True):
+        return self.ns.create(path, client, replication, block_size,
+                              overwrite)
+
+    def add_block(self, path, client, prev_block_size=-1, excluded=None):
+        return self.ns.add_block(path, client, prev_block_size, excluded)
+
+    def abandon_block(self, path, client, block_id):
+        return self.ns.abandon_block(path, client, block_id)
+
+    def complete(self, path, client, last_block_size):
+        return self.ns.complete(path, client, last_block_size)
+
+    def renew_lease(self, client):
+        return self.ns.renew_lease(client)
+
+    def get_block_locations(self, path):
+        return self.ns.get_block_locations(path)
+
+    def mkdirs(self, path):
+        return self.ns.mkdirs(path)
+
+    def delete(self, path, recursive=True):
+        return self.ns.delete(path, recursive)
+
+    def rename(self, src, dst):
+        return self.ns.rename(src, dst)
+
+    def set_replication(self, path, replication):
+        return self.ns.set_replication(path, replication)
+
+    def get_status(self, path):
+        return self.ns.get_status(path)
+
+    def list_status(self, path):
+        return self.ns.list_status(path)
+
+    def exists(self, path):
+        return self.ns.exists(path)
+
+    def register_datanode(self, addr, capacity):
+        return self.ns.register_datanode(addr, capacity)
+
+    def dn_heartbeat(self, addr, used, capacity, block_count):
+        return self.ns.dn_heartbeat(addr, used, capacity, block_count)
+
+    def block_report(self, addr, blocks):
+        return self.ns.block_report(addr, blocks)
+
+    def block_received(self, addr, block_id, size):
+        return self.ns.block_received(addr, block_id, size)
+
+    def safemode(self, action="get"):
+        if action == "leave":
+            self.ns.safemode = False
+        elif action == "enter":
+            self.ns.safemode = True
+        return self.ns.safemode
+
+    def save_namespace(self):
+        return self.ns.save_namespace()
+
+    def get_name_state(self):
+        return self.ns.get_name_state()
+
+    def put_image(self, image):
+        return self.ns.put_image(image)
+
+    def get_blocks(self, addr, max_blocks=16):
+        return self.ns.get_blocks(addr, max_blocks)
+
+    def remove_replica(self, addr, block_id):
+        return self.ns.remove_replica(addr, block_id)
+
+    def datanode_report(self):
+        return self.ns.datanode_report()

@@ -102,6 +102,9 @@ class FileSystem(ABC):
     scheme: str = ""
     _registry: dict[str, "Callable[[Any], FileSystem]"] = {}
     _cache: dict[str, "FileSystem"] = {}
+    #: schemes registered on first use (module imported lazily to avoid
+    #: pulling daemon deps into every fs consumer)
+    _lazy_schemes: dict[str, str] = {"tdfs": "tpumr.dfs.dfs_filesystem"}
 
     # ------------------------------------------------------------ dispatch
 
@@ -118,10 +121,20 @@ class FileSystem(ABC):
         fs = cls._cache.get(key)
         if fs is None:
             factory = cls._registry.get(scheme)
+            if factory is None and scheme in cls._lazy_schemes:
+                import importlib
+                importlib.import_module(cls._lazy_schemes[scheme])
+                factory = cls._registry.get(scheme)
             if factory is None:
                 raise ValueError(f"no FileSystem for scheme {scheme!r}; "
                                  f"registered: {sorted(cls._registry)}")
-            fs = factory(conf)
+            import inspect
+            params = inspect.signature(factory).parameters
+            if "authority" in params:
+                # network filesystems need the URI authority (host:port)
+                fs = factory(conf, authority=p.authority)
+            else:
+                fs = factory(conf)
             cls._cache[key] = fs
         return fs
 

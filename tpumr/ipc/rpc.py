@@ -58,6 +58,12 @@ def _recv_frame(sock: socket.socket) -> Any:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self) -> None:
+        self.server.track_connection(self.request)  # type: ignore[attr-defined]
+
+    def finish(self) -> None:
+        self.server.untrack_connection(self.request)  # type: ignore[attr-defined]
+
     def handle(self) -> None:
         server: RpcServer = self.server  # type: ignore[assignment]
         sock = self.request
@@ -107,9 +113,21 @@ class RpcServer:
         self._server.lookup = self.lookup  # type: ignore[attr-defined]
         self._server.response_cache_get = self.response_cache_get  # type: ignore[attr-defined]
         self._server.response_cache_put = self.response_cache_put  # type: ignore[attr-defined]
+        self._server.track_connection = self._track_connection  # type: ignore[attr-defined]
+        self._server.untrack_connection = self._untrack_connection  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
         self._resp_cache: "dict[tuple, Any]" = {}
         self._resp_cache_lock = threading.Lock()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+
+    def _track_connection(self, sock: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.add(sock)
+
+    def _untrack_connection(self, sock: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.discard(sock)
 
     def response_cache_get(self, key: tuple) -> Any | None:
         with self._resp_cache_lock:
@@ -156,6 +174,20 @@ class RpcServer:
         if self._thread is not None:
             self._server.shutdown()
         self._server.server_close()
+        # sever established connections too: a stopped server must not keep
+        # answering RPCs through old handler threads (a restarted daemon on
+        # the same port would otherwise never see its clients reconnect)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class RpcClient:
