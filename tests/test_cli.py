@@ -1,0 +1,191 @@
+"""CLI + FsShell + examples driver ≈ bin/hadoop dispatch, FsShell.java,
+ExampleDriver.java (SURVEY.md §1 L8)."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpumr.cli import main as cli_main
+from tpumr.fs import get_filesystem
+from tpumr.fs.shell import FsShell
+
+
+def run_shell(*argv, default_fs=None):
+    out, err = io.StringIO(), io.StringIO()
+    sh = FsShell(default_fs=default_fs, out=out, err=err)
+    rc = sh.run(list(argv))
+    return rc, out.getvalue(), err.getvalue()
+
+
+class TestFsShell:
+    def test_mkdir_ls_put_cat(self, tmp_path):
+        local = tmp_path / "src.txt"
+        local.write_text("hello shell\n")
+        rc, _, _ = run_shell("-mkdir", "mem:///sh/dir")
+        assert rc == 0
+        rc, _, _ = run_shell("-put", str(local), "mem:///sh/dir/a.txt")
+        assert rc == 0
+        rc, out, _ = run_shell("-cat", "mem:///sh/dir/a.txt")
+        assert rc == 0 and out == "hello shell\n"
+        rc, out, _ = run_shell("-ls", "mem:///sh/dir")
+        assert rc == 0 and "a.txt" in out
+
+    def test_get_cp_mv_rm(self, tmp_path):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/t/x.txt", b"data")
+        dst = tmp_path / "out.txt"
+        rc, _, _ = run_shell("-get", "mem:///t/x.txt", str(dst))
+        assert rc == 0 and dst.read_bytes() == b"data"
+        rc, _, _ = run_shell("-cp", "mem:///t/x.txt", "mem:///t/y.txt")
+        assert rc == 0 and fs.read_bytes("/t/y.txt") == b"data"
+        rc, _, _ = run_shell("-mv", "mem:///t/y.txt", "mem:///t/z.txt")
+        assert rc == 0 and not fs.exists("/t/y.txt")
+        rc, _, _ = run_shell("-rm", "mem:///t/z.txt")
+        assert rc == 0 and not fs.exists("/t/z.txt")
+
+    def test_du_count_test(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/d/a", b"xx")
+        fs.write_bytes("/d/b", b"yyy")
+        rc, out, _ = run_shell("-du", "mem:///d")
+        assert rc == 0 and "total 5" in out
+        rc, out, _ = run_shell("-count", "mem:///d")
+        assert rc == 0
+        assert run_shell("-test", "-e", "mem:///d/a")[0] == 0
+        assert run_shell("-test", "-e", "mem:///d/nope")[0] == 1
+        assert run_shell("-test", "-d", "mem:///d")[0] == 0
+
+    def test_default_fs_resolution(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/base/f.txt", b"resolved")
+        rc, out, _ = run_shell("-cat", "/base/f.txt", default_fs="mem://")
+        assert rc == 0 and out == "resolved"
+
+    def test_glob(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/g/part-00000", b"a\n")
+        fs.write_bytes("/g/part-00001", b"b\n")
+        rc, out, _ = run_shell("-cat", "mem:///g/part-*")
+        assert rc == 0 and out == "a\nb\n"
+
+    def test_unknown_and_missing(self):
+        rc, _, err = run_shell("-bogus")
+        assert rc == 255 and "unknown command" in err
+        rc, _, err = run_shell("-cat", "mem:///nope")
+        assert rc == 1
+
+
+class TestCliDispatch:
+    def test_version(self, capsys):
+        assert cli_main(["version"]) == 0
+        assert "tpumr" in capsys.readouterr().out
+
+    def test_unknown(self, capsys):
+        assert cli_main(["frobnicate"]) == 255
+
+    def test_generic_options_fs(self, capsys):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/cli/hello.txt", b"via cli")
+        rc = cli_main(["-fs", "mem://", "fs", "-cat", "/cli/hello.txt"])
+        assert rc == 0
+        assert capsys.readouterr().out == "via cli"
+
+
+class TestJobControl:
+    def test_job_list_and_status(self, capsys):
+        from tpumr.mapred.job_client import JobClient
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0) as c:
+            fs = get_filesystem("mem:///")
+            fs.write_bytes("/jc/in.txt", b"a b c\n" * 50)
+            conf = c.create_job_conf()
+            conf.set_input_paths("mem:///jc/in.txt")
+            conf.set_output_path("mem:///jc/out")
+            from tpumr.ops.wordcount import WordCountCpuMapper
+            from tpumr.examples.basic import LongSumReducer
+            conf.set_mapper_class(WordCountCpuMapper)
+            conf.set_reducer_class(LongSumReducer)
+            result = JobClient(conf).run_job(conf)
+            assert result.successful
+            jt = c.master_address
+            assert cli_main(["-jt", jt, "job", "-list"]) == 0
+            out = capsys.readouterr().out
+            assert "job_" in out and "SUCCEEDED" in out
+            jid = out.split()[0]
+            assert cli_main(["-jt", jt, "job", "-status", jid]) == 0
+            status = json.loads(capsys.readouterr().out)
+            assert status["state"] == "SUCCEEDED"
+            assert cli_main(["-jt", jt, "job", "-counters", jid]) == 0
+
+
+class TestExamples:
+    def test_driver_lists(self, capsys):
+        assert cli_main(["examples", "-h"]) == 0
+        err = capsys.readouterr().err
+        assert "wordcount" in err and "kmeans" in err
+
+    def test_wordcount(self, capsys):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/ex/in.txt", b"apple pear apple\npear apple\n")
+        rc = cli_main(["examples", "wordcount",
+                       "mem:///ex/in.txt", "mem:///ex/out"])
+        assert rc == 0
+        text = fs.read_bytes("/ex/out/part-00000").decode()
+        counts = dict(line.split("\t") for line in text.splitlines())
+        assert counts == {"apple": "3", "pear": "2"}
+
+    def test_pi(self, capsys):
+        rc = cli_main(["examples", "pi", "4", "500",
+                       "--work", "mem:///ex/pi"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        est = float(out.strip().rsplit(" ", 1)[1])
+        assert 2.5 < est < 3.8
+
+    def test_kmeans_converges(self, capsys):
+        from tpumr.examples.basic import save_npy
+        fs = get_filesystem("mem:///")
+        rng = np.random.default_rng(7)
+        pts = np.concatenate([
+            rng.normal(loc=(0, 0), scale=0.05, size=(60, 2)),
+            rng.normal(loc=(9, 9), scale=0.05, size=(60, 2)),
+        ]).astype(np.float32)
+        rng.shuffle(pts)
+        save_npy(fs, "/ex/km/points.npy", pts)
+        rc = cli_main(["examples", "kmeans", "mem:///ex/km/points.npy",
+                       "mem:///ex/km/out", "-k", "2", "-i", "3",
+                       "--split-rows", "50"])
+        assert rc == 0
+        from tpumr.examples.basic import load_npy
+        cents = load_npy(fs, "mem:///ex/km/out/centroids.npy")
+        cents = cents[np.argsort(cents[:, 0])]
+        np.testing.assert_allclose(cents[0], (0, 0), atol=0.2)
+        np.testing.assert_allclose(cents[1], (9, 9), atol=0.2)
+
+    def test_grep(self, capsys):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/ex/g/in.txt", b"foo123 bar foo456\nbaz foo789\n")
+        rc = cli_main(["examples", "grep", "mem:///ex/g/in.txt",
+                       "mem:///ex/g/out", r"foo\d+"])
+        assert rc == 0
+        text = fs.read_bytes("/ex/g/out/part-00000").decode()
+        assert len(text.splitlines()) == 3
+
+    def test_matmul(self):
+        from tpumr.examples.basic import load_npy, save_npy
+        fs = get_filesystem("mem:///")
+        rng = np.random.default_rng(3)
+        a = rng.normal(size=(32, 16)).astype(np.float32)
+        b = rng.normal(size=(16, 8)).astype(np.float32)
+        save_npy(fs, "/ex/mm/a.npy", a)
+        save_npy(fs, "/ex/mm/b.npy", b)
+        rc = cli_main(["examples", "matmul", "mem:///ex/mm/a.npy",
+                       "mem:///ex/mm/b.npy", "mem:///ex/mm/out",
+                       "--split-rows", "16", "--cpu-only"])
+        assert rc == 0
+        outs = [st for st in fs.list_files("/ex/mm/out")
+                if st.path.name.startswith("part")]
+        assert outs

@@ -1,0 +1,294 @@
+"""``tpumr`` — the framework's command-line entry point.
+
+≈ the reference's ``bin/hadoop`` dispatch script (bin/hadoop:66-95): one
+command name selects a daemon, a client tool, or a user program. Generic
+options (≈ GenericOptionsParser, src/core/.../util/GenericOptionsParser.java)
+come before the subcommand's own arguments: ``-D k=v``, ``-fs <uri>``,
+``-jt <host:port|local>``.
+
+Daemon commands run in the foreground until SIGINT (process supervision is
+the operator's problem, as with the reference's hadoop-daemon.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import sys
+import threading
+import time
+from typing import Any
+
+USAGE = """\
+Usage: tpumr [generic options] COMMAND [args]
+Generic options: -D k=v   -fs <default-fs-uri>   -jt <host:port|local>
+
+Daemons:
+  namenode -dir DIR [-host H] [-port P]      run the tdfs NameNode
+  datanode -nn HOST:PORT -dir DIR            run a tdfs DataNode
+  secondarynamenode -nn HOST:PORT -dir DIR   periodic checkpoint daemon
+  jobtracker [-host H] [-port P]             run the JobMaster
+  tasktracker -jt HOST:PORT                  run a NodeRunner (worker)
+
+Clients:
+  fs -CMD ...          filesystem shell (tpumr fs -help for commands)
+  job ...              job control: -list | -status ID | -kill ID | -counters ID
+  balancer -nn HOST:PORT                     rebalance tdfs blocks
+  pipes ...            submit an external-binary (pipes) job
+  streaming ...        submit a script (streaming) job
+  examples NAME ...    run an example program (examples -h lists them)
+  version              print the version
+"""
+
+from tpumr import __version__ as VERSION
+
+
+def _parse_generic(argv: list[str]) -> tuple[dict[str, Any], list[str]]:
+    """Strip leading generic options; return (overrides, rest)."""
+    over: dict[str, Any] = {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "-D" and i + 1 < len(argv):
+            k, _, v = argv[i + 1].partition("=")
+            over[k.strip()] = v.strip()
+            i += 2
+        elif a.startswith("-D") and "=" in a:
+            k, _, v = a[2:].partition("=")
+            over[k.strip()] = v.strip()
+            i += 1
+        elif a == "-fs" and i + 1 < len(argv):
+            over["fs.default.name"] = argv[i + 1]
+            i += 2
+        elif a == "-jt" and i + 1 < len(argv):
+            over["mapred.job.tracker"] = argv[i + 1]
+            i += 2
+        else:
+            break
+    return over, argv[i:]
+
+
+def _conf(overrides: dict[str, Any]):
+    from tpumr.mapred.jobconf import JobConf
+    conf = JobConf()
+    for k, v in overrides.items():
+        conf.set(k, v)
+    return conf
+
+
+def _serve_forever(stop) -> int:
+    """Block until SIGINT/SIGTERM, then stop() the daemon."""
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: done.set())
+        except ValueError:  # non-main thread (tests)
+            pass
+    try:
+        while not done.is_set():
+            time.sleep(0.5)
+    finally:
+        stop()
+    return 0
+
+
+def _kv_args(argv: list[str]) -> dict[str, str]:
+    """Parse '-name value' pairs of the daemon commands."""
+    out: dict[str, str] = {}
+    i = 0
+    while i < len(argv):
+        if argv[i].startswith("-") and i + 1 < len(argv):
+            out[argv[i].lstrip("-")] = argv[i + 1]
+            i += 2
+        else:
+            raise SystemExit(f"unexpected argument: {argv[i]}")
+    return out
+
+
+def _host_port(s: str) -> tuple[str, int]:
+    host, _, port = s.partition(":")
+    return host or "127.0.0.1", int(port)
+
+
+# ------------------------------------------------------------------ daemons
+
+
+def cmd_namenode(conf, argv: list[str]) -> int:
+    from tpumr.dfs.namenode import NameNode
+    a = _kv_args(argv)
+    nn = NameNode(a.get("dir", "/tmp/tpumr-name"), conf,
+                  host=a.get("host", "127.0.0.1"),
+                  port=int(a.get("port", 9000))).start()
+    host, port = nn.address
+    print(f"NameNode up at tdfs://{host}:{port}/", file=sys.stderr)
+    return _serve_forever(nn.stop)
+
+
+def cmd_datanode(conf, argv: list[str]) -> int:
+    from tpumr.dfs.datanode import DataNode
+    a = _kv_args(argv)
+    host, port = _host_port(a["nn"])
+    dn = DataNode(host, port, a.get("dir", "/tmp/tpumr-data"),
+                  capacity=int(a.get("capacity", 1 << 34))).start()
+    print(f"DataNode up ({dn.addr}), reporting to {a['nn']}", file=sys.stderr)
+    return _serve_forever(dn.stop)
+
+
+def cmd_secondarynamenode(conf, argv: list[str]) -> int:
+    from tpumr.dfs.secondary import SecondaryNameNode
+    a = _kv_args(argv)
+    host, port = _host_port(a["nn"])
+    if "interval" in a:
+        conf.set("fs.checkpoint.period", a["interval"])
+    snn = SecondaryNameNode(host, port, a.get("dir", "/tmp/tpumr-secondary"),
+                            conf=conf).start()
+    print(f"SecondaryNameNode up, checkpointing {a['nn']}", file=sys.stderr)
+    return _serve_forever(snn.stop)
+
+
+def cmd_jobtracker(conf, argv: list[str]) -> int:
+    from tpumr.mapred.jobtracker import JobMaster
+    a = _kv_args(argv)
+    jm = JobMaster(conf, host=a.get("host", "127.0.0.1"),
+                   port=int(a.get("port", 9001))).start()
+    host, port = jm.address
+    print(f"JobMaster up at {host}:{port}", file=sys.stderr)
+    return _serve_forever(jm.stop)
+
+
+def cmd_tasktracker(conf, argv: list[str]) -> int:
+    from tpumr.mapred.tasktracker import NodeRunner
+    a = _kv_args(argv)
+    jt = a.get("jt") or conf.get("mapred.job.tracker")
+    if not jt or jt == "local" or ":" not in jt:
+        print("tasktracker needs -jt HOST:PORT", file=sys.stderr)
+        return 255
+    host, port = _host_port(jt)
+    nr = NodeRunner(host, port, conf).start()
+    print(f"NodeRunner up, heartbeating to {host}:{port}", file=sys.stderr)
+    return _serve_forever(nr.stop)
+
+
+def cmd_balancer(conf, argv: list[str]) -> int:
+    from tpumr.dfs.balancer import Balancer
+    a = _kv_args(argv)
+    host, port = _host_port(a["nn"])
+    moved = Balancer(host, port,
+                     threshold=float(a.get("threshold", 0.1))).balance()
+    print(f"Balancer moved {moved} blocks")
+    return 0
+
+
+# ------------------------------------------------------------------ clients
+
+
+def cmd_fs(conf, argv: list[str]) -> int:
+    from tpumr.fs.shell import FsShell
+    default_fs = conf.get("fs.default.name")
+    return FsShell(conf, default_fs=default_fs).run(argv)
+
+
+def cmd_job(conf, argv: list[str]) -> int:
+    """≈ bin/hadoop job: -list, -status, -kill, -counters."""
+    from tpumr.ipc.rpc import RpcClient, RpcError
+    jt = conf.get("mapred.job.tracker")
+    if not jt or jt == "local":
+        print("job control needs -jt HOST:PORT", file=sys.stderr)
+        return 255
+    host, port = _host_port(jt)
+    client = RpcClient(host, port)
+    usage = ("Usage: tpumr job -list | -status ID | -kill ID | "
+             "-counters ID | -events ID")
+    if not argv:
+        print(usage, file=sys.stderr)
+        return 255
+    cmd, *rest = argv
+    if cmd != "-list" and not rest:
+        print(usage, file=sys.stderr)
+        return 255
+    try:
+        if cmd == "-list":
+            for jid in client.call("list_jobs"):
+                st = client.call("get_job_status", jid)
+                print(f"{jid}\t{st.get('state')}"
+                      f"\tmaps={st.get('map_progress'):.2f}"
+                      f"\treduces={st.get('reduce_progress'):.2f}")
+            return 0
+        if cmd == "-status":
+            print(json.dumps(client.call("get_job_status", rest[0]),
+                             indent=2, default=str))
+            return 0
+        if cmd == "-counters":
+            print(json.dumps(client.call("get_counters", rest[0]), indent=2,
+                             default=str))
+            return 0
+        if cmd == "-kill":
+            ok = client.call("kill_job", rest[0])
+            print(f"Killed {rest[0]}" if ok
+                  else f"{rest[0]} already finished; not killed")
+            return 0 if ok else 1
+        if cmd == "-events":
+            for ev in client.call("get_map_completion_events",
+                                  rest[0], 0, 100):
+                print(ev)
+            return 0
+    except RpcError as e:
+        print(f"job {cmd}: {e}", file=sys.stderr)
+        return 1
+    print(f"job: unknown option {cmd}", file=sys.stderr)
+    return 255
+
+
+def cmd_pipes(conf, argv: list[str]) -> int:
+    from tpumr.pipes.submitter import main as pipes_main
+    return pipes_main(argv)
+
+
+def cmd_streaming(conf, argv: list[str]) -> int:
+    from tpumr.streaming.stream_job import main as stream_main
+    return stream_main(argv)
+
+
+def cmd_examples(conf, argv: list[str]) -> int:
+    from tpumr.examples import main as ex_main
+    return ex_main(argv)
+
+
+def cmd_version(conf, argv: list[str]) -> int:
+    print(f"tpumr {VERSION}")
+    return 0
+
+
+COMMANDS = {
+    "namenode": cmd_namenode,
+    "datanode": cmd_datanode,
+    "secondarynamenode": cmd_secondarynamenode,
+    "jobtracker": cmd_jobtracker,
+    "tasktracker": cmd_tasktracker,
+    "balancer": cmd_balancer,
+    "fs": cmd_fs,
+    "job": cmd_job,
+    "pipes": cmd_pipes,
+    "streaming": cmd_streaming,
+    "examples": cmd_examples,
+    "version": cmd_version,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    overrides, rest = _parse_generic(argv)
+    if not rest:
+        sys.stderr.write(USAGE)
+        return 255
+    cmd, *args = rest
+    fn = COMMANDS.get(cmd)
+    if fn is None:
+        sys.stderr.write(f"Unknown command: {cmd}\n\n" + USAGE)
+        return 255
+    conf = _conf(overrides)
+    return fn(conf, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

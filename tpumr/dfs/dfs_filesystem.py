@@ -71,6 +71,12 @@ class DistributedFileSystem(FileSystem):
     def rename(self, src: "str | Path", dst: "str | Path") -> bool:
         return self.client.rename(self._p(src), self._p(dst))
 
+    def set_replication(self, path: "str | Path", replication: int) -> bool:
+        return self.client.set_replication(self._p(path), replication)
+
+    def datanode_report(self) -> list[dict]:
+        return self.client.datanode_report()
+
     def get_block_locations(self, path: "str | Path", offset: int,
                             length: int) -> list[BlockLocation]:
         blocks = self.client.nn.call("get_block_locations", self._p(path))

@@ -1,0 +1,327 @@
+"""FsShell — the ``tpumr fs`` command-line file-system client.
+
+≈ the reference's ``org.apache.hadoop.fs.FsShell`` (hadoop-1.0.3
+``src/core/org/apache/hadoop/fs/FsShell.java``): dash-prefixed subcommands
+(``-ls``, ``-put``, ``-cat``, …) resolved against the FileSystem SPI, so
+the same shell drives ``file://``, ``mem://`` and ``tdfs://`` URIs.
+Glob expansion mirrors FsShell's use of ``FileSystem.globStatus``.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Callable
+
+from tpumr.fs.filesystem import FileStatus, FileSystem, Path, get_filesystem
+
+
+class ShellError(Exception):
+    pass
+
+
+class FsShell:
+    """Each ``cmd_*`` method is one dash-command; ``run`` dispatches."""
+
+    def __init__(self, conf: Any = None, default_fs: str | None = None,
+                 out: Any = None, err: Any = None) -> None:
+        self.conf = conf
+        self.default_fs = default_fs
+        self.out = out or sys.stdout
+        self.err = err or sys.stderr
+
+    # ------------------------------------------------------------ helpers
+
+    def _resolve(self, path: str) -> str:
+        if "://" in path:
+            return path
+        if self.default_fs:
+            scheme, _, rest = self.default_fs.partition("://")
+            authority = rest.split("/", 1)[0]
+            if not path.startswith("/"):
+                path = "/" + path
+            return f"{scheme}://{authority}{path}"
+        return path
+
+    def _fs(self, path: str) -> FileSystem:
+        return get_filesystem(self._resolve(path), self.conf)
+
+    def _expand(self, pattern: str) -> list[FileStatus]:
+        """Glob-expand one argument; error if it matches nothing."""
+        full = self._resolve(pattern)
+        fs = get_filesystem(full, self.conf)
+        if any(c in full for c in "*?[{"):
+            matches = fs.glob_status(full)
+            if not matches:
+                raise ShellError(f"{pattern}: No such file or directory")
+            return matches
+        if not fs.exists(full):
+            raise ShellError(f"{pattern}: No such file or directory")
+        return [fs.get_status(full)]
+
+    def _print(self, *a: Any) -> None:
+        print(*a, file=self.out)
+
+    # ------------------------------------------------------------ commands
+
+    def cmd_ls(self, *args: str) -> int:
+        recursive = False
+        paths = [a for a in args if a != "-R"]
+        recursive = len(paths) != len(args)
+        for p in paths or ["/"]:
+            for st in self._expand(p):
+                fs = self._fs(p)
+                items = ([st] if not st.is_dir
+                         else fs.list_status(st.path))
+                self._print(f"Found {len(items)} items") if st.is_dir else None
+                self._ls_items(fs, items, recursive)
+        return 0
+
+    def _ls_items(self, fs: FileSystem, items: list[FileStatus],
+                  recursive: bool) -> None:
+        for it in sorted(items, key=lambda s: str(s.path)):
+            kind = "d" if it.is_dir else "-"
+            mtime = time.strftime("%Y-%m-%d %H:%M",
+                                  time.localtime(it.mtime or 0))
+            repl = getattr(it, "replication", 1) or 1
+            self._print(f"{kind}rw-r--r--  {repl:>2} {it.length:>12} "
+                        f"{mtime} {it.path}")
+            if recursive and it.is_dir:
+                self._ls_items(fs, fs.list_status(it.path), True)
+
+    def cmd_lsr(self, *args: str) -> int:
+        return self.cmd_ls("-R", *args)
+
+    def cmd_mkdir(self, *args: str) -> int:
+        if not args:
+            raise ShellError("-mkdir: missing path")
+        for p in args:
+            self._fs(p).mkdirs(self._resolve(p))
+        return 0
+
+    def cmd_touchz(self, *args: str) -> int:
+        for p in args:
+            full = self._resolve(p)
+            with self._fs(p).create(full) as f:
+                f.write(b"")
+        return 0
+
+    def cmd_cat(self, *args: str) -> int:
+        for p in args:
+            for st in self._expand(p):
+                if st.is_dir:
+                    raise ShellError(f"{st.path}: is a directory")
+                data = get_filesystem(st.path, self.conf).read_bytes(st.path)
+                self.out.write(data.decode("utf-8", errors="replace"))
+        return 0
+
+    def cmd_text(self, *args: str) -> int:
+        """≈ FsShell -text: decodes SequenceFiles, else plain cat."""
+        from tpumr.io import sequencefile
+        for p in args:
+            for st in self._expand(p):
+                fs = get_filesystem(st.path, self.conf)
+                with fs.open(st.path) as f:
+                    head = f.read(len(sequencefile.MAGIC))
+                if head == sequencefile.MAGIC:
+                    with fs.open(st.path) as f:
+                        for k, v in sequencefile.Reader(f):
+                            self._print(f"{k}\t{v}")
+                else:
+                    self.out.write(fs.read_bytes(st.path)
+                                   .decode("utf-8", errors="replace"))
+        return 0
+
+    def cmd_tail(self, *args: str) -> int:
+        for p in args:
+            st = self._expand(p)[0]
+            fs = get_filesystem(st.path, self.conf)
+            data = fs.read_bytes(st.path)
+            self.out.write(data[-1024:].decode("utf-8", errors="replace"))
+        return 0
+
+    def cmd_put(self, *args: str) -> int:
+        if len(args) < 2:
+            raise ShellError("-put: <localsrc...> <dst>")
+        *srcs, dst = args
+        import os
+        dst_full = self._resolve(dst)
+        dst_fs = get_filesystem(dst_full, self.conf)
+        many = len(srcs) > 1 or (dst_fs.exists(dst_full)
+                                 and dst_fs.get_status(dst_full).is_dir)
+        for src in srcs:
+            with open(src, "rb") as f:
+                data = f.read()
+            target = (str(Path(dst_full).child(os.path.basename(src)))
+                      if many else dst_full)
+            dst_fs.write_bytes(target, data)
+        return 0
+
+    cmd_copyFromLocal = cmd_put
+
+    def cmd_get(self, *args: str) -> int:
+        if len(args) != 2:
+            raise ShellError("-get: <src> <localdst>")
+        src, dst = args
+        import os
+        st = self._expand(src)[0]
+        data = get_filesystem(st.path, self.conf).read_bytes(st.path)
+        if os.path.isdir(dst):
+            dst = os.path.join(dst, st.path.name)
+        with open(dst, "wb") as f:
+            f.write(data)
+        return 0
+
+    cmd_copyToLocal = cmd_get
+
+    def cmd_cp(self, *args: str) -> int:
+        if len(args) != 2:
+            raise ShellError("-cp: <src> <dst>")
+        src, dst = self._resolve(args[0]), self._resolve(args[1])
+        sfs, dfs = get_filesystem(src, self.conf), get_filesystem(dst, self.conf)
+        dfs.write_bytes(dst, sfs.read_bytes(src))
+        return 0
+
+    def cmd_mv(self, *args: str) -> int:
+        if len(args) != 2:
+            raise ShellError("-mv: <src> <dst>")
+        src, dst = self._resolve(args[0]), self._resolve(args[1])
+        if not self._fs(args[0]).rename(src, dst):
+            raise ShellError(f"-mv failed: {src} -> {dst}")
+        return 0
+
+    def cmd_rm(self, *args: str) -> int:
+        for p in args:
+            for st in self._expand(p):
+                if st.is_dir:
+                    raise ShellError(f"{st.path}: is a directory (use -rmr)")
+                get_filesystem(st.path, self.conf).delete(st.path)
+                self._print(f"Deleted {st.path}")
+        return 0
+
+    def cmd_rmr(self, *args: str) -> int:
+        for p in args:
+            for st in self._expand(p):
+                get_filesystem(st.path, self.conf).delete(st.path,
+                                                          recursive=True)
+                self._print(f"Deleted {st.path}")
+        return 0
+
+    def cmd_du(self, *args: str) -> int:
+        for p in args or ["/"]:
+            total = 0
+            for st in self._expand(p):
+                fs = get_filesystem(st.path, self.conf)
+                for f in fs.list_files(st.path, recursive=True) \
+                        if st.is_dir else [st]:
+                    self._print(f"{f.length:<12} {f.path}")
+                    total += f.length
+            self._print(f"total {total}")
+        return 0
+
+    def cmd_dus(self, *args: str) -> int:
+        for p in args or ["/"]:
+            for st in self._expand(p):
+                fs = get_filesystem(st.path, self.conf)
+                total = (sum(f.length for f in
+                             fs.list_files(st.path, recursive=True))
+                         if st.is_dir else st.length)
+                self._print(f"{st.path}\t{total}")
+        return 0
+
+    def cmd_count(self, *args: str) -> int:
+        def walk(fs: FileSystem, st: FileStatus) -> tuple[int, int, int]:
+            if not st.is_dir:
+                return 0, 1, st.length
+            ndirs, nfiles, nbytes = 1, 0, 0
+            for child in fs.list_status(st.path):
+                d, f, b = walk(fs, child)
+                ndirs, nfiles, nbytes = ndirs + d, nfiles + f, nbytes + b
+            return ndirs, nfiles, nbytes
+
+        for p in args:
+            for st in self._expand(p):
+                fs = get_filesystem(st.path, self.conf)
+                ndirs, nfiles, nbytes = walk(fs, st)
+                self._print(f"{ndirs:>8} {nfiles:>8} {nbytes:>12} {st.path}")
+        return 0
+
+    def cmd_stat(self, *args: str) -> int:
+        for p in args:
+            st = self._expand(p)[0]
+            self._print(time.strftime("%Y-%m-%d %H:%M:%S",
+                                      time.localtime(st.mtime or 0)))
+        return 0
+
+    def cmd_test(self, *args: str) -> int:
+        """-test -[ezd] <path>: exit 0/1 like the reference."""
+        if len(args) != 2:
+            raise ShellError("-test: -[ezd] <path>")
+        flag, p = args
+        full = self._resolve(p)
+        fs = get_filesystem(full, self.conf)
+        if flag == "-e":
+            return 0 if fs.exists(full) else 1
+        if not fs.exists(full):
+            return 1
+        st = fs.get_status(full)
+        if flag == "-z":
+            return 0 if st.length == 0 else 1
+        if flag == "-d":
+            return 0 if st.is_dir else 1
+        raise ShellError(f"-test: unknown flag {flag}")
+
+    def cmd_setrep(self, *args: str) -> int:
+        """-setrep [-w] <rep> <path> (tdfs only; no-op elsewhere)."""
+        args = [a for a in args if a != "-w"]
+        if len(args) != 2:
+            raise ShellError("-setrep: <rep> <path>")
+        rep, p = int(args[0]), self._resolve(args[1])
+        fs = get_filesystem(p, self.conf)
+        set_rep = getattr(fs, "set_replication", None)
+        if set_rep is not None:
+            set_rep(p, rep)
+            self._print(f"Replication {rep} set: {p}")
+        return 0
+
+    def cmd_df(self, *args: str) -> int:
+        for p in args or ["/"]:
+            fs = self._fs(p)
+            report = getattr(fs, "datanode_report", None)
+            if report is None:
+                self._print("df: only meaningful on tdfs://")
+                continue
+            for dn in report():
+                self._print(f"{dn['addr']}\tcapacity={dn['capacity']}"
+                            f"\tused={dn['used']}")
+        return 0
+
+    # ------------------------------------------------------------ dispatch
+
+    def run(self, argv: list[str]) -> int:
+        if not argv:
+            self._usage()
+            return 255
+        cmd, *rest = argv
+        if not cmd.startswith("-"):
+            self.err.write(f"fs: unknown command {cmd}\n")
+            self._usage()
+            return 255
+        fn: Callable[..., int] | None = getattr(self, "cmd_" + cmd[1:], None)
+        if fn is None:
+            self.err.write(f"fs: unknown command {cmd}\n")
+            self._usage()
+            return 255
+        try:
+            return fn(*rest) or 0
+        except ShellError as e:
+            self.err.write(f"fs {cmd}: {e}\n")
+            return 1
+        except FileNotFoundError as e:
+            self.err.write(f"fs {cmd}: {e}\n")
+            return 1
+
+    def _usage(self) -> None:
+        cmds = sorted(m[4:] for m in dir(self) if m.startswith("cmd_"))
+        self.err.write("Usage: tpumr fs [-fs <uri>] -<cmd> ...\nCommands: "
+                       + " ".join("-" + c for c in cmds) + "\n")

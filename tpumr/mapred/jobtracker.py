@@ -128,6 +128,12 @@ class JobMaster:
             self.history.job_submitted(jip)
             return str(job_id)
 
+    def list_jobs(self) -> list[str]:
+        """All known job ids ≈ JobSubmissionProtocol.jobsToComplete +
+        getAllJobs (bin/hadoop job -list)."""
+        with self.lock:
+            return sorted(self.jobs)
+
     def get_job_status(self, job_id: str) -> dict:
         jip = self._job(job_id)
         return jip.status_dict()
@@ -149,7 +155,12 @@ class JobMaster:
         } for t in tips]
 
     def kill_job(self, job_id: str) -> bool:
+        from tpumr.mapred.job_in_progress import JobState
         jip = self._job(job_id)
+        with jip.lock:
+            terminal = jip.state in JobState.TERMINAL
+        if terminal:  # ≈ JobTracker.killJob: no-op on finished jobs
+            return False
         jip.kill()
         self._finalize_job(jip)
         return True
