@@ -1,0 +1,121 @@
+"""Examples tier ≈ the reference's src/examples inventory (SURVEY.md §2.4):
+terasort family, sort, secondarysort, join, sleep, randomwriter."""
+
+import numpy as np
+
+from tpumr.cli import main as cli_main
+from tpumr.fs import get_filesystem
+from tpumr.io import sequencefile
+
+
+def _read_seq_parts(fs, out_dir):
+    recs = []
+    for st in sorted(fs.list_files(out_dir), key=lambda s: str(s.path)):
+        if not st.path.name.startswith("part"):
+            continue
+        with fs.open(st.path) as f:
+            recs.extend(sequencefile.Reader(f))
+    return recs
+
+
+class TestTeraSort:
+    def test_teragen_terasort_teravalidate(self, capsys):
+        fs = get_filesystem("mem:///")
+        assert cli_main(["examples", "teragen", "1000", "mem:///ts/gen",
+                         "-m", "3"]) == 0
+        recs = _read_seq_parts(fs, "/ts/gen")
+        assert len(recs) == 1000
+        assert all(len(k) == 10 and len(v) == 90 for k, v in recs)
+        # deterministic row ids present
+        rows = sorted(v[:10] for _, v in recs)
+        assert rows[0] == b"0000000000" and rows[-1] == b"0000000999"
+
+        assert cli_main(["examples", "terasort", "mem:///ts/gen",
+                         "mem:///ts/sorted", "-r", "3"]) == 0
+        out = _read_seq_parts(fs, "/ts/sorted")
+        assert len(out) == 1000
+        keys = [k for k, _ in out]
+        assert keys == sorted(keys), "parts concatenated must be sorted"
+
+        assert cli_main(["examples", "teravalidate", "mem:///ts/sorted",
+                         "mem:///ts/report"]) == 0
+        assert "globally sorted" in capsys.readouterr().out
+
+    def test_teravalidate_catches_misorder(self, capsys):
+        fs = get_filesystem("mem:///")
+        # two part files with an inverted cross-part boundary
+        for name, keys in (("part-00000", [b"zzz", b"aaa"]),
+                           ("part-00001", [b"mmm"])):
+            with fs.create(f"/tv/bad/{name}") as f:
+                w = sequencefile.Writer(f)
+                for k in keys:
+                    w.append(k, b"x")
+                w.close()
+        assert cli_main(["examples", "teravalidate", "mem:///tv/bad",
+                         "mem:///tv/report"]) == 1
+        assert "FAILED" in capsys.readouterr().out
+
+
+class TestSortAndRandomWriter:
+    def test_randomwriter_then_total_order_sort(self):
+        fs = get_filesystem("mem:///")
+        assert cli_main(["examples", "randomwriter", "mem:///rw/data",
+                         "-m", "2", "--bytes-per-map", "20000"]) == 0
+        inp = _read_seq_parts(fs, "/rw/data")
+        assert sum(len(k) + len(v) for k, v in inp) >= 40000
+        assert cli_main(["examples", "sort", "mem:///rw/data",
+                         "mem:///rw/sorted", "-r", "2",
+                         "--total-order"]) == 0
+        out = _read_seq_parts(fs, "/rw/sorted")
+        assert len(out) == len(inp)
+        keys = [k for k, _ in out]
+        assert keys == sorted(keys)
+
+
+class TestSecondarySort:
+    def test_values_sorted_within_group(self):
+        fs = get_filesystem("mem:///")
+        rng = np.random.default_rng(5)
+        lines = []
+        for first in (3, 1, 2):
+            for second in rng.permutation(20):
+                lines.append(f"{first} {int(second)}")
+        rng.shuffle(lines)
+        fs.write_bytes("/ss/in.txt", ("\n".join(lines) + "\n").encode())
+        assert cli_main(["examples", "secondarysort", "mem:///ss/in.txt",
+                         "mem:///ss/out"]) == 0
+        text = fs.read_bytes("/ss/out/part-00000").decode()
+        got = {}
+        for line in text.splitlines():
+            k, _, v = line.partition("\t")
+            got[int(k)] = v
+        assert sorted(got) == [1, 2, 3]
+        for v in got.values():
+            import ast
+            seconds = ast.literal_eval(v)
+            assert seconds == sorted(seconds), "secondary order violated"
+
+
+class TestJoin:
+    def test_inner_and_outer(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/j/left.txt",
+                       b"k1\tL|ankara\nk2\tL|oslo\nk3\tL|lima\n")
+        fs.write_bytes("/j/right.txt",
+                       b"k1\tR|tr\nk3\tR|pe\nk4\tR|xx\n")
+        assert cli_main(["examples", "join", "mem:///j/left.txt",
+                         "mem:///j/right.txt", "mem:///j/inner"]) == 0
+        text = fs.read_bytes("/j/inner/part-00000").decode()
+        rows = dict(line.split("\t", 1) for line in text.splitlines())
+        assert rows == {"k1": "ankara\ttr", "k3": "lima\tpe"}
+        assert cli_main(["examples", "join", "mem:///j/left.txt",
+                         "mem:///j/right.txt", "mem:///j/outer",
+                         "--outer"]) == 0
+        text = fs.read_bytes("/j/outer/part-00000").decode()
+        assert len(text.splitlines()) == 4  # k1 k2 k3 k4
+
+
+class TestSleep:
+    def test_sleep_runs(self):
+        assert cli_main(["examples", "sleep", "-m", "2", "-r", "1",
+                         "--map-ms", "1", "--reduce-ms", "1"]) == 0

@@ -286,8 +286,19 @@ def main(argv: list[str] | None = None) -> int:
     if fn is None:
         sys.stderr.write(f"Unknown command: {cmd}\n\n" + USAGE)
         return 255
-    conf = _conf(overrides)
-    return fn(conf, args)
+    if not overrides:
+        return fn(_conf(overrides), args)
+    # generic options must reach confs the subcommand builds itself
+    # (examples/pipes/streaming construct their own JobConf) — install them
+    # as a default resource layer ≈ GenericOptionsParser merging into the
+    # job conf; removed afterwards so repeated in-process invocations
+    # (tests, embedding) don't accumulate layers
+    from tpumr.core.configuration import Configuration
+    Configuration.add_default_resource(overrides)
+    try:
+        return fn(_conf(overrides), args)
+    finally:
+        Configuration._default_resources.pop()
 
 
 if __name__ == "__main__":

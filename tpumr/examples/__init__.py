@@ -29,15 +29,12 @@ def programs() -> dict[str, str]:
 def _load_all() -> None:
     # import for registration side effects
     from tpumr.examples import basic  # noqa: F401
-    try:
-        from tpumr.examples import terasort  # noqa: F401
-        from tpumr.examples import sort  # noqa: F401
-        from tpumr.examples import secondary_sort  # noqa: F401
-        from tpumr.examples import join  # noqa: F401
-        from tpumr.examples import sleep  # noqa: F401
-        from tpumr.examples import random_writer  # noqa: F401
-    except ImportError:  # pragma: no cover - during staged build
-        pass
+    from tpumr.examples import join  # noqa: F401
+    from tpumr.examples import random_writer  # noqa: F401
+    from tpumr.examples import secondary_sort  # noqa: F401
+    from tpumr.examples import sleep  # noqa: F401
+    from tpumr.examples import sort  # noqa: F401
+    from tpumr.examples import terasort  # noqa: F401
 
 
 def main(argv: list[str]) -> int:

@@ -72,6 +72,19 @@ def load_npy(fs, path: str) -> np.ndarray:
     return np.load(io.BytesIO(fs.read_bytes(path)))
 
 
+def load_npy_rows(fs, path: str, k: int) -> np.ndarray:
+    """First ``k`` rows via a ranged read — the driver must not pull the
+    full (possibly 100M-point) array just to seed centroids."""
+    from tpumr.mapred.input_formats import read_npy_header
+    with fs.open(path) as f:
+        shape, dtype, data_start = read_npy_header(f)
+        n_rows = min(k, shape[0])
+        row_bytes = int(np.prod(shape[1:], dtype=np.int64)) * dtype.itemsize
+        f.seek(data_start)
+        raw = f.read(n_rows * row_bytes)
+    return np.frombuffer(raw, dtype=dtype).reshape((n_rows,) + shape[1:])
+
+
 @register("wordcount", "count words in the input files")
 def wordcount(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(prog="tpumr examples wordcount")
@@ -187,8 +200,8 @@ def kmeans(argv: list[str]) -> int:
     fs = get_filesystem(args.output)
     out = args.output.rstrip("/")
     cent_path = f"{out}/centroids.npy"
-    pts = load_npy(get_filesystem(args.points), args.points)
-    save_npy(fs, cent_path, pts[: args.k].astype(np.float32))
+    seeds = load_npy_rows(get_filesystem(args.points), args.points, args.k)
+    save_npy(fs, cent_path, seeds.astype(np.float32))
     centroids = None
     for it in range(args.iterations):
         clear_centroid_cache()

@@ -1,0 +1,71 @@
+"""RandomWriter — bulk random SequenceFile generation.
+
+≈ ``src/examples/org/apache/hadoop/examples/RandomWriter.java``: map-only
+job, each map writes ~``bytes_per_map`` of random key/value records to its
+own output file (the standard input generator for the Sort benchmark).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from tpumr.examples import register
+from tpumr.fs import get_filesystem
+from tpumr.mapred.api import Mapper
+from tpumr.mapred.input_formats import NLineInputFormat
+from tpumr.mapred.job_client import run_job
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.output_formats import SequenceFileOutputFormat
+
+
+class RandomWriteMapper(Mapper):
+    """Input record "<seed> <total_bytes>": emits random-sized random
+    records until total_bytes is reached (key 10-1000 bytes, value
+    0-10000 bytes ≈ RandomWriter defaults)."""
+
+    def configure(self, conf) -> None:
+        self._min_key = conf.get_int("tpumr.randomwriter.min.key", 10)
+        self._max_key = conf.get_int("tpumr.randomwriter.max.key", 100)
+        self._min_val = conf.get_int("tpumr.randomwriter.min.value", 0)
+        self._max_val = conf.get_int("tpumr.randomwriter.max.value", 1000)
+
+    def map(self, key, value, output, reporter):
+        s = value.decode() if isinstance(value, (bytes, bytearray)) else value
+        seed, total = (int(x) for x in s.split())
+        rng = np.random.default_rng(seed)
+        written = 0
+        while written < total:
+            klen = int(rng.integers(self._min_key, self._max_key + 1))
+            vlen = int(rng.integers(self._min_val, self._max_val + 1))
+            kb = rng.integers(0, 256, size=klen, dtype=np.uint8).tobytes()
+            vb = rng.integers(0, 256, size=vlen, dtype=np.uint8).tobytes()
+            output.collect(kb, vb)
+            written += klen + vlen
+
+
+@register("randomwriter", "each map writes random SequenceFile records")
+def randomwriter(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr examples randomwriter")
+    ap.add_argument("output")
+    ap.add_argument("-m", "--maps", type=int, default=2)
+    ap.add_argument("--bytes-per-map", type=int, default=1 << 20)
+    args = ap.parse_args(argv)
+    out = args.output.rstrip("/")
+    inp = f"{out}.rw-in/maps.txt"
+    get_filesystem(inp).write_bytes(
+        inp, "".join(f"{1234 + m} {args.bytes_per_map}\n"
+                     for m in range(args.maps)).encode())
+    conf = JobConf()
+    conf.set_job_name("random-writer")
+    conf.set_input_paths(inp)
+    conf.set_output_path(out)
+    conf.set_input_format(NLineInputFormat)
+    conf.set("mapred.line.input.format.linespermap", 1)
+    conf.set_mapper_class(RandomWriteMapper)
+    conf.set_output_format(SequenceFileOutputFormat)
+    conf.set_num_reduce_tasks(0)
+    ok = run_job(conf).successful
+    get_filesystem(out).delete(f"{out}.rw-in", recursive=True)
+    return 0 if ok else 1

@@ -1,0 +1,75 @@
+"""SecondarySort — value ordering inside a reduce group.
+
+≈ ``src/examples/org/apache/hadoop/examples/SecondarySort.java``: the map
+key is the composite ``(first, second)``; partitioning and reduce grouping
+use only ``first`` (FirstPartitioner + FirstGroupingComparator), while the
+sort comparator orders the full pair — so each reduce group sees its
+values with ``second`` ascending.
+"""
+
+from __future__ import annotations
+
+import argparse
+import zlib
+
+from tpumr.examples import register
+from tpumr.io.writable import serialize
+from tpumr.mapred.api import Mapper, Partitioner, Reducer
+from tpumr.mapred.input_formats import TextInputFormat
+from tpumr.mapred.job_client import run_job
+from tpumr.mapred.jobconf import JobConf
+
+
+class FirstPartitioner(Partitioner):
+    """≈ SecondarySort.FirstPartitioner: hash only the natural key."""
+
+    def get_partition(self, key, value, num_partitions):
+        return zlib.crc32(serialize(key[0])) % num_partitions
+
+
+class FirstGroupingComparator:
+    """Groups composite keys by their first element (the grouping-comparator
+    seam, JobConf.set_output_value_grouping_comparator)."""
+
+    def sort_key(self, kbytes: bytes):
+        from tpumr.io.writable import deserialize
+        return deserialize(kbytes)[0]
+
+
+class PairMapper(Mapper):
+    """Line "<first> <second>" → key (first, second), value second."""
+
+    def map(self, key, value, output, reporter):
+        s = value.decode() if isinstance(value, (bytes, bytearray)) else value
+        parts = s.split()
+        if len(parts) >= 2:
+            first, second = int(parts[0]), int(parts[1])
+            output.collect((first, second), second)
+
+
+class SortedValuesReducer(Reducer):
+    """Emits (first, [seconds in ascending order]) — the secondary-sort
+    guarantee made visible in the output."""
+
+    def reduce(self, key, values, output, reporter):
+        output.collect(key[0], list(values))
+
+
+@register("secondarysort", "sort values within reduce groups")
+def secondarysort(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr examples secondarysort")
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("-r", "--reduces", type=int, default=1)
+    args = ap.parse_args(argv)
+    conf = JobConf()
+    conf.set_job_name("secondarysort")
+    conf.set_input_paths(*args.input.split(","))
+    conf.set_output_path(args.output)
+    conf.set_input_format(TextInputFormat)
+    conf.set_mapper_class(PairMapper)
+    conf.set_reducer_class(SortedValuesReducer)
+    conf.set_partitioner_class(FirstPartitioner)
+    conf.set_output_value_grouping_comparator(FirstGroupingComparator)
+    conf.set_num_reduce_tasks(args.reduces)
+    return 0 if run_job(conf).successful else 1

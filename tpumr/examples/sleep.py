@@ -1,0 +1,83 @@
+"""SleepJob — a do-nothing job for exercising the scheduler.
+
+≈ ``src/examples/org/apache/hadoop/examples/SleepJob.java``: N maps and R
+reduces that just sleep — the tool the reference community used to test
+slot accounting, speculative execution, and scheduler behavior. Here it
+also doubles as a hybrid-scheduler probe: with ``--tpu`` the job registers
+the no-op device kernel so both slot pools are exercised.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Iterable
+
+from tpumr.examples import register
+from tpumr.mapred.api import Mapper, Reducer
+from tpumr.mapred.input_formats import NLineInputFormat
+from tpumr.mapred.job_client import run_job
+from tpumr.mapred.jobconf import JobConf
+from tpumr.fs import get_filesystem
+from tpumr.ops.registry import KernelMapper, register_kernel
+
+
+class SleepMapper(Mapper):
+    def configure(self, conf) -> None:
+        self._ms = conf.get_int("tpumr.sleep.map.ms", 100)
+
+    def map(self, key, value, output, reporter):
+        time.sleep(self._ms / 1000.0)
+        output.collect(0, 0)
+
+
+class SleepKernel(KernelMapper):
+    name = "sleep"
+    cpu_mapper_class = SleepMapper
+
+    def map_batch(self, batch, conf, task) -> Iterable[tuple]:
+        time.sleep(conf.get_int("tpumr.sleep.map.ms", 100) / 1000.0)
+        yield 0, 0
+
+
+register_kernel(SleepKernel())
+
+
+class SleepReducer(Reducer):
+    def configure(self, conf) -> None:
+        self._ms = conf.get_int("tpumr.sleep.reduce.ms", 100)
+
+    def reduce(self, key, values, output, reporter):
+        for _ in values:
+            pass
+        time.sleep(self._ms / 1000.0)
+
+
+@register("sleep", "N sleeping maps + R sleeping reduces (scheduler probe)")
+def sleep(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr examples sleep")
+    ap.add_argument("-m", "--maps", type=int, default=4)
+    ap.add_argument("-r", "--reduces", type=int, default=1)
+    ap.add_argument("--map-ms", type=int, default=100)
+    ap.add_argument("--reduce-ms", type=int, default=100)
+    ap.add_argument("--tpu", action="store_true",
+                    help="register the device kernel (hybrid-scheduler probe)")
+    ap.add_argument("--work", default="mem:///tmp/sleep")
+    args = ap.parse_args(argv)
+    inp = f"{args.work.rstrip('/')}/in.txt"
+    fs = get_filesystem(inp)
+    fs.write_bytes(inp, b"".join(b"%d\n" % i for i in range(args.maps)))
+    conf = JobConf()
+    conf.set_job_name("sleep")
+    conf.set_input_paths(inp)
+    conf.set_output_path(f"{args.work.rstrip('/')}/out")
+    conf.set_input_format(NLineInputFormat)
+    conf.set("mapred.line.input.format.linespermap", 1)
+    conf.set("tpumr.sleep.map.ms", args.map_ms)
+    conf.set("tpumr.sleep.reduce.ms", args.reduce_ms)
+    conf.set_mapper_class(SleepMapper)
+    if args.tpu:
+        conf.set_map_kernel("sleep")
+    conf.set_reducer_class(SleepReducer)
+    conf.set_num_reduce_tasks(args.reduces)
+    return 0 if run_job(conf).successful else 1

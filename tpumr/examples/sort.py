@@ -1,0 +1,46 @@
+"""Sort — the generic sort benchmark job.
+
+≈ ``src/examples/org/apache/hadoop/examples/Sort.java``: identity map +
+identity reduce over SequenceFile records; with ``--total-order`` the
+sampled range partitioner makes the output globally sorted (the reference
+wires lib/InputSampler + TotalOrderPartitioner the same way).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from tpumr.examples import register
+from tpumr.mapred.api import IdentityMapper, IdentityReducer
+from tpumr.mapred.input_formats import SequenceFileInputFormat
+from tpumr.mapred.job_client import run_job
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.output_formats import SequenceFileOutputFormat
+from tpumr.mapred.total_order import (TotalOrderPartitioner, sample_input,
+                                      write_partition_file)
+
+
+@register("sort", "sort SequenceFile records (identity map/reduce)")
+def sort(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr examples sort")
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("-r", "--reduces", type=int, default=2)
+    ap.add_argument("--total-order", action="store_true",
+                    help="globally sort via sampled range partitioning")
+    args = ap.parse_args(argv)
+    conf = JobConf()
+    conf.set_job_name("sorter")
+    conf.set_input_paths(*args.input.split(","))
+    conf.set_output_path(args.output)
+    conf.set_input_format(SequenceFileInputFormat)
+    conf.set_output_format(SequenceFileOutputFormat)
+    conf.set_mapper_class(IdentityMapper)
+    conf.set_reducer_class(IdentityReducer)
+    conf.set_num_reduce_tasks(args.reduces)
+    if args.total_order:
+        samples = sample_input(conf, num_samples=1000)
+        write_partition_file(conf, args.output.rstrip("/") + ".partitions",
+                             samples, args.reduces)
+        conf.set_partitioner_class(TotalOrderPartitioner)
+    return 0 if run_job(conf).successful else 1

@@ -1,0 +1,205 @@
+"""TeraGen / TeraSort / TeraValidate.
+
+≈ the reference's ``src/examples/org/apache/hadoop/examples/terasort/``
+(TeraGen.java, TeraSort.java, TeraValidate.java): 100-byte records — a
+10-byte key plus a 90-byte payload — generated deterministically, globally
+sorted via sampled range partitioning (the reference's TeraSort samples in
+TeraInputFormat and range-partitions with a trie; here the shared
+TotalOrderPartitioner does the bisect), then validated for global order.
+
+Records live in SequenceFiles (the framework's splittable container)
+rather than the reference's fixed-width text lines; keys are raw ``bytes``
+so byte-lexicographic order — the RawComparator fast path, fixed-width
+keys being the device-sortable case called out in SURVEY.md §7 — is the
+sort order.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from tpumr.examples import register
+from tpumr.fs import get_filesystem
+from tpumr.mapred.api import (IdentityReducer, Mapper, RawComparator,
+                              Reducer)
+from tpumr.mapred.input_formats import (NLineInputFormat,
+                                        SequenceFileInputFormat)
+from tpumr.mapred.job_client import run_job
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.output_formats import SequenceFileOutputFormat
+from tpumr.mapred.total_order import (TotalOrderPartitioner, sample_input,
+                                      write_partition_file)
+
+KEY_LEN = 10
+VALUE_LEN = 90
+_PRINTABLE_LO, _PRINTABLE_HI = 0x20, 0x7E  # ' '..'~' ≈ TeraGen key alphabet
+
+
+def gen_records(row_start: int, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized record block: (n, 10) key bytes + (n, 90) value bytes,
+    deterministic in the absolute row number (≈ TeraGen's seeded
+    RandomGenerator — one whole block per call, no per-record loop)."""
+    rng = np.random.default_rng(0xC0FFEE ^ row_start)
+    keys = rng.integers(_PRINTABLE_LO, _PRINTABLE_HI + 1,
+                        size=(n, KEY_LEN), dtype=np.uint8)
+    values = np.full((n, VALUE_LEN), ord("."), dtype=np.uint8)
+    for i in range(n):  # row-id prefix "rrrrrrrrrr" ≈ TeraGen's row field
+        values[i, :10] = np.frombuffer(
+            b"%010d" % (row_start + i), dtype=np.uint8)
+    return keys, values
+
+
+class TeraGenMapper(Mapper):
+    """Input record: ``"<row_start> <num_rows>"``; emits the block."""
+
+    def map(self, key, value, output, reporter):
+        s = value.decode() if isinstance(value, (bytes, bytearray)) else value
+        row_start, n = (int(x) for x in s.split())
+        keys, values = gen_records(row_start, n)
+        for i in range(n):
+            output.collect(keys[i].tobytes(), values[i].tobytes())
+
+
+class TeraSortMapper(Mapper):
+    """Identity — the sort happens in the framework's sort/merge path."""
+
+    def map(self, key, value, output, reporter):
+        output.collect(key, value)
+
+
+class TeraValidateMapper(Mapper):
+    """Per-split order check; emits (split-ordinal, (first, last, errors))
+    at close so the single reducer can check cross-part boundaries.
+    The part index rides on the key so reduce order == file order."""
+
+    def configure(self, conf) -> None:
+        self._first: bytes | None = None
+        self._last: bytes | None = None
+        self._errors = 0
+        self._out = None
+        self._ordinal = conf.get_int("tpumr.task.partition", 0)
+
+    def map(self, key, value, output, reporter):
+        self._out = output
+        if self._first is None:
+            self._first = key
+        elif key < self._last:
+            self._errors += 1
+        self._last = key
+
+    def close(self) -> None:
+        if self._out is not None and self._first is not None:
+            self._out.collect(self._ordinal,
+                              (self._first, self._last, self._errors))
+
+
+class TeraValidateReducer(Reducer):
+    """One group per split, keys ascending = file order; checks boundaries."""
+
+    def __init__(self) -> None:
+        self._prev_last: bytes | None = None
+        self._bad = 0
+
+    def reduce(self, key, values, output, reporter):
+        for first, last, errors in values:
+            if errors:
+                self._bad += errors
+                output.collect("misordered-in-part", errors)
+            if self._prev_last is not None and first < self._prev_last:
+                self._bad += 1
+                output.collect("misordered-across-parts", 1)
+            self._prev_last = last
+
+    def close(self) -> None:
+        pass
+
+
+@register("teragen", "generate 100-byte terasort records")
+def teragen(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr examples teragen")
+    ap.add_argument("num_rows", type=int)
+    ap.add_argument("output")
+    ap.add_argument("-m", "--maps", type=int, default=2)
+    args = ap.parse_args(argv)
+    out = args.output.rstrip("/")
+    fs = get_filesystem(out)
+    inp = f"{out}.teragen-in/rows.txt"
+    per = args.num_rows // args.maps
+    lines, start = [], 0
+    for m in range(args.maps):
+        n = per + (args.num_rows - per * args.maps if m == args.maps - 1
+                   else 0)
+        lines.append(f"{start} {n}\n")
+        start += n
+    get_filesystem(inp).write_bytes(inp, "".join(lines).encode())
+    conf = JobConf()
+    conf.set_job_name("teragen")
+    conf.set_input_paths(inp)
+    conf.set_output_path(out)
+    conf.set_input_format(NLineInputFormat)
+    conf.set("mapred.line.input.format.linespermap", 1)
+    conf.set_mapper_class(TeraGenMapper)
+    conf.set_output_format(SequenceFileOutputFormat)
+    conf.set_num_reduce_tasks(0)
+    ok = run_job(conf).successful
+    fs.delete(f"{out}.teragen-in", recursive=True)
+    return 0 if ok else 1
+
+
+@register("terasort", "globally sort terasort records")
+def terasort(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr examples terasort")
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("-r", "--reduces", type=int, default=2)
+    args = ap.parse_args(argv)
+    conf = JobConf()
+    conf.set_job_name("terasort")
+    conf.set_input_paths(args.input)
+    conf.set_output_path(args.output)
+    conf.set_input_format(SequenceFileInputFormat)
+    conf.set_mapper_class(TeraSortMapper)
+    conf.set_reducer_class(IdentityReducer)
+    conf.set_output_format(SequenceFileOutputFormat)
+    conf.set_output_key_comparator_class(RawComparator)
+    conf.set_num_reduce_tasks(args.reduces)
+    samples = sample_input(conf, num_samples=1000)
+    write_partition_file(conf, args.output.rstrip("/") + ".partitions",
+                         samples, args.reduces)
+    conf.set_partitioner_class(TotalOrderPartitioner)
+    return 0 if run_job(conf).successful else 1
+
+
+@register("teravalidate", "validate that terasort output is globally sorted")
+def teravalidate(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr examples teravalidate")
+    ap.add_argument("input", help="terasort output directory")
+    ap.add_argument("output")
+    args = ap.parse_args(argv)
+    conf = JobConf()
+    conf.set_job_name("teravalidate")
+    parts = sorted(
+        str(st.path) for st in get_filesystem(args.input)
+        .list_files(args.input) if st.path.name.startswith("part"))
+    conf.set_input_paths(*parts)
+    conf.set_output_path(args.output)
+    conf.set_input_format(SequenceFileInputFormat)
+    conf.set("mapred.min.split.size", 1 << 60)  # one split per part file
+    conf.set_mapper_class(TeraValidateMapper)
+    conf.set_reducer_class(TeraValidateReducer)
+    conf.set_num_reduce_tasks(1)
+    if not run_job(conf).successful:
+        return 1
+    fs = get_filesystem(args.output)
+    bad = [line for st in fs.list_files(args.output)
+           if st.path.name.startswith("part")
+           for line in fs.read_bytes(st.path).decode().splitlines()]
+    if bad:
+        print("VALIDATION FAILED:")
+        for b in bad:
+            print(" ", b)
+        return 1
+    print("Output is globally sorted.")
+    return 0

@@ -136,8 +136,11 @@ class FsShell:
         for p in args:
             st = self._expand(p)[0]
             fs = get_filesystem(st.path, self.conf)
-            data = fs.read_bytes(st.path)
-            self.out.write(data[-1024:].decode("utf-8", errors="replace"))
+            with fs.open(st.path) as f:
+                if st.length > 1024:
+                    f.seek(st.length - 1024)
+                data = f.read()
+            self.out.write(data.decode("utf-8", errors="replace"))
         return 0
 
     def cmd_put(self, *args: str) -> int:
@@ -160,16 +163,20 @@ class FsShell:
     cmd_copyFromLocal = cmd_put
 
     def cmd_get(self, *args: str) -> int:
-        if len(args) != 2:
-            raise ShellError("-get: <src> <localdst>")
-        src, dst = args
+        if len(args) < 2:
+            raise ShellError("-get: <src...> <localdst>")
+        *srcs, dst = args
         import os
-        st = self._expand(src)[0]
-        data = get_filesystem(st.path, self.conf).read_bytes(st.path)
-        if os.path.isdir(dst):
-            dst = os.path.join(dst, st.path.name)
-        with open(dst, "wb") as f:
-            f.write(data)
+        matches = [st for s in srcs for st in self._expand(s)]
+        if len(matches) > 1 and not os.path.isdir(dst):
+            raise ShellError(f"-get: {len(matches)} sources but {dst} "
+                             "is not a directory")
+        for st in matches:
+            data = get_filesystem(st.path, self.conf).read_bytes(st.path)
+            target = (os.path.join(dst, st.path.name)
+                      if os.path.isdir(dst) else dst)
+            with open(target, "wb") as f:
+                f.write(data)
         return 0
 
     cmd_copyToLocal = cmd_get

@@ -149,6 +149,16 @@ class JobConf(Configuration):
                              DeserializingComparator)
         return cls()
 
+    def set_output_value_grouping_comparator(self, cls: type) -> None:
+        """≈ JobConf.setOutputValueGroupingComparator — the secondary-sort
+        seam: reduce groups run under this comparator while the merge order
+        stays the output-key comparator's."""
+        self.set_class("mapred.output.value.groupfn.class", cls)
+
+    def get_output_value_grouping_comparator(self) -> Any:
+        cls = self.get_class("mapred.output.value.groupfn.class")
+        return cls() if cls is not None else None
+
     def set_map_runner_class(self, cls: type) -> None:
         """≈ JobConf.setMapRunnerClass (CPU path)."""
         self.set_class("mapred.map.runner.class", cls)

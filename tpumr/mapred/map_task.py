@@ -192,6 +192,19 @@ class MapOutputBuffer:
         return final_path, index
 
 
+def localize_task_conf(conf: Any, task: Task) -> Any:
+    """Per-attempt conf copy with the task's identity keys set ≈
+    Task.localizeConfiguration (mapred.task.id / mapred.task.partition /
+    mapred.task.is.map). A copy, not a mutation — tasks share the job conf
+    and may run concurrently in one process."""
+    from tpumr.mapred.jobconf import JobConf
+    local = JobConf(conf)
+    local.set("tpumr.task.attempt.id", str(task.attempt_id))
+    local.set("tpumr.task.partition", task.partition)
+    local.set("tpumr.task.is.map", task.is_map)
+    return local
+
+
 def run_map_task(conf: Any, task: Task, local_dir: str,
                  reporter: Reporter | None = None,
                  status: Any = None) -> tuple[str, dict]:
@@ -203,6 +216,7 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     committer work dir instead (reference behavior: NewDirectOutputCollector).
     """
     reporter = reporter or Reporter()
+    conf = localize_task_conf(conf, task)
     in_fmt = new_instance(conf.get_input_format(), conf)
     split = InputSplit.from_dict(task.split) if task.split else None
     t0 = time.time()

@@ -32,8 +32,12 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
     """Execute one reduce attempt. ``fetch(map_index, partition)`` returns the
     sorted segment of map ``map_index`` for this reduce's partition."""
     reporter = reporter or Reporter()
+    from tpumr.mapred.map_task import localize_task_conf
+    conf = localize_task_conf(conf, task)
     comparator = conf.get_output_key_comparator()
     sk = comparator.sort_key
+    grouping = conf.get_output_value_grouping_comparator()
+    gk = grouping.sort_key if grouping is not None else sk
 
     # shuffle: gather all map segments (copy phase ≈ ReduceCopier.fetchOutputs)
     segments: list[Iterable[tuple[bytes, bytes]]] = []
@@ -60,7 +64,7 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
 
     collector = OutputCollector(emit)
     try:
-        for key, values in group_by_key(merged, sk, reporter):
+        for key, values in group_by_key(merged, gk, reporter):
             reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                                   TaskCounter.REDUCE_INPUT_GROUPS)
             reducer.reduce(key, values, collector, reporter)
