@@ -1,0 +1,230 @@
+"""Fair + capacity schedulers against fakes ≈ the reference's contrib
+scheduler tests (TestFairScheduler / TestCapacityScheduler drive the
+scheduler through the TaskTrackerManager seam; SURVEY.md §2.4). Ours are
+additionally TPU-aware — asserted explicitly."""
+
+from tpumr.contrib.capacity import CapacityScheduler
+from tpumr.contrib.fairscheduler import FairScheduler, pool_of
+from tpumr.mapred.ids import JobID
+from tpumr.mapred.job_in_progress import JobInProgress
+from tpumr.mapred.jobconf import JobConf
+
+from test_scheduler import FakeManager, make_job, tracker_status
+
+
+def make_fair(jobs, n_trackers=1, **conf_kv):
+    sched = FairScheduler()
+    conf = JobConf()
+    for k, v in conf_kv.items():
+        conf.set(k, v)
+    sched.configure(conf)
+    sched.set_manager(FakeManager(jobs, n_trackers))
+    return sched
+
+
+def make_capacity(jobs, n_trackers=1, **conf_kv):
+    sched = CapacityScheduler()
+    conf = JobConf()
+    for k, v in conf_kv.items():
+        conf.set(k, v)
+    sched.configure(conf)
+    sched.set_manager(FakeManager(jobs, n_trackers))
+    return sched
+
+
+def make_pool_job(pool, job_num, n_maps=8, kernel=False, n_reduces=0):
+    conf = {"mapred.reduce.tasks": n_reduces,
+            "mapred.fairscheduler.pool": pool,
+            "mapred.reduce.slowstart.completed.maps": 0.0}
+    if kernel:
+        conf["tpumr.map.kernel"] = "kmeans-assign"
+    splits = [{"locations": []} for _ in range(n_maps)]
+    return JobInProgress(JobID("test", job_num), conf, splits)
+
+
+def make_queue_job(queue, job_num, n_maps=8, kernel=False):
+    conf = {"mapred.reduce.tasks": 0,
+            "mapred.job.queue.name": queue,
+            "mapred.reduce.slowstart.completed.maps": 0.0}
+    if kernel:
+        conf["tpumr.map.kernel"] = "kmeans-assign"
+    splits = [{"locations": []} for _ in range(n_maps)]
+    return JobInProgress(JobID("test", job_num), conf, splits)
+
+
+class TestFairScheduler:
+    def test_pool_from_conf_or_user(self):
+        a = make_pool_job("analytics", 1)
+        assert pool_of(a) == "analytics"
+        b = make_job(job_num=2)
+        b.conf["user.name"] = "erin"
+        assert pool_of(b) == "erin"
+
+    def test_starved_pool_gets_slots_first(self):
+        # pool A hogs: job1 (earlier) in A, job2 in B; equal weights →
+        # assignments must alternate between pools, not drain FIFO
+        j1 = make_pool_job("A", 1, n_maps=4)
+        j2 = make_pool_job("B", 2, n_maps=4)
+        sched = make_fair([j1, j2])
+        tasks = sched.assign_tasks(tracker_status(cpu=4, tpu=0, reduce=0))
+        assert len(tasks) == 4
+        pools = [str(t.attempt_id.task.job) for t in tasks]
+        # 2 slots each, interleaved — pure FIFO would give all 4 to job1
+        assert pools.count(str(j1.job_id)) == 2
+        assert pools.count(str(j2.job_id)) == 2
+
+    def test_weights_skew_shares(self):
+        j1 = make_pool_job("heavy", 1, n_maps=8)
+        j2 = make_pool_job("light", 2, n_maps=8)
+        sched = make_fair([j1, j2],
+                          **{"tpumr.fairscheduler.pool.heavy.weight": 3.0})
+        tasks = sched.assign_tasks(tracker_status(cpu=4, tpu=0, reduce=0))
+        by_job = [str(t.attempt_id.task.job) for t in tasks]
+        assert by_job.count(str(j1.job_id)) == 3
+        assert by_job.count(str(j2.job_id)) == 1
+
+    def test_min_share_beats_weight(self):
+        j1 = make_pool_job("big", 1, n_maps=8)
+        j2 = make_pool_job("guaranteed", 2, n_maps=8)
+        sched = make_fair(
+            [j1, j2],
+            **{"tpumr.fairscheduler.pool.big.weight": 100.0,
+               "tpumr.fairscheduler.pool.guaranteed.minmaps": 2})
+        tasks = sched.assign_tasks(tracker_status(cpu=2, tpu=0, reduce=0))
+        by_job = [str(t.attempt_id.task.job) for t in tasks]
+        # guaranteed pool is below min share → first slot goes there even
+        # though big's weight dwarfs it
+        assert by_job.count(str(j2.job_id)) >= 1
+
+    def test_tpu_pass_respects_fair_order_and_kernel_gate(self):
+        j1 = make_pool_job("A", 1, n_maps=4, kernel=False)
+        j2 = make_pool_job("B", 2, n_maps=4, kernel=True)
+        sched = make_fair([j1, j2])
+        tasks = sched.assign_tasks(tracker_status(cpu=0, tpu=1, reduce=0))
+        assert len(tasks) == 1
+        t = tasks[0]
+        assert str(t.attempt_id.task.job) == str(j2.job_id)
+        assert t.run_on_tpu and t.tpu_device_id == 0
+
+
+class TestCapacityScheduler:
+    def test_underserved_queue_first(self):
+        j1 = make_queue_job("prod", 1, n_maps=8)
+        j2 = make_queue_job("adhoc", 2, n_maps=8)
+        sched = make_capacity(
+            [j1, j2],
+            **{"tpumr.capacity.queues": "prod,adhoc",
+               "tpumr.capacity.prod.capacity": 75,
+               "tpumr.capacity.adhoc.capacity": 25})
+        tasks = sched.assign_tasks(tracker_status(cpu=4, tpu=0, reduce=0))
+        by_job = [str(t.attempt_id.task.job) for t in tasks]
+        assert by_job.count(str(j1.job_id)) == 3
+        assert by_job.count(str(j2.job_id)) == 1
+
+    def test_elasticity_when_other_queue_idle(self):
+        j2 = make_queue_job("adhoc", 2, n_maps=8)
+        sched = make_capacity(
+            [j2],
+            **{"tpumr.capacity.queues": "prod,adhoc",
+               "tpumr.capacity.prod.capacity": 75,
+               "tpumr.capacity.adhoc.capacity": 25})
+        tasks = sched.assign_tasks(tracker_status(cpu=4, tpu=0, reduce=0))
+        assert len(tasks) == 4  # adhoc takes the whole cluster while idle
+
+    def test_max_capacity_ceiling(self):
+        j2 = make_queue_job("adhoc", 2, n_maps=8)
+        sched = make_capacity(
+            [j2],
+            **{"tpumr.capacity.queues": "prod,adhoc",
+               "tpumr.capacity.prod.capacity": 75,
+               "tpumr.capacity.adhoc.capacity": 25,
+               "tpumr.capacity.adhoc.max-capacity": 50})
+        # tracker claims 2 adhoc maps already running cluster-wide
+        j2._pending_maps -= {0, 1}  # simulate 2 assigned
+        tasks = sched.assign_tasks(tracker_status(cpu=4, tpu=0, reduce=0))
+        # ceiling = 50% of 4 slots = 2 running → no more
+        assert len(tasks) == 0
+
+    def test_unknown_queue_falls_back_to_default(self):
+        j = make_queue_job("nonexistent", 1, n_maps=2)
+        sched = make_capacity(
+            [j], **{"tpumr.capacity.queues": "default,prod",
+                    "tpumr.capacity.prod.capacity": 50,
+                    "tpumr.capacity.default.capacity": 50})
+        tasks = sched.assign_tasks(tracker_status(cpu=2, tpu=0, reduce=0))
+        assert len(tasks) == 2
+
+    def test_tpu_aware(self):
+        j = make_queue_job("prod", 1, n_maps=4, kernel=True)
+        sched = make_capacity(
+            [j], **{"tpumr.capacity.queues": "prod",
+                    "tpumr.capacity.prod.capacity": 100})
+        tasks = sched.assign_tasks(tracker_status(cpu=0, tpu=1, reduce=0))
+        assert len(tasks) == 1 and tasks[0].run_on_tpu
+
+
+class TestReducePass:
+    def test_fair_minmaps_does_not_leak_into_reduce_order(self):
+        # prod has a huge map min-share; its reduces must NOT preempt
+        # other pools' reduces (one reduce per heartbeat → check who wins)
+        j1 = make_pool_job("prod", 1, n_maps=0, n_reduces=4)
+        j2 = make_pool_job("other", 2, n_maps=0, n_reduces=4)
+        # make prod busier in the reduce dimension
+        j1._pending_reduces -= {0, 1}
+        sched = make_fair(
+            [j1, j2],
+            **{"tpumr.fairscheduler.pool.prod.minmaps": 100})
+        tasks = sched.assign_tasks(tracker_status(cpu=0, tpu=0, reduce=1))
+        assert len(tasks) == 1
+        assert str(tasks[0].attempt_id.task.job) == str(j2.job_id)
+
+    def test_capacity_reduce_uses_reduce_slot_pool(self):
+        # 50% max-capacity against 8 reduce slots = ceiling 4, so a queue
+        # with 2 running reduces must still get a reduce (the bug was
+        # computing the ceiling against the 4 map slots → 2 >= 2 → starved)
+        conf = {"mapred.reduce.tasks": 4,
+                "mapred.job.queue.name": "adhoc",
+                "mapred.reduce.slowstart.completed.maps": 0.0}
+        j = JobInProgress(JobID("test", 1), conf,
+                          [])
+        j._pending_reduces -= {0, 1}  # 2 reduces already running
+        sched = make_capacity(
+            [j],
+            **{"tpumr.capacity.queues": "prod,adhoc",
+               "tpumr.capacity.prod.capacity": 75,
+               "tpumr.capacity.adhoc.capacity": 25,
+               "tpumr.capacity.adhoc.max-capacity": 50})
+
+        class WideManager(FakeManager):
+            def total_slots(self):
+                return {"cpu": 4, "tpu": 0, "reduce": 8}
+
+        sched.set_manager(WideManager([j]))
+        tasks = sched.assign_tasks(tracker_status(cpu=0, tpu=0, reduce=1))
+        assert len(tasks) == 1 and not tasks[0].is_map
+
+    def test_capacity_unknown_queue_is_last_not_privileged(self):
+        known = make_queue_job("prod", 1, n_maps=4)
+        stray = make_queue_job("typo", 2, n_maps=4)
+        sched = make_capacity(
+            [stray, known],
+            **{"tpumr.capacity.queues": "prod,adhoc",
+               "tpumr.capacity.prod.capacity": 75,
+               "tpumr.capacity.adhoc.capacity": 25})
+        tasks = sched.assign_tasks(tracker_status(cpu=1, tpu=0, reduce=0))
+        # the single slot goes to the configured queue, not the stray job
+        assert len(tasks) == 1
+        assert str(tasks[0].attempt_id.task.job) == str(known.job_id)
+
+
+class TestPluggability:
+    def test_jobmaster_loads_contrib_scheduler(self):
+        from tpumr.mapred.jobtracker import JobMaster
+        conf = JobConf()
+        conf.set("mapred.jobtracker.taskScheduler",
+                 "tpumr.contrib.fairscheduler.FairScheduler")
+        jm = JobMaster(conf)
+        try:
+            assert isinstance(jm.scheduler, FairScheduler)
+        finally:
+            jm.stop()

@@ -1,0 +1,3 @@
+"""Contrib tier ≈ the reference's ``src/contrib``: pluggable schedulers
+(fairscheduler, capacity-scheduler) and other optional components that sit
+on public SPIs rather than in the core."""

@@ -1,0 +1,118 @@
+"""Capacity scheduler — queue-based capacity guarantees with elasticity.
+
+≈ ``src/contrib/capacity-scheduler/.../CapacityTaskScheduler.java``:
+operators define queues with capacity percentages; each queue is
+guaranteed its share of cluster slots, can elastically exceed it while
+other queues are idle (bounded by an optional maximum capacity), and
+jobs pick a queue with ``mapred.job.queue.name`` (the reference's key).
+
+Config:
+  tpumr.capacity.queues                 = default,prod,adhoc
+  tpumr.capacity.<queue>.capacity       = percent of cluster slots (int)
+  tpumr.capacity.<queue>.max-capacity   = elastic ceiling percent (optional)
+
+Queues most below their guaranteed capacity are offered slots first;
+within a queue, FIFO. Map and reduce passes each rank against their own
+slot pool (map usage / map-slot capacity, reduce usage / reduce-slot
+capacity — the reference's TaskSchedulingMgr per-type split). A job
+naming an undefined queue is scheduled LAST (zero guaranteed capacity,
+elastic only) rather than rejected at submit time like the reference —
+divergence documented: submission stays non-blocking and configured
+queues' guarantees stay intact.
+
+TPU-aware through the hybrid base class, unlike the reference contrib
+(SURVEY.md §2.4: "no GPU awareness — verified by grep").
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tpumr.mapred.job_in_progress import JobInProgress
+from tpumr.mapred.scheduler import HybridQueueScheduler
+
+QUEUE_KEY = "mapred.job.queue.name"
+_PHANTOM = "\x00undefined"  # bucket for jobs naming a queue not configured
+
+
+def queue_of(job: JobInProgress) -> str:
+    return str(job.conf.get(QUEUE_KEY) or "default")
+
+
+class CapacityScheduler(HybridQueueScheduler):
+    def __init__(self) -> None:
+        super().__init__()
+        self._caps: dict[str, float] = {"default": 1.0}
+        self._map_slot_total = 1
+        self._reduce_slot_total = 1
+
+    def _parse_queues(self) -> dict[str, float]:
+        """queue -> capacity fraction (normalized; unset = equal split)."""
+        if self.conf is None:
+            return {"default": 1.0}
+        names = [q.strip() for q in
+                 str(self.conf.get("tpumr.capacity.queues",
+                                   "default")).split(",") if q.strip()]
+        caps = {}
+        for q in names:
+            caps[q] = float(self.conf.get(f"tpumr.capacity.{q}.capacity",
+                                          100.0 / len(names)))
+        total = sum(caps.values()) or 1.0
+        return {q: c / total for q, c in caps.items()}
+
+    def _max_capacity(self, queue: str) -> float | None:
+        if self.conf is None or queue == _PHANTOM:
+            return None
+        v = self.conf.get(f"tpumr.capacity.{queue}.max-capacity")
+        return float(v) / 100.0 if v is not None else None
+
+    def _begin_assignment(self, tts: dict) -> None:
+        """Heartbeat-invariant context, computed once (the order hooks run
+        per free slot and must not re-parse config or re-lock the master)."""
+        assert self.manager is not None
+        self._caps = self._parse_queues()
+        slots = self.manager.total_slots()
+        self._map_slot_total = max(1, int(slots.get("cpu", 0))
+                                   + int(slots.get("tpu", 0)))
+        self._reduce_slot_total = max(1, int(slots.get("reduce", 0)))
+
+    def _order(self, jobs: list[JobInProgress],
+               running_of: Callable[[JobInProgress], int],
+               slot_total: int) -> list[JobInProgress]:
+        caps = self._caps
+        by_queue: dict[str, list[JobInProgress]] = {}
+        for j in jobs:
+            q = queue_of(j)
+            if q not in caps:
+                q = _PHANTOM
+            by_queue.setdefault(q, []).append(j)
+
+        def rank(item):
+            name, members = item
+            running = sum(running_of(j) for j in members)
+            cap = caps.get(name, 0.0)
+            # queues with guaranteed capacity always outrank the phantom
+            # bucket (jobs naming an unconfigured queue: elastic only)
+            if cap <= 0.0:
+                return (1, float(running), name)
+            return (0, running / (cap * slot_total), name)
+
+        out: list[JobInProgress] = []
+        for name, members in sorted(by_queue.items(), key=rank):
+            # elastic ceiling against THIS pass's slot pool
+            ceiling = self._max_capacity(name)
+            if ceiling is not None:
+                running = sum(running_of(j) for j in members)
+                if running >= ceiling * slot_total:
+                    continue
+            out.extend(sorted(members, key=lambda j: j.start_time))
+        return out
+
+    def _map_job_order(self, jobs: list[JobInProgress]) -> list[JobInProgress]:
+        return self._order(jobs, JobInProgress.running_map_count,
+                           self._map_slot_total)
+
+    def _reduce_job_order(self,
+                          jobs: list[JobInProgress]) -> list[JobInProgress]:
+        return self._order(jobs, JobInProgress.running_reduce_count,
+                           self._reduce_slot_total)

@@ -1,0 +1,80 @@
+"""Fair scheduler — pool-based fair sharing.
+
+≈ ``src/contrib/fairscheduler/.../FairScheduler.java`` (pools, weights,
+minimum shares, deficit-style ordering). Jobs are grouped into pools (job
+conf ``mapred.fairscheduler.pool``, falling back to ``user.name``); each
+free slot is offered to the most-starved pool first:
+
+1. *map pass only*: pools running below their map minimum share
+   (``tpumr.fairscheduler.pool.<name>.minmaps``) come before satisfied
+   pools (≈ the reference's minMaps guarantee);
+2. ties break on running-tasks-to-weight ratio (lower = more starved,
+   ``tpumr.fairscheduler.pool.<name>.weight``, default 1.0);
+3. within a pool, FIFO by start time (the reference's default ordering
+   inside a pool before fair-share-within-pool was added).
+
+The reduce pass ranks pools purely by running-reduces/weight — map
+min-shares do not leak into reduce ordering.
+
+Unlike the reference's contrib scheduler — which had no GPU awareness at
+all (SURVEY.md §2.4) — this subclasses the hybrid scheduler, so CPU/TPU
+placement, optional-scheduling starvation, and device-id assignment all
+apply within the fair ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from tpumr.mapred.job_in_progress import JobInProgress
+from tpumr.mapred.scheduler import HybridQueueScheduler
+
+POOL_KEY = "mapred.fairscheduler.pool"
+
+
+def pool_of(job: JobInProgress) -> str:
+    return str(job.conf.get(POOL_KEY)
+               or job.conf.get("user.name")
+               or "default")
+
+
+class FairScheduler(HybridQueueScheduler):
+    def _pool_conf(self, pool: str, suffix: str, default: Any) -> Any:
+        if self.conf is None:
+            return default
+        return self.conf.get(f"tpumr.fairscheduler.pool.{pool}.{suffix}",
+                             default)
+
+    def _ordered(self, jobs: list[JobInProgress],
+                 running_of: Callable[[JobInProgress], int],
+                 use_min_share: bool) -> list[JobInProgress]:
+        pools: dict[str, list[JobInProgress]] = {}
+        for j in jobs:
+            pools.setdefault(pool_of(j), []).append(j)
+
+        def pool_rank(item: tuple[str, list[JobInProgress]]):
+            name, members = item
+            running = sum(running_of(j) for j in members)
+            weight = float(self._pool_conf(name, "weight", 1.0))
+            below_min = False
+            if use_min_share:
+                min_share = int(self._pool_conf(name, "minmaps", 0))
+                below_min = running < min_share
+            # most starved first: below-min pools, then lowest usage/weight
+            return (0 if below_min else 1,
+                    running / max(weight, 1e-9),
+                    name)
+
+        out: list[JobInProgress] = []
+        for _name, members in sorted(pools.items(), key=pool_rank):
+            out.extend(sorted(members, key=lambda j: j.start_time))
+        return out
+
+    def _map_job_order(self, jobs: list[JobInProgress]) -> list[JobInProgress]:
+        return self._ordered(jobs, JobInProgress.running_map_count,
+                             use_min_share=True)
+
+    def _reduce_job_order(self,
+                          jobs: list[JobInProgress]) -> list[JobInProgress]:
+        return self._ordered(jobs, JobInProgress.running_reduce_count,
+                             use_min_share=False)

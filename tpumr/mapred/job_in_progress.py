@@ -127,6 +127,15 @@ class JobInProgress:
     def pending_reduce_count(self) -> int:
         return len(self._pending_reduces)
 
+    def running_map_count(self) -> int:
+        """Maps assigned and not yet finished (scheduler's usage signal)."""
+        return max(0, len(self.maps) - self.finished_maps
+                   - self.pending_map_count())
+
+    def running_reduce_count(self) -> int:
+        return max(0, len(self.reduces) - self.finished_reduces
+                   - self.pending_reduce_count())
+
     def has_kernel(self) -> bool:
         """≈ the hadoop.pipes.gpu.executable gate
         (JobQueueTaskScheduler.java:342-347): only jobs with a device kernel

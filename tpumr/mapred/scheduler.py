@@ -70,7 +70,24 @@ def _free_tpu_devices(tracker_status: dict) -> list[int]:
 
 
 class HybridQueueScheduler(TaskScheduler):
-    """FIFO job queue + Shirahata hybrid CPU/TPU map placement."""
+    """FIFO job queue + Shirahata hybrid CPU/TPU map placement.
+
+    Subclass seams: ``_map_job_order`` / ``_reduce_job_order`` decide which
+    job is offered the next free slot — the fair and capacity schedulers
+    (tpumr.contrib) override only these, inheriting the hybrid CPU/TPU
+    passes (an upgrade over the reference, whose contrib schedulers were
+    GPU-blind — SURVEY.md §1 L5)."""
+
+    def _map_job_order(self, jobs: list[JobInProgress]) -> list[JobInProgress]:
+        return jobs
+
+    def _reduce_job_order(self,
+                          jobs: list[JobInProgress]) -> list[JobInProgress]:
+        return jobs
+
+    def _begin_assignment(self, tts: dict) -> None:
+        """Called once per heartbeat before the passes — subclasses cache
+        heartbeat-invariant state here (the order hooks run per free slot)."""
 
     def assign_tasks(self, tts: dict) -> list[Task]:
         assert self.manager is not None
@@ -78,6 +95,7 @@ class HybridQueueScheduler(TaskScheduler):
                 if j.state == JobState.RUNNING]
         if not jobs:
             return []
+        self._begin_assignment(tts)
         n_trackers = max(1, self.manager.num_trackers())
         host = tts.get("host", "")
 
@@ -125,7 +143,7 @@ class HybridQueueScheduler(TaskScheduler):
             if not free_devices:
                 break
             task = None
-            for job in jobs:
+            for job in self._map_job_order(jobs):
                 if not job.has_kernel():
                     continue  # ≈ gpu-executable gate (:342-347)
                 device = free_devices[0]
@@ -142,7 +160,7 @@ class HybridQueueScheduler(TaskScheduler):
         # ---- CPU pass (:290-327)
         for _ in range(free_cpu):
             task = None
-            for job in jobs:
+            for job in self._map_job_order(jobs):
                 jid = str(job.job_id)
                 if cpu_budget.get(jid, 0) <= 0:
                     continue
@@ -157,7 +175,7 @@ class HybridQueueScheduler(TaskScheduler):
 
         # ---- reduce pass: at most one per heartbeat (:527-560)
         if free_red > 0:
-            for job in jobs:
+            for job in self._reduce_job_order(jobs):
                 task = job.obtain_new_reduce_task(host)
                 if task is not None:
                     assigned.append(task)
