@@ -1,0 +1,166 @@
+"""Metrics system + HTTP status tier + history server ≈ metrics2,
+HttpServer/webapps, JobHistoryServer (SURVEY.md §5)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tpumr.fs import get_filesystem
+from tpumr.metrics import FileSink, MetricsRegistry, MetricsSystem
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.mini_cluster import MiniMRCluster
+
+
+def fetch(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestMetricsCore:
+    def test_registry_counters_and_gauges(self):
+        reg = MetricsRegistry("x")
+        reg.incr("events")
+        reg.incr("events", 4)
+        reg.set_gauge("depth", lambda: 7)
+        reg.set_gauge("static", 3)
+        snap = reg.snapshot()
+        assert snap == {"events": 5, "depth": 7, "static": 3}
+
+    def test_broken_gauge_survives(self):
+        reg = MetricsRegistry("x")
+        reg.set_gauge("bad", lambda: 1 / 0)
+        assert "error" in str(reg.snapshot()["bad"])
+
+    def test_system_publish_to_file_sink(self, tmp_path):
+        ms = MetricsSystem("test", period_s=3600)
+        reg = ms.new_registry("src1")
+        reg.incr("n", 2)
+        path = str(tmp_path / "metrics.jsonl")
+        ms.add_sink(FileSink(path))
+        ms.publish_once()
+        rec = json.loads(open(path).read().splitlines()[0])
+        assert rec["prefix"] == "test"
+        assert rec["sources"]["src1"]["n"] == 2
+
+
+class WcMapper:
+    def configure(self, conf):
+        pass
+
+    def map(self, key, value, output, reporter):
+        for w in value.split():
+            output.collect(w, 1)
+
+    def close(self):
+        pass
+
+
+class SumReducer:
+    def configure(self, conf):
+        pass
+
+    def reduce(self, key, values, output, reporter):
+        output.collect(key, sum(values))
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    hist = str(tmp_path_factory.mktemp("hist"))
+    conf = JobConf()
+    conf.set("mapred.job.tracker.http.port", 0)   # ephemeral
+    conf.set("tpumr.history.dir", hist)
+    with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0,
+                       conf=conf) as c:
+        c.history_dir = hist
+        yield c
+
+
+def run_wc(cluster, name):
+    from tpumr.mapred.job_client import JobClient
+    fs = get_filesystem("mem:///")
+    fs.write_bytes(f"/mh/{name}.txt", b"a b a\n" * 30)
+    conf = cluster.create_job_conf()
+    conf.set_input_paths(f"mem:///mh/{name}.txt")
+    conf.set_output_path(f"mem:///mh/{name}-out")
+    conf.set_class("mapred.mapper.class", WcMapper)
+    conf.set_class("mapred.reducer.class", SumReducer)
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+    return result
+
+
+class TestJobTrackerHttp:
+    def test_endpoints(self, cluster):
+        run_wc(cluster, "one")
+        base = cluster.master.http_url
+        assert base is not None
+        code, body = fetch(base + "/json/cluster")
+        assert code == 200
+        info = json.loads(body)
+        assert info["trackers"] == 1 and info["jobs_total"] >= 1
+
+        code, body = fetch(base + "/json/jobs")
+        jobs = json.loads(body)
+        assert any(j["state"] == "SUCCEEDED" for j in jobs)
+
+        jid = jobs[0]["job_id"]
+        code, body = fetch(base + f"/json/job?id={jid}")
+        assert json.loads(body)["job_id"] == jid
+
+        code, body = fetch(base + "/json/metrics")
+        metrics = json.loads(body)["jobtracker"]
+        assert metrics["heartbeats"] >= 1
+        assert metrics["jobs_submitted"] >= 1
+        assert metrics["maps_launched_cpu"] >= 1
+        assert metrics["jobs_succeeded"] >= 1
+
+        code, body = fetch(base + "/json/trackers")
+        assert len(json.loads(body)) == 1
+
+        code, body = fetch(base + "/")
+        assert code == 200 and "<html>" in body
+
+        code, body = fetch(base + "/json/nope")
+        assert code == 404 and "endpoints" in body
+
+    def test_history_server(self, cluster):
+        run_wc(cluster, "two")
+        from tpumr.mapred.history_server import JobHistoryServer
+        hs = JobHistoryServer(cluster.history_dir).start()
+        try:
+            code, body = fetch(hs.url + "/json/history")
+            summaries = json.loads(body)
+            assert any(s.get("state") == "SUCCEEDED" for s in summaries)
+            done = [s for s in summaries if s.get("state")][0]
+            code, body = fetch(hs.url + f"/json/job?id={done['job_id']}")
+            events = json.loads(body)
+            kinds = {e["event"] for e in events}
+            assert {"JOB_SUBMITTED", "JOB_FINISHED"} <= kinds
+        finally:
+            hs.stop()
+
+
+class TestNameNodeHttp:
+    def test_dfs_endpoints(self, tmp_path):
+        from tpumr.dfs.mini_cluster import MiniDFSCluster
+        conf = JobConf()
+        conf.set("tdfs.http.port", 0)
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            client = c.client()
+            with client.create("/h.txt") as f:
+                f.write(b"hello")
+            base = c.namenode.http_url
+            assert base is not None
+            code, body = fetch(base + "/json/namenode")
+            info = json.loads(body)
+            assert info["files"] == 1 and info["datanodes"] == 2
+            code, body = fetch(base + "/json/datanodes")
+            assert len(json.loads(body)) == 2

@@ -29,6 +29,7 @@ Daemons:
   secondarynamenode -nn HOST:PORT -dir DIR   periodic checkpoint daemon
   jobtracker [-host H] [-port P]             run the JobMaster
   tasktracker -jt HOST:PORT                  run a NodeRunner (worker)
+  historyserver -dir DIR [-port P]           serve completed-job history
 
 Clients:
   fs -CMD ...          filesystem shell (tpumr fs -help for commands)
@@ -169,6 +170,17 @@ def cmd_tasktracker(conf, argv: list[str]) -> int:
     return _serve_forever(nr.stop)
 
 
+def cmd_historyserver(conf, argv: list[str]) -> int:
+    from tpumr.mapred.history_server import JobHistoryServer
+    a = _kv_args(argv)
+    hs = JobHistoryServer(a.get("dir")
+                          or conf.get("tpumr.history.dir")
+                          or "/tmp/tpumr-history",
+                          port=int(a.get("port", 9888))).start()
+    print(f"JobHistoryServer up at {hs.url}", file=sys.stderr)
+    return _serve_forever(hs.stop)
+
+
 def cmd_balancer(conf, argv: list[str]) -> int:
     from tpumr.dfs.balancer import Balancer
     a = _kv_args(argv)
@@ -265,6 +277,7 @@ COMMANDS = {
     "secondarynamenode": cmd_secondarynamenode,
     "jobtracker": cmd_jobtracker,
     "tasktracker": cmd_tasktracker,
+    "historyserver": cmd_historyserver,
     "balancer": cmd_balancer,
     "fs": cmd_fs,
     "job": cmd_job,

@@ -39,11 +39,23 @@ def pool_of(job: JobInProgress) -> str:
 
 
 class FairScheduler(HybridQueueScheduler):
+    def __init__(self) -> None:
+        super().__init__()
+        self._pool_cache: dict[tuple[str, str], Any] = {}
+
+    def _begin_assignment(self, tts: dict) -> None:
+        # weights/min-shares are heartbeat-invariant; the order hooks run
+        # once per free slot — don't re-parse config each time
+        self._pool_cache.clear()
+
     def _pool_conf(self, pool: str, suffix: str, default: Any) -> Any:
         if self.conf is None:
             return default
-        return self.conf.get(f"tpumr.fairscheduler.pool.{pool}.{suffix}",
-                             default)
+        key = (pool, suffix)
+        if key not in self._pool_cache:
+            self._pool_cache[key] = self.conf.get(
+                f"tpumr.fairscheduler.pool.{pool}.{suffix}", default)
+        return self._pool_cache[key]
 
     def _ordered(self, jobs: list[JobInProgress],
                  running_of: Callable[[JobInProgress], int],

@@ -573,16 +573,48 @@ class NameNode:
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="nn-monitors", daemon=True)
+        self._http: Any = None
+        self._http_port = int(conf.get("tdfs.http.port", -1))
 
     def start(self) -> "NameNode":
         self._server.start()
         self._monitor.start()
+        if self._http_port >= 0:
+            self._http = self._build_http(self._http_port).start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self._http is not None:
+            self._http.stop()
         self._server.stop()
         self.ns.edits.close()
+
+    @property
+    def http_url(self) -> "str | None":
+        return self._http.url if self._http is not None else None
+
+    def _build_http(self, port: int):
+        """Status endpoints ≈ webapps/hdfs dfshealth.jsp + NameNodeMXBean."""
+        from tpumr.http import StatusHttpServer
+        srv = StatusHttpServer("namenode", port=port)
+
+        def summary(q: dict) -> dict:
+            ns = self.ns
+            with ns.lock:
+                files = sum(1 for i in ns.namespace.values()
+                            if i.get("type") == "file")
+                dirs = sum(1 for i in ns.namespace.values()
+                           if i.get("type") == "dir")
+                blocks = sum(len(i.get("blocks", []))
+                             for i in ns.namespace.values())
+            return {"files": files, "directories": dirs, "blocks": blocks,
+                    "safemode": ns.safemode,
+                    "datanodes": len(ns.datanodes)}
+
+        srv.add_json("namenode", summary)
+        srv.add_json("datanodes", lambda q: self.ns.datanode_report())
+        return srv
 
     @property
     def address(self) -> tuple[str, int]:

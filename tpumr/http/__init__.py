@@ -1,0 +1,9 @@
+"""Embedded HTTP status tier ≈ the reference's Jetty ``HttpServer`` +
+JSP webapps (src/core/org/apache/hadoop/http/HttpServer.java;
+webapps/{job,task,hdfs,history}). JSON endpoints are the primary
+interface (the MXBean/``/jmx`` analog); a minimal HTML dashboard renders
+the same JSON for humans."""
+
+from tpumr.http.server import StatusHttpServer
+
+__all__ = ["StatusHttpServer"]

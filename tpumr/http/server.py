@@ -1,0 +1,126 @@
+"""Threaded HTTP status server.
+
+≈ ``org.apache.hadoop.http.HttpServer`` (839 LoC Jetty wrapper): daemons
+register handlers; ``/json/*`` endpoints return JSON, ``/`` renders an
+HTML dashboard from the same handlers. Stdlib http.server — the status
+plane is low-traffic (humans + scrapers), unlike the shuffle path.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+#: A handler takes the query dict and returns a JSON-able object.
+Handler = Callable[[dict], Any]
+
+
+class StatusHttpServer:
+    def __init__(self, name: str, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.name = name
+        self._handlers: dict[str, Handler] = {}
+        self._parameterized: set[str] = set()
+        outer = self
+
+        class _Req(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self) -> None:
+                outer._serve(self)
+
+        self._server = ThreadingHTTPServer((host, port), _Req)
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ wiring
+
+    def add_json(self, path: str, handler: Handler,
+                 parameterized: bool = False) -> None:
+        """Register ``/json/<path>``. ``parameterized`` endpoints require
+        query args — the dashboard links them but doesn't invoke them."""
+        self._handlers[path] = handler
+        if parameterized:
+            self._parameterized.add(path)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "StatusHttpServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"http-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------ serving
+
+    def _serve(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        path = parsed.path.rstrip("/")
+        try:
+            if path in ("", "/"):
+                self._send(req, 200, self._dashboard(), "text/html")
+            elif path.startswith("/json/"):
+                name = path[len("/json/"):]
+                handler = self._handlers.get(name)
+                if handler is None:
+                    self._send(req, 404, json.dumps(
+                        {"error": f"no endpoint {name!r}",
+                         "endpoints": sorted(self._handlers)}),
+                        "application/json")
+                else:
+                    body = json.dumps(handler(query), indent=2, default=str)
+                    self._send(req, 200, body, "application/json")
+            else:
+                self._send(req, 404, "not found", "text/plain")
+        except Exception as e:
+            self._send(req, 500, json.dumps({"error": str(e)}),
+                       "application/json")
+
+    @staticmethod
+    def _send(req: BaseHTTPRequestHandler, code: int, body: str,
+              ctype: str) -> None:
+        data = body.encode()
+        req.send_response(code)
+        req.send_header("Content-Type", ctype + "; charset=utf-8")
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _dashboard(self) -> str:
+        """One-page HTML: each JSON endpoint rendered as a <pre> block
+        (≈ the JSP dashboards' information, minus the JSP)."""
+        parts = [f"<html><head><title>{html.escape(self.name)}</title>",
+                 "<style>body{font-family:monospace;margin:2em}"
+                 "h2{border-bottom:1px solid #888}</style></head><body>",
+                 f"<h1>{html.escape(self.name)}</h1>"]
+        for name in sorted(self._handlers):
+            if name in self._parameterized:
+                parts.append(f"<h2>/json/{name}?…</h2>"
+                             "<pre>(takes query parameters)</pre>")
+                continue
+            try:
+                body = json.dumps(self._handlers[name]({}), indent=2,
+                                  default=str)
+            except Exception as e:
+                body = f"error: {e}"
+            parts.append(f"<h2><a href='/json/{name}'>{name}</a></h2>"
+                         f"<pre>{html.escape(body)}</pre>")
+        parts.append("</body></html>")
+        return "".join(parts)

@@ -1,0 +1,87 @@
+"""Job history server.
+
+≈ ``org.apache.hadoop.mapred.JobHistoryServer`` + ``HistoryViewer`` +
+the webapps/history JSP tier: serves completed-job summaries and full
+event streams from the history directory (JSON-lines files written by
+``tpumr.mapred.history.JobHistory``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from tpumr.http import StatusHttpServer
+from tpumr.mapred.history import JobHistory
+
+
+def job_summary(events: list[dict]) -> dict:
+    """Collapse one job's event stream into the viewer row
+    (≈ HistoryViewer's analysis: submit/finish, task counts, backends)."""
+    out: dict[str, Any] = {"events": len(events)}
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "JOB_SUBMITTED":
+            out.update(job_id=ev.get("job_id"), name=ev.get("job_name"),
+                       num_maps=ev.get("num_maps"),
+                       num_reduces=ev.get("num_reduces"),
+                       kernel=ev.get("kernel"), submitted_ts=ev.get("ts"))
+        elif kind == "JOB_FINISHED":
+            out.update(state=ev.get("state"),
+                       wall_time=ev.get("wall_time"),
+                       finished_cpu_maps=ev.get("finished_cpu_maps"),
+                       finished_tpu_maps=ev.get("finished_tpu_maps"),
+                       acceleration_factor=ev.get("acceleration_factor"),
+                       error=ev.get("error"))
+    return out
+
+
+class JobHistoryServer:
+    def __init__(self, history_dir: str, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.dir = history_dir
+        #: (path, mtime) -> summary; finished-job files are immutable, so
+        #: summaries are cacheable and a scrape is O(new files) not
+        #: O(total historical events)
+        self._summary_cache: dict[str, tuple[float, dict]] = {}
+        self._http = StatusHttpServer("history", host=host, port=port)
+        self._http.add_json("history", self._list)
+        self._http.add_json("job", self._job, parameterized=True)
+
+    def _files(self) -> dict[str, str]:
+        if not os.path.isdir(self.dir):
+            return {}
+        return {f[:-len(".jsonl")]: os.path.join(self.dir, f)
+                for f in sorted(os.listdir(self.dir))
+                if f.endswith(".jsonl")}
+
+    def _list(self, q: dict) -> list[dict]:
+        out = []
+        for _job, path in self._files().items():
+            mtime = os.path.getmtime(path)
+            cached = self._summary_cache.get(path)
+            if cached is None or cached[0] != mtime:
+                cached = (mtime, job_summary(JobHistory.read(path)))
+                self._summary_cache[path] = cached
+            out.append(cached[1])
+        return out
+
+    def _job(self, q: dict) -> Any:
+        path = self._files().get(q.get("id", ""))
+        if path is None:
+            return {"error": f"no history for job {q.get('id')!r}",
+                    "known": sorted(self._files())}
+        return JobHistory.read(path)
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def url(self) -> str:
+        return self._http.url
+
+    def start(self) -> "JobHistoryServer":
+        self._http.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.stop()

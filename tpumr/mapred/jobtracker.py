@@ -78,16 +78,95 @@ class JobMaster:
         self._expire_thread = threading.Thread(
             target=self._expire_loop, name="expire-trackers", daemon=True)
 
+        # instrumentation ≈ JobTrackerInstrumentation + JobTrackerMXBean:
+        # backend placement is a first-class metric (SURVEY.md §5)
+        from tpumr.metrics import FileSink, MetricsSystem
+        self.metrics = MetricsSystem(
+            "jobtracker",
+            period_s=conf.get_int("tpumr.metrics.period.ms", 10_000) / 1000)
+        self._mreg = self.metrics.new_registry("jobtracker")
+        def _locked(fn):
+            def sample():
+                with self.lock:
+                    return fn()
+            return sample
+
+        self._mreg.set_gauge("jobs_running",
+                             _locked(lambda: len(self.running_jobs())))
+        self._mreg.set_gauge("jobs_total", _locked(lambda: len(self.jobs)))
+        self._mreg.set_gauge("trackers", _locked(lambda: len(self.trackers)))
+        self._mreg.set_gauge(
+            "trackers_blacklisted",
+            _locked(lambda: sum(1 for t in self.trackers.values()
+                                if t.blacklisted)))
+        self._mreg.set_gauge("slots", self.total_slots)
+        sink_path = conf.get("tpumr.metrics.file")
+        if sink_path:
+            self.metrics.add_sink(FileSink(sink_path))
+        self._http: Any = None
+        self._http_port = conf.get_int("mapred.job.tracker.http.port", -1)
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "JobMaster":
         self._server.start()
         self._expire_thread.start()
+        self.metrics.start()
+        if self._http_port >= 0:
+            self._http = self._build_http(self._http_port).start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.metrics.stop()
+        if self._http is not None:
+            self._http.stop()
         self._server.stop()
+
+    @property
+    def http_url(self) -> str | None:
+        return self._http.url if self._http is not None else None
+
+    def _build_http(self, port: int):
+        """Status endpoints ≈ webapps/job JSP dashboards + /jmx."""
+        from tpumr.http import StatusHttpServer
+        srv = StatusHttpServer("jobtracker", port=port)
+        def cluster_info(q: dict) -> dict:
+            with self.lock:
+                return {
+                    "cluster_id": self.cluster_id,
+                    "trackers": len(self.trackers),
+                    "slots": self.total_slots(),
+                    "jobs_running": len(self.running_jobs()),
+                    "jobs_total": len(self.jobs),
+                }
+
+        def jobs_info(q: dict) -> list:
+            with self.lock:
+                jips = [self.jobs[j] for j in sorted(self.jobs)]
+            return [j.status_dict() for j in jips]
+
+        def trackers_info(q: dict) -> list:
+            with self.lock:
+                rows = [(n, t.last_seen, t.blacklisted, t.failures, t.status)
+                        for n, t in sorted(self.trackers.items())]
+            return [{"name": n, "last_seen": seen, "blacklisted": bl,
+                     "failures": f, "status": st}
+                    for n, seen, bl, f, st in rows]
+
+        srv.add_json("cluster", cluster_info)
+        srv.add_json("jobs", jobs_info)
+        srv.add_json("job", lambda q: self._job(q["id"]).status_dict(),
+                     parameterized=True)
+        srv.add_json("counters", lambda q: self.get_counters(q["id"]),
+                     parameterized=True)
+        srv.add_json("tasks", lambda q: self.get_task_reports(
+            q["id"], q.get("kind", "map")), parameterized=True)
+        srv.add_json("trackers", trackers_info)
+        srv.add_json("metrics", lambda q: self.metrics.snapshot())
+        srv.add_json("conf", lambda q: {
+            k: self.conf.get(k) for k in sorted(self.conf.keys())})
+        return srv
 
     @property
     def address(self) -> tuple[str, int]:
@@ -126,6 +205,7 @@ class JobMaster:
             jip = JobInProgress(job_id, conf_dict, splits)
             self.jobs[str(job_id)] = jip
             self.history.job_submitted(jip)
+            self._mreg.incr("jobs_submitted")
             return str(job_id)
 
     def list_jobs(self) -> list[str]:
@@ -184,6 +264,7 @@ class JobMaster:
         except Exception as e:  # noqa: BLE001
             jip.error = jip.error or f"job finalization failed: {e}"
         self.history.job_finished(jip)
+        self._mreg.incr(f"jobs_{jip.state.lower()}")
 
     def get_map_completion_events(self, job_id: str, from_index: int = 0,
                                   max_events: int = 10_000) -> list:
@@ -221,6 +302,7 @@ class JobMaster:
     def heartbeat(self, status: dict, initial_contact: bool,
                   ask_for_new_task: bool, response_id: int) -> dict:
         name = status["tracker_name"]
+        self._mreg.incr("heartbeats")
         with self.lock:
             info = self.trackers.get(name)
             if info is None and not initial_contact:
@@ -281,6 +363,12 @@ class JobMaster:
 
             if ask_for_new_task and not info.blacklisted:
                 for task in self.scheduler.assign_tasks(status):
+                    if not task.is_map:
+                        self._mreg.incr("reduces_launched")
+                    elif task.run_on_tpu:
+                        self._mreg.incr("maps_launched_tpu")
+                    else:
+                        self._mreg.incr("maps_launched_cpu")
                     actions.append({"type": "launch",
                                     "job_id": str(task.attempt_id.task.job),
                                     "task": task.to_dict()})

@@ -103,15 +103,39 @@ class NodeRunner:
                                            name=f"{self.name}-heartbeat",
                                            daemon=True)
 
+        # instrumentation ≈ TaskTrackerInstrumentation/TaskTrackerMXBean
+        from tpumr.metrics import MetricsSystem
+        self.metrics = MetricsSystem(
+            "tasktracker",
+            period_s=conf.get_int("tpumr.metrics.period.ms", 10_000) / 1000)
+        self._mreg = self.metrics.new_registry(self.name)
+        self._mreg.set_gauge("running", lambda: dict(zip(
+            ("cpu_maps", "tpu_maps", "reduces"), self._counts())))
+        self._mreg.set_gauge("slots", lambda: {
+            "cpu": self.max_cpu_map_slots, "tpu": self.max_tpu_map_slots,
+            "reduce": self.max_reduce_slots})
+        self._http: Any = None
+        self._http_port = conf.get_int("mapred.task.tracker.http.port", -1)
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "NodeRunner":
         self._server.start()
         self._hb_thread.start()
+        self.metrics.start()
+        if self._http_port >= 0:
+            from tpumr.http import StatusHttpServer
+            srv = StatusHttpServer(self.name, port=self._http_port)
+            srv.add_json("status", lambda q: self._status_dict())
+            srv.add_json("metrics", lambda q: self.metrics.snapshot())
+            self._http = srv.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.metrics.stop()
+        if self._http is not None:
+            self._http.stop()
         self._server.stop()
         shutil.rmtree(self.local_root, ignore_errors=True)
 
@@ -271,6 +295,11 @@ class NodeRunner:
         with self.lock:
             self.running[aid] = status
             self.running_tasks[aid] = task
+        if not task.is_map:
+            self._mreg.incr("reduces_launched")
+        else:
+            self._mreg.incr("tpu_maps_launched" if task.run_on_tpu
+                            else "cpu_maps_launched")
         t = threading.Thread(target=self._run_task,
                              args=(job_id, task, status),
                              name=f"task-{aid}", daemon=True)

@@ -1,0 +1,15 @@
+"""Metrics system ≈ the reference's ``metrics2`` framework.
+
+(src/core/org/apache/hadoop/metrics2/impl/MetricsSystemImpl.java: named
+sources publish records to sinks on a period; sinks are pluggable —
+FileSink, Ganglia.) Here: a registry of counters/gauges per source, a
+`MetricsSystem` that snapshots all sources either on demand (the HTTP
+``/json/metrics`` endpoint — the MXBean analog) or on a period into
+sinks. Backend (CPU vs TPU) placement counts are first-class metrics —
+the reference's GPU observability was log-grep only (SURVEY.md §5).
+"""
+
+from tpumr.metrics.core import (FileSink, MetricsRegistry, MetricsSystem,
+                                MetricsSink)
+
+__all__ = ["FileSink", "MetricsRegistry", "MetricsSink", "MetricsSystem"]

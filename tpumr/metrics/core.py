@@ -1,0 +1,126 @@
+"""Registry, system, and sinks — see package docstring.
+
+≈ metrics2 concepts: MetricsRegistry (metrics2/lib/MetricsRegistry.java),
+MetricsSystemImpl (register/start/publish loop), MetricsSink SPI
+(metrics2/MetricsSink.java), FileSink (metrics2/sink/FileSink.java).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Protocol
+
+
+class MetricsRegistry:
+    """Thread-safe named counters + gauges for one source."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, Callable[[], Any]] = {}
+
+    def incr(self, name: str, amount: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def set_gauge(self, name: str, fn_or_value: Any) -> None:
+        """A callable is sampled at snapshot time; a value is stored."""
+        fn = fn_or_value if callable(fn_or_value) else (lambda: fn_or_value)
+        with self._lock:
+            self._gauges[name] = fn
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            out: dict[str, Any] = dict(self._counters)
+            gauges = list(self._gauges.items())
+        for name, fn in gauges:
+            try:
+                out[name] = fn()
+            except Exception as e:  # a broken gauge must not kill publish
+                out[name] = f"<error: {e}>"
+        return out
+
+
+class MetricsSink(Protocol):
+    def put_metrics(self, record: dict) -> None: ...
+
+
+class FileSink:
+    """JSON-lines metrics log ≈ metrics2/sink/FileSink.java."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+
+    def put_metrics(self, record: dict) -> None:
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+
+class MetricsSystem:
+    """Holds sources (registries), publishes snapshots to sinks on a
+    period, and serves pull-based snapshots (the /json/metrics endpoint)."""
+
+    def __init__(self, prefix: str, period_s: float = 10.0) -> None:
+        self.prefix = prefix
+        self.period_s = period_s
+        self._lock = threading.Lock()
+        self._sources: dict[str, MetricsRegistry] = {}
+        self._sinks: list[MetricsSink] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self, registry: MetricsRegistry) -> MetricsRegistry:
+        with self._lock:
+            self._sources[registry.name] = registry
+        return registry
+
+    def new_registry(self, name: str) -> MetricsRegistry:
+        return self.register(MetricsRegistry(name))
+
+    def add_sink(self, sink: MetricsSink) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def snapshot(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            sources = list(self._sources.items())
+        return {name: reg.snapshot() for name, reg in sources}
+
+    # ------------------------------------------------------------ publish
+
+    def start(self) -> "MetricsSystem":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name=f"metrics-{self.prefix}",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            has_sinks = bool(self._sinks)
+        if has_sinks:
+            # final flush so counters bumped since the last period aren't
+            # lost (the reference MetricsSystemImpl flushes on stop)
+            self.publish_once()
+
+    def publish_once(self) -> None:
+        record = {"prefix": self.prefix, "ts": time.time(),
+                  "sources": self.snapshot()}
+        with self._lock:
+            sinks = list(self._sinks)
+        for sink in sinks:
+            try:
+                sink.put_metrics(record)
+            except Exception:
+                pass  # a broken sink must not kill the publish loop
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.publish_once()
