@@ -1,0 +1,206 @@
+"""New-style context-object API ≈ org.apache.hadoop.mapreduce (Job/Mapper/
+Reducer with setup/cleanup lifecycles) — and, unlike the reference, the
+new API is TPU-wired (SURVEY.md §2.4: reference GPU was old-API only)."""
+
+import numpy as np
+
+from tpumr.fs import get_filesystem
+from tpumr.mapred.input_formats import DenseInputFormat, TextInputFormat
+from tpumr.mapreduce import Context, Job, Mapper, Partitioner, Reducer
+
+
+class TokenMapper(Mapper):
+    def setup(self, context):
+        self.setup_ran = True
+        context.get_counter("app", "mapper_setups").increment()
+
+    def map(self, key, value, context):
+        assert self.setup_ran
+        for w in value.split():
+            context.write(w, 1)
+
+    def cleanup(self, context):
+        context.get_counter("app", "mapper_cleanups").increment()
+
+
+class SumReducer(Reducer):
+    def setup(self, context):
+        self.seen = 0
+
+    def reduce(self, key, values, context):
+        total = sum(values)
+        self.seen += 1
+        context.write(key, total)
+
+    def cleanup(self, context):
+        context.get_counter("app", "reducer_groups").increment(self.seen)
+
+
+class TestNewApiWordCount:
+    def test_wordcount_with_lifecycle(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/na/in.txt", b"ab cd ab\ncd ab\n")
+        job = Job(name="new-api-wc")
+        job.add_input_path("mem:///na/in.txt")
+        job.set_output_path("mem:///na/out")
+        job.set_input_format(TextInputFormat)
+        job.set_mapper_class(TokenMapper)
+        job.set_reducer_class(SumReducer)
+        job.set_num_reduce_tasks(1)
+        assert job.wait_for_completion()
+        text = fs.read_bytes("/na/out/part-00000").decode()
+        assert dict(l.split("\t") for l in text.splitlines()) == \
+            {"ab": "3", "cd": "2"}
+        counters = job.counters.to_dict()["app"]
+        assert counters["mapper_setups"] >= 1
+        assert counters["mapper_cleanups"] >= 1
+        assert counters["reducer_groups"] == 2
+
+    def test_identity_defaults(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/na2/in.txt", b"x\ny\n")
+        job = Job(name="identity")
+        job.add_input_path("mem:///na2/in.txt")
+        job.set_output_path("mem:///na2/out")
+        job.set_input_format(TextInputFormat)
+        job.set_mapper_class(Mapper)     # identity
+        job.set_reducer_class(Reducer)   # identity
+        assert job.wait_for_completion()
+        text = fs.read_bytes("/na2/out/part-00000").decode()
+        assert len(text.splitlines()) == 2
+
+
+class EvenOddPartitioner(Partitioner):
+    def get_partition(self, key, value, num_partitions):
+        return int(key) % num_partitions
+
+
+class TestNewApiPartitioner:
+    def test_custom_partitioner(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/na3/in.txt",
+                       b"".join(b"%d\n" % i for i in range(10)))
+
+        class NumMapper(Mapper):
+            def map(self, key, value, context):
+                context.write(int(value), 1)
+
+        job = Job(name="parts")
+        job.add_input_path("mem:///na3/in.txt")
+        job.set_output_path("mem:///na3/out")
+        job.set_input_format(TextInputFormat)
+        job.set_mapper_class(NumMapper)
+        job.set_reducer_class(Reducer)
+        job.set_partitioner_class(EvenOddPartitioner)
+        job.set_num_reduce_tasks(2)
+        assert job.wait_for_completion()
+        part0 = fs.read_bytes("/na3/out/part-00000").decode()
+        keys0 = [int(l.split("\t")[0]) for l in part0.splitlines()]
+        assert keys0 and all(k % 2 == 0 for k in keys0)
+
+
+class TestNewApiTpuKernel:
+    def test_kernel_job_through_new_api(self):
+        """The device-kernel path composes with the new-API Job facade."""
+        from tpumr.ops.kmeans import clear_centroid_cache
+        clear_centroid_cache()
+        import io as _io
+        fs = get_filesystem("mem:///")
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(100, 4)).astype(np.float32)
+
+        def save(path, arr):
+            b = _io.BytesIO()
+            np.save(b, arr)
+            fs.write_bytes(path, b.getvalue())
+
+        save("/na4/points.npy", pts)
+        save("/na4/cents.npy", pts[:3])
+
+        class CentReducer(Reducer):
+            def reduce(self, key, values, context):
+                total, n = None, 0
+                for s, c in values:
+                    s = np.asarray(s)
+                    total = s if total is None else total + s
+                    n += c
+                context.write(key, (total / max(1, n)).tolist())
+
+        job = Job(name="kmeans-new-api")
+        job.add_input_path("mem:///na4/points.npy")
+        job.set_output_path("mem:///na4/out")
+        job.set_input_format(DenseInputFormat)
+        job.conf.set("tpumr.dense.split.rows", 50)
+        job.conf.set("tpumr.kmeans.centroids", "mem:///na4/cents.npy")
+        job.set_map_kernel("kmeans-assign")
+        job.set_reducer_class(CentReducer)
+        job.conf.set("tpumr.local.run.on.tpu", True)
+        assert job.wait_for_completion()
+        text = fs.read_bytes("/na4/out/part-00000").decode()
+        assert len(text.splitlines()) >= 1
+
+
+class TestNewApiCombiner:
+    def test_combiner_applied(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/na5/in.txt", b"q q q q\n" * 50)
+
+        class CountCombiner(Reducer):
+            def reduce(self, key, values, context):
+                context.write(key, sum(values))
+
+        job = Job(name="combine")
+        job.add_input_path("mem:///na5/in.txt")
+        job.set_output_path("mem:///na5/out")
+        job.set_input_format(TextInputFormat)
+        job.set_mapper_class(TokenMapper)
+        job.set_combiner_class(CountCombiner)
+        job.set_reducer_class(SumReducer)
+        job.conf.set("io.sort.mb", 1)
+        assert job.wait_for_completion()
+        text = fs.read_bytes("/na5/out/part-00000").decode()
+        assert text.strip() == "q\t200"
+        from tpumr.core.counters import TaskCounter
+        fw = job.counters.to_dict()[TaskCounter.FRAMEWORK_GROUP]
+        assert fw.get(TaskCounter.COMBINE_INPUT_RECORDS, 0) > 0
+
+    def test_empty_partition_still_runs_lifecycle(self):
+        # all keys partition to 0; partition 1's reducer sees zero groups
+        # but must still run setup/cleanup (reference Reducer.run contract)
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/na6/in.txt", b"same same\n")
+
+        class LifecycleReducer(Reducer):
+            def setup(self, context):
+                context.get_counter("app", "reduce_setups").increment()
+
+            def cleanup(self, context):
+                context.get_counter("app", "reduce_cleanups").increment()
+
+        job = Job(name="empty-part")
+        job.add_input_path("mem:///na6/in.txt")
+        job.set_output_path("mem:///na6/out")
+        job.set_input_format(TextInputFormat)
+        job.set_mapper_class(TokenMapper)
+        job.set_reducer_class(LifecycleReducer)
+        job.set_num_reduce_tasks(2)
+        assert job.wait_for_completion()
+        app = job.counters.to_dict()["app"]
+        assert app["reduce_setups"] == 2
+        assert app["reduce_cleanups"] == 2
+
+    def test_wait_for_completion_returns_false_on_failure(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/na7/in.txt", b"boom\n")
+
+        class FailingMapper(Mapper):
+            def map(self, key, value, context):
+                raise ValueError("intentional")
+
+        job = Job(name="fails")
+        job.add_input_path("mem:///na7/in.txt")
+        job.set_output_path("mem:///na7/out")
+        job.set_input_format(TextInputFormat)
+        job.set_mapper_class(FailingMapper)
+        assert job.wait_for_completion() is False
+        assert "intentional" in job.error or job.error

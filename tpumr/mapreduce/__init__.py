@@ -1,0 +1,251 @@
+"""New-style context-object API.
+
+≈ the reference's ``org.apache.hadoop.mapreduce`` package (Job.java,
+Mapper.java, Reducer.java — the context-object API added alongside the
+old ``mapred`` interfaces): users subclass ``Mapper``/``Reducer`` with
+``setup/map|reduce/cleanup(context)`` lifecycles and drive jobs through a
+``Job`` facade. Implemented as adapters over the mapred execution engine —
+one engine, two user APIs, exactly the reference's layering
+(mapreduce/** delegates to mapred core, SURVEY.md §2.4).
+
+Unlike the reference — where the new API was NOT GPU-wired (the GPU path
+was old-API pipes only, SURVEY.md §2.4) — device kernels work here too:
+``job.set_map_kernel(name)`` passes straight through to the TPU runner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from tpumr.mapred import api as old_api
+from tpumr.mapred.job_client import run_job as _run_job
+from tpumr.mapred.jobconf import JobConf
+
+__all__ = ["Job", "Mapper", "Reducer", "Partitioner", "Context"]
+
+
+class _Counter:
+    __slots__ = ("_reporter", "_group", "_name")
+
+    def __init__(self, reporter, group: str, name: str) -> None:
+        self._reporter = reporter
+        self._group = group
+        self._name = name
+
+    def increment(self, amount: int = 1) -> None:
+        self._reporter.incr_counter(self._group, self._name, amount)
+
+
+class Context:
+    """≈ TaskInputOutputContext: write + counters + conf + progress."""
+
+    def __init__(self, conf: Any, output: old_api.OutputCollector,
+                 reporter: old_api.Reporter) -> None:
+        self.conf = conf
+        self._output = output
+        self._reporter = reporter
+        #: current key/value, visible during map() ≈ getCurrentKey/Value
+        self.current_key: Any = None
+        self.current_value: Any = None
+
+    def write(self, key: Any, value: Any) -> None:
+        self._output.collect(key, value)
+
+    def get_counter(self, group: str, name: str) -> _Counter:
+        return _Counter(self._reporter, group, name)
+
+    def set_status(self, status: str) -> None:
+        self._reporter.set_status(status)
+
+    def progress(self) -> None:
+        self._reporter.progress()
+
+
+class Mapper:
+    """≈ org.apache.hadoop.mapreduce.Mapper: setup/map/cleanup, and an
+    overridable run() for whole-split control (the reference's
+    Mapper.run(Context))."""
+
+    def setup(self, context: Context) -> None:
+        pass
+
+    def map(self, key: Any, value: Any, context: Context) -> None:
+        context.write(key, value)  # identity default, as in the reference
+
+    def cleanup(self, context: Context) -> None:
+        pass
+
+    def run(self, records: Iterator[tuple], context: Context) -> None:
+        self.setup(context)
+        try:
+            for key, value in records:
+                context.current_key, context.current_value = key, value
+                self.map(key, value, context)
+        finally:
+            self.cleanup(context)
+
+
+class Reducer:
+    """≈ org.apache.hadoop.mapreduce.Reducer."""
+
+    def setup(self, context: Context) -> None:
+        pass
+
+    def reduce(self, key: Any, values: Iterator[Any],
+               context: Context) -> None:
+        for v in values:
+            context.write(key, v)
+
+    def cleanup(self, context: Context) -> None:
+        pass
+
+
+class Partitioner:
+    """≈ org.apache.hadoop.mapreduce.Partitioner."""
+
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------ adapters
+# Bridge new-API classes onto the mapred engine's runner/reducer seams.
+
+
+class _NewApiMapRunner(old_api.MapRunnable):
+    """Old-engine MapRunnable that drives a new-API Mapper.run()."""
+
+    def __init__(self) -> None:
+        self.conf: Any = None
+        self.mapper: Mapper | None = None
+
+    def configure(self, conf: Any) -> None:
+        self.conf = conf
+        from tpumr.utils.reflection import new_instance
+        cls = conf.get_class("tpumr.mapreduce.mapper.class", Mapper)
+        self.mapper = new_instance(cls)
+
+    def run(self, reader, output, reporter, task_ctx=None) -> None:
+        assert self.mapper is not None
+        self.mapper.run(iter(reader), Context(self.conf, output, reporter))
+
+
+class _NewApiReducerAdapter(old_api.Reducer):
+    """Old-engine Reducer wrapping a new-API Reducer. The engine's
+    ``begin_task`` seam hands over the collector before grouping, so
+    setup()/cleanup() run even for partitions with zero groups (the
+    reference's Reducer.run semantics)."""
+
+    _key = "tpumr.mapreduce.reducer.class"
+
+    def configure(self, conf: Any) -> None:
+        from tpumr.utils.reflection import new_instance
+        cls = conf.get_class(self._key, Reducer)
+        self._new = new_instance(cls)
+        self._conf = conf
+        self._ctx: Context | None = None
+
+    def _ensure_ctx(self, output, reporter) -> Context:
+        if self._ctx is None:
+            self._ctx = Context(self._conf, output, reporter)
+            self._new.setup(self._ctx)
+        else:
+            self._ctx._output = output
+            self._ctx._reporter = reporter
+        return self._ctx
+
+    def begin_task(self, output, reporter) -> None:
+        self._ensure_ctx(output, reporter)
+
+    def reduce(self, key, values, output, reporter):
+        self._new.reduce(key, values, self._ensure_ctx(output, reporter))
+
+    def close(self) -> None:
+        if self._ctx is not None:
+            self._new.cleanup(self._ctx)
+
+
+class _NewApiPartitionerAdapter(old_api.Partitioner):
+    def configure(self, conf: Any) -> None:
+        from tpumr.utils.reflection import new_instance
+        cls = conf.get_class("tpumr.mapreduce.partitioner.class", None)
+        self._new = new_instance(cls, conf) if cls else old_api.HashPartitioner()
+
+    def get_partition(self, key, value, num_partitions):
+        return self._new.get_partition(key, value, num_partitions)
+
+
+# ------------------------------------------------------------ Job facade
+
+
+class Job:
+    """≈ org.apache.hadoop.mapreduce.Job: configure + submit + wait."""
+
+    def __init__(self, conf: JobConf | None = None, name: str = "") -> None:
+        self.conf = conf or JobConf()
+        if name:
+            self.conf.set_job_name(name)
+
+    # configuration ------------------------------------------------------
+
+    def set_mapper_class(self, cls: type) -> None:
+        self.conf.set_class("tpumr.mapreduce.mapper.class", cls)
+        self.conf.set_map_runner_class(_NewApiMapRunner)
+
+    def set_reducer_class(self, cls: type) -> None:
+        self.conf.set_class("tpumr.mapreduce.reducer.class", cls)
+        self.conf.set_reducer_class(_NewApiReducerAdapter)
+
+    def set_combiner_class(self, cls: type) -> None:
+        # combiner runs through the old-API seam; new-API combiners are
+        # plain Reducer subclasses so the adapter applies unchanged
+        self.conf.set_class("tpumr.mapreduce.combiner.class", cls)
+        self.conf.set_combiner_class(_NewApiCombinerAdapter)
+
+    def set_partitioner_class(self, cls: type) -> None:
+        self.conf.set_class("tpumr.mapreduce.partitioner.class", cls)
+        self.conf.set_partitioner_class(_NewApiPartitionerAdapter)
+
+    def set_map_kernel(self, name: str) -> None:
+        """Device-kernel map — works with the new API here, unlike the
+        reference where GPU was old-API pipes only."""
+        self.conf.set_map_kernel(name)
+
+    def set_input_format(self, cls: type) -> None:
+        self.conf.set_input_format(cls)
+
+    def set_output_format(self, cls: type) -> None:
+        self.conf.set_output_format(cls)
+
+    def set_num_reduce_tasks(self, n: int) -> None:
+        self.conf.set_num_reduce_tasks(n)
+
+    def add_input_path(self, path: str) -> None:
+        self.conf.add_input_path(path)
+
+    def set_output_path(self, path: str) -> None:
+        self.conf.set_output_path(path)
+
+    # execution ----------------------------------------------------------
+
+    def wait_for_completion(self, verbose: bool = False) -> bool:
+        """Runs the job; returns False on job failure (the reference's
+        boolean contract — task errors surface via ``job.error``)."""
+        import sys
+        try:
+            result = _run_job(self.conf)
+        except Exception as e:  # engine raises on failed jobs
+            self.error = str(e)
+            if verbose:
+                print(f"job failed: {e}", file=sys.stderr)
+            return False
+        self._result = result
+        self.error = "" if result.successful else "job failed"
+        return result.successful
+
+    @property
+    def counters(self):
+        return getattr(self, "_result", None) and self._result.counters
+
+
+class _NewApiCombinerAdapter(_NewApiReducerAdapter):
+    _key = "tpumr.mapreduce.combiner.class"
