@@ -1,0 +1,178 @@
+"""Tools tier ≈ src/tools (DistCp, archives, rumen) + io.MapFile
+(SURVEY.md §2.2, §2.4)."""
+
+import json
+
+import pytest
+
+from tpumr.cli import main as cli_main
+from tpumr.fs import get_filesystem
+from tpumr.io import mapfile
+
+
+class TestMapFile:
+    def test_write_get_iterate(self):
+        fs = get_filesystem("mem:///")
+        with mapfile.Writer(fs, "/mf/table", index_interval=8) as w:
+            for i in range(0, 1000, 2):   # even keys only
+                w.append(f"k{i:06d}", i * 10)
+        with mapfile.Reader(fs, "/mf/table") as r:
+            assert r.get("k000000") == 0
+            assert r.get("k000498") == 4980
+            assert r.get("k000998") == 9980
+            assert r.get("k000499") is None          # odd: absent
+            assert r.get("a") is None                # before first
+            assert r.get("z") is None                # after last
+            k, v = r.get_closest("k000499")
+            assert k == "k000500" and v == 5000
+            assert len(list(r)) == 500
+
+    def test_duplicate_keys_across_index_boundary(self):
+        # 200 records with the same key and index_interval=128: get() must
+        # return the FIRST record's value, not the one at the 2nd index entry
+        fs = get_filesystem("mem:///")
+        with mapfile.Writer(fs, "/mf/dups", index_interval=128) as w:
+            for i in range(200):
+                w.append("same", i)
+            w.append("tail", 999)
+        with mapfile.Reader(fs, "/mf/dups") as r:
+            assert r.get("same") == 0
+            assert r.get("tail") == 999
+            k, v = r.get_closest("s")
+            assert k == "same" and v == 0
+
+    def test_rejects_out_of_order_keys(self):
+        fs = get_filesystem("mem:///")
+        with pytest.raises(ValueError, match="out of order"):
+            with mapfile.Writer(fs, "/mf/bad") as w:
+                w.append("b", 1)
+                w.append("a", 2)
+
+
+class TestDistCp:
+    def test_tree_copy_across_schemes(self, tmp_path):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/dc/src/a.txt", b"alpha")
+        fs.write_bytes("/dc/src/sub/b.txt", b"beta" * 1000)
+        dst = tmp_path / "out"
+        assert cli_main(["distcp", "mem:///dc/src", f"file://{dst}",
+                         "-m", "2"]) == 0
+        assert (dst / "a.txt").read_bytes() == b"alpha"
+        assert (dst / "sub/b.txt").read_bytes() == b"beta" * 1000
+
+    def test_update_skips_same_size(self, tmp_path):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/dc2/src/x.txt", b"12345")
+        dst = tmp_path / "out2"
+        assert cli_main(["distcp", "mem:///dc2/src", f"file://{dst}"]) == 0
+        # second run with -update: nothing breaks, file intact
+        assert cli_main(["distcp", "mem:///dc2/src", f"file://{dst}",
+                         "-update"]) == 0
+        assert (dst / "x.txt").read_bytes() == b"12345"
+
+    def test_single_file(self, tmp_path):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/dc3/one.bin", b"\x00\x01\x02")
+        assert cli_main(["distcp", "mem:///dc3/one.bin",
+                         f"file://{tmp_path}/one.bin"]) == 0
+        assert (tmp_path / "one.bin").read_bytes() == b"\x00\x01\x02"
+
+
+class TestArchive:
+    def test_create_list_read(self, capsys):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/ar/src/x.txt", b"XX")
+        fs.write_bytes("/ar/src/d/y.txt", b"YYYY")
+        fs.write_bytes("/ar/src/d/z.txt", b"Z" * 100)
+        assert cli_main(["archive", "mem:///ar/src",
+                         "mem:///ar/packed.tharch"]) == 0
+        assert "Archived 3 files" in capsys.readouterr().out
+
+        assert cli_main(["archive", "-ls", "mem:///ar/packed.tharch"]) == 0
+        listing = capsys.readouterr().out
+        assert "d/y.txt" in listing and "x.txt" in listing
+
+        # transparent reads through the tharch:// FileSystem
+        afs = get_filesystem("tharch://mem/ar/packed.tharch")
+        assert afs.read_bytes(
+            "tharch://mem/ar/packed.tharch/x.txt") == b"XX"
+        assert afs.read_bytes(
+            "tharch://mem/ar/packed.tharch/d/y.txt") == b"YYYY"
+        st = afs.get_status("tharch://mem/ar/packed.tharch/d")
+        assert st.is_dir
+        names = {str(s.path.name)
+                 for s in afs.list_status("tharch://mem/ar/packed.tharch/d")}
+        assert names == {"y.txt", "z.txt"}
+        with pytest.raises(FileNotFoundError):
+            afs.read_bytes("tharch://mem/ar/packed.tharch/nope")
+        with pytest.raises(PermissionError):
+            afs.delete("tharch://mem/ar/packed.tharch/x.txt")
+
+    def test_archive_as_job_input(self):
+        """MR over archived inputs — the many-small-files use case."""
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/aj/src/f1.txt", b"one two\n")
+        fs.write_bytes("/aj/src/f2.txt", b"two three\n")
+        assert cli_main(["archive", "mem:///aj/src",
+                         "mem:///aj/a.tharch"]) == 0
+        assert cli_main(["examples", "wordcount",
+                         "tharch://mem/aj/a.tharch/f1.txt,"
+                         "tharch://mem/aj/a.tharch/f2.txt",
+                         "mem:///aj/out", "--cpu-only"]) == 0
+        text = fs.read_bytes("/aj/out/part-00000").decode()
+        counts = dict(l.split("\t") for l in text.splitlines())
+        assert counts == {"one": "1", "two": "2", "three": "1"}
+
+
+class TestRumen:
+    def test_traces_from_history(self, tmp_path, capsys):
+        hist = tmp_path / "hist"
+        hist.mkdir()
+        events = [
+            {"event": "JOB_SUBMITTED", "job_id": "job_x_1",
+             "job_name": "demo", "num_maps": 2, "num_reduces": 1,
+             "kernel": "kmeans-assign", "ts": 1.0},
+            {"event": "TASK_FINISHED", "attempt_id": "attempt_m1_0",
+             "is_map": True, "run_on_tpu": True, "tpu_device_id": 0,
+             "runtime": 0.5, "tracker": "t0", "ts": 2.0},
+            {"event": "TASK_FINISHED", "attempt_id": "attempt_m2_0",
+             "is_map": True, "run_on_tpu": False, "tpu_device_id": -1,
+             "runtime": 2.0, "tracker": "t0", "ts": 3.0},
+            {"event": "JOB_FINISHED", "state": "SUCCEEDED",
+             "wall_time": 3.0, "acceleration_factor": 4.0, "ts": 4.0},
+        ]
+        with open(hist / "job_x_1.jsonl", "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+        assert cli_main(["rumen", str(hist)]) == 0
+        traces = json.loads(capsys.readouterr().out)
+        assert len(traces) == 1
+        t = traces[0]
+        assert t["job_id"] == "job_x_1" and t["outcome"] == "SUCCEEDED"
+        assert t["cpu_task_mean"] == 2.0 and t["tpu_task_mean"] == 0.5
+        backends = {x["backend"] for x in t["tasks"]}
+        assert backends == {"cpu", "tpu"}
+
+    def test_live_cluster_history_has_task_events(self, tmp_path):
+        from tpumr.mapred.jobconf import JobConf
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        from tpumr.mapred.job_client import JobClient
+        from tpumr.tools.rumen import build_traces
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0,
+                           conf=conf) as c:
+            fs = get_filesystem("mem:///")
+            fs.write_bytes("/ru/in.txt", b"p q\n" * 20)
+            jc = c.create_job_conf()
+            jc.set_input_paths("mem:///ru/in.txt")
+            jc.set_output_path("mem:///ru/out")
+            from tpumr.ops.wordcount import WordCountCpuMapper
+            from tpumr.examples.basic import LongSumReducer
+            jc.set_class("mapred.mapper.class", WordCountCpuMapper)
+            jc.set_class("mapred.reducer.class", LongSumReducer)
+            assert JobClient(jc).run_job(jc).successful
+        traces = build_traces(str(tmp_path))
+        assert traces and traces[0]["outcome"] == "SUCCEEDED"
+        assert traces[0]["tasks"], "task events must be in history"
+        assert traces[0]["cpu_task_mean"] is not None

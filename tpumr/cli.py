@@ -38,6 +38,9 @@ Clients:
   pipes ...            submit an external-binary (pipes) job
   streaming ...        submit a script (streaming) job
   examples NAME ...    run an example program (examples -h lists them)
+  distcp SRC DST       distributed copy (any scheme to any scheme)
+  archive SRC DEST.tharch | archive -ls ARCH   pack/list archives
+  rumen HISTORY_DIR    extract job traces from history
   version              print the version
 """
 
@@ -251,6 +254,21 @@ def cmd_job(conf, argv: list[str]) -> int:
     return 255
 
 
+def cmd_distcp(conf, argv: list[str]) -> int:
+    from tpumr.tools.distcp import main as distcp_main
+    return distcp_main(argv)
+
+
+def cmd_archive(conf, argv: list[str]) -> int:
+    from tpumr.tools.archive import main as archive_main
+    return archive_main(argv)
+
+
+def cmd_rumen(conf, argv: list[str]) -> int:
+    from tpumr.tools.rumen import main as rumen_main
+    return rumen_main(argv)
+
+
 def cmd_pipes(conf, argv: list[str]) -> int:
     from tpumr.pipes.submitter import main as pipes_main
     return pipes_main(argv)
@@ -283,6 +301,9 @@ COMMANDS = {
     "job": cmd_job,
     "pipes": cmd_pipes,
     "streaming": cmd_streaming,
+    "distcp": cmd_distcp,
+    "archive": cmd_archive,
+    "rumen": cmd_rumen,
     "examples": cmd_examples,
     "version": cmd_version,
 }

@@ -104,7 +104,8 @@ class FileSystem(ABC):
     _cache: dict[str, "FileSystem"] = {}
     #: schemes registered on first use (module imported lazily to avoid
     #: pulling daemon deps into every fs consumer)
-    _lazy_schemes: dict[str, str] = {"tdfs": "tpumr.dfs.dfs_filesystem"}
+    _lazy_schemes: dict[str, str] = {"tdfs": "tpumr.dfs.dfs_filesystem",
+                                     "tharch": "tpumr.tools.archive"}
 
     # ------------------------------------------------------------ dispatch
 
@@ -222,8 +223,18 @@ class FileSystem(ABC):
                 out.append(st)
         return out
 
-    def copy(self, src: "str | Path", dst_fs: "FileSystem", dst: "str | Path") -> None:
-        dst_fs.write_bytes(dst, self.read_bytes(src))
+    def copy(self, src: "str | Path", dst_fs: "FileSystem",
+             dst: "str | Path", chunk_size: int = 1 << 20) -> int:
+        """Chunked stream copy (never materializes the whole file);
+        returns bytes copied."""
+        total = 0
+        with self.open(src) as fin, dst_fs.create(dst) as fout:
+            while True:
+                chunk = fin.read(chunk_size)
+                if not chunk:
+                    return total
+                fout.write(chunk)
+                total += len(chunk)
 
     def content_length(self, path: "str | Path") -> int:
         """Total bytes under path (file or directory tree)."""

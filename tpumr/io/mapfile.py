@@ -1,0 +1,110 @@
+"""MapFile — a sorted, indexed SequenceFile directory.
+
+≈ ``org.apache.hadoop.io.MapFile`` (reference: src/core/org/apache/hadoop/
+io/MapFile.java): a directory holding ``data`` (records in key order) and
+``index`` (every Nth key → seek position). ``Reader.get(key)`` bisects the
+in-memory index and scans at most one index interval of the data file.
+Keys must be appended in non-decreasing order (the reference's checkKey).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Iterator
+
+from tpumr.fs.filesystem import FileSystem, Path
+from tpumr.io import sequencefile
+
+DATA_NAME = "data"
+INDEX_NAME = "index"
+
+
+class Writer:
+    def __init__(self, fs: FileSystem, dirname: "str | Path",
+                 index_interval: int = 128, codec: str = "none") -> None:
+        self.dir = Path(str(dirname))
+        fs.mkdirs(self.dir)
+        self._data_stream = fs.create(self.dir.child(DATA_NAME))
+        self._index_stream = fs.create(self.dir.child(INDEX_NAME))
+        # small blocks so an index interval spans whole blocks cheaply
+        self._data = sequencefile.Writer(self._data_stream, codec=codec,
+                                         block_records=min(64,
+                                                           index_interval))
+        self._index = sequencefile.Writer(self._index_stream)
+        self.index_interval = max(1, index_interval)
+        self._count = 0
+        self._last_key: Any = None
+
+    def append(self, key: Any, value: Any) -> None:
+        if self._last_key is not None and key < self._last_key:
+            raise ValueError(f"keys out of order: {key!r} after "
+                             f"{self._last_key!r}")
+        if self._count % self.index_interval == 0:
+            pos = self._data.sync_pos()
+            self._index.append(key, pos)
+        self._data.append(key, value)
+        self._last_key = key
+        self._count += 1
+
+    def close(self) -> None:
+        self._data.close()
+        self._index.close()
+        self._data_stream.close()
+        self._index_stream.close()
+
+    def __enter__(self) -> "Writer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class Reader:
+    def __init__(self, fs: FileSystem, dirname: "str | Path") -> None:
+        self.dir = Path(str(dirname))
+        with fs.open(self.dir.child(INDEX_NAME)) as f:
+            self._index: list[tuple[Any, int]] = list(
+                sequencefile.Reader(f))
+        self._keys = [k for k, _ in self._index]
+        self._data_stream = fs.open(self.dir.child(DATA_NAME))
+        self._data = sequencefile.Reader(self._data_stream)
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        """Value of the FIRST record with exactly ``key`` (≈
+        MapFile.Reader.get). bisect_left so duplicate keys spanning an
+        index boundary scan from the interval holding the first one."""
+        if not self._keys or key < self._keys[0]:
+            return default
+        i = max(0, bisect.bisect_left(self._keys, key) - 1)
+        self._data.sync(self._index[i][1])
+        for k, v in self._data:
+            if k == key:
+                return v
+            if k > key:
+                return default
+        return default
+
+    def get_closest(self, key: Any, default: Any = None) -> Any:
+        """(key, value) of the first record with key >= ``key``
+        (≈ MapFile.Reader.getClosest)."""
+        if not self._index:
+            return default
+        i = max(0, bisect.bisect_left(self._keys, key) - 1)
+        self._data.sync(self._index[i][1])
+        for k, v in self._data:
+            if k >= key:
+                return (k, v)
+        return default
+
+    def __iter__(self) -> Iterator[tuple[Any, Any]]:
+        self._data.sync(0)
+        return iter(self._data)
+
+    def close(self) -> None:
+        self._data_stream.close()
+
+    def __enter__(self) -> "Reader":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

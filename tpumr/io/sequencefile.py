@@ -80,10 +80,18 @@ class Writer:
         self._buf.clear()
 
     def sync_now(self) -> None:
+        self.sync_pos()
+
+    def sync_pos(self) -> int:
+        """Flush pending records, emit a sync marker, and return the escape
+        offset — a position where ``Reader.sync(pos)`` lands exactly (the
+        seekable-entry contract MapFile indexes rely on)."""
         self._flush_block()
+        pos = self._out.tell()
         self._out.write(struct.pack(">I", _SYNC_ESCAPE))
         self._out.write(self._sync)
         self._since_sync = 0
+        return pos
 
     def close(self) -> None:
         """Flush pending records. The caller owns (and closes) the stream."""

@@ -104,6 +104,9 @@ class JobInProgress:
         self._pending_reduces = set(range(self.num_reduces))
         self.finished_maps = 0
         self.finished_reduces = 0
+        #: attempts whose terminal outcome is already in the history log
+        #: (heartbeat replays re-deliver terminal statuses)
+        self.history_logged: set[str] = set()
         # --- per-backend profiling (running sums, O(1) per update) ---
         self.finished_cpu_maps = 0
         self.finished_tpu_maps = 0

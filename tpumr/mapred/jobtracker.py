@@ -328,6 +328,22 @@ class JobMaster:
                 if jip is not None:
                     before = jip.state
                     jip.update_task_status(ts, shuffle_addr)
+                    aid = str(ts.attempt_id)
+                    if ts.state in TaskState.TERMINAL \
+                            and aid not in jip.history_logged:
+                        # replayed heartbeats re-deliver terminal statuses;
+                        # log each attempt's outcome exactly once
+                        jip.history_logged.add(aid)
+                        event = {TaskState.SUCCEEDED: "TASK_FINISHED",
+                                 TaskState.KILLED: "TASK_KILLED"}.get(
+                            ts.state, "TASK_FAILED")
+                        self.history.task_event(
+                            job_id, event, attempt_id=aid,
+                            is_map=ts.is_map, run_on_tpu=ts.run_on_tpu,
+                            tpu_device_id=ts.tpu_device_id,
+                            runtime=max(0.0, (ts.finish_time or 0)
+                                        - (ts.start_time or 0)),
+                            tracker=name)
                     if ts.state in (TaskState.FAILED, TaskState.KILLED):
                         # a dead attempt must not keep the commit grant —
                         # otherwise its re-run is denied commit and output

@@ -63,12 +63,14 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
         writer.write(k, v)
 
     collector = OutputCollector(emit)
-    # optional seam: a reducer may take the collector up front so its
-    # lifecycle (new-API setup/cleanup) runs even for zero-group partitions
-    begin = getattr(reducer, "begin_task", None)
-    if begin is not None:
-        begin(collector, reporter)
     try:
+        # optional seam: a reducer may take the collector up front so its
+        # lifecycle (new-API setup/cleanup) runs even for zero-group
+        # partitions; inside the try so a raising setup still closes the
+        # writer and the reducer
+        begin = getattr(reducer, "begin_task", None)
+        if begin is not None:
+            begin(collector, reporter)
         for key, values in group_by_key(merged, gk, reporter):
             reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                                   TaskCounter.REDUCE_INPUT_GROUPS)

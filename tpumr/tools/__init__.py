@@ -1,0 +1,3 @@
+"""Tools tier ≈ the reference's ``src/tools/org/apache/hadoop/tools``:
+DistCp (distributed copy), archives (HAR analog), and the rumen history
+trace extractor."""

@@ -1,0 +1,195 @@
+"""Archives — pack many small files into one indexed container.
+
+≈ ``src/tools/org/apache/hadoop/tools/HadoopArchives.java`` + the ``har://``
+FileSystem: an archive directory holds ``_index`` (a MapFile of
+relative-path → (offset, length)) and ``part-0`` (concatenated file
+bytes). The ``tharch`` FileSystem scheme serves transparent reads:
+
+    tharch://<underlying-scheme>/path/to/name.tharch/inner/path
+
+so MapReduce inputs can point inside an archive exactly like the
+reference's har:// paths (the many-small-files problem: one container,
+no per-file namespace cost).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+from typing import Any, BinaryIO
+
+from tpumr.fs import get_filesystem
+from tpumr.fs.filesystem import (BlockLocation, FileStatus, FileSystem,
+                                 Path)
+from tpumr.io import mapfile
+
+SUFFIX = ".tharch"
+INDEX = "_index"
+PART = "part-0"
+
+
+def create_archive(src_dir: str, archive_dir: str, conf: Any = None) -> int:
+    """Pack ``src_dir`` (recursively) into ``archive_dir`` (a *.tharch
+    directory on the same or another fs). Returns number of files packed."""
+    if not archive_dir.rstrip("/").endswith(SUFFIX):
+        raise ValueError(f"archive name must end with {SUFFIX}")
+    sfs = get_filesystem(src_dir, conf)
+    afs = get_filesystem(archive_dir, conf)
+    afs.mkdirs(archive_dir)
+    base = str(sfs.get_status(src_dir).path)
+    files = sorted(sfs.list_files(src_dir, recursive=True),
+                   key=lambda st: str(st.path))
+    entries: list[tuple[str, tuple[int, int]]] = []
+    offset = 0
+    with afs.create(Path(archive_dir).child(PART)) as part:
+        for st in files:
+            # stream in chunks — one huge source file must not be
+            # materialized in memory
+            length = 0
+            with sfs.open(st.path) as fin:
+                while True:
+                    chunk = fin.read(1 << 20)
+                    if not chunk:
+                        break
+                    part.write(chunk)
+                    length += len(chunk)
+            rel = str(st.path)[len(base):].lstrip("/")
+            entries.append((rel, (offset, length)))
+            offset += length
+    entries.sort()
+    with mapfile.Writer(afs, Path(archive_dir).child(INDEX)) as w:
+        for rel, span in entries:
+            w.append(rel, span)
+    return len(entries)
+
+
+def list_archive(archive_dir: str, conf: Any = None) -> list[tuple[str, int]]:
+    afs = get_filesystem(archive_dir, conf)
+    with mapfile.Reader(afs, Path(archive_dir).child(INDEX)) as r:
+        return [(k, span[1]) for k, span in r]
+
+
+class ArchiveFileSystem(FileSystem):
+    """Read-only view into archives ≈ HarFileSystem. The authority names
+    the underlying scheme; the path is split at the ``.tharch`` component."""
+
+    scheme = "tharch"
+
+    def __init__(self, conf: Any = None, authority: str = "") -> None:
+        self.conf = conf
+        self.under_scheme = authority or "file"
+
+    # ------------------------------------------------------------ helpers
+
+    def _split(self, path: "str | Path") -> tuple[str, str]:
+        """-> (underlying archive dir URI, inner path)."""
+        s = str(path)
+        if "://" in s:
+            s = s.split("://", 1)[1]
+            s = "/" + s.split("/", 1)[1] if "/" in s else "/"
+        marker = SUFFIX + "/"
+        if s.endswith(SUFFIX):
+            arch, inner = s, ""
+        elif marker in s:
+            idx = s.index(marker) + len(SUFFIX)
+            arch, inner = s[:idx], s[idx + 1:]
+        else:
+            raise FileNotFoundError(f"no {SUFFIX} component in {path}")
+        return f"{self.under_scheme}://{arch}", inner
+
+    def _index(self, arch_uri: str) -> "mapfile.Reader":
+        afs = get_filesystem(arch_uri, self.conf)
+        return mapfile.Reader(afs, Path(arch_uri).child(INDEX))
+
+    # ------------------------------------------------------------ SPI
+
+    def open(self, path: "str | Path") -> BinaryIO:
+        arch, inner = self._split(path)
+        with self._index(arch) as idx:
+            span = idx.get(inner)
+        if span is None:
+            raise FileNotFoundError(f"{inner!r} not in archive {arch}")
+        offset, length = span
+        afs = get_filesystem(arch, self.conf)
+        with afs.open(Path(arch).child(PART)) as f:
+            f.seek(offset)
+            return io.BytesIO(f.read(length))
+
+    def create(self, path, overwrite: bool = True) -> BinaryIO:
+        raise PermissionError("tharch archives are immutable (re-create "
+                              "with `tpumr archive`)")
+
+    append = create
+
+    def delete(self, path, recursive: bool = False) -> bool:
+        raise PermissionError("tharch archives are immutable")
+
+    def rename(self, src, dst) -> bool:
+        raise PermissionError("tharch archives are immutable")
+
+    def mkdirs(self, path) -> bool:
+        raise PermissionError("tharch archives are immutable")
+
+    def exists(self, path: "str | Path") -> bool:
+        try:
+            self.get_status(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def get_status(self, path: "str | Path") -> FileStatus:
+        arch, inner = self._split(path)
+        if not inner:
+            return FileStatus(Path(str(path)), is_dir=True)
+        with self._index(arch) as idx:
+            span = idx.get(inner)
+            if span is not None:
+                return FileStatus(Path(str(path)), length=span[1])
+            prefix = inner.rstrip("/") + "/"
+            for k, _ in idx:
+                if k.startswith(prefix):
+                    return FileStatus(Path(str(path)), is_dir=True)
+        raise FileNotFoundError(str(path))
+
+    def list_status(self, path: "str | Path") -> list[FileStatus]:
+        arch, inner = self._split(path)
+        prefix = inner.rstrip("/") + "/" if inner else ""
+        seen: dict[str, FileStatus] = {}
+        base = str(path).rstrip("/")
+        with self._index(arch) as idx:
+            for k, (off, length) in idx:
+                if not k.startswith(prefix):
+                    continue
+                rest = k[len(prefix):]
+                head = rest.split("/", 1)[0]
+                full = Path(f"{base}/{head}")
+                if "/" in rest:
+                    seen.setdefault(head, FileStatus(full, is_dir=True))
+                else:
+                    seen[head] = FileStatus(full, length=length)
+        return [seen[k] for k in sorted(seen)]
+
+    def get_block_locations(self, path, offset: int,
+                            length: int) -> list[BlockLocation]:
+        return [BlockLocation([], offset, length)]
+
+
+FileSystem.register("tharch", ArchiveFileSystem)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr archive")
+    ap.add_argument("-ls", action="store_true", dest="ls",
+                    help="list an existing archive instead of creating")
+    ap.add_argument("paths", nargs="+",
+                    help="create: SRC DEST.tharch | list: ARCHIVE.tharch")
+    args = ap.parse_args(argv)
+    if args.ls:
+        for name, size in list_archive(args.paths[0]):
+            print(f"{size:>12} {name}")
+        return 0
+    if len(args.paths) != 2:
+        ap.error("create needs SRC and DEST.tharch")
+    n = create_archive(args.paths[0], args.paths[1])
+    print(f"Archived {n} files into {args.paths[1]}")
+    return 0

@@ -1,0 +1,105 @@
+"""DistCp — distributed copy as a map-only MapReduce job.
+
+≈ ``src/tools/org/apache/hadoop/tools/DistCp.java``: expand the source
+tree into a file list, one map task per batch of files, each map copies
+its files through the FileSystem SPI (so any scheme→any scheme works:
+local→tdfs, mem→local, …), preserving relative paths. ``-update`` skips
+files whose destination already exists with the same length.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from tpumr.fs import get_filesystem
+from tpumr.fs.filesystem import Path
+from tpumr.mapred.api import Mapper
+from tpumr.mapred.input_formats import NLineInputFormat
+from tpumr.mapred.job_client import run_job
+from tpumr.mapred.jobconf import JobConf
+
+
+class DistCpMapper(Mapper):
+    """Input record "<src-uri><TAB><dst-uri>": copy one file."""
+
+    def configure(self, conf) -> None:
+        self._update = bool(conf.get("tpumr.distcp.update", False))
+        self._conf = conf
+
+    def map(self, key, value, output, reporter):
+        s = value.decode() if isinstance(value, (bytes, bytearray)) else value
+        src, _, dst = s.partition("\t")
+        if not dst:
+            return
+        sfs = get_filesystem(src, self._conf)
+        dfs = get_filesystem(dst, self._conf)
+        length = sfs.get_status(src).length
+        if self._update and dfs.exists(dst) \
+                and dfs.get_status(dst).length == length:
+            reporter.incr_counter("distcp", "skipped")
+            return
+        copied = sfs.copy(src, dfs, dst)
+        reporter.incr_counter("distcp", "copied")
+        reporter.incr_counter("distcp", "bytes", copied)
+
+
+def build_file_list(src: str, dst: str, conf=None) -> list[str]:
+    """Expand src (file or tree) into "<src>\t<dst>" copy records."""
+    sfs = get_filesystem(src, conf)
+    st = sfs.get_status(src)
+    pairs: list[str] = []
+    if not st.is_dir:
+        name = Path(src).name
+        dfs = get_filesystem(dst, conf)
+        target = (str(Path(dst).child(name))
+                  if dfs.exists(dst) and dfs.get_status(dst).is_dir else dst)
+        return [f"{src}\t{target}"]
+    base = str(st.path)
+    for f in sfs.list_files(src, recursive=True):
+        rel = str(f.path)[len(base):].lstrip("/")
+        pairs.append(f"{f.path}\t{dst.rstrip('/')}/{rel}")
+    return sorted(pairs)
+
+
+def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
+           conf: JobConf | None = None) -> bool:
+    conf = conf or JobConf()
+    pairs = build_file_list(src, dst, conf)
+    if not pairs:
+        return True
+    # the staging listing must be readable by remote task processes, so it
+    # lives NEXT TO the destination (a shared fs by definition) unless the
+    # caller overrides — mem:// scratch would be client-process-local
+    work = conf.get("tpumr.distcp.work",
+                    dst.rstrip("/") + ".distcp-work")
+    listing = f"{work.rstrip('/')}/files.txt"
+    get_filesystem(listing, conf).write_bytes(
+        listing, ("\n".join(pairs) + "\n").encode())
+    per_map = max(1, (len(pairs) + maps - 1) // maps)
+    conf.set_job_name("distcp")
+    conf.set_input_paths(listing)
+    conf.set_output_path(f"{work.rstrip('/')}/out")
+    conf.set_input_format(NLineInputFormat)
+    conf.set("mapred.line.input.format.linespermap", per_map)
+    conf.set("tpumr.distcp.update", update)
+    conf.set_mapper_class(DistCpMapper)
+    conf.set_num_reduce_tasks(0)
+    from tpumr.mapred.output_formats import NullOutputFormat
+    conf.set_output_format(NullOutputFormat)
+    try:
+        return run_job(conf).successful
+    finally:
+        get_filesystem(work, conf).delete(work, recursive=True)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr distcp")
+    ap.add_argument("src")
+    ap.add_argument("dst")
+    ap.add_argument("-m", "--maps", type=int, default=4)
+    ap.add_argument("-update", action="store_true",
+                    help="skip files already at the destination with the "
+                         "same size")
+    args = ap.parse_args(argv)
+    return 0 if distcp(args.src, args.dst, maps=args.maps,
+                       update=args.update) else 1

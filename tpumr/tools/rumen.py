@@ -1,0 +1,83 @@
+"""Rumen-lite — job-history trace extraction.
+
+≈ ``src/tools/org/apache/hadoop/tools/rumen`` (TraceBuilder: parse job
+history into machine-readable traces for simulation/analysis). Input is
+the history directory's JSON-lines event files; output is one trace
+object per job with per-task runtimes split by backend — the exact data
+the hybrid scheduler's profiling consumes, made available offline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any
+
+from tpumr.mapred.history import JobHistory
+
+
+def build_trace(events: list[dict]) -> dict[str, Any]:
+    """One job's event stream → trace (≈ rumen LoggedJob)."""
+    trace: dict[str, Any] = {"tasks": []}
+    attempts: dict[str, dict] = {}
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "JOB_SUBMITTED":
+            trace.update(job_id=ev.get("job_id"), name=ev.get("job_name"),
+                         num_maps=ev.get("num_maps"),
+                         num_reduces=ev.get("num_reduces"),
+                         kernel=ev.get("kernel"),
+                         submit_time=ev.get("ts"))
+        elif kind == "JOB_FINISHED":
+            trace.update(outcome=ev.get("state"),
+                         wall_time=ev.get("wall_time"),
+                         acceleration_factor=ev.get("acceleration_factor"))
+        elif kind in ("TASK_FINISHED", "TASK_FAILED", "TASK_KILLED"):
+            attempt = ev.get("attempt_id", "")
+            rec = attempts.setdefault(attempt, {"attempt_id": attempt})
+            rec.update(
+                outcome={"TASK_FINISHED": "SUCCEEDED",
+                         "TASK_KILLED": "KILLED"}.get(kind, "FAILED"),
+                is_map=ev.get("is_map"),
+                backend="tpu" if ev.get("run_on_tpu") else "cpu",
+                device=ev.get("tpu_device_id"),
+                runtime=ev.get("runtime"),
+                tracker=ev.get("tracker"))
+    trace["tasks"] = sorted(attempts.values(),
+                            key=lambda r: r["attempt_id"])
+    done = [t for t in trace["tasks"] if t.get("outcome") == "SUCCEEDED"]
+    cpu = [t["runtime"] for t in done
+           if t.get("backend") == "cpu" and t.get("runtime")]
+    tpu = [t["runtime"] for t in done
+           if t.get("backend") == "tpu" and t.get("runtime")]
+    trace["cpu_task_mean"] = sum(cpu) / len(cpu) if cpu else None
+    trace["tpu_task_mean"] = sum(tpu) / len(tpu) if tpu else None
+    return trace
+
+
+def build_traces(history_dir: str) -> list[dict]:
+    out = []
+    if not os.path.isdir(history_dir):
+        return out
+    for f in sorted(os.listdir(history_dir)):
+        if f.endswith(".jsonl"):
+            out.append(build_trace(
+                JobHistory.read(os.path.join(history_dir, f))))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr rumen")
+    ap.add_argument("history_dir")
+    ap.add_argument("-o", "--output", default="-",
+                    help="trace file (JSON, default stdout)")
+    args = ap.parse_args(argv)
+    traces = build_traces(args.history_dir)
+    text = json.dumps(traces, indent=2, default=str)
+    if args.output == "-":
+        print(text)
+    else:
+        with open(args.output, "w") as f:
+            f.write(text)
+    return 0
