@@ -18,6 +18,14 @@ import time
 from typing import Any
 
 
+def _json_safe(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
 class JobHistory:
     def __init__(self, conf: Any) -> None:
         self.dir = conf.get("tpumr.history.dir") if conf else None
@@ -40,7 +48,47 @@ class JobHistory:
             "num_maps": jip.num_maps,
             "num_reduces": jip.num_reduces,
             "kernel": jip.conf.get("tpumr.map.kernel"),
+            # full submission payload so a restarted master can replay the
+            # job (≈ RecoveryManager reading the job-info staging file)
+            "conf": {k: v for k, v in jip.conf.items()
+                     if _json_safe(v)},
+            # keys whose values can't ride the wire (in-process class
+            # objects): recovery refuses to replay such jobs rather than
+            # resubmitting them broken
+            "conf_dropped": sorted(k for k, v in jip.conf.items()
+                                   if not _json_safe(v)),
+            "splits": [t.split for t in jip.maps],
         })
+
+    def job_recovered(self, old_job_id: str, new_job_id: str) -> None:
+        """Marks the interrupted job as resubmitted (so a second restart
+        doesn't replay it again)."""
+        self._write(old_job_id, {"event": "JOB_RECOVERED",
+                                 "job_id": old_job_id,
+                                 "new_job_id": new_job_id})
+
+    def incomplete_jobs(self) -> list[dict]:
+        """JOB_SUBMITTED events of jobs with no terminal/recovered marker —
+        the restart-recovery work list (≈ RecoveryManager.recover,
+        JobTracker.java:1203)."""
+        import glob
+        if not self.dir:
+            return []
+        out = []
+        for path in sorted(glob.glob(os.path.join(self.dir, "*.jsonl"))):
+            submitted = None
+            finished = False
+            for ev in self.read(path):
+                kind = ev.get("event")
+                if kind == "JOB_SUBMITTED":
+                    submitted = ev
+                elif kind in ("JOB_FINISHED", "JOB_RECOVERED",
+                              "JOB_RECOVERY_FAILED"):
+                    finished = True
+            if submitted is not None and not finished \
+                    and submitted.get("conf") is not None:
+                out.append(submitted)
+        return out
 
     def job_finished(self, jip: Any) -> None:
         self._write(str(jip.job_id), {

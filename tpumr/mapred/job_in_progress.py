@@ -107,6 +107,7 @@ class JobInProgress:
         #: attempts whose terminal outcome is already in the history log
         #: (heartbeat replays re-deliver terminal statuses)
         self.history_logged: set[str] = set()
+        self.speculative_map_tasks = 0
         # --- per-backend profiling (running sums, O(1) per update) ---
         self.finished_cpu_maps = 0
         self.finished_tpu_maps = 0
@@ -190,8 +191,11 @@ class JobInProgress:
         obtainNewNonLocalMapTask (selection path of
         JobQueueTaskScheduler.java:306-317)."""
         with self.lock:
-            if self.state != JobState.RUNNING or not self._pending_maps:
+            if self.state != JobState.RUNNING:
                 return None
+            if not self._pending_maps:
+                return self._obtain_speculative_map(host, run_on_tpu,
+                                                    tpu_device_id)
             local = self.host_cache.get(host, set()) & self._pending_maps
             idx = min(local) if local else min(self._pending_maps)
             self._pending_maps.discard(idx)
@@ -206,6 +210,51 @@ class JobInProgress:
             return Task(attempt, partition=idx, num_reduces=self.num_reduces,
                         split=tip.split, num_maps=len(self.maps),
                         run_on_tpu=run_on_tpu, tpu_device_id=tpu_device_id)
+
+    def _obtain_speculative_map(self, host: str, run_on_tpu: bool,
+                                tpu_device_id: int) -> Task | None:
+        """Straggler mitigation ≈ JobInProgress.hasSpeculativeMap /
+        speculativeMapTasks (JobInProgress.java:2777): when all maps are
+        assigned but some run much longer than the completed mean, issue a
+        duplicate attempt; first completion wins (the loser is killed by
+        the master). Caller holds self.lock."""
+        if not self.speculative or self.finished_maps == 0:
+            return None
+        done = self.finished_maps
+        mean = ((self._cpu_time_sum + self._tpu_time_sum) / done)
+        factor = float(self.conf.get("mapred.speculative.lag.factor", 1.5))
+        # minimum runtime before a task can be speculated — ≈ the
+        # reference's SPECULATIVE_LAG (60s); without a floor, short-task
+        # jobs speculate everything instantly
+        floor = float(self.conf.get("mapred.speculative.min.runtime.s", 10.0))
+        now = time.time()
+        for tip in self.maps:
+            if tip.state != "running":
+                continue
+            if tip.next_attempt != 1:
+                continue  # already speculated (or restarted) — one dup max
+            elapsed = now - (tip.report.start_time or now)
+            if elapsed <= max(floor, factor * mean):
+                continue
+            attempt = tip.new_attempt()
+            self.speculative_map_tasks += 1
+            tip.report.run_on_tpu = run_on_tpu
+            tip.report.tpu_device_id = tpu_device_id
+            return Task(attempt, partition=tip.partition,
+                        num_reduces=self.num_reduces, split=tip.split,
+                        num_maps=len(self.maps), run_on_tpu=run_on_tpu,
+                        tpu_device_id=tpu_device_id)
+        return None
+
+    def should_kill_attempt(self, attempt_id: str) -> bool:
+        """True when this RUNNING attempt lost a speculative race — its TIP
+        already succeeded through a different attempt (≈ the reference
+        killing the slower speculative twin)."""
+        from tpumr.mapred.ids import TaskAttemptID
+        with self.lock:
+            tip = self._tip_of(TaskAttemptID.parse(attempt_id).task)
+            return (tip is not None and tip.state == "succeeded"
+                    and tip.successful_attempt != attempt_id)
 
     def obtain_new_reduce_task(self, host: str) -> Task | None:
         with self.lock:
@@ -298,6 +347,14 @@ class JobInProgress:
             self.finish_time = time.time()
             self.error = (f"task {tip.task_id} failed {tip.failures} times; "
                           f"last: {status.diagnostics}")
+            return
+        # if a twin attempt (speculative, or not-yet-reaped) is still
+        # running, don't re-queue — a third concurrent attempt would waste
+        # a slot and the live twin may still succeed
+        aid = str(status.attempt_id)
+        if any(s.state == TaskState.RUNNING and str(s.attempt_id) != aid
+               for s in tip.attempts.values()):
+            tip.state = "running"
             return
         # re-queue (≈ lost/failed task re-execution)
         tip.state = "pending"

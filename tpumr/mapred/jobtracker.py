@@ -65,7 +65,10 @@ class JobMaster:
         self._last_response: dict[str, tuple[int, list]] = {}
         self._commit_grants: dict[str, str] = {}   # task_id -> attempt_id
         self._next_job = 0
-        self.cluster_id = time.strftime("%Y%m%d%H%M")
+        # start-time-in-ms identifier ≈ JobTracker's trackerIdentifier —
+        # must differ across restarts or recovered job ids collide with
+        # the original's history file
+        self.cluster_id = str(int(time.time() * 1000))
         self.expiry_s = conf.get_int("tpumr.tracker.expiry.ms", 10_000) / 1000.0
         self.blacklist_faults = conf.get_int("tpumr.tracker.max.faults", 4)
         sched_cls = conf.get_class("mapred.jobtracker.taskScheduler",
@@ -114,7 +117,36 @@ class JobMaster:
         self.metrics.start()
         if self._http_port >= 0:
             self._http = self._build_http(self._http_port).start()
+        if self.conf.get_boolean("mapred.jobtracker.restart.recover", False):
+            self._recover_jobs()
         return self
+
+    def _recover_jobs(self) -> None:
+        """Restart recovery ≈ RecoveryManager (JobTracker.java:1203):
+        resubmit jobs whose history shows a submission but no terminal
+        event. Task-level state is NOT resumed — maps re-execute, the
+        reference's job-level semantics (mid-task checkpointing doesn't
+        exist there either, SURVEY.md §5)."""
+        for ev in self.history.incomplete_jobs():
+            old_id = ev["job_id"]
+            if ev.get("conf_dropped"):
+                # conf keys lost in serialization (in-process classes) —
+                # a replay would fail every task; flag instead
+                self._mreg.incr("jobs_recovery_failed")
+                self.history.task_event(
+                    old_id, "JOB_RECOVERY_FAILED",
+                    error=f"non-serializable conf keys: "
+                          f"{ev['conf_dropped']}")
+                continue
+            try:
+                new_id = self.submit_job(ev["conf"], ev["splits"])
+            except Exception as e:  # noqa: BLE001 — recovery is best-effort
+                self._mreg.incr("jobs_recovery_failed")
+                self.history.task_event(old_id, "JOB_RECOVERY_FAILED",
+                                        error=str(e))
+                continue
+            self.history.job_recovered(old_id, new_id)
+            self._mreg.incr("jobs_recovered")
 
     def stop(self) -> None:
         self._stop.set()
@@ -204,9 +236,10 @@ class JobMaster:
             job_id = JobID(self.cluster_id, self._next_job)
             jip = JobInProgress(job_id, conf_dict, splits)
             self.jobs[str(job_id)] = jip
-            self.history.job_submitted(jip)
             self._mreg.incr("jobs_submitted")
-            return str(job_id)
+        # history write (serializes conf + splits) outside the master lock
+        self.history.job_submitted(jip)
+        return str(job_id)
 
     def list_jobs(self) -> list[str]:
         """All known job ids ≈ JobSubmissionProtocol.jobsToComplete +
@@ -303,6 +336,21 @@ class JobMaster:
                   ask_for_new_task: bool, response_id: int) -> dict:
         name = status["tracker_name"]
         self._mreg.incr("heartbeats")
+        # history appends are file I/O — deferred past the master lock so
+        # disk latency never serializes the control plane
+        deferred_events: list[tuple[str, str, dict]] = []
+        try:
+            return self._heartbeat_locked(status, initial_contact,
+                                          ask_for_new_task, response_id,
+                                          name, deferred_events)
+        finally:
+            for job_id, event, fields in deferred_events:
+                self.history.task_event(job_id, event, **fields)
+
+    def _heartbeat_locked(self, status: dict, initial_contact: bool,
+                          ask_for_new_task: bool, response_id: int,
+                          name: str,
+                          deferred_events: list) -> dict:
         with self.lock:
             info = self.trackers.get(name)
             if info is None and not initial_contact:
@@ -337,13 +385,11 @@ class JobMaster:
                         event = {TaskState.SUCCEEDED: "TASK_FINISHED",
                                  TaskState.KILLED: "TASK_KILLED"}.get(
                             ts.state, "TASK_FAILED")
-                        self.history.task_event(
-                            job_id, event, attempt_id=aid,
-                            is_map=ts.is_map, run_on_tpu=ts.run_on_tpu,
+                        deferred_events.append((job_id, event, dict(
+                            attempt_id=aid, is_map=ts.is_map,
+                            run_on_tpu=ts.run_on_tpu,
                             tpu_device_id=ts.tpu_device_id,
-                            runtime=max(0.0, (ts.finish_time or 0)
-                                        - (ts.start_time or 0)),
-                            tracker=name)
+                            runtime=ts.runtime, tracker=name)))
                     if ts.state in (TaskState.FAILED, TaskState.KILLED):
                         # a dead attempt must not keep the commit grant —
                         # otherwise its re-run is denied commit and output
@@ -367,17 +413,20 @@ class JobMaster:
                 return {"response_id": last[0], "actions": last[1]}
 
             actions: list[dict] = []
-            # kill actions for tasks of dead jobs
+            # kill actions: tasks of dead jobs + speculative-race losers
             from tpumr.mapred.ids import TaskAttemptID
             for sd in status.get("task_statuses", []):
                 aid = sd["attempt_id"]
                 job_id = str(TaskAttemptID.parse(aid).task.job)
                 jip = self.jobs.get(job_id)
-                if jip is not None and jip.state in JobState.TERMINAL \
-                        and sd["state"] == "RUNNING":
+                if jip is None or sd["state"] != "RUNNING":
+                    continue
+                if jip.state in JobState.TERMINAL \
+                        or jip.should_kill_attempt(aid):
                     actions.append({"type": "kill_task", "attempt_id": aid})
 
-            if ask_for_new_task and not info.blacklisted:
+            if ask_for_new_task and not info.blacklisted \
+                    and status.get("healthy", True):
                 for task in self.scheduler.assign_tasks(status):
                     if not task.is_map:
                         self._mreg.incr("reduces_launched")

@@ -217,6 +217,8 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     """
     reporter = reporter or Reporter()
     conf = localize_task_conf(conf, task)
+    from tpumr.utils.fi import maybe_fail
+    maybe_fail("map.task", conf)
     in_fmt = new_instance(conf.get_input_format(), conf)
     split = InputSplit.from_dict(task.split) if task.split else None
     t0 = time.time()

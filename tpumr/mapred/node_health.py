@@ -1,0 +1,143 @@
+"""Node health checking + task memory management.
+
+≈ the reference's TaskTracker self-checks (SURVEY.md §5):
+``NodeHealthCheckerService`` (367 LoC — runs an operator-supplied script;
+any output starting with ERROR marks the node unhealthy and the
+JobTracker stops assigning to it) and ``TaskMemoryManagerThread`` (kills
+tasks whose process tree exceeds the configured memory limit).
+
+The memory manager watches *subprocess* tasks (pipes/streaming children)
+via /proc RSS — in-process kernel tasks live inside the runner and are
+bounded by the runner process itself (documented divergence: the
+reference's every task is a child JVM).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+from typing import Any, Callable
+
+
+class NodeHealthChecker:
+    """≈ NodeHealthCheckerService: periodic external script."""
+
+    def __init__(self, script: str, interval_s: float = 10.0,
+                 timeout_s: float = 30.0) -> None:
+        self.script = script
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.healthy = True
+        self.report = ""
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def check_once(self) -> None:
+        try:
+            proc = subprocess.run(
+                ["/bin/sh", "-c", self.script], capture_output=True,
+                text=True, timeout=self.timeout_s)
+            out = (proc.stdout or "").strip()
+            # reference contract: a line starting with ERROR == unhealthy;
+            # nonzero exit alone is NOT unhealthy (script bugs must not
+            # depool nodes — NodeHealthCheckerService semantics)
+            bad = [l for l in out.splitlines() if l.startswith("ERROR")]
+            self.healthy = not bad
+            self.report = "; ".join(bad)
+        except subprocess.TimeoutExpired:
+            self.healthy = False
+            self.report = "health script timed out"
+        except Exception as e:  # noqa: BLE001
+            self.healthy = True  # can't run the script ≠ unhealthy node
+            self.report = f"health script error: {e}"
+
+    def start(self) -> "NodeHealthChecker":
+        if self._thread is None:
+            self.check_once()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="node-health", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.check_once()
+
+
+def process_rss_bytes(pid: int) -> int | None:
+    """VmRSS of one process from /proc (Linux)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
+class TaskMemoryManager:
+    """≈ TaskMemoryManagerThread: sample registered task subprocesses,
+    kill those above their limit (the kill callback owns process-tree
+    semantics)."""
+
+    def __init__(self, interval_s: float = 1.0) -> None:
+        self.interval_s = interval_s
+        self._lock = threading.Lock()
+        #: attempt_id -> (pid, limit_bytes, kill_cb)
+        self._tasks: dict[str, tuple[int, int, Callable[[str], None]]] = {}
+        self.killed: list[str] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self, attempt_id: str, pid: int, limit_bytes: int,
+                 kill_cb: Callable[[str], None]) -> None:
+        with self._lock:
+            self._tasks[attempt_id] = (pid, limit_bytes, kill_cb)
+        # self-starting: a limit set only in the JOB conf must still be
+        # enforced even when the tracker conf never started the sampler
+        self.start()
+
+    def unregister(self, attempt_id: str) -> None:
+        with self._lock:
+            self._tasks.pop(attempt_id, None)
+
+    def check_once(self) -> list[str]:
+        with self._lock:
+            tasks = list(self._tasks.items())
+        over = []
+        for aid, (pid, limit, kill_cb) in tasks:
+            rss = process_rss_bytes(pid)
+            if rss is not None and limit > 0 and rss > limit:
+                over.append(aid)
+                self.killed.append(aid)
+                try:
+                    kill_cb(aid)
+                except Exception:  # noqa: BLE001
+                    pass
+                self.unregister(aid)
+        return over
+
+    def start(self) -> "TaskMemoryManager":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="task-memory", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.check_once()
+
+
+#: process-wide manager — subprocess task runners (pipes/streaming)
+#: register their children here; the owning NodeRunner starts/stops it
+GLOBAL_MEMORY_MANAGER = TaskMemoryManager()

@@ -34,6 +34,8 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
     reporter = reporter or Reporter()
     from tpumr.mapred.map_task import localize_task_conf
     conf = localize_task_conf(conf, task)
+    from tpumr.utils.fi import maybe_fail
+    maybe_fail("reduce.task", conf)
     comparator = conf.get_output_key_comparator()
     sk = comparator.sort_key
     grouping = conf.get_output_value_grouping_comparator()

@@ -117,12 +117,30 @@ class NodeRunner:
         self._http: Any = None
         self._http_port = conf.get_int("mapred.task.tracker.http.port", -1)
 
+        # self-checks ≈ NodeHealthCheckerService + TaskMemoryManagerThread
+        from tpumr.mapred.node_health import (GLOBAL_MEMORY_MANAGER,
+                                              NodeHealthChecker)
+        script = conf.get("mapred.healthChecker.script.path")
+        self.health: NodeHealthChecker | None = None
+        if script:
+            self.health = NodeHealthChecker(
+                script,
+                interval_s=conf.get_int("mapred.healthChecker.interval.ms",
+                                        10_000) / 1000)
+        self._memory_manager = (
+            GLOBAL_MEMORY_MANAGER
+            if conf.get_int("mapred.task.limit.maxrss.mb", 0) > 0 else None)
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "NodeRunner":
         self._server.start()
         self._hb_thread.start()
         self.metrics.start()
+        if self.health is not None:
+            self.health.start()
+        if self._memory_manager is not None:
+            self._memory_manager.start()
         if self._http_port >= 0:
             from tpumr.http import StatusHttpServer
             srv = StatusHttpServer(self.name, port=self._http_port)
@@ -134,6 +152,8 @@ class NodeRunner:
     def stop(self) -> None:
         self._stop.set()
         self.metrics.stop()
+        if self.health is not None:
+            self.health.stop()
         if self._http is not None:
             self._http.stop()
         self._server.stop()
@@ -186,6 +206,10 @@ class NodeRunner:
                 "count_reduce_tasks": red,
                 "available_tpu_devices": self._available_tpu_devices(),
                 "task_statuses": statuses,
+                "healthy": (self.health.healthy
+                            if self.health is not None else True),
+                "health_report": (self.health.report
+                                  if self.health is not None else ""),
             }
 
     # ------------------------------------------------------------ heartbeat

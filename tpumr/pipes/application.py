@@ -88,6 +88,18 @@ class Application:
         self._rfile = self.sock.makefile("rb")
         self._wfile = self.sock.makefile("wb")
         self.downlink = P.DownwardProtocol(self._wfile)
+
+        # memory-limit enforcement ≈ TaskMemoryManagerThread: register the
+        # child with the process-wide manager when a limit is configured
+        limit_mb = int(conf.get("mapred.task.limit.maxrss.mb", 0) or 0)
+        self._mem_key: str | None = None
+        if limit_mb > 0:
+            from tpumr.mapred.node_health import GLOBAL_MEMORY_MANAGER
+            self._mem_key = (str(conf.get("tpumr.task.attempt.id", ""))
+                             or f"pid-{self.process.pid}")
+            GLOBAL_MEMORY_MANAGER.register(
+                self._mem_key, self.process.pid, limit_mb << 20,
+                lambda _aid: self.process.kill())
         try:
             self._authenticate()
         except Exception:
@@ -188,6 +200,10 @@ class Application:
         self.cleanup(kill=True)
 
     def cleanup(self, kill: bool = False) -> None:
+        if self._mem_key is not None:
+            from tpumr.mapred.node_health import GLOBAL_MEMORY_MANAGER
+            GLOBAL_MEMORY_MANAGER.unregister(self._mem_key)
+            self._mem_key = None
         if kill and self.process.poll() is None:
             self.process.kill()
         try:

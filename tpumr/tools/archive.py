@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import io
+import threading
 from typing import Any, BinaryIO
 
 from tpumr.fs import get_filesystem
@@ -69,6 +70,53 @@ def list_archive(archive_dir: str, conf: Any = None) -> list[tuple[str, int]]:
         return [(k, span[1]) for k, span in r]
 
 
+class _BoundedFile(io.RawIOBase):
+    """Window [offset, offset+length) over the part stream — reads stream
+    through, nothing is materialized."""
+
+    def __init__(self, raw: BinaryIO, offset: int, length: int) -> None:
+        self._raw = raw
+        self._start = offset
+        self._length = length
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, pos: int, whence: int = io.SEEK_SET) -> int:
+        if whence == io.SEEK_SET:
+            self._pos = pos
+        elif whence == io.SEEK_CUR:
+            self._pos += pos
+        else:
+            self._pos = self._length + pos
+        self._pos = max(0, min(self._pos, self._length))
+        self._raw.seek(self._start + self._pos)
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def readinto(self, b: bytearray) -> int:  # type: ignore[override]
+        n = min(len(b), self._length - self._pos)
+        if n <= 0:
+            return 0
+        self._raw.seek(self._start + self._pos)
+        data = self._raw.read(n)
+        b[: len(data)] = data
+        self._pos += len(data)
+        return len(data)
+
+    def close(self) -> None:
+        try:
+            self._raw.close()
+        finally:
+            super().close()
+
+
 class ArchiveFileSystem(FileSystem):
     """Read-only view into archives ≈ HarFileSystem. The authority names
     the underlying scheme; the path is split at the ``.tharch`` component."""
@@ -78,6 +126,9 @@ class ArchiveFileSystem(FileSystem):
     def __init__(self, conf: Any = None, authority: str = "") -> None:
         self.conf = conf
         self.under_scheme = authority or "file"
+        #: archive uri -> cached in-memory index entries (immutable files)
+        self._index_cache: dict[str, list[tuple[str, tuple[int, int]]]] = {}
+        self._cache_lock = threading.Lock()
 
     # ------------------------------------------------------------ helpers
 
@@ -97,23 +148,38 @@ class ArchiveFileSystem(FileSystem):
             raise FileNotFoundError(f"no {SUFFIX} component in {path}")
         return f"{self.under_scheme}://{arch}", inner
 
-    def _index(self, arch_uri: str) -> "mapfile.Reader":
+    def _entries(self, arch_uri: str) -> list[tuple[str, tuple[int, int]]]:
+        """Cached index entries — archives are immutable, and reloading
+        the index per open() would make N-file jobs O(N × index)."""
+        with self._cache_lock:
+            cached = self._index_cache.get(arch_uri)
+        if cached is not None:
+            return cached
         afs = get_filesystem(arch_uri, self.conf)
-        return mapfile.Reader(afs, Path(arch_uri).child(INDEX))
+        with mapfile.Reader(afs, Path(arch_uri).child(INDEX)) as r:
+            entries = list(r)
+        with self._cache_lock:
+            self._index_cache[arch_uri] = entries
+        return entries
+
+    def _lookup(self, arch_uri: str, inner: str) -> "tuple[int, int] | None":
+        for k, span in self._entries(arch_uri):
+            if k == inner:
+                return span
+        return None
 
     # ------------------------------------------------------------ SPI
 
     def open(self, path: "str | Path") -> BinaryIO:
         arch, inner = self._split(path)
-        with self._index(arch) as idx:
-            span = idx.get(inner)
+        span = self._lookup(arch, inner)
         if span is None:
             raise FileNotFoundError(f"{inner!r} not in archive {arch}")
         offset, length = span
         afs = get_filesystem(arch, self.conf)
-        with afs.open(Path(arch).child(PART)) as f:
-            f.seek(offset)
-            return io.BytesIO(f.read(length))
+        f = afs.open(Path(arch).child(PART))
+        f.seek(offset)
+        return _BoundedFile(f, offset, length)
 
     def create(self, path, overwrite: bool = True) -> BinaryIO:
         raise PermissionError("tharch archives are immutable (re-create "
@@ -141,14 +207,13 @@ class ArchiveFileSystem(FileSystem):
         arch, inner = self._split(path)
         if not inner:
             return FileStatus(Path(str(path)), is_dir=True)
-        with self._index(arch) as idx:
-            span = idx.get(inner)
-            if span is not None:
-                return FileStatus(Path(str(path)), length=span[1])
-            prefix = inner.rstrip("/") + "/"
-            for k, _ in idx:
-                if k.startswith(prefix):
-                    return FileStatus(Path(str(path)), is_dir=True)
+        span = self._lookup(arch, inner)
+        if span is not None:
+            return FileStatus(Path(str(path)), length=span[1])
+        prefix = inner.rstrip("/") + "/"
+        for k, _ in self._entries(arch):
+            if k.startswith(prefix):
+                return FileStatus(Path(str(path)), is_dir=True)
         raise FileNotFoundError(str(path))
 
     def list_status(self, path: "str | Path") -> list[FileStatus]:
@@ -156,17 +221,16 @@ class ArchiveFileSystem(FileSystem):
         prefix = inner.rstrip("/") + "/" if inner else ""
         seen: dict[str, FileStatus] = {}
         base = str(path).rstrip("/")
-        with self._index(arch) as idx:
-            for k, (off, length) in idx:
-                if not k.startswith(prefix):
-                    continue
-                rest = k[len(prefix):]
-                head = rest.split("/", 1)[0]
-                full = Path(f"{base}/{head}")
-                if "/" in rest:
-                    seen.setdefault(head, FileStatus(full, is_dir=True))
-                else:
-                    seen[head] = FileStatus(full, length=length)
+        for k, (off, length) in self._entries(arch):
+            if not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            head = rest.split("/", 1)[0]
+            full = Path(f"{base}/{head}")
+            if "/" in rest:
+                seen.setdefault(head, FileStatus(full, is_dir=True))
+            else:
+                seen[head] = FileStatus(full, length=length)
         return [seen[k] for k in sorted(seen)]
 
     def get_block_locations(self, path, offset: int,

@@ -70,8 +70,10 @@ def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
     # the staging listing must be readable by remote task processes, so it
     # lives NEXT TO the destination (a shared fs by definition) unless the
     # caller overrides — mem:// scratch would be client-process-local
-    work = conf.get("tpumr.distcp.work",
-                    dst.rstrip("/") + ".distcp-work")
+    work = conf.get("tpumr.distcp.work")
+    own_work = work is None
+    if own_work:
+        work = dst.rstrip("/") + ".distcp-work"
     listing = f"{work.rstrip('/')}/files.txt"
     get_filesystem(listing, conf).write_bytes(
         listing, ("\n".join(pairs) + "\n").encode())
@@ -89,7 +91,10 @@ def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
     try:
         return run_job(conf).successful
     finally:
-        get_filesystem(work, conf).delete(work, recursive=True)
+        # only clean up scratch WE created — a caller-supplied work dir may
+        # be a shared staging area with unrelated contents
+        if own_work:
+            get_filesystem(work, conf).delete(work, recursive=True)
 
 
 def main(argv: list[str]) -> int:

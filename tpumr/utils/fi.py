@@ -1,0 +1,49 @@
+"""Fault injection ≈ the reference's fi/AspectJ framework.
+
+(src/test/aop/org/apache/hadoop/fi/{FiConfig,ProbabilityModel}.java +
+weave targets, SURVEY.md §4.5: probabilistic faults at named join
+points.) No bytecode weaving here — seams call ``maybe_fail(point,
+conf)`` directly; production cost is one dict lookup returning None.
+
+Config per point:
+  tpumr.fi.<point>.probability   fault probability (0 disables, default)
+  tpumr.fi.<point>.max.failures  stop injecting after N fires (per
+                                 process; 0 = unlimited) — lets tests
+                                 fail the first attempt and watch the
+                                 retry succeed.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any
+
+_lock = threading.Lock()
+_fired: dict[str, int] = {}
+
+
+class InjectedFault(RuntimeError):
+    """Raised at a join point when the probability model fires."""
+
+
+def reset() -> None:
+    with _lock:
+        _fired.clear()
+
+
+def maybe_fail(point: str, conf: Any = None) -> None:
+    """≈ ProbabilityModel.injectCriteria + the woven fault advice."""
+    if conf is None:
+        return
+    p = conf.get(f"tpumr.fi.{point}.probability")
+    if not p:
+        return
+    if random.random() >= float(p):
+        return
+    limit = int(conf.get(f"tpumr.fi.{point}.max.failures", 0) or 0)
+    with _lock:
+        if limit and _fired.get(point, 0) >= limit:
+            return
+        _fired[point] = _fired.get(point, 0) + 1
+    raise InjectedFault(f"injected fault at {point}")
