@@ -1,0 +1,156 @@
+"""Security-lite (UGI + HMAC RPC auth ≈ security/, SaslRpcServer) and rack
+topology (≈ net/NetworkTopology) — SURVEY.md §2.2."""
+
+import pytest
+
+from tpumr.ipc.rpc import RpcClient, RpcError, RpcServer
+from tpumr.mapred.jobconf import JobConf
+from tpumr.net.topology import (DEFAULT_RACK, NetworkTopology,
+                                resolver_from_conf, static_resolver)
+from tpumr.security import UserGroupInformation, rpc_secret
+
+
+class Echo:
+    def ping(self, x):
+        return x
+
+
+class TestRpcAuth:
+    def test_signed_calls_work(self):
+        srv = RpcServer(Echo(), secret=b"s3cret").start()
+        try:
+            cli = RpcClient(*srv.address, secret=b"s3cret")
+            assert cli.call("ping", 42) == 42
+        finally:
+            srv.stop()
+
+    def test_unsigned_and_wrong_secret_rejected(self):
+        srv = RpcServer(Echo(), secret=b"s3cret").start()
+        try:
+            unsigned = RpcClient(*srv.address)
+            with pytest.raises(RpcError, match="not signed"):
+                unsigned.call("ping", 1)
+            wrong = RpcClient(*srv.address, secret=b"nope")
+            with pytest.raises(RpcError, match="not signed"):
+                wrong.call("ping", 1)
+        finally:
+            srv.stop()
+
+    def test_no_secret_means_open(self):
+        srv = RpcServer(Echo()).start()
+        try:
+            assert RpcClient(*srv.address).call("ping", 7) == 7
+        finally:
+            srv.stop()
+
+    def test_secured_mini_cluster_runs_job(self):
+        from tpumr.fs import get_filesystem
+        from tpumr.mapred.job_client import JobClient
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", "cluster-shared-secret")
+        with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0,
+                           conf=conf) as c:
+            fs = get_filesystem("mem:///")
+            fs.write_bytes("/sec/in.txt", b"k l k\n" * 20)
+            jc = c.create_job_conf()
+            jc.set_input_paths("mem:///sec/in.txt")
+            jc.set_output_path("mem:///sec/out")
+            from tpumr.ops.wordcount import WordCountCpuMapper
+            from tpumr.examples.basic import LongSumReducer
+            jc.set_class("mapred.mapper.class", WordCountCpuMapper)
+            jc.set_class("mapred.reducer.class", LongSumReducer)
+            assert JobClient(jc).run_job(jc).successful
+            # an unauthenticated client is refused
+            host, port = c.master.address
+            with pytest.raises(RpcError, match="not signed"):
+                RpcClient(host, port).call("list_jobs")
+
+    def test_secret_file(self, tmp_path):
+        p = tmp_path / "secret"
+        p.write_text("filesecret\n")
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret.file", str(p))
+        assert rpc_secret(conf) == b"filesecret"
+        assert rpc_secret(JobConf()) is None
+
+
+class TestUgi:
+    def test_current_user_and_do_as(self):
+        me = UserGroupInformation.get_current_user()
+        assert me.user
+        with UserGroupInformation("erin").do_as():
+            assert UserGroupInformation.get_current_user().user == "erin"
+        assert UserGroupInformation.get_current_user().user == me.user
+
+    def test_job_conf_stamps_user(self):
+        from tpumr.mapred.job_client import _wire_conf
+        conf = JobConf()
+        wired = _wire_conf(conf)
+        assert wired["user.name"]
+
+
+class TestTopology:
+    def test_static_resolver_and_ports(self):
+        r = static_resolver({"h1": "/r1", "h2": "/r2"})
+        assert r("h1") == "/r1"
+        assert r("h1:8020") == "/r1"
+        assert r("unknown") == DEFAULT_RACK
+
+    def test_resolver_from_conf(self):
+        conf = JobConf()
+        conf.set("tpumr.topology.map", "a=/ra, b=/rb")
+        r = resolver_from_conf(conf)
+        assert r("a") == "/ra" and r("b") == "/rb"
+
+    def test_script_resolver(self, tmp_path):
+        script = tmp_path / "rack.sh"
+        script.write_text("#!/bin/sh\necho /scripted-rack\n")
+        script.chmod(0o755)
+        conf = JobConf()
+        conf.set("topology.script.file.name", str(script))
+        r = resolver_from_conf(conf)
+        assert r("anyhost") == "/scripted-rack"
+
+    def test_network_topology(self):
+        t = NetworkTopology(static_resolver({"a": "/r1", "b": "/r1",
+                                             "c": "/r2"}))
+        for h in "abc":
+            t.add(h)
+        assert t.on_same_rack("a", "b") and not t.on_same_rack("a", "c")
+        assert t.racks() == {"/r1": ["a", "b"], "/r2": ["c"]}
+
+
+class TestRackAwarePlacement:
+    def test_second_replica_off_rack(self):
+        from tpumr.dfs.namenode import FSNamesystem
+        conf = JobConf()
+        # distinct fake hosts exercise the rack split
+        conf.set("tpumr.topology.map",
+                 "dn1=/r1,dn2=/r1,dn3=/r2")
+        import tempfile
+        ns = FSNamesystem(tempfile.mkdtemp(), conf)
+        for addr, used in (("dn1:1", 0), ("dn2:1", 10), ("dn3:1", 20)):
+            ns.register_datanode(addr, 1 << 30)
+            ns.datanodes[addr]["used"] = used
+        targets = ns._choose_targets(2, set())
+        assert targets[0] == "dn1:1"          # least used
+        assert targets[1] == "dn3:1", \
+            "second replica must land on a different rack"
+        # with 3 replicas everyone gets one
+        assert set(ns._choose_targets(3, set())) == \
+            {"dn1:1", "dn2:1", "dn3:1"}
+
+    def test_scheduler_prefers_rack_local(self):
+        from tpumr.mapred.ids import JobID
+        from tpumr.mapred.job_in_progress import JobInProgress
+        conf = {"mapred.reduce.tasks": 0,
+                "tpumr.topology.map": "h1=/r1,h2=/r1,h9=/r9",
+                "mapred.reduce.slowstart.completed.maps": 0.0}
+        splits = [{"locations": ["h9"]},   # off-rack split
+                  {"locations": ["h1"]}]   # rack-local to h2
+        job = JobInProgress(JobID("topo", 1), conf, splits)
+        # h2 has no node-local split; rack tier must pick split 1 (h1,
+        # same /r1 rack), not split 0
+        t = job.obtain_new_map_task("h2", run_on_tpu=False)
+        assert t.partition == 1

@@ -211,7 +211,8 @@ def cmd_job(conf, argv: list[str]) -> int:
         print("job control needs -jt HOST:PORT", file=sys.stderr)
         return 255
     host, port = _host_port(jt)
-    client = RpcClient(host, port)
+    from tpumr.security import rpc_secret
+    client = RpcClient(host, port, secret=rpc_secret(conf))
     usage = ("Usage: tpumr job -list | -status ID | -kill ID | "
              "-counters ID | -events ID")
     if not argv:

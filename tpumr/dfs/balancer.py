@@ -18,7 +18,9 @@ from tpumr.ipc.rpc import RpcClient
 class Balancer:
     def __init__(self, nn_host: str, nn_port: int,
                  threshold: float = 0.10, conf: Any = None) -> None:
-        self.nn = RpcClient(nn_host, nn_port)
+        from tpumr.security import rpc_secret
+        self._secret = rpc_secret(conf)
+        self.nn = RpcClient(nn_host, nn_port, secret=self._secret)
         self.threshold = threshold
         self._dn_clients: dict[str, RpcClient] = {}
 
@@ -26,7 +28,7 @@ class Balancer:
         cli = self._dn_clients.get(addr)
         if cli is None:
             host, port = addr.rsplit(":", 1)
-            cli = self._dn_clients[addr] = RpcClient(host, int(port))
+            cli = self._dn_clients[addr] = RpcClient(host, int(port), secret=self._secret)
         return cli
 
     def _utilization(self) -> dict[str, float]:

@@ -25,7 +25,9 @@ from tpumr.ipc.rpc import RpcClient, RpcError
 class DFSClient:
     def __init__(self, host: str, port: int, conf: Any = None) -> None:
         self.conf = conf
-        self.nn = RpcClient(host, int(port))
+        from tpumr.security import rpc_secret
+        self._secret = rpc_secret(conf)
+        self.nn = RpcClient(host, int(port), secret=self._secret)
         self.name = f"TDFSClient_{uuid.uuid4().hex[:12]}"
         self._dn_clients: dict[str, RpcClient] = {}
         self._lock = threading.Lock()
@@ -40,7 +42,7 @@ class DFSClient:
             cli = self._dn_clients.get(addr)
             if cli is None:
                 host, port = addr.rsplit(":", 1)
-                cli = self._dn_clients[addr] = RpcClient(host, int(port))
+                cli = self._dn_clients[addr] = RpcClient(host, int(port), secret=self._secret)
             return cli
 
     # ------------------------------------------------------------ lease

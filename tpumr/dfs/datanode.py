@@ -99,11 +99,13 @@ class DataNode:
                  conf: Any, host: str = "127.0.0.1", port: int = 0) -> None:
         self.conf = conf
         self.store = BlockStore(data_dir)
-        self.nn = RpcClient(nn_host, nn_port)
+        from tpumr.security import rpc_secret
+        self._secret = rpc_secret(conf)
+        self.nn = RpcClient(nn_host, nn_port, secret=self._secret)
         self.capacity = int(conf.get("tdfs.datanode.capacity",
                                      1 << 40))
         self.heartbeat_s = float(conf.get("tdfs.datanode.heartbeat.s", 1.0))
-        self._server = RpcServer(self, host=host, port=port)
+        self._server = RpcServer(self, host=host, port=port, secret=self._secret)
         self._stop = threading.Event()
         self._hb = threading.Thread(target=self._heartbeat_loop,
                                     name="dn-heartbeat", daemon=True)
@@ -137,7 +139,7 @@ class DataNode:
             cli = self._peer_clients.get(addr)
             if cli is None:
                 host, port = addr.rsplit(":", 1)
-                cli = self._peer_clients[addr] = RpcClient(host, int(port))
+                cli = self._peer_clients[addr] = RpcClient(host, int(port), secret=self._secret)
             return cli
 
     # ------------------------------------------------------------ heartbeat

@@ -83,6 +83,10 @@ class FSNamesystem:
             if i.get("type") == "file")
         self.safemode = self.total_known_blocks > 0
 
+        # rack awareness ≈ FSNamesystem's clusterMap (NetworkTopology)
+        from tpumr.net import NetworkTopology, resolver_from_conf
+        self.topology = NetworkTopology(resolver_from_conf(conf))
+
     # ------------------------------------------------------------ journal
 
     @staticmethod
@@ -373,10 +377,13 @@ class FSNamesystem:
     # ------------------------------------------------------------ datanodes
 
     def register_datanode(self, addr: str, capacity: int) -> None:
+        # rack resolution may exec the operator script — never under the
+        # namesystem lock (a slow script would stall the control plane)
+        rack = self.topology.add(addr)
         with self.lock:
             self.datanodes[addr] = {"addr": addr, "capacity": capacity,
                                     "used": 0, "last_seen": _now(),
-                                    "blocks": 0}
+                                    "blocks": 0, "rack": rack}
             self.commands.setdefault(addr, [])
 
     def dn_heartbeat(self, addr: str, used: int, capacity: int,
@@ -420,12 +427,27 @@ class FSNamesystem:
 
     def _choose_targets(self, replication: int,
                         excluded: set[str]) -> list[str]:
-        """Placement: least-used live nodes first, capped at cluster size
-        (the reference's rack-aware chooseTarget collapses to spread-by-load
-        on a flat topology)."""
+        """Rack-aware placement ≈ ReplicationTargetChooser: the second
+        replica goes to a DIFFERENT rack than the first (rack-failure
+        tolerance), remaining replicas spread by load. On a flat topology
+        (all /default-rack) this collapses to spread-by-load."""
         live = [a for a, d in self.datanodes.items() if a not in excluded]
         live.sort(key=lambda a: (self.datanodes[a]["used"], random.random()))
-        return live[:replication]
+        if len(live) <= 1 or replication <= 1:
+            return live[:replication]
+        chosen = [live[0]]
+        first_rack = self.topology.rack_of(live[0])
+        rest = live[1:]
+        off_rack = [a for a in rest
+                    if self.topology.rack_of(a) != first_rack]
+        if off_rack:
+            chosen.append(off_rack[0])
+            rest = [a for a in rest if a != off_rack[0]]
+        for a in rest:
+            if len(chosen) >= replication:
+                break
+            chosen.append(a)
+        return chosen[:replication]
 
     # ------------------------------------------------------------ monitors
 
@@ -569,7 +591,9 @@ class NameNode:
         self.conf = conf
         self.ns = FSNamesystem(name_dir, conf)
         self.dn_expiry_s = float(conf.get("tdfs.datanode.expiry.s", 10))
-        self._server = RpcServer(self, host=host, port=port)
+        from tpumr.security import rpc_secret
+        self._server = RpcServer(self, host=host, port=port,
+                                 secret=rpc_secret(conf))
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="nn-monitors", daemon=True)

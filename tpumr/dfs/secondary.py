@@ -19,7 +19,8 @@ from tpumr.ipc.rpc import RpcClient
 class SecondaryNameNode:
     def __init__(self, nn_host: str, nn_port: int, checkpoint_dir: str,
                  conf: Any = None) -> None:
-        self.nn = RpcClient(nn_host, nn_port)
+        from tpumr.security import rpc_secret
+        self.nn = RpcClient(nn_host, nn_port, secret=rpc_secret(conf))
         self.dir = checkpoint_dir
         self.interval_s = float(conf.get("fs.checkpoint.period", 3600)
                                 if conf is not None else 3600)

@@ -18,6 +18,7 @@ umbilical status and the host-shuffle fallback.
 
 from __future__ import annotations
 
+import hmac
 import socket
 import socketserver
 import struct
@@ -33,6 +34,25 @@ MAX_FRAME = 1 << 30
 
 class RpcError(RuntimeError):
     """Remote exception surfaced locally (≈ RemoteException)."""
+
+
+class RpcAuthError(RpcError):
+    """Request failed HMAC verification (≈ SASL auth failure)."""
+
+
+#: signed-timestamp freshness window (seconds)
+AUTH_WINDOW_S = 300.0
+
+
+def _sign(secret: bytes, req: dict, port: int) -> str:
+    """HMAC-SHA256 over the canonical request identity+payload+timestamp,
+    bound to the target port (≈ the reference's DIGEST token auth,
+    SaslRpcServer — SURVEY.md §2.2). Replay defenses: the timestamp must
+    be fresh, the port binds the frame to one daemon, and the server
+    tracks a per-client high-water request id."""
+    canon = serialize([req.get("cid"), req.get("id"), req.get("method"),
+                       list(req.get("params", [])), req.get("ts"), port])
+    return hmac.new(secret, canon, "sha256").hexdigest()
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -71,6 +91,25 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             while True:
                 req = _recv_frame(sock)
+                secret = server.secret
+                if secret is not None:
+                    import time as _time
+                    sig = req.get("auth")
+                    my_port = sock.getsockname()[1]
+                    ts = req.get("ts")
+                    if not sig or not hmac.compare_digest(
+                            sig, _sign(secret, req, my_port)):
+                        _send_frame(sock, {
+                            "id": req.get("id"),
+                            "error": "RpcAuthError: request not signed "
+                                     "with the cluster secret"})
+                        continue
+                    if ts is None or abs(_time.time() - ts) > AUTH_WINDOW_S:
+                        _send_frame(sock, {
+                            "id": req.get("id"),
+                            "error": "RpcAuthError: stale or missing "
+                                     "request timestamp (replay?)"})
+                        continue
                 # client-side reconnect retries resend the same (cid, id):
                 # replay the cached response instead of re-executing, so
                 # non-idempotent methods (submit_job) never run twice
@@ -79,6 +118,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     cached = server.response_cache_get(dedupe_key)
                     if cached is not None:
                         _send_frame(sock, cached)
+                        continue
+                    if secret is not None and not server.advance_hwm(
+                            req.get("cid"), req.get("id")):
+                        # id at/below this client's high-water mark and
+                        # not in the cache: a replayed old frame
+                        _send_frame(sock, {
+                            "id": req.get("id"),
+                            "error": "RpcAuthError: replayed request id"})
                         continue
                 resp: dict[str, Any] = {"id": req.get("id")}
                 try:
@@ -106,9 +153,11 @@ class RpcServer:
     RESPONSE_CACHE_SIZE = 2048
 
     def __init__(self, handler: Any, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, secret: "bytes | None" = None) -> None:
         self._handlers: dict[str, Any] = {"": handler}
+        self.secret = secret
         self._server = _ThreadingServer((host, port), _Handler)
+        self._server.secret = secret  # type: ignore[attr-defined]
         # expose hooks on the socketserver instance for _Handler
         self._server.lookup = self.lookup  # type: ignore[attr-defined]
         self._server.response_cache_get = self.response_cache_get  # type: ignore[attr-defined]
@@ -118,6 +167,8 @@ class RpcServer:
         self._thread: threading.Thread | None = None
         self._resp_cache: "dict[tuple, Any]" = {}
         self._resp_cache_lock = threading.Lock()
+        self._cid_hwm: dict[Any, int] = {}
+        self._server.advance_hwm = self.advance_hwm  # type: ignore[attr-defined]
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
 
@@ -132,6 +183,18 @@ class RpcServer:
     def response_cache_get(self, key: tuple) -> Any | None:
         with self._resp_cache_lock:
             return self._resp_cache.get(key)
+
+    def advance_hwm(self, cid: Any, req_id: Any) -> bool:
+        """Per-client monotonic id check (replay defense under auth):
+        returns False for an id at/below the high-water mark."""
+        if not isinstance(req_id, int):
+            return False
+        with self._resp_cache_lock:
+            hwm = self._cid_hwm.get(cid, 0)
+            if req_id <= hwm:
+                return False
+            self._cid_hwm[cid] = req_id
+            return True
 
     def response_cache_put(self, key: tuple, resp: Any) -> None:
         with self._resp_cache_lock:
@@ -195,9 +258,11 @@ class RpcClient:
     fan-out callers hold one client per target like the reference's
     per-connection multiplexing without the async responder)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 secret: "bytes | None" = None) -> None:
         self.host, self.port = host, port
         self.timeout = timeout
+        self.secret = secret
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._id = 0
@@ -217,6 +282,10 @@ class RpcClient:
             self._id += 1
             req = {"id": self._id, "cid": self._cid, "method": method,
                    "params": list(params)}
+            if self.secret is not None:
+                import time as _time
+                req["ts"] = _time.time()
+                req["auth"] = _sign(self.secret, req, self.port)
             try:
                 sock = self._connect()
                 _send_frame(sock, req)
@@ -228,8 +297,10 @@ class RpcClient:
                 _send_frame(sock, req)
                 resp = _recv_frame(sock)
         if "error" in resp:
-            raise RpcError(resp["error"] + "\n[remote] " +
-                           resp.get("traceback", ""))
+            msg = resp["error"] + "\n[remote] " + resp.get("traceback", "")
+            if resp["error"].startswith("RpcAuthError"):
+                raise RpcAuthError(msg)
+            raise RpcError(msg)
         return resp.get("result")
 
     def close_locked(self) -> None:
@@ -256,10 +327,11 @@ class _Proxy:
 
 
 def get_proxy(host: str, port: int, protocol_version: int | None = None,
-              namespace: str = "", timeout: float = 30.0) -> Any:
+              namespace: str = "", timeout: float = 30.0,
+              secret: "bytes | None" = None) -> Any:
     """Create a method proxy; verifies the protocol version handshake when
     ``protocol_version`` is given (≈ RPC.getProxy + VersionedProtocol)."""
-    client = RpcClient(host, port, timeout=timeout)
+    client = RpcClient(host, port, timeout=timeout, secret=secret)
     proxy = _Proxy(client, namespace)
     if protocol_version is not None:
         remote = proxy.get_protocol_version()

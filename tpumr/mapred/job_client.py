@@ -66,7 +66,9 @@ class JobClient:
         self._client: RpcClient | None = None
         if tracker and tracker != "local":
             host, port = str(tracker).rsplit(":", 1)
-            self._client = RpcClient(host, int(port))
+            from tpumr.security import rpc_secret
+            self._client = RpcClient(host, int(port),
+                                     secret=rpc_secret(conf))
 
     @property
     def is_local(self) -> bool:
@@ -113,6 +115,11 @@ def _wire_conf(job_conf: JobConf) -> dict[str, Any]:
                 f"conf key {k!r} holds a class object that is not importable "
                 f"by name; distributed jobs need module-level classes")
         out[k] = v
+    if not out.get("user.name"):
+        # stamp the submitting identity ≈ UGI on JobClient.submitJob —
+        # the fair scheduler's default pool and history attribution use it
+        from tpumr.security import UserGroupInformation
+        out["user.name"] = UserGroupInformation.get_current_user().user
     return out
 
 

@@ -95,11 +95,19 @@ class JobInProgress:
                      for i, s in enumerate(splits)]
         self.reduces = [TaskInProgress(TaskID(job_id, False, r), r)
                         for r in range(self.num_reduces)]
-        # locality cache host -> set(map idx) (≈ nonRunningMapCache)
+        # locality caches ≈ nonRunningMapCache: host -> splits and
+        # rack -> splits (the rack tier of obtainNewNodeOrRackLocalMapTask)
+        from tpumr.net import DEFAULT_RACK, resolver_from_conf
+        self._rack_resolver = resolver_from_conf(self.conf)
+        self._default_rack = DEFAULT_RACK
         self.host_cache: dict[str, set[int]] = {}
+        self.rack_cache: dict[str, set[int]] = {}
         for i, s in enumerate(splits):
             for h in (s or {}).get("locations", []) or []:
                 self.host_cache.setdefault(h, set()).add(i)
+                rack = self._rack_resolver(h)
+                if rack != DEFAULT_RACK:
+                    self.rack_cache.setdefault(rack, set()).add(i)
         self._pending_maps = set(range(len(self.maps)))
         self._pending_reduces = set(range(self.num_reduces))
         self.finished_maps = 0
@@ -108,6 +116,10 @@ class JobInProgress:
         #: (heartbeat replays re-deliver terminal statuses)
         self.history_logged: set[str] = set()
         self.speculative_map_tasks = 0
+        #: set by the master once job-level output commit/abort completed —
+        #: clients must not observe a terminal state before the output is
+        #: actually promoted (finalization runs outside the heartbeat lock)
+        self.finalized = threading.Event()
         # --- per-backend profiling (running sums, O(1) per update) ---
         self.finished_cpu_maps = 0
         self.finished_tpu_maps = 0
@@ -196,7 +208,14 @@ class JobInProgress:
             if not self._pending_maps:
                 return self._obtain_speculative_map(host, run_on_tpu,
                                                     tpu_device_id)
+            # tiers: node-local → rack-local → any (≈ obtainNewNodeLocal /
+            # rack-local / NonLocal MapTask)
             local = self.host_cache.get(host, set()) & self._pending_maps
+            if not local:
+                rack = self._rack_resolver(host)
+                if rack != self._default_rack:
+                    local = self.rack_cache.get(rack,
+                                                set()) & self._pending_maps
             idx = min(local) if local else min(self._pending_maps)
             self._pending_maps.discard(idx)
             tip = self.maps[idx]

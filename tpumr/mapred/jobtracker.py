@@ -76,7 +76,10 @@ class JobMaster:
         self.scheduler: TaskScheduler = new_instance(sched_cls, conf)
         self.scheduler.set_manager(self)
         self.history = JobHistory(conf)
-        self._server = RpcServer(self, host=host, port=port)
+        from tpumr.security import rpc_secret
+        self._rpc_secret = rpc_secret(conf)
+        self._server = RpcServer(self, host=host, port=port,
+                                 secret=self._rpc_secret)
         self._stop = threading.Event()
         self._expire_thread = threading.Thread(
             target=self._expire_loop, name="expire-trackers", daemon=True)
@@ -234,7 +237,10 @@ class JobMaster:
         with self.lock:
             self._next_job += 1
             job_id = JobID(self.cluster_id, self._next_job)
-            jip = JobInProgress(job_id, conf_dict, splits)
+        # JobInProgress construction resolves split racks (may exec the
+        # topology script) — built outside the master lock
+        jip = JobInProgress(job_id, conf_dict, splits)
+        with self.lock:
             self.jobs[str(job_id)] = jip
             self._mreg.incr("jobs_submitted")
         # history write (serializes conf + splits) outside the master lock
@@ -249,7 +255,12 @@ class JobMaster:
 
     def get_job_status(self, job_id: str) -> dict:
         jip = self._job(job_id)
-        return jip.status_dict()
+        d = jip.status_dict()
+        if d["state"] in JobState.TERMINAL and not jip.finalized.is_set():
+            # commit/abort still in flight — don't let a polling client
+            # read the output dir before it's promoted
+            d["state"] = JobState.RUNNING
+        return d
 
     def get_counters(self, job_id: str) -> dict:
         return self._job(job_id).counters.to_dict()
@@ -298,6 +309,7 @@ class JobMaster:
             jip.error = jip.error or f"job finalization failed: {e}"
         self.history.job_finished(jip)
         self._mreg.incr(f"jobs_{jip.state.lower()}")
+        jip.finalized.set()
 
     def get_map_completion_events(self, job_id: str, from_index: int = 0,
                                   max_events: int = 10_000) -> list:
@@ -336,21 +348,27 @@ class JobMaster:
                   ask_for_new_task: bool, response_id: int) -> dict:
         name = status["tracker_name"]
         self._mreg.incr("heartbeats")
-        # history appends are file I/O — deferred past the master lock so
-        # disk latency never serializes the control plane
+        # history appends + job finalization are file I/O — deferred past
+        # the master lock so disk latency never serializes the control
+        # plane; task events flush BEFORE finalization so the per-job log
+        # stays causally ordered (TASK_* precede JOB_FINISHED)
         deferred_events: list[tuple[str, str, dict]] = []
+        deferred_final: list[JobInProgress] = []
         try:
             return self._heartbeat_locked(status, initial_contact,
                                           ask_for_new_task, response_id,
-                                          name, deferred_events)
+                                          name, deferred_events,
+                                          deferred_final)
         finally:
             for job_id, event, fields in deferred_events:
                 self.history.task_event(job_id, event, **fields)
+            for jip in deferred_final:
+                self._finalize_job(jip)
 
     def _heartbeat_locked(self, status: dict, initial_contact: bool,
                           ask_for_new_task: bool, response_id: int,
-                          name: str,
-                          deferred_events: list) -> dict:
+                          name: str, deferred_events: list,
+                          deferred_final: list) -> dict:
         with self.lock:
             info = self.trackers.get(name)
             if info is None and not initial_contact:
@@ -402,7 +420,7 @@ class JobMaster:
                             info.blacklisted = True
                     if before == JobState.RUNNING and \
                             jip.state in JobState.TERMINAL:
-                        self._finalize_job(jip)
+                        deferred_final.append(jip)
 
             # Normal case: the tracker echoes the response id we last sent
             # (last[0] == response_id). A MISMATCH means our response was

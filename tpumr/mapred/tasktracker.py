@@ -66,7 +66,10 @@ class NodeRunner:
         self.bind_host = bind_host or ("127.0.0.1" if host and not
                                        _resolvable(host) else host)
         self.name = name or f"tracker_{host}_{id(self) & 0xffff}"
-        self.master = RpcClient(master_host, master_port)
+        from tpumr.security import rpc_secret
+        self._rpc_secret = rpc_secret(conf)
+        self.master = RpcClient(master_host, master_port,
+                                secret=self._rpc_secret)
         remote_version = self.master.call("get_protocol_version")
         if remote_version != PROTOCOL_VERSION:
             raise RuntimeError(f"master protocol {remote_version} != "
@@ -98,7 +101,8 @@ class NodeRunner:
         self._red_sem = threading.Semaphore(max(1, self.max_reduce_slots))
 
         # shuffle server = this tracker's RPC surface (MapOutputServlet role)
-        self._server = RpcServer(self, host=self.bind_host, port=0)
+        self._server = RpcServer(self, host=self.bind_host, port=0,
+                                 secret=self._rpc_secret)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            name=f"{self.name}-heartbeat",
                                            daemon=True)
@@ -416,6 +420,7 @@ class NodeRunner:
         events: dict[int, dict] = {}
         seen = [0]  # incremental cursor into the master's event list
         clients: dict[str, RpcClient] = {}
+        conf_secret = self._rpc_secret
         poll_s = self.conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0
         deadline = time.time() + self.conf.get_int(
             "tpumr.shuffle.timeout.ms", 600_000) / 1000.0
@@ -437,7 +442,8 @@ class NodeRunner:
             host, port = addr.rsplit(":", 1)
             cli = clients.get(addr)
             if cli is None:
-                cli = clients[addr] = RpcClient(host, int(port))
+                cli = clients[addr] = RpcClient(host, int(port),
+                                                secret=conf_secret)
             out = cli.call("get_map_output", job_id, map_index, partition)
             return ifile.iter_transferred_segment(out["data"], out["codec"])
 

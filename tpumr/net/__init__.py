@@ -1,0 +1,6 @@
+"""Network topology ≈ ``org.apache.hadoop.net``."""
+
+from tpumr.net.topology import (DEFAULT_RACK, NetworkTopology,
+                                resolver_from_conf)
+
+__all__ = ["DEFAULT_RACK", "NetworkTopology", "resolver_from_conf"]

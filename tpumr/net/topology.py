@@ -1,0 +1,113 @@
+"""Rack awareness.
+
+≈ ``org.apache.hadoop.net.NetworkTopology`` + ``DNSToSwitchMapping``
+(src/core/org/apache/hadoop/net/, SURVEY.md §2.2): hosts map to racks via
+either a static table (``tpumr.topology.map`` = ``host=\\/rack1,host2=\\/rack2``)
+or an operator script (``topology.script.file.name`` — invoked with
+hostnames, prints one rack per line, the reference's ScriptBasedMapping).
+Unresolvable hosts land in ``/default-rack``. Resolutions are cached.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Callable
+
+DEFAULT_RACK = "/default-rack"
+
+Resolver = Callable[[str], str]
+
+
+def _host_only(name: str) -> str:
+    """Strip a :port suffix so tracker/datanode addresses resolve."""
+    return name.rsplit(":", 1)[0] if ":" in name else name
+
+
+def static_resolver(table: dict[str, str]) -> Resolver:
+    def resolve(host: str) -> str:
+        return table.get(_host_only(host), DEFAULT_RACK)
+    return resolve
+
+
+#: process-wide script-resolution cache — rack mappings are stable, and
+#: per-consumer caches would re-exec the script for every job/daemon
+_script_cache: dict[tuple[str, str], str] = {}
+_script_cache_lock = threading.Lock()
+
+
+def script_resolver(script: str, timeout_s: float = 30.0) -> Resolver:
+    """≈ ScriptBasedMapping: run the script with the hostname, read the
+    rack from stdout. Resolutions cache process-wide; still, callers must
+    not invoke this while holding a control-plane lock on a cold cache."""
+
+    def resolve(host: str) -> str:
+        h = _host_only(host)
+        with _script_cache_lock:
+            if (script, h) in _script_cache:
+                return _script_cache[(script, h)]
+        try:
+            proc = subprocess.run(["/bin/sh", "-c", f"{script} {h}"],
+                                  capture_output=True, text=True,
+                                  timeout=timeout_s)
+            rack = (proc.stdout or "").strip().splitlines()
+            result = rack[0].strip() if rack else DEFAULT_RACK
+        except Exception:  # noqa: BLE001 — resolution failure ≠ crash
+            result = DEFAULT_RACK
+        with _script_cache_lock:
+            _script_cache[(script, h)] = result
+        return result
+
+    return resolve
+
+
+def resolver_from_conf(conf) -> Resolver:
+    """Pick the mapping strategy from configuration (static table wins)."""
+    if conf is not None:
+        table_s = conf.get("tpumr.topology.map")
+        if table_s:
+            table = {}
+            for pair in str(table_s).split(","):
+                host, _, rack = pair.partition("=")
+                if host.strip() and rack.strip():
+                    table[host.strip()] = rack.strip()
+            return static_resolver(table)
+        script = conf.get("topology.script.file.name")
+        if script:
+            return script_resolver(str(script))
+    return lambda host: DEFAULT_RACK
+
+
+class NetworkTopology:
+    """Rack membership tracking ≈ NetworkTopology.add/getRack — the
+    placement-policy input for tdfs and the scheduler's rack-local tier."""
+
+    def __init__(self, resolver: Resolver | None = None) -> None:
+        self.resolver = resolver or (lambda host: DEFAULT_RACK)
+        self._lock = threading.Lock()
+        self._rack_of: dict[str, str] = {}
+
+    def add(self, host: str) -> str:
+        rack = self.resolver(host)
+        with self._lock:
+            self._rack_of[host] = rack
+        return rack
+
+    def remove(self, host: str) -> None:
+        with self._lock:
+            self._rack_of.pop(host, None)
+
+    def rack_of(self, host: str) -> str:
+        with self._lock:
+            cached = self._rack_of.get(host)
+        return cached if cached is not None else self.resolver(host)
+
+    def on_same_rack(self, a: str, b: str) -> bool:
+        return self.rack_of(a) == self.rack_of(b)
+
+    def racks(self) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        with self._lock:
+            for host, rack in self._rack_of.items():
+                out.setdefault(rack, []).append(host)
+        return out

@@ -1,0 +1,70 @@
+"""Security-lite: identity + RPC authentication.
+
+≈ the reference's ``org.apache.hadoop.security`` tier (UserGroupInformation,
+SaslRpcServer digest auth, delegation tokens — 10k LoC of Kerberos/SASL
+machinery, SURVEY.md §2.2). Scoped to what a single-operator TPU cluster
+needs: a process identity (UGI), and shared-secret HMAC request signing
+on every RPC (≈ the DIGEST-MD5 token path, with HMAC-SHA256). Kerberos
+is out of scope — documented divergence.
+
+Config: ``tpumr.rpc.secret`` (inline secret) or ``tpumr.rpc.secret.file``
+(path to a secret file; trailing whitespace ignored). All daemons and
+clients of one cluster must share it. Unset = auth off (the reference's
+``simple`` auth mode).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import getpass
+import os
+import threading
+from typing import Any, Iterator
+
+_local = threading.local()
+
+
+class UserGroupInformation:
+    """≈ UserGroupInformation.getCurrentUser / doAs (simple-auth mode:
+    identity is asserted, not cryptographically proven — exactly the
+    reference's non-Kerberos default)."""
+
+    def __init__(self, user: str, groups: "list[str] | None" = None) -> None:
+        self.user = user
+        self.groups = groups or []
+
+    @staticmethod
+    def get_current_user(conf: Any = None) -> "UserGroupInformation":
+        override = getattr(_local, "ugi", None)
+        if override is not None:
+            return override
+        if conf is not None and conf.get("user.name"):
+            return UserGroupInformation(str(conf.get("user.name")))
+        try:
+            return UserGroupInformation(getpass.getuser())
+        except Exception:  # no passwd entry (containers)
+            return UserGroupInformation(os.environ.get("USER", "nobody"))
+
+    @contextlib.contextmanager
+    def do_as(self) -> Iterator["UserGroupInformation"]:
+        """≈ ugi.doAs: run a block under this identity."""
+        prev = getattr(_local, "ugi", None)
+        _local.ugi = self
+        try:
+            yield self
+        finally:
+            _local.ugi = prev
+
+
+def rpc_secret(conf: Any) -> "bytes | None":
+    """Resolve the cluster RPC secret from conf (None = auth disabled)."""
+    if conf is None:
+        return None
+    inline = conf.get("tpumr.rpc.secret")
+    if inline:
+        return str(inline).encode()
+    path = conf.get("tpumr.rpc.secret.file")
+    if path:
+        with open(path, "rb") as f:
+            return f.read().strip()
+    return None
