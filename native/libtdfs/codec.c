@@ -1,0 +1,223 @@
+/* Typed binary codec — see codec.h and tpumr/io/writable.py. */
+
+#include "codec.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+/* ------------------------------------------------------------ values */
+
+td_val td_null(void) { td_val v; memset(&v, 0, sizeof v); v.t = TD_NULL; return v; }
+
+td_val td_int(int64_t x) { td_val v = td_null(); v.t = TD_INT; v.i = x; return v; }
+
+td_val td_bool(int x) { td_val v = td_null(); v.t = TD_BOOL; v.i = x ? 1 : 0; return v; }
+
+td_val td_text(const char* s) {
+  td_val v = td_null();
+  v.t = TD_TEXT;
+  v.slen = strlen(s);
+  v.s = (char*)malloc(v.slen + 1);
+  memcpy(v.s, s, v.slen + 1);
+  return v;
+}
+
+td_val td_bytes(const char* data, size_t len) {
+  td_val v = td_null();
+  v.t = TD_BYTES;
+  v.slen = len;
+  v.s = (char*)malloc(len + 1);
+  memcpy(v.s, data, len);
+  v.s[len] = 0;
+  return v;
+}
+
+td_val td_list(size_t n) {
+  td_val v = td_null();
+  v.t = TD_LIST;
+  v.n = n;
+  v.items = (td_val*)calloc(n ? n : 1, sizeof(td_val));
+  return v;
+}
+
+td_val td_dict(size_t n_pairs) {
+  td_val v = td_null();
+  v.t = TD_DICT;
+  v.n = n_pairs;
+  v.items = (td_val*)calloc(n_pairs ? 2 * n_pairs : 1, sizeof(td_val));
+  return v;
+}
+
+void td_free(td_val* v) {
+  size_t i, count;
+  if (!v) return;
+  free(v->s);
+  if (v->items) {
+    count = (v->t == TD_DICT) ? 2 * v->n : v->n;
+    for (i = 0; i < count; i++) td_free(&v->items[i]);
+    free(v->items);
+  }
+  memset(v, 0, sizeof *v);
+}
+
+/* ------------------------------------------------------------ buffer */
+
+void td_buf_init(td_buf* b) { b->data = NULL; b->len = b->cap = 0; }
+
+void td_buf_free(td_buf* b) { free(b->data); td_buf_init(b); }
+
+static void buf_put(td_buf* b, const void* p, size_t n) {
+  if (b->len + n > b->cap) {
+    size_t cap = b->cap ? b->cap * 2 : 256;
+    while (cap < b->len + n) cap *= 2;
+    b->data = (char*)realloc(b->data, cap);
+    b->cap = cap;
+  }
+  memcpy(b->data + b->len, p, n);
+  b->len += n;
+}
+
+static void buf_byte(td_buf* b, unsigned char c) { buf_put(b, &c, 1); }
+
+/* ------------------------------------------------------------ encode */
+
+static void enc_vint(td_buf* b, uint64_t v) {
+  while (1) {
+    unsigned char byte = v & 0x7F;
+    v >>= 7;
+    if (v) buf_byte(b, byte | 0x80);
+    else { buf_byte(b, byte); return; }
+  }
+}
+
+static uint64_t zigzag64(int64_t v) {
+  return v >= 0 ? ((uint64_t)v << 1) : (((uint64_t)(-v)) << 1) - 1;
+}
+
+void td_encode(td_buf* out, const td_val* v) {
+  size_t i;
+  switch (v->t) {
+    case TD_NULL: buf_byte(out, 0); break;
+    case TD_BOOL: buf_byte(out, v->i ? 5 : 6); break;
+    case TD_BYTES:
+      buf_byte(out, 1);
+      enc_vint(out, v->slen);
+      buf_put(out, v->s, v->slen);
+      break;
+    case TD_TEXT:
+      buf_byte(out, 2);
+      enc_vint(out, v->slen);
+      buf_put(out, v->s, v->slen);
+      break;
+    case TD_INT:
+      buf_byte(out, 3);
+      enc_vint(out, zigzag64(v->i));
+      break;
+    case TD_FLOAT: {
+      unsigned char be[8];
+      uint64_t bits;
+      memcpy(&bits, &v->f, 8);
+      for (i = 0; i < 8; i++) be[i] = (unsigned char)(bits >> (56 - 8 * i));
+      buf_byte(out, 4);
+      buf_put(out, be, 8);
+      break;
+    }
+    case TD_LIST:
+      buf_byte(out, 7);
+      enc_vint(out, v->n);
+      for (i = 0; i < v->n; i++) td_encode(out, &v->items[i]);
+      break;
+    case TD_DICT:
+      buf_byte(out, 9);
+      enc_vint(out, v->n);
+      for (i = 0; i < 2 * v->n; i++) td_encode(out, &v->items[i]);
+      break;
+  }
+}
+
+/* ------------------------------------------------------------ decode */
+
+static int dec_vint(const char* d, size_t len, size_t* pos, uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (*pos < len) {
+    unsigned char b = (unsigned char)d[(*pos)++];
+    result |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) { *out = result; return 0; }
+    shift += 7;
+    if (shift > 63) return -1;
+  }
+  return -1;
+}
+
+static int64_t unzigzag64(uint64_t v) {
+  return (v & 1) ? -(int64_t)((v + 1) >> 1) : (int64_t)(v >> 1);
+}
+
+int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
+  uint64_t n;
+  size_t i;
+  unsigned char tag;
+  *out = td_null();
+  if (*pos >= len) return -1;
+  tag = (unsigned char)d[(*pos)++];
+  switch (tag) {
+    case 0: return 0;
+    case 5: *out = td_bool(1); return 0;
+    case 6: *out = td_bool(0); return 0;
+    case 1:
+    case 2:
+      if (dec_vint(d, len, pos, &n)) return -1;
+      if (*pos + n > len) return -1;
+      *out = (tag == 1) ? td_bytes(d + *pos, n) : td_null();
+      if (tag == 2) {
+        out->t = TD_TEXT;
+        out->slen = n;
+        out->s = (char*)malloc(n + 1);
+        memcpy(out->s, d + *pos, n);
+        out->s[n] = 0;
+      }
+      *pos += n;
+      return 0;
+    case 3:
+      if (dec_vint(d, len, pos, &n)) return -1;
+      *out = td_int(unzigzag64(n));
+      return 0;
+    case 4: {
+      uint64_t bits = 0;
+      if (*pos + 8 > len) return -1;
+      for (i = 0; i < 8; i++)
+        bits = (bits << 8) | (unsigned char)d[*pos + i];
+      *pos += 8;
+      out->t = TD_FLOAT;
+      memcpy(&out->f, &bits, 8);
+      return 0;
+    }
+    case 7:
+      if (dec_vint(d, len, pos, &n)) return -1;
+      *out = td_list(n);
+      for (i = 0; i < n; i++)
+        if (td_decode(d, len, pos, &out->items[i])) { td_free(out); return -1; }
+      return 0;
+    case 9:
+      if (dec_vint(d, len, pos, &n)) return -1;
+      *out = td_dict(n);
+      for (i = 0; i < 2 * n; i++)
+        if (td_decode(d, len, pos, &out->items[i])) { td_free(out); return -1; }
+      return 0;
+    default:
+      /* tag 8 (ndarray) and unknown tags unsupported in C */
+      return -1;
+  }
+}
+
+const td_val* td_get(const td_val* dict, const char* key) {
+  size_t i;
+  if (!dict || dict->t != TD_DICT) return NULL;
+  for (i = 0; i < dict->n; i++) {
+    const td_val* k = &dict->items[2 * i];
+    if (k->t == TD_TEXT && strcmp(k->s, key) == 0)
+      return &dict->items[2 * i + 1];
+  }
+  return NULL;
+}

@@ -1,0 +1,69 @@
+/* Typed binary codec — C implementation of tpumr.io.writable.
+ *
+ * Wire format (tpumr/io/writable.py): 1 tag byte then payload; varints
+ * are LEB128 (7-bit groups, high bit = continuation); ints are
+ * zigzag-encoded varints; floats are big-endian IEEE float64; ndarray
+ * (tag 8) is not supported here (the C client never needs it).
+ */
+#ifndef TPUMR_CODEC_H
+#define TPUMR_CODEC_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  TD_NULL = 0,
+  TD_BYTES = 1,
+  TD_TEXT = 2,
+  TD_INT = 3,
+  TD_FLOAT = 4,
+  TD_BOOL = 5,   /* wire tags 5 (true) / 6 (false) */
+  TD_LIST = 7,
+  TD_DICT = 9
+} td_type;
+
+typedef struct td_val {
+  td_type t;
+  int64_t i;            /* TD_INT / TD_BOOL */
+  double f;             /* TD_FLOAT */
+  char* s;              /* TD_BYTES / TD_TEXT (owned, NUL-terminated) */
+  size_t slen;
+  struct td_val* items; /* TD_LIST: n entries; TD_DICT: 2n (k,v,k,v…) */
+  size_t n;
+} td_val;
+
+/* constructors (deep-own their arguments' copies) */
+td_val td_null(void);
+td_val td_int(int64_t v);
+td_val td_bool(int v);
+td_val td_text(const char* s);
+td_val td_bytes(const char* data, size_t len);
+td_val td_list(size_t n);              /* items zeroed; fill items[i] */
+td_val td_dict(size_t n_pairs);        /* fill items[2i], items[2i+1] */
+void td_free(td_val* v);
+
+/* growable output buffer */
+typedef struct {
+  char* data;
+  size_t len, cap;
+} td_buf;
+
+void td_buf_init(td_buf* b);
+void td_buf_free(td_buf* b);
+void td_encode(td_buf* out, const td_val* v);
+
+/* decode one value from data[*pos..len); returns 0 ok, -1 error */
+int td_decode(const char* data, size_t len, size_t* pos, td_val* out);
+
+/* dict lookup by text key; NULL if absent */
+const td_val* td_get(const td_val* dict, const char* key);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUMR_CODEC_H */

@@ -1,0 +1,393 @@
+/* libtdfs — see tdfs.h. RPC framing: 4-byte big-endian length +
+ * codec-serialized dict {"id","method","params"} (tpumr/ipc/rpc.py).
+ * Responses: {"id","result"} or {"id","error","traceback"}. */
+
+#include "tdfs.h"
+#include "codec.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static __thread char g_err[1024];
+
+const char* tdfs_last_error(void) { return g_err; }
+
+static void set_err(const char* fmt, const char* detail) {
+  snprintf(g_err, sizeof g_err, fmt, detail ? detail : "");
+}
+
+/* ------------------------------------------------------------ rpc core */
+
+typedef struct {
+  int fd;
+  int64_t next_id;
+} rpc_conn;
+
+static int rpc_open(rpc_conn* c, const char* host, int port) {
+  struct addrinfo hints, *res = NULL, *rp;
+  char portbuf[16];
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host, portbuf, &hints, &res)) {
+    set_err("cannot resolve %s", host);
+    return -1;
+  }
+  c->fd = -1;
+  for (rp = res; rp; rp = rp->ai_next) {
+    c->fd = socket(rp->ai_family, rp->ai_socktype, rp->ai_protocol);
+    if (c->fd < 0) continue;
+    if (connect(c->fd, rp->ai_addr, rp->ai_addrlen) == 0) break;
+    close(c->fd);
+    c->fd = -1;
+  }
+  freeaddrinfo(res);
+  if (c->fd < 0) {
+    set_err("cannot connect to %s", host);
+    return -1;
+  }
+  c->next_id = 1;
+  return 0;
+}
+
+static int write_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = write(fd, p, n);
+    if (w <= 0) return -1;
+    p += w;
+    n -= (size_t)w;
+  }
+  return 0;
+}
+
+static int read_all(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return -1;
+    p += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+/* Calls method(params); params ownership transfers (freed here).
+ * On success returns 0 and fills *result (caller td_free's). */
+static int rpc_call(rpc_conn* c, const char* method, td_val params,
+                    td_val* result) {
+  td_val req = td_dict(3);
+  td_buf buf;
+  unsigned char lenbe[4];
+  uint32_t rlen;
+  char* rdata;
+  size_t pos = 0;
+  td_val resp;
+  const td_val* err;
+  const td_val* res;
+  int rc = -1;
+
+  *result = td_null();  /* every failure path leaves a freeable value */
+
+  req.items[0] = td_text("id");
+  req.items[1] = td_int(c->next_id++);
+  req.items[2] = td_text("method");
+  req.items[3] = td_text(method);
+  req.items[4] = td_text("params");
+  req.items[5] = params;
+
+  td_buf_init(&buf);
+  td_encode(&buf, &req);
+  td_free(&req);
+
+  lenbe[0] = (unsigned char)(buf.len >> 24);
+  lenbe[1] = (unsigned char)(buf.len >> 16);
+  lenbe[2] = (unsigned char)(buf.len >> 8);
+  lenbe[3] = (unsigned char)buf.len;
+  if (write_all(c->fd, (const char*)lenbe, 4) ||
+      write_all(c->fd, buf.data, buf.len)) {
+    td_buf_free(&buf);
+    set_err("rpc send failed%s", NULL);
+    return -1;
+  }
+  td_buf_free(&buf);
+
+  if (read_all(c->fd, (char*)lenbe, 4)) {
+    set_err("rpc recv failed%s", NULL);
+    return -1;
+  }
+  rlen = ((uint32_t)lenbe[0] << 24) | ((uint32_t)lenbe[1] << 16) |
+         ((uint32_t)lenbe[2] << 8) | lenbe[3];
+  rdata = (char*)malloc(rlen);
+  if (read_all(c->fd, rdata, rlen)) {
+    free(rdata);
+    set_err("rpc recv failed%s", NULL);
+    return -1;
+  }
+  if (td_decode(rdata, rlen, &pos, &resp)) {
+    free(rdata);
+    set_err("rpc decode failed%s", NULL);
+    return -1;
+  }
+  free(rdata);
+
+  err = td_get(&resp, "error");
+  if (err && err->t == TD_TEXT) {
+    set_err("remote error: %s", err->s);
+  } else {
+    res = td_get(&resp, "result");
+    if (res) {
+      /* steal the result subtree: blank it in resp so td_free skips it */
+      *result = *res;
+      memset((void*)res, 0, sizeof(td_val));
+    } else {
+      *result = td_null();
+    }
+    rc = 0;
+  }
+  td_free(&resp);
+  return rc;
+}
+
+/* ------------------------------------------------------------ fs handle */
+
+struct tdfsFS_s {
+  rpc_conn nn;
+  char client_name[64];
+};
+
+tdfsFS* tdfs_connect(const char* host, int port) {
+  tdfsFS* fs = (tdfsFS*)calloc(1, sizeof(tdfsFS));
+  if (rpc_open(&fs->nn, host, port)) {
+    free(fs);
+    return NULL;
+  }
+  snprintf(fs->client_name, sizeof fs->client_name, "libtdfs-%d",
+           (int)getpid());
+  return fs;
+}
+
+void tdfs_disconnect(tdfsFS* fs) {
+  if (!fs) return;
+  close(fs->nn.fd);
+  free(fs);
+}
+
+/* one-arg / two-arg boolean helpers */
+
+static int call_bool(tdfsFS* fs, const char* method, td_val params) {
+  td_val result;
+  int rc;
+  if (rpc_call(&fs->nn, method, params, &result)) return -1;
+  rc = (result.t == TD_BOOL || result.t == TD_INT) ? (result.i ? 1 : 0) : 0;
+  td_free(&result);
+  return rc;
+}
+
+int tdfs_exists(tdfsFS* fs, const char* path) {
+  td_val p = td_list(1);
+  p.items[0] = td_text(path);
+  return call_bool(fs, "exists", p);
+}
+
+int tdfs_mkdirs(tdfsFS* fs, const char* path) {
+  td_val p = td_list(1);
+  p.items[0] = td_text(path);
+  return call_bool(fs, "mkdirs", p);
+}
+
+int tdfs_delete(tdfsFS* fs, const char* path, int recursive) {
+  td_val p = td_list(2);
+  p.items[0] = td_text(path);
+  p.items[1] = td_bool(recursive);
+  return call_bool(fs, "delete", p);
+}
+
+int tdfs_rename(tdfsFS* fs, const char* src, const char* dst) {
+  td_val p = td_list(2);
+  p.items[0] = td_text(src);
+  p.items[1] = td_text(dst);
+  return call_bool(fs, "rename", p);
+}
+
+int64_t tdfs_file_size(tdfsFS* fs, const char* path) {
+  td_val p = td_list(1);
+  td_val st;
+  const td_val* len;
+  int64_t out = -1;
+  p.items[0] = td_text(path);
+  if (rpc_call(&fs->nn, "get_status", p, &st)) return -1;
+  len = td_get(&st, "length");
+  if (len && len->t == TD_INT) out = len->i;
+  td_free(&st);
+  return out;
+}
+
+/* ------------------------------------------------------------ read */
+
+static int dn_split(const char* addr, char* host, size_t hostsz, int* port) {
+  const char* colon = strrchr(addr, ':');
+  size_t hl;
+  if (!colon) return -1;
+  hl = (size_t)(colon - addr);
+  if (hl + 1 > hostsz) return -1;
+  memcpy(host, addr, hl);
+  host[hl] = 0;
+  *port = atoi(colon + 1);
+  return 0;
+}
+
+char* tdfs_read_file(tdfsFS* fs, const char* path, int64_t* len_out) {
+  td_val p = td_list(1);
+  td_val blocks;
+  char* out = NULL;
+  size_t total = 0, off = 0, i, j;
+
+  p.items[0] = td_text(path);
+  if (rpc_call(&fs->nn, "get_block_locations", p, &blocks)) return NULL;
+  if (blocks.t != TD_LIST) {
+    td_free(&blocks);
+    set_err("unexpected block list%s", NULL);
+    return NULL;
+  }
+  for (i = 0; i < blocks.n; i++) {
+    const td_val* sz = td_get(&blocks.items[i], "size");
+    total += sz && sz->t == TD_INT ? (size_t)sz->i : 0;
+  }
+  out = (char*)malloc(total ? total : 1);
+
+  for (i = 0; i < blocks.n; i++) {
+    const td_val* bid = td_get(&blocks.items[i], "block_id");
+    const td_val* locs = td_get(&blocks.items[i], "locations");
+    int ok = 0;
+    if (!bid || !locs || locs->t != TD_LIST) {
+      free(out);
+      td_free(&blocks);
+      set_err("malformed block entry for %s", path);
+      return NULL;
+    }
+    for (j = 0; j < locs->n && !ok; j++) {  /* replica failover */
+      char host[256];
+      int port;
+      rpc_conn dn;
+      td_val dp;
+      td_val data = td_null();
+      if (locs->items[j].t != TD_TEXT ||
+          dn_split(locs->items[j].s, host, sizeof host, &port))
+        continue;
+      if (rpc_open(&dn, host, port)) continue;
+      dp = td_list(1);
+      dp.items[0] = td_int(bid->i);
+      if (rpc_call(&dn, "read_block", dp, &data) == 0 &&
+          data.t == TD_BYTES) {
+        if (off + data.slen > total) {
+          /* replica longer than NameNode metadata: corrupt/byzantine */
+          td_free(&data);
+          close(dn.fd);
+          free(out);
+          td_free(&blocks);
+          set_err("replica larger than metadata for %s", path);
+          return NULL;
+        }
+        memcpy(out + off, data.s, data.slen);
+        off += data.slen;
+        ok = 1;
+      }
+      td_free(&data);
+      close(dn.fd);
+    }
+    if (!ok) {
+      free(out);
+      td_free(&blocks);
+      set_err("no replica readable for a block of %s", path);
+      return NULL;
+    }
+  }
+  td_free(&blocks);
+  *len_out = (int64_t)off;
+  return out;
+}
+
+/* ------------------------------------------------------------ write */
+
+int tdfs_write_file(tdfsFS* fs, const char* path, const char* data,
+                    int64_t len) {
+  td_val p = td_list(5);
+  td_val meta;
+  const td_val* bs;
+  int64_t block_size, off = 0, prev = -1, last = -1;
+
+  p.items[0] = td_text(path);
+  p.items[1] = td_text(fs->client_name);
+  p.items[2] = td_null();  /* replication: default */
+  p.items[3] = td_null();  /* block size: default */
+  p.items[4] = td_bool(1); /* overwrite */
+  if (rpc_call(&fs->nn, "create", p, &meta)) return -1;
+  bs = td_get(&meta, "block_size");
+  block_size = bs && bs->t == TD_INT ? bs->i : (8 << 20);
+  td_free(&meta);
+
+  while (off < len || (len == 0 && off == 0)) {
+    int64_t n = len - off < block_size ? len - off : block_size;
+    td_val ap, alloc, wp, wres;
+    const td_val* bid;
+    const td_val* targets;
+    char host[256];
+    int port;
+    rpc_conn dn;
+    size_t k;
+
+    if (len == 0) break; /* empty file: create+complete only */
+
+    ap = td_list(4);
+    ap.items[0] = td_text(path);
+    ap.items[1] = td_text(fs->client_name);
+    ap.items[2] = td_int(prev);
+    ap.items[3] = td_list(0); /* excluded */
+    if (rpc_call(&fs->nn, "add_block", ap, &alloc)) return -1;
+    bid = td_get(&alloc, "block_id");
+    targets = td_get(&alloc, "targets");
+    if (!bid || !targets || targets->t != TD_LIST || targets->n == 0 ||
+        targets->items[0].t != TD_TEXT ||
+        dn_split(targets->items[0].s, host, sizeof host, &port)) {
+      td_free(&alloc);
+      set_err("bad block allocation for %s", path);
+      return -1;
+    }
+    if (rpc_open(&dn, host, port)) {
+      td_free(&alloc);
+      return -1;
+    }
+    wp = td_list(3);
+    wp.items[0] = td_int(bid->i);
+    wp.items[1] = td_bytes(data + off, (size_t)n);
+    wp.items[2] = td_list(targets->n - 1); /* downstream pipeline */
+    for (k = 1; k < targets->n; k++)
+      wp.items[2].items[k - 1] = td_text(targets->items[k].s);
+    td_free(&alloc);
+    if (rpc_call(&dn, "write_block", wp, &wres)) {
+      close(dn.fd);
+      return -1;
+    }
+    td_free(&wres);
+    close(dn.fd);
+    prev = n;
+    last = n;
+    off += n;
+  }
+
+  {
+    td_val cp = td_list(3);
+    td_val cres;
+    cp.items[0] = td_text(path);
+    cp.items[1] = td_text(fs->client_name);
+    cp.items[2] = td_int(last);
+    if (rpc_call(&fs->nn, "complete", cp, &cres)) return -1;
+    td_free(&cres);
+  }
+  return 0;
+}

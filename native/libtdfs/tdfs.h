@@ -1,0 +1,55 @@
+/* libtdfs — C client for the tdfs replicated block store.
+ *
+ * ≈ the reference's libhdfs (src/c++/libhdfs/hdfs.h — the C FS API over
+ * the Java client): connect to the NameNode, namespace operations, and
+ * whole-file block-granular read/write through the DataNode protocol.
+ * Speaks the framework's typed binary RPC codec natively (codec.h) —
+ * no JNI/embedded-interpreter detour (the reference needed a JVM in
+ * process; this is a plain TCP client).
+ *
+ * Thread safety: one tdfsFS per thread (connection state is per-handle).
+ * Cluster auth (tpumr.rpc.secret) is not supported — connect to open
+ * clusters only (documented divergence).
+ */
+#ifndef TPUMR_TDFS_H
+#define TPUMR_TDFS_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tdfsFS_s tdfsFS;
+
+/* Connect to a NameNode; NULL on failure (see tdfs_last_error). */
+tdfsFS* tdfs_connect(const char* host, int port);
+void tdfs_disconnect(tdfsFS* fs);
+
+/* Namespace ops: 1 = yes/ok, 0 = no, -1 = error. */
+int tdfs_exists(tdfsFS* fs, const char* path);
+int tdfs_mkdirs(tdfsFS* fs, const char* path);
+int tdfs_delete(tdfsFS* fs, const char* path, int recursive);
+int tdfs_rename(tdfsFS* fs, const char* src, const char* dst);
+
+/* File size in bytes, -1 on error. */
+int64_t tdfs_file_size(tdfsFS* fs, const char* path);
+
+/* Read a whole file. Returns a malloc'd buffer (caller frees), sets
+ * *len_out; NULL on error. */
+char* tdfs_read_file(tdfsFS* fs, const char* path, int64_t* len_out);
+
+/* Create/overwrite a file with the given bytes (block-granular pipeline
+ * writes under the hood). 0 on success, -1 on error. */
+int tdfs_write_file(tdfsFS* fs, const char* path, const char* data,
+                    int64_t len);
+
+/* Last error message for this thread ("" if none). */
+const char* tdfs_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUMR_TDFS_H */

@@ -1,0 +1,93 @@
+/* tdfs_cli — command-line exerciser for libtdfs (the round-trip tests
+ * drive this against a MiniDFSCluster; ≈ the hdfs_test binary shipped
+ * with libhdfs). */
+
+#include "tdfs.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+int main(int argc, char** argv) {
+  const char* host;
+  int port;
+  const char* cmd;
+  tdfsFS* fs;
+  int rc = 2;
+
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: tdfs_cli HOST PORT CMD [args]\n"
+            "  exists PATH | mkdirs PATH | delete PATH | rename SRC DST\n"
+            "  size PATH | cat PATH | put LOCAL PATH\n");
+    return 2;
+  }
+  host = argv[1];
+  port = atoi(argv[2]);
+  cmd = argv[3];
+
+  fs = tdfs_connect(host, port);
+  if (!fs) {
+    fprintf(stderr, "connect failed: %s\n", tdfs_last_error());
+    return 2;
+  }
+
+  if (strcmp(cmd, "exists") == 0 && argc == 5) {
+    rc = tdfs_exists(fs, argv[4]);
+    printf("%s\n", rc == 1 ? "yes" : "no");
+    rc = rc == 1 ? 0 : 1;
+  } else if (strcmp(cmd, "mkdirs") == 0 && argc == 5) {
+    rc = tdfs_mkdirs(fs, argv[4]) == 1 ? 0 : 1;
+  } else if (strcmp(cmd, "delete") == 0 && argc == 5) {
+    rc = tdfs_delete(fs, argv[4], 1) == 1 ? 0 : 1;
+  } else if (strcmp(cmd, "rename") == 0 && argc == 6) {
+    rc = tdfs_rename(fs, argv[4], argv[5]) == 1 ? 0 : 1;
+  } else if (strcmp(cmd, "size") == 0 && argc == 5) {
+    int64_t n = tdfs_file_size(fs, argv[4]);
+    if (n >= 0) {
+      printf("%lld\n", (long long)n);
+      rc = 0;
+    } else {
+      fprintf(stderr, "size failed: %s\n", tdfs_last_error());
+      rc = 1;
+    }
+  } else if (strcmp(cmd, "cat") == 0 && argc == 5) {
+    int64_t n = 0;
+    char* data = tdfs_read_file(fs, argv[4], &n);
+    if (data) {
+      fwrite(data, 1, (size_t)n, stdout);
+      free(data);
+      rc = 0;
+    } else {
+      fprintf(stderr, "read failed: %s\n", tdfs_last_error());
+      rc = 1;
+    }
+  } else if (strcmp(cmd, "put") == 0 && argc == 6) {
+    FILE* f = fopen(argv[4], "rb");
+    char* data;
+    long n;
+    if (!f) {
+      fprintf(stderr, "cannot open %s\n", argv[4]);
+      rc = 1;
+    } else {
+      fseek(f, 0, SEEK_END);
+      n = ftell(f);
+      fseek(f, 0, SEEK_SET);
+      data = (char*)malloc(n ? (size_t)n : 1);
+      if (fread(data, 1, (size_t)n, f) != (size_t)n) n = -1;
+      fclose(f);
+      if (n < 0 || tdfs_write_file(fs, argv[5], data, n)) {
+        fprintf(stderr, "write failed: %s\n", tdfs_last_error());
+        rc = 1;
+      } else {
+        rc = 0;
+      }
+      free(data);
+    }
+  } else {
+    fprintf(stderr, "unknown command %s\n", cmd);
+  }
+
+  tdfs_disconnect(fs);
+  return rc;
+}

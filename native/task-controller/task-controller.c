@@ -1,0 +1,108 @@
+/* task-controller — privilege-separated task launcher.
+ *
+ * ≈ the reference's setuid task-controller (src/c++/task-controller/,
+ * 2.8k C: the LinuxTaskController backend that launches task processes
+ * as the submitting user, with path validation so a compromised tracker
+ * cannot aim it outside the task sandbox).
+ *
+ * Usage: task-controller <user> <task-dir> <stdout-file> <cmd> [args...]
+ *
+ * - validates the task dir exists, is owned by the invoking/target user,
+ *   and contains no ".." traversal;
+ * - when running as root (installed setuid, production): setgid/setuid
+ *   to the target user before exec;
+ * - when not root (tests, single-user clusters): requires <user> to be
+ *   the current user and just sandboxes cwd/env;
+ * - clears the environment except PATH/HOME/LANG + TPUMR_* passthrough,
+ *   chdirs into the task dir, redirects stdout/stderr to the log file,
+ *   then execs the command.
+ */
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pwd.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern char** environ;
+
+static int fail(const char* msg) {
+  fprintf(stderr, "task-controller: %s (errno=%s)\n", msg,
+          errno ? strerror(errno) : "0");
+  return 10;
+}
+
+static int validate_path(const char* p) {
+  if (p[0] != '/') return -1;               /* absolute only */
+  if (strstr(p, "/../") || strstr(p, "/./")) return -1;
+  size_t n = strlen(p);
+  if (n >= 3 && strcmp(p + n - 3, "/..") == 0) return -1;
+  if (n >= 2 && strcmp(p + n - 2, "/.") == 0) return -1;
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  const char* user;
+  const char* task_dir;
+  const char* logfile;
+  struct passwd* pw;
+  struct stat st;
+  int logfd;
+  char* keep_env[64];
+  int nkeep = 0;
+  int i;
+
+  if (argc < 5) {
+    fprintf(stderr,
+            "usage: task-controller USER TASK_DIR LOGFILE CMD [ARGS...]\n");
+    return 2;
+  }
+  user = argv[1];
+  task_dir = argv[2];
+  logfile = argv[3];
+
+  if (validate_path(task_dir) || validate_path(logfile))
+    return fail("task dir and logfile must be absolute, no traversal");
+
+  pw = getpwnam(user);
+  if (!pw) return fail("unknown target user");
+
+  if (stat(task_dir, &st) || !S_ISDIR(st.st_mode))
+    return fail("task dir missing or not a directory");
+
+  if (getuid() == 0) {
+    /* production (setuid root): the sandbox must belong to the target
+     * user before we drop into it */
+    if (st.st_uid != pw->pw_uid)
+      return fail("task dir not owned by target user");
+    if (setgid(pw->pw_gid) || setuid(pw->pw_uid))
+      return fail("cannot drop privileges");
+  } else if (getuid() != pw->pw_uid) {
+    return fail("not root: target user must be the invoking user");
+  }
+
+  /* minimal environment: PATH/HOME/LANG + TPUMR_* passthrough */
+  for (i = 0; environ[i] && nkeep < 60; i++) {
+    if (strncmp(environ[i], "PATH=", 5) == 0 ||
+        strncmp(environ[i], "HOME=", 5) == 0 ||
+        strncmp(environ[i], "LANG=", 5) == 0 ||
+        strncmp(environ[i], "TPUMR_", 6) == 0)
+      keep_env[nkeep++] = environ[i];
+  }
+  keep_env[nkeep] = NULL;
+
+  if (chdir(task_dir)) return fail("cannot chdir into task dir");
+
+  logfd = open(logfile, O_WRONLY | O_CREAT | O_APPEND, 0640);
+  if (logfd < 0) return fail("cannot open logfile");
+  if (dup2(logfd, 1) < 0 || dup2(logfd, 2) < 0)
+    return fail("cannot redirect stdio");
+  close(logfd);
+
+  execve(argv[4], &argv[4], keep_env);
+  return fail("exec failed");
+}

@@ -1,0 +1,131 @@
+"""Native tier ≈ SURVEY.md §2.6: libtdfs (C client over the tdfs
+protocol, ≈ libhdfs) and the task-controller launcher. Builds with the
+local toolchain; skipped when no C compiler is available."""
+
+import getpass
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from tpumr.mapred.jobconf import JobConf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIBTDFS = os.path.join(REPO, "native", "libtdfs")
+TASKCTL = os.path.join(REPO, "native", "task-controller")
+
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C toolchain")
+
+
+def build(path):
+    r = subprocess.run(["make"], cwd=path, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return os.path.join(path, "build")
+
+
+@pytest.fixture(scope="module")
+def tdfs_cli():
+    return os.path.join(build(LIBTDFS), "tdfs_cli")
+
+
+@pytest.fixture(scope="module")
+def task_controller():
+    return os.path.join(build(TASKCTL), "task-controller")
+
+
+class TestLibTdfs:
+    @pytest.fixture()
+    def cluster(self):
+        from tpumr.dfs.mini_cluster import MiniDFSCluster
+        conf = JobConf()
+        conf.set("dfs.block.size", 4096)  # force multi-block files
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            yield c
+
+    def run(self, cli, cluster, *args, binary=False):
+        host, port = cluster.namenode.address
+        return subprocess.run([cli, host, str(port), *args],
+                              capture_output=True, timeout=60,
+                              text=not binary)
+
+    def test_roundtrip_multi_block(self, tdfs_cli, cluster, tmp_path):
+        payload = os.urandom(3 * 4096 + 123)  # 4 blocks
+        local = tmp_path / "in.bin"
+        local.write_bytes(payload)
+        r = self.run(tdfs_cli, cluster, "put", str(local), "/n/file.bin")
+        assert r.returncode == 0, r.stderr
+        r = self.run(tdfs_cli, cluster, "size", "/n/file.bin")
+        assert int(r.stdout) == len(payload)
+        r = self.run(tdfs_cli, cluster, "cat", "/n/file.bin", binary=True)
+        assert r.returncode == 0 and r.stdout == payload
+        # Python client sees the C-written file bit-for-bit
+        with cluster.client().open("/n/file.bin") as f:
+            assert f.read() == payload
+
+    def test_namespace_ops(self, tdfs_cli, cluster):
+        assert self.run(tdfs_cli, cluster, "mkdirs", "/n/d").returncode == 0
+        assert self.run(tdfs_cli, cluster, "exists", "/n/d").returncode == 0
+        assert self.run(tdfs_cli, cluster,
+                        "exists", "/n/nope").returncode == 1
+        # C client reads a Python-written file
+        with cluster.client().create("/n/py.txt") as f:
+            f.write(b"from python")
+        r = self.run(tdfs_cli, cluster, "cat", "/n/py.txt")
+        assert r.stdout == "from python"
+        assert self.run(tdfs_cli, cluster, "rename", "/n/py.txt",
+                        "/n/d/moved.txt").returncode == 0
+        assert self.run(tdfs_cli, cluster, "delete", "/n/d").returncode == 0
+        assert self.run(tdfs_cli, cluster,
+                        "exists", "/n/d").returncode == 1
+
+    def test_error_reporting(self, tdfs_cli, cluster):
+        r = self.run(tdfs_cli, cluster, "cat", "/does/not/exist")
+        assert r.returncode == 1
+        assert "error" in r.stderr.lower()
+
+
+class TestTaskController:
+    def test_launches_sandboxed(self, task_controller, tmp_path):
+        task_dir = tmp_path / "attempt_1"
+        task_dir.mkdir()
+        log = tmp_path / "task.log"
+        env = dict(os.environ, TPUMR_MARKER="visible", SECRET_THING="hidden")
+        r = subprocess.run(
+            [task_controller, getpass.getuser(), str(task_dir), str(log),
+             "/bin/sh", "-c", "pwd; echo M=$TPUMR_MARKER S=$SECRET_THING"],
+            env=env, capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, r.stderr
+        out = log.read_text()
+        assert str(task_dir) in out          # chdir'd into the sandbox
+        assert "M=visible" in out            # TPUMR_* passes through
+        assert "S=hidden" not in out         # everything else scrubbed
+
+    def test_rejects_traversal_and_relative(self, task_controller, tmp_path):
+        log = tmp_path / "l.log"
+        for bad in ("relative/dir", "/tmp/../etc"):
+            r = subprocess.run(
+                [task_controller, getpass.getuser(), bad, str(log),
+                 "/bin/true"], capture_output=True, text=True)
+            assert r.returncode == 10
+            assert "traversal" in r.stderr or "absolute" in r.stderr
+
+    def test_rejects_other_user_when_not_root(self, task_controller,
+                                              tmp_path):
+        if os.getuid() == 0:
+            pytest.skip("running as root")
+        task_dir = tmp_path / "t"
+        task_dir.mkdir()
+        r = subprocess.run(
+            [task_controller, "daemon", str(task_dir),
+             str(tmp_path / "l.log"), "/bin/true"],
+            capture_output=True, text=True)
+        assert r.returncode == 10
+
+    def test_missing_task_dir(self, task_controller, tmp_path):
+        r = subprocess.run(
+            [task_controller, getpass.getuser(), str(tmp_path / "nope"),
+             str(tmp_path / "l.log"), "/bin/true"],
+            capture_output=True, text=True)
+        assert r.returncode == 10

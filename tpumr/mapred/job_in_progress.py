@@ -198,7 +198,8 @@ class JobInProgress:
     # ------------------------------------------------------------ obtain
 
     def obtain_new_map_task(self, host: str, run_on_tpu: bool,
-                            tpu_device_id: int = -1) -> Task | None:
+                            tpu_device_id: int = -1,
+                            rack: "str | None" = None) -> Task | None:
         """Locality-preferring map assignment ≈ obtainNewNodeLocalMapTask →
         obtainNewNonLocalMapTask (selection path of
         JobQueueTaskScheduler.java:306-317)."""
@@ -209,10 +210,14 @@ class JobInProgress:
                 return self._obtain_speculative_map(host, run_on_tpu,
                                                     tpu_device_id)
             # tiers: node-local → rack-local → any (≈ obtainNewNodeLocal /
-            # rack-local / NonLocal MapTask)
+            # rack-local / NonLocal MapTask). The tracker reports its own
+            # rack (resolved tracker-side); resolving here is the fallback
+            # for local/direct callers only — it may exec the topology
+            # script, which must not happen on the scheduling path.
             local = self.host_cache.get(host, set()) & self._pending_maps
             if not local:
-                rack = self._rack_resolver(host)
+                if rack is None:
+                    rack = self._rack_resolver(host)
                 if rack != self._default_rack:
                     local = self.rack_cache.get(rack,
                                                 set()) & self._pending_maps

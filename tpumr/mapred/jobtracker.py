@@ -307,9 +307,13 @@ class JobMaster:
                     committer.abort_job()
         except Exception as e:  # noqa: BLE001
             jip.error = jip.error or f"job finalization failed: {e}"
-        self.history.job_finished(jip)
-        self._mreg.incr(f"jobs_{jip.state.lower()}")
-        jip.finalized.set()
+        try:
+            self.history.job_finished(jip)
+            self._mreg.incr(f"jobs_{jip.state.lower()}")
+        finally:
+            # even when history I/O fails the job must become observable
+            # as finished — a stuck RUNNING mask would hang clients
+            jip.finalized.set()
 
     def get_map_completion_events(self, job_id: str, from_index: int = 0,
                                   max_events: int = 10_000) -> list:
@@ -361,9 +365,16 @@ class JobMaster:
                                           deferred_final)
         finally:
             for job_id, event, fields in deferred_events:
-                self.history.task_event(job_id, event, **fields)
+                try:
+                    self.history.task_event(job_id, event, **fields)
+                except Exception:  # noqa: BLE001 — history I/O best-effort
+                    pass
             for jip in deferred_final:
-                self._finalize_job(jip)
+                try:
+                    self._finalize_job(jip)
+                except Exception:  # noqa: BLE001
+                    jip.error = jip.error or "finalization failed"
+                    jip.finalized.set()
 
     def _heartbeat_locked(self, status: dict, initial_contact: bool,
                           ask_for_new_task: bool, response_id: int,

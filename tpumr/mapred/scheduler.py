@@ -148,7 +148,8 @@ class HybridQueueScheduler(TaskScheduler):
                     continue  # ≈ gpu-executable gate (:342-347)
                 device = free_devices[0]
                 task = job.obtain_new_map_task(host, run_on_tpu=True,
-                                               tpu_device_id=device)
+                                               tpu_device_id=device,
+                                               rack=tts.get("rack"))
                 if task is not None:
                     free_devices.pop(0)  # consume locally (:373-378)
                     break
@@ -164,7 +165,8 @@ class HybridQueueScheduler(TaskScheduler):
                 jid = str(job.job_id)
                 if cpu_budget.get(jid, 0) <= 0:
                     continue
-                task = job.obtain_new_map_task(host, run_on_tpu=False)
+                task = job.obtain_new_map_task(host, run_on_tpu=False,
+                                               rack=tts.get("rack"))
                 if task is not None:
                     cpu_budget[jid] -= 1
                     break

@@ -75,6 +75,11 @@ class NodeRunner:
             raise RuntimeError(f"master protocol {remote_version} != "
                                f"{PROTOCOL_VERSION}")
 
+        # rack resolved tracker-side at startup (outside any master lock —
+        # the scheduler must never exec the topology script mid-heartbeat)
+        from tpumr.net import resolver_from_conf
+        self.rack = resolver_from_conf(conf)(self.host)
+
         self.max_cpu_map_slots = conf.max_cpu_map_slots
         self.max_tpu_map_slots = conf.max_tpu_map_slots
         self.max_reduce_slots = conf.max_reduce_slots
@@ -210,6 +215,7 @@ class NodeRunner:
                 "count_reduce_tasks": red,
                 "available_tpu_devices": self._available_tpu_devices(),
                 "task_statuses": statuses,
+                "rack": self.rack,
                 "healthy": (self.health.healthy
                             if self.health is not None else True),
                 "health_report": (self.health.report

@@ -47,7 +47,9 @@ def script_resolver(script: str, timeout_s: float = 30.0) -> Resolver:
             if (script, h) in _script_cache:
                 return _script_cache[(script, h)]
         try:
-            proc = subprocess.run(["/bin/sh", "-c", f"{script} {h}"],
+            # argv form, never a shell: host strings come from job
+            # submissions and must not be interpretable
+            proc = subprocess.run([script, h],
                                   capture_output=True, text=True,
                                   timeout=timeout_s)
             rack = (proc.stdout or "").strip().splitlines()
