@@ -91,7 +91,8 @@ static void enc_vint(td_buf* b, uint64_t v) {
 }
 
 static uint64_t zigzag64(int64_t v) {
-  return v >= 0 ? ((uint64_t)v << 1) : (((uint64_t)(-v)) << 1) - 1;
+  /* no signed negation: -INT64_MIN is UB */
+  return v >= 0 ? (uint64_t)v << 1 : ((~(uint64_t)v) << 1) | 1;
 }
 
 void td_encode(td_buf* out, const td_val* v) {
@@ -195,13 +196,19 @@ int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
     }
     case 7:
       if (dec_vint(d, len, pos, &n)) return -1;
+      /* each element needs >= 1 byte: bound against remaining input so a
+       * malicious count can't drive a huge/failed allocation */
+      if (n > len - *pos) return -1;
       *out = td_list(n);
+      if (!out->items) return -1;
       for (i = 0; i < n; i++)
         if (td_decode(d, len, pos, &out->items[i])) { td_free(out); return -1; }
       return 0;
     case 9:
       if (dec_vint(d, len, pos, &n)) return -1;
+      if (n > (len - *pos) / 2 + 1) return -1;
       *out = td_dict(n);
+      if (!out->items) return -1;
       for (i = 0; i < 2 * n; i++)
         if (td_decode(d, len, pos, &out->items[i])) { td_free(out); return -1; }
       return 0;

@@ -1,0 +1,26 @@
+"""Gridmix-lite harness ≈ src/benchmarks/gridmix (SURVEY.md §2.4)."""
+
+import json
+
+from tpumr.benchmarks.gridmix import run
+from tpumr.cli import main as cli_main
+
+
+def test_small_mix_succeeds():
+    report = run("small", root="mem:///gmx", cpu_only=True)
+    assert report["succeeded"], report
+    assert set(report["jobs"]) == {"wordcount", "grep", "randomwriter",
+                                   "sort", "kmeans", "pi"}
+    assert all(j["ok"] for j in report["jobs"].values())
+    assert report["total_wall_s"] > 0
+
+
+def test_cli_entry(capsys):
+    assert cli_main(["gridmix", "--scale", "small",
+                     "--root", "mem:///gmx2", "--cpu-only"]) == 0
+    out = capsys.readouterr().out
+    # example jobs print their own stdout first; the report is the final
+    # top-level JSON object
+    report = json.loads(out[out.rindex('{\n  "benchmark"'):])
+    assert report["benchmark"] == "gridmix-lite"
+    assert report["succeeded"]

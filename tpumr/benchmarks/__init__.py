@@ -1,0 +1,2 @@
+"""Benchmark harnesses ≈ the reference's ``src/benchmarks`` tree
+(gridmix/gridmix2: synthetic mixed workloads — SURVEY.md §2.4)."""

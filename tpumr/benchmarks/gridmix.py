@@ -1,0 +1,118 @@
+"""Gridmix-lite — synthetic mixed-workload benchmark.
+
+≈ ``src/benchmarks/gridmix{,2}`` (reference README: "runs a mix of
+small/medium/large jobs", sized there for a 480-500 node cluster —
+SURVEY.md §6). This harness generates synthetic inputs and runs a
+representative mix through the real job path — text jobs (wordcount,
+grep), a sort over random SequenceFile records, the device-kernel
+K-Means assignment, and Monte-Carlo pi — reporting per-job wall clock
+and aggregate throughput as one JSON object.
+
+Scales: ``small`` (seconds, CI-sized), ``medium``, ``large``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import time
+
+import numpy as np
+
+from tpumr.cli import main as cli_main
+from tpumr.fs import get_filesystem
+
+SCALES = {
+    #           text_mb  sort_mb  kmeans_pts  pi_samples
+    "small":   (1,       1,       50_000,     20_000),
+    "medium":  (32,      32,      2_000_000,  2_000_000),
+    "large":   (256,     128,     20_000_000, 20_000_000),
+}
+
+_WORDS = ("alpha bravo charlie delta echo foxtrot golf hotel india "
+          "juliet kilo lima mike november oscar papa").split()
+
+
+def _gen_text(fs, path: str, mb: int, seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    target = mb << 20
+    out = io.BytesIO()
+    while out.tell() < target:
+        line = b" ".join(rng.choice(_WORDS).encode()
+                         for _ in range(12)) + b"\n"
+        out.write(line * 256)
+    fs.write_bytes(path, out.getvalue()[:target])
+
+
+def _gen_points(fs, path: str, n: int, seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    pts = rng.normal(size=(n, 16)).astype(np.float32)
+    buf = io.BytesIO()
+    np.save(buf, pts)
+    fs.write_bytes(path, buf.getvalue())
+
+
+def _timed(name: str, argv: list[str], results: dict) -> bool:
+    t0 = time.time()
+    rc = cli_main(argv)
+    results[name] = {"wall_s": round(time.time() - t0, 3), "ok": rc == 0}
+    return rc == 0
+
+
+def run(scale: str = "small", root: str = "mem:///gridmix",
+        cpu_only: bool = False) -> dict:
+    text_mb, sort_mb, kmeans_pts, pi_samples = SCALES[scale]
+    fs = get_filesystem(root)
+    base = root.rstrip("/")
+    results: dict = {}
+    t_all = time.time()
+
+    _gen_text(fs, f"{base}/text.txt", text_mb, 1)
+    _gen_points(fs, f"{base}/points.npy", kmeans_pts, 2)
+    flags = ["--cpu-only"] if cpu_only else []
+
+    ok = True
+    ok &= _timed("wordcount", ["examples", "wordcount", f"{base}/text.txt",
+                               f"{base}/wc-out", "-r", "2", *flags],
+                 results)
+    ok &= _timed("grep", ["examples", "grep", f"{base}/text.txt",
+                          f"{base}/grep-out", r"al\w+", *flags], results)
+    ok &= _timed("randomwriter", ["examples", "randomwriter",
+                                  f"{base}/rand", "-m", "2",
+                                  "--bytes-per-map",
+                                  str((sort_mb << 20) // 2)], results)
+    ok &= _timed("sort", ["examples", "sort", f"{base}/rand",
+                          f"{base}/sorted", "-r", "2", "--total-order"],
+                 results)
+    ok &= _timed("kmeans", ["examples", "kmeans", f"{base}/points.npy",
+                            f"{base}/km-out", "-k", "8", "-i", "2",
+                            *flags], results)
+    ok &= _timed("pi", ["examples", "pi", "4", str(pi_samples // 4),
+                        "--work", f"{base}/pi", *flags], results)
+
+    return {
+        "benchmark": "gridmix-lite",
+        "scale": scale,
+        "cpu_only": cpu_only,
+        "jobs": results,
+        "total_wall_s": round(time.time() - t_all, 3),
+        "succeeded": ok,
+    }
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="tpumr gridmix")
+    ap.add_argument("--scale", choices=sorted(SCALES), default="small")
+    ap.add_argument("--root", default="mem:///gridmix",
+                    help="working URI (use tdfs:// for cluster runs)")
+    ap.add_argument("--cpu-only", action="store_true")
+    args = ap.parse_args(argv)
+    report = run(args.scale, args.root, args.cpu_only)
+    print(json.dumps(report, indent=2))
+    return 0 if report["succeeded"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -41,6 +41,7 @@ Clients:
   distcp SRC DST       distributed copy (any scheme to any scheme)
   archive SRC DEST.tharch | archive -ls ARCH   pack/list archives
   rumen HISTORY_DIR    extract job traces from history
+  gridmix [--scale S]  synthetic mixed-workload benchmark
   version              print the version
 """
 
@@ -255,6 +256,11 @@ def cmd_job(conf, argv: list[str]) -> int:
     return 255
 
 
+def cmd_gridmix(conf, argv: list[str]) -> int:
+    from tpumr.benchmarks.gridmix import main as gridmix_main
+    return gridmix_main(argv)
+
+
 def cmd_distcp(conf, argv: list[str]) -> int:
     from tpumr.tools.distcp import main as distcp_main
     return distcp_main(argv)
@@ -303,6 +309,7 @@ COMMANDS = {
     "pipes": cmd_pipes,
     "streaming": cmd_streaming,
     "distcp": cmd_distcp,
+    "gridmix": cmd_gridmix,
     "archive": cmd_archive,
     "rumen": cmd_rumen,
     "examples": cmd_examples,

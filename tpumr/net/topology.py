@@ -48,8 +48,10 @@ def script_resolver(script: str, timeout_s: float = 30.0) -> Resolver:
                 return _script_cache[(script, h)]
         try:
             # argv form, never a shell: host strings come from job
-            # submissions and must not be interpretable
-            proc = subprocess.run([script, h],
+            # submissions and must not be interpretable; shlex keeps
+            # interpreter-style configs ("python3 /opt/rack.py") working
+            import shlex
+            proc = subprocess.run(shlex.split(script) + [h],
                                   capture_output=True, text=True,
                                   timeout=timeout_s)
             rack = (proc.stdout or "").strip().splitlines()
