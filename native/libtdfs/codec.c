@@ -152,7 +152,9 @@ static int dec_vint(const char* d, size_t len, size_t* pos, uint64_t* out) {
 }
 
 static int64_t unzigzag64(uint64_t v) {
-  return (v & 1) ? -(int64_t)((v + 1) >> 1) : (int64_t)(v >> 1);
+  /* branchless standard form: correct for v = UINT64_MAX (INT64_MIN),
+   * where the naive -(int64_t)((v + 1) >> 1) wraps v+1 to 0 */
+  return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
 }
 
 int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {

@@ -3,14 +3,28 @@
  * ≈ the reference's setuid task-controller (src/c++/task-controller/,
  * 2.8k C: the LinuxTaskController backend that launches task processes
  * as the submitting user, with path validation so a compromised tracker
- * cannot aim it outside the task sandbox).
+ * cannot aim it outside the task sandbox).  Security checks mirror
+ * impl/task-controller.c:529-540 (reference): refuse root and system
+ * uids, refuse banned users, and validate the task dir against the
+ * tracker-local dirs named in a root-owned config file.
  *
  * Usage: task-controller <user> <task-dir> <stdout-file> <cmd> [args...]
  *
- * - validates the task dir exists, is owned by the invoking/target user,
- *   and contains no ".." traversal;
- * - when running as root (installed setuid, production): setgid/setuid
- *   to the target user before exec;
+ * Config (only consulted when running setuid-root):
+ *   /etc/tpumr/task-controller.cfg, overridable at build time via
+ *   -DTC_CONF_PATH=...  Must be owned by root and not group/world
+ *   writable.  Keys (one `key=value` per line, '#' comments):
+ *     min.user.id=1000          lowest uid allowed to run tasks
+ *     banned.users=root,daemon  comma list of refused user names
+ *     allowed.local.dirs=/a,/b  comma list of absolute prefixes the
+ *                               task dir must live under
+ *
+ * - validates the task dir exists, is owned by the target user, and
+ *   contains no ".." traversal;
+ * - when running as root (installed setuid, production): refuses
+ *   uid 0 and uids below min.user.id, requires the task dir to be
+ *   inside an allowed local dir, then setgid/setuid to the target
+ *   user before exec;
  * - when not root (tests, single-user clusters): requires <user> to be
  *   the current user and just sandboxes cwd/env;
  * - clears the environment except PATH/HOME/LANG + TPUMR_* passthrough,
@@ -20,6 +34,8 @@
 
 #include <errno.h>
 #include <fcntl.h>
+#include <grp.h>
+#include <limits.h>
 #include <pwd.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -27,6 +43,12 @@
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
+
+#ifndef TC_CONF_PATH
+#define TC_CONF_PATH "/etc/tpumr/task-controller.cfg"
+#endif
+
+#define TC_DEFAULT_MIN_UID 1000
 
 extern char** environ;
 
@@ -42,6 +64,125 @@ static int validate_path(const char* p) {
   size_t n = strlen(p);
   if (n >= 3 && strcmp(p + n - 3, "/..") == 0) return -1;
   if (n >= 2 && strcmp(p + n - 2, "/.") == 0) return -1;
+  return 0;
+}
+
+/* Root-mode policy loaded from the root-owned config file. */
+struct tc_config {
+  long min_uid;
+  char banned[1024];        /* comma list, surrounded by commas */
+  char allowed_dirs[4096];  /* comma list of absolute prefixes */
+};
+
+static int load_config(struct tc_config* cfg) {
+  struct stat st;
+  FILE* f;
+  int fd;
+  char line[4096];
+
+  cfg->min_uid = TC_DEFAULT_MIN_UID;
+  snprintf(cfg->banned, sizeof(cfg->banned), ",root,daemon,bin,");
+  cfg->allowed_dirs[0] = '\0';
+
+  /* open first, then fstat the fd — a stat-then-fopen pair is a TOCTOU
+   * window in a setuid binary (reference checks the fd it reads) */
+  fd = open(TC_CONF_PATH, O_RDONLY | O_NOFOLLOW);
+  if (fd < 0)
+    return fail("config file " TC_CONF_PATH " required when running as root");
+  if (fstat(fd, &st) != 0) { close(fd); return fail("cannot stat config"); }
+  if (!S_ISREG(st.st_mode)) { close(fd); return fail("config not a regular file"); }
+  if (st.st_uid != 0) { close(fd); return fail("config file must be owned by root"); }
+  if (st.st_mode & (S_IWGRP | S_IWOTH)) {
+    close(fd);
+    return fail("config file must not be group/world writable");
+  }
+
+  f = fdopen(fd, "r");
+  if (!f) { close(fd); return fail("cannot open config file"); }
+  /* any malformed or over-long policy value is a hard error, never a
+   * silently-weaker policy (fail closed: this binary runs setuid root) */
+  while (fgets(line, sizeof(line), f)) {
+    char* nl = strchr(line, '\n');
+    char* end = NULL;
+    char* eq;
+    int n;
+    if (!nl && !feof(f)) {
+      fclose(f);
+      return fail("config line too long");
+    }
+    if (nl) *nl = '\0';
+    if (line[0] == '#' || line[0] == '\0') continue;
+    eq = strchr(line, '=');
+    if (!eq) continue;
+    *eq = '\0';
+    if (strcmp(line, "min.user.id") == 0) {
+      errno = 0;
+      cfg->min_uid = strtol(eq + 1, &end, 10);
+      if (errno || end == eq + 1 || *end != '\0' || cfg->min_uid < 1) {
+        fclose(f);
+        return fail("invalid min.user.id (must be a positive integer)");
+      }
+    } else if (strcmp(line, "banned.users") == 0) {
+      n = snprintf(cfg->banned, sizeof(cfg->banned), ",%s,", eq + 1);
+      if (n < 0 || (size_t)n >= sizeof(cfg->banned)) {
+        fclose(f);
+        return fail("banned.users value too long");
+      }
+    } else if (strcmp(line, "allowed.local.dirs") == 0) {
+      n = snprintf(cfg->allowed_dirs, sizeof(cfg->allowed_dirs), "%s",
+                   eq + 1);
+      if (n < 0 || (size_t)n >= sizeof(cfg->allowed_dirs)) {
+        fclose(f);
+        return fail("allowed.local.dirs value too long");
+      }
+    }
+  }
+  fclose(f);
+  if (cfg->allowed_dirs[0] == '\0')
+    return fail("config must set allowed.local.dirs");
+  return 0;
+}
+
+static int user_banned(const struct tc_config* cfg, const char* user) {
+  char needle[256];
+  if (strlen(user) > sizeof(needle) - 3) return 1;
+  snprintf(needle, sizeof(needle), ",%s,", user);
+  return strstr(cfg->banned, needle) != NULL;
+}
+
+/* Resolve the parent directory of `path` through symlinks and re-attach
+ * the final component (which may not exist yet, e.g. the logfile).  The
+ * final component itself is kept symlink-safe by O_NOFOLLOW at open. */
+static int resolve_parent(const char* path, char* out, size_t outlen) {
+  char parent[PATH_MAX];
+  char parent_real[PATH_MAX];
+  const char* slash = strrchr(path, '/');
+  size_t plen;
+  if (!slash || slash == path) return -1;     /* "/x" or no slash: refuse */
+  plen = (size_t)(slash - path);
+  if (plen >= sizeof(parent)) return -1;
+  memcpy(parent, path, plen);
+  parent[plen] = '\0';
+  if (!realpath(parent, parent_real)) return -1;
+  if (strlen(parent_real) + 1 + strlen(slash + 1) + 1 > outlen) return -1;
+  snprintf(out, outlen, "%s/%s", parent_real, slash + 1);
+  return 0;
+}
+
+/* task_dir must equal, or live strictly under, one allowed prefix. */
+static int dir_allowed(const struct tc_config* cfg, const char* task_dir) {
+  char dirs[sizeof(cfg->allowed_dirs)];
+  char* save = NULL;
+  char* tok;
+  snprintf(dirs, sizeof(dirs), "%s", cfg->allowed_dirs);
+  for (tok = strtok_r(dirs, ",", &save); tok; tok = strtok_r(NULL, ",", &save)) {
+    size_t n = strlen(tok);
+    if (n == 0 || tok[0] != '/') continue;
+    while (n > 1 && tok[n - 1] == '/') tok[--n] = '\0';
+    if (strncmp(task_dir, tok, n) == 0 &&
+        (task_dir[n] == '\0' || task_dir[n] == '/'))
+      return 1;
+  }
   return 0;
 }
 
@@ -75,12 +216,37 @@ int main(int argc, char** argv) {
     return fail("task dir missing or not a directory");
 
   if (getuid() == 0) {
-    /* production (setuid root): the sandbox must belong to the target
-     * user before we drop into it */
+    /* production (setuid root): enforce the root-owned policy before
+     * touching anything (reference impl/task-controller.c:529-540) */
+    static char task_real[PATH_MAX];
+    static char log_real[PATH_MAX];
+    struct tc_config cfg;
+    int rc = load_config(&cfg);
+    if (rc) return rc;
+    if (pw->pw_uid == 0) return fail("refusing to run tasks as root");
+    if ((long)pw->pw_uid < cfg.min_uid)
+      return fail("target uid below min.user.id");
+    if (user_banned(&cfg, user)) return fail("target user is banned");
+    /* resolve symlinks BEFORE the confinement checks — a link planted
+     * inside an allowed dir must not smuggle the sandbox outside it */
+    if (!realpath(task_dir, task_real))
+      return fail("cannot resolve task dir");
+    if (resolve_parent(logfile, log_real, sizeof(log_real)))
+      return fail("cannot resolve logfile parent");
+    task_dir = task_real;
+    logfile = log_real;
+    if (stat(task_dir, &st) || !S_ISDIR(st.st_mode))
+      return fail("resolved task dir missing or not a directory");
+    if (!dir_allowed(&cfg, task_dir))
+      return fail("task dir not under an allowed local dir");
+    if (!dir_allowed(&cfg, logfile))
+      return fail("logfile not under an allowed local dir");
     if (st.st_uid != pw->pw_uid)
       return fail("task dir not owned by target user");
-    if (setgid(pw->pw_gid) || setuid(pw->pw_uid))
+    if (setgroups(0, NULL) || setgid(pw->pw_gid) || setuid(pw->pw_uid))
       return fail("cannot drop privileges");
+    if (setuid(0) == 0 || getuid() == 0)
+      return fail("privilege drop did not stick");
   } else if (getuid() != pw->pw_uid) {
     return fail("not root: target user must be the invoking user");
   }
@@ -97,7 +263,7 @@ int main(int argc, char** argv) {
 
   if (chdir(task_dir)) return fail("cannot chdir into task dir");
 
-  logfd = open(logfile, O_WRONLY | O_CREAT | O_APPEND, 0640);
+  logfd = open(logfile, O_WRONLY | O_CREAT | O_APPEND | O_NOFOLLOW, 0640);
   if (logfd < 0) return fail("cannot open logfile");
   if (dup2(logfd, 1) < 0 || dup2(logfd, 2) < 0)
     return fail("cannot redirect stdio");

@@ -131,6 +131,25 @@ class TestJobTrackerHttp:
         code, body = fetch(base + "/json/nope")
         assert code == 404 and "endpoints" in body
 
+    def test_conf_endpoint_redacts_secrets(self, cluster):
+        """Credential-bearing conf values must never reach the status port
+        (≈ ConfServlet sanitization) — leaking tpumr.rpc.secret would
+        defeat the RPC HMAC auth entirely."""
+        master_conf = cluster.master.conf
+        master_conf.set("tpumr.rpc.secret", "hunter2-cluster-secret")
+        master_conf.set("some.service.password", "pw-value")
+        try:
+            code, body = fetch(cluster.master.http_url + "/json/conf")
+            assert code == 200
+            conf = json.loads(body)
+            assert "hunter2-cluster-secret" not in body
+            assert "pw-value" not in body
+            assert conf["tpumr.rpc.secret"] == "*** redacted ***"
+            assert conf["some.service.password"] == "*** redacted ***"
+        finally:
+            master_conf.unset("tpumr.rpc.secret")
+            master_conf.unset("some.service.password")
+
     def test_history_server(self, cluster):
         run_wc(cluster, "two")
         from tpumr.mapred.history_server import JobHistoryServer
@@ -146,6 +165,31 @@ class TestJobTrackerHttp:
             assert {"JOB_SUBMITTED", "JOB_FINISHED"} <= kinds
         finally:
             hs.stop()
+
+    def test_history_server_redacts_submission_conf(self, tmp_path):
+        """The JOB_SUBMITTED event keeps the full conf on disk (recovery
+        needs it) but the history status port must mask credentials."""
+        import json as _json
+        from tpumr.mapred.history_server import JobHistoryServer
+        events = [{"event": "JOB_SUBMITTED", "job_id": "job_x_0001",
+                   "job_name": "j", "num_maps": 1, "num_reduces": 0,
+                   "conf": {"tpumr.rpc.secret": "leak-me",
+                            "mapred.job.name": "j"}, "splits": []},
+                  {"event": "JOB_FINISHED", "job_id": "job_x_0001",
+                   "state": "SUCCEEDED"}]
+        with open(tmp_path / "job_x_0001.jsonl", "w") as f:
+            f.write("\n".join(_json.dumps(e) for e in events) + "\n")
+        hs = JobHistoryServer(str(tmp_path)).start()
+        try:
+            code, body = fetch(hs.url + "/json/job?id=job_x_0001")
+            assert code == 200 and "leak-me" not in body
+            served = json.loads(body)[0]["conf"]
+            assert served["tpumr.rpc.secret"] == "*** redacted ***"
+            assert served["mapred.job.name"] == "j"
+        finally:
+            hs.stop()
+        # the on-disk file is untouched — recovery still sees the secret
+        assert "leak-me" in (tmp_path / "job_x_0001.jsonl").read_text()
 
 
 class TestNameNodeHttp:

@@ -86,7 +86,110 @@ class TestLibTdfs:
         assert "error" in r.stderr.lower()
 
 
+IS_ROOT = os.getuid() == 0
+
+
+@pytest.fixture(scope="module")
+def tc_root(tmp_path_factory):
+    """Root-mode test binary: TC_CONF_PATH relocated into scratch so the
+    root-owned-config policy (≈ reference impl/task-controller.c:529-540)
+    is testable without touching /etc."""
+    scratch = tmp_path_factory.mktemp("tc")
+    conf = scratch / "task-controller.cfg"
+    sandbox = scratch / "local"
+    sandbox.mkdir()
+    # the dropped-privilege child must be able to traverse into its
+    # sandbox: open up the (root-owned) pytest tmp dirs above it —
+    # but never walk past the system tmp root (chmodding /root or /
+    # as uid 0 would silently open the host)
+    import tempfile
+    stop = {tempfile.gettempdir(), "/"}
+    p = sandbox
+    while str(p) not in stop and str(p.parent) != str(p):
+        try:
+            os.chmod(p, 0o755)
+        except OSError:
+            break
+        p = p.parent
+    conf.write_text("min.user.id=100\nbanned.users=root,daemon\n"
+                    f"allowed.local.dirs={sandbox}\n")
+    os.chmod(conf, 0o600)
+    r = subprocess.run(["make", "test-binary", f"TC_CONF={conf}"],
+                       cwd=TASKCTL, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return os.path.join(TASKCTL, "build", "task-controller-test"), sandbox
+
+
+@pytest.mark.skipif(not IS_ROOT, reason="root-mode policy needs uid 0")
+class TestTaskControllerRootPolicy:
+    def run_tc(self, binary, user, task_dir, log, *cmd):
+        return subprocess.run([binary, user, str(task_dir), str(log), *cmd],
+                              capture_output=True, text=True, timeout=30)
+
+    def test_refuses_root_target(self, tc_root):
+        binary, sandbox = tc_root
+        d = sandbox / "r"
+        d.mkdir(exist_ok=True)
+        r = self.run_tc(binary, "root", d, sandbox / "r.log", "/bin/true")
+        assert r.returncode == 10
+        assert "root" in r.stderr or "banned" in r.stderr
+
+    def test_refuses_dir_outside_allowed(self, tc_root, tmp_path):
+        binary, _ = tc_root
+        outside = tmp_path / "outside"
+        outside.mkdir()
+        r = self.run_tc(binary, "nobody", outside, tmp_path / "o.log",
+                        "/bin/true")
+        assert r.returncode == 10 and "allowed local dir" in r.stderr
+
+    def test_refuses_when_no_config(self, task_controller, tmp_path):
+        # stock binary points at /etc/tpumr/task-controller.cfg (absent here)
+        d = tmp_path / "t"
+        d.mkdir()
+        r = self.run_tc(task_controller, "nobody", d, tmp_path / "t.log",
+                        "/bin/true")
+        assert r.returncode == 10 and "config" in r.stderr
+
+    def test_symlink_cannot_escape_allowed_dir(self, tc_root, tmp_path):
+        """A symlink planted inside the allowed dir must not smuggle the
+        sandbox outside it (realpath runs before the prefix check)."""
+        import pwd as pwd_mod
+        binary, sandbox = tc_root
+        pw = pwd_mod.getpwnam("nobody")
+        outside = tmp_path / "victim"
+        outside.mkdir()
+        os.chown(outside, pw.pw_uid, pw.pw_gid)  # even user-owned: refused
+        link = sandbox / "sneaky"
+        if link.exists() or link.is_symlink():
+            link.unlink()
+        link.symlink_to(outside)
+        r = self.run_tc(binary, "nobody", link, sandbox / "s.log",
+                        "/bin/true")
+        assert r.returncode == 10
+        assert "allowed local dir" in r.stderr
+
+    def test_launches_as_unprivileged_user(self, tc_root):
+        import pwd as pwd_mod
+        binary, sandbox = tc_root
+        pw = pwd_mod.getpwnam("nobody")
+        task_dir = sandbox / "attempt_1"
+        task_dir.mkdir(exist_ok=True)
+        os.chown(task_dir, pw.pw_uid, pw.pw_gid)
+        log = task_dir / "task.log"
+        env = dict(os.environ, TPUMR_MARKER="visible", SECRET_THING="hidden")
+        r = subprocess.run(
+            [binary, "nobody", str(task_dir), str(log),
+             "/bin/sh", "-c", "id -u; echo M=$TPUMR_MARKER S=$SECRET_THING"],
+            env=env, capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, r.stderr
+        out = log.read_text()
+        assert str(pw.pw_uid) in out          # really dropped to nobody
+        assert "M=visible" in out             # TPUMR_* passes through
+        assert "S=hidden" not in out          # everything else scrubbed
+
+
 class TestTaskController:
+    @pytest.mark.skipif(IS_ROOT, reason="non-root path; root mode above")
     def test_launches_sandboxed(self, task_controller, tmp_path):
         task_dir = tmp_path / "attempt_1"
         task_dir.mkdir()

@@ -1,6 +1,7 @@
 """Reliability tier ≈ SURVEY.md §5: restart recovery (RecoveryManager),
 speculative execution, node health, task memory limits, fault injection."""
 
+import os
 import time
 
 import pytest
@@ -140,6 +141,32 @@ class TestRecovery:
             # again itself since it was never finished — but exactly once)
         finally:
             jm3.stop()
+
+
+class TestFinalizeIdempotent:
+    def test_double_finalize_emits_one_history_event(self, tmp_path):
+        """kill_job racing a heartbeat-deferred finalization must not run
+        commit/abort twice or duplicate JOB_FINISHED events."""
+        from tpumr.mapred.jobtracker import JobMaster
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        jm = JobMaster(conf).start()
+        try:
+            jid = jm.submit_job(
+                {"mapred.job.name": "dupfin", "mapred.reduce.tasks": 0},
+                [{"locations": []}])
+            jip = jm.jobs[jid]
+            jip.kill()
+            jm._finalize_job(jip)
+            jm._finalize_job(jip)          # second caller must no-op
+            assert jm.kill_job(jid) is False  # already terminal
+        finally:
+            jm.stop()
+        events = [e for f in os.listdir(tmp_path)
+                  if f.endswith(".jsonl")
+                  for e in open(os.path.join(tmp_path, f))
+                  if '"JOB_FINISHED"' in e]
+        assert len(events) == 1
 
 
 class TestNodeHealth:

@@ -43,6 +43,45 @@ class TestRpcAuth:
         finally:
             srv.stop()
 
+    def test_captured_frame_cannot_be_replayed_elsewhere(self):
+        """A signed frame is bound to its connection by the server's hello
+        nonce: replaying it to a sibling daemon, or to the same daemon over
+        a new connection, must fail (≈ DIGEST SASL challenge semantics)."""
+        import socket
+        import time
+
+        from tpumr.ipc import rpc as R
+
+        a = RpcServer(Echo(), secret=b"s3cret").start()
+        b = RpcServer(Echo(), secret=b"s3cret").start()
+        socks = []
+        try:
+            sa = socket.create_connection(a.address)
+            socks.append(sa)
+            hello = R._recv_frame(sa)
+            req = {"id": 1, "cid": "observed-cid", "method": "ping",
+                   "params": [41], "ts": time.time()}
+            req["auth"] = R._sign(b"s3cret", req, a.port, hello["nonce"])
+            R._send_frame(sa, req)
+            assert R._recv_frame(sa).get("result") == 41
+            # replay verbatim to sibling daemon B
+            sb = socket.create_connection(b.address)
+            socks.append(sb)
+            R._recv_frame(sb)  # B's hello — different nonce
+            R._send_frame(sb, req)
+            assert "RpcAuthError" in R._recv_frame(sb).get("error", "")
+            # replay verbatim to A itself over a fresh connection
+            sa2 = socket.create_connection(a.address)
+            socks.append(sa2)
+            R._recv_frame(sa2)
+            R._send_frame(sa2, req)
+            assert "RpcAuthError" in R._recv_frame(sa2).get("error", "")
+        finally:
+            for s in socks:
+                s.close()
+            a.stop()
+            b.stop()
+
     def test_secured_mini_cluster_runs_job(self):
         from tpumr.fs import get_filesystem
         from tpumr.mapred.job_client import JobClient

@@ -244,3 +244,30 @@ class Configuration:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Configuration({len(self)} keys, {len(self._resources)} resources)"
+
+
+#: substrings marking a conf key as credential-bearing; values of such keys
+#: must never leave the process over status/HTTP surfaces (≈ the reference
+#: ConfServlet's credential sanitization)
+SENSITIVE_KEY_MARKERS = ("secret", "password", "passwd", "credential",
+                         "token", "private.key")
+
+
+def is_sensitive_key(key: str) -> bool:
+    low = key.lower()
+    return any(m in low for m in SENSITIVE_KEY_MARKERS)
+
+
+REDACTED = "*** redacted ***"
+
+
+def redact_mapping(d: Mapping[str, Any]) -> dict[str, Any]:
+    """Mask credential-bearing values in a plain conf mapping (used by
+    every status surface that serves conf: JT /json/conf, history)."""
+    return {k: (REDACTED if is_sensitive_key(k) else v) for k, v in d.items()}
+
+
+def redacted_dict(conf: "Configuration") -> dict[str, Any]:
+    """Conf as a dict safe for status endpoints: secret-bearing values
+    (tpumr.rpc.secret*, *password*, …) are masked, key presence kept."""
+    return redact_mapping({k: conf.get(k) for k in sorted(conf.keys())})

@@ -44,14 +44,18 @@ class RpcAuthError(RpcError):
 AUTH_WINDOW_S = 300.0
 
 
-def _sign(secret: bytes, req: dict, port: int) -> str:
+def _sign(secret: bytes, req: dict, port: int, nonce: str) -> str:
     """HMAC-SHA256 over the canonical request identity+payload+timestamp,
-    bound to the target port (≈ the reference's DIGEST token auth,
-    SaslRpcServer — SURVEY.md §2.2). Replay defenses: the timestamp must
-    be fresh, the port binds the frame to one daemon, and the server
-    tracks a per-client high-water request id."""
+    bound to the serving connection via the server's per-connection nonce
+    (≈ the reference's DIGEST SASL challenge, SaslRpcServer — SURVEY.md
+    §2.2). Replay defenses: the nonce ties every frame to one connection
+    of one daemon (a frame captured on the way to datanode A cannot be
+    replayed to datanode B, or to A over a new connection), the timestamp
+    must be fresh, and the server tracks a per-client high-water request
+    id within the connection's lifetime."""
     canon = serialize([req.get("cid"), req.get("id"), req.get("method"),
-                       list(req.get("params", [])), req.get("ts"), port])
+                       list(req.get("params", [])), req.get("ts"), port,
+                       nonce])
     return hmac.new(secret, canon, "sha256").hexdigest()
 
 
@@ -88,6 +92,16 @@ class _Handler(socketserver.BaseRequestHandler):
         server: RpcServer = self.server  # type: ignore[assignment]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nonce = ""
+        if server.secret is not None:
+            # authenticated servers open with a one-shot connection nonce
+            # the client must fold into every signature (≈ SASL challenge)
+            import secrets as _secrets
+            nonce = _secrets.token_hex(16)
+            try:
+                _send_frame(sock, {"hello": 1, "nonce": nonce})
+            except OSError:
+                return
         try:
             while True:
                 req = _recv_frame(sock)
@@ -98,7 +112,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     my_port = sock.getsockname()[1]
                     ts = req.get("ts")
                     if not sig or not hmac.compare_digest(
-                            sig, _sign(secret, req, my_port)):
+                            sig, _sign(secret, req, my_port, nonce)):
                         _send_frame(sock, {
                             "id": req.get("id"),
                             "error": "RpcAuthError: request not signed "
@@ -265,6 +279,7 @@ class RpcClient:
         self.secret = secret
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
+        self._nonce = ""
         self._id = 0
         import uuid
         self._cid = uuid.uuid4().hex  # pairs with server response cache
@@ -274,28 +289,65 @@ class RpcClient:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.secret is not None:
+                # authenticated servers greet with a per-connection nonce;
+                # an unsecured server sends nothing — fail fast with a
+                # config-skew diagnosis instead of hanging for the full
+                # socket timeout (both sides would otherwise wait forever)
+                s.settimeout(min(5.0, self.timeout))
+                try:
+                    hello = _recv_frame(s)
+                except (TimeoutError, socket.timeout):
+                    s.close()
+                    raise RpcAuthError(
+                        f"server {self.host}:{self.port} sent no auth "
+                        "hello — this client has a cluster secret "
+                        "configured but the server appears to run "
+                        "unauthenticated (tpumr.rpc.secret mismatch?)")
+                finally:
+                    if s.fileno() >= 0:
+                        s.settimeout(self.timeout)
+                self._nonce = hello.get("nonce", "") \
+                    if isinstance(hello, dict) else ""
             self._sock = s
         return self._sock
+
+    def _stamp(self, req: dict) -> None:
+        """Timestamp + sign a request for the CURRENT connection (must be
+        re-done after any reconnect: the nonce changes)."""
+        if self.secret is not None:
+            import time as _time
+            req["ts"] = _time.time()
+            req["auth"] = _sign(self.secret, req, self.port, self._nonce)
+
+    @staticmethod
+    def _recv_resp(sock: socket.socket) -> Any:
+        # a client configured without a secret may still receive an
+        # authenticated server's hello frame first — skip past it (the
+        # real response, an auth error, follows)
+        resp = _recv_frame(sock)
+        while isinstance(resp, dict) and "hello" in resp:
+            resp = _recv_frame(sock)
+        return resp
 
     def call(self, method: str, *params: Any) -> Any:
         with self._lock:
             self._id += 1
             req = {"id": self._id, "cid": self._cid, "method": method,
                    "params": list(params)}
-            if self.secret is not None:
-                import time as _time
-                req["ts"] = _time.time()
-                req["auth"] = _sign(self.secret, req, self.port)
             try:
                 sock = self._connect()
+                self._stamp(req)
                 _send_frame(sock, req)
-                resp = _recv_frame(sock)
+                resp = self._recv_resp(sock)
             except (ConnectionError, OSError):
-                # one reconnect attempt (server restart / idle drop)
+                # one reconnect attempt (server restart / idle drop);
+                # re-sign against the fresh connection's nonce
                 self.close_locked()
                 sock = self._connect()
+                self._stamp(req)
                 _send_frame(sock, req)
-                resp = _recv_frame(sock)
+                resp = self._recv_resp(sock)
         if "error" in resp:
             msg = resp["error"] + "\n[remote] " + resp.get("traceback", "")
             if resp["error"].startswith("RpcAuthError"):

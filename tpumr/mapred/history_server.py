@@ -71,7 +71,21 @@ class JobHistoryServer:
         if path is None:
             return {"error": f"no history for job {q.get('id')!r}",
                     "known": sorted(self._files())}
-        return JobHistory.read(path)
+        return [self._redact(ev) for ev in JobHistory.read(path)]
+
+    @staticmethod
+    def _redact(event: dict) -> dict:
+        """History files keep the full submission conf (the restarted
+        master needs it to replay jobs), but the status port must not
+        serve credential values (≈ ConfServlet sanitization) — the
+        JOB_SUBMITTED conf can carry tpumr.rpc.secret."""
+        conf = event.get("conf")
+        if not isinstance(conf, dict):
+            return event
+        from tpumr.core.configuration import redact_mapping
+        event = dict(event)
+        event["conf"] = redact_mapping(conf)
+        return event
 
     # ------------------------------------------------------------ lifecycle
 

@@ -120,6 +120,10 @@ class JobInProgress:
         #: clients must not observe a terminal state before the output is
         #: actually promoted (finalization runs outside the heartbeat lock)
         self.finalized = threading.Event()
+        #: atomic claim (under ``lock``) that finalization is running —
+        #: kill_job racing a heartbeat-deferred finalize must not run
+        #: commit/abort twice or duplicate JOB_FINISHED history events
+        self.finalize_started = False
         # --- per-backend profiling (running sums, O(1) per update) ---
         self.finished_cpu_maps = 0
         self.finished_tpu_maps = 0
@@ -423,11 +427,15 @@ class JobInProgress:
                         e for e in self.completion_events
                         if e["attempt_id"] != aid]
 
-    def kill(self) -> None:
+    def kill(self) -> bool:
+        """Transition to KILLED; returns True only for the caller that
+        actually performed the transition (False if already terminal)."""
         with self.lock:
-            if self.state not in JobState.TERMINAL:
-                self.state = JobState.KILLED
-                self.finish_time = time.time()
+            if self.state in JobState.TERMINAL:
+                return False
+            self.state = JobState.KILLED
+            self.finish_time = time.time()
+            return True
 
     # ------------------------------------------------------------ wire
 

@@ -199,8 +199,8 @@ class JobMaster:
             q["id"], q.get("kind", "map")), parameterized=True)
         srv.add_json("trackers", trackers_info)
         srv.add_json("metrics", lambda q: self.metrics.snapshot())
-        srv.add_json("conf", lambda q: {
-            k: self.conf.get(k) for k in sorted(self.conf.keys())})
+        from tpumr.core.configuration import redacted_dict
+        srv.add_json("conf", lambda q: redacted_dict(self.conf))
         return srv
 
     @property
@@ -279,13 +279,10 @@ class JobMaster:
         } for t in tips]
 
     def kill_job(self, job_id: str) -> bool:
-        from tpumr.mapred.job_in_progress import JobState
         jip = self._job(job_id)
-        with jip.lock:
-            terminal = jip.state in JobState.TERMINAL
-        if terminal:  # ≈ JobTracker.killJob: no-op on finished jobs
+        # kill() no-ops if a concurrent heartbeat already made it terminal
+        if not jip.kill():  # ≈ JobTracker.killJob: no-op on finished jobs
             return False
-        jip.kill()
         self._finalize_job(jip)
         return True
 
@@ -293,7 +290,13 @@ class JobMaster:
         """Job-level output commit/abort + history. The reference runs this
         as a cleanup TASK on a tracker (getSetupAndCleanupTasks,
         JobTracker.java:3398); master-side finalization is a deliberate
-        simplification — the output FS is shared, the work is two renames."""
+        simplification — the output FS is shared, the work is two renames.
+        Idempotent: the first caller claims it under jip.lock; later
+        callers (kill_job racing a heartbeat-deferred finalize) return."""
+        with jip.lock:
+            if jip.finalize_started:
+                return
+            jip.finalize_started = True
         try:
             from tpumr.mapred.output_formats import FileOutputCommitter
             conf = JobConf()
