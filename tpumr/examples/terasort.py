@@ -154,22 +154,37 @@ def terasort(argv: list[str]) -> int:
     ap.add_argument("input")
     ap.add_argument("output")
     ap.add_argument("-r", "--reduces", type=int, default=2)
+    ap.add_argument("--device-shuffle", action="store_true",
+                    help="shuffle+sort on the device mesh (ICI all_to_all "
+                         "+ per-device sort) instead of the host path")
     args = ap.parse_args(argv)
+    conf = make_terasort_conf(args.input, args.output, args.reduces,
+                              device_shuffle=args.device_shuffle)
+    return 0 if run_job(conf).successful else 1
+
+
+def make_terasort_conf(input_path: str, output_path: str, reduces: int,
+                       device_shuffle: bool = False) -> JobConf:
+    """Terasort job conf (shared with benchmarks/tests): sampled range
+    partitioning; optionally the device-shuffled reduce — terasort's
+    fixed-width 10+90 records are the canonical device-sortable layout."""
     conf = JobConf()
     conf.set_job_name("terasort")
-    conf.set_input_paths(args.input)
-    conf.set_output_path(args.output)
+    conf.set_input_paths(input_path)
+    conf.set_output_path(output_path)
     conf.set_input_format(SequenceFileInputFormat)
     conf.set_mapper_class(TeraSortMapper)
     conf.set_reducer_class(IdentityReducer)
     conf.set_output_format(SequenceFileOutputFormat)
     conf.set_output_key_comparator_class(RawComparator)
-    conf.set_num_reduce_tasks(args.reduces)
+    conf.set_num_reduce_tasks(reduces)
     samples = sample_input(conf, num_samples=1000)
-    write_partition_file(conf, args.output.rstrip("/") + ".partitions",
-                         samples, args.reduces)
+    write_partition_file(conf, output_path.rstrip("/") + ".partitions",
+                         samples, reduces)
     conf.set_partitioner_class(TotalOrderPartitioner)
-    return 0 if run_job(conf).successful else 1
+    if device_shuffle:
+        conf.set_device_shuffle(KEY_LEN, VALUE_LEN)
+    return conf
 
 
 @register("teravalidate", "validate that terasort output is globally sorted")

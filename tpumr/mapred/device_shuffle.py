@@ -1,0 +1,380 @@
+"""Device-shuffled reduce — the MapReduce shuffle+sort as ICI collectives.
+
+The reference's shuffle/sort is host machinery end to end: R reduce tasks
+each run parallel HTTP fetchers against every map's spill file
+(ReduceTask.java:659 ReduceCopier ↔ TaskTracker.java:4050 MapOutputServlet)
+and k-way-merge on disk (:399-409). On a TPU mesh that entire exchange is
+ONE ``all_to_all`` and the merge is a per-device vectorized sort — so this
+mode re-plans the reduce phase as a single *gang task* that owns the host's
+device mesh:
+
+  map tasks (CPU or TPU, unchanged) → **dense map output** (fixed-width
+  key/value byte arrays, no sort/spill/partition — the device does both) →
+  one device-reduce task: stage all map outputs onto the mesh →
+  ``device_partition_sort`` (range partition from sampled splitters, ICI
+  all-to-all, per-device lexsort — tpumr.parallel.device_sort) → host
+  writes the R range-ordered part files through the normal OutputFormat/
+  OutputCommitter path.
+
+Opt-in per job: ``conf.set_device_shuffle(key_bytes, value_bytes)``; keys
+and values must be fixed-width ``bytes`` (the device-sortable contract,
+SURVEY.md §7 — terasort's 10+90 layout is the canonical fit). The reduce
+phase collapses to one task; the original reduce count becomes the number
+of output ranges (``part-*`` files), preserving the job's output shape.
+Capacity overflow in the exchange retries with doubled buckets and finally
+falls back to a host numpy sort (the reference's disk-spill fallback role)
+— never wrong output, only a slower path.
+
+Why map outputs come back to the host before staging: map tasks and the
+reduce gang task are separate slots, possibly separate processes; the
+hand-off rides the same host shuffle-serving seam as the reference
+(MapOutputServlet role). The *exchange and sort* — the O(N log N) part the
+reference does over HTTP + disk merges — run on device.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from tpumr.core.counters import BackendCounter, TaskCounter
+from tpumr.mapred.api import OutputCollector, Reporter
+from tpumr.mapred.output_formats import FileOutputCommitter
+from tpumr.mapred.task import Task
+from tpumr.utils.reflection import new_instance
+
+#: job conf keys
+DEVICE_SHUFFLE_KEY = "tpumr.shuffle.device"
+KEY_BYTES_KEY = "tpumr.shuffle.device.key.bytes"
+VALUE_BYTES_KEY = "tpumr.shuffle.device.value.bytes"
+RANGES_KEY = "tpumr.shuffle.device.ranges"
+CAPACITY_KEY = "tpumr.shuffle.device.capacity"
+
+_MAGIC = b"TDSH"
+_HEADER = struct.Struct(">4sIHH")  # magic, n, klen, vlen
+
+
+def is_device_shuffle(conf: Any) -> bool:
+    return bool(conf.get_boolean(DEVICE_SHUFFLE_KEY, False))
+
+
+def prepare_device_shuffle_job(conf: Any) -> None:
+    """Submission-side re-plan (JobClient + LocalJobRunner): the reduce
+    phase becomes ONE gang task; the requested reduce count survives as the
+    output range count so the job still produces R part files."""
+    if not is_device_shuffle(conf):
+        return
+    if conf.get_int(KEY_BYTES_KEY, 0) <= 0 or \
+            conf.get_int(VALUE_BYTES_KEY, 0) < 0:
+        raise ValueError(
+            f"device shuffle needs fixed record widths: set {KEY_BYTES_KEY}"
+            f" / {VALUE_BYTES_KEY} (JobConf.set_device_shuffle)")
+    r = conf.num_reduce_tasks
+    if r == 0:
+        raise ValueError("device shuffle requires a reduce phase "
+                         "(num_reduce_tasks >= 1)")
+    # the device sorts raw bytes ascending — a custom key order or a
+    # grouping comparator would silently change output order/grouping
+    # relative to the host path, so reject rather than diverge
+    from tpumr.mapred.api import RawComparator
+    cmp_cls = conf.get_class("mapred.output.key.comparator.class")
+    if cmp_cls is not None and cmp_cls is not RawComparator:
+        raise ValueError(
+            f"device shuffle sorts raw bytes ascending; output key "
+            f"comparator {cmp_cls.__name__} is not supported — use "
+            f"RawComparator or the host shuffle")
+    if conf.get_class("mapred.output.value.groupfn.class") is not None:
+        raise ValueError("device shuffle does not support a grouping "
+                         "comparator (secondary sort) — use the host "
+                         "shuffle")
+    if not conf.get(RANGES_KEY):
+        conf.set(RANGES_KEY, r)
+    conf.set_num_reduce_tasks(1)
+
+
+class DenseMapOutputBuffer:
+    """Map-side collector for device-shuffled jobs: fixed-width records
+    appended to flat byte buffers, written as ONE dense file — no
+    partitioning, no sort, no spill (the device does all three). Replaces
+    MapOutputBuffer at the same seam in ``run_map_task``."""
+
+    def __init__(self, conf: Any, local_dir: str, reporter: Reporter) -> None:
+        self.klen = conf.get_int(KEY_BYTES_KEY, 0)
+        self.vlen = conf.get_int(VALUE_BYTES_KEY, 0)
+        self.local_dir = local_dir
+        self.reporter = reporter
+        self._keys = bytearray()
+        self._values = bytearray()
+        self._n = 0
+        os.makedirs(local_dir, exist_ok=True)
+
+    def collect(self, key: Any, value: Any) -> None:
+        if not isinstance(key, (bytes, bytearray)) or len(key) != self.klen:
+            raise ValueError(
+                f"device shuffle requires {self.klen}-byte keys, got "
+                f"{type(key).__name__}[{len(key) if hasattr(key, '__len__') else '?'}]")
+        if not isinstance(value, (bytes, bytearray)) or \
+                len(value) != self.vlen:
+            raise ValueError(
+                f"device shuffle requires {self.vlen}-byte values, got "
+                f"{type(value).__name__}")
+        self._keys += key
+        self._values += value
+        self._n += 1
+        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                   TaskCounter.MAP_OUTPUT_RECORDS)
+        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                   TaskCounter.MAP_OUTPUT_BYTES,
+                                   self.klen + self.vlen)
+
+    def flush(self) -> tuple[str, dict]:
+        path = os.path.join(self.local_dir, "file.dense")
+        with open(path, "wb") as f:
+            f.write(_HEADER.pack(_MAGIC, self._n, self.klen, self.vlen))
+            f.write(bytes(self._keys))
+            f.write(bytes(self._values))
+        return path, {"dense": True, "n": self._n,
+                      "klen": self.klen, "vlen": self.vlen}
+
+
+def parse_dense_bytes(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """(keys [n, klen] u8, values [n, vlen] u8) from dense-output bytes —
+    the serving tracker ships the file verbatim (header is self-describing)
+    so there is no reserialize hop on the hot shuffle path."""
+    magic, n, klen, vlen = _HEADER.unpack_from(data)
+    if magic != _MAGIC:
+        raise ValueError("not a dense map output (bad magic)")
+    off = _HEADER.size
+    keys = np.frombuffer(data, np.uint8, n * klen, off).reshape(n, klen)
+    values = np.frombuffer(data, np.uint8, n * vlen,
+                           off + n * klen).reshape(n, vlen)
+    return keys, values
+
+
+def read_dense_output(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """(keys, values) arrays from a dense map output file."""
+    with open(path, "rb") as f:
+        return parse_dense_bytes(f.read())
+
+
+#: a dense fetch returns one map's (keys, values) arrays
+DenseFetchFn = Callable[[int], tuple[np.ndarray, np.ndarray]]
+
+
+def _load_splitters(conf: Any, keys: np.ndarray, num_ranges: int,
+                    klen: int) -> np.ndarray:
+    """Range cut points [r-1, klen] u8: the job's TotalOrderPartitioner
+    file when present (terasort writes one), else sampled from the staged
+    keys themselves (device mode is self-contained — ≈ TeraInputFormat's
+    in-job sampling)."""
+    from tpumr.mapred.total_order import PARTITION_PATH_KEY
+    path = conf.get(PARTITION_PATH_KEY)
+    if path:
+        from tpumr.fs import get_filesystem
+        from tpumr.io.writable import deserialize
+        cuts = deserialize(get_filesystem(path, conf).read_bytes(path))
+        good = [c for c in cuts
+                if isinstance(c, (bytes, bytearray)) and len(c) == klen]
+        if len(good) == len(cuts) and cuts:
+            return np.frombuffer(b"".join(good), np.uint8).reshape(-1, klen)
+    if num_ranges <= 1 or keys.shape[0] == 0:
+        return np.zeros((0, klen), np.uint8)
+    n = keys.shape[0]
+    sample_idx = np.linspace(0, n - 1, min(n, 64 * num_ranges)).astype(int)
+    samp = keys[sample_idx]
+    order = np.lexsort(tuple(samp[:, c] for c in range(klen - 1, -1, -1)))
+    samp = samp[order]
+    cut_idx = [min(len(samp) - 1, round(i * len(samp) / num_ranges))
+               for i in range(1, num_ranges)]
+    return samp[cut_idx]
+
+
+def _range_boundaries(sorted_keys: np.ndarray, splitters: np.ndarray,
+                      lo_range: int, hi_range: int) -> list[int]:
+    """Split one device's key-sorted shard into its ranges: boundary after
+    range i = #keys <= splitters[i] (vectorized lexicographic count —
+    consistent with compute_dest's 'equal goes low' convention). Cut lists
+    can be SHORT (write_partition_file dedups duplicate samples): a missing
+    splitter acts as +inf, leaving the top ranges empty — same tolerance
+    as the host TotalOrderPartitioner."""
+    from tpumr.parallel.device_sort import _lex_gt, key_columns
+    n, klen = sorted_keys.shape
+    if n == 0:
+        return [0] * (hi_range - lo_range - 1)
+    kcols = key_columns(sorted_keys, klen)
+    scols = key_columns(splitters, klen) if len(splitters) else None
+    bounds = []
+    for i in range(lo_range, hi_range - 1):
+        if scols is None or i >= len(scols):
+            bounds.append(n)  # +inf splitter: everything stays below
+        else:
+            bounds.append(int(n - _lex_gt(kcols, scols[i]).sum()))
+    return bounds
+
+
+def run_device_reduce(conf: Any, task: Task, dense_fetch: DenseFetchFn,
+                      reporter: Reporter | None = None) -> None:
+    """Execute the reduce gang task: fetch every map's dense output, run
+    the device partition+exchange+sort, apply the job's reducer over each
+    range's sorted stream, write R part files, one commit."""
+    reporter = reporter or Reporter()
+    from tpumr.mapred.map_task import localize_task_conf
+    conf = localize_task_conf(conf, task)
+    from tpumr.utils.fi import maybe_fail
+    maybe_fail("reduce.task", conf)
+
+    klen = conf.get_int(KEY_BYTES_KEY, 0)
+    vlen = conf.get_int(VALUE_BYTES_KEY, 0)
+    num_ranges = conf.get_int(RANGES_KEY, 1)
+
+    # ---- copy phase (host, ≈ ReduceCopier.fetchOutputs)
+    t0 = time.time()
+    key_parts, val_parts = [], []
+    for m in range(task.num_maps):
+        k, v = dense_fetch(m)
+        if k.shape[1] != klen or v.shape[1] != vlen:
+            raise ValueError(f"map {m} dense output widths "
+                             f"({k.shape[1]},{v.shape[1]}) != conf "
+                             f"({klen},{vlen})")
+        key_parts.append(k)
+        val_parts.append(v)
+    keys = np.concatenate(key_parts) if key_parts else \
+        np.zeros((0, klen), np.uint8)
+    values = np.concatenate(val_parts) if val_parts else \
+        np.zeros((0, vlen), np.uint8)
+    n = keys.shape[0]
+    reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                          TaskCounter.REDUCE_INPUT_RECORDS, n)
+    records = np.concatenate([keys, values], axis=1)
+    splitters = _load_splitters(conf, keys, num_ranges, klen)
+
+    # ---- exchange + sort phase (device)
+    shards = None
+    overflow = 0
+    if n > 0:
+        import jax
+        from tpumr.parallel.mesh import make_mesh
+        from tpumr.parallel.device_sort import device_partition_sort
+        mesh = make_mesh(devices=jax.local_devices())
+        capacity = conf.get_int(CAPACITY_KEY, 0) or None
+        shards, overflow = device_partition_sort(
+            mesh, records, klen, splitters, num_ranges, capacity=capacity)
+        if shards is not None:  # count only records the device actually moved
+            reporter.incr_counter(BackendCounter.GROUP,
+                                  BackendCounter.TPU_SHUFFLE_RECORDS, n)
+            reporter.incr_counter(BackendCounter.GROUP,
+                                  BackendCounter.TPU_SHUFFLE_BYTES,
+                                  int(records.nbytes))
+    if shards is None:
+        # host fallback: full numpy lexsort, then the same range split
+        # (≈ the disk-spill fallback role; correctness never depends on
+        # the device path)
+        if n > 0 and overflow:
+            reporter.incr_counter(BackendCounter.GROUP,
+                                  BackendCounter.SHUFFLE_HOST_FALLBACKS)
+        from tpumr.parallel.device_sort import key_columns
+        kcols = key_columns(keys, klen) if n else None
+        order = np.lexsort(tuple(
+            kcols[:, c] for c in range(kcols.shape[1] - 1, -1, -1))) \
+            if n else np.zeros(0, int)
+        all_sorted = records[order]
+        n_dev = 1
+        shards = [all_sorted]
+    else:
+        n_dev = len(shards)
+    ranges_per_dev = -(-num_ranges // n_dev)
+    reporter.set_status(
+        f"device shuffle: {n} records over {n_dev} devices in "
+        f"{time.time() - t0:.3f}s (overflow retries seen: {overflow})")
+
+    # ---- reduce + write phase (host, range-ordered part files)
+    reducer_cls = conf.get_reducer_class()
+    from tpumr.mapred.api import IdentityReducer
+    identity = reducer_cls is None or reducer_cls is IdentityReducer
+    committer = FileOutputCommitter(conf)
+    wd = committer.setup_task(str(task.attempt_id))
+    out_fmt = new_instance(conf.get_output_format(), conf)
+
+    def write_range(range_idx: int, rows: np.ndarray) -> None:
+        writer = out_fmt.get_record_writer(conf, wd, range_idx)
+        try:
+            if identity:
+                _write_rows(writer, rows, klen, reporter)
+            else:
+                _reduce_rows(conf, reducer_cls, rows, klen, writer, reporter)
+        finally:
+            writer.close()
+
+    emitted = set()
+    for d in range(n_dev):
+        lo_r = d * ranges_per_dev
+        hi_r = min((d + 1) * ranges_per_dev, num_ranges)
+        if lo_r >= hi_r:
+            continue
+        shard = shards[d]
+        bounds = _range_boundaries(shard[:, :klen], splitters, lo_r, hi_r)
+        cuts = [0] + bounds + [shard.shape[0]]
+        for i, r in enumerate(range(lo_r, hi_r)):
+            write_range(r, shard[cuts[i]:cuts[i + 1]])
+            emitted.add(r)
+    for r in range(num_ranges):  # ranges on idle devices: empty parts
+        if r not in emitted:
+            write_range(r, np.zeros((0, klen + vlen), np.uint8))
+    # commit is the CALLER's job (tracker: master-gated can_commit;
+    # local runner: direct commit_task) — same contract as run_reduce_task
+
+
+def _write_rows(writer: Any, rows: np.ndarray, klen: int,
+                reporter: Reporter) -> None:
+    kb = rows[:, :klen]
+    vb = rows[:, klen:]
+    for i in range(rows.shape[0]):
+        writer.write(kb[i].tobytes(), vb[i].tobytes())
+    reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                          TaskCounter.REDUCE_OUTPUT_RECORDS, rows.shape[0])
+
+
+def _reduce_rows(conf: Any, reducer_cls: type, rows: np.ndarray, klen: int,
+                 writer: Any, reporter: Reporter) -> None:
+    """Run the user reducer over the key-sorted rows of one range: groups
+    are consecutive equal keys (device sort replaced the merge, grouping
+    semantics preserved)."""
+    reducer = new_instance(reducer_cls, conf)
+    n = rows.shape[0]
+
+    def emit(k: Any, v: Any) -> None:
+        reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                              TaskCounter.REDUCE_OUTPUT_RECORDS)
+        writer.write(k, v)
+
+    collector = OutputCollector(emit)
+    try:
+        i = 0
+        while i < n:
+            key = rows[i, :klen].tobytes()
+            j = i
+            while j < n and rows[j, :klen].tobytes() == key:
+                j += 1
+            reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                  TaskCounter.REDUCE_INPUT_GROUPS)
+            values = (rows[t, klen:].tobytes() for t in range(i, j))
+            reducer.reduce(key, values, collector, reporter)
+            i = j
+    finally:
+        reducer.close()
+
+
+def local_dense_fetch(map_outputs: "list[tuple[str, dict] | None]"
+                      ) -> DenseFetchFn:
+    """In-process fetch over the maps' dense files (LocalJobRunner path)."""
+
+    def fetch(map_index: int) -> tuple[np.ndarray, np.ndarray]:
+        ent = map_outputs[map_index]
+        assert ent is not None, f"map {map_index} output missing"
+        return read_dense_output(ent[0])
+
+    return fetch

@@ -76,6 +76,8 @@ class JobClient:
 
     def submit_job(self, job_conf: JobConf) -> RunningJob:
         assert self._client is not None, "local jobs use run_job()"
+        from tpumr.mapred.device_shuffle import prepare_device_shuffle_job
+        prepare_device_shuffle_job(job_conf)  # reduce phase → one gang task
         in_fmt = new_instance(job_conf.get_input_format(), job_conf)
         out_fmt = new_instance(job_conf.get_output_format(), job_conf)
         out_fmt.check_output_specs(job_conf)

@@ -189,6 +189,14 @@ class JobConf(Configuration):
     def get_map_kernel(self) -> str | None:
         return self.get("tpumr.map.kernel")
 
+    def set_device_shuffle(self, key_bytes: int, value_bytes: int) -> None:
+        """Opt this job into the device-shuffled reduce (ICI all_to_all +
+        per-device sort — tpumr.mapred.device_shuffle): map outputs must be
+        fixed-width ``bytes`` keys/values of exactly these lengths."""
+        self.set("tpumr.shuffle.device", True)
+        self.set("tpumr.shuffle.device.key.bytes", key_bytes)
+        self.set("tpumr.shuffle.device.value.bytes", value_bytes)
+
     # ------------------------------------------------------------ slot pools
 
     @property

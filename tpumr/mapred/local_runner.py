@@ -65,6 +65,9 @@ class LocalJobRunner:
 
     def _run(self, job_id: JobID, conf: JobConf, work_root: str,
              counters: Counters) -> JobResult:
+        from tpumr.mapred.device_shuffle import (is_device_shuffle,
+                                                prepare_device_shuffle_job)
+        prepare_device_shuffle_job(conf)  # collapses reduces to 1 gang task
         in_fmt = new_instance(conf.get_input_format(), conf)
         out_fmt = new_instance(conf.get_output_format(), conf)
         out_fmt.check_output_specs(conf)
@@ -104,7 +107,20 @@ class LocalJobRunner:
                 one_map(i)
 
         # ---- reduce phase
-        if num_reduces > 0:
+        if num_reduces > 0 and is_device_shuffle(conf):
+            # ONE gang task owns the local mesh: exchange + sort on device
+            from tpumr.mapred.device_shuffle import (local_dense_fetch,
+                                                    run_device_reduce)
+            attempt = TaskAttemptID(TaskID(job_id, False, 0), 0)
+            task = Task(attempt, partition=0, num_reduces=1,
+                        num_maps=len(splits))
+            reporter = Reporter()
+            run_device_reduce(conf, task, local_dense_fetch(map_outputs),
+                              reporter)
+            committer.commit_task(str(attempt))
+            counters.merge(reporter.counters)
+            counters.incr(JobCounter.GROUP, JobCounter.LAUNCHED_REDUCE_TASKS)
+        elif num_reduces > 0:
             fetch = local_fetch_factory([mo for mo in map_outputs])  # type: ignore[misc]
             for r in range(num_reduces):
                 attempt = TaskAttemptID(TaskID(job_id, False, r), 0)

@@ -250,7 +250,14 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
                               int((time.time() - t0) * 1000))
         return "", {}
 
-    buffer = MapOutputBuffer(conf, task.num_reduces, local_dir, reporter)
+    from tpumr.mapred.device_shuffle import is_device_shuffle
+    if is_device_shuffle(conf):
+        # device-shuffled jobs skip sort/spill/partition entirely — the
+        # reduce gang task does all three on the mesh (device_shuffle.py)
+        from tpumr.mapred.device_shuffle import DenseMapOutputBuffer
+        buffer: Any = DenseMapOutputBuffer(conf, local_dir, reporter)
+    else:
+        buffer = MapOutputBuffer(conf, task.num_reduces, local_dir, reporter)
     collector = OutputCollector(buffer.collect)
     reader = _counted_reader(in_fmt, split, conf, reporter)
     runner.run(reader, collector, reporter, task_ctx=task)

@@ -367,8 +367,17 @@ class NodeRunner:
                     committed = self._commit(conf, task)
             else:
                 status.phase = TaskPhase.SHUFFLE
-                fetch = self._remote_fetch_factory(job_id, task)
-                run_reduce_task(conf, task, fetch, reporter)
+                from tpumr.mapred.device_shuffle import is_device_shuffle
+                if is_device_shuffle(conf):
+                    # gang task: exchange + sort on this host's mesh
+                    from tpumr.mapred.device_shuffle import run_device_reduce
+                    run_device_reduce(
+                        conf, task,
+                        self._remote_dense_fetch_factory(job_id, task),
+                        reporter)
+                else:
+                    fetch = self._remote_fetch_factory(job_id, task)
+                    run_reduce_task(conf, task, fetch, reporter)
                 status.phase = TaskPhase.REDUCE
                 committed = self._commit(conf, task)
             status.counters = reporter.counters.to_dict()
@@ -415,14 +424,33 @@ class NodeRunner:
         if ent is None:
             raise KeyError(f"no map output for {job_id} map {map_index}")
         path, index = ent
+        if index.get("dense"):
+            raise ValueError(f"map output for {job_id} map {map_index} is "
+                             "dense (device-shuffled job) — fetch with "
+                             "get_map_output_dense")
         with open(path, "rb") as f:
             data = ifile.partition_bytes(f, index, partition)
         return {"data": data, "codec": index.get("codec", "none")}
 
-    def _remote_fetch_factory(self, job_id: str, task: Task):
-        """Parallel-capable fetch ≈ ReduceCopier.MapOutputCopier: resolves
-        map locations from completion events, pulls each segment over the
-        source tracker's RPC."""
+    def get_map_output_dense(self, job_id: str, map_index: int) -> dict:
+        """Serve a device-shuffled job's whole dense map output (same
+        MapOutputServlet role; the exchange itself happens on the mesh).
+        Ships the self-describing file verbatim — no parse/reserialize."""
+        with self.lock:
+            ent = self.map_outputs.get((job_id, map_index))
+        if ent is None:
+            raise KeyError(f"no map output for {job_id} map {map_index}")
+        path, index = ent
+        if not index.get("dense"):
+            raise ValueError(f"map output for {job_id} map {map_index} is "
+                             "not dense — fetch with get_map_output")
+        with open(path, "rb") as f:
+            return {"data": f.read()}
+
+    def _map_locator(self, job_id: str):
+        """Resolve a map's serving tracker from the master's completion
+        events (shared by the IFile and dense fetch paths): returns
+        ``locate(map_index) -> RpcClient`` to the source tracker."""
         events: dict[int, dict] = {}
         seen = [0]  # incremental cursor into the master's event list
         clients: dict[str, RpcClient] = {}
@@ -431,7 +459,7 @@ class NodeRunner:
         deadline = time.time() + self.conf.get_int(
             "tpumr.shuffle.timeout.ms", 600_000) / 1000.0
 
-        def fetch(map_index: int, partition: int):
+        def locate(map_index: int) -> RpcClient:
             while map_index not in events:
                 fresh = self.master.call("get_map_completion_events",
                                          job_id, seen[0])
@@ -450,7 +478,33 @@ class NodeRunner:
             if cli is None:
                 cli = clients[addr] = RpcClient(host, int(port),
                                                 secret=conf_secret)
-            out = cli.call("get_map_output", job_id, map_index, partition)
+            return cli
+
+        return locate
+
+    def _remote_fetch_factory(self, job_id: str, task: Task):
+        """Parallel-capable fetch ≈ ReduceCopier.MapOutputCopier: resolves
+        map locations from completion events, pulls each segment over the
+        source tracker's RPC."""
+        locate = self._map_locator(job_id)
+
+        def fetch(map_index: int, partition: int):
+            out = locate(map_index).call("get_map_output", job_id,
+                                         map_index, partition)
             return ifile.iter_transferred_segment(out["data"], out["codec"])
+
+        return fetch
+
+    def _remote_dense_fetch_factory(self, job_id: str, task: Task):
+        """Dense fetch for device-shuffled jobs: pulls each map's whole
+        fixed-width output (same serving seam, array payload)."""
+        from tpumr.mapred.device_shuffle import parse_dense_bytes
+
+        locate = self._map_locator(job_id)
+
+        def fetch(map_index: int):
+            out = locate(map_index).call("get_map_output_dense", job_id,
+                                         map_index)
+            return parse_dense_bytes(out["data"])
 
         return fetch
