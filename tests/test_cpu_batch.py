@@ -1,0 +1,127 @@
+"""Vectorized CPU batch map path (CpuBatchMapRunner + map_batch_cpu):
+CPU slots of kernel jobs process whole staged splits in numpy instead of
+per-record Python — the reference's hybrid premise (CPU slots carry real
+work, JobQueueTaskScheduler.java:127-178) made honest."""
+
+import numpy as np
+
+from tpumr.core.counters import BackendCounter
+from tpumr.examples.basic import save_npy as _save_npy
+from tpumr.fs import get_filesystem
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.local_runner import run_job
+
+
+class TestNumpyKernel:
+    def test_matches_device_path(self):
+        from tpumr.ops.kmeans import (assign_and_partials,
+                                      assign_and_partials_numpy)
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(5000, 6)).astype(np.float32)
+        cents = rng.normal(size=(9, 6)).astype(np.float32)
+        _a, dev_sums, dev_counts = assign_and_partials(pts, cents)
+        sums, counts = assign_and_partials_numpy(pts, cents, chunk=700)
+        np.testing.assert_array_equal(counts, np.asarray(dev_counts))
+        np.testing.assert_allclose(sums, np.asarray(dev_sums), rtol=1e-4)
+
+    def test_throughput_is_batch_speed(self):
+        """The point of the path: the per-record loop measured ~34k rec/s;
+        the batch path should clear a GENEROUS floor even on a loaded CI
+        host (bench.py reports the real multi-M rec/s number)."""
+        import time
+        from tpumr.ops.kmeans import assign_and_partials_numpy
+        rng = np.random.default_rng(2)
+        pts = rng.normal(size=(1_000_000, 8)).astype(np.float32)
+        cents = rng.normal(size=(16, 8)).astype(np.float32)
+        assign_and_partials_numpy(pts[:1000], cents)  # warm caches
+        t0 = time.time()
+        assign_and_partials_numpy(pts, cents)
+        rate = pts.shape[0] / (time.time() - t0)
+        assert rate >= 200_000, f"CPU batch rate {rate:.0f} rec/s — " \
+            "batch path appears to have regressed to per-record speed"
+
+
+class TestCpuBatchJobs:
+    def _kmeans_conf(self, tag: str, batch: bool) -> JobConf:
+        fs = get_filesystem("mem:///")
+        rng = np.random.default_rng(5)
+        _save_npy(fs, f"/cb/{tag}/pts.npy",
+                  rng.normal(size=(600, 4)).astype(np.float32))
+        _save_npy(fs, f"/cb/{tag}/cents.npy",
+                  rng.normal(size=(3, 4)).astype(np.float32))
+        conf = JobConf()
+        conf.set_input_paths(f"mem:///cb/{tag}/pts.npy")
+        conf.set_output_path(f"mem:///cb/{tag}/out")
+        conf.set("mapred.input.format.class",
+                 "tpumr.mapred.input_formats.DenseInputFormat")
+        conf.set("tpumr.dense.split.rows", 150)
+        conf.set("tpumr.kmeans.centroids", f"mem:///cb/{tag}/cents.npy")
+        conf.set_map_kernel("kmeans-assign")
+        conf.set("mapred.mapper.class", "tpumr.ops.kmeans.KMeansCpuMapper")
+        conf.set("mapred.reducer.class",
+                 "tests.test_mini_cluster.CentroidReducer")
+        conf.set_num_reduce_tasks(1)
+        if not batch:
+            conf.set("tpumr.cpu.batch.map", False)
+        return conf
+
+    def test_kernel_job_on_cpu_uses_batch_runner(self):
+        from tpumr.ops.kmeans import clear_centroid_cache
+        clear_centroid_cache()
+        result = run_job(self._kmeans_conf("batch", batch=True))
+        assert result.successful
+        assert result.counters.value(
+            BackendCounter.GROUP, BackendCounter.CPU_BATCH_MAP_TASKS) == 4
+        # and no TPU task ran (local runner defaulted to CPU)
+        assert result.counters.value(
+            BackendCounter.GROUP, BackendCounter.TPU_MAP_TASKS) == 0
+
+    def test_batch_and_per_record_agree(self):
+        """Same job, batch path vs per-record opt-out: identical reduce
+        output (the batch path is an optimization, not a semantic change)."""
+        from tpumr.ops.kmeans import clear_centroid_cache
+        fs = get_filesystem("mem:///")
+
+        clear_centroid_cache()
+        assert run_job(self._kmeans_conf("a", batch=True)).successful
+        clear_centroid_cache()
+        r2 = run_job(self._kmeans_conf("b", batch=False))
+        assert r2.successful
+        assert r2.counters.value(
+            BackendCounter.GROUP, BackendCounter.CPU_BATCH_MAP_TASKS) == 0
+
+        def read_out(tag):
+            out = {}
+            for st in fs.list_status(f"/cb/{tag}/out"):
+                if st.path.name.startswith("part-"):
+                    for line in fs.read_bytes(st.path).decode().splitlines():
+                        k, _, v = line.partition("\t")
+                        out[k] = v
+            return out
+
+        a, b = read_out("a"), read_out("b")
+        assert a.keys() == b.keys()
+        for k in a:
+            va = np.asarray(eval(a[k]))  # noqa: S307 — test-local literals
+            vb = np.asarray(eval(b[k]))
+            np.testing.assert_allclose(va, vb, rtol=1e-4)
+
+    def test_wordcount_kernel_cpu_batch(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/cbw/in.txt", b"alpha beta alpha\ngamma beta alpha\n")
+        conf = JobConf()
+        conf.set_input_paths("mem:///cbw/in.txt")
+        conf.set_output_path("mem:///cbw/out")
+        conf.set_map_kernel("wordcount")
+        conf.set("mapred.reducer.class",
+                 "tpumr.examples.basic.LongSumReducer")
+        conf.set_num_reduce_tasks(1)
+        result = run_job(conf)
+        assert result.successful
+        assert result.counters.value(
+            BackendCounter.GROUP, BackendCounter.CPU_BATCH_MAP_TASKS) >= 1
+        text = b"".join(fs.read_bytes(st.path)
+                        for st in fs.list_status("/cbw/out")
+                        if st.path.name.startswith("part-")).decode()
+        counts = dict(line.split("\t") for line in text.splitlines())
+        assert counts == {"alpha": "3", "beta": "2", "gamma": "1"}

@@ -36,6 +36,7 @@ class BackendCounter:
     CPU_MAP_MILLIS = "CPU_MAP_MILLIS"
     TPU_MAP_MILLIS = "TPU_MAP_MILLIS"
     TPU_DEVICE_BYTES_STAGED = "TPU_DEVICE_BYTES_STAGED"
+    CPU_BATCH_MAP_TASKS = "CPU_BATCH_MAP_TASKS"
     TPU_SHUFFLE_RECORDS = "TPU_SHUFFLE_RECORDS"
     TPU_SHUFFLE_BYTES = "TPU_SHUFFLE_BYTES"
     SHUFFLE_HOST_FALLBACKS = "SHUFFLE_HOST_FALLBACKS"

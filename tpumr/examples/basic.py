@@ -213,10 +213,12 @@ def kmeans(argv: list[str]) -> int:
         conf.set("tpumr.dense.split.rows", args.split_rows)
         conf.set("tpumr.kmeans.centroids", cent_path)
         from tpumr.ops.kmeans import KMeansCpuMapper
-        if args.cpu_only:
-            conf.set_mapper_class(KMeansCpuMapper)
-        else:
-            conf.set_map_kernel("kmeans-assign")
+        # the kernel is set in BOTH modes: CPU slots run its vectorized
+        # map_batch_cpu (CpuBatchMapRunner); --cpu-only just withholds the
+        # device. The per-record mapper stays as the opt-out fallback
+        # (-D tpumr.cpu.batch.map=false).
+        conf.set_map_kernel("kmeans-assign")
+        conf.set_mapper_class(KMeansCpuMapper)
         conf.set_reducer_class(CentroidReducer)
         _apply(conf, args)
         if not run_job(conf).successful:

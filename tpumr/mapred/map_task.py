@@ -228,7 +228,7 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
         backend_tasks, backend_ms = (BackendCounter.TPU_MAP_TASKS,
                                      BackendCounter.TPU_MAP_MILLIS)
     else:
-        runner_cls = conf.get_map_runner_class()
+        runner_cls = _cpu_runner_class(conf)
         backend_tasks, backend_ms = (BackendCounter.CPU_MAP_TASKS,
                                      BackendCounter.CPU_MAP_MILLIS)
     runner = new_instance(runner_cls, conf)
@@ -266,6 +266,22 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     reporter.incr_counter(BackendCounter.GROUP, backend_ms,
                           int((time.time() - t0) * 1000))
     return out
+
+
+def _cpu_runner_class(conf: Any) -> type:
+    """CPU runner selection: a kernel job whose kernel ships a vectorized
+    host implementation (``map_batch_cpu``) processes batches on CPU slots
+    too — the reference's hybrid premise (CPU slots carry real work,
+    JobQueueTaskScheduler.java:127-178) demands a batch CPU path, not
+    per-record Python. ``tpumr.cpu.batch.map=false`` opts out (e.g. to
+    measure the per-record baseline)."""
+    name = conf.get_map_kernel()
+    if name and conf.get_boolean("tpumr.cpu.batch.map", True):
+        from tpumr.mapred.tpu_runner import CpuBatchMapRunner
+        from tpumr.ops import get_kernel
+        if get_kernel(name).map_batch_cpu is not None:
+            return CpuBatchMapRunner
+    return conf.get_map_runner_class()
 
 
 def _counted_reader(in_fmt: Any, split: InputSplit | None, conf: Any,

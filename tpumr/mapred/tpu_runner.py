@@ -123,8 +123,8 @@ class TpuMapRunner(MapRunnable):
         dev_id = getattr(task_ctx, "tpu_device_id", -1) if task_ctx else -1
         device = devices[dev_id % len(devices)] if dev_id >= 0 else devices[0]
 
-        batch, counted_by_reader, staged_bytes = self._stage_batch(
-            reader, task_ctx, device)
+        batch, counted_by_reader, staged_bytes = stage_batch(
+            self.conf, reader, task_ctx, device)
         if not counted_by_reader:
             # the record-reader path already counts MAP_INPUT_RECORDS
             reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
@@ -143,52 +143,86 @@ class TpuMapRunner(MapRunnable):
             f"{getattr(batch, 'num_records', 0)} records in "
             f"{time.time() - t0:.3f}s")
 
-    def _stage_batch(self, reader, task_ctx,
-                     device) -> tuple[Any, bool, int]:
-        """Batch-native input formats hand over the split whole; otherwise
-        drain the record reader into a RecordBatch (keys discarded — kernel
-        inputs are values, matching the pipes data path where keys were
-        offsets). Dense splits go through the HBM split cache: a cache hit
-        skips storage I/O and the host→device transfer entirely.
-        Returns (batch, counted_by_reader, bytes_actually_staged)."""
-        import jax
-        import numpy as np
+
+def stage_batch(conf, reader, task_ctx, device=None) -> tuple[Any, bool, int]:
+    """Batch-native input formats hand over the split whole; otherwise
+    drain the record reader into a RecordBatch (keys discarded — kernel
+    inputs are values, matching the pipes data path where keys were
+    offsets). With a ``device``, dense splits go through the HBM split
+    cache: a cache hit skips storage I/O and the host→device transfer
+    entirely; ``device=None`` stages on host (the CPU batch runner).
+    Returns (batch, counted_by_reader, bytes_actually_staged)."""
+    in_fmt = new_instance(conf.get_input_format(), conf)
+    split = None
+    if task_ctx is not None and getattr(task_ctx, "split", None):
+        split = InputSplit.from_dict(task_ctx.split)
+    if split is not None and hasattr(in_fmt, "read_batch"):
+        use_cache = conf.get_boolean("tpumr.tpu.split.cache", True)
+        cache_mb = conf.get_int("tpumr.tpu.split.cache.mb", 2048)
+        if device is not None and use_cache and isinstance(split, DenseSplit):
+            import jax
+
+            from tpumr.fs.filesystem import FileSystem
+            cache = split_cache(device, cache_mb * 1024 * 1024)
+            # file freshness (length, mtime) is part of the key so a
+            # rewritten input never serves stale resident data
+            st = FileSystem.get(split.path, conf).get_status(split.path)
+            key = (split.path, split.row_start, split.num_rows,
+                   split.dtype, split.data_offset, st.length, st.mtime)
+            entry = cache.get(key)
+            if entry is not None:
+                staged, ids, meta = entry
+                return DenseBatch(staged, ids, dict(meta)), False, 0
+            batch = in_fmt.read_batch(split, conf)
+            staged = jax.device_put(batch.values, device)
+            cache.put(key, (staged, batch.ids, dict(batch.meta)),
+                      int(batch.values.nbytes))
+            return DenseBatch(staged, batch.ids, batch.meta), False, \
+                int(batch.values.nbytes)
+        batch = in_fmt.read_batch(split, conf)
+        return batch, False, int(getattr(batch, "nbytes", 0))
+    values = []
+    for _k, v in reader:
+        if isinstance(v, (bytes, bytearray)):
+            values.append(bytes(v))
+        elif isinstance(v, str):
+            values.append(v.encode("utf-8"))
+        else:
+            values.append(serialize(v))
+    batch = RecordBatch.from_values(values)
+    return batch, True, int(batch.nbytes)
+
+
+class CpuBatchMapRunner(MapRunnable):
+    """CPU-slot whole-batch runner — the vectorized host twin of
+    :class:`TpuMapRunner`. The reference's hybrid premise is that CPU slots
+    carry real work (3 CPU + 1 GPU slots per node,
+    JobQueueTaskScheduler.java:127-178): per-record Python would make the
+    CPU backend artificially slow and inflate the measured acceleration
+    factor, so kernel jobs whose kernel provides ``map_batch_cpu`` (numpy)
+    process the whole staged split per task here, exactly like the device
+    path minus the device."""
+
+    def configure(self, conf) -> None:
+        self.conf = conf
+
+    def run(self, reader, output, reporter, task_ctx=None) -> None:
+        from tpumr.ops import get_kernel
 
         conf = self.conf
-        in_fmt = new_instance(conf.get_input_format(), conf)
-        split = None
-        if task_ctx is not None and getattr(task_ctx, "split", None):
-            split = InputSplit.from_dict(task_ctx.split)
-        if split is not None and hasattr(in_fmt, "read_batch"):
-            use_cache = conf.get_boolean("tpumr.tpu.split.cache", True)
-            cache_mb = conf.get_int("tpumr.tpu.split.cache.mb", 2048)
-            if use_cache and isinstance(split, DenseSplit):
-                from tpumr.fs.filesystem import FileSystem
-                cache = split_cache(device, cache_mb * 1024 * 1024)
-                # file freshness (length, mtime) is part of the key so a
-                # rewritten input never serves stale resident data
-                st = FileSystem.get(split.path, conf).get_status(split.path)
-                key = (split.path, split.row_start, split.num_rows,
-                       split.dtype, split.data_offset, st.length, st.mtime)
-                entry = cache.get(key)
-                if entry is not None:
-                    staged, ids, meta = entry
-                    return DenseBatch(staged, ids, dict(meta)), False, 0
-                batch = in_fmt.read_batch(split, conf)
-                staged = jax.device_put(batch.values, device)
-                cache.put(key, (staged, batch.ids, dict(batch.meta)),
-                          int(batch.values.nbytes))
-                return DenseBatch(staged, batch.ids, batch.meta), False, \
-                    int(batch.values.nbytes)
-            batch = in_fmt.read_batch(split, conf)
-            return batch, False, int(getattr(batch, "nbytes", 0))
-        values = []
-        for _k, v in reader:
-            if isinstance(v, (bytes, bytearray)):
-                values.append(bytes(v))
-            elif isinstance(v, str):
-                values.append(v.encode("utf-8"))
-            else:
-                values.append(serialize(v))
-        batch = RecordBatch.from_values(values)
-        return batch, True, int(batch.nbytes)
+        kernel = get_kernel(conf.get_map_kernel())
+        assert kernel.map_batch_cpu is not None  # selection checked upstream
+        batch, counted_by_reader, _ = stage_batch(conf, reader, task_ctx)
+        if not counted_by_reader:
+            reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                  TaskCounter.MAP_INPUT_RECORDS,
+                                  getattr(batch, "num_records", 0))
+        reporter.incr_counter(BackendCounter.GROUP,
+                              BackendCounter.CPU_BATCH_MAP_TASKS)
+        t0 = time.time()
+        for key, value in kernel.map_batch_cpu(batch, conf, task_ctx):
+            output.collect(key, value)
+        reporter.set_status(
+            f"cpu-batch kernel {kernel.name}: "
+            f"{getattr(batch, 'num_records', 0)} records in "
+            f"{time.time() - t0:.3f}s")

@@ -172,6 +172,31 @@ def clear_centroid_cache() -> None:
     _centroid_cache.clear()
 
 
+def assign_and_partials_numpy(points: np.ndarray, centroids: np.ndarray,
+                              chunk: int = 1 << 16
+                              ) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized host twin of :func:`assign_and_partials` for CPU map
+    slots: chunked ``|x|² - 2x·cᵀ + |c|²`` + argmin (BLAS matmul), partial
+    sums via per-dimension bincount (C-speed scatter-add). Returns
+    (sums [k,d] f32, counts [k] i64)."""
+    points = np.asarray(points, np.float32)
+    centroids = np.asarray(centroids, np.float32)
+    k, d = centroids.shape
+    c2 = np.einsum("kd,kd->k", centroids, centroids)
+    sums = np.zeros((k, d), np.float32)
+    counts = np.zeros(k, np.int64)
+    for lo in range(0, points.shape[0], chunk):
+        block = points[lo:lo + chunk]
+        # |x|² is constant per row — argmin doesn't need it
+        d2 = c2[None, :] - 2.0 * (block @ centroids.T)
+        assign = np.argmin(d2, axis=1)
+        counts += np.bincount(assign, minlength=k)
+        for j in range(d):
+            sums[:, j] += np.bincount(assign, weights=block[:, j],
+                                      minlength=k)
+    return sums, counts
+
+
 class KMeansCpuMapper(Mapper):
     """CPU-slot mapper for the same job: per-record nearest centroid in
     numpy — deliberately the 'slow backend' the hybrid scheduler profiles
@@ -198,6 +223,16 @@ class KMeansAssignKernel(KernelMapper):
                                                     use_pallas=use_pallas)
         sums = np.asarray(sums)
         counts = np.asarray(counts)
+        for cid in range(centroids.shape[0]):
+            if counts[cid] > 0:
+                yield int(cid), (sums[cid], int(counts[cid]))
+
+    def map_batch_cpu(self, batch, conf, task) -> Iterable[tuple]:
+        """Vectorized CPU-slot path: same pre-aggregated output shape as
+        the device kernel, so reduce sees identical records either way."""
+        centroids = _load_centroids(conf)
+        sums, counts = assign_and_partials_numpy(np.asarray(batch.values),
+                                                 centroids)
         for cid in range(centroids.shape[0]):
             if counts[cid] > 0:
                 yield int(cid), (sums[cid], int(counts[cid]))

@@ -35,6 +35,14 @@ class KernelMapper:
     # scheduler's CPU slots (same job, both backends)
     cpu_mapper_class: type | None = None
 
+    #: optional vectorized host implementation with the same
+    #: ``(batch, conf, task) -> iterable of (key, value)`` contract —
+    #: when present, CPU slots run the whole staged split through it
+    #: (CpuBatchMapRunner) instead of per-record Python, keeping the
+    #: hybrid scheduler's acceleration factor an honest batch-vs-batch
+    #: measurement
+    map_batch_cpu: Any = None
+
 
 _REGISTRY: dict[str, KernelMapper] = {}
 

@@ -49,5 +49,9 @@ class WordCountKernel(KernelMapper):
         for word, cnt in counts.items():
             yield word.decode("utf-8", errors="replace"), cnt
 
+    # tokenization is host work either way — CPU slots run the same
+    # vectorized whole-batch pass (CpuBatchMapRunner)
+    map_batch_cpu = map_batch
+
 
 register_kernel(WordCountKernel())

@@ -158,8 +158,12 @@ def device_partition_sort(mesh: Mesh, records: np.ndarray, klen: int,
                         axis_name)(sharded)
 
     if capacity is None:
-        # balanced per-(src,dst) load with 2x headroom for sampling skew
-        capacity = max(16, int(2 * n / (n_dev * n_dev)))
+        # balanced per-(src,dst) load with 2x headroom for sampling skew;
+        # the receive side is only the ACTIVE destination devices (when
+        # num_ranges < mesh size, fewer devices share the whole load —
+        # dividing by n_dev² would systematically overflow)
+        active = max(1, -(-num_ranges // ranges_per_dev))
+        capacity = max(16, int(2 * n / (n_dev * active)))
     overflowed = 0
     for _attempt in range(max_retries + 1):
         res = shuffle_dense(mesh, sharded, dest, capacity=capacity,
