@@ -1,20 +1,36 @@
-"""Benchmark: K-Means map-task throughput, TPU kernel path vs CPU-only path.
+"""Benchmark: FULL-JOB wall-clock on the BASELINE.md workloads.
 
-Measures the BASELINE.json primary metric — map-task records/sec/chip on the
-K-Means assignment workload — through the REAL task path (run_map_task:
-input format → runner selection → kernel/mapper → MapOutputBuffer), not a
-bare kernel microbenchmark:
+Every number is an end-to-end job through LocalJobRunner — splits → map →
+(shuffle) → reduce → commit — never a bare-kernel microbenchmark. The
+north star (BASELINE.json): K-Means on 100M points, TPU vs CPU-only
+MapReduce, ≥5×.
 
-- TPU path: DenseSplit staged into HBM (split cache warm, as in every
-  round ≥ 2 of an iterative job), Pallas/XLA assignment + partial sums.
-- CPU baseline: the same task through the per-record CPU mapper — the
-  reference's execution model (one record at a time through the map call,
-  ≈ the pipes socket loop) on a sample, extrapolated per record.
+Modes measured for K-Means:
+- ``tpu cold``  — first job: storage read + host→device staging + XLA
+  compile all included.
+- ``tpu warm``  — subsequent jobs of the iterative driver (HBM split cache
+  resident, compile cached): the steady state of the actual workload
+  (Shirahata's K-Means runs tens of rounds; round 0 amortizes away).
+  Reported as mean over 3 rounds with min/max so round-to-round variance
+  is visible, not hidden.
+- ``cpu batch`` — the framework's OWN vectorized CPU backend
+  (CpuBatchMapRunner + numpy): the strongest honest CPU-only baseline.
+- ``cpu per-record`` — the reference's execution model (one record per
+  map() call ≈ the pipes socket loop), measured as a full job on 1M
+  points (100M would take ~1h); reported as a rate, used only as a
+  secondary comparison.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": records/sec/chip, "unit": ..., "vs_baseline": x}
-vs_baseline = TPU rate / CPU-only rate (north star: ≥5, BASELINE.md).
-Diagnostics go to stderr.
+Also measured: wordcount, pi, and terasort (host shuffle vs device
+shuffle) at real sizes — the BASELINE.md workload table.
+
+Output contract: ONE JSON line on stdout
+  {"metric", "value", "unit", "vs_baseline"}
+vs_baseline = cpu-batch job wall-clock / tpu WARM job wall-clock (the
+iterative steady state). The cold ratio and every other row go to stderr
+and to ``bench_details.json``.
+
+Scale: env BENCH_SCALE=small shrinks every workload ~50× for smoke runs;
+default is the full size (100M-point K-Means needs ~13 GB RAM + disk).
 """
 
 from __future__ import annotations
@@ -32,74 +48,264 @@ def log(*a: object) -> None:
     print(*a, file=sys.stderr, flush=True)
 
 
-def run_map(conf, split, on_tpu: bool, attempt: int, work: str):
-    from tpumr.mapred.api import Reporter
-    from tpumr.mapred.ids import JobID, TaskAttemptID, TaskID
-    from tpumr.mapred.map_task import run_map_task
-    from tpumr.mapred.task import Task
+SMALL = os.environ.get("BENCH_SCALE") == "small"
 
-    aid = TaskAttemptID(TaskID(JobID("bench", 1), True, 0), attempt)
-    task = Task(aid, partition=0, num_reduces=1, split=split.to_dict(),
-                run_on_tpu=on_tpu, tpu_device_id=0 if on_tpu else -1)
+
+def _fs(path: str):
+    from tpumr.fs import get_filesystem
+    return get_filesystem(path)
+
+
+# --------------------------------------------------------------- K-Means
+
+
+def kmeans_conf(work: str, mode: str, rows_per_split: int):
+    from tpumr.mapred.input_formats import DenseInputFormat
+    from tpumr.mapred.jobconf import JobConf
+
+    conf = JobConf()
+    conf.set_job_name(f"bench-kmeans-{mode}")
+    conf.set_input_paths(f"file://{work}/points.npy")
+    conf.set_output_path(f"file://{work}/out-{mode}-{time.time_ns()}")
+    conf.set_input_format(DenseInputFormat)
+    conf.set("tpumr.dense.split.rows", rows_per_split)
+    conf.set("tpumr.kmeans.centroids", f"file://{work}/cents.npy")
+    conf.set("mapred.reducer.class", "tpumr.examples.basic.CentroidReducer")
+    conf.set_num_reduce_tasks(1)
+    conf.set("tpumr.tpu.split.cache.mb", 14_000)  # whole dataset resident
+    conf.set_map_kernel("kmeans-assign")
+    conf.set("mapred.mapper.class", "tpumr.ops.kmeans.KMeansCpuMapper")
+    if mode == "tpu":
+        conf.set("tpumr.local.run.on.tpu", True)
+    elif mode == "cpu-record":
+        conf.set("tpumr.cpu.batch.map", False)   # reference execution model
+    return conf
+
+
+def run_kmeans_job(work: str, mode: str, rows_per_split: int) -> float:
+    from tpumr.mapred.local_runner import run_job
+    from tpumr.ops.kmeans import clear_centroid_cache
+
+    clear_centroid_cache()
+    conf = kmeans_conf(work, mode, rows_per_split)
     t0 = time.time()
-    run_map_task(conf, task, os.path.join(work, f"a{attempt}"), Reporter())
-    return time.time() - t0
+    result = run_job(conf)
+    dt = time.time() - t0
+    assert result.successful, f"kmeans {mode} job failed: {result.error}"
+    return dt
+
+
+def bench_kmeans(rows: dict) -> tuple[float, float]:
+    n = 2_000_000 if SMALL else 100_000_000
+    n_record = min(n, 200_000 if SMALL else 1_000_000)
+    d, k = 16, 16
+    per_split = 4_000_000 if not SMALL else 500_000
+
+    work = tempfile.mkdtemp(prefix="tpumr-bench-kmeans-")
+    log(f"[kmeans] generating {n:,} x {d} points ({n * d * 4 / 1e9:.1f} GB) "
+        f"in {work} ...")
+    rng = np.random.default_rng(0)
+    cents = rng.normal(size=(k, d)).astype(np.float32)
+    np.save(os.path.join(work, "cents.npy"), cents)
+    # chunked generation+write keeps peak RAM ~1 split
+    out = open(os.path.join(work, "points.npy"), "wb")
+    header = np.lib.format.header_data_from_array_1_0(
+        np.empty((0, d), np.float32))
+    header["shape"] = (n, d)
+    np.lib.format.write_array_header_1_0(out, header)
+    chunk = 4_000_000
+    for lo in range(0, n, chunk):
+        m = min(chunk, n - lo)
+        out.write(rng.normal(size=(m, d)).astype(np.float32).tobytes())
+    out.close()
+
+    t_cpu = run_kmeans_job(work, "cpu", per_split)
+    log(f"[kmeans] cpu-batch full job ({n:,} pts): {t_cpu:.2f}s "
+        f"({n / t_cpu / 1e6:.2f}M rec/s)")
+    rows["kmeans_cpu_batch_job_s"] = round(t_cpu, 3)
+    rows["kmeans_cpu_batch_rec_per_s"] = round(n / t_cpu)
+
+    t_cold = run_kmeans_job(work, "tpu", per_split)
+    log(f"[kmeans] tpu COLD full job (read+stage+compile): {t_cold:.2f}s")
+    rows["kmeans_tpu_cold_job_s"] = round(t_cold, 3)
+
+    warm = [run_kmeans_job(work, "tpu", per_split) for _ in range(3)]
+    t_warm = sum(warm) / len(warm)
+    log(f"[kmeans] tpu WARM full jobs: mean {t_warm:.2f}s "
+        f"(min {min(warm):.2f} max {max(warm):.2f}) — variance is host-side "
+        f"job machinery (split planning, reduce, commit), the device work "
+        f"is microseconds at this size")
+    rows["kmeans_tpu_warm_job_s"] = round(t_warm, 3)
+    rows["kmeans_tpu_warm_job_min_s"] = round(min(warm), 3)
+    rows["kmeans_tpu_warm_job_max_s"] = round(max(warm), 3)
+    rows["kmeans_tpu_warm_rec_per_s"] = round(n / t_warm)
+
+    # reference execution model (per-record map calls) on a small full job
+    sub = os.path.join(work, "sub")
+    os.makedirs(sub, exist_ok=True)
+    pts = np.lib.format.open_memmap(os.path.join(work, "points.npy"),
+                                    mode="r")
+    np.save(os.path.join(sub, "points.npy"),
+            np.ascontiguousarray(pts[:n_record]))
+    np.save(os.path.join(sub, "cents.npy"), cents)
+    t_rec = run_kmeans_job(sub, "cpu-record", n_record)
+    log(f"[kmeans] cpu PER-RECORD full job ({n_record:,} pts): {t_rec:.2f}s "
+        f"({n_record / t_rec / 1e3:.1f}k rec/s — the reference's "
+        f"one-record-per-map()-call model)")
+    rows["kmeans_cpu_per_record_rec_per_s"] = round(n_record / t_rec)
+    rows["kmeans_n_points"] = n
+    return t_cpu, t_warm
+
+
+# ------------------------------------------------------------- wordcount
+
+
+def bench_wordcount(rows: dict) -> None:
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.local_runner import run_job
+
+    mb = 4 if SMALL else 200
+    work = tempfile.mkdtemp(prefix="tpumr-bench-wc-")
+    words = [f"word{i:04d}".encode() for i in range(4096)]
+    rng = np.random.default_rng(1)
+    path = os.path.join(work, "text.txt")
+    with open(path, "wb") as f:
+        line = b" ".join(words[i] for i in rng.integers(0, 4096, 12)) + b"\n"
+        reps = mb * 1024 * 1024 // len(line)
+        idx = rng.integers(0, 4096, size=(reps, 12))
+        f.write(b"\n".join(b" ".join(words[j] for j in r) for r in idx))
+    size = os.path.getsize(path)
+
+    conf = JobConf()
+    conf.set_job_name("bench-wordcount")
+    conf.set_input_paths(f"file://{path}")
+    conf.set_output_path(f"file://{work}/out")
+    conf.set_map_kernel("wordcount")
+    conf.set("mapred.reducer.class", "tpumr.examples.basic.LongSumReducer")
+    conf.set("mapred.combiner.class", "tpumr.examples.basic.LongSumReducer")
+    conf.set_num_reduce_tasks(1)
+    t0 = time.time()
+    result = run_job(conf)
+    dt = time.time() - t0
+    assert result.successful
+    log(f"[wordcount] {size / 1e6:.0f} MB full job (vectorized batch "
+        f"tokenize): {dt:.2f}s ({size / dt / 1e6:.0f} MB/s)")
+    rows["wordcount_job_s"] = round(dt, 3)
+    rows["wordcount_mb_per_s"] = round(size / dt / 1e6, 1)
+
+
+# -------------------------------------------------------------------- pi
+
+
+def bench_pi(rows: dict) -> None:
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.local_runner import run_job
+
+    samples = 10_000_000 if SMALL else 400_000_000
+    maps = 8
+    work = tempfile.mkdtemp(prefix="tpumr-bench-pi-")
+    path = os.path.join(work, "seeds.txt")
+    with open(path, "w") as f:
+        for m in range(maps):
+            f.write(f"{m} {samples // maps}\n")
+
+    def run(mode: str) -> float:
+        from tpumr.mapred.input_formats import NLineInputFormat
+        conf = JobConf()
+        conf.set_job_name(f"bench-pi-{mode}")
+        conf.set_input_paths(f"file://{path}")
+        conf.set_output_path(f"file://{work}/out-{mode}-{time.time_ns()}")
+        conf.set_input_format(NLineInputFormat)
+        conf.set("mapred.line.input.format.linespermap", 1)
+        conf.set_map_kernel("pi-sampler")
+        conf.set("mapred.reducer.class",
+                 "tpumr.examples.basic.LongSumReducer")
+        conf.set_num_reduce_tasks(1)
+        if mode == "tpu":
+            conf.set("tpumr.local.run.on.tpu", True)
+        t0 = time.time()
+        assert run_job(conf).successful
+        return time.time() - t0
+
+    t_tpu = run("tpu")
+    t_tpu_warm = run("tpu")  # compile cached
+    t_cpu = run("cpu")
+    log(f"[pi] {samples:,} samples: tpu {t_tpu:.2f}s (warm "
+        f"{t_tpu_warm:.2f}s), cpu-batch {t_cpu:.2f}s -> "
+        f"{t_cpu / t_tpu_warm:.1f}x")
+    rows["pi_tpu_job_s"] = round(t_tpu_warm, 3)
+    rows["pi_cpu_batch_job_s"] = round(t_cpu, 3)
+    rows["pi_samples"] = samples
+
+
+# -------------------------------------------------------------- terasort
+
+
+def bench_terasort(rows: dict) -> None:
+    from tpumr.examples.terasort import make_terasort_conf
+    from tpumr.mapred.local_runner import run_job
+
+    n = 100_000 if SMALL else 2_000_000
+    work = tempfile.mkdtemp(prefix="tpumr-bench-ts-")
+    from tpumr.cli import main as cli_main
+    t0 = time.time()
+    assert cli_main(["examples", "teragen", str(n),
+                     f"file://{work}/gen", "-m", "4"]) == 0
+    log(f"[terasort] teragen {n:,} records: {time.time() - t0:.2f}s")
+
+    def run(device: bool) -> float:
+        mode = "device" if device else "host"
+        conf = make_terasort_conf(f"file://{work}/gen",
+                                  f"file://{work}/out-{mode}-"
+                                  f"{time.time_ns()}", 4,
+                                  device_shuffle=device)
+        t0 = time.time()
+        assert run_job(conf).successful
+        return time.time() - t0
+
+    t_host = run(False)
+    t_dev_cold = run(True)    # pays the dest/exchange/sort XLA compiles
+    t_dev = run(True)         # compile cache warm: the steady state
+    log(f"[terasort] {n:,} records ({n * 100 / 1e6:.0f} MB): host shuffle "
+        f"{t_host:.2f}s, device shuffle cold {t_dev_cold:.2f}s / warm "
+        f"{t_dev:.2f}s -> warm {t_host / t_dev:.2f}x")
+    rows["terasort_host_job_s"] = round(t_host, 3)
+    rows["terasort_device_cold_job_s"] = round(t_dev_cold, 3)
+    rows["terasort_device_job_s"] = round(t_dev, 3)
+    rows["terasort_records"] = n
+
+
+# ------------------------------------------------------------------ main
 
 
 def main() -> None:
     import jax
+    log(f"backend={jax.default_backend()} devices={jax.devices()} "
+        f"scale={'small' if SMALL else 'full'}")
 
-    from tpumr.mapred.input_formats import DenseInputFormat
-    from tpumr.mapred.jobconf import JobConf
-    from tpumr.ops import kmeans  # noqa: F401 — registers kernels
+    rows: dict = {}
+    t_cpu, t_warm = bench_kmeans(rows)
+    for fn in (bench_wordcount, bench_pi, bench_terasort):
+        try:
+            fn(rows)
+        except Exception as e:  # noqa: BLE001 — secondary rows best-effort
+            log(f"[{fn.__name__}] FAILED: {type(e).__name__}: {e}")
+            rows[fn.__name__] = f"failed: {e}"
 
-    n, d, k = 1_000_000, 16, 16
-    cpu_sample = 20_000
-    log(f"backend={jax.default_backend()} devices={jax.devices()}")
-    rng = np.random.default_rng(0)
-    points = rng.normal(size=(n, d)).astype(np.float32)
-    cents = rng.normal(size=(k, d)).astype(np.float32)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_details.json"), "w") as f:
+        json.dump(rows, f, indent=2, sort_keys=True)
+    log(f"detail rows -> bench_details.json: "
+        f"{json.dumps(rows, sort_keys=True)}")
 
-    work = tempfile.mkdtemp(prefix="tpumr-bench-")
-    np.save(os.path.join(work, "points.npy"), points)
-    np.save(os.path.join(work, "cents.npy"), cents)
-
-    conf = JobConf()
-    conf.set_input_paths(f"file://{work}/points.npy")
-    conf.set("tpumr.kmeans.centroids", f"file://{work}/cents.npy")
-    conf.set("tpumr.map.kernel", "kmeans-assign")
-    conf.set("mapred.mapper.class", "tpumr.ops.kmeans.KMeansCpuMapper")
-    conf.set_input_format(DenseInputFormat)
-    conf.set("tpumr.dense.split.rows", n)
-
-    fmt = DenseInputFormat()
-    [tpu_split] = fmt.get_splits(conf, 1)
-
-    # ---- TPU path: round 0 pays staging+compile; measure warm rounds
-    t_cold = run_map(conf, tpu_split, True, 0, work)
-    log(f"tpu round0 (stage+compile): {t_cold:.2f}s")
-    times = []
-    for it in range(1, 4):
-        dt = run_map(conf, tpu_split, True, it, work)
-        times.append(dt)
-        log(f"tpu round{it} (HBM-resident): {dt:.3f}s")
-    tpu_rate = n / (sum(times) / len(times))
-
-    # ---- CPU-only baseline: per-record mapper on a sample
-    conf_cpu = JobConf(conf)
-    conf_cpu.set("tpumr.dense.split.rows", cpu_sample)
-    cpu_split = fmt.get_splits(conf_cpu, 1)[0]
-    t_cpu = run_map(conf_cpu, cpu_split, False, 9, work)
-    cpu_rate = cpu_sample / t_cpu
-    log(f"cpu sample ({cpu_sample} rec): {t_cpu:.2f}s -> {cpu_rate:,.0f} rec/s")
-    log(f"tpu warm: {tpu_rate:,.0f} rec/s/chip -> {tpu_rate / cpu_rate:.1f}x cpu")
-
+    n = rows["kmeans_n_points"]
     print(json.dumps({
-        "metric": "kmeans map-task throughput (1M pts x16d, 16 clusters, "
-                  "warm HBM split cache)",
-        "value": round(tpu_rate, 1),
-        "unit": "records/sec/chip",
-        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+        "metric": f"kmeans {n / 1e6:.0f}M-pt full-job wall-clock, warm "
+                  f"iterative round (tpu kernel vs vectorized cpu-only "
+                  f"batch baseline; cold={rows['kmeans_tpu_cold_job_s']}s)",
+        "value": round(t_warm, 3),
+        "unit": "seconds/job",
+        "vs_baseline": round(t_cpu / t_warm, 2),
     }))
 
 

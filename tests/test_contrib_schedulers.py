@@ -228,3 +228,199 @@ class TestPluggability:
             assert isinstance(jm.scheduler, FairScheduler)
         finally:
             jm.stop()
+
+
+class TestFairPreemption:
+    """≈ FairScheduler.preemptTasksIfNecessary: a pool starved below its min
+    share beyond the timeout reclaims slots by killing the NEWEST running
+    maps of over-share pools (killed, not failed — no attempt budget spent).
+    Deterministic: time injected, no daemons."""
+
+    def _run_maps(self, sched, job, n, start_base):
+        """Assign n maps to the hog job and back-date their start times so
+        victim ordering (newest first) is deterministic."""
+        import time as _time
+        from tpumr.mapred.task import TaskState, TaskStatus
+        tasks = []
+        for i in range(n):
+            t = job.obtain_new_map_task("host0", run_on_tpu=False)
+            assert t is not None
+            st = TaskStatus(attempt_id=t.attempt_id, is_map=True,
+                            state=TaskState.RUNNING,
+                            start_time=start_base + i)
+            job.update_task_status(st, "h:0")
+            tasks.append(t)
+        return tasks
+
+    def _make(self, hog, starved, timeout_ms=1000):
+        return make_fair(
+            [hog, starved],
+            **{"tpumr.fairscheduler.preemption": True,
+               "tpumr.fairscheduler.preemption.timeout.ms": timeout_ms,
+               "tpumr.fairscheduler.preemption.interval.ms": 0,
+               "tpumr.fairscheduler.pool.gold.minmaps": 2})
+
+    def test_starved_pool_preempts_newest_after_timeout(self):
+        import time as _time
+        hog = make_pool_job("bulk", 1, n_maps=6)
+        starved = make_pool_job("gold", 2, n_maps=4)
+        sched = self._make(hog, starved)
+        hog_tasks = self._run_maps(sched, hog, 4, start_base=1000.0)
+
+        now = _time.time()
+        sched._preempt_if_starved(now=now)          # starts the clock
+        assert not any(hog.should_kill_attempt(str(t.attempt_id))
+                       for t in hog_tasks)          # not yet: timeout unmet
+        sched._preempt_if_starved(now=now + 2.0)    # past 1s timeout
+        marked = [t for t in hog_tasks
+                  if hog.should_kill_attempt(str(t.attempt_id))]
+        # deficit = min share (2) - usage (0) → two newest victims
+        assert len(marked) == 2
+        newest_two = {str(t.attempt_id) for t in hog_tasks[-2:]}
+        assert {str(t.attempt_id) for t in marked} == newest_two
+
+    def test_preemption_never_breaches_victims_own_min_share(self):
+        import time as _time
+        hog = make_pool_job("bulk", 1, n_maps=6)
+        starved = make_pool_job("gold", 2, n_maps=4)
+        sched = make_fair(
+            [hog, starved],
+            **{"tpumr.fairscheduler.preemption": True,
+               "tpumr.fairscheduler.preemption.timeout.ms": 1000,
+               "tpumr.fairscheduler.preemption.interval.ms": 0,
+               "tpumr.fairscheduler.pool.gold.minmaps": 4,
+               "tpumr.fairscheduler.pool.bulk.minmaps": 3})
+        hog_tasks = self._run_maps(sched, hog, 4, start_base=1000.0)
+        now = _time.time()
+        sched._preempt_if_starved(now=now)
+        sched._preempt_if_starved(now=now + 2.0)
+        marked = [t for t in hog_tasks
+                  if hog.should_kill_attempt(str(t.attempt_id))]
+        # bulk runs 4 with min share 3: only ONE is preemptable even though
+        # gold's deficit is 4
+        assert len(marked) == 1
+        # repeated checks while the kill is in flight must NOT erode the
+        # victim pool below ITS min share (in-flight counts as surplus
+        # already spent)
+        sched._preempt_if_starved(now=now + 4.0)
+        sched._preempt_if_starved(now=now + 6.0)
+        marked = [t for t in hog_tasks
+                  if hog.should_kill_attempt(str(t.attempt_id))]
+        assert len(marked) == 1
+
+    def test_starvation_clock_resets_when_pool_empties(self):
+        """A pool that stops running jobs while starved must not keep a
+        stale clock — a later job in it has to re-serve the full timeout."""
+        import time as _time
+        hog = make_pool_job("bulk", 1, n_maps=6)
+        starved = make_pool_job("gold", 2, n_maps=4)
+        sched = self._make(hog, starved)
+        hog_tasks = self._run_maps(sched, hog, 4, start_base=1000.0)
+        now = _time.time()
+        sched._preempt_if_starved(now=now)             # clock starts
+        # gold's job leaves the running set (finished/killed)
+        sched.set_manager(FakeManager([hog]))
+        sched._preempt_if_starved(now=now + 0.5)       # clock dropped
+        # a NEW gold job appears much later
+        gold2 = make_pool_job("gold", 3, n_maps=4)
+        sched.set_manager(FakeManager([hog, gold2]))
+        sched._preempt_if_starved(now=now + 10.0)      # first sighting
+        marked = [t for t in hog_tasks
+                  if hog.should_kill_attempt(str(t.attempt_id))]
+        assert marked == []                            # timeout not served
+        sched._preempt_if_starved(now=now + 12.0)      # 2s > 1s timeout
+        marked = [t for t in hog_tasks
+                  if hog.should_kill_attempt(str(t.attempt_id))]
+        assert len(marked) == 2
+
+    def test_lost_tracker_clears_preempt_marks(self):
+        """A preempt-marked attempt on a lost tracker must not linger as a
+        phantom in-flight kill suppressing future preemption."""
+        import time as _time
+        hog = make_pool_job("bulk", 1, n_maps=6)
+        starved = make_pool_job("gold", 2, n_maps=4)
+        sched = self._make(hog, starved)
+        hog_tasks = self._run_maps(sched, hog, 4, start_base=1000.0)
+        now = _time.time()
+        sched._preempt_if_starved(now=now)
+        sched._preempt_if_starved(now=now + 2.0)
+        marked = [str(t.attempt_id) for t in hog_tasks
+                  if hog.should_kill_attempt(str(t.attempt_id))]
+        assert len(marked) == 2
+        hog.requeue_lost_attempts(marked)  # tracker died before kills landed
+        assert hog.preempt_pending() == set()
+
+    def test_in_flight_kills_not_double_counted(self):
+        import time as _time
+        hog = make_pool_job("bulk", 1, n_maps=6)
+        starved = make_pool_job("gold", 2, n_maps=4)
+        sched = self._make(hog, starved)
+        hog_tasks = self._run_maps(sched, hog, 4, start_base=1000.0)
+        now = _time.time()
+        sched._preempt_if_starved(now=now)
+        sched._preempt_if_starved(now=now + 2.0)
+        sched._preempt_if_starved(now=now + 4.0)   # kills still in flight
+        marked = [t for t in hog_tasks
+                  if hog.should_kill_attempt(str(t.attempt_id))]
+        assert len(marked) == 2  # no extra victims while kills in flight
+
+    def test_killed_preempted_attempt_requeues_without_failure(self):
+        import time as _time
+        from tpumr.mapred.task import TaskState, TaskStatus
+        hog = make_pool_job("bulk", 1, n_maps=2)
+        starved = make_pool_job("gold", 2, n_maps=2)
+        sched = self._make(hog, starved)
+        [t] = self._run_maps(sched, hog, 1, start_base=1000.0)
+        now = _time.time()
+        sched._preempt_if_starved(now=now)
+        sched._preempt_if_starved(now=now + 2.0)
+        aid = str(t.attempt_id)
+        assert hog.should_kill_attempt(aid)
+        pending_before = hog.pending_map_count()
+        hog.update_task_status(TaskStatus(
+            attempt_id=t.attempt_id, is_map=True, state=TaskState.KILLED,
+            start_time=1000.0, finish_time=now), "h:0")
+        assert hog.pending_map_count() == pending_before + 1  # requeued
+        assert hog.maps[t.partition].failures == 0            # no budget
+        assert not hog.preempt_pending()                      # mark cleared
+
+
+class TestCapacityMemoryMatching:
+    """≈ CapacityTaskScheduler memory matching: trackers report available
+    memory; jobs declaring more than a tracker has left are skipped there
+    (not failed), and assignment consumes the budget within a heartbeat."""
+
+    def _mem_job(self, job_num, map_mb, n_maps=4):
+        conf = {"mapred.reduce.tasks": 0,
+                "mapred.job.queue.name": "default",
+                "mapred.job.map.memory.mb": map_mb,
+                "mapred.reduce.slowstart.completed.maps": 0.0}
+        splits = [{"locations": []} for _ in range(n_maps)]
+        return JobInProgress(JobID("test", job_num), conf, splits)
+
+    def test_high_memory_job_skips_small_tracker(self):
+        big = self._mem_job(1, map_mb=4000)
+        small = self._mem_job(2, map_mb=500)
+        sched = make_capacity([big, small])
+        tts = tracker_status(cpu=2, tpu=0, reduce=0)
+        tts["available_memory_mb"] = 1200
+        tasks = sched.assign_tasks(tts)
+        # both slots go to the small job; the 4 GB job never lands here
+        assert len(tasks) == 2
+        assert all(str(t.attempt_id.task.job) == str(small.job_id)
+                   for t in tasks)
+        assert all(t.memory_mb == 500 for t in tasks)
+
+    def test_memory_budget_consumed_within_heartbeat(self):
+        job = self._mem_job(1, map_mb=700)
+        sched = make_capacity([job])
+        tts = tracker_status(cpu=3, tpu=0, reduce=0)
+        tts["available_memory_mb"] = 1500
+        tasks = sched.assign_tasks(tts)
+        assert len(tasks) == 2  # 700+700 fits, third (2100) would not
+
+    def test_unlimited_when_tracker_reports_none(self):
+        job = self._mem_job(1, map_mb=100_000)
+        sched = make_capacity([job])
+        tasks = sched.assign_tasks(tracker_status(cpu=2, tpu=0, reduce=0))
+        assert len(tasks) == 2  # no memory report = matching off

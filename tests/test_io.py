@@ -121,3 +121,62 @@ def test_sequencefile_sync_split():
     keys = [k for k, _ in tail]
     assert keys == sorted(keys)
     assert keys[-1] == 99
+
+
+class TestAppendFixedRows:
+    def test_byte_identical_to_per_record_appends(self):
+        """Bulk fixed-width append must produce exactly the framing of n
+        scalar append() calls (same reader, same sync semantics)."""
+        import io as _io
+        import os as _os
+        import numpy as np
+        from tpumr.io import sequencefile as sf
+        rng = np.random.default_rng(0)
+        rows = rng.integers(0, 256, size=(2500, 14), dtype=np.uint8)
+        orig = _os.urandom
+        _os.urandom = lambda n: b"S" * n  # pin sync for comparability
+        try:
+            # DEFAULT block size: the contract must hold for production
+            # writers (_SeqWriter passes no block_records)
+            b1, b2 = _io.BytesIO(), _io.BytesIO()
+            w1 = sf.Writer(b1)
+            w1.append_fixed_rows(rows, 10)
+            w1.close()
+            w2 = sf.Writer(b2)
+            for r in rows:
+                w2.append(bytes(r[:10]), bytes(r[10:]))
+            w2.close()
+        finally:
+            _os.urandom = orig
+        assert b1.getvalue() == b2.getvalue()
+
+    def test_roundtrip_and_mixed_appends(self):
+        import io as _io
+        import numpy as np
+        from tpumr.io import sequencefile as sf
+        rng = np.random.default_rng(1)
+        rows = rng.integers(0, 256, size=(300, 12), dtype=np.uint8)
+        b = _io.BytesIO()
+        w = sf.Writer(b)
+        w.append(b"first-0000", b"xx")       # scalar before bulk: ordered
+        w.append_fixed_rows(rows, 10)
+        w.append(b"last-00000", b"yy")
+        w.close()
+        b.seek(0)
+        recs = list(sf.Reader(b))
+        assert len(recs) == 302
+        assert recs[0] == (b"first-0000", b"xx")
+        assert recs[1] == (bytes(rows[0, :10]), bytes(rows[0, 10:]))
+        assert recs[-1] == (b"last-00000", b"yy")
+
+    def test_zero_width_values(self):
+        import io as _io
+        import numpy as np
+        from tpumr.io import sequencefile as sf
+        rows = np.arange(50, dtype=np.uint8).reshape(5, 10)
+        b = _io.BytesIO()
+        w = sf.Writer(b)
+        w.append_fixed_rows(rows, 10)
+        w.close()
+        b.seek(0)
+        assert list(sf.Reader(b)) == [(bytes(r), b"") for r in rows]

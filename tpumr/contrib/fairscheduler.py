@@ -24,9 +24,10 @@ apply within the fair ordering.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable
 
-from tpumr.mapred.job_in_progress import JobInProgress
+from tpumr.mapred.job_in_progress import JobInProgress, JobState
 from tpumr.mapred.scheduler import HybridQueueScheduler
 
 POOL_KEY = "mapred.fairscheduler.pool"
@@ -42,11 +43,100 @@ class FairScheduler(HybridQueueScheduler):
     def __init__(self) -> None:
         super().__init__()
         self._pool_cache: dict[tuple[str, str], Any] = {}
+        #: pool -> wall time it first fell below its map min share
+        self._starved_since: dict[str, float] = {}
+        self._last_preempt_check = 0.0
 
     def _begin_assignment(self, tts: dict) -> None:
         # weights/min-shares are heartbeat-invariant; the order hooks run
         # once per free slot — don't re-parse config each time
         self._pool_cache.clear()
+
+    def before_heartbeat(self, tts: dict) -> None:
+        # preemption runs on EVERY heartbeat — not inside assign_tasks,
+        # which a saturated cluster (the one case preemption exists for)
+        # never reaches because full trackers don't ask for work
+        if self.conf is not None and self.conf.get_boolean(
+                "tpumr.fairscheduler.preemption", False):
+            self._pool_cache.clear()
+            self._preempt_if_starved()
+
+    # -------------------------------------------------------- preemption
+
+    def _preempt_if_starved(self, now: float | None = None) -> None:
+        """≈ FairScheduler.preemptTasksIfNecessary (reference
+        src/contrib/fairscheduler): a pool below its map min share with
+        pending work for longer than ``tpumr.fairscheduler.preemption.
+        timeout.ms`` reclaims its guarantee by killing the NEWEST running
+        map attempts of pools above their own min share. Kills requeue the
+        victims (KILLED, not FAILED — no attempt budget burned)."""
+        assert self.manager is not None and self.conf is not None
+        now = time.time() if now is None else now
+        interval = self.conf.get_int(
+            "tpumr.fairscheduler.preemption.interval.ms", 1000) / 1000.0
+        if now - self._last_preempt_check < interval:
+            return
+        self._last_preempt_check = now
+        timeout = self.conf.get_int(
+            "tpumr.fairscheduler.preemption.timeout.ms", 15_000) / 1000.0
+
+        jobs = [j for j in self.manager.running_jobs()
+                if j.state == JobState.RUNNING]
+        pools: dict[str, list[JobInProgress]] = {}
+        for j in jobs:
+            pools.setdefault(pool_of(j), []).append(j)
+
+        usage = {p: sum(j.running_map_count() for j in members)
+                 for p, members in pools.items()}
+        pending = {p: sum(j.pending_map_count() for j in members)
+                   for p, members in pools.items()}
+        minshare = {p: int(self._pool_conf(p, "minmaps", 0)) for p in pools}
+        pool_in_flight = {p: sum(len(j.preempt_pending()) for j in members)
+                          for p, members in pools.items()}
+
+        # drop starvation clocks of pools that no longer have running jobs
+        # — a stale timestamp would let a future job in that pool preempt
+        # instantly, skipping the configured timeout
+        for p in list(self._starved_since):
+            if p not in pools:
+                del self._starved_since[p]
+
+        starved: set[str] = set()
+        deficit = 0
+        for p in pools:
+            if usage[p] < minshare[p] and pending[p] > 0:
+                since = self._starved_since.setdefault(p, now)
+                if now - since >= timeout:
+                    starved.add(p)
+                    deficit += min(minshare[p] - usage[p], pending[p])
+            else:
+                self._starved_since.pop(p, None)
+        # kills already in flight count toward the coming free slots
+        deficit -= sum(pool_in_flight.values())
+        if deficit <= 0:
+            return
+
+        # victims: newest attempts of pools strictly above their OWN min
+        # share (never push a pool below its guarantee — in-flight kills
+        # already count against the pool's surplus), newest-first so the
+        # least sunk work is lost (the reference's victim order)
+        victims: list[tuple[float, str, JobInProgress, str]] = []
+        for p, members in pools.items():
+            over = usage[p] - max(minshare[p], 0) - pool_in_flight[p]
+            if over <= 0 or p in starved:
+                continue
+            cand = []
+            for j in members:
+                already = j.preempt_pending()
+                cand.extend((start, p, j, aid)
+                            for aid, start in j.running_map_attempts()
+                            if aid not in already)
+            cand.sort(key=lambda t: t[0], reverse=True)
+            victims.extend(cand[:over])
+        victims.sort(key=lambda t: t[0], reverse=True)  # newest first
+
+        for _start, _p, job, aid in victims[:deficit]:
+            job.request_preempt(aid)
 
     def _pool_conf(self, pool: str, suffix: str, default: Any) -> Any:
         if self.conf is None:

@@ -27,7 +27,13 @@ class SleepMapper(Mapper):
         self._ms = conf.get_int("tpumr.sleep.map.ms", 100)
 
     def map(self, key, value, output, reporter):
-        time.sleep(self._ms / 1000.0)
+        # sleep in slices polling the kill flag — the model for how any
+        # long single-record mapper stays preemptible (record-loop mappers
+        # get the poll for free in the framework's reader)
+        deadline = time.time() + self._ms / 1000.0
+        while time.time() < deadline:
+            reporter.raise_if_aborted()
+            time.sleep(min(0.05, max(0.0, deadline - time.time())))
         output.collect(0, 0)
 
 

@@ -69,7 +69,11 @@ class Writer:
             body.write(k)
             write_vint(body, len(v))
             body.write(v)
-        payload = self._codec.compress(body.getvalue())
+        self._emit_block(body.getvalue())
+        self._buf.clear()
+
+    def _emit_block(self, body: bytes) -> None:
+        payload = self._codec.compress(body)
         if self._since_sync >= SYNC_INTERVAL:
             self._out.write(struct.pack(">I", _SYNC_ESCAPE))
             self._out.write(self._sync)
@@ -77,7 +81,49 @@ class Writer:
         self._out.write(struct.pack(">I", len(payload)))
         self._out.write(payload)
         self._since_sync += len(payload) + 4
-        self._buf.clear()
+
+    def append_fixed_rows(self, rows, klen: int) -> None:
+        """Vectorized bulk append of fixed-width raw records: ``rows`` is a
+        ``[n, klen+vlen] uint8`` array whose first ``klen`` bytes per row
+        are the key. Produces byte-identical framing to per-record
+        ``append(bytes, bytes)`` calls (every serialized length is a
+        per-file constant, so frames are a numpy tile job) — the write
+        path of the device-shuffled reduce, where per-record Python append
+        would dominate the whole job."""
+        import numpy as np
+
+        from tpumr.io.writable import serialize
+        n = int(rows.shape[0])
+        if n == 0:
+            return
+        self._flush_block()  # keep scalar-appended records ordered first
+        vlen = int(rows.shape[1]) - klen
+
+        def field_prefix(length: int) -> bytes:
+            ser = serialize(b"\x00" * length)
+            ser_prefix = ser[:len(ser) - length]  # tag+vint, payload off
+            head = BytesIO()
+            write_vint(head, len(ser_prefix) + length)
+            return head.getvalue() + ser_prefix
+
+        kf = np.frombuffer(field_prefix(klen), np.uint8)
+        vf = np.frombuffer(field_prefix(vlen), np.uint8)
+        frame_len = len(kf) + klen + len(vf) + vlen
+        frames = np.empty((n, frame_len), np.uint8)
+        frames[:, :len(kf)] = kf
+        frames[:, len(kf):len(kf) + klen] = rows[:, :klen]
+        off = len(kf) + klen
+        frames[:, off:off + len(vf)] = vf
+        frames[:, off + len(vf):] = rows[:, klen:]
+
+        per = self._block_records  # same block granularity as scalar appends
+        for lo in range(0, n, per):
+            m = min(per, n - lo)
+            head = BytesIO()
+            write_vint(head, m)
+            # block-sized copies only — one big tobytes() would double the
+            # peak memory of exactly the large-partition path this serves
+            self._emit_block(head.getvalue() + frames[lo:lo + m].tobytes())
 
     def sync_now(self) -> None:
         self.sync_pos()

@@ -21,13 +21,25 @@ from tpumr.core.counters import Counters
 from tpumr.io.writable import deserialize, serialize
 
 
+class TaskKilledError(Exception):
+    """Raised inside a task when its attempt was killed (preemption,
+    speculative-race loss, job kill) — surfaces as state KILLED (requeue,
+    no attempt budget), never FAILED."""
+
+
 class Reporter:
-    """≈ org.apache.hadoop.mapred.Reporter: progress + status + counters."""
+    """≈ org.apache.hadoop.mapred.Reporter: progress + status + counters.
+    Also the cooperative-cancellation seam: in-process task threads cannot
+    be interrupted, so record loops poll :meth:`aborted` and bail with
+    :class:`TaskKilledError` — this is what makes a preemption kill free
+    its slot mid-task instead of at natural completion."""
 
     def __init__(self, counters: Counters | None = None,
-                 on_progress: Callable[[float], None] | None = None) -> None:
+                 on_progress: Callable[[float], None] | None = None,
+                 abort_check: Callable[[], bool] | None = None) -> None:
         self.counters = counters or Counters()
         self._on_progress = on_progress
+        self._abort_check = abort_check
         self.status = ""
 
     def set_status(self, status: str) -> None:
@@ -36,6 +48,13 @@ class Reporter:
     def progress(self, fraction: float | None = None) -> None:
         if self._on_progress is not None and fraction is not None:
             self._on_progress(fraction)
+
+    def aborted(self) -> bool:
+        return self._abort_check is not None and self._abort_check()
+
+    def raise_if_aborted(self) -> None:
+        if self.aborted():
+            raise TaskKilledError("attempt killed while running")
 
     def incr_counter(self, group: str, name: str, amount: int = 1) -> None:
         self.counters.incr(group, name, amount)

@@ -330,10 +330,15 @@ def run_device_reduce(conf: Any, task: Task, dense_fetch: DenseFetchFn,
 
 def _write_rows(writer: Any, rows: np.ndarray, klen: int,
                 reporter: Reporter) -> None:
-    kb = rows[:, :klen]
-    vb = rows[:, klen:]
-    for i in range(rows.shape[0]):
-        writer.write(kb[i].tobytes(), vb[i].tobytes())
+    bulk = getattr(writer, "write_fixed_rows", None)
+    if bulk is not None:
+        bulk(rows, klen)  # vectorized framing — per-record append would
+        #                   dominate the whole device-shuffled job
+    else:
+        kb = rows[:, :klen]
+        vb = rows[:, klen:]
+        for i in range(rows.shape[0]):
+            writer.write(kb[i].tobytes(), vb[i].tobytes())
     reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                           TaskCounter.REDUCE_OUTPUT_RECORDS, rows.shape[0])
 

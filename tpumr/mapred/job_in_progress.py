@@ -124,6 +124,9 @@ class JobInProgress:
         #: kill_job racing a heartbeat-deferred finalize must not run
         #: commit/abort twice or duplicate JOB_FINISHED history events
         self.finalize_started = False
+        #: attempts a scheduler marked for preemption (kill-not-fail);
+        #: cleared when the attempt's terminal status arrives
+        self._preempt_requested: set[str] = set()
         # --- per-backend profiling (running sums, O(1) per update) ---
         self.finished_cpu_maps = 0
         self.finished_tpu_maps = 0
@@ -237,7 +240,8 @@ class JobInProgress:
             tip.report.tpu_device_id = tpu_device_id
             return Task(attempt, partition=idx, num_reduces=self.num_reduces,
                         split=tip.split, num_maps=len(self.maps),
-                        run_on_tpu=run_on_tpu, tpu_device_id=tpu_device_id)
+                        run_on_tpu=run_on_tpu, tpu_device_id=tpu_device_id,
+                        memory_mb=self.map_memory_mb())
 
     def _obtain_speculative_map(self, host: str, run_on_tpu: bool,
                                 tpu_device_id: int) -> Task | None:
@@ -271,18 +275,58 @@ class JobInProgress:
             return Task(attempt, partition=tip.partition,
                         num_reduces=self.num_reduces, split=tip.split,
                         num_maps=len(self.maps), run_on_tpu=run_on_tpu,
-                        tpu_device_id=tpu_device_id)
+                        tpu_device_id=tpu_device_id,
+                        memory_mb=self.map_memory_mb())
         return None
 
     def should_kill_attempt(self, attempt_id: str) -> bool:
         """True when this RUNNING attempt lost a speculative race — its TIP
         already succeeded through a different attempt (≈ the reference
-        killing the slower speculative twin)."""
+        killing the slower speculative twin) — or a scheduler marked it for
+        preemption (≈ FairScheduler.preemptTasksIfNecessary)."""
         from tpumr.mapred.ids import TaskAttemptID
         with self.lock:
+            if attempt_id in self._preempt_requested:
+                return True
             tip = self._tip_of(TaskAttemptID.parse(attempt_id).task)
             return (tip is not None and tip.state == "succeeded"
                     and tip.successful_attempt != attempt_id)
+
+    def request_preempt(self, attempt_id: str) -> None:
+        """Mark a RUNNING attempt for preemption: the next heartbeat of its
+        tracker carries a kill action; the KILLED report requeues the TIP
+        without counting a failure (fair-scheduler min-share restoration —
+        the reference kills tasks of over-share pools the same way)."""
+        with self.lock:
+            self._preempt_requested.add(attempt_id)
+
+    def preempt_pending(self) -> set[str]:
+        """Attempts marked but not yet observed terminal (so the scheduler
+        does not double-count in-flight preemptions when sizing the next
+        round of kills)."""
+        with self.lock:
+            return set(self._preempt_requested)
+
+    def running_map_attempts(self) -> "list[tuple[str, float]]":
+        """(attempt_id, start_time) for every RUNNING map attempt — the
+        fair scheduler's victim candidates (newest first is the caller's
+        sort)."""
+        with self.lock:
+            out = []
+            for tip in self.maps:
+                for aid, st in tip.attempts.items():
+                    if st.state == TaskState.RUNNING:
+                        out.append((aid, st.start_time))
+            return out
+
+    def map_memory_mb(self) -> int:
+        """Declared per-map memory demand (mapred.job.map.memory.mb, 0 =
+        undeclared) — the capacity scheduler's memory-matching input
+        (≈ CapacityTaskScheduler's memory checks)."""
+        return int(self.conf.get("mapred.job.map.memory.mb", 0) or 0)
+
+    def reduce_memory_mb(self) -> int:
+        return int(self.conf.get("mapred.job.reduce.memory.mb", 0) or 0)
 
     def obtain_new_reduce_task(self, host: str) -> Task | None:
         with self.lock:
@@ -299,7 +343,8 @@ class JobInProgress:
             tip.report.state = TaskState.RUNNING
             tip.report.start_time = tip.report.start_time or time.time()
             return Task(attempt, partition=idx, num_reduces=self.num_reduces,
-                        num_maps=len(self.maps))
+                        num_maps=len(self.maps),
+                        memory_mb=self.reduce_memory_mb())
 
     # ------------------------------------------------------------ updates
 
@@ -309,6 +354,8 @@ class JobInProgress:
             tip = self._tip_of(status.attempt_id.task)
             if tip is None:
                 return
+            if status.state in TaskState.TERMINAL:
+                self._preempt_requested.discard(str(status.attempt_id))
             tip.attempts[str(status.attempt_id)] = status
             tip.report.progress = max(tip.report.progress, status.progress)
             if status.state == TaskState.SUCCEEDED:
@@ -402,6 +449,9 @@ class JobInProgress:
                 tip = self._tip_of(attempt.task)
                 if tip is None:
                     continue
+                # a lost attempt is terminal either way — a pending preempt
+                # mark must not linger as a phantom in-flight kill
+                self._preempt_requested.discard(aid)
                 st = tip.attempts.get(aid)
                 if st is not None and st.state == TaskState.RUNNING:
                     st.state = TaskState.KILLED

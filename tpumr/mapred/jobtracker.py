@@ -445,6 +445,15 @@ class JobMaster:
                 return {"response_id": last[0], "actions": last[1]}
 
             actions: list[dict] = []
+            # scheduler observation hook BEFORE the kill scan and
+            # independent of free slots: a saturated cluster (no tracker
+            # ever asks for work) is exactly when fair-share preemption
+            # must still run, and marks made here produce kill actions in
+            # THIS response for victims on this tracker
+            try:
+                self.scheduler.before_heartbeat(status)
+            except Exception:  # noqa: BLE001 — observation must not
+                pass           # break heartbeats
             # kill actions: tasks of dead jobs + speculative-race losers
             from tpumr.mapred.ids import TaskAttemptID
             for sd in status.get("task_statuses", []):

@@ -287,7 +287,9 @@ def _cpu_runner_class(conf: Any) -> type:
 def _counted_reader(in_fmt: Any, split: InputSplit | None, conf: Any,
                     reporter: Reporter) -> Iterator[tuple[Any, Any]]:
     reader = in_fmt.get_record_reader(split, conf, reporter)
-    for k, v in reader:
+    for i, (k, v) in enumerate(reader):
+        if (i & 0x1FF) == 0:  # cooperative kill poll every 512 records —
+            reporter.raise_if_aborted()  # preemption frees the slot NOW
         reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                               TaskCounter.MAP_INPUT_RECORDS)
         yield k, v

@@ -89,6 +89,11 @@ class _SeqWriter(RecordWriter):
     def write(self, key: Any, value: Any) -> None:
         self._w.append(key, value)
 
+    def write_fixed_rows(self, rows, klen: int) -> None:
+        """Bulk path for fixed-width byte records (device-shuffled reduce):
+        one numpy tile job instead of n append() calls."""
+        self._w.append_fixed_rows(rows, klen)
+
     def close(self) -> None:
         self._w.close()
         self._f.close()

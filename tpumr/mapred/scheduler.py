@@ -58,6 +58,12 @@ class TaskScheduler:
     def assign_tasks(self, tracker_status: dict) -> list[Task]:
         raise NotImplementedError
 
+    def before_heartbeat(self, tracker_status: dict) -> None:
+        """Observation hook run on EVERY heartbeat, before kill-action
+        generation and regardless of free slots (assign_tasks only runs
+        when the tracker asks for work — a fully saturated cluster never
+        does, which is precisely when preemption logic must still fire)."""
+
 
 def _free_tpu_devices(tracker_status: dict) -> list[int]:
     """Free physical device ids, recomputed from running task statuses each
@@ -109,6 +115,13 @@ class HybridQueueScheduler(TaskScheduler):
         free_tpu = max(0, max_tpu - run_tpu)
         free_red = max(0, max_red - run_red)
         free_devices = _free_tpu_devices(tts)
+        # memory matching (≈ CapacityTaskScheduler): a tracker reporting
+        # finite memory only receives tasks whose declared demand fits;
+        # consumed locally as this heartbeat assigns. -1 / absent = off.
+        mem_left = int(tts.get("available_memory_mb", -1))
+
+        def fits(demand_mb: int) -> bool:
+            return mem_left < 0 or demand_mb <= mem_left
 
         # cluster-wide pending load + profile scan (:127-178) — cheap here:
         # per-job O(1) running sums instead of per-report recomputation
@@ -146,6 +159,8 @@ class HybridQueueScheduler(TaskScheduler):
             for job in self._map_job_order(jobs):
                 if not job.has_kernel():
                     continue  # ≈ gpu-executable gate (:342-347)
+                if not fits(job.map_memory_mb()):
+                    continue
                 device = free_devices[0]
                 task = job.obtain_new_map_task(host, run_on_tpu=True,
                                                tpu_device_id=device,
@@ -156,6 +171,8 @@ class HybridQueueScheduler(TaskScheduler):
             if task is None:
                 break
             assigned.append(task)
+            if mem_left >= 0:
+                mem_left -= task.memory_mb
             pending_map_load -= 1
 
         # ---- CPU pass (:290-327)
@@ -165,6 +182,8 @@ class HybridQueueScheduler(TaskScheduler):
                 jid = str(job.job_id)
                 if cpu_budget.get(jid, 0) <= 0:
                     continue
+                if not fits(job.map_memory_mb()):
+                    continue
                 task = job.obtain_new_map_task(host, run_on_tpu=False,
                                                rack=tts.get("rack"))
                 if task is not None:
@@ -173,11 +192,15 @@ class HybridQueueScheduler(TaskScheduler):
             if task is None:
                 break
             assigned.append(task)
+            if mem_left >= 0:
+                mem_left -= task.memory_mb
             pending_map_load -= 1
 
         # ---- reduce pass: at most one per heartbeat (:527-560)
         if free_red > 0:
             for job in self._reduce_job_order(jobs):
+                if not fits(job.reduce_memory_mb()):
+                    continue
                 task = job.obtain_new_reduce_task(host)
                 if task is not None:
                     assigned.append(task)

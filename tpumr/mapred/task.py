@@ -56,6 +56,10 @@ class Task:
     # --- accelerator placement (≈ Task.java:169-170) ---
     run_on_tpu: bool = False
     tpu_device_id: int = -1
+    #: declared memory demand (mapred.job.{map,reduce}.memory.mb), stamped
+    #: at assign time so the tracker can report available memory without a
+    #: conf lookup — feeds the capacity scheduler's memory matching
+    memory_mb: int = 0
 
     @property
     def is_map(self) -> bool:
@@ -74,6 +78,7 @@ class Task:
             "num_maps": self.num_maps,
             "run_on_tpu": self.run_on_tpu,
             "tpu_device_id": self.tpu_device_id,
+            "memory_mb": self.memory_mb,
         }
 
     @classmethod
@@ -82,7 +87,8 @@ class Task:
                    partition=d["partition"], num_reduces=d["num_reduces"],
                    split=d.get("split"), num_maps=d.get("num_maps", 0),
                    run_on_tpu=d.get("run_on_tpu", False),
-                   tpu_device_id=d.get("tpu_device_id", -1))
+                   tpu_device_id=d.get("tpu_device_id", -1),
+                   memory_mb=d.get("memory_mb", 0))
 
 
 @dataclass

@@ -34,7 +34,7 @@ from typing import Any
 from tpumr.core.counters import Counters
 from tpumr.io import ifile
 from tpumr.ipc.rpc import RpcClient, RpcServer
-from tpumr.mapred.api import Reporter
+from tpumr.mapred.api import Reporter, TaskKilledError
 from tpumr.mapred.ids import TaskAttemptID
 from tpumr.mapred.jobconf import JobConf
 from tpumr.mapred.jobtracker import PROTOCOL_VERSION
@@ -202,7 +202,19 @@ class NodeRunner:
         with self.lock:
             cpu, tpu, red = self._counts()
             statuses = [st.to_dict() for st in self.running.values()]
+            # memory accounting for the capacity scheduler's matching
+            # (≈ CapacityTaskScheduler memory checks): total offered minus
+            # the declared demand of everything running; -1 = unlimited
+            total_mb = self.conf.get_int("mapred.tasktracker.memory.mb", -1)
+            if total_mb >= 0:
+                used = sum(t.memory_mb for aid, t in self.running_tasks.items()
+                           if self.running.get(aid) is not None
+                           and self.running[aid].state == TaskState.RUNNING)
+                avail_mb = max(0, total_mb - used)
+            else:
+                avail_mb = -1
             return {
+                "available_memory_mb": avail_mb,
                 "tracker_name": self.name,
                 "host": self.host,
                 "shuffle_addr": f"{self.bind_host}:{self.shuffle_port}",
@@ -341,7 +353,16 @@ class NodeRunner:
 
     def _run_task(self, job_id: str, task: Task, status: TaskStatus) -> None:
         aid = str(task.attempt_id)
-        reporter = Reporter()
+
+        def killed() -> bool:
+            with self.lock:
+                return aid in self._kill_requested
+
+        # cooperative cancellation: record loops poll this so a preemption
+        # or speculative-race kill frees the slot mid-task, not at natural
+        # completion (hard process kills arrive with the subprocess
+        # executor; threads cannot be interrupted)
+        reporter = Reporter(abort_check=killed)
         sem = (self._red_sem if not task.is_map
                else self._tpu_sem if task.run_on_tpu else self._cpu_sem)
         sem.acquire()
@@ -391,6 +412,11 @@ class NodeRunner:
             else:
                 status.state = (TaskState.KILLED if killed
                                 else TaskState.SUCCEEDED)
+        except TaskKilledError:
+            status.diagnostics = "attempt killed while running (preempted " \
+                                 "or superseded)"
+            status.finish_time = time.time()
+            status.state = TaskState.KILLED  # requeue, no attempt budget
         except Exception as e:  # noqa: BLE001 — task failure is data
             status.diagnostics = f"{type(e).__name__}: {e}\n" + \
                 traceback.format_exc(limit=8)
