@@ -1,0 +1,272 @@
+"""Process-isolated task execution ≈ the reference's child-JVM tier.
+
+Covers the TaskRunner/JvmManager/Child/TaskController contracts
+(reference: mapred/Child.java:69, JvmManager.java:322-413,
+TaskController.java): with ``tpumr.task.isolation=process`` every CPU
+attempt is a real OS process, so a crashing (os._exit) or runaway-memory
+mapper costs one attempt — the tracker survives and the job completes on
+retry. The last test launches children through the native setuid
+task-controller as an unprivileged user (root-only, ≈ TestPipesAsDifferentUser).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpumr.fs import get_filesystem
+from tpumr.mapred.job_client import JobClient
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.mini_cluster import MiniMRCluster
+
+
+class PidWordCountMapper:
+    """Wordcount that also records which pid ran it."""
+
+    def configure(self, conf):
+        pass
+
+    def map(self, key, value, output, reporter):
+        reporter.incr_counter("pids", f"pid_{os.getpid()}")
+        for w in value.split():
+            output.collect(w, 1)
+
+    def close(self):
+        pass
+
+
+class SumReducer:
+    def configure(self, conf):
+        pass
+
+    def reduce(self, key, values, output, reporter):
+        output.collect(key, sum(values))
+
+    def close(self):
+        pass
+
+
+class CrashOnFirstAttemptMapper:
+    """os._exit on attempt 0 — in-process this would take down the whole
+    tracker (and this pytest process); isolated it costs one attempt."""
+
+    def configure(self, conf):
+        self.attempt = conf.get("tpumr.task.attempt.id", "")
+
+    def map(self, key, value, output, reporter):
+        if self.attempt.endswith("_0"):
+            os._exit(66)
+        output.collect(value, 1)
+
+    def close(self):
+        pass
+
+
+class MemoryBombOnFirstAttemptMapper:
+    """Allocates far past the task memory limit on attempt 0 and then
+    lingers so the TaskMemoryManager sampler catches and kills it."""
+
+    def configure(self, conf):
+        self.attempt = conf.get("tpumr.task.attempt.id", "")
+
+    def map(self, key, value, output, reporter):
+        if self.attempt.endswith("_0"):
+            hog = [bytearray(16 * 1024 * 1024) for _ in range(24)]  # 384 MB
+            time.sleep(30)
+            del hog
+        output.collect(value, 1)
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    conf = JobConf()
+    conf.set("tpumr.task.isolation", "process")
+    conf.set("mapred.map.max.attempts", 3)
+    with MiniMRCluster(num_trackers=2, conf=conf, cpu_slots=2,
+                       tpu_slots=0) as c:
+        yield c
+
+
+def _job_conf(cluster, tmp_path, name):
+    conf = cluster.create_job_conf()
+    conf.set_job_name(name)
+    conf.set("tpumr.task.isolation", "process")
+    src = tmp_path / f"{name}-in.txt"
+    src.write_bytes(b"alpha beta\nbeta gamma\n" * 50)
+    conf.set_input_paths(f"file://{src}")
+    conf.set_output_path(f"file://{tmp_path}/{name}-out")
+    conf.set("mapred.min.split.size", 1)
+    conf.set("mapred.map.tasks", 2)
+    return conf
+
+
+def _read_output(out_dir):
+    fs = get_filesystem(f"file://{out_dir}")
+    out = {}
+    for st in fs.list_files(f"file://{out_dir}"):
+        if st.path.name.startswith("part-"):
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                k, v = line.split("\t")
+                out[k] = int(v)
+    return out
+
+
+def test_isolated_wordcount_runs_out_of_process(cluster, tmp_path):
+    conf = _job_conf(cluster, tmp_path, "iso-wc")
+    conf.set_class("mapred.mapper.class", PidWordCountMapper)
+    conf.set_class("mapred.reducer.class", SumReducer)
+    conf.set_num_reduce_tasks(1)
+
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+    assert _read_output(tmp_path / "iso-wc-out") == {
+        "alpha": 50, "beta": 100, "gamma": 50}
+    # the proof of isolation: no map ran inside this (tracker) process
+    pid_counters = result.counters.to_dict().get("pids", {})
+    assert pid_counters, "mapper pid counters missing"
+    assert f"pid_{os.getpid()}" not in pid_counters
+
+
+def test_crashing_mapper_fails_attempt_tracker_survives(cluster, tmp_path):
+    """VERDICT r1 'done' criterion: a crashing mapper fails its attempt,
+    the tracker survives, and the job completes via retry."""
+    conf = _job_conf(cluster, tmp_path, "iso-crash")
+    conf.set_class("mapred.mapper.class", CrashOnFirstAttemptMapper)
+    conf.set_num_reduce_tasks(1)
+
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+    # both trackers still heartbeat: a fresh job schedules and finishes
+    conf2 = _job_conf(cluster, tmp_path, "iso-after-crash")
+    conf2.set_class("mapred.mapper.class", PidWordCountMapper)
+    conf2.set_class("mapred.reducer.class", SumReducer)
+    assert JobClient(conf2).run_job(conf2).successful
+
+
+def test_memory_bomb_killed_and_retried(cluster, tmp_path):
+    from tpumr.mapred.node_health import GLOBAL_MEMORY_MANAGER
+    conf = _job_conf(cluster, tmp_path, "iso-mem")
+    conf.set_class("mapred.mapper.class", MemoryBombOnFirstAttemptMapper)
+    conf.set_num_reduce_tasks(1)
+    # child baseline RSS in this image is ~165 MB (interpreter);
+    # the limit sits above that, the bomb far above the limit
+    conf.set("mapred.task.limit.maxrss.mb", 320)
+
+    before = len(GLOBAL_MEMORY_MANAGER.killed)
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+    assert len(GLOBAL_MEMORY_MANAGER.killed) > before, \
+        "memory manager never killed the bombing attempt"
+
+
+# --------------------------------------------------------------------------
+# launch through the setuid task-controller as an unprivileged user
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TASKCTL = os.path.join(REPO, "native", "task-controller")
+
+UIDMAP_MODULE = '''\
+import os
+
+class UidMapper:
+    def configure(self, conf):
+        pass
+
+    def map(self, key, value, output, reporter):
+        reporter.incr_counter("ids", "uid_%d" % os.getuid())
+
+    def close(self):
+        pass
+'''
+
+
+@pytest.fixture(scope="module")
+def tc_sandbox(tmp_path_factory):
+    """Sandbox the task-controller policy allows, traversable by the
+    dropped-privilege child, with a world-readable copy of tpumr (the repo
+    itself lives under /root, unreadable to the task user)."""
+    import shutil
+
+    scratch = tmp_path_factory.mktemp("tciso")
+    sandbox = scratch / "local"
+    sandbox.mkdir()
+    pylib = scratch / "pylib"
+    shutil.copytree(os.path.join(REPO, "tpumr"), pylib / "tpumr")
+    (pylib / "uidmap.py").write_text(UIDMAP_MODULE)
+    for root, dirs, files in os.walk(scratch):
+        os.chmod(root, 0o755)
+        for f in files:
+            os.chmod(os.path.join(root, f), 0o644)
+    # pytest tmp parents are 0700: open traversal up to the tmp root
+    import tempfile
+    stop = {tempfile.gettempdir(), "/"}
+    p = scratch
+    while str(p) not in stop and str(p.parent) != str(p):
+        try:
+            os.chmod(p, 0o755)
+        except OSError:
+            break
+        p = p.parent
+
+    conf = scratch / "task-controller.cfg"
+    conf.write_text("min.user.id=100\nbanned.users=root,daemon\n"
+                    f"allowed.local.dirs={sandbox}\n")
+    os.chmod(conf, 0o600)
+    binary = scratch / "task-controller"
+    r = subprocess.run(
+        ["cc", "-O2", "-Wall", f"-DTC_CONF_PATH=\"{conf}\"",
+         "-o", str(binary), "task-controller.c"],
+        cwd=TASKCTL, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    os.chmod(binary, 0o755)
+    return {"sandbox": sandbox, "pylib": pylib, "binary": binary,
+            "scratch": scratch}
+
+
+@pytest.mark.skipif(os.getuid() != 0, reason="needs root to drop to nobody")
+def test_launch_through_task_controller_as_nobody(tc_sandbox):
+    """End-to-end: tracker (root) launches the child through the native
+    task-controller, which drops to 'nobody' before exec — the uid counter
+    reported over the umbilical proves both the launch path and the
+    privilege drop (reference: LinuxTaskController + TestPipesAsDifferentUser)."""
+    import pwd
+    try:
+        pwd.getpwnam("nobody")
+    except KeyError:
+        pytest.skip("no 'nobody' user")
+
+    # the child resolves tpumr from the world-readable copy
+    sys.path.insert(0, str(tc_sandbox["pylib"]))
+    try:
+        conf = JobConf()
+        conf.set("tpumr.task.isolation", "process")
+        conf.set("mapred.task.tracker.task-controller",
+                 str(tc_sandbox["binary"]))
+        conf.set("tpumr.task.user", "nobody")
+        conf.set("mapred.local.dir", str(tc_sandbox["sandbox"]))
+        with MiniMRCluster(num_trackers=1, conf=conf, cpu_slots=1,
+                           tpu_slots=0) as cluster:
+            src = tc_sandbox["scratch"] / "in.txt"
+            src.write_bytes(b"x\ny\n")
+            os.chmod(src, 0o644)
+            jconf = cluster.create_job_conf()
+            jconf.set_job_name("tc-uid")
+            jconf.set("tpumr.task.isolation", "process")
+            jconf.set_input_paths(f"file://{src}")
+            jconf.set("mapred.mapper.class", "uidmap.UidMapper")
+            from tpumr.mapred.output_formats import NullOutputFormat
+            jconf.set_class("mapred.output.format.class", NullOutputFormat)
+            jconf.set_num_reduce_tasks(0)
+            result = JobClient(jconf).run_job(jconf)
+        assert result.successful
+        ids = result.counters.to_dict().get("ids", {})
+        nobody_uid = pwd.getpwnam("nobody").pw_uid
+        assert f"uid_{nobody_uid}" in ids, f"uid counters: {ids}"
+        assert "uid_0" not in ids, "child ran as root"
+    finally:
+        sys.path.remove(str(tc_sandbox["pylib"]))

@@ -1,0 +1,207 @@
+"""Task child — the isolated per-attempt process main.
+
+≈ ``org.apache.hadoop.mapred.Child`` (reference: src/mapred/org/apache/
+hadoop/mapred/Child.java:69 main, :172 task fetch, :255 run): a separate
+OS process per task attempt that talks to its tracker over an umbilical
+RPC (≈ TaskUmbilicalProtocol, mapred/TaskUmbilicalProtocol.java:65) —
+status/progress updates, kill polling, commit approval, and final
+completion all flow through the tracker, never directly to the master.
+
+Divergences from the reference, by design:
+
+- the child is launched only for CPU map/reduce attempts when process
+  isolation is enabled (``tpumr.task.isolation=process``): TPU tasks stay
+  in the tracker process so kernels share one JAX runtime and the HBM
+  split cache (tasktracker.py module docstring);
+- task state is shipped in one self-contained task file (conf + task +
+  umbilical address + RPC secret) written into the attempt's sandbox dir,
+  instead of being fetched over the umbilical after launch — one fewer
+  startup round-trip, and it gives the setuid task-controller a single
+  file whose ownership it can validate;
+- there is no JVM-reuse pool (JvmManager.java:322-413): Python process
+  startup is milliseconds, and idle-child reuse would keep dead task
+  state alive across attempts.
+
+The umbilical methods live on the tracker's existing RPC surface
+(NodeRunner.umbilical_*), authenticated with the same cluster secret.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any
+
+_PING_INTERVAL_S = 0.5
+_STATUS_INTERVAL_S = 1.0
+
+
+class _Umbilical:
+    """Child side of the tracker umbilical: rate-limited kill polling and
+    periodic status push (≈ Child.java's TaskReporter thread)."""
+
+    def __init__(self, client: Any, aid: str) -> None:
+        self.client = client
+        self.aid = aid
+        self._last_ping = 0.0
+        self._killed = False
+
+    def kill_requested(self) -> bool:
+        now = time.time()
+        if self._killed:
+            return True
+        if now - self._last_ping >= _PING_INTERVAL_S:
+            self._last_ping = now
+            try:
+                self._killed = bool(
+                    self.client.call("umbilical_ping", self.aid))
+            except Exception:  # noqa: BLE001 — tracker gone: die quietly
+                self._killed = True
+        return self._killed
+
+    def push_status(self, reporter: Any, phase: str,
+                    progress: float) -> None:
+        try:
+            self.client.call("umbilical_status", self.aid, {
+                "phase": phase,
+                "progress": progress,
+                "counters": reporter.counters.to_dict(),
+                "status": reporter.status,
+            })
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def run_child(task_file: str) -> int:
+    """Execute the attempt described by ``task_file``; returns exit code."""
+    from tpumr.io.writable import deserialize
+    from tpumr.ipc.rpc import RpcClient
+    from tpumr.mapred.api import Reporter, TaskKilledError
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.task import Task
+
+    with open(task_file, "rb") as f:
+        spec = deserialize(f.read())
+
+    conf = JobConf()
+    for k, v in spec["conf"].items():
+        conf.set(k, v)
+    task = Task.from_dict(spec["task"])
+    job_id = spec["job_id"]
+    aid = str(task.attempt_id)
+    secret = spec.get("secret") or None
+
+    tracker = RpcClient(spec["tracker_host"], spec["tracker_port"],
+                        secret=secret)
+    umb = _Umbilical(tracker, aid)
+    phase = ["MAP" if task.is_map else "SHUFFLE"]
+    progress = [0.0]
+    reporter = Reporter(abort_check=umb.kill_requested,
+                        on_progress=lambda f: progress.__setitem__(0, f))
+
+    stop = threading.Event()
+
+    def status_loop() -> None:
+        while not stop.wait(_STATUS_INTERVAL_S):
+            umb.push_status(reporter, phase[0], progress[0])
+
+    threading.Thread(target=status_loop, daemon=True,
+                     name="umbilical-status").start()
+
+    def can_commit() -> bool:
+        return bool(tracker.call("umbilical_can_commit",
+                                 str(task.task_id), aid))
+
+    try:
+        out_path, index = "", {}
+        committed = True
+        if task.is_map:
+            from tpumr.mapred.map_task import run_map_task
+            local_dir = os.path.dirname(os.path.abspath(task_file))
+            out_path, index = run_map_task(conf, task, local_dir, reporter)
+            if task.num_reduces == 0:
+                committed = _commit(conf, task, can_commit)
+        else:
+            from tpumr.mapred.reduce_task import run_reduce_task
+            from tpumr.mapred.tasktracker import make_map_locator
+
+            locate = make_map_locator(
+                lambda cursor: tracker.call("umbilical_events", job_id,
+                                            cursor),
+                secret,
+                poll_s=conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0,
+                timeout_s=conf.get_int("tpumr.shuffle.timeout.ms",
+                                       600_000) / 1000.0)
+
+            def fetch(map_index: int, partition: int):
+                from tpumr.io import ifile
+                out = locate(map_index).call("get_map_output", job_id,
+                                             map_index, partition)
+                return ifile.iter_transferred_segment(out["data"],
+                                                      out["codec"])
+
+            run_reduce_task(conf, task, fetch, reporter)
+            phase[0] = "REDUCE"
+            committed = _commit(conf, task, can_commit)
+        stop.set()
+        final = {
+            "counters": reporter.counters.to_dict(),
+            "progress": 1.0,
+            "phase": phase[0],
+            "state": "SUCCEEDED" if committed else "KILLED",
+            "diagnostics": ("" if committed
+                            else "commit denied: another attempt won"),
+        }
+        tracker.call("umbilical_done", aid, final, job_id,
+                     task.partition, out_path, index)
+        return 0
+    except TaskKilledError:
+        stop.set()
+        _report_fail(tracker, aid, "KILLED",
+                     "attempt killed while running (preempted or "
+                     "superseded)")
+        return 0
+    except BaseException as e:  # noqa: BLE001 — task failure is data
+        stop.set()
+        diag = f"{type(e).__name__}: {e}\n" + traceback.format_exc(limit=8)
+        _report_fail(tracker, aid, "FAILED", diag)
+        return 1
+
+
+def _commit(conf: Any, task: Any, can_commit: Any) -> bool:
+    """Commit gate, child side (same contract as NodeRunner._commit): the
+    tracker proxies the grant to the master; a losing attempt aborts its
+    work dir and reports KILLED."""
+    from tpumr.mapred.output_formats import FileOutputCommitter
+    committer = FileOutputCommitter(conf)
+    aid = str(task.attempt_id)
+    if not committer.needs_commit(aid):
+        return True
+    if can_commit():
+        committer.commit_task(aid)
+        return True
+    committer.abort_task(aid)
+    return False
+
+
+def _report_fail(tracker: Any, aid: str, state: str, diag: str) -> None:
+    try:
+        tracker.call("umbilical_fail", aid, state, diag)
+    except Exception:  # noqa: BLE001 — tracker reaps us by exit code
+        pass
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m tpumr.mapred.child <task-file>",
+              file=sys.stderr)
+        return 2
+    return run_child(argv[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

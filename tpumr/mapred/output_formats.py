@@ -152,11 +152,16 @@ class FileOutputCommitter:
         return str(Path(self.out).child(TEMP_DIR).child(str(attempt_id)))
 
     def setup_task(self, attempt_id: str) -> str:
+        # no output dir (NullOutputFormat jobs): nothing to stage or commit
+        if self.fs is None:
+            return ""
         wd = self.work_dir(attempt_id)
         self.fs.mkdirs(wd)
         return wd
 
     def needs_commit(self, attempt_id: str) -> bool:
+        if self.fs is None:
+            return False
         wd = self.work_dir(attempt_id)
         return self.fs.exists(wd) and bool(self.fs.list_files(wd))
 

@@ -1,0 +1,200 @@
+"""Process-isolated task execution — the tracker side.
+
+≈ ``TaskRunner`` + ``JvmManager`` + the ``TaskController`` SPI (reference:
+src/mapred/org/apache/hadoop/mapred/TaskRunner.java:252 child cmdline,
+JvmManager.java:322-413 spawn/reap, TaskController.java DefaultTaskController
+vs setuid LinuxTaskController): builds the child command line, optionally
+routes the launch through the native setuid ``task-controller`` binary
+(native/task-controller/), watches the process, and settles the attempt's
+final status if the child died without reporting over the umbilical.
+
+Enabled per job or per tracker with ``tpumr.task.isolation=process``; the
+default stays in-process threads (tasktracker.py module docstring — TPU
+tasks and device-shuffle gang reduces always stay in-process because they
+must share the tracker's JAX runtime and HBM split cache). A crashing
+(segfault / os._exit / OOM-killed) child then costs one task attempt, not
+the tracker — the reference's whole reason for child JVMs.
+
+Launch-path contracts:
+
+- the child runs from a per-attempt sandbox dir (the same dir the
+  in-process path uses for spills), so the tracker can serve the map
+  output files after the child exits;
+- a bootstrap script with the tracker's ``sys.path`` baked in is execed
+  instead of ``-m``, because the task-controller clears the environment
+  (including PYTHONPATH) before exec;
+- the task file (conf + task + umbilical address + RPC secret) is written
+  0600 into the sandbox — the single file the setuid controller validates;
+- memory limits (``mapred.task.limit.maxrss.mb``) are enforced by the
+  shared TaskMemoryManager against the child pid — process kills, as the
+  reference's TaskMemoryManagerThread does, not cooperative checks.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any
+
+from tpumr.io.writable import serialize
+from tpumr.mapred.task import Task, TaskState, TaskStatus
+
+_BOOT_TEMPLATE = """\
+import sys
+sys.path[:0] = {path!r}
+from tpumr.mapred.child import main
+sys.exit(main([{task_file!r}]))
+"""
+
+
+def build_child_command(runner: Any, task_dir: str, task_file: str,
+                        log_path: str) -> "list[str]":
+    """Child argv; routed through the task-controller when the TRACKER
+    conf names one (the job conf is untrusted for launcher selection —
+    reference: LinuxTaskController reads its binary path from the tracker,
+    never the job)."""
+    boot = os.path.join(task_dir, "child_boot.py")
+    with open(boot, "w", encoding="utf-8") as f:
+        f.write(_BOOT_TEMPLATE.format(path=list(sys.path),
+                                      task_file=task_file))
+    cmd = [sys.executable, boot]
+    tc = runner.conf.get("mapred.task.tracker.task-controller")
+    if tc:
+        import getpass
+        user = runner.conf.get("tpumr.task.user") or getpass.getuser()
+        cmd = [tc, user, task_dir, log_path] + cmd
+    return cmd
+
+
+def run_task_in_process(runner: Any, job_id: str, task: Task,
+                        status: TaskStatus, conf: Any) -> None:
+    """Spawn + babysit one isolated attempt. The child reports its own
+    terminal state over the umbilical; this function only (a) relays
+    kill requests as process kills, (b) applies memory-limit kills, and
+    (c) declares FAILED when the child exits without having reported."""
+    aid = str(task.attempt_id)
+    task_dir = os.path.join(runner.local_root, job_id, aid)
+    os.makedirs(task_dir, exist_ok=True)
+
+    task_file = os.path.join(task_dir, "task.bin")
+    payload = serialize({
+        "job_id": job_id,
+        "task": task.to_dict(),
+        "conf": conf.to_dict(),
+        "tracker_host": runner.bind_host,
+        "tracker_port": runner.shuffle_port,
+        "secret": runner._rpc_secret or b"",
+    })
+    fd = os.open(task_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(payload)
+
+    log_path = os.path.join(task_dir, "child.log")
+    cmd = build_child_command(runner, task_dir, task_file, log_path)
+    open(log_path, "ab").close()
+    _prepare_sandbox_for_user(runner, task_dir)
+
+    mem_killed = []
+    with open(log_path, "ab") as log_f:
+        proc = subprocess.Popen(cmd, cwd=task_dir, stdout=log_f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+
+    limit_mb = conf.get_int("mapred.task.limit.maxrss.mb", 0)
+    manager = None
+    if limit_mb > 0:
+        from tpumr.mapred.node_health import GLOBAL_MEMORY_MANAGER
+        manager = GLOBAL_MEMORY_MANAGER
+
+        def mem_kill(_aid: str) -> None:
+            mem_killed.append(_aid)
+            _kill_tree(proc)
+
+        manager.register(aid, proc.pid, limit_mb * 1024 * 1024, mem_kill)
+
+    try:
+        while proc.poll() is None:
+            with runner.lock:
+                wants_kill = aid in runner._kill_requested
+            if wants_kill:
+                _kill_tree(proc)
+                break
+            time.sleep(0.1)
+        proc.wait()
+    finally:
+        if manager is not None:
+            manager.unregister(aid)
+
+    # settle: the child normally set a terminal state via umbilical_done/
+    # umbilical_fail; if it vanished first (segfault, os._exit, SIGKILL),
+    # the attempt is decided here
+    with runner.lock:
+        if status.state in TaskState.TERMINAL:
+            return
+        status.finish_time = time.time()
+        if mem_killed:
+            status.state = TaskState.FAILED
+            status.diagnostics = (
+                f"killed by memory manager: RSS exceeded {limit_mb} MB "
+                f"(mapred.task.limit.maxrss.mb)")
+        elif aid in runner._kill_requested:
+            status.state = TaskState.KILLED
+            status.diagnostics = "child killed on tracker request"
+        else:
+            status.state = TaskState.FAILED
+            status.diagnostics = (
+                f"child exited rc={proc.returncode} without reporting\n"
+                + _tail(log_path))
+
+
+def _prepare_sandbox_for_user(runner: Any, task_dir: str) -> None:
+    """When launching through the setuid task-controller as root, hand the
+    attempt sandbox to the task user before exec — the controller refuses
+    a task dir the target user does not own. This is the role of the
+    reference controller's INITIALIZE_TASK command (the tracker-side
+    Localizer chowns task dirs through it). Parent dirs get traverse-only
+    bits so the child can reach its sandbox but not list sibling jobs."""
+    tc = runner.conf.get("mapred.task.tracker.task-controller")
+    if not tc or os.geteuid() != 0:
+        return
+    import getpass
+    import pwd
+    user = runner.conf.get("tpumr.task.user") or getpass.getuser()
+    try:
+        pw = pwd.getpwnam(user)
+    except KeyError:
+        return
+    if pw.pw_uid == os.geteuid():
+        return
+    os.chmod(runner.local_root, 0o711)
+    os.chmod(os.path.dirname(task_dir), 0o711)
+    for root, dirs, files in os.walk(task_dir):
+        os.chown(root, pw.pw_uid, pw.pw_gid)
+        for name in files:
+            os.chown(os.path.join(root, name), pw.pw_uid, pw.pw_gid)
+
+
+def _kill_tree(proc: "subprocess.Popen[bytes]") -> None:
+    """Kill the child's whole session (it may have spawned pipes/streaming
+    grandchildren — reference kills the process TREE via the controller)."""
+    import signal
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def _tail(path: str, max_bytes: int = 4096) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""

@@ -15,10 +15,14 @@ apache/hadoop/mapred/TaskTracker.java, 4636 LoC). Reproduced contracts:
   shipped in every heartbeat;
 - the shuffle server role (MapOutputServlet :4050): map outputs are served
   per (job, map, partition) over the tracker's RPC port;
-- task execution in-process on threads (the reference forks child JVMs via
-  TaskRunner/JvmManager — an explicit re-design: kernels must share the
-  host process to share the JAX runtime and HBM split cache; subprocess
-  isolation remains available through the pipes/streaming tier).
+- task execution in-process on threads by default (the reference forks
+  child JVMs via TaskRunner/JvmManager — an explicit re-design: kernels
+  must share the host process to share the JAX runtime and HBM split
+  cache). ``tpumr.task.isolation=process`` opts CPU map/reduce attempts
+  into real child processes (process_runner.py ≈ TaskRunner/JvmManager,
+  child.py ≈ Child.java) talking back over the umbilical_* RPC methods
+  (≈ TaskUmbilicalProtocol), optionally launched through the native
+  setuid task-controller.
 """
 
 from __future__ import annotations
@@ -51,6 +55,41 @@ def _resolvable(host: str) -> bool:
         return True
     except OSError:
         return False
+
+
+def make_map_locator(events_fn: Any, secret: bytes | None,
+                     poll_s: float = 0.2, timeout_s: float = 600.0):
+    """Map-output location resolution ≈ the ReduceCopier's polling of
+    TaskCompletionEvents (ReduceTask.java:659 fetch loop). ``events_fn
+    (cursor) -> [event]`` is the master's incremental completion-event
+    feed (called directly by the tracker, via the umbilical by isolated
+    child processes). Returns ``locate(map_index) -> RpcClient`` bound to
+    the serving tracker's shuffle RPC."""
+    events: dict[int, dict] = {}
+    seen = [0]
+    clients: dict[str, RpcClient] = {}
+
+    def locate(map_index: int) -> RpcClient:
+        deadline = time.time() + timeout_s
+        while map_index not in events:
+            fresh = events_fn(seen[0])
+            seen[0] += len(fresh)
+            for e in fresh:
+                events[e["map_index"]] = e
+            if map_index in events:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"map {map_index} output never became available")
+            time.sleep(poll_s)
+        addr = events[map_index]["shuffle_addr"]
+        host, port = addr.rsplit(":", 1)
+        cli = clients.get(addr)
+        if cli is None:
+            cli = clients[addr] = RpcClient(host, int(port), secret=secret)
+        return cli
+
+    return locate
 
 
 class NodeRunner:
@@ -93,7 +132,13 @@ class NodeRunner:
         self._kill_requested: set[str] = set()
         self.map_outputs: dict[tuple[str, int], tuple[str, dict]] = {}
         self.job_confs: dict[str, JobConf] = {}
-        self.local_root = tempfile.mkdtemp(prefix=f"tpumr-{self.name}-")
+        # ≈ mapred.local.dir: tracker-local scratch root — when set it must
+        # match the task-controller's allowed.local.dirs policy
+        local_base = conf.get("mapred.local.dir")
+        if local_base:
+            os.makedirs(local_base, exist_ok=True)
+        self.local_root = tempfile.mkdtemp(prefix=f"tpumr-{self.name}-",
+                                           dir=local_base or None)
         self._response_id = 0
         self._initial_contact = True
         self._stop = threading.Event()
@@ -371,11 +416,31 @@ class NodeRunner:
         finally:
             sem.release()  # ≈ addFreeSlots on done/kill (:3401-3402)
 
+    def _isolate_in_process(self, conf: JobConf, task: Task) -> bool:
+        """Process isolation gate (≈ which tasks get a child JVM): opt-in
+        via ``tpumr.task.isolation=process`` (job conf first, tracker conf
+        fallback). TPU tasks and device-shuffle gang reduces always stay
+        in-process — they must share the tracker's JAX runtime, device
+        mesh, and HBM split cache."""
+        mode = conf.get("tpumr.task.isolation",
+                        self.conf.get("tpumr.task.isolation", "thread"))
+        if mode != "process" or task.run_on_tpu:
+            return False
+        if not task.is_map:
+            from tpumr.mapred.device_shuffle import is_device_shuffle
+            if is_device_shuffle(conf):
+                return False
+        return True
+
     def _run_task_inner(self, job_id: str, task: Task, status: TaskStatus,
                         reporter: Reporter) -> None:
         aid = str(task.attempt_id)
         try:
             conf = self._job_conf(job_id)
+            if self._isolate_in_process(conf, task):
+                from tpumr.mapred.process_runner import run_task_in_process
+                run_task_in_process(self, job_id, task, status, conf)
+                return
             committed = True
             if task.is_map:
                 local_dir = os.path.join(self.local_root, job_id, aid)
@@ -438,6 +503,69 @@ class NodeRunner:
         committer.abort_task(aid)
         return False
 
+    # ------------------------------------------------------------ umbilical
+    # child-process task protocol ≈ TaskUmbilicalProtocol (reference:
+    # mapred/TaskUmbilicalProtocol.java:65) on the tracker's existing
+    # authenticated RPC surface. The child NEVER talks to the master —
+    # commit grants and completion events are proxied, like the reference
+    # TaskTracker proxies commit/shuffle coordination for its children.
+
+    def umbilical_ping(self, attempt_id: str) -> bool:
+        """Kill-poll: True = the tracker wants this attempt gone."""
+        with self.lock:
+            return attempt_id in self._kill_requested
+
+    def umbilical_status(self, attempt_id: str, d: dict) -> bool:
+        """Periodic progress/counter push (≈ statusUpdate)."""
+        with self.lock:
+            st = self.running.get(attempt_id)
+            if st is None or st.state in TaskState.TERMINAL:
+                return False
+            st.phase = d.get("phase", st.phase)
+            st.progress = float(d.get("progress", st.progress))
+            if d.get("counters"):
+                st.counters = d["counters"]
+            return True
+
+    def umbilical_can_commit(self, task_id: str, attempt_id: str) -> bool:
+        """Commit-grant proxy (≈ commitPending → JobTracker.canCommit)."""
+        return bool(self.master.call("can_commit", task_id, attempt_id))
+
+    def umbilical_events(self, job_id: str, cursor: int) -> list:
+        """Map-completion-event proxy for isolated reduce children."""
+        return self.master.call("get_map_completion_events", job_id, cursor)
+
+    def umbilical_done(self, attempt_id: str, final: dict, job_id: str,
+                       partition: int, out_path: str, index: dict) -> None:
+        """Terminal report (≈ done): settle status, register map output."""
+        with self.lock:
+            st = self.running.get(attempt_id)
+            if st is not None and st.state not in TaskState.TERMINAL:
+                st.counters = final.get("counters", {})
+                st.progress = float(final.get("progress", 1.0))
+                st.phase = final.get("phase", st.phase)
+                st.diagnostics = final.get("diagnostics", "")
+                st.finish_time = time.time()
+                st.state = final.get("state", TaskState.SUCCEEDED)
+            if out_path:
+                # confine served paths to this tracker's scratch tree — the
+                # shuffle server must never be steerable at arbitrary files
+                real = os.path.realpath(out_path)
+                root = os.path.realpath(self.local_root) + os.sep
+                if real.startswith(root):
+                    self.map_outputs[(job_id, partition)] = (real, index)
+
+    def umbilical_fail(self, attempt_id: str, state: str,
+                       diagnostics: str) -> None:
+        """Failure/kill report (≈ fsError/fatalError)."""
+        with self.lock:
+            st = self.running.get(attempt_id)
+            if st is not None and st.state not in TaskState.TERMINAL:
+                st.diagnostics = diagnostics
+                st.finish_time = time.time()
+                st.state = (state if state in TaskState.TERMINAL
+                            else TaskState.FAILED)
+
     # ------------------------------------------------------------ shuffle
 
     def get_map_output(self, job_id: str, map_index: int,
@@ -477,36 +605,13 @@ class NodeRunner:
         """Resolve a map's serving tracker from the master's completion
         events (shared by the IFile and dense fetch paths): returns
         ``locate(map_index) -> RpcClient`` to the source tracker."""
-        events: dict[int, dict] = {}
-        seen = [0]  # incremental cursor into the master's event list
-        clients: dict[str, RpcClient] = {}
-        conf_secret = self._rpc_secret
-        poll_s = self.conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0
-        deadline = time.time() + self.conf.get_int(
-            "tpumr.shuffle.timeout.ms", 600_000) / 1000.0
-
-        def locate(map_index: int) -> RpcClient:
-            while map_index not in events:
-                fresh = self.master.call("get_map_completion_events",
-                                         job_id, seen[0])
-                seen[0] += len(fresh)
-                for e in fresh:
-                    events[e["map_index"]] = e
-                if map_index in events:
-                    break
-                if time.time() > deadline:
-                    raise TimeoutError(
-                        f"map {map_index} output never became available")
-                time.sleep(poll_s)
-            addr = events[map_index]["shuffle_addr"]
-            host, port = addr.rsplit(":", 1)
-            cli = clients.get(addr)
-            if cli is None:
-                cli = clients[addr] = RpcClient(host, int(port),
-                                                secret=conf_secret)
-            return cli
-
-        return locate
+        return make_map_locator(
+            lambda cursor: self.master.call("get_map_completion_events",
+                                            job_id, cursor),
+            self._rpc_secret,
+            poll_s=self.conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0,
+            timeout_s=self.conf.get_int("tpumr.shuffle.timeout.ms",
+                                        600_000) / 1000.0)
 
     def _remote_fetch_factory(self, job_id: str, task: Task):
         """Parallel-capable fetch ≈ ReduceCopier.MapOutputCopier: resolves
