@@ -1,0 +1,194 @@
+"""Multi-process cluster smoke test ≈ TestMiniMRWithDFS over REAL process
+boundaries: NameNode, DataNode, JobMaster, and two NodeRunners launched as
+separate OS processes via ``python -m tpumr.cli`` (the bin/hadoop analog,
+reference bin/hadoop:66-95 + hadoop-daemon.sh), then a wordcount submitted
+from this process with tdfs:// input and output.
+
+This is the seam the in-process MiniMRCluster cannot cover: daemon arg
+parsing, conf propagation through -D generic options, RPC (authenticated
+with a shared secret) across real process boundaries, tdfs reads/writes
+from tracker processes, and job history written by the master daemon.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+SECRET = "smoke-secret"
+
+
+class Daemon:
+    """One `python -m tpumr.cli <cmd>` child; parses its startup banner."""
+
+    def __init__(self, name, args, banner):
+        self.name = name
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tpumr.cli"] + args,
+            cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True)
+        self.banner = banner
+        self.banner_line = None
+        self.lines = []
+        self._reader = threading.Thread(target=self._drain, daemon=True)
+        self._reader.start()
+
+    def _drain(self):
+        for line in self.proc.stderr:
+            self.lines.append(line.rstrip())
+            if self.banner in line and self.banner_line is None:
+                self.banner_line = line.strip()
+
+    def wait_up(self, timeout=30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.banner_line is not None:
+                return self.banner_line
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{self.name} died rc={self.proc.returncode}:\n"
+                    + "\n".join(self.lines[-20:]))
+            time.sleep(0.05)
+        raise TimeoutError(f"{self.name} never printed {self.banner!r}:\n"
+                           + "\n".join(self.lines[-20:]))
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def _port_from(line, prefix_split):
+    # e.g. "NameNode up at tdfs://127.0.0.1:38291/" -> 38291
+    frag = line.split(prefix_split, 1)[1]
+    return int(frag.split("/", 1)[0].rsplit(":", 1)[1])
+
+
+@pytest.fixture(scope="module")
+def cluster_procs(tmp_path_factory):
+    work = tmp_path_factory.mktemp("mpsmoke")
+    daemons = []
+    common = ["-D", f"tpumr.rpc.secret={SECRET}",
+              "-D", "dfs.replication=1",
+              "-D", "tpumr.heartbeat.interval.ms=200"]
+    try:
+        nn = Daemon("namenode", common + [
+            "namenode", "-dir", str(work / "name"), "-port", "0"],
+            "NameNode up at ")
+        daemons.append(nn)
+        nn_port = _port_from(nn.wait_up(), "tdfs://")
+
+        dn = Daemon("datanode", common + [
+            "datanode", "-nn", f"127.0.0.1:{nn_port}",
+            "-dir", str(work / "data")], "DataNode up ")
+        daemons.append(dn)
+        dn.wait_up()
+
+        jt = Daemon("jobtracker", common + [
+            "-D", f"tpumr.history.dir={work / 'history'}",
+            "-D", f"fs.default.name=tdfs://127.0.0.1:{nn_port}/",
+            "jobtracker", "-port", "0"], "JobMaster up at ")
+        daemons.append(jt)
+        jt_port = _port_from(jt.wait_up() + "/", "up at ")
+
+        for i in range(2):
+            tt = Daemon(f"tasktracker{i}", common + [
+                "-D", "mapred.tasktracker.map.cpu.tasks.maximum=2",
+                "-D", f"mapred.local.dir={work / f'local{i}'}",
+                "tasktracker", "-jt", f"127.0.0.1:{jt_port}"],
+                "NodeRunner up")
+            daemons.append(tt)
+            tt.wait_up()
+
+        yield {"nn_port": nn_port, "jt_port": jt_port, "work": work}
+    finally:
+        for d in reversed(daemons):
+            d.stop()
+
+
+def _client_conf(cluster_procs):
+    from tpumr.mapred.jobconf import JobConf
+    conf = JobConf()
+    conf.set("tpumr.rpc.secret", SECRET)
+    conf.set("dfs.replication", 1)
+    conf.set("fs.default.name",
+             f"tdfs://127.0.0.1:{cluster_procs['nn_port']}/")
+    conf.set("mapred.job.tracker", f"127.0.0.1:{cluster_procs['jt_port']}")
+    return conf
+
+
+def test_wordcount_across_real_processes(cluster_procs):
+    from tpumr.fs import get_filesystem
+    from tpumr.mapred.job_client import JobClient
+
+    conf = _client_conf(cluster_procs)
+    nn = cluster_procs["nn_port"]
+    fs = get_filesystem(f"tdfs://127.0.0.1:{nn}/", conf)
+    fs.mkdirs("/smoke")
+    fs.write_bytes("/smoke/in.txt", b"alpha beta\nbeta gamma\n" * 100)
+
+    jconf = _client_conf(cluster_procs)
+    jconf.set_job_name("mp-smoke-wordcount")
+    jconf.set_input_paths(f"tdfs://127.0.0.1:{nn}/smoke/in.txt")
+    jconf.set_output_path(f"tdfs://127.0.0.1:{nn}/smoke/out")
+    jconf.set("mapred.mapper.class",
+              "tpumr.ops.wordcount.WordCountCpuMapper")
+    jconf.set("mapred.reducer.class",
+              "tpumr.examples.basic.LongSumReducer")
+    jconf.set("mapred.min.split.size", 1)
+    jconf.set("mapred.map.tasks", 2)
+    jconf.set_num_reduce_tasks(2)
+
+    result = JobClient(jconf).run_job(jconf)
+    assert result.successful
+
+    counts = {}
+    parts = 0
+    for st in fs.list_files("/smoke/out"):
+        if st.path.name.startswith("part-"):
+            parts += 1
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                k, v = line.split("\t")
+                counts[k] = int(v)
+    assert parts == 2
+    assert counts == {"alpha": 100, "beta": 200, "gamma": 100}
+
+    # history written by the MASTER process, one JOB_FINISHED event
+    hist_dir = cluster_procs["work"] / "history"
+    hist_files = list(hist_dir.glob("job_*.jsonl"))
+    assert hist_files, "job tracker process wrote no history"
+    events = [json.loads(line)
+              for f in hist_files for line in f.read_text().splitlines()]
+    kinds = {e.get("event") for e in events}
+    assert "JOB_FINISHED" in kinds or "JOB_SUBMITTED" in kinds, kinds
+
+
+def test_job_cli_lists_job_from_other_process(cluster_procs):
+    """`tpumr job -list` (the bin/hadoop job analog) against the live
+    master daemon — exercises the client CLI over the same secret."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpumr.cli",
+         "-D", f"tpumr.rpc.secret={SECRET}",
+         "-jt", f"127.0.0.1:{cluster_procs['jt_port']}",
+         "job", "-list"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "job_" in r.stdout
+    assert "SUCCEEDED" in r.stdout

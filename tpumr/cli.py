@@ -133,8 +133,10 @@ def cmd_datanode(conf, argv: list[str]) -> int:
     from tpumr.dfs.datanode import DataNode
     a = _kv_args(argv)
     host, port = _host_port(a["nn"])
+    if "capacity" in a:
+        conf.set("tdfs.datanode.capacity", a["capacity"])
     dn = DataNode(host, port, a.get("dir", "/tmp/tpumr-data"),
-                  capacity=int(a.get("capacity", 1 << 34))).start()
+                  conf).start()
     print(f"DataNode up ({dn.addr}), reporting to {a['nn']}", file=sys.stderr)
     return _serve_forever(dn.stop)
 
@@ -190,7 +192,8 @@ def cmd_balancer(conf, argv: list[str]) -> int:
     a = _kv_args(argv)
     host, port = _host_port(a["nn"])
     moved = Balancer(host, port,
-                     threshold=float(a.get("threshold", 0.1))).balance()
+                     threshold=float(a.get("threshold", 0.1)),
+                     conf=conf).balance()
     print(f"Balancer moved {moved} blocks")
     return 0
 
