@@ -176,8 +176,13 @@ def test_wordcount_across_real_processes(cluster_procs):
     kinds = {e.get("event") for e in events}
     assert "JOB_FINISHED" in kinds or "JOB_SUBMITTED" in kinds, kinds
 
+    # the `tpumr job -list` CLI sees the finished job from yet another
+    # process (folded in here so the assertion does not depend on test
+    # ordering — the module fixture starts a master with zero jobs)
+    _assert_job_cli_lists(cluster_procs)
 
-def test_job_cli_lists_job_from_other_process(cluster_procs):
+
+def _assert_job_cli_lists(cluster_procs):
     """`tpumr job -list` (the bin/hadoop job analog) against the live
     master daemon — exercises the client CLI over the same secret."""
     env = dict(os.environ)

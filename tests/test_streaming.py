@@ -112,3 +112,131 @@ def test_streaming_combiner():
                                  TaskCounter.COMBINE_INPUT_RECORDS) == 60
     assert result.counters.value(TaskCounter.FRAMEWORK_GROUP,
                                  TaskCounter.COMBINE_OUTPUT_RECORDS) == 1
+
+
+# ------------------------------------------------------------- typed-bytes
+
+TB_MAPPER = (f"{PY} -c \"import sys\n"
+             "sys.path[:0] = {path!r}\n"
+             "from tpumr.streaming.typedbytes import read_pairs, write_pair\n"
+             "for k, v in read_pairs(sys.stdin.buffer):\n"
+             "    v = v.encode() if isinstance(v, str) else v\n"
+             "    write_pair(sys.stdout.buffer, v, bytes([0]) + v + b'\\\\n' + v)\n"
+             "sys.stdout.buffer.flush()\"")
+
+TB_REDUCER = (f"{PY} -c \"import sys\n"
+              "sys.path[:0] = {path!r}\n"
+              "from tpumr.streaming.typedbytes import read_pairs, write_pair\n"
+              "for k, v in read_pairs(sys.stdin.buffer):\n"
+              "    write_pair(sys.stdout.buffer, k, v)\n"
+              "sys.stdout.buffer.flush()\"")
+
+
+def test_typedbytes_roundtrip_all_types():
+    """Codec roundtrip ≈ typedbytes/TestTypedBytesInput: every supported
+    type, including byte strings with embedded NUL/TAB/NL."""
+    import io as _io
+
+    from tpumr.streaming.typedbytes import read_typed, write_typed
+
+    values = [
+        b"",
+        b"embedded\x00nul\ttab\nnewline\xff\xfe",
+        True, False,
+        0, -1, 2**31 - 1, -(2**31), 2**31, -(2**63),  # INT edge + LONG
+        3.5, -0.0,
+        "unicode é中",
+        (1, "two", b"\x00three"),          # VECTOR
+        [b"\n", [1, 2], "nested"],          # LIST (nested)
+        {b"k\x00": b"v\n", "n": 1},        # MAP
+    ]
+    buf = _io.BytesIO()
+    for v in values:
+        write_typed(buf, v)
+    buf.seek(0)
+    out = [read_typed(buf) for _ in values]
+    assert out == values
+    import pytest as _pytest
+    with _pytest.raises(EOFError):
+        read_typed(buf)
+
+
+def test_typedbytes_wire_format_is_reference_compatible():
+    """Byte-level check against Type.java codes so reference typed-bytes
+    tools interoperate: code byte + big-endian payloads."""
+    import io as _io
+    import struct
+
+    from tpumr.streaming.typedbytes import write_typed
+
+    def enc(v):
+        b = _io.BytesIO()
+        write_typed(b, v)
+        return b.getvalue()
+
+    assert enc(b"ab") == b"\x00" + struct.pack(">i", 2) + b"ab"
+    assert enc(True) == b"\x02\x01"
+    assert enc(7) == b"\x03" + struct.pack(">i", 7)
+    assert enc(2**40) == b"\x04" + struct.pack(">q", 2**40)
+    assert enc(1.5) == b"\x06" + struct.pack(">d", 1.5)
+    assert enc("hi") == b"\x07" + struct.pack(">i", 2) + b"hi"
+    assert enc([1]) == b"\x09" + enc(1) + b"\xff"
+
+
+def test_typedbytes_streaming_job_binary_safe(tmp_path):
+    """End-to-end -io typedbytes job: values with embedded \\n and \\0
+    survive the child pipes byte-for-byte (the exact records the line
+    protocol cannot carry). Output via SequenceFile stays binary-safe."""
+    from tpumr.io import sequencefile
+    from tpumr.mapred.output_formats import SequenceFileOutputFormat
+    import sys as _sys
+
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/tb/in.txt", b"r1\nr2\nr3\n")
+    conf = JobConf()
+    conf.set_input_paths("mem:///tb/in.txt")
+    conf.set_output_path("mem:///tb/out")
+    conf.set_num_reduce_tasks(1)
+    conf.set_output_format(SequenceFileOutputFormat)
+    path = list(_sys.path)
+    setup_stream_job(conf,
+                     mapper=TB_MAPPER.replace("{path!r}", repr(path)),
+                     reducer=TB_REDUCER.replace("{path!r}", repr(path)),
+                     io="typedbytes")
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+
+    recs = {}
+    for st in fs.list_files("mem:///tb/out"):
+        if st.path.name.startswith("part-"):
+            with fs.open(st.path) as f:
+                for k, v in sequencefile.Reader(f):
+                    recs[k] = v
+    expected = {f"r{i}".encode():
+                b"\x00" + f"r{i}".encode() + b"\n" + f"r{i}".encode()
+                for i in (1, 2, 3)}
+    assert recs == expected
+
+
+def test_typedbytes_protocol_error_fails_task(tmp_path):
+    """A child that emits a dangling key (truncated pair) must FAIL the
+    task — not hang the reader thread or silently drop output."""
+    import sys as _sys
+
+    import pytest as _pytest
+
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/tberr/in.txt", b"a\n")
+    conf = JobConf()
+    conf.set_input_paths("mem:///tberr/in.txt")
+    conf.set_output_path("mem:///tberr/out")
+    conf.set_num_reduce_tasks(0)
+    conf.set("mapred.map.max.attempts", 1)
+    bad_mapper = (f"{PY} -c \"import sys\n"
+                  f"sys.path[:0] = {list(_sys.path)!r}\n"
+                  "from tpumr.streaming.typedbytes import write_typed\n"
+                  "write_typed(sys.stdout.buffer, b'lone-key')\n"
+                  "sys.stdout.buffer.flush()\"")
+    setup_stream_job(conf, mapper=bad_mapper, io="typedbytes")
+    with _pytest.raises(RuntimeError):
+        JobClient(conf).run_job(conf)

@@ -133,8 +133,7 @@ def cmd_datanode(conf, argv: list[str]) -> int:
     from tpumr.dfs.datanode import DataNode
     a = _kv_args(argv)
     host, port = _host_port(a["nn"])
-    if "capacity" in a:
-        conf.set("tdfs.datanode.capacity", a["capacity"])
+    conf.set("tdfs.datanode.capacity", int(a.get("capacity", 1 << 34)))
     dn = DataNode(host, port, a.get("dir", "/tmp/tpumr-data"),
                   conf).start()
     print(f"DataNode up ({dn.addr}), reporting to {a['nn']}", file=sys.stderr)

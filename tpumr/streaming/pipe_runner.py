@@ -25,6 +25,7 @@ import threading
 from typing import Any, BinaryIO
 
 from tpumr.mapred.api import MapRunnable, OutputCollector, Reducer, Reporter
+from tpumr.streaming.typedbytes import read_pairs, write_pair
 
 
 def _child_env(conf: Any) -> dict:
@@ -68,31 +69,54 @@ def _stderr_pump(stream: BinaryIO, reporter: Reporter) -> threading.Thread:
 
 class _StreamProcess:
     """One child + stdin writer / stdout reader plumbing shared by the map
-    and reduce sides."""
+    and reduce sides. ``in_mode``/``out_mode`` select the wire format each
+    direction: "text" (key<TAB>value lines) or "typedbytes" (binary-safe
+    typed frames ≈ -io typedbytes, typedbytes/TypedBytesInput.java)."""
 
     def __init__(self, conf: Any, command: str, output: OutputCollector,
-                 reporter: Reporter) -> None:
+                 reporter: Reporter, in_mode: str = "text",
+                 out_mode: str = "text") -> None:
         self.sep = conf.get("stream.map.output.field.separator", "\t") \
             .encode("utf-8")
+        self.in_mode = in_mode
+        self.out_mode = out_mode
         self.proc = subprocess.Popen(
             shlex.split(command), env=_child_env(conf),
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE)
         self._err_thread = _stderr_pump(self.proc.stderr, reporter)
+        self._out_error: BaseException | None = None
         self._out_thread = threading.Thread(
             target=self._drain_stdout, args=(output,),
             name="stream-stdout", daemon=True)
         self._out_thread.start()
 
     def _drain_stdout(self, output: OutputCollector) -> None:
-        for raw in self.proc.stdout:
-            line = raw.rstrip(b"\n")
-            if not line:
-                continue
-            k, v = _split_line(line, self.sep)
-            output.collect(k, v)
+        try:
+            if self.out_mode == "typedbytes":
+                for k, v in read_pairs(self.proc.stdout):
+                    output.collect(k, v)
+                return
+            for raw in self.proc.stdout:
+                line = raw.rstrip(b"\n")
+                if not line:
+                    continue
+                k, v = _split_line(line, self.sep)
+                output.collect(k, v)
+        except BaseException as e:  # noqa: BLE001 — surfaced by finish()
+            self._out_error = e
+            # keep draining so a still-writing child never blocks on a
+            # full pipe (which would hang finish()'s proc.wait forever)
+            try:
+                while self.proc.stdout.read(65536):
+                    pass
+            except OSError:
+                pass
 
     def write_record(self, key: Any, value: Any) -> None:
+        if self.in_mode == "typedbytes":
+            write_pair(self.proc.stdin, key, value)
+            return
         self.proc.stdin.write(f"{key}\t{value}\n".encode("utf-8"))
 
     def write_line(self, value: Any) -> None:
@@ -107,6 +131,10 @@ class _StreamProcess:
             raise RuntimeError(
                 f"streaming {what} exited rc={rc} "
                 f"(≈ PipeMapRed 'subprocess failed with code')")
+        if self._out_error is not None:
+            raise RuntimeError(
+                f"streaming {what} output protocol error: "
+                f"{self._out_error}") from self._out_error
 
 
 class StreamMapRunner(MapRunnable):
@@ -123,12 +151,16 @@ class StreamMapRunner(MapRunnable):
         command = self.conf.get("stream.map.command")
         if not command:
             raise ValueError("streaming job missing stream.map.command")
+        in_mode = self.conf.get("stream.map.input", "text")
+        out_mode = self.conf.get("stream.map.output", "text")
         # text input feeds the child only the line, not the byte offset
-        # (≈ PipeMapper.ignoreKey for TextInputFormat)
-        ignore_key = self.conf.get_boolean(
+        # (≈ PipeMapper.ignoreKey for TextInputFormat); typed-bytes input
+        # always frames full pairs (≈ PipeMapper with -io typedbytes)
+        ignore_key = in_mode != "typedbytes" and self.conf.get_boolean(
             "stream.map.input.ignoreKey",
             self.conf.get_input_format().__name__ == "TextInputFormat")
-        child = _StreamProcess(self.conf, command, output, reporter)
+        child = _StreamProcess(self.conf, command, output, reporter,
+                               in_mode=in_mode, out_mode=out_mode)
         try:
             for key, value in reader:
                 if ignore_key:
@@ -155,7 +187,10 @@ class StreamReducer(Reducer):
             command = self.conf.get("stream.reduce.command")
             if not command:
                 raise ValueError("streaming job missing stream.reduce.command")
-            self._child = _StreamProcess(self.conf, command, output, reporter)
+            self._child = _StreamProcess(
+                self.conf, command, output, reporter,
+                in_mode=self.conf.get("stream.reduce.input", "text"),
+                out_mode=self.conf.get("stream.reduce.output", "text"))
         for v in values:
             self._child.write_record(key, v)
 
@@ -176,6 +211,9 @@ class StreamCombiner(StreamReducer):
             command = self.conf.get("stream.combine.command")
             if not command:
                 raise ValueError("streaming job missing stream.combine.command")
-            self._child = _StreamProcess(self.conf, command, output, reporter)
+            self._child = _StreamProcess(
+                self.conf, command, output, reporter,
+                in_mode=self.conf.get("stream.reduce.input", "text"),
+                out_mode=self.conf.get("stream.reduce.output", "text"))
         for v in values:
             self._child.write_record(key, v)

@@ -13,7 +13,8 @@ from tpumr.mapred.jobconf import JobConf
 
 def setup_stream_job(conf: JobConf, mapper: str | None = None,
                      reducer: str | None = None,
-                     combiner: str | None = None) -> None:
+                     combiner: str | None = None,
+                     io: str | None = None) -> None:
     from tpumr.streaming.pipe_runner import (StreamCombiner, StreamMapRunner,
                                              StreamReducer)
     if mapper:
@@ -25,6 +26,14 @@ def setup_stream_job(conf: JobConf, mapper: str | None = None,
     if combiner:
         conf.set("stream.combine.command", combiner)
         conf.set_combiner_class(StreamCombiner)
+    if io:
+        # ≈ StreamJob -io typedbytes: one flag sets all four directions
+        if io not in ("text", "typedbytes"):
+            raise ValueError(f"unknown -io format {io!r} "
+                             "(expected text or typedbytes)")
+        for key in ("stream.map.input", "stream.map.output",
+                    "stream.reduce.input", "stream.reduce.output"):
+            conf.set(key, io)
 
 
 class StreamJob:
@@ -60,6 +69,8 @@ def main(argv: list[str]) -> int:
     ap.add_argument("-reducer", dest="reducer", default=None)
     ap.add_argument("-combiner", dest="combiner", default=None)
     ap.add_argument("-numReduceTasks", dest="reduces", type=int, default=1)
+    ap.add_argument("-io", dest="io", default=None,
+                    choices=["text", "typedbytes"])
     ap.add_argument("-jobconf", "-D", dest="jobconf", action="append",
                     default=[])
     args = ap.parse_args(argv)
@@ -72,7 +83,7 @@ def main(argv: list[str]) -> int:
         k, _, v = kv.partition("=")
         conf.set(k.strip(), v.strip())
     setup_stream_job(conf, mapper=args.mapper, reducer=args.reducer,
-                     combiner=args.combiner)
+                     combiner=args.combiner, io=args.io)
     from tpumr.mapred.job_client import JobClient
     result = JobClient(conf).run_job(conf)
     return 0 if result.successful else 1
