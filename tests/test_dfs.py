@@ -144,7 +144,7 @@ def test_namenode_restart_recovers_namespace():
 
 def test_secondary_checkpoint():
     import os
-    from tpumr.dfs.editlog import EDITS_NAME
+    from tpumr.dfs.editlog import list_segments
     from tpumr.dfs.secondary import SecondaryNameNode
     with MiniDFSCluster(num_datanodes=1,
                         conf=small_conf(replication=1)) as c:
@@ -152,13 +152,17 @@ def test_secondary_checkpoint():
         for i in range(5):
             with client.create(f"/ckpt/f{i}") as f:
                 f.write(b"data")
-        edits_path = os.path.join(c.root, "name", EDITS_NAME)
-        assert os.path.getsize(edits_path) > 0
+        name_dir = os.path.join(c.root, "name")
+
+        def journal_bytes():
+            return sum(os.path.getsize(p) for p in list_segments(name_dir))
+
+        assert journal_bytes() > 0
         snn = SecondaryNameNode(c.nn_host, c.nn_port,
                                 os.path.join(c.root, "secondary"))
         snn.do_checkpoint()
-        # journal rolled; namespace survives restart from merged image
-        assert os.path.getsize(edits_path) == 0
+        # merged segments purged; namespace survives restart from image
+        assert journal_bytes() == 0
         with client.create("/ckpt/after") as f:
             f.write(b"post-checkpoint")
         c.restart_namenode()
@@ -213,3 +217,189 @@ def test_mapreduce_on_tdfs():
                     k, v = line.split("\t")
                     out[k] = int(v)
         assert out == {"dfs": 120, "tpu": 80, "mr": 40}
+
+
+# ----------------------------------------------------- hardening (round 2)
+
+
+def test_fsck_reports_under_replicated_and_missing(tmp_path):
+    """≈ NamenodeFsck: healthy → under-replicated (DN death) → healthy
+    again after re-replication... then missing when all replicas die."""
+    conf = small_conf(replication=2)
+    with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+        client = c.client()
+        with client.create("/fsck/f", replication=2) as f:
+            f.write(b"j" * 3000)  # 3 blocks
+        r = client.fsck("/")
+        assert r["healthy"] and r["files"] == 1 and r["blocks"] == 3
+        assert not r["under_replicated"] and not r["missing"]
+
+        c.datanodes[0].stop()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = client.fsck("/fsck")
+            if r["under_replicated"] or r["missing"]:
+                break
+            time.sleep(0.2)
+        assert r["under_replicated"], r
+        assert r["healthy"]  # degraded but nothing lost
+
+        c.datanodes[1].stop()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = client.fsck("/")
+            if len(r["missing"]) == 3:
+                break
+            time.sleep(0.2)
+        assert len(r["missing"]) == 3
+        assert not r["healthy"]
+
+
+def test_permissions_owner_mode_enforced(tmp_path):
+    """Owner/mode checks ≈ FSPermissionChecker: a non-owner cannot write
+    into a 0755 dir, delete another user's file, or chmod it; the owner
+    and the superuser can."""
+    from tpumr.ipc.rpc import RpcError
+    from tpumr.security import UserGroupInformation
+
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        alice = UserGroupInformation("alice")
+        bob = UserGroupInformation("bob")
+
+        client = c.client()
+        # root is superuser-owned 0755 (like a formatted HDFS namespace):
+        # the admin provisions the user's home, like `hadoop fs -mkdir
+        # /home/alice && -chown alice` — alice alone could not
+        with bob.do_as():
+            with pytest.raises(RpcError, match="PermissionError"):
+                client.mkdirs("/home/bob")
+        client.mkdirs("/home/alice")
+        client.set_owner("/home/alice", "alice")
+        with alice.do_as():
+            with client.create("/home/alice/secret") as f:
+                f.write(b"mine")
+            st = client.get_status("/home/alice")
+            assert st["owner"] == "alice"
+
+        with bob.do_as():
+            with pytest.raises(RpcError, match="PermissionError"):
+                client.create("/home/alice/intruder").close()
+            with pytest.raises(RpcError, match="PermissionError"):
+                client.delete("/home/alice/secret")
+            with pytest.raises(RpcError, match="PermissionError"):
+                client.nn.call("set_permission", "/home/alice/secret", 0o777)
+
+        # owner chmods the dir open, bob can now create
+        with alice.do_as():
+            client.nn.call("set_permission", "/home/alice", 0o777)
+        with bob.do_as():
+            client.create("/home/alice/guestbook").close()
+            st = client.get_status("/home/alice/guestbook")
+            assert st["owner"] == "bob"
+
+        # superuser (the test process user running the NN) bypasses all
+        client.delete("/home/alice/secret")
+        assert not client.exists("/home/alice/secret")
+
+
+def test_permission_read_denied(tmp_path):
+    from tpumr.ipc.rpc import RpcError
+    from tpumr.security import UserGroupInformation
+
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        client = c.client()
+        alice = UserGroupInformation("alice")
+        bob = UserGroupInformation("bob")
+        client.mkdirs("/p")
+        client.set_permission("/p", 0o777)
+        with alice.do_as():
+            with client.create("/p/private") as f:
+                f.write(b"top secret")
+            client.nn.call("set_permission", "/p/private", 0o600)
+        with bob.do_as():
+            with pytest.raises(RpcError, match="PermissionError"):
+                with client.open("/p/private") as f:
+                    f.read()
+        with alice.do_as():
+            with client.open("/p/private") as f:
+                assert f.read() == b"top secret"
+
+
+def test_edit_log_segments_stay_bounded(tmp_path):
+    """Size-bounded journal ≈ FSEditLog roll semantics: segments roll at
+    the configured size; a checkpoint purges merged segments so the
+    journal never grows without bound; state survives restart."""
+    import os
+
+    from tpumr.dfs.editlog import list_segments
+    from tpumr.dfs.namenode import FSNamesystem
+
+    conf = small_conf()
+    conf.set("tdfs.edits.segment.mb", 2 / 1024)  # 2 KiB segments
+    name_dir = str(tmp_path / "name")
+    ns = FSNamesystem(name_dir, conf)
+    for i in range(200):
+        ns.mkdirs(f"/d{i:04d}")
+    segs = list_segments(name_dir)
+    assert len(segs) > 2, "journal never rolled"
+    assert all(os.path.getsize(s) < 4096 for s in segs[:-1])
+
+    before = ns.edits.total_bytes()
+    ns.save_namespace()
+    assert ns.edits.total_bytes() < before / 10, "checkpoint did not purge"
+
+    # restart from image + remaining segments: nothing lost
+    ns.edits.close()
+    ns2 = FSNamesystem(name_dir, conf)
+    assert sum(1 for p in ns2.namespace if p.startswith("/d")) == 200
+
+
+def test_secondary_checkpoint_with_segments(tmp_path):
+    """The 2NN cycle over the segmented journal: fetch seals segments,
+    upload purges exactly those; edits during the cycle survive."""
+    from tpumr.dfs.secondary import SecondaryNameNode
+
+    conf = small_conf(replication=1)
+    conf.set("tdfs.edits.segment.mb", 2 / 1024)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        client = c.client()
+        for i in range(60):
+            client.mkdirs(f"/pre{i}")
+        host, port = c.namenode.address
+        snn = SecondaryNameNode(host, port, str(tmp_path / "2nn"), conf=conf)
+        snn.do_checkpoint()
+        for i in range(5):
+            client.mkdirs(f"/post{i}")
+        # restart the namesystem from disk: both epochs present
+        from tpumr.dfs.namenode import FSNamesystem
+        c.namenode.ns.edits.close()
+        ns2 = FSNamesystem(c.namenode.ns.name_dir, conf)
+        assert "/pre59" in ns2.namespace
+        assert "/post4" in ns2.namespace
+
+
+def test_edit_log_torn_tail_recovery(tmp_path):
+    """A crash mid-append leaves a torn last line; recovery must not
+    append new ops AFTER the torn fragment (they would be skipped on the
+    next replay while later segments still apply)."""
+    from tpumr.dfs.namenode import FSNamesystem
+
+    conf = small_conf()
+    name_dir = str(tmp_path / "name")
+    ns = FSNamesystem(name_dir, conf)
+    ns.mkdirs("/before")
+    seg = ns.edits.path
+    ns.edits.close()
+    with open(seg, "ab") as f:  # simulate the crash: torn tail
+        f.write(b'{"op":"mkd')
+
+    ns2 = FSNamesystem(name_dir, conf)
+    assert "/before" in ns2.namespace
+    assert ns2.edits.path != seg, "reopened the torn segment for append"
+    ns2.mkdirs("/after")
+    ns2.edits.close()
+
+    ns3 = FSNamesystem(name_dir, conf)
+    assert "/before" in ns3.namespace and "/after" in ns3.namespace

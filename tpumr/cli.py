@@ -35,6 +35,7 @@ Clients:
   fs -CMD ...          filesystem shell (tpumr fs -help for commands)
   job ...              job control: -list | -status ID | -kill ID | -counters ID
   balancer -nn HOST:PORT                     rebalance tdfs blocks
+  fsck [PATH]          tdfs health report (missing/under-replicated blocks)
   pipes ...            submit an external-binary (pipes) job
   streaming ...        submit a script (streaming) job
   examples NAME ...    run an example program (examples -h lists them)
@@ -258,6 +259,44 @@ def cmd_job(conf, argv: list[str]) -> int:
     return 255
 
 
+def cmd_fsck(conf, argv: list[str]) -> int:
+    """≈ bin/hadoop fsck: namespace health report from the NameNode
+    (reference: hdfs/server/namenode/NamenodeFsck.java)."""
+    from tpumr.fs import get_filesystem
+    from tpumr.fs.shell import FsShell
+    target = argv[0] if argv else "/"
+    # same resolution rules as the fs shell (relative paths against
+    # fs.default.name) — no hand-rolled URI gluing
+    uri = FsShell(conf,
+                  default_fs=conf.get("fs.default.name"))._resolve(target)
+    if "://" not in uri:
+        print("fsck: no filesystem given — pass a tdfs:// path or set "
+              "fs.default.name (-fs tdfs://HOST:PORT/)", file=sys.stderr)
+        return 255
+    fs = get_filesystem(uri, conf)
+    fsck = getattr(fs, "fsck", None)
+    if fsck is None:
+        print(f"fsck: only meaningful on tdfs:// (got {uri})",
+              file=sys.stderr)
+        return 255
+    r = fsck(uri)
+    print(f"FSCK started for path {target}")
+    print(f" Total dirs:\t{r['dirs']}")
+    print(f" Total files:\t{r['files']}")
+    print(f" Total blocks:\t{r['blocks']} (size {r['size']} B)")
+    print(f" Under-replicated blocks:\t{len(r['under_replicated'])}")
+    print(f" Over-replicated blocks:\t{len(r['over_replicated'])}")
+    print(f" Missing blocks:\t{len(r['missing'])}")
+    print(f" Corrupt blocks:\t{len(r['corrupt'])}")
+    print(f" Files open for write:\t{len(r['open_files'])}")
+    for kind in ("under_replicated", "missing", "corrupt"):
+        for ent in r[kind]:
+            print(f"  {kind}: block {ent['block_id']} of {ent['path']}")
+    print(f"The filesystem under path '{target}' is "
+          + ("HEALTHY" if r["healthy"] else "CORRUPT"))
+    return 0 if r["healthy"] else 1
+
+
 def cmd_gridmix(conf, argv: list[str]) -> int:
     from tpumr.benchmarks.gridmix import main as gridmix_main
     return gridmix_main(argv)
@@ -306,6 +345,7 @@ COMMANDS = {
     "tasktracker": cmd_tasktracker,
     "historyserver": cmd_historyserver,
     "balancer": cmd_balancer,
+    "fsck": cmd_fsck,
     "fs": cmd_fs,
     "job": cmd_job,
     "pipes": cmd_pipes,

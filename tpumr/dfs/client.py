@@ -113,6 +113,16 @@ class DFSClient:
     def set_replication(self, path: str, replication: int) -> bool:
         return self.nn.call("set_replication", path, replication)
 
+    def set_permission(self, path: str, mode: int) -> None:
+        self.nn.call("set_permission", path, mode)
+
+    def set_owner(self, path: str, owner: "str | None" = None,
+                  group: "str | None" = None) -> None:
+        self.nn.call("set_owner", path, owner, group)
+
+    def fsck(self, path: str = "/") -> dict:
+        return self.nn.call("fsck", path)
+
     def datanode_report(self) -> list[dict]:
         return self.nn.call("datanode_report")
 
@@ -245,6 +255,14 @@ class _DFSInputStream(io.RawIOBase):
                     "read_block", blk["block_id"], offset, length)
             except Exception as e:  # noqa: BLE001 — dead/corrupt replica
                 last_err = e
+                if "checksum" in str(e).lower():
+                    # tell the NameNode so it drops the corrupt replica
+                    # and re-replicates (≈ ClientProtocol.reportBadBlocks)
+                    try:
+                        self.client.nn.call("report_bad_block",
+                                            blk["block_id"], addr)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
                 continue
         raise IOError(f"all replicas failed for block {blk['block_id']} "
                       f"(locations {blk['locations']}): {last_err}")

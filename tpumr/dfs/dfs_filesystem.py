@@ -71,6 +71,16 @@ class DistributedFileSystem(FileSystem):
     def rename(self, src: "str | Path", dst: "str | Path") -> bool:
         return self.client.rename(self._p(src), self._p(dst))
 
+    def set_permission(self, path: "str | Path", mode: int) -> None:
+        self.client.set_permission(self._p(path), mode)
+
+    def set_owner(self, path: "str | Path", owner: "str | None" = None,
+                  group: "str | None" = None) -> None:
+        self.client.set_owner(self._p(path), owner, group)
+
+    def fsck(self, path: "str | Path" = "/") -> dict:
+        return self.client.fsck(self._p(path))
+
     def set_replication(self, path: "str | Path", replication: int) -> bool:
         return self.client.set_replication(self._p(path), replication)
 

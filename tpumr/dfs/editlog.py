@@ -1,61 +1,167 @@
-"""NameNode persistence: edit log journal + image checkpoints.
+"""NameNode persistence: segmented edit-log journal + image checkpoints.
 
-≈ ``FSEditLog`` (hdfs/server/namenode/FSEditLog.java, 1433 LoC), ``FSImage``
+≈ ``FSEditLog`` (hdfs/server/namenode/FSEditLog.java, 1433 LoC — in
+particular rollEditLog's edits/edits.new split), ``FSImage``
 (FSImage.java, 1832 LoC) and the SecondaryNameNode merge
-(SecondaryNameNode.java:64). Contracts kept: every namespace mutation is
-appended + fsynced to the journal BEFORE being applied in memory is visible
-to clients; startup = load newest image, replay edits; a checkpoint merges
-image+edits into a fresh image and truncates the journal (the secondary's
-doCheckpoint cycle, here callable in-process or from the standalone
-:class:`CheckpointNode`)."""
+(SecondaryNameNode.java:64). Contracts kept:
+
+- every namespace mutation is appended + fsynced to the journal BEFORE
+  the in-memory change is visible to clients;
+- startup = load newest image, replay edits in order;
+- a checkpoint merges image+edits into a fresh image and purges exactly
+  the merged edits.
+
+The journal is a sequence of numbered segment files
+(``edits-0000000001.jsonl`` …): the writer rolls to a new segment when the
+current one passes ``segment_bytes`` (≈ FSEditLog roll), so a checkpoint
+can seal-and-purge whole segments without ever truncating the file being
+written. Sealed segments are deleted only AFTER the merged image is
+durably in place (crash between a secondary's fetch and its upload loses
+nothing — the reference's CheckpointSignature rollback guarantee).
+"""
 
 from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Iterator
 
 IMAGE_NAME = "fsimage.json"
+#: legacy single-file journal name (still replayed first if present)
 EDITS_NAME = "edits.jsonl"
+_SEG_RE = re.compile(r"^edits-(\d{10})\.jsonl$")
+
+
+def _segment_name(n: int) -> str:
+    return f"edits-{n:010d}.jsonl"
+
+
+def _tail_is_clean(path: str) -> bool:
+    """True when the file is empty or its last line is a complete JSON
+    record (ends with a newline and parses)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size == 0:
+                return True
+            f.seek(max(0, size - 65536))
+            tail = f.read()
+    except OSError:
+        return False
+    if not tail.endswith(b"\n"):
+        return False
+    last = tail.rstrip(b"\n").rsplit(b"\n", 1)[-1]
+    if not last:
+        return True
+    try:
+        json.loads(last)
+        return True
+    except json.JSONDecodeError:
+        return False
+
+
+def list_segments(name_dir: str) -> "list[str]":
+    """Segment paths in write order (legacy single file first)."""
+    out = []
+    legacy = os.path.join(name_dir, EDITS_NAME)
+    if os.path.exists(legacy):
+        out.append(legacy)
+    nums = []
+    try:
+        for name in os.listdir(name_dir):
+            m = _SEG_RE.match(name)
+            if m:
+                nums.append(int(m.group(1)))
+    except FileNotFoundError:
+        pass
+    out.extend(os.path.join(name_dir, _segment_name(n))
+               for n in sorted(nums))
+    return out
 
 
 class FSEditLog:
-    """Append-only JSON-line journal with fsync on every op."""
+    """Append-only JSON-line journal over numbered segments, fsync on
+    every op, size-triggered rolls."""
 
-    def __init__(self, name_dir: str) -> None:
-        self.path = os.path.join(name_dir, EDITS_NAME)
+    def __init__(self, name_dir: str, segment_bytes: int = 0) -> None:
+        self.name_dir = name_dir
+        #: roll threshold; 0 = never auto-roll mid-write (rolls still
+        #: happen at checkpoints)
+        self.segment_bytes = segment_bytes
         os.makedirs(name_dir, exist_ok=True)
+        existing = [p for p in list_segments(name_dir)
+                    if not p.endswith(EDITS_NAME)]
+        self._seg_no = (int(_SEG_RE.match(os.path.basename(existing[-1]))
+                            .group(1)) if existing else 1)
+        # never append to a segment with a torn tail (crash mid-write):
+        # replay stops at the torn line, so bytes appended after it would
+        # be silently skipped on the NEXT replay while later segments
+        # still apply — seal it and write to a fresh segment instead
+        if existing and not _tail_is_clean(existing[-1]):
+            self._seg_no += 1
+        self.path = os.path.join(name_dir, _segment_name(self._seg_no))
         self._f = open(self.path, "ab")
 
     def log(self, op: dict) -> None:
         self._f.write(json.dumps(op, separators=(",", ":")).encode() + b"\n")
         self._f.flush()
         os.fsync(self._f.fileno())
+        if self.segment_bytes and self._f.tell() >= self.segment_bytes:
+            self.roll()
 
     def close(self) -> None:
         self._f.close()
 
-    def roll(self) -> None:
-        """Truncate after a checkpoint (≈ rollEditLog + purge)."""
+    def roll(self) -> "list[str]":
+        """Seal the current segment and open the next (≈ rollEditLog:
+        edits → edits.new). Returns every sealed segment path — the set a
+        checkpoint may purge once its merged image is durable."""
         self._f.close()
-        self._f = open(self.path, "wb")
+        sealed = list_segments(self.name_dir)
+        self._seg_no += 1
+        self.path = os.path.join(self.name_dir,
+                                 _segment_name(self._seg_no))
+        self._f = open(self.path, "ab")
+        return sealed
+
+    def total_bytes(self) -> int:
+        """Journal size on disk — the auto-checkpoint trigger input."""
+        total = 0
+        for p in list_segments(self.name_dir):
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+        return total
 
     @staticmethod
-    def replay(name_dir: str) -> Iterator[dict]:
-        path = os.path.join(name_dir, EDITS_NAME)
-        if not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except json.JSONDecodeError:
-                    # torn tail write from a crash: stop at the last
-                    # complete record (journal recovery semantics)
-                    return
+    def purge(paths: "list[str]") -> None:
+        """Delete merged segments (checkpoint completion)."""
+        for p in paths:
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+
+    @staticmethod
+    def replay(name_dir: str,
+               paths: "list[str] | None" = None) -> Iterator[dict]:
+        for path in (list_segments(name_dir) if paths is None else paths):
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        # torn tail write from a crash: stop this segment
+                        # at the last complete record (journal recovery)
+                        break
 
 
 class FSImage:
@@ -82,13 +188,14 @@ class FSImage:
 
 
 def checkpoint(name_dir: str, apply_op: Any) -> None:
-    """Merge image + edits → new image, truncate edits (≈ the
-    SecondaryNameNode doCheckpoint merge). ``apply_op(namespace, counters,
-    op)`` is the namesystem's replay function, shared with startup so the
-    merge and live replay can never diverge."""
+    """Merge image + all on-disk edits → new image, then purge exactly the
+    merged segments (≈ the SecondaryNameNode doCheckpoint merge, done
+    in-process). ``apply_op(namespace, counters, op)`` is the namesystem's
+    replay function, shared with startup so merge and live replay never
+    diverge. Caller must have closed/rolled the live writer first."""
+    merged = list_segments(name_dir)
     namespace, counters = FSImage.load(name_dir)
-    for op in FSEditLog.replay(name_dir):
+    for op in FSEditLog.replay(name_dir, merged):
         apply_op(namespace, counters, op)
     FSImage.save(name_dir, namespace, counters)
-    with open(os.path.join(name_dir, EDITS_NAME), "wb"):
-        pass
+    FSEditLog.purge(merged)

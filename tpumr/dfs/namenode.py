@@ -67,9 +67,36 @@ class FSNamesystem:
             self.apply_op(self.namespace, self.counters, op)
         self.counters.setdefault("next_block", 1)
         self.counters.setdefault("gen", 1)
-        if "/" not in self.namespace:
-            self.namespace["/"] = {"type": "dir", "mtime": _now()}
-        self.edits = FSEditLog(name_dir)
+        self._edits_segment_bytes = int(
+            float(conf.get("tdfs.edits.segment.mb", 16)) * 1024 * 1024)
+        self.edits = FSEditLog(name_dir,
+                               segment_bytes=self._edits_segment_bytes)
+        #: sealed segments shipped to a secondary, purged on put_image
+        self._checkpoint_segments: list[str] = []
+        #: bumped by every in-process checkpoint; a secondary's put_image
+        #: is refused if it straddled one (≈ CheckpointSignature check)
+        self._ckpt_serial = 0
+        self._shipped_serial = -1
+
+        # permission model ≈ FSNamesystem/FSPermissionChecker: owner/group/
+        # mode per inode; the NN process user is the superuser; identity is
+        # the (signed) simple-auth user asserted on each RPC. In-process
+        # calls (monitor threads, lease recovery) carry no RPC user and
+        # bypass checks — they ARE the namesystem.
+        self.permissions_enabled = conf.get_boolean("dfs.permissions", True)
+        import getpass
+        self.superuser = str(conf.get("tdfs.superuser", "")
+                             or getpass.getuser())
+        self.supergroup = str(conf.get("dfs.permissions.supergroup",
+                                       "supergroup"))
+        # root inode: superuser-owned 0755 like a formatted HDFS namespace
+        root = self.namespace.setdefault("/", {"type": "dir",
+                                               "mtime": _now()})
+        root.setdefault("owner", self.superuser)
+        root.setdefault("group", self.supergroup)
+        root.setdefault("mode", 0o755)
+        #: corrupt replicas reported by clients: bid -> {addr}
+        self.corrupt_replicas: dict[int, set[str]] = {}
 
         # volatile state, rebuilt at runtime
         self.block_locations: dict[int, set[str]] = {}   # bid -> {dn addr}
@@ -96,11 +123,17 @@ class FSNamesystem:
         kind = op["op"]
         p = op.get("path")
         if kind == "mkdir":
-            namespace[p] = {"type": "dir", "mtime": op["t"]}
+            namespace[p] = {"type": "dir", "mtime": op["t"],
+                            "owner": op.get("o", ""),
+                            "group": op.get("g", ""),
+                            "mode": op.get("m", 0o755)}
         elif kind == "create":
             namespace[p] = {"type": "file", "blocks": [], "uc": True,
                             "replication": op["r"], "block_size": op["bs"],
-                            "mtime": op["t"], "client": op.get("c", "")}
+                            "mtime": op["t"], "client": op.get("c", ""),
+                            "owner": op.get("o", ""),
+                            "group": op.get("g", ""),
+                            "mode": op.get("m", 0o644)}
         elif kind == "add_block":
             namespace[p]["blocks"].append([op["bid"], 0])
         elif kind == "block_size":
@@ -130,6 +163,13 @@ class FSNamesystem:
                 del namespace[k]
         elif kind == "set_repl":
             namespace[p]["replication"] = op["r"]
+        elif kind == "chmod":
+            namespace[p]["mode"] = op["m"]
+        elif kind == "chown":
+            if op.get("o"):
+                namespace[p]["owner"] = op["o"]
+            if op.get("g"):
+                namespace[p]["group"] = op["g"]
         elif kind == "counters":
             counters.update(op["values"])
 
@@ -160,15 +200,19 @@ class FSNamesystem:
                 self._reported_fraction() >= self.safemode_threshold:
             self.safemode = False
 
-    def _ensure_parents(self, path: str) -> None:
+    def _ensure_parents(self, path: str,
+                        user: "str | None" = None) -> None:
         parts = [p for p in path.split("/") if p]
         cur = ""
         for part in parts[:-1]:
             cur += "/" + part
             inode = self.namespace.get(cur)
             if inode is None:
-                self._log({"op": "mkdir", "path": cur, "t": _now()})
-                self.namespace[cur] = {"type": "dir", "mtime": _now()}
+                op = {"op": "mkdir", "path": cur, "t": _now(),
+                      "o": user or self.superuser, "g": self.supergroup,
+                      "m": 0o755}
+                self._log(op)
+                self.apply_op(self.namespace, self.counters, op)
             elif inode["type"] != "dir":
                 raise NotADirectoryError(cur)
 
@@ -178,12 +222,70 @@ class FSNamesystem:
             raise FileNotFoundError(path)
         return inode
 
+    # ------------------------------------------------------------ permissions
+
+    @staticmethod
+    def _caller() -> "str | None":
+        from tpumr.ipc.rpc import current_rpc_user
+        return current_rpc_user()
+
+    def _groups_of(self, user: str) -> set:
+        """Static group mapping from conf (``tpumr.user.groups.<user>`` =
+        comma list) ≈ the reference's configurable GroupMappingServiceProvider
+        — no JNI/shell group lookup on the NameNode's hot path."""
+        gs = self.conf.get(f"tpumr.user.groups.{user}")
+        return {s.strip() for s in str(gs).split(",")} if gs else set()
+
+    @staticmethod
+    def _parent_of(path: str) -> str:
+        return path.rstrip("/").rsplit("/", 1)[0] or "/"
+
+    def _check_access(self, path: str, want: int,
+                      user: "str | None") -> None:
+        """rwx bit check (want: 4=r, 2=w, 1=x) ≈ FSPermissionChecker.check.
+        None user = in-process caller (the namesystem itself); superuser
+        bypasses everything."""
+        if (not self.permissions_enabled or user is None
+                or user == self.superuser):
+            return
+        inode = self.namespace.get(path)
+        if inode is None:
+            return
+        # same defaults get_status displays — enforcement and ls must
+        # never disagree about what a missing mode means
+        mode = inode.get("mode",
+                         0o755 if inode.get("type") == "dir" else 0o644)
+        owner = inode.get("owner", "")
+        group = inode.get("group", "")
+        if user == owner:
+            ok = (mode >> 6) & want
+        elif group and group in self._groups_of(user):
+            ok = (mode >> 3) & want
+        else:
+            ok = mode & want
+        if not ok:
+            access = {4: "READ", 2: "WRITE", 1: "EXECUTE"}.get(want, want)
+            raise PermissionError(
+                f"Permission denied: user={user}, access={access}, "
+                f"inode={path} (owner={owner or '?'}, "
+                f"mode={oct(mode & 0o777)})")
+
+    def _check_parent_write(self, path: str, user: "str | None") -> None:
+        """WRITE on the nearest EXISTING ancestor dir — creating a deep
+        path checks where the subtree attaches, like the reference's
+        checkAncestorAccess."""
+        p = self._parent_of(path)
+        while p != "/" and p not in self.namespace:
+            p = self._parent_of(p)
+        self._check_access(p, 2, user)
+
     # ------------------------------------------------------------ client ops
 
     def create(self, path: str, client: str, replication: int | None,
                block_size: int | None, overwrite: bool) -> dict:
         with self.lock:
             self._check_safemode()
+            user = self._caller()
             existing = self.namespace.get(path)
             if existing is not None:
                 if existing["type"] == "dir":
@@ -194,12 +296,16 @@ class FSNamesystem:
                         f"{existing.get('client')}")
                 if not overwrite:
                     raise FileExistsError(path)
+                self._check_access(path, 2, user)  # overwrite = write file
                 self.delete(path)
-            self._ensure_parents(path)
+            self._check_parent_write(path, user)
+            self._ensure_parents(path, user)
             r = replication or self.default_replication
             bs = block_size or self.default_block_size
             op = {"op": "create", "path": path, "r": r, "bs": bs,
-                  "t": _now(), "c": client}
+                  "t": _now(), "c": client,
+                  "o": user or self.superuser, "g": self.supergroup,
+                  "m": 0o644}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
             lease = self.leases.setdefault(
@@ -271,6 +377,7 @@ class FSNamesystem:
             inode = self._inode(path)
             if inode["type"] != "file":
                 raise IsADirectoryError(path)
+            self._check_access(path, 4, self._caller())
             out = []
             for bid, size in inode["blocks"]:
                 locs = sorted(self.block_locations.get(bid, ()))
@@ -286,8 +393,12 @@ class FSNamesystem:
             self._check_safemode()
             if path in self.namespace:
                 return self.namespace[path]["type"] == "dir"
-            self._ensure_parents(path + "/x")
-            op = {"op": "mkdir", "path": path, "t": _now()}
+            user = self._caller()
+            self._check_parent_write(path, user)
+            self._ensure_parents(path + "/x", user)
+            op = {"op": "mkdir", "path": path, "t": _now(),
+                  "o": user or self.superuser, "g": self.supergroup,
+                  "m": 0o755}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
             return True
@@ -298,6 +409,7 @@ class FSNamesystem:
             inode = self.namespace.get(path)
             if inode is None:
                 return False
+            self._check_access(self._parent_of(path), 2, self._caller())
             children = [k for k in self.namespace
                         if k.startswith(path.rstrip("/") + "/")]
             if inode["type"] == "dir" and children and not recursive:
@@ -324,11 +436,14 @@ class FSNamesystem:
             self._check_safemode()
             if src not in self.namespace:
                 return False
+            user = self._caller()
+            self._check_access(self._parent_of(src), 2, user)
             if dst in self.namespace and self.namespace[dst]["type"] == "dir":
                 dst = dst.rstrip("/") + "/" + src.rsplit("/", 1)[-1]
             if dst in self.namespace:
                 return False
-            self._ensure_parents(dst)
+            self._check_parent_write(dst, user)
+            self._ensure_parents(dst, user)
             op = {"op": "rename", "path": src, "dst": dst}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
@@ -340,24 +455,71 @@ class FSNamesystem:
             inode = self._inode(path)
             if inode["type"] != "file":
                 return False
+            self._check_access(path, 2, self._caller())
             op = {"op": "set_repl", "path": path, "r": replication}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
             return True
 
+    def set_permission(self, path: str, mode: int) -> None:
+        """chmod ≈ FSNamesystem.setPermission: owner or superuser only."""
+        with self.lock:
+            self._check_safemode()
+            inode = self._inode(path)
+            user = self._caller()
+            if (self.permissions_enabled and user is not None
+                    and user != self.superuser
+                    and user != inode.get("owner", "")):
+                raise PermissionError(
+                    f"Permission denied: only the owner "
+                    f"({inode.get('owner', '?')}) or the superuser may "
+                    f"chmod {path}")
+            op = {"op": "chmod", "path": path, "m": int(mode) & 0o7777}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+
+    def set_owner(self, path: str, owner: "str | None" = None,
+                  group: "str | None" = None) -> None:
+        """chown ≈ FSNamesystem.setOwner: owner changes need the superuser;
+        the file owner may change its group to one of their own groups."""
+        with self.lock:
+            self._check_safemode()
+            inode = self._inode(path)
+            user = self._caller()
+            if self.permissions_enabled and user is not None \
+                    and user != self.superuser:
+                if owner:
+                    raise PermissionError(
+                        "Permission denied: only the superuser may change "
+                        f"the owner of {path}")
+                if group and (user != inode.get("owner", "")
+                              or group not in self._groups_of(user)):
+                    raise PermissionError(
+                        f"Permission denied: user={user} may not move "
+                        f"{path} into group {group}")
+            op = {"op": "chown", "path": path, "o": owner or "",
+                  "g": group or ""}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+
     def get_status(self, path: str) -> dict:
         with self.lock:
             inode = self._inode(path)
+            perms = {"owner": inode.get("owner", ""),
+                     "group": inode.get("group", ""),
+                     "mode": inode.get("mode",
+                                       0o755 if inode["type"] == "dir"
+                                       else 0o644)}
             if inode["type"] == "dir":
                 return {"path": path, "is_dir": True, "length": 0,
-                        "mtime": inode.get("mtime", 0)}
+                        "mtime": inode.get("mtime", 0), **perms}
             length = sum(self.block_sizes.get(bid, size)
                          for bid, size in inode["blocks"])
             return {"path": path, "is_dir": False, "length": length,
                     "replication": inode["replication"],
                     "block_size": inode["block_size"],
                     "mtime": inode.get("mtime", 0),
-                    "under_construction": bool(inode.get("uc"))}
+                    "under_construction": bool(inode.get("uc")), **perms}
 
     def list_status(self, path: str) -> list[dict]:
         with self.lock:
@@ -516,44 +678,144 @@ class FSNamesystem:
                     self.total_known_blocks += len(inode["blocks"])
                 del self.leases[client]
 
+    # ------------------------------------------------------------ fsck
+
+    def report_bad_block(self, block_id: int, addr: str) -> None:
+        """Client found a checksum-corrupt replica (≈ ClientProtocol.
+        reportBadBlocks): forget the location, tell the node to delete its
+        copy, and let replication_check re-replicate from a good one.
+        Safety rails: the caller must be able to READ the owning file
+        (a report is as destructive as a delete), unknown blocks/locations
+        are ignored, and the LAST live replica is never invalidated — a
+        spurious report (or a transport error mistaken for corruption)
+        must not be able to destroy the only copy (the HDFS rule)."""
+        with self.lock:
+            locs = self.block_locations.get(block_id)
+            if not locs or addr not in locs:
+                return
+            path = next(
+                (p for p, ino in self.namespace.items()
+                 if ino.get("type") == "file"
+                 and any(b[0] == block_id for b in ino.get("blocks", []))),
+                None)
+            if path is not None:
+                self._check_access(path, 4, self._caller())
+            self.corrupt_replicas.setdefault(block_id, set()).add(addr)
+            if len(locs) <= 1:
+                return  # recorded as corrupt, but keep the last copy
+            locs.discard(addr)
+            self.commands.setdefault(addr, []).append(
+                {"type": "delete", "block_id": block_id})
+
+    def fsck(self, path: str = "/") -> dict:
+        """Namespace health walk ≈ NamenodeFsck.check: per-file block
+        accounting against live replica locations."""
+        with self.lock:
+            report: dict = {"path": path, "files": 0, "dirs": 0,
+                            "blocks": 0, "size": 0,
+                            "under_replicated": [], "missing": [],
+                            "corrupt": [], "over_replicated": [],
+                            "open_files": []}
+            prefix = "/" if path == "/" else path.rstrip("/") + "/"
+            for p in sorted(self.namespace):
+                if not (p == path or p.startswith(prefix)):
+                    continue
+                inode = self.namespace[p]
+                if inode["type"] == "dir":
+                    report["dirs"] += 1
+                    continue
+                if inode.get("uc"):
+                    report["open_files"].append(p)
+                    continue
+                report["files"] += 1
+                want = inode.get("replication", 1)
+                for bid, size in inode.get("blocks", []):
+                    report["blocks"] += 1
+                    report["size"] += self.block_sizes.get(bid, size)
+                    live = len(self.block_locations.get(bid, ()))
+                    if bid in self.corrupt_replicas and live == 0:
+                        report["corrupt"].append(
+                            {"path": p, "block_id": bid,
+                             "bad_replicas":
+                                 sorted(self.corrupt_replicas[bid])})
+                    elif live == 0:
+                        report["missing"].append(
+                            {"path": p, "block_id": bid})
+                    elif live < want:
+                        report["under_replicated"].append(
+                            {"path": p, "block_id": bid,
+                             "live": live, "want": want})
+                    elif live > want:
+                        report["over_replicated"].append(
+                            {"path": p, "block_id": bid,
+                             "live": live, "want": want})
+            report["healthy"] = not (report["missing"] or report["corrupt"])
+            return report
+
     # ------------------------------------------------------------ admin
 
     def save_namespace(self) -> None:
-        """Checkpoint in place (image ∪ edits → image; truncate edits)."""
+        """Checkpoint in place (image ∪ edits → image; purge merged
+        segments)."""
         with self.lock:
             self.edits.close()
             checkpoint(self.name_dir, self.apply_op)
-            self.edits = FSEditLog(self.name_dir)
+            self.edits = FSEditLog(
+                self.name_dir, segment_bytes=self._edits_segment_bytes)
+            self._ckpt_serial += 1
+
+    def edits_bytes(self) -> int:
+        """On-disk journal size (auto-checkpoint trigger input)."""
+        return self.edits.total_bytes()
 
     def get_name_state(self) -> dict:
-        """Secondary checkpoint fetch (≈ GetImageServlet): returns the
-        current image + edits and ROLLS the journal, so edits after this
-        point replay cleanly on top of the merged image the secondary will
-        upload."""
+        """Secondary checkpoint fetch (≈ GetImageServlet): ship the image
+        plus every SEALED edit segment, rolling the journal first. The
+        sealed segments are only purged when the merged image comes back
+        (put_image) — a secondary that dies mid-cycle loses nothing."""
         import os
-        from tpumr.dfs.editlog import EDITS_NAME, IMAGE_NAME
+        from tpumr.dfs.editlog import IMAGE_NAME
         with self.lock:
             image = b"{}"
             img_path = os.path.join(self.name_dir, IMAGE_NAME)
             if os.path.exists(img_path):
                 with open(img_path, "rb") as f:
                     image = f.read()
-            with open(os.path.join(self.name_dir, EDITS_NAME), "rb") as f:
-                edits = f.read()
-            self.edits.roll()
-            return {"image": image, "edits": edits}
+            sealed = self.edits.roll()
+            chunks = []
+            for seg in sealed:
+                try:
+                    with open(seg, "rb") as f:
+                        chunks.append(f.read())
+                except FileNotFoundError:
+                    pass
+            self._checkpoint_segments = sealed
+            # every fetch starts a NEW checkpoint epoch: a concurrent
+            # checkpointer's earlier fetch is invalidated (its put_image
+            # would purge segments its merged image does not cover)
+            self._ckpt_serial += 1
+            self._shipped_serial = self._ckpt_serial
+            return {"image": image, "edits": b"".join(chunks)}
 
     def put_image(self, image: bytes) -> None:
-        """Secondary checkpoint upload (≈ putFSImage + rollFSImage)."""
+        """Secondary checkpoint upload (≈ putFSImage + rollFSImage): make
+        the merged image durable, THEN purge the segments it covers."""
         import os
         from tpumr.dfs.editlog import IMAGE_NAME
         with self.lock:
+            if self._shipped_serial != self._ckpt_serial:
+                raise RuntimeError(
+                    "checkpoint signature mismatch: the namespace was "
+                    "checkpointed in-process since get_name_state — "
+                    "discarding this (now stale) secondary merge")
             tmp = os.path.join(self.name_dir, IMAGE_NAME + ".ckpt")
             with open(tmp, "wb") as f:
                 f.write(image)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.name_dir, IMAGE_NAME))
+            FSEditLog.purge(self._checkpoint_segments)
+            self._checkpoint_segments = []
 
     def get_blocks(self, addr: str, max_blocks: int = 16) -> list[dict]:
         """Blocks hosted on one DataNode (≈ NamenodeProtocol.getBlocks —
@@ -646,11 +908,18 @@ class NameNode:
 
     def _monitor_loop(self) -> None:
         interval = float(self.conf.get("tdfs.replication.interval.s", 1.0))
+        # journal growth bound: checkpoint in-process once edits pass this
+        # size, so the journal stays bounded even with no secondary
+        # (≈ dfs.namenode.checkpoint.txns-style trigger); 0 disables
+        auto_ckpt = int(float(self.conf.get(
+            "tdfs.edits.auto.checkpoint.mb", 256)) * 1024 * 1024)
         while not self._stop.wait(interval):
             try:
                 self.ns.heartbeat_check(self.dn_expiry_s)
                 self.ns.replication_check()
                 self.ns.lease_check()
+                if auto_ckpt and self.ns.edits_bytes() > auto_ckpt:
+                    self.ns.save_namespace()
             except Exception:  # noqa: BLE001 — monitors must survive
                 pass
 
@@ -691,6 +960,18 @@ class NameNode:
 
     def set_replication(self, path, replication):
         return self.ns.set_replication(path, replication)
+
+    def set_permission(self, path, mode):
+        return self.ns.set_permission(path, mode)
+
+    def set_owner(self, path, owner=None, group=None):
+        return self.ns.set_owner(path, owner, group)
+
+    def fsck(self, path="/"):
+        return self.ns.fsck(path)
+
+    def report_bad_block(self, block_id, addr):
+        return self.ns.report_bad_block(block_id, addr)
 
     def get_status(self, path):
         return self.ns.get_status(path)

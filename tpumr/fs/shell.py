@@ -291,6 +291,41 @@ class FsShell:
             self._print(f"Replication {rep} set: {p}")
         return 0
 
+    def cmd_chmod(self, *args: str) -> int:
+        """-chmod <octal-mode> <path>... (≈ FsShell chmod; tdfs only)."""
+        if len(args) < 2:
+            raise ShellError("-chmod: <octal-mode> <path>...")
+        try:
+            mode = int(args[0], 8)
+        except ValueError:
+            raise ShellError(f"-chmod: bad mode {args[0]!r} "
+                             "(octal, e.g. 750)") from None
+        for p in args[1:]:
+            full = self._resolve(p)
+            fs = get_filesystem(full, self.conf)
+            setp = getattr(fs, "set_permission", None)
+            if setp is None:
+                self._print("chmod: only meaningful on tdfs://")
+                return 1
+            setp(full, mode)
+        return 0
+
+    def cmd_chown(self, *args: str) -> int:
+        """-chown <owner>[:<group>] <path>... (≈ FsShell chown; tdfs
+        only)."""
+        if len(args) < 2:
+            raise ShellError("-chown: <owner>[:<group>] <path>...")
+        owner, _, group = args[0].partition(":")
+        for p in args[1:]:
+            full = self._resolve(p)
+            fs = get_filesystem(full, self.conf)
+            seto = getattr(fs, "set_owner", None)
+            if seto is None:
+                self._print("chown: only meaningful on tdfs://")
+                return 1
+            seto(full, owner or None, group or None)
+        return 0
+
     def cmd_df(self, *args: str) -> int:
         for p in args or ["/"]:
             fs = self._fs(p)

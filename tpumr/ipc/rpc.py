@@ -43,6 +43,19 @@ class RpcAuthError(RpcError):
 #: signed-timestamp freshness window (seconds)
 AUTH_WINDOW_S = 300.0
 
+#: caller identity of the RPC being served on THIS handler thread —
+#: simple-auth semantics (asserted by the client, covered by the HMAC
+#: signature when auth is on, but any secret holder may assert any name —
+#: exactly the reference's non-Kerberos trust model). None outside an RPC
+#: dispatch, i.e. for a daemon's own in-process calls.
+_current_user = threading.local()
+
+
+def current_rpc_user() -> "str | None":
+    """User asserted by the RPC currently being dispatched (None when not
+    inside a dispatch — the callee is acting as the daemon itself)."""
+    return getattr(_current_user, "user", None)
+
 
 def _sign(secret: bytes, req: dict, port: int, nonce: str) -> str:
     """HMAC-SHA256 over the canonical request identity+payload+timestamp,
@@ -55,7 +68,7 @@ def _sign(secret: bytes, req: dict, port: int, nonce: str) -> str:
     id within the connection's lifetime."""
     canon = serialize([req.get("cid"), req.get("id"), req.get("method"),
                        list(req.get("params", [])), req.get("ts"), port,
-                       nonce])
+                       nonce, req.get("user")])
     return hmac.new(secret, canon, "sha256").hexdigest()
 
 
@@ -144,7 +157,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 resp: dict[str, Any] = {"id": req.get("id")}
                 try:
                     method = server.lookup(req["method"])
-                    resp["result"] = method(*req.get("params", []))
+                    _current_user.user = req.get("user")
+                    try:
+                        resp["result"] = method(*req.get("params", []))
+                    finally:
+                        _current_user.user = None
                 except Exception as e:  # noqa: BLE001 — remote surface
                     resp["error"] = f"{type(e).__name__}: {e}"
                     resp["traceback"] = traceback.format_exc(limit=8)
@@ -331,10 +348,15 @@ class RpcClient:
         return resp
 
     def call(self, method: str, *params: Any) -> Any:
+        # caller identity rides every request (simple-auth assertion ≈ the
+        # reference's UGI-in-ConnectionHeader); resolved per call so
+        # UserGroupInformation.do_as scopes apply
+        from tpumr.security import UserGroupInformation
+        user = UserGroupInformation.get_current_user().user
         with self._lock:
             self._id += 1
             req = {"id": self._id, "cid": self._cid, "method": method,
-                   "params": list(params)}
+                   "params": list(params), "user": user}
             try:
                 sock = self._connect()
                 self._stamp(req)
