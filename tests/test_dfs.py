@@ -403,3 +403,57 @@ def test_edit_log_torn_tail_recovery(tmp_path):
 
     ns3 = FSNamesystem(name_dir, conf)
     assert "/before" in ns3.namespace and "/after" in ns3.namespace
+
+
+def test_stale_secondary_upload_refused(tmp_path):
+    """Two overlapping checkpoint cycles: the superseded fetch's upload
+    must be refused (its merged image does not cover the later sealed
+    segments — accepting it would purge un-merged edits)."""
+    from tpumr.ipc.rpc import RpcError
+
+    import os
+
+    from tpumr.dfs.secondary import SecondaryNameNode
+
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        client = c.client()
+        client.mkdirs("/t1")
+        stale = client.nn.call("get_name_state")  # secondary A's fetch
+        client.mkdirs("/t2")
+        # secondary B runs a full (correctly merged) cycle and wins
+        snn = SecondaryNameNode(c.nn_host, c.nn_port,
+                                os.path.join(c.root, "2nn-b"), conf=conf)
+        snn.do_checkpoint()
+        # A's upload is from a superseded fetch: must be refused — its
+        # image covers neither /t2 nor even /t1's merge
+        with pytest.raises(RpcError, match="superseded"):
+            client.nn.call("put_image", stale["image"], stale["token"])
+        # nothing lost: restart replays image + surviving segments
+        from tpumr.dfs.namenode import FSNamesystem
+        c.namenode.ns.edits.close()
+        ns2 = FSNamesystem(c.namenode.ns.name_dir, conf)
+        assert "/t1" in ns2.namespace and "/t2" in ns2.namespace
+
+
+def test_owner_can_overwrite_in_readonly_dir(tmp_path):
+    """create(overwrite) is a truncate, not an unlink: the file owner may
+    overwrite their own writable file even when the parent dir denies
+    them write (HDFS startFile semantics)."""
+    from tpumr.security import UserGroupInformation
+
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        client = c.client()
+        bob = UserGroupInformation("bob")
+        client.mkdirs("/ro")
+        client.set_permission("/ro", 0o777)
+        with bob.do_as():
+            with client.create("/ro/own") as f:
+                f.write(b"v1")
+        client.set_permission("/ro", 0o755)  # dir now read-only for bob
+        with bob.do_as():
+            with client.create("/ro/own", overwrite=True) as f:
+                f.write(b"v2")
+            with client.open("/ro/own") as f:
+                assert f.read() == b"v2"

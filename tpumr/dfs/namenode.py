@@ -73,10 +73,12 @@ class FSNamesystem:
                                segment_bytes=self._edits_segment_bytes)
         #: sealed segments shipped to a secondary, purged on put_image
         self._checkpoint_segments: list[str] = []
-        #: bumped by every in-process checkpoint; a secondary's put_image
-        #: is refused if it straddled one (≈ CheckpointSignature check)
-        self._ckpt_serial = 0
-        self._shipped_serial = -1
+        #: checkpoint epoch token (≈ CheckpointSignature): bumped by every
+        #: get_name_state fetch AND every in-process checkpoint; put_image
+        #: must echo the token of the LATEST fetch or it is refused — a
+        #: stale secondary upload can never purge segments its merged
+        #: image does not cover
+        self._ckpt_token = 0
 
         # permission model ≈ FSNamesystem/FSPermissionChecker: owner/group/
         # mode per inode; the NN process user is the superuser; identity is
@@ -97,6 +99,12 @@ class FSNamesystem:
         root.setdefault("mode", 0o755)
         #: corrupt replicas reported by clients: bid -> {addr}
         self.corrupt_replicas: dict[int, set[str]] = {}
+        #: reverse index bid -> owning path, kept alongside the other
+        #: volatile block maps — report_bad_block's permission lookup must
+        #: not scan the namespace under the lock
+        self.block_to_path: dict[int, str] = {
+            b[0]: p for p, ino in self.namespace.items()
+            if ino.get("type") == "file" for b in ino.get("blocks", [])}
 
         # volatile state, rebuilt at runtime
         self.block_locations: dict[int, set[str]] = {}   # bid -> {dn addr}
@@ -257,7 +265,10 @@ class FSNamesystem:
                          0o755 if inode.get("type") == "dir" else 0o644)
         owner = inode.get("owner", "")
         group = inode.get("group", "")
-        if user == owner:
+        # pre-permission inodes (replayed from old journals) have no
+        # owner: everyone gets the owner bits — an upgrade must not lock
+        # users out of trees they created before permissions existed
+        if user == owner or owner == "":
             ok = (mode >> 6) & want
         elif group and group in self._groups_of(user):
             ok = (mode >> 3) & want
@@ -296,9 +307,14 @@ class FSNamesystem:
                         f"{existing.get('client')}")
                 if not overwrite:
                     raise FileExistsError(path)
-                self._check_access(path, 2, user)  # overwrite = write file
-                self.delete(path)
-            self._check_parent_write(path, user)
+                # overwrite is a truncate, not an unlink: WRITE on the
+                # file itself suffices (HDFS startFile semantics) — the
+                # internal delete must not re-check the parent dir
+                self._check_access(path, 2, user)
+                self._delete_impl(path, recursive=True)
+            else:
+                # a NEW namespace entry needs write on the parent
+                self._check_parent_write(path, user)
             self._ensure_parents(path, user)
             r = replication or self.default_replication
             bs = block_size or self.default_block_size
@@ -340,6 +356,7 @@ class FSNamesystem:
             op = {"op": "add_block", "path": path, "bid": bid}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self.block_to_path[bid] = path
             return {"block_id": bid, "gen": gen, "targets": targets}
 
     def abandon_block(self, path: str, client: str, block_id: int) -> None:
@@ -349,6 +366,7 @@ class FSNamesystem:
             op = {"op": "abandon", "path": path, "bid": block_id}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self.block_to_path.pop(block_id, None)
 
     def complete(self, path: str, client: str, last_block_size: int) -> None:
         with self.lock:
@@ -406,30 +424,40 @@ class FSNamesystem:
     def delete(self, path: str, recursive: bool = True) -> bool:
         with self.lock:
             self._check_safemode()
-            inode = self.namespace.get(path)
-            if inode is None:
+            if path not in self.namespace:
                 return False
             self._check_access(self._parent_of(path), 2, self._caller())
-            children = [k for k in self.namespace
-                        if k.startswith(path.rstrip("/") + "/")]
-            if inode["type"] == "dir" and children and not recursive:
-                raise OSError(f"{path} is a non-empty directory")
-            # schedule replica invalidation on the owning DataNodes
-            doomed: list[int] = []
-            for k in children + [path]:
-                node = self.namespace.get(k, {})
-                if node.get("type") == "file":
-                    doomed.extend(b[0] for b in node.get("blocks", []))
-            op = {"op": "delete", "path": path}
-            self._log(op)
-            self.apply_op(self.namespace, self.counters, op)
-            for bid in doomed:
-                for addr in self.block_locations.pop(bid, set()):
-                    self.commands.setdefault(addr, []).append(
-                        {"type": "delete", "block_id": bid})
-                self.block_sizes.pop(bid, None)
-                self.total_known_blocks = max(0, self.total_known_blocks - 1)
-            return True
+            return self._delete_impl(path, recursive)
+
+    def _delete_impl(self, path: str, recursive: bool) -> bool:
+        """Delete body, no permission check — for callers that already
+        authorized the operation (create-with-overwrite checks WRITE on
+        the file; re-checking the parent here would wrongly deny an
+        owner overwriting their own file in a read-only dir)."""
+        inode = self.namespace.get(path)
+        if inode is None:
+            return False
+        children = [k for k in self.namespace
+                    if k.startswith(path.rstrip("/") + "/")]
+        if inode["type"] == "dir" and children and not recursive:
+            raise OSError(f"{path} is a non-empty directory")
+        # schedule replica invalidation on the owning DataNodes
+        doomed: list[int] = []
+        for k in children + [path]:
+            node = self.namespace.get(k, {})
+            if node.get("type") == "file":
+                doomed.extend(b[0] for b in node.get("blocks", []))
+        op = {"op": "delete", "path": path}
+        self._log(op)
+        self.apply_op(self.namespace, self.counters, op)
+        for bid in doomed:
+            for addr in self.block_locations.pop(bid, set()):
+                self.commands.setdefault(addr, []).append(
+                    {"type": "delete", "block_id": bid})
+            self.block_sizes.pop(bid, None)
+            self.block_to_path.pop(bid, None)
+            self.total_known_blocks = max(0, self.total_known_blocks - 1)
+        return True
 
     def rename(self, src: str, dst: str) -> bool:
         with self.lock:
@@ -447,6 +475,13 @@ class FSNamesystem:
             op = {"op": "rename", "path": src, "dst": dst}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            # blocks moved with their files: refresh the reverse index
+            prefix = dst.rstrip("/") + "/"
+            for k, v in self.namespace.items():
+                if (k == dst or k.startswith(prefix)) \
+                        and v.get("type") == "file":
+                    for b in v.get("blocks", []):
+                        self.block_to_path[b[0]] = k
             return True
 
     def set_replication(self, path: str, replication: int) -> bool:
@@ -693,11 +728,7 @@ class FSNamesystem:
             locs = self.block_locations.get(block_id)
             if not locs or addr not in locs:
                 return
-            path = next(
-                (p for p, ino in self.namespace.items()
-                 if ino.get("type") == "file"
-                 and any(b[0] == block_id for b in ino.get("blocks", []))),
-                None)
+            path = self.block_to_path.get(block_id)
             if path is not None:
                 self._check_access(path, 4, self._caller())
             self.corrupt_replicas.setdefault(block_id, set()).add(addr)
@@ -762,7 +793,7 @@ class FSNamesystem:
             checkpoint(self.name_dir, self.apply_op)
             self.edits = FSEditLog(
                 self.name_dir, segment_bytes=self._edits_segment_bytes)
-            self._ckpt_serial += 1
+            self._ckpt_token += 1  # invalidate any in-flight 2NN cycle
 
     def edits_bytes(self) -> int:
         """On-disk journal size (auto-checkpoint trigger input)."""
@@ -770,9 +801,12 @@ class FSNamesystem:
 
     def get_name_state(self) -> dict:
         """Secondary checkpoint fetch (≈ GetImageServlet): ship the image
-        plus every SEALED edit segment, rolling the journal first. The
-        sealed segments are only purged when the merged image comes back
-        (put_image) — a secondary that dies mid-cycle loses nothing."""
+        plus every SEALED edit segment — as a LIST, preserving segment
+        boundaries so the secondary's replay keeps per-segment torn-tail
+        recovery (a concatenated blob would let one torn segment swallow
+        the ops of every later one). The journal is rolled first; sealed
+        segments are purged only when the merged image comes back with
+        this fetch's token (put_image)."""
         import os
         from tpumr.dfs.editlog import IMAGE_NAME
         with self.lock:
@@ -782,32 +816,32 @@ class FSNamesystem:
                 with open(img_path, "rb") as f:
                     image = f.read()
             sealed = self.edits.roll()
-            chunks = []
+            segments = []
             for seg in sealed:
                 try:
                     with open(seg, "rb") as f:
-                        chunks.append(f.read())
+                        segments.append(f.read())
                 except FileNotFoundError:
                     pass
             self._checkpoint_segments = sealed
-            # every fetch starts a NEW checkpoint epoch: a concurrent
-            # checkpointer's earlier fetch is invalidated (its put_image
-            # would purge segments its merged image does not cover)
-            self._ckpt_serial += 1
-            self._shipped_serial = self._ckpt_serial
-            return {"image": image, "edits": b"".join(chunks)}
+            self._ckpt_token += 1  # this fetch supersedes any earlier one
+            return {"image": image, "segments": segments,
+                    "token": self._ckpt_token}
 
-    def put_image(self, image: bytes) -> None:
+    def put_image(self, image: bytes, token: int = -1) -> None:
         """Secondary checkpoint upload (≈ putFSImage + rollFSImage): make
-        the merged image durable, THEN purge the segments it covers."""
+        the merged image durable, THEN purge the segments it covers. The
+        token must be the one handed out by the LATEST get_name_state —
+        an upload from a superseded fetch (another secondary rolled the
+        journal since, or an in-process checkpoint ran) is refused, since
+        purging would delete edits its image does not contain."""
         import os
         from tpumr.dfs.editlog import IMAGE_NAME
         with self.lock:
-            if self._shipped_serial != self._ckpt_serial:
+            if token != self._ckpt_token:
                 raise RuntimeError(
-                    "checkpoint signature mismatch: the namespace was "
-                    "checkpointed in-process since get_name_state — "
-                    "discarding this (now stale) secondary merge")
+                    "checkpoint signature mismatch: this merge is from a "
+                    "superseded get_name_state fetch — discarding it")
             tmp = os.path.join(self.name_dir, IMAGE_NAME + ".ckpt")
             with open(tmp, "wb") as f:
                 f.write(image)
@@ -900,6 +934,55 @@ class NameNode:
 
         srv.add_json("namenode", summary)
         srv.add_json("datanodes", lambda q: self.ns.datanode_report())
+        srv.add_json("fsck", lambda q: self.ns.fsck(q.get("path", "/")))
+
+        # HTML view ≈ webapps/hdfs/dfshealth.jsp
+        from tpumr.http import html_escape, html_table
+
+        fsck_cache: dict = {"ts": 0.0, "report": None}
+
+        def cached_fsck() -> dict:
+            """The full fsck walk holds the namesystem lock — cache it so
+            dashboard refreshes/scrapers can't stall client RPCs by
+            hammering '/' (≈ dfshealth.jsp reads cached FSNamesystem
+            counters, it does not run fsck per request)."""
+            import time as _time
+            now = _time.time()
+            if fsck_cache["report"] is None or \
+                    now - fsck_cache["ts"] > 10.0:
+                fsck_cache["report"] = self.ns.fsck("/")
+                fsck_cache["ts"] = now
+            return fsck_cache["report"]
+
+        def index_page(q: dict) -> str:
+            s = summary(q)
+            fsck = cached_fsck()
+            rows = []
+            for d in self.ns.datanode_report():
+                cap = max(1, d.get("capacity", 1))
+                used = d.get("used", 0)
+                rows.append([
+                    d.get("addr", "?"), d.get("rack", "?"),
+                    f"{d.get('blocks', 0)}",
+                    f"{used / 1e6:.1f} MB",
+                    f"{100 * used / cap:.1f}%",
+                ])
+            health = ("<span class='ok'>HEALTHY</span>"
+                      if fsck["healthy"]
+                      else "<span class='bad'>CORRUPT</span>")
+            return (
+                f"<h1>NameNode — {html_escape(self.ns.name_dir)}</h1>"
+                f"<p>{s['files']} files · {s['directories']} dirs · "
+                f"{s['blocks']} blocks · "
+                f"{'SAFEMODE · ' if s['safemode'] else ''}"
+                f"{s['datanodes']} datanodes · filesystem {health}</p>"
+                f"<p>under-replicated {len(fsck['under_replicated'])} · "
+                f"missing {len(fsck['missing'])} · corrupt "
+                f"{len(fsck['corrupt'])}</p><h2>DataNodes</h2>"
+                + html_table(["address", "rack", "blocks", "used",
+                              "used %"], rows))
+
+        srv.add_page("index", index_page)
         return srv
 
     @property
@@ -1007,8 +1090,8 @@ class NameNode:
     def get_name_state(self):
         return self.ns.get_name_state()
 
-    def put_image(self, image):
-        return self.ns.put_image(image)
+    def put_image(self, image, token=-1):
+        return self.ns.put_image(image, token)
 
     def get_blocks(self, addr, max_blocks=16):
         return self.ns.get_blocks(addr, max_blocks)

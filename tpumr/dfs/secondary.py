@@ -12,7 +12,7 @@ import os
 import threading
 from typing import Any
 
-from tpumr.dfs.editlog import EDITS_NAME, IMAGE_NAME, FSEditLog, FSImage
+from tpumr.dfs.editlog import IMAGE_NAME, FSEditLog, FSImage
 from tpumr.ipc.rpc import RpcClient
 
 
@@ -29,12 +29,22 @@ class SecondaryNameNode:
         self._thread: threading.Thread | None = None
 
     def do_checkpoint(self) -> None:
-        """One checkpoint cycle (≈ SecondaryNameNode.doCheckpoint)."""
+        """One checkpoint cycle (≈ SecondaryNameNode.doCheckpoint). The
+        segments arrive as a list and are written as separate files so
+        replay keeps per-segment torn-tail recovery; the NN's fetch token
+        is echoed with the upload (≈ CheckpointSignature) so a superseded
+        cycle is refused instead of purging uncovered edits."""
         state = self.nn.call("get_name_state")
+        # clear any previous cycle's files, then mirror the NN layout
+        for name in os.listdir(self.dir):
+            if name.startswith("edits") or name == IMAGE_NAME:
+                os.remove(os.path.join(self.dir, name))
         with open(os.path.join(self.dir, IMAGE_NAME), "wb") as f:
             f.write(state["image"])
-        with open(os.path.join(self.dir, EDITS_NAME), "wb") as f:
-            f.write(state["edits"])
+        for i, seg in enumerate(state["segments"], start=1):
+            with open(os.path.join(self.dir, f"edits-{i:010d}.jsonl"),
+                      "wb") as f:
+                f.write(seg)
         # offline merge using the namesystem's own replay function
         from tpumr.dfs.namenode import FSNamesystem
         namespace, counters = FSImage.load(self.dir)
@@ -43,7 +53,7 @@ class SecondaryNameNode:
         FSImage.save(self.dir, namespace, counters)
         with open(os.path.join(self.dir, IMAGE_NAME), "rb") as f:
             merged = f.read()
-        self.nn.call("put_image", merged)
+        self.nn.call("put_image", merged, state["token"])
 
     def start(self) -> "SecondaryNameNode":
         self._thread = threading.Thread(target=self._loop,

@@ -1,9 +1,12 @@
 """Embedded HTTP status tier ≈ the reference's Jetty ``HttpServer`` +
 JSP webapps (src/core/org/apache/hadoop/http/HttpServer.java;
-webapps/{job,task,hdfs,history}). JSON endpoints are the primary
-interface (the MXBean/``/jmx`` analog); a minimal HTML dashboard renders
-the same JSON for humans."""
+webapps/{job,task,hdfs,history}). JSON endpoints are the machine
+interface (the MXBean/``/jmx`` analog); daemons additionally register
+HTML pages (jobs table, task drill-down, datanode table) filling the
+JSP dashboards' role."""
 
-from tpumr.http.server import StatusHttpServer
+from tpumr.http.server import (RawHtml, StatusHttpServer, html_escape,
+                               html_table, progress_bar)
 
-__all__ = ["StatusHttpServer"]
+__all__ = ["RawHtml", "StatusHttpServer", "html_escape", "html_table",
+           "progress_bar"]

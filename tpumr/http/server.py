@@ -18,13 +18,65 @@ from urllib.parse import parse_qs, urlparse
 #: A handler takes the query dict and returns a JSON-able object.
 Handler = Callable[[dict], Any]
 
+#: A page handler takes the query dict and returns an HTML body fragment.
+PageHandler = Callable[[dict], str]
+
+_STYLE = """
+body{font-family:sans-serif;margin:1.5em;color:#222}
+h1{font-size:1.4em}h2{font-size:1.1em;border-bottom:1px solid #aaa;
+padding-bottom:.2em;margin-top:1.4em}
+table{border-collapse:collapse;margin:.5em 0;font-size:.92em}
+th,td{border:1px solid #bbb;padding:.25em .6em;text-align:left}
+th{background:#eee}tr:nth-child(even){background:#f7f7f7}
+nav a{margin-right:1em}.num{text-align:right}
+.ok{color:#060}.bad{color:#a00}.dim{color:#777}
+progress{width:8em;vertical-align:middle}
+pre{background:#f4f4f4;padding:.6em;overflow-x:auto}
+"""
+
+
+def html_escape(v: Any) -> str:
+    return html.escape(str(v))
+
+
+class RawHtml(str):
+    """Explicit marker for a trusted, caller-built HTML fragment. ONLY
+    RawHtml cells skip escaping in html_table — user-controlled strings
+    (job names, counter names) can never smuggle markup by merely
+    starting with '<'."""
+
+
+def html_table(headers: "list[str]", rows: "list[list[Any]]") -> str:
+    """Render a table; every cell is escaped unless it is a RawHtml
+    fragment the caller explicitly built (links, progress bars)."""
+    out = ["<table><tr>"]
+    out += [f"<th>{html_escape(h)}</th>" for h in headers]
+    out.append("</tr>")
+    for row in rows:
+        out.append("<tr>")
+        for cell in row:
+            s = cell if isinstance(cell, RawHtml) else html_escape(cell)
+            out.append(f"<td>{s}</td>")
+        out.append("</tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
+def progress_bar(fraction: float) -> RawHtml:
+    pct = max(0.0, min(1.0, float(fraction))) * 100
+    return RawHtml(f"<progress max='100' value='{pct:.0f}'></progress> "
+                   f"{pct:.0f}%")
+
 
 class StatusHttpServer:
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: int = 0) -> None:
         self.name = name
         self._handlers: dict[str, Handler] = {}
+        self._pages: dict[str, PageHandler] = {}
         self._parameterized: set[str] = set()
+        #: pages that need query params (not linked from the nav)
+        self._page_params: set[str] = set()
         outer = self
 
         class _Req(BaseHTTPRequestHandler):
@@ -46,6 +98,17 @@ class StatusHttpServer:
         self._handlers[path] = handler
         if parameterized:
             self._parameterized.add(path)
+
+    def add_page(self, path: str, handler: PageHandler,
+                 parameterized: bool = False) -> None:
+        """Register a human-readable HTML view at ``/<path>`` (≈ one JSP
+        of webapps/{job,task,hdfs}). ``"index"`` becomes ``/``; the raw
+        JSON dump moves to ``/raw``. The handler returns a body fragment;
+        the server wraps it with the chrome (title, nav, style).
+        ``parameterized`` pages need query args and stay out of the nav."""
+        self._pages[path] = handler
+        if parameterized:
+            self._page_params.add(path)
 
     @property
     def address(self) -> tuple[str, int]:
@@ -75,7 +138,16 @@ class StatusHttpServer:
         path = parsed.path.rstrip("/")
         try:
             if path in ("", "/"):
+                if "index" in self._pages:
+                    self._send(req, 200,
+                               self._page("index", query), "text/html")
+                else:
+                    self._send(req, 200, self._dashboard(), "text/html")
+            elif path == "/raw":
                 self._send(req, 200, self._dashboard(), "text/html")
+            elif path.lstrip("/") in self._pages:
+                self._send(req, 200,
+                           self._page(path.lstrip("/"), query), "text/html")
             elif path.startswith("/json/"):
                 name = path[len("/json/"):]
                 handler = self._handlers.get(name)
@@ -102,6 +174,23 @@ class StatusHttpServer:
         req.send_header("Content-Length", str(len(data)))
         req.end_headers()
         req.wfile.write(data)
+
+    def _page(self, name: str, query: dict) -> str:
+        """Wrap a page handler's body fragment with the shared chrome."""
+        try:
+            body = self._pages[name](query)
+        except KeyError as e:
+            body = f"<p class='bad'>missing parameter/entity: {html_escape(e)}</p>"
+        except Exception as e:  # noqa: BLE001 — render, don't 500
+            body = f"<p class='bad'>error: {html_escape(e)}</p>"
+        nav = "".join(f"<a href='/{'' if p == 'index' else html_escape(p)}'>"
+                      f"{html_escape(p)}</a>"
+                      for p in sorted(self._pages)
+                      if p not in self._page_params)
+        return (f"<html><head><title>{html_escape(self.name)}</title>"
+                f"<style>{_STYLE}</style></head><body>"
+                f"<nav>{nav}<a href='/raw'>raw json</a></nav>"
+                f"{body}</body></html>")
 
     def _dashboard(self) -> str:
         """One-page HTML: each JSON endpoint rendered as a <pre> block

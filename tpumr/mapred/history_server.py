@@ -47,6 +47,30 @@ class JobHistoryServer:
         self._http = StatusHttpServer("history", host=host, port=port)
         self._http.add_json("history", self._list)
         self._http.add_json("job", self._job, parameterized=True)
+        self._http.add_page("index", self._index_page)
+
+    def _index_page(self, q: dict) -> str:
+        """Completed-jobs table ≈ webapps/history jobhistory.jsp."""
+        from tpumr.http import RawHtml, html_escape, html_table
+        rows = []
+        for s in sorted(self._list(q),
+                        key=lambda s: s.get("submitted_ts") or 0,
+                        reverse=True):
+            state = s.get("state", "?")
+            cls = "ok" if state == "SUCCEEDED" else "bad"
+            rows.append([
+                s.get("job_id", "?"),
+                s.get("name", ""),
+                RawHtml(f"<span class='{cls}'>{html_escape(state)}</span>"),
+                f"{s.get('num_maps', '?')}", f"{s.get('num_reduces', '?')}",
+                f"{s.get('finished_tpu_maps', 0) or 0}",
+                f"{s.get('finished_cpu_maps', 0) or 0}",
+                (f"{s['wall_time']:.1f}s"
+                 if s.get("wall_time") is not None else "—"),
+            ])
+        return ("<h1>Job History</h1>" + html_table(
+            ["job", "name", "state", "#maps", "#reduces", "tpu maps",
+             "cpu maps", "wall time"], rows))
 
     def _files(self) -> dict[str, str]:
         if not os.path.isdir(self.dir):

@@ -201,6 +201,121 @@ class JobMaster:
         srv.add_json("metrics", lambda q: self.metrics.snapshot())
         from tpumr.core.configuration import redacted_dict
         srv.add_json("conf", lambda q: redacted_dict(self.conf))
+
+        # HTML views ≈ webapps/job/{jobtracker,jobdetails,jobtasks}.jsp
+        from tpumr.http import (RawHtml, html_escape, html_table,
+                                progress_bar)
+
+        def index_page(q: dict) -> str:
+            c = cluster_info(q)
+            jobs = jobs_info(q)
+            rows = []
+            for j in jobs:
+                jid = j["job_id"]
+                state_cls = ("ok" if j["state"] == "SUCCEEDED" else
+                             "bad" if j["state"] in ("FAILED", "KILLED")
+                             else "dim")
+                rows.append([
+                    RawHtml(f"<a href='/job?id={html_escape(jid)}'>"
+                            f"{html_escape(jid)}</a>"),
+                    RawHtml(f"<span class='{state_cls}'>"
+                            f"{html_escape(j['state'])}</span>"),
+                    progress_bar(j["map_progress"]),
+                    progress_bar(j["reduce_progress"]),
+                    f"{j['num_maps']}", f"{j['num_reduces']}",
+                    f"{j['finished_tpu_maps']}", f"{j['finished_cpu_maps']}",
+                    (f"{j['acceleration_factor']:.2f}"
+                     if j.get("acceleration_factor") else "—"),
+                ])
+            slots = c["slots"]
+            slots_txt = (" / ".join(f"{k} {v}" for k, v in slots.items())
+                         if isinstance(slots, dict) else str(slots))
+            return (
+                f"<h1>JobTracker — cluster {html_escape(self.cluster_id)}"
+                f"</h1>"
+                f"<p>{c['trackers']} trackers · slots "
+                f"{html_escape(slots_txt)} · "
+                f"{c['jobs_running']} running / {c['jobs_total']} total "
+                f"jobs</p><h2>Jobs</h2>"
+                + html_table(
+                    ["job", "state", "maps", "reduces", "#maps",
+                     "#reduces", "tpu maps", "cpu maps", "accel"], rows))
+
+        def job_page(q: dict) -> str:
+            jid = q.get("id", "")
+            jip = self._job(jid)
+            st = jip.status_dict()
+            parts = [f"<h1>Job {html_escape(jid)}</h1>",
+                     f"<p>state <b>{html_escape(st['state'])}</b>"
+                     + (f" — {html_escape(st['error'])}"
+                        if st.get("error") else "") + "</p>",
+                     "<p>map ", progress_bar(st["map_progress"]),
+                     " reduce ", progress_bar(st["reduce_progress"]),
+                     "</p>",
+                     f"<p>TPU maps {st['finished_tpu_maps']} · CPU maps "
+                     f"{st['finished_cpu_maps']} · mean map time "
+                     f"tpu {st['tpu_map_mean_time']:.3f}s / "
+                     f"cpu {st['cpu_map_mean_time']:.3f}s</p>"]
+            for kind in ("map", "reduce"):
+                reports = self.get_task_reports(jid, kind)
+                rows = []
+                for t in reports:
+                    backend = ("—" if kind == "reduce"
+                               else f"tpu:{t['tpu_device_id']}"
+                               if t["run_on_tpu"] else "cpu")
+                    runtime = (t["finish_time"] - t["start_time"]
+                               if t["finish_time"] and t["start_time"]
+                               else 0.0)
+                    rows.append([
+                        t["task_id"], t["state"],
+                        progress_bar(t["progress"]), backend,
+                        f"{runtime:.2f}s" if runtime else "—",
+                        t["successful_attempt"] or "—",
+                    ])
+                parts.append(f"<h2>{kind} tasks ({len(rows)})</h2>")
+                parts.append(html_table(
+                    ["task", "state", "progress", "backend", "runtime",
+                     "attempt"], rows))
+            counters = self.get_counters(jid)
+            crows = [[g, n, f"{v}"]
+                     for g, cs in sorted(counters.items())
+                     for n, v in sorted(cs.items())]
+            parts.append("<h2>Counters</h2>")
+            parts.append(html_table(["group", "counter", "value"], crows))
+            return "".join(parts)
+
+        def trackers_page(q: dict) -> str:
+            import time as _time
+            rows = []
+            for t in trackers_info(q):
+                st = t["status"] or {}
+                rows.append([
+                    t["name"],
+                    st.get("host", "?"),
+                    f"{st.get('count_cpu_map_tasks', 0)}"
+                    f"/{st.get('max_cpu_map_slots', 0)}",
+                    f"{st.get('count_tpu_map_tasks', 0)}"
+                    f"/{st.get('max_tpu_map_slots', 0)}",
+                    f"{st.get('count_reduce_tasks', 0)}"
+                    f"/{st.get('max_reduce_slots', 0)}",
+                    "".join("●" if free else "○"
+                            for free in st.get("available_tpu_devices",
+                                               [])),
+                    f"{max(0.0, _time.time() - t['last_seen']):.1f}s ago",
+                    RawHtml("<span class='bad'>blacklisted</span>"
+                            if t["blacklisted"] else
+                            "<span class='ok'>healthy</span>"
+                            if st.get("healthy", True) else
+                            "<span class='bad'>unhealthy</span>"),
+                ])
+            return "<h1>Trackers</h1>" + html_table(
+                ["tracker", "host", "cpu slots", "tpu slots",
+                 "reduce slots", "tpu devices (●=free)", "last heartbeat",
+                 "state"], rows)
+
+        srv.add_page("index", index_page)
+        srv.add_page("job", job_page, parameterized=True)
+        srv.add_page("trackers", trackers_page)
         return srv
 
     @property

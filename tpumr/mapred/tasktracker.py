@@ -196,10 +196,33 @@ class NodeRunner:
         if self._memory_manager is not None:
             self._memory_manager.start()
         if self._http_port >= 0:
-            from tpumr.http import StatusHttpServer
+            from tpumr.http import StatusHttpServer, html_table
             srv = StatusHttpServer(self.name, port=self._http_port)
             srv.add_json("status", lambda q: self._status_dict())
             srv.add_json("metrics", lambda q: self.metrics.snapshot())
+
+            def index_page(q: dict) -> str:
+                st = self._status_dict()
+                rows = [[s["attempt_id"], s["state"], s["phase"],
+                         (f"tpu:{s['tpu_device_id']}" if s["run_on_tpu"]
+                          else "cpu") if s["is_map"] else "reduce",
+                         f"{s['progress']:.0%}"]
+                        for s in st["task_statuses"]]
+                return (
+                    f"<h1>TaskTracker {st['tracker_name']}</h1>"
+                    f"<p>host {st['host']} · cpu "
+                    f"{st['count_cpu_map_tasks']}/{st['max_cpu_map_slots']}"
+                    f" · tpu {st['count_tpu_map_tasks']}/"
+                    f"{st['max_tpu_map_slots']} · reduce "
+                    f"{st['count_reduce_tasks']}/{st['max_reduce_slots']}"
+                    f" · devices free "
+                    + "".join("●" if f else "○"
+                              for f in st["available_tpu_devices"])
+                    + "</p><h2>Running attempts</h2>"
+                    + html_table(["attempt", "state", "phase", "backend",
+                                  "progress"], rows))
+
+            srv.add_page("index", index_page)
             self._http = srv.start()
         return self
 
