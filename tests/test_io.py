@@ -180,3 +180,92 @@ class TestAppendFixedRows:
         w.close()
         b.seek(0)
         assert list(sf.Reader(b)) == [(bytes(r), b"") for r in rows]
+
+
+# ---------------------------------------------------------------- TFile
+
+
+class TestTFile:
+    """≈ io/file/tfile TestTFile*: sorted container, block index,
+    range scanners, meta blocks."""
+
+    def _build(self, f, n=500, codec="zlib", block_bytes=512):
+        from tpumr.io import tfile
+        with tfile.Writer(f, codec=codec, block_bytes=block_bytes) as w:
+            for i in range(n):
+                w.append(f"k{i:06d}".encode(), f"v{i}".encode() * 3)
+            w.write_meta("stats", b'{"rows": 500}')
+        return f
+
+    def test_roundtrip_and_block_index(self):
+        import io as _io
+
+        from tpumr.io import tfile
+        f = self._build(_io.BytesIO())
+        r = tfile.Reader(f)
+        assert r.num_records == 500
+        assert len(r.block_keys) > 5, "never rolled a block"
+        recs = list(r)
+        assert len(recs) == 500
+        assert recs[0][0] == b"k000000" and recs[-1][0] == b"k000499"
+        assert recs == sorted(recs)
+
+    def test_seek_and_range_scanner(self):
+        import io as _io
+
+        from tpumr.io import tfile
+        r = tfile.Reader(self._build(_io.BytesIO()))
+        # exact get
+        assert r.get(b"k000123") == b"v123" * 3
+        assert r.get(b"nope") is None
+        # range [k000100, k000110)
+        keys = [k for k, _ in r.scanner(b"k000100", b"k000110")]
+        assert keys == [f"k{i:06d}".encode() for i in range(100, 110)]
+        # seek positions at first key >= target
+        it = r.seek_to(b"k000250")
+        assert next(it)[0] == b"k000250"
+
+    def test_meta_blocks(self):
+        import io as _io
+
+        from tpumr.io import tfile
+        r = tfile.Reader(self._build(_io.BytesIO()))
+        assert r.meta_names() == ["stats"]
+        assert r.meta("stats") == b'{"rows": 500}'
+
+    def test_out_of_order_append_rejected(self):
+        import io as _io
+
+        from tpumr.io import tfile
+        w = tfile.Writer(_io.BytesIO())
+        w.append(b"b", b"1")
+        with pytest.raises(tfile.TFileError, match="out of order"):
+            w.append(b"a", b"2")
+
+    def test_uncompressed_and_corrupt_magic(self):
+        import io as _io
+
+        from tpumr.io import tfile
+        f = self._build(_io.BytesIO(), codec="none")
+        r = tfile.Reader(f)
+        assert r.get(b"k000001") == b"v1v1v1"
+        with pytest.raises(tfile.TFileError, match="magic"):
+            tfile.Reader(_io.BytesIO(b"not a tfile at all"))
+
+    def test_duplicate_keys_across_block_boundary(self):
+        """Equal keys spanning a block boundary: scans starting at that
+        key must include records from the EARLIER block too."""
+        import io as _io
+
+        from tpumr.io import tfile
+        w = tfile.Writer(_io.BytesIO(), codec="none", block_bytes=16)
+        for i in range(6):
+            w.append(b"dup", b"v%d" % i)
+        w.append(b"zz", b"tail")
+        f = w._f
+        w.close()
+        r = tfile.Reader(f)
+        assert len(r.block_keys) >= 2
+        vals = [v for k, v in r.scanner(b"dup") if k == b"dup"]
+        assert vals == [b"v%d" % i for i in range(6)]
+        assert r.get(b"dup") == b"v0"

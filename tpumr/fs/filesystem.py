@@ -105,7 +105,9 @@ class FileSystem(ABC):
     #: schemes registered on first use (module imported lazily to avoid
     #: pulling daemon deps into every fs consumer)
     _lazy_schemes: dict[str, str] = {"tdfs": "tpumr.dfs.dfs_filesystem",
-                                     "tharch": "tpumr.tools.archive"}
+                                     "tharch": "tpumr.tools.archive",
+                                     "gs": "tpumr.fs.objectstore",
+                                     "s3": "tpumr.fs.objectstore"}
 
     # ------------------------------------------------------------ dispatch
 
@@ -118,17 +120,22 @@ class FileSystem(ABC):
         p = Path(uri) if not isinstance(uri, Path) else uri
         scheme = p.scheme or (conf.get("fs.default.name", "file") if conf is not None else "file")
         scheme = Path(scheme).scheme or scheme  # allow full default URIs
-        key = f"{scheme}://{p.authority}"
+        factory = cls._registry.get(scheme)
+        if factory is None and scheme in cls._lazy_schemes:
+            import importlib
+            importlib.import_module(cls._lazy_schemes[scheme])
+            factory = cls._registry.get(scheme)
+        if factory is None:
+            raise ValueError(f"no FileSystem for scheme {scheme!r}; "
+                             f"registered: {sorted(cls._registry)}")
+        # instances cache per scheme://authority; a factory whose backing
+        # store depends on conf (object-store emulation dir) contributes a
+        # conf-derived salt so different configs never share an instance
+        salt_fn = getattr(factory, "cache_salt", None)
+        key = f"{scheme}://{p.authority}" + \
+            (f"#{salt_fn(conf)}" if salt_fn else "")
         fs = cls._cache.get(key)
         if fs is None:
-            factory = cls._registry.get(scheme)
-            if factory is None and scheme in cls._lazy_schemes:
-                import importlib
-                importlib.import_module(cls._lazy_schemes[scheme])
-                factory = cls._registry.get(scheme)
-            if factory is None:
-                raise ValueError(f"no FileSystem for scheme {scheme!r}; "
-                                 f"registered: {sorted(cls._registry)}")
             import inspect
             params = inspect.signature(factory).parameters
             if "authority" in params:

@@ -1,0 +1,245 @@
+"""TFile — sorted, block-compressed, indexed key/value container.
+
+≈ ``org.apache.hadoop.io.file.tfile.TFile`` (reference:
+src/core/org/apache/hadoop/io/file/tfile/ — TFile.java, BCFile.java,
+~8k LoC): the third container format next to SequenceFile and MapFile.
+Contracts kept:
+
+- keys are raw byte strings appended in non-decreasing order (enforced
+  at append, ≈ TFile.Writer.append's key-ordering check);
+- records live in independently COMPRESSED data blocks (≈ BCFile data
+  blocks), so a scan touching one key range decompresses only the blocks
+  it crosses;
+- a data-block index of (first_key, offset, length) supports
+  ``seek_to(key)`` by binary search (≈ TFile.Reader.createScannerByKey);
+- named META blocks ride in the same file (≈ BCFile meta blocks);
+- readers address the file by ranges: ``scanner(start_key, stop_key)``
+  yields [start_key, stop_key) like TFile.Reader.createScanner.
+
+Single-stream layout (offsets from 0):
+
+    MAGIC "TFL1"
+    data block*        each: codec-compressed concat of
+                       (vint klen, vint vlen, key, value)*
+    meta block*        codec-compressed blobs
+    index              compressed list of data-block entries
+    trailer            json: codec, counts, index/meta offsets
+    u32 trailer_len, MAGIC "TFL1"
+
+The trailer is self-describing JSON — version-friendly, greppable, and
+costs a few dozen bytes per file (these are block-scale containers).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from bisect import bisect_left
+from typing import Any, BinaryIO, Iterator
+
+from tpumr.io.compress import get_codec
+from tpumr.io.writable import read_vint, write_vint
+
+MAGIC = b"TFL1"
+_U32 = struct.Struct(">I")
+
+
+class TFileError(ValueError):
+    pass
+
+
+class Writer:
+    """Append-only sorted writer (≈ TFile.Writer). The caller owns the
+    stream (SequenceFile convention in this codebase)."""
+
+    def __init__(self, stream: BinaryIO, codec: str = "zlib",
+                 block_bytes: int = 64 * 1024) -> None:
+        self._f = stream
+        self.codec_name = codec if codec else "none"
+        self._codec = get_codec(self.codec_name)
+        self.block_bytes = block_bytes
+        self._buf = io.BytesIO()
+        self._buf_first_key: bytes | None = None
+        self._buf_records = 0
+        self._last_key: bytes | None = None
+        #: (first_key, offset, compressed_len, n_records)
+        self._index: list[tuple[bytes, int, int, int]] = []
+        self._meta: dict[str, tuple[int, int]] = {}
+        self._meta_pending: dict[str, bytes] = {}
+        self._n_records = 0
+        self._closed = False
+        self._f.write(MAGIC)
+        self._pos = len(MAGIC)
+
+    def append(self, key: bytes, value: bytes) -> None:
+        key, value = bytes(key), bytes(value)
+        if self._last_key is not None and key < self._last_key:
+            raise TFileError(
+                f"keys out of order: {key!r} after {self._last_key!r} "
+                "(TFile keys must be appended sorted)")
+        self._last_key = key
+        if self._buf_first_key is None:
+            self._buf_first_key = key
+        write_vint(self._buf, len(key))
+        write_vint(self._buf, len(value))
+        self._buf.write(key)
+        self._buf.write(value)
+        self._buf_records += 1
+        self._n_records += 1
+        if self._buf.tell() >= self.block_bytes:
+            self._flush_block()
+
+    def write_meta(self, name: str, data: bytes) -> None:
+        """Named meta block (≈ BCFile prepareMetaBlock); written at
+        close."""
+        self._meta_pending[name] = bytes(data)
+
+    def _flush_block(self) -> None:
+        if self._buf_records == 0:
+            return
+        raw = self._buf.getvalue()
+        packed = self._codec.compress(raw)
+        self._index.append((self._buf_first_key or b"", self._pos,
+                            len(packed), self._buf_records))
+        self._f.write(packed)
+        self._pos += len(packed)
+        self._buf = io.BytesIO()
+        self._buf_first_key = None
+        self._buf_records = 0
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._flush_block()
+        for name, data in self._meta_pending.items():
+            packed = self._codec.compress(data)
+            self._f.write(packed)
+            self._meta[name] = (self._pos, len(packed))
+            self._pos += len(packed)
+        index_blob = io.BytesIO()
+        for first_key, off, clen, n in self._index:
+            write_vint(index_blob, len(first_key))
+            index_blob.write(first_key)
+            write_vint(index_blob, off)
+            write_vint(index_blob, clen)
+            write_vint(index_blob, n)
+        packed_index = self._codec.compress(index_blob.getvalue())
+        index_off = self._pos
+        self._f.write(packed_index)
+        self._pos += len(packed_index)
+        trailer = json.dumps({
+            "codec": self.codec_name,
+            "records": self._n_records,
+            "blocks": len(self._index),
+            "index": [index_off, len(packed_index)],
+            "meta": {k: list(v) for k, v in self._meta.items()},
+        }).encode()
+        self._f.write(trailer)
+        self._f.write(_U32.pack(len(trailer)))
+        self._f.write(MAGIC)
+        self._f.flush()
+
+    def __enter__(self) -> "Writer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class Reader:
+    """Range/seek reader (≈ TFile.Reader + Scanner). Needs a seekable
+    stream; the caller owns it."""
+
+    def __init__(self, stream: BinaryIO) -> None:
+        self._f = stream
+        self._f.seek(0)
+        if self._f.read(len(MAGIC)) != MAGIC:
+            raise TFileError("not a TFile (bad leading magic)")
+        self._f.seek(-(len(MAGIC) + _U32.size), io.SEEK_END)
+        tlen_at = self._f.tell()
+        tlen = _U32.unpack(self._f.read(_U32.size))[0]
+        if self._f.read(len(MAGIC)) != MAGIC:
+            raise TFileError("not a TFile (bad trailing magic)")
+        self._f.seek(tlen_at - tlen)
+        trailer = json.loads(self._f.read(tlen))
+        self.codec_name = trailer["codec"]
+        self._codec = get_codec(self.codec_name)
+        self.num_records = trailer["records"]
+        self._meta = {k: tuple(v) for k, v in trailer["meta"].items()}
+        idx_off, idx_len = trailer["index"]
+        self._f.seek(idx_off)
+        blob = io.BytesIO(self._codec.decompress(self._f.read(idx_len)))
+        #: parallel arrays for bisect
+        self.block_keys: list[bytes] = []
+        self._blocks: list[tuple[int, int, int]] = []
+        end = len(blob.getvalue())
+        while blob.tell() < end:
+            klen = read_vint(blob)
+            key = blob.read(klen)
+            off = read_vint(blob)
+            clen = read_vint(blob)
+            n = read_vint(blob)
+            self.block_keys.append(key)
+            self._blocks.append((off, clen, n))
+
+    # ------------------------------------------------------------ access
+
+    def meta_names(self) -> list[str]:
+        return sorted(self._meta)
+
+    def meta(self, name: str) -> bytes:
+        off, clen = self._meta[name]
+        self._f.seek(off)
+        return self._codec.decompress(self._f.read(clen))
+
+    def _block_records(self, i: int) -> Iterator[tuple[bytes, bytes]]:
+        off, clen, n = self._blocks[i]
+        self._f.seek(off)
+        blob = io.BytesIO(self._codec.decompress(self._f.read(clen)))
+        for _ in range(n):
+            klen = read_vint(blob)
+            vlen = read_vint(blob)
+            yield blob.read(klen), blob.read(vlen)
+
+    def scanner(self, start_key: "bytes | None" = None,
+                stop_key: "bytes | None" = None
+                ) -> Iterator[tuple[bytes, bytes]]:
+        """Yield (key, value) over [start_key, stop_key), decompressing
+        only the blocks the range crosses (≈ createScanner(byte[],byte[]))."""
+        if not self._blocks:
+            return
+        first = 0
+        if start_key is not None:
+            # one block BEFORE the leftmost whose first_key >= start_key:
+            # duplicate keys equal to a later block's first key may span
+            # the boundary backwards (bisect_right here would skip them)
+            first = max(0, bisect_left(self.block_keys,
+                                       bytes(start_key)) - 1)
+        for i in range(first, len(self._blocks)):
+            if stop_key is not None and self.block_keys[i] >= stop_key:
+                return
+            for k, v in self._block_records(i):
+                if start_key is not None and k < start_key:
+                    continue
+                if stop_key is not None and k >= stop_key:
+                    return
+                yield k, v
+
+    def seek_to(self, key: bytes) -> Iterator[tuple[bytes, bytes]]:
+        """Scanner positioned at the first record with key >= ``key``."""
+        return self.scanner(start_key=key)
+
+    def get(self, key: bytes, default: Any = None) -> Any:
+        """First value whose key == ``key`` (binary-searched block)."""
+        key = bytes(key)
+        for k, v in self.scanner(start_key=key):
+            if k == key:
+                return v
+            if k > key:
+                break
+        return default
+
+    def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
+        return self.scanner()
