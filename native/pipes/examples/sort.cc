@@ -1,0 +1,48 @@
+// Pipes sort — identity mapper/reducer.
+// ≈ src/examples/pipes/impl/sort.cc: the binary just passes records
+// through; the framework's sort/shuffle between map and reduce does the
+// actual ordering. Useful as the minimal pipes program and as a
+// shuffle-path exerciser from an external child.
+
+#include <cstdio>
+
+#include "../tpumr_pipes.hh"
+
+using tpumr::pipes::Factory;
+using tpumr::pipes::Mapper;
+using tpumr::pipes::Reducer;
+using tpumr::pipes::TaskContext;
+
+class IdentityMapper : public Mapper {
+ public:
+  explicit IdentityMapper(TaskContext&) {}
+  void map(TaskContext& ctx) {
+    // key on the line content so the framework sorts by it
+    ctx.emit(ctx.getInputValue(), "");
+  }
+};
+
+class IdentityReducer : public Reducer {
+ public:
+  explicit IdentityReducer(TaskContext&) {}
+  void reduce(TaskContext& ctx) {
+    while (ctx.nextValue())
+      ctx.emit(ctx.getInputKey(), ctx.getInputValue());
+  }
+};
+
+class SortFactory : public Factory {
+ public:
+  Mapper* createMapper(TaskContext& ctx) const {
+    return new IdentityMapper(ctx);
+  }
+  Reducer* createReducer(TaskContext& ctx) const {
+    return new IdentityReducer(ctx);
+  }
+};
+
+int main(int argc, char** argv) {
+  if (argc > 1) fprintf(stderr, "sort: bound to device %s\n", argv[1]);
+  SortFactory factory;
+  return tpumr::pipes::runTask(factory);
+}

@@ -1,0 +1,118 @@
+// Pipes wordcount with the child's OWN record reader (non-piped input).
+// ≈ src/examples/pipes/impl/wordcount-nopipe.cc: with
+// tpumr.pipes.piped.input=false the framework sends RUN_MAP with the
+// split description and NO per-record frames — the child parses the
+// split JSON, opens the file itself, and reads exactly its byte range.
+// This is the "bring your own reader" capability: record parsing costs
+// stay in native code and nothing crosses the pipe until output.
+
+#include <cstdio>
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+
+#include "../tpumr_pipes.hh"
+
+using tpumr::pipes::Factory;
+using tpumr::pipes::Mapper;
+using tpumr::pipes::Reducer;
+using tpumr::pipes::TaskContext;
+
+// minimal extraction from the split JSON ({"path": "file://...",
+// "start": N, "split_length": N, ...}); a real deployment would link a
+// JSON library — the demo keeps the binary dependency-free
+static std::string jsonString(const std::string& js, const std::string& k) {
+  std::string needle = "\"" + k + "\"";
+  size_t p = js.find(needle);
+  if (p == std::string::npos) return "";
+  p = js.find('"', p + needle.size() + 1);
+  if (p == std::string::npos) return "";
+  size_t e = js.find('"', p + 1);
+  return js.substr(p + 1, e - p - 1);
+}
+
+static long long jsonNumber(const std::string& js, const std::string& k) {
+  std::string needle = "\"" + k + "\"";
+  size_t p = js.find(needle);
+  if (p == std::string::npos) return 0;
+  p = js.find(':', p);
+  return atoll(js.c_str() + p + 1);
+}
+
+class NoPipeMapper : public Mapper {
+ public:
+  explicit NoPipeMapper(TaskContext&) {}
+
+  void map(TaskContext& ctx) {
+    const std::string& split = ctx.getInputSplit();
+    std::string path = jsonString(split, "path");
+    long long start = jsonNumber(split, "start");
+    long long length = jsonNumber(split, "split_length");
+    if (path.rfind("file://", 0) == 0) path = path.substr(7);
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) {
+      ctx.setStatus("cannot open " + path);
+      throw std::runtime_error("wordcount-nopipe: cannot open input");
+    }
+    // line-split contract of the framework's own TextInputFormat: a
+    // non-zero start skips the partial first line (the previous split
+    // owns it); read through the line crossing the end boundary
+    if (start > 0) {
+      fseek(f, start - 1, SEEK_SET);
+      int c;
+      while ((c = fgetc(f)) != EOF && c != '\n') {}
+    } else {
+      fseek(f, 0, SEEK_SET);
+    }
+    long long limit = start + length;
+    for (;;) {
+      // a line belongs to this split iff it STARTS inside [start, limit)
+      if (ftell(f) >= limit) break;
+      std::string line;
+      int c;
+      while ((c = fgetc(f)) != EOF && c != '\n') line.push_back(char(c));
+      size_t i = 0;
+      while (i < line.size()) {
+        while (i < line.size() &&
+               isspace(static_cast<unsigned char>(line[i])))
+          i++;
+        size_t w = i;
+        while (i < line.size() &&
+               !isspace(static_cast<unsigned char>(line[i])))
+          i++;
+        if (i > w) ctx.emit(line.substr(w, i - w), "1");
+      }
+      if (c == EOF) break;
+    }
+    fclose(f);
+  }
+};
+
+class SumReducer : public Reducer {
+ public:
+  explicit SumReducer(TaskContext&) {}
+  void reduce(TaskContext& ctx) {
+    long long sum = 0;
+    while (ctx.nextValue()) sum += atoll(ctx.getInputValue().c_str());
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%lld", sum);
+    ctx.emit(ctx.getInputKey(), buf);
+  }
+};
+
+class NoPipeFactory : public Factory {
+ public:
+  Mapper* createMapper(TaskContext& ctx) const {
+    return new NoPipeMapper(ctx);
+  }
+  Reducer* createReducer(TaskContext& ctx) const {
+    return new SumReducer(ctx);
+  }
+};
+
+int main(int argc, char** argv) {
+  if (argc > 1)
+    fprintf(stderr, "wordcount-nopipe: bound to device %s\n", argv[1]);
+  NoPipeFactory factory;
+  return tpumr::pipes::runTask(factory);
+}

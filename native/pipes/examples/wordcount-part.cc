@@ -1,0 +1,76 @@
+// Pipes wordcount with a C++ partitioner.
+// ≈ src/examples/pipes/impl/wordcount-part.cc: the child routes each map
+// output to a reduce itself (PARTITIONED_OUTPUT frames); the framework's
+// PipesPartitioner honors the child's choice, so custom routing logic can
+// live entirely in the user binary.
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "../tpumr_pipes.hh"
+
+using tpumr::pipes::Factory;
+using tpumr::pipes::Mapper;
+using tpumr::pipes::Partitioner;
+using tpumr::pipes::Reducer;
+using tpumr::pipes::TaskContext;
+
+class WordCountMapper : public Mapper {
+ public:
+  explicit WordCountMapper(TaskContext&) {}
+  void map(TaskContext& ctx) {
+    const std::string& line = ctx.getInputValue();
+    size_t i = 0;
+    while (i < line.size()) {
+      while (i < line.size() && isspace(static_cast<unsigned char>(line[i])))
+        i++;
+      size_t start = i;
+      while (i < line.size() && !isspace(static_cast<unsigned char>(line[i])))
+        i++;
+      if (i > start) ctx.emit(line.substr(start, i - start), "1");
+    }
+  }
+};
+
+class SumReducer : public Reducer {
+ public:
+  explicit SumReducer(TaskContext&) {}
+  void reduce(TaskContext& ctx) {
+    long long sum = 0;
+    while (ctx.nextValue()) sum += atoll(ctx.getInputValue().c_str());
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%lld", sum);
+    ctx.emit(ctx.getInputKey(), buf);
+  }
+};
+
+// first-byte partitioner (same idea as the reference's WordCountPartitioner:
+// a deliberately observable, deterministic routing rule)
+class FirstBytePartitioner : public Partitioner {
+ public:
+  int partition(const std::string& key, int numReduces) {
+    if (key.empty() || numReduces <= 0) return 0;
+    return static_cast<unsigned char>(key[0]) % numReduces;
+  }
+};
+
+class WordCountPartFactory : public Factory {
+ public:
+  Mapper* createMapper(TaskContext& ctx) const {
+    return new WordCountMapper(ctx);
+  }
+  Reducer* createReducer(TaskContext& ctx) const {
+    return new SumReducer(ctx);
+  }
+  Partitioner* createPartitioner(TaskContext&) const {
+    return new FirstBytePartitioner();
+  }
+};
+
+int main(int argc, char** argv) {
+  if (argc > 1)
+    fprintf(stderr, "wordcount-part: bound to device %s\n", argv[1]);
+  WordCountPartFactory factory;
+  return tpumr::pipes::runTask(factory);
+}

@@ -242,12 +242,13 @@ bool JobConf::getBoolean(const std::string& key, bool def) const {
 class TaskRunner : public TaskContext {
  public:
   TaskRunner(const Factory& factory, SocketStream& io)
-      : factory_(factory), io_(io), nextCounter_(0),
+      : factory_(factory), io_(io), nextCounter_(0), numReduces_(0),
         havePendingKey_(false), closed_(false) {}
 
   int run() {
     std::unique_ptr<Mapper> mapper;
     std::unique_ptr<Reducer> reducer;
+    std::unique_ptr<Partitioner> partitioner;
     for (;;) {
       uint64_t code = io_.readVarint();
       if (code == START) {
@@ -264,9 +265,17 @@ class TaskRunner : public TaskContext {
         io_.readBytes();
       } else if (code == RUN_MAP) {
         split_ = io_.readBytes();
-        io_.readVarint();  // num reduces
-        io_.readVarint();  // piped input
+        numReduces_ = int(io_.readVarint());
+        uint64_t pipedInput = io_.readVarint();
         mapper.reset(factory_.createMapper(*this));
+        partitioner.reset(factory_.createPartitioner(*this));
+        partitioner_ = partitioner.get();
+        if (!pipedInput) {
+          // non-piped input (≈ wordcount-nopipe / isJavaInput=false,
+          // Submitter's own-reader mode): the child reads the split
+          // itself — one map() call over the whole split, no MAP_ITEMs
+          mapper->map(*this);
+        }
       } else if (code == MAP_ITEM) {
         key_ = io_.readBytes();
         value_ = io_.readBytes();
@@ -318,6 +327,13 @@ class TaskRunner : public TaskContext {
   const std::string& getInputValue() { return value_; }
   const std::string& getInputSplit() { return split_; }
   void emit(const std::string& key, const std::string& value) {
+    // a user partitioner routes map output itself (≈ HadoopPipes.cc:
+    // emit via partitioned writer when a partitioner is defined)
+    if (partitioner_ && numReduces_ > 0) {
+      partitionedEmit(partitioner_->partition(key, numReduces_),
+                      key, value);
+      return;
+    }
     io_.writeVarint(OUTPUT);
     io_.writeBytes(key);
     io_.writeBytes(value);
@@ -374,12 +390,16 @@ class TaskRunner : public TaskContext {
     throw std::runtime_error("unexpected opcode inside reduce");
   }
 
+  int getNumReduces() { return numReduces_; }
+
  private:
   const Factory& factory_;
   SocketStream& io_;
   JobConf conf_;
   std::string key_, value_, split_, pendingKey_;
   int nextCounter_;
+  int numReduces_;
+  Partitioner* partitioner_ = 0;
   bool havePendingKey_, closed_;
 };
 

@@ -44,6 +44,8 @@ class TaskContext {
   virtual void incrementCounter(int counterId, uint64_t amount) = 0;
   // reduce side: advance the value cursor; false at end of key group
   virtual bool nextValue() = 0;
+  // map side: reduce count of the job (for custom partitioners)
+  virtual int getNumReduces() = 0;
 };
 
 class Mapper {
@@ -61,11 +63,22 @@ class Reducer {
   virtual void close() {}
 };
 
+class Partitioner {
+ public:
+  virtual ~Partitioner() {}
+  // ≈ Pipes.hh Partitioner::partition: route a map output key to a
+  // reduce; the runtime then ships PARTITIONED_OUTPUT frames and the
+  // framework's PipesPartitioner honors the child's choice
+  virtual int partition(const std::string& key, int numReduces) = 0;
+};
+
 class Factory {
  public:
   virtual ~Factory() {}
   virtual Mapper* createMapper(TaskContext& context) const = 0;
   virtual Reducer* createReducer(TaskContext& context) const = 0;
+  // optional: NULL (the default) = framework-side hash partitioning
+  virtual Partitioner* createPartitioner(TaskContext&) const { return 0; }
 };
 
 // Child entry point (≈ HadoopPipes::runTask). Returns the process exit code.

@@ -181,15 +181,8 @@ def test_pipes_distributed_hybrid(tmp_path):
 
 
 @pytest.fixture(scope="module")
-def cpp_wordcount():
-    if shutil.which("g++") is None:
-        pytest.skip("g++ not available")
-    native = os.path.join(REPO, "native", "pipes")
-    build = subprocess.run(["make", "-C", native], capture_output=True,
-                           text=True)
-    if build.returncode != 0:
-        pytest.fail(f"native pipes build failed:\n{build.stderr}")
-    return os.path.join(native, "build", "wordcount")
+def cpp_wordcount(cpp_examples):
+    return os.path.join(cpp_examples, "wordcount")
 
 
 def test_pipes_wordcount_cpp_child(cpp_wordcount, tmp_path):
@@ -208,3 +201,167 @@ def test_pipes_wordcount_cpp_child(cpp_wordcount, tmp_path):
     out = _read_output(fs, "mem:///cpp/out")
     assert out == {"tpu": "30", "mxu": "20", "ici": "10"}
     assert result.counters.value("WordCount", "INPUT_WORDS") == 60
+
+
+@pytest.fixture(scope="module")
+def cpp_examples():
+    """Build all native pipes examples once (≈ the reference's 4 demos)."""
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    native = os.path.join(REPO, "native", "pipes")
+    build = subprocess.run(["make", "-C", native], capture_output=True,
+                           text=True)
+    if build.returncode != 0:
+        pytest.fail(f"native pipes build failed:\n{build.stderr}")
+    return os.path.join(native, "build")
+
+
+def test_pipes_wordcount_part_cpp_partitioner(cpp_examples, tmp_path):
+    """≈ wordcount-part.cc: the CHILD routes outputs (first-byte
+    partitioner → PARTITIONED_OUTPUT frames); each word must land in the
+    partition its first byte selects."""
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/cpppart/in.txt", b"apple crumble apple\nbanana crumble\n" * 5)
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///cpppart/in.txt")
+    conf.set_output_path("mem:///cpppart/out")
+    conf.set_num_reduce_tasks(2)
+    conf.set("tpumr.cache.dir", str(tmp_path / "cache"))
+    Submitter.set_executable(conf,
+                             os.path.join(cpp_examples, "wordcount-part"))
+    result = Submitter.run_job(conf)
+    assert result.successful
+
+    by_part = {}
+    for st in fs.list_files("mem:///cpppart/out"):
+        if st.path.name.startswith("part-"):
+            idx = int(st.path.name.rsplit("-", 1)[1])
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                k, v = line.split("\t")
+                by_part[k] = (idx, int(v))
+    assert {k: v[1] for k, v in by_part.items()} == \
+        {"apple": 10, "banana": 5, "crumble": 10}
+    for word, (idx, _) in by_part.items():
+        assert idx == ord(word[0]) % 2, f"{word} landed in wrong partition"
+
+
+def test_pipes_sort_cpp_identity(cpp_examples, tmp_path):
+    """≈ sort.cc: identity child; the framework's sort/shuffle orders the
+    records."""
+    fs = get_filesystem("mem:///")
+    lines = [f"k{97 - i:03d}" for i in range(60)]
+    fs.write_bytes("/cppsort/in.txt", ("\n".join(lines) + "\n").encode())
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///cppsort/in.txt")
+    conf.set_output_path("mem:///cppsort/out")
+    conf.set_num_reduce_tasks(1)
+    conf.set("tpumr.cache.dir", str(tmp_path / "cache"))
+    Submitter.set_executable(conf, os.path.join(cpp_examples, "sort"))
+    result = Submitter.run_job(conf)
+    assert result.successful
+    out_keys = []
+    for st in fs.list_files("mem:///cppsort/out"):
+        if st.path.name.startswith("part-"):
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                out_keys.append(line.split("\t")[0])
+    assert out_keys == sorted(lines)
+
+
+def test_pipes_wordcount_nopipe_child_reads_split(cpp_examples, tmp_path):
+    """≈ wordcount-nopipe.cc: tpumr.pipes.piped.input=false — the child
+    parses the split JSON and reads its own byte range; multiple splits
+    must not double-count boundary lines."""
+    src = tmp_path / "nopipe-in.txt"
+    src.write_bytes(b"red green red\nblue green\n" * 40)
+
+    conf = JobConf()
+    conf.set_input_paths(f"file://{src}")
+    conf.set_output_path(f"file://{tmp_path}/nopipe-out")
+    conf.set_num_reduce_tasks(1)
+    conf.set("mapred.map.tasks", 3)
+    conf.set("mapred.min.split.size", 1)
+    conf.set("tpumr.pipes.piped.input", False)
+    conf.set("tpumr.cache.dir", str(tmp_path / "cache"))
+    Submitter.set_executable(conf,
+                             os.path.join(cpp_examples, "wordcount-nopipe"))
+    result = Submitter.run_job(conf)
+    assert result.successful
+    out = {}
+    for name in (tmp_path / "nopipe-out").iterdir():
+        if name.name.startswith("part-"):
+            for line in name.read_text().splitlines():
+                k, v = line.split("\t")
+                out[k] = int(v)
+    assert out == {"red": 80, "green": 80, "blue": 40}
+
+
+NOPIPE_PY = """
+    import json
+    from tpumr.pipes import child
+
+    class M(child.Mapper):
+        def __init__(self, ctx):
+            self.ctx = ctx
+
+        def map(self, ctx):
+            # own-reader mode: one call, the split JSON in input_split
+            split = json.loads(ctx.input_split.decode())
+            path = split["path"].replace("file://", "")
+            start, length = split["start"], split["split_length"]
+            with open(path, "rb") as f:
+                if start > 0:
+                    f.seek(start - 1)
+                    f.readline()  # previous split owns the partial line
+                while f.tell() < start + length:
+                    line = f.readline()
+                    if not line:
+                        break
+                    for w in line.split():
+                        ctx.emit(w, "1")
+
+    class R(child.Reducer):
+        def reduce(self, ctx):
+            total = 0
+            while ctx.next_value():
+                total += int(ctx.input_value)
+            ctx.emit(ctx.input_key, str(total))
+
+    class F(child.Factory):
+        def create_mapper(self, ctx):
+            return M(ctx)
+
+        def create_reducer(self, ctx):
+            return R()
+
+    raise SystemExit(child.run_task(F()))
+"""
+
+
+def test_pipes_nopipe_python_child(tmp_path):
+    """Own-reader mode for PYTHON children too: with piped.input=false the
+    child maps once over the split it reads itself — never a silent
+    zero-record success."""
+    prog = _write_script(str(tmp_path / "nopipe.py"), NOPIPE_PY)
+    src = tmp_path / "np-in.txt"
+    src.write_bytes(b"dog cat dog\ncat\n" * 30)
+
+    conf = JobConf()
+    conf.set_input_paths(f"file://{src}")
+    conf.set_output_path(f"file://{tmp_path}/np-out")
+    conf.set_num_reduce_tasks(1)
+    conf.set("mapred.map.tasks", 2)
+    conf.set("mapred.min.split.size", 1)
+    conf.set("tpumr.pipes.piped.input", False)
+    conf.set("tpumr.cache.dir", str(tmp_path / "cache"))
+    Submitter.set_executable(conf, prog)
+    result = Submitter.run_job(conf)
+    assert result.successful
+    out = {}
+    for name in (tmp_path / "np-out").iterdir():
+        if name.name.startswith("part-"):
+            for line in name.read_text().splitlines():
+                k, v = line.split("\t")
+                out[k] = int(v)
+    assert out == {"dog": 60, "cat": 60}

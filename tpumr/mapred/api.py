@@ -15,6 +15,7 @@ same job, which is what makes hybrid CPU/TPU assignment meaningful.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Iterator
 
 from tpumr.core.counters import Counters
@@ -197,3 +198,70 @@ class MapRunner(MapRunnable):
                 self.mapper.map(key, value, output, reporter)
         finally:
             self.mapper.close()
+
+
+class MultithreadedMapRunner(MapRunner):
+    """Thread-pooled record runner ≈ mapred/lib/MultithreadedMapRunner.java
+    (parallelism strategy #8, SURVEY.md §2.5): N worker threads call
+    ``map()`` concurrently within ONE slot — for mappers that block on
+    external IO (RPC lookups, fetches), not for CPU parallelism (the GIL;
+    CPU-bound batching belongs to the kernel/batch runners).
+
+    Contracts kept from the reference: one shared mapper instance (the
+    user's map() must be thread-safe, as documented there); the output
+    collector is serialized behind a lock (≈ its synchronized collector
+    wrapper); the first worker exception aborts the run and re-raises on
+    the main thread (≈ its ioException/runtimeException fields); thread
+    count from ``mapred.map.multithreadedrunner.threads`` (same key,
+    default 10)."""
+
+    def run(self, reader, output, reporter, task_ctx=None) -> None:
+        assert self.mapper is not None
+        import queue as _queue
+
+        n_threads = max(1, self.conf.get_int(
+            "mapred.map.multithreadedrunner.threads", 10))
+        out_lock = threading.Lock()
+        locked_collect = OutputCollector(
+            lambda k, v: _locked_call(out_lock, output, k, v))
+        work: _queue.Queue = _queue.Queue(maxsize=n_threads * 2)
+        errors: list[BaseException] = []
+        err_lock = threading.Lock()
+
+        def worker() -> None:
+            while True:
+                item = work.get()
+                if item is None:
+                    return
+                try:
+                    self.mapper.map(item[0], item[1], locked_collect,
+                                    reporter)
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    with err_lock:
+                        errors.append(e)
+
+        threads = [threading.Thread(target=worker,
+                                    name=f"mt-map-{i}", daemon=True)
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        try:
+            for key, value in reader:
+                with err_lock:
+                    if errors:
+                        break
+                work.put((key, value))
+        finally:
+            for _ in threads:
+                work.put(None)
+            for t in threads:
+                t.join()
+            self.mapper.close()
+        if errors:
+            raise errors[0]
+
+
+def _locked_call(lock: "threading.Lock", output: Any, k: Any,
+                 v: Any) -> None:
+    with lock:
+        output.collect(k, v)

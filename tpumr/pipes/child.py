@@ -198,11 +198,17 @@ class _TaskRunner:
             elif code == P.RUN_MAP:
                 split = P.read_bytes(self.inp)
                 nred = P.read_varint(self.inp)
-                P.read_varint(self.inp)  # piped input flag
+                piped_input = P.read_varint(self.inp)
                 self.ctx = TaskContext(self.up, conf)
                 self.ctx.input_split = split
                 self.ctx.num_reduces = nred
                 self.mapper = self.factory.create_mapper(self.ctx)
+                if not piped_input:
+                    # own-reader mode (tpumr.pipes.piped.input=false): no
+                    # MAP_ITEM frames will come — map() runs once over the
+                    # whole split, which the mapper reads itself (same
+                    # contract as the C++ child / wordcount-nopipe)
+                    self.mapper.map(self.ctx)
             elif code == P.MAP_ITEM:
                 assert self.mapper is not None and self.ctx is not None
                 self.ctx.input_key = P.read_bytes(self.inp)

@@ -116,13 +116,19 @@ class PipesMapRunner(MapRunnable):
             down.start()
             down.set_job_conf(_wire_conf_items(conf))
             split = getattr(task_ctx, "split", None) or {}
+            # non-piped input (≈ Submitter -inputformat / isJavaInput=false,
+            # the wordcount-nopipe mode): the child owns the record reader
+            # and reads the split itself — no MAP_ITEM frames cross the pipe
+            piped = conf.get_boolean("tpumr.pipes.piped.input", True)
             down.run_map(json.dumps(split).encode("utf-8"), num_reduces,
-                         piped_input=True)
-            # per-record downlink hot loop ≈ PipesMapRunner.java:97-107 —
-            # kept for compatibility; the TPU-native path avoids it entirely
-            # by running the map as a kernel in-process (tpu_runner)
-            for key, value in reader:
-                down.map_item(encode(key), encode(value))
+                         piped_input=piped)
+            if piped:
+                # per-record downlink hot loop ≈ PipesMapRunner.java:97-107
+                # — kept for compatibility; the TPU-native path avoids it
+                # entirely by running the map as a kernel in-process
+                # (tpu_runner)
+                for key, value in reader:
+                    down.map_item(encode(key), encode(value))
             down.close()
             app.wait_for_finish()
         except Exception:
