@@ -457,3 +457,149 @@ def test_owner_can_overwrite_in_readonly_dir(tmp_path):
                 f.write(b"v2")
             with client.open("/ro/own") as f:
                 assert f.read() == b"v2"
+
+
+def test_namespace_and_space_quotas(tmp_path):
+    """≈ TestQuota: dfsadmin-set quotas reject namespace/space overruns
+    with actionable errors; clearing restores writes."""
+    from tpumr.ipc.rpc import RpcError
+
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        client = c.client()
+        client.mkdirs("/q")
+        client.nn.call("set_quota", "/q", 3, None)   # max 3 inodes
+        client.create("/q/a").close()
+        client.create("/q/b").close()
+        client.create("/q/c").close()
+        with pytest.raises(RpcError, match="namespace quota"):
+            client.create("/q/d").close()
+        client.nn.call("set_quota", "/q", -1, None)  # clear
+        client.create("/q/d").close()
+
+        # space quota: 1 block of 1024 x rep 1 fits, the second doesn't
+        client.mkdirs("/sq")
+        client.nn.call("set_quota", "/sq", None, 1500)
+        with pytest.raises(RpcError, match="space quota"):
+            with client.create("/sq/big") as f:
+                f.write(b"B" * 3000)  # needs 3 blocks
+
+
+def test_decommission_drains_and_completes():
+    """≈ TestDecommission: a draining node takes no new replicas, its
+    blocks are copied off, and it reaches 'decommissioned'."""
+    conf = small_conf(replication=2)
+    with MiniDFSCluster(num_datanodes=3, conf=conf) as c:
+        client = c.client()
+        with client.create("/dec/f", replication=2) as f:
+            f.write(b"D" * 2500)
+        blocks = client.nn.call("get_block_locations", "/dec/f")
+        victim = blocks[0]["locations"][0]
+        state = client.nn.call("set_decommission", victim, "start")
+        assert state == "decommissioning"
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            report = {d["addr"]: d.get("state")
+                      for d in client.datanode_report() if "addr" in d}
+            if report.get(victim) == "decommissioned":
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail(f"never decommissioned: {report}")
+        # every block now fully replicated on the OTHER nodes
+        for blk in client.nn.call("get_block_locations", "/dec/f"):
+            others = [a for a in blk["locations"] if a != victim]
+            assert len(others) >= 2, blk
+        with client.open("/dec/f") as f:
+            assert f.read() == b"D" * 2500
+
+
+def test_block_scanner_detects_and_heals_corruption():
+    """≈ DataBlockScanner: background CRC sweep finds a silently corrupted
+    replica, reports it, and the NameNode re-replicates from a good copy."""
+    conf = small_conf(replication=2)
+    conf.set("tdfs.datanode.scan.period.s", 0)  # drive scan_once manually
+    with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+        client = c.client()
+        with client.create("/scan/f", replication=2) as f:
+            f.write(b"S" * 900)
+        blk = client.nn.call("get_block_locations", "/scan/f")[0]
+        victim_addr = blk["locations"][0]
+        victim = next(dn for dn in c.datanodes if dn.addr == victim_addr)
+        path = victim.store._path(blk["block_id"])
+        raw = bytearray(open(path, "rb").read())
+        raw[5] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+
+        bad = victim.scan_once()
+        assert bad == [blk["block_id"]]
+        # NN dropped the corrupt replica and re-replicates to the victim
+        # (the only other node) — eventually 2 healthy replicas again
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            locs = client.nn.call("get_block_locations",
+                                  "/scan/f")[0]["locations"]
+            if len(locs) == 2 and victim.scan_once() == []:
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("corrupt replica never healed")
+        with client.open("/scan/f") as f:
+            assert f.read() == b"S" * 900
+
+
+def test_quota_rename_and_setrep_and_intermediates(tmp_path):
+    """Review regressions: renames charge the destination quota (exempting
+    quota dirs that already contain the source), replication increases
+    charge space quotas, and implicit intermediate dirs count."""
+    from tpumr.ipc.rpc import RpcError
+
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        client = c.client()
+        # intermediates: quota 3, create /q/a/b/c/f needs 4 inodes
+        client.mkdirs("/q")
+        client.nn.call("set_quota", "/q", 3, None)
+        with pytest.raises(RpcError, match="namespace quota"):
+            client.create("/q/a/b/c/f").close()
+        # rename INTO a full quota dir rejected
+        client.create("/q/x").close()
+        client.create("/q/y").close()
+        client.mkdirs("/outside")
+        client.create("/outside/z1").close()
+        client.create("/outside/z2").close()
+        with pytest.raises(RpcError, match="namespace quota"):
+            client.rename("/outside", "/q/moved")
+        # rename WITHIN the quota dir is net-zero and allowed
+        assert client.rename("/q/x", "/q/x2")
+        # space quota blocks raising replication
+        client.mkdirs("/sp")
+        client.nn.call("set_quota", "/sp", None, 2000)
+        with client.create("/sp/f", replication=1) as f:
+            f.write(b"Q" * 1024)
+        time.sleep(0.3)  # block sizes reported
+        with pytest.raises(RpcError, match="space quota"):
+            client.set_replication("/sp/f", 3)
+
+
+def test_decommission_survives_namenode_restart():
+    conf = small_conf(replication=2)
+    with MiniDFSCluster(num_datanodes=3, conf=conf) as c:
+        client = c.client()
+        victim = c.datanodes[0].addr
+        client.nn.call("set_decommission", victim, "start")
+        c.restart_namenode()
+        client2 = c.client()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                report = {d["addr"]: d.get("state")
+                          for d in client2.datanode_report()
+                          if "addr" in d}
+                if victim in report:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert report.get(victim, "in-service") != "in-service", report

@@ -204,3 +204,102 @@ class TestObjectStoreFs:
         gs.write_bytes("gs://bucket/deep/obj", b"o")
         assert gs.rename("gs://bucket/deep/obj", "gs://bucket/")
         assert gs.read_bytes("gs://bucket/obj") == b"o"
+
+
+# ---------------------------------------------------------------- trash
+
+
+class TestTrash:
+    """≈ TestTrash: fs.trash.interval routes shell deletes into the
+    per-user trash; checkpoints age out; -skipTrash bypasses."""
+
+    def _shell(self, tmp_path, interval_min=60):
+        from tpumr.fs.shell import FsShell
+        from tpumr.mapred.jobconf import JobConf
+        conf = JobConf()
+        conf.set("fs.trash.interval", interval_min)
+        conf.set("fs.trash.root", f"{tmp_path}/.Trash")
+        import io as _io
+        out = _io.StringIO()
+        return FsShell(conf, default_fs=f"file://{tmp_path}",
+                       out=out, err=out), conf, out
+
+    def test_rm_moves_to_trash_and_is_restorable(self, tmp_path):
+        from tpumr.fs import get_filesystem
+        sh, conf, out = self._shell(tmp_path)
+        victim = tmp_path / "data" / "keepme.txt"
+        victim.parent.mkdir()
+        victim.write_bytes(b"precious")
+        assert sh.run(["-rm", f"file://{victim}"]) == 0
+        assert "Moved to trash" in out.getvalue()
+        assert not victim.exists()
+        trashed = (tmp_path / ".Trash" / "Current"
+                   / str(victim).lstrip("/"))
+        assert trashed.read_bytes() == b"precious"
+        # restore = rename back
+        fs = get_filesystem(f"file://{tmp_path}", conf)
+        assert fs.rename(f"file://{trashed}", f"file://{victim}")
+        assert victim.read_bytes() == b"precious"
+
+    def test_skip_trash_really_deletes(self, tmp_path):
+        sh, conf, out = self._shell(tmp_path)
+        victim = tmp_path / "gone.txt"
+        victim.write_bytes(b"x")
+        assert sh.run(["-rm", "-skipTrash", f"file://{victim}"]) == 0
+        assert "Deleted" in out.getvalue()
+        assert not victim.exists()
+        assert not (tmp_path / ".Trash").exists()
+
+    def test_trash_disabled_deletes_outright(self, tmp_path):
+        sh, conf, out = self._shell(tmp_path, interval_min=0)
+        victim = tmp_path / "plain.txt"
+        victim.write_bytes(b"x")
+        assert sh.run(["-rm", f"file://{victim}"]) == 0
+        assert "Deleted" in out.getvalue()
+        assert not (tmp_path / ".Trash").exists()
+
+    def test_checkpoint_expiry_and_expunge(self, tmp_path):
+        import time as _time
+
+        from tpumr.fs import get_filesystem
+        from tpumr.fs.trash import Trash
+        from tpumr.mapred.jobconf import JobConf
+        conf = JobConf()
+        conf.set("fs.trash.interval", 1)  # 1 minute
+        conf.set("fs.trash.root", f"{tmp_path}/.Trash")
+        fs = get_filesystem(f"file://{tmp_path}", conf)
+        trash = Trash(fs, conf, user="tester")
+        f = tmp_path / "old.txt"
+        f.write_bytes(b"old")
+        assert trash.move_to_trash(f"file://{f}")
+        stamp = trash.checkpoint()
+        assert stamp is not None
+        # young checkpoint survives expunge
+        assert trash.expunge() == 0
+        # age it past the interval by renaming to an old timestamp
+        old = str(int(_time.time() - 120))
+        fs.rename(stamp, trash.trash_root(stamp).child(old))
+        assert trash.expunge() == 1
+        # deleting a path already IN trash never re-trashes
+        g = tmp_path / "g.txt"
+        g.write_bytes(b"g")
+        assert trash.move_to_trash(f"file://{g}")
+        inside = trash.trash_root(f"file://{g}").child("Current")
+        assert trash.move_to_trash(inside) is False
+        # ... but a dir merely NAMED .Trash elsewhere is ordinary data
+        other = tmp_path / "backups" / ".Trash"
+        other.mkdir(parents=True)
+        (other / "notes.txt").write_bytes(b"keep")
+        assert trash.move_to_trash(f"file://{other}/notes.txt") is True
+
+    def test_expunge_all_via_shell(self, tmp_path):
+        sh, conf, out = self._shell(tmp_path)
+        victim = tmp_path / "v.txt"
+        victim.write_bytes(b"v")
+        assert sh.run(["-rm", f"file://{victim}"]) == 0
+        assert sh.run(["-expunge"]) == 0
+        assert "Expunged 1" in out.getvalue()
+        troot = tmp_path / ".Trash"
+        names = [p.name for p in troot.iterdir()] if troot.exists() else []
+        assert "Current" not in names
+        assert not any(n.isdigit() for n in names)

@@ -36,6 +36,7 @@ Clients:
   job ...              job control: -list | -status ID | -kill ID | -counters ID
   balancer -nn HOST:PORT                     rebalance tdfs blocks
   fsck [PATH]          tdfs health report (missing/under-replicated blocks)
+  dfsadmin ...         quotas, decommissioning, safemode, cluster report
   pipes ...            submit an external-binary (pipes) job
   streaming ...        submit a script (streaming) job
   examples NAME ...    run an example program (examples -h lists them)
@@ -297,6 +298,68 @@ def cmd_fsck(conf, argv: list[str]) -> int:
     return 0 if r["healthy"] else 1
 
 
+def cmd_dfsadmin(conf, argv: list[str]) -> int:
+    """≈ bin/hadoop dfsadmin: quotas, decommissioning, cluster report."""
+    from tpumr.fs import get_filesystem
+    usage = ("Usage: tpumr dfsadmin -setQuota N PATH | -setSpaceQuota N "
+             "PATH | -clrQuota PATH | -clrSpaceQuota PATH | "
+             "-decommission ADDR start|stop | "
+             "-report | -safemode enter|leave|get | -saveNamespace")
+    if not argv:
+        print(usage, file=sys.stderr)
+        return 255
+
+    def dfs(path="/"):
+        uri = path if "://" in path else \
+            (conf.get("fs.default.name") or "") .rstrip("/") + path
+        fs = get_filesystem(uri, conf)
+        if not hasattr(fs, "client"):
+            raise SystemExit(f"dfsadmin: {uri} is not a tdfs:// filesystem")
+        return fs, uri
+
+    cmd, *rest = argv
+    if cmd == "-setQuota" and len(rest) == 2:
+        fs, uri = dfs(rest[1])
+        fs.client.nn.call("set_quota", fs._p(uri), int(rest[0]), None)
+        return 0
+    if cmd == "-setSpaceQuota" and len(rest) == 2:
+        fs, uri = dfs(rest[1])
+        fs.client.nn.call("set_quota", fs._p(uri), None, int(rest[0]))
+        return 0
+    if cmd == "-clrQuota" and len(rest) == 1:
+        fs, uri = dfs(rest[0])
+        fs.client.nn.call("set_quota", fs._p(uri), -1, None)
+        return 0
+    if cmd == "-clrSpaceQuota" and len(rest) == 1:
+        fs, uri = dfs(rest[0])
+        fs.client.nn.call("set_quota", fs._p(uri), None, -1)
+        return 0
+    if cmd == "-decommission" and len(rest) == 2:
+        fs, _ = dfs("/")
+        state = fs.client.nn.call("set_decommission", rest[0], rest[1])
+        print(f"{rest[0]}: {state}")
+        return 0
+    if cmd == "-safemode" and len(rest) == 1:
+        fs, _ = dfs("/")
+        print(f"Safe mode is {'ON' if fs.client.nn.call('safemode', rest[0]) else 'OFF'}")
+        return 0
+    if cmd == "-saveNamespace":
+        fs, _ = dfs("/")
+        fs.client.nn.call("save_namespace")
+        return 0
+    if cmd == "-report":
+        fs, _ = dfs("/")
+        for d in fs.client.datanode_report():
+            cap = d.get("capacity") or 0
+            used = d.get("used", 0)
+            pct = f"{100 * used / cap:.1f}%" if cap else "?"
+            print(f"{d.get('addr', '?')}\t{d.get('state', '?')}\t"
+                  f"blocks={d.get('blocks', '?')}\tused={used} ({pct})")
+        return 0
+    print(usage, file=sys.stderr)
+    return 255
+
+
 def cmd_gridmix(conf, argv: list[str]) -> int:
     from tpumr.benchmarks.gridmix import main as gridmix_main
     return gridmix_main(argv)
@@ -346,6 +409,7 @@ COMMANDS = {
     "historyserver": cmd_historyserver,
     "balancer": cmd_balancer,
     "fsck": cmd_fsck,
+    "dfsadmin": cmd_dfsadmin,
     "fs": cmd_fs,
     "job": cmd_job,
     "pipes": cmd_pipes,

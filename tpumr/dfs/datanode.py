@@ -111,6 +111,14 @@ class DataNode:
                                     name="dn-heartbeat", daemon=True)
         self._peer_clients: dict[str, RpcClient] = {}
         self._lock = threading.Lock()
+        #: periodic CRC verification of every stored block ≈
+        #: DataBlockScanner (reference default: one full pass per 3
+        #: weeks; here per-period sweep, 0 disables)
+        self.scan_period_s = float(conf.get("tdfs.datanode.scan.period.s",
+                                            6 * 3600))
+        self._scanner = threading.Thread(target=self._scan_loop,
+                                         name="dn-block-scanner",
+                                         daemon=True)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -118,6 +126,8 @@ class DataNode:
         self._server.start()
         self._register()
         self._hb.start()
+        if self.scan_period_s > 0:
+            self._scanner.start()
         return self
 
     def stop(self) -> None:
@@ -153,6 +163,36 @@ class DataNode:
                 for cmd in cmds:
                     self._apply_command(cmd)
             except Exception:  # noqa: BLE001 — NN briefly unreachable
+                pass
+
+    # ------------------------------------------------------------ scanner
+
+    def scan_once(self) -> "list[int]":
+        """One verification sweep over every stored block; corrupt ones
+        are reported to the NameNode (which drops the replica — unless it
+        is the last — and re-replicates from a good copy). Returns the
+        corrupt block ids found."""
+        bad = []
+        for bid, _size in self.store.blocks():
+            if self._stop.is_set():
+                break
+            try:
+                self.store.read(bid)  # full read = CRC verification
+            except ChecksumError:
+                bad.append(bid)
+                try:
+                    self.nn.call("report_bad_block", bid, self.addr)
+                except Exception:  # noqa: BLE001 — retried next sweep
+                    pass
+            except FileNotFoundError:
+                continue  # deleted mid-scan
+        return bad
+
+    def _scan_loop(self) -> None:
+        while not self._stop.wait(self.scan_period_s):
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 — scanner must survive
                 pass
 
     def _apply_command(self, cmd: dict) -> None:

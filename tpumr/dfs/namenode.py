@@ -43,6 +43,10 @@ class LeaseError(RuntimeError):
     pass
 
 
+class QuotaExceededError(RuntimeError):
+    pass
+
+
 def _now() -> float:
     return time.time()
 
@@ -105,6 +109,12 @@ class FSNamesystem:
         self.block_to_path: dict[int, str] = {
             b[0]: p for p, ino in self.namespace.items()
             if ino.get("type") == "file" for b in ino.get("blocks", [])}
+        #: addr -> "decommissioning" | "decommissioned" (admin-driven,
+        #: ≈ the exclude-file + refreshNodes workflow). Journaled through
+        #: 'decommission' ops into counters so an NN restart cannot
+        #: silently return a draining node to service.
+        self.decommissioning: dict[str, str] = \
+            self.counters.setdefault("decommissioning", {})
 
         # volatile state, rebuilt at runtime
         self.block_locations: dict[int, set[str]] = {}   # bid -> {dn addr}
@@ -178,6 +188,20 @@ class FSNamesystem:
                 namespace[p]["owner"] = op["o"]
             if op.get("g"):
                 namespace[p]["group"] = op["g"]
+        elif kind == "set_quota":
+            ino = namespace[p]
+            for field_name, key in (("ns_quota", "nsq"), ("sp_quota", "spq")):
+                if key in op:
+                    if op[key] is None or op[key] < 0:
+                        ino.pop(field_name, None)
+                    else:
+                        ino[field_name] = op[key]
+        elif kind == "decommission":
+            d = counters.setdefault("decommissioning", {})
+            if op.get("state"):
+                d[op["addr"]] = op["state"]
+            else:
+                d.pop(op["addr"], None)
         elif kind == "counters":
             counters.update(op["values"])
 
@@ -290,6 +314,103 @@ class FSNamesystem:
             p = self._parent_of(p)
         self._check_access(p, 2, user)
 
+    def _check_superuser(self, what: str) -> None:
+        user = self._caller()
+        if (self.permissions_enabled and user is not None
+                and user != self.superuser):
+            raise PermissionError(
+                f"Permission denied: only the superuser may {what}")
+
+    # ------------------------------------------------------------ quotas
+
+    def _quota_ancestors(self, path: str) -> "list[tuple[str, dict]]":
+        """Ancestor dirs of ``path`` (inclusive) carrying a quota."""
+        out = []
+        p = path
+        while True:
+            ino = self.namespace.get(p)
+            if ino is not None and ("ns_quota" in ino or "sp_quota" in ino):
+                out.append((p, ino))
+            if p == "/":
+                return out
+            p = self._parent_of(p)
+
+    def _subtree_usage(self, root: str) -> "tuple[int, int]":
+        """(inode_count, consumed_bytes) under ``root`` — consumed =
+        block bytes × replication, the reference's diskspace accounting
+        (INodeDirectoryWithQuota). Computed on demand: quota dirs are
+        rare and ops on them tolerate the walk."""
+        prefix = "/" if root == "/" else root.rstrip("/") + "/"
+        inodes = 0
+        consumed = 0
+        for p, ino in self.namespace.items():
+            if p == root or p == "/" or not p.startswith(prefix):
+                continue
+            inodes += 1
+            if ino.get("type") == "file":
+                repl = ino.get("replication", 1)
+                consumed += sum(self.block_sizes.get(b[0], b[1])
+                                for b in ino.get("blocks", [])) * repl
+        return inodes, consumed
+
+    def _missing_ancestors(self, path: str) -> int:
+        """How many intermediate dirs _ensure_parents would create —
+        they count against namespace quotas too (the reference charges
+        every new INode, not just the leaf)."""
+        n = 0
+        p = self._parent_of(path)
+        while p != "/" and p not in self.namespace:
+            n += 1
+            p = self._parent_of(p)
+        return n
+
+    def _check_quota(self, path: str, new_inodes: int,
+                     new_bytes: int,
+                     skip_ancestors_of: "str | None" = None) -> None:
+        """≈ FSDirectory.verifyQuota: adding ``new_inodes`` namespace
+        entries / ``new_bytes`` replicated bytes at ``path`` must fit
+        every quota-carrying ancestor. ``skip_ancestors_of``: for renames,
+        quota dirs that ALREADY contain the source subtree are exempt
+        (the usage moves within them, net zero)."""
+        skip = {q for q, _ in self._quota_ancestors(skip_ancestors_of)} \
+            if skip_ancestors_of is not None else set()
+        for qpath, ino in self._quota_ancestors(path):
+            if qpath in skip:
+                continue
+            ns_q = ino.get("ns_quota")
+            sp_q = ino.get("sp_quota")
+            if ns_q is None and sp_q is None:
+                continue
+            inodes, consumed = self._subtree_usage(qpath)
+            if ns_q is not None and new_inodes \
+                    and inodes + new_inodes > ns_q:
+                raise QuotaExceededError(
+                    f"namespace quota of {qpath} exceeded: "
+                    f"quota={ns_q}, count={inodes + new_inodes}")
+            if sp_q is not None and new_bytes \
+                    and consumed + new_bytes > sp_q:
+                raise QuotaExceededError(
+                    f"space quota of {qpath} exceeded: quota={sp_q} B, "
+                    f"consumed={consumed} B, requested={new_bytes} B")
+
+    def set_quota(self, path: str, ns_quota: "int | None" = None,
+                  sp_quota: "int | None" = None) -> None:
+        """≈ ClientProtocol.setQuota (dfsadmin -setQuota/-setSpaceQuota):
+        superuser only; None leaves a dimension unchanged, -1 clears it."""
+        with self.lock:
+            self._check_safemode()
+            self._check_superuser("set quotas")
+            inode = self._inode(path)
+            if inode["type"] != "dir":
+                raise NotADirectoryError(f"quotas apply to dirs: {path}")
+            op: dict = {"op": "set_quota", "path": path}
+            if ns_quota is not None:
+                op["nsq"] = None if ns_quota < 0 else int(ns_quota)
+            if sp_quota is not None:
+                op["spq"] = None if sp_quota < 0 else int(sp_quota)
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+
     # ------------------------------------------------------------ client ops
 
     def create(self, path: str, client: str, replication: int | None,
@@ -315,6 +436,9 @@ class FSNamesystem:
             else:
                 # a NEW namespace entry needs write on the parent
                 self._check_parent_write(path, user)
+                self._check_quota(
+                    path, new_inodes=1 + self._missing_ancestors(path),
+                    new_bytes=0)
             self._ensure_parents(path, user)
             r = replication or self.default_replication
             bs = block_size or self.default_block_size
@@ -344,6 +468,11 @@ class FSNamesystem:
                       "size": prev_block_size}
                 self._log(op)
                 self.apply_op(self.namespace, self.counters, op)
+            # space quota: a new block may consume up to block_size ×
+            # replication (verifyQuota charges the full block up front)
+            self._check_quota(path, new_inodes=0,
+                              new_bytes=inode["block_size"]
+                              * inode.get("replication", 1))
             bid = self.counters["next_block"]
             gen = self.counters["gen"]
             self.counters["next_block"] = bid + 1
@@ -413,6 +542,9 @@ class FSNamesystem:
                 return self.namespace[path]["type"] == "dir"
             user = self._caller()
             self._check_parent_write(path, user)
+            self._check_quota(
+                path, new_inodes=1 + self._missing_ancestors(path),
+                new_bytes=0)
             self._ensure_parents(path + "/x", user)
             op = {"op": "mkdir", "path": path, "t": _now(),
                   "o": user or self.superuser, "g": self.supergroup,
@@ -471,6 +603,19 @@ class FSNamesystem:
             if dst in self.namespace:
                 return False
             self._check_parent_write(dst, user)
+            # the moved subtree charges dst-side quotas (FSDirectory.
+            # verifyQuotaForRename); quota dirs already containing src
+            # are net-zero and exempt
+            sub_inodes, sub_bytes = self._subtree_usage(src)
+            src_ino = self.namespace[src]
+            if src_ino.get("type") == "file":
+                sub_bytes += sum(self.block_sizes.get(b[0], b[1])
+                                 for b in src_ino.get("blocks", [])) \
+                    * src_ino.get("replication", 1)
+            self._check_quota(
+                dst,
+                new_inodes=1 + sub_inodes + self._missing_ancestors(dst),
+                new_bytes=sub_bytes, skip_ancestors_of=src)
             self._ensure_parents(dst, user)
             op = {"op": "rename", "path": src, "dst": dst}
             self._log(op)
@@ -491,6 +636,12 @@ class FSNamesystem:
             if inode["type"] != "file":
                 return False
             self._check_access(path, 2, self._caller())
+            old = inode.get("replication", 1)
+            if replication > old:
+                size = sum(self.block_sizes.get(b[0], b[1])
+                           for b in inode.get("blocks", []))
+                self._check_quota(path, new_inodes=0,
+                                  new_bytes=size * (replication - old))
             op = {"op": "set_repl", "path": path, "r": replication}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
@@ -628,7 +779,9 @@ class FSNamesystem:
         replica goes to a DIFFERENT rack than the first (rack-failure
         tolerance), remaining replicas spread by load. On a flat topology
         (all /default-rack) this collapses to spread-by-load."""
-        live = [a for a, d in self.datanodes.items() if a not in excluded]
+        # decommissioning nodes take no NEW replicas (they are draining)
+        live = [a for a, d in self.datanodes.items()
+                if a not in excluded and a not in self.decommissioning]
         live.sort(key=lambda a: (self.datanodes[a]["used"], random.random()))
         if len(live) <= 1 or replication <= 1:
             return live[:replication]
@@ -668,30 +821,89 @@ class FSNamesystem:
         with self.lock:
             if self.safemode or not self.datanodes:
                 return 0
+            healthy_nodes = [a for a in self.datanodes
+                             if a not in self.decommissioning]
             scheduled = 0
             for path, inode in self.namespace.items():
                 if inode.get("type") != "file" or inode.get("uc"):
                     continue
-                want = min(inode["replication"], len(self.datanodes))
+                want = min(inode["replication"],
+                           max(1, len(healthy_nodes)))
                 for bid, _ in inode["blocks"]:
                     locs = {a for a in self.block_locations.get(bid, set())
                             if a in self.datanodes}
-                    if 0 < len(locs) < want:
+                    # replicas on draining nodes don't count toward the
+                    # target (decommission = copy everything off first),
+                    # but they remain valid COPY SOURCES
+                    good = {a for a in locs
+                            if a not in self.decommissioning}
+                    if locs and len(good) < want:
                         targets = self._choose_targets(
-                            want - len(locs), excluded=locs)
+                            want - len(good), excluded=locs)
                         if targets:
-                            src = sorted(locs)[0]
+                            src = sorted(good or locs)[0]
                             self.commands.setdefault(src, []).append(
                                 {"type": "replicate", "block_id": bid,
                                  "targets": targets})
                             scheduled += 1
-                    elif len(locs) > want:
-                        for addr in sorted(locs)[want:]:
+                    elif len(good) > want:
+                        for addr in sorted(good)[want:]:
                             self.commands.setdefault(addr, []).append(
                                 {"type": "delete", "block_id": bid})
                             self.block_locations[bid].discard(addr)
                             scheduled += 1
             return scheduled
+
+    def decommission_check(self) -> None:
+        """Promote draining nodes to 'decommissioned' once every block
+        they host has enough replicas elsewhere (≈ FSNamesystem.
+        checkDecommissionStateInternal)."""
+        with self.lock:
+            for addr, state in list(self.decommissioning.items()):
+                if state != "decommissioning":
+                    continue
+                if addr not in self.datanodes:
+                    self.decommissioning[addr] = "decommissioned"
+                    continue
+                done = True
+                for bid, locs in self.block_locations.items():
+                    if addr not in locs:
+                        continue
+                    path = self.block_to_path.get(bid)
+                    ino = self.namespace.get(path) if path else None
+                    if ino is None:
+                        continue
+                    healthy = [a for a in self.datanodes
+                               if a not in self.decommissioning]
+                    want = min(ino.get("replication", 1),
+                               max(1, len(healthy)))
+                    good = {a for a in locs if a in self.datanodes
+                            and a not in self.decommissioning}
+                    if len(good) < want:
+                        done = False
+                        break
+                if done:
+                    self._log_decommission(addr, "decommissioned")
+
+    def _log_decommission(self, addr: str, state: "str | None") -> None:
+        op = {"op": "decommission", "addr": addr, "state": state}
+        self._log(op)
+        self.apply_op(self.namespace, self.counters, op)
+        # counters may have been swapped by a checkpoint reload: re-bind
+        self.decommissioning = self.counters.setdefault(
+            "decommissioning", {})
+
+    def set_decommission(self, addr: str, action: str = "start") -> str:
+        """Admin: start/stop draining a DataNode (≈ dfsadmin exclude +
+        refreshNodes). Journaled — the drain survives NN restarts.
+        Returns the node's current state."""
+        with self.lock:
+            self._check_superuser("decommission datanodes")
+            if action == "start" and addr not in self.decommissioning:
+                self._log_decommission(addr, "decommissioning")
+            elif action == "stop":
+                self._log_decommission(addr, None)
+            return self.decommissioning.get(addr, "in-service")
 
     def lease_check(self) -> None:
         """Expire hard-limit leases: finalize the file with whatever blocks
@@ -875,7 +1087,16 @@ class FSNamesystem:
 
     def datanode_report(self) -> list[dict]:
         with self.lock:
-            return [dict(d) for d in self.datanodes.values()]
+            out = []
+            for addr, d in self.datanodes.items():
+                row = dict(d)
+                row["state"] = self.decommissioning.get(addr, "in-service")
+                out.append(row)
+            # decommissioned nodes that already left the cluster
+            for addr, state in self.decommissioning.items():
+                if addr not in self.datanodes:
+                    out.append({"addr": addr, "state": state})
+            return out
 
 
 class NameNode:
@@ -1001,6 +1222,7 @@ class NameNode:
                 self.ns.heartbeat_check(self.dn_expiry_s)
                 self.ns.replication_check()
                 self.ns.lease_check()
+                self.ns.decommission_check()
                 if auto_ckpt and self.ns.edits_bytes() > auto_ckpt:
                     self.ns.save_namespace()
             except Exception:  # noqa: BLE001 — monitors must survive
@@ -1055,6 +1277,12 @@ class NameNode:
 
     def report_bad_block(self, block_id, addr):
         return self.ns.report_bad_block(block_id, addr)
+
+    def set_quota(self, path, ns_quota=None, sp_quota=None):
+        return self.ns.set_quota(path, ns_quota, sp_quota)
+
+    def set_decommission(self, addr, action="start"):
+        return self.ns.set_decommission(addr, action)
 
     def get_status(self, path):
         return self.ns.get_status(path)

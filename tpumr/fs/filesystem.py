@@ -186,6 +186,14 @@ class FileSystem(ABC):
         """Default: single localhost block (local FSes have no placement)."""
         return [BlockLocation(["localhost"], offset, length)]
 
+    def home_directory(self, user: "str | None" = None) -> Path:
+        """≈ FileSystem.getHomeDirectory: /user/<name> in the fs's own
+        namespace (DFS semantics; LocalFileSystem overrides with $HOME)."""
+        if user is None:
+            from tpumr.security import UserGroupInformation
+            user = UserGroupInformation.get_current_user().user
+        return Path(f"/user/{user}")
+
     def glob_status(self, pattern: "str | Path") -> list[FileStatus]:
         """Glob on the final path component(s) (≈ FileSystem.globStatus —
         supports * ? [] on each component)."""

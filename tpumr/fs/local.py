@@ -19,6 +19,14 @@ class LocalFileSystem(FileSystem):
     def __init__(self, conf: Any = None) -> None:
         self.conf = conf
 
+    def home_directory(self, user: "str | None" = None):
+        """$HOME, like RawLocalFileSystem.getHomeDirectory — NOT /user/x
+        (which would aim trash at the real filesystem root)."""
+        import os
+
+        from tpumr.fs.filesystem import Path
+        return Path(os.path.expanduser("~"))
+
     @staticmethod
     def _local(path: "str | Path") -> str:
         return Path(path).path

@@ -197,21 +197,43 @@ class FsShell:
             raise ShellError(f"-mv failed: {src} -> {dst}")
         return 0
 
+    def _delete_or_trash(self, st, recursive: bool,
+                         skip_trash: bool) -> None:
+        """fs.trash.interval > 0 routes deletes into the per-user trash
+        (≈ FsShell delete → Trash.moveToTrash); -skipTrash bypasses."""
+        fs = get_filesystem(st.path, self.conf)
+        if not skip_trash:
+            from tpumr.fs.trash import Trash
+            trash = Trash(fs, self.conf)
+            if trash.enabled and trash.move_to_trash(st.path):
+                self._print(f"Moved to trash: {st.path}")
+                return
+        fs.delete(st.path, recursive=recursive)
+        self._print(f"Deleted {st.path}")
+
     def cmd_rm(self, *args: str) -> int:
-        for p in args:
+        skip = "-skipTrash" in args
+        for p in (a for a in args if a != "-skipTrash"):
             for st in self._expand(p):
                 if st.is_dir:
                     raise ShellError(f"{st.path}: is a directory (use -rmr)")
-                get_filesystem(st.path, self.conf).delete(st.path)
-                self._print(f"Deleted {st.path}")
+                self._delete_or_trash(st, recursive=False, skip_trash=skip)
         return 0
 
     def cmd_rmr(self, *args: str) -> int:
-        for p in args:
+        skip = "-skipTrash" in args
+        for p in (a for a in args if a != "-skipTrash"):
             for st in self._expand(p):
-                get_filesystem(st.path, self.conf).delete(st.path,
-                                                          recursive=True)
-                self._print(f"Deleted {st.path}")
+                self._delete_or_trash(st, recursive=True, skip_trash=skip)
+        return 0
+
+    def cmd_expunge(self, *args: str) -> int:
+        """Empty the caller's trash on the default fs (≈ -expunge)."""
+        from tpumr.fs.trash import Trash
+        base = self._resolve(args[0] if args else "/")
+        fs = get_filesystem(base, self.conf)
+        n = Trash(fs, self.conf).expunge_all()
+        self._print(f"Expunged {n} trash checkpoint(s)")
         return 0
 
     def cmd_du(self, *args: str) -> int:

@@ -1,0 +1,131 @@
+"""Trash — recoverable deletes with periodic expiry.
+
+≈ ``org.apache.hadoop.fs.Trash`` (reference: src/core/org/apache/hadoop/
+fs/Trash.java): when ``fs.trash.interval`` (minutes) is positive, shell
+deletes MOVE paths into ``/user/<user>/.Trash/Current`` instead of
+destroying them; a checkpoint renames ``Current`` to a timestamped dir,
+and checkpoints older than the interval are expunged. Contracts kept:
+
+- per-user trash root under the user's home (same layout, so ``-ls`` of
+  the trash looks familiar);
+- name collisions get a numeric suffix (Trash.java's dodge);
+- paths already inside a trash dir are deleted outright (no recursive
+  trash-of-trash);
+- the API deletes nothing unless asked: ``move_to_trash`` returns False
+  when trash is disabled and the CALLER must then really delete.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Any
+
+from tpumr.fs.filesystem import FileSystem, Path
+
+CURRENT = "Current"
+_CHECKPOINT_RE = re.compile(r"^\d{10,}$")
+
+
+class Trash:
+    def __init__(self, fs: FileSystem, conf: Any,
+                 user: "str | None" = None) -> None:
+        self.fs = fs
+        self.conf = conf
+        self.interval_s = float(conf.get("fs.trash.interval", 0)) * 60 \
+            if conf is not None else 0.0
+        if user is None:
+            from tpumr.security import UserGroupInformation
+            user = UserGroupInformation.get_current_user(conf).user
+        self.user = user
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval_s > 0
+
+    def trash_root(self, path: "str | Path") -> Path:
+        """Per-user trash on the SAME filesystem as ``path``:
+        <home>/.Trash (≈ Trash.java's fs.getHomeDirectory()), overridable
+        with ``fs.trash.root`` (tests, shared scratch filesystems)."""
+        p = Path(path) if not isinstance(path, Path) else path
+        base = Path(str(p))
+        override = self.conf.get("fs.trash.root") if self.conf else None
+        if override:
+            base.path = Path(override).path
+        else:
+            base.path = self.fs.home_directory(self.user) \
+                .child(".Trash").path
+        return base
+
+    def _in_trash(self, path: Path) -> bool:
+        """Inside THIS user's trash root — not any dir merely named
+        .Trash (those are ordinary data and deserve trash protection)."""
+        root = self.trash_root(path).path.rstrip("/")
+        return path.path == root or path.path.startswith(root + "/")
+
+    def move_to_trash(self, path: "str | Path") -> bool:
+        """Move into Current; False = caller must delete for real (trash
+        disabled, or the path is already trash)."""
+        p = Path(path) if not isinstance(path, Path) else path
+        if not self.enabled or self._in_trash(p):
+            return False
+        if not self.fs.exists(p):
+            raise FileNotFoundError(str(p))
+        root = self.trash_root(p)
+        target = root.child(CURRENT)
+        for comp in [c for c in p.path.split("/") if c]:
+            target = target.child(comp)
+        self.fs.mkdirs(target.parent)
+        if self.fs.exists(target):  # collision: numeric suffix
+            n = 1
+            while self.fs.exists(Path(str(target) + f".{n}")):
+                n += 1
+            target = Path(str(target) + f".{n}")
+        if not self.fs.rename(p, target):
+            raise OSError(f"cannot move {p} to trash at {target}")
+        return True
+
+    def checkpoint(self) -> "Path | None":
+        """Seal Current under a timestamp dir (old deletes start aging)."""
+        root = self.trash_root(Path("/"))
+        current = root.child(CURRENT)
+        if not self.fs.exists(current):
+            return None
+        ts = int(time.time())
+        stamp = root.child(str(ts))
+        while self.fs.exists(stamp):  # same-second checkpoint collision
+            ts += 1
+            stamp = root.child(str(ts))
+        if not self.fs.rename(current, stamp):
+            raise OSError(f"cannot checkpoint trash: rename {current} "
+                          f"-> {stamp} failed")
+        return stamp
+
+    def expunge(self) -> int:
+        """Delete checkpoints older than the interval; returns how many."""
+        root = self.trash_root(Path("/"))
+        if not self.fs.exists(root):
+            return 0
+        removed = 0
+        now = time.time()
+        for st in self.fs.list_status(root):
+            name = st.path.name
+            if not _CHECKPOINT_RE.match(name):
+                continue
+            if now - int(name) >= self.interval_s:
+                self.fs.delete(st.path, recursive=True)
+                removed += 1
+        return removed
+
+    def expunge_all(self) -> int:
+        """Checkpoint then delete EVERY checkpoint (shell -expunge)."""
+        self.checkpoint()
+        root = self.trash_root(Path("/"))
+        if not self.fs.exists(root):
+            return 0
+        removed = 0
+        for st in self.fs.list_status(root):
+            if _CHECKPOINT_RE.match(st.path.name):
+                self.fs.delete(st.path, recursive=True)
+                removed += 1
+        return removed
