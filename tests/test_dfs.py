@@ -603,3 +603,75 @@ def test_decommission_survives_namenode_restart():
                 pass
             time.sleep(0.2)
         assert report.get(victim, "in-service") != "in-service", report
+
+
+def test_quota_usage_cache_stays_consistent(tmp_path):
+    """The incremental quota counters (review: no full-namespace scan per
+    write) must agree with a from-scratch recount after a workout of
+    creates, writes, renames, deletes, and replication changes."""
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+        client = c.client()
+        client.mkdirs("/w")
+        client.nn.call("set_quota", "/w", 1000, 10_000_000)
+        client.mkdirs("/w/sub")
+        client.nn.call("set_quota", "/w/sub", 500, None)
+        for i in range(4):
+            with client.create(f"/w/sub/f{i}", replication=1) as f:
+                f.write(b"x" * (700 + i * 400))  # multi-block sizes
+        client.rename("/w/sub/f0", "/w/f0-moved")
+        client.delete("/w/sub/f1")
+        client.set_replication("/w/sub/f2", 2)
+        client.mkdirs("/w/deep/a/b")
+        client.rename("/w/deep", "/w/deeper")
+
+        ns = c.namenode.ns
+        with ns.lock:
+            for qpath, cached in ns._quota_usage.items():
+                actual = list(ns._subtree_usage(qpath))
+                assert cached == actual, \
+                    f"{qpath}: cached {cached} != recount {actual}"
+
+
+def test_dead_draining_node_never_reports_decommissioned():
+    """Review regression: a node that dies mid-drain must stay
+    'decommissioning' — reporting it decommissioned invites wiping the
+    only copy of its blocks."""
+    conf = small_conf(replication=1)
+    with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+        client = c.client()
+        with client.create("/dd/f", replication=1) as f:
+            f.write(b"x" * 900)
+        blk = client.nn.call("get_block_locations", "/dd/f")[0]
+        victim = next(dn for dn in c.datanodes
+                      if dn.addr == blk["locations"][0])
+        victim.stop()  # the ONLY replica's host dies...
+        client.nn.call("set_decommission", victim.addr, "start")
+        time.sleep(2.5)  # expiry + several monitor sweeps
+        state = c.namenode.ns.decommissioning.get(victim.addr)
+        assert state == "decommissioning", state
+
+
+def test_trash_emptier_runs_on_namenode(tmp_path):
+    """≈ Trash.Emptier: the NN monitor checkpoints every user's
+    /user/<u>/.Trash/Current and expunges aged checkpoints."""
+    conf = small_conf(replication=1)
+    conf.set("fs.trash.interval", 1 / 600)      # 0.1 s aging
+    conf.set("fs.trash.checkpoint.interval.s", 0.3)
+    conf.set("tdfs.replication.interval.s", 0.1)
+    with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+        client = c.client()
+        client.mkdirs("/user/alice/.Trash/Current/doomed")
+        client.create("/user/alice/.Trash/Current/doomed/f").close()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ns = c.namenode.ns
+            with ns.lock:
+                paths = [p for p in ns.namespace
+                         if p.startswith("/user/alice/.Trash")]
+            # Current sealed into a checkpoint, checkpoint then expunged
+            if paths == ["/user/alice/.Trash"]:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"emptier never cleaned: {paths}")

@@ -210,3 +210,40 @@ class BoomMapper:
 
     def close(self):
         pass
+
+
+def test_task_profiling_opt_in(cluster, tmp_path):
+    """≈ mapred.task.profile*: opted-in tasks dump cProfile reports next
+    to their attempt files; the tracker lists and serves them; tasks
+    outside the range (and jobs not opting in) produce none."""
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/prof/in.txt", b"p q p\nq r p\n" * 50)
+    conf = cluster.create_job_conf()
+    conf.set_input_paths("mem:///prof/in.txt")
+    conf.set_output_path("mem:///prof/out")
+    conf.set_class("mapred.mapper.class", WordCountMapper)
+    conf.set_class("mapred.reducer.class", SumReducer)
+    conf.set("mapred.map.tasks", 4)
+    conf.set("mapred.min.split.size", 1)
+    conf.set_num_reduce_tasks(1)
+    conf.set("mapred.task.profile", True)
+    conf.set("mapred.task.profile.maps", "0-1")   # sample, not everything
+    conf.set("mapred.task.profile.reduces", "0")
+
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+
+    profiles = [aid for t in cluster.trackers for aid in t.list_profiles()]
+    maps = [a for a in profiles if "_m_" in a]
+    reduces = [a for a in profiles if "_r_" in a]
+    assert maps, "no map profiles written"
+    assert reduces, "no reduce profile written"
+    # range respected: only map partitions 0-1
+    assert all(int(a.split("_")[4]) <= 1 for a in maps), maps
+    # content is a pstats report mentioning the map runner
+    tracker = next(t for t in cluster.trackers
+                   if t.list_profiles())
+    text = tracker.get_profile(tracker.list_profiles()[0])
+    assert "cumulative" in text or "function calls" in text
+    with pytest.raises(KeyError):
+        tracker.get_profile("attempt_0_0000_m_000099_0")

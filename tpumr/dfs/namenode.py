@@ -115,13 +115,21 @@ class FSNamesystem:
         #: silently return a draining node to service.
         self.decommissioning: dict[str, str] = \
             self.counters.setdefault("decommissioning", {})
-
         # volatile state, rebuilt at runtime
         self.block_locations: dict[int, set[str]] = {}   # bid -> {dn addr}
         self.block_sizes: dict[int, int] = {}            # reported sizes
         self.datanodes: dict[str, dict] = {}             # addr -> info
         self.commands: dict[str, list[dict]] = {}        # addr -> pending
         self.leases: dict[str, dict] = {}                # client -> lease
+
+        #: incremental per-quota-dir usage cache: qpath -> [inodes, bytes]
+        #: (≈ INodeDirectoryWithQuota's cached counts) — quota checks must
+        #: not rescan the namespace under the lock on every write.
+        #: Maintained by the mutators via _charge, re-derived at every
+        #: checkpoint (self-healing against conservative drift from
+        #: lease-recovery closes). Needs block_sizes initialized above.
+        self._quota_usage: dict[str, list] = {}
+        self._rebuild_quota_usage()
 
         self.total_known_blocks = sum(
             len(i.get("blocks", [])) for i in self.namespace.values()
@@ -245,6 +253,7 @@ class FSNamesystem:
                       "m": 0o755}
                 self._log(op)
                 self.apply_op(self.namespace, self.counters, op)
+                self._charge(cur, 1, 0)
             elif inode["type"] != "dir":
                 raise NotADirectoryError(cur)
 
@@ -364,6 +373,32 @@ class FSNamesystem:
             p = self._parent_of(p)
         return n
 
+    def _rebuild_quota_usage(self) -> None:
+        """One scan re-deriving every quota dir's cached counters."""
+        usage: dict[str, list] = {}
+        for p, ino in self.namespace.items():
+            if ino.get("type") == "dir" and ("ns_quota" in ino
+                                             or "sp_quota" in ino):
+                usage[p] = None
+        for q in usage:
+            usage[q] = list(self._subtree_usage(q))
+        self._quota_usage = usage
+
+    def _charge(self, path: str, d_inodes: int, d_bytes: int) -> None:
+        """Apply a usage delta at ``path`` to every quota-carrying PROPER
+        ancestor's cached counters. No-op when no quotas exist."""
+        if not self._quota_usage:
+            return
+        p = self._parent_of(path)
+        while True:
+            u = self._quota_usage.get(p)
+            if u is not None:
+                u[0] += d_inodes
+                u[1] += d_bytes
+            if p == "/":
+                return
+            p = self._parent_of(p)
+
     def _check_quota(self, path: str, new_inodes: int,
                      new_bytes: int,
                      skip_ancestors_of: "str | None" = None) -> None:
@@ -381,7 +416,9 @@ class FSNamesystem:
             sp_q = ino.get("sp_quota")
             if ns_q is None and sp_q is None:
                 continue
-            inodes, consumed = self._subtree_usage(qpath)
+            cached = self._quota_usage.get(qpath)
+            inodes, consumed = cached if cached is not None \
+                else self._subtree_usage(qpath)
             if ns_q is not None and new_inodes \
                     and inodes + new_inodes > ns_q:
                 raise QuotaExceededError(
@@ -410,6 +447,12 @@ class FSNamesystem:
                 op["spq"] = None if sp_quota < 0 else int(sp_quota)
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            if "ns_quota" in inode or "sp_quota" in inode:
+                # (re)derive this dir's counters at admin time — the one
+                # place a full subtree scan is acceptable
+                self._quota_usage[path] = list(self._subtree_usage(path))
+            else:
+                self._quota_usage.pop(path, None)
 
     # ------------------------------------------------------------ client ops
 
@@ -448,6 +491,7 @@ class FSNamesystem:
                   "m": 0o644}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._charge(path, 1, 0)
             lease = self.leases.setdefault(
                 client, {"paths": set(), "renewed": _now()})
             lease["paths"].add(path)
@@ -468,6 +512,11 @@ class FSNamesystem:
                       "size": prev_block_size}
                 self._log(op)
                 self.apply_op(self.namespace, self.counters, op)
+                # the previous block was charged a FULL block up front;
+                # its real size is now known — settle the difference
+                self._charge(path, 0,
+                             (prev_block_size - inode["block_size"])
+                             * inode.get("replication", 1))
             # space quota: a new block may consume up to block_size ×
             # replication (verifyQuota charges the full block up front)
             self._check_quota(path, new_inodes=0,
@@ -485,6 +534,8 @@ class FSNamesystem:
             op = {"op": "add_block", "path": path, "bid": bid}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._charge(path, 0,
+                         inode["block_size"] * inode.get("replication", 1))
             self.block_to_path[bid] = path
             return {"block_id": bid, "gen": gen, "targets": targets}
 
@@ -492,9 +543,13 @@ class FSNamesystem:
         """Client hit a pipeline failure: drop the block and let it retry
         (≈ ClientProtocol.abandonBlock)."""
         with self.lock:
+            inode = self.namespace.get(path)
             op = {"op": "abandon", "path": path, "bid": block_id}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            if inode is not None:
+                self._charge(path, 0, -inode["block_size"]
+                             * inode.get("replication", 1))
             self.block_to_path.pop(block_id, None)
 
     def complete(self, path: str, client: str, last_block_size: int) -> None:
@@ -508,6 +563,10 @@ class FSNamesystem:
             op = {"op": "close", "path": path, "sizes": sizes}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            if sizes:  # settle the last block's optimistic full charge
+                self._charge(path, 0,
+                             (last_block_size - inode["block_size"])
+                             * inode.get("replication", 1))
             self.total_known_blocks += len(inode["blocks"])
             lease = self.leases.get(client)
             if lease:
@@ -545,12 +604,15 @@ class FSNamesystem:
             self._check_quota(
                 path, new_inodes=1 + self._missing_ancestors(path),
                 new_bytes=0)
-            self._ensure_parents(path + "/x", user)
+            # parents only — creating the target through _ensure_parents
+            # AND the op below would double-charge its quota inode
+            self._ensure_parents(path, user)
             op = {"op": "mkdir", "path": path, "t": _now(),
                   "o": user or self.superuser, "g": self.supergroup,
                   "m": 0o755}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._charge(path, 1, 0)
             return True
 
     def delete(self, path: str, recursive: bool = True) -> bool:
@@ -573,15 +635,23 @@ class FSNamesystem:
                     if k.startswith(path.rstrip("/") + "/")]
         if inode["type"] == "dir" and children and not recursive:
             raise OSError(f"{path} is a non-empty directory")
-        # schedule replica invalidation on the owning DataNodes
+        # schedule replica invalidation on the owning DataNodes; tally
+        # the removed usage for the quota counters in the same pass
         doomed: list[int] = []
+        removed_bytes = 0
         for k in children + [path]:
             node = self.namespace.get(k, {})
             if node.get("type") == "file":
                 doomed.extend(b[0] for b in node.get("blocks", []))
+                removed_bytes += sum(
+                    self.block_sizes.get(b[0], b[1])
+                    for b in node.get("blocks", [])) \
+                    * node.get("replication", 1)
+            self._quota_usage.pop(k, None)
         op = {"op": "delete", "path": path}
         self._log(op)
         self.apply_op(self.namespace, self.counters, op)
+        self._charge(path, -(len(children) + 1), -removed_bytes)
         for bid in doomed:
             for addr in self.block_locations.pop(bid, set()):
                 self.commands.setdefault(addr, []).append(
@@ -627,6 +697,17 @@ class FSNamesystem:
                         and v.get("type") == "file":
                     for b in v.get("blocks", []):
                         self.block_to_path[b[0]] = k
+            # quota counters: the subtree's usage leaves src's ancestors
+            # and lands under dst's; cached entries for quota dirs INSIDE
+            # the subtree move key
+            src_prefix = src.rstrip("/") + "/"
+            moved_q = [(k, v) for k, v in self._quota_usage.items()
+                       if k == src or k.startswith(src_prefix)]
+            for k, v in moved_q:
+                del self._quota_usage[k]
+                self._quota_usage[dst + k[len(src):]] = v
+            self._charge(src, -(1 + sub_inodes), -sub_bytes)
+            self._charge(dst, 1 + sub_inodes, sub_bytes)
             return True
 
     def set_replication(self, path: str, replication: int) -> bool:
@@ -637,14 +718,15 @@ class FSNamesystem:
                 return False
             self._check_access(path, 2, self._caller())
             old = inode.get("replication", 1)
+            size = sum(self.block_sizes.get(b[0], b[1])
+                       for b in inode.get("blocks", []))
             if replication > old:
-                size = sum(self.block_sizes.get(b[0], b[1])
-                           for b in inode.get("blocks", []))
                 self._check_quota(path, new_inodes=0,
                                   new_bytes=size * (replication - old))
             op = {"op": "set_repl", "path": path, "r": replication}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._charge(path, 0, size * (replication - old))
             return True
 
     def set_permission(self, path: str, mode: int) -> None:
@@ -863,7 +945,10 @@ class FSNamesystem:
                 if state != "decommissioning":
                     continue
                 if addr not in self.datanodes:
-                    self.decommissioning[addr] = "decommissioned"
+                    # died mid-drain: its blocks were NOT verified safe —
+                    # stay 'decommissioning' so the operator sees the
+                    # drain never completed (never report a dead node as
+                    # safely decommissioned)
                     continue
                 done = True
                 for bid, locs in self.block_locations.items():
@@ -995,6 +1080,39 @@ class FSNamesystem:
             report["healthy"] = not (report["missing"] or report["corrupt"])
             return report
 
+    def trash_emptier_check(self) -> int:
+        """One Emptier pass over EVERY user's trash (≈ Trash.Emptier,
+        which runs on the NameNode): seal each /user/<u>/.Trash/Current
+        into a timestamp checkpoint and delete checkpoints older than
+        fs.trash.interval. In-process calls bypass permissions — the
+        emptier acts as the namesystem. Returns checkpoints expunged."""
+        import re as _re
+        interval_s = float(self.conf.get("fs.trash.interval", 0)) * 60
+        if interval_s <= 0:
+            return 0
+        with self.lock:
+            roots = [p for p in self.namespace
+                     if _re.match(r"^/user/[^/]+/\.Trash$", p)]
+        expunged = 0
+        now = _now()
+        for root in roots:
+            current = root + "/Current"
+            if current in self.namespace:
+                ts = int(now)
+                while f"{root}/{ts}" in self.namespace:
+                    ts += 1
+                self.rename(current, f"{root}/{ts}")
+            with self.lock:
+                stamps = [p for p in self.namespace
+                          if p.startswith(root + "/")
+                          and p[len(root) + 1:].isdigit()
+                          and "/" not in p[len(root) + 1:]]
+            for stamp in stamps:
+                if now - int(stamp.rsplit("/", 1)[1]) >= interval_s:
+                    self.delete(stamp, recursive=True)
+                    expunged += 1
+        return expunged
+
     # ------------------------------------------------------------ admin
 
     def save_namespace(self) -> None:
@@ -1006,6 +1124,7 @@ class FSNamesystem:
             self.edits = FSEditLog(
                 self.name_dir, segment_bytes=self._edits_segment_bytes)
             self._ckpt_token += 1  # invalidate any in-flight 2NN cycle
+            self._rebuild_quota_usage()  # self-heal conservative drift
 
     def edits_bytes(self) -> int:
         """On-disk journal size (auto-checkpoint trigger input)."""
@@ -1217,6 +1336,12 @@ class NameNode:
         # (≈ dfs.namenode.checkpoint.txns-style trigger); 0 disables
         auto_ckpt = int(float(self.conf.get(
             "tdfs.edits.auto.checkpoint.mb", 256)) * 1024 * 1024)
+        # trash emptier cadence ≈ fs.trash.checkpoint.interval: default
+        # one pass per trash interval, never more often than the monitor
+        trash_every = float(self.conf.get(
+            "fs.trash.checkpoint.interval.s",
+            max(60.0, float(self.conf.get("fs.trash.interval", 0)) * 60)))
+        last_trash = time.monotonic()
         while not self._stop.wait(interval):
             try:
                 self.ns.heartbeat_check(self.dn_expiry_s)
@@ -1225,6 +1350,9 @@ class NameNode:
                 self.ns.decommission_check()
                 if auto_ckpt and self.ns.edits_bytes() > auto_ckpt:
                     self.ns.save_namespace()
+                if time.monotonic() - last_trash >= trash_every:
+                    last_trash = time.monotonic()
+                    self.ns.trash_emptier_check()
             except Exception:  # noqa: BLE001 — monitors must survive
                 pass
 

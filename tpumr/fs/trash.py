@@ -72,6 +72,15 @@ class Trash:
         if not self.fs.exists(p):
             raise FileNotFoundError(str(p))
         root = self.trash_root(p)
+        # refuse to trash a dir that CONTAINS the trash (Trash.java's
+        # 'Cannot remove ... as it contains the trash'): the rename would
+        # nest the tree inside itself
+        rp = root.path.rstrip("/")
+        pp = p.path.rstrip("/") or "/"
+        if rp == pp or rp.startswith(pp + "/") or pp == "/":
+            raise OSError(
+                f"cannot move {p} to trash: it contains the trash root "
+                f"{root} (delete with -skipTrash if you mean it)")
         target = root.child(CURRENT)
         for comp in [c for c in p.path.split("/") if c]:
             target = target.child(comp)
@@ -116,6 +125,15 @@ class Trash:
                 self.fs.delete(st.path, recursive=True)
                 removed += 1
         return removed
+
+    def run_emptier_cycle(self) -> int:
+        """One Emptier pass (≈ Trash.Emptier on the NameNode): seal the
+        current deletes into a checkpoint, then drop checkpoints older
+        than the interval. Returns how many checkpoints were expunged."""
+        if not self.enabled:
+            return 0
+        self.checkpoint()
+        return self.expunge()
 
     def expunge_all(self) -> int:
         """Checkpoint then delete EVERY checkpoint (shell -expunge)."""

@@ -118,10 +118,14 @@ def run_child(task_file: str) -> int:
     try:
         out_path, index = "", {}
         committed = True
+        from tpumr.mapred.profiler import maybe_profile, profile_dir
+        local_dir = os.path.dirname(os.path.abspath(task_file))
+        prof_dir = profile_dir(conf, aid, local_dir)
         if task.is_map:
             from tpumr.mapred.map_task import run_map_task
-            local_dir = os.path.dirname(os.path.abspath(task_file))
-            out_path, index = run_map_task(conf, task, local_dir, reporter)
+            out_path, index = maybe_profile(
+                conf, task, prof_dir,
+                lambda: run_map_task(conf, task, local_dir, reporter))
             if task.num_reduces == 0:
                 committed = _commit(conf, task, can_commit)
         else:
@@ -143,7 +147,9 @@ def run_child(task_file: str) -> int:
                 return ifile.iter_transferred_segment(out["data"],
                                                       out["codec"])
 
-            run_reduce_task(conf, task, fetch, reporter)
+            maybe_profile(conf, task, prof_dir,
+                          lambda: run_reduce_task(conf, task, fetch,
+                                                  reporter))
             phase[0] = "REDUCE"
             committed = _commit(conf, task, can_commit)
         stop.set()

@@ -1,0 +1,87 @@
+"""Per-task profiling hooks.
+
+≈ the ``mapred.task.profile*`` machinery (reference: mapred/JobConf.java:
+1482-1520 getProfileEnabled/getProfileParams/getProfileTaskRange, output
+to TaskLog.LogName.PROFILE): opt-in per job, limited to a task-id range
+so a huge job profiles a sample rather than everything. The JVM agent
+(hprof) becomes cProfile — the Python-native equivalent — dumped as
+readable pstats text next to the attempt's other local files and served
+by the tracker's status port.
+
+Conf keys (same names as the reference where they exist):
+
+- ``mapred.task.profile``          master switch (default false)
+- ``mapred.task.profile.maps``     map task-id ranges, e.g. "0-2,5"
+- ``mapred.task.profile.reduces``  reduce task-id ranges (same syntax)
+- ``tpumr.task.profile.sort``      pstats sort key (default "cumulative")
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+PROFILE_FILE = "profile.out"
+
+
+def profile_dir(conf: Any, attempt_id: str, fallback: str) -> str:
+    """Where this attempt's profile belongs: the tracker's retained
+    userlogs tree when configured (job scratch dirs are purged when the
+    job finishes — a profile there would vanish before anyone reads it),
+    else the given fallback dir."""
+    base = conf.get("tpumr.task.userlogs.dir")
+    return os.path.join(base, attempt_id) if base else fallback
+
+
+def parse_ranges(spec: str) -> "list[tuple[int, int]]":
+    """"0-2,5" → [(0,2),(5,5)] ≈ Configuration.IntegerRanges."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        lo, sep, hi = part.partition("-")
+        a = int(lo)
+        b = int(hi) if sep and hi.strip() else a
+        out.append((min(a, b), max(a, b)))
+    return out
+
+
+def in_ranges(n: int, spec: str) -> bool:
+    return any(lo <= n <= hi for lo, hi in parse_ranges(spec))
+
+
+def should_profile(conf: Any, task: Any) -> bool:
+    if not conf.get_boolean("mapred.task.profile", False):
+        return False
+    key = "mapred.task.profile.maps" if task.is_map \
+        else "mapred.task.profile.reduces"
+    return in_ranges(task.partition, conf.get(key, "0-2"))
+
+
+def maybe_profile(conf: Any, task: Any, local_dir: str,
+                  fn: Callable[[], Any]) -> Any:
+    """Run ``fn`` under cProfile when the job asks for this task; the
+    pstats report lands in ``<local_dir>/profile.out``. Profiling must
+    never fail the task: dump errors are swallowed, and the task's own
+    exceptions propagate unchanged."""
+    if not should_profile(conf, task):
+        return fn()
+    import cProfile
+    prof = cProfile.Profile()
+    try:
+        return prof.runcall(fn)
+    finally:
+        try:
+            import io
+            import pstats
+            os.makedirs(local_dir, exist_ok=True)
+            buf = io.StringIO()
+            sort = conf.get("tpumr.task.profile.sort", "cumulative")
+            pstats.Stats(prof, stream=buf).sort_stats(sort) \
+                .print_stats(60)
+            with open(os.path.join(local_dir, PROFILE_FILE), "w") as f:
+                f.write(f"# profile of {task.attempt_id}\n")
+                f.write(buf.getvalue())
+        except Exception:  # noqa: BLE001 — profiling is best-effort
+            pass

@@ -200,6 +200,12 @@ class NodeRunner:
             srv = StatusHttpServer(self.name, port=self._http_port)
             srv.add_json("status", lambda q: self._status_dict())
             srv.add_json("metrics", lambda q: self.metrics.snapshot())
+            srv.add_json("profiles", lambda q: self.list_profiles())
+            srv.add_json("profile",
+                         lambda q: {"attempt": q["attempt"],
+                                    "profile":
+                                        self.get_profile(q["attempt"])},
+                         parameterized=True)
 
             def index_page(q: dict) -> str:
                 st = self._status_dict()
@@ -394,6 +400,10 @@ class NodeRunner:
             # tracker-local cache root for DistributedCache localization
             jc.set("tpumr.cache.dir", os.path.join(self.local_root, "cache"))
             jc.set("tpumr.job.id", job_id)
+            # retained logs tree (≈ userlogs): per-attempt profiles land
+            # here, OUTSIDE the job scratch dir that cleanup rmtree's
+            jc.set("tpumr.task.userlogs.dir",
+                   os.path.join(self.local_root, "userlogs", job_id))
             with self.lock:
                 self.job_confs[job_id] = jc
         return jc
@@ -464,11 +474,15 @@ class NodeRunner:
                 from tpumr.mapred.process_runner import run_task_in_process
                 run_task_in_process(self, job_id, task, status, conf)
                 return
+            from tpumr.mapred.profiler import maybe_profile, profile_dir
             committed = True
+            local_dir = os.path.join(self.local_root, job_id, aid)
+            prof_dir = profile_dir(conf, aid, local_dir)
             if task.is_map:
-                local_dir = os.path.join(self.local_root, job_id, aid)
-                out = run_map_task(conf, task, local_dir, reporter,
-                                   status=status)
+                out = maybe_profile(
+                    conf, task, prof_dir,
+                    lambda: run_map_task(conf, task, local_dir, reporter,
+                                         status=status))
                 with self.lock:
                     if out[0]:
                         self.map_outputs[(job_id, task.partition)] = out
@@ -486,7 +500,10 @@ class NodeRunner:
                         reporter)
                 else:
                     fetch = self._remote_fetch_factory(job_id, task)
-                    run_reduce_task(conf, task, fetch, reporter)
+                    maybe_profile(
+                        conf, task, prof_dir,
+                        lambda: run_reduce_task(conf, task, fetch,
+                                                reporter))
                 status.phase = TaskPhase.REDUCE
                 committed = self._commit(conf, task)
             status.counters = reporter.counters.to_dict()
@@ -525,6 +542,38 @@ class NodeRunner:
             return True
         committer.abort_task(aid)
         return False
+
+    # ------------------------------------------------------------ profiles
+    # ≈ TaskLog.LogName.PROFILE served by TaskLogServlet: per-attempt
+    # cProfile reports written by profiler.maybe_profile
+
+    def list_profiles(self) -> "list[str]":
+        from tpumr.mapred.profiler import PROFILE_FILE
+        logs = os.path.join(self.local_root, "userlogs")
+        out = []
+        if not os.path.isdir(logs):
+            return out
+        for job_id in sorted(os.listdir(logs)):
+            job_dir = os.path.join(logs, job_id)
+            if not os.path.isdir(job_dir):
+                continue
+            for aid in sorted(os.listdir(job_dir)):
+                if os.path.exists(os.path.join(job_dir, aid,
+                                               PROFILE_FILE)):
+                    out.append(aid)
+        return out
+
+    def get_profile(self, attempt_id: str) -> str:
+        """One attempt's profile text; attempt ids are validated against
+        the listing (never used to build arbitrary paths)."""
+        from tpumr.mapred.profiler import PROFILE_FILE
+        if attempt_id not in self.list_profiles():
+            raise KeyError(f"no profile for attempt {attempt_id}")
+        from tpumr.mapred.ids import TaskAttemptID
+        job_id = str(TaskAttemptID.parse(attempt_id).task.job)
+        with open(os.path.join(self.local_root, "userlogs", job_id,
+                               attempt_id, PROFILE_FILE)) as f:
+            return f.read()
 
     # ------------------------------------------------------------ umbilical
     # child-process task protocol ≈ TaskUmbilicalProtocol (reference:
