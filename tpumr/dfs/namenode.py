@@ -167,8 +167,9 @@ class FSNamesystem:
                 if b[0] == op["bid"]:
                     b[1] = op["size"]
         elif kind == "abandon":
-            namespace[p]["blocks"] = [b for b in namespace[p]["blocks"]
-                                      if b[0] != op["bid"]]
+            if p in namespace:  # tolerate journals from older builds
+                namespace[p]["blocks"] = [b for b in namespace[p]["blocks"]
+                                          if b[0] != op["bid"]]
         elif kind == "close":
             inode = namespace[p]
             inode["uc"] = False
@@ -541,15 +542,25 @@ class FSNamesystem:
 
     def abandon_block(self, path: str, client: str, block_id: int) -> None:
         """Client hit a pipeline failure: drop the block and let it retry
-        (≈ ClientProtocol.abandonBlock)."""
+        (≈ ClientProtocol.abandonBlock). Validated BEFORE journaling — a
+        bad op must never reach the edit log (replay has no error
+        handling by design: a journaled op is a committed fact), and only
+        the lease holder of an under-construction file may abandon, else
+        any client could strip blocks from closed files."""
         with self.lock:
             inode = self.namespace.get(path)
+            if inode is None or inode.get("type") != "file":
+                raise FileNotFoundError(path)
+            if not inode.get("uc") or inode.get("client") != client:
+                raise LeaseError(
+                    f"{client} does not hold the lease on {path}")
+            if not any(b[0] == block_id for b in inode.get("blocks", [])):
+                return  # retried abandon: already gone, nothing to charge
             op = {"op": "abandon", "path": path, "bid": block_id}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
-            if inode is not None:
-                self._charge(path, 0, -inode["block_size"]
-                             * inode.get("replication", 1))
+            self._charge(path, 0, -inode["block_size"]
+                         * inode.get("replication", 1))
             self.block_to_path.pop(block_id, None)
 
     def complete(self, path: str, client: str, last_block_size: int) -> None:
@@ -642,11 +653,22 @@ class FSNamesystem:
         for k in children + [path]:
             node = self.namespace.get(k, {})
             if node.get("type") == "file":
-                doomed.extend(b[0] for b in node.get("blocks", []))
-                removed_bytes += sum(
-                    self.block_sizes.get(b[0], b[1])
-                    for b in node.get("blocks", [])) \
-                    * node.get("replication", 1)
+                blocks = node.get("blocks", [])
+                doomed.extend(b[0] for b in blocks)
+                repl = node.get("replication", 1)
+                if node.get("uc") and blocks:
+                    # the in-flight last block was charged a FULL block at
+                    # add_block and never settled — refund what was
+                    # charged, not its (still-zero) recorded size, or the
+                    # phantom charge outlives the file
+                    removed_bytes += (
+                        sum(self.block_sizes.get(b[0], b[1])
+                            for b in blocks[:-1])
+                        + node["block_size"]) * repl
+                else:
+                    removed_bytes += sum(
+                        self.block_sizes.get(b[0], b[1])
+                        for b in blocks) * repl
             self._quota_usage.pop(k, None)
         op = {"op": "delete", "path": path}
         self._log(op)

@@ -126,15 +126,6 @@ class Trash:
                 removed += 1
         return removed
 
-    def run_emptier_cycle(self) -> int:
-        """One Emptier pass (≈ Trash.Emptier on the NameNode): seal the
-        current deletes into a checkpoint, then drop checkpoints older
-        than the interval. Returns how many checkpoints were expunged."""
-        if not self.enabled:
-            return 0
-        self.checkpoint()
-        return self.expunge()
-
     def expunge_all(self) -> int:
         """Checkpoint then delete EVERY checkpoint (shell -expunge)."""
         self.checkpoint()

@@ -65,7 +65,11 @@ def maybe_profile(conf: Any, task: Any, local_dir: str,
     pstats report lands in ``<local_dir>/profile.out``. Profiling must
     never fail the task: dump errors are swallowed, and the task's own
     exceptions propagate unchanged."""
-    if not should_profile(conf, task):
+    try:
+        enabled = should_profile(conf, task)
+    except Exception:  # noqa: BLE001 — a typo'd range spec ("0:2") must
+        enabled = False  # disable profiling, never fail the task
+    if not enabled:
         return fn()
     import cProfile
     prof = cProfile.Profile()

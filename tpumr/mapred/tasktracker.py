@@ -370,6 +370,25 @@ class NodeRunner:
                         jc, os.path.join(self.local_root, "cache"), job_id)
                 shutil.rmtree(os.path.join(self.local_root, job_id),
                               ignore_errors=True)
+        self._purge_old_userlogs()
+
+    def _purge_old_userlogs(self) -> None:
+        """Retained logs (profiles) age out after
+        ``mapred.userlog.retain.hours`` (reference default 24) — they
+        outlive job cleanup on purpose, but not forever."""
+        logs = os.path.join(self.local_root, "userlogs")
+        if not os.path.isdir(logs):
+            return
+        retain_s = self.conf.get_float("mapred.userlog.retain.hours",
+                                       24.0) * 3600
+        now = time.time()
+        for job_id in os.listdir(logs):
+            d = os.path.join(logs, job_id)
+            try:
+                if now - os.path.getmtime(d) > retain_s:
+                    shutil.rmtree(d, ignore_errors=True)
+            except OSError:
+                pass
 
     def _apply_action(self, action: dict) -> None:
         kind = action.get("type")
