@@ -110,6 +110,11 @@ class Counters:
     def __init__(self) -> None:
         self._groups: dict[str, CounterGroup] = {}
         self._lock = threading.Lock()
+        #: (group, name) -> Counter fast path: incr() runs once per
+        #: RECORD on the host map/reduce paths — the two-level locked
+        #: lookup is profiling-visible. CPython dict reads are atomic;
+        #: insertion goes through the locked path once per counter.
+        self._flat: dict[tuple, Counter] = {}
 
     def group(self, name: str) -> CounterGroup:
         with self._lock:
@@ -119,7 +124,12 @@ class Counters:
             return g
 
     def counter(self, group: str, name: str) -> Counter:
-        return self.group(group).counter(name)
+        key = (group, name)
+        c = self._flat.get(key)
+        if c is None:
+            c = self.group(group).counter(name)
+            self._flat[key] = c
+        return c
 
     def incr(self, group: str, name: str, amount: int = 1) -> None:
         self.counter(group, name).increment(amount)

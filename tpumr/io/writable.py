@@ -22,17 +22,24 @@ import numpy as np
 # Unsigned LEB128 (different encoding than WritableUtils.writeVInt, same role)
 
 
-def write_vint(out: BinaryIO, value: int) -> None:
-    if value < 0:
-        raise ValueError("write_vint takes unsigned values; use zigzag first")
+def _vint_bytes(value: int) -> bytes:
+    if value < 0x80:
+        return bytes((value,))
+    out = bytearray()
     while True:
         b = value & 0x7F
         value >>= 7
         if value:
-            out.write(bytes((b | 0x80,)))
+            out.append(b | 0x80)
         else:
-            out.write(bytes((b,)))
-            return
+            out.append(b)
+            return bytes(out)
+
+
+def write_vint(out: BinaryIO, value: int) -> None:
+    if value < 0:
+        raise ValueError("write_vint takes unsigned values; use zigzag first")
+    out.write(_vint_bytes(value))  # single encoder, single write() call
 
 
 def read_vint(inp: BinaryIO) -> int:
@@ -70,13 +77,31 @@ _T_LIST = 7
 _T_NDARRAY = 8  # dtype-str, shape, raw bytes
 _T_DICT = 9
 
+#: one source of truth for the fast-path frames
+_TAG_BYTES = bytes((_T_BYTES,))
+_TAG_TEXT = bytes((_T_TEXT,))
+_TAG_INT = bytes((_T_INT,))
+
 
 def serialize(obj: Any, out: BinaryIO | None = None) -> bytes | None:
-    """Encode a value to the typed binary format."""
-    buf = out or BytesIO()
-    _write(buf, obj)
+    """Encode a value to the typed binary format. The exact-type fast
+    paths matter: this runs twice per record on the host map path (key +
+    value), and a BytesIO round-trip per call is profiling-visible.
+    ``type() is`` (not isinstance) so bool/np subtypes still take the
+    fully-general _write path."""
     if out is None:
-        return buf.getvalue()  # type: ignore[union-attr]
+        t = type(obj)
+        if t is bytes:
+            return _TAG_BYTES + _vint_bytes(len(obj)) + obj
+        if t is str:
+            b = obj.encode("utf-8")
+            return _TAG_TEXT + _vint_bytes(len(b)) + b
+        if t is int:
+            return _TAG_INT + _vint_bytes(zigzag(obj))
+        buf = BytesIO()
+        _write(buf, obj)
+        return buf.getvalue()
+    _write(out, obj)
     return None
 
 
@@ -128,8 +153,79 @@ def _write(out: BinaryIO, obj: Any) -> None:
 
 
 def deserialize(data: "bytes | BinaryIO") -> Any:
-    inp = BytesIO(data) if isinstance(data, (bytes, bytearray)) else data
-    return _read(inp)
+    if isinstance(data, (bytes, bytearray)):
+        # positional parser on the buffer — no BytesIO, no per-byte
+        # read() calls (this runs once per record on the reduce path)
+        try:
+            return _read_at(data, 0)[0]
+        except IndexError:
+            # keep the stream path's error contract for corrupt input
+            raise EOFError("truncated value buffer") from None
+    return _read(data)
+
+
+def _vint_at(d: "bytes", pos: int) -> "tuple[int, int]":
+    shift = 0
+    result = 0
+    while True:
+        b = d[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _read_at(d: "bytes", pos: int) -> "tuple[Any, int]":
+    tag = d[pos]
+    pos += 1
+    if tag == _T_BYTES:
+        n, pos = _vint_at(d, pos)
+        return bytes(d[pos:pos + n]), pos + n
+    if tag == _T_TEXT:
+        n, pos = _vint_at(d, pos)
+        return bytes(d[pos:pos + n]).decode("utf-8"), pos + n
+    if tag == _T_INT:
+        v, pos = _vint_at(d, pos)
+        return unzigzag(v), pos
+    if tag == _T_NULL:
+        return None, pos
+    if tag == _T_BOOL_T:
+        return True, pos
+    if tag == _T_BOOL_F:
+        return False, pos
+    if tag == _T_FLOAT:
+        return struct.unpack_from(">d", d, pos)[0], pos + 8
+    if tag == _T_NDARRAY:
+        n, pos = _vint_at(d, pos)
+        dt = np.dtype(bytes(d[pos:pos + n]).decode())
+        pos += n
+        ndim, pos = _vint_at(d, pos)
+        shape = []
+        for _ in range(ndim):
+            dim, pos = _vint_at(d, pos)
+            shape.append(dim)
+        nraw, pos = _vint_at(d, pos)
+        arr = np.frombuffer(d, dtype=dt, count=-1 if not dt.itemsize else
+                            nraw // dt.itemsize, offset=pos) \
+            .reshape(tuple(shape)).copy()
+        return arr, pos + nraw
+    if tag == _T_LIST:
+        n, pos = _vint_at(d, pos)
+        out = []
+        for _ in range(n):
+            item, pos = _read_at(d, pos)
+            out.append(item)
+        return out, pos
+    if tag == _T_DICT:
+        n, pos = _vint_at(d, pos)
+        res = {}
+        for _ in range(n):
+            k, pos = _read_at(d, pos)
+            v, pos = _read_at(d, pos)
+            res[k] = v
+        return res, pos
+    raise ValueError(f"bad type tag {tag}")
 
 
 def _read(inp: BinaryIO) -> Any:

@@ -50,6 +50,10 @@ class MapOutputBuffer:
         self._bytes = 0
         self._threshold = int(conf.sort_mb * 1024 * 1024 * conf.spill_percent)
         self._spills: list[tuple[str, dict]] = []
+        self._c_out_records = reporter.counters.counter(
+            TaskCounter.FRAMEWORK_GROUP, TaskCounter.MAP_OUTPUT_RECORDS)
+        self._c_out_bytes = reporter.counters.counter(
+            TaskCounter.FRAMEWORK_GROUP, TaskCounter.MAP_OUTPUT_BYTES)
         os.makedirs(local_dir, exist_ok=True)
 
     # ------------------------------------------------------------ collect
@@ -61,11 +65,9 @@ class MapOutputBuffer:
         kb, vb = serialize(key), serialize(value)
         self._buf.append((part, kb, vb))
         self._bytes += len(kb) + len(vb) + 16
-        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
-                                   TaskCounter.MAP_OUTPUT_RECORDS)
-        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
-                                   TaskCounter.MAP_OUTPUT_BYTES,
-                                   len(kb) + len(vb))
+        # hoisted Counter objects: this runs once per map OUTPUT record
+        self._c_out_records.increment()
+        self._c_out_bytes.increment(len(kb) + len(vb))
         if self._bytes >= self._threshold:
             self.sort_and_spill()
 
@@ -287,9 +289,10 @@ def _cpu_runner_class(conf: Any) -> type:
 def _counted_reader(in_fmt: Any, split: InputSplit | None, conf: Any,
                     reporter: Reporter) -> Iterator[tuple[Any, Any]]:
     reader = in_fmt.get_record_reader(split, conf, reporter)
+    c_in = reporter.counters.counter(TaskCounter.FRAMEWORK_GROUP,
+                                     TaskCounter.MAP_INPUT_RECORDS)
     for i, (k, v) in enumerate(reader):
         if (i & 0x1FF) == 0:  # cooperative kill poll every 512 records —
             reporter.raise_if_aborted()  # preemption frees the slot NOW
-        reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
-                              TaskCounter.MAP_INPUT_RECORDS)
+        c_in.increment()
         yield k, v

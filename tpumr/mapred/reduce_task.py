@@ -59,9 +59,11 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
     out_fmt = new_instance(conf.get_output_format(), conf)
     writer = out_fmt.get_record_writer(conf, wd, task.partition)
 
+    c_out = reporter.counters.counter(TaskCounter.FRAMEWORK_GROUP,
+                                      TaskCounter.REDUCE_OUTPUT_RECORDS)
+
     def emit(k: Any, v: Any) -> None:
-        reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
-                              TaskCounter.REDUCE_OUTPUT_RECORDS)
+        c_out.increment()
         writer.write(k, v)
 
     collector = OutputCollector(emit)
@@ -97,6 +99,8 @@ def group_by_key(stream: Iterator[tuple[bytes, bytes]],
     except StopIteration:
         return
     pending: list[tuple[bytes, bytes] | None] = [first]
+    c_in = reporter.counters.counter(TaskCounter.FRAMEWORK_GROUP,
+                                     TaskCounter.REDUCE_INPUT_RECORDS)
 
     while pending[0] is not None:
         head = pending[0]
@@ -106,8 +110,7 @@ def group_by_key(stream: Iterator[tuple[bytes, bytes]],
         def values() -> Iterator[Any]:
             while pending[0] is not None and sort_key(pending[0][0]) == group_sk:
                 kb, vb = pending[0]
-                reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
-                                      TaskCounter.REDUCE_INPUT_RECORDS)
+                c_in.increment()
                 try:
                     pending[0] = next(it)
                 except StopIteration:
