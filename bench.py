@@ -286,6 +286,12 @@ def main() -> None:
     rows: dict = {}
     t_cpu, t_warm = bench_kmeans(rows)
     for fn in (bench_wordcount, bench_pi, bench_terasort):
+        # workloads run in ONE process here; in production each job owns
+        # its runner. Drop the previous workload's HBM split cache so a
+        # 6.4 GB resident K-Means dataset doesn't starve the terasort
+        # device buffers into allocation thrash.
+        from tpumr.mapred.tpu_runner import clear_split_caches
+        clear_split_caches()
         try:
             fn(rows)
         except Exception as e:  # noqa: BLE001 — secondary rows best-effort

@@ -243,3 +243,28 @@ class TestDeviceShuffleMiniCluster:
         shuffled = result.counters.value(BackendCounter.GROUP,
                                        BackendCounter.TPU_SHUFFLE_RECORDS)
         assert shuffled == 600
+
+
+def test_device_partition_sort_single_device_mesh():
+    """The n_dev==1 short-circuit (the real single-chip bench path): no
+    exchange, no padding — straight device sort, full row fidelity."""
+    import numpy as np
+
+    from tpumr.parallel.device_sort import device_partition_sort
+    from tpumr.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(7)
+    n, klen, vlen = 5000, 10, 22
+    records = rng.integers(0, 256, size=(n, klen + vlen), dtype=np.uint8)
+    splitters = np.sort(
+        rng.integers(0, 256, size=(3, klen), dtype=np.uint8), axis=0)
+    mesh = make_mesh(1)
+    shards, overflow = device_partition_sort(mesh, records, klen,
+                                             splitters, 4)
+    assert overflow == 0 and len(shards) == 1
+    out = shards[0]
+    assert out.shape == (n, klen + vlen)
+    keys = [bytes(r) for r in out[:, :klen]]
+    assert keys == sorted(keys)
+    # permutation fidelity: exact multiset of rows survives
+    assert sorted(map(bytes, out)) == sorted(map(bytes, records))

@@ -145,6 +145,17 @@ def device_partition_sort(mesh: Mesh, records: np.ndarray, klen: int,
     n0, w = records.shape
     ranges_per_dev = -(-num_ranges // n_dev)
 
+    if n_dev == 1:
+        # single-device mesh: the all-to-all exchange is the identity and
+        # its 2x-capacity receive buffer would only double the
+        # device↔host transfer (the single-chip bottleneck is PCIe/tunnel
+        # bandwidth, not FLOPs). Sort the rows exactly as given — no
+        # padding, no validity column, no extra host copy.
+        sharded = shard_over(mesh, records, axis_name)
+        valid = shard_over(mesh, np.ones(n0, bool), axis_name)
+        sorted_recs, _ = make_sort_fn(mesh, klen, axis_name)(sharded, valid)
+        return [np.asarray(sorted_recs)], 0
+
     # trailing validity byte + pad rows (zeros → marked invalid) so the
     # leading dim divides the mesh; pads route to device 0 and are masked
     # out on the host after the sort
