@@ -189,3 +189,43 @@ class TestExamples:
         outs = [st for st in fs.list_files("/ex/mm/out")
                 if st.path.name.startswith("part")]
         assert outs
+
+
+def test_job_history_viewer(tmp_path, capsys):
+    """≈ hadoop job -history / HistoryViewer: offline summary of one
+    job's history file, including per-attempt failure rows."""
+    from tpumr.cli import main as cli
+    from tpumr.fs import get_filesystem
+    from tpumr.mapred.job_client import JobClient
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+
+    hist = tmp_path / "hist"
+    conf0 = JobConf()
+    conf0.set("tpumr.history.dir", str(hist))
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/jh/in.txt", b"x y\n" * 20)
+    with MiniMRCluster(num_trackers=1, conf=conf0, cpu_slots=2,
+                       tpu_slots=0) as c:
+        conf = c.create_job_conf()
+        conf.set_job_name("history-viewer-job")
+        conf.set_input_paths("mem:///jh/in.txt")
+        conf.set_output_path("mem:///jh/out")
+        conf.set("mapred.mapper.class",
+                 "tpumr.ops.wordcount.WordCountCpuMapper")
+        conf.set("mapred.reducer.class",
+                 "tpumr.examples.basic.LongSumReducer")
+        result = JobClient(conf).run_job(conf)
+        assert result.successful
+        job_id = str(result.job_id)
+
+    rc = cli(["job", "-history", job_id, str(hist)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "history-viewer-job" in out
+    assert "SUCCEEDED" in out
+    assert "JOB_FINISHED=1" in out
+
+    rc = cli(["job", "-history", "job_nope_0001", str(hist)])
+    assert rc == 1
+    assert "known:" in capsys.readouterr().err

@@ -144,3 +144,28 @@ def test_output_committer_speculative_and_abort():
     names = [s.path.name for s in fs.list_files("mem:///out")]
     assert "part-00001" not in names
     assert "_SUCCESS" in names
+
+
+def test_keyvalue_text_input_format(tmp_path):
+    """≈ KeyValueTextInputFormat: first-separator split, custom separator,
+    separator-less lines become (line, '')."""
+    from tpumr.mapred.input_formats import (FileSplit,
+                                            KeyValueTextInputFormat)
+    from tpumr.mapred.jobconf import JobConf
+
+    src = tmp_path / "kv.txt"
+    src.write_bytes(b"k1\tv1\nk2\tv2a\tv2b\nbare\nk3\tv3\n")
+    conf = JobConf()
+    fmt = KeyValueTextInputFormat()
+    split = FileSplit(path=f"file://{src}", start=0,
+                      split_length=src.stat().st_size)
+    recs = list(fmt.get_record_reader(split, conf))
+    assert recs == [("k1", "v1"), ("k2", "v2a\tv2b"), ("bare", ""),
+                    ("k3", "v3")]
+
+    conf.set("key.value.separator.in.input.line", ",")
+    src.write_bytes(b"a,1\nb,2\n")
+    split = FileSplit(path=f"file://{src}", start=0,
+                      split_length=src.stat().st_size)
+    assert list(fmt.get_record_reader(split, conf)) == [("a", "1"),
+                                                        ("b", "2")]

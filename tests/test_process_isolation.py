@@ -270,3 +270,39 @@ def test_launch_through_task_controller_as_nobody(tc_sandbox):
         assert "uid_0" not in ids, "child ran as root"
     finally:
         sys.path.remove(str(tc_sandbox["pylib"]))
+
+
+def test_child_logs_retained_and_served(cluster, tmp_path):
+    """≈ userlogs + TaskLogServlet: a child's stdout/stderr survives job
+    cleanup in the userlogs tree and is listed/served by the tracker."""
+    conf = _job_conf(cluster, tmp_path, "iso-logs")
+    conf.set_class("mapred.mapper.class", ChattyMapper)
+    conf.set_num_reduce_tasks(0)
+    result = JobClient(conf).run_job(conf)
+    assert result.successful
+
+    # the umbilical reports success before the tracker's monitor thread
+    # finishes reaping the child and copying its log — poll briefly
+    deadline = time.time() + 10
+    found = None
+    while time.time() < deadline and found is None:
+        for t in cluster.trackers:
+            for aid in t.list_task_logs():
+                if "hello from the child" in t.get_task_log(aid):
+                    found = (t, aid)
+        time.sleep(0.1)
+    assert found, "this job's child log never appeared in userlogs"
+    with pytest.raises(KeyError):
+        found[0].get_task_log("attempt_0_0000_m_000099_0")
+
+
+class ChattyMapper:
+    def configure(self, conf):
+        pass
+
+    def map(self, key, value, output, reporter):
+        print("hello from the child", flush=True)
+        output.collect(value, 1)
+
+    def close(self):
+        pass

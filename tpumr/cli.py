@@ -209,8 +209,12 @@ def cmd_fs(conf, argv: list[str]) -> int:
 
 
 def cmd_job(conf, argv: list[str]) -> int:
-    """≈ bin/hadoop job: -list, -status, -kill, -counters."""
+    """≈ bin/hadoop job: -list, -status, -kill, -counters, -history."""
     from tpumr.ipc.rpc import RpcClient, RpcError
+    if argv and argv[0] == "-history":
+        # offline: reads the history dir directly (≈ HistoryViewer) — no
+        # live master needed
+        return _job_history(conf, argv[1:])
     jt = conf.get("mapred.job.tracker")
     if not jt or jt == "local":
         print("job control needs -jt HOST:PORT", file=sys.stderr)
@@ -219,7 +223,7 @@ def cmd_job(conf, argv: list[str]) -> int:
     from tpumr.security import rpc_secret
     client = RpcClient(host, port, secret=rpc_secret(conf))
     usage = ("Usage: tpumr job -list | -status ID | -kill ID | "
-             "-counters ID | -events ID")
+             "-counters ID | -events ID | -history ID [HISTORY_DIR]")
     if not argv:
         print(usage, file=sys.stderr)
         return 255
@@ -358,6 +362,60 @@ def cmd_dfsadmin(conf, argv: list[str]) -> int:
         return 0
     print(usage, file=sys.stderr)
     return 255
+
+
+def _job_history(conf, argv: list[str]) -> int:
+    """Human summary of one job's history file (≈ HistoryViewer, the
+    engine behind `hadoop job -history`)."""
+    import os
+    if not argv:
+        print("Usage: tpumr job -history JOB_ID [HISTORY_DIR]",
+              file=sys.stderr)
+        return 255
+    job_id = argv[0]
+    hist_dir = argv[1] if len(argv) > 1 else \
+        conf.get("tpumr.history.dir")
+    if not hist_dir:
+        print("job -history: pass HISTORY_DIR or set tpumr.history.dir",
+              file=sys.stderr)
+        return 255
+    path = os.path.join(hist_dir, f"{job_id}.jsonl")
+    if not os.path.exists(path):
+        known = [f[:-6] for f in sorted(os.listdir(hist_dir))
+                 if f.endswith(".jsonl")] if os.path.isdir(hist_dir) else []
+        print(f"no history for {job_id} in {hist_dir}; known: "
+              f"{', '.join(known) or '(none)'}", file=sys.stderr)
+        return 1
+    from tpumr.mapred.history import JobHistory
+    from tpumr.mapred.history_server import job_summary
+    events = JobHistory.read(path)
+    s = job_summary(events)
+    print(f"Job: {s.get('job_id', job_id)}")
+    print(f"Name: {s.get('name', '')}")
+    print(f"State: {s.get('state', 'INCOMPLETE')}")
+    if s.get("wall_time") is not None:
+        print(f"Wall time: {s['wall_time']:.2f}s")
+    print(f"Maps: {s.get('num_maps', '?')}  Reduces: "
+          f"{s.get('num_reduces', '?')}")
+    print(f"TPU maps: {s.get('finished_tpu_maps', 0) or 0}  CPU maps: "
+          f"{s.get('finished_cpu_maps', 0) or 0}")
+    if s.get("acceleration_factor"):
+        print(f"Acceleration factor: {s['acceleration_factor']:.2f}")
+    if s.get("error"):
+        print(f"Error: {s['error']}")
+    kinds: dict = {}
+    for ev in events:
+        kinds[ev.get("event", "?")] = kinds.get(ev.get("event", "?"), 0) + 1
+    print("Events: " + ", ".join(f"{k}={v}"
+                                 for k, v in sorted(kinds.items())))
+    # per-task failure diagnostics ≈ HistoryViewer's FAILED task listing
+    for ev in events:
+        if ev.get("event") == "TASK_FAILED":
+            where = "tpu" if ev.get("run_on_tpu") else "cpu"
+            print(f"  failed: {ev.get('attempt_id', '?')} ({where} on "
+                  f"{ev.get('tracker', '?')}, "
+                  f"{ev.get('runtime', 0):.2f}s)")
+    return 0
 
 
 def cmd_gridmix(conf, argv: list[str]) -> int:

@@ -154,6 +154,23 @@ class BytesTextInputFormat(TextInputFormat):
     keep_bytes = True
 
 
+class KeyValueTextInputFormat(TextInputFormat):
+    """≈ mapred/KeyValueTextInputFormat.java: each line splits at the
+    first separator byte (``key.value.separator.in.input.line``, default
+    TAB) into (key, value); a line with no separator becomes (line, "")."""
+
+    def get_record_reader(self, split, conf, reporter=None):
+        # FIRST BYTE of the configured separator, as the reference does
+        # (KeyValueLineRecordReader takes separator.charAt(0)); an empty
+        # config value falls back to TAB instead of crashing the task
+        sep = (str(conf.get("key.value.separator.in.input.line", "\t"))
+               or "\t")[:1]
+        for _offset, line in super().get_record_reader(split, conf,
+                                                       reporter):
+            k, _, v = line.partition(sep)
+            yield k, v
+
+
 class NLineInputFormat(FileInputFormat):
     """≈ mapred/lib/NLineInputFormat.java: one split per N lines — the knob
     the reference's GPU config used to make one map = one kernel launch

@@ -91,10 +91,16 @@ def run_task_in_process(runner: Any, job_id: str, task: Task,
     with os.fdopen(fd, "wb") as f:
         f.write(payload)
 
-    log_path = os.path.join(task_dir, "child.log")
+    # the child's stdout/stderr goes STRAIGHT into the retained userlogs
+    # tree (≈ userlogs + TaskLogServlet): the sandbox dir is purged the
+    # moment the job finishes — a post-exit copy from it would race that
+    # cleanup and lose exactly the logs someone wants to read
+    logs_dir = os.path.join(runner.local_root, "userlogs", job_id, aid)
+    os.makedirs(logs_dir, exist_ok=True)
+    log_path = os.path.join(logs_dir, "child.log")
     cmd = build_child_command(runner, task_dir, task_file, log_path)
     open(log_path, "ab").close()
-    _prepare_sandbox_for_user(runner, task_dir)
+    _prepare_sandbox_for_user(runner, task_dir, logs_dir)
 
     mem_killed = []
     with open(log_path, "ab") as log_f:
@@ -149,13 +155,16 @@ def run_task_in_process(runner: Any, job_id: str, task: Task,
                 + _tail(log_path))
 
 
-def _prepare_sandbox_for_user(runner: Any, task_dir: str) -> None:
+def _prepare_sandbox_for_user(runner: Any, task_dir: str,
+                              logs_dir: "str | None" = None) -> None:
     """When launching through the setuid task-controller as root, hand the
-    attempt sandbox to the task user before exec — the controller refuses
-    a task dir the target user does not own. This is the role of the
-    reference controller's INITIALIZE_TASK command (the tracker-side
-    Localizer chowns task dirs through it). Parent dirs get traverse-only
-    bits so the child can reach its sandbox but not list sibling jobs."""
+    attempt sandbox (and its userlogs dir — the controller redirects the
+    child's stdio there after the privilege drop) to the task user before
+    exec — the controller refuses a task dir the target user does not
+    own. This is the role of the reference controller's INITIALIZE_TASK
+    command (the tracker-side Localizer chowns task dirs through it).
+    Parent dirs get traverse-only bits so the child can reach its sandbox
+    but not list sibling jobs."""
     tc = runner.conf.get("mapred.task.tracker.task-controller")
     if not tc or os.geteuid() != 0:
         return
@@ -170,10 +179,16 @@ def _prepare_sandbox_for_user(runner: Any, task_dir: str) -> None:
         return
     os.chmod(runner.local_root, 0o711)
     os.chmod(os.path.dirname(task_dir), 0o711)
-    for root, dirs, files in os.walk(task_dir):
-        os.chown(root, pw.pw_uid, pw.pw_gid)
-        for name in files:
-            os.chown(os.path.join(root, name), pw.pw_uid, pw.pw_gid)
+    roots = [task_dir]
+    if logs_dir is not None:
+        os.chmod(os.path.dirname(logs_dir), 0o711)          # userlogs/<job>
+        os.chmod(os.path.dirname(os.path.dirname(logs_dir)), 0o711)
+        roots.append(logs_dir)
+    for top in roots:
+        for root, dirs, files in os.walk(top):
+            os.chown(root, pw.pw_uid, pw.pw_gid)
+            for name in files:
+                os.chown(os.path.join(root, name), pw.pw_uid, pw.pw_gid)
 
 
 def _kill_tree(proc: "subprocess.Popen[bytes]") -> None:

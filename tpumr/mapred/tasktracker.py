@@ -206,6 +206,12 @@ class NodeRunner:
                                     "profile":
                                         self.get_profile(q["attempt"])},
                          parameterized=True)
+            srv.add_json("tasklogs", lambda q: self.list_task_logs())
+            srv.add_json("tasklog",
+                         lambda q: {"attempt": q["attempt"],
+                                    "log":
+                                        self.get_task_log(q["attempt"])},
+                         parameterized=True)
 
             def index_page(q: dict) -> str:
                 st = self._status_dict()
@@ -566,8 +572,8 @@ class NodeRunner:
     # ≈ TaskLog.LogName.PROFILE served by TaskLogServlet: per-attempt
     # cProfile reports written by profiler.maybe_profile
 
-    def list_profiles(self) -> "list[str]":
-        from tpumr.mapred.profiler import PROFILE_FILE
+    def _list_userlog_attempts(self, filename: str) -> "list[str]":
+        """Attempts whose retained userlogs dir holds ``filename``."""
         logs = os.path.join(self.local_root, "userlogs")
         out = []
         if not os.path.isdir(logs):
@@ -577,22 +583,44 @@ class NodeRunner:
             if not os.path.isdir(job_dir):
                 continue
             for aid in sorted(os.listdir(job_dir)):
-                if os.path.exists(os.path.join(job_dir, aid,
-                                               PROFILE_FILE)):
+                if os.path.exists(os.path.join(job_dir, aid, filename)):
                     out.append(aid)
         return out
 
-    def get_profile(self, attempt_id: str) -> str:
-        """One attempt's profile text; attempt ids are validated against
-        the listing (never used to build arbitrary paths)."""
-        from tpumr.mapred.profiler import PROFILE_FILE
-        if attempt_id not in self.list_profiles():
-            raise KeyError(f"no profile for attempt {attempt_id}")
+    def _userlog_path(self, attempt_id: str, filename: str) -> str:
+        """Validated path to one attempt's retained file — the attempt id
+        must round-trip through the id parser and exist in the listing
+        (never used to build arbitrary paths)."""
+        if attempt_id not in self._list_userlog_attempts(filename):
+            raise KeyError(f"no {filename} for attempt {attempt_id}")
         from tpumr.mapred.ids import TaskAttemptID
         job_id = str(TaskAttemptID.parse(attempt_id).task.job)
-        with open(os.path.join(self.local_root, "userlogs", job_id,
-                               attempt_id, PROFILE_FILE)) as f:
+        return os.path.join(self.local_root, "userlogs", job_id,
+                            attempt_id, filename)
+
+    def list_profiles(self) -> "list[str]":
+        from tpumr.mapred.profiler import PROFILE_FILE
+        return self._list_userlog_attempts(PROFILE_FILE)
+
+    def get_profile(self, attempt_id: str) -> str:
+        from tpumr.mapred.profiler import PROFILE_FILE
+        with open(self._userlog_path(attempt_id, PROFILE_FILE)) as f:
             return f.read()
+
+    def list_task_logs(self) -> "list[str]":
+        """Attempts with a retained child log (≈ the userlogs listing)."""
+        return self._list_userlog_attempts("child.log")
+
+    def get_task_log(self, attempt_id: str,
+                     max_bytes: int = 1 << 20) -> str:
+        """One attempt's retained stdout/stderr tail (≈ TaskLogServlet;
+        tail-bounded like TaskLogsTruncater)."""
+        path = self._userlog_path(attempt_id, "child.log")
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > max_bytes:
+                f.seek(size - max_bytes)
+            return f.read().decode("utf-8", "replace")
 
     # ------------------------------------------------------------ umbilical
     # child-process task protocol ≈ TaskUmbilicalProtocol (reference:
