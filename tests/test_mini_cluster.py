@@ -212,6 +212,41 @@ class BoomMapper:
         pass
 
 
+def test_concurrent_profiled_tasks_serialize():
+    """cProfile's sys.monitoring slot is process-global (3.12): two
+    attempts profiling at once must serialize, not fail with 'Another
+    profiling tool is already active'."""
+    import threading
+
+    from tpumr.mapred.ids import JobID, TaskAttemptID, TaskID
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.profiler import maybe_profile
+    from tpumr.mapred.task import Task
+
+    conf = JobConf()
+    conf.set("mapred.task.profile", True)
+    conf.set("mapred.task.profile.maps", "0-9")
+    errors = []
+
+    def run(i, tmp):
+        task = Task(TaskAttemptID(TaskID(JobID("prof", 1), True, i), 0),
+                    partition=i)
+        try:
+            maybe_profile(conf, task, tmp, lambda: sum(range(20000)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        threads = [threading.Thread(target=run, args=(i, f"{tmp}/{i}"))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+
+
 def test_task_profiling_opt_in(cluster, tmp_path):
     """≈ mapred.task.profile*: opted-in tasks dump cProfile reports next
     to their attempt files; the tracker lists and serves them; tasks

@@ -295,6 +295,56 @@ def test_child_logs_retained_and_served(cluster, tmp_path):
     with pytest.raises(KeyError):
         found[0].get_task_log("attempt_0_0000_m_000099_0")
 
+    # symlink defense: the attempt dir is task-user-owned in setuid mode —
+    # a child.log swapped for a symlink must NOT be followed by the
+    # (possibly root-running) tracker when serving /tasklog
+    tracker, aid = found
+    import os
+    from tpumr.mapred.ids import TaskAttemptID
+    job_id = str(TaskAttemptID.parse(aid).task.job)
+    log = os.path.join(tracker.local_root, "userlogs", job_id, aid,
+                       "child.log")
+    secret = tmp_path / "secret.txt"
+    secret.write_text("root-only contents")
+    os.remove(log)
+    os.symlink(str(secret), log)
+    with pytest.raises(KeyError):
+        tracker.get_task_log(aid)
+
+    # malformed / hostile ids keep the KeyError contract (no parser
+    # exceptions escape, no path bytes survive)
+    for bad in ("garbage", "attempt_0_x_m_000000_0",
+                "attempt_../x_0000_m_000000_0", ""):
+        with pytest.raises(KeyError):
+            tracker.get_task_log(bad)
+
+
+def test_userlog_purge_skips_jobs_with_running_attempts(cluster, tmp_path):
+    """A live attempt's userlogs dir must survive retention purge even
+    when the job dir's mtime is ancient (appends don't bump dir mtime)."""
+    import os
+    tracker = cluster.trackers[0]
+    logs = os.path.join(tracker.local_root, "userlogs")
+    live_dir = os.path.join(logs, "job_live_0001")
+    dead_dir = os.path.join(logs, "job_dead_0001")
+    os.makedirs(live_dir)
+    os.makedirs(dead_dir)
+    old = time.time() - 48 * 3600
+    os.utime(live_dir, (old, old))
+    os.utime(dead_dir, (old, old))
+    from tpumr.mapred.ids import TaskAttemptID
+    from tpumr.mapred.task import TaskStatus
+    with tracker.lock:
+        tracker.running["attempt_live_0001_m_000000_0"] = TaskStatus(
+            TaskAttemptID.parse("attempt_live_0001_m_000000_0"))
+    try:
+        tracker._purge_old_userlogs()
+    finally:
+        with tracker.lock:
+            tracker.running.pop("attempt_live_0001_m_000000_0")
+    assert os.path.isdir(live_dir), "live job's userlogs were purged"
+    assert not os.path.isdir(dead_dir), "retention purge stopped working"
+
 
 class ChattyMapper:
     def configure(self, conf):

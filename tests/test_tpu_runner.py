@@ -132,6 +132,114 @@ def test_wordcount_kernel_job_via_record_reader():
     assert out == {"alpha": "10", "beta": "20", "gamma": "10"}
 
 
+def _kmeans_conf(fs, tag, n=150, rows_per_split=40):
+    rng = np.random.default_rng(42)
+    pts = rng.normal(size=(n, 2)).astype(np.float32)
+    _save_npy(fs, f"/{tag}/points.npy", pts)
+    _save_npy(fs, f"/{tag}/centroids.npy",
+              np.array([[0, 0], [5, 5], [-5, 5]], np.float32))
+    conf = JobConf()
+    conf.set_input_paths(f"mem:///{tag}/points.npy")
+    conf.set_output_path(f"mem:///{tag}/out")
+    conf.set_input_format(DenseInputFormat)
+    conf.set("tpumr.dense.split.rows", rows_per_split)
+    conf.set("tpumr.kmeans.centroids", f"mem:///{tag}/centroids.npy")
+    conf.set_map_kernel("kmeans-assign")
+    conf.set_reducer_class(CentroidReducer)
+    conf.set_num_reduce_tasks(1)
+    conf.set("tpumr.local.run.on.tpu", True)
+    return conf
+
+
+def test_pipelined_window_fetches_once_per_window(monkeypatch):
+    """The map phase of a kernel job batches ALL tasks' device→host
+    transfers into one jax.device_get per pipeline window — on a tunneled
+    TPU each fetch of a computed array is a full network roundtrip, so
+    roundtrips per job must be O(tasks/window), not O(tasks)."""
+    import jax
+
+    from tpumr.ops.kmeans import clear_centroid_cache
+    clear_centroid_cache()
+    fs = get_filesystem("mem:///")
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get", lambda x: (calls.append(1), real(x))[1])
+
+    conf = _kmeans_conf(fs, "pw", n=150, rows_per_split=40)  # 4 splits
+    result = run_job(conf)
+    assert result.successful
+    assert result.num_maps == 4
+    assert len(calls) == 1  # one window, one roundtrip
+
+    # window smaller than the task count: one fetch per window
+    calls.clear()
+    clear_centroid_cache()
+    conf2 = _kmeans_conf(fs, "pw2", n=150, rows_per_split=40)
+    conf2.set("tpumr.tpu.pipeline.window", 2)
+    result2 = run_job(conf2)
+    assert result2.successful
+    assert len(calls) == 2  # ceil(4/2)
+
+
+def test_pipeline_window_byte_budget_closes_window_early(monkeypatch):
+    """The window is byte-bounded: staged inputs stay device-resident
+    until the window fetch, so a tiny budget must split one count-window
+    into several fetches (and still produce a correct job)."""
+    import jax
+
+    from tpumr.ops.kmeans import clear_centroid_cache
+    clear_centroid_cache()
+    fs = get_filesystem("mem:///")
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get", lambda x: (calls.append(1), real(x))[1])
+
+    conf = _kmeans_conf(fs, "pb", n=150, rows_per_split=40)  # 4 splits
+    conf.set("tpumr.tpu.pipeline.window.mb", 0)  # every task busts the budget
+    conf.set("tpumr.tpu.split.cache", False)
+    result = run_job(conf)
+    assert result.successful
+    assert len(calls) == 4  # one-task windows
+
+
+def test_pipelined_window_output_matches_per_task_path():
+    """Window on vs off (window=0 forces the per-task path) produce
+    byte-identical job output."""
+    from tpumr.ops.kmeans import clear_centroid_cache
+    fs = get_filesystem("mem:///")
+
+    outs = []
+    for i, window in enumerate((32, 0)):
+        clear_centroid_cache()
+        conf = _kmeans_conf(fs, f"pe{i}")
+        conf.set("tpumr.tpu.pipeline.window", window)
+        assert run_job(conf).successful
+        outs.append(fs.read_bytes(f"mem:///pe{i}/out/part-00000"))
+    assert outs[0] == outs[1]
+
+
+def test_pi_kernel_launch_drain_stays_on_device_until_fetch():
+    """pi-sampler's launch dispatches every sample block without a sync;
+    records appear only at drain, and totals match the sample count."""
+    from tpumr.mapred.split import InputSplit
+    from tpumr.ops import get_kernel
+    import jax
+
+    kernel = get_kernel("pi-sampler")
+    assert type(kernel).supports_launch()
+
+    class B:
+        num_records = 3
+        def value(self, i):
+            return f"{i} 1000".encode()
+
+    conf = JobConf()
+    state = kernel.map_batch_launch(B(), conf, None)
+    out = dict(kernel.map_batch_drain(jax.device_get(state), conf, None))
+    assert out["total"] == 3000
+    assert 0 < out["inside"] <= 3000
+
+
 def test_hbm_split_cache_hit_on_second_round():
     """Iterative jobs stage each dense split once: round 2 reports zero
     newly-staged device bytes (HBM-resident split cache)."""

@@ -82,18 +82,22 @@ class LocalJobRunner:
 
         # ---- map phase
         map_outputs: list[tuple[str, dict] | None] = [None] * len(splits)
+        tasks = [
+            Task(TaskAttemptID(TaskID(job_id, True, i), 0), partition=i,
+                 num_reduces=num_reduces, split=splits[i].to_dict(),
+                 run_on_tpu=run_on_tpu,
+                 tpu_device_id=0 if run_on_tpu else -1)
+            for i in range(len(splits))
+        ]
 
         def one_map(i: int) -> None:
-            split = splits[i]
-            attempt = TaskAttemptID(TaskID(job_id, True, i), 0)
-            task = Task(attempt, partition=i, num_reduces=num_reduces,
-                        split=split.to_dict(), run_on_tpu=run_on_tpu,
-                        tpu_device_id=0 if run_on_tpu else -1)
+            task = tasks[i]
             reporter = Reporter()
             local_dir = f"{work_root}/map_{i:06d}"
             out = run_map_task(conf, task, local_dir, reporter)
+            task.__dict__.pop("_device_prefetch", None)  # free window memory
             if num_reduces == 0:
-                committer.commit_task(str(attempt))
+                committer.commit_task(str(task.attempt_id))
             map_outputs[i] = out
             counters.merge(reporter.counters)
             counters.incr(JobCounter.GROUP, JobCounter.LAUNCHED_MAP_TASKS)
@@ -103,8 +107,29 @@ class LocalJobRunner:
             with ThreadPoolExecutor(max_workers=pool_size) as pool:
                 list(pool.map(one_map, range(len(splits))))
         else:
-            for i in range(len(splits)):
-                one_map(i)
+            # TPU kernel jobs run map windows through the two-phase device
+            # pipeline: dispatch a whole window of kernels, fetch every
+            # task's output in ONE device_get (tpu_runner.prelaunch_device_
+            # maps), then drain each task through the normal collect/spill
+            # path — tunnel roundtrips per job drop from O(tasks) to
+            # O(tasks / window)
+            window = (conf.get_int("tpumr.tpu.pipeline.window", 32)
+                      if run_on_tpu else 0)
+            lo = 0
+            while lo < len(splits):
+                hi = min(lo + window, len(splits)) if window > 0 else len(splits)
+                if window > 0:
+                    from tpumr.mapred.tpu_runner import prelaunch_device_maps
+                    pre = prelaunch_device_maps(conf, tasks[lo:hi])
+                    if pre is None:
+                        window, hi = 0, len(splits)  # ineligible: plain path
+                    else:
+                        hi = lo + len(pre)  # byte budget may shorten a window
+                        for t, p in zip(tasks[lo:hi], pre):
+                            t._device_prefetch = p
+                for i in range(lo, hi):
+                    one_map(i)
+                lo = hi
 
         # ---- reduce phase
         if num_reduces > 0 and is_device_shuffle(conf):

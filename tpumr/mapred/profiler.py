@@ -19,9 +19,14 @@ Conf keys (same names as the reference where they exist):
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable
 
 PROFILE_FILE = "profile.out"
+
+#: cProfile's sys.monitoring slot is process-global on 3.12 — one
+#: profiled section at a time (see maybe_profile)
+_PROFILE_SLOT = threading.Lock()
 
 
 def profile_dir(conf: Any, attempt_id: str, fallback: str) -> str:
@@ -72,20 +77,29 @@ def maybe_profile(conf: Any, task: Any, local_dir: str,
     if not enabled:
         return fn()
     import cProfile
-    prof = cProfile.Profile()
-    try:
-        return prof.runcall(fn)
-    finally:
+    # cPython 3.12 cProfile claims a PROCESS-global sys.monitoring tool
+    # slot: two attempts profiling concurrently (tracker threads in one
+    # process, MiniMRCluster) would die with "Another profiling tool is
+    # already active" — serialize profiled sections instead
+    with _PROFILE_SLOT:
+        prof = cProfile.Profile()
         try:
-            import io
-            import pstats
-            os.makedirs(local_dir, exist_ok=True)
-            buf = io.StringIO()
-            sort = conf.get("tpumr.task.profile.sort", "cumulative")
-            pstats.Stats(prof, stream=buf).sort_stats(sort) \
-                .print_stats(60)
-            with open(os.path.join(local_dir, PROFILE_FILE), "w") as f:
-                f.write(f"# profile of {task.attempt_id}\n")
-                f.write(buf.getvalue())
-        except Exception:  # noqa: BLE001 — profiling is best-effort
-            pass
+            return prof.runcall(fn)
+        finally:
+            _dump_profile(prof, conf, task, local_dir)
+
+
+def _dump_profile(prof: Any, conf: Any, task: Any, local_dir: str) -> None:
+    try:
+        import io
+        import pstats
+        os.makedirs(local_dir, exist_ok=True)
+        buf = io.StringIO()
+        sort = conf.get("tpumr.task.profile.sort", "cumulative")
+        pstats.Stats(prof, stream=buf).sort_stats(sort) \
+            .print_stats(60)
+        with open(os.path.join(local_dir, PROFILE_FILE), "w") as f:
+            f.write(f"# profile of {task.attempt_id}\n")
+            f.write(buf.getvalue())
+    except Exception:  # noqa: BLE001 — profiling is best-effort
+        pass

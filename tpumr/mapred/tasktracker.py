@@ -388,7 +388,14 @@ class NodeRunner:
         retain_s = self.conf.get_float("mapred.userlog.retain.hours",
                                        24.0) * 3600
         now = time.time()
+        with self.lock:
+            # a LIVE attempt's child.log lives in this tree; its job dir
+            # must never age out mid-run (appends don't bump dir mtime)
+            live_jobs = {str(TaskAttemptID.parse(aid).task.job)
+                         for aid in self.running}
         for job_id in os.listdir(logs):
+            if job_id in live_jobs:
+                continue
             d = os.path.join(logs, job_id)
             try:
                 if now - os.path.getmtime(d) > retain_s:
@@ -587,16 +594,34 @@ class NodeRunner:
                     out.append(aid)
         return out
 
-    def _userlog_path(self, attempt_id: str, filename: str) -> str:
-        """Validated path to one attempt's retained file — the attempt id
-        must round-trip through the id parser and exist in the listing
-        (never used to build arbitrary paths)."""
-        if attempt_id not in self._list_userlog_attempts(filename):
-            raise KeyError(f"no {filename} for attempt {attempt_id}")
-        from tpumr.mapred.ids import TaskAttemptID
-        job_id = str(TaskAttemptID.parse(attempt_id).task.job)
-        return os.path.join(self.local_root, "userlogs", job_id,
-                            attempt_id, filename)
+    def _open_userlog(self, attempt_id: str, filename: str):
+        """Open one attempt's retained file for reading, O(1) and
+        symlink-proof. The id is round-tripped through the parser (which
+        fully constrains the path — no traversal bytes survive it), and
+        the file is opened O_NOFOLLOW: the attempt dir is chowned to the
+        task user in setuid mode (_prepare_sandbox_for_user), so a job
+        could swap child.log for a symlink and have the root-running
+        tracker serve any file on the box (the native controller opens
+        its logfile O_NOFOLLOW for the same reason)."""
+        import re
+        try:
+            parsed = TaskAttemptID.parse(attempt_id)
+        except (ValueError, IndexError):
+            raise KeyError(f"bad attempt id {attempt_id!r}") from None
+        if (str(parsed) != attempt_id
+                or not re.fullmatch(r"[A-Za-z0-9-]+",
+                                    parsed.task.job.cluster)):
+            # the cluster segment is free-form text that survives the
+            # parse/str roundtrip — without this check "../x" would too
+            raise KeyError(f"bad attempt id {attempt_id!r}")
+        path = os.path.join(self.local_root, "userlogs",
+                            str(parsed.task.job), attempt_id, filename)
+        try:
+            fd = os.open(path, os.O_RDONLY | os.O_NOFOLLOW)
+        except OSError as e:
+            raise KeyError(
+                f"no {filename} for attempt {attempt_id}: {e}") from None
+        return os.fdopen(fd, "rb")
 
     def list_profiles(self) -> "list[str]":
         from tpumr.mapred.profiler import PROFILE_FILE
@@ -604,8 +629,8 @@ class NodeRunner:
 
     def get_profile(self, attempt_id: str) -> str:
         from tpumr.mapred.profiler import PROFILE_FILE
-        with open(self._userlog_path(attempt_id, PROFILE_FILE)) as f:
-            return f.read()
+        with self._open_userlog(attempt_id, PROFILE_FILE) as f:
+            return f.read().decode("utf-8", "replace")
 
     def list_task_logs(self) -> "list[str]":
         """Attempts with a retained child log (≈ the userlogs listing)."""
@@ -615,9 +640,8 @@ class NodeRunner:
                      max_bytes: int = 1 << 20) -> str:
         """One attempt's retained stdout/stderr tail (≈ TaskLogServlet;
         tail-bounded like TaskLogsTruncater)."""
-        path = self._userlog_path(attempt_id, "child.log")
-        size = os.path.getsize(path)
-        with open(path, "rb") as f:
+        with self._open_userlog(attempt_id, "child.log") as f:
+            size = os.fstat(f.fileno()).st_size
             if size > max_bytes:
                 f.seek(size - max_bytes)
             return f.read().decode("utf-8", "replace")

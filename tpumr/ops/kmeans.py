@@ -216,14 +216,19 @@ class KMeansAssignKernel(KernelMapper):
     name = "kmeans-assign"
     cpu_mapper_class = KMeansCpuMapper
 
-    def map_batch(self, batch, conf, task) -> Iterable[tuple]:
+    def map_batch_launch(self, batch, conf, task):
+        """Two-phase protocol: dispatch the assign+partials program and
+        hand the [k,d] sums / [k] counts back as device arrays — the
+        runner fetches a whole window of tasks in one roundtrip."""
         centroids = _load_centroids(conf)
         use_pallas = conf.get_boolean("tpumr.kmeans.use.pallas", False)
         _assign, sums, counts = assign_and_partials(batch.values, centroids,
                                                     use_pallas=use_pallas)
-        sums = np.asarray(sums)
-        counts = np.asarray(counts)
-        for cid in range(centroids.shape[0]):
+        return (sums, counts)
+
+    def map_batch_drain(self, fetched, conf, task) -> Iterable[tuple]:
+        sums, counts = (np.asarray(a) for a in fetched)
+        for cid in range(sums.shape[0]):
             if counts[cid] > 0:
                 yield int(cid), (sums[cid], int(counts[cid]))
 

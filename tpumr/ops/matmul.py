@@ -71,12 +71,15 @@ class MatmulBlockKernel(KernelMapper):
     name = "matmul-block"
     cpu_mapper_class = MatmulCpuMapper
 
-    def map_batch(self, batch, conf, task) -> Iterable[tuple]:
+    def map_batch_launch(self, batch, conf, task):
         b = _load_b(conf)
         bf16 = conf.get_boolean("tpumr.matmul.bf16", True)
-        c = np.asarray(block_matmul(batch.values, b, bf16=bf16))
+        c = block_matmul(batch.values, b, bf16=bf16)
         row0 = int(batch.ids[0]) if batch.ids is not None else 0
-        yield (row0, c)
+        return {"c": c, "row0": row0}
+
+    def map_batch_drain(self, fetched, conf, task) -> Iterable[tuple]:
+        yield (int(fetched["row0"]), np.asarray(fetched["c"]))
 
 
 register_kernel(MatmulBlockKernel())

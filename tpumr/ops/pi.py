@@ -48,15 +48,22 @@ class PiSamplerKernel(KernelMapper):
     name = "pi-sampler"
     cpu_mapper_class = PiCpuMapper
 
-    def map_batch(self, batch, conf, task) -> Iterable[tuple]:
-        inside = 0
+    def map_batch_launch(self, batch, conf, task):
+        """Dispatch every sample block without blocking — the per-block
+        device counters stay on device until the runner's single fetch
+        (the old path synced once per record: one tunnel roundtrip per
+        (seed, n) line)."""
+        counts = []
         total = 0
         for i in range(batch.num_records):
             seed, n = _parse(batch.value(i))
-            inside += int(_count_inside(seed, n))
+            counts.append(_count_inside(seed, n))
             total += n
-        yield "inside", inside
-        yield "total", total
+        return {"inside": counts, "total": total}
+
+    def map_batch_drain(self, fetched, conf, task) -> Iterable[tuple]:
+        yield "inside", sum(int(c) for c in fetched["inside"])
+        yield "total", int(fetched["total"])
 
     def map_batch_cpu(self, batch, conf, task) -> Iterable[tuple]:
         """Vectorized host sampling — whole blocks per numpy call (CPU

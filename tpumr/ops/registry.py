@@ -29,7 +29,48 @@ class KernelMapper:
     name: str = ""
 
     def map_batch(self, batch: Any, conf: Any, task: Any) -> Iterable[tuple]:
+        """Synchronous batch map. Kernels that implement the two-phase
+        launch/drain protocol get this for free (one host transfer per
+        task); others override it directly."""
+        state = self.map_batch_launch(batch, conf, task)
+        if state is None:
+            # a kernel that declines batches at runtime must also override
+            # map_batch with its own fallback path
+            raise NotImplementedError(
+                f"kernel {self.name!r}: map_batch_launch declined this "
+                "batch (returned None) and map_batch is not overridden")
+        import jax
+        return self.map_batch_drain(jax.device_get(state), conf, task)
+
+    # ---------------------------------------------- two-phase device protocol
+    #
+    # Remote/tunneled TPU runtimes charge a full roundtrip per host
+    # transfer of a computed array (~tens of ms on a tunneled chip),
+    # while dispatch is asynchronous and ~free. Kernels that split into
+    #   launch: dispatch device work, return a pytree of jax.Arrays
+    #           (plain-python leaves pass through untouched), and
+    #   drain:  turn the fetched host pytree into (key, value) records
+    # let the runner batch MANY tasks' fetches into ONE jax.device_get —
+    # one roundtrip per pipeline window instead of per output array
+    # (TpuMapRunner single-task path + LocalJobRunner windowed prelaunch).
+
+    def map_batch_launch(self, batch: Any, conf: Any, task: Any) -> Any:
+        """Dispatch the device computation for one staged batch; return a
+        pytree whose jax.Array leaves the runner will fetch, or None if
+        this kernel does not support the two-phase protocol. Must not
+        block on device results. Receives the job-level conf when called
+        from the prelaunch window (task-localized conf otherwise)."""
+        return None
+
+    def map_batch_drain(self, fetched: Any, conf: Any, task: Any
+                        ) -> Iterable[tuple]:
+        """Convert the fetched (host) pytree returned by
+        :meth:`map_batch_launch` into the task's (key, value) records."""
         raise NotImplementedError
+
+    @classmethod
+    def supports_launch(cls) -> bool:
+        return cls.map_batch_launch is not KernelMapper.map_batch_launch
 
     # optional: kernels can advertise a CPU mapper class for the hybrid
     # scheduler's CPU slots (same job, both backends)
