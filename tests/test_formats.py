@@ -6,8 +6,8 @@ import numpy as np
 from tpumr.fs import get_filesystem
 from tpumr.io import sequencefile
 from tpumr.mapred.input_formats import (
-    CombineFileInputFormat, DenseInputFormat, NLineInputFormat,
-    SequenceFileInputFormat, TextInputFormat,
+    BytesTextInputFormat, CombineFileInputFormat, DenseInputFormat,
+    NLineInputFormat, SequenceFileInputFormat, TextInputFormat,
 )
 from tpumr.mapred.jobconf import JobConf
 from tpumr.mapred.output_formats import FileOutputCommitter
@@ -53,6 +53,43 @@ def test_text_split_boundary_ownership():
         vals = [v for _, v in fmt.get_record_reader(s1, conf)]
         vals += [v for _, v in fmt.get_record_reader(s2, conf)]
         assert vals == ["aaaa", "bbbbbbbbbb", "cc", "dddddd"], f"cut={cut}"
+
+
+def test_text_read_batch_matches_line_reader_at_every_cut():
+    """The vectorized whole-split read_batch must own exactly the lines
+    the LineRecordReader owns, at every possible split boundary —
+    including CRLF endings, empty lines, and a missing final newline."""
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    for name, data in [
+        ("plain", b"aaaa\nbbbbbbbbbb\ncc\ndddddd\n"),
+        ("crlf", b"aa\r\nbb\r\n\r\ncc\r\n"),
+        ("empty-lines", b"\n\na\n\nb\n\n"),
+        ("no-final-nl", b"aaa\nbb\nclosing-line"),
+        ("cr-run", b"x\r\r\ny\n"),
+    ]:
+        path = f"/rb/{name}.txt"
+        fs.write_bytes(path, data)
+        fmt = TextInputFormat()
+        for cut in range(1, len(data)):
+            batches = []
+            readers = []
+            for s in (FileSplit([], f"mem://{path}", 0, cut),
+                      FileSplit([], f"mem://{path}", cut, len(data) - cut)):
+                b = fmt.read_batch(s, conf)
+                batches.extend(b.value(i) for i in range(b.num_records))
+                readers.extend(
+                    v for _, v in
+                    BytesTextInputFormat().get_record_reader(s, conf))
+            assert batches == readers, f"{name} cut={cut}"
+
+
+def test_joined_values_roundtrip():
+    from tpumr.io.recordbatch import RecordBatch
+    b = RecordBatch.from_values([b"alpha", b"", b"beta x", b"g"])
+    assert b.joined_values() == b"alpha  beta x g"
+    assert b.joined_values(0x00) == b"alpha\x00\x00beta x\x00g"
+    assert RecordBatch.empty().joined_values() == b""
 
 
 def test_nline_input_format():

@@ -101,6 +101,18 @@ class RecordBatch:
     def value_lengths(self) -> np.ndarray:
         return (self.value_offsets[1:] - self.value_offsets[:-1]).astype(np.int32)
 
+    def joined_values(self, sep: int = 0x20) -> bytes:
+        """All values as one buffer with ``sep`` between records — the
+        whole-split view for kernels that scan bytes (tokenizers, regex):
+        one C-level ``np.insert`` instead of per-record Python or an
+        O(total) int64 scatter."""
+        n = self.num_records
+        if n == 0:
+            return b""
+        return np.insert(self.value_data,
+                         self.value_offsets[1:-1].astype(np.int64),
+                         sep).tobytes()
+
     # ------------------------------------------------------------ device views
 
     def padded_values(self, width: int, fill: int = 0) -> tuple[np.ndarray, np.ndarray]:

@@ -148,6 +148,55 @@ class TextInputFormat(FileInputFormat):
         return iter(LineRecordReader(fs, split.path, split.start,
                                      split.split_length, self.keep_bytes))
 
+    def read_batch(self, split, conf):
+        """Whole-split vectorized read for kernel jobs: ONE file read +
+        C-speed newline scan instead of 100k+ Python ``readline`` calls.
+        Ownership matches :class:`LineRecordReader` exactly — skip the
+        partial first line when start > 0, own every line beginning at
+        pos <= end (reading past end to finish it), strip trailing
+        ``\\r``/``\\n`` per line."""
+        from tpumr.io.recordbatch import RecordBatch
+        assert isinstance(split, FileSplit)
+        fs = FileSystem.get(split.path, conf)
+        with fs.open(split.path) as f:
+            f.seek(split.start)
+            buf = f.read(split.split_length)
+            if split.start > 0:
+                nl = buf.find(b"\n")
+                if nl < 0:
+                    return RecordBatch.empty()  # mid-line: owns nothing
+                buf = buf[nl + 1:]
+            # the loop rule is `while pos <= end`: a line IN PROGRESS at
+            # the chunk boundary is finished past end, and a line starting
+            # exactly AT end is owned too (the next split discards it as
+            # its leading partial)
+            buf += f.readline()
+        if not buf:
+            return RecordBatch.empty()
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        nls = np.flatnonzero(arr == 0x0A).astype(np.int64)
+        # line spans [start, end): starts = 0 and nl+1; a trailing chunk
+        # with no final newline is still a line (EOF case)
+        starts = np.concatenate(([0], nls + 1))
+        ends = np.concatenate((nls, [arr.shape[0]]))
+        if starts[-1] >= arr.shape[0] and len(starts) > 1:
+            starts, ends = starts[:-1], ends[:-1]  # buf ended with \n
+        # rstrip(b"\r\n"): drop newlines and any trailing CRs per line
+        mask = arr != 0x0A
+        while True:
+            has_cr = (ends > starts) & (arr[np.maximum(ends - 1, 0)] == 0x0D)
+            if not has_cr.any():
+                break
+            ends = ends - has_cr
+            mask[ends[has_cr]] = False
+        value_data = arr[mask]
+        lengths = ends - starts
+        offsets = np.zeros(len(lengths) + 1, np.int32)
+        np.cumsum(lengths, out=offsets[1:])
+        n = len(lengths)
+        return RecordBatch(np.zeros(0, np.uint8), np.zeros(n + 1, np.int32),
+                           value_data, offsets)
+
 
 class BytesTextInputFormat(TextInputFormat):
     """Like TextInputFormat but values stay raw bytes (terasort rows)."""
@@ -158,6 +207,10 @@ class KeyValueTextInputFormat(TextInputFormat):
     """≈ mapred/KeyValueTextInputFormat.java: each line splits at the
     first separator byte (``key.value.separator.in.input.line``, default
     TAB) into (key, value); a line with no separator becomes (line, "")."""
+
+    # values here are the part AFTER the separator — the whole-line batch
+    # fast path would hand kernels the wrong bytes
+    read_batch = None
 
     def get_record_reader(self, split, conf, reporter=None):
         # FIRST BYTE of the configured separator, as the reference does

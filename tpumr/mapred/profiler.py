@@ -81,12 +81,14 @@ def maybe_profile(conf: Any, task: Any, local_dir: str,
     # slot: two attempts profiling concurrently (tracker threads in one
     # process, MiniMRCluster) would die with "Another profiling tool is
     # already active" — serialize profiled sections instead
-    with _PROFILE_SLOT:
-        prof = cProfile.Profile()
-        try:
+    prof = cProfile.Profile()
+    try:
+        # only runcall needs the slot (released when it disables the
+        # profiler) — the report dump happens outside the lock
+        with _PROFILE_SLOT:
             return prof.runcall(fn)
-        finally:
-            _dump_profile(prof, conf, task, local_dir)
+    finally:
+        _dump_profile(prof, conf, task, local_dir)
 
 
 def _dump_profile(prof: Any, conf: Any, task: Any, local_dir: str) -> None:

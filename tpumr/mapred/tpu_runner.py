@@ -175,7 +175,7 @@ def stage_batch(conf, reader, task_ctx, device=None) -> tuple[Any, bool, int]:
     split = None
     if task_ctx is not None and getattr(task_ctx, "split", None):
         split = InputSplit.from_dict(task_ctx.split)
-    if split is not None and hasattr(in_fmt, "read_batch"):
+    if split is not None and getattr(in_fmt, "read_batch", None) is not None:
         use_cache = conf.get_boolean("tpumr.tpu.split.cache", True)
         cache_mb = conf.get_int("tpumr.tpu.split.cache.mb", 2048)
         if device is not None and use_cache and isinstance(split, DenseSplit):
@@ -264,11 +264,12 @@ def prelaunch_device_maps(conf, tasks: "list[Any]") -> "list[DevicePrefetch] | N
     kernel = get_kernel(name)
     if not type(kernel).supports_launch():
         return None
-    # a custom TPU runner would ignore the prefetch and redo the work
-    if not issubclass(conf.get_tpu_map_runner_class(), TpuMapRunner):
+    # a custom TPU runner (or a subclass overriding run) would ignore the
+    # prefetch and redo the work — require the stock run method
+    if conf.get_tpu_map_runner_class().run is not TpuMapRunner.run:
         return None
     in_fmt = new_instance(conf.get_input_format(), conf)
-    if not hasattr(in_fmt, "read_batch"):
+    if getattr(in_fmt, "read_batch", None) is None:
         return None
     if any(not getattr(t, "split", None) for t in tasks):
         return None

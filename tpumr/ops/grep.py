@@ -8,6 +8,8 @@ import re
 from collections import Counter
 from typing import Iterable
 
+import numpy as np
+
 from tpumr.mapred.api import Mapper
 from tpumr.ops.registry import KernelMapper, register_kernel
 
@@ -37,9 +39,14 @@ class GrepKernel(KernelMapper):
     def map_batch(self, batch, conf, task) -> Iterable[tuple]:
         regex, group = _pattern(conf)
         counts: Counter = Counter()
+        # zero-copy memoryview slices replace per-record array slicing +
+        # tobytes; per-record finditer is kept (reference semantics: a
+        # match never crosses a record boundary)
+        mv = memoryview(np.ascontiguousarray(batch.value_data))
+        offs = batch.value_offsets
         for i in range(batch.num_records):
-            for m in regex.finditer(batch.value(i)):
-                counts[m.group(group)] += 1
+            for m in regex.finditer(mv[offs[i]:offs[i + 1]]):
+                counts[bytes(m.group(group))] += 1
         for match, n in counts.items():
             yield match.decode("utf-8", errors="replace"), n
 

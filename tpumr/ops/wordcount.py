@@ -29,23 +29,11 @@ class WordCountKernel(KernelMapper):
     cpu_mapper_class = WordCountCpuMapper
 
     def map_batch(self, batch, conf, task) -> Iterable[tuple]:
-        n = batch.num_records
-        if n == 0:
+        if batch.num_records == 0:
             return
-        import numpy as np
-        data = batch.value_data
-        lengths = batch.value_lengths
-        # O(total_bytes) space-separated join (NOT pad-to-max, which is
-        # O(n_records × longest_record) and explodes on one long line):
-        # each source byte lands at its offset plus one separator per
-        # preceding record boundary
-        total = int(data.shape[0])
-        out = np.full(total + n, 0x20, dtype=np.uint8)
-        if total:
-            dst = np.arange(total, dtype=np.int64) + \
-                np.repeat(np.arange(n, dtype=np.int64), lengths)
-            out[dst] = data
-        counts = Counter(out.tobytes().split())
+        # one C-level separator join (records can't merge across the
+        # boundary), one C-level whitespace split, one C-level count
+        counts = Counter(batch.joined_values().split())
         for word, cnt in counts.items():
             yield word.decode("utf-8", errors="replace"), cnt
 
