@@ -84,6 +84,46 @@ def test_text_read_batch_matches_line_reader_at_every_cut():
             assert batches == readers, f"{name} cut={cut}"
 
 
+def test_text_read_batch_invalid_utf8_matches_reader_semantics():
+    """TextInputFormat values pass through decode(errors='replace') on
+    the reader path; the batch path must produce the same bytes for
+    invalid UTF-8 (and raw bytes under BytesTextInputFormat)."""
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    data = b"caf\xe9 one\nplain two\n\xc3\xa9clair three\n"
+    fs.write_bytes("/u8/x.txt", data)
+    split = FileSplit([], "mem:///u8/x.txt", 0, len(data))
+    batch = TextInputFormat().read_batch(split, conf)
+    expect = [v.encode() for _, v in
+              TextInputFormat().get_record_reader(split, conf)]
+    assert [batch.value(i) for i in range(batch.num_records)] == expect
+    raw = BytesTextInputFormat().read_batch(split, conf)
+    assert raw.value(0) == b"caf\xe9 one"  # bytes flavor stays raw
+
+
+def test_sequencefile_read_batch_mixed_block_widths():
+    """Blocks that are individually fixed-width but differ across blocks
+    (or single-record blocks of a ragged file) must fall back, not crash."""
+    import io
+    from tpumr.io import sequencefile
+
+    for block_records, recs in [
+        (3, [(b"k" * 10, b"v" * 90)] * 3 + [(b"a", b"bb")]),
+        (1, [(b"k%d" % i, b"x" * (i + 1)) for i in range(5)]),
+    ]:
+        buf = io.BytesIO()
+        w = sequencefile.Writer(buf, block_records=block_records)
+        for k, v in recs:
+            w.append(k, v)
+        w.close()
+        raw = buf.getvalue()
+        r = sequencefile.Reader(io.BytesIO(raw))
+        batch = r.read_batch_range(0, len(raw))
+        got = [(batch.key(i), batch.value(i))
+               for i in range(batch.num_records)]
+        assert got == recs
+
+
 def test_joined_values_roundtrip():
     from tpumr.io.recordbatch import RecordBatch
     b = RecordBatch.from_values([b"alpha", b"", b"beta x", b"g"])

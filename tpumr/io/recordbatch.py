@@ -157,6 +157,9 @@ def _pad(data: np.ndarray, offsets: np.ndarray, width: int,
          fill: int) -> tuple[np.ndarray, np.ndarray]:
     n = len(offsets) - 1
     lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    if n and data.shape[0] == n * width and (lengths == width).all():
+        # fixed-width records: the padded view IS a reshape (no copy)
+        return data.reshape(n, width), lengths
     out = np.full((n, width), fill, dtype=np.uint8)
     # vectorized gather: for each row, take min(len, width) bytes
     take = np.minimum(lengths, width)

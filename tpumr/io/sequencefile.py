@@ -151,6 +151,52 @@ class Writer:
         self.close()
 
 
+def _parse_fixed_block(body: bytes):
+    """Vectorized block parse: when every record in the block shares the
+    first record's exact frame bytes outside the two payloads — i.e.
+    bytes-tagged keys and values of one constant width each, the terasort
+    layout — the whole block is one ``[n, frame]`` reshape. Returns
+    ``(keys [n, klen] u8, values [n, vlen] u8)`` or None (caller falls
+    back to the per-record parser)."""
+    import numpy as np
+
+    from tpumr.io.writable import _TAG_BYTES, _vint_at
+    try:
+        n, rec0 = _vint_at(body, 0)
+        if n <= 0:
+            return None
+        # first record, scalar: vint(len kser) ++ kser ++ vint(len vser)
+        # ++ vser, where kser = tag ++ vint(klen) ++ key payload
+        kser_len, kser0 = _vint_at(body, rec0)
+        if body[kser0] != _TAG_BYTES[0]:
+            return None
+        klen, kpay0 = _vint_at(body, kser0 + 1)
+        if kser0 + kser_len != kpay0 + klen:
+            return None
+        vser_len, vser0 = _vint_at(body, kpay0 + klen)
+        if body[vser0] != _TAG_BYTES[0]:
+            return None
+        vlen, vpay0 = _vint_at(body, vser0 + 1)
+        if vser0 + vser_len != vpay0 + vlen:
+            return None
+    except IndexError:
+        return None
+    frame = vpay0 + vlen - rec0
+    if len(body) - rec0 != n * frame:
+        return None
+    arr = np.frombuffer(body, np.uint8, n * frame, rec0).reshape(n, frame)
+    # every non-payload column must match record 0's bytes exactly (same
+    # lengths, same tags) — a cheap full proof that the reshape is valid
+    kpay = kpay0 - rec0
+    vhdr = kpay + klen
+    vpay = vpay0 - rec0
+    meta_idx = np.concatenate([np.arange(0, kpay),
+                               np.arange(vhdr, vpay)])
+    if n > 1 and not (arr[1:, meta_idx] == arr[0, meta_idx]).all():
+        return None
+    return arr[:, kpay:kpay + klen], arr[:, vpay:vpay + vlen]
+
+
 class Reader:
     """Stream reader; supports ``sync(pos)`` — skip forward to the first sync
     marker at/after ``pos`` then read whole blocks — which is what makes a
@@ -174,28 +220,37 @@ class Reader:
         for k, v in self.iter_raw():
             yield deserialize(k), deserialize(v)
 
-    def iter_range(self, start: int, end: int) -> Iterator[tuple[Any, Any]]:
-        """Records of the split [start, end): from the first sync at/after
-        ``start`` up to the first sync at/after ``end`` (the split-reader
-        contract of SequenceFileRecordReader — every record is read by
-        exactly one of a set of covering splits)."""
+    def _position_for_range(self, start: int, end: int) -> bool:
+        """Position the stream at the first block of split [start, end);
+        False when the split owns nothing. The ownership rule shared by
+        the per-record and batch readers (every record is read by exactly
+        one of a set of covering splits, ≈ SequenceFileRecordReader)."""
         if end <= self._header_end:
             # the header's trailing sync marker is the file's first boundary:
             # a split ending at/inside the header owns nothing (its successor
             # starting there syncs to header_end and owns the first block)
-            return
+            return False
         if not self.sync(start):
-            return
+            return False
         if start > self._header_end:
             # boundary = position of the 4-byte escape preceding the marker we
             # landed on; if it is already past `end` this split owns nothing
             boundary = self._in.tell() - SYNC_SIZE - 4
             if boundary >= end:
-                return
+                return False
+        return True
+
+    def iter_range(self, start: int, end: int) -> Iterator[tuple[Any, Any]]:
+        """Records of the split [start, end): from the first sync at/after
+        ``start`` up to the first sync at/after ``end``."""
+        if not self._position_for_range(start, end):
+            return
         for k, v in self.iter_raw(end=end):
             yield deserialize(k), deserialize(v)
 
-    def iter_raw(self, end: int | None = None) -> Iterator[tuple[bytes, bytes]]:
+    def iter_block_bodies(self, end: int | None = None) -> Iterator[bytes]:
+        """Decompressed block bodies from the current position; stops at
+        the first sync at/after ``end`` (iter_raw's end-side rule)."""
         while True:
             pos = self._in.tell()
             raw = self._in.read(4)
@@ -212,7 +267,11 @@ class Reader:
             payload = self._in.read(length)
             if len(payload) < length:
                 raise EOFError("truncated block")
-            block = BytesIO(self._codec.decompress(payload))
+            yield self._codec.decompress(payload)
+
+    def iter_raw(self, end: int | None = None) -> Iterator[tuple[bytes, bytes]]:
+        for body in self.iter_block_bodies(end):
+            block = BytesIO(body)
             n = read_vint(block)
             for _ in range(n):
                 klen = read_vint(block)
@@ -220,6 +279,71 @@ class Reader:
                 vlen = read_vint(block)
                 v = block.read(vlen)
                 yield k, v
+
+    def read_batch_range(self, start: int, end: int):
+        """Records of the split [start, end) as one
+        :class:`~tpumr.io.recordbatch.RecordBatch` — the whole-split read
+        for kernel jobs. Blocks whose serialized records all share the
+        first record's byte-level frame (fixed-width bytes keys/values —
+        the terasort layout) parse as ONE numpy reshape; anything else
+        falls back to the per-record path with the same
+        bytes/str/serialize value semantics as the reader-drain staging
+        path (tpu_runner.stage_batch)."""
+        import numpy as np
+
+        from tpumr.io.recordbatch import RecordBatch
+        from tpumr.io.writable import serialize
+
+        if not self._position_for_range(start, end):
+            return RecordBatch.empty()
+
+        key_chunks: list[np.ndarray] = []   # [n, klen] u8 per fast block
+        val_chunks: list[np.ndarray] = []
+        slow: list[tuple[bytes, bytes]] = []  # (key, value) payloads
+
+        for body in self.iter_block_bodies(end):
+            if body[:1] == b"\x00":  # vint 0: empty block, nothing to parse
+                continue
+            if not slow:
+                parsed = _parse_fixed_block(body)
+                if parsed is not None and key_chunks and (
+                        parsed[0].shape[1] != key_chunks[0].shape[1]
+                        or parsed[1].shape[1] != val_chunks[0].shape[1]):
+                    parsed = None  # widths changed across blocks: go slow
+                if parsed is not None:
+                    key_chunks.append(parsed[0])
+                    val_chunks.append(parsed[1])
+                    continue
+                # first ragged block: demote prior fast chunks to the slow
+                # list so record order is preserved (and stay slow — a
+                # mixed file is rare and order beats vectorization)
+                for karr, varr in zip(key_chunks, val_chunks):
+                    slow.extend((karr[i].tobytes(), varr[i].tobytes())
+                                for i in range(karr.shape[0]))
+                key_chunks, val_chunks = [], []
+            block = BytesIO(body)
+            n = read_vint(block)
+            for _ in range(n):
+                klen = read_vint(block)
+                k = deserialize(block.read(klen))
+                vlen = read_vint(block)
+                v = deserialize(block.read(vlen))
+                k = k if isinstance(k, (bytes, bytearray)) else (
+                    k.encode("utf-8") if isinstance(k, str) else serialize(k))
+                v = v if isinstance(v, (bytes, bytearray)) else (
+                    v.encode("utf-8") if isinstance(v, str) else serialize(v))
+                slow.append((bytes(k), bytes(v)))
+
+        if slow:
+            return RecordBatch.from_pairs(slow)
+        if not key_chunks:
+            return RecordBatch.empty()
+        keys = np.concatenate(key_chunks)
+        vals = np.concatenate(val_chunks)
+        n = keys.shape[0]
+        ko = (np.arange(n + 1, dtype=np.int64) * keys.shape[1]).astype(np.int32)
+        vo = (np.arange(n + 1, dtype=np.int64) * vals.shape[1]).astype(np.int32)
+        return RecordBatch(keys.reshape(-1), ko, vals.reshape(-1), vo)
 
     def sync(self, pos: int) -> bool:
         """Position the reader at the first sync marker at/after byte ``pos``.

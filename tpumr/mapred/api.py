@@ -62,13 +62,27 @@ class Reporter:
 
 
 class OutputCollector:
-    """≈ org.apache.hadoop.mapred.OutputCollector."""
+    """≈ org.apache.hadoop.mapred.OutputCollector, plus an optional bulk
+    lane: mappers producing fixed-width byte records in arrays (teragen)
+    can hand ``[n, klen+vlen]`` rows over in one ``collect_fixed_rows``
+    call; sinks without a vectorized path degrade it to per-record
+    ``collect`` calls."""
 
-    def __init__(self, fn: Callable[[Any, Any], None]) -> None:
+    def __init__(self, fn: Callable[[Any, Any], None],
+                 fixed_rows_fn: "Callable[[Any, int], None] | None" = None
+                 ) -> None:
         self._fn = fn
+        self._fixed_rows_fn = fixed_rows_fn
 
     def collect(self, key: Any, value: Any) -> None:
         self._fn(key, value)
+
+    def collect_fixed_rows(self, rows: Any, klen: int) -> None:
+        if self._fixed_rows_fn is not None:
+            self._fixed_rows_fn(rows, klen)
+            return
+        for i in range(rows.shape[0]):
+            self._fn(rows[i, :klen].tobytes(), rows[i, klen:].tobytes())
 
     __call__ = collect
 
@@ -100,6 +114,10 @@ class Reducer(JobConfigurable):
 
 class IdentityMapper(Mapper):
     """≈ mapred/lib/IdentityMapper.java."""
+
+    #: declares the stateless pass-through contract: the framework may
+    #: bypass map() and move records in bulk (device-shuffle fast path)
+    identity_map = True
 
     def map(self, key, value, output, reporter):
         output.collect(key, value)

@@ -131,6 +131,29 @@ class DenseMapOutputBuffer:
                                    TaskCounter.MAP_OUTPUT_BYTES,
                                    self.klen + self.vlen)
 
+    def collect_fixed_batch(self, keys: np.ndarray,
+                            values: np.ndarray) -> None:
+        """Bulk ingest for the identity-map fast path: ``[n, klen]`` /
+        ``[n, vlen]`` uint8 arrays appended in two copies, with the same
+        width validation and counter accounting as n ``collect`` calls."""
+        if keys.ndim != 2 or keys.shape[1] != self.klen:
+            raise ValueError(f"device shuffle requires {self.klen}-byte "
+                             f"keys, got array {keys.shape}")
+        if values.ndim != 2 or values.shape[1] != self.vlen:
+            raise ValueError(f"device shuffle requires {self.vlen}-byte "
+                             f"values, got array {values.shape}")
+        if keys.shape[0] != values.shape[0]:
+            raise ValueError("key/value row counts differ")
+        n = int(keys.shape[0])
+        self._keys += keys.astype(np.uint8, copy=False).tobytes()
+        self._values += values.astype(np.uint8, copy=False).tobytes()
+        self._n += n
+        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                   TaskCounter.MAP_OUTPUT_RECORDS, n)
+        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                   TaskCounter.MAP_OUTPUT_BYTES,
+                                   n * (self.klen + self.vlen))
+
     def flush(self) -> tuple[str, dict]:
         path = os.path.join(self.local_dir, "file.dense")
         with open(path, "wb") as f:

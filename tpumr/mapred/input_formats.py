@@ -194,8 +194,21 @@ class TextInputFormat(FileInputFormat):
         offsets = np.zeros(len(lengths) + 1, np.int32)
         np.cumsum(lengths, out=offsets[1:])
         n = len(lengths)
-        return RecordBatch(np.zeros(0, np.uint8), np.zeros(n + 1, np.int32),
-                           value_data, offsets)
+        batch = RecordBatch(np.zeros(0, np.uint8), np.zeros(n + 1, np.int32),
+                            value_data, offsets)
+        if not self.keep_bytes and (value_data > 0x7F).any():
+            # reader parity: TextInputFormat values pass through
+            # decode('utf-8', 'replace') — identical to raw bytes for
+            # valid UTF-8 (checked strictly with \n separators so a line
+            # ending mid-sequence can't be masked by its successor), so
+            # only genuinely invalid input pays the per-line fallback
+            try:
+                batch.joined_values(0x0A).decode("utf-8")
+            except UnicodeDecodeError:
+                return RecordBatch.from_values(
+                    batch.value(i).decode("utf-8", "replace").encode()
+                    for i in range(n))
+        return batch
 
 
 class BytesTextInputFormat(TextInputFormat):
@@ -290,6 +303,17 @@ class SequenceFileInputFormat(FileInputFormat):
                 f.close()
 
         return gen()
+
+    def read_batch(self, split, conf):
+        """Whole-split read for kernel jobs — fixed-width bytes records
+        (terasort's 10+90 layout) parse as one numpy reshape per block
+        (sequencefile._parse_fixed_block); anything else falls back to
+        the per-record parser with reader-equivalent value bytes."""
+        assert isinstance(split, FileSplit)
+        fs = FileSystem.get(split.path, conf)
+        with fs.open(split.path) as f:
+            return sequencefile.Reader(f).read_batch_range(
+                split.start, split.start + split.split_length)
 
 
 class WholeFileInputFormat(FileInputFormat):

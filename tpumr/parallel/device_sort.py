@@ -122,6 +122,18 @@ def make_sort_fn(mesh: Mesh, klen: int, axis_name: str = "data"):
     return _make_sort_fn(mesh, klen, axis_name)
 
 
+@functools.lru_cache(maxsize=8)
+def _argsort_keys(ncols: int):
+    """Jitted stable argsort of [n, ncols] uint32 key columns (ascending
+    lexicographic, column 0 most significant)."""
+    @jax.jit
+    def _argsort(cols):
+        keys = tuple(cols[:, c] for c in range(ncols - 1, -1, -1))
+        return jnp.lexsort(keys)
+
+    return _argsort
+
+
 def device_partition_sort(mesh: Mesh, records: np.ndarray, klen: int,
                           splitters: np.ndarray, num_ranges: int,
                           capacity: int | None = None,
@@ -146,15 +158,30 @@ def device_partition_sort(mesh: Mesh, records: np.ndarray, klen: int,
     ranges_per_dev = -(-num_ranges // n_dev)
 
     if n_dev == 1:
-        # single-device mesh: the all-to-all exchange is the identity and
-        # its 2x-capacity receive buffer would only double the
-        # device↔host transfer (the single-chip bottleneck is PCIe/tunnel
-        # bandwidth, not FLOPs). Sort the rows exactly as given — no
-        # padding, no validity column, no extra host copy.
-        sharded = shard_over(mesh, records, axis_name)
-        valid = shard_over(mesh, np.ones(n0, bool), axis_name)
-        sorted_recs, _ = make_sort_fn(mesh, klen, axis_name)(sharded, valid)
-        return [np.asarray(sorted_recs)], 0
+        # single-device mesh: the all-to-all exchange is the identity, so
+        # only the SORT KEYS visit the device — upload [n, ceil(klen/4)]
+        # uint32 columns, argsort there, download the [n] permutation,
+        # and gather the full rows on the host. On a tunneled chip this
+        # cuts the transfer from 2 x n x w bytes (rows up + sorted rows
+        # down) to ~n x (4 x cols + 4) bytes; the value payload never
+        # crosses the wire.
+        if n0 == 0:
+            return [records.copy()], 0
+        kcols = key_columns(records, klen)
+        # pad to the next power of two with all-FF sentinel keys so the
+        # jitted argsort compiles once per size BUCKET, not per exact n
+        # (XLA recompiles per shape; a variadic 2M-row sort compile is
+        # tens of seconds on a tunneled chip). lexsort is stable, so pad
+        # rows (indices >= n0) land after real rows even on all-FF keys.
+        n_pad = 1 << max(4, (n0 - 1).bit_length())
+        if n_pad != n0:
+            padded = np.full((n_pad, kcols.shape[1]), 0xFFFFFFFF, np.uint32)
+            padded[:n0] = kcols
+            kcols = padded
+        order = np.asarray(_argsort_keys(kcols.shape[1])(kcols))
+        if n_pad != n0:
+            order = order[order < n0]
+        return [records[order]], 0
 
     # trailing validity byte + pad rows (zeros → marked invalid) so the
     # leading dim divides the mesh; pads route to device 0 and are masked
