@@ -1,0 +1,115 @@
+"""Reduce-side data join ≈ contrib/data_join's TestDataJoin: two tagged
+sources joined on a shared key through a real job, cross-product and
+filter semantics, per-group truncation."""
+
+from tpumr.contrib.datajoin import (DataJoinMapper, DataJoinReducer,
+                                    make_datajoin_conf)
+from tpumr.fs import get_filesystem
+from tpumr.mapred import run_job
+
+
+class OrderMapper(DataJoinMapper):
+    def input_tag(self, conf):
+        return "orders"
+
+    def extract_key(self, key, value):
+        v = value if isinstance(value, str) else value.decode()
+        return v.split(",")[0]
+
+    def extract_value(self, key, value):
+        v = value if isinstance(value, str) else value.decode()
+        return v.split(",", 1)[1]
+
+
+class UserMapper(OrderMapper):
+    def input_tag(self, conf):
+        return "users"
+
+
+class InnerJoin(DataJoinReducer):
+    required_tags = ("orders", "users")
+
+    def combine(self, key, tags, values, output, reporter):
+        by_tag = dict(zip(tags, values))
+        if by_tag["orders"].endswith("drop-me"):
+            return None
+        return f"{by_tag['users']}|{by_tag['orders']}"
+
+
+def _write_sources(fs):
+    fs.write_bytes("/dj/orders/part-0",
+                   b"u1,order-a\nu1,order-b\nu2,order-c\n"
+                   b"u3,order-d\nu2,drop-me\n")
+    fs.write_bytes("/dj/users/part-0", b"u1,alice\nu2,bob\nu9,nobody\n")
+
+
+def test_inner_join_cross_product_and_filter():
+    fs = get_filesystem("mem:///")
+    _write_sources(fs)
+    conf = make_datajoin_conf(
+        [("orders", "mem:///dj/orders", OrderMapper),
+         ("users", "mem:///dj/users", UserMapper)],
+        InnerJoin, "mem:///dj/out")
+    conf.set_num_reduce_tasks(1)
+    result = run_job(conf)
+    assert result.successful
+    lines = sorted(fs.read_bytes("mem:///dj/out/part-00000")
+                   .decode().splitlines())
+    # u1 x 2 orders, u2 x 1 (drop-me filtered), u3 has no user row,
+    # u9 has no orders row
+    assert lines == ["u1\talice|order-a", "u1\talice|order-b",
+                     "u2\tbob|order-c"]
+    assert result.counters.value("tpumr.DataJoin", "TUPLES_JOINED") == 3
+    assert result.counters.value("tpumr.DataJoin", "KEYS_UNMATCHED") == 2
+
+
+def test_group_truncation_bounds_cross_product():
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/djt/orders/part-0",
+                   b"".join(b"u1,o%d\n" % i for i in range(10)))
+    fs.write_bytes("/djt/users/part-0", b"u1,alice\n")
+
+    class Join(DataJoinReducer):
+        def combine(self, key, tags, values, output, reporter):
+            return "|".join(values)
+
+    conf = make_datajoin_conf(
+        [("orders", "mem:///djt/orders", OrderMapper),
+         ("users", "mem:///djt/users", UserMapper)],
+        Join, "mem:///djt/out")
+    conf.set("datajoin.maxNumOfValuesPerGroup", 4)
+    conf.set_num_reduce_tasks(1)
+    result = run_job(conf)
+    assert result.successful
+    lines = fs.read_bytes("mem:///djt/out/part-00000").decode().splitlines()
+    assert len(lines) == 4  # capped at 4 orders x 1 user
+    assert result.counters.value("tpumr.DataJoin", "VALUES_TRUNCATED") == 6
+
+
+def test_sibling_directory_does_not_match_prefix():
+    """'orders' registered for /dj2/users must NOT claim /dj2/users_extra
+    (prefix matches only at a path-separator boundary)."""
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/dj2/users/part-0", b"u1,alice\n")
+    fs.write_bytes("/dj2/users_extra/part-0", b"u1,mallory\n")
+    conf = make_datajoin_conf(
+        [("users", "mem:///dj2/users", UserMapper)],
+        InnerJoin, "mem:///dj2/out")
+    conf.set_input_paths("mem:///dj2/users", "mem:///dj2/users_extra")
+    conf.set_num_reduce_tasks(1)
+    import pytest
+    with pytest.raises(ValueError, match="no datajoin mapper"):
+        run_job(conf)
+
+
+def test_unregistered_source_fails_loudly():
+    fs = get_filesystem("mem:///")
+    _write_sources(fs)
+    conf = make_datajoin_conf(
+        [("orders", "mem:///dj/orders", OrderMapper)],
+        InnerJoin, "mem:///dj/out2")
+    conf.set_input_paths("mem:///dj/orders", "mem:///dj/users")  # users
+    conf.set_num_reduce_tasks(1)                 # path not registered
+    import pytest
+    with pytest.raises(ValueError, match="no datajoin mapper"):
+        run_job(conf)

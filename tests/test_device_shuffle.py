@@ -140,6 +140,26 @@ class TestDeviceShuffleLocalJob:
         counts = {bytes(k): int.from_bytes(v, "big") for k, v in out}
         assert counts == {b"key%04d" % i: 30 for i in range(7)}
 
+    def test_identity_subclass_overriding_map_is_not_bypassed(self):
+        """A subclass of an identity mapper that overrides map() (but
+        inherits identity_map) must have its map() honored — the bulk
+        fast path only applies to classes declaring the flag themselves."""
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/dsi/in.txt",
+                       b"\n".join(b"key%04d" % i for i in range(20)))
+        conf = JobConf()
+        conf.set_input_paths("mem:///dsi/in.txt")
+        conf.set_output_path("mem:///dsi/out")
+        from tpumr.mapred.output_formats import SequenceFileOutputFormat
+        conf.set_mapper_class(DroppingIdentitySubclass)
+        conf.set_output_format(SequenceFileOutputFormat)
+        conf.set_num_reduce_tasks(1)
+        conf.set_device_shuffle(7, 0)
+        result = run_job(conf)
+        assert result.successful
+        out, _ = _read_parts(fs, "/dsi/out")
+        assert len(out) == 10  # the override's filter ran
+
     def test_duplicate_heavy_input_short_cut_list(self):
         """write_partition_file dedups duplicate samples, so the cut list
         can be shorter than R-1 — top ranges must come back empty, not
@@ -185,6 +205,20 @@ class TestDeviceShuffleLocalJob:
         conf.set_device_shuffle(10, 4)          # conf says 10 — mismatch
         with pytest.raises(Exception, match="10-byte keys"):
             run_job(conf)
+
+
+from tpumr.mapred.api import IdentityMapper
+
+
+class DroppingIdentitySubclass(IdentityMapper):
+    """Inherits identity_map=True but overrides map() to keep only even
+    rows — the override must run (7-byte key, empty value)."""
+
+    def map(self, key, value, output, reporter):
+        line = value if isinstance(value, (bytes, bytearray)) else \
+            str(value).encode()
+        if int(line[-1:] or b"0", 10) % 2 == 0:
+            output.collect(bytes(line.strip()[:7]), b"")
 
 
 class FixedKeyMapper:

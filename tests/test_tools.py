@@ -153,6 +153,92 @@ class TestRumen:
         backends = {x["backend"] for x in t["tasks"]}
         assert backends == {"cpu", "tpu"}
 
+    def test_vaidya_rules_on_synthetic_history(self):
+        from tpumr.core.counters import TaskCounter
+        from tpumr.tools.vaidya import diagnose
+        fw = TaskCounter.FRAMEWORK_GROUP
+
+        def task(i, is_map, event="TASK_FINISHED", runtime=5.0, tpu=False,
+                 counters=None):
+            return {"event": event, "attempt_id": f"a{i}", "is_map": is_map,
+                    "run_on_tpu": tpu, "runtime": runtime,
+                    "counters": counters or {}}
+
+        # skewed reduces: one reducer carries ~all records; maps spill 3x
+        events = [
+            {"event": "JOB_SUBMITTED", "job_id": "job_v_1",
+             "job_name": "skewed", "num_maps": 2, "num_reduces": 4},
+            task(0, True, counters={fw: {
+                TaskCounter.MAP_OUTPUT_RECORDS: 100,
+                TaskCounter.SPILLED_RECORDS: 300}}),
+            task(1, True, event="TASK_FAILED"),
+            *[task(10 + r, False, counters={fw: {
+                TaskCounter.REDUCE_INPUT_RECORDS:
+                    1000 if r == 0 else 1}}) for r in range(4)],
+            {"event": "JOB_FINISHED", "state": "SUCCEEDED",
+             "wall_time": 10.0, "acceleration_factor": 0.0},
+        ]
+        report = diagnose(events)
+        hit = {f["test"] for f in report["findings"]}
+        assert "balanced-reduce-partitioning" in hit
+        assert "map-side-disk-spill" in hit
+        assert "maps-reexecution-impact" in hit
+        top = report["findings"][0]
+        assert top["importance"] == "High" and top["prescription"]
+
+    def test_vaidya_backend_placement_rule(self):
+        from tpumr.tools.vaidya import diagnose
+        # TPU 8x faster but nearly all map runtime spent on CPU slots
+        events = [
+            {"event": "JOB_SUBMITTED", "job_id": "job_v_2",
+             "job_name": "misplaced", "num_maps": 10, "num_reduces": 1},
+            *[{"event": "TASK_FINISHED", "attempt_id": f"m{i}",
+               "is_map": True, "run_on_tpu": False, "runtime": 8.0,
+               "counters": {}} for i in range(9)],
+            {"event": "TASK_FINISHED", "attempt_id": "m9", "is_map": True,
+             "run_on_tpu": True, "runtime": 1.0, "counters": {}},
+            {"event": "JOB_FINISHED", "state": "SUCCEEDED",
+             "wall_time": 20.0, "acceleration_factor": 8.0},
+        ]
+        report = diagnose(events)
+        hit = {f["test"]: f for f in report["findings"]}
+        assert "backend-placement" in hit
+        assert "tpu" in hit["backend-placement"]["prescription"].lower()
+        # balanced case: no finding
+        events[-1]["acceleration_factor"] = 1.0
+        assert "backend-placement" not in {
+            f["test"] for f in diagnose(events)["findings"]}
+
+    def test_vaidya_cli_on_live_cluster_history(self, tmp_path, capsys):
+        from tpumr.mapred.jobconf import JobConf
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        from tpumr.mapred.job_client import JobClient
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0,
+                           conf=conf) as c:
+            fs = get_filesystem("mem:///")
+            fs.write_bytes("/vd/in.txt", b"p q\n" * 20)
+            jc = c.create_job_conf()
+            jc.set_input_paths("mem:///vd/in.txt")
+            jc.set_output_path("mem:///vd/out")
+            from tpumr.ops.wordcount import WordCountCpuMapper
+            from tpumr.examples.basic import LongSumReducer
+            jc.set_class("mapred.mapper.class", WordCountCpuMapper)
+            jc.set_class("mapred.reducer.class", LongSumReducer)
+            result = JobClient(jc).run_job(jc)
+            assert result.successful
+            job_id = str(result.job_id)
+        rc = cli_main(["job", "-diagnose", job_id, str(tmp_path), "-json"])
+        report = json.loads(capsys.readouterr().out)
+        assert rc in (0, 2)
+        assert report["job_id"] == job_id
+        assert report["state"] == "SUCCEEDED"
+        assert {r["test"] for r in
+                report["findings"] + report["passed"]} >= {
+            "balanced-reduce-partitioning", "map-side-disk-spill",
+            "backend-placement", "map-granularity"}
+
     def test_live_cluster_history_has_task_events(self, tmp_path):
         from tpumr.mapred.jobconf import JobConf
         from tpumr.mapred.mini_cluster import MiniMRCluster

@@ -34,6 +34,7 @@ Daemons:
 Clients:
   fs -CMD ...          filesystem shell (tpumr fs -help for commands)
   job ...              job control: -list | -status ID | -kill ID | -counters ID
+                       offline: -history ID [DIR] | -diagnose ID [DIR] (vaidya)
   balancer -nn HOST:PORT                     rebalance tdfs blocks
   fsck [PATH]          tdfs health report (missing/under-replicated blocks)
   dfsadmin ...         quotas, decommissioning, safemode, cluster report
@@ -215,6 +216,8 @@ def cmd_job(conf, argv: list[str]) -> int:
         # offline: reads the history dir directly (≈ HistoryViewer) — no
         # live master needed
         return _job_history(conf, argv[1:])
+    if argv and argv[0] == "-diagnose":
+        return _job_diagnose(conf, argv[1:])
     jt = conf.get("mapred.job.tracker")
     if not jt or jt == "local":
         print("job control needs -jt HOST:PORT", file=sys.stderr)
@@ -362,6 +365,43 @@ def cmd_dfsadmin(conf, argv: list[str]) -> int:
         return 0
     print(usage, file=sys.stderr)
     return 255
+
+
+def _job_diagnose(conf, argv: list[str]) -> int:
+    """Post-execution diagnosis (≈ contrib/vaidya's
+    PostExPerformanceDiagnoser): run the rule set over one job's history
+    and print findings + prescriptions. Accepts a JOB_ID (+ history dir
+    like -history) or a direct path to a history .jsonl file."""
+    import os
+    if not argv:
+        print("Usage: tpumr job -diagnose JOB_ID [HISTORY_DIR] | "
+              "-diagnose PATH.jsonl [-json]", file=sys.stderr)
+        return 255
+    as_json = "-json" in argv
+    argv = [a for a in argv if a != "-json"]
+    if not argv:
+        print("Usage: tpumr job -diagnose JOB_ID [HISTORY_DIR] | "
+              "-diagnose PATH.jsonl [-json]", file=sys.stderr)
+        return 255
+    from tpumr.tools import vaidya
+    target = argv[0]
+    if not target.endswith(".jsonl"):
+        hist_dir = argv[1] if len(argv) > 1 else conf.get("tpumr.history.dir")
+        if not hist_dir:
+            print("job -diagnose: pass HISTORY_DIR or set "
+                  "tpumr.history.dir", file=sys.stderr)
+            return 255
+        target = os.path.join(hist_dir, f"{target}.jsonl")
+    if "://" not in target and not os.path.exists(target):
+        print(f"no history file at {target}", file=sys.stderr)
+        return 1
+    report = vaidya.diagnose_file(target)
+    if as_json:
+        import json as _json
+        print(_json.dumps(report, indent=2))
+    else:
+        print(vaidya.format_report(report))
+    return 0 if not report["findings"] else 2
 
 
 def _job_history(conf, argv: list[str]) -> int:

@@ -1,0 +1,188 @@
+"""Reduce-side data join framework.
+
+≈ ``src/contrib/data_join`` (reference: contrib/utils/join/
+{DataJoinMapperBase,DataJoinReducerBase,TaggedMapOutput,DataJoinJob}.java):
+a generic framework for joining records from several sources on a shared
+key. Each source's mapper tags its records with the source name; the
+reducer groups each key's values by tag and emits one output per tuple of
+the cross product over the tag groups — subclasses implement ``combine``
+to build (or filter, by returning None) the joined record, exactly the
+reference's contract. The per-group value cap
+(``datajoin.maxNumOfValuesPerGroup``, reference DataJoinReducerBase's
+maxNumOfValuesPerGroup, default 100) bounds the cross-product blow-up.
+
+Usage::
+
+    class OrderMapper(DataJoinMapper):
+        def input_tag(self, conf):  # one mapper class per source
+            return "orders"
+        def extract_key(self, key, value):
+            return value.split(",")[0]
+
+    class Joiner(DataJoinReducer):
+        def combine(self, key, tags, values, output, reporter):
+            return ",".join(values)  # one joined record, or None to drop
+
+    conf = make_datajoin_conf([("orders", "mem:///o", OrderMapper),
+                               ("users", "mem:///u", UserMapper)],
+                              Joiner, "mem:///joined")
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterable
+
+from tpumr.mapred.api import Mapper, Reducer
+
+MAX_VALUES_KEY = "datajoin.maxNumOfValuesPerGroup"
+
+
+class TaggedValue:
+    """A record tagged with its source ≈ TaggedMapOutput. Serialized as a
+    (tag, payload) tuple on the wire."""
+
+    __slots__ = ("tag", "value")
+
+    def __init__(self, tag: str, value: Any) -> None:
+        self.tag = tag
+        self.value = value
+
+
+class DataJoinMapper(Mapper):
+    """≈ DataJoinMapperBase: tag every record of this source and re-key it
+    by the join key. Subclasses implement :meth:`input_tag` (the source
+    name) and :meth:`extract_key` (the join key for one record);
+    :meth:`extract_value` defaults to the record's value."""
+
+    def configure(self, conf) -> None:
+        self._conf = conf
+        self._tag = self.input_tag(conf)
+
+    def input_tag(self, conf) -> str:
+        raise NotImplementedError
+
+    def extract_key(self, key, value) -> Any:
+        raise NotImplementedError
+
+    def extract_value(self, key, value) -> Any:
+        return value
+
+    def map(self, key, value, output, reporter):
+        join_key = self.extract_key(key, value)
+        if join_key is None:
+            return  # unjoinable record (reference: null key → dropped)
+        output.collect(join_key,
+                       (self._tag, self.extract_value(key, value)))
+
+
+class DataJoinReducer(Reducer):
+    """≈ DataJoinReducerBase: regroup one key's values by source tag, walk
+    the cross product over the tag groups, and call :meth:`combine` once
+    per tuple. ``combine`` returns the joined output value (collected
+    under the join key) or None to filter the tuple out. Groups larger
+    than ``datajoin.maxNumOfValuesPerGroup`` are truncated (with a
+    counter) to bound the cross product, as the reference does."""
+
+    COUNTER_GROUP = "tpumr.DataJoin"
+
+    def configure(self, conf) -> None:
+        self._max_per_group = conf.get_int(MAX_VALUES_KEY, 100)
+
+    #: override for inner/outer behavior: tags that MUST be present for a
+    #: key to produce output (empty = every tag seen for the key suffices,
+    #: i.e. the reference's default cross product over present groups)
+    required_tags: "tuple[str, ...]" = ()
+
+    def combine(self, key, tags: "tuple[str, ...]", values: "tuple[Any, ...]",
+                output, reporter) -> Any:
+        raise NotImplementedError
+
+    def reduce(self, key, values, output, reporter):
+        groups: "dict[str, list[Any]]" = {}
+        truncated = 0
+        for v in values:
+            tag, payload = v
+            group = groups.setdefault(tag, [])
+            if len(group) >= self._max_per_group:
+                truncated += 1
+                continue
+            group.append(payload)
+        if truncated:
+            reporter.incr_counter(self.COUNTER_GROUP,
+                                  "VALUES_TRUNCATED", truncated)
+        if self.required_tags and any(t not in groups
+                                      for t in self.required_tags):
+            reporter.incr_counter(self.COUNTER_GROUP, "KEYS_UNMATCHED")
+            return
+        tags = tuple(sorted(groups))
+        for tup in itertools.product(*(groups[t] for t in tags)):
+            joined = self.combine(key, tags, tup, output, reporter)
+            if joined is not None:
+                output.collect(key, joined)
+                reporter.incr_counter(self.COUNTER_GROUP, "TUPLES_JOINED")
+
+
+def make_datajoin_conf(sources: "Iterable[tuple[str, str, type]]",
+                       reducer_cls: type, output_path: str,
+                       base_conf: Any = None):
+    """Build a join job over several (tag, input_path, mapper_cls)
+    sources ≈ DataJoinJob.createDataJoinJob. Each source's mapper runs
+    over its own input paths via per-path mapper dispatch."""
+    from tpumr.mapred.jobconf import JobConf
+    conf = JobConf(base_conf) if base_conf is not None else JobConf()
+    paths, tag_map = [], {}
+    for tag, path, mapper_cls in sources:
+        if not issubclass(mapper_cls, DataJoinMapper):
+            raise TypeError(f"{mapper_cls.__name__} is not a DataJoinMapper")
+        paths.append(path)
+        tag_map[path] = f"{mapper_cls.__module__}.{mapper_cls.__qualname__}"
+    conf.set_job_name("datajoin")
+    conf.set_input_paths(*paths)
+    conf.set_output_path(output_path)
+    conf.set("tpumr.datajoin.mappers", tag_map)
+    conf.set_mapper_class(PerSourceDispatchMapper)
+    conf.set_reducer_class(reducer_cls)
+    return conf
+
+
+class PerSourceDispatchMapper(Mapper):
+    """Routes each split's records to the mapper registered for the
+    split's input path prefix (the DataJoinJob role: one mapper class per
+    source directory). The split path arrives via the task-localized
+    conf."""
+
+    def configure(self, conf) -> None:
+        from tpumr.utils.reflection import resolve_class
+        self._conf = conf
+        self._by_prefix = {
+            prefix.rstrip("/"): resolve_class(cls_name)
+            for prefix, cls_name in
+            (conf.get("tpumr.datajoin.mappers") or {}).items()
+        }
+        self._delegate: "Mapper | None" = None
+
+    def _resolve(self, reporter) -> Mapper:
+        if self._delegate is None:
+            path = str(self._conf.get("tpumr.task.input.path") or "")
+            best = None
+            for prefix, cls in self._by_prefix.items():
+                # boundary-respecting match: 'in/users' must not claim
+                # 'in/users_extra/part-0'
+                if (path == prefix or path.startswith(prefix + "/")) and \
+                        (best is None or len(prefix) > len(best[0])):
+                    best = (prefix, cls)
+            if best is None:
+                raise ValueError(
+                    f"no datajoin mapper registered for split path {path!r}"
+                    f" (sources: {sorted(self._by_prefix)})")
+            self._delegate = best[1]()
+            self._delegate.configure(self._conf)
+        return self._delegate
+
+    def map(self, key, value, output, reporter):
+        self._resolve(reporter).map(key, value, output, reporter)
+
+    def close(self) -> None:
+        if self._delegate is not None:
+            self._delegate.close()

@@ -536,7 +536,12 @@ class JobMaster:
                             attempt_id=aid, is_map=ts.is_map,
                             run_on_tpu=ts.run_on_tpu,
                             tpu_device_id=ts.tpu_device_id,
-                            runtime=ts.runtime, tracker=name)))
+                            runtime=ts.runtime, tracker=name,
+                            # per-attempt counters make the history file
+                            # self-sufficient for post-hoc diagnosis
+                            # (tools.vaidya) ≈ the reference history's
+                            # COUNTERS field
+                            counters=ts.counters or {})))
                     if ts.state in (TaskState.FAILED, TaskState.KILLED):
                         # a dead attempt must not keep the commit grant —
                         # otherwise its re-run is denied commit and output

@@ -221,8 +221,12 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     conf = localize_task_conf(conf, task)
     from tpumr.utils.fi import maybe_fail
     maybe_fail("map.task", conf)
-    in_fmt = new_instance(conf.get_input_format(), conf)
     split = InputSplit.from_dict(task.split) if task.split else None
+    if split is not None and getattr(split, "path", None):
+        # the split's source path, for mappers that dispatch per input
+        # source (contrib.datajoin) ≈ map.input.file in the reference
+        conf.set("tpumr.task.input.path", str(split.path))
+    in_fmt = new_instance(conf.get_input_format(), conf)
     t0 = time.time()
 
     if task.run_on_tpu:
@@ -285,12 +289,21 @@ def _identity_dense_fast_path(conf: Any, in_fmt: Any, split: Any,
     RecordBatch (vectorized SequenceFile/text parse) and lands in the
     dense buffer as two array appends. Falls back (False) whenever the
     shape doesn't fit — non-identity mapper, no batch input, or record
-    widths that don't match the declared fixed layout."""
+    widths that don't match the declared fixed layout (the width check
+    needs the read, so THAT fallback re-reads the split — acceptable:
+    it only happens on misconfigured fixed-width declarations)."""
     mapper_cls = conf.get_class("mapred.mapper.class")
-    if not getattr(mapper_cls, "identity_map", False):
+    # the class ITSELF must declare identity_map (inherited flags don't
+    # count: a subclass overriding map() without re-declaring must not
+    # have its map() silently bypassed)
+    if mapper_cls is None or \
+            not mapper_cls.__dict__.get("identity_map", False):
         return False
     if split is None or getattr(in_fmt, "read_batch", None) is None:
         return False
+    from tpumr.mapred.split import DenseSplit
+    if isinstance(split, DenseSplit):
+        return False  # dense input has no byte keys to pass through
     batch = in_fmt.read_batch(split, conf)
     n = batch.num_records
     if n == 0:
