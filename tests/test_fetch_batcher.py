@@ -1,0 +1,154 @@
+"""Device-fetch coalescing: concurrent fetches share roundtrips, lone
+fetches are never delayed, and a failing entry doesn't poison its
+batch-mates."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpumr.mapred.fetch_batcher import DeviceFetchBatcher
+
+
+def _device_arrays(n):
+    import jax.numpy as jnp
+    return [jnp.asarray(np.full((4,), i, np.float32)) for i in range(n)]
+
+
+def test_single_fetch_roundtrip_and_result():
+    b = DeviceFetchBatcher()
+    (arr,) = _device_arrays(1)
+    out = b.fetch({"x": arr, "aux": 7})
+    assert out["aux"] == 7
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.zeros(4))
+    assert b.roundtrips == 1 and b.fetches == 1 and b.batched == 0
+
+
+def test_concurrent_fetches_coalesce():
+    """N threads fetching at once must use far fewer than N roundtrips
+    (arrivals during an in-flight fetch ride the next batch)."""
+    import jax
+
+    b = DeviceFetchBatcher()
+    arrs = _device_arrays(8)
+    results = [None] * 8
+    errors = []
+
+    real = jax.device_get
+    slow_calls = []
+
+    def slow_get(tree):
+        slow_calls.append(1)
+        time.sleep(0.05)  # make the roundtrip window wide
+        return real(tree)
+
+    gate = threading.Barrier(8)
+
+    def run(i):
+        try:
+            gate.wait()
+            results[i] = b.fetch((arrs[i],))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    import unittest.mock
+    with unittest.mock.patch.object(jax, "device_get", slow_get):
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors
+    for i in range(8):
+        np.testing.assert_array_equal(np.asarray(results[i][0]),
+                                      np.full(4, i))
+    assert b.fetches == 8
+    assert b.roundtrips <= 3, (b.roundtrips, b.batched)  # 1 leader + batch
+    assert b.batched >= 5
+
+
+def test_failing_entry_does_not_poison_batchmates():
+    import jax
+
+    b = DeviceFetchBatcher()
+    good = _device_arrays(2)
+
+    class Boom:
+        pass  # device_get chokes on this leaf inside a batch
+
+    real = jax.device_get
+
+    def get(tree):
+        # simulate: batched call fails, per-slot retry fails only for Boom
+        def has_boom(t):
+            if isinstance(t, Boom):
+                return True
+            if isinstance(t, (list, tuple)):
+                return any(has_boom(x) for x in t)
+            return False
+
+        if has_boom(tree):
+            raise RuntimeError("bad computation")
+        return real(tree)
+
+    import unittest.mock
+    results = {}
+    errors = {}
+    gate = threading.Barrier(3)
+
+    def run(name, tree):
+        try:
+            gate.wait()
+            results[name] = b.fetch(tree)
+        except Exception as e:  # noqa: BLE001
+            errors[name] = e
+
+    with unittest.mock.patch.object(jax, "device_get", get):
+        threads = [threading.Thread(target=run, args=(f"g{i}", (good[i],)))
+                   for i in range(2)]
+        threads.append(threading.Thread(target=run, args=("bad", (Boom(),))))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert set(errors) == {"bad"}
+    assert "bad computation" in str(errors["bad"])
+    assert set(results) == {"g0", "g1"}
+
+
+def test_tracker_tpu_tasks_share_roundtrips():
+    """End-to-end: a mini-cluster job with several concurrent TPU slots
+    funnels its kernel fetches through the shared batcher."""
+    from tpumr.fs import get_filesystem
+    from tpumr.mapred.fetch_batcher import shared_batcher
+    from tpumr.mapred.job_client import JobClient
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+    from tpumr.ops.kmeans import clear_centroid_cache
+
+    clear_centroid_cache()
+    fs = get_filesystem("mem:///")
+    rng = np.random.default_rng(5)
+    import io as _io
+    buf = _io.BytesIO()
+    np.save(buf, rng.normal(size=(400, 4)).astype(np.float32))
+    fs.write_bytes("/fb/points.npy", buf.getvalue())
+    buf = _io.BytesIO()
+    np.save(buf, rng.normal(size=(3, 4)).astype(np.float32))
+    fs.write_bytes("/fb/cents.npy", buf.getvalue())
+
+    before = shared_batcher().fetches
+    with MiniMRCluster(num_trackers=1, cpu_slots=0, tpu_slots=4) as c:
+        conf = c.create_job_conf()
+        from tpumr.mapred.input_formats import DenseInputFormat
+        conf.set_input_paths("mem:///fb/points.npy")
+        conf.set_output_path("mem:///fb/out")
+        conf.set_input_format(DenseInputFormat)
+        conf.set("tpumr.dense.split.rows", 50)  # 8 map tasks
+        conf.set("tpumr.kmeans.centroids", "mem:///fb/cents.npy")
+        conf.set_map_kernel("kmeans-assign")
+        conf.set("mapred.reducer.class",
+                 "tpumr.examples.basic.CentroidReducer")
+        conf.set_num_reduce_tasks(1)
+        assert JobClient(conf).run_job(conf).successful
+    assert shared_batcher().fetches - before == 8

@@ -14,6 +14,9 @@ class Echo:
     def ping(self, x):
         return x
 
+    def get_protocol_version(self):
+        return 9
+
 
 class TestRpcAuth:
     def test_signed_calls_work(self):
@@ -104,6 +107,108 @@ class TestRpcAuth:
             host, port = c.master.address
             with pytest.raises(RpcError, match="not signed"):
                 RpcClient(host, port).call("list_jobs")
+
+    def test_token_scoped_callers(self):
+        """Per-scope token auth (≈ JobTokenSecretManager): a scoped caller
+        signs with its token, may only call allowlisted methods, and an
+        unknown/wrong token is rejected."""
+        srv = RpcServer(Echo(), secret=b"cluster").start()
+        srv.token_resolver = {"job_1": b"tok-1"}.get
+        srv.scoped_methods = {"ping"}
+        try:
+            ok = RpcClient(*srv.address, secret=b"tok-1", scope="job_1")
+            assert ok.call("ping", 5) == 5
+            wrong_key = RpcClient(*srv.address, secret=b"tok-2",
+                                  scope="job_1")
+            with pytest.raises(RpcError, match="not signed"):
+                wrong_key.call("ping", 1)
+            # unknown scope: SAME error as a bad signature (no oracle
+            # for which job ids exist)
+            unknown = RpcClient(*srv.address, secret=b"tok-9",
+                                scope="job_9")
+            with pytest.raises(RpcError, match="not signed"):
+                unknown.call("ping", 1)
+            # the cluster secret cannot be used AS a token scope signer
+            cluster_as_scope = RpcClient(*srv.address, secret=b"cluster",
+                                         scope="job_1")
+            with pytest.raises(RpcError, match="not signed"):
+                cluster_as_scope.call("ping", 1)
+        finally:
+            srv.stop()
+
+    def test_token_scoped_method_allowlist(self):
+        srv = RpcServer(Echo(), secret=b"cluster").start()
+        srv.token_resolver = {"job_1": b"tok-1"}.get
+        srv.scoped_methods = {"ping"}
+        try:
+            scoped = RpcClient(*srv.address, secret=b"tok-1", scope="job_1")
+            with pytest.raises(RpcError,
+                               match="not available to token-scoped"):
+                scoped.call("get_protocol_version")
+            # daemons (cluster secret, no scope) are unrestricted
+            daemon = RpcClient(*srv.address, secret=b"cluster")
+            assert daemon.call("get_protocol_version") == 9
+
+        finally:
+            srv.stop()
+
+    def test_job_token_cannot_cross_jobs(self):
+        """A tracker serving two jobs' outputs refuses a job-A-token
+        fetch of job B's map output, and the master refuses token-scoped
+        frames entirely."""
+        from tpumr.fs import get_filesystem
+        from tpumr.mapred.job_client import JobClient
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", "cluster-shared-secret")
+        with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0,
+                           conf=conf) as c:
+            fs = get_filesystem("mem:///")
+            fs.write_bytes("/jt2/in.txt", b"a b\n" * 10)
+            job_ids = []
+            for i in range(2):
+                jc = c.create_job_conf()
+                jc.set_input_paths("mem:///jt2/in.txt")
+                jc.set_output_path(f"mem:///jt2/out{i}")
+                from tpumr.examples.basic import LongSumReducer
+                from tpumr.ops.wordcount import WordCountCpuMapper
+                jc.set_class("mapred.mapper.class", WordCountCpuMapper)
+                jc.set_class("mapred.reducer.class", LongSumReducer)
+                res = JobClient(jc).run_job(jc)
+                assert res.successful
+                job_ids.append(str(res.job_id))
+            tracker = c.trackers[0]
+            tok_a = tracker._job_token(job_ids[0])
+            assert tok_a and tok_a != b"cluster-shared-secret"
+            host, port = "127.0.0.1", tracker.shuffle_port
+            scoped = RpcClient(host, port, secret=tok_a, scope=job_ids[0])
+            # own job: served (or a clean KeyError if already purged)
+            try:
+                out = scoped.call("get_map_output", job_ids[0], 0, 0)
+                assert "data" in out
+            except RpcError as e:
+                assert "KeyError" in str(e)
+            # other job: denied by scope pinning, never a data response
+            with pytest.raises(RpcError, match="cannot access job"):
+                scoped.call("get_map_output", job_ids[1], 0, 0)
+            # non-allowlisted tracker surface: denied
+            with pytest.raises(RpcError, match="not available"):
+                scoped.call("list_task_logs")
+            # the master rejects token-scoped frames outright (no
+            # resolver — indistinguishable from a bad signature)
+            mh, mp = c.master.address
+            with pytest.raises(RpcError, match="not signed"):
+                RpcClient(mh, mp, secret=tok_a,
+                          scope=job_ids[0]).call("list_jobs")
+            # forged attempt/job binding: job A's token cannot settle an
+            # attempt labeled with job A but belonging to job B
+            scoped_a = RpcClient(host, port, secret=tok_a,
+                                 scope=job_ids[0])
+            bogus_attempt = job_ids[1].replace("job_", "attempt_") + \
+                "_m_000000_0"
+            with pytest.raises(RpcError, match="does not belong"):
+                scoped_a.call("umbilical_done", bogus_attempt,
+                              {"state": "SUCCEEDED"}, job_ids[0], 0, "", {})
 
     def test_secret_file(self, tmp_path):
         p = tmp_path / "secret"

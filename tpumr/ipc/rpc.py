@@ -57,6 +57,15 @@ def current_rpc_user() -> "str | None":
     return getattr(_current_user, "user", None)
 
 
+def current_rpc_scope() -> "str | None":
+    """Token scope of the RPC currently being dispatched: None for
+    cluster-secret (daemon) callers, the job id for callers signed with a
+    per-job token (≈ the reference's JobToken identity — task children
+    hold only their job's token, never the service secret). Only
+    meaningful when the server authenticates."""
+    return getattr(_current_user, "scope", None)
+
+
 def _sign(secret: bytes, req: dict, port: int, nonce: str) -> str:
     """HMAC-SHA256 over the canonical request identity+payload+timestamp,
     bound to the serving connection via the server's per-connection nonce
@@ -65,10 +74,11 @@ def _sign(secret: bytes, req: dict, port: int, nonce: str) -> str:
     of one daemon (a frame captured on the way to datanode A cannot be
     replayed to datanode B, or to A over a new connection), the timestamp
     must be fresh, and the server tracks a per-client high-water request
-    id within the connection's lifetime."""
+    id within the connection's lifetime. The token scope is part of the
+    canon so a scoped frame cannot be re-labeled."""
     canon = serialize([req.get("cid"), req.get("id"), req.get("method"),
                        list(req.get("params", [])), req.get("ts"), port,
-                       nonce, req.get("user")])
+                       nonce, req.get("user"), req.get("scope")])
     return hmac.new(secret, canon, "sha256").hexdigest()
 
 
@@ -119,23 +129,41 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 req = _recv_frame(sock)
                 secret = server.secret
+                scope = req.get("scope")
                 if secret is not None:
                     import time as _time
                     sig = req.get("auth")
-                    my_port = sock.getsockname()[1]
                     ts = req.get("ts")
-                    if not sig or not hmac.compare_digest(
-                            sig, _sign(secret, req, my_port, nonce)):
+                    if not sig or ts is None:
                         _send_frame(sock, {
                             "id": req.get("id"),
                             "error": "RpcAuthError: request not signed "
-                                     "with the cluster secret"})
+                                     "with the expected secret"})
                         continue
-                    if ts is None or abs(_time.time() - ts) > AUTH_WINDOW_S:
+                    # freshness BEFORE any resolver lookup: needs no
+                    # secret, so replayed/garbage frames never trigger
+                    # resolver work (which may do real lookups)
+                    if abs(_time.time() - ts) > AUTH_WINDOW_S:
                         _send_frame(sock, {
                             "id": req.get("id"),
                             "error": "RpcAuthError: stale or missing "
                                      "request timestamp (replay?)"})
+                        continue
+                    if scope is not None:
+                        # scoped caller: signed with a per-scope token
+                        # (job token), restricted to the scoped-method
+                        # allowlist below. An unknown scope produces the
+                        # SAME error as a bad signature — no oracle for
+                        # which scopes (job ids) exist.
+                        resolver = server.rpc.token_resolver
+                        secret = resolver(scope) if resolver else None
+                    my_port = sock.getsockname()[1]
+                    if secret is None or not hmac.compare_digest(
+                            sig, _sign(secret, req, my_port, nonce)):
+                        _send_frame(sock, {
+                            "id": req.get("id"),
+                            "error": "RpcAuthError: request not signed "
+                                     "with the expected secret"})
                         continue
                 # client-side reconnect retries resend the same (cid, id):
                 # replay the cached response instead of re-executing, so
@@ -156,12 +184,21 @@ class _Handler(socketserver.BaseRequestHandler):
                         continue
                 resp: dict[str, Any] = {"id": req.get("id")}
                 try:
+                    if server.secret is not None and scope is not None \
+                            and req.get("method") not in \
+                            server.rpc.scoped_methods:
+                        raise RpcAuthError(
+                            f"method {req.get('method')!r} is not "
+                            "available to token-scoped callers")
                     method = server.lookup(req["method"])
                     _current_user.user = req.get("user")
+                    _current_user.scope = scope if server.secret is not None \
+                        else None
                     try:
                         resp["result"] = method(*req.get("params", []))
                     finally:
                         _current_user.user = None
+                        _current_user.scope = None
                 except Exception as e:  # noqa: BLE001 — remote surface
                     resp["error"] = f"{type(e).__name__}: {e}"
                     resp["traceback"] = traceback.format_exc(limit=8)
@@ -187,9 +224,17 @@ class RpcServer:
                  port: int = 0, secret: "bytes | None" = None) -> None:
         self._handlers: dict[str, Any] = {"": handler}
         self.secret = secret
+        #: per-scope token lookup for scoped callers (job tokens):
+        #: ``resolver(scope) -> bytes | None``. None = scoped frames are
+        #: rejected (the default: only daemons hold the cluster secret).
+        self.token_resolver: "Any | None" = None
+        #: methods a token-scoped caller may invoke (umbilical + shuffle
+        #: surface); everything else is denied before dispatch
+        self.scoped_methods: "set[str]" = set()
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.secret = secret  # type: ignore[attr-defined]
         # expose hooks on the socketserver instance for _Handler
+        self._server.rpc = self  # type: ignore[attr-defined]
         self._server.lookup = self.lookup  # type: ignore[attr-defined]
         self._server.response_cache_get = self.response_cache_get  # type: ignore[attr-defined]
         self._server.response_cache_put = self.response_cache_put  # type: ignore[attr-defined]
@@ -290,10 +335,15 @@ class RpcClient:
     per-connection multiplexing without the async responder)."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 secret: "bytes | None" = None) -> None:
+                 secret: "bytes | None" = None,
+                 scope: "str | None" = None) -> None:
         self.host, self.port = host, port
         self.timeout = timeout
         self.secret = secret
+        #: token scope: set when ``secret`` is a per-job token rather
+        #: than the cluster secret (task children) — the server resolves
+        #: the verification key by scope and restricts callable methods
+        self.scope = scope
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._nonce = ""
@@ -357,6 +407,8 @@ class RpcClient:
             self._id += 1
             req = {"id": self._id, "cid": self._cid, "method": method,
                    "params": list(params), "user": user}
+            if self.scope is not None:
+                req["scope"] = self.scope
             try:
                 sock = self._connect()
                 self._stamp(req)

@@ -14,7 +14,7 @@ Divergences from the reference, by design:
   in the tracker process so kernels share one JAX runtime and the HBM
   split cache (tasktracker.py module docstring);
 - task state is shipped in one self-contained task file (conf + task +
-  umbilical address + RPC secret) written into the attempt's sandbox dir,
+  umbilical address + job token) written into the attempt's sandbox dir,
   instead of being fetched over the umbilical after launch — one fewer
   startup round-trip, and it gives the setuid task-controller a single
   file whose ownership it can validate;
@@ -23,7 +23,10 @@ Divergences from the reference, by design:
   state alive across attempts.
 
 The umbilical methods live on the tracker's existing RPC surface
-(NodeRunner.umbilical_*), authenticated with the same cluster secret.
+(NodeRunner.umbilical_*). The child authenticates with its PER-JOB token
+(≈ the reference's jobToken file + JobTokenSecretManager), never the
+cluster secret: the RPC layer restricts token-scoped callers to the
+umbilical/shuffle methods and each method pins the scope to its job.
 """
 
 from __future__ import annotations
@@ -93,9 +96,11 @@ def run_child(task_file: str) -> int:
     job_id = spec["job_id"]
     aid = str(task.attempt_id)
     secret = spec.get("secret") or None
+    scope = spec.get("scope") or None  # job-token identity (never the
+    #                                    cluster secret — see process_runner)
 
     tracker = RpcClient(spec["tracker_host"], spec["tracker_port"],
-                        secret=secret)
+                        secret=secret, scope=scope)
     umb = _Umbilical(tracker, aid)
     phase = ["MAP" if task.is_map else "SHUFFLE"]
     progress = [0.0]
@@ -138,7 +143,8 @@ def run_child(task_file: str) -> int:
                 secret,
                 poll_s=conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0,
                 timeout_s=conf.get_int("tpumr.shuffle.timeout.ms",
-                                       600_000) / 1000.0)
+                                       600_000) / 1000.0,
+                scope=scope)
 
             def fetch(map_index: int, partition: int):
                 from tpumr.io import ifile

@@ -1,0 +1,110 @@
+"""Device→host fetch coalescing for concurrent tasks.
+
+On a tunneled/remote TPU runtime every ``jax.device_get`` of computed
+arrays costs a full network roundtrip (~tens of ms) regardless of payload
+size, and ONE ``device_get`` over many tasks' pytrees costs the same as
+one task's (measured: 8 arrays across 4 tasks = 1 roundtrip). The
+LocalJobRunner exploits that with its windowed prelaunch
+(tpu_runner.prelaunch_device_maps); this module is the equivalent for the
+DISTRIBUTED runtime, where a tracker's TPU-slot threads run tasks
+concurrently and each would otherwise pay its own roundtrip.
+
+Design: rotating leader, zero added latency. The first thread to fetch
+becomes leader and issues its ``device_get`` immediately — no linger
+sleep. Threads arriving while a roundtrip is in flight queue up; when
+the leader finishes, one of the QUEUED threads becomes the next leader
+and takes the whole queue as one batched ``device_get`` — the in-flight
+roundtrip itself is the coalescing window. Each leader serves exactly
+one batch (which always contains its own entry), so no thread is held
+hostage doing other tasks' transfers after its own is done: a lone task
+is never delayed, and N concurrent tasks converge to ~2 roundtrips
+instead of N.
+
+If a batched fetch fails (one task's device computation raised), the
+leader retries each entry individually so the error lands on the task
+that caused it — innocent tasks in the same batch must not fail.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class DeviceFetchBatcher:
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._pending: "list[_Slot]" = []
+        self._leader_running = False
+        #: observability: how many device_get roundtrips vs fetch calls
+        self.roundtrips = 0
+        self.fetches = 0
+        self.batched = 0
+
+    def fetch(self, tree: Any) -> Any:
+        """Transfer one pytree of jax.Arrays to host, coalescing with
+        concurrent callers. Returns the host pytree; re-raises the
+        caller's own device error."""
+        slot = _Slot(tree)
+        with self._cond:
+            self.fetches += 1
+            self._pending.append(slot)
+            while not slot.done and self._leader_running:
+                self._cond.wait()
+            if slot.done:
+                # a previous leader's batch carried this slot
+                if slot.error is not None:
+                    raise slot.error
+                return slot.result
+            # become leader for exactly one batch — which includes this
+            # slot, so leading never outlives the caller's own work
+            self._leader_running = True
+            batch = self._pending
+            self._pending = []
+            self.roundtrips += 1
+            self.batched += len(batch) - 1
+        try:
+            self._transfer(batch)
+        finally:
+            with self._cond:
+                self._leader_running = False
+                self._cond.notify_all()
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    def _transfer(self, batch: "list[_Slot]") -> None:
+        import jax
+        try:
+            results = jax.device_get([s.tree for s in batch])
+            for s, r in zip(batch, results):
+                s.result = r
+        except Exception:  # noqa: BLE001 — isolate the failing entry
+            for s in batch:
+                try:
+                    s.result = jax.device_get(s.tree)
+                except Exception as e:  # noqa: BLE001
+                    s.error = e
+                with self._cond:
+                    self.roundtrips += 1
+        finally:
+            for s in batch:
+                s.done = True
+
+
+class _Slot:
+    __slots__ = ("tree", "result", "error", "done")
+
+    def __init__(self, tree: Any) -> None:
+        self.tree = tree
+        self.result = None
+        self.error: "Exception | None" = None
+        self.done = False
+
+
+_shared = DeviceFetchBatcher()
+
+
+def shared_batcher() -> DeviceFetchBatcher:
+    """The process-wide batcher (one tunnel, one queue)."""
+    return _shared

@@ -355,6 +355,12 @@ class JobMaster:
         # JobInProgress construction resolves split racks (may exec the
         # topology script) — built outside the master lock
         jip = JobInProgress(job_id, conf_dict, splits)
+        # per-job shuffle/umbilical token ≈ the reference's JobToken
+        # (JobTokenSecretManager): task children get THIS, never the
+        # cluster secret, so a task can only reach its own job's
+        # umbilical + map outputs
+        import secrets as _secrets
+        jip.job_token = _secrets.token_bytes(32)
         with self.lock:
             self.jobs[str(job_id)] = jip
             self._mreg.incr("jobs_submitted")
@@ -441,6 +447,12 @@ class JobMaster:
 
     def get_job_conf(self, job_id: str) -> dict:
         return dict(self._job(job_id).conf)
+
+    def get_job_token(self, job_id: str) -> bytes:
+        """Per-job token for trackers localizing the job (cluster-secret
+        callers only — the RPC layer rejects token-scoped frames at the
+        master, so a task child can never mint or read tokens)."""
+        return getattr(self._job(job_id), "job_token", b"") or b""
 
     def _job(self, job_id: str) -> JobInProgress:
         with self.lock:

@@ -79,13 +79,21 @@ def run_task_in_process(runner: Any, job_id: str, task: Task,
     os.makedirs(task_dir, exist_ok=True)
 
     task_file = os.path.join(task_dir, "task.bin")
+    # the child gets the per-JOB token, never the cluster secret (≈ the
+    # reference's jobToken file in the attempt dir): a compromised task
+    # can only reach its own job's umbilical + shuffle surface
+    if runner._rpc_secret:
+        child_secret, child_scope = runner._job_token(job_id), job_id
+    else:
+        child_secret, child_scope = b"", None  # unauthenticated cluster
     payload = serialize({
         "job_id": job_id,
         "task": task.to_dict(),
         "conf": conf.to_dict(),
         "tracker_host": runner.bind_host,
         "tracker_port": runner.shuffle_port,
-        "secret": runner._rpc_secret or b"",
+        "secret": child_secret,
+        "scope": child_scope,
     })
     fd = os.open(task_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     with os.fdopen(fd, "wb") as f:

@@ -58,7 +58,8 @@ def _resolvable(host: str) -> bool:
 
 
 def make_map_locator(events_fn: Any, secret: bytes | None,
-                     poll_s: float = 0.2, timeout_s: float = 600.0):
+                     poll_s: float = 0.2, timeout_s: float = 600.0,
+                     scope: "str | None" = None):
     """Map-output location resolution ≈ the ReduceCopier's polling of
     TaskCompletionEvents (ReduceTask.java:659 fetch loop). ``events_fn
     (cursor) -> [event]`` is the master's incremental completion-event
@@ -86,7 +87,8 @@ def make_map_locator(events_fn: Any, secret: bytes | None,
         host, port = addr.rsplit(":", 1)
         cli = clients.get(addr)
         if cli is None:
-            cli = clients[addr] = RpcClient(host, int(port), secret=secret)
+            cli = clients[addr] = RpcClient(host, int(port), secret=secret,
+                                            scope=scope)
         return cli
 
     return locate
@@ -153,6 +155,18 @@ class NodeRunner:
         # shuffle server = this tracker's RPC surface (MapOutputServlet role)
         self._server = RpcServer(self, host=self.bind_host, port=0,
                                  secret=self._rpc_secret)
+        # task children authenticate with their JOB token, not the
+        # cluster secret (≈ JobTokenSecretManager + SecureShuffleUtils):
+        # scoped callers may reach only the umbilical + shuffle surface,
+        # and the methods themselves pin the scope to the job argument
+        self._job_tokens: dict[str, bytes] = {}
+        self._job_token_misses: dict[str, float] = {}  # scope -> retry-at
+        self._server.token_resolver = self._job_token_or_none
+        self._server.scoped_methods = {
+            "get_protocol_version", "umbilical_ping", "umbilical_status",
+            "umbilical_can_commit", "umbilical_events", "umbilical_done",
+            "umbilical_fail", "get_map_output", "get_map_output_dense",
+        }
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            name=f"{self.name}-heartbeat",
                                            daemon=True)
@@ -370,6 +384,7 @@ class NodeRunner:
                                         self.map_outputs.items()
                                         if k[0] != job_id}
                     jc = self.job_confs.pop(job_id, None)
+                    self._job_tokens.pop(job_id, None)
                 if jc is not None:
                     from tpumr.mapred import filecache
                     filecache.release_job(
@@ -420,6 +435,49 @@ class NodeRunner:
                 self._response_id = 0
 
     # ------------------------------------------------------------ execution
+
+    def _job_token(self, job_id: str) -> bytes:
+        """This job's token, fetched from the master (cluster-secret
+        channel) on first use and cached for the job's lifetime."""
+        with self.lock:
+            tok = self._job_tokens.get(job_id)
+        if tok is None:
+            tok = bytes(self.master.call("get_job_token", job_id) or b"")
+            with self.lock:
+                self._job_tokens[job_id] = tok
+        return tok
+
+    def _job_token_or_none(self, scope: str) -> "bytes | None":
+        """Token resolver for the RPC server: serve scoped callers of any
+        job this tracker knows (it may be the shuffle SOURCE for a job
+        whose reduce child runs elsewhere — resolve via the master on
+        cache miss rather than rejecting). Misses are negatively cached
+        so a flood of bogus scopes cannot amplify into tracker→master
+        RPC traffic."""
+        now = time.time()
+        with self.lock:
+            if self._job_token_misses.get(scope, 0) > now:
+                return None
+        try:
+            return self._job_token(scope) or None
+        except Exception:  # noqa: BLE001 — unknown job / master down
+            with self.lock:
+                if len(self._job_token_misses) > 1024:
+                    self._job_token_misses = {
+                        k: v for k, v in self._job_token_misses.items()
+                        if v > now}
+                self._job_token_misses[scope] = now + 30.0
+            return None
+
+    @staticmethod
+    def _check_scope(job_id: str) -> None:
+        """Token-scoped callers may only touch THEIR job (≈ the
+        SecureShuffleUtils verification on MapOutputServlet)."""
+        from tpumr.ipc.rpc import current_rpc_scope
+        scope = current_rpc_scope()
+        if scope is not None and scope != job_id:
+            raise PermissionError(
+                f"job token for {scope} cannot access job {job_id}")
 
     def _job_conf(self, job_id: str) -> JobConf:
         with self.lock:
@@ -655,11 +713,13 @@ class NodeRunner:
 
     def umbilical_ping(self, attempt_id: str) -> bool:
         """Kill-poll: True = the tracker wants this attempt gone."""
+        self._check_scope(str(TaskAttemptID.parse(attempt_id).task.job))
         with self.lock:
             return attempt_id in self._kill_requested
 
     def umbilical_status(self, attempt_id: str, d: dict) -> bool:
         """Periodic progress/counter push (≈ statusUpdate)."""
+        self._check_scope(str(TaskAttemptID.parse(attempt_id).task.job))
         with self.lock:
             st = self.running.get(attempt_id)
             if st is None or st.state in TaskState.TERMINAL:
@@ -672,15 +732,24 @@ class NodeRunner:
 
     def umbilical_can_commit(self, task_id: str, attempt_id: str) -> bool:
         """Commit-grant proxy (≈ commitPending → JobTracker.canCommit)."""
+        self._check_scope(str(TaskAttemptID.parse(attempt_id).task.job))
         return bool(self.master.call("can_commit", task_id, attempt_id))
 
     def umbilical_events(self, job_id: str, cursor: int) -> list:
         """Map-completion-event proxy for isolated reduce children."""
+        self._check_scope(job_id)
         return self.master.call("get_map_completion_events", job_id, cursor)
 
     def umbilical_done(self, attempt_id: str, final: dict, job_id: str,
                        partition: int, out_path: str, index: dict) -> None:
         """Terminal report (≈ done): settle status, register map output."""
+        if str(TaskAttemptID.parse(attempt_id).task.job) != job_id:
+            # scope pins to job_id below — the attempt must actually BE
+            # that job's, or a scoped caller could settle another job's
+            # attempt by mislabeling the job argument
+            raise PermissionError(
+                f"attempt {attempt_id} does not belong to job {job_id}")
+        self._check_scope(job_id)
         with self.lock:
             st = self.running.get(attempt_id)
             if st is not None and st.state not in TaskState.TERMINAL:
@@ -701,6 +770,7 @@ class NodeRunner:
     def umbilical_fail(self, attempt_id: str, state: str,
                        diagnostics: str) -> None:
         """Failure/kill report (≈ fsError/fatalError)."""
+        self._check_scope(str(TaskAttemptID.parse(attempt_id).task.job))
         with self.lock:
             st = self.running.get(attempt_id)
             if st is not None and st.state not in TaskState.TERMINAL:
@@ -716,6 +786,7 @@ class NodeRunner:
         """Serve one partition segment (≈ MapOutputServlet,
         TaskTracker.java:4050): raw length-prefixed (possibly compressed)
         bytes straight off the spill file + the codec name."""
+        self._check_scope(job_id)
         with self.lock:
             ent = self.map_outputs.get((job_id, map_index))
         if ent is None:
@@ -733,6 +804,7 @@ class NodeRunner:
         """Serve a device-shuffled job's whole dense map output (same
         MapOutputServlet role; the exchange itself happens on the mesh).
         Ships the self-describing file verbatim — no parse/reserialize."""
+        self._check_scope(job_id)
         with self.lock:
             ent = self.map_outputs.get((job_id, map_index))
         if ent is None:

@@ -155,7 +155,18 @@ class TpuMapRunner(MapRunnable):
 
         t0 = time.time()
         with jax.default_device(device):
-            for key, value in kernel.map_batch(batch, conf, task_ctx):
+            state = (kernel.map_batch_launch(batch, conf, task_ctx)
+                     if type(kernel).supports_launch() else None)
+            if state is not None:
+                # coalesce this task's device→host transfer with any
+                # concurrently-fetching TPU-slot threads: one tunnel
+                # roundtrip can carry many tasks' outputs
+                from tpumr.mapred.fetch_batcher import shared_batcher
+                fetched = shared_batcher().fetch(state)
+                records = kernel.map_batch_drain(fetched, conf, task_ctx)
+            else:
+                records = kernel.map_batch(batch, conf, task_ctx)
+            for key, value in records:
                 output.collect(key, value)
         reporter.set_status(
             f"kernel {name} on {device}: "
