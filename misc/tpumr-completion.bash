@@ -1,0 +1,72 @@
+# Bash completion for the tpumr CLI.
+# ≈ src/contrib/bash-tab-completion/hadoop.sh (completes commands, then
+# per-command flags, then filesystem paths).
+#
+# Install:  source misc/tpumr-completion.bash
+#           (or drop it into /etc/bash_completion.d/)
+
+_tpumr_complete() {
+    local cur prev cmds
+    COMPREPLY=()
+    cur="${COMP_WORDS[COMP_CWORD]}"
+    prev="${COMP_WORDS[COMP_CWORD-1]}"
+    cmds="namenode datanode secondarynamenode jobtracker tasktracker \
+historyserver fs job balancer fsck dfsadmin pipes streaming examples \
+distcp archive rumen failmon gridmix version"
+
+    if [[ ${COMP_CWORD} -eq 1 ]]; then
+        COMPREPLY=( $(compgen -W "${cmds}" -- "${cur}") )
+        return 0
+    fi
+
+    case "${COMP_WORDS[1]}" in
+        fs)
+            if [[ ${COMP_CWORD} -eq 2 ]]; then
+                COMPREPLY=( $(compgen -W "-ls -lsr -cat -put -get -cp -mv \
+-rm -rmr -mkdir -touchz -du -dus -count -chmod -chown -tail -text -stat \
+-test -expunge -help" -- "${cur}") )
+                return 0
+            fi
+            ;;
+        job)
+            if [[ ${COMP_CWORD} -eq 2 ]]; then
+                COMPREPLY=( $(compgen -W "-list -status -kill -counters \
+-events -history -diagnose" -- "${cur}") )
+                return 0
+            fi
+            ;;
+        dfsadmin)
+            if [[ ${COMP_CWORD} -eq 2 ]]; then
+                COMPREPLY=( $(compgen -W "-report -safemode -setQuota \
+-clrQuota -setSpaceQuota -clrSpaceQuota -decommission -recommission \
+-refreshNodes" -- "${cur}") )
+                return 0
+            fi
+            ;;
+        failmon)
+            if [[ ${COMP_CWORD} -eq 2 ]]; then
+                COMPREPLY=( $(compgen -W "-collect -merge" -- "${cur}") )
+                return 0
+            fi
+            ;;
+        examples)
+            if [[ ${COMP_CWORD} -eq 2 ]]; then
+                COMPREPLY=( $(compgen -W "wordcount grep pi kmeans matmul \
+sort terasort teragen teravalidate join secondarysort sleep randomwriter" \
+                    -- "${cur}") )
+                return 0
+            fi
+            ;;
+        streaming|pipes)
+            COMPREPLY=( $(compgen -W "-input -output -mapper -reducer \
+-combiner -io -D -jt -files" -- "${cur}") )
+            return 0
+            ;;
+    esac
+    # default: local paths (input/output files, scripts, binaries)
+    COMPREPLY=( $(compgen -f -- "${cur}") )
+    return 0
+}
+
+complete -F _tpumr_complete tpumr
+complete -F _tpumr_complete "python -m tpumr.cli" 2>/dev/null || true

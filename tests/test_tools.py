@@ -153,6 +153,106 @@ class TestRumen:
         backends = {x["backend"] for x in t["tasks"]}
         assert backends == {"cpu", "tpu"}
 
+class TestFailmon:
+    def test_collect_upload_merge_roundtrip(self, tmp_path, capsys):
+        from tpumr.tools import failmon
+        log = tmp_path / "daemon.log"
+        log.write_text("INFO fine\nERROR disk on fire\nINFO ok\n")
+        store = failmon.LocalStore(str(tmp_path / "store"))
+        mons = [failmon.CpuMonitor(), failmon.MemoryMonitor(),
+                failmon.DiskMonitor([str(tmp_path)]),
+                failmon.LogMonitor(str(log))]
+        n = failmon.run_once(store, mons)
+        assert n >= 3
+        # persistent offset: second pass reports no OLD error lines
+        n2_events = []
+        state = store.load_state()
+        for ev in failmon.LogMonitor(str(log)).poll(state):
+            n2_events.append(ev)
+        assert n2_events == []
+        # new error appended -> exactly one new event
+        with open(log, "a") as f:
+            f.write("FATAL cascading failure\n")
+        new = list(failmon.LogMonitor(str(log)).poll(state))
+        assert len(new) == 1 and "cascading" in new[0]["line"]
+
+        # upload + merge through the FS abstraction
+        dest = store.upload("mem:///fm/uploads")
+        assert dest and dest.endswith(".jsonl")
+        total = failmon.merge("mem:///fm/uploads", "mem:///fm/all.jsonl")
+        assert total == n
+        from tpumr.fs import get_filesystem
+        lines = get_filesystem("mem:///").read_bytes(
+            "mem:///fm/all.jsonl").decode().splitlines()
+        assert len(lines) == n
+        import json as _json
+        kinds = {(_json.loads(l)["source"]) for l in lines}
+        assert {"cpu", "memory", "disk", "log"} <= kinds
+        # events are time-ordered after merge
+        ts = [_json.loads(l)["ts"] for l in lines]
+        assert ts == sorted(ts)
+
+    def test_cli_and_anonymize(self, tmp_path, capsys):
+        rc = cli_main(["failmon", "-collect", "-store",
+                       str(tmp_path / "s"), "-anonymize"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "collected" in out
+        import json as _json
+        events = [_json.loads(l) for l in
+                  (tmp_path / "s" / "failmon.events.jsonl")
+                  .read_text().splitlines()]
+        assert events and all(e["host"].startswith("host-")
+                              for e in events)
+
+    def test_log_monitor_truncated_pass_still_advances_offset(self, tmp_path):
+        """A log with more matches than max_events must not re-emit old
+        lines on the next pass — the offset advances past scanned bytes."""
+        from tpumr.tools import failmon
+        log = tmp_path / "busy.log"
+        log.write_text("".join(f"ERROR e{i}\n" for i in range(150)))
+        mon = failmon.LogMonitor(str(log), max_events=100)
+        state: dict = {}
+        first = list(mon.poll(state))
+        assert len(first) == 100
+        second = list(mon.poll(state))
+        assert len(second) == 50
+        assert second[0]["line"] == "ERROR e100"
+        assert list(mon.poll(state)) == []
+
+    def test_upload_failure_keeps_events(self, tmp_path):
+        from tpumr.tools import failmon
+        store = failmon.LocalStore(str(tmp_path / "s3"))
+        store.append([failmon.event("t", "x")])
+        import pytest
+        with pytest.raises(Exception):
+            store.upload("nosuchscheme://nope")
+        # events folded back — a later good upload ships them
+        dest = store.upload("mem:///fm2/up")
+        assert dest is not None
+
+    def test_cli_rejects_bad_flags(self, capsys):
+        assert cli_main(["failmon", "-collect", "-anonymise"]) == 255
+        assert "bad or valueless" in capsys.readouterr().err
+        assert cli_main(["failmon", "-collect", "-store"]) == 255
+
+    def test_monitor_failure_does_not_kill_the_pass(self, tmp_path):
+        from tpumr.tools import failmon
+
+        class Bad(failmon.Monitor):
+            name = "bad"
+
+            def poll(self, state):
+                raise RuntimeError("sensor exploded")
+
+        store = failmon.LocalStore(str(tmp_path / "s2"))
+        n = failmon.run_once(store, [Bad(), failmon.CpuMonitor()])
+        assert n == 2  # the failure event + the cpu event
+        text = (tmp_path / "s2" / "failmon.events.jsonl").read_text()
+        assert "monitor-failed" in text and "sensor exploded" in text
+
+
+class TestVaidya:
     def test_vaidya_rules_on_synthetic_history(self):
         from tpumr.core.counters import TaskCounter
         from tpumr.tools.vaidya import diagnose

@@ -44,6 +44,7 @@ Clients:
   distcp SRC DST       distributed copy (any scheme to any scheme)
   archive SRC DEST.tharch | archive -ls ARCH   pack/list archives
   rumen HISTORY_DIR    extract job traces from history
+  failmon -collect|-merge   node failure monitoring (collect/upload/merge)
   gridmix [--scale S]  synthetic mixed-workload benchmark
   version              print the version
 """
@@ -458,6 +459,49 @@ def _job_history(conf, argv: list[str]) -> int:
     return 0
 
 
+def cmd_failmon(conf, argv: list[str]) -> int:
+    """≈ contrib/failmon RunOnce + the HDFS merge step."""
+    from tpumr.tools import failmon
+    usage = ("Usage: tpumr failmon -collect [-store DIR] [-upload URL] "
+             "[-anonymize] | -merge URL DEST")
+    if not argv:
+        print(usage, file=sys.stderr)
+        return 255
+    if argv[0] == "-merge":
+        if len(argv) != 3:
+            print(usage, file=sys.stderr)
+            return 255
+        n = failmon.merge(argv[1], argv[2])
+        print(f"merged {n} events -> {argv[2]}")
+        return 0
+    if argv[0] != "-collect":
+        print(usage, file=sys.stderr)
+        return 255
+    rest = argv[1:]
+    anonymize = "-anonymize" in rest
+    rest = [a for a in rest if a != "-anonymize"]
+    opts: dict[str, str] = {}
+    i = 0
+    while i < len(rest):
+        flag = rest[i]
+        if flag not in ("-store", "-upload") or i + 1 >= len(rest):
+            print(f"failmon: bad or valueless option {flag!r}\n{usage}",
+                  file=sys.stderr)
+            return 255
+        opts[flag] = rest[i + 1]
+        i += 2
+    store_dir = opts.get("-store") or conf.get("failmon.store.dir") \
+        or "/tmp/tpumr-failmon"
+    store = failmon.LocalStore(store_dir, anonymize=anonymize)
+    n = failmon.run_once(store, failmon.default_monitors(conf))
+    print(f"collected {n} events -> {store_dir}")
+    url = opts.get("-upload") or conf.get("failmon.upload.url")
+    if url:
+        dest = store.upload(url)
+        print(f"uploaded -> {dest}" if dest else "nothing to upload")
+    return 0
+
+
 def cmd_gridmix(conf, argv: list[str]) -> int:
     from tpumr.benchmarks.gridmix import main as gridmix_main
     return gridmix_main(argv)
@@ -513,6 +557,7 @@ COMMANDS = {
     "pipes": cmd_pipes,
     "streaming": cmd_streaming,
     "distcp": cmd_distcp,
+    "failmon": cmd_failmon,
     "gridmix": cmd_gridmix,
     "archive": cmd_archive,
     "rumen": cmd_rumen,

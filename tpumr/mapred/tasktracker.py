@@ -161,6 +161,8 @@ class NodeRunner:
         # and the methods themselves pin the scope to the job argument
         self._job_tokens: dict[str, bytes] = {}
         self._job_token_misses: dict[str, float] = {}  # scope -> retry-at
+        self._miss_budget = 20.0            # token bucket for miss lookups
+        self._miss_budget_ts = time.time()
         self._server.token_resolver = self._job_token_or_none
         self._server.scoped_methods = {
             "get_protocol_version", "umbilical_ping", "umbilical_status",
@@ -451,21 +453,32 @@ class NodeRunner:
         """Token resolver for the RPC server: serve scoped callers of any
         job this tracker knows (it may be the shuffle SOURCE for a job
         whose reduce child runs elsewhere — resolve via the master on
-        cache miss rather than rejecting). Misses are negatively cached
-        so a flood of bogus scopes cannot amplify into tracker→master
-        RPC traffic."""
+        cache miss rather than rejecting). Unresolved scopes are
+        negatively cached AND master lookups for unknown scopes are
+        globally rate-limited, so a flood of unique bogus scopes (each a
+        guaranteed cache miss) cannot amplify into unbounded
+        tracker→master RPC traffic or memory growth."""
         now = time.time()
         with self.lock:
             if self._job_token_misses.get(scope, 0) > now:
                 return None
+            if scope not in self._job_tokens:
+                # token-bucket on miss lookups: ~4/s sustained, burst 20
+                self._miss_budget = min(
+                    20.0, self._miss_budget
+                    + (now - self._miss_budget_ts) * 4.0)
+                self._miss_budget_ts = now
+                if self._miss_budget < 1.0:
+                    return None
+                self._miss_budget -= 1.0
         try:
             return self._job_token(scope) or None
         except Exception:  # noqa: BLE001 — unknown job / master down
             with self.lock:
-                if len(self._job_token_misses) > 1024:
-                    self._job_token_misses = {
-                        k: v for k, v in self._job_token_misses.items()
-                        if v > now}
+                while len(self._job_token_misses) >= 1024:
+                    # hard cap: evict oldest entries (insertion order)
+                    self._job_token_misses.pop(
+                        next(iter(self._job_token_misses)))
                 self._job_token_misses[scope] = now + 30.0
             return None
 

@@ -1,0 +1,280 @@
+"""Failure/condition monitoring — the "failmon" tier.
+
+≈ ``src/contrib/failmon`` (reference: contrib/failmon/*.java — monitor
+jobs (CPUParser, SystemLogParser, HadoopLogParser, SMARTParser…) produce
+``EventRecord``s into a ``LocalStore`` whose contents are periodically
+uploaded to HDFS and merged for offline failure analysis; ``RunOnce`` /
+``Continuous`` drive collection, ``Anonymizer`` scrubs identities).
+
+The tpumr analog keeps the same pipeline with 2026-era sources: each
+monitor snapshots one node dimension into an event record; records
+append to a local JSONL store; ``upload`` rotates the store into any
+FileSystem URL (one file per host per rotation); ``merge`` concatenates
+every host's uploads into one dataset for analysis (rumen/vaidya-style
+post-processing). Log monitors keep a persistent byte offset so each
+scan reports only NEW error lines (the reference's PersistentState
+role). Hostname anonymization is a stable hash, matching the
+Anonymizer's intent.
+
+CLI::
+
+    tpumr failmon -collect [-store DIR] [-upload URL] [-anonymize]
+    tpumr failmon -merge URL DEST_FILE
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import socket
+import time
+from typing import Any, Iterable
+
+_ERROR_PAT = re.compile(
+    r"error|fail|fatal|panic|oops|traceback|segfault|corrupt", re.I)
+
+
+def _hostname(anonymize: bool) -> str:
+    name = socket.gethostname()
+    if anonymize:
+        return "host-" + hashlib.sha256(name.encode()).hexdigest()[:12]
+    return name
+
+
+def event(source: str, kind: str, **fields: Any) -> dict:
+    """One EventRecord ≈ contrib/failmon EventRecord: self-describing,
+    timestamped, host-stamped (host filled at store time)."""
+    return {"ts": time.time(), "source": source, "kind": kind, **fields}
+
+
+# ------------------------------------------------------------------ monitors
+
+
+class Monitor:
+    """One monitored dimension ≈ the Monitored interface."""
+
+    name = ""
+
+    def poll(self, state: dict) -> "Iterable[dict]":
+        raise NotImplementedError
+
+
+class CpuMonitor(Monitor):
+    """Load + core count ≈ CPUParser."""
+
+    name = "cpu"
+
+    def poll(self, state: dict) -> "Iterable[dict]":
+        la1, la5, la15 = os.getloadavg()
+        yield event(self.name, "load", load1=la1, load5=la5, load15=la15,
+                    cores=os.cpu_count() or 1)
+
+
+class MemoryMonitor(Monitor):
+    """/proc/meminfo snapshot (total/available/swap)."""
+
+    name = "memory"
+
+    def poll(self, state: dict) -> "Iterable[dict]":
+        try:
+            with open("/proc/meminfo") as f:
+                info = {}
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    parts = rest.split()
+                    if parts:
+                        info[k] = int(parts[0])  # kB
+        except OSError:
+            return
+        yield event(self.name, "meminfo",
+                    total_kb=info.get("MemTotal", 0),
+                    available_kb=info.get("MemAvailable", 0),
+                    swap_free_kb=info.get("SwapFree", 0))
+
+
+class DiskMonitor(Monitor):
+    """Capacity/usage of the monitored paths ≈ the df/SMART role (smartctl
+    isn't assumed present; a full SMART parser plugs in as another
+    Monitor)."""
+
+    name = "disk"
+
+    def __init__(self, paths: "list[str] | None" = None) -> None:
+        self.paths = paths or ["/"]
+
+    def poll(self, state: dict) -> "Iterable[dict]":
+        import shutil
+        for p in self.paths:
+            try:
+                u = shutil.disk_usage(p)
+            except OSError as e:
+                yield event(self.name, "probe-failed", path=p, error=str(e))
+                continue
+            yield event(self.name, "usage", path=p, total=u.total,
+                        used=u.used, free=u.free,
+                        pct_used=round(100.0 * u.used / max(1, u.total), 1))
+
+
+class LogMonitor(Monitor):
+    """Error-line scanner over one log file ≈ SystemLogParser /
+    HadoopLogParser: persistent byte offset per file, so each poll emits
+    only lines that appeared since the previous poll. A truncated/rotated
+    file (size < saved offset) rescans from the start."""
+
+    name = "log"
+
+    def __init__(self, path: str, pattern: "re.Pattern[str]" = _ERROR_PAT,
+                 max_events: int = 100) -> None:
+        self.path = path
+        self.pattern = pattern
+        self.max_events = max_events
+
+    def poll(self, state: dict) -> "Iterable[dict]":
+        key = f"log.offset:{self.path}"
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        offset = int(state.get(key, 0))
+        if size < offset:
+            offset = 0  # rotated
+        emitted = 0
+        # binary + manual offset accounting: text iteration disables
+        # tell(), and the offset MUST advance past scanned lines even
+        # when max_events truncates the pass (otherwise every later pass
+        # re-emits the same lines forever)
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            while emitted < self.max_events:
+                line = f.readline()
+                if not line:
+                    break
+                offset += len(line)
+                text = line.decode("utf-8", errors="replace")
+                if self.pattern.search(text):
+                    emitted += 1
+                    yield event(self.name, "error-line", file=self.path,
+                                line=text.rstrip()[:500])
+            state[key] = offset
+
+
+# ------------------------------------------------------------------ store
+
+
+class LocalStore:
+    """Append-only local JSONL event store ≈ contrib/failmon LocalStore,
+    with ``upload`` as the rotate-to-cluster step."""
+
+    STATE_FILE = "failmon.state.json"
+    EVENTS_FILE = "failmon.events.jsonl"
+
+    def __init__(self, store_dir: str, anonymize: bool = False) -> None:
+        self.dir = store_dir
+        self.host = _hostname(anonymize)
+        os.makedirs(store_dir, exist_ok=True)
+        self._state_path = os.path.join(store_dir, self.STATE_FILE)
+        self._events_path = os.path.join(store_dir, self.EVENTS_FILE)
+
+    def load_state(self) -> dict:
+        try:
+            with open(self._state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def save_state(self, state: dict) -> None:
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self._state_path)
+
+    def append(self, events: "Iterable[dict]") -> int:
+        n = 0
+        with open(self._events_path, "a") as f:
+            for ev in events:
+                ev.setdefault("host", self.host)
+                f.write(json.dumps(ev) + "\n")
+                n += 1
+        return n
+
+    def upload(self, url: str) -> "str | None":
+        """Rotate the local store into ``url`` (any FileSystem scheme) as
+        one per-host-per-rotation file; returns the destination path or
+        None when there is nothing to ship. The rotation is an atomic
+        rename FIRST, so events appended concurrently (an overlapping
+        collect pass) land in the fresh file instead of being deleted
+        with the shipped one."""
+        from tpumr.fs import get_filesystem
+        stamp = int(time.time() * 1000)
+        rotated = f"{self._events_path}.shipping.{stamp}"
+        try:
+            os.rename(self._events_path, rotated)
+        except OSError:
+            return None  # nothing collected yet
+        with open(rotated, "rb") as f:
+            data = f.read()
+        if not data:
+            os.remove(rotated)
+            return None
+        try:
+            fs = get_filesystem(url)
+            dest = url.rstrip("/") + f"/{self.host}-{stamp}.jsonl"
+            fs.write_bytes(dest, data)
+        except Exception:
+            # failed ship: fold the rotated events back so a retry (or
+            # the next upload) still carries them
+            with open(self._events_path, "ab") as f:
+                f.write(data)
+            os.remove(rotated)
+            raise
+        os.remove(rotated)
+        return dest
+
+
+def default_monitors(conf: Any = None) -> "list[Monitor]":
+    paths = ["/"]
+    logs: list[str] = []
+    if conf is not None:
+        paths = list(conf.get_strings("failmon.disk.paths") or ["/"])
+        logs = list(conf.get_strings("failmon.log.files") or [])
+    mons: "list[Monitor]" = [CpuMonitor(), MemoryMonitor(),
+                             DiskMonitor(paths)]
+    mons.extend(LogMonitor(p) for p in logs)
+    return mons
+
+
+def run_once(store: LocalStore, monitors: "list[Monitor]") -> int:
+    """One collection pass ≈ RunOnce: poll every monitor, append events,
+    persist monitor state (log offsets). Returns events appended."""
+    state = store.load_state()
+    total = 0
+    for mon in monitors:
+        try:
+            total += store.append(mon.poll(state))
+        except Exception as e:  # noqa: BLE001 — one bad monitor must not
+            total += store.append([event(mon.name, "monitor-failed",
+                                         error=str(e))])  # kill the pass
+    store.save_state(state)
+    return total
+
+
+def merge(url: str, dest: str) -> int:
+    """Concatenate every uploaded per-host file under ``url`` into one
+    time-ordered JSONL dataset at ``dest`` ≈ the offline merge step.
+    Returns the record count."""
+    from tpumr.fs import get_filesystem
+    fs = get_filesystem(url)
+    records: "list[dict]" = []
+    for st in fs.list_files(url):
+        if not str(st.path).endswith(".jsonl"):
+            continue
+        for line in fs.read_bytes(st.path).decode().splitlines():
+            if line.strip():
+                records.append(json.loads(line))
+    records.sort(key=lambda r: r.get("ts", 0))
+    out = "\n".join(json.dumps(r) for r in records)
+    get_filesystem(dest).write_bytes(dest, (out + "\n").encode()
+                                     if out else b"")
+    return len(records)
