@@ -238,6 +238,60 @@ def bench_pi(rows: dict) -> None:
     rows["pi_samples"] = samples
 
 
+# ---------------------------------------------------------------- matmul
+
+
+def bench_matmul(rows: dict) -> None:
+    """Blocked C = A @ B as a map-only job (BASELINE workload 4): each
+    map owns a row block of A, B rides as a side file, C blocks leave
+    through SequenceFile outputs."""
+    from tpumr.mapred.input_formats import DenseInputFormat
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.local_runner import run_job
+    from tpumr.mapred.output_formats import SequenceFileOutputFormat
+    from tpumr.ops.matmul import clear_b_cache
+
+    n = 1024 if SMALL else 4096
+    work = tempfile.mkdtemp(prefix="tpumr-bench-mm-")
+    rng = np.random.default_rng(2)
+    np.save(os.path.join(work, "a.npy"),
+            rng.normal(size=(n, n)).astype(np.float32))
+    np.save(os.path.join(work, "b.npy"),
+            rng.normal(size=(n, n)).astype(np.float32))
+
+    def run(mode: str) -> float:
+        clear_b_cache()
+        conf = JobConf()
+        conf.set_job_name(f"bench-matmul-{mode}")
+        conf.set_input_paths(f"file://{work}/a.npy")
+        conf.set_output_path(f"file://{work}/out-{mode}-{time.time_ns()}")
+        conf.set_input_format(DenseInputFormat)
+        conf.set("tpumr.dense.split.rows", n // 4)
+        conf.set("tpumr.matmul.b", f"file://{work}/b.npy")
+        conf.set_map_kernel("matmul-block")
+        conf.set("mapred.mapper.class", "tpumr.ops.matmul.MatmulCpuMapper")
+        conf.set_output_format(SequenceFileOutputFormat)
+        conf.set_num_reduce_tasks(0)
+        if mode == "tpu":
+            conf.set("tpumr.local.run.on.tpu", True)
+        t0 = time.time()
+        assert run_job(conf).successful
+        return time.time() - t0
+
+    t_tpu_cold = run("tpu")
+    t_tpu = run("tpu")        # compile cached
+    t_cpu = run("cpu")
+    flops = 2 * n ** 3
+    log(f"[matmul] {n}x{n} @ {n}x{n} full job: tpu {t_tpu:.2f}s warm "
+        f"({flops / t_tpu / 1e12:.2f} TFLOP/s incl. job machinery, cold "
+        f"{t_tpu_cold:.2f}s), cpu-batch {t_cpu:.2f}s -> "
+        f"{t_cpu / t_tpu:.1f}x")
+    rows["matmul_n"] = n
+    rows["matmul_tpu_job_s"] = round(t_tpu, 3)
+    rows["matmul_tpu_cold_job_s"] = round(t_tpu_cold, 3)
+    rows["matmul_cpu_batch_job_s"] = round(t_cpu, 3)
+
+
 # -------------------------------------------------------------- terasort
 
 
@@ -285,7 +339,7 @@ def main() -> None:
 
     rows: dict = {}
     t_cpu, t_warm = bench_kmeans(rows)
-    for fn in (bench_wordcount, bench_pi, bench_terasort):
+    for fn in (bench_wordcount, bench_pi, bench_matmul, bench_terasort):
         # workloads run in ONE process here; in production each job owns
         # its runner. Drop the previous workload's HBM split cache so a
         # 6.4 GB resident K-Means dataset doesn't starve the terasort

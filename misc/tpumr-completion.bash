@@ -69,4 +69,3 @@ sort terasort teragen teravalidate join secondarysort sleep randomwriter" \
 }
 
 complete -F _tpumr_complete tpumr
-complete -F _tpumr_complete "python -m tpumr.cli" 2>/dev/null || true

@@ -139,3 +139,40 @@ def test_distributed_kmeans_step_matches_single_device(mesh):
                       cents)
     np.testing.assert_allclose(np.asarray(new_c), expect, rtol=1e-5)
     np.testing.assert_array_equal(np.asarray(counts), np.asarray(cnt))
+
+
+def test_multihost_spec_and_single_host_noop(monkeypatch):
+    """Multi-host bring-up: conf keys beat env, nothing-configured is a
+    single-host no-op whose global mesh covers the local devices."""
+    import jax
+
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.parallel import multihost
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    assert multihost.distributed_spec(None) is None
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "envhost:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    spec = multihost.distributed_spec(None)
+    assert spec == {"coordinator_address": "envhost:1234",
+                    "num_processes": 4, "process_id": 2}
+
+    conf = JobConf()
+    conf.set("tpumr.distributed.coordinator", "confhost:9")
+    conf.set("tpumr.distributed.num.processes", 8)
+    spec = multihost.distributed_spec(conf)
+    assert spec["coordinator_address"] == "confhost:9"   # conf wins
+    assert spec["num_processes"] == 8
+    assert spec["process_id"] == 2                       # env fallback
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS")
+    monkeypatch.delenv("JAX_NUM_PROCESSES")
+    monkeypatch.delenv("JAX_PROCESS_ID")
+    assert multihost.ensure_initialized(None) is False   # no-op path
+    mesh = multihost.global_mesh(None)
+    assert mesh.devices.size == len(jax.devices())
+    assert multihost.process_info() == (0, 1)

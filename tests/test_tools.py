@@ -220,6 +220,30 @@ class TestFailmon:
         assert second[0]["line"] == "ERROR e100"
         assert list(mon.poll(state)) == []
 
+    def test_log_monitor_waits_for_complete_lines(self, tmp_path):
+        """A partial trailing line (writer mid-append) is neither emitted
+        nor skipped — the next poll sees it whole."""
+        from tpumr.tools import failmon
+        log = tmp_path / "p.log"
+        log.write_bytes(b"ERROR one\nERR")  # append in progress
+        mon = failmon.LogMonitor(str(log))
+        state: dict = {}
+        first = list(mon.poll(state))
+        assert [e["line"] for e in first] == ["ERROR one"]
+        with open(log, "ab") as f:
+            f.write(b"OR two\n")
+        second = list(mon.poll(state))
+        assert [e["line"] for e in second] == ["ERROR two"]
+
+    def test_merge_never_remears_its_own_output(self, tmp_path):
+        from tpumr.tools import failmon
+        store = failmon.LocalStore(str(tmp_path / "s4"))
+        store.append([failmon.event("t", "x"), failmon.event("t", "y")])
+        assert store.upload("mem:///fm3") is not None
+        dest = "mem:///fm3/all.jsonl"
+        assert failmon.merge("mem:///fm3", dest) == 2
+        assert failmon.merge("mem:///fm3", dest) == 2  # idempotent rerun
+
     def test_upload_failure_keeps_events(self, tmp_path):
         from tpumr.tools import failmon
         store = failmon.LocalStore(str(tmp_path / "s3"))

@@ -81,5 +81,13 @@ class MatmulBlockKernel(KernelMapper):
     def map_batch_drain(self, fetched, conf, task) -> Iterable[tuple]:
         yield (int(fetched["row0"]), np.asarray(fetched["c"]))
 
+    def map_batch_cpu(self, batch, conf, task) -> Iterable[tuple]:
+        """Vectorized host twin (BLAS) — CPU slots do the whole block in
+        one gemm, keeping the hybrid comparison batch-vs-batch."""
+        b = _load_b(conf)
+        c = np.asarray(batch.values, np.float32) @ np.asarray(b, np.float32)
+        row0 = int(batch.ids[0]) if batch.ids is not None else 0
+        yield (row0, c)
+
 
 register_kernel(MatmulBlockKernel())

@@ -22,6 +22,7 @@ hybrid scheduler (tpumr.mapred.scheduler) + these device paths.
 from tpumr.parallel.mesh import (
     make_mesh, shard_over, replicate, local_device_count,
 )
+from tpumr.parallel.multihost import ensure_initialized, global_mesh
 from tpumr.parallel.shuffle import shuffle_dense, ShuffleResult
 from tpumr.parallel.seqmap import sequence_parallel_map, ring_pass
 

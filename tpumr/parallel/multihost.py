@@ -1,0 +1,97 @@
+"""Multi-host (DCN) bring-up for the device data plane.
+
+The reference scales across hosts with its TCP control plane plus
+HTTP shuffle (SURVEY.md §5 'distributed communication backend'); the TPU
+analog keeps the host RPC control plane (tpumr.ipc) and moves the data
+plane onto XLA collectives, which ride ICI within a slice and DCN across
+slices once every participating process has joined one
+``jax.distributed`` job. This module is that bring-up: resolve the
+coordinator + process identity from job conf (or the TPU pod
+environment), initialize exactly once, and hand back the GLOBAL mesh
+that makes ``tpumr.parallel`` collectives span hosts.
+
+Conf keys (env fallbacks in parentheses — the standard JAX ones):
+
+- ``tpumr.distributed.coordinator``   host:port of process 0
+  (JAX_COORDINATOR_ADDRESS)
+- ``tpumr.distributed.num.processes`` world size (JAX_NUM_PROCESSES)
+- ``tpumr.distributed.process.id``    this process's rank (JAX_PROCESS_ID)
+
+On a Cloud TPU pod slice all three resolve automatically from the TPU
+metadata and ``initialize()`` may be called with no configuration at
+all — ``ensure_initialized`` passes through whatever is known.
+
+Single-host (or unset) configurations are a no-op: ``global_mesh`` then
+equals the local mesh, so every caller can use this module
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+_lock = threading.Lock()
+_initialized = False
+
+
+def distributed_spec(conf: Any = None) -> "dict | None":
+    """The (coordinator, num_processes, process_id) triple from conf/env,
+    or None when nothing multi-host is configured."""
+    import os
+
+    def get(key: str, env: str) -> "str | None":
+        v = conf.get(key) if conf is not None else None
+        return str(v) if v not in (None, "") else os.environ.get(env)
+
+    coord = get("tpumr.distributed.coordinator", "JAX_COORDINATOR_ADDRESS")
+    nproc = get("tpumr.distributed.num.processes", "JAX_NUM_PROCESSES")
+    pid = get("tpumr.distributed.process.id", "JAX_PROCESS_ID")
+    if coord is None and nproc is None and pid is None:
+        return None
+    spec: dict = {}
+    if coord is not None:
+        spec["coordinator_address"] = coord
+    if nproc is not None:
+        spec["num_processes"] = int(nproc)
+    if pid is not None:
+        spec["process_id"] = int(pid)
+    return spec
+
+
+def ensure_initialized(conf: Any = None) -> bool:
+    """Join the jax.distributed job exactly once per process. Returns
+    True when running multi-host (after a successful join), False for
+    the single-host no-op. Idempotent and thread-safe; raising callers
+    see the real jax.distributed error (mis-set ranks must fail loudly,
+    not degrade to a wrong-sized mesh)."""
+    global _initialized
+    with _lock:
+        if _initialized:
+            return True
+        spec = distributed_spec(conf)
+        if spec is None:
+            return False
+        import jax
+        jax.distributed.initialize(**spec)
+        _initialized = True
+        return True
+
+
+def global_mesh(conf: Any = None, axis_names=("data",), shape=None):
+    """The mesh over EVERY chip of the (possibly multi-host) job: the
+    object that makes ``tpumr.parallel`` collectives (psum, all_to_all,
+    ring permute) span DCN. Falls back to the local mesh on single-host
+    setups, so callers need no branches."""
+    import jax
+
+    from tpumr.parallel.mesh import make_mesh
+    ensure_initialized(conf)
+    return make_mesh(axis_names=axis_names, shape=shape,
+                     devices=list(jax.devices()))
+
+
+def process_info() -> "tuple[int, int]":
+    """(process_index, process_count) of this host in the job."""
+    import jax
+    return jax.process_index(), jax.process_count()

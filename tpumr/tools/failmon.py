@@ -149,7 +149,10 @@ class LogMonitor(Monitor):
             f.seek(offset)
             while emitted < self.max_events:
                 line = f.readline()
-                if not line:
+                if not line.endswith(b"\n"):
+                    # partial trailing line (a writer mid-append): leave
+                    # the offset BEFORE it so the next poll scans the
+                    # complete line — advancing would fragment or lose it
                     break
                 offset += len(line)
                 text = line.decode("utf-8", errors="replace")
@@ -267,9 +270,12 @@ def merge(url: str, dest: str) -> int:
     from tpumr.fs import get_filesystem
     fs = get_filesystem(url)
     records: "list[dict]" = []
+    dest_tail = dest.split("://", 1)[-1]
     for st in fs.list_files(url):
         if not str(st.path).endswith(".jsonl"):
             continue
+        if str(st.path).split("://", 1)[-1] == dest_tail:
+            continue  # a previous merge output under url: never re-merge
         for line in fs.read_bytes(st.path).decode().splitlines():
             if line.strip():
                 records.append(json.loads(line))
