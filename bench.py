@@ -269,7 +269,6 @@ def bench_matmul(rows: dict) -> None:
         conf.set("tpumr.dense.split.rows", n // 4)
         conf.set("tpumr.matmul.b", f"file://{work}/b.npy")
         conf.set_map_kernel("matmul-block")
-        conf.set("mapred.mapper.class", "tpumr.ops.matmul.MatmulCpuMapper")
         conf.set_output_format(SequenceFileOutputFormat)
         conf.set_num_reduce_tasks(0)
         if mode == "tpu":
@@ -329,6 +328,111 @@ def bench_terasort(rows: dict) -> None:
     rows["terasort_records"] = n
 
 
+# ---------------------------------------------------------------- hybrid
+
+
+def bench_hybrid(rows: dict) -> None:
+    """The heart of the reference, measured end-to-end: the profiling
+    hybrid scheduler (Shirahata) runs each job's maps on BOTH pools,
+    measures per-backend mean runtimes, and skews placement by the
+    acceleration factor. On this harness kmeans (compute-heavy, tiny
+    map outputs) measures accel >> 1 and lands mostly on the TPU pool;
+    blocked matmul ships its full N^2 output back over the tunnel
+    (bandwidth-bound), measures accel < 1, and the CPU pool carries it —
+    the hybrid premise working in both directions."""
+    from tpumr.core.counters import BackendCounter
+    from tpumr.mapred.input_formats import DenseInputFormat
+    from tpumr.mapred.job_client import JobClient
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+    from tpumr.mapred.output_formats import SequenceFileOutputFormat
+    from tpumr.ops.kmeans import clear_centroid_cache
+    from tpumr.ops.matmul import clear_b_cache
+
+    work = tempfile.mkdtemp(prefix="tpumr-bench-hybrid-")
+    rng = np.random.default_rng(4)
+    # split sizes MATCH the earlier kmeans/matmul workloads so their XLA
+    # compiles are reused — the per-backend means then measure steady-
+    # state task runtimes, not one first-task compile (the reference's
+    # mean-over-all-attempts profiling has the same cold-start skew)
+    n_km, d, k = (2_000_000 if SMALL else 32_000_000), 16, 16
+    np.save(os.path.join(work, "cents.npy"),
+            rng.normal(size=(k, d)).astype(np.float32))
+    out = open(os.path.join(work, "points.npy"), "wb")
+    header = np.lib.format.header_data_from_array_1_0(
+        np.empty((0, d), np.float32))
+    header["shape"] = (n_km, d)
+    np.lib.format.write_array_header_1_0(out, header)
+    for lo in range(0, n_km, 2_000_000):
+        m = min(2_000_000, n_km - lo)
+        out.write(rng.normal(size=(m, d)).astype(np.float32).tobytes())
+    out.close()
+    n_mm = 1024 if SMALL else 4096
+    np.save(os.path.join(work, "a.npy"),
+            rng.normal(size=(n_mm, n_mm)).astype(np.float32))
+    np.save(os.path.join(work, "b.npy"),
+            rng.normal(size=(n_mm, n_mm)).astype(np.float32))
+
+    def run_and_profile(c, conf, tag, out_suffix=""):
+        clear_centroid_cache()
+        clear_b_cache()
+        if out_suffix:
+            conf.set_output_path(conf.get("mapred.output.dir") + out_suffix)
+        t0 = time.time()
+        result = JobClient(conf).run_job(conf)
+        dt = time.time() - t0
+        assert result.successful, f"hybrid {tag} failed: {result.error}"
+        jip = c.master.jobs.get(str(result.job_id))
+        accel = jip.acceleration_factor() if jip is not None else 0.0
+        tpu = result.counters.value(BackendCounter.GROUP,
+                                    BackendCounter.TPU_MAP_TASKS)
+        cpu = result.counters.value(BackendCounter.GROUP,
+                                    BackendCounter.CPU_MAP_TASKS)
+        log(f"[hybrid] {tag}: accel factor {accel:.2f}, placement "
+            f"tpu={tpu} cpu={cpu}, job {dt:.2f}s")
+        rows[f"hybrid_{tag}_accel"] = round(accel, 3)
+        rows[f"hybrid_{tag}_tpu_maps"] = tpu
+        rows[f"hybrid_{tag}_cpu_maps"] = cpu
+
+    # the reference's shipped config: 3 CPU + 1 accelerator map slot
+    # (conf/mapred-site.xml:23-33), optional scheduling on
+    base = JobConf()
+    base.set("mapred.jobtracker.map.optionalscheduling", True)
+    with MiniMRCluster(num_trackers=2, cpu_slots=3, tpu_slots=1,
+                       conf=base) as c:
+        conf = c.create_job_conf()
+        conf.set_job_name("hybrid-kmeans")
+        conf.set_input_paths(f"file://{work}/points.npy")
+        conf.set_output_path(f"file://{work}/out-km")
+        conf.set_input_format(DenseInputFormat)
+        conf.set("tpumr.dense.split.rows", 4_000_000 if not SMALL
+                 else 500_000)
+        conf.set("tpumr.kmeans.centroids", f"file://{work}/cents.npy")
+        conf.set_map_kernel("kmeans-assign")
+        conf.set("mapred.reducer.class",
+                 "tpumr.examples.basic.CentroidReducer")
+        conf.set_num_reduce_tasks(1)
+        # round 1 pays cold staging per TPU task (a single-pass job is
+        # upload-bound on a tunneled chip); round 2 of the ITERATIVE
+        # workload hits the HBM split cache, the measured accel factor
+        # flips above 1, and optional scheduling converges placement to
+        # the TPU pool — the Shirahata loop closing in both directions
+        run_and_profile(c, conf, "kmeans_round1")
+        run_and_profile(c, conf, "kmeans_round2", out_suffix="-r2")
+
+        conf = c.create_job_conf()
+        conf.set_job_name("hybrid-matmul")
+        conf.set_input_paths(f"file://{work}/a.npy")
+        conf.set_output_path(f"file://{work}/out-mm")
+        conf.set_input_format(DenseInputFormat)
+        conf.set("tpumr.dense.split.rows", n_mm // 4)
+        conf.set("tpumr.matmul.b", f"file://{work}/b.npy")
+        conf.set_map_kernel("matmul-block")
+        conf.set_output_format(SequenceFileOutputFormat)
+        conf.set_num_reduce_tasks(0)
+        run_and_profile(c, conf, "matmul")
+
+
 # ------------------------------------------------------------------ main
 
 
@@ -339,7 +443,8 @@ def main() -> None:
 
     rows: dict = {}
     t_cpu, t_warm = bench_kmeans(rows)
-    for fn in (bench_wordcount, bench_pi, bench_matmul, bench_terasort):
+    for fn in (bench_wordcount, bench_pi, bench_matmul, bench_terasort,
+               bench_hybrid):
         # workloads run in ONE process here; in production each job owns
         # its runner. Drop the previous workload's HBM split cache so a
         # 6.4 GB resident K-Means dataset doesn't starve the terasort

@@ -124,6 +124,22 @@ def test_sequencefile_read_batch_mixed_block_widths():
         assert got == recs
 
 
+def test_combine_input_read_batch_matches_reader():
+    conf = _conf()
+    fs = get_filesystem("mem:///")
+    for i in range(5):
+        fs.write_bytes(f"/cmb/f{i}.txt", f"file{i} a\nfile{i} b\n".encode())
+    conf.set_input_paths("mem:///cmb")
+    fmt = CombineFileInputFormat()
+    splits = fmt.get_splits(conf, 2)
+    for s in splits:
+        batch = fmt.read_batch(s, conf)
+        reader_vals = [v.encode() if isinstance(v, str) else v
+                       for _, v in fmt.get_record_reader(s, conf)]
+        assert [batch.value(i) for i in range(batch.num_records)] == \
+            reader_vals
+
+
 def test_joined_values_roundtrip():
     from tpumr.io.recordbatch import RecordBatch
     b = RecordBatch.from_values([b"alpha", b"", b"beta x", b"g"])

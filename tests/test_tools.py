@@ -235,7 +235,20 @@ class TestFailmon:
         second = list(mon.poll(state))
         assert [e["line"] for e in second] == ["ERROR two"]
 
-    def test_merge_never_remears_its_own_output(self, tmp_path):
+    def test_log_monitor_emits_dead_writers_last_gasp(self, tmp_path):
+        """An unterminated final line whose file stops growing (writer
+        died mid-write) is emitted after one grace poll — exactly once."""
+        from tpumr.tools import failmon
+        log = tmp_path / "gasp.log"
+        log.write_bytes(b"INFO ok\nERROR fatal oom")  # no trailing \n
+        mon = failmon.LogMonitor(str(log))
+        state: dict = {}
+        assert list(mon.poll(state)) == []      # grace poll: wait
+        second = list(mon.poll(state))          # size unchanged: emit
+        assert [e["line"] for e in second] == ["ERROR fatal oom"]
+        assert list(mon.poll(state)) == []      # once only
+
+    def test_merge_never_remerges_its_own_output(self, tmp_path):
         from tpumr.tools import failmon
         store = failmon.LocalStore(str(tmp_path / "s4"))
         store.append([failmon.event("t", "x"), failmon.event("t", "y")])

@@ -365,6 +365,16 @@ class CombineFileInputFormat(FileInputFormat):
 
         return gen()
 
+    def read_batch(self, split, conf):
+        """Kernel jobs over many small files: one vectorized text batch
+        per part, concatenated — no per-line Python."""
+        from tpumr.io.recordbatch import RecordBatch
+        assert isinstance(split, MultiFileSplit)
+        text = TextInputFormat()
+        return RecordBatch.concat([
+            text.read_batch(FileSplit([], path, start, length), conf)
+            for path, start, length in split.parts])
+
 
 from dataclasses import dataclass, field  # noqa: E402
 
@@ -434,10 +444,14 @@ class DenseInputFormat(InputFormat):
 
     def get_record_reader(self, split, conf, reporter=None):
         """CPU fallback path: one record per row (id, row array). The TPU
-        runner bypasses this and calls :meth:`read_batch`."""
+        runner bypasses this and calls :meth:`read_batch`. Rows are
+        copied per record: read_batch hands out a read-only view (the
+        zero-copy staging contract) but user mappers may mutate their
+        row in place."""
         batch = self.read_batch(split, conf)
         ids = batch.ids if batch.ids is not None else np.arange(len(batch))
-        return iter((int(i), row) for i, row in zip(ids, batch.values))
+        return iter((int(i), np.array(row)) for i, row in
+                    zip(ids, batch.values))
 
     def read_batch(self, split, conf):
         from tpumr.io.recordbatch import DenseBatch
@@ -446,8 +460,11 @@ class DenseInputFormat(InputFormat):
         with fs.open(split.path) as f:
             f.seek(split.data_offset + split.row_start * split.row_bytes)
             raw = f.read(split.num_rows * split.row_bytes)
+        # read-only view over the freshly-read buffer: consumers compute
+        # from it or device_put it, never mutate — copying would double
+        # the memory traffic of exactly the multi-GB staging path
         arr = np.frombuffer(raw, dtype=np.dtype(split.dtype)).reshape(
-            split.num_rows, split.cols).copy()
+            split.num_rows, split.cols)
         ids = np.arange(split.row_start, split.row_start + split.num_rows,
                         dtype=np.int64)
         return DenseBatch(arr, ids)

@@ -294,6 +294,16 @@ class NodeRunner:
                 free[st.tpu_device_id] = False
         return free
 
+    @staticmethod
+    def _fetch_batcher_stats() -> dict:
+        """Device→host transfer coalescing effectiveness (fetch_batcher):
+        fetches vs actual tunnel roundtrips — first-class observability
+        for the cost the TPU data path is designed around."""
+        from tpumr.mapred.fetch_batcher import shared_batcher
+        b = shared_batcher()
+        return {"fetches": b.fetches, "roundtrips": b.roundtrips,
+                "coalesced": b.batched}
+
     def _status_dict(self) -> dict:
         with self.lock:
             cpu, tpu, red = self._counts()
@@ -322,6 +332,7 @@ class NodeRunner:
                 "count_tpu_map_tasks": tpu,
                 "count_reduce_tasks": red,
                 "available_tpu_devices": self._available_tpu_devices(),
+                "device_fetch": self._fetch_batcher_stats(),
                 "task_statuses": statuses,
                 "rack": self.rack,
                 "healthy": (self.health.healthy

@@ -29,4 +29,5 @@ from tpumr.parallel.seqmap import sequence_parallel_map, ring_pass
 __all__ = [
     "make_mesh", "shard_over", "replicate", "local_device_count",
     "shuffle_dense", "ShuffleResult", "sequence_parallel_map", "ring_pass",
+    "ensure_initialized", "global_mesh",
 ]

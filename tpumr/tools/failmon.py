@@ -145,15 +145,33 @@ class LogMonitor(Monitor):
         # tell(), and the offset MUST advance past scanned lines even
         # when max_events truncates the pass (otherwise every later pass
         # re-emits the same lines forever)
+        tail_key = f"log.tailwait:{self.path}"
         with open(self.path, "rb") as f:
             f.seek(offset)
             while emitted < self.max_events:
                 line = f.readline()
-                if not line.endswith(b"\n"):
-                    # partial trailing line (a writer mid-append): leave
-                    # the offset BEFORE it so the next poll scans the
-                    # complete line — advancing would fragment or lose it
+                if line and not line.endswith(b"\n"):
+                    # partial trailing line: usually a writer mid-append —
+                    # leave the offset BEFORE it so the next poll scans
+                    # the complete line. But a writer that DIED mid-write
+                    # never finishes it, and that last gasp is often the
+                    # error that matters: once the file stays the same
+                    # size across two polls, emit the unterminated tail.
+                    if state.get(tail_key) == size:
+                        state.pop(tail_key, None)
+                        offset += len(line)
+                        text = line.decode("utf-8", errors="replace")
+                        if self.pattern.search(text):
+                            yield event(self.name, "error-line",
+                                        file=self.path,
+                                        line=text.rstrip()[:500])
+                    else:
+                        state[tail_key] = size
                     break
+                if not line:
+                    state.pop(tail_key, None)
+                    break
+                state.pop(tail_key, None)
                 offset += len(line)
                 text = line.decode("utf-8", errors="replace")
                 if self.pattern.search(text):
