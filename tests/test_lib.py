@@ -1,0 +1,279 @@
+"""mapred.lib helpers ≈ the reference's lib/ test coverage
+(TestKeyFieldHelper, TestChainMapReduce, TestMultipleInputs,
+TestMultipleOutputs, aggregate tests)."""
+
+import numpy as np
+import pytest
+
+from tpumr.fs import get_filesystem
+from tpumr.mapred import JobConf, Mapper, Reducer, run_job
+from tpumr.mapred.lib import (ChainMapper, ChainReducer,
+                              FieldSelectionMapReduce, InverseMapper,
+                              KeyFieldBasedComparator, MultipleInputs,
+                              MultipleOutputs, RegexMapper,
+                              TokenCountMapper, ValueAggregatorCombiner,
+                              ValueAggregatorReducer)
+
+
+class SumReducer(Reducer):
+    def reduce(self, key, values, output, reporter):
+        output.collect(key, sum(values))
+
+
+def _read(fs, path):
+    return dict(l.split("\t", 1) for l in
+                fs.read_bytes(path).decode().splitlines())
+
+
+def test_token_count_and_regex_mappers():
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/lib1/in.txt", b"aa bb aa\ncc aa\n")
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib1/in.txt")
+    conf.set_output_path("mem:///lib1/out")
+    conf.set_mapper_class(TokenCountMapper)
+    conf.set_reducer_class(SumReducer)
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    assert _read(fs, "mem:///lib1/out/part-00000") == {
+        "aa": "3", "bb": "1", "cc": "1"}
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib1/in.txt")
+    conf.set_output_path("mem:///lib1/out2")
+    conf.set_mapper_class(RegexMapper)
+    conf.set("mapred.mapper.regex", r"[abc]{2}")
+    conf.set_reducer_class(SumReducer)
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    assert _read(fs, "mem:///lib1/out2/part-00000") == {
+        "aa": "3", "bb": "1", "cc": "1"}
+
+
+def test_field_selection():
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/lib2/in.txt",
+                   b"u1\tWA\t10\tx\nu2\tOR\t20\ty\nu1\tWA\t30\tz\n")
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib2/in.txt")
+    conf.set_output_path("mem:///lib2/out")
+    conf.set_mapper_class(FieldSelectionMapReduce)
+    conf.set_reducer_class(FieldSelectionMapReduce)
+    conf.set("mapred.text.key.value.fields.spec", "0,1:2-")
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    lines = sorted(fs.read_bytes("mem:///lib2/out/part-00000")
+                   .decode().splitlines())
+    assert lines == ["u1\tWA\t10\tx", "u1\tWA\t30\tz", "u2\tOR\t20\ty"]
+
+
+def test_key_field_based_comparator():
+    from tpumr.io.writable import serialize
+    conf = JobConf()
+    conf.set("mapred.text.key.comparator.options", "-k2,2nr -k1,1")
+    cmp_ = KeyFieldBasedComparator(conf)
+    keys = ["b\t2", "a\t10", "c\t10", "a\t1"]
+    got = sorted(keys, key=lambda k: cmp_.sort_key(serialize(k)))
+    # field 2 numeric DESC, then field 1 ASC
+    assert got == ["a\t10", "c\t10", "b\t2", "a\t1"]
+
+    # sort(1) semantics: -k2 (no end) = field 2 through END of key
+    conf2 = JobConf()
+    conf2.set("mapred.text.key.comparator.options", "-k2")
+    open_end = KeyFieldBasedComparator(conf2)
+    ks = ["a\t5\ty", "b\t5\tx"]
+    got = sorted(ks, key=lambda k: open_end.sort_key(serialize(k)))
+    assert got == ["b\t5\tx", "a\t5\ty"]  # tie on f2 broken by f3
+
+    # char offsets: explicit unsupported error, never silently wrong
+    conf3 = JobConf()
+    conf3.set("mapred.text.key.comparator.options", "-k1.3,1.5")
+    with pytest.raises(ValueError, match="char offsets"):
+        KeyFieldBasedComparator(conf3)
+
+    # end-to-end: job sorted by the comparator
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/lib3/in.txt", b"b\t2\na\t10\nc\t10\na\t1\n")
+
+    class LineKeyMapper(Mapper):
+        def map(self, key, value, output, reporter):
+            v = value if isinstance(value, str) else value.decode()
+            output.collect(v, 1)
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib3/in.txt")
+    conf.set_output_path("mem:///lib3/out")
+    conf.set_mapper_class(LineKeyMapper)
+    conf.set_output_key_comparator_class(KeyFieldBasedComparator)
+    conf.set("mapred.text.key.comparator.options", "-k2,2nr -k1,1")
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    order = [l.split("\t")[0] + "\t" + l.split("\t")[1] for l in
+             fs.read_bytes("mem:///lib3/out/part-00000")
+             .decode().splitlines()]
+    assert order == ["a\t10", "c\t10", "b\t2", "a\t1"]
+
+
+
+
+class SplitMapper(Mapper):
+    def map(self, key, value, output, reporter):
+        v = value if isinstance(value, str) else value.decode()
+        a, b = v.split()
+        output.collect(a, int(b))
+
+
+class DoubleMapper(Mapper):
+    def map(self, key, value, output, reporter):
+        output.collect(key, value * 2)
+
+
+class UpperMapper(Mapper):
+    def map(self, key, value, output, reporter):
+        output.collect(str(key).upper(), value)
+
+
+class CsvMapper(Mapper):
+    def map(self, key, value, output, reporter):
+        v = value if isinstance(value, str) else value.decode()
+        k, n = v.split(",")
+        output.collect(k, int(n))
+
+
+class TsvMapper(Mapper):
+    def map(self, key, value, output, reporter):
+        v = value if isinstance(value, str) else value.decode()
+        k, n = v.split("\t")
+        output.collect(k, int(n))
+
+def test_chain_mapper_and_reducer():
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/lib4/in.txt", b"x 1\ny 2\n")
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib4/in.txt")
+    conf.set_output_path("mem:///lib4/out")
+    ChainMapper.add_mapper(conf, SplitMapper)
+    ChainMapper.add_mapper(conf, DoubleMapper)   # [MAP+]
+    ChainReducer.set_reducer(conf, SumReducer)
+    ChainReducer.add_mapper(conf, UpperMapper)   # [REDUCE MAP*]
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    assert _read(fs, "mem:///lib4/out/part-00000") == {"X": "2", "Y": "4"}
+
+
+def test_multiple_inputs_routes_by_path():
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/lib5/csv/a.txt", b"k,1\nk,2\n")
+    fs.write_bytes("/lib5/tsv/b.txt", b"k\t3\n")
+
+    conf = JobConf()
+    conf.set_output_path("mem:///lib5/out")
+    MultipleInputs.add_input_path(conf, "mem:///lib5/csv", CsvMapper)
+    MultipleInputs.add_input_path(conf, "mem:///lib5/tsv", TsvMapper)
+    conf.set_reducer_class(SumReducer)
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    assert _read(fs, "mem:///lib5/out/part-00000") == {"k": "6"}
+
+
+def test_multiple_outputs_side_files_follow_commit():
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/lib6/in.txt", b"good 1\nbad 2\ngood 3\n")
+
+    class Router(Mapper):
+        def configure(self, conf):
+            self._conf = conf
+            self._mo = None
+
+        def map(self, key, value, output, reporter):
+            if self._mo is None:
+                self._mo = MultipleOutputs(self._conf)
+            v = value if isinstance(value, str) else value.decode()
+            tag, n = v.split()
+            if tag == "bad":
+                self._mo.collector("rejected").collect(tag, n)
+            else:
+                output.collect(tag, int(n))
+
+        def close(self):
+            if self._mo is not None:
+                self._mo.close()
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib6/in.txt")
+    conf.set_output_path("mem:///lib6/out")
+    conf.set_mapper_class(Router)
+    conf.set_num_reduce_tasks(0)
+    assert run_job(conf).successful
+    names = {str(s.path.name) for s in fs.list_status("/lib6/out")}
+    assert "rejected-00000" in names, names
+    assert fs.read_bytes("mem:///lib6/out/rejected-00000") == b"bad\t2\n"
+    main = fs.read_bytes("mem:///lib6/out/part-00000").decode()
+    assert sorted(main.splitlines()) == ["good\t1", "good\t3"]
+
+    for bad_name in ("../escape", "part"):
+        with pytest.raises(ValueError, match="bad MultipleOutputs"):
+            MultipleOutputs(conf).collector(bad_name)
+
+    # map-side named outputs in a job WITH reducers commit too
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib6/in.txt")
+    conf.set_output_path("mem:///lib6/out2")
+    conf.set_mapper_class(Router)
+    conf.set_reducer_class(SumReducer)
+
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    assert fs.read_bytes("mem:///lib6/out2/rejected-00000") == b"bad\t2\n"
+    assert _read(fs, "mem:///lib6/out2/part-00000") == {"good": "4"}
+
+
+def test_aggregate_framework():
+    fs = get_filesystem("mem:///")
+    fs.write_bytes("/lib7/in.txt", b"apple 3\npear 5\napple 4\n")
+
+    class Emit(Mapper):
+        def map(self, key, value, output, reporter):
+            v = value if isinstance(value, str) else value.decode()
+            word, n = v.split()
+            output.collect(f"LongValueSum:{word}", int(n))
+            output.collect(f"LongValueMax:max-{word}", int(n))
+            output.collect("UniqValueCount:words", word)
+            output.collect("ValueHistogram:lens", len(word))
+
+    conf = JobConf()
+    conf.set_input_paths("mem:///lib7/in.txt")
+    conf.set_output_path("mem:///lib7/out")
+    conf.set_mapper_class(Emit)
+    conf.set_reducer_class(ValueAggregatorReducer)
+    conf.set_combiner_class(ValueAggregatorCombiner)
+    conf.set_num_reduce_tasks(1)
+    assert run_job(conf).successful
+    got = _read(fs, "mem:///lib7/out/part-00000")
+    assert got["apple"] == "7" and got["pear"] == "5"
+    assert got["max-apple"] == "4"
+    assert got["words"] == "2"
+    assert got["lens"] == "4:1;5:2"  # pear(4)x1, apple(5)x2
+
+
+def test_streaming_reducer_aggregate(tmp_path):
+    import stat
+    mapper = tmp_path / "map.py"
+    mapper.write_text(
+        "#!/usr/bin/env python3\nimport sys\n"
+        "for line in sys.stdin:\n"
+        "    w = line.split()[0]\n"
+        "    print(f'LongValueSum:{w}\\t1')\n")
+    mapper.chmod(mapper.stat().st_mode | stat.S_IXUSR)
+    src = tmp_path / "in.txt"
+    src.write_text("dog x\ncat y\ndog z\n")
+    from tpumr.cli import main as cli_main
+    out = tmp_path / "out"
+    assert cli_main(["streaming", "-input", f"file://{src}",
+                     "-output", f"file://{out}",
+                     "-mapper", f"python3 {mapper}",
+                     "-reducer", "aggregate"]) == 0
+    got = dict(l.split("\t") for l in
+               (out / "part-00000").read_text().splitlines())
+    assert got == {"dog": "2", "cat": "1"}

@@ -240,6 +240,47 @@ def test_pi_kernel_launch_drain_stays_on_device_until_fetch():
     assert 0 < out["inside"] <= 3000
 
 
+def test_pipeline_window_kernel_error_fails_job_cleanly():
+    """A kernel that raises mid-window must fail the job with the real
+    error (no hang, no partial commit)."""
+    import pytest
+
+    from tpumr.ops.registry import KernelMapper, register_kernel
+
+    class BoomKernel(KernelMapper):
+        name = "boom-on-third"
+        calls = [0]
+
+        def map_batch_launch(self, batch, conf, task):
+            self.calls[0] += 1
+            if self.calls[0] == 3:
+                raise RuntimeError("kernel exploded on split 3")
+            import jax.numpy as jnp
+            return (jnp.zeros(2),)
+
+        def map_batch_drain(self, fetched, conf, task):
+            yield 0, float(fetched[0][0])
+
+    register_kernel(BoomKernel())
+    fs = get_filesystem("mem:///")
+    pts = np.zeros((160, 2), np.float32)
+    import io as _io
+    buf = _io.BytesIO()
+    np.save(buf, pts)
+    fs.write_bytes("/bw/points.npy", buf.getvalue())
+    conf = JobConf()
+    conf.set_input_paths("mem:///bw/points.npy")
+    conf.set_output_path("mem:///bw/out")
+    conf.set_input_format(DenseInputFormat)
+    conf.set("tpumr.dense.split.rows", 40)  # 4 splits, one window
+    conf.set_map_kernel("boom-on-third")
+    conf.set_num_reduce_tasks(0)
+    conf.set("tpumr.local.run.on.tpu", True)
+    with pytest.raises(RuntimeError, match="kernel exploded"):
+        run_job(conf)
+    assert not fs.exists("mem:///bw/out/part-00000")  # nothing committed
+
+
 def test_hbm_split_cache_hit_on_second_round():
     """Iterative jobs stage each dense split once: round 2 reports zero
     newly-staged device bytes (HBM-resident split cache)."""

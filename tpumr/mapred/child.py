@@ -131,8 +131,9 @@ def run_child(task_file: str) -> int:
             out_path, index = maybe_profile(
                 conf, task, prof_dir,
                 lambda: run_map_task(conf, task, local_dir, reporter))
-            if task.num_reduces == 0:
-                committed = _commit(conf, task, can_commit)
+            # direct-output maps AND map-side named outputs in jobs with
+            # reducers; _commit no-ops when the work dir has no files
+            committed = _commit(conf, task, can_commit)
         else:
             from tpumr.mapred.reduce_task import run_reduce_task
             from tpumr.mapred.tasktracker import make_map_locator

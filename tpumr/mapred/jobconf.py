@@ -145,9 +145,12 @@ class JobConf(Configuration):
 
     def get_output_key_comparator(self) -> Any:
         from tpumr.mapred.api import DeserializingComparator
+        from tpumr.utils.reflection import new_instance
         cls = self.get_class("mapred.output.key.comparator.class",
                              DeserializingComparator)
-        return cls()
+        # configured comparators (lib.KeyFieldBasedComparator reads its
+        # -k options from conf) get the conf; plain ones ignore it
+        return new_instance(cls, self)
 
     def set_output_value_grouping_comparator(self, cls: type) -> None:
         """≈ JobConf.setOutputValueGroupingComparator — the secondary-sort
@@ -156,8 +159,11 @@ class JobConf(Configuration):
         self.set_class("mapred.output.value.groupfn.class", cls)
 
     def get_output_value_grouping_comparator(self) -> Any:
+        from tpumr.utils.reflection import new_instance
         cls = self.get_class("mapred.output.value.groupfn.class")
-        return cls() if cls is not None else None
+        # conf-configured comparators (lib.KeyFieldBasedComparator) need
+        # their options here too, same as get_output_key_comparator
+        return new_instance(cls, self) if cls is not None else None
 
     def set_map_runner_class(self, cls: type) -> None:
         """≈ JobConf.setMapRunnerClass (CPU path)."""

@@ -1,0 +1,512 @@
+"""The old-API helper library ≈ ``org.apache.hadoop.mapred.lib``.
+
+Components reproduced here (reference file in parens):
+
+- trivial mappers: :class:`InverseMapper`, :class:`TokenCountMapper`,
+  :class:`RegexMapper` (InverseMapper.java, TokenCountMapper.java,
+  RegexMapper.java);
+- :class:`FieldSelectionMapReduce` (FieldSelectionMapReduce.java) —
+  cut(1)-style field selection with the reference's spec syntax
+  ``"2,3-4:0-"`` (key fields : value fields, ``n-`` = n to end);
+- :class:`KeyFieldBasedComparator` (KeyFieldBasedComparator.java /
+  KeyFieldHelper.java) — Unix-sort ``-kPOS1[,POS2][nr]`` options over
+  separated text keys, numeric and reverse per spec;
+- :class:`ChainMapper` / :class:`ChainReducer` (Chain.java) — run a
+  pipeline of mappers inside one task, [MAP+ / REDUCE MAP*];
+- :class:`MultipleInputs` (MultipleInputs.java/DelegatingMapper.java) —
+  per-input-path mapper dispatch (the generalization the datajoin
+  contrib builds on);
+- :class:`MultipleOutputs` (MultipleOutputs.java) — named side outputs
+  written through the job's OutputFormat into the task work dir;
+- the aggregate framework (lib/aggregate/ValueAggregator*.java):
+  mappers emit ``("<TYPE>:<id>", value)`` records and
+  :class:`ValueAggregatorReducer` folds them with the named aggregator
+  (LongValueSum, DoubleValueSum, LongValueMax/Min, StringValueMax/Min,
+  UniqValueCount, ValueHistogram); streaming's ``-reducer aggregate``
+  resolves here, as the reference's does.
+
+HashPartitioner / KeyFieldBasedPartitioner / Identity* /
+TotalOrderPartitioner / NLineInputFormat / CombineFileInputFormat /
+MultithreadedMapRunner live in their runtime modules (api.py,
+total_order.py, input_formats.py, multithreaded.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Iterable
+
+from tpumr.mapred.api import Mapper, OutputCollector, Reducer
+from tpumr.utils.reflection import (class_name, new_instance,
+                                    resolve_class)
+
+
+class InverseMapper(Mapper):
+    """(k, v) → (v, k) ≈ lib/InverseMapper.java."""
+
+    def map(self, key, value, output, reporter):
+        output.collect(value, key)
+
+
+class TokenCountMapper(Mapper):
+    """(_, text) → (token, 1) per whitespace token ≈ TokenCountMapper."""
+
+    def map(self, key, value, output, reporter):
+        text = value.decode("utf-8", "replace") \
+            if isinstance(value, (bytes, bytearray)) else str(value)
+        for tok in text.split():
+            output.collect(tok, 1)
+
+
+class RegexMapper(Mapper):
+    """(_, text) → (match_group, 1) ≈ lib/RegexMapper.java; conf keys
+    ``mapred.mapper.regex`` and ``mapred.mapper.regex.group``."""
+
+    def configure(self, conf) -> None:
+        self._re = re.compile(conf.get("mapred.mapper.regex", ""))
+        self._group = conf.get_int("mapred.mapper.regex.group", 0)
+
+    def map(self, key, value, output, reporter):
+        text = value.decode("utf-8", "replace") \
+            if isinstance(value, (bytes, bytearray)) else str(value)
+        for m in self._re.finditer(text):
+            output.collect(m.group(self._group), 1)
+
+
+# ------------------------------------------------------- field selection
+
+
+def _parse_field_spec(spec: str) -> "list[tuple[int, int | None]]":
+    """"2,3-4,6-" → [(2,2),(3,4),(6,None)] (None = to the last field)."""
+    out: "list[tuple[int, int | None]]" = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        lo, sep, hi = part.partition("-")
+        if not sep:
+            out.append((int(lo), int(lo)))
+        else:
+            out.append((int(lo), int(hi) if hi.strip() else None))
+    return out
+
+
+def _select(fields: "list[str]",
+            ranges: "list[tuple[int, int | None]]") -> "list[str]":
+    picked: "list[str]" = []
+    for lo, hi in ranges:
+        stop = len(fields) if hi is None else hi + 1
+        picked.extend(fields[lo:stop])
+    return picked
+
+
+class FieldSelectionMapReduce(Mapper, Reducer):
+    """≈ lib/FieldSelectionMapReduce.java: both phases split each record
+    on ``mapred.data.field.separator`` (default TAB) and re-emit selected
+    fields per ``mapred.text.key.value.fields.spec`` — the format is
+    ``keyFieldsSpec:valueFieldsSpec`` with 0-based fields, e.g.
+    ``"0,2:1-"``."""
+
+    def configure(self, conf) -> None:
+        self._sep = str(conf.get("mapred.data.field.separator", "\t"))
+        spec = str(conf.get("mapred.text.key.value.fields.spec", "0:1-"))
+        key_spec, _, val_spec = spec.partition(":")
+        self._key_ranges = _parse_field_spec(key_spec)
+        self._val_ranges = _parse_field_spec(val_spec)
+
+    def _split(self, value) -> "list[str]":
+        text = value.decode("utf-8", "replace") \
+            if isinstance(value, (bytes, bytearray)) else str(value)
+        return text.split(self._sep)
+
+    def map(self, key, value, output, reporter):
+        fields = self._split(value)
+        output.collect(self._sep.join(_select(fields, self._key_ranges)),
+                       self._sep.join(_select(fields, self._val_ranges)))
+
+    def reduce(self, key, values, output, reporter):
+        for v in values:
+            output.collect(key, v)
+
+
+# ---------------------------------------------------- key-field comparator
+
+
+_KEY_OPT = re.compile(r"-k\s*(\d+)(?:\.(\d+))?(?:,(\d+)(?:\.(\d+))?)?([nr]*)")
+
+
+@functools.total_ordering
+class _SpecKey:
+    """Orderable sort key honoring per-field numeric/reverse flags."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, parts: "list[tuple[Any, bool]]") -> None:
+        self.parts = parts  # [(comparable, reverse), ...]
+
+    def __eq__(self, other) -> bool:
+        return self.parts == other.parts
+
+    def __lt__(self, other) -> bool:
+        for (a, rev), (b, _) in zip(self.parts, other.parts):
+            if a == b:
+                continue
+            return (a > b) if rev else (a < b)
+        return len(self.parts) < len(other.parts)
+
+
+class KeyFieldBasedComparator:
+    """≈ lib/KeyFieldBasedComparator.java: Unix-sort style key options
+    from ``mapred.text.key.comparator.options``, e.g. ``-k2,2nr -k1,1``
+    (1-based fields over ``map.output.key.field.separator``, default
+    TAB; ``n`` = numeric, ``r`` = reverse). Plugs into the job's
+    comparator seam (JobConf.set_output_key_comparator_class)."""
+
+    def __init__(self, conf: Any = None) -> None:
+        opts, self._sep = "", "\t"
+        if conf is not None:
+            opts = str(conf.get("mapred.text.key.comparator.options", ""))
+            self._sep = str(conf.get("map.output.key.field.separator",
+                                     "\t"))
+        self._specs = []
+        for m in _KEY_OPT.finditer(opts):
+            if m.group(2) or m.group(4):
+                raise ValueError(
+                    f"char offsets in {m.group(0)!r} are not supported — "
+                    "use whole-field specs (-kPOS1[,POS2][nr])")
+            # sort(1) semantics: '-k2' = field 2 through END of key;
+            # '-k2,2' = field 2 only
+            end = int(m.group(3)) if m.group(3) else 10 ** 9
+            self._specs.append((int(m.group(1)), end,
+                                "n" in m.group(5), "r" in m.group(5)))
+        self._specs = self._specs or [(1, 10 ** 9, False, False)]
+
+    def configure(self, conf) -> None:  # JobConfigurable seam
+        self.__init__(conf)
+
+    def sort_key(self, kbytes: bytes):
+        from tpumr.io.writable import deserialize
+        key = deserialize(kbytes)
+        text = key.decode("utf-8", "replace") \
+            if isinstance(key, (bytes, bytearray)) else str(key)
+        fields = text.split(self._sep)
+        parts: "list[tuple[Any, bool]]" = []
+        for start, end, numeric, rev in self._specs:
+            sel = self._sep.join(fields[start - 1:end])
+            if numeric:
+                try:
+                    val: Any = (1, float(sel))
+                except ValueError:
+                    val = (0, 0.0)  # non-numeric sorts first, like sort -n
+                parts.append((val, rev))
+            else:
+                parts.append((sel, rev))
+        return _SpecKey(parts)
+
+
+# ----------------------------------------------------------------- chain
+
+
+def _chain_step(mapper: Mapper, downstream: Any, reporter: Any,
+                key: Any, value: Any) -> None:
+    mapper.map(key, value, downstream, reporter)
+
+
+class ChainMapper(Mapper):
+    """≈ lib/ChainMapper.java: run mappers in sequence inside one map
+    task — each mapper's collect feeds the next's map; the last one's
+    output reaches the real collector. Configure with
+    :meth:`add_mapper` or the ``tpumr.chain.mappers`` conf key (list of
+    class names)."""
+
+    CONF_KEY = "tpumr.chain.mappers"
+
+    @staticmethod
+    def add_mapper(conf: Any, mapper_cls: type) -> None:
+        chain = list(conf.get(ChainMapper.CONF_KEY) or [])
+        chain.append(class_name(mapper_cls))
+        conf.set(ChainMapper.CONF_KEY, chain)
+        conf.set_mapper_class(ChainMapper)
+
+    def configure(self, conf) -> None:
+        names = conf.get(self.CONF_KEY) or []
+        if not names:
+            raise ValueError(f"{self.CONF_KEY} is empty — add_mapper first")
+        self._chain = [new_instance(resolve_class(n), conf) for n in names]
+        self._wired: "tuple[Any, OutputCollector] | None" = None
+
+    def _first_collector(self, output, reporter) -> OutputCollector:
+        # wire the pipeline ONCE per (task, output): collectors are fixed
+        # for the task's lifetime, and map() is the per-record hot loop
+        if self._wired is None or self._wired[0] is not output:
+            nxt: Any = output
+            for mapper in reversed(self._chain[1:]):
+                nxt = OutputCollector(functools.partial(
+                    _chain_step, mapper, nxt, reporter))
+            self._wired = (output, nxt)
+        return self._wired[1]
+
+    def map(self, key, value, output, reporter):
+        self._chain[0].map(key, value,
+                           self._first_collector(output, reporter),
+                           reporter)
+
+    def close(self) -> None:
+        for m in self._chain:
+            m.close()
+
+
+class ChainReducer(Reducer):
+    """≈ lib/ChainReducer.java: one reducer, then a chain of mappers over
+    its output ([REDUCE MAP*])."""
+
+    REDUCER_KEY = "tpumr.chain.reducer"
+    MAPPERS_KEY = "tpumr.chain.reduce.mappers"
+
+    @staticmethod
+    def set_reducer(conf: Any, reducer_cls: type) -> None:
+        conf.set(ChainReducer.REDUCER_KEY, class_name(reducer_cls))
+        conf.set_reducer_class(ChainReducer)
+
+    @staticmethod
+    def add_mapper(conf: Any, mapper_cls: type) -> None:
+        chain = list(conf.get(ChainReducer.MAPPERS_KEY) or [])
+        chain.append(class_name(mapper_cls))
+        conf.set(ChainReducer.MAPPERS_KEY, chain)
+
+    def configure(self, conf) -> None:
+        name = conf.get(self.REDUCER_KEY)
+        if not name:
+            raise ValueError(f"{self.REDUCER_KEY} unset — set_reducer first")
+        self._reducer = new_instance(resolve_class(name), conf)
+        self._mappers = [new_instance(resolve_class(n), conf)
+                         for n in (conf.get(self.MAPPERS_KEY) or [])]
+        self._wired: "tuple[Any, OutputCollector] | None" = None
+
+    def reduce(self, key, values, output, reporter):
+        if self._wired is None or self._wired[0] is not output:
+            nxt: Any = output
+            for mapper in reversed(self._mappers):
+                nxt = OutputCollector(functools.partial(
+                    _chain_step, mapper, nxt, reporter))
+            self._wired = (output, nxt)
+        self._reducer.reduce(key, values, self._wired[1], reporter)
+
+    def close(self) -> None:
+        self._reducer.close()
+        for m in self._mappers:
+            m.close()
+
+
+# ------------------------------------------------------- multiple inputs
+
+
+class MultipleInputs:
+    """≈ lib/MultipleInputs.java: per-input-path mapper classes, routed
+    by the split's source path (DelegatingMapper role). Input formats
+    stay job-global (the reference's per-path InputFormat variant is
+    subsumed by path-specific jobs here — documented divergence)."""
+
+    CONF_KEY = "tpumr.multiple.inputs"
+
+    @staticmethod
+    def add_input_path(conf: Any, path: str, mapper_cls: type) -> None:
+        table = dict(conf.get(MultipleInputs.CONF_KEY) or {})
+        table[str(path).rstrip("/")] = class_name(mapper_cls)
+        conf.set(MultipleInputs.CONF_KEY, table)
+        existing = conf.get_strings("mapred.input.dir")
+        if str(path) not in existing:
+            conf.set_input_paths(*(list(existing) + [str(path)]))
+        conf.set_mapper_class(DelegatingMapper)
+
+
+class DelegatingMapper(Mapper):
+    """Routes records to the mapper registered for the split's path
+    (boundary-respecting longest-prefix match, like contrib.datajoin)."""
+
+    def configure(self, conf) -> None:
+        self._conf = conf
+        self._table = {p: resolve_class(n) for p, n in
+                       (conf.get(MultipleInputs.CONF_KEY) or {}).items()}
+        self._delegate: "Mapper | None" = None
+
+    def _resolve(self) -> Mapper:
+        if self._delegate is None:
+            path = str(self._conf.get("tpumr.task.input.path") or "")
+            best = None
+            for prefix, cls in self._table.items():
+                if (path == prefix or path.startswith(prefix + "/")) and \
+                        (best is None or len(prefix) > len(best[0])):
+                    best = (prefix, cls)
+            if best is None:
+                raise ValueError(f"no mapper registered for split path "
+                                 f"{path!r} (inputs: {sorted(self._table)})")
+            self._delegate = new_instance(best[1], self._conf)
+        return self._delegate
+
+    def map(self, key, value, output, reporter):
+        self._resolve().map(key, value, output, reporter)
+
+    def close(self) -> None:
+        if self._delegate is not None:
+            self._delegate.close()
+
+
+# ------------------------------------------------------ multiple outputs
+
+
+class MultipleOutputs:
+    """≈ lib/MultipleOutputs.java: named side outputs next to the task's
+    main output, through the job's OutputFormat and the same committer
+    work dir (so side files follow the job's two-phase commit). Usage::
+
+        mo = MultipleOutputs(conf)
+        mo.collector("errors", reporter).collect(k, v)
+        ...
+        mo.close()
+    """
+
+    def __init__(self, conf: Any) -> None:
+        self._conf = conf
+        self._writers: dict[str, Any] = {}
+
+    def _work_dir(self) -> str:
+        wd = self._conf.get("tpumr.task.work.dir")
+        if not wd:
+            raise ValueError("MultipleOutputs needs tpumr.task.work.dir "
+                             "(set by the task runtime)")
+        from tpumr.fs.filesystem import FileSystem
+        FileSystem.get(wd, self._conf).mkdirs(wd)  # lazy: only when used
+        return wd
+
+    def collector(self, name: str, reporter: Any = None) -> OutputCollector:
+        if not re.fullmatch(r"[A-Za-z0-9]+", name) or name == "part":
+            raise ValueError(f"bad MultipleOutputs name {name!r} "
+                             "(alphanumeric, not 'part' — that is the "
+                             "main output's prefix)")
+        w = self._writers.get(name)
+        if w is None:
+            out_fmt = new_instance(self._conf.get_output_format(),
+                                   self._conf)
+            part = self._conf.get_int("tpumr.task.partition", 0)
+            w = self._writers[name] = out_fmt.get_record_writer(
+                self._conf, self._work_dir(), part, prefix=name)
+        return OutputCollector(w.write)
+
+    def close(self) -> None:
+        for w in self._writers.values():
+            w.close()
+
+
+# -------------------------------------------------------------- aggregate
+
+
+class _Agg:
+    def add(self, v) -> None:
+        raise NotImplementedError
+
+    def result(self):
+        raise NotImplementedError
+
+
+class _Sum(_Agg):
+    def __init__(self, cast):
+        self.cast, self.total = cast, cast(0)
+
+    def add(self, v):
+        self.total += self.cast(v)
+
+    def result(self):
+        return self.total
+
+
+class _MinMax(_Agg):
+    def __init__(self, cast, is_max: bool):
+        self.cast, self.is_max, self.cur = cast, is_max, None
+
+    def add(self, v):
+        v = self.cast(v)
+        if self.cur is None or (v > self.cur if self.is_max else v < self.cur):
+            self.cur = v
+
+    def result(self):
+        return self.cur
+
+
+class _UniqCount(_Agg):
+    def __init__(self):
+        self.seen: set = set()
+
+    def add(self, v):
+        self.seen.add(str(v))
+
+    def result(self):
+        return len(self.seen)
+
+
+class _Histogram(_Agg):
+    def __init__(self):
+        from collections import Counter
+        self.counts: Any = Counter()
+
+    def add(self, v):
+        self.counts[str(v)] += 1
+
+    def result(self):
+        items = sorted(self.counts.items())
+        return ";".join(f"{k}:{n}" for k, n in items)
+
+
+AGGREGATORS = {
+    "LongValueSum": lambda: _Sum(int),
+    "DoubleValueSum": lambda: _Sum(float),
+    "LongValueMax": lambda: _MinMax(int, True),
+    "LongValueMin": lambda: _MinMax(int, False),
+    "StringValueMax": lambda: _MinMax(str, True),
+    "StringValueMin": lambda: _MinMax(str, False),
+    "UniqValueCount": lambda: _UniqCount(),
+    "ValueHistogram": lambda: _Histogram(),
+}
+
+
+def _agg_for(key: str) -> "tuple[_Agg, str]":
+    agg_type, sep, ident = str(key).partition(":")
+    maker = AGGREGATORS.get(agg_type)
+    if not sep or maker is None:
+        raise ValueError(
+            f"aggregate key {key!r} is not '<type>:<id>' with type in "
+            f"{sorted(AGGREGATORS)}")
+    return maker(), ident
+
+
+class ValueAggregatorReducer(Reducer):
+    """≈ lib/aggregate/ValueAggregatorReducer.java: the mapper emits
+    ``("<TYPE>:<id>", value)``; this folds each group with the named
+    aggregator and emits (id, result). Streaming's ``-reducer
+    aggregate`` resolves here."""
+
+    def reduce(self, key, values, output, reporter):
+        agg, ident = _agg_for(key)
+        for v in values:
+            agg.add(v)
+        output.collect(ident, agg.result())
+
+
+class ValueAggregatorCombiner(Reducer):
+    """Partial fold for the distributive aggregators; pass-through (key
+    kept) so the reducer still sees '<TYPE>:<id>' keys."""
+
+    DISTRIBUTIVE = {"LongValueSum", "DoubleValueSum", "LongValueMax",
+                    "LongValueMin", "StringValueMax", "StringValueMin"}
+
+    def reduce(self, key, values, output, reporter):
+        agg_type = str(key).partition(":")[0]
+        if agg_type not in self.DISTRIBUTIVE:
+            for v in values:  # uniq/histogram need every raw value
+                output.collect(key, v)
+            return
+        agg, _ = _agg_for(key)
+        for v in values:
+            agg.add(v)
+        output.collect(key, agg.result())

@@ -96,7 +96,10 @@ class LocalJobRunner:
             local_dir = f"{work_root}/map_{i:06d}"
             out = run_map_task(conf, task, local_dir, reporter)
             task.__dict__.pop("_device_prefetch", None)  # free window memory
-            if num_reduces == 0:
+            if num_reduces == 0 or \
+                    committer.needs_commit(str(task.attempt_id)):
+                # the OR arm: map-side named outputs (lib.MultipleOutputs)
+                # in jobs with reducers
                 committer.commit_task(str(task.attempt_id))
             map_outputs[i] = out
             counters.merge(reporter.counters)

@@ -243,6 +243,7 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
         from tpumr.mapred.output_formats import FileOutputCommitter
         committer = FileOutputCommitter(conf)
         wd = committer.setup_task(str(task.attempt_id))
+        conf.set("tpumr.task.work.dir", wd)  # lib.MultipleOutputs seam
         out_fmt = new_instance(conf.get_output_format(), conf)
         writer = out_fmt.get_record_writer(conf, wd, task.partition)
         collector = OutputCollector(
@@ -256,6 +257,16 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
         reporter.incr_counter(BackendCounter.GROUP, backend_ms,
                               int((time.time() - t0) * 1000))
         return "", {}
+
+    # map-side named outputs (lib.MultipleOutputs) in jobs WITH reducers
+    # write into the attempt's committer work dir; the dir is created
+    # lazily by MultipleOutputs, and commit happens through the normal
+    # gate only when files exist (FileOutputCommitter.needs_commit)
+    from tpumr.mapred.output_formats import FileOutputCommitter
+    _side_committer = FileOutputCommitter(conf)
+    if _side_committer.fs is not None:
+        conf.set("tpumr.task.work.dir",
+                 _side_committer.work_dir(str(task.attempt_id)))
 
     from tpumr.mapred.device_shuffle import is_device_shuffle
     if is_device_shuffle(conf):

@@ -34,7 +34,10 @@ class RecordWriter:
 
 class OutputFormat:
     def get_record_writer(self, conf: Any, work_dir: str,
-                          partition: int) -> RecordWriter:
+                          partition: int,
+                          prefix: str = "part") -> RecordWriter:
+        """``prefix`` names side outputs (lib.MultipleOutputs): the
+        default "part" is the job's main output stream."""
         raise NotImplementedError
 
     def check_output_specs(self, conf: Any) -> None:
@@ -74,10 +77,11 @@ class _TextWriter(RecordWriter):
 class TextOutputFormat(OutputFormat):
     """≈ org.apache.hadoop.mapred.TextOutputFormat: key<TAB>value lines."""
 
-    def get_record_writer(self, conf, work_dir, partition):
+    def get_record_writer(self, conf, work_dir, partition,
+                          prefix="part"):
         fs = FileSystem.get(work_dir, conf)
         sep = conf.get("mapred.textoutputformat.separator", "\t")
-        f = fs.create(Path(work_dir).child(part_name(partition)))
+        f = fs.create(Path(work_dir).child(part_name(partition, prefix)))
         return _TextWriter(f, sep)
 
 
@@ -100,11 +104,12 @@ class _SeqWriter(RecordWriter):
 
 
 class SequenceFileOutputFormat(OutputFormat):
-    def get_record_writer(self, conf, work_dir, partition):
+    def get_record_writer(self, conf, work_dir, partition,
+                          prefix="part"):
         fs = FileSystem.get(work_dir, conf)
         codec = conf.get("mapred.output.compression.codec", "none") \
             if conf.get_boolean("mapred.output.compress", False) else "none"
-        f = fs.create(Path(work_dir).child(part_name(partition)))
+        f = fs.create(Path(work_dir).child(part_name(partition, prefix)))
         return _SeqWriter(f, codec)
 
 
@@ -116,7 +121,7 @@ class _NullWriter(RecordWriter):
 class NullOutputFormat(OutputFormat):
     """≈ mapred/lib/NullOutputFormat.java — discards output."""
 
-    def get_record_writer(self, conf, work_dir, partition):
+    def get_record_writer(self, conf, work_dir, partition, prefix="part"):
         return _NullWriter()
 
     def check_output_specs(self, conf) -> None:

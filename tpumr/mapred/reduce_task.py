@@ -49,13 +49,14 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
     # sort phase: lazy k-way merge ≈ Merger.merge (ReduceTask.java:399-409)
     merged = ifile.merge_sorted(segments, sk)
 
-    # reduce phase
+    # reduce phase — work dir lands in conf BEFORE the reducer is
+    # configured so lib.MultipleOutputs works from configure() onward
+    committer = FileOutputCommitter(conf)
+    wd = committer.setup_task(str(task.attempt_id))
+    conf.set("tpumr.task.work.dir", wd)
     reducer_cls = conf.get_reducer_class()
     from tpumr.mapred.api import IdentityReducer
     reducer = new_instance(reducer_cls or IdentityReducer, conf)
-
-    committer = FileOutputCommitter(conf)
-    wd = committer.setup_task(str(task.attempt_id))
     out_fmt = new_instance(conf.get_output_format(), conf)
     writer = out_fmt.get_record_writer(conf, wd, task.partition)
 

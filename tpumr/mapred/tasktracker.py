@@ -600,8 +600,10 @@ class NodeRunner:
                 with self.lock:
                     if out[0]:
                         self.map_outputs[(job_id, task.partition)] = out
-                if task.num_reduces == 0:
-                    committed = self._commit(conf, task)
+                # commit covers direct-output maps AND map-side named
+                # outputs (lib.MultipleOutputs) in jobs with reducers;
+                # needs_commit makes it a no-op when no files exist
+                committed = self._commit(conf, task)
             else:
                 status.phase = TaskPhase.SHUFFLE
                 from tpumr.mapred.device_shuffle import is_device_shuffle

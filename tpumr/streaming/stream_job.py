@@ -20,7 +20,15 @@ def setup_stream_job(conf: JobConf, mapper: str | None = None,
     if mapper:
         conf.set("stream.map.command", mapper)
         conf.set_map_runner_class(StreamMapRunner)
-    if reducer:
+    if reducer == "aggregate":
+        # ≈ StreamJob's `-reducer aggregate`: the script mapper emits
+        # '<TYPE>:<id>\tvalue' lines; the framework-side aggregate
+        # reducer/combiner fold them (lib/aggregate role)
+        from tpumr.mapred.lib import (ValueAggregatorCombiner,
+                                      ValueAggregatorReducer)
+        conf.set_reducer_class(ValueAggregatorReducer)
+        conf.set_combiner_class(ValueAggregatorCombiner)
+    elif reducer:
         conf.set("stream.reduce.command", reducer)
         conf.set_reducer_class(StreamReducer)
     if combiner:
