@@ -197,3 +197,46 @@ def _assert_job_cli_lists(cluster_procs):
     assert r.returncode == 0, r.stderr
     assert "job_" in r.stdout
     assert "SUCCEEDED" in r.stdout
+
+
+def test_isolated_tasks_with_job_tokens_across_processes(cluster_procs):
+    """Process-isolated attempts over the authenticated multiprocess
+    cluster: the child process (grandchild of the tracker DAEMON
+    process) signs its umbilical + shuffle traffic with only its JOB
+    token — the full credential-scoping chain across real process
+    boundaries."""
+    from tpumr.fs import get_filesystem
+    from tpumr.mapred.job_client import JobClient
+
+    conf = _client_conf(cluster_procs)
+    nn = cluster_procs["nn_port"]
+    fs = get_filesystem(f"tdfs://127.0.0.1:{nn}/", conf)
+    fs.mkdirs("/iso")
+    fs.write_bytes("/iso/in.txt", b"tok a tok\nb tok\n" * 50)
+
+    jconf = _client_conf(cluster_procs)
+    jconf.set_job_name("mp-isolated")
+    jconf.set("tpumr.task.isolation", "process")
+    jconf.set_input_paths(f"tdfs://127.0.0.1:{nn}/iso/in.txt")
+    jconf.set_output_path(f"tdfs://127.0.0.1:{nn}/iso/out")
+    jconf.set("mapred.mapper.class",
+              "tpumr.ops.wordcount.WordCountCpuMapper")
+    jconf.set("mapred.reducer.class",
+              "tpumr.examples.basic.LongSumReducer")
+    jconf.set_num_reduce_tasks(1)
+
+    result = JobClient(jconf).run_job(jconf)
+    assert result.successful
+    counts = {}
+    for st in fs.list_files("/iso/out"):
+        if st.path.name.startswith("part-"):
+            for line in fs.read_bytes(st.path).decode().splitlines():
+                k, v = line.split("\t")
+                counts[k] = int(v)
+    assert counts == {"tok": 150, "a": 50, "b": 50}
+    # positive proof a CHILD PROCESS actually ran (the isolation path,
+    # not an in-process fallback): process_runner writes child.log into
+    # the tracker daemons' userlogs trees unconditionally
+    child_logs = list(cluster_procs["work"].glob(
+        "local*/*/userlogs/job_*/attempt_*/child.log"))
+    assert child_logs, "no isolated child ever ran"

@@ -356,3 +356,47 @@ class ChattyMapper:
 
     def close(self):
         pass
+
+
+class SecretProbeMapper:
+    """Reports whether the cluster secret is visible in the CHILD's conf."""
+
+    def configure(self, conf):
+        self._visible = 1 if conf.get("tpumr.rpc.secret") else 0
+
+    def map(self, key, value, output, reporter):
+        output.collect("secret_visible", self._visible)
+
+    def close(self):
+        pass
+
+
+def test_strip_cluster_secret_from_child_conf(tmp_path):
+    """tpumr.task.strip.cluster.secret=true: the child process's job conf
+    carries no secret-bearing keys (it still authenticates via its job
+    token); default keeps them (tdfs-reading tasks need the secret)."""
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.job_client import JobClient
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+
+    src = tmp_path / "in.txt"
+    src.write_bytes(b"x\n")
+    results = {}
+    for strip in (True, False):
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", "probe-secret")
+        conf.set("tpumr.task.isolation", "process")
+        with MiniMRCluster(num_trackers=1, cpu_slots=1, tpu_slots=0,
+                           conf=conf) as c:
+            jc = c.create_job_conf()
+            jc.set("tpumr.task.isolation", "process")
+            jc.set("tpumr.task.strip.cluster.secret", strip)
+            jc.set_input_paths(f"file://{src}")
+            jc.set_output_path(f"file://{tmp_path}/out-{strip}")
+            jc.set_class("mapred.mapper.class", SecretProbeMapper)
+            jc.set_num_reduce_tasks(0)
+            assert JobClient(jc).run_job(jc).successful
+        text = (tmp_path / f"out-{strip}" / "part-00000").read_text()
+        results[strip] = text.strip()
+    assert results[True] == "secret_visible\t0"
+    assert results[False] == "secret_visible\t1"

@@ -86,10 +86,21 @@ def run_task_in_process(runner: Any, job_id: str, task: Task,
         child_secret, child_scope = runner._job_token(job_id), job_id
     else:
         child_secret, child_scope = b"", None  # unauthenticated cluster
+    conf_dict = conf.to_dict()
+    if conf.get_boolean("tpumr.task.strip.cluster.secret", False):
+        # hardening opt-in: the child's umbilical/shuffle traffic signs
+        # with the job token either way, but the cluster secret ALSO
+        # rides the job conf (tasks reading tdfs:// authenticate to the
+        # dfs daemons with it — full child credential isolation needs
+        # delegation tokens, a documented non-goal). Deployments whose
+        # tasks don't touch tdfs directly can strip it.
+        conf_dict = {k: v for k, v in conf_dict.items()
+                     if "secret" not in k.lower()
+                     and "password" not in k.lower()}
     payload = serialize({
         "job_id": job_id,
         "task": task.to_dict(),
-        "conf": conf.to_dict(),
+        "conf": conf_dict,
         "tracker_host": runner.bind_host,
         "tracker_port": runner.shuffle_port,
         "secret": child_secret,
