@@ -126,3 +126,51 @@ def test_many_maps_many_reduces_shuffle(tmp_path):
     assert len(counts) == n_keys
     assert all(v == 10 for v in counts.values()), \
         {k: v for k, v in counts.items() if v != 10}
+
+
+def test_heartbeat_cost_independent_of_finished_task_history():
+    """SURVEY §3.2: the reference recomputes per-backend mean runtimes by
+    rescanning ALL TaskReports on every heartbeat (O(jobs × tasks)); this
+    framework keeps running sums, so assign_tasks cost must NOT grow with
+    a job's finished-task history. Measured as a ratio so machine speed
+    doesn't matter: 40x more finished tasks must not make heartbeats
+    meaningfully slower (the reference's rescan would be ~40x)."""
+    import time as _time
+
+    from test_scheduler import make_job, make_scheduler, tracker_status
+    from tpumr.mapred.task import TaskState, TaskStatus
+
+    def build_jobs(finished_per_job, jobs=8, pending=4):
+        out = []
+        for j in range(jobs):
+            job = make_job(n_maps=finished_per_job + pending, kernel=True,
+                           job_num=j + 1)
+            for i in range(finished_per_job):
+                task = job.obtain_new_map_task("host0",
+                                               run_on_tpu=(i % 2 == 0),
+                                               tpu_device_id=0)
+                assert task is not None
+                st = TaskStatus(attempt_id=task.attempt_id, is_map=True,
+                                state=TaskState.SUCCEEDED,
+                                run_on_tpu=task.run_on_tpu,
+                                start_time=0.0, finish_time=0.5)
+                job.update_task_status(st, "h:0")
+            out.append(job)
+        return out
+
+    def mean_heartbeat_s(jobs, rounds=150):
+        sched = make_scheduler(jobs, n_trackers=4)
+        # full pools so every heartbeat does the complete profiling scan
+        # but can't actually assign (pending stays stable across rounds)
+        tts = tracker_status(cpu=3, tpu=1, run_cpu=3, run_tpu=1,
+                             devices=[False])
+        t0 = _time.time()
+        for _ in range(rounds):
+            sched.assign_tasks(dict(tts))
+        return (_time.time() - t0) / rounds
+
+    small = mean_heartbeat_s(build_jobs(50))
+    big = mean_heartbeat_s(build_jobs(2000))
+    assert big / max(small, 1e-9) < 5.0, (
+        f"heartbeat cost grew with finished-task history: "
+        f"{small * 1e6:.0f}us -> {big * 1e6:.0f}us")

@@ -94,9 +94,9 @@ def run_task_in_process(runner: Any, job_id: str, task: Task,
         # dfs daemons with it — full child credential isolation needs
         # delegation tokens, a documented non-goal). Deployments whose
         # tasks don't touch tdfs directly can strip it.
+        from tpumr.core.configuration import is_sensitive_key
         conf_dict = {k: v for k, v in conf_dict.items()
-                     if "secret" not in k.lower()
-                     and "password" not in k.lower()}
+                     if not is_sensitive_key(k)}
     payload = serialize({
         "job_id": job_id,
         "task": task.to_dict(),
