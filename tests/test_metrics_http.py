@@ -48,6 +48,53 @@ class TestMetricsCore:
         assert rec["sources"]["src1"]["n"] == 2
 
 
+    def test_udp_sink_statsd_lines_and_conf_wiring(self, tmp_path):
+        """UdpSink (the GangliaSink role): statsd gauge lines over UDP,
+        numeric metrics only, MTU-bounded batching; sinks_from_conf wires
+        both sink kinds from daemon conf."""
+        import socket
+
+        from tpumr.metrics import UdpSink, sinks_from_conf
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5)
+        port = recv.getsockname()[1]
+
+        ms = MetricsSystem("td", period_s=3600)
+        reg = ms.new_registry("jt")
+        reg.incr("heartbeats", 7)
+        reg.set_gauge("ratio", lambda: 0.5)
+        reg.set_gauge("label", lambda: "text-is-skipped")
+        ms.add_sink(UdpSink("127.0.0.1", port))
+        ms.publish_once()
+        lines = recv.recv(65536).decode().splitlines()
+        assert "td.jt.heartbeats:7|g" in lines
+        assert "td.jt.ratio:0.5|g" in lines
+        assert not any("label" in l for l in lines)
+
+        # many metrics split across MTU-sized datagrams, none lost
+        reg2 = ms.new_registry("big")
+        for i in range(200):
+            reg2.incr(f"metric_{i:03d}", i)
+        ms.publish_once()
+        got = []
+        while len(got) < 202:
+            try:
+                got.extend(recv.recv(65536).decode().splitlines())
+            except socket.timeout:
+                break
+        assert len([l for l in got if l.startswith("td.big.")]) == 200
+        recv.close()
+
+        from tpumr.mapred.jobconf import JobConf
+        conf = JobConf()
+        conf.set("tpumr.metrics.file", str(tmp_path / "m.jsonl"))
+        conf.set("tpumr.metrics.udp", f"127.0.0.1:{port}")
+        kinds = {type(s).__name__ for s in sinks_from_conf(conf)}
+        assert kinds == {"FileSink", "UdpSink"}
+        assert sinks_from_conf(JobConf()) == []
+
+
 class WcMapper:
     def configure(self, conf):
         pass

@@ -86,7 +86,7 @@ class JobMaster:
 
         # instrumentation ≈ JobTrackerInstrumentation + JobTrackerMXBean:
         # backend placement is a first-class metric (SURVEY.md §5)
-        from tpumr.metrics import FileSink, MetricsSystem
+        from tpumr.metrics import MetricsSystem
         self.metrics = MetricsSystem(
             "jobtracker",
             period_s=conf.get_int("tpumr.metrics.period.ms", 10_000) / 1000)
@@ -106,9 +106,9 @@ class JobMaster:
             _locked(lambda: sum(1 for t in self.trackers.values()
                                 if t.blacklisted)))
         self._mreg.set_gauge("slots", self.total_slots)
-        sink_path = conf.get("tpumr.metrics.file")
-        if sink_path:
-            self.metrics.add_sink(FileSink(sink_path))
+        from tpumr.metrics import sinks_from_conf
+        for sink in sinks_from_conf(conf):
+            self.metrics.add_sink(sink)
         self._http: Any = None
         self._http_port = conf.get_int("mapred.job.tracker.http.port", -1)
 

@@ -184,6 +184,9 @@ class NodeRunner:
         self._mreg.set_gauge("slots", lambda: {
             "cpu": self.max_cpu_map_slots, "tpu": self.max_tpu_map_slots,
             "reduce": self.max_reduce_slots})
+        from tpumr.metrics import sinks_from_conf
+        for sink in sinks_from_conf(conf):
+            self.metrics.add_sink(sink)
         self._http: Any = None
         self._http_port = conf.get_int("mapred.task.tracker.http.port", -1)
 

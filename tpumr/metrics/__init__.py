@@ -10,6 +10,8 @@ the reference's GPU observability was log-grep only (SURVEY.md §5).
 """
 
 from tpumr.metrics.core import (FileSink, MetricsRegistry, MetricsSystem,
+                                UdpSink, sinks_from_conf,
                                 MetricsSink)
 
-__all__ = ["FileSink", "MetricsRegistry", "MetricsSink", "MetricsSystem"]
+__all__ = ["FileSink", "MetricsRegistry", "MetricsSink", "MetricsSystem",
+           "UdpSink", "sinks_from_conf"]

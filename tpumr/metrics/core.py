@@ -61,6 +61,61 @@ class FileSink:
                 f.write(json.dumps(record) + "\n")
 
 
+class UdpSink:
+    """Push metrics to a monitoring daemon over UDP ≈ the GangliaSink
+    role (metrics2/sink/ganglia/*) with statsd gauge lines as the
+    2026-era wire format: ``<prefix>.<source>.<name>:<value>|g``, one
+    datagram per publish (batched, newline-separated). Fire-and-forget:
+    a down collector costs nothing."""
+
+    MAX_DATAGRAM = 1400  # stay under typical MTU
+
+    def __init__(self, host: str, port: int) -> None:
+        import socket
+        self.addr = (host, int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def put_metrics(self, record: dict) -> None:
+        prefix = record.get("prefix", "tpumr")
+        lines: "list[str]" = []
+        for source, metrics in (record.get("sources") or {}).items():
+            for name, value in metrics.items():
+                if isinstance(value, (int, float)) and not isinstance(
+                        value, bool):
+                    lines.append(f"{prefix}.{source}.{name}:{value}|g")
+        batch = ""
+        for line in lines:
+            if batch and len(batch) + 1 + len(line) > self.MAX_DATAGRAM:
+                self._sock.sendto(batch.encode(), self.addr)
+                batch = ""
+            batch = f"{batch}\n{line}" if batch else line
+        if batch:
+            self._sock.sendto(batch.encode(), self.addr)
+
+
+def sinks_from_conf(conf: Any) -> "list[Any]":
+    """Conf-driven sink wiring shared by every daemon:
+    ``tpumr.metrics.file`` = JSONL path, ``tpumr.metrics.udp`` =
+    host:port for the statsd/Ganglia-role push."""
+    sinks: "list[Any]" = []
+    path = conf.get("tpumr.metrics.file")
+    if path:
+        sinks.append(FileSink(str(path)))
+    udp = conf.get("tpumr.metrics.udp")
+    if udp:
+        host, _, port = str(udp).rpartition(":")
+        try:
+            sinks.append(UdpSink(host or "127.0.0.1", int(port)))
+        except (ValueError, OSError):
+            # a typo'd observability knob must not kill the daemon —
+            # same resilience posture as broken gauges/sinks elsewhere
+            import logging
+            logging.getLogger("tpumr.metrics").warning(
+                "ignoring malformed tpumr.metrics.udp=%r "
+                "(expected host:port)", udp)
+    return sinks
+
+
 class MetricsSystem:
     """Holds sources (registries), publishes snapshots to sinks on a
     period, and serves pull-based snapshots (the /json/metrics endpoint)."""
