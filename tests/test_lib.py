@@ -229,6 +229,21 @@ def test_multiple_outputs_side_files_follow_commit():
     assert _read(fs, "mem:///lib6/out2/part-00000") == {"good": "4"}
 
 
+def test_key_field_based_partitioner():
+    """api.KeyFieldBasedPartitioner: records sharing the leading fields
+    land in the same partition regardless of trailing fields."""
+    from tpumr.mapred.api import KeyFieldBasedPartitioner
+    p = KeyFieldBasedPartitioner(num_fields=2)
+    a = p.get_partition("u1\tWA\textra1", None, 16)
+    b = p.get_partition("u1\tWA\textra2", None, 16)
+    c = p.get_partition("u2\tOR\textra1", None, 16)
+    assert a == b
+    assert 0 <= a < 16 and 0 <= c < 16
+    # and distinct prefixes spread (not a constant function)
+    parts = {p.get_partition(f"u{i}\tX", None, 64) for i in range(40)}
+    assert len(parts) > 8
+
+
 def test_aggregate_framework():
     fs = get_filesystem("mem:///")
     fs.write_bytes("/lib7/in.txt", b"apple 3\npear 5\napple 4\n")

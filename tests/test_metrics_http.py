@@ -94,6 +94,12 @@ class TestMetricsCore:
         assert kinds == {"FileSink", "UdpSink"}
         assert sinks_from_conf(JobConf()) == []
 
+        # a typo'd observability knob must not kill the daemon
+        for bad in ("monitor01", "monitor01:", ":notaport"):
+            c = JobConf()
+            c.set("tpumr.metrics.udp", bad)
+            assert sinks_from_conf(c) == []
+
 
 class WcMapper:
     def configure(self, conf):
