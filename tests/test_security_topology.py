@@ -209,6 +209,21 @@ class TestRpcAuth:
             with pytest.raises(RpcError, match="does not belong"):
                 scoped_a.call("umbilical_done", bogus_attempt,
                               {"state": "SUCCEEDED"}, job_ids[0], 0, "", {})
+            # same forged binding on the commit-grant proxy: task_id must
+            # be the attempt's OWN task, or a caller could seed another
+            # task's commit grant (master setdefaults to first claimant)
+            # with an attempt that never fails — permanent commit DoS
+            bogus_task = job_ids[1].replace("job_", "task_") + "_r_000000"
+            own_attempt = (job_ids[0].replace("job_", "attempt_")
+                           + "_r_000000_0")
+            with pytest.raises(RpcError, match="does not belong"):
+                scoped_a.call("umbilical_can_commit", bogus_task,
+                              own_attempt)
+            # sibling task of the SAME job: also rejected
+            sibling_task = job_ids[0].replace("job_", "task_") + "_m_000007"
+            with pytest.raises(RpcError, match="does not belong"):
+                scoped_a.call("umbilical_can_commit", sibling_task,
+                              own_attempt)
 
     def test_secret_file(self, tmp_path):
         p = tmp_path / "secret"

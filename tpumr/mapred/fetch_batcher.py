@@ -79,27 +79,37 @@ class DeviceFetchBatcher:
             results = jax.device_get([s.tree for s in batch])
             for s, r in zip(batch, results):
                 s.result = r
+                s.fulfilled = True
         except Exception:  # noqa: BLE001 — isolate the failing entry
             for s in batch:
                 try:
                     s.result = jax.device_get(s.tree)
+                    s.fulfilled = True
                 except Exception as e:  # noqa: BLE001
                     s.error = e
                 with self._cond:
                     self.roundtrips += 1
         finally:
             for s in batch:
+                if not s.fulfilled and s.error is None:
+                    # a BaseException (KeyboardInterrupt, SystemExit)
+                    # escaped both paths — batch-mates must see a real
+                    # failure, never a silent None pytree
+                    s.error = RuntimeError(
+                        "batched device fetch aborted before this "
+                        "entry transferred")
                 s.done = True
 
 
 class _Slot:
-    __slots__ = ("tree", "result", "error", "done")
+    __slots__ = ("tree", "result", "error", "done", "fulfilled")
 
     def __init__(self, tree: Any) -> None:
         self.tree = tree
         self.result = None
         self.error: "Exception | None" = None
         self.done = False
+        self.fulfilled = False
 
 
 _shared = DeviceFetchBatcher()

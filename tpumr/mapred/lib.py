@@ -26,9 +26,9 @@ Components reproduced here (reference file in parens):
   resolves here, as the reference's does.
 
 HashPartitioner / KeyFieldBasedPartitioner / Identity* /
-TotalOrderPartitioner / NLineInputFormat / CombineFileInputFormat /
-MultithreadedMapRunner live in their runtime modules (api.py,
-total_order.py, input_formats.py, multithreaded.py).
+MultithreadedMapRunner live in api.py; TotalOrderPartitioner in
+total_order.py; NLineInputFormat / CombineFileInputFormat in
+input_formats.py.
 """
 
 from __future__ import annotations

@@ -39,7 +39,7 @@ from tpumr.core.counters import Counters
 from tpumr.io import ifile
 from tpumr.ipc.rpc import RpcClient, RpcServer
 from tpumr.mapred.api import Reporter, TaskKilledError
-from tpumr.mapred.ids import TaskAttemptID
+from tpumr.mapred.ids import TaskAttemptID, TaskID
 from tpumr.mapred.jobconf import JobConf
 from tpumr.mapred.jobtracker import PROTOCOL_VERSION
 from tpumr.mapred.map_task import run_map_task
@@ -388,7 +388,11 @@ class NodeRunner:
         """Drop map outputs + cached confs of terminal jobs (≈ the
         KillJobAction-driven purge of job-local dirs)."""
         with self.lock:
-            job_ids = {j for j, _ in self.map_outputs} | set(self.job_confs)
+            # include resolver-populated token entries for jobs this
+            # tracker never ran (shuffle-source role) so they stop
+            # authenticating once the master reports the job terminal
+            job_ids = ({j for j, _ in self.map_outputs}
+                       | set(self.job_confs) | set(self._job_tokens))
         for job_id in job_ids:
             try:
                 st = self.master.call("get_job_status", job_id)
@@ -460,6 +464,10 @@ class NodeRunner:
         if tok is None:
             tok = bytes(self.master.call("get_job_token", job_id) or b"")
             with self.lock:
+                while len(self._job_tokens) >= 1024:
+                    # hard cap (same policy as _job_token_misses): an
+                    # evicted live job just re-resolves via the master
+                    self._job_tokens.pop(next(iter(self._job_tokens)))
                 self._job_tokens[job_id] = tok
         return tok
 
@@ -761,7 +769,16 @@ class NodeRunner:
 
     def umbilical_can_commit(self, task_id: str, attempt_id: str) -> bool:
         """Commit-grant proxy (≈ commitPending → JobTracker.canCommit)."""
-        self._check_scope(str(TaskAttemptID.parse(attempt_id).task.job))
+        attempt = TaskAttemptID.parse(attempt_id)
+        if str(TaskID.parse(task_id)) != str(attempt.task):
+            # task_id must be the ATTEMPT's OWN task: the master's
+            # can_commit setdefaults the grant to the first claimant, so
+            # any laxer binding lets an attempt seed a sibling (or
+            # foreign) task's grant with an attempt that never fails —
+            # permanently denying that task's real attempts
+            raise PermissionError(
+                f"task {task_id} does not belong to attempt {attempt_id}")
+        self._check_scope(str(attempt.task.job))
         return bool(self.master.call("can_commit", task_id, attempt_id))
 
     def umbilical_events(self, job_id: str, cursor: int) -> list:

@@ -23,6 +23,12 @@ class KernelMapper:
     the designed-in advantage over the reference's per-record socket protocol
     (BinaryProtocol MAP_ITEM hot loop, PipesGPUMapRunner.java:97-107): output
     leaves the device pre-combined.
+
+    ``batch.values`` (and dense batch arrays generally) may be READ-ONLY
+    numpy views over the input file's buffer (DenseInputFormat stages
+    splits zero-copy via ``np.frombuffer``). Kernels must not mutate
+    batch arrays in place — copy first (``np.array(batch.values)``) if a
+    writable array is needed; ``jnp.asarray`` staging is unaffected.
     """
 
     #: registry name
