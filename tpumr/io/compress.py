@@ -25,6 +25,58 @@ class CompressionCodec:
     def decompress(self, data: bytes) -> bytes:
         return data
 
+    def decompressor(self) -> "Decompressor":
+        """Streaming decompressor (≈ the Decompressor SPI the JNI codecs
+        implement): feed compressed chunks, get raw bytes incrementally
+        — the memory-bounded shuffle/merge path depends on this. Codecs
+        without native streaming inherit a buffering fallback (whole
+        payload held until flush)."""
+        return _BufferingDecompressor(self)
+
+
+class Decompressor:
+    """feed(data) -> raw bytes now available; flush() -> remaining raw."""
+
+    def feed(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def flush(self) -> bytes:
+        return b""
+
+
+class _PassthroughDecompressor(Decompressor):
+    def feed(self, data: bytes) -> bytes:
+        return data
+
+
+class _BufferingDecompressor(Decompressor):
+    """Fallback for codecs without a streaming object (e.g. snappy)."""
+
+    def __init__(self, codec: "CompressionCodec") -> None:
+        self._codec = codec
+        self._parts: list[bytes] = []
+
+    def feed(self, data: bytes) -> bytes:
+        self._parts.append(data)
+        return b""
+
+    def flush(self) -> bytes:
+        return self._codec.decompress(b"".join(self._parts))
+
+
+class _ObjDecompressor(Decompressor):
+    """Adapter over stdlib decompressobj-style objects."""
+
+    def __init__(self, obj) -> None:
+        self._obj = obj
+
+    def feed(self, data: bytes) -> bytes:
+        return self._obj.decompress(data)
+
+    def flush(self) -> bytes:
+        fl = getattr(self._obj, "flush", None)
+        return fl() if fl is not None else b""
+
 
 class ZlibCodec(CompressionCodec):
     """≈ DefaultCodec/zlib (src/native/.../zlib/ZlibCompressor.c)."""
@@ -40,6 +92,9 @@ class ZlibCodec(CompressionCodec):
     def decompress(self, data: bytes) -> bytes:
         return zlib.decompress(data)
 
+    def decompressor(self) -> Decompressor:
+        return _ObjDecompressor(zlib.decompressobj())
+
 
 class GzipCodec(CompressionCodec):
     name = "gzip"
@@ -50,6 +105,9 @@ class GzipCodec(CompressionCodec):
 
     def decompress(self, data: bytes) -> bytes:
         return gzip.decompress(data)
+
+    def decompressor(self) -> Decompressor:
+        return _ObjDecompressor(zlib.decompressobj(16 + zlib.MAX_WBITS))
 
 
 class Bzip2Codec(CompressionCodec):
@@ -62,6 +120,9 @@ class Bzip2Codec(CompressionCodec):
     def decompress(self, data: bytes) -> bytes:
         return bz2.decompress(data)
 
+    def decompressor(self) -> Decompressor:
+        return _ObjDecompressor(bz2.BZ2Decompressor())
+
 
 class LzmaCodec(CompressionCodec):
     name = "lzma"
@@ -73,9 +134,15 @@ class LzmaCodec(CompressionCodec):
     def decompress(self, data: bytes) -> bytes:
         return lzma.decompress(data)
 
+    def decompressor(self) -> Decompressor:
+        return _ObjDecompressor(lzma.LZMADecompressor())
+
 
 class NullCodec(CompressionCodec):
     name = "none"
+
+    def decompressor(self) -> Decompressor:
+        return _PassthroughDecompressor()
 
 
 _REGISTRY: dict[str, type[CompressionCodec]] = {

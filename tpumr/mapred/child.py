@@ -147,12 +147,10 @@ def run_child(task_file: str) -> int:
                                        600_000) / 1000.0,
                 scope=scope)
 
-            def fetch(map_index: int, partition: int):
-                from tpumr.io import ifile
-                out = locate(map_index).call("get_map_output", job_id,
-                                             map_index, partition)
-                return ifile.iter_transferred_segment(out["data"],
-                                                      out["codec"])
+            from tpumr.mapred.shuffle_copier import RemoteChunkSource
+            conf.set("tpumr.task.local.dir",
+                     os.path.join(local_dir, "shuffle"))
+            fetch = RemoteChunkSource(conf, job_id, locate)
 
             maybe_profile(conf, task, prof_dir,
                           lambda: run_reduce_task(conf, task, fetch,

@@ -12,6 +12,7 @@ output-key comparator — preserving the secondary-sort seam.
 
 from __future__ import annotations
 
+import tempfile
 import time
 from typing import Any, Callable, Iterable, Iterator
 
@@ -41,11 +42,54 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
     grouping = conf.get_output_value_grouping_comparator()
     gk = grouping.sort_key if grouping is not None else sk
 
-    # shuffle: gather all map segments (copy phase ≈ ReduceCopier.fetchOutputs)
-    segments: list[Iterable[tuple[bytes, bytes]]] = []
-    for m in range(task.num_maps):
-        segments.append(fetch(m, task.partition))
+    # shuffle: the copy phase ≈ ReduceCopier.fetchOutputs. Three source
+    # shapes (newest first):
+    #  - ChunkFetch (has .chunk_bytes / is RemoteChunkSource): parallel
+    #    RAM-budgeted ShuffleCopier over chunked tracker RPC;
+    #  - SegmentSource (has .segments): pre-localized lazy spill views
+    #    (LocalJobRunner) — nothing copied, nothing materialized;
+    #  - legacy FetchFn callable: sequential whole-segment iterables
+    #    (kept for tests and custom fetchers).
+    from tpumr.mapred.shuffle_copier import ShuffleCopier
+    segments: list[Iterable[tuple[bytes, bytes]]]
+    closeable: list[Any] = []
+    tmp_spill_dir: str | None = None
+    if hasattr(fetch, "segments"):
+        segments = list(fetch.segments(task.partition))
+        closeable = list(segments)
+    elif hasattr(fetch, "chunk_bytes"):
+        spill_dir = conf.get("tpumr.task.local.dir")
+        if not spill_dir:
+            spill_dir = tmp_spill_dir = tempfile.mkdtemp(
+                prefix=f"shuffle-{task.attempt_id}-")
+        copier = ShuffleCopier(conf, fetch, task.num_maps, task.partition,
+                               spill_dir, reporter)
+        segments = copier.copy_all()
+        closeable = list(segments)
+    else:
+        segments = [fetch(m, task.partition) for m in range(task.num_maps)]
 
+    try:
+        _run_reduce_phase(conf, task, segments, sk, gk, reporter)
+    finally:
+        # everything after the copy phase — even reducer/output SETUP —
+        # must release shuffle resources (RAM budget, disk spills) or a
+        # failing-and-retried attempt leaks a full set per try
+        for seg in closeable:
+            try:
+                seg.close()  # releases RAM budget / deletes shuffle spills
+            except Exception:  # noqa: BLE001 — cleanup must not mask
+                pass
+        if tmp_spill_dir is not None:
+            import shutil
+            shutil.rmtree(tmp_spill_dir, ignore_errors=True)
+
+
+def _run_reduce_phase(conf: Any, task: Task,
+                      segments: "list[Iterable[tuple[bytes, bytes]]]",
+                      sk: Callable, gk: Callable,
+                      reporter: Reporter) -> None:
+    """Merge → group → reduce → commit, over already-copied segments."""
     # sort phase: lazy k-way merge ≈ Merger.merge (ReduceTask.java:399-409)
     merged = ifile.merge_sorted(segments, sk)
 
@@ -125,15 +169,8 @@ def group_by_key(stream: Iterator[tuple[bytes, bytes]],
             pass
 
 
-def local_fetch_factory(map_outputs: "list[tuple[str, dict]]") -> FetchFn:
-    """Fetcher over same-process map outputs (LocalJobRunner path): reads the
-    partition segment straight from each map's merged IFile."""
-
-    def fetch(map_index: int, partition: int) -> Iterable[tuple[bytes, bytes]]:
-        path, index = map_outputs[map_index]
-        if not path:
-            return []
-        with open(path, "rb") as f:
-            return list(ifile.read_partition(f, index, partition))
-
-    return fetch
+def local_fetch_factory(map_outputs: "list[tuple[str, dict]]"):
+    """Segment source over same-process map outputs (LocalJobRunner path):
+    lazy spill-file views — see shuffle_copier.LocalSegmentSource."""
+    from tpumr.mapred.shuffle_copier import LocalSegmentSource
+    return LocalSegmentSource(map_outputs)

@@ -1,0 +1,340 @@
+"""The shuffle copy phase: parallel, chunk-streamed, RAM-budgeted.
+
+≈ ``ReduceCopier`` inside ``org.apache.hadoop.mapred.ReduceTask`` (reference:
+src/mapred/org/apache/hadoop/mapred/ReduceTask.java — MapOutputCopier fetch
+threads :659, ShuffleRamManager byte budget with in-memory vs on-disk
+shuffle :1080) and the chunk-serving half of the MapOutputServlet
+(TaskTracker.java:4050). Re-designed for this runtime:
+
+- ``tpumr.shuffle.parallel.copies`` fetcher threads pull map outputs
+  concurrently (the reference's mapred.reduce.parallel.copies);
+- segments move as bounded CHUNKS over tracker RPC (``tpumr.shuffle.
+  chunk.bytes``) — neither the serving tracker nor the copier ever holds
+  an unbounded payload for one request;
+- a :class:`ShuffleRamManager` budget decides in-memory vs on-disk per
+  segment by its RAW (decompressed) size: small segments decompress into
+  the budget, oversized or budget-starved ones stream to local disk and
+  are re-read incrementally at merge time (ifile.iter_chunked_segment),
+  so reduce-side memory is bounded by budget + copies × chunk.
+
+Divergence from the reference, documented: the reference BLOCKS a fetcher
+waiting for budget because concurrent in-memory merge threads free it; here
+nothing frees budget mid-copy (segments are consumed by the merge after the
+copy phase), so a fetcher that cannot reserve now goes to disk immediately —
+same memory bound, no deadlock, one less moving part.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+from tpumr.core.counters import TaskCounter
+from tpumr.io import ifile
+
+#: source protocol: fetch_chunk(map_index, partition, offset) -> dict with
+#: "data" (payload bytes from offset), "total" (payload length), "raw"
+#: (decompressed segment length), "codec".
+ChunkFetch = Callable[[int, int, int], dict]
+
+
+class ShuffleRamManager:
+    """In-memory shuffle byte budget (≈ ReduceTask.java:1080). Accounting
+    is in RAW segment bytes — what actually sits in memory after
+    decompression. ``max_single`` mirrors the reference's rule that one
+    segment may claim at most a fraction of the whole budget."""
+
+    def __init__(self, budget_bytes: int,
+                 max_single_frac: float = 0.25) -> None:
+        self.budget = max(0, int(budget_bytes))
+        self.max_single = int(self.budget * max_single_frac)
+        self._used = 0
+        self._lock = threading.Lock()
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    def try_reserve(self, nbytes: int) -> bool:
+        """Claim budget for one segment, or refuse (caller spills to
+        disk). Never blocks — see the module docstring divergence note."""
+        if nbytes > self.max_single:
+            return False
+        with self._lock:
+            if self._used + nbytes > self.budget:
+                return False
+            self._used += nbytes
+            return True
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._used = max(0, self._used - nbytes)
+
+
+class Segment:
+    """One map output's partition segment, iterable as (kbytes, vbytes)."""
+
+    #: raw (decompressed) size, for accounting/diagnostics
+    raw_length = 0
+    in_memory = False
+
+    def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemorySegment(Segment):
+    """Decompressed segment held under a ShuffleRamManager reservation."""
+
+    in_memory = True
+
+    def __init__(self, raw: bytes, ram: ShuffleRamManager | None) -> None:
+        self._raw: bytes | None = raw
+        self.raw_length = len(raw)
+        self._ram = ram
+
+    def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
+        if self._raw is None:
+            raise ValueError("segment closed")
+        return ifile.iter_segment(self._raw)
+
+    def close(self) -> None:
+        if self._raw is not None and self._ram is not None:
+            self._ram.release(self.raw_length)
+        self._raw = None
+
+
+class DiskSegment(Segment):
+    """Compressed payload spilled to a local file; records stream out
+    through the incremental decompressor at merge time."""
+
+    def __init__(self, path: str, codec: str, raw_length: int,
+                 offset: int = 0, length: int | None = None,
+                 owns_file: bool = True) -> None:
+        self.path = path
+        self.codec = codec
+        self.raw_length = raw_length
+        self.offset = offset
+        self.length = (length if length is not None
+                       else os.path.getsize(path) - offset)
+        self._owns = owns_file
+
+    def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
+        return ifile.iter_chunked_segment(
+            ifile.file_region_chunks(self.path, self.offset, self.length),
+            self.codec)
+
+    def close(self) -> None:
+        if self._owns:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def spill_region_segment(path: str, index: dict,
+                         partition: int) -> DiskSegment:
+    """A segment view straight over an existing local spill file (the
+    LocalJobRunner / same-host path): zero copy, streamed at merge time.
+    The spill file is owned by the map side — never deleted here."""
+    off, raw_len, part_len = index["partitions"][partition]
+    # skip the 4-byte length prefix; the payload is part_len - 4 bytes
+    return DiskSegment(path, index.get("codec", "none"), raw_len,
+                       offset=off + 4, length=part_len - 4,
+                       owns_file=False)
+
+
+class LocalSegmentSource:
+    """Segment source over same-process map outputs (LocalJobRunner):
+    replaces the old list-materializing local_fetch_factory — Weak #6's
+    unbounded reduce-side memory goes away because nothing is loaded
+    until the merge streams it."""
+
+    def __init__(self, map_outputs: "list[tuple[str, dict]]") -> None:
+        self._outputs = map_outputs
+
+    def segments(self, partition: int) -> "list[Segment]":
+        out: list[Segment] = []
+        for path, index in self._outputs:
+            if not path:
+                continue
+            out.append(spill_region_segment(path, index, partition))
+        return out
+
+
+class ShuffleCopier:
+    """Run the copy phase: ``copy_all()`` returns every map's segment for
+    this reduce's partition, fetched by a pool of copier threads."""
+
+    def __init__(self, conf: Any, source: ChunkFetch, num_maps: int,
+                 partition: int, spill_dir: str,
+                 reporter: Any = None) -> None:
+        self.conf = conf
+        self.source = source
+        self.num_maps = num_maps
+        self.partition = partition
+        self.spill_dir = spill_dir
+        self.reporter = reporter
+        self.parallel = max(1, conf.get_int("tpumr.shuffle.parallel.copies",
+                                            5))
+        ram_mb = conf.get_float("tpumr.shuffle.ram.mb", 128.0)
+        pct = conf.get_float("mapred.job.shuffle.input.buffer.percent", 0.70)
+        self.ram = ShuffleRamManager(int(ram_mb * 1024 * 1024 * pct))
+        self.retries = conf.get_int("tpumr.shuffle.copy.retries", 3)
+        self.backoff_s = conf.get_float("tpumr.shuffle.copy.backoff.ms",
+                                        200.0) / 1000.0
+        #: observability: how many segments went to disk vs memory
+        #: (mutated by parallel workers — guarded by _stats_lock)
+        self.spilled_to_disk = 0
+        self.copied_in_memory = 0
+        self._stats_lock = threading.Lock()
+
+    # ------------------------------------------------------------ one map
+
+    def _copy_one(self, map_index: int) -> Segment:
+        first = self.source(map_index, self.partition, 0)
+        total = int(first["total"])
+        raw = int(first.get("raw", total))
+        codec = first.get("codec", "none")
+        parts = [first["data"]]
+        got = len(first["data"])
+
+        if self.ram.try_reserve(raw):
+            # in-memory: pull remaining chunks, decompress into the budget
+            try:
+                while got < total:
+                    nxt = self.source(map_index, self.partition, got)
+                    if not nxt["data"]:
+                        raise EOFError(
+                            f"shuffle source returned empty chunk at "
+                            f"{got}/{total} for map {map_index}")
+                    parts.append(nxt["data"])
+                    got += len(nxt["data"])
+                from tpumr.io.compress import get_codec
+                raw_bytes = get_codec(codec).decompress(b"".join(parts))
+                with self._stats_lock:
+                    self.copied_in_memory += 1
+                return MemorySegment(raw_bytes, self.ram)
+            except BaseException:
+                self.ram.release(raw)
+                raise
+        # on-disk: stream chunks straight to a local spill file
+        fd, path = tempfile.mkstemp(prefix=f"shuffle-m{map_index}-",
+                                    suffix=".seg", dir=self.spill_dir)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                for p in parts:
+                    f.write(p)
+                while got < total:
+                    nxt = self.source(map_index, self.partition, got)
+                    if not nxt["data"]:
+                        raise EOFError(
+                            f"shuffle source returned empty chunk at "
+                            f"{got}/{total} for map {map_index}")
+                    f.write(nxt["data"])
+                    got += len(nxt["data"])
+        except BaseException:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
+        with self._stats_lock:
+            self.spilled_to_disk += 1
+        return DiskSegment(path, codec, raw)
+
+    def _copy_with_retries(self, map_index: int) -> Segment:
+        last: Exception | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self._copy_one(map_index)
+            except Exception as e:  # noqa: BLE001 — fetch failure is data
+                last = e
+                if attempt < self.retries:
+                    time.sleep(self.backoff_s * (2 ** attempt))
+        raise RuntimeError(
+            f"shuffle fetch of map {map_index} partition {self.partition} "
+            f"failed after {self.retries + 1} attempts: {last}") from last
+
+    # ------------------------------------------------------------ the phase
+
+    def copy_all(self) -> "list[Segment]":
+        os.makedirs(self.spill_dir, exist_ok=True)
+        results: "list[Segment | None]" = [None] * self.num_maps
+        errors: "list[Exception]" = []
+        work: "queue.Queue[int]" = queue.Queue()
+        for m in range(self.num_maps):
+            work.put(m)
+        done = [0]
+        lock = threading.Lock()
+
+        def worker() -> None:
+            while True:
+                with lock:
+                    if errors:
+                        return
+                if self.reporter is not None and self.reporter.aborted():
+                    return
+                try:
+                    m = work.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    seg = self._copy_with_retries(m)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(e)
+                    return
+                with lock:
+                    results[m] = seg
+                    done[0] += 1
+                if self.reporter is not None:
+                    self.reporter.incr_counter(
+                        TaskCounter.FRAMEWORK_GROUP,
+                        TaskCounter.REDUCE_SHUFFLE_BYTES, seg.raw_length)
+                    self.reporter.progress(done[0] / self.num_maps)
+
+        n = min(self.parallel, max(1, self.num_maps))
+        threads = [threading.Thread(target=worker,
+                                    name=f"shuffle-copier-{i}", daemon=True)
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        aborted = self.reporter is not None and self.reporter.aborted()
+        if errors or aborted:
+            for seg in results:
+                if seg is not None:
+                    seg.close()
+            if errors:
+                raise errors[0]
+            self.reporter.raise_if_aborted()
+        return [seg for seg in results if seg is not None]
+
+
+class RemoteChunkSource:
+    """ChunkFetch over tracker RPC (the client half of the chunked
+    MapOutputServlet): resolves each map's serving tracker via the
+    completion-event locator, then pulls ``get_map_output_chunk``
+    ranges. Shared by the in-tracker reduce path and the isolated child
+    (which locates through the umbilical event proxy)."""
+
+    def __init__(self, conf: Any, job_id: str,
+                 locate: Callable[[int], Any]) -> None:
+        self.job_id = job_id
+        self.locate = locate
+        self.chunk_bytes = max(64 * 1024,
+                               conf.get_int("tpumr.shuffle.chunk.bytes",
+                                            1 << 20))
+
+    def __call__(self, map_index: int, partition: int, offset: int) -> dict:
+        return self.locate(map_index).call(
+            "get_map_output_chunk", self.job_id, map_index, partition,
+            offset, self.chunk_bytes)

@@ -69,26 +69,42 @@ def make_map_locator(events_fn: Any, secret: bytes | None,
     events: dict[int, dict] = {}
     seen = [0]
     clients: dict[str, RpcClient] = {}
+    # the ShuffleCopier drives locate() from parallel fetcher threads.
+    # cache_lock guards the event cache/cursor/client table; poll_lock
+    # serializes the events_fn RPC OUTSIDE cache_lock, so threads whose
+    # map is already cached never wait behind a network poll — and the
+    # cursor can't double-advance (that silently skips events forever).
+    cache_lock = threading.Lock()
+    poll_lock = threading.Lock()
+
+    def cached(map_index: int) -> bool:
+        with cache_lock:
+            return map_index in events
 
     def locate(map_index: int) -> RpcClient:
         deadline = time.time() + timeout_s
-        while map_index not in events:
-            fresh = events_fn(seen[0])
-            seen[0] += len(fresh)
-            for e in fresh:
-                events[e["map_index"]] = e
-            if map_index in events:
+        while not cached(map_index):
+            with poll_lock:
+                if cached(map_index):  # another poller just fetched it
+                    break
+                fresh = events_fn(seen[0])
+                with cache_lock:
+                    seen[0] += len(fresh)
+                    for e in fresh:
+                        events[e["map_index"]] = e
+            if cached(map_index):
                 break
             if time.time() > deadline:
                 raise TimeoutError(
                     f"map {map_index} output never became available")
             time.sleep(poll_s)
-        addr = events[map_index]["shuffle_addr"]
-        host, port = addr.rsplit(":", 1)
-        cli = clients.get(addr)
-        if cli is None:
-            cli = clients[addr] = RpcClient(host, int(port), secret=secret,
-                                            scope=scope)
+        with cache_lock:
+            addr = events[map_index]["shuffle_addr"]
+            host, port = addr.rsplit(":", 1)
+            cli = clients.get(addr)
+            if cli is None:
+                cli = clients[addr] = RpcClient(host, int(port),
+                                                secret=secret, scope=scope)
         return cli
 
     return locate
@@ -167,7 +183,8 @@ class NodeRunner:
         self._server.scoped_methods = {
             "get_protocol_version", "umbilical_ping", "umbilical_status",
             "umbilical_can_commit", "umbilical_events", "umbilical_done",
-            "umbilical_fail", "get_map_output", "get_map_output_dense",
+            "umbilical_fail", "get_map_output", "get_map_output_chunk",
+            "get_map_output_dense",
         }
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            name=f"{self.name}-heartbeat",
@@ -524,6 +541,10 @@ class NodeRunner:
                 jc.set(k, v)
             # tracker-local cache root for DistributedCache localization
             jc.set("tpumr.cache.dir", os.path.join(self.local_root, "cache"))
+            # shuffle spill dir (ShuffleCopier disk segments) — inside the
+            # job scratch tree so job cleanup rmtree's any stragglers
+            jc.set("tpumr.task.local.dir",
+                   os.path.join(self.local_root, job_id, "shuffle"))
             jc.set("tpumr.job.id", job_id)
             # retained logs tree (≈ userlogs): per-attempt profiles land
             # here, OUTSIDE the job scratch dir that cleanup rmtree's
@@ -846,6 +867,42 @@ class NodeRunner:
             data = ifile.partition_bytes(f, index, partition)
         return {"data": data, "codec": index.get("codec", "none")}
 
+    #: server-side cap on one chunk response — bounds tracker memory per
+    #: request no matter what the client asks for (the chunked-transfer
+    #: half of Missing #6: whole segments never ride one RPC response)
+    MAX_CHUNK_BYTES = 4 << 20
+
+    def get_map_output_chunk(self, job_id: str, map_index: int,
+                             partition: int, offset: int,
+                             max_bytes: int) -> dict:
+        """Serve one bounded range of a partition segment's compressed
+        payload (the streaming re-design of MapOutputServlet,
+        TaskTracker.java:4050 — the reference streams via servlet chunked
+        output; here each RPC response is one bounded chunk). ``offset``
+        is payload-relative; ``total`` is the payload length so the copier
+        knows when it has everything; ``raw`` is the decompressed size the
+        ShuffleRamManager budgets on."""
+        self._check_scope(job_id)
+        with self.lock:
+            ent = self.map_outputs.get((job_id, map_index))
+        if ent is None:
+            raise KeyError(f"no map output for {job_id} map {map_index}")
+        path, index = ent
+        if index.get("dense"):
+            raise ValueError(f"map output for {job_id} map {map_index} is "
+                             "dense (device-shuffled job) — fetch with "
+                             "get_map_output_dense")
+        off, raw_len, part_len = index["partitions"][partition]
+        payload_len = part_len - 4          # minus the length prefix
+        offset = max(0, int(offset))
+        n = max(0, min(int(max_bytes), self.MAX_CHUNK_BYTES,
+                       payload_len - offset))
+        with open(path, "rb") as f:
+            f.seek(off + 4 + offset)
+            data = f.read(n)
+        return {"data": data, "total": payload_len, "raw": raw_len,
+                "codec": index.get("codec", "none")}
+
     def get_map_output_dense(self, job_id: str, map_index: int) -> dict:
         """Serve a device-shuffled job's whole dense map output (same
         MapOutputServlet role; the exchange itself happens on the mesh).
@@ -875,17 +932,12 @@ class NodeRunner:
                                         600_000) / 1000.0)
 
     def _remote_fetch_factory(self, job_id: str, task: Task):
-        """Parallel-capable fetch ≈ ReduceCopier.MapOutputCopier: resolves
-        map locations from completion events, pulls each segment over the
-        source tracker's RPC."""
-        locate = self._map_locator(job_id)
-
-        def fetch(map_index: int, partition: int):
-            out = locate(map_index).call("get_map_output", job_id,
-                                         map_index, partition)
-            return ifile.iter_transferred_segment(out["data"], out["codec"])
-
-        return fetch
+        """Chunked shuffle source ≈ ReduceCopier.MapOutputCopier: resolves
+        map locations from completion events; run_reduce_task drives it
+        with the parallel RAM-budgeted ShuffleCopier."""
+        from tpumr.mapred.shuffle_copier import RemoteChunkSource
+        return RemoteChunkSource(self._job_conf(job_id), job_id,
+                                 self._map_locator(job_id))
 
     def _remote_dense_fetch_factory(self, job_id: str, task: Task):
         """Dense fetch for device-shuffled jobs: pulls each map's whole
