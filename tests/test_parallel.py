@@ -176,3 +176,75 @@ def test_multihost_spec_and_single_host_noop(monkeypatch):
     mesh = multihost.global_mesh(None)
     assert mesh.devices.size == len(jax.devices())
     assert multihost.process_info() == (0, 1)
+
+
+class TestPersistentCompilationCache:
+    def test_conf_key_lands_in_jax_config(self, tmp_path, monkeypatch):
+        import jax
+
+        from tpumr.mapred.jobconf import JobConf
+        from tpumr.parallel import jaxruntime
+        jaxruntime._reset_for_tests()
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            conf = JobConf()
+            conf.set("tpumr.jax.cache.dir", str(tmp_path / "jc"))
+            got = jaxruntime.configure_persistent_cache(conf)
+            assert got == str(tmp_path / "jc")
+            assert jax.config.jax_compilation_cache_dir == got
+            # idempotent: second caller (different conf) is a no-op
+            other = JobConf()
+            other.set("tpumr.jax.cache.dir", str(tmp_path / "other"))
+            assert jaxruntime.configure_persistent_cache(other) == got
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            jaxruntime._reset_for_tests()
+
+    def test_disabled_with_none(self, monkeypatch):
+        import jax
+
+        from tpumr.mapred.jobconf import JobConf
+        from tpumr.parallel import jaxruntime
+        jaxruntime._reset_for_tests()
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            conf = JobConf()
+            conf.set("tpumr.jax.cache.dir", "none")
+            assert jaxruntime.configure_persistent_cache(conf) is None
+            assert jax.config.jax_compilation_cache_dir == prev
+        finally:
+            jaxruntime._reset_for_tests()
+
+    def test_cache_populates_and_hits_across_processes(self, tmp_path):
+        """Two fresh processes share compiles through the cache dir —
+        process 1 populates entries, process 2 must HIT (adds none).
+        Deterministic entry-count assertions, no wall-clock ratios."""
+        import os
+        import subprocess
+        import sys
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        prog = (
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from tpumr.mapred.jobconf import JobConf\n"
+            "from tpumr.parallel.jaxruntime import "
+            "configure_persistent_cache\n"
+            "conf = JobConf()\n"
+            "conf.set('tpumr.jax.cache.dir', %r)\n"
+            "conf.set('tpumr.jax.cache.min.compile.secs', 0.0)\n"
+            "configure_persistent_cache(conf)\n"
+            "import jax, jax.numpy as jnp\n"
+            "f = jax.jit(lambda x: jnp.sort(x * 2 + 1, axis=0))\n"
+            "f(jnp.zeros((4096, 8))).block_until_ready()\n"
+        ) % (repo_root, str(tmp_path / "xc"))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        entries = []
+        for _ in range(2):
+            out = subprocess.run([sys.executable, "-c", prog], env=env,
+                                 capture_output=True, text=True, timeout=120)
+            assert out.returncode == 0, out.stderr
+            entries.append(sorted(os.listdir(tmp_path / "xc")))
+        assert entries[0], "cache dir never populated"
+        # process 2 compiled nothing new — it loaded process 1's entries
+        assert entries[1] == entries[0], (entries[0], entries[1])

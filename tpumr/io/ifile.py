@@ -175,16 +175,21 @@ def iter_chunked_segment(chunks: Iterable[bytes],
 def file_region_chunks(path: str, offset: int, length: int,
                        chunk_bytes: int = 1 << 18) -> Iterator[bytes]:
     """Stream a byte region of a local file in bounded chunks (the
-    spill-file read half of the streaming shuffle)."""
-    with open(path, "rb") as f:
-        f.seek(offset)
-        remaining = length
-        while remaining > 0:
+    spill-file read half of the streaming shuffle). Opens the file PER
+    CHUNK instead of holding it across yields: the k-way merge keeps one
+    iterator live per map output simultaneously, and a reduce over ~1024
+    maps would otherwise exhaust the process fd limit mid-merge."""
+    pos = offset
+    remaining = length
+    while remaining > 0:
+        with open(path, "rb") as f:
+            f.seek(pos)
             piece = f.read(min(chunk_bytes, remaining))
-            if not piece:
-                raise EOFError(f"truncated spill file {path}")
-            remaining -= len(piece)
-            yield piece
+        if not piece:
+            raise EOFError(f"truncated spill file {path}")
+        pos += len(piece)
+        remaining -= len(piece)
+        yield piece
 
 
 def merge_sorted(segments: "list[Iterable[tuple[bytes, bytes]]]",

@@ -280,8 +280,10 @@ def run_device_reduce(conf: Any, task: Task, dense_fetch: DenseFetchFn,
     overflow = 0
     if n > 0:
         import jax
+        from tpumr.parallel.jaxruntime import configure_persistent_cache
         from tpumr.parallel.mesh import make_mesh
         from tpumr.parallel.device_sort import device_partition_sort
+        configure_persistent_cache(conf)
         mesh = make_mesh(devices=jax.local_devices())
         capacity = conf.get_int(CAPACITY_KEY, 0) or None
         shards, overflow = device_partition_sort(

@@ -57,19 +57,19 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
     if hasattr(fetch, "segments"):
         segments = list(fetch.segments(task.partition))
         closeable = list(segments)
-    elif hasattr(fetch, "chunk_bytes"):
-        spill_dir = conf.get("tpumr.task.local.dir")
-        if not spill_dir:
-            spill_dir = tmp_spill_dir = tempfile.mkdtemp(
-                prefix=f"shuffle-{task.attempt_id}-")
-        copier = ShuffleCopier(conf, fetch, task.num_maps, task.partition,
-                               spill_dir, reporter)
-        segments = copier.copy_all()
-        closeable = list(segments)
-    else:
-        segments = [fetch(m, task.partition) for m in range(task.num_maps)]
-
     try:
+        if hasattr(fetch, "chunk_bytes"):
+            spill_dir = conf.get("tpumr.task.local.dir")
+            if not spill_dir:
+                spill_dir = tmp_spill_dir = tempfile.mkdtemp(
+                    prefix=f"shuffle-{task.attempt_id}-")
+            copier = ShuffleCopier(conf, fetch, task.num_maps,
+                                   task.partition, spill_dir, reporter)
+            segments = copier.copy_all()
+            closeable = list(segments)
+        elif not hasattr(fetch, "segments"):
+            segments = [fetch(m, task.partition)
+                        for m in range(task.num_maps)]
         _run_reduce_phase(conf, task, segments, sk, gk, reporter)
     finally:
         # everything after the copy phase — even reducer/output SETUP —

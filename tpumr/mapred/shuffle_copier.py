@@ -90,13 +90,19 @@ class Segment:
 
 
 class MemorySegment(Segment):
-    """Decompressed segment held under a ShuffleRamManager reservation."""
+    """Decompressed segment held under a ShuffleRamManager reservation.
+    ``reserved`` is the amount actually claimed from the manager (the
+    index-reported raw size) — released EXACTLY, so a writer/index skew
+    between reported and actual decompressed size can never drift the
+    budget accounting."""
 
     in_memory = True
 
-    def __init__(self, raw: bytes, ram: ShuffleRamManager | None) -> None:
+    def __init__(self, raw: bytes, ram: ShuffleRamManager | None,
+                 reserved: int | None = None) -> None:
         self._raw: bytes | None = raw
         self.raw_length = len(raw)
+        self._reserved = self.raw_length if reserved is None else reserved
         self._ram = ram
 
     def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
@@ -106,7 +112,7 @@ class MemorySegment(Segment):
 
     def close(self) -> None:
         if self._raw is not None and self._ram is not None:
-            self._ram.release(self.raw_length)
+            self._ram.release(self._reserved)
         self._raw = None
 
 
@@ -220,7 +226,7 @@ class ShuffleCopier:
                 raw_bytes = get_codec(codec).decompress(b"".join(parts))
                 with self._stats_lock:
                     self.copied_in_memory += 1
-                return MemorySegment(raw_bytes, self.ram)
+                return MemorySegment(raw_bytes, self.ram, reserved=raw)
             except BaseException:
                 self.ram.release(raw)
                 raise

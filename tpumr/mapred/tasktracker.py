@@ -68,7 +68,7 @@ def make_map_locator(events_fn: Any, secret: bytes | None,
     the serving tracker's shuffle RPC."""
     events: dict[int, dict] = {}
     seen = [0]
-    clients: dict[str, RpcClient] = {}
+    clients: dict[tuple, RpcClient] = {}
     # the ShuffleCopier drives locate() from parallel fetcher threads.
     # cache_lock guards the event cache/cursor/client table; poll_lock
     # serializes the events_fn RPC OUTSIDE cache_lock, so threads whose
@@ -101,10 +101,15 @@ def make_map_locator(events_fn: Any, secret: bytes | None,
         with cache_lock:
             addr = events[map_index]["shuffle_addr"]
             host, port = addr.rsplit(":", 1)
-            cli = clients.get(addr)
+            # one client per (address, calling thread): RpcClient
+            # serializes calls on its single socket, so sharing one per
+            # address would collapse tpumr.shuffle.parallel.copies back
+            # to sequential whenever maps concentrate on few trackers
+            key = (addr, threading.get_ident())
+            cli = clients.get(key)
             if cli is None:
-                cli = clients[addr] = RpcClient(host, int(port),
-                                                secret=secret, scope=scope)
+                cli = clients[key] = RpcClient(host, int(port),
+                                               secret=secret, scope=scope)
         return cli
 
     return locate
@@ -224,6 +229,11 @@ class NodeRunner:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "NodeRunner":
+        if self.max_tpu_map_slots > 0:
+            # durable XLA compiles across worker processes — the TPU-era
+            # JvmManager-reuse analog (see parallel/jaxruntime.py)
+            from tpumr.parallel.jaxruntime import configure_persistent_cache
+            configure_persistent_cache(self.conf)
         self._server.start()
         self._hb_thread.start()
         self.metrics.start()

@@ -107,8 +107,10 @@ class TpuMapRunner(MapRunnable):
     def run(self, reader, output, reporter, task_ctx=None) -> None:
         import jax
         from tpumr.ops import get_kernel
+        from tpumr.parallel.jaxruntime import configure_persistent_cache
 
         conf = self.conf
+        configure_persistent_cache(conf)
         name = conf.get_map_kernel()
         if not name:
             raise ValueError(
@@ -182,6 +184,9 @@ def stage_batch(conf, reader, task_ctx, device=None) -> tuple[Any, bool, int]:
     cache: a cache hit skips storage I/O and the host→device transfer
     entirely; ``device=None`` stages on host (the CPU batch runner).
     Returns (batch, counted_by_reader, bytes_actually_staged)."""
+    if device is not None:
+        from tpumr.parallel.jaxruntime import configure_persistent_cache
+        configure_persistent_cache(conf)
     in_fmt = new_instance(conf.get_input_format(), conf)
     split = None
     if task_ctx is not None and getattr(task_ctx, "split", None):
@@ -268,6 +273,8 @@ def prelaunch_device_maps(conf, tasks: "list[Any]") -> "list[DevicePrefetch] | N
     """
     import jax
     from tpumr.ops import get_kernel
+    from tpumr.parallel.jaxruntime import configure_persistent_cache
+    configure_persistent_cache(conf)
 
     name = conf.get_map_kernel()
     if not name:

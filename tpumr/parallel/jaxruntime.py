@@ -1,0 +1,75 @@
+"""Process-level JAX runtime configuration.
+
+The reference amortizes task start-up with JVM reuse (JvmManager.java:322
+reapJvm); the TPU-native equivalent of that cost is XLA compilation — a
+fresh worker process otherwise pays every kernel/sort compile again (the
+device-shuffle sort alone is tens of seconds on a tunneled chip). The
+persistent compilation cache makes compiles durable ACROSS processes:
+first worker populates, every later worker (or restart, or next job) hits
+disk instead of the compiler.
+
+Conf keys:
+
+- ``tpumr.jax.cache.dir``: cache directory. Default
+  ``~/.cache/tpumr/jax-cache`` (per-user, NOT world-writable tmp — a
+  shared cache dir would let any local user poison compiled programs).
+  Set to ``none`` to disable.
+- ``tpumr.jax.cache.min.compile.secs``: only persist compiles that took
+  at least this long (default 0.5s — skips trivial host-callback jits,
+  keeps every kernel/sort compile that matters).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+_lock = threading.Lock()
+_configured = False
+
+
+def configure_persistent_cache(conf: Any = None) -> "str | None":
+    """Idempotently point JAX at the persistent compilation cache; first
+    caller in the process wins. Returns the cache dir (None = disabled).
+    Cheap after the first call — safe on every device-path entry."""
+    global _configured
+    if _configured:
+        import jax
+        return jax.config.jax_compilation_cache_dir
+    with _lock:
+        if _configured:
+            import jax
+            return jax.config.jax_compilation_cache_dir
+        path = None
+        if conf is not None:
+            path = conf.get("tpumr.jax.cache.dir")
+        if path is None:
+            path = os.environ.get("TPUMR_JAX_CACHE_DIR")
+        if path is None:
+            path = os.path.join(os.path.expanduser("~"), ".cache", "tpumr",
+                                "jax-cache")
+        if str(path).lower() in ("", "none", "off", "disabled"):
+            _configured = True
+            return None
+        import jax
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", str(path))
+            min_secs = 0.5
+            if conf is not None:
+                min_secs = conf.get_float(
+                    "tpumr.jax.cache.min.compile.secs", 0.5)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              min_secs)
+        except Exception:  # noqa: BLE001 — cache is an optimization only
+            _configured = True
+            return None
+        _configured = True
+        return str(path)
+
+
+def _reset_for_tests() -> None:
+    global _configured
+    with _lock:
+        _configured = False
