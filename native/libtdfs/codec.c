@@ -13,6 +13,8 @@ td_val td_int(int64_t x) { td_val v = td_null(); v.t = TD_INT; v.i = x; return v
 
 td_val td_bool(int x) { td_val v = td_null(); v.t = TD_BOOL; v.i = x ? 1 : 0; return v; }
 
+td_val td_float(double x) { td_val v = td_null(); v.t = TD_FLOAT; v.f = x; return v; }
+
 td_val td_text(const char* s) {
   td_val v = td_null();
   v.t = TD_TEXT;

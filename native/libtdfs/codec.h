@@ -40,6 +40,7 @@ typedef struct td_val {
 td_val td_null(void);
 td_val td_int(int64_t v);
 td_val td_bool(int v);
+td_val td_float(double v);
 td_val td_text(const char* s);
 td_val td_bytes(const char* data, size_t len);
 td_val td_list(size_t n);              /* items zeroed; fill items[i] */

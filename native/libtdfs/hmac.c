@@ -1,0 +1,139 @@
+/* SHA-256 (FIPS 180-4) + HMAC (RFC 2104). See hmac.h. */
+
+#include "hmac.h"
+
+#include <string.h>
+
+static const uint32_t K[64] = {
+    0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+    0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+    0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+    0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+    0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+    0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+    0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+    0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+    0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+    0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+    0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+    0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+    0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+
+#define ROTR(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void sha256_block(td_sha256_ctx* c, const unsigned char* p) {
+  uint32_t w[64], a, b, d, e, f, g, h, s0, s1, t1, t2, cc;
+  int i;
+  for (i = 0; i < 16; i++)
+    w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+           ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+  for (i = 16; i < 64; i++) {
+    s0 = ROTR(w[i - 15], 7) ^ ROTR(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    s1 = ROTR(w[i - 2], 17) ^ ROTR(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  a = c->h[0]; b = c->h[1]; cc = c->h[2]; d = c->h[3];
+  e = c->h[4]; f = c->h[5]; g = c->h[6]; h = c->h[7];
+  for (i = 0; i < 64; i++) {
+    s1 = ROTR(e, 6) ^ ROTR(e, 11) ^ ROTR(e, 25);
+    t1 = h + s1 + ((e & f) ^ (~e & g)) + K[i] + w[i];
+    s0 = ROTR(a, 2) ^ ROTR(a, 13) ^ ROTR(a, 22);
+    t2 = s0 + ((a & b) ^ (a & cc) ^ (b & cc));
+    h = g; g = f; f = e; e = d + t1;
+    d = cc; cc = b; b = a; a = t1 + t2;
+  }
+  c->h[0] += a; c->h[1] += b; c->h[2] += cc; c->h[3] += d;
+  c->h[4] += e; c->h[5] += f; c->h[6] += g; c->h[7] += h;
+}
+
+void td_sha256_init(td_sha256_ctx* c) {
+  c->h[0] = 0x6a09e667u; c->h[1] = 0xbb67ae85u;
+  c->h[2] = 0x3c6ef372u; c->h[3] = 0xa54ff53au;
+  c->h[4] = 0x510e527fu; c->h[5] = 0x9b05688cu;
+  c->h[6] = 0x1f83d9abu; c->h[7] = 0x5be0cd19u;
+  c->len = 0;
+  c->buflen = 0;
+}
+
+void td_sha256_update(td_sha256_ctx* c, const void* data, size_t n) {
+  const unsigned char* p = (const unsigned char*)data;
+  c->len += n;
+  while (n) {
+    size_t take = 64 - c->buflen;
+    if (take > n) take = n;
+    memcpy(c->buf + c->buflen, p, take);
+    c->buflen += take;
+    p += take;
+    n -= take;
+    if (c->buflen == 64) {
+      sha256_block(c, c->buf);
+      c->buflen = 0;
+    }
+  }
+}
+
+void td_sha256_final(td_sha256_ctx* c, unsigned char out[32]) {
+  uint64_t bits = c->len * 8;
+  unsigned char pad = 0x80;
+  unsigned char lenbe[8];
+  int i;
+  /* `bits` captured above — padding pushed through update() after this
+   * point no longer affects the encoded message length */
+  td_sha256_update(c, &pad, 1);
+  while (c->buflen != 56) {
+    unsigned char z = 0;
+    td_sha256_update(c, &z, 1);
+  }
+  for (i = 0; i < 8; i++) lenbe[i] = (unsigned char)(bits >> (56 - 8 * i));
+  memcpy(c->buf + c->buflen, lenbe, 8);
+  sha256_block(c, c->buf);
+  for (i = 0; i < 8; i++) {
+    out[4 * i] = (unsigned char)(c->h[i] >> 24);
+    out[4 * i + 1] = (unsigned char)(c->h[i] >> 16);
+    out[4 * i + 2] = (unsigned char)(c->h[i] >> 8);
+    out[4 * i + 3] = (unsigned char)c->h[i];
+  }
+}
+
+static void sha256_once(const void* d1, size_t n1, const void* d2, size_t n2,
+                        unsigned char out[32]) {
+  td_sha256_ctx c;
+  td_sha256_init(&c);
+  td_sha256_update(&c, d1, n1);
+  if (d2) td_sha256_update(&c, d2, n2);
+  td_sha256_final(&c, out);
+}
+
+void td_hmac_sha256_hex(const void* key, size_t keylen,
+                        const void* msg, size_t msglen,
+                        char out_hex[65]) {
+  unsigned char k[64], ipad[64], opad[64], inner[32], mac[32];
+  static const char hexd[] = "0123456789abcdef";
+  td_sha256_ctx c;
+  int i;
+  memset(k, 0, sizeof k);
+  if (keylen > 64) {
+    unsigned char kh[32];
+    sha256_once(key, keylen, NULL, 0, kh);
+    memcpy(k, kh, 32);
+  } else {
+    memcpy(k, key, keylen);
+  }
+  for (i = 0; i < 64; i++) {
+    ipad[i] = (unsigned char)(k[i] ^ 0x36);
+    opad[i] = (unsigned char)(k[i] ^ 0x5c);
+  }
+  td_sha256_init(&c);
+  td_sha256_update(&c, ipad, 64);
+  td_sha256_update(&c, msg, msglen);
+  td_sha256_final(&c, inner);
+  td_sha256_init(&c);
+  td_sha256_update(&c, opad, 64);
+  td_sha256_update(&c, inner, 32);
+  td_sha256_final(&c, mac);
+  for (i = 0; i < 32; i++) {
+    out_hex[2 * i] = hexd[mac[i] >> 4];
+    out_hex[2 * i + 1] = hexd[mac[i] & 15];
+  }
+  out_hex[64] = 0;
+}

@@ -1,0 +1,38 @@
+/* SHA-256 + HMAC-SHA256 for libtdfs RPC signing.
+ *
+ * ≈ the role DIGEST-MD5/SASL plays for the reference's libhdfs-over-JNI
+ * client (the Java client brings its own auth; this C client signs the
+ * framework's HMAC-SHA256 frames natively, tpumr/ipc/rpc.py:_sign).
+ * SHA-256 implemented from FIPS 180-4; no external dependencies.
+ */
+#ifndef TPUMR_HMAC_H
+#define TPUMR_HMAC_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+  uint32_t h[8];
+  uint64_t len;          /* total message bytes */
+  unsigned char buf[64];
+  size_t buflen;
+} td_sha256_ctx;
+
+void td_sha256_init(td_sha256_ctx* c);
+void td_sha256_update(td_sha256_ctx* c, const void* data, size_t n);
+void td_sha256_final(td_sha256_ctx* c, unsigned char out[32]);
+
+/* HMAC-SHA256(key, msg) -> 64-char lowercase hex + NUL. */
+void td_hmac_sha256_hex(const void* key, size_t keylen,
+                        const void* msg, size_t msglen,
+                        char out_hex[65]);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUMR_HMAC_H */

@@ -1,16 +1,25 @@
 /* libtdfs — see tdfs.h. RPC framing: 4-byte big-endian length +
  * codec-serialized dict {"id","method","params"} (tpumr/ipc/rpc.py).
- * Responses: {"id","result"} or {"id","error","traceback"}. */
+ * Responses: {"id","result"} or {"id","error","traceback"}.
+ *
+ * Cluster auth (tpumr.rpc.secret): an authenticated server greets each
+ * connection with {"hello":1,"nonce":...}; every request then carries
+ * cid/user/ts plus auth = HMAC-SHA256(secret, canon) where canon is the
+ * codec-serialized list [cid, id, method, params, ts, port, nonce,
+ * user, scope] (tpumr/ipc/rpc.py:_sign). Use tdfs_connect_secure. */
 
 #include "tdfs.h"
 #include "codec.h"
+#include "hmac.h"
 
 #include <arpa/inet.h>
 #include <netdb.h>
+#include <pwd.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 static __thread char g_err[1024];
@@ -26,7 +35,52 @@ static void set_err(const char* fmt, const char* detail) {
 typedef struct {
   int fd;
   int64_t next_id;
+  int port;                 /* dialed port — part of the signature canon */
+  char secret[256];
+  size_t secret_len;        /* 0 = auth off */
+  char nonce[128];          /* server hello nonce (hex text) */
+  char cid[33];             /* per-connection client id (hex) */
+  char user[64];            /* asserted simple-auth identity */
 } rpc_conn;
+
+static void fill_identity(rpc_conn* c) {
+  struct passwd* pw = getpwuid(getuid());
+  const char* u = pw ? pw->pw_name : getenv("USER");
+  unsigned char rnd[16];
+  size_t i;
+  FILE* f = fopen("/dev/urandom", "rb");
+  if (!f || fread(rnd, 1, sizeof rnd, f) != sizeof rnd)
+    for (i = 0; i < sizeof rnd; i++)
+      rnd[i] = (unsigned char)(rand() ^ (getpid() >> (i % 8)));
+  if (f) fclose(f);
+  for (i = 0; i < sizeof rnd; i++)
+    snprintf(c->cid + 2 * i, 3, "%02x", rnd[i]);
+  snprintf(c->user, sizeof c->user, "%s", u ? u : "nobody");
+}
+
+static int read_all(int fd, char* p, size_t n);
+
+/* Read one frame into a freshly decoded td_val; returns 0 ok. */
+static int recv_frame(int fd, td_val* out) {
+  unsigned char lenbe[4];
+  uint32_t rlen;
+  char* rdata;
+  size_t pos = 0;
+  if (read_all(fd, (char*)lenbe, 4)) return -1;
+  rlen = ((uint32_t)lenbe[0] << 24) | ((uint32_t)lenbe[1] << 16) |
+         ((uint32_t)lenbe[2] << 8) | lenbe[3];
+  rdata = (char*)malloc(rlen);
+  if (read_all(fd, rdata, rlen)) {
+    free(rdata);
+    return -1;
+  }
+  if (td_decode(rdata, rlen, &pos, out)) {
+    free(rdata);
+    return -1;
+  }
+  free(rdata);
+  return 0;
+}
 
 static int rpc_open(rpc_conn* c, const char* host, int port) {
   struct addrinfo hints, *res = NULL, *rp;
@@ -53,6 +107,37 @@ static int rpc_open(rpc_conn* c, const char* host, int port) {
     return -1;
   }
   c->next_id = 1;
+  c->port = port;
+  fill_identity(c);
+  if (c->secret_len) {
+    /* authenticated servers greet with a per-connection nonce the
+     * client must fold into every signature. Bounded wait (5s, like
+     * the Python client's fail-fast, rpc.py:364-373): an OPEN server
+     * sends nothing until a request arrives — without the timeout a
+     * config skew would hang forever instead of diagnosing. */
+    td_val hello;
+    const td_val* nv;
+    struct timeval hello_to = {5, 0}, clear_to = {0, 0};
+    setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &hello_to,
+               sizeof hello_to);
+    if (recv_frame(c->fd, &hello)) {
+      close(c->fd);
+      set_err("no auth hello from %s — secret configured but server "
+              "appears unauthenticated?", host);
+      return -1;
+    }
+    setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &clear_to,
+               sizeof clear_to);
+    nv = td_get(&hello, "nonce");
+    if (!nv || nv->t != TD_TEXT) {
+      td_free(&hello);
+      close(c->fd);
+      set_err("malformed auth hello from %s", host);
+      return -1;
+    }
+    snprintf(c->nonce, sizeof c->nonce, "%s", nv->s);
+    td_free(&hello);
+  }
   return 0;
 }
 
@@ -80,25 +165,67 @@ static int read_all(int fd, char* p, size_t n) {
  * On success returns 0 and fills *result (caller td_free's). */
 static int rpc_call(rpc_conn* c, const char* method, td_val params,
                     td_val* result) {
-  td_val req = td_dict(3);
+  int authed = c->secret_len > 0;
+  int64_t id = c->next_id++;
+  char auth_hex[65];
+  double ts = 0;
+  td_val req;
   td_buf buf;
   unsigned char lenbe[4];
-  uint32_t rlen;
-  char* rdata;
-  size_t pos = 0;
   td_val resp;
   const td_val* err;
   const td_val* res;
+  size_t k = 0;
   int rc = -1;
 
   *result = td_null();  /* every failure path leaves a freeable value */
 
-  req.items[0] = td_text("id");
-  req.items[1] = td_int(c->next_id++);
-  req.items[2] = td_text("method");
-  req.items[3] = td_text(method);
-  req.items[4] = td_text("params");
-  req.items[5] = params;
+  if (authed) {
+    /* canon = [cid, id, method, params, ts, port, nonce, user, scope]
+     * (tpumr/ipc/rpc.py:_sign) — params is BORROWED into the canon
+     * list and blanked before the free so ownership stays with req */
+    struct timeval tv;
+    td_val canon;
+    td_buf cbuf;
+    gettimeofday(&tv, NULL);
+    ts = (double)tv.tv_sec + (double)tv.tv_usec / 1e6;
+    canon = td_list(9);
+    canon.items[0] = td_text(c->cid);
+    canon.items[1] = td_int(id);
+    canon.items[2] = td_text(method);
+    canon.items[3] = params;                 /* borrowed */
+    canon.items[4] = td_float(ts);
+    canon.items[5] = td_int(c->port);
+    canon.items[6] = td_text(c->nonce);
+    canon.items[7] = td_text(c->user);
+    canon.items[8] = td_null();              /* scope: cluster secret */
+    td_buf_init(&cbuf);
+    td_encode(&cbuf, &canon);
+    memset(&canon.items[3], 0, sizeof(td_val));  /* un-borrow params */
+    canon.items[3].t = TD_NULL;
+    td_free(&canon);
+    td_hmac_sha256_hex(c->secret, c->secret_len, cbuf.data, cbuf.len,
+                       auth_hex);
+    td_buf_free(&cbuf);
+  }
+
+  req = td_dict(authed ? 7 : 5);
+  req.items[k++] = td_text("id");
+  req.items[k++] = td_int(id);
+  req.items[k++] = td_text("cid");
+  req.items[k++] = td_text(c->cid);
+  req.items[k++] = td_text("method");
+  req.items[k++] = td_text(method);
+  req.items[k++] = td_text("user");
+  req.items[k++] = td_text(c->user);
+  req.items[k++] = td_text("params");
+  req.items[k++] = params;
+  if (authed) {
+    req.items[k++] = td_text("ts");
+    req.items[k++] = td_float(ts);
+    req.items[k++] = td_text("auth");
+    req.items[k++] = td_text(auth_hex);
+  }
 
   td_buf_init(&buf);
   td_encode(&buf, &req);
@@ -116,24 +243,19 @@ static int rpc_call(rpc_conn* c, const char* method, td_val params,
   }
   td_buf_free(&buf);
 
-  if (read_all(c->fd, (char*)lenbe, 4)) {
+  if (recv_frame(c->fd, &resp)) {
     set_err("rpc recv failed%s", NULL);
     return -1;
   }
-  rlen = ((uint32_t)lenbe[0] << 24) | ((uint32_t)lenbe[1] << 16) |
-         ((uint32_t)lenbe[2] << 8) | lenbe[3];
-  rdata = (char*)malloc(rlen);
-  if (read_all(c->fd, rdata, rlen)) {
-    free(rdata);
-    set_err("rpc recv failed%s", NULL);
-    return -1;
+  /* an unauth client talking to an authed server sees the hello frame
+   * first — skip it so the real (auth error) response surfaces */
+  while (td_get(&resp, "hello")) {
+    td_free(&resp);
+    if (recv_frame(c->fd, &resp)) {
+      set_err("rpc recv failed%s", NULL);
+      return -1;
+    }
   }
-  if (td_decode(rdata, rlen, &pos, &resp)) {
-    free(rdata);
-    set_err("rpc decode failed%s", NULL);
-    return -1;
-  }
-  free(rdata);
 
   err = td_get(&resp, "error");
   if (err && err->t == TD_TEXT) {
@@ -160,8 +282,60 @@ struct tdfsFS_s {
   char client_name[64];
 };
 
+/* Open a DataNode connection inheriting the cluster secret: stack
+ * rpc_conn structs MUST be zeroed (rpc_open assumes secret fields are
+ * meaningful) and signed exactly like the NameNode channel — each
+ * connection gets its own hello nonce from its own server. */
+static int dn_open(tdfsFS* fs, rpc_conn* dn, const char* host, int port) {
+  memset(dn, 0, sizeof *dn);
+  memcpy(dn->secret, fs->nn.secret, fs->nn.secret_len);
+  dn->secret_len = fs->nn.secret_len;
+  return rpc_open(dn, host, port);
+}
+
 tdfsFS* tdfs_connect(const char* host, int port) {
+  return tdfs_connect_secure(host, port, NULL);
+}
+
+tdfsFS* tdfs_connect_secure(const char* host, int port,
+                            const char* secret_file) {
   tdfsFS* fs = (tdfsFS*)calloc(1, sizeof(tdfsFS));
+  if (secret_file && *secret_file) {
+    /* same semantics as tpumr.rpc.secret.file: bytes, whitespace
+     * stripped at both ends */
+    FILE* f = fopen(secret_file, "rb");
+    size_t n, start, end;
+    if (!f) {
+      set_err("cannot open secret file %s", secret_file);
+      free(fs);
+      return NULL;
+    }
+    n = fread(fs->nn.secret, 1, sizeof fs->nn.secret - 1, f);
+    if (n == sizeof fs->nn.secret - 1 && fgetc(f) != EOF) {
+      /* never sign with a silently-truncated key: Python reads the
+       * whole file, so a truncated HMAC would fail with a misleading
+       * "not signed" — fail loudly here instead */
+      fclose(f);
+      set_err("secret file %s exceeds the supported 255 bytes",
+              secret_file);
+      free(fs);
+      return NULL;
+    }
+    fclose(f);
+    start = 0;
+    end = n;
+    while (end > start && (unsigned char)fs->nn.secret[end - 1] <= ' ')
+      end--;
+    while (start < end && (unsigned char)fs->nn.secret[start] <= ' ')
+      start++;
+    memmove(fs->nn.secret, fs->nn.secret + start, end - start);
+    fs->nn.secret_len = end - start;
+    if (!fs->nn.secret_len) {
+      set_err("secret file %s is empty", secret_file);
+      free(fs);
+      return NULL;
+    }
+  }
   if (rpc_open(&fs->nn, host, port)) {
     free(fs);
     return NULL;
@@ -279,7 +453,7 @@ char* tdfs_read_file(tdfsFS* fs, const char* path, int64_t* len_out) {
       if (locs->items[j].t != TD_TEXT ||
           dn_split(locs->items[j].s, host, sizeof host, &port))
         continue;
-      if (rpc_open(&dn, host, port)) continue;
+      if (dn_open(fs, &dn, host, port)) continue;
       dp = td_list(1);
       dp.items[0] = td_int(bid->i);
       if (rpc_call(&dn, "read_block", dp, &data) == 0 &&
@@ -358,7 +532,7 @@ int tdfs_write_file(tdfsFS* fs, const char* path, const char* data,
       set_err("bad block allocation for %s", path);
       return -1;
     }
-    if (rpc_open(&dn, host, port)) {
+    if (dn_open(fs, &dn, host, port)) {
       td_free(&alloc);
       return -1;
     }

@@ -8,8 +8,10 @@
  * process; this is a plain TCP client).
  *
  * Thread safety: one tdfsFS per thread (connection state is per-handle).
- * Cluster auth (tpumr.rpc.secret) is not supported — connect to open
- * clusters only (documented divergence).
+ * Cluster auth: tdfs_connect_secure signs every request with
+ * HMAC-SHA256 over the framework's canonical frame (hmac.h) — full
+ * parity with authenticated Python clients (the reference's libhdfs
+ * inherits auth via JNI; this client implements it natively).
  */
 #ifndef TPUMR_TDFS_H
 #define TPUMR_TDFS_H
@@ -25,6 +27,13 @@ typedef struct tdfsFS_s tdfsFS;
 
 /* Connect to a NameNode; NULL on failure (see tdfs_last_error). */
 tdfsFS* tdfs_connect(const char* host, int port);
+
+/* Connect to a secret-protected cluster: secret_file holds the cluster
+ * secret (tpumr.rpc.secret.file semantics — surrounding whitespace
+ * stripped). Pass NULL/"" for an open cluster. */
+tdfsFS* tdfs_connect_secure(const char* host, int port,
+                            const char* secret_file);
+
 void tdfs_disconnect(tdfsFS* fs);
 
 /* Namespace ops: 1 = yes/ok, 0 = no, -1 = error. */

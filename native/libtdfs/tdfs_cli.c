@@ -19,14 +19,16 @@ int main(int argc, char** argv) {
     fprintf(stderr,
             "usage: tdfs_cli HOST PORT CMD [args]\n"
             "  exists PATH | mkdirs PATH | delete PATH | rename SRC DST\n"
-            "  size PATH | cat PATH | put LOCAL PATH\n");
+            "  size PATH | cat PATH | put LOCAL PATH\n"
+            "  TDFS_SECRET_FILE env: cluster secret for authenticated "
+            "clusters\n");
     return 2;
   }
   host = argv[1];
   port = atoi(argv[2]);
   cmd = argv[3];
 
-  fs = tdfs_connect(host, port);
+  fs = tdfs_connect_secure(host, port, getenv("TDFS_SECRET_FILE"));
   if (!fs) {
     fprintf(stderr, "connect failed: %s\n", tdfs_last_error());
     return 2;
@@ -34,10 +36,17 @@ int main(int argc, char** argv) {
 
   if (strcmp(cmd, "exists") == 0 && argc == 5) {
     rc = tdfs_exists(fs, argv[4]);
-    printf("%s\n", rc == 1 ? "yes" : "no");
-    rc = rc == 1 ? 0 : 1;
+    if (rc < 0) {
+      fprintf(stderr, "exists failed: %s\n", tdfs_last_error());
+      rc = 2;
+    } else {
+      printf("%s\n", rc == 1 ? "yes" : "no");
+      rc = rc == 1 ? 0 : 1;
+    }
   } else if (strcmp(cmd, "mkdirs") == 0 && argc == 5) {
-    rc = tdfs_mkdirs(fs, argv[4]) == 1 ? 0 : 1;
+    rc = tdfs_mkdirs(fs, argv[4]);
+    if (rc < 0) fprintf(stderr, "mkdirs failed: %s\n", tdfs_last_error());
+    rc = rc == 1 ? 0 : 1;
   } else if (strcmp(cmd, "delete") == 0 && argc == 5) {
     rc = tdfs_delete(fs, argv[4], 1) == 1 ? 0 : 1;
   } else if (strcmp(cmd, "rename") == 0 && argc == 6) {

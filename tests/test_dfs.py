@@ -675,3 +675,90 @@ def test_trash_emptier_runs_on_namenode(tmp_path):
             time.sleep(0.2)
         else:
             pytest.fail(f"emptier never cleaned: {paths}")
+
+
+class TestAppend:
+    """Block-granular append + hflush (≈ the dfs.support.append client
+    path, hdfs/DFSClient.java; divergence documented in OPERATIONS.md)."""
+
+    def test_append_extends_file(self, cluster):
+        client = cluster.client()
+        with client.create("/ap/log.txt") as f:
+            f.write(b"first|")
+        with client.append("/ap/log.txt") as f:
+            f.write(b"second|")
+        with client.append("/ap/log.txt") as f:
+            f.write(b"third")
+        with client.open("/ap/log.txt") as f:
+            assert f.read() == b"first|second|third"
+        assert client.get_status("/ap/log.txt")["length"] == \
+            len(b"first|second|third")
+
+    def test_append_multiblock_payload(self, cluster):
+        client = cluster.client()
+        base = bytes(range(256)) * 2            # 512 B
+        more = b"Z" * 3000                      # > 2 blocks of 1 KiB
+        with client.create("/ap/big.bin") as f:
+            f.write(base)
+        with client.append("/ap/big.bin") as f:
+            f.write(more)
+        with client.open("/ap/big.bin") as f:
+            assert f.read() == base + more
+
+    def test_hflush_publishes_to_concurrent_reader(self, cluster):
+        client = cluster.client()
+        writer = client.create("/ap/stream.log")
+        writer.write(b"record-1\n")
+        writer.hflush()
+        # a second client reads everything up to the hflush while the
+        # writer still holds the lease
+        reader = cluster.client()
+        with reader.open("/ap/stream.log") as f:
+            assert f.read() == b"record-1\n"
+        writer.write(b"record-2\n")             # buffered, NOT yet visible
+        with reader.open("/ap/stream.log") as f:
+            assert f.read() == b"record-1\n"
+        writer.hflush()
+        with reader.open("/ap/stream.log") as f:
+            assert f.read() == b"record-1\nrecord-2\n"
+        writer.close()
+        with reader.open("/ap/stream.log") as f:
+            assert f.read() == b"record-1\nrecord-2\n"
+
+    def test_append_respects_single_writer_lease(self, cluster):
+        client = cluster.client()
+        with client.create("/ap/lease.txt") as f:
+            f.write(b"x")
+        w1 = client.append("/ap/lease.txt")
+        other = cluster.client()
+        from tpumr.ipc.rpc import RpcError
+        with pytest.raises(RpcError, match="open for writing"):
+            other.append("/ap/lease.txt")
+        w1.close()
+        # lease released on close: now the other client may append
+        w2 = other.append("/ap/lease.txt")
+        w2.write(b"y")
+        w2.close()
+        with client.open("/ap/lease.txt") as f:
+            assert f.read() == b"xy"
+
+    def test_append_survives_namenode_restart(self):
+        conf = small_conf()
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            client = c.client()
+            with client.create("/ap/r.txt") as f:
+                f.write(b"aa")
+            with client.append("/ap/r.txt") as f:
+                f.write(b"bb")
+            c.restart_namenode()
+            client2 = c.client()
+            deadline = time.time() + 15
+            while time.time() < deadline:   # wait out safemode + reports
+                try:
+                    if not client2.nn.call("safemode", "get"):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            with client2.open("/ap/r.txt") as f:
+                assert f.read() == b"aabb"

@@ -1,0 +1,188 @@
+"""The new-API helper library (≈ mapreduce/lib/): mappers, reducers,
+partitioners, lazy output, and JobControl — driven end-to-end through the
+new-API Job facade (reference: src/mapred/org/apache/hadoop/mapreduce/lib/)."""
+
+import pytest
+
+from tpumr.fs import FileSystem, get_filesystem
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapreduce import Job, Mapper
+from tpumr.mapreduce.lib import (BinaryPartitioner, ControlledJob,
+                                 InverseMapper, IntSumReducer, JobControl,
+                                 KeyFieldBasedPartitioner, LazyOutputFormat,
+                                 LongSumReducer, MultithreadedMapper,
+                                 RegexMapper, TokenCounterMapper)
+
+
+@pytest.fixture(autouse=True)
+def _clear_fs():
+    yield
+    FileSystem.clear_cache()
+
+
+def read_parts(fs, outdir: str) -> str:
+    out = []
+    for st in sorted(fs.list_status(outdir), key=lambda s: str(s.path)):
+        if "part-" in str(st.path):
+            out.append(fs.read_bytes(st.path).decode())
+    return "".join(out)
+
+
+def new_job(name: str, inp: str, out: str) -> Job:
+    job = Job(JobConf(), name=name)
+    job.add_input_path(inp)
+    job.set_output_path(out)
+    return job
+
+
+class TestLibEndToEnd:
+    def test_wordcount_through_new_api(self):
+        """The canonical example, all-new-API: TokenCounterMapper +
+        IntSumReducer (≈ the reference's rewritten WordCount.java)."""
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/nl/in.txt", b"ab cd ab\nef ab cd\n")
+        job = new_job("wc-new", "mem:///nl/in.txt", "mem:///nl/out")
+        job.set_mapper_class(TokenCounterMapper)
+        job.set_combiner_class(IntSumReducer)
+        job.set_reducer_class(IntSumReducer)
+        job.set_num_reduce_tasks(1)
+        assert job.wait_for_completion()
+        text = read_parts(fs, "/nl/out")
+        assert "ab\t3" in text and "cd\t2" in text and "ef\t1" in text
+
+    def test_grep_through_new_api(self):
+        """RegexMapper + LongSumReducer ≈ the new-API Grep example."""
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/nl/g.txt", b"error: one\nok\nerror: two\n")
+        job = new_job("grep-new", "mem:///nl/g.txt", "mem:///nl/gout")
+        job.conf.set("mapreduce.mapper.regex", r"error: (\w+)")
+        job.conf.set("mapreduce.mapper.regex.group", 1)
+        job.set_mapper_class(RegexMapper)
+        job.set_reducer_class(LongSumReducer)
+        assert job.wait_for_completion()
+        text = read_parts(fs, "/nl/gout")
+        assert "one\t1" in text and "two\t1" in text and "ok" not in text
+
+    def test_inverse_mapper(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/nl/i.txt", b"x\ny\n")
+        job = new_job("inv", "mem:///nl/i.txt", "mem:///nl/iout")
+        job.set_mapper_class(InverseMapper)
+        job.set_num_reduce_tasks(0)
+        assert job.wait_for_completion()
+        # TextInputFormat keys are byte offsets; inverted => value is offset
+        text = read_parts(fs, "/nl/iout")
+        assert text.splitlines()[0].startswith("x\t")
+
+    def test_multithreaded_mapper(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/nl/mt.txt", b"".join(b"w%d\n" % i
+                                              for i in range(200)))
+        job = new_job("mt", "mem:///nl/mt.txt", "mem:///nl/mtout")
+        job.conf.set_class("mapreduce.mapper.multithreadedmapper.class",
+                           TokenCounterMapper)
+        job.conf.set("mapreduce.mapper.multithreadedmapper.threads", 4)
+        job.set_mapper_class(MultithreadedMapper)
+        job.set_reducer_class(IntSumReducer)
+        assert job.wait_for_completion()
+        text = read_parts(fs, "/nl/mtout")
+        assert len(text.splitlines()) == 200
+        assert "w0\t1" in text
+
+    def test_multithreaded_mapper_propagates_error(self):
+        class Boom(Mapper):
+            def map(self, key, value, context):
+                raise ValueError("inner mapper failure")
+
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/nl/mte.txt", b"a\nb\n")
+        job = new_job("mte", "mem:///nl/mte.txt", "mem:///nl/mteout")
+        job.conf.set_class("mapreduce.mapper.multithreadedmapper.class",
+                           Boom)
+        job.set_mapper_class(MultithreadedMapper)
+        assert not job.wait_for_completion()
+        assert "inner mapper failure" in job.error
+
+
+class TestPartitioners:
+    def test_binary_partitioner_ranges(self):
+        import zlib
+        p = BinaryPartitioner()                 # whole key
+        q = BinaryPartitioner(left=0, right=1)  # first two bytes
+        assert p.get_partition(b"aa-111", None, 16) == \
+            zlib.crc32(b"aa-111") % 16
+        assert q.get_partition(b"aa-111", None, 16) == \
+            zlib.crc32(b"aa") % 16
+        assert q.get_partition(b"aa-111", None, 16) == \
+            q.get_partition(b"aa-222", None, 16)   # same 2-byte prefix
+
+    def test_key_field_partitioner_delegates(self):
+        p = KeyFieldBasedPartitioner(num_fields=1)
+        assert p.get_partition("k1\tx", None, 8) == \
+            p.get_partition("k1\ty", None, 8)
+
+    def test_partitioner_wired_through_job(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/nl/p.txt", b"a 1\na 2\nb 3\n")
+
+        job = new_job("part", "mem:///nl/p.txt", "mem:///nl/pout")
+        job.set_mapper_class(TokenCounterMapper)
+        job.set_reducer_class(IntSumReducer)
+        job.set_partitioner_class(BinaryPartitioner)
+        job.set_num_reduce_tasks(2)
+        assert job.wait_for_completion()
+        text = read_parts(fs, "/nl/pout")
+        assert "a\t2" in text and "b\t1" in text
+
+
+class TestLazyOutput:
+    def test_empty_partition_writes_no_part_file(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/nl/lz.txt", b"only one key\n")
+        job = new_job("lazy", "mem:///nl/lz.txt", "mem:///nl/lzout")
+        from tpumr.mapreduce.lib import TextOutputFormat
+        job.set_mapper_class(TokenCounterMapper)
+        job.set_reducer_class(IntSumReducer)
+        LazyOutputFormat.set_output_format_class(job, TextOutputFormat)
+        job.set_num_reduce_tasks(4)             # 3 keys -> >=1 empty part
+        assert job.wait_for_completion()
+        parts = [st for st in fs.list_status("/nl/lzout")
+                 if "part-" in str(st.path)]
+        assert 0 < len(parts) < 4               # empty partitions: no file
+        text = read_parts(fs, "/nl/lzout")
+        assert "only\t1" in text and "one\t1" in text and "key\t1" in text
+
+
+class TestJobControl:
+    def _mk(self, fs, name, inp, out):
+        job = new_job(name, inp, out)
+        job.set_mapper_class(TokenCounterMapper)
+        job.set_reducer_class(IntSumReducer)
+        return job
+
+    def test_dependency_order_and_success(self):
+        fs = get_filesystem("mem:///")
+        fs.write_bytes("/jc/in.txt", b"x y x\n")
+        j1 = self._mk(fs, "first", "mem:///jc/in.txt", "mem:///jc/out1")
+        # second consumes the first's output
+        j2 = self._mk(fs, "second", "mem:///jc/out1", "mem:///jc/out2")
+        jc = JobControl()
+        c1 = jc.add_job(ControlledJob(j1))
+        c2 = jc.add_job(ControlledJob(j2, depending=[c1]))
+        jc.run()
+        assert jc.all_finished and not jc.failed_jobs()
+        assert c1.state == ControlledJob.SUCCESS
+        assert c2.state == ControlledJob.SUCCESS
+        assert "x\t1" in read_parts(fs, "/jc/out2")  # counted the counts
+
+    def test_dependent_failure_propagates(self):
+        fs = get_filesystem("mem:///")
+        j1 = self._mk(fs, "bad", "mem:///jc/missing", "mem:///jc/bout1")
+        j2 = self._mk(fs, "after", "mem:///jc/bout1", "mem:///jc/bout2")
+        jc = JobControl()
+        c1 = jc.add_job(ControlledJob(j1))
+        c2 = jc.add_job(ControlledJob(j2, depending=[c1]))
+        jc.run()
+        assert c1.state == ControlledJob.FAILED
+        assert c2.state == ControlledJob.DEPENDENT_FAILED
+        assert jc.failed_jobs() == [c1, c2]

@@ -235,6 +235,7 @@ class TestPersistentCompilationCache:
             "conf.set('tpumr.jax.cache.min.compile.secs', 0.0)\n"
             "configure_persistent_cache(conf)\n"
             "import jax, jax.numpy as jnp\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
             "f = jax.jit(lambda x: jnp.sort(x * 2 + 1, axis=0))\n"
             "f(jnp.zeros((4096, 8))).block_until_ready()\n"
         ) % (repo_root, str(tmp_path / "xc"))

@@ -219,3 +219,62 @@ def test_lost_tracker_requeues_completed_maps():
     assert job.finished_maps == 0
     assert job.pending_map_count() == 2
     assert not job.completion_events
+
+
+def test_per_job_minimize_mode_override():
+    """A job may opt into the f(x,y) minimizer via its own conf while the
+    cluster default stays shirahata (the bench's convergence round uses
+    exactly this seam)."""
+    job = make_job(n_maps=10)
+    job.conf["tpumr.scheduler.mode"] = "minimize"
+    for on_tpu, runtime in [(False, 10.0), (True, 1.0)]:
+        t = job.obtain_new_map_task("host0", run_on_tpu=on_tpu,
+                                    tpu_device_id=0 if on_tpu else -1)
+        finish_map(job, t, runtime, on_tpu)
+    sched = make_scheduler([job])          # cluster mode: shirahata
+    tasks = sched.assign_tasks(tracker_status())
+    # optimum at 10x accel, 1 TPU slot: zero CPU share — only TPU maps
+    assert [t.run_on_tpu for t in tasks if t.is_map] == [True]
+
+    # the same cluster WITHOUT the job override fills both pools
+    plain = make_job(n_maps=10, job_num=2)
+    for on_tpu, runtime in [(False, 10.0), (True, 1.0)]:
+        t = plain.obtain_new_map_task("host0", run_on_tpu=on_tpu,
+                                      tpu_device_id=0 if on_tpu else -1)
+        finish_map(plain, t, runtime, on_tpu)
+    sched2 = make_scheduler([plain])
+    tasks2 = sched2.assign_tasks(tracker_status())
+    assert len([t for t in tasks2 if t.is_map and not t.run_on_tpu]) == 3
+
+
+def test_within_job_convergence_timeline():
+    """The convergence clause end-to-end at the scheduler level: a many-
+    map job starts with no profile (both pools fill); once per-backend
+    means exist and pending drops below accel x tpuCapacity x trackers,
+    the CPU pass stops and the TAIL of the job is all-TPU."""
+    job = make_job(n_maps=24, optional=True)
+    sched = make_scheduler([job], n_trackers=2)
+    placements = []
+    for _hb in range(100):
+        if job.pending_map_count() == 0:
+            break
+        tasks = [t for t in sched.assign_tasks(tracker_status())
+                 if t.is_map]
+        for t in tasks:
+            placements.append(t.run_on_tpu)
+            # every map "runs" instantly: CPU maps 10s, TPU maps 1s
+            finish_map(job, t, 10.0 if not t.run_on_tpu else 1.0,
+                       t.run_on_tpu)
+    assert job.pending_map_count() == 0
+    # early waves used the CPU pool (TPU pass runs first, so the first
+    # heartbeat is 1 TPU + 3 CPU maps), and the tail converged to all-TPU
+    assert not all(placements[:4])
+    assert placements[-1] and placements[-2]
+    tail = 0
+    for b in reversed(placements):
+        if not b:
+            break
+        tail += 1
+    # accel=10, capacity 1x2 -> starvation from pending<20: nearly the
+    # whole job after the first profiled wave goes TPU
+    assert tail >= 10, (placements, tail)

@@ -252,7 +252,9 @@ def cmd_job(conf, argv: list[str]) -> int:
                              default=str))
             return 0
         if cmd == "-kill":
-            ok = client.call("kill_job", rest[0])
+            from tpumr.security import UserGroupInformation
+            ok = client.call("kill_job", rest[0],
+                             UserGroupInformation.get_current_user().user)
             print(f"Killed {rest[0]}" if ok
                   else f"{rest[0]} already finished; not killed")
             return 0 if ok else 1

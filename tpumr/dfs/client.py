@@ -84,6 +84,14 @@ class DFSClient:
         self._writer_opened()
         return _DFSOutputStream(self, path, meta["block_size"])
 
+    def append(self, path: str) -> "_DFSOutputStream":
+        """Reopen a complete file for block-granular append (≈
+        DFSClient.append, hdfs/DFSClient.java): appended data lands in
+        new blocks; ``hflush()`` publishes it to readers mid-write."""
+        meta = self.nn.call("append", path, self.name)
+        self._writer_opened()
+        return _DFSOutputStream(self, path, meta["block_size"])
+
     # ------------------------------------------------------------ read
 
     def open(self, path: str) -> io.BufferedReader:
@@ -173,6 +181,22 @@ class _DFSOutputStream(io.RawIOBase):
                                     self.client.name, bid)
         raise IOError(f"write pipeline failed for {self.path} after "
                       f"{self.MAX_BLOCK_RETRIES} attempts: {last_err}")
+
+    def hflush(self) -> None:
+        """Make everything written so far visible to readers (≈
+        DFSOutputStream.sync/hflush): flush the buffer as a (possibly
+        short) block, then have the NameNode journal its true size.
+        Log-style writers call this at record boundaries; each hflush
+        seals a block, so batch accordingly (block-granular append)."""
+        if self._buf:
+            data = bytes(self._buf)
+            self._buf.clear()
+            self._flush_block(data)
+        if self._prev_block_size >= 0:
+            self.client.nn.call("fsync", self.path, self.client.name,
+                                self._prev_block_size)
+            # size is journaled — add_block/close must not re-settle it
+            self._prev_block_size = -1
 
     def close(self) -> None:
         if self._closed:

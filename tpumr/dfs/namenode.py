@@ -160,6 +160,9 @@ class FSNamesystem:
                             "owner": op.get("o", ""),
                             "group": op.get("g", ""),
                             "mode": op.get("m", 0o644)}
+        elif kind == "append_open":
+            namespace[p]["uc"] = True
+            namespace[p]["client"] = op.get("c", "")
         elif kind == "add_block":
             namespace[p]["blocks"].append([op["bid"], 0])
         elif kind == "block_size":
@@ -498,6 +501,59 @@ class FSNamesystem:
             lease["paths"].add(path)
             lease["renewed"] = _now()
             return {"replication": r, "block_size": bs}
+
+    def append(self, path: str, client: str) -> dict:
+        """Reopen a complete file for writing (≈ ClientProtocol.append,
+        hdfs/DFSClient.java append path). BLOCK-GRANULAR by design:
+        appended data lands in NEW blocks (short tail blocks stay
+        short) — the reference appends into the last block under a new
+        generation stamp; immutable whole-block datanode storage here
+        makes new-blocks the honest equivalent (divergence documented in
+        docs/OPERATIONS.md)."""
+        with self.lock:
+            self._check_safemode()
+            user = self._caller()
+            inode = self._inode(path)
+            if inode["type"] != "file":
+                raise IsADirectoryError(path)
+            if inode.get("uc"):
+                raise LeaseError(
+                    f"{path} already open for writing by "
+                    f"{inode.get('client')}")
+            self._check_access(path, 2, user)
+            op = {"op": "append_open", "path": path, "c": client,
+                  "t": _now()}
+            self._log(op)
+            self.apply_op(self.namespace, self.counters, op)
+            lease = self.leases.setdefault(
+                client, {"paths": set(), "renewed": _now()})
+            lease["paths"].add(path)
+            lease["renewed"] = _now()
+            return {"block_size": inode["block_size"],
+                    "replication": inode.get("replication", 1)}
+
+    def fsync(self, path: str, client: str, last_block_size: int) -> None:
+        """Publish the last block's true size while the file stays open
+        (≈ ClientProtocol.fsync — the hflush visibility point: readers
+        see everything up to the last fsync'd block, never the writer's
+        unflushed buffer)."""
+        with self.lock:
+            inode = self._inode(path)
+            if not inode.get("uc") or inode.get("client") != client:
+                raise LeaseError(
+                    f"{client} does not hold the lease on {path}")
+            if inode["blocks"] and last_block_size >= 0:
+                bid = inode["blocks"][-1][0]
+                op = {"op": "block_size", "path": path, "bid": bid,
+                      "size": last_block_size}
+                self._log(op)
+                self.apply_op(self.namespace, self.counters, op)
+                # settle the optimistic full-block charge now; the
+                # client resets its prev-size so add_block/close never
+                # re-settle the same block
+                self._charge(path, 0,
+                             (last_block_size - inode["block_size"])
+                             * inode.get("replication", 1))
 
     def add_block(self, path: str, client: str,
                   prev_block_size: int = -1,
@@ -1388,6 +1444,12 @@ class NameNode:
                overwrite=True):
         return self.ns.create(path, client, replication, block_size,
                               overwrite)
+
+    def append(self, path, client):
+        return self.ns.append(path, client)
+
+    def fsync(self, path, client, last_block_size):
+        return self.ns.fsync(path, client, last_block_size)
 
     def add_block(self, path, client, prev_block_size=-1, excluded=None):
         return self.ns.add_block(path, client, prev_block_size, excluded)

@@ -44,7 +44,9 @@ class RunningJob:
         return self._client.call("get_task_reports", self.job_id, kind)
 
     def kill(self) -> None:
-        self._client.call("kill_job", self.job_id)
+        from tpumr.security import UserGroupInformation
+        self._client.call("kill_job", self.job_id,
+                          UserGroupInformation.get_current_user().user)
 
     def wait_for_completion(self, poll_s: float = 0.2,
                             timeout: float = 3600.0) -> dict:

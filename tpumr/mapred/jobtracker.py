@@ -75,6 +75,10 @@ class JobMaster:
                                    HybridQueueScheduler)
         self.scheduler: TaskScheduler = new_instance(sched_cls, conf)
         self.scheduler.set_manager(self)
+        # per-queue submit/administer ACLs ≈ QueueManager.java +
+        # mapred-queue-acls.xml, enforced in submit_job and kill_job
+        from tpumr.mapred.queue_manager import QueueManager
+        self.queue_manager = QueueManager(conf)
         self.history = JobHistory(conf)
         from tpumr.security import rpc_secret
         self._rpc_secret = rpc_secret(conf)
@@ -349,6 +353,16 @@ class JobMaster:
         return PROTOCOL_VERSION
 
     def submit_job(self, conf_dict: dict, splits: list) -> str:
+        # submit-time queue validation + ACL (≈ JobTracker.submitJob →
+        # QueueManager.hasAccess(SUBMIT_JOB)): rejected jobs never enter
+        # any scheduler queue
+        from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
+        from tpumr.security import server_side_ugi
+        queue = str(conf_dict.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
+                    or DEFAULT_QUEUE)
+        self.queue_manager.check_submit(
+            queue, server_side_ugi(str(conf_dict.get("user.name", "")),
+                                   self.conf))
         with self.lock:
             self._next_job += 1
             job_id = JobID(self.cluster_id, self._next_job)
@@ -399,8 +413,24 @@ class JobMaster:
             "successful_attempt": t.report.successful_attempt,
         } for t in tips]
 
-    def kill_job(self, job_id: str) -> bool:
+    def kill_job(self, job_id: str, user: str = "") -> bool:
         jip = self._job(job_id)
+        # job-level ACL (≈ JobTracker.killJob → ADMINISTER_JOBS check):
+        # owner always may; others need the queue's administer ACL.
+        # ``user`` is the caller's asserted simple-auth identity, like
+        # the reference's non-Kerberos UGI over the wire. A caller that
+        # sends NO identity is treated as an anonymous nobody — never as
+        # the daemon's own (usually administrator) identity, which would
+        # turn the old 1-arg call signature into an ACL bypass.
+        from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
+        from tpumr.security import UserGroupInformation
+        from tpumr.security import server_side_ugi
+        queue = str(jip.conf.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
+                    or DEFAULT_QUEUE)
+        owner = str(jip.conf.get("user.name", ""))
+        ugi = (server_side_ugi(user, self.conf) if user
+               else UserGroupInformation("anonymous", []))
+        self.queue_manager.check_administer(queue, ugi, owner)
         # kill() no-ops if a concurrent heartbeat already made it terminal
         if not jip.kill():  # ≈ JobTracker.killJob: no-op on finished jobs
             return False

@@ -1,0 +1,125 @@
+"""Queue administration: per-queue submit/administer ACLs.
+
+≈ ``org.apache.hadoop.mapred.QueueManager`` + ``conf/mapred-queue-acls.xml``
+(reference: src/mapred/org/apache/hadoop/mapred/QueueManager.java — queue
+set from ``mapred.queue.names``, ACL enforcement gated on
+``mapred.acls.enabled``, per-queue keys
+``mapred.queue.<name>.acl-submit-job`` / ``acl-administer-jobs``, checked
+at submit and at job kill/modify). Reference ACL syntax kept:
+
+- ``*``                      — everyone
+- ``user1,user2 group1,...`` — space-separated user list then group list
+- `` `` (blank)              — no one (owner/superuser still pass)
+
+Identity is the simple-auth model the rest of the framework uses
+(UserGroupInformation: asserted, not cryptographically proven — exactly
+the reference's non-Kerberos default; see docs/OPERATIONS.md threat
+model). The job OWNER and the cluster superuser
+(``mapred.cluster.administrators`` users/groups) always administer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpumr.security import UserGroupInformation
+
+QUEUE_NAMES_KEY = "mapred.queue.names"
+ACLS_ENABLED_KEY = "mapred.acls.enabled"
+JOB_QUEUE_KEY = "mapred.job.queue.name"
+ADMINS_KEY = "mapred.cluster.administrators"
+DEFAULT_QUEUE = "default"
+
+
+class AccessControlList:
+    """One ACL entry, reference syntax (users SP groups | ``*``)."""
+
+    def __init__(self, spec: str) -> None:
+        self.spec = spec = (spec or "").strip()
+        self.all = spec == "*"
+        users: set[str] = set()
+        groups: set[str] = set()
+        if not self.all and spec:
+            parts = spec.split(None, 1)
+            users = {u for u in parts[0].split(",") if u}
+            if len(parts) > 1:
+                groups = {g for g in parts[1].split(",") if g}
+        self.users = users
+        self.groups = groups
+
+    def allows(self, ugi: UserGroupInformation) -> bool:
+        if self.all:
+            return True
+        return (ugi.user in self.users
+                or any(g in self.groups for g in ugi.groups))
+
+
+class QueueManager:
+    def __init__(self, conf: Any) -> None:
+        self.conf = conf
+        explicit = conf.get(QUEUE_NAMES_KEY)
+        names = str(explicit if explicit is not None
+                    else (conf.get("tpumr.capacity.queues")
+                          or DEFAULT_QUEUE))
+        self.queue_names = [q.strip() for q in names.split(",") if q.strip()]
+        # queue EXISTENCE is enforced only when the operator configured
+        # mapred.queue.names explicitly — otherwise the capacity
+        # scheduler's documented phantom-bucket semantics (unconfigured
+        # queues scheduled last, never rejected) stay intact. Documented
+        # divergence from the reference, which always enforces.
+        self.enforce_exists = explicit is not None
+        self.acls_enabled = bool(conf.get_boolean(ACLS_ENABLED_KEY, False)) \
+            if hasattr(conf, "get_boolean") else \
+            str(conf.get(ACLS_ENABLED_KEY, "false")).lower() == "true"
+        self._admins = AccessControlList(str(conf.get(ADMINS_KEY, "") or ""))
+
+    # ------------------------------------------------------------ lookups
+
+    def queues(self) -> "list[str]":
+        return list(self.queue_names)
+
+    def _acl(self, queue: str, op: str) -> AccessControlList:
+        spec = self.conf.get(f"mapred.queue.{queue}.acl-{op}")
+        # unset = open, the reference's default (QueueManager.java: a
+        # missing key behaves as "*")
+        return AccessControlList("*" if spec is None else str(spec))
+
+    # ------------------------------------------------------------- checks
+
+    def has_access(self, queue: str, op: str,
+                   ugi: UserGroupInformation) -> bool:
+        """op ∈ {"submit-job", "administer-jobs"}."""
+        if not self.acls_enabled:
+            return True
+        if self._admins.allows(ugi):
+            return True
+        return self._acl(queue, op).allows(ugi)
+
+    def check_queue_exists(self, queue: str) -> None:
+        if self.enforce_exists and queue not in self.queue_names:
+            raise PermissionError(
+                f"queue {queue!r} is not defined; configured queues: "
+                f"{', '.join(self.queue_names)} ({QUEUE_NAMES_KEY})")
+
+    def check_submit(self, queue: str, ugi: UserGroupInformation) -> None:
+        """Submit-time gate (≈ JobTracker.submitJob → QueueManager.
+        hasAccess(SUBMIT_JOB)): the queue must exist AND allow this
+        user. REJECTS — never deprioritizes — unauthorized submission."""
+        self.check_queue_exists(queue)
+        if not self.has_access(queue, "submit-job", ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} cannot submit to queue {queue!r} "
+                f"(mapred.queue.{queue}.acl-submit-job)")
+
+    def check_administer(self, queue: str, ugi: UserGroupInformation,
+                         owner: str) -> None:
+        """Kill/modify gate (≈ QueueManager.hasAccess(ADMINISTER_JOBS),
+        checked in JobTracker.killJob): the job owner always may; else
+        queue administer ACL or cluster administrators."""
+        if ugi.user == owner:
+            return
+        if not self.has_access(queue, "administer-jobs", ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} cannot administer jobs in queue "
+                f"{queue!r} (owner {owner!r}; "
+                f"mapred.queue.{queue}.acl-administer-jobs)")

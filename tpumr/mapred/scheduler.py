@@ -128,7 +128,8 @@ class HybridQueueScheduler(TaskScheduler):
         pending_map_load = sum(j.pending_map_count() for j in jobs)
         assigned: list[Task] = []
 
-        mode = str(self.conf.get("tpumr.scheduler.mode", "shirahata")) \
+        cluster_mode = str(self.conf.get("tpumr.scheduler.mode",
+                                         "shirahata")) \
             if self.conf else "shirahata"
 
         # ---- per-JOB CPU budgets (a starved hybrid job must not block CPU
@@ -140,6 +141,9 @@ class HybridQueueScheduler(TaskScheduler):
             if not job.has_kernel():
                 continue
             accel = job.acceleration_factor()
+            # per-job override, same seam as optionalscheduling (a job
+            # may opt into the f(x,y) minimizer on a shirahata cluster)
+            mode = str(job.conf.get("tpumr.scheduler.mode", cluster_mode))
             if mode == "minimize":
                 cpu_budget[jid] = self._minimize_cpu_share(
                     job, free_cpu, max_tpu * n_trackers)

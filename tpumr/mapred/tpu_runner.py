@@ -26,6 +26,8 @@ from typing import Any
 import threading
 from collections import OrderedDict
 
+import numpy as np
+
 from tpumr.core.counters import BackendCounter, TaskCounter
 from tpumr.io.recordbatch import DenseBatch, RecordBatch
 from tpumr.io.writable import serialize
@@ -125,6 +127,11 @@ class TpuMapRunner(MapRunnable):
         # many-task batched transfer — only the drain remains
         pre = getattr(task_ctx, "_device_prefetch", None) if task_ctx else None
         if pre is not None:
+            if pre.device_rows is not None:
+                from tpumr.mapred import device_output
+                device_output.offer(
+                    str(conf.get("tpumr.task.attempt.id", "")),
+                    pre.device_rows)
             reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                                   TaskCounter.MAP_INPUT_RECORDS,
                                   pre.num_records)
@@ -160,6 +167,7 @@ class TpuMapRunner(MapRunnable):
             state = (kernel.map_batch_launch(batch, conf, task_ctx)
                      if type(kernel).supports_launch() else None)
             if state is not None:
+                _offer_device_rows(kernel, state, conf)
                 # coalesce this task's device→host transfer with any
                 # concurrently-fetching TPU-slot threads: one tunnel
                 # roundtrip can carry many tasks' outputs
@@ -208,6 +216,24 @@ def stage_batch(conf, reader, task_ctx, device=None) -> tuple[Any, bool, int]:
             if entry is not None:
                 staged, ids, meta = entry
                 return DenseBatch(staged, ids, dict(meta)), False, 0
+            # output chain: a predecessor job may have left this FILE's
+            # image resident (device_output.publish) — slice the split's
+            # rows on device, skipping the read AND the upload
+            from tpumr.mapred import device_output
+            whole = device_output.lookup(
+                conf, device, FileSystem.get(split.path, conf),
+                split.path, st.length, st.mtime)
+            if (whole is not None and getattr(whole, "ndim", 0) == 2
+                    and whole.shape[0] >= split.row_start + split.num_rows
+                    and whole.shape[1] == split.cols
+                    and str(whole.dtype) == str(np.dtype(split.dtype))):
+                staged = whole[split.row_start:
+                               split.row_start + split.num_rows]
+                ids = np.arange(split.row_start,
+                                split.row_start + split.num_rows,
+                                dtype=np.int64)
+                cache.put(key, (staged, ids, {}), int(staged.nbytes))
+                return DenseBatch(staged, ids, {}), False, 0
             batch = in_fmt.read_batch(split, conf)
             staged = jax.device_put(batch.values, device)
             cache.put(key, (staged, batch.ids, dict(batch.meta)),
@@ -236,16 +262,45 @@ def _select_device(dev_id: int):
     return devices[dev_id % len(devices)] if dev_id >= 0 else devices[0]
 
 
-class DevicePrefetch:
-    """Fetched kernel output for one map task of a pipelined window."""
+def _device_rows_of(kernel, state, conf):
+    """The kernel's device output rows for chaining, or None — gated on
+    the job's output format actually claiming them (DenseNpyOutputFormat)
+    so other jobs can never strand HBM in the pending table."""
+    if state is None:
+        return None
+    hook = getattr(kernel, "device_output_rows", None)
+    if hook is None:
+        return None
+    try:
+        fmt = conf.get_output_format()
+    except Exception:  # noqa: BLE001 — unset/bogus output format
+        return None
+    if not getattr(fmt, "claims_device_rows", False):
+        return None
+    return hook(state)
 
-    __slots__ = ("fetched", "num_records", "staged_bytes")
+
+def _offer_device_rows(kernel, state, conf) -> None:
+    rows = _device_rows_of(kernel, state, conf)
+    if rows is not None:
+        from tpumr.mapred import device_output
+        device_output.offer(str(conf.get("tpumr.task.attempt.id", "")),
+                            rows)
+
+
+class DevicePrefetch:
+    """Fetched kernel output for one map task of a pipelined window.
+    ``device_rows`` carries the still-resident output array when the job
+    chains through DenseNpyOutputFormat (offered at drain time)."""
+
+    __slots__ = ("fetched", "num_records", "staged_bytes", "device_rows")
 
     def __init__(self, fetched: Any, num_records: int,
-                 staged_bytes: int) -> None:
+                 staged_bytes: int, device_rows: Any = None) -> None:
         self.fetched = fetched
         self.num_records = num_records
         self.staged_bytes = staged_bytes
+        self.device_rows = device_rows
 
 
 def prelaunch_device_maps(conf, tasks: "list[Any]") -> "list[DevicePrefetch] | None":
@@ -310,15 +365,16 @@ def prelaunch_device_maps(conf, tasks: "list[Any]") -> "list[DevicePrefetch] | N
                 return None
             states.append(state)
             meta.append((int(getattr(batch, "num_records", 0)),
-                         int(staged_bytes)))
+                         int(staged_bytes),
+                         _device_rows_of(kernel, state, conf)))
             # every staged input stays device-resident until the window
             # fetch (cache hits were already resident — they don't count)
             resident += int(staged_bytes)
             if resident >= budget and len(states) < len(tasks):
                 break  # close the window early; caller resumes after us
         fetched = jax.device_get(states)  # ONE roundtrip for the window
-    return [DevicePrefetch(f, n, b)
-            for f, (n, b) in zip(fetched, meta)]
+    return [DevicePrefetch(f, n, b, rows)
+            for f, (n, b, rows) in zip(fetched, meta)]
 
 
 class CpuBatchMapRunner(MapRunnable):

@@ -1,0 +1,394 @@
+"""The new-API helper library ≈ ``org.apache.hadoop.mapreduce.lib``.
+
+The reference ships a second copy of the helper tier for the context-
+object API (src/mapred/org/apache/hadoop/mapreduce/lib/{input,output,
+partition,map,reduce,jobcontrol,...}). Here the ENGINE-level pieces
+(input/output formats, the total-order machinery) are shared with the old
+API — one engine, two user APIs — so this module provides:
+
+- new-API-NATIVE mappers/reducers/partitioners (lib/map/InverseMapper.
+  java, TokenCounterMapper.java, RegexMapper.java, MultithreadedMapper.
+  java; lib/reduce/IntSumReducer.java, LongSumReducer.java; lib/
+  partition/{HashPartitioner,BinaryPartitioner,KeyFieldBasedPartitioner,
+  TotalOrderPartitioner}.java);
+- re-exports of the shared formats under their new-API names
+  (lib/input/*.java, lib/output/*.java) plus :class:`LazyOutputFormat`;
+- :class:`ControlledJob` / :class:`JobControl` (lib/jobcontrol/
+  {ControlledJob,JobControl}.java) — dependency-ordered multi-job
+  execution, shared by both APIs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Iterator
+
+from tpumr.mapred import api as old_api
+# shared engine formats, re-exported under their mapreduce.lib names
+from tpumr.mapred.input_formats import (CombineFileInputFormat,
+                                        DenseInputFormat, FileInputFormat,
+                                        KeyValueTextInputFormat,
+                                        NLineInputFormat,
+                                        SequenceFileInputFormat,
+                                        TextInputFormat, WholeFileInputFormat)
+from tpumr.mapred.output_formats import (NullOutputFormat,
+                                         SequenceFileOutputFormat,
+                                         TextOutputFormat)
+from tpumr.mapreduce import Context, Job, Mapper, Partitioner, Reducer
+
+__all__ = [
+    # input (≈ lib/input)
+    "FileInputFormat", "TextInputFormat", "KeyValueTextInputFormat",
+    "NLineInputFormat", "SequenceFileInputFormat", "CombineFileInputFormat",
+    "WholeFileInputFormat", "DenseInputFormat",
+    # output (≈ lib/output)
+    "TextOutputFormat", "SequenceFileOutputFormat", "NullOutputFormat",
+    "LazyOutputFormat",
+    # map (≈ lib/map)
+    "InverseMapper", "TokenCounterMapper", "RegexMapper",
+    "MultithreadedMapper",
+    # reduce (≈ lib/reduce)
+    "IntSumReducer", "LongSumReducer",
+    # partition (≈ lib/partition)
+    "HashPartitioner", "BinaryPartitioner", "KeyFieldBasedPartitioner",
+    "TotalOrderPartitioner",
+    # jobcontrol (≈ lib/jobcontrol)
+    "ControlledJob", "JobControl",
+]
+
+
+# ------------------------------------------------------------------ map
+
+
+class InverseMapper(Mapper):
+    """(k, v) → (v, k) ≈ lib/map/InverseMapper.java."""
+
+    def map(self, key: Any, value: Any, context: Context) -> None:
+        context.write(value, key)
+
+
+class TokenCounterMapper(Mapper):
+    """(_, text) → (token, 1) ≈ lib/map/TokenCounterMapper.java."""
+
+    def map(self, key: Any, value: Any, context: Context) -> None:
+        text = value.decode("utf-8", "replace") \
+            if isinstance(value, (bytes, bytearray)) else str(value)
+        for tok in text.split():
+            context.write(tok, 1)
+
+
+class RegexMapper(Mapper):
+    """(_, text) → (match, 1) per regex group match ≈ lib/map/RegexMapper.
+    java; pattern from ``mapreduce.mapper.regex`` (reference key
+    ``mapred.mapper.regex`` is honoured too), group from
+    ``mapreduce.mapper.regex.group``."""
+
+    def setup(self, context: Context) -> None:
+        import re
+        pat = (context.conf.get("mapreduce.mapper.regex")
+               or context.conf.get("mapred.mapper.regex") or r"\w+")
+        self._re = re.compile(pat)
+        self._group = int(context.conf.get("mapreduce.mapper.regex.group",
+                                           context.conf.get(
+                                               "mapred.mapper.regex.group",
+                                               0)))
+
+    def map(self, key: Any, value: Any, context: Context) -> None:
+        text = value.decode("utf-8", "replace") \
+            if isinstance(value, (bytes, bytearray)) else str(value)
+        for m in self._re.finditer(text):
+            context.write(m.group(self._group), 1)
+
+
+class MultithreadedMapper(Mapper):
+    """N worker threads drive an inner new-API mapper within one slot
+    ≈ lib/map/MultithreadedMapper.java — for mappers that block on
+    external IO, not CPU parallelism (GIL; CPU-bound batching belongs to
+    the kernel/batch runners). Inner class from
+    ``mapreduce.mapper.multithreadedmapper.class``; thread count from
+    ``mapreduce.mapper.multithreadedmapper.threads`` (default 10).
+    Contracts kept from the reference: one shared inner mapper (map()
+    must be thread-safe), serialized writes, first worker error aborts."""
+
+    def run(self, records: Iterator[tuple], context: Context) -> None:
+        import queue as _queue
+
+        from tpumr.utils.reflection import new_instance
+        conf = context.conf
+        inner_cls = conf.get_class(
+            "mapreduce.mapper.multithreadedmapper.class", Mapper)
+        inner: Mapper = new_instance(inner_cls)
+        n_threads = max(1, int(conf.get(
+            "mapreduce.mapper.multithreadedmapper.threads", 10)))
+        lock = threading.Lock()
+        raw_write = context.write
+
+        def locked_write(k: Any, v: Any) -> None:
+            with lock:
+                raw_write(k, v)
+
+        context.write = locked_write  # type: ignore[method-assign]
+        work: _queue.Queue = _queue.Queue(maxsize=n_threads * 2)
+        errors: list[BaseException] = []
+        err_lock = threading.Lock()
+
+        def worker() -> None:
+            while True:
+                item = work.get()
+                if item is None:
+                    return
+                try:
+                    inner.map(item[0], item[1], context)
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    with err_lock:
+                        errors.append(e)
+
+        inner.setup(context)
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        try:
+            for key, value in records:
+                with err_lock:
+                    if errors:
+                        break
+                work.put((key, value))
+        finally:
+            for _ in threads:
+                work.put(None)
+            for t in threads:
+                t.join()
+            context.write = raw_write  # type: ignore[method-assign]
+            inner.cleanup(context)
+        if errors:
+            raise errors[0]
+
+
+# ---------------------------------------------------------------- reduce
+
+
+class IntSumReducer(Reducer):
+    """(k, [n...]) → (k, sum) ≈ lib/reduce/IntSumReducer.java."""
+
+    def reduce(self, key: Any, values: Iterator[Any],
+               context: Context) -> None:
+        context.write(key, sum(int(v) for v in values))
+
+
+class LongSumReducer(IntSumReducer):
+    """Python ints are arbitrary precision — same as IntSumReducer;
+    both names kept for API parity (lib/reduce/LongSumReducer.java)."""
+
+
+# ------------------------------------------------------------- partition
+
+
+class HashPartitioner(Partitioner):
+    """Stable hash of the key ≈ lib/partition/HashPartitioner.java."""
+
+    def get_partition(self, key: Any, value: Any,
+                      num_partitions: int) -> int:
+        return old_api.HashPartitioner().get_partition(key, value,
+                                                       num_partitions)
+
+
+class BinaryPartitioner(Partitioner):
+    """Partitions on a byte range of a bytes key ≈ lib/partition/
+    BinaryPartitioner.java: ``left``/``right`` offsets (negative =
+    from-end, defaults 0/-1 = whole key)."""
+
+    def __init__(self, left: int = 0, right: int = -1) -> None:
+        self.left = left
+        self.right = right
+
+    def get_partition(self, key: Any, value: Any,
+                      num_partitions: int) -> int:
+        import zlib
+        b = key if isinstance(key, (bytes, bytearray)) else \
+            str(key).encode()
+        n = len(b)
+        lo = self.left if self.left >= 0 else n + self.left
+        hi = (self.right if self.right >= 0 else n + self.right) + 1
+        return zlib.crc32(bytes(b[lo:hi])) % num_partitions
+
+
+class KeyFieldBasedPartitioner(Partitioner):
+    """New-API face of the field partitioner (lib/partition/
+    KeyFieldBasedPartitioner.java) — delegates to the engine's."""
+
+    def __init__(self, num_fields: int = 1, separator: str = "\t") -> None:
+        self._inner = old_api.KeyFieldBasedPartitioner(num_fields, separator)
+
+    def get_partition(self, key: Any, value: Any,
+                      num_partitions: int) -> int:
+        return self._inner.get_partition(key, value, num_partitions)
+
+
+class TotalOrderPartitioner(Partitioner):
+    """New-API face of the total-order partitioner (lib/partition/
+    TotalOrderPartitioner.java): reads the sampled partition file named
+    by the same conf key the engine's uses. Instantiated reflectively —
+    no-arg ctor + ``configure(conf)`` (≈ Configurable.setConf)."""
+
+    def __init__(self) -> None:
+        self._inner: Any = None
+
+    def configure(self, conf: Any) -> None:
+        from tpumr.mapred.total_order import TotalOrderPartitioner as _Engine
+        self._inner = _Engine()
+        self._inner.configure(conf)
+
+    def get_partition(self, key: Any, value: Any,
+                      num_partitions: int) -> int:
+        if self._inner is None:
+            raise RuntimeError("TotalOrderPartitioner not configured "
+                               "(no partition file conf)")
+        return self._inner.get_partition(key, value, num_partitions)
+
+
+# ------------------------------------------------------------ jobcontrol
+
+
+class ControlledJob:
+    """One job plus its dependencies ≈ lib/jobcontrol/ControlledJob.java.
+    States: WAITING → READY → RUNNING → SUCCESS | FAILED |
+    DEPENDENT_FAILED."""
+
+    WAITING = "WAITING"
+    READY = "READY"
+    RUNNING = "RUNNING"
+    SUCCESS = "SUCCESS"
+    FAILED = "FAILED"
+    DEPENDENT_FAILED = "DEPENDENT_FAILED"
+
+    def __init__(self, job: Job, depending: "list[ControlledJob] | None"
+                 = None, name: str = "") -> None:
+        self.job = job
+        self.name = name or job.conf.job_name or f"job-{id(job) & 0xffff}"
+        self.depending: "list[ControlledJob]" = list(depending or [])
+        self.state = self.WAITING
+        self.message = ""
+
+    def add_depending_job(self, dep: "ControlledJob") -> None:
+        self.depending.append(dep)
+
+    def _check_state(self) -> str:
+        if self.state != self.WAITING:
+            return self.state
+        if any(d.state in (self.FAILED, self.DEPENDENT_FAILED)
+               for d in self.depending):
+            self.state = self.DEPENDENT_FAILED
+            self.message = "a depending job failed"
+        elif all(d.state == self.SUCCESS for d in self.depending):
+            self.state = self.READY
+        return self.state
+
+
+class JobControl:
+    """Dependency-ordered runner ≈ lib/jobcontrol/JobControl.java: call
+    :meth:`run` (synchronous) or drive a background thread with
+    ``threading.Thread(target=jc.run)`` and poll :attr:`all_finished` —
+    the reference's Thread-subclass usage. Jobs run one at a time here
+    (the engine parallelizes WITHIN a job; concurrent jobs would fight
+    over the one-core host this targets)."""
+
+    def __init__(self, group_name: str = "jobcontrol") -> None:
+        self.group_name = group_name
+        self.jobs: "list[ControlledJob]" = []
+        self._stop = threading.Event()
+
+    def add_job(self, cj: ControlledJob) -> ControlledJob:
+        self.jobs.append(cj)
+        return cj
+
+    def add_jobs(self, cjs: "list[ControlledJob]") -> None:
+        for cj in cjs:
+            self.add_job(cj)
+
+    @property
+    def all_finished(self) -> bool:
+        return all(cj.state in (ControlledJob.SUCCESS, ControlledJob.FAILED,
+                                ControlledJob.DEPENDENT_FAILED)
+                   for cj in self.jobs)
+
+    def failed_jobs(self) -> "list[ControlledJob]":
+        return [cj for cj in self.jobs
+                if cj.state in (ControlledJob.FAILED,
+                                ControlledJob.DEPENDENT_FAILED)]
+
+    def successful_jobs(self) -> "list[ControlledJob]":
+        return [cj for cj in self.jobs if cj.state == ControlledJob.SUCCESS]
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, poll_s: float = 0.05) -> None:
+        """Run jobs as their dependencies succeed, until all settle."""
+        while not self.all_finished and not self._stop.is_set():
+            progressed = False
+            for cj in self.jobs:
+                if cj._check_state() == ControlledJob.READY:
+                    cj.state = ControlledJob.RUNNING
+                    ok = False
+                    try:
+                        ok = cj.job.wait_for_completion()
+                    except Exception as e:  # noqa: BLE001 — job failure
+                        cj.message = str(e)
+                    cj.state = (ControlledJob.SUCCESS if ok
+                                else ControlledJob.FAILED)
+                    if not ok and not cj.message:
+                        cj.message = getattr(cj.job, "error", "job failed")
+                    progressed = True
+            if not progressed and not self.all_finished:
+                time.sleep(poll_s)
+
+
+# ---------------------------------------------------------------- output
+
+
+class LazyOutputFormat:
+    """≈ lib/output/LazyOutputFormat.java: the real writer is created on
+    the FIRST write, so tasks that emit nothing produce no part file.
+    Configure with :meth:`set_output_format_class`."""
+
+    KEY = "mapreduce.output.lazyoutputformat.outputformat"
+
+    @classmethod
+    def set_output_format_class(cls, job_or_conf: Any,
+                                fmt: type) -> None:
+        conf = getattr(job_or_conf, "conf", job_or_conf)
+        conf.set_class(cls.KEY, fmt)
+        conf.set_class("mapred.output.format.class", cls)
+
+    def __init__(self, conf: Any = None) -> None:
+        self._conf = conf
+
+    def _inner(self, conf: Any):
+        from tpumr.utils.reflection import new_instance
+        fmt = conf.get_class(self.KEY, TextOutputFormat)
+        return new_instance(fmt, conf)
+
+    def check_output_specs(self, conf: Any) -> None:
+        self._inner(conf).check_output_specs(conf)
+
+    def get_record_writer(self, conf: Any, work_dir: str, partition: int,
+                          prefix: str = "part"):
+        from tpumr.mapred.output_formats import RecordWriter
+        inner_fmt = self._inner(conf)
+
+        class _Lazy(RecordWriter):
+            _writer: "RecordWriter | None" = None
+
+            def write(self, key: Any, value: Any) -> None:
+                if self._writer is None:
+                    self._writer = inner_fmt.get_record_writer(
+                        conf, work_dir, partition, prefix)
+                self._writer.write(key, value)
+
+            def close(self) -> None:
+                if self._writer is not None:
+                    self._writer.close()
+
+        return _Lazy()

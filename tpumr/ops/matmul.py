@@ -81,6 +81,12 @@ class MatmulBlockKernel(KernelMapper):
     def map_batch_drain(self, fetched, conf, task) -> Iterable[tuple]:
         yield (int(fetched["row0"]), np.asarray(fetched["c"]))
 
+    def device_output_rows(self, state):
+        """Output-chaining hook: C stays resident so a consumer job
+        (DenseNpyOutputFormat → DenseInputFormat) reads it from HBM
+        instead of round-tripping through the tunnel."""
+        return state["c"]
+
     def map_batch_cpu(self, batch, conf, task) -> Iterable[tuple]:
         """Vectorized host twin (BLAS) — CPU slots do the whole block in
         one gemm, keeping the hybrid comparison batch-vs-batch."""

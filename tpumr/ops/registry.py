@@ -78,6 +78,14 @@ class KernelMapper:
     def supports_launch(cls) -> bool:
         return cls.map_batch_launch is not KernelMapper.map_batch_launch
 
+    # optional output-chaining hook: the device array whose host image
+    # the task's output file will contain (same shape/dtype as the rows
+    # the drain writes). Jobs writing through DenseNpyOutputFormat get
+    # their output published into the HBM cache so a chained consumer
+    # (DenseInputFormat) skips the storage read AND the re-upload —
+    # see tpumr/mapred/device_output.py.
+    # def device_output_rows(self, state) -> "jax.Array | None"
+
     # optional: kernels can advertise a CPU mapper class for the hybrid
     # scheduler's CPU slots (same job, both backends)
     cpu_mapper_class: type | None = None

@@ -56,6 +56,35 @@ class UserGroupInformation:
             _local.ugi = prev
 
 
+def server_side_ugi(user: str, conf: Any = None) -> UserGroupInformation:
+    """Build a UGI for an asserted remote username with groups resolved
+    SERVER-side (≈ the reference's Groups/ShellBasedUnixGroupsMapping:
+    group membership is never trusted from the wire). Resolution order:
+    static conf mapping ``tpumr.user.groups.<user> = g1,g2``, then the
+    local OS group database; empty ``user`` falls back to the current
+    process identity (in-process callers)."""
+    if not user:
+        return UserGroupInformation.get_current_user()
+    groups: "list[str]" = []
+    if conf is not None:
+        static = conf.get(f"tpumr.user.groups.{user}")
+        if static:
+            groups = [g.strip() for g in str(static).split(",") if g.strip()]
+    if not groups:
+        try:
+            import grp
+            import pwd
+            pw = pwd.getpwnam(user)
+            groups = [g.gr_name for g in grp.getgrall()
+                      if user in g.gr_mem]
+            primary = grp.getgrgid(pw.pw_gid).gr_name
+            if primary not in groups:
+                groups.insert(0, primary)
+        except (KeyError, ImportError, OSError):
+            pass
+    return UserGroupInformation(user, groups)
+
+
 def rpc_secret(conf: Any) -> "bytes | None":
     """Resolve the cluster RPC secret from conf (None = auth disabled)."""
     if conf is None:
