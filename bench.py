@@ -180,6 +180,8 @@ def bench_wordcount(rows: dict) -> None:
     conf.set_job_name("bench-wordcount")
     conf.set_input_paths(f"file://{path}")
     conf.set_output_path(f"file://{work}/out")
+    from tpumr.mapred.input_formats import RawTextInputFormat
+    conf.set_input_format(RawTextInputFormat)
     conf.set_map_kernel("wordcount")
     conf.set("mapred.reducer.class", "tpumr.examples.basic.LongSumReducer")
     conf.set("mapred.combiner.class", "tpumr.examples.basic.LongSumReducer")

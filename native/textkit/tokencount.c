@@ -1,0 +1,131 @@
+/* tokencount — single-pass whitespace tokenize + count for wordcount.
+ *
+ * The role of the reference's per-line WordCount mapper hot loop
+ * (examples/WordCount.java StringTokenizer; pipes wordcount-simple.cc),
+ * rebuilt as native batch code: one pass over the whole split's bytes,
+ * open-addressing FNV-1a hash table of (token-pointer, len) -> count —
+ * tokens are NOT copied, they point into the caller's buffer. Token
+ * semantics are exactly Python bytes.split(): the six ASCII whitespace
+ * separators, no empty tokens.
+ *
+ * Result buffer layout (malloc'd, caller frees via tc_free):
+ *   u64 n_entries, then per entry: u32 len, u64 count, len token bytes.
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+  const unsigned char* tok;
+  uint32_t len;
+  uint64_t count;
+} slot_t;
+
+static const unsigned char WS[256] = {
+  [9] = 1, [10] = 1, [11] = 1, [12] = 1, [13] = 1, [32] = 1,
+};
+
+static uint64_t fnv1a(const unsigned char* p, uint32_t n) {
+  uint64_t h = 1469598103934665603ull;
+  uint32_t i;
+  for (i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+typedef struct {
+  slot_t* slots;
+  uint64_t cap;     /* power of two */
+  uint64_t used;
+} table_t;
+
+static int grow(table_t* t) {
+  uint64_t ncap = t->cap ? t->cap * 2 : 4096;
+  slot_t* ns = (slot_t*)calloc(ncap, sizeof(slot_t));
+  uint64_t i;
+  if (!ns) return -1;
+  for (i = 0; i < t->cap; i++) {
+    slot_t* s = &t->slots[i];
+    if (s->tok) {
+      uint64_t j = fnv1a(s->tok, s->len) & (ncap - 1);
+      while (ns[j].tok) j = (j + 1) & (ncap - 1);
+      ns[j] = *s;
+    }
+  }
+  free(t->slots);
+  t->slots = ns;
+  t->cap = ncap;
+  return 0;
+}
+
+static int bump(table_t* t, const unsigned char* tok, uint32_t len,
+                uint64_t h) {
+  uint64_t j;
+  if (t->used * 10 >= t->cap * 7 && grow(t)) return -1;
+  j = h & (t->cap - 1);
+  for (;;) {
+    slot_t* s = &t->slots[j];
+    if (!s->tok) {
+      s->tok = tok;
+      s->len = len;
+      s->count = 1;
+      t->used++;
+      return 0;
+    }
+    if (s->len == len && memcmp(s->tok, tok, len) == 0) {
+      s->count++;
+      return 0;
+    }
+    j = (j + 1) & (t->cap - 1);
+  }
+}
+
+char* tc_count(const unsigned char* data, uint64_t n, uint64_t* out_len) {
+  table_t t = {0, 0, 0};
+  uint64_t i = 0, total, k, w;
+  char* out;
+  if (grow(&t)) return NULL;
+  while (i < n) {
+    uint64_t start, h;
+    while (i < n && WS[data[i]]) i++;
+    start = i;
+    /* hash inline with the boundary scan — one pass over token bytes
+     * instead of scan-then-rehash */
+    h = 1469598103934665603ull;
+    while (i < n && !WS[data[i]]) {
+      h ^= data[i];
+      h *= 1099511628211ull;
+      i++;
+    }
+    if (i > start && bump(&t, data + start, (uint32_t)(i - start), h)) {
+      free(t.slots);
+      return NULL;
+    }
+  }
+  total = 8;
+  for (k = 0; k < t.cap; k++)
+    if (t.slots[k].tok) total += 12 + t.slots[k].len;
+  out = (char*)malloc(total);
+  if (!out) {
+    free(t.slots);
+    return NULL;
+  }
+  memcpy(out, &t.used, 8);
+  w = 8;
+  for (k = 0; k < t.cap; k++) {
+    slot_t* s = &t.slots[k];
+    if (!s->tok) continue;
+    memcpy(out + w, &s->len, 4);
+    memcpy(out + w + 4, &s->count, 8);
+    memcpy(out + w + 12, s->tok, s->len);
+    w += 12 + s->len;
+  }
+  free(t.slots);
+  *out_len = total;
+  return out;
+}
+
+void tc_free(char* p) { free(p); }

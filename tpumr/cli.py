@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import signal
+import os
 import sys
 import threading
 import time
@@ -569,6 +570,13 @@ COMMANDS = {
 
 
 def main(argv: list[str] | None = None) -> int:
+    # TPUMR_JAX_PLATFORM=cpu pins jax to a platform BEFORE any device
+    # touch — the supported way to run CPU-only (a TPU plugin may
+    # override the plain JAX_PLATFORMS env at interpreter startup)
+    plat = os.environ.get("TPUMR_JAX_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
     argv = list(sys.argv[1:] if argv is None else argv)
     overrides, rest = _parse_generic(argv)
     if not rest:

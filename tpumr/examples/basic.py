@@ -96,11 +96,15 @@ def wordcount(argv: list[str]) -> int:
     conf.set_job_name("wordcount")
     conf.set_input_paths(*args.input.split(","))
     conf.set_output_path(args.output)
-    conf.set_input_format(TextInputFormat)
     from tpumr.ops.wordcount import WordCountCpuMapper
     if args.cpu_only:
+        conf.set_input_format(TextInputFormat)
         conf.set_mapper_class(WordCountCpuMapper)
     else:
+        # whitespace tokenization doesn't need per-line records — the
+        # raw-buffer format skips the line machinery entirely
+        from tpumr.mapred.input_formats import RawTextInputFormat
+        conf.set_input_format(RawTextInputFormat)
         conf.set_map_kernel("wordcount")
     conf.set_reducer_class(LongSumReducer)
     conf.set_combiner_class(LongSumReducer)
