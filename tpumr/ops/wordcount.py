@@ -41,39 +41,45 @@ from tpumr.ops.registry import KernelMapper, register_kernel
 _WS_TABLE = np.zeros(256, dtype=bool)
 _WS_TABLE[[9, 10, 11, 12, 13, 32]] = True
 
+import threading as _threading
+
 _NATIVE = None          # loaded libtokencount, or False after a miss
-_NATIVE_LOCK = None     # created lazily (threading import stays cold)
+_NATIVE_LOCK = _threading.Lock()
 
 
 def _native_lib():
     """The native single-pass tokenizer (native/textkit), built by its
     Makefile like the other native tiers; None when unavailable —
-    callers fall back to the numpy path. Load/build is serialized so
-    concurrent map tasks can't race the compile or dlopen a
-    half-written artifact (make itself writes the .so atomically only
-    per-invocation — two concurrent makes would interleave)."""
-    global _NATIVE, _NATIVE_LOCK
+    callers fall back to the numpy path. The lazy build is serialized
+    against BOTH concurrent threads (module lock) and concurrent
+    processes (flock on a build lockfile): cc links the .so in place,
+    so an unserialized reader could dlopen a truncated artifact and
+    silently pin the process to the numpy fallback."""
+    global _NATIVE
     if _NATIVE is not None:
         return _NATIVE or None
-    import threading
-    if _NATIVE_LOCK is None:
-        _NATIVE_LOCK = threading.Lock()
     with _NATIVE_LOCK:
         if _NATIVE is not None:
             return _NATIVE or None
         import ctypes
         import os
-        so = os.path.join(os.path.dirname(os.path.dirname(
+        kit = os.path.join(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))),
-            "native", "textkit", "build", "libtokencount.so")
+            "native", "textkit")
+        so = os.path.join(kit, "build", "libtokencount.so")
         if not os.path.exists(so):
+            import fcntl
             import subprocess
             try:   # best-effort lazy build (gcc is in the base image)
-                r = subprocess.run(["make"], cwd=os.path.dirname(
-                    os.path.dirname(so)), capture_output=True, timeout=60)
-                if r.returncode != 0:
-                    _NATIVE = False
-                    return None
+                with open(os.path.join(kit, ".build.lock"), "w") as lf:
+                    fcntl.flock(lf, fcntl.LOCK_EX)
+                    if not os.path.exists(so):   # lost the build race?
+                        r = subprocess.run(["make"], cwd=kit,
+                                           capture_output=True,
+                                           timeout=60)
+                        if r.returncode != 0:
+                            _NATIVE = False
+                            return None
             except Exception:  # noqa: BLE001
                 _NATIVE = False
                 return None
