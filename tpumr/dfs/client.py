@@ -162,6 +162,10 @@ class _DFSOutputStream(io.RawIOBase):
     def _flush_block(self, data: bytes) -> None:
         excluded: list[str] = []
         last_err: Exception | None = None
+        chunk = 1 << 20
+        if self.client.conf is not None:
+            chunk = int(self.client.conf.get(
+                "tdfs.client.write.chunk.bytes", chunk))
         for _ in range(self.MAX_BLOCK_RETRIES):
             alloc = self.client.nn.call("add_block", self.path,
                                         self.client.name,
@@ -169,9 +173,27 @@ class _DFSOutputStream(io.RawIOBase):
             bid, targets = alloc["block_id"], alloc["targets"]
             # prev size is journaled now; next add_block must not re-log it
             self._prev_block_size = -1
+            cli = self.client._dn(targets[0])
             try:
-                self.client._dn(targets[0]).call(
-                    "write_block", bid, data, targets[1:])
+                if len(data) <= chunk:
+                    # small blocks: the single-shot path (one RPC)
+                    cli.call("write_block", bid, data, targets[1:])
+                else:
+                    # streamed pipeline (≈ DataTransferProtocol
+                    # WRITE_BLOCK): bounded chunks relay DN→DN→DN; the
+                    # commit only returns once every replica installed
+                    cli.call("open_block_stream", bid, targets[1:])
+                    try:
+                        for lo in range(0, len(data), chunk):
+                            cli.call("write_block_chunk", bid,
+                                     data[lo:lo + chunk])
+                        cli.call("commit_block_stream", bid)
+                    except Exception:
+                        try:
+                            cli.call("abort_block_stream", bid)
+                        except Exception:  # noqa: BLE001 — best effort
+                            pass
+                        raise
                 self._prev_block_size = len(data)
                 return
             except Exception as e:  # noqa: BLE001 — pipeline failure
@@ -273,10 +295,27 @@ class _DFSInputStream(io.RawIOBase):
 
     def _read_replica(self, blk: dict, offset: int, length: int) -> bytes:
         last_err: Exception | None = None
+        chunk = 1 << 20
+        if self.client.conf is not None:
+            chunk = int(self.client.conf.get("tdfs.client.read.chunk.bytes",
+                                             chunk))
         for addr in blk["locations"]:
             try:
-                return self.client._dn(addr).call(
-                    "read_block", blk["block_id"], offset, length)
+                # streamed read (≈ BlockSender): bounded chunks per RPC,
+                # so neither side ever holds a whole block per response
+                cli = self.client._dn(addr)
+                parts: list[bytes] = []
+                got = 0
+                while got < length:
+                    r = cli.call("read_block_chunk", blk["block_id"],
+                                 offset + got, min(chunk, length - got))
+                    if not r["data"]:
+                        raise IOError(
+                            f"short read at {offset + got} of block "
+                            f"{blk['block_id']} (total {r['total']})")
+                    parts.append(r["data"])
+                    got += len(r["data"])
+                return b"".join(parts)
             except Exception as e:  # noqa: BLE001 — dead/corrupt replica
                 last_err = e
                 if "checksum" in str(e).lower():

@@ -7,9 +7,13 @@ Contracts reproduced:
 - blocks live as ``blk_<id>`` files with a sidecar ``.meta`` of per-chunk
   CRC32s (≈ the checksum meta file); reads verify and raise on corruption
   (ChecksumException), which also triggers client replica failover;
-- write pipeline: the client sends a block to the FIRST target, each node
-  forwards downstream then stores, acks propagate back up the chain
-  (DN→DN→DN chained pipeline of BlockReceiver);
+- write pipeline: the client streams a block to the FIRST target in
+  bounded chunks (open/write_chunk/commit), each node forwards
+  downstream then appends, acks propagate back up the chain
+  (DN→DN→DN chained pipeline of BlockReceiver; ≈ DataTransferProtocol
+  WRITE_BLOCK). Reads stream the same way (read_block_chunk ≈
+  BlockSender) with chunk-aligned checksum verification — whole blocks
+  never ride one RPC payload in either direction;
 - heartbeat loop: register → initial block report → periodic heartbeats
   that carry back NameNode commands (replicate/delete ≈
   DNA_TRANSFER/DNA_INVALIDATE), full block reports on request/interval.
@@ -73,6 +77,79 @@ class BlockStore:
             length = len(data) - offset
         return data[offset:offset + length]
 
+    def read_range(self, block_id: int, offset: int,
+                   length: int) -> "tuple[bytes, int]":
+        """Range read verifying ONLY the covering checksum chunks (the
+        reference's chunk-aligned verification in BlockSender): a
+        streaming reader never re-reads or re-hashes the whole block
+        per chunk. Returns (data, block_length)."""
+        path = self._path(block_id)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"block {block_id} not stored here")
+        with open(path + ".meta") as f:
+            meta = json.load(f)
+        total = meta["len"]
+        offset = max(0, offset)
+        length = max(0, min(length, total - offset))
+        if length == 0:
+            return b"", total
+        c0 = offset // CHUNK
+        c1 = (offset + length - 1) // CHUNK
+        with open(path, "rb") as f:
+            f.seek(c0 * CHUNK)
+            covering = f.read((c1 - c0 + 1) * CHUNK)
+        sums = [zlib.crc32(covering[i:i + CHUNK])
+                for i in range(0, len(covering), CHUNK)]
+        if sums != meta["sums"][c0:c1 + 1]:
+            raise ChecksumError(f"block {block_id} fails checksum "
+                                f"(chunks {c0}..{c1})")
+        lo = offset - c0 * CHUNK
+        return covering[lo:lo + length], total
+
+    # ------------------------------------------------ streaming receive
+
+    def open_stream(self, block_id: int) -> str:
+        """Begin a streamed block write: appends go to the .tmp file,
+        finalize_stream checksums + atomically installs it."""
+        tmp = self._path(block_id) + ".tmp"
+        open(tmp, "wb").close()
+        return tmp
+
+    def append_stream(self, block_id: int, data: bytes) -> None:
+        with open(self._path(block_id) + ".tmp", "ab") as f:
+            f.write(data)
+
+    def finalize_stream(self, block_id: int) -> int:
+        """Compute chunk CRCs from the streamed file (one bounded-memory
+        re-read), fsync, install block + meta. Returns the length."""
+        tmp = self._path(block_id) + ".tmp"
+        sums = []
+        total = 0
+        with open(tmp, "rb") as f:
+            while True:
+                piece = f.read(CHUNK)
+                if not piece and total > 0:
+                    break
+                sums.append(zlib.crc32(piece))
+                total += len(piece)
+                if len(piece) < CHUNK:
+                    break
+        with open(tmp, "ab") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        with open(tmp + ".meta", "w") as f:
+            json.dump({"len": total, "sums": sums}, f)
+        os.replace(tmp + ".meta", self._path(block_id) + ".meta")
+        os.replace(tmp, self._path(block_id))
+        return total
+
+    def abort_stream(self, block_id: int) -> None:
+        for suffix in (".tmp", ".tmp.meta"):
+            try:
+                os.remove(self._path(block_id) + suffix)
+            except FileNotFoundError:
+                pass
+
     def delete(self, block_id: int) -> None:
         for suffix in ("", ".meta"):
             try:
@@ -111,6 +188,8 @@ class DataNode:
                                     name="dn-heartbeat", daemon=True)
         self._peer_clients: dict[str, RpcClient] = {}
         self._lock = threading.Lock()
+        #: in-flight streamed uploads: block_id -> {downstream, ts}
+        self._uploads: dict[int, dict] = {}
         #: periodic CRC verification of every stored block ≈
         #: DataBlockScanner (reference default: one full pass per 3
         #: weeks; here per-period sweep, 0 disables)
@@ -164,6 +243,18 @@ class DataNode:
                     self._apply_command(cmd)
             except Exception:  # noqa: BLE001 — NN briefly unreachable
                 pass
+            # purge streamed uploads abandoned by dead clients (their
+            # temp files would otherwise live forever)
+            cutoff = time.time() - float(
+                self.conf.get("tdfs.upload.stale.s", 600))
+            with self._lock:
+                stale = [bid for bid, up in self._uploads.items()
+                         if up["ts"] < cutoff]
+            for bid in stale:
+                try:
+                    self.abort_block_stream(bid)
+                except Exception:  # noqa: BLE001
+                    pass
 
     # ------------------------------------------------------------ scanner
 
@@ -229,6 +320,71 @@ class DataNode:
     def read_block(self, block_id: int, offset: int = 0,
                    length: int = -1) -> bytes:
         return self.store.read(block_id, offset, length)
+
+    #: server-side cap per streamed-transfer RPC — bounds datanode
+    #: memory per request regardless of client asks (the streaming
+    #: re-design of DataTransferProtocol's op READ_BLOCK: payloads move
+    #: as bounded chunks, never whole blocks per response)
+    MAX_CHUNK_BYTES = 4 << 20
+
+    def read_block_chunk(self, block_id: int, offset: int,
+                         max_bytes: int) -> dict:
+        """One bounded chunk of a block + its total length; checksums
+        verified for the covering CRC chunks only."""
+        n = max(0, min(int(max_bytes), self.MAX_CHUNK_BYTES))
+        data, total = self.store.read_range(block_id, int(offset), n)
+        return {"data": data, "total": total}
+
+    # streamed pipelined write ≈ DataTransferProtocol op WRITE_BLOCK:
+    # chunks relay downstream FIRST (same ordering as write_block), each
+    # ack returns once the whole chain appended; commit finalizes the
+    # chain from the tail up so a successful return means every replica
+    # is installed. Session state is (block_id, downstream) — one
+    # concurrent upload per block per node, like the reference's
+    # single-writer block lease.
+
+    def open_block_stream(self, block_id: int,
+                          downstream: "list[str]") -> None:
+        if downstream:
+            self._peer(downstream[0]).call("open_block_stream", block_id,
+                                           downstream[1:])
+        with self._lock:
+            self._uploads[block_id] = {"downstream": list(downstream),
+                                       "ts": time.time()}
+        self.store.open_stream(block_id)
+
+    def write_block_chunk(self, block_id: int, data: bytes) -> None:
+        with self._lock:
+            up = self._uploads.get(block_id)
+        if up is None:
+            raise KeyError(f"no open stream for block {block_id}")
+        if up["downstream"]:
+            self._peer(up["downstream"][0]).call("write_block_chunk",
+                                                 block_id, data)
+        self.store.append_stream(block_id, data)
+        up["ts"] = time.time()
+
+    def commit_block_stream(self, block_id: int) -> None:
+        with self._lock:
+            up = self._uploads.pop(block_id, None)
+        if up is None:
+            raise KeyError(f"no open stream for block {block_id}")
+        if up["downstream"]:
+            self._peer(up["downstream"][0]).call("commit_block_stream",
+                                                 block_id)
+        size = self.store.finalize_stream(block_id)
+        self.nn.call("block_received", self.addr, block_id, size)
+
+    def abort_block_stream(self, block_id: int) -> None:
+        with self._lock:
+            up = self._uploads.pop(block_id, None)
+        if up and up["downstream"]:
+            try:
+                self._peer(up["downstream"][0]).call("abort_block_stream",
+                                                     block_id)
+            except Exception:  # noqa: BLE001 — best-effort chain abort
+                pass
+        self.store.abort_stream(block_id)
 
     def block_checksum(self, block_id: int) -> int:
         return zlib.crc32(self.store.read(block_id))
