@@ -22,6 +22,9 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+/* Sanity cap on a server frame length read off the wire. */
+#define TDFS_MAX_FRAME (256u * 1024 * 1024)
+
 static __thread char g_err[1024];
 
 const char* tdfs_last_error(void) { return g_err; }
@@ -69,7 +72,17 @@ static int recv_frame(int fd, td_val* out) {
   if (read_all(fd, (char*)lenbe, 4)) return -1;
   rlen = ((uint32_t)lenbe[0] << 24) | ((uint32_t)lenbe[1] << 16) |
          ((uint32_t)lenbe[2] << 8) | lenbe[3];
-  rdata = (char*)malloc(rlen);
+  /* The length word comes off the wire: bound it (server frames are
+     block-chunk sized, far below this) and never trust malloc. */
+  if (rlen > TDFS_MAX_FRAME) {
+    set_err("oversized frame from server (%s)", "len > 256 MiB");
+    return -1;
+  }
+  rdata = (char*)malloc(rlen ? rlen : 1);
+  if (!rdata) {
+    set_err("out of memory for %s", "rpc frame");
+    return -1;
+  }
   if (read_all(fd, rdata, rlen)) {
     free(rdata);
     return -1;

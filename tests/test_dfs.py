@@ -742,6 +742,25 @@ class TestAppend:
         with client.open("/ap/lease.txt") as f:
             assert f.read() == b"xy"
 
+    def test_append_close_does_not_inflate_block_count(self, cluster):
+        # every append→close must add only the NEW blocks to the
+        # safemode denominator; re-counting the whole list each close
+        # inflates total_known_blocks and can wedge post-restart
+        # safemode below threshold forever
+        ns = cluster.namenode.ns
+        client = cluster.client()
+        with client.create("/ap/count.bin") as f:
+            f.write(b"A" * 2500)                # 3 blocks of 1 KiB
+        base = ns.total_known_blocks
+        for i in range(3):                      # 3 cycles, 1 new block each
+            with client.append("/ap/count.bin") as f:
+                f.write(b"B" * 100)
+        assert ns.total_known_blocks == base + 3
+        actual = sum(len(i.get("blocks", []))
+                     for i in ns.namespace.values()
+                     if i.get("type") == "file")
+        assert ns.total_known_blocks == actual
+
     def test_append_survives_namenode_restart(self):
         conf = small_conf()
         with MiniDFSCluster(num_datanodes=2, conf=conf) as c:

@@ -32,6 +32,16 @@ class TestAccessControlList:
         acl = AccessControlList("alice")
         assert acl.allows(ugi("alice")) and not acl.allows(ugi("bob"))
 
+    def test_groups_only_spec_leading_blank(self):
+        # the reference's groups-only form: leading space, then groups
+        # (AccessControlList.java split(" ", 2) — parts[0] is empty)
+        acl = AccessControlList(" devs,ops")
+        assert acl.allows(ugi("carol", ["devs"]))
+        assert acl.allows(ugi("dan", ["ops"]))
+        # a USER literally named like the group must NOT pass
+        assert not acl.allows(ugi("devs"))
+        assert not acl.allows(ugi("erin", ["qa"]))
+
 
 class TestQueueManager:
     def make(self, **kv):
@@ -61,10 +71,21 @@ class TestQueueManager:
             qm.check_submit("nosuch", ugi("alice"))
 
     def test_capacity_phantom_semantics_kept_without_explicit_names(self):
-        # no mapred.queue.names: capacity's unconfigured-queue bucket
-        # must keep working (scheduled last, never rejected)
+        # no mapred.queue.names, ACLs OFF: capacity's unconfigured-queue
+        # bucket must keep working (scheduled last, never rejected)
         qm = self.make(**{"tpumr.capacity.queues": "prod,adhoc"})
         qm.check_submit("experimental", ugi("alice"))
+
+    def test_acls_on_always_validates_queue_existence(self):
+        # with mapred.acls.enabled=true the queue must exist even when
+        # mapred.queue.names was never set — otherwise every phantom
+        # queue defaults to an open "*" ACL and enforcement is hollow
+        # (the reference's QueueManager.java always validates)
+        qm = self.make(**{"mapred.acls.enabled": True,
+                          "tpumr.capacity.queues": "prod,adhoc"})
+        qm.check_submit("prod", ugi("alice"))
+        with pytest.raises(PermissionError, match="not defined"):
+            qm.check_submit("experimental", ugi("alice"))
 
     def test_administer_owner_and_admins(self):
         qm = self.make(**{
@@ -114,6 +135,25 @@ class TestMasterEnforcement:
             self.submit(master, "bob")
         with pytest.raises(PermissionError, match="not defined"):
             self.submit(master, "alice", queue="nosuch")
+
+    def test_identityless_submit_is_anonymous_not_daemon(self):
+        # an identity-less submit must never inherit the daemon's own
+        # process identity — even when that identity is a cluster
+        # administrator (which would bypass every queue submit ACL)
+        import getpass
+        conf = JobConf()
+        conf.set("mapred.acls.enabled", True)
+        conf.set("mapred.queue.names", "prod")
+        conf.set("mapred.queue.prod.acl-submit-job", "alice")
+        conf.set("mapred.cluster.administrators", getpass.getuser())
+        m = JobMaster(conf).start()
+        try:
+            with pytest.raises(PermissionError, match="cannot submit"):
+                m.submit_job({"mapred.job.queue.name": "prod",
+                              "mapred.reduce.tasks": 0},
+                             [{"locations": []}])
+        finally:
+            m.stop()
 
     def test_kill_acl_enforced(self, master):
         jid = self.submit(master, "alice")

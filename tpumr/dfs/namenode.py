@@ -135,6 +135,15 @@ class FSNamesystem:
             len(i.get("blocks", [])) for i in self.namespace.values()
             if i.get("type") == "file")
         self.safemode = self.total_known_blocks > 0
+        # Blocks of each open (uc) file ALREADY included in
+        # total_known_blocks — close adds only the delta, so an
+        # append→close cycle never re-counts pre-existing blocks into
+        # the safemode denominator. Files open at restart had all their
+        # blocks counted by the sum above.
+        self._uc_counted: dict[str, int] = {
+            p: len(i.get("blocks", []))
+            for p, i in self.namespace.items()
+            if i.get("type") == "file" and i.get("uc")}
 
         # rack awareness ≈ FSNamesystem's clusterMap (NetworkTopology)
         from tpumr.net import NetworkTopology, resolver_from_conf
@@ -525,6 +534,8 @@ class FSNamesystem:
                   "t": _now()}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            # pre-existing blocks are already in total_known_blocks
+            self._uc_counted[path] = len(inode.get("blocks", []))
             lease = self.leases.setdefault(
                 client, {"paths": set(), "renewed": _now()})
             lease["paths"].add(path)
@@ -634,7 +645,8 @@ class FSNamesystem:
                 self._charge(path, 0,
                              (last_block_size - inode["block_size"])
                              * inode.get("replication", 1))
-            self.total_known_blocks += len(inode["blocks"])
+            self.total_known_blocks += (len(inode["blocks"])
+                                        - self._uc_counted.pop(path, 0))
             lease = self.leases.get(client)
             if lease:
                 lease["paths"].discard(path)
@@ -1085,7 +1097,9 @@ class FSNamesystem:
                         for bid, size in inode["blocks"]}}
                     self._log(op)
                     self.apply_op(self.namespace, self.counters, op)
-                    self.total_known_blocks += len(inode["blocks"])
+                    self.total_known_blocks += (
+                        len(inode["blocks"])
+                        - self._uc_counted.pop(path, 0))
                 del self.leases[client]
 
     # ------------------------------------------------------------ fsck

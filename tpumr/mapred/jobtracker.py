@@ -357,12 +357,17 @@ class JobMaster:
         # QueueManager.hasAccess(SUBMIT_JOB)): rejected jobs never enter
         # any scheduler queue
         from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
-        from tpumr.security import server_side_ugi
+        from tpumr.security import UserGroupInformation, server_side_ugi
         queue = str(conf_dict.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
                     or DEFAULT_QUEUE)
+        # A submission with NO identity is an anonymous nobody, mirroring
+        # kill_job — never the daemon's own process identity, which is
+        # often in mapred.cluster.administrators and would bypass the
+        # queue submit ACL.
+        user = str(conf_dict.get("user.name", "") or "")
         self.queue_manager.check_submit(
-            queue, server_side_ugi(str(conf_dict.get("user.name", "")),
-                                   self.conf))
+            queue, server_side_ugi(user, self.conf) if user
+            else UserGroupInformation("anonymous", []))
         with self.lock:
             self._next_job += 1
             job_id = JobID(self.cluster_id, self._next_job)

@@ -35,15 +35,21 @@ class AccessControlList:
     """One ACL entry, reference syntax (users SP groups | ``*``)."""
 
     def __init__(self, spec: str) -> None:
-        self.spec = spec = (spec or "").strip()
-        self.all = spec == "*"
+        raw = spec if spec is not None else ""
+        self.spec = raw.strip()
+        self.all = self.spec == "*"
         users: set[str] = set()
         groups: set[str] = set()
-        if not self.all and spec:
-            parts = spec.split(None, 1)
-            users = {u for u in parts[0].split(",") if u}
+        if not self.all and self.spec:
+            # Split on the FIRST space WITHOUT stripping first: the
+            # reference's groups-only form is a leading blank
+            # (" devs,ops" = no users, groups devs+ops —
+            # AccessControlList.java split(" ", 2) semantics).
+            parts = raw.split(" ", 1)
+            users = {u.strip() for u in parts[0].split(",") if u.strip()}
             if len(parts) > 1:
-                groups = {g for g in parts[1].split(",") if g}
+                groups = {g.strip() for g in parts[1].split(",")
+                          if g.strip()}
         self.users = users
         self.groups = groups
 
@@ -62,15 +68,18 @@ class QueueManager:
                     else (conf.get("tpumr.capacity.queues")
                           or DEFAULT_QUEUE))
         self.queue_names = [q.strip() for q in names.split(",") if q.strip()]
-        # queue EXISTENCE is enforced only when the operator configured
-        # mapred.queue.names explicitly — otherwise the capacity
-        # scheduler's documented phantom-bucket semantics (unconfigured
-        # queues scheduled last, never rejected) stay intact. Documented
-        # divergence from the reference, which always enforces.
-        self.enforce_exists = explicit is not None
         self.acls_enabled = bool(conf.get_boolean(ACLS_ENABLED_KEY, False)) \
             if hasattr(conf, "get_boolean") else \
             str(conf.get(ACLS_ENABLED_KEY, "false")).lower() == "true"
+        # Queue EXISTENCE is enforced whenever the operator configured
+        # mapred.queue.names explicitly, AND always once ACLs are on —
+        # an ACL regime over phantom queues (each defaulting to open
+        # "*") would silently bypass enforcement. Only with ACLs off
+        # and no explicit names do the capacity scheduler's documented
+        # phantom-bucket semantics (unconfigured queues scheduled last,
+        # never rejected) stay intact; that narrower divergence from the
+        # reference (QueueManager.java always validates) is documented.
+        self.enforce_exists = explicit is not None or self.acls_enabled
         self._admins = AccessControlList(str(conf.get(ADMINS_KEY, "") or ""))
 
     # ------------------------------------------------------------ lookups
