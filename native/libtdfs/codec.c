@@ -159,11 +159,18 @@ static int64_t unzigzag64(uint64_t v) {
   return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
 }
 
-int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
+/* Containers nest by recursion: cap the depth so a frame of nested
+ * list tags (2 bytes/level) can't overflow the C stack. The protocol's
+ * real structures are < 10 deep. */
+#define TD_MAX_DEPTH 64
+
+static int decode_impl(const char* d, size_t len, size_t* pos, td_val* out,
+                       int depth) {
   uint64_t n;
   size_t i;
   unsigned char tag;
   *out = td_null();
+  if (depth > TD_MAX_DEPTH) return -1;
   if (*pos >= len) return -1;
   tag = (unsigned char)d[(*pos)++];
   switch (tag) {
@@ -173,15 +180,15 @@ int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
     case 1:
     case 2:
       if (dec_vint(d, len, pos, &n)) return -1;
-      if (*pos + n > len) return -1;
-      *out = (tag == 1) ? td_bytes(d + *pos, n) : td_null();
-      if (tag == 2) {
-        out->t = TD_TEXT;
-        out->slen = n;
-        out->s = (char*)malloc(n + 1);
-        memcpy(out->s, d + *pos, n);
-        out->s[n] = 0;
-      }
+      /* compare against the REMAINDER: "*pos + n > len" wraps for huge
+       * n off the wire and would pass the check into an OOB memcpy */
+      if (n > len - *pos) return -1;
+      out->s = (char*)malloc((size_t)n + 1);
+      if (!out->s) return -1;
+      memcpy(out->s, d + *pos, n);
+      out->s[n] = 0;
+      out->t = (tag == 1) ? TD_BYTES : TD_TEXT;
+      out->slen = (size_t)n;
       *pos += n;
       return 0;
     case 3:
@@ -190,7 +197,7 @@ int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
       return 0;
     case 4: {
       uint64_t bits = 0;
-      if (*pos + 8 > len) return -1;
+      if (len - *pos < 8) return -1;
       for (i = 0; i < 8; i++)
         bits = (bits << 8) | (unsigned char)d[*pos + i];
       *pos += 8;
@@ -206,7 +213,10 @@ int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
       *out = td_list(n);
       if (!out->items) return -1;
       for (i = 0; i < n; i++)
-        if (td_decode(d, len, pos, &out->items[i])) { td_free(out); return -1; }
+        if (decode_impl(d, len, pos, &out->items[i], depth + 1)) {
+          td_free(out);
+          return -1;
+        }
       return 0;
     case 9:
       if (dec_vint(d, len, pos, &n)) return -1;
@@ -214,12 +224,19 @@ int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
       *out = td_dict(n);
       if (!out->items) return -1;
       for (i = 0; i < 2 * n; i++)
-        if (td_decode(d, len, pos, &out->items[i])) { td_free(out); return -1; }
+        if (decode_impl(d, len, pos, &out->items[i], depth + 1)) {
+          td_free(out);
+          return -1;
+        }
       return 0;
     default:
       /* tag 8 (ndarray) and unknown tags unsupported in C */
       return -1;
   }
+}
+
+int td_decode(const char* d, size_t len, size_t* pos, td_val* out) {
+  return decode_impl(d, len, pos, out, 0);
 }
 
 const td_val* td_get(const td_val* dict, const char* key) {

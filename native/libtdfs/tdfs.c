@@ -22,8 +22,11 @@
 #include <sys/time.h>
 #include <unistd.h>
 
-/* Sanity cap on a server frame length read off the wire. */
-#define TDFS_MAX_FRAME (256u * 1024 * 1024)
+/* Sanity cap on a server frame length read off the wire. Must exceed
+ * any configured dfs.block.size (read_block returns a whole block as
+ * one TD_BYTES frame); 1 GiB covers every sane block size while still
+ * refusing a hostile server's 4 GiB allocation bomb. */
+#define TDFS_MAX_FRAME (1024u * 1024 * 1024)
 
 static __thread char g_err[1024];
 
@@ -75,7 +78,7 @@ static int recv_frame(int fd, td_val* out) {
   /* The length word comes off the wire: bound it (server frames are
      block-chunk sized, far below this) and never trust malloc. */
   if (rlen > TDFS_MAX_FRAME) {
-    set_err("oversized frame from server (%s)", "len > 256 MiB");
+    set_err("oversized frame from server (%s)", "len > 1 GiB");
     return -1;
   }
   rdata = (char*)malloc(rlen ? rlen : 1);

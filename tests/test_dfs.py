@@ -761,6 +761,41 @@ class TestAppend:
                      if i.get("type") == "file")
         assert ns.total_known_blocks == actual
 
+    def test_delete_and_rename_of_open_file_keep_count_exact(self, cluster):
+        # deleting a file open for append must remove exactly its
+        # COUNTED blocks from the denominator (post-open blocks were
+        # never added); renaming one must move its counted-entry so the
+        # eventual close settles under the new path
+        ns = cluster.namenode.ns
+        client = cluster.client()
+
+        def actual():
+            return sum(len(i.get("blocks", []))
+                       for i in ns.namespace.values()
+                       if i.get("type") == "file" and not i.get("uc")) \
+                + sum(ns._uc_counted.get(p, 0) for p, i in
+                      ns.namespace.items() if i.get("uc"))
+
+        with client.create("/acc/del.bin") as f:
+            f.write(b"D" * 2500)                 # 3 counted blocks
+        w = client.append("/acc/del.bin")        # _uc_counted = 3
+        w.write(b"E" * 1500)                     # ~2 new, uncounted
+        w.hflush()
+        base = ns.total_known_blocks
+        ns._delete_impl("/acc/del.bin", recursive=False)
+        assert ns.total_known_blocks == base - 3
+        assert "/acc/del.bin" not in ns._uc_counted
+
+        with client.create("/acc/mv.bin") as f:
+            f.write(b"F" * 2500)
+        w2 = client.append("/acc/mv.bin")
+        w2.write(b"G" * 100)
+        w2.hflush()
+        ns.rename("/acc/mv.bin", "/acc/mv2.bin")
+        assert "/acc/mv.bin" not in ns._uc_counted
+        assert ns._uc_counted.get("/acc/mv2.bin") == 3
+        assert ns.total_known_blocks == actual()
+
     def test_append_survives_namenode_restart(self):
         conf = small_conf()
         with MiniDFSCluster(num_datanodes=2, conf=conf) as c:

@@ -718,12 +718,19 @@ class FSNamesystem:
         # the removed usage for the quota counters in the same pass
         doomed: list[int] = []
         removed_bytes = 0
+        counted_removed = 0
         for k in children + [path]:
             node = self.namespace.get(k, {})
             if node.get("type") == "file":
                 blocks = node.get("blocks", [])
                 doomed.extend(b[0] for b in blocks)
                 repl = node.get("replication", 1)
+                # only blocks actually IN total_known_blocks leave it: a
+                # uc file's post-open blocks were never added (its
+                # pre-open count lives in _uc_counted), so decrementing
+                # per doomed block would drift the safemode denominator
+                counted_removed += (self._uc_counted.pop(k, 0)
+                                    if node.get("uc") else len(blocks))
                 if node.get("uc") and blocks:
                     # the in-flight last block was charged a FULL block at
                     # add_block and never settled — refund what was
@@ -748,7 +755,8 @@ class FSNamesystem:
                     {"type": "delete", "block_id": bid})
             self.block_sizes.pop(bid, None)
             self.block_to_path.pop(bid, None)
-            self.total_known_blocks = max(0, self.total_known_blocks - 1)
+        self.total_known_blocks = max(
+            0, self.total_known_blocks - counted_removed)
         return True
 
     def rename(self, src: str, dst: str) -> bool:
@@ -796,6 +804,14 @@ class FSNamesystem:
             for k, v in moved_q:
                 del self._quota_usage[k]
                 self._quota_usage[dst + k[len(src):]] = v
+            # open-file counted-block entries move with their paths, or
+            # a later close would pop a stale/absent key and corrupt the
+            # safemode denominator
+            moved_uc = [k for k in self._uc_counted
+                        if k == src or k.startswith(src_prefix)]
+            for k in moved_uc:
+                self._uc_counted[dst + k[len(src):]] = \
+                    self._uc_counted.pop(k)
             self._charge(src, -(1 + sub_inodes), -sub_bytes)
             self._charge(dst, 1 + sub_inodes, sub_bytes)
             return True
