@@ -1,0 +1,185 @@
+/* fuzz_codec — deterministic fuzz loop for the td codec (codec.c), the
+ * frame payload parser libtdfs feeds with bytes read off the wire.
+ *
+ * Built with ASAN+UBSAN (make fuzz) and run in CI (tests/test_native.py
+ * TestSanitizers): libFuzzer isn't in this toolchain, so this is a
+ * self-contained driver — xorshift PRNG, fixed seeds, three phases:
+ *
+ *   A  random buffers -> td_decode must never crash/leak, only return -1
+ *   B  valid encodings mutated/truncated -> same
+ *   C  roundtrip property: encode(decode(encode(v))) is byte-identical
+ *
+ * argv: [iterations] [corpus-dir] — corpus files are decoded as-is and
+ * under mutation. SURVEY.md §5 sanitizer note; reference analog: the
+ * fault-injection tests around Writable deserialization.
+ */
+
+#include "codec.h"
+
+#include <dirent.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static uint64_t rng_state = 0x9E3779B97F4A7C15ull;
+
+static uint64_t rnd(void) {
+  uint64_t x = rng_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return rng_state = x;
+}
+
+static void decode_all(const char* data, size_t len) {
+  size_t pos = 0;
+  while (pos < len) {
+    td_val v;
+    if (td_decode(data, len, &pos, &v)) break;
+    td_free(&v);
+  }
+}
+
+/* a random valid value, bounded depth/size */
+static td_val gen_val(int depth) {
+  switch (rnd() % (depth > 3 ? 6 : 8)) {
+    case 0: return td_null();
+    case 1: return td_int((int64_t)rnd());
+    case 2: return td_bool(rnd() & 1);
+    case 3: {
+      double d;
+      uint64_t bits = rnd();
+      memcpy(&d, &bits, 8);
+      return td_float(d);
+    }
+    case 4: {
+      char buf[64];
+      size_t n = rnd() % sizeof buf, i;
+      for (i = 0; i < n; i++) buf[i] = (char)rnd();
+      return td_bytes(buf, n);
+    }
+    case 5: {
+      char buf[32];
+      size_t n = rnd() % (sizeof buf - 1), i;
+      for (i = 0; i < n; i++) buf[i] = (char)('a' + rnd() % 26);
+      buf[n] = 0;
+      return td_text(buf);
+    }
+    case 6: {
+      size_t n = rnd() % 5, i;
+      td_val v = td_list(n);
+      for (i = 0; i < n; i++) v.items[i] = gen_val(depth + 1);
+      return v;
+    }
+    default: {
+      size_t n = rnd() % 4, i;
+      td_val v = td_dict(n);
+      for (i = 0; i < n; i++) {
+        char key[16];
+        snprintf(key, sizeof key, "k%llu",
+                 (unsigned long long)(rnd() % 100));
+        v.items[2 * i] = td_text(key);
+        v.items[2 * i + 1] = gen_val(depth + 1);
+      }
+      return v;
+    }
+  }
+}
+
+static int roundtrip(const td_val* v) {
+  td_buf b1, b2;
+  td_val back;
+  size_t pos = 0;
+  int ok;
+  td_buf_init(&b1);
+  td_buf_init(&b2);
+  td_encode(&b1, v);
+  if (td_decode(b1.data, b1.len, &pos, &back)) {
+    fprintf(stderr, "FUZZ FAIL: valid encoding did not decode\n");
+    td_buf_free(&b1);
+    td_buf_free(&b2);
+    return -1;
+  }
+  td_encode(&b2, &back);
+  ok = b1.len == b2.len && memcmp(b1.data, b2.data, b1.len) == 0;
+  if (!ok)
+    fprintf(stderr, "FUZZ FAIL: roundtrip not byte-identical "
+            "(%zu vs %zu bytes)\n", b1.len, b2.len);
+  td_free(&back);
+  td_buf_free(&b1);
+  td_buf_free(&b2);
+  return ok ? 0 : -1;
+}
+
+static void mutate_and_decode(const char* data, size_t len) {
+  char* m = (char*)malloc(len ? len : 1);
+  size_t cut = len ? 1 + rnd() % len : 0, flips = 1 + rnd() % 8, i;
+  memcpy(m, data, len);
+  for (i = 0; i < flips && len; i++)
+    m[rnd() % len] = (char)rnd();
+  decode_all(m, len);
+  decode_all(m, cut);          /* truncation */
+  free(m);
+}
+
+static void fuzz_corpus_file(const char* path) {
+  FILE* f = fopen(path, "rb");
+  char* data;
+  long sz;
+  int i;
+  if (!f) return;
+  fseek(f, 0, SEEK_END);
+  sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz < 0 || sz > 1 << 20) {
+    fclose(f);
+    return;
+  }
+  data = (char*)malloc(sz ? (size_t)sz : 1);
+  if (fread(data, 1, (size_t)sz, f) != (size_t)sz) sz = 0;
+  fclose(f);
+  decode_all(data, (size_t)sz);
+  for (i = 0; i < 50; i++) mutate_and_decode(data, (size_t)sz);
+  free(data);
+}
+
+int main(int argc, char** argv) {
+  long iters = argc > 1 ? atol(argv[1]) : 2000;
+  long it;
+  for (it = 0; it < iters; it++) {
+    rng_state = 0x9E3779B97F4A7C15ull + (uint64_t)it * 2654435761u;
+    /* A: random garbage */
+    {
+      char buf[512];
+      size_t n = rnd() % sizeof buf, i;
+      for (i = 0; i < n; i++) buf[i] = (char)rnd();
+      decode_all(buf, n);
+    }
+    /* B+C: valid value -> roundtrip property -> mutations */
+    {
+      td_val v = gen_val(0);
+      td_buf b;
+      if (roundtrip(&v)) return 1;
+      td_buf_init(&b);
+      td_encode(&b, &v);
+      mutate_and_decode(b.data, b.len);
+      td_buf_free(&b);
+      td_free(&v);
+    }
+  }
+  if (argc > 2) {
+    DIR* d = opendir(argv[2]);
+    struct dirent* e;
+    if (d) {
+      while ((e = readdir(d)) != NULL) {
+        char path[4096];
+        if (e->d_name[0] == '.') continue;
+        snprintf(path, sizeof path, "%s/%s", argv[2], e->d_name);
+        fuzz_corpus_file(path);
+      }
+      closedir(d);
+    }
+  }
+  printf("fuzz_codec: %ld iterations clean\n", iters);
+  return 0;
+}

@@ -1,0 +1,129 @@
+// fuzz_stream — deterministic fuzz for the pipes child's wire parser
+// (SocketStream in tpumr_pipes.cc: LEB128 varints, length-prefixed
+// bytes, big-endian doubles — the protocol the child speaks with the
+// TaskTracker, ≈ the reference's BinaryProtocol stream).
+//
+// Includes the runtime TU directly to reach the internal class; built
+// with ASAN+UBSAN via `make fuzz` and run by tests/test_native.py.
+//
+// Phase A: random bytes through a random read schedule — the parser
+//          must only ever throw, never crash or over-read.
+// Phase B: writer->reader roundtrip property on random values.
+//
+// argv: [iterations]
+
+#include "tpumr_pipes.cc"  // NOLINT — internal-class test harness
+
+#include <fcntl.h>
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+
+using tpumr::pipes::SocketStream;
+
+static uint64_t rng_state;
+
+static uint64_t rnd() {
+  uint64_t x = rng_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return rng_state = x;
+}
+
+// feed buf through a pipe (capacity-safe: caller keeps n < 60KB)
+struct FedStream {
+  int fds[2];
+  explicit FedStream(const std::string& buf) {
+    if (pipe(fds) != 0) abort();
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t w = ::write(fds[1], buf.data() + off, buf.size() - off);
+      if (w <= 0) abort();
+      off += size_t(w);
+    }
+    ::close(fds[1]);
+  }
+  ~FedStream() { ::close(fds[0]); }
+};
+
+static void phase_random() {
+  std::string buf;
+  size_t n = rnd() % 2048;
+  for (size_t i = 0; i < n; i++) buf.push_back(char(rnd()));
+  FedStream fed(buf);
+  SocketStream io(fed.fds[0]);
+  try {
+    for (;;) {
+      switch (rnd() % 3) {
+        case 0: io.readVarint(); break;
+        case 1: io.readBytes(); break;
+        default: io.readDouble(); break;
+      }
+    }
+  } catch (const std::exception&) {
+    // expected: closed / too-large / varint-too-long — all fine
+  }
+}
+
+static int phase_roundtrip() {
+  std::vector<uint64_t> ints;
+  std::vector<std::string> blobs;
+  std::vector<double> dbls;
+  std::string buf;
+  {
+    int tmp[2];
+    if (pipe(tmp) != 0) abort();
+    SocketStream w(tmp[1]);
+    for (int i = 0; i < 8; i++) {
+      uint64_t v = rnd() >> (rnd() % 64);
+      ints.push_back(v);
+      w.writeVarint(v);
+      std::string s;
+      size_t n = rnd() % 512;
+      for (size_t j = 0; j < n; j++) s.push_back(char(rnd()));
+      blobs.push_back(s);
+      w.writeBytes(s);
+      double d;
+      uint64_t bits = rnd();
+      memcpy(&d, &bits, 8);
+      dbls.push_back(d);
+      w.writeDouble(d);
+    }
+    w.flush();
+    ::close(tmp[1]);
+    char c[4096];
+    ssize_t r;
+    while ((r = ::read(tmp[0], c, sizeof c)) > 0) buf.append(c, size_t(r));
+    ::close(tmp[0]);
+  }
+  FedStream fed(buf);
+  SocketStream io(fed.fds[0]);
+  for (int i = 0; i < 8; i++) {
+    if (io.readVarint() != ints[size_t(i)]) {
+      fprintf(stderr, "FUZZ FAIL: varint roundtrip\n");
+      return -1;
+    }
+    if (io.readBytes() != blobs[size_t(i)]) {
+      fprintf(stderr, "FUZZ FAIL: bytes roundtrip\n");
+      return -1;
+    }
+    double d = io.readDouble();
+    if (memcmp(&d, &dbls[size_t(i)], 8) != 0) {
+      fprintf(stderr, "FUZZ FAIL: double roundtrip\n");
+      return -1;
+    }
+  }
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  long iters = argc > 1 ? atol(argv[1]) : 500;
+  for (long it = 0; it < iters; it++) {
+    rng_state = 0xF00DF00D ^ uint64_t(it) * 0x9E3779B97F4A7C15ull;
+    phase_random();
+    if (phase_roundtrip()) return 1;
+  }
+  printf("fuzz_stream: %ld iterations clean\n", iters);
+  return 0;
+}

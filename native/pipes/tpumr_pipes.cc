@@ -131,6 +131,10 @@ static std::string hmacSha1Hex(const std::string& key,
 // ------------------------------------------------------------------ stream
 class SocketStream {
  public:
+  // sanity bound on one length-prefixed byte string; key/value/split
+  // payloads are far smaller (the framework streams large data)
+  static const uint64_t kMaxBytes = 256ull * 1024 * 1024;
+
   explicit SocketStream(int fd) : fd_(fd), rpos_(0), rlen_(0) {}
 
   uint64_t readVarint() {
@@ -146,6 +150,10 @@ class SocketStream {
   }
   std::string readBytes() {
     uint64_t n = readVarint();
+    // the length is untrusted wire data: cap it before the allocation
+    // (a hostile/corrupt parent could otherwise drive a 2^63 resize)
+    if (n > kMaxBytes)
+      throw std::runtime_error("pipes frame too large");
     std::string out(n, '\0');
     readFully(&out[0], n);
     return out;

@@ -1,0 +1,5 @@
+# Shared sanitizer configuration for every native tier's fuzz/ASAN
+# targets — change instrumentation HERE, not per-Makefile (a missed copy
+# silently runs a tier with weaker checking).
+SANFLAGS := -fsanitize=address,undefined -fno-sanitize-recover=all \
+  -fno-omit-frame-pointer -g -O1

@@ -1,0 +1,163 @@
+/* fuzz_tokencount — deterministic fuzz + property check for the
+ * single-pass tokenizer (tokencount.c), which runs over arbitrary split
+ * bytes handed in by the wordcount job.
+ *
+ * Properties checked each iteration (ASAN+UBSAN catch the memory side):
+ *   - the result buffer parses: entry lens stay in bounds, n_entries
+ *     matches the walked count
+ *   - sum(count) equals a naive independent token count
+ *   - every emitted token contains no whitespace byte
+ *
+ * argv: [iterations] [corpus-dir]
+ */
+
+#include <dirent.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+char* tc_count(const unsigned char* data, uint64_t n, uint64_t* out_len);
+void tc_free(char* p);
+
+static uint64_t rng_state;
+
+static uint64_t rnd(void) {
+  uint64_t x = rng_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return rng_state = x;
+}
+
+static int is_ws(unsigned char c) {
+  return c == 9 || c == 10 || c == 11 || c == 12 || c == 13 || c == 32;
+}
+
+static uint64_t naive_tokens(const unsigned char* d, uint64_t n) {
+  uint64_t i = 0, count = 0;
+  while (i < n) {
+    while (i < n && is_ws(d[i])) i++;
+    if (i < n) count++;
+    while (i < n && !is_ws(d[i])) i++;
+  }
+  return count;
+}
+
+static int check(const unsigned char* data, uint64_t n) {
+  uint64_t out_len = 0, entries, total = 0, w = 8, k, i;
+  char* out = tc_count(data, n, &out_len);
+  if (!out) {
+    fprintf(stderr, "FUZZ FAIL: tc_count returned NULL for %llu bytes\n",
+            (unsigned long long)n);
+    return -1;
+  }
+  if (out_len < 8) {
+    fprintf(stderr, "FUZZ FAIL: result shorter than header\n");
+    tc_free(out);
+    return -1;
+  }
+  memcpy(&entries, out, 8);
+  for (k = 0; k < entries; k++) {
+    uint32_t len;
+    uint64_t count;
+    if (w + 12 > out_len) {
+      fprintf(stderr, "FUZZ FAIL: entry %llu header out of bounds\n",
+              (unsigned long long)k);
+      tc_free(out);
+      return -1;
+    }
+    memcpy(&len, out + w, 4);
+    memcpy(&count, out + w + 4, 8);
+    if (w + 12 + len > out_len || len == 0 || count == 0) {
+      fprintf(stderr, "FUZZ FAIL: entry %llu malformed\n",
+              (unsigned long long)k);
+      tc_free(out);
+      return -1;
+    }
+    for (i = 0; i < len; i++)
+      if (is_ws((unsigned char)out[w + 12 + i])) {
+        fprintf(stderr, "FUZZ FAIL: token contains whitespace\n");
+        tc_free(out);
+        return -1;
+      }
+    total += count;
+    w += 12 + len;
+  }
+  if (w != out_len) {
+    fprintf(stderr, "FUZZ FAIL: trailing bytes after last entry\n");
+    tc_free(out);
+    return -1;
+  }
+  if (total != naive_tokens(data, n)) {
+    fprintf(stderr, "FUZZ FAIL: count mismatch %llu vs naive %llu\n",
+            (unsigned long long)total,
+            (unsigned long long)naive_tokens(data, n));
+    tc_free(out);
+    return -1;
+  }
+  tc_free(out);
+  return 0;
+}
+
+static int fuzz_corpus_file(const char* path) {
+  FILE* f = fopen(path, "rb");
+  unsigned char* data;
+  long sz;
+  int rc;
+  if (!f) return 0;
+  fseek(f, 0, SEEK_END);
+  sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz < 0 || sz > 4 << 20) {
+    fclose(f);
+    return 0;
+  }
+  data = (unsigned char*)malloc(sz ? (size_t)sz : 1);
+  if (fread(data, 1, (size_t)sz, f) != (size_t)sz) sz = 0;
+  fclose(f);
+  rc = check(data, (uint64_t)sz);
+  free(data);
+  return rc;
+}
+
+int main(int argc, char** argv) {
+  long iters = argc > 1 ? atol(argv[1]) : 1000;
+  long it;
+  for (it = 0; it < iters; it++) {
+    unsigned char buf[2048];
+    size_t n, i;
+    rng_state = 0xC0FFEE ^ (uint64_t)it * 0x9E3779B97F4A7C15ull;
+    n = rnd() % sizeof buf;
+    for (i = 0; i < n; i++) {
+      /* bias: ~1/4 whitespace, mix of repeated and arbitrary bytes */
+      uint64_t r = rnd();
+      if ((r & 3) == 0)
+        buf[i] = " \t\n\v\f\r"[r % 6];
+      else if ((r & 3) == 1)
+        buf[i] = (unsigned char)('a' + r % 4);   /* heavy collisions */
+      else
+        buf[i] = (unsigned char)r;
+    }
+    if (check(buf, n)) return 1;
+    if (n) {                    /* no trailing separator */
+      while (n && is_ws(buf[n - 1])) n--;
+      if (check(buf, n)) return 1;
+    }
+  }
+  if (argc > 2) {
+    DIR* d = opendir(argv[2]);
+    struct dirent* e;
+    if (d) {
+      while ((e = readdir(d)) != NULL) {
+        char path[4096];
+        if (e->d_name[0] == '.') continue;
+        snprintf(path, sizeof path, "%s/%s", argv[2], e->d_name);
+        if (fuzz_corpus_file(path)) return 1;
+      }
+      closedir(d);
+    }
+  }
+  printf("fuzz_tokencount: %ld iterations clean\n", iters);
+  return 0;
+}
