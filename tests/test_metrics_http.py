@@ -219,6 +219,39 @@ class TestJobTrackerHttp:
         finally:
             hs.stop()
 
+    def test_history_task_drilldown(self, cluster):
+        """Per-task drill-down (≈ jobtasks.jsp + TaskGraphServlet): the
+        /json/tasks rows carry timings + placement for every attempt,
+        and /jobtasks renders the backend-colored timeline SVG."""
+        result = run_wc(cluster, "drill")
+        jid = str(result.job_id)
+        from tpumr.mapred.history_server import JobHistoryServer
+        hs = JobHistoryServer(cluster.history_dir).start()
+        try:
+            code, body = fetch(hs.url + f"/json/tasks?id={jid}")
+            assert code == 200
+            tasks = json.loads(body)
+            maps = [t for t in tasks if t.get("is_map")]
+            assert maps, tasks
+            for t in maps:
+                assert t["state"] == "FINISHED"
+                assert t["start_ts"] is not None
+                assert t["runtime"] is not None and t["runtime"] >= 0
+                assert t["tracker"]
+                assert t["run_on_tpu"] is False     # cpu-only cluster
+            assert any(not t.get("is_map") for t in tasks)  # the reduce
+
+            code, body = fetch(hs.url + f"/jobtasks?id={jid}")
+            assert code == 200
+            assert "<svg" in body and "attempt_" in body
+            assert "[cpu]" in body      # per-row backend label, not the
+            assert "[reduce]" in body   # static legend
+            # the index links each job to its drill-down page
+            code, index = fetch(hs.url + "/index")
+            assert f"/jobtasks?id={jid}" in index
+        finally:
+            hs.stop()
+
     def test_history_server_redacts_submission_conf(self, tmp_path):
         """The JOB_SUBMITTED event keeps the full conf on disk (recovery
         needs it) but the history status port must mask credentials."""

@@ -36,6 +36,106 @@ def job_summary(events: list[dict]) -> dict:
     return out
 
 
+def task_timeline(events: list[dict]) -> list[dict]:
+    """Per-attempt rows merged from TASK_STARTED + terminal events —
+    the data behind the drill-down table and timeline (the role of the
+    reference's jobtasks.jsp/taskdetails.jsp tables and
+    ``TaskGraphServlet``'s progress graph, src/mapred/org/apache/hadoop/
+    mapred/TaskGraphServlet.java — placement is first-class here where
+    the reference had no backend column at all)."""
+    rows: dict[str, dict] = {}
+    for ev in events:
+        kind = ev.get("event")
+        aid = ev.get("attempt_id")
+        if not aid:
+            continue
+        row = rows.setdefault(aid, {"attempt_id": aid})
+        if kind == "TASK_STARTED":
+            row.update(start_ts=ev.get("ts"), is_map=ev.get("is_map"),
+                       run_on_tpu=ev.get("run_on_tpu"),
+                       tpu_device_id=ev.get("tpu_device_id"),
+                       tracker=ev.get("tracker"))
+        elif kind in ("TASK_FINISHED", "TASK_FAILED", "TASK_KILLED"):
+            row.update(state=kind[len("TASK_"):], finish_ts=ev.get("ts"),
+                       runtime=ev.get("runtime"),
+                       is_map=ev.get("is_map", row.get("is_map")),
+                       run_on_tpu=ev.get("run_on_tpu",
+                                         row.get("run_on_tpu")),
+                       tpu_device_id=ev.get("tpu_device_id",
+                                            row.get("tpu_device_id")),
+                       tracker=ev.get("tracker", row.get("tracker")),
+                       counters=ev.get("counters"))
+            # attempts recovered from a pre-restart log may miss their
+            # TASK_STARTED: derive start from finish - runtime
+            if row.get("start_ts") is None and ev.get("ts") is not None \
+                    and ev.get("runtime") is not None:
+                row["start_ts"] = ev["ts"] - ev["runtime"]
+    out = sorted(rows.values(), key=lambda r: (r.get("start_ts") or 0,
+                                               r["attempt_id"]))
+    for r in out:
+        r.setdefault("state", "RUNNING")
+        if r.get("runtime") is None and r.get("start_ts") is not None \
+                and r.get("finish_ts") is not None:
+            r["runtime"] = r["finish_ts"] - r["start_ts"]
+    return out
+
+
+def _backend_label(t: dict) -> str:
+    """Placement label shared by the SVG rows and the attempts table —
+    one definition so the two views can't drift."""
+    if not t.get("is_map"):
+        return "reduce"
+    return f"tpu:{t.get('tpu_device_id')}" if t.get("run_on_tpu") \
+        else "cpu"
+
+
+def timeline_svg(tasks: list[dict], width: int = 900) -> str:
+    """Inline-SVG Gantt of one job's attempts, colored by backend —
+    the TaskGraphServlet drawing, redrawn for the hybrid story: the
+    convergence signature (CPU rows early, an all-TPU tail) is visible
+    at a glance."""
+    from tpumr.http import html_escape
+    spans = [t for t in tasks if t.get("start_ts") is not None]
+    if not spans:
+        return "<p class='dim'>no timeline data in this job's events</p>"
+    t0 = min(t["start_ts"] for t in spans)
+    t1 = max((t.get("finish_ts") or t["start_ts"]) for t in spans)
+    span = max(t1 - t0, 1e-6)
+    rh, gap, left = 16, 4, 230
+    h = len(spans) * (rh + gap) + 24
+    parts = [f"<svg viewBox='0 0 {width} {h}' width='100%' "
+             f"xmlns='http://www.w3.org/2000/svg' role='img' "
+             f"style='font:11px monospace'>"]
+    for i, t in enumerate(spans):
+        y = i * (rh + gap) + 18
+        x0 = left + (t["start_ts"] - t0) / span * (width - left - 10)
+        x1 = left + ((t.get("finish_ts") or t1) - t0) / span \
+            * (width - left - 10)
+        color = ("#7f5af0" if t.get("run_on_tpu") else "#2cb67d") \
+            if t.get("state") == "FINISHED" else \
+            ("#e45858" if t.get("state") in ("FAILED", "KILLED")
+             else "#888888")
+        label = t["attempt_id"]
+        backend = _backend_label(t)
+        parts.append(
+            f"<text x='0' y='{y + rh - 4}' fill='currentColor'>"
+            f"{html_escape(label)} [{html_escape(backend)}]</text>")
+        parts.append(
+            f"<rect x='{x0:.1f}' y='{y}' "
+            f"width='{max(x1 - x0, 2):.1f}' height='{rh}' rx='2' "
+            f"fill='{color}'><title>{html_escape(label)} "
+            f"{html_escape(backend)} {t.get('runtime') or 0:.2f}s "
+            f"{html_escape(t.get('state', ''))}</title></rect>")
+    parts.append(
+        f"<text x='{left}' y='12' fill='currentColor'>"
+        f"0s … {span:.2f}s &#160; "
+        f"<tspan fill='#7f5af0'>&#9632; tpu</tspan> "
+        f"<tspan fill='#2cb67d'>&#9632; cpu</tspan> "
+        f"<tspan fill='#e45858'>&#9632; failed</tspan></text>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
 class JobHistoryServer:
     def __init__(self, history_dir: str, host: str = "127.0.0.1",
                  port: int = 0) -> None:
@@ -47,7 +147,10 @@ class JobHistoryServer:
         self._http = StatusHttpServer("history", host=host, port=port)
         self._http.add_json("history", self._list)
         self._http.add_json("job", self._job, parameterized=True)
+        self._http.add_json("tasks", self._tasks, parameterized=True)
         self._http.add_page("index", self._index_page)
+        self._http.add_page("jobtasks", self._jobtasks_page,
+                            parameterized=True)
 
     def _index_page(self, q: dict) -> str:
         """Completed-jobs table ≈ webapps/history jobhistory.jsp."""
@@ -58,8 +161,10 @@ class JobHistoryServer:
                         reverse=True):
             state = s.get("state", "?")
             cls = "ok" if state == "SUCCEEDED" else "bad"
+            jid = s.get("job_id", "?")
             rows.append([
-                s.get("job_id", "?"),
+                RawHtml(f"<a href='/jobtasks?id={html_escape(jid)}'>"
+                        f"{html_escape(jid)}</a>"),
                 s.get("name", ""),
                 RawHtml(f"<span class='{cls}'>{html_escape(state)}</span>"),
                 f"{s.get('num_maps', '?')}", f"{s.get('num_reduces', '?')}",
@@ -96,6 +201,58 @@ class JobHistoryServer:
             return {"error": f"no history for job {q.get('id')!r}",
                     "known": sorted(self._files())}
         return [self._redact(ev) for ev in JobHistory.read(path)]
+
+    def _tasks(self, q: dict) -> Any:
+        """Per-attempt drill-down rows (timings, tracker, placement)."""
+        path = self._files().get(q.get("id", ""))
+        if path is None:
+            return {"error": f"no history for job {q.get('id')!r}"}
+        return task_timeline(JobHistory.read(path))
+
+    def _jobtasks_page(self, q: dict) -> str:
+        """Task table + backend-colored timeline for one finished job
+        (≈ jobtasks.jsp/taskdetails.jsp + TaskGraphServlet)."""
+        from tpumr.http import RawHtml, html_escape, html_table
+        jid = q.get("id", "")
+        path = self._files().get(jid)
+        if path is None:
+            return (f"<h1>Unknown job {html_escape(jid)}</h1>"
+                    "<p><a href='/index'>back</a></p>")
+        events = JobHistory.read(path)
+        summary = job_summary(events)
+        tasks = task_timeline(events)
+        rows = []
+        from tpumr.core.counters import TaskCounter
+        for t in tasks:
+            cls = {"FINISHED": "ok", "FAILED": "bad",
+                   "KILLED": "bad"}.get(t.get("state", ""), "dim")
+            shuffled = (t.get("counters") or {}).get(
+                TaskCounter.FRAMEWORK_GROUP, {}).get(
+                TaskCounter.REDUCE_SHUFFLE_BYTES)
+            rows.append([
+                t["attempt_id"],
+                RawHtml(f"<span class='{cls}'>"
+                        f"{html_escape(t.get('state', '?'))}</span>"),
+                _backend_label(t),
+                t.get("tracker") or "—",
+                (f"{t['runtime']:.2f}s"
+                 if t.get("runtime") is not None else "—"),
+                (f"{shuffled:,}" if shuffled is not None else "—"),
+            ])
+        name = summary.get("name") or ""
+        return (
+            f"<h1>Tasks — {html_escape(jid)}</h1>"
+            f"<p>{html_escape(name)} · state "
+            f"<b>{html_escape(str(summary.get('state', '?')))}</b> · "
+            f"{summary.get('num_maps', '?')} maps / "
+            f"{summary.get('num_reduces', '?')} reduces · accel "
+            f"{summary.get('acceleration_factor') or '—'}</p>"
+            f"<h2>Timeline</h2>" + timeline_svg(tasks)
+            + f"<h2>Attempts ({len(rows)})</h2>"
+            + html_table(["attempt", "state", "backend", "tracker",
+                          "runtime", "shuffle bytes"], rows)
+            + "<p><a href='/index'>« job list</a> · "
+            + f"<a href='/job?id={html_escape(jid)}'>raw events</a></p>")
 
     @staticmethod
     def _redact(event: dict) -> dict:

@@ -645,6 +645,17 @@ class JobMaster:
                     actions.append({"type": "launch",
                                     "job_id": str(task.attempt_id.task.job),
                                     "task": task.to_dict()})
+                    # assignment-time event: gives the history timeline
+                    # true start stamps + placement (≈ JobHistory
+                    # Task.START_TIME; rendered by the history server's
+                    # /jobtasks view, the TaskGraphServlet role)
+                    deferred_events.append((
+                        str(task.attempt_id.task.job), "TASK_STARTED",
+                        dict(attempt_id=str(task.attempt_id),
+                             is_map=task.is_map,
+                             run_on_tpu=task.run_on_tpu,
+                             tpu_device_id=task.tpu_device_id,
+                             tracker=name)))
 
             response_id += 1
             self._last_response[name] = (response_id, actions)
