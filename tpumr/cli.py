@@ -225,8 +225,9 @@ def cmd_job(conf, argv: list[str]) -> int:
         print("job control needs -jt HOST:PORT", file=sys.stderr)
         return 255
     host, port = _host_port(jt)
-    from tpumr.security import rpc_secret
-    client = RpcClient(host, port, secret=rpc_secret(conf))
+    from tpumr.security import client_credentials
+    secret, scope = client_credentials(conf, "jobtracker")
+    client = RpcClient(host, port, secret=secret, scope=scope)
     usage = ("Usage: tpumr job -list | -status ID | -kill ID | "
              "-counters ID | -events ID | -history ID [HISTORY_DIR]")
     if not argv:
@@ -540,6 +541,110 @@ def cmd_examples(conf, argv: list[str]) -> int:
     return ex_main(argv)
 
 
+def cmd_keys(conf, argv: list[str]) -> int:
+    """Credential provisioning (tpumr/security/tokens.py):
+
+    - ``keys user-key USER`` — derive USER's personal signing key from
+      the cluster secret (operator-side; hand the hex to the user, who
+      sets ``tpumr.rpc.user.key``). ≈ provisioning a service keytab.
+    - ``keys token [-renewer R] [-out FILE]`` — obtain a delegation
+      token from the JobTracker for the CALLER's identity and write the
+      credential file (``tpumr.rpc.token.file``).
+    - ``keys renew FILE`` / ``keys cancel FILE``.
+    """
+    usage = ("Usage: tpumr keys user-key USER | "
+             "token [-renewer R] [-out FILE] | renew FILE | cancel FILE")
+    if not argv:
+        print(usage, file=sys.stderr)
+        return 255
+    sub, *rest = argv
+    if sub == "user-key":
+        from tpumr.security import rpc_secret
+        from tpumr.security.tokens import derive_user_key
+        secret = rpc_secret(conf)
+        if secret is None or not rest:
+            print("user-key needs USER and the cluster secret "
+                  "(tpumr.rpc.secret[.file])", file=sys.stderr)
+            return 1
+        print(derive_user_key(secret, rest[0]).hex())
+        return 0
+    if sub in ("token", "renew", "cancel"):
+        from tpumr.ipc.rpc import RpcClient, RpcError
+        from tpumr.security import client_credentials
+        # -nn targets the NameNode (tokens are per-issuing-service,
+        # like the reference's NN vs JT delegation tokens)
+        service = "namenode" if "-nn" in rest else "jobtracker"
+        rest = [a for a in rest if a != "-nn"]
+        if service == "namenode":
+            default = str(conf.get("fs.default.name", ""))
+            if not default.startswith("tdfs://"):
+                print("-nn needs fs.default.name=tdfs://HOST:PORT",
+                      file=sys.stderr)
+                return 255
+            host, port = _host_port(default[len("tdfs://"):].rstrip("/"))
+        else:
+            jt = conf.get("mapred.job.tracker")
+            if not jt or jt == "local":
+                print("token ops need -jt HOST:PORT", file=sys.stderr)
+                return 255
+            host, port = _host_port(jt)
+        secret, scope = client_credentials(conf, service)
+        client = RpcClient(host, port, secret=secret, scope=scope)
+        try:
+            if sub == "token":
+                renewer, out = "", None
+                it = iter(rest)
+                for a in it:
+                    if a == "-renewer":
+                        renewer = next(it, "")
+                    elif a == "-out":
+                        out = next(it, None)
+                wire = client.call("get_delegation_token", renewer)
+                if out:
+                    # merge under the service key so one credential file
+                    # can hold both the JT and NN tokens
+                    merged: dict = {}
+                    if os.path.exists(out):
+                        with open(out) as f:
+                            prev = json.load(f)
+                        if isinstance(prev, dict):
+                            if "ident" in prev:
+                                # flat single-service file: preserve the
+                                # existing credential under the OTHER
+                                # service key rather than discarding it
+                                other = ("namenode"
+                                         if service == "jobtracker"
+                                         else "jobtracker")
+                                merged = {other: prev}
+                            else:
+                                merged = prev
+                    merged[service] = wire
+                    fd = os.open(out, os.O_WRONLY | os.O_CREAT
+                                 | os.O_TRUNC, 0o600)  # credential file
+                    with os.fdopen(fd, "w") as f:
+                        json.dump(merged, f, indent=2)
+                        f.write("\n")
+                    print(f"{service} token written to {out}")
+                else:
+                    print(json.dumps(wire, indent=2))
+                return 0
+            with open(rest[0]) as f:
+                data = json.load(f)
+            wire = data if "ident" in data else data[service]
+            if sub == "renew":
+                exp = client.call("renew_delegation_token", wire)
+                print(f"renewed until {exp}")
+            else:
+                client.call("cancel_delegation_token", wire)
+                print("canceled")
+            return 0
+        except (RpcError, OSError, IndexError, ValueError, KeyError) as e:
+            print(f"keys {sub}: {e}", file=sys.stderr)
+            return 1
+    print(usage, file=sys.stderr)
+    return 255
+
+
 def cmd_version(conf, argv: list[str]) -> int:
     print(f"tpumr {VERSION}")
     return 0
@@ -565,6 +670,7 @@ COMMANDS = {
     "archive": cmd_archive,
     "rumen": cmd_rumen,
     "examples": cmd_examples,
+    "keys": cmd_keys,
     "version": cmd_version,
 }
 

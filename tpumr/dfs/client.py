@@ -25,11 +25,14 @@ from tpumr.ipc.rpc import RpcClient, RpcError
 class DFSClient:
     def __init__(self, host: str, port: int, conf: Any = None) -> None:
         self.conf = conf
-        from tpumr.security import rpc_secret
-        self._secret = rpc_secret(conf)
-        self.nn = RpcClient(host, int(port), secret=self._secret)
+        from tpumr.security import client_credentials
+        self._secret, self._scope = client_credentials(conf, "namenode")
+        self.nn = RpcClient(host, int(port), secret=self._secret,
+                            scope=self._scope)
         self.name = f"TDFSClient_{uuid.uuid4().hex[:12]}"
         self._dn_clients: dict[str, RpcClient] = {}
+        #: block_id -> NameNode access stamp (≈ LocatedBlock.blockToken)
+        self._block_access: dict[int, Any] = {}
         self._lock = threading.Lock()
         self._open_writes = 0
         self._renewer: threading.Thread | None = None
@@ -42,8 +45,30 @@ class DFSClient:
             cli = self._dn_clients.get(addr)
             if cli is None:
                 host, port = addr.rsplit(":", 1)
-                cli = self._dn_clients[addr] = RpcClient(host, int(port), secret=self._secret)
+                cli = self._dn_clients[addr] = RpcClient(
+                    host, int(port), secret=self._secret,
+                    scope=self._scope)
+                cli.envelope_provider = self._dn_envelope
             return cli
+
+    def _dn_envelope(self, method: str, params: tuple) -> "dict | None":
+        """Attach the NameNode-minted block-access stamp to DataNode
+        calls (personal-credential clients only — daemons don't need
+        one). Stamps arrive on get_block_locations/add_block responses."""
+        if self._scope is None or not params:
+            return None
+        try:
+            stamp = self._block_access.get(int(params[0]))
+        except (TypeError, ValueError):
+            return None
+        return {"access": stamp} if stamp is not None else None
+
+    def _remember_access(self, block_id: Any, stamp: Any) -> None:
+        if stamp is None:
+            return
+        if len(self._block_access) > 8192:   # bound a long-lived client
+            self._block_access.clear()
+        self._block_access[int(block_id)] = stamp
 
     # ------------------------------------------------------------ lease
 
@@ -96,6 +121,8 @@ class DFSClient:
 
     def open(self, path: str) -> io.BufferedReader:
         blocks = self.nn.call("get_block_locations", path)
+        for b in blocks:
+            self._remember_access(b["block_id"], b.get("access"))
         return io.BufferedReader(_DFSInputStream(self, blocks))
 
     # ------------------------------------------------------------ namespace
@@ -171,6 +198,7 @@ class _DFSOutputStream(io.RawIOBase):
                                         self.client.name,
                                         self._prev_block_size, excluded)
             bid, targets = alloc["block_id"], alloc["targets"]
+            self.client._remember_access(bid, alloc.get("access"))
             # prev size is journaled now; next add_block must not re-log it
             self._prev_block_size = -1
             cli = self.client._dn(targets[0])

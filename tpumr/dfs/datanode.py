@@ -183,6 +183,13 @@ class DataNode:
                                      1 << 40))
         self.heartbeat_s = float(conf.get("tdfs.datanode.heartbeat.s", 1.0))
         self._server = RpcServer(self, host=host, port=port, secret=self._secret)
+        # Personal-credential callers (user keys, delegation tokens)
+        # reach block data ONLY with a NameNode-minted per-block access
+        # stamp (≈ the reference's BlockToken split): the frame is
+        # authenticated statelessly, the GATE below demands the stamp.
+        # Cluster-secret daemons (NN commands, peer replication) bypass.
+        self._server.token_stateless = True
+        self._server.request_gate = self._gate_block_access
         self._stop = threading.Event()
         self._hb = threading.Thread(target=self._heartbeat_loop,
                                     name="dn-heartbeat", daemon=True)
@@ -303,6 +310,40 @@ class DataNode:
                     continue
         elif kind == "register":
             self._register()
+
+    # ------------------------------------------------------------ access gate
+
+    #: method -> required access mode; every entry takes block_id first
+    _GATED = {"read_block": "r", "read_block_chunk": "r",
+              "block_checksum": "r", "write_block": "w",
+              "open_block_stream": "w", "write_block_chunk": "w",
+              "commit_block_stream": "w", "abort_block_stream": "w"}
+
+    def _gate_block_access(self, req: dict, verified_user, job_scoped):
+        """Pre-dispatch enforcement (rpc request_gate): personal-scoped
+        callers must present a live NameNode stamp bound to (user,
+        block, mode). Raw block ids are guessable integers — without
+        this, a canceled token could read/corrupt arbitrary blocks until
+        its max lifetime."""
+        if verified_user is None:
+            return                      # cluster-secret daemon caller
+        from tpumr.ipc.rpc import RpcAuthError
+        method = str(req.get("method", ""))
+        mode = self._GATED.get(method)
+        if mode is None:
+            if method in ("get_protocol_version",):
+                return
+            raise RpcAuthError(
+                f"method {method!r} is not available to "
+                "personal-credential callers")
+        params = req.get("params") or []
+        from tpumr.security.tokens import check_block_access
+        if not params or not check_block_access(
+                self._secret, req.get("access"), verified_user,
+                params[0], mode):
+            raise RpcAuthError(
+                "block access denied: missing/expired/mismatched "
+                "NameNode access stamp")
 
     # ------------------------------------------------------------ transfer RPC
 

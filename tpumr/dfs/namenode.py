@@ -1336,8 +1336,16 @@ class NameNode:
         self.ns = FSNamesystem(name_dir, conf)
         self.dn_expiry_s = float(conf.get("tdfs.datanode.expiry.s", 10))
         from tpumr.security import rpc_secret
+        self._rpc_secret = rpc_secret(conf)
         self._server = RpcServer(self, host=host, port=port,
-                                 secret=rpc_secret(conf))
+                                 secret=self._rpc_secret)
+        # per-service delegation tokens (≈ ClientProtocol.
+        # getDelegationToken / DelegationTokenSecretManager): the
+        # NameNode issues + tracks liveness for ITS tokens; JobTracker
+        # tokens are a different service's and don't verify here
+        from tpumr.security.tokens import TokenStore
+        self.token_store = TokenStore(conf)
+        self._server.token_store = self.token_store
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="nn-monitors", daemon=True)
@@ -1456,6 +1464,7 @@ class NameNode:
                 self.ns.replication_check()
                 self.ns.lease_check()
                 self.ns.decommission_check()
+                self.token_store.purge_expired()
                 if auto_ckpt and self.ns.edits_bytes() > auto_ckpt:
                     self.ns.save_namespace()
                 if time.monotonic() - last_trash >= trash_every:
@@ -1481,8 +1490,28 @@ class NameNode:
     def fsync(self, path, client, last_block_size):
         return self.ns.fsync(path, client, last_block_size)
 
+    def _mint_access(self, block_id, mode):
+        """Short-lived per-block DataNode access stamp for the calling
+        user (≈ BlockTokenSecretManager.generateToken, attached to
+        located blocks). Only block-id-granting RPCs mint, so a
+        canceled/expired delegation token stops yielding fresh stamps —
+        DN access dies within the stamp lifetime."""
+        if self._rpc_secret is None:
+            return None
+        from tpumr.ipc.rpc import current_rpc_user
+        from tpumr.security.tokens import mint_block_access
+        lifetime = float(self.conf.get("tpumr.block.access.lifetime.s",
+                                       3600.0))
+        return mint_block_access(self._rpc_secret,
+                                 str(current_rpc_user() or ""),
+                                 block_id, mode, lifetime)
+
     def add_block(self, path, client, prev_block_size=-1, excluded=None):
-        return self.ns.add_block(path, client, prev_block_size, excluded)
+        out = self.ns.add_block(path, client, prev_block_size, excluded)
+        access = self._mint_access(out["block_id"], "rw")
+        if access is not None:
+            out["access"] = access
+        return out
 
     def abandon_block(self, path, client, block_id):
         return self.ns.abandon_block(path, client, block_id)
@@ -1494,10 +1523,37 @@ class NameNode:
         return self.ns.renew_lease(client)
 
     def get_block_locations(self, path):
-        return self.ns.get_block_locations(path)
+        out = self.ns.get_block_locations(path)
+        if self._rpc_secret is not None:
+            for b in out:
+                access = self._mint_access(b["block_id"], "r")
+                if access is not None:
+                    b["access"] = access
+        return out
 
     def mkdirs(self, path):
         return self.ns.mkdirs(path)
+
+    # per-service delegation tokens ≈ ClientProtocol.getDelegationToken/
+    # renewDelegationToken/cancelDelegationToken (DFSClient token path)
+
+    def get_delegation_token(self, renewer=""):
+        from tpumr.security.tokens import issue_for_caller
+        return issue_for_caller(self.token_store, self._rpc_secret,
+                                renewer)
+
+    def renew_delegation_token(self, wire):
+        from tpumr.ipc.rpc import current_rpc_user
+        from tpumr.security.tokens import verify_wire
+        tok = verify_wire(self._rpc_secret, wire)
+        return self.token_store.renew(tok, str(current_rpc_user() or ""))
+
+    def cancel_delegation_token(self, wire):
+        from tpumr.ipc.rpc import current_rpc_user
+        from tpumr.security.tokens import verify_wire
+        tok = verify_wire(self._rpc_secret, wire)
+        self.token_store.cancel(tok, str(current_rpc_user() or ""))
+        return True
 
     def delete(self, path, recursive=True):
         return self.ns.delete(path, recursive)

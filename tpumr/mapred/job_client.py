@@ -68,9 +68,10 @@ class JobClient:
         self._client: RpcClient | None = None
         if tracker and tracker != "local":
             host, port = str(tracker).rsplit(":", 1)
-            from tpumr.security import rpc_secret
-            self._client = RpcClient(host, int(port),
-                                     secret=rpc_secret(conf))
+            from tpumr.security import client_credentials
+            secret, scope = client_credentials(conf, "jobtracker")
+            self._client = RpcClient(host, int(port), secret=secret,
+                                     scope=scope)
 
     @property
     def is_local(self) -> bool:
@@ -108,6 +109,15 @@ class JobClient:
         return result
 
 
+#: client-local credentials that must NEVER ride the submitted conf:
+#: the user key is a full-impersonation secret (and job confs land in
+#: history files), and the key/token FILE PATHS are meaningless or
+#: identity-corrupting on worker hosts (a worker resolving the
+#: submitter's credential would sign DFS calls as the wrong principal)
+_CLIENT_CREDENTIAL_KEYS = ("tpumr.rpc.user.key", "tpumr.rpc.user.key.file",
+                           "tpumr.rpc.token.file")
+
+
 def _wire_conf(job_conf: JobConf) -> dict[str, Any]:
     """Serialize the conf for submission; class OBJECTS (test-local classes)
     don't survive the wire — fail fast with a clear message
@@ -118,6 +128,8 @@ def _wire_conf(job_conf: JobConf) -> dict[str, Any]:
             raise ValueError(
                 f"conf key {k!r} holds a class object that is not importable "
                 f"by name; distributed jobs need module-level classes")
+        if k in _CLIENT_CREDENTIAL_KEYS:
+            continue
         out[k] = v
     if not out.get("user.name"):
         # stamp the submitting identity ≈ UGI on JobClient.submitJob —

@@ -84,6 +84,19 @@ class JobMaster:
         self._rpc_secret = rpc_secret(conf)
         self._server = RpcServer(self, host=host, port=port,
                                  secret=self._rpc_secret)
+        # delegation-token liveness (≈ JobTracker's
+        # DelegationTokenSecretManager): issued/renewed/canceled here,
+        # validated by the RPC layer per request
+        from tpumr.security.tokens import TokenStore
+        self.token_store = TokenStore(conf)
+        self._server.token_store = self.token_store
+        #: require cryptographically verified identity (user key or
+        #: delegation token) for ACL-relevant identity claims — with it
+        #: off (default), cluster-secret assertions keep working (the
+        #: flat round-3 trust domain, documented in docs/OPERATIONS.md)
+        self._require_verified = conf.get_boolean(
+            "tpumr.acls.require.verified", False) \
+            if hasattr(conf, "get_boolean") else False
         self._stop = threading.Event()
         self._expire_thread = threading.Thread(
             target=self._expire_loop, name="expire-trackers", daemon=True)
@@ -352,22 +365,43 @@ class JobMaster:
     def get_protocol_version(self) -> int:
         return PROTOCOL_VERSION
 
+    def _acl_caller(self, asserted: str):
+        """UGI for an ACL decision. Order: a cryptographically VERIFIED
+        rpc identity (user key / delegation token) wins outright; else
+        the asserted simple-auth name — unless the cluster demands
+        verified identities (tpumr.acls.require.verified), in which case
+        unverified assertions count as anonymous. A missing identity is
+        always anonymous, never the daemon's own (administrator) user."""
+        from tpumr.ipc.rpc import current_rpc_user, current_rpc_verified
+        from tpumr.security import UserGroupInformation, server_side_ugi
+        if current_rpc_verified():
+            return server_side_ugi(str(current_rpc_user()), self.conf)
+        if self._require_verified and self.queue_manager.acls_enabled:
+            return UserGroupInformation("anonymous", [])
+        if asserted:
+            return server_side_ugi(asserted, self.conf)
+        return UserGroupInformation("anonymous", [])
+
     def submit_job(self, conf_dict: dict, splits: list) -> str:
         # submit-time queue validation + ACL (≈ JobTracker.submitJob →
         # QueueManager.hasAccess(SUBMIT_JOB)): rejected jobs never enter
         # any scheduler queue
+        from tpumr.ipc.rpc import current_rpc_user, current_rpc_verified
         from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
-        from tpumr.security import UserGroupInformation, server_side_ugi
         queue = str(conf_dict.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
                     or DEFAULT_QUEUE)
-        # A submission with NO identity is an anonymous nobody, mirroring
-        # kill_job — never the daemon's own process identity, which is
-        # often in mapred.cluster.administrators and would bypass the
-        # queue submit ACL.
         user = str(conf_dict.get("user.name", "") or "")
-        self.queue_manager.check_submit(
-            queue, server_side_ugi(user, self.conf) if user
-            else UserGroupInformation("anonymous", []))
+        if current_rpc_verified():
+            # the job OWNER is the authenticated caller (the reference
+            # binds owner to the RPC UGI): a verified carol cannot
+            # submit a job owned by alice
+            verified = str(current_rpc_user())
+            if user and user != verified:
+                raise PermissionError(
+                    f"authenticated user {verified!r} cannot submit a "
+                    f"job owned by {user!r}")
+            user = conf_dict["user.name"] = verified
+        self.queue_manager.check_submit(queue, self._acl_caller(user))
         with self.lock:
             self._next_job += 1
             job_id = JobID(self.cluster_id, self._next_job)
@@ -386,6 +420,37 @@ class JobMaster:
         # history write (serializes conf + splits) outside the master lock
         self.history.job_submitted(jip)
         return str(job_id)
+
+    # -------------------------------------------------- RPC: tokens
+
+    def get_delegation_token(self, renewer: str = "") -> dict:
+        """Issue a delegation token for the CALLER's identity
+        (≈ JobTracker.getDelegationToken): a verified user gets their
+        own token; a cluster-secret caller (operator tooling) gets one
+        for its asserted identity. Token-authenticated callers are
+        refused — tokens must not mint successors. The wire dict is the
+        client credential (tpumr.rpc.token.file)."""
+        from tpumr.security.tokens import issue_for_caller
+        wire = issue_for_caller(self.token_store, self._rpc_secret,
+                                renewer)
+        self._mreg.incr("tokens_issued")
+        return wire
+
+    def renew_delegation_token(self, wire: dict) -> float:
+        """≈ renewDelegationToken: owner/renewer extends the tracked
+        expiry by one renew interval (capped at max lifetime)."""
+        from tpumr.ipc.rpc import current_rpc_user
+        from tpumr.security.tokens import verify_wire
+        tok = verify_wire(self._rpc_secret, wire)
+        return self.token_store.renew(tok, str(current_rpc_user() or ""))
+
+    def cancel_delegation_token(self, wire: dict) -> bool:
+        """≈ cancelDelegationToken: kills the token immediately."""
+        from tpumr.ipc.rpc import current_rpc_user
+        from tpumr.security.tokens import verify_wire
+        tok = verify_wire(self._rpc_secret, wire)
+        self.token_store.cancel(tok, str(current_rpc_user() or ""))
+        return True
 
     def list_jobs(self) -> list[str]:
         """All known job ids ≈ JobSubmissionProtocol.jobsToComplete +
@@ -428,14 +493,11 @@ class JobMaster:
         # the daemon's own (usually administrator) identity, which would
         # turn the old 1-arg call signature into an ACL bypass.
         from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
-        from tpumr.security import UserGroupInformation
-        from tpumr.security import server_side_ugi
         queue = str(jip.conf.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
                     or DEFAULT_QUEUE)
         owner = str(jip.conf.get("user.name", ""))
-        ugi = (server_side_ugi(user, self.conf) if user
-               else UserGroupInformation("anonymous", []))
-        self.queue_manager.check_administer(queue, ugi, owner)
+        self.queue_manager.check_administer(queue, self._acl_caller(user),
+                                            owner)
         # kill() no-ops if a concurrent heartbeat already made it terminal
         if not jip.kill():  # ≈ JobTracker.killJob: no-op on finished jobs
             return False
@@ -666,6 +728,7 @@ class JobMaster:
     def _expire_loop(self) -> None:
         while not self._stop.wait(min(1.0, self.expiry_s / 3)):
             now = time.time()
+            self.token_store.purge_expired()
             with self.lock:
                 lost = [n for n, t in self.trackers.items()
                         if now - t.last_seen > self.expiry_s]

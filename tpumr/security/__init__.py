@@ -97,3 +97,19 @@ def rpc_secret(conf: Any) -> "bytes | None":
         with open(path, "rb") as f:
             return f.read().strip()
     return None
+
+
+def client_credentials(conf: Any, service: "str | None" = None) \
+        -> "tuple[bytes | None, str | None]":
+    """(signing_secret, scope) for an RPC client. Personal credentials
+    win over the cluster secret: a user configured with their own key or
+    a delegation token signs as a VERIFIED identity and never needs (or
+    touches) the cluster secret — the trust split the reference draws
+    between service keytabs and user tokens. ``service`` selects the
+    right token from a per-service token file ("jobtracker",
+    "namenode")."""
+    from tpumr.security.tokens import user_signing_credentials
+    personal = user_signing_credentials(conf, service)
+    if personal is not None:
+        return personal
+    return rpc_secret(conf), None
