@@ -472,6 +472,58 @@ def bench_terasort(rows: dict) -> None:
             f"failed: rc={out.returncode}"
 
 
+# ---------------------------------------------------------------- codecs
+
+
+def bench_codecs(rows: dict) -> None:
+    """Shuffle/spill codec cost (VERDICT r3 Next #5): stdlib zlib vs the
+    native tlz codec on the two spill regimes — text-like (wordcount
+    spills) and incompressible (terasort keys). Host-side; runs even
+    when the TPU is down."""
+    import zlib
+    from tpumr.io.compress import TlzCodec
+
+    mb = 8 if SMALL else 48
+    rng = np.random.default_rng(3)
+    words = [f"word{i:04d}".encode() for i in range(4096)]
+    text = b"".join(words[i] + b"\t" + str(i % 100).encode() + b"\n"
+                    for i in rng.integers(0, 4096,
+                                          mb * 1024 * 1024 // 12))
+    text = text[:mb * 1024 * 1024]
+    rand = rng.integers(0, 256, size=mb * 1024 * 1024,
+                        dtype=np.uint8).tobytes()
+
+    def measure(tag: str, data: bytes, comp, decomp) -> None:
+        t0 = time.time()
+        c = comp(data)
+        t1 = time.time()
+        d = decomp(c)
+        t2 = time.time()
+        assert d == data
+        rows[f"codec_{tag}_ratio"] = round(len(c) / len(data), 3)
+        rows[f"codec_{tag}_compress_mb_s"] = round(
+            len(data) / 1e6 / (t1 - t0), 1)
+        rows[f"codec_{tag}_decompress_mb_s"] = round(
+            len(data) / 1e6 / (t2 - t1), 1)
+
+    for kind, data in (("text", text), ("random", rand)):
+        measure(f"zlib1_{kind}", data,
+                lambda d: zlib.compress(d, 1), zlib.decompress)
+        if TlzCodec.available():
+            c = TlzCodec()
+            measure(f"tlz_{kind}", data, c.compress, c.decompress)
+    rows["codec_tlz_native"] = TlzCodec.available()
+    log(f"[codecs] text: zlib1 {rows['codec_zlib1_text_compress_mb_s']}"
+        f" MB/s ratio {rows['codec_zlib1_text_ratio']}"
+        + (f" | tlz {rows.get('codec_tlz_text_compress_mb_s')} MB/s "
+           f"ratio {rows.get('codec_tlz_text_ratio')}"
+           if TlzCodec.available() else " | tlz unavailable")
+        + f"; random: zlib1 "
+          f"{rows['codec_zlib1_random_compress_mb_s']} MB/s"
+        + (f" | tlz {rows.get('codec_tlz_random_compress_mb_s')} MB/s"
+           if TlzCodec.available() else ""))
+
+
 # ---------------------------------------------------------- kernel MFU
 
 
@@ -851,7 +903,8 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             log(f"[bench_kmeans] FAILED: {type(e).__name__}: {e}")
             rows["bench_kmeans"] = f"failed: {e}"
-        fns = [bench_wordcount, bench_pi, bench_matmul, bench_terasort]
+        fns = [bench_wordcount, bench_pi, bench_matmul, bench_terasort,
+               bench_codecs]
         if TPU_OK:
             fns += [bench_kernels, bench_chained, bench_hybrid]
         for fn in fns:

@@ -1,0 +1,121 @@
+/* fuzz_tlz — deterministic fuzz + property checks for the tlz codec.
+ *
+ * A: roundtrip property on generated payloads spanning the codec's
+ *    regimes (repetitive text-like, random, mixed, tiny).
+ * B: decompress of MUTATED valid frames must only ever return -1 or a
+ *    (possibly wrong) payload — never crash/overrun (ASAN enforces).
+ * C: random garbage into tlz_decompress.
+ *
+ * argv: [iterations]
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+uint64_t tlz_bound(uint64_t n);
+int64_t tlz_compress(const uint8_t* src, uint64_t n, uint8_t* dst,
+                     uint64_t cap);
+int64_t tlz_raw_size(const uint8_t* src, uint64_t n);
+int64_t tlz_decompress(const uint8_t* src, uint64_t n, uint8_t* dst,
+                       uint64_t cap);
+
+static uint64_t rng_state;
+
+static uint64_t rnd(void) {
+  uint64_t x = rng_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return rng_state = x;
+}
+
+static uint64_t gen_payload(uint8_t* buf, uint64_t cap) {
+  uint64_t n = rnd() % cap, i, mode = rnd() % 4;
+  if (mode == 0) {                      /* repetitive text-like */
+    const char* words[4] = {"alpha ", "beta ", "gamma7 ", "x"};
+    uint64_t w = 0;
+    while (w < n) {
+      const char* s = words[rnd() % 4];
+      uint64_t l = strlen(s);
+      if (w + l > n) break;
+      memcpy(buf + w, s, l);
+      w += l;
+    }
+    return w;
+  }
+  if (mode == 1) {                      /* pure random */
+    for (i = 0; i < n; i++) buf[i] = (uint8_t)rnd();
+    return n;
+  }
+  if (mode == 2) {                      /* long runs (overlap copies) */
+    uint64_t w = 0;
+    while (w < n) {
+      uint8_t c = (uint8_t)rnd();
+      uint64_t run = 1 + rnd() % 300;
+      for (i = 0; i < run && w < n; i++) buf[w++] = c;
+    }
+    return w;
+  }
+  for (i = 0; i < n; i++)               /* mixed */
+    buf[i] = (rnd() & 1) ? (uint8_t)(rnd() % 4) : (uint8_t)rnd();
+  return n;
+}
+
+int main(int argc, char** argv) {
+  long iters = argc > 1 ? atol(argv[1]) : 800;
+  enum { CAP = 1 << 16 };
+  uint8_t* raw = malloc(CAP);
+  uint8_t* comp = malloc(tlz_bound(CAP));
+  uint8_t* mut = malloc(tlz_bound(CAP));
+  uint8_t* back = malloc(CAP);
+  long it;
+  for (it = 0; it < iters; it++) {
+    uint64_t n;
+    int64_t c, d;
+    rng_state = 0x7152DEAD ^ (uint64_t)it * 0x9E3779B97F4A7C15ull;
+    n = gen_payload(raw, CAP);
+    c = tlz_compress(raw, n, comp, tlz_bound(CAP));
+    if (c < 0) {
+      fprintf(stderr, "FUZZ FAIL: compress returned %lld for %llu\n",
+              (long long)c, (unsigned long long)n);
+      return 1;
+    }
+    if (tlz_raw_size(comp, (uint64_t)c) != (int64_t)n) {
+      fprintf(stderr, "FUZZ FAIL: raw_size mismatch\n");
+      return 1;
+    }
+    d = tlz_decompress(comp, (uint64_t)c, back, CAP);
+    if (d != (int64_t)n || (n && memcmp(raw, back, n) != 0)) {
+      fprintf(stderr, "FUZZ FAIL: roundtrip (%llu -> %lld -> %lld)\n",
+              (unsigned long long)n, (long long)c, (long long)d);
+      return 1;
+    }
+    /* B: mutate the valid frame */
+    {
+      int m;
+      for (m = 0; m < 16; m++) {
+        uint64_t cut = (uint64_t)c ? 1 + rnd() % (uint64_t)c : 0;
+        int f;
+        memcpy(mut, comp, (size_t)c);
+        for (f = 0; f < 4; f++)
+          mut[rnd() % (c ? (uint64_t)c : 1)] = (uint8_t)rnd();
+        tlz_decompress(mut, (uint64_t)c, back, CAP);
+        tlz_decompress(mut, cut, back, CAP);
+      }
+    }
+    /* C: garbage */
+    {
+      uint64_t gn = rnd() % 512, i;
+      for (i = 0; i < gn; i++) comp[i] = (uint8_t)rnd();
+      tlz_decompress(comp, gn, back, CAP);
+    }
+  }
+  printf("fuzz_tlz: %ld iterations clean\n", iters);
+  free(raw);
+  free(comp);
+  free(mut);
+  free(back);
+  return 0;
+}

@@ -1,0 +1,232 @@
+/* tlz — the framework's fast shuffle/spill codec.
+ *
+ * Role of the reference's JNI compression tier (src/native/src/org/
+ * apache/hadoop/io/compress/ — shipped native zlib/snappy because map
+ * output compression sits on the spill/shuffle hot path). Measured here
+ * (bench_details codec rows): Python's zlib tops out ~134 MB/s at
+ * level 1 on text-like spills — below the pipeline's own throughput —
+ * and wastes ~40 MB/s achieving nothing on incompressible data. This
+ * is an ORIGINAL byte-oriented LZ77 implementation (greedy hash-4
+ * matching, 64 KB window, LZ4-class speed target) with its own frame
+ * format; we control both ends of the wire, so no interop format is
+ * needed.
+ *
+ * Frame: 'T' 'L' 'Z' ver, u64 LE raw length, payload.
+ *   ver '0' — stored raw (compressor found the input incompressible:
+ *             memcpy-speed passthrough instead of negative-gain work)
+ *   ver '1' — LZ payload: sequences of
+ *       token byte   (lit_len in high nibble, match_len-4 in low)
+ *       [lit ext]    if lit_len == 15: bytes of 255 + terminator added
+ *       literals
+ *       u16 LE offset (1..65535, match source = out_pos - offset)
+ *       [match ext]  if match_len-4 == 15: same extension coding
+ *     The final sequence may end after its literals (offset omitted)
+ *     exactly when the raw length is reached.
+ *
+ * The decompressor bounds-checks every read and write: corrupt or
+ * hostile frames return -1, never overrun (fuzzed under ASAN/UBSAN by
+ * fuzz_tlz.c like the other native parsers).
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#define TLZ_WINDOW 65535u
+#define TLZ_MIN_MATCH 4u
+#define TLZ_HASH_BITS 15
+#define TLZ_HASH_SIZE (1u << TLZ_HASH_BITS)
+
+uint64_t tlz_bound(uint64_t n) {
+  /* worst case: all literals with extension bytes, plus frame header */
+  return n + n / 255 + 32;
+}
+
+static uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static uint32_t hash4(uint32_t v) {
+  return (v * 2654435761u) >> (32 - TLZ_HASH_BITS);
+}
+
+static void put_u64(uint8_t* p, uint64_t v) {
+  int i;
+  for (i = 0; i < 8; i++) p[i] = (uint8_t)(v >> (8 * i));
+}
+
+static uint64_t get_u64(const uint8_t* p) {
+  uint64_t v = 0;
+  int i;
+  for (i = 7; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+
+/* write the length-extension coding: bytes of 255 then remainder */
+static uint64_t put_ext(uint8_t* dst, uint64_t cap, uint64_t w,
+                        uint64_t v) {
+  while (v >= 255) {
+    if (w >= cap) return (uint64_t)-1;
+    dst[w++] = 255;
+    v -= 255;
+  }
+  if (w >= cap) return (uint64_t)-1;
+  dst[w++] = (uint8_t)v;
+  return w;
+}
+
+static int64_t store_raw(const uint8_t* src, uint64_t n, uint8_t* dst,
+                         uint64_t cap) {
+  if (cap < n + 12) return -1;
+  dst[0] = 'T'; dst[1] = 'L'; dst[2] = 'Z'; dst[3] = '0';
+  put_u64(dst + 4, n);
+  memcpy(dst + 12, src, n);
+  return (int64_t)(n + 12);
+}
+
+int64_t tlz_compress(const uint8_t* src, uint64_t n, uint8_t* dst,
+                     uint64_t cap) {
+  static const uint64_t HDR = 12;
+  uint32_t tab[TLZ_HASH_SIZE];
+  uint64_t w = HDR, pos = 0, lit_start = 0, misses = 0;
+  if (cap < HDR) return -1;
+  if (n < 16) return store_raw(src, n, dst, cap);
+  memset(tab, 0xFF, sizeof tab);
+  while (pos + TLZ_MIN_MATCH <= n) {
+    uint32_t v = read32(src + pos);
+    uint32_t h = hash4(v);
+    uint32_t cand = tab[h];
+    tab[h] = (uint32_t)pos;
+    if (cand != 0xFFFFFFFFu && (uint64_t)cand < pos &&
+        pos - cand <= TLZ_WINDOW && read32(src + cand) == v) {
+      /* extend the match forward */
+      uint64_t mlen = TLZ_MIN_MATCH;
+      uint64_t lit = pos - lit_start;
+      uint64_t mtok, offset = pos - cand;
+      while (pos + mlen < n &&
+             src[cand + mlen] == src[pos + mlen])
+        mlen++;
+      /* token + extensions + literals + offset */
+      mtok = mlen - TLZ_MIN_MATCH;
+      if (w >= cap) return store_raw(src, n, dst, cap);
+      dst[w++] = (uint8_t)(((lit < 15 ? lit : 15) << 4)
+                           | (mtok < 15 ? mtok : 15));
+      if (lit >= 15) {
+        w = put_ext(dst, cap, w, lit - 15);
+        if (w == (uint64_t)-1) return store_raw(src, n, dst, cap);
+      }
+      if (w + lit + 2 > cap) return store_raw(src, n, dst, cap);
+      memcpy(dst + w, src + lit_start, lit);
+      w += lit;
+      dst[w++] = (uint8_t)(offset & 0xFF);
+      dst[w++] = (uint8_t)(offset >> 8);
+      if (mtok >= 15) {
+        w = put_ext(dst, cap, w, mtok - 15);
+        if (w == (uint64_t)-1) return store_raw(src, n, dst, cap);
+      }
+      /* seed the table through the matched region (sparsely: every
+       * other position is plenty for this codec's speed class) */
+      {
+        uint64_t p2 = pos + 1, end = pos + mlen;
+        for (; p2 + TLZ_MIN_MATCH <= end && p2 + 4 <= n; p2 += 2)
+          tab[hash4(read32(src + p2))] = (uint32_t)p2;
+      }
+      pos += mlen;
+      lit_start = pos;
+      misses = 0;
+    } else {
+      /* skip-accelerator: incompressible regions fast-forward so a
+       * random 100 MB spill doesn't crawl through every byte */
+      pos += 1 + (misses >> 6);
+      misses++;
+    }
+  }
+  /* tail literals */
+  {
+    uint64_t lit = n - lit_start;
+    if (w >= cap) return store_raw(src, n, dst, cap);
+    dst[w++] = (uint8_t)((lit < 15 ? lit : 15) << 4);
+    if (lit >= 15) {
+      w = put_ext(dst, cap, w, lit - 15);
+      if (w == (uint64_t)-1) return store_raw(src, n, dst, cap);
+    }
+    if (w + lit > cap) return store_raw(src, n, dst, cap);
+    memcpy(dst + w, src + lit_start, lit);
+    w += lit;
+  }
+  if (w >= n + HDR)  /* no gain: ship stored for memcpy decompression */
+    return store_raw(src, n, dst, cap);
+  dst[0] = 'T'; dst[1] = 'L'; dst[2] = 'Z'; dst[3] = '1';
+  put_u64(dst + 4, n);
+  return (int64_t)w;
+}
+
+int64_t tlz_raw_size(const uint8_t* src, uint64_t n) {
+  if (n < 12 || src[0] != 'T' || src[1] != 'L' || src[2] != 'Z')
+    return -1;
+  if (src[3] != '0' && src[3] != '1') return -1;
+  return (int64_t)get_u64(src + 4);
+}
+
+/* read one extended length; returns updated r or -1 on overrun */
+static uint64_t get_ext(const uint8_t* src, uint64_t n, uint64_t r,
+                        uint64_t* v) {
+  for (;;) {
+    uint8_t b;
+    if (r >= n) return (uint64_t)-1;
+    b = src[r++];
+    *v += b;
+    if (b != 255) return r;
+  }
+}
+
+int64_t tlz_decompress(const uint8_t* src, uint64_t n, uint8_t* dst,
+                       uint64_t cap) {
+  uint64_t raw, r = 12, w = 0;
+  int64_t hdr = tlz_raw_size(src, n);
+  if (hdr < 0) return -1;
+  raw = (uint64_t)hdr;
+  if (raw > cap) return -1;
+  if (src[3] == '0') {
+    if (n - 12 != raw) return -1;
+    memcpy(dst, src + 12, raw);
+    return (int64_t)raw;
+  }
+  while (w < raw) {
+    uint64_t lit, mlen, offset;
+    uint8_t token;
+    if (r >= n) return -1;
+    token = src[r++];
+    lit = token >> 4;
+    if (lit == 15) {
+      r = get_ext(src, n, r, &lit);
+      if (r == (uint64_t)-1) return -1;
+    }
+    if (lit > n - r || lit > raw - w) return -1;
+    memcpy(dst + w, src + r, lit);
+    r += lit;
+    w += lit;
+    if (w == raw) break;          /* final literal-only sequence */
+    mlen = (uint64_t)(token & 0xF);
+    if (r + 2 > n) return -1;
+    offset = (uint64_t)src[r] | ((uint64_t)src[r + 1] << 8);
+    r += 2;
+    if (mlen == 15) {
+      r = get_ext(src, n, r, &mlen);
+      if (r == (uint64_t)-1) return -1;
+    }
+    mlen += TLZ_MIN_MATCH;
+    if (offset == 0 || offset > w || mlen > raw - w) return -1;
+    /* overlapping copy must run forward byte-wise (offset < mlen
+     * replicates the window — the classic LZ run encoding) */
+    {
+      const uint8_t* from = dst + (w - offset);
+      uint64_t i;
+      for (i = 0; i < mlen; i++) dst[w + i] = from[i];
+    }
+    w += mlen;
+  }
+  if (w != raw) return -1;
+  return (int64_t)raw;
+}

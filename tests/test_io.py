@@ -77,11 +77,64 @@ def test_densebatch():
     assert cat.ids.tolist() == [0, 1, 5]
 
 
-@pytest.mark.parametrize("codec", ["none", "zlib", "gzip", "bzip2", "lzma"])
+@pytest.mark.parametrize("codec", ["none", "zlib", "gzip", "bzip2",
+                                   "lzma", "tlz"])
 def test_codec_roundtrip(codec):
     c = get_codec(codec)
     data = b"some repetitive data " * 100
     assert c.decompress(c.compress(data)) == data
+
+
+class TestTlzCodec:
+    """Native fast shuffle/spill codec (native/tlz ≈ the reference's
+    JNI compression tier) — native and pure-Python ends must agree on
+    the frame format in every combination."""
+
+    PAYLOADS = [b"", b"x", b"abc" * 5000, bytes(range(256)) * 300,
+                b"aaaaaaaaab" * 1 + b"Z" * 100 + b"aaaaaaaaab" * 40]
+
+    def test_native_and_python_interop(self):
+        import os
+        from tpumr.io.compress import TlzCodec
+        c = TlzCodec()
+        rnd = os.urandom(50_000)              # stored-mode path
+        for data in self.PAYLOADS + [rnd]:
+            native = c.compress(data)
+            if TlzCodec.available():
+                # python reader decodes native frames
+                assert TlzCodec._py_decompress(native) == data
+            assert c.decompress(native) == data
+            # python stored frames decode natively
+            stored = TlzCodec._py_store(data)
+            assert c.decompress(stored) == data
+
+    def test_corrupt_frames_raise(self):
+        import struct
+        from tpumr.io.compress import TlzCodec
+        c = TlzCodec()
+        frame = bytearray(c.compress(b"abcabcabc" * 1000))
+        with pytest.raises(ValueError):
+            c.decompress(b"NOPE" + bytes(frame[4:]))
+        with pytest.raises(ValueError):
+            c.decompress(bytes(frame[: len(frame) // 2]))
+        with pytest.raises(ValueError):
+            TlzCodec._py_decompress(bytes(frame[: len(frame) // 2]))
+        # a bit-flipped LENGTH header must raise, never size a huge
+        # allocation off untrusted bytes
+        bomb = bytes(frame[:4]) + struct.pack("<Q", 1 << 60) \
+            + bytes(frame[12:])
+        with pytest.raises(ValueError, match="implausible|corrupt"):
+            c.decompress(bomb)
+
+    def test_compresses_text_class_data(self):
+        from tpumr.io.compress import TlzCodec
+        if not TlzCodec.available():
+            pytest.skip("no C toolchain")
+        c = TlzCodec()
+        data = b"word0001\t17\nword0002\t3\n" * 20000
+        out = c.compress(data)
+        assert len(out) < len(data) // 2      # real compression
+        assert c.decompress(out) == data
 
 
 def test_codec_for_path():

@@ -343,6 +343,10 @@ class TestSanitizers:
         self.run_fuzz(os.path.join(b, "fuzz_tokencount"), "800",
                       os.path.join(self.CORPUS, "text"))
 
+    def test_tlz_fuzz_asan(self):
+        b = self.build_fuzz(os.path.join(REPO, "native", "tlz"))
+        self.run_fuzz(os.path.join(b, "fuzz_tlz"), "1200")
+
     def test_pipes_stream_fuzz_asan(self):
         if shutil.which("g++") is None:
             pytest.skip("no C++ toolchain")

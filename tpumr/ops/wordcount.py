@@ -41,58 +41,21 @@ from tpumr.ops.registry import KernelMapper, register_kernel
 _WS_TABLE = np.zeros(256, dtype=bool)
 _WS_TABLE[[9, 10, 11, 12, 13, 32]] = True
 
-import threading as _threading
-
-_NATIVE = None          # loaded libtokencount, or False after a miss
-_NATIVE_LOCK = _threading.Lock()
-
-
 def _native_lib():
-    """The native single-pass tokenizer (native/textkit), built by its
-    Makefile like the other native tiers; None when unavailable —
-    callers fall back to the numpy path. The lazy build is serialized
-    against BOTH concurrent threads (module lock) and concurrent
-    processes (flock on a build lockfile): cc links the .so in place,
-    so an unserialized reader could dlopen a truncated artifact and
-    silently pin the process to the numpy fallback."""
-    global _NATIVE
-    if _NATIVE is not None:
-        return _NATIVE or None
-    with _NATIVE_LOCK:
-        if _NATIVE is not None:
-            return _NATIVE or None
+    """The native single-pass tokenizer (native/textkit), lazily built
+    and loaded through the shared loader (tpumr.utils.nativelib — same
+    thread/process build serialization as the tlz codec); None when
+    unavailable, callers fall back to the numpy path."""
+
+    def configure(lib):
         import ctypes
-        import os
-        kit = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))),
-            "native", "textkit")
-        so = os.path.join(kit, "build", "libtokencount.so")
-        if not os.path.exists(so):
-            import fcntl
-            import subprocess
-            try:   # best-effort lazy build (gcc is in the base image)
-                with open(os.path.join(kit, ".build.lock"), "w") as lf:
-                    fcntl.flock(lf, fcntl.LOCK_EX)
-                    if not os.path.exists(so):   # lost the build race?
-                        r = subprocess.run(["make"], cwd=kit,
-                                           capture_output=True,
-                                           timeout=60)
-                        if r.returncode != 0:
-                            _NATIVE = False
-                            return None
-            except Exception:  # noqa: BLE001
-                _NATIVE = False
-                return None
-        try:
-            lib = ctypes.CDLL(so)
-            lib.tc_count.restype = ctypes.POINTER(ctypes.c_char)
-            lib.tc_count.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                     ctypes.POINTER(ctypes.c_uint64)]
-            lib.tc_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
-            _NATIVE = lib
-        except OSError:
-            _NATIVE = False
-    return _NATIVE or None
+        lib.tc_count.restype = ctypes.POINTER(ctypes.c_char)
+        lib.tc_count.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.POINTER(ctypes.c_uint64)]
+        lib.tc_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+
+    from tpumr.utils.nativelib import load_native_lib
+    return load_native_lib("textkit", "libtokencount.so", configure)
 
 
 def tokenize_count_native(data) -> "Iterator[tuple[bytes, int]] | None":

@@ -1,0 +1,65 @@
+"""Lazy loader for the repo's native tiers (native/<kit>/build/<so>).
+
+One pattern, used by the textkit tokenizer and the tlz codec: build on
+first use via the kit's Makefile, serialized against concurrent THREADS
+(per-kit lock) and concurrent PROCESSES (flock on a build lockfile —
+cc links the .so in place, so an unserialized reader could dlopen a
+truncated artifact and silently pin the process to its fallback path).
+Returns None when the toolchain is unavailable; callers fall back.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+_libs: dict[str, Any] = {}      # kit -> CDLL | False (permanent miss)
+_lock = threading.Lock()
+
+
+def repo_native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def load_native_lib(kit: str, so_name: str,
+                    configure: "Callable[[Any], None] | None" = None):
+    """CDLL for ``native/<kit>/build/<so_name>``, building it on first
+    use; ``configure`` sets restype/argtypes exactly once."""
+    cached = _libs.get(kit)
+    if cached is not None:
+        return cached or None
+    with _lock:
+        cached = _libs.get(kit)
+        if cached is not None:
+            return cached or None
+        import ctypes
+        kit_dir = os.path.join(repo_native_dir(), kit)
+        so = os.path.join(kit_dir, "build", so_name)
+        if not os.path.exists(so):
+            import fcntl
+            import subprocess
+            try:
+                with open(os.path.join(kit_dir, ".build.lock"),
+                          "w") as lf:
+                    fcntl.flock(lf, fcntl.LOCK_EX)
+                    if not os.path.exists(so):  # lost the build race?
+                        r = subprocess.run(["make"], cwd=kit_dir,
+                                           capture_output=True,
+                                           timeout=60)
+                        if r.returncode != 0:
+                            _libs[kit] = False
+                            return None
+            except Exception:  # noqa: BLE001 — no toolchain/locked FS
+                _libs[kit] = False
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+            if configure is not None:
+                configure(lib)
+            _libs[kit] = lib
+        except OSError:
+            _libs[kit] = False
+            return None
+    return _libs[kit] or None
