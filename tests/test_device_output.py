@@ -39,6 +39,48 @@ class TestFingerprint:
         assert head == b"abc" and tail == b"" and size == 3
 
 
+class TestAliasRejection:
+    def test_boundary_alias_never_serves_wrong_rows(self, tmp_path):
+        """Two files with identical size, mtime, and 8 KB boundary
+        windows but a DIFFERENT middle: the published image must serve
+        only the real one — lookup's first-hit full-sha verification is
+        the correctness story, not the probabilistic fingerprint."""
+        import hashlib
+        import os
+        import jax
+        import jax.numpy as jnp
+        from tpumr.fs import get_filesystem
+        from tpumr.mapred.jobconf import JobConf
+
+        conf = JobConf()
+        fs = get_filesystem(f"file://{tmp_path}")
+        real = bytearray(os.urandom(32 * 1024))
+        alias = bytearray(real)
+        alias[16_000:16_016] = b"DIFFERENTPAYLOAD"   # middle-only change
+        pr, pa = tmp_path / "real.bin", tmp_path / "alias.bin"
+        pr.write_bytes(real)
+        pa.write_bytes(alias)
+        mtime = pr.stat().st_mtime
+        os.utime(pa, (mtime, mtime))                 # same mtime
+
+        rows = jax.device_put(jnp.arange(8.0).reshape(2, 4))
+        head, tail, size = device_output.head_tail(bytes(real))
+        device_output.publish(
+            conf, rows, head, tail, size, mtime,
+            full_sha=hashlib.sha1(bytes(real)).hexdigest())
+        dev = jax.devices()[0]
+        # identical fingerprints by construction
+        ha, ta, sa = device_output.head_tail(bytes(alias))
+        assert device_output.fingerprint(ha, ta, sa, mtime) == \
+            device_output.fingerprint(head, tail, size, mtime)
+        # alias rejected; the real file verifies and serves
+        assert device_output.lookup(conf, dev, fs, f"file://{pa}",
+                                    size, mtime) is None
+        got = device_output.lookup(conf, dev, fs, f"file://{pr}",
+                                   size, mtime)
+        assert got is not None and got.shape == (2, 4)
+
+
 class TestOfferClaim:
     def test_roundtrip_and_cap(self):
         device_output.offer("a1", "rows1")

@@ -25,6 +25,10 @@ class TaskCounter:
     REDUCE_INPUT_RECORDS = "REDUCE_INPUT_RECORDS"
     REDUCE_OUTPUT_RECORDS = "REDUCE_OUTPUT_RECORDS"
     REDUCE_SHUFFLE_BYTES = "REDUCE_SHUFFLE_BYTES"
+    #: copier segment placement (ShuffleRamManager budget outcome):
+    #: how many map outputs merged straight from RAM vs spilled local
+    REDUCE_SHUFFLE_SEGMENTS_MEM = "REDUCE_SHUFFLE_SEGMENTS_MEM"
+    REDUCE_SHUFFLE_SEGMENTS_DISK = "REDUCE_SHUFFLE_SEGMENTS_DISK"
     SPILLED_RECORDS = "SPILLED_RECORDS"
     FRAMEWORK_GROUP = "tpumr.TaskCounter"
 

@@ -64,14 +64,14 @@ def claim(attempt_id: str) -> Any:
 
 def fingerprint(head: bytes, tail: bytes, size: int,
                 mtime: float) -> str:
-    """Identity of one written file: size + mtime + head/tail windows.
-    mtime disambiguates re-runs whose output happens to share size and
-    boundary bytes (rename preserves mtime, so commit promotion keeps
-    the key valid); head/tail windows disambiguate same-mtime different
-    content. Aliasing would need same size AND same mtime AND same 8 KB
-    of boundary bytes with a different middle — and the worst case is a
-    wrong INPUT for one job run, so the windows + mtime together are
-    the correctness story, stated here deliberately."""
+    """Cache-key identity of one written file: size + mtime + head/tail
+    windows. mtime disambiguates re-runs whose output happens to share
+    size and boundary bytes (rename preserves mtime, so commit
+    promotion keeps the key valid); head/tail windows disambiguate
+    same-mtime different content. The fingerprint only SELECTS the
+    candidate — correctness comes from :func:`lookup` verifying the
+    publisher's full-content sha1 on the first hit, so a boundary-alias
+    file can never serve wrong data."""
     h = hashlib.sha1()
     h.update(str(size).encode())
     h.update(repr(mtime).encode())
@@ -86,11 +86,24 @@ def _cache(conf: Any, device: Any):
     return split_cache(device, cache_mb * 1024 * 1024)
 
 
+#: (path, size, mtime, fp) identities whose FULL content has been
+#: verified against the published sha — later hits on the same on-disk
+#: identity skip the verification read. _verify_locks serializes the
+#: first hit per identity so parallel map tasks of one chained job
+#: don't each hash the same multi-GB file.
+_verified: set = set()
+_verify_locks: dict = {}
+
+
 def publish(conf: Any, rows: Any, file_bytes_head: bytes,
-            file_bytes_tail: bytes, size: int, mtime: float) -> None:
+            file_bytes_tail: bytes, size: int, mtime: float,
+            full_sha: "str | None" = None) -> None:
     """Register a device row-matrix as resident image of a just-written
     file (writer side — fingerprint from the in-memory bytes + the
-    written file's stat mtime, which the commit rename preserves)."""
+    written file's stat mtime, which the commit rename preserves).
+    ``full_sha`` is the sha1 of the COMPLETE file bytes — the writer
+    holds them all — so the consumer's first hit can verify the match
+    beyond the boundary windows."""
     global _published_any
     try:
         devs = list(rows.devices())
@@ -98,7 +111,8 @@ def publish(conf: Any, rows: Any, file_bytes_head: bytes,
         return
     key = ("devout", fingerprint(file_bytes_head, file_bytes_tail, size,
                                  mtime))
-    _cache(conf, devs[0]).put(key, rows, int(rows.nbytes))
+    _cache(conf, devs[0]).put(key, {"rows": rows, "sha": full_sha},
+                              int(rows.nbytes))
     _published_any = True
 
 
@@ -106,7 +120,12 @@ def lookup(conf: Any, device: Any, fs: Any, path: str, size: int,
            mtime: float):
     """The whole-file resident array for ``path``, or None. Costs one
     8 KB read to fingerprint the file — and nothing at all until some
-    job in this process has actually published an output."""
+    job in this process has actually published an output. The FIRST hit
+    per on-disk identity additionally reads the whole file and checks
+    the publisher's full-content sha1: a local sequential read is far
+    cheaper than the tunnel upload being skipped, and it closes the
+    boundary-window aliasing hole (same size+mtime+8 KB edges, different
+    middle) that probabilistic fingerprints leave open."""
     if not _published_any:
         return None
     if not conf.get_boolean("tpumr.tpu.output.cache", True):
@@ -121,8 +140,37 @@ def lookup(conf: Any, device: Any, fs: Any, path: str, size: int,
                 tail = b""
     except OSError:
         return None
-    key = ("devout", fingerprint(head, tail, size, mtime))
-    return _cache(conf, device).get(key)
+    fp = fingerprint(head, tail, size, mtime)
+    key = ("devout", fp)
+    cache = _cache(conf, device)
+    entry = cache.get(key)
+    if entry is None:
+        return None
+    sha = entry.get("sha")
+    ident = (path, size, mtime, fp)
+    if sha is not None and ident not in _verified:
+        with _lock:
+            vlock = _verify_locks.setdefault(ident, threading.Lock())
+        with vlock:
+            if ident not in _verified:   # first arrival verifies; the
+                try:                     # rest wait and reuse the result
+                    h = hashlib.sha1()
+                    with fs.open(path) as f:
+                        while True:
+                            chunk = f.read(1 << 20)
+                            if not chunk:
+                                break
+                            h.update(chunk)
+                except OSError:
+                    return None
+                if h.hexdigest() != sha:
+                    return None          # alias: fall back to real read
+                with _lock:
+                    if len(_verified) > 4096:
+                        _verified.clear()
+                        _verify_locks.clear()
+                    _verified.add(ident)
+    return entry["rows"]
 
 
 def head_tail(data: bytes) -> "tuple[bytes, bytes, int]":

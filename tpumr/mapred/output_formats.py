@@ -154,8 +154,10 @@ class _DenseNpyWriter(RecordWriter):
                 mtime = self._fs.get_status(self._path).mtime
             except OSError:
                 return
+            import hashlib
             device_output.publish(self._conf, rows, head, tail, size,
-                                  mtime)
+                                  mtime,
+                                  full_sha=hashlib.sha1(data).hexdigest())
 
 
 class DenseNpyOutputFormat(OutputFormat):

@@ -304,6 +304,11 @@ class ShuffleCopier:
                     self.reporter.incr_counter(
                         TaskCounter.FRAMEWORK_GROUP,
                         TaskCounter.REDUCE_SHUFFLE_BYTES, seg.raw_length)
+                    self.reporter.incr_counter(
+                        TaskCounter.FRAMEWORK_GROUP,
+                        TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK
+                        if isinstance(seg, DiskSegment)
+                        else TaskCounter.REDUCE_SHUFFLE_SEGMENTS_MEM, 1)
                     self.reporter.progress(done[0] / self.num_maps)
 
         n = min(self.parallel, max(1, self.num_maps))

@@ -57,22 +57,25 @@ class HbmSplitCache:
 
     def get(self, key: tuple) -> Any | None:
         with self._lock:
-            val = self._entries.get(key)
-            if val is not None:
+            entry = self._entries.get(key)
+            if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-            else:
-                self.misses += 1
-            return val
+                return entry[0]
+            self.misses += 1
+            return None
 
     def put(self, key: tuple, value: Any, nbytes: int) -> None:
         with self._lock:
             if key in self._entries or nbytes > self.capacity:
                 return  # oversized items never evict resident ones
             while self._bytes + nbytes > self.capacity and self._entries:
-                _, (old, _ids, _meta) = self._entries.popitem(last=False)
-                self._bytes -= int(old.nbytes)
-            self._entries[key] = value
+                # entries carry their CHARGED size: eviction accounting
+                # must not depend on any particular value shape (split
+                # tuples and device-output dicts share this cache)
+                _, (_old, old_bytes) = self._entries.popitem(last=False)
+                self._bytes -= old_bytes
+            self._entries[key] = (value, nbytes)
             self._bytes += nbytes
 
     def clear(self) -> None:
