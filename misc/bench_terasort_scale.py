@@ -28,8 +28,17 @@ def main() -> int:
     reduces = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     #: reuse an existing teragen dir (skip the 3-min gen) and/or raise
     #: the copier RAM budget: TERASORT_GEN_DIR=..., TERASORT_RAM_MB=...
+    #: TERASORT_DEVICE=1 runs the dense/gang-reduce shuffle instead of
+    #: the per-record host path (vectorized end-to-end; sorts on
+    #: whatever backend JAX has — pin TPUMR_JAX_PLATFORM=cpu for the
+    #: host-dense row)
     gen_dir = os.environ.get("TERASORT_GEN_DIR")
     ram_mb = float(os.environ.get("TERASORT_RAM_MB", 0) or 0)
+    device = os.environ.get("TERASORT_DEVICE") == "1"
+    plat = os.environ.get("TPUMR_JAX_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
 
     from tpumr.cli import main as cli_main
     from tpumr.core.counters import TaskCounter
@@ -58,7 +67,9 @@ def main() -> int:
     with MiniMRCluster(num_trackers=2, cpu_slots=2, tpu_slots=0,
                        conf=base) as c:
         conf = c.create_job_conf()
-        ts = make_terasort_conf(gen_uri, f"file://{work}/out", reduces)
+        ts = make_terasort_conf(gen_uri, f"file://{work}/out", reduces,
+                                device_shuffle=device)
+        rows["device_shuffle"] = device
         for k, v in ts:
             conf.set(k, v)
         # production shuffle config: tlz-compressed map outputs through
