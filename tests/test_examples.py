@@ -119,3 +119,39 @@ class TestSleep:
     def test_sleep_runs(self):
         assert cli_main(["examples", "sleep", "-m", "2", "-r", "1",
                          "--map-ms", "1", "--reduce-ms", "1"]) == 0
+
+
+class TestVectorizedValidate:
+    def test_batch_order_check_matches_per_record(self):
+        """map_record_batch must reproduce exact Python-bytes ordering —
+        including prefix keys, trailing-NUL keys, and embedded NULs
+        (the cases padded comparisons classically get wrong)."""
+        from tpumr.examples.terasort import TeraValidateMapper
+        from tpumr.io.recordbatch import RecordBatch
+        from tpumr.mapred.api import OutputCollector
+        from tpumr.mapred.jobconf import JobConf
+
+        cases = [
+            [b"a", b"ab", b"b"],                        # sorted, prefixes
+            [b"ab", b"a"],                              # prefix inversion
+            [b"ab", b"ab\x00"],                         # trailing NUL asc
+            [b"ab\x00", b"ab"],                         # trailing NUL inv
+            [b"a\x00b", b"a\x00a"],                     # embedded NUL inv
+            [b"a\x00a", b"a\x00b"],                     # embedded NUL asc
+            [b"x" * 10, b"x" * 9 + b"y", b"z"],         # fixed width
+            [b"k", b"k", b"k"],                         # all equal
+            [b"", b"", b""],                            # all empty keys
+        ]
+        for keys in cases:
+            expect = sum(1 for i in range(1, len(keys))
+                         if keys[i] < keys[i - 1])
+            batch = RecordBatch.from_pairs([(k, b"v") for k in keys])
+            m = TeraValidateMapper()
+            m.configure(JobConf())
+            got = []
+            m.map_record_batch(batch, OutputCollector(
+                lambda k, v: got.append((k, v))), None)
+            m.close()
+            ordinal, (first, last, errors) = got[0]
+            assert errors == expect, (keys, errors, expect)
+            assert first == keys[0] and last == keys[-1]

@@ -237,7 +237,16 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
         runner_cls = _cpu_runner_class(conf)
         backend_tasks, backend_ms = (BackendCounter.CPU_MAP_TASKS,
                                      BackendCounter.CPU_MAP_MILLIS)
-    runner = new_instance(runner_cls, conf)
+
+    def run_mapper(collector: Any) -> None:
+        """Batch fast path when eligible, else the per-record runner —
+        built HERE so a vectorized split never constructs (and
+        configures) a throwaway runner+mapper pair."""
+        if task.run_on_tpu or not _host_batch_fast_path(
+                conf, in_fmt, split, collector, reporter):
+            runner = new_instance(runner_cls, conf)
+            reader = _counted_reader(in_fmt, split, conf, reporter)
+            runner.run(reader, collector, reporter, task_ctx=task)
 
     if task.num_reduces == 0:
         from tpumr.mapred.output_formats import FileOutputCommitter
@@ -248,9 +257,8 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
         writer = out_fmt.get_record_writer(conf, wd, task.partition)
         collector = OutputCollector(
             writer.write, getattr(writer, "write_fixed_rows", None))
-        reader = _counted_reader(in_fmt, split, conf, reporter)
         try:
-            runner.run(reader, collector, reporter, task_ctx=task)
+            run_mapper(collector)
         finally:
             writer.close()
         reporter.incr_counter(BackendCounter.GROUP, backend_tasks)
@@ -282,14 +290,38 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
             return out
     else:
         buffer = MapOutputBuffer(conf, task.num_reduces, local_dir, reporter)
-    collector = OutputCollector(buffer.collect)
-    reader = _counted_reader(in_fmt, split, conf, reporter)
-    runner.run(reader, collector, reporter, task_ctx=task)
+    run_mapper(OutputCollector(buffer.collect))
     out = buffer.flush()
     reporter.incr_counter(BackendCounter.GROUP, backend_tasks)
     reporter.incr_counter(BackendCounter.GROUP, backend_ms,
                           int((time.time() - t0) * 1000))
     return out
+
+
+def _declared_mapper_class(conf: Any, attr: str):
+    """The job's mapper class iff the class ITSELF declares ``attr``
+    truthy (inherited flags don't count: a subclass overriding map()
+    without re-declaring must not have its map() silently bypassed)."""
+    mapper_cls = conf.get_class("mapred.mapper.class")
+    if mapper_cls is not None and mapper_cls.__dict__.get(attr):
+        return mapper_cls
+    return None
+
+
+def _read_batch_for_fast_path(conf: Any, in_fmt: Any, split: Any):
+    """One RecordBatch for a vectorized map fast path, or None when the
+    input shape is ineligible (no batch reader; dense splits have no
+    byte keys to pass through). Shared gate for the identity-dense and
+    host-batch-mapper paths so their eligibility can't drift apart."""
+    if split is None or getattr(in_fmt, "read_batch", None) is None:
+        return None
+    from tpumr.mapred.split import DenseSplit
+    if isinstance(split, DenseSplit):
+        return None
+    batch = in_fmt.read_batch(split, conf)
+    if not hasattr(batch, "padded_keys"):
+        return None  # DenseBatch-shaped input: no byte keys
+    return batch
 
 
 def _identity_dense_fast_path(conf: Any, in_fmt: Any, split: Any,
@@ -303,24 +335,14 @@ def _identity_dense_fast_path(conf: Any, in_fmt: Any, split: Any,
     widths that don't match the declared fixed layout (the width check
     needs the read, so THAT fallback re-reads the split — acceptable:
     it only happens on misconfigured fixed-width declarations)."""
-    mapper_cls = conf.get_class("mapred.mapper.class")
-    # the class ITSELF must declare identity_map (inherited flags don't
-    # count: a subclass overriding map() without re-declaring must not
-    # have its map() silently bypassed)
-    if mapper_cls is None or \
-            not mapper_cls.__dict__.get("identity_map", False):
+    if _declared_mapper_class(conf, "identity_map") is None:
         return False
-    if split is None or getattr(in_fmt, "read_batch", None) is None:
+    batch = _read_batch_for_fast_path(conf, in_fmt, split)
+    if batch is None:
         return False
-    from tpumr.mapred.split import DenseSplit
-    if isinstance(split, DenseSplit):
-        return False  # dense input has no byte keys to pass through
-    batch = in_fmt.read_batch(split, conf)
     n = batch.num_records
     if n == 0:
         return True
-    if not hasattr(batch, "padded_keys"):
-        return False  # DenseBatch input: no byte keys to pass through
     klens = batch.key_offsets[1:] - batch.key_offsets[:-1]
     vlens = batch.value_offsets[1:] - batch.value_offsets[:-1]
     if not ((klens == buffer.klen).all() and (vlens == buffer.vlen).all()):
@@ -330,6 +352,30 @@ def _identity_dense_fast_path(conf: Any, in_fmt: Any, split: Any,
     buffer.collect_fixed_batch(keys, values)
     reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                           TaskCounter.MAP_INPUT_RECORDS, n)
+    return True
+
+
+def _host_batch_fast_path(conf: Any, in_fmt: Any, split: Any,
+                          collector: Any, reporter: Reporter) -> bool:
+    """Host-vectorized mapper seam: a mapper class that declares
+    ``map_record_batch(batch, output, reporter)`` processes the whole
+    split as ONE RecordBatch instead of the per-record reader→map loop
+    (the host twin of a kernel's ``map_batch_cpu`` — example:
+    TeraValidateMapper's consecutive-key order check)."""
+    mapper_cls = _declared_mapper_class(conf, "map_record_batch")
+    if mapper_cls is None:
+        return False
+    batch = _read_batch_for_fast_path(conf, in_fmt, split)
+    if batch is None:
+        return False
+    # new_instance already ran configure(conf) — JobConfigurable seam
+    mapper = new_instance(mapper_cls, conf)
+    try:
+        mapper.map_record_batch(batch, collector, reporter)
+    finally:
+        mapper.close()
+    reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                          TaskCounter.MAP_INPUT_RECORDS, batch.num_records)
     return True
 
 
