@@ -427,6 +427,47 @@ class TestClientCredentialPlumbing:
         assert "tpumr.rpc.token.file" not in wire
         assert wire["mapred.job.name"] == "j"
 
+    def test_keys_cli_token_lifecycle(self, master, tmp_path):
+        """tpumr keys token/renew/cancel against a live master, driving
+        the whole provisioning loop through the CLI surface."""
+        import io
+        from contextlib import redirect_stdout
+        from tpumr.cli import main as cli_main
+        from tpumr.security import UserGroupInformation
+
+        host, port = master.address
+        keyfile = tmp_path / "carol.key"
+        keyfile.write_text(derive_user_key(SECRET, "carol").hex())
+        credfile = tmp_path / "creds.json"
+        base = ["-D", f"mapred.job.tracker={host}:{port}",
+                "-D", f"tpumr.rpc.user.key.file={keyfile}",
+                "-D", "user.name=carol"]
+        with UserGroupInformation("carol", []).do_as():
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert cli_main([*base, "keys", "token",
+                                 "-renewer", "carol",
+                                 "-out", str(credfile)]) == 0
+            assert "jobtracker token written" in buf.getvalue()
+            data = json.loads(credfile.read_text())
+            assert "jobtracker" in data
+            tok = DelegationToken.from_wire(data["jobtracker"])
+            assert tok.owner == "carol"
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert cli_main([*base, "keys", "renew",
+                                 str(credfile)]) == 0
+            assert "renewed until" in buf.getvalue()
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert cli_main([*base, "keys", "cancel",
+                                 str(credfile)]) == 0
+            assert "canceled" in buf.getvalue()
+            # the canceled token no longer authenticates
+            c = rpc(master, tok.password, scope=tok.scope())
+            with pytest.raises(RpcAuthError):
+                submit(c)
+
     def test_token_file_credentials(self, tmp_path):
         store = TokenStore()
         tok = store.issue(SECRET, "carol")
