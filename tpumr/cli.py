@@ -47,6 +47,8 @@ Clients:
   rumen HISTORY_DIR    extract job traces from history
   failmon -collect|-merge   node failure monitoring (collect/upload/merge)
   gridmix [--scale S]  synthetic mixed-workload benchmark
+  keys SUBCMD          credentials: user-key USER | token [-nn] [-renewer R]
+                       [-out FILE] | renew FILE | cancel FILE
   version              print the version
 """
 
