@@ -94,8 +94,10 @@ def main() -> int:
             TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK)
 
     t0 = time.time()
-    assert cli_main(["examples", "teravalidate", f"file://{work}/out",
-                     f"file://{work}/validate"]) == 0
+    import contextlib
+    with contextlib.redirect_stdout(sys.stderr):   # keep stdout pure JSON
+        assert cli_main(["examples", "teravalidate", f"file://{work}/out",
+                         f"file://{work}/validate"]) == 0
     rows["teravalidate_s"] = round(time.time() - t0, 1)
     rows["mb_per_s"] = round(records * 100 / 1e6 / rows["terasort_s"], 1)
     print(json.dumps(rows))
