@@ -155,6 +155,60 @@ class TestMasterEnforcement:
         finally:
             m.stop()
 
+    def test_job_level_view_and_modify_acls(self):
+        """≈ JobACLsManager: with ACLs on, mapreduce.job.acl-view-job /
+        acl-modify-job grant per-job access beyond owner/queue-admin;
+        unlisted users are denied VIEW (the reference's closed default),
+        and a job-modify grantee may kill without queue rights."""
+        from tpumr.ipc.rpc import RpcClient, RpcError
+        from tpumr.security.tokens import derive_user_key
+        from tpumr.security import UserGroupInformation
+        secret = b"acl-test-secret"
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", secret.decode())
+        conf.set("mapred.acls.enabled", True)
+        conf.set("mapred.queue.names", "prod")
+        conf.set("mapred.queue.prod.acl-submit-job", "*")
+        conf.set("mapred.queue.prod.acl-administer-jobs", " ops")
+        m = JobMaster(conf).start()
+        try:
+            host, port = m.address
+
+            def client(user):
+                key = derive_user_key(secret, user)
+                return RpcClient(host, port, secret=key,
+                                 scope=f"user:{user}")
+
+            with UserGroupInformation("alice", []).do_as():
+                jid = client("alice").call(
+                    "submit_job",
+                    {"mapred.job.queue.name": "prod",
+                     "user.name": "alice", "mapred.reduce.tasks": 0,
+                     "mapreduce.job.acl-view-job": "viewer",
+                     "mapreduce.job.acl-modify-job": "killer"},
+                    [{"locations": []}])
+            # owner views; the view-ACL grantee views; a stranger can't
+            assert client("alice").call("get_job_status", jid)
+            assert client("viewer").call("get_job_status", jid)
+            assert client("viewer").call("get_counters", jid) is not None
+            with pytest.raises(RpcError, match="cannot view"):
+                client("mallory").call("get_job_status", jid)
+            with pytest.raises(RpcError, match="cannot view"):
+                client("mallory").call("get_job_conf", jid)
+            # view does not grant modify; the modify grantee may kill
+            with pytest.raises(RpcError, match="cannot administer"):
+                client("viewer").call("kill_job", jid, "viewer")
+            # the infrastructure tier (cluster-secret daemons: trackers
+            # localizing confs, proxying events) is NOT view-gated —
+            # locking queue ACLs down must never break the trackers
+            daemon = RpcClient(host, port, secret=secret)
+            assert daemon.call("get_job_conf", jid)
+            assert daemon.call("get_job_status", jid)
+            assert client("killer").call("kill_job", jid, "killer") \
+                is True
+        finally:
+            m.stop()
+
     def test_kill_acl_enforced(self, master):
         jid = self.submit(master, "alice")
         with pytest.raises(PermissionError, match="cannot administer"):

@@ -458,8 +458,52 @@ class JobMaster:
         with self.lock:
             return sorted(self.jobs)
 
+    def _job_acl_allows(self, jip: JobInProgress, op: str, ugi) -> bool:
+        """The JobACLsManager ladder (reference src/mapred/.../
+        JobACLsManager.java + ACLsManager.checkAccess): owner, cluster
+        administrators / queue administer ACL, then the job's own
+        ``mapreduce.job.acl-<op>-job`` list — which defaults to ""
+        (nobody beyond the above), the reference's closed default."""
+        from tpumr.mapred.queue_manager import (DEFAULT_QUEUE,
+                                                JOB_QUEUE_KEY,
+                                                AccessControlList)
+        owner = str(jip.conf.get("user.name", ""))
+        if ugi.user == owner:
+            return True
+        queue = str(jip.conf.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
+                    or DEFAULT_QUEUE)
+        if self.queue_manager.has_access(queue, "administer-jobs", ugi):
+            return True                  # cluster admins included here
+        spec = str(jip.conf.get(f"mapreduce.job.acl-{op}-job", "") or "")
+        return AccessControlList(spec).allows(ugi)
+
+    def _check_job_op(self, jip: JobInProgress, op: str) -> None:
+        """Job-level VIEW/MODIFY gate for the PERSONAL-CREDENTIAL tier:
+        a verified user-key/token caller must pass the JobACLsManager
+        ladder. Cluster-secret callers — daemons above all: trackers
+        localize job confs and proxy completion events through their
+        service client — are the infrastructure tier of the documented
+        flat trust domain and are NOT gated here (a secret holder could
+        read the history files directly; gating them would only break
+        the trackers the moment an operator locks the queue ACLs down).
+        The reference draws the same line with service-level
+        authorization (hadoop-policy.xml) vs job ACLs."""
+        if not self.queue_manager.acls_enabled:
+            return
+        from tpumr.ipc.rpc import current_rpc_user, current_rpc_verified
+        if not current_rpc_verified():
+            return
+        from tpumr.security import server_side_ugi
+        ugi = server_side_ugi(str(current_rpc_user()), self.conf)
+        if not self._job_acl_allows(jip, op, ugi):
+            owner = str(jip.conf.get("user.name", ""))
+            raise PermissionError(
+                f"user {ugi.user!r} cannot {op} job {jip.job_id} "
+                f"(owner {owner!r}; mapreduce.job.acl-{op}-job)")
+
     def get_job_status(self, job_id: str) -> dict:
         jip = self._job(job_id)
+        self._check_job_op(jip, "view")
         d = jip.status_dict()
         if d["state"] in JobState.TERMINAL and not jip.finalized.is_set():
             # commit/abort still in flight — don't let a polling client
@@ -468,10 +512,13 @@ class JobMaster:
         return d
 
     def get_counters(self, job_id: str) -> dict:
-        return self._job(job_id).counters.to_dict()
+        jip = self._job(job_id)
+        self._check_job_op(jip, "view")
+        return jip.counters.to_dict()
 
     def get_task_reports(self, job_id: str, kind: str = "map") -> list:
         jip = self._job(job_id)
+        self._check_job_op(jip, "view")
         tips = jip.maps if kind == "map" else jip.reduces
         return [{
             "task_id": str(t.task_id), "state": t.report.state,
@@ -496,8 +543,16 @@ class JobMaster:
         queue = str(jip.conf.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
                     or DEFAULT_QUEUE)
         owner = str(jip.conf.get("user.name", ""))
-        self.queue_manager.check_administer(queue, self._acl_caller(user),
-                                            owner)
+        ugi = self._acl_caller(user)
+        # one MODIFY ladder (owner / queue admin / cluster admin / the
+        # job's acl-modify-job list) shared with the view gate — the
+        # asserted-identity handling above (anonymous for missing
+        # names) is kill_job's long-standing contract
+        if self.queue_manager.acls_enabled and \
+                not self._job_acl_allows(jip, "modify", ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} cannot administer job {jip.job_id} "
+                f"in queue {queue!r} (owner {owner!r})")
         # kill() no-ops if a concurrent heartbeat already made it terminal
         if not jip.kill():  # ≈ JobTracker.killJob: no-op on finished jobs
             return False
@@ -539,11 +594,14 @@ class JobMaster:
     def get_map_completion_events(self, job_id: str, from_index: int = 0,
                                   max_events: int = 10_000) -> list:
         jip = self._job(job_id)
+        self._check_job_op(jip, "view")   # own task children pass by scope
         with jip.lock:
             return jip.completion_events[from_index: from_index + max_events]
 
     def get_job_conf(self, job_id: str) -> dict:
-        return dict(self._job(job_id).conf)
+        jip = self._job(job_id)
+        self._check_job_op(jip, "view")
+        return dict(jip.conf)
 
     def get_job_token(self, job_id: str) -> bytes:
         """Per-job token for trackers localizing the job (cluster-secret
