@@ -408,12 +408,32 @@ def bench_terasort(rows: dict) -> None:
     from tpumr.mapred.local_runner import run_job
 
     n = 100_000 if SMALL else 2_000_000
-    work = tempfile.mkdtemp(prefix="tpumr-bench-ts-")
+    # gen data lives in the shared dir so the terasort_fresh PHASE (a
+    # separate process, by design — see bench_terasort_fresh) can reuse it
+    shared = os.environ.get("BENCH_SHARED_DIR") or tempfile.mkdtemp(
+        prefix="tpumr-bench-shared-")
+    work = os.path.join(shared, "ts")
+    os.makedirs(work, exist_ok=True)
     from tpumr.cli import main as cli_main
-    t0 = time.time()
-    assert cli_main(["examples", "teragen", str(n),
-                     f"file://{work}/gen", "-m", "4"]) == 0
-    log(f"[terasort] teragen {n:,} records: {time.time() - t0:.2f}s")
+    # sentinel carries the record count: a kill mid-teragen (or a scale
+    # flip across runs) must force regeneration, not benchmark a
+    # truncated/mis-sized dataset as if it were n records
+    sentinel = os.path.join(work, "gen", "_BENCH_GEN_OK")
+    ok = False
+    try:
+        with open(sentinel) as f:
+            ok = f.read().strip() == str(n)
+    except OSError:
+        pass
+    if not ok:
+        import shutil
+        shutil.rmtree(os.path.join(work, "gen"), ignore_errors=True)
+        t0 = time.time()
+        assert cli_main(["examples", "teragen", str(n),
+                         f"file://{work}/gen", "-m", "4"]) == 0
+        with open(sentinel, "w") as f:
+            f.write(str(n))
+        log(f"[terasort] teragen {n:,} records: {time.time() - t0:.2f}s")
 
     def run(device: bool) -> float:
         mode = "device" if device else "host"
@@ -440,36 +460,55 @@ def bench_terasort(rows: dict) -> None:
     rows["terasort_device_cold_job_s"] = round(t_dev_cold, 3)
     rows["terasort_device_job_s"] = round(t_dev, 3)
 
-    # A FRESH process with the persistent compilation cache populated by
-    # the runs above (TPUMR_JAX_CACHE_DIR, set per bench run in main):
-    # the production cold path — every new worker process inherits the
-    # compile bill already paid, so "cold" stops meaning minutes of XLA.
-    prog = (
-        _PIN_PREAMBLE +
-        "import sys, time\n"
-        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
-        "from tpumr.examples.terasort import make_terasort_conf\n"
-        "from tpumr.mapred.local_runner import run_job\n"
-        f"conf = make_terasort_conf('file://{work}/gen',\n"
-        f"    'file://{work}/out-fresh', 4, device_shuffle=True)\n"
-        "t0 = time.time()\n"
-        "assert run_job(conf).successful\n"
-        "print('FRESH_DEVICE_JOB_S', time.time() - t0)\n")
-    import subprocess
-    import sys as _sys
-    out = subprocess.run([_sys.executable, "-c", prog],
-                         capture_output=True, text=True, timeout=1800)
-    if out.returncode == 0:
-        t_fresh = float(out.stdout.split("FRESH_DEVICE_JOB_S")[1].strip())
-        log(f"[terasort] fresh-process device job with inherited "
-            f"compilation cache: {t_fresh:.2f}s (in-process true cold was "
-            f"{t_dev_cold:.2f}s)")
-        rows["terasort_device_fresh_process_cached_s"] = round(t_fresh, 3)
-    else:
-        log(f"[terasort] fresh-process cached run FAILED: "
-            f"{out.stderr.strip()[-400:]}")
-        rows["terasort_device_fresh_process_cached_s"] = \
-            f"failed: rc={out.returncode}"
+    # the fresh-process compile-cache row is its OWN phase
+    # (bench_terasort_fresh): a single tunneled TPU is exclusive, so the
+    # fresh process can only initialize the backend after THIS process
+    # has exited — the orchestrator sequences that.
+
+
+def bench_terasort_fresh(rows: dict) -> None:
+    """The production cold path: a FRESH worker process (this one — the
+    orchestrator runs every phase in its own subprocess) running the
+    device terasort with the persistent XLA compilation cache populated
+    by the preceding terasort phase (shared ``TPUMR_JAX_CACHE_DIR``).
+    Measures what a brand-new worker pays when the compile bill is
+    already settled — the JVM-reuse story (``JvmManager.java:322``) in
+    XLA terms. A separate phase because the tunneled TPU is EXCLUSIVE:
+    a subprocess spawned while a parent held the backend can never
+    initialize (``UNAVAILABLE`` after ~25 min — the round-4 failure mode
+    this design removes)."""
+    from tpumr.examples.terasort import make_terasort_conf
+    from tpumr.mapred.local_runner import run_job
+
+    n = 100_000 if SMALL else 2_000_000
+    shared = os.environ.get("BENCH_SHARED_DIR", "")
+    gen = os.path.join(shared, "ts", "gen")
+    gen_ok = False
+    if shared:
+        try:
+            with open(os.path.join(gen, "_BENCH_GEN_OK")) as f:
+                gen_ok = f.read().strip() == str(n)
+        except OSError:
+            pass
+    if not gen_ok:
+        # sentinel missing or wrong record count: the terasort phase was
+        # skipped, failed, or killed mid-teragen — a plausible-looking
+        # number measured on truncated data is worse than no number
+        log("[terasort-fresh] no complete shared teragen data (terasort "
+            "phase skipped/failed?) — skipping")
+        rows["terasort_device_fresh_process_cached_s"] = "skipped: no data"
+        return
+    conf = make_terasort_conf(
+        f"file://{gen}",
+        f"file://{os.path.join(shared, 'ts')}/out-fresh-{time.time_ns()}",
+        4, device_shuffle=True)
+    t0 = time.time()
+    assert run_job(conf).successful
+    t_fresh = time.time() - t0
+    log(f"[terasort-fresh] fresh-process device job with inherited "
+        f"compilation cache: {t_fresh:.2f}s (compare "
+        f"terasort_device_cold_job_s — the same compiles paid in-process)")
+    rows["terasort_device_fresh_process_cached_s"] = round(t_fresh, 3)
 
 
 # ---------------------------------------------------------------- codecs
@@ -871,81 +910,247 @@ def bench_hybrid(rows: dict) -> None:
         run_and_profile(c, conf, "matmul")
 
 
-# ------------------------------------------------------------------ main
+# ----------------------------------------------------- phase orchestration
+#
+# Every phase runs in its OWN subprocess, sequentially. Rationale
+# (learned the hard way on this harness):
+#  * the tunneled TPU is EXCLUSIVE — a second process cannot initialize
+#    the backend while another holds it, so fresh-process measurements
+#    (terasort_fresh) are only possible when the orchestrator itself
+#    never touches the device;
+#  * a wedged tunnel blocks inside an XLA call where no Python-level
+#    timeout can preempt it — only a process boundary lets the run
+#    continue past a hung phase instead of sinking the whole artifact;
+#  * rows are written to bench_details.json INCREMENTALLY after every
+#    phase (plus a write-through spill inside each phase), so even a
+#    kill -9 of everything leaves the completed rows on disk.
+
+#: (name, fn, device policy, full-scale timeout seconds). Policy:
+#: "required" — skip when the backend is unavailable; "optional" — run
+#: with whatever backend is up (fn handles TPU_OK internally);
+#: "never" — pure host phase, always pinned to the CPU backend.
+PHASES: list = [
+    ("kmeans", bench_kmeans, "optional", 5400),
+    ("wordcount", bench_wordcount, "optional", 900),
+    ("pi", bench_pi, "optional", 1200),
+    ("matmul", bench_matmul, "optional", 1800),
+    ("terasort", bench_terasort, "optional", 2700),
+    ("terasort_fresh", bench_terasort_fresh, "required", 1500),
+    ("codecs", bench_codecs, "never", 600),
+    ("kernels", bench_kernels, "required", 2400),
+    ("chained", bench_chained, "required", 1800),
+    ("hybrid", bench_hybrid, "required", 5400),
+]
+
+
+def _atomic_json_dump(obj: dict, path: str, **kw) -> None:
+    """tmp-file + rename: a SIGKILL mid-write must never leave truncated
+    JSON at ``path`` — these files exist precisely to survive kills."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, **kw)
+    os.replace(tmp, path)
+
+
+class _SpillDict(dict):
+    """Phase-side rows dict that writes itself through to a JSON side
+    file on every insertion, so a phase killed mid-flight still leaves
+    the rows it HAD captured for the orchestrator to merge."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+
+    def __setitem__(self, k, v):  # noqa: ANN001
+        super().__setitem__(k, v)
+        try:
+            _atomic_json_dump(dict(self), self._path)
+        except OSError:
+            pass
+
+
+def run_phase_child(name: str) -> int:
+    """Entry for ``bench.py --phase NAME``: run one phase in this
+    process (which owns the device for its lifetime) and hand rows back
+    on stdout."""
+    global TPU_OK
+    env_ok = os.environ.get("BENCH_TPU_OK")
+    entry = next((p for p in PHASES if p[0] == name), None)
+    if entry is None:
+        log(f"unknown phase: {name} (have: {[p[0] for p in PHASES]})")
+        return 2
+    _, fn, device, _ = entry
+    # standalone invocation (no orchestrator env): probe for ourselves
+    TPU_OK = env_ok == "1" if env_ok is not None else probe_backend({})
+    import jax
+    if not TPU_OK or device == "never":
+        jax.config.update("jax_platforms", "cpu")
+    elif os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    spill = os.environ.get("BENCH_ROWS_SPILL")
+    rows: dict = _SpillDict(spill) if spill else {}
+    t0 = time.time()
+    failed = False
+    try:
+        fn(rows)
+    except Exception as e:  # noqa: BLE001 — rows are best-effort
+        failed = True
+        log(f"[{name}] FAILED: {type(e).__name__}: {e}")
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        rows[f"bench_{name}"] = f"failed: {type(e).__name__}: {e}"
+    log(f"[timing] {name}: {time.time() - t0:.1f}s")
+    print("PHASE_ROWS " + json.dumps(rows), flush=True)
+    # rc=3 tells the orchestrator "rows are good but the phase FAILED" —
+    # it must re-probe the backend before sinking hours into later
+    # device phases against a possibly-wedged tunnel
+    return 3 if failed else 0
+
+
+def _dump(rows: dict) -> None:
+    _atomic_json_dump(rows, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_details.json"),
+        indent=2, sort_keys=True)
+
+
+def run_phase_subprocess(name: str, timeout_s: float, rows: dict) -> bool:
+    """Run one phase in its own process group; merge its rows. Returns
+    False when the phase timed out or crashed (spilled rows are still
+    merged)."""
+    import signal
+
+    spill = os.path.join(os.environ["BENCH_SHARED_DIR"],
+                         f"rows-{name}.json")
+    env = dict(os.environ, BENCH_TPU_OK="1" if TPU_OK else "0",
+               BENCH_ROWS_SPILL=spill)
+
+    def merge_spill() -> None:
+        try:
+            with open(spill) as f:
+                rows.update(json.load(f))
+        except (OSError, ValueError):
+            pass
+
+    t0 = time.time()
+    with tempfile.TemporaryFile("w+") as out:
+        # stderr inherits: phase logs stream live into the bench log
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--phase", name],
+            stdout=out, env=env, start_new_session=True)
+        try:
+            child.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log(f"[{name}] phase TIMEOUT after {timeout_s:.0f}s — "
+                f"SIGTERM, 30s grace, then SIGKILL")
+            try:
+                os.killpg(child.pid, signal.SIGTERM)
+            except OSError:
+                child.terminate()
+            try:
+                child.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(child.pid, signal.SIGKILL)
+                except OSError:
+                    child.kill()
+                try:
+                    child.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+            merge_spill()
+            rows[f"bench_{name}"] = f"failed: phase timeout {timeout_s:.0f}s"
+            rows[f"phase_{name}_s"] = round(time.time() - t0, 1)
+            return False
+        out.seek(0)
+        stdout = out.read()
+    rows[f"phase_{name}_s"] = round(time.time() - t0, 1)
+    line = next((ln for ln in stdout.splitlines()
+                 if ln.startswith("PHASE_ROWS ")), None)
+    if line is not None:
+        # rows travel back even when the phase failed (rc=3: fn raised
+        # but captured rows; the failure marker rides in the rows). The
+        # line itself may be truncated by a mid-write kill — fall back
+        # to the spill file rather than crash the orchestrator.
+        try:
+            rows.update(json.loads(line[len("PHASE_ROWS "):]))
+            return child.returncode == 0
+        except ValueError:
+            log(f"[{name}] PHASE_ROWS line unparseable (truncated by a "
+                f"kill?) — merging spill file instead")
+    merge_spill()
+    rows[f"bench_{name}"] = (
+        f"failed: phase exited rc={child.returncode}"
+        f"{' without parseable rows' if line else ' without rows'}")
+    return False
 
 
 def main() -> None:
     global TPU_OK
-    # fresh per-run persistent compilation cache: in-process "cold" rows
-    # stay TRUE cold (empty cache), while the fresh-subprocess terasort
-    # row below measures the production cold path (inherited cache)
-    os.environ["TPUMR_JAX_CACHE_DIR"] = tempfile.mkdtemp(
-        prefix="tpumr-bench-jaxcache-")
-    rows: dict = {}
-    # probe BEFORE this process initializes any backend: if the device
-    # tunnel is wedged, pin to CPU and still capture every host row
-    TPU_OK = probe_backend(rows)
-    import jax
-    if not TPU_OK:
-        jax.config.update("jax_platforms", "cpu")
-    elif os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    log(f"backend={jax.default_backend()} devices={jax.devices()} "
-        f"scale={'small' if SMALL else 'full'} tpu_ok={TPU_OK}")
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        sys.exit(run_phase_child(sys.argv[2]))
 
-    # every workload — including the kmeans north star — must leave its
-    # rows in the artifact even when a later (or its own) device step
-    # dies mid-run: dump what we have no matter how we exit
-    t_cpu = t_warm = 0.0
-    try:
-        try:
-            t_cpu, t_warm = bench_kmeans(rows)
-        except Exception as e:  # noqa: BLE001
-            log(f"[bench_kmeans] FAILED: {type(e).__name__}: {e}")
-            rows["bench_kmeans"] = f"failed: {e}"
-        fns = [bench_wordcount, bench_pi, bench_matmul, bench_terasort,
-               bench_codecs]
-        if TPU_OK:
-            fns += [bench_kernels, bench_chained, bench_hybrid]
-        for fn in fns:
-            # workloads run in ONE process here; in production each job
-            # owns its runner. Drop the previous workload's HBM split
-            # cache so a 6.4 GB resident K-Means dataset doesn't starve
-            # the terasort device buffers into allocation thrash.
-            from tpumr.mapred.tpu_runner import clear_split_caches
-            clear_split_caches()
-            t0 = time.time()
-            try:
-                fn(rows)
-            except Exception as e:  # noqa: BLE001 — rows best-effort
-                log(f"[{fn.__name__}] FAILED: {type(e).__name__}: {e}")
-                rows[fn.__name__] = f"failed: {e}"
-            log(f"[timing] {fn.__name__}: {time.time() - t0:.1f}s")
-    finally:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "bench_details.json"), "w") as f:
-            json.dump(rows, f, indent=2, sort_keys=True)
-        log(f"detail rows -> bench_details.json: "
-            f"{json.dumps(rows, sort_keys=True)}")
+    # fresh per-run persistent compilation cache: each phase's "cold"
+    # rows stay true cold for their own shapes, while terasort_fresh
+    # measures the production cold path (cache inherited across the
+    # process boundary)
+    os.environ.setdefault("TPUMR_JAX_CACHE_DIR", tempfile.mkdtemp(
+        prefix="tpumr-bench-jaxcache-"))
+    os.environ.setdefault("BENCH_SHARED_DIR", tempfile.mkdtemp(
+        prefix="tpumr-bench-shared-"))
+    rows: dict = {}
+    # probe in a SUBPROCESS before anything else: a wedged tunnel yields
+    # a host-only partial artifact, never rc=1 with nothing
+    TPU_OK = probe_backend(rows)
+    _dump(rows)
+    backend_name = rows.get("backend_probe", {}).get(
+        "backend", "unavailable") if TPU_OK else "unavailable"
+    log(f"orchestrator: backend={backend_name} "
+        f"scale={'small' if SMALL else 'full'}; one process per phase "
+        f"(exclusive device, per-phase timeouts, incremental artifact)")
+    mult = float(os.environ.get("BENCH_PHASE_TIMEOUT_MULT", "1.0"))
+    for name, _, device, timeout_s in PHASES:
+        if device == "required" and not TPU_OK:
+            rows[f"bench_{name}"] = "skipped: tpu unavailable"
+            log(f"[{name}] skipped: device required, backend unavailable")
+            _dump(rows)
+            continue
+        if SMALL:
+            timeout_s = max(120, timeout_s // 6)
+        ok = run_phase_subprocess(name, timeout_s * mult, rows)
+        _dump(rows)
+        if not ok and TPU_OK and device != "never":
+            # the failed phase may have wedged the tunnel; a cheap
+            # re-probe decides whether later device phases stand a chance
+            if probe_backend({}, attempts=1, timeout_s=120.0):
+                log(f"[{name}] failed but backend re-probe OK — continuing")
+            else:
+                TPU_OK = False
+                rows["tpu_unavailable_after_phase"] = name
+                log(f"[{name}] backend re-probe FAILED — skipping "
+                    f"remaining device phases")
+            _dump(rows)
+    log(f"detail rows -> bench_details.json: "
+        f"{json.dumps(rows, sort_keys=True)}")
 
     n = rows.get("kmeans_n_points", 0)
-    if TPU_OK and t_warm:
+    t_cpu = rows.get("kmeans_cpu_batch_job_s") or 0.0
+    t_warm = rows.get("kmeans_tpu_warm_job_s") or 0.0
+    if t_warm and t_cpu:
         print(json.dumps({
             "metric": f"kmeans {n / 1e6:.0f}M-pt full-job wall-clock, "
                       f"warm iterative round (tpu kernel vs vectorized "
                       f"cpu-only batch baseline; "
-                      f"cold={rows['kmeans_tpu_cold_job_s']}s)",
+                      f"cold={rows.get('kmeans_tpu_cold_job_s')}s)",
             "value": round(t_warm, 3),
             "unit": "seconds/job",
             "vs_baseline": round(t_cpu / t_warm, 2),
         }))
     else:
         # partial artifact with an explicit marker — a wedged tunnel or
-        # mid-run device failure must stay diagnosable, not rc=1 with
-        # nothing
+        # mid-run device failure stays diagnosable
         why = ("TPU BACKEND UNAVAILABLE — host-only partial capture"
                if not TPU_OK else
-               "device kmeans FAILED mid-run — partial capture")
+               "device kmeans did not complete — partial capture")
         print(json.dumps({
             "metric": f"kmeans {n / 1e6:.0f}M-pt cpu-batch full-job "
                       f"wall-clock ({why})",
