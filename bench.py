@@ -403,6 +403,18 @@ def bench_matmul(rows: dict) -> None:
 # -------------------------------------------------------------- terasort
 
 
+def _teragen_ok(gen_dir: str, n: int) -> bool:
+    """The gen-dir sentinel carries the record count: a kill
+    mid-teragen (or a scale flip across runs) must force regeneration,
+    not benchmark a truncated/mis-sized dataset as if it were n
+    records."""
+    try:
+        with open(os.path.join(gen_dir, "_BENCH_GEN_OK")) as f:
+            return f.read().strip() == str(n)
+    except OSError:
+        return False
+
+
 def bench_terasort(rows: dict) -> None:
     from tpumr.examples.terasort import make_terasort_conf
     from tpumr.mapred.local_runner import run_job
@@ -415,17 +427,8 @@ def bench_terasort(rows: dict) -> None:
     work = os.path.join(shared, "ts")
     os.makedirs(work, exist_ok=True)
     from tpumr.cli import main as cli_main
-    # sentinel carries the record count: a kill mid-teragen (or a scale
-    # flip across runs) must force regeneration, not benchmark a
-    # truncated/mis-sized dataset as if it were n records
     sentinel = os.path.join(work, "gen", "_BENCH_GEN_OK")
-    ok = False
-    try:
-        with open(sentinel) as f:
-            ok = f.read().strip() == str(n)
-    except OSError:
-        pass
-    if not ok:
+    if not _teragen_ok(os.path.join(work, "gen"), n):
         import shutil
         shutil.rmtree(os.path.join(work, "gen"), ignore_errors=True)
         t0 = time.time()
@@ -483,14 +486,7 @@ def bench_terasort_fresh(rows: dict) -> None:
     n = 100_000 if SMALL else 2_000_000
     shared = os.environ.get("BENCH_SHARED_DIR", "")
     gen = os.path.join(shared, "ts", "gen")
-    gen_ok = False
-    if shared:
-        try:
-            with open(os.path.join(gen, "_BENCH_GEN_OK")) as f:
-                gen_ok = f.read().strip() == str(n)
-        except OSError:
-            pass
-    if not gen_ok:
+    if not (shared and _teragen_ok(gen, n)):
         # sentinel missing or wrong record count: the terasort phase was
         # skipped, failed, or killed mid-teragen — a plausible-looking
         # number measured on truncated data is worse than no number
@@ -582,12 +578,17 @@ def _peak_for(kind: str) -> float | None:
 
 def bench_kernels(rows: dict) -> None:
     """ON-CHIP kernel efficiency, isolated from job machinery AND from
-    the tunnel: each kernel runs ``iters`` chained iterations inside one
-    jitted ``lax.fori_loop`` — a single dispatch, a single result fetch —
-    so per-iteration time is pure device compute, not the ~70 ms/RPC
-    tunnel tax that dominates per-call timings on this harness. This is
-    the measurement VERDICT r3 Weak #4 asked for: records/s/chip and
-    FLOP/s vs peak per kernel, separate from job wall-clocks."""
+    the tunnel: each kernel runs its iterations chained inside one
+    jitted ``lax.fori_loop`` and is timed by TWO-POINT DIFFERENCING —
+    the same chain compiled at a short and a long iteration count, each
+    run fetched as a SCALAR reduction via ``np.asarray`` (forcing a real
+    device→host roundtrip; on this tunneled harness
+    ``block_until_ready`` returns before the device work is actually
+    done, which round-4 smoke exposed as a 192% "MFU"). Per-iteration
+    time = (t_long − t_short)/(I_HI − I_LO): the constant dispatch +
+    RPC + fetch cost cancels in the difference. This is the measurement
+    VERDICT r3 Weak #4 asked for: records/s/chip and FLOP/s vs peak per
+    kernel, separate from job wall-clocks."""
     import jax
     from jax import lax
     import jax.numpy as jnp
@@ -596,16 +597,36 @@ def bench_kernels(rows: dict) -> None:
     backend = jax.default_backend()
     peak = _peak_for(kind)
     rows["kernel_device_kind"] = kind
-    iters = 4 if backend == "cpu" else 24
+    i_lo, i_hi = (2, 6) if backend == "cpu" else (8, 40)
+    rows["kernel_timing_method"] = (
+        f"two-point differenced chained fori_loop ({i_lo} vs {i_hi} "
+        f"iters), scalar np.asarray fetch, median of 3")
 
-    def timed_loop(fn, *args):
-        """Compile, then wall-time the jitted chained loop; returns
-        seconds per iteration."""
-        out = fn(*args)
-        jax.block_until_ready(out)      # compile + warm
-        t0 = time.time()
-        jax.block_until_ready(fn(*args))
-        return (time.time() - t0) / iters
+    def timed_chain(build, *args):
+        """``build(iters)`` returns the chain function (ending in a
+        scalar reduction). Compile both lengths, then difference; the
+        median over 3 passes rejects one-off tunnel hiccups."""
+        fn_lo = jax.jit(build(i_lo))
+        fn_hi = jax.jit(build(i_hi))
+        np.asarray(fn_lo(*args))        # compile + warm both lengths
+        np.asarray(fn_hi(*args))
+        diffs = []
+        for _ in range(3):
+            t0 = time.time()
+            np.asarray(fn_lo(*args))
+            t_lo = time.time() - t0
+            t0 = time.time()
+            np.asarray(fn_hi(*args))
+            t_hi = time.time() - t0
+            per = (t_hi - t_lo) / (i_hi - i_lo)
+            if per > 0:
+                diffs.append(per)
+        if not diffs:
+            # noise swamped the compute delta in every pass — surface
+            # "unmeasurable", never a NaN that poisons the JSON artifact
+            return None
+        diffs.sort()
+        return diffs[(len(diffs) - 1) // 2]   # lower median
 
     # --- matmul: the MXU headline. n=4096 f32 accumulate from bf16.
     n = 1024 if (SMALL or backend == "cpu") else 4096
@@ -614,36 +635,44 @@ def bench_kernels(rows: dict) -> None:
     b16 = jax.random.normal(key, (n, n), jnp.bfloat16)
     bf32 = b16.astype(jnp.float32)
 
-    @jax.jit
-    def mm_chain_bf16(y, b):
-        def body(_, acc):
-            acc = jnp.dot(acc.astype(jnp.bfloat16), b,
-                          preferred_element_type=jnp.float32)
-            return acc * (1.0 / n)      # keep magnitudes bounded
-        return lax.fori_loop(0, iters, body, y)
-
-    @jax.jit
-    def mm_chain_f32(y, b):
-        def body(_, acc):
-            acc = jnp.dot(acc, b, preferred_element_type=jnp.float32)
-            return acc * (1.0 / n)
-        return lax.fori_loop(0, iters, body, y)
+    def mm_build(dtype_in):
+        def build(iters):
+            def chain(y, b):
+                def body(_, acc):
+                    acc = jnp.dot(acc.astype(dtype_in), b,
+                                  preferred_element_type=jnp.float32)
+                    return acc * (1.0 / n)   # keep magnitudes bounded
+                return jnp.sum(lax.fori_loop(0, iters, body, y))
+            return chain
+        return build
 
     flops = 2.0 * n ** 3
-    t16 = timed_loop(mm_chain_bf16, a, b16)
-    t32 = timed_loop(mm_chain_f32, a, bf32)
-    r16, r32 = flops / t16, flops / t32
+    t16 = timed_chain(mm_build(jnp.bfloat16), a, b16)
+    t32 = timed_chain(mm_build(jnp.float32), a, bf32)
     rows["kernel_matmul_n"] = n
-    rows["kernel_matmul_bf16_onchip_s"] = round(t16, 6)
-    rows["kernel_matmul_bf16_tflops"] = round(r16 / 1e12, 2)
-    rows["kernel_matmul_f32_onchip_s"] = round(t32, 6)
-    rows["kernel_matmul_f32_tflops"] = round(r32 / 1e12, 2)
-    if peak:
-        rows["kernel_matmul_bf16_mfu"] = round(r16 / peak, 3)
-    log(f"[kernels] matmul {n}^3 on-chip: bf16 {t16 * 1e3:.2f} ms/iter "
-        f"= {r16 / 1e12:.1f} TFLOP/s"
-        + (f" (MFU {r16 / peak:.1%} of {kind})" if peak else f" ({kind})")
-        + f"; f32 {t32 * 1e3:.2f} ms/iter = {r32 / 1e12:.1f} TFLOP/s")
+    if t16 is None:
+        rows["kernel_matmul_bf16_onchip_s"] = "unmeasurable: noise"
+        log("[kernels] bf16 matmul timing unmeasurable (noise swamped "
+            "the compute delta in all passes)")
+    else:
+        r16 = flops / t16
+        rows["kernel_matmul_bf16_onchip_s"] = round(t16, 6)
+        rows["kernel_matmul_bf16_tflops"] = round(r16 / 1e12, 2)
+        if peak:
+            rows["kernel_matmul_bf16_mfu"] = round(r16 / peak, 3)
+        log(f"[kernels] matmul {n}^3 on-chip: bf16 {t16 * 1e3:.2f} ms/iter "
+            f"= {r16 / 1e12:.1f} TFLOP/s"
+            + (f" (MFU {r16 / peak:.1%} of {kind})" if peak
+               else f" ({kind})"))
+    if t32 is None:
+        rows["kernel_matmul_f32_onchip_s"] = "unmeasurable: noise"
+        log("[kernels] f32 matmul timing unmeasurable")
+    else:
+        r32 = flops / t32
+        rows["kernel_matmul_f32_onchip_s"] = round(t32, 6)
+        rows["kernel_matmul_f32_tflops"] = round(r32 / 1e12, 2)
+        log(f"[kernels] matmul {n}^3 on-chip: f32 {t32 * 1e3:.2f} ms/iter "
+            f"= {r32 / 1e12:.1f} TFLOP/s")
 
     # --- kmeans-assign: the north-star map kernel (distance matmul +
     # argmin + partial-sum matmul), iterated as real Lloyd rounds.
@@ -652,52 +681,65 @@ def bench_kernels(rows: dict) -> None:
     pts = jax.random.normal(key, (n_pts, d), jnp.float32)
     cents = jax.random.normal(key, (k, d), jnp.float32)
 
-    @jax.jit
-    def km_chain(p, c0):
-        def body(_, c):
-            x2 = jnp.sum(p * p, axis=1, keepdims=True)
-            c2 = jnp.sum(c * c, axis=1)
-            d2 = x2 - 2.0 * jnp.dot(p, c.T,
-                                    preferred_element_type=jnp.float32) \
-                + c2[None, :]
-            assign = jnp.argmin(d2, axis=1)
-            onehot = jax.nn.one_hot(assign, k, dtype=p.dtype)
-            sums = jnp.dot(onehot.T, p,
-                           preferred_element_type=jnp.float32)
-            counts = jnp.sum(onehot, axis=0)
-            return sums / jnp.maximum(counts, 1.0)[:, None]
-        return lax.fori_loop(0, iters, body, c0)
+    def km_build(iters):
+        def chain(p, c0):
+            def body(_, c):
+                x2 = jnp.sum(p * p, axis=1, keepdims=True)
+                c2 = jnp.sum(c * c, axis=1)
+                d2 = x2 - 2.0 * jnp.dot(p, c.T,
+                                        preferred_element_type=jnp.float32) \
+                    + c2[None, :]
+                assign = jnp.argmin(d2, axis=1)
+                onehot = jax.nn.one_hot(assign, k, dtype=p.dtype)
+                sums = jnp.dot(onehot.T, p,
+                               preferred_element_type=jnp.float32)
+                counts = jnp.sum(onehot, axis=0)
+                return sums / jnp.maximum(counts, 1.0)[:, None]
+            return jnp.sum(lax.fori_loop(0, iters, body, c0))
+        return chain
 
-    t_km = timed_loop(km_chain, pts, cents)
+    t_km = timed_chain(km_build, pts, cents)
     km_flops = 4.0 * n_pts * k * d      # two [n,d]x[d,k]-class matmuls
     rows["kernel_kmeans_n_points"] = n_pts
-    rows["kernel_kmeans_onchip_s"] = round(t_km, 6)
-    rows["kernel_kmeans_mrec_per_s"] = round(n_pts / t_km / 1e6, 1)
-    rows["kernel_kmeans_tflops"] = round(km_flops / t_km / 1e12, 2)
-    log(f"[kernels] kmeans-assign {n_pts / 1e6:.0f}M pts on-chip: "
-        f"{t_km * 1e3:.2f} ms/round = {n_pts / t_km / 1e6:.0f} M rec/s "
-        f"({km_flops / t_km / 1e12:.2f} TFLOP/s — HBM-bound at d={d}: "
-        f"arith intensity ~{4 * k / (2 * 4):.0f} FLOP/byte)")
+    if t_km is None:
+        rows["kernel_kmeans_onchip_s"] = "unmeasurable: noise"
+        log("[kernels] kmeans timing unmeasurable")
+    else:
+        rows["kernel_kmeans_onchip_s"] = round(t_km, 6)
+        rows["kernel_kmeans_mrec_per_s"] = round(n_pts / t_km / 1e6, 1)
+        rows["kernel_kmeans_tflops"] = round(km_flops / t_km / 1e12, 2)
+        log(f"[kernels] kmeans-assign {n_pts / 1e6:.0f}M pts on-chip: "
+            f"{t_km * 1e3:.2f} ms/round = {n_pts / t_km / 1e6:.0f} M rec/s "
+            f"({km_flops / t_km / 1e12:.2f} TFLOP/s — HBM-bound at d={d}: "
+            f"arith intensity ~{4 * k / (2 * 4):.0f} FLOP/byte)")
 
     # --- device sort + permutation-apply: the shuffle hot op (terasort
     # path sorts uint32 key columns, then gathers rows into order).
     n_rec = 200_000 if (SMALL or backend == "cpu") else 4_000_000
     cols = jax.random.bits(key, (n_rec, 3), jnp.uint32)
 
-    @jax.jit
-    def sort_chain(c0):
-        def body(_, c):
-            order = jnp.lexsort((c[:, 2], c[:, 1], c[:, 0]))
-            return c[order]             # apply = the real shuffle gather
-        return lax.fori_loop(0, iters, body, c0)
+    def sort_build(iters):
+        def chain(c0):
+            def body(i, c):
+                order = jnp.lexsort((c[:, 2], c[:, 1], c[:, 0]))
+                # re-randomize after the gather so every iteration sorts
+                # random data, not the previous iteration's sorted output
+                return c[order] ^ (jnp.uint32(2654435761) * (i + 1))
+            return jnp.sum(lax.fori_loop(0, iters, body, c0),
+                           dtype=jnp.uint32)
+        return chain
 
-    t_sort = timed_loop(sort_chain, cols)
+    t_sort = timed_chain(sort_build, cols)
     rows["kernel_sort_n_records"] = n_rec
-    rows["kernel_sort_onchip_s"] = round(t_sort, 6)
-    rows["kernel_sort_mrec_per_s"] = round(n_rec / t_sort / 1e6, 1)
-    log(f"[kernels] lexsort+apply {n_rec / 1e6:.1f}M 96-bit keys "
-        f"on-chip: {t_sort * 1e3:.2f} ms = "
-        f"{n_rec / t_sort / 1e6:.1f} M rec/s")
+    if t_sort is None:
+        rows["kernel_sort_onchip_s"] = "unmeasurable: noise"
+        log("[kernels] sort timing unmeasurable")
+    else:
+        rows["kernel_sort_onchip_s"] = round(t_sort, 6)
+        rows["kernel_sort_mrec_per_s"] = round(n_rec / t_sort / 1e6, 1)
+        log(f"[kernels] lexsort+apply {n_rec / 1e6:.1f}M 96-bit keys "
+            f"on-chip: {t_sort * 1e3:.2f} ms = "
+            f"{n_rec / t_sort / 1e6:.1f} M rec/s")
 
 
 # --------------------------------------------------------------- chained
@@ -744,12 +786,15 @@ def bench_chained(rows: dict) -> None:
         conf.set("tpumr.local.run.on.tpu", True)
         if not chained:
             conf.set("tpumr.tpu.output.cache", False)
+        log(f"[chained] starting job: {inp} -> {out} "
+            f"(chained={chained})")
         t0 = time.time()
         result = run_job(conf)
         dt = time.time() - t0
         assert result.successful, f"chain job failed: {result.error}"
         staged = result.counters.value(
             BackendCounter.GROUP, BackendCounter.TPU_DEVICE_BYTES_STAGED)
+        log(f"[chained] job done in {dt:.2f}s, staged {staged} bytes")
         return dt, staged
 
     t1, staged1 = run(f"file://{work}/a.npy", f"file://{work}/c", True)
@@ -987,6 +1032,16 @@ def run_phase_child(name: str) -> int:
         jax.config.update("jax_platforms", "cpu")
     elif os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if TPU_OK and device != "never":
+        # initialize the backend EAGERLY with a visible marker: when a
+        # phase hangs with no output, the absence of this line pins the
+        # hang on backend init (tunnel-session release race) rather
+        # than on the phase's own work — round-4 smoke burned 300 s
+        # being unable to tell the two apart
+        t_init = time.time()
+        devs = jax.devices()
+        log(f"[{name}] backend ready: {devs[0].device_kind} x{len(devs)} "
+            f"in {time.time() - t_init:.1f}s")
     spill = os.environ.get("BENCH_ROWS_SPILL")
     rows: dict = _SpillDict(spill) if spill else {}
     t0 = time.time()
@@ -1021,6 +1076,10 @@ def run_phase_subprocess(name: str, timeout_s: float, rows: dict) -> bool:
 
     spill = os.path.join(os.environ["BENCH_SHARED_DIR"],
                          f"rows-{name}.json")
+    try:  # a stale spill from a previous run in a reused shared dir
+        os.unlink(spill)  # must never be merged as fresh measurements
+    except OSError:
+        pass
     env = dict(os.environ, BENCH_TPU_OK="1" if TPU_OK else "0",
                BENCH_ROWS_SPILL=spill)
 
@@ -1092,7 +1151,9 @@ def main() -> None:
     # fresh per-run persistent compilation cache: each phase's "cold"
     # rows stay true cold for their own shapes, while terasort_fresh
     # measures the production cold path (cache inherited across the
-    # process boundary)
+    # process boundary). setdefault: an operator-exported
+    # TPUMR_JAX_CACHE_DIR is honored — but then the "cold" rows measure
+    # cache-hit compiles, so only preset it deliberately.
     os.environ.setdefault("TPUMR_JAX_CACHE_DIR", tempfile.mkdtemp(
         prefix="tpumr-bench-jaxcache-"))
     os.environ.setdefault("BENCH_SHARED_DIR", tempfile.mkdtemp(
@@ -1108,6 +1169,16 @@ def main() -> None:
         f"scale={'small' if SMALL else 'full'}; one process per phase "
         f"(exclusive device, per-phase timeouts, incremental artifact)")
     mult = float(os.environ.get("BENCH_PHASE_TIMEOUT_MULT", "1.0"))
+    settle_s = float(os.environ.get("BENCH_PHASE_SETTLE", "15"))
+    # the settle exists for the tunneled device's async session release;
+    # a CPU-pinned run has no tunnel to settle (or 30s-floor re-probe) for
+    tunnel = rows.get("backend_probe", {}).get("backend") not in (None,
+                                                                  "cpu")
+    if not tunnel:
+        settle_s = 0.0
+    # the startup probe subprocess already touched the device, so the
+    # FIRST device phase needs the settle too
+    prev_touched_device = TPU_OK
     for name, _, device, timeout_s in PHASES:
         if device == "required" and not TPU_OK:
             rows[f"bench_{name}"] = "skipped: tpu unavailable"
@@ -1116,11 +1187,27 @@ def main() -> None:
             continue
         if SMALL:
             timeout_s = max(120, timeout_s // 6)
+        touches_device = TPU_OK and device != "never"
+        if touches_device and prev_touched_device and settle_s > 0:
+            # the tunneled TPU is exclusive and its server releases a
+            # dead client's session asynchronously: a phase child that
+            # begins backend init before the release lands can park in
+            # init forever (the round-4 chained-phase hang). A short
+            # settle between device phases sidesteps the race.
+            log(f"[{name}] settling {settle_s:.0f}s for tunnel session "
+                f"release before next device phase")
+            time.sleep(settle_s)
+        prev_touched_device = touches_device
         ok = run_phase_subprocess(name, timeout_s * mult, rows)
         _dump(rows)
         if not ok and TPU_OK and device != "never":
             # the failed phase may have wedged the tunnel; a cheap
-            # re-probe decides whether later device phases stand a chance
+            # re-probe decides whether later device phases stand a chance.
+            # Settle first (30 s floor even when the operator zeroed the
+            # inter-phase settle) — probing into the just-killed child's
+            # half-released session reads as wedged even when it isn't.
+            if tunnel:
+                time.sleep(max(settle_s, 30.0))
             if probe_backend({}, attempts=1, timeout_s=120.0):
                 log(f"[{name}] failed but backend re-probe OK — continuing")
             else:
