@@ -1177,8 +1177,10 @@ def main() -> None:
     if not tunnel:
         settle_s = 0.0
     # the startup probe subprocess already touched the device, so the
-    # FIRST device phase needs the settle too
-    prev_touched_device = TPU_OK
+    # FIRST device phase needs the settle too. Time-based, not
+    # previous-phase-based: a short host-only phase between two device
+    # phases must not cancel the settle.
+    last_device_exit = time.time() if TPU_OK else 0.0
     for name, _, device, timeout_s in PHASES:
         if device == "required" and not TPU_OK:
             rows[f"bench_{name}"] = "skipped: tpu unavailable"
@@ -1188,17 +1190,19 @@ def main() -> None:
         if SMALL:
             timeout_s = max(120, timeout_s // 6)
         touches_device = TPU_OK and device != "never"
-        if touches_device and prev_touched_device and settle_s > 0:
+        remaining = settle_s - (time.time() - last_device_exit)
+        if touches_device and last_device_exit and remaining > 0:
             # the tunneled TPU is exclusive and its server releases a
             # dead client's session asynchronously: a phase child that
             # begins backend init before the release lands can park in
             # init forever (the round-4 chained-phase hang). A short
             # settle between device phases sidesteps the race.
-            log(f"[{name}] settling {settle_s:.0f}s for tunnel session "
+            log(f"[{name}] settling {remaining:.0f}s for tunnel session "
                 f"release before next device phase")
-            time.sleep(settle_s)
-        prev_touched_device = touches_device
+            time.sleep(remaining)
         ok = run_phase_subprocess(name, timeout_s * mult, rows)
+        if touches_device:
+            last_device_exit = time.time()
         _dump(rows)
         if not ok and TPU_OK and device != "never":
             # the failed phase may have wedged the tunnel; a cheap

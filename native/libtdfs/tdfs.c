@@ -20,6 +20,7 @@
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/time.h>
+#include <time.h>
 #include <unistd.h>
 
 /* Sanity cap on a server frame length read off the wire. Must exceed
@@ -50,14 +51,27 @@ typedef struct {
 } rpc_conn;
 
 static void fill_identity(rpc_conn* c) {
-  struct passwd* pw = getpwuid(getuid());
+  /* getpwuid_r, not getpwuid: concurrent connects (one tdfsFS per
+   * thread — the documented contract) must not race on libc's shared
+   * passwd buffer (found by the TSAN stress tier) */
+  struct passwd pwbuf, *pw = NULL;
+  char pwstr[1024];
+  if (getpwuid_r(getuid(), &pwbuf, pwstr, sizeof pwstr, &pw) != 0)
+    pw = NULL;
   const char* u = pw ? pw->pw_name : getenv("USER");
   unsigned char rnd[16];
   size_t i;
   FILE* f = fopen("/dev/urandom", "rb");
-  if (!f || fread(rnd, 1, sizeof rnd, f) != sizeof rnd)
+  if (!f || fread(rnd, 1, sizeof rnd, f) != sizeof rnd) {
+    /* the counter keeps same-second reconnects (which often get the
+     * same rpc_conn address back from malloc) from repeating a cid */
+    static _Atomic unsigned g_cid_counter;
+    unsigned seed = (unsigned)(getpid() ^ (uintptr_t)c ^
+                               (unsigned)time(NULL) ^
+                               (++g_cid_counter << 16));
     for (i = 0; i < sizeof rnd; i++)
-      rnd[i] = (unsigned char)(rand() ^ (getpid() >> (i % 8)));
+      rnd[i] = (unsigned char)rand_r(&seed);
+  }
   if (f) fclose(f);
   for (i = 0; i < sizeof rnd; i++)
     snprintf(c->cid + 2 * i, 3, "%02x", rnd[i]);

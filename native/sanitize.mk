@@ -3,3 +3,6 @@
 # silently runs a tier with weaker checking).
 SANFLAGS := -fsanitize=address,undefined -fno-sanitize-recover=all \
   -fno-omit-frame-pointer -g -O1
+
+# ThreadSanitizer (mutually exclusive with ASAN — separate binaries)
+TSANFLAGS := -fsanitize=thread -fno-omit-frame-pointer -g -O1
