@@ -597,7 +597,11 @@ def bench_kernels(rows: dict) -> None:
     backend = jax.default_backend()
     peak = _peak_for(kind)
     rows["kernel_device_kind"] = kind
-    i_lo, i_hi = (2, 6) if backend == "cpu" else (8, 40)
+    # wide spread on the device: at 4096³ one iteration is ~1 ms, so a
+    # 96-iteration delta (~100 ms) stands clear of per-call tunnel
+    # jitter; the loop bound is a compile-time constant in ONE While op,
+    # so the long chain costs no extra compile
+    i_lo, i_hi = (2, 6) if backend == "cpu" else (8, 104)
     rows["kernel_timing_method"] = (
         f"two-point differenced chained fori_loop ({i_lo} vs {i_hi} "
         f"iters), scalar np.asarray fetch, median of 3")
