@@ -200,7 +200,7 @@ def bench_kmeans(rows: dict) -> tuple[float, float]:
     log(f"[kmeans] generating {n:,} x {d} points ({n * d * 4 / 1e9:.1f} GB) "
         f"in {work} ...")
     rng = np.random.default_rng(0)
-    cents = rng.normal(size=(k, d)).astype(np.float32)
+    cents = rng.standard_normal(size=(k, d), dtype=np.float32)
     np.save(os.path.join(work, "cents.npy"), cents)
     # chunked generation+write keeps peak RAM ~1 split
     out = open(os.path.join(work, "points.npy"), "wb")
@@ -211,7 +211,7 @@ def bench_kmeans(rows: dict) -> tuple[float, float]:
     chunk = 4_000_000
     for lo in range(0, n, chunk):
         m = min(chunk, n - lo)
-        out.write(rng.normal(size=(m, d)).astype(np.float32).tobytes())
+        out.write(rng.standard_normal(size=(m, d), dtype=np.float32).tobytes())
     out.close()
 
     t_cpu = run_kmeans_job(work, "cpu", per_split)
@@ -360,9 +360,9 @@ def bench_matmul(rows: dict) -> None:
     work = tempfile.mkdtemp(prefix="tpumr-bench-mm-")
     rng = np.random.default_rng(2)
     np.save(os.path.join(work, "a.npy"),
-            rng.normal(size=(n, n)).astype(np.float32))
+            rng.standard_normal(size=(n, n), dtype=np.float32))
     np.save(os.path.join(work, "b.npy"),
-            rng.normal(size=(n, n)).astype(np.float32))
+            rng.standard_normal(size=(n, n), dtype=np.float32))
 
     def run(mode: str) -> float:
         clear_b_cache()
@@ -764,9 +764,9 @@ def bench_chained(rows: dict) -> None:
     work = tempfile.mkdtemp(prefix="tpumr-bench-chain-")
     rng = np.random.default_rng(9)
     np.save(os.path.join(work, "a.npy"),
-            rng.normal(size=(n, n)).astype(np.float32))
+            rng.standard_normal(size=(n, n), dtype=np.float32))
     np.save(os.path.join(work, "b.npy"),
-            rng.normal(size=(n, n)).astype(np.float32))
+            rng.standard_normal(size=(n, n), dtype=np.float32))
 
     def run(inp: str, out: str, chained: bool) -> tuple[float, int]:
         from tpumr.mapred.tpu_runner import clear_split_caches
@@ -846,7 +846,7 @@ def bench_hybrid(rows: dict) -> None:
     # mean-over-all-attempts profiling has the same cold-start skew)
     n_km, d, k = (2_000_000 if SMALL else 32_000_000), 16, 16
     np.save(os.path.join(work, "cents.npy"),
-            rng.normal(size=(k, d)).astype(np.float32))
+            rng.standard_normal(size=(k, d), dtype=np.float32))
     out = open(os.path.join(work, "points.npy"), "wb")
     header = np.lib.format.header_data_from_array_1_0(
         np.empty((0, d), np.float32))
@@ -854,13 +854,13 @@ def bench_hybrid(rows: dict) -> None:
     np.lib.format.write_array_header_1_0(out, header)
     for lo in range(0, n_km, 2_000_000):
         m = min(2_000_000, n_km - lo)
-        out.write(rng.normal(size=(m, d)).astype(np.float32).tobytes())
+        out.write(rng.standard_normal(size=(m, d), dtype=np.float32).tobytes())
     out.close()
     n_mm = 1024 if SMALL else 4096
     np.save(os.path.join(work, "a.npy"),
-            rng.normal(size=(n_mm, n_mm)).astype(np.float32))
+            rng.standard_normal(size=(n_mm, n_mm), dtype=np.float32))
     np.save(os.path.join(work, "b.npy"),
-            rng.normal(size=(n_mm, n_mm)).astype(np.float32))
+            rng.standard_normal(size=(n_mm, n_mm), dtype=np.float32))
 
     def run_and_profile(c, conf, tag, out_suffix=""):
         clear_centroid_cache()
@@ -1029,8 +1029,21 @@ def run_phase_child(name: str) -> int:
         log(f"unknown phase: {name} (have: {[p[0] for p in PHASES]})")
         return 2
     _, fn, device, _ = entry
-    # standalone invocation (no orchestrator env): probe for ourselves
-    TPU_OK = env_ok == "1" if env_ok is not None else probe_backend({})
+    # standalone invocation (no orchestrator env): probe for ourselves —
+    # then settle, because our own backend init follows the probe
+    # child's exit into the same tunnel-session-release race the
+    # orchestrator settles for. Only for a real tunneled device: cpu
+    # backends and host-only phases have no session to settle (mirrors
+    # the orchestrator's settle gating).
+    if env_ok is not None:
+        TPU_OK = env_ok == "1"
+    else:
+        probe_rows: dict = {}
+        TPU_OK = probe_backend(probe_rows)
+        if (TPU_OK and device != "never"
+                and probe_rows.get("backend_probe", {}).get("backend")
+                != "cpu"):
+            time.sleep(float(os.environ.get("BENCH_PHASE_SETTLE", "15")))
     import jax
     if not TPU_OK or device == "never":
         jax.config.update("jax_platforms", "cpu")
