@@ -992,6 +992,113 @@ PHASES: list = [
 ]
 
 
+#: phase -> key that only exists when its DEVICE rows were captured;
+#: --resume re-runs a "clean" phase whose device story is missing (it
+#: completed host-only under an earlier wedge) once TPU is back
+DEVICE_SENTINEL = {
+    "kmeans": "kmeans_tpu_warm_job_s", "pi": "pi_tpu_job_s",
+    "matmul": "matmul_tpu_job_s", "terasort": "terasort_device_job_s",
+    "terasort_fresh": "terasort_device_fresh_process_cached_s",
+    "kernels": "kernel_matmul_bf16_onchip_s",
+    "chained": "chained_consumer_job_s",
+    "hybrid": "hybrid_kmeans_round2_placement_seq",
+}
+
+_FRESH_KEY = "terasort_device_fresh_process_cached_s"
+_ROW_PREFIX = {"codecs": "codec_", "kernels": "kernel_",
+               "terasort_fresh": _FRESH_KEY}
+
+
+def phase_owns(name: str, key: str) -> bool:
+    """Row-ownership predicate per phase (keys are prefix-named; the
+    one overlap is the terasort/terasort_fresh pair)."""
+    if name == "terasort":
+        return key.startswith("terasort_") and key != _FRESH_KEY
+    return key.startswith(_ROW_PREFIX.get(name, name + "_"))
+
+
+def phase_done(prior: dict, name: str, device: str, tpu_ok: bool,
+               backend: "str | None" = None) -> bool:
+    """Did a prior run capture this phase completely (for --resume)?
+    ``backend`` is THIS run's probed backend name."""
+    if f"phase_{name}_s" not in prior or f"bench_{name}" in prior:
+        return False              # never ran, or ran and failed
+    stamp = prior.get(f"phase_{name}_backend")
+    if tpu_ok and device != "never" and backend is not None \
+            and stamp is not None and stamp != backend:
+        # measured on a DIFFERENT backend (host-only fallback under a
+        # wedge); the device is back — re-measure (covers phases with
+        # no device-only row key, e.g. wordcount)
+        return False
+    sentinel = DEVICE_SENTINEL.get(name)
+    if tpu_ok and device != "never" and sentinel is not None:
+        val = prior.get(sentinel)
+        if val is None or (isinstance(val, str)
+                           and val.split(":")[0] in ("skipped",
+                                                     "failed")):
+            # the device story wasn't captured (phase ran host-only
+            # under a wedge, or left a marker) — re-run now that the
+            # device is back
+            return False
+    return True
+
+
+def plan_resume(prior: dict, tpu_ok: bool, resume: bool, rows: dict,
+                backend: "str | None" = None) -> "tuple[set, set, dict]":
+    """Decide which phases run, and invalidate their prior rows.
+
+    Returns ``(rerun, forced, invalidated)``. terasort and
+    terasort_fresh re-run as a PAIR when the device is up: a re-run
+    terasort invalidates the fresh-process row (it measures THIS run's
+    compile cache + gen data), and a re-run terasort_fresh without its
+    terasort would find a brand-new empty shared dir and converge to
+    "skipped: no data" on every resume. ``forced`` holds the phases
+    added ONLY by that pairing — if the device dies mid-loop before
+    they run, the caller restores their rows from ``invalidated``
+    rather than re-measuring host-only. Invalidation happens UP FRONT,
+    not lazily per-iteration: a kill between a forced pair's first and
+    second member must not leave the second's stale rows looking clean
+    to the next resume; partway kills must never merge two attempts'
+    measurements silently.
+    """
+    rerun = {name for name, _, device, _ in PHASES
+             if not (resume and phase_done(prior, name, device, tpu_ok,
+                                           backend))}
+    forced: set = set()
+    if tpu_ok and rerun & {"terasort", "terasort_fresh"}:
+        forced = {"terasort", "terasort_fresh"} - rerun
+        rerun |= forced
+    invalidated: dict = {}
+    if resume:
+        for name in rerun:
+            for k in [k for k in rows
+                      if phase_owns(name, k)
+                      or k in (f"bench_{name}", f"phase_{name}_s",
+                               f"phase_{name}_backend")]:
+                invalidated[k] = rows.pop(k)
+    return rerun, forced, invalidated
+
+
+def resume_context(prior: dict) -> dict:
+    """The context a prior artifact's rows were measured under. For
+    artifacts that predate context stamping, synthesize from what they
+    recorded — the probe's backend, and the kmeans workload size (small
+    pins 2M points) when a kmeans row exists; unknown scale must read
+    as a MISMATCH (assuming the current scale would let small-scale
+    rows merge into a full-scale run relabeled)."""
+    ctx = prior.pop("bench_context", None)
+    if ctx is not None:
+        return ctx
+    n_prior = prior.get("kmeans_n_points")
+    import platform
+    return {"backend": prior.get("backend_probe", {}).get("backend"),
+            "small": (n_prior == 2_000_000) if n_prior else "unknown",
+            # legacy artifacts carry no host stamp; trust them as LOCAL
+            # (the resume restamps, so artifacts that travel in a git
+            # clone mismatch on every other machine thereafter)
+            "host": platform.node()}
+
+
 def _atomic_json_dump(obj: dict, path: str, **kw) -> None:
     """tmp-file + rename: a SIGKILL mid-write must never leave truncated
     JSON at ``path`` — these files exist precisely to survive kills."""
@@ -1028,7 +1135,34 @@ def run_phase_child(name: str) -> int:
     if entry is None:
         log(f"unknown phase: {name} (have: {[p[0] for p in PHASES]})")
         return 2
-    _, fn, device, _ = entry
+    _, fn, device, budget_s = entry
+    # Wedge diagnostics: when a device op hangs (tunnel wedge — observed
+    # live in round 4: main thread futex-parked under jax, tokio
+    # transport idle in epoll, zero CPU), the orchestrator's kill leaves
+    # no record of WHERE. Dump every thread's Python stack to stderr
+    # shortly before the phase budget expires so the artifact pins the
+    # hung frame, and register SIGUSR1 so an operator can poke a live
+    # stack out of a wedged phase without killing it.
+    import faulthandler
+    import signal as _signal
+    # chain=False: the default SIGUSR1 disposition is process death —
+    # a live-poke diagnostic must dump and keep the phase running
+    faulthandler.register(_signal.SIGUSR1, all_threads=True, chain=False)
+    # dump strictly BEFORE the orchestrator's kill lands, whatever the
+    # effective timeout (tiny-mult smoke runs included); a completed
+    # phase cancels the timer, so only still-running phases ever dump.
+    # The orchestrator exports its computed deadline; the formula below
+    # is only for standalone `--phase` invocations.
+    _eff = os.environ.get("BENCH_PHASE_BUDGET_S")
+    if _eff is not None:
+        _eff = float(_eff)
+    else:
+        _mult = float(os.environ.get("BENCH_PHASE_TIMEOUT_MULT", "1.0"))
+        if SMALL:  # mirror the orchestrator's SMALL-mode reduction
+            budget_s = max(120, budget_s // 6)
+        _eff = budget_s * _mult
+    faulthandler.dump_traceback_later(
+        max(5.0, min(_eff - 30.0, _eff * 0.9)), exit=False)
     # standalone invocation (no orchestrator env): probe for ourselves —
     # then settle, because our own backend init follows the probe
     # child's exit into the same tunnel-session-release race the
@@ -1061,6 +1195,11 @@ def run_phase_child(name: str) -> int:
             f"in {time.time() - t_init:.1f}s")
     spill = os.environ.get("BENCH_ROWS_SPILL")
     rows: dict = _SpillDict(spill) if spill else {}
+    # stamp which backend measured this phase: phases without a
+    # device-only row key (wordcount) would otherwise pass phase_done
+    # forever after a host-only run under a wedge — cpu numbers wearing
+    # the artifact's tpu label
+    rows[f"phase_{name}_backend"] = jax.default_backend()
     t0 = time.time()
     failed = False
     try:
@@ -1071,6 +1210,7 @@ def run_phase_child(name: str) -> int:
         import traceback
         traceback.print_exc(file=sys.stderr)
         rows[f"bench_{name}"] = f"failed: {type(e).__name__}: {e}"
+    faulthandler.cancel_dump_traceback_later()
     log(f"[timing] {name}: {time.time() - t0:.1f}s")
     print("PHASE_ROWS " + json.dumps(rows), flush=True)
     # rc=3 tells the orchestrator "rows are good but the phase FAILED" —
@@ -1079,10 +1219,14 @@ def run_phase_child(name: str) -> int:
     return 3 if failed else 0
 
 
+#: the detail artifact — written incrementally by the orchestrator and
+#: read back by --resume; one constant so the two can never diverge
+DETAILS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_details.json")
+
+
 def _dump(rows: dict) -> None:
-    _atomic_json_dump(rows, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "bench_details.json"),
-        indent=2, sort_keys=True)
+    _atomic_json_dump(rows, DETAILS_PATH, indent=2, sort_keys=True)
 
 
 def run_phase_subprocess(name: str, timeout_s: float, rows: dict) -> bool:
@@ -1098,7 +1242,11 @@ def run_phase_subprocess(name: str, timeout_s: float, rows: dict) -> bool:
     except OSError:
         pass
     env = dict(os.environ, BENCH_TPU_OK="1" if TPU_OK else "0",
-               BENCH_ROWS_SPILL=spill)
+               BENCH_ROWS_SPILL=spill,
+               # the effective kill deadline, so the child's wedge stack
+               # dump can be scheduled strictly before it without
+               # re-deriving (and drifting from) this computation
+               BENCH_PHASE_BUDGET_S=str(timeout_s))
 
     def merge_spill() -> None:
         try:
@@ -1165,6 +1313,30 @@ def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         sys.exit(run_phase_child(sys.argv[2]))
 
+    # --resume: merge the existing bench_details.json and run ONLY the
+    # phases that left no rows (or left a failure marker). The recovery
+    # path after a mid-run tunnel wedge: the completed phases' rows are
+    # kept as-is; a wedged phase re-runs once the tunnel heals. The
+    # summary line is recomputed over the merged artifact either way.
+    resume = "--resume" in sys.argv[1:]
+    prior: dict = {}
+    if resume:
+        try:
+            with open(DETAILS_PATH) as f:
+                prior = json.load(f)
+        except (OSError, ValueError) as e:
+            log(f"--resume: no usable bench_details.json ({e}); "
+                f"running everything")
+        # stale orchestration markers must not survive into the merged
+        # artifact (a re-probe decides availability afresh)
+        for k in ("tpu_unavailable", "tpu_unavailable_after_phase"):
+            prior.pop(k, None)
+    #: the context the prior rows were measured under; compared against
+    #: THIS run after the probe — resuming a cpu-pinned or small-scale
+    #: artifact on a real full-scale device run must re-measure, never
+    #: relabel (cpu numbers wearing tpu labels)
+    prior_ctx = resume_context(prior) if prior else None
+
     # fresh per-run persistent compilation cache: each phase's "cold"
     # rows stay true cold for their own shapes, while terasort_fresh
     # measures the production cold path (cache inherited across the
@@ -1176,15 +1348,44 @@ def main() -> None:
     os.environ.setdefault("BENCH_SHARED_DIR", tempfile.mkdtemp(
         prefix="tpumr-bench-shared-"))
     rows: dict = {}
+    if resume and prior:
+        # seed BEFORE the first _dump: the startup dump must never
+        # replace the on-disk artifact with probe-only rows while the
+        # prior measurements live only in this process's memory
+        rows.update({k: v for k, v in prior.items()
+                     if k != "backend_probe"})
     # probe in a SUBPROCESS before anything else: a wedged tunnel yields
     # a host-only partial artifact, never rc=1 with nothing
     TPU_OK = probe_backend(rows)
-    _dump(rows)
     backend_name = rows.get("backend_probe", {}).get(
         "backend", "unavailable") if TPU_OK else "unavailable"
     log(f"orchestrator: backend={backend_name} "
         f"scale={'small' if SMALL else 'full'}; one process per phase "
         f"(exclusive device, per-phase timeouts, incremental artifact)")
+    import platform
+    current_ctx = {"backend": backend_name if TPU_OK else None,
+                   "small": SMALL, "host": platform.node()}
+    if resume and prior:
+        ctx = prior_ctx or {}
+        # scale and host must always match; backend must match whenever
+        # THIS run has one (with the device down, prior device rows are
+        # kept — the re-run phases can only add host rows, which carry
+        # no device labels to mislabel). The host check stops a
+        # git-tracked artifact from another machine being merged into a
+        # local run as if it were this machine's own interrupted state.
+        if ctx.get("small") != SMALL \
+                or ctx.get("host") != current_ctx["host"] or (
+                TPU_OK and ctx.get("backend") != backend_name):
+            log(f"--resume: prior artifact context {ctx} does not match "
+                f"this run {current_ctx} — ignoring prior rows, "
+                f"running everything")
+            prior = {}
+            rows = {k: v for k, v in rows.items()
+                    if k in ("backend_probe", "tpu_unavailable")}
+    # the artifact's context: the prior run's when its rows are kept
+    # (a device-down resume stays labeled by the run that measured it)
+    rows["bench_context"] = prior_ctx if (resume and prior) else current_ctx
+    _dump(rows)
     mult = float(os.environ.get("BENCH_PHASE_TIMEOUT_MULT", "1.0"))
     settle_s = float(os.environ.get("BENCH_PHASE_SETTLE", "15"))
     # the settle exists for the tunneled device's async session release;
@@ -1198,7 +1399,28 @@ def main() -> None:
     # previous-phase-based: a short host-only phase between two device
     # phases must not cancel the settle.
     last_device_exit = time.time() if TPU_OK else 0.0
+
+    rerun, forced, invalidated = plan_resume(prior, TPU_OK, resume, rows,
+                                            backend_name)
+    if resume and invalidated:
+        _dump(rows)
     for name, _, device, timeout_s in PHASES:
+        if name not in rerun:
+            log(f"[{name}] --resume: rows present and clean — skipping")
+            continue
+        if name in forced and not TPU_OK:
+            # this phase was dragged in ONLY by pair-forcing while the
+            # device was up; the tunnel has since died mid-loop — put
+            # its invalidated prior rows back rather than overwrite
+            # good device measurements with a host-only re-measure
+            rows.update({k: v for k, v in invalidated.items()
+                         if phase_owns(name, k)
+                         or k in (f"bench_{name}", f"phase_{name}_s",
+                                  f"phase_{name}_backend")})
+            _dump(rows)
+            log(f"[{name}] device lost mid-resume — restored prior rows "
+                f"instead of re-measuring host-only")
+            continue
         if device == "required" and not TPU_OK:
             rows[f"bench_{name}"] = "skipped: tpu unavailable"
             log(f"[{name}] skipped: device required, backend unavailable")
@@ -1237,6 +1459,9 @@ def main() -> None:
                 log(f"[{name}] backend re-probe FAILED — skipping "
                     f"remaining device phases")
             _dump(rows)
+    # safety net only: every mutation above already dumps, but a future
+    # branch that forgets must not ship a stale artifact
+    _dump(rows)
     log(f"detail rows -> bench_details.json: "
         f"{json.dumps(rows, sort_keys=True)}")
 

@@ -1,0 +1,213 @@
+"""Unit tests for bench.py's --resume planning machinery.
+
+The resume predicates decide whether hour-long completed phases are
+kept or re-measured, and whether device rows can be silently relabeled
+across backends/scales — load-bearing enough for the artifact the
+driver captures that they get direct coverage here (the end-to-end
+flows are driven by the bench itself; these pin the predicate
+semantics against row-key / PHASES drift).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_under_test",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_under_test"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _clean_prior(bench, names=None, device_rows=True):
+    """A prior artifact where every named phase completed cleanly."""
+    prior: dict = {}
+    for name, _, _, _ in bench.PHASES:
+        if names is not None and name not in names:
+            continue
+        prior[f"phase_{name}_s"] = 10.0
+        if device_rows and name in bench.DEVICE_SENTINEL:
+            prior[bench.DEVICE_SENTINEL[name]] = 1.5
+        prior[name.rstrip("s") + "_x" if name == "codecs"
+              else name + "_something"] = 1
+    return prior
+
+
+# ------------------------------------------------------------ ownership
+
+
+def test_every_sentinel_owned_by_its_phase(bench):
+    """DEVICE_SENTINEL and phase_owns must agree, or invalidation
+    leaves a sentinel behind and resume skips a half-invalidated
+    phase."""
+    for name, key in bench.DEVICE_SENTINEL.items():
+        assert bench.phase_owns(name, key), (name, key)
+        # ...and no OTHER phase owns it
+        for other, _, _, _ in bench.PHASES:
+            if other != name:
+                assert not bench.phase_owns(other, key), (other, key)
+
+
+def test_terasort_pair_ownership_disjoint(bench):
+    assert bench.phase_owns("terasort", "terasort_host_job_s")
+    assert bench.phase_owns("terasort", "terasort_device_cold_job_s")
+    assert not bench.phase_owns(
+        "terasort", "terasort_device_fresh_process_cached_s")
+    assert bench.phase_owns(
+        "terasort_fresh", "terasort_device_fresh_process_cached_s")
+    assert not bench.phase_owns("terasort_fresh", "terasort_host_job_s")
+
+
+def test_kmeans_does_not_own_kernel_rows(bench):
+    assert not bench.phase_owns("kmeans", "kernel_kmeans_mrec_per_s")
+    assert bench.phase_owns("kernels", "kernel_kmeans_mrec_per_s")
+    assert bench.phase_owns("codecs", "codec_tlz_text_ratio")
+
+
+# ------------------------------------------------------------ phase_done
+
+
+def test_phase_done_requires_timing_and_no_marker(bench):
+    assert not bench.phase_done({}, "pi", "optional", tpu_ok=True)
+    prior = {"phase_pi_s": 5.0, "pi_tpu_job_s": 0.4}
+    assert bench.phase_done(prior, "pi", "optional", tpu_ok=True)
+    prior["bench_pi"] = "failed: phase exited rc=3"
+    assert not bench.phase_done(prior, "pi", "optional", tpu_ok=True)
+
+
+def test_phase_done_missing_device_rows_reruns_when_tpu_back(bench):
+    """A phase that completed host-only under a wedge re-runs once the
+    device is back — but counts as done while it is still down."""
+    prior = {"phase_pi_s": 5.0}          # no pi_tpu_job_s captured
+    assert not bench.phase_done(prior, "pi", "optional", tpu_ok=True)
+    assert bench.phase_done(prior, "pi", "optional", tpu_ok=False)
+    # marker-string sentinel values read as not-captured too
+    prior["pi_tpu_job_s"] = "skipped: tpu unavailable"
+    assert not bench.phase_done(prior, "pi", "optional", tpu_ok=True)
+
+
+# ----------------------------------------------------------- plan_resume
+
+
+def test_plan_rerun_only_failed_phase(bench):
+    prior = _clean_prior(bench)
+    prior["bench_wordcount"] = "failed: phase timeout 900s"
+    rows = dict(prior)
+    rerun, forced, invalidated = bench.plan_resume(
+        prior, tpu_ok=True, resume=True, rows=rows)
+    assert rerun == {"wordcount"}
+    assert forced == set()
+    assert "bench_wordcount" in invalidated
+    assert "phase_wordcount_s" not in rows
+    # untouched phases keep their rows
+    assert "phase_pi_s" in rows
+
+
+def test_plan_pairs_terasort_with_fresh_when_device_up(bench):
+    prior = _clean_prior(bench)
+    prior["bench_terasort_fresh"] = "failed: phase exited rc=3"
+    rows = dict(prior)
+    rerun, forced, invalidated = bench.plan_resume(
+        prior, tpu_ok=True, resume=True, rows=rows)
+    assert rerun == {"terasort", "terasort_fresh"}
+    assert forced == {"terasort"}        # dragged in only by the pair
+    # terasort's prior device rows were invalidated but preserved for
+    # the mid-loop-device-loss restore path
+    assert "terasort_device_job_s" in invalidated
+    assert "terasort_device_job_s" not in rows
+
+
+def test_plan_no_pairing_while_device_down(bench):
+    """With the tunnel down, terasort_fresh is unfixable anyway —
+    terasort's good device rows must NOT be sacrificed."""
+    prior = _clean_prior(bench)
+    prior["bench_terasort_fresh"] = "skipped: tpu unavailable"
+    rows = dict(prior)
+    rerun, forced, _ = bench.plan_resume(
+        prior, tpu_ok=False, resume=True, rows=rows)
+    assert "terasort" not in rerun
+    assert forced == set()
+    assert "terasort_device_job_s" in rows
+
+
+def test_plan_fresh_run_reruns_everything(bench):
+    rows: dict = {}
+    rerun, forced, invalidated = bench.plan_resume(
+        {}, tpu_ok=True, resume=False, rows=rows)
+    assert rerun == {name for name, _, _, _ in bench.PHASES}
+    assert invalidated == {}
+
+
+# -------------------------------------------------------- resume_context
+
+
+def test_resume_context_prefers_stamp(bench):
+    prior = {"bench_context": {"backend": "tpu", "small": False}}
+    assert bench.resume_context(prior) == {"backend": "tpu",
+                                           "small": False}
+    assert "bench_context" not in prior   # consumed
+
+
+def test_resume_context_synthesizes_for_legacy_artifacts(bench):
+    prior = {"backend_probe": {"backend": "cpu"},
+             "kmeans_n_points": 2_000_000}
+    ctx = bench.resume_context(prior)
+    assert (ctx["backend"], ctx["small"]) == ("cpu", True)
+    prior = {"backend_probe": {"backend": "tpu"},
+             "kmeans_n_points": 100_000_000}
+    ctx = bench.resume_context(prior)
+    assert (ctx["backend"], ctx["small"]) == ("tpu", False)
+
+
+def test_phase_done_host_measured_phase_reruns_when_tpu_back(bench):
+    """wordcount has no device-only row key; the per-phase backend
+    stamp is what forces its re-measure after a host-only wedge run."""
+    prior = {"phase_wordcount_s": 3.0, "wordcount_job_s": 60.0,
+             "wordcount_mb_per_s": 3.5, "phase_wordcount_backend": "cpu"}
+    assert not bench.phase_done(prior, "wordcount", "optional",
+                                tpu_ok=True, backend="tpu")
+    assert bench.phase_done(prior, "wordcount", "optional",
+                            tpu_ok=False, backend="tpu")
+    # a cpu-REQUESTED run legitimately measures on cpu: stamp matches
+    assert bench.phase_done(prior, "wordcount", "optional",
+                            tpu_ok=True, backend="cpu")
+    prior["phase_wordcount_backend"] = "tpu"
+    assert bench.phase_done(prior, "wordcount", "optional",
+                            tpu_ok=True, backend="tpu")
+
+
+def test_plan_invalidates_backend_stamp_too(bench):
+    prior = {"phase_wordcount_s": 3.0, "wordcount_job_s": 60.0,
+             "phase_wordcount_backend": "cpu"}
+    rows = dict(prior)
+    rerun, _, invalidated = bench.plan_resume(
+        prior, tpu_ok=True, resume=True, rows=rows, backend="tpu")
+    assert "wordcount" in rerun
+    assert "phase_wordcount_backend" in invalidated
+    assert "phase_wordcount_backend" not in rows
+
+
+def test_resume_context_includes_local_host_for_legacy(bench):
+    import platform
+    ctx = bench.resume_context({"backend_probe": {"backend": "cpu"},
+                                "kmeans_n_points": 2_000_000})
+    assert ctx["host"] == platform.node()
+
+
+def test_resume_context_unknown_scale_never_matches(bench):
+    """kmeans never ran: scale is unknowable and must mismatch BOTH
+    scales (forcing a full re-measure), not default to the current
+    run's."""
+    ctx = bench.resume_context({"backend_probe": {"backend": "cpu"}})
+    assert ctx["small"] not in (True, False)
