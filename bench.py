@@ -1017,6 +1017,15 @@ def phase_owns(name: str, key: str) -> bool:
     return key.startswith(_ROW_PREFIX.get(name, name + "_"))
 
 
+def phase_all_keys(name: str, rows: dict) -> "list[str]":
+    """Every key in ``rows`` belonging to one phase: its data rows plus
+    the orchestration meta keys. The ONE list both invalidation
+    (plan_resume) and the forced-pair restore use — a meta key added to
+    one but not the other would make them asymmetric."""
+    meta = (f"bench_{name}", f"phase_{name}_s", f"phase_{name}_backend")
+    return [k for k in rows if phase_owns(name, k) or k in meta]
+
+
 def phase_done(prior: dict, name: str, device: str, tpu_ok: bool,
                backend: "str | None" = None) -> bool:
     """Did a prior run capture this phase completely (for --resume)?
@@ -1071,10 +1080,7 @@ def plan_resume(prior: dict, tpu_ok: bool, resume: bool, rows: dict,
     invalidated: dict = {}
     if resume:
         for name in rerun:
-            for k in [k for k in rows
-                      if phase_owns(name, k)
-                      or k in (f"bench_{name}", f"phase_{name}_s",
-                               f"phase_{name}_backend")]:
+            for k in phase_all_keys(name, rows):
                 invalidated[k] = rows.pop(k)
     return rerun, forced, invalidated
 
@@ -1413,10 +1419,8 @@ def main() -> None:
             # device was up; the tunnel has since died mid-loop — put
             # its invalidated prior rows back rather than overwrite
             # good device measurements with a host-only re-measure
-            rows.update({k: v for k, v in invalidated.items()
-                         if phase_owns(name, k)
-                         or k in (f"bench_{name}", f"phase_{name}_s",
-                                  f"phase_{name}_backend")})
+            rows.update({k: invalidated[k]
+                         for k in phase_all_keys(name, invalidated)})
             _dump(rows)
             log(f"[{name}] device lost mid-resume — restored prior rows "
                 f"instead of re-measuring host-only")

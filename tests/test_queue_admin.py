@@ -1,0 +1,211 @@
+"""Queue administration surface: ``tpumr queue`` / ``mradmin
+-refreshQueues`` / ``daemonlog`` (≈ bin/hadoop queue — JobQueueClient
+over JobClient.getQueues/getJobsFromQueue/getQueueAclsForCurrentUser;
+AdminOperationsProtocol.refreshQueues; the LogLevel servlet)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.jobtracker import JobMaster
+from tpumr.mapred.queue_manager import QueueManager
+from tpumr.security import UserGroupInformation
+
+
+def ugi(user, groups=()):
+    return UserGroupInformation(user, list(groups))
+
+
+@pytest.fixture()
+def master():
+    conf = JobConf()
+    conf.set("mapred.acls.enabled", True)
+    conf.set("mapred.queue.names", "default,prod")
+    conf.set("mapred.queue.prod.acl-submit-job", "alice")
+    conf.set("mapred.queue.prod.acl-administer-jobs", "opsuser")
+    conf.set("mapred.cluster.administrators", "root0")
+    m = JobMaster(conf).start()
+    yield m
+    m.stop()
+
+
+def submit(master, user, queue="prod"):
+    return master.submit_job(
+        {"mapred.job.queue.name": queue, "user.name": user,
+         "mapred.reduce.tasks": 0}, [{"locations": []}])
+
+
+class TestQueueInfo:
+    def test_list_reports_acls_and_counts(self, master):
+        jid = submit(master, "alice")
+        info = {q["queue"]: q for q in master.get_queue_info()}
+        assert set(info) == {"default", "prod"}
+        assert info["prod"]["acl_submit_job"] == "alice"
+        assert info["prod"]["acl_administer_jobs"] == "opsuser"
+        assert info["prod"]["total_jobs"] == 1
+        assert info["default"]["total_jobs"] == 0
+        assert info["default"]["acl_submit_job"] == "*"  # unset = open
+        assert jid in master.get_queue_jobs("prod")
+        assert master.get_queue_jobs("default") == []
+
+    def test_showacls_per_user(self, master):
+        rows = {r["queue"]: r["operations"]
+                for r in master.get_queue_acls("alice")}
+        assert rows["prod"] == ["submit-job"]
+        assert set(rows["default"]) == {"submit-job", "administer-jobs"}
+        rows = {r["queue"]: r["operations"]
+                for r in master.get_queue_acls("opsuser")}
+        assert rows["prod"] == ["administer-jobs"]
+        # cluster administrators hold every operation everywhere
+        rows = {r["queue"]: r["operations"]
+                for r in master.get_queue_acls("root0")}
+        assert set(rows["prod"]) == {"submit-job", "administer-jobs"}
+
+
+class TestRefreshQueues:
+    def test_refresh_requires_admin_when_acls_on(self, master):
+        with pytest.raises(PermissionError, match="administrator"):
+            master.refresh_queues("alice")
+        assert master.refresh_queues("root0") == ["default", "prod"]
+
+    def test_refresh_rereads_acls_file(self, tmp_path):
+        """The hot-reload path ≈ mapred-queue-acls.xml: ACL changes in
+        mapred.queue.acls.file take effect on refresh, no restart."""
+        acls = tmp_path / "queue-acls.json"
+        acls.write_text(json.dumps(
+            {"mapred.queue.prod.acl-submit-job": "alice"}))
+        conf = JobConf()
+        conf.set("mapred.acls.enabled", True)
+        conf.set("mapred.queue.names", "prod")
+        conf.set("mapred.cluster.administrators", "admin0")
+        conf.set("mapred.queue.acls.file", str(acls))
+        m = JobMaster(conf).start()
+        try:
+            submit(m, "alice")
+            with pytest.raises(PermissionError, match="cannot submit"):
+                submit(m, "bob")
+            # operator edits the file, then mradmin -refreshQueues
+            acls.write_text(json.dumps(
+                {"mapred.queue.prod.acl-submit-job": "alice,bob"}))
+            with pytest.raises(PermissionError, match="cannot submit"):
+                submit(m, "bob")        # not yet refreshed
+            m.refresh_queues("admin0")
+            submit(m, "bob")
+        finally:
+            m.stop()
+
+    def test_refresh_admin_gate_uses_acl_file_admins(self, tmp_path):
+        """With ACLs on and no cluster administrators configured,
+        refresh is denied (blank admin ACL allows no one) — the closed
+        default, matching every other admin-gated operation."""
+        conf = JobConf()
+        conf.set("mapred.acls.enabled", True)
+        conf.set("mapred.queue.names", "prod")
+        m = JobMaster(conf).start()
+        try:
+            with pytest.raises(PermissionError, match="administrator"):
+                m.refresh_queues("anyone")
+        finally:
+            m.stop()
+
+
+class TestQueueManagerAclsFile:
+    def test_file_layer_beats_startup_conf(self, tmp_path):
+        acls = tmp_path / "acls.json"
+        acls.write_text(json.dumps(
+            {"mapred.queue.q.acl-submit-job": "fileuser"}))
+        conf = JobConf()
+        conf.set("mapred.queue.names", "q")
+        conf.set("mapred.acls.enabled", True)
+        conf.set("mapred.queue.acls.file", str(acls))
+        qm = QueueManager(conf)
+        assert qm.acl_spec("q", "submit-job") == "fileuser"
+        assert qm.has_access("q", "submit-job", ugi("fileuser"))
+        assert not qm.has_access("q", "submit-job", ugi("other"))
+
+    def test_missing_file_fails_loudly(self):
+        conf = JobConf()
+        conf.set("mapred.queue.acls.file", "/nonexistent/acls.json")
+        with pytest.raises(OSError):
+            QueueManager(conf)
+
+
+class TestDaemonLogEndpoint:
+    def test_get_and_set_level_over_http(self):
+        import logging
+
+        from tpumr.http import StatusHttpServer
+        srv = StatusHttpServer("test").start()
+        try:
+            host, port = srv.address
+            base = f"http://{host}:{port}/json/logLevel"
+            name = "tpumr.test.daemonlog"
+            with urllib.request.urlopen(f"{base}?log={name}") as r:
+                body = json.loads(r.read())
+            assert body["log"] == name and body["level"] == "UNSET"
+            # a GET can never mutate (drive-by <img> protection): 405
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}?log={name}&level=DEBUG")
+            assert ei.value.code == 405
+            assert logging.getLogger(name).level == logging.NOTSET
+            req = urllib.request.Request(
+                f"{base}?log={name}&level=DEBUG", method="POST")
+            with urllib.request.urlopen(req) as r:
+                body = json.loads(r.read())
+            assert body["level"] == "DEBUG"
+            assert logging.getLogger(name).level == logging.DEBUG
+        finally:
+            srv.stop()
+            logging.getLogger("tpumr.test.daemonlog").setLevel(
+                logging.NOTSET)
+
+    def test_daemonlog_cli(self, capsys):
+        from tpumr.cli import main as cli_main
+        from tpumr.http import StatusHttpServer
+        srv = StatusHttpServer("test").start()
+        try:
+            host, port = srv.address
+            rc = cli_main(["daemonlog", "-setlevel", f"{host}:{port}",
+                           "tpumr.test.dlcli", "WARNING"])
+            assert rc == 0
+            assert "level=WARNING" in capsys.readouterr().out
+            rc = cli_main(["daemonlog", "-getlevel", f"{host}:{port}",
+                           "tpumr.test.dlcli"])
+            assert rc == 0
+            assert "effective=WARNING" in capsys.readouterr().out
+        finally:
+            srv.stop()
+
+
+class TestQueueCli:
+    def test_queue_list_and_showacls_over_rpc(self, master, capsys):
+        from tpumr.cli import main as cli_main
+        submit(master, "alice")
+        host, port = master.address
+        rc = cli_main(["-jt", f"{host}:{port}", "queue", "-list"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Queue: prod" in out and "acl-submit-job: alice" in out
+        assert "1 running / 1 total" in out or "0 running / 1 total" in out
+        rc = cli_main(["-jt", f"{host}:{port}", "queue", "-info", "prod",
+                       "-showJobs"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert '"queue": "prod"' in out and "job_" in out
+        rc = cli_main(["-jt", f"{host}:{port}", "queue", "-showacls"])
+        assert rc == 0
+        assert "Queue acls for user" in capsys.readouterr().out
+
+    def test_mradmin_refresh_over_rpc(self, master, capsys, monkeypatch):
+        from tpumr.cli import main as cli_main
+        host, port = master.address
+        # the CLI asserts the process user; make it the configured admin
+        monkeypatch.setattr(
+            "tpumr.security.UserGroupInformation.get_current_user",
+            staticmethod(lambda: ugi("root0")))
+        rc = cli_main(["-jt", f"{host}:{port}", "mradmin",
+                       "-refreshQueues"])
+        assert rc == 0
+        assert "Queues refreshed: default, prod" in capsys.readouterr().out

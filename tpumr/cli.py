@@ -49,6 +49,10 @@ Clients:
   gridmix [--scale S]  synthetic mixed-workload benchmark
   keys SUBCMD          credentials: user-key USER | token [-nn] [-renewer R]
                        [-out FILE] | renew FILE | cancel FILE
+  fetchdt TOKEN_FILE   fetch a NameNode delegation token (= keys token -nn)
+  queue ...            queue info: -list | -info Q [-showJobs] | -showacls
+  mradmin -refreshQueues   re-read queue names/ACLs on the live JobTracker
+  daemonlog ...        -getlevel H:P LOGGER | -setlevel H:P LOGGER LEVEL
   version              print the version
 """
 
@@ -647,6 +651,164 @@ def cmd_keys(conf, argv: list[str]) -> int:
     return 255
 
 
+def _jt_client(conf):
+    """An RPC client for the configured JobTracker, or None (with the
+    error already printed) when mapred.job.tracker is unset/local."""
+    from tpumr.ipc.rpc import RpcClient
+    from tpumr.security import client_credentials
+    jt = conf.get("mapred.job.tracker")
+    if not jt or jt == "local" or ":" not in str(jt):
+        print("this command needs -jt HOST:PORT "
+              "(or mapred.job.tracker)", file=sys.stderr)
+        return None
+    host, port = _host_port(str(jt))
+    secret, scope = client_credentials(conf, "jobtracker")
+    return RpcClient(host, port, secret=secret, scope=scope)
+
+
+def cmd_queue(conf, argv: list[str]) -> int:
+    """≈ bin/hadoop queue: -list | -info QUEUE [-showJobs] | -showacls
+    (reference CLI: JobQueueClient over JobClient.getQueues/
+    getJobsFromQueue/getQueueAclsForCurrentUser)."""
+    from tpumr.ipc.rpc import RpcError
+    usage = "Usage: tpumr queue -list | -info QUEUE [-showJobs] | -showacls"
+    if not argv or argv[0] not in ("-list", "-info", "-showacls"):
+        print(usage, file=sys.stderr)
+        return 255
+    client = _jt_client(conf)
+    if client is None:
+        return 255
+    cmd, *rest = argv
+    try:
+        if cmd == "-list":
+            for q in client.call("get_queue_info"):
+                print(f"Queue: {q['queue']}")
+                print(f"  acl-submit-job: {q['acl_submit_job']}"
+                      + ("" if q["acls_enabled"] else " (acls disabled)"))
+                print(f"  acl-administer-jobs: {q['acl_administer_jobs']}")
+                print(f"  jobs: {q['running_jobs']} running / "
+                      f"{q['total_jobs']} total")
+            return 0
+        if cmd == "-info":
+            if not rest:
+                print(usage, file=sys.stderr)
+                return 255
+            queue, *flags = rest
+            info = next((q for q in client.call("get_queue_info")
+                         if q["queue"] == queue), None)
+            if info is None:
+                print(f"queue {queue!r} is not defined", file=sys.stderr)
+                return 1
+            print(json.dumps(info, indent=2))
+            if "-showJobs" in flags:
+                for jid in client.call("get_queue_jobs", queue):
+                    # per-job view ACLs may hide a status from this
+                    # caller; the queue listing itself must still
+                    # complete (the id is queue metadata, not job data)
+                    try:
+                        state = client.call("get_job_status",
+                                            jid).get("state")
+                    except RpcError:
+                        state = "(not viewable)"
+                    print(f"{jid}\t{state}")
+            return 0
+        if cmd == "-showacls":
+            from tpumr.security import UserGroupInformation
+            me = UserGroupInformation.get_current_user().user
+            print(f"Queue acls for user: {me}")
+            for row in client.call("get_queue_acls", me):
+                ops = ",".join(row["operations"]) or "(none)"
+                print(f"  {row['queue']}: {ops}")
+            return 0
+    except RpcError as e:
+        print(f"queue: {e}", file=sys.stderr)
+        return 1
+    print(usage, file=sys.stderr)
+    return 255
+
+
+def cmd_mradmin(conf, argv: list[str]) -> int:
+    """≈ bin/hadoop mradmin: -refreshQueues re-reads queue names + ACLs
+    (mapred.queue.acls.file) on the live JobTracker without a restart
+    (AdminOperationsProtocol.refreshQueues). Admin-gated when ACLs are
+    enforced."""
+    from tpumr.ipc.rpc import RpcError
+    usage = "Usage: tpumr mradmin -refreshQueues"
+    if argv != ["-refreshQueues"]:
+        # strict: silently ignoring a trailing flag would report an
+        # operation as done that never ran
+        print(usage, file=sys.stderr)
+        return 255
+    client = _jt_client(conf)
+    if client is None:
+        return 255
+    from tpumr.security import UserGroupInformation
+    me = UserGroupInformation.get_current_user().user
+    try:
+        queues = client.call("refresh_queues", me)
+    except RpcError as e:
+        print(f"mradmin: {e}", file=sys.stderr)
+        return 1
+    print(f"Queues refreshed: {', '.join(queues)}")
+    return 0
+
+
+def cmd_daemonlog(conf, argv: list[str]) -> int:
+    """≈ bin/hadoop daemonlog: get/set a live daemon's logger level
+    through its status HTTP server (/json/logLevel ≈ the LogLevel
+    servlet). Works against ANY tpumr daemon's HTTP port."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+    usage = ("Usage: tpumr daemonlog -getlevel HOST:PORT LOGGER | "
+             "-setlevel HOST:PORT LOGGER LEVEL")
+    if len(argv) < 3 or argv[0] not in ("-getlevel", "-setlevel") \
+            or (argv[0] == "-setlevel" and len(argv) < 4):
+        print(usage, file=sys.stderr)
+        return 255
+    hostport, logger = argv[1], argv[2]
+    params = {"log": "" if logger == "root" else logger}
+    if argv[0] == "-setlevel":
+        params["level"] = argv[3]
+    url = (f"http://{hostport}/json/logLevel?"
+           f"{urllib.parse.urlencode(params)}")
+    try:
+        # level mutation must travel as POST (the server rejects GET
+        # sets so drive-by GETs can't silence a daemon's logging)
+        req = urllib.request.Request(
+            url, method="POST" if "level" in params else "GET")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        # the server reports rejected levels/loggers as a JSON error
+        # body — surface its message, not a bare "HTTP Error 500"
+        try:
+            detail = json.loads(e.read().decode("utf-8")).get("error", e)
+        except ValueError:
+            detail = e
+        print(f"daemonlog: {detail}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"daemonlog: {hostport}: {e}", file=sys.stderr)
+        return 1
+    if "error" in body:
+        print(f"daemonlog: {body['error']}", file=sys.stderr)
+        return 1
+    print(f"{body['log']}: level={body['level']} "
+          f"effective={body['effective']}")
+    return 0
+
+
+def cmd_fetchdt(conf, argv: list[str]) -> int:
+    """≈ bin/hadoop fetchdt TOKEN_FILE: fetch a NameNode delegation
+    token into a credential file — an alias for
+    ``tpumr keys token -nn -out FILE``."""
+    if len(argv) != 1:
+        print("Usage: tpumr fetchdt TOKEN_FILE", file=sys.stderr)
+        return 255
+    return cmd_keys(conf, ["token", "-nn", "-out", argv[0]])
+
+
 def cmd_version(conf, argv: list[str]) -> int:
     print(f"tpumr {VERSION}")
     return 0
@@ -673,6 +835,10 @@ COMMANDS = {
     "rumen": cmd_rumen,
     "examples": cmd_examples,
     "keys": cmd_keys,
+    "queue": cmd_queue,
+    "mradmin": cmd_mradmin,
+    "daemonlog": cmd_daemonlog,
+    "fetchdt": cmd_fetchdt,
     "version": cmd_version,
 }
 

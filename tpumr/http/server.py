@@ -68,6 +68,32 @@ def progress_bar(fraction: float) -> RawHtml:
                    f"{pct:.0f}%")
 
 
+def _log_level(query: "dict[str, str]") -> dict:
+    """/json/logLevel?log=NAME[&level=LEVEL] — read or set a logger's
+    level at runtime (≈ LogLevel.Servlet: same get/set semantics, JSON
+    instead of HTML). Empty/omitted ``log`` addresses the root logger.
+    The server only routes the ``level`` mutation here on POST — a GET
+    (browser, <img> drive-by, monitoring scrape) can never change a
+    daemon's logging, unlike the reference servlet."""
+    import logging
+    name = query.get("log", "")
+    logger = logging.getLogger(name) if name else logging.getLogger()
+    if "level" in query:
+        level = query["level"].upper()
+        # str->int mapping check that exists on 3.10 (getLevelName
+        # returns the int for a known name, "Level X" otherwise)
+        if not isinstance(logging.getLevelName(level), int):
+            raise ValueError(
+                f"unknown level {query['level']!r}; try DEBUG, INFO, "
+                f"WARNING, ERROR or CRITICAL")
+        logger.setLevel(level)
+    return {"log": name or "root",
+            "level": (logging.getLevelName(logger.level)
+                      if logger.level else "UNSET"),
+            "effective": logging.getLevelName(
+                logger.getEffectiveLevel())}
+
+
 class StatusHttpServer:
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: int = 0) -> None:
@@ -75,6 +101,8 @@ class StatusHttpServer:
         self._handlers: dict[str, Handler] = {}
         self._pages: dict[str, PageHandler] = {}
         self._parameterized: set[str] = set()
+        #: endpoint -> query param whose presence requires POST
+        self._mutating_param: dict[str, str] = {}
         #: pages that need query params (not linked from the nav)
         self._page_params: set[str] = set()
         outer = self
@@ -86,18 +114,35 @@ class StatusHttpServer:
             def do_GET(self) -> None:
                 outer._serve(self)
 
+            def do_POST(self) -> None:
+                # POST exists solely for mutating endpoints (logLevel
+                # set); handlers read params from the query string
+                # either way
+                outer._serve(self)
+
         self._server = ThreadingHTTPServer((host, port), _Req)
         self._thread: threading.Thread | None = None
+        # every daemon gets the log-level endpoint ≈ the reference's
+        # org.apache.hadoop.log.LogLevel servlet on every HttpServer
+        # (bin/hadoop daemonlog -getlevel/-setlevel)
+        self.add_json("logLevel", _log_level, parameterized=True,
+                      mutating_param="level")
 
     # ------------------------------------------------------------ wiring
 
     def add_json(self, path: str, handler: Handler,
-                 parameterized: bool = False) -> None:
+                 parameterized: bool = False,
+                 mutating_param: "str | None" = None) -> None:
         """Register ``/json/<path>``. ``parameterized`` endpoints require
-        query args — the dashboard links them but doesn't invoke them."""
+        query args — the dashboard links them but doesn't invoke them.
+        ``mutating_param`` names a query arg whose presence makes the
+        request a MUTATION: such requests are rejected on GET (405) so a
+        browser/drive-by GET can never change daemon state."""
         self._handlers[path] = handler
         if parameterized:
             self._parameterized.add(path)
+        if mutating_param is not None:
+            self._mutating_param[path] = mutating_param
 
     def add_page(self, path: str, handler: PageHandler,
                  parameterized: bool = False) -> None:
@@ -150,6 +195,15 @@ class StatusHttpServer:
                            self._page(path.lstrip("/"), query), "text/html")
             elif path.startswith("/json/"):
                 name = path[len("/json/"):]
+                mut = self._mutating_param.get(name)
+                if mut is not None and mut in query \
+                        and req.command != "POST":
+                    self._send(req, 405, json.dumps(
+                        {"error": f"{name}: mutating requests "
+                                  f"({mut}=...) require POST "
+                                  f"(GET is read-only)"}),
+                        "application/json")
+                    return
                 handler = self._handlers.get(name)
                 if handler is None:
                     self._send(req, 404, json.dumps(

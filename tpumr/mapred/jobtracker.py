@@ -458,6 +458,74 @@ class JobMaster:
         with self.lock:
             return sorted(self.jobs)
 
+    def get_queue_info(self) -> "list[dict]":
+        """Per-queue summary ≈ ``bin/hadoop queue -list`` (JobClient.
+        getQueues → JobQueueInfo): name, ACL specs, and job counts
+        attributed by each job's ``mapred.job.queue.name``."""
+        from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
+        qm = self.queue_manager
+        with self.lock:
+            per_queue: dict[str, dict] = {}
+            for jip in self.jobs.values():
+                q = str(jip.conf.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
+                        or DEFAULT_QUEUE)
+                c = per_queue.setdefault(q, {"running": 0, "total": 0})
+                c["total"] += 1
+                # a terminal-but-unfinalized job still counts as
+                # running — get_job_status masks that window as RUNNING
+                # and the two surfaces must agree about the same job
+                if (jip.status_dict()["state"] not in JobState.TERMINAL
+                        or not jip.finalized.is_set()):
+                    c["running"] += 1
+        out = []
+        for q in qm.queues():
+            counts = per_queue.get(q, {"running": 0, "total": 0})
+            out.append({
+                "queue": q,
+                "acl_submit_job": qm.acl_spec(q, "submit-job"),
+                "acl_administer_jobs": qm.acl_spec(q, "administer-jobs"),
+                "acls_enabled": qm.acls_enabled,
+                "running_jobs": counts["running"],
+                "total_jobs": counts["total"],
+            })
+        return out
+
+    def get_queue_jobs(self, queue: str) -> "list[str]":
+        """Job ids submitted to one queue (``queue -info Q -showJobs``)."""
+        from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
+        with self.lock:
+            return sorted(
+                jid for jid, jip in self.jobs.items()
+                if str(jip.conf.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
+                       or DEFAULT_QUEUE) == queue)
+
+    def get_queue_acls(self, user: str = "") -> "list[dict]":
+        """The CALLER's operations per queue ≈ JobClient.
+        getQueueAclsForCurrentUser (``queue -showacls``). Identity
+        resolution matches submit/kill: verified rpc identity wins,
+        else the asserted name (anonymous under require.verified)."""
+        return self.queue_manager.operations_for(self._acl_caller(user))
+
+    def refresh_queues(self, user: str = "") -> "list[str]":
+        """Re-read queue names + ACLs without a restart ≈
+        AdminOperationsProtocol.refreshQueues (``mradmin``). Gated on
+        cluster administrators whenever ACLs are enforced; with ACLs
+        off the cluster is open by definition and any caller may
+        refresh (same trust stance as every other open-cluster op).
+        Raises (so the CLI reports it) if the configured ACL file is
+        unreadable — a failed refresh must never half-apply."""
+        from tpumr.mapred.queue_manager import QueueManager
+        ugi = self._acl_caller(user)
+        qm = self.queue_manager
+        if qm.acls_enabled and not qm.is_admin(ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} is not a cluster administrator "
+                f"(mapred.cluster.administrators)")
+        fresh = QueueManager(self.conf)   # re-reads mapred.queue.acls.file
+        with self.lock:
+            self.queue_manager = fresh
+        return fresh.queues()
+
     def _job_acl_allows(self, jip: JobInProgress, op: str, ugi) -> bool:
         """The JobACLsManager ladder (reference src/mapred/.../
         JobACLsManager.java + ACLsManager.checkAccess): owner, cluster

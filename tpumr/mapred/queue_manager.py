@@ -60,8 +60,27 @@ class AccessControlList:
                 or any(g in self.groups for g in ugi.groups))
 
 
+#: optional separate hot-reloadable ACL file ≈ conf/mapred-queue-acls.xml
+#: (the reference loads queue ACLs from their own resource so
+#: ``mradmin -refreshQueues`` can re-read them without a restart)
+ACLS_FILE_KEY = "mapred.queue.acls.file"
+
+
 class QueueManager:
     def __init__(self, conf: Any) -> None:
+        acls_file = conf.get(ACLS_FILE_KEY)
+        if acls_file:
+            # overlay the file as the TOPMOST resource layer: its keys
+            # beat the daemon's startup resources (so a refresh takes
+            # effect) but not explicit set()/-D overrides. Re-reading
+            # happens by rebuilding the QueueManager (JobMaster.
+            # refresh_queues ≈ AdminOperationsProtocol.refreshQueues).
+            from tpumr.core.configuration import Configuration
+            eff = Configuration(conf)
+            eff.add_resource(str(acls_file))   # OSError -> caller; a
+            # misconfigured ACL file must fail loudly, never silently
+            # fall back to whatever the stale conf says
+            conf = eff
         self.conf = conf
         explicit = conf.get(QUEUE_NAMES_KEY)
         names = str(explicit if explicit is not None
@@ -87,6 +106,23 @@ class QueueManager:
     def queues(self) -> "list[str]":
         return list(self.queue_names)
 
+    def acl_spec(self, queue: str, op: str) -> str:
+        """The raw ACL spec string for display (``tpumr queue -list`` ≈
+        jobqueue_details.jsp's scheduling-info column)."""
+        spec = self.conf.get(f"mapred.queue.{queue}.acl-{op}")
+        return "*" if spec is None else str(spec)
+
+    def operations_for(self, ugi: UserGroupInformation) -> "list[dict]":
+        """Per-queue operations this user may perform — the payload of
+        ``tpumr queue -showacls`` (≈ JobClient.getQueueAclsForCurrentUser
+        → QueueManager.getQueueAcls)."""
+        out = []
+        for q in self.queue_names:
+            ops = [op for op in ("submit-job", "administer-jobs")
+                   if self.has_access(q, op, ugi)]
+            out.append({"queue": q, "operations": ops})
+        return out
+
     def _acl(self, queue: str, op: str) -> AccessControlList:
         spec = self.conf.get(f"mapred.queue.{queue}.acl-{op}")
         # unset = open, the reference's default (QueueManager.java: a
@@ -95,12 +131,18 @@ class QueueManager:
 
     # ------------------------------------------------------------- checks
 
+    def is_admin(self, ugi: UserGroupInformation) -> bool:
+        """Cluster administrator (``mapred.cluster.administrators``) —
+        the identity tier above every queue ACL, and the gate for
+        admin RPCs (refresh_queues ≈ AdminOperationsProtocol)."""
+        return self._admins.allows(ugi)
+
     def has_access(self, queue: str, op: str,
                    ugi: UserGroupInformation) -> bool:
         """op ∈ {"submit-job", "administer-jobs"}."""
         if not self.acls_enabled:
             return True
-        if self._admins.allows(ugi):
+        if self.is_admin(ugi):
             return True
         return self._acl(queue, op).allows(ugi)
 
