@@ -12,7 +12,8 @@ _tpumr_complete() {
     prev="${COMP_WORDS[COMP_CWORD-1]}"
     cmds="namenode datanode secondarynamenode jobtracker tasktracker \
 historyserver fs job balancer fsck dfsadmin pipes streaming examples \
-distcp archive rumen failmon gridmix version"
+distcp archive rumen failmon gridmix keys queue mradmin daemonlog \
+fetchdt version"
 
     if [[ ${COMP_CWORD} -eq 1 ]]; then
         COMPREPLY=( $(compgen -W "${cmds}" -- "${cur}") )

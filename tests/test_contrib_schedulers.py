@@ -424,3 +424,35 @@ class TestCapacityMemoryMatching:
         sched = make_capacity([job])
         tasks = sched.assign_tasks(tracker_status(cpu=2, tpu=0, reduce=0))
         assert len(tasks) == 2  # no memory report = matching off
+
+
+def test_priority_orders_within_pool_and_queue():
+    """Within one pool (fair) a HIGH job drains before an
+    earlier-submitted NORMAL one; the capacity scheduler honors
+    priority only when supports-priority is enabled (the reference's
+    opt-in, default off)."""
+    for make, kv in ((make_fair, {}),
+                     (make_capacity,
+                      {"tpumr.capacity.supports-priority": True})):
+        j1 = make_pool_job("p", 1, n_maps=2)
+        j2 = make_pool_job("p", 2, n_maps=2)
+        j2.priority = "HIGH"
+        sched = make([j1, j2], **kv)
+        order = [str(t.attempt_id.task.job)
+                 for t in sched.assign_tasks(tracker_status(cpu=4, tpu=0))
+                 if t.is_map]
+        assert order[:2] == ["job_test_0002"] * 2, (make.__name__, order)
+
+
+def test_capacity_priority_off_by_default():
+    """Without supports-priority, within-queue order stays submit time
+    (reference default: mapred.capacity-scheduler...supports-priority
+    = false)."""
+    j1 = make_pool_job("p", 1, n_maps=2)
+    j2 = make_pool_job("p", 2, n_maps=2)
+    j2.priority = "HIGH"
+    sched = make_capacity([j1, j2])
+    order = [str(t.attempt_id.task.job)
+             for t in sched.assign_tasks(tracker_status(cpu=4, tpu=0))
+             if t.is_map]
+    assert order[:2] == ["job_test_0001"] * 2, order

@@ -209,3 +209,144 @@ class TestQueueCli:
                        "-refreshQueues"])
         assert rc == 0
         assert "Queues refreshed: default, prod" in capsys.readouterr().out
+
+class TestSetJobPriority:
+    def test_owner_sets_priority_others_denied(self, master):
+        jid = submit(master, "alice")
+        assert master.set_job_priority(jid, "high", "alice") == "HIGH"
+        st = master.get_job_status(jid)
+        assert st["priority"] == "HIGH"
+        # opsuser holds prod's administer ACL -> allowed
+        assert master.set_job_priority(jid, "LOW", "opsuser") == "LOW"
+        with pytest.raises(PermissionError, match="cannot administer"):
+            master.set_job_priority(jid, "NORMAL", "mallory")
+        with pytest.raises(ValueError, match="unknown job priority"):
+            master.set_job_priority(jid, "URGENT", "alice")
+
+    def test_cli_set_priority(self, master, capsys, monkeypatch):
+        from tpumr.cli import main as cli_main
+        jid = submit(master, "alice")
+        host, port = master.address
+        monkeypatch.setattr(
+            "tpumr.security.UserGroupInformation.get_current_user",
+            staticmethod(lambda: ugi("alice")))
+        rc = cli_main(["-jt", f"{host}:{port}", "job", "-set-priority",
+                       jid, "VERY_HIGH"])
+        assert rc == 0
+        assert "to VERY_HIGH" in capsys.readouterr().out
+        rc = cli_main(["-jt", f"{host}:{port}", "job", "-list"])
+        assert rc == 0
+        assert "VERY_HIGH" in capsys.readouterr().out
+
+
+class TestRefreshNodes:
+    def test_excluded_host_refused_at_contact(self, tmp_path):
+        """≈ DisallowedTaskTrackerException at initial contact."""
+        excl = tmp_path / "exclude.txt"
+        excl.write_text("badhost\n")
+        conf = JobConf()
+        conf.set("mapred.hosts.exclude", str(excl))
+        m = JobMaster(conf).start()
+        try:
+            resp = m.heartbeat({"tracker_name": "t1", "host": "badhost",
+                                "task_statuses": []}, True, True, 0)
+            assert resp["actions"] == [{"type": "disallowed"}]
+            assert "t1" not in m.trackers
+            resp = m.heartbeat({"tracker_name": "t2", "host": "goodhost",
+                                "task_statuses": []}, True, True, 0)
+            assert {"type": "disallowed"} not in resp["actions"]
+            assert "t2" in m.trackers
+        finally:
+            m.stop()
+
+    def test_include_list_admits_only_listed(self, tmp_path):
+        inc = tmp_path / "include.txt"
+        inc.write_text("# comment\nnodeA\n")
+        conf = JobConf()
+        conf.set("mapred.hosts", str(inc))
+        m = JobMaster(conf).start()
+        try:
+            ok = m.heartbeat({"tracker_name": "a", "host": "nodeA",
+                              "task_statuses": []}, True, True, 0)
+            assert {"type": "disallowed"} not in ok["actions"]
+            no = m.heartbeat({"tracker_name": "b", "host": "nodeB",
+                              "task_statuses": []}, True, True, 0)
+            assert no["actions"] == [{"type": "disallowed"}]
+        finally:
+            m.stop()
+
+    def test_refresh_nodes_evicts_live_tracker(self, tmp_path):
+        """Operator adds a host to the exclude file, runs
+        -refreshNodes: the registered tracker is evicted and later
+        heartbeats are refused."""
+        excl = tmp_path / "exclude.txt"
+        excl.write_text("")
+        conf = JobConf()
+        conf.set("mapred.hosts.exclude", str(excl))
+        m = JobMaster(conf).start()
+        try:
+            m.heartbeat({"tracker_name": "t1", "host": "node1",
+                         "shuffle_port": 1, "task_statuses": []},
+                        True, True, 0)
+            assert "t1" in m.trackers
+            excl.write_text("node1\n")
+            r = m.refresh_nodes()
+            assert r["evicted_trackers"] == ["t1"]
+            assert "t1" not in m.trackers
+            resp = m.heartbeat({"tracker_name": "t1", "host": "node1",
+                                "task_statuses": []}, False, True, 1)
+            assert resp["actions"] == [{"type": "disallowed"}]
+        finally:
+            m.stop()
+
+    def test_refresh_nodes_admin_gated(self, master):
+        with pytest.raises(PermissionError, match="administrator"):
+            master.refresh_nodes("alice")
+        r = master.refresh_nodes("root0")
+        assert r["included"] == "*" and r["excluded"] == []
+
+    def test_disallowed_noderunner_shuts_down(self, tmp_path):
+        """End-to-end through a real NodeRunner: after -refreshNodes
+        excludes its host, the next heartbeat returns 'disallowed' and
+        the tracker stops heartbeating (the reference TaskTracker's
+        shutdown on DisallowedTaskTrackerException)."""
+        import time
+
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        excl = tmp_path / "exclude.txt"
+        excl.write_text("")
+        conf = JobConf()
+        conf.set("mapred.hosts.exclude", str(excl))
+        cluster = MiniMRCluster(num_trackers=1, conf=conf,
+                                cpu_slots=1, tpu_slots=0,
+                                hosts=["nodeX"])
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline and not cluster.master.trackers:
+                time.sleep(0.05)
+            assert cluster.master.trackers
+            excl.write_text("nodeX\n")
+            cluster.master.refresh_nodes()
+            tracker = cluster.trackers[0]
+            deadline = time.time() + 5
+            while time.time() < deadline and not tracker._stop.is_set():
+                time.sleep(0.05)
+            assert tracker._stop.is_set(), \
+                "NodeRunner should stop after being disallowed"
+            assert not cluster.master.trackers
+        finally:
+            cluster.shutdown()
+
+    def test_hosts_file_indented_comment_ignored(self, tmp_path):
+        inc = tmp_path / "include.txt"
+        inc.write_text("   # managed by config mgmt\n")
+        conf = JobConf()
+        conf.set("mapred.hosts", str(inc))
+        m = JobMaster(conf).start()
+        try:
+            # comment-only include file = empty = admit all
+            r = m.heartbeat({"tracker_name": "t", "host": "any",
+                             "task_statuses": []}, True, True, 0)
+            assert {"type": "disallowed"} not in r["actions"]
+        finally:
+            m.stop()

@@ -248,3 +248,35 @@ class TestTaskMemoryManager:
             assert proc.poll() is None
         finally:
             proc.kill()
+
+
+class TestRecoveryPriority:
+    def test_restart_preserves_runtime_priority_change(self, tmp_path):
+        """A `job -set-priority` survives master restart: recovery
+        replays the JOB_PRIORITY_CHANGED history event into the
+        resubmitted conf (without it, the recovered job would silently
+        revert to its submit-time priority)."""
+        from tpumr.mapred.jobtracker import JobMaster
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        jm = JobMaster(conf).start()
+        try:
+            jid = jm.submit_job(
+                {"mapred.job.name": "bumped", "mapred.reduce.tasks": 0},
+                [{"locations": []}])
+            assert jm.jobs[jid].priority == "NORMAL"
+            jm.set_job_priority(jid, "VERY_HIGH", "anyone")
+        finally:
+            jm.stop()
+
+        conf2 = JobConf()
+        conf2.set("tpumr.history.dir", str(tmp_path))
+        conf2.set("mapred.jobtracker.restart.recover", True)
+        jm2 = JobMaster(conf2).start()
+        try:
+            recovered = [j for j in jm2.jobs.values()
+                         if j.conf.get("mapred.job.name") == "bumped"]
+            assert len(recovered) == 1
+            assert recovered[0].priority == "VERY_HIGH"
+        finally:
+            jm2.stop()

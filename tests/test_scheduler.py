@@ -278,3 +278,31 @@ def test_within_job_convergence_timeline():
     # accel=10, capacity 1x2 -> starvation from pending<20: nearly the
     # whole job after the first profiled wave goes TPU
     assert tail >= 10, (placements, tail)
+
+
+def test_priority_reorders_fifo_queue():
+    """≈ JobQueueJobInProgressListener's FIFO comparator: priority
+    outranks submit order, and set_job_priority reorders a live queue
+    (hadoop job -set-priority)."""
+    j1 = make_job(n_maps=2, job_num=1, kernel=False)
+    j2 = make_job(n_maps=2, job_num=2, kernel=False)
+    j2.priority = "HIGH"
+    sched = make_scheduler([j1, j2])
+    tasks = sched.assign_tasks(tracker_status(cpu=4, tpu=0))
+    order = [str(t.attempt_id.task.job) for t in tasks if t.is_map]
+    # HIGH j2 drains before NORMAL j1 despite submitting second
+    assert order[:2] == ["job_test_0002"] * 2
+    assert all(j == "job_test_0001" for j in order[2:])
+
+
+def test_priority_from_conf_and_validation():
+    import pytest
+
+    from tpumr.mapred.job_in_progress import normalize_priority
+    j = make_job(job_num=3)
+    assert j.priority == "NORMAL"
+    conf = {"mapred.reduce.tasks": 0, "mapred.job.priority": "very_low"}
+    jlow = JobInProgress(JobID("test", 4), conf, [{"locations": []}])
+    assert jlow.priority == "VERY_LOW"
+    with pytest.raises(ValueError, match="unknown job priority"):
+        normalize_priority("URGENT")

@@ -51,7 +51,7 @@ Clients:
                        [-out FILE] | renew FILE | cancel FILE
   fetchdt TOKEN_FILE   fetch a NameNode delegation token (= keys token -nn)
   queue ...            queue info: -list | -info Q [-showJobs] | -showacls
-  mradmin -refreshQueues   re-read queue names/ACLs on the live JobTracker
+  mradmin -refreshQueues|-refreshNodes   live-reload queue ACLs / host lists
   daemonlog ...        -getlevel H:P LOGGER | -setlevel H:P LOGGER LEVEL
   version              print the version
 """
@@ -235,6 +235,7 @@ def cmd_job(conf, argv: list[str]) -> int:
     secret, scope = client_credentials(conf, "jobtracker")
     client = RpcClient(host, port, secret=secret, scope=scope)
     usage = ("Usage: tpumr job -list | -status ID | -kill ID | "
+             "-set-priority ID PRIO | "
              "-counters ID | -events ID | -history ID [HISTORY_DIR]")
     if not argv:
         print(usage, file=sys.stderr)
@@ -248,6 +249,7 @@ def cmd_job(conf, argv: list[str]) -> int:
             for jid in client.call("list_jobs"):
                 st = client.call("get_job_status", jid)
                 print(f"{jid}\t{st.get('state')}"
+                      f"\t{st.get('priority', 'NORMAL')}"
                       f"\tmaps={st.get('map_progress'):.2f}"
                       f"\treduces={st.get('reduce_progress'):.2f}")
             return 0
@@ -270,6 +272,17 @@ def cmd_job(conf, argv: list[str]) -> int:
             for ev in client.call("get_map_completion_events",
                                   rest[0], 0, 100):
                 print(ev)
+            return 0
+        if cmd == "-set-priority":
+            if len(rest) < 2:
+                print("Usage: tpumr job -set-priority ID "
+                      "VERY_HIGH|HIGH|NORMAL|LOW|VERY_LOW",
+                      file=sys.stderr)
+                return 255
+            from tpumr.security import UserGroupInformation
+            p = client.call("set_job_priority", rest[0], rest[1],
+                            UserGroupInformation.get_current_user().user)
+            print(f"Changed job priority of {rest[0]} to {p}")
             return 0
     except RpcError as e:
         print(f"job {cmd}: {e}", file=sys.stderr)
@@ -728,13 +741,18 @@ def cmd_queue(conf, argv: list[str]) -> int:
 
 
 def cmd_mradmin(conf, argv: list[str]) -> int:
-    """≈ bin/hadoop mradmin: -refreshQueues re-reads queue names + ACLs
-    (mapred.queue.acls.file) on the live JobTracker without a restart
-    (AdminOperationsProtocol.refreshQueues). Admin-gated when ACLs are
-    enforced."""
+    """≈ bin/hadoop mradmin (AdminOperationsProtocol), admin-gated when
+    ACLs are enforced:
+
+    - ``-refreshQueues``: re-read queue names + ACLs
+      (mapred.queue.acls.file) on the live JobTracker, no restart.
+    - ``-refreshNodes``: re-read mapred.hosts / mapred.hosts.exclude;
+      trackers on newly excluded hosts are evicted (their work
+      re-queues like a lost tracker's).
+    """
     from tpumr.ipc.rpc import RpcError
-    usage = "Usage: tpumr mradmin -refreshQueues"
-    if argv != ["-refreshQueues"]:
+    usage = "Usage: tpumr mradmin -refreshQueues | -refreshNodes"
+    if argv not in (["-refreshQueues"], ["-refreshNodes"]):
         # strict: silently ignoring a trailing flag would report an
         # operation as done that never ran
         print(usage, file=sys.stderr)
@@ -745,11 +763,20 @@ def cmd_mradmin(conf, argv: list[str]) -> int:
     from tpumr.security import UserGroupInformation
     me = UserGroupInformation.get_current_user().user
     try:
-        queues = client.call("refresh_queues", me)
+        if argv == ["-refreshQueues"]:
+            queues = client.call("refresh_queues", me)
+            print(f"Queues refreshed: {', '.join(queues)}")
+        else:
+            r = client.call("refresh_nodes", me)
+            inc = r["included"]
+            print(f"Nodes refreshed: include="
+                  f"{inc if inc == '*' else ','.join(inc) or '(none)'} "
+                  f"exclude={','.join(r['excluded']) or '(none)'}")
+            for name in r["evicted_trackers"]:
+                print(f"  evicted: {name}")
     except RpcError as e:
         print(f"mradmin: {e}", file=sys.stderr)
         return 1
-    print(f"Queues refreshed: {', '.join(queues)}")
     return 0
 
 

@@ -10,6 +10,9 @@ Config:
   tpumr.capacity.queues                 = default,prod,adhoc
   tpumr.capacity.<queue>.capacity       = percent of cluster slots (int)
   tpumr.capacity.<queue>.max-capacity   = elastic ceiling percent (optional)
+  tpumr.capacity[.<queue>].supports-priority = honor job priority within
+                                          the queue (default false, the
+                                          reference's default)
 
 Queues most below their guaranteed capacity are offered slots first;
 within a queue, FIFO. Map and reduce passes each rank against their own
@@ -29,7 +32,8 @@ from __future__ import annotations
 from typing import Callable
 
 from tpumr.mapred.job_in_progress import JobInProgress
-from tpumr.mapred.scheduler import HybridQueueScheduler
+from tpumr.mapred.scheduler import (HybridQueueScheduler,
+                                    _priority_fifo)
 
 QUEUE_KEY = "mapred.job.queue.name"
 _PHANTOM = "\x00undefined"  # bucket for jobs naming a queue not configured
@@ -105,8 +109,21 @@ class CapacityScheduler(HybridQueueScheduler):
                 running = sum(running_of(j) for j in members)
                 if running >= ceiling * slot_total:
                     continue
-            out.extend(sorted(members, key=lambda j: j.start_time))
+            # within-queue priority order is OPT-IN, matching the
+            # reference's supports-priority default (off -> submit
+            # order): mapred.capacity-scheduler...supports-priority
+            if self._supports_priority(name):
+                out.extend(_priority_fifo(members))
+            else:
+                out.extend(sorted(members, key=lambda j: j.start_time))
         return out
+
+    def _supports_priority(self, queue: str) -> bool:
+        assert self.conf is not None
+        v = self.conf.get(f"tpumr.capacity.{queue}.supports-priority")
+        if v is None:
+            v = self.conf.get("tpumr.capacity.supports-priority", False)
+        return str(v).lower() in ("true", "1")
 
     def _map_job_order(self, jobs: list[JobInProgress]) -> list[JobInProgress]:
         return self._order(jobs, JobInProgress.running_map_count,

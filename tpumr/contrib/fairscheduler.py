@@ -28,7 +28,8 @@ import time
 from typing import Any, Callable
 
 from tpumr.mapred.job_in_progress import JobInProgress, JobState
-from tpumr.mapred.scheduler import HybridQueueScheduler
+from tpumr.mapred.scheduler import (HybridQueueScheduler,
+                                    _priority_fifo)
 
 POOL_KEY = "mapred.fairscheduler.pool"
 
@@ -169,7 +170,7 @@ class FairScheduler(HybridQueueScheduler):
 
         out: list[JobInProgress] = []
         for _name, members in sorted(pools.items(), key=pool_rank):
-            out.extend(sorted(members, key=lambda j: j.start_time))
+            out.extend(_priority_fifo(members))
         return out
 
     def _map_job_order(self, jobs: list[JobInProgress]) -> list[JobInProgress]:

@@ -78,6 +78,7 @@ class JobHistory:
         for path in sorted(glob.glob(os.path.join(self.dir, "*.jsonl"))):
             submitted = None
             finished = False
+            priority = None
             for ev in self.read(path):
                 kind = ev.get("event")
                 if kind == "JOB_SUBMITTED":
@@ -85,8 +86,15 @@ class JobHistory:
                 elif kind in ("JOB_FINISHED", "JOB_RECOVERED",
                               "JOB_RECOVERY_FAILED"):
                     finished = True
+                elif kind == "JOB_PRIORITY_CHANGED":
+                    priority = ev.get("priority")
             if submitted is not None and not finished \
                     and submitted.get("conf") is not None:
+                if priority:
+                    # replay runtime priority changes into the conf the
+                    # recovery resubmits — a restart must not silently
+                    # revert `job -set-priority`
+                    submitted["conf"]["mapred.job.priority"] = priority
                 out.append(submitted)
         return out
 

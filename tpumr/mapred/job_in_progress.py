@@ -40,6 +40,25 @@ class JobState:
     TERMINAL = {SUCCEEDED, FAILED, KILLED}
 
 
+#: ≈ mapred/JobPriority.java — ordinal order is scheduling order
+JOB_PRIORITIES = ("VERY_HIGH", "HIGH", "NORMAL", "LOW", "VERY_LOW")
+
+
+def normalize_priority(value: Any) -> str:
+    """Validate/canonicalize a priority name (case-insensitive; the
+    reference's JobPriority.valueOf raises on unknowns — so do we)."""
+    p = str(value).upper()
+    if p not in JOB_PRIORITIES:
+        raise ValueError(f"unknown job priority {value!r}; one of "
+                         f"{', '.join(JOB_PRIORITIES)}")
+    return p
+
+
+def priority_rank(priority: str) -> int:
+    """Sort key: lower rank schedules first."""
+    return JOB_PRIORITIES.index(priority)
+
+
 @dataclass
 class TaskInProgress:
     """≈ mapred/TaskInProgress.java (condensed): one logical task, its
@@ -89,6 +108,11 @@ class JobInProgress:
         self.slowstart = float(self.conf.get(
             "mapred.reduce.slowstart.completed.maps", 0.05))
         self.speculative = bool(self.conf.get("mapred.speculative.execution", True))
+        # ≈ JobPriority (mapred/JobPriority.java) — FIFO scheduling
+        # sorts by (priority, start time); mutable at runtime via
+        # JobMaster.set_job_priority (hadoop job -set-priority)
+        self.priority = normalize_priority(
+            self.conf.get("mapred.job.priority", "NORMAL"))
         self.error = ""
 
         self.maps = [TaskInProgress(TaskID(job_id, True, i), i, split=s)
@@ -494,6 +518,7 @@ class JobInProgress:
             return {
                 "job_id": str(self.job_id),
                 "state": self.state,
+                "priority": self.priority,
                 "map_progress": self.map_progress(),
                 "reduce_progress": self.reduce_progress(),
                 "finished_maps": self.finished_maps,

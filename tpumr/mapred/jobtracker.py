@@ -34,7 +34,8 @@ from tpumr.ipc.rpc import RpcServer
 from tpumr.mapred.history import JobHistory
 from tpumr.mapred.ids import JobID
 from tpumr.mapred.jobconf import JobConf
-from tpumr.mapred.job_in_progress import JobInProgress, JobState
+from tpumr.mapred.job_in_progress import (JobInProgress, JobState,
+                                          normalize_priority)
 from tpumr.mapred.scheduler import HybridQueueScheduler, TaskScheduler
 from tpumr.mapred.task import TaskState, TaskStatus
 from tpumr.utils.reflection import new_instance
@@ -97,6 +98,10 @@ class JobMaster:
         self._require_verified = conf.get_boolean(
             "tpumr.acls.require.verified", False) \
             if hasattr(conf, "get_boolean") else False
+        # tracker admission lists ≈ mapred.hosts / mapred.hosts.exclude
+        # (JobTracker.hostsReader + DisallowedTaskTrackerException):
+        # one hostname per line, re-read by mradmin -refreshNodes
+        self._hosts_include, self._hosts_exclude = self._read_hosts_lists()
         self._stop = threading.Event()
         self._expire_thread = threading.Thread(
             target=self._expire_loop, name="expire-trackers", daemon=True)
@@ -140,6 +145,51 @@ class JobMaster:
         if self.conf.get_boolean("mapred.jobtracker.restart.recover", False):
             self._recover_jobs()
         return self
+
+    def _read_hosts_lists(self) -> "tuple[set | None, set]":
+        """(include, exclude) host sets from the files named by
+        ``mapred.hosts`` / ``mapred.hosts.exclude``. include=None means
+        no include file → every host may join (the reference's
+        semantics: an EMPTY or absent include list admits all)."""
+        def read(path: Any) -> "set[str]":
+            with open(str(path)) as f:   # unreadable file fails loudly
+                return {s for s in (ln.strip() for ln in f)
+                        if s and not s.startswith("#")}
+        inc_path = self.conf.get("mapred.hosts")
+        exc_path = self.conf.get("mapred.hosts.exclude")
+        include = read(inc_path) if inc_path else None
+        if include is not None and not include:
+            include = None               # empty include file = admit all
+        return include, read(exc_path) if exc_path else set()
+
+    def _host_allowed(self, host: str) -> bool:
+        if host in self._hosts_exclude:
+            return False
+        return self._hosts_include is None or host in self._hosts_include
+
+    def refresh_nodes(self, user: str = "") -> dict:
+        """≈ AdminOperationsProtocol.refreshNodes (mradmin
+        -refreshNodes): re-read the include/exclude files and evict any
+        registered tracker that is no longer admitted — its running
+        attempts and completed map outputs re-queue like a lost
+        tracker's. Admin-gated exactly like refresh_queues."""
+        ugi = self._acl_caller(user)
+        qm = self.queue_manager
+        if qm.acls_enabled and not qm.is_admin(ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} is not a cluster administrator "
+                f"(mapred.cluster.administrators)")
+        include, exclude = self._read_hosts_lists()
+        with self.lock:
+            self._hosts_include, self._hosts_exclude = include, exclude
+            evicted = [n for n, t in self.trackers.items()
+                       if not self._host_allowed(
+                           t.status.get("host", ""))]
+            for name in evicted:
+                self._evict_tracker_locked(name)
+        return {"excluded": sorted(exclude),
+                "included": sorted(include) if include is not None else "*",
+                "evicted_trackers": sorted(evicted)}
 
     def _recover_jobs(self) -> None:
         """Restart recovery ≈ RecoveryManager (JobTracker.java:1203):
@@ -598,6 +648,29 @@ class JobMaster:
             "successful_attempt": t.report.successful_attempt,
         } for t in tips]
 
+    def set_job_priority(self, job_id: str, priority: str,
+                         user: str = "") -> str:
+        """≈ JobTracker.setJobPriority (hadoop job -set-priority): the
+        MODIFY ladder gates it exactly like kill_job (owner / queue
+        admin / cluster admin / acl-modify-job); the FIFO queue re-sorts
+        on the next heartbeat. Returns the canonical priority set."""
+        jip = self._job(job_id)
+        p = normalize_priority(priority)   # raises on unknown names
+        ugi = self._acl_caller(user)
+        if self.queue_manager.acls_enabled and \
+                not self._job_acl_allows(jip, "modify", ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} cannot administer job {jip.job_id}")
+        with jip.lock:
+            jip.priority = p
+            # NOTE: restart survival is handled by the
+            # JOB_PRIORITY_CHANGED replay in history.incomplete_jobs()
+            # — recovery resubmits the conf serialized at submit time,
+            # so mutating jip.conf here could never reach it
+        self.history.task_event(str(jip.job_id), "JOB_PRIORITY_CHANGED",
+                                priority=p, by=ugi.user)
+        return p
+
     def kill_job(self, job_id: str, user: str = "") -> bool:
         jip = self._job(job_id)
         # job-level ACL (≈ JobTracker.killJob → ADMINISTER_JOBS check):
@@ -734,6 +807,14 @@ class JobMaster:
                           name: str, deferred_events: list,
                           deferred_final: list) -> dict:
         with self.lock:
+            if not self._host_allowed(status.get("host", "")):
+                # ≈ DisallowedTaskTrackerException: the tracker's host is
+                # excluded (or absent from a configured include list) —
+                # refuse it; the NodeRunner shuts itself down on this
+                if name in self.trackers:
+                    self._evict_tracker_locked(name)
+                return {"response_id": response_id, "actions":
+                        [{"type": "disallowed"}]}
             info = self.trackers.get(name)
             if info is None and not initial_contact:
                 # ≈ ReinitTrackerAction (JobTracker.java:3358): we don't know
@@ -851,6 +932,26 @@ class JobMaster:
 
     # ------------------------------------------------------------ expiry
 
+    def _evict_tracker_locked(self, name: str) -> None:
+        """Remove one tracker and re-queue everything it owned (running
+        attempts AND completed maps whose outputs lived there) —
+        ≈ JobTracker.lostTaskTracker. Caller holds self.lock."""
+        info = self.trackers.pop(name)
+        self._last_response.pop(name, None)
+        attempts = [sd["attempt_id"] for sd in
+                    info.status.get("task_statuses", [])]
+        addr = (f"{info.status.get('host', '')}:"
+                f"{info.status.get('shuffle_port', 0)}")
+        for jip in self.jobs.values():
+            with jip.lock:
+                owned = [e["attempt_id"]
+                         for e in jip.completion_events
+                         if e["shuffle_addr"] == addr]
+            jip.requeue_lost_attempts(attempts + owned)
+        from tpumr.mapred.ids import TaskAttemptID
+        for aid in attempts:
+            self._revoke_commit(str(TaskAttemptID.parse(aid).task), aid)
+
     def _expire_loop(self) -> None:
         while not self._stop.wait(min(1.0, self.expiry_s / 3)):
             now = time.time()
@@ -859,20 +960,4 @@ class JobMaster:
                 lost = [n for n, t in self.trackers.items()
                         if now - t.last_seen > self.expiry_s]
                 for name in lost:
-                    info = self.trackers.pop(name)
-                    self._last_response.pop(name, None)
-                    attempts = [sd["attempt_id"] for sd in
-                                info.status.get("task_statuses", [])]
-                    addr = (f"{info.status.get('host', '')}:"
-                            f"{info.status.get('shuffle_port', 0)}")
-                    # also re-queue completed maps whose outputs lived there
-                    for jip in self.jobs.values():
-                        with jip.lock:
-                            owned = [e["attempt_id"]
-                                     for e in jip.completion_events
-                                     if e["shuffle_addr"] == addr]
-                        jip.requeue_lost_attempts(attempts + owned)
-                    from tpumr.mapred.ids import TaskAttemptID
-                    for aid in attempts:
-                        self._revoke_commit(str(TaskAttemptID.parse(aid).task),
-                                            aid)
+                    self._evict_tracker_locked(name)

@@ -28,7 +28,8 @@ from __future__ import annotations
 import math
 from typing import Any, Protocol
 
-from tpumr.mapred.job_in_progress import JobInProgress, JobState
+from tpumr.mapred.job_in_progress import (JobInProgress, JobState,
+                                          priority_rank)
 from tpumr.mapred.task import Task
 
 
@@ -75,6 +76,14 @@ def _free_tpu_devices(tracker_status: dict) -> list[int]:
     return [i for i, free in enumerate(avail) if free]
 
 
+def _priority_fifo(jobs: list[JobInProgress]) -> list[JobInProgress]:
+    """The reference's FIFO queue order (JobQueueJobInProgressListener.
+    FIFO_JOB_QUEUE_COMPARATOR): priority first, then submit time, then
+    job id — so ``job -set-priority`` reorders the queue live."""
+    return sorted(jobs, key=lambda j: (priority_rank(j.priority),
+                                       j.start_time, str(j.job_id)))
+
+
 class HybridQueueScheduler(TaskScheduler):
     """FIFO job queue + Shirahata hybrid CPU/TPU map placement.
 
@@ -85,11 +94,11 @@ class HybridQueueScheduler(TaskScheduler):
     GPU-blind — SURVEY.md §1 L5)."""
 
     def _map_job_order(self, jobs: list[JobInProgress]) -> list[JobInProgress]:
-        return jobs
+        return _priority_fifo(jobs)
 
     def _reduce_job_order(self,
                           jobs: list[JobInProgress]) -> list[JobInProgress]:
-        return jobs
+        return _priority_fifo(jobs)
 
     def _begin_assignment(self, tts: dict) -> None:
         """Called once per heartbeat before the passes — subclasses cache

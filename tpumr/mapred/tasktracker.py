@@ -480,6 +480,20 @@ class NodeRunner:
                 self.running_tasks.clear()
                 self._initial_contact = True
                 self._response_id = 0
+        elif kind == "disallowed":
+            # ≈ DisallowedTaskTrackerException: this host was excluded
+            # (mapred.hosts/.exclude + mradmin -refreshNodes). The
+            # reference's TaskTracker shuts down; ours stops
+            # heartbeating and kills its local work — an operator must
+            # re-admit the host before restarting the daemon.
+            import logging
+            logging.getLogger(__name__).warning(
+                "master disallowed this tracker (host excluded) — "
+                "shutting down")
+            with self.lock:
+                for aid in list(self.running_tasks):
+                    self._kill_requested.add(aid)
+            self._stop.set()
 
     # ------------------------------------------------------------ execution
 
