@@ -350,3 +350,96 @@ class TestRefreshNodes:
             assert {"type": "disallowed"} not in r["actions"]
         finally:
             m.stop()
+
+
+class TestKillFailTask:
+    def _cluster_job(self, tmp_path, max_attempts=4):
+        """A real mini cluster running one long sleep map."""
+        import os
+        import threading
+        import time as _t
+
+        from tpumr.mapred.job_client import JobClient
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        os.makedirs(f"{tmp_path}/in", exist_ok=True)
+        with open(f"{tmp_path}/in/f.txt", "w") as f:
+            f.write("x\n")
+        cluster = MiniMRCluster(num_trackers=1, cpu_slots=1, tpu_slots=0)
+        conf = JobConf()
+        conf.set_job_name("victim")
+        conf.set_input_paths(f"file://{tmp_path}/in")
+        conf.set_output_path(f"file://{tmp_path}/out")
+        conf.set("mapred.mapper.class",
+                 "tpumr.examples.sleep.SleepMapper")
+        conf.set("tpumr.sleep.map.ms", 8000)
+        conf.set("mapred.reduce.tasks", 0)
+        conf.set("mapred.map.max.attempts", max_attempts)
+        conf.set("mapred.job.tracker", "%s:%d" % cluster.master.address)
+        result = {}
+
+        def _run():
+            try:
+                result["r"] = JobClient(conf).run_job(conf)
+            except RuntimeError as e:   # run_job raises on job failure
+                result["error"] = str(e)
+
+        t = threading.Thread(target=_run)
+        t.start()
+        deadline = _t.time() + 10
+        aid = None
+        while _t.time() < deadline and aid is None:
+            for jip in cluster.master.jobs.values():
+                ids = [a for tip in jip.maps
+                       for a, s in tip.attempts.items()
+                       if s.state == "RUNNING"]
+                if ids:
+                    aid = ids[0]
+            _t.sleep(0.05)
+        assert aid is not None, "no running attempt appeared"
+        return cluster, t, result, aid
+
+    def test_kill_task_requeues_without_burning_attempt(self, tmp_path):
+        import time as _t
+        cluster, t, result, aid = self._cluster_job(tmp_path)
+        try:
+            jip = next(iter(cluster.master.jobs.values()))
+            assert cluster.master.kill_task(aid) is True
+            # unknown attempt (same job) -> False, not an exception
+            assert cluster.master.kill_task(aid[:-1] + "9") is False
+            deadline = _t.time() + 15
+            while _t.time() < deadline and aid not in {
+                    a for tip in jip.maps for a, s in tip.attempts.items()
+                    if s.state in ("KILLED", "FAILED")}:
+                _t.sleep(0.1)
+            states = {a: s.state for tip in jip.maps
+                      for a, s in tip.attempts.items()}
+            assert states[aid] == "KILLED"
+            assert jip.maps[0].failures == 0    # no attempt burned
+            t.join(30)
+            assert result.get("r") is not None and result["r"].successful
+        finally:
+            cluster.shutdown()
+
+    def test_fail_task_counts_and_fails_job_at_limit(self, tmp_path):
+        import time as _t
+        cluster, t, result, aid = self._cluster_job(tmp_path,
+                                                    max_attempts=1)
+        try:
+            assert cluster.master.kill_task(aid, should_fail=True)
+            t.join(30)
+            jip = next(iter(cluster.master.jobs.values()))
+            assert jip.maps[0].failures == 1    # the -fail-task burn
+            assert "error" in result            # limit 1 -> job FAILED
+            assert "failed 1 times" in result["error"]
+        finally:
+            cluster.shutdown()
+
+
+class TestTrackerListings:
+    def test_active_and_attempt_listings(self, master):
+        master.heartbeat({"tracker_name": "tr1", "host": "h1",
+                          "task_statuses": []}, True, True, 0)
+        assert master.get_active_trackers() == ["tr1"]
+        assert master.get_blacklisted_trackers() == []
+        jid = submit(master, "alice")
+        assert master.get_attempt_ids(jid, "map", "running") == []

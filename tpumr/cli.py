@@ -235,13 +235,17 @@ def cmd_job(conf, argv: list[str]) -> int:
     secret, scope = client_credentials(conf, "jobtracker")
     client = RpcClient(host, port, secret=secret, scope=scope)
     usage = ("Usage: tpumr job -list | -status ID | -kill ID | "
-             "-set-priority ID PRIO | "
+             "-set-priority ID PRIO | -kill-task ATTEMPT | "
+             "-fail-task ATTEMPT | -list-attempt-ids ID map|reduce "
+             "running|completed | -list-active-trackers | "
+             "-list-blacklisted-trackers | "
              "-counters ID | -events ID | -history ID [HISTORY_DIR]")
     if not argv:
         print(usage, file=sys.stderr)
         return 255
     cmd, *rest = argv
-    if cmd != "-list" and not rest:
+    if cmd not in ("-list", "-list-active-trackers",
+                   "-list-blacklisted-trackers") and not rest:
         print(usage, file=sys.stderr)
         return 255
     try:
@@ -272,6 +276,30 @@ def cmd_job(conf, argv: list[str]) -> int:
             for ev in client.call("get_map_completion_events",
                                   rest[0], 0, 100):
                 print(ev)
+            return 0
+        if cmd in ("-kill-task", "-fail-task"):
+            from tpumr.security import UserGroupInformation
+            ok = client.call("kill_task", rest[0], cmd == "-fail-task",
+                             UserGroupInformation.get_current_user().user)
+            verb = "Failed" if cmd == "-fail-task" else "Killed"
+            print(f"{verb} task attempt {rest[0]}" if ok else
+                  f"{rest[0]} not running; nothing to do")
+            return 0 if ok else 1
+        if cmd == "-list-attempt-ids":
+            if len(rest) < 3:
+                print(usage, file=sys.stderr)
+                return 255
+            for aid in client.call("get_attempt_ids", rest[0], rest[1],
+                                   rest[2]):
+                print(aid)
+            return 0
+        if cmd == "-list-active-trackers":
+            for name in client.call("get_active_trackers"):
+                print(name)
+            return 0
+        if cmd == "-list-blacklisted-trackers":
+            for name in client.call("get_blacklisted_trackers"):
+                print(name)
             return 0
         if cmd == "-set-priority":
             if len(rest) < 2:

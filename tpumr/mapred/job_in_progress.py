@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any
 
 from tpumr.core.counters import Counters
@@ -151,6 +151,8 @@ class JobInProgress:
         #: attempts a scheduler marked for preemption (kill-not-fail);
         #: cleared when the attempt's terminal status arrives
         self._preempt_requested: set[str] = set()
+        #: attempts whose operator kill must count as FAILED (-fail-task)
+        self._fail_requested: set[str] = set()
         # --- per-backend profiling (running sums, O(1) per update) ---
         self.finished_cpu_maps = 0
         self.finished_tpu_maps = 0
@@ -324,6 +326,35 @@ class JobInProgress:
         with self.lock:
             self._preempt_requested.add(attempt_id)
 
+    def request_attempt_kill(self, attempt_id: str,
+                             fail: bool = False) -> bool:
+        """Operator-driven attempt kill ≈ JobTracker.killTask(taskid,
+        shouldFail) — `job -kill-task` / `-fail-task`. ``fail=True``
+        makes the attempt count toward the task's attempt limit (the
+        -fail-task semantics); plain kill re-queues without burning an
+        attempt. Returns False when the attempt is unknown or already
+        terminal."""
+        with self.lock:
+            tip = self._tip_of_attempt(attempt_id)
+            if tip is None:
+                return False
+            st = tip.attempts.get(attempt_id)
+            if st is None or st.state in TaskState.TERMINAL:
+                # unknown to the master, or already finished — nothing
+                # to kill (the reference's killTask returns false too)
+                return False
+            self._preempt_requested.add(attempt_id)
+            if fail:
+                self._fail_requested.add(attempt_id)
+            return True
+
+    def _tip_of_attempt(self, attempt_id: str) -> "TaskInProgress | None":
+        from tpumr.mapred.ids import TaskAttemptID
+        try:
+            return self._tip_of(TaskAttemptID.parse(attempt_id).task)
+        except (ValueError, KeyError, IndexError):
+            return None
+
     def preempt_pending(self) -> set[str]:
         """Attempts marked but not yet observed terminal (so the scheduler
         does not double-count in-flight preemptions when sizing the next
@@ -378,8 +409,22 @@ class JobInProgress:
             tip = self._tip_of(status.attempt_id.task)
             if tip is None:
                 return
+            aid_s = str(status.attempt_id)
             if status.state in TaskState.TERMINAL:
-                self._preempt_requested.discard(str(status.attempt_id))
+                self._preempt_requested.discard(aid_s)
+                if status.state == TaskState.KILLED \
+                        and aid_s in self._fail_requested:
+                    # -fail-task: the tracker reports the kill as KILLED;
+                    # the operator asked for FAILED semantics (burn an
+                    # attempt) — rewrite before accounting
+                    status = replace(status, state=TaskState.FAILED,
+                                     diagnostics=(status.diagnostics
+                                                  or "failed by operator "
+                                                     "(-fail-task)"))
+                # any terminal outcome clears the fail mark (an attempt
+                # that FAILED or SUCCEEDED on its own must not leak a
+                # stale entry for the life of the job)
+                self._fail_requested.discard(aid_s)
             tip.attempts[str(status.attempt_id)] = status
             tip.report.progress = max(tip.report.progress, status.progress)
             if status.state == TaskState.SUCCEEDED:
@@ -478,7 +523,15 @@ class JobInProgress:
                 self._preempt_requested.discard(aid)
                 st = tip.attempts.get(aid)
                 if st is not None and st.state == TaskState.RUNNING:
-                    st.state = TaskState.KILLED
+                    # honor a pending -fail-task even when the tracker
+                    # died before delivering the kill: the operator asked
+                    # for a burned attempt, not a free requeue
+                    if aid in self._fail_requested:
+                        st.state = TaskState.FAILED
+                        st.diagnostics = (st.diagnostics or
+                                          "failed by operator (-fail-task)")
+                    else:
+                        st.state = TaskState.KILLED
                     self._on_failure(tip, st)
                 elif (tip.is_map and tip.state == "succeeded"
                       and tip.successful_attempt == aid
@@ -500,6 +553,9 @@ class JobInProgress:
                     self.completion_events = [
                         e for e in self.completion_events
                         if e["attempt_id"] != aid]
+                # lost = terminal for this attempt whatever branch ran:
+                # never leak a -fail-task mark for the life of the job
+                self._fail_requested.discard(aid)
 
     def kill(self) -> bool:
         """Transition to KILLED; returns True only for the caller that

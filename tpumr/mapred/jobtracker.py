@@ -671,6 +671,68 @@ class JobMaster:
                                 priority=p, by=ugi.user)
         return p
 
+    def kill_task(self, attempt_id: str, should_fail: bool = False,
+                  user: str = "") -> bool:
+        """≈ JobTracker.killTask(taskid, shouldFail) — `tpumr job
+        -kill-task` / `-fail-task`. Modify-ACL gated like kill_job. The
+        tracker running the attempt receives a kill action on its next
+        heartbeat; with ``should_fail`` the terminal report counts
+        toward the task's attempt limit."""
+        from tpumr.mapred.ids import TaskAttemptID
+        try:
+            job_id = str(TaskAttemptID.parse(attempt_id).task.job)
+        except (ValueError, KeyError, IndexError):
+            return False     # malformed id: nothing to kill, not a crash
+        jip = self._job(job_id)
+        ugi = self._acl_caller(user)
+        if self.queue_manager.acls_enabled and \
+                not self._job_acl_allows(jip, "modify", ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} cannot administer job {jip.job_id}")
+        ok = jip.request_attempt_kill(attempt_id, fail=should_fail)
+        if ok:
+            self.history.task_event(
+                job_id, "TASK_KILL_REQUESTED", attempt_id=attempt_id,
+                should_fail=should_fail, by=ugi.user)
+        return ok
+
+    def get_attempt_ids(self, job_id: str, kind: str = "map",
+                        state: str = "running") -> "list[str]":
+        """≈ `job -list-attempt-ids JOB_ID map|reduce STATE`: attempt
+        ids of one task type filtered by state (running/completed)."""
+        jip = self._job(job_id)
+        self._check_job_op(jip, "view")
+        if kind not in ("map", "reduce") \
+                or state.lower() not in ("running", "completed"):
+            # a typo must be an error, not the OTHER listing with rc=0
+            raise ValueError(
+                f"kind must be map|reduce and state running|completed "
+                f"(got {kind!r}, {state!r})")
+        want_running = state.lower() == "running"
+        out = []
+        with jip.lock:
+            tips = jip.maps if kind == "map" else jip.reduces
+            for tip in tips:
+                for aid, st in tip.attempts.items():
+                    if want_running and st.state == TaskState.RUNNING:
+                        out.append(aid)
+                    elif not want_running \
+                            and st.state == TaskState.SUCCEEDED:
+                        out.append(aid)
+        return sorted(out)
+
+    def get_active_trackers(self) -> "list[str]":
+        """≈ `job -list-active-trackers` (ClusterStatus tracker names)."""
+        with self.lock:
+            return sorted(n for n, t in self.trackers.items()
+                          if not t.blacklisted)
+
+    def get_blacklisted_trackers(self) -> "list[str]":
+        """≈ `job -list-blacklisted-trackers`."""
+        with self.lock:
+            return sorted(n for n, t in self.trackers.items()
+                          if t.blacklisted)
+
     def kill_job(self, job_id: str, user: str = "") -> bool:
         jip = self._job(job_id)
         # job-level ACL (≈ JobTracker.killJob → ADMINISTER_JOBS check):
