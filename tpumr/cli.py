@@ -363,7 +363,8 @@ def cmd_dfsadmin(conf, argv: list[str]) -> int:
     usage = ("Usage: tpumr dfsadmin -setQuota N PATH | -setSpaceQuota N "
              "PATH | -clrQuota PATH | -clrSpaceQuota PATH | "
              "-decommission ADDR start|stop | "
-             "-report | -safemode enter|leave|get | -saveNamespace")
+             "-report | -safemode enter|leave|get | -saveNamespace | "
+             "-refreshServiceAcl")
     if not argv:
         print(usage, file=sys.stderr)
         return 255
@@ -377,6 +378,17 @@ def cmd_dfsadmin(conf, argv: list[str]) -> int:
         return fs, uri
 
     cmd, *rest = argv
+    if cmd == "-refreshServiceAcl" and not rest:
+        from tpumr.ipc.rpc import RpcError
+        fs, _ = dfs()
+        try:
+            for key, spec in fs.client.nn.call(
+                    "refresh_service_acl").items():
+                print(f"{key} = {spec}")
+        except RpcError as e:
+            print(f"dfsadmin: {e}", file=sys.stderr)
+            return 1
+        return 0
     if cmd == "-setQuota" and len(rest) == 2:
         fs, uri = dfs(rest[1])
         fs.client.nn.call("set_quota", fs._p(uri), int(rest[0]), None)
@@ -779,8 +791,10 @@ def cmd_mradmin(conf, argv: list[str]) -> int:
       re-queues like a lost tracker's).
     """
     from tpumr.ipc.rpc import RpcError
-    usage = "Usage: tpumr mradmin -refreshQueues | -refreshNodes"
-    if argv not in (["-refreshQueues"], ["-refreshNodes"]):
+    usage = ("Usage: tpumr mradmin -refreshQueues | -refreshNodes | "
+             "-refreshServiceAcl")
+    if argv not in (["-refreshQueues"], ["-refreshNodes"],
+                    ["-refreshServiceAcl"]):
         # strict: silently ignoring a trailing flag would report an
         # operation as done that never ran
         print(usage, file=sys.stderr)
@@ -794,6 +808,9 @@ def cmd_mradmin(conf, argv: list[str]) -> int:
         if argv == ["-refreshQueues"]:
             queues = client.call("refresh_queues", me)
             print(f"Queues refreshed: {', '.join(queues)}")
+        elif argv == ["-refreshServiceAcl"]:
+            for key, spec in client.call("refresh_service_acl").items():
+                print(f"{key} = {spec}")
         else:
             r = client.call("refresh_nodes", me)
             inc = r["included"]

@@ -1326,6 +1326,29 @@ class FSNamesystem:
             return out
 
 
+#: method → service keys ≈ HDFSPolicyProvider: client ops (incl. the
+#: dfsadmin surface, which rides ClientProtocol in the reference and is
+#: additionally superuser-gated inside the namesystem), DataNode
+#: reporting, and the 2NN/balancer NamenodeProtocol tier
+NAMENODE_POLICY = {
+    m: ["security.datanode.protocol.acl"]
+    for m in ("register_datanode", "dn_heartbeat", "block_report",
+              "block_received")
+}
+NAMENODE_POLICY.update({
+    m: ["security.namenode.protocol.acl"]
+    for m in ("get_name_state", "put_image", "get_blocks",
+              "remove_replica")
+})
+NAMENODE_POLICY["report_bad_block"] = [
+    "security.client.protocol.acl", "security.datanode.protocol.acl"]
+NAMENODE_POLICY["refresh_service_acl"] = [
+    "security.refresh.policy.protocol.acl"]
+NAMENODE_POLICY["get_protocol_version"] = [
+    "security.client.protocol.acl", "security.datanode.protocol.acl",
+    "security.namenode.protocol.acl"]
+
+
 class NameNode:
     """RPC daemon front (≈ NameNode.java): hosts the namesystem plus the
     monitor threads (heartbeat expiry, replication, lease recovery)."""
@@ -1346,6 +1369,11 @@ class NameNode:
         from tpumr.security.tokens import TokenStore
         self.token_store = TokenStore(conf)
         self._server.token_store = self.token_store
+        # service-level authorization ≈ hadoop-policy.xml (off unless
+        # tpumr.security.authorization=true)
+        from tpumr.security.authorize import ServiceAuthorizationManager
+        self._server.authz = ServiceAuthorizationManager(
+            conf, NAMENODE_POLICY, "security.client.protocol.acl")
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="nn-monitors", daemon=True)
@@ -1602,6 +1630,23 @@ class NameNode:
 
     def block_received(self, addr, block_id, size):
         return self.ns.block_received(addr, block_id, size)
+
+    def refresh_service_acl(self) -> dict:
+        """≈ RefreshAuthorizationPolicyProtocol.refreshServiceAcl
+        (dfsadmin -refreshServiceAcl): re-read the policy (incl.
+        tpumr.policy.file) live. The call itself is authorized by
+        security.refresh.policy.protocol.acl; like the reference it
+        refuses when authorization is off (a refresh that silently
+        guards nothing misleads the operator)."""
+        from tpumr.security.authorize import ServiceAuthorizationManager
+        if self._server.authz is None or not self._server.authz.enabled:
+            raise PermissionError(
+                "service authorization is disabled "
+                "(tpumr.security.authorization)")
+        fresh = ServiceAuthorizationManager(
+            self.conf, NAMENODE_POLICY, "security.client.protocol.acl")
+        self._server.authz = fresh
+        return fresh.acl_specs()
 
     def safemode(self, action="get"):
         if action == "leave":

@@ -140,6 +140,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = _recv_frame(sock)
                 secret = server.secret
                 scope = req.get("scope")
+                # defined for every request path: an UNSECURED server
+                # never enters the auth block below, yet the authz hook
+                # still reads these (a scoped frame against a
+                # secret-less daemon must not crash the handler)
+                verified_user = None
+                job_scoped = False
                 if secret is not None:
                     import time as _time
                     sig = req.get("auth")
@@ -159,8 +165,6 @@ class _Handler(socketserver.BaseRequestHandler):
                             "error": "RpcAuthError: stale or missing "
                                      "request timestamp (replay?)"})
                         continue
-                    verified_user = None
-                    job_scoped = False
                     if scope is not None:
                         # Scoped caller. Three scope families, all folded
                         # into the signature canon (no re-labeling):
@@ -208,6 +212,14 @@ class _Handler(socketserver.BaseRequestHandler):
                         raise RpcAuthError(
                             f"method {req.get('method')!r} is not "
                             "available to token-scoped callers")
+                    authz = server.rpc.authz
+                    if authz is not None:
+                        # service-level authorization (hadoop-policy.xml
+                        # tier): who may reach this protocol at all —
+                        # verified identity wins, else the asserted name
+                        authz.check(req.get("method"),
+                                    (verified_user if scope is not None
+                                     else None) or req.get("user"))
                     gate = server.rpc.request_gate
                     if gate is not None and server.secret is not None:
                         gate(req, verified_user if scope is not None
@@ -272,6 +284,10 @@ class RpcServer:
         #: job_scoped)`` raising RpcAuthError to deny (datanode block
         #: access enforcement)
         self.request_gate: "Any | None" = None
+        #: service-level authorization (tpumr.security.authorize.
+        #: ServiceAuthorizationManager) — the hadoop-policy.xml tier;
+        #: None/disabled = every caller may reach every protocol
+        self.authz: "Any | None" = None
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.secret = secret  # type: ignore[attr-defined]
         # expose hooks on the socketserver instance for _Handler

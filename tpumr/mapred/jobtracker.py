@@ -43,6 +43,34 @@ from tpumr.utils.reflection import new_instance
 #: ≈ InterTrackerProtocol versionID 29 (InterTrackerProtocol.java:75)
 PROTOCOL_VERSION = 29
 
+#: method → service keys ≈ MapReducePolicyProvider (reference:
+#: security.job.submission / inter.tracker / task.umbilical /
+#: admin.operations / refresh.policy .protocol.acl). Unmapped methods
+#: default to the job-submission (client) key.
+JOBTRACKER_POLICY = {
+    "heartbeat": ["security.inter.tracker.protocol.acl"],
+    "get_job_conf": ["security.inter.tracker.protocol.acl",
+                     "security.job.submission.protocol.acl"],
+    "get_job_token": ["security.inter.tracker.protocol.acl"],
+    # trackers RELAY the umbilical surface for their children (and call
+    # get_job_status in the purge loop), so the inter-tracker identity
+    # must reach these too — a restricted umbilical/submission ACL must
+    # never break commit grants, completion events, or job purging
+    "can_commit": ["security.task.umbilical.protocol.acl",
+                   "security.inter.tracker.protocol.acl"],
+    "get_map_completion_events": ["security.task.umbilical.protocol.acl",
+                                  "security.inter.tracker.protocol.acl",
+                                  "security.job.submission.protocol.acl"],
+    "get_job_status": ["security.inter.tracker.protocol.acl",
+                       "security.job.submission.protocol.acl"],
+    "refresh_queues": ["security.admin.operations.protocol.acl"],
+    "refresh_nodes": ["security.admin.operations.protocol.acl"],
+    "refresh_service_acl": ["security.refresh.policy.protocol.acl"],
+    "get_protocol_version": ["security.job.submission.protocol.acl",
+                             "security.inter.tracker.protocol.acl",
+                             "security.task.umbilical.protocol.acl"],
+}
+
 
 class _TrackerInfo:
     def __init__(self, status: dict) -> None:
@@ -91,6 +119,12 @@ class JobMaster:
         from tpumr.security.tokens import TokenStore
         self.token_store = TokenStore(conf)
         self._server.token_store = self.token_store
+        # service-level authorization ≈ hadoop-policy.xml (off unless
+        # tpumr.security.authorization=true)
+        from tpumr.security.authorize import ServiceAuthorizationManager
+        self._server.authz = ServiceAuthorizationManager(
+            conf, JOBTRACKER_POLICY,
+            "security.job.submission.protocol.acl")
         #: require cryptographically verified identity (user key or
         #: delegation token) for ACL-relevant identity claims — with it
         #: off (default), cluster-secret assertions keep working (the
@@ -575,6 +609,22 @@ class JobMaster:
         with self.lock:
             self.queue_manager = fresh
         return fresh.queues()
+
+    def refresh_service_acl(self) -> dict:
+        """≈ RefreshAuthorizationPolicyProtocol.refreshServiceAcl
+        (mradmin -refreshServiceAcl) — authorized by
+        security.refresh.policy.protocol.acl; refuses when service
+        authorization is off, like the reference."""
+        from tpumr.security.authorize import ServiceAuthorizationManager
+        if self._server.authz is None or not self._server.authz.enabled:
+            raise PermissionError(
+                "service authorization is disabled "
+                "(tpumr.security.authorization)")
+        fresh = ServiceAuthorizationManager(
+            self.conf, JOBTRACKER_POLICY,
+            "security.job.submission.protocol.acl")
+        self._server.authz = fresh
+        return fresh.acl_specs()
 
     def _job_acl_allows(self, jip: JobInProgress, op: str, ugi) -> bool:
         """The JobACLsManager ladder (reference src/mapred/.../
