@@ -887,3 +887,99 @@ class TestStreamedTransfer:
                 time.sleep(0.1)
             assert not _os.path.exists(tmp), "stale upload never purged"
             assert 987654 not in dn._uploads
+
+
+class TestDfsRefreshNodes:
+    """≈ FSNamesystem.refreshNodes: dfs.hosts / dfs.hosts.exclude drive
+    datanode admission and decommissioning (dfsadmin -refreshNodes)."""
+
+    def test_exclude_starts_drain_and_unexclude_stops(self, tmp_path):
+        excl = tmp_path / "dfs-exclude.txt"
+        excl.write_text("")
+        conf = small_conf()
+        conf.set("dfs.hosts.exclude", str(excl))
+        with MiniDFSCluster(num_datanodes=2, conf=conf,
+                            root=str(tmp_path / "c")) as c:
+            addr = c.datanodes[0].addr
+            excl.write_text(addr.split(":")[0] + "\n")
+            r = c.namenode.ns.refresh_nodes()
+            # both datanodes share 127.0.0.1, so both start draining —
+            # host-granular lists, like the reference's
+            assert all(v == "decommissioning"
+                       for v in r["changed"].values())
+            assert c.namenode.ns.decommissioning
+            excl.write_text("")
+            r = c.namenode.ns.refresh_nodes()
+            assert all(v == "in-service" for v in r["changed"].values())
+            assert not c.namenode.ns.decommissioning
+
+    def test_refresh_without_lists_keeps_manual_drains(self, tmp_path):
+        """Documented divergence: with NO hosts files configured, a
+        refresh must not cancel addr-keyed manual drains."""
+        with MiniDFSCluster(num_datanodes=2, conf=small_conf(),
+                            root=str(tmp_path / "c")) as c:
+            addr = c.datanodes[0].addr
+            c.namenode.ns.set_decommission(addr, "start")
+            r = c.namenode.ns.refresh_nodes()
+            assert r["changed"] == {}
+            assert c.namenode.ns.decommissioning.get(addr) \
+                == "decommissioning"
+
+    def test_not_in_include_refused_at_registration(self, tmp_path):
+        inc = tmp_path / "dfs-include.txt"
+        inc.write_text("allowedhost\n")
+        conf = small_conf()
+        conf.set("dfs.hosts", str(inc))
+        from tpumr.dfs.namenode import NameNode
+        nn = NameNode(str(tmp_path / "name"), conf).start()
+        try:
+            with pytest.raises(PermissionError, match="not in the "
+                               "dfs.hosts include"):
+                nn.ns.register_datanode("127.0.0.1:7777", 1 << 20)
+            nn.ns.register_datanode("allowedhost:7777", 1 << 20)
+            assert "allowedhost:7777" in nn.ns.datanodes
+        finally:
+            nn.stop()
+
+    def test_excluded_host_registers_then_drains(self, tmp_path):
+        excl = tmp_path / "dfs-exclude.txt"
+        excl.write_text("drainhost\n")
+        conf = small_conf()
+        conf.set("dfs.hosts.exclude", str(excl))
+        from tpumr.dfs.namenode import NameNode
+        nn = NameNode(str(tmp_path / "name"), conf).start()
+        try:
+            nn.ns.register_datanode("drainhost:7777", 1 << 20)
+            assert nn.ns.decommissioning.get("drainhost:7777") \
+                == "decommissioning"
+        finally:
+            nn.stop()
+
+    def test_hosts_file_reference_grammar(self, tmp_path):
+        """HostsFileReader grammar: whitespace-separated tokens, a
+        '#' token ends its line."""
+        from tpumr.utils.hostsfile import read_hosts_file
+        p = tmp_path / "hosts.txt"
+        p.write_text("hostA hostB\nhostC  # drained 2026-07\n"
+                     "# full comment line\n  hostD\n")
+        assert read_hosts_file(p) == {"hostA", "hostB", "hostC", "hostD"}
+
+    def test_dead_mid_drain_node_never_marked_decommissioned(self,
+                                                             tmp_path):
+        """A dead decommissioning node must not flip to 'decommissioned'
+        on refresh — its blocks were never confirmed safe."""
+        inc = tmp_path / "dfs-include.txt"
+        inc.write_text("someotherhost\n")
+        conf = small_conf()
+        from tpumr.dfs.namenode import NameNode
+        nn = NameNode(str(tmp_path / "name"), conf).start()
+        try:
+            # a drain recorded for a node that is NOT registered (died)
+            nn.ns.set_decommission("deadhost:1234", "start")
+            conf.set("dfs.hosts", str(inc))
+            r = nn.ns.refresh_nodes()
+            assert "deadhost:1234" not in r["changed"]
+            assert nn.ns.decommissioning["deadhost:1234"] \
+                == "decommissioning"
+        finally:
+            nn.stop()

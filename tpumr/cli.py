@@ -364,7 +364,7 @@ def cmd_dfsadmin(conf, argv: list[str]) -> int:
              "PATH | -clrQuota PATH | -clrSpaceQuota PATH | "
              "-decommission ADDR start|stop | "
              "-report | -safemode enter|leave|get | -saveNamespace | "
-             "-refreshServiceAcl")
+             "-refreshNodes | -refreshServiceAcl")
     if not argv:
         print(usage, file=sys.stderr)
         return 255
@@ -378,6 +378,21 @@ def cmd_dfsadmin(conf, argv: list[str]) -> int:
         return fs, uri
 
     cmd, *rest = argv
+    if cmd == "-refreshNodes" and not rest:
+        from tpumr.ipc.rpc import RpcError
+        fs, _ = dfs()
+        try:
+            r = fs.client.nn.call("refresh_nodes")
+        except RpcError as e:
+            print(f"dfsadmin: {e}", file=sys.stderr)
+            return 1
+        inc = r["included"]
+        print(f"Nodes refreshed: include="
+              f"{inc if inc == '*' else ','.join(inc) or '(none)'} "
+              f"exclude={','.join(r['excluded']) or '(none)'}")
+        for addr, state in sorted(r["changed"].items()):
+            print(f"  {addr}: {state}")
+        return 0
     if cmd == "-refreshServiceAcl" and not rest:
         from tpumr.ipc.rpc import RpcError
         fs, _ = dfs()

@@ -916,11 +916,26 @@ class FSNamesystem:
         # rack resolution may exec the operator script — never under the
         # namesystem lock (a slow script would stall the control plane)
         rack = self.topology.add(addr)
+        # admission check (may lazily read the hosts files) outside the
+        # lock, like rack resolution above; the cached include/exclude
+        # sets are replaced atomically by refresh_nodes
+        admission = self._dn_admission(addr)
         with self.lock:
+            if admission == "refuse":
+                # ≈ DisallowedDatanodeException: host absent from a
+                # configured dfs.hosts include list
+                raise PermissionError(
+                    f"datanode {addr} is not in the dfs.hosts include "
+                    f"list; registration refused")
             self.datanodes[addr] = {"addr": addr, "capacity": capacity,
                                     "used": 0, "last_seen": _now(),
                                     "blocks": 0, "rack": rack}
             self.commands.setdefault(addr, [])
+            if admission == "drain" and addr not in self.decommissioning:
+                # excluded hosts register and immediately start draining
+                # (verifyNodeRegistration's "registered but being
+                # decommissioned" case)
+                self._log_decommission(addr, "decommissioning")
 
     def dn_heartbeat(self, addr: str, used: int, capacity: int,
                      block_count: int) -> list[dict]:
@@ -1083,6 +1098,72 @@ class FSNamesystem:
         # counters may have been swapped by a checkpoint reload: re-bind
         self.decommissioning = self.counters.setdefault(
             "decommissioning", {})
+
+    def refresh_nodes(self) -> dict:
+        """≈ FSNamesystem.refreshNodes (dfsadmin -refreshNodes):
+        re-read ``dfs.hosts`` / ``dfs.hosts.exclude`` and reconcile
+        every known DataNode — removed-from-include ⇒ decommissioned
+        outright; newly excluded ⇒ start draining; removed from exclude
+        ⇒ stop draining. The stop case only applies when at least one
+        hosts file is configured: an operator draining nodes via
+        ``-decommission ADDR start`` (our addr-keyed alternative the
+        reference lacks) must not have the drain silently canceled by a
+        refresh against NO lists — a deliberate, documented divergence.
+        Registration of disallowed hosts is refused
+        (≈ verifyNodeRegistration / DisallowedDatanodeException)."""
+        from tpumr.utils.hostsfile import read_hosts_lists
+        # file I/O BEFORE the namesystem lock (same principle as rack
+        # resolution in register_datanode: a slow NFS-mounted hosts
+        # file must not stall every namespace RPC)
+        include, exclude = read_hosts_lists(
+            self.conf, "dfs.hosts", "dfs.hosts.exclude")
+        with self.lock:
+            self._check_superuser("refresh datanode admission lists")
+            self._dn_include, self._dn_exclude = include, exclude
+            # "configured" = the operator manages admission via FILES
+            # (key set, even if currently empty — emptying the exclude
+            # file is exactly how the reference un-drains everything);
+            # only with NO keys do manual addr-keyed drains survive
+            configured = bool(self.conf.get("dfs.hosts")) \
+                or bool(self.conf.get("dfs.hosts.exclude"))
+            changed: dict[str, str] = {}
+            for addr in list(self.datanodes) + list(self.decommissioning):
+                host = addr.split(":")[0]
+                state = self.decommissioning.get(addr)
+                if include is not None and host not in include:
+                    # case 2 — but never flip a DEAD mid-drain node to
+                    # "decommissioned": its blocks were not confirmed
+                    # safe elsewhere (the decommission_check invariant)
+                    if state != "decommissioned" \
+                            and addr in self.datanodes:
+                        self._log_decommission(addr, "decommissioned")
+                        changed[addr] = "decommissioned"
+                elif host in exclude:
+                    if state is None:                    # case 3
+                        self._log_decommission(addr, "decommissioning")
+                        changed[addr] = "decommissioning"
+                elif configured and state is not None:   # case 4
+                    self._log_decommission(addr, None)
+                    changed[addr] = "in-service"
+            return {"included": (sorted(include) if include is not None
+                                 else "*"),
+                    "excluded": sorted(exclude),
+                    "changed": changed}
+
+    def _dn_admission(self, addr: str) -> str:
+        """'refuse' (not in a configured include list), 'drain' (in the
+        exclude list — registers, then decommissions, the reference's
+        verifyNodeRegistration contract), or 'ok'."""
+        if not hasattr(self, "_dn_include"):
+            from tpumr.utils.hostsfile import read_hosts_lists
+            self._dn_include, self._dn_exclude = read_hosts_lists(
+                self.conf, "dfs.hosts", "dfs.hosts.exclude")
+        host = addr.split(":")[0]
+        if self._dn_include is not None and host not in self._dn_include:
+            return "refuse"
+        if host in self._dn_exclude:
+            return "drain"
+        return "ok"
 
     def set_decommission(self, addr: str, action: str = "start") -> str:
         """Admin: start/stop draining a DataNode (≈ dfsadmin exclude +
@@ -1630,6 +1711,9 @@ class NameNode:
 
     def block_received(self, addr, block_id, size):
         return self.ns.block_received(addr, block_id, size)
+
+    def refresh_nodes(self):
+        return self.ns.refresh_nodes()
 
     def refresh_service_acl(self) -> dict:
         """≈ RefreshAuthorizationPolicyProtocol.refreshServiceAcl

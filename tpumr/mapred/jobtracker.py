@@ -181,20 +181,11 @@ class JobMaster:
         return self
 
     def _read_hosts_lists(self) -> "tuple[set | None, set]":
-        """(include, exclude) host sets from the files named by
-        ``mapred.hosts`` / ``mapred.hosts.exclude``. include=None means
-        no include file → every host may join (the reference's
-        semantics: an EMPTY or absent include list admits all)."""
-        def read(path: Any) -> "set[str]":
-            with open(str(path)) as f:   # unreadable file fails loudly
-                return {s for s in (ln.strip() for ln in f)
-                        if s and not s.startswith("#")}
-        inc_path = self.conf.get("mapred.hosts")
-        exc_path = self.conf.get("mapred.hosts.exclude")
-        include = read(inc_path) if inc_path else None
-        if include is not None and not include:
-            include = None               # empty include file = admit all
-        return include, read(exc_path) if exc_path else set()
+        """``mapred.hosts`` / ``mapred.hosts.exclude`` host sets
+        (≈ HostsFileReader; include=None admits all)."""
+        from tpumr.utils.hostsfile import read_hosts_lists
+        return read_hosts_lists(self.conf, "mapred.hosts",
+                                "mapred.hosts.exclude")
 
     def _host_allowed(self, host: str) -> bool:
         if host in self._hosts_exclude:

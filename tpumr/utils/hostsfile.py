@@ -1,0 +1,40 @@
+"""Include/exclude host list files ≈ the reference's ``HostsFileReader``
+(src/core/org/apache/hadoop/util/HostsFileReader.java): one hostname
+per line, ``#`` comments, re-read by the refreshNodes admin ops of both
+masters (``mapred.hosts[.exclude]`` on the JobTracker,
+``dfs.hosts[.exclude]`` on the NameNode)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def read_hosts_file(path: Any) -> "set[str]":
+    """Hostname entries of one file — whitespace-separated tokens, a
+    ``#`` token ending its line (the reference HostsFileReader's
+    grammar, so ported files parse identically: ``hostA hostB`` and
+    ``hostC  # drained 2026-07`` both work). Unreadable files raise (a
+    misconfigured admission list must fail loudly, never silently admit
+    everyone)."""
+    out: "set[str]" = set()
+    with open(str(path)) as f:
+        for ln in f:
+            for tok in ln.split():
+                if tok.startswith("#"):
+                    break                # comment: rest of line ignored
+                out.add(tok)
+    return out
+
+
+def read_hosts_lists(conf: Any, include_key: str,
+                     exclude_key: str) -> "tuple[set | None, set]":
+    """(include, exclude) from the files named by the two conf keys.
+    include=None means no include file → every host may join (the
+    reference's semantics: an EMPTY or absent include list admits
+    all)."""
+    inc_path = conf.get(include_key)
+    exc_path = conf.get(exclude_key)
+    include = read_hosts_file(inc_path) if inc_path else None
+    if include is not None and not include:
+        include = None           # empty include file = admit all
+    return include, read_hosts_file(exc_path) if exc_path else set()
