@@ -207,3 +207,187 @@ class TestClusterUnderRestrictedPolicy:
             assert JobClient(jc).run_job(jc).successful
         finally:
             cluster.shutdown()
+
+
+class TestProxyUsers:
+    """≈ ProxyUsers.authorize: hadoop.proxyuser.<real>.groups/.hosts
+    gate impersonation (doas); both rules required, default closed."""
+
+    def _conf(self, **kv):
+        conf = JobConf()
+        for k, v in kv.items():
+            conf.set(k, v)
+        return conf
+
+    def test_authorize_rules(self):
+        from tpumr.security.authorize import authorize_proxy
+        conf = self._conf(**{
+            "hadoop.proxyuser.svc.groups": "webusers",
+            "hadoop.proxyuser.svc.hosts": "127.0.0.1",
+            "tpumr.user.groups.alice": "webusers",
+            "tpumr.user.groups.carol": "admins"})
+        authorize_proxy(conf, "svc", "alice", "127.0.0.1")
+        with pytest.raises(AuthorizationError, match="not allowed to "
+                           "impersonate"):
+            authorize_proxy(conf, "svc", "carol", "127.0.0.1")  # group
+        with pytest.raises(AuthorizationError, match="Unauthorized "
+                           "connection"):
+            authorize_proxy(conf, "svc", "alice", "10.0.0.9")   # host
+        with pytest.raises(AuthorizationError):
+            authorize_proxy(conf, "other", "alice", "127.0.0.1")  # no rules
+
+    def test_star_wildcards(self):
+        from tpumr.security.authorize import authorize_proxy
+        conf = self._conf(**{"hadoop.proxyuser.svc.groups": "*",
+                             "hadoop.proxyuser.svc.hosts": "*"})
+        authorize_proxy(conf, "svc", "anyone", "10.9.9.9")
+
+    def test_doas_over_rpc_lands_as_effective_user(self):
+        """End-to-end: a doas submit is ACL-checked and owned as the
+        effective user; the real caller is auditable."""
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", "s3")
+        conf.set("mapred.acls.enabled", True)
+        conf.set("mapred.queue.names", "prod")
+        conf.set("mapred.queue.prod.acl-submit-job", "alice")
+        conf.set("hadoop.proxyuser.svc.groups", "webusers")
+        conf.set("hadoop.proxyuser.svc.hosts", "127.0.0.1")
+        conf.set("tpumr.user.groups.alice", "webusers")
+        m = JobMaster(conf).start()
+        try:
+            host, port = m.address
+            c = RpcClient(host, port, secret=b"s3")
+            c._scope_user = "svc"
+            c.doas = "alice"
+            jid = c.call("submit_job",
+                         {"mapred.job.queue.name": "prod",
+                          "user.name": "alice",
+                          "mapred.reduce.tasks": 0},
+                         [{"locations": []}])
+            assert jid in m.list_jobs()
+            # svc directly (no doas) cannot pass alice's submit ACL
+            c2 = RpcClient(host, port, secret=b"s3")
+            c2._scope_user = "svc"
+            with pytest.raises(RpcError, match="cannot submit"):
+                c2.call("submit_job",
+                        {"mapred.job.queue.name": "prod",
+                         "user.name": "svc",
+                         "mapred.reduce.tasks": 0},
+                        [{"locations": []}])
+            # an unauthorized impersonation target is refused
+            c3 = RpcClient(host, port, secret=b"s3")
+            c3._scope_user = "svc"
+            c3.doas = "carol"
+            with pytest.raises(RpcError, match="impersonate"):
+                c3.call("list_jobs")
+        finally:
+            m.stop()
+
+    def test_doas_rejected_without_proxy_conf(self):
+        from tpumr.ipc.rpc import RpcServer
+
+        class H:
+            def ping(self):
+                return "pong"
+
+        srv = RpcServer(H(), secret=b"k")
+        srv.proxy_conf = None
+        srv.start()
+        try:
+            c = RpcClient(*srv.address, secret=b"k")
+            c.doas = "anyone"
+            with pytest.raises(RpcError, match="not enabled"):
+                c.call("ping")
+        finally:
+            srv.stop()
+
+    def test_doas_signature_binds(self):
+        """Tampering the doas field after signing must fail auth."""
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", "s4")
+        conf.set("hadoop.proxyuser.svc.groups", "*")
+        conf.set("hadoop.proxyuser.svc.hosts", "*")
+        m = JobMaster(conf).start()
+        try:
+            host, port = m.address
+            c = RpcClient(host, port, secret=b"s4")
+            c._scope_user = "svc"
+            c.doas = "alice"
+            assert c.call("list_jobs") == []
+            # flip doas post-signing via the envelope hook
+            c2 = RpcClient(host, port, secret=b"s4")
+            c2._scope_user = "svc"
+            c2.doas = "alice"
+            orig = c2._stamp
+
+            def tamper(req):
+                orig(req)
+                req["doas"] = "root0"   # after the signature
+            c2._stamp = tamper
+            from tpumr.ipc.rpc import RpcAuthError
+            with pytest.raises((RpcError, RpcAuthError)):
+                c2.call("list_jobs")
+        finally:
+            m.stop()
+
+    def test_empty_doas_rejected(self):
+        """Empty doas must never resolve to the daemon's own identity."""
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", "s5")
+        conf.set("hadoop.proxyuser.svc.groups", "*")
+        conf.set("hadoop.proxyuser.svc.hosts", "*")
+        m = JobMaster(conf).start()
+        try:
+            from tpumr.ipc.rpc import RpcAuthError
+            host, port = m.address
+            c = RpcClient(host, port, secret=b"s5")
+            c._scope_user = "svc"
+            c.doas = ""
+            with pytest.raises((RpcError, RpcAuthError),
+                               match="invalid doas"):
+                c.call("list_jobs")
+        finally:
+            m.stop()
+
+    def test_doas_with_verified_real_caller(self):
+        """The mode where doas is the ONLY route: a personal-key
+        (verified) caller cannot assert another identity, but CAN act
+        as one through authorized impersonation — and the job lands
+        owned by the effective user even under require.verified."""
+        from tpumr.security.tokens import derive_user_key
+        conf = JobConf()
+        conf.set("tpumr.rpc.secret", "s6")
+        conf.set("tpumr.acls.require.verified", True)
+        conf.set("mapred.acls.enabled", True)
+        conf.set("mapred.queue.names", "prod")
+        conf.set("mapred.queue.prod.acl-submit-job", "alice")
+        conf.set("hadoop.proxyuser.svc.groups", "webusers")
+        conf.set("hadoop.proxyuser.svc.hosts", "127.0.0.1")
+        conf.set("tpumr.user.groups.alice", "webusers")
+        m = JobMaster(conf).start()
+        try:
+            host, port = m.address
+            svc_key = derive_user_key(b"s6", "svc")
+            # verified svc WITHOUT doas: its own identity fails the ACL
+            c = RpcClient(host, port, secret=svc_key, scope="user:svc")
+            with pytest.raises(RpcError, match="cannot submit"):
+                c.call("submit_job",
+                       {"mapred.job.queue.name": "prod",
+                        "user.name": "svc", "mapred.reduce.tasks": 0},
+                       [{"locations": []}])
+            # verified svc WITH doas=alice: authorized impersonation
+            c2 = RpcClient(host, port, secret=svc_key, scope="user:svc")
+            c2.doas = "alice"
+            jid = c2.call("submit_job",
+                          {"mapred.job.queue.name": "prod",
+                           "user.name": "alice",
+                           "mapred.reduce.tasks": 0},
+                          [{"locations": []}])
+            assert jid in m.list_jobs()
+            # ...and an unauthorized target stays refused
+            c3 = RpcClient(host, port, secret=svc_key, scope="user:svc")
+            c3.doas = "carol"
+            with pytest.raises(RpcError, match="impersonate"):
+                c3.call("list_jobs")
+        finally:
+            m.stop()

@@ -1455,6 +1455,9 @@ class NameNode:
         from tpumr.security.authorize import ServiceAuthorizationManager
         self._server.authz = ServiceAuthorizationManager(
             conf, NAMENODE_POLICY, "security.client.protocol.acl")
+        # impersonation rules (hadoop.proxyuser.*) are consulted from
+        # the daemon conf; without this, doas frames are rejected
+        self._server.proxy_conf = conf
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="nn-monitors", daemon=True)

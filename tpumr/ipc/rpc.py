@@ -66,6 +66,12 @@ def current_rpc_scope() -> "str | None":
     return getattr(_current_user, "scope", None)
 
 
+def current_rpc_real_user() -> "str | None":
+    """The REAL (credentialed) caller behind an impersonated request
+    (≈ UGI.getRealUser) — None when the request is not proxied."""
+    return getattr(_current_user, "real", None)
+
+
 def current_rpc_verified() -> bool:
     """True when the RPC being dispatched proved its user identity
     cryptographically — signed with the caller's personal user key or a
@@ -86,10 +92,18 @@ def _sign(secret: bytes, req: dict, port: int, nonce: str) -> str:
     must be fresh, and the server tracks a per-client high-water request
     id within the connection's lifetime. The token scope is part of the
     canon so a scoped frame cannot be re-labeled."""
-    canon = serialize([req.get("cid"), req.get("id"), req.get("method"),
-                       list(req.get("params", [])), req.get("ts"), port,
-                       nonce, req.get("user"), req.get("scope")])
-    return hmac.new(secret, canon, "sha256").hexdigest()
+    base = [req.get("cid"), req.get("id"), req.get("method"),
+            list(req.get("params", [])), req.get("ts"), port,
+            nonce, req.get("user"), req.get("scope")]
+    if req.get("doas") is not None:
+        # appended ONLY when impersonating, so non-doas signers (incl.
+        # the native libtdfs client, which builds the 9-element canon)
+        # stay wire-compatible. Still tamper-proof in both directions:
+        # the serialized list length differs, so adding doas to an
+        # unsigned-for-doas frame — or stripping it from a signed one —
+        # changes the canon and breaks the HMAC.
+        base.append(req["doas"])
+    return hmac.new(secret, serialize(base), "sha256").hexdigest()
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -212,29 +226,62 @@ class _Handler(socketserver.BaseRequestHandler):
                         raise RpcAuthError(
                             f"method {req.get('method')!r} is not "
                             "available to token-scoped callers")
+                    real_user = (verified_user if scope is not None
+                                 else None) or req.get("user")
+                    effective_user = real_user
+                    doas = req.get("doas")
+                    if doas is not None and (
+                            not isinstance(doas, str) or not doas.strip()):
+                        # an empty/garbage effective identity resolves
+                        # downstream to the DAEMON's own process user —
+                        # an escalation, not an impersonation
+                        raise RpcAuthError("invalid doas identity")
+                    if doas is not None:
+                        # impersonation ≈ ProxyUsers.authorize: the
+                        # REAL caller's credential signed this frame
+                        # (doas is in the canon); the proxy rules decide
+                        # whether it may act as the effective user
+                        proxy_conf = server.rpc.proxy_conf
+                        if proxy_conf is None:
+                            raise RpcAuthError(
+                                "impersonation is not enabled on this "
+                                "daemon")
+                        from tpumr.security.authorize import \
+                            authorize_proxy
+                        authorize_proxy(proxy_conf, str(real_user),
+                                        str(doas),
+                                        sock.getpeername()[0])
+                        effective_user = doas
                     authz = server.rpc.authz
                     if authz is not None:
                         # service-level authorization (hadoop-policy.xml
                         # tier): who may reach this protocol at all —
-                        # verified identity wins, else the asserted name
-                        authz.check(req.get("method"),
-                                    (verified_user if scope is not None
-                                     else None) or req.get("user"))
+                        # checked against the EFFECTIVE identity (the
+                        # reference authorizes the proxy UGI)
+                        authz.check(req.get("method"), effective_user)
                     gate = server.rpc.request_gate
                     if gate is not None and server.secret is not None:
                         gate(req, verified_user if scope is not None
                              else None,
                              job_scoped if scope is not None else False)
                     method = server.lookup(req["method"])
-                    _current_user.user = req.get("user")
+                    # handlers see the EFFECTIVE identity; the real
+                    # caller stays available for audit
+                    # (current_rpc_real_user ≈ UGI.getRealUser)
+                    _current_user.user = effective_user
+                    _current_user.real = real_user if doas is not None \
+                        else None
                     _current_user.scope = scope if server.secret is not None \
                         else None
+                    # a proxied identity is only as verified as the
+                    # REAL credential behind it
                     _current_user.verified = (server.secret is not None
                                               and verified_user is not None)
                     try:
                         resp["result"] = method(*req.get("params", []))
                     finally:
                         _current_user.user = None
+                        _current_user.real = None
                         _current_user.scope = None
                         _current_user.verified = False
                 except Exception as e:  # noqa: BLE001 — remote surface
@@ -288,6 +335,10 @@ class RpcServer:
         #: ServiceAuthorizationManager) — the hadoop-policy.xml tier;
         #: None/disabled = every caller may reach every protocol
         self.authz: "Any | None" = None
+        #: conf consulted for hadoop.proxyuser.* impersonation rules;
+        #: None (default) rejects every doas frame — impersonation is
+        #: strictly opt-in per daemon
+        self.proxy_conf: "Any | None" = None
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.secret = secret  # type: ignore[attr-defined]
         # expose hooks on the socketserver instance for _Handler
@@ -445,6 +496,10 @@ class RpcClient:
         #: match, so deriving it anywhere else just manufactures
         #: unexplainable auth failures
         self._scope_user: "str | None" = None
+        #: impersonation: when set, every request carries doas=<name>
+        #: and the server enforces hadoop.proxyuser.<real>.* rules
+        #: (≈ UserGroupInformation.createProxyUser + doAs)
+        self.doas: "str | None" = None
         if isinstance(scope, str):
             if scope.startswith("user:"):
                 self._scope_user = scope[len("user:"):]
@@ -531,6 +586,8 @@ class RpcClient:
                    "params": list(params), "user": user}
             if self.scope is not None:
                 req["scope"] = self.scope
+            if self.doas is not None:
+                req["doas"] = self.doas
             if self.envelope_provider is not None:
                 extra = self.envelope_provider(method, params)
                 if extra:

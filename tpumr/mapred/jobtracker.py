@@ -125,6 +125,9 @@ class JobMaster:
         self._server.authz = ServiceAuthorizationManager(
             conf, JOBTRACKER_POLICY,
             "security.job.submission.protocol.acl")
+        # impersonation rules (hadoop.proxyuser.*) are consulted from
+        # the daemon conf; without this, doas frames are rejected
+        self._server.proxy_conf = conf
         #: require cryptographically verified identity (user key or
         #: delegation token) for ACL-relevant identity claims — with it
         #: off (default), cluster-secret assertions keep working (the

@@ -39,6 +39,41 @@ class AuthorizationError(PermissionError):
     """≈ org.apache.hadoop.security.authorize.AuthorizationException."""
 
 
+def authorize_proxy(conf: Any, real_user: str, effective_user: str,
+                    remote_addr: str) -> None:
+    """≈ ProxyUsers.authorize (hadoop.proxyuser.<real>.groups/.hosts):
+    may ``real_user`` impersonate ``effective_user`` from
+    ``remote_addr``? BOTH rules must pass, both default CLOSED (an
+    unset key denies — impersonation is opt-in per superuser). ``*``
+    is accepted in either key (a convenience the reference's 1.0.3
+    ProxyUsers lacks but its successors added). Rules are read from
+    conf on every call, so edits via a reloaded daemon conf apply
+    without a dedicated refresh RPC."""
+    if not str(effective_user).strip() or not str(real_user).strip():
+        # defense in depth with the RPC-layer check: an empty identity
+        # on either side of a proxy decision must never pass (empty
+        # users resolve to the daemon's own UGI downstream)
+        raise AuthorizationError("empty identity in proxy authorization")
+    groups_spec = str(conf.get(f"hadoop.proxyuser.{real_user}.groups",
+                               "") or "")
+    hosts_spec = str(conf.get(f"hadoop.proxyuser.{real_user}.hosts",
+                              "") or "")
+    allowed_groups = {g.strip() for g in groups_spec.split(",")
+                      if g.strip()}
+    if "*" not in allowed_groups:
+        effective = server_side_ugi(effective_user, conf)
+        if not allowed_groups & set(effective.groups):
+            raise AuthorizationError(
+                f"User: {real_user} is not allowed to impersonate "
+                f"{effective_user}")
+    allowed_hosts = {h.strip() for h in hosts_spec.split(",")
+                     if h.strip()}
+    if "*" not in allowed_hosts and remote_addr not in allowed_hosts:
+        raise AuthorizationError(
+            f"Unauthorized connection for super-user {real_user} "
+            f"from IP {remote_addr}")
+
+
 class ServiceAuthorizationManager:
     def __init__(self, conf: Any, policy_map: "dict[str, list[str]]",
                  default_key: str) -> None:
