@@ -443,3 +443,24 @@ class TestTrackerListings:
         assert master.get_blacklisted_trackers() == []
         jid = submit(master, "alice")
         assert master.get_attempt_ids(jid, "map", "running") == []
+
+
+class TestCounterAccessor:
+    def test_single_counter_bare_value(self, master, capsys):
+        from tpumr.cli import main as cli_main
+        jid = submit(master, "alice")
+        host, port = master.address
+        rc = cli_main(["-jt", f"{host}:{port}", "job", "-counter",
+                       jid, "NoSuchGroup", "NoSuchName"])
+        assert rc == 1
+        assert "not found" in capsys.readouterr().err
+
+    def test_single_counter_happy_path(self, master, capsys):
+        from tpumr.cli import main as cli_main
+        jid = submit(master, "alice")
+        master.jobs[jid].counters.counter("MyGroup", "RECORDS").set_value(7)
+        host, port = master.address
+        rc = cli_main(["-jt", f"{host}:{port}", "job", "-counter",
+                       jid, "MyGroup", "RECORDS"])
+        assert rc == 0
+        assert capsys.readouterr().out.strip() == "7"   # bare, scriptable

@@ -239,7 +239,8 @@ def cmd_job(conf, argv: list[str]) -> int:
              "-fail-task ATTEMPT | -list-attempt-ids ID map|reduce "
              "running|completed | -list-active-trackers | "
              "-list-blacklisted-trackers | "
-             "-counters ID | -events ID | -history ID [HISTORY_DIR]")
+             "-counters ID | -counter ID GROUP NAME | -events ID | "
+             "-history ID [HISTORY_DIR]")
     if not argv:
         print(usage, file=sys.stderr)
         return 255
@@ -264,6 +265,22 @@ def cmd_job(conf, argv: list[str]) -> int:
         if cmd == "-counters":
             print(json.dumps(client.call("get_counters", rest[0]), indent=2,
                              default=str))
+            return 0
+        if cmd == "-counter":
+            # ≈ `hadoop job -counter ID GROUP NAME`: one value, bare on
+            # stdout (scriptable, the reference's contract)
+            if len(rest) < 3:
+                print("Usage: tpumr job -counter ID GROUP NAME",
+                      file=sys.stderr)
+                return 255
+            groups = client.call("get_counters", rest[0])
+            val = (groups.get(rest[1]) or {}).get(rest[2])
+            if val is None:
+                print(f"counter {rest[1]}.{rest[2]} not found "
+                      f"(groups: {', '.join(sorted(groups))})",
+                      file=sys.stderr)
+                return 1
+            print(val)
             return 0
         if cmd == "-kill":
             from tpumr.security import UserGroupInformation

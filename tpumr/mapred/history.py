@@ -48,6 +48,7 @@ class JobHistory:
             "num_maps": jip.num_maps,
             "num_reduces": jip.num_reduces,
             "kernel": jip.conf.get("tpumr.map.kernel"),
+            "priority": jip.priority,
             # full submission payload so a restarted master can replay the
             # job (≈ RecoveryManager reading the job-info staging file)
             "conf": {k: v for k, v in jip.conf.items()

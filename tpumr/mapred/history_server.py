@@ -25,7 +25,12 @@ def job_summary(events: list[dict]) -> dict:
             out.update(job_id=ev.get("job_id"), name=ev.get("job_name"),
                        num_maps=ev.get("num_maps"),
                        num_reduces=ev.get("num_reduces"),
-                       kernel=ev.get("kernel"), submitted_ts=ev.get("ts"))
+                       kernel=ev.get("kernel"), submitted_ts=ev.get("ts"),
+                       priority=ev.get("priority", "NORMAL"))
+        elif kind == "JOB_PRIORITY_CHANGED":
+            # the queue can be re-ordered live (job -set-priority); the
+            # viewer must show the priority the job actually ran at
+            out["priority"] = ev.get("priority", out.get("priority"))
         elif kind == "JOB_FINISHED":
             out.update(state=ev.get("state"),
                        wall_time=ev.get("wall_time"),
