@@ -229,3 +229,59 @@ def test_job_history_viewer(tmp_path, capsys):
     rc = cli(["job", "-history", "job_nope_0001", str(hist)])
     assert rc == 1
     assert "known:" in capsys.readouterr().err
+
+
+class TestSiteConfigLoading:
+    """≈ HADOOP_CONF_DIR *-site.xml auto-loading + GenericOptionsParser
+    -conf: site files layer below -conf files below -D overrides."""
+
+    def test_conf_dir_and_dash_conf_precedence(self, tmp_path,
+                                               monkeypatch, capsys):
+        import json as _json
+
+        from tpumr.cli import main as cli_main
+        site = tmp_path / "tpumr-site.json"
+        site.write_text(_json.dumps({"k.site": "from-site",
+                                     "k.both": "site"}))
+        extra = tmp_path / "extra.json"
+        extra.write_text(_json.dumps({"k.both": "conf-file",
+                                      "k.d": "conf-file"}))
+        monkeypatch.setenv("TPUMR_CONF_DIR", str(tmp_path))
+        # inject a probe command that records what conf it was handed
+        from tpumr.core.configuration import Configuration
+        seen = {}
+
+        def probe_cmd(conf, argv):
+            seen["site"] = conf.get("k.site")
+            seen["both"] = conf.get("k.both")
+            seen["d"] = conf.get("k.d")
+            return 0
+
+        import tpumr.cli as cli_mod
+        monkeypatch.setitem(cli_mod.COMMANDS, "probeconf", probe_cmd)
+        depth = len(Configuration._default_resources)
+        rc = cli_main(["-conf", str(extra), "-D", "k.d=dash-d",
+                       "probeconf"])
+        assert rc == 0
+        assert seen == {"site": "from-site", "both": "conf-file",
+                        "d": "dash-d"}
+        # layers removed after the invocation (no accumulation)
+        assert len(Configuration._default_resources) == depth
+
+    def test_missing_dash_conf_fails_loudly(self, tmp_path, capsys):
+        from tpumr.cli import main as cli_main
+        with pytest.raises(OSError):
+            cli_main(["-conf", str(tmp_path / "nope.json"), "version"])
+
+    def test_partial_conf_failure_leaks_no_layers(self, tmp_path):
+        import json as _json
+
+        from tpumr.cli import main as cli_main
+        from tpumr.core.configuration import Configuration
+        ok = tmp_path / "a.json"
+        ok.write_text(_json.dumps({"x": 1}))
+        depth = len(Configuration._default_resources)
+        with pytest.raises(OSError):
+            cli_main(["-conf", str(ok),
+                      "-conf", str(tmp_path / "missing.json"), "version"])
+        assert len(Configuration._default_resources) == depth

@@ -22,7 +22,9 @@ from typing import Any
 
 USAGE = """\
 Usage: tpumr [generic options] COMMAND [args]
-Generic options: -D k=v   -fs <default-fs-uri>   -jt <host:port|local>
+Generic options: -D k=v  -conf FILE  -fs <default-fs-uri>  -jt <host:port|local>
+Site config: $TPUMR_CONF_DIR/tpumr-site.{toml,json} loads automatically
+(precedence: defaults < site file < -conf files < -D/-fs/-jt)
 
 Daemons:
   namenode -dir DIR [-host H] [-port P]      run the tdfs NameNode
@@ -59,9 +61,13 @@ Clients:
 from tpumr import __version__ as VERSION
 
 
-def _parse_generic(argv: list[str]) -> tuple[dict[str, Any], list[str]]:
-    """Strip leading generic options; return (overrides, rest)."""
+def _parse_generic(argv: list[str]) \
+        -> tuple[dict[str, Any], list[str], list[str]]:
+    """Strip leading generic options; return (overrides, conf_files,
+    rest). ``-conf FILE`` ≈ GenericOptionsParser's -conf: an extra
+    site-file resource layered below -D overrides."""
     over: dict[str, Any] = {}
+    conf_files: list[str] = []
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -73,6 +79,9 @@ def _parse_generic(argv: list[str]) -> tuple[dict[str, Any], list[str]]:
             k, _, v = a[2:].partition("=")
             over[k.strip()] = v.strip()
             i += 1
+        elif a == "-conf" and i + 1 < len(argv):
+            conf_files.append(argv[i + 1])
+            i += 2
         elif a == "-fs" and i + 1 < len(argv):
             over["fs.default.name"] = argv[i + 1]
             i += 2
@@ -81,7 +90,25 @@ def _parse_generic(argv: list[str]) -> tuple[dict[str, Any], list[str]]:
             i += 2
         else:
             break
-    return over, argv[i:]
+    return over, conf_files, argv[i:]
+
+
+def _site_files(conf_files: list[str]) -> list[str]:
+    """Resource files for this invocation, lowest precedence first:
+    ``$TPUMR_CONF_DIR/tpumr-site.{toml,json}`` (≈ HADOOP_CONF_DIR's
+    *-site.xml auto-loading), then explicit ``-conf`` files in order.
+    A configured-but-missing conf dir site file is fine (the reference
+    tolerates absent site files); an explicit -conf that is missing is
+    an error the Configuration loader raises."""
+    out: list[str] = []
+    conf_dir = os.environ.get("TPUMR_CONF_DIR")
+    if conf_dir:
+        for name in ("tpumr-site.toml", "tpumr-site.json"):
+            p = os.path.join(conf_dir, name)
+            if os.path.exists(p):
+                out.append(p)
+    out.extend(conf_files)
+    return out
 
 
 def _conf(overrides: dict[str, Any]):
@@ -956,7 +983,7 @@ def main(argv: list[str] | None = None) -> int:
         import jax
         jax.config.update("jax_platforms", plat)
     argv = list(sys.argv[1:] if argv is None else argv)
-    overrides, rest = _parse_generic(argv)
+    overrides, conf_files, rest = _parse_generic(argv)
     if not rest:
         sys.stderr.write(USAGE)
         return 255
@@ -965,19 +992,29 @@ def main(argv: list[str] | None = None) -> int:
     if fn is None:
         sys.stderr.write(f"Unknown command: {cmd}\n\n" + USAGE)
         return 255
-    if not overrides:
-        return fn(_conf(overrides), args)
-    # generic options must reach confs the subcommand builds itself
-    # (examples/pipes/streaming construct their own JobConf) — install them
-    # as a default resource layer ≈ GenericOptionsParser merging into the
-    # job conf; removed afterwards so repeated in-process invocations
-    # (tests, embedding) don't accumulate layers
+    # resource layers for this invocation, lowest first: conf-dir site
+    # file(s), -conf files, then -D/-fs/-jt overrides on top. Installed
+    # as default resources ≈ GenericOptionsParser merging into the job
+    # conf so they also reach confs the subcommand builds itself
+    # (examples/pipes/streaming); removed afterwards so repeated
+    # in-process invocations (tests, embedding) don't accumulate layers
     from tpumr.core.configuration import Configuration
-    Configuration.add_default_resource(overrides)
+    layers: "list[dict | str]" = list(_site_files(conf_files))
+    if overrides:
+        layers.append(overrides)
+    if not layers:
+        return fn(_conf(overrides), args)
+    installed = 0
     try:
+        for layer in layers:
+            # a broken -conf file raises here, before dispatch — the
+            # command never runs against partial configuration
+            Configuration.add_default_resource(layer)
+            installed += 1
         return fn(_conf(overrides), args)
     finally:
-        Configuration._default_resources.pop()
+        if installed:
+            del Configuration._default_resources[-installed:]
 
 
 if __name__ == "__main__":

@@ -51,8 +51,14 @@ class Configuration:
     # ------------------------------------------------------------------ setup
 
     @classmethod
-    def add_default_resource(cls, resource: Mapping[str, Any]) -> None:
-        cls._default_resources.append(dict(resource))
+    def add_default_resource(cls,
+                             resource: "Mapping[str, Any] | str") -> None:
+        """Add a process-wide default layer: a dict, or a path to a
+        .json/.toml file (same forms as add_resource)."""
+        if isinstance(resource, str):
+            cls._default_resources.append(cls._load_file(resource))
+        else:
+            cls._default_resources.append(dict(resource))
 
     def add_resource(self, resource: "Mapping[str, Any] | str") -> None:
         """Add a resource layer: a dict, or a path to a .json/.toml file."""
