@@ -54,14 +54,20 @@ class DistributedFileSystem(FileSystem):
                           is_dir=st["is_dir"],
                           replication=st.get("replication", 1),
                           block_size=st.get("block_size", 0),
-                          mtime=st.get("mtime", 0.0))
+                          mtime=st.get("mtime", 0.0),
+                          owner=st.get("owner", ""))
+
+    def get_permission(self, path: "str | Path") -> int:
+        """Octal mode bits (distcp -p reads these to preserve them)."""
+        return int(self.client.get_status(self._p(path)).get("mode", 0o644))
 
     def list_status(self, path: "str | Path") -> list[FileStatus]:
         return [FileStatus(path=self._q(st["path"]), length=st["length"],
                            is_dir=st["is_dir"],
                            replication=st.get("replication", 1),
                            block_size=st.get("block_size", 0),
-                           mtime=st.get("mtime", 0.0))
+                           mtime=st.get("mtime", 0.0),
+                           owner=st.get("owner", ""))
                 for st in self.client.list_status(self._p(path))]
 
     def mkdirs(self, path: "str | Path") -> bool:

@@ -4,12 +4,16 @@
 tree into a file list, one map task per batch of files, each map copies
 its files through the FileSystem SPI (so any scheme→any scheme works:
 local→tdfs, mem→local, …), preserving relative paths. ``-update`` skips
-files whose destination already exists with the same length.
+files whose destination already exists with the same length;
+``-delete`` (with -update, the reference's pairing) removes destination
+files absent from the source; ``-p`` preserves owner and permission
+bits where the filesystems expose them (tdfs does).
 """
 
 from __future__ import annotations
 
 import argparse
+import sys
 
 from tpumr.fs import get_filesystem
 from tpumr.fs.filesystem import Path
@@ -24,6 +28,7 @@ class DistCpMapper(Mapper):
 
     def configure(self, conf) -> None:
         self._update = bool(conf.get("tpumr.distcp.update", False))
+        self._preserve = bool(conf.get("tpumr.distcp.preserve", False))
         self._conf = conf
 
     def map(self, key, value, output, reporter):
@@ -33,14 +38,24 @@ class DistCpMapper(Mapper):
             return
         sfs = get_filesystem(src, self._conf)
         dfs = get_filesystem(dst, self._conf)
-        length = sfs.get_status(src).length
+        st = sfs.get_status(src)
         if self._update and dfs.exists(dst) \
-                and dfs.get_status(dst).length == length:
+                and dfs.get_status(dst).length == st.length:
             reporter.incr_counter("distcp", "skipped")
             return
         copied = sfs.copy(src, dfs, dst)
         reporter.incr_counter("distcp", "copied")
         reporter.incr_counter("distcp", "bytes", copied)
+        if self._preserve:
+            # -p: owner + mode where both ends expose them (best effort
+            # across schemes — a local->tdfs copy preserves what the
+            # source can report); reuses the status fetched above
+            if st.owner and hasattr(dfs, "set_owner"):
+                dfs.set_owner(dst, st.owner)
+            get_perm = getattr(sfs, "get_permission", None)
+            if get_perm is not None and hasattr(dfs, "set_permission"):
+                dfs.set_permission(dst, get_perm(src))
+                reporter.incr_counter("distcp", "preserved")
 
 
 def build_file_list(src: str, dst: str, conf=None) -> list[str]:
@@ -62,10 +77,19 @@ def build_file_list(src: str, dst: str, conf=None) -> list[str]:
 
 
 def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
+           delete: bool = False, preserve: bool = False,
            conf: JobConf | None = None) -> bool:
+    if delete and not update:
+        # the reference pairs -delete with -update/-overwrite; without
+        # the comparison pass, deleting is too easy to fire by accident
+        raise ValueError("-delete requires -update")
     conf = conf or JobConf()
     pairs = build_file_list(src, dst, conf)
     if not pairs:
+        # an emptied source still syncs: the -delete pass must run or
+        # stale destination files survive forever
+        if delete:
+            _delete_extraneous(src, dst, pairs, conf)
         return True
     # the staging listing must be readable by remote task processes, so it
     # lives NEXT TO the destination (a shared fs by definition) unless the
@@ -84,12 +108,16 @@ def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
     conf.set_input_format(NLineInputFormat)
     conf.set("mapred.line.input.format.linespermap", per_map)
     conf.set("tpumr.distcp.update", update)
+    conf.set("tpumr.distcp.preserve", preserve)
     conf.set_mapper_class(DistCpMapper)
     conf.set_num_reduce_tasks(0)
     from tpumr.mapred.output_formats import NullOutputFormat
     conf.set_output_format(NullOutputFormat)
     try:
-        return run_job(conf).successful
+        ok = run_job(conf).successful
+        if ok and delete:
+            _delete_extraneous(src, dst, pairs, conf)
+        return ok
     finally:
         # only clean up scratch WE created — a caller-supplied work dir may
         # be a shared staging area with unrelated contents
@@ -97,14 +125,66 @@ def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
             get_filesystem(work, conf).delete(work, recursive=True)
 
 
+def _delete_extraneous(src: str, dst: str, pairs: list[str],
+                       conf) -> int:
+    """rsync-style -delete: destination files whose RELATIVE path does
+    not exist under the source are removed (reference DistCp's -delete;
+    runs after a successful copy pass, driver-side). Compared by
+    relative path so scheme/authority spelling differences can't make
+    everything look extraneous."""
+    dfs = get_filesystem(dst, conf)
+    if not dfs.exists(dst) or not dfs.get_status(dst).is_dir:
+        return 0
+    dst_base = dst.rstrip("/")
+    wanted_rel = set()
+    for p in pairs:
+        target = p.split("\t", 1)[1]
+        if target.startswith(dst_base):
+            wanted_rel.add(target[len(dst_base):].lstrip("/"))
+    base = str(dfs.get_status(dst).path)
+    removed = 0
+    # directories first, top-down: a stale dir (no wanted file beneath
+    # it) goes wholesale, so the tree converges to the source like the
+    # reference's -delete — not just a file-level sweep
+    def sweep_dirs(path: str) -> None:
+        nonlocal removed
+        for st in dfs.list_status(path):
+            if not st.is_dir:
+                continue
+            rel = str(st.path)[len(base):].lstrip("/")
+            if rel and not any(w == rel or w.startswith(rel + "/")
+                               for w in wanted_rel):
+                dfs.delete(str(st.path), recursive=True)
+                removed += 1
+            else:
+                sweep_dirs(str(st.path))
+    sweep_dirs(dst)
+    for f in dfs.list_files(dst, recursive=True):
+        rel = str(f.path)[len(base):].lstrip("/")
+        if rel and rel not in wanted_rel:
+            dfs.delete(str(f.path))
+            removed += 1
+    return removed
+
+
 def main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(prog="tpumr distcp")
     ap.add_argument("src")
     ap.add_argument("dst")
     ap.add_argument("-m", "--maps", type=int, default=4)
+    ap.add_argument("-delete", action="store_true",
+                    help="remove dst files absent from src (needs -update)")
+    ap.add_argument("-p", dest="preserve", action="store_true",
+                    help="preserve owner + permission bits")
     ap.add_argument("-update", action="store_true",
                     help="skip files already at the destination with the "
                          "same size")
     args = ap.parse_args(argv)
-    return 0 if distcp(args.src, args.dst, maps=args.maps,
-                       update=args.update) else 1
+    try:
+        ok = distcp(args.src, args.dst, maps=args.maps,
+                    update=args.update, delete=args.delete,
+                    preserve=args.preserve)
+    except ValueError as e:
+        print(f"distcp: {e}", file=sys.stderr)
+        return 255
+    return 0 if ok else 1
