@@ -30,6 +30,8 @@ from __future__ import annotations
 import threading
 from typing import Any
 
+from tpumr.utils import progress
+
 
 class DeviceFetchBatcher:
     def __init__(self) -> None:
@@ -77,6 +79,7 @@ class DeviceFetchBatcher:
         import jax
         try:
             results = jax.device_get([s.tree for s in batch])
+            progress.tick(0, f"fetch-batch-{len(batch)}")
             for s, r in zip(batch, results):
                 s.result = r
                 s.fulfilled = True

@@ -33,6 +33,7 @@ from tpumr.io.recordbatch import DenseBatch, RecordBatch
 from tpumr.io.writable import serialize
 from tpumr.mapred.api import MapRunnable
 from tpumr.mapred.split import DenseSplit, InputSplit
+from tpumr.utils import progress
 from tpumr.utils.reflection import new_instance
 
 
@@ -239,6 +240,7 @@ def stage_batch(conf, reader, task_ctx, device=None) -> tuple[Any, bool, int]:
                 return DenseBatch(staged, ids, {}), False, 0
             batch = in_fmt.read_batch(split, conf)
             staged = jax.device_put(batch.values, device)
+            progress.tick(int(batch.values.nbytes), "stage")
             cache.put(key, (staged, batch.ids, dict(batch.meta)),
                       int(batch.values.nbytes))
             return DenseBatch(staged, batch.ids, batch.meta), False, \
@@ -376,6 +378,7 @@ def prelaunch_device_maps(conf, tasks: "list[Any]") -> "list[DevicePrefetch] | N
             if resident >= budget and len(states) < len(tasks):
                 break  # close the window early; caller resumes after us
         fetched = jax.device_get(states)  # ONE roundtrip for the window
+        progress.tick(sum(m[1] for m in meta), "window-drain")
     return [DevicePrefetch(f, n, b, rows)
             for f, (n, b, rows) in zip(fetched, meta)]
 

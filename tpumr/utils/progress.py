@@ -1,0 +1,41 @@
+"""Device-liveness ticks for external watchdogs.
+
+A tunneled TPU runtime can wedge *inside* an XLA call — the host parks on
+a futex with zero CPU and no Python-level timeout can preempt it (seen
+live in bench rounds 2–4). A watchdog outside the process can only tell
+"slow but alive" from "wedged" if the process leaves a heartbeat at every
+completed device transfer. That is what :func:`tick` is: each finished
+``device_put`` / ``device_get`` (the tunnel roundtrips) rewrites the file
+named by ``TPUMR_DEVICE_PROGRESS_FILE``, so the file's mtime is a
+monotone "last proven device roundtrip" clock readable by any supervisor
+(``bench.py``'s stall watchdog is the consumer in-tree).
+
+Unset env (the default, and all normal production use) disables ticks
+entirely — one dict lookup per transfer, no I/O.
+
+The file is shared by every process of a job tree (tasks inherit the
+env); each writer overwrites rather than appends because the watchdog
+only reads the mtime — contents are a small debugging aid, not a log.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+_count = 0
+
+
+def tick(nbytes: int = 0, what: str = "") -> None:
+    """Record one completed device transfer (best-effort, never raises)."""
+    path = os.environ.get("TPUMR_DEVICE_PROGRESS_FILE")
+    if not path:
+        return
+    global _count
+    _count += 1
+    try:
+        with open(path, "w") as f:
+            f.write(f"{os.getpid()} {_count} {nbytes} {what} "
+                    f"{time.time():.1f}\n")
+    except OSError:
+        pass
