@@ -64,11 +64,11 @@ class TestSpeculativeExecution:
         return JobInProgress(JobID("spec", 1), splits=splits,
                              conf_dict=base)
 
-    def _finish(self, job, task, runtime=1.0):
+    def _finish(self, job, task, runtime=1.0, is_map=True):
         from tpumr.mapred.task import TaskState, TaskStatus
         now = time.time()
         job.update_task_status(TaskStatus(
-            attempt_id=task.attempt_id, is_map=True,
+            attempt_id=task.attempt_id, is_map=is_map,
             state=TaskState.SUCCEEDED, start_time=now - runtime,
             finish_time=now), "t:0")
 
@@ -91,6 +91,46 @@ class TestSpeculativeExecution:
         self._finish(job, spec, runtime=0.01)
         assert job.should_kill_attempt(str(t1.attempt_id))
         assert not job.should_kill_attempt(str(spec.attempt_id))
+
+    def test_speculates_slow_reduce_straggler(self):
+        """≈ JobInProgress.java:257,2320 hasSpeculativeReduces: a reduce
+        running far beyond the completed-reduce mean gets a duplicate
+        attempt; first completion wins and the loser is killed."""
+        job = self._job(n_maps=0, **{"mapred.reduce.tasks": 2})
+        r0 = job.obtain_new_reduce_task("h")
+        r1 = job.obtain_new_reduce_task("h")
+        assert r0 is not None and r1 is not None
+        assert job.obtain_new_reduce_task("h") is None
+        self._finish(job, r0, runtime=0.01, is_map=False)
+        # r1 is now a straggler: backdate its start so elapsed >> mean
+        job.reduces[r1.partition].report.start_time = time.time() - 100
+        spec = job.obtain_new_reduce_task("h")
+        assert spec is not None
+        assert spec.partition == r1.partition
+        assert spec.attempt_id != r1.attempt_id
+        assert job.speculative_reduce_tasks == 1
+        # only one speculative twin per task
+        assert job.obtain_new_reduce_task("h") is None
+        # first completion wins; the loser must be killed
+        self._finish(job, spec, runtime=0.01, is_map=False)
+        assert job.should_kill_attempt(str(r1.attempt_id))
+        assert not job.should_kill_attempt(str(spec.attempt_id))
+
+    def test_reduce_speculation_needs_completion_and_flag(self):
+        # no completed reduce yet -> no mean -> no speculation
+        job = self._job(n_maps=0, **{"mapred.reduce.tasks": 1})
+        r = job.obtain_new_reduce_task("h")
+        job.reduces[r.partition].report.start_time = time.time() - 100
+        assert job.obtain_new_reduce_task("h") is None
+        # mapred.reduce.speculative.execution=False turns ONLY reduces off
+        off = self._job(n_maps=0, **{
+            "mapred.reduce.tasks": 2,
+            "mapred.reduce.speculative.execution": False})
+        a = off.obtain_new_reduce_task("h")
+        off.obtain_new_reduce_task("h")
+        self._finish(off, a, runtime=0.01, is_map=False)
+        off.reduces[1].report.start_time = time.time() - 100
+        assert off.obtain_new_reduce_task("h") is None
 
     def test_no_speculation_without_completions_or_flag(self):
         job = self._job(n_maps=1)

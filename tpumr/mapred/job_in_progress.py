@@ -108,6 +108,13 @@ class JobInProgress:
         self.slowstart = float(self.conf.get(
             "mapred.reduce.slowstart.completed.maps", 0.05))
         self.speculative = bool(self.conf.get("mapred.speculative.execution", True))
+        # ≈ mapred.reduce.tasks.speculative.execution: reduces speculate
+        # too (JobInProgress.java:257,739,2320 hasSpeculativeReduces /
+        # findSpeculativeTask) — a straggling reduce ends every job, so
+        # it needs the same mitigation maps get. Defaults to the global
+        # switch; the dedicated key turns one side off independently.
+        self.speculative_reduces = bool(self.conf.get(
+            "mapred.reduce.speculative.execution", self.speculative))
         # ≈ JobPriority (mapred/JobPriority.java) — FIFO scheduling
         # sorts by (priority, start time); mutable at runtime via
         # JobMaster.set_job_priority (hadoop job -set-priority)
@@ -140,6 +147,11 @@ class JobInProgress:
         #: (heartbeat replays re-deliver terminal statuses)
         self.history_logged: set[str] = set()
         self.speculative_map_tasks = 0
+        self.speculative_reduce_tasks = 0
+        #: running sum of successful reduce runtimes — the speculation
+        #: threshold's mean (reduces have no per-backend split: they
+        #: always run on CPU slots)
+        self._reduce_time_sum = 0.0
         #: set by the master once job-level output commit/abort completed —
         #: clients must not observe a terminal state before the output is
         #: actually promoted (finalization runs outside the heartbeat lock)
@@ -385,8 +397,10 @@ class JobInProgress:
 
     def obtain_new_reduce_task(self, host: str) -> Task | None:
         with self.lock:
-            if self.state != JobState.RUNNING or not self._pending_reduces:
+            if self.state != JobState.RUNNING:
                 return None
+            if not self._pending_reduces:
+                return self._obtain_speculative_reduce()
             # slowstart gate ≈ JobInProgress.scheduleReduces
             if self.finished_maps < self.slowstart * max(1, len(self.maps)):
                 return None
@@ -400,6 +414,37 @@ class JobInProgress:
             return Task(attempt, partition=idx, num_reduces=self.num_reduces,
                         num_maps=len(self.maps),
                         memory_mb=self.reduce_memory_mb())
+
+    def _obtain_speculative_reduce(self) -> Task | None:
+        """Straggler mitigation for the phase that ends every job ≈
+        JobInProgress.hasSpeculativeReduces / findSpeculativeTask
+        (JobInProgress.java:257,739,2320): when all reduces are assigned
+        but one runs much longer than the completed mean, issue a
+        duplicate attempt; first completion wins (the loser is killed by
+        the master via should_kill_attempt, and the output committer's
+        promote-on-commit makes the race safe). Same progress-gap rule
+        as maps. Caller holds ``self.lock``."""
+        if not self.speculative_reduces or self.finished_reduces == 0:
+            return None
+        mean = self._reduce_time_sum / self.finished_reduces
+        factor = float(self.conf.get("mapred.speculative.lag.factor", 1.5))
+        floor = float(self.conf.get("mapred.speculative.min.runtime.s", 10.0))
+        now = time.time()
+        for tip in self.reduces:
+            if tip.state != "running":
+                continue
+            if tip.next_attempt != 1:
+                continue  # already speculated (or restarted) — one dup max
+            elapsed = now - (tip.report.start_time or now)
+            if elapsed <= max(floor, factor * mean):
+                continue
+            attempt = tip.new_attempt()
+            self.speculative_reduce_tasks += 1
+            return Task(attempt, partition=tip.partition,
+                        num_reduces=self.num_reduces,
+                        num_maps=len(self.maps),
+                        memory_mb=self.reduce_memory_mb())
+        return None
 
     # ------------------------------------------------------------ updates
 
@@ -472,6 +517,7 @@ class JobInProgress:
             })
         else:
             self.finished_reduces += 1
+            self._reduce_time_sum += status.runtime
         if (self.finished_maps == len(self.maps)
                 and self.finished_reduces == len(self.reduces)):
             self.state = JobState.SUCCEEDED
