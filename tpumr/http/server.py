@@ -80,12 +80,16 @@ def _log_level(query: "dict[str, str]") -> dict:
     logger = logging.getLogger(name) if name else logging.getLogger()
     if "level" in query:
         level = query["level"].upper()
+        # the reference's daemonlog accepts log4j names — operators
+        # porting runbooks send WARN/FATAL, which Python spells
+        # WARNING/CRITICAL
+        level = {"WARN": "WARNING", "FATAL": "CRITICAL"}.get(level, level)
         # str->int mapping check that exists on 3.10 (getLevelName
         # returns the int for a known name, "Level X" otherwise)
         if not isinstance(logging.getLevelName(level), int):
             raise ValueError(
                 f"unknown level {query['level']!r}; try DEBUG, INFO, "
-                f"WARNING, ERROR or CRITICAL")
+                f"WARN(ING), ERROR, FATAL or CRITICAL")
         logger.setLevel(level)
     return {"log": name or "root",
             "level": (logging.getLevelName(logger.level)

@@ -69,9 +69,47 @@ def authorize_proxy(conf: Any, real_user: str, effective_user: str,
     allowed_hosts = {h.strip() for h in hosts_spec.split(",")
                      if h.strip()}
     if "*" not in allowed_hosts and remote_addr not in allowed_hosts:
+        # entries may be hostnames (the reference resolves each via
+        # InetAddress.getByName before comparing, ProxyUsers.authorize) —
+        # a config listing "localhost" must match a 127.0.0.1 peer.
+        # ALL addresses of a multi-homed entry count, resolutions are
+        # TTL-cached (a DNS outage must not stall every doas RPC for the
+        # resolver timeout), and failures are tolerated per-entry
+        # (fail closed).
+        for h in allowed_hosts:
+            if remote_addr in _resolve_host(h):
+                return
         raise AuthorizationError(
             f"Unauthorized connection for super-user {real_user} "
             f"from IP {remote_addr}")
+
+
+#: hostname -> (monotonic deadline, frozenset of addresses); negative
+#: results cache too — a dead resolver stalls each name once per TTL,
+#: not once per RPC
+_HOST_CACHE: "dict[str, tuple[float, frozenset]]" = {}
+_HOST_CACHE_TTL_S = 300.0
+
+
+def _resolve_host(name: str) -> frozenset:
+    """Every address ``name`` resolves to (A/AAAA — a round-robin or
+    multi-homed gateway must match whichever address the peer arrives
+    from), empty on resolution failure."""
+    import socket
+    import time
+    hit = _HOST_CACHE.get(name)
+    now = time.monotonic()
+    if hit is not None and now < hit[0]:
+        return hit[1]
+    try:
+        addrs = frozenset(
+            info[4][0] for info in socket.getaddrinfo(name, None))
+    except OSError:
+        addrs = frozenset()
+    if len(_HOST_CACHE) > 1024:     # bound: entries come from config,
+        _HOST_CACHE.clear()         # but stay safe against abuse
+    _HOST_CACHE[name] = (now + _HOST_CACHE_TTL_S, addrs)
+    return addrs
 
 
 class ServiceAuthorizationManager:
@@ -102,6 +140,15 @@ class ServiceAuthorizationManager:
         self._acls = {k: AccessControlList(
             "*" if conf.get(k) is None else str(conf.get(k)))
             for k in keys}
+        # user→UGI TTL cache ≈ the reference's Groups cache
+        # (hadoop.security.groups.cache.secs, default 300): without it
+        # every authorized RPC pays a full group-database scan
+        # (grp.getgrall() inside server_side_ugi). Per-manager, so a
+        # -refreshServiceAcl (which rebuilds the manager) also drops
+        # stale memberships.
+        self._ugi_ttl = float(conf.get(
+            "hadoop.security.groups.cache.secs", 300) or 300)
+        self._ugi_cache: "dict[str, tuple[float, Any]]" = {}
 
     def acl_specs(self) -> "dict[str, str]":
         """Current specs per service key (for -refreshServiceAcl's
@@ -119,8 +166,26 @@ class ServiceAuthorizationManager:
         if not self.enabled:
             return
         keys = self.policy_map.get(method) or [self.default_key]
-        ugi = server_side_ugi(str(user), self.conf) if user else \
-            UserGroupInformation("anonymous", [])
+        if user:
+            import time
+            name = str(user)
+            hit = self._ugi_cache.get(name)
+            now = time.monotonic()
+            if hit is not None and now - hit[0] < self._ugi_ttl:
+                ugi = hit[1]
+            else:
+                ugi = server_side_ugi(name, self.conf)
+                if len(self._ugi_cache) >= 4096:
+                    # names are CALLER-asserted under simple auth: a
+                    # client spraying distinct users must not grow a
+                    # daemon-lifetime dict without bound. Drop expired
+                    # entries first; full-clear if they were all live.
+                    live = {k: v for k, v in self._ugi_cache.items()
+                            if now - v[0] < self._ugi_ttl}
+                    self._ugi_cache = live if len(live) < 4096 else {}
+                self._ugi_cache[name] = (now, ugi)
+        else:
+            ugi = UserGroupInformation("anonymous", [])
         for key in keys:
             if self._acls[key].allows(ugi):
                 return

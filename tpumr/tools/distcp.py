@@ -42,20 +42,28 @@ class DistCpMapper(Mapper):
         if self._update and dfs.exists(dst) \
                 and dfs.get_status(dst).length == st.length:
             reporter.incr_counter("distcp", "skipped")
+            # -p -update: an unchanged file may still have changed owner
+            # or mode — the reference refreshes preserved attributes even
+            # on skipped files (DistCp updateDestStatus)
+            self._preserve_attrs(sfs, src, st, dfs, dst, reporter)
             return
         copied = sfs.copy(src, dfs, dst)
         reporter.incr_counter("distcp", "copied")
         reporter.incr_counter("distcp", "bytes", copied)
-        if self._preserve:
-            # -p: owner + mode where both ends expose them (best effort
-            # across schemes — a local->tdfs copy preserves what the
-            # source can report); reuses the status fetched above
-            if st.owner and hasattr(dfs, "set_owner"):
-                dfs.set_owner(dst, st.owner)
-            get_perm = getattr(sfs, "get_permission", None)
-            if get_perm is not None and hasattr(dfs, "set_permission"):
-                dfs.set_permission(dst, get_perm(src))
-                reporter.incr_counter("distcp", "preserved")
+        self._preserve_attrs(sfs, src, st, dfs, dst, reporter)
+
+    def _preserve_attrs(self, sfs, src, st, dfs, dst, reporter) -> None:
+        """-p: owner + mode where both ends expose them (best effort
+        across schemes — a local->tdfs copy preserves what the source
+        can report); reuses the status fetched by map()."""
+        if not self._preserve:
+            return
+        if st.owner and hasattr(dfs, "set_owner"):
+            dfs.set_owner(dst, st.owner)
+        get_perm = getattr(sfs, "get_permission", None)
+        if get_perm is not None and hasattr(dfs, "set_permission"):
+            dfs.set_permission(dst, get_perm(src))
+            reporter.incr_counter("distcp", "preserved")
 
 
 def build_file_list(src: str, dst: str, conf=None) -> list[str]:
@@ -89,7 +97,7 @@ def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
         # an emptied source still syncs: the -delete pass must run or
         # stale destination files survive forever
         if delete:
-            _delete_extraneous(src, dst, pairs, conf)
+            _delete_extraneous(dst, pairs, conf)
         return True
     # the staging listing must be readable by remote task processes, so it
     # lives NEXT TO the destination (a shared fs by definition) unless the
@@ -116,7 +124,7 @@ def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
     try:
         ok = run_job(conf).successful
         if ok and delete:
-            _delete_extraneous(src, dst, pairs, conf)
+            _delete_extraneous(dst, pairs, conf)
         return ok
     finally:
         # only clean up scratch WE created — a caller-supplied work dir may
@@ -125,7 +133,7 @@ def distcp(src: str, dst: str, maps: int = 4, update: bool = False,
             get_filesystem(work, conf).delete(work, recursive=True)
 
 
-def _delete_extraneous(src: str, dst: str, pairs: list[str],
+def _delete_extraneous(dst: str, pairs: list[str],
                        conf) -> int:
     """rsync-style -delete: destination files whose RELATIVE path does
     not exist under the source are removed (reference DistCp's -delete;
