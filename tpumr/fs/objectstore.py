@@ -148,13 +148,27 @@ class ObjectStoreFileSystem(FileSystem):
         #: alias) — returned paths must round-trip through the registry
         self.mount_scheme = scheme
         backend_dir = conf.get("fs.gs.emulation.dir") if conf else None
-        if not backend_dir:
-            raise ValueError(
-                "gs:// needs a backend: set fs.gs.emulation.dir to a local "
-                "directory (object-store emulation; a real GCS client "
-                "plugs in at tpumr.fs.objectstore.ObjectBackend)")
-        self.backend: ObjectBackend = LocalEmulationBackend(
-            os.path.join(backend_dir, authority or "_default"))
+        if backend_dir:
+            # the in-tree default (this environment has zero egress)
+            self.backend: ObjectBackend = LocalEmulationBackend(
+                os.path.join(backend_dir, authority or "_default"))
+            return
+        # no emulation dir: the REAL service client (GCS JSON API over
+        # stdlib urllib — ≈ S3FileSystem.java:50 talking live S3) when a
+        # credential source or explicit endpoint exists
+        from tpumr.fs.gcs import GcsJsonBackend, TokenProvider
+        tokens = TokenProvider(conf)
+        endpoint = conf.get("fs.gs.endpoint") if conf else None
+        if endpoint or tokens.token():
+            self.backend = GcsJsonBackend(authority, conf,
+                                          tokens=tokens)
+            return
+        raise ValueError(
+            "gs:// needs a backend: set fs.gs.emulation.dir for the "
+            "local emulation, or provide real-GCS credentials "
+            "(fs.gs.auth.token / GCS_OAUTH_TOKEN / run on a GCE or "
+            "Cloud-TPU VM with a metadata service account; "
+            "fs.gs.endpoint points at an emulator)")
 
     # ------------------------------------------------------------ keys
 
@@ -275,12 +289,23 @@ def _make_factory(scheme: str):
         return ObjectStoreFileSystem(conf, authority=authority,
                                      scheme=scheme)
 
-    # the instance is bound to its backing store: two confs with
-    # different emulation dirs must NOT share a cache slot (FileSystem
-    # caches per scheme://authority by default)
-    factory.cache_salt = (
-        lambda conf: str(conf.get("fs.gs.emulation.dir")
-                         if conf is not None else None))
+    # the instance is bound to its backing store AND its credential: two
+    # confs with different emulation dirs, endpoints, or auth tokens must
+    # NOT share a cache slot (FileSystem caches per scheme://authority by
+    # default; a shared slot would let job B's reads ride job A's bearer
+    # token). The token enters the salt as a digest so cache keys never
+    # carry the credential itself.
+    def _salt(conf):
+        if conf is None:
+            return ("None", "None", "None")
+        tok = str(conf.get("fs.gs.auth.token") or "")
+        if tok:
+            import hashlib
+            tok = hashlib.sha256(tok.encode()).hexdigest()[:12]
+        return (str(conf.get("fs.gs.emulation.dir")),
+                str(conf.get("fs.gs.endpoint")), tok)
+
+    factory.cache_salt = _salt
     return factory
 
 
