@@ -252,6 +252,33 @@ class TestJobTrackerHttp:
         finally:
             hs.stop()
 
+    def test_placement_series_in_status_and_history(self, cluster):
+        """VERDICT r4 #9: every map assignment appends (t, backend) to the
+        job's placement series; the finished job's history carries the
+        full timeline so a convergence curve plots from any run."""
+        result = run_wc(cluster, "plc")
+        jid = str(result.job_id)
+        jip = cluster.master.jobs[jid]
+        tl = jip.placement_timeline()
+        assert tl["seq"] and set(tl["seq"]) <= {"T", "c"}
+        assert len(tl["t"]) == len(tl["seq"])
+        # status carries the TAIL (RPC-polled payload stays bounded)
+        assert jip.status_dict()["placement_seq"] == tl["seq"][-512:]
+        # history JOB_FINISHED carries it
+        from tpumr.mapred.history_server import (JobHistoryServer,
+                                                placement_svg)
+        hs = JobHistoryServer(cluster.history_dir).start()
+        try:
+            code, body = fetch(hs.url + f"/json/job?id={jid}")
+            events = json.loads(body)
+            fin = [e for e in events if e["event"] == "JOB_FINISHED"][0]
+            assert fin["placement"]["seq"] == tl["seq"]
+        finally:
+            hs.stop()
+        svg = placement_svg({"seq": "ccTcTT"})
+        assert "<svg" in svg and "polyline" in svg
+        assert placement_svg({"seq": ""}) == ""
+
     def test_history_server_redacts_submission_conf(self, tmp_path):
         """The JOB_SUBMITTED event keeps the full conf on disk (recovery
         needs it) but the history status port must mask credentials."""

@@ -110,6 +110,9 @@ class JobHistory:
             "cpu_map_mean_time": jip.cpu_map_mean_time(),
             "tpu_map_mean_time": jip.tpu_map_mean_time(),
             "acceleration_factor": jip.acceleration_factor(),
+            # the assignment-order backend series + stamps: the hybrid
+            # convergence curve, plottable from the history file alone
+            "placement": jip.placement_timeline(),
             "error": jip.error,
         })
 

@@ -37,6 +37,7 @@ def job_summary(events: list[dict]) -> dict:
                        finished_cpu_maps=ev.get("finished_cpu_maps"),
                        finished_tpu_maps=ev.get("finished_tpu_maps"),
                        acceleration_factor=ev.get("acceleration_factor"),
+                       placement=ev.get("placement"),
                        error=ev.get("error"))
     return out
 
@@ -92,6 +93,40 @@ def _backend_label(t: dict) -> str:
         return "reduce"
     return f"tpu:{t.get('tpu_device_id')}" if t.get("run_on_tpu") \
         else "cpu"
+
+
+def placement_svg(placement: dict, width: int = 600) -> str:
+    """Inline-SVG convergence curve: cumulative TPU share of map
+    assignments vs assignment index (the plot VERDICT r4 #9 asked the
+    history to carry — optional scheduling shows as the share climbing
+    to 1.0 mid-job as the starvation rule fires,
+    ≈ JobQueueTaskScheduler.java:290-327)."""
+    seq = (placement or {}).get("seq") or ""
+    if len(seq) < 2:
+        return ""
+    h, pad = 80, 14
+    tpu = 0
+    pts = []
+    for i, b in enumerate(seq):
+        tpu += (b == "T")
+        x = pad + i / (len(seq) - 1) * (width - 2 * pad)
+        y = h - pad - (tpu / (i + 1)) * (h - 2 * pad)
+        pts.append(f"{x:.1f},{y:.1f}")
+    share = tpu / len(seq)
+    return (
+        f"<h2>Placement convergence</h2>"
+        f"<svg viewBox='0 0 {width} {h}' width='{width}' "
+        f"xmlns='http://www.w3.org/2000/svg' role='img' "
+        f"style='font:10px monospace'>"
+        f"<line x1='{pad}' y1='{h - pad}' x2='{width - pad}' "
+        f"y2='{h - pad}' stroke='#888888'/>"
+        f"<line x1='{pad}' y1='{pad}' x2='{pad}' y2='{h - pad}' "
+        f"stroke='#888888'/>"
+        f"<polyline points='{' '.join(pts)}' fill='none' "
+        f"stroke='#7f5af0' stroke-width='1.5'/>"
+        f"<text x='{pad + 4}' y='{pad}' fill='currentColor'>"
+        f"cumulative TPU share of map assignments "
+        f"(final {share:.0%}, n={len(seq)})</text></svg>")
 
 
 def timeline_svg(tasks: list[dict], width: int = 900) -> str:
@@ -252,7 +287,8 @@ class JobHistoryServer:
             f"{summary.get('num_maps', '?')} maps / "
             f"{summary.get('num_reduces', '?')} reduces · accel "
             f"{summary.get('acceleration_factor') or '—'}</p>"
-            f"<h2>Timeline</h2>" + timeline_svg(tasks)
+            + placement_svg(summary.get("placement") or {})
+            + f"<h2>Timeline</h2>" + timeline_svg(tasks)
             + f"<h2>Attempts ({len(rows)})</h2>"
             + html_table(["attempt", "state", "backend", "tracker",
                           "runtime", "shuffle bytes"], rows)

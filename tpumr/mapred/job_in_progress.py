@@ -175,6 +175,13 @@ class JobInProgress:
         self._tpu_ewma = 0.0
         # completion events for reduce fetchers (≈ TaskCompletionEvents)
         self.completion_events: list[dict] = []
+        #: per-assignment backend placement: (seconds-since-submit, 'T'|'c')
+        #: appended at every map assignment — the raw series behind the
+        #: hybrid scheduler's convergence curve, so ANY run's status or
+        #: history doubles as the convergence artifact (SURVEY §5: backend
+        #: placement is a first-class metric). Bounded; overflow counted.
+        self.placement_series: list = []
+        self.placement_dropped = 0
 
     # ------------------------------------------------------------ queries
 
@@ -270,6 +277,7 @@ class JobInProgress:
             self._pending_maps.discard(idx)
             tip = self.maps[idx]
             tip.state = "running"
+            self._record_placement(run_on_tpu)
             attempt = tip.new_attempt()
             tip.report.state = TaskState.RUNNING
             tip.report.start_time = tip.report.start_time or time.time()
@@ -308,6 +316,7 @@ class JobInProgress:
                 continue
             attempt = tip.new_attempt()
             self.speculative_map_tasks += 1
+            self._record_placement(run_on_tpu)
             tip.report.run_on_tpu = run_on_tpu
             tip.report.tpu_device_id = tpu_device_id
             return Task(attempt, partition=tip.partition,
@@ -615,6 +624,32 @@ class JobInProgress:
 
     # ------------------------------------------------------------ wire
 
+    _PLACEMENT_CAP = 50_000
+
+    def _record_placement(self, run_on_tpu: bool) -> None:
+        """One map assignment's backend, time-stamped relative to submit.
+        Caller holds ``self.lock``."""
+        if len(self.placement_series) >= self._PLACEMENT_CAP:
+            self.placement_dropped += 1
+            return
+        self.placement_series.append(
+            (round(time.time() - self.start_time, 3),
+             "T" if run_on_tpu else "c"))
+
+    def placement_timeline(self) -> dict:
+        """The convergence curve the hybrid scheduler is judged on
+        (≈ JobQueueTaskScheduler.java:290-327 starvation rule observed
+        from outside): the full assignment sequence ('TcccTTcT…') plus
+        per-assignment timestamps, so a plot falls out of any finished
+        run's history. Cumulative counts are derivable from ``seq`` in
+        one pass — deliberately NOT serialized (a 50k-map job's history
+        event would triple in size for redundant data)."""
+        with self.lock:
+            series = list(self.placement_series)
+        return {"seq": "".join(b for _, b in series),
+                "t": [t for t, _ in series],
+                "dropped": self.placement_dropped}
+
     def status_dict(self) -> dict:
         with self.lock:
             return {
@@ -631,5 +666,11 @@ class JobInProgress:
                 "cpu_map_mean_time": self.cpu_map_mean_time(),
                 "tpu_map_mean_time": self.tpu_map_mean_time(),
                 "acceleration_factor": self.acceleration_factor(),
+                # placement TAIL only: status_dict rides every polled
+                # get_job_status RPC (clients poll at 5 Hz), so it must
+                # stay small on 50k-map jobs; the full timeline ships
+                # once, in the JOB_FINISHED history event
+                "placement_seq": "".join(
+                    b for _, b in self.placement_series[-512:]),
                 "error": self.error,
             }

@@ -350,7 +350,13 @@ class JobMaster:
                      f"<p>TPU maps {st['finished_tpu_maps']} · CPU maps "
                      f"{st['finished_cpu_maps']} · mean map time "
                      f"tpu {st['tpu_map_mean_time']:.3f}s / "
-                     f"cpu {st['cpu_map_mean_time']:.3f}s</p>"]
+                     f"cpu {st['cpu_map_mean_time']:.3f}s</p>",
+                     # assignment-order placement (T=tpu, c=cpu): the
+                     # convergence curve at a glance — optional
+                     # scheduling shows as a c→T flip mid-string
+                     (f"<p>placement <code>"
+                      f"{html_escape(st['placement_seq'][-512:])}"
+                      f"</code></p>" if st.get("placement_seq") else "")]
             for kind in ("map", "reduce"):
                 reports = self.get_task_reports(jid, kind)
                 rows = []
