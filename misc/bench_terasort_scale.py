@@ -84,6 +84,14 @@ def main() -> int:
         rows["terasort_s"] = round(time.time() - t0, 1)
         assert result.successful, result.error
         cv = result.counters.value
+        if device:
+            # which backend ACTUALLY sorted (the gang reduce stamps a
+            # counter when jax resolved to a real accelerator) — the
+            # artifact must say "backend: tpu" only when it was
+            from tpumr.core.counters import BackendCounter
+            rows["backend"] = ("tpu" if cv(
+                BackendCounter.GROUP,
+                BackendCounter.DEVICE_SORT_ON_ACCEL) else "cpu")
         rows["shuffle_bytes"] = cv(TaskCounter.FRAMEWORK_GROUP,
                                    TaskCounter.REDUCE_SHUFFLE_BYTES)
         rows["segments_mem"] = cv(

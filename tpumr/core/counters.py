@@ -43,6 +43,11 @@ class BackendCounter:
     CPU_BATCH_MAP_TASKS = "CPU_BATCH_MAP_TASKS"
     TPU_SHUFFLE_RECORDS = "TPU_SHUFFLE_RECORDS"
     TPU_SHUFFLE_BYTES = "TPU_SHUFFLE_BYTES"
+    #: gang reduces whose device sort ran on a REAL accelerator backend
+    #: (vs the same vectorized path on the CPU backend) — lets a job
+    #: artifact PROVE which backend sorted it, not just that the dense
+    #: path ran
+    DEVICE_SORT_ON_ACCEL = "DEVICE_SORT_ON_ACCEL"
     SHUFFLE_HOST_FALLBACKS = "SHUFFLE_HOST_FALLBACKS"
     GROUP = "tpumr.BackendCounter"
 

@@ -294,6 +294,9 @@ def run_device_reduce(conf: Any, task: Task, dense_fetch: DenseFetchFn,
             reporter.incr_counter(BackendCounter.GROUP,
                                   BackendCounter.TPU_SHUFFLE_BYTES,
                                   int(records.nbytes))
+            if jax.default_backend() != "cpu":
+                reporter.incr_counter(BackendCounter.GROUP,
+                                      BackendCounter.DEVICE_SORT_ON_ACCEL)
     if shards is None:
         # host fallback: full numpy lexsort, then the same range split
         # (≈ the disk-spill fallback role; correctness never depends on
