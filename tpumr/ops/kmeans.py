@@ -169,7 +169,20 @@ def _load_centroids(conf) -> np.ndarray:
 
 def clear_centroid_cache() -> None:
     """Iterative drivers rewrite the centroid file between rounds."""
+    from tpumr.ops.devcache import clear_device_cache
     _centroid_cache.clear()
+    clear_device_cache("kmeans-centroids:")
+
+
+def _device_centroids(conf):
+    """Centroids as a DEVICE-resident array, uploaded once per
+    (file, device) instead of once per map task — on a tunneled chip the
+    per-task re-upload was the warm-job wall-clock (25 round-trips of
+    identical bytes per job; see ops/devcache.py)."""
+    from tpumr.ops.devcache import device_cached
+    host = _load_centroids(conf)
+    tag = f"kmeans-centroids:{conf.get('tpumr.kmeans.centroids')}"
+    return device_cached(tag, host.astype(np.float32, copy=False), conf)
 
 
 def assign_and_partials_numpy(points: np.ndarray, centroids: np.ndarray,
@@ -220,7 +233,7 @@ class KMeansAssignKernel(KernelMapper):
         """Two-phase protocol: dispatch the assign+partials program and
         hand the [k,d] sums / [k] counts back as device arrays — the
         runner fetches a whole window of tasks in one roundtrip."""
-        centroids = _load_centroids(conf)
+        centroids = _device_centroids(conf)
         use_pallas = conf.get_boolean("tpumr.kmeans.use.pallas", False)
         _assign, sums, counts = assign_and_partials(batch.values, centroids,
                                                     use_pallas=use_pallas)

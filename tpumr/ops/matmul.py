@@ -38,7 +38,20 @@ def _load_b(conf) -> np.ndarray:
 
 
 def clear_b_cache() -> None:
+    from tpumr.ops.devcache import clear_device_cache
     _b_cache.clear()
+    clear_device_cache("matmul-b:")
+
+
+def _device_b(conf):
+    """B as a DEVICE-resident array, uploaded once per (file, device):
+    without this every map task re-shipped the full B (64 MB at 4096²)
+    over the tunnel — the dominant term of the measured 0.2× device
+    matmul row (see ops/devcache.py)."""
+    from tpumr.ops.devcache import device_cached
+    host = _load_b(conf)
+    return device_cached(f"matmul-b:{conf.get('tpumr.matmul.b')}",
+                         host, conf)
 
 
 @jax.jit
@@ -72,7 +85,7 @@ class MatmulBlockKernel(KernelMapper):
     cpu_mapper_class = MatmulCpuMapper
 
     def map_batch_launch(self, batch, conf, task):
-        b = _load_b(conf)
+        b = _device_b(conf)
         bf16 = conf.get_boolean("tpumr.matmul.bf16", True)
         c = block_matmul(batch.values, b, bf16=bf16)
         row0 = int(batch.ids[0]) if batch.ids is not None else 0
