@@ -28,6 +28,21 @@ def _count_inside(seed: int, n: int):
     return jnp.sum(jnp.sum(pts * pts, axis=1) <= 1.0).astype(jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
+def _count_inside_many(seeds, n: int):
+    """All of a task's same-size sample blocks in ONE dispatch:
+    ``lax.map`` runs the blocks sequentially on device (same transient
+    memory as one block), so a task costs one small seed-array upload +
+    one program launch instead of a scalar upload + dispatch per record
+    — on a tunneled runtime the per-record launches were the task's
+    wall-clock. Per-seed results are bit-identical to :func:`_count_inside`."""
+    def one(seed):
+        key = jax.random.key(seed)
+        pts = jax.random.uniform(key, (n, 2), dtype=jnp.float32)
+        return jnp.sum(jnp.sum(pts * pts, axis=1) <= 1.0).astype(jnp.int32)
+    return jax.lax.map(one, seeds)
+
+
 def _parse(value) -> tuple[int, int]:
     s = value.decode() if isinstance(value, (bytes, bytearray)) else str(value)
     seed_s, n_s = s.split()
@@ -49,20 +64,26 @@ class PiSamplerKernel(KernelMapper):
     cpu_mapper_class = PiCpuMapper
 
     def map_batch_launch(self, batch, conf, task):
-        """Dispatch every sample block without blocking — the per-block
-        device counters stay on device until the runner's single fetch
-        (the old path synced once per record: one tunnel roundtrip per
-        (seed, n) line)."""
-        counts = []
+        """Group the task's records by sample count and launch ONE
+        program per distinct n (usually exactly one) — the per-block
+        device counters stay on device until the runner's single fetch.
+        The original path synced once per record; the first batched
+        version still dispatched once per record."""
+        from collections import defaultdict
+        groups: "dict[int, list[int]]" = defaultdict(list)
         total = 0
         for i in range(batch.num_records):
             seed, n = _parse(batch.value(i))
-            counts.append(_count_inside(seed, n))
+            groups[n].append(seed)
             total += n
+        counts = [
+            _count_inside_many(np.asarray(seeds, np.uint32), n)
+            for n, seeds in groups.items()]
         return {"inside": counts, "total": total}
 
     def map_batch_drain(self, fetched, conf, task) -> Iterable[tuple]:
-        yield "inside", sum(int(c) for c in fetched["inside"])
+        yield "inside", sum(int(np.asarray(c).sum())
+                            for c in fetched["inside"])
         yield "total", int(fetched["total"])
 
     def map_batch_cpu(self, batch, conf, task) -> Iterable[tuple]:
