@@ -1,0 +1,200 @@
+/* fuzz_recio — deterministic fuzz + property checks for the Record I/O
+ * binary codec (ASAN/UBSAN enforced, native/sanitize.mk):
+ *
+ * A: vlong roundtrip across the value space (including the ±112/±120
+ *    length-byte boundaries and 8-byte extremes).
+ * B: random garbage through recio_validate with a battery of
+ *    descriptors — must return -1 or a count, never crash/overrun.
+ * C: VALID records (generated from the descriptor) must validate, and
+ *    truncations of them must fail cleanly.
+ *
+ * argv: [iterations]
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+long recio_vlong_write(uint8_t* buf, size_t cap, int64_t v);
+long recio_vlong_read(const uint8_t* buf, size_t len, int64_t* out);
+int recio_desc_check(const char* desc);
+int recio_skip(const uint8_t* buf, size_t len, const char* desc,
+               size_t* pos);
+long recio_validate(const uint8_t* buf, size_t len, const char* desc);
+
+static uint64_t rng_state = 0x243F6A8885A308D3ull;
+
+static uint64_t rnd(void) {
+  uint64_t x = rng_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return rng_state = x;
+}
+
+/* advance a descriptor cursor past one type, emitting nothing */
+static void desc_skip(const char** d) {
+  switch (*(*d)++) {
+    case '[':
+      desc_skip(d);
+      (*d)++;                           /* ']' */
+      return;
+    case '{':
+      desc_skip(d);
+      desc_skip(d);
+      (*d)++;                           /* '}' */
+      return;
+    case '(':
+      while (**d != ')') desc_skip(d);
+      (*d)++;
+      return;
+    default:
+      return;
+  }
+}
+
+static const char* DESCS[] = {
+    "i", "s", "B", "bzifd", "i[s]{bi}", "([i]s)d", "[[i]]",
+    "{s{is}}", "(bz(if)s)[B]", "[{i(sz)}]",
+};
+
+/* append one VALID value of type **d to buf (advances both) */
+static size_t gen_value(uint8_t* buf, size_t cap, size_t pos,
+                        const char** d, int depth) {
+  if (pos + 64 > cap) {             /* keep headroom; emit minimal */
+    depth = 99;
+  }
+  char t = *(*d)++;
+  int64_t n;
+  long w;
+  switch (t) {
+    case 'b':
+    case 'z':
+      buf[pos++] = (uint8_t)rnd();
+      return pos;
+    case 'i':
+      w = recio_vlong_write(buf + pos, cap - pos,
+                            (int64_t)rnd() >> (rnd() % 64));
+      return pos + (size_t)w;
+    case 'f':
+      for (int i = 0; i < 4; i++) buf[pos++] = (uint8_t)rnd();
+      return pos;
+    case 'd':
+      for (int i = 0; i < 8; i++) buf[pos++] = (uint8_t)rnd();
+      return pos;
+    case 's':
+    case 'B':
+      n = (depth > 4) ? 0 : (int64_t)(rnd() % 16);
+      w = recio_vlong_write(buf + pos, cap - pos, n);
+      pos += (size_t)w;
+      for (int64_t i = 0; i < n; i++)
+        buf[pos++] = (t == 's') ? (uint8_t)('a' + rnd() % 26)
+                                : (uint8_t)rnd();
+      return pos;
+    case '[': {
+      n = (depth > 4) ? 0 : (int64_t)(rnd() % 4);
+      w = recio_vlong_write(buf + pos, cap - pos, n);
+      pos += (size_t)w;
+      const char* elem = *d;
+      for (int64_t i = 0; i < n; i++) {
+        const char* e = elem;
+        pos = gen_value(buf, cap, pos, &e, depth + 1);
+        *d = e;
+      }
+      if (n == 0) desc_skip(d);         /* still advance past elem type */
+      (*d)++;                           /* ']' */
+      return pos;
+    }
+    case '{': {
+      n = (depth > 4) ? 0 : (int64_t)(rnd() % 3);
+      w = recio_vlong_write(buf + pos, cap - pos, n);
+      pos += (size_t)w;
+      const char* kv = *d;
+      for (int64_t i = 0; i < n; i++) {
+        const char* e = kv;
+        pos = gen_value(buf, cap, pos, &e, depth + 1);
+        pos = gen_value(buf, cap, pos, &e, depth + 1);
+        *d = e;
+      }
+      if (n == 0) {
+        desc_skip(d);
+        desc_skip(d);
+      }
+      (*d)++;                           /* '}' */
+      return pos;
+    }
+    case '(': {
+      while (**d != ')') pos = gen_value(buf, cap, pos, d, depth + 1);
+      (*d)++;
+      return pos;
+    }
+    default:
+      fprintf(stderr, "gen: bad descriptor char %c\n", t);
+      exit(2);
+  }
+}
+
+int main(int argc, char** argv) {
+  long iters = argc > 1 ? atol(argv[1]) : 2000;
+  uint8_t buf[4096];
+  int64_t v, back;
+
+  /* A: vlong roundtrip */
+  for (long it = 0; it < iters; it++) {
+    v = (int64_t)rnd() >> (rnd() % 64);
+    long w = recio_vlong_write(buf, sizeof buf, v);
+    if (w < 1 || recio_vlong_read(buf, (size_t)w, &back) != w ||
+        back != v) {
+      fprintf(stderr, "vlong roundtrip failed for %lld\n",
+              (long long)v);
+      return 1;
+    }
+  }
+  int64_t edges[] = {0, 127, 128, -112, -113, 255, 256, -129,
+                     (int64_t)1 << 62, -((int64_t)1 << 62),
+                     INT64_MAX, INT64_MIN};
+  for (size_t i = 0; i < sizeof edges / sizeof *edges; i++) {
+    long w = recio_vlong_write(buf, sizeof buf, edges[i]);
+    if (w < 1 || recio_vlong_read(buf, (size_t)w, &back) != w ||
+        back != edges[i]) {
+      fprintf(stderr, "vlong edge failed\n");
+      return 1;
+    }
+  }
+
+  size_t ndesc = sizeof DESCS / sizeof *DESCS;
+  for (size_t i = 0; i < ndesc; i++) {
+    if (recio_desc_check(DESCS[i]) != 0) {
+      fprintf(stderr, "descriptor %s rejected\n", DESCS[i]);
+      return 1;
+    }
+  }
+
+  /* B: garbage in -> no crash */
+  for (long it = 0; it < iters; it++) {
+    size_t n = rnd() % sizeof buf;
+    for (size_t i = 0; i < n; i++) buf[i] = (uint8_t)rnd();
+    (void)recio_validate(buf, n, DESCS[rnd() % ndesc]);
+  }
+
+  /* C: valid records validate; truncations fail cleanly */
+  for (long it = 0; it < iters; it++) {
+    const char* desc = DESCS[rnd() % ndesc];
+    const char* d = desc;
+    size_t n = 0;
+    while (*d) n = gen_value(buf, sizeof buf, n, &d, 0);
+    if (recio_validate(buf, n, desc) != 1) {
+      fprintf(stderr, "valid record rejected (desc %s, %zu bytes)\n",
+              desc, n);
+      return 1;
+    }
+    if (n > 1) {
+      size_t cut = 1 + rnd() % (n - 1);
+      long r = recio_validate(buf, cut, desc);
+      (void)r;                        /* -1 or short count, NO crash */
+    }
+  }
+  printf("recio fuzz clean (%ld iterations)\n", iters);
+  return 0;
+}

@@ -347,6 +347,10 @@ class TestSanitizers:
         b = self.build_fuzz(os.path.join(REPO, "native", "tlz"))
         self.run_fuzz(os.path.join(b, "fuzz_tlz"), "1200")
 
+    def test_recio_fuzz_asan(self):
+        b = self.build_fuzz(os.path.join(REPO, "native", "recordio"))
+        self.run_fuzz(os.path.join(b, "fuzz_recio"), "2000")
+
     def test_pipes_stream_fuzz_asan(self):
         if shutil.which("g++") is None:
             pytest.skip("no C++ toolchain")

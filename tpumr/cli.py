@@ -55,6 +55,7 @@ Clients:
   queue ...            queue info: -list | -info Q [-showJobs] | -showacls
   mradmin -refreshQueues|-refreshNodes   live-reload queue ACLs / host lists
   daemonlog ...        -getlevel H:P LOGGER | -setlevel H:P LOGGER LEVEL
+  rcc FILE.jr ...      compile Record I/O DDL to record classes (= bin/rcc)
   version              print the version
 """
 
@@ -940,6 +941,12 @@ def cmd_fetchdt(conf, argv: list[str]) -> int:
     return cmd_keys(conf, ["token", "-nn", "-out", argv[0]])
 
 
+def cmd_rcc(conf, argv: list[str]) -> int:
+    """≈ bin/rcc: compile Record I/O DDL to record classes."""
+    from tpumr.recordio.rcc import main as rcc_main
+    return rcc_main(argv)
+
+
 def cmd_version(conf, argv: list[str]) -> int:
     print(f"tpumr {VERSION}")
     return 0
@@ -970,6 +977,7 @@ COMMANDS = {
     "mradmin": cmd_mradmin,
     "daemonlog": cmd_daemonlog,
     "fetchdt": cmd_fetchdt,
+    "rcc": cmd_rcc,
     "version": cmd_version,
 }
 

@@ -77,7 +77,12 @@ class PiSamplerKernel(KernelMapper):
             groups[n].append(seed)
             total += n
         counts = [
-            _count_inside_many(np.asarray(seeds, np.uint32), n)
+            # mask to uint32 EXPLICITLY: numpy 2 refuses out-of-range
+            # casts, and jax folds seeds to uint32 anyway (verified
+            # key(-1) == key(2**32-1)) — negative/wide seeds keep the
+            # per-record path's semantics instead of crashing the task
+            _count_inside_many(np.asarray(
+                [s & 0xFFFFFFFF for s in seeds], np.uint32), n)
             for n, seeds in groups.items()]
         return {"inside": counts, "total": total}
 

@@ -1,0 +1,21 @@
+"""Record I/O — DDL-driven serialization (≈ org.apache.hadoop.record +
+bin/rcc + src/c++/librecordio; deprecated upstream but part of the
+1.0.3 surface, so implemented rather than gated).
+
+- :mod:`tpumr.recordio.runtime` — Record base + Binary/Csv/Xml record
+  streams, wire-compatible with the reference's three formats.
+- :mod:`tpumr.recordio.rcc` — the DDL compiler (``tpumr rcc``).
+- ``native/recordio`` — C codec for the binary wire format (librecordio
+  role): validate/skip records without a Python runtime, fuzz-hardened
+  like the tree's other native parsers.
+"""
+
+from tpumr.recordio.runtime import (BinaryRecordInput, BinaryRecordOutput,
+                                    CsvRecordInput, CsvRecordOutput,
+                                    Record, XmlRecordInput,
+                                    XmlRecordOutput, read_vlong,
+                                    write_vlong)
+
+__all__ = ["Record", "BinaryRecordInput", "BinaryRecordOutput",
+           "CsvRecordInput", "CsvRecordOutput", "XmlRecordInput",
+           "XmlRecordOutput", "read_vlong", "write_vlong"]

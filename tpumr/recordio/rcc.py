@@ -1,0 +1,265 @@
+"""``rcc`` — the Record I/O DDL compiler (≈ ``bin/rcc`` driving
+``org.apache.hadoop.record.compiler.generated.Rcc`` + JavaGenerator/
+CppGenerator, src/core/org/apache/hadoop/record/compiler/).
+
+Grammar (the reference's .jr files, src/test/ddl/*.jr):
+
+    include "other.jr"
+    module some.dotted.name {
+        class RecName {
+            <type> <field>;
+            ...
+        }
+    }
+
+with types ``byte boolean int long float double ustring buffer``,
+``vector<T>``, ``map<K,V>``, and references to other record classes
+(bare or module-qualified). ``//``, ``/* */`` comments anywhere.
+
+Where the reference generates per-field Java/C++ method bodies, this
+generator emits a Python module of :class:`tpumr.recordio.runtime.Record`
+subclasses carrying declarative ``FIELDS`` typespecs — the runtime
+walker does the rest, for all three wire formats.
+
+CLI: ``tpumr rcc <file.jr …> [--dest DIR]`` writes ``<module>.py`` per
+DDL module (dots → underscores), mirroring bin/rcc's per-language
+destdir layout.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+PRIMS = {"byte", "boolean", "int", "long", "float", "double",
+         "ustring", "buffer"}
+
+
+class DdlError(ValueError):
+    pass
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", text)
+
+
+class _Tokens:
+    _TOK = re.compile(r'"[^"]*"|[A-Za-z_][\w.]*|[{}<>,;]')
+
+    def __init__(self, text: str) -> None:
+        self.toks = self._TOK.findall(_strip_comments(text))
+        self.pos = 0
+
+    def peek(self) -> "str | None":
+        return self.toks[self.pos] if self.pos < len(self.toks) else None
+
+    def next(self) -> str:
+        tok = self.peek()
+        if tok is None:
+            raise DdlError("unexpected end of DDL")
+        self.pos += 1
+        return tok
+
+    def expect(self, want: str) -> str:
+        tok = self.next()
+        if tok != want:
+            raise DdlError(f"expected {want!r}, found {tok!r}")
+        return tok
+
+
+def parse_type(toks: _Tokens) -> Any:
+    """Typespec tree: primitive name, ('vector', t), ('map', k, v), or
+    ('ref', name) for record references resolved at generation time."""
+    tok = toks.next()
+    if tok in PRIMS:
+        return tok
+    if tok == "vector":
+        toks.expect("<")
+        elem = parse_type(toks)
+        toks.expect(">")
+        return ("vector", elem)
+    if tok == "map":
+        toks.expect("<")
+        key = parse_type(toks)
+        toks.expect(",")
+        val = parse_type(toks)
+        toks.expect(">")
+        return ("map", key, val)
+    if re.fullmatch(r"[A-Za-z_][\w.]*", tok):
+        return ("ref", tok)
+    raise DdlError(f"bad type token {tok!r}")
+
+
+def parse_ddl(text: str) -> "list[dict]":
+    """[{module, classes: [(name, [(field, typespec), …]), …],
+    includes: [path, …]}, …]"""
+    toks = _Tokens(text)
+    modules = []
+    includes = []
+    while toks.peek() is not None:
+        tok = toks.next()
+        if tok == "include":
+            path = toks.next()
+            if not (path.startswith('"') and path.endswith('"')):
+                raise DdlError(f"include needs a quoted path, got {path!r}")
+            includes.append(path[1:-1])
+            continue
+        if tok != "module":
+            raise DdlError(f"expected 'module' or 'include', got {tok!r}")
+        name = toks.next()
+        toks.expect("{")
+        classes = []
+        while toks.peek() != "}":
+            toks.expect("class")
+            cname = toks.next()
+            toks.expect("{")
+            fields = []
+            while toks.peek() != "}":
+                ts = parse_type(toks)
+                fname = toks.next()
+                if not re.fullmatch(r"[A-Za-z_]\w*", fname):
+                    raise DdlError(f"bad field name {fname!r}")
+                toks.expect(";")
+                fields.append((fname, ts))
+            toks.expect("}")
+            classes.append((cname, fields))
+        toks.expect("}")
+        modules.append({"module": name, "classes": classes,
+                        "includes": list(includes)})
+        includes = []
+    return modules
+
+
+def _pyspec(ts: Any, resolve) -> str:
+    """Typespec literal for the generated module; record references go
+    through ``resolve`` (local name, or cross-module via imports)."""
+    if isinstance(ts, str):
+        return repr(ts)
+    if ts[0] == "vector":
+        return f"(\"vector\", {_pyspec(ts[1], resolve)})"
+    if ts[0] == "map":
+        return (f"(\"map\", {_pyspec(ts[1], resolve)}, "
+                f"{_pyspec(ts[2], resolve)})")
+    return resolve(ts[1])
+
+
+def generate_python(modules: "list[dict]",
+                    registry: "dict[str, set] | None" = None
+                    ) -> "dict[str, str]":
+    """module-name → generated Python source.
+
+    Forward references inside a module are legal DDL (the reference
+    resolves them at link time), so FIELDS referencing a later class are
+    assigned after all classes exist. ``registry`` maps every module IN
+    SCOPE (this compile run + includes) to its class names: a
+    module-qualified reference (``other.mod.Rec``) — or a bare name
+    defined in exactly one other in-scope module — becomes a Python
+    import of the sibling generated module (dots → underscores, so all
+    generated files in one --dest dir import each other)."""
+    registry = dict(registry or {})
+    for mod in modules:
+        registry.setdefault(mod["module"], set()).update(
+            c for c, _ in mod["classes"])
+    out = {}
+    for mod in modules:
+        known = {c for c, _ in mod["classes"]}
+        imports: "set[tuple[str, str]]" = set()
+
+        def resolve(ref: str, known=known, mod=mod, imports=imports) -> str:
+            name = ref.rsplit(".", 1)[-1]
+            if "." in ref:
+                src_mod = ref.rsplit(".", 1)[0]
+                if src_mod == mod["module"] and name in known:
+                    return name
+                if name in registry.get(src_mod, ()):
+                    imports.add((src_mod, name))
+                    return name
+                raise DdlError(f"unknown record type {ref!r} (module "
+                               f"{src_mod!r} not in scope — missing "
+                               f"include?)")
+            if name in known:
+                return name
+            homes = [m for m, cs in registry.items()
+                     if name in cs and m != mod["module"]]
+            if len(homes) == 1:
+                imports.add((homes[0], name))
+                return name
+            raise DdlError(
+                f"unknown record type {ref!r}" if not homes else
+                f"ambiguous record type {ref!r} (in modules {homes}); "
+                f"qualify it")
+
+        body: "list[str]" = []
+        for cname, _fields in mod["classes"]:
+            body += [f"class {cname}(Record):", "    FIELDS = []", "", ""]
+        for cname, fields in mod["classes"]:
+            specs = ", ".join(
+                f"(\"{fname}\", {_pyspec(ts, resolve)})"
+                for fname, ts in fields)
+            body.append(f"{cname}.FIELDS = [{specs}]")
+        lines = [
+            '"""Generated by tpumr rcc — do not edit.',
+            "",
+            f"DDL module: {mod['module']}",
+            '"""',
+            "",
+            "from tpumr.recordio.runtime import Record",
+        ]
+        for src_mod, name in sorted(imports):
+            lines.append(
+                f"from {src_mod.replace('.', '_')} import {name}")
+        out[mod["module"]] = "\n".join(lines + [""] + body + [""])
+    return out
+
+
+def _parse_tree(path: str, seen: "dict[str, list]") -> None:
+    """Parse ``path`` and, recursively, everything it includes (relative
+    to the including file — bin/rcc's include semantics)."""
+    import os
+    real = os.path.realpath(path)
+    if real in seen:
+        return
+    with open(path) as f:
+        modules = parse_ddl(f.read())
+    seen[real] = modules
+    for mod in modules:
+        for inc in mod["includes"]:
+            _parse_tree(os.path.join(os.path.dirname(path), inc), seen)
+
+
+def compile_files(paths: "list[str]", dest: str = ".") -> "list[str]":
+    import os
+    seen: "dict[str, list]" = {}
+    roots = []
+    for path in paths:
+        _parse_tree(path, seen)
+        roots.append(os.path.realpath(path))
+    registry: "dict[str, set]" = {}
+    for modules in seen.values():
+        for mod in modules:
+            registry.setdefault(mod["module"], set()).update(
+                c for c, _ in mod["classes"])
+    written = []
+    # included-only modules generate too: they are the import targets
+    for real, modules in seen.items():
+        for name, src in generate_python(modules, registry).items():
+            target = os.path.join(dest, name.replace(".", "_") + ".py")
+            with open(target, "w") as f:
+                f.write(src)
+            written.append(target)
+    return written
+
+
+def main(argv: "list[str]") -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="tpumr rcc",
+        description="compile Record I/O DDL (.jr) to Python record "
+                    "classes (= bin/rcc --language python)")
+    ap.add_argument("files", nargs="+", help=".jr DDL files")
+    ap.add_argument("--dest", default=".", help="output directory")
+    args = ap.parse_args(argv)
+    for target in compile_files(args.files, args.dest):
+        print(target)
+    return 0
