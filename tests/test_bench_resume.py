@@ -211,3 +211,76 @@ def test_resume_context_unknown_scale_never_matches(bench):
     run's."""
     ctx = bench.resume_context({"backend_probe": {"backend": "cpu"}})
     assert ctx["small"] not in (True, False)
+
+
+class TestStallWatchdog:
+    """run_phase_subprocess's wedge watchdog: a zero-CPU no-progress
+    child dies early with a 'stalled' marker; a CPU-busy child is left
+    alone. Popen is stubbed so no real phase (or device) is involved."""
+
+    def _run(self, bench, monkeypatch, tmp_path, child_code, window="6",
+             timeout_s=120):
+        import subprocess as sp
+        real = sp.Popen
+
+        def stub(cmd, **kw):
+            return real([sys.executable, "-c", child_code],
+                        **{k: v for k, v in kw.items()
+                           if k in ("stdout", "start_new_session")})
+        monkeypatch.setattr(bench.subprocess, "Popen", stub)
+        monkeypatch.setenv("BENCH_SHARED_DIR", str(tmp_path))
+        monkeypatch.setenv("BENCH_STALL_WINDOW_S", window)
+        rows: dict = {}
+        ok = bench.run_phase_subprocess("kernels", timeout_s, rows,
+                                        stall_watch=True)
+        return ok, rows
+
+    def test_zero_cpu_child_killed_as_stalled(self, bench, monkeypatch,
+                                              tmp_path):
+        import time
+        t0 = time.time()
+        ok, rows = self._run(bench, monkeypatch, tmp_path,
+                             "import time; time.sleep(600)")
+        assert not ok
+        assert "stalled" in rows["bench_kernels"]
+        assert time.time() - t0 < 60
+
+    def test_busy_child_not_flagged(self, bench, monkeypatch, tmp_path):
+        ok, rows = self._run(
+            bench, monkeypatch, tmp_path,
+            "import time\nt=time.time()\nwhile time.time()-t<9: pass")
+        # child ran to completion (exits rc=0 without PHASE_ROWS -> not
+        # ok, but crucially NOT the stalled marker)
+        assert "stalled" not in rows.get("bench_kernels", "")
+
+    def test_progress_file_counts_as_liveness(self, bench, monkeypatch,
+                                              tmp_path):
+        # sleeper that ticks the progress file stays alive past the
+        # window, then exits on its own
+        code = (
+            "import os, time\n"
+            "p = os.environ['TPUMR_DEVICE_PROGRESS_FILE']\n"
+            "for _ in range(4):\n"
+            "    open(p, 'w').write('tick')\n"
+            "    time.sleep(2.5)\n")
+        ok, rows = self._run(bench, monkeypatch, tmp_path, code)
+        assert "stalled" not in rows.get("bench_kernels", "")
+
+    def test_tree_cpu_covers_detached_descendants(self, bench):
+        import subprocess as sp
+        import time
+        # grandchild in its OWN session burns CPU; the tree scan must
+        # still see it (pgroup scans would not)
+        child = sp.Popen([sys.executable, "-c", (
+            "import subprocess, sys, time\n"
+            "p = subprocess.Popen([sys.executable, '-c', "
+            "'t=__import__(\"time\");e=t.time()+4\\n"
+            "while t.time()<e: pass'], start_new_session=True)\n"
+            "p.wait()\n")], start_new_session=True)
+        try:
+            time.sleep(2.0)
+            cpu = bench._tree_cpu_s(child.pid)
+            assert cpu > 0.5, f"descendant CPU invisible: {cpu}"
+        finally:
+            child.kill()
+            child.wait()
