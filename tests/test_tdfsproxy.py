@@ -1,0 +1,220 @@
+"""tdfsproxy (≈ contrib/hdfsproxy): fail-closed path permissions, the
+three servlet routes, IP pinning, TLS, and tdfs backing."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpumr.mapred.jobconf import JobConf
+from tpumr.tools.tdfsproxy import (TdfsProxy, load_permissions,
+                                   path_permitted)
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    (tmp_path / "data" / "public").mkdir(parents=True)
+    (tmp_path / "data" / "public" / "a.txt").write_bytes(b"alpha")
+    (tmp_path / "data" / "public" / "sub").mkdir()
+    (tmp_path / "data" / "public" / "sub" / "b.bin").write_bytes(
+        b"\x00\x01beta")
+    (tmp_path / "secret").mkdir()
+    (tmp_path / "secret" / "s.txt").write_bytes(b"classified")
+    return tmp_path
+
+
+@pytest.fixture()
+def proxy(tree, tmp_path):
+    perms = tmp_path / "perms.toml"
+    perms.write_text(
+        '[alice]\npaths = ["/data/public", "/secret"]\n'
+        '[bob]\npaths = ["/data/public"]\n'
+        '[eve]\npaths = ["/data/public"]\nips = ["203.0.113.9"]\n')
+    conf = JobConf()
+    conf.set("tdfsproxy.permissions.file", str(perms))
+    conf.set("fs.default.name", f"file://{tree}")
+    p = TdfsProxy(conf, port=0, host="127.0.0.1").start()
+    yield p
+    p.stop()
+
+
+def fetch(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestPermissions:
+    def test_load_and_prefix_rules(self, tmp_path):
+        f = tmp_path / "p.toml"
+        f.write_text('[u]\npaths = ["/a/b"]\n')
+        perms = load_permissions(str(f))
+        assert path_permitted(perms, "u", "/a/b/c.txt", "1.2.3.4")
+        assert path_permitted(perms, "u", "/a/b", "1.2.3.4")
+        # /a/bc must NOT match the /a/b prefix; nor traversal escapes
+        assert not path_permitted(perms, "u", "/a/bc", "1.2.3.4")
+        assert not path_permitted(perms, "u", "/a/b/../../etc", "1.2.3.4")
+        assert not path_permitted(perms, "nobody", "/a/b", "1.2.3.4")
+
+    def test_requires_permissions_file(self):
+        with pytest.raises(ValueError, match="permissions.file"):
+            TdfsProxy(JobConf(), port=0)
+
+
+class TestRoutes:
+    def test_list_data_checksum(self, proxy):
+        code, body = fetch(
+            f"{proxy.url}/listPaths/data/public?user.name=alice")
+        assert code == 200
+        paths = json.loads(body)["paths"]
+        names = {p["path"].rsplit("/", 1)[-1] for p in paths
+                 if not p["is_dir"]}
+        assert names == {"a.txt", "b.bin"}
+        # namespace-relative, never the backing-store URI (trust
+        # boundary: no file:///... leak) — round-trip into /data is
+        # asserted in TestReviewRegressions.test_listing_roundtrips
+        assert all(p["path"].startswith("/data/public") for p in paths), paths
+
+        code, body = fetch(
+            f"{proxy.url}/data/data/public/a.txt?user.name=bob")
+        assert (code, body) == (200, b"alpha")
+
+        code, body = fetch(
+            f"{proxy.url}/fileChecksum/data/public/a.txt?user.name=bob")
+        assert code == 200
+        import hashlib
+        assert json.loads(body)["checksum"] == \
+            hashlib.md5(b"alpha").hexdigest()
+
+    def test_denials(self, proxy):
+        # no identity
+        code, _ = fetch(f"{proxy.url}/data/data/public/a.txt")
+        assert code == 401
+        # outside the user's prefixes (fail closed)
+        code, _ = fetch(f"{proxy.url}/data/secret/s.txt?user.name=bob")
+        assert code == 403
+        # unknown user
+        code, _ = fetch(f"{proxy.url}/data/data/public/a.txt?user.name=x")
+        assert code == 403
+        # IP-pinned user from the wrong address
+        code, _ = fetch(f"{proxy.url}/data/data/public/a.txt?user.name=eve")
+        assert code == 403
+        # traversal out of the prefix
+        code, _ = fetch(
+            f"{proxy.url}/data/data/public/../../secret/s.txt"
+            f"?user.name=bob")
+        assert code == 403
+        # alice IS allowed into /secret
+        code, body = fetch(f"{proxy.url}/data/secret/s.txt?user.name=alice")
+        assert (code, body) == (200, b"classified")
+
+    def test_missing_and_bad_paths(self, proxy):
+        code, _ = fetch(f"{proxy.url}/data/data/public/nope?user.name=bob")
+        assert code == 404
+        code, _ = fetch(f"{proxy.url}/data/data/public?user.name=bob")
+        assert code == 400          # directory, not a file
+        code, _ = fetch(f"{proxy.url}/bogusroute/x?user.name=bob")
+        assert code == 404
+
+
+class TestTls:
+    def test_https_serving(self, tree, tmp_path):
+        try:
+            import subprocess
+            r = subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-keyout", str(tmp_path / "key.pem"),
+                 "-out", str(tmp_path / "cert.pem"),
+                 "-days", "1", "-nodes", "-subj", "/CN=localhost"],
+                capture_output=True, timeout=60)
+            if r.returncode != 0:
+                pytest.skip("openssl unavailable")
+        except FileNotFoundError:
+            pytest.skip("openssl unavailable")
+        perms = tmp_path / "perms.toml"
+        perms.write_text('[alice]\npaths = ["/data/public"]\n')
+        conf = JobConf()
+        conf.set("tdfsproxy.permissions.file", str(perms))
+        conf.set("fs.default.name", f"file://{tree}")
+        conf.set("tdfsproxy.ssl.cert", str(tmp_path / "cert.pem"))
+        conf.set("tdfsproxy.ssl.key", str(tmp_path / "key.pem"))
+        p = TdfsProxy(conf, port=0, host="127.0.0.1").start()
+        try:
+            import ssl
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                    f"{p.url}/data/data/public/a.txt?user.name=alice",
+                    context=ctx) as r:
+                assert r.read() == b"alpha"
+            assert p.url.startswith("https://")
+        finally:
+            p.stop()
+
+
+class TestTdfsBacked:
+    def test_proxies_a_real_tdfs_namespace(self, tmp_path):
+        from tpumr.dfs.mini_cluster import MiniDFSCluster
+        from tpumr.fs import get_filesystem
+        with MiniDFSCluster(num_datanodes=1) as c:
+            fs = get_filesystem(c.uri + "/")
+            fs.write_bytes(f"{c.uri}/exports/report.txt", b"quarterly")
+            perms = tmp_path / "perms.toml"
+            perms.write_text('[auditor]\npaths = ["/exports"]\n')
+            conf = JobConf()
+            conf.set("tdfsproxy.permissions.file", str(perms))
+            conf.set("fs.default.name", c.uri)
+            p = TdfsProxy(conf, port=0, host="127.0.0.1").start()
+            try:
+                code, body = fetch(
+                    f"{p.url}/data/exports/report.txt?user.name=auditor")
+                assert (code, body) == (200, b"quarterly")
+                code, _ = fetch(
+                    f"{p.url}/data/exports/report.txt?user.name=stranger")
+                assert code == 403
+            finally:
+                p.stop()
+
+
+class TestReviewRegressions:
+    def test_empty_ip_pin_denies_all(self, tmp_path):
+        f = tmp_path / "p.toml"
+        f.write_text('[u]\npaths = ["/a"]\nips = []\n')
+        perms = load_permissions(str(f))
+        assert not path_permitted(perms, "u", "/a/x", "1.2.3.4")
+
+    def test_root_namespace_default(self, tree, tmp_path):
+        """fs.default.name='file:///': naive string joins mangle the
+        root URI into 'file:' — requests must still resolve."""
+        perms = tmp_path / "perms.toml"
+        perms.write_text(f'[u]\npaths = ["{tree}/data"]\n')
+        conf = JobConf()
+        conf.set("tdfsproxy.permissions.file", str(perms))
+        conf.set("fs.default.name", "file:///")
+        p = TdfsProxy(conf, port=0, host="127.0.0.1").start()
+        try:
+            code, body = fetch(
+                f"{p.url}/data{tree}/data/public/a.txt?user.name=u")
+            assert (code, body) == (200, b"alpha")
+        finally:
+            p.stop()
+
+    def test_listing_roundtrips_into_data(self, proxy):
+        code, body = fetch(
+            f"{proxy.url}/listPaths/data/public?user.name=alice")
+        files = [p for p in json.loads(body)["paths"] if not p["is_dir"]]
+        for ent in files:
+            code, data = fetch(
+                f"{proxy.url}/data{ent['path']}?user.name=alice")
+            assert code == 200 and len(data) == ent["length"]
+
+    def test_deleted_between_list_and_read_is_404(self, proxy, tree):
+        (tree / "data" / "public" / "gone.txt").write_bytes(b"x")
+        (tree / "data" / "public" / "gone.txt").unlink()
+        code, _ = fetch(
+            f"{proxy.url}/data/data/public/gone.txt?user.name=alice")
+        assert code == 404

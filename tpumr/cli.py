@@ -56,6 +56,7 @@ Clients:
   mradmin -refreshQueues|-refreshNodes   live-reload queue ACLs / host lists
   daemonlog ...        -getlevel H:P LOGGER | -setlevel H:P LOGGER LEVEL
   rcc FILE.jr ...      compile Record I/O DDL to record classes (= bin/rcc)
+  tdfsproxy -port P    read-only HTTP(S) storage gateway (= hdfsproxy)
   version              print the version
 """
 
@@ -947,6 +948,12 @@ def cmd_rcc(conf, argv: list[str]) -> int:
     return rcc_main(argv)
 
 
+def cmd_tdfsproxy(conf, argv: list[str]) -> int:
+    """≈ contrib/hdfsproxy: read-only HTTP(S) storage gateway."""
+    from tpumr.tools.tdfsproxy import main as proxy_main
+    return proxy_main(argv, conf)
+
+
 def cmd_version(conf, argv: list[str]) -> int:
     print(f"tpumr {VERSION}")
     return 0
@@ -978,6 +985,7 @@ COMMANDS = {
     "daemonlog": cmd_daemonlog,
     "fetchdt": cmd_fetchdt,
     "rcc": cmd_rcc,
+    "tdfsproxy": cmd_tdfsproxy,
     "version": cmd_version,
 }
 

@@ -1,0 +1,325 @@
+"""tdfsproxy — read-only HTTP(S) gateway into cluster storage.
+
+≈ the reference's hdfsproxy contrib (src/contrib/hdfsproxy/ —
+``HdfsProxy.java``, ``ProxyListPathsServlet``/``ProxyStreamFile``
+behind ``ProxyFilter``/``AuthorizationFilter``): expose file listing
+and data to clients OUTSIDE the cluster's trust boundary, gated by a
+per-user path allowlist, without giving them RPC access to the
+NameNode. Same servlet surface:
+
+- ``/listPaths/<path>``  — JSON recursive listing (the reference's XML
+  ListPathsServlet, JSON like the rest of this stack's status ports);
+- ``/data/<path>``       — streamed file bytes;
+- ``/fileChecksum/<path>`` — MD5 of the content (the MD5-of-block-MD5s
+  role; content MD5 since tdfs checksums are chunk-CRCs).
+
+Access model (user-permissions.xml role): ``tdfsproxy.permissions.file``
+is a TOML table of user → list of permitted path PREFIXES; absent user
+= denied (fail closed, like AuthorizationFilter). Identity: the
+reference authenticated by client TLS certificate
+(``user-certs.xml``); this stack's posture elsewhere is simple-auth +
+HMAC, so the proxy takes ``?user.name=`` and optionally pins each user
+to source IPs (``ips = [...]`` per user — the certs analog), and can
+serve TLS with ``tdfsproxy.ssl.cert``/``.key`` (stdlib ssl).
+Documented divergence: no client-certificate auth.
+
+Run: ``tpumr tdfsproxy -port 50479`` (0 = ephemeral, for tests).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import posixpath
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, unquote, urlparse
+
+PERMISSIONS_KEY = "tdfsproxy.permissions.file"
+SSL_CERT_KEY = "tdfsproxy.ssl.cert"
+SSL_KEY_KEY = "tdfsproxy.ssl.key"
+
+
+def load_permissions(path: str) -> "dict[str, dict]":
+    """{user: {"paths": [prefix, ...], "ips": [ip, ...] | None}}.
+    TOML (stdlib tomllib), e.g.::
+
+        [alice]
+        paths = ["/data/public", "/user/alice"]
+        [bob]
+        paths = ["/data/public"]
+        ips = ["10.0.0.5"]
+    """
+    import tomllib
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    perms: "dict[str, dict]" = {}
+    for user, spec in raw.items():
+        if not isinstance(spec, dict):
+            raise ValueError(f"bad permissions entry for {user!r}")
+        paths = [str(p) for p in spec.get("paths", [])]
+        ips = spec.get("ips")
+        # `ips = []` means "pinned to NO addresses" (deny all) — it must
+        # not collapse into None ("no restriction"); only an absent key
+        # leaves the user unpinned
+        perms[user] = {"paths": paths,
+                       "ips": ([str(i) for i in ips]
+                               if ips is not None else None)}
+    return perms
+
+
+def path_permitted(perms: "dict[str, dict]", user: str, path: str,
+                   remote_ip: str) -> bool:
+    """Fail-closed prefix check (AuthorizationFilter.checkPath role):
+    the normalized path must sit under one of the user's prefixes, and
+    the peer must match the user's IP pins when present."""
+    spec = perms.get(user)
+    if spec is None:
+        return False
+    if spec["ips"] is not None and remote_ip not in spec["ips"]:
+        return False
+    norm = posixpath.normpath("/" + path.lstrip("/"))
+    for prefix in spec["paths"]:
+        p = posixpath.normpath("/" + prefix.lstrip("/"))
+        if norm == p or norm.startswith(p.rstrip("/") + "/"):
+            return True
+    return False
+
+
+class TdfsProxy:
+    """The daemon: a threading HTTP(S) server over the FileSystem SPI."""
+
+    def __init__(self, conf: Any, port: int = 50479,
+                 host: str = "0.0.0.0") -> None:
+        self.conf = conf
+        perm_path = conf.get(PERMISSIONS_KEY)
+        if not perm_path:
+            raise ValueError(
+                f"{PERMISSIONS_KEY} is required (fail-closed: a proxy "
+                f"with no permissions file would deny everyone anyway)")
+        self.permissions = load_permissions(str(perm_path))
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            #: per-connection socket timeout: a stalled peer must cost
+            #: one handler thread for 30s, never wedge the daemon
+            timeout = 30
+
+            def log_message(self, *a):  # daemon logs, not stderr spam
+                pass
+
+            def do_GET(self) -> None:  # noqa: N802 — stdlib contract
+                self._streaming = False
+                try:
+                    proxy._serve(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — 500, not crash
+                    if self._streaming:
+                        # headers + partial body already sent: a second
+                        # response would be counted as FILE BYTES by the
+                        # client — drop the connection so the short read
+                        # is detectable instead of silently corrupt
+                        self.close_connection = True
+                        return
+                    try:
+                        proxy._send_error(self, 500,
+                                          f"{type(e).__name__}: {e}")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        cert = conf.get(SSL_CERT_KEY)
+        if cert:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(str(cert),
+                                keyfile=(str(conf.get(SSL_KEY_KEY))
+                                         if conf.get(SSL_KEY_KEY)
+                                         else None))
+            # handshake lazily in the per-connection handler thread: with
+            # the default handshake-on-accept, one client that connects
+            # and never sends a ClientHello parks the SINGLE accept loop
+            # — a one-socket denial of service
+            self.server.socket = ctx.wrap_socket(
+                self.server.socket, server_side=True,
+                do_handshake_on_connect=False)
+            self.scheme = "https"
+        else:
+            self.scheme = "http"
+        self._thread: "threading.Thread | None" = None
+
+    # ------------------------------------------------------------ plumbing
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self.server.server_address[0]
+        if host == "0.0.0.0":
+            host = "127.0.0.1"
+        return f"{self.scheme}://{host}:{self.port}"
+
+    def start(self) -> "TdfsProxy":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="tdfsproxy", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    @staticmethod
+    def _send_error(req: BaseHTTPRequestHandler, code: int,
+                    msg: str) -> None:
+        body = json.dumps({"error": msg}).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    # ------------------------------------------------------------ serving
+
+    def _fs(self, path: str):
+        from tpumr.fs import get_filesystem
+        return get_filesystem(path, self.conf)
+
+    def _default_uri(self):
+        from urllib.parse import urlsplit
+        default = str(self.conf.get("fs.default.name", "file:///") or
+                      "file:///")
+        if "://" not in default:
+            default = "file://" + default
+        return urlsplit(default)
+
+    def _qualify(self, path: str) -> str:
+        """Relative paths resolve against fs.default.name, matching the
+        reference's proxy forwarding to its configured namenode.
+        URI-aware joining — naive string concat mangles the root
+        namespace ('file:///'.rstrip('/') would yield 'file:')."""
+        if "://" in path:
+            # scheme-qualified requests could sidestep the prefix
+            # check's normalization — the proxy serves ONE namespace
+            raise ValueError("proxy paths are namespace-relative "
+                             "(no scheme://)")
+        from urllib.parse import urlunsplit
+        s = self._default_uri()
+        base = (s.path or "/").rstrip("/")
+        return urlunsplit((s.scheme, s.netloc,
+                           base + "/" + path.lstrip("/"), "", ""))
+
+    def _relativize(self, full: str) -> str:
+        """Back from a backing-store URI to the namespace-relative path
+        clients speak — listings must neither leak the internal layout
+        (file:///srv/cluster/..., namenode host:port) nor return paths
+        /data/<path> would reject."""
+        from urllib.parse import urlsplit
+        s = self._default_uri()
+        p = urlsplit(full if "://" in full else "file://" + full)
+        base = (s.path or "/").rstrip("/")
+        rel = p.path
+        if base and rel.startswith(base):
+            rel = rel[len(base):]
+        return "/" + rel.lstrip("/")
+
+    def _serve(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        user = query.get("user.name", "")
+        route, _, rel = parsed.path.lstrip("/").partition("/")
+        rel = unquote(rel)
+        if route not in ("listPaths", "data", "fileChecksum"):
+            self._send_error(req, 404,
+                             "routes: /listPaths/<path>, /data/<path>, "
+                             "/fileChecksum/<path>")
+            return
+        if not user:
+            self._send_error(req, 401, "user.name query param required")
+            return
+        remote_ip = req.client_address[0]
+        if not path_permitted(self.permissions, user, "/" + rel,
+                              remote_ip):
+            self._send_error(
+                req, 403, f"user {user!r} is not permitted {'/' + rel!r}"
+                          f" from {remote_ip}")
+            return
+        full = self._qualify("/" + rel)
+        fs = self._fs(full)
+        try:
+            # ONE metadata call: exists()+get_status() would double the
+            # namenode RPCs and turn a delete between them into a 500
+            st = fs.get_status(full)
+        except FileNotFoundError:
+            self._send_error(req, 404, f"no such path: /{rel}")
+            return
+        if route == "listPaths":
+            out = []
+            entries = ([st] if not st.is_dir
+                       else fs.list_files(full, recursive=True))
+            for ent in entries:
+                out.append({"path": self._relativize(str(ent.path)),
+                            "is_dir": ent.is_dir,
+                            "length": ent.length,
+                            "mtime": getattr(ent, "mtime", 0)})
+            body = json.dumps({"user": user, "paths": out}).encode()
+            req.send_response(200)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+            return
+        if st.is_dir:
+            self._send_error(req, 400, f"/{rel} is a directory")
+            return
+        if route == "fileChecksum":
+            md5 = hashlib.md5()
+            with fs.open(full) as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    md5.update(chunk)
+            body = json.dumps({"path": f"/{rel}", "algorithm": "MD5",
+                               "checksum": md5.hexdigest()}).encode()
+            req.send_response(200)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+            return
+        # /data — stream the file. The flag flips BEFORE headers go out:
+        # any later failure must close the connection, not append a 500
+        # into the declared Content-Length
+        req._streaming = True
+        req.send_response(200)
+        req.send_header("Content-Type", "application/octet-stream")
+        req.send_header("Content-Length", str(st.length))
+        req.end_headers()
+        with fs.open(full) as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                req.wfile.write(chunk)
+
+
+def main(argv: "list[str]", conf: Any = None) -> int:
+    import argparse
+
+    from tpumr.mapred.jobconf import JobConf
+    ap = argparse.ArgumentParser(
+        prog="tpumr tdfsproxy",
+        description="read-only HTTP(S) gateway into cluster storage "
+                    "(= contrib/hdfsproxy)")
+    ap.add_argument("-port", type=int, default=50479)
+    ap.add_argument("-host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+    conf = conf or JobConf()
+    proxy = TdfsProxy(conf, port=args.port, host=args.host).start()
+    print(f"tdfsproxy serving {conf.get('fs.default.name', 'file:///')} "
+          f"on {proxy.url} ({len(proxy.permissions)} users)")
+    try:
+        proxy._thread.join()
+    except KeyboardInterrupt:
+        proxy.stop()
+    return 0
