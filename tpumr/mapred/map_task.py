@@ -257,10 +257,15 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
         writer = out_fmt.get_record_writer(conf, wd, task.partition)
         collector = OutputCollector(
             writer.write, getattr(writer, "write_fixed_rows", None))
+        ok = False
         try:
             run_mapper(collector)
+            ok = True
         finally:
-            writer.close()
+            # same success gate as the reduce side: direct-write formats
+            # (DBOutputFormat) must not flush a failed task's buffer
+            abort = None if ok else getattr(writer, "abort", None)
+            (abort or writer.close)()
         reporter.incr_counter(BackendCounter.GROUP, backend_tasks)
         reporter.incr_counter(BackendCounter.GROUP, backend_ms,
                               int((time.time() - t0) * 1000))

@@ -112,6 +112,7 @@ def _run_reduce_phase(conf: Any, task: Task,
         writer.write(k, v)
 
     collector = OutputCollector(emit)
+    ok = False
     try:
         # optional seam: a reducer may take the collector up front so its
         # lifecycle (new-API setup/cleanup) runs even for zero-group
@@ -127,9 +128,15 @@ def _run_reduce_phase(conf: Any, task: Task,
             # drain any unconsumed values so grouping stays aligned
             for _ in values:
                 pass
+        ok = True
     finally:
         reducer.close()
-        writer.close()
+        # failed tasks tear the writer down through its abort seam when
+        # it has one: file writers are naturally safe (the committer
+        # never promotes a failed attempt's temp file) but direct-write
+        # formats (DBOutputFormat) must not flush a failed task's buffer
+        abort = None if ok else getattr(writer, "abort", None)
+        (abort or writer.close)()
 
 
 def group_by_key(stream: Iterator[tuple[bytes, bytes]],
