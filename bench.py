@@ -721,6 +721,41 @@ def bench_kernels(rows: dict) -> None:
             f"({km_flops / t_km / 1e12:.2f} TFLOP/s — HBM-bound at d={d}: "
             f"arith intensity ~{4 * k / (2 * 4):.0f} FLOP/byte)")
 
+    # --- the PALLAS assign kernel head-to-head vs XLA's fusion (the
+    # ops/kmeans.py design claim: XLA wins at narrow d because Mosaic's
+    # 128-lane tile pads d→128; pallas stays selectable for wide d).
+    # Device-only: interpret mode on cpu measures the interpreter.
+    if backend != "cpu":
+        from tpumr.ops.kmeans import pallas_assign
+
+        def kmp_build(iters):
+            def chain(p, c0):
+                def body(i, acc):
+                    a = pallas_assign(p, c0 + (0.0 * i))
+                    return acc + jnp.sum(a)
+                return lax.fori_loop(0, iters, body, jnp.int32(0))
+            return chain
+
+        try:
+            t_kp = timed_chain(kmp_build, pts, cents)
+        except Exception as e:  # noqa: BLE001 — a Mosaic lowering gap
+            rows["kernel_kmeans_pallas_onchip_s"] = \
+                f"failed: {type(e).__name__}"
+            log(f"[kernels] pallas assign failed to lower: {e}")
+        else:
+            if t_kp is None:
+                rows["kernel_kmeans_pallas_onchip_s"] = \
+                    "unmeasurable: noise"
+            else:
+                rows["kernel_kmeans_pallas_onchip_s"] = round(t_kp, 6)
+                rows["kernel_kmeans_pallas_mrec_per_s"] = round(
+                    n_pts / t_kp / 1e6, 1)
+                log(f"[kernels] pallas assign {n_pts / 1e6:.0f}M pts: "
+                    f"{t_kp * 1e3:.2f} ms/round "
+                    f"({n_pts / t_kp / 1e6:.0f} M rec/s) vs XLA "
+                    f"{(t_km or 0) * 1e3:.2f} ms — measured basis for "
+                    f"the d={d} XLA-default choice")
+
     # --- device sort + permutation-apply: the shuffle hot op (terasort
     # path sorts uint32 key columns, then gathers rows into order).
     n_rec = 200_000 if (SMALL or backend == "cpu") else 4_000_000
