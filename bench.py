@@ -1327,14 +1327,24 @@ def _archive_device_capture(rows: dict) -> None:
             merged = json.load(f)
     except (OSError, ValueError):
         pass
+    incoming = {k: v for k, v in rows.items()
+                if k != "prior_device_capture"}
     for name, _fn, _dev, _t in PHASES:
         # a phase that failed/stalled in an earlier run of this round but
         # completed now (phase timing present, no failure marker) must
-        # not keep wearing the archived failure marker
+        # not keep wearing the archived failure marker...
         if f"phase_{name}_s" in rows and f"bench_{name}" not in rows:
             merged.pop(f"bench_{name}", None)
-    merged.update({k: v for k, v in rows.items()
-                   if k != "prior_device_capture"})
+        # ...and the converse: a later wedged run of the SAME round that
+        # never reached this phase (skip/fail marker, no timing) must
+        # not stamp its marker over an earlier run's good archived rows
+        archived_good = (f"phase_{name}_s" in merged
+                         and f"bench_{name}" not in merged)
+        marker_only = (f"bench_{name}" in incoming
+                       and f"phase_{name}_s" not in incoming)
+        if archived_good and marker_only:
+            incoming.pop(f"bench_{name}")
+    merged.update(incoming)
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         _atomic_json_dump(merged, path, indent=2, sort_keys=True)
@@ -1466,7 +1476,8 @@ def run_phase_subprocess(name: str, timeout_s: float, rows: dict,
         # to show its first sign of life (backend init IS covered: the
         # round-4 chained hang parked exactly there)
         last_live = t0
-        cpu_at_live = 0.0
+        prev_cpu = 0.0
+        accrued_cpu = 0.0
         seen_mtime = 0.0
         while True:
             try:
@@ -1485,16 +1496,20 @@ def run_phase_subprocess(name: str, timeout_s: float, rows: dict,
                 return False
             if not stall_watch:
                 continue
+            # CPU accrues as per-SAMPLE deltas, clamped at 0: a task
+            # child exiting between samples drops its total from the
+            # tree sum, which must cost at most that one interval's
+            # delta — an absolute-baseline scheme reset the whole
+            # window's accrual on every child churn and could kill a
+            # busy mini-cluster phase as "stalled"
             cpu = _tree_cpu_s(child.pid)
-            if cpu < cpu_at_live:
-                # a descendant exited and took its CPU total with it —
-                # re-baseline; only future accrual counts as liveness
-                cpu_at_live = cpu
+            accrued_cpu += max(0.0, cpu - prev_cpu)
+            prev_cpu = cpu
             m = newest_mtime()
-            if m > seen_mtime or cpu - cpu_at_live >= \
-                    0.05 * stall_window:
+            if m > seen_mtime or accrued_cpu >= 0.05 * stall_window:
                 seen_mtime = max(seen_mtime, m)
-                last_live, cpu_at_live = now, cpu
+                last_live = now
+                accrued_cpu = 0.0
             elif now - last_live >= stall_window:
                 kill_phase(
                     child,

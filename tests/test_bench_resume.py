@@ -224,9 +224,13 @@ class TestStallWatchdog:
         real = sp.Popen
 
         def stub(cmd, **kw):
+            # env passes through: the progress-file liveness test's
+            # child reads TPUMR_DEVICE_PROGRESS_FILE from it — dropping
+            # env made that child crash instantly and the test vacuous
             return real([sys.executable, "-c", child_code],
                         **{k: v for k, v in kw.items()
-                           if k in ("stdout", "start_new_session")})
+                           if k in ("stdout", "start_new_session",
+                                    "env")})
         monkeypatch.setattr(bench.subprocess, "Popen", stub)
         monkeypatch.setenv("BENCH_SHARED_DIR", str(tmp_path))
         monkeypatch.setenv("BENCH_STALL_WINDOW_S", window)
@@ -284,3 +288,28 @@ class TestStallWatchdog:
         finally:
             child.kill()
             child.wait()
+
+
+class TestArchiveMarkers:
+    def test_wedged_rerun_cannot_mask_good_archive_rows(self, bench,
+                                                        tmp_path,
+                                                        monkeypatch):
+        import json, os
+        monkeypatch.setenv("TPUMR_BENCH_ROUND", "97")
+        monkeypatch.setattr(bench.os.path, "dirname",
+                            bench.os.path.dirname)
+        # write via the real helper into the repo misc dir, then clean
+        bench._archive_device_capture(
+            {"phase_kernels_s": 30.0,
+             "kernel_matmul_bf16_onchip_s": 0.001})
+        bench._archive_device_capture(
+            {"bench_kernels": "skipped: tpu unavailable"})
+        path = os.path.join(os.path.dirname(bench.__file__)
+                            if hasattr(bench, "__file__") else ".",
+                            "misc", "bench_device_r97.json")
+        try:
+            d = json.load(open(path))
+        finally:
+            os.unlink(path)
+        assert "bench_kernels" not in d, d
+        assert d["kernel_matmul_bf16_onchip_s"] == 0.001

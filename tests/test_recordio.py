@@ -352,3 +352,22 @@ class TestReviewRegressions:
         t0 = time.time()
         lib.recio_validate(data, len(data), b"[()]")
         assert time.time() - t0 < 1.0
+
+
+def test_xml_vector_of_empty_records_roundtrips():
+    """Round-5 review: empty structs emit no leaf tokens, so the XML
+    reader lost vector<EmptyRec> elements entirely; struct edges are
+    events now and the count survives."""
+    class E(Record):
+        FIELDS = []
+
+    class V(Record):
+        FIELDS = [("v", ("vector", E)), ("tail", "int")]
+    rec = V(v=[E(), E(), E()], tail=7)
+    buf = io.BytesIO()
+    rec.serialize(XmlRecordOutput(buf))
+    buf.seek(0)
+    back = V()
+    back.deserialize(XmlRecordInput(buf))
+    assert len(back.v) == 3 and back.tail == 7
+    assert back == rec

@@ -284,6 +284,13 @@ class ObjectStoreFileSystem(FileSystem):
         return moved
 
 
+def _token_digest(tok: str) -> str:
+    if not tok:
+        return ""
+    import hashlib
+    return hashlib.sha256(tok.encode()).hexdigest()[:12]
+
+
 def _make_factory(scheme: str):
     def factory(conf: Any, authority: str = "") -> ObjectStoreFileSystem:
         return ObjectStoreFileSystem(conf, authority=authority,
@@ -296,14 +303,17 @@ def _make_factory(scheme: str):
     # token). The token enters the salt as a digest so cache keys never
     # carry the credential itself.
     def _salt(conf):
+        # the ENV token is part of the credential identity too: without
+        # it, a cached instance pins whatever GCS_OAUTH_TOKEN held at
+        # first construction — expired tokens a fresh export can't fix,
+        # or one user's requests riding another's bearer
+        env_tok = os.environ.get("GCS_OAUTH_TOKEN", "")
         if conf is None:
-            return ("None", "None", "None")
+            return ("None", "None", "None", _token_digest(env_tok))
         tok = str(conf.get("fs.gs.auth.token") or "")
-        if tok:
-            import hashlib
-            tok = hashlib.sha256(tok.encode()).hexdigest()[:12]
         return (str(conf.get("fs.gs.emulation.dir")),
-                str(conf.get("fs.gs.endpoint")), tok)
+                str(conf.get("fs.gs.endpoint")),
+                _token_digest(tok), _token_digest(env_tok))
 
     factory.cache_salt = _salt
     return factory

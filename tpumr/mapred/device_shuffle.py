@@ -288,6 +288,10 @@ def run_device_reduce(conf: Any, task: Task, dense_fetch: DenseFetchFn,
         capacity = conf.get_int(CAPACITY_KEY, 0) or None
         shards, overflow = device_partition_sort(
             mesh, records, klen, splitters, num_ranges, capacity=capacity)
+        # liveness tick for the bench wedge watchdog: the gang sort is
+        # one long device stretch with no other transfer chokepoint
+        from tpumr.utils import progress
+        progress.tick(int(records.nbytes), "gang-sort")
         if shards is not None:  # count only records the device actually moved
             reporter.incr_counter(BackendCounter.GROUP,
                                   BackendCounter.TPU_SHUFFLE_RECORDS, n)

@@ -84,6 +84,14 @@ class HbmSplitCache:
             self._entries.clear()
             self._bytes = 0
 
+    def drop_where(self, pred) -> None:
+        """Evict every entry whose KEY satisfies ``pred`` (targeted
+        invalidation — e.g. one side-input family of the ops devcache)."""
+        with self._lock:
+            for k in [k for k in self._entries if pred(k)]:
+                _v, b = self._entries.pop(k)
+                self._bytes -= b
+
 
 _split_caches: dict[str, HbmSplitCache] = {}
 _cache_lock = threading.Lock()

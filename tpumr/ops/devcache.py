@@ -1,36 +1,49 @@
 """Device-resident cache for kernel SIDE INPUTS (DistributedCache files).
 
-The split cache (tpu_runner.split_cache) keeps each task's INPUT split
-resident in HBM; this is its twin for the constants every task of a job
-shares — K-Means centroids, the matmul B matrix — which the reference
-shipped per-node via the DistributedCache (filecache/) and each GPU task
-re-uploaded per launch. On a tunneled/remote TPU runtime that re-upload
-is the warm-job bottleneck: 25 map tasks × one host→device transfer each
-costs 25 network round-trips for bytes that are IDENTICAL every time
-(measured round 5: the kmeans warm job spent most of its wall-clock
-re-uploading a 1 KB centroid array per task; matmul re-shipped a 64 MB B
-per task, the dominant term of its 0.2× row).
+The split cache (tpu_runner.HbmSplitCache) keeps each task's INPUT split
+resident in HBM; this is the same machinery applied to the constants
+every task of a job shares — K-Means centroids, the matmul B matrix —
+which the reference shipped per-node via the DistributedCache
+(filecache/) and each GPU task re-uploaded per launch. On a
+tunneled/remote TPU runtime that re-upload is the warm-job bottleneck:
+25 map tasks × one host→device transfer each costs 25 network
+round-trips for bytes that are IDENTICAL every time (measured round 5:
+the kmeans warm job spent most of its wall-clock re-uploading a 1 KB
+centroid array per task; matmul re-shipped a 64 MB B per task, the
+dominant term of its 0.2× row).
 
-Keyed by (tag, current default device): tasks bind devices via
-``jax.default_device`` (tpu_runner._select_device), so per-device
-residency falls out of the key. Byte-budgeted LRU
-(``tpumr.ops.device.cache.mb``, default 1024) — centroids are nothing,
-but a few distinct B matrices must not silently pin HBM forever.
-
-Tags embed the source path; iterative drivers that rewrite a side file
-between rounds clear by prefix (clear_centroid_cache / clear_b_cache
-call :func:`clear_device_cache` with their tag family).
+One byte-budgeted :class:`HbmSplitCache` (``tpumr.ops.device.cache.mb``,
+default 1024, fixed at first use) keyed by (tag, current default
+device): tasks bind devices via ``jax.default_device``
+(tpu_runner._select_device), so per-device residency falls out of the
+key. Tags embed the source path; iterative drivers that rewrite a side
+file between rounds clear by prefix (clear_centroid_cache /
+clear_b_cache call :func:`clear_device_cache` with their tag family).
 """
 
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
 from typing import Any
 
 _lock = threading.Lock()
-#: (tag, device) -> (device_array, nbytes)
-_cache: "OrderedDict[tuple, tuple[Any, int]]" = OrderedDict()
+_cache = None           # lazily-built HbmSplitCache
+
+
+def _cache_for(conf: Any):
+    global _cache
+    with _lock:
+        if _cache is None:
+            budget_mb = 1024
+            if conf is not None:
+                try:
+                    budget_mb = int(conf.get("tpumr.ops.device.cache.mb",
+                                             1024))
+                except (TypeError, ValueError):
+                    pass
+            from tpumr.mapred.tpu_runner import HbmSplitCache
+            _cache = HbmSplitCache(budget_mb * 1024 * 1024)
+        return _cache
 
 
 def device_cached(tag: str, host_array: Any, conf: Any = None) -> Any:
@@ -39,34 +52,22 @@ def device_cached(tag: str, host_array: Any, conf: Any = None) -> Any:
     import jax
     import jax.numpy as jnp
 
-    dev = jax.config.jax_default_device
-    key = (tag, dev)
-    with _lock:
-        hit = _cache.get(key)
-        if hit is not None:
-            _cache.move_to_end(key)
-            return hit[0]
+    key = (tag, str(jax.config.jax_default_device))
+    cache = _cache_for(conf)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
     arr = jnp.asarray(host_array)          # the one upload
-    nbytes = int(getattr(arr, "nbytes", 0))
-    budget_mb = 1024
-    if conf is not None:
-        try:
-            budget_mb = int(conf.get("tpumr.ops.device.cache.mb", 1024))
-        except (TypeError, ValueError):
-            pass
-    with _lock:
-        _cache[key] = (arr, nbytes)
-        total = sum(b for _, b in _cache.values())
-        while total > budget_mb * 1024 * 1024 and len(_cache) > 1:
-            _k, (_a, b) = _cache.popitem(last=False)
-            total -= b
+    cache.put(key, arr, int(getattr(arr, "nbytes", 0)))
     return arr
 
 
 def clear_device_cache(tag_prefix: "str | None" = None) -> None:
     with _lock:
-        if tag_prefix is None:
-            _cache.clear()
-            return
-        for k in [k for k in _cache if k[0].startswith(tag_prefix)]:
-            del _cache[k]
+        cache = _cache
+    if cache is None:
+        return
+    if tag_prefix is None:
+        cache.clear()
+    else:
+        cache.drop_where(lambda k: k[0].startswith(tag_prefix))

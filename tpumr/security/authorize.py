@@ -149,6 +149,11 @@ class ServiceAuthorizationManager:
         self._ugi_ttl = float(conf.get(
             "hadoop.security.groups.cache.secs", 300) or 300)
         self._ugi_cache: "dict[str, tuple[float, Any]]" = {}
+        # the RPC server dispatches check() from concurrent handler
+        # threads; the eviction sweep iterates the dict, so lookups and
+        # inserts must serialize (group resolution itself stays outside
+        # the lock — it can hit the OS group database)
+        self._ugi_lock = __import__("threading").Lock()
 
     def acl_specs(self) -> "dict[str, str]":
         """Current specs per service key (for -refreshServiceAcl's
@@ -169,21 +174,24 @@ class ServiceAuthorizationManager:
         if user:
             import time
             name = str(user)
-            hit = self._ugi_cache.get(name)
             now = time.monotonic()
+            with self._ugi_lock:
+                hit = self._ugi_cache.get(name)
             if hit is not None and now - hit[0] < self._ugi_ttl:
                 ugi = hit[1]
             else:
                 ugi = server_side_ugi(name, self.conf)
-                if len(self._ugi_cache) >= 4096:
-                    # names are CALLER-asserted under simple auth: a
-                    # client spraying distinct users must not grow a
-                    # daemon-lifetime dict without bound. Drop expired
-                    # entries first; full-clear if they were all live.
-                    live = {k: v for k, v in self._ugi_cache.items()
-                            if now - v[0] < self._ugi_ttl}
-                    self._ugi_cache = live if len(live) < 4096 else {}
-                self._ugi_cache[name] = (now, ugi)
+                with self._ugi_lock:
+                    if len(self._ugi_cache) >= 4096:
+                        # names are CALLER-asserted under simple auth: a
+                        # client spraying distinct users must not grow a
+                        # daemon-lifetime dict without bound. Drop
+                        # expired entries first; full-clear if they were
+                        # all live.
+                        live = {k: v for k, v in self._ugi_cache.items()
+                                if now - v[0] < self._ugi_ttl}
+                        self._ugi_cache = live if len(live) < 4096 else {}
+                    self._ugi_cache[name] = (now, ugi)
         else:
             ugi = UserGroupInformation("anonymous", [])
         for key in keys:

@@ -43,7 +43,8 @@ SSL_KEY_KEY = "tdfsproxy.ssl.key"
 
 def load_permissions(path: str) -> "dict[str, dict]":
     """{user: {"paths": [prefix, ...], "ips": [ip, ...] | None}}.
-    TOML (stdlib tomllib), e.g.::
+    TOML (stdlib tomllib, Python >= 3.11) or JSON of the same shape when
+    the path ends ``.json`` (the 3.10 route), e.g.::
 
         [alice]
         paths = ["/data/public", "/user/alice"]
@@ -51,9 +52,19 @@ def load_permissions(path: str) -> "dict[str, dict]":
         paths = ["/data/public"]
         ips = ["10.0.0.5"]
     """
-    import tomllib
-    with open(path, "rb") as f:
-        raw = tomllib.load(f)
+    if path.endswith(".json"):
+        with open(path) as jf:
+            raw = json.load(jf)
+    else:
+        try:
+            import tomllib     # stdlib only since 3.11
+        except ImportError as e:
+            raise RuntimeError(
+                "TOML permissions need Python >= 3.11 (stdlib tomllib); "
+                "on 3.10 use a .json permissions file with the same "
+                "shape") from e
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
     perms: "dict[str, dict]" = {}
     for user, spec in raw.items():
         if not isinstance(spec, dict):
