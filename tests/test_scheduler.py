@@ -218,7 +218,12 @@ def test_lost_tracker_requeues_completed_maps():
     job.requeue_lost_attempts([aid])
     assert job.finished_maps == 0
     assert job.pending_map_count() == 2
-    assert not job.completion_events
+    # the event feed is append-only (cursor-based consumers): the lost
+    # output's event is OBSOLETE-marked + tombstoned, never removed
+    assert not [e for e in job.completion_events
+                if e.get("status") != "OBSOLETE"]
+    assert any(e["attempt_id"] == aid and e.get("status") == "OBSOLETE"
+               for e in job.completion_events)
 
 
 def test_per_job_minimize_mode_override():

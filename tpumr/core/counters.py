@@ -29,6 +29,9 @@ class TaskCounter:
     #: how many map outputs merged straight from RAM vs spilled local
     REDUCE_SHUFFLE_SEGMENTS_MEM = "REDUCE_SHUFFLE_SEGMENTS_MEM"
     REDUCE_SHUFFLE_SEGMENTS_DISK = "REDUCE_SHUFFLE_SEGMENTS_DISK"
+    #: fetch failures the copier survived (local retries, penalty box,
+    #: and fetch-failure reports to the master — shuffle fault tolerance)
+    REDUCE_FETCH_FAILURES = "REDUCE_FETCH_FAILURES"
     SPILLED_RECORDS = "SPILLED_RECORDS"
     FRAMEWORK_GROUP = "tpumr.TaskCounter"
 

@@ -152,6 +152,18 @@ def run_child(task_file: str) -> int:
                      os.path.join(local_dir, "shuffle"))
             fetch = RemoteChunkSource(conf, job_id, locate)
 
+            def report_fetch_failure(map_index: int,
+                                     map_attempt: str) -> None:
+                # best-effort: the copier's penalty/retry loop keeps the
+                # reduce alive even when the report can't be delivered
+                try:
+                    tracker.call("umbilical_report_fetch_failure", aid,
+                                 map_attempt)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            fetch.on_fetch_failure = report_fetch_failure
+
             maybe_profile(conf, task, prof_dir,
                           lambda: run_reduce_task(conf, task, fetch,
                                                   reporter))

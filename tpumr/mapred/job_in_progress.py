@@ -105,6 +105,12 @@ class JobInProgress:
         self.lock = threading.RLock()
         self.max_map_attempts = int(self.conf.get("mapred.map.max.attempts", 4))
         self.max_reduce_attempts = int(self.conf.get("mapred.reduce.max.attempts", 4))
+        #: distinct reducers that must report a map attempt's output
+        #: unfetchable before the master re-executes the map
+        #: (≈ JobInProgress.fetchFailureNotification's
+        #: MAX_FETCH_FAILURES_NOTIFICATIONS)
+        self.max_fetch_failures_per_map = int(self.conf.get(
+            "mapred.max.fetch.failures.per.map", 3))
         self.slowstart = float(self.conf.get(
             "mapred.reduce.slowstart.completed.maps", 0.05))
         self.speculative = bool(self.conf.get("mapred.speculative.execution", True))
@@ -173,8 +179,15 @@ class JobInProgress:
         self._ewma_alpha = float(self.conf.get("tpumr.profile.ewma", 0.0))
         self._cpu_ewma = 0.0
         self._tpu_ewma = 0.0
-        # completion events for reduce fetchers (≈ TaskCompletionEvents)
+        # completion events for reduce fetchers (≈ TaskCompletionEvents).
+        # APPEND-ONLY: consumers read incrementally by cursor, so a
+        # withdrawn map output is marked status=OBSOLETE in place AND
+        # re-announced as a tombstone event — never removed (removal
+        # would shift indices under every live cursor)
         self.completion_events: list[dict] = []
+        #: map attempt -> distinct reduce attempts reporting its output
+        #: unfetchable (the "too many fetch failures" ledger)
+        self._fetch_failures: dict[str, set[str]] = {}
         #: per-assignment backend placement: (seconds-since-submit, 'T'|'c')
         #: appended at every map assignment — the raw series behind the
         #: hybrid scheduler's convergence curve, so ANY run's status or
@@ -464,6 +477,17 @@ class JobInProgress:
             if tip is None:
                 return
             aid_s = str(status.attempt_id)
+            prev = tip.attempts.get(aid_s)
+            if prev is not None and prev.state in (TaskState.FAILED,
+                                                   TaskState.KILLED):
+                # the master already terminally settled this attempt
+                # (withdrawn output, lost tracker, -fail-task): a
+                # replayed tracker status must neither resurrect a dead
+                # attempt (a re-delivered SUCCEEDED would re-publish a
+                # withdrawn shuffle address and re-increment
+                # finished_maps while the tip sits in _pending_maps) nor
+                # double-count its failure
+                return
             if status.state in TaskState.TERMINAL:
                 self._preempt_requested.discard(aid_s)
                 if status.state == TaskState.KILLED \
@@ -523,6 +547,7 @@ class JobInProgress:
                 "map_index": tip.partition,
                 "attempt_id": str(status.attempt_id),
                 "shuffle_addr": shuffle_addr,
+                "status": "SUCCEEDED",
             })
         else:
             self.finished_reduces += 1
@@ -562,6 +587,122 @@ class JobInProgress:
         else:
             self._pending_reduces.add(tip.partition)
 
+    def _obsolete_map_output(self, tip: TaskInProgress, aid: str) -> str:
+        """Withdraw a published map output: mark its completion event(s)
+        OBSOLETE in place (late consumers replaying from cursor 0 see
+        SUCCEEDED→OBSOLETE in order) AND append a tombstone event so
+        consumers whose cursor is already past the original learn of the
+        withdrawal. Returns the shuffle address that served the output
+        ("" when it was never published). Caller holds ``self.lock``."""
+        addr = ""
+        for e in self.completion_events:
+            if e["attempt_id"] == aid and e.get("status") != "OBSOLETE":
+                addr = e.get("shuffle_addr", "")
+                e["status"] = "OBSOLETE"
+        self.completion_events.append({
+            "map_index": tip.partition, "attempt_id": aid,
+            "shuffle_addr": addr, "status": "OBSOLETE"})
+        return addr
+
+    def _unwind_finished_map(self, tip: TaskInProgress,
+                             st: "TaskStatus | None") -> None:
+        """Take one completed map back out of the books: completion
+        count AND the per-backend profile sums, so the hybrid
+        scheduler's means aren't poisoned by a re-run being
+        double-counted. Caller holds ``self.lock``."""
+        self.finished_maps -= 1
+        if st is not None and st.is_map:
+            if st.run_on_tpu:
+                self.finished_tpu_maps -= 1
+                self._tpu_time_sum -= st.runtime
+            else:
+                self.finished_cpu_maps -= 1
+                self._cpu_time_sum -= st.runtime
+
+    def fetch_failure_notification(self, map_attempt: str,
+                                   reduce_attempt: str) -> "dict | None":
+        """A reducer reports ``map_attempt``'s output unfetchable
+        (≈ JobInProgress.fetchFailureNotification, reached via
+        ReduceTask's umbilical → heartbeat). Distinct reporting reducers
+        are counted per map attempt; at ``mapred.max.fetch.failures.per.
+        map`` (or once EVERY live reduce is reporting — a 1-reduce job
+        could never reach 3) the still-"successful" attempt is failed:
+        its output is withdrawn (OBSOLETE completion events), the hybrid
+        profile sums are unwound, and the map re-queues for re-execution
+        while the reporting reduces stay alive in their penalty-box
+        retry loops. Returns None for stale/unknown reports, else a dict
+        with ``reexecuted`` and the serving ``shuffle_addr`` (so the
+        master can charge a fault to the lame tracker)."""
+        try:
+            attempt = TaskAttemptID.parse(map_attempt)
+            reducer = TaskAttemptID.parse(reduce_attempt)
+        except (ValueError, IndexError):
+            return None
+        with self.lock:
+            if self.state != JobState.RUNNING or not attempt.task.is_map:
+                return None
+            tip = self._tip_of(attempt.task)
+            if tip is None:
+                return None
+            # the reporter must be a real, running reduce attempt of
+            # THIS job (≈ the reference trusting only its own umbilical
+            # children): forged reducer names must not be able to
+            # manufacture "distinct reducers" and kill healthy maps
+            if reducer.task.is_map or reducer.task.job != self.job_id:
+                return None
+            rtip = self._tip_of(reducer.task)
+            rst = rtip.attempts.get(reduce_attempt) \
+                if rtip is not None else None
+            if rst is None or rst.state != TaskState.RUNNING:
+                return None
+            if tip.state != "succeeded" \
+                    or tip.successful_attempt != map_attempt:
+                # stale: the output was already withdrawn (lost tracker
+                # or an earlier notification) — the reducer just hasn't
+                # refreshed its events yet
+                return None
+            reporters = self._fetch_failures.setdefault(map_attempt, set())
+            # keyed by reduce TASK, not attempt: a speculative twin is
+            # the same reducer corroborating nothing new
+            reporters.add(str(reducer.task))
+            n_reports = len(reporters)
+            live_reduces = max(1, len(self.reduces) - self.finished_reduces)
+            threshold = min(self.max_fetch_failures_per_map, live_reduces)
+            if n_reports < threshold:
+                return {"withdrawn": False, "reexecuted": False,
+                        "shuffle_addr": "", "reports": n_reports}
+            del self._fetch_failures[map_attempt]
+            addr = self._obsolete_map_output(tip, map_attempt)
+            st = tip.attempts.get(map_attempt)
+            if st is not None:
+                st.state = TaskState.FAILED
+                st.diagnostics = (
+                    f"Too many fetch failures: {n_reports} reducer(s) "
+                    f"could not fetch this attempt's output from {addr}")
+            # the attempt is burned (≈ failedTask for fetch failures): a
+            # map whose output keeps vanishing eventually fails the job
+            # like any other repeatedly-failing task
+            tip.failures += 1
+            tip.state = "pending"
+            tip.successful_attempt = ""
+            self._unwind_finished_map(tip, st)
+            self._pending_maps.add(tip.partition)
+            if tip.failures >= self.max_map_attempts:
+                self.state = JobState.FAILED
+                self.finish_time = time.time()
+                self.error = (f"map {tip.task_id} lost its output to "
+                              f"fetch failures {tip.failures} times")
+                return {"withdrawn": True, "reexecuted": False,
+                        "shuffle_addr": addr, "reports": n_reports}
+            return {"withdrawn": True, "reexecuted": True,
+                    "shuffle_addr": addr, "reports": n_reports}
+
+    def fetch_failure_pending_count(self) -> int:
+        """Map attempts with outstanding (sub-threshold) fetch-failure
+        reports — the master's penalty-ledger gauge."""
+        with self.lock:
+            return len(self._fetch_failures)
+
     def requeue_lost_attempts(self, attempt_ids: list[str]) -> None:
         """Tracker lost (≈ JobTracker.lostTaskTracker): running attempts on
         it are killed and their tasks re-queued; completed MAPS are also
@@ -594,20 +735,12 @@ class JobInProgress:
                       and self.state == JobState.RUNNING):
                     tip.state = "pending"
                     tip.successful_attempt = ""
-                    self.finished_maps -= 1
                     # unwind the backend profile so the re-run isn't
                     # double-counted in the hybrid scheduler's means
-                    if st is not None and st.is_map:
-                        if st.run_on_tpu:
-                            self.finished_tpu_maps -= 1
-                            self._tpu_time_sum -= st.runtime
-                        else:
-                            self.finished_cpu_maps -= 1
-                            self._cpu_time_sum -= st.runtime
+                    self._unwind_finished_map(tip, st)
                     self._pending_maps.add(tip.partition)
-                    self.completion_events = [
-                        e for e in self.completion_events
-                        if e["attempt_id"] != aid]
+                    self._obsolete_map_output(tip, aid)
+                    self._fetch_failures.pop(aid, None)
                 # lost = terminal for this attempt whatever branch ran:
                 # never leak a -fail-task mark for the life of the job
                 self._fail_requested.discard(aid)

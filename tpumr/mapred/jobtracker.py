@@ -165,6 +165,14 @@ class JobMaster:
             _locked(lambda: sum(1 for t in self.trackers.values()
                                 if t.blacklisted)))
         self._mreg.set_gauge("slots", self.total_slots)
+        # shuffle fault tolerance: map attempts with outstanding
+        # (sub-threshold) fetch-failure reports across running jobs —
+        # the master-side penalty ledger behind fetch_failures_reported
+        # / maps_reexecuted_fetch_failure counters
+        self._mreg.set_gauge(
+            "fetch_failure_penalty_box",
+            _locked(lambda: sum(j.fetch_failure_pending_count()
+                                for j in self.jobs.values())))
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)
@@ -325,13 +333,21 @@ class JobMaster:
             slots = c["slots"]
             slots_txt = (" / ".join(f"{k} {v}" for k, v in slots.items())
                          if isinstance(slots, dict) else str(slots))
+            snap = self.metrics.snapshot().get("jobtracker", {})
             return (
                 f"<h1>JobTracker — cluster {html_escape(self.cluster_id)}"
                 f"</h1>"
                 f"<p>{c['trackers']} trackers · slots "
                 f"{html_escape(slots_txt)} · "
                 f"{c['jobs_running']} running / {c['jobs_total']} total "
-                f"jobs</p><h2>Jobs</h2>"
+                f"jobs</p>"
+                f"<p>shuffle fault tolerance: "
+                f"{snap.get('fetch_failures_reported', 0):.0f} fetch "
+                f"failures reported · "
+                f"{snap.get('maps_reexecuted_fetch_failure', 0):.0f} maps "
+                f"re-executed · penalty box "
+                f"{snap.get('fetch_failure_penalty_box', 0)}</p>"
+                f"<h2>Jobs</h2>"
                 + html_table(
                     ["job", "state", "maps", "reduces", "#maps",
                      "#reduces", "tpu maps", "cpu maps", "accel"], rows))
@@ -984,6 +1000,18 @@ class JobMaster:
                             jip.state in JobState.TERMINAL:
                         deferred_final.append(jip)
 
+            # Fetch-failure reports (the "too many fetch failures"
+            # protocol): reducers on this tracker found a completed
+            # map's output unfetchable while its tracker still
+            # heartbeats. Folded BEFORE replay detection for the same
+            # reason as task statuses: the tracker only drops reports
+            # once a response is delivered, so a retried heartbeat
+            # re-carries them (distinct-reducer counting makes the
+            # re-delivery harmless).
+            for ff in status.get("fetch_failures", []):
+                self._fetch_failure_locked(ff, deferred_events,
+                                           deferred_final)
+
             # Normal case: the tracker echoes the response id we last sent
             # (last[0] == response_id). A MISMATCH means our response was
             # lost in flight — replay the stored actions rather than
@@ -1042,6 +1070,62 @@ class JobMaster:
             self._last_response[name] = (response_id, actions)
             return {"response_id": response_id, "actions": actions}
 
+    def _fetch_failure_locked(self, ff: dict, deferred_events: list,
+                              deferred_final: list) -> None:
+        """Apply one reducer fetch-failure report (caller holds
+        ``self.lock``). The job counts distinct reporting reducers; once
+        it withdraws the map output the master-side effects land here:
+        the burned attempt's commit grant is revoked (the re-run must be
+        able to commit), a fault is charged to the tracker that SERVED
+        the lost output — a lame-but-heartbeating shuffle server walks
+        toward blacklisting exactly like a task-failing tracker — and
+        the re-execution is metered + history-logged."""
+        from tpumr.mapred.ids import TaskAttemptID
+        map_attempt = str(ff.get("map_attempt", ""))
+        reduce_attempt = str(ff.get("reduce_attempt", ""))
+        try:
+            task_id = TaskAttemptID.parse(map_attempt).task
+        except (ValueError, IndexError):
+            return
+        jip = self.jobs.get(str(task_id.job))
+        if jip is None:
+            return
+        before = jip.state
+        res = jip.fetch_failure_notification(map_attempt, reduce_attempt)
+        if res is None:
+            return   # stale (already withdrawn) — not a counted report
+        self._mreg.incr("fetch_failures_reported")
+        if res["withdrawn"]:
+            self._revoke_commit(str(task_id), map_attempt)
+            if res["reexecuted"]:
+                self._mreg.incr("maps_reexecuted_fetch_failure")
+            addr = res.get("shuffle_addr", "")
+            info = self._tracker_by_shuffle_addr(addr)
+            if info is not None:
+                info.failures += 1
+                if info.failures >= self.blacklist_faults:
+                    info.blacklisted = True
+            deferred_events.append((str(task_id.job), "MAP_OUTPUT_LOST",
+                                    dict(attempt_id=map_attempt,
+                                         shuffle_addr=addr,
+                                         reports=res.get("reports", 0),
+                                         reexecuted=res["reexecuted"])))
+        if before == JobState.RUNNING and jip.state in JobState.TERMINAL:
+            deferred_final.append(jip)
+
+    def _tracker_by_shuffle_addr(self, addr: str) -> "_TrackerInfo | None":
+        """The registered tracker serving map outputs at ``addr``
+        (caller holds ``self.lock``)."""
+        if not addr:
+            return None
+        for info in self.trackers.values():
+            st = info.status
+            a = st.get("shuffle_addr") or \
+                f"{st.get('host', '')}:{st.get('shuffle_port', 0)}"
+            if a == addr:
+                return info
+        return None
+
     # ------------------------------------------------------------ expiry
 
     def _evict_tracker_locked(self, name: str) -> None:
@@ -1056,9 +1140,13 @@ class JobMaster:
                 f"{info.status.get('shuffle_port', 0)}")
         for jip in self.jobs.values():
             with jip.lock:
+                # OBSOLETE entries are tombstones of already-withdrawn
+                # outputs — only live events name outputs this tracker
+                # still owed the shuffle
                 owned = [e["attempt_id"]
                          for e in jip.completion_events
-                         if e["shuffle_addr"] == addr]
+                         if e["shuffle_addr"] == addr
+                         and e.get("status") != "OBSOLETE"]
             jip.requeue_lost_attempts(attempts + owned)
         from tpumr.mapred.ids import TaskAttemptID
         for aid in attempts:

@@ -63,8 +63,14 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
             if not spill_dir:
                 spill_dir = tmp_spill_dir = tempfile.mkdtemp(
                     prefix=f"shuffle-{task.attempt_id}-")
+            # the fetch-failure seam rides on the source: trackers /
+            # isolated children wire on_fetch_failure to the umbilical
+            # report, so a lost map output stalls (and recovers) this
+            # reduce instead of failing it
             copier = ShuffleCopier(conf, fetch, task.num_maps,
-                                   task.partition, spill_dir, reporter)
+                                   task.partition, spill_dir, reporter,
+                                   on_fetch_failure=getattr(
+                                       fetch, "on_fetch_failure", None))
             segments = copier.copy_all()
             closeable = list(segments)
         elif not hasattr(fetch, "segments"):

@@ -22,12 +22,25 @@ waiting for budget because concurrent in-memory merge threads free it; here
 nothing frees budget mid-copy (segments are consumed by the merge after the
 copy phase), so a fetcher that cannot reserve now goes to disk immediately —
 same memory bound, no deadlock, one less moving part.
+
+Lost-map-output recovery (the "too many fetch failures" protocol,
+≈ ReduceTask's fetch-failure notification up the umbilical): when the
+caller wires an ``on_fetch_failure`` callback, a failing map location is
+never terminal for the reduce. Each source lands in a per-address
+PENALTY BOX (capped, jittered exponential backoff — a recovering tracker
+is never hit by a thundering herd); after
+``tpumr.shuffle.fetch.retries.per.source`` failures against one location
+the failure is reported up (the master counts distinct reducers and
+re-executes the map) and the cached location is invalidated so the
+re-run map's NEW address is picked up mid-shuffle from refreshed
+completion events — the copy phase never restarts.
 """
 
 from __future__ import annotations
 
 import os
 import queue
+import random
 import tempfile
 import threading
 import time
@@ -174,19 +187,69 @@ class LocalSegmentSource:
         return out
 
 
+class PenaltyBox:
+    """Per-source backoff state (≈ the reference ReduceCopier's
+    penaltyBox of fetch-failed hosts): each failure against a location
+    doubles its hold-off up to ``cap_s``, jittered to 50–100% of nominal
+    so fetchers never re-converge on a recovering tracker in lockstep.
+    A success clears the location's strikes entirely."""
+
+    def __init__(self, base_s: float, cap_s: float) -> None:
+        self.base_s = max(0.0, base_s)
+        self.cap_s = max(self.base_s, cap_s)
+        self._lock = threading.Lock()
+        self._strikes: dict[str, int] = {}
+        self._until: dict[str, float] = {}
+
+    def punish(self, key: str) -> float:
+        """Record one failure; returns the jittered hold-off seconds."""
+        with self._lock:
+            strikes = self._strikes.get(key, 0) + 1
+            self._strikes[key] = strikes
+            delay = min(self.cap_s, self.base_s * (2 ** (strikes - 1)))
+            delay *= 0.5 + random.random() * 0.5
+            self._until[key] = max(self._until.get(key, 0.0),
+                                   time.time() + delay)
+            return delay
+
+    def until(self, key: str) -> float:
+        """Earliest time this source should be fetched from again."""
+        with self._lock:
+            return self._until.get(key, 0.0)
+
+    def clear(self, key: str) -> None:
+        with self._lock:
+            self._strikes.pop(key, None)
+            self._until.pop(key, None)
+
+    def active(self) -> int:
+        """How many sources are currently serving a penalty (gauge)."""
+        now = time.time()
+        with self._lock:
+            return sum(1 for t in self._until.values() if t > now)
+
+
 class ShuffleCopier:
     """Run the copy phase: ``copy_all()`` returns every map's segment for
     this reduce's partition, fetched by a pool of copier threads."""
 
     def __init__(self, conf: Any, source: ChunkFetch, num_maps: int,
                  partition: int, spill_dir: str,
-                 reporter: Any = None) -> None:
+                 reporter: Any = None,
+                 on_fetch_failure: "Callable[[int, str], None] | None"
+                 = None) -> None:
         self.conf = conf
         self.source = source
         self.num_maps = num_maps
         self.partition = partition
         self.spill_dir = spill_dir
         self.reporter = reporter
+        #: fetch-failure report seam (reduce → tracker → master): called
+        #: as ``on_fetch_failure(map_index, map_attempt_id)`` after
+        #: ``retries.per.source`` failures against one location. When
+        #: None (local/legacy sources) a persistently failing fetch is
+        #: terminal after the local retries, as before.
+        self.on_fetch_failure = on_fetch_failure
         self.parallel = max(1, conf.get_int("tpumr.shuffle.parallel.copies",
                                             5))
         ram_mb = conf.get_float("tpumr.shuffle.ram.mb", 128.0)
@@ -195,15 +258,34 @@ class ShuffleCopier:
         self.retries = conf.get_int("tpumr.shuffle.copy.retries", 3)
         self.backoff_s = conf.get_float("tpumr.shuffle.copy.backoff.ms",
                                         200.0) / 1000.0
+        self.backoff_cap_s = conf.get_float(
+            "tpumr.shuffle.copy.backoff.max.ms", 10_000.0) / 1000.0
+        #: failures against ONE map location before a fetch-failure
+        #: report goes up the umbilical (≈ maxFetchFailuresBeforeReporting)
+        self.retries_per_source = max(1, conf.get_int(
+            "tpumr.shuffle.fetch.retries.per.source", 3))
+        #: hard ceiling of total failures for one map before the copy
+        #: phase gives up terminally even in protocol mode — bounds a
+        #: shuffle against a map the master never manages to re-run
+        self.max_fetch_failures = max(1, conf.get_int(
+            "tpumr.shuffle.fetch.max.failures", 50))
+        self.penalty_box = PenaltyBox(self.backoff_s, self.backoff_cap_s)
         #: observability: how many segments went to disk vs memory
         #: (mutated by parallel workers — guarded by _stats_lock)
         self.spilled_to_disk = 0
         self.copied_in_memory = 0
+        self.fetch_failures = 0
+        self.fetch_failures_reported = 0
         self._stats_lock = threading.Lock()
+        self._map_failures: dict[int, int] = {}
+        self._src_failures: dict[tuple[int, str], int] = {}
 
     # ------------------------------------------------------------ one map
 
     def _copy_one(self, map_index: int) -> Segment:
+        from tpumr.utils.fi import maybe_fail
+        maybe_fail("shuffle.fetch", self.conf)
+        maybe_fail(f"shuffle.fetch.m{map_index}", self.conf)
         first = self.source(map_index, self.partition, 0)
         total = int(first["total"])
         raw = int(first.get("raw", total))
@@ -255,6 +337,14 @@ class ShuffleCopier:
             self.spilled_to_disk += 1
         return DiskSegment(path, codec, raw)
 
+    def _local_backoff_s(self, attempt: int) -> float:
+        """Capped, jittered exponential backoff for in-line retries:
+        the raw ``base * 2**attempt`` was unbounded AND synchronized
+        across fetchers — every copier that failed together retried
+        together, a thundering herd onto a recovering tracker."""
+        delay = min(self.backoff_cap_s, self.backoff_s * (2 ** attempt))
+        return delay * (0.5 + random.random() * 0.5)
+
     def _copy_with_retries(self, map_index: int) -> Segment:
         last: Exception | None = None
         for attempt in range(self.retries + 1):
@@ -263,10 +353,76 @@ class ShuffleCopier:
             except Exception as e:  # noqa: BLE001 — fetch failure is data
                 last = e
                 if attempt < self.retries:
-                    time.sleep(self.backoff_s * (2 ** attempt))
+                    time.sleep(self._local_backoff_s(attempt))
         raise RuntimeError(
             f"shuffle fetch of map {map_index} partition {self.partition} "
             f"failed after {self.retries + 1} attempts: {last}") from last
+
+    # ------------------------------------------- fetch-failure protocol
+
+    def _source_hook(self, name: str, map_index: int, default: Any = None):
+        fn = getattr(self.source, name, None)
+        if fn is None:
+            return default
+        try:
+            return fn(map_index)
+        except Exception:  # noqa: BLE001 — hooks are advisory
+            return default
+
+    def _addr_of(self, map_index: int) -> str:
+        """The map's currently-resolved serving address (penalty-box
+        key); falls back to a per-map key for sources without one."""
+        return self._source_hook("addr_of", map_index) or f"map-{map_index}"
+
+    def _penalized_until(self, map_index: int) -> float:
+        return self.penalty_box.until(self._addr_of(map_index))
+
+    def _note_success(self, map_index: int) -> None:
+        self.penalty_box.clear(self._addr_of(map_index))
+        with self._stats_lock:
+            self._map_failures.pop(map_index, None)
+            # per-source strikes too — otherwise they'd accumulate
+            # across long-separated transient blips until the modulo
+            # cadence fired a spurious report against a healthy source
+            for k in [k for k in self._src_failures if k[0] == map_index]:
+                del self._src_failures[k]
+
+    def _note_failure(self, map_index: int) -> "float | None":
+        """Account one failed fetch round. Returns the retry hold-off in
+        seconds, or None when the failure must be terminal (no report
+        callback wired, or the per-map failure ceiling was hit)."""
+        if self.on_fetch_failure is None:
+            return None
+        addr = self._addr_of(map_index)
+        with self._stats_lock:
+            total = self._map_failures.get(map_index, 0) + 1
+            self._map_failures[map_index] = total
+            key = (map_index, addr)
+            per_src = self._src_failures.get(key, 0) + 1
+            self._src_failures[key] = per_src
+            self.fetch_failures += 1
+        if total >= self.max_fetch_failures:
+            return None
+        delay = self.penalty_box.punish(addr)
+        if self.reporter is not None:
+            self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                       TaskCounter.REDUCE_FETCH_FAILURES, 1)
+        if per_src % self.retries_per_source == 0:
+            # this location has had its chances: report up (the master
+            # counts distinct reducers per map attempt and re-executes
+            # at mapred.max.fetch.failures.per.map) and drop the cached
+            # location so the next round re-resolves from refreshed
+            # completion events — a re-run map's new address is picked
+            # up WITHOUT restarting the copy phase
+            attempt = self._source_hook("attempt_of", map_index, "") or ""
+            try:
+                self.on_fetch_failure(map_index, attempt)
+                with self._stats_lock:
+                    self.fetch_failures_reported += 1
+            except Exception:  # noqa: BLE001 — reporting is best-effort;
+                pass           # the penalty/retry loop keeps the reduce alive
+            self._source_hook("invalidate", map_index)
+        return delay
 
     # ------------------------------------------------------------ the phase
 
@@ -274,32 +430,59 @@ class ShuffleCopier:
         os.makedirs(self.spill_dir, exist_ok=True)
         results: "list[Segment | None]" = [None] * self.num_maps
         errors: "list[Exception]" = []
-        work: "queue.Queue[int]" = queue.Queue()
+        # (ready_at, map_index): failed maps re-enter with a hold-off
+        # instead of failing the reduce — the queue is drained only when
+        # every map has actually been copied
+        work: "queue.Queue[tuple[float, int]]" = queue.Queue()
         for m in range(self.num_maps):
-            work.put(m)
-        done = [0]
+            work.put((0.0, m))
+        outstanding = [self.num_maps]
         lock = threading.Lock()
 
         def worker() -> None:
             while True:
                 with lock:
-                    if errors:
+                    if errors or outstanding[0] <= 0:
                         return
                 if self.reporter is not None and self.reporter.aborted():
                     return
                 try:
-                    m = work.get_nowait()
+                    ready, m = work.get(timeout=0.05)
                 except queue.Empty:
-                    return
+                    continue   # others may still re-queue penalized maps
+                # the penalty hold is consulted FRESH on every pop (never
+                # baked into the stored timestamp): a success against the
+                # same address clears the box and the map retries
+                # immediately instead of waiting out a stale hold-off
+                hold = max(ready, self._penalized_until(m))
+                now = time.time()
+                if hold > now:
+                    # not yet — rotate it to the back and nap briefly so
+                    # an all-penalized queue doesn't busy-spin
+                    work.put((ready, m))
+                    time.sleep(min(hold - now, 0.05))
+                    continue
                 try:
-                    seg = self._copy_with_retries(m)
+                    # with a fetch-failure callback the penalty box IS
+                    # the retry loop (one fetch per round); without one,
+                    # keep the legacy in-line quick retries + raise
+                    seg = (self._copy_one(m)
+                           if self.on_fetch_failure is not None
+                           else self._copy_with_retries(m))
                 except Exception as e:  # noqa: BLE001
-                    with lock:
-                        errors.append(e)
-                    return
+                    if self._note_failure(m) is None:
+                        with lock:
+                            errors.append(e)
+                        return
+                    # ready now; the pop-side penalty check supplies the
+                    # (possibly already-cleared) hold-off
+                    work.put((time.time(), m))
+                    continue
+                self._note_success(m)
                 with lock:
                     results[m] = seg
-                    done[0] += 1
+                    outstanding[0] -= 1
+                    completed = self.num_maps - outstanding[0]
                 if self.reporter is not None:
                     self.reporter.incr_counter(
                         TaskCounter.FRAMEWORK_GROUP,
@@ -309,7 +492,7 @@ class ShuffleCopier:
                         TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK
                         if isinstance(seg, DiskSegment)
                         else TaskCounter.REDUCE_SHUFFLE_SEGMENTS_MEM, 1)
-                    self.reporter.progress(done[0] / self.num_maps)
+                    self.reporter.progress(completed / self.num_maps)
 
         n = min(self.parallel, max(1, self.num_maps))
         threads = [threading.Thread(target=worker,
@@ -344,8 +527,29 @@ class RemoteChunkSource:
         self.chunk_bytes = max(64 * 1024,
                                conf.get_int("tpumr.shuffle.chunk.bytes",
                                             1 << 20))
+        #: fetch-failure report seam, wired by the tracker / child so the
+        #: ShuffleCopier can report a dead location up the umbilical
+        self.on_fetch_failure: "Callable[[int, str], None] | None" = None
 
     def __call__(self, map_index: int, partition: int, offset: int) -> dict:
         return self.locate(map_index).call(
             "get_map_output_chunk", self.job_id, map_index, partition,
             offset, self.chunk_bytes)
+
+    # --- lost-output recovery hooks (delegated to the locator when it
+    # --- has them — tasktracker.make_map_locator's MapLocator does)
+
+    def addr_of(self, map_index: int) -> str:
+        fn = getattr(self.locate, "addr_of", None)
+        return fn(map_index) if fn is not None else ""
+
+    def attempt_of(self, map_index: int) -> str:
+        fn = getattr(self.locate, "attempt_of", None)
+        return fn(map_index) if fn is not None else ""
+
+    def invalidate(self, map_index: int) -> None:
+        """Drop the cached location so the next fetch re-resolves from
+        refreshed completion events (a re-run map's new address)."""
+        fn = getattr(self.locate, "invalidate", None)
+        if fn is not None:
+            fn(map_index)

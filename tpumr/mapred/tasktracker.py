@@ -57,62 +57,152 @@ def _resolvable(host: str) -> bool:
         return False
 
 
-def make_map_locator(events_fn: Any, secret: bytes | None,
-                     poll_s: float = 0.2, timeout_s: float = 600.0,
-                     scope: "str | None" = None):
+class MapLocator:
     """Map-output location resolution ≈ the ReduceCopier's polling of
     TaskCompletionEvents (ReduceTask.java:659 fetch loop). ``events_fn
     (cursor) -> [event]`` is the master's incremental completion-event
     feed (called directly by the tracker, via the umbilical by isolated
-    child processes). Returns ``locate(map_index) -> RpcClient`` bound to
-    the serving tracker's shuffle RPC."""
-    events: dict[int, dict] = {}
-    seen = [0]
-    clients: dict[tuple, RpcClient] = {}
-    # the ShuffleCopier drives locate() from parallel fetcher threads.
-    # cache_lock guards the event cache/cursor/client table; poll_lock
-    # serializes the events_fn RPC OUTSIDE cache_lock, so threads whose
-    # map is already cached never wait behind a network poll — and the
-    # cursor can't double-advance (that silently skips events forever).
-    cache_lock = threading.Lock()
-    poll_lock = threading.Lock()
+    child processes). Calling ``locate(map_index)`` returns an RpcClient
+    bound to the serving tracker's shuffle RPC.
 
-    def cached(map_index: int) -> bool:
-        with cache_lock:
-            return map_index in events
+    The completion-event feed is APPEND-ONLY: a map output withdrawn by
+    the master (lost tracker, too-many-fetch-failures re-execution)
+    arrives as an OBSOLETE-status event that evicts the cached location;
+    ``invalidate`` lets the ShuffleCopier drop a location it observed
+    dead itself, so the next locate() round blocks until the re-run
+    map's fresh completion event supplies the new address — mid-shuffle,
+    without restarting the copy phase."""
 
-    def locate(map_index: int) -> RpcClient:
-        deadline = time.time() + timeout_s
-        while not cached(map_index):
-            with poll_lock:
-                if cached(map_index):  # another poller just fetched it
+    def __init__(self, events_fn: Any, secret: bytes | None,
+                 poll_s: float = 0.2, timeout_s: float = 600.0,
+                 scope: "str | None" = None) -> None:
+        self._events_fn = events_fn
+        self._secret = secret
+        self._poll_s = poll_s
+        self._timeout_s = timeout_s
+        self._scope = scope
+        self._events: dict[int, dict] = {}
+        #: invalidated-but-not-withdrawn locations: the feed is cursor-
+        #: based (an old SUCCEEDED event is never re-sent), so a
+        #: location WE dropped must stay available as a fallback until
+        #: the master actually replaces or withdraws it — otherwise one
+        #: reducer's asymmetric fetch fault would strand it blocking for
+        #: a re-run the master never schedules
+        self._stale: dict[int, dict] = {}
+        self._seen = 0
+        self._clients: dict[tuple, RpcClient] = {}
+        # the ShuffleCopier drives locate() from parallel fetcher
+        # threads. cache_lock guards the event cache/cursor/client
+        # table; poll_lock serializes the events_fn RPC OUTSIDE
+        # cache_lock, so threads whose map is already cached never wait
+        # behind a network poll — and the cursor can't double-advance
+        # (that silently skips events forever).
+        self._cache_lock = threading.Lock()
+        self._poll_lock = threading.Lock()
+
+    def _cached(self, map_index: int) -> bool:
+        with self._cache_lock:
+            return map_index in self._events
+
+    def _fold(self, fresh: "list[dict]") -> None:
+        """Apply one batch of completion events to the location cache.
+        Caller holds ``_cache_lock``."""
+        self._seen += len(fresh)
+        for e in fresh:
+            idx = e["map_index"]
+            if e.get("status") == "OBSOLETE":
+                cur = self._events.get(idx)
+                if cur is not None and cur["attempt_id"] == e["attempt_id"]:
+                    del self._events[idx]
+                st = self._stale.get(idx)
+                if st is not None and st["attempt_id"] == e["attempt_id"]:
+                    # genuinely withdrawn: the fallback dies too — now
+                    # we really do block for the re-run's fresh event
+                    del self._stale[idx]
+            else:
+                self._events[idx] = e
+                self._stale.pop(idx, None)
+
+    def _entry(self, map_index: int) -> "dict | None":
+        """Caller holds ``_cache_lock``."""
+        e = self._events.get(map_index)
+        return e if e is not None else self._stale.get(map_index)
+
+    def attempt_of(self, map_index: int) -> str:
+        """The map attempt whose output the (possibly stale) cached
+        location serves — what a fetch-failure report names to the
+        master."""
+        with self._cache_lock:
+            e = self._entry(map_index)
+            return e["attempt_id"] if e is not None else ""
+
+    def addr_of(self, map_index: int) -> str:
+        with self._cache_lock:
+            e = self._entry(map_index)
+            return e["shuffle_addr"] if e is not None else ""
+
+    def invalidate(self, map_index: int) -> None:
+        """Demote the cached location to a fallback: the next locate()
+        round polls for a fresh event first, but while the master keeps
+        the output live (other reducers may fetch it fine — the fault
+        could be ours) the known location keeps serving retries."""
+        with self._cache_lock:
+            e = self._events.pop(map_index, None)
+            if e is not None:
+                self._stale[map_index] = e
+
+    def __call__(self, map_index: int) -> RpcClient:
+        deadline = time.time() + self._timeout_s
+        while True:
+            with self._cache_lock:
+                # event read under the SAME lock hold that checked it: a
+                # concurrent _fold of an OBSOLETE withdrawal between a
+                # cached() check and a later read would KeyError
+                e = self._events.get(map_index)
+                if e is not None:
+                    addr = e["shuffle_addr"]
                     break
-                fresh = events_fn(seen[0])
-                with cache_lock:
-                    seen[0] += len(fresh)
-                    for e in fresh:
-                        events[e["map_index"]] = e
-            if cached(map_index):
-                break
+            with self._poll_lock:
+                if self._cached(map_index):  # another poller just fetched
+                    continue
+                fresh = self._events_fn(self._seen)
+                with self._cache_lock:
+                    self._fold(fresh)
+            if self._cached(map_index):
+                continue
+            with self._cache_lock:
+                stale = self._stale.pop(map_index, None)
+                if stale is not None:
+                    # nothing fresh after a poll: the invalidated
+                    # location is still the best known — reinstate it
+                    # (retries keep hammering it through the penalty
+                    # box) until the master replaces or withdraws it
+                    self._events[map_index] = stale
+                    continue
             if time.time() > deadline:
                 raise TimeoutError(
                     f"map {map_index} output never became available")
-            time.sleep(poll_s)
-        with cache_lock:
-            addr = events[map_index]["shuffle_addr"]
-            host, port = addr.rsplit(":", 1)
+            time.sleep(self._poll_s)
+        host, port = addr.rsplit(":", 1)
+        with self._cache_lock:
             # one client per (address, calling thread): RpcClient
             # serializes calls on its single socket, so sharing one per
             # address would collapse tpumr.shuffle.parallel.copies back
             # to sequential whenever maps concentrate on few trackers
             key = (addr, threading.get_ident())
-            cli = clients.get(key)
+            cli = self._clients.get(key)
             if cli is None:
-                cli = clients[key] = RpcClient(host, int(port),
-                                               secret=secret, scope=scope)
+                cli = self._clients[key] = RpcClient(
+                    host, int(port), secret=self._secret, scope=self._scope)
         return cli
 
-    return locate
+
+def make_map_locator(events_fn: Any, secret: bytes | None,
+                     poll_s: float = 0.2, timeout_s: float = 600.0,
+                     scope: "str | None" = None) -> MapLocator:
+    """Factory kept for the existing call sites (tracker + child)."""
+    return MapLocator(events_fn, secret, poll_s=poll_s,
+                      timeout_s=timeout_s, scope=scope)
 
 
 class NodeRunner:
@@ -188,9 +278,14 @@ class NodeRunner:
         self._server.scoped_methods = {
             "get_protocol_version", "umbilical_ping", "umbilical_status",
             "umbilical_can_commit", "umbilical_events", "umbilical_done",
-            "umbilical_fail", "get_map_output", "get_map_output_chunk",
+            "umbilical_fail", "umbilical_report_fetch_failure",
+            "get_map_output", "get_map_output_chunk",
             "get_map_output_dense",
         }
+        #: fetch-failure reports from this tracker's reduces (in-process
+        #: or via the umbilical), forwarded to the master on the next
+        #: heartbeat and dropped only once a heartbeat delivered them
+        self._fetch_failures: list[dict] = []
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            name=f"{self.name}-heartbeat",
                                            daemon=True)
@@ -351,6 +446,7 @@ class NodeRunner:
                 avail_mb = -1
             return {
                 "available_memory_mb": avail_mb,
+                "fetch_failures": list(self._fetch_failures),
                 "tracker_name": self.name,
                 "host": self.host,
                 "shuffle_addr": f"{self.bind_host}:{self.shuffle_port}",
@@ -378,8 +474,11 @@ class NodeRunner:
             try:
                 self._heartbeat_once()
             except Exception:
-                # master briefly unreachable — keep trying (lease semantics)
-                time.sleep(self.heartbeat_s)
+                # master briefly unreachable — keep trying (lease
+                # semantics); back off solely via the interruptible
+                # _stop.wait below (an extra time.sleep here doubled the
+                # error-path interval AND ignored shutdown for it)
+                pass
             self._stop.wait(self.heartbeat_s)
 
     def _heartbeat_once(self) -> None:
@@ -395,6 +494,13 @@ class NodeRunner:
         self._initial_contact = False
         self._response_id = resp["response_id"]
         with self.lock:
+            # the heartbeat DELIVERED these fetch-failure reports (they
+            # were snapshotted into `status` first — a failed RPC keeps
+            # them queued for the retry); entries appended since the
+            # snapshot stay for the next beat
+            sent_ff = len(status.get("fetch_failures", []))
+            if sent_ff:
+                del self._fetch_failures[:sent_ff]
             # Drop only statuses whose SENT snapshot was terminal — a task
             # that finished while the RPC was in flight was reported as
             # RUNNING, so it must survive until the next heartbeat or the
@@ -655,7 +761,14 @@ class NodeRunner:
                                          status=status))
                 with self.lock:
                     if out[0]:
-                        self.map_outputs[(job_id, task.partition)] = out
+                        # stamp the producing attempt on the served index
+                        # (fi serve seams target attempt generations; a
+                        # re-run registers OVER the lost attempt's entry)
+                        idx = dict(out[1])
+                        idx["attempt"] = aid
+                        idx["attempt_no"] = task.attempt_id.attempt
+                        self.map_outputs[(job_id, task.partition)] = (
+                            out[0], idx)
                 # commit covers direct-output maps AND map-side named
                 # outputs (lib.MultipleOutputs) in jobs with reducers;
                 # needs_commit makes it a no-op when no files exist
@@ -856,7 +969,11 @@ class NodeRunner:
                 real = os.path.realpath(out_path)
                 root = os.path.realpath(self.local_root) + os.sep
                 if real.startswith(root):
-                    self.map_outputs[(job_id, partition)] = (real, index)
+                    idx = dict(index)
+                    idx["attempt"] = attempt_id
+                    idx["attempt_no"] = TaskAttemptID.parse(
+                        attempt_id).attempt
+                    self.map_outputs[(job_id, partition)] = (real, idx)
 
     def umbilical_fail(self, attempt_id: str, state: str,
                        diagnostics: str) -> None:
@@ -870,7 +987,57 @@ class NodeRunner:
                 st.state = (state if state in TaskState.TERMINAL
                             else TaskState.FAILED)
 
+    # ------------------------------------------------- fetch failures
+
+    def report_fetch_failure(self, reduce_attempt: str,
+                             map_attempt: str) -> None:
+        """A reduce on this tracker could not fetch ``map_attempt``'s
+        output (≈ ReduceTask's fetch-failure notification up the
+        umbilical): queue the report for the next heartbeat — the master
+        counts distinct reducers per map attempt and re-executes the map
+        at ``mapred.max.fetch.failures.per.map``. The reduce stays alive
+        (stalled-but-progressing) while that happens."""
+        if not map_attempt:
+            return   # location never resolved — nothing to indict
+        with self.lock:
+            self._fetch_failures.append({"reduce_attempt": reduce_attempt,
+                                         "map_attempt": map_attempt})
+        self._mreg.incr("fetch_failures_reported")
+
+    def umbilical_report_fetch_failure(self, reduce_attempt: str,
+                                       map_attempt: str) -> None:
+        """Child-process seam for :meth:`report_fetch_failure`. BOTH
+        attempts must belong to the caller's job: a job-token child may
+        only ever indict its own job's map outputs (the master
+        additionally verifies the reducer is a real, running attempt)."""
+        reduce_job = str(TaskAttemptID.parse(reduce_attempt).task.job)
+        if map_attempt and \
+                str(TaskAttemptID.parse(map_attempt).task.job) != reduce_job:
+            raise PermissionError(
+                f"map attempt {map_attempt} does not belong to "
+                f"{reduce_attempt}'s job")
+        self._check_scope(reduce_job)
+        self.report_fetch_failure(reduce_attempt, map_attempt)
+
     # ------------------------------------------------------------ shuffle
+
+    def _maybe_fail_serve(self, job_id: str, map_index: int,
+                          index: dict) -> None:
+        """Deterministic chaos seam on the serving side of the shuffle
+        (the map-output-unfetchable failure mode: disk loss, corrupt
+        spill, wedged-but-heartbeating tracker). Qualified points let a
+        test target one map's output or one attempt GENERATION — e.g.
+        ``tpumr.fi.shuffle.serve.a0.probability=1`` makes every map's
+        FIRST attempt unfetchable while its re-run serves fine."""
+        from tpumr.utils.fi import maybe_fail
+        with self.lock:
+            conf = self.job_confs.get(job_id)
+        conf = conf if conf is not None else self.conf
+        maybe_fail("shuffle.serve", conf)
+        maybe_fail(f"shuffle.serve.m{map_index}", conf)
+        attempt_no = index.get("attempt_no")
+        if attempt_no is not None:
+            maybe_fail(f"shuffle.serve.a{attempt_no}", conf)
 
     def get_map_output(self, job_id: str, map_index: int,
                        partition: int) -> dict:
@@ -883,6 +1050,7 @@ class NodeRunner:
         if ent is None:
             raise KeyError(f"no map output for {job_id} map {map_index}")
         path, index = ent
+        self._maybe_fail_serve(job_id, map_index, index)
         if index.get("dense"):
             raise ValueError(f"map output for {job_id} map {map_index} is "
                              "dense (device-shuffled job) — fetch with "
@@ -912,6 +1080,7 @@ class NodeRunner:
         if ent is None:
             raise KeyError(f"no map output for {job_id} map {map_index}")
         path, index = ent
+        self._maybe_fail_serve(job_id, map_index, index)
         if index.get("dense"):
             raise ValueError(f"map output for {job_id} map {map_index} is "
                              "dense (device-shuffled job) — fetch with "
@@ -960,8 +1129,13 @@ class NodeRunner:
         map locations from completion events; run_reduce_task drives it
         with the parallel RAM-budgeted ShuffleCopier."""
         from tpumr.mapred.shuffle_copier import RemoteChunkSource
-        return RemoteChunkSource(self._job_conf(job_id), job_id,
-                                 self._map_locator(job_id))
+        src = RemoteChunkSource(self._job_conf(job_id), job_id,
+                                self._map_locator(job_id))
+        reduce_attempt = str(task.attempt_id)
+        src.on_fetch_failure = (
+            lambda map_index, map_attempt:
+            self.report_fetch_failure(reduce_attempt, map_attempt))
+        return src
 
     def _remote_dense_fetch_factory(self, job_id: str, task: Task):
         """Dense fetch for device-shuffled jobs: pulls each map's whole

@@ -11,6 +11,21 @@ Config per point:
                                  process; 0 = unlimited) — lets tests
                                  fail the first attempt and watch the
                                  retry succeed.
+Global:
+  tpumr.fi.seed                  seed per-process, PER-POINT RNG streams
+                                 so chaos runs replay deterministically
+                                 (unset = the global unseeded ``random``
+                                 module). A point exercised from one
+                                 thread replays bit-identically; points
+                                 hit by concurrent threads draw from
+                                 their own stream, so other points'
+                                 sequences stay reproducible even then.
+
+Shuffle seams (the lost-map-output recovery loop) fire at qualified
+point names so one map's output — or one attempt generation — can be
+targeted deterministically:
+  shuffle.serve / shuffle.serve.m<map_index> / shuffle.serve.a<attempt>
+  shuffle.fetch / shuffle.fetch.m<map_index>
 """
 
 from __future__ import annotations
@@ -21,6 +36,11 @@ from typing import Any
 
 _lock = threading.Lock()
 _fired: dict[str, int] = {}
+#: per-process seeded RNGs, one per (seed, point) — separate streams per
+#: join point so concurrent threads exercising DIFFERENT points can't
+#: perturb each other's replay sequence (the determinism contract chaos
+#: tests rely on)
+_rngs: dict[tuple[str, str], random.Random] = {}
 
 
 class InjectedFault(RuntimeError):
@@ -30,6 +50,28 @@ class InjectedFault(RuntimeError):
 def reset() -> None:
     with _lock:
         _fired.clear()
+        _rngs.clear()
+
+
+def _random(point: str, conf: Any) -> float:
+    """One draw from the (seed, point) stream when ``tpumr.fi.seed`` is
+    set, else the global unseeded module RNG."""
+    seed = conf.get("tpumr.fi.seed") if conf is not None else None
+    if seed in (None, ""):
+        return random.random()
+    key = (str(seed), point)
+    with _lock:
+        rng = _rngs.get(key)
+        if rng is None:
+            rng = _rngs[key] = random.Random(f"{seed}:{point}")
+        return rng.random()
+
+
+def fired(point: str) -> int:
+    """How many times ``point`` has fired in this process (observability
+    for chaos tests asserting a fault actually happened)."""
+    with _lock:
+        return _fired.get(point, 0)
 
 
 def maybe_fail(point: str, conf: Any = None) -> None:
@@ -39,7 +81,7 @@ def maybe_fail(point: str, conf: Any = None) -> None:
     p = conf.get(f"tpumr.fi.{point}.probability")
     if not p:
         return
-    if random.random() >= float(p):
+    if _random(point, conf) >= float(p):
         return
     limit = int(conf.get(f"tpumr.fi.{point}.max.failures", 0) or 0)
     with _lock:
