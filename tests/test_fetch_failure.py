@@ -126,7 +126,9 @@ class TestCopierRecovery:
         for d, nominal in zip(delays, [1, 2, 4, 4, 4, 4]):
             assert 0.5 * nominal <= d <= nominal
         assert box.active() == 1
-        assert box.until("t0") > time.time()
+        # hold-offs are MONOTONIC stamps (clock-step immunity, this
+        # PR's deadline sweep) — compare against the monotonic clock
+        assert box.until("t0") > time.monotonic()
         box.clear("t0")
         assert box.active() == 0
         # strikes reset: next punishment starts from the base again
@@ -472,6 +474,7 @@ class TestHeartbeatErrorBackoff:
         nr = object.__new__(NodeRunner)      # no daemon bring-up
         nr._stop = threading.Event()
         nr.heartbeat_s = 0.2
+        nr.tracer = None                     # tracing off (the default)
         beats = []
         nr._heartbeat_once = lambda: (beats.append(time.time()),
                                       (_ for _ in ()).throw(

@@ -47,6 +47,23 @@ class TestMetricsCore:
         assert rec["prefix"] == "test"
         assert rec["sources"]["src1"]["n"] == 2
 
+    def test_file_sink_stamps_host_and_sequence(self, tmp_path):
+        """FileSink records carry hostname + a monotonic per-sink seq so
+        interleaved daemon logs (per-host files concatenated later) can
+        be totally ordered — wall-clock ts alone cannot do that across
+        hosts or clock steps; a seq gap is a dropped-record tell."""
+        import socket
+        ms = MetricsSystem("test", period_s=3600)
+        ms.new_registry("src").incr("n")
+        path = str(tmp_path / "m.jsonl")
+        ms.add_sink(FileSink(path))
+        ms.publish_once()
+        ms.publish_once()
+        ms.publish_once()
+        recs = [json.loads(line) for line in open(path)]
+        assert [r["seq"] for r in recs] == [1, 2, 3]
+        assert all(r["host"] == socket.gethostname() for r in recs)
+
 
     def test_udp_sink_statsd_lines_and_conf_wiring(self, tmp_path):
         """UdpSink (the GangliaSink role): statsd gauge lines over UDP,
@@ -178,6 +195,13 @@ class TestJobTrackerHttp:
         code, body = fetch(base + "/json/trackers")
         assert len(json.loads(body)) == 1
 
+        # the uniform top-level /metrics endpoint (same payload shape on
+        # every daemon — one scraper config for the whole cluster)
+        code, body = fetch(base + "/metrics")
+        assert code == 200
+        uniform = json.loads(body)
+        assert uniform["jobtracker"]["heartbeats"] >= 1
+
         code, body = fetch(base + "/")
         assert code == 200 and "<html>" in body
 
@@ -305,6 +329,63 @@ class TestJobTrackerHttp:
         assert "leak-me" in (tmp_path / "job_x_0001.jsonl").read_text()
 
 
+class TestTaskTrackerHttp:
+    def test_task_detail_page_surfaces_profile(self, tmp_path_factory):
+        """The tracker's /task?attempt= detail page inlines the top of
+        the attempt's cProfile report (profile.out used to be stranded
+        in the task-local dir) and links the full text + child log."""
+        hist = str(tmp_path_factory.mktemp("tt-hist"))
+        conf = JobConf()
+        conf.set("tpumr.history.dir", hist)
+        conf.set("mapred.task.tracker.http.port", 0)
+        with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0,
+                           conf=conf) as c:
+            fs = get_filesystem("mem:///")
+            fs.write_bytes("/ttp/in.txt", b"p q p\n" * 50)
+            jc = c.create_job_conf()
+            jc.set_input_paths("mem:///ttp/in.txt")
+            jc.set_output_path("mem:///ttp/out")
+            jc.set_class("mapred.mapper.class", WcMapper)
+            jc.set_class("mapred.reducer.class", SumReducer)
+            jc.set_num_reduce_tasks(1)
+            jc.set("mapred.task.profile", True)
+            jc.set("mapred.task.profile.maps", "0")
+            jc.set("mapred.task.profile.reduces", "0")
+            from tpumr.mapred.job_client import JobClient
+            assert JobClient(jc).run_job(jc).successful
+
+            tracker = c.trackers[0]
+            base = tracker._http.url
+            code, body = fetch(base + "/metrics")
+            assert code == 200 and tracker.name in json.loads(body)
+            profiled = tracker.list_profiles()
+            assert profiled
+            aid = profiled[0]
+            code, body = fetch(base + f"/task?attempt={aid}")
+            assert code == 200
+            assert "Profile (top of pstats report)" in body
+            assert "ncalls" in body or "function calls" in body
+            assert f"/json/profile?attempt={aid}" in body
+            # index links each attempt to its detail page
+            code, body = fetch(base + "/")
+            assert code == 200 and f"/task?attempt={aid}" in body
+            # unprofiled attempt renders a hint, not a 500
+            code, body = fetch(base + "/task?attempt="
+                               "attempt_0_0000_m_000099_0")
+            assert code == 200 and "no profile" in body
+
+    def test_profile_top_lines(self):
+        from tpumr.mapred.profiler import profile_top_lines
+        text = ("# profile of a\n   12 function calls in 0.001s\n\n"
+                "   ncalls  tottime  percall\n" +
+                "\n".join(f"   row{i}" for i in range(50)))
+        top = profile_top_lines(text, n=10)
+        assert top[3].lstrip().startswith("ncalls")
+        assert len(top) == 14          # header block + 10 rows
+        assert profile_top_lines("no header\njust text", n=1) == \
+            ["no header"]
+
+
 class TestNameNodeHttp:
     def test_dfs_endpoints(self, tmp_path):
         from tpumr.dfs.mini_cluster import MiniDFSCluster
@@ -321,6 +402,11 @@ class TestNameNodeHttp:
             assert info["files"] == 1 and info["datanodes"] == 2
             code, body = fetch(base + "/json/datanodes")
             assert len(json.loads(body)) == 2
+            # uniform /metrics on the dfs tier too
+            code, body = fetch(base + "/metrics")
+            assert code == 200
+            ns = json.loads(body)["namenode"]["namespace"]
+            assert ns["files"] == 1 and ns["datanodes"] == 2
 
 
 class TestHtmlDashboard:

@@ -38,6 +38,8 @@ Clients:
   fs -CMD ...          filesystem shell (tpumr fs -help for commands)
   job ...              job control: -list | -status ID | -kill ID | -counters ID
                        offline: -history ID [DIR] | -diagnose ID [DIR] (vaidya)
+                       tracing: trace ID [-out FILE] [-dir DIR] (Chrome trace
+                       + critical path; needs tpumr.trace.enabled at submit)
   balancer -nn HOST:PORT                     rebalance tdfs blocks
   fsck [PATH]          tdfs health report (missing/under-replicated blocks)
   dfsadmin ...         quotas, decommissioning, safemode, cluster report
@@ -255,6 +257,8 @@ def cmd_job(conf, argv: list[str]) -> int:
         return _job_history(conf, argv[1:])
     if argv and argv[0] == "-diagnose":
         return _job_diagnose(conf, argv[1:])
+    if argv and argv[0] in ("trace", "-trace"):
+        return _job_trace(conf, argv[1:])
     jt = conf.get("mapred.job.tracker")
     if not jt or jt == "local":
         print("job control needs -jt HOST:PORT", file=sys.stderr)
@@ -269,7 +273,7 @@ def cmd_job(conf, argv: list[str]) -> int:
              "running|completed | -list-active-trackers | "
              "-list-blacklisted-trackers | "
              "-counters ID | -counter ID GROUP NAME | -events ID | "
-             "-history ID [HISTORY_DIR]")
+             "-history ID [HISTORY_DIR] | trace ID [-out FILE] [-dir DIR]")
     if not argv:
         print(usage, file=sys.stderr)
         return 255
@@ -527,6 +531,81 @@ def _job_diagnose(conf, argv: list[str]) -> int:
     else:
         print(vaidya.format_report(report))
     return 0 if not report["findings"] else 2
+
+
+def _job_trace(conf, argv: list[str]) -> int:
+    """``tpumr job trace JOB_ID [-out FILE] [-dir TRACE_DIR]`` — export
+    one traced job's merged distributed trace (Chrome trace-event JSON,
+    loadable by chrome://tracing / Perfetto) and print its critical
+    path: the submit→schedule→launch→run chain that determined the
+    makespan, with per-span contribution percentages. Live mode pulls
+    the merge from the JobTracker (get_job_trace); offline mode
+    (``-dir``, or no jobtracker configured) merges the span files the
+    daemons flushed next to the job history."""
+    from tpumr.core import tracing
+    usage = "Usage: tpumr job trace JOB_ID [-out FILE] [-dir TRACE_DIR]"
+    if not argv:
+        print(usage, file=sys.stderr)
+        return 255
+    job_id, out, trace_dir = argv[0], None, None
+    it = iter(argv[1:])
+    for a in it:
+        if a == "-out":
+            out = next(it, None)
+        elif a == "-dir":
+            trace_dir = next(it, None)
+        else:
+            print(usage, file=sys.stderr)
+            return 255
+    spans: "list[dict]" = []
+    jt = conf.get("mapred.job.tracker")
+    if trace_dir is None and jt and jt != "local":
+        client = _jt_client(conf)
+        if client is None:
+            return 255
+        from tpumr.ipc.rpc import RpcError
+        try:
+            t = client.call("get_job_trace", job_id)
+        except RpcError as e:
+            print(f"job trace: {e}", file=sys.stderr)
+            return 1
+        if t.get("error"):
+            print(f"job trace: {t['error']}", file=sys.stderr)
+            return 1
+        spans = t["spans"]
+    else:
+        trace_dir = trace_dir or tracing.trace_dir_from_conf(conf)
+        if not trace_dir:
+            print("job trace: pass -dir TRACE_DIR or set "
+                  "tpumr.trace.dir / tpumr.history.dir", file=sys.stderr)
+            return 255
+        # the trace id IS the job id (jobtracker.submit_job)
+        spans = tracing.read_trace_files(str(trace_dir), job_id)
+    if not spans:
+        print(f"job trace: no spans found for {job_id} (was the job "
+              f"submitted with tpumr.trace.enabled=true?)",
+              file=sys.stderr)
+        return 1
+    chrome = tracing.to_chrome_trace(spans)
+    out = out or f"{job_id}-trace.json"
+    with open(out, "w") as f:
+        json.dump(chrome, f, indent=1)
+    roles = sorted({s.get("role", "?") for s in spans})
+    cp = tracing.critical_path(spans)
+    print(f"Trace: {len(spans)} spans across roles "
+          f"{', '.join(roles)}")
+    print(f"Makespan: {cp['makespan_s']:.3f}s — Chrome trace written to "
+          f"{out} (load in chrome://tracing or ui.perfetto.dev)")
+    print(f"Critical path ({len(cp['path'])} spans, "
+          f"{cp['total_s']:.3f}s summed, "
+          f"{cp['self_total_s']:.3f}s self time):")
+    print(f"  {'span':<28} {'role':<12} {'backend':<8} "
+          f"{'duration':>10} {'self':>10} {'contrib':>8}")
+    for p in cp["path"]:
+        print(f"  {p['name']:<28} {p['role']:<12} "
+              f"{p['backend'] or '—':<8} {p['duration_s']:>9.4f}s "
+              f"{p['self_s']:>9.4f}s {p['contribution_pct']:>7.1f}%")
+    return 0
 
 
 def _job_history(conf, argv: list[str]) -> int:

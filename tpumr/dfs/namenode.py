@@ -929,6 +929,11 @@ class FSNamesystem:
                     f"list; registration refused")
             self.datanodes[addr] = {"addr": addr, "capacity": capacity,
                                     "used": 0, "last_seen": _now(),
+                                    # monotonic twin of last_seen: the
+                                    # expiry deadline must survive NTP
+                                    # steps (last_seen stays wall-clock
+                                    # for the report/display surface)
+                                    "seen_mono": time.monotonic(),
                                     "blocks": 0, "rack": rack}
             self.commands.setdefault(addr, [])
             if admission == "drain" and addr not in self.decommissioning:
@@ -946,7 +951,7 @@ class FSNamesystem:
                 # and send a fresh block report (≈ DNA_REGISTER)
                 return [{"type": "register"}]
             info.update(used=used, capacity=capacity, last_seen=_now(),
-                        blocks=block_count)
+                        seen_mono=time.monotonic(), blocks=block_count)
             cmds = self.commands.get(addr, [])
             self.commands[addr] = []
             return cmds
@@ -1008,9 +1013,9 @@ class FSNamesystem:
         """Remove dead DataNodes; their replicas become under-replicated
         (≈ FSNamesystem.heartbeatCheck → removeDatanode)."""
         with self.lock:
-            now = _now()
+            now = time.monotonic()
             dead = [a for a, d in self.datanodes.items()
-                    if now - d["last_seen"] > expiry_s]
+                    if now - d.get("seen_mono", now) > expiry_s]
             for addr in dead:
                 del self.datanodes[addr]
                 self.commands.pop(addr, None)
@@ -1486,6 +1491,26 @@ class NameNode:
         """Status endpoints ≈ webapps/hdfs dfshealth.jsp + NameNodeMXBean."""
         from tpumr.http import StatusHttpServer
         srv = StatusHttpServer("namenode", port=port)
+
+        # uniform /metrics (same payload shape as the mapred daemons —
+        # one scraper config covers the whole cluster)
+        from tpumr.metrics import MetricsSystem
+        ms = MetricsSystem("namenode")
+        reg = ms.new_registry("namenode")
+
+        def _ns_gauges() -> dict:
+            with self.ns.lock:
+                return {
+                    "datanodes": len(self.ns.datanodes),
+                    "safemode": int(self.ns.safemode),
+                    "files": sum(1 for i in self.ns.namespace.values()
+                                 if i.get("type") == "file"),
+                    "blocks": sum(len(i.get("blocks", []))
+                                  for i in self.ns.namespace.values()),
+                }
+
+        reg.set_gauge("namespace", _ns_gauges)
+        srv.attach_metrics(ms)
 
         def summary(q: dict) -> dict:
             ns = self.ns

@@ -104,6 +104,9 @@ class StatusHttpServer:
         self.name = name
         self._handlers: dict[str, Handler] = {}
         self._pages: dict[str, PageHandler] = {}
+        #: top-level raw endpoints (/<path>): handler returns a str body
+        #: (served verbatim) or any JSON-able object
+        self._raw: dict[str, tuple[Handler, str]] = {}
         self._parameterized: set[str] = set()
         #: endpoint -> query param whose presence requires POST
         self._mutating_param: dict[str, str] = {}
@@ -147,6 +150,27 @@ class StatusHttpServer:
             self._parameterized.add(path)
         if mutating_param is not None:
             self._mutating_param[path] = mutating_param
+
+    def add_raw(self, path: str, handler: Handler,
+                content_type: str = "application/json") -> None:
+        """Register a TOP-LEVEL endpoint at ``/<path>`` (no /json prefix,
+        no HTML chrome): tool-facing surfaces whose path is part of the
+        operational contract — ``/metrics`` for scrapers, ``/tracejson``
+        for chrome://tracing / Perfetto. A str return is served verbatim;
+        anything else is JSON-encoded."""
+        self._raw[path] = (handler, content_type)
+
+    def attach_metrics(self, metrics_system: Any) -> None:
+        """The uniform ``/metrics`` endpoint every daemon exposes: one
+        JSON document of every registered MetricsRegistry's snapshot
+        (``{source: {metric: value}}``) — same payload shape on the
+        jobtracker, trackers, and the namenode, so one scraper config
+        covers the whole cluster. Also registered at ``/json/metrics``
+        when the daemon didn't already wire it there."""
+        handler = lambda q: metrics_system.snapshot()  # noqa: E731
+        self.add_raw("metrics", handler)
+        if "metrics" not in self._handlers:
+            self.add_json("metrics", handler)
 
     def add_page(self, path: str, handler: PageHandler,
                  parameterized: bool = False) -> None:
@@ -194,6 +218,12 @@ class StatusHttpServer:
                     self._send(req, 200, self._dashboard(), "text/html")
             elif path == "/raw":
                 self._send(req, 200, self._dashboard(), "text/html")
+            elif path.lstrip("/") in self._raw:
+                handler, ctype = self._raw[path.lstrip("/")]
+                body = handler(query)
+                if not isinstance(body, str):
+                    body = json.dumps(body, indent=2, default=str)
+                self._send(req, 200, body, ctype)
             elif path.lstrip("/") in self._pages:
                 self._send(req, 200,
                            self._page(path.lstrip("/"), query), "text/html")

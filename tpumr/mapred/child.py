@@ -53,7 +53,9 @@ class _Umbilical:
         self._killed = False
 
     def kill_requested(self) -> bool:
-        now = time.time()
+        # monotonic: the ping rate limit is interval arithmetic — a
+        # clock step must not freeze (or flood) the kill poll
+        now = time.monotonic()
         if self._killed:
             return True
         if now - self._last_ping >= _PING_INTERVAL_S:
@@ -120,55 +122,85 @@ def run_child(task_file: str) -> int:
         return bool(tracker.call("umbilical_can_commit",
                                  str(task.task_id), aid))
 
+    # distributed tracing: the task file's conf carries the trace flag +
+    # dir and the Task carries the tracker's launch-span context — the
+    # child's run span (and everything nested: spills, shuffle fetches)
+    # joins the job trace across the process boundary
+    from tpumr.core import tracing
+    tracer = tracing.Tracer.from_conf(conf, "task") \
+        if task.trace is not None else None
+    run_span = None
+    if tracer is not None:
+        run_span = tracer.start_span(
+            "task:run", task.trace["trace_id"], parent=task.trace,
+            backend="cpu", attempt_id=aid, isolation="process",
+            pid=os.getpid())
+
+    trace_done_once = [False]
+
+    def _trace_done(state: str) -> None:
+        # idempotent: the success path finishes the span BEFORE the
+        # umbilical_done RPC (so it can't be lost to a crash mid-call);
+        # if that RPC then raises, the exception handler's call must not
+        # write a second record with the same span_id
+        if tracer is None or run_span is None or trace_done_once[0]:
+            return
+        trace_done_once[0] = True
+        tracer.finish(run_span.set(state=state))
+        tracer.flush()
+
     try:
         out_path, index = "", {}
         committed = True
         from tpumr.mapred.profiler import maybe_profile, profile_dir
         local_dir = os.path.dirname(os.path.abspath(task_file))
         prof_dir = profile_dir(conf, aid, local_dir)
-        if task.is_map:
-            from tpumr.mapred.map_task import run_map_task
-            out_path, index = maybe_profile(
-                conf, task, prof_dir,
-                lambda: run_map_task(conf, task, local_dir, reporter))
-            # direct-output maps AND map-side named outputs in jobs with
-            # reducers; _commit no-ops when the work dir has no files
-            committed = _commit(conf, task, can_commit)
-        else:
-            from tpumr.mapred.reduce_task import run_reduce_task
-            from tpumr.mapred.tasktracker import make_map_locator
+        with tracing.activate(tracer, run_span):
+            if task.is_map:
+                from tpumr.mapred.map_task import run_map_task
+                out_path, index = maybe_profile(
+                    conf, task, prof_dir,
+                    lambda: run_map_task(conf, task, local_dir, reporter))
+                # direct-output maps AND map-side named outputs in jobs
+                # with reducers; _commit no-ops with no files
+                committed = _commit(conf, task, can_commit)
+            else:
+                from tpumr.mapred.reduce_task import run_reduce_task
+                from tpumr.mapred.tasktracker import make_map_locator
 
-            locate = make_map_locator(
-                lambda cursor: tracker.call("umbilical_events", job_id,
-                                            cursor),
-                secret,
-                poll_s=conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0,
-                timeout_s=conf.get_int("tpumr.shuffle.timeout.ms",
-                                       600_000) / 1000.0,
-                scope=scope)
+                locate = make_map_locator(
+                    lambda cursor: tracker.call("umbilical_events", job_id,
+                                                cursor),
+                    secret,
+                    poll_s=conf.get_int("tpumr.shuffle.poll.ms",
+                                        200) / 1000.0,
+                    timeout_s=conf.get_int("tpumr.shuffle.timeout.ms",
+                                           600_000) / 1000.0,
+                    scope=scope)
 
-            from tpumr.mapred.shuffle_copier import RemoteChunkSource
-            conf.set("tpumr.task.local.dir",
-                     os.path.join(local_dir, "shuffle"))
-            fetch = RemoteChunkSource(conf, job_id, locate)
+                from tpumr.mapred.shuffle_copier import RemoteChunkSource
+                conf.set("tpumr.task.local.dir",
+                         os.path.join(local_dir, "shuffle"))
+                fetch = RemoteChunkSource(conf, job_id, locate)
 
-            def report_fetch_failure(map_index: int,
-                                     map_attempt: str) -> None:
-                # best-effort: the copier's penalty/retry loop keeps the
-                # reduce alive even when the report can't be delivered
-                try:
-                    tracker.call("umbilical_report_fetch_failure", aid,
-                                 map_attempt)
-                except Exception:  # noqa: BLE001
-                    pass
+                def report_fetch_failure(map_index: int,
+                                         map_attempt: str) -> None:
+                    # best-effort: the copier's penalty/retry loop keeps
+                    # the reduce alive even when the report can't be
+                    # delivered
+                    try:
+                        tracker.call("umbilical_report_fetch_failure",
+                                     aid, map_attempt)
+                    except Exception:  # noqa: BLE001
+                        pass
 
-            fetch.on_fetch_failure = report_fetch_failure
+                fetch.on_fetch_failure = report_fetch_failure
 
-            maybe_profile(conf, task, prof_dir,
-                          lambda: run_reduce_task(conf, task, fetch,
-                                                  reporter))
-            phase[0] = "REDUCE"
-            committed = _commit(conf, task, can_commit)
+                maybe_profile(conf, task, prof_dir,
+                              lambda: run_reduce_task(conf, task, fetch,
+                                                      reporter))
+                phase[0] = "REDUCE"
+                committed = _commit(conf, task, can_commit)
         stop.set()
         final = {
             "counters": reporter.counters.to_dict(),
@@ -178,11 +210,13 @@ def run_child(task_file: str) -> int:
             "diagnostics": ("" if committed
                             else "commit denied: another attempt won"),
         }
+        _trace_done(final["state"])
         tracker.call("umbilical_done", aid, final, job_id,
                      task.partition, out_path, index)
         return 0
     except TaskKilledError:
         stop.set()
+        _trace_done("KILLED")
         _report_fail(tracker, aid, "KILLED",
                      "attempt killed while running (preempted or "
                      "superseded)")
@@ -190,6 +224,9 @@ def run_child(task_file: str) -> int:
     except BaseException as e:  # noqa: BLE001 — task failure is data
         stop.set()
         diag = f"{type(e).__name__}: {e}\n" + traceback.format_exc(limit=8)
+        if run_span is not None:
+            run_span.set(error=diag.splitlines()[0])
+        _trace_done("FAILED")
         _report_fail(tracker, aid, "FAILED", diag)
         return 1
 
@@ -198,16 +235,20 @@ def _commit(conf: Any, task: Any, can_commit: Any) -> bool:
     """Commit gate, child side (same contract as NodeRunner._commit): the
     tracker proxies the grant to the master; a losing attempt aborts its
     work dir and reports KILLED."""
+    from tpumr.core import tracing
     from tpumr.mapred.output_formats import FileOutputCommitter
     committer = FileOutputCommitter(conf)
     aid = str(task.attempt_id)
     if not committer.needs_commit(aid):
         return True
-    if can_commit():
-        committer.commit_task(aid)
-        return True
-    committer.abort_task(aid)
-    return False
+    with tracing.span("task:commit", attempt_id=aid) as s:
+        if can_commit():
+            committer.commit_task(aid)
+            return True
+        if s is not None:
+            s.set(denied=True)
+        committer.abort_task(aid)
+        return False
 
 
 def _report_fail(tracker: Any, aid: str, state: str, diag: str) -> None:

@@ -195,6 +195,11 @@ class JobInProgress:
         #: placement is a first-class metric). Bounded; overflow counted.
         self.placement_series: list = []
         self.placement_dropped = 0
+        #: distributed tracing (core/tracing.py): the job's trace id and
+        #: the open root span, set by the master at submit for traced
+        #: jobs only ("" / None keeps every trace check a cheap miss)
+        self.trace_id: str = str(self.conf.get("tpumr.trace.id", "") or "")
+        self.trace_root: Any = None
 
     # ------------------------------------------------------------ queries
 

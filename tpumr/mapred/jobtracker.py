@@ -63,6 +63,8 @@ JOBTRACKER_POLICY = {
                                   "security.job.submission.protocol.acl"],
     "get_job_status": ["security.inter.tracker.protocol.acl",
                        "security.job.submission.protocol.acl"],
+    "get_job_trace": ["security.inter.tracker.protocol.acl",
+                      "security.job.submission.protocol.acl"],
     "refresh_queues": ["security.admin.operations.protocol.acl"],
     "refresh_nodes": ["security.admin.operations.protocol.acl"],
     "refresh_service_acl": ["security.refresh.policy.protocol.acl"],
@@ -75,7 +77,11 @@ JOBTRACKER_POLICY = {
 class _TrackerInfo:
     def __init__(self, status: dict) -> None:
         self.status = status
+        #: wall-clock, for the status surfaces (/json/trackers)
         self.last_seen = time.time()
+        #: monotonic twin for the lease DEADLINE — an NTP step on the
+        #: master must not mass-expire (or immortalize) trackers
+        self.seen_mono = time.monotonic()
         self.failures = 0
         self.blacklisted = False
 
@@ -176,6 +182,15 @@ class JobMaster:
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)
+        # distributed tracing (core/tracing.py): the tracer always
+        # exists (cheap buffer object); spans are recorded ONLY for jobs
+        # whose conf enables tracing — jip.trace_root None is the
+        # zero-overhead-off fast path on every heartbeat
+        from tpumr.core.tracing import (Tracer, trace_dir_from_conf,
+                                        trace_enabled)
+        self.tracer = Tracer("jobtracker",
+                             trace_dir=trace_dir_from_conf(conf))
+        self._trace_all = trace_enabled(conf)
         self._http: Any = None
         self._http_port = conf.get_int("mapred.job.tracker.http.port", -1)
 
@@ -257,6 +272,7 @@ class JobMaster:
     def stop(self) -> None:
         self._stop.set()
         self.metrics.stop()
+        self.tracer.flush()
         if self._http is not None:
             self._http.stop()
         self._server.stop()
@@ -301,9 +317,24 @@ class JobMaster:
         srv.add_json("tasks", lambda q: self.get_task_reports(
             q["id"], q.get("kind", "map")), parameterized=True)
         srv.add_json("trackers", trackers_info)
-        srv.add_json("metrics", lambda q: self.metrics.snapshot())
+        # registers both /metrics (uniform, scraper-facing) and the
+        # long-standing /json/metrics with one handler
+        srv.attach_metrics(self.metrics)
         from tpumr.core.configuration import redacted_dict
         srv.add_json("conf", lambda q: redacted_dict(self.conf))
+
+        # distributed tracing: /tracejson?job= serves the merged trace
+        # in Chrome trace-event format (chrome://tracing / Perfetto
+        # load it directly); /trace?job= renders the swimlane timeline
+        from tpumr.core import tracing as _tracing
+
+        def tracejson(q: dict):
+            return _tracing.to_chrome_trace(
+                self.get_job_trace(q["job"])["spans"])
+
+        srv.add_raw("tracejson", tracejson)
+        srv.add_json("trace", lambda q: self.get_job_trace(q["job"]),
+                     parameterized=True)
 
         # HTML views ≈ webapps/job/{jobtracker,jobdetails,jobtasks}.jsp
         from tpumr.http import (RawHtml, html_escape, html_table,
@@ -399,7 +430,35 @@ class JobMaster:
                      for n, v in sorted(cs.items())]
             parts.append("<h2>Counters</h2>")
             parts.append(html_table(["group", "counter", "value"], crows))
+            if jip.trace_id:
+                parts.append(
+                    f"<p><a href='/trace?job={html_escape(jid)}'>span "
+                    f"timeline</a> · <a href='/tracejson?job="
+                    f"{html_escape(jid)}'>chrome trace json</a></p>")
             return "".join(parts)
+
+        def trace_page(q: dict) -> str:
+            jid = q["job"]
+            t = self.get_job_trace(jid)
+            if not t["spans"]:
+                return (f"<h1>Trace {html_escape(jid)}</h1>"
+                        f"<p class='dim'>{html_escape(t.get('error') or 'no spans yet')}</p>")
+            cp = _tracing.critical_path(t["spans"])
+            crit_rows = [[p["name"], p["role"], p["backend"] or "—",
+                          f"{p['duration_s']:.4f}s",
+                          f"{p['self_s']:.4f}s",
+                          f"{p['contribution_pct']:.1f}%"]
+                         for p in cp["path"]]
+            return (
+                f"<h1>Trace {html_escape(jid)}</h1>"
+                f"<p>{len(t['spans'])} spans · makespan "
+                f"{cp['makespan_s']:.3f}s · <a href='/tracejson?job="
+                f"{html_escape(jid)}'>chrome trace json</a> (load in "
+                f"chrome://tracing or Perfetto)</p>"
+                + RawHtml(_tracing.swimlane_svg(t["spans"]))
+                + "<h2>Critical path</h2>"
+                + html_table(["span", "role", "backend", "duration",
+                              "self", "contribution"], crit_rows))
 
         def trackers_page(q: dict) -> str:
             import time as _time
@@ -432,6 +491,7 @@ class JobMaster:
 
         srv.add_page("index", index_page)
         srv.add_page("job", job_page, parameterized=True)
+        srv.add_page("trace", trace_page, parameterized=True)
         srv.add_page("trackers", trackers_page)
         return srv
 
@@ -505,9 +565,40 @@ class JobMaster:
         with self.lock:
             self._next_job += 1
             job_id = JobID(self.cluster_id, self._next_job)
+        # distributed tracing: one trace per job, id = the job id (file
+        # names + grep both read naturally). Minted BEFORE JobInProgress
+        # construction so jip.conf carries it to every tracker
+        # (get_job_conf) and child process (the task file).
+        from tpumr.core.tracing import (ENABLED_KEY, TRACE_ID_KEY,
+                                        trace_dir_from_conf, trace_enabled)
+        if self._trace_all or trace_enabled(conf_dict):
+            # overwrite, never setdefault: a clone-and-rerun of a
+            # finished job's conf carries the OLD job's trace id, which
+            # would merge two jobs' spans into one file
+            conf_dict[TRACE_ID_KEY] = str(job_id)
+            # master-conf-only tracing must still reach trackers and
+            # children — they build their tracers from the JOB conf
+            conf_dict[ENABLED_KEY] = True
+            # ONE authoritative sink for the whole trace: the master's
+            # dir when it has one, else the job conf's — stamped into
+            # the job conf so trackers/children write exactly where
+            # get_job_trace will read
+            sink = self.tracer.trace_dir or trace_dir_from_conf(conf_dict)
+            if sink:
+                conf_dict["tpumr.trace.dir"] = sink
         # JobInProgress construction resolves split racks (may exec the
         # topology script) — built outside the master lock
         jip = JobInProgress(job_id, conf_dict, splits)
+        if jip.trace_id:
+            if not self.tracer.trace_dir:
+                self.tracer.trace_dir = trace_dir_from_conf(conf_dict)
+            jip.trace_root = self.tracer.start_span(
+                "job", jip.trace_id, job_id=str(job_id),
+                job_name=str(conf_dict.get("mapred.job.name", "")))
+            self.tracer.instant(
+                "job:submit", jip.trace_id, parent=jip.trace_root,
+                num_maps=len(splits),
+                num_reduces=int(conf_dict.get("mapred.reduce.tasks", 1)))
         # per-job shuffle/umbilical token ≈ the reference's JobToken
         # (JobTokenSecretManager): task children get THIS, never the
         # cluster secret, so a task can only reach its own job's
@@ -839,6 +930,10 @@ class JobMaster:
             if jip.finalize_started:
                 return
             jip.finalize_started = True
+        root = jip.trace_root
+        fin_span = self.tracer.start_span(
+            "job:finalize", jip.trace_id, parent=root) \
+            if root is not None else None
         try:
             from tpumr.mapred.output_formats import FileOutputCommitter
             conf = JobConf()
@@ -856,6 +951,16 @@ class JobMaster:
             self.history.job_finished(jip)
             self._mreg.incr(f"jobs_{jip.state.lower()}")
         finally:
+            if root is not None:
+                # the root span closes with the job and every master
+                # span hits disk BEFORE clients can observe the terminal
+                # state — a trace pulled right after completion is whole
+                if fin_span is not None:
+                    self.tracer.finish(fin_span.set(state=jip.state))
+                jip.trace_root = None
+                self.tracer.finish(root.set(state=jip.state,
+                                            error=jip.error or ""))
+                self.tracer.flush()
             # even when history I/O fails the job must become observable
             # as finished — a stuck RUNNING mask would hang clients
             jip.finalized.set()
@@ -871,6 +976,36 @@ class JobMaster:
         jip = self._job(job_id)
         self._check_job_op(jip, "view")
         return dict(jip.conf)
+
+    def get_job_trace(self, job_id: str) -> dict:
+        """Merged distributed trace of one traced job: every daemon's
+        flushed span files under the trace dir plus the master's own
+        buffer, as raw span dicts (the CLI/HTTP layers convert to Chrome
+        trace-event format / compute the critical path)."""
+        jip = self._job(job_id)
+        self._check_job_op(jip, "view")
+        from tpumr.core import tracing
+        if not jip.trace_id:
+            return {"trace_id": "", "spans": [],
+                    "error": f"job {job_id} was not traced "
+                             f"(set tpumr.trace.enabled=true at submit)"}
+        self.tracer.flush()
+        # read from the JOB's stamped sink (submit_job made it the
+        # authoritative dir every daemon writes to), falling back to the
+        # master's own — writers and readers must resolve one place
+        read_dir = tracing.trace_dir_from_conf(jip.conf) \
+            or self.tracer.trace_dir
+        spans = tracing.read_trace_files(read_dir, jip.trace_id) \
+            if read_dir else []
+        root = jip.trace_root
+        if root is not None:
+            # still running: ship the open root (end = now) so partial
+            # traces anchor correctly in viewers
+            d = root.to_dict()
+            d["end"] = time.time()
+            d["attributes"] = {**d["attributes"], "in_flight": True}
+            spans.append(d)
+        return {"trace_id": jip.trace_id, "spans": spans}
 
     def get_job_token(self, job_id: str) -> bytes:
         """Per-job token for trackers localizing the job (cluster-secret
@@ -953,6 +1088,7 @@ class JobMaster:
                 info = self.trackers[name] = _TrackerInfo(status)
             info.status = status
             info.last_seen = time.time()
+            info.seen_mono = time.monotonic()
 
             # Fold in task statuses FIRST — even when this turns out to be a
             # replayed heartbeat. The tracker drops terminal statuses after
@@ -1051,6 +1187,19 @@ class JobMaster:
                         self._mreg.incr("maps_launched_tpu")
                     else:
                         self._mreg.incr("maps_launched_cpu")
+                    tjip = self.jobs.get(str(task.attempt_id.task.job))
+                    if tjip is not None and tjip.trace_root is not None:
+                        # scheduling decision span; its context rides the
+                        # launch action so the tracker/child parent their
+                        # spans to it (submit→schedule→launch→run chain)
+                        sched = self.tracer.instant(
+                            "schedule", tjip.trace_id,
+                            parent=tjip.trace_root,
+                            backend=("tpu" if task.run_on_tpu else "cpu")
+                            if task.is_map else "cpu",
+                            attempt_id=str(task.attempt_id), tracker=name)
+                        task.trace = {"trace_id": tjip.trace_id,
+                                      "span_id": sched.span_id}
                     actions.append({"type": "launch",
                                     "job_id": str(task.attempt_id.task.job),
                                     "task": task.to_dict()})
@@ -1095,6 +1244,17 @@ class JobMaster:
         if res is None:
             return   # stale (already withdrawn) — not a counted report
         self._mreg.incr("fetch_failures_reported")
+        if jip.trace_root is not None:
+            # per-map fetch-failure recovery on the job timeline: report
+            # marks are sub-threshold; a withdrawal is the re-execution
+            # decision itself
+            self.tracer.instant(
+                "fetch_failure:withdraw" if res["withdrawn"]
+                else "fetch_failure:report",
+                jip.trace_id, parent=jip.trace_root,
+                map_attempt=map_attempt, reduce_attempt=reduce_attempt,
+                reports=res.get("reports", 0),
+                reexecuted=res["reexecuted"])
         if res["withdrawn"]:
             self._revoke_commit(str(task_id), map_attempt)
             if res["reexecuted"]:
@@ -1154,10 +1314,10 @@ class JobMaster:
 
     def _expire_loop(self) -> None:
         while not self._stop.wait(min(1.0, self.expiry_s / 3)):
-            now = time.time()
+            now = time.monotonic()
             self.token_store.purge_expired()
             with self.lock:
                 lost = [n for n, t in self.trackers.items()
-                        if now - t.last_seen > self.expiry_s]
+                        if now - t.seen_mono > self.expiry_s]
                 for name in lost:
                     self._evict_tracker_locked(name)

@@ -95,6 +95,12 @@ class MapOutputBuffer:
         """≈ MapTask.sortAndSpill (MapTask.java:1396)."""
         if not self._buf:
             return
+        from tpumr.core import tracing
+        with tracing.span("map:spill", records=len(self._buf),
+                          bytes=self._bytes, spill=len(self._spills)):
+            self._sort_and_spill_inner()
+
+    def _sort_and_spill_inner(self) -> None:
         sk = self.comparator.sort_key
         self._buf.sort(key=lambda rec: (rec[0], sk(rec[1])))
         spill_path = os.path.join(self.local_dir,
@@ -169,6 +175,12 @@ class MapOutputBuffer:
             path, index = self._spills[0]
             os.replace(path, final_path)
             return final_path, index
+        from tpumr.core import tracing
+        with tracing.span("map:merge", spills=len(self._spills)):
+            return self._merge_spills(final_path)
+
+    def _merge_spills(self, final_path: str) -> tuple[str, dict]:
+        """Final k-way merge of the spill files (≈ mergeParts)."""
         sk = self.comparator.sort_key
         streams = [open(p, "rb") for p, _ in self._spills]
         try:

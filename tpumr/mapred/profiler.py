@@ -91,6 +91,18 @@ def maybe_profile(conf: Any, task: Any, local_dir: str,
         _dump_profile(prof, conf, task, local_dir)
 
 
+def profile_top_lines(text: str, n: int = 25) -> "list[str]":
+    """The header + first ``n`` data rows of a pstats report — the
+    task-detail-page summary (full text stays one click away). Keeps
+    everything through the column-header line, then ``n`` rows."""
+    lines = text.splitlines()
+    header_end = next((i for i, ln in enumerate(lines)
+                       if ln.lstrip().startswith("ncalls")), None)
+    if header_end is None:
+        return lines[:n]
+    return lines[:header_end + 1 + n]
+
+
 def _dump_profile(prof: Any, conf: Any, task: Any, local_dir: str) -> None:
     try:
         import io

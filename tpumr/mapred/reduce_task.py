@@ -50,6 +50,7 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
     #    (LocalJobRunner) — nothing copied, nothing materialized;
     #  - legacy FetchFn callable: sequential whole-segment iterables
     #    (kept for tests and custom fetchers).
+    from tpumr.core import tracing
     from tpumr.mapred.shuffle_copier import ShuffleCopier
     segments: list[Iterable[tuple[bytes, bytes]]]
     closeable: list[Any] = []
@@ -67,16 +68,23 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
             # isolated children wire on_fetch_failure to the umbilical
             # report, so a lost map output stalls (and recovers) this
             # reduce instead of failing it
-            copier = ShuffleCopier(conf, fetch, task.num_maps,
-                                   task.partition, spill_dir, reporter,
-                                   on_fetch_failure=getattr(
-                                       fetch, "on_fetch_failure", None))
-            segments = copier.copy_all()
+            with tracing.span("reduce:shuffle",
+                              num_maps=task.num_maps) as s:
+                copier = ShuffleCopier(conf, fetch, task.num_maps,
+                                       task.partition, spill_dir, reporter,
+                                       on_fetch_failure=getattr(
+                                           fetch, "on_fetch_failure", None))
+                segments = copier.copy_all()
+                if s is not None:
+                    s.set(in_memory=copier.copied_in_memory,
+                          on_disk=copier.spilled_to_disk,
+                          fetch_failures=copier.fetch_failures)
             closeable = list(segments)
         elif not hasattr(fetch, "segments"):
             segments = [fetch(m, task.partition)
                         for m in range(task.num_maps)]
-        _run_reduce_phase(conf, task, segments, sk, gk, reporter)
+        with tracing.span("reduce:merge_reduce", segments=len(segments)):
+            _run_reduce_phase(conf, task, segments, sk, gk, reporter)
     finally:
         # everything after the copy phase — even reducer/output SETUP —
         # must release shuffle resources (RAM budget, disk spills) or a

@@ -202,18 +202,22 @@ class PenaltyBox:
         self._until: dict[str, float] = {}
 
     def punish(self, key: str) -> float:
-        """Record one failure; returns the jittered hold-off seconds."""
+        """Record one failure; returns the jittered hold-off seconds.
+        Hold-offs are MONOTONIC stamps: a wall-clock step mid-shuffle
+        must neither spring every penalized source free at once nor
+        freeze them in the box."""
         with self._lock:
             strikes = self._strikes.get(key, 0) + 1
             self._strikes[key] = strikes
             delay = min(self.cap_s, self.base_s * (2 ** (strikes - 1)))
             delay *= 0.5 + random.random() * 0.5
             self._until[key] = max(self._until.get(key, 0.0),
-                                   time.time() + delay)
+                                   time.monotonic() + delay)
             return delay
 
     def until(self, key: str) -> float:
-        """Earliest time this source should be fetched from again."""
+        """Earliest time (monotonic clock) this source should be fetched
+        from again."""
         with self._lock:
             return self._until.get(key, 0.0)
 
@@ -224,7 +228,7 @@ class PenaltyBox:
 
     def active(self) -> int:
         """How many sources are currently serving a penalty (gauge)."""
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             return sum(1 for t in self._until.values() if t > now)
 
@@ -279,10 +283,25 @@ class ShuffleCopier:
         self._stats_lock = threading.Lock()
         self._map_failures: dict[int, int] = {}
         self._src_failures: dict[tuple[int, str], int] = {}
+        # built on the TASK thread: snapshot its ambient trace context so
+        # fetch spans recorded by the worker pool nest under the reduce's
+        # run span (core/tracing.py; None when tracing is off)
+        from tpumr.core import tracing
+        self._trace_ctx = tracing.capture()
 
     # ------------------------------------------------------------ one map
 
     def _copy_one(self, map_index: int) -> Segment:
+        from tpumr.core import tracing
+        with tracing.span("shuffle:fetch", map_index=map_index,
+                          addr=self._addr_of(map_index)) as s:
+            seg = self._copy_one_inner(map_index)
+            if s is not None:
+                s.set(raw_bytes=seg.raw_length,
+                      in_memory=seg.in_memory)
+            return seg
+
+    def _copy_one_inner(self, map_index: int) -> Segment:
         from tpumr.utils.fi import maybe_fail
         maybe_fail("shuffle.fetch", self.conf)
         maybe_fail(f"shuffle.fetch.m{map_index}", self.conf)
@@ -404,6 +423,11 @@ class ShuffleCopier:
         if total >= self.max_fetch_failures:
             return None
         delay = self.penalty_box.punish(addr)
+        from tpumr.core import tracing
+        # penalty-box entries on the trace: where a reduce's wall-clock
+        # goes while a source recovers (or its map re-executes)
+        tracing.instant("shuffle:penalty", map_index=map_index, addr=addr,
+                        delay_s=round(delay, 4), failures=per_src)
         if self.reporter is not None:
             self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                                        TaskCounter.REDUCE_FETCH_FAILURES, 1)
@@ -417,6 +441,8 @@ class ShuffleCopier:
             attempt = self._source_hook("attempt_of", map_index, "") or ""
             try:
                 self.on_fetch_failure(map_index, attempt)
+                tracing.instant("shuffle:fetch_failure_report",
+                                map_index=map_index, map_attempt=attempt)
                 with self._stats_lock:
                     self.fetch_failures_reported += 1
             except Exception:  # noqa: BLE001 — reporting is best-effort;
@@ -440,6 +466,13 @@ class ShuffleCopier:
         lock = threading.Lock()
 
         def worker() -> None:
+            # adopt the task thread's trace context so fetch/penalty
+            # spans land under the reduce's run span
+            from tpumr.core import tracing
+            with tracing.activate_captured(self._trace_ctx):
+                worker_body()
+
+        def worker_body() -> None:
             while True:
                 with lock:
                     if errors or outstanding[0] <= 0:
@@ -455,7 +488,7 @@ class ShuffleCopier:
                 # same address clears the box and the map retries
                 # immediately instead of waiting out a stale hold-off
                 hold = max(ready, self._penalized_until(m))
-                now = time.time()
+                now = time.monotonic()
                 if hold > now:
                     # not yet — rotate it to the back and nap briefly so
                     # an all-penalized queue doesn't busy-spin
@@ -476,7 +509,7 @@ class ShuffleCopier:
                         return
                     # ready now; the pop-side penalty check supplies the
                     # (possibly already-cleared) hold-off
-                    work.put((time.time(), m))
+                    work.put((time.monotonic(), m))
                     continue
                 self._note_success(m)
                 with lock:

@@ -60,6 +60,11 @@ class Task:
     #: at assign time so the tracker can report available memory without a
     #: conf lookup — feeds the capacity scheduler's memory matching
     memory_mb: int = 0
+    #: distributed-tracing context ({trace_id, span_id} of the master's
+    #: scheduling span), stamped at assign time for traced jobs only —
+    #: the tracker and child parent their spans to it (core/tracing.py).
+    #: None for untraced jobs: the zero-overhead-off contract.
+    trace: dict | None = None
 
     @property
     def is_map(self) -> bool:
@@ -79,6 +84,7 @@ class Task:
             "run_on_tpu": self.run_on_tpu,
             "tpu_device_id": self.tpu_device_id,
             "memory_mb": self.memory_mb,
+            "trace": self.trace,
         }
 
     @classmethod
@@ -88,7 +94,8 @@ class Task:
                    split=d.get("split"), num_maps=d.get("num_maps", 0),
                    run_on_tpu=d.get("run_on_tpu", False),
                    tpu_device_id=d.get("tpu_device_id", -1),
-                   memory_mb=d.get("memory_mb", 0))
+                   memory_mb=d.get("memory_mb", 0),
+                   trace=d.get("trace"))
 
 
 @dataclass

@@ -152,7 +152,9 @@ class MapLocator:
                 self._stale[map_index] = e
 
     def __call__(self, map_index: int) -> RpcClient:
-        deadline = time.time() + self._timeout_s
+        # monotonic deadline: an NTP step mid-shuffle must neither fire
+        # the timeout early nor stall it past the configured bound
+        deadline = time.monotonic() + self._timeout_s
         while True:
             with self._cache_lock:
                 # event read under the SAME lock hold that checked it: a
@@ -179,7 +181,7 @@ class MapLocator:
                     # box) until the master replaces or withdraws it
                     self._events[map_index] = stale
                     continue
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"map {map_index} output never became available")
             time.sleep(self._poll_s)
@@ -271,9 +273,11 @@ class NodeRunner:
         # scoped callers may reach only the umbilical + shuffle surface,
         # and the methods themselves pin the scope to the job argument
         self._job_tokens: dict[str, bytes] = {}
-        self._job_token_misses: dict[str, float] = {}  # scope -> retry-at
+        #: scope -> monotonic retry-at (negative cache deadlines must
+        #: not stretch/shrink with wall-clock steps)
+        self._job_token_misses: dict[str, float] = {}
         self._miss_budget = 20.0            # token bucket for miss lookups
-        self._miss_budget_ts = time.time()
+        self._miss_budget_ts = time.monotonic()
         self._server.token_resolver = self._job_token_or_none
         self._server.scoped_methods = {
             "get_protocol_version", "umbilical_ping", "umbilical_status",
@@ -304,6 +308,13 @@ class NodeRunner:
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)
+        # distributed tracing (core/tracing.py): daemon-level tracer when
+        # the TRACKER conf enables it (None otherwise — the fast path);
+        # jobs traced without the daemon flag get a per-job tracer built
+        # from their own conf, cached until job cleanup
+        from tpumr.core.tracing import Tracer
+        self.tracer = Tracer.from_conf(conf, "tasktracker")
+        self._job_tracers: dict[str, Tracer] = {}
         self._http: Any = None
         self._http_port = conf.get_int("mapred.task.tracker.http.port", -1)
 
@@ -340,7 +351,8 @@ class NodeRunner:
             from tpumr.http import StatusHttpServer, html_table
             srv = StatusHttpServer(self.name, port=self._http_port)
             srv.add_json("status", lambda q: self._status_dict())
-            srv.add_json("metrics", lambda q: self.metrics.snapshot())
+            # /metrics + /json/metrics from one handler
+            srv.attach_metrics(self.metrics)
             srv.add_json("profiles", lambda q: self.list_profiles())
             srv.add_json("profile",
                          lambda q: {"attempt": q["attempt"],
@@ -354,13 +366,23 @@ class NodeRunner:
                                         self.get_task_log(q["attempt"])},
                          parameterized=True)
 
+            from tpumr.http import RawHtml, html_escape
+
             def index_page(q: dict) -> str:
                 st = self._status_dict()
-                rows = [[s["attempt_id"], s["state"], s["phase"],
+                rows = [[RawHtml(
+                            f"<a href='/task?attempt="
+                            f"{html_escape(s['attempt_id'])}'>"
+                            f"{html_escape(s['attempt_id'])}</a>"),
+                         s["state"], s["phase"],
                          (f"tpu:{s['tpu_device_id']}" if s["run_on_tpu"]
                           else "cpu") if s["is_map"] else "reduce",
                          f"{s['progress']:.0%}"]
                         for s in st["task_statuses"]]
+                profiled = self.list_profiles()
+                prof_links = " · ".join(
+                    f"<a href='/task?attempt={html_escape(a)}'>"
+                    f"{html_escape(a)}</a>" for a in profiled)
                 return (
                     f"<h1>TaskTracker {st['tracker_name']}</h1>"
                     f"<p>host {st['host']} · cpu "
@@ -373,15 +395,69 @@ class NodeRunner:
                               for f in st["available_tpu_devices"])
                     + "</p><h2>Running attempts</h2>"
                     + html_table(["attempt", "state", "phase", "backend",
-                                  "progress"], rows))
+                                  "progress"], rows)
+                    + (f"<h2>Profiled attempts</h2><p>{prof_links}</p>"
+                       if profiled else ""))
+
+            def task_page(q: dict) -> str:
+                """Per-attempt detail (≈ taskdetails.jsp + the
+                TaskLogServlet links): live status when running, the
+                retained child log link, and the cProfile report's
+                top-N pstats lines inline instead of stranding
+                profile.out in the task-local dir."""
+                aid = q["attempt"]
+                with self.lock:
+                    st = self.running.get(aid)
+                parts = [f"<h1>Attempt {html_escape(aid)}</h1>"]
+                if st is not None:
+                    parts.append(
+                        f"<p>state <b>{html_escape(st.state)}</b> · phase "
+                        f"{html_escape(st.phase)} · progress "
+                        f"{st.progress:.0%}"
+                        + (f" · diagnostics "
+                           f"{html_escape(st.diagnostics)}"
+                           if st.diagnostics else "") + "</p>")
+                else:
+                    parts.append("<p class='dim'>not currently running "
+                                 "on this tracker</p>")
+                from tpumr.mapred.profiler import profile_top_lines
+                try:
+                    text = self.get_profile(aid)
+                except KeyError:
+                    parts.append("<p class='dim'>no profile for this "
+                                 "attempt (enable mapred.task.profile "
+                                 "and the task-id range keys)</p>")
+                else:
+                    top = profile_top_lines(text)
+                    parts.append(
+                        "<h2>Profile (top of pstats report)</h2><pre>"
+                        + html_escape("\n".join(top)) + "</pre>"
+                        f"<p><a href='/json/profile?attempt="
+                        f"{html_escape(aid)}'>full profile.out</a></p>")
+                try:
+                    self._open_userlog(aid, "child.log").close()
+                except KeyError:
+                    pass
+                else:
+                    parts.append(
+                        f"<p><a href='/json/tasklog?attempt="
+                        f"{html_escape(aid)}'>retained child log</a></p>")
+                return "".join(parts)
 
             srv.add_page("index", index_page)
+            srv.add_page("task", task_page, parameterized=True)
             self._http = srv.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         self.metrics.stop()
+        if self.tracer is not None:
+            self.tracer.flush()
+        with self.lock:
+            tracers = list(self._job_tracers.values())
+        for t in tracers:
+            t.flush()
         if self.health is not None:
             self.health.stop()
         if self._http is not None:
@@ -472,7 +548,15 @@ class NodeRunner:
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                self._heartbeat_once()
+                if self.tracer is None:
+                    self._heartbeat_once()
+                else:
+                    # daemon-scoped trace (trace id = the tracker, not a
+                    # job): heartbeat latency is where master contention
+                    # shows up first
+                    with self.tracer.span("heartbeat",
+                                          f"daemon-{self.name}"):
+                        self._heartbeat_once()
             except Exception:
                 # master briefly unreachable — keep trying (lease
                 # semantics); back off solely via the interruptible
@@ -538,6 +622,9 @@ class NodeRunner:
                                         if k[0] != job_id}
                     jc = self.job_confs.pop(job_id, None)
                     self._job_tokens.pop(job_id, None)
+                    jt = self._job_tracers.pop(job_id, None)
+                if jt is not None:
+                    jt.flush()   # stragglers of the finished traced job
                 if jc is not None:
                     from tpumr.mapred import filecache
                     filecache.release_job(
@@ -627,7 +714,7 @@ class NodeRunner:
         globally rate-limited, so a flood of unique bogus scopes (each a
         guaranteed cache miss) cannot amplify into unbounded
         tracker→master RPC traffic or memory growth."""
-        now = time.time()
+        now = time.monotonic()
         with self.lock:
             if self._job_token_misses.get(scope, 0) > now:
                 return None
@@ -680,6 +767,14 @@ class NodeRunner:
             # here, OUTSIDE the job scratch dir that cleanup rmtree's
             jc.set("tpumr.task.userlogs.dir",
                    os.path.join(self.local_root, "userlogs", job_id))
+            # trace sink fallback: a client may enable tracing without
+            # naming a dir (those are daemon-side keys) — without this,
+            # the tracker's and child's spans would be silently dropped
+            from tpumr.core.tracing import trace_dir_from_conf
+            if trace_dir_from_conf(jc) is None:
+                d = trace_dir_from_conf(self.conf)
+                if d:
+                    jc.set("tpumr.trace.dir", d)
             with self.lock:
                 self.job_confs[job_id] = jc
         return jc
@@ -705,6 +800,42 @@ class NodeRunner:
                              name=f"task-{aid}", daemon=True)
         t.start()
 
+    def _trace_tracer(self, job_id: str, task: Task):
+        """The tracer for a TRACED task (``task.trace`` stamped by the
+        master), or None: the daemon's own when the tracker conf enables
+        tracing, else a per-job tracer built from the job conf (cached
+        until job cleanup). Never raises — a master outage during the
+        conf fetch just runs the task untraced."""
+        if task.trace is None:
+            return None
+        if self.tracer is not None:
+            if self.tracer.trace_dir is None:
+                # tracker conf enabled tracing but named no sink — the
+                # job conf (dir-fallback-patched in _job_conf) supplies
+                # it, exactly like the master patches its own at submit
+                try:
+                    from tpumr.core.tracing import trace_dir_from_conf
+                    self.tracer.trace_dir = trace_dir_from_conf(
+                        self._job_conf(job_id))
+                except Exception:  # noqa: BLE001 — master briefly down
+                    pass
+            return self.tracer
+        with self.lock:
+            t = self._job_tracers.get(job_id)
+        if t is not None:
+            return t
+        try:
+            conf = self._job_conf(job_id)
+        except Exception:  # noqa: BLE001
+            return None
+        from tpumr.core.tracing import Tracer
+        t = Tracer.from_conf(conf, "tasktracker")
+        if t is None:
+            return None
+        with self.lock:
+            t = self._job_tracers.setdefault(job_id, t)
+        return t
+
     def _run_task(self, job_id: str, task: Task, status: TaskStatus) -> None:
         aid = str(task.attempt_id)
 
@@ -719,11 +850,58 @@ class NodeRunner:
         reporter = Reporter(abort_check=killed)
         sem = (self._red_sem if not task.is_map
                else self._tpu_sem if task.run_on_tpu else self._cpu_sem)
+        tracer = self._trace_tracer(job_id, task)
+        wait_t0 = time.monotonic()
         sem.acquire()
         try:
-            self._run_task_inner(job_id, task, status, reporter)
+            if tracer is None:
+                self._run_task_inner(job_id, task, status, reporter)
+                return
+            self._run_task_traced(tracer, job_id, task, status, reporter,
+                                  time.monotonic() - wait_t0)
         finally:
             sem.release()  # ≈ addFreeSlots on done/kill (:3401-3402)
+
+    def _run_task_traced(self, tracer: Any, job_id: str, task: Task,
+                         status: TaskStatus, reporter: Reporter,
+                         slot_wait_s: float) -> None:
+        """Traced execution: a tracker-role ``task:launch`` span parented
+        to the master's scheduling span, and (in-process only — isolated
+        children open their own) a task-role ``task:run`` span installed
+        as the thread's ambient context so spill/merge/shuffle/TPU spans
+        nest under it."""
+        from tpumr.core import tracing
+        aid = str(task.attempt_id)
+        backend = ("tpu" if task.run_on_tpu else "cpu") if task.is_map \
+            else "cpu"
+        launch = tracer.start_span(
+            "task:launch", task.trace["trace_id"], parent=task.trace,
+            backend=backend, attempt_id=aid, tracker=self.name,
+            is_map=task.is_map, slot_wait_s=round(slot_wait_s, 6))
+        try:
+            isolated = False
+            try:
+                isolated = self._isolate_in_process(
+                    self._job_conf(job_id), task)
+            except Exception:  # noqa: BLE001 — inner settles the failure
+                pass
+            # re-parent downstream spans (isolated child's task:run, the
+            # master-facing chain stays schedule → launch → run)
+            task.trace = launch.context
+            if isolated:
+                self._run_task_inner(job_id, task, status, reporter)
+                return
+            run = tracer.start_span("task:run", launch.trace_id,
+                                    parent=launch, role="task",
+                                    backend=backend, attempt_id=aid)
+            try:
+                with tracing.activate(tracer, run):
+                    self._run_task_inner(job_id, task, status, reporter)
+            finally:
+                tracer.finish(run.set(state=status.state))
+        finally:
+            tracer.finish(launch.set(state=status.state))
+            tracer.flush()
 
     def _isolate_in_process(self, conf: JobConf, task: Task) -> bool:
         """Process isolation gate (≈ which tasks get a child JVM): opt-in
@@ -818,15 +996,19 @@ class NodeRunner:
         CommitTaskAction). Returns False when the grant went to another
         attempt — the caller must report this attempt KILLED, not SUCCEEDED
         (its output was discarded)."""
+        from tpumr.core import tracing
         committer = FileOutputCommitter(conf)
         aid = str(task.attempt_id)
         if not committer.needs_commit(aid):
             return True
-        if self.master.call("can_commit", str(task.task_id), aid):
-            committer.commit_task(aid)
-            return True
-        committer.abort_task(aid)
-        return False
+        with tracing.span("task:commit", attempt_id=aid) as s:
+            if self.master.call("can_commit", str(task.task_id), aid):
+                committer.commit_task(aid)
+                return True
+            if s is not None:
+                s.set(denied=True)
+            committer.abort_task(aid)
+            return False
 
     # ------------------------------------------------------------ profiles
     # ≈ TaskLog.LogName.PROFILE served by TaskLogServlet: per-attempt

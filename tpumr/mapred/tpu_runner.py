@@ -96,6 +96,36 @@ class HbmSplitCache:
 _split_caches: dict[str, HbmSplitCache] = {}
 _cache_lock = threading.Lock()
 
+#: (kernel, input signature) pairs this process has dispatched before —
+#: the trace's compile-cache attribute: a first dispatch ("cold") pays
+#: XLA compilation or a persistent-cache load (parallel/jaxruntime.py);
+#: later dispatches of the same signature hit the in-process jit cache
+_dispatched: set = set()
+_dispatched_lock = threading.Lock()
+
+
+def _dispatch_signature(kernel_name: str, batch: Any) -> tuple:
+    values = getattr(batch, "values", None)
+    shape = tuple(getattr(values, "shape", ()) or ())
+    dtype = str(getattr(values, "dtype", ""))
+    return (kernel_name, shape, dtype)
+
+
+def _compile_temperature(kernel_name: str, batch: Any) -> str:
+    """'cold' before this process's first SUCCESSFUL dispatch of
+    (kernel, signature) — XLA compiles or loads the persistent cache —
+    else 'warm'. Mark with :func:`_mark_dispatched` only after the
+    execution completes: a failed cold attempt's retry pays the compile
+    again and must not report warm."""
+    with _dispatched_lock:
+        return ("warm" if _dispatch_signature(kernel_name, batch)
+                in _dispatched else "cold")
+
+
+def _mark_dispatched(kernel_name: str, batch: Any) -> None:
+    with _dispatched_lock:
+        _dispatched.add(_dispatch_signature(kernel_name, batch))
+
 
 def split_cache(device: Any, capacity_bytes: int) -> HbmSplitCache:
     key = str(device)
@@ -137,6 +167,8 @@ class TpuMapRunner(MapRunnable):
         # a windowed prelaunch (prelaunch_device_maps) already staged,
         # dispatched, and fetched this task's kernel output as part of a
         # many-task batched transfer — only the drain remains
+        from tpumr.core import tracing
+
         pre = getattr(task_ctx, "_device_prefetch", None) if task_ctx else None
         if pre is not None:
             if pre.device_rows is not None:
@@ -151,9 +183,12 @@ class TpuMapRunner(MapRunnable):
                                   BackendCounter.TPU_DEVICE_BYTES_STAGED,
                                   pre.staged_bytes)
             t0 = time.time()
-            for key, value in kernel.map_batch_drain(pre.fetched, conf,
-                                                     task_ctx):
-                output.collect(key, value)
+            with tracing.span("tpu:window_drain", backend="tpu",
+                              records=pre.num_records,
+                              staged_bytes=pre.staged_bytes):
+                for key, value in kernel.map_batch_drain(pre.fetched, conf,
+                                                         task_ctx):
+                    output.collect(key, value)
             reporter.set_status(
                 f"kernel {name} (pipelined window): {pre.num_records} "
                 f"records, drained in {time.time() - t0:.3f}s")
@@ -163,8 +198,17 @@ class TpuMapRunner(MapRunnable):
         dev_id = getattr(task_ctx, "tpu_device_id", -1) if task_ctx else -1
         device = _select_device(dev_id)
 
-        batch, counted_by_reader, staged_bytes = stage_batch(
-            self.conf, reader, task_ctx, device)
+        with tracing.span("tpu:stage", backend="tpu",
+                          device=str(device)) as st:
+            batch, counted_by_reader, staged_bytes = stage_batch(
+                self.conf, reader, task_ctx, device)
+            if st is not None:
+                # staged_bytes == 0 means the split was already device-
+                # resident (HBM split cache / output chain) — the stage
+                # cost this span exists to surface was skipped entirely
+                st.set(staged_bytes=staged_bytes,
+                       hbm_cache="hit" if staged_bytes == 0 else "miss",
+                       records=getattr(batch, "num_records", 0))
         if not counted_by_reader:
             # the record-reader path already counts MAP_INPUT_RECORDS
             reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
@@ -176,20 +220,26 @@ class TpuMapRunner(MapRunnable):
 
         t0 = time.time()
         with jax.default_device(device):
-            state = (kernel.map_batch_launch(batch, conf, task_ctx)
-                     if type(kernel).supports_launch() else None)
-            if state is not None:
-                _offer_device_rows(kernel, state, conf)
-                # coalesce this task's device→host transfer with any
-                # concurrently-fetching TPU-slot threads: one tunnel
-                # roundtrip can carry many tasks' outputs
-                from tpumr.mapred.fetch_batcher import shared_batcher
-                fetched = shared_batcher().fetch(state)
-                records = kernel.map_batch_drain(fetched, conf, task_ctx)
-            else:
-                records = kernel.map_batch(batch, conf, task_ctx)
-            for key, value in records:
-                output.collect(key, value)
+            with tracing.span("tpu:execute", backend="tpu",
+                              kernel=name, device=str(device)) as ex:
+                if ex is not None:
+                    ex.set(compile=_compile_temperature(name, batch))
+                state = (kernel.map_batch_launch(batch, conf, task_ctx)
+                         if type(kernel).supports_launch() else None)
+                if state is not None:
+                    _offer_device_rows(kernel, state, conf)
+                    # coalesce this task's device→host transfer with any
+                    # concurrently-fetching TPU-slot threads: one tunnel
+                    # roundtrip can carry many tasks' outputs
+                    from tpumr.mapred.fetch_batcher import shared_batcher
+                    fetched = shared_batcher().fetch(state)
+                    records = kernel.map_batch_drain(fetched, conf,
+                                                     task_ctx)
+                else:
+                    records = kernel.map_batch(batch, conf, task_ctx)
+                for key, value in records:
+                    output.collect(key, value)
+                _mark_dispatched(name, batch)
         reporter.set_status(
             f"kernel {name} on {device}: "
             f"{getattr(batch, 'num_records', 0)} records in "

@@ -49,16 +49,28 @@ class MetricsSink(Protocol):
 
 
 class FileSink:
-    """JSON-lines metrics log ≈ metrics2/sink/FileSink.java."""
+    """JSON-lines metrics log ≈ metrics2/sink/FileSink.java.
+
+    Every record is stamped with the writing host and a per-sink
+    monotonic sequence number: daemons across a cluster append to
+    per-host files that later get concatenated for analysis, and
+    wall-clock ``ts`` alone cannot order records across hosts (clock
+    skew) or even within one host across a clock step — ``(host, seq)``
+    can, and a gap in ``seq`` is a dropped-record tell."""
 
     def __init__(self, path: str) -> None:
         self.path = path
         self._lock = threading.Lock()
+        self._seq = 0
+        import socket
+        self._host = socket.gethostname()
 
     def put_metrics(self, record: dict) -> None:
         with self._lock:
+            self._seq += 1
+            stamped = {**record, "host": self._host, "seq": self._seq}
             with open(self.path, "a") as f:
-                f.write(json.dumps(record) + "\n")
+                f.write(json.dumps(stamped) + "\n")
 
 
 class UdpSink:
