@@ -1,0 +1,282 @@
+"""Microbenchmark: shuffle merge engine vs the flat merge.
+
+Measures the reduce-side merge data plane in isolation (no cluster, no
+kernels — pure host path), on the wide-shuffle shape the critical-path
+tool shows dominating warm wall-clock:
+
+- ``merge_throughput``  — k-way merge records/sec over W sorted
+  segments: the seed's flat ``heapq.merge(..., key=lambda kv:
+  sort_key(kv[0]))`` vs the engine's raw-key fast path
+  (``ifile.merge_sorted``: itemgetter key, dedicated two-way loop).
+- ``two_way``           — the dominant map-side shape (two runs).
+- ``bounded_fanin``     — multi-pass merge at ``io.sort.factor`` over a
+  segment count far above the factor: the engine pays intermediate disk
+  passes to bound fan-in; the row records the cost so the bound is an
+  informed trade, not a hidden tax.
+- ``copier_engine`` / ``copier_flat`` — a ShuffleCopier run over W
+  in-memory map outputs with a RAM budget ≪ total bytes, background
+  in-memory merging ON vs OFF, measuring copy+merge-drain wall-clock
+  and how many segments fell to per-segment disk spills.
+
+Output contract (same shape as ``bench.py``): ONE JSON line on stdout
+  {"metric", "value", "unit", "vs_baseline"}
+with vs_baseline = engine merge throughput / flat merge throughput on
+the wide-shuffle merge. Every other row goes to stderr and to
+``bench_shuffle.json``. env BENCH_SCALE=small (or --smoke) shrinks the
+workload for CI smoke runs.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+def log(*a: object) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+SMALL = os.environ.get("BENCH_SCALE") == "small" or "--smoke" in sys.argv
+
+#: wide-shuffle shape: W map-output segments × R records each
+W = 8 if SMALL else 64
+R = 2_000 if SMALL else 30_000
+
+
+def make_segments(w: int, r: int) -> "list[list[tuple[bytes, bytes]]]":
+    """W sorted segments with interleaved (shared-prefix) keys — the
+    wordcount-like shape where equal-key tiebreaks actually fire."""
+    import random
+    rng = random.Random(0)
+    segs = []
+    for _ in range(w):
+        seg = sorted((b"k%08d" % rng.randrange(r * 4), b"v" * 10)
+                     for _ in range(r))
+        segs.append(seg)
+    return segs
+
+
+def drain(it) -> int:
+    n = 0
+    for _ in it:
+        n += 1
+    return n
+
+
+def timed(fn) -> "tuple[float, int]":
+    t0 = time.perf_counter()
+    n = fn()
+    return time.perf_counter() - t0, n
+
+
+def bench_merge_throughput(rows: dict) -> "tuple[float, float]":
+    from tpumr.io import ifile
+
+    segs = make_segments(W, R)
+    total = W * R
+
+    def flat() -> int:
+        # the seed path: one lazy heap merge over every segment, with a
+        # Python-level key-fn call (plus closure frame) per comparison
+        sk = lambda k: k  # noqa: E731 — the RawComparator identity seam
+        return drain(heapq.merge(*segs, key=lambda kv: sk(kv[0])))
+
+    def engine() -> int:
+        # the background merger's kernel: budget-bounded batches are
+        # fully resident, so Timsort galloping merges the runs at C speed
+        return drain(ifile.merge_sorted_inmem(segs, lambda k: k))
+
+    def engine_lazy() -> int:
+        # the engine's lazy path (final merges): raw-key itemgetter key
+        return drain(ifile.merge_sorted(segs, lambda k: k))
+
+    # alternate and keep the best of 3: same allocator state for both
+    t_flat = min(timed(flat)[0] for _ in range(3))
+    t_eng = min(timed(engine)[0] for _ in range(3))
+    t_lazy = min(timed(engine_lazy)[0] for _ in range(3))
+    r_flat, r_eng = total / t_flat, total / t_eng
+    rows["merge_segments"] = W
+    rows["merge_records"] = total
+    rows["merge_flat_rec_per_s"] = round(r_flat)
+    rows["merge_engine_rec_per_s"] = round(r_eng)
+    rows["merge_engine_lazy_rec_per_s"] = round(total / t_lazy)
+    rows["merge_engine_speedup"] = round(r_eng / r_flat, 3)
+    log(f"[merge] {W}-way x {R} records: flat {r_flat / 1e6:.2f}M rec/s, "
+        f"engine in-mem {r_eng / 1e6:.2f}M rec/s "
+        f"({r_eng / r_flat:.2f}x), engine lazy "
+        f"{total / t_lazy / 1e6:.2f}M rec/s")
+
+    segs2 = make_segments(2, total // 2)
+    t2_flat = min(timed(lambda: drain(
+        heapq.merge(*segs2, key=lambda kv: kv[0])))[0] for _ in range(3))
+    t2_eng = min(timed(lambda: drain(
+        ifile.merge_sorted(segs2, lambda k: k)))[0] for _ in range(3))
+    rows["two_way_flat_rec_per_s"] = round(total / t2_flat)
+    rows["two_way_engine_rec_per_s"] = round(total / t2_eng)
+    log(f"[two-way] {total} records: flat {total / t2_flat / 1e6:.2f}M "
+        f"rec/s, engine {total / t2_eng / 1e6:.2f}M rec/s -> "
+        f"{t2_flat / t2_eng:.2f}x")
+    return r_eng, r_flat
+
+
+def bench_bounded_fanin(rows: dict) -> None:
+    from tpumr.io import merger as merge_engine
+
+    factor = 10
+    segs = make_segments(W, R // 2)
+    total = W * (R // 2)
+    run_dir = tempfile.mkdtemp(prefix="bench-shuffle-merge-")
+    try:
+        bm = merge_engine.BoundedMerge(segs, None, factor,
+                                       run_dir=run_dir)
+        t, n = timed(lambda: drain(bm))
+        assert n == total, f"bounded merge lost records: {n} != {total}"
+        rows["fanin_factor"] = factor
+        rows["fanin_passes"] = bm.passes
+        rows["fanin_max_fan_in"] = bm.max_fan_in
+        rows["fanin_rec_per_s"] = round(total / t)
+        bm.close()
+        log(f"[fan-in] {W} runs at factor {factor}: {bm.passes} passes, "
+            f"max fan-in {bm.max_fan_in}, {total / t / 1e6:.2f}M rec/s "
+            f"(the bounded-memory trade)")
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
+class _SpillSource:
+    """ChunkFetch over in-memory spill files (the test double of the
+    tracker's get_map_output_chunk), with a small per-chunk hold
+    emulating tracker RPC latency — the window the background merger
+    exists to overlap."""
+
+    chunk_bytes = 64 * 1024
+
+    def __init__(self, spills, latency_s: float = 0.0005) -> None:
+        self.spills = spills
+        self.latency_s = latency_s
+
+    def __call__(self, map_index: int, partition: int, offset: int) -> dict:
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        data, index = self.spills[map_index]
+        off, raw_len, part_len = index["partitions"][partition]
+        payload = data[off + 4: off + part_len]
+        return {"data": payload[offset: offset + self.chunk_bytes],
+                "total": len(payload), "raw": raw_len,
+                "codec": index.get("codec", "none")}
+
+
+def bench_copier(rows: dict) -> "tuple[float, float]":
+    """The wide-shuffle microbench proper: copy + merge-drain wall-clock
+    with the engine (background in-memory merges + bounded fan-in + raw
+    fast path) vs the flat seed path (no background merging, one
+    heapq.merge with a key-fn over every segment)."""
+    import io as _io
+
+    from tpumr.io import ifile, merger as merge_engine
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.shuffle_copier import ShuffleCopier
+
+    w = 12 if SMALL else max(40, W // 2)
+    r = R // 2
+    spills = []
+    for m in range(w):
+        buf = _io.BytesIO()
+        wtr = ifile.Writer(buf, codec="none")
+        wtr.start_partition()
+        for kb, vb in sorted((b"k%08d" % ((i * 37 + m) % (r * 4)),
+                              b"v" * 10) for i in range(r)):
+            wtr.append_raw(kb, vb)
+        wtr.end_partition()
+        spills.append((buf.getvalue(), wtr.close()))
+    total = w * r
+    seg_bytes = spills[0][1]["partitions"][0][1]
+    # budget ~6 segments (one segment is < the 25% max_single cap, so
+    # segments CAN land in memory) against w ≫ 6 total: without the
+    # background merger most of the shuffle falls to per-segment disk
+    # spills once the budget fills
+    ram_mb = seg_bytes * 6.2 / (0.70 * 1024 * 1024)
+
+    def run(enabled: bool) -> "tuple[float, float, ShuffleCopier]":
+        from tpumr.mapred.api import RawComparator
+        conf = JobConf()
+        conf.set_output_key_comparator_class(RawComparator)
+        conf.set("tpumr.shuffle.ram.mb", ram_mb)
+        conf.set("tpumr.shuffle.merge.enabled", enabled)
+        spill_dir = tempfile.mkdtemp(prefix="bench-shuffle-copy-")
+        copier = ShuffleCopier(conf, _SpillSource(spills), w, 0, spill_dir)
+        t0 = time.perf_counter()
+        segs = copier.copy_all()
+        t_copy = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        if enabled:
+            bm = merge_engine.BoundedMerge(segs, None, 10,
+                                           run_dir=spill_dir)
+            n = drain(bm)
+        else:
+            sk = lambda k: k  # noqa: E731 — the seed's flat merge
+            n = drain(heapq.merge(*segs, key=lambda kv: sk(kv[0])))
+        t_merge = time.perf_counter() - t0
+        assert n == total, f"copier merge lost records: {n} != {total}"
+        if enabled:
+            bm.close()
+        for s in segs:
+            s.close()
+        shutil.rmtree(spill_dir, ignore_errors=True)
+        return t_copy, t_merge, copier
+
+    t_copy_e, t_merge_e, c_eng = min((run(True) for _ in range(2)),
+                                     key=lambda p: p[0] + p[1])
+    t_copy_f, t_merge_f, c_flat = min((run(False) for _ in range(2)),
+                                      key=lambda p: p[0] + p[1])
+    t_eng, t_flat = t_copy_e + t_merge_e, t_copy_f + t_merge_f
+    rows["copier_maps"] = w
+    rows["copier_engine_copy_s"] = round(t_copy_e, 4)
+    rows["copier_engine_merge_s"] = round(t_merge_e, 4)
+    rows["copier_flat_copy_s"] = round(t_copy_f, 4)
+    rows["copier_flat_merge_s"] = round(t_merge_f, 4)
+    rows["copier_engine_s"] = round(t_eng, 4)
+    rows["copier_flat_s"] = round(t_flat, 4)
+    rows["copier_engine_speedup"] = round(t_flat / t_eng, 3)
+    rows["copier_merge_phase_speedup"] = round(t_merge_f / t_merge_e, 3)
+    rows["copier_engine_inmem_merges"] = c_eng.inmem_merges
+    rows["copier_engine_segments_disk"] = c_eng.spilled_to_disk
+    rows["copier_flat_segments_disk"] = c_flat.spilled_to_disk
+    log(f"[copier] {w} maps, budget ~6 segments: engine copy "
+        f"{t_copy_e:.3f}s + merge {t_merge_e:.3f}s "
+        f"({c_eng.inmem_merges} in-mem merges, "
+        f"{c_eng.spilled_to_disk} disk segments) vs flat copy "
+        f"{t_copy_f:.3f}s + merge {t_merge_f:.3f}s "
+        f"({c_flat.spilled_to_disk} disk segments) -> end-to-end "
+        f"{t_flat / t_eng:.2f}x, merge_reduce phase "
+        f"{t_merge_f / t_merge_e:.2f}x")
+    return t_eng, t_flat
+
+
+def main() -> None:
+    rows: dict = {}
+    r_eng, r_flat = bench_merge_throughput(rows)
+    bench_bounded_fanin(rows)
+    bench_copier(rows)
+    with open("bench_shuffle.json", "w") as f:
+        json.dump(rows, f, sort_keys=True, indent=1)
+    log(f"detail rows -> bench_shuffle.json: "
+        f"{json.dumps(rows, sort_keys=True)}")
+    print(json.dumps({
+        "metric": f"wide-shuffle merge throughput, {W} segments x {R} "
+                  f"records: merge engine (in-memory Timsort-galloping "
+                  f"merge, the background merger's kernel) vs the flat "
+                  f"key-fn heap merge over all segments",
+        "value": round(r_eng),
+        "unit": "records/sec",
+        "vs_baseline": round(r_eng / r_flat, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

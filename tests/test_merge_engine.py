@@ -1,0 +1,453 @@
+"""The shuffle merge engine: background in-memory merges under the RAM
+budget (≈ ReduceTask's InMemFSMergeThread), bounded-fan-in multi-pass
+merging honoring io.sort.factor (≈ Merger pass selection), the raw-key
+merge fast paths, and the streaming combiner-at-merge."""
+
+import heapq
+import io
+import os
+import random
+
+import pytest
+
+from tpumr.io import ifile
+from tpumr.io import merger as merge_engine
+from tpumr.mapred.api import (DeserializingComparator, RawComparator,
+                              Reporter)
+from tpumr.mapred.jobconf import JobConf
+from tpumr.core.counters import TaskCounter
+from tpumr.io.writable import deserialize, serialize
+from tpumr.mapred.shuffle_copier import (DiskSegment, MemorySegment,
+                                         ShuffleCopier)
+
+
+def rand_segments(n_segs, n_recs, seed=0, dup_keys=True):
+    """Sorted segments with heavy key overlap and per-segment-unique
+    values, so equal-key tiebreak order is observable."""
+    rng = random.Random(seed)
+    space = max(4, n_recs // 2) if dup_keys else n_recs * 100
+    return [sorted((b"k%06d" % rng.randrange(space),
+                    b"s%d-%04d" % (s, i))
+                   for i in range(n_recs))
+            for s in range(n_segs)]
+
+
+def flat_merge(segs):
+    """The seed's flat path: one heap merge with a key-fn closure."""
+    sk = lambda k: k  # noqa: E731
+    return list(heapq.merge(*segs, key=lambda kv: sk(kv[0])))
+
+
+def make_spill(records, codec="none"):
+    buf = io.BytesIO()
+    w = ifile.Writer(buf, codec=codec)
+    w.start_partition()
+    for k, v in records:
+        w.append_raw(k, v)
+    w.end_partition()
+    return buf.getvalue(), w.close()
+
+
+class SpillChunkSource:
+    """ChunkFetch over in-memory spill files."""
+
+    chunk_bytes = 1 << 20
+
+    def __init__(self, spills):
+        self.spills = spills
+
+    def __call__(self, map_index, partition, offset):
+        data, index = self.spills[map_index]
+        off, raw_len, part_len = index["partitions"][partition]
+        payload = data[off + 4: off + part_len]
+        return {"data": payload[offset: offset + self.chunk_bytes],
+                "total": len(payload), "raw": raw_len,
+                "codec": index.get("codec", "none")}
+
+
+# ---------------------------------------------------------------- fast path
+
+
+class TestRawFastPath:
+    def test_identity_detection(self):
+        assert ifile.is_raw_sort_key(None)
+        assert ifile.is_raw_sort_key(lambda k: k)
+        assert ifile.is_raw_sort_key(RawComparator().sort_key)
+        # the deserializing comparator re-types keys: NOT raw
+        assert not ifile.is_raw_sort_key(DeserializingComparator().sort_key)
+        assert not ifile.is_raw_sort_key(lambda k: k[::-1])
+
+    def test_two_way_merge_byte_identical(self):
+        a, b = rand_segments(2, 500, seed=1)
+        assert list(ifile.merge_sorted([a, b], lambda k: k)) == \
+            flat_merge([a, b])
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 8])
+    def test_kway_byte_identical_with_dup_keys(self, n):
+        segs = rand_segments(n, 300, seed=n)
+        assert list(ifile.merge_sorted(segs, lambda k: k)) == \
+            flat_merge(segs)
+
+    def test_empty_and_uneven_segments(self):
+        segs = [[], [(b"a", b"1")], [], [(b"a", b"2"), (b"b", b"3")]]
+        assert list(ifile.merge_sorted(segs, None)) == flat_merge(segs)
+        assert list(ifile.merge_sorted([], None)) == []
+
+    def test_inmem_merge_byte_identical(self):
+        segs = rand_segments(6, 400, seed=3)
+        assert ifile.merge_sorted_inmem(segs, lambda k: k) == \
+            flat_merge(segs)
+
+    def test_non_identity_sort_key_respected(self):
+        # reversed-bytes order: the fast path must NOT kick in
+        segs = [sorted(((b"ab", b"1"), (b"zx", b"2")),
+                       key=lambda kv: kv[0][::-1]),
+                sorted(((b"ba", b"3"), (b"xz", b"4")),
+                       key=lambda kv: kv[0][::-1])]
+        got = [k for k, _ in ifile.merge_sorted(segs, lambda k: k[::-1])]
+        assert got == sorted(got, key=lambda k: k[::-1])
+        got2 = ifile.merge_sorted_inmem(segs, lambda k: k[::-1])
+        assert [k for k, _ in got2] == got
+
+
+# ------------------------------------------------------------ bounded merge
+
+
+class CloseTracking(list):
+    closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestBoundedMerge:
+    FACTOR = 4
+
+    @pytest.mark.parametrize("n_segs", [1, 3, 4, 5, 12])
+    def test_multipass_byte_identical_to_flat(self, n_segs, tmp_path):
+        """Around the io.sort.factor boundaries (1, factor, factor+1,
+        3x factor) the multi-pass output must be byte-identical to the
+        flat merge — the contiguous-window pass selection preserves the
+        segment-order tiebreak."""
+        segs = rand_segments(n_segs, 200, seed=n_segs)
+        bm = merge_engine.BoundedMerge(
+            [list(s) for s in segs], lambda k: k, self.FACTOR,
+            run_dir=str(tmp_path))
+        got = list(bm)
+        assert got == flat_merge(segs)
+        assert bm.max_fan_in <= max(2, self.FACTOR)
+        assert (bm.passes > 0) == (n_segs > self.FACTOR)
+        bm.close()
+        assert os.listdir(tmp_path) == []   # intermediate runs deleted
+
+    def test_fan_in_never_exceeds_factor_wide(self, tmp_path):
+        segs = rand_segments(33, 40, seed=7)
+        bm = merge_engine.BoundedMerge(segs, None, 5,
+                                       run_dir=str(tmp_path))
+        assert list(bm) == flat_merge(segs)
+        assert bm.max_fan_in <= 5 and bm.passes >= 7
+        bm.close()
+
+    def test_pass_counters_and_input_close(self, tmp_path):
+        reporter = Reporter()
+        segs = [CloseTracking(s) for s in rand_segments(9, 50)]
+        bm = merge_engine.BoundedMerge(segs, None, 3,
+                                       run_dir=str(tmp_path),
+                                       reporter=reporter)
+        list(bm)
+        assert reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.MERGE_PASSES) == bm.passes > 0
+        assert reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.MERGE_PASS_SEGMENTS) > 0
+        # every pass-consumed input was closed promptly
+        assert sum(1 for s in segs if s.closed) >= bm.passes
+        bm.close()
+
+    def test_streaming_run_decodes_as_ifile_segment(self, tmp_path):
+        """write_run_streaming's padded-count patch must still decode
+        as a standard single-partition IFile segment — including across
+        its internal block-flush boundary."""
+        recs = rand_segments(1, 5000, seed=11)[0]   # > one join block? no,
+        run = merge_engine.write_run_streaming(iter(recs), str(tmp_path))
+        assert list(run) == recs
+        assert run.records == len(recs)
+        # readable through the generic ifile partition reader too
+        index = {"codec": "none",
+                 "partitions": [(4, run.raw_length, run.length + 4)]}
+        with open(run.path, "rb") as f:
+            assert list(ifile.read_partition(f, index, 0)) == recs
+        run.close()
+
+    def test_padded_vint_roundtrip(self):
+        from tpumr.io.writable import read_vint
+        for n in (0, 1, 127, 128, 100000, 2**34 - 1):
+            buf = io.BytesIO(merge_engine._padded_vint(n))
+            assert read_vint(buf) == n
+        with pytest.raises(ValueError):
+            merge_engine._padded_vint(2**35)
+
+    def test_write_run_format_matches_writer(self, tmp_path):
+        """write_run's direct framing must stay byte-identical to
+        ifile.Writer's single-partition output."""
+        recs = rand_segments(1, 100, seed=9)[0]
+        run = merge_engine.write_run(iter(recs), str(tmp_path),
+                                     codec="zlib")
+        assert list(run) == recs
+        assert run.records == len(recs)
+        data, index = make_spill(recs, codec="zlib")
+        with open(run.path, "rb") as f:
+            assert f.read() == data
+        run.close()
+        assert not os.path.exists(run.path)
+
+
+# ------------------------------------------------------- background merges
+
+
+def conf_for_copier(ram_mb, merge_enabled=True, combiner=None):
+    conf = JobConf()
+    conf.set_output_key_comparator_class(RawComparator)
+    conf.set("tpumr.shuffle.ram.mb", ram_mb)
+    conf.set("tpumr.shuffle.merge.enabled", merge_enabled)
+    if combiner is not None:
+        conf.set_combiner_class(combiner)
+    return conf
+
+
+class TestBackgroundMerge:
+    def _spills(self, n_maps=30, n_recs=400):
+        return [make_spill(rand_segments(1, n_recs, seed=m)[0])
+                for m in range(n_maps)], n_maps * n_recs
+
+    def test_wide_shuffle_merges_in_memory_and_releases_budget(
+            self, tmp_path):
+        """The acceptance shape: ≥30 maps, budget ≪ total bytes — at
+        least one background merge runs, budget is observably released
+        mid-copy (more segments land in memory than fit at once), and
+        the merged stream equals the flat merge's content."""
+        spills, total = self._spills(30)
+        seg_raw = spills[0][1]["partitions"][0][1]
+        budget_segs = 6
+        ram_mb = seg_raw * (budget_segs + 0.2) / (0.70 * 1024 * 1024)
+        reporter = Reporter()
+        copier = ShuffleCopier(conf_for_copier(ram_mb),
+                               SpillChunkSource(spills), 30, 0,
+                               str(tmp_path), reporter)
+        segs = copier.copy_all()
+        assert copier.merger is not None
+        assert copier.inmem_merges >= 1
+        mem_placed = reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.REDUCE_SHUFFLE_SEGMENTS_MEM)
+        # more in-memory placements than the budget can hold at once ⇒
+        # reservations were released mid-copy and fetchers kept landing
+        assert mem_placed > budget_segs
+        assert reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.SHUFFLE_INMEM_MERGES) == copier.inmem_merges
+        # returned streams: pre-merged runs + live segments, all sorted
+        assert any(isinstance(s, merge_engine.DiskRun) for s in segs)
+        bm = merge_engine.BoundedMerge(segs, None, 10,
+                                       run_dir=str(tmp_path))
+        got = list(bm)
+        # content check against the ground truth (order of equal-key
+        # values may differ from the flat path across merge batches —
+        # same multiset, keys non-decreasing)
+        expect = sorted(kv for data, idx in spills
+                        for kv in self._read_spill(data, idx))
+        assert sorted(got) == expect
+        keys = [k for k, _ in got]
+        assert keys == sorted(keys)
+        assert len(got) == total
+        bm.close()
+        for s in segs:
+            s.close()
+        assert copier.ram.used == 0
+
+    @staticmethod
+    def _read_spill(data, index):
+        off, raw_len, part_len = index["partitions"][0]
+        return list(ifile.iter_chunked_segment(
+            [data[off + 4: off + part_len]], index.get("codec", "none")))
+
+    def test_disk_segments_drop_vs_merge_disabled(self, tmp_path):
+        """The counter the ISSUE gates on: with the engine on, fewer
+        segments fall to per-segment disk spills than the seed path."""
+        spills, _ = self._spills(24)
+        seg_raw = spills[0][1]["partitions"][0][1]
+        ram_mb = seg_raw * 6.2 / (0.70 * 1024 * 1024)
+
+        def run(enabled, sub):
+            d = tmp_path / sub
+            d.mkdir()
+            reporter = Reporter()
+            copier = ShuffleCopier(conf_for_copier(ram_mb, enabled),
+                                   SpillChunkSource(spills), 24, 0,
+                                   str(d), reporter)
+            segs = copier.copy_all()
+            disk = reporter.counters.value(
+                TaskCounter.FRAMEWORK_GROUP,
+                TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK)
+            for s in segs:
+                s.close()
+            return disk, copier
+
+        disk_on, c_on = run(True, "on")
+        disk_off, c_off = run(False, "off")
+        assert c_off.merger is None and c_on.merger is not None
+        assert disk_off > 0
+        assert disk_on < disk_off
+        assert c_on.inmem_merges >= 1
+
+    def test_merge_error_fails_fast_not_per_fetch_timeout(self, tmp_path):
+        """A combiner blowing up inside a background merge must kill the
+        copy phase promptly: busy_or_pending flips false (fetchers stop
+        burning the reserve-wait timeout), offers are refused, and
+        finish() surfaces the stored error."""
+
+        class BoomCombiner:
+            def reduce(self, key, values, output, reporter):
+                raise RuntimeError("boom at merge time")
+
+            def close(self):
+                pass
+
+        spills = [make_spill(sorted(((serialize(f"k{i:03d}"), serialize(1))
+                                     for i in range(60)),
+                                    key=lambda kv: deserialize(kv[0])))
+                  for _ in range(16)]
+        seg_raw = spills[0][1]["partitions"][0][1]
+        ram_mb = seg_raw * 6.2 / (0.70 * 1024 * 1024)
+        conf = conf_for_copier(ram_mb, combiner=BoomCombiner)
+        conf.set_class("mapred.output.key.comparator.class",
+                       DeserializingComparator)
+        copier = ShuffleCopier(conf, SpillChunkSource(spills), 16, 0,
+                               str(tmp_path))
+        import time
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="boom at merge time"):
+            copier.copy_all()
+        # 16 fetches each burning the 2s reserve-wait would take >> this
+        assert time.monotonic() - t0 < 10
+        assert not copier.merger.busy_or_pending()
+        assert copier.ram.used == 0
+
+    def test_combiner_runs_at_shuffle_merge_time(self, tmp_path):
+        """Combiner correctness when it runs inside the background
+        merge: aggregates are partial (per batch) but the grand totals
+        must be exact, and combine counters must tick."""
+        from tpumr.examples.basic import LongSumReducer
+        n_maps, keys = 16, [f"w{i:02d}" for i in range(5)]
+        spills = []
+        for m in range(n_maps):
+            recs = sorted(((serialize(k), serialize(1))
+                           for k in keys for _ in range(3)),
+                          key=lambda kv: deserialize(kv[0]))
+            spills.append(make_spill(recs))
+        seg_raw = spills[0][1]["partitions"][0][1]
+        ram_mb = seg_raw * 6.2 / (0.70 * 1024 * 1024)
+        conf = conf_for_copier(ram_mb, combiner=LongSumReducer)
+        # combining groups on the job comparator, not raw bytes
+        conf.set_class("mapred.output.key.comparator.class",
+                       DeserializingComparator)
+        reporter = Reporter()
+        copier = ShuffleCopier(conf, SpillChunkSource(spills), n_maps, 0,
+                               str(tmp_path), reporter)
+        segs = copier.copy_all()
+        assert copier.inmem_merges >= 1
+        totals: dict = {}
+        for s in segs:
+            for kb, vb in s:
+                k = deserialize(kb)
+                totals[k] = totals.get(k, 0) + deserialize(vb)
+        assert totals == {k: n_maps * 3 for k in keys}
+        assert reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.COMBINE_INPUT_RECORDS) > 0
+        for s in segs:
+            s.close()
+
+
+# ------------------------------------------------------- mid-batch spills
+
+
+class TestCollectRawBatchSpill:
+    def test_spills_at_threshold_crossings_mid_batch(self, tmp_path):
+        from tpumr.mapred.map_task import MapOutputBuffer
+        conf = JobConf()
+        conf.set("io.sort.mb", 1)
+        conf.set("io.sort.spill.percent", 0.01)   # ~10 KB spill threshold
+        reporter = Reporter()
+        buf = MapOutputBuffer(conf, 1, str(tmp_path), reporter)
+        n = 2000                               # ~50 KB >> threshold
+        kbs = [serialize(f"k{i:05d}") for i in range(n)]
+        vbs = [serialize(i) for i in range(n)]
+        buf.collect_raw_batch([0] * n, kbs, vbs)
+        # spilled MID-batch, repeatedly — never held the whole batch
+        assert len(buf._spills) >= 3
+        assert buf._bytes < buf._threshold
+        path, index = buf.flush()
+        with open(path, "rb") as f:
+            got = list(ifile.read_partition(f, index, 0))
+        assert len(got) == n
+        assert [kb for kb, _ in got] == sorted(kbs,
+                                               key=lambda b: deserialize(b))
+
+
+# ------------------------------------------------------------ e2e cluster
+
+
+class TestEndToEnd:
+    def test_tiny_budget_job_output_identical_and_disk_drops(self):
+        """Mini-cluster wordcount with a RAM budget forcing the seed
+        path to spill: output bytes identical with the engine on vs
+        off, REDUCE_SHUFFLE_SEGMENTS_DISK strictly lower, and at least
+        one background merge recorded."""
+        from tpumr.fs import FileSystem, get_filesystem
+        from tpumr.mapred.job_client import JobClient
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+
+        def run(enabled):
+            base = JobConf()
+            base.set("tpumr.shuffle.ram.mb", 0.35)
+            base.set("tpumr.shuffle.merge.enabled", enabled)
+            with MiniMRCluster(num_trackers=2, conf=base) as c:
+                fs = get_filesystem("mem:///")
+                fs.write_bytes("/me/in.txt",
+                               b"".join(b"w%03d x\n" % (i % 97)
+                                        for i in range(30000)))
+                conf = c.create_job_conf()
+                conf.set_input_paths("mem:///me/in.txt")
+                conf.set_output_path(f"mem:///me/out-{enabled}")
+                conf.set("mapred.mapper.class",
+                         "tpumr.mapred.lib.TokenCountMapper")
+                conf.set("mapred.reducer.class",
+                         "tpumr.examples.basic.LongSumReducer")
+                conf.set_num_reduce_tasks(2)
+                conf.set("mapred.map.tasks", 8)
+                conf.set("mapred.min.split.size", 1)
+                result = JobClient(conf).run_job(conf)
+                assert result.successful
+                out = b"".join(
+                    fs.read_bytes(st.path)
+                    for st in sorted(fs.list_status(f"/me/out-{enabled}"),
+                                     key=lambda s: str(s.path))
+                    if "part-" in str(st.path))
+            FileSystem.clear_cache()
+            return out, result.counters
+
+        out_on, counters_on = run(True)
+        out_off, counters_off = run(False)
+        assert out_on == out_off            # byte-identical job output
+        disk_on = counters_on.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK)
+        disk_off = counters_off.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK)
+        assert counters_on.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.SHUFFLE_INMEM_MERGES) >= 1
+        assert disk_on < disk_off

@@ -33,6 +33,15 @@ class TaskCounter:
     #: and fetch-failure reports to the master — shuffle fault tolerance)
     REDUCE_FETCH_FAILURES = "REDUCE_FETCH_FAILURES"
     SPILLED_RECORDS = "SPILLED_RECORDS"
+    #: shuffle merge engine: background in-memory merges that freed
+    #: ShuffleRamManager budget mid-copy (≈ InMemFSMergeThread), and the
+    #: segments they consumed
+    SHUFFLE_INMEM_MERGES = "SHUFFLE_INMEM_MERGES"
+    SHUFFLE_INMEM_MERGE_SEGMENTS = "SHUFFLE_INMEM_MERGE_SEGMENTS"
+    #: bounded-fan-in merging (≈ Merger intermediate passes honoring
+    #: io.sort.factor): intermediate passes run and segments they merged
+    MERGE_PASSES = "MERGE_PASSES"
+    MERGE_PASS_SEGMENTS = "MERGE_PASS_SEGMENTS"
     FRAMEWORK_GROUP = "tpumr.TaskCounter"
 
 

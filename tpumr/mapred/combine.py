@@ -1,0 +1,89 @@
+"""Streaming combiner-at-merge — one key group resident at a time.
+
+≈ the reference's ``Task.CombinerRunner`` used inside ``sortAndSpill``
+and ``mergeParts`` (MapTask.java:1396,1621) and at shuffle-merge time
+(ReduceTask's InMemFSMergeThread). The seed materialized whole
+partitions (``self._combine(list(merged))``) before combining — on a
+wide merge that is the entire partition in Python lists. This helper
+groups the already-sorted stream run-at-a-time instead: memory is
+bounded by the largest single key group, never the partition.
+
+Combiner lifecycle keeps Hadoop semantics (instantiated per use, closed
+deterministically) and tolerates subprocess-backed combiners
+(streaming.StreamCombiner) that emit output only when the child
+finishes: records buffered by the collector are yielded as they appear,
+and anything the combiner flushes at ``close()`` is drained afterward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator
+
+from tpumr.core.counters import TaskCounter
+from tpumr.io.writable import deserialize, serialize
+
+
+def combined_stream(conf: Any, combiner_cls: type,
+                    sort_key: "Callable[[bytes], Any] | None",
+                    stream: Iterable[tuple[bytes, bytes]],
+                    reporter: Any) -> Iterator[tuple[bytes, bytes]]:
+    """Run ``combiner_cls`` over a SORTED raw (kbytes, vbytes) stream,
+    yielding combined raw records group by group. ``sort_key`` is the
+    grouping comparator seam (None = group on raw key bytes, the
+    RawComparator case)."""
+    from tpumr.mapred.api import OutputCollector
+    from tpumr.utils.reflection import new_instance
+
+    out: "list[tuple[bytes, bytes]]" = []
+    collector = OutputCollector(
+        lambda k, v: out.append((serialize(k), serialize(v))))
+    combiner = new_instance(combiner_cls, conf)
+    n_in = 0
+    n_out = 0
+    closed = False
+    it = iter(stream)
+    try:
+        try:
+            kb, vb = next(it)
+        except StopIteration:
+            kb = None  # type: ignore[assignment]
+        while kb is not None:
+            group: "list[bytes]" = [vb]
+            group_sk = sort_key(kb) if sort_key is not None else kb
+            first_kb = kb
+            try:
+                while True:
+                    nkb, nvb = next(it)
+                    if (sort_key(nkb) if sort_key is not None
+                            else nkb) != group_sk:
+                        break
+                    group.append(nvb)
+            except StopIteration:
+                nkb = None  # type: ignore[assignment]
+                nvb = b""
+            n_in += len(group)
+            key = deserialize(first_kb)
+            # the group is already materialized, so a combiner that
+            # stops early needs no drain — unconsumed values just drop
+            values = (deserialize(v) for v in group)
+            combiner.reduce(key, values, collector, reporter)
+            if out:
+                n_out += len(out)
+                yield from out
+                out.clear()
+            kb, vb = nkb, nvb
+        closed = True
+        combiner.close()
+        # subprocess combiners flush on close — drain the tail
+        if out:
+            n_out += len(out)
+            yield from out
+            out.clear()
+    finally:
+        if not closed:
+            combiner.close()
+        if reporter is not None:
+            reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                  TaskCounter.COMBINE_INPUT_RECORDS, n_in)
+            reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
+                                  TaskCounter.COMBINE_OUTPUT_RECORDS, n_out)

@@ -45,6 +45,11 @@ DEFAULTS: dict[str, Any] = {
     "mapred.reduce.slowstart.completed.maps": 0.05,
     "mapred.speculative.execution": True,
     "mapred.job.shuffle.input.buffer.percent": 0.70,
+    # background in-memory shuffle merge (≈ InMemFSMergeThread): merge
+    # accumulated memory segments into one sorted disk run once they
+    # cross this fraction of the ShuffleRamManager budget
+    "mapred.job.shuffle.merge.percent": 0.66,
+    "tpumr.shuffle.merge.enabled": True,
     "tpumr.shuffle.parallel.copies": 5,
 }
 

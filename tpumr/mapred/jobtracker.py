@@ -179,6 +179,23 @@ class JobMaster:
             "fetch_failure_penalty_box",
             _locked(lambda: sum(j.fetch_failure_pending_count()
                                 for j in self.jobs.values())))
+        # shuffle merge engine, cluster-wide: background in-memory merges
+        # and bounded-fan-in passes summed from every job's aggregated
+        # framework counters (same names the task pages show per attempt)
+        from tpumr.core.counters import TaskCounter
+
+        def _merge_engine_totals() -> dict:
+            out: dict[str, int] = {}
+            for name in ("SHUFFLE_INMEM_MERGES",
+                         "SHUFFLE_INMEM_MERGE_SEGMENTS",
+                         "MERGE_PASSES", "MERGE_PASS_SEGMENTS"):
+                out[name.lower()] = sum(
+                    j.counters.value(TaskCounter.FRAMEWORK_GROUP, name)
+                    for j in self.jobs.values())
+            return out
+
+        self._mreg.set_gauge("shuffle_merge",
+                             _locked(_merge_engine_totals))
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)

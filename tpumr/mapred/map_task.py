@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Callable, Iterator
+from typing import Any, Callable, Iterable, Iterator
 
 from tpumr.core.counters import BackendCounter, Counters, TaskCounter
 from tpumr.io import ifile
-from tpumr.io.writable import deserialize, serialize
+from tpumr.io.writable import serialize
 from tpumr.mapred.api import OutputCollector, Reporter
 from tpumr.mapred.split import InputSplit
 from tpumr.mapred.task import Task, TaskPhase
@@ -74,7 +74,10 @@ class MapOutputBuffer:
     def collect_raw_batch(self, parts: "list[int]", kbs: "list[bytes]",
                           vbs: "list[bytes]") -> None:
         """Batched ingest for the TPU runner (whole kernel output at once).
-        Same accounting and validation as the scalar :meth:`collect` path."""
+        Same accounting and validation as the scalar :meth:`collect` path —
+        including the spill threshold, checked at every crossing MID-batch:
+        a kernel batch larger than ``io.sort.mb`` must spill as it lands,
+        not overshoot the buffer by the whole batch."""
         nbytes = 0
         for p, kb, vb in zip(parts, kbs, vbs):
             if not 0 <= p < self.n_parts:
@@ -82,12 +85,12 @@ class MapOutputBuffer:
             self._buf.append((p, kb, vb))
             nbytes += len(kb) + len(vb)
             self._bytes += len(kb) + len(vb) + 16
+            if self._bytes >= self._threshold:
+                self.sort_and_spill()
         self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                                    TaskCounter.MAP_OUTPUT_RECORDS, len(kbs))
         self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
                                    TaskCounter.MAP_OUTPUT_BYTES, nbytes)
-        if self._bytes >= self._threshold:
-            self.sort_and_spill()
 
     # ------------------------------------------------------------ spill
 
@@ -110,13 +113,14 @@ class MapOutputBuffer:
             idx = 0
             for part in range(self.n_parts):
                 w.start_partition()
-                part_records: list[tuple[bytes, bytes]] = []
+                lo = idx
                 while idx < len(self._buf) and self._buf[idx][0] == part:
-                    part_records.append(self._buf[idx][1:])
                     idx += 1
+                records: "Iterator[tuple[bytes, bytes]]" = \
+                    (rec[1:] for rec in self._buf[lo:idx])
                 if self.combiner is not None:
-                    part_records = self._combine(part_records)
-                for kb, vb in part_records:
+                    records = self._combine(records)
+                for kb, vb in records:
                     w.append_raw(kb, vb)
                 w.end_partition()
             index = w.close()
@@ -126,34 +130,16 @@ class MapOutputBuffer:
         self._buf.clear()
         self._bytes = 0
 
-    def _combine(self, records: "list[tuple[bytes, bytes]]"
-                 ) -> "list[tuple[bytes, bytes]]":
-        """Run the combiner over one partition's sorted records
-        (≈ combiner invocation inside sortAndSpill)."""
-        out: list[tuple[bytes, bytes]] = []
-        collector = OutputCollector(
-            lambda k, v: out.append((serialize(k), serialize(v))))
-        combiner = new_instance(self.combiner_cls, self.conf)
-        i = 0
-        sk = self.comparator.sort_key
-        n_in = len(records)
-        try:
-            while i < n_in:
-                j = i
-                key_sk = sk(records[i][0])
-                while j < n_in and sk(records[j][0]) == key_sk:
-                    j += 1
-                key = deserialize(records[i][0])
-                values = (deserialize(records[t][1]) for t in range(i, j))
-                combiner.reduce(key, values, collector, self.reporter)
-                i = j
-        finally:
-            combiner.close()
-        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
-                                   TaskCounter.COMBINE_INPUT_RECORDS, n_in)
-        self.reporter.incr_counter(TaskCounter.FRAMEWORK_GROUP,
-                                   TaskCounter.COMBINE_OUTPUT_RECORDS, len(out))
-        return out
+    def _combine(self, records: "Iterable[tuple[bytes, bytes]]"
+                 ) -> "Iterator[tuple[bytes, bytes]]":
+        """Run the combiner over one partition's sorted record stream
+        (≈ combiner invocation inside sortAndSpill) — STREAMING, one key
+        group resident at a time (combine.combined_stream), never the
+        whole partition."""
+        from tpumr.mapred.combine import combined_stream
+        return combined_stream(self.conf, self.combiner_cls,
+                               self.comparator.sort_key, records,
+                               self.reporter)
 
     # ------------------------------------------------------------ finish
 
@@ -180,27 +166,39 @@ class MapOutputBuffer:
             return self._merge_spills(final_path)
 
     def _merge_spills(self, final_path: str) -> tuple[str, dict]:
-        """Final k-way merge of the spill files (≈ mergeParts)."""
+        """Final merge of the spill files (≈ mergeParts) with BOUNDED
+        fan-in: ``io.sort.factor`` caps open streams / heap entries per
+        pass (intermediate passes land in ``merge-tmp`` as IFile runs —
+        io.merger.BoundedMerge), spill partitions stream through
+        per-chunk file reads instead of one held-open fd per spill, and
+        the combiner runs group-at-a-time over the merged stream instead
+        of materializing the partition."""
+        from tpumr.io import merger as merge_engine
+        from tpumr.mapred.shuffle_copier import spill_region_segment
         sk = self.comparator.sort_key
-        streams = [open(p, "rb") for p, _ in self._spills]
-        try:
-            with open(final_path, "wb") as f:
-                w = ifile.Writer(f, codec=self.codec)
-                for part in range(self.n_parts):
-                    w.start_partition()
-                    segs = [ifile.read_partition(s, idx, part)
-                            for s, (_, idx) in zip(streams, self._spills)]
-                    merged: "Iterator[tuple[bytes, bytes]]" = \
-                        ifile.merge_sorted(segs, sk)
+        factor = self.conf.sort_factor
+        run_dir = os.path.join(self.local_dir, "merge-tmp")
+        with open(final_path, "wb") as f:
+            w = ifile.Writer(f, codec=self.codec)
+            for part in range(self.n_parts):
+                w.start_partition()
+                segs = [spill_region_segment(p, idx, part)
+                        for p, idx in self._spills]
+                bm = merge_engine.BoundedMerge(
+                    segs, sk, factor, run_dir=run_dir,
+                    reporter=self.reporter, prefix=f"spill-p{part}")
+                try:
+                    merged: "Iterator[tuple[bytes, bytes]]" = iter(bm)
                     if self.combiner is not None:
-                        merged = iter(self._combine(list(merged)))
+                        merged = self._combine(merged)
                     for kb, vb in merged:
                         w.append_raw(kb, vb)
-                    w.end_partition()
-                index = w.close()
-        finally:
-            for s in streams:
-                s.close()
+                finally:
+                    bm.close()
+                w.end_partition()
+            index = w.close()
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
         for p, _ in self._spills:
             os.remove(p)
         return final_path, index

@@ -17,7 +17,6 @@ import time
 from typing import Any, Callable, Iterable, Iterator
 
 from tpumr.core.counters import TaskCounter
-from tpumr.io import ifile
 from tpumr.io.writable import deserialize
 from tpumr.mapred.api import OutputCollector, Reporter
 from tpumr.mapred.output_formats import FileOutputCommitter
@@ -78,6 +77,7 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
                 if s is not None:
                     s.set(in_memory=copier.copied_in_memory,
                           on_disk=copier.spilled_to_disk,
+                          mem_merges=copier.inmem_merges,
                           fetch_failures=copier.fetch_failures)
             closeable = list(segments)
         elif not hasattr(fetch, "segments"):
@@ -104,8 +104,24 @@ def _run_reduce_phase(conf: Any, task: Task,
                       sk: Callable, gk: Callable,
                       reporter: Reporter) -> None:
     """Merge → group → reduce → commit, over already-copied segments."""
-    # sort phase: lazy k-way merge ≈ Merger.merge (ReduceTask.java:399-409)
-    merged = ifile.merge_sorted(segments, sk)
+    # sort phase: bounded-fan-in merge ≈ Merger.merge honoring
+    # io.sort.factor (ReduceTask.java:399-409): a wide shuffle runs
+    # intermediate passes (merge:pass spans, MERGE_PASSES counter) so
+    # open streams / heap entries never exceed the factor
+    from tpumr.io import merger as merge_engine
+    engine = merge_engine.BoundedMerge(
+        segments, sk, conf.get_int("io.sort.factor", 10),
+        run_dir=conf.get("tpumr.task.local.dir") or None,
+        reporter=reporter, prefix=f"reduce-p{task.partition}")
+    try:
+        _reduce_merged(conf, task, iter(engine), gk, reporter)
+    finally:
+        engine.close()
+
+
+def _reduce_merged(conf: Any, task: Task,
+                   merged: "Iterator[tuple[bytes, bytes]]",
+                   gk: Callable, reporter: Reporter) -> None:
 
     # reduce phase — work dir lands in conf BEFORE the reducer is
     # configured so lib.MultipleOutputs works from configure() onward

@@ -17,11 +17,19 @@ shuffle :1080) and the chunk-serving half of the MapOutputServlet
   are re-read incrementally at merge time (ifile.iter_chunked_segment),
   so reduce-side memory is bounded by budget + copies × chunk.
 
-Divergence from the reference, documented: the reference BLOCKS a fetcher
-waiting for budget because concurrent in-memory merge threads free it; here
-nothing frees budget mid-copy (segments are consumed by the merge after the
-copy phase), so a fetcher that cannot reserve now goes to disk immediately —
-same memory bound, no deadlock, one less moving part.
+The copy phase owns a BACKGROUND IN-MEMORY MERGER
+(:class:`ShuffleMergeManager` ≈ ReduceTask's InMemFSMergeThread): once the
+memory segments accumulated by fetchers cross
+``mapred.job.shuffle.merge.percent`` of the ShuffleRamManager budget, the
+merger thread k-way merges them (running the job's combiner when one is
+configured) into ONE sorted on-disk run and releases their reservations —
+so fetchers keep landing in memory mid-copy instead of degrading to one
+disk file per segment once the budget fills. A budget-starved fetcher
+waits BOUNDED for an in-flight merge to free reservations
+(``tpumr.shuffle.merge.reserve.wait.ms``) and only then falls back to a
+per-segment disk spill — the reference blocks unboundedly here; the bound
+keeps the no-deadlock property of the earlier design. ``copy_all()``
+returns live memory segments plus a handful of pre-merged sorted runs.
 
 Lost-map-output recovery (the "too many fetch failures" protocol,
 ≈ ReduceTask's fetch-failure notification up the umbilical): when the
@@ -44,7 +52,7 @@ import random
 import tempfile
 import threading
 import time
-from typing import Any, Callable, Iterator
+from typing import Any, Callable, Iterable, Iterator
 
 from tpumr.core.counters import TaskCounter
 from tpumr.io import ifile
@@ -67,6 +75,7 @@ class ShuffleRamManager:
         self.max_single = int(self.budget * max_single_frac)
         self._used = 0
         self._lock = threading.Lock()
+        self._freed = threading.Condition(self._lock)
 
     @property
     def used(self) -> int:
@@ -74,7 +83,8 @@ class ShuffleRamManager:
 
     def try_reserve(self, nbytes: int) -> bool:
         """Claim budget for one segment, or refuse (caller spills to
-        disk). Never blocks — see the module docstring divergence note."""
+        disk, or waits via :meth:`reserve_wait` when a background merge
+        may free budget). Never blocks."""
         if nbytes > self.max_single:
             return False
         with self._lock:
@@ -83,9 +93,33 @@ class ShuffleRamManager:
             self._used += nbytes
             return True
 
+    def reserve_wait(self, nbytes: int, keep_waiting: "Callable[[], bool]",
+                     timeout_s: float) -> bool:
+        """Bounded-blocking reserve: wait for budget while
+        ``keep_waiting()`` reports a concurrent merge may still free
+        some, up to ``timeout_s``. The reference blocks a fetcher here
+        UNBOUNDEDLY (its merge thread always frees budget eventually);
+        the bound keeps this runtime deadlock-free even if the merger
+        stalls — the caller just falls back to a disk spill."""
+        if nbytes > self.max_single:
+            return False
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._freed:
+            while True:
+                if self._used + nbytes <= self.budget:
+                    self._used += nbytes
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not keep_waiting():
+                    return False
+                # short waits: keep_waiting() can flip false without a
+                # release ever being notified
+                self._freed.wait(min(remaining, 0.05))
+
     def release(self, nbytes: int) -> None:
-        with self._lock:
+        with self._freed:
             self._used = max(0, self._used - nbytes)
+            self._freed.notify_all()
 
 
 class Segment:
@@ -233,6 +267,208 @@ class PenaltyBox:
             return sum(1 for t in self._until.values() if t > now)
 
 
+class ShuffleMergeManager:
+    """Background in-memory merger thread (≈ ReduceTask's
+    InMemFSMergeThread): fetchers hand fully-copied
+    :class:`MemorySegment`\\ s over via :meth:`offer`; once their bytes
+    cross ``mapred.job.shuffle.merge.percent`` of the RAM budget (or a
+    budget-starved fetcher calls :meth:`request_merge`), the merger
+    k-way merges them — running the job's combiner when configured —
+    into ONE sorted on-disk run (``ifile`` format via
+    ``io.merger.write_run``) and closes the inputs, releasing their
+    reservations mid-copy. Batches merge in map-index order so the
+    merged run's equal-key tiebreak is deterministic."""
+
+    def __init__(self, conf: Any, ram: ShuffleRamManager, spill_dir: str,
+                 reporter: Any, trace_ctx: Any) -> None:
+        self.conf = conf
+        self.ram = ram
+        self.spill_dir = spill_dir
+        self.reporter = reporter
+        self._trace_ctx = trace_ctx
+        pct = conf.get_float("mapred.job.shuffle.merge.percent", 0.66)
+        self.threshold = max(1, int(ram.budget * pct))
+        get_cmp = getattr(conf, "get_output_key_comparator", None)
+        self._sort_key = (get_cmp().sort_key if get_cmp is not None
+                          else None)
+        get_comb = getattr(conf, "get_combiner_class", None)
+        self.combiner_cls = get_comb() if get_comb is not None else None
+        self.codec = getattr(conf, "compress_map_output", "none")
+        self._cond = threading.Condition()
+        self._pending: "list[tuple[int, MemorySegment]]" = []
+        self._pending_bytes = 0
+        self._merged_ids: "set[int]" = set()
+        self._runs: "list[Any]" = []
+        self._requested = False
+        self._busy = False
+        self._closed = False
+        self._error: "Exception | None" = None
+        self._thread: "threading.Thread | None" = None
+        self.inmem_merges = 0
+        self.inmem_merge_segments = 0
+
+    # ------------------------------------------------------- fetcher side
+
+    def offer(self, map_index: int, seg: MemorySegment) -> bool:
+        """Take ownership of a fully-fetched memory segment. Returns
+        False (caller keeps ownership) after close/abort or once a merge
+        error killed the merger — nothing would ever merge it."""
+        with self._cond:
+            if self._closed or self._error is not None:
+                return False
+            self._pending.append((map_index, seg))
+            self._pending_bytes += seg.raw_length
+            if self._pending_bytes >= self.threshold \
+                    and len(self._pending) >= 2:
+                self._requested = True
+                self._cond.notify_all()
+            self._ensure_thread()
+            return True
+
+    def request_merge(self) -> None:
+        """A budget-starved fetcher asks for whatever has accumulated
+        to be merged out of memory now, below the watermark."""
+        with self._cond:
+            if self._closed or self._error is not None \
+                    or len(self._pending) < 2:
+                return
+            self._requested = True
+            self._ensure_thread()
+            self._cond.notify_all()
+
+    def busy_or_pending(self) -> bool:
+        """Is budget plausibly about to be freed? (the fetcher's
+        keep-waiting predicate for ``ShuffleRamManager.reserve_wait``).
+        A stored merge error means the merger thread is DEAD — budget is
+        never coming, so fetchers must fall through to disk immediately
+        instead of burning the full reserve-wait timeout per fetch."""
+        with self._cond:
+            return self._error is None and (self._busy or self._requested)
+
+    # ------------------------------------------------------- merger side
+
+    def _ensure_thread(self) -> None:
+        # lazily started (under self._cond) so a copier that never
+        # copies doesn't leak an idle thread
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="shuffle-inmem-merger",
+                                            daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        from tpumr.core import tracing
+        with tracing.activate_captured(self._trace_ctx):
+            while True:
+                with self._cond:
+                    while not self._closed and not self._requested:
+                        self._cond.wait(0.1)
+                    if self._requested and len(self._pending) >= 2:
+                        # map-index order: deterministic equal-key
+                        # tiebreak no matter the fetch completion order
+                        batch = [s for _, s in sorted(self._pending,
+                                                      key=lambda p: p[0])]
+                        self._pending = []
+                        self._pending_bytes = 0
+                        self._requested = False
+                        self._busy = True
+                    elif self._closed:
+                        return
+                    else:
+                        self._requested = False
+                        continue
+                try:
+                    self._merge_batch(batch)
+                except Exception as e:  # noqa: BLE001 — surfaced at finish
+                    for seg in batch:
+                        seg.close()   # release reservations regardless
+                    with self._cond:
+                        self._error = e
+                        self._busy = False
+                        self._merged_ids.update(id(s) for s in batch)
+                        self._cond.notify_all()
+                    return
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _merge_batch(self, batch: "list[MemorySegment]") -> None:
+        from tpumr.core import tracing
+        from tpumr.io import merger as merge_engine
+        raw_bytes = sum(s.raw_length for s in batch)
+        with tracing.span("shuffle:mem_merge", segments=len(batch),
+                          raw_bytes=raw_bytes) as sp:
+            # batches are budget-bounded and fully resident, so the
+            # materialized Timsort-galloping merge applies (~2× the
+            # lazy heap merge, byte-identical order)
+            merged: "Iterable[tuple[bytes, bytes]]" = \
+                ifile.merge_sorted_inmem(batch, self._sort_key)
+            if self.combiner_cls is not None:
+                from tpumr.mapred.combine import combined_stream
+                merged = combined_stream(self.conf, self.combiner_cls,
+                                         self._sort_key, merged,
+                                         self.reporter)
+            run = merge_engine.write_run(merged, self.spill_dir,
+                                         codec=self.codec,
+                                         prefix="inmem-merge")
+            if sp is not None:
+                sp.set(run_bytes=run.length, records=run.records)
+        for seg in batch:
+            seg.close()   # HERE the budget frees — mid-copy, not at end
+        with self._cond:
+            self._runs.append(run)
+            self._merged_ids.update(id(s) for s in batch)
+            self.inmem_merges += 1
+            self.inmem_merge_segments += len(batch)
+        if self.reporter is not None:
+            self.reporter.incr_counter(
+                TaskCounter.FRAMEWORK_GROUP,
+                TaskCounter.SHUFFLE_INMEM_MERGES, 1)
+            self.reporter.incr_counter(
+                TaskCounter.FRAMEWORK_GROUP,
+                TaskCounter.SHUFFLE_INMEM_MERGE_SEGMENTS, len(batch))
+
+    # ---------------------------------------------------------- lifecycle
+
+    def finish(self) -> "list[Any]":
+        """Stop the merger (honoring one outstanding requested merge)
+        and return the merged runs. Raises a merge error if one was
+        stored — the copy phase must not return half-merged state."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join()
+        if self._error is not None:
+            raise self._error
+        return list(self._runs)
+
+    @property
+    def merged_ids(self) -> "set[int]":
+        with self._cond:
+            return set(self._merged_ids)
+
+    def abort(self) -> None:
+        """Failure-path teardown: close pending segments (releasing
+        budget) and delete merged runs."""
+        with self._cond:
+            self._closed = True
+            self._requested = False
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30)
+        with self._cond:
+            pending, self._pending = self._pending, []
+            self._pending_bytes = 0
+            runs, self._runs = self._runs, []
+        for _, seg in pending:
+            seg.close()
+        for run in runs:
+            run.close()
+
+
 class ShuffleCopier:
     """Run the copy phase: ``copy_all()`` returns every map's segment for
     this reduce's partition, fetched by a pool of copier threads."""
@@ -288,6 +524,17 @@ class ShuffleCopier:
         # run span (core/tracing.py; None when tracing is off)
         from tpumr.core import tracing
         self._trace_ctx = tracing.capture()
+        #: background in-memory merger (≈ InMemFSMergeThread); None when
+        #: disabled or pointless (no budget, single map)
+        self.merger: "ShuffleMergeManager | None" = None
+        if (conf.get_boolean("tpumr.shuffle.merge.enabled", True)
+                and self.ram.budget > 0 and num_maps >= 2):
+            self.merger = ShuffleMergeManager(conf, self.ram, spill_dir,
+                                              reporter, self._trace_ctx)
+        #: how long a budget-starved fetcher waits for an in-flight
+        #: background merge to free reservations before spilling to disk
+        self.reserve_wait_s = conf.get_float(
+            "tpumr.shuffle.merge.reserve.wait.ms", 2000.0) / 1000.0
 
     # ------------------------------------------------------------ one map
 
@@ -312,7 +559,15 @@ class ShuffleCopier:
         parts = [first["data"]]
         got = len(first["data"])
 
-        if self.ram.try_reserve(raw):
+        reserved = self.ram.try_reserve(raw)
+        if not reserved and self.merger is not None:
+            # budget full: ask the merger to fold the accumulated memory
+            # segments into a disk run, and wait (bounded) for the freed
+            # reservations instead of degrading straight to a disk spill
+            self.merger.request_merge()
+            reserved = self.ram.reserve_wait(
+                raw, self.merger.busy_or_pending, self.reserve_wait_s)
+        if reserved:
             # in-memory: pull remaining chunks, decompress into the budget
             try:
                 while got < total:
@@ -512,6 +767,11 @@ class ShuffleCopier:
                     work.put((time.monotonic(), m))
                     continue
                 self._note_success(m)
+                if self.merger is not None and isinstance(seg,
+                                                          MemorySegment):
+                    # the merger owns it now; results[m] keeps a handle
+                    # for the error-path sweep (double close is safe)
+                    self.merger.offer(m, seg)
                 with lock:
                     results[m] = seg
                     outstanding[0] -= 1
@@ -537,13 +797,32 @@ class ShuffleCopier:
             t.join()
         aborted = self.reporter is not None and self.reporter.aborted()
         if errors or aborted:
+            if self.merger is not None:
+                self.merger.abort()
             for seg in results:
                 if seg is not None:
                     seg.close()
             if errors:
                 raise errors[0]
             self.reporter.raise_if_aborted()
-        return [seg for seg in results if seg is not None]
+        out: "list[Segment]" = [seg for seg in results if seg is not None]
+        if self.merger is not None:
+            try:
+                runs = self.merger.finish()
+            except Exception:
+                for seg in out:
+                    seg.close()
+                raise
+            merged = self.merger.merged_ids
+            # pre-merged sorted runs first, then live segments in map
+            # order — every stream is sorted; the final merge interleaves
+            out = list(runs) + [s for s in out if id(s) not in merged]
+        return out
+
+    @property
+    def inmem_merges(self) -> int:
+        """Background in-memory merges performed this copy phase."""
+        return 0 if self.merger is None else self.merger.inmem_merges
 
 
 class RemoteChunkSource:

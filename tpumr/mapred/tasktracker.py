@@ -305,6 +305,12 @@ class NodeRunner:
         self._mreg.set_gauge("slots", lambda: {
             "cpu": self.max_cpu_map_slots, "tpu": self.max_tpu_map_slots,
             "reduce": self.max_reduce_slots})
+        #: shuffle merge-engine totals across this tracker's finished
+        #: attempts (uniform /metrics surface for the in-memory merges,
+        #: bounded-fan-in passes, and segment placement)
+        self._merge_totals: dict[str, int] = {}
+        self._mreg.set_gauge("shuffle_merge",
+                             lambda: dict(self._merge_totals))
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)
@@ -420,6 +426,17 @@ class NodeRunner:
                 else:
                     parts.append("<p class='dim'>not currently running "
                                  "on this tracker</p>")
+                if st is not None and st.counters:
+                    # shuffle merge-engine placement for this attempt:
+                    # in-memory merges, bounded passes, segment homes
+                    from tpumr.core.counters import TaskCounter
+                    fw = st.counters.get(TaskCounter.FRAMEWORK_GROUP) or {}
+                    rows = [[html_escape(k.lower()), int(fw[k])]
+                            for k in self._MERGE_COUNTER_KEYS if k in fw]
+                    if rows:
+                        parts.append("<h2>Shuffle / merge</h2>"
+                                     + html_table(["counter", "value"],
+                                                  rows))
                 from tpumr.mapred.profiler import profile_top_lines
                 try:
                     text = self.get_profile(aid)
@@ -970,6 +987,7 @@ class NodeRunner:
                 status.phase = TaskPhase.REDUCE
                 committed = self._commit(conf, task)
             status.counters = reporter.counters.to_dict()
+            self._note_merge_counters(status.counters)
             status.progress = 1.0
             status.finish_time = time.time()
             with self.lock:
@@ -990,6 +1008,27 @@ class NodeRunner:
                 traceback.format_exc(limit=8)
             status.finish_time = time.time()
             status.state = TaskState.FAILED
+
+    #: framework counters rolled up into the /metrics shuffle_merge gauge
+    _MERGE_COUNTER_KEYS = ("SHUFFLE_INMEM_MERGES",
+                           "SHUFFLE_INMEM_MERGE_SEGMENTS",
+                           "MERGE_PASSES", "MERGE_PASS_SEGMENTS",
+                           "REDUCE_SHUFFLE_SEGMENTS_MEM",
+                           "REDUCE_SHUFFLE_SEGMENTS_DISK")
+
+    def _note_merge_counters(self, counters: "dict | None") -> None:
+        """Fold one finished attempt's merge-engine counters into the
+        tracker-wide totals behind the ``shuffle_merge`` metrics gauge."""
+        if not counters:
+            return
+        from tpumr.core.counters import TaskCounter
+        group = counters.get(TaskCounter.FRAMEWORK_GROUP) or {}
+        with self.lock:   # RLock — safe from the umbilical path too
+            for key in self._MERGE_COUNTER_KEYS:
+                v = int(group.get(key, 0))
+                if v:
+                    k = key.lower()
+                    self._merge_totals[k] = self._merge_totals.get(k, 0) + v
 
     def _commit(self, conf: JobConf, task: Task) -> bool:
         """Output promotion gated by the master (≈ COMMIT_PENDING →
@@ -1140,6 +1179,7 @@ class NodeRunner:
             st = self.running.get(attempt_id)
             if st is not None and st.state not in TaskState.TERMINAL:
                 st.counters = final.get("counters", {})
+                self._note_merge_counters(st.counters)
                 st.progress = float(final.get("progress", 1.0))
                 st.phase = final.get("phase", st.phase)
                 st.diagnostics = final.get("diagnostics", "")
