@@ -72,6 +72,11 @@ class JobCounter:
     FAILED_MAP_TASKS = "FAILED_MAP_TASKS"
     FAILED_REDUCE_TASKS = "FAILED_REDUCE_TASKS"
     SPECULATIVE_MAPS = "SPECULATIVE_MAPS"
+    #: accelerator fault tolerance: TIPs pinned CPU-only after repeated
+    #: device/compile-classed TPU failures, and attempts the tracker
+    #: reaper failed for progress silence (failure_class=timeout)
+    TPU_DEMOTIONS = "TPU_DEMOTIONS"
+    TASKS_REAPED_TIMEOUT = "TASKS_REAPED_TIMEOUT"
     GROUP = "tpumr.JobCounter"
 
 

@@ -25,8 +25,30 @@ from tpumr.ops.registry import KernelMapper, register_kernel
 class SleepMapper(Mapper):
     def configure(self, conf) -> None:
         self._ms = conf.get_int("tpumr.sleep.map.ms", 100)
+        # hang mode (the reaper's manual test dummy): map index
+        # tpumr.sleep.hang.map stops reporting progress mid-map — forever
+        # — on its first tpumr.sleep.hang.attempts attempts, so the
+        # tracker's mapred.task.timeout reaper must fail it and the
+        # re-run (a later attempt) completes the job
+        self._hang_map = conf.get_int("tpumr.sleep.hang.map", -1)
+        self._hang_attempts = conf.get_int("tpumr.sleep.hang.attempts", 1)
+        self._partition = conf.get_int("tpumr.task.partition", -1)
+        aid = conf.get("tpumr.task.attempt.id", "")
+        try:
+            from tpumr.mapred.ids import TaskAttemptID
+            self._attempt_no = TaskAttemptID.parse(aid).attempt
+        except (ValueError, IndexError):
+            self._attempt_no = 0
 
     def map(self, key, value, output, reporter):
+        if (self._partition == self._hang_map
+                and self._attempt_no < self._hang_attempts):
+            # silent forever: no progress, no status, no counters — but
+            # keep polling the kill flag so an in-process reap can
+            # actually free the thread (isolated children get SIGKILL)
+            while True:
+                reporter.raise_if_aborted()
+                time.sleep(0.05)
         # sleep in slices polling the kill flag — the model for how any
         # long single-record mapper stays preemptible (record-loop mappers
         # get the poll for free in the framework's reader)
@@ -68,6 +90,13 @@ def sleep(argv: list[str]) -> int:
     ap.add_argument("--reduce-ms", type=int, default=100)
     ap.add_argument("--tpu", action="store_true",
                     help="register the device kernel (hybrid-scheduler probe)")
+    ap.add_argument("--hang-map", type=int, default=-1, metavar="IDX",
+                    help="map IDX stops reporting progress mid-map "
+                         "(reaper probe: mapred.task.timeout must fail "
+                         "it; the retry completes)")
+    ap.add_argument("--hang-attempts", type=int, default=1,
+                    help="how many of the hang map's attempts hang "
+                         "(default 1: the re-run succeeds)")
     ap.add_argument("--work", default="mem:///tmp/sleep")
     args = ap.parse_args(argv)
     inp = f"{args.work.rstrip('/')}/in.txt"
@@ -81,6 +110,9 @@ def sleep(argv: list[str]) -> int:
     conf.set("mapred.line.input.format.linespermap", 1)
     conf.set("tpumr.sleep.map.ms", args.map_ms)
     conf.set("tpumr.sleep.reduce.ms", args.reduce_ms)
+    if args.hang_map >= 0:
+        conf.set("tpumr.sleep.hang.map", args.hang_map)
+        conf.set("tpumr.sleep.hang.attempts", args.hang_attempts)
     conf.set_mapper_class(SleepMapper)
     if args.tpu:
         conf.set_map_kernel("sleep")

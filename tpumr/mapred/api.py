@@ -42,11 +42,26 @@ class Reporter:
         self._on_progress = on_progress
         self._abort_check = abort_check
         self.status = ""
+        #: liveness ticks for the tracker's hung-task reaper: wait loops
+        #: that are legitimately idle-but-alive (a reduce blocked on a
+        #: not-yet-rerun map's location, a penalty-boxed fetcher) call
+        #: keepalive() so silence stays the hang signal, activity doesn't
+        #: have to mean record throughput (≈ Hadoop reduces calling
+        #: reporter.progress() every fetch-loop iteration)
+        self.ticks = 0
 
     def set_status(self, status: str) -> None:
         self.status = status
+        # a status line IS a progress report (the in-process reaper sees
+        # the string itself; an isolated child only ships ticks, so the
+        # bump is what carries set_status liveness over the umbilical)
+        self.ticks += 1
+
+    def keepalive(self) -> None:
+        self.ticks += 1   # GIL-atomic int bump; no lock on the wait path
 
     def progress(self, fraction: float | None = None) -> None:
+        self.ticks += 1
         if self._on_progress is not None and fraction is not None:
             self._on_progress(fraction)
 

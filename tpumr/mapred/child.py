@@ -75,6 +75,11 @@ class _Umbilical:
                 "progress": progress,
                 "counters": reporter.counters.to_dict(),
                 "status": reporter.status,
+                # liveness ticks for the tracker's reaper: the push
+                # itself is a timer and must NOT count as progress — a
+                # hung task keeps pushing identical payloads; only a
+                # CHANGING tick count proves the task thread moves
+                "ticks": reporter.ticks,
             })
         except Exception:  # noqa: BLE001
             pass
@@ -227,7 +232,10 @@ def run_child(task_file: str) -> int:
         if run_span is not None:
             run_span.set(error=diag.splitlines()[0])
         _trace_done("FAILED")
-        _report_fail(tracker, aid, "FAILED", diag)
+        # classification rides the umbilical so the master's demotion/
+        # quarantine plane sees isolated attempts like in-process ones
+        from tpumr.mapred.task import classify_exception
+        _report_fail(tracker, aid, "FAILED", diag, classify_exception(e))
         return 1
 
 
@@ -251,9 +259,10 @@ def _commit(conf: Any, task: Any, can_commit: Any) -> bool:
         return False
 
 
-def _report_fail(tracker: Any, aid: str, state: str, diag: str) -> None:
+def _report_fail(tracker: Any, aid: str, state: str, diag: str,
+                 failure_class: str = "") -> None:
     try:
-        tracker.call("umbilical_fail", aid, state, diag)
+        tracker.call("umbilical_fail", aid, state, diag, failure_class)
     except Exception:  # noqa: BLE001 — tracker reaps us by exit code
         pass
 

@@ -71,6 +71,10 @@ class TaskInProgress:
     attempts: dict[str, TaskStatus] = field(default_factory=dict)
     next_attempt: int = 0
     failures: int = 0
+    #: device/compile-classed failures of TPU attempts — the TPU→CPU
+    #: demotion ledger (counted separately from ``failures`` because a
+    #: demoted TIP keeps its normal attempt budget for the CPU re-runs)
+    tpu_failures: int = 0
     successful_attempt: str = ""
     report: TaskReport = None  # type: ignore[assignment]
 
@@ -188,6 +192,31 @@ class JobInProgress:
         #: map attempt -> distinct reduce attempts reporting its output
         #: unfetchable (the "too many fetch failures" ledger)
         self._fetch_failures: dict[str, set[str]] = {}
+        # --- accelerator fault tolerance (tentpole PR 4) ---
+        #: device/compile-classed failures a TIP may take before it is
+        #: pinned CPU-only (≈ "how many TPU retries does a sick kernel
+        #: placement get"); ≥1 — 0 would demote before any failure
+        self.tpu_attempt_retries = max(1, int(self.conf.get(
+            "tpumr.tpu.attempt.retries", 1)))
+        #: distinct device-failing TIPs before the whole JOB's TPU pass
+        #: is quarantined off
+        self.tpu_quarantine_tips = max(1, int(self.conf.get(
+            "tpumr.tpu.job.quarantine.tips", 3)))
+        #: job-level TPU quarantine flag: the scheduler's TPU pass and
+        #: the optional-scheduling starvation gate both honor it (the
+        #: gate MUST, or a quarantined job deadlocks with zero CPU
+        #: budget and an ineligible TPU pass)
+        self.tpu_disabled = False
+        #: map partitions pinned CPU-only after repeated device-classed
+        #: failures — the TPU obtain path skips them
+        self._cpu_only_maps: set[int] = set()
+        #: distinct TIPs that ever took a device-classed TPU failure
+        #: (the job-quarantine threshold counts TIPs, not attempts)
+        self._tpu_failed_tips: set[int] = set()
+        #: demotion/quarantine decisions made inside update_task_status,
+        #: drained by the master's heartbeat for metrics + history +
+        #: trace instants (the JIP has no tracer/history of its own)
+        self._accel_events: list[dict] = []
         #: per-assignment backend placement: (seconds-since-submit, 'T'|'c')
         #: appended at every map assignment — the raw series behind the
         #: hybrid scheduler's convergence curve, so ANY run's status or
@@ -229,6 +258,25 @@ class JobInProgress:
         return bool(self.conf.get("tpumr.map.kernel")
                     or self.conf.get("tpumr.pipes.tpu.executable"))
 
+    def tpu_eligible(self) -> bool:
+        """May the scheduler's TPU pass offer this job work? The kernel
+        gate plus the job-level accelerator quarantine."""
+        return self.has_kernel() and not self.tpu_disabled
+
+    def cpu_pinned_pending_count(self) -> int:
+        """Pending maps that can ONLY run on CPU (demoted TIPs) — the
+        optional-scheduling starvation gate must not zero the CPU budget
+        while any of these exist, or they can never be assigned."""
+        with self.lock:
+            return len(self._pending_maps & self._cpu_only_maps)
+
+    def drain_accel_events(self) -> "list[dict]":
+        """Demotion/quarantine decisions since the last drain (consumed
+        by the master heartbeat for metrics, history, and traces)."""
+        with self.lock:
+            out, self._accel_events = self._accel_events, []
+            return out
+
     def cpu_map_mean_time(self) -> float:
         """Mean CPU map runtime (0.0 when no data — matching the reference's
         'returns 0 until first completion' behavior that makes the scheduler
@@ -246,7 +294,11 @@ class JobInProgress:
 
     def acceleration_factor(self) -> float:
         """cpuMean / tpuMean (JobQueueTaskScheduler.java:175-178); 1.0 until
-        both backends have profile data."""
+        both backends have profile data — and again after a job-level TPU
+        quarantine (the unwound sums must not resurrect via in-flight
+        TPU completions trickling in post-quarantine)."""
+        if self.tpu_disabled:
+            return 1.0
         cpu, tpu = self.cpu_map_mean_time(), self.tpu_map_mean_time()
         if cpu > 0 and tpu > 0:
             return cpu / tpu
@@ -276,22 +328,28 @@ class JobInProgress:
         with self.lock:
             if self.state != JobState.RUNNING:
                 return None
+            if run_on_tpu and self.tpu_disabled:
+                return None  # job-level accelerator quarantine
+            # demoted TIPs never land on TPU again; the CPU pass sees all
+            eligible = (self._pending_maps - self._cpu_only_maps
+                        if run_on_tpu else self._pending_maps)
             if not self._pending_maps:
                 return self._obtain_speculative_map(host, run_on_tpu,
                                                     tpu_device_id)
+            if not eligible:
+                return None  # pending work exists but none TPU-eligible
             # tiers: node-local → rack-local → any (≈ obtainNewNodeLocal /
             # rack-local / NonLocal MapTask). The tracker reports its own
             # rack (resolved tracker-side); resolving here is the fallback
             # for local/direct callers only — it may exec the topology
             # script, which must not happen on the scheduling path.
-            local = self.host_cache.get(host, set()) & self._pending_maps
+            local = self.host_cache.get(host, set()) & eligible
             if not local:
                 if rack is None:
                     rack = self._rack_resolver(host)
                 if rack != self._default_rack:
-                    local = self.rack_cache.get(rack,
-                                                set()) & self._pending_maps
-            idx = min(local) if local else min(self._pending_maps)
+                    local = self.rack_cache.get(rack, set()) & eligible
+            idx = min(local) if local else min(eligible)
             self._pending_maps.discard(idx)
             tip = self.maps[idx]
             tip.state = "running"
@@ -314,9 +372,17 @@ class JobInProgress:
         assigned but some run much longer than the completed mean, issue a
         duplicate attempt; first completion wins (the loser is killed by
         the master). Caller holds self.lock."""
-        if not self.speculative or self.finished_maps == 0:
+        if not self.speculative:
             return None
-        done = self.finished_maps
+        if run_on_tpu and self.tpu_disabled:
+            return None
+        # denominator matches the sums: a TPU quarantine unwinds both
+        # finished_tpu_maps and _tpu_time_sum, so using finished_maps
+        # here would deflate the mean and over-speculate exactly when
+        # the job just lost its accelerator capacity
+        done = self.finished_cpu_maps + self.finished_tpu_maps
+        if done == 0:
+            return None
         mean = ((self._cpu_time_sum + self._tpu_time_sum) / done)
         factor = float(self.conf.get("mapred.speculative.lag.factor", 1.5))
         # minimum runtime before a task can be speculated — ≈ the
@@ -329,6 +395,8 @@ class JobInProgress:
                 continue
             if tip.next_attempt != 1:
                 continue  # already speculated (or restarted) — one dup max
+            if run_on_tpu and tip.partition in self._cpu_only_maps:
+                continue  # a demoted TIP's twin must not land on TPU
             elapsed = now - (tip.report.start_time or now)
             if elapsed <= max(floor, factor * mean):
                 continue
@@ -535,12 +603,19 @@ class JobInProgress:
             self.finished_maps += 1
             runtime = status.runtime
             if status.run_on_tpu:
-                self.finished_tpu_maps += 1
-                self._tpu_time_sum += runtime
-                if self._ewma_alpha:
-                    a = self._ewma_alpha
-                    self._tpu_ewma = (runtime if not self._tpu_ewma
-                                      else a * runtime + (1 - a) * self._tpu_ewma)
+                # post-quarantine TPU completions (in-flight attempts
+                # finishing after tpu_disabled) are excluded from BOTH
+                # backends' profiles: the unwound TPU sums must not
+                # resurrect, and folding TPU runtimes into the CPU mean
+                # would skew it just as badly
+                if not self.tpu_disabled:
+                    self.finished_tpu_maps += 1
+                    self._tpu_time_sum += runtime
+                    if self._ewma_alpha:
+                        a = self._ewma_alpha
+                        self._tpu_ewma = (
+                            runtime if not self._tpu_ewma
+                            else a * runtime + (1 - a) * self._tpu_ewma)
             else:
                 self.finished_cpu_maps += 1
                 self._cpu_time_sum += runtime
@@ -570,6 +645,10 @@ class JobInProgress:
             # do NOT count toward the attempt limit — only real failures do
             # (Hadoop excludes killed attempts the same way)
             tip.failures += 1
+            from tpumr.mapred.task import FailureClass
+            if (tip.is_map and status.run_on_tpu
+                    and status.failure_class in FailureClass.ACCELERATOR):
+                self._note_tpu_failure(tip, status)
         limit = self.max_map_attempts if tip.is_map else self.max_reduce_attempts
         if status.state == TaskState.FAILED and tip.failures >= limit:
             self.state = JobState.FAILED
@@ -591,6 +670,43 @@ class JobInProgress:
             self._pending_maps.add(tip.partition)
         else:
             self._pending_reduces.add(tip.partition)
+
+    def _note_tpu_failure(self, tip: TaskInProgress,
+                          status: TaskStatus) -> None:
+        """One device/compile-classed TPU failure: walk the TIP toward
+        CPU-only pinning and the job toward TPU quarantine. Caller holds
+        ``self.lock`` (via update_task_status)."""
+        from tpumr.core.counters import JobCounter
+        tip.tpu_failures += 1
+        self._tpu_failed_tips.add(tip.partition)
+        if (tip.partition not in self._cpu_only_maps
+                and tip.tpu_failures >= self.tpu_attempt_retries):
+            # ≈ the reference re-landing a deterministically-crashing
+            # kernel on the same backend until the job dies — instead
+            # the TIP's remaining attempts are pinned to the CPU pass
+            self._cpu_only_maps.add(tip.partition)
+            self.counters.incr(JobCounter.GROUP, JobCounter.TPU_DEMOTIONS)
+            self._accel_events.append({
+                "kind": "tip_demoted", "task_id": str(tip.task_id),
+                "attempt_id": str(status.attempt_id),
+                "failure_class": status.failure_class,
+                "tpu_failures": tip.tpu_failures})
+        if (not self.tpu_disabled
+                and len(self._tpu_failed_tips) >= self.tpu_quarantine_tips):
+            # enough DISTINCT tasks indicted the accelerator path: the
+            # fault is the job's kernel (or the fleet's devices), not
+            # one unlucky split — stop offering this job TPU work at all
+            self.tpu_disabled = True
+            # unwind the TPU profile sums so acceleration_factor → 1.0:
+            # a poisoned factor would keep the optional-scheduling gate
+            # starving the CPU pass, deadlocking the job it just demoted
+            self.finished_tpu_maps = 0
+            self._tpu_time_sum = 0.0
+            self._tpu_ewma = 0.0
+            self._accel_events.append({
+                "kind": "job_tpu_quarantined",
+                "failed_tips": len(self._tpu_failed_tips),
+                "attempt_id": str(status.attempt_id)})
 
     def _obsolete_map_output(self, tip: TaskInProgress, aid: str) -> str:
         """Withdraw a published map output: mark its completion event(s)
@@ -810,5 +926,10 @@ class JobInProgress:
                 # once, in the JOB_FINISHED history event
                 "placement_seq": "".join(
                     b for _, b in self.placement_series[-512:]),
+                # accelerator fault tolerance: demoted TIPs + the job-
+                # level quarantine flag (the /job page's "why did my TPU
+                # job go CPU" answer)
+                "tpu_disabled": self.tpu_disabled,
+                "tpu_demoted_tips": len(self._cpu_only_maps),
                 "error": self.error,
             }

@@ -51,6 +51,19 @@ DEFAULTS: dict[str, Any] = {
     "mapred.job.shuffle.merge.percent": 0.66,
     "tpumr.shuffle.merge.enabled": True,
     "tpumr.shuffle.parallel.copies": 5,
+    # --- accelerator fault tolerance ---
+    # device/compile-classed TPU failures a TIP may accumulate before it
+    # is pinned CPU-only (its remaining attempts never land on TPU)
+    "tpumr.tpu.attempt.retries": 1,
+    # distinct TIPs failing with device-classed errors before the JOB's
+    # TPU pass is disabled outright and its TPU profile sums unwound
+    "tpumr.tpu.job.quarantine.tips": 3,
+    # consecutive device-classed failures on one physical device before
+    # the tracker quarantines it (0 disables device quarantine); the
+    # probe re-admits it (trivial jnp op, capped exponential backoff)
+    "tpumr.tpu.device.quarantine.failures": 3,
+    "tpumr.tpu.device.probe.interval.ms": 10_000,
+    "tpumr.tpu.device.probe.max.interval.ms": 300_000,
 }
 
 

@@ -196,6 +196,18 @@ class JobMaster:
 
         self._mreg.set_gauge("shuffle_merge",
                              _locked(_merge_engine_totals))
+        # accelerator fault tolerance: cluster-wide demotion/quarantine
+        # visibility (the per-event counters are incremented inline in
+        # the heartbeat as the decisions arrive)
+        self._mreg.set_gauge(
+            "jobs_tpu_quarantined_now",
+            _locked(lambda: sum(1 for j in self.jobs.values()
+                                if j.tpu_disabled)))
+        self._mreg.set_gauge(
+            "tpu_devices_quarantined",
+            _locked(lambda: sum(
+                len(t.status.get("quarantined_tpu_devices", []) or [])
+                for t in self.trackers.values())))
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)
@@ -395,6 +407,13 @@ class JobMaster:
                 f"{snap.get('maps_reexecuted_fetch_failure', 0):.0f} maps "
                 f"re-executed · penalty box "
                 f"{snap.get('fetch_failure_penalty_box', 0)}</p>"
+                f"<p>accelerator fault tolerance: "
+                f"{snap.get('tpu_demotions', 0):.0f} TIP demotions · "
+                f"{snap.get('jobs_tpu_quarantined_now', 0)} jobs TPU-"
+                f"quarantined · {snap.get('tpu_devices_quarantined', 0)} "
+                f"devices quarantined · "
+                f"{snap.get('tasks_reaped_timeout', 0):.0f} tasks reaped "
+                f"(timeout)</p>"
                 f"<h2>Jobs</h2>"
                 + html_table(
                     ["job", "state", "maps", "reduces", "#maps",
@@ -482,6 +501,22 @@ class JobMaster:
             rows = []
             for t in trackers_info(q):
                 st = t["status"] or {}
+                quarantined = set(
+                    st.get("quarantined_tpu_devices", []) or [])
+                # ✖ = quarantined by the device-health monitor (the slot
+                # vanished from the advertised pool until a probe passes)
+                devices = "".join(
+                    "✖" if i in quarantined else "●" if free else "○"
+                    for i, free in enumerate(
+                        st.get("available_tpu_devices", [])))
+                state = ("<span class='bad'>blacklisted</span>"
+                         if t["blacklisted"] else
+                         "<span class='ok'>healthy</span>"
+                         if st.get("healthy", True) else
+                         "<span class='bad'>unhealthy</span>")
+                # the NodeHealthChecker's ERROR reason — previously
+                # invisible cluster-wide (satellite)
+                report = st.get("health_report", "")
                 rows.append([
                     t["name"],
                     st.get("host", "?"),
@@ -491,20 +526,15 @@ class JobMaster:
                     f"/{st.get('max_tpu_map_slots', 0)}",
                     f"{st.get('count_reduce_tasks', 0)}"
                     f"/{st.get('max_reduce_slots', 0)}",
-                    "".join("●" if free else "○"
-                            for free in st.get("available_tpu_devices",
-                                               [])),
+                    devices,
                     f"{max(0.0, _time.time() - t['last_seen']):.1f}s ago",
-                    RawHtml("<span class='bad'>blacklisted</span>"
-                            if t["blacklisted"] else
-                            "<span class='ok'>healthy</span>"
-                            if st.get("healthy", True) else
-                            "<span class='bad'>unhealthy</span>"),
+                    RawHtml(state + (f" — {html_escape(report)}"
+                                     if report else "")),
                 ])
             return "<h1>Trackers</h1>" + html_table(
                 ["tracker", "host", "cpu slots", "tpu slots",
-                 "reduce slots", "tpu devices (●=free)", "last heartbeat",
-                 "state"], rows)
+                 "reduce slots", "tpu devices (●=free ✖=quarantined)",
+                 "last heartbeat", "state / health report"], rows)
 
         srv.add_page("index", index_page)
         srv.add_page("job", job_page, parameterized=True)
@@ -896,10 +926,23 @@ class JobMaster:
         return sorted(out)
 
     def get_active_trackers(self) -> "list[str]":
-        """≈ `job -list-active-trackers` (ClusterStatus tracker names)."""
+        """≈ `job -list-active-trackers` (ClusterStatus tracker names).
+        Unhealthy-but-heartbeating trackers are annotated with their
+        NodeHealthChecker ERROR reason — the cause used to be visible
+        only on the node itself."""
+        out = []
         with self.lock:
-            return sorted(n for n, t in self.trackers.items()
-                          if not t.blacklisted)
+            for n in sorted(self.trackers):
+                t = self.trackers[n]
+                if t.blacklisted:
+                    continue
+                st = t.status or {}
+                if st.get("healthy", True):
+                    out.append(n)
+                else:
+                    reason = st.get("health_report", "") or "unhealthy"
+                    out.append(f"{n}\tUNHEALTHY: {reason}")
+        return out
 
     def get_blacklisted_trackers(self) -> "list[str]":
         """≈ `job -list-blacklisted-trackers`."""
@@ -1042,7 +1085,27 @@ class JobMaster:
     def can_commit(self, task_id: str, attempt_id: str) -> bool:
         """First asker wins (≈ the single CommitTaskAction per task). Grants
         are revoked when the granted attempt fails or its tracker is lost,
-        so re-runs can commit."""
+        so re-runs can commit. An attempt the master already settled
+        terminally is refused outright: a reaped zombie thread asking
+        AFTER its FAILED status was folded (and any prior grant revoked)
+        must not capture a fresh grant it would hold forever, denying
+        every re-run."""
+        from tpumr.mapred.ids import TaskAttemptID
+        jip = None
+        try:
+            job_id = str(TaskAttemptID.parse(attempt_id).task.job)
+        except (ValueError, IndexError):
+            pass   # unparseable id: no job to consult, legacy grant path
+        else:
+            with self.lock:
+                jip = self.jobs.get(job_id)
+        if jip is not None:
+            with jip.lock:
+                tip = jip._tip_of_attempt(attempt_id)
+                st = tip.attempts.get(attempt_id) if tip is not None \
+                    else None
+                if st is not None and st.state in TaskState.TERMINAL:
+                    return False
         with self.lock:
             granted = self._commit_grants.setdefault(task_id, attempt_id)
             return granted == attempt_id
@@ -1120,12 +1183,27 @@ class JobMaster:
                 if jip is not None:
                     before = jip.state
                     jip.update_task_status(ts, shuffle_addr)
+                    self._drain_accel_events(jip, job_id, name,
+                                             deferred_events)
                     aid = str(ts.attempt_id)
                     if ts.state in TaskState.TERMINAL \
                             and aid not in jip.history_logged:
                         # replayed heartbeats re-deliver terminal statuses;
                         # log each attempt's outcome exactly once
                         jip.history_logged.add(aid)
+                        if ts.state == TaskState.FAILED \
+                                and ts.failure_class == "timeout":
+                            # a tracker reaped this attempt for progress
+                            # silence (counted once per attempt — this
+                            # dedup block — because a lost response
+                            # replays the same terminal status); the
+                            # FAILED fold below also charges the tracker
+                            # a blacklist fault, like any task failure
+                            from tpumr.core.counters import JobCounter
+                            self._mreg.incr("tasks_reaped_timeout")
+                            jip.counters.incr(
+                                JobCounter.GROUP,
+                                JobCounter.TASKS_REAPED_TIMEOUT)
                         event = {TaskState.SUCCEEDED: "TASK_FINISHED",
                                  TaskState.KILLED: "TASK_KILLED"}.get(
                             ts.state, "TASK_FAILED")
@@ -1235,6 +1313,26 @@ class JobMaster:
             response_id += 1
             self._last_response[name] = (response_id, actions)
             return {"response_id": response_id, "actions": actions}
+
+    def _drain_accel_events(self, jip: JobInProgress, job_id: str,
+                            tracker: str, deferred_events: list) -> None:
+        """Demotion/quarantine decisions made inside update_task_status:
+        meter them, history-log them, and drop trace instants on the job
+        timeline (caller holds ``self.lock``; history I/O is deferred)."""
+        for ev in jip.drain_accel_events():
+            kind = ev.pop("kind")
+            ev["tracker"] = tracker
+            if kind == "tip_demoted":
+                self._mreg.incr("tpu_demotions")
+                deferred_events.append((job_id, "TIP_TPU_DEMOTED", ev))
+                instant = "tpu:demote_tip"
+            else:
+                self._mreg.incr("jobs_tpu_quarantined")
+                deferred_events.append((job_id, "JOB_TPU_QUARANTINED", ev))
+                instant = "tpu:job_quarantine"
+            if jip.trace_root is not None:
+                self.tracer.instant(instant, jip.trace_id,
+                                    parent=jip.trace_root, **ev)
 
     def _fetch_failure_locked(self, ff: dict, deferred_events: list,
                               deferred_final: list) -> None:

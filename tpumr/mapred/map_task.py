@@ -229,8 +229,11 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     """
     reporter = reporter or Reporter()
     conf = localize_task_conf(conf, task)
-    from tpumr.utils.fi import maybe_fail
+    from tpumr.utils.fi import fires, maybe_fail
     maybe_fail("map.task", conf)
+    if fires("task.hang", conf) or fires(f"task.hang.m{task.partition}",
+                                         conf):
+        _hang_silently(reporter)
     split = InputSplit.from_dict(task.split) if task.split else None
     if split is not None and getattr(split, "path", None):
         # the split's source path, for mappers that dispatch per input
@@ -311,6 +314,19 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     reporter.incr_counter(BackendCounter.GROUP, backend_ms,
                           int((time.time() - t0) * 1000))
     return out
+
+
+def _hang_silently(reporter: Reporter) -> None:
+    """The ``task.hang`` chaos behavior: stop reporting progress forever
+    — no counter ticks, no status, no progress — exactly the silent-
+    but-alive attempt ``mapred.task.timeout`` exists for. Polls ONLY the
+    kill flag: cooperative cancel is how an in-process reap frees the
+    thread (isolated children are SIGKILLed regardless, and the poll is
+    what keeps their umbilical kill-ping alive without counting as
+    progress)."""
+    while True:
+        reporter.raise_if_aborted()
+        time.sleep(0.05)
 
 
 def _declared_mapper_class(conf: Any, attr: str):

@@ -154,13 +154,16 @@ def run_task_in_process(runner: Any, job_id: str, task: Task,
 
     # settle: the child normally set a terminal state via umbilical_done/
     # umbilical_fail; if it vanished first (segfault, os._exit, SIGKILL),
-    # the attempt is decided here
+    # the attempt is decided here. A reaper-settled (timeout) attempt is
+    # already terminal — the early return keeps its failure_class.
+    from tpumr.mapred.task import FailureClass
     with runner.lock:
         if status.state in TaskState.TERMINAL:
             return
         status.finish_time = time.time()
         if mem_killed:
             status.state = TaskState.FAILED
+            status.failure_class = FailureClass.OOM
             status.diagnostics = (
                 f"killed by memory manager: RSS exceeded {limit_mb} MB "
                 f"(mapred.task.limit.maxrss.mb)")
@@ -169,6 +172,11 @@ def run_task_in_process(runner: Any, job_id: str, task: Task,
             status.diagnostics = "child killed on tracker request"
         else:
             status.state = TaskState.FAILED
+            # a crash without a report is user code's doing (segfault,
+            # os._exit) — possibly the OOM killer's, recognizable by rc
+            status.failure_class = (FailureClass.OOM
+                                    if proc.returncode == -9 else
+                                    FailureClass.USER)
             status.diagnostics = (
                 f"child exited rc={proc.returncode} without reporting\n"
                 + _tail(log_path))

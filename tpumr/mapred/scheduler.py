@@ -153,13 +153,26 @@ class HybridQueueScheduler(TaskScheduler):
             # per-job override, same seam as optionalscheduling (a job
             # may opt into the f(x,y) minimizer on a shirahata cluster)
             mode = str(job.conf.get("tpumr.scheduler.mode", cluster_mode))
+            if job.tpu_disabled:
+                # job-level accelerator quarantine: the TPU pass below
+                # skips this job entirely, so neither starvation mode may
+                # zero its CPU budget — that combination would deadlock
+                # the job with pending maps no pass can assign
+                continue
             if mode == "minimize":
-                cpu_budget[jid] = self._minimize_cpu_share(
-                    job, free_cpu, max_tpu * n_trackers)
+                # the f(x,y) optimum may put everything on TPU — demoted
+                # (CPU-pinned) TIPs still need a floor of CPU slots
+                cpu_budget[jid] = max(
+                    self._minimize_cpu_share(job, free_cpu,
+                                             max_tpu * n_trackers),
+                    min(free_cpu, job.cpu_pinned_pending_count()))
             elif (self._optional_scheduling(job)
+                    and job.cpu_pinned_pending_count() == 0
                     and job.pending_map_count() < accel * max_tpu * n_trackers):
                 # optional scheduling: starve THIS job's CPU share so its
-                # remaining maps converge to the accelerator (:290-327)
+                # remaining maps converge to the accelerator (:290-327).
+                # CPU-pinned (demoted) TIPs lift the starvation: they can
+                # only ever run on the CPU pass
                 cpu_budget[jid] = 0
 
         # ---- TPU pass first (reference order fills GPU after CPU; filling
@@ -170,8 +183,10 @@ class HybridQueueScheduler(TaskScheduler):
                 break
             task = None
             for job in self._map_job_order(jobs):
-                if not job.has_kernel():
-                    continue  # ≈ gpu-executable gate (:342-347)
+                if not job.tpu_eligible():
+                    # ≈ gpu-executable gate (:342-347), plus the job-
+                    # level accelerator quarantine
+                    continue
                 if not fits(job.map_memory_mb()):
                     continue
                 device = free_devices[0]

@@ -510,6 +510,16 @@ class ShuffleCopier:
         self.max_fetch_failures = max(1, conf.get_int(
             "tpumr.shuffle.fetch.max.failures", 50))
         self.penalty_box = PenaltyBox(self.backoff_s, self.backoff_cap_s)
+        # blocked-on-location waits count as liveness for the tracker's
+        # hung-task reaper: a fetcher parked in the locator's poll loop
+        # (waiting for a lost map's re-run to publish) is waiting, not
+        # hung (≈ Hadoop reduces ticking reporter.progress per fetch
+        # iteration). Duck-typed: only the tracker/child MapLocator has
+        # the on_wait seam.
+        if reporter is not None:
+            locate = getattr(source, "locate", None)
+            if locate is not None and hasattr(locate, "on_wait"):
+                locate.on_wait = reporter.keepalive
         #: observability: how many segments went to disk vs memory
         #: (mutated by parallel workers — guarded by _stats_lock)
         self.spilled_to_disk = 0
@@ -746,7 +756,11 @@ class ShuffleCopier:
                 now = time.monotonic()
                 if hold > now:
                     # not yet — rotate it to the back and nap briefly so
-                    # an all-penalized queue doesn't busy-spin
+                    # an all-penalized queue doesn't busy-spin. Waiting
+                    # out a penalty is liveness, not a hang: tick the
+                    # reaper's keepalive.
+                    if self.reporter is not None:
+                        self.reporter.keepalive()
                     work.put((ready, m))
                     time.sleep(min(hold - now, 0.05))
                     continue

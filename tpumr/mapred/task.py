@@ -44,6 +44,84 @@ class TaskPhase:
     CLEANUP = "CLEANUP"
 
 
+class FailureClass:
+    """Why an attempt failed — the accelerator-fault-tolerance signal.
+
+    The reference retries every failure identically (mapred.map.max.
+    attempts) and often re-lands the retry on the same backend; the
+    hybrid dispatch plane needs to know WHETHER the failure indicts the
+    accelerator (demote the TIP to CPU, quarantine the device) or the
+    user code (burn attempts as usual). Derived at the failure site
+    (tpu_runner / child / the tracker's reaper) and carried on
+    TaskStatus through heartbeats into JobInProgress._on_failure."""
+
+    DEVICE = "device"      # the accelerator runtime/device misbehaved
+    COMPILE = "compile"    # XLA/kernel compilation failed
+    OOM = "oom"            # memory exhaustion (host RSS or device HBM)
+    USER = "user"          # user code raised — backend is innocent
+    TIMEOUT = "timeout"    # reaped: stopped reporting progress
+
+    #: classes that indict the accelerator path (drive TPU→CPU demotion
+    #: and job-level TPU quarantine); OOM is excluded — a split too big
+    #: for HBM usually OOMs the host spill path too
+    ACCELERATOR = {DEVICE, COMPILE}
+
+
+def tag_failure(exc: BaseException, failure_class: str) -> BaseException:
+    """Stamp ``failure_class`` on an exception at its site (first stamp
+    wins). Best-effort: exotic exceptions with __slots__ just stay
+    unclassified and fall through to the heuristics."""
+    if not getattr(exc, "failure_class", ""):
+        try:
+            exc.failure_class = failure_class
+        except (AttributeError, TypeError):
+            pass
+    return exc
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Generic (site-less) classification at the settle points: an
+    explicit site tag wins; memory exhaustion is recognized by type or
+    by the XLA RESOURCE_EXHAUSTED wording; everything else is user
+    code's fault."""
+    fc = getattr(exc, "failure_class", "")
+    if fc:
+        return str(fc)
+    if isinstance(exc, MemoryError):
+        return FailureClass.OOM
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if "resource_exhausted" in text or "out of memory" in text \
+            or "hbm" in text and "exhaust" in text:
+        return FailureClass.OOM
+    return FailureClass.USER
+
+
+def classify_accelerator_exception(exc: BaseException,
+                                   compile_cold: bool = False) -> str:
+    """Classification inside the TPU runner (the stage and execute
+    sites). Compile failures surface as execute-time errors under JAX's
+    lazy compilation, so a COLD dispatch whose error text mentions
+    compilation/lowering is classed ``compile``; errors raised by the
+    jax/jaxlib/XLA stack are ``device``; anything else is user code
+    that happened to run on an accelerator slot."""
+    fc = getattr(exc, "failure_class", "")
+    if fc:
+        return str(fc)
+    generic = classify_exception(exc)
+    if generic == FailureClass.OOM:
+        return generic
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if compile_cold and ("compil" in text or "lowering" in text
+                         or "unsupported" in text):
+        return FailureClass.COMPILE
+    # top-level package match, not a prefix: jaxtyping/jax_md etc. are
+    # user-code stacks whose bugs must not indict the device
+    mod = (type(exc).__module__ or "").split(".")[0]
+    if mod in ("jax", "jaxlib") or "xla" in text:
+        return FailureClass.DEVICE
+    return FailureClass.USER
+
+
 @dataclass
 class Task:
     """A scheduled task attempt, shipped master → node runner."""
@@ -115,6 +193,9 @@ class TaskStatus:
     # --- accelerator placement ---
     run_on_tpu: bool = False
     tpu_device_id: int = -1
+    #: why a FAILED attempt failed (FailureClass.*; "" = unclassified) —
+    #: the demotion/quarantine/reaping signal, heartbeat-carried
+    failure_class: str = ""
 
     @property
     def runtime(self) -> float:

@@ -81,6 +81,12 @@ class MapLocator:
         self._poll_s = poll_s
         self._timeout_s = timeout_s
         self._scope = scope
+        #: liveness seam for the hung-task reaper: invoked once per poll
+        #: iteration while a caller blocks waiting for a map location
+        #: (the ShuffleCopier wires the reduce Reporter's keepalive here
+        #: — a reduce stalled on a not-yet-rerun map is waiting, not
+        #: hung, and must not be reaped at mapred.task.timeout)
+        self.on_wait: "Any | None" = None
         self._events: dict[int, dict] = {}
         #: invalidated-but-not-withdrawn locations: the feed is cursor-
         #: based (an old SUCCEEDED event is never re-sent), so a
@@ -184,6 +190,8 @@ class MapLocator:
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"map {map_index} output never became available")
+            if self.on_wait is not None:
+                self.on_wait()
             time.sleep(self._poll_s)
         host, port = addr.rsplit(":", 1)
         with self._cache_lock:
@@ -338,6 +346,43 @@ class NodeRunner:
             GLOBAL_MEMORY_MANAGER
             if conf.get_int("mapred.task.limit.maxrss.mb", 0) > 0 else None)
 
+        # per-device accelerator quarantine: N consecutive device-classed
+        # failures depool a physical device (its slot vanishes from the
+        # next heartbeat); a background probe re-admits it. Conf-gated:
+        # threshold 0 disables.
+        from tpumr.mapred.node_health import TpuDeviceHealth
+        dq_threshold = conf.get_int("tpumr.tpu.device.quarantine.failures",
+                                    3)
+        self.device_health: TpuDeviceHealth | None = None
+        if self.max_tpu_map_slots > 0 and dq_threshold > 0:
+            self.device_health = TpuDeviceHealth(
+                self.n_tpu_devices, threshold=dq_threshold,
+                probe_interval_s=conf.get_int(
+                    "tpumr.tpu.device.probe.interval.ms", 10_000) / 1000,
+                probe_max_interval_s=conf.get_int(
+                    "tpumr.tpu.device.probe.max.interval.ms",
+                    300_000) / 1000)
+        self._mreg.set_gauge(
+            "tpu_devices_quarantined",
+            lambda: (len(self.device_health.quarantined())
+                     if self.device_health is not None else 0))
+
+        # hung-task reaping ≈ mapred.task.timeout + TaskTracker's
+        # markUnresponsiveTasks: a monotonic last-progress stamp per
+        # attempt, fed by the in-process reporter's observable activity
+        # and by CHANGED umbilical status pushes (an isolated child's
+        # unconditional 1 Hz push must not count — a hung child keeps
+        # pushing identical payloads). The reaper thread fails attempts
+        # silent past the (job-conf) timeout with failure_class=timeout.
+        self._last_progress: dict[str, float] = {}
+        self._progress_sigs: dict[str, tuple] = {}
+        self._live_reporters: dict[str, Reporter] = {}
+        #: last keepalive tick count pushed by each isolated child
+        self._umb_ticks: dict[str, int] = {}
+        self._reaper_thread = threading.Thread(
+            target=self._reaper_loop, name=f"{self.name}-task-reaper",
+            daemon=True)
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "NodeRunner":
@@ -348,6 +393,7 @@ class NodeRunner:
             configure_persistent_cache(self.conf)
         self._server.start()
         self._hb_thread.start()
+        self._reaper_thread.start()
         self.metrics.start()
         if self.health is not None:
             self.health.start()
@@ -477,6 +523,8 @@ class NodeRunner:
             t.flush()
         if self.health is not None:
             self.health.stop()
+        if self.device_health is not None:
+            self.device_health.stop()
         if self._http is not None:
             self._http.stop()
         self._server.stop()
@@ -504,12 +552,18 @@ class NodeRunner:
 
     def _available_tpu_devices(self) -> list[bool]:
         """free[i] derived from running task statuses each heartbeat
-        (≈ TaskTrackerStatus.availableGPUDevices, :536-550)."""
+        (≈ TaskTrackerStatus.availableGPUDevices, :536-550), minus any
+        quarantined devices — the scheduler derives assignable device
+        ids from this list, so a sick device vanishes here first."""
         free = [True] * self.n_tpu_devices
         for st in self.running.values():
             if (st.state == TaskState.RUNNING and st.run_on_tpu
                     and 0 <= st.tpu_device_id < self.n_tpu_devices):
                 free[st.tpu_device_id] = False
+        if self.device_health is not None:
+            for d in self.device_health.quarantined():
+                if 0 <= d < self.n_tpu_devices:
+                    free[d] = False
         return free
 
     @staticmethod
@@ -537,6 +591,12 @@ class NodeRunner:
                 avail_mb = max(0, total_mb - used)
             else:
                 avail_mb = -1
+            # device quarantine shrinks the ADVERTISED TPU slot pool on
+            # the next heartbeat (the acceptance contract: a sick device
+            # is observably depooled, and restored when the probe clears)
+            quarantined = (self.device_health.quarantined()
+                           if self.device_health is not None else [])
+            tpu_slots = max(0, self.max_tpu_map_slots - len(quarantined))
             return {
                 "available_memory_mb": avail_mb,
                 "fetch_failures": list(self._fetch_failures),
@@ -545,7 +605,8 @@ class NodeRunner:
                 "shuffle_addr": f"{self.bind_host}:{self.shuffle_port}",
                 "shuffle_port": self.shuffle_port,
                 "max_cpu_map_slots": self.max_cpu_map_slots,
-                "max_tpu_map_slots": self.max_tpu_map_slots,
+                "max_tpu_map_slots": tpu_slots,
+                "quarantined_tpu_devices": quarantined,
                 "max_reduce_slots": self.max_reduce_slots,
                 "count_cpu_map_tasks": cpu,
                 "count_tpu_map_tasks": tpu,
@@ -612,6 +673,11 @@ class NodeRunner:
             for aid in sent_terminal:
                 self.running.pop(aid, None)
                 self.running_tasks.pop(aid, None)
+                # reaper bookkeeping dies with the attempt
+                self._last_progress.pop(aid, None)
+                self._progress_sigs.pop(aid, None)
+                self._live_reporters.pop(aid, None)
+                self._umb_ticks.pop(aid, None)
         for action in resp["actions"]:
             self._apply_action(action)
         self._hb_count += 1
@@ -807,6 +873,7 @@ class NodeRunner:
         with self.lock:
             self.running[aid] = status
             self.running_tasks[aid] = task
+            self._last_progress[aid] = time.monotonic()
         if not task.is_map:
             self._mreg.incr("reduces_launched")
         else:
@@ -865,6 +932,12 @@ class NodeRunner:
         # completion (hard process kills arrive with the subprocess
         # executor; threads cannot be interrupted)
         reporter = Reporter(abort_check=killed)
+        with self.lock:
+            # the reaper samples this live reporter's counters/status for
+            # progress liveness — zero hot-path cost (hoisted Counter
+            # objects bypass Reporter.incr_counter, so a push-style hook
+            # could never see the per-record activity anyway)
+            self._live_reporters[aid] = reporter
         sem = (self._red_sem if not task.is_map
                else self._tpu_sem if task.run_on_tpu else self._cpu_sem)
         tracer = self._trace_tracer(job_id, task)
@@ -936,6 +1009,20 @@ class NodeRunner:
                 return False
         return True
 
+    def _abort_if_settled(self, status: TaskStatus) -> None:
+        """A reaped (terminally settled) in-process attempt must never
+        reach the commit gate or register map outputs: the master
+        already counted it FAILED and re-queued the task, and a zombie
+        can_commit call would CAPTURE the commit grant for a dead
+        attempt — every re-run then loses the grant race and the task
+        livelocks KILLED forever. Checked at the side-effect boundaries
+        (output registration, commit)."""
+        with self.lock:
+            if status.state in TaskState.TERMINAL:
+                raise TaskKilledError(
+                    "attempt settled terminally while still running "
+                    "(reaped for progress silence)")
+
     def _run_task_inner(self, job_id: str, task: Task, status: TaskStatus,
                         reporter: Reporter) -> None:
         aid = str(task.attempt_id)
@@ -954,6 +1041,7 @@ class NodeRunner:
                     conf, task, prof_dir,
                     lambda: run_map_task(conf, task, local_dir, reporter,
                                          status=status))
+                self._abort_if_settled(status)
                 with self.lock:
                     if out[0]:
                         # stamp the producing attempt on the served index
@@ -985,29 +1073,194 @@ class NodeRunner:
                         lambda: run_reduce_task(conf, task, fetch,
                                                 reporter))
                 status.phase = TaskPhase.REDUCE
+                self._abort_if_settled(status)
                 committed = self._commit(conf, task)
-            status.counters = reporter.counters.to_dict()
-            self._note_merge_counters(status.counters)
-            status.progress = 1.0
-            status.finish_time = time.time()
             with self.lock:
                 killed = aid in self._kill_requested
-            if not committed:
-                status.diagnostics = "commit denied: another attempt won"
-                status.state = TaskState.KILLED
-            else:
-                status.state = (TaskState.KILLED if killed
-                                else TaskState.SUCCEEDED)
+                # the reaper may have terminally settled this attempt
+                # (FAILED/timeout) while the thread finished anyway — a
+                # late settle must not resurrect it
+                if status.state in TaskState.TERMINAL:
+                    return
+                status.counters = reporter.counters.to_dict()
+                self._note_merge_counters(status.counters)
+                status.progress = 1.0
+                status.finish_time = time.time()
+                if not committed:
+                    status.diagnostics = "commit denied: another attempt won"
+                    status.state = TaskState.KILLED
+                else:
+                    status.state = (TaskState.KILLED if killed
+                                    else TaskState.SUCCEEDED)
+            if status.state == TaskState.SUCCEEDED:
+                self._note_device_result(task, None)
         except TaskKilledError:
-            status.diagnostics = "attempt killed while running (preempted " \
-                                 "or superseded)"
-            status.finish_time = time.time()
-            status.state = TaskState.KILLED  # requeue, no attempt budget
+            with self.lock:
+                if status.state in TaskState.TERMINAL:
+                    return  # reaped: FAILED/timeout already settled
+                status.diagnostics = "attempt killed while running " \
+                                     "(preempted or superseded)"
+                status.finish_time = time.time()
+                status.state = TaskState.KILLED  # requeue, no attempt budget
         except Exception as e:  # noqa: BLE001 — task failure is data
-            status.diagnostics = f"{type(e).__name__}: {e}\n" + \
-                traceback.format_exc(limit=8)
-            status.finish_time = time.time()
-            status.state = TaskState.FAILED
+            from tpumr.mapred.task import classify_exception
+            with self.lock:
+                if status.state in TaskState.TERMINAL:
+                    return
+                status.diagnostics = f"{type(e).__name__}: {e}\n" + \
+                    traceback.format_exc(limit=8)
+                status.finish_time = time.time()
+                # the demotion/quarantine signal: tagged at the failure
+                # site (tpu_runner) or classified generically here
+                status.failure_class = classify_exception(e)
+                status.state = TaskState.FAILED
+            self._note_device_result(task, status.failure_class)
+
+    def _note_device_result(self, task: Task,
+                            failure_class: "str | None") -> None:
+        """Feed the per-device quarantine: device-classed failures of TPU
+        attempts count against their physical device; a success (or any
+        non-device failure) breaks the consecutive streak."""
+        if (self.device_health is None or not task.is_map
+                or not task.run_on_tpu or task.tpu_device_id < 0):
+            return
+        from tpumr.mapred.task import FailureClass
+        dev = task.tpu_device_id % max(1, self.n_tpu_devices)
+        if failure_class == FailureClass.DEVICE:
+            if self.device_health.record_failure(dev):
+                self._mreg.incr("tpu_device_quarantines")
+        else:
+            self.device_health.record_success(dev)
+
+    # ------------------------------------------------------------ reaper
+    # ≈ mapred.task.timeout + TaskTracker.markUnresponsiveTasks: fail
+    # attempts that stopped reporting progress. Liveness is OBSERVED, not
+    # pushed: the reaper samples each running attempt's progress
+    # signature (phase, progress, status line, total counter ticks —
+    # from the live in-process Reporter when there is one, else from the
+    # umbilical-pushed status) and bumps last_progress on change. An
+    # isolated child's unconditional 1 Hz status push therefore does NOT
+    # count unless its payload changed, and neither does its kill-poll
+    # ping — a hung child is reaped despite both threads staying alive.
+
+    def _progress_signature(self, aid: str, st: TaskStatus,
+                            reporter: "Reporter | None") -> tuple:
+        if reporter is not None:
+            total = sum(c.value for g in reporter.counters for c in g)
+            note = reporter.status
+            ticks = reporter.ticks
+        else:
+            total, note, ticks = 0, "", 0
+        pushed = sum(v for g in (st.counters or {}).values()
+                     for v in g.values()) if st.counters else 0
+        with self.lock:
+            umb_ticks = self._umb_ticks.get(aid, 0)
+        return (st.phase, round(st.progress, 6), note, total, ticks,
+                pushed, umb_ticks)
+
+    def _task_timeout_s(self, aid: str) -> float:
+        """This attempt's progress timeout (job conf wins over tracker
+        conf, tracker conf over the Hadoop default; ≤0 disables —
+        mapred.task.timeout contract)."""
+        tracker_ms = self.conf.get_int("mapred.task.timeout", 600_000)
+        try:
+            job_id = str(TaskAttemptID.parse(aid).task.job)
+        except (ValueError, IndexError):
+            return tracker_ms / 1000
+        with self.lock:
+            jc = self.job_confs.get(job_id)
+        if jc is None:
+            return tracker_ms / 1000
+        return jc.get_int("mapred.task.timeout", tracker_ms) / 1000
+
+    def _reaper_wait_s(self) -> float:
+        """Poll granularity: a quarter of the SMALLEST live timeout
+        (tracker conf and every cached job conf — a job may override
+        mapred.task.timeout far below the tracker's), bounded [0.1, 5]s,
+        so a tight per-job timeout is enforced near its configured
+        value, not at a fixed 5 s grid."""
+        smallest = self.conf.get_int("mapred.task.timeout", 600_000)
+        with self.lock:
+            confs = list(self.job_confs.values())
+        for jc in confs:
+            t = jc.get_int("mapred.task.timeout", smallest)
+            if 0 < t < smallest or smallest <= 0 < t:
+                smallest = t
+        if smallest <= 0:
+            return 5.0   # reaping disabled everywhere; idle slowly
+        return max(0.1, min(5.0, smallest / 1000 / 4.0))
+
+    def _reaper_loop(self) -> None:
+        while not self._stop.wait(self._reaper_wait_s()):
+            try:
+                self._reap_hung_tasks()
+            except Exception:  # noqa: BLE001 — the reaper must outlive
+                pass           # any one attempt's weirdness
+
+    def _reap_hung_tasks(self) -> "list[str]":
+        now = time.monotonic()
+        with self.lock:
+            snapshot = [(aid, st, self._live_reporters.get(aid))
+                        for aid, st in self.running.items()
+                        if st.state == TaskState.RUNNING]
+        reaped = []
+        for aid, st, reporter in snapshot:
+            try:
+                sig = self._progress_signature(aid, st, reporter)
+            except RuntimeError:
+                # a counter table grew mid-iteration (live Counters are
+                # read lock-free) — a mutating table IS task activity
+                with self.lock:
+                    self._last_progress[aid] = now
+                continue
+            with self.lock:
+                if self._progress_sigs.get(aid) != sig:
+                    self._progress_sigs[aid] = sig
+                    self._last_progress[aid] = now
+                    continue
+                last = self._last_progress.setdefault(aid, now)
+            timeout_s = self._task_timeout_s(aid)
+            if timeout_s <= 0 or now - last <= timeout_s:
+                continue
+            if self._reap_one(aid, now - last, timeout_s):
+                reaped.append(aid)
+        return reaped
+
+    def _reap_one(self, aid: str, silent_s: float,
+                  timeout_s: float) -> bool:
+        """Terminally fail one silent attempt. The kill mechanics differ
+        by isolation: the babysitter SIGKILLs an isolated child's whole
+        session via _kill_tree the moment the kill request lands;
+        in-process runners see the cooperative cancel flag at their next
+        batch/record boundary (a thread cannot be interrupted — the
+        settle guards keep a late finisher from resurrecting the
+        attempt)."""
+        with self.lock:
+            st = self.running.get(aid)
+            if st is None or st.state in TaskState.TERMINAL:
+                return False
+            self._kill_requested.add(aid)   # SIGKILL / cooperative cancel
+            st.diagnostics = (
+                f"Task {aid} failed to report status for "
+                f"{silent_s:.0f} seconds (mapred.task.timeout="
+                f"{int(timeout_s * 1000)} ms). Killing!")
+            from tpumr.mapred.task import FailureClass
+            st.failure_class = FailureClass.TIMEOUT
+            st.finish_time = time.time()
+            st.state = TaskState.FAILED
+            task = self.running_tasks.get(aid)
+        self._mreg.incr("tasks_reaped_timeout")
+        if task is not None and task.trace is not None:
+            try:
+                job_id = str(TaskAttemptID.parse(aid).task.job)
+                tracer = self._trace_tracer(job_id, task)
+                if tracer is not None:
+                    tracer.instant("task:reaped", task.trace["trace_id"],
+                                   parent=task.trace, attempt_id=aid,
+                                   silent_s=round(silent_s, 3))
+            except Exception:  # noqa: BLE001 — observability best-effort
+                pass
+        return True
 
     #: framework counters rolled up into the /metrics shuffle_merge gauge
     _MERGE_COUNTER_KEYS = ("SHUFFLE_INMEM_MERGES",
@@ -1134,7 +1387,10 @@ class NodeRunner:
             return attempt_id in self._kill_requested
 
     def umbilical_status(self, attempt_id: str, d: dict) -> bool:
-        """Periodic progress/counter push (≈ statusUpdate)."""
+        """Periodic progress/counter push (≈ statusUpdate). The reaper
+        watches the fields written here: a push whose observable payload
+        never changes keeps the attempt walking toward
+        ``mapred.task.timeout``."""
         self._check_scope(str(TaskAttemptID.parse(attempt_id).task.job))
         with self.lock:
             st = self.running.get(attempt_id)
@@ -1144,6 +1400,8 @@ class NodeRunner:
             st.progress = float(d.get("progress", st.progress))
             if d.get("counters"):
                 st.counters = d["counters"]
+            if "ticks" in d:
+                self._umb_ticks[attempt_id] = int(d["ticks"])
             return True
 
     def umbilical_can_commit(self, task_id: str, attempt_id: str) -> bool:
@@ -1198,14 +1456,18 @@ class NodeRunner:
                     self.map_outputs[(job_id, partition)] = (real, idx)
 
     def umbilical_fail(self, attempt_id: str, state: str,
-                       diagnostics: str) -> None:
-        """Failure/kill report (≈ fsError/fatalError)."""
+                       diagnostics: str, failure_class: str = "") -> None:
+        """Failure/kill report (≈ fsError/fatalError). ``failure_class``
+        carries the child-side classification (task.FailureClass) into
+        the heartbeat so the master's demotion/quarantine logic sees
+        isolated attempts exactly like in-process ones."""
         self._check_scope(str(TaskAttemptID.parse(attempt_id).task.job))
         with self.lock:
             st = self.running.get(attempt_id)
             if st is not None and st.state not in TaskState.TERMINAL:
                 st.diagnostics = diagnostics
                 st.finish_time = time.time()
+                st.failure_class = str(failure_class or "")
                 st.state = (state if state in TaskState.TERMINAL
                             else TaskState.FAILED)
 

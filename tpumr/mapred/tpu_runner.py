@@ -144,6 +144,22 @@ def clear_split_caches() -> None:
         _split_caches.clear()
 
 
+def _maybe_fail_accelerator(conf, dev_id: int) -> None:
+    """Chaos seams for the accelerator fault-tolerance layer, classed so
+    the demotion/quarantine pipeline sees exactly what a real fault
+    would report: ``tpu.compile`` (failure_class=compile), ``tpu.execute``
+    and the device-qualified ``tpu.execute.d<id>`` (failure_class=device
+    — the qualified point lets a test sicken ONE physical device while
+    its siblings keep serving)."""
+    from tpumr.mapred.task import FailureClass
+    from tpumr.utils.fi import maybe_fail
+    maybe_fail("tpu.compile", conf, failure_class=FailureClass.COMPILE)
+    maybe_fail("tpu.execute", conf, failure_class=FailureClass.DEVICE)
+    if dev_id >= 0:
+        maybe_fail(f"tpu.execute.d{dev_id}", conf,
+                   failure_class=FailureClass.DEVICE)
+
+
 class TpuMapRunner(MapRunnable):
     def configure(self, conf) -> None:
         self.conf = conf
@@ -155,6 +171,8 @@ class TpuMapRunner(MapRunnable):
 
         conf = self.conf
         configure_persistent_cache(conf)
+        _maybe_fail_accelerator(
+            conf, getattr(task_ctx, "tpu_device_id", -1) if task_ctx else -1)
         name = conf.get_map_kernel()
         if not name:
             raise ValueError(
@@ -200,8 +218,13 @@ class TpuMapRunner(MapRunnable):
 
         with tracing.span("tpu:stage", backend="tpu",
                           device=str(device)) as st:
-            batch, counted_by_reader, staged_bytes = stage_batch(
-                self.conf, reader, task_ctx, device)
+            try:
+                batch, counted_by_reader, staged_bytes = stage_batch(
+                    self.conf, reader, task_ctx, device)
+            except Exception as e:  # noqa: BLE001 — classify at the site
+                from tpumr.mapred.task import (classify_accelerator_exception,
+                                               tag_failure)
+                raise tag_failure(e, classify_accelerator_exception(e))
             if st is not None:
                 # staged_bytes == 0 means the split was already device-
                 # resident (HBM split cache / output chain) — the stage
@@ -219,27 +242,34 @@ class TpuMapRunner(MapRunnable):
                               staged_bytes)
 
         t0 = time.time()
-        with jax.default_device(device):
-            with tracing.span("tpu:execute", backend="tpu",
-                              kernel=name, device=str(device)) as ex:
-                if ex is not None:
-                    ex.set(compile=_compile_temperature(name, batch))
-                state = (kernel.map_batch_launch(batch, conf, task_ctx)
-                         if type(kernel).supports_launch() else None)
-                if state is not None:
-                    _offer_device_rows(kernel, state, conf)
-                    # coalesce this task's device→host transfer with any
-                    # concurrently-fetching TPU-slot threads: one tunnel
-                    # roundtrip can carry many tasks' outputs
-                    from tpumr.mapred.fetch_batcher import shared_batcher
-                    fetched = shared_batcher().fetch(state)
-                    records = kernel.map_batch_drain(fetched, conf,
-                                                     task_ctx)
-                else:
-                    records = kernel.map_batch(batch, conf, task_ctx)
-                for key, value in records:
-                    output.collect(key, value)
-                _mark_dispatched(name, batch)
+        temperature = _compile_temperature(name, batch)
+        try:
+            with jax.default_device(device):
+                with tracing.span("tpu:execute", backend="tpu",
+                                  kernel=name, device=str(device)) as ex:
+                    if ex is not None:
+                        ex.set(compile=temperature)
+                    state = (kernel.map_batch_launch(batch, conf, task_ctx)
+                             if type(kernel).supports_launch() else None)
+                    if state is not None:
+                        _offer_device_rows(kernel, state, conf)
+                        # coalesce this task's device→host transfer with
+                        # any concurrently-fetching TPU-slot threads: one
+                        # tunnel roundtrip can carry many tasks' outputs
+                        from tpumr.mapred.fetch_batcher import shared_batcher
+                        fetched = shared_batcher().fetch(state)
+                        records = kernel.map_batch_drain(fetched, conf,
+                                                         task_ctx)
+                    else:
+                        records = kernel.map_batch(batch, conf, task_ctx)
+                    for key, value in records:
+                        output.collect(key, value)
+                    _mark_dispatched(name, batch)
+        except Exception as e:  # noqa: BLE001 — classify at the site
+            from tpumr.mapred.task import (classify_accelerator_exception,
+                                           tag_failure)
+            raise tag_failure(e, classify_accelerator_exception(
+                e, compile_cold=temperature == "cold"))
         reporter.set_status(
             f"kernel {name} on {device}: "
             f"{getattr(batch, 'num_records', 0)} records in "

@@ -26,6 +26,17 @@ point names so one map's output — or one attempt generation — can be
 targeted deterministically:
   shuffle.serve / shuffle.serve.m<map_index> / shuffle.serve.a<attempt>
   shuffle.fetch / shuffle.fetch.m<map_index>
+
+Accelerator-fault seams (the TPU→CPU demotion / device-quarantine /
+hung-task-reaping loop):
+  tpu.compile                    raises classed ``compile`` at dispatch
+  tpu.execute / tpu.execute.d<id>  raises classed ``device`` (optionally
+                                 targeting one physical device)
+  task.hang / task.hang.m<idx>   BEHAVIORAL fault — the task stops
+                                 reporting progress forever (drawn via
+                                 :func:`fires`, nothing raised); the
+                                 tracker's reaper is the quarry's
+                                 predator
 """
 
 from __future__ import annotations
@@ -74,18 +85,34 @@ def fired(point: str) -> int:
         return _fired.get(point, 0)
 
 
-def maybe_fail(point: str, conf: Any = None) -> None:
-    """≈ ProbabilityModel.injectCriteria + the woven fault advice."""
+def fires(point: str, conf: Any = None) -> bool:
+    """Draw the probability model for ``point`` WITHOUT raising — for
+    seams whose fault is behavioral (a hang, a silence) rather than an
+    exception. Same config keys, counting, and determinism contract as
+    :func:`maybe_fail`."""
     if conf is None:
-        return
+        return False
     p = conf.get(f"tpumr.fi.{point}.probability")
     if not p:
-        return
+        return False
     if _random(point, conf) >= float(p):
-        return
+        return False
     limit = int(conf.get(f"tpumr.fi.{point}.max.failures", 0) or 0)
     with _lock:
         if limit and _fired.get(point, 0) >= limit:
-            return
+            return False
         _fired[point] = _fired.get(point, 0) + 1
-    raise InjectedFault(f"injected fault at {point}")
+    return True
+
+
+def maybe_fail(point: str, conf: Any = None,
+               failure_class: str = "") -> None:
+    """≈ ProbabilityModel.injectCriteria + the woven fault advice.
+    ``failure_class`` stamps the raised fault for the accelerator
+    failure-classification pipeline (task.classify_exception honors the
+    attribute), so a seam can impersonate a device/compile/oom error."""
+    if fires(point, conf):
+        e = InjectedFault(f"injected fault at {point}")
+        if failure_class:
+            e.failure_class = failure_class
+        raise e
