@@ -32,9 +32,21 @@ class TestMetricsCore:
         assert snap == {"events": 5, "depth": 7, "static": 3}
 
     def test_broken_gauge_survives(self):
+        """A failing gauge is SKIPPED and counted — never snapshotted as
+        an '<error: ...>' string that numeric sinks (UdpSink, the
+        Prometheus renderer) would have to dodge."""
         reg = MetricsRegistry("x")
         reg.set_gauge("bad", lambda: 1 / 0)
-        assert "error" in str(reg.snapshot()["bad"])
+        reg.set_gauge("good", lambda: 7)
+        snap = reg.snapshot()
+        assert "bad" not in snap
+        assert snap["good"] == 7
+        assert snap["metrics_gauge_errors"] == 1
+        reg.snapshot()
+        assert reg.snapshot()["metrics_gauge_errors"] == 3
+        typed = reg.typed_snapshot()
+        assert "bad" not in typed["gauges"]
+        assert typed["counters"]["metrics_gauge_errors"] == 4
 
     def test_system_publish_to_file_sink(self, tmp_path):
         ms = MetricsSystem("test", period_s=3600)
@@ -116,6 +128,322 @@ class TestMetricsCore:
             c = JobConf()
             c.set("tpumr.metrics.udp", bad)
             assert sinks_from_conf(c) == []
+
+
+class TestHistogram:
+    def test_observe_count_sum_minmax_and_percentiles(self):
+        from tpumr.metrics import Histogram, exponential_bounds
+        h = Histogram("lat", exponential_bounds(0.001, 2.0, 12))
+        for ms in range(1, 101):          # 1..100 ms uniform
+            h.observe(ms / 1000.0)
+        s = h.snapshot()
+        assert s["count"] == 100
+        assert abs(s["sum"] - 5.05) < 1e-9
+        assert s["min"] == 0.001 and s["max"] == 0.1
+        # estimation error bounded by the bucket factor (2x)
+        assert 0.025 <= s["p50"] <= 0.1
+        assert 0.05 <= s["p95"] <= 0.2
+        assert s["p50"] <= s["p95"] <= s["p99"]
+
+    def test_bounds_validation_and_defaults(self):
+        from tpumr.metrics import Histogram, exponential_bounds
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            exponential_bounds(0, 2, 4)
+        with _pytest.raises(ValueError):
+            Histogram("x", [1.0, 1.0, 2.0])
+        assert Histogram("x").bounds  # SECONDS default ladder
+
+    def test_timer_records_even_on_exception(self):
+        from tpumr.metrics import Histogram
+        h = Histogram("t")
+        with pytest.raises(RuntimeError):
+            with h.time():
+                raise RuntimeError("boom")
+        assert h.count == 1
+
+    def test_merge_typed_and_typed_delta(self):
+        from tpumr.metrics import Histogram
+        from tpumr.metrics.histogram import typed_delta
+        a = Histogram("x")
+        for v in (0.001, 0.01, 0.1, 1.0):
+            a.observe(v)
+        snap1 = a.typed()
+        a.observe(10.0)
+        snap2 = a.typed()
+        # delta between cumulative states = just the new observation
+        d = typed_delta(snap2, snap1)
+        assert d["count"] == 1 and abs(d["sum"] - 10.0) < 1e-9
+        assert sum(d["buckets"].values()) == 1
+        # unchanged state -> no delta; restart (shrunk count) -> re-base
+        assert typed_delta(snap2, snap2) is None
+        assert typed_delta(snap1, snap2) == snap1
+        # merging two full states doubles everything
+        m = Histogram("x")
+        m.merge_typed(snap2)
+        m.merge_typed(snap2)
+        assert m.count == 10 and abs(m.sum - 2 * a.sum) < 1e-9
+        assert m.max == 10.0 and m.min == 0.001
+        # mismatched ladders are dropped, not corrupted
+        other = Histogram("y", [1.0, 2.0]).typed()
+        m.merge_typed(other)
+        assert m.count == 10
+
+    def test_registry_histogram_get_or_create(self):
+        from tpumr.metrics import MetricsRegistry
+        reg = MetricsRegistry("s")
+        h1 = reg.histogram("lat")
+        h2 = reg.histogram("lat")
+        assert h1 is h2
+        h1.observe(0.5)
+        snap = reg.snapshot()["lat"]
+        assert snap["count"] == 1
+        typed = reg.typed_snapshot()
+        assert typed["histograms"]["lat"]["count"] == 1
+
+    def test_exact_percentiles(self):
+        from tpumr.metrics import exact_percentiles
+        assert exact_percentiles([]) == {}
+        p = exact_percentiles(list(range(1, 101)))
+        assert p["p50"] == 50 and p["p95"] == 95 and p["p99"] == 99
+        assert p["count"] == 100 and p["max"] == 100
+
+
+class TestPrometheus:
+    def _system(self):
+        ms = MetricsSystem("jobtracker", period_s=3600)
+        reg = ms.new_registry("jobtracker")
+        reg.incr("heartbeats", 3)
+        reg.set_gauge("slots", lambda: {"cpu": 4, "tpu": 2})
+        reg.set_gauge("jobs_running", lambda: 1)
+        reg.set_gauge("label", lambda: "text-skipped")
+        h = reg.histogram("heartbeat_seconds")
+        for v in (0.001, 0.002, 0.02, 1.5):
+            h.observe(v)
+        return ms
+
+    def test_render_and_validate(self):
+        from tpumr.metrics import render_exposition, validate_exposition
+        text = render_exposition(self._system().typed_snapshot())
+        validate_exposition(text)   # raises on any format violation
+        lines = text.splitlines()
+        assert "# TYPE tpumr_heartbeats counter" in lines
+        assert 'tpumr_heartbeats{source="jobtracker"} 3' in lines
+        # composite gauges flatten one level; non-numeric skipped
+        assert 'tpumr_slots_cpu{source="jobtracker"} 4' in lines
+        assert not any("label" in l for l in lines)
+        # cumulative-le histogram series with +Inf == _count
+        assert "# TYPE tpumr_heartbeat_seconds histogram" in lines
+        inf = [l for l in lines if 'le="+Inf"' in l]
+        assert inf and inf[0].endswith(" 4")
+        assert 'tpumr_heartbeat_seconds_count{source="jobtracker"} 4' \
+            in lines
+
+    def test_name_sanitization_and_label_escaping(self):
+        from tpumr.metrics import (MetricsRegistry, render_exposition,
+                                   validate_exposition)
+        from tpumr.metrics.prometheus import sanitize_name
+        assert sanitize_name("rpc.get-map output") == "rpc_get_map_output"
+        assert sanitize_name("9lives")[0] == "_"
+        ms = MetricsSystem("t", period_s=3600)
+        reg = MetricsRegistry('trk "weird"\nname')
+        reg.incr("some.metric-name", 1)
+        ms.register(reg)
+        text = render_exposition(ms.typed_snapshot())
+        validate_exposition(text)
+        assert "tpumr_some_metric_name" in text
+
+    def test_validator_rejects_bad_expositions(self):
+        from tpumr.metrics import validate_exposition
+        with pytest.raises(ValueError, match="no # TYPE"):
+            validate_exposition("tpumr_x 1\n")
+        with pytest.raises(ValueError, match="unparseable"):
+            validate_exposition("# TYPE tpumr_x gauge\ntpumr_x one\n")
+        with pytest.raises(ValueError, match="not cumulative"):
+            validate_exposition(
+                "# TYPE h histogram\n"
+                'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+                "h_sum 1\nh_count 3\n")
+        with pytest.raises(ValueError, match=r"\+Inf"):
+            validate_exposition(
+                "# TYPE h histogram\n"
+                'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+        with pytest.raises(ValueError, match="_count"):
+            validate_exposition(
+                "# TYPE h histogram\n"
+                'h_bucket{le="+Inf"} 2\nh_sum 1\nh_count 3\n')
+        with pytest.raises(ValueError, match="duplicate TYPE"):
+            validate_exposition(
+                "# TYPE g gauge\n# TYPE g gauge\ng 1\n")
+
+    def test_conflicting_kinds_qualified_by_source(self):
+        """The same metric name as a counter in one source and a gauge
+        in another must not produce two TYPE lines for one family."""
+        from tpumr.metrics import render_exposition, validate_exposition
+        ms = MetricsSystem("t", period_s=3600)
+        ms.new_registry("a").incr("depth", 2)
+        ms.new_registry("b").set_gauge("depth", lambda: 5)
+        text = render_exposition(ms.typed_snapshot())
+        validate_exposition(text)
+        assert "tpumr_b_depth" in text
+
+
+class TestClusterAggregator:
+    def _piggyback(self, n_fetches: int, errors: int = 2) -> dict:
+        from tpumr.metrics import MetricsRegistry
+        reg = MetricsRegistry("shuffle")
+        reg.incr("fetch_errors", errors)
+        h = reg.histogram("fetch_seconds")
+        for _ in range(n_fetches):
+            h.observe(0.01)
+        t = reg.typed_snapshot()
+        return {"shuffle": t,
+                "tasktracker": {"counters": {"cpu_maps_launched": 4},
+                                "gauges": {"slot_utilization":
+                                           {"cpu": 0.5}}}}
+
+    def test_cumulative_merge_is_idempotent(self):
+        from tpumr.metrics import MetricsRegistry
+        from tpumr.metrics.cluster import ClusterAggregator
+        agg = ClusterAggregator(MetricsRegistry("cluster"))
+        pb = self._piggyback(10)
+        agg.merge("t1", pb)
+        agg.merge("t1", pb)       # replayed heartbeat: no double count
+        snap = agg.registry.snapshot()
+        assert snap["shuffle_fetch_errors"] == 2
+        assert snap["shuffle_fetch_seconds"]["count"] == 10
+        assert snap["cpu_maps_launched"] == 4
+        # a second tracker's state adds
+        agg.merge("t2", self._piggyback(5))
+        snap = agg.registry.snapshot()
+        assert snap["shuffle_fetch_errors"] == 4
+        assert snap["shuffle_fetch_seconds"]["count"] == 15
+        assert agg.gauge_totals()["slot_utilization_cpu"] == 1.0
+        assert set(agg.gauge_rows()) == {"t1", "t2"}
+
+    def test_restart_rebases_instead_of_negative(self):
+        from tpumr.metrics import MetricsRegistry
+        from tpumr.metrics.cluster import ClusterAggregator
+        agg = ClusterAggregator(MetricsRegistry("cluster"))
+        agg.merge("t1", self._piggyback(10))
+        # tracker restarted: cumulative values shrank — the shrunk
+        # state is folded as a fresh baseline, never a negative delta
+        agg.merge("t1", self._piggyback(3, errors=1))
+        snap = agg.registry.snapshot()
+        assert snap["shuffle_fetch_seconds"]["count"] == 13
+        assert snap["shuffle_fetch_errors"] == 3
+        agg.forget("t1")
+        assert agg.gauge_rows() == {}
+
+    def test_malformed_piggyback_is_dropped(self):
+        from tpumr.metrics import MetricsRegistry
+        from tpumr.metrics.cluster import ClusterAggregator
+        agg = ClusterAggregator(MetricsRegistry("cluster"))
+        agg.merge("t1", None)
+        agg.merge("t1", "garbage")
+        agg.merge("t1", {"src": {"histograms": {"h": "not-a-dict"},
+                                 "counters": {"c": "NaN-ish"}}})
+        assert agg.registry.snapshot() == {}
+
+
+class TestMetricsSatellites:
+    def test_stop_joins_publish_thread(self, tmp_path):
+        ms = MetricsSystem("t", period_s=0.05)
+        ms.new_registry("s").incr("n")
+        path = str(tmp_path / "m.jsonl")
+        ms.add_sink(FileSink(path))
+        ms.start()
+        t = ms._thread
+        assert t is not None and t.is_alive()
+        ms.stop()
+        assert not t.is_alive()          # joined, not orphaned
+        assert ms._thread is None
+        # final flush happened and the sink's handle was closed
+        assert open(path).read().strip()
+        assert ms._sinks[0]._f is None
+
+    def test_file_sink_holds_one_handle(self, tmp_path):
+        sink = FileSink(str(tmp_path / "m.jsonl"))
+        sink.put_metrics({"a": 1})
+        f = sink._f
+        assert f is not None
+        sink.put_metrics({"a": 2})
+        assert sink._f is f              # same handle, not reopened
+        # flush-per-record: both records readable NOW, pre-close
+        lines = open(sink.path).read().splitlines()
+        assert len(lines) == 2
+        assert [json.loads(l)["seq"] for l in lines] == [1, 2]
+        sink.close()
+        assert sink._f is None
+        sink.put_metrics({"a": 3})       # post-close put reopens
+        assert len(open(sink.path).read().splitlines()) == 3
+        sink.close()
+
+    def _recv_all(self, sock, expect_lines):
+        import socket
+        got, grams = [], []
+        while len(got) < expect_lines:
+            try:
+                data = sock.recv(65536)
+            except socket.timeout:
+                break
+            grams.append(data)
+            got.extend(data.decode().splitlines())
+        return got, grams
+
+    def test_udp_sink_single_over_mtu_line(self):
+        """One statsd line longer than MAX_DATAGRAM still goes out (its
+        own datagram) — UDP caps at ~64KiB, not at our batching MTU."""
+        import socket
+        from tpumr.metrics import UdpSink
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5)
+        sink = UdpSink("127.0.0.1", recv.getsockname()[1])
+        big = "m" * (UdpSink.MAX_DATAGRAM + 100)
+        sink.put_metrics({"prefix": "p", "sources": {"s": {big: 1}}})
+        got, grams = self._recv_all(recv, 1)
+        assert got == [f"p.s.{big}:1|g"]
+        assert len(grams) == 1
+        recv.close()
+
+    def test_udp_sink_splits_exactly_at_mtu_boundary(self):
+        """A batch whose next line would push it past MAX_DATAGRAM
+        splits there; one that lands exactly ON the limit does not."""
+        import socket
+        from tpumr.metrics import UdpSink
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5)
+        sink = UdpSink("127.0.0.1", recv.getsockname()[1])
+        # two lines + newline == exactly MAX_DATAGRAM -> one datagram
+        overhead = len("p.s.:1|g")     # per-line chrome around the name
+        l1, l2 = 699, UdpSink.MAX_DATAGRAM - 700  # l1 + 1 + l2 == MAX
+        names = ["a" * (l1 - overhead), "b" * (l2 - overhead)]
+        metrics = {n: 1 for n in names}
+        sink.put_metrics({"prefix": "p", "sources": {"s": metrics}})
+        got, grams = self._recv_all(recv, 2)
+        assert len(got) == 2
+        assert len(grams) == 1
+        assert len(grams[0]) == UdpSink.MAX_DATAGRAM
+        # one byte more and the batch must split into two datagrams,
+        # losing nothing
+        names[1] += "b"
+        metrics = {n: 1 for n in names}
+        sink.put_metrics({"prefix": "p", "sources": {"s": metrics}})
+        got, grams = self._recv_all(recv, 2)
+        assert len(got) == 2
+        assert len(grams) == 2
+        assert all(len(g) <= UdpSink.MAX_DATAGRAM for g in grams)
+        recv.close()
+
+    def test_sinks_from_conf_malformed_udp_values(self):
+        from tpumr.metrics import sinks_from_conf
+        for bad in ("monitor01", "monitor01:", ":notaport",
+                    "host:port:extra:", "host: ", " : "):
+            c = JobConf()
+            c.set("tpumr.metrics.udp", bad)
+            assert sinks_from_conf(c) == [], bad
 
 
 class WcMapper:
@@ -329,6 +657,148 @@ class TestJobTrackerHttp:
         assert "leak-me" in (tmp_path / "job_x_0001.jsonl").read_text()
 
 
+class TestClusterMetricsE2E:
+    """The metrics-v2 acceptance surface: Prometheus exposition on the
+    live master, heartbeat-aggregated cluster series, per-method RPC and
+    scheduler instrumentation, the per-job stats rollup + CLI, and
+    output-byte identity with publishing on vs off."""
+
+    def _poll_prom(self, base, needles, timeout=10.0):
+        deadline = time.time() + timeout
+        while True:
+            code, body = fetch(base + "/metrics/prom")
+            assert code == 200
+            if all(n in body for n in needles) or time.time() > deadline:
+                return body
+
+    def test_prom_scrape_validates_with_cluster_series(self, cluster):
+        from tpumr.metrics import validate_exposition
+        run_wc(cluster, "prom")
+        base = cluster.master.http_url
+        # tracker-aggregated series arrive on the next heartbeat after
+        # the job — poll briefly rather than sleeping blind
+        body = self._poll_prom(base, [
+            'tpumr_cpu_maps_launched{source="cluster"}',
+            'tpumr_shuffle_fetch_seconds_count{source="cluster"}'])
+        validate_exposition(body)
+        # cluster-wide utilization gauges + the master's own heartbeat
+        # latency histogram (the acceptance criteria series); the
+        # utilization names match the trackers' per-host gauge exactly
+        assert 'tpumr_slot_utilization_tpu{source="cluster"}' in body
+        assert 'tpumr_slot_utilization_cpu{source="cluster"}' in body
+        assert 'tpumr_heartbeat_seconds_bucket{source="jobtracker",le=' \
+            in body
+        # per-method RPC server latency + wire request sizes on the
+        # master's surface — rpc_heartbeat_request_bytes IS the
+        # heartbeat payload-size series (frame length, not re-encoded)
+        assert 'tpumr_rpc_heartbeat_count{source="rpc"}' in body
+        assert 'tpumr_rpc_heartbeat_request_bytes_count{source="rpc"}' \
+            in body
+        # merged tracker counters carry real values
+        m = [l for l in body.splitlines()
+             if l.startswith('tpumr_cpu_maps_launched{source="cluster"}')]
+        assert m and float(m[0].rsplit(" ", 1)[1]) >= 1
+        # CI artifact: the scraped exposition body (tier1.yml uploads it)
+        with open("/tmp/tpumr-e2e-metrics-prom.txt", "w") as f:
+            f.write(body)
+        # the JSON twin still serves, now with histogram summaries
+        code, body = fetch(base + "/metrics")
+        snap = json.loads(body)
+        assert snap["jobtracker"]["heartbeat_seconds"]["count"] >= 1
+        assert "cluster" in snap
+
+    def test_rpc_and_scheduler_latency_histograms(self, cluster):
+        run_wc(cluster, "rpcstats")
+        code, body = fetch(cluster.master.http_url + "/metrics")
+        snap = json.loads(body)
+        # per-method RPC server latency: the heartbeat method must have
+        # been dispatched and timed
+        assert snap["rpc"]["rpc_heartbeat"]["count"] >= 1
+        assert snap["rpc"]["rpc_heartbeat"]["p99"] >= 0
+        # scheduler decision timing + per-backend assignment counters
+        assert snap["scheduler"]["assign_seconds"]["count"] >= 1
+        assert snap["scheduler"]["assigned_cpu_maps"] >= 1
+        assert snap["scheduler"]["assigned_reduces"] >= 1
+
+    def test_cluster_page(self, cluster):
+        run_wc(cluster, "clpage")
+        code, body = fetch(cluster.master.http_url + "/cluster")
+        assert code == 200
+        assert "Merged distributions" in body
+        assert "slot utilization" in body
+        assert "Per-tracker gauges" in body
+
+    def test_rollup_written_and_cli_prints_it(self, cluster, capsys):
+        result = run_wc(cluster, "rollup")
+        jid = str(result.job_id)
+        import os
+        path = os.path.join(cluster.history_dir, f"metrics-{jid}.json")
+        assert os.path.exists(path)
+        r = json.load(open(path))
+        assert r["state"] == "SUCCEEDED"
+        assert r["map_latency"]["count"] >= 1
+        for k in ("p50", "p95", "p99"):
+            assert r["map_latency"][k] >= 0
+        assert r["reduce_latency"]["count"] >= 1
+        split = r["task_time_split"]
+        assert split["cpu_map_s"] > 0 and split["tpu_map_s"] == 0
+        assert split["tpu_fraction_of_map_time"] == 0.0
+        assert r["counters"]          # counters rode along
+        # CI artifact: the per-job rollup (tier1.yml uploads it)
+        import shutil
+        shutil.copyfile(path, "/tmp/tpumr-e2e-job-metrics.json")
+
+        # `tpumr job stats <id>` prints percentiles + the task-time
+        # split from the on-disk rollup — no live master needed
+        from tpumr import cli
+        rc = cli.main(["job", "stats", jid, cluster.history_dir])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "map latency" in out and "p99=" in out
+        assert "task time" in out and "tpu" in out and "cpu" in out
+        rc = cli.main(["job", "stats", jid, cluster.history_dir, "-json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["job_id"] == jid
+        # unknown job: actionable error, not a traceback
+        rc = cli.main(["job", "stats", "job_nope_1", cluster.history_dir])
+        assert rc == 1
+        assert "no stats rollup" in capsys.readouterr().err
+
+    def test_output_bytes_identical_with_publishing_on_vs_off(
+            self, tmp_path_factory):
+        """Metrics publishing (file sink + heartbeat piggyback) must be
+        pure observation: same input, byte-identical job output."""
+        from tpumr.mapred.job_client import JobClient
+        outputs = {}
+        for mode in ("off", "on"):
+            base = tmp_path_factory.mktemp(f"mpub-{mode}")
+            conf = JobConf()
+            conf.set("tpumr.history.dir", str(base / "hist"))
+            if mode == "on":
+                conf.set("tpumr.metrics.file", str(base / "metrics.jsonl"))
+                conf.set("tpumr.metrics.period.ms", 50)
+            with MiniMRCluster(num_trackers=1, cpu_slots=2, tpu_slots=0,
+                               conf=conf) as c:
+                fs = get_filesystem("mem:///")
+                fs.write_bytes(f"/mpub{mode}/in.txt", b"x y x z\n" * 40)
+                jc = c.create_job_conf()
+                jc.set_input_paths(f"mem:///mpub{mode}/in.txt")
+                jc.set_output_path(f"mem:///mpub{mode}/out")
+                jc.set_class("mapred.mapper.class", WcMapper)
+                jc.set_class("mapred.reducer.class", SumReducer)
+                assert JobClient(jc).run_job(jc).successful
+                outputs[mode] = b"".join(
+                    fs.read_bytes(st.path)
+                    for st in sorted(fs.list_status(f"/mpub{mode}/out"),
+                                     key=lambda s: str(s.path))
+                    if "part-" in str(st.path))
+            if mode == "on":
+                # the sink actually published something
+                assert (base / "metrics.jsonl").exists()
+                assert open(base / "metrics.jsonl").read().strip()
+        assert outputs["on"] == outputs["off"]
+
+
 class TestTaskTrackerHttp:
     def test_task_detail_page_surfaces_profile(self, tmp_path_factory):
         """The tracker's /task?attempt= detail page inlines the top of
@@ -358,6 +828,20 @@ class TestTaskTrackerHttp:
             base = tracker._http.url
             code, body = fetch(base + "/metrics")
             assert code == 200 and tracker.name in json.loads(body)
+            snap = json.loads(body)
+            # per-tracker slot-utilization gauge rides the tracker's own
+            # registry (and from there the heartbeat piggyback)
+            util = snap[tracker.name]["slot_utilization"]
+            assert set(util) == {"cpu", "tpu", "reduce"}
+            # every daemon serves validated Prometheus exposition
+            from tpumr.metrics import validate_exposition
+            code, prom = fetch(base + "/metrics/prom")
+            assert code == 200
+            validate_exposition(prom)
+            assert "tpumr_slot_utilization_cpu" in prom
+            # the tracker's RPC surface (shuffle serving) was timed
+            assert 'tpumr_rpc_get_map_output_chunk_count{source="rpc"}' \
+                in prom
             profiled = tracker.list_profiles()
             assert profiled
             aid = profiled[0]

@@ -259,6 +259,8 @@ def cmd_job(conf, argv: list[str]) -> int:
         return _job_diagnose(conf, argv[1:])
     if argv and argv[0] in ("trace", "-trace"):
         return _job_trace(conf, argv[1:])
+    if argv and argv[0] in ("stats", "-stats"):
+        return _job_stats(conf, argv[1:])
     jt = conf.get("mapred.job.tracker")
     if not jt or jt == "local":
         print("job control needs -jt HOST:PORT", file=sys.stderr)
@@ -273,7 +275,8 @@ def cmd_job(conf, argv: list[str]) -> int:
              "running|completed | -list-active-trackers | "
              "-list-blacklisted-trackers | "
              "-counters ID | -counter ID GROUP NAME | -events ID | "
-             "-history ID [HISTORY_DIR] | trace ID [-out FILE] [-dir DIR]")
+             "-history ID [HISTORY_DIR] | stats ID [HISTORY_DIR] | "
+             "trace ID [-out FILE] [-dir DIR]")
     if not argv:
         print(usage, file=sys.stderr)
         return 255
@@ -605,6 +608,82 @@ def _job_trace(conf, argv: list[str]) -> int:
         print(f"  {p['name']:<28} {p['role']:<12} "
               f"{p['backend'] or '—':<8} {p['duration_s']:>9.4f}s "
               f"{p['self_s']:>9.4f}s {p['contribution_pct']:>7.1f}%")
+    return 0
+
+
+def _fmt_latency(label: str, pct: dict) -> str:
+    if not pct:
+        return f"{label}: (no finished tasks)"
+    return (f"{label}: n={pct['count']}  mean={pct['mean']:.3f}s  "
+            f"p50={pct['p50']:.3f}s  p95={pct['p95']:.3f}s  "
+            f"p99={pct['p99']:.3f}s  max={pct['max']:.3f}s")
+
+
+def _job_stats(conf, argv: list[str]) -> int:
+    """`tpumr job stats JOB_ID [HISTORY_DIR] [-json]`: print the per-job
+    stats rollup (metrics-<jobid>.json, written next to job history at
+    finalization) — latency percentiles, the TPU/CPU task-time split,
+    and acceleration factors. Offline like -history: reads the rollup
+    file, no live master needed."""
+    import os
+    as_json = "-json" in argv
+    argv = [a for a in argv if a != "-json"]
+    if not argv:
+        print("Usage: tpumr job stats JOB_ID [HISTORY_DIR] [-json]",
+              file=sys.stderr)
+        return 255
+    job_id = argv[0]
+    hist_dir = argv[1] if len(argv) > 1 else conf.get("tpumr.history.dir")
+    if not hist_dir:
+        print("job stats: pass HISTORY_DIR or set tpumr.history.dir",
+              file=sys.stderr)
+        return 255
+    path = os.path.join(hist_dir, f"metrics-{job_id}.json")
+    if not os.path.exists(path):
+        known = [f[len("metrics-"):-len(".json")]
+                 for f in sorted(os.listdir(hist_dir))
+                 if f.startswith("metrics-") and f.endswith(".json")] \
+            if os.path.isdir(hist_dir) else []
+        print(f"no stats rollup for {job_id} in {hist_dir} (written at "
+              f"job finalization); known: {', '.join(known) or '(none)'}",
+              file=sys.stderr)
+        return 1
+    with open(path) as f:
+        r = json.load(f)
+    if as_json:
+        print(json.dumps(r, indent=2))
+        return 0
+    print(f"Job: {r.get('job_id', job_id)}"
+          + (f"  ({r['job_name']})" if r.get("job_name") else ""))
+    print(f"State: {r.get('state', '?')}   wall time: "
+          f"{r.get('wall_time', 0):.2f}s   maps: {r.get('num_maps', 0)} "
+          f"({r.get('finished_tpu_maps', 0)} tpu / "
+          f"{r.get('finished_cpu_maps', 0)} cpu)   reduces: "
+          f"{r.get('num_reduces', 0)}")
+    print(_fmt_latency("map latency   ", r.get("map_latency") or {}))
+    if r.get("map_latency_tpu"):
+        print(_fmt_latency("  tpu maps    ", r["map_latency_tpu"]))
+    if r.get("map_latency_cpu"):
+        print(_fmt_latency("  cpu maps    ", r["map_latency_cpu"]))
+    print(_fmt_latency("reduce latency", r.get("reduce_latency") or {}))
+    split = r.get("task_time_split") or {}
+    print(f"task time     : tpu {split.get('tpu_map_s', 0):.3f}s / "
+          f"cpu {split.get('cpu_map_s', 0):.3f}s map "
+          f"(tpu {split.get('tpu_fraction_of_map_time', 0):.0%} of map "
+          f"task-time), reduce {split.get('reduce_s', 0):.3f}s")
+    prof = r.get("acceleration_factor_profiled") or 0
+    obs = r.get("acceleration_factor_observed") or 0
+    if prof or obs:
+        print(f"acceleration  : profiled {prof:.2f}x, observed "
+              f"{obs:.2f}x")
+    dropped = r.get("runtime_samples_dropped", 0)
+    if dropped:
+        print(f"(percentiles computed over a capped sample; "
+              f"{dropped} runtimes dropped)")
+    counters = r.get("counters") or {}
+    n = sum(len(v) for v in counters.values())
+    print(f"counters      : {n} across {len(counters)} groups "
+          f"(full dump: tpumr job stats {job_id} -json)")
     return 0
 
 

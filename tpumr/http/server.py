@@ -166,11 +166,21 @@ class StatusHttpServer:
         (``{source: {metric: value}}``) — same payload shape on the
         jobtracker, trackers, and the namenode, so one scraper config
         covers the whole cluster. Also registered at ``/json/metrics``
-        when the daemon didn't already wire it there."""
+        when the daemon didn't already wire it there, and at
+        ``/metrics/prom`` as Prometheus text exposition (v0.0.4) —
+        counters/gauges/histograms from the same typed snapshot, so a
+        stock Prometheus scrapes every daemon with one job config."""
         handler = lambda q: metrics_system.snapshot()  # noqa: E731
         self.add_raw("metrics", handler)
         if "metrics" not in self._handlers:
             self.add_json("metrics", handler)
+
+        def prom(q: dict) -> str:
+            from tpumr.metrics.prometheus import render_exposition
+            return render_exposition(metrics_system.typed_snapshot())
+
+        self.add_raw("metrics/prom", prom,
+                     content_type="text/plain; version=0.0.4")
 
     def add_page(self, path: str, handler: PageHandler,
                  parameterized: bool = False) -> None:

@@ -23,6 +23,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import traceback
 from typing import Any
 
@@ -121,11 +122,15 @@ def _send_frame(sock: socket.socket, obj: Any) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_frame(sock: socket.socket) -> Any:
+def _recv_frame_with_len(sock: socket.socket) -> "tuple[Any, int]":
     (length,) = _LEN.unpack(_read_exact(sock, 4))
     if length > MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
-    return deserialize(_read_exact(sock, length))
+    return deserialize(_read_exact(sock, length)), length
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    return _recv_frame_with_len(sock)[0]
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -151,7 +156,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
         try:
             while True:
-                req = _recv_frame(sock)
+                req, req_len = _recv_frame_with_len(sock)
                 secret = server.secret
                 scope = req.get("scope")
                 # defined for every request path: an UNSECURED server
@@ -277,9 +282,25 @@ class _Handler(socketserver.BaseRequestHandler):
                     # REAL credential behind it
                     _current_user.verified = (server.secret is not None
                                               and verified_user is not None)
+                    # per-method server-side latency + request-size
+                    # distributions (when the owning daemon wired a
+                    # registry). The size comes from the frame length
+                    # the transport ALREADY read — never re-serialized.
+                    # Histogram objects are cached per name, so the hot
+                    # path is one dict hit + one observe each.
+                    _mreg = server.rpc.metrics
+                    _t0 = time.monotonic() if _mreg is not None else 0.0
                     try:
                         resp["result"] = method(*req.get("params", []))
                     finally:
+                        if _mreg is not None:
+                            from tpumr.metrics.histogram import BYTES
+                            _mname = "rpc_" + str(req.get("method", "")) \
+                                .replace(".", "_")
+                            _mreg.histogram(_mname).observe(
+                                time.monotonic() - _t0)
+                            _mreg.histogram(_mname + "_request_bytes",
+                                            BYTES).observe(req_len)
                         _current_user.user = None
                         _current_user.real = None
                         _current_user.scope = None
@@ -339,6 +360,11 @@ class RpcServer:
         #: None (default) rejects every doas frame — impersonation is
         #: strictly opt-in per daemon
         self.proxy_conf: "Any | None" = None
+        #: optional MetricsRegistry: when set, every dispatched method
+        #: records its server-side handler latency into a per-method
+        #: ``rpc_<method>`` histogram (names are bounded by the
+        #: handler's real method surface — lookup precedes timing)
+        self.metrics: "Any | None" = None
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.secret = secret  # type: ignore[attr-defined]
         # expose hooks on the socketserver instance for _Handler

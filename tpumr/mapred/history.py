@@ -119,7 +119,87 @@ class JobHistory:
     def task_event(self, job_id: str, event: str, **fields: Any) -> None:
         self._write(job_id, {"event": event, **fields})
 
+    # ------------------------------------------------------ stats rollup
+
+    def metrics_path(self, job_id: str) -> "str | None":
+        """Where the job's stats rollup lives, next to its event log."""
+        if not self.dir:
+            return None
+        return os.path.join(self.dir, f"metrics-{job_id}.json")
+
+    def write_job_metrics(self, jip: Any) -> "str | None":
+        """One-shot per-job stats rollup written at finalization:
+        counters plus exact latency percentiles and the TPU-vs-CPU
+        task-time split. The machine-readable substrate for ``tpumr job
+        stats`` today and for affinity/critical-path scheduling to mine
+        later — the history event log answers "what happened", this
+        answers "how fast"."""
+        path = self.metrics_path(str(jip.job_id))
+        if path is None:
+            return None
+        os.makedirs(self.dir, exist_ok=True)
+        rollup = job_metrics_rollup(jip)
+        tmp = path + ".tmp"
+        with self._lock:
+            with open(tmp, "w") as f:
+                json.dump(rollup, f, indent=2, default=str)
+            os.replace(tmp, path)   # readers never see a torn rollup
+        return path
+
+    def read_job_metrics(self, job_id: str) -> "dict | None":
+        path = self.metrics_path(job_id)
+        if path is None or not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
     @staticmethod
     def read(path: str) -> list[dict]:
         with open(path) as f:
             return [json.loads(line) for line in f if line.strip()]
+
+
+def job_metrics_rollup(jip: Any) -> dict:
+    """Build the stats rollup from a (terminal) JobInProgress. Exact
+    percentiles — the job kept every successful attempt's runtime — and
+    the task-time split from those same raw samples (NOT the scheduler's
+    profile sums, which deliberately unwind on TPU quarantine)."""
+    from tpumr.metrics.histogram import exact_percentiles
+    with jip.lock:
+        map_rts = list(jip.map_runtimes)
+        reduce_rts = list(jip.reduce_runtimes)
+        dropped = jip.runtimes_dropped
+        counters = jip.counters.to_dict()
+        state = jip.state
+        finish = jip.finish_time
+    tpu = [r for r, on_tpu in map_rts if on_tpu]
+    cpu = [r for r, on_tpu in map_rts if not on_tpu]
+    tpu_s, cpu_s = sum(tpu), sum(cpu)
+    map_task_s = tpu_s + cpu_s
+    observed_accel = ((cpu_s / len(cpu)) / (tpu_s / len(tpu))
+                      if tpu and cpu and tpu_s > 0 else 0.0)
+    return {
+        "job_id": str(jip.job_id),
+        "job_name": str(jip.conf.get("mapred.job.name", "") or ""),
+        "state": state,
+        "wall_time": (finish or time.time()) - jip.start_time,
+        "num_maps": len(jip.maps),
+        "num_reduces": len(jip.reduces),
+        "map_latency": exact_percentiles([r for r, _ in map_rts]),
+        "map_latency_tpu": exact_percentiles(tpu),
+        "map_latency_cpu": exact_percentiles(cpu),
+        "reduce_latency": exact_percentiles(reduce_rts),
+        "task_time_split": {
+            "tpu_map_s": tpu_s,
+            "cpu_map_s": cpu_s,
+            "reduce_s": sum(reduce_rts),
+            "tpu_fraction_of_map_time":
+                tpu_s / map_task_s if map_task_s > 0 else 0.0,
+        },
+        "acceleration_factor_profiled": jip.acceleration_factor(),
+        "acceleration_factor_observed": observed_accel,
+        "finished_tpu_maps": len(tpu),
+        "finished_cpu_maps": len(cpu),
+        "runtime_samples_dropped": dropped,
+        "counters": counters,
+    }

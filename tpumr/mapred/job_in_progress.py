@@ -224,6 +224,14 @@ class JobInProgress:
         #: placement is a first-class metric). Bounded; overflow counted.
         self.placement_series: list = []
         self.placement_dropped = 0
+        #: raw successful-attempt runtimes, kept verbatim for the
+        #: per-job stats rollup (metrics-<jobid>.json): the profile
+        #: sums above are means the SCHEDULER needs (and unwind on
+        #: quarantine); the rollup wants exact percentiles over what
+        #: actually ran, quarantined or not. Bounded; overflow counted.
+        self.map_runtimes: "list[tuple[float, bool]]" = []  # (s, on_tpu)
+        self.reduce_runtimes: "list[float]" = []
+        self.runtimes_dropped = 0
         #: distributed tracing (core/tracing.py): the job's trace id and
         #: the open root span, set by the master at submit for traced
         #: jobs only ("" / None keeps every trace check a cheap miss)
@@ -602,6 +610,8 @@ class JobInProgress:
         if tip.is_map:
             self.finished_maps += 1
             runtime = status.runtime
+            self._record_runtime(runtime, is_map=True,
+                                 on_tpu=bool(status.run_on_tpu))
             if status.run_on_tpu:
                 # post-quarantine TPU completions (in-flight attempts
                 # finishing after tpu_disabled) are excluded from BOTH
@@ -632,10 +642,26 @@ class JobInProgress:
         else:
             self.finished_reduces += 1
             self._reduce_time_sum += status.runtime
+            self._record_runtime(status.runtime, is_map=False)
         if (self.finished_maps == len(self.maps)
                 and self.finished_reduces == len(self.reduces)):
             self.state = JobState.SUCCEEDED
             self.finish_time = time.time()
+
+    _MAX_RUNTIME_SAMPLES = 65536
+
+    def _record_runtime(self, runtime: float, is_map: bool,
+                        on_tpu: bool = False) -> None:
+        """Keep one successful attempt's runtime for the stats rollup
+        (caller holds ``self.lock`` via update_task_status)."""
+        if len(self.map_runtimes) + len(self.reduce_runtimes) \
+                >= self._MAX_RUNTIME_SAMPLES:
+            self.runtimes_dropped += 1
+            return
+        if is_map:
+            self.map_runtimes.append((float(runtime), on_tpu))
+        else:
+            self.reduce_runtimes.append(float(runtime))
 
     def _on_failure(self, tip: TaskInProgress, status: TaskStatus) -> None:
         if tip.state == "succeeded":

@@ -208,6 +208,46 @@ class JobMaster:
             _locked(lambda: sum(
                 len(t.status.get("quarantined_tpu_devices", []) or [])
                 for t in self.trackers.values())))
+        # control-plane latency distributions: heartbeat handling wall
+        # time (hoisted Histogram object — the heartbeat path must not
+        # pay a registry lookup), per-method RPC server latency + wire
+        # request sizes (the heartbeat payload-size series is the rpc
+        # source's rpc_heartbeat_request_bytes — measured from the frame
+        # length the transport already read, never re-serialized), and
+        # scheduler decision timing. These are the series the ROADMAP's
+        # control-plane scale-out work reads first.
+        self._hb_seconds = self._mreg.histogram("heartbeat_seconds")
+        self._server.metrics = self.metrics.new_registry("rpc")
+        self.scheduler.metrics = self.metrics.new_registry("scheduler")
+        # heartbeat-aggregated cluster view: trackers piggyback their
+        # metrics on heartbeats; one scrape of THIS daemon yields
+        # cluster-wide distributions (metrics/cluster.py)
+        from tpumr.metrics.cluster import ClusterAggregator
+        cluster_reg = self.metrics.new_registry("cluster")
+        self.cluster_agg = ClusterAggregator(cluster_reg)
+        cluster_reg.set_gauge("trackers_reporting",
+                              _locked(lambda: len(self.trackers)))
+        # named to match the trackers' own flattened slot_utilization
+        # gauge, so one dashboard query covers the cluster series and
+        # the per-host rows (only the source label differs)
+        for kind in ("cpu", "tpu", "reduce"):
+            cluster_reg.set_gauge(
+                f"slot_utilization_{kind}",
+                (lambda k: _locked(
+                    lambda: self._slot_utilization_locked(k)))(kind))
+        # cluster-wide observed acceleration derived from the MERGED
+        # distributions (global means) — per-tracker ratio gauges can't
+        # be summed, but merged count/sum histograms aggregate exactly
+        _exe = cluster_reg.histogram("tpu_execute_seconds")
+        _cpu = cluster_reg.histogram("tpu_cpu_batch_seconds")
+
+        def _cluster_observed_accel() -> float:
+            if not _exe.count or not _cpu.count or _exe.sum <= 0:
+                return 0.0
+            return (_cpu.sum / _cpu.count) / (_exe.sum / _exe.count)
+
+        cluster_reg.set_gauge("tpu_observed_acceleration",
+                              _cluster_observed_accel)
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)
@@ -536,10 +576,59 @@ class JobMaster:
                  "reduce slots", "tpu devices (●=free ✖=quarantined)",
                  "last heartbeat", "state / health report"], rows)
 
+        def cluster_page(q: dict) -> str:
+            """Heartbeat-aggregated cluster view: what one scrape of the
+            master knows about the whole cluster — slot utilization,
+            merged tracker distributions (shuffle fetch, TPU stage/
+            execute, tracker RPC), and per-tracker gauge rows."""
+            with self.lock:
+                util = {k: self._slot_utilization_locked(k)
+                        for k in ("cpu", "tpu", "reduce")}
+                n_trackers = len(self.trackers)
+            snaps = self.metrics.snapshot()
+            snap = snaps.get("cluster", {})
+            hb = snaps.get("jobtracker", {}).get("heartbeat_seconds", {})
+            rows, hist_rows = [], []
+            for name in sorted(snap):
+                v = snap[name]
+                if isinstance(v, dict) and "p99" in v:
+                    hist_rows.append([
+                        name, f"{v['count']:.0f}",
+                        f"{v['p50']:.4g}", f"{v['p95']:.4g}",
+                        f"{v['p99']:.4g}", f"{v['max']:.4g}"])
+                elif isinstance(v, (int, float)):
+                    rows.append([name, f"{v:.4g}"])
+            parts = [
+                "<h1>Cluster</h1>",
+                f"<p>{n_trackers} trackers reporting · slot utilization "
+                + " · ".join(f"{k} {v:.0%}" for k, v in util.items())
+                + (f" · heartbeat p99 {hb.get('p99', 0):.4g}s over "
+                   f"{hb.get('count', 0):.0f} beats" if hb else "")
+                + "</p>",
+                "<h2>Merged distributions</h2>",
+                html_table(["metric", "count", "p50", "p95", "p99",
+                            "max"], hist_rows)
+                if hist_rows else "<p class='dim'>none yet</p>",
+                "<h2>Merged counters / gauges</h2>",
+                html_table(["metric", "value"], rows)
+                if rows else "<p class='dim'>none yet</p>",
+            ]
+            gauge_rows = self.cluster_agg.gauge_rows()
+            if gauge_rows:
+                keys = sorted({k for g in gauge_rows.values() for k in g})
+                parts.append("<h2>Per-tracker gauges</h2>")
+                parts.append(html_table(
+                    ["tracker"] + keys,
+                    [[t] + [f"{gauge_rows[t].get(k, 0):.4g}"
+                            for k in keys]
+                     for t in sorted(gauge_rows)]))
+            return "".join(parts)
+
         srv.add_page("index", index_page)
         srv.add_page("job", job_page, parameterized=True)
         srv.add_page("trace", trace_page, parameterized=True)
         srv.add_page("trackers", trackers_page)
+        srv.add_page("cluster", cluster_page)
         return srv
 
     @property
@@ -566,6 +655,22 @@ class JobMaster:
                 out["tpu"] += t.status.get("max_tpu_map_slots", 0)
                 out["reduce"] += t.status.get("max_reduce_slots", 0)
             return out
+
+    _SLOT_KEYS = {"cpu": ("count_cpu_map_tasks", "max_cpu_map_slots"),
+                  "tpu": ("count_tpu_map_tasks", "max_tpu_map_slots"),
+                  "reduce": ("count_reduce_tasks", "max_reduce_slots")}
+
+    def _slot_utilization_locked(self, kind: str) -> float:
+        """Cluster-wide busy fraction of one slot pool, from the
+        trackers' last heartbeat statuses (caller holds ``self.lock``).
+        0.0 with no slots of the kind — a present-but-zero series beats
+        a missing one for dashboards on heterogeneous clusters."""
+        busy_key, max_key = self._SLOT_KEYS[kind]
+        busy = total = 0
+        for t in self.trackers.values():
+            busy += int(t.status.get(busy_key, 0))
+            total += int(t.status.get(max_key, 0))
+        return busy / total if total else 0.0
 
     # ------------------------------------------------------------ RPC: jobs
 
@@ -1010,6 +1115,14 @@ class JobMaster:
         try:
             self.history.job_finished(jip)
             self._mreg.incr(f"jobs_{jip.state.lower()}")
+            # per-job stats rollup (metrics-<jobid>.json next to the
+            # history log): counters + latency percentiles + the
+            # TPU/CPU task-time split — what `tpumr job stats` prints
+            # and what a future affinity/critical-path scheduler reads
+            try:
+                self.history.write_job_metrics(jip)
+            except Exception:  # noqa: BLE001 — the rollup is auxiliary;
+                pass           # its I/O must not fail job finalization
         finally:
             if root is not None:
                 # the root span closes with the job and every master
@@ -1121,6 +1234,7 @@ class JobMaster:
                   ask_for_new_task: bool, response_id: int) -> dict:
         name = status["tracker_name"]
         self._mreg.incr("heartbeats")
+        t0 = time.monotonic()
         # history appends + job finalization are file I/O — deferred past
         # the master lock so disk latency never serializes the control
         # plane; task events flush BEFORE finalization so the per-job log
@@ -1144,6 +1258,11 @@ class JobMaster:
                 except Exception:  # noqa: BLE001
                     jip.error = jip.error or "finalization failed"
                     jip.finalized.set()
+            # handling latency INCLUDING the deferred history/finalize
+            # I/O: that work serializes this handler thread (and with it
+            # this tracker's next heartbeat), so it is part of the
+            # latency an operator must see
+            self._hb_seconds.observe(time.monotonic() - t0)
 
     def _heartbeat_locked(self, status: dict, initial_contact: bool,
                           ask_for_new_task: bool, response_id: int,
@@ -1169,6 +1288,10 @@ class JobMaster:
             info.status = status
             info.last_seen = time.time()
             info.seen_mono = time.monotonic()
+            # fold the piggybacked tracker metrics into the cluster
+            # registry — cumulative state, so replayed heartbeats are
+            # idempotent (no seq protocol needed, unlike task statuses)
+            self.cluster_agg.merge(name, status.get("metrics"))
 
             # Fold in task statuses FIRST — even when this turns out to be a
             # replayed heartbeat. The tracker drops terminal statuses after
@@ -1409,6 +1532,7 @@ class JobMaster:
         ≈ JobTracker.lostTaskTracker. Caller holds self.lock."""
         info = self.trackers.pop(name)
         self._last_response.pop(name, None)
+        self.cluster_agg.forget(name)
         attempts = [sd["attempt_id"] for sd in
                     info.status.get("task_statuses", [])]
         addr = (f"{info.status.get('host', '')}:"

@@ -49,6 +49,11 @@ class TaskScheduler:
     def __init__(self) -> None:
         self.manager: TaskTrackerManager | None = None
         self.conf: Any = None
+        #: optional MetricsRegistry wired by the master: scheduling is a
+        #: per-heartbeat decision on the control plane's critical path,
+        #: so its wall time is a first-class distribution
+        #: (``assign_seconds``) and its output a per-backend counter set
+        self.metrics: Any = None
 
     def set_manager(self, manager: TaskTrackerManager) -> None:
         self.manager = manager
@@ -105,6 +110,21 @@ class HybridQueueScheduler(TaskScheduler):
         heartbeat-invariant state here (the order hooks run per free slot)."""
 
     def assign_tasks(self, tts: dict) -> list[Task]:
+        reg = self.metrics
+        if reg is None:
+            return self._assign_tasks(tts)
+        with reg.histogram("assign_seconds").time():
+            assigned = self._assign_tasks(tts)
+        for task in assigned:
+            if not task.is_map:
+                reg.incr("assigned_reduces")
+            elif task.run_on_tpu:
+                reg.incr("assigned_tpu_maps")
+            else:
+                reg.incr("assigned_cpu_maps")
+        return assigned
+
+    def _assign_tasks(self, tts: dict) -> list[Task]:
         assert self.manager is not None
         jobs = [j for j in self.manager.running_jobs()
                 if j.state == JobState.RUNNING]

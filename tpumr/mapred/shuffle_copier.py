@@ -63,6 +63,23 @@ from tpumr.io import ifile
 ChunkFetch = Callable[[int, int, int], dict]
 
 
+def shuffle_metrics():
+    """The process-wide ``shuffle`` metrics source: whole-segment fetch
+    latency and size distributions plus a failure counter, shared by
+    every reduce attempt in this process. Published by whichever tracker
+    claims the source (tasktracker.py); fetch p95 is the series the
+    ROADMAP's shuffle wire-path work regresses against."""
+    from tpumr.metrics.core import process_registry
+    from tpumr.metrics.histogram import BYTES
+    reg = process_registry("shuffle")
+    # names carry the source prefix so a direct tracker scrape and the
+    # master's cluster merge agree on one metric name (the source is a
+    # label on the tracker, "cluster" on the master)
+    reg.histogram("shuffle_fetch_seconds")
+    reg.histogram("shuffle_fetch_bytes", BYTES)
+    return reg
+
+
 class ShuffleRamManager:
     """In-memory shuffle byte budget (≈ ReduceTask.java:1080). Accounting
     is in RAW segment bytes — what actually sits in memory after
@@ -550,9 +567,23 @@ class ShuffleCopier:
 
     def _copy_one(self, map_index: int) -> Segment:
         from tpumr.core import tracing
+        reg = shuffle_metrics()
+        t0 = time.monotonic()
         with tracing.span("shuffle:fetch", map_index=map_index,
                           addr=self._addr_of(map_index)) as s:
-            seg = self._copy_one_inner(map_index)
+            try:
+                seg = self._copy_one_inner(map_index)
+            except Exception:
+                # failed rounds are part of the latency story too — a
+                # fetcher burning 2s per failure against a dead source
+                # shows up in the distribution, not just the counter
+                reg.incr("shuffle_fetch_errors")
+                reg.histogram("shuffle_fetch_seconds").observe(
+                    time.monotonic() - t0)
+                raise
+            reg.histogram("shuffle_fetch_seconds").observe(
+                time.monotonic() - t0)
+            reg.histogram("shuffle_fetch_bytes").observe(seg.raw_length)
             if s is not None:
                 s.set(raw_bytes=seg.raw_length,
                       in_memory=seg.in_memory)

@@ -313,6 +313,27 @@ class NodeRunner:
         self._mreg.set_gauge("slots", lambda: {
             "cpu": self.max_cpu_map_slots, "tpu": self.max_tpu_map_slots,
             "reduce": self.max_reduce_slots})
+        # per-pool busy fractions: the device-utilization signal the
+        # hybrid/job-driven scheduling work consumes (PAPERS.md), and
+        # the per-tracker rows behind the master's cluster view
+        self._mreg.set_gauge("slot_utilization", self._slot_utilization)
+        # RPC server-side latency per method — the tracker's RPC surface
+        # IS the shuffle server (get_map_output_chunk) + the umbilical
+        self._server.metrics = self.metrics.new_registry("rpc")
+        # claim the process-wide data-plane registries (shuffle fetch,
+        # TPU runner) for publication: exactly one co-located tracker
+        # may publish each, or the master would double-merge increments
+        from tpumr.metrics.core import claim_process_registry
+        self._claimed_sources: list[str] = []
+        from tpumr.mapred import shuffle_copier as _sc  # registers hists
+        from tpumr.mapred import tpu_runner as _tr
+        _sc.shuffle_metrics()
+        _tr.runner_metrics()
+        for src in ("shuffle", "tpu"):
+            reg = claim_process_registry(src, self.name)
+            if reg is not None:
+                self.metrics.register(reg)
+                self._claimed_sources.append(src)
         #: shuffle merge-engine totals across this tracker's finished
         #: attempts (uniform /metrics surface for the in-memory merges,
         #: bounded-fan-in passes, and segment placement)
@@ -515,6 +536,9 @@ class NodeRunner:
     def stop(self) -> None:
         self._stop.set()
         self.metrics.stop()
+        from tpumr.metrics.core import release_process_registry
+        for src in self._claimed_sources:
+            release_process_registry(src, self.name)
         if self.tracer is not None:
             self.tracer.flush()
         with self.lock:
@@ -535,6 +559,20 @@ class NodeRunner:
         return self._server.port
 
     # ------------------------------------------------------------ status
+
+    def _slot_utilization(self) -> dict:
+        """Busy fraction per slot pool (0.0 when the pool is absent —
+        a present-but-zero series beats a missing one)."""
+        with self.lock:
+            cpu, tpu, red = self._counts()
+        return {
+            "cpu": cpu / self.max_cpu_map_slots
+            if self.max_cpu_map_slots else 0.0,
+            "tpu": tpu / self.max_tpu_map_slots
+            if self.max_tpu_map_slots else 0.0,
+            "reduce": red / self.max_reduce_slots
+            if self.max_reduce_slots else 0.0,
+        }
 
     def _counts(self) -> tuple[int, int, int]:
         cpu = tpu = red = 0
@@ -643,8 +681,33 @@ class NodeRunner:
                 pass
             self._stop.wait(self.heartbeat_s)
 
+    def _metrics_piggyback(self) -> dict:
+        """The compact metrics snapshot that rides every heartbeat:
+        cumulative counters + cumulative sparse histogram state + numeric
+        gauges, per source. Cumulative (not delta) on purpose — replayed
+        heartbeats merge idempotently master-side (metrics/cluster.py).
+        The tracker's own per-instance source name is normalized to
+        ``tasktracker`` so cluster metric names don't embed instance
+        names."""
+        out: dict[str, dict] = {}
+        for src, t in self.metrics.typed_snapshot().items():
+            name = "tasktracker" if src == self.name else src
+            counters = {k: v for k, v in (t.get("counters") or {}).items()
+                        if isinstance(v, (int, float))}
+            gauges = {k: v for k, v in (t.get("gauges") or {}).items()
+                      if isinstance(v, (int, float, dict))}
+            hists = t.get("histograms") or {}
+            if counters or gauges or hists:
+                out[name] = {"counters": counters, "gauges": gauges,
+                             "histograms": hists}
+        return out
+
     def _heartbeat_once(self) -> None:
         status = self._status_dict()
+        try:
+            status["metrics"] = self._metrics_piggyback()
+        except Exception:  # noqa: BLE001 — metering must not break
+            pass           # the heartbeat lease
         cpu, tpu, red = (status["count_cpu_map_tasks"],
                          status["count_tpu_map_tasks"],
                          status["count_reduce_tasks"])

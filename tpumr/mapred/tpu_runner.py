@@ -96,6 +96,32 @@ class HbmSplitCache:
 _split_caches: dict[str, HbmSplitCache] = {}
 _cache_lock = threading.Lock()
 
+
+def runner_metrics():
+    """The process-wide ``tpu`` metrics source: stage (host→device) and
+    execute wall-time distributions for the device path, the CPU batch
+    runner's twin, and a ``tpu_observed_acceleration`` gauge — measured
+    mean CPU-batch time over mean TPU-execute time, sitting next to the
+    per-job PROFILED factor the scheduler derives from whole-task
+    runtimes (job status ``acceleration_factor``). The two disagreeing
+    is signal: profiled includes staging + per-task overhead, observed
+    is pure kernel wall time."""
+    from tpumr.metrics.core import process_registry
+    reg = process_registry("tpu")
+    reg.histogram("tpu_stage_seconds")
+    execute = reg.histogram("tpu_execute_seconds")
+    cpu = reg.histogram("tpu_cpu_batch_seconds")
+
+    def _observed() -> float:
+        if not execute.count or not cpu.count:
+            return 0.0
+        tpu_mean = execute.sum / execute.count
+        cpu_mean = cpu.sum / cpu.count
+        return cpu_mean / tpu_mean if tpu_mean > 0 else 0.0
+
+    reg.set_gauge("tpu_observed_acceleration", _observed)
+    return reg
+
 #: (kernel, input signature) pairs this process has dispatched before —
 #: the trace's compile-cache attribute: a first dispatch ("cold") pays
 #: XLA compilation or a persistent-cache load (parallel/jaxruntime.py);
@@ -187,6 +213,7 @@ class TpuMapRunner(MapRunnable):
         # many-task batched transfer — only the drain remains
         from tpumr.core import tracing
 
+        mreg = runner_metrics()
         pre = getattr(task_ctx, "_device_prefetch", None) if task_ctx else None
         if pre is not None:
             if pre.device_rows is not None:
@@ -204,9 +231,11 @@ class TpuMapRunner(MapRunnable):
             with tracing.span("tpu:window_drain", backend="tpu",
                               records=pre.num_records,
                               staged_bytes=pre.staged_bytes):
-                for key, value in kernel.map_batch_drain(pre.fetched, conf,
-                                                         task_ctx):
-                    output.collect(key, value)
+                with mreg.histogram("tpu_window_drain_seconds").time():
+                    for key, value in kernel.map_batch_drain(pre.fetched,
+                                                             conf,
+                                                             task_ctx):
+                        output.collect(key, value)
             reporter.set_status(
                 f"kernel {name} (pipelined window): {pre.num_records} "
                 f"records, drained in {time.time() - t0:.3f}s")
@@ -219,8 +248,9 @@ class TpuMapRunner(MapRunnable):
         with tracing.span("tpu:stage", backend="tpu",
                           device=str(device)) as st:
             try:
-                batch, counted_by_reader, staged_bytes = stage_batch(
-                    self.conf, reader, task_ctx, device)
+                with mreg.histogram("tpu_stage_seconds").time():
+                    batch, counted_by_reader, staged_bytes = stage_batch(
+                        self.conf, reader, task_ctx, device)
             except Exception as e:  # noqa: BLE001 — classify at the site
                 from tpumr.mapred.task import (classify_accelerator_exception,
                                                tag_failure)
@@ -244,7 +274,8 @@ class TpuMapRunner(MapRunnable):
         t0 = time.time()
         temperature = _compile_temperature(name, batch)
         try:
-            with jax.default_device(device):
+            with mreg.histogram("tpu_execute_seconds").time(), \
+                    jax.default_device(device):
                 with tracing.span("tpu:execute", backend="tpu",
                                   kernel=name, device=str(device)) as ex:
                     if ex is not None:
@@ -498,8 +529,9 @@ class CpuBatchMapRunner(MapRunnable):
         reporter.incr_counter(BackendCounter.GROUP,
                               BackendCounter.CPU_BATCH_MAP_TASKS)
         t0 = time.time()
-        for key, value in kernel.map_batch_cpu(batch, conf, task_ctx):
-            output.collect(key, value)
+        with runner_metrics().histogram("tpu_cpu_batch_seconds").time():
+            for key, value in kernel.map_batch_cpu(batch, conf, task_ctx):
+                output.collect(key, value)
         reporter.set_status(
             f"cpu-batch kernel {kernel.name}: "
             f"{getattr(batch, 'num_records', 0)} records in "

@@ -12,6 +12,11 @@ the reference's GPU observability was log-grep only (SURVEY.md §5).
 from tpumr.metrics.core import (FileSink, MetricsRegistry, MetricsSystem,
                                 UdpSink, sinks_from_conf,
                                 MetricsSink)
+from tpumr.metrics.histogram import (BYTES, SECONDS, Histogram, Timer,
+                                     exact_percentiles, exponential_bounds)
+from tpumr.metrics.prometheus import render_exposition, validate_exposition
 
-__all__ = ["FileSink", "MetricsRegistry", "MetricsSink", "MetricsSystem",
-           "UdpSink", "sinks_from_conf"]
+__all__ = ["BYTES", "FileSink", "Histogram", "MetricsRegistry",
+           "MetricsSink", "MetricsSystem", "SECONDS", "Timer", "UdpSink",
+           "exact_percentiles", "exponential_bounds", "render_exposition",
+           "sinks_from_conf", "validate_exposition"]

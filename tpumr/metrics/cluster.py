@@ -1,0 +1,128 @@
+"""Heartbeat-aggregated cluster metrics — the master-side merge.
+
+Trackers piggyback a compact snapshot of their MetricsSystem on every
+heartbeat (``NodeRunner._metrics_piggyback``): cumulative counter values
+and cumulative histogram bucket state, numeric gauges by value. The
+master folds each tracker's piggyback into ONE ``cluster`` registry, so
+a single scrape of the master's ``/metrics/prom`` yields cluster-wide
+series (TPU utilization, shuffle fetch percentiles, demotion totals)
+without a per-tracker scrape fleet — the Hadoop-era answer was "run
+Ganglia next to the cluster"; here the control plane already carries a
+periodic all-trackers RPC, so the aggregation rides it.
+
+Cumulative-state-with-derived-increments (not sender-side deltas) is
+deliberate: heartbeats are retried and replayed (response-id protocol),
+and re-applying a cumulative snapshot is idempotent where re-applying a
+delta double-counts. A tracker restart shows as shrunk cumulative values
+and is folded as a fresh baseline.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from tpumr.metrics.core import MetricsRegistry
+from tpumr.metrics.histogram import typed_delta
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+class ClusterAggregator:
+    """Folds per-tracker metric piggybacks into a shared registry.
+
+    Metric naming: the tracker's own source arrives pre-renamed to
+    ``tasktracker`` (tracker instance names would explode the cluster
+    namespace); other sources prefix their metrics (``shuffle`` →
+    ``shuffle_fetch_seconds``) unless the metric already carries the
+    prefix (the ``rpc`` source's ``rpc_*`` histograms).
+    """
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+        self._lock = threading.Lock()
+        #: tracker -> {("c", key): value, ("h", key): typed} baselines
+        self._prev: dict[str, dict] = {}
+        #: tracker -> {key: value} last-reported numeric gauges
+        self._gauges: dict[str, dict[str, float]] = {}
+
+    @staticmethod
+    def _key(source: str, name: str) -> str:
+        if source == "tasktracker" or name.startswith(source + "_"):
+            return name
+        return f"{source}_{name}"
+
+    def merge(self, tracker: str, piggyback: "dict | None") -> None:
+        """Fold one tracker's heartbeat piggyback. Idempotent per
+        snapshot; malformed payloads are dropped whole (a tracker on a
+        newer/older build must not corrupt the cluster registry)."""
+        if not isinstance(piggyback, dict) or not piggyback:
+            return
+        try:
+            self._merge(tracker, piggyback)
+        except Exception:  # noqa: BLE001 — observability must not
+            pass           # break heartbeats
+
+    def _merge(self, tracker: str, piggyback: dict) -> None:
+        gauges_out: dict[str, float] = {}
+        with self._lock:
+            prev = self._prev.setdefault(tracker, {})
+            for source in sorted(piggyback):
+                t = piggyback[source]
+                if not isinstance(t, dict):
+                    continue
+                for name, v in (t.get("counters") or {}).items():
+                    if not _is_num(v):
+                        continue
+                    key = self._key(source, name)
+                    base = prev.get(("c", key), 0)
+                    inc = v - base if v >= base else v  # restart: re-base
+                    prev[("c", key)] = v
+                    if inc > 0:
+                        self.registry.incr(key, inc)
+                for name, h in (t.get("histograms") or {}).items():
+                    if not isinstance(h, dict):
+                        continue
+                    key = self._key(source, name)
+                    delta = typed_delta(h, prev.get(("h", key)))
+                    prev[("h", key)] = h
+                    if delta:
+                        self.registry.histogram(
+                            key, delta.get("bounds") or None
+                        ).merge_typed(delta)
+                for name, v in (t.get("gauges") or {}).items():
+                    key = self._key(source, name)
+                    if _is_num(v):
+                        gauges_out[key] = float(v)
+                    elif isinstance(v, dict):
+                        for k, sub in v.items():
+                            if _is_num(sub):
+                                gauges_out[f"{key}_{k}"] = float(sub)
+            self._gauges[tracker] = gauges_out
+
+    def forget(self, tracker: str) -> None:
+        """Evicted/expired tracker: drop its baselines and gauge rows
+        (already-merged counter/histogram increments stay — they
+        happened)."""
+        with self._lock:
+            self._prev.pop(tracker, None)
+            self._gauges.pop(tracker, None)
+
+    def gauge_rows(self) -> "dict[str, dict[str, float]]":
+        """Per-tracker last-reported numeric gauges (the /cluster page's
+        tracker table)."""
+        with self._lock:
+            return {t: dict(g) for t, g in self._gauges.items()}
+
+    def gauge_totals(self) -> "dict[str, float]":
+        """Summed numeric gauges across live trackers — right for
+        count-like gauges (running tasks, quarantined devices); ratio
+        gauges are recomputed master-side from slot totals instead."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for g in self._gauges.values():
+                for k, v in g.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out

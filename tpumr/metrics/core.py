@@ -10,17 +10,25 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Any, Callable, Protocol
+from typing import Any, Callable, Protocol, Sequence
+
+from tpumr.metrics.histogram import Histogram
+
+#: counter bumped (in the registry owning the gauge) when a gauge
+#: callable raises at sample time — the failure is counted, never
+#: snapshotted as a poison string that numeric sinks must dodge
+GAUGE_ERRORS = "metrics_gauge_errors"
 
 
 class MetricsRegistry:
-    """Thread-safe named counters + gauges for one source."""
+    """Thread-safe named counters + gauges + histograms for one source."""
 
     def __init__(self, name: str) -> None:
         self.name = name
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, Callable[[], Any]] = {}
+        self._histograms: dict[str, Histogram] = {}
 
     def incr(self, name: str, amount: float = 1) -> None:
         with self._lock:
@@ -32,20 +40,104 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = fn
 
+    def histogram(self, name: str,
+                  bounds: "Sequence[float] | None" = None) -> Histogram:
+        """Get-or-create the named distribution (callers at hot sites
+        hoist the returned object; lookups here stay cheap for the lazy
+        per-method RPC path). ``bounds`` only applies on creation."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, bounds)
+            return h
+
+    def _sample_gauges(self, gauges: "list[tuple[str, Any]]",
+                       out: dict, counters: dict) -> None:
+        errors = 0
+        for name, fn in gauges:
+            try:
+                out[name] = fn()
+            except Exception:  # a broken gauge must not kill publish —
+                errors += 1    # counted, not snapshotted as a string
+        if errors:
+            self.incr(GAUGE_ERRORS, errors)
+            with self._lock:   # surface the bump in THIS snapshot too
+                counters[GAUGE_ERRORS] = self._counters[GAUGE_ERRORS]
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             out: dict[str, Any] = dict(self._counters)
             gauges = list(self._gauges.items())
-        for name, fn in gauges:
-            try:
-                out[name] = fn()
-            except Exception as e:  # a broken gauge must not kill publish
-                out[name] = f"<error: {e}>"
+            hists = list(self._histograms.items())
+        self._sample_gauges(gauges, out, out)
+        for name, h in hists:
+            out[name] = h.snapshot()
         return out
+
+    def typed_snapshot(self) -> dict[str, dict]:
+        """Kind-separated view so sinks can tell counters from gauges
+        from distributions: ``{"counters": {...}, "gauges": {...},
+        "histograms": {name: typed}}``. Histograms ride in their full
+        typed (bucketed, mergeable) form."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        sampled: dict[str, Any] = {}
+        self._sample_gauges(gauges, sampled, counters)
+        return {"counters": counters, "gauges": sampled,
+                "histograms": {name: h.typed() for name, h in hists}}
 
 
 class MetricsSink(Protocol):
     def put_metrics(self, record: dict) -> None: ...
+
+
+# ---------------------------------------------------------------- process
+# Data-plane instrumentation sites (shuffle fetchers, the TPU runner)
+# live far below any daemon object, so their registries are process-wide
+# singletons. A daemon CLAIMS a registry to publish it: exactly one
+# MetricsSystem per process may own each source — co-located trackers
+# (mini clusters) would otherwise each piggyback the same process-wide
+# cumulative values and the master would double-count the increments.
+
+_process_registries: dict[str, MetricsRegistry] = {}
+_process_claims: dict[str, str] = {}
+_process_lock = threading.Lock()
+
+
+def process_registry(name: str) -> MetricsRegistry:
+    """The process-wide registry for ``name`` (created on first use) —
+    instrumentation sites call this; claiming is the publisher's job."""
+    with _process_lock:
+        reg = _process_registries.get(name)
+        if reg is None:
+            reg = _process_registries[name] = MetricsRegistry(name)
+        return reg
+
+
+def claim_process_registry(name: str,
+                           owner: str) -> "MetricsRegistry | None":
+    """Claim ``name`` for publication by ``owner`` (idempotent per
+    owner). Returns the registry, or None when another live owner in
+    this process already publishes it."""
+    with _process_lock:
+        holder = _process_claims.get(name)
+        if holder is not None and holder != owner:
+            return None
+        _process_claims[name] = owner
+        reg = _process_registries.get(name)
+        if reg is None:
+            reg = _process_registries[name] = MetricsRegistry(name)
+        return reg
+
+
+def release_process_registry(name: str, owner: str) -> None:
+    """Drop ``owner``'s claim (daemon shutdown) so a later daemon in the
+    same process can publish the source."""
+    with _process_lock:
+        if _process_claims.get(name) == owner:
+            del _process_claims[name]
 
 
 class FileSink:
@@ -62,6 +154,11 @@ class FileSink:
         self.path = path
         self._lock = threading.Lock()
         self._seq = 0
+        #: one append handle for the sink's lifetime, flushed per record
+        #: — reopening per publish cost an open/close syscall pair every
+        #: period on every daemon and made each record a separate dentry
+        #: walk; flush (not just close) is what readers actually need
+        self._f: Any = None
         import socket
         self._host = socket.gethostname()
 
@@ -69,8 +166,16 @@ class FileSink:
         with self._lock:
             self._seq += 1
             stamped = {**record, "host": self._host, "seq": self._seq}
-            with open(self.path, "a") as f:
-                f.write(json.dumps(stamped) + "\n")
+            if self._f is None:
+                self._f = open(self.path, "a")
+            self._f.write(json.dumps(stamped) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
 
 class UdpSink:
@@ -158,6 +263,13 @@ class MetricsSystem:
             sources = list(self._sources.items())
         return {name: reg.snapshot() for name, reg in sources}
 
+    def typed_snapshot(self) -> dict[str, dict]:
+        """Every source's kind-separated snapshot — the input shape the
+        Prometheus renderer and the heartbeat cluster merge consume."""
+        with self._lock:
+            sources = list(self._sources.items())
+        return {name: reg.typed_snapshot() for name, reg in sources}
+
     # ------------------------------------------------------------ publish
 
     def start(self) -> "MetricsSystem":
@@ -170,12 +282,28 @@ class MetricsSystem:
 
     def stop(self) -> None:
         self._stop.set()
+        # join the publish thread so stop() means STOPPED: an orphaned
+        # loop mid-publish could interleave with (or outlive) the final
+        # flush below and write to sinks the caller is about to close.
+        # Bounded join — a sink wedged in I/O must not hang daemon
+        # shutdown (the thread is a daemon thread either way).
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
         with self._lock:
-            has_sinks = bool(self._sinks)
-        if has_sinks:
+            sinks = list(self._sinks)
+        if sinks:
             # final flush so counters bumped since the last period aren't
             # lost (the reference MetricsSystemImpl flushes on stop)
             self.publish_once()
+        for sink in sinks:
+            close = getattr(sink, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — shutdown best-effort
+                    pass
 
     def publish_once(self) -> None:
         record = {"prefix": self.prefix, "ts": time.time(),

@@ -1,0 +1,239 @@
+"""Typed latency/size distributions for the metrics registry.
+
+≈ the metrics2 ``MutableQuantiles``/``MutableStat`` role (reference:
+metrics2/lib/MutableQuantiles.java — sampled estimation over a rolling
+window), re-designed as fixed exponential-bucket histograms: constant
+memory, lock-held O(1) observe, mergeable across processes (bucket
+counts add), and directly renderable as Prometheus cumulative-``le``
+``_bucket`` series. The paper's hybrid scheduler is profiling-driven;
+means hide exactly the tail behavior placement decisions need
+(PAPERS.md "It's the Critical Path!"), so distributions — not flat
+counters — are the unit of measurement here.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Sequence
+
+
+def exponential_bounds(base: float, factor: float, count: int) -> "tuple[float, ...]":
+    """``count`` upper bounds: base, base*factor, … (the +Inf bucket is
+    implicit — every histogram has ``count + 1`` counters)."""
+    if base <= 0 or factor <= 1 or count < 1:
+        raise ValueError(f"invalid bucket spec ({base}, {factor}, {count})")
+    out, b = [], float(base)
+    for _ in range(count):
+        out.append(b)
+        b *= factor
+    return tuple(out)
+
+
+#: default ladder for wall-time observations: 100µs … ~1.7 hours at
+#: factor 2 — heartbeat handling, RPC dispatch, shuffle fetches, and
+#: whole-task runtimes all land inside it with <2x relative error
+SECONDS = exponential_bounds(1e-4, 2.0, 26)
+
+#: default ladder for payload/transfer sizes: 64 B … ~4 GiB at factor 4
+BYTES = exponential_bounds(64, 4.0, 13)
+
+
+class Histogram:
+    """Thread-safe exponential-bucket histogram with count/sum/min/max
+    and interpolated percentile estimation.
+
+    Estimation error is bounded by the bucket ratio (``factor``): a
+    reported p99 is within one bucket of the true value — plenty for
+    "did heartbeat p99 regress 10x", useless noise for "did it regress
+    3%", which is the honest trade fixed buckets make.
+    """
+
+    __slots__ = ("name", "bounds", "_counts", "count", "sum",
+                 "min", "max", "_lock")
+
+    def __init__(self, name: str,
+                 bounds: "Sequence[float] | None" = None) -> None:
+        self.name = name
+        self.bounds: "tuple[float, ...]" = tuple(bounds) if bounds \
+            else SECONDS
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError("histogram bounds must be strictly increasing")
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if self.count == 1 or v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def time(self) -> "Timer":
+        """``with hist.time(): ...`` — observe the block's wall time."""
+        return Timer(self)
+
+    # -------------------------------------------------------- read side
+
+    def _state(self) -> tuple:
+        with self._lock:
+            return (list(self._counts), self.count, self.sum,
+                    self.min, self.max)
+
+    def percentile(self, q: float, counts: "list[int] | None" = None,
+                   count: "int | None" = None) -> float:
+        """Estimated q-quantile (q in [0, 1]) by linear interpolation
+        inside the bucket holding the target rank; the +Inf bucket
+        reports the observed max (the only honest bound we have)."""
+        if counts is None or count is None:
+            counts, count, _s, _mn, _mx = self._state()
+        if count == 0:
+            return 0.0
+        rank = q * count
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                if i >= len(self.bounds):
+                    return self.max
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            seen += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        """Flat summary for the long-standing ``/metrics`` JSON surface
+        (dict-valued like the existing composite gauges)."""
+        counts, count, total, mn, mx = self._state()
+        if count == 0:
+            return {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0,
+                    "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        return {
+            "count": count, "sum": total, "mean": total / count,
+            "min": mn, "max": mx,
+            "p50": self.percentile(0.50, counts, count),
+            "p95": self.percentile(0.95, counts, count),
+            "p99": self.percentile(0.99, counts, count),
+        }
+
+    def typed(self) -> dict:
+        """Full typed form: sparse cumulative state for sinks that can
+        use the distribution itself (Prometheus exposition, the
+        heartbeat cluster merge). ``buckets`` is sparse {index: count}
+        over ``bounds`` plus index len(bounds) for +Inf — compact on the
+        wire, mergeable by addition."""
+        counts, count, total, mn, mx = self._state()
+        return {
+            "bounds": list(self.bounds),
+            "buckets": {i: c for i, c in enumerate(counts) if c},
+            "count": count, "sum": total, "min": mn, "max": mx,
+        }
+
+    def merge_typed(self, delta: dict) -> None:
+        """Fold another histogram's (partial) typed state into this one
+        — the master-side cluster merge. Bucket ladders must match;
+        mismatched deltas are dropped (a tracker running older code must
+        not corrupt the cluster distribution)."""
+        if list(delta.get("bounds", [])) != list(self.bounds):
+            return
+        count = int(delta.get("count", 0))
+        if count <= 0:
+            return
+        with self._lock:
+            for i, c in (delta.get("buckets") or {}).items():
+                i = int(i)
+                if 0 <= i < len(self._counts):
+                    self._counts[i] += int(c)
+            first = self.count == 0
+            self.count += count
+            self.sum += float(delta.get("sum", 0.0))
+            dmin = float(delta.get("min", 0.0))
+            dmax = float(delta.get("max", 0.0))
+            if first or dmin < self.min:
+                self.min = dmin
+            if dmax > self.max:
+                self.max = dmax
+
+
+def typed_delta(cur: dict, prev: "dict | None") -> "dict | None":
+    """The increment between two cumulative ``Histogram.typed()`` states
+    of the SAME histogram (the heartbeat cluster merge: trackers ship
+    cumulative state — idempotent under replays — and the master derives
+    increments). A shrunk count or changed ladder means the source
+    restarted: the full current state is the delta. None = nothing new."""
+    if not cur or not cur.get("count"):
+        return None
+    if prev is None or prev.get("count", 0) > cur["count"] \
+            or list(prev.get("bounds", [])) != list(cur.get("bounds", [])):
+        return cur
+    count = cur["count"] - prev["count"]
+    if count <= 0:
+        return None
+    pb = prev.get("buckets") or {}
+    buckets = {}
+    for i, c in (cur.get("buckets") or {}).items():
+        d = int(c) - int(pb.get(i, pb.get(str(i), 0)))
+        if d > 0:
+            buckets[i] = d
+    return {"bounds": list(cur.get("bounds", [])), "buckets": buckets,
+            "count": count,
+            "sum": float(cur.get("sum", 0.0)) - float(prev.get("sum", 0.0)),
+            # cumulative extrema are correct merge inputs: the cluster
+            # min/max folds of per-tracker lifetime min/max
+            "min": cur.get("min", 0.0), "max": cur.get("max", 0.0)}
+
+
+class Timer:
+    """Context manager observing a block's wall time into a histogram.
+    Monotonic clock — an NTP step mid-block must not record a negative
+    (or hour-long) latency. Exceptions still observe: a failing RPC's
+    latency is data, not noise."""
+
+    __slots__ = ("hist", "_t0", "elapsed")
+
+    def __init__(self, hist: Histogram) -> None:
+        self.hist = hist
+        self._t0 = 0.0
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.elapsed = time.monotonic() - self._t0
+        self.hist.observe(self.elapsed)
+
+
+def exact_percentiles(values: "Sequence[float]",
+                      qs: "Sequence[float]" = (0.50, 0.95, 0.99)) -> dict:
+    """Exact quantiles of a finished sample (the per-job rollup path —
+    the job kept every task runtime, so no estimation is needed).
+    Nearest-rank on the sorted sample; {} for an empty one."""
+    if not values:
+        return {}
+    import math
+    s = sorted(float(v) for v in values)
+    out = {}
+    for q in qs:
+        # nearest-rank: the smallest value with at least q of the sample
+        # at or below it
+        idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+        out[f"p{int(q * 100)}"] = s[idx]
+    out["count"] = len(s)
+    out["mean"] = sum(s) / len(s)
+    out["max"] = s[-1]
+    return out

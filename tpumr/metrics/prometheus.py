@@ -1,0 +1,237 @@
+"""Prometheus text exposition (format v0.0.4) over typed snapshots.
+
+The scraper-facing twin of the JSON ``/metrics`` surface: every daemon
+serves ``/metrics/prom`` rendering its MetricsSystem's typed snapshot —
+counters as ``counter``, numeric gauges as ``gauge`` (one level of
+dict-valued composite gauges is flattened to ``name_key``), histograms
+as cumulative-``le`` ``_bucket``/``_sum``/``_count`` series. Sources
+become a ``{source="..."}`` label so one metric name aggregates across
+registries (and, on the master, across the heartbeat-merged ``cluster``
+source). Non-numeric gauge values are skipped — the exposition format
+has no place for them, and the registry already counts gauge failures
+instead of snapshotting poison strings.
+
+``validate_exposition`` is the in-repo format checker the tests and the
+CI e2e run against scraped bodies, so a renderer regression fails a
+test — not a production Prometheus.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: default metric-name namespace — fixed across daemon types so one
+#: dashboard query covers JT, trackers, and the namenode (the daemon
+#: identity is the scrape target / instance label, not the name)
+NAMESPACE = "tpumr"
+
+
+def sanitize_name(name: str) -> str:
+    """Metric-name charset enforcement: every illegal char becomes
+    ``_`` (dots in RPC method names, dashes in tracker names)."""
+    out = _SANITIZE.sub("_", str(name))
+    if not out or not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _flatten_gauges(gauges: dict) -> "dict[str, float]":
+    """Numeric gauges, with one level of dict-valued composites
+    flattened (``slots`` -> ``slots_cpu`` …); everything else skipped."""
+    out: dict[str, float] = {}
+    for name, v in gauges.items():
+        if _is_num(v):
+            out[name] = float(v)
+        elif isinstance(v, bool):
+            out[name] = float(v)
+        elif isinstance(v, dict):
+            for k, sub in v.items():
+                if _is_num(sub):
+                    out[f"{name}_{k}"] = float(sub)
+    return out
+
+
+def render_exposition(typed_snapshot: "dict[str, dict]",
+                      namespace: str = NAMESPACE) -> str:
+    """Render ``MetricsSystem.typed_snapshot()`` as exposition text.
+
+    Metric families are grouped across sources: the same metric name in
+    two registries becomes one ``# TYPE`` block with two ``source``-
+    labeled samples. A name claimed with conflicting kinds is qualified
+    by its source instead — a valid exposition beats a pretty one.
+    """
+    # family name -> (kind, [(source, payload)])
+    families: "dict[str, tuple[str, list]]" = {}
+
+    def claim(name: str, kind: str, source: str, payload: Any) -> None:
+        full = f"{namespace}_{sanitize_name(name)}"
+        if full in families and families[full][0] != kind:
+            full = f"{namespace}_{sanitize_name(source)}_" \
+                   f"{sanitize_name(name)}"
+            if full in families and families[full][0] != kind:
+                return  # still conflicting: drop rather than corrupt
+        families.setdefault(full, (kind, []))[1].append((source, payload))
+
+    for source in sorted(typed_snapshot):
+        t = typed_snapshot[source] or {}
+        for name, v in sorted((t.get("counters") or {}).items()):
+            if _is_num(v):
+                claim(name, "counter", source, float(v))
+        for name, v in sorted(_flatten_gauges(
+                t.get("gauges") or {}).items()):
+            claim(name, "gauge", source, v)
+        for name, h in sorted((t.get("histograms") or {}).items()):
+            claim(name, "histogram", source, h)
+
+    lines: list[str] = []
+    for full in sorted(families):
+        kind, samples = families[full]
+        lines.append(f"# HELP {full} tpumr metric {full}")
+        lines.append(f"# TYPE {full} {kind}")
+        for source, payload in samples:
+            label = f'source="{_escape_label(source)}"'
+            if kind != "histogram":
+                lines.append(f"{full}{{{label}}} {_fmt(payload)}")
+                continue
+            bounds = list(payload.get("bounds") or [])
+            sparse = payload.get("buckets") or {}
+            counts = [0] * (len(bounds) + 1)
+            for i, c in sparse.items():
+                i = int(i)
+                if 0 <= i < len(counts):
+                    counts[i] = int(c)
+            cum = 0
+            for i, bound in enumerate(bounds):
+                cum += counts[i]
+                lines.append(f"{full}_bucket{{{label},"
+                             f'le="{_fmt(bound)}"}} {cum}')
+            total = int(payload.get("count", cum + counts[-1]))
+            lines.append(f'{full}_bucket{{{label},le="+Inf"}} {total}')
+            lines.append(f"{full}_sum{{{label}}} "
+                         f"{_fmt(payload.get('sum', 0.0))}")
+            lines.append(f"{full}_count{{{label}}} {total}")
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------- validator
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
+    r"(?:\{(.*)\})?"                          # optional label set
+    r" (-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)|NaN|[+-]Inf)"
+    r"(?: -?[0-9]+)?$")                       # optional timestamp
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_KINDS = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def validate_exposition(text: str) -> None:
+    """Raise ``ValueError`` on the first format violation. Checks the
+    contract a real Prometheus scrape depends on: parseable samples,
+    legal names, TYPE-before-samples, one TYPE per family, and for
+    histograms cumulative (non-decreasing) ``le`` buckets ending in a
+    ``+Inf`` bucket that equals ``_count``."""
+    types: dict[str, str] = {}
+    seen_samples: set[str] = set()
+    # (family, labelset-ex-le) -> [(le, value)] in line order
+    hist_buckets: dict[tuple, list] = {}
+    hist_counts: dict[tuple, float] = {}
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                return base
+        return name
+
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue  # free-form comment — legal
+            name = parts[2]
+            if not _NAME_OK.match(name):
+                raise ValueError(f"line {ln}: illegal metric name "
+                                 f"{name!r} in {parts[1]}")
+            if parts[1] == "TYPE":
+                kind = parts[3].strip() if len(parts) > 3 else ""
+                if kind not in _KINDS:
+                    raise ValueError(f"line {ln}: unknown TYPE {kind!r}")
+                if name in types:
+                    raise ValueError(f"line {ln}: duplicate TYPE for "
+                                     f"{name}")
+                if name in seen_samples:
+                    raise ValueError(f"line {ln}: TYPE for {name} after "
+                                     f"its samples")
+                types[name] = kind
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"line {ln}: unparseable sample {line!r}")
+        name, labels_raw, value = m.group(1), m.group(2) or "", m.group(3)
+        labels = dict(_LABEL.findall(labels_raw))
+        if labels_raw and _LABEL.sub("", labels_raw).strip(", ") != "":
+            raise ValueError(f"line {ln}: malformed labels {labels_raw!r}")
+        family = family_of(name)
+        if family not in types:
+            raise ValueError(f"line {ln}: sample {name} has no # TYPE")
+        seen_samples.add(family)
+        if types[family] == "histogram":
+            key = (family, tuple(sorted((k, v) for k, v in labels.items()
+                                        if k != "le")))
+            if name == f"{family}_bucket":
+                if "le" not in labels:
+                    raise ValueError(f"line {ln}: {name} without le label")
+                hist_buckets.setdefault(key, []).append(
+                    (labels["le"], float(value)))
+            elif name == f"{family}_count":
+                hist_counts[key] = float(value)
+            elif name != f"{family}_sum":
+                raise ValueError(f"line {ln}: sample {name} under "
+                                 f"histogram family {family}")
+        elif name != family:
+            raise ValueError(f"line {ln}: sample {name} does not match "
+                             f"declared family {family}")
+
+    for (family, labelset), buckets in hist_buckets.items():
+        prev = -1.0
+        inf = None
+        for le, v in buckets:
+            if v < prev:
+                raise ValueError(
+                    f"{family}{dict(labelset)}: bucket le={le} count {v} "
+                    f"decreased (not cumulative)")
+            prev = v
+            if le == "+Inf":
+                inf = v
+        if inf is None:
+            raise ValueError(f"{family}{dict(labelset)}: no +Inf bucket")
+        count = hist_counts.get((family, labelset))
+        if count is None:
+            raise ValueError(f"{family}{dict(labelset)}: no _count sample")
+        if count != inf:
+            raise ValueError(
+                f"{family}{dict(labelset)}: _count {count} != +Inf "
+                f"bucket {inf}")
