@@ -1,0 +1,199 @@
+"""Control-plane scale bench: where does the master saturate?
+
+Ramps a simulated-tracker fleet (``tpumr/scale/``) against a real
+``JobMaster`` — real RPC sockets, real heartbeat handling, real
+scheduler passes, real completion-event polls; only task execution is
+a timed no-op — and records, per fleet size, the master's saturation
+series:
+
+- ``heartbeat_p50_s`` / ``heartbeat_p99_s`` — master-side handling
+  latency including deferred history I/O (``heartbeat_seconds``);
+- ``heartbeat_lag_p99_s``   — scheduled-interval overrun per tracker
+  (``heartbeat_lag_seconds``): the first externally visible symptom;
+- ``lock_wait_p99_s``       — queueing on THE master lock
+  (``jt_lock_wait_seconds``), with hold time alongside;
+- ``assign_p99_s``          — scheduler pass cost (``assign_seconds``);
+- ``rpc_inflight_peak``     — high-water concurrently dispatched RPCs;
+- ``completion_event_lag_p99`` — events pending per reduce poll.
+
+Each fleet size gets a FRESH master so rows are independent
+distributions, not cumulative smears. The report names the max
+sustainable fleet size at a p99 heartbeat-latency SLO
+(``TPUMR_SCALE_SLO_MS``, default 250 ms) — the baseline number every
+control-plane refactor (heartbeat batching, sharded master internals)
+must move.
+
+Output contract (same shape as ``bench.py``/``bench_shuffle.py``): ONE
+JSON line on stdout {"metric", "value", "unit", "vs_baseline"}; every
+per-size row goes to stderr and to ``bench_scale.json``. env
+BENCH_SCALE=small (or --smoke) shrinks the ramp for CI smoke runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a: object) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+SMALL = os.environ.get("BENCH_SCALE") == "small" or "--smoke" in sys.argv
+
+#: fleet ramp (≥ 4 sizes in every mode — the per-size rows ARE the
+#: trajectory) and the heartbeat interval the fleet schedules against
+FLEETS = [4, 8, 12, 16] if SMALL else [25, 50, 100, 200, 400]
+INTERVAL_S = 0.05 if SMALL else 0.1
+
+#: p99 heartbeat-latency SLO the "max sustainable fleet" is judged at
+SLO_S = float(os.environ.get("TPUMR_SCALE_SLO_MS", "250")) / 1000.0
+
+
+def _p(h: "dict | None", q: str) -> float:
+    return float((h or {}).get(q, 0.0))
+
+
+def run_step(n_trackers: int, interval_s: float,
+             wait_timeout_s: float) -> dict:
+    """One ramp step: fresh master, fleet of ``n_trackers``, a synthetic
+    multi-job workload sized to keep every slot busy for a few seconds,
+    then one snapshot of the master's saturation series."""
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.jobtracker import JobMaster
+    from tpumr.scale import ScaleDriver, SimFleet
+
+    conf = JobConf()
+    conf.set("tpumr.heartbeat.interval.ms", int(interval_s * 1000))
+    # lagging trackers under saturation must stay registered — eviction
+    # mid-row would re-queue work and double-count the chaos
+    conf.set("tpumr.tracker.expiry.ms", 60_000)
+    master = JobMaster(conf).start()
+    host, port = master.address
+
+    cpu_slots, reduce_slots = 2, 1
+    task_mean_s = 3.0 * interval_s
+    # size the workload to ~a few seconds of full-fleet occupancy:
+    # total_maps ≈ slots × target_busy_s / task_mean
+    target_busy_s = 2.5 if SMALL else 6.0
+    total_maps = max(8, int(cpu_slots * n_trackers * target_busy_s
+                            / task_mean_s))
+    n_jobs = max(2, n_trackers // 8)
+    maps_per_job = max(4, total_maps // n_jobs)
+    reduces_per_job = 2
+
+    fleet = SimFleet(host, port, n_trackers, interval_s=interval_s,
+                     cpu_slots=cpu_slots, reduce_slots=reduce_slots,
+                     task_time_mean_s=task_mean_s).start()
+    driver = ScaleDriver(host, port)
+    t0 = time.monotonic()
+    try:
+        result = driver.run_workload(n_jobs, maps_per_job,
+                                     reduces_per_job,
+                                     timeout_s=wait_timeout_s)
+        wall = time.monotonic() - t0
+        snap = master.metrics.snapshot()
+        jt = snap.get("jobtracker", {})
+        fl = fleet.stats()
+        row = {
+            "trackers": n_trackers,
+            "jobs": n_jobs,
+            "maps_per_job": maps_per_job,
+            "reduces_per_job": reduces_per_job,
+            "completed": not result["unfinished"] and
+                         not result["failed"],
+            "wall_s": round(wall, 3),
+            "heartbeats": int(_p(jt.get("heartbeat_seconds"), "count")),
+            "heartbeat_p50_s": round(
+                _p(jt.get("heartbeat_seconds"), "p50"), 6),
+            "heartbeat_p99_s": round(
+                _p(jt.get("heartbeat_seconds"), "p99"), 6),
+            "heartbeat_lag_p99_s": round(
+                _p(jt.get("heartbeat_lag_seconds"), "p99"), 6),
+            "lock_wait_p99_s": round(
+                _p(jt.get("jt_lock_wait_seconds"), "p99"), 6),
+            "lock_hold_p99_s": round(
+                _p(jt.get("jt_lock_hold_seconds"), "p99"), 6),
+            "assign_p99_s": round(
+                _p(snap.get("scheduler", {}).get("assign_seconds"),
+                   "p99"), 6),
+            "completion_event_lag_p99": round(
+                _p(jt.get("completion_event_lag"), "p99"), 2),
+            "rpc_inflight_peak": master._server.inflight_peak(),
+            "client_rtt_p99_s": round(_p(fl["hb_rtt"], "p99"), 6),
+            "client_lag_p99_s": round(_p(fl["hb_lag"], "p99"), 6),
+            "hb_errors": int(fl["hb_errors"]),
+            "tasks_completed": fl["tasks_completed"],
+        }
+    finally:
+        fleet.stop()
+        driver.close()
+        master.stop()
+    return row
+
+
+def run_bench(fleets: "list[int] | None" = None,
+              interval_s: "float | None" = None,
+              slo_s: "float | None" = None,
+              wait_timeout_s: "float | None" = None) -> dict:
+    fleets = fleets or FLEETS
+    interval_s = interval_s or INTERVAL_S
+    slo_s = slo_s or SLO_S
+    wait_timeout_s = wait_timeout_s or (60.0 if SMALL else 180.0)
+    rows = []
+    for n in fleets:
+        row = run_step(n, interval_s, wait_timeout_s)
+        rows.append(row)
+        log(f"[scale] {n:4d} trackers: hb p50 "
+            f"{row['heartbeat_p50_s'] * 1e3:.2f}ms p99 "
+            f"{row['heartbeat_p99_s'] * 1e3:.2f}ms · lag p99 "
+            f"{row['heartbeat_lag_p99_s'] * 1e3:.2f}ms · lock wait p99 "
+            f"{row['lock_wait_p99_s'] * 1e3:.2f}ms · assign p99 "
+            f"{row['assign_p99_s'] * 1e3:.2f}ms · inflight peak "
+            f"{row['rpc_inflight_peak']} · "
+            f"{row['heartbeats']} beats, {row['tasks_completed']} tasks "
+            f"in {row['wall_s']:.1f}s"
+            + ("" if row["completed"] else " · WORKLOAD INCOMPLETE"))
+    # the SLO gates BOTH latency series: handling p99 (the master is
+    # slow) and lag p99 (trackers can't keep schedule — beats arriving
+    # a second late mean stale statuses and expiring leases long before
+    # raw handling time looks bad)
+    sustainable = [r["trackers"] for r in rows
+                   if r["completed"]
+                   and r["heartbeat_p99_s"] <= slo_s
+                   and r["heartbeat_lag_p99_s"] <= slo_s]
+    return {
+        "interval_s": interval_s,
+        "slo_s": slo_s,
+        "slo_series": ["heartbeat_p99_s", "heartbeat_lag_p99_s"],
+        "max_sustainable_trackers": max(sustainable, default=0),
+        "rows": rows,
+    }
+
+
+def main() -> None:
+    report = run_bench()
+    with open("bench_scale.json", "w") as f:
+        json.dump(report, f, sort_keys=True, indent=1)
+    log(f"detail rows -> bench_scale.json: "
+        f"{json.dumps(report, sort_keys=True)}")
+    rows = report["rows"]
+    print(json.dumps({
+        "metric": f"control-plane scale: max simulated-tracker fleet "
+                  f"(of ramp {[r['trackers'] for r in rows]}, "
+                  f"{report['interval_s'] * 1000:.0f}ms heartbeats) the "
+                  f"master sustains with workload completion and "
+                  f"heartbeat handling AND lag p99 <= "
+                  f"{report['slo_s'] * 1000:.0f}ms",
+        "value": report["max_sustainable_trackers"],
+        "unit": "trackers",
+        # this bench IS the baseline the control-plane refactor must
+        # beat; nothing earlier exists to compare against
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -727,6 +727,11 @@ class TestClusterMetricsE2E:
         assert "Merged distributions" in body
         assert "slot utilization" in body
         assert "Per-tracker gauges" in body
+        # staleness signal on the per-tracker rows: a wedged tracker's
+        # merged gauges persist, so without this column it looked
+        # healthy until eviction
+        assert "last heartbeat" in body
+        assert "s ago" in body
 
     def test_rollup_written_and_cli_prints_it(self, cluster, capsys):
         result = run_wc(cluster, "rollup")

@@ -51,6 +51,11 @@ Clients:
   rumen HISTORY_DIR    extract job traces from history
   failmon -collect|-merge   node failure monitoring (collect/upload/merge)
   gridmix [--scale S]  synthetic mixed-workload benchmark
+  simulate [-trackers N] [-jobs J] [-maps M] [-reduces R] [-interval MS]
+                       [-task-ms MEAN] [-timeout S] [-ff-rate P]
+                       control-plane scale harness: a simulated tracker
+                       fleet driving real heartbeat/RPC paths against
+                       the -jt master (or a self-hosted one)
   keys SUBCMD          credentials: user-key USER | token [-nn] [-renewer R]
                        [-out FILE] | renew FILE | cancel FILE
   fetchdt TOKEN_FILE   fetch a NameNode delegation token (= keys token -nn)
@@ -789,6 +794,90 @@ def cmd_gridmix(conf, argv: list[str]) -> int:
     return gridmix_main(argv)
 
 
+def cmd_simulate(conf, argv: list[str]) -> int:
+    """Control-plane scale harness (tpumr/scale/): N simulated trackers
+    speaking the real heartbeat protocol plus a synthetic multi-job
+    workload, against the configured master (``-jt HOST:PORT``) or a
+    self-hosted in-process one. With a self-hosted master the report
+    includes the master-side saturation series (heartbeat p50/p99, lag
+    p99, lock-wait p99, assign p99, RPC inflight peak); against a live
+    master read those off its /metrics/prom. See docs/OPERATIONS.md
+    "Sizing the master"."""
+    from tpumr.scale import ScaleDriver, SimFleet
+    from tpumr.security import rpc_secret
+    a = _kv_args(argv)
+    n = int(a.get("trackers", 25))
+    n_jobs = int(a.get("jobs", 4))
+    maps = int(a.get("maps", 64))
+    reduces = int(a.get("reduces", 2))
+    interval_s = float(a.get("interval", 200)) / 1000.0
+    task_mean_s = float(a.get("task-ms", 500)) / 1000.0
+    timeout_s = float(a.get("timeout", 120))
+    ff_rate = float(a.get("ff-rate", 0.0))
+    jt = conf.get("mapred.job.tracker")
+    master = None
+    if jt and jt != "local" and ":" in str(jt):
+        host, port = _host_port(str(jt))
+    else:
+        from tpumr.mapred.jobtracker import JobMaster
+        conf.set("tpumr.heartbeat.interval.ms", int(interval_s * 1000))
+        conf.set_if_unset("tpumr.tracker.expiry.ms", 60_000)
+        master = JobMaster(conf).start()
+        host, port = master.address
+        print(f"self-hosted JobMaster at {host}:{port}", file=sys.stderr)
+    secret = rpc_secret(conf)
+    fleet = SimFleet(host, port, n, secret=secret, interval_s=interval_s,
+                     task_time_mean_s=task_mean_s,
+                     fetch_failure_rate=ff_rate).start()
+    driver = ScaleDriver(host, port, secret=secret)
+    try:
+        print(f"simulate: {n} trackers @ {interval_s * 1000:.0f}ms "
+              f"heartbeats, {n_jobs} jobs x {maps} maps / {reduces} "
+              f"reduces, task mean {task_mean_s * 1000:.0f}ms",
+              file=sys.stderr)
+        result = driver.run_workload(n_jobs, maps, reduces,
+                                     timeout_s=timeout_s)
+        fl = fleet.stats()
+        report = {
+            "trackers": n,
+            "jobs_succeeded": len(result["succeeded"]),
+            "jobs_failed": len(result["failed"]),
+            "jobs_unfinished": len(result["unfinished"]),
+            "heartbeats": fl["heartbeats"],
+            "tasks_completed": fl["tasks_completed"],
+            "hb_errors": fl["hb_errors"],
+            "client_rtt_p50_s": fl["hb_rtt"].get("p50", 0.0),
+            "client_rtt_p99_s": fl["hb_rtt"].get("p99", 0.0),
+            "client_lag_p99_s": fl["hb_lag"].get("p99", 0.0),
+        }
+        if master is not None:
+            snap = master.metrics.snapshot()
+            jt_m = snap.get("jobtracker", {})
+            report.update({
+                "heartbeat_p50_s": jt_m.get("heartbeat_seconds",
+                                            {}).get("p50", 0.0),
+                "heartbeat_p99_s": jt_m.get("heartbeat_seconds",
+                                            {}).get("p99", 0.0),
+                "heartbeat_lag_p99_s": jt_m.get("heartbeat_lag_seconds",
+                                                {}).get("p99", 0.0),
+                "lock_wait_p99_s": jt_m.get("jt_lock_wait_seconds",
+                                            {}).get("p99", 0.0),
+                "assign_p99_s": snap.get("scheduler", {}).get(
+                    "assign_seconds", {}).get("p99", 0.0),
+                "completion_event_lag_p99": jt_m.get(
+                    "completion_event_lag", {}).get("p99", 0.0),
+                "rpc_inflight_peak": master._server.inflight_peak(),
+            })
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if not result["failed"] and not result["unfinished"] \
+            else 1
+    finally:
+        fleet.stop()
+        driver.close()
+        if master is not None:
+            master.stop()
+
+
 def cmd_distcp(conf, argv: list[str]) -> int:
     from tpumr.tools.distcp import main as distcp_main
     return distcp_main(argv)
@@ -1134,6 +1223,7 @@ COMMANDS = {
     "distcp": cmd_distcp,
     "failmon": cmd_failmon,
     "gridmix": cmd_gridmix,
+    "simulate": cmd_simulate,
     "archive": cmd_archive,
     "rumen": cmd_rumen,
     "examples": cmd_examples,

@@ -48,10 +48,18 @@ from typing import Any, Iterator
 ENABLED_KEY = "tpumr.trace.enabled"
 TRACE_ID_KEY = "tpumr.trace.id"
 TRACE_DIR_KEY = "tpumr.trace.dir"
+SAMPLE_KEY = "tpumr.trace.sample"
 
 #: flush to disk once this many finished spans are buffered (spans also
 #: flush explicitly at task/job completion so merges see fresh data)
 FLUSH_THRESHOLD = 256
+
+#: hard per-process buffer bound: when the flusher can't keep up (or no
+#: trace dir is configured and nothing drains the buffer between
+#: threshold flushes), the OLDEST buffered spans are dropped and counted
+#: (``Tracer.dropped``) — a scale-harness run with hundreds of simulated
+#: trackers must never let trace buffering grow without bound
+MAX_BUFFERED = 8192
 
 _id_lock = threading.Lock()
 _id_counter = 0
@@ -82,6 +90,25 @@ def trace_enabled(conf: Any) -> bool:
     except (AttributeError, TypeError, ValueError):
         v = conf.get(ENABLED_KEY, "")
         return v is True or str(v).lower() in ("true", "1")
+
+
+def trace_sample_rate(conf: Any) -> float:
+    """Per-job head-sampling rate (``tpumr.trace.sample``, default 1.0):
+    the master draws once at submit — a sampled-out job is simply not
+    traced (no id minted, zero per-span cost anywhere), which is how a
+    cluster runs hundreds of trackers with tracing on without the JSONL
+    volume scaling with job count. Clamped to [0, 1]; a malformed value
+    falls back to 1.0 (trace rather than silently lose everything)."""
+    try:
+        v = conf.get(SAMPLE_KEY)
+    except (AttributeError, TypeError):
+        return 1.0
+    if v is None or v == "":
+        return 1.0
+    try:
+        return min(1.0, max(0.0, float(v)))
+    except (TypeError, ValueError):
+        return 1.0
 
 
 def trace_dir_from_conf(conf: Any) -> "str | None":
@@ -149,6 +176,9 @@ class Tracer:
         self.hostname = hostname
         self._lock = threading.Lock()
         self._finished: list[Span] = []
+        #: spans dropped at the MAX_BUFFERED high-water mark (observable
+        #: tell that the flusher fell behind the span rate)
+        self.dropped = 0
         #: serializes the file-append phase of flush() — concurrent
         #: flushes (threshold thread + an explicit caller) must not
         #: interleave partial lines in one tracer's file
@@ -189,6 +219,13 @@ class Tracer:
         with self._lock:
             self._finished.append(span)
             n = len(self._finished)
+            if n > MAX_BUFFERED:
+                # flusher outrun (or no sink): shed the OLDEST spans —
+                # bounded memory beats a complete-but-growing buffer
+                shed = n - MAX_BUFFERED
+                del self._finished[:shed]
+                self.dropped += shed
+                n = MAX_BUFFERED
         if n >= FLUSH_THRESHOLD:
             # finish() is called from hot paths that may hold daemon
             # locks (the master records schedule spans mid-heartbeat) —

@@ -224,6 +224,11 @@ class _Handler(socketserver.BaseRequestHandler):
                             "error": "RpcAuthError: replayed request id"})
                         continue
                 resp: dict[str, Any] = {"id": req.get("id")}
+                # saturation accounting: requests currently past auth/
+                # replay checks and occupying a handler (the master's
+                # rpc_inflight gauge — climbing toward the connection
+                # count means handlers can't drain the offered load)
+                server.rpc.note_dispatch_start()
                 try:
                     if server.secret is not None and scope is not None \
                             and job_scoped and req.get("method") not in \
@@ -308,6 +313,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 except Exception as e:  # noqa: BLE001 — remote surface
                     resp["error"] = f"{type(e).__name__}: {e}"
                     resp["traceback"] = traceback.format_exc(limit=8)
+                finally:
+                    server.rpc.note_dispatch_end()
                 if req.get("cid") is not None:
                     server.response_cache_put(dedupe_key, resp)
                 _send_frame(sock, resp)
@@ -363,8 +370,16 @@ class RpcServer:
         #: optional MetricsRegistry: when set, every dispatched method
         #: records its server-side handler latency into a per-method
         #: ``rpc_<method>`` histogram (names are bounded by the
-        #: handler's real method surface — lookup precedes timing)
-        self.metrics: "Any | None" = None
+        #: handler's real method surface — lookup precedes timing), and
+        #: the saturation gauges below register (rpc_inflight,
+        #: rpc_inflight_peak, rpc_handler_threads)
+        self._metrics: "Any | None" = None
+        # in-flight dispatch accounting (control-plane saturation): how
+        # many requests are past auth/replay and inside handler code
+        # RIGHT NOW, plus the high-water mark since the last peak read
+        self._inflight = 0
+        self._inflight_peak = 0
+        self._inflight_lock = threading.Lock()
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.secret = secret  # type: ignore[attr-defined]
         # expose hooks on the socketserver instance for _Handler
@@ -381,6 +396,42 @@ class RpcServer:
         self._server.advance_hwm = self.advance_hwm  # type: ignore[attr-defined]
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+
+    @property
+    def metrics(self) -> "Any | None":
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, reg: "Any | None") -> None:
+        self._metrics = reg
+        if reg is not None:
+            # the server's saturation gauges live in the same registry
+            # as the per-method latency hists: one scrape answers both
+            # "how slow" and "how deep is the queue"
+            reg.set_gauge("rpc_inflight", lambda: self._inflight)
+            reg.set_gauge("rpc_inflight_peak",
+                          lambda: self.inflight_peak())
+            reg.set_gauge("rpc_handler_threads",
+                          lambda: len(self._conns))
+
+    def note_dispatch_start(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+            if self._inflight > self._inflight_peak:
+                self._inflight_peak = self._inflight
+
+    def note_dispatch_end(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def inflight_peak(self, reset: bool = False) -> int:
+        """High-water mark of concurrently dispatched requests since
+        the last ``reset=True`` read (the bench_scale per-row peak)."""
+        with self._inflight_lock:
+            peak = self._inflight_peak
+            if reset:
+                self._inflight_peak = self._inflight
+            return peak
 
     def _track_connection(self, sock: socket.socket) -> None:
         with self._conns_lock:

@@ -94,7 +94,11 @@ class JobMaster:
     def __init__(self, conf: Any, host: str = "127.0.0.1",
                  port: int = 0) -> None:
         self.conf = conf
-        self.lock = threading.RLock()
+        # THE master lock, wrapped so contention is measurable: wait and
+        # hold distributions bind to jt_lock_wait_seconds /
+        # jt_lock_hold_seconds once the metrics registry exists below
+        from tpumr.metrics.locks import InstrumentedRLock
+        self.lock = InstrumentedRLock()
         self.jobs: dict[str, JobInProgress] = {}
         self.trackers: dict[str, _TrackerInfo] = {}
         self._last_response: dict[str, tuple[int, list]] = {}
@@ -217,6 +221,31 @@ class JobMaster:
         # scheduler decision timing. These are the series the ROADMAP's
         # control-plane scale-out work reads first.
         self._hb_seconds = self._mreg.histogram("heartbeat_seconds")
+        # master saturation series (the scale harness's read side, all
+        # hoisted off the registry lookup path):
+        # - lock wait/hold on the master lock (metrics/locks.py),
+        # - heartbeat phase breakdown (fold = task-status/fetch-failure
+        #   folding, assign = the scheduler pass, deferred_io = history/
+        #   finalize I/O after the lock) as ONE labeled Prometheus
+        #   family via the `name|phase=...` registry convention,
+        # - per-tracker heartbeat LAG: observed inter-heartbeat gap
+        #   minus the configured interval — trackers overrunning their
+        #   schedule is the first externally visible saturation symptom,
+        # - completion-event feed lag: events still pending at each
+        #   reduce poll (a growing backlog means reduces fall behind
+        #   the map completion rate — or polls can't get through).
+        from tpumr.metrics.histogram import COUNTS
+        self.lock.bind(self._mreg.histogram("jt_lock_wait_seconds"),
+                       self._mreg.histogram("jt_lock_hold_seconds"))
+        self._hb_phase = {
+            phase: self._mreg.histogram(
+                f"heartbeat_phase_seconds|phase={phase}")
+            for phase in ("fold", "assign", "deferred_io")}
+        self._hb_lag = self._mreg.histogram("heartbeat_lag_seconds")
+        self._hb_interval_s = conf.get_int(
+            "tpumr.heartbeat.interval.ms", 1000) / 1000.0
+        self._event_lag = self._mreg.histogram("completion_event_lag",
+                                               COUNTS)
         self._server.metrics = self.metrics.new_registry("rpc")
         self.scheduler.metrics = self.metrics.new_registry("scheduler")
         # heartbeat-aggregated cluster view: trackers piggyback their
@@ -581,10 +610,13 @@ class JobMaster:
             master knows about the whole cluster — slot utilization,
             merged tracker distributions (shuffle fetch, TPU stage/
             execute, tracker RPC), and per-tracker gauge rows."""
+            import time as _time
             with self.lock:
                 util = {k: self._slot_utilization_locked(k)
                         for k in ("cpu", "tpu", "reduce")}
                 n_trackers = len(self.trackers)
+                hb_ages = {n: max(0.0, _time.time() - t.last_seen)
+                           for n, t in self.trackers.items()}
             snaps = self.metrics.snapshot()
             snap = snaps.get("cluster", {})
             hb = snaps.get("jobtracker", {}).get("heartbeat_seconds", {})
@@ -617,10 +649,16 @@ class JobMaster:
             if gauge_rows:
                 keys = sorted({k for g in gauge_rows.values() for k in g})
                 parts.append("<h2>Per-tracker gauges</h2>")
+                # last-heartbeat age leads each row: merged gauges alone
+                # made a wedged tracker look healthy (its last-reported
+                # numbers persist) until eviction — staleness is the
+                # signal that says whether the row is even current
                 parts.append(html_table(
-                    ["tracker"] + keys,
-                    [[t] + [f"{gauge_rows[t].get(k, 0):.4g}"
-                            for k in keys]
+                    ["tracker", "last heartbeat"] + keys,
+                    [[t,
+                      (f"{hb_ages[t]:.1f}s ago" if t in hb_ages
+                       else "evicted")]
+                     + [f"{gauge_rows[t].get(k, 0):.4g}" for k in keys]
                      for t in sorted(gauge_rows)]))
             return "".join(parts)
 
@@ -721,9 +759,25 @@ class JobMaster:
         # names + grep both read naturally). Minted BEFORE JobInProgress
         # construction so jip.conf carries it to every tracker
         # (get_job_conf) and child process (the task file).
-        from tpumr.core.tracing import (ENABLED_KEY, TRACE_ID_KEY,
-                                        trace_dir_from_conf, trace_enabled)
-        if self._trace_all or trace_enabled(conf_dict):
+        from tpumr.core.tracing import (ENABLED_KEY, SAMPLE_KEY,
+                                        TRACE_ID_KEY, trace_dir_from_conf,
+                                        trace_enabled, trace_sample_rate)
+        want_trace = self._trace_all or trace_enabled(conf_dict)
+        if want_trace:
+            # per-job head sampling (tpumr.trace.sample, default 1.0):
+            # decided ONCE here — a sampled-out job is simply untraced
+            # everywhere (no id minted into its conf), so a cluster can
+            # keep tracing on while span volume stays proportional to
+            # the sample rate, not the job count. The job conf's rate
+            # wins; the master conf supplies the cluster default.
+            import random as _random
+            rate = trace_sample_rate(
+                conf_dict if SAMPLE_KEY in conf_dict else self.conf)
+            if rate < 1.0 and _random.random() >= rate:
+                want_trace = False
+                conf_dict.pop(TRACE_ID_KEY, None)
+                self._mreg.incr("traces_sampled_out")
+        if want_trace:
             # overwrite, never setdefault: a clone-and-rerun of a
             # finished job's conf carries the OLD job's trace id, which
             # would merge two jobs' spans into one file
@@ -1143,7 +1197,15 @@ class JobMaster:
         jip = self._job(job_id)
         self._check_job_op(jip, "view")   # own task children pass by scope
         with jip.lock:
-            return jip.completion_events[from_index: from_index + max_events]
+            events = jip.completion_events[from_index:
+                                           from_index + max_events]
+            pending = max(0, len(jip.completion_events) - int(from_index))
+        # completion-event feed lag: how many events each poll still had
+        # to catch up on. A growing distribution means reduces fall
+        # behind the map completion rate — or their polls can't get
+        # through a saturated master.
+        self._event_lag.observe(pending)
+        return events
 
     def get_job_conf(self, job_id: str) -> dict:
         jip = self._job(job_id)
@@ -1235,6 +1297,12 @@ class JobMaster:
         name = status["tracker_name"]
         self._mreg.incr("heartbeats")
         t0 = time.monotonic()
+        # the tracker's PR-2 heartbeat span context (shipped only when
+        # the tracker traces its daemon loop): master-side phase work
+        # records as sub-spans on that same trace, so one swimlane shows
+        # where a slow heartbeat's time went. Popped so the stored
+        # tracker status never carries it.
+        hb_trace = status.pop("trace", None)
         # history appends + job finalization are file I/O — deferred past
         # the master lock so disk latency never serializes the control
         # plane; task events flush BEFORE finalization so the per-job log
@@ -1245,8 +1313,10 @@ class JobMaster:
             return self._heartbeat_locked(status, initial_contact,
                                           ask_for_new_task, response_id,
                                           name, deferred_events,
-                                          deferred_final)
+                                          deferred_final, hb_trace)
         finally:
+            t_io = time.monotonic()
+            t_io_wall = time.time()
             for job_id, event, fields in deferred_events:
                 try:
                     self.history.task_event(job_id, event, **fields)
@@ -1258,16 +1328,36 @@ class JobMaster:
                 except Exception:  # noqa: BLE001
                     jip.error = jip.error or "finalization failed"
                     jip.finalized.set()
+            if deferred_events or deferred_final:
+                self._hb_phase["deferred_io"].observe(
+                    time.monotonic() - t_io)
+                self._phase_span(hb_trace, "heartbeat:deferred_io",
+                                 t_io_wall,
+                                 events=len(deferred_events),
+                                 finalized=len(deferred_final))
             # handling latency INCLUDING the deferred history/finalize
             # I/O: that work serializes this handler thread (and with it
             # this tracker's next heartbeat), so it is part of the
             # latency an operator must see
             self._hb_seconds.observe(time.monotonic() - t0)
 
+    def _phase_span(self, hb_trace: "dict | None", name: str,
+                    start_wall: float, **attrs: Any) -> None:
+        """Record one already-elapsed heartbeat phase as a sub-span of
+        the tracker's heartbeat span (no-op when the tracker didn't ship
+        trace context — the zero-overhead-off contract)."""
+        if hb_trace is None:
+            return
+        s = self.tracer.start_span(name, hb_trace.get("trace_id", ""),
+                                   parent=hb_trace, **attrs)
+        s.start = start_wall
+        self.tracer.finish(s)
+
     def _heartbeat_locked(self, status: dict, initial_contact: bool,
                           ask_for_new_task: bool, response_id: int,
                           name: str, deferred_events: list,
-                          deferred_final: list) -> dict:
+                          deferred_final: list,
+                          hb_trace: "dict | None" = None) -> dict:
         with self.lock:
             if not self._host_allowed(status.get("host", "")):
                 # ≈ DisallowedTaskTrackerException: the tracker's host is
@@ -1285,9 +1375,18 @@ class JobMaster:
                         [{"type": "reinit"}]}
             if info is None:
                 info = self.trackers[name] = _TrackerInfo(status)
+            elif not initial_contact:
+                # heartbeat LAG: how far past its scheduled interval this
+                # tracker's beat arrived. Climbing lag p99 with flat
+                # handling latency = trackers (or the network/handler
+                # pool) can't keep schedule — the first saturation tell.
+                gap = time.monotonic() - info.seen_mono
+                self._hb_lag.observe(max(0.0, gap - self._hb_interval_s))
             info.status = status
             info.last_seen = time.time()
             info.seen_mono = time.monotonic()
+            t_fold = time.monotonic()
+            t_fold_wall = time.time()
             # fold the piggybacked tracker metrics into the cluster
             # registry — cumulative state, so replayed heartbeats are
             # idempotent (no seq protocol needed, unlike task statuses)
@@ -1365,6 +1464,10 @@ class JobMaster:
             for ff in status.get("fetch_failures", []):
                 self._fetch_failure_locked(ff, deferred_events,
                                            deferred_final)
+            self._hb_phase["fold"].observe(time.monotonic() - t_fold)
+            self._phase_span(
+                hb_trace, "heartbeat:fold", t_fold_wall,
+                statuses=len(status.get("task_statuses", [])))
 
             # Normal case: the tracker echoes the response id we last sent
             # (last[0] == response_id). A MISMATCH means our response was
@@ -1398,6 +1501,8 @@ class JobMaster:
 
             if ask_for_new_task and not info.blacklisted \
                     and status.get("healthy", True):
+                t_assign = time.monotonic()
+                t_assign_wall = time.time()
                 for task in self.scheduler.assign_tasks(status):
                     if not task.is_map:
                         self._mreg.incr("reduces_launched")
@@ -1432,6 +1537,13 @@ class JobMaster:
                              run_on_tpu=task.run_on_tpu,
                              tpu_device_id=task.tpu_device_id,
                              tracker=name)))
+                # the scheduler pass plus per-assignment bookkeeping —
+                # observed only when the pass actually ran, so the
+                # distribution isn't drowned by no-ask heartbeats
+                self._hb_phase["assign"].observe(
+                    time.monotonic() - t_assign)
+                self._phase_span(hb_trace, "heartbeat:assign",
+                                 t_assign_wall)
 
             response_id += 1
             self._last_response[name] = (response_id, actions)

@@ -669,10 +669,14 @@ class NodeRunner:
                 else:
                     # daemon-scoped trace (trace id = the tracker, not a
                     # job): heartbeat latency is where master contention
-                    # shows up first
+                    # shows up first. The span's context rides the
+                    # status dict so the master records its phase
+                    # breakdown (fold/assign/deferred_io) as sub-spans
+                    # of THIS span — one swimlane shows where a slow
+                    # beat's time went, master-side included.
                     with self.tracer.span("heartbeat",
-                                          f"daemon-{self.name}"):
-                        self._heartbeat_once()
+                                          f"daemon-{self.name}") as hb:
+                        self._heartbeat_once(hb_span=hb)
             except Exception:
                 # master briefly unreachable — keep trying (lease
                 # semantics); back off solely via the interruptible
@@ -702,12 +706,16 @@ class NodeRunner:
                              "histograms": hists}
         return out
 
-    def _heartbeat_once(self) -> None:
+    def _heartbeat_once(self, hb_span: Any = None) -> None:
         status = self._status_dict()
         try:
             status["metrics"] = self._metrics_piggyback()
         except Exception:  # noqa: BLE001 — metering must not break
             pass           # the heartbeat lease
+        if hb_span is not None:
+            # the master pops this and parents its heartbeat phase
+            # sub-spans to it (never stored in the tracker registry)
+            status["trace"] = hb_span.context
         cpu, tpu, red = (status["count_cpu_map_tasks"],
                          status["count_tpu_map_tasks"],
                          status["count_reduce_tasks"])

@@ -39,6 +39,11 @@ SECONDS = exponential_bounds(1e-4, 2.0, 26)
 #: default ladder for payload/transfer sizes: 64 B … ~4 GiB at factor 4
 BYTES = exponential_bounds(64, 4.0, 13)
 
+#: default ladder for small-integer counts (queue depths, events pending
+#: per completion-event poll): 1 … ~1M at factor 2, with the first
+#: bucket isolating the healthy "nothing pending" case exactly
+COUNTS = exponential_bounds(1, 2.0, 20)
+
 
 class Histogram:
     """Thread-safe exponential-bucket histogram with count/sum/min/max

@@ -1,0 +1,78 @@
+"""Instrumented locking — contention as a first-class distribution.
+
+The JobTracker is one process behind one RLock; every heartbeat,
+completion-event poll, and status page serializes on it. The reference
+never measured that (its global synchronized heartbeat monitor was a
+known scaling wall nobody could see coming — SURVEY.md §3.2); here the
+master lock is wrapped so wait time (how long callers queue) and hold
+time (how long the winner keeps everyone else out) land in histograms
+(``jt_lock_wait_seconds`` / ``jt_lock_hold_seconds``). Wait p99 climbing
+while hold p99 stays flat = more contenders; both climbing = the work
+under the lock grew. These are the first series the control-plane
+scale-out refactor is judged against (ROADMAP, bench_scale.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class InstrumentedRLock:
+    """A re-entrant lock recording acquisition wait and outermost hold
+    durations into histograms.
+
+    Drop-in for ``threading.RLock`` at the ``acquire``/``release``/
+    context-manager surface. Only the OUTERMOST acquire measures wait
+    (a re-entrant acquire by the owner never blocks) and only the
+    outermost release records hold — nested ``with`` blocks must not
+    turn one hold into N overlapping observations. Histograms may be
+    bound after construction (:meth:`bind`) so the lock can exist
+    before the metrics registry does; unbound, it costs one thread-local
+    read over a plain RLock.
+    """
+
+    def __init__(self, wait_hist: Any = None, hold_hist: Any = None) -> None:
+        self._lock = threading.RLock()
+        self._wait = wait_hist
+        self._hold = hold_hist
+        self._tl = threading.local()
+
+    def bind(self, wait_hist: Any, hold_hist: Any) -> "InstrumentedRLock":
+        self._wait = wait_hist
+        self._hold = hold_hist
+        return self
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        depth = getattr(self._tl, "depth", 0)
+        if depth:
+            # re-entrant: the owner never waits, the hold already runs
+            ok = self._lock.acquire(blocking, timeout)
+            if ok:
+                self._tl.depth = depth + 1
+            return ok
+        t0 = time.monotonic()
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            now = time.monotonic()
+            if self._wait is not None:
+                self._wait.observe(now - t0)
+            self._tl.depth = 1
+            self._tl.acquired_at = now
+        return ok
+
+    def release(self) -> None:
+        depth = getattr(self._tl, "depth", 0)
+        if depth == 1 and self._hold is not None:
+            self._hold.observe(time.monotonic() - self._tl.acquired_at)
+        if depth:
+            self._tl.depth = depth - 1
+        self._lock.release()
+
+    def __enter__(self) -> "InstrumentedRLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()

@@ -44,6 +44,24 @@ def _escape_label(value: str) -> str:
             .replace("\n", r"\n"))
 
 
+def split_extra_labels(name: str) -> "tuple[str, tuple]":
+    """Registry-name convention for labeled series: a metric registered
+    as ``family|k=v[,k2=v2]`` renders as family ``family`` with extra
+    labels ``{k="v"}`` next to the standard ``source`` label — how the
+    heartbeat phase breakdown ships as one
+    ``heartbeat_phase_seconds{phase=...}`` family instead of N
+    disconnected names. Plain names pass through untouched."""
+    base, sep, rest = str(name).partition("|")
+    if not sep:
+        return base, ()
+    labels = []
+    for part in rest.split(","):
+        k, eq, v = part.partition("=")
+        if eq and k.strip():
+            labels.append((sanitize_name(k.strip()), v.strip()))
+    return base, tuple(labels)
+
+
 def _fmt(v: Any) -> str:
     f = float(v)
     if f == int(f) and abs(f) < 1e15:
@@ -80,17 +98,19 @@ def render_exposition(typed_snapshot: "dict[str, dict]",
     labeled samples. A name claimed with conflicting kinds is qualified
     by its source instead — a valid exposition beats a pretty one.
     """
-    # family name -> (kind, [(source, payload)])
+    # family name -> (kind, [(source, extra-labels, payload)])
     families: "dict[str, tuple[str, list]]" = {}
 
     def claim(name: str, kind: str, source: str, payload: Any) -> None:
-        full = f"{namespace}_{sanitize_name(name)}"
+        base, extra = split_extra_labels(name)
+        full = f"{namespace}_{sanitize_name(base)}"
         if full in families and families[full][0] != kind:
             full = f"{namespace}_{sanitize_name(source)}_" \
-                   f"{sanitize_name(name)}"
+                   f"{sanitize_name(base)}"
             if full in families and families[full][0] != kind:
                 return  # still conflicting: drop rather than corrupt
-        families.setdefault(full, (kind, []))[1].append((source, payload))
+        families.setdefault(full, (kind, []))[1].append(
+            (source, extra, payload))
 
     for source in sorted(typed_snapshot):
         t = typed_snapshot[source] or {}
@@ -108,8 +128,9 @@ def render_exposition(typed_snapshot: "dict[str, dict]",
         kind, samples = families[full]
         lines.append(f"# HELP {full} tpumr metric {full}")
         lines.append(f"# TYPE {full} {kind}")
-        for source, payload in samples:
-            label = f'source="{_escape_label(source)}"'
+        for source, extra, payload in samples:
+            label = f'source="{_escape_label(source)}"' + "".join(
+                f',{k}="{_escape_label(v)}"' for k, v in extra)
             if kind != "histogram":
                 lines.append(f"{full}{{{label}}} {_fmt(payload)}")
                 continue

@@ -1,0 +1,31 @@
+"""Control-plane scale harness — simulated trackers, real wire protocol.
+
+The ROADMAP's scale-out item demands measurement before refactoring:
+the JobTracker is one process absorbing every heartbeat, completion-
+event poll, and fetch-failure report, and nobody ever measured where it
+saturates (the reference inherited Hadoop 1.0.3's JobTracker with the
+same blind spot). This package supplies the load side:
+
+- :mod:`tpumr.scale.simtracker` — ``SimTracker``/``SimFleet``: N
+  lightweight fake trackers speaking the REAL heartbeat protocol over
+  the REAL RPC transport (``RpcClient`` → ``ipc/rpc.py`` → the live
+  ``JobMaster.heartbeat``), executing assigned tasks as timed no-ops
+  drawn from a configurable duration distribution. Everything the wire
+  carries is authentic — response-id replay, metrics piggybacks,
+  completion-event polls, fetch-failure reports — only task execution
+  is faked, because task bytes are the data plane and this harness
+  measures the control plane.
+- :mod:`tpumr.scale.driver` — ``ScaleDriver``: submits synthetic
+  multi-job workloads over the client RPC surface and waits for them.
+
+The read side is the master's own saturation series (heartbeat
+latency/lag/phases, ``jt_lock_wait_seconds``, ``rpc_inflight``,
+completion-event lag) — see ``bench_scale.py`` at the repo root, which
+ramps fleet sizes and writes the ``bench_scale.json`` baseline every
+control-plane refactor must beat, and ``tpumr simulate`` in the CLI.
+"""
+
+from tpumr.scale.driver import ScaleDriver
+from tpumr.scale.simtracker import SimFleet, SimTracker
+
+__all__ = ["ScaleDriver", "SimFleet", "SimTracker"]

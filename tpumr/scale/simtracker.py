@@ -1,0 +1,407 @@
+"""Simulated trackers: the real heartbeat wire protocol, fake execution.
+
+A ``SimTracker`` is what a ``NodeRunner`` looks like FROM THE MASTER:
+it registers with the protocol-version handshake, heartbeats a complete
+status dict (slot pools, task statuses, metrics piggyback, fetch-failure
+reports) through a real ``RpcClient`` socket, honors the response-id
+replay protocol, and applies launch/kill/reinit/disallowed actions. The
+one thing it fakes is the work: an assigned task becomes a timed no-op
+whose duration is drawn from a configurable distribution, and a
+simulated reduce only completes after it has polled the master's
+completion-event feed to "see" every map — so event polls (and their
+master-side lag series) scale with the fleet exactly like real ones.
+
+``SimFleet`` drives N of them from a bounded worker pool on a
+fixed-rate schedule: each tracker has a due time every ``interval_s``,
+and the gap between due and actual send is the CLIENT-side heartbeat
+lag (the master independently measures arrival-gap lag). A saturated
+master shows up here first as climbing round-trip latency, then as lag
+when round trips exceed the interval.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import threading
+import time
+from typing import Any, Callable
+
+from tpumr.ipc.rpc import RpcClient
+from tpumr.mapred.ids import TaskAttemptID
+from tpumr.mapred.jobtracker import PROTOCOL_VERSION
+from tpumr.mapred.task import TaskPhase, TaskState, TaskStatus
+from tpumr.metrics.core import MetricsRegistry
+from tpumr.metrics.histogram import Histogram
+from tpumr.net import DEFAULT_RACK
+
+
+def default_task_time(rng: random.Random, is_map: bool,
+                      mean_s: float = 0.1) -> float:
+    """Uniform 0.5–1.5× the mean — enough spread that assignment order
+    and completion order decorrelate (like real stragglers) without a
+    heavy tail that would stall smoke-sized runs."""
+    return rng.uniform(0.5, 1.5) * mean_s * (1.0 if is_map else 1.5)
+
+
+class _SimTask:
+    """One fake in-flight attempt: a deadline and a wire status."""
+
+    __slots__ = ("job_id", "num_maps", "duration", "started", "status")
+
+    def __init__(self, job_id: str, num_maps: int, duration: float,
+                 status: TaskStatus) -> None:
+        self.job_id = job_id
+        self.num_maps = num_maps
+        self.duration = max(1e-4, duration)
+        self.started = time.monotonic()
+        self.status = status
+
+
+class SimTracker:
+    """One simulated tracker speaking the real InterTracker protocol."""
+
+    def __init__(self, name: str, master_host: str, master_port: int,
+                 *, secret: "bytes | None" = None, cpu_slots: int = 2,
+                 reduce_slots: int = 2,
+                 task_time: "Callable[..., float] | None" = None,
+                 task_time_mean_s: float = 0.1,
+                 rng: "random.Random | None" = None,
+                 fetch_failure_rate: float = 0.0,
+                 piggyback: bool = True,
+                 handshake: bool = True,
+                 rpc_timeout_s: float = 30.0) -> None:
+        self.name = name
+        self.cpu_slots = cpu_slots
+        self.reduce_slots = reduce_slots
+        self._task_time = task_time or (
+            lambda r, is_map: default_task_time(r, is_map,
+                                                task_time_mean_s))
+        self._rng = rng or random.Random(hash(name) & 0xFFFFFFFF)
+        self._fetch_failure_rate = float(fetch_failure_rate)
+        self.master = RpcClient(master_host, master_port, secret=secret,
+                                timeout=rpc_timeout_s)
+        if handshake:
+            remote = self.master.call("get_protocol_version")
+            if remote != PROTOCOL_VERSION:
+                raise RuntimeError(f"master protocol {remote} != "
+                                   f"{PROTOCOL_VERSION}")
+        self._running: "dict[str, _SimTask]" = {}
+        self._kill_requested: "set[str]" = set()
+        self._fetch_failures: "list[dict]" = []
+        self._reported_ff: "set[tuple[str, str]]" = set()
+        self._response_id = 0
+        self._initial_contact = True
+        #: per-job completion-event cursor + live map outputs seen
+        #: (OBSOLETE tombstones evict, like the real MapLocator fold)
+        self._event_cursor: "dict[str, int]" = {}
+        self._maps_live: "dict[str, dict[int, dict]]" = {}
+        self.stopped = False
+        self.heartbeats = 0
+        self.tasks_completed = 0
+        # the metrics piggyback: a REAL registry shipped in the real
+        # cumulative typed form, so the master's ClusterAggregator does
+        # per-fleet-scale work on every beat exactly as in production
+        self._reg = MetricsRegistry("tasktracker") if piggyback else None
+        if self._reg is not None:
+            self._task_hist = self._reg.histogram("sim_task_seconds")
+
+    # ------------------------------------------------------------ protocol
+
+    def heartbeat_once(self) -> None:
+        """One full heartbeat round: advance fake work, poll completion
+        events for gated reduces, send status, apply the response."""
+        if self.stopped:
+            return
+        self._poll_completion_events()
+        self._advance_tasks()
+        status = self._status_dict()
+        cpu, red = self._counts()
+        ask = cpu < self.cpu_slots or red < self.reduce_slots
+        resp = self.master.call("heartbeat", status,
+                                self._initial_contact, ask,
+                                self._response_id)
+        self._initial_contact = False
+        self._response_id = resp["response_id"]
+        self.heartbeats += 1
+        # delivered fetch-failure reports are done; ones appended since
+        # the snapshot would stay — mirrors NodeRunner's contract
+        sent_ff = len(status.get("fetch_failures", []))
+        if sent_ff:
+            del self._fetch_failures[:sent_ff]
+        # drop statuses whose SENT snapshot was terminal (same rule as
+        # the real tracker: a completion racing the RPC must survive)
+        for sd in status.get("task_statuses", []):
+            if sd["state"] in TaskState.TERMINAL:
+                self._running.pop(sd["attempt_id"], None)
+                self._kill_requested.discard(sd["attempt_id"])
+        for action in resp.get("actions", []):
+            self._apply_action(action)
+
+    def close(self) -> None:
+        self.stopped = True
+        self.master.close()
+
+    # ------------------------------------------------------------ fake work
+
+    def _counts(self) -> "tuple[int, int]":
+        cpu = red = 0
+        for t in self._running.values():
+            if t.status.state != TaskState.RUNNING:
+                continue
+            if t.status.is_map:
+                cpu += 1
+            else:
+                red += 1
+        return cpu, red
+
+    def _advance_tasks(self) -> None:
+        now = time.monotonic()
+        for aid, t in self._running.items():
+            st = t.status
+            if st.state != TaskState.RUNNING:
+                continue
+            if aid in self._kill_requested:
+                st.state = TaskState.KILLED
+                st.finish_time = time.time()
+                st.diagnostics = "killed by master action (simulated)"
+                continue
+            elapsed = now - t.started
+            if not st.is_map:
+                live = self._maps_live.get(t.job_id, {})
+                self._maybe_report_fetch_failure(t, live)
+                if len(live) < t.num_maps:
+                    # shuffle-gated: a reduce cannot finish before the
+                    # event feed showed it every map output
+                    st.progress = min(
+                        0.3, 0.3 * len(live) / max(1, t.num_maps))
+                    continue
+                st.phase = TaskPhase.REDUCE
+            if elapsed >= t.duration:
+                st.state = TaskState.SUCCEEDED
+                st.progress = 1.0
+                st.finish_time = time.time()
+                self.tasks_completed += 1
+                if self._reg is not None:
+                    self._reg.incr("sim_tasks_completed")
+                    self._task_hist.observe(t.duration)
+            else:
+                st.progress = min(0.99, elapsed / t.duration)
+
+    def _poll_completion_events(self) -> None:
+        """Per running reduce's job, one incremental completion-event
+        poll per beat — the real umbilical cadence, carried over the
+        same master RPC surface (and observed by its lag series)."""
+        jobs = {t.job_id for t in self._running.values()
+                if not t.status.is_map
+                and t.status.state == TaskState.RUNNING}
+        for job_id in jobs:
+            cursor = self._event_cursor.get(job_id, 0)
+            try:
+                events = self.master.call("get_map_completion_events",
+                                          job_id, cursor, 10_000)
+            except Exception:  # noqa: BLE001 — purged job / master load
+                continue
+            self._event_cursor[job_id] = cursor + len(events)
+            live = self._maps_live.setdefault(job_id, {})
+            for e in events:
+                idx = e.get("map_index")
+                if e.get("status") == "OBSOLETE":
+                    cur = live.get(idx)
+                    if cur is not None \
+                            and cur["attempt_id"] == e["attempt_id"]:
+                        del live[idx]
+                else:
+                    live[idx] = e
+
+    def _maybe_report_fetch_failure(self, t: _SimTask,
+                                    live: "dict[int, dict]") -> None:
+        """Optional chaos: with probability ``fetch_failure_rate`` per
+        beat, a running reduce reports one seen map output unfetchable —
+        driving the master's withdraw/re-execute path under load. Each
+        (reduce, map attempt) pair reports at most once, like a real
+        copier that penalty-boxes after reporting."""
+        if not self._fetch_failure_rate or not live:
+            return
+        if self._rng.random() >= self._fetch_failure_rate:
+            return
+        ev = live[self._rng.choice(list(live))]
+        key = (str(t.status.attempt_id), ev["attempt_id"])
+        if key in self._reported_ff:
+            return
+        self._reported_ff.add(key)
+        self._fetch_failures.append({
+            "map_attempt": ev["attempt_id"],
+            "reduce_attempt": str(t.status.attempt_id)})
+
+    # ------------------------------------------------------------ wire
+
+    def _status_dict(self) -> dict:
+        cpu, red = self._counts()
+        status = {
+            "tracker_name": self.name,
+            "host": f"sim-{self.name}",
+            "shuffle_addr": f"sim-{self.name}:0",
+            "shuffle_port": 0,
+            "max_cpu_map_slots": self.cpu_slots,
+            "max_tpu_map_slots": 0,
+            "quarantined_tpu_devices": [],
+            "max_reduce_slots": self.reduce_slots,
+            "count_cpu_map_tasks": cpu,
+            "count_tpu_map_tasks": 0,
+            "count_reduce_tasks": red,
+            "available_tpu_devices": [],
+            "available_memory_mb": -1,
+            "task_statuses": [t.status.to_dict()
+                              for t in self._running.values()],
+            "fetch_failures": list(self._fetch_failures),
+            "rack": DEFAULT_RACK,
+            "healthy": True,
+            "health_report": "",
+        }
+        if self._reg is not None:
+            status["metrics"] = {"tasktracker":
+                                 self._reg.typed_snapshot()}
+        return status
+
+    def _apply_action(self, action: dict) -> None:
+        kind = action.get("type")
+        if kind == "launch":
+            d = action["task"]
+            attempt = TaskAttemptID.parse(d["attempt_id"])
+            is_map = attempt.task.is_map
+            status = TaskStatus(
+                attempt_id=attempt, is_map=is_map,
+                state=TaskState.RUNNING,
+                phase=TaskPhase.MAP if is_map else TaskPhase.SHUFFLE,
+                run_on_tpu=bool(d.get("run_on_tpu", False)),
+                tpu_device_id=int(d.get("tpu_device_id", -1)))
+            self._running[d["attempt_id"]] = _SimTask(
+                action["job_id"], int(d.get("num_maps", 0)),
+                self._task_time(self._rng, is_map), status)
+        elif kind == "kill_task":
+            self._kill_requested.add(action["attempt_id"])
+        elif kind == "reinit":
+            self._running.clear()
+            self._kill_requested.clear()
+            self._fetch_failures.clear()
+            self._initial_contact = True
+            self._response_id = 0
+        elif kind == "disallowed":
+            self.stopped = True
+
+
+class SimFleet:
+    """N ``SimTracker``s on a fixed-rate heartbeat schedule, driven by a
+    bounded worker pool (hundreds of trackers don't need hundreds of
+    client threads — a beat is one blocking RPC)."""
+
+    def __init__(self, master_host: str, master_port: int,
+                 n_trackers: int, *, secret: "bytes | None" = None,
+                 interval_s: float = 0.2, workers: "int | None" = None,
+                 name_prefix: str = "sim", seed: int = 0,
+                 **tracker_kwargs: Any) -> None:
+        self.master_host, self.master_port = master_host, master_port
+        self.n = int(n_trackers)
+        self.interval_s = float(interval_s)
+        self.secret = secret
+        self.workers = workers or min(64, max(4, self.n // 4))
+        self._prefix = name_prefix
+        self._seed = seed
+        self._tracker_kwargs = tracker_kwargs
+        self.trackers: "list[SimTracker]" = []
+        self._heap: "list[tuple[float, int]]" = []
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._threads: "list[threading.Thread]" = []
+        # client-side observability (the harness's own view, independent
+        # of the master's): round-trip latency, schedule overrun, errors
+        self.registry = MetricsRegistry("simfleet")
+        self._rtt = self.registry.histogram("hb_rtt_seconds")
+        self._lag = self.registry.histogram("hb_lag_seconds")
+
+    def start(self) -> "SimFleet":
+        rng = random.Random(self._seed)
+        for i in range(self.n):
+            self.trackers.append(SimTracker(
+                f"{self._prefix}_{i:04d}", self.master_host,
+                self.master_port, secret=self.secret,
+                rng=random.Random(rng.randrange(1 << 30)),
+                **self._tracker_kwargs))
+        now = time.monotonic()
+        # stagger first beats across one interval so fleet start doesn't
+        # land as one synchronized thundering herd (unless saturation
+        # makes it one — which is then a real measurement)
+        self._heap = [(now + (i * self.interval_s) / max(1, self.n), i)
+                      for i in range(self.n)]
+        heapq.heapify(self._heap)
+        for w in range(self.workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"{self._prefix}-fleet-{w}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                while not self._stop.is_set():
+                    if not self._heap:
+                        self._cv.wait(0.05)
+                        continue
+                    due, idx = self._heap[0]
+                    wait = due - time.monotonic()
+                    if wait <= 0:
+                        heapq.heappop(self._heap)
+                        break
+                    self._cv.wait(min(wait, 0.05))
+                else:
+                    return
+            now = time.monotonic()
+            self._lag.observe(max(0.0, now - due))
+            tracker = self.trackers[idx]
+            if not tracker.stopped:
+                t0 = time.monotonic()
+                try:
+                    tracker.heartbeat_once()
+                    self._rtt.observe(time.monotonic() - t0)
+                except Exception:  # noqa: BLE001 — master down/overload
+                    self.registry.incr("hb_errors")
+            # fixed-rate schedule; when more than a full interval behind,
+            # skip ahead (the lag was recorded — re-queueing a backlog of
+            # missed beats would only spiral the overload)
+            nxt = due + self.interval_s
+            now = time.monotonic()
+            if nxt <= now:
+                nxt = now + self.interval_s
+            with self._cv:
+                if not tracker.stopped and not self._stop.is_set():
+                    heapq.heappush(self._heap, (nxt, idx))
+                self._cv.notify()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        for tr in self.trackers:
+            tr.close()
+
+    # ------------------------------------------------------------ read side
+
+    def stats(self) -> dict:
+        """Client-side summary: heartbeat round-trip and schedule-lag
+        distributions, error count, beats delivered, tasks completed."""
+        snap = self.registry.snapshot()
+        return {
+            "heartbeats": sum(t.heartbeats for t in self.trackers),
+            "tasks_completed": sum(t.tasks_completed
+                                   for t in self.trackers),
+            "hb_errors": snap.get("hb_errors", 0),
+            "hb_rtt": snap.get("hb_rtt_seconds",
+                               Histogram("x").snapshot()),
+            "hb_lag": snap.get("hb_lag_seconds",
+                               Histogram("x").snapshot()),
+        }
