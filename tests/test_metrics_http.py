@@ -727,6 +727,11 @@ class TestClusterMetricsE2E:
         assert "Merged distributions" in body
         assert "slot utilization" in body
         assert "Per-tracker gauges" in body
+        # the decomposed master locks are observable per class right on
+        # the page (wait vs hold for lock=global|trackers|scheduler)
+        assert "Master locks" in body
+        for which in ("global", "trackers", "scheduler"):
+            assert which in body
         # staleness signal on the per-tracker rows: a wedged tracker's
         # merged gauges persist, so without this column it looked
         # healthy until eviction

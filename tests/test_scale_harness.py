@@ -166,18 +166,23 @@ class TestSimFleetEndToEnd:
         master = _master()
         host, port = master.address
         fleet = SimFleet(host, port, 4, interval_s=0.05, cpu_slots=2,
-                         reduce_slots=1, task_time_mean_s=0.05).start()
+                         reduce_slots=1, task_time_mean_s=0.05,
+                         piggyback_interval_s=0.05).start()
         driver = ScaleDriver(host, port)
         try:
             res = driver.run_workload(2, 8, 2, timeout_s=30)
             assert not res["unfinished"] and not res["failed"], res
             snap = master.metrics.snapshot()
             jt = snap["jobtracker"]
-            # master-side saturation series all populated
+            # master-side saturation series all populated — the lock
+            # series are per decomposed lock class since PR 8
             assert jt["heartbeat_seconds"]["count"] > 0
             assert jt["heartbeat_lag_seconds"]["count"] > 0
-            assert jt["jt_lock_wait_seconds"]["count"] > 0
-            assert jt["jt_lock_hold_seconds"]["count"] > 0
+            for lock in ("global", "trackers", "scheduler"):
+                assert jt[f"jt_lock_wait_seconds|lock={lock}"][
+                    "count"] > 0, lock
+                assert jt[f"jt_lock_hold_seconds|lock={lock}"][
+                    "count"] > 0, lock
             assert jt["completion_event_lag"]["count"] > 0
             for phase in ("fold", "assign"):
                 assert jt[f"heartbeat_phase_seconds|phase={phase}"][
@@ -243,6 +248,12 @@ class TestSimFleetEndToEnd:
             assert "# TYPE tpumr_heartbeat_phase_seconds histogram" \
                 in body
             assert 'phase="fold"' in body and 'phase="assign"' in body
+            # per-lock wait/hold of the decomposed master locks render
+            # as ONE labeled family (satellite: the decomposition is
+            # observable on /metrics/prom)
+            assert "# TYPE tpumr_jt_lock_wait_seconds histogram" in body
+            for lock in ("global", "trackers", "scheduler"):
+                assert f'lock="{lock}"' in body, lock
         finally:
             fleet.stop()
             driver.close()
@@ -256,8 +267,7 @@ class TestSimFleetEndToEnd:
             t.heartbeat_once()   # initial contact registers
             assert t.heartbeats == 1
             # master restart amnesia: evict it, next beat gets reinit
-            with master.lock:
-                master._evict_tracker_locked("solo")
+            master._evict_tracker("solo")
             t.heartbeat_once()
             assert t._initial_contact is True and t._response_id == 0
             t.heartbeat_once()   # re-registers
@@ -358,6 +368,423 @@ class TestTraceVolumeControls:
         assert tracer.pending()[-1].name == f"s{total - 1}"
 
 
+# ------------------------------------------------------------ delta protocol
+
+
+class TestHeartbeatDelta:
+    def test_delta_reconstruction_and_per_beat_keys(self):
+        from tpumr.mapred.heartbeat import HeartbeatEncoder
+        master = _master()
+        try:
+            enc = HeartbeatEncoder(True)
+            full = _sim_status("d1")
+            r = master.heartbeat(enc.encode(dict(full)), True, False, 0)
+            enc.delivered()
+            assert master.trackers["d1"].status["host"] == "h1"
+            # idle beat: near-empty wire dict
+            wire = enc.encode(dict(full))
+            assert wire.get("delta") is True
+            assert set(wire) == {"tracker_name", "delta"}
+            r = master.heartbeat(wire, False, False, r["response_id"])
+            enc.delivered()
+            stored = master.trackers["d1"].status
+            # baseline keys inherited; per-beat keys are NOT
+            assert stored["host"] == "h1"
+            assert stored["max_cpu_map_slots"] == 1
+            assert not stored.get("task_statuses")
+            # a changed slot count rides the delta (and only it)
+            full["max_cpu_map_slots"] = 5
+            wire = enc.encode(dict(full))
+            assert wire["max_cpu_map_slots"] == 5
+            assert "host" not in wire
+            master.heartbeat(wire, False, False, r["response_id"])
+            enc.delivered()
+            assert master.trackers["d1"].status[
+                "max_cpu_map_slots"] == 5
+        finally:
+            master.stop()
+
+    def test_unknown_delta_gets_reinit(self):
+        master = _master()
+        try:
+            resp = master.heartbeat(
+                {"tracker_name": "ghost", "delta": True}, False, True, 7)
+            assert resp["actions"] == [{"type": "reinit"}]
+            assert "ghost" not in master.trackers
+        finally:
+            master.stop()
+
+    def test_failed_delivery_resets_to_full_status(self):
+        from tpumr.mapred.heartbeat import HeartbeatEncoder
+        enc = HeartbeatEncoder(True)
+        full = _sim_status("d2")
+        enc.encode(dict(full))
+        enc.delivered()
+        assert enc.encode(dict(full)).get("delta") is True
+        # an RPC failure leaves delivery unknown: next beat must be full
+        enc.reset()
+        wire = enc.encode(dict(full))
+        assert "delta" not in wire and wire["host"] == "h1"
+
+    def test_unchanged_metrics_piggyback_is_omitted(self):
+        from tpumr.mapred.heartbeat import HeartbeatEncoder
+        enc = HeartbeatEncoder(True)
+        full = _sim_status("d3")
+        m = {"tasktracker": {"counters": {"x": 1}}}
+        first = enc.encode(dict(full), m)
+        assert first["metrics"] == m
+        enc.delivered()
+        assert "metrics" not in enc.encode(dict(full), m)
+        # a delivered piggyback-less beat (the common case — piggyback
+        # intervals are longer than heartbeat intervals) must not
+        # clobber the baseline: the snapshot is STILL unchanged after
+        enc.encode(dict(full), None)
+        enc.delivered()
+        assert "metrics" not in enc.encode(dict(full), m)
+        changed = {"tasktracker": {"counters": {"x": 2}}}
+        assert enc.encode(dict(full), changed)["metrics"] == changed
+
+    def test_delta_disabled_sends_full_every_beat(self):
+        from tpumr.mapred.heartbeat import HeartbeatEncoder
+        enc = HeartbeatEncoder(False)
+        full = _sim_status("d4")
+        for _ in range(2):
+            wire = enc.encode(dict(full))
+            enc.delivered()
+            assert "delta" not in wire and wire["host"] == "h1"
+
+
+# ------------------------------------------------------------ replay path
+
+
+class TestReplayObservability:
+    def test_replayed_beat_observes_phase_and_lag_series(self):
+        """Satellite: a replayed heartbeat (stale response id) lands in
+        heartbeat_lag_seconds AND heartbeat_phase_seconds{phase=replay},
+        so replays are distinguishable from first deliveries."""
+        master = _master()
+        try:
+            st = _sim_status("r1")
+            r1 = master.heartbeat(dict(st), True, True, 0)
+            r2 = master.heartbeat(dict(st), False, True,
+                                  r1["response_id"])
+
+            def jt():
+                return master.metrics.snapshot()["jobtracker"]
+
+            replays = jt().get("heartbeat_phase_seconds|phase=replay",
+                               {}).get("count", 0)
+            lags = jt()["heartbeat_lag_seconds"]["count"]
+            # retry echoing the ALREADY-CONSUMED id: response was lost
+            r3 = master.heartbeat(dict(st), False, True,
+                                  r1["response_id"])
+            assert r3 == r2            # stored actions replayed
+            snap = jt()
+            assert snap["heartbeat_phase_seconds|phase=replay"][
+                "count"] == replays + 1
+            assert snap["heartbeat_lag_seconds"]["count"] == lags + 1
+        finally:
+            master.stop()
+
+
+# ------------------------------------------------------------ adaptive cadence
+
+
+class TestAdaptiveCadence:
+    def test_interval_scales_with_fleet_floor_and_cap(self):
+        """max(floor, fleet/rate), capped: small fleets keep the
+        configured floor; the instruction grows with registrations and
+        never exceeds the cap."""
+        master = _master({"tpumr.heartbeat.beats.per.second": 100,
+                          "tpumr.heartbeat.interval.max.ms": 120})
+        try:
+            first = master.heartbeat(_sim_status("ac000"), True, False, 0)
+            # one registered tracker: 1/100 s << the 50 ms floor
+            assert first["next_interval_ms"] == 50
+            for i in range(1, 20):
+                master.heartbeat(_sim_status(f"ac{i:03d}"), True,
+                                 False, 0)
+            # 20 trackers at 100 beats/s wants 200 ms — the cap wins
+            again = master.heartbeat(_sim_status("ac000"), False,
+                                     False, first["response_id"])
+            assert again["next_interval_ms"] == 120
+            assert master._mreg.snapshot()[
+                "heartbeat_interval_instructed_ms"] == 120
+        finally:
+            master.stop()
+
+    def test_rate_zero_always_instructs_the_floor(self):
+        master = _master()   # beats.per.second unset -> adaptation off
+        try:
+            for i in range(8):
+                r = master.heartbeat(_sim_status(f"off{i}"), True,
+                                     False, 0)
+            assert r["next_interval_ms"] == 50
+        finally:
+            master.stop()
+
+    def test_floor_above_cap_pins_the_cadence(self):
+        master = _master({"tpumr.heartbeat.beats.per.second": 1,
+                          "tpumr.heartbeat.interval.max.ms": 20})
+        try:
+            r = master.heartbeat(_sim_status("pin"), True, False, 0)
+            # operator pinned a 50 ms floor above the 20 ms cap: the
+            # floor wins (adaptation never speeds beats up)
+            assert r["next_interval_ms"] == 50
+        finally:
+            master.stop()
+
+    def test_replay_carries_current_interval(self):
+        master = _master({"tpumr.heartbeat.beats.per.second": 2})
+        try:
+            r1 = master.heartbeat(_sim_status("rp"), True, True, 0)
+            # mismatched response id -> the replay path must still
+            # instruct the cadence (1 tracker / 2 per s = 500 ms)
+            r2 = master.heartbeat(_sim_status("rp"), False, True, 999)
+            assert r2["response_id"] == r1["response_id"]
+            assert r2["next_interval_ms"] == 500
+        finally:
+            master.stop()
+
+    def test_sim_tracker_honors_instructed_interval(self):
+        master = _master({"tpumr.heartbeat.beats.per.second": 2})
+        host, port = master.address
+        tracker = SimTracker("ad0001", host, port)
+        try:
+            tracker.heartbeat_once()
+            assert tracker.next_interval_s == 0.5
+        finally:
+            tracker.close()
+            master.stop()
+
+    def test_node_runner_honors_instructed_interval(self):
+        """The REAL tracker reschedules its loop from the response —
+        two runners at 4 beats/s aggregate settle on 500 ms beats."""
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        base = JobConf()
+        base.set("tpumr.heartbeat.beats.per.second", 4)
+        with MiniMRCluster(num_trackers=2, conf=base) as c:
+            deadline = time.monotonic() + 15
+            want = [0.5, 0.5]
+            while time.monotonic() < deadline and \
+                    [t.heartbeat_s for t in c.trackers] != want:
+                time.sleep(0.05)
+            assert [t.heartbeat_s for t in c.trackers] == want
+
+
+# ------------------------------------------------------------ lock order
+
+
+class TestLockOrdering:
+    def test_descending_acquisition_raises_in_debug_mode(self):
+        from tpumr.metrics import locks
+        if not locks.ORDER_CHECK:
+            pytest.skip("lock-order checking disabled")
+        job = locks.InstrumentedRLock(name="job-x", rank=locks.RANK_JOB)
+        sched = locks.InstrumentedRLock(name="scheduler",
+                                        rank=locks.RANK_SCHEDULER)
+        with sched:      # scheduler -> job: the documented legal order
+            with job:
+                pass
+        with pytest.raises(AssertionError, match="lock-order violation"):
+            with job:    # job -> scheduler: the deadlock direction
+                with sched:
+                    pass
+        # the held stack unwound cleanly after the violation
+        with sched:
+            with job:
+                pass
+
+    def test_reentrancy_and_unranked_locks_exempt(self):
+        from tpumr.metrics import locks
+        job = locks.InstrumentedRLock(name="job-x", rank=locks.RANK_JOB)
+        plain = locks.InstrumentedRLock()          # unranked: exempt
+        with job:
+            with job:      # same-lock re-entrancy always legal
+                with plain:
+                    pass
+
+
+# ------------------------------------------------------------ event feed
+
+
+class TestCompletionEventFeed:
+    def test_cursor_reads_and_post_serve_backlog(self):
+        from tpumr.mapred.job_in_progress import CompletionEventFeed
+        feed = CompletionEventFeed()
+        for i in range(10):
+            feed.append({"map_index": i, "attempt_id": f"a{i}",
+                         "shuffle_addr": "x", "status": "SUCCEEDED"})
+        events, pending = feed.read(0, 4)
+        assert [e["map_index"] for e in events] == [0, 1, 2, 3]
+        assert pending == 6       # backlog AFTER the batch, not before
+        events, pending = feed.read(4, 100)
+        assert len(events) == 6 and pending == 0
+        events, pending = feed.read(10, 5)
+        assert events == [] and pending == 0
+        events, _ = feed.read(-3, 2)     # clamped, not wrapped
+        assert events[0]["map_index"] == 0
+        # list-like surface the eviction/withdrawal paths rely on
+        assert len(feed) == 10
+        assert feed[3]["attempt_id"] == "a3"
+        assert [e["map_index"] for e in feed][:3] == [0, 1, 2]
+
+
+# ------------------------------------------------------------ stress
+
+
+class TestLockDecompositionStress:
+    def test_concurrent_folds_and_polls_no_deadlock_no_lost_status(self):
+        """Satellite: N in-process trackers heartbeat concurrently into
+        ONE job (half of them speaking delta) while pollers hammer
+        get_map_completion_events — no deadlock, no lost terminal
+        status, and every poller sees a monotone, self-consistent
+        event feed."""
+        from tpumr.mapred.heartbeat import HeartbeatEncoder
+        from tpumr.mapred.ids import TaskAttemptID
+        from tpumr.mapred.task import TaskPhase, TaskState, TaskStatus
+
+        n_maps, n_trackers, n_pollers = 48, 6, 3
+        master = _master()
+        jid = master.submit_job(
+            {"user.name": "stress", "mapred.reduce.tasks": 0,
+             "mapred.speculative.execution": False},
+            [{} for _ in range(n_maps)])
+        jip = master.jobs[jid]
+        done = threading.Event()
+        errors: list = []
+
+        def tracker(i):
+            enc = HeartbeatEncoder(enabled=(i % 2 == 0))
+            name, rid, initial = f"st{i}", 0, True
+            running: dict = {}
+            try:
+                deadline = time.monotonic() + 60
+                while not done.is_set():
+                    if time.monotonic() > deadline:
+                        errors.append(f"{name}: never drained")
+                        return
+                    statuses = []
+                    for aid in list(running):
+                        a = TaskAttemptID.parse(aid)
+                        statuses.append(TaskStatus(
+                            attempt_id=a, is_map=True,
+                            state=TaskState.SUCCEEDED, progress=1.0,
+                            phase=TaskPhase.MAP,
+                            finish_time=time.time()).to_dict())
+                    full = dict(_sim_status(name), max_cpu_map_slots=2,
+                                task_statuses=statuses)
+                    resp = master.heartbeat(enc.encode(full), initial,
+                                            True, rid)
+                    enc.delivered()
+                    initial = False
+                    rid = resp["response_id"]
+                    for sd in statuses:
+                        running.pop(sd["attempt_id"], None)
+                    for act in resp["actions"]:
+                        if act["type"] == "launch":
+                            running[act["task"]["attempt_id"]] = act
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        poller_seen = [0] * n_pollers
+
+        def poller(pi):
+            cursor, seen = 0, []
+            try:
+                while not done.is_set():
+                    events = master.get_map_completion_events(
+                        jid, cursor, 10)
+                    # cursor-based serving: batches are contiguous and
+                    # an index, once served, never changes identity
+                    seen.extend(events)
+                    cursor += len(events)
+                    poller_seen[pi] = cursor
+                    time.sleep(0.001)
+                if len(seen) != n_maps:
+                    errors.append(f"poller saw {len(seen)}/{n_maps}")
+                if sorted(e["map_index"] for e in seen) != \
+                        list(range(n_maps)):
+                    errors.append("non-monotone/duplicated event feed")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=tracker, args=(i,))
+                   for i in range(n_trackers)]
+        threads += [threading.Thread(target=poller, args=(pi,))
+                    for pi in range(n_pollers)]
+        try:
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if jip.state != "RUNNING" and jip.finalized.is_set():
+                    break
+                time.sleep(0.01)
+            # let every poller drain the tail (deterministically — a
+            # fixed sleep flaked under ambient load)
+            drain = time.monotonic() + 20
+            while time.monotonic() < drain \
+                    and min(poller_seen) < n_maps:
+                time.sleep(0.01)
+            done.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not [t for t in threads if t.is_alive()], "deadlock"
+            assert not errors, errors
+            # no lost terminal status: every map completed exactly once
+            assert jip.state == "SUCCEEDED"
+            assert jip.finished_maps == n_maps
+            assert all(t.state == "succeeded" for t in jip.maps)
+            assert len(jip.completion_events) == n_maps
+        finally:
+            done.set()
+            master.stop()
+
+
+# ------------------------------------------------------------ delta e2e
+
+
+class TestDeltaHeartbeatEndToEnd:
+    def test_job_output_byte_identical_delta_on_vs_off(self):
+        """Acceptance: wordcount over a real mini-cluster produces
+        byte-identical output with delta heartbeats on vs off."""
+        from tpumr.fs import FileSystem, get_filesystem
+        from tpumr.mapred.job_client import JobClient
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+
+        def run(enabled):
+            base = JobConf()
+            base.set("tpumr.heartbeat.delta", enabled)
+            with MiniMRCluster(num_trackers=2, conf=base) as c:
+                fs = get_filesystem("mem:///")
+                fs.write_bytes("/hd/in.txt",
+                               b"".join(b"w%02d x\n" % (i % 23)
+                                        for i in range(3000)))
+                conf = c.create_job_conf()
+                conf.set_input_paths("mem:///hd/in.txt")
+                conf.set_output_path(f"mem:///hd/out-{enabled}")
+                conf.set("mapred.mapper.class",
+                         "tpumr.mapred.lib.TokenCountMapper")
+                conf.set("mapred.reducer.class",
+                         "tpumr.examples.basic.LongSumReducer")
+                conf.set_num_reduce_tasks(2)
+                conf.set("mapred.map.tasks", 4)
+                conf.set("mapred.min.split.size", 1)
+                result = JobClient(conf).run_job(conf)
+                assert result.successful
+                out = b"".join(
+                    fs.read_bytes(st.path)
+                    for st in sorted(
+                        fs.list_status(f"/hd/out-{enabled}"),
+                        key=lambda s: str(s.path))
+                    if "part-" in str(st.path))
+            FileSystem.clear_cache()
+            return out
+
+        assert run(True) == run(False)
+
+
 # ------------------------------------------------------------ prometheus
 
 
@@ -390,7 +817,10 @@ class TestBenchScale:
         for row in report["rows"]:
             for key in ("heartbeat_p50_s", "heartbeat_p99_s",
                         "heartbeat_lag_p99_s", "lock_wait_p99_s",
+                        "lock_wait_share", "lock_wait_trackers_p99_s",
+                        "lock_wait_scheduler_p99_s",
                         "assign_p99_s", "rpc_inflight_peak",
+                        "interval_instructed_ms",
                         "completed", "trackers"):
                 assert key in row, key
             assert row["completed"], row

@@ -98,11 +98,71 @@ def serialize(obj: Any, out: BinaryIO | None = None) -> bytes | None:
             return _TAG_TEXT + _vint_bytes(len(b)) + b
         if t is int:
             return _TAG_INT + _vint_bytes(zigzag(obj))
-        buf = BytesIO()
-        _write(buf, obj)
-        return buf.getvalue()
+        # container path: encode into ONE bytearray (append/extend are
+        # the cheapest byte sinks CPython has) instead of a BytesIO with
+        # a bytes((tag,)) allocation per element — RPC envelopes are
+        # dicts of ~40 small values and this runs per request/response
+        # on every heartbeat of every tracker. Byte-identical to _write.
+        buf = bytearray()
+        _enc(buf, obj)
+        return bytes(buf)
     _write(out, obj)
     return None
+
+
+def _vint_into(buf: bytearray, value: int) -> None:
+    if value < 0x80:
+        buf.append(value)
+        return
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            buf.append(b | 0x80)
+        else:
+            buf.append(b)
+            return
+
+
+def _enc(buf: bytearray, obj: Any) -> None:
+    """bytearray twin of :func:`_write` for the common value types
+    (exact-type dispatch; np scalars/arrays and subclasses fall back to
+    the general path through a one-element BytesIO round trip)."""
+    t = type(obj)
+    if t is str:
+        b = obj.encode("utf-8")
+        buf.append(_T_TEXT)
+        _vint_into(buf, len(b))
+        buf += b
+    elif t is int:
+        buf.append(_T_INT)
+        _vint_into(buf, zigzag(obj))
+    elif t is dict:
+        buf.append(_T_DICT)
+        _vint_into(buf, len(obj))
+        for k, v in obj.items():
+            _enc(buf, k)
+            _enc(buf, v)
+    elif t is bool:
+        buf.append(_T_BOOL_T if obj else _T_BOOL_F)
+    elif obj is None:
+        buf.append(_T_NULL)
+    elif t is float:
+        buf.append(_T_FLOAT)
+        buf += struct.pack(">d", obj)
+    elif t is list or t is tuple:
+        buf.append(_T_LIST)
+        _vint_into(buf, len(obj))
+        for item in obj:
+            _enc(buf, item)
+    elif t is bytes:
+        buf.append(_T_BYTES)
+        _vint_into(buf, len(obj))
+        buf += obj
+    else:
+        tmp = BytesIO()
+        _write(tmp, obj)
+        buf += tmp.getvalue()
 
 
 def _write(out: BinaryIO, obj: Any) -> None:

@@ -19,6 +19,8 @@ umbilical status and the host-shuffle fallback.
 from __future__ import annotations
 
 import hmac
+import os
+import selectors
 import socket
 import socketserver
 import struct
@@ -117,6 +119,43 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+class _FrameReader:
+    """Buffered frame reads for one connection: the naive path paid two
+    ``recv`` syscalls per frame (4-byte length, then payload); at
+    thousands of heartbeats/second on the master those syscalls are a
+    measurable share of the per-beat budget. One reader per connection,
+    single-threaded by construction (the client serializes calls on its
+    lock; the server runs one handler thread per connection)."""
+
+    __slots__ = ("_sock", "_buf")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self._sock = sock
+        self._buf = bytearray()
+
+    def _fill(self, n: int) -> None:
+        buf = self._buf
+        while len(buf) < n:
+            chunk = self._sock.recv(max(65536, n - len(buf)))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+
+    def frame_with_len(self) -> "tuple[Any, int]":
+        self._fill(4)
+        (length,) = _LEN.unpack_from(self._buf)
+        if length > MAX_FRAME:
+            raise RpcError(f"frame too large: {length}")
+        end = 4 + length
+        self._fill(end)
+        payload = bytes(self._buf[4:end])
+        del self._buf[:end]
+        return deserialize(payload), length
+
+    def frame(self) -> Any:
+        return self.frame_with_len()[0]
+
+
 def _send_frame(sock: socket.socket, obj: Any) -> None:
     payload = serialize(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
@@ -133,6 +172,27 @@ def _recv_frame(sock: socket.socket) -> Any:
     return _recv_frame_with_len(sock)[0]
 
 
+class _ConnCtx:
+    """Per-connection serving state shared by both transports (the
+    thread-per-connection handler and the reactor): the auth nonce, the
+    adopted client id, and the endpoints the signature canon / proxy
+    rules need (resolved once per connection, not per frame)."""
+
+    __slots__ = ("nonce", "cid", "port", "peer")
+
+    def __init__(self, port: int, peer: str = "", nonce: str = "") -> None:
+        self.nonce = nonce
+        self.port = port
+        self.peer = peer
+        # connection-adopted client id: unsecured clients send their cid
+        # on the FIRST request of a connection only (it's ~35 bytes of
+        # serialize/deserialize on every frame otherwise — measurable at
+        # fleet heartbeat rates); later frames inherit it here. Secured
+        # clients keep sending it per frame (the signature canon binds
+        # it), so the auth path is unchanged.
+        self.cid: Any = None
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def setup(self) -> None:
         self.server.track_connection(self.request)  # type: ignore[attr-defined]
@@ -141,183 +201,28 @@ class _Handler(socketserver.BaseRequestHandler):
         self.server.untrack_connection(self.request)  # type: ignore[attr-defined]
 
     def handle(self) -> None:
-        server: RpcServer = self.server  # type: ignore[assignment]
+        rpc: RpcServer = self.server.rpc  # type: ignore[attr-defined]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        nonce = ""
-        if server.secret is not None:
+        try:
+            ctx = _ConnCtx(port=sock.getsockname()[1],
+                           peer=sock.getpeername()[0])
+        except OSError:
+            return
+        if rpc.secret is not None:
             # authenticated servers open with a one-shot connection nonce
             # the client must fold into every signature (≈ SASL challenge)
             import secrets as _secrets
-            nonce = _secrets.token_hex(16)
+            ctx.nonce = _secrets.token_hex(16)
             try:
-                _send_frame(sock, {"hello": 1, "nonce": nonce})
+                _send_frame(sock, {"hello": 1, "nonce": ctx.nonce})
             except OSError:
                 return
+        reader = _FrameReader(sock)
         try:
             while True:
-                req, req_len = _recv_frame_with_len(sock)
-                secret = server.secret
-                scope = req.get("scope")
-                # defined for every request path: an UNSECURED server
-                # never enters the auth block below, yet the authz hook
-                # still reads these (a scoped frame against a
-                # secret-less daemon must not crash the handler)
-                verified_user = None
-                job_scoped = False
-                if secret is not None:
-                    import time as _time
-                    sig = req.get("auth")
-                    ts = req.get("ts")
-                    if not sig or ts is None:
-                        _send_frame(sock, {
-                            "id": req.get("id"),
-                            "error": "RpcAuthError: request not signed "
-                                     "with the expected secret"})
-                        continue
-                    # freshness BEFORE any resolver lookup: needs no
-                    # secret, so replayed/garbage frames never trigger
-                    # resolver work (which may do real lookups)
-                    if abs(_time.time() - ts) > AUTH_WINDOW_S:
-                        _send_frame(sock, {
-                            "id": req.get("id"),
-                            "error": "RpcAuthError: stale or missing "
-                                     "request timestamp (replay?)"})
-                        continue
-                    if scope is not None:
-                        # Scoped caller. Three scope families, all folded
-                        # into the signature canon (no re-labeling):
-                        #   user:<name>  — personal user key (derived
-                        #                  from the cluster secret)
-                        #   token:<hex>  — delegation token ident; the
-                        #                  signing secret is its password
-                        #   <job id>     — per-job token, restricted to
-                        #                  the scoped-method allowlist
-                        # Every failure mode yields the SAME error as a
-                        # bad signature — no oracle for which scopes
-                        # (job ids, users, tokens) exist.
-                        secret, verified_user, job_scoped = \
-                            server.rpc.resolve_scope(scope, req)
-                    my_port = sock.getsockname()[1]
-                    if secret is None or not hmac.compare_digest(
-                            sig, _sign(secret, req, my_port, nonce)):
-                        _send_frame(sock, {
-                            "id": req.get("id"),
-                            "error": "RpcAuthError: request not signed "
-                                     "with the expected secret"})
-                        continue
-                # client-side reconnect retries resend the same (cid, id):
-                # replay the cached response instead of re-executing, so
-                # non-idempotent methods (submit_job) never run twice
-                dedupe_key = (req.get("cid"), req.get("id"))
-                if req.get("cid") is not None:
-                    cached = server.response_cache_get(dedupe_key)
-                    if cached is not None:
-                        _send_frame(sock, cached)
-                        continue
-                    if secret is not None and not server.advance_hwm(
-                            req.get("cid"), req.get("id")):
-                        # id at/below this client's high-water mark and
-                        # not in the cache: a replayed old frame
-                        _send_frame(sock, {
-                            "id": req.get("id"),
-                            "error": "RpcAuthError: replayed request id"})
-                        continue
-                resp: dict[str, Any] = {"id": req.get("id")}
-                # saturation accounting: requests currently past auth/
-                # replay checks and occupying a handler (the master's
-                # rpc_inflight gauge — climbing toward the connection
-                # count means handlers can't drain the offered load)
-                server.rpc.note_dispatch_start()
-                try:
-                    if server.secret is not None and scope is not None \
-                            and job_scoped and req.get("method") not in \
-                            server.rpc.scoped_methods:
-                        raise RpcAuthError(
-                            f"method {req.get('method')!r} is not "
-                            "available to token-scoped callers")
-                    real_user = (verified_user if scope is not None
-                                 else None) or req.get("user")
-                    effective_user = real_user
-                    doas = req.get("doas")
-                    if doas is not None and (
-                            not isinstance(doas, str) or not doas.strip()):
-                        # an empty/garbage effective identity resolves
-                        # downstream to the DAEMON's own process user —
-                        # an escalation, not an impersonation
-                        raise RpcAuthError("invalid doas identity")
-                    if doas is not None:
-                        # impersonation ≈ ProxyUsers.authorize: the
-                        # REAL caller's credential signed this frame
-                        # (doas is in the canon); the proxy rules decide
-                        # whether it may act as the effective user
-                        proxy_conf = server.rpc.proxy_conf
-                        if proxy_conf is None:
-                            raise RpcAuthError(
-                                "impersonation is not enabled on this "
-                                "daemon")
-                        from tpumr.security.authorize import \
-                            authorize_proxy
-                        authorize_proxy(proxy_conf, str(real_user),
-                                        str(doas),
-                                        sock.getpeername()[0])
-                        effective_user = doas
-                    authz = server.rpc.authz
-                    if authz is not None:
-                        # service-level authorization (hadoop-policy.xml
-                        # tier): who may reach this protocol at all —
-                        # checked against the EFFECTIVE identity (the
-                        # reference authorizes the proxy UGI)
-                        authz.check(req.get("method"), effective_user)
-                    gate = server.rpc.request_gate
-                    if gate is not None and server.secret is not None:
-                        gate(req, verified_user if scope is not None
-                             else None,
-                             job_scoped if scope is not None else False)
-                    method = server.lookup(req["method"])
-                    # handlers see the EFFECTIVE identity; the real
-                    # caller stays available for audit
-                    # (current_rpc_real_user ≈ UGI.getRealUser)
-                    _current_user.user = effective_user
-                    _current_user.real = real_user if doas is not None \
-                        else None
-                    _current_user.scope = scope if server.secret is not None \
-                        else None
-                    # a proxied identity is only as verified as the
-                    # REAL credential behind it
-                    _current_user.verified = (server.secret is not None
-                                              and verified_user is not None)
-                    # per-method server-side latency + request-size
-                    # distributions (when the owning daemon wired a
-                    # registry). The size comes from the frame length
-                    # the transport ALREADY read — never re-serialized.
-                    # Histogram objects are cached per name, so the hot
-                    # path is one dict hit + one observe each.
-                    _mreg = server.rpc.metrics
-                    _t0 = time.monotonic() if _mreg is not None else 0.0
-                    try:
-                        resp["result"] = method(*req.get("params", []))
-                    finally:
-                        if _mreg is not None:
-                            from tpumr.metrics.histogram import BYTES
-                            _mname = "rpc_" + str(req.get("method", "")) \
-                                .replace(".", "_")
-                            _mreg.histogram(_mname).observe(
-                                time.monotonic() - _t0)
-                            _mreg.histogram(_mname + "_request_bytes",
-                                            BYTES).observe(req_len)
-                        _current_user.user = None
-                        _current_user.real = None
-                        _current_user.scope = None
-                        _current_user.verified = False
-                except Exception as e:  # noqa: BLE001 — remote surface
-                    resp["error"] = f"{type(e).__name__}: {e}"
-                    resp["traceback"] = traceback.format_exc(limit=8)
-                finally:
-                    server.rpc.note_dispatch_end()
-                if req.get("cid") is not None:
-                    server.response_cache_put(dedupe_key, resp)
-                _send_frame(sock, resp)
+                req, req_len = reader.frame_with_len()
+                _send_frame(sock, rpc.serve_request(ctx, req, req_len))
         except (ConnectionError, OSError):
             return
 
@@ -327,6 +232,220 @@ class _ThreadingServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
 
 
+class _Reactor:
+    """Selector-loop transport: every connection served from ONE thread
+    (≈ the reference's NIO reactor — Server.java:279 Listener/:320
+    Reader), with methods on the owning server's ``fast_methods``
+    allowlist executed INLINE in the loop and everything else handed to
+    a small handler pool (≈ the Handler pool, Server.java:1350).
+
+    Why it exists: the thread-per-connection transport costs a
+    many-hundred-tracker master two thread handoffs per heartbeat and
+    N mostly-idle handler threads churning the scheduler. At fleet
+    heartbeat rates the reactor thread stays hot — a ready frame is
+    usually served without a single context switch on the server.
+
+    The inline contract: a fast-path handler must be short and must
+    never block on anything that needs another RPC to THIS server to
+    resolve (it would deadlock the loop). The master's heartbeat fold /
+    event-feed reads qualify; submit_job's history I/O does not —
+    that's what the pool is for. Response sends are blocking with the
+    connection's socket timeout: control-plane responses are small
+    (a stuck peer times out and is dropped rather than wedging the
+    loop — the reference's async Responder exists for big payloads,
+    which this surface doesn't carry)."""
+
+    #: handler-pool width for non-fast methods (the reference default
+    #: was 10 Handler threads; dfs.namenode.handler.count etc.)
+    POOL_SIZE = 8
+
+    def __init__(self, rpc: "RpcServer", host: str, port: int) -> None:
+        self.rpc = rpc
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, port))
+        self._listen.listen(512)
+        self._listen.setblocking(False)
+        self._port = self._listen.getsockname()[1]
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._listen, selectors.EVENT_READ, None)
+        # wake pipe: stop() must interrupt a parked select() promptly
+        self._rpipe, self._wpipe = os.pipe()
+        self._sel.register(self._rpipe, selectors.EVENT_READ, "wake")
+        self._pool: "Any | None" = None
+        self._stopping = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    @property
+    def server_address(self) -> tuple:
+        return self._listen.getsockname()
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.POOL_SIZE, thread_name_prefix="rpc-handler")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="rpc-reactor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            os.write(self._wpipe, b"x")
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+        for fd in (self._rpipe, self._wpipe):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        try:
+            self._sel.close()   # the epoll fd leaks per stop otherwise
+        except OSError:
+            pass
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    # -------------------------------------------------------- the loop
+
+    def _loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                events = self._sel.select(0.5)
+            except OSError:
+                return
+            for key, _ in events:
+                if key.data is None:
+                    self._accept()
+                elif key.data == "wake":
+                    try:
+                        os.read(self._rpipe, 4096)
+                    except OSError:
+                        pass
+                else:
+                    self._on_readable(key.data)
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, addr = self._listen.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # blocking sends with a bound: a response to a stuck peer
+            # must drop the connection, never wedge the loop
+            sock.settimeout(30.0)
+            ctx = _ConnCtx(port=self._port, peer=addr[0])
+            if self.rpc.secret is not None:
+                import secrets as _secrets
+                ctx.nonce = _secrets.token_hex(16)
+                try:
+                    _send_frame(sock, {"hello": 1, "nonce": ctx.nonce})
+                except OSError:
+                    sock.close()
+                    continue
+            conn = _RConn(sock, ctx)
+            try:
+                self._sel.register(sock, selectors.EVENT_READ, conn)
+            except (ValueError, KeyError, OSError):
+                sock.close()
+                continue
+            self.rpc._track_connection(sock)
+
+    def _close(self, conn: "_RConn") -> None:
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        self.rpc._untrack_connection(conn.sock)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _on_readable(self, conn: "_RConn") -> None:
+        try:
+            data = conn.sock.recv(65536)
+        except (BlockingIOError, InterruptedError, socket.timeout):
+            return
+        except OSError:
+            self._close(conn)
+            return
+        if not data:
+            self._close(conn)
+            return
+        buf = conn.buf
+        buf.extend(data)
+        fast = self.rpc.fast_methods
+        while True:
+            if len(buf) < 4:
+                return
+            (length,) = _LEN.unpack_from(buf)
+            if length > MAX_FRAME:
+                self._close(conn)
+                return
+            end = 4 + length
+            if len(buf) < end:
+                return
+            payload = bytes(buf[4:end])
+            del buf[:end]
+            try:
+                req = deserialize(payload)
+            except Exception:  # noqa: BLE001 — garbage frame
+                self._close(conn)
+                return
+            if isinstance(req, dict) and req.get("method") in fast:
+                # the heartbeat fast path: parse → serve → respond on
+                # the reactor thread, zero handoffs
+                resp = self.rpc.serve_request(conn.ctx, req, length)
+                try:
+                    _send_frame(conn.sock, resp)
+                except OSError:
+                    self._close(conn)
+                    return
+            else:
+                # clients serialize calls per connection, so at most
+                # one pooled request per connection is in flight — no
+                # response interleaving to defend against
+                assert self._pool is not None
+                self._pool.submit(self._serve_pooled, conn, req, length)
+
+    def _serve_pooled(self, conn: "_RConn", req: Any, length: int) -> None:
+        try:
+            if not isinstance(req, dict):
+                raise RpcError(f"malformed request frame: {type(req)}")
+            resp = self.rpc.serve_request(conn.ctx, req, length)
+        except Exception as e:  # noqa: BLE001 — keep the pool alive
+            resp = {"id": req.get("id") if isinstance(req, dict) else None,
+                    "error": f"{type(e).__name__}: {e}"}
+        try:
+            _send_frame(conn.sock, resp)
+        except OSError:
+            pass  # the reactor notices the dead socket on next select
+
+
+class _RConn:
+    """One reactor-served connection: socket + receive buffer + the
+    transport-agnostic serving context."""
+
+    __slots__ = ("sock", "buf", "ctx")
+
+    def __init__(self, sock: socket.socket, ctx: _ConnCtx) -> None:
+        self.sock = sock
+        self.buf = bytearray()
+        self.ctx = ctx
+
+
 class RpcServer:
     """Exposes public methods of a handler object (and optional extra named
     protocols) over TCP."""
@@ -334,9 +453,15 @@ class RpcServer:
     RESPONSE_CACHE_SIZE = 2048
 
     def __init__(self, handler: Any, host: str = "127.0.0.1",
-                 port: int = 0, secret: "bytes | None" = None) -> None:
+                 port: int = 0, secret: "bytes | None" = None,
+                 reactor: bool = False,
+                 fast_methods: "set[str] | None" = None) -> None:
         self._handlers: dict[str, Any] = {"": handler}
         self.secret = secret
+        #: methods the reactor transport may execute INLINE in its
+        #: select loop (short, never block on another RPC to this
+        #: server); ignored by the thread-per-connection transport
+        self.fast_methods: "set[str]" = set(fast_methods or ())
         #: per-scope token lookup for scoped callers (job tokens):
         #: ``resolver(scope) -> bytes | None``. None = scoped frames are
         #: rejected (the default: only daemons hold the cluster secret).
@@ -380,20 +505,28 @@ class RpcServer:
         self._inflight = 0
         self._inflight_peak = 0
         self._inflight_lock = threading.Lock()
-        self._server = _ThreadingServer((host, port), _Handler)
-        self._server.secret = secret  # type: ignore[attr-defined]
-        # expose hooks on the socketserver instance for _Handler
-        self._server.rpc = self  # type: ignore[attr-defined]
-        self._server.lookup = self.lookup  # type: ignore[attr-defined]
-        self._server.response_cache_get = self.response_cache_get  # type: ignore[attr-defined]
-        self._server.response_cache_put = self.response_cache_put  # type: ignore[attr-defined]
-        self._server.track_connection = self._track_connection  # type: ignore[attr-defined]
-        self._server.untrack_connection = self._untrack_connection  # type: ignore[attr-defined]
+        self._reactor: "_Reactor | None" = None
+        if reactor:
+            self._reactor = _Reactor(self, host, port)
+            self._server: Any = self._reactor
+        else:
+            self._server = _ThreadingServer((host, port), _Handler)
+            # expose hooks on the socketserver instance for _Handler
+            self._server.rpc = self  # type: ignore[attr-defined]
+            self._server.track_connection = self._track_connection  # type: ignore[attr-defined]
+            self._server.untrack_connection = self._untrack_connection  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
-        self._resp_cache: "dict[tuple, Any]" = {}
-        self._resp_cache_lock = threading.Lock()
+        # response/replay caches STRIPED by client id: every request of
+        # every client passes through here, and one shared lock was a
+        # measurable cross-tracker convoy on the master's heartbeat
+        # path (a holder preempted mid-section stalls every handler)
+        self._resp_stripes = [
+            ({}, threading.Lock()) for _ in range(16)]
+        #: method -> (latency_hist, bytes_hist), read LOCK-FREE on the
+        #: dispatch path (GIL-atomic dict get; bounded because only
+        #: successfully looked-up method names reach it)
+        self._method_hists: "dict[str, tuple] | Any" = {}
         self._cid_hwm: dict[Any, int] = {}
-        self._server.advance_hwm = self.advance_hwm  # type: ignore[attr-defined]
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
 
@@ -404,6 +537,7 @@ class RpcServer:
     @metrics.setter
     def metrics(self, reg: "Any | None") -> None:
         self._metrics = reg
+        self._method_hists.clear()   # hist cache binds to one registry
         if reg is not None:
             # the server's saturation gauges live in the same registry
             # as the per-method latency hists: one scrape answers both
@@ -441,16 +575,194 @@ class RpcServer:
         with self._conns_lock:
             self._conns.discard(sock)
 
+    def serve_request(self, ctx: _ConnCtx, req: dict,
+                      req_len: int) -> "dict[str, Any]":
+        """Serve ONE parsed request frame to a response dict — the whole
+        auth → replay-dedupe → authorize → dispatch pipeline, transport
+        agnostic (called from per-connection handler threads, from the
+        reactor loop for fast-path methods, and from its handler pool
+        for the rest)."""
+        if "cid" in req:
+            ctx.cid = req["cid"]
+        else:
+            req["cid"] = ctx.cid
+        secret = self.secret
+        scope = req.get("scope")
+        # defined for every request path: an UNSECURED server never
+        # enters the auth block below, yet the authz hook still reads
+        # these (a scoped frame against a secret-less daemon must not
+        # crash the handler)
+        verified_user = None
+        job_scoped = False
+        if secret is not None:
+            import time as _time
+            sig = req.get("auth")
+            ts = req.get("ts")
+            if not sig or ts is None:
+                return {"id": req.get("id"),
+                        "error": "RpcAuthError: request not signed "
+                                 "with the expected secret"}
+            # freshness BEFORE any resolver lookup: needs no secret, so
+            # replayed/garbage frames never trigger resolver work
+            # (which may do real lookups)
+            if abs(_time.time() - ts) > AUTH_WINDOW_S:
+                return {"id": req.get("id"),
+                        "error": "RpcAuthError: stale or missing "
+                                 "request timestamp (replay?)"}
+            if scope is not None:
+                # Scoped caller. Three scope families, all folded
+                # into the signature canon (no re-labeling):
+                #   user:<name>  — personal user key (derived
+                #                  from the cluster secret)
+                #   token:<hex>  — delegation token ident; the
+                #                  signing secret is its password
+                #   <job id>     — per-job token, restricted to
+                #                  the scoped-method allowlist
+                # Every failure mode yields the SAME error as a
+                # bad signature — no oracle for which scopes
+                # (job ids, users, tokens) exist.
+                secret, verified_user, job_scoped = \
+                    self.resolve_scope(scope, req)
+            if secret is None or not hmac.compare_digest(
+                    sig, _sign(secret, req, ctx.port, ctx.nonce)):
+                return {"id": req.get("id"),
+                        "error": "RpcAuthError: request not signed "
+                                 "with the expected secret"}
+        # client-side reconnect retries resend the same (cid, id):
+        # replay the cached response instead of re-executing, so
+        # non-idempotent methods (submit_job) never run twice
+        dedupe_key = (req.get("cid"), req.get("id"))
+        if req.get("cid") is not None:
+            cached = self.response_cache_get(dedupe_key)
+            if cached is not None:
+                return cached
+            if self.secret is not None and not self.advance_hwm(
+                    req.get("cid"), req.get("id")):
+                # id at/below this client's high-water mark and not in
+                # the cache: a replayed old frame
+                return {"id": req.get("id"),
+                        "error": "RpcAuthError: replayed request id"}
+        resp: dict[str, Any] = {"id": req.get("id")}
+        # saturation accounting: requests currently past auth/replay
+        # checks and occupying a handler (the master's rpc_inflight
+        # gauge — climbing toward the connection count means handlers
+        # can't drain the offered load)
+        self.note_dispatch_start()
+        try:
+            if self.secret is not None and scope is not None \
+                    and job_scoped and req.get("method") not in \
+                    self.scoped_methods:
+                raise RpcAuthError(
+                    f"method {req.get('method')!r} is not "
+                    "available to token-scoped callers")
+            real_user = (verified_user if scope is not None
+                         else None) or req.get("user")
+            effective_user = real_user
+            doas = req.get("doas")
+            if doas is not None and (
+                    not isinstance(doas, str) or not doas.strip()):
+                # an empty/garbage effective identity resolves
+                # downstream to the DAEMON's own process user — an
+                # escalation, not an impersonation
+                raise RpcAuthError("invalid doas identity")
+            if doas is not None:
+                # impersonation ≈ ProxyUsers.authorize: the REAL
+                # caller's credential signed this frame (doas is in the
+                # canon); the proxy rules decide whether it may act as
+                # the effective user
+                proxy_conf = self.proxy_conf
+                if proxy_conf is None:
+                    raise RpcAuthError(
+                        "impersonation is not enabled on this daemon")
+                from tpumr.security.authorize import authorize_proxy
+                authorize_proxy(proxy_conf, str(real_user), str(doas),
+                                ctx.peer)
+                effective_user = doas
+            authz = self.authz
+            if authz is not None:
+                # service-level authorization (hadoop-policy.xml tier):
+                # who may reach this protocol at all — checked against
+                # the EFFECTIVE identity (the reference authorizes the
+                # proxy UGI)
+                authz.check(req.get("method"), effective_user)
+            gate = self.request_gate
+            if gate is not None and self.secret is not None:
+                gate(req, verified_user if scope is not None else None,
+                     job_scoped if scope is not None else False)
+            method = self.lookup(req["method"])
+            # handlers see the EFFECTIVE identity; the real caller
+            # stays available for audit
+            # (current_rpc_real_user ≈ UGI.getRealUser)
+            _current_user.user = effective_user
+            _current_user.real = real_user if doas is not None else None
+            _current_user.scope = scope if self.secret is not None \
+                else None
+            # a proxied identity is only as verified as the REAL
+            # credential behind it
+            _current_user.verified = (self.secret is not None
+                                      and verified_user is not None)
+            # per-method server-side latency + request-size
+            # distributions (when the owning daemon wired a registry).
+            # The size comes from the frame length the transport
+            # ALREADY read — never re-serialized. Histogram pairs are
+            # cached per method AFTER lookup succeeded (bogus names
+            # mint no series), read lock-free: the registry's own lock
+            # was a measurable per-request convoy at fleet heartbeat
+            # rates.
+            _hists = self.method_hists(req.get("method")) \
+                if self._metrics is not None else None
+            _t0 = time.monotonic() if _hists is not None else 0.0
+            try:
+                resp["result"] = method(*req.get("params", []))
+            finally:
+                if _hists is not None:
+                    _hists[0].observe(time.monotonic() - _t0)
+                    _hists[1].observe(req_len)
+                _current_user.user = None
+                _current_user.real = None
+                _current_user.scope = None
+                _current_user.verified = False
+        except Exception as e:  # noqa: BLE001 — remote surface
+            resp["error"] = f"{type(e).__name__}: {e}"
+            resp["traceback"] = traceback.format_exc(limit=8)
+        finally:
+            self.note_dispatch_end()
+        if req.get("cid") is not None:
+            self.response_cache_put(dedupe_key, resp)
+        return resp
+
+    def method_hists(self, method: Any) -> "tuple | None":
+        """(latency, request_bytes) histogram pair for one REAL method
+        (callers consult it only after lookup succeeded). The hit path
+        is a lock-free dict read; the miss path builds through the
+        registry once per method name."""
+        pair = self._method_hists.get(method)
+        if pair is None:
+            reg = self._metrics
+            if reg is None:
+                return None
+            from tpumr.metrics.histogram import BYTES
+            name = "rpc_" + str(method).replace(".", "_")
+            pair = (reg.histogram(name),
+                    reg.histogram(name + "_request_bytes", BYTES))
+            self._method_hists[method] = pair
+        return pair
+
+    def _resp_stripe(self, cid: Any) -> "tuple[dict, Any]":
+        return self._resp_stripes[hash(cid) & 15]
+
     def response_cache_get(self, key: tuple) -> Any | None:
-        with self._resp_cache_lock:
-            return self._resp_cache.get(key)
+        cache, lock = self._resp_stripe(key[0])
+        with lock:
+            return cache.get(key)
 
     def advance_hwm(self, cid: Any, req_id: Any) -> bool:
         """Per-client monotonic id check (replay defense under auth):
         returns False for an id at/below the high-water mark."""
         if not isinstance(req_id, int):
             return False
-        with self._resp_cache_lock:
+        _, lock = self._resp_stripe(cid)
+        with lock:
             hwm = self._cid_hwm.get(cid, 0)
             if req_id <= hwm:
                 return False
@@ -458,12 +770,14 @@ class RpcServer:
             return True
 
     def response_cache_put(self, key: tuple, resp: Any) -> None:
-        with self._resp_cache_lock:
-            if len(self._resp_cache) >= self.RESPONSE_CACHE_SIZE:
+        cache, lock = self._resp_stripe(key[0])
+        cap = max(2, self.RESPONSE_CACHE_SIZE // 16)
+        with lock:
+            if len(cache) >= cap:
                 # drop oldest half (insertion-ordered dict)
-                for k in list(self._resp_cache)[: self.RESPONSE_CACHE_SIZE // 2]:
-                    del self._resp_cache[k]
-            self._resp_cache[key] = resp
+                for k in list(cache)[: cap // 2]:
+                    del cache[k]
+            cache[key] = resp
 
     def resolve_scope(self, scope: Any,
                       req: dict) -> "tuple[bytes | None, str | None, bool]":
@@ -525,17 +839,23 @@ class RpcServer:
         return self.address[1]
 
     def start(self) -> "RpcServer":
+        if self._reactor is not None:
+            self._reactor.start()
+            return self
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="rpc-server", daemon=True)
         self._thread.start()
         return self
 
     def stop(self) -> None:
-        # shutdown() blocks forever if serve_forever never ran — only call
-        # it when start() actually happened
-        if self._thread is not None:
-            self._server.shutdown()
-        self._server.server_close()
+        if self._reactor is not None:
+            self._reactor.stop()
+        else:
+            # shutdown() blocks forever if serve_forever never ran — only
+            # call it when start() actually happened
+            if self._thread is not None:
+                self._server.shutdown()
+            self._server.server_close()
         # sever established connections too: a stopped server must not keep
         # answering RPCs through old handler threads (a restarted daemon on
         # the same port would otherwise never see its clients reconnect)
@@ -596,16 +916,22 @@ class RpcClient:
         self.envelope_provider: "Any | None" = None
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
+        self._reader: "_FrameReader | None" = None
         self._nonce = ""
         self._id = 0
         import uuid
         self._cid = uuid.uuid4().hex  # pairs with server response cache
+        #: has this connection already carried our cid? Unsecured
+        #: clients send it once per connection (the server adopts it);
+        #: secured clients resend it every frame (signature-bound)
+        self._cid_sent = False
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._reader = _FrameReader(s)
             if self.secret is not None:
                 # authenticated servers greet with a per-connection nonce;
                 # an unsecured server sends nothing — fail fast with a
@@ -613,7 +939,7 @@ class RpcClient:
                 # socket timeout (both sides would otherwise wait forever)
                 s.settimeout(min(5.0, self.timeout))
                 try:
-                    hello = _recv_frame(s)
+                    hello = self._reader.frame()
                 except (TimeoutError, socket.timeout):
                     s.close()
                     raise RpcAuthError(
@@ -637,17 +963,17 @@ class RpcClient:
             req["ts"] = _time.time()
             req["auth"] = _sign(self.secret, req, self.port, self._nonce)
 
-    @staticmethod
-    def _recv_resp(sock: socket.socket) -> Any:
+    def _recv_resp(self) -> Any:
         # a client configured without a secret may still receive an
         # authenticated server's hello frame first — skip past it (the
         # real response, an auth error, follows)
-        resp = _recv_frame(sock)
+        assert self._reader is not None
+        resp = self._reader.frame()
         while isinstance(resp, dict) and "hello" in resp:
-            resp = _recv_frame(sock)
+            resp = self._reader.frame()
         return resp
 
-    def call(self, method: str, *params: Any) -> Any:
+    def _build_req(self, method: str, params: tuple) -> dict:
         # caller identity rides every request (simple-auth assertion ≈ the
         # reference's UGI-in-ConnectionHeader); resolved per call so
         # UserGroupInformation.do_as scopes apply — unless a personal
@@ -657,37 +983,97 @@ class RpcClient:
         else:
             from tpumr.security import UserGroupInformation
             user = UserGroupInformation.get_current_user().user
-        with self._lock:
-            self._id += 1
-            req = {"id": self._id, "cid": self._cid, "method": method,
-                   "params": list(params), "user": user}
-            if self.scope is not None:
-                req["scope"] = self.scope
-            if self.doas is not None:
-                req["doas"] = self.doas
-            if self.envelope_provider is not None:
-                extra = self.envelope_provider(method, params)
-                if extra:
-                    req.update(extra)
-            try:
-                sock = self._connect()
-                self._stamp(req)
-                _send_frame(sock, req)
-                resp = self._recv_resp(sock)
-            except (ConnectionError, OSError):
-                # one reconnect attempt (server restart / idle drop);
-                # re-sign against the fresh connection's nonce
-                self.close_locked()
-                sock = self._connect()
-                self._stamp(req)
-                _send_frame(sock, req)
-                resp = self._recv_resp(sock)
+        self._id += 1
+        req = {"id": self._id, "method": method,
+               "params": list(params), "user": user}
+        if self.secret is not None or not self._cid_sent:
+            req["cid"] = self._cid
+        if self.scope is not None:
+            req["scope"] = self.scope
+        if self.doas is not None:
+            req["doas"] = self.doas
+        if self.envelope_provider is not None:
+            extra = self.envelope_provider(method, params)
+            if extra:
+                req.update(extra)
+        return req
+
+    @staticmethod
+    def _check_resp(resp: Any) -> Any:
         if "error" in resp:
             msg = resp["error"] + "\n[remote] " + resp.get("traceback", "")
             if resp["error"].startswith("RpcAuthError"):
                 raise RpcAuthError(msg)
             raise RpcError(msg)
         return resp.get("result")
+
+    def call(self, method: str, *params: Any) -> Any:
+        with self._lock:
+            req = self._build_req(method, params)
+            try:
+                sock = self._connect()
+                self._stamp(req)
+                _send_frame(sock, req)
+                resp = self._recv_resp()
+            except (ConnectionError, OSError):
+                # one reconnect attempt (server restart / idle drop);
+                # re-sign against the fresh connection's nonce. The
+                # retry MUST carry the cid: the new connection has not
+                # adopted it yet, and the server-side (cid, id) dedupe
+                # is what keeps a resent submit_job from running twice.
+                self.close_locked()
+                req["cid"] = self._cid
+                sock = self._connect()
+                self._stamp(req)
+                _send_frame(sock, req)
+                resp = self._recv_resp()
+            self._cid_sent = True
+        return self._check_resp(resp)
+
+    # ------------------------------------------------ pipelined calls
+    #
+    # Split call surface for fan-out load generators (the scale fleet):
+    # send many requests across many clients back-to-back, then collect
+    # the responses — the server overlaps its handling with the
+    # caller's next sends instead of ping-ponging one context switch
+    # per call. NOT thread-safe by design: a pipelining caller owns its
+    # clients for the whole begin/finish window (the fleet's worker
+    # sharding guarantees it); exactly one call_begin may be
+    # outstanding per client.
+
+    def call_begin(self, method: str, *params: Any) -> None:
+        """Send one request WITHOUT waiting for the response; pair with
+        :meth:`call_finish`. One reconnect retry, like :meth:`call`
+        (the request has not been received when the send itself
+        fails)."""
+        req = self._build_req(method, params)
+        try:
+            sock = self._connect()
+            self._stamp(req)
+            _send_frame(sock, req)
+        except (ConnectionError, OSError):
+            self.close_locked()
+            req["cid"] = self._cid
+            sock = self._connect()
+            self._stamp(req)
+            _send_frame(sock, req)
+        self._cid_sent = True
+
+    def call_finish(self) -> Any:
+        """Receive the response of the outstanding :meth:`call_begin`.
+        No resend on failure: delivery is UNKNOWN once the request went
+        out, and pipelined callers (heartbeats) have their own replay
+        protocol for exactly this case."""
+        try:
+            return self._check_resp(self._recv_resp())
+        except (ConnectionError, OSError):
+            # the stream may still deliver this response LATE; reusing
+            # the connection would hand that stale frame to the next
+            # call_finish (responses carry no request id) and desync
+            # every call after it — drop the connection so the next
+            # call starts clean, like call()'s error path
+            self.close_locked()
+            raise
 
     def close_locked(self) -> None:
         if self._sock is not None:
@@ -696,6 +1082,8 @@ class RpcClient:
             except OSError:
                 pass
             self._sock = None
+            self._reader = None
+            self._cid_sent = False   # the next connection re-introduces it
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,123 @@
+"""Heartbeat wire encoding — full statuses vs change-only deltas.
+
+A tracker's status dict is mostly static: slot maxima, host names,
+device lists, and health flags change rarely, yet every beat used to
+re-ship (and the master to re-deserialize and re-store) all of them.
+With delta encoding (``tpumr.heartbeat.delta``, default on) a tracker
+sends the FULL status on initial contact and, afterwards, only the keys
+whose values changed since the last beat the master is known to have
+received — so an idle tracker's beat shrinks to a near-empty dict
+(``rpc_heartbeat_request_bytes`` is the series that shows it) and the
+master's per-beat fold touches proportionally less state.
+
+Three key classes:
+
+- **baseline keys** (slot counts, devices, health, memory): shipped
+  only when changed; the master inherits the previous value otherwise.
+- **per-beat keys** (``task_statuses``, ``fetch_failures``): describe
+  THIS beat only — shipped when non-empty, never inherited by the
+  master (a delta without them means "none this beat", not "same as
+  last beat").
+- **metrics piggyback**: cumulative by design (metrics/cluster.py), so
+  an unchanged snapshot is safely omitted — the master's fold of the
+  last one already holds. Idle trackers skip both the merge cost and
+  the bytes.
+
+Delivery contract: the encoder diffs against the last status the
+master has SEEN. ``delivered()`` commits a beat's baseline only after
+the RPC returned; any failed/uncertain call must ``reset()`` so the
+next beat re-ships the full status (a delta against a baseline the
+master never stored — or stored a newer version of — would silently
+corrupt its view: a key that changed and changed back across a lost
+beat would never be corrected). A master that has no baseline for a
+delta (restart, eviction) answers ``reinit``, which also resets the
+encoder via the tracker's normal reinit handling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: status keys that describe one beat and are never inherited when the
+#: master reconstructs a full status from a delta
+PER_BEAT_KEYS = ("task_statuses", "fetch_failures", "metrics")
+
+_MISSING = object()
+
+
+class HeartbeatEncoder:
+    """Client-side (tracker) half of the delta protocol."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = bool(enabled)
+        self._base: "dict | None" = None
+        self._metrics: Any = None
+        self._pending: "tuple[dict, Any] | None" = None
+
+    def encode(self, full: dict, metrics: Any = None) -> dict:
+        """The wire status for one beat: ``full`` verbatim (plus the
+        piggyback) when delta is off or no delivered baseline exists,
+        else a change-only dict flagged ``delta: True``. Call
+        :meth:`delivered` after the RPC succeeds."""
+        base = {k: v for k, v in full.items() if k not in PER_BEAT_KEYS}
+        self._pending = (base, metrics)
+        if not self.enabled or self._base is None:
+            status = dict(full)
+            if metrics is not None:
+                status["metrics"] = metrics
+            return status
+        prev = self._base
+        status: dict = {"tracker_name": full.get("tracker_name"),
+                        "delta": True}
+        for k, v in base.items():
+            if prev.get(k, _MISSING) != v:
+                status[k] = v
+        for k in ("task_statuses", "fetch_failures"):
+            if full.get(k):
+                status[k] = full[k]
+        if metrics is not None and metrics != self._metrics:
+            status["metrics"] = metrics
+        return status
+
+    def will_delta(self) -> bool:
+        """Will the next :meth:`encode` produce a change-only beat?
+        Callers use this to bypass their own per-key suppression (e.g.
+        the RUNNING-status report-rate limit) when a FULL beat is due —
+        a full beat must carry everything, it resets the master's
+        believed-running set."""
+        return self.enabled and self._base is not None
+
+    def delivered(self) -> None:
+        """The master received the last encoded beat — its view now
+        includes that beat, so future deltas may build on it."""
+        if self._pending is not None:
+            base, metrics = self._pending
+            self._base = base
+            # a piggyback-less beat leaves the master's last-merged
+            # metrics untouched — clobbering the baseline to None here
+            # would make every later unchanged snapshot look new and
+            # re-ship it, defeating the suppression
+            if metrics is not None:
+                self._metrics = metrics
+            self._pending = None
+
+    def reset(self) -> None:
+        """Forget the baseline (failed RPC, reinit): the next beat
+        ships the full status."""
+        self._base = None
+        self._metrics = None
+        self._pending = None
+
+
+def fold_delta(prev_full: dict, status: dict) -> dict:
+    """Master-side half: reconstruct a full status from a change-only
+    beat against the previous full status. A non-delta ``status``
+    passes through (minus any stray flag). Per-beat keys never inherit
+    from ``prev_full`` — absent means none this beat."""
+    if not status.get("delta"):
+        status.pop("delta", None)
+        return status
+    full = {k: v for k, v in prev_full.items() if k not in PER_BEAT_KEYS}
+    full.update(status)
+    full.pop("delta", None)
+    return full

@@ -4,6 +4,11 @@
 src/mapred/org/apache/hadoop/mapred/JobID.java etc.) with the same string
 shapes: ``job_<cluster>_<n>``, ``task_<cluster>_<n>_[mr]_<t>``,
 ``attempt_<cluster>_<n>_[mr]_<t>_<a>``.
+
+``__str__`` is memoized on each (frozen, hence immutable) instance: the
+master's heartbeat fast path stringifies ids hundreds of times per beat
+(job-table keys, status folds, kill scans), and rebuilding the f-string
+each time was profiling-visible at fleet scale.
 """
 
 from __future__ import annotations
@@ -17,7 +22,11 @@ class JobID:
     id: int
 
     def __str__(self) -> str:
-        return f"job_{self.cluster}_{self.id:04d}"
+        s = self.__dict__.get("_str")
+        if s is None:
+            s = f"job_{self.cluster}_{self.id:04d}"
+            object.__setattr__(self, "_str", s)
+        return s
 
     @classmethod
     def parse(cls, s: str) -> "JobID":
@@ -32,8 +41,13 @@ class TaskID:
     id: int
 
     def __str__(self) -> str:
-        kind = "m" if self.is_map else "r"
-        return f"task_{self.job.cluster}_{self.job.id:04d}_{kind}_{self.id:06d}"
+        s = self.__dict__.get("_str")
+        if s is None:
+            kind = "m" if self.is_map else "r"
+            s = (f"task_{self.job.cluster}_{self.job.id:04d}_{kind}_"
+                 f"{self.id:06d}")
+            object.__setattr__(self, "_str", s)
+        return s
 
     @classmethod
     def parse(cls, s: str) -> "TaskID":
@@ -47,10 +61,14 @@ class TaskAttemptID:
     attempt: int
 
     def __str__(self) -> str:
-        t = self.task
-        kind = "m" if t.is_map else "r"
-        return (f"attempt_{t.job.cluster}_{t.job.id:04d}_{kind}_"
-                f"{t.id:06d}_{self.attempt}")
+        s = self.__dict__.get("_str")
+        if s is None:
+            t = self.task
+            kind = "m" if t.is_map else "r"
+            s = (f"attempt_{t.job.cluster}_{t.job.id:04d}_{kind}_"
+                 f"{t.id:06d}_{self.attempt}")
+            object.__setattr__(self, "_str", s)
+        return s
 
     @classmethod
     def parse(cls, s: str) -> "TaskAttemptID":

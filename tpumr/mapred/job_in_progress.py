@@ -29,6 +29,54 @@ from typing import Any
 from tpumr.core.counters import Counters
 from tpumr.mapred.ids import JobID, TaskAttemptID, TaskID
 from tpumr.mapred.task import Task, TaskReport, TaskState, TaskStatus
+from tpumr.metrics.locks import RANK_JOB, InstrumentedRLock
+
+
+class CompletionEventFeed:
+    """Append-only completion-event feed with LOCK-FREE reads.
+
+    Writers — the master's status fold, under the job lock — only ever
+    ``append()`` or flip an existing event's ``status`` value in place
+    (the OBSOLETE withdrawal mark); events are never removed or
+    reordered, so an index, once served, names the same event forever.
+    Readers slice by cursor WITHOUT any lock: under CPython's GIL a
+    list slice concurrent with appends returns a consistent prefix, and
+    an in-place ``status`` overwrite is a single atomic value store on
+    a dict whose shape never changes. A reader racing a withdrawal sees
+    either SUCCEEDED (and later the appended tombstone at a higher
+    index) or OBSOLETE directly — both orderings the PR-1 protocol
+    already handles. This is what lets ``get_map_completion_events``
+    serve reducer polls while the fold appends, with neither touching
+    the job lock (PR 8's lock decomposition).
+    """
+
+    __slots__ = ("_events",)
+
+    def __init__(self) -> None:
+        self._events: "list[dict]" = []
+
+    def append(self, event: dict) -> None:
+        self._events.append(event)
+
+    def read(self, from_index: int, max_events: int) -> "tuple[list, int]":
+        """One cursor-based incremental poll: up to ``max_events``
+        events from ``from_index``, plus the backlog REMAINING after
+        this batch (0 when the poll fully caught up — the lag series
+        must measure what a poller couldn't drain, not the volume it
+        drained fine, or it grows with job width forever)."""
+        total = len(self._events)
+        frm = max(0, int(from_index))
+        events = self._events[frm:frm + max(0, int(max_events))]
+        return events, max(0, total - frm - len(events))
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def __getitem__(self, i: Any) -> Any:
+        return self._events[i]
+
+    def __iter__(self) -> Any:
+        return iter(self._events)
 
 
 class JobState:
@@ -106,7 +154,12 @@ class JobInProgress:
         self.start_time = time.time()
         self.finish_time = 0.0
         self.counters = Counters()
-        self.lock = threading.RLock()
+        # rank-ordered (metrics/locks.py): the job lock is the BOTTOM of
+        # the master's lock order — the status fold and the scheduler's
+        # obtain calls take it while holding nothing above it, and
+        # nothing acquired under it may reach back up (scheduler → job,
+        # never the reverse; asserted in debug mode)
+        self.lock = InstrumentedRLock(name=f"job-{job_id}", rank=RANK_JOB)
         self.max_map_attempts = int(self.conf.get("mapred.map.max.attempts", 4))
         self.max_reduce_attempts = int(self.conf.get("mapred.reduce.max.attempts", 4))
         #: distinct reducers that must report a map attempt's output
@@ -118,6 +171,8 @@ class JobInProgress:
         self.slowstart = float(self.conf.get(
             "mapred.reduce.slowstart.completed.maps", 0.05))
         self.speculative = bool(self.conf.get("mapred.speculative.execution", True))
+        #: lazily memoized has_kernel() answer (kernel conf is submit-fixed)
+        self._has_kernel: "bool | None" = None
         # ≈ mapred.reduce.tasks.speculative.execution: reduces speculate
         # too (JobInProgress.java:257,739,2320 hasSpeculativeReduces /
         # findSpeculativeTask) — a straggling reduce ends every job, so
@@ -173,6 +228,12 @@ class JobInProgress:
         #: attempts a scheduler marked for preemption (kill-not-fail);
         #: cleared when the attempt's terminal status arrives
         self._preempt_requested: set[str] = set()
+        #: RUNNING attempts with a kill pending (speculative-race
+        #: losers, preemptions, operator kills) — maintained at the
+        #: points where an attempt BECOMES a kill candidate so the
+        #: heartbeat kill scan is a lock-free set probe instead of a
+        #: per-attempt job-lock round trip re-deriving it every beat
+        self._kill_marked: set[str] = set()
         #: attempts whose operator kill must count as FAILED (-fail-task)
         self._fail_requested: set[str] = set()
         # --- per-backend profiling (running sums, O(1) per update) ---
@@ -187,8 +248,9 @@ class JobInProgress:
         # APPEND-ONLY: consumers read incrementally by cursor, so a
         # withdrawn map output is marked status=OBSOLETE in place AND
         # re-announced as a tombstone event — never removed (removal
-        # would shift indices under every live cursor)
-        self.completion_events: list[dict] = []
+        # would shift indices under every live cursor). The feed object
+        # makes reducer polls lock-free against the appending fold.
+        self.completion_events = CompletionEventFeed()
         #: map attempt -> distinct reduce attempts reporting its output
         #: unfetchable (the "too many fetch failures" ledger)
         self._fetch_failures: dict[str, set[str]] = {}
@@ -262,9 +324,15 @@ class JobInProgress:
     def has_kernel(self) -> bool:
         """≈ the hadoop.pipes.gpu.executable gate
         (JobQueueTaskScheduler.java:342-347): only jobs with a device kernel
-        OR a TPU pipes executable are eligible for TPU slots."""
-        return bool(self.conf.get("tpumr.map.kernel")
-                    or self.conf.get("tpumr.pipes.tpu.executable"))
+        OR a TPU pipes executable are eligible for TPU slots. Memoized —
+        the kernel conf is fixed at submit, and the scheduler consults
+        this per job per pass on the heartbeat fast path."""
+        v = self._has_kernel
+        if v is None:
+            v = self._has_kernel = bool(
+                self.conf.get("tpumr.map.kernel")
+                or self.conf.get("tpumr.pipes.tpu.executable"))
+        return v
 
     def tpu_eligible(self) -> bool:
         """May the scheduler's TPU pass offer this job work? The kernel
@@ -277,6 +345,13 @@ class JobInProgress:
         while any of these exist, or they can never be assigned."""
         with self.lock:
             return len(self._pending_maps & self._cpu_only_maps)
+
+    def has_accel_events(self) -> bool:
+        """Lock-free emptiness hint so the heartbeat fold can skip the
+        drain's lock round trip on the (overwhelmingly common) beat
+        with no demotion/quarantine decisions. May be stale by one
+        beat; the next fold drains whatever it missed."""
+        return bool(self._accel_events)
 
     def drain_accel_events(self) -> "list[dict]":
         """Demotion/quarantine decisions since the last drain (consumed
@@ -433,6 +508,11 @@ class JobInProgress:
             return (tip is not None and tip.state == "succeeded"
                     and tip.successful_attempt != attempt_id)
 
+    def kill_marked(self, attempt_id: str) -> bool:
+        """Lock-free kill-scan probe (see ``_kill_marked``); a mark set
+        mid-probe is caught on the next beat."""
+        return attempt_id in self._kill_marked
+
     def request_preempt(self, attempt_id: str) -> None:
         """Mark a RUNNING attempt for preemption: the next heartbeat of its
         tracker carries a kill action; the KILLED report requeues the TIP
@@ -440,6 +520,7 @@ class JobInProgress:
         the reference kills tasks of over-share pools the same way)."""
         with self.lock:
             self._preempt_requested.add(attempt_id)
+            self._kill_marked.add(attempt_id)
 
     def request_attempt_kill(self, attempt_id: str,
                              fail: bool = False) -> bool:
@@ -459,6 +540,7 @@ class JobInProgress:
                 # to kill (the reference's killTask returns false too)
                 return False
             self._preempt_requested.add(attempt_id)
+            self._kill_marked.add(attempt_id)
             if fail:
                 self._fail_requested.add(attempt_id)
             return True
@@ -571,6 +653,7 @@ class JobInProgress:
                 return
             if status.state in TaskState.TERMINAL:
                 self._preempt_requested.discard(aid_s)
+                self._kill_marked.discard(aid_s)
                 if status.state == TaskState.KILLED \
                         and aid_s in self._fail_requested:
                     # -fail-task: the tracker reports the kill as KILLED;
@@ -586,6 +669,13 @@ class JobInProgress:
                 self._fail_requested.discard(aid_s)
             tip.attempts[str(status.attempt_id)] = status
             tip.report.progress = max(tip.report.progress, status.progress)
+            if status.state == TaskState.RUNNING \
+                    and tip.state == "succeeded" \
+                    and tip.successful_attempt != aid_s:
+                # a speculative loser reporting progress after its twin
+                # already won (possibly its FIRST report): mark it so
+                # the kill scan catches it without re-deriving the race
+                self._kill_marked.add(aid_s)
             if status.state == TaskState.SUCCEEDED:
                 self._on_success(tip, status, tracker_shuffle_addr)
             elif status.state in (TaskState.FAILED, TaskState.KILLED):
@@ -601,6 +691,13 @@ class JobInProgress:
             return  # a speculative duplicate — first completion wins
         tip.state = "succeeded"
         tip.successful_attempt = str(status.attempt_id)
+        # the losing speculative twins (any other attempt still RUNNING)
+        # get their kill marks NOW — the heartbeat kill scan reads the
+        # mark set lock-free instead of re-deriving the race per beat
+        for other_aid, other in tip.attempts.items():
+            if other_aid != tip.successful_attempt \
+                    and other.state == TaskState.RUNNING:
+                self._kill_marked.add(other_aid)
         tip.report.state = TaskState.SUCCEEDED
         tip.report.progress = 1.0
         tip.report.finish_time = status.finish_time or time.time()
@@ -864,6 +961,7 @@ class JobInProgress:
                 # a lost attempt is terminal either way — a pending preempt
                 # mark must not linger as a phantom in-flight kill
                 self._preempt_requested.discard(aid)
+                self._kill_marked.discard(aid)
                 st = tip.attempts.get(aid)
                 if st is not None and st.state == TaskState.RUNNING:
                     # honor a pending -fail-task even when the tracker

@@ -22,6 +22,33 @@ the heartbeat endpoint. Reproduced contracts:
 Structural divergence (by design, SURVEY.md §3.2): no global synchronized
 heartbeat monitor around O(jobs×tasks) recomputation — job profiling uses
 O(1) running sums and the master lock only guards registries.
+
+Lock decomposition (PR 8 — the reference's single synchronized monitor
+is exactly the ~200-tracker wall bench_scale.json measured): the
+heartbeat fast path touches the GLOBAL lock briefly or not at all.
+
+- ``self.lock`` (rank ``global``) guards only the job table, commit
+  grants, and admin swaps; the job table itself is insert-only, so
+  lookups (``self.jobs.get``) are lock-free dict reads under the GIL.
+- the tracker registry is striped (``tracker_registry.TrackerRegistry``,
+  rank ``trackers``): heartbeats from different trackers never contend
+  on registration/status-store, and the response-replay cache
+  (``self._last_response``) is read and written lock-free (single-key
+  dict ops are GIL-atomic; each tracker's beats are serialized by its
+  own ``hb_lock``, so a retry can never interleave with its original).
+- the per-task STATUS FOLD, accel-event drain, and fetch-failure
+  protocol run under the per-job locks only (``JobInProgress.lock``,
+  rank ``job``).
+- ``get_map_completion_events`` serves from the append-only
+  ``CompletionEventFeed`` with NO lock at all — reducer polls never
+  queue behind the fold.
+- scheduler entry (``before_heartbeat`` / ``assign_tasks``) runs under
+  a dedicated ``sched_lock`` (rank ``scheduler``); the ordering rule —
+  scheduler → job, never the reverse — is asserted in debug mode
+  (metrics/locks.py).
+
+Each lock class feeds ``jt_lock_wait_seconds{lock=global|trackers|
+scheduler}`` (+ hold twins) so the decomposition itself is observable.
 """
 
 from __future__ import annotations
@@ -32,7 +59,7 @@ from typing import Any
 
 from tpumr.ipc.rpc import RpcServer
 from tpumr.mapred.history import JobHistory
-from tpumr.mapred.ids import JobID
+from tpumr.mapred.ids import JobID, TaskAttemptID
 from tpumr.mapred.jobconf import JobConf
 from tpumr.mapred.job_in_progress import (JobInProgress, JobState,
                                           normalize_priority)
@@ -84,26 +111,97 @@ class _TrackerInfo:
         self.seen_mono = time.monotonic()
         self.failures = 0
         self.blacklisted = False
+        #: the heartbeat interval the master last INSTRUCTED this
+        #: tracker to keep (adaptive cadence); lag is judged against
+        #: the schedule the tracker was actually told to run. None
+        #: until the first response (use the configured floor).
+        self.interval_s: "float | None" = None
+        #: serializes THIS tracker's heartbeat processing end-to-end:
+        #: a retry racing its own lost original must fold after it and
+        #: hit the replay cache, never double-assign. Different
+        #: trackers' beats never touch each other's lock — this is the
+        #: bottom rank of the master's lock order, held across the
+        #: fold/assign phases while the shard lock is not.
+        from tpumr.metrics.locks import (RANK_TRACKER_BEAT,
+                                         InstrumentedRLock)
+        self.hb_lock = InstrumentedRLock(name="tracker-beat",
+                                         rank=RANK_TRACKER_BEAT)
+        #: fault charges arrive from OTHER trackers' heartbeats too
+        #: (fetch-failure blame), so the counter needs its own tiny
+        #: leaf lock now that the global lock no longer covers it
+        self._fault_lock = threading.Lock()
+        #: attempts the master believes are RUNNING on this tracker —
+        #: maintained from launch actions + folded statuses (under
+        #: ``hb_lock``) because delta beats may suppress unchanged
+        #: RUNNING statuses: the last beat's ``task_statuses`` list is
+        #: no longer the full picture, and eviction/kill scans need one
+        self.running: "set[str]" = set()
 
     @property
     def name(self) -> str:
         return self.status["tracker_name"]
+
+    def fold_status(self, status: dict) -> dict:
+        """Store one beat's status — reconstructing the full dict first
+        when the tracker sent a change-only delta — and stamp the
+        lease. Returns the full status the rest of the heartbeat works
+        on. Caller holds the registry shard lock."""
+        from tpumr.mapred.heartbeat import fold_delta
+        status = fold_delta(self.status, status)
+        self.status = status
+        self.last_seen = time.time()
+        self.seen_mono = time.monotonic()
+        return status
+
+    def charge_fault(self, limit: int) -> bool:
+        """One blacklist fault (failed task / lost shuffle output).
+        Returns True when THIS fault newly blacklisted the tracker (the
+        master keeps an approximate blacklist count off it)."""
+        with self._fault_lock:
+            self.failures += 1
+            if self.failures >= limit and not self.blacklisted:
+                self.blacklisted = True
+                return True
+            return False
 
 
 class JobMaster:
     def __init__(self, conf: Any, host: str = "127.0.0.1",
                  port: int = 0) -> None:
         self.conf = conf
-        # THE master lock, wrapped so contention is measurable: wait and
-        # hold distributions bind to jt_lock_wait_seconds /
-        # jt_lock_hold_seconds once the metrics registry exists below
-        from tpumr.metrics.locks import InstrumentedRLock
-        self.lock = InstrumentedRLock()
+        # the GLOBAL lock — after the PR-8 decomposition it guards only
+        # the job table, commit grants, and admin swaps (tracker
+        # registry, fold, completion feed, and scheduler each have
+        # their own synchronization). Wait/hold distributions bind to
+        # jt_lock_wait_seconds{lock=global} once the registry exists.
+        from tpumr.metrics.locks import (RANK_GLOBAL, RANK_SCHEDULER,
+                                         InstrumentedRLock)
+        self.lock = InstrumentedRLock(name="global", rank=RANK_GLOBAL)
+        #: scheduler entry (before_heartbeat/assign_tasks) serializes
+        #: here, NOT on the global lock; ordering rule: scheduler → job,
+        #: never the reverse (asserted in debug mode, metrics/locks.py)
+        self.sched_lock = InstrumentedRLock(name="scheduler",
+                                            rank=RANK_SCHEDULER)
+        #: INSERT-ONLY (jobs are never removed from the table), so
+        #: heartbeat-path lookups read it lock-free under the GIL;
+        #: writers still serialize on the global lock
         self.jobs: dict[str, JobInProgress] = {}
-        self.trackers: dict[str, _TrackerInfo] = {}
+        from tpumr.mapred.tracker_registry import TrackerRegistry
+        self.trackers = TrackerRegistry(
+            conf.get_int("tpumr.tracker.registry.shards", 16))
+        #: response-replay cache: read and written LOCK-FREE (single-key
+        #: dict get/set are GIL-atomic; same-tracker races are excluded
+        #: by _TrackerInfo.hb_lock, and the value is an immutable tuple)
         self._last_response: dict[str, tuple[int, list]] = {}
         self._commit_grants: dict[str, str] = {}   # task_id -> attempt_id
         self._next_job = 0
+        #: running-job-set change counter + the cache it keys (see
+        #: jobs_version/running_jobs) — the scheduler's per-pass reads
+        self._jobs_version = 0
+        self._running_cache: "tuple[int, list]" = (-1, [])
+        #: approximate count of blacklisted trackers (num_trackers'
+        #: lock-free divisor; the exact set still comes from scans)
+        self._blacklisted = 0
         # start-time-in-ms identifier ≈ JobTracker's trackerIdentifier —
         # must differ across restarts or recovered job ids collide with
         # the original's history file
@@ -114,6 +212,12 @@ class JobMaster:
                                    HybridQueueScheduler)
         self.scheduler: TaskScheduler = new_instance(sched_cls, conf)
         self.scheduler.set_manager(self)
+        #: does this scheduler override the per-beat observation hook?
+        #: The stock schedulers don't — skipping the no-op saves a
+        #: sched_lock round trip on every heartbeat of every tracker
+        self._sched_observes = (
+            type(self.scheduler).before_heartbeat
+            is not TaskScheduler.before_heartbeat)
         # per-queue submit/administer ACLs ≈ QueueManager.java +
         # mapred-queue-acls.xml, enforced in submit_job and kill_job
         from tpumr.mapred.queue_manager import QueueManager
@@ -121,8 +225,28 @@ class JobMaster:
         self.history = JobHistory(conf)
         from tpumr.security import rpc_secret
         self._rpc_secret = rpc_secret(conf)
+        # the master's transport is the selector reactor (≈ the
+        # reference's NIO Listener/Reader + Handler pool) with the
+        # heartbeat fast path served INLINE in the loop: at fleet scale
+        # the thread-per-connection transport spent more CPU waking
+        # handler threads than handling beats. The inline set must stay
+        # short-running and never block on an RPC back to this server;
+        # everything else (submit_job's history I/O, admin surface)
+        # runs on the reactor's handler pool.
+        use_reactor = True
+        if hasattr(conf, "get_boolean"):
+            use_reactor = conf.get_boolean(
+                "tpumr.jobtracker.rpc.reactor", True)
         self._server = RpcServer(self, host=host, port=port,
-                                 secret=self._rpc_secret)
+                                 secret=self._rpc_secret,
+                                 reactor=use_reactor,
+                                 fast_methods={
+                                     "heartbeat",
+                                     "get_map_completion_events",
+                                     "get_job_status",
+                                     "can_commit",
+                                     "get_protocol_version",
+                                 })
         # delegation-token liveness (≈ JobTracker's
         # DelegationTokenSecretManager): issued/renewed/canceled here,
         # validated by the RPC layer per request
@@ -169,11 +293,13 @@ class JobMaster:
         self._mreg.set_gauge("jobs_running",
                              _locked(lambda: len(self.running_jobs())))
         self._mreg.set_gauge("jobs_total", _locked(lambda: len(self.jobs)))
-        self._mreg.set_gauge("trackers", _locked(lambda: len(self.trackers)))
+        # tracker gauges read the striped registry; the global lock has
+        # no say over trackers since the decomposition
+        self._mreg.set_gauge("trackers", lambda: len(self.trackers))
         self._mreg.set_gauge(
             "trackers_blacklisted",
-            _locked(lambda: sum(1 for t in self.trackers.values()
-                                if t.blacklisted)))
+            lambda: sum(1 for t in self.trackers.values()
+                        if t.blacklisted))
         self._mreg.set_gauge("slots", self.total_slots)
         # shuffle fault tolerance: map attempts with outstanding
         # (sub-threshold) fetch-failure reports across running jobs —
@@ -209,9 +335,9 @@ class JobMaster:
                                 if j.tpu_disabled)))
         self._mreg.set_gauge(
             "tpu_devices_quarantined",
-            _locked(lambda: sum(
+            lambda: sum(
                 len(t.status.get("quarantined_tpu_devices", []) or [])
-                for t in self.trackers.values())))
+                for t in self.trackers.values()))
         # control-plane latency distributions: heartbeat handling wall
         # time (hoisted Histogram object — the heartbeat path must not
         # pay a registry lookup), per-method RPC server latency + wire
@@ -223,27 +349,60 @@ class JobMaster:
         self._hb_seconds = self._mreg.histogram("heartbeat_seconds")
         # master saturation series (the scale harness's read side, all
         # hoisted off the registry lookup path):
-        # - lock wait/hold on the master lock (metrics/locks.py),
+        # - lock wait/hold PER DECOMPOSED LOCK CLASS as one labeled
+        #   family each (jt_lock_wait_seconds{lock=global|trackers|
+        #   scheduler} via the `name|k=v` registry convention) — the
+        #   decomposition itself is observable, and "which lock is the
+        #   wall now" is one scrape away,
         # - heartbeat phase breakdown (fold = task-status/fetch-failure
-        #   folding, assign = the scheduler pass, deferred_io = history/
-        #   finalize I/O after the lock) as ONE labeled Prometheus
-        #   family via the `name|phase=...` registry convention,
+        #   folding under the per-job locks, assign = the scheduler
+        #   pass, deferred_io = history/finalize I/O, replay =
+        #   response-id replays of lost responses) as ONE labeled
+        #   family,
         # - per-tracker heartbeat LAG: observed inter-heartbeat gap
         #   minus the configured interval — trackers overrunning their
         #   schedule is the first externally visible saturation symptom,
-        # - completion-event feed lag: events still pending at each
-        #   reduce poll (a growing backlog means reduces fall behind
-        #   the map completion rate — or polls can't get through).
+        # - completion-event feed lag: backlog REMAINING after each
+        #   reduce poll was served (a poll that fully catches up
+        #   records 0 — the series measures pollers falling behind, not
+        #   job width).
         from tpumr.metrics.histogram import COUNTS
-        self.lock.bind(self._mreg.histogram("jt_lock_wait_seconds"),
-                       self._mreg.histogram("jt_lock_hold_seconds"))
+        self.lock.bind(
+            self._mreg.histogram("jt_lock_wait_seconds|lock=global"),
+            self._mreg.histogram("jt_lock_hold_seconds|lock=global"))
+        self.sched_lock.bind(
+            self._mreg.histogram("jt_lock_wait_seconds|lock=scheduler"),
+            self._mreg.histogram("jt_lock_hold_seconds|lock=scheduler"))
+        self.trackers.bind(
+            self._mreg.histogram("jt_lock_wait_seconds|lock=trackers"),
+            self._mreg.histogram("jt_lock_hold_seconds|lock=trackers"))
         self._hb_phase = {
             phase: self._mreg.histogram(
                 f"heartbeat_phase_seconds|phase={phase}")
-            for phase in ("fold", "assign", "deferred_io")}
+            for phase in ("fold", "assign", "deferred_io", "replay")}
         self._hb_lag = self._mreg.histogram("heartbeat_lag_seconds")
         self._hb_interval_s = conf.get_int(
             "tpumr.heartbeat.interval.ms", 1000) / 1000.0
+        # Master-controlled adaptive heartbeat cadence
+        # (≈ mapreduce.jobtracker.heartbeats.in.second / JobTracker.
+        # getNextHeartbeatInterval, MAPREDUCE-1906): the master targets
+        # an AGGREGATE beat rate and instructs each tracker's next
+        # interval in the heartbeat response (`next_interval_ms`), so
+        # cadence degrades smoothly with fleet size instead of the whole
+        # fleet missing schedule at once past the master's beat-rate
+        # capacity. The configured interval is the FLOOR (small fleets
+        # see no change); `tpumr.heartbeat.interval.max.ms` bounds the
+        # staleness an operator will tolerate (0 = uncapped, like the
+        # reference). Off by default (0): existing clusters keep exact
+        # fixed-cadence semantics unless an operator opts in with a
+        # target rate.
+        self._hb_target_rate = conf.get_int(
+            "tpumr.heartbeat.beats.per.second", 0)
+        self._hb_interval_max_s = conf.get_int(
+            "tpumr.heartbeat.interval.max.ms", 0) / 1000.0
+        self._mreg.set_gauge(
+            "heartbeat_interval_instructed_ms",
+            lambda: int(self._instructed_interval_s() * 1000))
         self._event_lag = self._mreg.histogram("completion_event_lag",
                                                COUNTS)
         self._server.metrics = self.metrics.new_registry("rpc")
@@ -255,15 +414,14 @@ class JobMaster:
         cluster_reg = self.metrics.new_registry("cluster")
         self.cluster_agg = ClusterAggregator(cluster_reg)
         cluster_reg.set_gauge("trackers_reporting",
-                              _locked(lambda: len(self.trackers)))
+                              lambda: len(self.trackers))
         # named to match the trackers' own flattened slot_utilization
         # gauge, so one dashboard query covers the cluster series and
         # the per-host rows (only the source label differs)
         for kind in ("cpu", "tpu", "reduce"):
             cluster_reg.set_gauge(
                 f"slot_utilization_{kind}",
-                (lambda k: _locked(
-                    lambda: self._slot_utilization_locked(k)))(kind))
+                (lambda k: lambda: self._slot_utilization(k))(kind))
         # cluster-wide observed acceleration derived from the MERGED
         # distributions (global means) — per-tracker ratio gauges can't
         # be summed, but merged count/sum histograms aggregate exactly
@@ -331,11 +489,10 @@ class JobMaster:
         include, exclude = self._read_hosts_lists()
         with self.lock:
             self._hosts_include, self._hosts_exclude = include, exclude
-            evicted = [n for n, t in self.trackers.items()
-                       if not self._host_allowed(
-                           t.status.get("host", ""))]
-            for name in evicted:
-                self._evict_tracker_locked(name)
+        evicted = [n for n, t in self.trackers.items()
+                   if not self._host_allowed(t.status.get("host", ""))]
+        for name in evicted:
+            self._evict_tracker(name)
         return {"excluded": sorted(exclude),
                 "included": sorted(include) if include is not None else "*",
                 "evicted_trackers": sorted(evicted)}
@@ -385,13 +542,15 @@ class JobMaster:
         srv = StatusHttpServer("jobtracker", port=port)
         def cluster_info(q: dict) -> dict:
             with self.lock:
-                return {
-                    "cluster_id": self.cluster_id,
-                    "trackers": len(self.trackers),
-                    "slots": self.total_slots(),
-                    "jobs_running": len(self.running_jobs()),
-                    "jobs_total": len(self.jobs),
-                }
+                jobs_running = len(self.running_jobs())
+                jobs_total = len(self.jobs)
+            return {
+                "cluster_id": self.cluster_id,
+                "trackers": len(self.trackers),
+                "slots": self.total_slots(),
+                "jobs_running": jobs_running,
+                "jobs_total": jobs_total,
+            }
 
         def jobs_info(q: dict) -> list:
             with self.lock:
@@ -399,9 +558,8 @@ class JobMaster:
             return [j.status_dict() for j in jips]
 
         def trackers_info(q: dict) -> list:
-            with self.lock:
-                rows = [(n, t.last_seen, t.blacklisted, t.failures, t.status)
-                        for n, t in sorted(self.trackers.items())]
+            rows = [(n, t.last_seen, t.blacklisted, t.failures, t.status)
+                    for n, t in sorted(self.trackers.items())]
             return [{"name": n, "last_seen": seen, "blacklisted": bl,
                      "failures": f, "status": st}
                     for n, seen, bl, f, st in rows]
@@ -611,15 +769,30 @@ class JobMaster:
             merged tracker distributions (shuffle fetch, TPU stage/
             execute, tracker RPC), and per-tracker gauge rows."""
             import time as _time
-            with self.lock:
-                util = {k: self._slot_utilization_locked(k)
-                        for k in ("cpu", "tpu", "reduce")}
-                n_trackers = len(self.trackers)
-                hb_ages = {n: max(0.0, _time.time() - t.last_seen)
-                           for n, t in self.trackers.items()}
+            util = {k: self._slot_utilization(k)
+                    for k in ("cpu", "tpu", "reduce")}
+            hb_ages = {n: max(0.0, _time.time() - t.last_seen)
+                       for n, t in self.trackers.items()}
+            n_trackers = len(hb_ages)
             snaps = self.metrics.snapshot()
             snap = snaps.get("cluster", {})
-            hb = snaps.get("jobtracker", {}).get("heartbeat_seconds", {})
+            jt_snap = snaps.get("jobtracker", {})
+            hb = jt_snap.get("heartbeat_seconds", {})
+            # per-lock wait/hold of the decomposed master locks — the
+            # "which lock is the wall now" table (lock=global|trackers|
+            # scheduler via the labeled-family convention)
+            lock_rows = []
+            for name in sorted(jt_snap):
+                if not name.startswith("jt_lock_wait_seconds|"):
+                    continue
+                which = name.split("lock=", 1)[-1]
+                w = jt_snap[name]
+                h = jt_snap.get(
+                    f"jt_lock_hold_seconds|lock={which}", {})
+                lock_rows.append([
+                    which, f"{w.get('count', 0):.0f}",
+                    f"{w.get('p99', 0):.4g}", f"{w.get('max', 0):.4g}",
+                    f"{h.get('p99', 0):.4g}", f"{h.get('max', 0):.4g}"])
             rows, hist_rows = [], []
             for name in sorted(snap):
                 v = snap[name]
@@ -637,6 +810,10 @@ class JobMaster:
                 + (f" · heartbeat p99 {hb.get('p99', 0):.4g}s over "
                    f"{hb.get('count', 0):.0f} beats" if hb else "")
                 + "</p>",
+                "<h2>Master locks (wait vs hold)</h2>",
+                html_table(["lock", "acquires", "wait p99", "wait max",
+                            "hold p99", "hold max"], lock_rows)
+                if lock_rows else "<p class='dim'>none yet</p>",
                 "<h2>Merged distributions</h2>",
                 html_table(["metric", "count", "p50", "p95", "p99",
                             "max"], hist_rows)
@@ -675,32 +852,56 @@ class JobMaster:
 
     # ------------------------------------------------------------ SPI seams
 
+    def jobs_version(self) -> int:
+        """Monotone-ish counter bumped whenever the running-job set (or
+        a job's priority) changes — the scheduler's FIFO-order cache key.
+        Bumps are plain int increments (a lost race just means one
+        extra re-sort or one pass on a stale order; obtain re-checks job
+        state under the job lock, so staleness is never incorrect)."""
+        return self._jobs_version
+
+    def _bump_jobs_version(self) -> None:
+        self._jobs_version += 1
+
     def running_jobs(self) -> list[JobInProgress]:
+        # version-cached: the scheduler asks once per assign pass, and
+        # rebuilding (under the global lock) per pass was measurable at
+        # fleet heartbeat rates. Rebuilt only when the version moved.
+        ver = self._jobs_version
+        cached_ver, cached = self._running_cache
+        if cached_ver == ver:
+            return cached
         with self.lock:
-            return [j for j in self.jobs.values()
+            jobs = [j for j in self.jobs.values()
                     if j.state == JobState.RUNNING]
+        self._running_cache = (ver, jobs)
+        return jobs
 
     def num_trackers(self) -> int:
-        with self.lock:
-            return len([t for t in self.trackers.values()
-                        if not t.blacklisted]) or 1
+        # lock-free approximation for the scheduler's per-pass divisor:
+        # per-stripe dict lens (GIL-atomic) minus the blacklist counter.
+        # The exact blacklisted set still comes from the full scan on
+        # the metrics/status paths; mid-pass the scheduler must never
+        # queue on (or take, the ordering rule forbids it) the global
+        # lock — and at 400+ trackers even the striped values() walk
+        # per pass was a measurable share of assign time.
+        return max(1, self.trackers.approx_len() - self._blacklisted)
 
     def total_slots(self) -> dict:
-        with self.lock:
-            out = {"cpu": 0, "tpu": 0, "reduce": 0}
-            for t in self.trackers.values():
-                out["cpu"] += t.status.get("max_cpu_map_slots", 0)
-                out["tpu"] += t.status.get("max_tpu_map_slots", 0)
-                out["reduce"] += t.status.get("max_reduce_slots", 0)
-            return out
+        out = {"cpu": 0, "tpu": 0, "reduce": 0}
+        for t in self.trackers.values():
+            out["cpu"] += t.status.get("max_cpu_map_slots", 0)
+            out["tpu"] += t.status.get("max_tpu_map_slots", 0)
+            out["reduce"] += t.status.get("max_reduce_slots", 0)
+        return out
 
     _SLOT_KEYS = {"cpu": ("count_cpu_map_tasks", "max_cpu_map_slots"),
                   "tpu": ("count_tpu_map_tasks", "max_tpu_map_slots"),
                   "reduce": ("count_reduce_tasks", "max_reduce_slots")}
 
-    def _slot_utilization_locked(self, kind: str) -> float:
+    def _slot_utilization(self, kind: str) -> float:
         """Cluster-wide busy fraction of one slot pool, from the
-        trackers' last heartbeat statuses (caller holds ``self.lock``).
+        trackers' last heartbeat statuses (registry-striped reads).
         0.0 with no slots of the kind — a present-but-zero series beats
         a missing one for dashboards on heterogeneous clusters."""
         busy_key, max_key = self._SLOT_KEYS[kind]
@@ -814,6 +1015,7 @@ class JobMaster:
         with self.lock:
             self.jobs[str(job_id)] = jip
             self._mreg.incr("jobs_submitted")
+            self._bump_jobs_version()
         # history write (serializes conf + splits) outside the master lock
         self.history.job_submitted(jip)
         return str(job_id)
@@ -1030,6 +1232,7 @@ class JobMaster:
             # JOB_PRIORITY_CHANGED replay in history.incomplete_jobs()
             # — recovery resubmits the conf serialized at submit time,
             # so mutating jip.conf here could never reach it
+        self._bump_jobs_version()   # the FIFO-order cache re-sorts
         self.history.task_event(str(jip.job_id), "JOB_PRIORITY_CHANGED",
                                 priority=p, by=ugi.user)
         return p
@@ -1041,7 +1244,6 @@ class JobMaster:
         tracker running the attempt receives a kill action on its next
         heartbeat; with ``should_fail`` the terminal report counts
         toward the task's attempt limit."""
-        from tpumr.mapred.ids import TaskAttemptID
         try:
             job_id = str(TaskAttemptID.parse(attempt_id).task.job)
         except (ValueError, KeyError, IndexError):
@@ -1135,6 +1337,7 @@ class JobMaster:
         # kill() no-ops if a concurrent heartbeat already made it terminal
         if not jip.kill():  # ≈ JobTracker.killJob: no-op on finished jobs
             return False
+        self._bump_jobs_version()
         self._finalize_job(jip)
         return True
 
@@ -1196,14 +1399,18 @@ class JobMaster:
                                   max_events: int = 10_000) -> list:
         jip = self._job(job_id)
         self._check_job_op(jip, "view")   # own task children pass by scope
-        with jip.lock:
-            events = jip.completion_events[from_index:
-                                           from_index + max_events]
-            pending = max(0, len(jip.completion_events) - int(from_index))
-        # completion-event feed lag: how many events each poll still had
-        # to catch up on. A growing distribution means reduces fall
-        # behind the map completion rate — or their polls can't get
-        # through a saturated master.
+        # LOCK-FREE: the feed is append-only (CompletionEventFeed), so
+        # reducer polls never queue behind the status fold appending
+        # under the job lock — at fleet scale these polls outnumber
+        # heartbeats and used to serialize on the same locks
+        events, pending = jip.completion_events.read(int(from_index),
+                                                     int(max_events))
+        # completion-event feed lag: the backlog REMAINING after this
+        # poll was served (0 = fully caught up). A growing distribution
+        # means pollers can't drain the feed — they fall behind the map
+        # completion rate, or can't get through a saturated master. The
+        # volume a poll catches up on fine is deliberately NOT counted:
+        # that grows with job width, not with saturation.
         self._event_lag.observe(pending)
         return events
 
@@ -1249,8 +1456,10 @@ class JobMaster:
         return getattr(self._job(job_id), "job_token", b"") or b""
 
     def _job(self, job_id: str) -> JobInProgress:
-        with self.lock:
-            jip = self.jobs.get(job_id)
+        # lock-free: the job table is insert-only and dict reads are
+        # GIL-atomic — completion-event polls and status RPCs must not
+        # queue on the global lock just to look up their job
+        jip = self.jobs.get(job_id)
         if jip is None:
             raise KeyError(f"unknown job {job_id}")
         return jip
@@ -1265,15 +1474,13 @@ class JobMaster:
         AFTER its FAILED status was folded (and any prior grant revoked)
         must not capture a fresh grant it would hold forever, denying
         every re-run."""
-        from tpumr.mapred.ids import TaskAttemptID
         jip = None
         try:
             job_id = str(TaskAttemptID.parse(attempt_id).task.job)
         except (ValueError, IndexError):
             pass   # unparseable id: no job to consult, legacy grant path
         else:
-            with self.lock:
-                jip = self.jobs.get(job_id)
+            jip = self.jobs.get(job_id)   # lock-free: insert-only table
         if jip is not None:
             with jip.lock:
                 tip = jip._tip_of_attempt(attempt_id)
@@ -1292,6 +1499,22 @@ class JobMaster:
 
     # ------------------------------------------------------------ RPC: heartbeat
 
+    def _instructed_interval_s(self) -> float:
+        """The heartbeat interval the master currently asks trackers to
+        keep: ``max(floor, fleet_size / target_rate)``, optionally
+        capped. Lock-free (``approx_len``) — called per beat under the
+        tracker's ``hb_lock``, the bottom of the lock order, where no
+        shard stripe may be taken."""
+        rate = self._hb_target_rate
+        if rate <= 0:
+            return self._hb_interval_s
+        s = max(self._hb_interval_s, self.trackers.approx_len() / rate)
+        if self._hb_interval_max_s > 0:
+            # a floor above the cap means the operator pinned the
+            # cadence — the floor wins (adaptation never speeds beats up)
+            s = min(s, max(self._hb_interval_max_s, self._hb_interval_s))
+        return s
+
     def heartbeat(self, status: dict, initial_contact: bool,
                   ask_for_new_task: bool, response_id: int) -> dict:
         name = status["tracker_name"]
@@ -1304,16 +1527,16 @@ class JobMaster:
         # tracker status never carries it.
         hb_trace = status.pop("trace", None)
         # history appends + job finalization are file I/O — deferred past
-        # the master lock so disk latency never serializes the control
-        # plane; task events flush BEFORE finalization so the per-job log
+        # all locks so disk latency never serializes the control plane;
+        # task events flush BEFORE finalization so the per-job log
         # stays causally ordered (TASK_* precede JOB_FINISHED)
         deferred_events: list[tuple[str, str, dict]] = []
         deferred_final: list[JobInProgress] = []
         try:
-            return self._heartbeat_locked(status, initial_contact,
-                                          ask_for_new_task, response_id,
-                                          name, deferred_events,
-                                          deferred_final, hb_trace)
+            return self._heartbeat(status, initial_contact,
+                                   ask_for_new_task, response_id,
+                                   name, deferred_events,
+                                   deferred_final, hb_trace, t0)
         finally:
             t_io = time.monotonic()
             t_io_wall = time.time()
@@ -1353,65 +1576,154 @@ class JobMaster:
         s.start = start_wall
         self.tracer.finish(s)
 
-    def _heartbeat_locked(self, status: dict, initial_contact: bool,
-                          ask_for_new_task: bool, response_id: int,
-                          name: str, deferred_events: list,
-                          deferred_final: list,
-                          hb_trace: "dict | None" = None) -> dict:
-        with self.lock:
-            if not self._host_allowed(status.get("host", "")):
-                # ≈ DisallowedTaskTrackerException: the tracker's host is
-                # excluded (or absent from a configured include list) —
-                # refuse it; the NodeRunner shuts itself down on this
-                if name in self.trackers:
-                    self._evict_tracker_locked(name)
-                return {"response_id": response_id, "actions":
-                        [{"type": "disallowed"}]}
-            info = self.trackers.get(name)
-            if info is None and not initial_contact:
-                # ≈ ReinitTrackerAction (JobTracker.java:3358): we don't know
-                # this tracker (expired or master restarted) — reset it
+    def _heartbeat(self, status: dict, initial_contact: bool,
+                   ask_for_new_task: bool, response_id: int,
+                   name: str, deferred_events: list,
+                   deferred_final: list,
+                   hb_trace: "dict | None" = None,
+                   t0: float = 0.0) -> dict:
+        # ---- phase: registry — the ONLY synchronization here is the
+        # tracker registry's shard stripe; the global lock is never
+        # taken on the heartbeat fast path
+        is_delta = bool(status.get("delta"))
+        shard_lock, shard = self.trackers.shard_of(name)
+        with shard_lock:
+            info = shard.get(name)
+            # host screening first (≈ DisallowedTaskTrackerException)
+            # whenever the beat names its host — excluded trackers get
+            # "disallowed", never "reinit". A delta that omits the host
+            # is screened against the stored status; an UNKNOWN delta
+            # can't be screened here and falls through to reinit (its
+            # full re-registration beat gets screened).
+            host = status.get("host") if "host" in status \
+                or not status.get("delta") \
+                else info.status.get("host", "") if info is not None \
+                else None
+            host_ok = host is None or self._host_allowed(host or "")
+            if not host_ok:
+                registered = info is not None
+            elif info is None and (not initial_contact
+                                   or status.get("delta")):
+                # ≈ ReinitTrackerAction (JobTracker.java:3358): we don't
+                # know this tracker (expired or master restarted) — or
+                # it sent a delta we have no baseline to apply to.
+                # Reset it; it re-registers with a full status.
                 return {"response_id": response_id, "actions":
                         [{"type": "reinit"}]}
-            if info is None:
-                info = self.trackers[name] = _TrackerInfo(status)
-            elif not initial_contact:
-                # heartbeat LAG: how far past its scheduled interval this
-                # tracker's beat arrived. Climbing lag p99 with flat
-                # handling latency = trackers (or the network/handler
-                # pool) can't keep schedule — the first saturation tell.
-                gap = time.monotonic() - info.seen_mono
-                self._hb_lag.observe(max(0.0, gap - self._hb_interval_s))
-            info.status = status
-            info.last_seen = time.time()
-            info.seen_mono = time.monotonic()
-            t_fold = time.monotonic()
-            t_fold_wall = time.time()
-            # fold the piggybacked tracker metrics into the cluster
-            # registry — cumulative state, so replayed heartbeats are
-            # idempotent (no seq protocol needed, unlike task statuses)
-            self.cluster_agg.merge(name, status.get("metrics"))
+            elif info is not None:
+                if not initial_contact:
+                    # heartbeat LAG: how far past its scheduled interval
+                    # this tracker's beat arrived — judged against the
+                    # interval the master last INSTRUCTED it to keep
+                    # (adaptive cadence), not the configured floor.
+                    # Climbing lag p99 with flat handling latency =
+                    # trackers (or the network/handler pool) can't keep
+                    # schedule — the first saturation tell. Observed for
+                    # replayed beats too.
+                    gap = time.monotonic() - info.seen_mono
+                    self._hb_lag.observe(max(
+                        0.0,
+                        gap - (info.interval_s or self._hb_interval_s)))
+                # delta beats reconstruct against the stored status
+                # (heartbeat.py); full beats replace it wholesale
+                status = info.fold_status(status)
+            else:
+                status.pop("delta", None)
+                info = shard[name] = _TrackerInfo(status)
+        if not host_ok:
+            # ≈ DisallowedTaskTrackerException: the tracker's host is
+            # excluded (or absent from a configured include list) —
+            # refuse it; the NodeRunner shuts itself down on this
+            if registered:
+                self._evict_tracker(name)
+            return {"response_id": response_id, "actions":
+                    [{"type": "disallowed"}]}
 
-            # Fold in task statuses FIRST — even when this turns out to be a
-            # replayed heartbeat. The tracker drops terminal statuses after
-            # any delivered response, so a completion carried on a retry
-            # would otherwise be lost forever.
-            shuffle_addr = status.get("shuffle_addr") or \
-                f"{status.get('host', '')}:{status.get('shuffle_port', 0)}"
-            for sd in status.get("task_statuses", []):
+        # ---- per-tracker serialization: one beat of one tracker at a
+        # time. A retry racing its own lost original folds after it and
+        # hits the replay cache — it can never double-assign. Trackers
+        # never contend here (rank tracker-beat, bottom of the order).
+        with info.hb_lock:
+            # eviction (expiry/exclusion) may have raced the registry
+            # phase above: it pops the entry, then requeues the running
+            # set under THIS lock. A beat that loses that race must not
+            # fold/assign onto the orphaned info — work assigned there
+            # would never be requeued (pre-decomposition the global
+            # lock made evict-vs-beat atomic). GIL-atomic dict read;
+            # `is` distinguishes a concurrent fresh re-registration.
+            if shard.get(name) is not info:
+                return {"response_id": response_id, "actions":
+                        [{"type": "reinit"}]}
+            return self._heartbeat_fold_and_assign(
+                status, info, initial_contact, ask_for_new_task,
+                response_id, name, deferred_events, deferred_final,
+                hb_trace, t0, is_delta)
+
+    def _heartbeat_fold_and_assign(self, status: dict, info: _TrackerInfo,
+                                   initial_contact: bool,
+                                   ask_for_new_task: bool,
+                                   response_id: int, name: str,
+                                   deferred_events: list,
+                                   deferred_final: list,
+                                   hb_trace: "dict | None",
+                                   t0: float,
+                                   is_delta: bool = False) -> dict:
+        """Fold + replay-check + assign for one beat (caller holds the
+        tracker's ``hb_lock`` and NOTHING else — every acquisition below
+        is rank-ascending: scheduler → global → trackers → job)."""
+        t_fold = time.monotonic()
+        t_fold_wall = time.time() if hb_trace is not None else 0.0
+        # fold the piggybacked tracker metrics into the cluster
+        # registry — cumulative state, so replayed heartbeats are
+        # idempotent (no seq protocol needed, unlike task statuses);
+        # delta beats omit an UNCHANGED piggyback entirely, so idle
+        # trackers skip this merge altogether
+        self.cluster_agg.merge(name, status.get("metrics"))
+
+        # Fold in task statuses FIRST — even when this turns out to be a
+        # replayed heartbeat. The tracker drops terminal statuses after
+        # any delivered response, so a completion carried on a retry
+        # would otherwise be lost forever. Each status folds under ITS
+        # job's lock only; the job table read is lock-free
+        # (insert-only dict under the GIL).
+        shuffle_addr = status.get("shuffle_addr") or \
+            f"{status.get('host', '')}:{status.get('shuffle_port', 0)}"
+        statuses = status.get("task_statuses") or []
+        if not is_delta:
+            # a FULL beat's status list is the tracker's complete
+            # running set (delta beats may suppress unchanged RUNNING
+            # statuses — they only ever add/remove incrementally below)
+            info.running = {sd["attempt_id"] for sd in statuses
+                            if sd.get("state") == TaskState.RUNNING}
+        # group by job: a beat's statuses overwhelmingly belong to few
+        # jobs, and taking each job's lock ONCE per beat (not once per
+        # status) halves the lock round trips on the fold fast path
+        by_job: "dict[str, list] | None" = None
+        if statuses:
+            by_job = {}
+            for sd in statuses:
                 ts = TaskStatus.from_dict(sd)
-                job_id = str(ts.attempt_id.task.job)
-                jip = self.jobs.get(job_id)
-                if jip is not None:
-                    before = jip.state
-                    jip.update_task_status(ts, shuffle_addr)
-                    self._drain_accel_events(jip, job_id, name,
-                                             deferred_events)
+                aid = str(ts.attempt_id)
+                if ts.state == TaskState.RUNNING:
+                    info.running.add(aid)
+                elif ts.state in TaskState.TERMINAL:
+                    info.running.discard(aid)
+                by_job.setdefault(str(ts.attempt_id.task.job),
+                                  []).append(ts)
+        for job_id, group in (by_job or {}).items():
+            jip = self.jobs.get(job_id)
+            if jip is None:
+                continue
+            revoke: "list[tuple[str, str]]" = []
+            with jip.lock:
+                before = jip.state
+                for ts in group:
                     aid = str(ts.attempt_id)
+                    jip.update_task_status(ts, shuffle_addr)
                     if ts.state in TaskState.TERMINAL \
                             and aid not in jip.history_logged:
-                        # replayed heartbeats re-deliver terminal statuses;
-                        # log each attempt's outcome exactly once
+                        # replayed heartbeats re-deliver terminal
+                        # statuses; log each attempt's outcome once
                         jip.history_logged.add(aid)
                         if ts.state == TaskState.FAILED \
                                 and ts.failure_class == "timeout":
@@ -1434,126 +1746,186 @@ class JobMaster:
                             run_on_tpu=ts.run_on_tpu,
                             tpu_device_id=ts.tpu_device_id,
                             runtime=ts.runtime, tracker=name,
-                            # per-attempt counters make the history file
-                            # self-sufficient for post-hoc diagnosis
-                            # (tools.vaidya) ≈ the reference history's
-                            # COUNTERS field
+                            # per-attempt counters make the history
+                            # file self-sufficient for post-hoc
+                            # diagnosis (tools.vaidya) ≈ the reference
+                            # history's COUNTERS field
                             counters=ts.counters or {})))
                     if ts.state in (TaskState.FAILED, TaskState.KILLED):
-                        # a dead attempt must not keep the commit grant —
-                        # otherwise its re-run is denied commit and output
-                        # is silently lost
-                        self._revoke_commit(str(ts.attempt_id.task),
-                                            str(ts.attempt_id))
+                        # a dead attempt must not keep the commit
+                        # grant — otherwise its re-run is denied commit
+                        # and output is silently lost (revoked after
+                        # the job lock drops: global < job in the rank
+                        # order, so the grant table must not be touched
+                        # while a job lock is held)
+                        revoke.append((str(ts.attempt_id.task), aid))
                     if ts.state == "FAILED":
-                        info.failures += 1
-                        if info.failures >= self.blacklist_faults:
-                            info.blacklisted = True
-                    if before == JobState.RUNNING and \
-                            jip.state in JobState.TERMINAL:
-                        deferred_final.append(jip)
+                        if info.charge_fault(self.blacklist_faults):
+                            self._blacklisted += 1
+                job_done = (before == JobState.RUNNING
+                            and jip.state in JobState.TERMINAL)
+            if jip.has_accel_events():
+                self._drain_accel_events(jip, job_id, name,
+                                         deferred_events)
+            for task_id, aid in revoke:
+                self._revoke_commit(task_id, aid)
+            if job_done:
+                self._bump_jobs_version()
+                deferred_final.append(jip)
 
-            # Fetch-failure reports (the "too many fetch failures"
-            # protocol): reducers on this tracker found a completed
-            # map's output unfetchable while its tracker still
-            # heartbeats. Folded BEFORE replay detection for the same
-            # reason as task statuses: the tracker only drops reports
-            # once a response is delivered, so a retried heartbeat
-            # re-carries them (distinct-reducer counting makes the
-            # re-delivery harmless).
-            for ff in status.get("fetch_failures", []):
-                self._fetch_failure_locked(ff, deferred_events,
-                                           deferred_final)
-            self._hb_phase["fold"].observe(time.monotonic() - t_fold)
-            self._phase_span(
-                hb_trace, "heartbeat:fold", t_fold_wall,
-                statuses=len(status.get("task_statuses", [])))
+        # Fetch-failure reports (the "too many fetch failures"
+        # protocol): reducers on this tracker found a completed
+        # map's output unfetchable while its tracker still
+        # heartbeats. Folded BEFORE replay detection for the same
+        # reason as task statuses: the tracker only drops reports
+        # once a response is delivered, so a retried heartbeat
+        # re-carries them (distinct-reducer counting makes the
+        # re-delivery harmless).
+        for ff in status.get("fetch_failures") or []:
+            self._fetch_failure(ff, deferred_events, deferred_final)
+        self._hb_phase["fold"].observe(time.monotonic() - t_fold)
+        self._phase_span(
+            hb_trace, "heartbeat:fold", t_fold_wall,
+            statuses=len(statuses))
 
-            # Normal case: the tracker echoes the response id we last sent
-            # (last[0] == response_id). A MISMATCH means our response was
-            # lost in flight — replay the stored actions rather than
-            # assigning duplicate work (JobTracker.java:3336-3375).
-            last = self._last_response.get(name)
-            if last is not None and last[0] != response_id and not initial_contact:
-                return {"response_id": last[0], "actions": last[1]}
+        # Normal case: the tracker echoes the response id we last sent
+        # (last[0] == response_id). A MISMATCH means our response was
+        # lost in flight — replay the stored actions rather than
+        # assigning duplicate work (JobTracker.java:3336-3375). The
+        # cache read is lock-free (GIL-atomic dict get of an immutable
+        # tuple; hb_lock excludes same-tracker writers).
+        last = self._last_response.get(name)
+        if last is not None and last[0] != response_id \
+                and not initial_contact:
+            # replayed beats observe the phase + lag series uniformly
+            # (lag landed in the registry phase above) — distinguishable
+            # from first-delivery beats by the phase=replay label
+            self._hb_phase["replay"].observe(
+                time.monotonic() - (t0 or t_fold))
+            self._phase_span(hb_trace, "heartbeat:replay",
+                             time.time() if hb_trace is not None else 0.0,
+                             response_id=last[0])
+            # a tracker whose response was lost still needs the cadence
+            # instruction — replays re-carry the CURRENT interval
+            nxt = self._instructed_interval_s()
+            info.interval_s = nxt
+            return {"response_id": last[0], "actions": last[1],
+                    "next_interval_ms": int(nxt * 1000 + 0.5)}
 
-            actions: list[dict] = []
-            # scheduler observation hook BEFORE the kill scan and
-            # independent of free slots: a saturated cluster (no tracker
-            # ever asks for work) is exactly when fair-share preemption
-            # must still run, and marks made here produce kill actions in
-            # THIS response for victims on this tracker
+        actions: list[dict] = []
+        # scheduler observation hook BEFORE the kill scan and
+        # independent of free slots: a saturated cluster (no tracker
+        # ever asks for work) is exactly when fair-share preemption
+        # must still run, and marks made here produce kill actions in
+        # THIS response for victims on this tracker. Skipped entirely
+        # for schedulers that don't override the hook — no reason to
+        # serialize every beat on the scheduler lock for a no-op.
+        if self._sched_observes:
+            with self.sched_lock:
+                try:
+                    self.scheduler.before_heartbeat(status)
+                except Exception:  # noqa: BLE001 — observation must not
+                    pass           # break heartbeats
+        # kill actions: tasks of dead jobs + marked attempts
+        # (speculative-race losers, preemptions, operator kills) — over
+        # the tracker's BELIEVED running set (delta beats may suppress
+        # an unchanged RUNNING status, and a speculative loser whose
+        # progress report was suppressed must still die). The whole scan
+        # is lock-free: job state and the kill-mark set are plain reads
+        # (marks are maintained at the points where an attempt becomes
+        # a kill candidate — job_in_progress._kill_marked)
+        for aid in list(info.running):
+            # attempt_<cluster>_<nnnn>_... → job_<cluster>_<nnnn>
+            # (sliced, not parsed: this runs per running attempt per
+            # beat and TaskAttemptID.parse was profiling-visible)
+            parts = aid.split("_", 3)
+            jip = self.jobs.get(f"job_{parts[1]}_{parts[2]}")
+            if jip is None:
+                continue
+            if jip.state in JobState.TERMINAL or jip.kill_marked(aid):
+                actions.append({"type": "kill_task", "attempt_id": aid})
+
+        want_task = (ask_for_new_task and not info.blacklisted
+                     and status.get("healthy", True))
+        if want_task and not self.sched_lock.acquire(blocking=False):
+            # TRY-lock, never queue: with thousands of asking trackers,
+            # beats waiting in line for the one-at-a-time scheduler
+            # pass were the post-decomposition wall (sched-lock wait
+            # p99 tracked heartbeat p99 exactly like the old global
+            # lock did). A beat that loses the race simply assigns
+            # nothing — the tracker re-asks next interval, and
+            # assignment throughput is bounded by pass cost, not by
+            # contention. Counted so a hot scheduler is visible.
+            self._mreg.incr("assign_skipped_busy")
+        elif want_task:
+            t_assign = time.monotonic()
+            t_assign_wall = time.time() if hb_trace is not None else 0.0
             try:
-                self.scheduler.before_heartbeat(status)
-            except Exception:  # noqa: BLE001 — observation must not
-                pass           # break heartbeats
-            # kill actions: tasks of dead jobs + speculative-race losers
-            from tpumr.mapred.ids import TaskAttemptID
-            for sd in status.get("task_statuses", []):
-                aid = sd["attempt_id"]
-                job_id = str(TaskAttemptID.parse(aid).task.job)
-                jip = self.jobs.get(job_id)
-                if jip is None or sd["state"] != "RUNNING":
-                    continue
-                if jip.state in JobState.TERMINAL \
-                        or jip.should_kill_attempt(aid):
-                    actions.append({"type": "kill_task", "attempt_id": aid})
+                assigned = self.scheduler.assign_tasks(status)
+            finally:
+                self.sched_lock.release()
+            for task in assigned:
+                if not task.is_map:
+                    self._mreg.incr("reduces_launched")
+                elif task.run_on_tpu:
+                    self._mreg.incr("maps_launched_tpu")
+                else:
+                    self._mreg.incr("maps_launched_cpu")
+                tjip = self.jobs.get(str(task.attempt_id.task.job))
+                if tjip is not None and tjip.trace_root is not None:
+                    # scheduling decision span; its context rides the
+                    # launch action so the tracker/child parent their
+                    # spans to it (submit→schedule→launch→run chain)
+                    sched = self.tracer.instant(
+                        "schedule", tjip.trace_id,
+                        parent=tjip.trace_root,
+                        backend=("tpu" if task.run_on_tpu else "cpu")
+                        if task.is_map else "cpu",
+                        attempt_id=str(task.attempt_id), tracker=name)
+                    task.trace = {"trace_id": tjip.trace_id,
+                                  "span_id": sched.span_id}
+                # the believed-running set learns launches immediately:
+                # a launched-but-never-yet-reported attempt must still
+                # be requeued if this tracker is lost, and killed if
+                # its job dies before the first status arrives
+                info.running.add(str(task.attempt_id))
+                actions.append({"type": "launch",
+                                "job_id": str(task.attempt_id.task.job),
+                                "task": task.to_dict()})
+                # assignment-time event: gives the history timeline
+                # true start stamps + placement (≈ JobHistory
+                # Task.START_TIME; rendered by the history server's
+                # /jobtasks view, the TaskGraphServlet role)
+                deferred_events.append((
+                    str(task.attempt_id.task.job), "TASK_STARTED",
+                    dict(attempt_id=str(task.attempt_id),
+                         is_map=task.is_map,
+                         run_on_tpu=task.run_on_tpu,
+                         tpu_device_id=task.tpu_device_id,
+                         tracker=name)))
+            # the scheduler pass plus per-assignment bookkeeping —
+            # observed only when the pass actually ran, so the
+            # distribution isn't drowned by no-ask heartbeats
+            self._hb_phase["assign"].observe(
+                time.monotonic() - t_assign)
+            self._phase_span(hb_trace, "heartbeat:assign",
+                             t_assign_wall)
 
-            if ask_for_new_task and not info.blacklisted \
-                    and status.get("healthy", True):
-                t_assign = time.monotonic()
-                t_assign_wall = time.time()
-                for task in self.scheduler.assign_tasks(status):
-                    if not task.is_map:
-                        self._mreg.incr("reduces_launched")
-                    elif task.run_on_tpu:
-                        self._mreg.incr("maps_launched_tpu")
-                    else:
-                        self._mreg.incr("maps_launched_cpu")
-                    tjip = self.jobs.get(str(task.attempt_id.task.job))
-                    if tjip is not None and tjip.trace_root is not None:
-                        # scheduling decision span; its context rides the
-                        # launch action so the tracker/child parent their
-                        # spans to it (submit→schedule→launch→run chain)
-                        sched = self.tracer.instant(
-                            "schedule", tjip.trace_id,
-                            parent=tjip.trace_root,
-                            backend=("tpu" if task.run_on_tpu else "cpu")
-                            if task.is_map else "cpu",
-                            attempt_id=str(task.attempt_id), tracker=name)
-                        task.trace = {"trace_id": tjip.trace_id,
-                                      "span_id": sched.span_id}
-                    actions.append({"type": "launch",
-                                    "job_id": str(task.attempt_id.task.job),
-                                    "task": task.to_dict()})
-                    # assignment-time event: gives the history timeline
-                    # true start stamps + placement (≈ JobHistory
-                    # Task.START_TIME; rendered by the history server's
-                    # /jobtasks view, the TaskGraphServlet role)
-                    deferred_events.append((
-                        str(task.attempt_id.task.job), "TASK_STARTED",
-                        dict(attempt_id=str(task.attempt_id),
-                             is_map=task.is_map,
-                             run_on_tpu=task.run_on_tpu,
-                             tpu_device_id=task.tpu_device_id,
-                             tracker=name)))
-                # the scheduler pass plus per-assignment bookkeeping —
-                # observed only when the pass actually ran, so the
-                # distribution isn't drowned by no-ask heartbeats
-                self._hb_phase["assign"].observe(
-                    time.monotonic() - t_assign)
-                self._phase_span(hb_trace, "heartbeat:assign",
-                                 t_assign_wall)
-
-            response_id += 1
-            self._last_response[name] = (response_id, actions)
-            return {"response_id": response_id, "actions": actions}
+        response_id += 1
+        self._last_response[name] = (response_id, actions)
+        # adaptive cadence: every response tells the tracker when to
+        # come back (TaskTracker honors HeartbeatResponse's interval in
+        # the reference; ours is the same contract)
+        nxt = self._instructed_interval_s()
+        info.interval_s = nxt
+        return {"response_id": response_id, "actions": actions,
+                "next_interval_ms": int(nxt * 1000 + 0.5)}
 
     def _drain_accel_events(self, jip: JobInProgress, job_id: str,
                             tracker: str, deferred_events: list) -> None:
         """Demotion/quarantine decisions made inside update_task_status:
         meter them, history-log them, and drop trace instants on the job
-        timeline (caller holds ``self.lock``; history I/O is deferred)."""
+        timeline (takes only the job lock; history I/O is deferred)."""
         for ev in jip.drain_accel_events():
             kind = ev.pop("kind")
             ev["tracker"] = tracker
@@ -1569,17 +1941,17 @@ class JobMaster:
                 self.tracer.instant(instant, jip.trace_id,
                                     parent=jip.trace_root, **ev)
 
-    def _fetch_failure_locked(self, ff: dict, deferred_events: list,
-                              deferred_final: list) -> None:
-        """Apply one reducer fetch-failure report (caller holds
-        ``self.lock``). The job counts distinct reporting reducers; once
+    def _fetch_failure(self, ff: dict, deferred_events: list,
+                       deferred_final: list) -> None:
+        """Apply one reducer fetch-failure report (job-lock work only —
+        the global lock is touched just to revoke the burned attempt's
+        commit grant). The job counts distinct reporting reducers; once
         it withdraws the map output the master-side effects land here:
         the burned attempt's commit grant is revoked (the re-run must be
         able to commit), a fault is charged to the tracker that SERVED
         the lost output — a lame-but-heartbeating shuffle server walks
         toward blacklisting exactly like a task-failing tracker — and
         the re-execution is metered + history-logged."""
-        from tpumr.mapred.ids import TaskAttemptID
         map_attempt = str(ff.get("map_attempt", ""))
         reduce_attempt = str(ff.get("reduce_attempt", ""))
         try:
@@ -1611,21 +1983,21 @@ class JobMaster:
                 self._mreg.incr("maps_reexecuted_fetch_failure")
             addr = res.get("shuffle_addr", "")
             info = self._tracker_by_shuffle_addr(addr)
-            if info is not None:
-                info.failures += 1
-                if info.failures >= self.blacklist_faults:
-                    info.blacklisted = True
+            if info is not None and \
+                    info.charge_fault(self.blacklist_faults):
+                self._blacklisted += 1
             deferred_events.append((str(task_id.job), "MAP_OUTPUT_LOST",
                                     dict(attempt_id=map_attempt,
                                          shuffle_addr=addr,
                                          reports=res.get("reports", 0),
                                          reexecuted=res["reexecuted"])))
         if before == JobState.RUNNING and jip.state in JobState.TERMINAL:
+            self._bump_jobs_version()
             deferred_final.append(jip)
 
     def _tracker_by_shuffle_addr(self, addr: str) -> "_TrackerInfo | None":
         """The registered tracker serving map outputs at ``addr``
-        (caller holds ``self.lock``)."""
+        (registry-striped scan)."""
         if not addr:
             return None
         for info in self.trackers.values():
@@ -1638,18 +2010,35 @@ class JobMaster:
 
     # ------------------------------------------------------------ expiry
 
-    def _evict_tracker_locked(self, name: str) -> None:
+    def _evict_tracker(self, name: str) -> None:
         """Remove one tracker and re-queue everything it owned (running
         attempts AND completed maps whose outputs lived there) —
-        ≈ JobTracker.lostTaskTracker. Caller holds self.lock."""
+        ≈ JobTracker.lostTaskTracker. Takes the registry shard lock for
+        the pop only; the requeue work runs under per-job locks (a slow
+        eviction must not stall other trackers' heartbeats)."""
         info = self.trackers.pop(name)
+        if info is None:
+            return
+        if info.blacklisted:
+            self._blacklisted = max(0, self._blacklisted - 1)
         self._last_response.pop(name, None)
         self.cluster_agg.forget(name)
-        attempts = [sd["attempt_id"] for sd in
-                    info.status.get("task_statuses", [])]
+        # the BELIEVED running set, not the last beat's status list: a
+        # delta beat may have suppressed (rate-limited) an unchanged
+        # RUNNING status, and a launched-but-never-reported attempt
+        # only exists here. Snapshot under the tracker's hb_lock: an
+        # in-flight beat that won the lock first finishes its
+        # fold/assign and its launches land in the snapshot; one that
+        # loses sees the popped registry entry and aborts with reinit
+        # (the membership re-check in _heartbeat) — either way nothing
+        # can be assigned to this tracker after the snapshot.
+        with info.hb_lock:
+            attempts = list(info.running) or \
+                [sd["attempt_id"] for sd in
+                 info.status.get("task_statuses", [])]
         addr = (f"{info.status.get('host', '')}:"
                 f"{info.status.get('shuffle_port', 0)}")
-        for jip in self.jobs.values():
+        for jip in list(self.jobs.values()):
             with jip.lock:
                 # OBSOLETE entries are tombstones of already-withdrawn
                 # outputs — only live events name outputs this tracker
@@ -1659,7 +2048,6 @@ class JobMaster:
                          if e["shuffle_addr"] == addr
                          and e.get("status") != "OBSOLETE"]
             jip.requeue_lost_attempts(attempts + owned)
-        from tpumr.mapred.ids import TaskAttemptID
         for aid in attempts:
             self._revoke_commit(str(TaskAttemptID.parse(aid).task), aid)
 
@@ -1667,8 +2055,7 @@ class JobMaster:
         while not self._stop.wait(min(1.0, self.expiry_s / 3)):
             now = time.monotonic()
             self.token_store.purge_expired()
-            with self.lock:
-                lost = [n for n, t in self.trackers.items()
-                        if now - t.seen_mono > self.expiry_s]
-                for name in lost:
-                    self._evict_tracker_locked(name)
+            lost = [n for n, t in self.trackers.items()
+                    if now - t.seen_mono > self.expiry_s]
+            for name in lost:
+                self._evict_tracker(name)

@@ -40,6 +40,10 @@ class TaskTrackerManager(Protocol):
     def running_jobs(self) -> list[JobInProgress]: ...
     def num_trackers(self) -> int: ...
     def total_slots(self) -> dict: ...   # {"cpu": n, "tpu": n, "reduce": n}
+    # optional: monotonically bumped when the running-job set (or a job
+    # priority) changes — lets the FIFO order cache skip its re-sort.
+    # Fakes without it just lose the caching (getattr-guarded).
+    # def jobs_version(self) -> int: ...
 
 
 class TaskScheduler:
@@ -98,12 +102,34 @@ class HybridQueueScheduler(TaskScheduler):
     passes (an upgrade over the reference, whose contrib schedulers were
     GPU-blind — SURVEY.md §1 L5)."""
 
+    #: FIFO-order cache state: (manager jobs_version, len(jobs)) → sorted
+    #: list. The order hooks run PER FREE SLOT per heartbeat (contract
+    #: below), which at fleet scale meant thousands of identical
+    #: O(jobs log jobs) sorts per second; priority and submit time only
+    #: change when the master bumps its jobs_version, so the sorted
+    #: order is reused until it does. Subclass overrides (fair/capacity
+    #: recompute shares per slot) are unaffected — the cache lives in
+    #: the base implementation only.
+    _fifo_key: "tuple | None" = None
+    _fifo_cache: "list[JobInProgress]" = []
+
+    def _priority_fifo_cached(self,
+                              jobs: list[JobInProgress]) -> list[JobInProgress]:
+        ver_fn = getattr(self.manager, "jobs_version", None)
+        if ver_fn is None:
+            return _priority_fifo(jobs)
+        key = (ver_fn(), len(jobs))
+        if key != self._fifo_key:
+            self._fifo_cache = _priority_fifo(jobs)
+            self._fifo_key = key
+        return self._fifo_cache
+
     def _map_job_order(self, jobs: list[JobInProgress]) -> list[JobInProgress]:
-        return _priority_fifo(jobs)
+        return self._priority_fifo_cached(jobs)
 
     def _reduce_job_order(self,
                           jobs: list[JobInProgress]) -> list[JobInProgress]:
-        return _priority_fifo(jobs)
+        return self._priority_fifo_cached(jobs)
 
     def _begin_assignment(self, tts: dict) -> None:
         """Called once per heartbeat before the passes — subclasses cache
@@ -152,9 +178,6 @@ class HybridQueueScheduler(TaskScheduler):
         def fits(demand_mb: int) -> bool:
             return mem_left < 0 or demand_mb <= mem_left
 
-        # cluster-wide pending load + profile scan (:127-178) — cheap here:
-        # per-job O(1) running sums instead of per-report recomputation
-        pending_map_load = sum(j.pending_map_count() for j in jobs)
         assigned: list[Task] = []
 
         cluster_mode = str(self.conf.get("tpumr.scheduler.mode",
@@ -162,38 +185,49 @@ class HybridQueueScheduler(TaskScheduler):
             if self.conf else "shirahata"
 
         # ---- per-JOB CPU budgets (a starved hybrid job must not block CPU
-        # slots for kernel-less jobs that can only ever run on CPU)
+        # slots for kernel-less jobs that can only ever run on CPU).
+        # Computed LAZILY on first visit: the passes walk the job order
+        # front-to-first-assignable, so a wide queue's tail — the common
+        # case at fleet scale, where this ran per asking heartbeat —
+        # never pays the accel-profile/minimizer arithmetic.
         cpu_budget: dict[str, int] = {}
-        for job in jobs:
+
+        def budget_of(job: JobInProgress) -> int:
             jid = str(job.job_id)
-            cpu_budget[jid] = free_cpu
-            if not job.has_kernel():
-                continue
-            accel = job.acceleration_factor()
-            # per-job override, same seam as optionalscheduling (a job
-            # may opt into the f(x,y) minimizer on a shirahata cluster)
-            mode = str(job.conf.get("tpumr.scheduler.mode", cluster_mode))
-            if job.tpu_disabled:
-                # job-level accelerator quarantine: the TPU pass below
-                # skips this job entirely, so neither starvation mode may
-                # zero its CPU budget — that combination would deadlock
-                # the job with pending maps no pass can assign
-                continue
-            if mode == "minimize":
-                # the f(x,y) optimum may put everything on TPU — demoted
-                # (CPU-pinned) TIPs still need a floor of CPU slots
-                cpu_budget[jid] = max(
-                    self._minimize_cpu_share(job, free_cpu,
-                                             max_tpu * n_trackers),
-                    min(free_cpu, job.cpu_pinned_pending_count()))
-            elif (self._optional_scheduling(job)
-                    and job.cpu_pinned_pending_count() == 0
-                    and job.pending_map_count() < accel * max_tpu * n_trackers):
-                # optional scheduling: starve THIS job's CPU share so its
-                # remaining maps converge to the accelerator (:290-327).
-                # CPU-pinned (demoted) TIPs lift the starvation: they can
-                # only ever run on the CPU pass
-                cpu_budget[jid] = 0
+            b = cpu_budget.get(jid)
+            if b is not None:
+                return b
+            b = free_cpu
+            if job.has_kernel() and not job.tpu_disabled:
+                # (quarantined jobs keep the full budget: the TPU pass
+                # skips them entirely, so neither starvation mode may
+                # zero their CPU share — that combination would deadlock
+                # the job with pending maps no pass can assign)
+                accel = job.acceleration_factor()
+                # per-job override, same seam as optionalscheduling (a
+                # job may opt into the f(x,y) minimizer on a shirahata
+                # cluster)
+                mode = str(job.conf.get("tpumr.scheduler.mode",
+                                        cluster_mode))
+                if mode == "minimize":
+                    # the f(x,y) optimum may put everything on TPU —
+                    # demoted (CPU-pinned) TIPs still need a floor of
+                    # CPU slots
+                    b = max(
+                        self._minimize_cpu_share(job, free_cpu,
+                                                 max_tpu * n_trackers),
+                        min(free_cpu, job.cpu_pinned_pending_count()))
+                elif (self._optional_scheduling(job)
+                        and job.cpu_pinned_pending_count() == 0
+                        and job.pending_map_count()
+                        < accel * max_tpu * n_trackers):
+                    # optional scheduling: starve THIS job's CPU share
+                    # so its remaining maps converge to the accelerator
+                    # (:290-327). CPU-pinned (demoted) TIPs lift the
+                    # starvation: they can only ever run on the CPU pass
+                    b = 0
+            cpu_budget[jid] = b
+            return b
 
         # ---- TPU pass first (reference order fills GPU after CPU; filling
         # the scarcer, faster pool first avoids giving a map to a CPU slot
@@ -206,6 +240,11 @@ class HybridQueueScheduler(TaskScheduler):
                 if not job.tpu_eligible():
                     # ≈ gpu-executable gate (:342-347), plus the job-
                     # level accelerator quarantine
+                    continue
+                if job.pending_map_count() == 0 and not job.speculative:
+                    # lock-free precheck (len of a set, stale by at most
+                    # a beat): obtain re-checks under the job lock, this
+                    # just skips the lock round trip for drained jobs
                     continue
                 if not fits(job.map_memory_mb()):
                     continue
@@ -221,32 +260,38 @@ class HybridQueueScheduler(TaskScheduler):
             assigned.append(task)
             if mem_left >= 0:
                 mem_left -= task.memory_mb
-            pending_map_load -= 1
 
         # ---- CPU pass (:290-327)
         for _ in range(free_cpu):
             task = None
             for job in self._map_job_order(jobs):
-                jid = str(job.job_id)
-                if cpu_budget.get(jid, 0) <= 0:
+                if job.pending_map_count() == 0 and not job.speculative:
+                    continue   # lock-free precheck, same as TPU pass
+                if budget_of(job) <= 0:
                     continue
                 if not fits(job.map_memory_mb()):
                     continue
                 task = job.obtain_new_map_task(host, run_on_tpu=False,
                                                rack=tts.get("rack"))
                 if task is not None:
-                    cpu_budget[jid] -= 1
+                    cpu_budget[str(job.job_id)] -= 1
                     break
             if task is None:
                 break
             assigned.append(task)
             if mem_left >= 0:
                 mem_left -= task.memory_mb
-            pending_map_load -= 1
 
         # ---- reduce pass: at most one per heartbeat (:527-560)
         if free_red > 0:
             for job in self._reduce_job_order(jobs):
+                if job.pending_reduce_count() == 0 \
+                        and not job.speculative_reduces:
+                    # lock-free precheck: most jobs in a wide queue have
+                    # their (few) reduces already placed — without this,
+                    # every heartbeat's reduce pass took every job's
+                    # lock just to hear "nothing pending"
+                    continue
                 if not fits(job.reduce_memory_mb()):
                     continue
                 task = job.obtain_new_reduce_task(host)

@@ -264,6 +264,31 @@ class NodeRunner:
                                            dir=local_base or None)
         self._response_id = 0
         self._initial_contact = True
+        # heartbeat delta encoding (tpumr.heartbeat.delta, default on):
+        # full status on (re)contact, change-only beats afterwards — an
+        # idle tracker's beat is a near-empty dict on the wire
+        from tpumr.mapred.heartbeat import HeartbeatEncoder
+        self._hb_encoder = HeartbeatEncoder(
+            conf.get_boolean("tpumr.heartbeat.delta", True))
+        #: the metrics piggyback rides at most this often (cumulative
+        #: state — freshness is a seconds-scale concern, and building
+        #: the typed snapshot every beat is pure overhead on fast-
+        #: heartbeat clusters; 0 = every beat, the default, where the
+        #: delta encoder still drops piggybacks that didn't change)
+        self._piggyback_interval_s = conf.get_int(
+            "tpumr.metrics.piggyback.interval.ms", 0) / 1000.0
+        self._piggyback_last = 0.0
+        #: RUNNING-status report-rate limit (delta beats only): a status
+        #: whose state/phase didn't change rides the wire at most once
+        #: per this interval — continuous progress movement otherwise
+        #: re-ships (and the master re-folds) every running task on
+        #: every beat. State transitions and terminal statuses always
+        #: ship. 0 = every beat. The master's believed-running set
+        #: tolerates the gaps (delta beats add/remove incrementally).
+        self._status_interval_s = conf.get_int(
+            "tpumr.task.status.report.interval.ms", 1000) / 1000.0
+        #: aid -> (state, phase, monotonic of last ship)
+        self._status_shipped: "dict[str, tuple]" = {}
         self._stop = threading.Event()
         self._hb_count = 0
         # per-pool gating ≈ TaskLauncher's numCPUFreeSlots/numGPUFreeSlots
@@ -706,32 +731,87 @@ class NodeRunner:
                              "histograms": hists}
         return out
 
+    def _suppress_statuses(self, statuses: "list[dict]") -> "list[dict]":
+        """The RUNNING-status rate limit: drop statuses whose
+        (state, phase) is unchanged and whose last ship is fresher than
+        the report interval. Terminal statuses always pass (losing one
+        would lose the completion)."""
+        if not self._status_interval_s:
+            return statuses
+        now = time.monotonic()
+        out = []
+        for sd in statuses:
+            if sd["state"] != TaskState.RUNNING:
+                out.append(sd)
+                continue
+            aid = sd["attempt_id"]
+            key = (sd["state"], sd.get("phase"))
+            prev = self._status_shipped.get(aid)
+            if prev is not None and prev[:2] == key \
+                    and now - prev[2] < self._status_interval_s:
+                continue
+            self._status_shipped[aid] = (*key, now)
+            out.append(sd)
+        return out
+
     def _heartbeat_once(self, hb_span: Any = None) -> None:
-        status = self._status_dict()
-        try:
-            status["metrics"] = self._metrics_piggyback()
-        except Exception:  # noqa: BLE001 — metering must not break
-            pass           # the heartbeat lease
+        full = self._status_dict()
+        now = time.monotonic()
+        metrics = None
+        if now - self._piggyback_last >= self._piggyback_interval_s:
+            try:
+                metrics = self._metrics_piggyback()
+            except Exception:  # noqa: BLE001 — metering must not break
+                metrics = None  # the heartbeat lease
+        # wire encoding: full on (re)contact, change-only delta after —
+        # the encoder also omits an UNCHANGED metrics piggyback (it is
+        # cumulative, so the master's last fold still holds). Delta
+        # beats additionally rate-limit unchanged RUNNING statuses; a
+        # FULL beat bypasses that (it resets the master's believed set)
+        wire = full
+        if self._hb_encoder.will_delta():
+            wire = dict(full, task_statuses=self._suppress_statuses(
+                full["task_statuses"]))
+        status = self._hb_encoder.encode(wire, metrics)
         if hb_span is not None:
             # the master pops this and parents its heartbeat phase
             # sub-spans to it (never stored in the tracker registry)
             status["trace"] = hb_span.context
-        cpu, tpu, red = (status["count_cpu_map_tasks"],
-                         status["count_tpu_map_tasks"],
-                         status["count_reduce_tasks"])
+        cpu, tpu, red = (full["count_cpu_map_tasks"],
+                         full["count_tpu_map_tasks"],
+                         full["count_reduce_tasks"])
         # ask if ANY pool has room (TaskTracker.java:1841-1844)
         ask = (cpu < self.max_cpu_map_slots or tpu < self.max_tpu_map_slots
                or red < self.max_reduce_slots)
-        resp = self.master.call("heartbeat", status, self._initial_contact,
-                                ask, self._response_id)
+        try:
+            resp = self.master.call("heartbeat", status,
+                                    self._initial_contact,
+                                    ask, self._response_id)
+        except Exception:
+            # delivery UNKNOWN (the master may have applied the beat and
+            # lost the response): the next beat must re-ship the full
+            # status — a delta against a baseline newer than ours could
+            # mask a changed-then-reverted key forever
+            self._hb_encoder.reset()
+            raise
+        self._hb_encoder.delivered()
+        if metrics is not None:
+            self._piggyback_last = now
         self._initial_contact = False
         self._response_id = resp["response_id"]
+        # adaptive cadence: the master instructs the next interval
+        # (scaled to fleet size, ≈ HeartbeatResponse.getHeartbeat-
+        # Interval); the loop's _stop.wait reads heartbeat_s fresh
+        # every beat, so the new cadence takes effect immediately
+        nxt = resp.get("next_interval_ms")
+        if isinstance(nxt, (int, float)) and nxt > 0:
+            self.heartbeat_s = nxt / 1000.0
         with self.lock:
             # the heartbeat DELIVERED these fetch-failure reports (they
-            # were snapshotted into `status` first — a failed RPC keeps
+            # were snapshotted into `full` first — a failed RPC keeps
             # them queued for the retry); entries appended since the
             # snapshot stay for the next beat
-            sent_ff = len(status.get("fetch_failures", []))
+            sent_ff = len(full.get("fetch_failures", []))
             if sent_ff:
                 del self._fetch_failures[:sent_ff]
             # Drop only statuses whose SENT snapshot was terminal — a task
@@ -739,10 +819,11 @@ class NodeRunner:
             # RUNNING, so it must survive until the next heartbeat or the
             # master never learns it completed.
             sent_terminal = {sd["attempt_id"]
-                             for sd in status.get("task_statuses", [])
+                             for sd in full.get("task_statuses", [])
                              if sd["state"] in TaskState.TERMINAL}
             for aid in sent_terminal:
                 self.running.pop(aid, None)
+                self._status_shipped.pop(aid, None)
                 self.running_tasks.pop(aid, None)
                 # reaper bookkeeping dies with the attempt
                 self._last_progress.pop(aid, None)
@@ -821,12 +902,16 @@ class NodeRunner:
             with self.lock:
                 self._kill_requested.add(action["attempt_id"])
         elif kind == "reinit":
-            # ≈ ReinitTrackerAction: drop local state, re-register
+            # ≈ ReinitTrackerAction: drop local state, re-register —
+            # with a FULL status (the master that reset us has no
+            # baseline to apply deltas to)
             with self.lock:
                 self.running.clear()
                 self.running_tasks.clear()
                 self._initial_contact = True
                 self._response_id = 0
+                self._hb_encoder.reset()
+                self._status_shipped.clear()
         elif kind == "disallowed":
             # ≈ DisallowedTaskTrackerException: this host was excluded
             # (mapred.hosts/.exclude + mradmin -refreshNodes). The

@@ -42,7 +42,12 @@ class ClusterAggregator:
 
     def __init__(self, registry: MetricsRegistry) -> None:
         self.registry = registry
-        self._lock = threading.Lock()
+        # per-tracker state STRIPED by tracker name: merges arrive from
+        # every heartbeat handler thread, and one shared lock here was
+        # a measurable cross-tracker convoy on the decomposed master
+        # (each tracker's baselines are private to it anyway; only the
+        # read-side aggregations walk all stripes)
+        self._stripes = [threading.Lock() for _ in range(16)]
         #: tracker -> {("c", key): value, ("h", key): typed} baselines
         self._prev: dict[str, dict] = {}
         #: tracker -> {key: value} last-reported numeric gauges
@@ -65,9 +70,12 @@ class ClusterAggregator:
         except Exception:  # noqa: BLE001 — observability must not
             pass           # break heartbeats
 
+    def _stripe(self, tracker: str) -> threading.Lock:
+        return self._stripes[hash(tracker) & 15]
+
     def _merge(self, tracker: str, piggyback: dict) -> None:
         gauges_out: dict[str, float] = {}
-        with self._lock:
+        with self._stripe(tracker):
             prev = self._prev.setdefault(tracker, {})
             for source in sorted(piggyback):
                 t = piggyback[source]
@@ -106,23 +114,33 @@ class ClusterAggregator:
         """Evicted/expired tracker: drop its baselines and gauge rows
         (already-merged counter/histogram increments stay — they
         happened)."""
-        with self._lock:
+        with self._stripe(tracker):
             self._prev.pop(tracker, None)
             self._gauges.pop(tracker, None)
 
     def gauge_rows(self) -> "dict[str, dict[str, float]]":
         """Per-tracker last-reported numeric gauges (the /cluster page's
         tracker table)."""
-        with self._lock:
-            return {t: dict(g) for t, g in self._gauges.items()}
+        # per-stripe-consistent walk (dict views are GIL-safe; each
+        # row is copied under its owner stripe's lock)
+        out: "dict[str, dict[str, float]]" = {}
+        for t in list(self._gauges):
+            with self._stripe(t):
+                g = self._gauges.get(t)
+                if g is not None:
+                    out[t] = dict(g)
+        return out
 
     def gauge_totals(self) -> "dict[str, float]":
         """Summed numeric gauges across live trackers — right for
         count-like gauges (running tasks, quarantined devices); ratio
         gauges are recomputed master-side from slot totals instead."""
         out: dict[str, float] = {}
-        with self._lock:
-            for g in self._gauges.values():
+        for t in list(self._gauges):
+            with self._stripe(t):
+                g = self._gauges.get(t)
+                if g is None:
+                    continue
                 for k, v in g.items():
                     out[k] = out.get(k, 0.0) + v
         return out

@@ -21,18 +21,49 @@ GAUGE_ERRORS = "metrics_gauge_errors"
 
 
 class MetricsRegistry:
-    """Thread-safe named counters + gauges + histograms for one source."""
+    """Thread-safe named counters + gauges + histograms for one source.
+
+    The counter WRITE path is lock-free (one GIL-atomic list append,
+    folded into the counter table lazily on the read side) for the same
+    reason ``Histogram.observe`` is: per-beat counters on the master's
+    heartbeat path are bumped from hundreds of handler threads, and a
+    mutex holder preempted mid-increment convoys all of them on one
+    core. Appends can neither be lost nor block; snapshots drain."""
+
+    #: pending-increment high-water mark — past it, the incrementing
+    #: thread try-locks and folds (never blocks)
+    INCR_HWM = 65536
 
     def __init__(self, name: str) -> None:
         self.name = name
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
+        self._counter_ops: "list[tuple[str, float]]" = []
         self._gauges: dict[str, Callable[[], Any]] = {}
         self._histograms: dict[str, Histogram] = {}
 
     def incr(self, name: str, amount: float = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + amount
+        ops = self._counter_ops
+        ops.append((name, amount))
+        if len(ops) >= self.INCR_HWM and self._lock.acquire(False):
+            try:
+                self._drain_locked()
+            finally:
+                self._lock.release()
+
+    def _drain_locked(self) -> None:
+        """Fold pending increments (caller holds ``_lock``). The
+        snapshotted-prefix copy + single atomic ``del`` make concurrent
+        appends safe — a late append lands past the deleted prefix."""
+        ops = self._counter_ops
+        n = len(ops)
+        if not n:
+            return
+        batch = ops[:n]
+        del ops[:n]
+        counters = self._counters
+        for name, amount in batch:
+            counters[name] = counters.get(name, 0) + amount
 
     def set_gauge(self, name: str, fn_or_value: Any) -> None:
         """A callable is sampled at snapshot time; a value is stored."""
@@ -62,10 +93,12 @@ class MetricsRegistry:
         if errors:
             self.incr(GAUGE_ERRORS, errors)
             with self._lock:   # surface the bump in THIS snapshot too
+                self._drain_locked()
                 counters[GAUGE_ERRORS] = self._counters[GAUGE_ERRORS]
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
+            self._drain_locked()
             out: dict[str, Any] = dict(self._counters)
             gauges = list(self._gauges.items())
             hists = list(self._histograms.items())
@@ -80,6 +113,7 @@ class MetricsRegistry:
         "histograms": {name: typed}}``. Histograms ride in their full
         typed (bucketed, mergeable) form."""
         with self._lock:
+            self._drain_locked()
             counters = dict(self._counters)
             gauges = list(self._gauges.items())
             hists = list(self._histograms.items())

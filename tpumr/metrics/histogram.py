@@ -53,10 +53,30 @@ class Histogram:
     reported p99 is within one bucket of the true value — plenty for
     "did heartbeat p99 regress 10x", useless noise for "did it regress
     3%", which is the honest trade fixed buckets make.
+
+    The WRITE path is lock-free: ``observe`` is a single
+    ``list.append`` (atomic under the GIL), and pending observations
+    fold into the bucket state lazily on the read side (snapshots,
+    percentiles, typed exports — all of which drain under the lock).
+    The eager-fold original held a mutex for a few field updates, which
+    looks harmless until hundreds of handler threads share one hot
+    histogram on one core: a holder preempted mid-section (the GIL
+    switch interval) convoys EVERY observer behind it — measured as the
+    dominant wait on the master's heartbeat path at fleet scale. An
+    append can neither be lost nor block, so the hot path has no convoy
+    to form. Readers pay the fold cost instead, off the hot path (every
+    owning daemon's metrics loop reads at least once per period, which
+    also bounds pending growth; a very hot histogram additionally
+    self-drains past a high-water mark).
     """
 
-    __slots__ = ("name", "bounds", "_counts", "count", "sum",
-                 "min", "max", "_lock")
+    __slots__ = ("name", "bounds", "_counts", "_count", "_sum",
+                 "_min", "_max", "_lock", "_pending")
+
+    #: pending-observation high-water mark: past this, the OBSERVING
+    #: thread try-locks and folds (never blocks) so an unread histogram
+    #: cannot grow without bound
+    PENDING_HWM = 65536
 
     def __init__(self, name: str,
                  bounds: "Sequence[float] | None" = None) -> None:
@@ -66,23 +86,73 @@ class Histogram:
         if list(self.bounds) != sorted(set(self.bounds)):
             raise ValueError("histogram bounds must be strictly increasing")
         self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
-        self.count = 0
-        self.sum = 0.0
-        self.min = 0.0
-        self.max = 0.0
+        self._count = 0
+        self._sum = 0.0
+        self._min = 0.0
+        self._max = 0.0
         self._lock = threading.Lock()
+        self._pending: "list[float]" = []
 
     def observe(self, value: float) -> None:
-        v = float(value)
-        i = bisect.bisect_left(self.bounds, v)
+        p = self._pending
+        p.append(float(value))
+        if len(p) >= self.PENDING_HWM and self._lock.acquire(False):
+            try:
+                self._drain_locked()
+            finally:
+                self._lock.release()
+
+    def _drain_locked(self) -> None:
+        """Fold pending observations into the bucket state. Caller
+        holds ``_lock``. Concurrent appends are safe: the length is
+        snapshotted first, the copied prefix is folded, and the single
+        ``del`` of that prefix is one atomic bytecode — late appends
+        land past the deleted prefix and survive for the next drain."""
+        p = self._pending
+        n = len(p)
+        if not n:
+            return
+        vals = p[:n]
+        del p[:n]
+        bounds, counts = self.bounds, self._counts
+        bl = bisect.bisect_left
+        count, total = self._count, self._sum
+        mn, mx = self._min, self._max
+        for v in vals:
+            counts[bl(bounds, v)] += 1
+            count += 1
+            total += v
+            if count == 1 or v < mn:
+                mn = v
+            if v > mx:
+                mx = v
+        self._count, self._sum = count, total
+        self._min, self._max = mn, mx
+
+    # folded totals (drain-on-read so the attributes stay exact)
+    @property
+    def count(self) -> int:
         with self._lock:
-            self._counts[i] += 1
-            self.count += 1
-            self.sum += v
-            if self.count == 1 or v < self.min:
-                self.min = v
-            if v > self.max:
-                self.max = v
+            self._drain_locked()
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            self._drain_locked()
+            return self._sum
+
+    @property
+    def min(self) -> float:
+        with self._lock:
+            self._drain_locked()
+            return self._min
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            self._drain_locked()
+            return self._max
 
     def time(self) -> "Timer":
         """``with hist.time(): ...`` — observe the block's wall time."""
@@ -92,8 +162,9 @@ class Histogram:
 
     def _state(self) -> tuple:
         with self._lock:
-            return (list(self._counts), self.count, self.sum,
-                    self.min, self.max)
+            self._drain_locked()
+            return (list(self._counts), self._count, self._sum,
+                    self._min, self._max)
 
     def percentile(self, q: float, counts: "list[int] | None" = None,
                    count: "int | None" = None) -> float:
@@ -158,19 +229,20 @@ class Histogram:
         if count <= 0:
             return
         with self._lock:
+            self._drain_locked()
             for i, c in (delta.get("buckets") or {}).items():
                 i = int(i)
                 if 0 <= i < len(self._counts):
                     self._counts[i] += int(c)
-            first = self.count == 0
-            self.count += count
-            self.sum += float(delta.get("sum", 0.0))
+            first = self._count == 0
+            self._count += count
+            self._sum += float(delta.get("sum", 0.0))
             dmin = float(delta.get("min", 0.0))
             dmax = float(delta.get("max", 0.0))
-            if first or dmin < self.min:
-                self.min = dmin
-            if dmax > self.max:
-                self.max = dmax
+            if first or dmin < self._min:
+                self._min = dmin
+            if dmax > self._max:
+                self._max = dmax
 
 
 def typed_delta(cur: dict, prev: "dict | None") -> "dict | None":

@@ -1,27 +1,72 @@
-"""Instrumented locking — contention as a first-class distribution.
+"""Instrumented, rank-ordered locking — contention as a first-class
+distribution, deadlocks as assertion failures.
 
-The JobTracker is one process behind one RLock; every heartbeat,
-completion-event poll, and status page serializes on it. The reference
+The JobTracker began as one process behind one RLock; every heartbeat,
+completion-event poll, and status page serialized on it. The reference
 never measured that (its global synchronized heartbeat monitor was a
-known scaling wall nobody could see coming — SURVEY.md §3.2); here the
-master lock is wrapped so wait time (how long callers queue) and hold
-time (how long the winner keeps everyone else out) land in histograms
-(``jt_lock_wait_seconds`` / ``jt_lock_hold_seconds``). Wait p99 climbing
-while hold p99 stays flat = more contenders; both climbing = the work
-under the lock grew. These are the first series the control-plane
-scale-out refactor is judged against (ROADMAP, bench_scale.py).
+known scaling wall nobody could see coming — SURVEY.md §3.2); here
+every master lock is wrapped so wait time (how long callers queue) and
+hold time (how long the winner keeps everyone else out) land in
+histograms (``jt_lock_wait_seconds{lock=...}`` /
+``jt_lock_hold_seconds{lock=...}``). Wait p99 climbing while hold p99
+stays flat = more contenders; both climbing = the work under the lock
+grew. These are the first series the control-plane scale-out refactor
+is judged against (ROADMAP, bench_scale.py).
+
+Since the lock decomposition (PR 8) the master runs on FIVE lock
+classes with a fixed acquisition order, ascending by rank::
+
+    tracker-beat(5) -> scheduler(10) -> global(20) -> trackers(30)
+        -> job(40)
+
+A thread may acquire a lock only when every lock it already holds has a
+rank <= the new lock's (same-lock re-entrancy always allowed). The one
+rule worth memorizing: **scheduler -> job, never the reverse** — the
+scheduler pass obtains tasks under per-job locks, so a job-lock holder
+calling back into the scheduler would deadlock the control plane. The
+order is asserted in debug mode: violations raise ``AssertionError``
+with both lock names. ``python -O`` or ``TPUMR_LOCK_ORDER_CHECK=0``
+disables the check (the bookkeeping is a thread-local list append/pop
+per outermost acquire — cheap, but not free).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any
 
+#: canonical lock ranks (ascending = legal acquisition order). The
+#: numbers are spaced so a future lock class can slot between tiers.
+RANK_TRACKER_BEAT = 5    # one tracker's heartbeat processing
+RANK_SCHEDULER = 10      # scheduler passes (before_heartbeat / assign)
+RANK_GLOBAL = 20         # job table, commit grants, admin swaps
+RANK_TRACKERS = 30       # tracker registry stripes
+RANK_JOB = 40            # one JobInProgress's task bookkeeping
+
+_ORDER_NAMES = "tracker-beat(5) -> scheduler(10) -> global(20) " \
+               "-> trackers(30) -> job(40)"
+
+#: debug-mode ordering assertion: on under ``__debug__`` (plain
+#: ``python``), off under ``python -O`` or TPUMR_LOCK_ORDER_CHECK=0
+ORDER_CHECK = __debug__ and os.environ.get(
+    "TPUMR_LOCK_ORDER_CHECK", "1").lower() not in ("0", "false", "no")
+
+_held = threading.local()
+
+
+def _held_stack() -> "list[InstrumentedRLock]":
+    s = getattr(_held, "stack", None)
+    if s is None:
+        s = _held.stack = []
+    return s
+
 
 class InstrumentedRLock:
     """A re-entrant lock recording acquisition wait and outermost hold
-    durations into histograms.
+    durations into histograms, optionally participating in the master's
+    rank-ordered deadlock assertion.
 
     Drop-in for ``threading.RLock`` at the ``acquire``/``release``/
     context-manager surface. Only the OUTERMOST acquire measures wait
@@ -29,20 +74,37 @@ class InstrumentedRLock:
     outermost release records hold — nested ``with`` blocks must not
     turn one hold into N overlapping observations. Histograms may be
     bound after construction (:meth:`bind`) so the lock can exist
-    before the metrics registry does; unbound, it costs one thread-local
-    read over a plain RLock.
+    before the metrics registry does; unbound and unranked, it costs
+    one thread-local read over a plain RLock (no clock calls).
     """
 
-    def __init__(self, wait_hist: Any = None, hold_hist: Any = None) -> None:
+    def __init__(self, wait_hist: Any = None, hold_hist: Any = None,
+                 *, name: str = "", rank: int = 0) -> None:
         self._lock = threading.RLock()
         self._wait = wait_hist
         self._hold = hold_hist
+        self.name = name
+        self.rank = int(rank)
         self._tl = threading.local()
 
     def bind(self, wait_hist: Any, hold_hist: Any) -> "InstrumentedRLock":
         self._wait = wait_hist
         self._hold = hold_hist
         return self
+
+    def _assert_order(self) -> None:
+        stack = _held_stack()
+        if not stack:
+            return
+        # acquisition ranks are enforced ascending, so the top of the
+        # held stack is the max held rank
+        top = stack[-1]
+        if top.rank > self.rank:
+            raise AssertionError(
+                f"lock-order violation: acquiring "
+                f"{self.name or 'lock'} (rank {self.rank}) while "
+                f"holding {top.name or 'lock'} (rank {top.rank}); "
+                f"the master's order is {_ORDER_NAMES}")
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         depth = getattr(self._tl, "depth", 0)
@@ -52,20 +114,42 @@ class InstrumentedRLock:
             if ok:
                 self._tl.depth = depth + 1
             return ok
-        t0 = time.monotonic()
-        ok = self._lock.acquire(blocking, timeout)
-        if ok:
-            now = time.monotonic()
-            if self._wait is not None:
+        if ORDER_CHECK and self.rank:
+            self._assert_order()
+        if self._wait is None:
+            ok = self._lock.acquire(blocking, timeout)
+            if ok:
+                self._tl.depth = 1
+                if self._hold is not None:
+                    self._tl.acquired_at = time.monotonic()
+        else:
+            t0 = time.monotonic()
+            ok = self._lock.acquire(blocking, timeout)
+            if ok:
+                now = time.monotonic()
                 self._wait.observe(now - t0)
-            self._tl.depth = 1
-            self._tl.acquired_at = now
+                self._tl.depth = 1
+                self._tl.acquired_at = now
+        if ok and ORDER_CHECK and self.rank:
+            _held_stack().append(self)
         return ok
 
     def release(self) -> None:
         depth = getattr(self._tl, "depth", 0)
-        if depth == 1 and self._hold is not None:
-            self._hold.observe(time.monotonic() - self._tl.acquired_at)
+        if depth == 1:
+            if self._hold is not None:
+                t0 = getattr(self._tl, "acquired_at", None)
+                if t0 is not None:
+                    self._hold.observe(time.monotonic() - t0)
+            if ORDER_CHECK and self.rank:
+                stack = _held_stack()
+                if stack and stack[-1] is self:
+                    stack.pop()
+                else:  # released out of acquisition order — still legal
+                    try:
+                        stack.remove(self)
+                    except ValueError:
+                        pass
         if depth:
             self._tl.depth = depth - 1
         self._lock.release()

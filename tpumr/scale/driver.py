@@ -90,11 +90,12 @@ class ScaleDriver:
 
     def run_workload(self, n_jobs: int, maps_per_job: int,
                      reduces_per_job: int = 1, timeout_s: float = 60.0,
+                     poll_s: float = 0.2,
                      **conf_overrides: Any) -> dict:
         """submit + wait, one call (the bench/CLI entry)."""
         ids = self.submit(n_jobs, maps_per_job, reduces_per_job,
                           **conf_overrides)
-        return self.wait(ids, timeout_s=timeout_s)
+        return self.wait(ids, timeout_s=timeout_s, poll_s=poll_s)
 
     def close(self) -> None:
         self.client.close()

@@ -1,10 +1,12 @@
 """Simulated trackers: the real heartbeat wire protocol, fake execution.
 
 A ``SimTracker`` is what a ``NodeRunner`` looks like FROM THE MASTER:
-it registers with the protocol-version handshake, heartbeats a complete
-status dict (slot pools, task statuses, metrics piggyback, fetch-failure
-reports) through a real ``RpcClient`` socket, honors the response-id
-replay protocol, and applies launch/kill/reinit/disallowed actions. The
+it registers with the protocol-version handshake, heartbeats its status
+(slot pools, task statuses, metrics piggyback, fetch-failure reports —
+full on contact, change-only deltas afterwards, exactly the NodeRunner
+encoding from ``tpumr.mapred.heartbeat``) through a real ``RpcClient``
+socket, honors the response-id replay protocol, and applies
+launch/kill/reinit/disallowed actions. The
 one thing it fakes is the work: an assigned task becomes a timed no-op
 whose duration is drawn from a configurable distribution, and a
 simulated reduce only completes after it has polled the master's
@@ -28,6 +30,7 @@ import time
 from typing import Any, Callable
 
 from tpumr.ipc.rpc import RpcClient
+from tpumr.mapred.heartbeat import HeartbeatEncoder
 from tpumr.mapred.ids import TaskAttemptID
 from tpumr.mapred.jobtracker import PROTOCOL_VERSION
 from tpumr.mapred.task import TaskPhase, TaskState, TaskStatus
@@ -69,7 +72,9 @@ class SimTracker:
                  rng: "random.Random | None" = None,
                  fetch_failure_rate: float = 0.0,
                  piggyback: bool = True,
+                 piggyback_interval_s: float = 1.0,
                  handshake: bool = True,
+                 delta: bool = True,
                  rpc_timeout_s: float = 30.0) -> None:
         self.name = name
         self.cpu_slots = cpu_slots
@@ -105,36 +110,111 @@ class SimTracker:
         self._reg = MetricsRegistry("tasktracker") if piggyback else None
         if self._reg is not None:
             self._task_hist = self._reg.histogram("sim_task_seconds")
+        #: piggyback dirty flag + minimum ship interval: the registry
+        #: only moves when a task completes, so idle beats skip
+        #: building (and shipping) the typed snapshot entirely; under
+        #: load the snapshot rides at most once per interval (metrics
+        #: freshness is a seconds-scale concern, heartbeats are not) —
+        #: mirrors the NodeRunner's tpumr.metrics.piggyback.interval.ms
+        self._metrics_dirty = True
+        self._piggyback_interval_s = float(piggyback_interval_s)
+        self._piggyback_last = 0.0
+        # the real tracker's delta encoding (tpumr.mapred.heartbeat):
+        # the sim fleet must exercise the same wire protocol the master
+        # optimizes for — near-empty idle beats included
+        self._hb_encoder = HeartbeatEncoder(delta)
+        #: RUNNING-status report-rate limit, mirroring the NodeRunner's
+        #: tpumr.task.status.report.interval.ms (state transitions and
+        #: terminal statuses always ship; unchanged RUNNING at most
+        #: once per interval on delta beats)
+        self._status_interval_s = 1.0
+        self._status_shipped: "dict[str, tuple]" = {}
+        #: in-flight pipelined beat (heartbeat_begin → heartbeat_finish)
+        self._beat_ctx: "tuple | None" = None
+        #: master-instructed heartbeat interval (adaptive cadence);
+        #: None until the first response — the fleet schedules this
+        #: tracker's next beat from it, exactly like a NodeRunner
+        self.next_interval_s: "float | None" = None
 
     # ------------------------------------------------------------ protocol
 
     def heartbeat_once(self) -> None:
         """One full heartbeat round: advance fake work, poll completion
         events for gated reduces, send status, apply the response."""
+        if self.heartbeat_begin():
+            self.heartbeat_finish()
+
+    def heartbeat_begin(self) -> bool:
+        """First half of a beat: advance fake work, poll events, SEND
+        the status — without waiting for the response. Returns True
+        when a request is now outstanding (pair with
+        :meth:`heartbeat_finish`). The fleet pipelines many trackers'
+        begins back-to-back so the master's handling overlaps the
+        client side of other trackers instead of context-switching
+        once per beat."""
         if self.stopped:
-            return
+            return False
         self._poll_completion_events()
         self._advance_tasks()
-        status = self._status_dict()
+        full = self._status_dict()
+        now = time.monotonic()
+        ship_metrics = (self._reg is not None and self._metrics_dirty
+                        and now - self._piggyback_last
+                        >= self._piggyback_interval_s)
+        metrics = ({"tasktracker": self._reg.typed_snapshot()}
+                   if ship_metrics else None)
+        wire = full
+        if self._hb_encoder.will_delta():
+            wire = dict(full, task_statuses=self._suppress_statuses(
+                full["task_statuses"], now))
+        status = self._hb_encoder.encode(wire, metrics)
         cpu, red = self._counts()
         ask = cpu < self.cpu_slots or red < self.reduce_slots
-        resp = self.master.call("heartbeat", status,
-                                self._initial_contact, ask,
-                                self._response_id)
+        try:
+            self.master.call_begin("heartbeat", status,
+                                   self._initial_contact, ask,
+                                   self._response_id)
+        except Exception:
+            # delivery unknown — same contract as NodeRunner: the next
+            # beat re-ships the full status
+            self._hb_encoder.reset()
+            raise
+        self._beat_ctx = (full, metrics, now)
+        return True
+
+    def heartbeat_finish(self) -> None:
+        """Second half: receive the response of the outstanding
+        :meth:`heartbeat_begin` and apply it."""
+        full, metrics, now = self._beat_ctx
+        try:
+            resp = self.master.call_finish()
+        except Exception:
+            # delivery unknown — same contract as NodeRunner: the next
+            # beat re-ships the full status
+            self._hb_encoder.reset()
+            raise
+        self._hb_encoder.delivered()
+        if metrics is not None:
+            self._metrics_dirty = False
+            self._piggyback_last = now
         self._initial_contact = False
         self._response_id = resp["response_id"]
+        nxt = resp.get("next_interval_ms")
+        if isinstance(nxt, (int, float)) and nxt > 0:
+            self.next_interval_s = nxt / 1000.0
         self.heartbeats += 1
         # delivered fetch-failure reports are done; ones appended since
         # the snapshot would stay — mirrors NodeRunner's contract
-        sent_ff = len(status.get("fetch_failures", []))
+        sent_ff = len(full.get("fetch_failures", []))
         if sent_ff:
             del self._fetch_failures[:sent_ff]
         # drop statuses whose SENT snapshot was terminal (same rule as
         # the real tracker: a completion racing the RPC must survive)
-        for sd in status.get("task_statuses", []):
+        for sd in full.get("task_statuses", []):
             if sd["state"] in TaskState.TERMINAL:
                 self._running.pop(sd["attempt_id"], None)
                 self._kill_requested.discard(sd["attempt_id"])
+                self._status_shipped.pop(sd["attempt_id"], None)
         for action in resp.get("actions", []):
             self._apply_action(action)
 
@@ -185,16 +265,22 @@ class SimTracker:
                 if self._reg is not None:
                     self._reg.incr("sim_tasks_completed")
                     self._task_hist.observe(t.duration)
+                    self._metrics_dirty = True
             else:
                 st.progress = min(0.99, elapsed / t.duration)
 
     def _poll_completion_events(self) -> None:
         """Per running reduce's job, one incremental completion-event
         poll per beat — the real umbilical cadence, carried over the
-        same master RPC surface (and observed by its lag series)."""
+        same master RPC surface (and observed by its lag series). A
+        reduce that has already seen every map output stops polling,
+        exactly like the real ReduceCopier once its fetch set is
+        complete (OBSOLETE withdrawals can't strand it: a sim reduce
+        past its shuffle gate no longer re-fetches)."""
         jobs = {t.job_id for t in self._running.values()
                 if not t.status.is_map
-                and t.status.state == TaskState.RUNNING}
+                and t.status.state == TaskState.RUNNING
+                and len(self._maps_live.get(t.job_id, {})) < t.num_maps}
         for job_id in jobs:
             cursor = self._event_cursor.get(job_id, 0)
             try:
@@ -236,6 +322,27 @@ class SimTracker:
 
     # ------------------------------------------------------------ wire
 
+    def _suppress_statuses(self, statuses: "list[dict]",
+                           now: float) -> "list[dict]":
+        """NodeRunner._suppress_statuses's sim twin: rate-limit
+        unchanged RUNNING statuses on delta beats."""
+        if not self._status_interval_s:
+            return statuses
+        out = []
+        for sd in statuses:
+            if sd["state"] != TaskState.RUNNING:
+                out.append(sd)
+                continue
+            aid = sd["attempt_id"]
+            key = (sd["state"], sd.get("phase"))
+            prev = self._status_shipped.get(aid)
+            if prev is not None and prev[:2] == key \
+                    and now - prev[2] < self._status_interval_s:
+                continue
+            self._status_shipped[aid] = (*key, now)
+            out.append(sd)
+        return out
+
     def _status_dict(self) -> dict:
         cpu, red = self._counts()
         status = {
@@ -259,9 +366,6 @@ class SimTracker:
             "healthy": True,
             "health_report": "",
         }
-        if self._reg is not None:
-            status["metrics"] = {"tasktracker":
-                                 self._reg.typed_snapshot()}
         return status
 
     def _apply_action(self, action: dict) -> None:
@@ -287,6 +391,8 @@ class SimTracker:
             self._fetch_failures.clear()
             self._initial_contact = True
             self._response_id = 0
+            self._hb_encoder.reset()   # re-register with a full status
+            self._status_shipped.clear()
         elif kind == "disallowed":
             self.stopped = True
 
@@ -343,41 +449,64 @@ class SimFleet:
             self._threads.append(t)
         return self
 
+    #: max due beats one worker drains per wakeup: begins are PIPELINED
+    #: (send all, then collect all responses) so the master handles a
+    #: batch while this worker is still building the next request —
+    #: at fleet rates the per-beat context-switch ping-pong was costing
+    #: more CPU than the beats themselves. Bounded so one worker can't
+    #: hoard a saturated heap (lag is recorded per beat either way).
+    BATCH = 16
+
     def _worker(self) -> None:
         while not self._stop.is_set():
+            batch: "list[tuple[float, int]]" = []
             with self._cv:
                 while not self._stop.is_set():
-                    if not self._heap:
-                        self._cv.wait(0.05)
-                        continue
-                    due, idx = self._heap[0]
-                    wait = due - time.monotonic()
-                    if wait <= 0:
-                        heapq.heappop(self._heap)
+                    now = time.monotonic()
+                    while self._heap and len(batch) < self.BATCH \
+                            and self._heap[0][0] <= now:
+                        batch.append(heapq.heappop(self._heap))
+                    if batch:
                         break
-                    self._cv.wait(min(wait, 0.05))
+                    wait = (self._heap[0][0] - now) if self._heap \
+                        else 0.05
+                    self._cv.wait(min(max(wait, 0.0), 0.05))
                 else:
                     return
             now = time.monotonic()
-            self._lag.observe(max(0.0, now - due))
-            tracker = self.trackers[idx]
-            if not tracker.stopped:
+            begun: "list[tuple[float, int, float]]" = []
+            for due, idx in batch:
+                self._lag.observe(max(0.0, now - due))
+                tracker = self.trackers[idx]
+                if tracker.stopped:
+                    continue
                 t0 = time.monotonic()
                 try:
-                    tracker.heartbeat_once()
+                    if tracker.heartbeat_begin():
+                        begun.append((due, idx, t0))
+                except Exception:  # noqa: BLE001 — master down/overload
+                    self.registry.incr("hb_errors")
+            for due, idx, t0 in begun:
+                try:
+                    self.trackers[idx].heartbeat_finish()
                     self._rtt.observe(time.monotonic() - t0)
                 except Exception:  # noqa: BLE001 — master down/overload
                     self.registry.incr("hb_errors")
-            # fixed-rate schedule; when more than a full interval behind,
-            # skip ahead (the lag was recorded — re-queueing a backlog of
-            # missed beats would only spiral the overload)
-            nxt = due + self.interval_s
+            # fixed-rate schedule AGAINST THE INSTRUCTED CADENCE (the
+            # master's adaptive interval, once a response carried one);
+            # when more than a full interval behind, skip ahead (the lag
+            # was recorded — re-queueing a backlog of missed beats would
+            # only spiral the overload)
             now = time.monotonic()
-            if nxt <= now:
-                nxt = now + self.interval_s
             with self._cv:
-                if not tracker.stopped and not self._stop.is_set():
-                    heapq.heappush(self._heap, (nxt, idx))
+                for due, idx in batch:
+                    tracker = self.trackers[idx]
+                    if not tracker.stopped and not self._stop.is_set():
+                        iv = tracker.next_interval_s or self.interval_s
+                        nxt = due + iv
+                        if nxt <= now:
+                            nxt = now + iv
+                        heapq.heappush(self._heap, (nxt, idx))
                 self._cv.notify()
 
     def stop(self) -> None:

@@ -23,6 +23,9 @@ from typing import Any, Iterator
 
 _local = threading.local()
 
+#: memoized process login (see get_current_user)
+_process_login: "str | None" = None
+
 
 class UserGroupInformation:
     """≈ UserGroupInformation.getCurrentUser / doAs (simple-auth mode:
@@ -40,10 +43,17 @@ class UserGroupInformation:
             return override
         if conf is not None and conf.get("user.name"):
             return UserGroupInformation(str(conf.get("user.name")))
-        try:
-            return UserGroupInformation(getpass.getuser())
-        except Exception:  # no passwd entry (containers)
-            return UserGroupInformation(os.environ.get("USER", "nobody"))
+        # the process login is resolved once: this sits on the RPC
+        # client's per-call path (identity rides every request) and
+        # getpass walks env/passwd each time — measurable at thousands
+        # of heartbeats per second
+        global _process_login
+        if _process_login is None:
+            try:
+                _process_login = getpass.getuser()
+            except Exception:  # no passwd entry (containers)
+                _process_login = os.environ.get("USER", "nobody")
+        return UserGroupInformation(_process_login)
 
     @contextlib.contextmanager
     def do_as(self) -> Iterator["UserGroupInformation"]:
