@@ -57,6 +57,11 @@ SMALL = os.environ.get("BENCH_SCALE") == "small" or "--smoke" in sys.argv
 FLEETS = [4, 8, 12, 16] if SMALL else [25, 50, 100, 200, 400]
 INTERVAL_S = 0.05 if SMALL else 0.1
 
+#: fleet sizes for the master-restart recovery series (kill→first
+#: post-restart assignment): smaller than the ramp — the series
+#: measures the recovery protocol, not saturation
+RECOVERY_FLEETS = [4, 8] if SMALL else [50, 200]
+
 #: p99 heartbeat-latency SLO the "max sustainable fleet" is judged at
 SLO_S = float(os.environ.get("TPUMR_SCALE_SLO_MS", "250")) / 1000.0
 
@@ -205,6 +210,142 @@ def run_step(n_trackers: int, interval_s: float,
     return row
 
 
+def _log_recovery_row(row: dict) -> None:
+    log(f"[scale] recovery @ {row['trackers']:4d} trackers: master "
+        f"kill→restart {row['restart_s'] * 1e3:.0f}ms · kill→first "
+        f"assignment {row['recovery_first_assign_s'] * 1e3:.0f}ms · "
+        f"{row['jobs_recovered']} jobs / {row['attempts_recovered']} "
+        f"attempts recovered · {row['trackers_adopted']} trackers "
+        f"adopted"
+        + ("" if row["completed"] else " · WORKLOAD INCOMPLETE"))
+
+
+def run_recovery_step(n_trackers: int, interval_s: float,
+                      wait_timeout_s: float) -> dict:
+    """Master-restart recovery time under a live fleet: run a workload
+    to ~1/3 map completion, kill the master (stop with no goodbye),
+    restart it on the same address with attempt-level recovery on, and
+    measure kill→first post-restart task assignment — the window in
+    which the cluster makes no scheduling progress. The fleet keeps its
+    fake in-flight work running throughout (lost-master semantics), the
+    driver keeps polling the OLD job ids (the job_recovered alias), and
+    the workload must still complete. The recovery grace (sized to a
+    few beats here, since the whole fleet re-joins within ~1 interval)
+    is deliberately INSIDE the measured window: waiting for re-joins IS
+    recovery time."""
+    import shutil
+    import tempfile
+
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.jobtracker import JobMaster
+    from tpumr.scale import ScaleDriver, SimFleet
+
+    hist = tempfile.mkdtemp(prefix="tpumr-bench-recovery-")
+
+    def _conf(recover: bool) -> "JobConf":
+        conf = JobConf()
+        conf.set("tpumr.history.dir", hist)
+        conf.set("tpumr.heartbeat.interval.ms", int(interval_s * 1000))
+        conf.set("tpumr.tracker.expiry.ms", 60_000)
+        conf.set("mapred.jobtracker.restart.recover", recover)
+        conf.set("mapred.jobtracker.restart.recovery.grace.ms",
+                 int(4 * interval_s * 1000))
+        return conf
+
+    master = JobMaster(_conf(False)).start()
+    host, port = master.address
+    fleet = SimFleet(host, port, n_trackers, interval_s=interval_s,
+                     cpu_slots=2, reduce_slots=1,
+                     task_time_mean_s=6.0 * interval_s).start()
+    driver = ScaleDriver(host, port)
+    m2 = None
+    try:
+        n_jobs = max(2, n_trackers // 8)
+        total_maps = 8 * 2 * n_trackers        # ~4 waves over the slots
+        maps_per_job = max(8, total_maps // n_jobs)
+        ids = driver.submit(n_jobs, maps_per_job, 2)
+        deadline = time.monotonic() + wait_timeout_s
+
+        def _finished_maps() -> int:
+            done = 0
+            for jid in ids:
+                try:
+                    done += driver.client.call("get_job_status",
+                                               jid)["finished_maps"]
+                except Exception:  # noqa: BLE001 — restart window
+                    pass
+            return done
+
+        while _finished_maps() < (n_jobs * maps_per_job) // 3:
+            if time.monotonic() > deadline:
+                raise TimeoutError("workload never reached 1/3 maps")
+            time.sleep(5 * interval_s)
+        t_kill = time.monotonic()
+        master.stop()
+        for _ in range(250):
+            try:
+                m2 = JobMaster(_conf(True), host=host,
+                               port=port).start()
+                break
+            except OSError:
+                time.sleep(0.02)
+        if m2 is None:
+            raise RuntimeError("could not rebind the master port")
+        t_up = time.monotonic()
+
+        def _launched() -> int:
+            jt = m2.metrics.snapshot().get("jobtracker", {})
+            return int(jt.get("maps_launched_cpu", 0)
+                       + jt.get("maps_launched_tpu", 0)
+                       + jt.get("reduces_launched", 0))
+
+        while _launched() == 0 and time.monotonic() < deadline:
+            time.sleep(interval_s / 10)
+        t_first = time.monotonic()
+        result = driver.wait(ids, timeout_s=max(
+            5.0, deadline - time.monotonic()), poll_s=0.5)
+        jt = m2.metrics.snapshot().get("jobtracker", {})
+        return {
+            "trackers": n_trackers,
+            "jobs": n_jobs,
+            "maps_per_job": maps_per_job,
+            "interval_s": interval_s,
+            "grace_s": 4 * interval_s,
+            "restart_s": round(t_up - t_kill, 3),
+            "recovery_first_assign_s": round(t_first - t_kill, 3),
+            "jobs_recovered": int(jt.get("jobs_recovered", 0)),
+            "attempts_recovered": int(jt.get("attempts_recovered", 0)),
+            "trackers_adopted": int(jt.get("trackers_adopted", 0)),
+            "completed": not result["unfinished"]
+                         and not result["failed"],
+        }
+    finally:
+        fleet.stop()
+        driver.close()
+        (m2 if m2 is not None else master).stop()
+        shutil.rmtree(hist, ignore_errors=True)
+
+
+def run_recovery_bench(fleets: "list[int] | None" = None,
+                       interval_s: "float | None" = None,
+                       wait_timeout_s: "float | None" = None) -> list:
+    """The recovery-time series (non-gating): one row per fleet size;
+    a failed step becomes an error row rather than failing the bench."""
+    rows = []
+    for n in fleets or RECOVERY_FLEETS:
+        try:
+            row = run_recovery_step(n, interval_s or INTERVAL_S,
+                                    wait_timeout_s
+                                    or (60.0 if SMALL else 180.0))
+        except Exception as e:  # noqa: BLE001 — non-gating series
+            log(f"[scale] recovery @ {n} trackers FAILED: {e}")
+            rows.append({"trackers": n, "error": str(e)})
+            continue
+        rows.append(row)
+        _log_recovery_row(row)
+    return rows
+
+
 def run_bench(fleets: "list[int] | None" = None,
               interval_s: "float | None" = None,
               slo_s: "float | None" = None,
@@ -278,7 +419,25 @@ def main() -> None:
             prior = json.load(f)
     except (OSError, ValueError):
         pass
+    if "--recovery-only" in sys.argv:
+        # refresh ONLY the master-restart recovery series, preserving
+        # the committed ramp rows (the ramp is minutes of measurement;
+        # the recovery series is seconds)
+        report = prior or {"rows": []}
+        report["recovery_rows"] = run_recovery_bench()
+        with open("bench_scale.json", "w") as f:
+            json.dump(report, f, sort_keys=True, indent=1)
+        print(json.dumps({
+            "metric": "master-restart recovery: kill→first assignment",
+            "value": max((r.get("recovery_first_assign_s", 0.0)
+                          for r in report["recovery_rows"]),
+                         default=0.0),
+            "unit": "s", "vs_baseline": 1.0}))
+        return
     report = run_bench()
+    # the recovery series rides every run (non-gating; the --assert-slo
+    # gate below judges only the ramp rows)
+    report["recovery_rows"] = run_recovery_bench()
     with open("bench_scale.json", "w") as f:
         json.dump(report, f, sort_keys=True, indent=1)
     log(f"detail rows -> bench_scale.json: "

@@ -465,31 +465,71 @@ class TestSeededFaultInjection:
 # ------------------------------------------------- tracker heartbeat
 
 
+def _bare_noderunner(interval_s=0.2):
+    """A NodeRunner shell for heartbeat-loop tests — no daemon
+    bring-up, just the fields the loop touches."""
+    from tpumr.mapred.tasktracker import NodeRunner
+    from tpumr.metrics.core import MetricsRegistry
+    nr = object.__new__(NodeRunner)
+    nr._stop = threading.Event()
+    nr.heartbeat_s = interval_s
+    nr.tracer = None                     # tracing off (the default)
+    nr.master_unreachable = False
+    nr._master_failures = 0
+    nr._last_master_contact = time.monotonic()
+    nr._lost_master_backoff_max_s = 15.0
+    nr._mreg = MetricsRegistry("t")
+    return nr
+
+
 class TestHeartbeatErrorBackoff:
-    def test_error_path_waits_one_interval_and_honors_stop(self):
-        """Satellite: the old error path did time.sleep(interval) AND
-        _stop.wait(interval) — doubling the backoff and ignoring
-        shutdown for a full extra interval."""
-        from tpumr.mapred.tasktracker import NodeRunner
-        nr = object.__new__(NodeRunner)      # no daemon bring-up
-        nr._stop = threading.Event()
-        nr.heartbeat_s = 0.2
-        nr.tracer = None                     # tracing off (the default)
+    def test_lost_master_backs_off_and_honors_stop(self):
+        """Master-unreachable beats enter the lost-master state: capped
+        jittered exponential backoff (never below one interval), the
+        master_unreachable flag raised, retries forever, and _stop
+        still interrupts the wait promptly."""
+        nr = _bare_noderunner(interval_s=0.1)
         beats = []
         nr._heartbeat_once = lambda: (beats.append(time.time()),
                                       (_ for _ in ()).throw(
                                           ConnectionError("down")))
         t = threading.Thread(target=nr._heartbeat_loop, daemon=True)
-        start = time.time()
         t.start()
-        time.sleep(0.5)   # ~2-3 error iterations at ONE interval each
+        time.sleep(1.0)
+        assert nr.master_unreachable, \
+            "transport failure must raise the lost-master flag"
         nr._stop.set()
         t.join(timeout=1.0)
         assert not t.is_alive(), "stop must interrupt the backoff wait"
-        assert len(beats) >= 2, "must keep retrying through errors"
+        assert len(beats) >= 2, "must keep retrying through the outage"
         gaps = [b - a for a, b in zip(beats, beats[1:])]
-        assert all(g < 0.4 for g in gaps), \
-            f"error path must back off ONE interval, not two (gaps={gaps})"
+        # jittered exponential: every gap within [interval, cap], and
+        # the SECOND retry gap is never shorter than half the first's
+        # ceiling — it backs off rather than hammering a restarting
+        # master at a fixed cadence
+        assert all(0.09 <= g <= 15.0 for g in gaps), gaps
+        assert nr._master_failures == len(beats)
+
+    def test_application_rpc_error_keeps_cadence_and_charges_nothing(self):
+        """An RPC-level error (the master answered, unhappily) is NOT a
+        lost master: normal interval, no unreachable flag, no backoff."""
+        from tpumr.ipc.rpc import RpcError
+        nr = _bare_noderunner(interval_s=0.1)
+        beats = []
+        nr._heartbeat_once = lambda: (beats.append(time.time()),
+                                      (_ for _ in ()).throw(
+                                          RpcError("handler raised")))
+        t = threading.Thread(target=nr._heartbeat_loop, daemon=True)
+        t.start()
+        time.sleep(0.55)
+        nr._stop.set()
+        t.join(timeout=1.0)
+        assert not nr.master_unreachable
+        assert nr._master_failures == 0
+        assert len(beats) >= 3, "application errors keep the cadence"
+        gaps = [b - a for a, b in zip(beats, beats[1:])]
+        assert all(g < 0.25 for g in gaps), \
+            f"no lost-master backoff for application errors (gaps={gaps})"
 
 
 # ------------------------------------------------------------ end to end

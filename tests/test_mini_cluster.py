@@ -162,7 +162,12 @@ def test_heartbeat_dedupe_replays_actions():
         master.stop()
 
 
-def test_unknown_tracker_told_to_reinit():
+def test_unknown_tracker_rejoin_contract():
+    """Master-restart survival: an unknown tracker's FULL non-initial
+    beat is ADOPTED (registered, in-flight work kept — never the old
+    blanket reinit), while an unknown DELTA beat — which the master has
+    no baseline to apply — is asked to resend the full status without
+    killing anything."""
     from tpumr.mapred.jobtracker import JobMaster
     master = JobMaster(JobConf())
     try:
@@ -171,8 +176,17 @@ def test_unknown_tracker_told_to_reinit():
                   "max_reduce_slots": 0, "count_cpu_map_tasks": 0,
                   "count_tpu_map_tasks": 0, "count_reduce_tasks": 0,
                   "available_tpu_devices": [], "task_statuses": []}
-        resp = master.heartbeat(status, False, True, 5)
-        assert resp["actions"] == [{"type": "reinit"}]
+        delta = {"tracker_name": "ghost2", "delta": True,
+                 "task_statuses": []}
+        resp = master.heartbeat(dict(delta), False, True, 5)
+        assert resp["actions"] == [{"type": "resend_full"}]
+        assert "ghost2" not in master.trackers
+        resp = master.heartbeat(dict(status), False, True, 5)
+        assert not [a for a in resp["actions"]
+                    if a["type"] in ("reinit", "resend_full")]
+        assert "ghost" in master.trackers
+        assert master.metrics.snapshot()["jobtracker"][
+            "trackers_adopted"] == 1
     finally:
         master.stop()
 

@@ -149,6 +149,138 @@ class TestRpcServerConcurrency:
             srv.stop()
 
 
+# ------------------------------------------------ reactor hardening
+
+
+class TestReactorEdgeCases:
+    """Satellite: the selector-reactor transport under hostile/unlucky
+    connections — torn frames, resets between request and response,
+    oversized frames, and handler-pool saturation. The loop must shrug
+    each one off: later connections keep being served, and overload
+    answers bounded backpressure instead of queueing without bound."""
+
+    def _reactor_server(self, handler=None, fast=()):
+        srv = RpcServer(handler or _MixedService(), reactor=True,
+                        fast_methods=set(fast)).start()
+        return srv
+
+    def _alive(self, srv):
+        cli = RpcClient(*srv.address)
+        try:
+            assert cli.call("echo", "ping") == "ping"
+        finally:
+            cli.close()
+
+    def test_mid_frame_disconnect_leaves_server_serving(self):
+        import socket
+        import struct
+        srv = self._reactor_server()
+        try:
+            host, port = srv.address
+            # announce a 1000-byte frame, send 10 bytes, hang up
+            s = socket.create_connection((host, port), timeout=5)
+            s.sendall(struct.pack(">I", 1000) + b"x" * 10)
+            s.close()
+            time.sleep(0.1)
+            self._alive(srv)
+        finally:
+            srv.stop()
+
+    def test_reset_between_request_and_response(self):
+        import socket
+        from tpumr.io.writable import serialize
+        import struct
+        srv = self._reactor_server()
+        try:
+            host, port = srv.address
+            # a well-formed slow request whose connection dies before
+            # the response can be written back
+            req = serialize({"id": 1, "method": "slow", "params": [0.2]})
+            s = socket.create_connection((host, port), timeout=5)
+            s.sendall(struct.pack(">I", len(req)) + req)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))   # RST on close
+            s.close()
+            time.sleep(0.4)   # the pooled handler writes into the void
+            self._alive(srv)
+        finally:
+            srv.stop()
+
+    def test_oversized_frame_rejected_without_allocation(self):
+        import socket
+        import struct
+        srv = self._reactor_server()
+        try:
+            host, port = srv.address
+            s = socket.create_connection((host, port), timeout=5)
+            # length prefix far beyond MAX_FRAME: the reactor must drop
+            # the connection on the prefix alone, never buffer toward it
+            s.sendall(struct.pack(">I", 0xFFFFFFFE)[:4])
+            s.sendall(b"y" * 64)
+            time.sleep(0.1)
+            # connection observably dead...
+            s.settimeout(2)
+            assert s.recv(1) == b""
+            s.close()
+            # ...server observably alive
+            self._alive(srv)
+        finally:
+            srv.stop()
+
+    def test_handler_pool_saturation_returns_backpressure(self):
+        from tpumr.ipc.rpc import RpcError, _Reactor
+        reg = MetricsRegistry("rpc")
+        srv = self._reactor_server()
+        srv.metrics = reg
+        old_backlog = _Reactor.POOL_BACKLOG
+        _Reactor.POOL_BACKLOG = 4
+        srv._reactor.POOL_BACKLOG = 4
+        try:
+            n = 12
+            barrier = threading.Barrier(n)
+            results = {"ok": 0, "busy": 0, "other": []}
+            rlock = threading.Lock()
+
+            def worker():
+                cli = RpcClient(*srv.address)
+                try:
+                    barrier.wait(timeout=5)
+                    cli.call("slow", 0.3)
+                    with rlock:
+                        results["ok"] += 1
+                except RpcError as e:
+                    with rlock:
+                        if "saturated" in str(e):
+                            results["busy"] += 1
+                        else:
+                            results["other"].append(e)
+                except Exception as e:  # noqa: BLE001
+                    with rlock:
+                        results["other"].append(e)
+                finally:
+                    cli.close()
+
+            threads = [threading.Thread(target=worker) for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=20)
+            assert not [t for t in threads if t.is_alive()], \
+                "saturation must never deadlock callers"
+            assert not results["other"], results["other"]
+            # the pool (8 threads, backlog 4) absorbed some, pushed the
+            # rest back IMMEDIATELY as busy errors — and nothing hung
+            assert results["busy"] >= 1
+            assert results["ok"] >= 4
+            assert results["ok"] + results["busy"] == n
+            assert reg.snapshot()["rpc_pool_saturated"] >= 1
+            # after the storm the server serves normally again
+            self._alive(srv)
+        finally:
+            _Reactor.POOL_BACKLOG = old_backlog
+            srv.stop()
+
+
 # ------------------------------------------------------------ fleet e2e
 
 
@@ -259,20 +391,26 @@ class TestSimFleetEndToEnd:
             driver.close()
             master.stop()
 
-    def test_sim_tracker_honors_reinit_and_kill(self):
+    def test_sim_tracker_rejoins_after_eviction_without_reinit(self):
         master = _master()
         host, port = master.address
         t = SimTracker("solo", host, port, cpu_slots=1, reduce_slots=1)
         try:
             t.heartbeat_once()   # initial contact registers
             assert t.heartbeats == 1
-            # master restart amnesia: evict it, next beat gets reinit
+            # master amnesia (eviction/restart): the next DELTA beat is
+            # asked for a full re-send — no reinit, nothing dropped —
+            # and the full beat after that is ADOPTED
             master._evict_tracker("solo")
             t.heartbeat_once()
-            assert t._initial_contact is True and t._response_id == 0
-            t.heartbeat_once()   # re-registers
+            assert t._initial_contact is False, \
+                "resend_full must not reset the tracker like reinit"
+            assert "solo" not in master.trackers
+            t.heartbeat_once()   # full status → adopted
             with master.lock:
                 assert "solo" in master.trackers
+            assert master.metrics.snapshot()["jobtracker"][
+                "trackers_adopted"] == 1
         finally:
             t.close()
             master.stop()
@@ -404,12 +542,15 @@ class TestHeartbeatDelta:
         finally:
             master.stop()
 
-    def test_unknown_delta_gets_reinit(self):
+    def test_unknown_delta_gets_resend_full(self):
         master = _master()
         try:
             resp = master.heartbeat(
                 {"tracker_name": "ghost", "delta": True}, False, True, 7)
-            assert resp["actions"] == [{"type": "reinit"}]
+            # a baseline-less delta is asked for the full status — the
+            # master can't use the delta, but unlike the old reinit
+            # nothing on the tracker is killed
+            assert resp["actions"] == [{"type": "resend_full"}]
             assert "ghost" not in master.trackers
         finally:
             master.stop()

@@ -300,8 +300,14 @@ def cmd_job(conf, argv: list[str]) -> int:
                       f"\treduces={st.get('reduce_progress'):.2f}")
             return 0
         if cmd == "-status":
-            print(json.dumps(client.call("get_job_status", rest[0]),
-                             indent=2, default=str))
+            st = client.call("get_job_status", rest[0])
+            if st.get("job_id") and st["job_id"] != rest[0]:
+                # the master restarted and recovered this job under a
+                # new id (job_recovered alias) — say so, then report
+                # the live job (scripts parsing stdout still work)
+                print(f"job {rest[0]} was recovered as {st['job_id']} "
+                      f"after a master restart", file=sys.stderr)
+            print(json.dumps(st, indent=2, default=str))
             return 0
         if cmd == "-counters":
             print(json.dumps(client.call("get_counters", rest[0]), indent=2,

@@ -259,8 +259,18 @@ class _Reactor:
     #: was 10 Handler threads; dfs.namenode.handler.count etc.)
     POOL_SIZE = 8
 
+    #: max pooled requests in flight (running + queued). Past this the
+    #: reactor answers "server busy" IMMEDIATELY instead of queueing —
+    #: bounded backpressure: an unbounded executor queue under overload
+    #: turns into unbounded memory plus minutes-stale responses, and
+    #: the caller's own timeout/retry policy is the right place to
+    #: absorb the pushback. Fast-path methods never queue here.
+    POOL_BACKLOG = 64
+
     def __init__(self, rpc: "RpcServer", host: str, port: int) -> None:
         self.rpc = rpc
+        self._pool_inflight = 0
+        self._pool_lock = threading.Lock()
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listen.bind((host, port))
@@ -418,7 +428,30 @@ class _Reactor:
                 # one pooled request per connection is in flight — no
                 # response interleaving to defend against
                 assert self._pool is not None
-                self._pool.submit(self._serve_pooled, conn, req, length)
+                with self._pool_lock:
+                    saturated = self._pool_inflight >= self.POOL_BACKLOG
+                    if not saturated:
+                        self._pool_inflight += 1
+                if saturated:
+                    # bounded backpressure: answer busy NOW (an error
+                    # the caller sees and backs off on) instead of
+                    # queueing without bound. Deliberately NOT cached
+                    # in the replay cache — a retried id re-enters the
+                    # pipeline normally once the pool drains.
+                    reg = self.rpc.metrics
+                    if reg is not None:
+                        reg.incr("rpc_pool_saturated")
+                    resp = {"id": req.get("id")
+                            if isinstance(req, dict) else None,
+                            "error": "RpcError: handler pool saturated "
+                                     "(server busy, retry later)"}
+                    try:
+                        _send_frame(conn.sock, resp)
+                    except OSError:
+                        self._close(conn)
+                else:
+                    self._pool.submit(self._serve_pooled, conn, req,
+                                      length)
 
     def _serve_pooled(self, conn: "_RConn", req: Any, length: int) -> None:
         try:
@@ -428,6 +461,9 @@ class _Reactor:
         except Exception as e:  # noqa: BLE001 — keep the pool alive
             resp = {"id": req.get("id") if isinstance(req, dict) else None,
                     "error": f"{type(e).__name__}: {e}"}
+        finally:
+            with self._pool_lock:
+                self._pool_inflight -= 1
         try:
             _send_frame(conn.sock, resp)
         except OSError:
@@ -875,14 +911,33 @@ class RpcServer:
 class RpcClient:
     """Connection-cached, thread-safe client (one socket; calls serialized —
     fan-out callers hold one client per target like the reference's
-    per-connection multiplexing without the async responder)."""
+    per-connection multiplexing without the async responder).
+
+    Control-plane partition tolerance: transport failures (connect
+    refused, reset mid-call, timeout) retry up to ``retries`` times with
+    jittered exponential backoff (``tpumr.rpc.client.retries`` /
+    ``tpumr.rpc.client.backoff.ms`` where daemons wire them through).
+    The first retry is immediate — a dropped idle connection just needs
+    a reconnect; sleeps start from the second. Retries are safe for
+    non-idempotent methods because every resend carries the same
+    ``(cid, id)`` and the server's response cache replays instead of
+    re-executing. Application-level errors (``RpcError``) are never
+    retried — the server answered."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  secret: "bytes | None" = None,
-                 scope: "str | None" = None) -> None:
+                 scope: "str | None" = None,
+                 retries: int = 1, backoff_ms: float = 200.0,
+                 backoff_max_ms: float = 10_000.0) -> None:
         self.host, self.port = host, port
         self.timeout = timeout
         self.secret = secret
+        self.retries = max(0, int(retries))
+        self.backoff_s = max(0.0, float(backoff_ms)) / 1000.0
+        self.backoff_max_s = max(0.0, float(backoff_max_ms)) / 1000.0
+        #: conf consulted by the rpc.drop / rpc.delay / rpc.reset chaos
+        #: seams (tpumr/utils/fi.py); None (default) = zero-cost off
+        self.fi_conf: "Any | None" = None
         #: token scope: set when ``secret`` is a per-job token rather
         #: than the cluster secret (task children) — the server resolves
         #: the verification key by scope and restricts callable methods
@@ -1007,26 +1062,63 @@ class RpcClient:
             raise RpcError(msg)
         return resp.get("result")
 
+    def _fi_pre_send(self) -> None:
+        """Chaos seams on the send side: ``rpc.delay`` sleeps the call
+        (``tpumr.fi.rpc.delay.ms``, default 100), ``rpc.drop`` loses the
+        request before it reaches the wire (the retry policy's quarry)."""
+        from tpumr.utils import fi
+        if fi.fires("rpc.delay", self.fi_conf):
+            time.sleep(float(self.fi_conf.get(
+                "tpumr.fi.rpc.delay.ms", 100) or 100) / 1000.0)
+        if fi.fires("rpc.drop", self.fi_conf):
+            raise ConnectionError("injected fault at rpc.drop")
+
+    def _fi_post_send(self) -> None:
+        """``rpc.reset``: the connection dies AFTER the request went out
+        — delivery unknown, the hardest retry case (the server may have
+        executed; the resent id must hit the replay cache)."""
+        from tpumr.utils import fi
+        if fi.fires("rpc.reset", self.fi_conf):
+            self.close_locked()
+            raise ConnectionError("injected fault at rpc.reset")
+
     def call(self, method: str, *params: Any) -> Any:
+        import random as _random
         with self._lock:
             req = self._build_req(method, params)
-            try:
-                sock = self._connect()
-                self._stamp(req)
-                _send_frame(sock, req)
-                resp = self._recv_resp()
-            except (ConnectionError, OSError):
-                # one reconnect attempt (server restart / idle drop);
-                # re-sign against the fresh connection's nonce. The
-                # retry MUST carry the cid: the new connection has not
-                # adopted it yet, and the server-side (cid, id) dedupe
-                # is what keeps a resent submit_job from running twice.
-                self.close_locked()
-                req["cid"] = self._cid
-                sock = self._connect()
-                self._stamp(req)
-                _send_frame(sock, req)
-                resp = self._recv_resp()
+            attempt = 0
+            while True:
+                try:
+                    if self.fi_conf is not None:
+                        self._fi_pre_send()
+                    sock = self._connect()
+                    # re-sign per attempt: a reconnect changed the nonce
+                    self._stamp(req)
+                    _send_frame(sock, req)
+                    if self.fi_conf is not None:
+                        self._fi_post_send()
+                    resp = self._recv_resp()
+                    break
+                except (ConnectionError, OSError):
+                    # server restart / idle drop / partition. The retry
+                    # MUST carry the cid: the new connection has not
+                    # adopted it yet, and the server-side (cid, id)
+                    # dedupe is what keeps a resent submit_job from
+                    # running twice.
+                    self.close_locked()
+                    req["cid"] = self._cid
+                    attempt += 1
+                    if attempt > self.retries:
+                        raise
+                    if attempt > 1:
+                        # first retry immediate (a dropped idle
+                        # connection just needs a reconnect); then
+                        # jittered exponential backoff, capped — a
+                        # restarting master must not be stampeded
+                        time.sleep(min(self.backoff_max_s,
+                                       self.backoff_s
+                                       * (2 ** (attempt - 2)))
+                                   * _random.uniform(0.5, 1.0))
             self._cid_sent = True
         return self._check_resp(resp)
 

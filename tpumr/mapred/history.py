@@ -99,6 +99,121 @@ class JobHistory:
                 out.append(submitted)
         return out
 
+    def recovered_attempt_state(self, job_id: str) -> dict:
+        """Replay one interrupted job's attempt-level outcome from its
+        event log (≈ RecoveryManager.JobRecoveryListener walking the
+        history file): the LAST successful attempt per task, with the
+        detail a restarted master needs to adopt the work instead of
+        re-running it — attempt id, serving tracker + shuffle address
+        (map outputs), backend, runtime, and counters. ``MAP_OUTPUT_LOST``
+        events (fetch-failure withdrawals, lost trackers) erase the
+        outputs the old master already declared gone. Returns
+        ``{"maps": {partition: record}, "reduces": {partition: record}}``.
+        """
+        from tpumr.mapred.ids import TaskAttemptID
+        maps: dict[int, dict] = {}
+        reduces: dict[int, dict] = {}
+        if not self.dir:
+            return {"maps": maps, "reduces": reduces}
+        path = os.path.join(self.dir, f"{job_id}.jsonl")
+        if not os.path.exists(path):
+            return {"maps": maps, "reduces": reduces}
+        for ev in self.read(path):
+            kind = ev.get("event")
+            aid = str(ev.get("attempt_id", "") or "")
+            if not aid:
+                continue
+            try:
+                attempt = TaskAttemptID.parse(aid)
+            except (ValueError, IndexError):
+                continue
+            idx = attempt.task.id
+            if kind == "TASK_FINISHED":
+                rec = {
+                    "attempt_id": aid,
+                    "attempt": attempt.attempt,
+                    "is_map": bool(attempt.task.is_map),
+                    "runtime": float(ev.get("runtime", 0.0) or 0.0),
+                    "tracker": ev.get("tracker", ""),
+                    "shuffle_addr": ev.get("shuffle_addr", "") or "",
+                    "run_on_tpu": bool(ev.get("run_on_tpu", False)),
+                    "tpu_device_id": int(ev.get("tpu_device_id", -1)),
+                    "counters": ev.get("counters") or {},
+                    "ts": float(ev.get("ts", 0.0) or 0.0),
+                }
+                (maps if attempt.task.is_map else reduces)[idx] = rec
+            elif kind == "MAP_OUTPUT_LOST":
+                # the old master withdrew this output (too many fetch
+                # failures, or its tracker was lost) — whatever replaced
+                # it appears as a LATER TASK_FINISHED, or not at all
+                cur = maps.get(idx)
+                if cur is not None and cur["attempt_id"] == aid:
+                    del maps[idx]
+        return {"maps": maps, "reduces": reduces}
+
+    def retired_job_status(self, job_id: str) -> "dict | None":
+        """Terminal status of a job known only to HISTORY — a restarted
+        master serving polls for jobs that finished (or were already
+        recovered) before the crash, ≈ the reference JobTracker's
+        retired-jobs cache backed by completed-job history. Returns a
+        client-shaped status dict; for a job an EARLIER master already
+        resubmitted, ``recovered_as`` names the successor id to chase.
+        None when this job's history holds no outcome."""
+        if not self.dir:
+            return None
+        path = os.path.join(self.dir, f"{job_id}.jsonl")
+        if not os.path.exists(path):
+            return None
+        submitted: "dict | None" = None
+        outcome: "dict | None" = None
+        for ev in self.read(path):
+            kind = ev.get("event")
+            if kind == "JOB_SUBMITTED":
+                submitted = ev
+            elif kind in ("JOB_FINISHED", "JOB_RECOVERED",
+                          "JOB_RECOVERY_FAILED"):
+                outcome = ev
+        if outcome is None:
+            return None
+        if outcome["event"] == "JOB_RECOVERED":
+            return {"job_id": job_id,
+                    "recovered_as": outcome.get("new_job_id"), }
+        #: the submit-time conf, for the caller's job-view ACL check
+        #: (popped before the status goes on the wire)
+        acl_conf = (submitted or {}).get("conf") or {}
+        n_maps = int((submitted or {}).get("num_maps", 0) or 0)
+        n_reduces = int((submitted or {}).get("num_reduces", 0) or 0)
+        if outcome["event"] == "JOB_FINISHED":
+            state = str(outcome.get("state", "SUCCEEDED"))
+            error = str(outcome.get("error", "") or "")
+        else:   # JOB_RECOVERY_FAILED
+            state = "FAILED"
+            error = (f"recovery failed after a master restart: "
+                     f"{outcome.get('error', '')}")
+        done = state == "SUCCEEDED"
+        return {
+            "job_id": job_id, "state": state, "priority": "NORMAL",
+            "map_progress": 1.0 if done else 0.0,
+            "reduce_progress": 1.0 if done else 0.0,
+            "finished_maps": n_maps if done else 0,
+            "finished_tpu_maps": int(
+                outcome.get("finished_tpu_maps", 0) or 0),
+            "finished_cpu_maps": int(
+                outcome.get("finished_cpu_maps", 0) or 0),
+            "num_maps": n_maps, "num_reduces": n_reduces,
+            "cpu_map_mean_time": float(
+                outcome.get("cpu_map_mean_time", 0.0) or 0.0),
+            "tpu_map_mean_time": float(
+                outcome.get("tpu_map_mean_time", 0.0) or 0.0),
+            "acceleration_factor": float(
+                outcome.get("acceleration_factor", 0.0) or 0.0),
+            "placement_seq": "", "tpu_disabled": False,
+            "tpu_demoted_tips": 0,
+            "error": error,
+            "retired": True,   # served from history, not a live JIP
+            "_acl_conf": acl_conf,
+        }
+
     def job_finished(self, jip: Any) -> None:
         self._write(str(jip.job_id), {
             "event": "JOB_FINISHED",

@@ -21,14 +21,24 @@ from tpumr.utils.reflection import new_instance
 
 
 class RunningJob:
-    """≈ org.apache.hadoop.mapred.RunningJob."""
+    """≈ org.apache.hadoop.mapred.RunningJob.
+
+    Master-restart aware: a restarted master recovers interrupted jobs
+    under NEW ids and serves the old id through its ``job_recovered``
+    alias — every status poll re-reads the authoritative id from the
+    response and rebinds, so a polling client follows the resubmitted
+    job instead of reporting it vanished."""
 
     def __init__(self, client: RpcClient, job_id: str) -> None:
         self._client = client
         self.job_id = job_id
 
     def status(self) -> dict:
-        return self._client.call("get_job_status", self.job_id)
+        st = self._client.call("get_job_status", self.job_id)
+        new_id = st.get("job_id")
+        if new_id and new_id != self.job_id:
+            self.job_id = new_id
+        return st
 
     def is_complete(self) -> bool:
         return self.status()["state"] in ("SUCCEEDED", "FAILED", "KILLED")
@@ -70,8 +80,15 @@ class JobClient:
             host, port = str(tracker).rsplit(":", 1)
             from tpumr.security import client_credentials
             secret, scope = client_credentials(conf, "jobtracker")
-            self._client = RpcClient(host, int(port), secret=secret,
-                                     scope=scope)
+            # partition tolerance: a client poll rides out a master
+            # restart (retry + server-side replay dedupe), so
+            # wait_for_completion survives the same restarts the
+            # trackers do
+            self._client = RpcClient(
+                host, int(port), secret=secret, scope=scope,
+                retries=conf.get_int("tpumr.rpc.client.retries", 3),
+                backoff_ms=conf.get_int("tpumr.rpc.client.backoff.ms",
+                                        200))
 
     @property
     def is_local(self) -> bool:

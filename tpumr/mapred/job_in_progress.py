@@ -28,7 +28,8 @@ from typing import Any
 
 from tpumr.core.counters import Counters
 from tpumr.mapred.ids import JobID, TaskAttemptID, TaskID
-from tpumr.mapred.task import Task, TaskReport, TaskState, TaskStatus
+from tpumr.mapred.task import (Task, TaskPhase, TaskReport, TaskState,
+                               TaskStatus)
 from tpumr.metrics.locks import RANK_JOB, InstrumentedRLock
 
 
@@ -66,6 +67,14 @@ class CompletionEventFeed:
         drained fine, or it grows with job width forever)."""
         total = len(self._events)
         frm = max(0, int(from_index))
+        if frm > total:
+            # a cursor minted against a PREVIOUS incarnation of this
+            # job's feed (master restart → the resubmitted job re-feeds
+            # recovered events from 0): an append-only feed can never be
+            # shorter than a cursor it issued, so serve the WHOLE feed —
+            # client folds are idempotent, and a stale cursor must never
+            # silently skip recovered or fresh events
+            frm = 0
         events = self._events[frm:frm + max(0, int(max_events))]
         return events, max(0, total - frm - len(events))
 
@@ -299,6 +308,21 @@ class JobInProgress:
         #: jobs only ("" / None keeps every trace check a cheap miss)
         self.trace_id: str = str(self.conf.get("tpumr.trace.id", "") or "")
         self.trace_root: Any = None
+        # --- master restart survival (attempt-level recovery) ---
+        #: the interrupted job this one was recovered from (None for a
+        #: normal submission): attempt ids carrying the OLD job id are
+        #: accepted as this job's own — recovered completion events,
+        #: adopted in-flight attempts, and their fetch-failure reports
+        #: all name old-id attempts
+        self.recovered_from: "str | None" = None
+        #: monotonic deadline before which the scheduler must NOT hand
+        #: out this job's tasks (obtain_* return None): the recovery
+        #: grace window. A restarted master sees pending TIPs whose
+        #: attempts are still RUNNING on trackers that have not
+        #: re-joined yet — assigning them would duplicate in-flight
+        #: work (≈ the reference RecoveryManager waiting for trackers
+        #: to report back before scheduling resumes)
+        self.schedule_hold_until = 0.0
 
     # ------------------------------------------------------------ queries
 
@@ -411,6 +435,9 @@ class JobInProgress:
         with self.lock:
             if self.state != JobState.RUNNING:
                 return None
+            if self.schedule_hold_until \
+                    and time.monotonic() < self.schedule_hold_until:
+                return None  # recovery grace: re-joining trackers first
             if run_on_tpu and self.tpu_disabled:
                 return None  # job-level accelerator quarantine
             # demoted TIPs never land on TPU again; the CPU pass sees all
@@ -584,6 +611,9 @@ class JobInProgress:
         with self.lock:
             if self.state != JobState.RUNNING:
                 return None
+            if self.schedule_hold_until \
+                    and time.monotonic() < self.schedule_hold_until:
+                return None  # recovery grace: re-joining trackers first
             if not self._pending_reduces:
                 return self._obtain_speculative_reduce()
             # slowstart gate ≈ JobInProgress.scheduleReduces
@@ -704,6 +734,15 @@ class JobInProgress:
         tip.report.successful_attempt = str(status.attempt_id)
         if status.counters:
             self.counters.merge(Counters.from_dict(status.counters))
+        # a completion may fold for a tip the master believed PENDING: a
+        # restarted master recovers in-flight tasks as pending, and the
+        # re-joining tracker's first beat can carry the attempt's
+        # (undelivered) terminal status directly — the tip must leave
+        # the pending set or the scheduler re-assigns finished work
+        if tip.is_map:
+            self._pending_maps.discard(tip.partition)
+        else:
+            self._pending_reduces.discard(tip.partition)
         if tip.is_map:
             self.finished_maps += 1
             runtime = status.runtime
@@ -891,8 +930,13 @@ class JobInProgress:
             # the reporter must be a real, running reduce attempt of
             # THIS job (≈ the reference trusting only its own umbilical
             # children): forged reducer names must not be able to
-            # manufacture "distinct reducers" and kill healthy maps
-            if reducer.task.is_map or reducer.task.job != self.job_id:
+            # manufacture "distinct reducers" and kill healthy maps.
+            # Attempts adopted from the job this one was recovered from
+            # (master restart) carry the OLD job id and count as ours.
+            if reducer.task.is_map or (
+                    reducer.task.job != self.job_id
+                    and str(reducer.task.job) != (self.recovered_from
+                                                  or "")):
                 return None
             rtip = self._tip_of(reducer.task)
             rst = rtip.attempts.get(reduce_attempt) \
@@ -947,11 +991,15 @@ class JobInProgress:
         with self.lock:
             return len(self._fetch_failures)
 
-    def requeue_lost_attempts(self, attempt_ids: list[str]) -> None:
+    def requeue_lost_attempts(self, attempt_ids: list[str]) -> "list[str]":
         """Tracker lost (≈ JobTracker.lostTaskTracker): running attempts on
         it are killed and their tasks re-queued; completed MAPS are also
         re-queued because their outputs lived on the lost tracker — unless
-        the job has no reduces (reference semantics)."""
+        the job has no reduces (reference semantics). Returns the attempt
+        ids whose published map outputs were withdrawn, so the caller can
+        journal MAP_OUTPUT_LOST events (restart recovery must not adopt
+        outputs the master already declared gone)."""
+        withdrawn: "list[str]" = []
         with self.lock:
             for aid in attempt_ids:
                 attempt = TaskAttemptID.parse(aid)
@@ -986,9 +1034,144 @@ class JobInProgress:
                     self._pending_maps.add(tip.partition)
                     self._obsolete_map_output(tip, aid)
                     self._fetch_failures.pop(aid, None)
+                    withdrawn.append(aid)
                 # lost = terminal for this attempt whatever branch ran:
                 # never leak a -fail-task mark for the life of the job
                 self._fail_requested.discard(aid)
+        return withdrawn
+
+    # ------------------------------------------------------------ recovery
+
+    def recover_attempts(self, state: dict, old_job_id: str) -> int:
+        """Replay an interrupted job's completed attempts (from
+        ``JobHistory.recovered_attempt_state``) into this resubmitted
+        job: completed maps are marked SUCCEEDED with their ORIGINAL
+        attempt ids and their completion events re-fed into the
+        append-only feed (reducers fetch the surviving outputs instead
+        of waiting for re-runs); completed reduces are simply counted
+        done (their output is already committed). A recovered output
+        that turns out to be gone re-executes through the normal
+        fetch-failure protocol. Returns the number of attempts adopted
+        from history."""
+        n = 0
+        with self.lock:
+            self.recovered_from = old_job_id
+            for idx, rec in sorted((state.get("maps") or {}).items()):
+                idx = int(idx)
+                if idx >= len(self.maps):
+                    continue
+                if self.num_reduces > 0 and not rec.get("shuffle_addr"):
+                    # no recorded serving address (pre-upgrade history):
+                    # reducers could never fetch it — re-run instead
+                    continue
+                self._recover_one(self.maps[idx], rec)
+                if self.num_reduces > 0:
+                    self.completion_events.append({
+                        "map_index": idx,
+                        "attempt_id": rec["attempt_id"],
+                        "shuffle_addr": rec["shuffle_addr"],
+                        "status": "SUCCEEDED",
+                    })
+                n += 1
+            for idx, rec in sorted((state.get("reduces") or {}).items()):
+                idx = int(idx)
+                if idx >= len(self.reduces):
+                    continue
+                self._recover_one(self.reduces[idx], rec)
+                n += 1
+            if (self.finished_maps == len(self.maps)
+                    and self.finished_reduces == len(self.reduces)):
+                # the crash fell between the last completion and
+                # finalization — the caller finalizes, nothing re-runs
+                self.state = JobState.SUCCEEDED
+                self.finish_time = time.time()
+        return n
+
+    def _recover_one(self, tip: TaskInProgress, rec: dict) -> None:
+        """Adopt one history-recovered successful attempt into its TIP.
+        Caller holds ``self.lock``."""
+        aid = rec["attempt_id"]
+        finish = rec.get("ts") or time.time()
+        runtime = float(rec.get("runtime", 0.0) or 0.0)
+        status = TaskStatus(
+            attempt_id=TaskAttemptID.parse(aid), is_map=tip.is_map,
+            state=TaskState.SUCCEEDED, progress=1.0,
+            phase=TaskPhase.MAP if tip.is_map else TaskPhase.REDUCE,
+            start_time=finish - runtime, finish_time=finish,
+            run_on_tpu=bool(rec.get("run_on_tpu", False)),
+            tpu_device_id=int(rec.get("tpu_device_id", -1)))
+        tip.attempts[aid] = status
+        tip.next_attempt = max(tip.next_attempt,
+                               int(rec.get("attempt", 0)) + 1)
+        tip.state = "succeeded"
+        tip.successful_attempt = aid
+        tip.report.state = TaskState.SUCCEEDED
+        tip.report.progress = 1.0
+        tip.report.start_time = status.start_time
+        tip.report.finish_time = finish
+        tip.report.successful_attempt = aid
+        self.history_logged.add(aid)
+        if rec.get("counters"):
+            self.counters.merge(Counters.from_dict(rec["counters"]))
+        if tip.is_map:
+            self._pending_maps.discard(tip.partition)
+            self.finished_maps += 1
+            self._record_runtime(runtime, is_map=True,
+                                 on_tpu=status.run_on_tpu)
+            tip.report.run_on_tpu = status.run_on_tpu
+            tip.report.tpu_device_id = status.tpu_device_id
+            # feed the hybrid profile so the recovered job's scheduler
+            # means start where the interrupted job's left off
+            if status.run_on_tpu:
+                self.finished_tpu_maps += 1
+                self._tpu_time_sum += runtime
+            else:
+                self.finished_cpu_maps += 1
+                self._cpu_time_sum += runtime
+        else:
+            self._pending_reduces.discard(tip.partition)
+            self.finished_reduces += 1
+            self._reduce_time_sum += runtime
+            self._record_runtime(runtime, is_map=False)
+
+    def adopt_running_attempt(self, status: TaskStatus) -> bool:
+        """A re-joining tracker reports ``status`` RUNNING and the
+        master has no record of launching it (master restart, or the
+        tracker was expired and re-contacted). Bind it to its TIP —
+        in-flight work survives the restart — or return False: the
+        caller kills the attempt individually (its task already
+        succeeded through another attempt, was settled terminally, or
+        the job is over). A blanket ``reinit`` never happens here."""
+        with self.lock:
+            if self.state != JobState.RUNNING:
+                return False
+            tip = self._tip_of(status.attempt_id.task)
+            if tip is None:
+                return False
+            aid = str(status.attempt_id)
+            if tip.state == "succeeded":
+                # only the recorded winner survives; an unknown twin of
+                # a finished task is a zombie to kill
+                return tip.successful_attempt == aid
+            prev = tip.attempts.get(aid)
+            if prev is not None and prev.state in TaskState.TERMINAL:
+                return False   # the master already settled it
+            tip.attempts[aid] = status
+            tip.next_attempt = max(tip.next_attempt,
+                                   status.attempt_id.attempt + 1)
+            if tip.state == "pending":
+                tip.state = "running"
+                if tip.is_map:
+                    self._pending_maps.discard(tip.partition)
+                else:
+                    self._pending_reduces.discard(tip.partition)
+            tip.report.state = TaskState.RUNNING
+            tip.report.start_time = (tip.report.start_time
+                                     or status.start_time or time.time())
+            if tip.is_map:
+                tip.report.run_on_tpu = status.run_on_tpu
+                tip.report.tpu_device_id = status.tpu_device_id
+            return True
 
     def kill(self) -> bool:
         """Transition to KILLED; returns True only for the caller that

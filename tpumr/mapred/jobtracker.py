@@ -90,6 +90,8 @@ JOBTRACKER_POLICY = {
                                   "security.job.submission.protocol.acl"],
     "get_job_status": ["security.inter.tracker.protocol.acl",
                        "security.job.submission.protocol.acl"],
+    "get_recovered_jobs": ["security.inter.tracker.protocol.acl",
+                           "security.job.submission.protocol.acl"],
     "get_job_trace": ["security.inter.tracker.protocol.acl",
                       "security.job.submission.protocol.acl"],
     "refresh_queues": ["security.admin.operations.protocol.acl"],
@@ -194,6 +196,12 @@ class JobMaster:
         #: by _TrackerInfo.hb_lock, and the value is an immutable tuple)
         self._last_response: dict[str, tuple[int, list]] = {}
         self._commit_grants: dict[str, str] = {}   # task_id -> attempt_id
+        #: old job id -> resubmitted job id for jobs this master
+        #: recovered at startup (restart survival). Insert-only, written
+        #: before the RPC server starts — read lock-free everywhere a
+        #: job id off the wire may predate the restart (heartbeat folds,
+        #: kill scans, commit grants, client status polls).
+        self._recovered: dict[str, str] = {}
         self._next_job = 0
         #: running-job-set change counter + the cache it keys (see
         #: jobs_version/running_jobs) — the scheduler's per-pass reads
@@ -453,13 +461,17 @@ class JobMaster:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "JobMaster":
+        # recovery runs BEFORE the RPC server accepts its first frame:
+        # a re-joining tracker's heartbeat must find the recovered jobs
+        # (and the old→new id aliases) already in place, or its adopted
+        # in-flight attempts would be killed as unknown
+        if self.conf.get_boolean("mapred.jobtracker.restart.recover", False):
+            self._recover_jobs()
         self._server.start()
         self._expire_thread.start()
         self.metrics.start()
         if self._http_port >= 0:
             self._http = self._build_http(self._http_port).start()
-        if self.conf.get_boolean("mapred.jobtracker.restart.recover", False):
-            self._recover_jobs()
         return self
 
     def _read_hosts_lists(self) -> "tuple[set | None, set]":
@@ -500,9 +512,15 @@ class JobMaster:
     def _recover_jobs(self) -> None:
         """Restart recovery ≈ RecoveryManager (JobTracker.java:1203):
         resubmit jobs whose history shows a submission but no terminal
-        event. Task-level state is NOT resumed — maps re-execute, the
-        reference's job-level semantics (mid-task checkpointing doesn't
-        exist there either, SURVEY.md §5)."""
+        event, then replay their ATTEMPT-level outcome from the event
+        log (≈ the reference's RecoveryManager walking each job's
+        history file) — completed maps are adopted with their original
+        attempt ids and surviving shuffle outputs instead of re-running,
+        completed reduces are counted done, and the old→new job id
+        mapping is kept for every party still speaking the old id
+        (re-joining trackers, in-flight task children, polling clients).
+        A recovered output that turns out to be gone re-executes through
+        the PR-1 fetch-failure protocol."""
         for ev in self.history.incomplete_jobs():
             old_id = ev["job_id"]
             if ev.get("conf_dropped"):
@@ -521,8 +539,53 @@ class JobMaster:
                 self.history.task_event(old_id, "JOB_RECOVERY_FAILED",
                                         error=str(e))
                 continue
+            jip = self.jobs[new_id]
+            recovered = 0
+            try:
+                state = self.history.recovered_attempt_state(old_id)
+                recovered = jip.recover_attempts(state, old_id)
+            except Exception:  # noqa: BLE001 — attempt replay is an
+                pass           # optimization; a failed one just re-runs
+            # recovery grace (≈ the reference RecoveryManager waiting
+            # for trackers to report back): trackers still RUNNING this
+            # job's attempts re-join within a couple of heartbeats —
+            # scheduling its tasks before they do would duplicate
+            # in-flight work (and break the zero-re-run contract)
+            grace_s = self.conf.get_int(
+                "mapred.jobtracker.restart.recovery.grace.ms",
+                3000) / 1000.0
+            if grace_s > 0:
+                jip.schedule_hold_until = time.monotonic() + grace_s
+            self._recovered[old_id] = new_id
             self.history.job_recovered(old_id, new_id)
             self._mreg.incr("jobs_recovered")
+            if recovered:
+                self._mreg.incr("attempts_recovered", recovered)
+                self.history.task_event(
+                    new_id, "JOB_ATTEMPTS_RECOVERED", from_job=old_id,
+                    attempts=recovered)
+            if jip.state in JobState.TERMINAL:
+                # every task had already completed — the crash fell in
+                # the completion→finalization window; just finalize
+                self._bump_jobs_version()
+                self._finalize_job(jip)
+
+    def _resolve_job(self, job_id: str) -> "JobInProgress | None":
+        """Job lookup that follows the restart-recovery alias: ids off
+        the wire (attempt ids on heartbeats, client polls, commit asks)
+        may still name the pre-restart job. Lock-free — both dicts are
+        insert-only."""
+        jip = self.jobs.get(job_id)
+        if jip is None and self._recovered:
+            jip = self.jobs.get(self._recovered.get(job_id, ""))
+        return jip
+
+    def get_recovered_jobs(self) -> dict:
+        """old job id → resubmitted job id for every job this master
+        recovered at startup — the client-facing rebinding surface
+        (``tpumr job status/trace <old-id>`` and polling JobClients
+        follow the mapping instead of reporting the job vanished)."""
+        return dict(self._recovered)
 
     def stop(self) -> None:
         self._stop.set()
@@ -1185,7 +1248,17 @@ class JobMaster:
                 f"(owner {owner!r}; mapreduce.job.acl-{op}-job)")
 
     def get_job_status(self, job_id: str) -> dict:
-        jip = self._job(job_id)
+        try:
+            jip = self._job(job_id)
+        except KeyError:
+            # restart survival for FINISHED work too: a job that
+            # completed before the crash lives only in history — serve
+            # its terminal status from there (≈ the reference's retired
+            # jobs) instead of telling a polling client it vanished
+            st = self._retired_status(job_id)
+            if st is None:
+                raise
+            return st
         self._check_job_op(jip, "view")
         d = jip.status_dict()
         if d["state"] in JobState.TERMINAL and not jip.finalized.is_set():
@@ -1193,6 +1266,31 @@ class JobMaster:
             # read the output dir before it's promoted
             d["state"] = JobState.RUNNING
         return d
+
+    def _retired_status(self, job_id: str) -> "dict | None":
+        """History-backed terminal status, following at most a few
+        hops of ``JOB_RECOVERED`` chains from masters before the last
+        restart (each hop either lands on a live job or on that
+        incarnation's terminal history)."""
+        for _ in range(8):
+            st = self.history.retired_job_status(job_id)
+            if st is None:
+                return None
+            successor = st.pop("recovered_as", None)
+            if not successor:
+                # the same job-view ACL ladder the live path enforces,
+                # against the submit-time conf history retained — a job
+                # must not become world-readable by finishing + restart
+                from types import SimpleNamespace
+                self._check_job_op(
+                    SimpleNamespace(conf=st.pop("_acl_conf", {}) or {},
+                                    job_id=job_id), "view")
+                return st
+            jip = self._resolve_job(successor)
+            if jip is not None:
+                return self.get_job_status(str(jip.job_id))
+            job_id = successor
+        return None
 
     def get_counters(self, job_id: str) -> dict:
         jip = self._job(job_id)
@@ -1458,8 +1556,11 @@ class JobMaster:
     def _job(self, job_id: str) -> JobInProgress:
         # lock-free: the job table is insert-only and dict reads are
         # GIL-atomic — completion-event polls and status RPCs must not
-        # queue on the global lock just to look up their job
-        jip = self.jobs.get(job_id)
+        # queue on the global lock just to look up their job. Follows
+        # the restart-recovery alias: a pre-restart id serves the
+        # resubmitted job (status_dict carries the NEW id, so clients
+        # can rebind).
+        jip = self._resolve_job(job_id)
         if jip is None:
             raise KeyError(f"unknown job {job_id}")
         return jip
@@ -1480,7 +1581,7 @@ class JobMaster:
         except (ValueError, IndexError):
             pass   # unparseable id: no job to consult, legacy grant path
         else:
-            jip = self.jobs.get(job_id)   # lock-free: insert-only table
+            jip = self._resolve_job(job_id)   # lock-free lookup
         if jip is not None:
             with jip.lock:
                 tip = jip._tip_of_attempt(attempt_id)
@@ -1586,6 +1687,7 @@ class JobMaster:
         # tracker registry's shard stripe; the global lock is never
         # taken on the heartbeat fast path
         is_delta = bool(status.get("delta"))
+        adopted = False
         shard_lock, shard = self.trackers.shard_of(name)
         with shard_lock:
             info = shard.get(name)
@@ -1593,8 +1695,8 @@ class JobMaster:
             # whenever the beat names its host — excluded trackers get
             # "disallowed", never "reinit". A delta that omits the host
             # is screened against the stored status; an UNKNOWN delta
-            # can't be screened here and falls through to reinit (its
-            # full re-registration beat gets screened).
+            # can't be screened here and is asked for a full re-send
+            # (which gets screened).
             host = status.get("host") if "host" in status \
                 or not status.get("delta") \
                 else info.status.get("host", "") if info is not None \
@@ -1602,14 +1704,14 @@ class JobMaster:
             host_ok = host is None or self._host_allowed(host or "")
             if not host_ok:
                 registered = info is not None
-            elif info is None and (not initial_contact
-                                   or status.get("delta")):
-                # ≈ ReinitTrackerAction (JobTracker.java:3358): we don't
-                # know this tracker (expired or master restarted) — or
-                # it sent a delta we have no baseline to apply to.
-                # Reset it; it re-registers with a full status.
+            elif info is None and is_delta:
+                # no baseline to apply this delta to (master restarted,
+                # or the tracker was evicted): ask for a FULL status.
+                # Unlike the old blanket reinit, nothing is killed — the
+                # full beat that follows is adopted below, in-flight
+                # tasks and all.
                 return {"response_id": response_id, "actions":
-                        [{"type": "reinit"}]}
+                        [{"type": "resend_full"}]}
             elif info is not None:
                 if not initial_contact:
                     # heartbeat LAG: how far past its scheduled interval
@@ -1628,6 +1730,13 @@ class JobMaster:
                 # (heartbeat.py); full beats replace it wholesale
                 status = info.fold_status(status)
             else:
+                # full status from an unknown tracker: a true initial
+                # contact registers; a NON-initial full beat is a
+                # RE-JOIN (this master restarted, or the tracker was
+                # expired while partitioned away) — register it and
+                # ADOPT its in-flight work in the fold below instead of
+                # answering reinit (which would kill healthy tasks)
+                adopted = not initial_contact
                 status.pop("delta", None)
                 info = shard[name] = _TrackerInfo(status)
         if not host_ok:
@@ -1651,13 +1760,15 @@ class JobMaster:
             # would never be requeued (pre-decomposition the global
             # lock made evict-vs-beat atomic). GIL-atomic dict read;
             # `is` distinguishes a concurrent fresh re-registration.
+            # The tracker re-ships a full status and is adopted on its
+            # next beat — no reinit, nothing killed.
             if shard.get(name) is not info:
                 return {"response_id": response_id, "actions":
-                        [{"type": "reinit"}]}
+                        [{"type": "resend_full"}]}
             return self._heartbeat_fold_and_assign(
                 status, info, initial_contact, ask_for_new_task,
                 response_id, name, deferred_events, deferred_final,
-                hb_trace, t0, is_delta)
+                hb_trace, t0, is_delta, adopted)
 
     def _heartbeat_fold_and_assign(self, status: dict, info: _TrackerInfo,
                                    initial_contact: bool,
@@ -1667,10 +1778,15 @@ class JobMaster:
                                    deferred_final: list,
                                    hb_trace: "dict | None",
                                    t0: float,
-                                   is_delta: bool = False) -> dict:
+                                   is_delta: bool = False,
+                                   adopted: bool = False) -> dict:
         """Fold + replay-check + assign for one beat (caller holds the
         tracker's ``hb_lock`` and NOTHING else — every acquisition below
-        is rank-ascending: scheduler → global → trackers → job)."""
+        is rank-ascending: scheduler → global → trackers → job).
+        ``adopted`` marks a re-join beat (full status from a tracker
+        this master doesn't know): RUNNING attempts are bound to their
+        (possibly recovered) TIPs; attempts no live job will claim are
+        killed INDIVIDUALLY, never via blanket reinit."""
         t_fold = time.monotonic()
         t_fold_wall = time.time() if hb_trace is not None else 0.0
         # fold the piggybacked tracker metrics into the cluster
@@ -1710,15 +1826,38 @@ class JobMaster:
                     info.running.discard(aid)
                 by_job.setdefault(str(ts.attempt_id.task.job),
                                   []).append(ts)
+        #: attempts a re-join beat carried that no live job adopted —
+        #: killed individually in THIS response
+        adopt_kills: "list[str]" = []
+        attempts_adopted = 0
         for job_id, group in (by_job or {}).items():
-            jip = self.jobs.get(job_id)
+            jip = self._resolve_job(job_id)
             if jip is None:
+                if adopted:
+                    # the job died with the old master (or recovery is
+                    # off / failed): these survivors have no home
+                    for ts in group:
+                        if ts.state not in TaskState.TERMINAL:
+                            adopt_kills.append(str(ts.attempt_id))
+                            info.running.discard(str(ts.attempt_id))
                 continue
             revoke: "list[tuple[str, str]]" = []
             with jip.lock:
                 before = jip.state
                 for ts in group:
                     aid = str(ts.attempt_id)
+                    if adopted and ts.state not in TaskState.TERMINAL:
+                        # bind the in-flight attempt to its TIP (the
+                        # recovered job's, or this job's after an
+                        # eviction re-join) — any non-terminal state
+                        # counts as in flight; rejects are zombies,
+                        # their task already succeeded elsewhere
+                        if jip.adopt_running_attempt(ts):
+                            attempts_adopted += 1
+                        else:
+                            adopt_kills.append(aid)
+                            info.running.discard(aid)
+                            continue
                     jip.update_task_status(ts, shuffle_addr)
                     if ts.state in TaskState.TERMINAL \
                             and aid not in jip.history_logged:
@@ -1741,11 +1880,19 @@ class JobMaster:
                         event = {TaskState.SUCCEEDED: "TASK_FINISHED",
                                  TaskState.KILLED: "TASK_KILLED"}.get(
                             ts.state, "TASK_FAILED")
-                        deferred_events.append((job_id, event, dict(
+                        deferred_events.append((str(jip.job_id), event,
+                                                dict(
                             attempt_id=aid, is_map=ts.is_map,
                             run_on_tpu=ts.run_on_tpu,
                             tpu_device_id=ts.tpu_device_id,
                             runtime=ts.runtime, tracker=name,
+                            # where a successful map's output is served
+                            # from — restart recovery re-feeds it into
+                            # the resubmitted job's completion events
+                            shuffle_addr=(shuffle_addr if ts.is_map
+                                          and ts.state
+                                          == TaskState.SUCCEEDED
+                                          else ""),
                             # per-attempt counters make the history
                             # file self-sufficient for post-hoc
                             # diagnosis (tools.vaidya) ≈ the reference
@@ -1765,13 +1912,19 @@ class JobMaster:
                 job_done = (before == JobState.RUNNING
                             and jip.state in JobState.TERMINAL)
             if jip.has_accel_events():
-                self._drain_accel_events(jip, job_id, name,
+                self._drain_accel_events(jip, str(jip.job_id), name,
                                          deferred_events)
             for task_id, aid in revoke:
                 self._revoke_commit(task_id, aid)
             if job_done:
                 self._bump_jobs_version()
                 deferred_final.append(jip)
+        if adopted:
+            # the re-join itself is the observable event (acceptance:
+            # trackers survive a master restart without reinit)
+            self._mreg.incr("trackers_adopted")
+            if attempts_adopted:
+                self._mreg.incr("attempts_adopted", attempts_adopted)
 
         # Fetch-failure reports (the "too many fetch failures"
         # protocol): reducers on this tracker found a completed
@@ -1813,6 +1966,16 @@ class JobMaster:
                     "next_interval_ms": int(nxt * 1000 + 0.5)}
 
         actions: list[dict] = []
+        if adopted:
+            # individually kill the survivors no job would claim, and
+            # teach the tracker any job id rebindings (it re-keys the
+            # recovered jobs' served map outputs so NEW-id reducers can
+            # fetch outputs produced under the OLD id)
+            for aid in adopt_kills:
+                actions.append({"type": "kill_task", "attempt_id": aid})
+            for old, new in self._recovered.items():
+                actions.append({"type": "recover_job",
+                                "old": old, "new": new})
         # scheduler observation hook BEFORE the kill scan and
         # independent of free slots: a saturated cluster (no tracker
         # ever asks for work) is exactly when fair-share preemption
@@ -1837,9 +2000,11 @@ class JobMaster:
         for aid in list(info.running):
             # attempt_<cluster>_<nnnn>_... → job_<cluster>_<nnnn>
             # (sliced, not parsed: this runs per running attempt per
-            # beat and TaskAttemptID.parse was profiling-visible)
+            # beat and TaskAttemptID.parse was profiling-visible).
+            # Alias-resolved: adopted pre-restart attempts must still
+            # be killable when their (recovered) job dies.
             parts = aid.split("_", 3)
-            jip = self.jobs.get(f"job_{parts[1]}_{parts[2]}")
+            jip = self._resolve_job(f"job_{parts[1]}_{parts[2]}")
             if jip is None:
                 continue
             if jip.state in JobState.TERMINAL or jip.kill_marked(aid):
@@ -1958,7 +2123,7 @@ class JobMaster:
             task_id = TaskAttemptID.parse(map_attempt).task
         except (ValueError, IndexError):
             return
-        jip = self.jobs.get(str(task_id.job))
+        jip = self._resolve_job(str(task_id.job))
         if jip is None:
             return
         before = jip.state
@@ -2047,7 +2212,14 @@ class JobMaster:
                          for e in jip.completion_events
                          if e["shuffle_addr"] == addr
                          and e.get("status") != "OBSOLETE"]
-            jip.requeue_lost_attempts(attempts + owned)
+            withdrawn = jip.requeue_lost_attempts(attempts + owned)
+            for aid in withdrawn:
+                # journal the withdrawal: restart recovery replays the
+                # history file and must not adopt outputs this master
+                # already declared gone with their tracker
+                self.history.task_event(
+                    str(jip.job_id), "MAP_OUTPUT_LOST", attempt_id=aid,
+                    shuffle_addr=addr, reason="tracker_lost")
         for aid in attempts:
             self._revoke_commit(str(TaskAttemptID.parse(aid).task), aid)
 

@@ -96,6 +96,12 @@ class MapLocator:
         #: a re-run the master never schedules
         self._stale: dict[int, dict] = {}
         self._seen = 0
+        #: consecutive polls that surfaced nothing while a caller was
+        #: starving — past a threshold the cursor rewinds to 0 (see
+        #: __call__): a cursor minted before a master restart can sit
+        #: past the resubmitted job's shorter feed, hiding recovered
+        #: events; re-folding from 0 is idempotent
+        self._empty_polls = 0
         self._clients: dict[tuple, RpcClient] = {}
         # the ShuffleCopier drives locate() from parallel fetcher
         # threads. cache_lock guards the event cache/cursor/client
@@ -173,11 +179,28 @@ class MapLocator:
             with self._poll_lock:
                 if self._cached(map_index):  # another poller just fetched
                     continue
-                fresh = self._events_fn(self._seen)
+                try:
+                    fresh = self._events_fn(self._seen)
+                except Exception:  # noqa: BLE001 — master briefly down
+                    # (restarting): a reduce mid-shuffle survives the
+                    # control-plane outage by simply polling again; the
+                    # deadline below bounds how long, and on_wait keeps
+                    # the reaper informed that we are waiting, not hung
+                    fresh = []
                 with self._cache_lock:
                     self._fold(fresh)
+                if fresh:
+                    self._empty_polls = 0
             if self._cached(map_index):
                 continue
+            self._empty_polls += 1
+            if self._empty_polls >= 25:
+                # starving on an empty feed: the cursor may predate a
+                # master restart (the recovered feed restarted at 0) —
+                # rewind and re-fold everything (idempotent)
+                self._empty_polls = 0
+                with self._cache_lock:
+                    self._seen = 0
             with self._cache_lock:
                 stale = self._stale.pop(map_index, None)
                 if stale is not None:
@@ -230,8 +253,15 @@ class NodeRunner:
         self.name = name or f"tracker_{host}_{id(self) & 0xffff}"
         from tpumr.security import rpc_secret
         self._rpc_secret = rpc_secret(conf)
-        self.master = RpcClient(master_host, master_port,
-                                secret=self._rpc_secret)
+        # control-plane partition tolerance: the master channel retries
+        # transport failures with capped jittered backoff before giving
+        # up (tpumr.rpc.client.*); the heartbeat loop's lost-master
+        # state handles outages longer than one call's retry budget
+        self.master = RpcClient(
+            master_host, master_port, secret=self._rpc_secret,
+            retries=conf.get_int("tpumr.rpc.client.retries", 1),
+            backoff_ms=conf.get_int("tpumr.rpc.client.backoff.ms", 200))
+        self.master.fi_conf = conf   # rpc.drop/delay/reset chaos seams
         remote_version = self.master.call("get_protocol_version")
         if remote_version != PROTOCOL_VERSION:
             raise RuntimeError(f"master protocol {remote_version} != "
@@ -291,6 +321,24 @@ class NodeRunner:
         self._status_shipped: "dict[str, tuple]" = {}
         self._stop = threading.Event()
         self._hb_count = 0
+        # --- lost-master state (master restart survival) ---
+        #: True while the master is unreachable at the TRANSPORT level
+        #: (connect refused / reset / timeout) — in-flight tasks keep
+        #: running, heartbeats retry with capped jittered backoff, and
+        #: on re-contact the master ADOPTS the full status instead of
+        #: answering reinit. Application-level RPC errors (the master
+        #: answered, unhappily) never enter this state.
+        self.master_unreachable = False
+        self._master_failures = 0
+        self._last_master_contact = time.monotonic()
+        self._lost_master_backoff_max_s = conf.get_int(
+            "tpumr.heartbeat.lostmaster.backoff.max.ms", 15_000) / 1000.0
+        #: old job id -> resubmitted id, taught by a recovered master's
+        #: recover_job actions: future map-output registrations under
+        #: the old id are stored under the new one (existing entries
+        #: are re-keyed on receipt), so NEW-id reducers can fetch
+        #: outputs produced before the restart
+        self._job_rebinds: dict[str, str] = {}
         # per-pool gating ≈ TaskLauncher's numCPUFreeSlots/numGPUFreeSlots
         # wait loops (TaskTracker.java:2502-2628): even if the master ever
         # over-assigns, a task blocks until ITS pool has a slot
@@ -342,6 +390,16 @@ class NodeRunner:
         # hybrid/job-driven scheduling work consumes (PAPERS.md), and
         # the per-tracker rows behind the master's cluster view
         self._mreg.set_gauge("slot_utilization", self._slot_utilization)
+        # lost-master visibility: whether the control plane is reachable
+        # from HERE, and how stale the lease is — the first thing to
+        # check when a tracker looks wedged (the dashboards' twin of the
+        # master-side heartbeat-age column)
+        self._mreg.set_gauge("master_unreachable",
+                             lambda: 1 if self.master_unreachable else 0)
+        self._mreg.set_gauge(
+            "master_contact_age_s",
+            lambda: round(time.monotonic() - self._last_master_contact,
+                          3))
         # RPC server-side latency per method — the tracker's RPC surface
         # IS the shuffle server (get_map_output_chunk) + the umbilical
         self._server.metrics = self.metrics.new_registry("rpc")
@@ -481,8 +539,14 @@ class NodeRunner:
                 prof_links = " · ".join(
                     f"<a href='/task?attempt={html_escape(a)}'>"
                     f"{html_escape(a)}</a>" for a in profiled)
+                age = time.monotonic() - self._last_master_contact
+                master_line = (
+                    "<span class='bad'>master UNREACHABLE</span>"
+                    if self.master_unreachable else
+                    "<span class='ok'>master ok</span>")
                 return (
                     f"<h1>TaskTracker {st['tracker_name']}</h1>"
+                    f"<p>{master_line} · last contact {age:.1f}s ago</p>"
                     f"<p>host {st['host']} · cpu "
                     f"{st['count_cpu_map_tasks']}/{st['max_cpu_map_slots']}"
                     f" · tpu {st['count_tpu_map_tasks']}/"
@@ -687,7 +751,9 @@ class NodeRunner:
     # ------------------------------------------------------------ heartbeat
 
     def _heartbeat_loop(self) -> None:
+        import random as _random
         while not self._stop.is_set():
+            wait_s = self.heartbeat_s
             try:
                 if self.tracer is None:
                     self._heartbeat_once()
@@ -702,13 +768,27 @@ class NodeRunner:
                     with self.tracer.span("heartbeat",
                                           f"daemon-{self.name}") as hb:
                         self._heartbeat_once(hb_span=hb)
+            except (ConnectionError, OSError):
+                # LOST MASTER: transport-level failure (crashed,
+                # restarting, partitioned). In-flight tasks keep
+                # running; retry with capped jittered exponential
+                # backoff so a restarting master isn't stampeded by the
+                # whole fleet at once. NOT a fault of this tracker and
+                # NOT an application error — nothing is killed.
+                self._master_failures += 1
+                self.master_unreachable = True
+                self._mreg.incr("master_unreachable_beats")
+                backoff = min(self._lost_master_backoff_max_s,
+                              self.heartbeat_s
+                              * (2 ** min(self._master_failures, 6)))
+                wait_s = max(self.heartbeat_s,
+                             backoff * _random.uniform(0.5, 1.0))
             except Exception:
-                # master briefly unreachable — keep trying (lease
-                # semantics); back off solely via the interruptible
-                # _stop.wait below (an extra time.sleep here doubled the
-                # error-path interval AND ignored shutdown for it)
+                # application-level RPC error: the master is ALIVE and
+                # answered (a raise inside the handler, an auth refusal)
+                # — keep the normal cadence, no lost-master backoff
                 pass
-            self._stop.wait(self.heartbeat_s)
+            self._stop.wait(wait_s)
 
     def _metrics_piggyback(self) -> dict:
         """The compact metrics snapshot that rides every heartbeat:
@@ -795,6 +875,11 @@ class NodeRunner:
             self._hb_encoder.reset()
             raise
         self._hb_encoder.delivered()
+        # re-contact: the lost-master state clears the moment a beat
+        # lands (the master that answered has adopted our full status)
+        self.master_unreachable = False
+        self._master_failures = 0
+        self._last_master_contact = time.monotonic()
         if metrics is not None:
             self._piggyback_last = now
         self._initial_contact = False
@@ -806,6 +891,15 @@ class NodeRunner:
         nxt = resp.get("next_interval_ms")
         if isinstance(nxt, (int, float)) and nxt > 0:
             self.heartbeat_s = nxt / 1000.0
+        if any(a.get("type") == "resend_full"
+               for a in resp["actions"]):
+            # the master did NOT fold this beat (no baseline — it wants
+            # the full status first): keep every status and report for
+            # the re-send, or a terminal completion delivered into the
+            # early return would be dropped unseen and its task re-run
+            for action in resp["actions"]:
+                self._apply_action(action)
+            return
         with self.lock:
             # the heartbeat DELIVERED these fetch-failure reports (they
             # were snapshotted into `full` first — a failed RPC keeps
@@ -848,8 +942,15 @@ class NodeRunner:
         for job_id in job_ids:
             try:
                 st = self.master.call("get_job_status", job_id)
-            except Exception:
-                continue
+            except Exception as e:  # noqa: BLE001
+                from tpumr.ipc.rpc import RpcError
+                if isinstance(e, RpcError) and "KeyError" in str(e):
+                    # the master does not know this job at all (restart
+                    # with recovery off, or past its alias horizon) —
+                    # purgeable, or the outputs leak forever
+                    st = {"state": "KILLED"}
+                else:
+                    continue
             if st["state"] in ("SUCCEEDED", "FAILED", "KILLED"):
                 with self.lock:
                     self.map_outputs = {k: v for k, v in
@@ -858,6 +959,9 @@ class NodeRunner:
                     jc = self.job_confs.pop(job_id, None)
                     self._job_tokens.pop(job_id, None)
                     jt = self._job_tracers.pop(job_id, None)
+                    self._job_rebinds = {
+                        k: v for k, v in self._job_rebinds.items()
+                        if job_id not in (k, v)}
                 if jt is not None:
                     jt.flush()   # stragglers of the finished traced job
                 if jc is not None:
@@ -912,6 +1016,25 @@ class NodeRunner:
                 self._response_id = 0
                 self._hb_encoder.reset()
                 self._status_shipped.clear()
+        elif kind == "resend_full":
+            # the master lost our baseline (restart / eviction): the
+            # next beat ships the FULL status and the master ADOPTS it.
+            # Unlike reinit, nothing local is dropped — in-flight tasks
+            # survive the master's restart.
+            with self.lock:
+                self._hb_encoder.reset()
+                self._status_shipped.clear()
+        elif kind == "recover_job":
+            # a restarted master resubmitted an interrupted job under a
+            # new id: re-key this tracker's served map outputs (and
+            # translate future registrations) so reducers launched
+            # under the NEW id can fetch outputs produced under the old
+            old, new = str(action["old"]), str(action["new"])
+            with self.lock:
+                self._job_rebinds[old] = new
+                for key in [k for k in self.map_outputs if k[0] == old]:
+                    self.map_outputs[(new, key[1])] = \
+                        self.map_outputs.pop(key)
         elif kind == "disallowed":
             # ≈ DisallowedTaskTrackerException: this host was excluded
             # (mapred.hosts/.exclude + mradmin -refreshNodes). The
@@ -1206,8 +1329,11 @@ class NodeRunner:
                         idx = dict(out[1])
                         idx["attempt"] = aid
                         idx["attempt_no"] = task.attempt_id.attempt
-                        self.map_outputs[(job_id, task.partition)] = (
-                            out[0], idx)
+                        # a job recovered under a new id registers its
+                        # stragglers' outputs under the NEW key
+                        self.map_outputs[
+                            (self._job_rebinds.get(job_id, job_id),
+                             task.partition)] = (out[0], idx)
                 # commit covers direct-output maps AND map-side named
                 # outputs (lib.MultipleOutputs) in jobs with reducers;
                 # needs_commit makes it a no-op when no files exist
@@ -1609,7 +1735,9 @@ class NodeRunner:
                     idx["attempt"] = attempt_id
                     idx["attempt_no"] = TaskAttemptID.parse(
                         attempt_id).attempt
-                    self.map_outputs[(job_id, partition)] = (real, idx)
+                    self.map_outputs[
+                        (self._job_rebinds.get(job_id, job_id),
+                         partition)] = (real, idx)
 
     def umbilical_fail(self, attempt_id: str, state: str,
                        diagnostics: str, failure_class: str = "") -> None:
@@ -1679,14 +1807,27 @@ class NodeRunner:
         if attempt_no is not None:
             maybe_fail(f"shuffle.serve.a{attempt_no}", conf)
 
+    def _map_output_entry(self, job_id: str,
+                          map_index: int) -> "tuple | None":
+        """Served-output lookup that follows the recover_job rebinding
+        in BOTH directions: entries are re-keyed to the NEW job id when
+        the master teaches the rebinding, but reducers ADOPTED across
+        the restart keep fetching with the OLD id — both must hit."""
+        with self.lock:
+            ent = self.map_outputs.get((job_id, map_index))
+            if ent is None:
+                new = self._job_rebinds.get(job_id)
+                if new is not None:
+                    ent = self.map_outputs.get((new, map_index))
+        return ent
+
     def get_map_output(self, job_id: str, map_index: int,
                        partition: int) -> dict:
         """Serve one partition segment (≈ MapOutputServlet,
         TaskTracker.java:4050): raw length-prefixed (possibly compressed)
         bytes straight off the spill file + the codec name."""
         self._check_scope(job_id)
-        with self.lock:
-            ent = self.map_outputs.get((job_id, map_index))
+        ent = self._map_output_entry(job_id, map_index)
         if ent is None:
             raise KeyError(f"no map output for {job_id} map {map_index}")
         path, index = ent
@@ -1715,8 +1856,7 @@ class NodeRunner:
         knows when it has everything; ``raw`` is the decompressed size the
         ShuffleRamManager budgets on."""
         self._check_scope(job_id)
-        with self.lock:
-            ent = self.map_outputs.get((job_id, map_index))
+        ent = self._map_output_entry(job_id, map_index)
         if ent is None:
             raise KeyError(f"no map output for {job_id} map {map_index}")
         path, index = ent
@@ -1741,8 +1881,7 @@ class NodeRunner:
         MapOutputServlet role; the exchange itself happens on the mesh).
         Ships the self-describing file verbatim — no parse/reserialize."""
         self._check_scope(job_id)
-        with self.lock:
-            ent = self.map_outputs.get((job_id, map_index))
+        ent = self._map_output_entry(job_id, map_index)
         if ent is None:
             raise KeyError(f"no map output for {job_id} map {map_index}")
         path, index = ent

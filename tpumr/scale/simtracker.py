@@ -101,6 +101,10 @@ class SimTracker:
         #: (OBSOLETE tombstones evict, like the real MapLocator fold)
         self._event_cursor: "dict[str, int]" = {}
         self._maps_live: "dict[str, dict[int, dict]]" = {}
+        #: consecutive empty polls per starving job — rewinds the
+        #: cursor like the real MapLocator (a pre-restart cursor can
+        #: sit past a recovered job's shorter feed)
+        self._empty_polls: "dict[str, int]" = {}
         self.stopped = False
         self.heartbeats = 0
         self.tasks_completed = 0
@@ -203,6 +207,13 @@ class SimTracker:
         if isinstance(nxt, (int, float)) and nxt > 0:
             self.next_interval_s = nxt / 1000.0
         self.heartbeats += 1
+        if any(a.get("type") == "resend_full"
+               for a in resp.get("actions", [])):
+            # master folded nothing (it wants the full status first):
+            # keep statuses + reports for the re-send (NodeRunner rule)
+            for action in resp.get("actions", []):
+                self._apply_action(action)
+            return
         # delivered fetch-failure reports are done; ones appended since
         # the snapshot would stay — mirrors NodeRunner's contract
         sent_ff = len(full.get("fetch_failures", []))
@@ -289,6 +300,16 @@ class SimTracker:
             except Exception:  # noqa: BLE001 — purged job / master load
                 continue
             self._event_cursor[job_id] = cursor + len(events)
+            if events:
+                self._empty_polls[job_id] = 0
+            else:
+                n = self._empty_polls.get(job_id, 0) + 1
+                self._empty_polls[job_id] = n
+                if n >= 25:
+                    # starving: rewind — the cursor may predate a master
+                    # restart (re-folds are idempotent, like MapLocator)
+                    self._empty_polls[job_id] = 0
+                    self._event_cursor[job_id] = 0
             live = self._maps_live.setdefault(job_id, {})
             for e in events:
                 idx = e.get("map_index")
@@ -392,6 +413,12 @@ class SimTracker:
             self._initial_contact = True
             self._response_id = 0
             self._hb_encoder.reset()   # re-register with a full status
+            self._status_shipped.clear()
+        elif kind == "resend_full":
+            # master lost our baseline (restart): re-ship the full
+            # status next beat; unlike reinit, fake in-flight work
+            # survives — the master adopts it (NodeRunner semantics)
+            self._hb_encoder.reset()
             self._status_shipped.clear()
         elif kind == "disallowed":
             self.stopped = True

@@ -37,6 +37,18 @@ hung-task-reaping loop):
                                  :func:`fires`, nothing raised); the
                                  tracker's reaper is the quarry's
                                  predator
+
+Control-plane partition seams (``RpcClient`` with ``fi_conf`` set —
+the master-restart / partition-tolerance chaos loop):
+  rpc.drop                       the request is lost before the wire
+                                 (ConnectionError; exercises the
+                                 client retry policy)
+  rpc.delay                      the call stalls ``tpumr.fi.rpc.delay.
+                                 ms`` (default 100) before sending
+  rpc.reset                      the connection resets AFTER the send —
+                                 delivery unknown; the resent id must
+                                 hit the server's replay cache, never
+                                 re-execute
 """
 
 from __future__ import annotations
