@@ -1,0 +1,565 @@
+"""tpulint — the analyzer must catch each seeded violation class and
+stay quiet on known-good (and pragma'd) code, and the repo itself must
+lint clean (the CI gate's contract)."""
+
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpumr.core import confkeys
+from tpumr.tools.tpulint.clockcheck import check_clock
+from tpumr.tools.tpulint.confcheck import check_conf
+from tpumr.tools.tpulint.core import apply_pragmas, load_corpus
+from tpumr.tools.tpulint.driftcheck import (check_fi_drift,
+                                            check_metric_drift)
+from tpumr.tools.tpulint.lockcheck import check_locks
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+def write_tree(root: Path, files: "dict[str, str]") -> None:
+    for rel, body in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+
+
+def lint_files(tmp_path: Path, files: "dict[str, str]", checker,
+               **kw):
+    write_tree(tmp_path, files)
+    mods = load_corpus(str(tmp_path), ("tpumr",))
+    if checker in (check_conf, check_metric_drift, check_fi_drift):
+        findings = checker(mods, str(tmp_path), **kw)
+    else:
+        findings = checker(mods, **kw)
+    return apply_pragmas(mods, findings)
+
+
+# --------------------------------------------------------------- lock rank
+
+LOCK_PRELUDE = """\
+    from tpumr.metrics.locks import (RANK_GLOBAL, RANK_SCHEDULER,
+                                     RANK_JOB, InstrumentedRLock)
+
+    class Master:
+        def __init__(self):
+            self.lock = InstrumentedRLock(name="global",
+                                          rank=RANK_GLOBAL)
+            self.sched_lock = InstrumentedRLock(name="scheduler",
+                                                rank=RANK_SCHEDULER)
+            self.job_lock = InstrumentedRLock(name="job", rank=RANK_JOB)
+"""
+
+
+def test_lock_order_direct_inversion(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/bad.py": LOCK_PRELUDE + """\
+
+        def bad(self):
+            with self.lock:
+                with self.sched_lock:
+                    pass
+    """}, check_locks)
+    assert [f.rule for f in found] == ["lock-order"]
+    assert "'scheduler' (rank 10)" in found[0].message
+    assert "'global' (rank 20)" in found[0].message
+
+
+def test_lock_order_ascending_is_legal(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/good.py": LOCK_PRELUDE + """\
+
+        def good(self):
+            with self.sched_lock:
+                with self.lock:
+                    with self.job_lock:
+                        pass
+    """}, check_locks)
+    assert found == []
+
+
+def test_lock_order_two_hop_call_chain(tmp_path):
+    """The case the runtime assertion misses on unexercised paths: the
+    inversion is only reachable through a TWO-hop call chain."""
+    found = lint_files(tmp_path, {"tpumr/mapred/chain.py": LOCK_PRELUDE + """\
+
+        def holder(self):
+            with self.job_lock:
+                self.hop1()
+
+        def hop1(self):
+            self.hop2()
+
+        def hop2(self):
+            with self.sched_lock:
+                pass
+    """}, check_locks)
+    rules = [f.rule for f in found]
+    assert "lock-order" in rules
+    order = next(f for f in found if f.rule == "lock-order")
+    assert "'job' (rank 40)" in order.message
+    assert "'scheduler' (rank 10)" in order.message
+    # the chain names both hops so the path is actionable
+    assert any("hop1" in hop for hop in order.chain)
+    assert any("hop2" in hop for hop in order.chain)
+
+
+def test_lock_blocking_direct_and_chained(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/blk.py": LOCK_PRELUDE + """\
+
+        def direct(self):
+            import time
+            with self.sched_lock:
+                time.sleep(0.5)
+
+        def chained(self):
+            with self.lock:
+                self.notify()
+
+        def notify(self):
+            import time
+            time.sleep(0.1)
+    """}, check_locks)
+    blocking = [f for f in found if f.rule == "lock-blocking"]
+    assert len(blocking) == 2
+    assert all("time.sleep" in f.message for f in blocking)
+
+
+def test_lock_blocking_rpc_under_lock(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/rpc_hold.py":
+                                  LOCK_PRELUDE + """\
+
+        def bad(self, client):
+            with self.lock:
+                client.call("get_task")
+    """}, check_locks)
+    assert [f.rule for f in found] == ["lock-blocking"]
+    assert "RPC" in found[0].message
+
+
+def test_lock_nested_def_is_deferred_work(tmp_path):
+    """Code inside a nested def under a with-block runs LATER (thread
+    target, callback) — it must not be charged to the lock region."""
+    found = lint_files(tmp_path, {"tpumr/mapred/defer.py":
+                                  LOCK_PRELUDE + """\
+
+        def ok(self):
+            import time
+            with self.lock:
+                def later():
+                    time.sleep(5)
+                self.pending = later
+    """}, check_locks)
+    assert found == []
+
+
+def test_lock_pragma_suppresses(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/prag.py": LOCK_PRELUDE + """\
+
+        def excused(self):
+            with self.lock:
+                with self.sched_lock:  # tpulint: disable=lock-order
+                    pass
+    """}, check_locks)
+    assert found == []
+
+
+def test_lock_ranks_parsed_from_locks_py(tmp_path):
+    """The rank table comes from tpumr/metrics/locks.py — a fixture
+    declaring an INVERTED numbering must flip the verdict."""
+    files = {
+        "tpumr/metrics/locks.py": """\
+            RANK_GLOBAL = 10
+            RANK_SCHEDULER = 20
+        """,
+        "tpumr/mapred/m.py": """\
+            from tpumr.metrics.locks import (RANK_GLOBAL, RANK_SCHEDULER,
+                                             InstrumentedRLock)
+
+            class M:
+                def __init__(self):
+                    self.lock = InstrumentedRLock(name="global",
+                                                  rank=RANK_GLOBAL)
+                    self.sched_lock = InstrumentedRLock(
+                        name="scheduler", rank=RANK_SCHEDULER)
+
+                def f(self):
+                    with self.lock:
+                        with self.sched_lock:
+                            pass
+        """,
+    }
+    assert lint_files(tmp_path, files, check_locks) == []
+
+
+# ------------------------------------------------------------------- conf
+
+def test_conf_unregistered_key_with_suggestion(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/c.py": """\
+        def f(conf):
+            return conf.get_int("tpumr.hartbeat.interval.ms", 1000)
+    """}, check_conf)
+    keyed = [f for f in found if f.rule == "conf-key"]
+    assert len(keyed) == 1
+    assert "tpumr.heartbeat.interval.ms" in keyed[0].message
+
+
+def test_conf_registered_key_passes(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/c.py": """\
+        def f(conf):
+            return conf.get_int("tpumr.heartbeat.interval.ms", 1000)
+    """}, check_conf)
+    assert [f for f in found if f.rule == "conf-key"] == []
+
+
+def test_conf_conflicting_defaults_across_files(tmp_path):
+    files = {
+        "tpumr/mapred/a.py": """\
+            def f(conf):
+                return conf.get_int("tpumr.zz.unregistered.knob", 5)
+        """,
+        "tpumr/mapred/b.py": """\
+            def g(conf):
+                return conf.get_int("tpumr.zz.unregistered.knob", 9)
+        """,
+    }
+    found = lint_files(tmp_path, files, check_conf)
+    conflicts = [f for f in found if f.rule == "conf-default"]
+    assert len(conflicts) == 1
+    assert "conflicting defaults" in conflicts[0].message
+
+
+def test_conf_default_contradicting_registry(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/c.py": """\
+        def f(conf):
+            return conf.get_int("tpumr.heartbeat.interval.ms", 9999)
+    """}, check_conf)
+    bad = [f for f in found if f.rule == "conf-default"]
+    assert len(bad) == 1
+    assert "registry says 1000" in bad[0].message
+
+
+def test_conf_pragma_suppresses(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/c.py": """\
+        def f(conf):
+            return conf.get("tpumr.zz.bogus")  # tpulint: disable=conf-key
+    """}, check_conf)
+    assert [f for f in found if f.rule == "conf-key"] == []
+
+
+def test_conf_unread_registered_key(tmp_path, monkeypatch):
+    ghost = confkeys.ConfKey("tpumr.zz.ghost.knob", "int", 1, "unused")
+    monkeypatch.setitem(confkeys.REGISTRY, ghost.key, ghost)
+    found = lint_files(tmp_path, {"tpumr/mapred/c.py": """\
+        def f(conf):
+            return conf.get_int("tpumr.heartbeat.interval.ms", 1000)
+    """}, check_conf)
+    unread = [f for f in found if f.rule == "conf-unread"]
+    assert any("tpumr.zz.ghost.knob" in f.message for f in unread)
+
+
+def test_conf_dynamic_fi_key_matches_pattern(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/mapred/c.py": """\
+        def f(conf, point):
+            return conf.get(f"tpumr.fi.{point}.probability")
+    """}, check_conf)
+    assert [f for f in found if f.rule == "conf-key"] == []
+
+
+def test_conf_example_phantom_key(tmp_path):
+    write_tree(tmp_path, {"conf/tpumr-site.example.toml": """\
+        [tpumr.zz]
+        "phantom.knob" = 1
+    """})
+    found = lint_files(tmp_path, {"tpumr/mapred/c.py": "X = 1\n"},
+                       check_conf)
+    phantom = [f for f in found if f.rule == "conf-example"]
+    assert len(phantom) == 1
+    assert "tpumr.zz.phantom.knob" in phantom[0].message
+
+
+# ------------------------------------------------------------------ clock
+
+def test_clock_deadline_arith_flagged(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/w.py": """\
+        import time
+
+        def bad_deadline():
+            return time.time() + 30
+
+        def bad_compare(deadline):
+            return time.time() > deadline
+
+        def bad_tainted_var(start):
+            t0 = time.time()
+            return t0 - start
+    """}, check_clock)
+    assert [f.rule for f in found] == ["clock-arith"] * 3
+
+
+def test_clock_good_samples_pass(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/w.py": """\
+        import time
+
+        def stamp_only():
+            return {"ts": time.time()}
+
+        def monotonic_deadline():
+            return time.monotonic() + 30
+
+        def scaled_stamp():
+            return int(time.time() * 1000)
+    """}, check_clock)
+    assert found == []
+
+
+def test_clock_pragma_suppresses(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/w.py": """\
+        import time
+
+        def display_age(last_seen):
+            # human-facing status age off a persisted wall stamp
+            return time.time() - last_seen  # tpulint: disable=clock-arith
+    """}, check_clock)
+    assert found == []
+
+
+def test_clock_file_level_pragma(tmp_path):
+    found = lint_files(tmp_path, {"tpumr/w.py": """\
+        # tpulint: disable=clock-arith — absolute wall lifetimes module
+        import time
+
+        def a():
+            return time.time() + 1
+
+        def b():
+            return time.time() - 1
+    """}, check_clock)
+    assert found == []
+
+
+# ------------------------------------------------------------------ drift
+
+def test_metric_drift_flags_unknown_only(tmp_path):
+    files = {
+        "tpumr/m.py": """\
+            def setup(reg):
+                reg.incr("frobnication_total")
+                reg.histogram("frob_seconds")
+        """,
+        "docs/OPERATIONS.md": """\
+            Watch `tpumr_frob_seconds` and `frobnication_total`; the
+            `ghost_metric_total` series was renamed away.
+        """,
+    }
+    found = lint_files(tmp_path, files, check_metric_drift)
+    assert [f.rule for f in found] == ["drift-metric"]
+    assert "ghost_metric_total" in found[0].message
+
+
+def test_fi_drift_flags_unfired_seam(tmp_path):
+    files = {
+        "tpumr/utils/fi.py": '''\
+            """Fault seams:
+              good.seam / good.seam.m<idx>
+              ghost.seam
+            """
+
+            def maybe_fail(point, conf=None):
+                pass
+        ''',
+        "tpumr/mapred/m.py": """\
+            from tpumr.utils.fi import maybe_fail
+
+            def f(conf, idx):
+                maybe_fail("good.seam", conf)
+                maybe_fail(f"good.seam.m{idx}", conf)
+        """,
+    }
+    found = lint_files(tmp_path, files, check_fi_drift)
+    assert [f.rule for f in found] == ["drift-fi"]
+    assert "ghost.seam" in found[0].message
+
+
+# --------------------------------------------------------------- registry
+
+def test_confkeys_lookup_and_patterns():
+    assert confkeys.lookup("tpumr.heartbeat.interval.ms").default == 1000
+    assert confkeys.lookup("tpumr.fi.tpu.execute.probability").pattern
+    assert confkeys.lookup("tpumr.totally.unknown") is None
+
+
+def test_confkeys_suggest_typo():
+    assert "tpumr.heartbeat.interval.ms" in \
+        confkeys.suggest("tpumr.hartbeat.interval.ms")
+
+
+def test_confkeys_typed_readers_on_dict_and_conf():
+    from tpumr.core.configuration import Configuration
+    assert confkeys.get_int({}, "tpumr.heartbeat.interval.ms") == 1000
+    assert confkeys.get_int({"tpumr.heartbeat.interval.ms": "250"},
+                            "tpumr.heartbeat.interval.ms") == 250
+    assert confkeys.get_boolean({"mapred.speculative.execution": "false"},
+                                "mapred.speculative.execution") is False
+    conf = Configuration(load_defaults=False)
+    conf.set("tpumr.shuffle.copy.retries", 7)
+    assert confkeys.get_int(conf, "tpumr.shuffle.copy.retries") == 7
+    assert confkeys.get_float(conf, "tpumr.shuffle.copy.backoff.ms") \
+        == 200.0
+
+
+def test_lock_cycle_does_not_poison_memo(tmp_path):
+    """A mutually-recursive pair must not get a truncated summary
+    memoized by an early query — the inversion through the cycle has
+    to surface for later callers (the false-negative class: CI green
+    on a real deadlock path)."""
+    found = lint_files(tmp_path, {"tpumr/mapred/cyc.py": LOCK_PRELUDE + """\
+
+        def early(self):
+            # forces a query of ping/pong while pong is mid-recursion;
+            # scheduler(10) held, cycle acquires scheduler -> no report
+            with self.sched_lock:
+                self.ping()
+
+        def ping(self, n=0):
+            if n:
+                self.pong(n)
+            with self.sched_lock:
+                pass
+
+        def pong(self, n):
+            self.ping(n - 1)
+
+        def late(self):
+            # job(40) held; the cycle's scheduler(10) acquisition MUST
+            # still be visible here
+            with self.job_lock:
+                self.pong(3)
+    """}, check_locks)
+    assert any(f.rule == "lock-order" and "'job' (rank 40)" in f.message
+               for f in found), found
+
+
+def test_foreign_root_uses_its_own_registry(tmp_path):
+    """Linting another checkout judges its code against ITS
+    tpumr/core/confkeys.py, not this process's imported registry."""
+    files = {
+        "tpumr/core/confkeys.py": """\
+            from dataclasses import dataclass
+
+
+            @dataclass(frozen=True)
+            class ConfKey:
+                key: str
+                type: str
+                default: object
+                doc: str
+                pattern: bool = False
+
+
+            REGISTRY = {e.key: e for e in [
+                ConfKey("tpumr.branch.only.knob", "int", 5, "new key"),
+            ]}
+
+
+            def lookup(key):
+                return REGISTRY.get(key)
+
+
+            def pattern_matches(p, k):
+                return False
+
+
+            def pattern_covers(p, k):
+                return False
+
+
+            def suggest(key, n=3, cutoff=4):
+                return []
+        """,
+        "tpumr/mapred/c.py": """\
+            def f(conf):
+                return conf.get_int("tpumr.branch.only.knob", 5)
+        """,
+    }
+    found = lint_files(tmp_path, files, check_conf)
+    assert [f for f in found if f.rule == "conf-key"] == []
+    # and the repo's registry keys are NOT demanded of the foreign tree
+    assert all("tpumr.heartbeat" not in f.message for f in found)
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    """A broken file must FAIL lint — an empty tree would silently
+    disable every other rule for that file."""
+    from tpumr.tools.tpulint.core import parse_error_findings
+    write_tree(tmp_path, {"tpumr/broken.py": """\
+        def broken(:
+            return time.time() + 30
+    """})
+    mods = load_corpus(str(tmp_path), ("tpumr",))
+    found = parse_error_findings(mods)
+    assert [f.rule for f in found] == ["parse-error"]
+    assert found[0].path == "tpumr/broken.py"
+
+
+def test_conf_unread_anchors_at_registry_line(tmp_path, monkeypatch):
+    """The finding must point at the _K(...) entry to delete, not at
+    line 1 of the registry."""
+    from tpumr.tools.tpulint.confcheck import _registry_source
+    mods = load_corpus(REPO_ROOT, ("tpumr",))
+    rel, lines = _registry_source(mods)
+    assert rel.endswith("core/confkeys.py")
+    assert len(lines) > 200   # every shipped entry is mapped
+    assert lines["tpumr.heartbeat.interval.ms"] > 1
+
+
+def test_speculative_reduces_parses_string_false():
+    """'-D mapred.reduce.speculative.execution=false' arrives as the
+    STRING 'false' in the job's dict conf — it must disable reduce
+    speculation (bool('false') truthiness was the old bug)."""
+    from tpumr.mapred.ids import JobID
+    from tpumr.mapred.job_in_progress import JobInProgress
+    jip = JobInProgress(
+        JobID("t", 1),
+        {"mapred.reduce.speculative.execution": "false"}, splits=[])
+    assert jip.speculative is True
+    assert jip.speculative_reduces is False
+    jip2 = JobInProgress(JobID("t", 2), {}, splits=[])
+    assert jip2.speculative_reduces is True   # follows the master switch
+
+
+# ------------------------------------------------------------- repo gate
+
+def test_repo_lints_clean():
+    """The CI contract: `tpumr lint` exits 0 on the repo itself."""
+    from tpumr.tools.tpulint.cli import main
+    assert main(["--root", REPO_ROOT]) == 0
+
+
+def test_cli_json_report(tmp_path):
+    from tpumr.tools.tpulint.cli import main
+    out = tmp_path / "findings.json"
+    rc = main(["--root", REPO_ROOT, "--rules", "conf-key",
+               "--json", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["rules"] == ["conf-key"]
+    assert report["findings"] == []
+
+
+def test_cli_unknown_rule_is_usage_error():
+    from tpumr.tools.tpulint.cli import main
+    assert main(["--rules", "no-such-rule"]) == 2
+
+
+def test_conf_doc_generation(tmp_path):
+    from tpumr.tools.tpulint.cli import write_conf_doc
+    out = tmp_path / "CONFIG.md"
+    assert write_conf_doc(REPO_ROOT, str(out)) == 0
+    text = out.read_text()
+    assert "`tpumr.heartbeat.interval.ms`" in text
+    assert "GENERATED" in text
+    # committed copy must be regenerated (the CI diff gate)
+    committed = Path(REPO_ROOT) / "docs" / "CONFIG.md"
+    assert committed.read_text() == text

@@ -64,6 +64,11 @@ Clients:
   daemonlog ...        -getlevel H:P LOGGER | -setlevel H:P LOGGER LEVEL
   rcc FILE.jr ...      compile Record I/O DDL to record classes (= bin/rcc)
   tdfsproxy -port P    read-only HTTP(S) storage gateway (= hdfsproxy)
+  lint [--json FILE] [--rules R,..] [--conf-doc [FILE]] [--list-keys]
+                       repo-native static analyzer (lock discipline,
+                       config-key registry, clock discipline, docs
+                       drift); exit 0 = clean. --conf-doc regenerates
+                       docs/CONFIG.md from tpumr/core/confkeys.py
   version              print the version
 """
 
@@ -949,7 +954,7 @@ def cmd_keys(conf, argv: list[str]) -> int:
         service = "namenode" if "-nn" in rest else "jobtracker"
         rest = [a for a in rest if a != "-nn"]
         if service == "namenode":
-            default = str(conf.get("fs.default.name", ""))
+            default = str(conf.get("fs.default.name") or "")
             if not default.startswith("tdfs://"):
                 print("-nn needs fs.default.name=tdfs://HOST:PORT",
                       file=sys.stderr)
@@ -1207,6 +1212,15 @@ def cmd_tdfsproxy(conf, argv: list[str]) -> int:
     return proxy_main(argv, conf)
 
 
+def cmd_lint(conf, argv: list[str]) -> int:
+    """Repo-native static analyzer (tpumr/tools/tpulint): proves the
+    master's lock-rank discipline, the config-key registry, monotonic-
+    clock deadline arithmetic, and docs/code drift — the invariants the
+    runtime only spot-checks on exercised paths."""
+    from tpumr.tools.tpulint.cli import main as lint_main
+    return lint_main(argv)
+
+
 def cmd_version(conf, argv: list[str]) -> int:
     print(f"tpumr {VERSION}")
     return 0
@@ -1240,6 +1254,7 @@ COMMANDS = {
     "fetchdt": cmd_fetchdt,
     "rcc": cmd_rcc,
     "tdfsproxy": cmd_tdfsproxy,
+    "lint": cmd_lint,
     "version": cmd_version,
 }
 

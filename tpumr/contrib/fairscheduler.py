@@ -72,7 +72,7 @@ class FairScheduler(HybridQueueScheduler):
         map attempts of pools above their own min share. Kills requeue the
         victims (KILLED, not FAILED — no attempt budget burned)."""
         assert self.manager is not None and self.conf is not None
-        now = time.time() if now is None else now
+        now = time.monotonic() if now is None else now
         interval = self.conf.get_int(
             "tpumr.fairscheduler.preemption.interval.ms", 1000) / 1000.0
         if now - self._last_preempt_check < interval:

@@ -88,7 +88,7 @@ def trace_enabled(conf: Any) -> bool:
     try:
         return bool(conf.get_boolean(ENABLED_KEY, False))
     except (AttributeError, TypeError, ValueError):
-        v = conf.get(ENABLED_KEY, "")
+        v = conf.get(ENABLED_KEY)
         return v is True or str(v).lower() in ("true", "1")
 
 

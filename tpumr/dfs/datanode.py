@@ -252,7 +252,7 @@ class DataNode:
                 pass
             # purge streamed uploads abandoned by dead clients (their
             # temp files would otherwise live forever)
-            cutoff = time.time() - float(
+            cutoff = time.monotonic() - float(
                 self.conf.get("tdfs.upload.stale.s", 600))
             with self._lock:
                 stale = [bid for bid, up in self._uploads.items()
@@ -391,7 +391,7 @@ class DataNode:
                                            downstream[1:])
         with self._lock:
             self._uploads[block_id] = {"downstream": list(downstream),
-                                       "ts": time.time()}
+                                       "ts": time.monotonic()}
         self.store.open_stream(block_id)
 
     def write_block_chunk(self, block_id: int, data: bytes) -> None:
@@ -403,7 +403,7 @@ class DataNode:
             self._peer(up["downstream"][0]).call("write_block_chunk",
                                                  block_id, data)
         self.store.append_stream(block_id, data)
-        up["ts"] = time.time()
+        up["ts"] = time.monotonic()
 
     def commit_block_stream(self, block_id: int) -> None:
         with self._lock:

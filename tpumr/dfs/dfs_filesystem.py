@@ -19,7 +19,7 @@ class DistributedFileSystem(FileSystem):
 
     def __init__(self, conf: Any = None, authority: str = "") -> None:
         if not authority and conf is not None:
-            authority = Path(conf.get("fs.default.name", "")).authority
+            authority = Path(conf.get("fs.default.name") or "").authority
         if not authority:
             raise ValueError("tdfs URI needs an authority (tdfs://host:port/)")
         host, port = authority.rsplit(":", 1)

@@ -22,8 +22,9 @@ class MiniDFSCluster:
     def __init__(self, num_datanodes: int = 3, conf: Any = None,
                  root: str | None = None) -> None:
         self.conf = conf or JobConf()
-        self.conf.set("tdfs.datanode.heartbeat.s",
-                      self.conf.get("tdfs.datanode.heartbeat.s", 0.2))
+        # mini clusters default to a fast heartbeat (tests wait on
+        # liveness); an explicit site value still wins
+        self.conf.set_if_unset("tdfs.datanode.heartbeat.s", 0.2)
         self.root = root or tempfile.mkdtemp(prefix="tpumr-minidfs-")
         self._own_root = root is None
         self.namenode = NameNode(f"{self.root}/name", self.conf).start()
@@ -35,8 +36,8 @@ class MiniDFSCluster:
         self._wait_active(num_datanodes)
 
     def _wait_active(self, n: int, timeout: float = 20.0) -> None:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if len(self.namenode.ns.datanodes) >= n \
                     and not self.namenode.ns.safemode:
                 return

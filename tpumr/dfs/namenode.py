@@ -1540,7 +1540,7 @@ class NameNode:
             hammering '/' (≈ dfshealth.jsp reads cached FSNamesystem
             counters, it does not run fsck per request)."""
             import time as _time
-            now = _time.time()
+            now = _time.monotonic()
             if fsck_cache["report"] is None or \
                     now - fsck_cache["ts"] > 10.0:
                 fsck_cache["report"] = self.ns.fsck("/")

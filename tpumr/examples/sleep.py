@@ -52,10 +52,10 @@ class SleepMapper(Mapper):
         # sleep in slices polling the kill flag — the model for how any
         # long single-record mapper stays preemptible (record-loop mappers
         # get the poll for free in the framework's reader)
-        deadline = time.time() + self._ms / 1000.0
-        while time.time() < deadline:
+        deadline = time.monotonic() + self._ms / 1000.0
+        while time.monotonic() < deadline:
             reporter.raise_if_aborted()
-            time.sleep(min(0.05, max(0.0, deadline - time.time())))
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
         output.collect(0, 0)
 
 

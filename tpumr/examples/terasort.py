@@ -90,7 +90,7 @@ class TeraValidateMapper(Mapper):
         self._last: bytes | None = None
         self._errors = 0
         self._out = None
-        self._ordinal = conf.get_int("tpumr.task.partition", 0)
+        self._ordinal = max(0, conf.get_int("tpumr.task.partition", -1))
 
     def map(self, key, value, output, reporter):
         self._out = output

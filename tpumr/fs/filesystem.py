@@ -118,7 +118,8 @@ class FileSystem(ABC):
     @classmethod
     def get(cls, uri: "str | Path", conf: Any = None) -> "FileSystem":
         p = Path(uri) if not isinstance(uri, Path) else uri
-        scheme = p.scheme or (conf.get("fs.default.name", "file") if conf is not None else "file")
+        scheme = p.scheme or ((conf.get("fs.default.name") or "file")
+                              if conf is not None else "file")
         scheme = Path(scheme).scheme or scheme  # allow full default URIs
         factory = cls._registry.get(scheme)
         if factory is None and scheme in cls._lazy_schemes:

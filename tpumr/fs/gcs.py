@@ -65,9 +65,9 @@ class TokenProvider:
         global _metadata_down_until
         if self._static:
             return self._static
-        if self._cached and time.time() < self._cached[1]:
+        if self._cached and time.monotonic() < self._cached[1]:
             return self._cached[0]
-        if time.time() < _metadata_down_until:
+        if time.monotonic() < _metadata_down_until:
             return None
         req = urllib.request.Request(
             _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"})
@@ -75,14 +75,14 @@ class TokenProvider:
             with urllib.request.urlopen(req, timeout=2) as resp:
                 body = json.loads(resp.read())
         except (OSError, ValueError):
-            _metadata_down_until = time.time() + _METADATA_RETRY_S
+            _metadata_down_until = time.monotonic() + _METADATA_RETRY_S
             return None
         tok = body.get("access_token")
         if not tok:
-            _metadata_down_until = time.time() + _METADATA_RETRY_S
+            _metadata_down_until = time.monotonic() + _METADATA_RETRY_S
             return None
         # refresh a minute early so a token never expires mid-request
-        self._cached = (tok, time.time() + float(
+        self._cached = (tok, time.monotonic() + float(
             body.get("expires_in", 300)) - 60)
         return tok
 

@@ -121,7 +121,9 @@ class Trash:
             name = st.path.name
             if not _CHECKPOINT_RE.match(name):
                 continue
-            if now - int(name) >= self.interval_s:
+            # checkpoint names ARE wall-clock epochs persisted on disk;
+            # ages must be judged against the same clock
+            if now - int(name) >= self.interval_s:  # tpulint: disable=clock-arith
                 self.fs.delete(st.path, recursive=True)
                 removed += 1
         return removed

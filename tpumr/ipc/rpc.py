@@ -641,7 +641,9 @@ class RpcServer:
             # freshness BEFORE any resolver lookup: needs no secret, so
             # replayed/garbage frames never trigger resolver work
             # (which may do real lookups)
-            if abs(_time.time() - ts) > AUTH_WINDOW_S:
+            # the frame timestamp comes from ANOTHER HOST: freshness
+            # is inherently a wall-clock comparison
+            if abs(_time.time() - ts) > AUTH_WINDOW_S:  # tpulint: disable=clock-arith
                 return {"id": req.get("id"),
                         "error": "RpcAuthError: stale or missing "
                                  "request timestamp (replay?)"}
@@ -840,8 +842,10 @@ class RpcServer:
                 if store is not None:
                     ok = store.check(tok) is None
                 elif self.token_stateless:
+                    # token lifetimes are absolute wall instants
+                    # minted by another daemon
                     now = _time.time()
-                    ok = tok.issue_ts - AUTH_WINDOW_S <= now <= tok.max_ts
+                    ok = tok.issue_ts - AUTH_WINDOW_S <= now <= tok.max_ts  # tpulint: disable=clock-arith
                 else:
                     ok = False
                 if not ok or req.get("user") != tok.owner:

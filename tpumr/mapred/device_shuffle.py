@@ -255,7 +255,7 @@ def run_device_reduce(conf: Any, task: Task, dense_fetch: DenseFetchFn,
     num_ranges = conf.get_int(RANGES_KEY, 1)
 
     # ---- copy phase (host, ≈ ReduceCopier.fetchOutputs)
-    t0 = time.time()
+    t0 = time.monotonic()
     key_parts, val_parts = [], []
     for m in range(task.num_maps):
         k, v = dense_fetch(m)
@@ -321,7 +321,7 @@ def run_device_reduce(conf: Any, task: Task, dense_fetch: DenseFetchFn,
     ranges_per_dev = -(-num_ranges // n_dev)
     reporter.set_status(
         f"device shuffle: {n} records over {n_dev} devices in "
-        f"{time.time() - t0:.3f}s (overflow retries seen: {overflow})")
+        f"{time.monotonic() - t0:.3f}s (overflow retries seen: {overflow})")
 
     # ---- reduce + write phase (host, range-ordered part files)
     reducer_cls = conf.get_reducer_class()

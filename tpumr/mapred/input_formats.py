@@ -365,7 +365,7 @@ class CombineFileInputFormat(FileInputFormat):
     def get_splits(self, conf, num_splits):
         files = self.list_input_files(conf)
         total = sum(st.length for _, st in files)
-        target = conf.get_int("mapred.max.split.size", 0)
+        target = conf.get_int("mapred.max.split.size", 2**63 - 1)
         if target in (0, 2**63 - 1):
             target = max(1, total // max(1, num_splits))
         splits: list[InputSplit] = []

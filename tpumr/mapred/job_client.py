@@ -60,12 +60,12 @@ class RunningJob:
 
     def wait_for_completion(self, poll_s: float = 0.2,
                             timeout: float = 3600.0) -> dict:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             st = self.status()
             if st["state"] in ("SUCCEEDED", "FAILED", "KILLED"):
                 return st
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"job {self.job_id} did not finish "
                                    f"within {timeout}s: {st}")
             time.sleep(poll_s)
@@ -83,10 +83,15 @@ class JobClient:
             # partition tolerance: a client poll rides out a master
             # restart (retry + server-side replay dedupe), so
             # wait_for_completion survives the same restarts the
-            # trackers do
+            # trackers do. The submit/poll channel gets its own retry
+            # key — wider than the daemon default (trackers fall back
+            # to the lost-master heartbeat backoff instead; a client
+            # has no such loop)
+            from tpumr.core import confkeys
             self._client = RpcClient(
                 host, int(port), secret=secret, scope=scope,
-                retries=conf.get_int("tpumr.rpc.client.retries", 3),
+                retries=confkeys.get_int(conf,
+                                         "tpumr.jobclient.rpc.retries"),
                 backoff_ms=conf.get_int("tpumr.rpc.client.backoff.ms",
                                         200))
 

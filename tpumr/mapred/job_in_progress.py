@@ -30,6 +30,7 @@ from tpumr.core.counters import Counters
 from tpumr.mapred.ids import JobID, TaskAttemptID, TaskID
 from tpumr.mapred.task import (Task, TaskPhase, TaskReport, TaskState,
                                TaskStatus)
+from tpumr.core import confkeys
 from tpumr.metrics.locks import RANK_JOB, InstrumentedRLock
 
 
@@ -158,7 +159,8 @@ class JobInProgress:
                  tracker_addr_of: Any = None) -> None:
         self.job_id = job_id
         self.conf = dict(conf_dict)
-        self.num_reduces = int(self.conf.get("mapred.reduce.tasks", 1))
+        self.num_reduces = confkeys.get_int(self.conf,
+                                            "mapred.reduce.tasks")
         self.state = JobState.RUNNING
         self.start_time = time.time()
         self.finish_time = 0.0
@@ -169,17 +171,20 @@ class JobInProgress:
         # nothing acquired under it may reach back up (scheduler → job,
         # never the reverse; asserted in debug mode)
         self.lock = InstrumentedRLock(name=f"job-{job_id}", rank=RANK_JOB)
-        self.max_map_attempts = int(self.conf.get("mapred.map.max.attempts", 4))
-        self.max_reduce_attempts = int(self.conf.get("mapred.reduce.max.attempts", 4))
+        self.max_map_attempts = confkeys.get_int(
+            self.conf, "mapred.map.max.attempts")
+        self.max_reduce_attempts = confkeys.get_int(
+            self.conf, "mapred.reduce.max.attempts")
         #: distinct reducers that must report a map attempt's output
         #: unfetchable before the master re-executes the map
         #: (≈ JobInProgress.fetchFailureNotification's
         #: MAX_FETCH_FAILURES_NOTIFICATIONS)
-        self.max_fetch_failures_per_map = int(self.conf.get(
-            "mapred.max.fetch.failures.per.map", 3))
-        self.slowstart = float(self.conf.get(
-            "mapred.reduce.slowstart.completed.maps", 0.05))
-        self.speculative = bool(self.conf.get("mapred.speculative.execution", True))
+        self.max_fetch_failures_per_map = confkeys.get_int(
+            self.conf, "mapred.max.fetch.failures.per.map")
+        self.slowstart = confkeys.get_float(
+            self.conf, "mapred.reduce.slowstart.completed.maps")
+        self.speculative = confkeys.get_boolean(
+            self.conf, "mapred.speculative.execution")
         #: lazily memoized has_kernel() answer (kernel conf is submit-fixed)
         self._has_kernel: "bool | None" = None
         # ≈ mapred.reduce.tasks.speculative.execution: reduces speculate
@@ -187,13 +192,15 @@ class JobInProgress:
         # findSpeculativeTask) — a straggling reduce ends every job, so
         # it needs the same mitigation maps get. Defaults to the global
         # switch; the dedicated key turns one side off independently.
-        self.speculative_reduces = bool(self.conf.get(
-            "mapred.reduce.speculative.execution", self.speculative))
+        spec_reduces = confkeys.get_boolean(
+            self.conf, "mapred.reduce.speculative.execution")
+        self.speculative_reduces = self.speculative \
+            if spec_reduces is None else spec_reduces
         # ≈ JobPriority (mapred/JobPriority.java) — FIFO scheduling
         # sorts by (priority, start time); mutable at runtime via
         # JobMaster.set_job_priority (hadoop job -set-priority)
         self.priority = normalize_priority(
-            self.conf.get("mapred.job.priority", "NORMAL"))
+            confkeys.get(self.conf, "mapred.job.priority"))
         self.error = ""
 
         self.maps = [TaskInProgress(TaskID(job_id, True, i), i, split=s)
@@ -250,7 +257,8 @@ class JobInProgress:
         self.finished_tpu_maps = 0
         self._cpu_time_sum = 0.0
         self._tpu_time_sum = 0.0
-        self._ewma_alpha = float(self.conf.get("tpumr.profile.ewma", 0.0))
+        self._ewma_alpha = confkeys.get_float(self.conf,
+                                              "tpumr.profile.ewma")
         self._cpu_ewma = 0.0
         self._tpu_ewma = 0.0
         # completion events for reduce fetchers (≈ TaskCompletionEvents).
@@ -494,11 +502,13 @@ class JobInProgress:
         if done == 0:
             return None
         mean = ((self._cpu_time_sum + self._tpu_time_sum) / done)
-        factor = float(self.conf.get("mapred.speculative.lag.factor", 1.5))
+        factor = confkeys.get_float(
+            self.conf, "mapred.speculative.lag.factor")
         # minimum runtime before a task can be speculated — ≈ the
         # reference's SPECULATIVE_LAG (60s); without a floor, short-task
         # jobs speculate everything instantly
-        floor = float(self.conf.get("mapred.speculative.min.runtime.s", 10.0))
+        floor = confkeys.get_float(
+            self.conf, "mapred.speculative.min.runtime.s")
         now = time.time()
         for tip in self.maps:
             if tip.state != "running":
@@ -507,7 +517,9 @@ class JobInProgress:
                 continue  # already speculated (or restarted) — one dup max
             if run_on_tpu and tip.partition in self._cpu_only_maps:
                 continue  # a demoted TIP's twin must not land on TPU
-            elapsed = now - (tip.report.start_time or now)
+            # report.start_time is a cross-host wall stamp (client-
+            # visible report field); skew only biases the heuristic
+            elapsed = now - (tip.report.start_time or now)  # tpulint: disable=clock-arith
             if elapsed <= max(floor, factor * mean):
                 continue
             attempt = tip.new_attempt()
@@ -602,10 +614,11 @@ class JobInProgress:
         """Declared per-map memory demand (mapred.job.map.memory.mb, 0 =
         undeclared) — the capacity scheduler's memory-matching input
         (≈ CapacityTaskScheduler's memory checks)."""
-        return int(self.conf.get("mapred.job.map.memory.mb", 0) or 0)
+        return confkeys.get_int(self.conf, "mapred.job.map.memory.mb")
 
     def reduce_memory_mb(self) -> int:
-        return int(self.conf.get("mapred.job.reduce.memory.mb", 0) or 0)
+        return confkeys.get_int(self.conf,
+                                "mapred.job.reduce.memory.mb")
 
     def obtain_new_reduce_task(self, host: str) -> Task | None:
         with self.lock:
@@ -642,15 +655,18 @@ class JobInProgress:
         if not self.speculative_reduces or self.finished_reduces == 0:
             return None
         mean = self._reduce_time_sum / self.finished_reduces
-        factor = float(self.conf.get("mapred.speculative.lag.factor", 1.5))
-        floor = float(self.conf.get("mapred.speculative.min.runtime.s", 10.0))
+        factor = confkeys.get_float(
+            self.conf, "mapred.speculative.lag.factor")
+        floor = confkeys.get_float(
+            self.conf, "mapred.speculative.min.runtime.s")
         now = time.time()
         for tip in self.reduces:
             if tip.state != "running":
                 continue
             if tip.next_attempt != 1:
                 continue  # already speculated (or restarted) — one dup max
-            elapsed = now - (tip.report.start_time or now)
+            # cross-host wall stamp, as in the map pass above
+            elapsed = now - (tip.report.start_time or now)  # tpulint: disable=clock-arith
             if elapsed <= max(floor, factor * mean):
                 continue
             attempt = tip.new_attempt()
@@ -1194,7 +1210,9 @@ class JobInProgress:
             self.placement_dropped += 1
             return
         self.placement_series.append(
-            (round(time.time() - self.start_time, 3),
+            # offsets from the submit WALL stamp — the same zero the
+            # history/trace timeline uses
+            (round(time.time() - self.start_time, 3),  # tpulint: disable=clock-arith
              "T" if run_on_tpu else "c"))
 
     def placement_timeline(self) -> dict:

@@ -21,49 +21,49 @@ from __future__ import annotations
 from typing import Any
 
 from tpumr.core.configuration import Configuration
+from tpumr.core import confkeys
+
+#: keys whose job-layer baseline IS the registry default — seeded from
+#: tpumr/core/confkeys.py so the generated reference (docs/CONFIG.md)
+#: and the runtime defaults can never diverge (tpumr lint guards
+#: call-site literals; this guards the resource layer). Per-key docs
+#: live in the registry. Dual slot pools ≈ reference
+#: conf/mapred-site.xml:23-33 (3 CPU + 1 GPU map slots).
+_REGISTRY_SEEDED = (
+    "mapred.reduce.tasks",
+    "mapred.map.max.attempts",
+    "mapred.reduce.max.attempts",
+    "mapred.task.timeout",
+    "io.sort.mb",
+    "io.sort.spill.percent",
+    "io.sort.factor",
+    "mapred.compress.map.output",
+    "mapred.map.output.compression.codec",
+    "mapred.min.split.size",
+    "mapred.max.split.size",
+    "mapred.tasktracker.map.cpu.tasks.maximum",
+    "mapred.tasktracker.map.tpu.tasks.maximum",
+    "mapred.tasktracker.reduce.tasks.maximum",
+    "mapred.jobtracker.map.optionalscheduling",
+    "mapred.reduce.slowstart.completed.maps",
+    "mapred.speculative.execution",
+    "mapred.job.shuffle.input.buffer.percent",
+    "mapred.job.shuffle.merge.percent",
+    "tpumr.shuffle.merge.enabled",
+    "tpumr.shuffle.parallel.copies",
+    "tpumr.tpu.attempt.retries",
+    "tpumr.tpu.job.quarantine.tips",
+    "tpumr.tpu.device.quarantine.failures",
+    "tpumr.tpu.device.probe.interval.ms",
+    "tpumr.tpu.device.probe.max.interval.ms",
+)
 
 DEFAULTS: dict[str, Any] = {
-    "mapred.reduce.tasks": 1,
-    "mapred.map.max.attempts": 4,
-    "mapred.reduce.max.attempts": 4,
-    "mapred.task.timeout": 600_000,
-    "io.sort.mb": 100,
-    "io.sort.spill.percent": 0.80,
-    "io.sort.factor": 10,
+    **{k: confkeys.default_of(k) for k in _REGISTRY_SEEDED},
+    # job-layer-only parameters consumed through this layer (no
+    # conf-getter read sites, hence no registry entry)
     "io.file.buffer.size": 65536,
-    "mapred.compress.map.output": False,
-    "mapred.map.output.compression.codec": "zlib",
-    "mapred.min.split.size": 1,
-    "mapred.max.split.size": 2**63 - 1,
     "fs.local.block.size": 32 * 1024 * 1024,
-    # dual slot pools — reference defaults conf/mapred-site.xml:23-33 are
-    # 3 CPU + 1 GPU map slots; we default tpu slots to 1 per chip at runtime
-    "mapred.tasktracker.map.cpu.tasks.maximum": 3,
-    "mapred.tasktracker.map.tpu.tasks.maximum": 1,
-    "mapred.tasktracker.reduce.tasks.maximum": 2,
-    "mapred.jobtracker.map.optionalscheduling": False,
-    "mapred.reduce.slowstart.completed.maps": 0.05,
-    "mapred.speculative.execution": True,
-    "mapred.job.shuffle.input.buffer.percent": 0.70,
-    # background in-memory shuffle merge (≈ InMemFSMergeThread): merge
-    # accumulated memory segments into one sorted disk run once they
-    # cross this fraction of the ShuffleRamManager budget
-    "mapred.job.shuffle.merge.percent": 0.66,
-    "tpumr.shuffle.merge.enabled": True,
-    "tpumr.shuffle.parallel.copies": 5,
-    # --- accelerator fault tolerance ---
-    # device/compile-classed TPU failures a TIP may accumulate before it
-    # is pinned CPU-only (its remaining attempts never land on TPU)
-    "tpumr.tpu.attempt.retries": 1,
-    # distinct TIPs failing with device-classed errors before the JOB's
-    # TPU pass is disabled outright and its TPU profile sums unwound
-    "tpumr.tpu.job.quarantine.tips": 3,
-    # consecutive device-classed failures on one physical device before
-    # the tracker quarantines it (0 disables device quarantine); the
-    # probe re-admits it (trivial jnp op, capped exponential backoff)
-    "tpumr.tpu.device.quarantine.failures": 3,
-    "tpumr.tpu.device.probe.interval.ms": 10_000,
-    "tpumr.tpu.device.probe.max.interval.ms": 300_000,
 }
 
 
@@ -105,14 +105,14 @@ class JobConf(Configuration):
 
     @property
     def num_reduce_tasks(self) -> int:
-        return self.get_int("mapred.reduce.tasks", 1)
+        return confkeys.get_int(self, "mapred.reduce.tasks")
 
     def set_num_reduce_tasks(self, n: int) -> None:
         self.set("mapred.reduce.tasks", n)
 
     @property
     def num_map_tasks_hint(self) -> int:
-        return self.get_int("mapred.map.tasks", 1)
+        return confkeys.get_int(self, "mapred.map.tasks")
 
     def set_num_map_tasks_hint(self, n: int) -> None:
         self.set("mapred.map.tasks", n)
@@ -225,36 +225,40 @@ class JobConf(Configuration):
 
     @property
     def max_cpu_map_slots(self) -> int:
-        return self.get_int("mapred.tasktracker.map.cpu.tasks.maximum", 3)
+        return confkeys.get_int(
+            self, "mapred.tasktracker.map.cpu.tasks.maximum")
 
     @property
     def max_tpu_map_slots(self) -> int:
-        return self.get_int("mapred.tasktracker.map.tpu.tasks.maximum", 1)
+        return confkeys.get_int(
+            self, "mapred.tasktracker.map.tpu.tasks.maximum")
 
     @property
     def max_reduce_slots(self) -> int:
-        return self.get_int("mapred.tasktracker.reduce.tasks.maximum", 2)
+        return confkeys.get_int(
+            self, "mapred.tasktracker.reduce.tasks.maximum")
 
     @property
     def optional_scheduling(self) -> bool:
-        return self.get_boolean("mapred.jobtracker.map.optionalscheduling", False)
+        return confkeys.get_boolean(
+            self, "mapred.jobtracker.map.optionalscheduling")
 
     # ------------------------------------------------------------ sort/spill
 
     @property
     def sort_mb(self) -> int:
-        return self.get_int("io.sort.mb", 100)
+        return confkeys.get_int(self, "io.sort.mb")
 
     @property
     def spill_percent(self) -> float:
-        return self.get_float("io.sort.spill.percent", 0.80)
+        return confkeys.get_float(self, "io.sort.spill.percent")
 
     @property
     def sort_factor(self) -> int:
-        return self.get_int("io.sort.factor", 10)
+        return confkeys.get_int(self, "io.sort.factor")
 
     @property
     def compress_map_output(self) -> str:
-        if self.get_boolean("mapred.compress.map.output", False):
+        if confkeys.get_boolean(self, "mapred.compress.map.output"):
             return self.get("mapred.map.output.compression.codec", "zlib")
         return "none"

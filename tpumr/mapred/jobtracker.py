@@ -58,6 +58,7 @@ import time
 from typing import Any
 
 from tpumr.ipc.rpc import RpcServer
+from tpumr.core import confkeys
 from tpumr.mapred.history import JobHistory
 from tpumr.mapred.ids import JobID, TaskAttemptID
 from tpumr.mapred.jobconf import JobConf
@@ -190,7 +191,7 @@ class JobMaster:
         self.jobs: dict[str, JobInProgress] = {}
         from tpumr.mapred.tracker_registry import TrackerRegistry
         self.trackers = TrackerRegistry(
-            conf.get_int("tpumr.tracker.registry.shards", 16))
+            confkeys.get_int(conf, "tpumr.tracker.registry.shards"))
         #: response-replay cache: read and written LOCK-FREE (single-key
         #: dict get/set are GIL-atomic; same-tracker races are excluded
         #: by _TrackerInfo.hb_lock, and the value is an immutable tuple)
@@ -290,7 +291,7 @@ class JobMaster:
         from tpumr.metrics import MetricsSystem
         self.metrics = MetricsSystem(
             "jobtracker",
-            period_s=conf.get_int("tpumr.metrics.period.ms", 10_000) / 1000)
+            period_s=confkeys.get_int(conf, "tpumr.metrics.period.ms") / 1000)
         self._mreg = self.metrics.new_registry("jobtracker")
         def _locked(fn):
             def sample():
@@ -817,7 +818,9 @@ class JobMaster:
                     f"{st.get('count_reduce_tasks', 0)}"
                     f"/{st.get('max_reduce_slots', 0)}",
                     devices,
-                    f"{max(0.0, _time.time() - t['last_seen']):.1f}s ago",
+                    # display ages off the wall stamp kept for status
+                    # surfaces (seen_mono owns the lease deadline)
+                    f"{max(0.0, _time.time() - t['last_seen']):.1f}s ago",  # tpulint: disable=clock-arith
                     RawHtml(state + (f" — {html_escape(report)}"
                                      if report else "")),
                 ])
@@ -834,7 +837,8 @@ class JobMaster:
             import time as _time
             util = {k: self._slot_utilization(k)
                     for k in ("cpu", "tpu", "reduce")}
-            hb_ages = {n: max(0.0, _time.time() - t.last_seen)
+            # wall display ages, as on the trackers page
+            hb_ages = {n: max(0.0, _time.time() - t.last_seen)  # tpulint: disable=clock-arith
                        for n, t in self.trackers.items()}
             n_trackers = len(hb_ages)
             snaps = self.metrics.snapshot()

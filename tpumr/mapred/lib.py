@@ -389,7 +389,9 @@ class MultipleOutputs:
         if w is None:
             out_fmt = new_instance(self._conf.get_output_format(),
                                    self._conf)
-            part = self._conf.get_int("tpumr.task.partition", 0)
+            # -1 = framework never stamped a partition (off-framework
+            # use); part files then number from 0
+            part = max(0, self._conf.get_int("tpumr.task.partition", -1))
             w = self._writers[name] = out_fmt.get_record_writer(
                 self._conf, self._work_dir(), part, prefix=name)
         return OutputCollector(w.write)

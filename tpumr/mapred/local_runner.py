@@ -53,12 +53,12 @@ class LocalJobRunner:
         with LocalJobRunner._seq_lock:
             LocalJobRunner._seq += 1
             job_id = JobID("local", LocalJobRunner._seq)
-        t0 = time.time()
+        t0 = time.monotonic()
         work_root = tempfile.mkdtemp(prefix=f"tpumr-{job_id}-")
         counters = Counters()
         try:
             result = self._run(job_id, job_conf, work_root, counters)
-            result.wall_time = time.time() - t0
+            result.wall_time = time.monotonic() - t0
             return result
         finally:
             shutil.rmtree(work_root, ignore_errors=True)

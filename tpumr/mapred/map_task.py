@@ -240,7 +240,7 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
         # source (contrib.datajoin) ≈ map.input.file in the reference
         conf.set("tpumr.task.input.path", str(split.path))
     in_fmt = new_instance(conf.get_input_format(), conf)
-    t0 = time.time()
+    t0 = time.monotonic()
 
     if task.run_on_tpu:
         runner_cls = conf.get_tpu_map_runner_class()
@@ -281,7 +281,7 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
             (abort or writer.close)()
         reporter.incr_counter(BackendCounter.GROUP, backend_tasks)
         reporter.incr_counter(BackendCounter.GROUP, backend_ms,
-                              int((time.time() - t0) * 1000))
+                              int((time.monotonic() - t0) * 1000))
         return "", {}
 
     # map-side named outputs (lib.MultipleOutputs) in jobs WITH reducers
@@ -304,7 +304,7 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
             out = buffer.flush()
             reporter.incr_counter(BackendCounter.GROUP, backend_tasks)
             reporter.incr_counter(BackendCounter.GROUP, backend_ms,
-                                  int((time.time() - t0) * 1000))
+                                  int((time.monotonic() - t0) * 1000))
             return out
     else:
         buffer = MapOutputBuffer(conf, task.num_reduces, local_dir, reporter)
@@ -312,7 +312,7 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     out = buffer.flush()
     reporter.incr_counter(BackendCounter.GROUP, backend_tasks)
     reporter.incr_counter(BackendCounter.GROUP, backend_ms,
-                          int((time.time() - t0) * 1000))
+                          int((time.monotonic() - t0) * 1000))
     return out
 
 

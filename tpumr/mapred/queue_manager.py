@@ -89,7 +89,7 @@ class QueueManager:
         self.queue_names = [q.strip() for q in names.split(",") if q.strip()]
         self.acls_enabled = bool(conf.get_boolean(ACLS_ENABLED_KEY, False)) \
             if hasattr(conf, "get_boolean") else \
-            str(conf.get(ACLS_ENABLED_KEY, "false")).lower() == "true"
+            str(conf.get(ACLS_ENABLED_KEY) or "").lower() == "true"
         # Queue EXISTENCE is enforced whenever the operator configured
         # mapred.queue.names explicitly, AND always once ACLs are on —
         # an ACL regime over phantom queues (each defaulting to open

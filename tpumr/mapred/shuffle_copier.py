@@ -55,6 +55,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator
 
 from tpumr.core.counters import TaskCounter
+from tpumr.core import confkeys
 from tpumr.io import ifile
 
 #: source protocol: fetch_chunk(map_index, partition, offset) -> dict with
@@ -303,7 +304,7 @@ class ShuffleMergeManager:
         self.spill_dir = spill_dir
         self.reporter = reporter
         self._trace_ctx = trace_ctx
-        pct = conf.get_float("mapred.job.shuffle.merge.percent", 0.66)
+        pct = confkeys.get_float(conf, "mapred.job.shuffle.merge.percent")
         self.threshold = max(1, int(ram.budget * pct))
         get_cmp = getattr(conf, "get_output_key_comparator", None)
         self._sort_key = (get_cmp().sort_key if get_cmp is not None
@@ -507,25 +508,26 @@ class ShuffleCopier:
         #: None (local/legacy sources) a persistently failing fetch is
         #: terminal after the local retries, as before.
         self.on_fetch_failure = on_fetch_failure
-        self.parallel = max(1, conf.get_int("tpumr.shuffle.parallel.copies",
-                                            5))
-        ram_mb = conf.get_float("tpumr.shuffle.ram.mb", 128.0)
-        pct = conf.get_float("mapred.job.shuffle.input.buffer.percent", 0.70)
+        self.parallel = max(1, confkeys.get_int(
+            conf, "tpumr.shuffle.parallel.copies"))
+        ram_mb = confkeys.get_float(conf, "tpumr.shuffle.ram.mb")
+        pct = confkeys.get_float(
+            conf, "mapred.job.shuffle.input.buffer.percent")
         self.ram = ShuffleRamManager(int(ram_mb * 1024 * 1024 * pct))
-        self.retries = conf.get_int("tpumr.shuffle.copy.retries", 3)
-        self.backoff_s = conf.get_float("tpumr.shuffle.copy.backoff.ms",
-                                        200.0) / 1000.0
-        self.backoff_cap_s = conf.get_float(
-            "tpumr.shuffle.copy.backoff.max.ms", 10_000.0) / 1000.0
+        self.retries = confkeys.get_int(conf, "tpumr.shuffle.copy.retries")
+        self.backoff_s = confkeys.get_float(
+            conf, "tpumr.shuffle.copy.backoff.ms") / 1000.0
+        self.backoff_cap_s = confkeys.get_float(
+            conf, "tpumr.shuffle.copy.backoff.max.ms") / 1000.0
         #: failures against ONE map location before a fetch-failure
         #: report goes up the umbilical (≈ maxFetchFailuresBeforeReporting)
-        self.retries_per_source = max(1, conf.get_int(
-            "tpumr.shuffle.fetch.retries.per.source", 3))
+        self.retries_per_source = max(1, confkeys.get_int(
+            conf, "tpumr.shuffle.fetch.retries.per.source"))
         #: hard ceiling of total failures for one map before the copy
         #: phase gives up terminally even in protocol mode — bounds a
         #: shuffle against a map the master never manages to re-run
-        self.max_fetch_failures = max(1, conf.get_int(
-            "tpumr.shuffle.fetch.max.failures", 50))
+        self.max_fetch_failures = max(1, confkeys.get_int(
+            conf, "tpumr.shuffle.fetch.max.failures"))
         self.penalty_box = PenaltyBox(self.backoff_s, self.backoff_cap_s)
         # blocked-on-location waits count as liveness for the tracker's
         # hung-task reaper: a fetcher parked in the locator's poll loop
@@ -554,14 +556,14 @@ class ShuffleCopier:
         #: background in-memory merger (≈ InMemFSMergeThread); None when
         #: disabled or pointless (no budget, single map)
         self.merger: "ShuffleMergeManager | None" = None
-        if (conf.get_boolean("tpumr.shuffle.merge.enabled", True)
+        if (confkeys.get_boolean(conf, "tpumr.shuffle.merge.enabled")
                 and self.ram.budget > 0 and num_maps >= 2):
             self.merger = ShuffleMergeManager(conf, self.ram, spill_dir,
                                               reporter, self._trace_ctx)
         #: how long a budget-starved fetcher waits for an in-flight
         #: background merge to free reservations before spilling to disk
-        self.reserve_wait_s = conf.get_float(
-            "tpumr.shuffle.merge.reserve.wait.ms", 2000.0) / 1000.0
+        self.reserve_wait_s = confkeys.get_float(
+            conf, "tpumr.shuffle.merge.reserve.wait.ms") / 1000.0
 
     # ------------------------------------------------------------ one map
 
@@ -881,9 +883,8 @@ class RemoteChunkSource:
                  locate: Callable[[int], Any]) -> None:
         self.job_id = job_id
         self.locate = locate
-        self.chunk_bytes = max(64 * 1024,
-                               conf.get_int("tpumr.shuffle.chunk.bytes",
-                                            1 << 20))
+        self.chunk_bytes = max(64 * 1024, confkeys.get_int(
+            conf, "tpumr.shuffle.chunk.bytes"))
         #: fetch-failure report seam, wired by the tracker / child so the
         #: ShuffleCopier can report a dead location up the umbilical
         self.on_fetch_failure: "Callable[[int, str], None] | None" = None

@@ -36,6 +36,7 @@ import traceback
 from typing import Any
 
 from tpumr.core.counters import Counters
+from tpumr.core import confkeys
 from tpumr.io import ifile
 from tpumr.ipc.rpc import RpcClient, RpcServer
 from tpumr.mapred.api import Reporter, TaskKilledError
@@ -259,8 +260,8 @@ class NodeRunner:
         # state handles outages longer than one call's retry budget
         self.master = RpcClient(
             master_host, master_port, secret=self._rpc_secret,
-            retries=conf.get_int("tpumr.rpc.client.retries", 1),
-            backoff_ms=conf.get_int("tpumr.rpc.client.backoff.ms", 200))
+            retries=confkeys.get_int(conf, "tpumr.rpc.client.retries"),
+            backoff_ms=confkeys.get_int(conf, "tpumr.rpc.client.backoff.ms"))
         self.master.fi_conf = conf   # rpc.drop/delay/reset chaos seams
         remote_version = self.master.call("get_protocol_version")
         if remote_version != PROTOCOL_VERSION:
@@ -299,7 +300,7 @@ class NodeRunner:
         # idle tracker's beat is a near-empty dict on the wire
         from tpumr.mapred.heartbeat import HeartbeatEncoder
         self._hb_encoder = HeartbeatEncoder(
-            conf.get_boolean("tpumr.heartbeat.delta", True))
+            confkeys.get_boolean(conf, "tpumr.heartbeat.delta"))
         #: the metrics piggyback rides at most this often (cumulative
         #: state — freshness is a seconds-scale concern, and building
         #: the typed snapshot every beat is pure overhead on fast-
@@ -379,7 +380,7 @@ class NodeRunner:
         from tpumr.metrics import MetricsSystem
         self.metrics = MetricsSystem(
             "tasktracker",
-            period_s=conf.get_int("tpumr.metrics.period.ms", 10_000) / 1000)
+            period_s=confkeys.get_int(conf, "tpumr.metrics.period.ms") / 1000)
         self._mreg = self.metrics.new_registry(self.name)
         self._mreg.set_gauge("running", lambda: dict(zip(
             ("cpu_maps", "tpu_maps", "reduces"), self._counts())))
@@ -992,7 +993,8 @@ class NodeRunner:
                 continue
             d = os.path.join(logs, job_id)
             try:
-                if now - os.path.getmtime(d) > retain_s:
+                # file mtimes are wall clock; so must the cutoff be
+                if now - os.path.getmtime(d) > retain_s:  # tpulint: disable=clock-arith
                     shutil.rmtree(d, ignore_errors=True)
             except OSError:
                 pass
@@ -1444,7 +1446,7 @@ class NodeRunner:
         """This attempt's progress timeout (job conf wins over tracker
         conf, tracker conf over the Hadoop default; ≤0 disables —
         mapred.task.timeout contract)."""
-        tracker_ms = self.conf.get_int("mapred.task.timeout", 600_000)
+        tracker_ms = confkeys.get_int(self.conf, "mapred.task.timeout")
         try:
             job_id = str(TaskAttemptID.parse(aid).task.job)
         except (ValueError, IndexError):
@@ -1461,7 +1463,7 @@ class NodeRunner:
         mapred.task.timeout far below the tracker's), bounded [0.1, 5]s,
         so a tight per-job timeout is enforced near its configured
         value, not at a fixed 5 s grid."""
-        smallest = self.conf.get_int("mapred.task.timeout", 600_000)
+        smallest = confkeys.get_int(self.conf, "mapred.task.timeout")
         with self.lock:
             confs = list(self.job_confs.values())
         for jc in confs:

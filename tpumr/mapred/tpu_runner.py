@@ -227,7 +227,7 @@ class TpuMapRunner(MapRunnable):
             reporter.incr_counter(BackendCounter.GROUP,
                                   BackendCounter.TPU_DEVICE_BYTES_STAGED,
                                   pre.staged_bytes)
-            t0 = time.time()
+            t0 = time.monotonic()
             with tracing.span("tpu:window_drain", backend="tpu",
                               records=pre.num_records,
                               staged_bytes=pre.staged_bytes):
@@ -238,7 +238,7 @@ class TpuMapRunner(MapRunnable):
                         output.collect(key, value)
             reporter.set_status(
                 f"kernel {name} (pipelined window): {pre.num_records} "
-                f"records, drained in {time.time() - t0:.3f}s")
+                f"records, drained in {time.monotonic() - t0:.3f}s")
             return
 
         # device binding ≈ GPUDeviceId → cudaSetDevice
@@ -271,7 +271,7 @@ class TpuMapRunner(MapRunnable):
                               BackendCounter.TPU_DEVICE_BYTES_STAGED,
                               staged_bytes)
 
-        t0 = time.time()
+        t0 = time.monotonic()
         temperature = _compile_temperature(name, batch)
         try:
             with mreg.histogram("tpu_execute_seconds").time(), \
@@ -304,7 +304,7 @@ class TpuMapRunner(MapRunnable):
         reporter.set_status(
             f"kernel {name} on {device}: "
             f"{getattr(batch, 'num_records', 0)} records in "
-            f"{time.time() - t0:.3f}s")
+            f"{time.monotonic() - t0:.3f}s")
 
 
 def stage_batch(conf, reader, task_ctx, device=None) -> tuple[Any, bool, int]:
@@ -528,11 +528,11 @@ class CpuBatchMapRunner(MapRunnable):
                                   getattr(batch, "num_records", 0))
         reporter.incr_counter(BackendCounter.GROUP,
                               BackendCounter.CPU_BATCH_MAP_TASKS)
-        t0 = time.time()
+        t0 = time.monotonic()
         with runner_metrics().histogram("tpu_cpu_batch_seconds").time():
             for key, value in kernel.map_batch_cpu(batch, conf, task_ctx):
                 output.collect(key, value)
         reporter.set_status(
             f"cpu-batch kernel {kernel.name}: "
             f"{getattr(batch, 'num_records', 0)} records in "
-            f"{time.time() - t0:.3f}s")
+            f"{time.monotonic() - t0:.3f}s")

@@ -130,7 +130,7 @@ class ServiceAuthorizationManager:
         self.conf = conf
         self.enabled = bool(conf.get_boolean(AUTHORIZATION_KEY, False)) \
             if hasattr(conf, "get_boolean") else \
-            str(conf.get(AUTHORIZATION_KEY, "false")).lower() == "true"
+            str(conf.get(AUTHORIZATION_KEY) or "").lower() == "true"
         # parse every referenced ACL once at construction (refresh =
         # rebuild, the queue-manager pattern), so a syntax problem
         # surfaces at refresh time, not on some later request

@@ -1,3 +1,6 @@
+# tpulint: disable=clock-arith — token lifetimes are ABSOLUTE wall-clock
+# instants shared across daemons and credential files; one process's
+# monotonic clock means nothing to another.
 """Per-user signing keys + delegation tokens — verified identity.
 
 ≈ the reference's token tier (src/core/org/apache/hadoop/security/token/

@@ -1,0 +1,22 @@
+"""tpulint — the repo-native static analyzer behind ``tpumr lint``.
+
+Four rule families, each proving an invariant the runtime only
+spot-checks (see the module docstrings for the contracts):
+
+- :mod:`tpumr.tools.tpulint.lockcheck` — the master's ranked-lock
+  acquisition order and the no-blocking-under-lock rule, derived
+  interprocedurally (rank table parsed from ``tpumr/metrics/locks.py``).
+- :mod:`tpumr.tools.tpulint.confcheck` — the config-key registry
+  (``tpumr/core/confkeys.py``) as the single source of truth for
+  keys, types, and defaults.
+- :mod:`tpumr.tools.tpulint.clockcheck` — ``time.time()`` must not
+  flow into deadline/interval arithmetic (monotonic-clock discipline).
+- :mod:`tpumr.tools.tpulint.driftcheck` — docs/OPERATIONS.md metric
+  names and fault-injection seams checked against what the code
+  actually registers and fires.
+
+Per-line suppression: ``# tpulint: disable=<rule>[,<rule>...]``.
+"""
+
+from tpumr.tools.tpulint.core import ALL_RULES, Finding  # noqa: F401
+from tpumr.tools.tpulint.cli import main, run_lint  # noqa: F401

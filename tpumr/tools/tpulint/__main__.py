@@ -1,0 +1,10 @@
+"""``python -m tpumr.tools.tpulint`` — the warning-free module entry
+point (running ``.cli`` directly trips runpy's already-imported
+warning because the package __init__ re-exports it)."""
+
+import sys
+
+from tpumr.tools.tpulint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

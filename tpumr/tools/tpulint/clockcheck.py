@@ -1,0 +1,109 @@
+"""Rule family 3: clock discipline (the PR 2 sweep, un-regressable).
+
+``clock-arith``: a ``time.time()`` value flowing into comparison or
+add/subtract arithmetic inside one function. Deadlines, intervals,
+expiry checks, and backoff math must use ``time.monotonic()`` — an NTP
+step on a master mass-expires (or immortalizes) every tracker lease
+computed from wall clock. Wall clock stays legal for human-facing
+stamps (status pages, history events, trace alignment across hosts);
+those sites carry ``# tpulint: disable=clock-arith`` with the reason
+implied by the surrounding code.
+
+Detection is deliberately local (one function at a time):
+
+- a direct ``time.time()`` operand of ``+``/``-`` or a comparison;
+- a local name assigned from ``time.time()`` later used as such an
+  operand.
+
+Cross-function flows (a wall stamp stored then compared elsewhere) are
+out of scope here — storing the stamp is the legitimate use, and the
+comparing site almost always re-reads ``time.time()`` locally, which
+this rule does see.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpumr.tools.tpulint.core import Finding, Module, call_name, \
+    receiver_name
+
+_MSG = ("wall-clock time.time() used in {what} — deadline/interval "
+        "arithmetic must use time.monotonic(); if this is a "
+        "human-facing stamp, pragma it")
+
+
+def _is_walltime_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) == "time" and \
+        receiver_name(node) in ("time", "_time")
+
+
+class _Scope(ast.NodeVisitor):
+    def __init__(self, m: Module, findings: "list[Finding]") -> None:
+        self.m = m
+        self.findings = findings
+        self.tainted: set[str] = set()
+
+    # each def gets its own taint scope
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        _Scope(self.m, self.findings).generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _tainted_operand(self, node: ast.AST) -> bool:
+        if _is_walltime_call(node):
+            return True
+        return isinstance(node, ast.Name) and node.id in self.tainted
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _is_walltime_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.tainted.add(tgt.id)
+        elif isinstance(node.value, ast.IfExp):
+            # t = time.time() if cond else 0.0  — still a wall stamp
+            if _is_walltime_call(node.value.body) or \
+                    _is_walltime_call(node.value.orelse):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.tainted.add(tgt.id)
+            self.generic_visit(node)
+        else:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.tainted.discard(tgt.id)
+            self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, (ast.Add, ast.Sub)) and (
+                self._tainted_operand(node.left)
+                or self._tainted_operand(node.right)):
+            what = "'+' arithmetic" if isinstance(node.op, ast.Add) \
+                else "'-' arithmetic"
+            self.findings.append(Finding(
+                rule="clock-arith", path=self.m.rel, line=node.lineno,
+                message=_MSG.format(what=what)))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        operands = [node.left, *node.comparators]
+        if any(self._tainted_operand(o) for o in operands):
+            self.findings.append(Finding(
+                rule="clock-arith", path=self.m.rel, line=node.lineno,
+                message=_MSG.format(what="a comparison")))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, (ast.Add, ast.Sub)) and \
+                self._tainted_operand(node.value):
+            self.findings.append(Finding(
+                rule="clock-arith", path=self.m.rel, line=node.lineno,
+                message=_MSG.format(what="'+='/'-=' arithmetic")))
+        self.generic_visit(node)
+
+
+def check_clock(mods: "list[Module]") -> "list[Finding]":
+    findings: "list[Finding]" = []
+    for m in mods:
+        _Scope(m, findings).visit(m.tree)
+    return findings

@@ -1,0 +1,363 @@
+"""Rule family 2: the config-key registry, enforced.
+
+``tpumr/core/confkeys.py`` is the single source of truth for every
+configuration key the tree reads — key, type, default, one doc line.
+This pass keeps the registry and the code from drifting apart:
+
+``conf-key``
+    A typed-getter read (``conf.get*("tpumr..."...)``) of a key the
+    registry doesn't know. The finding carries edit-distance
+    suggestions, because in a dotted-string config system a typo'd key
+    silently reads the default forever (the reference shipped exactly
+    such bugs).
+
+``conf-default``
+    The same key read with different literal fallback defaults in
+    different call sites, or with a literal default that contradicts
+    the registry. Defaults live in ONE place; a second opinion in a
+    call site is a latent config fork.
+
+``conf-unread``
+    A registered key nothing in the tree reads — a knob the docs
+    promise but the code ignores.
+
+``conf-example``
+    ``conf/tpumr-site.example.toml`` names a key (active or
+    suggested-commented) the registry doesn't know.
+
+Dynamic keys (f-strings like ``f"tpumr.fi.{point}.probability"``)
+match registry entries carrying ``pattern=True`` wildcards.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+from tpumr.tools.tpulint.core import (Finding, Module, call_name,
+                                      const_str, joined_prefix,
+                                      receiver_name)
+
+
+def registry_module(root: str, mods: "list[Module] | None" = None):
+    """The confkeys module OF THE TREE BEING LINTED. Linting a foreign
+    checkout (another branch, a colleague's tree) must judge its code
+    against ITS registry, not whatever this process imported — so the
+    root's ``tpumr/core/confkeys.py`` is executed in a private module
+    namespace, with the imported module as fallback (fixture roots in
+    tests carry no registry of their own)."""
+    import types
+
+    path = os.path.join(root, "tpumr", "core", "confkeys.py")
+    src = None
+    if mods is not None:
+        for m in mods:
+            if m.rel == "tpumr/core/confkeys.py":
+                src, path = m.source, m.path
+                break
+    if src is None and os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    if src is not None:
+        import sys
+
+        ns = types.ModuleType("_tpulint_root_confkeys")
+        ns.__file__ = path
+        # dataclass processing resolves sys.modules[cls.__module__]
+        # at class-creation time — the module must be registered while
+        # its body executes
+        prev = sys.modules.get(ns.__name__)
+        sys.modules[ns.__name__] = ns
+        try:
+            exec(compile(src, path, "exec"), ns.__dict__)
+        except Exception:
+            src = None   # unexecutable registry: fall back (the file's
+        finally:         # own parse error is reported separately)
+            if prev is None:
+                sys.modules.pop(ns.__name__, None)
+            else:
+                sys.modules[ns.__name__] = prev
+        if src is not None and hasattr(ns, "REGISTRY") and \
+                hasattr(ns, "lookup"):
+            return ns
+    from tpumr.core import confkeys as fallback
+    return fallback
+
+GETTER_TYPES = {
+    "get": "str", "get_int": "int", "get_float": "float",
+    "get_boolean": "bool", "get_strings": "strings", "get_size": "size",
+    "get_class": "class",
+}
+
+#: prefixes under registry enforcement (reads of other prefixes may be
+#: registered for the generated docs, but are not required to be)
+ENFORCED_PREFIXES = ("tpumr.", "mapred.", "mapreduce.", "io.")
+
+#: receivers a plain ``.get("key")`` counts as a CONFIG read on —
+#: filters out dict lookups that happen to use dotted keys (counter
+#: groups, status dicts). Typed getters (``get_int`` …) are
+#: unambiguous and accepted on any receiver.
+CONF_RECEIVERS = {"conf", "self", "_conf", "conf_dict", "jc", "jobconf",
+                  "job_conf", "cfg", "site", "fi_conf", "confkeys"}
+
+#: helpers that read conf keys handed to them as string arguments —
+#: function name -> positional indexes carrying key names (e.g.
+#: ``read_hosts_lists(conf, "mapred.hosts", "mapred.hosts.exclude")``)
+INDIRECT_READERS = {"read_hosts_lists": (1, 2)}
+
+
+@dataclass
+class Read:
+    rel: str
+    line: int
+    key: str             # literal key, or f-string prefix for dynamic
+    dynamic: bool
+    type: str
+    default: object      # literal default or _NO_DEFAULT
+    typed: bool          # via a typed getter (not plain .get)
+
+
+_NO_DEFAULT = object()
+
+
+def _literal(node: "ast.AST | None"):
+    if node is None:
+        return _NO_DEFAULT
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.Constant):
+        return -node.operand.value
+    return _NO_DEFAULT   # computed defaults aren't literal opinions
+
+
+def _const_maps(mods: "list[Module]") \
+        -> "tuple[dict[str, dict[str, str]], dict[str, str]]":
+    """UPPER_CASE string-constant assignments, per module and globally
+    (for keys read through names like ``conf.get(ENABLED_KEY)``). A
+    name assigned different strings in different modules is dropped
+    from the global map (ambiguous across imports)."""
+    per_mod: dict[str, dict[str, str]] = {}
+    global_map: dict[str, str] = {}
+    clashed: set[str] = set()
+    for m in mods:
+        consts = per_mod.setdefault(m.name, {})
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id.isupper():
+                        consts[tgt.id] = node.value.value
+                        if tgt.id in global_map and \
+                                global_map[tgt.id] != node.value.value:
+                            clashed.add(tgt.id)
+                        global_map.setdefault(tgt.id, node.value.value)
+    for name in clashed:
+        global_map.pop(name, None)
+    return per_mod, global_map
+
+
+def _key_of(arg: ast.AST, consts: "dict[str, str]",
+            global_consts: "dict[str, str]") \
+        -> "tuple[str, bool] | None":
+    """(key, dynamic) for an argument that names a config key."""
+    key = const_str(arg)
+    if key is not None:
+        return key, False
+    if isinstance(arg, ast.JoinedStr):
+        prefix = joined_prefix(arg)
+        return (prefix, True) if prefix else None
+    if isinstance(arg, ast.Name) and arg.id.isupper():
+        val = consts.get(arg.id, global_consts.get(arg.id))
+        if val is not None:
+            return val, False
+    if isinstance(arg, ast.Attribute) and arg.attr.isupper():
+        val = global_consts.get(arg.attr)
+        if val is not None:
+            return val, False
+    return None
+
+
+def collect_reads(mods: "list[Module]") -> "list[Read]":
+    per_mod, global_consts = _const_maps(mods)
+    reads: "list[Read]" = []
+    for m in mods:
+        consts = per_mod.get(m.name, {})
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            getter = call_name(node)
+            if getter in INDIRECT_READERS:
+                for idx in INDIRECT_READERS[getter]:
+                    if idx < len(node.args):
+                        got = _key_of(node.args[idx], consts,
+                                      global_consts)
+                        if got is not None:
+                            reads.append(Read(
+                                rel=m.rel, line=node.lineno, key=got[0],
+                                dynamic=got[1], type="str",
+                                default=_NO_DEFAULT, typed=False))
+                continue
+            if getter not in GETTER_TYPES or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if getter == "get" and \
+                    receiver_name(node) not in CONF_RECEIVERS:
+                continue
+            got = _key_of(node.args[0], consts, global_consts)
+            default_idx = 1
+            if got is None and len(node.args) > 1:
+                # confkeys.get_*(conf, "key") — registry-backed readers
+                # carry the key second and no call-site default
+                got = _key_of(node.args[1], consts, global_consts)
+                default_idx = 2
+            if got is None:
+                continue
+            key, dynamic = got
+            if not re.match(r"^[a-z][A-Za-z0-9_.\-]*$",
+                            key if not dynamic else key + "x") or \
+                    "." not in key:
+                continue
+            default = _NO_DEFAULT
+            if len(node.args) > default_idx:
+                default = _literal(node.args[default_idx])
+            for kw in node.keywords:
+                if kw.arg == "default":
+                    default = _literal(kw.value)
+            reads.append(Read(rel=m.rel, line=node.lineno, key=key,
+                              dynamic=dynamic, type=GETTER_TYPES[getter],
+                              default=default, typed=getter != "get"))
+    return reads
+
+
+def _is_read(ck, entry, reads: "list[Read]") -> bool:
+    for r in reads:
+        if r.dynamic:
+            if entry.pattern and ck.pattern_covers(entry.key, r.key):
+                return True
+            continue
+        if entry.pattern:
+            if ck.pattern_matches(entry.key, r.key):
+                return True
+        elif r.key == entry.key:
+            return True
+    return False
+
+
+def _toml_keys(path: str) -> "list[tuple[str, int]]":
+    """(dotted key, line) for every active AND suggested-commented key
+    in a site-example TOML: table headers combine with quoted keys;
+    ``#"sub.key" = v`` comment lines document a knob and count."""
+    out: "list[tuple[str, int]]" = []
+    table = ""
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, start=1):
+            line = raw.strip()
+            m = re.match(r"^\[([A-Za-z0-9_.\"\-]+)\]$", line)
+            if m:
+                table = m.group(1).replace('"', "")
+                continue
+            m = re.match(r"^#?\s*\"([^\"]+)\"\s*=", line) or \
+                re.match(r"^#?\s*([A-Za-z0-9_.\-]+)\s*=\s*[^=]", line)
+            if m and not line.startswith("##"):
+                key = m.group(1)
+                if line.startswith("#") and not re.match(
+                        r"^#\s*\"", line):
+                    continue   # prose comment, not a commented key
+                out.append((f"{table}.{key}" if table else key, i))
+    return out
+
+
+def check_conf(mods: "list[Module]", root: str) -> "list[Finding]":
+    findings: "list[Finding]" = []
+    reads = collect_reads(mods)
+    ck = registry_module(root, mods)
+    registry = ck.REGISTRY
+
+    # conf-key: enforced-prefix reads must be registered
+    for r in reads:
+        if not r.key.startswith(ENFORCED_PREFIXES):
+            continue
+        if r.dynamic:
+            if not any(e.pattern and ck.pattern_covers(e.key, r.key)
+                       for e in registry.values()):
+                findings.append(Finding(
+                    rule="conf-key", path=r.rel, line=r.line,
+                    message=(f"dynamic config key '{r.key}…' matches no "
+                             f"registered pattern — add a pattern entry "
+                             f"to tpumr/core/confkeys.py")))
+            continue
+        if ck.lookup(r.key) is None:
+            hint = ck.suggest(r.key)
+            extra = f" (did you mean: {', '.join(hint)}?)" if hint else ""
+            findings.append(Finding(
+                rule="conf-key", path=r.rel, line=r.line,
+                message=(f"config key '{r.key}' is not in the registry "
+                         f"(tpumr/core/confkeys.py){extra}")))
+
+    # conf-default: literal defaults must agree across sites + registry
+    by_key: dict[str, list[Read]] = {}
+    for r in reads:
+        if not r.dynamic and r.default is not _NO_DEFAULT:
+            by_key.setdefault(r.key, []).append(r)
+    for key, sites in sorted(by_key.items()):
+        entry = ck.lookup(key)
+        distinct = {repr(s.default) for s in sites}
+        if entry is not None and not entry.pattern:
+            bad = [s for s in sites if s.default != entry.default]
+            for s in bad:
+                findings.append(Finding(
+                    rule="conf-default", path=s.rel, line=s.line,
+                    message=(f"'{key}' read with default "
+                             f"{s.default!r} but the registry says "
+                             f"{entry.default!r} — defaults live in "
+                             f"confkeys.py only")))
+        elif len(distinct) > 1:
+            where = ", ".join(f"{s.rel}:{s.line}={s.default!r}"
+                              for s in sites)
+            findings.append(Finding(
+                rule="conf-default", path=sites[0].rel,
+                line=sites[0].line,
+                message=(f"'{key}' read with conflicting defaults "
+                         f"({where}) — register it and pick one")))
+
+    # conf-unread: every registry entry must be read somewhere
+    ck_rel, ck_lines = _registry_source(mods)
+    for entry in registry.values():
+        if not _is_read(ck, entry, reads):
+            findings.append(Finding(
+                rule="conf-unread", path=ck_rel,
+                line=ck_lines.get(entry.key, 1),
+                message=(f"registered key '{entry.key}' is read "
+                         f"nowhere in tpumr/ — dead knob (remove it or "
+                         f"wire it up)")))
+
+    # conf-example: the shipped example file names only known keys
+    example = os.path.join(root, "conf", "tpumr-site.example.toml")
+    if os.path.exists(example):
+        rel = os.path.relpath(example, root).replace(os.sep, "/")
+        for key, line in _toml_keys(example):
+            if ck.lookup(key) is None:
+                findings.append(Finding(
+                    rule="conf-example", path=rel, line=line,
+                    message=(f"example conf names '{key}', which is not "
+                             f"a registered key (phantom knob)")))
+    return findings
+
+
+def _registry_source(mods: "list[Module]") \
+        -> "tuple[str, dict[str, int]]":
+    """Line of each registered key string inside confkeys.py, for
+    anchoring conf-unread findings."""
+    for m in mods:
+        if m.rel.endswith("core/confkeys.py"):
+            lines: dict[str, int] = {}
+            for i, text in enumerate(m.source.splitlines(), start=1):
+                mm = re.search(r'''_K\(["']([^"']+)["']''', text)
+                if mm:
+                    lines.setdefault(mm.group(1), i)
+            return m.rel, lines
+    return "tpumr/core/confkeys.py", {}

@@ -1,0 +1,207 @@
+"""tpulint infrastructure: corpus loading, findings, pragmas.
+
+The analyzer is repo-native: every rule encodes an invariant THIS
+codebase promises (the master's lock-rank order, the config-key
+registry, monotonic-clock deadline arithmetic, docs/code drift), not a
+general style opinion. Rules operate on stdlib ``ast`` trees — no new
+dependencies — and report :class:`Finding` rows a CLI renders as text
+or JSON.
+
+Suppression is per-rule and per-line::
+
+    deadline = time.time() + 30   # tpulint: disable=clock-arith
+
+A pragma on a comment-only line suppresses the next code line; a
+pragma in the leading comment block (before any code) suppresses the
+rule for the whole file. Pragmas are deliberately narrow — one rule
+name each (comma-separated for several) — so a disable never outlives
+the violation it excuses.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable
+
+PRAGMA_RE = re.compile(r"#\s*tpulint:\s*disable=([a-z\-*,\s]+)")
+
+#: rule families, in report order
+ALL_RULES = (
+    "parse-error",      # file failed to parse — every other rule is blind to it
+    "lock-order",       # ranked-lock acquisition violating the master's order
+    "lock-blocking",    # blocking call reachable while a ranked lock is held
+    "conf-key",         # config key read but not in the confkeys registry
+    "conf-default",     # key read with a default conflicting across sites/registry
+    "conf-unread",      # registered key nothing reads
+    "conf-example",     # example conf file key not in the registry (or phantom)
+    "clock-arith",      # time.time() flowing into deadline/interval arithmetic
+    "drift-metric",     # docs name a metric the code never registers
+    "drift-fi",         # docs/fi.py name a fault seam no call site fires
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative
+    line: int
+    message: str
+    chain: "list[str]" = field(default_factory=list)
+
+    def render(self) -> str:
+        head = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.chain:
+            head += "".join(f"\n    {hop}" for hop in self.chain)
+        return head
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "chain": list(self.chain)}
+
+
+class Pragmas:
+    """Per-file suppression table parsed from the raw source."""
+
+    def __init__(self, source: str) -> None:
+        self.line_rules: dict[int, set[str]] = {}
+        self.file_rules: set[str] = set()
+        in_header = True
+        for i, text in enumerate(source.splitlines(), start=1):
+            stripped = text.strip()
+            if in_header and stripped and not stripped.startswith("#"):
+                in_header = False
+            m = PRAGMA_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if in_header and stripped.startswith("#"):
+                self.file_rules |= rules
+            elif stripped.startswith("#"):
+                # comment-only line: the pragma governs the next line
+                self.line_rules.setdefault(i + 1, set()).update(rules)
+            else:
+                self.line_rules.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_rules or "*" in self.file_rules:
+            return True
+        rules = self.line_rules.get(line, ())
+        return rule in rules or "*" in rules
+
+
+@dataclass
+class Module:
+    """One parsed source file plus everything rules need from it."""
+
+    path: str            # absolute
+    rel: str             # repo-relative, '/'-separated
+    source: str
+    tree: ast.Module
+    pragmas: Pragmas
+    #: (lineno, message) when the file failed to parse — the tree is
+    #: then empty and every other rule is blind to the file, so the
+    #: error MUST surface as a finding of its own
+    parse_error: "tuple[int, str] | None" = None
+
+    @property
+    def name(self) -> str:
+        """Dotted module name (tpumr.mapred.jobtracker)."""
+        return self.rel[:-3].replace("/", ".").replace(".__init__", "")
+
+
+def _iter_py(root: str, subdir: str) -> Iterable[str]:
+    base = os.path.join(root, subdir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def load_corpus(root: str, subdirs: "tuple[str, ...]" = ("tpumr",)) \
+        -> "list[Module]":
+    mods: "list[Module]" = []
+    for sub in subdirs:
+        for path in _iter_py(root, sub):
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            parse_error = None
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError as e:  # a broken file is its own finding
+                tree = ast.Module(body=[], type_ignores=[])
+                parse_error = (e.lineno or 1, e.msg or "syntax error")
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            mods.append(Module(path=path, rel=rel, source=src, tree=tree,
+                               pragmas=Pragmas(src),
+                               parse_error=parse_error))
+    return mods
+
+
+def parse_error_findings(mods: "list[Module]") -> "list[Finding]":
+    return [Finding(rule="parse-error", path=m.rel,
+                    line=m.parse_error[0],
+                    message=(f"file does not parse "
+                             f"({m.parse_error[1]}) — every other rule "
+                             f"is blind to it"))
+            for m in mods if m.parse_error is not None]
+
+
+def apply_pragmas(mods: "list[Module]",
+                  findings: "list[Finding]") -> "list[Finding]":
+    by_rel = {m.rel: m for m in mods}
+    out = []
+    for f in findings:
+        m = by_rel.get(f.path)
+        if m is not None and m.pragmas.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# ------------------------------------------------------------- ast helpers
+
+
+def const_str(node: ast.AST) -> "str | None":
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def joined_prefix(node: ast.JoinedStr) -> str:
+    """Literal prefix of an f-string, up to the first interpolation."""
+    out = []
+    for part in node.values:
+        s = const_str(part)
+        if s is None:
+            break
+        out.append(s)
+    return "".join(out)
+
+
+def call_name(node: ast.Call) -> str:
+    """Rightmost name of the called thing: foo() / a.b.foo() -> 'foo'."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def receiver_name(node: ast.Call) -> str:
+    """Name of the call receiver: a.foo() -> 'a', self.b.foo() -> 'b',
+    foo() -> ''."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return ""
+    obj = fn.value
+    if isinstance(obj, ast.Name):
+        return obj.id
+    if isinstance(obj, ast.Attribute):
+        return obj.attr
+    return ""

@@ -1,0 +1,583 @@
+"""Rule family 1: the master's lock discipline, proven statically.
+
+Since the lock decomposition (PR 8) the control plane runs on five
+ranked lock classes (``tpumr/metrics/locks.py``) whose acquisition
+order is asserted only at runtime, on paths tests happen to exercise.
+This pass re-derives the invariant from source:
+
+``lock-order``
+    A ``with``-acquisition of a ranked lock whose rank is LOWER than a
+    rank already held — directly, or anywhere down an interprocedural
+    call chain (the runtime assertion only fires if the path runs).
+
+``lock-blocking``
+    A blocking operation (RPC call, socket/file I/O, ``time.sleep``,
+    ``.join()`` on a thread, ``.wait()``, subprocess waits) reachable
+    while a ranked lock is held. Ranked locks guard the heartbeat fast
+    path; one blocked holder convoys every contender (PAPERS.md "It's
+    the Critical Path!").
+
+Scope: ``tpumr/mapred/`` + ``tpumr/ipc/`` + ``tpumr/metrics/`` (where
+the ranks live). Lock identity is derived from
+``InstrumentedRLock(..., rank=...)`` assignments; the rank constants
+are parsed out of ``tpumr/metrics/locks.py`` itself so this file never
+restates the order. Unranked locks (plain ``threading.Lock``/``RLock``)
+are out of scope by design — the discipline is a contract between the
+five master lock classes, not every mutex in the tree.
+
+Heuristics, stated plainly (a repo-native analyzer can afford them):
+
+- ``self.X`` resolves through the enclosing class (and corpus bases);
+  other receivers resolve when the attribute is ranked in exactly one
+  class, or via :data:`RECEIVER_HINTS` (``jip``/``job`` are always a
+  ``JobInProgress``, etc.).
+- Calls resolve: ``self.m()`` within the class/bases;
+  ``self.attr.m()`` when ``self.attr = SomeCorpusClass(...)`` is
+  assigned anywhere in the class; ``recv.m()`` via hints; bare ``f()``
+  within the module or its corpus ``from``-imports. Unresolvable calls
+  are skipped — the rule prefers silence to noise.
+- Code inside nested ``def``/``lambda`` is NOT considered to run under
+  an enclosing ``with`` (it is deferred work); it is analyzed as its
+  own function and charged at its call sites.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tpumr.tools.tpulint.core import (Finding, Module, call_name,
+                                      receiver_name)
+
+#: receiver-variable naming conventions this codebase holds to; used
+#: only when an attribute name is ranked in more than one class
+RECEIVER_HINTS = {
+    "jip": "JobInProgress",
+    "job": "JobInProgress",
+    "info": "_TrackerInfo",
+    "tracker_info": "_TrackerInfo",
+}
+
+#: methods returning ``(ranked_lock, ...)`` tuples — the tracker
+#: registry's stripe accessor
+TUPLE_LOCK_METHODS = {"shard_of": "RANK_TRACKERS"}
+
+#: fallback rank table; overridden by whatever tpumr/metrics/locks.py
+#: actually declares when it is in the corpus
+DEFAULT_RANKS = {"RANK_TRACKER_BEAT": 5, "RANK_SCHEDULER": 10,
+                 "RANK_GLOBAL": 20, "RANK_TRACKERS": 30, "RANK_JOB": 40}
+
+_SOCKETY = ("sock", "conn", "channel")
+_THREADY = ("thread", "worker", "pumper", "_t")
+_RPC_RECEIVERS = {"client", "rpc", "proxy", "nn", "jt", "master",
+                  "umbilical", "_client"}
+_BLOCK_SOCKET_METHODS = {"recv", "recv_into", "sendall", "accept",
+                         "connect", "makefile"}
+_BLOCK_SUBPROCESS = {"run", "check_output", "check_call", "communicate"}
+
+
+@dataclass
+class FuncInfo:
+    key: str                     # module:Class.name or module:name
+    rel: str
+    node: ast.AST
+    cls: "str | None"
+    acquires: "list[tuple[int, str, int]]" = field(default_factory=list)
+    blocking: "list[tuple[str, int]]" = field(default_factory=list)
+    # (candidate keys, line, held ranks [(rank, lockname)], callee label)
+    calls: "list[tuple[tuple[str, ...], int, tuple, str]]" = \
+        field(default_factory=list)
+    direct_findings: "list[Finding]" = field(default_factory=list)
+
+
+class LockWorld:
+    """Everything the rule knows about locks, classes, and functions."""
+
+    def __init__(self, mods: "list[Module]") -> None:
+        self.mods = mods
+        self.ranks = dict(DEFAULT_RANKS)
+        # (class, attr) -> (rank, lockname); attr -> {class, ...}
+        self.class_attr_rank: dict[tuple[str, str], tuple[int, str]] = {}
+        self.attr_classes: dict[str, set[str]] = {}
+        self.bases: dict[str, list[str]] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self.methods_by_name: dict[str, list[str]] = {}
+        self.module_funcs: dict[tuple[str, str], str] = {}
+        self.imports: dict[str, dict[str, str]] = {}
+        self.class_names: set[str] = set()
+        # (class, attr) -> corpus class the attr is an instance of
+        self.attr_types: dict[tuple[str, str], str] = {}
+        for m in mods:
+            for node in m.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self.class_names.add(node.name)
+        self._collect_ranks()
+        self._collect_defs()
+
+    # -------------------------------------------------------- collection
+
+    def _collect_ranks(self) -> None:
+        for m in self.mods:
+            if not m.rel.endswith("metrics/locks.py"):
+                continue
+            for node in m.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id.startswith("RANK_") \
+                        and isinstance(node.value, ast.Constant):
+                    self.ranks[node.targets[0].id] = int(node.value.value)
+
+    def _rank_of_call(self, call: ast.Call) -> "tuple[int, str] | None":
+        if call_name(call) != "InstrumentedRLock":
+            return None
+        rank, name = 0, ""
+        for kw in call.keywords:
+            if kw.arg == "rank":
+                if isinstance(kw.value, ast.Name):
+                    rank = self.ranks.get(kw.value.id, 0)
+                elif isinstance(kw.value, ast.Constant):
+                    rank = int(kw.value.value)
+            elif kw.arg == "name":
+                if isinstance(kw.value, ast.Constant):
+                    name = str(kw.value.value)
+                elif isinstance(kw.value, ast.JoinedStr):
+                    from tpumr.tools.tpulint.core import joined_prefix
+                    name = joined_prefix(kw.value) + "*"
+        return (rank, name) if rank else None
+
+    def _lock_value(self, value: ast.AST) -> "tuple[int, str] | None":
+        """Rank of an assigned value: a ranked-lock ctor call, or a
+        list/comprehension of them (stripe arrays)."""
+        if isinstance(value, ast.Call):
+            return self._rank_of_call(value)
+        if isinstance(value, ast.ListComp) and \
+                isinstance(value.elt, ast.Call):
+            return self._rank_of_call(value.elt)
+        if isinstance(value, ast.List):
+            for elt in value.elts:
+                if isinstance(elt, ast.Call):
+                    got = self._rank_of_call(elt)
+                    if got:
+                        return got
+        return None
+
+    def _collect_defs(self) -> None:
+        for m in self.mods:
+            self.imports[m.name] = imps = {}
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        imps[alias.asname or alias.name] = \
+                            f"{node.module}:{alias.name}"
+            for node in m.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self.bases[node.name] = [
+                        b.id for b in node.bases if isinstance(b, ast.Name)]
+                    self._collect_class(m, node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    self._add_func(m, node, None)
+
+    def _collect_class(self, m: Module, cls: ast.ClassDef) -> None:
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                got = self._lock_value(node.value)
+                inst = None
+                if got is None and isinstance(node.value, ast.Call):
+                    cname = call_name(node.value)
+                    if cname in self.class_names:
+                        inst = cname
+                    elif call_name(node.value) in ("bind", "start") and \
+                            isinstance(node.value.func, ast.Attribute) and \
+                            isinstance(node.value.func.value, ast.Call) \
+                            and call_name(node.value.func.value) \
+                            in self.class_names:
+                        # self.x = Cls(...).bind(...) / .start()
+                        inst = call_name(node.value.func.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        if got:
+                            self.class_attr_rank[(cls.name, tgt.attr)] = got
+                            self.attr_classes.setdefault(
+                                tgt.attr, set()).add(cls.name)
+                        elif inst:
+                            self.attr_types[(cls.name, tgt.attr)] = inst
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(m, node, cls.name)
+
+    def _add_func(self, m: Module, node: ast.AST, cls: "str | None",
+                  prefix: str = "") -> None:
+        label = f"{cls}.{node.name}" if cls else node.name
+        if prefix:
+            label = f"{prefix}.{label}"
+        key = f"{m.name}:{label}"
+        self.funcs[key] = FuncInfo(key=key, rel=m.rel, node=node, cls=cls)
+        if cls:
+            self.methods_by_name.setdefault(node.name, []).append(key)
+        else:
+            self.module_funcs[(m.name, node.name)] = key
+            self.methods_by_name.setdefault(node.name, []).append(key)
+        # nested defs get their own (deferred-execution) summaries
+        for stmt in ast.walk(node):
+            if stmt is not node and isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    getattr(stmt, "_tpulint_seen", False) is False:
+                stmt._tpulint_seen = True  # type: ignore[attr-defined]
+                self._add_func(m, stmt, cls, prefix=node.name)
+
+    # -------------------------------------------------------- resolution
+
+    def attr_rank(self, cls: "str | None", attr: str,
+                  recv: str) -> "tuple[int, str] | None":
+        """Rank of ``recv.attr`` seen from a method of ``cls``."""
+        if recv == "self" and cls:
+            seen, stack = set(), [cls]
+            while stack:
+                c = stack.pop()
+                if c in seen:
+                    continue
+                seen.add(c)
+                got = self.class_attr_rank.get((c, attr))
+                if got:
+                    return got
+                stack.extend(self.bases.get(c, ()))
+        owners = self.attr_classes.get(attr, set())
+        if len(owners) == 1:
+            return self.class_attr_rank[(next(iter(owners)), attr)]
+        hint = RECEIVER_HINTS.get(recv)
+        if hint and (hint, attr) in self.class_attr_rank:
+            return self.class_attr_rank[(hint, attr)]
+        return None
+
+    def resolve_call(self, mod: str, cls: "str | None",
+                     call: ast.Call) -> "tuple[str, ...]":
+        name = call_name(call)
+        if not name or name.startswith("__"):
+            return ()
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            key = self.module_funcs.get((mod, name))
+            if key:
+                return (key,)
+            imp = self.imports.get(mod, {}).get(name)
+            if imp:
+                imod, iname = imp.split(":", 1)
+                key = self.module_funcs.get((imod, iname))
+                if key:
+                    return (key,)
+            return ()
+        recv = receiver_name(call)
+        if recv == "self" and cls:
+            return self._class_method(cls, name)
+        # self.attr.m() where self.attr = CorpusClass(...)
+        if isinstance(fn.value, ast.Attribute) and \
+                isinstance(fn.value.value, ast.Name) and \
+                fn.value.value.id == "self" and cls:
+            owner = self.attr_types.get((cls, fn.value.attr))
+            if owner:
+                return self._class_method(owner, name)
+            return ()
+        hint = RECEIVER_HINTS.get(recv)
+        if hint:
+            return self._class_method(hint, name)
+        return ()
+
+    def _class_method(self, cls: str, name: str) -> "tuple[str, ...]":
+        seen, stack = set(), [cls]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            for key in self.methods_by_name.get(name, ()):
+                if self.funcs[key].cls == c:
+                    return (key,)
+            stack.extend(self.bases.get(c, ()))
+        return ()
+
+
+def _blocking_kind(call: ast.Call) -> "str | None":
+    name = call_name(call)
+    recv = receiver_name(call)
+    if name == "sleep" and recv in ("", "time", "_time"):
+        return "time.sleep()"
+    if name == "call" and recv in _RPC_RECEIVERS:
+        return f"RPC {recv}.call()"
+    if name == "wait" or name == "waitpid":
+        return f"{recv or 'os'}.{name}()"
+    if name == "join" and any(h in recv.lower() for h in _THREADY):
+        return f"thread join ({recv}.join())"
+    if name in _BLOCK_SOCKET_METHODS and \
+            any(h in recv.lower() for h in _SOCKETY):
+        return f"socket {recv}.{name}()"
+    if recv == "socket" and name == "create_connection":
+        return "socket.create_connection()"
+    if recv == "subprocess" and name in _BLOCK_SUBPROCESS | {"Popen"}:
+        return f"subprocess.{name}()"
+    if name == "open" and isinstance(call.func, ast.Name):
+        return "file open()"
+    if name == "urlopen":
+        return "urllib urlopen()"
+    return None
+
+
+class _FuncScanner:
+    """Single in-order pass over one function's statements, tracking
+    the held ranked-lock stack and a local var -> rank environment."""
+
+    def __init__(self, world: LockWorld, m: Module, fi: FuncInfo) -> None:
+        self.w = world
+        self.m = m
+        self.fi = fi
+        self.env: dict[str, tuple[int, str]] = {}
+        self.held: "list[tuple[int, str, int]]" = []   # (rank, name, line)
+
+    # lock identity of an arbitrary expression, or None
+    def lock_of(self, node: ast.AST) -> "tuple[int, str] | None":
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, (ast.Name, ast.Attribute)):
+            recv = node.value.id if isinstance(node.value, ast.Name) \
+                else node.value.attr
+            return self.w.attr_rank(self.fi.cls, node.attr, recv)
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Subscript):
+            return self.lock_of(node.value)
+        if isinstance(node, ast.Call):
+            got = self.w._rank_of_call(node)
+            if got:
+                return got
+            const = TUPLE_LOCK_METHODS.get(call_name(node))
+            if const:
+                return (self.w.ranks.get(const, 0), "trackers")
+        return None
+
+    def _track_assign(self, node: ast.Assign) -> None:
+        value = node.value
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                got = self.lock_of(value)
+                if got:
+                    self.env[tgt.id] = got
+                else:
+                    self.env.pop(tgt.id, None)
+            elif isinstance(tgt, ast.Tuple) and isinstance(value, ast.Call):
+                const = TUPLE_LOCK_METHODS.get(call_name(value))
+                if const and tgt.elts and isinstance(tgt.elts[0], ast.Name):
+                    self.env[tgt.elts[0].id] = \
+                        (self.w.ranks.get(const, 0), "trackers")
+
+    def _note_calls(self, stmt: ast.stmt) -> None:
+        """Record every Call in ``stmt`` (excluding nested defs) with
+        the current held stack; record direct blocking ops."""
+        held = tuple(self.held)
+        for node in _walk_no_defs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _blocking_kind(node)
+            if kind:
+                self.fi.blocking.append((kind, node.lineno))
+                if held:
+                    top = max(held)
+                    self.fi.direct_findings.append(Finding(
+                        rule="lock-blocking", path=self.m.rel,
+                        line=node.lineno,
+                        message=(f"{kind} while holding ranked lock "
+                                 f"'{top[1]}' (rank {top[0]}) acquired at "
+                                 f"line {top[2]} — blocking under a "
+                                 f"master lock convoys every contender")))
+            cands = self.w.resolve_call(self.m.name, self.fi.cls, node)
+            if cands:
+                self.fi.calls.append(
+                    (cands, node.lineno, held, call_name(node)))
+
+    def scan(self, body: "list[ast.stmt]") -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue   # deferred execution: analyzed separately
+            if isinstance(stmt, ast.Assign):
+                self._track_assign(stmt)
+            if isinstance(stmt, ast.With):
+                self._scan_with(stmt)
+                continue
+            self._note_calls(stmt)
+            for sub in _stmt_bodies(stmt):
+                self.scan(sub)
+
+    def _scan_with(self, stmt: ast.With) -> None:
+        # the with-items' own expressions run before acquisition
+        pushed = 0
+        for item in stmt.items:
+            for node in _walk_no_defs_expr(item.context_expr):
+                if isinstance(node, ast.Call):
+                    cands = self.w.resolve_call(self.m.name, self.fi.cls,
+                                                node)
+                    if cands:
+                        self.fi.calls.append((cands, node.lineno,
+                                              tuple(self.held),
+                                              call_name(node)))
+            got = self.lock_of(item.context_expr)
+            if not got:
+                continue
+            rank, name = got
+            self.fi.acquires.append((rank, name, stmt.lineno))
+            if self.held:
+                top = max(self.held)
+                if top[0] > rank and top[1] != name:
+                    self.fi.direct_findings.append(Finding(
+                        rule="lock-order", path=self.m.rel,
+                        line=stmt.lineno,
+                        message=(f"acquiring '{name}' (rank {rank}) while "
+                                 f"holding '{top[1]}' (rank {top[0]}) — "
+                                 f"violates the master's lock order")))
+            self.held.append((rank, name, stmt.lineno))
+            pushed += 1
+        self.scan(stmt.body)
+        del self.held[len(self.held) - pushed:]
+
+
+def _stmt_bodies(stmt: ast.stmt) -> "list[list[ast.stmt]]":
+    out = []
+    for attr in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, attr, None)
+        if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+            out.append(sub)
+    for h in getattr(stmt, "handlers", ()):
+        out.append(h.body)
+    return out
+
+
+def _walk_no_defs(stmt: ast.stmt):
+    """Walk a statement's expressions without descending into control
+    bodies (scanned recursively) or nested function/class defs."""
+    todo: "list[ast.AST]" = []
+    for f, v in ast.iter_fields(stmt):
+        if f in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(v, list):
+            todo.extend(x for x in v if isinstance(x, ast.AST))
+        elif isinstance(v, ast.AST):
+            todo.append(v)
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def _walk_no_defs_expr(expr: ast.AST):
+    todo: "list[ast.AST]" = [expr]
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------- transitive pass
+
+
+class _Transitive:
+    """Memoized transitive (acquires, blocking) summaries with one
+    representative chain per entry; cycle-safe.
+
+    A summary computed while a back-edge (recursion cycle) or the depth
+    cutoff truncated some subtree is PARTIAL — memoizing it would
+    poison every later query through that function and silently hide
+    real violations (a mutually-recursive pair's acquisitions would
+    vanish for all callers). Partial results are returned but never
+    cached; a later query with a fresh stack recomputes the full set.
+    """
+
+    MAX_DEPTH = 6
+
+    def __init__(self, world: LockWorld) -> None:
+        self.w = world
+        self.memo: dict[str, tuple] = {}
+
+    def of(self, key: str, depth: int = 0,
+           stack: "frozenset[str]" = frozenset()) -> tuple:
+        """-> (acquires, blocking, truncated)."""
+        if key in self.memo:
+            return self.memo[key]
+        if key in stack or depth > self.MAX_DEPTH:
+            return ({}, {}, True)
+        fi = self.w.funcs.get(key)
+        if fi is None:
+            return ({}, {}, False)
+        acquires: dict[int, tuple] = {}
+        blocking: dict[str, tuple] = {}
+        truncated = False
+        label = _short(key)
+        for rank, name, line in fi.acquires:
+            acquires.setdefault(
+                rank, (name, (f"{label} acquires '{name}' (rank {rank}) "
+                              f"at {fi.rel}:{line}",)))
+        for kind, line in fi.blocking:
+            blocking.setdefault(
+                kind, ((f"{label} does {kind} at {fi.rel}:{line}",),))
+        for cands, line, _held, cname in fi.calls:
+            for cand in cands:
+                sub_acq, sub_blk, sub_trunc = self.of(cand, depth + 1,
+                                                      stack | {key})
+                truncated |= sub_trunc
+                hop = f"{label} calls {_short(cand)} at {fi.rel}:{line}"
+                for rank, (name, chain) in sub_acq.items():
+                    acquires.setdefault(rank, (name, (hop,) + chain))
+                for kind, (chain,) in sub_blk.items():
+                    blocking.setdefault(kind, ((hop,) + chain,))
+        result = (acquires, blocking, truncated)
+        if not truncated:
+            self.memo[key] = result
+        return result
+
+
+def _short(key: str) -> str:
+    mod, label = key.split(":", 1)
+    return f"{mod.rsplit('.', 1)[-1]}.{label}"
+
+
+def check_locks(mods: "list[Module]") -> "list[Finding]":
+    scope = [m for m in mods
+             if "/mapred/" in f"/{m.rel}" or "/ipc/" in f"/{m.rel}"
+             or "/metrics/" in f"/{m.rel}"]
+    world = LockWorld(scope)
+    by_name = {m.name: m for m in scope}
+    findings: "list[Finding]" = []
+    for key, fi in world.funcs.items():
+        m = by_name[key.split(":", 1)[0]]
+        _FuncScanner(world, m, fi).scan(fi.node.body)
+        findings.extend(fi.direct_findings)
+    trans = _Transitive(world)
+    for key, fi in world.funcs.items():
+        for cands, line, held, cname in fi.calls:
+            if not held:
+                continue
+            top = max(held)
+            for cand in cands:
+                acq, blk, _trunc = trans.of(cand)
+                for rank, (name, chain) in sorted(acq.items()):
+                    if rank < top[0] and name != top[1]:
+                        findings.append(Finding(
+                            rule="lock-order", path=fi.rel, line=line,
+                            message=(f"call to {_short(cand)}() while "
+                                     f"holding '{top[1]}' (rank "
+                                     f"{top[0]}) reaches acquisition of "
+                                     f"'{name}' (rank {rank})"),
+                            chain=list(chain)))
+                for kind, (chain,) in sorted(blk.items()):
+                    findings.append(Finding(
+                        rule="lock-blocking", path=fi.rel, line=line,
+                        message=(f"call to {_short(cand)}() while "
+                                 f"holding '{top[1]}' (rank {top[0]}) "
+                                 f"reaches {kind}"),
+                        chain=list(chain)))
+    return findings
