@@ -256,6 +256,131 @@ def bench_kmeans(rows: dict) -> tuple[float, float]:
     return t_cpu, t_warm
 
 
+# ------------------------------------------------------- kmeans pipeline
+
+
+def bench_kmeans_pipeline(rows: dict) -> None:
+    """The DAG engine's acceptance row: kmeans-10-rounds as ONE
+    pipeline submission (loop node, round barrier, per-round versioned
+    centroid files, zero cache clears) vs 10 SEQUENTIAL job
+    submissions (today's iterative driver: per-round client submit +
+    poll + clear_centroid_cache). Both run the identical per-round job
+    on the same in-process mini cluster (CPU mapper — this measures
+    control-plane and staging overhead, not kernels), and the final
+    centroids must be byte-identical. The win is the eliminated
+    per-round submit+schedule+poll overhead, reported per round."""
+    from tpumr.fs import get_filesystem
+    from tpumr.mapred.job_client import JobClient
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+    from tpumr.ops.kmeans import clear_centroid_cache, \
+        clear_pipeline_caches
+    from tpumr.pipeline import JobGraph, PipelineClient
+
+    rounds = 10
+    n = 60_000 if SMALL else 400_000
+    d, k = 8, 8
+    per_split = n // 4
+    work = tempfile.mkdtemp(prefix="tpumr-bench-kmpipe-")
+    rng = np.random.default_rng(11)
+    pts = rng.standard_normal(size=(n, d), dtype=np.float32)
+    np.save(os.path.join(work, "points.npy"), pts)
+    cents0 = rng.standard_normal(size=(k, d), dtype=np.float32)
+
+    def round_conf_dict(tag: str) -> dict:
+        return {
+            "mapred.input.dir": f"file://{work}/points.npy",
+            "mapred.output.dir": f"file://{work}/{tag}-out-r{{round}}",
+            "mapred.input.format.class":
+                "tpumr.mapred.input_formats.DenseInputFormat",
+            "tpumr.dense.split.rows": per_split,
+            "mapred.mapper.class": "tpumr.ops.kmeans.KMeansCpuMapper",
+            "mapred.reducer.class":
+                "tpumr.ops.kmeans.KMeansCentroidUpdateReducer",
+            "mapred.reduce.tasks": 1,
+            "tpumr.kmeans.centroids":
+                f"file://{work}/{tag}-cents-r{{round}}.npy",
+            "tpumr.kmeans.centroids.out":
+                f"file://{work}/{tag}-cents-r{{next_round}}.npy",
+            "mapred.reduce.slowstart.completed.maps": 0.0,
+            "mapred.speculative.execution": False,
+        }
+
+    cluster_conf = JobConf()
+    cluster_conf.set("mapred.reduce.slowstart.completed.maps", 0.0)
+    with MiniMRCluster(num_trackers=2, tpu_slots=0, cpu_slots=2,
+                       conf=cluster_conf) as c:
+        from tpumr.pipeline.graph import expand_round
+        master = c.master
+
+        def job_exec_s(job_ids: "list[str]") -> float:
+            return sum(master.jobs[j].finish_time
+                       - master.jobs[j].start_time for j in job_ids)
+
+        # --- sequential baseline: today's iterative driver shape
+        np.save(os.path.join(work, "seq-cents-r0.npy"), cents0)
+        seq_jobs: "list[str]" = []
+        t0 = time.time()
+        for r in range(rounds):
+            clear_centroid_cache()   # the per-round staleness flush the
+            # pipeline path no longer needs (versioned paths)
+            conf = c.create_job_conf()
+            for key, v in expand_round(round_conf_dict("seq"),
+                                       r).items():
+                conf.set(key, v)
+            running = JobClient(conf).submit_job(conf)
+            st = running.wait_for_completion(poll_s=0.05)
+            assert st["state"] == "SUCCEEDED", st
+            seq_jobs.append(running.job_id)
+        t_seq = time.time() - t0
+        seq_exec = job_exec_s(seq_jobs)
+
+        # --- one pipeline submission, loop node, max-rounds cutoff
+        np.save(os.path.join(work, "pipe-cents-r0.npy"), cents0)
+        g = JobGraph("bench-kmeans-pipeline")
+        g.loop("km", round_conf_dict("pipe"), max_rounds=rounds,
+               converge={"group": "KMeans",
+                         "counter": "CENTROID_SHIFT_MILLI",
+                         "op": "lt", "value": 0})   # never: fixed rounds
+        t0 = time.time()
+        running_p = PipelineClient(c.create_job_conf()).submit(g)
+        st = running_p.wait_for_completion(poll_s=0.05)
+        t_pipe = time.time() - t0
+        assert st["state"] == "SUCCEEDED", st
+        assert st["nodes"]["km"]["rounds_run"] == rounds, st
+        pipe_exec = job_exec_s(st["nodes"]["km"]["jobs"])
+        clear_pipeline_caches()   # teardown: ONE prefix-clear
+
+    fs = get_filesystem(f"file://{work}")
+    final_seq = fs.read_bytes(f"file://{work}/seq-cents-r{rounds}.npy")
+    final_pipe = fs.read_bytes(f"file://{work}/pipe-cents-r{rounds}.npy")
+    identical = final_seq == final_pipe
+
+    win = t_seq - t_pipe
+    seq_overhead = t_seq - seq_exec      # client submit+stage+poll
+    pipe_overhead = t_pipe - pipe_exec   # engine advance residual
+    log(f"[kmeans_pipeline] {rounds} rounds on {n:,} pts: sequential "
+        f"{t_seq:.2f}s (exec {seq_exec:.2f}s, overhead "
+        f"{seq_overhead:.2f}s) vs pipeline {t_pipe:.2f}s (exec "
+        f"{pipe_exec:.2f}s, overhead {pipe_overhead:.2f}s) -> win "
+        f"{win:.2f}s ({win / rounds * 1000:.0f} ms/round), "
+        f"identical={identical}")
+    rows["kmeans_pipeline_rounds"] = rounds
+    rows["kmeans_pipeline_n_points"] = n
+    rows["kmeans_pipeline_seq_10_jobs_s"] = round(t_seq, 3)
+    rows["kmeans_pipeline_one_submission_s"] = round(t_pipe, 3)
+    rows["kmeans_pipeline_seq_job_exec_s"] = round(seq_exec, 3)
+    rows["kmeans_pipeline_job_exec_s"] = round(pipe_exec, 3)
+    rows["kmeans_pipeline_seq_overhead_s"] = round(seq_overhead, 3)
+    rows["kmeans_pipeline_engine_overhead_s"] = round(pipe_overhead, 3)
+    rows["kmeans_pipeline_win_s"] = round(win, 3)
+    rows["kmeans_pipeline_win_per_round_ms"] = round(win / rounds * 1000)
+    rows["kmeans_pipeline_speedup"] = round(t_seq / t_pipe, 3)
+    rows["kmeans_pipeline_identical_output"] = identical
+    assert identical, "pipeline rounds must reproduce the sequential " \
+                      "driver's centroids byte-for-byte"
+
+
 # ------------------------------------------------------------- wordcount
 
 
@@ -1041,6 +1166,7 @@ PHASES: list = [
     ("terasort", bench_terasort, "optional", 2700),
     ("terasort_fresh", bench_terasort_fresh, "required", 1500),
     ("kmeans", bench_kmeans, "optional", 5400),
+    ("kmeans_pipeline", bench_kmeans_pipeline, "never", 1800),
     ("pi", bench_pi, "optional", 1200),
     ("matmul", bench_matmul, "optional", 1800),
     ("wordcount", bench_wordcount, "optional", 900),
@@ -1067,9 +1193,13 @@ _ROW_PREFIX = {"codecs": "codec_", "kernels": "kernel_",
 
 def phase_owns(name: str, key: str) -> bool:
     """Row-ownership predicate per phase (keys are prefix-named; the
-    one overlap is the terasort/terasort_fresh pair)."""
+    overlaps are the terasort/terasort_fresh and
+    kmeans/kmeans_pipeline pairs)."""
     if name == "terasort":
         return key.startswith("terasort_") and key != _FRESH_KEY
+    if name == "kmeans":
+        return key.startswith("kmeans_") \
+            and not key.startswith("kmeans_pipeline_")
     return key.startswith(_ROW_PREFIX.get(name, name + "_"))
 
 

@@ -1,0 +1,198 @@
+"""Pipeline restart survival (PR 11 chaos leg).
+
+THE acceptance e2e: a master SIGKILL mid-pipeline (upstream stage done,
+downstream in flight) followed by a restart with recovery on must
+finish the pipeline with byte-identical final output and WITHOUT
+re-running the completed upstream stage — its node keeps the original
+pre-restart job id, adopted terminal from history, while the in-flight
+downstream stage re-binds to its job-recovery alias.
+
+Runs both handoff modes: the dfs-staged chain, and the streamed chain
+(where the post-restart downstream maps land on the committed part-file
+fallback whenever the old master's handoff feed died with it — the
+artifact-of-record stance: the stream is an optimization, DFS is the
+truth).
+"""
+
+import json
+import os
+import time
+
+from tpumr.fs import FileSystem, get_filesystem
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.jobtracker import JobMaster
+from tpumr.mapred.mini_cluster import MiniMRCluster
+from tpumr.pipeline import JobGraph, PipelineClient
+
+PIPELINE_TRACE_OUT = "/tmp/tpumr-pipeline-trace.json"
+
+
+def _cluster_conf(tmp_path):
+    conf = JobConf()
+    conf.set("tpumr.history.dir", str(tmp_path / "history"))
+    conf.set("mapred.jobtracker.restart.recover", True)
+    conf.set("mapred.jobtracker.restart.recovery.grace.ms", 500)
+    conf.set("tpumr.heartbeat.interval.ms", 50)
+    conf.set("tpumr.tracker.expiry.ms", 60_000)
+    conf.set("tpumr.rpc.client.retries", 2)
+    conf.set("tpumr.rpc.client.backoff.ms", 50)
+    conf.set("mapred.reduce.slowstart.completed.maps", 0.0)
+    conf.set("mapred.speculative.execution", False)
+    return conf
+
+
+def _write_words(fs, path, lines=2500):
+    fs.write_bytes(path, b"".join(b"w%02d x\n" % (i % 17)
+                                  for i in range(lines)))
+
+
+def _read_parts(fs, outdir):
+    return b"".join(fs.read_bytes(st.path)
+                    for st in sorted(fs.list_status(outdir),
+                                     key=lambda s: str(s.path))
+                    if "part-" in str(st.path))
+
+
+def _chain_graph(name, inpath, middir, outdir, stream):
+    g = JobGraph(name)
+    g.node("count", {
+        "mapred.input.dir": inpath,
+        "mapred.output.dir": middir,
+        "mapred.mapper.class": "tpumr.mapred.lib.TokenCountMapper",
+        "mapred.reducer.class": "tpumr.examples.basic.LongSumReducer",
+        "mapred.reduce.tasks": 2,
+        "mapred.map.tasks": 4,
+        "mapred.output.format.class":
+            "tpumr.mapred.output_formats.SequenceFileOutputFormat",
+    })
+    emit = {
+        "mapred.output.dir": outdir,
+        "mapred.mapper.class": "tpumr.mapred.api.IdentityMapper",
+        "mapred.reduce.tasks": 0,
+    }
+    if not stream:
+        emit["mapred.input.format.class"] = \
+            "tpumr.mapred.input_formats.SequenceFileInputFormat"
+    g.node("emit", emit)
+    g.edge("count", "emit", stream=stream)
+    return g
+
+
+def _poll_status(running, deadline_s=60.0):
+    """Status poll that rides out the restart window."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            return running.status()
+        except Exception:  # noqa: BLE001 — master restarting
+            time.sleep(0.05)
+    raise TimeoutError("master never answered a pipeline status poll")
+
+
+def _wait_node(running, node, state, deadline_s=90.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        st = _poll_status(running)
+        if st["nodes"][node]["state"] == state:
+            return st
+        if st["state"] in ("FAILED", "KILLED"):
+            raise AssertionError(f"pipeline died early: {st}")
+        time.sleep(0.02)
+    raise TimeoutError(f"node {node} never reached {state}")
+
+
+def _wait_terminal(running, deadline_s=120.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        st = _poll_status(running)
+        if st["state"] in ("SUCCEEDED", "FAILED", "KILLED"):
+            return st
+        time.sleep(0.05)
+    raise TimeoutError("pipeline never finished")
+
+
+def _kill_and_restart_master(cluster):
+    """Abrupt master death (no finalization, no goodbye) + restart on
+    the same address with recovery on."""
+    host, port = cluster.master.address
+    cluster.master.stop()
+    m2 = None
+    for _ in range(200):
+        try:
+            m2 = JobMaster(cluster.conf, host=host, port=port).start()
+            break
+        except OSError:
+            time.sleep(0.05)
+    assert m2 is not None, "could not rebind the master port"
+    cluster.master = m2
+    return m2
+
+
+class TestPipelineRestartChaos:
+    def teardown_method(self):
+        FileSystem.clear_cache()
+
+    def _control(self, tmp_path, stream):
+        with MiniMRCluster(num_trackers=2, tpu_slots=0,
+                           conf=_cluster_conf(tmp_path
+                                              / "control")) as c:
+            fs = get_filesystem("mem:///")
+            _write_words(fs, "/ctl/in.txt")
+            g = _chain_graph("control", "mem:///ctl/in.txt",
+                             "mem:///ctl/mid", "mem:///ctl/out", stream)
+            st = PipelineClient(c.create_job_conf()).submit(g) \
+                .wait_for_completion(timeout=120)
+            assert st["state"] == "SUCCEEDED", st
+            return _read_parts(fs, "/ctl/out")
+
+    def _run_chaos(self, tmp_path, stream):
+        control = self._control(tmp_path, stream)
+        with MiniMRCluster(num_trackers=2, tpu_slots=0,
+                           conf=_cluster_conf(tmp_path)) as c:
+            fs = get_filesystem("mem:///")
+            _write_words(fs, "/pr/in.txt")
+            g = _chain_graph("chaos", "mem:///pr/in.txt",
+                             "mem:///pr/mid", "mem:///pr/out", stream)
+            if not stream:
+                # traced leg: the merged end-to-end pipeline trace is
+                # the CI artifact (stage jobs share the pipeline trace)
+                g.conf["tpumr.trace.enabled"] = True
+                g.conf["tpumr.trace.dir"] = str(tmp_path / "traces")
+            client = PipelineClient(c.create_job_conf())
+            running = client.submit(g)
+            pid = running.pipeline_id
+            # kill once the upstream stage SETTLED (its output is
+            # committed, the downstream stage is submitted or about to
+            # be — mid-pipeline by construction)
+            st = _wait_node(running, "count", "SUCCEEDED")
+            count_job = st["nodes"]["count"]["job_id"]
+            m2 = _kill_and_restart_master(c)
+            st = _wait_terminal(running)
+            assert st["state"] == "SUCCEEDED", st
+            # byte-identical final output vs the undisturbed chain
+            out = _read_parts(fs, "/pr/out")
+            assert out == control, "post-restart output must be " \
+                                   "byte-identical"
+            # the completed upstream stage was adopted, NEVER re-run:
+            # same single job id as before the kill, no resubmission
+            assert st["nodes"]["count"]["jobs"] == [count_job], st
+            snap = m2.metrics.snapshot()["jobtracker"]
+            assert snap.get("pipelines_recovered", 0) == 1
+            # pipeline identity is stable across the restart
+            assert m2.get_pipeline_status(pid)["state"] == "SUCCEEDED"
+            return m2, pid
+
+    def test_master_killed_mid_pipeline_dfs_chain(self, tmp_path):
+        m2, pid = self._run_chaos(tmp_path, stream=False)
+        # export the merged pipeline trace (CI artifact): the recovered
+        # pipeline keeps its trace id, so the file spans both masters
+        from tpumr.core import tracing
+        trace = m2.get_pipeline_trace(pid)
+        assert trace["spans"], "traced pipeline must have spans"
+        chrome = tracing.to_chrome_trace(trace["spans"])
+        with open(PIPELINE_TRACE_OUT, "w") as f:
+            json.dump(chrome, f)
+        assert os.path.getsize(PIPELINE_TRACE_OUT) > 0
+
+    def test_master_killed_mid_pipeline_streamed_chain(self, tmp_path):
+        self._run_chaos(tmp_path, stream=True)

@@ -59,6 +59,8 @@ Clients:
   keys SUBCMD          credentials: user-key USER | token [-nn] [-renewer R]
                        [-out FILE] | renew FILE | cancel FILE
   fetchdt TOKEN_FILE   fetch a NameNode delegation token (= keys token -nn)
+  pipeline ...         DAG-of-jobs pipelines: submit GRAPH.json [-wait] |
+                       status ID | -list | -kill ID | trace ID [-out FILE]
   queue ...            queue info: -list | -info Q [-showJobs] | -showacls
   mradmin -refreshQueues|-refreshNodes   live-reload queue ACLs / host lists
   daemonlog ...        -getlevel H:P LOGGER | -setlevel H:P LOGGER LEVEL
@@ -385,6 +387,91 @@ def cmd_job(conf, argv: list[str]) -> int:
         print(f"job {cmd}: {e}", file=sys.stderr)
         return 1
     print(f"job: unknown option {cmd}", file=sys.stderr)
+    return 255
+
+
+def cmd_pipeline(conf, argv: list[str]) -> int:
+    """DAG-of-jobs pipeline control: submit a JobGraph spec (JSON wire
+    form — nodes/edges/loop, see docs/OPERATIONS.md "Running
+    pipelines"), poll status, list, kill, or pull the merged
+    end-to-end trace."""
+    from tpumr.ipc.rpc import RpcError
+    usage = ("Usage: tpumr pipeline submit GRAPH.json [-wait] | "
+             "status ID | -list | -kill ID | trace ID [-out FILE]")
+    if not argv:
+        print(usage, file=sys.stderr)
+        return 255
+    jt = conf.get("mapred.job.tracker")
+    if not jt or jt == "local":
+        print("pipelines need -jt HOST:PORT (a cluster master)",
+              file=sys.stderr)
+        return 255
+    from tpumr.pipeline import PipelineClient
+    client = PipelineClient(conf)
+    cmd, *rest = argv
+    try:
+        if cmd == "submit":
+            if not rest:
+                print(usage, file=sys.stderr)
+                return 255
+            with open(rest[0]) as f:
+                graph = json.load(f)
+            running = client.submit(graph)
+            print(running.pipeline_id)
+            if "-wait" in rest:
+                st = running.wait_for_completion()
+                print(json.dumps(st, indent=2, default=str))
+                return 0 if st["state"] == "SUCCEEDED" else 1
+            return 0
+        if cmd in ("status", "-status"):
+            if not rest:
+                print(usage, file=sys.stderr)
+                return 255
+            print(json.dumps(client.status(rest[0]), indent=2,
+                             default=str))
+            return 0
+        if cmd == "-list":
+            for p in client.list():
+                done = sum(1 for n in p["nodes"].values()
+                           if n["state"] == "SUCCEEDED")
+                print(f"{p['pipeline_id']}\t{p['state']}"
+                      f"\t{p.get('name', '')}"
+                      f"\tstages={done}/{len(p['nodes'])}")
+            return 0
+        if cmd == "-kill":
+            if not rest:
+                print(usage, file=sys.stderr)
+                return 255
+            ok = client.running(rest[0]).kill()
+            print(f"Killed {rest[0]}" if ok
+                  else f"{rest[0]} already finished; not killed")
+            return 0 if ok else 1
+        if cmd in ("trace", "-trace"):
+            if not rest:
+                print(usage, file=sys.stderr)
+                return 255
+            from tpumr.core import tracing
+            t = client.trace(rest[0])
+            if not t["spans"]:
+                print(t.get("error") or "no spans", file=sys.stderr)
+                return 1
+            chrome = tracing.to_chrome_trace(t["spans"])
+            out = f"{rest[0]}-trace.json"
+            if "-out" in rest:
+                i = rest.index("-out") + 1
+                if i >= len(rest):
+                    print("Usage: tpumr pipeline trace ID -out FILE",
+                          file=sys.stderr)
+                    return 255
+                out = rest[i]
+            with open(out, "w") as f:
+                json.dump(chrome, f)
+            print(f"wrote {len(t['spans'])} spans to {out}")
+            return 0
+    except (RpcError, OSError, ValueError) as e:
+        print(f"pipeline {cmd}: {e}", file=sys.stderr)
+        return 1
+    print(f"pipeline: unknown option {cmd}", file=sys.stderr)
     return 255
 
 
@@ -1238,6 +1325,7 @@ COMMANDS = {
     "dfsadmin": cmd_dfsadmin,
     "fs": cmd_fs,
     "job": cmd_job,
+    "pipeline": cmd_pipeline,
     "pipes": cmd_pipes,
     "streaming": cmd_streaming,
     "distcp": cmd_distcp,

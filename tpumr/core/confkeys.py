@@ -410,6 +410,10 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "thread-per-connection)."),
     _K('tpumr.kmeans.centroids', 'str', None,
         "KMeans op: serialized centroids."),
+    _K('tpumr.kmeans.centroids.out', 'str', None,
+        "KMeans iterative driver: where the centroid-update reducer "
+        "writes the NEXT round's centroid .npy (round-templated in "
+        "pipelines, so rounds never rewrite one path)."),
     _K('tpumr.kmeans.use.pallas', 'bool', False,
         "KMeans op: use the Pallas kernel."),
     _K('tpumr.local.run.on.tpu', 'bool', False,
@@ -435,6 +439,37 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "UDP sink HOST:PORT for metrics records."),
     _K('tpumr.ops.device.cache.mb', 'int', 1024,
         "Ops-level device cache budget, MiB."),
+    _K('tpumr.pipeline.conf.hooks.allowed', 'strings', 'tpumr.',
+        "Dotted-prefix allowlist for pipeline conf_hook callables — "
+        "hooks run IN THE MASTER PROCESS, so only operator-vetted "
+        "module prefixes may execute (default: the tpumr tree)."),
+    _K('tpumr.pipeline.handoff.dir', 'str', None,
+        "Tracker-local root for streamed-handoff reduce spills (set by "
+        "the tracker; outlives job cleanup until the pipeline ends)."),
+    _K('tpumr.pipeline.handoff.poll.ms', 'int', 200,
+        "Downstream handoff reader poll period, ms (event feed + DFS "
+        "fallback probes)."),
+    _K('tpumr.pipeline.handoff.source', 'str', None,
+        "INTERNAL in-process seam: the tracker's handoff stream-source "
+        "factory object, stashed in the stage conf for thread-isolated "
+        "maps (never serialized; absent = DFS fallback only)."),
+    _K('tpumr.pipeline.handoff.timeout.ms', 'int', 600000,
+        "Bound on a downstream map waiting for one upstream partition "
+        "(stream or committed fallback) before the attempt fails."),
+    _K('tpumr.pipeline.handoff.upstream', 'str', None,
+        "Stage conf: JSON list of upstream job ids a streamed stage "
+        "fetches from (stamped by the pipeline engine)."),
+    _K('tpumr.pipeline.id', 'str', None,
+        "Stage conf: the owning pipeline id (stamped by the engine; "
+        "anchors scheduler ordering and trace parenting)."),
+    _K('tpumr.pipeline.node', 'str', None,
+        "Stage conf: the owning graph node id (stamped by the engine)."),
+    _K('tpumr.pipeline.round', 'int', 0,
+        "Stage conf: loop-node round number (stamped by the engine)."),
+    _K('tpumr.pipeline.stream.handoff', 'bool', False,
+        "Stage conf: tee this stage's reduce output into map-output "
+        "(IFile) framing served over the shuffle wire for downstream "
+        "stages (set by the engine on stream out-edges)."),
     _K('tpumr.pipes.executable', 'str', None,
         "Pipes binary URI."),
     _K('tpumr.pipes.piped.input', 'bool', True,

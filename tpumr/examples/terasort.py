@@ -227,6 +227,27 @@ def make_terasort_conf(input_path: str, output_path: str, reduces: int,
     return conf
 
 
+def pipeline_sort_hook(conf: dict, upstreams: dict) -> None:
+    """``conf_hook`` for a PIPELINE sort stage (teragen → sort →
+    validate as one graph): the range-partition sampling that
+    ``terasort()`` runs client-side between jobs needs the teragen
+    output to EXIST, so in a pipeline it runs master-side, right before
+    the sort stage submits — its input dir is already wired to the
+    upstream's committed output."""
+    jc = JobConf()
+    for k, v in conf.items():
+        jc.set(k, v)
+    reduces = int(conf.get("mapred.reduce.tasks", 1) or 1)
+    samples = sample_input(jc, num_samples=1000)
+    part_path = str(conf["mapred.output.dir"]).rstrip("/") \
+        + ".partitions"
+    write_partition_file(jc, part_path, samples, reduces)
+    for k, v in jc:
+        conf[k] = v   # PARTITION_PATH_KEY and friends
+    conf["mapred.partitioner.class"] = \
+        "tpumr.mapred.total_order.TotalOrderPartitioner"
+
+
 @register("teravalidate", "validate that terasort output is globally sorted")
 def teravalidate(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(prog="tpumr examples teravalidate")

@@ -99,6 +99,39 @@ class JobHistory:
                 out.append(submitted)
         return out
 
+    def incomplete_pipelines(self) -> "list[dict]":
+        """PIPELINE_SUBMITTED records (full graph payload) of pipelines
+        with no terminal marker, plus their replayed stage submissions
+        — the pipeline half of restart recovery. A PIPELINE_RECOVERED
+        marker does NOT finish the file: the pipeline keeps its id
+        across restarts and a second crash replays it again (stage-job
+        aliasing is the jobs' problem, handled by the caller)."""
+        import glob
+        if not self.dir:
+            return []
+        out = []
+        for path in sorted(glob.glob(os.path.join(self.dir,
+                                                  "pipe_*.jsonl"))):
+            submitted = None
+            finished = False
+            stages: "list[dict]" = []
+            for ev in self.read(path):
+                kind = ev.get("event")
+                if kind == "PIPELINE_SUBMITTED":
+                    submitted = ev
+                elif kind in ("PIPELINE_FINISHED",
+                              "PIPELINE_RECOVERY_FAILED"):
+                    finished = True
+                elif kind == "PIPELINE_STAGE_SUBMITTED":
+                    stages.append(ev)
+            if submitted is not None and not finished \
+                    and submitted.get("graph"):
+                out.append({"pipeline_id": submitted["pipeline_id"],
+                            "graph": submitted["graph"],
+                            "user": submitted.get("user", ""),
+                            "stages": stages})
+        return out
+
     def recovered_attempt_state(self, job_id: str) -> dict:
         """Replay one interrupted job's attempt-level outcome from its
         event log (≈ RecoveryManager.JobRecoveryListener walking the

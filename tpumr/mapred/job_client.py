@@ -101,15 +101,8 @@ class JobClient:
 
     def submit_job(self, job_conf: JobConf) -> RunningJob:
         assert self._client is not None, "local jobs use run_job()"
-        from tpumr.mapred.device_shuffle import prepare_device_shuffle_job
-        prepare_device_shuffle_job(job_conf)  # reduce phase → one gang task
-        in_fmt = new_instance(job_conf.get_input_format(), job_conf)
-        out_fmt = new_instance(job_conf.get_output_format(), job_conf)
-        out_fmt.check_output_specs(job_conf)
-        splits = in_fmt.get_splits(job_conf, job_conf.num_map_tasks_hint)
-        conf_dict = _wire_conf(job_conf)
-        job_id = self._client.call("submit_job", conf_dict,
-                                   [s.to_dict() for s in splits])
+        conf_dict, splits = build_submission(job_conf)
+        job_id = self._client.call("submit_job", conf_dict, splits)
         return RunningJob(self._client, job_id)
 
     def run_job(self, job_conf: JobConf) -> JobResult:
@@ -138,6 +131,30 @@ class JobClient:
 #: submitter's credential would sign DFS calls as the wrong principal)
 _CLIENT_CREDENTIAL_KEYS = ("tpumr.rpc.user.key", "tpumr.rpc.user.key.file",
                            "tpumr.rpc.token.file")
+
+
+def build_submission(job_conf: JobConf) -> "tuple[dict, list[dict]]":
+    """The submission prep shared by the CLIENT and the master-side
+    pipeline engine (one copy, or the two paths drift): device-shuffle
+    collapse, format instantiation + output-spec check, split
+    computation, and the credential-stripped wire conf. Returns
+    ``(conf_dict, split_dicts)`` ready for the submit_job RPC."""
+    from tpumr.mapred.device_shuffle import prepare_device_shuffle_job
+    prepare_device_shuffle_job(job_conf)  # reduce phase → one gang task
+    in_fmt = new_instance(job_conf.get_input_format(), job_conf)
+    out_fmt = new_instance(job_conf.get_output_format(), job_conf)
+    out_fmt.check_output_specs(job_conf)
+    splits = in_fmt.get_splits(job_conf, job_conf.num_map_tasks_hint)
+    return _wire_conf(job_conf), [s.to_dict() for s in splits]
+
+
+def scrub_credentials(conf: dict) -> dict:
+    """Drop client-local credentials from a plain conf dict — the
+    pipeline path's twin of ``_wire_conf``'s stripping (graph confs
+    land in the master's history journal and every stage job conf; an
+    impersonation secret must never ride along)."""
+    return {k: v for k, v in conf.items()
+            if k not in _CLIENT_CREDENTIAL_KEYS}
 
 
 def _wire_conf(job_conf: JobConf) -> dict[str, Any]:

@@ -271,6 +271,32 @@ class JobInProgress:
         #: map attempt -> distinct reduce attempts reporting its output
         #: unfetchable (the "too many fetch failures" ledger)
         self._fetch_failures: dict[str, set[str]] = {}
+        # --- pipeline streamed handoff (DAG engine) ---
+        #: does this stage tee reduce output into IFile framing served
+        #: over the shuffle wire for a downstream stage? Gated off for
+        #: run shapes whose trackers never REGISTER a tee (process
+        #: isolation drops the child's payload; device-shuffle reduces
+        #: bypass run_reduce_task) — announcing addresses nothing
+        #: serves would have every downstream map burn doomed fetch
+        #: RPCs until the DFS fallback appears
+        from tpumr.mapred.device_shuffle import DEVICE_SHUFFLE_KEY
+        self.stream_handoff = (
+            confkeys.get_boolean(self.conf,
+                                 "tpumr.pipeline.stream.handoff")
+            and str(self.conf.get("tpumr.task.isolation")
+                    or "thread") != "process"
+            and not bool(self.conf.get(DEVICE_SHUFFLE_KEY)))
+        #: reduce-commit announcements for downstream stages — the SAME
+        #: append-only feed class (and OBSOLETE-withdrawal dialect) the
+        #: map completion events use, with ``map_index`` carrying the
+        #: reduce PARTITION; served lock-free by
+        #: get_handoff_completion_events
+        self.handoff_events = CompletionEventFeed()
+        #: scheduler FIFO anchor: normally the submit time, but stage
+        #: jobs of a pipeline inherit the PIPELINE's submit time so a
+        #: late stage never queues behind independent jobs submitted
+        #: mid-pipeline (the master stamps it at submit)
+        self.sched_anchor = self.start_time
         # --- accelerator fault tolerance (tentpole PR 4) ---
         #: device/compile-classed failures a TIP may take before it is
         #: pinned CPU-only (≈ "how many TPU retries does a sick kernel
@@ -795,6 +821,16 @@ class JobInProgress:
             self.finished_reduces += 1
             self._reduce_time_sum += status.runtime
             self._record_runtime(status.runtime, is_map=False)
+            if self.stream_handoff:
+                # announce the committed reduce partition to downstream
+                # pipeline stages (their HandoffSplit readers poll this
+                # feed through the same MapLocator the shuffle uses)
+                self.handoff_events.append({
+                    "map_index": tip.partition,
+                    "attempt_id": str(status.attempt_id),
+                    "shuffle_addr": shuffle_addr,
+                    "status": "SUCCEEDED",
+                })
         if (self.finished_maps == len(self.maps)
                 and self.finished_reduces == len(self.reduces)):
             self.state = JobState.SUCCEEDED
@@ -1056,6 +1092,31 @@ class JobInProgress:
                 self._fail_requested.discard(aid)
         return withdrawn
 
+    def withdraw_handoff_at(self, addr: str) -> int:
+        """The tracker serving streamed-handoff reduce output at
+        ``addr`` is gone: tombstone its announcements (OBSOLETE in
+        place + appended, the PR-1 withdrawal dialect) so downstream
+        readers evict the location and fall back to the COMMITTED part
+        file — the reduce itself never re-runs for this (its DFS output
+        survived the tracker). Runs for terminal jobs too: a finished
+        upstream stage keeps serving a live pipeline. Returns the
+        number of partitions withdrawn."""
+        if not self.stream_handoff:
+            return 0
+        with self.lock:
+            # snapshot before appending tombstones: the feed grows
+            # under this very loop otherwise
+            live = [e for e in self.handoff_events
+                    if e.get("shuffle_addr") == addr
+                    and e.get("status") != "OBSOLETE"]
+            for e in live:
+                e["status"] = "OBSOLETE"
+                self.handoff_events.append({
+                    "map_index": e["map_index"],
+                    "attempt_id": e["attempt_id"],
+                    "shuffle_addr": addr, "status": "OBSOLETE"})
+        return len(live)
+
     # ------------------------------------------------------------ recovery
 
     def recover_attempts(self, state: dict, old_job_id: str) -> int:
@@ -1094,6 +1155,17 @@ class JobInProgress:
                 if idx >= len(self.reduces):
                     continue
                 self._recover_one(self.reduces[idx], rec)
+                if self.stream_handoff and rec.get("shuffle_addr"):
+                    # re-announce the surviving streamed handoff copy:
+                    # downstream readers' cursors rewind on the shorter
+                    # post-restart feed (MapLocator's starvation rewind)
+                    # and re-fold idempotently
+                    self.handoff_events.append({
+                        "map_index": idx,
+                        "attempt_id": rec["attempt_id"],
+                        "shuffle_addr": rec["shuffle_addr"],
+                        "status": "SUCCEEDED",
+                    })
                 n += 1
             if (self.finished_maps == len(self.maps)
                     and self.finished_reduces == len(self.reduces)):
@@ -1256,5 +1328,13 @@ class JobInProgress:
                 # job go CPU" answer)
                 "tpu_disabled": self.tpu_disabled,
                 "tpu_demoted_tips": len(self._cpu_only_maps),
+                # pipeline stage identity ("which stage/round is this
+                # job", the /job page's link back to its /pipeline)
+                "pipeline": str(confkeys.get(
+                    self.conf, "tpumr.pipeline.id") or ""),
+                "pipeline_node": str(confkeys.get(
+                    self.conf, "tpumr.pipeline.node") or ""),
+                "pipeline_round": confkeys.get_int(
+                    self.conf, "tpumr.pipeline.round"),
                 "error": self.error,
             }

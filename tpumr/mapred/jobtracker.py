@@ -89,6 +89,17 @@ JOBTRACKER_POLICY = {
     "get_map_completion_events": ["security.task.umbilical.protocol.acl",
                                   "security.inter.tracker.protocol.acl",
                                   "security.job.submission.protocol.acl"],
+    # pipeline surface: submission-tier for clients; trackers reach the
+    # handoff feed (downstream maps resolve upstream reduce partitions)
+    # and the purge oracle through their inter-tracker identity
+    "get_handoff_completion_events": [
+        "security.task.umbilical.protocol.acl",
+        "security.inter.tracker.protocol.acl",
+        "security.job.submission.protocol.acl"],
+    "handoff_purgeable": ["security.inter.tracker.protocol.acl",
+                          "security.job.submission.protocol.acl"],
+    "get_pipeline_status": ["security.inter.tracker.protocol.acl",
+                            "security.job.submission.protocol.acl"],
     "get_job_status": ["security.inter.tracker.protocol.acl",
                        "security.job.submission.protocol.acl"],
     "get_recovered_jobs": ["security.inter.tracker.protocol.acl",
@@ -177,7 +188,8 @@ class JobMaster:
         # registry, fold, completion feed, and scheduler each have
         # their own synchronization). Wait/hold distributions bind to
         # jt_lock_wait_seconds{lock=global} once the registry exists.
-        from tpumr.metrics.locks import (RANK_GLOBAL, RANK_SCHEDULER,
+        from tpumr.metrics.locks import (RANK_GLOBAL, RANK_PIPELINE,
+                                         RANK_SCHEDULER,
                                          InstrumentedRLock)
         self.lock = InstrumentedRLock(name="global", rank=RANK_GLOBAL)
         #: scheduler entry (before_heartbeat/assign_tasks) serializes
@@ -185,6 +197,18 @@ class JobMaster:
         #: never the reverse (asserted in debug mode, metrics/locks.py)
         self.sched_lock = InstrumentedRLock(name="scheduler",
                                             rank=RANK_SCHEDULER)
+        #: DAG-engine state lock (rank pipeline, below global: planning
+        #: reads member-job state and recording a submission both happen
+        #: under it, but every BLOCKING part of stage submission — split
+        #: computation, conf hooks, submit_job's history write — runs
+        #: outside; advancement lives in the heartbeat's DEFERRED phase
+        #: and the expiry loop, never on the fast path
+        self._pipe_lock = InstrumentedRLock(name="pipeline",
+                                            rank=RANK_PIPELINE)
+        #: pipeline table: insert-only like the job table, so the
+        #: `if self.pipelines` fast-path guard is a lock-free dict read
+        self.pipelines: dict[str, Any] = {}
+        self._next_pipe = 0
         #: INSERT-ONLY (jobs are never removed from the table), so
         #: heartbeat-path lookups read it lock-free under the GIL;
         #: writers still serialize on the global lock
@@ -252,6 +276,7 @@ class JobMaster:
                                  fast_methods={
                                      "heartbeat",
                                      "get_map_completion_events",
+                                     "get_handoff_completion_events",
                                      "get_job_status",
                                      "can_commit",
                                      "get_protocol_version",
@@ -285,6 +310,15 @@ class JobMaster:
         self._stop = threading.Event()
         self._expire_thread = threading.Thread(
             target=self._expire_loop, name="expire-trackers", daemon=True)
+        # ALL advancement runs on its own thread: stage submission can
+        # block on DFS (split listing, output checks, conf hooks), and
+        # a wedged submission must stall pipelines — never tracker
+        # eviction (the expiry loop) or heartbeats. The heartbeat
+        # deferred phase and submit_pipeline just set the wake event.
+        self._pipe_wake = threading.Event()
+        self._pipe_thread = threading.Thread(
+            target=self._pipeline_loop, name="pipeline-advance",
+            daemon=True)
 
         # instrumentation ≈ JobTrackerInstrumentation + JobTrackerMXBean:
         # backend placement is a first-class metric (SURVEY.md §5)
@@ -342,6 +376,14 @@ class JobMaster:
             "jobs_tpu_quarantined_now",
             _locked(lambda: sum(1 for j in self.jobs.values()
                                 if j.tpu_disabled)))
+        # DAG engine: running pipelines (table is insert-only; the scan
+        # is over a handful of pipelines, not jobs)
+        self._mreg.set_gauge(
+            "pipelines_running",
+            lambda: sum(1 for p in self.pipelines.values()
+                        if p.state == "RUNNING"))
+        self._mreg.set_gauge("pipelines_total",
+                             lambda: len(self.pipelines))
         self._mreg.set_gauge(
             "tpu_devices_quarantined",
             lambda: sum(
@@ -382,6 +424,9 @@ class JobMaster:
         self.sched_lock.bind(
             self._mreg.histogram("jt_lock_wait_seconds|lock=scheduler"),
             self._mreg.histogram("jt_lock_hold_seconds|lock=scheduler"))
+        self._pipe_lock.bind(
+            self._mreg.histogram("jt_lock_wait_seconds|lock=pipeline"),
+            self._mreg.histogram("jt_lock_hold_seconds|lock=pipeline"))
         self.trackers.bind(
             self._mreg.histogram("jt_lock_wait_seconds|lock=trackers"),
             self._mreg.histogram("jt_lock_hold_seconds|lock=trackers"))
@@ -468,8 +513,13 @@ class JobMaster:
         # in-flight attempts would be killed as unknown
         if self.conf.get_boolean("mapred.jobtracker.restart.recover", False):
             self._recover_jobs()
+            # pipelines recover AFTER jobs: the stage-job alias table
+            # (_recovered) must be complete before stage replay maps
+            # old ids to the resubmitted jobs
+            self._recover_pipelines()
         self._server.start()
         self._expire_thread.start()
+        self._pipe_thread.start()
         self.metrics.start()
         if self._http_port >= 0:
             self._http = self._build_http(self._http_port).start()
@@ -534,7 +584,11 @@ class JobMaster:
                           f"{ev['conf_dropped']}")
                 continue
             try:
-                new_id = self.submit_job(ev["conf"], ev["splits"])
+                # _submit_job directly: a recovered PIPELINE STAGE job
+                # must keep its pipeline stamps (the public RPC strips
+                # them from untrusted direct submissions)
+                new_id = self._submit_job(ev["conf"], ev["splits"],
+                                          verified=None)
             except Exception as e:  # noqa: BLE001 — recovery is best-effort
                 self._mreg.incr("jobs_recovery_failed")
                 self.history.task_event(old_id, "JOB_RECOVERY_FAILED",
@@ -590,6 +644,7 @@ class JobMaster:
 
     def stop(self) -> None:
         self._stop.set()
+        self._pipe_wake.set()   # unblock the advancement thread's wait
         self.metrics.stop()
         self.tracer.flush()
         if self._http is not None:
@@ -691,7 +746,8 @@ class JobMaster:
                 f"<p>{c['trackers']} trackers · slots "
                 f"{html_escape(slots_txt)} · "
                 f"{c['jobs_running']} running / {c['jobs_total']} total "
-                f"jobs</p>"
+                f"jobs · <a href='/pipelines'>"
+                f"{len(self.pipelines)} pipelines</a></p>"
                 f"<p>shuffle fault tolerance: "
                 f"{snap.get('fetch_failures_reported', 0):.0f} fetch "
                 f"failures reported · "
@@ -718,6 +774,13 @@ class JobMaster:
                      f"<p>state <b>{html_escape(st['state'])}</b>"
                      + (f" — {html_escape(st['error'])}"
                         if st.get("error") else "") + "</p>",
+                     # stage jobs link back to their pipeline
+                     (f"<p>pipeline <a href='/pipeline?id="
+                      f"{html_escape(st['pipeline'])}'>"
+                      f"{html_escape(st['pipeline'])}</a> · stage "
+                      f"{html_escape(st['pipeline_node'])} · round "
+                      f"{st['pipeline_round']}</p>"
+                      if st.get("pipeline") else ""),
                      "<p>map ", progress_bar(st["map_progress"]),
                      " reduce ", progress_bar(st["reduce_progress"]),
                      "</p>",
@@ -906,6 +969,82 @@ class JobMaster:
                      for t in sorted(gauge_rows)]))
             return "".join(parts)
 
+        # pipeline surfaces: /json/pipelines (+/json/pipeline?id=) for
+        # tooling, /pipelines + /pipeline?id= for operators, and the
+        # merged end-to-end trace of a traced pipeline
+        def pipelines_page(q: dict) -> str:
+            with self._pipe_lock:
+                rows_src = [self.pipelines[p].status_dict()
+                            for p in sorted(self.pipelines)]
+            rows = []
+            for p in rows_src:
+                state_cls = ("ok" if p["state"] == "SUCCEEDED" else
+                             "bad" if p["state"] in ("FAILED", "KILLED")
+                             else "dim")
+                done = sum(1 for n in p["nodes"].values()
+                           if n["state"] == "SUCCEEDED")
+                rows.append([
+                    RawHtml(f"<a href='/pipeline?id="
+                            f"{html_escape(p['pipeline_id'])}'>"
+                            f"{html_escape(p['pipeline_id'])}</a>"),
+                    html_escape(p.get("name", "") or "—"),
+                    RawHtml(f"<span class='{state_cls}'>"
+                            f"{html_escape(p['state'])}</span>"),
+                    f"{done}/{len(p['nodes'])}",
+                ])
+            return ("<h1>Pipelines</h1>"
+                    + (html_table(["pipeline", "name", "state",
+                                   "stages done"], rows)
+                       if rows else "<p class='dim'>none</p>"))
+
+        def pipeline_page(q: dict) -> str:
+            pid = q.get("id", "")
+            st = self.get_pipeline_status(pid)
+            rows = []
+            for nid in sorted(st["nodes"]):
+                n = st["nodes"][nid]
+                state_cls = ("ok" if n["state"] == "SUCCEEDED" else
+                             "bad" if n["state"] == "FAILED"
+                             else "dim")
+                jid = n.get("job_id", "")
+                rows.append([
+                    html_escape(nid),
+                    RawHtml(f"<span class='{state_cls}'>"
+                            f"{html_escape(n['state'])}</span>"),
+                    (RawHtml(f"<a href='/job?id={html_escape(jid)}'>"
+                             f"{html_escape(jid)}</a>") if jid else "—"),
+                    f"{n.get('rounds_run', 0)}",
+                    html_escape(n.get("output_dir", "") or "—"),
+                    html_escape(n.get("error", "") or ""),
+                ])
+            pip = self.pipelines.get(pid)
+            trace_link = (
+                f"<p><a href='/pipelinetrace?id={html_escape(pid)}'>"
+                f"end-to-end trace json</a> (chrome://tracing / "
+                f"Perfetto)</p>"
+                if pip is not None and pip.trace_id else "")
+            return (
+                f"<h1>Pipeline {html_escape(pid)}"
+                + (f" — {html_escape(st.get('name', ''))}"
+                   if st.get("name") else "") + "</h1>"
+                + f"<p>state <b>{html_escape(st['state'])}</b>"
+                + (f" — {html_escape(st['error'])}"
+                   if st.get("error") else "") + "</p>"
+                + html_table(["stage", "state", "job", "rounds",
+                              "output", "error"], rows)
+                + trace_link)
+
+        def pipelinetrace(q: dict):
+            return _tracing.to_chrome_trace(
+                self.get_pipeline_trace(q["id"])["spans"])
+
+        srv.add_json("pipelines", lambda q: self.list_pipelines())
+        srv.add_json("pipeline",
+                     lambda q: self.get_pipeline_status(q["id"]),
+                     parameterized=True)
+        srv.add_raw("pipelinetrace", pipelinetrace)
+        srv.add_page("pipelines", pipelines_page)
+        srv.add_page("pipeline", pipeline_page, parameterized=True)
         srv.add_page("index", index_page)
         srv.add_page("job", job_page, parameterized=True)
         srv.add_page("trace", trace_page, parameterized=True)
@@ -1001,19 +1140,38 @@ class JobMaster:
         return UserGroupInformation("anonymous", [])
 
     def submit_job(self, conf_dict: dict, splits: list) -> str:
+        from tpumr.ipc.rpc import current_rpc_user, current_rpc_verified
+        # the pipeline stamps are the ENGINE's to set (via _submit_job
+        # directly): a direct submission claiming a live pipeline's id
+        # would adopt its FIFO anchor (queue-jumping every job since),
+        # merge foreign spans into its trace, and ride its handoff
+        # purge lifetime — strip them at the RPC door
+        for key in ("tpumr.pipeline.id", "tpumr.pipeline.node",
+                    "tpumr.pipeline.round"):
+            conf_dict.pop(key, None)
+        verified = str(current_rpc_user()) if current_rpc_verified() \
+            else None
+        return self._submit_job(conf_dict, splits, verified)
+
+    def _submit_job(self, conf_dict: dict, splits: list,
+                    verified: "str | None") -> str:
+        """Submission core. ``verified`` is the cryptographically
+        authenticated caller, or None — pipeline STAGE submissions pass
+        None explicitly: they run on whatever thread advanced the
+        pipeline (usually a heartbeat handler, whose rpc identity is
+        the TRACKER's), and the owner-binding check already happened
+        once at submit_pipeline against the pipeline's submitter."""
         # submit-time queue validation + ACL (≈ JobTracker.submitJob →
         # QueueManager.hasAccess(SUBMIT_JOB)): rejected jobs never enter
         # any scheduler queue
-        from tpumr.ipc.rpc import current_rpc_user, current_rpc_verified
         from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
         queue = str(conf_dict.get(JOB_QUEUE_KEY, DEFAULT_QUEUE)
                     or DEFAULT_QUEUE)
         user = str(conf_dict.get("user.name", "") or "")
-        if current_rpc_verified():
+        if verified is not None:
             # the job OWNER is the authenticated caller (the reference
             # binds owner to the RPC UGI): a verified carol cannot
             # submit a job owned by alice
-            verified = str(current_rpc_user())
             if user and user != verified:
                 raise PermissionError(
                     f"authenticated user {verified!r} cannot submit a "
@@ -1045,11 +1203,30 @@ class JobMaster:
                 want_trace = False
                 conf_dict.pop(TRACE_ID_KEY, None)
                 self._mreg.incr("traces_sampled_out")
+        # the owning pipeline, when this is a stage submission: the
+        # stage job anchors its scheduler order and its trace to it
+        pipe = self.pipelines.get(
+            str(conf_dict.get("tpumr.pipeline.id") or ""))
+        pipe_id = str(conf_dict.get("tpumr.pipeline.id") or "")
         if want_trace:
-            # overwrite, never setdefault: a clone-and-rerun of a
-            # finished job's conf carries the OLD job's trace id, which
-            # would merge two jobs' spans into one file
-            conf_dict[TRACE_ID_KEY] = str(job_id)
+            if pipe is not None and pipe.trace_id:
+                # per-STAGE spans live under one pipeline root: every
+                # stage job of a traced pipeline shares the pipeline's
+                # trace id (one file, one swimlane end-to-end)
+                conf_dict[TRACE_ID_KEY] = pipe.trace_id
+            elif pipe_id and str(conf_dict.get(TRACE_ID_KEY)
+                                 or "") == pipe_id:
+                # restart recovery resubmitting a pipeline-traced
+                # stage BEFORE _recover_pipelines rebuilt the table
+                # (jobs recover first, by design): the journaled conf
+                # already carries the pipeline's trace id — keep it,
+                # so the merged trace spans both masters
+                pass
+            else:
+                # overwrite, never setdefault: a clone-and-rerun of a
+                # finished job's conf carries the OLD job's trace id,
+                # which would merge two jobs' spans into one file
+                conf_dict[TRACE_ID_KEY] = str(job_id)
             # master-conf-only tracing must still reach trackers and
             # children — they build their tracers from the JOB conf
             conf_dict[ENABLED_KEY] = True
@@ -1063,11 +1240,18 @@ class JobMaster:
         # JobInProgress construction resolves split racks (may exec the
         # topology script) — built outside the master lock
         jip = JobInProgress(job_id, conf_dict, splits)
+        if pipe is not None:
+            # FIFO anchor: every stage of one pipeline sorts at the
+            # PIPELINE's submit time, so a late stage never queues
+            # behind independent jobs submitted mid-pipeline
+            jip.sched_anchor = pipe.start_time
         if jip.trace_id:
             if not self.tracer.trace_dir:
                 self.tracer.trace_dir = trace_dir_from_conf(conf_dict)
             jip.trace_root = self.tracer.start_span(
-                "job", jip.trace_id, job_id=str(job_id),
+                "job", jip.trace_id,
+                parent=(pipe.trace_root if pipe is not None else None),
+                job_id=str(job_id),
                 job_name=str(conf_dict.get("mapred.job.name", "")))
             self.tracer.instant(
                 "job:submit", jip.trace_id, parent=jip.trace_root,
@@ -1569,6 +1753,412 @@ class JobMaster:
             raise KeyError(f"unknown job {job_id}")
         return jip
 
+    # --------------------------------------------------- RPC: pipelines
+
+    def submit_pipeline(self, graph_dict: dict) -> str:
+        """Admit one validated :class:`~tpumr.pipeline.graph.JobGraph`
+        atomically: the whole DAG lands in one RPC, the master owns
+        every stage submission from here (split computation included) —
+        an N-stage chain costs one client round trip instead of N
+        submit/poll/resubmit cycles. Source stages submit before this
+        returns, so the client's first status poll already sees them."""
+        from tpumr.ipc.rpc import current_rpc_user, current_rpc_verified
+        from tpumr.mapred.queue_manager import DEFAULT_QUEUE, JOB_QUEUE_KEY
+        from tpumr.pipeline.graph import JobGraph
+        from tpumr.pipeline.pipeline_in_progress import PipelineInProgress
+        graph = JobGraph.from_dict(dict(graph_dict or {}))
+        graph.validate()   # clients lie — reject before admitting
+        # ...and they leak: strip client-local credentials server-side
+        # too — the graph goes VERBATIM into the history journal and
+        # every stage job conf (the submit path's _wire_conf stance)
+        from tpumr.mapred.job_client import scrub_credentials
+        graph.conf = scrub_credentials(graph.conf)
+        for n in graph.nodes.values():
+            n["conf"] = scrub_credentials(n["conf"])
+        user = str(graph.conf.get("user.name", "") or "")
+        if current_rpc_verified():
+            verified = str(current_rpc_user())
+            if user and user != verified:
+                raise PermissionError(
+                    f"authenticated user {verified!r} cannot submit a "
+                    f"pipeline owned by {user!r}")
+            user = graph.conf["user.name"] = verified
+        # one submit-ACL check per distinct stage queue, up front — a
+        # stage the submitter may not queue must fail the WHOLE graph
+        # now, not strand a half-run pipeline later
+        ugi = self._acl_caller(user)
+        queues = {str(n["conf"].get(JOB_QUEUE_KEY,
+                                    graph.conf.get(JOB_QUEUE_KEY,
+                                                   DEFAULT_QUEUE))
+                      or DEFAULT_QUEUE)
+                  for n in graph.nodes.values()}
+        for q in sorted(queues):
+            self.queue_manager.check_submit(q, ugi)
+        # conf hooks execute IN THIS PROCESS at stage submit: only
+        # operator-allowlisted module prefixes may run (mapper/reducer
+        # names resolve on trackers; this is the one seam where a
+        # client string executes in the master itself)
+        allowed = [s.strip() for s in str(confkeys.get(
+            self.conf, "tpumr.pipeline.conf.hooks.allowed")
+            or "").split(",") if s.strip()]
+        for nid, n in graph.nodes.items():
+            hook = n.get("conf_hook")
+            if hook and not any(str(hook).startswith(p)
+                                for p in allowed):
+                raise PermissionError(
+                    f"node {nid!r}: conf_hook {hook!r} is not under "
+                    f"an allowed prefix ({', '.join(allowed)}) — "
+                    f"hooks run in the master; extend "
+                    f"tpumr.pipeline.conf.hooks.allowed to admit it")
+        with self.lock:
+            self._next_pipe += 1
+            pid = f"pipe_{self.cluster_id}_{self._next_pipe:04d}"
+        pip = PipelineInProgress(pid, graph, user=user)
+        # distributed tracing: ONE root for the whole pipeline; stage
+        # jobs share its trace id and parent their job roots to it, so
+        # /pipelinetrace renders submit→stage→stage end-to-end
+        from tpumr.core.tracing import (ENABLED_KEY, TRACE_ID_KEY,
+                                        trace_dir_from_conf,
+                                        trace_enabled)
+        if self._trace_all or trace_enabled(graph.conf):
+            pip.trace_id = pid
+            graph.conf[TRACE_ID_KEY] = pid
+            graph.conf[ENABLED_KEY] = True
+            sink = self.tracer.trace_dir or trace_dir_from_conf(graph.conf)
+            if sink:
+                graph.conf["tpumr.trace.dir"] = sink
+                if not self.tracer.trace_dir:
+                    self.tracer.trace_dir = sink
+            pip.trace_root = self.tracer.start_span(
+                "pipeline", pid, pipeline_id=pid,
+                pipeline_name=graph.name, nodes=len(graph.nodes))
+        with self._pipe_lock:
+            self.pipelines[pid] = pip
+        self._mreg.incr("pipelines_submitted")
+        # full graph into the journal BEFORE any stage submits: restart
+        # recovery replays submission order (≈ job_submitted's stance)
+        self.history.task_event(pid, "PIPELINE_SUBMITTED",
+                                pipeline_id=pid, user=user,
+                                graph=graph.to_dict())
+        self._advance_pipeline(pip)
+        return pid
+
+    def get_pipeline_status(self, pipeline_id: str) -> dict:
+        pip = self.pipelines.get(pipeline_id)
+        if pip is None:
+            raise KeyError(f"unknown pipeline {pipeline_id}")
+        with self._pipe_lock:
+            return pip.status_dict()
+
+    def list_pipelines(self) -> "list[dict]":
+        with self._pipe_lock:
+            return [self.pipelines[pid].status_dict()
+                    for pid in sorted(self.pipelines)]
+
+    def kill_pipeline(self, pipeline_id: str, user: str = "") -> bool:
+        """Kill the pipeline and every in-flight stage job. MODIFY
+        gate: the pipeline's submitter, or a cluster/queue
+        administrator (same ladder kill_job walks, at pipeline
+        granularity)."""
+        pip = self.pipelines.get(pipeline_id)
+        if pip is None:
+            raise KeyError(f"unknown pipeline {pipeline_id}")
+        ugi = self._acl_caller(user)
+        qm = self.queue_manager
+        if qm.acls_enabled and ugi.user != pip.user \
+                and not qm.is_admin(ugi):
+            raise PermissionError(
+                f"user {ugi.user!r} cannot kill pipeline {pipeline_id} "
+                f"(owner {pip.user!r})")
+        with self._pipe_lock:
+            was_terminal = pip.state in ("SUCCEEDED", "FAILED",
+                                         "KILLED")
+            victims = pip.kill()
+        for jid in victims:
+            jip = self.jobs.get(jid)
+            if jip is not None and jip.kill():
+                self._bump_jobs_version()
+                self._finalize_job(jip)
+        self._finish_pipeline(pip)
+        # ≈ kill_job's contract: False for an already-finished target
+        return not was_terminal
+
+    def get_handoff_completion_events(self, job_id: str,
+                                      from_index: int = 0,
+                                      max_events: int = 10_000) -> list:
+        """Streamed-handoff announcements of one upstream stage job —
+        the completion-event protocol verbatim, second feed: LOCK-FREE
+        cursor reads off the append-only ``handoff_events``, OBSOLETE
+        tombstones for withdrawn copies, alias-following lookups for
+        pre-restart stage ids."""
+        jip = self._job(job_id)
+        self._check_job_op(jip, "view")
+        events, _pending = jip.handoff_events.read(int(from_index),
+                                                   int(max_events))
+        return events
+
+    def handoff_purgeable(self, job_id: str) -> bool:
+        """May a tracker drop its streamed-handoff copies for
+        ``job_id``? Only once the OWNING PIPELINE is over — a finished
+        upstream stage keeps serving live downstream stages (job
+        cleanup must not eat the intermediates mid-pipeline). Unknown
+        jobs (recovery off, alias horizon passed) are purgeable: the
+        committed DFS artifact is the fallback truth either way."""
+        jip = self._resolve_job(job_id)
+        if jip is not None:
+            if jip.state not in JobState.TERMINAL:
+                return False
+            pid = str(jip.conf.get("tpumr.pipeline.id") or "")
+        else:
+            st = self.history.retired_job_status(job_id)
+            if st is None:
+                return True
+            pid = str((st.get("_acl_conf") or {})
+                      .get("tpumr.pipeline.id", "") or "")
+        if not pid:
+            return True
+        pip = self.pipelines.get(pid)
+        return pip is None or pip.state in ("SUCCEEDED", "FAILED",
+                                            "KILLED")
+
+    def get_pipeline_trace(self, pipeline_id: str) -> dict:
+        """The merged end-to-end trace of a traced pipeline: every
+        stage job's spans plus the pipeline root, one file (they share
+        the pipeline's trace id)."""
+        pip = self.pipelines.get(pipeline_id)
+        if pip is None:
+            raise KeyError(f"unknown pipeline {pipeline_id}")
+        from tpumr.core import tracing
+        if not pip.trace_id:
+            return {"trace_id": "", "spans": [],
+                    "error": f"pipeline {pipeline_id} was not traced"}
+        self.tracer.flush()
+        read_dir = self.tracer.trace_dir \
+            or tracing.trace_dir_from_conf(pip.graph.conf)
+        spans = tracing.read_trace_files(read_dir, pip.trace_id) \
+            if read_dir else []
+        root = pip.trace_root
+        if root is not None:
+            d = root.to_dict()
+            d["end"] = time.time()
+            d["attributes"] = {**d["attributes"], "in_flight": True}
+            spans.append(d)
+        return {"trace_id": pip.trace_id, "spans": spans}
+
+    # ------------------------------------------------ pipeline engine
+
+    def _advance_pipelines(self) -> None:
+        """One advancement sweep over the running pipelines. Called
+        from the heartbeat's DEFERRED phase and the expiry loop — the
+        caller holds NO locks; each pipeline's plan/record transitions
+        take the pipeline lock briefly, all I/O runs between."""
+        for pip in list(self.pipelines.values()):
+            if pip.state == "RUNNING":
+                self._advance_pipeline(pip)
+
+    def _advance_pipeline(self, pip: Any) -> None:
+        # bounded: each iteration either submits stages, resolves
+        # history-only stage outcomes, or stops; a loop node chains
+        # rounds one fold per iteration
+        for _ in range(len(pip.nodes) * 4 + 8):
+            with self._pipe_lock:
+                plans, unresolved = pip.plan_locked(self)
+            if not plans and not unresolved:
+                break
+            for nid, rnd in plans:
+                self._submit_stage(pip, nid, rnd)
+            if unresolved:
+                # stage jobs only history remembers (finished before a
+                # restart): the file reads happen HERE, outside the
+                # pipeline lock; verdicts feed back under it
+                verdicts = [(nid, pip._retired_state(self, jid))
+                            for nid, jid in unresolved]
+                with self._pipe_lock:
+                    for nid, st in verdicts:
+                        pip.apply_retired(nid, st)
+                if all(st == "RUNNING" for _, st in verdicts) \
+                        and not plans:
+                    break   # nothing actionable yet — next beat retries
+        if pip.state in ("SUCCEEDED", "FAILED", "KILLED"):
+            self._finish_pipeline(pip)
+
+    def _finish_pipeline(self, pip: Any) -> None:
+        """Terminal bookkeeping, exactly once (idempotent claim under
+        the pipeline lock; the I/O runs outside it). A FAILED pipeline
+        kills its still-running sibling stages — half a diamond must
+        not burn slots for a join that can never run."""
+        with self._pipe_lock:
+            if getattr(pip, "finished_recorded", False):
+                return
+            pip.finished_recorded = True
+            victims = []
+            if pip.state in ("FAILED", "KILLED"):
+                for n in pip.nodes.values():
+                    if n.state == "RUNNING":
+                        # settle the sibling observably: advancement
+                        # stops on terminal pipelines, nothing would
+                        # ever fold this node again
+                        if n.job_id:
+                            victims.append(n.job_id)
+                        n.state = "FAILED"
+                        n.error = n.error or "killed with pipeline"
+        for jid in victims:
+            jip = self.jobs.get(jid)
+            if jip is not None and jip.kill():
+                self._bump_jobs_version()
+                self._finalize_job(jip)
+        self._mreg.incr(f"pipelines_{pip.state.lower()}")
+        self.history.task_event(
+            pip.pipeline_id, "PIPELINE_FINISHED", state=pip.state,
+            error=pip.error,
+            wall_time=(pip.finish_time or time.time()) - pip.start_time,
+            nodes={nid: n.state for nid, n in pip.nodes.items()})
+        root = pip.trace_root
+        if root is not None:
+            pip.trace_root = None
+            self.tracer.finish(root.set(state=pip.state,
+                                        error=pip.error or ""))
+            self.tracer.flush()
+
+    def _submit_stage(self, pip: Any, nid: str, rnd: int) -> None:
+        """Build and submit one stage job (NO pipeline lock held: conf
+        hooks, split computation, and the submission's history write
+        all block). The node was marked SUBMITTING under the lock, so
+        concurrent advances cannot double-submit."""
+        import json as _json
+        node = pip.nodes[nid]
+        graph = pip.graph
+        try:
+            conf = node.round_conf(graph.conf, rnd)
+            conf.setdefault("user.name", pip.user)
+            conf["tpumr.pipeline.id"] = pip.pipeline_id
+            conf["tpumr.pipeline.node"] = nid
+            conf["tpumr.pipeline.round"] = rnd
+            conf.setdefault(
+                "mapred.job.name",
+                f"{graph.name or pip.pipeline_id}:{nid}"
+                + (f"@r{rnd}" if node.is_loop else ""))
+            if any(e["stream"] for e in graph.downstreams(nid)):
+                conf["tpumr.pipeline.stream.handoff"] = True
+            ins = graph.upstreams(nid)
+            ups = {e["src"]: pip.nodes[e["src"]] for e in ins}
+            ups_info = {src: {"job_id": up.job_id,
+                              "output_dir": up.output_dir,
+                              "num_reduces": up.num_reduces}
+                        for src, up in ups.items()}
+            handoff_splits = None
+            if ins and all(e["stream"] for e in ins):
+                # streamed input: one map per upstream reduce
+                # partition, fetched over the shuffle wire — splits are
+                # built HERE, no DFS listing, no client round trip
+                from tpumr.pipeline.handoff import build_handoff_splits
+                conf["mapred.input.format.class"] = \
+                    "tpumr.pipeline.handoff.PipelineHandoffInputFormat"
+                conf["tpumr.pipeline.handoff.upstream"] = _json.dumps(
+                    sorted({i["job_id"] for i in ups_info.values()}))
+                handoff_splits = []
+                for src in sorted(ups):
+                    up = ups[src]
+                    serving = self._handoff_serving(up.job_id)
+                    handoff_splits.extend(build_handoff_splits(
+                        up.job_id, up.num_reduces, up.output_dir,
+                        serving))
+            elif ins and not str(conf.get("mapred.input.dir") or ""):
+                # dfs wiring: the committed upstream output dirs
+                conf["mapred.input.dir"] = ",".join(
+                    ups_info[src]["output_dir"] for src in sorted(ups))
+            hook = node.spec.get("conf_hook")
+            if hook:
+                # a FUNCTION by dotted name (resolve_class insists on
+                # classes): the master-side prep seam for work that
+                # needs upstream output to exist (partition sampling)
+                import importlib
+                mod_name, _, attr = str(hook).rpartition(".")
+                getattr(importlib.import_module(mod_name),
+                        attr)(conf, ups_info)
+            if handoff_splits is not None:
+                splits_wire = [s.to_dict() for s in handoff_splits]
+            else:
+                # the client's submission prep, master-side — the ONE
+                # shared helper (job_client.build_submission), so the
+                # client and pipeline submit paths can never drift
+                # (this is the latency the sequential chain pays per
+                # stage)
+                from tpumr.mapred.job_client import build_submission
+                jc = JobConf()
+                for k, v in conf.items():
+                    jc.set(k, v)
+                conf, splits_wire = build_submission(jc)
+            job_id = self._submit_job(conf, splits_wire, verified=None)
+            jip = self.jobs[job_id]
+            out_dir = str(conf.get("mapred.output.dir") or "")
+            with self._pipe_lock:
+                accepted = pip.record_submitted(nid, rnd, job_id,
+                                                out_dir,
+                                                jip.num_reduces)
+            if not accepted:
+                # the pipeline was killed/failed while this submission
+                # was in flight — reap the just-submitted job now, or
+                # nothing ever would (advancement stops on terminal
+                # pipelines)
+                if jip.kill():
+                    self._bump_jobs_version()
+                    self._finalize_job(jip)
+            self._mreg.incr("pipeline_stages_submitted")
+            self.history.task_event(
+                pip.pipeline_id, "PIPELINE_STAGE_SUBMITTED", node=nid,
+                round=rnd, stage_job_id=job_id, output_dir=out_dir,
+                num_reduces=jip.num_reduces)
+            if pip.trace_root is not None:
+                self.tracer.instant(
+                    "pipeline:stage_submit", pip.trace_id,
+                    parent=pip.trace_root, node=nid, round=rnd,
+                    job_id=job_id)
+        except Exception as e:  # noqa: BLE001 — a stage that cannot
+            # submit fails the pipeline observably, never silently
+            with self._pipe_lock:
+                pip.record_submit_failed(
+                    nid, f"{type(e).__name__}: {e}")
+            self._mreg.incr("pipeline_stage_submit_failed")
+            self.history.task_event(
+                pip.pipeline_id, "PIPELINE_STAGE_SUBMIT_FAILED",
+                node=nid, round=rnd, error=f"{type(e).__name__}: {e}")
+
+    def _handoff_serving(self, job_id: str) -> "dict[int, str]":
+        """partition -> serving shuffle_addr of one upstream stage's
+        already-committed handoff copies (locality hints for the
+        downstream splits; lock-free feed iteration)."""
+        jip = self._resolve_job(job_id)
+        if jip is None:
+            return {}
+        return {e["map_index"]: e["shuffle_addr"]
+                for e in jip.handoff_events
+                if e.get("status") == "SUCCEEDED"}
+
+    def _recover_pipelines(self) -> None:
+        """Restart recovery for in-flight pipelines: replay each
+        journal's graph + stage submissions, following the job-recovery
+        alias for stage jobs the restart resubmitted. Completed
+        upstream stages are adopted terminal from history — a master
+        kill mid-pipeline must never re-run finished stages."""
+        from tpumr.pipeline.pipeline_in_progress import PipelineInProgress
+        for rec in self.history.incomplete_pipelines():
+            pid = rec["pipeline_id"]
+            try:
+                pip = PipelineInProgress.from_recovery(
+                    pid, rec["graph"], rec["stages"], self,
+                    user=rec.get("user", ""))
+            except Exception as e:  # noqa: BLE001 — recovery is
+                self._mreg.incr("pipelines_recovery_failed")  # best-
+                self.history.task_event(                      # effort
+                    pid, "PIPELINE_RECOVERY_FAILED", error=str(e))
+                continue
+            with self._pipe_lock:
+                self.pipelines[pid] = pip
+            self._mreg.incr("pipelines_recovered")
+            self.history.task_event(pid, "PIPELINE_RECOVERED",
+                                    pipeline_id=pid)
+
     # ------------------------------------------------------------ RPC: commit
 
     def can_commit(self, task_id: str, attempt_id: str) -> bool:
@@ -1663,6 +2253,16 @@ class JobMaster:
                                  t_io_wall,
                                  events=len(deferred_events),
                                  finalized=len(deferred_final))
+            if self.pipelines:
+                # DAG advancement must NEVER run on a heartbeat
+                # handler thread (stage submission blocks on DFS
+                # listings and conf hooks — it would silence this
+                # tracker's beats): the deferred phase just WAKES the
+                # dedicated pipeline-advance thread, which picks the
+                # fold's consequences up within microseconds. The
+                # guard is a lock-free dict-truthiness read, so
+                # pipeline-less clusters pay nothing here.
+                self._pipe_wake.set()
             # handling latency INCLUDING the deferred history/finalize
             # I/O: that work serializes this handler thread (and with it
             # this tracker's next heartbeat), so it is part of the
@@ -1892,8 +2492,14 @@ class JobMaster:
                             runtime=ts.runtime, tracker=name,
                             # where a successful map's output is served
                             # from — restart recovery re-feeds it into
-                            # the resubmitted job's completion events
-                            shuffle_addr=(shuffle_addr if ts.is_map
+                            # the resubmitted job's completion events.
+                            # Streamed-handoff stages record it for
+                            # REDUCES too: recovery re-announces the
+                            # surviving handoff copies to downstream
+                            # pipeline stages
+                            shuffle_addr=(shuffle_addr
+                                          if (ts.is_map
+                                              or jip.stream_handoff)
                                           and ts.state
                                           == TaskState.SUCCEEDED
                                           else ""),
@@ -2224,6 +2830,17 @@ class JobMaster:
                 self.history.task_event(
                     str(jip.job_id), "MAP_OUTPUT_LOST", attempt_id=aid,
                     shuffle_addr=addr, reason="tracker_lost")
+            # streamed-handoff copies this tracker served die with it:
+            # tombstone their announcements (downstream readers evict
+            # the location and fall back to the committed part files —
+            # the PR-1 withdrawal dialect, one feed over)
+            lost_handoff = jip.withdraw_handoff_at(addr)
+            if lost_handoff:
+                self._mreg.incr("handoff_outputs_lost", lost_handoff)
+                self.history.task_event(
+                    str(jip.job_id), "HANDOFF_OUTPUT_LOST",
+                    shuffle_addr=addr, partitions=lost_handoff,
+                    reason="tracker_lost")
         for aid in attempts:
             self._revoke_commit(str(TaskAttemptID.parse(aid).task), aid)
 
@@ -2235,3 +2852,21 @@ class JobMaster:
                     if now - t.seen_mono > self.expiry_s]
             for name in lost:
                 self._evict_tracker(name)
+
+    def _pipeline_loop(self) -> None:
+        """THE advancement thread: woken by heartbeat folds (the
+        deferred phase sets the event when a pipeline may have moved)
+        with a 500ms poll backstop for quiet clusters — e.g.
+        resubmitting stages right after a restart while the fleet
+        re-joins. Isolated here so blocking stage-submission I/O can
+        never wedge eviction or heartbeats."""
+        while not self._stop.is_set():
+            self._pipe_wake.wait(0.5)
+            self._pipe_wake.clear()
+            if self._stop.is_set():
+                return
+            if self.pipelines:
+                try:
+                    self._advance_pipelines()
+                except Exception:  # noqa: BLE001
+                    self._mreg.incr("pipeline_advance_errors")

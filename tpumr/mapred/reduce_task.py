@@ -28,9 +28,15 @@ FetchFn = Callable[[int, int], Iterable[tuple[bytes, bytes]]]
 
 
 def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
-                    reporter: Reporter | None = None) -> None:
+                    reporter: Reporter | None = None) -> "dict | None":
     """Execute one reduce attempt. ``fetch(map_index, partition)`` returns the
-    sorted segment of map ``map_index`` for this reduce's partition."""
+    sorted segment of map ``map_index`` for this reduce's partition.
+
+    Returns the streamed-handoff registration payload ({path, index,
+    partition, records}) when this stage tees its output for a
+    downstream pipeline stage, else None — the tracker registers the
+    payload with its shuffle server AFTER the attempt wins the commit.
+    """
     reporter = reporter or Reporter()
     from tpumr.mapred.map_task import localize_task_conf
     conf = localize_task_conf(conf, task)
@@ -84,7 +90,8 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
             segments = [fetch(m, task.partition)
                         for m in range(task.num_maps)]
         with tracing.span("reduce:merge_reduce", segments=len(segments)):
-            _run_reduce_phase(conf, task, segments, sk, gk, reporter)
+            return _run_reduce_phase(conf, task, segments, sk, gk,
+                                     reporter)
     finally:
         # everything after the copy phase — even reducer/output SETUP —
         # must release shuffle resources (RAM budget, disk spills) or a
@@ -102,7 +109,7 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
 def _run_reduce_phase(conf: Any, task: Task,
                       segments: "list[Iterable[tuple[bytes, bytes]]]",
                       sk: Callable, gk: Callable,
-                      reporter: Reporter) -> None:
+                      reporter: Reporter) -> "dict | None":
     """Merge → group → reduce → commit, over already-copied segments."""
     # sort phase: bounded-fan-in merge ≈ Merger.merge honoring
     # io.sort.factor (ReduceTask.java:399-409): a wide shuffle runs
@@ -114,14 +121,14 @@ def _run_reduce_phase(conf: Any, task: Task,
         run_dir=conf.get("tpumr.task.local.dir") or None,
         reporter=reporter, prefix=f"reduce-p{task.partition}")
     try:
-        _reduce_merged(conf, task, iter(engine), gk, reporter)
+        return _reduce_merged(conf, task, iter(engine), gk, reporter)
     finally:
         engine.close()
 
 
 def _reduce_merged(conf: Any, task: Task,
                    merged: "Iterator[tuple[bytes, bytes]]",
-                   gk: Callable, reporter: Reporter) -> None:
+                   gk: Callable, reporter: Reporter) -> "dict | None":
 
     # reduce phase — work dir lands in conf BEFORE the reducer is
     # configured so lib.MultipleOutputs works from configure() onward
@@ -137,9 +144,23 @@ def _reduce_merged(conf: Any, task: Task,
     c_out = reporter.counters.counter(TaskCounter.FRAMEWORK_GROUP,
                                       TaskCounter.REDUCE_OUTPUT_RECORDS)
 
-    def emit(k: Any, v: Any) -> None:
-        c_out.increment()
-        writer.write(k, v)
+    # streamed stage handoff (pipeline engine): tee every emitted
+    # record into a single-partition IFile the tracker serves over the
+    # shuffle wire — downstream maps fetch it instead of re-reading
+    # the committed part file from DFS. None for non-pipeline jobs and
+    # wherever there is no serving side (LocalJobRunner).
+    from tpumr.pipeline.handoff import HandoffWriter
+    handoff = HandoffWriter.open_for(conf, task)
+
+    if handoff is None:
+        def emit(k: Any, v: Any) -> None:
+            c_out.increment()
+            writer.write(k, v)
+    else:
+        def emit(k: Any, v: Any) -> None:
+            c_out.increment()
+            writer.write(k, v)
+            handoff.append(k, v)
 
     collector = OutputCollector(emit)
     ok = False
@@ -160,13 +181,26 @@ def _reduce_merged(conf: Any, task: Task,
                 pass
         ok = True
     finally:
-        reducer.close()
-        # failed tasks tear the writer down through its abort seam when
-        # it has one: file writers are naturally safe (the committer
-        # never promotes a failed attempt's temp file) but direct-write
+        # failed/killed attempts tear BOTH the reducer and the writer
+        # down through their abort seams when they have one: a reducer
+        # with side effects in close() (KMeansCentroidUpdateReducer
+        # publishing next-round state) must not publish from a
+        # partially-fed run — a killed speculative twin's close()
+        # would otherwise overwrite the winner's complete artifact
+        # with partial aggregates. Plain close() remains the cleanup
+        # path for reducers without the seam.
+        r_abort = None if ok else getattr(reducer, "abort", None)
+        (r_abort or reducer.close)()
+        # file writers are naturally safe (the committer never
+        # promotes a failed attempt's temp file) but direct-write
         # formats (DBOutputFormat) must not flush a failed task's buffer
         abort = None if ok else getattr(writer, "abort", None)
         (abort or writer.close)()
+        if handoff is not None and not ok:
+            handoff.abort()   # a failed attempt's tee must not linger
+    if handoff is not None:
+        return handoff.finish(task.partition)
+    return None
 
 
 def group_by_key(stream: Iterator[tuple[bytes, bytes]],

@@ -88,8 +88,17 @@ def _free_tpu_devices(tracker_status: dict) -> list[int]:
 def _priority_fifo(jobs: list[JobInProgress]) -> list[JobInProgress]:
     """The reference's FIFO queue order (JobQueueJobInProgressListener.
     FIFO_JOB_QUEUE_COMPARATOR): priority first, then submit time, then
-    job id — so ``job -set-priority`` reorders the queue live."""
+    job id — so ``job -set-priority`` reorders the queue live.
+
+    Submit time is the job's ``sched_anchor``: normally its own submit
+    stamp, but pipeline STAGE jobs inherit their pipeline's submit time
+    — a chain's late stages keep the chain's queue position instead of
+    re-queueing behind every job submitted while the early stages ran
+    (start_time stays the tiebreak so stages still order among
+    themselves)."""
     return sorted(jobs, key=lambda j: (priority_rank(j.priority),
+                                       getattr(j, "sched_anchor",
+                                               j.start_time),
                                        j.start_time, str(j.job_id)))
 
 

@@ -340,6 +340,10 @@ class NodeRunner:
         #: are re-keyed on receipt), so NEW-id reducers can fetch
         #: outputs produced before the restart
         self._job_rebinds: dict[str, str] = {}
+        #: upstream job id -> shared HandoffSource for streamed-
+        #: pipeline downstream maps on this tracker (one MapLocator per
+        #: upstream stage, every map task of the stage shares it)
+        self._handoff_sources: dict[str, Any] = {}
         # per-pool gating ≈ TaskLauncher's numCPUFreeSlots/numGPUFreeSlots
         # wait loops (TaskTracker.java:2502-2628): even if the master ever
         # over-assigns, a task blocks until ITS pool has a slot
@@ -933,13 +937,35 @@ class NodeRunner:
 
     def _cleanup_finished_jobs(self) -> None:
         """Drop map outputs + cached confs of terminal jobs (≈ the
-        KillJobAction-driven purge of job-local dirs)."""
+        KillJobAction-driven purge of job-local dirs). Streamed-handoff
+        entries (``handoff:<job>`` keys) are NOT governed by their
+        job's terminal state — a finished upstream stage keeps serving
+        its live pipeline — so they consult the master's purge oracle
+        (pipeline terminal?) instead."""
+        from tpumr.pipeline.handoff import SERVE_PREFIX
         with self.lock:
             # include resolver-populated token entries for jobs this
             # tracker never ran (shuffle-source role) so they stop
             # authenticating once the master reports the job terminal
-            job_ids = ({j for j, _ in self.map_outputs}
+            all_ids = ({j for j, _ in self.map_outputs}
                        | set(self.job_confs) | set(self._job_tokens))
+        job_ids = {j for j in all_ids
+                   if not j.startswith(SERVE_PREFIX)}
+        for key in all_ids - job_ids:
+            job_id = key[len(SERVE_PREFIX):]
+            try:
+                if not self.master.call("handoff_purgeable", job_id):
+                    continue
+            except Exception:  # noqa: BLE001 — master briefly down:
+                continue       # keep serving, retry next sweep
+            with self.lock:
+                self.map_outputs = {k: v for k, v in
+                                    self.map_outputs.items()
+                                    if k[0] != key}
+            shutil.rmtree(os.path.join(self.local_root, "handoff",
+                                       job_id), ignore_errors=True)
+            with self.lock:
+                self._handoff_sources.pop(job_id, None)
         for job_id in job_ids:
             try:
                 st = self.master.call("get_job_status", job_id)
@@ -1131,6 +1157,27 @@ class NodeRunner:
             # here, OUTSIDE the job scratch dir that cleanup rmtree's
             jc.set("tpumr.task.userlogs.dir",
                    os.path.join(self.local_root, "userlogs", job_id))
+            # pipeline streamed handoff: the tee spills land OUTSIDE the
+            # job scratch tree — they must outlive this job's cleanup
+            # (downstream stages fetch them after the job is terminal)
+            # and are purged only once the owning pipeline is over.
+            # Thread-isolated tasks only: a PROCESS child's registration
+            # payload never reaches the tracker, so its tee would be
+            # write-only waste — those stages serve via DFS fallback
+            if jc.get_boolean("tpumr.pipeline.stream.handoff", False) \
+                    and jc.get("tpumr.task.isolation",
+                               "thread") != "process":
+                jc.set("tpumr.pipeline.handoff.dir",
+                       os.path.join(self.local_root, "handoff", job_id))
+            # downstream streamed stage: stash the in-process stream-
+            # source factory (MapLocator over the master's handoff feed
+            # + this tracker's rpc credentials). Thread-isolated tasks
+            # only — a process child's conf serializes to a file, and
+            # its maps fall back to the committed DFS artifact instead.
+            if jc.get("tpumr.pipeline.handoff.upstream") and \
+                    jc.get("tpumr.task.isolation", "thread") != "process":
+                jc.set("tpumr.pipeline.handoff.source",
+                       self._handoff_source)
             # trace sink fallback: a client may enable tracing without
             # naming a dir (those are daemon-side keys) — without this,
             # the tracker's and child's spans would be silently dropped
@@ -1342,6 +1389,7 @@ class NodeRunner:
                 committed = self._commit(conf, task)
             else:
                 status.phase = TaskPhase.SHUFFLE
+                handoff_out = None
                 from tpumr.mapred.device_shuffle import is_device_shuffle
                 if is_device_shuffle(conf):
                     # gang task: exchange + sort on this host's mesh
@@ -1352,13 +1400,38 @@ class NodeRunner:
                         reporter)
                 else:
                     fetch = self._remote_fetch_factory(job_id, task)
-                    maybe_profile(
+                    handoff_out = maybe_profile(
                         conf, task, prof_dir,
                         lambda: run_reduce_task(conf, task, fetch,
                                                 reporter))
                 status.phase = TaskPhase.REDUCE
                 self._abort_if_settled(status)
                 committed = self._commit(conf, task)
+                if handoff_out and not committed:
+                    # the tee of a commit-race loser must not linger on
+                    # disk (nothing would ever register or purge it)
+                    try:
+                        os.unlink(handoff_out["path"])
+                    except OSError:
+                        pass
+                elif committed and handoff_out:
+                    # streamed stage handoff: ONLY the commit winner
+                    # registers (a speculative loser's tee must never
+                    # serve) — downstream pipeline maps fetch this
+                    # through the same get_map_output endpoints, keyed
+                    # off the job id proper so job cleanup can't
+                    # collide with the pipeline-scoped lifetime
+                    from tpumr.pipeline.handoff import serve_key
+                    idx = dict(handoff_out["index"])
+                    idx["attempt"] = aid
+                    idx["attempt_no"] = task.attempt_id.attempt
+                    with self.lock:
+                        self.map_outputs[
+                            (serve_key(self._job_rebinds.get(job_id,
+                                                             job_id)),
+                             task.partition)] = (handoff_out["path"],
+                                                 idx)
+                    self._mreg.incr("handoff_outputs_registered")
             with self.lock:
                 killed = aid in self._kill_requested
                 # the reaper may have terminally settled this attempt
@@ -1814,9 +1887,21 @@ class NodeRunner:
         """Served-output lookup that follows the recover_job rebinding
         in BOTH directions: entries are re-keyed to the NEW job id when
         the master teaches the rebinding, but reducers ADOPTED across
-        the restart keep fetching with the OLD id — both must hit."""
+        the restart keep fetching with the OLD id — both must hit.
+        Streamed-handoff keys (``handoff:<job>``) follow the SAME
+        rebinding on their embedded job id: downstream pipeline splits
+        name the pre-restart upstream id forever, while re-run reduces
+        register under the recovered one."""
+        from tpumr.pipeline.handoff import SERVE_PREFIX
+        rebind = job_id
+        if job_id.startswith(SERVE_PREFIX):
+            inner = self._job_rebinds.get(job_id[len(SERVE_PREFIX):])
+            if inner is not None:
+                rebind = SERVE_PREFIX + inner
         with self.lock:
             ent = self.map_outputs.get((job_id, map_index))
+            if ent is None and rebind != job_id:
+                ent = self.map_outputs.get((rebind, map_index))
             if ent is None:
                 new = self._job_rebinds.get(job_id)
                 if new is not None:
@@ -1904,6 +1989,28 @@ class NodeRunner:
             poll_s=self.conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0,
             timeout_s=self.conf.get_int("tpumr.shuffle.timeout.ms",
                                         600_000) / 1000.0)
+
+    def _handoff_source(self, upstream_job: str):
+        """Shared per-upstream-stage stream source for downstream
+        pipeline maps (the `tpumr.pipeline.handoff.source` conf seam):
+        the PR-1 MapLocator over the master's HANDOFF completion-event
+        feed, authenticated with this tracker's credentials. Cached —
+        every map of the downstream stage on this tracker folds one
+        cursor instead of N."""
+        with self.lock:
+            src = self._handoff_sources.get(upstream_job)
+        if src is not None:
+            return src
+        from tpumr.pipeline.handoff import make_handoff_source
+        src = make_handoff_source(
+            upstream_job,
+            lambda cursor: self.master.call(
+                "get_handoff_completion_events", upstream_job, cursor),
+            self._rpc_secret,
+            poll_s=self.conf.get_int("tpumr.shuffle.poll.ms",
+                                     200) / 1000.0)
+        with self.lock:
+            return self._handoff_sources.setdefault(upstream_job, src)
 
     def _remote_fetch_factory(self, job_id: str, task: Task):
         """Chunked shuffle source ≈ ReduceCopier.MapOutputCopier: resolves

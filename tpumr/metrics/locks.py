@@ -13,11 +13,18 @@ stays flat = more contenders; both climbing = the work under the lock
 grew. These are the first series the control-plane scale-out refactor
 is judged against (ROADMAP, bench_scale.py).
 
-Since the lock decomposition (PR 8) the master runs on FIVE lock
+Since the lock decomposition (PR 8) the master runs on SIX lock
 classes with a fixed acquisition order, ascending by rank::
 
-    tracker-beat(5) -> scheduler(10) -> global(20) -> trackers(30)
-        -> job(40)
+    tracker-beat(5) -> scheduler(10) -> pipeline(15) -> global(20)
+        -> trackers(30) -> job(40)
+
+The ``pipeline`` rank (the DAG engine's state lock) sits below
+``global`` because recording a stage submission and reading member-job
+outcomes happen while the engine plans — but every BLOCKING part of a
+stage submission (split computation, conf hooks, submit_job's history
+write) runs outside it: pipeline advancement lives in the heartbeat's
+deferred phase, off the fast path, and must stay there.
 
 A thread may acquire a lock only when every lock it already holds has a
 rank <= the new lock's (same-lock re-entrancy always allowed). The one
@@ -41,12 +48,13 @@ from typing import Any
 #: numbers are spaced so a future lock class can slot between tiers.
 RANK_TRACKER_BEAT = 5    # one tracker's heartbeat processing
 RANK_SCHEDULER = 10      # scheduler passes (before_heartbeat / assign)
+RANK_PIPELINE = 15       # DAG engine state (PipelineInProgress tables)
 RANK_GLOBAL = 20         # job table, commit grants, admin swaps
 RANK_TRACKERS = 30       # tracker registry stripes
 RANK_JOB = 40            # one JobInProgress's task bookkeeping
 
-_ORDER_NAMES = "tracker-beat(5) -> scheduler(10) -> global(20) " \
-               "-> trackers(30) -> job(40)"
+_ORDER_NAMES = "tracker-beat(5) -> scheduler(10) -> pipeline(15) " \
+               "-> global(20) -> trackers(30) -> job(40)"
 
 #: debug-mode ordering assertion: on under ``__debug__`` (plain
 #: ``python``), off under ``python -O`` or TPUMR_LOCK_ORDER_CHECK=0

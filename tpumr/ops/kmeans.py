@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from tpumr.mapred.api import Mapper
+from tpumr.mapred.api import Mapper, Reducer
 from tpumr.ops.registry import KernelMapper, register_kernel
 
 _BIG = 1e30
@@ -153,6 +153,11 @@ def make_distributed_step(mesh, axis_name: str = "data"):
 
 _centroid_cache: dict[str, np.ndarray] = {}
 
+#: host-cache bound: PIPELINE rounds version their centroid path (one
+#: NEW entry per round, nothing invalidated), so the dict would other-
+#: wise grow one k×d array per round for the life of the process
+_CENTROID_CACHE_CAP = 8
+
 
 def _load_centroids(conf) -> np.ndarray:
     from tpumr.fs.filesystem import FileSystem
@@ -163,15 +168,35 @@ def _load_centroids(conf) -> np.ndarray:
     cached = _centroid_cache.get(path)
     if cached is None:
         fs = FileSystem.get(path, conf)
+        while len(_centroid_cache) >= _CENTROID_CACHE_CAP:
+            _centroid_cache.pop(next(iter(_centroid_cache)))
         cached = _centroid_cache[path] = load_dense(fs, path).astype(np.float32)
     return cached
 
 
 def clear_centroid_cache() -> None:
-    """Iterative drivers rewrite the centroid file between rounds."""
+    """SEQUENTIAL iterative drivers rewrite one centroid file between
+    rounds, so both the host cache and the device-resident copy go
+    stale and must be dropped per round. Pipeline loop nodes do NOT
+    call this between rounds: their conf templates a fresh centroid
+    path per round (``cents-r{round}.npy``), so every cache key stays
+    valid — call :func:`clear_pipeline_caches` once at convergence or
+    pipeline teardown instead."""
     from tpumr.ops.devcache import clear_device_cache
     _centroid_cache.clear()
     clear_device_cache("kmeans-centroids:")
+
+
+def clear_pipeline_caches() -> None:
+    """Pipeline teardown: prefix-clear the per-round centroid entries
+    (host + HBM) in one sweep. During the rounds themselves nothing is
+    cleared — round r+1's upload is a NEW tag, round r's entry ages out
+    of the byte-budgeted device LRU naturally, and the devcache
+    pre-seed in :class:`KMeansCentroidUpdateReducer` means the next
+    round's centroids may never leave the device at all. Same sweep as
+    :func:`clear_centroid_cache`; the distinct name is the distinct
+    CONTRACT (once at teardown vs once per round)."""
+    clear_centroid_cache()
 
 
 def _device_centroids(conf):
@@ -223,6 +248,111 @@ class KMeansCpuMapper(Mapper):
         d2 = ((c - np.asarray(row)[None, :]) ** 2).sum(axis=1)
         cid = int(np.argmin(d2))
         output.collect(cid, (np.asarray(row, np.float32), 1))
+
+
+#: convergence counter the iterative driver (pipeline loop node) reads:
+#: total centroid movement this round, in milli-units (counters are
+#: integral) — ``converge={"group": "KMeans", "counter":
+#: "CENTROID_SHIFT_MILLI", "op": "le", "value": T}``
+SHIFT_COUNTER_GROUP = "KMeans"
+SHIFT_COUNTER = "CENTROID_SHIFT_MILLI"
+
+
+class KMeansCentroidUpdateReducer(Reducer):
+    """Round-closing reducer for ITERATIVE kmeans: averages the maps'
+    (partial_sum, count) records into the new centroids, writes them as
+    the NEXT round's ``.npy`` (``tpumr.kmeans.centroids.out`` — a fresh
+    round-templated path, so no cache is ever rewritten-under), emits
+    the centroid-shift convergence counter, and pre-seeds the device
+    cache under the next round's tag: on a single-host cluster the new
+    centroids are HBM-resident before round r+1's first map asks —
+    between rounds they never leave the device. Requires
+    ``mapred.reduce.tasks=1`` (the update needs every cluster id).
+
+    Also emits (cid, new_centroid) records like the plain
+    CentroidReducer, so the round job's committed output remains the
+    inspectable artifact."""
+
+    def __init__(self) -> None:
+        self._sums: "dict[int, np.ndarray]" = {}
+        self._counts: "dict[int, int]" = {}
+        self._conf = None
+        self._reporter = None
+
+    def configure(self, conf) -> None:
+        self._conf = conf
+        if int(conf.get("mapred.reduce.tasks", 1)) != 1:
+            raise ValueError(
+                "KMeansCentroidUpdateReducer needs mapred.reduce.tasks"
+                "=1 — the centroid update must see every cluster")
+
+    def reduce(self, key, values, output, reporter):
+        self._reporter = reporter
+        total, n = None, 0
+        for s, c in values:
+            s = np.asarray(s, dtype=np.float64)
+            total = s if total is None else total + s
+            n += int(c)
+        cid = int(key)
+        self._sums[cid] = total
+        self._counts[cid] = n
+        output.collect(cid, (total / max(1, n)).tolist())
+
+    def abort(self) -> None:
+        """Failed/killed attempt (reduce_task's reducer abort seam): a
+        PARTIALLY-fed run must never publish next-round state — its
+        rename would replace the commit winner's complete file with
+        partial aggregates."""
+        self._sums.clear()
+        self._counts.clear()
+
+    def close(self) -> None:
+        conf = self._conf
+        out_path = conf.get("tpumr.kmeans.centroids.out") if conf else None
+        if not out_path:
+            return   # plain (non-iterative) use: output records suffice
+        prev = _load_centroids(conf)
+        new = prev.copy()
+        for cid, total in self._sums.items():
+            if 0 <= cid < new.shape[0] and self._counts[cid] > 0:
+                new[cid] = (total / self._counts[cid]).astype(np.float32)
+        # write-then-rename: a twin killed MID-WRITE must never leave
+        # a truncated file at the final path (fs.create truncates — a
+        # direct write could corrupt a completed file). The bytes are
+        # deterministic, so on filesystems whose rename replaces
+        # (local os.replace, mem) the landing order is irrelevant; on
+        # a DFS that REFUSES an existing destination the first writer
+        # simply wins — either way the tmp must not linger.
+        import io as _io
+
+        from tpumr.fs.filesystem import FileSystem
+        buf = _io.BytesIO()
+        np.save(buf, np.ascontiguousarray(new))
+        fs = FileSystem.get(out_path, conf)
+        tmp = (f"{out_path}._"
+               f"{conf.get('tpumr.task.attempt.id') or 'local'}.tmp")
+        with fs.create(tmp) as f:
+            f.write(buf.getvalue())
+        if not fs.rename(tmp, out_path):
+            try:
+                fs.delete(tmp)
+            except OSError:
+                pass
+        shift = float(np.abs(new - prev).sum())
+        if self._reporter is not None:
+            self._reporter.incr_counter(SHIFT_COUNTER_GROUP,
+                                        SHIFT_COUNTER,
+                                        int(round(shift * 1000)))
+        # HBM pre-seed: register the new centroids under the NEXT
+        # round's cache tag so round r+1's maps on this host hit the
+        # device copy without touching storage (best-effort — a distant
+        # tracker's maps just upload once, as before)
+        try:
+            from tpumr.ops.devcache import device_cached
+            device_cached(f"kmeans-centroids:{out_path}",
+                          new.astype(np.float32, copy=False), conf)
+        except Exception:  # noqa: BLE001 — residency is an
+            pass           # optimization, never a dependency
 
 
 class KMeansAssignKernel(KernelMapper):

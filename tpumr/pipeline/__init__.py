@@ -1,0 +1,30 @@
+"""DAG-of-jobs pipeline engine.
+
+Every real workload in this tree is already a chain — kmeans resubmits a
+job per round, terasort is teragen→sort→validate, gridmix replays job
+mixes — yet each stage used to round-trip its output through DFS and pay
+full client-observed submit+schedule latency. This package makes the DAG
+first-class:
+
+- :mod:`tpumr.pipeline.graph` — the client-side :class:`JobGraph` API
+  (nodes = jobconfs, edges = data deps, loop nodes with a round barrier
+  and a convergence predicate) and its validated wire form;
+- :mod:`tpumr.pipeline.pipeline_in_progress` — the master-side engine
+  that submits downstream stages as upstream reduces commit, driven off
+  the same append-only completion machinery the shuffle already uses;
+- :mod:`tpumr.pipeline.handoff` — streamed stage handoff: reduce output
+  re-served in map-output (IFile) framing over the existing shuffle
+  wire, so downstream maps fetch upstream partitions instead of
+  re-reading DFS (the committed DFS artifact stays the fallback truth);
+- :mod:`tpumr.pipeline.client` — submission + polling
+  (:class:`PipelineClient` / :class:`RunningPipeline`), master-restart
+  aware like the job client.
+
+Grounding: PAPERS.md "High-throughput Execution of Hierarchical
+Analysis Pipelines on Hybrid Cluster Platforms"; ROADMAP "DAG-of-jobs
+pipeline engine with streamed stage handoff".
+"""
+
+from tpumr.pipeline.graph import JobGraph, PipelineError  # noqa: F401
+from tpumr.pipeline.client import (PipelineClient,  # noqa: F401
+                                   RunningPipeline)

@@ -1,0 +1,126 @@
+"""Pipeline submission + monitoring — the JobClient of DAGs.
+
+One atomic RPC submits the whole validated graph; the master owns all
+subsequent stage submissions (split computation included), so an
+N-stage chain costs ONE client round trip instead of N×(submit + poll
+until terminal + resubmit) — the per-stage overhead the bench.py
+``kmeans_pipeline`` row measures.
+
+Partition tolerance matches the job client: polls retry through master
+restarts (pipeline ids are stable across restarts — the recovered
+pipeline keeps its id, unlike stage jobs, which rebind through the
+job-recovery alias under the covers).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from tpumr.ipc.rpc import RpcClient
+from tpumr.mapred.jobconf import JobConf
+from tpumr.pipeline.graph import JobGraph
+
+
+class RunningPipeline:
+    def __init__(self, client: RpcClient, pipeline_id: str) -> None:
+        self._client = client
+        self.pipeline_id = pipeline_id
+
+    def status(self) -> dict:
+        return self._client.call("get_pipeline_status", self.pipeline_id)
+
+    def is_complete(self) -> bool:
+        return self.status()["state"] in ("SUCCEEDED", "FAILED", "KILLED")
+
+    def kill(self) -> bool:
+        from tpumr.security import UserGroupInformation
+        return self._client.call(
+            "kill_pipeline", self.pipeline_id,
+            UserGroupInformation.get_current_user().user)
+
+    def wait_for_completion(self, poll_s: float = 0.2,
+                            timeout: float = 3600.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while True:
+            st = self.status()
+            if st["state"] in ("SUCCEEDED", "FAILED", "KILLED"):
+                return st
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pipeline {self.pipeline_id} did not finish within "
+                    f"{timeout}s: {st}")
+            time.sleep(poll_s)
+
+
+class PipelineClient:
+    def __init__(self, conf: JobConf) -> None:
+        self.conf = conf
+        tracker = conf.get("mapred.job.tracker")
+        if not tracker or tracker == "local":
+            raise ValueError(
+                "pipelines need a cluster master (mapred.job.tracker); "
+                "run the stages through LocalJobRunner individually for "
+                "daemon-less execution")
+        host, port = str(tracker).rsplit(":", 1)
+        from tpumr.core import confkeys
+        from tpumr.security import client_credentials
+        secret, scope = client_credentials(conf, "jobtracker")
+        self._client = RpcClient(
+            host, int(port), secret=secret, scope=scope,
+            retries=confkeys.get_int(conf, "tpumr.jobclient.rpc.retries"),
+            backoff_ms=conf.get_int("tpumr.rpc.client.backoff.ms", 200))
+
+    def submit(self, graph: "JobGraph | dict") -> RunningPipeline:
+        """Validate client-side (fail fast, no half-submitted graphs),
+        then hand the wire form to the master — which validates AGAIN
+        before admitting it (clients lie)."""
+        if isinstance(graph, JobGraph):
+            graph.validate()
+            graph = graph.to_dict()
+        else:
+            JobGraph.from_dict(graph).validate()
+        graph = dict(graph)
+        # client-local credentials must never ride the graph: node
+        # confs built from a client JobConf may carry the user key /
+        # token paths, and the master JOURNALS the full graph (the
+        # _wire_conf stripping, pipeline edition — the master scrubs
+        # again, but secrets shouldn't even cross the wire)
+        from tpumr.mapred.job_client import scrub_credentials
+        conf = scrub_credentials(dict(graph.get("conf") or {}))
+        if not conf.get("user.name"):
+            from tpumr.security import UserGroupInformation
+            conf["user.name"] = \
+                UserGroupInformation.get_current_user().user
+        graph["conf"] = conf
+        graph["nodes"] = [
+            {**n, "conf": scrub_credentials(dict(n.get("conf") or {}))}
+            for n in graph.get("nodes") or []]
+        pid = self._client.call("submit_pipeline", graph)
+        return RunningPipeline(self._client, pid)
+
+    def list(self) -> "list[dict]":
+        return self._client.call("list_pipelines")
+
+    def status(self, pipeline_id: str) -> dict:
+        return self._client.call("get_pipeline_status", pipeline_id)
+
+    def trace(self, pipeline_id: str) -> dict:
+        """The merged end-to-end trace of a traced pipeline (raw span
+        dicts; feed to tracing.to_chrome_trace for viewers)."""
+        return self._client.call("get_pipeline_trace", pipeline_id)
+
+    def running(self, pipeline_id: str) -> RunningPipeline:
+        return RunningPipeline(self._client, pipeline_id)
+
+
+def run_pipeline(conf: JobConf, graph: "JobGraph | dict",
+                 timeout: float = 3600.0) -> dict:
+    """Submit and wait; raises on a non-SUCCEEDED terminal state."""
+    running = PipelineClient(conf).submit(graph)
+    st = running.wait_for_completion(timeout=timeout)
+    if st["state"] != "SUCCEEDED":
+        raise RuntimeError(
+            f"pipeline {running.pipeline_id} {st['state']}: "
+            f"{st.get('error', '')}")
+    return st

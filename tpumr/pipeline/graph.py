@@ -1,0 +1,264 @@
+"""JobGraph — the client-side pipeline description and its wire form.
+
+A pipeline is a DAG whose nodes are job confs and whose edges are data
+dependencies. Two edge modes:
+
+``dfs`` (default)
+    The downstream stage reads the upstream stage's committed output
+    directory; it is submitted once the upstream job finalized (output
+    promoted). Input wiring is automatic when the downstream conf names
+    no ``mapred.input.dir`` of its own.
+
+``stream``
+    The upstream reduce output is ALSO written in map-output (IFile)
+    framing and served over the shuffle wire; the downstream stage's
+    maps are one-per-upstream-partition and fetch their records from
+    the serving tracker instead of re-reading DFS — submitted as soon
+    as upstream reduces start committing, not when the whole job
+    finalized. Requires the upstream stage to have reduces and to write
+    SequenceFiles (the committed part files remain the byte-truth a
+    lost intermediate falls back to).
+
+A ``loop`` node is one job resubmitted round-by-round behind a round
+barrier: round ``r+1`` is submitted only after round ``r``'s job
+succeeded AND either the convergence predicate (a counter threshold on
+the round job's aggregated counters) held false and ``max_rounds`` is
+not exhausted. Conf values may embed ``{round}`` / ``{prev_round}`` /
+``{next_round}`` placeholders, expanded per round — iterative drivers
+version their state files per round instead of rewriting one path
+(which is what lets the HBM-resident side-input cache survive rounds,
+see ops/devcache.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+#: convergence predicate comparators (counter value OP threshold)
+_CONVERGE_OPS = {"lt", "le", "gt", "ge"}
+
+#: node/pipeline id alphabet — ids land in file names and URLs
+_ID_RE = re.compile(r"^[A-Za-z0-9_.\-]{1,64}$")
+
+_ROUND_RE = re.compile(r"\{(round|prev_round|next_round)\}")
+
+
+class PipelineError(ValueError):
+    """Graph validation failure (cycle, dangling edge, duplicate id,
+    unsatisfiable stream edge, malformed loop spec)."""
+
+
+def expand_round(conf: dict, rnd: int) -> dict:
+    """Per-round conf instantiation: every string value's ``{round}`` /
+    ``{prev_round}`` / ``{next_round}`` placeholders become ``rnd`` /
+    ``rnd-1`` / ``rnd+1``. Non-string values pass through untouched."""
+    vals = {"round": rnd, "prev_round": rnd - 1, "next_round": rnd + 1}
+
+    def sub(v: Any) -> Any:
+        if isinstance(v, str) and "{" in v:
+            return _ROUND_RE.sub(lambda m: str(vals[m.group(1)]), v)
+        return v
+
+    return {k: sub(v) for k, v in conf.items()}
+
+
+class JobGraph:
+    """Builder + validator for one pipeline submission.
+
+    >>> g = JobGraph("terasort-chain")
+    >>> g.node("gen", gen_conf)
+    >>> g.node("sort", sort_conf, conf_hook="pkg.mod.sample_hook")
+    >>> g.node("validate", val_conf)
+    >>> g.edge("gen", "sort")
+    >>> g.edge("sort", "validate", stream=True)
+    >>> pid = PipelineClient(conf).submit(g).pipeline_id
+    """
+
+    def __init__(self, name: str = "", conf: "dict | None" = None) -> None:
+        self.name = name
+        #: pipeline-wide conf defaults merged under every stage conf
+        #: (queue, priority, tracing switches)
+        self.conf: dict = dict(conf or {})
+        self.nodes: "dict[str, dict]" = {}
+        self.edges: "list[dict]" = []
+
+    # ------------------------------------------------------------ build
+
+    def node(self, node_id: str, conf: dict,
+             conf_hook: "str | None" = None) -> "JobGraph":
+        """One job stage. ``conf_hook`` names an importable
+        ``fn(conf_dict, upstreams) -> None`` the master calls right
+        before submitting the stage — the seam for prep that needs the
+        upstream output to exist (terasort's partition-file sampling)."""
+        if node_id in self.nodes:
+            raise PipelineError(f"duplicate node id {node_id!r}")
+        if not _ID_RE.match(node_id or ""):
+            raise PipelineError(f"bad node id {node_id!r} (want "
+                                f"[A-Za-z0-9_.-], max 64 chars)")
+        self.nodes[node_id] = {"id": node_id, "conf": dict(conf),
+                               "conf_hook": conf_hook}
+        return self
+
+    def loop(self, node_id: str, conf: dict, max_rounds: int,
+             converge: "dict | None" = None,
+             conf_hook: "str | None" = None) -> "JobGraph":
+        """An iterative node: the job resubmits round-by-round (round
+        barrier) until ``converge`` — ``{"group": G, "counter": C,
+        "op": lt|le|gt|ge, "value": V}`` over the round job's aggregated
+        counters — holds, or ``max_rounds`` is exhausted (the cutoff)."""
+        self.node(node_id, conf, conf_hook)
+        self.nodes[node_id]["loop"] = {
+            "max_rounds": int(max_rounds),
+            "converge": dict(converge) if converge else None,
+        }
+        return self
+
+    def edge(self, src: str, dst: str, stream: bool = False) -> "JobGraph":
+        self.edges.append({"src": src, "dst": dst,
+                           "stream": bool(stream)})
+        return self
+
+    # ------------------------------------------------------------- wire
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "conf": dict(self.conf),
+                "nodes": [dict(n) for n in self.nodes.values()],
+                "edges": [dict(e) for e in self.edges]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "JobGraph":
+        g = JobGraph(str(d.get("name", "") or ""),
+                     dict(d.get("conf") or {}))
+        for n in d.get("nodes") or []:
+            nid = str(n.get("id", ""))
+            loop = n.get("loop")
+            if loop:
+                g.loop(nid, dict(n.get("conf") or {}),
+                       int(loop.get("max_rounds", 1)),
+                       loop.get("converge"),
+                       n.get("conf_hook"))
+            else:
+                g.node(nid, dict(n.get("conf") or {}),
+                       n.get("conf_hook"))
+        for e in d.get("edges") or []:
+            g.edge(str(e.get("src", "")), str(e.get("dst", "")),
+                   bool(e.get("stream")))
+        return g
+
+    # ------------------------------------------------------ topology
+
+    def upstreams(self, node_id: str) -> "list[dict]":
+        return [e for e in self.edges if e["dst"] == node_id]
+
+    def downstreams(self, node_id: str) -> "list[dict]":
+        return [e for e in self.edges if e["src"] == node_id]
+
+    def topo_order(self) -> "list[str]":
+        """Kahn topological order; raises :class:`PipelineError` on a
+        cycle (naming the nodes stuck in it)."""
+        indeg = {nid: 0 for nid in self.nodes}
+        for e in self.edges:
+            indeg[e["dst"]] += 1
+        ready = sorted(nid for nid, d in indeg.items() if d == 0)
+        order: "list[str]" = []
+        while ready:
+            nid = ready.pop(0)
+            order.append(nid)
+            for e in self.downstreams(nid):
+                indeg[e["dst"]] -= 1
+                if indeg[e["dst"]] == 0:
+                    ready.append(e["dst"])
+            ready.sort()
+        if len(order) != len(self.nodes):
+            stuck = sorted(set(self.nodes) - set(order))
+            raise PipelineError(f"cycle through nodes {stuck}")
+        return order
+
+    # ---------------------------------------------------- validation
+
+    def validate(self) -> "JobGraph":
+        """Raise :class:`PipelineError` on anything the master would
+        choke on later — an invalid graph must be rejected at submit,
+        never half-run."""
+        if not self.nodes:
+            raise PipelineError("empty pipeline (no nodes)")
+        for e in self.edges:
+            for end in ("src", "dst"):
+                if e[end] not in self.nodes:
+                    raise PipelineError(
+                        f"dangling edge endpoint {e[end]!r} "
+                        f"({e['src']} -> {e['dst']})")
+            if e["src"] == e["dst"]:
+                raise PipelineError(
+                    f"self-edge on {e['src']!r} (iterate with a loop "
+                    f"node instead)")
+        self.topo_order()   # cycle rejection
+        for nid, n in self.nodes.items():
+            conf = n["conf"]
+            if not str(conf.get("mapred.output.dir") or ""):
+                raise PipelineError(
+                    f"node {nid!r} has no mapred.output.dir — every "
+                    f"stage needs one (downstream wiring + recovery "
+                    f"fall back to the committed artifact)")
+            loop = n.get("loop")
+            if loop is not None:
+                if loop["max_rounds"] < 1:
+                    raise PipelineError(
+                        f"loop node {nid!r}: max_rounds must be >= 1")
+                conv = loop.get("converge")
+                if conv is not None:
+                    missing = {"group", "counter", "op",
+                               "value"} - set(conv)
+                    if missing:
+                        raise PipelineError(
+                            f"loop node {nid!r}: converge spec is "
+                            f"missing {sorted(missing)}")
+                    if conv["op"] not in _CONVERGE_OPS:
+                        raise PipelineError(
+                            f"loop node {nid!r}: converge op "
+                            f"{conv['op']!r} not in "
+                            f"{sorted(_CONVERGE_OPS)}")
+                    if isinstance(conv["value"], bool) or \
+                            not isinstance(conv["value"], (int, float)):
+                        # a string threshold would TypeError against
+                        # the int counter on EVERY advance — the
+                        # pipeline would spin RUNNING forever
+                        raise PipelineError(
+                            f"loop node {nid!r}: converge value "
+                            f"{conv['value']!r} must be a number")
+            ins = self.upstreams(nid)
+            modes = {bool(e["stream"]) for e in ins}
+            if len(modes) > 1:
+                raise PipelineError(
+                    f"node {nid!r} mixes stream and dfs in-edges — a "
+                    f"stage reads through one input format")
+            if ins and modes == {True} \
+                    and str(conf.get("mapred.input.dir") or ""):
+                raise PipelineError(
+                    f"node {nid!r} has stream in-edges AND its own "
+                    f"mapred.input.dir — streamed input is wired by "
+                    f"the engine")
+        for e in self.edges:
+            if not e["stream"]:
+                continue
+            # NOTE: a stream edge OUT of a converging loop node is
+            # legal — streaming just begins only once the loop settles
+            # on its final round (see _stream_ready's degradation)
+            src = self.nodes[e["src"]]
+            sconf = src["conf"]
+            if int(sconf.get("mapred.reduce.tasks", 1) or 0) < 1:
+                raise PipelineError(
+                    f"stream edge {e['src']} -> {e['dst']}: upstream "
+                    f"is map-only — streamed handoff serves REDUCE "
+                    f"output (use a dfs edge)")
+            out_fmt = str(sconf.get("mapred.output.format.class", "")
+                          or "")
+            if "SequenceFileOutputFormat" not in out_fmt:
+                raise PipelineError(
+                    f"stream edge {e['src']} -> {e['dst']}: upstream "
+                    f"must write SequenceFiles (got "
+                    f"{out_fmt or 'the text default'}) — the committed "
+                    f"part files are the record-identical fallback for "
+                    f"a lost intermediate")
+        return self

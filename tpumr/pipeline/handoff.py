@@ -1,0 +1,322 @@
+"""Streamed stage handoff — reduce output served over the shuffle wire.
+
+The write side (:class:`HandoffWriter`, driven by reduce_task) tees the
+reduce's emitted records into ONE single-partition IFile next to the
+normal OutputFormat write, under the tracker's handoff tree; the tracker
+registers it post-commit under the ``handoff:<job_id>`` serving key so
+the EXISTING shuffle endpoints (``get_map_output`` /
+``get_map_output_chunk``) serve it unchanged — the wire, chunking, and
+fault-injection seams are all the PR-1 machinery.
+
+The read side (:class:`PipelineHandoffInputFormat` over
+:class:`HandoffSplit`) is a downstream map whose "split" is one
+upstream reduce partition. Discovery reuses the completion-event
+protocol verbatim: the master keeps a per-job append-only
+``handoff_events`` feed (same :class:`CompletionEventFeed`,
+``map_index`` = reduce partition) and the reader drives the same
+:class:`~tpumr.mapred.tasktracker.MapLocator` over it — OBSOLETE
+tombstones (serving tracker evicted) drop the cached location exactly
+like a withdrawn map output. A partition the stream cannot serve falls
+back to the upstream stage's COMMITTED SequenceFile part file, which
+holds record-identical data: residency on the wire is an optimization,
+the DFS artifact stays the truth (the device_output.py stance).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+from tpumr.core import confkeys
+from tpumr.mapred.split import InputSplit
+
+#: one locator attempt's budget before the reader interleaves a DFS
+#: fallback probe — short enough that a dead stream degrades quickly,
+#: long enough that locate() amortizes its event polls
+_LOCATE_SLICE_S = 2.0
+
+#: counters the reader emits (group "Pipeline") — one per split, so the
+#: job's aggregated counters say how much of the stage actually streamed
+COUNTER_GROUP = "Pipeline"
+COUNTER_STREAMED = "HANDOFF_STREAMED_SPLITS"
+COUNTER_FALLBACK = "HANDOFF_DFS_FALLBACK_SPLITS"
+
+#: serving-key namespace on the tracker: handoff entries live beside map
+#: outputs but are keyed off the job id proper, so job cleanup can't
+#: collide with them and the pipeline controls their lifetime
+SERVE_PREFIX = "handoff:"
+
+
+def serve_key(job_id: str) -> str:
+    return SERVE_PREFIX + job_id
+
+
+# ----------------------------------------------------------------- write
+
+
+class HandoffWriter:
+    """Tee of one reduce attempt's output records into a
+    single-partition IFile (the map-output spill framing, so the
+    existing shuffle server serves it without a new wire format)."""
+
+    def __init__(self, path: str, codec: str = "none") -> None:
+        from tpumr.io import ifile
+        self.path = path
+        self._f = open(path, "wb")
+        self._w = ifile.Writer(self._f, codec=codec)
+        self._w.start_partition()
+        self._n = 0
+
+    def append(self, key: Any, value: Any) -> None:
+        from tpumr.io.writable import serialize
+        self._w.append_raw(serialize(key), serialize(value))
+        self._n += 1
+
+    def finish(self, partition: int) -> dict:
+        """Close and return the registration payload the tracker stores
+        beside map-output indexes."""
+        self._w.end_partition()
+        index = self._w.close()
+        self._f.close()
+        return {"path": self.path, "index": index,
+                "partition": partition, "records": self._n}
+
+    def abort(self) -> None:
+        import os
+        try:
+            self._f.close()
+        finally:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    @staticmethod
+    def open_for(conf: Any, task: Any) -> "HandoffWriter | None":
+        """A writer when this reduce attempt should stream its output:
+        the stage opted in AND the runtime provided a handoff dir (the
+        tracker does; LocalJobRunner has no serving side)."""
+        if not confkeys.get_boolean(conf, "tpumr.pipeline.stream.handoff"):
+            return None
+        d = conf.get("tpumr.pipeline.handoff.dir")
+        if not d:
+            return None
+        import os
+        os.makedirs(d, exist_ok=True)
+        return HandoffWriter(os.path.join(d, f"{task.attempt_id}.handoff"))
+
+
+# ------------------------------------------------------------------ read
+
+
+@dataclass
+class HandoffSplit(InputSplit):
+    """One upstream reduce partition as a downstream map's input: fetch
+    it over the shuffle wire from whichever tracker committed it, fall
+    back to the upstream stage's committed part file."""
+
+    upstream_job: str = ""
+    partition: int = 0
+    #: the upstream stage's mapred.output.dir — the DFS fallback root
+    fallback_dir: str = ""
+    #: records the upstream reduce emitted (0 = unknown): progress hint
+    num_records: int = 0
+
+    def describe(self) -> str:
+        return f"{self.upstream_job}[r{self.partition}]"
+
+
+def build_handoff_splits(upstream_job: str, num_reduces: int,
+                         output_dir: str,
+                         serving: "dict[int, str] | None" = None
+                         ) -> "list[HandoffSplit]":
+    """Master-side split construction for a streamed stage: one split
+    per upstream reduce partition; locality hints from the partitions
+    already committed (``serving``: partition -> shuffle_addr)."""
+    serving = serving or {}
+    out = []
+    for p in range(num_reduces):
+        addr = serving.get(p, "")
+        host = addr.rsplit(":", 1)[0] if addr else ""
+        out.append(HandoffSplit(locations=[host] if host else [],
+                                upstream_job=upstream_job, partition=p,
+                                fallback_dir=output_dir))
+    return out
+
+
+class PipelineHandoffInputFormat:
+    """Input format of a streamed downstream stage. ``get_splits`` is
+    never called — the master builds :class:`HandoffSplit`\\ s when it
+    submits the stage (that is the point: no client round trip, no DFS
+    listing)."""
+
+    def get_splits(self, conf: Any, num_splits: int):
+        raise RuntimeError(
+            "PipelineHandoffInputFormat splits are built by the "
+            "pipeline engine at stage submit — this job must be "
+            "submitted through a pipeline, not directly")
+
+    def get_record_reader(self, split: HandoffSplit, conf: Any,
+                          reporter: Any = None
+                          ) -> "Iterator[tuple[Any, Any]]":
+        assert isinstance(split, HandoffSplit), split
+        timeout_s = confkeys.get_int(
+            conf, "tpumr.pipeline.handoff.timeout.ms") / 1000.0
+        poll_s = confkeys.get_int(
+            conf, "tpumr.pipeline.handoff.poll.ms") / 1000.0
+        # the tracker's in-process seam: a factory of per-upstream-job
+        # handoff sources (MapLocator over the master's handoff feed +
+        # the tracker's rpc credentials). Absent outside a tracker
+        # (child isolation, local tests) — DFS fallback only.
+        factory = conf.get("tpumr.pipeline.handoff.source")
+        src = factory(split.upstream_job) if callable(factory) else None
+        counters = getattr(reporter, "counters", None)
+
+        def bump(name: str) -> None:
+            if counters is not None:
+                counters.counter(COUNTER_GROUP, name).increment()
+
+        # monotonic deadline: an NTP step mid-wait must not fire (or
+        # stall) the handoff timeout
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if src is not None:
+                records = self._try_stream(src, split)
+                if records is not None:
+                    bump(COUNTER_STREAMED)
+                    return records
+            records = self._try_fallback(split, conf)
+            if records is not None:
+                bump(COUNTER_FALLBACK)
+                return records
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"handoff partition {split.describe()} never became "
+                    f"available (stream or committed fallback) within "
+                    f"{timeout_s:.0f}s")
+            if reporter is not None:
+                # waiting for an upstream reduce is progress, not a
+                # hang — keep the task-timeout reaper informed
+                keepalive = getattr(reporter, "progress", None)
+                if keepalive is not None:
+                    keepalive()
+            time.sleep(poll_s)
+
+    # one source object per upstream job is shared by every map task of
+    # the stage on a tracker; locate() and the fetch itself are
+    # thread-safe (MapLocator's own locking + per-thread RpcClients)
+
+    #: one streamed-fetch chunk on the wire — the tracker's own
+    #: chunked-transfer discipline (its MAX_CHUNK_BYTES server cap):
+    #: whole partitions never ride one RPC response, so a multi-GB
+    #: upstream partition streams memory-bounded on both ends
+    FETCH_CHUNK_BYTES = 4 << 20
+
+    def _try_stream(self, src: Any, split: HandoffSplit):
+        """One bounded attempt at the streamed path: locate the serving
+        tracker via the handoff completion-event feed, then stream the
+        single-partition segment through the CHUNKED shuffle endpoint
+        (first chunk fetched eagerly so a dead server demotes the
+        location instead of failing the attempt; a mid-stream loss
+        raises into the normal attempt-retry protocol). None = not
+        (yet) streamable — the caller interleaves the DFS fallback."""
+        from tpumr.io import ifile
+        try:
+            client = src.locate(split.partition)
+        except TimeoutError:
+            return None
+        if client is None:
+            return None
+        key = serve_key(split.upstream_job)
+        try:
+            first = client.call("get_map_output_chunk", key,
+                                split.partition, 0, 0,
+                                self.FETCH_CHUNK_BYTES)
+        except Exception:  # noqa: BLE001 — serving tracker gone/lame:
+            # demote the cached location (the feed's OBSOLETE tombstone
+            # or a fresh event decides its fate) and fall back
+            src.invalidate(split.partition)
+            return None
+        from tpumr.io.writable import deserialize
+
+        def chunks() -> Iterator[bytes]:
+            total = int(first["total"])
+            yield first["data"]
+            off = len(first["data"])
+            while off < total:
+                out = client.call("get_map_output_chunk", key,
+                                  split.partition, 0, off,
+                                  self.FETCH_CHUNK_BYTES)
+                data = out["data"]
+                if not data:
+                    raise EOFError(
+                        f"handoff stream for {split.describe()} "
+                        f"truncated at {off}/{total}")
+                yield data
+                off += len(data)
+
+        def gen() -> Iterator[tuple[Any, Any]]:
+            for kb, vb in ifile.iter_chunked_segment(
+                    chunks(), first.get("codec", "none")):
+                yield deserialize(kb), deserialize(vb)
+
+        return gen()
+
+    def _try_fallback(self, split: HandoffSplit, conf: Any):
+        """The committed part file, once the upstream stage's output
+        promotion made it visible. Record-identical to the stream: the
+        stream edge contract pins the upstream output format to
+        SequenceFiles."""
+        from tpumr.fs.filesystem import FileSystem, Path
+        from tpumr.io import sequencefile
+        from tpumr.mapred.output_formats import part_name
+        path = str(Path(split.fallback_dir).child(
+            part_name(split.partition)))
+        fs = FileSystem.get(path, conf)
+        try:
+            if not fs.exists(path):
+                return None
+            length = fs.get_status(path).length
+        except OSError:
+            return None
+
+        def gen() -> Iterator[tuple[Any, Any]]:
+            f = fs.open(path)
+            try:
+                yield from sequencefile.Reader(f).iter_range(0, length)
+            finally:
+                f.close()
+
+        return gen()
+
+
+@dataclass
+class HandoffSource:
+    """The tracker-built per-upstream-job stream source: a
+    :class:`~tpumr.mapred.tasktracker.MapLocator` (reused verbatim —
+    the handoff feed speaks the same event dialect) plus bookkeeping.
+    ``locate`` returns the serving tracker's RpcClient or raises
+    TimeoutError after its bounded slice."""
+
+    locator: Any = None
+    upstream_job: str = ""
+
+    def locate(self, partition: int):
+        return self.locator(partition)
+
+    def invalidate(self, partition: int) -> None:
+        self.locator.invalidate(partition)
+
+
+def make_handoff_source(upstream_job: str, events_fn: Any,
+                        secret: "bytes | None",
+                        poll_s: float) -> HandoffSource:
+    """Build the stream source the tracker stashes in the stage conf:
+    the PR-1 MapLocator over the master's handoff completion-event feed,
+    with a SHORT per-call timeout so the reader can interleave DFS
+    fallback probes between locate slices."""
+    from tpumr.mapred.tasktracker import make_map_locator
+    locator = make_map_locator(events_fn, secret, poll_s=poll_s,
+                               timeout_s=_LOCATE_SLICE_S)
+    return HandoffSource(locator=locator, upstream_job=upstream_job)

@@ -1,0 +1,372 @@
+"""PipelineInProgress — the master-side DAG engine.
+
+One instance per submitted pipeline. The master drives :meth:`advance`
+from the heartbeat's DEFERRED phase (after every lock is released) and
+from the expiry loop: pipeline bookkeeping NEVER rides the heartbeat
+fast path, and the engine's own lock (rank ``pipeline``, slotted
+between ``scheduler`` and ``global`` in metrics/locks.py) is held only
+for state transitions — stage submission (split computation, conf
+hooks, history I/O) runs OUTSIDE it, with a SUBMITTING mark making
+concurrent advances idempotent.
+
+Stage readiness:
+
+- no in-edges → ready at pipeline submit;
+- ``dfs`` in-edges → every upstream node SUCCEEDED and its job
+  FINALIZED (output promoted — the downstream input format lists it);
+- ``stream`` in-edges → every upstream node's job has started
+  COMMITTING reduces (``finished_reduces >= 1``; loop upstreams: the
+  loop settled on its final round first) — downstream maps fetch
+  partitions as they commit and wait on the handoff feed for the rest.
+
+Loop nodes run one job per round behind a round barrier; after a round
+SUCCEEDS the convergence predicate is evaluated on the round job's
+aggregated counters, and either the node settles (predicate holds, or
+``max_rounds`` exhausted — the cutoff) or the next round submits with
+``{round}``-expanded conf.
+
+Restart recovery: the pipeline journals PIPELINE_SUBMITTED (full graph)
+and one PIPELINE_STAGE_SUBMITTED per stage job into its own history
+file; :meth:`from_recovery` replays them, mapping stage job ids through
+the master's job-recovery alias table — completed upstream stages are
+adopted terminal from history (never re-run), in-flight stages re-bind
+to their recovered jobs, unsubmitted stages submit normally once their
+upstreams settle.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from tpumr.pipeline.graph import JobGraph, expand_round
+
+
+class PipelineState:
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+    TERMINAL = {SUCCEEDED, FAILED, KILLED}
+
+
+class NodeState:
+    PENDING = "PENDING"
+    SUBMITTING = "SUBMITTING"   # a plan is in flight outside the lock
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    SKIPPED = "SKIPPED"         # pipeline died before this stage ran
+    TERMINAL = {SUCCEEDED, FAILED, SKIPPED}
+
+
+class _Node:
+    __slots__ = ("spec", "state", "round", "jobs", "job_id",
+                 "output_dir", "num_reduces", "error")
+
+    def __init__(self, spec: dict) -> None:
+        self.spec = spec
+        self.state = NodeState.PENDING
+        self.round = 0
+        #: every job this node submitted, in order (loop rounds)
+        self.jobs: "list[str]" = []
+        #: the CURRENT (or final) round's job id
+        self.job_id = ""
+        #: the settled output dir (final round's, for loops)
+        self.output_dir = ""
+        self.num_reduces = 0
+        self.error = ""
+
+    @property
+    def is_loop(self) -> bool:
+        return self.spec.get("loop") is not None
+
+    def round_conf(self, pipeline_conf: dict, rnd: int) -> dict:
+        conf = dict(pipeline_conf)
+        conf.update(self.spec["conf"])
+        return expand_round(conf, rnd) if self.is_loop else conf
+
+
+class PipelineInProgress:
+    def __init__(self, pipeline_id: str, graph: JobGraph,
+                 user: str = "") -> None:
+        self.pipeline_id = pipeline_id
+        self.graph = graph
+        self.user = user
+        self.state = PipelineState.RUNNING
+        self.error = ""
+        #: wall stamp for status surfaces AND the scheduler's pipeline
+        #: anchor (stage jobs inherit this as their FIFO sort key so a
+        #: late stage never queues behind jobs submitted mid-pipeline)
+        self.start_time = time.time()
+        self.finish_time = 0.0
+        self.nodes: "dict[str, _Node]" = {
+            nid: _Node(spec) for nid, spec in graph.nodes.items()}
+        self.order = graph.topo_order()
+        #: open pipeline root span (traced pipelines only)
+        self.trace_root: Any = None
+        self.trace_id = ""
+
+    # --------------------------------------------------------- queries
+
+    def node_of_job(self, job_id: str) -> "str | None":
+        for nid, n in self.nodes.items():
+            if job_id in n.jobs:
+                return nid
+        return None
+
+    def status_dict(self) -> dict:
+        return {
+            "pipeline_id": self.pipeline_id,
+            "name": self.graph.name,
+            "state": self.state,
+            "error": self.error,
+            "start_time": self.start_time,
+            "finish_time": self.finish_time,
+            "nodes": {nid: {
+                "state": n.state,
+                "round": n.round,
+                "rounds_run": len(n.jobs),
+                "job_id": n.job_id,
+                "jobs": list(n.jobs),
+                "output_dir": n.output_dir,
+                "error": n.error,
+            } for nid, n in self.nodes.items()},
+        }
+
+    # ------------------------------------------------------- readiness
+
+    def _upstream_ready(self, master: Any, nid: str) -> bool:
+        """All in-edges satisfied for ``nid``'s (next) submission.
+        Reads of member jobs are lock-free (job table insert-only;
+        jip.state / finished_reduces are GIL-atomic reads — staleness
+        costs one extra advance pass, never correctness)."""
+        for e in self.graph.upstreams(nid):
+            up = self.nodes[e["src"]]
+            if up.state != NodeState.SUCCEEDED:
+                return False
+            if e["stream"]:
+                continue   # a SUCCEEDED stream upstream is settled
+            jip = master.jobs.get(up.job_id)
+            if jip is not None and not jip.finalized.is_set():
+                return False   # output not promoted yet
+        return True
+
+    def _stream_ready(self, master: Any, nid: str) -> bool:
+        """Early readiness for an all-stream-in-edge node: every
+        upstream has settled WHICH job serves (non-loop: its only job;
+        loop: the final round) and that job began committing reduces."""
+        ins = self.graph.upstreams(nid)
+        if not ins or not all(e["stream"] for e in ins):
+            return False
+        for e in ins:
+            up = self.nodes[e["src"]]
+            if up.state == NodeState.SUCCEEDED:
+                continue
+            if up.is_loop or up.state != NodeState.RUNNING:
+                # a loop's current round may not be its last — wait for
+                # the node to settle (documented degradation)
+                return False
+            jip = master.jobs.get(up.job_id)
+            if jip is None or jip.finished_reduces < 1:
+                return False
+        return True
+
+    # --------------------------------------------------------- advance
+
+    def plan_locked(self, master: Any
+                    ) -> "tuple[list[tuple[str, int]], list[tuple[str, str]]]":
+        """Fold member-job outcomes into node states and return
+        ``(plans, unresolved)``: the (node, round) submissions now due
+        (marked SUBMITTING), and (node, job_id) pairs whose job only
+        HISTORY remembers — the caller resolves those OUTSIDE this lock
+        (history reads are file I/O) and feeds the verdicts back via
+        :meth:`apply_retired`. Caller holds the master's pipeline lock;
+        everything here is in-memory — no I/O, no ranked lock below
+        ``pipeline`` (job-state reads are lock-free)."""
+        if self.state in PipelineState.TERMINAL:
+            return [], []
+        plans: "list[tuple[str, int]]" = []
+        unresolved: "list[tuple[str, str]]" = []
+        for nid in self.order:
+            n = self.nodes[nid]
+            if n.state == NodeState.RUNNING:
+                jip = master.jobs.get(n.job_id)
+                if jip is None:
+                    unresolved.append((nid, n.job_id))
+                else:
+                    self._fold_job_outcome(nid, n, jip, plans)
+            if n.state == NodeState.PENDING \
+                    and (self._upstream_ready(master, nid)
+                         or self._stream_ready(master, nid)):
+                n.state = NodeState.SUBMITTING
+                plans.append((nid, n.round))
+        if self.state == PipelineState.RUNNING and all(
+                n.state == NodeState.SUCCEEDED
+                for n in self.nodes.values()):
+            self.state = PipelineState.SUCCEEDED
+            self.finish_time = time.time()
+        return plans, unresolved
+
+    def _fold_job_outcome(self, nid: str, n: _Node, jip: Any,
+                          plans: "list[tuple[str, int]]") -> None:
+        """One RUNNING node's live current job: settle, iterate, or
+        fail. Caller holds the pipeline lock."""
+        st = jip.state
+        if st == "SUCCEEDED":
+            if n.is_loop and not self._loop_settled(n, jip):
+                n.round += 1
+                n.state = NodeState.SUBMITTING
+                plans.append((nid, n.round))
+                return
+            n.state = NodeState.SUCCEEDED
+        elif st in ("FAILED", "KILLED"):
+            n.state = NodeState.FAILED
+            n.error = jip.error or f"stage job {n.job_id} {st}"
+            self._fail(f"stage {nid!r} {st.lower()}: {n.error}")
+
+    def apply_retired(self, nid: str, state: str) -> None:
+        """Feed back one history-resolved stage outcome (caller re-took
+        the pipeline lock). Loops settle conservatively — the finished
+        round's counters died with the old master, so convergence can't
+        be evaluated and the loop keeps iterating toward max_rounds."""
+        n = self.nodes.get(nid)
+        if n is None or n.state != NodeState.RUNNING:
+            return
+        if state == "SUCCEEDED":
+            if n.is_loop and n.round + 1 < int(
+                    n.spec["loop"]["max_rounds"]):
+                n.round += 1
+                n.state = NodeState.PENDING
+            else:
+                n.state = NodeState.SUCCEEDED
+        elif state in ("FAILED", "KILLED"):
+            n.state = NodeState.FAILED
+            n.error = f"stage job {n.job_id} {state} (history)"
+            self._fail(f"stage {nid!r} {state.lower()}: {n.error}")
+
+    def _loop_settled(self, n: _Node, jip: Any) -> bool:
+        """True when this loop node is done iterating: convergence
+        predicate holds on the finished round's counters, or the
+        max-rounds cutoff is reached."""
+        loop = n.spec["loop"]
+        if n.round + 1 >= int(loop["max_rounds"]):
+            return True
+        conv = loop.get("converge")
+        if not conv or jip is None:
+            return False
+        value = jip.counters.value(str(conv["group"]),
+                                   str(conv["counter"]))
+        threshold = conv["value"]
+        op = conv["op"]
+        return (value < threshold if op == "lt" else
+                value <= threshold if op == "le" else
+                value > threshold if op == "gt" else
+                value >= threshold)
+
+    @staticmethod
+    def _retired_state(master: Any, job_id: str) -> str:
+        """Terminal state of a stage job only history remembers (the
+        job finished before a master restart)."""
+        if not job_id:
+            return "RUNNING"
+        st = master.history.retired_job_status(job_id)
+        return str(st["state"]) if st else "RUNNING"
+
+    def _fail(self, error: str) -> None:
+        if self.state in PipelineState.TERMINAL:
+            return
+        self.state = PipelineState.FAILED
+        self.error = self.error or error
+        self.finish_time = time.time()
+        for n in self.nodes.values():
+            if n.state in (NodeState.PENDING, NodeState.SUBMITTING):
+                n.state = NodeState.SKIPPED
+
+    def record_submitted(self, nid: str, rnd: int, job_id: str,
+                         output_dir: str, num_reduces: int) -> bool:
+        """A planned submission landed (caller re-took the pipeline
+        lock). Returns False when the pipeline died while the
+        submission was in flight outside the lock (kill/fail flipped
+        the SUBMITTING node) — the CALLER must kill the just-submitted
+        job, or it runs to completion as an orphan burning slots."""
+        n = self.nodes[nid]
+        n.jobs.append(job_id)
+        n.job_id = job_id
+        n.round = rnd
+        n.output_dir = output_dir
+        n.num_reduces = num_reduces
+        if n.state == NodeState.SUBMITTING:
+            n.state = NodeState.RUNNING
+            return True
+        return False
+
+    def record_submit_failed(self, nid: str, error: str) -> None:
+        n = self.nodes[nid]
+        n.state = NodeState.FAILED
+        n.error = error
+        self._fail(f"stage {nid!r} submission failed: {error}")
+
+    def kill(self) -> "list[str]":
+        """Mark KILLED; returns the in-flight stage job ids the caller
+        must kill (outside the pipeline lock — kill_job does I/O)."""
+        if self.state in PipelineState.TERMINAL:
+            return []
+        self.state = PipelineState.KILLED
+        self.finish_time = time.time()
+        victims = []
+        for n in self.nodes.values():
+            if n.state == NodeState.RUNNING:
+                # settle the node observably — advancement stops on a
+                # terminal pipeline, so nothing would ever fold it
+                if n.job_id:
+                    victims.append(n.job_id)
+                n.state = NodeState.FAILED
+                n.error = n.error or "killed with pipeline"
+            if n.state in (NodeState.PENDING, NodeState.SUBMITTING):
+                n.state = NodeState.SKIPPED
+        return victims
+
+    # -------------------------------------------------------- recovery
+
+    @staticmethod
+    def from_recovery(pipeline_id: str, graph_dict: dict,
+                      stage_events: "list[dict]", master: Any,
+                      user: str = "") -> "PipelineInProgress":
+        """Rebuild an interrupted pipeline from its journal: replay each
+        PIPELINE_STAGE_SUBMITTED through the master's job-recovery alias
+        (a stage job the restart resubmitted is watched under its NEW
+        id), adopt history-terminal stages without re-running them, and
+        leave the rest for normal advancement."""
+        pip = PipelineInProgress(
+            pipeline_id, JobGraph.from_dict(graph_dict), user=user)
+        # a traced pipeline keeps its trace identity across the restart
+        # (the id was stamped into the journaled graph conf): the
+        # merged trace file spans both masters' spans. No root span —
+        # the old master's root closed with it.
+        pip.trace_id = str(pip.graph.conf.get("tpumr.trace.id", "")
+                           or "")
+        for ev in stage_events:
+            nid = str(ev.get("node", ""))
+            n = pip.nodes.get(nid)
+            if n is None:
+                continue
+            job_id = str(ev.get("stage_job_id", ""))
+            job_id = master._recovered.get(job_id, job_id)
+            n.jobs.append(job_id)
+            n.job_id = job_id
+            n.round = int(ev.get("round", 0) or 0)
+            n.output_dir = str(ev.get("output_dir", "") or "")
+            n.num_reduces = int(ev.get("num_reduces", 0) or 0)
+            n.state = NodeState.RUNNING
+        # settle nodes whose job already has a terminal outcome: live
+        # recovered jobs fold on the first advance; history-only jobs
+        # (finished before the crash) settle here so completed upstream
+        # stages are adopted, never re-run (runs at master startup —
+        # no ranked lock held, history file reads are fine)
+        for nid, n in pip.nodes.items():
+            if n.state == NodeState.RUNNING \
+                    and master.jobs.get(n.job_id) is None:
+                pip.apply_retired(nid, pip._retired_state(master,
+                                                          n.job_id))
+        return pip

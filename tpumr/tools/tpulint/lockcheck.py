@@ -65,7 +65,8 @@ TUPLE_LOCK_METHODS = {"shard_of": "RANK_TRACKERS"}
 #: fallback rank table; overridden by whatever tpumr/metrics/locks.py
 #: actually declares when it is in the corpus
 DEFAULT_RANKS = {"RANK_TRACKER_BEAT": 5, "RANK_SCHEDULER": 10,
-                 "RANK_GLOBAL": 20, "RANK_TRACKERS": 30, "RANK_JOB": 40}
+                 "RANK_PIPELINE": 15, "RANK_GLOBAL": 20,
+                 "RANK_TRACKERS": 30, "RANK_JOB": 40}
 
 _SOCKETY = ("sock", "conn", "channel")
 _THREADY = ("thread", "worker", "pumper", "_t")
