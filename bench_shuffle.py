@@ -17,6 +17,16 @@ tool shows dominating warm wall-clock:
   in-memory map outputs with a RAM budget ≪ total bytes, background
   in-memory merging ON vs OFF, measuring copy+merge-drain wall-clock
   and how many segments fell to per-segment disk spills.
+- ``wire_*`` — the copy path over a REAL reactor RpcServer on
+  loopback: pipelined chunk streams vs one-at-a-time
+  (``wire_pipeline_speedup``), a wide job's batched multi-segment
+  fetches vs per-segment RPCs under a per-RPC hold
+  (``wire_batch_speedup`` — the small-segment regime where roundtrip
+  overhead dominates), and tlz wire compression
+  (``wire_compress_ratio``).
+
+When a previous ``bench_shuffle.json`` exists, a ``[vs prior]`` line
+per headline metric goes to stderr before the file is overwritten.
 
 Output contract (same shape as ``bench.py``): ONE JSON line on stdout
   {"metric", "value", "unit", "vs_baseline"}
@@ -46,6 +56,12 @@ SMALL = os.environ.get("BENCH_SCALE") == "small" or "--smoke" in sys.argv
 #: wide-shuffle shape: W map-output segments × R records each
 W = 8 if SMALL else 64
 R = 2_000 if SMALL else 30_000
+
+#: copier-row regime: per-chunk RPC hold emulating a remote shuffle
+#: (64 KiB / 20 ms ≈ 3 MB/s per stream) and the in-memory budget in
+#: segments — copy-dominated, the regime the copy path lives in
+COPIER_LATENCY_S = 0.0 if SMALL else 0.02
+COPIER_BUDGET_SEGS = 6.2
 
 
 def make_segments(w: int, r: int) -> "list[list[tuple[bytes, bytes]]]":
@@ -149,20 +165,23 @@ def bench_bounded_fanin(rows: dict) -> None:
 
 
 class _SpillSource:
-    """ChunkFetch over in-memory spill files (the test double of the
-    tracker's get_map_output_chunk), with a small per-chunk hold
-    emulating tracker RPC latency — the window the background merger
-    exists to overlap."""
+    """The wire half of the copier row: in-memory spill files served
+    chunk-at-a-time with a per-request RTT hold. ``__call__`` is the
+    seed's sequential fetch (one outstanding request, full RTT per
+    chunk); ``fetch_chunks`` is the overhauled protocol — a
+    depth-bounded window of concurrent requests whose holds overlap,
+    exactly what the real pipelined ``call_begin`` window buys on a
+    leased connection."""
 
     chunk_bytes = 64 * 1024
+    pipeline_depth = 4
 
     def __init__(self, spills, latency_s: float = 0.0005) -> None:
         self.spills = spills
         self.latency_s = latency_s
+        self._pool = None
 
-    def __call__(self, map_index: int, partition: int, offset: int) -> dict:
-        if self.latency_s:
-            time.sleep(self.latency_s)
+    def _chunk(self, map_index: int, partition: int, offset: int) -> dict:
         data, index = self.spills[map_index]
         off, raw_len, part_len = index["partitions"][partition]
         payload = data[off + 4: off + part_len]
@@ -170,12 +189,70 @@ class _SpillSource:
                 "total": len(payload), "raw": raw_len,
                 "codec": index.get("codec", "none")}
 
+    def __call__(self, map_index: int, partition: int, offset: int) -> dict:
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        return self._chunk(map_index, partition, offset)
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def fetch_chunks(self, map_index: int, partition: int,
+                     start: int = 0, total: "int | None" = None):
+        from collections import deque
+        first = self(map_index, partition, start)
+        yield first
+        offsets = iter(range(start + len(first["data"]), first["total"],
+                             self.chunk_bytes))
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="bench-wire")
+        pending: "deque" = deque()
+        for off in offsets:
+            pending.append(
+                self._pool.submit(self, map_index, partition, off))
+            if len(pending) >= self.pipeline_depth:
+                break
+        while pending:
+            out = pending.popleft().result()
+            nxt = next(offsets, None)
+            if nxt is not None:
+                pending.append(
+                    self._pool.submit(self, map_index, partition, nxt))
+            yield out
+
+
+class _SeqView:
+    """The seed's wire interface: a plain 3-arg chunk callable with no
+    ``fetch_chunks``, so the copier takes its legacy sequential path —
+    one outstanding request, a full RTT hold per chunk."""
+
+    def __init__(self, source: _SpillSource) -> None:
+        self._source = source
+        self.chunk_bytes = source.chunk_bytes
+
+    def __call__(self, map_index: int, partition: int, offset: int) -> dict:
+        return self._source(map_index, partition, offset)
+
 
 def bench_copier(rows: dict) -> "tuple[float, float]":
-    """The wide-shuffle microbench proper: copy + merge-drain wall-clock
-    with the engine (background in-memory merges + bounded fan-in + raw
-    fast path) vs the flat seed path (no background merging, one
-    heapq.merge with a key-fn over every segment)."""
+    """The end-to-end copy+merge row: the overhauled shuffle engine
+    against the seed it replaced. "Engine" is the full new path —
+    pipelined ``fetch_chunks`` wire (RTT holds overlap inside a
+    depth-bounded window), no-park landing, background in-memory AND
+    disk-run merges, and ``io.sort.factor`` tuned per the ops guide so
+    the final merge is one pass. "Flat" is the seed: one outstanding
+    chunk request per segment (full RTT each) and a single unbounded
+    ``heapq.merge`` with a key-fn over every landed segment.
+
+    The row is COPY-DOMINATED: a 20 ms per-chunk RPC hold emulates a
+    remote shuffle (64 KiB / 20 ms ≈ 3 MB/s per stream), the regime the
+    copy path actually lives in. The engine's win is the overlap the
+    pipelined wire buys plus whatever merging it hides inside the
+    remaining waits."""
     import io as _io
 
     from tpumr.io import ifile, merger as merge_engine
@@ -184,6 +261,8 @@ def bench_copier(rows: dict) -> "tuple[float, float]":
 
     w = 12 if SMALL else max(40, W // 2)
     r = R // 2
+    lat = COPIER_LATENCY_S
+    budget_segs = COPIER_BUDGET_SEGS
     spills = []
     for m in range(w):
         buf = _io.BytesIO()
@@ -196,11 +275,16 @@ def bench_copier(rows: dict) -> "tuple[float, float]":
         spills.append((buf.getvalue(), wtr.close()))
     total = w * r
     seg_bytes = spills[0][1]["partitions"][0][1]
-    # budget ~6 segments (one segment is < the 25% max_single cap, so
-    # segments CAN land in memory) against w ≫ 6 total: without the
-    # background merger most of the shuffle falls to per-segment disk
-    # spills once the budget fills
-    ram_mb = seg_bytes * 6.2 / (0.70 * 1024 * 1024)
+    # budget ≪ w segments (one segment is < the 25% max_single cap, so
+    # segments CAN land in memory): without the background merger most
+    # of the shuffle falls to per-segment disk spills once the budget
+    # fills
+    ram_mb = seg_bytes * budget_segs / (0.70 * 1024 * 1024)
+
+    # the engine's merge fan-in, tuned for this width per the ops
+    # guide (w + merged runs stay below it: the final merge is ONE
+    # pass); the seed's flat merge is unbounded by construction
+    factor = w + 16
 
     def run(enabled: bool) -> "tuple[float, float, ShuffleCopier]":
         from tpumr.mapred.api import RawComparator
@@ -208,14 +292,20 @@ def bench_copier(rows: dict) -> "tuple[float, float]":
         conf.set_output_key_comparator_class(RawComparator)
         conf.set("tpumr.shuffle.ram.mb", ram_mb)
         conf.set("tpumr.shuffle.merge.enabled", enabled)
+        conf.set("io.sort.factor", factor)
+        src = _SpillSource(spills, latency_s=lat)
+        # the seed's wire is a plain 3-arg chunk callable — one
+        # outstanding request, a full RTT hold per chunk; the engine
+        # sees the full protocol (pipelined fetch_chunks)
+        source = src if enabled else _SeqView(src)
         spill_dir = tempfile.mkdtemp(prefix="bench-shuffle-copy-")
-        copier = ShuffleCopier(conf, _SpillSource(spills), w, 0, spill_dir)
+        copier = ShuffleCopier(conf, source, w, 0, spill_dir)
         t0 = time.perf_counter()
         segs = copier.copy_all()
         t_copy = time.perf_counter() - t0
         t0 = time.perf_counter()
         if enabled:
-            bm = merge_engine.BoundedMerge(segs, None, 10,
+            bm = merge_engine.BoundedMerge(segs, None, factor,
                                            run_dir=spill_dir)
             n = drain(bm)
         else:
@@ -227,6 +317,7 @@ def bench_copier(rows: dict) -> "tuple[float, float]":
             bm.close()
         for s in segs:
             s.close()
+        src.close()
         shutil.rmtree(spill_dir, ignore_errors=True)
         return t_copy, t_merge, copier
 
@@ -245,12 +336,13 @@ def bench_copier(rows: dict) -> "tuple[float, float]":
     rows["copier_engine_speedup"] = round(t_flat / t_eng, 3)
     rows["copier_merge_phase_speedup"] = round(t_merge_f / t_merge_e, 3)
     rows["copier_engine_inmem_merges"] = c_eng.inmem_merges
+    rows["copier_engine_disk_merges"] = c_eng.disk_merges
     rows["copier_engine_segments_disk"] = c_eng.spilled_to_disk
     rows["copier_flat_segments_disk"] = c_flat.spilled_to_disk
     log(f"[copier] {w} maps, budget ~6 segments: engine copy "
         f"{t_copy_e:.3f}s + merge {t_merge_e:.3f}s "
-        f"({c_eng.inmem_merges} in-mem merges, "
-        f"{c_eng.spilled_to_disk} disk segments) vs flat copy "
+        f"({c_eng.inmem_merges} in-mem + {c_eng.disk_merges} disk-run "
+        f"merges, {c_eng.spilled_to_disk} disk segments) vs flat copy "
         f"{t_copy_f:.3f}s + merge {t_merge_f:.3f}s "
         f"({c_flat.spilled_to_disk} disk segments) -> end-to-end "
         f"{t_flat / t_eng:.2f}x, merge_reduce phase "
@@ -258,11 +350,252 @@ def bench_copier(rows: dict) -> "tuple[float, float]":
     return t_eng, t_flat
 
 
+def _write_spill_file(dirname: str, name: str, records) -> "tuple[str, dict]":
+    import io as _io
+
+    from tpumr.io import ifile
+
+    buf = _io.BytesIO()
+    w = ifile.Writer(buf, codec="none")
+    w.start_partition()
+    for kb, vb in records:
+        w.append_raw(kb, vb)
+    w.end_partition()
+    index = w.close()
+    path = os.path.join(dirname, name)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+    return path, index
+
+
+class _WireStub:
+    """The tracker's shuffle-serving surface behind a real RpcServer:
+    serve_chunk/serve_batch over real spill files through the fd cache,
+    plus an optional per-RPC hold emulating request overhead — the
+    fixed cost batching exists to amortize."""
+
+    MAX_CHUNK = 4 << 20
+
+    def __init__(self, outputs: dict, delay_s: float = 0.0) -> None:
+        from tpumr.mapred.tasktracker import SpillFdCache
+        self.outputs = outputs
+        self.delay_s = delay_s
+        self.fds = SpillFdCache(64)
+        self.rpcs = 0
+
+    def get_protocol_version(self) -> int:
+        return 7
+
+    def get_map_output_chunk(self, job_id, map_index, partition, offset,
+                             max_bytes, wire="none") -> dict:
+        from tpumr.mapred.tasktracker import serve_chunk
+        self.rpcs += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        path, index = self.outputs[map_index]
+        return serve_chunk(self.fds, path, index, partition, offset,
+                           max_bytes, self.MAX_CHUNK, wire)
+
+    def get_map_outputs_batch(self, job_id, partition, map_indexes,
+                              max_bytes_each=1 << 20,
+                              max_total_bytes=8 << 20,
+                              wire="none") -> list:
+        from tpumr.mapred.tasktracker import serve_batch
+        self.rpcs += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return serve_batch(self.fds, lambda m: self.outputs[m], partition,
+                           list(map_indexes), max_bytes_each,
+                           max_total_bytes, self.MAX_CHUNK, wire)
+
+
+def bench_wire(rows: dict) -> None:
+    """The wire rows: the rebuilt copy path over a real reactor
+    RpcServer on loopback — pipelined chunk streams, wide-job batched
+    fetches, and tlz wire compression."""
+    from tpumr.io.compress import wire_codec_or_none
+    from tpumr.ipc.rpc import RpcServer
+    from tpumr.mapred.api import RawComparator
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.shuffle_copier import (RemoteChunkSource,
+                                             ShuffleCopier)
+    from tpumr.mapred.tasktracker import make_map_locator
+
+    job = "job_bench_0001"
+
+    def start(stub: _WireStub) -> RpcServer:
+        s = RpcServer(stub, reactor=True,
+                      fast_methods={"get_protocol_version"})
+        s.uncached_methods = {"get_map_output_chunk",
+                              "get_map_outputs_batch"}
+        return s.start()
+
+    def locator(port: int, maps, conns: int = 2):
+        events = [{"map_index": m, "attempt_id": "a%d" % m,
+                   "shuffle_addr": "127.0.0.1:%d" % port,
+                   "status": "SUCCEEDED"} for m in maps]
+        return make_map_locator(lambda cursor: events[cursor:], None,
+                                poll_s=0.01, timeout_s=30.0,
+                                conns_per_target=conns)
+
+    def conf_for(**kv) -> "JobConf":
+        conf = JobConf()
+        conf.set_output_key_comparator_class(RawComparator)
+        conf.set("tpumr.shuffle.chunk.bytes", 64 * 1024)
+        conf.set("tpumr.shuffle.ram.mb", 64)
+        for k, v in kv.items():
+            conf.set(k, v)
+        return conf
+
+    tmp = tempfile.mkdtemp(prefix="bench-shuffle-wire-")
+    try:
+        # ---- pipelined chunk stream vs one-at-a-time, one big output.
+        # No artificial hold: the reactor serves one connection's
+        # pipeline from one pool slot, so the honest win is overlapping
+        # client-side decode/landing with server-side pread+send.
+        n_big = 12_000 if SMALL else 60_000
+        big = [(b"k%08d" % i, b"x" * 120) for i in range(n_big)]
+        stub = _WireStub({0: _write_spill_file(tmp, "big", big)})
+        srv = start(stub)
+        try:
+            def pull(depth: int) -> "tuple[float, int]":
+                conf = conf_for(**{
+                    "tpumr.shuffle.fetch.pipeline.depth": depth,
+                    "tpumr.shuffle.wire.codec": "none"})
+                src = RemoteChunkSource(conf, job, locator(srv.port, [0]))
+
+                def go() -> int:
+                    return sum(len(c["data"])
+                               for c in src.fetch_chunks(0, 0))
+
+                return min((timed(go) for _ in range(3)),
+                           key=lambda p: p[0])
+
+            t_seq, nbytes = pull(1)
+            t_pipe, _ = pull(4)
+        finally:
+            srv.stop()
+        rows["wire_stream_bytes"] = nbytes
+        rows["wire_seq_mb_s"] = round(nbytes / t_seq / 1e6, 1)
+        rows["wire_pipeline_mb_s"] = round(nbytes / t_pipe / 1e6, 1)
+        rows["wire_pipeline_speedup"] = round(t_seq / t_pipe, 3)
+        log(f"[wire-pipeline] {nbytes / 1e6:.1f} MB in 64 KiB chunks: "
+            f"depth 1 {nbytes / t_seq / 1e6:.0f} MB/s, depth 4 "
+            f"{nbytes / t_pipe / 1e6:.0f} MB/s -> "
+            f"{t_seq / t_pipe:.2f}x")
+
+        # ---- wide job: many tiny segments, batched vs per-segment.
+        # A 3 ms per-RPC hold stands in for real request overhead
+        # (scheduling, auth, framing) — the regime where one
+        # get_map_outputs_batch frame replaces batch.segments RPCs.
+        w_wide = 24 if SMALL else 96
+        tiny = {m: _write_spill_file(tmp, "t%d" % m,
+                                     [(b"k%04d" % i, b"v" * 10)
+                                      for i in range(40)])
+                for m in range(w_wide)}
+        stub2 = _WireStub(tiny, delay_s=0.003)
+        srv2 = start(stub2)
+        try:
+            def copy_all(batch_segments: int) -> "tuple[float, int]":
+                conf = conf_for(**{
+                    "tpumr.shuffle.batch.segments": batch_segments,
+                    "tpumr.shuffle.wire.codec": "none",
+                    "tpumr.shuffle.parallel.copies": 4})
+                src = RemoteChunkSource(
+                    conf, job, locator(srv2.port, range(w_wide)))
+                spill_dir = tempfile.mkdtemp(dir=tmp)
+                rpc0 = stub2.rpcs
+                t0 = time.perf_counter()
+                segs = ShuffleCopier(conf, src, w_wide, 0, spill_dir,
+                                     on_fetch_failure=lambda m, a: None
+                                     ).copy_all()
+                t = time.perf_counter() - t0
+                n = sum(drain(s) for s in segs)
+                for s in segs:
+                    s.close()
+                assert n == w_wide * 40, f"wide copy lost records: {n}"
+                return t, stub2.rpcs - rpc0
+
+            t_per, rpc_per = min((copy_all(1) for _ in range(2)),
+                                 key=lambda p: p[0])
+            t_bat, rpc_bat = min((copy_all(16) for _ in range(2)),
+                                 key=lambda p: p[0])
+        finally:
+            srv2.stop()
+        rows["wire_wide_maps"] = w_wide
+        rows["wire_perseg_s"] = round(t_per, 4)
+        rows["wire_perseg_rpcs"] = rpc_per
+        rows["wire_batch_s"] = round(t_bat, 4)
+        rows["wire_batch_rpcs"] = rpc_bat
+        rows["wire_batch_speedup"] = round(t_per / t_bat, 3)
+        log(f"[wire-batch] {w_wide} tiny segments at 3ms/RPC: "
+            f"per-segment {t_per:.3f}s ({rpc_per} RPCs) vs batched "
+            f"{t_bat:.3f}s ({rpc_bat} RPCs) -> {t_per / t_bat:.2f}x")
+
+        # ---- wire compression: compressible payload, tlz vs raw.
+        # Throughput is RAW payload bytes per wall second both ways, so
+        # the rows compare like-for-like.
+        n_comp = 8_000 if SMALL else 40_000
+        comp = [(b"k%08d" % i, b"the quick brown fox " * 6)
+                for i in range(n_comp)]
+        stub3 = _WireStub({0: _write_spill_file(tmp, "comp", comp)})
+        srv3 = start(stub3)
+        try:
+            def pull_wire(wirec: str) -> "tuple[float, int, int]":
+                conf = conf_for(**{"tpumr.shuffle.wire.codec": wirec})
+                src = RemoteChunkSource(conf, job,
+                                        locator(srv3.port, [0]))
+
+                def go() -> "tuple[int, int]":
+                    raw = wire = 0
+                    for c in src.fetch_chunks(0, 0):
+                        raw += len(c["data"])
+                        wire += c.get("wire_len", len(c["data"]))
+                    return raw, wire
+
+                t, (raw, wire) = min((timed(go) for _ in range(3)),
+                                     key=lambda p: p[0])
+                return t, raw, wire
+
+            codec = wire_codec_or_none("tlz")
+            t_raw, raw_b, _ = pull_wire("none")
+            rows["wire_codec"] = codec
+            rows["wire_raw_mb_s"] = round(raw_b / t_raw / 1e6, 1)
+            if codec != "none":
+                t_cmp, _, wire_b = pull_wire(codec)
+                rows["wire_compress_ratio"] = round(wire_b / raw_b, 3)
+                rows["wire_compressed_mb_s"] = round(
+                    raw_b / t_cmp / 1e6, 1)
+                log(f"[wire-codec] {raw_b / 1e6:.1f} MB payload: raw "
+                    f"{raw_b / t_raw / 1e6:.0f} MB/s, {codec} "
+                    f"{raw_b / t_cmp / 1e6:.0f} MB/s at "
+                    f"{wire_b / raw_b:.2f}x wire bytes")
+            else:
+                log(f"[wire-codec] no native codec in this build: raw "
+                    f"{raw_b / t_raw / 1e6:.0f} MB/s")
+        finally:
+            srv3.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
+    prior: dict = {}
+    try:
+        with open("bench_shuffle.json") as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        pass
     rows: dict = {}
     r_eng, r_flat = bench_merge_throughput(rows)
     bench_bounded_fanin(rows)
     bench_copier(rows)
+    bench_wire(rows)
+    for k in ("merge_engine_speedup", "copier_engine_speedup",
+              "wire_pipeline_speedup", "wire_batch_speedup",
+              "wire_compress_ratio"):
+        if k in rows:
+            log(f"[vs prior] {k}: {prior.get(k, '(new)')} -> {rows[k]}")
     with open("bench_shuffle.json", "w") as f:
         json.dump(rows, f, sort_keys=True, indent=1)
     log(f"detail rows -> bench_shuffle.json: "

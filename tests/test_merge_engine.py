@@ -7,6 +7,7 @@ import heapq
 import io
 import os
 import random
+import time
 
 import pytest
 
@@ -18,7 +19,8 @@ from tpumr.mapred.jobconf import JobConf
 from tpumr.core.counters import TaskCounter
 from tpumr.io.writable import deserialize, serialize
 from tpumr.mapred.shuffle_copier import (DiskSegment, MemorySegment,
-                                         ShuffleCopier)
+                                         ShuffleCopier, ShuffleMergeManager,
+                                         ShuffleRamManager)
 
 
 def rand_segments(n_segs, n_recs, seed=0, dup_keys=True):
@@ -368,6 +370,101 @@ class TestBackgroundMerge:
             TaskCounter.COMBINE_INPUT_RECORDS) > 0
         for s in segs:
             s.close()
+
+
+class TestDiskBackgroundMerge:
+    """The disk-side merger thread (≈ the reference LocalFSMerger):
+    accumulated per-segment disk spills fold into sorted runs while the
+    copy phase is still fetching."""
+
+    def _disk_segments(self, tmp_path, n, n_recs=120):
+        segs, records = [], []
+        for m in range(n):
+            recs = rand_segments(1, n_recs, seed=100 + m)[0]
+            data, index = make_spill(recs)
+            p = tmp_path / f"spill-{m}.out"
+            p.write_bytes(data)
+            off, raw_len, part_len = index["partitions"][0]
+            segs.append(DiskSegment(str(p), "none", raw_len,
+                                    offset=off + 4, length=part_len - 4))
+            records.append(recs)
+        return segs, records
+
+    def test_manager_folds_spills_into_runs(self, tmp_path):
+        """9 spills at factor 4 → exactly two background merges; the
+        ninth stays unmerged (a live segment for the final merge), and
+        runs + leftover together hold exactly the input records."""
+        conf = conf_for_copier(1.0)
+        conf.set("io.sort.factor", 4)
+        reporter = Reporter()
+        mgr = ShuffleMergeManager(conf, ShuffleRamManager(1 << 20),
+                                  str(tmp_path), reporter, None)
+        segs, records = self._disk_segments(tmp_path, 9)
+        for m, s in enumerate(segs):
+            assert mgr.offer_disk(m, s)
+        deadline = time.monotonic() + 10
+        while mgr.disk_merges < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        runs = mgr.finish()
+        assert mgr.disk_merges == 2
+        assert mgr.disk_merge_segments == 8
+        assert len(runs) == 2
+        leftovers = [s for s in segs if id(s) not in mgr.merged_ids]
+        assert len(leftovers) == 1
+        for run in runs:
+            keys = [k for k, _ in run]
+            assert keys == sorted(keys)
+        got = sorted(kv for src in (*runs, *leftovers) for kv in src)
+        assert got == sorted(kv for recs in records for kv in recs)
+        assert reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.SHUFFLE_DISK_MERGES) == 2
+        assert reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.SHUFFLE_DISK_MERGE_SEGMENTS) == 8
+        for s in (*runs, *leftovers):
+            s.close()
+
+    def test_copier_disk_merges_under_slow_wire(self, tmp_path):
+        """Copier-level wiring: with a tiny budget (most segments fall
+        to disk) and a slow wire, disk merges run mid-copy and the
+        merged stream still holds every record."""
+
+        class SlowSource(SpillChunkSource):
+            def __call__(self, map_index, partition, offset):
+                time.sleep(0.008)
+                return super().__call__(map_index, partition, offset)
+
+        n_maps, n_recs = 24, 200
+        spills = [make_spill(rand_segments(1, n_recs, seed=m)[0])
+                  for m in range(n_maps)]
+        seg_raw = spills[0][1]["partitions"][0][1]
+        # budget ~2 segments: nearly everything spills to disk
+        ram_mb = seg_raw * 2.2 / (0.70 * 1024 * 1024)
+        conf = conf_for_copier(ram_mb)
+        conf.set("io.sort.factor", 3)
+        reporter = Reporter()
+        copier = ShuffleCopier(conf, SlowSource(spills), n_maps, 0,
+                               str(tmp_path), reporter)
+        segs = copier.copy_all()
+        assert copier.disk_merges >= 1
+        assert reporter.counters.value(
+            TaskCounter.FRAMEWORK_GROUP,
+            TaskCounter.SHUFFLE_DISK_MERGES) == copier.disk_merges
+        bm = merge_engine.BoundedMerge(segs, None, 10,
+                                       run_dir=str(tmp_path))
+        got = list(bm)
+        assert len(got) == n_maps * n_recs
+        keys = [k for k, _ in got]
+        assert keys == sorted(keys)
+        expect = sorted(
+            kv for data, idx in spills
+            for kv in TestBackgroundMerge._read_spill(data, idx))
+        assert sorted(got) == expect
+        bm.close()
+        for s in segs:
+            s.close()
+        assert copier.ram.used == 0
 
 
 # ------------------------------------------------------- mid-batch spills

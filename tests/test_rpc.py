@@ -86,3 +86,101 @@ def test_concurrent_clients(server):
     for t in threads:
         t.join()
     assert len(results) == 160
+
+
+# ------------------------------------------------- pipelined client calls
+
+
+class SlowEchoService:
+    def get_protocol_version(self):
+        return 7
+
+    def echo(self, x):
+        return x
+
+    def slow_echo(self, x):
+        import time
+        time.sleep(0.01)
+        return x
+
+
+def test_call_begin_finish_fifo_reactor():
+    from tpumr.ipc.rpc import RpcServer
+    s = RpcServer(SlowEchoService(), reactor=True,
+                  fast_methods={"get_protocol_version"}).start()
+    try:
+        cli = RpcClient(*s.address)
+        for i in range(6):
+            cli.call_begin("slow_echo", i)
+        assert cli.outstanding == 6
+        # responses collect strictly FIFO — the reactor serves one
+        # connection's frames in request order
+        assert [cli.call_finish() for _ in range(6)] == list(range(6))
+        assert cli.outstanding == 0
+        # frames queued behind a busy pooled response stay ordered,
+        # fast methods included
+        assert s._reactor.pipeline_depth_peak > 1
+        cli.close()
+    finally:
+        s.stop()
+
+
+def test_call_finish_surfaces_remote_error_in_order():
+    from tpumr.ipc.rpc import RpcServer
+    s = RpcServer(EchoService(), reactor=True).start()
+    try:
+        cli = RpcClient(*s.address)
+        cli.call_begin("echo", "a")
+        cli.call_begin("boom")
+        cli.call_begin("echo", "b")
+        assert cli.call_finish() == "a"
+        with pytest.raises(RpcError, match="deliberate"):
+            cli.call_finish()
+        assert cli.call_finish() == "b"
+        cli.close()
+    finally:
+        s.stop()
+
+
+def test_client_pool_reuses_and_retires():
+    from tpumr.ipc.rpc import RpcClientPool, RpcServer
+    s = RpcServer(EchoService(), reactor=True).start()
+    addr = "%s:%d" % s.address
+    pool = RpcClientPool(lambda h, p: RpcClient(h, p), conns_per_target=2)
+    try:
+        a = pool.acquire(addr)
+        assert a.call("add", 1, 2) == 3
+        pool.release(addr, a)
+        b = pool.acquire(addr)
+        assert b is a                 # idle connection reused
+        assert pool.connects == 1
+        # a lease returned with uncollected responses is NEVER reused:
+        # the next caller would read the stale frames
+        b.call_begin("echo", "x")
+        assert b.outstanding == 1
+        pool.release(addr, b)
+        c = pool.acquire(addr)
+        assert c is not b
+        assert pool.connects == 2
+        pool.release(addr, c)
+    finally:
+        pool.close()
+        s.stop()
+
+
+def test_client_pool_caps_connections_per_target():
+    from tpumr.ipc.rpc import RpcClientPool, RpcServer
+    s = RpcServer(EchoService(), reactor=True).start()
+    addr = "%s:%d" % s.address
+    pool = RpcClientPool(lambda h, p: RpcClient(h, p), conns_per_target=1)
+    try:
+        a = pool.acquire(addr)
+        with pytest.raises(TimeoutError):
+            pool.acquire(addr, timeout_s=0.05)
+        pool.release(addr, a)
+        b = pool.acquire(addr)    # freed slot satisfies the waiter
+        assert b is a
+        pool.release(addr, b)
+    finally:
+        pool.close()
+        s.stop()

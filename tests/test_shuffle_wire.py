@@ -1,0 +1,366 @@
+"""The shuffle wire path: reactor-served pipelined chunk streams, batched
+multi-segment fetches, fd-cached serving, and wire compression (the copy
+side of the data plane — ≈ MapOutputServlet + MapOutputCopier, rebuilt
+around the selector-reactor RPC core)."""
+
+import io
+import os
+import time
+
+import pytest
+
+from tpumr.io import ifile
+from tpumr.io.compress import TlzCodec
+from tpumr.ipc.rpc import RpcServer
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.shuffle_copier import RemoteChunkSource, ShuffleCopier
+from tpumr.mapred.tasktracker import (SpillFdCache, make_map_locator,
+                                      serve_batch, serve_chunk)
+from tpumr.utils import fi
+
+JOB = "job_wire_0001"
+
+
+def write_spill(tmp_path, name, records, codec="none"):
+    buf = io.BytesIO()
+    w = ifile.Writer(buf, codec=codec)
+    w.start_partition()
+    for k, v in records:
+        w.append_raw(k, v)
+    w.end_partition()
+    index = w.close()
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    path = tmp_path / name
+    path.write_bytes(buf.getvalue())
+    return str(path), index
+
+
+def records_for(n, tag=b"m"):
+    # repetitive values: wire compression has something to win
+    return [(b"%s-%06d" % (tag, i), b"value" * 8) for i in range(n)]
+
+
+def payload_of(path, index, partition=0):
+    off, _raw, part_len = index["partitions"][partition]
+    with open(path, "rb") as f:
+        f.seek(off + 4)
+        return f.read(part_len - 4)
+
+
+class ShuffleServeStub:
+    """A tracker's shuffle-serving surface, minus the tracker: the same
+    serve_chunk/serve_batch core over real spill files, with the fi
+    ``shuffle.serve`` seams, behind a REAL RpcServer."""
+
+    MAX_CHUNK = 4 << 20
+
+    def __init__(self, outputs, conf=None, delay_s=0.0, fd_cap=64):
+        self.outputs = outputs          # map_index -> (path, index)
+        self.conf = conf if conf is not None else JobConf()
+        self.delay_s = delay_s
+        self.fds = SpillFdCache(fd_cap)
+
+    def get_protocol_version(self):
+        return 7
+
+    def _lookup(self, map_index):
+        from tpumr.utils.fi import maybe_fail
+        maybe_fail(f"shuffle.serve.m{map_index}", self.conf)
+        if map_index not in self.outputs:
+            raise KeyError(f"no map output for map {map_index}")
+        return self.outputs[map_index]
+
+    def get_map_output_chunk(self, job_id, map_index, partition, offset,
+                             max_bytes, wire="none"):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        path, index = self._lookup(map_index)
+        return serve_chunk(self.fds, path, index, partition, offset,
+                           max_bytes, self.MAX_CHUNK, wire)
+
+    def get_map_outputs_batch(self, job_id, partition, map_indexes,
+                              max_bytes_each=1 << 20,
+                              max_total_bytes=8 << 20, wire="none"):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return serve_batch(self.fds, self._lookup, partition,
+                           list(map_indexes), max_bytes_each,
+                           max_total_bytes, self.MAX_CHUNK, wire)
+
+
+def start_server(stub, reactor=True):
+    s = RpcServer(stub, reactor=reactor,
+                  fast_methods={"get_protocol_version"} if reactor
+                  else None)
+    s.uncached_methods = {"get_map_output_chunk", "get_map_outputs_batch"}
+    s.start()
+    return s
+
+
+def locator_for(port, maps, conns=2):
+    events = [{"map_index": m, "attempt_id": "a%d" % m,
+               "shuffle_addr": "127.0.0.1:%d" % port,
+               "status": "SUCCEEDED"} for m in maps]
+    return make_map_locator(lambda cursor: events[cursor:], None,
+                            poll_s=0.01, timeout_s=10.0,
+                            conns_per_target=conns)
+
+
+def wire_conf(**kv):
+    conf = JobConf()
+    defaults = {"tpumr.shuffle.chunk.bytes": 65536}
+    defaults.update(kv)
+    for k, v in defaults.items():
+        conf.set(k, v)
+    return conf
+
+
+def all_records(segs):
+    out = []
+    for s in segs:
+        out.extend(s)
+    return sorted(out)
+
+
+# ------------------------------------------------------------ serve core
+
+
+class TestSpillFdCache:
+    def test_eviction_under_many_jobs(self, tmp_path):
+        """10 spills through a 4-entry cache: bounded open fds, LRU
+        evictions, and every byte still served correctly."""
+        spills = [write_spill(tmp_path, "s%d" % i,
+                              records_for(50, b"m%d" % i))
+                  for i in range(10)]
+        fds = SpillFdCache(4)
+        for path, index in spills:
+            got = serve_chunk(fds, path, index, 0, 0, 1 << 20, 4 << 20)
+            assert got["data"] == payload_of(path, index)
+        assert fds.opens == 10
+        assert fds.evictions == 6
+        assert len(fds) == 4
+        # an evicted path re-opens (and re-serves) transparently
+        path, index = spills[0]
+        got = serve_chunk(fds, path, index, 0, 0, 1 << 20, 4 << 20)
+        assert got["data"] == payload_of(path, index)
+        assert fds.opens == 11
+
+    def test_invalidate_prefix(self, tmp_path):
+        spills = [write_spill(tmp_path, "j%d" % i, records_for(10))
+                  for i in range(3)]
+        fds = SpillFdCache(8)
+        for path, index in spills:
+            serve_chunk(fds, path, index, 0, 0, 1 << 20, 4 << 20)
+        fds.invalidate(str(tmp_path / "j1"))
+        assert len(fds) == 2
+
+    def test_wire_compression_only_when_it_pays(self, tmp_path):
+        if not TlzCodec.available():
+            pytest.skip("native tlz unavailable")
+        fds = SpillFdCache(4)
+        # compressible, uncompressed spill: wire-compressed
+        path, index = write_spill(tmp_path, "big", records_for(500))
+        out = serve_chunk(fds, path, index, 0, 0, 1 << 20, 4 << 20,
+                          wire="tlz")
+        assert out["wire"] == "tlz"
+        assert len(out["data"]) < out["n"]
+        assert TlzCodec().decompress(out["data"]) == \
+            payload_of(path, index)
+        # tiny payload: below the wire floor, ships raw
+        path, index = write_spill(tmp_path, "tiny", records_for(2))
+        out = serve_chunk(fds, path, index, 0, 0, 1 << 20, 4 << 20,
+                          wire="tlz")
+        assert "wire" not in out
+        # already-compressed spill: never re-compressed
+        path, index = write_spill(tmp_path, "z", records_for(500),
+                                  codec="zlib")
+        out = serve_chunk(fds, path, index, 0, 0, 1 << 20, 4 << 20,
+                          wire="tlz")
+        assert "wire" not in out
+
+
+class TestServeBatch:
+    def _fixture(self, tmp_path, n=5):
+        spills = {m: write_spill(tmp_path, "s%d" % m,
+                                 records_for(30, b"m%d" % m))
+                  for m in range(n)}
+        return SpillFdCache(8), spills
+
+    def test_per_entry_error_rides_back(self, tmp_path):
+        fds, spills = self._fixture(tmp_path)
+
+        def lookup(m):
+            if m == 2:
+                raise KeyError("no map output for map 2")
+            return spills[m]
+
+        out = serve_batch(fds, lookup, 0, [0, 1, 2, 3], 1 << 20, 8 << 20,
+                          4 << 20)
+        assert [e["map_index"] for e in out] == [0, 1, 2, 3]
+        assert "error" in out[2] and "KeyError" in out[2]["error"]
+        for e in (out[0], out[1], out[3]):
+            path, index = spills[e["map_index"]]
+            assert e["data"] == payload_of(path, index)
+
+    def test_byte_budget_omits_tail(self, tmp_path):
+        fds, spills = self._fixture(tmp_path)
+        one = len(payload_of(*spills[0]))
+        out = serve_batch(fds, lambda m: spills[m], 0, list(range(5)),
+                          1 << 20, int(one * 2.5), 4 << 20)
+        # ~2.5 payloads of budget: 3 entries (the overflowing one still
+        # ships), the rest omitted for the copier to requeue
+        assert len(out) == 3
+
+    def test_oversized_entry_arrives_as_prefix(self, tmp_path):
+        fds, spills = self._fixture(tmp_path)
+        out = serve_batch(fds, lambda m: spills[m], 0, [0], 100, 8 << 20,
+                          4 << 20)
+        ent = out[0]
+        assert len(ent["data"]) == 100 and ent["total"] > 100
+        assert ent["data"] == payload_of(*spills[0])[:100]
+
+
+# --------------------------------------------------------- the wire path
+
+
+class TestWirePath:
+    def _cluster(self, tmp_path, n_maps, recs_per_map=120, reactor=True,
+                 delay_s=0.0, serve_conf=None):
+        spills = {m: write_spill(tmp_path, "s%d" % m,
+                                 records_for(recs_per_map, b"m%d" % m))
+                  for m in range(n_maps)}
+        stub = ShuffleServeStub(spills, conf=serve_conf, delay_s=delay_s)
+        server = start_server(stub, reactor=reactor)
+        return spills, stub, server
+
+    def test_byte_identity_engine_on_vs_off(self, tmp_path):
+        """Batching + pipelining + wire compression on the reactor
+        transport must move byte-identical records vs the flat
+        per-chunk path on the threaded transport."""
+        spills, _, srv_on = self._cluster(tmp_path / "on", 6)
+        _, _, srv_off = self._cluster(tmp_path / "off", 6, reactor=False)
+        # re-point the off server at the SAME spills for identical input
+        srv_off._handlers[""].outputs = spills
+        try:
+            conf_on = wire_conf(**{"tpumr.shuffle.batch.segments": 4,
+                                   "tpumr.shuffle.wire.codec": "tlz"})
+            src_on = RemoteChunkSource(
+                conf_on, JOB, locator_for(srv_on.port, range(6)))
+            segs_on = ShuffleCopier(
+                conf_on, src_on, 6, 0, str(tmp_path / "sp_on"),
+                on_fetch_failure=lambda m, a: None).copy_all()
+
+            conf_off = wire_conf(**{"tpumr.shuffle.batch.segments": 1,
+                                    "tpumr.shuffle.fetch.pipeline.depth": 1,
+                                    "tpumr.shuffle.wire.codec": "none"})
+            src_off = RemoteChunkSource(
+                conf_off, JOB, locator_for(srv_off.port, range(6)))
+            segs_off = ShuffleCopier(
+                conf_off, src_off, 6, 0, str(tmp_path / "sp_off"),
+                on_fetch_failure=None).copy_all()
+
+            on, off = all_records(segs_on), all_records(segs_off)
+            assert on == off
+            assert len(on) == 6 * 120
+        finally:
+            srv_on.stop()
+            srv_off.stop()
+
+    def test_wire_bytes_shrink_and_are_accounted(self, tmp_path):
+        if not TlzCodec.available():
+            pytest.skip("native tlz unavailable")
+        _, _, server = self._cluster(tmp_path, 4, recs_per_map=400)
+        try:
+            conf = wire_conf(**{"tpumr.shuffle.wire.codec": "tlz",
+                                "tpumr.shuffle.batch.segments": 1})
+            src = RemoteChunkSource(conf, JOB,
+                                    locator_for(server.port, range(4)))
+            segs = ShuffleCopier(conf, src, 4, 0, str(tmp_path / "sp"),
+                                 ).copy_all()
+            wire = sum(s.wire_length for s in segs if hasattr(s, "wire_length"))
+            raw = sum(s.raw_length for s in segs)
+            assert 0 < wire < raw   # compressed in flight, decompressed here
+            assert len(all_records(segs)) == 4 * 400
+        finally:
+            server.stop()
+
+    def test_pipelined_fetch_keeps_multiple_in_flight(self, tmp_path):
+        """The reactor's per-connection pipeline depth proves requests
+        genuinely overlap on one socket (in-flight > 1)."""
+        recs = records_for(6000)   # payload ≫ several 64 KiB chunks
+        path, index = write_spill(tmp_path, "big", recs)
+        stub = ShuffleServeStub({0: (path, index)}, delay_s=0.002)
+        server = start_server(stub, reactor=True)
+        try:
+            conf = wire_conf(**{"tpumr.shuffle.fetch.pipeline.depth": 4,
+                                "tpumr.shuffle.wire.codec": "none"})
+            src = RemoteChunkSource(conf, JOB,
+                                    locator_for(server.port, [0]))
+            chunks = list(src.fetch_chunks(0, 0))
+            assert b"".join(c["data"] for c in chunks) == \
+                payload_of(path, index)
+            assert len(chunks) > 4
+            assert server._reactor.pipeline_depth_peak > 1
+        finally:
+            server.stop()
+
+    def test_batched_round_uses_one_rpc(self, tmp_path):
+        _, _, server = self._cluster(tmp_path, 8, recs_per_map=20)
+        try:
+            conf = wire_conf(**{"tpumr.shuffle.batch.segments": 8})
+            src = RemoteChunkSource(conf, JOB,
+                                    locator_for(server.port, range(8)))
+            entries = src.fetch_batch(list(range(8)), 0)
+            assert sorted(e["map_index"] for e in entries) == list(range(8))
+            assert all("error" not in e for e in entries)
+        finally:
+            server.stop()
+
+    def test_chaos_mid_batch_reexecutes_exactly_the_lost_map(self,
+                                                             tmp_path):
+        """A batched fetch hitting the fi ``shuffle.serve`` seam for ONE
+        map fails that member alone: its batch-mates land, the
+        fetch-failure protocol reports exactly the lost map, and the
+        retry (seam exhausted ≈ the re-run map) completes the copy."""
+        fi.reset()
+        serve_conf = JobConf()
+        serve_conf.set("tpumr.fi.shuffle.serve.m2.probability", 1.0)
+        serve_conf.set("tpumr.fi.shuffle.serve.m2.max.failures", 1)
+        spills, _, server = self._cluster(tmp_path, 6, recs_per_map=40,
+                                          serve_conf=serve_conf)
+        reported = []
+        try:
+            conf = wire_conf(**{
+                "tpumr.shuffle.batch.segments": 8,
+                "tpumr.shuffle.parallel.copies": 1,
+                "tpumr.shuffle.fetch.retries.per.source": 1,
+                "tpumr.shuffle.copy.backoff.ms": 1})
+            src = RemoteChunkSource(conf, JOB,
+                                    locator_for(server.port, range(6)))
+            copier = ShuffleCopier(
+                conf, src, 6, 0, str(tmp_path / "sp"),
+                on_fetch_failure=lambda m, a: reported.append((m, a)))
+            segs = copier.copy_all()
+            assert len(all_records(segs)) == 6 * 40
+            assert reported == [(2, "a2")]
+            assert copier.fetch_failures == 1
+        finally:
+            server.stop()
+            fi.reset()
+
+    def test_connection_pool_multiplexes_few_sockets(self, tmp_path):
+        """parallel.copies fetchers over conns_per_target=2 sockets:
+        the locator's shared pool, not one client per fetcher."""
+        _, _, server = self._cluster(tmp_path, 10, recs_per_map=60)
+        try:
+            conf = wire_conf(**{"tpumr.shuffle.parallel.copies": 6,
+                                "tpumr.shuffle.batch.segments": 1})
+            loc = locator_for(server.port, range(10), conns=2)
+            src = RemoteChunkSource(conf, JOB, loc)
+            segs = ShuffleCopier(conf, src, 10, 0, str(tmp_path / "sp"),
+                                 on_fetch_failure=lambda m, a: None
+                                 ).copy_all()
+            assert len(all_records(segs)) == 10 * 60
+            assert loc.pool.connects <= 2
+        finally:
+            server.stop()

@@ -509,8 +509,17 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "search)."),
     _K('tpumr.security.authorization', 'bool', False,
         "Service-level authorization (policy file) master switch."),
+    _K('tpumr.shuffle.batch.bytes', 'int', 8 << 20,
+        "Total payload budget of one batched multi-segment fetch "
+        "response, bytes."),
+    _K('tpumr.shuffle.batch.segments', 'int', 8,
+        "Max map outputs coalesced into one get_map_outputs_batch RPC "
+        "(1 = per-segment fetches)."),
     _K('tpumr.shuffle.chunk.bytes', 'int', 1 << 20,
         "Serve-side chunking of map output reads, bytes."),
+    _K('tpumr.shuffle.conns.per.target', 'int', 2,
+        "Pooled shuffle connections per source tracker; fetchers "
+        "multiplex over them instead of one socket each."),
     _K('tpumr.shuffle.copy.backoff.max.ms', 'float', 10000.0,
         "Penalty-box backoff cap, ms."),
     _K('tpumr.shuffle.copy.backoff.ms', 'float', 200.0,
@@ -528,8 +537,14 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Partition ranges per device sort pass."),
     _K('tpumr.shuffle.device.value.bytes', 'int', 0,
         "Fixed value width for device shuffle records, bytes."),
+    _K('tpumr.shuffle.fd.cache.size', 'int', 64,
+        "Open spill file descriptors the serving tracker caches (LRU) "
+        "so chunk reads pread instead of open+seek per chunk."),
     _K('tpumr.shuffle.fetch.max.failures', 'int', 50,
         "Total fetch failures before the reduce attempt aborts."),
+    _K('tpumr.shuffle.fetch.pipeline.depth', 'int', 4,
+        "Chunk requests kept in flight per connection while streaming "
+        "one segment (1 = one chunk per round trip)."),
     _K('tpumr.shuffle.fetch.retries.per.source', 'int', 3,
         "Fetch failures per map location before a report goes up the "
         "umbilical."),
@@ -547,6 +562,9 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "In-memory shuffle budget per reduce, MiB."),
     _K('tpumr.shuffle.timeout.ms', 'int', 600000,
         "Shuffle phase overall deadline, ms."),
+    _K('tpumr.shuffle.wire.codec', 'str', 'tlz',
+        "Wire compression for chunks of UNCOMPRESSED spills ('none' "
+        "disables); decompressed copier-side inside the RAM budget."),
     _K('tpumr.sleep.hang.attempts', 'int', 1,
         "Sleep example: attempts that hang before succeeding."),
     _K('tpumr.sleep.hang.map', 'int', -1,
@@ -580,6 +598,9 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Override for task userlog directory."),
     _K('tpumr.task.work.dir', 'str', None,
         "Task working directory (framework-set)."),
+    _K('tpumr.tasktracker.reactor', 'bool', True,
+        "Serve the tracker RPC surface (umbilical + shuffle) through "
+        "the selector reactor instead of thread-per-connection."),
     _K('tpumr.topology.map', 'str', None,
         "Inline host->rack map (JSON/dict), the script-less topology "
         "source."),

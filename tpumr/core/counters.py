@@ -25,6 +25,10 @@ class TaskCounter:
     REDUCE_INPUT_RECORDS = "REDUCE_INPUT_RECORDS"
     REDUCE_OUTPUT_RECORDS = "REDUCE_OUTPUT_RECORDS"
     REDUCE_SHUFFLE_BYTES = "REDUCE_SHUFFLE_BYTES"
+    #: bytes that actually crossed the shuffle wire (post wire-codec
+    #: compression) — the ratio REDUCE_SHUFFLE_WIRE_BYTES /
+    #: REDUCE_SHUFFLE_BYTES is the wire compression win per job
+    REDUCE_SHUFFLE_WIRE_BYTES = "REDUCE_SHUFFLE_WIRE_BYTES"
     #: copier segment placement (ShuffleRamManager budget outcome):
     #: how many map outputs merged straight from RAM vs spilled local
     REDUCE_SHUFFLE_SEGMENTS_MEM = "REDUCE_SHUFFLE_SEGMENTS_MEM"
@@ -38,6 +42,12 @@ class TaskCounter:
     #: segments they consumed
     SHUFFLE_INMEM_MERGES = "SHUFFLE_INMEM_MERGES"
     SHUFFLE_INMEM_MERGE_SEGMENTS = "SHUFFLE_INMEM_MERGE_SEGMENTS"
+    #: background disk-run merges during the copy phase (≈ the
+    #: reference LocalFSMerger): accumulated per-segment spills folded
+    #: into one sorted run while fetchers wait on the wire, keeping the
+    #: final merge single-pass
+    SHUFFLE_DISK_MERGES = "SHUFFLE_DISK_MERGES"
+    SHUFFLE_DISK_MERGE_SEGMENTS = "SHUFFLE_DISK_MERGE_SEGMENTS"
     #: bounded-fan-in merging (≈ Merger intermediate passes honoring
     #: io.sort.factor): intermediate passes run and segments they merged
     MERGE_PASSES = "MERGE_PASSES"

@@ -320,6 +320,25 @@ def get_codec(name: str | None) -> CompressionCodec:
     return cls()
 
 
+def wire_codec_or_none(name: "str | None") -> str:
+    """Resolve a configured shuffle wire codec to one THIS process can
+    run at native speed, else 'none'. The wire codec is a transport
+    optimization, never a format commitment: a copier without the
+    native tlz library must not request tlz frames it can only
+    store-decode (the pure-python fallback handles stored frames, not
+    compressed blocks), so unavailable codecs silently degrade to an
+    uncompressed wire rather than failing fetches."""
+    if not name or name == "none":
+        return "none"
+    cls = _REGISTRY.get(name.lower())
+    if cls is None:
+        return "none"
+    avail = getattr(cls, "available", None)
+    if callable(avail) and not avail():
+        return "none"
+    return name.lower()
+
+
 def codec_for_path(path: str) -> CompressionCodec | None:
     """Pick a codec by file extension (≈ CompressionCodecFactory)."""
     for cls in _REGISTRY.values():

@@ -27,6 +27,7 @@ import struct
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any
 
 from tpumr.io.writable import deserialize, serialize
@@ -271,6 +272,11 @@ class _Reactor:
         self.rpc = rpc
         self._pool_inflight = 0
         self._pool_lock = threading.Lock()
+        #: high-water mark of frames a single connection had in flight
+        #: at once (the one being served + those queued behind it) —
+        #: >1 proves a client actually pipelined requests instead of
+        #: ping-ponging one per round trip
+        self.pipeline_depth_peak = 1
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listen.bind((host, port))
@@ -414,7 +420,42 @@ class _Reactor:
             except Exception:  # noqa: BLE001 — garbage frame
                 self._close(conn)
                 return
-            if isinstance(req, dict) and req.get("method") in fast:
+            # Pipelining clients (the shuffle fetchers' call_begin /
+            # call_finish window) may have MANY frames of one
+            # connection in flight at once, and they match responses to
+            # requests purely by arrival order — so every frame that
+            # arrives while a pooled response is still owed on this
+            # connection queues IN ORDER behind it (fast methods
+            # included: serving one inline would jump the queue). The
+            # serving pool thread drains the queue itself, so one
+            # connection occupies at most one pool slot however deep it
+            # pipelines; parallelism comes from other connections.
+            assert self._pool is not None
+            mode = "inline"
+            saturated = False
+            with self._pool_lock:
+                if conn.busy:
+                    saturated = self._pool_inflight >= self.POOL_BACKLOG
+                    if saturated:
+                        conn.pending.append((None, self._busy_resp(req)))
+                    else:
+                        self._pool_inflight += 1
+                        conn.pending.append(((req, length), None))
+                    depth = 1 + len(conn.pending)
+                    if depth > self.pipeline_depth_peak:
+                        self.pipeline_depth_peak = depth
+                    mode = "queued"
+                elif isinstance(req, dict) and req.get("method") in fast:
+                    mode = "inline"
+                else:
+                    saturated = self._pool_inflight >= self.POOL_BACKLOG
+                    if saturated:
+                        mode = "busy"
+                    else:
+                        self._pool_inflight += 1
+                        conn.busy = True
+                        mode = "submit"
+            if mode == "inline":
                 # the heartbeat fast path: parse → serve → respond on
                 # the reactor thread, zero handoffs
                 resp = self.rpc.serve_request(conn.ctx, req, length)
@@ -423,63 +464,92 @@ class _Reactor:
                 except OSError:
                     self._close(conn)
                     return
-            else:
-                # clients serialize calls per connection, so at most
-                # one pooled request per connection is in flight — no
-                # response interleaving to defend against
-                assert self._pool is not None
-                with self._pool_lock:
-                    saturated = self._pool_inflight >= self.POOL_BACKLOG
-                    if not saturated:
-                        self._pool_inflight += 1
-                if saturated:
-                    # bounded backpressure: answer busy NOW (an error
-                    # the caller sees and backs off on) instead of
-                    # queueing without bound. Deliberately NOT cached
-                    # in the replay cache — a retried id re-enters the
-                    # pipeline normally once the pool drains.
-                    reg = self.rpc.metrics
-                    if reg is not None:
-                        reg.incr("rpc_pool_saturated")
-                    resp = {"id": req.get("id")
-                            if isinstance(req, dict) else None,
-                            "error": "RpcError: handler pool saturated "
-                                     "(server busy, retry later)"}
-                    try:
-                        _send_frame(conn.sock, resp)
-                    except OSError:
-                        self._close(conn)
-                else:
-                    self._pool.submit(self._serve_pooled, conn, req,
-                                      length)
+            elif mode == "submit":
+                self._pool.submit(self._serve_pooled, conn, req, length)
+            elif mode == "busy":
+                # bounded backpressure: answer busy NOW (an error
+                # the caller sees and backs off on) instead of
+                # queueing without bound. Deliberately NOT cached
+                # in the replay cache — a retried id re-enters the
+                # pipeline normally once the pool drains.
+                try:
+                    _send_frame(conn.sock, self._busy_resp(req))
+                except OSError:
+                    self._close(conn)
+                    return
+            if saturated:
+                reg = self.rpc.metrics
+                if reg is not None:
+                    reg.incr("rpc_pool_saturated")
+
+    @staticmethod
+    def _busy_resp(req: Any) -> dict:
+        return {"id": req.get("id") if isinstance(req, dict) else None,
+                "error": "RpcError: handler pool saturated "
+                         "(server busy, retry later)"}
 
     def _serve_pooled(self, conn: "_RConn", req: Any, length: int) -> None:
-        try:
-            if not isinstance(req, dict):
-                raise RpcError(f"malformed request frame: {type(req)}")
-            resp = self.rpc.serve_request(conn.ctx, req, length)
-        except Exception as e:  # noqa: BLE001 — keep the pool alive
-            resp = {"id": req.get("id") if isinstance(req, dict) else None,
-                    "error": f"{type(e).__name__}: {e}"}
-        finally:
-            with self._pool_lock:
-                self._pool_inflight -= 1
+        while True:
+            try:
+                if not isinstance(req, dict):
+                    raise RpcError(f"malformed request frame: {type(req)}")
+                resp = self.rpc.serve_request(conn.ctx, req, length)
+            except Exception as e:  # noqa: BLE001 — keep the pool alive
+                resp = {"id": req.get("id") if isinstance(req, dict)
+                        else None,
+                        "error": f"{type(e).__name__}: {e}"}
+            finally:
+                with self._pool_lock:
+                    self._pool_inflight -= 1
+            if not self._send_or_abandon(conn, resp):
+                return
+            # in-order drain of frames the client pipelined behind the
+            # one just answered; pre-built saturation responses send
+            # without a dispatch
+            while True:
+                with self._pool_lock:
+                    if not conn.pending:
+                        conn.busy = False
+                        return
+                    work, canned = conn.pending.popleft()
+                if work is not None:
+                    req, length = work
+                    break
+                if not self._send_or_abandon(conn, canned):
+                    return
+
+    def _send_or_abandon(self, conn: "_RConn", resp: Any) -> bool:
+        """Send one response; on a dead socket release the backlog slots
+        of everything still queued behind it (the reactor reaps the
+        socket itself on its next select) and report False."""
         try:
             _send_frame(conn.sock, resp)
+            return True
         except OSError:
-            pass  # the reactor notices the dead socket on next select
+            with self._pool_lock:
+                for work, _ in conn.pending:
+                    if work is not None:
+                        self._pool_inflight -= 1
+                conn.pending.clear()
+                conn.busy = False
+            return False
 
 
 class _RConn:
     """One reactor-served connection: socket + receive buffer + the
-    transport-agnostic serving context."""
+    transport-agnostic serving context, plus the per-connection request
+    pipeline (``busy`` = a pooled response is owed; ``pending`` = frames
+    queued in arrival order behind it, drained by the serving pool
+    thread so responses keep request order)."""
 
-    __slots__ = ("sock", "buf", "ctx")
+    __slots__ = ("sock", "buf", "ctx", "pending", "busy")
 
     def __init__(self, sock: socket.socket, ctx: _ConnCtx) -> None:
         self.sock = sock
         self.buf = bytearray()
         self.ctx = ctx
+        self.pending: "deque[tuple]" = deque()
+        self.busy = False
 
 
 class RpcServer:
@@ -505,6 +575,14 @@ class RpcServer:
         #: methods a token-scoped caller may invoke (umbilical + shuffle
         #: surface); everything else is denied before dispatch
         self.scoped_methods: "set[str]" = set()
+        #: idempotent READ methods opted out of the (cid, id) replay
+        #: machinery: their responses are never stored in the response
+        #: cache (a shuffle chunk response is MiB-scale — caching 128
+        #: per stripe would pin gigabytes of payload) and a replayed id
+        #: re-executes instead of being rejected (re-reading a byte
+        #: range is harmless). Everything else keeps exactly-once
+        #: semantics.
+        self.uncached_methods: "set[str]" = set()
         #: delegation-token liveness store (tpumr.security.tokens.
         #: TokenStore) for ISSUING daemons (jobtracker, namenode)
         self.token_store: "Any | None" = None
@@ -583,6 +661,11 @@ class RpcServer:
                           lambda: self.inflight_peak())
             reg.set_gauge("rpc_handler_threads",
                           lambda: len(self._conns))
+            if self._reactor is not None:
+                # deepest per-connection request pipeline observed:
+                # >1 means clients are actually overlapping requests
+                reg.set_gauge("rpc_pipeline_depth_peak",
+                              lambda: self._reactor.pipeline_depth_peak)
 
     def note_dispatch_start(self) -> None:
         with self._inflight_lock:
@@ -670,7 +753,8 @@ class RpcServer:
         # replay the cached response instead of re-executing, so
         # non-idempotent methods (submit_job) never run twice
         dedupe_key = (req.get("cid"), req.get("id"))
-        if req.get("cid") is not None:
+        uncached = req.get("method") in self.uncached_methods
+        if req.get("cid") is not None and not uncached:
             cached = self.response_cache_get(dedupe_key)
             if cached is not None:
                 return cached
@@ -765,7 +849,7 @@ class RpcServer:
             resp["traceback"] = traceback.format_exc(limit=8)
         finally:
             self.note_dispatch_end()
-        if req.get("cid") is not None:
+        if req.get("cid") is not None and not uncached:
             self.response_cache_put(dedupe_key, resp)
         return resp
 
@@ -984,6 +1068,10 @@ class RpcClient:
         #: clients send it once per connection (the server adopts it);
         #: secured clients resend it every frame (signature-bound)
         self._cid_sent = False
+        #: requests sent via call_begin whose responses have not been
+        #: collected yet — both transports serve one connection's
+        #: frames in request order, so call_finish drains them FIFO
+        self._outstanding = 0
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -1128,40 +1216,56 @@ class RpcClient:
 
     # ------------------------------------------------ pipelined calls
     #
-    # Split call surface for fan-out load generators (the scale fleet):
-    # send many requests across many clients back-to-back, then collect
-    # the responses — the server overlaps its handling with the
-    # caller's next sends instead of ping-ponging one context switch
-    # per call. NOT thread-safe by design: a pipelining caller owns its
-    # clients for the whole begin/finish window (the fleet's worker
-    # sharding guarantees it); exactly one call_begin may be
-    # outstanding per client.
+    # Split call surface for fan-out callers (the scale fleet's load
+    # generators, the shuffle copier's chunk streams): send many
+    # requests back-to-back, then collect the responses — the server
+    # overlaps its handling with the caller's next sends instead of
+    # ping-ponging one context switch per call. NOT thread-safe by
+    # design: a pipelining caller owns its client for the whole
+    # begin/finish window (fleet worker sharding / a shuffle connection
+    # -pool lease guarantees it). Any number of call_begins may be
+    # outstanding at once; both server transports answer one
+    # connection's frames in request order, so call_finish collects
+    # responses strictly FIFO.
+
+    @property
+    def outstanding(self) -> int:
+        """Responses still owed to this client's call_begin window —
+        nonzero means the connection cannot be handed to another caller
+        (the next response on the wire belongs to THIS window)."""
+        return self._outstanding
 
     def call_begin(self, method: str, *params: Any) -> None:
         """Send one request WITHOUT waiting for the response; pair with
         :meth:`call_finish`. One reconnect retry, like :meth:`call`
-        (the request has not been received when the send itself
-        fails)."""
+        (the request has not been received when the send itself fails)
+        — but only while NOTHING is outstanding: reconnecting under a
+        live window would silently drop every in-flight response (the
+        new connection never delivers them)."""
         req = self._build_req(method, params)
         try:
             sock = self._connect()
             self._stamp(req)
             _send_frame(sock, req)
         except (ConnectionError, OSError):
+            had_outstanding = self._outstanding > 0
             self.close_locked()
+            if had_outstanding:
+                raise
             req["cid"] = self._cid
             sock = self._connect()
             self._stamp(req)
             _send_frame(sock, req)
         self._cid_sent = True
+        self._outstanding += 1
 
     def call_finish(self) -> Any:
-        """Receive the response of the outstanding :meth:`call_begin`.
+        """Receive the OLDEST outstanding :meth:`call_begin` response.
         No resend on failure: delivery is UNKNOWN once the request went
-        out, and pipelined callers (heartbeats) have their own replay
-        protocol for exactly this case."""
+        out, and pipelined callers (heartbeats, shuffle fetch retries)
+        have their own replay protocol for exactly this case."""
         try:
-            return self._check_resp(self._recv_resp())
+            resp = self._recv_resp()
         except (ConnectionError, OSError):
             # the stream may still deliver this response LATE; reusing
             # the connection would hand that stale frame to the next
@@ -1170,6 +1274,8 @@ class RpcClient:
             # call starts clean, like call()'s error path
             self.close_locked()
             raise
+        self._outstanding -= 1
+        return self._check_resp(resp)
 
     def close_locked(self) -> None:
         if self._sock is not None:
@@ -1180,10 +1286,115 @@ class RpcClient:
             self._sock = None
             self._reader = None
             self._cid_sent = False   # the next connection re-introduces it
+            self._outstanding = 0    # in-flight responses died with it
 
     def close(self) -> None:
         with self._lock:
             self.close_locked()
+
+
+class RpcClientPool:
+    """Shared per-target connection pool for fan-out data-plane callers
+    (the shuffle copier's fetchers, the streamed stage handoff): many
+    worker threads multiplex over at most ``conns_per_target`` sockets
+    per (host, port). A lease is EXCLUSIVE — the holder may pipeline
+    call_begin/call_finish freely — and release() returns the
+    connection warm for the next fetch (and the penalty-box recovery
+    path), instead of the one-serialized-client-per-(addr, thread)
+    caches that opened ``parallel.copies`` sockets per target and paid
+    a fresh TCP (+auth hello) handshake after every eviction.
+
+    ``factory(host, port) -> RpcClient`` builds new connections, so the
+    owner attaches its own secret/scope/timeouts. Acquire blocks (with
+    an optional timeout) when every connection to the target is leased
+    — that bound is the point: a tracker being fetched from by hundreds
+    of reducers sees ``conns_per_target`` sockets per reduce, not
+    ``parallel.copies``."""
+
+    def __init__(self, factory: Any, conns_per_target: int = 2) -> None:
+        self._factory = factory
+        self._cap = max(1, int(conns_per_target))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # addr -> [idle clients]; addr -> total live (leased + idle)
+        self._idle: "dict[str, list[RpcClient]]" = {}
+        self._count: "dict[str, int]" = {}
+        self._closed = False
+        #: connections ever built (pool efficiency: a healthy copy
+        #: phase reuses — this stays near targets * conns_per_target)
+        self.connects = 0
+
+    def acquire(self, addr: str, timeout_s: "float | None" = 30.0
+                ) -> RpcClient:
+        """Exclusive lease of one connection to ``addr`` ("host:port").
+        Reuses an idle one, builds below the per-target cap, else waits
+        for a release."""
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise RpcError("client pool is closed")
+                idle = self._idle.get(addr)
+                if idle:
+                    return idle.pop()
+                if self._count.get(addr, 0) < self._cap:
+                    # reserve the slot, build OUTSIDE the lock (a slow
+                    # connect must not block other targets' leases)
+                    self._count[addr] = self._count.get(addr, 0) + 1
+                    break
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"no shuffle connection to {addr} became free "
+                        f"within {timeout_s:.0f}s")
+                self._cond.wait(timeout=remaining)
+        try:
+            host, _, port = addr.rpartition(":")
+            client = self._factory(host, int(port))
+            with self._cond:
+                self.connects += 1
+            return client
+        except BaseException:
+            with self._cond:
+                self._count[addr] = self._count.get(addr, 1) - 1
+                self._cond.notify()
+            raise
+
+    def release(self, addr: str, client: RpcClient,
+                dead: bool = False) -> None:
+        """Return a leased connection. ``dead=True`` (transport error,
+        or responses abandoned mid-pipeline) closes it and frees the
+        slot — the next acquire dials fresh."""
+        if dead or getattr(client, "outstanding", 0):
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+            with self._cond:
+                self._count[addr] = max(0, self._count.get(addr, 1) - 1)
+                self._cond.notify()
+            return
+        with self._cond:
+            if self._closed:
+                self._count[addr] = max(0, self._count.get(addr, 1) - 1)
+            else:
+                self._idle.setdefault(addr, []).append(client)
+                self._cond.notify()
+                return
+        client.close()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            idle = [c for lst in self._idle.values() for c in lst]
+            self._idle.clear()
+            self._cond.notify_all()
+        for c in idle:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
 
 
 class _Proxy:

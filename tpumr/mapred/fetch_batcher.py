@@ -1,4 +1,18 @@
-"""Device→host fetch coalescing for concurrent tasks.
+"""Fetch coalescing: device→host transfers AND shuffle wire batching.
+
+Two batchers live here because they exploit the same economics — a
+fixed per-roundtrip cost that dwarfs small payloads, amortized by
+carrying many logical fetches per wire exchange:
+
+- :class:`DeviceFetchBatcher` coalesces concurrent tasks'
+  ``jax.device_get`` calls into one tunnel roundtrip;
+- :func:`coalesce_shuffle_fetches` groups a reduce's pending map-output
+  queue per SOURCE ADDRESS so the ShuffleCopier pulls many small
+  segments from one tracker in one ``get_map_outputs_batch`` frame
+  (the small-segment regime is exactly where per-RPC overhead
+  dominates the shuffle).
+
+Device→host batching design notes:
 
 On a tunneled/remote TPU runtime every ``jax.device_get`` of computed
 arrays costs a full network roundtrip (~tens of ms) regardless of payload
@@ -27,10 +41,48 @@ that caused it — innocent tasks in the same batch must not fail.
 
 from __future__ import annotations
 
+import queue
 import threading
-from typing import Any
+from typing import Any, Callable
 
 from tpumr.utils import progress
+
+
+def coalesce_shuffle_fetches(
+        first_map: int, addr: str,
+        work: "queue.Queue[tuple[float, int]]",
+        addr_of: "Callable[[int], str]",
+        ready_now: "Callable[[float, int], bool]",
+        max_segments: int) -> "list[int]":
+    """Drain the copier's pending queue for more maps served by the
+    same source as ``first_map`` — the members of one batched fetch.
+
+    One bounded pass over the queue's current content (``qsize`` at
+    entry — entries other workers push concurrently are next round's
+    problem): maps that are ready (``ready_now(ready_at, m)``, i.e. no
+    pending hold-off or penalty) and resolve to ``addr`` join the
+    batch; everything else rotates back with its stamp intact. Always
+    returns at least ``[first_map]``, so the caller degrades to a
+    plain single fetch when nothing coalesces."""
+    members = [first_map]
+    if max_segments <= 1:
+        return members
+    putback: "list[tuple[float, int]]" = []
+    scan = work.qsize()
+    while scan > 0 and len(members) < max_segments:
+        scan -= 1
+        try:
+            item = work.get_nowait()
+        except queue.Empty:
+            break
+        ready, m = item
+        if ready_now(ready, m) and addr_of(m) == addr:
+            members.append(m)
+        else:
+            putback.append(item)
+    for item in putback:
+        work.put(item)
+    return members
 
 
 class DeviceFetchBatcher:

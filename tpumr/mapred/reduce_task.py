@@ -84,6 +84,7 @@ def run_reduce_task(conf: Any, task: Task, fetch: FetchFn,
                     s.set(in_memory=copier.copied_in_memory,
                           on_disk=copier.spilled_to_disk,
                           mem_merges=copier.inmem_merges,
+                          disk_merges=copier.disk_merges,
                           fetch_failures=copier.fetch_failures)
             closeable = list(segments)
         elif not hasattr(fetch, "segments"):

@@ -77,7 +77,12 @@ def shuffle_metrics():
     # master's cluster merge agree on one metric name (the source is a
     # label on the tracker, "cluster" on the master)
     reg.histogram("shuffle_fetch_seconds")
+    # transferred (post-wire-codec) bytes — what actually crossed the
+    # network; shuffle_fetch_raw_bytes is the decompressed size, so the
+    # wire/raw pair separates compression ratio from copy throughput
     reg.histogram("shuffle_fetch_bytes", BYTES)
+    reg.histogram("shuffle_fetch_wire_bytes", BYTES)
+    reg.histogram("shuffle_fetch_raw_bytes", BYTES)
     return reg
 
 
@@ -145,6 +150,9 @@ class Segment:
 
     #: raw (decompressed) size, for accounting/diagnostics
     raw_length = 0
+    #: bytes that actually crossed the wire fetching this segment
+    #: (post wire-codec compression); 0 for purely local segments
+    wire_length = 0
     in_memory = False
 
     def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
@@ -295,7 +303,13 @@ class ShuffleMergeManager:
     into ONE sorted on-disk run (``ifile`` format via
     ``io.merger.write_run``) and closes the inputs, releasing their
     reservations mid-copy. Batches merge in map-index order so the
-    merged run's equal-key tiebreak is deterministic."""
+    merged run's equal-key tiebreak is deterministic.
+
+    A second, disk-side thread (≈ ReduceTask's LocalFSMerger) folds
+    accumulated per-segment disk spills into sorted runs whenever
+    ``io.sort.factor`` of them exist — the copy phase's wire waits pay
+    for the rewrite, and the final merge stays a single pass instead of
+    re-reading everything through bounded-fan-in intermediate passes."""
 
     def __init__(self, conf: Any, ram: ShuffleRamManager, spill_dir: str,
                  reporter: Any, trace_ctx: Any) -> None:
@@ -324,6 +338,16 @@ class ShuffleMergeManager:
         self._thread: "threading.Thread | None" = None
         self.inmem_merges = 0
         self.inmem_merge_segments = 0
+        #: disk side (≈ LocalFSMerger): per-segment spills accumulate
+        #: here; once ``io.sort.factor`` of them exist, a second
+        #: background thread folds them into one sorted run. The work
+        #: overlaps fetchers' wire waits, so the end-of-copy merge stays
+        #: single-pass instead of paying bounded-fan-in rewrite passes.
+        self.disk_factor = max(2, confkeys.get_int(conf, "io.sort.factor"))
+        self._pending_disk: "list[tuple[int, Segment]]" = []
+        self._disk_thread: "threading.Thread | None" = None
+        self.disk_merges = 0
+        self.disk_merge_segments = 0
 
     # ------------------------------------------------------- fetcher side
 
@@ -341,6 +365,21 @@ class ShuffleMergeManager:
                 self._requested = True
                 self._cond.notify_all()
             self._ensure_thread()
+            return True
+
+    def offer_disk(self, map_index: int, seg: Segment) -> bool:
+        """Take ownership of a landed per-segment disk spill. Once
+        ``io.sort.factor`` spills accumulate, the disk-merge thread
+        folds the first ``factor`` (in map-index order, for a
+        deterministic equal-key tiebreak) into one sorted run. Returns
+        False after close/error — the caller keeps ownership."""
+        with self._cond:
+            if self._closed or self._error is not None:
+                return False
+            self._pending_disk.append((map_index, seg))
+            if len(self._pending_disk) >= self.disk_factor:
+                self._ensure_disk_thread()
+                self._cond.notify_all()
             return True
 
     def request_merge(self) -> None:
@@ -373,6 +412,78 @@ class ShuffleMergeManager:
                                             name="shuffle-inmem-merger",
                                             daemon=True)
             self._thread.start()
+
+    def _ensure_disk_thread(self) -> None:
+        # separate from the in-memory loop: a long disk merge must not
+        # delay the merges that free ShuffleRamManager budget
+        if self._disk_thread is None:
+            self._disk_thread = threading.Thread(
+                target=self._disk_loop, name="shuffle-disk-merger",
+                daemon=True)
+            self._disk_thread.start()
+
+    def _disk_loop(self) -> None:
+        from tpumr.core import tracing
+        with tracing.activate_captured(self._trace_ctx):
+            while True:
+                with self._cond:
+                    while (not self._closed and
+                           len(self._pending_disk) < self.disk_factor):
+                        self._cond.wait(0.1)
+                    if self._closed:
+                        return
+                    self._pending_disk.sort(key=lambda p: p[0])
+                    batch = [s for _, s in
+                             self._pending_disk[:self.disk_factor]]
+                    del self._pending_disk[:self.disk_factor]
+                try:
+                    self._merge_disk_batch(batch)
+                except Exception as e:  # noqa: BLE001 — surfaced at finish
+                    for seg in batch:
+                        seg.close()
+                    with self._cond:
+                        self._error = e
+                        self._merged_ids.update(id(s) for s in batch)
+                        self._cond.notify_all()
+                    return
+
+    def _merge_disk_batch(self, batch: "list[Segment]") -> None:
+        from tpumr.core import tracing
+        from tpumr.io import merger as merge_engine
+        raw_bytes = sum(s.raw_length for s in batch)
+        with tracing.span("shuffle:disk_merge", segments=len(batch),
+                          raw_bytes=raw_bytes) as sp:
+            if raw_bytes <= 2 * self.ram.budget:
+                # a factor-sized batch of budget-scale spills: a
+                # transient full materialization (NOT reserved — it is
+                # bounded by construction) buys the Timsort-galloping
+                # merge, keeping this thread's GIL draw small enough to
+                # hide inside fetchers' wire waits
+                merged = ifile.merge_sorted_inmem(batch, self._sort_key)
+                run = merge_engine.write_run(merged, self.spill_dir,
+                                             prefix="disk-merge")
+            else:
+                # oversized spills (> max_single each): streaming heap
+                # merge + bounded-memory run writer
+                merged = ifile.merge_sorted(batch, self._sort_key)
+                run = merge_engine.write_run_streaming(
+                    merged, self.spill_dir, prefix="disk-merge")
+            if sp is not None:
+                sp.set(run_bytes=run.length, records=run.records)
+        for seg in batch:
+            seg.close()
+        with self._cond:
+            self._runs.append(run)
+            self._merged_ids.update(id(s) for s in batch)
+            self.disk_merges += 1
+            self.disk_merge_segments += len(batch)
+        if self.reporter is not None:
+            self.reporter.incr_counter(
+                TaskCounter.FRAMEWORK_GROUP,
+                TaskCounter.SHUFFLE_DISK_MERGES, 1)
+            self.reporter.incr_counter(
+                TaskCounter.FRAMEWORK_GROUP,
+                TaskCounter.SHUFFLE_DISK_MERGE_SEGMENTS, len(batch))
 
     def _loop(self) -> None:
         from tpumr.core import tracing
@@ -455,11 +566,14 @@ class ShuffleMergeManager:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-            thread = self._thread
-        if thread is not None:
-            thread.join()
+            threads = [t for t in (self._thread, self._disk_thread)
+                       if t is not None]
+        for t in threads:
+            t.join()
         if self._error is not None:
             raise self._error
+        # unmerged disk leftovers stay out of _merged_ids, so the copier
+        # returns them as ordinary live segments
         return list(self._runs)
 
     @property
@@ -474,14 +588,18 @@ class ShuffleMergeManager:
             self._closed = True
             self._requested = False
             self._cond.notify_all()
-            thread = self._thread
-        if thread is not None:
-            thread.join(timeout=30)
+            threads = [t for t in (self._thread, self._disk_thread)
+                       if t is not None]
+        for t in threads:
+            t.join(timeout=30)
         with self._cond:
             pending, self._pending = self._pending, []
+            pending_disk, self._pending_disk = self._pending_disk, []
             self._pending_bytes = 0
             runs, self._runs = self._runs, []
         for _, seg in pending:
+            seg.close()
+        for _, seg in pending_disk:
             seg.close()
         for run in runs:
             run.close()
@@ -585,74 +703,247 @@ class ShuffleCopier:
                 raise
             reg.histogram("shuffle_fetch_seconds").observe(
                 time.monotonic() - t0)
-            reg.histogram("shuffle_fetch_bytes").observe(seg.raw_length)
+            self._observe_seg(reg, seg)
             if s is not None:
                 s.set(raw_bytes=seg.raw_length,
+                      wire_bytes=seg.wire_length,
                       in_memory=seg.in_memory)
             return seg
+
+    @staticmethod
+    def _observe_seg(reg, seg: Segment) -> None:
+        # fetch_bytes reports TRANSFERRED bytes (it used to report raw —
+        # with a wire codec those diverge); wire/raw land in their own
+        # pair so ratio and throughput stay separable on /metrics
+        wire = seg.wire_length or seg.raw_length
+        reg.histogram("shuffle_fetch_bytes").observe(wire)
+        reg.histogram("shuffle_fetch_wire_bytes").observe(wire)
+        reg.histogram("shuffle_fetch_raw_bytes").observe(seg.raw_length)
 
     def _copy_one_inner(self, map_index: int) -> Segment:
         from tpumr.utils.fi import maybe_fail
         maybe_fail("shuffle.fetch", self.conf)
         maybe_fail(f"shuffle.fetch.m{map_index}", self.conf)
+        fetch_chunks = getattr(self.source, "fetch_chunks", None)
+        if fetch_chunks is not None:
+            # pipelined path: the source resolves the serving address
+            # ONCE, leases one pooled connection, and keeps N chunk
+            # requests in flight — re-resolution happens only on the
+            # next retry round after a failure, so a mid-fetch OBSOLETE
+            # fold can't flip a healthy in-flight stream
+            chunks = fetch_chunks(map_index, self.partition)
+            try:
+                first = next(iter(chunks))
+            except StopIteration:
+                raise EOFError(f"shuffle source returned no chunks for "
+                               f"map {map_index}") from None
+            return self._materialize(map_index, first, chunks,
+                                     park_on_merger=False)
         first = self.source(map_index, self.partition, 0)
+
+        def rest() -> "Iterator[dict]":
+            got = len(first["data"])
+            total = int(first["total"])
+            while got < total:
+                nxt = self.source(map_index, self.partition, got)
+                if not nxt["data"]:
+                    raise EOFError(
+                        f"shuffle source returned empty chunk at "
+                        f"{got}/{total} for map {map_index}")
+                yield nxt
+                got += len(nxt["data"])
+
+        return self._materialize(map_index, first, rest(),
+                                 park_on_merger=True)
+
+    def _materialize(self, map_index: int, first: dict,
+                     rest: "Iterator[dict]", *,
+                     park_on_merger: bool) -> Segment:
+        """Land one segment from a decoded first chunk + an iterator of
+        the remaining decoded chunks: reserve RAM budget (or spill to
+        disk), account wire vs raw bytes, verify the byte count.
+
+        ``park_on_merger`` keeps the legacy budget-starved behavior
+        (bounded ``reserve_wait`` gated on the background merger) for
+        plain chunk sources. The pipelined/batched paths pass False:
+        gating fetch throughput on merge throughput is exactly how the
+        copy-dominated regime lost end-to-end — they nudge the merger,
+        take whatever budget exists right now, and otherwise stream to
+        local disk at disk speed."""
         total = int(first["total"])
         raw = int(first.get("raw", total))
         codec = first.get("codec", "none")
         parts = [first["data"]]
         got = len(first["data"])
+        wire = int(first.get("wire_len", got))
 
         reserved = self.ram.try_reserve(raw)
         if not reserved and self.merger is not None:
             # budget full: ask the merger to fold the accumulated memory
-            # segments into a disk run, and wait (bounded) for the freed
-            # reservations instead of degrading straight to a disk spill
+            # segments into a disk run and free their reservations
             self.merger.request_merge()
-            reserved = self.ram.reserve_wait(
-                raw, self.merger.busy_or_pending, self.reserve_wait_s)
-        if reserved:
-            # in-memory: pull remaining chunks, decompress into the budget
-            try:
-                while got < total:
-                    nxt = self.source(map_index, self.partition, got)
-                    if not nxt["data"]:
-                        raise EOFError(
-                            f"shuffle source returned empty chunk at "
-                            f"{got}/{total} for map {map_index}")
-                    parts.append(nxt["data"])
-                    got += len(nxt["data"])
-                from tpumr.io.compress import get_codec
-                raw_bytes = get_codec(codec).decompress(b"".join(parts))
-                with self._stats_lock:
-                    self.copied_in_memory += 1
-                return MemorySegment(raw_bytes, self.ram, reserved=raw)
-            except BaseException:
-                self.ram.release(raw)
-                raise
-        # on-disk: stream chunks straight to a local spill file
-        fd, path = tempfile.mkstemp(prefix=f"shuffle-m{map_index}-",
-                                    suffix=".seg", dir=self.spill_dir)
+            if park_on_merger:
+                reserved = self.ram.reserve_wait(
+                    raw, self.merger.busy_or_pending, self.reserve_wait_s)
+            else:
+                reserved = self.ram.try_reserve(raw)
         try:
-            with os.fdopen(fd, "wb") as f:
-                for p in parts:
-                    f.write(p)
-                while got < total:
-                    nxt = self.source(map_index, self.partition, got)
-                    if not nxt["data"]:
+            if reserved:
+                # in-memory: drain chunks, decompress into the budget
+                try:
+                    for nxt in rest:
+                        parts.append(nxt["data"])
+                        got += len(nxt["data"])
+                        wire += int(nxt.get("wire_len", len(nxt["data"])))
+                    if got != total:
                         raise EOFError(
-                            f"shuffle source returned empty chunk at "
-                            f"{got}/{total} for map {map_index}")
-                    f.write(nxt["data"])
-                    got += len(nxt["data"])
-        except BaseException:
+                            f"shuffle stream ended at {got}/{total} for "
+                            f"map {map_index}")
+                    from tpumr.io.compress import get_codec
+                    raw_bytes = get_codec(codec).decompress(b"".join(parts))
+                    with self._stats_lock:
+                        self.copied_in_memory += 1
+                    seg: Segment = MemorySegment(raw_bytes, self.ram,
+                                                 reserved=raw)
+                except BaseException:
+                    self.ram.release(raw)
+                    raise
+            else:
+                # on-disk: stream chunks straight to a local spill file
+                fd, path = tempfile.mkstemp(
+                    prefix=f"shuffle-m{map_index}-", suffix=".seg",
+                    dir=self.spill_dir)
+                try:
+                    with os.fdopen(fd, "wb") as f:
+                        for p in parts:
+                            f.write(p)
+                        for nxt in rest:
+                            f.write(nxt["data"])
+                            got += len(nxt["data"])
+                            wire += int(nxt.get("wire_len",
+                                                len(nxt["data"])))
+                    if got != total:
+                        raise EOFError(
+                            f"shuffle stream ended at {got}/{total} for "
+                            f"map {map_index}")
+                except BaseException:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    raise
+                with self._stats_lock:
+                    self.spilled_to_disk += 1
+                seg = DiskSegment(path, codec, raw)
+        finally:
+            close = getattr(rest, "close", None)
+            if close is not None:
+                close()   # abandoned pipelined window: release the lease
+        seg.wire_length = wire
+        return seg
+
+    # ------------------------------------------------- batched fetching
+
+    def _coalesce(self, work: "queue.Queue[tuple[float, int]]",
+                  first_map: int) -> "list[int]":
+        """Group queued maps served by ``first_map``'s source address
+        into one batched round (the wire-level half of
+        :mod:`tpumr.mapred.fetch_batcher`). Only in protocol mode
+        (``on_fetch_failure`` wired): a batch-member failure re-enters
+        the queue via the penalty box, which IS the retry loop there —
+        the legacy in-line-retries path stays per-map."""
+        if self.on_fetch_failure is None \
+                or getattr(self.source, "fetch_batch", None) is None:
+            return [first_map]
+        limit = int(getattr(self.source, "batch_segments", 1))
+        if limit <= 1:
+            return [first_map]
+        from tpumr.mapred.fetch_batcher import coalesce_shuffle_fetches
+        addr = self._addr_of(first_map)
+
+        def ready_now(ready: float, m: int) -> bool:
+            hold = max(ready, self._penalized_until(m))
+            return hold <= time.monotonic()
+
+        return coalesce_shuffle_fetches(
+            first_map, addr, work, self._addr_of, ready_now, limit)
+
+    def _copy_batch(self, members: "list[int]") \
+            -> "list[tuple[int, Segment | None, Exception | None]]":
+        """One ``get_map_outputs_batch`` round against a single source:
+        many small segments in one response frame. Returns a
+        ``(map_index, segment, error)`` triple per member — segment set
+        on success, error set on a per-member failure (fetch-failure
+        protocol), NEITHER set when the server omitted the entry under
+        its byte budget (just requeue it)."""
+        from tpumr.core import tracing
+        from tpumr.utils.fi import maybe_fail
+        reg = shuffle_metrics()
+        t0 = time.monotonic()
+        out: "list[tuple[int, Segment | None, Exception | None]]" = []
+        ask: "list[int]" = []
+        for m in members:
             try:
-                os.unlink(path)
-            except OSError:
-                pass
-            raise
-        with self._stats_lock:
-            self.spilled_to_disk += 1
-        return DiskSegment(path, codec, raw)
+                # the per-map chaos seam fires per MEMBER, client-side,
+                # so one poisoned map fails alone while siblings batch
+                maybe_fail(f"shuffle.fetch.m{m}", self.conf)
+                ask.append(m)
+            except Exception as e:  # noqa: BLE001 — fi seam
+                out.append((m, None, e))
+        if not ask:
+            return out
+        with tracing.span("shuffle:fetch_batch", members=len(ask),
+                          addr=self._addr_of(ask[0])) as sp:
+            try:
+                maybe_fail("shuffle.fetch", self.conf)
+                entries = self.source.fetch_batch(ask, self.partition)
+            except Exception as e:  # noqa: BLE001 — whole round failed
+                reg.incr("shuffle_fetch_errors")
+                reg.histogram("shuffle_fetch_seconds").observe(
+                    time.monotonic() - t0)
+                out.extend((m, None, e) for m in ask)
+                return out
+            reg.histogram("shuffle_fetch_seconds").observe(
+                time.monotonic() - t0)
+            by_map = {int(ent["map_index"]): ent for ent in entries}
+            landed = 0
+            for m in ask:
+                ent = by_map.get(m)
+                if ent is None:
+                    out.append((m, None, None))   # budget-omitted
+                    continue
+                if ent.get("error"):
+                    # per-entry failure rode back inside a healthy
+                    # batch: exactly this map enters the fetch-failure
+                    # protocol, its batch-mates landed
+                    out.append((m, None, RuntimeError(
+                        f"shuffle source error for map {m}: "
+                        f"{ent['error']}")))
+                    continue
+                try:
+                    seg = self._land_batch_entry(m, ent)
+                except Exception as e:  # noqa: BLE001
+                    out.append((m, None, e))
+                    continue
+                self._observe_seg(reg, seg)
+                landed += 1
+                out.append((m, seg, None))
+            if sp is not None:
+                sp.set(landed=landed)
+        return out
+
+    def _land_batch_entry(self, map_index: int, ent: dict) -> Segment:
+        """Materialize one batch entry; an oversized segment arrives as
+        a payload PREFIX and continues over the chunked stream."""
+        total = int(ent["total"])
+        if len(ent["data"]) < total:
+            chunks = self.source.fetch_chunks(
+                map_index, self.partition, start=len(ent["data"]),
+                total=total)
+            return self._materialize(map_index, ent, chunks,
+                                     park_on_merger=False)
+        return self._materialize(map_index, ent, iter(()),
+                                 park_on_merger=False)
 
     def _local_backoff_s(self, attempt: int) -> float:
         """Capped, jittered exponential backoff for in-line retries:
@@ -770,6 +1061,48 @@ class ShuffleCopier:
             with tracing.activate_captured(self._trace_ctx):
                 worker_body()
 
+        def land(m: int, seg: Segment) -> None:
+            self._note_success(m)
+            if self.merger is not None and isinstance(seg, MemorySegment):
+                # the merger owns it now; results[m] keeps a handle
+                # for the error-path sweep (double close is safe)
+                self.merger.offer(m, seg)
+            elif self.merger is not None and isinstance(seg, DiskSegment):
+                # likewise: accumulated spills background-merge into
+                # sorted runs while other fetchers wait on the wire
+                self.merger.offer_disk(m, seg)
+            with lock:
+                results[m] = seg
+                outstanding[0] -= 1
+                completed = self.num_maps - outstanding[0]
+            if self.reporter is not None:
+                self.reporter.incr_counter(
+                    TaskCounter.FRAMEWORK_GROUP,
+                    TaskCounter.REDUCE_SHUFFLE_BYTES, seg.raw_length)
+                if seg.wire_length:
+                    self.reporter.incr_counter(
+                        TaskCounter.FRAMEWORK_GROUP,
+                        TaskCounter.REDUCE_SHUFFLE_WIRE_BYTES,
+                        seg.wire_length)
+                self.reporter.incr_counter(
+                    TaskCounter.FRAMEWORK_GROUP,
+                    TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK
+                    if isinstance(seg, DiskSegment)
+                    else TaskCounter.REDUCE_SHUFFLE_SEGMENTS_MEM, 1)
+                self.reporter.progress(completed / self.num_maps)
+
+        def fail(m: int, e: Exception) -> bool:
+            """Account one failed round; False when terminal (stop the
+            worker), True when the map re-entered the queue."""
+            if self._note_failure(m) is None:
+                with lock:
+                    errors.append(e)
+                return False
+            # ready now; the pop-side penalty check supplies the
+            # (possibly already-cleared) hold-off
+            work.put((time.monotonic(), m))
+            return True
+
         def worker_body() -> None:
             while True:
                 with lock:
@@ -797,6 +1130,21 @@ class ShuffleCopier:
                     work.put((ready, m))
                     time.sleep(min(hold - now, 0.05))
                     continue
+                members = self._coalesce(work, m)
+                if len(members) > 1:
+                    # batched round: one RPC pulls every coalesced
+                    # member from the shared source
+                    for mm, seg, exc in self._copy_batch(members):
+                        if seg is not None:
+                            land(mm, seg)
+                        elif exc is not None:
+                            if not fail(mm, exc):
+                                return
+                        else:
+                            # omitted under the server's byte budget —
+                            # not a failure, just didn't fit this frame
+                            work.put((0.0, mm))
+                    continue
                 try:
                     # with a fetch-failure callback the penalty box IS
                     # the retry loop (one fetch per round); without one,
@@ -805,34 +1153,10 @@ class ShuffleCopier:
                            if self.on_fetch_failure is not None
                            else self._copy_with_retries(m))
                 except Exception as e:  # noqa: BLE001
-                    if self._note_failure(m) is None:
-                        with lock:
-                            errors.append(e)
+                    if not fail(m, e):
                         return
-                    # ready now; the pop-side penalty check supplies the
-                    # (possibly already-cleared) hold-off
-                    work.put((time.monotonic(), m))
                     continue
-                self._note_success(m)
-                if self.merger is not None and isinstance(seg,
-                                                          MemorySegment):
-                    # the merger owns it now; results[m] keeps a handle
-                    # for the error-path sweep (double close is safe)
-                    self.merger.offer(m, seg)
-                with lock:
-                    results[m] = seg
-                    outstanding[0] -= 1
-                    completed = self.num_maps - outstanding[0]
-                if self.reporter is not None:
-                    self.reporter.incr_counter(
-                        TaskCounter.FRAMEWORK_GROUP,
-                        TaskCounter.REDUCE_SHUFFLE_BYTES, seg.raw_length)
-                    self.reporter.incr_counter(
-                        TaskCounter.FRAMEWORK_GROUP,
-                        TaskCounter.REDUCE_SHUFFLE_SEGMENTS_DISK
-                        if isinstance(seg, DiskSegment)
-                        else TaskCounter.REDUCE_SHUFFLE_SEGMENTS_MEM, 1)
-                    self.reporter.progress(completed / self.num_maps)
+                land(m, seg)
 
         n = min(self.parallel, max(1, self.num_maps))
         threads = [threading.Thread(target=worker,
@@ -871,6 +1195,11 @@ class ShuffleCopier:
         """Background in-memory merges performed this copy phase."""
         return 0 if self.merger is None else self.merger.inmem_merges
 
+    @property
+    def disk_merges(self) -> int:
+        """Background disk-run merges performed this copy phase."""
+        return 0 if self.merger is None else self.merger.disk_merges
+
 
 class RemoteChunkSource:
     """ChunkFetch over tracker RPC (the client half of the chunked
@@ -883,16 +1212,119 @@ class RemoteChunkSource:
                  locate: Callable[[int], Any]) -> None:
         self.job_id = job_id
         self.locate = locate
-        self.chunk_bytes = max(64 * 1024, confkeys.get_int(
-            conf, "tpumr.shuffle.chunk.bytes"))
+        # clamped to the server's 4 MiB MAX_CHUNK: chunk length is then
+        # DETERMINISTIC (min(chunk_bytes, remaining)), which is what
+        # lets fetch_chunks predict offsets and pipeline requests
+        self.chunk_bytes = min(4 << 20, max(64 * 1024, confkeys.get_int(
+            conf, "tpumr.shuffle.chunk.bytes")))
+        #: chunk requests kept in flight per leased connection (RTT hiding)
+        self.pipeline_depth = max(1, confkeys.get_int(
+            conf, "tpumr.shuffle.fetch.pipeline.depth"))
+        #: batched multi-segment fetch shape; segments=1 disables batching
+        self.batch_segments = max(1, confkeys.get_int(
+            conf, "tpumr.shuffle.batch.segments"))
+        self.batch_bytes = max(self.chunk_bytes, confkeys.get_int(
+            conf, "tpumr.shuffle.batch.bytes"))
+        from tpumr.io.compress import wire_codec_or_none
+        #: wire codec THIS process can decode natively, else "none" —
+        #: never request frames the pure-python fallback can't decompress
+        self.wire_codec = wire_codec_or_none(
+            confkeys.get(conf, "tpumr.shuffle.wire.codec"))
         #: fetch-failure report seam, wired by the tracker / child so the
         #: ShuffleCopier can report a dead location up the umbilical
         self.on_fetch_failure: "Callable[[int, str], None] | None" = None
 
+    def _decode(self, out: dict) -> dict:
+        """Account wire bytes and undo wire compression in place: after
+        this, ``len(out['data'])`` is back in payload space, so chunk
+        offsets keep composing."""
+        data = out.get("data", b"")
+        out["wire_len"] = len(data)
+        if out.get("wire"):
+            from tpumr.io.compress import get_codec
+            out["data"] = get_codec(out["wire"]).decompress(data)
+        return out
+
     def __call__(self, map_index: int, partition: int, offset: int) -> dict:
-        return self.locate(map_index).call(
+        return self._decode(self.locate(map_index).call(
             "get_map_output_chunk", self.job_id, map_index, partition,
-            offset, self.chunk_bytes)
+            offset, self.chunk_bytes, self.wire_codec))
+
+    def fetch_chunks(self, map_index: int, partition: int,
+                     start: int = 0,
+                     total: "int | None" = None) -> "Iterator[dict]":
+        """Pipelined chunk stream for one segment: resolve the serving
+        address ONCE, lease one pooled connection, keep
+        ``pipeline_depth`` chunk requests in flight (``call_begin`` /
+        ``call_finish`` — responses collect strictly FIFO), yield
+        decoded chunks in order. Offsets are predicted client-side from
+        ``total`` because the server's chunk length is deterministic.
+        On a transport error the lease is returned dead (a connection
+        with uncollected responses is never reused)."""
+        proxy = self.locate(map_index)
+        lease = getattr(proxy, "lease", None)
+        if lease is None:
+            # legacy locator (bare RpcClient): sequential chunks
+            got = start
+            while total is None or got < total:
+                out = self(map_index, partition, got)
+                total = int(out["total"])
+                yield out
+                got += len(out["data"])
+                if not out["data"] and got < total:
+                    raise EOFError(f"empty chunk at {got}/{total} for "
+                                   f"map {map_index}")
+            return
+        cli = lease()
+        dead = False
+        try:
+            if total is None:
+                # eager first chunk: learn total before opening the window
+                out = self._decode(cli.call(
+                    "get_map_output_chunk", self.job_id, map_index,
+                    partition, start, self.chunk_bytes, self.wire_codec))
+                total = int(out["total"])
+                yield out
+                start += len(out["data"])
+            offsets = range(start, total, self.chunk_bytes)
+            inflight = 0
+            i = 0
+            while inflight or i < len(offsets):
+                while i < len(offsets) and inflight < self.pipeline_depth:
+                    cli.call_begin(
+                        "get_map_output_chunk", self.job_id, map_index,
+                        partition, offsets[i], self.chunk_bytes,
+                        self.wire_codec)
+                    i += 1
+                    inflight += 1
+                yield self._decode(cli.call_finish())
+                inflight -= 1
+        except (ConnectionError, OSError):
+            dead = True
+            raise
+        finally:
+            # an abandoned window (consumer stopped early, or an error
+            # response mid-pipeline) leaves outstanding > 0 — the pool
+            # closes such connections instead of reusing them
+            proxy.release(cli, dead=dead)
+
+    def fetch_batch(self, map_indexes: "list[int]",
+                    partition: int) -> "list[dict]":
+        """Many small segments of one source in ONE response frame (the
+        wire-level batcher's RPC). Entries come back decoded; a
+        per-member lookup failure rides back as an ``error`` entry and
+        a byte-budget overflow simply omits trailing members."""
+        if not map_indexes:
+            return []
+        proxy = self.locate(map_indexes[0])
+        entries = proxy.call(
+            "get_map_outputs_batch", self.job_id, partition,
+            list(map_indexes), self.chunk_bytes, self.batch_bytes,
+            self.wire_codec)
+        for ent in entries:
+            if "data" in ent:
+                self._decode(ent)
+        return entries
 
     # --- lost-output recovery hooks (delegated to the locator when it
     # --- has them — tasktracker.make_map_locator's MapLocator does)

@@ -38,7 +38,7 @@ from typing import Any
 from tpumr.core.counters import Counters
 from tpumr.core import confkeys
 from tpumr.io import ifile
-from tpumr.ipc.rpc import RpcClient, RpcServer
+from tpumr.ipc.rpc import RpcClient, RpcClientPool, RpcServer
 from tpumr.mapred.api import Reporter, TaskKilledError
 from tpumr.mapred.ids import TaskAttemptID, TaskID
 from tpumr.mapred.jobconf import JobConf
@@ -63,8 +63,10 @@ class MapLocator:
     TaskCompletionEvents (ReduceTask.java:659 fetch loop). ``events_fn
     (cursor) -> [event]`` is the master's incremental completion-event
     feed (called directly by the tracker, via the umbilical by isolated
-    child processes). Calling ``locate(map_index)`` returns an RpcClient
-    bound to the serving tracker's shuffle RPC.
+    child processes). Calling ``locate(map_index)`` returns a
+    :class:`_ShuffleTarget` bound to the serving tracker's shuffle RPC —
+    RpcClient-shaped for one-shot ``.call``, plus ``lease``/``release``
+    over the locator's shared connection pool for pipelined streams.
 
     The completion-event feed is APPEND-ONLY: a map output withdrawn by
     the master (lost tracker, too-many-fetch-failures re-execution)
@@ -76,7 +78,8 @@ class MapLocator:
 
     def __init__(self, events_fn: Any, secret: bytes | None,
                  poll_s: float = 0.2, timeout_s: float = 600.0,
-                 scope: "str | None" = None) -> None:
+                 scope: "str | None" = None,
+                 conns_per_target: int = 2) -> None:
         self._events_fn = events_fn
         self._secret = secret
         self._poll_s = poll_s
@@ -103,13 +106,21 @@ class MapLocator:
         #: past the resubmitted job's shorter feed, hiding recovered
         #: events; re-folding from 0 is idempotent
         self._empty_polls = 0
-        self._clients: dict[tuple, RpcClient] = {}
+        # shared per-target connection pool: parallel.copies fetcher
+        # threads multiplex pipelined fetches over conns_per_target
+        # sockets per tracker, reused across fetches and across the
+        # penalty-box recovery path — not one serialized client per
+        # (addr, thread) opened anew by every fetcher
+        self.pool = RpcClientPool(
+            lambda host, port: RpcClient(host, port, secret=secret,
+                                         scope=scope),
+            conns_per_target=conns_per_target)
         # the ShuffleCopier drives locate() from parallel fetcher
-        # threads. cache_lock guards the event cache/cursor/client
-        # table; poll_lock serializes the events_fn RPC OUTSIDE
-        # cache_lock, so threads whose map is already cached never wait
-        # behind a network poll — and the cursor can't double-advance
-        # (that silently skips events forever).
+        # threads. cache_lock guards the event cache/cursor; poll_lock
+        # serializes the events_fn RPC OUTSIDE cache_lock, so threads
+        # whose map is already cached never wait behind a network poll
+        # — and the cursor can't double-advance (that silently skips
+        # events forever).
         self._cache_lock = threading.Lock()
         self._poll_lock = threading.Lock()
 
@@ -164,7 +175,14 @@ class MapLocator:
             if e is not None:
                 self._stale[map_index] = e
 
-    def __call__(self, map_index: int) -> RpcClient:
+    def __call__(self, map_index: int) -> "_ShuffleTarget":
+        return _ShuffleTarget(self.pool, self.resolve(map_index))
+
+    def resolve(self, map_index: int) -> str:
+        """Block until the map's serving address is known and return it
+        ("host:port") — resolution WITHOUT binding a connection, so a
+        streaming fetch resolves once per segment and a mid-fetch
+        OBSOLETE fold can't flip a healthy in-flight stream."""
         # monotonic deadline: an NTP step mid-shuffle must neither fire
         # the timeout early nor stall it past the configured bound
         deadline = time.monotonic() + self._timeout_s
@@ -217,26 +235,250 @@ class MapLocator:
             if self.on_wait is not None:
                 self.on_wait()
             time.sleep(self._poll_s)
-        host, port = addr.rsplit(":", 1)
-        with self._cache_lock:
-            # one client per (address, calling thread): RpcClient
-            # serializes calls on its single socket, so sharing one per
-            # address would collapse tpumr.shuffle.parallel.copies back
-            # to sequential whenever maps concentrate on few trackers
-            key = (addr, threading.get_ident())
-            cli = self._clients.get(key)
-            if cli is None:
-                cli = self._clients[key] = RpcClient(
-                    host, int(port), secret=self._secret, scope=self._scope)
-        return cli
+        return addr
+
+    def close(self) -> None:
+        self.pool.close()
+
+
+class _ShuffleTarget:
+    """One resolved shuffle target over the locator's shared connection
+    pool. ``call`` leases a pooled connection for exactly one RPC (the
+    legacy per-call sites: dense fetch, handoff probe); ``lease`` hands
+    the caller an exclusive RpcClient for a pipelined call_begin/
+    call_finish window, paired with ``release``. The address is fixed at
+    construction — re-resolution is the LOCATOR's job, on failure."""
+
+    __slots__ = ("pool", "addr")
+
+    def __init__(self, pool: RpcClientPool, addr: str) -> None:
+        self.pool = pool
+        self.addr = addr
+
+    @property
+    def host(self) -> str:
+        return self.addr.rsplit(":", 1)[0]
+
+    @property
+    def port(self) -> int:
+        return int(self.addr.rsplit(":", 1)[1])
+
+    def call(self, method: str, *params: Any) -> Any:
+        cli = self.pool.acquire(self.addr)
+        dead = False
+        try:
+            return cli.call(method, *params)
+        except (ConnectionError, OSError):
+            dead = True
+            raise
+        finally:
+            self.pool.release(self.addr, cli, dead=dead)
+
+    def lease(self) -> RpcClient:
+        return self.pool.acquire(self.addr)
+
+    def release(self, cli: RpcClient, dead: bool = False) -> None:
+        self.pool.release(self.addr, cli, dead=dead)
 
 
 def make_map_locator(events_fn: Any, secret: bytes | None,
                      poll_s: float = 0.2, timeout_s: float = 600.0,
-                     scope: "str | None" = None) -> MapLocator:
+                     scope: "str | None" = None,
+                     conns_per_target: int = 2) -> MapLocator:
     """Factory kept for the existing call sites (tracker + child)."""
     return MapLocator(events_fn, secret, poll_s=poll_s,
-                      timeout_s=timeout_s, scope=scope)
+                      timeout_s=timeout_s, scope=scope,
+                      conns_per_target=conns_per_target)
+
+
+class SpillFdCache:
+    """LRU of open spill-file descriptors on the SERVING side of the
+    shuffle. The original chunk path re-opened and re-seeked the spill
+    per chunk — O(chunks · open) syscalls and dentry walks for a
+    segment that is read start-to-finish in 1 MiB slices by design.
+    Here every chunk is one ``os.pread`` on a cached fd: stateless
+    (no shared file position, so the reactor's pool threads read
+    concurrently), exactly the payload slice is allocated (``pread``
+    returns the bytes the response frame ships — no staging buffer to
+    copy out of), and the fd survives across chunks, fetchers, and
+    reducers until LRU pressure or job cleanup closes it.
+
+    Pinning: an fd being pread by one thread may be evicted by another;
+    eviction under pin marks the entry dead and the LAST unpin closes
+    it — never a read on a closed (possibly reused) fd number."""
+
+    class _Ent:
+        __slots__ = ("fd", "pins", "dead")
+
+        def __init__(self, fd: int) -> None:
+            self.fd = fd
+            self.pins = 0
+            self.dead = False
+
+    def __init__(self, capacity: int = 64) -> None:
+        self._cap = max(1, int(capacity))
+        # insertion order = recency order (re-inserted on every hit)
+        self._entries: "dict[str, SpillFdCache._Ent]" = {}
+        self._lock = threading.Lock()
+        self.opens = 0
+        self.evictions = 0
+
+    def pread(self, path: str, n: int, offset: int) -> bytes:
+        ent = self._pin(path)
+        try:
+            return os.pread(ent.fd, n, offset)
+        finally:
+            self._unpin(ent)
+
+    def _pin(self, path: str) -> "SpillFdCache._Ent":
+        with self._lock:
+            ent = self._entries.pop(path, None)
+            if ent is not None:
+                self._entries[path] = ent   # most-recently used again
+                ent.pins += 1
+                return ent
+        fd = os.open(path, os.O_RDONLY)
+        close_now = None
+        try:
+            with self._lock:
+                ent = self._entries.get(path)
+                if ent is not None:
+                    # lost an open race — use the cached fd, drop ours
+                    ent.pins += 1
+                    close_now = fd
+                    return ent
+                self.opens += 1
+                ent = SpillFdCache._Ent(fd)
+                ent.pins = 1
+                self._entries[path] = ent
+                while len(self._entries) > self._cap:
+                    victim_path = next(iter(self._entries))
+                    victim = self._entries.pop(victim_path)
+                    self.evictions += 1
+                    if victim.pins:
+                        victim.dead = True   # last unpin closes it
+                    else:
+                        try:
+                            os.close(victim.fd)
+                        except OSError:
+                            pass
+                return ent
+        finally:
+            if close_now is not None:
+                try:
+                    os.close(close_now)
+                except OSError:
+                    pass
+
+    def _unpin(self, ent: "SpillFdCache._Ent") -> None:
+        with self._lock:
+            ent.pins -= 1
+            if ent.dead and ent.pins == 0:
+                try:
+                    os.close(ent.fd)
+                except OSError:
+                    pass
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def invalidate(self, prefix: str = "") -> None:
+        """Drop (and close) every cached fd whose path starts with
+        ``prefix`` — job cleanup unlinks the spill tree, and a cached
+        fd would otherwise pin the disk blocks of a purged job until
+        LRU pressure got around to it. '' drops everything."""
+        with self._lock:
+            victims = [p for p in self._entries if p.startswith(prefix)] \
+                if prefix else list(self._entries)
+            for p in victims:
+                ent = self._entries.pop(p)
+                if ent.pins:
+                    ent.dead = True
+                else:
+                    try:
+                        os.close(ent.fd)
+                    except OSError:
+                        pass
+
+
+#: the tiniest chunk worth a compression attempt: below this the codec
+#: frame overhead eats the win and the CPU is pure waste
+_WIRE_MIN_BYTES = 1024
+
+
+def _wire_compress(out: dict, wire: str) -> None:
+    """Compress one served chunk's payload bytes for the wire, in
+    place, when it pays: the client OFFERED a codec, the spill itself
+    is uncompressed (re-compressing zlib'd bytes only burns CPU), and
+    the result actually shrank (pre-compressed/random data rides raw —
+    the response omits ``wire`` and the client skips the decode).
+    ``n`` always reports the payload-space length covered, so chunk
+    offsets stay payload-relative whatever the wire carried."""
+    if (not wire or wire == "none" or out.get("codec", "none") != "none"
+            or len(out["data"]) < _WIRE_MIN_BYTES):
+        return
+    from tpumr.io.compress import get_codec
+    try:
+        comp = get_codec(wire).compress(bytes(out["data"]))
+    except Exception:  # noqa: BLE001 — wire codec is best-effort
+        return
+    if len(comp) < len(out["data"]):
+        out["wire"] = wire
+        out["data"] = comp
+
+
+def serve_chunk(fds: SpillFdCache, path: str, index: dict,
+                partition: int, offset: int, max_bytes: int,
+                max_chunk: int, wire: str = "none") -> dict:
+    """One bounded chunk of one partition segment, pread off the fd
+    cache. The chunk length is DETERMINISTIC — ``min(max_bytes,
+    max_chunk, remaining)`` in payload space — which is what lets a
+    pipelining client schedule follow-up offsets before their
+    predecessors arrive. Shared by the tracker's RPC methods and the
+    bench/test serving stubs."""
+    off, raw_len, part_len = index["partitions"][partition]
+    payload_len = part_len - 4          # minus the length prefix
+    offset = max(0, int(offset))
+    n = max(0, min(int(max_bytes), max_chunk, payload_len - offset))
+    data = fds.pread(path, n, off + 4 + offset)
+    out = {"data": data, "total": payload_len, "raw": raw_len,
+           "codec": index.get("codec", "none"), "n": n}
+    _wire_compress(out, wire)
+    return out
+
+
+def serve_batch(fds: SpillFdCache, lookup: Any, partition: int,
+                map_indexes: "list[int]", max_bytes_each: int,
+                max_total_bytes: int, max_chunk: int,
+                wire: str = "none") -> "list[dict]":
+    """Many small segments from ONE tracker in ONE response frame — the
+    small-segment regime where per-call overhead dominates the copy
+    phase. ``lookup(map_index) -> (path, index)`` raises to fail THAT
+    entry alone: the error rides back as ``{"map_index", "error"}`` so
+    one lost map triggers the fetch-failure protocol for exactly that
+    map while the rest of the batch lands. The total-bytes budget stops
+    the batch early (≥1 entry always served; omitted indexes are simply
+    absent and the copier requeues them); an entry bigger than its
+    per-entry cap arrives as a prefix the copier continues chunked."""
+    out: "list[dict]" = []
+    budget = max(1, int(max_total_bytes))
+    for m in map_indexes:
+        if budget <= 0 and out:
+            break
+        try:
+            path, index = lookup(m)
+            ent = serve_chunk(fds, path, index, partition, 0,
+                              min(int(max_bytes_each), budget)
+                              if out else int(max_bytes_each),
+                              max_chunk, wire)
+        except Exception as e:  # noqa: BLE001 — per-entry failure seam
+            out.append({"map_index": m, "error": f"{type(e).__name__}: {e}"})
+            continue
+        ent["map_index"] = m
+        budget -= len(ent["data"])
+        out.append(ent)
+    return out
 
 
 class NodeRunner:
@@ -351,9 +593,30 @@ class NodeRunner:
         self._tpu_sem = threading.Semaphore(max(1, self.max_tpu_map_slots))
         self._red_sem = threading.Semaphore(max(1, self.max_reduce_slots))
 
-        # shuffle server = this tracker's RPC surface (MapOutputServlet role)
+        # shuffle server = this tracker's RPC surface (MapOutputServlet
+        # role) — reactor-served by default: shuffle reads ride the
+        # selector loop's bounded handler pool (saturation answered and
+        # counted, rpc_pool_saturated) and pipelining fetchers keep
+        # several chunk requests in flight per connection. The knob is
+        # the escape hatch back to thread-per-connection.
+        use_reactor = confkeys.get_boolean(conf, "tpumr.tasktracker.reactor")
         self._server = RpcServer(self, host=self.bind_host, port=0,
-                                 secret=self._rpc_secret)
+                                 secret=self._rpc_secret,
+                                 reactor=use_reactor,
+                                 fast_methods={"get_protocol_version",
+                                               "umbilical_ping"}
+                                 if use_reactor else None)
+        # shuffle reads are idempotent byte-range reads: opt them out of
+        # the replay cache so MiB-scale chunk responses never pin the
+        # response stripes (and replays simply re-read)
+        self._server.uncached_methods = {
+            "get_map_output", "get_map_output_chunk",
+            "get_map_output_dense", "get_map_outputs_batch",
+        }
+        #: serving-side LRU of open spill fds (os.pread per chunk — no
+        #: per-chunk open/seek; invalidated on job purge/rebind)
+        self._spill_fds = SpillFdCache(
+            confkeys.get_int(conf, "tpumr.shuffle.fd.cache.size"))
         # task children authenticate with their JOB token, not the
         # cluster secret (≈ JobTokenSecretManager + SecureShuffleUtils):
         # scoped callers may reach only the umbilical + shuffle surface,
@@ -370,7 +633,7 @@ class NodeRunner:
             "umbilical_can_commit", "umbilical_events", "umbilical_done",
             "umbilical_fail", "umbilical_report_fetch_failure",
             "get_map_output", "get_map_output_chunk",
-            "get_map_output_dense",
+            "get_map_output_dense", "get_map_outputs_batch",
         }
         #: fetch-failure reports from this tracker's reduces (in-process
         #: or via the umbilical), forwarded to the master on the next
@@ -962,6 +1225,8 @@ class NodeRunner:
                 self.map_outputs = {k: v for k, v in
                                     self.map_outputs.items()
                                     if k[0] != key}
+            self._spill_fds.invalidate(
+                os.path.join(self.local_root, "handoff", job_id))
             shutil.rmtree(os.path.join(self.local_root, "handoff",
                                        job_id), ignore_errors=True)
             with self.lock:
@@ -995,6 +1260,8 @@ class NodeRunner:
                     from tpumr.mapred import filecache
                     filecache.release_job(
                         jc, os.path.join(self.local_root, "cache"), job_id)
+                self._spill_fds.invalidate(
+                    os.path.join(self.local_root, job_id))
                 shutil.rmtree(os.path.join(self.local_root, job_id),
                               ignore_errors=True)
         self._purge_old_userlogs()
@@ -1934,15 +2201,48 @@ class NodeRunner:
 
     def get_map_output_chunk(self, job_id: str, map_index: int,
                              partition: int, offset: int,
-                             max_bytes: int) -> dict:
+                             max_bytes: int, wire: str = "none") -> dict:
         """Serve one bounded range of a partition segment's compressed
         payload (the streaming re-design of MapOutputServlet,
         TaskTracker.java:4050 — the reference streams via servlet chunked
         output; here each RPC response is one bounded chunk). ``offset``
         is payload-relative; ``total`` is the payload length so the copier
         knows when it has everything; ``raw`` is the decompressed size the
-        ShuffleRamManager budgets on."""
+        ShuffleRamManager budgets on. ``wire`` (optional, 6th param so
+        old 5-arg callers are untouched) names a codec the CLIENT can
+        decode: chunks of uncompressed spills come back wire-compressed
+        (response field ``wire``) when it shrinks them, with ``n`` the
+        payload-space length covered so offsets stay payload-relative."""
         self._check_scope(job_id)
+        path, index = self._chunk_entry(job_id, map_index)
+        return serve_chunk(self._spill_fds, path, index, partition,
+                           offset, max_bytes, self.MAX_CHUNK_BYTES, wire)
+
+    def get_map_outputs_batch(self, job_id: str, partition: int,
+                              map_indexes: "list[int]",
+                              max_bytes_each: int = 1 << 20,
+                              max_total_bytes: int = 8 << 20,
+                              wire: str = "none") -> "list[dict]":
+        """Batched multi-segment fetch: many (small) map outputs of one
+        partition in ONE response frame — see :func:`serve_batch` for
+        the per-entry failure / budget-omission / prefix-continuation
+        contract. The per-entry fault seam fires INSIDE the batch, so a
+        chaos-killed map fails its own entry while siblings land."""
+        self._check_scope(job_id)
+
+        def lookup(m: int) -> tuple:
+            return self._chunk_entry(job_id, m)
+
+        return serve_batch(
+            self._spill_fds, lookup, partition, list(map_indexes),
+            min(int(max_bytes_each), self.MAX_CHUNK_BYTES),
+            min(int(max_total_bytes), 8 * self.MAX_CHUNK_BYTES),
+            self.MAX_CHUNK_BYTES, wire)
+
+    def _chunk_entry(self, job_id: str, map_index: int) -> tuple:
+        """(path, index) of one chunk-servable output, with the lookup
+        failure + chaos seam + dense guard shared by the chunk and
+        batch endpoints."""
         ent = self._map_output_entry(job_id, map_index)
         if ent is None:
             raise KeyError(f"no map output for {job_id} map {map_index}")
@@ -1952,16 +2252,7 @@ class NodeRunner:
             raise ValueError(f"map output for {job_id} map {map_index} is "
                              "dense (device-shuffled job) — fetch with "
                              "get_map_output_dense")
-        off, raw_len, part_len = index["partitions"][partition]
-        payload_len = part_len - 4          # minus the length prefix
-        offset = max(0, int(offset))
-        n = max(0, min(int(max_bytes), self.MAX_CHUNK_BYTES,
-                       payload_len - offset))
-        with open(path, "rb") as f:
-            f.seek(off + 4 + offset)
-            data = f.read(n)
-        return {"data": data, "total": payload_len, "raw": raw_len,
-                "codec": index.get("codec", "none")}
+        return path, index
 
     def get_map_output_dense(self, job_id: str, map_index: int) -> dict:
         """Serve a device-shuffled job's whole dense map output (same
@@ -1988,7 +2279,9 @@ class NodeRunner:
             self._rpc_secret,
             poll_s=self.conf.get_int("tpumr.shuffle.poll.ms", 200) / 1000.0,
             timeout_s=self.conf.get_int("tpumr.shuffle.timeout.ms",
-                                        600_000) / 1000.0)
+                                        600_000) / 1000.0,
+            conns_per_target=confkeys.get_int(
+                self.conf, "tpumr.shuffle.conns.per.target"))
 
     def _handoff_source(self, upstream_job: str):
         """Shared per-upstream-stage stream source for downstream

@@ -179,10 +179,13 @@ class PipelineHandoffInputFormat:
 
         # monotonic deadline: an NTP step mid-wait must not fire (or
         # stall) the handoff timeout
+        from tpumr.io.compress import wire_codec_or_none
+        wire = wire_codec_or_none(
+            confkeys.get(conf, "tpumr.shuffle.wire.codec"))
         deadline = time.monotonic() + timeout_s
         while True:
             if src is not None:
-                records = self._try_stream(src, split)
+                records = self._try_stream(src, split, wire)
                 if records is not None:
                     bump(COUNTER_STREAMED)
                     return records
@@ -213,14 +216,26 @@ class PipelineHandoffInputFormat:
     #: upstream partition streams memory-bounded on both ends
     FETCH_CHUNK_BYTES = 4 << 20
 
-    def _try_stream(self, src: Any, split: HandoffSplit):
+    #: streamed-fetch chunk requests kept in flight per connection
+    #: (the copier's pipelined-window discipline, inherited here)
+    PIPELINE_DEPTH = 4
+
+    def _try_stream(self, src: Any, split: HandoffSplit,
+                    wire: str = "none"):
         """One bounded attempt at the streamed path: locate the serving
         tracker via the handoff completion-event feed, then stream the
         single-partition segment through the CHUNKED shuffle endpoint
         (first chunk fetched eagerly so a dead server demotes the
         location instead of failing the attempt; a mid-stream loss
         raises into the normal attempt-retry protocol). None = not
-        (yet) streamable — the caller interleaves the DFS fallback."""
+        (yet) streamable — the caller interleaves the DFS fallback.
+
+        The stream inherits the shuffle wire-path machinery: when the
+        source hands out a pooled target (``lease``), remaining chunks
+        ride a PIPELINED window over one leased connection
+        (offset-predictive — the server's chunk length is
+        deterministic), and ``wire`` wire-compresses uncompressed
+        handoff spills in flight."""
         from tpumr.io import ifile
         try:
             client = src.locate(split.partition)
@@ -232,7 +247,7 @@ class PipelineHandoffInputFormat:
         try:
             first = client.call("get_map_output_chunk", key,
                                 split.partition, 0, 0,
-                                self.FETCH_CHUNK_BYTES)
+                                self.FETCH_CHUNK_BYTES, wire)
         except Exception:  # noqa: BLE001 — serving tracker gone/lame:
             # demote the cached location (the feed's OBSOLETE tombstone
             # or a fresh event decides its fate) and fall back
@@ -240,21 +255,65 @@ class PipelineHandoffInputFormat:
             return None
         from tpumr.io.writable import deserialize
 
+        def decode(out: dict) -> bytes:
+            if out.get("wire"):
+                from tpumr.io.compress import get_codec
+                return get_codec(out["wire"]).decompress(out["data"])
+            return out["data"]
+
         def chunks() -> Iterator[bytes]:
             total = int(first["total"])
-            yield first["data"]
-            off = len(first["data"])
-            while off < total:
-                out = client.call("get_map_output_chunk", key,
-                                  split.partition, 0, off,
-                                  self.FETCH_CHUNK_BYTES)
-                data = out["data"]
-                if not data:
-                    raise EOFError(
-                        f"handoff stream for {split.describe()} "
-                        f"truncated at {off}/{total}")
-                yield data
-                off += len(data)
+            data = decode(first)
+            yield data
+            off = len(data)
+            if off >= total:
+                return
+            lease = getattr(client, "lease", None)
+            if lease is None:
+                # legacy bare-client source: sequential chunks
+                while off < total:
+                    out = client.call("get_map_output_chunk", key,
+                                      split.partition, 0, off,
+                                      self.FETCH_CHUNK_BYTES, wire)
+                    data = decode(out)
+                    if not data:
+                        raise EOFError(
+                            f"handoff stream for {split.describe()} "
+                            f"truncated at {off}/{total}")
+                    yield data
+                    off += len(data)
+                return
+            cli = lease()
+            dead = False
+            try:
+                offsets = range(off, total, self.FETCH_CHUNK_BYTES)
+                inflight = 0
+                i = 0
+                while inflight or i < len(offsets):
+                    while i < len(offsets) \
+                            and inflight < self.PIPELINE_DEPTH:
+                        cli.call_begin(
+                            "get_map_output_chunk", key,
+                            split.partition, 0, offsets[i],
+                            self.FETCH_CHUNK_BYTES, wire)
+                        i += 1
+                        inflight += 1
+                    out = cli.call_finish()
+                    inflight -= 1
+                    data = decode(out)
+                    if not data:
+                        raise EOFError(
+                            f"handoff stream for {split.describe()} "
+                            f"truncated at {off}/{total}")
+                    yield data
+                    off += len(data)
+            except (ConnectionError, OSError):
+                dead = True
+                raise
+            finally:
+                # abandoned window ⇒ outstanding responses ⇒ the pool
+                # closes the connection instead of reusing it
+                client.release(cli, dead=dead)
 
         def gen() -> Iterator[tuple[Any, Any]]:
             for kb, vb in ifile.iter_chunked_segment(
