@@ -380,6 +380,187 @@ def bench_kmeans_pipeline(rows: dict) -> None:
     assert identical, "pipeline rounds must reproduce the sequential " \
                       "driver's centroids byte-for-byte"
 
+    # --- devcache-affinity warm rounds: the same pipeline on the DEVICE
+    # kernel path (jax is pinned to cpu in this phase — the split-cache/
+    # devcache machinery is backend-agnostic), where round r's reducer
+    # pre-seeds round r+1's centroids under their tag and the scheduler
+    # places maps by the tag inventory trackers piggyback on heartbeats.
+    # In-process mini-cluster trackers share ONE process-global devcache,
+    # so what this rig measures honestly is cold-vs-warm staged bytes,
+    # the warm-round HBM hit rate, and the affinity counters proving the
+    # placement layer consulted (and hit) the tag index — not
+    # per-tracker re-staging, which needs real multi-host trackers.
+    from tpumr.core.counters import BackendCounter
+    from tpumr.ops.devcache import clear_device_cache
+
+    aff_rounds = 6
+
+    def device_pipeline(tag: str,
+                        affinity: bool) -> "tuple[list[int], dict]":
+        clear_pipeline_caches()
+        clear_device_cache()
+        np.save(os.path.join(work, f"{tag}-cents-r0.npy"), cents0)
+        dconf = round_conf_dict(tag)
+        dconf["tpumr.map.kernel"] = "kmeans-assign"
+        cconf = JobConf()
+        cconf.set("mapred.reduce.slowstart.completed.maps", 0.0)
+        cconf.set("tpumr.scheduler.affinity", affinity)
+        with MiniMRCluster(num_trackers=2, tpu_slots=2, cpu_slots=0,
+                           conf=cconf) as dc:
+            g2 = JobGraph(f"bench-kmeans-{tag}")
+            g2.loop("km", dconf, max_rounds=aff_rounds,
+                    converge={"group": "KMeans",
+                              "counter": "CENTROID_SHIFT_MILLI",
+                              "op": "lt", "value": 0})
+            st2 = PipelineClient(dc.create_job_conf()).submit(g2) \
+                .wait_for_completion(poll_s=0.05)
+            assert st2["state"] == "SUCCEEDED", st2
+            staged = [int(dc.master.jobs[j].counters.value(
+                          BackendCounter.GROUP,
+                          BackendCounter.TPU_DEVICE_BYTES_STAGED))
+                      for j in st2["nodes"]["km"]["jobs"]]
+            sched_counters = dict(dc.master.scheduler.metrics.snapshot())
+        clear_pipeline_caches()
+        clear_device_cache()
+        return staged, sched_counters
+
+    staged_on, aff_counters = device_pipeline("aff", affinity=True)
+    staged_off, _ = device_pipeline("affoff", affinity=False)
+    final_on = fs.read_bytes(f"file://{work}/aff-cents-r{aff_rounds}.npy")
+    final_off = fs.read_bytes(
+        f"file://{work}/affoff-cents-r{aff_rounds}.npy")
+    aff_identical = final_on == final_off
+    cold = staged_on[0]
+    warm = sum(staged_on[1:])
+    warm_rounds = max(1, len(staged_on) - 1)
+    hit_rate = sum(1 for s in staged_on[1:] if s == 0) / warm_rounds
+    log(f"[kmeans_pipeline] affinity warm rounds: cold round staged "
+        f"{cold:,} B, warm rounds staged {warm:,} B total over "
+        f"{warm_rounds} (hbm hit rate {hit_rate:.2f}), scheduler "
+        f"warm_hits={aff_counters.get('affinity_warm_hits', 0)} "
+        f"defers={aff_counters.get('affinity_defers', 0)}, "
+        f"identical(affinity on/off)={aff_identical}")
+    rows["kmeans_pipeline_affinity_rounds"] = aff_rounds
+    rows["kmeans_pipeline_affinity_cold_staged_bytes"] = cold
+    rows["kmeans_pipeline_affinity_warm_staged_bytes"] = warm
+    rows["kmeans_pipeline_affinity_warm_hbm_hit_rate"] = round(
+        hit_rate, 3)
+    rows["kmeans_pipeline_affinity_warm_hits"] = int(
+        aff_counters.get("affinity_warm_hits", 0))
+    rows["kmeans_pipeline_affinity_defers"] = int(
+        aff_counters.get("affinity_defers", 0))
+    rows["kmeans_pipeline_affinity_cold_assigns"] = int(
+        aff_counters.get("affinity_cold_assigns", 0))
+    rows["kmeans_pipeline_affinity_off_warm_staged_bytes"] = \
+        sum(staged_off[1:])
+    rows["kmeans_pipeline_affinity_identical_output"] = aff_identical
+    assert cold > 0, "round 0 must stage the splits host->device"
+    assert warm < cold, \
+        "warm rounds must not re-stage what the caches hold " \
+        f"(cold {cold} B vs warm total {warm} B)"
+    assert aff_identical, "affinity placement must change WHERE maps " \
+                          "run, never the centroids they produce"
+
+
+# ------------------------------------------------------------- straggler
+
+
+def bench_straggler(rows: dict) -> None:
+    """Targeted speculation's acceptance row: one fi-injected slow map
+    (``task.slow.m0`` crawls for ``tpumr.fi.task.slow.ms`` before its
+    real work) in a sleep job with deliberately bimodal map runtimes,
+    run three ways on identical mini clusters. OFF: the job's wall IS
+    the crawl. BLANKET (``tpumr.speculative.targeted=false``): the
+    reference's age-only rule rescues the job but also twins the
+    healthy long maps — wasted duplicate work. TARGETED (default): the
+    estimated-finish + critical-path gates twin exactly the straggler.
+    Host-only — this measures the control plane, not kernels. The
+    acceptance relations are asserted here, not just reported."""
+    from tpumr.mapred.job_client import JobClient
+    from tpumr.mapred.jobconf import JobConf
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+    from tpumr.utils import fi
+
+    slow_ms = 6000 if SMALL else 10000
+    # map i sleeps lines[i] x 100 ms. m0 carries the fault AND the
+    # longest split, so its crawling original pins the critical path
+    # until its twin lands — the targeted pass therefore never twins
+    # m1/m2 (healthy but long: exactly what blanket's age-only rule
+    # wastes twins on). m3..m5 finish first and set the completed-
+    # runtime mean both modes' lag gates compare against.
+    lines = [30, 22, 22, 1, 1, 1]
+    work = tempfile.mkdtemp(prefix="tpumr-bench-strag-")
+    paths = []
+    for i, n in enumerate(lines):
+        p = os.path.join(work, f"in-{i}.txt")
+        with open(p, "w") as f:
+            f.write("x\n" * n)
+        paths.append(f"file://{p}")
+
+    def run_mode(tag: str, speculative: bool,
+                 targeted: bool) -> "tuple[float, int, int, int]":
+        fi.reset()   # fired-counts are per-process; each mode re-arms
+        base = JobConf()
+        base.set("tpumr.heartbeat.interval.ms", 100)
+        with MiniMRCluster(num_trackers=3, conf=base, cpu_slots=2,
+                           tpu_slots=0) as c:
+            conf = c.create_job_conf()
+            conf.set_input_paths(",".join(paths))
+            conf.set_output_path(f"file://{work}/out-{tag}")
+            # one split per file, in input order: m<i> <-> in-<i>.txt
+            conf.set("mapred.min.split.size", 1 << 40)
+            conf.set("mapred.mapper.class",
+                     "tpumr.examples.sleep.SleepMapper")
+            conf.set("mapred.reducer.class",
+                     "tpumr.examples.sleep.SleepReducer")
+            conf.set_num_reduce_tasks(1)
+            conf.set("tpumr.sleep.map.ms", 100)
+            conf.set("tpumr.sleep.reduce.ms", 100)
+            conf.set("mapred.speculative.execution", speculative)
+            conf.set("tpumr.speculative.targeted", targeted)
+            conf.set("mapred.speculative.min.runtime.s", 0.3)
+            conf.set("tpumr.fi.task.slow.m0.probability", 1.0)
+            conf.set("tpumr.fi.task.slow.m0.max.failures", 1)
+            conf.set("tpumr.fi.task.slow.ms", slow_ms)
+            t0 = time.time()
+            result = JobClient(conf).run_job(conf)
+            wall = time.time() - t0
+            assert result.successful, f"straggler[{tag}] job failed"
+            assert fi.fired("task.slow.m0") == 1
+            jip = c.master.jobs[str(result.job_id)]
+            return (wall, jip.speculative_launched,
+                    jip.speculative_won, jip.speculative_wasted)
+
+    off = run_mode("off", speculative=False, targeted=True)
+    blanket = run_mode("blanket", speculative=True, targeted=False)
+    targeted = run_mode("targeted", speculative=True, targeted=True)
+
+    speedup = off[0] / max(1e-9, targeted[0])
+    log(f"[straggler] m0 crawls {slow_ms} ms: off {off[0]:.2f}s / "
+        f"blanket {blanket[0]:.2f}s ({blanket[1]} twins, {blanket[3]} "
+        f"wasted) / targeted {targeted[0]:.2f}s ({targeted[1]} twins, "
+        f"{targeted[3]} wasted) -> targeted {speedup:.2f}x over off")
+    rows["straggler_slow_ms"] = slow_ms
+    rows["straggler_maps"] = len(lines)
+    rows["straggler_off_s"] = round(off[0], 3)
+    rows["straggler_blanket_s"] = round(blanket[0], 3)
+    rows["straggler_targeted_s"] = round(targeted[0], 3)
+    rows["straggler_targeted_speedup_vs_off"] = round(speedup, 3)
+    rows["straggler_off_launched"] = off[1]
+    rows["straggler_blanket_launched"] = blanket[1]
+    rows["straggler_blanket_wasted"] = blanket[3]
+    rows["straggler_targeted_launched"] = targeted[1]
+    rows["straggler_targeted_won"] = targeted[2]
+    rows["straggler_targeted_wasted"] = targeted[3]
+    assert off[1] == 0, "speculation off must launch no twins"
+    assert targeted[2] >= 1, "the targeted twin must win the race"
+    assert speedup >= 1.3, \
+        f"targeted speculation must beat speculation-off >=1.3x " \
+        f"(got {speedup:.2f}x)"
+    assert targeted[1] < blanket[1], \
+        f"targeted must launch strictly fewer twins than blanket " \
+        f"({targeted[1]} vs {blanket[1]})"
+
 
 # ------------------------------------------------------------- wordcount
 
@@ -1167,6 +1348,7 @@ PHASES: list = [
     ("terasort_fresh", bench_terasort_fresh, "required", 1500),
     ("kmeans", bench_kmeans, "optional", 5400),
     ("kmeans_pipeline", bench_kmeans_pipeline, "never", 1800),
+    ("straggler", bench_straggler, "never", 900),
     ("pi", bench_pi, "optional", 1200),
     ("matmul", bench_matmul, "optional", 1800),
     ("wordcount", bench_wordcount, "optional", 900),

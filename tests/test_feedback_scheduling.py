@@ -1,0 +1,518 @@
+"""Feedback-driven scheduling: the per-TIP remaining-work model,
+LATE-style targeted speculation (estimated-finish stragglers on the
+critical path, capped), devcache-affinity placement, and size-aware
+shuffle fetch ordering. The mini-cluster e2e at the bottom injects a
+``task.slow`` straggler and proves the master twins EXACTLY it, with
+byte-correct output."""
+
+import io
+import os
+import threading
+import time
+
+import pytest
+
+from tpumr.io import ifile
+from tpumr.mapred.ids import JobID
+from tpumr.mapred.job_in_progress import JobInProgress
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.task import TaskState, TaskStatus
+from tpumr.utils import fi
+
+FI_SEED = os.environ.get("TPUMR_FI_SEED", "20260804")
+
+
+def _job(n_maps=2, **conf):
+    base = {"mapred.reduce.tasks": 0,
+            "mapred.speculative.execution": True,
+            "mapred.reduce.slowstart.completed.maps": 0.0}
+    base.update(conf)
+    splits = [{"locations": []} for _ in range(n_maps)]
+    return JobInProgress(JobID("fb", 1), splits=splits, conf_dict=base)
+
+
+def _finish(job, task, runtime=1.0, is_map=True):
+    now = time.time()
+    job.update_task_status(TaskStatus(
+        attempt_id=task.attempt_id, is_map=is_map,
+        state=TaskState.SUCCEEDED, start_time=now - runtime,
+        finish_time=now), "t:0")
+
+
+def _running(job, task, progress):
+    job.update_task_status(TaskStatus(
+        attempt_id=task.attempt_id, is_map=True,
+        state=TaskState.RUNNING, progress=progress), "t:0")
+
+
+# ------------------------------------------------- remaining-work model
+
+
+class TestRemainingWorkModel:
+    def test_progress_folds_into_rate_ewma(self):
+        job = _job(n_maps=1)
+        t = job.obtain_new_map_task("h", run_on_tpu=False)
+        tip = job.maps[t.partition]
+        _running(job, t, 0.2)
+        time.sleep(0.02)
+        _running(job, t, 0.6)
+        assert tip.rate_ewma > 0.0
+        assert tip.last_progress == 0.6
+        ewma = tip.rate_ewma
+        # a beat with no advance must not move the anchor or the rate
+        _running(job, t, 0.5)
+        assert tip.last_progress == 0.6 and tip.rate_ewma == ewma
+
+    def test_remaining_estimate_prefers_rate(self):
+        job = _job(n_maps=1)
+        t = job.obtain_new_map_task("h", run_on_tpu=False)
+        tip = job.maps[t.partition]
+        now = time.monotonic()
+        tip.rate_ewma, tip.last_progress = 0.1, 0.5
+        assert job._tip_remaining_s(tip, now, 99.0) == pytest.approx(5.0)
+        # no EWMA yet: elapsed-proportional fallback
+        tip.rate_ewma = 0.0
+        tip.last_progress = 0.25
+        tip.dispatch_mono = now - 30.0
+        assert job._tip_remaining_s(tip, now, 99.0) == pytest.approx(
+            90.0, rel=0.01)
+        # silent tip: a full mean runtime — stalls must look LONG
+        tip.last_progress = 0.0
+        assert job._tip_remaining_s(tip, now, 7.0) == 7.0
+
+    def test_critical_path_and_longest_path(self):
+        job = _job(n_maps=3)
+        t0 = job.obtain_new_map_task("h", run_on_tpu=False)
+        t1 = job.obtain_new_map_task("h", run_on_tpu=False)
+        t2 = job.obtain_new_map_task("h", run_on_tpu=False)
+        fast, slow, mid = (job.maps[t.partition] for t in (t0, t1, t2))
+        fast.rate_ewma, fast.last_progress = 1.0, 0.9    # ~0.1s left
+        slow.rate_ewma, slow.last_progress = 0.01, 0.1   # ~90s left
+        mid.rate_ewma, mid.last_progress = 0.01, 0.2     # ~80s left
+        cp = job.critical_path_maps()
+        assert slow.partition in cp and mid.partition in cp
+        assert fast.partition not in cp
+        est = job.map_remaining_estimates()
+        assert len(est) == 3
+        assert job.longest_remaining_path_s() == pytest.approx(
+            est[slow.partition], rel=0.05)
+        sd = job.status_dict()
+        assert sd["longest_remaining_path_s"] > 0
+        assert sd["speculative_in_flight"] == 0
+
+
+# ------------------------------------------------- targeted speculation
+
+
+class TestTargetedSpeculation:
+    def test_targets_the_critical_straggler_not_the_nearly_done(self):
+        """Two old running maps: one nearly done, one silent. Blanket
+        would twin both; targeted twins ONLY the critical-path one."""
+        job = _job(n_maps=3, **{"tpumr.speculative.cap": 1})
+        t0 = job.obtain_new_map_task("h", run_on_tpu=False)
+        near = job.obtain_new_map_task("h", run_on_tpu=False)
+        stuck = job.obtain_new_map_task("h", run_on_tpu=False)
+        _finish(job, t0, runtime=1.0)
+        for t in (near, stuck):
+            job.maps[t.partition].dispatch_mono = time.monotonic() - 100
+        # nearly done: high rate, high progress -> tiny remaining
+        job.maps[near.partition].rate_ewma = 1.0
+        job.maps[near.partition].last_progress = 0.99
+        spec = job.obtain_new_map_task("h", run_on_tpu=False)
+        assert spec is not None and spec.partition == stuck.partition
+        assert job.speculative_launched == 1
+        assert job.speculative_in_flight() == 1
+        # cap=1: the nearly-done tip can't twin even if it qualified
+        assert job.obtain_new_map_task("h", run_on_tpu=False) is None
+        # the twin wins; the original's kill settles nothing extra
+        _finish(job, spec, runtime=0.01)
+        assert job.should_kill_attempt(str(stuck.attempt_id))
+        assert job.speculative_won == 1 and job.speculative_wasted == 0
+        assert job.speculative_in_flight() == 0
+
+    def test_young_task_never_speculated(self):
+        """Counter-case: all maps dispatched moments ago — under the
+        min-runtime floor nothing twins, targeted or blanket."""
+        for targeted in (True, False):
+            job = _job(n_maps=2,
+                       **{"tpumr.speculative.targeted": targeted})
+            a = job.obtain_new_map_task("h", run_on_tpu=False)
+            job.obtain_new_map_task("h", run_on_tpu=False)
+            _finish(job, a, runtime=0.01)
+            assert job.obtain_new_map_task("h", run_on_tpu=False) is None
+            assert job.speculative_launched == 0
+
+    def test_within_distribution_estimate_not_speculated(self):
+        """A task whose ESTIMATED FINISH sits inside the completed-
+        runtime distribution is left alone even past the age floor —
+        the case blanket speculation gets wrong."""
+        job = _job(n_maps=2,
+                   **{"mapred.speculative.min.runtime.s": 0.0})
+        a = job.obtain_new_map_task("h", run_on_tpu=False)
+        b = job.obtain_new_map_task("h", run_on_tpu=False)
+        _finish(job, a, runtime=5.0)          # mean = 5s
+        tip = job.maps[b.partition]
+        tip.dispatch_mono = time.monotonic() - 1.0   # 1s old
+        tip.rate_ewma, tip.last_progress = 1.0, 0.8  # ~0.2s remaining
+        # est finish 1.2s << 1.5 * 5s: no twin
+        assert job.obtain_new_map_task("h", run_on_tpu=False) is None
+        assert job.speculative_launched == 0
+
+    def test_cap_bounds_concurrent_twins_blanket_does_not(self):
+        def straggling_job(**extra):
+            job = _job(n_maps=3, **extra)
+            t0 = job.obtain_new_map_task("h", run_on_tpu=False)
+            s1 = job.obtain_new_map_task("h", run_on_tpu=False)
+            s2 = job.obtain_new_map_task("h", run_on_tpu=False)
+            _finish(job, t0, runtime=0.5)
+            for t in (s1, s2):
+                job.maps[t.partition].dispatch_mono = \
+                    time.monotonic() - 100
+            return job
+
+        capped = straggling_job(**{"tpumr.speculative.cap": 1})
+        assert capped.obtain_new_map_task("h", run_on_tpu=False) \
+            is not None
+        assert capped.obtain_new_map_task("h", run_on_tpu=False) is None
+        assert capped.speculative_launched == 1
+
+        blanket = straggling_job(**{"tpumr.speculative.targeted": False})
+        assert blanket.obtain_new_map_task("h", run_on_tpu=False) \
+            is not None
+        assert blanket.obtain_new_map_task("h", run_on_tpu=False) \
+            is not None
+        assert blanket.speculative_launched == 2
+
+    def test_wasted_twin_counted(self):
+        job = _job(n_maps=2)
+        t0 = job.obtain_new_map_task("h", run_on_tpu=False)
+        slow = job.obtain_new_map_task("h", run_on_tpu=False)
+        _finish(job, t0, runtime=0.01)
+        job.maps[slow.partition].dispatch_mono = time.monotonic() - 100
+        spec = job.obtain_new_map_task("h", run_on_tpu=False)
+        assert spec is not None
+        # the ORIGINAL finishes first: the twin was wasted work
+        _finish(job, slow, runtime=0.01)
+        assert job.should_kill_attempt(str(spec.attempt_id))
+        now = time.time()
+        job.update_task_status(TaskStatus(
+            attempt_id=spec.attempt_id, is_map=True,
+            state=TaskState.KILLED, start_time=now, finish_time=now),
+            "t:0")
+        assert job.speculative_wasted == 1 and job.speculative_won == 0
+        assert job.speculative_in_flight() == 0
+
+
+# ---------------------------------------------- devcache-affinity placement
+
+
+class _FakeManager:
+    def __init__(self, index=None):
+        self._index = index
+
+    def devcache_tag_index(self):
+        if self._index is None:
+            raise AssertionError("index must not be consulted")
+        return self._index
+
+
+class _FakeJob:
+    def __init__(self, jid, tags):
+        self.job_id = jid
+        self._tags = tuple(tags)
+
+    def devcache_tags(self):
+        return self._tags
+
+
+def _affinity_sched(manager, **conf_kv):
+    from tpumr.mapred.scheduler import HybridQueueScheduler
+    conf = JobConf()
+    for k, v in conf_kv.items():
+        conf.set(k, v)
+    sched = HybridQueueScheduler()
+    sched.conf = conf
+    sched.manager = manager
+    return sched
+
+
+class TestDevcacheAffinity:
+    TAG = "kmeans-centroids:mem:///c.npy"
+
+    def test_warm_tracker_assigns_immediately(self):
+        sched = _affinity_sched(_FakeManager({self.TAG: {"t1"}}))
+        sched._begin_affinity({"devcache_tags": [self.TAG]})
+        job = _FakeJob("job_a_1", [self.TAG])
+        assert sched._affinity_defer(job) is False
+
+    def test_cold_tracker_defers_until_budget_then_places(self):
+        sched = _affinity_sched(
+            _FakeManager({self.TAG: {"warm-tracker"}}),
+            **{"tpumr.scheduler.affinity.defer.passes": 2})
+        job = _FakeJob("job_a_1", [self.TAG])
+        for _ in range(2):
+            sched._begin_affinity({"devcache_tags": []})
+            assert sched._affinity_defer(job) is True
+        # budget spent: place cold rather than starve
+        sched._begin_affinity({"devcache_tags": []})
+        assert sched._affinity_defer(job) is False
+        # ...and the budget stays pinned on later beats
+        sched._begin_affinity({"devcache_tags": []})
+        assert sched._affinity_defer(job) is False
+
+    def test_budget_forgiven_on_warm_hit(self):
+        sched = _affinity_sched(_FakeManager({self.TAG: {"w"}}))
+        job = _FakeJob("job_a_1", [self.TAG])
+        sched._begin_affinity({"devcache_tags": []})
+        assert sched._affinity_defer(job) is True
+        sched._begin_affinity({"devcache_tags": [self.TAG]})
+        assert sched._affinity_defer(job) is False
+        assert job.job_id not in sched._affinity_defers
+
+    def test_nobody_warm_anywhere_places_cold(self):
+        sched = _affinity_sched(_FakeManager({}))
+        sched._begin_affinity({"devcache_tags": []})
+        assert sched._affinity_defer(
+            _FakeJob("job_a_1", [self.TAG])) is False
+
+    def test_absent_index_and_absent_tags_are_inert(self):
+        # manager without the devcache_tag_index seam: never deferred
+        class Bare:
+            pass
+        sched = _affinity_sched(Bare())
+        sched._begin_affinity({"devcache_tags": []})
+        assert sched._affinity_defer(
+            _FakeJob("job_a_1", [self.TAG])) is False
+        # a job with no side-input tags: never deferred (index unused)
+        sched2 = _affinity_sched(_FakeManager({self.TAG: {"w"}}))
+        sched2._begin_affinity({"devcache_tags": []})
+        assert sched2._affinity_defer(_FakeJob("job_b_1", [])) is False
+
+    def test_disabled_by_conf(self):
+        sched = _affinity_sched(
+            _FakeManager(None),  # raises if the index is consulted
+            **{"tpumr.scheduler.affinity": False})
+        sched._begin_affinity({"devcache_tags": []})
+        assert sched._affinity_defer(
+            _FakeJob("job_a_1", [self.TAG])) is False
+
+    def test_decision_memoized_per_beat(self):
+        sched = _affinity_sched(_FakeManager({self.TAG: {"w"}}))
+        job = _FakeJob("job_a_1", [self.TAG])
+        sched._begin_affinity({"devcache_tags": []})
+        assert sched._affinity_defer(job) is True
+        # per-slot repeats in the same beat charge the budget ONCE
+        assert sched._affinity_defer(job) is True
+        assert sched._affinity_defers[job.job_id] == 1
+
+    def test_job_devcache_tags_derived_and_explicit(self):
+        derived = _job(n_maps=1, **{
+            "tpumr.kmeans.centroids": "mem:///c.npy"})
+        assert derived.devcache_tags() == (
+            "kmeans-centroids:mem:///c.npy",)
+        explicit = _job(n_maps=1, **{
+            "tpumr.devcache.required.tags": "a:1, b:2",
+            "tpumr.kmeans.centroids": "mem:///ignored.npy"})
+        assert explicit.devcache_tags() == ("a:1", "b:2")
+        assert _job(n_maps=1).devcache_tags() == ()
+
+
+# --------------------------------------------------- size-aware fetching
+
+
+def _make_spill(records, codec="zlib"):
+    buf = io.BytesIO()
+    w = ifile.Writer(buf, codec=codec)
+    w.start_partition()
+    for k, v in records:
+        w.append_raw(k, v)
+    w.end_partition()
+    return buf.getvalue(), w.close()
+
+
+class _SizedSource:
+    """ChunkFetch fake advertising per-map output sizes, recording the
+    order maps were first fetched in."""
+
+    def __init__(self, spills, sizes):
+        self.spills = spills
+        self.sizes = sizes
+        self.order = []
+        self._lock = threading.Lock()
+
+    def size_of(self, map_index):
+        return self.sizes[map_index]
+
+    def __call__(self, map_index, partition, offset):
+        with self._lock:
+            if map_index not in self.order:
+                self.order.append(map_index)
+        data, index = self.spills[map_index]
+        off, raw_len, part_len = index["partitions"][partition]
+        payload = data[off + 4: off + part_len]
+        return {"data": payload[offset:], "total": len(payload),
+                "raw": raw_len, "codec": index.get("codec", "none")}
+
+
+class TestSizeAwareFetchOrder:
+    def _run(self, conf, sizes):
+        from tpumr.mapred.shuffle_copier import ShuffleCopier
+        spills = [_make_spill([(b"k%d" % i, b"v")]) for i in range(4)]
+        src = _SizedSource(spills, sizes)
+        conf.set("tpumr.shuffle.parallel.copies", 1)
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            segs = ShuffleCopier(conf, src, 4, 0, d).copy_all()
+        assert len(segs) == 4
+        return src.order
+
+    def test_largest_advertised_output_fetched_first(self):
+        order = self._run(JobConf(), {0: 10, 1: 4000, 2: 50, 3: 900})
+        assert order == [1, 3, 2, 0]
+
+    def test_priority_disabled_keeps_seed_order(self):
+        conf = JobConf()
+        conf.set("tpumr.shuffle.size.priority", False)
+        order = self._run(conf, {0: 10, 1: 4000, 2: 50, 3: 900})
+        assert order == [0, 1, 2, 3]
+
+    def test_unknown_sizes_sort_last_not_blocked(self):
+        order = self._run(JobConf(), {0: 0, 1: 500, 2: 0, 3: 900})
+        assert order[:2] == [3, 1]
+        assert set(order[2:]) == {0, 2}
+
+    def test_locator_size_of_from_completion_events(self):
+        from tpumr.mapred.tasktracker import MapLocator
+        events = [
+            {"map_index": 0, "attempt_id": "a0", "status": "SUCCEEDED",
+             "shuffle_addr": "h:1", "output_bytes": 1234},
+            {"map_index": 1, "attempt_id": "a1", "status": "SUCCEEDED",
+             "shuffle_addr": "h:1"},          # pre-size-field event
+        ]
+        loc = MapLocator(lambda cursor: events[cursor:], secret=None)
+        loc.resolve(0)
+        assert loc.size_of(0) == 1234
+        assert loc.size_of(1) == 0            # unknown: advisory zero
+        assert loc.size_of(7) == 0            # never completed
+        loc.close()
+
+    def test_status_output_bytes_rides_the_wire(self):
+        from tpumr.mapred.ids import TaskAttemptID
+        st = TaskStatus(
+            attempt_id=TaskAttemptID.parse(
+                "attempt_fb_0001_m_000000_1"),
+            output_bytes=4096)
+        assert TaskStatus.from_dict(st.to_dict()).output_bytes == 4096
+
+
+# ------------------------------------------------- devcache observability
+
+
+class TestDevcacheInventory:
+    def test_inventory_and_occupancy_shapes(self):
+        from tpumr.mapred.tpu_runner import HbmSplitCache
+        from tpumr.ops import devcache
+        cache = HbmSplitCache(1 << 20)
+        cache.put(("kmeans-centroids:mem:///c", "dev0"), object(), 100)
+        cache.put(("kmeans-centroids:mem:///c", "dev1"), object(), 100)
+        cache.put(("matmul-b:mem:///b", "dev0"), object(), 5000)
+        old = devcache._cache
+        devcache._cache = cache
+        try:
+            inv = devcache.inventory()
+            assert inv == {"kmeans-centroids:mem:///c": 200,
+                           "matmul-b:mem:///b": 5000}
+            # the bound keeps the MOST RECENTLY USED tags
+            assert list(devcache.inventory(max_tags=1)) == \
+                ["matmul-b:mem:///b"]
+            occ = devcache.occupancy()
+            assert occ["entries"] == 3 and occ["bytes"] == 5200
+            assert occ["families"] == {"kmeans-centroids": 200,
+                                       "matmul-b": 5000}
+        finally:
+            devcache._cache = old
+
+    def test_empty_before_first_use(self):
+        from tpumr.ops import devcache
+        old = devcache._cache
+        devcache._cache = None
+        try:
+            assert devcache.inventory() == {}
+            assert devcache.occupancy() == {"entries": 0, "bytes": 0,
+                                            "families": {}}
+        finally:
+            devcache._cache = old
+
+
+# ------------------------------------------------------ straggler e2e
+
+
+def _write_input(fs, path, n=2000):
+    fs.write_bytes(path, b"".join(b"w%02d x\n" % (i % 23)
+                                  for i in range(n)))
+
+
+def _output_bytes(fs, out_dir):
+    return b"".join(fs.read_bytes(st.path)
+                    for st in sorted(fs.list_status(out_dir),
+                                     key=lambda s: str(s.path))
+                    if "part-" in str(st.path))
+
+
+class TestEndToEndTargetedSpeculation:
+    def test_slow_map_gets_exactly_one_targeted_twin(self):
+        """Acceptance: a ``task.slow``-injected straggler map is the
+        ONLY tip twinned; the twin wins well before the straggler's
+        crawl would end; output is byte-correct."""
+        fi.reset()
+        from tpumr.fs import FileSystem, get_filesystem
+        from tpumr.mapred.mini_cluster import MiniMRCluster
+        from tpumr.mapred.job_client import JobClient
+        base = JobConf()
+        base.set("tpumr.heartbeat.interval.ms", 100)
+        base.set("tpumr.fi.seed", FI_SEED)
+        try:
+            fs = get_filesystem("mem:///")
+            _write_input(fs, "/straggle/in.txt")
+            with MiniMRCluster(num_trackers=2, conf=base, cpu_slots=2,
+                               tpu_slots=0) as c:
+                conf = c.create_job_conf()
+                conf.set_input_paths("mem:///straggle/in.txt")
+                conf.set_output_path("mem:///straggle/out")
+                conf.set("mapred.mapper.class",
+                         "tpumr.mapred.lib.TokenCountMapper")
+                conf.set("mapred.reducer.class",
+                         "tpumr.examples.basic.LongSumReducer")
+                conf.set("mapred.map.tasks", 4)
+                conf.set_num_reduce_tasks(1)
+                # map 0 crawls for 8s unless a twin rescues the job
+                conf.set("tpumr.fi.task.slow.m0.probability", 1.0)
+                conf.set("tpumr.fi.task.slow.m0.max.failures", 1)
+                conf.set("tpumr.fi.task.slow.ms", 8000)
+                conf.set("mapred.speculative.min.runtime.s", 0.3)
+                t0 = time.monotonic()
+                result = JobClient(conf).run_job(conf)
+                wall = time.monotonic() - t0
+                assert result.successful
+                counts = dict(
+                    line.split(b"\t") for line in
+                    _output_bytes(fs, "/straggle/out").splitlines())
+                assert counts[b"x"] == b"2000"
+                assert fi.fired("task.slow.m0") == 1
+
+                jip = c.master.jobs[str(result.job_id)]
+                # EXACTLY the straggler was twinned, nothing else
+                assert jip.maps[0].next_attempt == 2
+                assert all(t.next_attempt == 1 for t in jip.maps[1:])
+                assert jip.speculative_launched == 1
+                assert jip.speculative_won == 1
+                assert jip.speculative_wasted == 0
+                assert jip.speculative_in_flight() == 0
+                sd = jip.status_dict()
+                assert sd["speculative_launched"] == 1
+                # the twin beat the 8s crawl by a wide margin
+                assert wall < 8.0, \
+                    f"speculation should rescue the job, took {wall:.1f}s"
+        finally:
+            fi.reset()
+            FileSystem.clear_cache()

@@ -79,7 +79,7 @@ class TestSpeculativeExecution:
         assert job.obtain_new_map_task("h", run_on_tpu=False) is None
         self._finish(job, t0, runtime=0.01)
         # t1 is now a straggler: backdate its start so elapsed >> mean
-        job.maps[t1.partition].report.start_time = time.time() - 100
+        job.maps[t1.partition].dispatch_mono = time.monotonic() - 100
         spec = job.obtain_new_map_task("h", run_on_tpu=False)
         assert spec is not None
         assert spec.partition == t1.partition
@@ -103,7 +103,7 @@ class TestSpeculativeExecution:
         assert job.obtain_new_reduce_task("h") is None
         self._finish(job, r0, runtime=0.01, is_map=False)
         # r1 is now a straggler: backdate its start so elapsed >> mean
-        job.reduces[r1.partition].report.start_time = time.time() - 100
+        job.reduces[r1.partition].dispatch_mono = time.monotonic() - 100
         spec = job.obtain_new_reduce_task("h")
         assert spec is not None
         assert spec.partition == r1.partition
@@ -120,7 +120,7 @@ class TestSpeculativeExecution:
         # no completed reduce yet -> no mean -> no speculation
         job = self._job(n_maps=0, **{"mapred.reduce.tasks": 1})
         r = job.obtain_new_reduce_task("h")
-        job.reduces[r.partition].report.start_time = time.time() - 100
+        job.reduces[r.partition].dispatch_mono = time.monotonic() - 100
         assert job.obtain_new_reduce_task("h") is None
         # mapred.reduce.speculative.execution=False turns ONLY reduces off
         off = self._job(n_maps=0, **{
@@ -129,20 +129,20 @@ class TestSpeculativeExecution:
         a = off.obtain_new_reduce_task("h")
         off.obtain_new_reduce_task("h")
         self._finish(off, a, runtime=0.01, is_map=False)
-        off.reduces[1].report.start_time = time.time() - 100
+        off.reduces[1].dispatch_mono = time.monotonic() - 100
         assert off.obtain_new_reduce_task("h") is None
 
     def test_no_speculation_without_completions_or_flag(self):
         job = self._job(n_maps=1)
         t = job.obtain_new_map_task("h", run_on_tpu=False)
-        job.maps[t.partition].report.start_time = time.time() - 100
+        job.maps[t.partition].dispatch_mono = time.monotonic() - 100
         assert job.obtain_new_map_task("h", run_on_tpu=False) is None
         off = self._job(n_maps=2,
                         **{"mapred.speculative.execution": False})
         a = off.obtain_new_map_task("h", run_on_tpu=False)
         off.obtain_new_map_task("h", run_on_tpu=False)
         self._finish(off, a, runtime=0.01)
-        off.maps[1].report.start_time = time.time() - 100
+        off.maps[1].dispatch_mono = time.monotonic() - 100
         assert off.obtain_new_map_task("h", run_on_tpu=False) is None
 
 

@@ -360,6 +360,12 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "DB output: table name."),
     _K('tpumr.dense.split.rows', 'int', 0,
         "Dense-tensor input format: rows per split (0 = one split)."),
+    _K('tpumr.devcache.heartbeat.tags', 'int', 32,
+        "Max device-cache tags a tracker piggybacks per heartbeat for "
+        "affinity placement (0 = don't advertise)."),
+    _K('tpumr.devcache.required.tags', 'str', '',
+        "Comma list of device-cache tags this job's tasks want warm "
+        "(empty = derived from the job's known side inputs)."),
     _K('tpumr.distcp.preserve', 'bool', False,
         "distcp: preserve file attributes."),
     _K('tpumr.distcp.update', 'bool', False,
@@ -377,6 +383,8 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
     _K('tpumr.fi.seed', 'str', None,
         "Fault-injection RNG seed (per-(seed,point) streams; chaos runs "
         "replay deterministically)."),
+    _K('tpumr.fi.task.slow.ms', 'int', 2000,
+        "Ms the task.slow fault seam crawls before the real work runs."),
     _K('tpumr.grep.group', 'int', 0,
         "Grep example: capture group."),
     _K('tpumr.grep.pattern', 'str', None,
@@ -504,6 +512,12 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Per-user signing key (hex) for personal-credential RPC."),
     _K('tpumr.rpc.user.key.file', 'str', None,
         "File holding the per-user signing key."),
+    _K('tpumr.scheduler.affinity', 'bool', True,
+        "Prefer TPU slots on trackers whose device cache already holds "
+        "the job's side-input tags."),
+    _K('tpumr.scheduler.affinity.defer.passes', 'int', 3,
+        "Heartbeats a job's TPU assignment may be deferred waiting for "
+        "a tag-warm tracker before placing cold (0 = never defer)."),
     _K('tpumr.scheduler.mode', 'str', 'shirahata',
         "'shirahata' slot split or 'minimize' (the f(x,y) makespan "
         "search)."),
@@ -560,6 +574,9 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "ms."),
     _K('tpumr.shuffle.ram.mb', 'float', 128.0,
         "In-memory shuffle budget per reduce, MiB."),
+    _K('tpumr.shuffle.size.priority', 'bool', True,
+        "Order pending shuffle fetches largest-advertised-output first "
+        "(completion events carry map output sizes)."),
     _K('tpumr.shuffle.timeout.ms', 'int', 600000,
         "Shuffle phase overall deadline, ms."),
     _K('tpumr.shuffle.wire.codec', 'str', 'tlz',
@@ -573,6 +590,17 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Sleep example: per-map sleep, ms."),
     _K('tpumr.sleep.reduce.ms', 'int', 100,
         "Sleep example: per-reduce sleep, ms."),
+    _K('tpumr.speculative.cap', 'int', 2,
+        "Max speculative attempts in flight per job (targeted mode)."),
+    _K('tpumr.speculative.critical.fraction', 'float', 0.75,
+        "A straggler is speculated only when its remaining time is "
+        "within this fraction of the job's longest remaining path."),
+    _K('tpumr.speculative.rate.ewma', 'float', 0.4,
+        "Smoothing factor for per-task progress-rate EWMAs (the "
+        "remaining-work estimator's input)."),
+    _K('tpumr.speculative.targeted', 'bool', True,
+        "LATE-style targeted speculation (estimated-finish stragglers "
+        "on the critical path, capped) instead of blanket twins."),
     _K('tpumr.task.attempt.id', 'str', '',
         "This attempt's id (framework-set, task-side)."),
     _K('tpumr.task.input.path', 'str', None,

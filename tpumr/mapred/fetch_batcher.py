@@ -75,7 +75,9 @@ def coalesce_shuffle_fetches(
             item = work.get_nowait()
         except queue.Empty:
             break
-        ready, m = item
+        # 2-tuple (ready, m) or the size-priority 3-tuple
+        # (ready, -bytes, m): readiness first, map index last
+        ready, m = item[0], item[-1]
         if ready_now(ready, m) and addr_of(m) == addr:
             members.append(m)
         else:

@@ -135,6 +135,16 @@ class TaskInProgress:
     tpu_failures: int = 0
     successful_attempt: str = ""
     report: TaskReport = None  # type: ignore[assignment]
+    # --- scheduling feedback (master-local, MONOTONIC domain — never
+    # --- mixed with the wall stamps the client-visible report carries) ---
+    #: monotonic stamp of the current incarnation's first dispatch; 0.0
+    #: until assigned (and again after a requeue re-pends the TIP)
+    dispatch_mono: float = 0.0
+    #: EWMA of progress units per second, folded from heartbeat statuses
+    rate_ewma: float = 0.0
+    #: best progress seen across the incarnation's attempts, and when
+    last_progress: float = 0.0
+    last_progress_mono: float = 0.0
 
     def __post_init__(self) -> None:
         if self.report is None:
@@ -144,6 +154,14 @@ class TaskInProgress:
         a = TaskAttemptID(self.task_id, self.next_attempt)
         self.next_attempt += 1
         return a
+
+    def reset_feedback(self) -> None:
+        """Requeue: the next dispatch starts a fresh incarnation whose
+        age and progress rate must not inherit the dead attempt's."""
+        self.dispatch_mono = 0.0
+        self.rate_ewma = 0.0
+        self.last_progress = 0.0
+        self.last_progress_mono = 0.0
 
     @property
     def is_map(self) -> bool:
@@ -229,6 +247,31 @@ class JobInProgress:
         self.history_logged: set[str] = set()
         self.speculative_map_tasks = 0
         self.speculative_reduce_tasks = 0
+        # --- scheduling feedback: targeted (LATE-style) speculation ---
+        #: False = legacy blanket twins (the reference's age-only rule)
+        self.speculative_targeted = confkeys.get_boolean(
+            self.conf, "tpumr.speculative.targeted")
+        #: concurrent speculative attempts allowed in flight per job
+        self.speculative_cap = max(1, confkeys.get_int(
+            self.conf, "tpumr.speculative.cap"))
+        #: critical-path membership: a TIP whose remaining estimate is
+        #: within this fraction of the job's longest remaining estimate
+        self._spec_cp_fraction = confkeys.get_float(
+            self.conf, "tpumr.speculative.critical.fraction")
+        #: per-TIP progress-rate EWMA weight
+        self._rate_alpha = confkeys.get_float(
+            self.conf, "tpumr.speculative.rate.ewma")
+        #: outcome counters: launched at obtain time; won/wasted settle
+        #: when the speculative attempt reaches a terminal state
+        self.speculative_launched = 0
+        self.speculative_won = 0
+        self.speculative_wasted = 0
+        #: speculative attempts not yet terminal (the in-flight gauge);
+        #: mutated only under ``lock``, len() read lock-free by gauges
+        self._spec_attempts: set[str] = set()
+        #: memoized devcache_tags() answer (side-input conf is
+        #: submit-fixed; the affinity scheduler asks per TPU pass)
+        self._devcache_tags: "tuple[str, ...] | None" = None
         #: running sum of successful reduce runtimes — the speculation
         #: threshold's mean (reduces have no per-backend split: they
         #: always run on CPU slots)
@@ -458,6 +501,144 @@ class JobInProgress:
             return 1.0
         return self.finished_reduces / len(self.reduces)
 
+    # ------------------------------------------- scheduling feedback model
+
+    def devcache_tags(self) -> "tuple[str, ...]":
+        """Side-input devcache tags this job's device tasks stage
+        (``tpumr.devcache.required.tags``, or derived from the kernels'
+        known side-input confs) — the affinity scheduler matches these
+        against tracker-piggybacked inventories. The derivation is
+        string-level coupling with ops/kmeans.device_centroids and
+        ops/matmul: the tag IS ``family:path``, so the conf that names
+        the side input names the tag."""
+        v = self._devcache_tags
+        if v is None:
+            explicit = str(confkeys.get(
+                self.conf, "tpumr.devcache.required.tags") or "")
+            tags = [t.strip() for t in explicit.split(",") if t.strip()]
+            if not tags:
+                c = self.conf.get("tpumr.kmeans.centroids")
+                if c:
+                    tags.append(f"kmeans-centroids:{c}")
+                b = self.conf.get("tpumr.matmul.b")
+                if b:
+                    tags.append(f"matmul-b:{b}")
+            v = self._devcache_tags = tuple(tags)
+        return v
+
+    def speculative_in_flight(self) -> int:
+        """Speculative attempts launched and not yet terminal — the
+        scheduler gauge's per-job term. Lock-free: len() of a set only
+        mutated under the job lock; one beat of staleness is fine."""
+        return len(self._spec_attempts)
+
+    def _fold_progress(self, tip: TaskInProgress,
+                       status: TaskStatus) -> None:
+        """Fold one RUNNING status into the TIP's progress-rate EWMA.
+        Master-local monotonic stamps only — the status' own wall
+        clocks never enter the math (cross-host skew). A beat with no
+        progress advance leaves the anchor alone, so the next advance
+        averages over the whole stall. Caller holds ``self.lock``."""
+        now = time.monotonic()
+        if tip.dispatch_mono == 0.0:
+            tip.dispatch_mono = now   # adopted/recovered attempt
+        p = min(1.0, max(0.0, status.progress))
+        if p <= tip.last_progress:
+            return
+        base = tip.last_progress_mono or tip.dispatch_mono
+        dt = now - base
+        if dt <= 0.0:
+            return
+        rate = (p - tip.last_progress) / dt
+        a = self._rate_alpha
+        tip.rate_ewma = rate if not tip.rate_ewma \
+            else a * rate + (1 - a) * tip.rate_ewma
+        tip.last_progress = p
+        tip.last_progress_mono = now
+
+    @staticmethod
+    def _tip_remaining_s(tip: TaskInProgress, now: float,
+                         mean_hint: float) -> float:
+        """Estimated seconds until a RUNNING tip finishes: rate EWMA
+        when it reports progress; elapsed-proportional fallback before
+        the first EWMA fold; a full mean runtime when it has shown no
+        progress at all — a silent tip must look LONG, never
+        nearly-done (stalls are exactly what speculation targets)."""
+        p = tip.last_progress
+        if tip.rate_ewma > 0.0:
+            return max(0.0, (1.0 - p) / tip.rate_ewma)
+        elapsed = now - (tip.dispatch_mono or now)
+        if p > 0.0 and elapsed > 0.0:
+            return elapsed * (1.0 - p) / p
+        return max(0.0, mean_hint)
+
+    def _remaining_locked(self, tips: "list[TaskInProgress]", now: float,
+                          mean_hint: float) -> "dict[int, float]":
+        return {t.partition: self._tip_remaining_s(t, now, mean_hint)
+                for t in tips if t.state == "running"}
+
+    def _map_mean_locked(self) -> float:
+        done = self.finished_cpu_maps + self.finished_tpu_maps
+        return ((self._cpu_time_sum + self._tpu_time_sum) / done) \
+            if done else 0.0
+
+    def map_remaining_estimates(self) -> "dict[int, float]":
+        """partition → estimated seconds remaining, for RUNNING maps."""
+        with self.lock:
+            return self._remaining_locked(self.maps, time.monotonic(),
+                                          self._map_mean_locked())
+
+    def critical_path_maps(self) -> "set[int]":
+        """Running map partitions on the estimated critical path: those
+        whose remaining estimate is within
+        ``tpumr.speculative.critical.fraction`` of the longest."""
+        est = self.map_remaining_estimates()
+        if not est:
+            return set()
+        mx = max(est.values())
+        if mx <= 0.0:
+            return set(est)
+        return {p for p, r in est.items()
+                if r >= self._spec_cp_fraction * mx}
+
+    def longest_remaining_path_s(self) -> float:
+        """Live longest-remaining-path estimate: the slowest running
+        map's remaining (pending maps contribute at least one mean
+        runtime — they haven't even started) plus the same term for the
+        reduce phase. An estimate of the floor on job completion, not a
+        promise; the targeted speculation pass and the /job page read
+        it."""
+        with self.lock:
+            now = time.monotonic()
+            m_mean = self._map_mean_locked()
+            m_est = self._remaining_locked(self.maps, now, m_mean)
+            path = max(m_est.values(), default=0.0)
+            if self._pending_maps:
+                path = max(path, m_mean)
+            r_mean = self._reduce_time_sum / self.finished_reduces \
+                if self.finished_reduces else 0.0
+            r_est = self._remaining_locked(self.reduces, now, r_mean)
+            rpath = max(r_est.values(), default=0.0)
+            if self._pending_reduces:
+                rpath = max(rpath, r_mean)
+            return path + rpath
+
+    def _note_spec_launch(self, attempt: TaskAttemptID) -> None:
+        """Account one speculative twin launch (caller holds the lock)."""
+        self.speculative_launched += 1
+        self._spec_attempts.add(str(attempt))
+
+    def _settle_speculative(self, aid: str, won: bool) -> None:
+        """A speculative attempt reached a terminal state: move it from
+        in-flight to won/wasted. No-op for non-speculative attempts.
+        Caller holds ``self.lock``."""
+        if aid in self._spec_attempts:
+            self._spec_attempts.discard(aid)
+            if won:
+                self.speculative_won += 1
+            else:
+                self.speculative_wasted += 1
+
     # ------------------------------------------------------------ obtain
 
     def obtain_new_map_task(self, host: str, run_on_tpu: bool,
@@ -497,6 +678,7 @@ class JobInProgress:
             self._pending_maps.discard(idx)
             tip = self.maps[idx]
             tip.state = "running"
+            tip.dispatch_mono = tip.dispatch_mono or time.monotonic()
             self._record_placement(run_on_tpu)
             attempt = tip.new_attempt()
             tip.report.state = TaskState.RUNNING
@@ -513,9 +695,17 @@ class JobInProgress:
                                 tpu_device_id: int) -> Task | None:
         """Straggler mitigation ≈ JobInProgress.hasSpeculativeMap /
         speculativeMapTasks (JobInProgress.java:2777): when all maps are
-        assigned but some run much longer than the completed mean, issue a
-        duplicate attempt; first completion wins (the loser is killed by
-        the master). Caller holds self.lock."""
+        assigned but some lag, issue a duplicate attempt; first
+        completion wins (the loser is killed by the master).
+
+        Two modes. Blanket (``tpumr.speculative.targeted=false``): the
+        reference's age-only rule — any running TIP older than
+        max(floor, factor·mean) twins. Targeted (default), LATE-style:
+        a TIP is speculated only when its ESTIMATED FINISH (elapsed +
+        estimated remaining, from the per-TIP progress-rate EWMA) lags
+        the job's completed-runtime distribution AND it sits on the
+        estimated critical path, under a concurrent-speculation cap.
+        Caller holds self.lock."""
         if not self.speculative:
             return None
         if run_on_tpu and self.tpu_disabled:
@@ -535,7 +725,15 @@ class JobInProgress:
         # jobs speculate everything instantly
         floor = confkeys.get_float(
             self.conf, "mapred.speculative.min.runtime.s")
-        now = time.time()
+        targeted = self.speculative_targeted
+        if targeted and len(self._spec_attempts) >= self.speculative_cap:
+            return None  # concurrent-speculation cap
+        now = time.monotonic()
+        est: "dict[int, float]" = {}
+        max_rem = 0.0
+        if targeted:
+            est = self._remaining_locked(self.maps, now, mean)
+            max_rem = max(est.values(), default=0.0)
         for tip in self.maps:
             if tip.state != "running":
                 continue
@@ -543,13 +741,23 @@ class JobInProgress:
                 continue  # already speculated (or restarted) — one dup max
             if run_on_tpu and tip.partition in self._cpu_only_maps:
                 continue  # a demoted TIP's twin must not land on TPU
-            # report.start_time is a cross-host wall stamp (client-
-            # visible report field); skew only biases the heuristic
-            elapsed = now - (tip.report.start_time or now)  # tpulint: disable=clock-arith
-            if elapsed <= max(floor, factor * mean):
+            # master-local monotonic age: the dispatch stamp lives in the
+            # same clock domain as ``now``, so no wall arithmetic here
+            elapsed = now - (tip.dispatch_mono or now)
+            if targeted:
+                if elapsed <= floor:
+                    continue
+                remaining = est.get(tip.partition, 0.0)
+                if elapsed + remaining <= factor * mean:
+                    continue  # estimated finish within the distribution
+                if max_rem > 0.0 \
+                        and remaining < self._spec_cp_fraction * max_rem:
+                    continue  # lagging, but not on the critical path
+            elif elapsed <= max(floor, factor * mean):
                 continue
             attempt = tip.new_attempt()
             self.speculative_map_tasks += 1
+            self._note_spec_launch(attempt)
             self._record_placement(run_on_tpu)
             tip.report.run_on_tpu = run_on_tpu
             tip.report.tpu_device_id = tpu_device_id
@@ -662,6 +870,7 @@ class JobInProgress:
             self._pending_reduces.discard(idx)
             tip = self.reduces[idx]
             tip.state = "running"
+            tip.dispatch_mono = tip.dispatch_mono or time.monotonic()
             attempt = tip.new_attempt()
             tip.report.state = TaskState.RUNNING
             tip.report.start_time = tip.report.start_time or time.time()
@@ -677,7 +886,8 @@ class JobInProgress:
         duplicate attempt; first completion wins (the loser is killed by
         the master via should_kill_attempt, and the output committer's
         promote-on-commit makes the race safe). Same progress-gap rule
-        as maps. Caller holds ``self.lock``."""
+        as maps (and the same targeted/blanket split as the map pass).
+        Caller holds ``self.lock``."""
         if not self.speculative_reduces or self.finished_reduces == 0:
             return None
         mean = self._reduce_time_sum / self.finished_reduces
@@ -685,18 +895,36 @@ class JobInProgress:
             self.conf, "mapred.speculative.lag.factor")
         floor = confkeys.get_float(
             self.conf, "mapred.speculative.min.runtime.s")
-        now = time.time()
+        targeted = self.speculative_targeted
+        if targeted and len(self._spec_attempts) >= self.speculative_cap:
+            return None  # concurrent-speculation cap (shared with maps)
+        now = time.monotonic()
+        est: "dict[int, float]" = {}
+        max_rem = 0.0
+        if targeted:
+            est = self._remaining_locked(self.reduces, now, mean)
+            max_rem = max(est.values(), default=0.0)
         for tip in self.reduces:
             if tip.state != "running":
                 continue
             if tip.next_attempt != 1:
                 continue  # already speculated (or restarted) — one dup max
-            # cross-host wall stamp, as in the map pass above
-            elapsed = now - (tip.report.start_time or now)  # tpulint: disable=clock-arith
-            if elapsed <= max(floor, factor * mean):
+            # master-local monotonic age, as in the map pass above
+            elapsed = now - (tip.dispatch_mono or now)
+            if targeted:
+                if elapsed <= floor:
+                    continue
+                remaining = est.get(tip.partition, 0.0)
+                if elapsed + remaining <= factor * mean:
+                    continue
+                if max_rem > 0.0 \
+                        and remaining < self._spec_cp_fraction * max_rem:
+                    continue
+            elif elapsed <= max(floor, factor * mean):
                 continue
             attempt = tip.new_attempt()
             self.speculative_reduce_tasks += 1
+            self._note_spec_launch(attempt)
             return Task(attempt, partition=tip.partition,
                         num_reduces=self.num_reduces,
                         num_maps=len(self.maps),
@@ -742,6 +970,12 @@ class JobInProgress:
             tip.attempts[str(status.attempt_id)] = status
             tip.report.progress = max(tip.report.progress, status.progress)
             if status.state == TaskState.RUNNING \
+                    and tip.state == "running":
+                # the feedback model's input: per-TIP progress-rate EWMA
+                # folded here, under the job lock only (off the
+                # heartbeat fast path per the PR-8 lock ranks)
+                self._fold_progress(tip, status)
+            if status.state == TaskState.RUNNING \
                     and tip.state == "succeeded" \
                     and tip.successful_attempt != aid_s:
                 # a speculative loser reporting progress after its twin
@@ -759,10 +993,15 @@ class JobInProgress:
 
     def _on_success(self, tip: TaskInProgress, status: TaskStatus,
                     shuffle_addr: str) -> None:
+        aid = str(status.attempt_id)
         if tip.state == "succeeded":
-            return  # a speculative duplicate — first completion wins
+            # a speculative duplicate — first completion wins (and this
+            # late finisher's work is by definition wasted)
+            self._settle_speculative(aid, won=False)
+            return
         tip.state = "succeeded"
-        tip.successful_attempt = str(status.attempt_id)
+        tip.successful_attempt = aid
+        self._settle_speculative(aid, won=True)
         # the losing speculative twins (any other attempt still RUNNING)
         # get their kill marks NOW — the heartbeat kill scan reads the
         # mark set lock-free instead of re-deriving the race per beat
@@ -816,6 +1055,10 @@ class JobInProgress:
                 "attempt_id": str(status.attempt_id),
                 "shuffle_addr": shuffle_addr,
                 "status": "SUCCEEDED",
+                # tracker-stamped map-output size: reducers order their
+                # fetch queues largest-first on it (size-aware shuffle)
+                "output_bytes": int(getattr(status, "output_bytes", 0)
+                                    or 0),
             })
         else:
             self.finished_reduces += 1
@@ -852,6 +1095,9 @@ class JobInProgress:
             self.reduce_runtimes.append(float(runtime))
 
     def _on_failure(self, tip: TaskInProgress, status: TaskStatus) -> None:
+        # a FAILED/KILLED speculative twin settles as wasted whether or
+        # not its TIP already succeeded through the other attempt
+        self._settle_speculative(str(status.attempt_id), won=False)
         if tip.state == "succeeded":
             return
         if status.state == TaskState.FAILED:
@@ -880,6 +1126,7 @@ class JobInProgress:
             return
         # re-queue (≈ lost/failed task re-execution)
         tip.state = "pending"
+        tip.reset_feedback()
         if tip.is_map:
             self._pending_maps.add(tip.partition)
         else:
@@ -1025,6 +1272,7 @@ class JobInProgress:
             tip.failures += 1
             tip.state = "pending"
             tip.successful_attempt = ""
+            tip.reset_feedback()
             self._unwind_finished_map(tip, st)
             self._pending_maps.add(tip.partition)
             if tip.failures >= self.max_map_attempts:
@@ -1080,6 +1328,7 @@ class JobInProgress:
                       and self.state == JobState.RUNNING):
                     tip.state = "pending"
                     tip.successful_attempt = ""
+                    tip.reset_feedback()
                     # unwind the backend profile so the re-run isn't
                     # double-counted in the hybrid scheduler's means
                     self._unwind_finished_map(tip, st)
@@ -1247,6 +1496,9 @@ class JobInProgress:
             tip.attempts[aid] = status
             tip.next_attempt = max(tip.next_attempt,
                                    status.attempt_id.attempt + 1)
+            # age anchor for the feedback model: adoption time is the
+            # best master-local stand-in for the unknown dispatch time
+            tip.dispatch_mono = tip.dispatch_mono or time.monotonic()
             if tip.state == "pending":
                 tip.state = "running"
                 if tip.is_map:
@@ -1317,6 +1569,15 @@ class JobInProgress:
                 "cpu_map_mean_time": self.cpu_map_mean_time(),
                 "tpu_map_mean_time": self.tpu_map_mean_time(),
                 "acceleration_factor": self.acceleration_factor(),
+                # scheduling feedback: the live remaining-work model and
+                # the targeted-speculation ledger (the "/job page's one
+                # map is dragging this job" answer)
+                "longest_remaining_path_s": round(
+                    self.longest_remaining_path_s(), 3),
+                "speculative_launched": self.speculative_launched,
+                "speculative_won": self.speculative_won,
+                "speculative_wasted": self.speculative_wasted,
+                "speculative_in_flight": len(self._spec_attempts),
                 # placement TAIL only: status_dict rides every polled
                 # get_job_status RPC (clients poll at 5 Hz), so it must
                 # stay small on 50k-map jobs; the full timeline ships

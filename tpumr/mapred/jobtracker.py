@@ -235,6 +235,11 @@ class JobMaster:
         #: approximate count of blacklisted trackers (num_trackers'
         #: lock-free divisor; the exact set still comes from scans)
         self._blacklisted = 0
+        #: TTL cache for devcache_tag_index (monotonic stamp, index) —
+        #: the affinity pass asks once per heartbeat, and rescanning the
+        #: striped registry for every beat of every tracker would make
+        #: the warm-placement hint a fleet-rate O(trackers) tax
+        self._devcache_index_cache: "tuple[float, dict]" = (-1.0, {})
         # start-time-in-ms identifier ≈ JobTracker's trackerIdentifier —
         # must differ across restarts or recovered job ids collide with
         # the original's history file
@@ -461,6 +466,13 @@ class JobMaster:
                                                COUNTS)
         self._server.metrics = self.metrics.new_registry("rpc")
         self.scheduler.metrics = self.metrics.new_registry("scheduler")
+        # speculative attempts in flight, summed over running jobs —
+        # each term is a lock-free set len, so the gauge never queues
+        # on a job lock from the metrics scrape path
+        self.scheduler.metrics.set_gauge(
+            "speculative_in_flight",
+            lambda: sum(j.speculative_in_flight()
+                        for j in self.running_jobs()))
         # heartbeat-aggregated cluster view: trackers piggyback their
         # metrics on heartbeats; one scrape of THIS daemon yields
         # cluster-wide distributions (metrics/cluster.py)
@@ -1100,6 +1112,29 @@ class JobMaster:
             out["tpu"] += t.status.get("max_tpu_map_slots", 0)
             out["reduce"] += t.status.get("max_reduce_slots", 0)
         return out
+
+    def devcache_tag_index(self) -> "dict[str, set[str]]":
+        """Devcache tag → names of live trackers holding it warm, from
+        the trackers' piggybacked ``devcache_tags`` inventories (their
+        last folded heartbeat statuses). The scheduler's affinity pass
+        reads this once per heartbeat; a short monotonic TTL keeps the
+        striped-registry walk off the fleet-rate fast path — staleness
+        of a fraction of a beat only costs one cold placement, never
+        correctness (placement is a hint, execution works anywhere)."""
+        now = time.monotonic()
+        stamp, cached = self._devcache_index_cache
+        if now - stamp < 0.5:
+            return cached
+        index: "dict[str, set[str]]" = {}
+        for t in self.trackers.values():
+            tags = t.status.get("devcache_tags")
+            if not tags:
+                continue
+            name = t.name
+            for tag in tags:
+                index.setdefault(str(tag), set()).add(name)
+        self._devcache_index_cache = (now, index)
+        return index
 
     _SLOT_KEYS = {"cpu": ("count_cpu_map_tasks", "max_cpu_map_slots"),
                   "tpu": ("count_tpu_map_tasks", "max_tpu_map_slots"),

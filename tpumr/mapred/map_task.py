@@ -234,6 +234,9 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     if fires("task.hang", conf) or fires(f"task.hang.m{task.partition}",
                                          conf):
         _hang_silently(reporter)
+    if fires("task.slow", conf) or fires(f"task.slow.m{task.partition}",
+                                         conf):
+        _run_slowly(conf, reporter)
     split = InputSplit.from_dict(task.split) if task.split else None
     if split is not None and getattr(split, "path", None):
         # the split's source path, for mappers that dispatch per input
@@ -314,6 +317,28 @@ def run_map_task(conf: Any, task: Task, local_dir: str,
     reporter.incr_counter(BackendCounter.GROUP, backend_ms,
                           int((time.monotonic() - t0) * 1000))
     return out
+
+
+def _run_slowly(conf: Any, reporter: Reporter) -> None:
+    """The ``task.slow`` chaos behavior: a straggler, not a hang — the
+    attempt stays ALIVE and keeps reporting slowly-advancing progress
+    for ``tpumr.fi.task.slow.ms`` before the real work runs. This is
+    the seam the targeted-speculation tests and the straggler bench
+    phase inject: progress ticks feed the master's per-TIP rate model
+    (so the estimated finish lags honestly), while the kill-flag poll
+    lets a speculative twin's win cancel the slow original promptly."""
+    from tpumr.core import confkeys as _ck
+    total_s = max(0.0, _ck.get_int(conf, "tpumr.fi.task.slow.ms") / 1000.0)
+    t0 = time.monotonic()
+    while True:
+        elapsed = time.monotonic() - t0
+        if elapsed >= total_s:
+            return
+        # crawl toward (but never reach) half done: honest "running but
+        # way behind" telemetry for the remaining-work estimator
+        reporter.progress(min(0.45, 0.45 * elapsed / total_s))
+        reporter.raise_if_aborted()
+        time.sleep(min(0.05, total_s - elapsed))
 
 
 def _hang_silently(reporter: Reporter) -> None:

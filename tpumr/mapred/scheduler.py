@@ -28,6 +28,7 @@ from __future__ import annotations
 import math
 from typing import Any, Protocol
 
+from tpumr.core import confkeys
 from tpumr.mapred.job_in_progress import (JobInProgress, JobState,
                                           priority_rank)
 from tpumr.mapred.task import Task
@@ -44,6 +45,10 @@ class TaskTrackerManager(Protocol):
     # priority) changes — lets the FIFO order cache skip its re-sort.
     # Fakes without it just lose the caching (getattr-guarded).
     # def jobs_version(self) -> int: ...
+    # optional: tag -> live tracker names whose piggybacked devcache
+    # inventory holds the tag (the affinity pass's cross-tracker view).
+    # Fakes without it just lose deferral (getattr-guarded).
+    # def devcache_tag_index(self) -> dict[str, set[str]]: ...
 
 
 class TaskScheduler:
@@ -122,6 +127,20 @@ class HybridQueueScheduler(TaskScheduler):
     _fifo_key: "tuple | None" = None
     _fifo_cache: "list[JobInProgress]" = []
 
+    def __init__(self) -> None:
+        super().__init__()
+        # --- devcache-affinity placement state ---
+        #: job id → TPU passes its maps were held back waiting for a
+        #: tag-warm tracker's heartbeat; reset on a warm hit, pinned at
+        #: the budget once spent (the job then places cold anywhere)
+        self._affinity_defers: "dict[str, int]" = {}
+        #: (enabled, defer budget) — conf is master-fixed; parsed once
+        self._affinity_conf: "tuple[bool, int] | None" = None
+        # per-heartbeat state (the passes run per free slot)
+        self._beat_local_tags: "frozenset[str]" = frozenset()
+        self._beat_tag_index: "dict[str, Any] | None" = None
+        self._beat_affinity: "dict[str, bool]" = {}
+
     def _priority_fifo_cached(self,
                               jobs: list[JobInProgress]) -> list[JobInProgress]:
         ver_fn = getattr(self.manager, "jobs_version", None)
@@ -143,6 +162,79 @@ class HybridQueueScheduler(TaskScheduler):
     def _begin_assignment(self, tts: dict) -> None:
         """Called once per heartbeat before the passes — subclasses cache
         heartbeat-invariant state here (the order hooks run per free slot)."""
+
+    # ------------------------------------------ devcache-affinity placement
+
+    def _begin_affinity(self, tts: dict) -> None:
+        """Per-heartbeat affinity context: the asking tracker's
+        piggybacked devcache tag inventory, the master's cross-tracker
+        tag index (getattr-guarded — fakes without it lose deferral,
+        not correctness), and a fresh per-job decision memo so the
+        per-slot inner loops charge each job's defer budget at most
+        once per heartbeat. Lives in ``_assign_tasks`` rather than
+        ``_begin_assignment`` because contrib subclasses override the
+        latter without chaining up."""
+        if self._affinity_conf is None:
+            if self.conf is None:
+                self._affinity_conf = (True, 3)
+            else:
+                self._affinity_conf = (
+                    confkeys.get_boolean(self.conf,
+                                         "tpumr.scheduler.affinity"),
+                    max(0, confkeys.get_int(
+                        self.conf,
+                        "tpumr.scheduler.affinity.defer.passes")))
+        self._beat_affinity = {}
+        self._beat_local_tags = frozenset(tts.get("devcache_tags") or ())
+        self._beat_tag_index = None
+        if self._affinity_conf[0]:
+            index_fn = getattr(self.manager, "devcache_tag_index", None)
+            if index_fn is not None:
+                self._beat_tag_index = index_fn()
+
+    def _affinity_defer(self, job: JobInProgress) -> bool:
+        """Should the TPU pass hold this job's maps back from the asking
+        tracker this heartbeat? True only when the job names side-input
+        tags, this tracker's devcache is cold on all of them, some OTHER
+        live tracker is warm, and the job still has defer budget — a
+        bounded wait for the warm tracker's next heartbeat, never
+        starvation (the budget pins once spent and the job places cold).
+        FIFO/priority order is never reordered, only deferred."""
+        jid = str(job.job_id)
+        memo = self._beat_affinity
+        if jid in memo:
+            return memo[jid]
+        memo[jid] = d = self._affinity_defer_uncached(job, jid)
+        return d
+
+    def _affinity_defer_uncached(self, job: JobInProgress,
+                                 jid: str) -> bool:
+        enabled, budget = self._affinity_conf or (True, 3)
+        if not enabled:
+            return False
+        tags_fn = getattr(job, "devcache_tags", None)
+        tags = tags_fn() if tags_fn is not None else ()
+        if not tags:
+            return False
+        reg = self.metrics
+        if any(t in self._beat_local_tags for t in tags):
+            # warm here: assign here (and forgive any defer history)
+            self._affinity_defers.pop(jid, None)
+            if reg is not None:
+                reg.incr("affinity_warm_hits")
+            return False
+        index = self._beat_tag_index
+        if not index or not any(index.get(t) for t in tags):
+            return False   # nobody warm anywhere — no reason to wait
+        spent = self._affinity_defers.get(jid, 0)
+        if spent >= budget:
+            if reg is not None:
+                reg.incr("affinity_cold_assigns")
+            return False   # budget pinned: place cold rather than starve
+        self._affinity_defers[jid] = spent + 1
+        if reg is not None:
+            reg.incr("affinity_defers")
+        return True
 
     def assign_tasks(self, tts: dict) -> list[Task]:
         reg = self.metrics
@@ -166,6 +258,7 @@ class HybridQueueScheduler(TaskScheduler):
         if not jobs:
             return []
         self._begin_assignment(tts)
+        self._begin_affinity(tts)
         n_trackers = max(1, self.manager.num_trackers())
         host = tts.get("host", "")
 
@@ -256,6 +349,11 @@ class HybridQueueScheduler(TaskScheduler):
                     # just skips the lock round trip for drained jobs
                     continue
                 if not fits(job.map_memory_mb()):
+                    continue
+                if self._affinity_defer(job):
+                    # this tracker's devcache is cold on the job's side
+                    # inputs and a warm tracker is live — hold the maps
+                    # for its heartbeat (bounded by the defer budget)
                     continue
                 device = free_devices[0]
                 task = job.obtain_new_map_task(host, run_on_tpu=True,

@@ -682,6 +682,14 @@ class ShuffleCopier:
         #: background merge to free reservations before spilling to disk
         self.reserve_wait_s = confkeys.get_float(
             conf, "tpumr.shuffle.merge.reserve.wait.ms") / 1000.0
+        #: size-aware fetch ordering: completion events advertise each
+        #: map's output bytes (TaskStatus.output_bytes); among equally-
+        #: ready pending fetches the LARGEST advertised output pops
+        #: first, so the long-pole transfer overlaps the most remaining
+        #: copy work instead of landing last. Advisory — an unknown
+        #: size (0) just sorts behind known ones, never blocks a fetch.
+        self.size_priority = confkeys.get_boolean(
+            conf, "tpumr.shuffle.size.priority")
 
     # ------------------------------------------------------------ one map
 
@@ -844,7 +852,7 @@ class ShuffleCopier:
 
     # ------------------------------------------------- batched fetching
 
-    def _coalesce(self, work: "queue.Queue[tuple[float, int]]",
+    def _coalesce(self, work: "queue.Queue[tuple]",
                   first_map: int) -> "list[int]":
         """Group queued maps served by ``first_map``'s source address
         into one batched round (the wire-level half of
@@ -1045,12 +1053,23 @@ class ShuffleCopier:
         os.makedirs(self.spill_dir, exist_ok=True)
         results: "list[Segment | None]" = [None] * self.num_maps
         errors: "list[Exception]" = []
-        # (ready_at, map_index): failed maps re-enter with a hold-off
-        # instead of failing the reduce — the queue is drained only when
-        # every map has actually been copied
-        work: "queue.Queue[tuple[float, int]]" = queue.Queue()
+        # (ready_at, -advertised_bytes, map_index): failed maps re-enter
+        # with a hold-off instead of failing the reduce — the queue is
+        # drained only when every map has actually been copied. A
+        # PriorityQueue so that among equally-ready entries the largest
+        # advertised map output pops first (size-aware shuffle); with
+        # size priority off the middle element is constant-0 and the
+        # orders degenerate to the legacy readiness-stamp FIFO.
+        work: "queue.PriorityQueue[tuple[float, int, int]]" = \
+            queue.PriorityQueue()
+
+        def push(ready: float, m: int) -> None:
+            size = (self._source_hook("size_of", m, 0) or 0
+                    if self.size_priority else 0)
+            work.put((ready, -int(size), m))
+
         for m in range(self.num_maps):
-            work.put((0.0, m))
+            push(0.0, m)
         outstanding = [self.num_maps]
         lock = threading.Lock()
 
@@ -1100,7 +1119,7 @@ class ShuffleCopier:
                 return False
             # ready now; the pop-side penalty check supplies the
             # (possibly already-cleared) hold-off
-            work.put((time.monotonic(), m))
+            push(time.monotonic(), m)
             return True
 
         def worker_body() -> None:
@@ -1111,7 +1130,8 @@ class ShuffleCopier:
                 if self.reporter is not None and self.reporter.aborted():
                     return
                 try:
-                    ready, m = work.get(timeout=0.05)
+                    item = work.get(timeout=0.05)
+                    ready, m = item[0], item[-1]
                 except queue.Empty:
                     continue   # others may still re-queue penalized maps
                 # the penalty hold is consulted FRESH on every pop (never
@@ -1124,10 +1144,14 @@ class ShuffleCopier:
                     # not yet — rotate it to the back and nap briefly so
                     # an all-penalized queue doesn't busy-spin. Waiting
                     # out a penalty is liveness, not a hang: tick the
-                    # reaper's keepalive.
+                    # reaper's keepalive. Re-stamped with NOW so the
+                    # priority order can't keep popping one big
+                    # penalized map ahead of smaller ready ones (the
+                    # penalty itself is still consulted fresh per pop,
+                    # never baked into the stamp).
                     if self.reporter is not None:
                         self.reporter.keepalive()
-                    work.put((ready, m))
+                    push(now, m)
                     time.sleep(min(hold - now, 0.05))
                     continue
                 members = self._coalesce(work, m)
@@ -1143,7 +1167,7 @@ class ShuffleCopier:
                         else:
                             # omitted under the server's byte budget —
                             # not a failure, just didn't fit this frame
-                            work.put((0.0, mm))
+                            push(0.0, mm)
                     continue
                 try:
                     # with a fetch-failure callback the penalty box IS
@@ -1336,6 +1360,12 @@ class RemoteChunkSource:
     def attempt_of(self, map_index: int) -> str:
         fn = getattr(self.locate, "attempt_of", None)
         return fn(map_index) if fn is not None else ""
+
+    def size_of(self, map_index: int) -> int:
+        """Advertised output bytes from the cached completion event
+        (0 = unknown) — the copier's largest-first ordering key."""
+        fn = getattr(self.locate, "size_of", None)
+        return int(fn(map_index) or 0) if fn is not None else 0
 
     def invalidate(self, map_index: int) -> None:
         """Drop the cached location so the next fetch re-resolves from

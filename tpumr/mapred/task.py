@@ -196,6 +196,10 @@ class TaskStatus:
     #: why a FAILED attempt failed (FailureClass.*; "" = unclassified) —
     #: the demotion/quarantine/reaping signal, heartbeat-carried
     failure_class: str = ""
+    #: total map-output bytes (sum of partition part lengths), stamped at
+    #: the success settle sites — rides completion events so reduces can
+    #: order their fetch queues largest-first (size-aware shuffle)
+    output_bytes: int = 0
 
     @property
     def runtime(self) -> float:

@@ -165,6 +165,14 @@ class MapLocator:
             e = self._entry(map_index)
             return e["shuffle_addr"] if e is not None else ""
 
+    def size_of(self, map_index: int) -> int:
+        """Total map-output bytes the cached completion event advertised
+        (0 when unknown) — the ShuffleCopier's largest-first fetch
+        ordering key. Advisory only: a 0 never blocks a fetch."""
+        with self._cache_lock:
+            e = self._entry(map_index)
+            return int(e.get("output_bytes", 0) or 0) if e is not None else 0
+
     def invalidate(self, map_index: int) -> None:
         """Demote the cached location to a fallback: the next locate()
         round polls for a fresh event first, but while the master keeps
@@ -691,6 +699,18 @@ class NodeRunner:
         self._merge_totals: dict[str, int] = {}
         self._mreg.set_gauge("shuffle_merge",
                              lambda: dict(self._merge_totals))
+        # device-cache occupancy (ops/devcache.py): how much HBM the
+        # side-input cache holds here and for which tag families — the
+        # observability twin of the devcache_tags heartbeat inventory
+        # the master's affinity placement consumes
+        from tpumr.ops.devcache import occupancy as _devcache_occupancy
+        self._mreg.set_gauge("devcache_entries",
+                             lambda: _devcache_occupancy()["entries"])
+        self._mreg.set_gauge("devcache_bytes",
+                             lambda: _devcache_occupancy()["bytes"])
+        self._mreg.set_gauge(
+            "devcache_family_bytes",
+            lambda: dict(_devcache_occupancy()["families"]))
         from tpumr.metrics import sinks_from_conf
         for sink in sinks_from_conf(conf):
             self.metrics.add_sink(sink)
@@ -971,6 +991,19 @@ class NodeRunner:
         return {"fetches": b.fetches, "roundtrips": b.roundtrips,
                 "coalesced": b.batched}
 
+    def _devcache_tags(self) -> "list[str]":
+        """Bounded, SORTED list of device-cache tags resident here —
+        the heartbeat inventory behind the master's affinity placement.
+        Sorted so an unchanged inventory is byte-identical across beats
+        and the heartbeat delta encoder elides it; bounded
+        (tpumr.devcache.heartbeat.tags, 0 disables) so a tag-heavy
+        workload can't bloat every beat."""
+        limit = confkeys.get_int(self.conf, "tpumr.devcache.heartbeat.tags")
+        if limit <= 0:
+            return []
+        from tpumr.ops.devcache import inventory
+        return sorted(inventory(max_tags=limit))
+
     def _status_dict(self) -> dict:
         with self.lock:
             cpu, tpu, red = self._counts()
@@ -1008,6 +1041,11 @@ class NodeRunner:
                 "count_reduce_tasks": red,
                 "available_tpu_devices": self._available_tpu_devices(),
                 "device_fetch": self._fetch_batcher_stats(),
+                # bounded devcache inventory (tag names only — byte
+                # counts stay in the local gauges): the master's
+                # affinity placement signal. A baseline heartbeat key,
+                # so steady-state beats delta-encode it away for free.
+                "devcache_tags": self._devcache_tags(),
                 "task_statuses": statuses,
                 "rack": self.rack,
                 "healthy": (self.health.healthy
@@ -1522,11 +1560,18 @@ class NodeRunner:
             with self.lock:
                 return aid in self._kill_requested
 
+        def on_progress(f: float) -> None:
+            # in-process fraction reports land directly on the heartbeat
+            # status (isolated children ship theirs over the umbilical) —
+            # the master's per-TIP rate model is fed either way. Monotone
+            # max: a late report must never roll back the settle's 1.0.
+            status.progress = max(status.progress, min(1.0, float(f)))
+
         # cooperative cancellation: record loops poll this so a preemption
         # or speculative-race kill frees the slot mid-task, not at natural
         # completion (hard process kills arrive with the subprocess
         # executor; threads cannot be interrupted)
-        reporter = Reporter(abort_check=killed)
+        reporter = Reporter(abort_check=killed, on_progress=on_progress)
         with self.lock:
             # the reaper samples this live reporter's counters/status for
             # progress liveness — zero hot-path cost (hoisted Counter
@@ -1645,6 +1690,11 @@ class NodeRunner:
                         idx = dict(out[1])
                         idx["attempt"] = aid
                         idx["attempt_no"] = task.attempt_id.attempt
+                        # total output size rides the success status into
+                        # the completion event — the reduces' fetch-
+                        # ordering key (size-aware shuffle)
+                        status.output_bytes = sum(
+                            int(p[2]) for p in idx.get("partitions", ()))
                         # a job recovered under a new id registers its
                         # stragglers' outputs under the NEW key
                         self.map_outputs[
@@ -2067,6 +2117,11 @@ class NodeRunner:
                 st.diagnostics = final.get("diagnostics", "")
                 st.finish_time = time.time()
                 st.state = final.get("state", TaskState.SUCCEEDED)
+                if out_path and index:
+                    # size-aware shuffle: isolated children report their
+                    # output size exactly like in-process attempts do
+                    st.output_bytes = sum(
+                        int(p[2]) for p in index.get("partitions", ()))
             if out_path:
                 # confine served paths to this tracker's scratch tree — the
                 # shuffle server must never be steerable at arbitrary files

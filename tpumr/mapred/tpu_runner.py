@@ -92,6 +92,18 @@ class HbmSplitCache:
                 _v, b = self._entries.pop(k)
                 self._bytes -= b
 
+    def snapshot(self) -> "list[tuple[tuple, int]]":
+        """Locked point-in-time (key, charged_bytes) listing, LRU→MRU —
+        the devcache inventory the tracker piggybacks on heartbeats.
+        Values are deliberately NOT exposed (device arrays stay put)."""
+        with self._lock:
+            return [(k, b) for k, (_v, b) in self._entries.items()]
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
 
 _split_caches: dict[str, HbmSplitCache] = {}
 _cache_lock = threading.Lock()

@@ -71,3 +71,42 @@ def clear_device_cache(tag_prefix: "str | None" = None) -> None:
         cache.clear()
     else:
         cache.drop_where(lambda k: k[0].startswith(tag_prefix))
+
+
+def inventory(max_tags: int = 32) -> "dict[str, int]":
+    """Resident tag → total bytes across devices, MRU-first, bounded to
+    ``max_tags`` entries — the devcache inventory trackers piggyback on
+    heartbeats so the scheduler can place tasks where their side inputs
+    already live. Cheap (one locked snapshot) and safe pre-first-use
+    (empty dict when the cache was never built)."""
+    with _lock:
+        cache = _cache
+    if cache is None:
+        return {}
+    tags: "dict[str, int]" = {}
+    # snapshot is LRU→MRU; walk reversed so the bound keeps HOT tags
+    for key, nbytes in reversed(cache.snapshot()):
+        tag = key[0] if isinstance(key, tuple) else str(key)
+        if tag in tags:
+            tags[tag] += nbytes
+        elif len(tags) < max_tags:
+            tags[tag] = nbytes
+    return tags
+
+
+def occupancy() -> "dict[str, Any]":
+    """Gauge-shaped occupancy summary: entry count, resident bytes, and
+    per-tag-family byte totals (family = tag prefix before ':')."""
+    with _lock:
+        cache = _cache
+    if cache is None:
+        return {"entries": 0, "bytes": 0, "families": {}}
+    snap = cache.snapshot()
+    families: "dict[str, int]" = {}
+    total = 0
+    for key, nbytes in snap:
+        tag = key[0] if isinstance(key, tuple) else str(key)
+        family = tag.split(":", 1)[0]
+        families[family] = families.get(family, 0) + nbytes
+        total += nbytes
+    return {"entries": len(snap), "bytes": total, "families": families}

@@ -37,6 +37,12 @@ hung-task-reaping loop):
                                  :func:`fires`, nothing raised); the
                                  tracker's reaper is the quarry's
                                  predator
+  task.slow / task.slow.m<idx>   BEHAVIORAL fault — a straggler: the
+                                 task stays alive, reporting slowly-
+                                 advancing progress for ``tpumr.fi.
+                                 task.slow.ms`` before the real work
+                                 runs; targeted speculation is the
+                                 quarry's predator
 
 Control-plane partition seams (``RpcClient`` with ``fi_conf`` set —
 the master-restart / partition-tolerance chaos loop):
