@@ -17,7 +17,12 @@ series:
   lock IS the latency) alongside;
 - ``assign_p99_s``          — scheduler pass cost (``assign_seconds``);
 - ``rpc_inflight_peak``     — high-water concurrently dispatched RPCs;
-- ``completion_event_lag_p99`` — events pending per reduce poll.
+- ``completion_event_lag_p99`` — events pending per reduce poll;
+- ``cpu_share_{fold,assign,rpc,history,other}`` — where the master's
+  CPU went, from the continuous sampler (``tpumr/metrics/sampler.py``)
+  running at its default hz DURING the ramp — so the SLO gate also
+  proves profiling overhead fits inside the SLO — plus
+  ``gil_delay_p99``, the sampler's GIL-scheduling-delay proxy.
 
 Each fleet size gets a FRESH master so rows are independent
 distributions, not cumulative smears. The report names the max
@@ -98,7 +103,12 @@ def _log_row(row: dict) -> None:
         f"{row['rpc_inflight_peak']} · interval "
         f"{row['interval_instructed_ms']}ms · "
         f"{row['heartbeats']} beats, {row['tasks_completed']} tasks "
-        f"in {row['wall_s']:.1f}s"
+        f"in {row['wall_s']:.1f}s · cpu "
+        f"fold {row['cpu_share_fold']:.0%}/assign "
+        f"{row['cpu_share_assign']:.0%}/rpc {row['cpu_share_rpc']:.0%}"
+        f"/hist {row['cpu_share_history']:.0%}/other "
+        f"{row['cpu_share_other']:.0%} · gil p99 "
+        f"{row['gil_delay_p99'] * 1e3:.1f}ms"
         + ("" if row["completed"] else " · WORKLOAD INCOMPLETE"))
 
 
@@ -113,6 +123,12 @@ def run_step(n_trackers: int, interval_s: float,
 
     conf = JobConf()
     conf.set("tpumr.heartbeat.interval.ms", int(interval_s * 1000))
+    # the continuous profiler runs DURING the ramp at its default hz:
+    # every row's latency series is measured with sampling on, so the
+    # SLO gate also proves the profiler's overhead fits inside it —
+    # and the row gains the cpu_share_* attribution columns (where the
+    # master's CPU went at this fleet size)
+    conf.set("tpumr.prof.enabled", True)
     # adaptive cadence: configured interval is the floor, 2x the SLO
     # is the ceiling — rows ≤ target_rate × floor trackers keep the
     # exact baseline cadence, larger fleets are instructed (and their
@@ -203,6 +219,20 @@ def run_step(n_trackers: int, interval_s: float,
         hb = row["heartbeat_p99_s"]
         row["lock_wait_share"] = round(
             row["lock_wait_p99_s"] / hb, 3) if hb > 0 else 0.0
+        # subsystem CPU attribution from the continuous sampler (whole-
+        # row window): reactor rides with rpc and the shuffle/merger
+        # categories (worker-side, ~0 on a master) ride with other, so
+        # the five columns sum to ~1.0 whenever any sample landed
+        shares = master.sampler.subsystem_shares()
+        row["cpu_share_fold"] = round(shares["fold"], 4)
+        row["cpu_share_assign"] = round(shares["assign"], 4)
+        row["cpu_share_rpc"] = round(
+            shares["rpc"] + shares["reactor"], 4)
+        row["cpu_share_history"] = round(shares["history"], 4)
+        row["cpu_share_other"] = round(
+            shares["other"] + shares["shuffle"] + shares["merger"], 4)
+        row["gil_delay_p99"] = round(
+            _p(snap.get("prof", {}).get("gil_delay_seconds"), "p99"), 6)
     finally:
         fleet.stop()
         driver.close()
@@ -459,16 +489,29 @@ def main() -> None:
         # beat; nothing earlier exists to compare against
         "vs_baseline": 1.0,
     }))
-    if "--assert-slo" in sys.argv and \
-            report["max_sustainable_trackers"] < max(FLEETS):
-        # CI regression gate (smoke sizes only — the full ramp is a
-        # measurement, not a gate): the whole smoke fleet must hold the
-        # dual-p99 SLO, or the control plane regressed
-        log(f"[scale] SLO FAILED: sustained "
-            f"{report['max_sustainable_trackers']} of {max(FLEETS)} "
-            f"trackers at the {report['slo_s'] * 1000:.0f}ms dual-p99 "
-            f"SLO")
-        sys.exit(3)
+    if "--assert-slo" in sys.argv:
+        if report["max_sustainable_trackers"] < max(FLEETS):
+            # CI regression gate (smoke sizes only — the full ramp is a
+            # measurement, not a gate): the whole smoke fleet must hold
+            # the dual-p99 SLO, or the control plane regressed
+            log(f"[scale] SLO FAILED: sustained "
+                f"{report['max_sustainable_trackers']} of {max(FLEETS)} "
+                f"trackers at the {report['slo_s'] * 1000:.0f}ms "
+                f"dual-p99 SLO")
+            sys.exit(3)
+        # attribution sanity: every row's cpu_share_* columns must be
+        # present and account for (essentially) all sampled CPU — a sum
+        # outside [0.95, 1.05] means the classifier or the collapsing
+        # above dropped a category
+        for row in rows:
+            s = sum(row.get(f"cpu_share_{k}", 0.0)
+                    for k in ("fold", "assign", "rpc", "history",
+                              "other"))
+            if not 0.95 <= s <= 1.05:
+                log(f"[scale] CPU ATTRIBUTION FAILED @ "
+                    f"{row['trackers']} trackers: cpu_share_* sums to "
+                    f"{s:.3f}, expected ~1.0")
+                sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,456 @@
+"""Continuous profiler + flight recorder (tpumr/metrics/sampler.py,
+tpumr/metrics/flightrec.py): trie bounding, subsystem classification,
+self-exclusion, folded round-trips, the sampler's overhead bound, the
+SLO-breach incident pipeline end-to-end, and the /threads, /stacks,
+/flame, and ``tpumr prof`` surfaces."""
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpumr.mapred.jobconf import JobConf
+from tpumr.metrics.flightrec import (FlightRecorder, typed_p99,
+                                     validate_incident)
+from tpumr.metrics.locks import InstrumentedRLock, lock_table
+from tpumr.metrics.sampler import (StackSampler, StackTrie, classify,
+                                   flame_svg, is_idle, parse_folded,
+                                   render_folded, threads_dump)
+
+
+def fetch(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestStackTrie:
+    def test_canonical_passthrough_and_counts(self):
+        t = StackTrie(max_nodes=100)
+        s = ("m:a", "m:b", "m:c")
+        assert t.add(s) == s
+        assert t.add(s) == s
+        assert dict(t.folded())[("m:a", "m:b", "m:c")] == 2
+
+    def test_node_budget_truncates_visibly(self):
+        t = StackTrie(max_nodes=10)
+        for i in range(50):
+            t.add((f"m:root{i}", f"m:leaf{i}"))
+        # bounded: budget nodes plus at most one (other) child per level
+        assert t.nodes <= 2 * t.max_nodes
+        folded = t.folded()
+        assert any(StackTrie.OTHER in stack for stack, _ in folded), \
+            "overflow must be visible in the output, not dropped"
+        # total count is conserved through truncation
+        assert sum(c for _, c in folded) == 50
+
+    def test_deep_recursion_truncates_at_depth_limit(self):
+        from tpumr.metrics.sampler import MAX_STACK_DEPTH
+        parked = threading.Event()
+        done = threading.Event()
+
+        def recurse(n):
+            if n:
+                return recurse(n - 1)
+            parked.set()
+            done.wait(10)
+
+        t = threading.Thread(target=recurse,
+                             args=(MAX_STACK_DEPTH + 50,),
+                             name="deep-thread", daemon=True)
+        t.start()
+        assert parked.wait(5)
+        s = StackSampler(hz=97).start()
+        try:
+            time.sleep(0.2)
+            pairs = parse_folded(s.folded(thread_prefix="deep-thread"))
+        finally:
+            s.stop()
+            done.set()
+        assert pairs
+        # a runaway recursion samples as a bounded stack, not an
+        # unbounded allocation (thread-name root + MAX_STACK_DEPTH)
+        assert all(len(stack) <= MAX_STACK_DEPTH + 1
+                   for stack, _ in pairs)
+
+
+class TestClassify:
+    def test_reactor_wins_by_thread_identity(self):
+        # even mid-dispatch into jobtracker code, the reactor's samples
+        # are the loop's, never the dispatched subsystem's
+        s = ("tpumr.ipc.rpc:_serve", "tpumr.mapred.jobtracker:heartbeat")
+        assert classify(s, "rpc-reactor") == "reactor"
+
+    def test_assign_beats_fold_innermost_out(self):
+        # both frames live in one rpc-handler stack during a beat's
+        # assign pass; the deeper scheduler frame owns the sample
+        s = ("tpumr.mapred.jobtracker:heartbeat",
+             "tpumr.mapred.jobtracker:_heartbeat_fold_and_assign",
+             "tpumr.mapred.scheduler:assign_tasks")
+        assert classify(s, "rpc-handler_3") == "assign"
+        # without the scheduler frame the same thread is folding
+        assert classify(s[:2], "rpc-handler_3") == "fold"
+
+    def test_history_and_roles_and_other(self):
+        assert classify(("tpumr.mapred.history:append",),
+                        "history-writer") == "history"
+        # no module match -> thread role
+        assert classify(("tpumr.ipc.rpc:_dispatch",),
+                        "rpc-handler_0") == "rpc"
+        assert classify(("x:y",), "mystery") == "other"
+
+    def test_idle_leaves(self):
+        assert is_idle(("tpumr.scale.simtracker:_worker",
+                        "threading:wait"))
+        assert is_idle(("tpumr.ipc.rpc:_serve", "selectors:select"))
+        assert is_idle(("tpumr.ipc.rpc:call", "tpumr.ipc.rpc:_fill"))
+        assert not is_idle(("tpumr.mapred.jobtracker:heartbeat",))
+
+
+class TestFolded:
+    def test_round_trip(self):
+        pairs = [(("main", "m:a", "m:b"), 3), (("worker", "m:c"), 1)]
+        text = render_folded(pairs)
+        assert parse_folded(text) == sorted(pairs)
+
+    def test_flame_svg_self_contained(self):
+        svg = flame_svg(render_folded([(("main", "m:a", "m:b"), 5),
+                                       (("main", "m:a", "m:c"), 3)]),
+                        title="t")
+        assert svg.startswith("<svg") and svg.endswith("</svg>")
+        assert "<script" not in svg
+        assert "m:b" in svg and "m:c" in svg
+
+    def test_flame_svg_empty_window(self):
+        assert "no samples" in flame_svg("", title="t")
+
+
+class TestSampler:
+    def test_samples_busy_thread_and_excludes_self(self):
+        stop = threading.Event()
+
+        def burn():
+            x = 0
+            while not stop.is_set():
+                x += 1
+            return x
+
+        t = threading.Thread(target=burn, name="burner", daemon=True)
+        t.start()
+        s = StackSampler(hz=97).start()
+        try:
+            time.sleep(0.6)
+            folded = s.folded()
+        finally:
+            s.stop()
+            stop.set()
+            t.join()
+        pairs = parse_folded(folded)
+        roots = {stack[0] for stack, _ in pairs}
+        assert "burner" in roots
+        # the sampler's own threads never appear in their own samples
+        assert not any(r.startswith("prof-") for r in roots)
+        shares = s.subsystem_shares()
+        assert abs(sum(shares.values()) - 1.0) < 1e-9
+        assert shares["other"] > 0  # the burner
+
+    def test_thread_prefix_filter(self):
+        stop = threading.Event()
+        ts = [threading.Thread(target=stop.wait, name=f"task-a{i}",
+                               daemon=True) for i in range(2)]
+        for t in ts:
+            t.start()
+        s = StackSampler(hz=97).start()
+        try:
+            time.sleep(0.3)
+            only = parse_folded(s.folded(thread_prefix="task-a0"))
+        finally:
+            s.stop()
+            stop.set()
+        assert only and all(stack[0] == "task-a0" for stack, _ in only)
+
+    def test_from_conf_gating(self):
+        conf = JobConf()
+        assert StackSampler.from_conf(conf) is None
+        conf.set("tpumr.prof.enabled", True)
+        s = StackSampler.from_conf(conf)
+        assert s is not None and s.hz == 19
+
+    def test_overhead_within_bound(self):
+        """Sampling at the default hz must not cost more than ~10% of a
+        CPU-bound workload's wall time (the always-on contract)."""
+
+        def work():
+            x = 0
+            for i in range(600_000):
+                x += i * i
+            return x
+
+        def best_of(n):
+            return min(_timed(work) for _ in range(n))
+
+        def _timed(fn):
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+
+        base = best_of(3)
+        s = StackSampler(hz=19).start()
+        try:
+            sampled = best_of(3)
+        finally:
+            s.stop()
+        assert sampled <= base * 1.10 + 0.005, \
+            f"sampler overhead too high: {base:.4f}s -> {sampled:.4f}s"
+        # and the sampler's own accounting agrees it is cheap
+        snap = s.registry.snapshot()
+        assert snap["prof_overhead_share"] < 0.05
+
+
+class TestLockTable:
+    def test_holder_and_waiter_visible(self):
+        lk = InstrumentedRLock(name="t_lock_table", rank=45)
+        got = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                got.set()
+                release.wait(5)
+
+        h = threading.Thread(target=holder, name="holder-thread",
+                             daemon=True)
+        h.start()
+        assert got.wait(5)
+        waiting = threading.Thread(
+            target=lambda: lk.acquire(timeout=5) and lk.release(),
+            name="waiter-thread", daemon=True)
+        waiting.start()
+        deadline = time.monotonic() + 5
+        row = None
+        while time.monotonic() < deadline:
+            rows = {r["name"]: r for r in lock_table()}
+            row = rows.get("t_lock_table")
+            if row and row["waiters"]:
+                break
+            time.sleep(0.01)
+        assert row is not None
+        assert row["holder"] == "holder-thread"
+        assert "waiter-thread" in row["waiters"]
+        assert row["held_for_s"] >= 0
+        release.set()
+        h.join(5)
+        waiting.join(5)
+        rows = {r["name"]: r for r in lock_table()}
+        assert rows["t_lock_table"]["holder"] is None
+
+    def test_threads_dump_annotates(self):
+        lk = InstrumentedRLock(name="t_dump_lock", rank=46)
+        with lk:
+            text = threads_dump()
+        assert "== locks (rank order) ==" in text
+        assert "t_dump_lock" in text
+        assert "MainThread" in text
+
+
+class TestTypedP99:
+    def test_interpolates_buckets(self):
+        # sparse {bucket_index: count} over bounds, Histogram.typed()
+        # shape: all observations in (0.1, 0.2] -> p99 inside it
+        t = {"bounds": [0.1, 0.2, 0.4], "buckets": {1: 100},
+             "count": 100, "max": 0.2}
+        v = typed_p99(t)
+        assert 0.1 < v <= 0.2
+
+    def test_empty_and_overflow(self):
+        assert typed_p99({"bounds": [], "buckets": {}, "count": 0}) == 0.0
+        # index len(bounds) is the +Inf bucket -> p99 reports max
+        t = {"bounds": [0.1], "buckets": {1: 10}, "count": 10,
+             "max": 3.0}
+        assert typed_p99(t) == 3.0
+
+    def test_windowed_via_typed_delta(self):
+        from tpumr.metrics.histogram import Histogram, typed_delta
+        h = Histogram("hb", bounds=[0.05, 0.1, 0.5, 1.0])
+        for _ in range(50):
+            h.observe(0.01)
+        prev = h.typed()
+        for _ in range(50):
+            h.observe(0.7)   # the breach happens AFTER the snapshot
+        d = typed_delta(h.typed(), prev)
+        # the delta window sees only the slow half -> p99 lands high
+        assert typed_p99(d) > 0.5
+
+
+@pytest.fixture(scope="module")
+def prof_cluster(tmp_path_factory):
+    """One mini cluster with the profiler on and a forced-slow master
+    heartbeat: the flight-recorder e2e substrate."""
+    from tpumr.mapred.mini_cluster import MiniMRCluster
+    inc_dir = str(tmp_path_factory.mktemp("incidents"))
+    conf = JobConf()
+    conf.set("mapred.job.tracker.http.port", 0)
+    conf.set("mapred.task.tracker.http.port", 0)
+    conf.set("tpumr.prof.enabled", True)
+    conf.set("tpumr.prof.incident.dir", inc_dir)
+    conf.set("tpumr.prof.incident.slo.ms", 250)
+    conf.set("tpumr.prof.incident.cooldown.ms", 600_000)
+    # the observability seam: stall the first 3 beats past the SLO
+    conf.set("tpumr.fi.jt.heartbeat.slow.probability", 1.0)
+    conf.set("tpumr.fi.jt.heartbeat.slow.max.failures", 3)
+    conf.set("tpumr.fi.jt.heartbeat.slow.ms", 400)
+    with MiniMRCluster(num_trackers=1, cpu_slots=1, tpu_slots=0,
+                       conf=conf) as c:
+        c.incident_dir = os.path.join(inc_dir, "incidents")
+        yield c
+
+
+class TestIncidentE2E:
+    def _wait_incidents(self, cluster, timeout=15.0):
+        url = cluster.master.http_url + "/json/incidents"
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            _, body = fetch(url)
+            rows = json.loads(body)
+            if rows:
+                return rows
+            time.sleep(0.25)
+        raise AssertionError("no incident within deadline")
+
+    def test_breach_writes_exactly_one_valid_bundle(self, cluster_env):
+        cluster = cluster_env
+        rows = self._wait_incidents(cluster)
+        # the seam stalled 3 beats but the cooldown admits ONE bundle
+        time.sleep(2.5)   # two more recorder ticks under breach
+        _, body = fetch(cluster.master.http_url + "/json/incidents")
+        rows = json.loads(body)
+        assert len(rows) == 1, rows
+        assert rows[0]["reason"][0]["metric"] == "heartbeat_seconds"
+        _, body = fetch(cluster.master.http_url
+                        + f"/incident?name={rows[0]['name']}")
+        doc = json.loads(body)
+        assert validate_incident(doc) == []
+        assert doc["reason"][0]["p99_s"] > doc["slo_ms"] / 1000.0
+        # the bundle carries every forensic section with real content
+        assert doc["folded_stacks"].strip()
+        assert doc["heartbeat"]["trackers"] == 1
+        assert "rpc_inflight" in doc["rpc"]
+        # suppressed repeats are counted, not silently dropped
+        _, body = fetch(cluster.master.http_url + "/json/metrics")
+        prof = json.loads(body).get("prof", {})
+        assert prof.get("incidents_written") == 1
+        # export for the CI artifact when asked: the bundle itself plus
+        # the master's live folded-stack window (flamegraph.pl-ready)
+        out = os.environ.get("TPUMR_INCIDENT_E2E_OUT")
+        if out:
+            os.makedirs(out, exist_ok=True)
+            shutil.copy(
+                os.path.join(cluster.incident_dir, rows[0]["name"]), out)
+            _, folded = fetch(cluster.master.http_url + "/stacks")
+            with open(os.path.join(out, "master-stacks.folded"),
+                      "w") as f:
+                f.write(folded)
+
+    @pytest.fixture()
+    def cluster_env(self, prof_cluster):
+        return prof_cluster
+
+    def test_incidents_page_lists_bundle(self, cluster_env):
+        rows = self._wait_incidents(cluster_env)
+        status, page = fetch(cluster_env.master.http_url + "/incidents")
+        assert status == 200
+        assert rows[0]["name"] in page
+        assert "heartbeat_seconds" in page
+
+    def test_incident_name_traversal_rejected(self, cluster_env):
+        self._wait_incidents(cluster_env)
+        status, _ = fetch(cluster_env.master.http_url
+                          + "/incident?name=../../etc/passwd")
+        assert status >= 400
+
+
+class TestHttpSurfaces:
+    def test_master_stacks_flame_threads(self, prof_cluster):
+        base = prof_cluster.master.http_url
+        time.sleep(0.3)
+        status, stacks = fetch(base + "/stacks?seconds=30")
+        assert status == 200
+        assert parse_folded(stacks), "no samples in folded output"
+        status, svg = fetch(base + "/flame")
+        assert status == 200 and svg.startswith("<svg")
+        status, dump = fetch(base + "/threads")
+        assert status == 200
+        assert "== locks (rank order) ==" in dump
+        assert "rpc-reactor" in dump
+
+    def test_threads_without_sampler(self):
+        """/threads is universal — a daemon with profiling off still
+        serves the instant dump."""
+        from tpumr.mapred.jobtracker import JobMaster
+        conf = JobConf()
+        conf.set("mapred.job.tracker.http.port", 0)
+        m = JobMaster(conf).start()
+        try:
+            status, dump = fetch(m.http_url + "/threads")
+            assert status == 200 and "MainThread" in dump
+            # but the sampler surfaces 404 (off by default)
+            status, _ = fetch(m.http_url + "/stacks")
+            assert status == 404
+            status, page = fetch(m.http_url + "/incidents")
+            assert status == 200 and "disabled" in page
+        finally:
+            m.stop()
+
+    def test_cluster_page_profiler_line(self, prof_cluster):
+        status, page = fetch(prof_cluster.master.http_url + "/cluster")
+        assert status == 200
+        assert "trace spans dropped" in page
+        assert "sampler overhead" in page
+
+    def test_tracker_attempt_filter_and_metrics(self, prof_cluster):
+        tr = prof_cluster.trackers[0]
+        base = tr._http.url
+        status, stacks = fetch(base + "/stacks")
+        assert status == 200
+        # attempt filter returns cleanly even for a finished attempt
+        status, filtered = fetch(base + "/stacks?attempt=nope")
+        assert status == 200
+        assert parse_folded(filtered) == []
+        _, body = fetch(base + "/json/metrics")
+        snap = json.loads(body)
+        assert "prof" in snap, "tracker sampler registry not registered"
+        assert any(k.startswith("cpu_share|subsystem=")
+                   for k in snap["prof"])
+
+
+class TestProfCli:
+    def test_prof_pulls_folded_and_flame(self, prof_cluster, tmp_path,
+                                         capsys):
+        from tpumr.cli import main as cli_main
+        hp = prof_cluster.master.http_url.split("//", 1)[1]
+        assert cli_main(["prof", hp]) == 0
+        out = capsys.readouterr().out
+        assert parse_folded(out)
+        svg_path = str(tmp_path / "f.svg")
+        assert cli_main(["prof", hp, "-seconds", "30", "-flame",
+                         "-out", svg_path]) == 0
+        assert open(svg_path).read().startswith("<svg")
+
+    def test_prof_404_mentions_knob(self, capsys):
+        from tpumr.cli import main as cli_main
+        from tpumr.mapred.jobtracker import JobMaster
+        conf = JobConf()
+        conf.set("mapred.job.tracker.http.port", 0)
+        m = JobMaster(conf).start()
+        try:
+            hp = m.http_url.split("//", 1)[1]
+            assert cli_main(["prof", hp]) == 1
+            assert "tpumr.prof.enabled" in capsys.readouterr().err
+        finally:
+            m.stop()

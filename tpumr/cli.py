@@ -64,6 +64,9 @@ Clients:
   queue ...            queue info: -list | -info Q [-showJobs] | -showacls
   mradmin -refreshQueues|-refreshNodes   live-reload queue ACLs / host lists
   daemonlog ...        -getlevel H:P LOGGER | -setlevel H:P LOGGER LEVEL
+  prof HOST:PORT [-seconds N] [-out FILE] [-flame]
+                       pull folded stacks (or -flame SVG) off a live
+                       daemon's continuous sampler (tpumr.prof.enabled)
   rcc FILE.jr ...      compile Record I/O DDL to record classes (= bin/rcc)
   tdfsproxy -port P    read-only HTTP(S) storage gateway (= hdfsproxy)
   lint [--json FILE] [--rules R,..] [--conf-doc [FILE]] [--list-keys]
@@ -235,7 +238,8 @@ def cmd_historyserver(conf, argv: list[str]) -> int:
     hs = JobHistoryServer(a.get("dir")
                           or conf.get("tpumr.history.dir")
                           or "/tmp/tpumr-history",
-                          port=int(a.get("port", 9888))).start()
+                          port=int(a.get("port", 9888)),
+                          conf=conf).start()
     print(f"JobHistoryServer up at {hs.url}", file=sys.stderr)
     return _serve_forever(hs.stop)
 
@@ -1277,6 +1281,49 @@ def cmd_daemonlog(conf, argv: list[str]) -> int:
     return 0
 
 
+def cmd_prof(conf, argv: list[str]) -> int:
+    """Pull a profiling window off a live daemon's continuous sampler:
+    ``tpumr prof HOST:PORT [-seconds N] [-out FILE] [-flame]``. Default
+    output is the collapsed folded-stack text (one ``thread;frames
+    count`` line per unique stack — pipe into any flamegraph tool);
+    ``-flame`` asks the daemon for the self-contained SVG instead.
+    Needs ``tpumr.prof.enabled`` on the target daemon."""
+    import urllib.error
+    import urllib.request
+    usage = ("Usage: tpumr prof HOST:PORT [-seconds N] [-out FILE] "
+             "[-flame]")
+    if not argv or ":" not in argv[0]:
+        print(usage, file=sys.stderr)
+        return 255
+    hostport, rest = argv[0], argv[1:]
+    a = _kv_args([x for x in rest if x != "-flame"])
+    flame = "-flame" in rest
+    path = "flame" if flame else "stacks"
+    url = f"http://{hostport}/{path}"
+    if a.get("seconds"):
+        url += f"?seconds={float(a['seconds'])}"
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            body = resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        # a daemon without the sampler 404s — say what to enable
+        detail = (f"{e} — is tpumr.prof.enabled set on the daemon?"
+                  if e.code == 404 else e)
+        print(f"prof: {hostport}: {detail}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"prof: {hostport}: {e}", file=sys.stderr)
+        return 1
+    out = a.get("out")
+    if out:
+        with open(out, "w") as f:
+            f.write(body)
+        print(f"wrote {len(body)} bytes to {out}", file=sys.stderr)
+    else:
+        sys.stdout.write(body)
+    return 0
+
+
 def cmd_fetchdt(conf, argv: list[str]) -> int:
     """≈ bin/hadoop fetchdt TOKEN_FILE: fetch a NameNode delegation
     token into a credential file — an alias for
@@ -1339,6 +1386,7 @@ COMMANDS = {
     "queue": cmd_queue,
     "mradmin": cmd_mradmin,
     "daemonlog": cmd_daemonlog,
+    "prof": cmd_prof,
     "fetchdt": cmd_fetchdt,
     "rcc": cmd_rcc,
     "tdfsproxy": cmd_tdfsproxy,

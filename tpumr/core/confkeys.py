@@ -378,6 +378,9 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Fair scheduler: preemption check period, ms."),
     _K('tpumr.fairscheduler.preemption.timeout.ms', 'int', 15000,
         "Fair scheduler: starvation window before preempting, ms."),
+    _K('tpumr.fi.jt.heartbeat.slow.ms', 'int', 400,
+        "Ms the jt.heartbeat.slow fault seam stalls master heartbeat "
+        "handling (drives the flight-recorder incident e2e)."),
     _K('tpumr.fi.rpc.delay.ms', 'int', 100,
         "Ms the rpc.delay fault seam stalls a call."),
     _K('tpumr.fi.seed', 'str', None,
@@ -486,6 +489,27 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Pipes binary for the TPU pass."),
     _K('tpumr.policy.file', 'str', None,
         "Service-level authorization policy file."),
+    _K('tpumr.prof.enabled', 'bool', False,
+        "Continuous profiler master switch: stack sampling, cpu_share "
+        "subsystem attribution, gil_delay_seconds, /stacks + /flame."),
+    _K('tpumr.prof.hz', 'int', 19,
+        "Profiler sampling rate (Hz); co-prime with common timer grids "
+        "so periodic work cannot hide between samples."),
+    _K('tpumr.prof.incident.cooldown.ms', 'int', 60000,
+        "Min ms between flight-recorder incident bundles — a sustained "
+        "breach writes one bundle per window, not a stream."),
+    _K('tpumr.prof.incident.dir', 'str', None,
+        "Flight-recorder bundle directory (default: an incidents/ dir "
+        "next to the job history)."),
+    _K('tpumr.prof.incident.slo.ms', 'int', 250,
+        "Windowed heartbeat p99 (handling or lag) above this arms the "
+        "flight recorder — the bench_scale dual-p99 SLO, live."),
+    _K('tpumr.prof.trie.max.nodes', 'int', 20000,
+        "Profiler stack-trie node budget; overflow folds into (other) "
+        "so profiler memory stays bounded."),
+    _K('tpumr.prof.window.s', 'float', 120.0,
+        "Profiler sample-retention window for /stacks?seconds= queries "
+        "and the cpu_share gauges."),
     _K('tpumr.profile.ewma', 'float', 0.0,
         "EWMA weight for the job's TPU acceleration profile (0 = plain "
         "mean)."),

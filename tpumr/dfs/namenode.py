@@ -1468,6 +1468,7 @@ class NameNode:
                                          name="nn-monitors", daemon=True)
         self._http: Any = None
         self._http_port = int(conf.get("tdfs.http.port", -1))
+        self.sampler: Any = None  # set by _build_http when prof enabled
 
     def start(self) -> "NameNode":
         self._server.start()
@@ -1478,6 +1479,8 @@ class NameNode:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.sampler is not None:
+            self.sampler.stop()
         if self._http is not None:
             self._http.stop()
         self._server.stop()
@@ -1511,6 +1514,14 @@ class NameNode:
 
         reg.set_gauge("namespace", _ns_gauges)
         srv.attach_metrics(ms)
+
+        # continuous profiler: same knob as the mapred daemons, so
+        # enabling tpumr.prof.enabled lights /stacks + /flame here too
+        from tpumr.metrics.sampler import StackSampler
+        self.sampler = StackSampler.from_conf(self.conf, ms)
+        if self.sampler is not None:
+            self.sampler.start()
+            self.sampler.attach_http(srv)
 
         def summary(q: dict) -> dict:
             ns = self.ns

@@ -98,6 +98,15 @@ def _log_level(query: "dict[str, str]") -> dict:
                 logger.getEffectiveLevel())}
 
 
+def _threads(query: "dict[str, str]") -> str:
+    """/threads — one-shot dump of every live thread's stack with
+    InstrumentedRLock holder/waiter annotations (tpumr/metrics/locks.py
+    + tpumr/metrics/sampler.py). Lazy import: the http package must not
+    pull the metrics package at import time."""
+    from tpumr.metrics.sampler import threads_dump
+    return threads_dump()
+
+
 class StatusHttpServer:
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: int = 0) -> None:
@@ -134,6 +143,12 @@ class StatusHttpServer:
         # (bin/hadoop daemonlog -getlevel/-setlevel)
         self.add_json("logLevel", _log_level, parameterized=True,
                       mutating_param="level")
+        # ... and the instant stack dump (≈ the reference's
+        # StackServlet on every HttpServer / `kill -QUIT`): all live
+        # threads annotated with instrumented-lock holder/waiter state.
+        # Needs no sampler and no daemon lock — the "is it deadlocked
+        # right now" page works precisely when everything else doesn't.
+        self.add_raw("threads", _threads, content_type="text/plain")
 
     # ------------------------------------------------------------ wiring
 

@@ -178,13 +178,20 @@ def timeline_svg(tasks: list[dict], width: int = 900) -> str:
 
 class JobHistoryServer:
     def __init__(self, history_dir: str, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, conf: Any = None) -> None:
         self.dir = history_dir
         #: (path, mtime) -> summary; finished-job files are immutable, so
         #: summaries are cacheable and a scrape is O(new files) not
         #: O(total historical events)
         self._summary_cache: dict[str, tuple[float, dict]] = {}
         self._http = StatusHttpServer("history", host=host, port=port)
+        # continuous profiler (conf-gated, same knob as every daemon)
+        self.sampler = None
+        if conf is not None:
+            from tpumr.metrics.sampler import StackSampler
+            self.sampler = StackSampler.from_conf(conf)
+            if self.sampler is not None:
+                self.sampler.attach_http(self._http)
         self._http.add_json("history", self._list)
         self._http.add_json("job", self._job, parameterized=True)
         self._http.add_json("tasks", self._tasks, parameterized=True)
@@ -317,7 +324,11 @@ class JobHistoryServer:
 
     def start(self) -> "JobHistoryServer":
         self._http.start()
+        if self.sampler is not None:
+            self.sampler.start()
         return self
 
     def stop(self) -> None:
+        if self.sampler is not None:
+            self.sampler.stop()
         self._http.stop()

@@ -179,6 +179,40 @@ class _TrackerInfo:
             return False
 
 
+def _profiler_line(snaps: dict, jt_snap: dict, flightrec_on: bool) -> str:
+    """One cluster-page paragraph answering "what is the master's CPU
+    doing, and is watching it costing anything" — cpu_share by
+    subsystem, GIL-delay p99, sampler overhead, and the tracer's
+    ring-drop count, off the already-taken metrics snapshot."""
+    prof = snaps.get("prof", {})
+    shares = []
+    for name in sorted(prof):
+        if name.startswith("cpu_share|subsystem="):
+            v = prof[name]
+            if isinstance(v, (int, float)) and v > 0:
+                shares.append(
+                    f"{name.split('subsystem=', 1)[-1]} {v:.0%}")
+    gil = prof.get("gil_delay_seconds", {})
+    dropped = jt_snap.get("trace_spans_dropped", 0)
+    bits = []
+    if shares:
+        bits.append("cpu share " + " · ".join(shares))
+    if isinstance(gil, dict) and gil.get("count"):
+        bits.append(f"gil delay p99 {gil.get('p99', 0):.4g}s")
+    ov = prof.get("prof_overhead_share")
+    if isinstance(ov, (int, float)):
+        bits.append(f"sampler overhead {ov:.2%}")
+    bits.append(f"trace spans dropped {dropped:.0f}")
+    link = (" · <a href='/flame'>flame</a> / <a href='/stacks'>stacks"
+            "</a>" if prof else "")
+    link += (" / <a href='/incidents'>incidents</a>"
+             if flightrec_on else "")
+    if not prof:
+        return ("<p class='dim'>profiler off (tpumr.prof.enabled) · "
+                f"trace spans dropped {dropped:.0f}</p>")
+    return "<p>" + " · ".join(bits) + link + "</p>"
+
+
 class JobMaster:
     def __init__(self, conf: Any, host: str = "127.0.0.1",
                  port: int = 0) -> None:
@@ -513,6 +547,17 @@ class JobMaster:
         self.tracer = Tracer("jobtracker",
                              trace_dir=trace_dir_from_conf(conf))
         self._trace_all = trace_enabled(conf)
+        # trace shedding is a loss signal, not a log line: the buffer's
+        # shed-oldest counter rides the same scrape as everything else
+        self._mreg.set_gauge("trace_spans_dropped",
+                             lambda: self.tracer.dropped)
+        # continuous profiler + flight recorder (both None unless
+        # tpumr.prof.enabled): where the master's CPU goes, and an
+        # automatic postmortem bundle when the heartbeat SLO breaches
+        from tpumr.metrics.flightrec import FlightRecorder
+        from tpumr.metrics.sampler import StackSampler
+        self.sampler = StackSampler.from_conf(conf, self.metrics)
+        self.flightrec = FlightRecorder.from_conf(conf, self, self.sampler)
         self._http: Any = None
         self._http_port = conf.get_int("mapred.job.tracker.http.port", -1)
 
@@ -533,6 +578,10 @@ class JobMaster:
         self._expire_thread.start()
         self._pipe_thread.start()
         self.metrics.start()
+        if self.sampler is not None:
+            self.sampler.start()
+        if self.flightrec is not None:
+            self.flightrec.start()
         if self._http_port >= 0:
             self._http = self._build_http(self._http_port).start()
         return self
@@ -657,6 +706,10 @@ class JobMaster:
     def stop(self) -> None:
         self._stop.set()
         self._pipe_wake.set()   # unblock the advancement thread's wait
+        if self.flightrec is not None:
+            self.flightrec.stop()
+        if self.sampler is not None:
+            self.sampler.stop()
         self.metrics.stop()
         self.tracer.flush()
         if self._http is not None:
@@ -722,6 +775,27 @@ class JobMaster:
         srv.add_raw("tracejson", tracejson)
         srv.add_json("trace", lambda q: self.get_job_trace(q["job"]),
                      parameterized=True)
+
+        # continuous profiler: /stacks (collapsed folded-stack text) and
+        # /flame (self-contained SVG) when tpumr.prof.enabled; the
+        # flight recorder's bundle listing is always registered so the
+        # page can say WHY it is empty
+        if self.sampler is not None:
+            self.sampler.attach_http(srv)
+
+        def incidents_json(q: dict) -> list:
+            return (self.flightrec.list_incidents()
+                    if self.flightrec is not None else [])
+
+        def incident_raw(q: dict) -> dict:
+            if self.flightrec is None:
+                raise ValueError(
+                    "flight recorder disabled (tpumr.prof.enabled off "
+                    "or no incident dir)")
+            return self.flightrec.read_incident(q["name"])
+
+        srv.add_json("incidents", incidents_json)
+        srv.add_raw("incident", incident_raw)
 
         # HTML views ≈ webapps/job/{jobtracker,jobdetails,jobtasks}.jsp
         from tpumr.http import (RawHtml, html_escape, html_table,
@@ -952,6 +1026,8 @@ class JobMaster:
                 + (f" · heartbeat p99 {hb.get('p99', 0):.4g}s over "
                    f"{hb.get('count', 0):.0f} beats" if hb else "")
                 + "</p>",
+                _profiler_line(snaps, jt_snap,
+                               self.flightrec is not None),
                 "<h2>Master locks (wait vs hold)</h2>",
                 html_table(["lock", "acquires", "wait p99", "wait max",
                             "hold p99", "hold max"], lock_rows)
@@ -1056,6 +1132,39 @@ class JobMaster:
                      parameterized=True)
         srv.add_raw("pipelinetrace", pipelinetrace)
         srv.add_page("pipelines", pipelines_page)
+        def incidents_page(q: dict) -> str:
+            if self.flightrec is None:
+                return ("<h1>Incidents</h1><p class='dim'>flight "
+                        "recorder disabled — set tpumr.prof.enabled "
+                        "and an incident dir (tpumr.prof.incident.dir "
+                        "or tpumr.history.dir)</p>")
+            import time as _time
+            rows = []
+            for r in self.flightrec.list_incidents():
+                reason = " · ".join(
+                    f"{b.get('metric', '?')} p99 "
+                    f"{b.get('p99_s', 0):.3f}s > {b.get('slo_s', 0):.3f}s"
+                    for b in r.get("reason", []))
+                rows.append([
+                    RawHtml(f"<a href='/incident?name="
+                            f"{html_escape(r['name'])}'>"
+                            f"{html_escape(r['name'])}</a>"),
+                    (_time.strftime("%Y-%m-%d %H:%M:%S",
+                                    _time.localtime(r["ts"]))
+                     if r.get("ts") else "?"),
+                    html_escape(reason),
+                    f"{r.get('bytes', 0)}",
+                ])
+            return ("<h1>Incidents</h1>"
+                    "<p>SLO-breach snapshots written by the flight "
+                    "recorder (folded stacks + lock table + rpc/"
+                    "heartbeat state + recent spans)</p>"
+                    + (html_table(["bundle", "written", "reason",
+                                   "bytes"], rows)
+                       if rows else "<p class='dim'>none — the "
+                       "heartbeat p99 has stayed under the SLO</p>"))
+
+        srv.add_page("incidents", incidents_page)
         srv.add_page("pipeline", pipeline_page, parameterized=True)
         srv.add_page("index", index_page)
         srv.add_page("job", job_page, parameterized=True)
@@ -2250,6 +2359,13 @@ class JobMaster:
         name = status["tracker_name"]
         self._mreg.incr("heartbeats")
         t0 = time.monotonic()
+        from tpumr.utils.fi import fires
+        if fires("jt.heartbeat.slow", self.conf):
+            # BEHAVIORAL observability seam: handling crawls for
+            # tpumr.fi.jt.heartbeat.slow.ms, breaching the windowed
+            # heartbeat p99 SLO — the flight recorder's forcing function
+            time.sleep(confkeys.get_int(
+                self.conf, "tpumr.fi.jt.heartbeat.slow.ms") / 1000.0)
         # the tracker's PR-2 heartbeat span context (shipped only when
         # the tracker traces its daemon loop): master-side phase work
         # records as sub-spans on that same trace, so one swimlane shows

@@ -720,7 +720,17 @@ class NodeRunner:
         # from their own conf, cached until job cleanup
         from tpumr.core.tracing import Tracer
         self.tracer = Tracer.from_conf(conf, "tasktracker")
+        if self.tracer is not None:
+            # ring-buffer drops = spans silently lost to backpressure;
+            # invisible until surfaced as a gauge (satellite of PR 15)
+            self._mreg.set_gauge("trace_spans_dropped",
+                                 lambda: self.tracer.dropped)
         self._job_tracers: dict[str, Tracer] = {}
+        # continuous profiler (metrics/sampler.py): None unless
+        # tpumr.prof.enabled — trackers share the master's knobs so one
+        # conf flips the whole cluster's sampling on
+        from tpumr.metrics.sampler import StackSampler
+        self.sampler = StackSampler.from_conf(conf, self.metrics)
         self._http: Any = None
         self._http_port = conf.get_int("mapred.task.tracker.http.port", -1)
 
@@ -787,6 +797,8 @@ class NodeRunner:
         self._hb_thread.start()
         self._reaper_thread.start()
         self.metrics.start()
+        if self.sampler is not None:
+            self.sampler.start()
         if self.health is not None:
             self.health.start()
         if self._memory_manager is not None:
@@ -797,6 +809,12 @@ class NodeRunner:
             srv.add_json("status", lambda q: self._status_dict())
             # /metrics + /json/metrics from one handler
             srv.attach_metrics(self.metrics)
+            if self.sampler is not None:
+                # /stacks?attempt= narrows to one in-process attempt's
+                # thread (they run named task-<attempt_id>) — the live
+                # complement to the post-mortem pstats block below
+                self.sampler.attach_http(
+                    srv, attempt_thread_prefix=lambda a: f"task-{a}")
             srv.add_json("profiles", lambda q: self.list_profiles())
             srv.add_json("profile",
                          lambda q: {"attempt": q["attempt"],
@@ -881,6 +899,14 @@ class NodeRunner:
                         parts.append("<h2>Shuffle / merge</h2>"
                                      + html_table(["counter", "value"],
                                                   rows))
+                if st is not None and self.sampler is not None:
+                    # live view while the attempt runs; the pstats block
+                    # below only exists after it finishes
+                    parts.append(
+                        f"<p>live: <a href='/stacks?attempt="
+                        f"{html_escape(aid)}'>sampled stacks</a> · "
+                        f"<a href='/flame?attempt={html_escape(aid)}'>"
+                        f"flame graph</a> (last 30s)</p>")
                 from tpumr.mapred.profiler import profile_top_lines
                 try:
                     text = self.get_profile(aid)
@@ -912,6 +938,8 @@ class NodeRunner:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.sampler is not None:
+            self.sampler.stop()
         self.metrics.stop()
         from tpumr.metrics.core import release_process_registry
         for src in self._claimed_sources:

@@ -12,11 +12,16 @@ the reference's GPU observability was log-grep only (SURVEY.md §5).
 from tpumr.metrics.core import (FileSink, MetricsRegistry, MetricsSystem,
                                 UdpSink, sinks_from_conf,
                                 MetricsSink)
+from tpumr.metrics.flightrec import FlightRecorder, validate_incident
 from tpumr.metrics.histogram import (BYTES, SECONDS, Histogram, Timer,
                                      exact_percentiles, exponential_bounds)
 from tpumr.metrics.prometheus import render_exposition, validate_exposition
+from tpumr.metrics.sampler import (StackSampler, flame_svg, parse_folded,
+                                   threads_dump)
 
-__all__ = ["BYTES", "FileSink", "Histogram", "MetricsRegistry",
-           "MetricsSink", "MetricsSystem", "SECONDS", "Timer", "UdpSink",
-           "exact_percentiles", "exponential_bounds", "render_exposition",
-           "sinks_from_conf", "validate_exposition"]
+__all__ = ["BYTES", "FileSink", "FlightRecorder", "Histogram",
+           "MetricsRegistry", "MetricsSink", "MetricsSystem", "SECONDS",
+           "StackSampler", "Timer", "UdpSink", "exact_percentiles",
+           "exponential_bounds", "flame_svg", "parse_folded",
+           "render_exposition", "sinks_from_conf", "threads_dump",
+           "validate_exposition", "validate_incident"]

@@ -1,0 +1,299 @@
+"""Flight recorder: automatic postmortem bundles when the master
+breaches its own latency SLO.
+
+bench_scale.json enshrines a dual-p99 SLO (heartbeat handling and
+per-tracker lag under 250 ms) that CI gates on — but a breach in a LIVE
+cluster evaporates before anyone can attach a profiler: by the time an
+operator reads the page, the convoy that caused it is gone. The
+recorder closes that gap. A watchdog thread on the master derives a
+WINDOWED p99 each tick from the cumulative ``heartbeat_seconds`` /
+``heartbeat_lag_seconds`` histograms (``typed()`` state diffed with
+``typed_delta`` — the same mechanism the heartbeat cluster merge uses),
+and on a breach writes one incident bundle: the profiler's folded
+stacks for the breach window, the live InstrumentedRLock holder/waiter
+table plus per-lock wait/hold distributions, rpc saturation and
+heartbeat-phase snapshots, and the most recent buffered trace spans —
+everything a postmortem needs, captured AT the breach, as one JSON file
+under ``tpumr.prof.incident.dir``.
+
+Bundles are rate-limited (``tpumr.prof.incident.cooldown.ms``): a
+sustained breach produces exactly one bundle per cooldown window, not a
+disk-filling stream. ``/incidents`` on the master lists them;
+``validate_incident`` is the schema checker the e2e test (and any
+external consumer) holds bundles against.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from tpumr.metrics.histogram import typed_delta
+
+#: bundle schema tag — bump on incompatible shape changes
+SCHEMA = "tpumr-incident-1"
+
+#: watchdog cadence: 1 s ticks make the breach window ~1 s, matching
+#: the heartbeat cadence the SLO is defined over
+TICK_S = 1.0
+
+
+def typed_p99(t: "dict | None", q: float = 0.99) -> float:
+    """Interpolated quantile of a ``Histogram.typed()`` (or
+    ``typed_delta``) state — the windowed read the watchdog runs on,
+    where no Histogram object exists to ask."""
+    if not t or not t.get("count"):
+        return 0.0
+    bounds = list(t.get("bounds") or [])
+    buckets = {int(k): int(v) for k, v in (t.get("buckets") or {}).items()}
+    total = int(t["count"])
+    rank = q * total
+    seen = 0.0
+    for i in range(len(bounds) + 1):
+        c = buckets.get(i, 0)
+        if not c:
+            continue
+        if seen + c >= rank:
+            if i >= len(bounds):
+                return float(t.get("max") or (bounds[-1] if bounds else 0.0))
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        seen += c
+    return float(t.get("max") or 0.0)
+
+
+class FlightRecorder:
+    """The master's SLO watchdog + incident writer. Owns one daemon
+    thread; reads only racy-safe surfaces (cumulative histogram state,
+    the metrics snapshot, the lock table, buffered spans) so arming it
+    adds nothing to the heartbeat path."""
+
+    def __init__(self, master: Any, sampler: Any, slo_ms: int,
+                 cooldown_ms: int, incident_dir: str) -> None:
+        self.master = master
+        self.sampler = sampler
+        self.slo_s = slo_ms / 1000.0
+        self.cooldown_s = cooldown_ms / 1000.0
+        self.incident_dir = incident_dir
+        self._registry = sampler.registry if sampler is not None else None
+        self._prev: "dict[str, dict]" = {}
+        self._last_write_mono: "float | None" = None
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    @classmethod
+    def from_conf(cls, conf: Any, master: Any,
+                  sampler: Any) -> "FlightRecorder | None":
+        """None unless the profiler is on AND an incident dir can be
+        derived (``tpumr.prof.incident.dir``, else next to the job
+        history) — the recorder's whole value is the folded stacks, so
+        it rides the profiler's opt-in."""
+        from tpumr.core import confkeys
+        if sampler is None:
+            return None
+        d = conf.get("tpumr.prof.incident.dir") \
+            or conf.get("tpumr.history.dir")
+        if not d:
+            return None
+        return cls(
+            master, sampler,
+            slo_ms=confkeys.get_int(conf, "tpumr.prof.incident.slo.ms"),
+            cooldown_ms=confkeys.get_int(
+                conf, "tpumr.prof.incident.cooldown.ms"),
+            incident_dir=os.path.join(str(d), "incidents"))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "FlightRecorder":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="prof-flightrec", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(TICK_S):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the watchdog must never
+                pass           # take the master down with it
+
+    # ------------------------------------------------------------ watchdog
+
+    def _windowed_p99s(self) -> "list[tuple[str, float]]":
+        """(metric, windowed p99 seconds) for each watched histogram —
+        the delta since the previous tick, so a breach long past can't
+        keep the cumulative p99 pinned above the SLO forever."""
+        out = []
+        for metric, hist in (
+                ("heartbeat_seconds", self.master._hb_seconds),
+                ("heartbeat_lag_seconds", self.master._hb_lag)):
+            cur = hist.typed()
+            delta = typed_delta(cur, self._prev.get(metric))
+            self._prev[metric] = cur
+            if delta and delta.get("count"):
+                out.append((metric, typed_p99(delta)))
+        return out
+
+    def _tick(self) -> None:
+        breaches = [(m, p99) for m, p99 in self._windowed_p99s()
+                    if p99 > self.slo_s]
+        if not breaches:
+            return
+        now = time.monotonic()
+        if self._last_write_mono is not None \
+                and now - self._last_write_mono < self.cooldown_s:
+            if self._registry is not None:
+                self._registry.incr("incidents_suppressed")
+            return
+        self._last_write_mono = now
+        self.write_incident(breaches)
+
+    # ------------------------------------------------------------ bundles
+
+    def bundle(self, breaches: "list[tuple[str, float]]") -> dict:
+        """Assemble the incident document (pure read — the e2e test and
+        ``write_incident`` share it)."""
+        from tpumr.metrics.locks import lock_table
+        m = self.master
+        snaps = m.metrics.snapshot()
+        jt = snaps.get("jobtracker", {})
+        rpc = snaps.get("rpc", {})
+        wait_hold = {
+            name: val for name, val in jt.items()
+            if name.startswith(("jt_lock_wait_seconds|",
+                                "jt_lock_hold_seconds|"))}
+        phases = {name.split("phase=", 1)[-1]: val
+                  for name, val in jt.items()
+                  if name.startswith("heartbeat_phase_seconds|")}
+        spans = [s.to_dict() for s in m.tracer.pending()[-200:]] \
+            if getattr(m, "tracer", None) is not None else []
+        return {
+            "schema": SCHEMA,
+            "ts": time.time(),
+            "role": "jobtracker",
+            "slo_ms": int(self.slo_s * 1000),
+            "reason": [{"metric": metric, "p99_s": round(p99, 6),
+                        "slo_s": self.slo_s}
+                       for metric, p99 in breaches],
+            "folded_stacks": self.sampler.folded(
+                max(2 * TICK_S, 5.0)) if self.sampler else "",
+            "subsystem_shares": self.sampler.subsystem_shares()
+            if self.sampler else {},
+            "locks": {"live": lock_table(), "wait_hold": wait_hold},
+            "rpc": {k: rpc.get(k) for k in
+                    ("rpc_inflight", "rpc_inflight_peak",
+                     "rpc_handler_threads") if k in rpc},
+            "heartbeat": {
+                "seconds": jt.get("heartbeat_seconds", {}),
+                "lag": jt.get("heartbeat_lag_seconds", {}),
+                "phases": phases,
+                "trackers": len(getattr(m, "trackers", ()) or ()),
+            },
+            "spans": spans,
+        }
+
+    def write_incident(
+            self, breaches: "list[tuple[str, float]]") -> "str | None":
+        """Write one bundle; returns its path (None on I/O failure —
+        the recorder must outlive a full disk)."""
+        doc = self.bundle(breaches)
+        try:
+            os.makedirs(self.incident_dir, exist_ok=True)
+            name = f"incident-{int(doc['ts'] * 1000)}.json"
+            path = os.path.join(self.incident_dir, name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        if self._registry is not None:
+            self._registry.incr("incidents_written")
+        return path
+
+    # ------------------------------------------------------------ listing
+
+    def list_incidents(self) -> "list[dict]":
+        """Newest-first {name, bytes, reason…} rows for /incidents."""
+        try:
+            names = sorted(
+                (n for n in os.listdir(self.incident_dir)
+                 if n.startswith("incident-") and n.endswith(".json")),
+                reverse=True)
+        except OSError:
+            return []
+        rows = []
+        for n in names:
+            path = os.path.join(self.incident_dir, n)
+            row: "dict[str, Any]" = {"name": n}
+            try:
+                row["bytes"] = os.path.getsize(path)
+                with open(path) as f:
+                    doc = json.load(f)
+                row["ts"] = doc.get("ts")
+                row["reason"] = doc.get("reason", [])
+            except (OSError, ValueError):
+                row["reason"] = [{"metric": "(unreadable)"}]
+            rows.append(row)
+        return rows
+
+    def read_incident(self, name: str) -> dict:
+        """One bundle by basename — path-traversal-proof (the name must
+        be exactly a listing entry)."""
+        base = os.path.basename(name)
+        if not (base.startswith("incident-") and base.endswith(".json")):
+            raise ValueError(f"not an incident bundle name: {name!r}")
+        with open(os.path.join(self.incident_dir, base)) as f:
+            return json.load(f)
+
+
+def validate_incident(doc: Any) -> "list[str]":
+    """Schema check for one incident bundle — same stance as the trace
+    module's ``validate_chrome_trace``: an empty list means the bundle
+    holds everything a postmortem consumer may rely on."""
+    errs: "list[str]" = []
+    if not isinstance(doc, dict):
+        return ["bundle is not an object"]
+    if doc.get("schema") != SCHEMA:
+        errs.append(f"schema is {doc.get('schema')!r}, want {SCHEMA!r}")
+    if not isinstance(doc.get("ts"), (int, float)):
+        errs.append("ts missing or non-numeric")
+    reason = doc.get("reason")
+    if not isinstance(reason, list) or not reason:
+        errs.append("reason missing or empty")
+    else:
+        for i, r in enumerate(reason):
+            if not isinstance(r, dict) or "metric" not in r \
+                    or not isinstance(r.get("p99_s"), (int, float)):
+                errs.append(f"reason[{i}] lacks metric/p99_s")
+    if not isinstance(doc.get("slo_ms"), int):
+        errs.append("slo_ms missing")
+    if not isinstance(doc.get("folded_stacks"), str):
+        errs.append("folded_stacks missing (must be a string)")
+    locks = doc.get("locks")
+    if not isinstance(locks, dict) or not isinstance(
+            locks.get("live"), list) \
+            or not isinstance(locks.get("wait_hold"), dict):
+        errs.append("locks.live / locks.wait_hold missing")
+    if not isinstance(doc.get("rpc"), dict):
+        errs.append("rpc snapshot missing")
+    hb = doc.get("heartbeat")
+    if not isinstance(hb, dict) or "seconds" not in hb \
+            or "phases" not in hb:
+        errs.append("heartbeat snapshot missing seconds/phases")
+    if not isinstance(doc.get("spans"), list):
+        errs.append("spans missing (must be a list)")
+    return errs

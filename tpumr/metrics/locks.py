@@ -42,6 +42,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from typing import Any
 
 #: canonical lock ranks (ascending = legal acquisition order). The
@@ -71,6 +72,38 @@ def _held_stack() -> "list[InstrumentedRLock]":
     return s
 
 
+#: every NAMED InstrumentedRLock self-registers here so live-state pages
+#: (/threads) and incident bundles can enumerate the master's lock
+#: classes without threading a list through every constructor. Weak so
+#: per-job locks die with their JobInProgress.
+_named_locks: "weakref.WeakSet[InstrumentedRLock]" = weakref.WeakSet()
+
+
+def lock_table(now: "float | None" = None) -> "list[dict[str, Any]]":
+    """Live holder/waiter rows for every named instrumented lock, sorted
+    by (rank, name) — the "is it deadlocked right now" view. Lock-free
+    read of racy-by-design fields: a row may be a few microseconds
+    stale, which is exactly good enough for a human or a postmortem
+    bundle (the alternative — taking each lock to report on it — would
+    make the reporter a contender)."""
+    if now is None:
+        now = time.monotonic()
+    rows = []
+    for lk in list(_named_locks):
+        holder = lk._holder          # racy read: grab one reference
+        waiters = list(lk._waiters.values())
+        rows.append({
+            "name": lk.name, "rank": lk.rank,
+            "holder": holder[0] if holder else None,
+            "held_for_s": round(now - holder[1], 6) if holder else None,
+            "waiters": sorted(w[0] for w in waiters),
+            "longest_wait_s": round(
+                max((now - w[1] for w in waiters), default=0.0), 6),
+        })
+    rows.sort(key=lambda r: (r["rank"], r["name"]))
+    return rows
+
+
 class InstrumentedRLock:
     """A re-entrant lock recording acquisition wait and outermost hold
     durations into histograms, optionally participating in the master's
@@ -82,8 +115,15 @@ class InstrumentedRLock:
     outermost release records hold — nested ``with`` blocks must not
     turn one hold into N overlapping observations. Histograms may be
     bound after construction (:meth:`bind`) so the lock can exist
-    before the metrics registry does; unbound and unranked, it costs
-    one thread-local read over a plain RLock (no clock calls).
+    before the metrics registry does.
+
+    Named locks additionally publish LIVE state — who holds me, since
+    when, who is queued — via :func:`lock_table` (/threads, incident
+    bundles). The bookkeeping is deliberately lock-free: the holder
+    field is one GIL-atomic tuple store per outermost acquire/release,
+    and only a caller that LOST the uncontended try-acquire ever
+    touches the waiter dict, so the uncontended path costs two clock
+    reads and never a second lock.
     """
 
     def __init__(self, wait_hist: Any = None, hold_hist: Any = None,
@@ -94,6 +134,13 @@ class InstrumentedRLock:
         self.name = name
         self.rank = int(rank)
         self._tl = threading.local()
+        #: (thread name, monotonic since) of the current outermost
+        #: holder, or None — racy by design, read by lock_table()
+        self._holder: "tuple[str, float] | None" = None
+        #: ident -> (thread name, monotonic since) of blocked acquirers
+        self._waiters: "dict[int, tuple[str, float]]" = {}
+        if name:
+            _named_locks.add(self)
 
     def bind(self, wait_hist: Any, hold_hist: Any) -> "InstrumentedRLock":
         self._wait = wait_hist
@@ -124,27 +171,36 @@ class InstrumentedRLock:
             return ok
         if ORDER_CHECK and self.rank:
             self._assert_order()
-        if self._wait is None:
-            ok = self._lock.acquire(blocking, timeout)
-            if ok:
-                self._tl.depth = 1
-                if self._hold is not None:
-                    self._tl.acquired_at = time.monotonic()
-        else:
-            t0 = time.monotonic()
-            ok = self._lock.acquire(blocking, timeout)
-            if ok:
-                now = time.monotonic()
-                self._wait.observe(now - t0)
-                self._tl.depth = 1
-                self._tl.acquired_at = now
-        if ok and ORDER_CHECK and self.rank:
+        t0 = time.monotonic()
+        # uncontended try first: only a caller that LOSES this race
+        # registers in the waiter table, so the fast path never mutates
+        # shared state beyond the underlying lock itself
+        ok = self._lock.acquire(False)
+        if not ok:
+            if not blocking:
+                return False
+            ident = threading.get_ident()
+            self._waiters[ident] = (threading.current_thread().name, t0)
+            try:
+                ok = self._lock.acquire(True, timeout)
+            finally:
+                self._waiters.pop(ident, None)
+            if not ok:
+                return False
+        now = time.monotonic()
+        if self._wait is not None:
+            self._wait.observe(now - t0)
+        self._tl.depth = 1
+        self._tl.acquired_at = now
+        self._holder = (threading.current_thread().name, now)
+        if ORDER_CHECK and self.rank:
             _held_stack().append(self)
-        return ok
+        return True
 
     def release(self) -> None:
         depth = getattr(self._tl, "depth", 0)
         if depth == 1:
+            self._holder = None
             if self._hold is not None:
                 t0 = getattr(self._tl, "acquired_at", None)
                 if t0 is not None:

@@ -1,0 +1,638 @@
+"""Continuous in-process profiler: stack sampling, subsystem CPU
+attribution, and a GIL-contention proxy — stdlib only.
+
+After the lock decomposition the master saturates on one Python
+process's throughput (the GIL), not on locking — and nothing in the
+tree says WHERE that CPU goes, so the sharded-master boundary (ROADMAP)
+would be chosen blind. The reference's answer was offline profiling of
+dev clusters; ours is a daemon thread that samples every live thread's
+stack via ``sys._current_frames()`` at ``tpumr.prof.hz`` (default 19 —
+deliberately co-prime with the 1 Hz heartbeat cadence and common 10/100
+ms timer grids, so periodic work can't hide between samples), folds the
+frames into a bounded trie, and classifies every sample into a
+subsystem (reactor loop, rpc handler pool, heartbeat fold/assign,
+history/deferred I/O, shuffle, merger, other) so ``cpu_share``
+gauges land in the owning daemon's MetricsRegistry and ``/metrics/prom``.
+
+The GIL itself is measured by proxy: a sentinel thread sleeps 5 ms in a
+loop and observes its scheduling OVERSHOOT (wakeup lateness) into a
+``gil_delay_seconds`` histogram. A healthy process wakes the sentinel
+within a few hundred µs; a GIL convoy (one thread holding the
+interpreter through its switch interval while runnable threads queue)
+shows up directly as overshoot p99 — the cheapest honest contention
+signal a pure-Python process can produce about itself.
+
+Costs are measured, not asserted: the sampler times its own passes and
+publishes ``prof_overhead_share`` (fraction of one core it consumes),
+and excludes its own two threads from every sample.
+
+HTTP surface (``attach_http``): ``/stacks?seconds=N`` returns
+flamegraph-compatible collapsed folded-stack text (``a;b;c count``,
+rooted at the thread name), ``/flame?seconds=N`` a self-contained SVG
+flame graph (same in-repo-SVG approach as the trace swimlane);
+``/threads`` (served by StatusHttpServer on every daemon, sampler or
+not) is the one-shot dump with InstrumentedRLock holder/waiter
+annotations.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+from tpumr.metrics.core import MetricsRegistry
+
+#: sentinel sleep quantum — small enough to sample scheduling latency
+#: many times per second, large enough that the sentinel itself stays
+#: far below 1% of a core
+SENTINEL_SLEEP_S = 0.005
+
+#: stacks deeper than this truncate at the root end — a runaway
+#: recursion must not make one sample allocate unboundedly
+MAX_STACK_DEPTH = 64
+
+#: the canonical subsystem labels every sample classifies into (the
+#: bench's cpu_share columns group these further)
+SUBSYSTEMS = ("reactor", "rpc", "fold", "assign", "history",
+              "shuffle", "merger", "other")
+
+#: ordered (module prefix, function prefixes, subsystem): the FIRST
+#: table row matching any frame, walking the stack innermost-out, wins
+#: — so a heartbeat that is currently inside the scheduler pass counts
+#: as "assign" (the scheduler module frame is deeper) while the fold
+#: loop around it counts as "fold".
+_MODULE_TABLE: "tuple[tuple[str, tuple, str], ...]" = (
+    ("tpumr.mapred.scheduler", (), "assign"),
+    ("tpumr.mapred.jobtracker", ("heartbeat", "_heartbeat"), "fold"),
+    ("tpumr.mapred.history", (), "history"),
+    ("tpumr.mapred.shuffle_copier", (), "shuffle"),
+    ("tpumr.mapred.fetch_batcher", (), "shuffle"),
+    ("tpumr.mapred.device_shuffle", (), "shuffle"),
+    ("tpumr.io.merger", (), "merger"),
+)
+
+#: thread-name roles, consulted when no module frame matched: the
+#: reactor spends its life in the selector/dispatch loop (ipc.rpc
+#: frames, which deliberately have NO module-table row so handler-pool
+#: work doesn't masquerade as reactor time), the pool threads own
+#: everything dispatched into daemon code the table doesn't name
+_THREAD_ROLES: "tuple[tuple[str, str], ...]" = (
+    ("rpc-reactor", "reactor"),
+    ("rpc-handler", "rpc"),
+    ("rpc-server", "rpc"),
+    ("shuffle-inmem-merger", "merger"),
+    ("shuffle-disk-merger", "merger"),
+    ("shuffle-copier", "shuffle"),
+)
+
+
+#: idle-leaf detection (the py-spy approach): a sample whose INNERMOST
+#: frame is a known blocking call is parked, not burning CPU. Idle
+#: samples stay in the folded stacks (the wait is the interesting fact
+#: when diagnosing a hang) but are excluded from cpu_share — counting
+#: them would measure thread population, not CPU (a daemon has dozens
+#: of parked threads per busy one). C-level blocking (socket recv,
+#: time.sleep) shows the CALLER as the leaf, so the repo's own blocking
+#: read helpers are named here alongside the stdlib wait primitives.
+_IDLE_LEAF_MODULES = ("selectors", "socketserver")
+_IDLE_LEAVES = frozenset((
+    ("threading", "wait"), ("threading", "_wait_for_tstate_lock"),
+    ("threading", "join"),
+    ("queue", "get"), ("queue", "put"),
+    ("concurrent.futures.thread", "_worker"),
+))
+_IDLE_LEAF_FUNCS = frozenset(
+    ("select", "poll", "accept", "_read_exact", "_fill"))
+
+
+def is_idle(stack: "tuple[str, ...]") -> bool:
+    """True when the innermost frame of a sampled stack (labels
+    root-first, ``module:function``) is a known blocking call."""
+    if not stack:
+        return True
+    mod, _, func = stack[-1].partition(":")
+    return (mod in _IDLE_LEAF_MODULES
+            or (mod, func) in _IDLE_LEAVES
+            or func in _IDLE_LEAF_FUNCS)
+
+
+def classify(stack: "tuple[str, ...]", thread_name: str) -> str:
+    """Subsystem for one sampled stack (labels root-first,
+    ``module:function``). Reactor wins by thread identity — its
+    dispatch loop must never be attributed to the code it dispatches."""
+    if thread_name.startswith("rpc-reactor"):
+        return "reactor"
+    for label in reversed(stack):
+        mod, _, func = label.partition(":")
+        for mprefix, funcs, sub in _MODULE_TABLE:
+            if mod.startswith(mprefix) and (
+                    not funcs or func.startswith(funcs)):
+                return sub
+    for prefix, sub in _THREAD_ROLES:
+        if thread_name.startswith(prefix):
+            return sub
+    return "other"
+
+
+class StackTrie:
+    """Bounded prefix tree of sampled stacks. Each ``add`` walks the
+    stack root-first, creating nodes up to ``max_nodes``; past the
+    budget, unseen branches collapse into a per-level ``(other)`` child
+    and the stack truncates there — memory stays bounded no matter how
+    pathological the code under the profiler is, and the overflow is
+    visible in the output rather than silently dropped."""
+
+    OTHER = "(other)"
+
+    def __init__(self, max_nodes: int = 20000) -> None:
+        self.max_nodes = int(max_nodes)
+        self.nodes = 0
+        #: label -> [leaf_count, children_dict]
+        self.root: "dict[str, list]" = {}
+
+    def add(self, stack: "tuple[str, ...]") -> "tuple[str, ...]":
+        """Record one sample; returns the canonical stack actually
+        stored (identical to the input unless the node budget forced a
+        ``(other)`` truncation)."""
+        out: "list[str]" = []
+        children = self.root
+        node = None
+        for label in stack:
+            nd = children.get(label)
+            if nd is None:
+                if self.nodes >= self.max_nodes:
+                    nd = children.get(self.OTHER)
+                    if nd is None:
+                        # the overflow child is always grantable: one
+                        # per existing node bounds the total at 2x
+                        nd = children[self.OTHER] = [0, {}]
+                        self.nodes += 1
+                    out.append(self.OTHER)
+                    node = nd
+                    break
+                nd = children[label] = [0, {}]
+                self.nodes += 1
+            out.append(label)
+            node = nd
+            children = nd[1]
+        if node is not None:
+            node[0] += 1
+        return tuple(out)
+
+    def folded(self) -> "list[tuple[tuple[str, ...], int]]":
+        """Lifetime (stack, count) pairs for every stack observed."""
+        out: "list[tuple[tuple[str, ...], int]]" = []
+
+        def walk(children: dict, prefix: "tuple[str, ...]") -> None:
+            for label, (count, kids) in children.items():
+                path = prefix + (label,)
+                if count:
+                    out.append((path, count))
+                walk(kids, path)
+
+        walk(self.root, ())
+        return out
+
+
+def parse_folded(text: str) -> "list[tuple[tuple[str, ...], int]]":
+    """Inverse of the collapsed folded-stack rendering: ``a;b;c N``
+    lines back into (stack, count) pairs (blank lines skipped)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        path, _, count = line.rpartition(" ")
+        out.append((tuple(path.split(";")), int(count)))
+    return out
+
+
+def render_folded(pairs: "list[tuple[tuple[str, ...], int]]") -> str:
+    return "\n".join(f"{';'.join(stack)} {count}"
+                     for stack, count in sorted(pairs)) + (
+                         "\n" if pairs else "")
+
+
+class StackSampler:
+    """The continuous profiler: one sampling thread + one GIL sentinel.
+
+    Samples land in three places — a bounded :class:`StackTrie`
+    (lifetime aggregate), a time-pruned window of per-tick samples
+    (``/stacks?seconds=N`` queries), and per-subsystem rolling totals
+    feeding the ``cpu_share`` gauges. All three mutate under one plain
+    lock held for microseconds per tick; HTTP readers take the same
+    lock, never the daemon's."""
+
+    def __init__(self, hz: int = 19, window_s: float = 120.0,
+                 max_trie_nodes: int = 20000,
+                 registry: "MetricsRegistry | None" = None) -> None:
+        self.hz = max(1, int(hz))
+        self.window_s = float(window_s)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry("prof")
+        self.trie = StackTrie(max_trie_nodes)
+        self._lock = threading.Lock()
+        #: deque-ish list of (monotonic ts, [(ident, tname, stack,
+        #: subsystem)], {subsystem: busy count}) ticks inside the
+        #: window; list+del beats deque here because pruning is
+        #: amortized batch work. Entry tuples are SHARED across ticks
+        #: while a thread stays parked (see _frame_cache) so a
+        #: fleet-scale window holds millions of references but only
+        #: thousands of tuples — without the sharing, allocation + GC
+        #: scan cost of the window dominates the profiler's overhead.
+        self._ticks: "list[tuple[float, list, dict]]" = []
+        self._sub_totals: "dict[str, int]" = {s: 0 for s in SUBSYSTEMS}
+        self._total = 0
+        self._busy_s = 0.0
+        self._started_at = 0.0
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._sentinel: "threading.Thread | None" = None
+        self._own_idents: "set[int]" = set()
+        #: code object -> "module:function" — frame labeling without a
+        #: per-frame f_globals lookup + string build (the dominant cost
+        #: of a sampling pass once a process has hundreds of threads)
+        self._label_cache: "dict[Any, str]" = {}
+        #: ident -> ((id(frame), f_lasti), entry, sub) where entry is
+        #: the shared (ident, tname, stack, sub) tuple: a thread whose
+        #: leaf frame object AND instruction pointer are unchanged since
+        #: the last tick is parked in the same place — reuse last tick's
+        #: walk AND its entry tuple instead of re-walking/re-allocating
+        #: (a frame's f_back chain is immutable for its lifetime, so an
+        #: unchanged leaf implies an unchanged label stack). On a
+        #: fleet-scale daemon ~99% of threads hit this cache every tick;
+        #: without it sampling cost scales with thread COUNT instead of
+        #: thread ACTIVITY.
+        self._frame_cache: "dict[int, tuple]" = {}
+        #: ident -> thread name; threading.enumerate() walks a lock and
+        #: two properties per thread, so it only reruns when an unknown
+        #: ident shows up (or the cache holds mostly-dead idents)
+        self._name_cache: "dict[int, str]" = {}
+        self.gil_delay = self.registry.histogram("gil_delay_seconds")
+        for sub in SUBSYSTEMS:
+            self.registry.set_gauge(f"cpu_share|subsystem={sub}",
+                                    lambda s=sub: self._share(s))
+        self.registry.set_gauge("prof_overhead_share", self._overhead)
+
+    # ------------------------------------------------------------ wiring
+
+    @classmethod
+    def from_conf(cls, conf: Any,
+                  metrics: Any = None) -> "StackSampler | None":
+        """The daemon entry point: None when ``tpumr.prof.enabled`` is
+        off (the default — profiling is opt-in), else a ready-to-start
+        sampler whose registry is registered into ``metrics`` (a
+        MetricsSystem) when one is given."""
+        from tpumr.core import confkeys
+        if not confkeys.get_boolean(conf, "tpumr.prof.enabled"):
+            return None
+        sampler = cls(
+            hz=confkeys.get_int(conf, "tpumr.prof.hz"),
+            window_s=confkeys.get_float(conf, "tpumr.prof.window.s"),
+            max_trie_nodes=confkeys.get_int(
+                conf, "tpumr.prof.trie.max.nodes"))
+        if metrics is not None:
+            metrics.register(sampler.registry)
+        return sampler
+
+    def start(self) -> "StackSampler":
+        if self._thread is not None:
+            return self
+        self._started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, name="prof-sampler", daemon=True)
+        self._sentinel = threading.Thread(
+            target=self._sentinel_loop, name="prof-gil-sentinel",
+            daemon=True)
+        self._thread.start()
+        self._sentinel.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in (self._thread, self._sentinel):
+            if t is not None:
+                t.join(timeout=2.0)
+        self._thread = self._sentinel = None
+
+    # ------------------------------------------------------------ loops
+
+    def _loop(self) -> None:
+        self._own_idents.add(threading.get_ident())
+        period = 1.0 / self.hz
+        next_t = time.monotonic()
+        while not self._stop.is_set():
+            next_t += period
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                if self._stop.wait(delay):
+                    return
+            else:
+                # fell behind (suspend, GIL convoy): resync instead of
+                # bursting to catch up — burst samples are biased
+                next_t = time.monotonic()
+            self._sample_once()
+
+    def _sentinel_loop(self) -> None:
+        self._own_idents.add(threading.get_ident())
+        observe = self.gil_delay.observe
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            time.sleep(SENTINEL_SLEEP_S)
+            overshoot = time.monotonic() - t0 - SENTINEL_SLEEP_S
+            if overshoot > 0:
+                observe(overshoot)
+
+    def _walk(self, frame: Any) -> "tuple[str, ...]":
+        labels: "list[str]" = []
+        cache = self._label_cache
+        f = frame
+        while f is not None and len(labels) < MAX_STACK_DEPTH:
+            code = f.f_code
+            label = cache.get(code)
+            if label is None:
+                if len(cache) > 100_000:   # runaway dynamic code
+                    cache.clear()
+                mod = f.f_globals.get("__name__", "?")
+                label = cache[code] = f"{mod}:{code.co_name}"
+            labels.append(label)
+            f = f.f_back
+        labels.reverse()
+        return tuple(labels)
+
+    def _sample_once(self) -> None:
+        t0 = time.monotonic()
+        frames = sys._current_frames()
+        own = self._own_idents
+        cache = self._frame_cache
+        names = self._name_cache
+        if (len(names) > len(frames) + 64
+                or any(i not in names for i in frames)):
+            names = self._name_cache = {
+                t.ident: t.name for t in threading.enumerate()
+                if t.ident is not None}
+        entries: "list[tuple[int, str, tuple, str]]" = []
+        tick_subs: "dict[str, int]" = {}
+        busy = 0
+        with self._lock:
+            for ident, frame in frames.items():
+                if ident in own:
+                    continue
+                key = (id(frame), frame.f_lasti)
+                hit = cache.get(ident)
+                if hit is not None and hit[0] == key:
+                    _, entry, sub = hit
+                else:
+                    tname = names.get(ident) or f"tid-{ident}"
+                    stack = self.trie.add(self._walk(frame))
+                    # sub=None marks a parked thread: kept in the folded
+                    # output, excluded from the cpu_share totals
+                    sub = (None if is_idle(stack)
+                           else classify(stack, tname))
+                    entry = (ident, tname, stack, sub)
+                    cache[ident] = (key, entry, sub)
+                entries.append(entry)
+                if sub is not None:
+                    tick_subs[sub] = tick_subs.get(sub, 0) + 1
+                    busy += 1
+            if len(cache) > len(entries) + len(own):
+                for ident in [i for i in cache if i not in frames]:
+                    del cache[ident]
+            now = time.monotonic()
+            self._ticks.append((now, entries, tick_subs))
+            for sub, n in tick_subs.items():
+                self._sub_totals[sub] = self._sub_totals.get(sub, 0) + n
+            self._total += busy
+            self._prune_locked(now)
+            self._busy_s += time.monotonic() - t0
+        self.registry.incr("prof_samples", len(entries))
+
+    def _prune_locked(self, now: float) -> None:
+        cutoff = now - self.window_s
+        drop = 0
+        for ts, _entries, tick_subs in self._ticks:
+            if ts >= cutoff:
+                break
+            drop += 1
+            for sub, n in tick_subs.items():
+                self._sub_totals[sub] -= n
+                self._total -= n
+        if drop:
+            del self._ticks[:drop]
+
+    # ------------------------------------------------------------ reads
+
+    def _share(self, sub: str) -> float:
+        with self._lock:
+            total = self._total
+            return self._sub_totals.get(sub, 0) / total if total else 0.0
+
+    def _overhead(self) -> float:
+        elapsed = time.monotonic() - self._started_at
+        with self._lock:
+            busy = self._busy_s
+        return busy / elapsed if elapsed > 0 else 0.0
+
+    def subsystem_shares(
+            self, seconds: "float | None" = None) -> "dict[str, float]":
+        """Per-subsystem CPU share over the last ``seconds`` (whole
+        window when None), over BUSY samples only (idle-leaf samples
+        don't burn CPU). Shares sum to 1.0 by construction whenever any
+        busy sample exists."""
+        with self._lock:
+            if seconds is None:
+                counts = dict(self._sub_totals)
+                total = self._total
+            else:
+                cutoff = time.monotonic() - float(seconds)
+                counts = {}
+                total = 0
+                for ts, _entries, tick_subs in self._ticks:
+                    if ts < cutoff:
+                        continue
+                    for sub, n in tick_subs.items():
+                        counts[sub] = counts.get(sub, 0) + n
+                        total += n
+        if not total:
+            return {s: 0.0 for s in SUBSYSTEMS}
+        return {s: counts.get(s, 0) / total for s in SUBSYSTEMS}
+
+    def folded(self, seconds: "float | None" = None,
+               thread_prefix: "str | None" = None) -> str:
+        """Collapsed folded-stack text over the last ``seconds`` (whole
+        window when None), each stack rooted at its thread name;
+        ``thread_prefix`` narrows to matching thread names (the
+        tracker's per-attempt view — task threads are ``task-<id>``)."""
+        agg: "dict[tuple[str, ...], int]" = {}
+        with self._lock:
+            cutoff = None if seconds is None \
+                else time.monotonic() - float(seconds)
+            for ts, entries, _subs in self._ticks:
+                if cutoff is not None and ts < cutoff:
+                    continue
+                for _ident, tname, stack, _sub in entries:
+                    if thread_prefix is not None \
+                            and not tname.startswith(thread_prefix):
+                        continue
+                    key = (tname,) + stack
+                    agg[key] = agg.get(key, 0) + 1
+        return render_folded(list(agg.items()))
+
+    def flame_svg(self, seconds: "float | None" = None,
+                  title: str = "tpumr flame graph") -> str:
+        return flame_svg(self.folded(seconds), title=title)
+
+    # ------------------------------------------------------------ http
+
+    def attach_http(self, srv: Any,
+                    attempt_thread_prefix:
+                    "Callable[[str], str] | None" = None) -> None:
+        """Register ``/stacks`` and ``/flame`` on a StatusHttpServer.
+        ``attempt_thread_prefix`` maps an ``attempt=`` query arg to the
+        thread-name prefix running it (tracker in-process attempts)."""
+
+        def _window(q: dict) -> "float | None":
+            return float(q["seconds"]) if "seconds" in q else None
+
+        def _prefix(q: dict) -> "str | None":
+            if attempt_thread_prefix is not None and "attempt" in q:
+                return attempt_thread_prefix(q["attempt"])
+            return None
+
+        def stacks(q: dict) -> str:
+            return self.folded(_window(q), thread_prefix=_prefix(q))
+
+        def flame(q: dict) -> str:
+            return flame_svg(
+                self.folded(_window(q), thread_prefix=_prefix(q)),
+                title=f"{srv.name} flame graph")
+
+        srv.add_raw("stacks", stacks, content_type="text/plain")
+        srv.add_raw("flame", flame, content_type="image/svg+xml")
+
+
+# ---------------------------------------------------------------- /threads
+
+
+def threads_dump() -> str:
+    """One-shot plain-text dump of every live thread's stack, prefixed
+    by the InstrumentedRLock holder/waiter table — the "is it
+    deadlocked right now" page. Needs no sampler and takes no daemon
+    lock: reading ``sys._current_frames`` and the racy lock fields is
+    safe from any thread at any time."""
+    from tpumr.metrics.locks import lock_table
+    out: "list[str]" = []
+    rows = lock_table()
+    out.append("== locks (rank order) ==")
+    if not rows:
+        out.append("(no named instrumented locks)")
+    for r in rows:
+        held = (f"held by {r['holder']} for {r['held_for_s']:.3f}s"
+                if r["holder"] else "free")
+        waiters = (f"; waiters: {', '.join(r['waiters'])} "
+                   f"(longest {r['longest_wait_s']:.3f}s)"
+                   if r["waiters"] else "")
+        out.append(f"{r['name']} (rank {r['rank']}): {held}{waiters}")
+    holder_of: "dict[str, list[str]]" = {}
+    waiting_on: "dict[str, list[str]]" = {}
+    for r in rows:
+        if r["holder"]:
+            holder_of.setdefault(r["holder"], []).append(r["name"])
+        for w in r["waiters"]:
+            waiting_on.setdefault(w, []).append(r["name"])
+    out.append("")
+    out.append("== threads ==")
+    frames = sys._current_frames()
+    threads = {t.ident: t for t in threading.enumerate()}
+    def _key(item):  # stable, named threads first
+        t = threads.get(item[0])
+        return (t.name if t else f"~tid-{item[0]}")
+    for ident, frame in sorted(frames.items(), key=_key):
+        t = threads.get(ident)
+        name = t.name if t else f"tid-{ident}"
+        flags = " daemon" if (t is not None and t.daemon) else ""
+        ann = ""
+        if name in holder_of:
+            ann += f" [holds: {', '.join(holder_of[name])}]"
+        if name in waiting_on:
+            ann += f" [waiting on: {', '.join(waiting_on[name])}]"
+        out.append(f"--- {name} (ident {ident}{flags}){ann}")
+        out.append("".join(traceback.format_stack(frame)).rstrip())
+    return "\n".join(out) + "\n"
+
+
+# ------------------------------------------------------------- flame SVG
+
+_FLAME_ROW_H = 17
+_FLAME_PALETTE = ("#e05038", "#e07038", "#e09038", "#e0b038",
+                  "#d0a030", "#c8883a", "#e06048", "#d07840")
+
+
+def _flame_color(label: str) -> str:
+    return _FLAME_PALETTE[hash(label) % len(_FLAME_PALETTE)]
+
+
+def flame_svg(folded_text: str, title: str = "tpumr flame graph",
+              width: int = 1200) -> str:
+    """A self-contained SVG flame graph from collapsed folded-stack
+    text — no scripts, no external assets, loadable straight from
+    ``/flame`` in any browser (the same in-repo-SVG stance as the trace
+    swimlane: the artifact must render decades from now). Frame width
+    is proportional to sample count; ``<title>`` elements carry the
+    full label + counts for hover inspection."""
+    from html import escape
+    pairs = parse_folded(folded_text)
+    total = sum(c for _s, c in pairs)
+    # fold the flat pairs back into a tree: label -> [count, children]
+    root: "dict[str, list]" = {}
+    maxdepth = 0
+    for stack, count in pairs:
+        children = root
+        maxdepth = max(maxdepth, len(stack))
+        for label in stack:
+            nd = children.get(label)
+            if nd is None:
+                nd = children[label] = [0, {}]
+            nd[0] += count
+            children = nd[1]
+    height = (maxdepth + 1) * _FLAME_ROW_H + 40
+    out = [
+        f"<svg xmlns='http://www.w3.org/2000/svg' width='{width}' "
+        f"height='{height}' font-family='monospace' font-size='11'>",
+        f"<rect width='100%' height='100%' fill='#fffdf7'/>",
+        f"<text x='8' y='16' font-size='13'>{escape(title)} "
+        f"&#8212; {total} samples</text>",
+    ]
+
+    def layout(children: dict, x: float, depth: int) -> None:
+        y = height - (depth + 1) * _FLAME_ROW_H - 8
+        for label, (count, kids) in sorted(
+                children.items(), key=lambda kv: (-kv[1][0], kv[0])):
+            w = count / total * width
+            if w >= 0.4:
+                pct = 100.0 * count / total
+                lab = escape(label)
+                out.append(
+                    f"<g><rect x='{x:.2f}' y='{y}' width='{w:.2f}' "
+                    f"height='{_FLAME_ROW_H - 1}' "
+                    f"fill='{_flame_color(label)}' rx='1'>"
+                    f"<title>{lab} &#8212; {count} samples "
+                    f"({pct:.1f}%)</title></rect>")
+                if w > 40:
+                    shown = escape(label[: max(1, int(w / 7))])
+                    out.append(
+                        f"<text x='{x + 3:.2f}' y='{y + 12}' "
+                        f"fill='#222'>{shown}</text>")
+                out.append("</g>")
+                layout(kids, x, depth + 1)
+            x += w
+
+    if total:
+        layout(root, 0.0, 0)
+    else:
+        out.append(f"<text x='8' y='40'>(no samples in window)</text>")
+    out.append("</svg>")
+    return "\n".join(out)

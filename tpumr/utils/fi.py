@@ -44,6 +44,15 @@ hung-task-reaping loop):
                                  runs; targeted speculation is the
                                  quarry's predator
 
+Observability seams (the flight-recorder / continuous-profiler loop):
+  jt.heartbeat.slow              BEHAVIORAL fault — master heartbeat
+                                 handling stalls ``tpumr.fi.jt.
+                                 heartbeat.slow.ms`` (default 400)
+                                 before the real fold runs, breaching
+                                 the windowed heartbeat p99 SLO; the
+                                 flight recorder's incident bundle is
+                                 the quarry's predator
+
 Control-plane partition seams (``RpcClient`` with ``fi_conf`` set —
 the master-restart / partition-tolerance chaos loop):
   rpc.drop                       the request is lost before the wire
