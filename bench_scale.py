@@ -376,6 +376,59 @@ def run_recovery_bench(fleets: "list[int] | None" = None,
     return rows
 
 
+#: the scenario-lab mixes committed as bench rows: per-class latency
+#: percentiles + chaos counters under a pinned seed (deterministic
+#: traces), so a control-plane change shows its effect on interactive
+#: vs batch SLOs — not just on raw heartbeat percentiles
+SCENARIOS = ["steady_mix", "interactive_burst", "churn_storm",
+             "overload_brownout", "master_failover"]
+SCENARIO_SEED = 1337
+
+
+def _scenario_row(rep: dict) -> dict:
+    """One committed row per mix: the report minus its bulky per-tick
+    window history and replay plan (those live in -report output)."""
+    row = {
+        "scenario": rep["scenario"], "seed": rep["seed"],
+        "wall_s": rep["wall_s"], "pass": rep["pass"],
+        "jobs": rep["jobs"], "chaos": rep["chaos"],
+        "brownout_max_level": rep["brownout_max_level"],
+        "incidents": len(rep["incidents"]),
+        "classes": {},
+    }
+    for cls_name, stats in rep["classes"].items():
+        verdict = rep["verdicts"].get(cls_name, {})
+        row["classes"][cls_name] = dict(stats,
+                                        **{"pass": verdict.get("pass")})
+    return row
+
+
+def run_scenario_bench(names: "list[str] | None" = None,
+                       seed: int = SCENARIO_SEED) -> list:
+    """The scenario series (gated by --assert-scenarios): one row per
+    named mix; a crashed run becomes an error row."""
+    from tpumr.scale.scenario import run_named
+    rows = []
+    for name in names or SCENARIOS:
+        try:
+            rep = run_named(name, seed=seed)
+        except Exception as e:  # noqa: BLE001 — keep the series going
+            log(f"[scale] scenario {name} FAILED: {e}")
+            rows.append({"scenario": name, "error": str(e)})
+            continue
+        row = _scenario_row(rep)
+        rows.append(row)
+        jobs = row["jobs"]
+        log(f"[scale] scenario {name}: "
+            f"{jobs['succeeded']}/{jobs['submitted']} jobs · "
+            f"crashed {row['chaos']['trackers_crashed']} adopted "
+            f"{row['chaos']['trackers_adopted']} restarts "
+            f"{row['chaos']['master_restarts']} · brownout max "
+            f"{row['brownout_max_level']} · "
+            f"{'PASS' if row['pass'] else 'FAIL'} in {row['wall_s']}s")
+    return rows
+
+
 def run_bench(fleets: "list[int] | None" = None,
               interval_s: "float | None" = None,
               slo_s: "float | None" = None,
@@ -449,6 +502,24 @@ def main() -> None:
             prior = json.load(f)
     except (OSError, ValueError):
         pass
+    if "--scenarios-only" in sys.argv:
+        # refresh ONLY the scenario-lab series, preserving the
+        # committed ramp + recovery rows
+        report = prior or {"rows": []}
+        report["scenario_rows"] = run_scenario_bench()
+        with open("bench_scale.json", "w") as f:
+            json.dump(report, f, sort_keys=True, indent=1)
+        passed = sum(1 for r in report["scenario_rows"]
+                     if r.get("pass"))
+        print(json.dumps({
+            "metric": "scenario lab: mixes passing all per-class SLO "
+                      "verdicts under chaos",
+            "value": passed, "unit": "scenarios",
+            "vs_baseline": 1.0}))
+        if "--assert-scenarios" in sys.argv \
+                and passed < len(report["scenario_rows"]):
+            sys.exit(3)
+        return
     if "--recovery-only" in sys.argv:
         # refresh ONLY the master-restart recovery series, preserving
         # the committed ramp rows (the ramp is minutes of measurement;
@@ -465,9 +536,11 @@ def main() -> None:
             "unit": "s", "vs_baseline": 1.0}))
         return
     report = run_bench()
-    # the recovery series rides every run (non-gating; the --assert-slo
-    # gate below judges only the ramp rows)
+    # the recovery + scenario series ride every run (the --assert-slo
+    # gate below judges only the ramp rows; --assert-scenarios gates
+    # the scenario series)
     report["recovery_rows"] = run_recovery_bench()
+    report["scenario_rows"] = run_scenario_bench()
     with open("bench_scale.json", "w") as f:
         json.dump(report, f, sort_keys=True, indent=1)
     log(f"detail rows -> bench_scale.json: "
@@ -512,6 +585,13 @@ def main() -> None:
                     f"{row['trackers']} trackers: cpu_share_* sums to "
                     f"{s:.3f}, expected ~1.0")
                 sys.exit(3)
+    if "--assert-scenarios" in sys.argv:
+        bad = [r.get("scenario", "?")
+               for r in report.get("scenario_rows", [])
+               if not r.get("pass")]
+        if bad:
+            log(f"[scale] SCENARIO VERDICTS FAILED: {bad}")
+            sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -908,6 +908,8 @@ def cmd_simulate(conf, argv: list[str]) -> int:
     from tpumr.scale import ScaleDriver, SimFleet
     from tpumr.security import rpc_secret
     a = _kv_args(argv)
+    if "scenario" in a:
+        return _simulate_scenario(conf, a)
     n = int(a.get("trackers", 25))
     n_jobs = int(a.get("jobs", 4))
     maps = int(a.get("maps", 64))
@@ -978,6 +980,78 @@ def cmd_simulate(conf, argv: list[str]) -> int:
         driver.close()
         if master is not None:
             master.stop()
+
+
+def _simulate_scenario(conf, a: "dict[str, str]") -> int:
+    """``simulate -scenario NAME`` — replay one scenario-lab mix
+    (tpumr/scale/scenario.py) and gate on its per-class SLO verdicts.
+    ``-seed S`` overrides the spec's seed, ``-report PATH`` writes the
+    full machine-readable report there (stdout then carries a short
+    verdict summary instead), ``-incidents DIR`` keeps history +
+    incident bundles under DIR even on success."""
+    from tpumr.core import confkeys
+    from tpumr.scale.scenario import ScenarioError, run_named
+    seed = int(a["seed"]) if "seed" in a else None
+    scenario_dir = a.get("dir") \
+        or confkeys.get(conf, "tpumr.scenario.dir")
+    try:
+        rep = run_named(a["scenario"], seed=seed,
+                        scenario_dir=scenario_dir,
+                        artifacts_dir=a.get("incidents"))
+    except ScenarioError as e:
+        print(f"scenario error: {e}", file=sys.stderr)
+        return 2
+    doc = json.dumps(rep, indent=2, sort_keys=True)
+    if "report" in a:
+        with open(a["report"], "w") as f:
+            f.write(doc + "\n")
+        jobs = rep["jobs"]
+        print(f"scenario {rep['scenario']} seed {rep['seed']}: "
+              f"{jobs['succeeded']}/{jobs['submitted']} jobs, "
+              f"{jobs['failed']} failed, {jobs['unfinished']} "
+              f"unfinished, wall {rep['wall_s']}s -> {a['report']}")
+        for cls_name, row in sorted(rep["verdicts"].items()):
+            print(f"  class {cls_name}: "
+                  f"{'PASS' if row.get('pass') else 'FAIL'}")
+        print(f"  overall: {'PASS' if rep['pass'] else 'FAIL'}")
+    else:
+        print(doc)
+    return 0 if rep["pass"] else 1
+
+
+def cmd_scenario(conf, argv: list[str]) -> int:
+    """Scenario-lab catalog / runner:
+
+    - ``scenario -list`` — the built-in mixes plus any ``*.toml`` specs
+      under ``tpumr.scenario.dir`` (or ``-dir DIR``).
+    - ``scenario NAME [-seed S] [-report PATH] [-incidents DIR]`` —
+      replay one (same as ``simulate -scenario NAME``).
+    """
+    from tpumr.core import confkeys
+    if argv and argv[0].lstrip("-") == "list":
+        from tpumr.scale.scenario import list_scenarios
+        a = _kv_args(argv[1:])
+        scenario_dir = a.get("dir") \
+            or confkeys.get(conf, "tpumr.scenario.dir")
+        for row in list_scenarios(scenario_dir):
+            if "error" in row:
+                print(f"{row['name']}  [{row['origin']}]  "
+                      f"ERROR: {row['error']}")
+                continue
+            chaos = ",".join(row["chaos"]) or "none"
+            print(f"{row['name']}  [{row['origin']}]  "
+                  f"jobs={row['jobs']} classes="
+                  f"{','.join(row['classes'])} chaos={chaos} "
+                  f"trace={row['trace_s']:.1f}s")
+        return 0
+    if argv and not argv[0].startswith("-"):
+        a = _kv_args(argv[1:])
+        a["scenario"] = argv[0]
+        return _simulate_scenario(conf, a)
+    print("usage: tpumr scenario -list | "
+          "tpumr scenario NAME [-seed S] [-report PATH]",
+          file=sys.stderr)
+    return 2
 
 
 def cmd_distcp(conf, argv: list[str]) -> int:
@@ -1379,6 +1453,7 @@ COMMANDS = {
     "failmon": cmd_failmon,
     "gridmix": cmd_gridmix,
     "simulate": cmd_simulate,
+    "scenario": cmd_scenario,
     "archive": cmd_archive,
     "rumen": cmd_rumen,
     "examples": cmd_examples,

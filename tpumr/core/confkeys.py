@@ -324,6 +324,22 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Reject unsigned callers once ACLs are on."),
     _K('tpumr.block.access.lifetime.s', 'float', 3600.0,
         "NameNode-minted block access stamp lifetime, seconds."),
+    _K('tpumr.brownout.cadence.factor', 'float', 3.0,
+        "Brownout heartbeat-cadence stretch multiplier while the "
+        "'cadence' shed step is active (capped at the instructed max)."),
+    _K('tpumr.brownout.dwell.ms', 'int', 3000,
+        "Min ms between brownout level transitions — one step per "
+        "dwell, so shedding ramps instead of slamming."),
+    _K('tpumr.brownout.enabled', 'bool', False,
+        "Master brownout mode: under sustained SLO pressure the master "
+        "sheds deferrable load in ranked steps (trace sampling -> "
+        "heartbeat cadence -> speculation + history I/O)."),
+    _K('tpumr.brownout.engage.ticks', 'int', 3,
+        "Consecutive breached flight-recorder windows before the "
+        "brownout steps up one level."),
+    _K('tpumr.brownout.release.ticks', 'int', 3,
+        "Consecutive clear flight-recorder windows before the brownout "
+        "steps back down one level."),
     _K('tpumr.cache.dir', 'str', None,
         "Distributed-cache local materialization root."),
     _K('tpumr.cache.executables', 'str', '',
@@ -545,6 +561,15 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
     _K('tpumr.scheduler.mode', 'str', 'shirahata',
         "'shirahata' slot split or 'minimize' (the f(x,y) makespan "
         "search)."),
+    _K('tpumr.scenario.class', 'str', None,
+        "Traffic class tag on a submitted job (scenario lab): keys the "
+        "per-class latency percentiles and SLO verdicts."),
+    _K('tpumr.scenario.dir', 'str', None,
+        "Directory of operator-authored *.toml scenario specs for "
+        "'tpumr scenario -list' / 'tpumr simulate -scenario'."),
+    _K('tpumr.scenario.name', 'str', None,
+        "Active scenario name on the master; stamped into flight-"
+        "recorder incident bundles as workload context."),
     _K('tpumr.security.authorization', 'bool', False,
         "Service-level authorization (policy file) master switch."),
     _K('tpumr.shuffle.batch.bytes', 'int', 8 << 20,
@@ -714,6 +739,9 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
     _K('tpumr.fi.*', 'str', None,
         "Per-seam fault-injection knobs: tpumr.fi.<point>.probability / "
         ".max.failures (docs/OPERATIONS.md lists the seams).", pattern=True),
+    _K('tpumr.scenario.slo.*', 'str', None,
+        "Per-traffic-class latency SLOs (scenario lab): "
+        "tpumr.scenario.slo.<class>.{assign,complete}.ms.", pattern=True),
     _K('tpumr.user.groups.*', 'str', None,
         "Static user->groups mapping entries.", pattern=True),
 )

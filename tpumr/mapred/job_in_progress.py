@@ -21,6 +21,7 @@ to the hybrid scheduler are carried exactly:
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from dataclasses import dataclass, field, replace
@@ -219,6 +220,20 @@ class JobInProgress:
         # JobMaster.set_job_priority (hadoop job -set-priority)
         self.priority = normalize_priority(
             confkeys.get(self.conf, "mapred.job.priority"))
+        # scenario lab: a job tagged with a traffic class gets per-class
+        # submit→first-assignment / submit→complete latency series on
+        # the master, which the flight recorder windows into per-class
+        # SLO verdicts. Sanitized: the tag becomes a metric label.
+        cls = str(confkeys.get(self.conf, "tpumr.scenario.class") or "")
+        self.traffic_class = re.sub(r"[^a-z0-9_]", "_",
+                                    cls.lower())[:24]
+        self.submit_mono = time.monotonic()
+        self.first_assign_mono: "float | None" = None
+        #: master brownout: True pauses speculative scans for this job
+        #: (stamped at submit while shedding, flipped on running jobs
+        #: at level transitions; speculation is pure opportunism and
+        #: the first deferrable scheduler cost)
+        self.speculation_hold = False
         self.error = ""
 
         self.maps = [TaskInProgress(TaskID(job_id, True, i), i, split=s)
@@ -706,7 +721,7 @@ class JobInProgress:
         the job's completed-runtime distribution AND it sits on the
         estimated critical path, under a concurrent-speculation cap.
         Caller holds self.lock."""
-        if not self.speculative:
+        if not self.speculative or self.speculation_hold:
             return None
         if run_on_tpu and self.tpu_disabled:
             return None
@@ -888,7 +903,8 @@ class JobInProgress:
         promote-on-commit makes the race safe). Same progress-gap rule
         as maps (and the same targeted/blanket split as the map pass).
         Caller holds ``self.lock``."""
-        if not self.speculative_reduces or self.finished_reduces == 0:
+        if not self.speculative_reduces or self.speculation_hold \
+                or self.finished_reduces == 0:
             return None
         mean = self._reduce_time_sum / self.finished_reduces
         factor = confkeys.get_float(

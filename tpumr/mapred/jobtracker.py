@@ -551,9 +551,34 @@ class JobMaster:
         # shed-oldest counter rides the same scrape as everything else
         self._mreg.set_gauge("trace_spans_dropped",
                              lambda: self.tracer.dropped)
+        # master brownout (mapred/brownout.py): None unless
+        # tpumr.brownout.enabled. The flight recorder's tick drives it;
+        # every deferrable path consults it lock-free. Level + counters
+        # ride the scrape so operators see sheds as they happen.
+        from tpumr.mapred.brownout import BrownoutController
+        self.brownout = BrownoutController.from_conf(conf)
+        if self.brownout is not None:
+            _b = self.brownout
+            self._mreg.set_gauge("brownout_level", lambda: _b.level)
+            self._mreg.set_gauge("brownout_step_ups",
+                                 lambda: _b.step_ups)
+            self._mreg.set_gauge("brownout_step_downs",
+                                 lambda: _b.step_downs)
+            self._mreg.set_gauge("brownout_events_shed",
+                                 lambda: _b.events_shed)
+        # scenario lab: the active scenario's name (stamped into the
+        # master conf by the scenario runner) annotates incident bundles
+        self.scenario_name = str(confkeys.get(
+            conf, "tpumr.scenario.name") or "")
+        #: per-traffic-class latency histograms keyed (kind, class),
+        #: created lazily at first observation; the flight recorder
+        #: windows them into online per-class SLO verdicts
+        self._class_hists: "dict[tuple[str, str], Any]" = {}
         # continuous profiler + flight recorder (both None unless
-        # tpumr.prof.enabled): where the master's CPU goes, and an
-        # automatic postmortem bundle when the heartbeat SLO breaches
+        # tpumr.prof.enabled — the recorder alone also comes up under
+        # tpumr.brownout.enabled, stacks-less, to drive the brownout):
+        # where the master's CPU goes, and an automatic postmortem
+        # bundle when an SLO breaches
         from tpumr.metrics.flightrec import FlightRecorder
         from tpumr.metrics.sampler import StackSampler
         self.sampler = StackSampler.from_conf(conf, self.metrics)
@@ -1343,6 +1368,12 @@ class JobMaster:
             import random as _random
             rate = trace_sample_rate(
                 conf_dict if SAMPLE_KEY in conf_dict else self.conf)
+            if self.brownout is not None \
+                    and self.brownout.sheds("trace"):
+                # brownout level 1+: new jobs go untraced regardless of
+                # the configured rate — span buffers and journal I/O
+                # are the cheapest deferrable cost on the master
+                rate = 0.0
             if rate < 1.0 and _random.random() >= rate:
                 want_trace = False
                 conf_dict.pop(TRACE_ID_KEY, None)
@@ -1384,6 +1415,14 @@ class JobMaster:
         # JobInProgress construction resolves split racks (may exec the
         # topology script) — built outside the master lock
         jip = JobInProgress(job_id, conf_dict, splits)
+        if self.brownout is not None \
+                and self.brownout.sheds("speculation"):
+            # jobs born while the master is shedding start with
+            # speculation paused; released on step-down with the rest
+            jip.speculation_hold = True
+        if jip.traffic_class:
+            self._mreg.incr(
+                f"class_jobs_submitted|class={jip.traffic_class}")
         if pipe is not None:
             # FIFO anchor: every stage of one pipeline sorts at the
             # PIPELINE's submit time, so a late stage never queues
@@ -1802,6 +1841,17 @@ class JobMaster:
         try:
             self.history.job_finished(jip)
             self._mreg.incr(f"jobs_{jip.state.lower()}")
+            if jip.traffic_class:
+                # scenario lab: submit→complete latency by traffic
+                # class — successful runs only (a fast failure must
+                # not flatter the completion SLO), failures counted
+                if jip.state == JobState.SUCCEEDED:
+                    self._class_observe(
+                        "complete", jip.traffic_class,
+                        time.monotonic() - jip.submit_mono)
+                else:
+                    self._mreg.incr(f"class_jobs_failed|class="
+                                    f"{jip.traffic_class}")
             # per-job stats rollup (metrics-<jobid>.json next to the
             # history log): counters + latency percentiles + the
             # TPU/CPU task-time split — what `tpumr job stats` prints
@@ -2346,13 +2396,51 @@ class JobMaster:
         shard stripe may be taken."""
         rate = self._hb_target_rate
         if rate <= 0:
-            return self._hb_interval_s
-        s = max(self._hb_interval_s, self.trackers.approx_len() / rate)
-        if self._hb_interval_max_s > 0:
-            # a floor above the cap means the operator pinned the
-            # cadence — the floor wins (adaptation never speeds beats up)
-            s = min(s, max(self._hb_interval_max_s, self._hb_interval_s))
+            s = self._hb_interval_s
+        else:
+            s = max(self._hb_interval_s,
+                    self.trackers.approx_len() / rate)
+            if self._hb_interval_max_s > 0:
+                # a floor above the cap means the operator pinned the
+                # cadence — the floor wins (adaptation never speeds
+                # beats up)
+                s = min(s, max(self._hb_interval_max_s,
+                               self._hb_interval_s))
+        if self.brownout is not None:
+            # brownout level 2+: stretch the instructed cadence toward
+            # the adaptive max — the whole fleet beats slower and the
+            # fold/assign path breathes (lock-free, one int read)
+            s = self.brownout.stretch_interval(
+                s, max(self._hb_interval_max_s, self._hb_interval_s))
         return s
+
+    def _class_observe(self, kind: str, cls: str,
+                       seconds: float) -> None:
+        """Per-traffic-class latency fold (scenario lab):
+        ``class_assign_seconds`` / ``class_complete_seconds`` labeled
+        by class. Get-or-create is registry-locked and idempotent; the
+        local dict probe keeps repeat observations allocation-free."""
+        h = self._class_hists.get((kind, cls))
+        if h is None:
+            h = self._mreg.histogram(
+                f"class_{kind}_seconds|class={cls}")
+            self._class_hists[(kind, cls)] = h
+        h.observe(max(0.0, seconds))
+
+    def brownout_tick(self, pressure: bool) -> None:
+        """One flight-recorder tick's pressure bit → the brownout state
+        machine, plus the side effects a level change implies (the
+        speculation hold is per-job state, flipped here on transitions
+        so the scheduler's lock-free prechecks see it)."""
+        b = self.brownout
+        if b is None:
+            return
+        was_holding = b.sheds("speculation")
+        b.on_tick(pressure)
+        holding = b.sheds("speculation")
+        if was_holding != holding:
+            for jip in list(self.jobs.values()):
+                jip.speculation_hold = holding
 
     def heartbeat(self, status: dict, initial_contact: bool,
                   ask_for_new_task: bool, response_id: int) -> dict:
@@ -2443,6 +2531,7 @@ class JobMaster:
         # taken on the heartbeat fast path
         is_delta = bool(status.get("delta"))
         adopted = False
+        restarted_info: "_TrackerInfo | None" = None
         shard_lock, shard = self.trackers.shard_of(name)
         with shard_lock:
             info = shard.get(name)
@@ -2467,6 +2556,22 @@ class JobMaster:
                 # tasks and all.
                 return {"response_id": response_id, "actions":
                         [{"type": "resend_full"}]}
+            elif info is not None and initial_contact and not is_delta:
+                # full INITIAL-contact beat from a tracker this master
+                # already knows: the tracker PROCESS restarted under
+                # its old name (cold re-registration — crash + rejoin
+                # faster than the expiry sweep), or its registration
+                # response was lost and this is the re-send. Either
+                # way the OLD incarnation's believed-running attempts
+                # never ran to completion there, and its replay-cache
+                # entry would feed the new process a response meant
+                # for the dead one. Swap in a fresh registration here;
+                # the stale work is requeued below, outside the shard
+                # lock (≈ JobTracker.java's lostTaskTracker on a known
+                # tracker's initialContact).
+                restarted_info = info
+                status.pop("delta", None)
+                info = shard[name] = _TrackerInfo(status)
             elif info is not None:
                 if not initial_contact:
                     # heartbeat LAG: how far past its scheduled interval
@@ -2502,6 +2607,9 @@ class JobMaster:
                 self._evict_tracker(name)
             return {"response_id": response_id, "actions":
                     [{"type": "disallowed"}]}
+
+        if restarted_info is not None:
+            self._requeue_restarted(name, restarted_info, status)
 
         # ---- per-tracker serialization: one beat of one tracker at a
         # time. A retry racing its own lost original folds after it and
@@ -2798,6 +2906,16 @@ class JobMaster:
                 else:
                     self._mreg.incr("maps_launched_cpu")
                 tjip = self.jobs.get(str(task.attempt_id.task.job))
+                if tjip is not None and tjip.first_assign_mono is None:
+                    # first assignment for this job — the scheduling-
+                    # responsiveness half of the per-class SLO (the
+                    # assign pass is serialized by sched_lock, so the
+                    # None check can't race itself)
+                    tjip.first_assign_mono = time.monotonic()
+                    if tjip.traffic_class:
+                        self._class_observe(
+                            "assign", tjip.traffic_class,
+                            tjip.first_assign_mono - tjip.submit_mono)
                 if tjip is not None and tjip.trace_root is not None:
                     # scheduling decision span; its context rides the
                     # launch action so the tracker/child parent their
@@ -2821,14 +2939,21 @@ class JobMaster:
                 # assignment-time event: gives the history timeline
                 # true start stamps + placement (≈ JobHistory
                 # Task.START_TIME; rendered by the history server's
-                # /jobtasks view, the TaskGraphServlet role)
-                deferred_events.append((
-                    str(task.attempt_id.task.job), "TASK_STARTED",
-                    dict(attempt_id=str(task.attempt_id),
-                         is_map=task.is_map,
-                         run_on_tpu=task.run_on_tpu,
-                         tpu_device_id=task.tpu_device_id,
-                         tracker=name)))
+                # /jobtasks view, the TaskGraphServlet role). Display-
+                # only — the history server derives a start stamp when
+                # it's absent — so brownout level 3 sheds the append
+                # and its deferred file I/O.
+                if self.brownout is not None \
+                        and self.brownout.sheds("history"):
+                    self.brownout.events_shed += 1
+                else:
+                    deferred_events.append((
+                        str(task.attempt_id.task.job), "TASK_STARTED",
+                        dict(attempt_id=str(task.attempt_id),
+                             is_map=task.is_map,
+                             run_on_tpu=task.run_on_tpu,
+                             tpu_device_id=task.tpu_device_id,
+                             tracker=name)))
             # the scheduler pass plus per-assignment bookkeeping —
             # observed only when the pass actually ran, so the
             # distribution isn't drowned by no-ask heartbeats
@@ -2964,6 +3089,34 @@ class JobMaster:
                  info.status.get("task_statuses", [])]
         addr = (f"{info.status.get('host', '')}:"
                 f"{info.status.get('shuffle_port', 0)}")
+        self._requeue_tracker_work(attempts, addr)
+
+    def _requeue_restarted(self, name: str, old: "_TrackerInfo",
+                           status: dict) -> None:
+        """Cold re-registration cleanup (caller just swapped the
+        registry entry; holds no locks): requeue what the OLD
+        incarnation owned — minus any attempt the new status still
+        carries, per the wire contract, though a cold process never
+        carries one — and drop its replay-cache entry so a stale
+        response id can never replay the dead process's actions into
+        the new one."""
+        self._mreg.incr("trackers_restarted")
+        self._last_response.pop(name, None)
+        carried = {sd.get("attempt_id")
+                   for sd in status.get("task_statuses", [])}
+        with old.hb_lock:
+            attempts = [a for a in old.running if a not in carried]
+        addr = (f"{old.status.get('host', '')}:"
+                f"{old.status.get('shuffle_port', 0)}")
+        self._requeue_tracker_work(attempts, addr)
+
+    def _requeue_tracker_work(self, attempts: "list[str]",
+                              addr: str) -> None:
+        """Requeue a dead tracker incarnation's work: running attempts
+        back to pending, completed map outputs it served withdrawn,
+        streamed-handoff announcements tombstoned, commit grants
+        revoked. Per-job locks only — shared by eviction and cold
+        re-registration."""
         for jip in list(self.jobs.values()):
             with jip.lock:
                 # OBSOLETE entries are tombstones of already-withdrawn

@@ -343,10 +343,13 @@ class HybridQueueScheduler(TaskScheduler):
                     # ≈ gpu-executable gate (:342-347), plus the job-
                     # level accelerator quarantine
                     continue
-                if job.pending_map_count() == 0 and not job.speculative:
+                if job.pending_map_count() == 0 \
+                        and not (job.speculative
+                                 and not job.speculation_hold):
                     # lock-free precheck (len of a set, stale by at most
                     # a beat): obtain re-checks under the job lock, this
                     # just skips the lock round trip for drained jobs
+                    # (a brownout speculation hold drains them too)
                     continue
                 if not fits(job.map_memory_mb()):
                     continue
@@ -372,7 +375,9 @@ class HybridQueueScheduler(TaskScheduler):
         for _ in range(free_cpu):
             task = None
             for job in self._map_job_order(jobs):
-                if job.pending_map_count() == 0 and not job.speculative:
+                if job.pending_map_count() == 0 \
+                        and not (job.speculative
+                                 and not job.speculation_hold):
                     continue   # lock-free precheck, same as TPU pass
                 if budget_of(job) <= 0:
                     continue
@@ -393,7 +398,8 @@ class HybridQueueScheduler(TaskScheduler):
         if free_red > 0:
             for job in self._reduce_job_order(jobs):
                 if job.pending_reduce_count() == 0 \
-                        and not job.speculative_reduces:
+                        and not (job.speculative_reduces
+                                 and not job.speculation_hold):
                     # lock-free precheck: most jobs in a wide queue have
                     # their (few) reduces already placed — without this,
                     # every heartbeat's reduce pass took every job's

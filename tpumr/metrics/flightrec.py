@@ -21,6 +21,21 @@ sustained breach produces exactly one bundle per cooldown window, not a
 disk-filling stream. ``/incidents`` on the master lists them;
 ``validate_incident`` is the schema checker the e2e test (and any
 external consumer) holds bundles against.
+
+The scenario lab grew the watchdog two surfaces. Per-TRAFFIC-CLASS
+windowed percentiles: the master's lazily-created
+``class_assign_seconds`` / ``class_complete_seconds`` histograms are
+windowed the same way each tick and judged against per-class SLOs
+(``tpumr.scenario.slo.<class>.{assign,complete}.ms``), yielding an
+online per-class verdict (``class_report``) plus a bounded per-tick
+window history the overload e2e asserts recovery against. And the tick
+is the master BROWNOUT's clock: every tick folds one pressure bit
+(any windowed breach, heartbeat or class) into
+``JobMaster.brownout_tick``, so sustained pressure engages ranked load
+shedding and sustained calm releases it. Bundles carry the workload
+context — active scenario name, per-class breakdown at breach time,
+brownout level and recent transitions — so a bundle alone answers
+"degrading for whom, and what was already shed".
 """
 
 from __future__ import annotations
@@ -29,12 +44,14 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Any
 
 from tpumr.metrics.histogram import typed_delta
 
 #: bundle schema tag — bump on incompatible shape changes
-SCHEMA = "tpumr-incident-1"
+#: (2: reason rows carry per-row slo_s; workload context section)
+SCHEMA = "tpumr-incident-2"
 
 #: watchdog cadence: 1 s ticks make the breach window ~1 s, matching
 #: the heartbeat cadence the SLO is defined over
@@ -74,14 +91,25 @@ class FlightRecorder:
     adds nothing to the heartbeat path."""
 
     def __init__(self, master: Any, sampler: Any, slo_ms: int,
-                 cooldown_ms: int, incident_dir: str) -> None:
+                 cooldown_ms: int, incident_dir: str,
+                 conf: Any = None) -> None:
         self.master = master
         self.sampler = sampler
+        self.conf = conf
         self.slo_s = slo_ms / 1000.0
         self.cooldown_s = cooldown_ms / 1000.0
         self.incident_dir = incident_dir
-        self._registry = sampler.registry if sampler is not None else None
+        self._registry = sampler.registry if sampler is not None \
+            else getattr(master, "_mreg", None)
         self._prev: "dict[str, dict]" = {}
+        #: per-class online verdict state, keyed by class name
+        self._class_state: "dict[str, dict]" = {}
+        self._class_slo_cache: \
+            "dict[str, tuple[float | None, float | None]]" = {}
+        #: bounded per-tick history: per-class windowed p99s + brownout
+        #: level — the overload e2e proves "interactive recovered WHILE
+        #: brownout was active" from this, not from cumulative state
+        self._window_history: "deque[dict]" = deque(maxlen=900)
         self._last_write_mono: "float | None" = None
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
@@ -89,12 +117,15 @@ class FlightRecorder:
     @classmethod
     def from_conf(cls, conf: Any, master: Any,
                   sampler: Any) -> "FlightRecorder | None":
-        """None unless the profiler is on AND an incident dir can be
-        derived (``tpumr.prof.incident.dir``, else next to the job
-        history) — the recorder's whole value is the folded stacks, so
-        it rides the profiler's opt-in."""
+        """None unless an incident dir can be derived
+        (``tpumr.prof.incident.dir``, else next to the job history) AND
+        something wants the watchdog: the profiler (folded stacks in
+        every bundle) or brownout mode (the tick is the brownout's
+        clock — a stacks-less recorder still windows SLOs, judges
+        classes, and writes bundles with empty ``folded_stacks``)."""
         from tpumr.core import confkeys
-        if sampler is None:
+        if sampler is None and not confkeys.get_boolean(
+                conf, "tpumr.brownout.enabled"):
             return None
         d = conf.get("tpumr.prof.incident.dir") \
             or conf.get("tpumr.history.dir")
@@ -105,7 +136,8 @@ class FlightRecorder:
             slo_ms=confkeys.get_int(conf, "tpumr.prof.incident.slo.ms"),
             cooldown_ms=confkeys.get_int(
                 conf, "tpumr.prof.incident.cooldown.ms"),
-            incident_dir=os.path.join(str(d), "incidents"))
+            incident_dir=os.path.join(str(d), "incidents"),
+            conf=conf)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -147,9 +179,70 @@ class FlightRecorder:
                 out.append((metric, typed_p99(delta)))
         return out
 
+    def _class_slos(self, cls_name: str) \
+            -> "tuple[float | None, float | None]":
+        """(assign_slo_s, complete_slo_s) for one traffic class, from
+        ``tpumr.scenario.slo.<class>.{assign,complete}.ms`` — None when
+        unset (that side is observed but never judged)."""
+        cached = self._class_slo_cache.get(cls_name)
+        if cached is not None:
+            return cached
+        out = []
+        for kind in ("assign", "complete"):
+            raw = self.conf.get(
+                f"tpumr.scenario.slo.{cls_name}.{kind}.ms") \
+                if self.conf is not None else None
+            try:
+                out.append(float(raw) / 1000.0 if raw not in
+                           (None, "") else None)
+            except (TypeError, ValueError):
+                out.append(None)
+        self._class_slo_cache[cls_name] = (out[0], out[1])
+        return self._class_slo_cache[cls_name]
+
+    def _fold_classes(self) -> "list[tuple[str, str, float,"\
+            " float | None, bool]]":
+        """Window the master's per-class latency histograms (same
+        typed-delta mechanism as the heartbeat SLOs) and fold the
+        online verdict state. Returns (class, kind, p99_s, slo_s,
+        breach) rows for windows that carried data."""
+        rows: "list[tuple[str, str, float, float | None, bool]]" = []
+        hists = getattr(self.master, "_class_hists", None) or {}
+        for (kind, cls_name), hist in list(hists.items()):
+            key = f"class_{kind}|{cls_name}"
+            cur = hist.typed()
+            delta = typed_delta(cur, self._prev.get(key))
+            self._prev[key] = cur
+            if not delta or not delta.get("count"):
+                continue
+            p99 = typed_p99(delta)
+            slo = self._class_slos(cls_name)[0 if kind == "assign"
+                                             else 1]
+            breach = slo is not None and p99 > slo
+            st = self._class_state.setdefault(cls_name, {})
+            st[f"{kind}_windows"] = st.get(f"{kind}_windows", 0) + 1
+            if breach:
+                st[f"{kind}_breach_windows"] = \
+                    st.get(f"{kind}_breach_windows", 0) + 1
+            st[f"{kind}_last_p99_s"] = round(p99, 6)
+            st[f"{kind}_ok"] = (not breach) if slo is not None else None
+            rows.append((cls_name, kind, p99, slo, breach))
+        return rows
+
     def _tick(self) -> None:
-        breaches = [(m, p99) for m, p99 in self._windowed_p99s()
+        hb = self._windowed_p99s()
+        class_rows = self._fold_classes()
+        breaches = [(m, p99, self.slo_s) for m, p99 in hb
                     if p99 > self.slo_s]
+        breaches += [(f"class_{kind}_seconds|class={cls_name}", p99,
+                      slo)
+                     for cls_name, kind, p99, slo, breach in class_rows
+                     if breach]
+        # the brownout's clock: one pressure bit per tick — any
+        # windowed breach, heartbeat or class, counts as pressure
+        if getattr(self.master, "brownout", None) is not None:
+            self.master.brownout_tick(bool(breaches))
+        self._record_window(hb, class_rows)
         if not breaches:
             return
         now = time.monotonic()
@@ -161,16 +254,85 @@ class FlightRecorder:
         self._last_write_mono = now
         self.write_incident(breaches)
 
+    def _record_window(self, hb: "list[tuple[str, float]]",
+                       class_rows: "list") -> None:
+        brown = getattr(self.master, "brownout", None)
+        rec: "dict[str, Any]" = {
+            "t_mono": round(time.monotonic(), 3),
+            "brownout_level": brown.level if brown is not None else 0,
+            "heartbeat": {m: round(p, 6) for m, p in hb},
+            "classes": {},
+        }
+        for cls_name, kind, p99, slo, breach in class_rows:
+            c = rec["classes"].setdefault(cls_name, {})
+            c[f"{kind}_p99_s"] = round(p99, 6)
+            if slo is not None:
+                c[f"{kind}_ok"] = not breach
+        self._window_history.append(rec)
+
+    def window_history(self) -> "list[dict]":
+        """The bounded per-tick record (copy) — per-class windowed
+        p99s, verdict bits, and the brownout level at each tick."""
+        return list(self._window_history)
+
+    def class_report(self) -> dict:
+        """Machine-readable per-class verdicts: cumulative p50/p99 plus
+        the online windowed state for both latency kinds, and one
+        ``pass`` bit per class — the last data-carrying window must be
+        under SLO and breached windows must stay a minority, so a class
+        that RECOVERED under brownout passes while one still drowning
+        fails. Classes without SLOs report latencies with ``pass``
+        True (observed, never judged)."""
+        hists = getattr(self.master, "_class_hists", None) or {}
+        by_cls: "dict[str, dict]" = {}
+        for (kind, cls_name), hist in list(hists.items()):
+            by_cls.setdefault(cls_name, {})[kind] = hist
+        out: "dict[str, dict]" = {}
+        for cls_name in sorted(by_cls):
+            slo_assign, slo_complete = self._class_slos(cls_name)
+            st = self._class_state.get(cls_name, {})
+            row: "dict[str, Any]" = {}
+            ok = True
+            for kind, slo in (("assign", slo_assign),
+                              ("complete", slo_complete)):
+                hist = by_cls[cls_name].get(kind)
+                snap = hist.snapshot() if hist is not None else {}
+                windows = st.get(f"{kind}_windows", 0)
+                breach_w = st.get(f"{kind}_breach_windows", 0)
+                entry: "dict[str, Any]" = {
+                    "count": snap.get("count", 0),
+                    "p50_s": snap.get("p50", 0.0),
+                    "p99_s": snap.get("p99", 0.0),
+                    "slo_ms": int(slo * 1000) if slo is not None
+                    else None,
+                    "windows": windows,
+                    "breach_windows": breach_w,
+                    "last_window_p99_s": st.get(f"{kind}_last_p99_s"),
+                    "ok": st.get(f"{kind}_ok"),
+                }
+                if slo is not None and windows:
+                    frac = breach_w / windows
+                    entry["breach_fraction"] = round(frac, 4)
+                    if entry["ok"] is False or frac > 0.5:
+                        ok = False
+                row[kind] = entry
+            row["pass"] = ok
+            out[cls_name] = row
+        return out
+
     # ------------------------------------------------------------ bundles
 
-    def bundle(self, breaches: "list[tuple[str, float]]") -> dict:
+    def bundle(self, breaches: "list[tuple]") -> dict:
         """Assemble the incident document (pure read — the e2e test and
-        ``write_incident`` share it)."""
+        ``write_incident`` share it). ``breaches`` rows are (metric,
+        p99_s) judged against the heartbeat SLO, or (metric, p99_s,
+        slo_s) carrying their own — per-class SLOs differ."""
         from tpumr.metrics.locks import lock_table
         m = self.master
         snaps = m.metrics.snapshot()
         jt = snaps.get("jobtracker", {})
         rpc = snaps.get("rpc", {})
+        brown = getattr(m, "brownout", None)
         wait_hold = {
             name: val for name, val in jt.items()
             if name.startswith(("jt_lock_wait_seconds|",
@@ -185,9 +347,20 @@ class FlightRecorder:
             "ts": time.time(),
             "role": "jobtracker",
             "slo_ms": int(self.slo_s * 1000),
-            "reason": [{"metric": metric, "p99_s": round(p99, 6),
-                        "slo_s": self.slo_s}
-                       for metric, p99 in breaches],
+            "reason": [{"metric": b[0], "p99_s": round(b[1], 6),
+                        "slo_s": round(b[2] if len(b) > 2
+                                       else self.slo_s, 6)}
+                       for b in breaches],
+            # workload context: WHO was degrading and what the master
+            # had already shed when this bundle was cut
+            "workload": {
+                "scenario": getattr(m, "scenario_name", "") or "",
+                "brownout": brown.snapshot() if brown is not None
+                else {"level": 0},
+                "classes": {
+                    cls_name: dict(st)
+                    for cls_name, st in self._class_state.items()},
+            },
             "folded_stacks": self.sampler.folded(
                 max(2 * TICK_S, 5.0)) if self.sampler else "",
             "subsystem_shares": self.sampler.subsystem_shares()
@@ -205,8 +378,7 @@ class FlightRecorder:
             "spans": spans,
         }
 
-    def write_incident(
-            self, breaches: "list[tuple[str, float]]") -> "str | None":
+    def write_incident(self, breaches: "list[tuple]") -> "str | None":
         """Write one bundle; returns its path (None on I/O failure —
         the recorder must outlive a full disk)."""
         doc = self.bundle(breaches)
@@ -296,4 +468,11 @@ def validate_incident(doc: Any) -> "list[str]":
         errs.append("heartbeat snapshot missing seconds/phases")
     if not isinstance(doc.get("spans"), list):
         errs.append("spans missing (must be a list)")
+    wl = doc.get("workload")
+    if not isinstance(wl, dict) \
+            or not isinstance(wl.get("scenario"), str) \
+            or not isinstance(wl.get("brownout"), dict) \
+            or not isinstance(wl.get("classes"), dict):
+        errs.append("workload context missing "
+                    "(scenario/brownout/classes)")
     return errs

@@ -17,6 +17,11 @@ same blind spot). This package supplies the load side:
   measures the control plane.
 - :mod:`tpumr.scale.driver` — ``ScaleDriver``: submits synthetic
   multi-job workloads over the client RPC surface and waits for them.
+- :mod:`tpumr.scale.scenario` — the scenario lab: named,
+  seed-deterministic traffic mixes (interactive bursts, wide batch,
+  iterative pipelines) replayed against a real master with chaos
+  (tracker churn, master kill/restart, fi seams) and judged by
+  per-traffic-class SLO verdicts from the flight recorder.
 
 The read side is the master's own saturation series (heartbeat
 latency/lag/phases, ``jt_lock_wait_seconds``, ``rpc_inflight``,
@@ -26,6 +31,13 @@ control-plane refactor must beat, and ``tpumr simulate`` in the CLI.
 """
 
 from tpumr.scale.driver import ScaleDriver
+from tpumr.scale.scenario import (BUILTIN_SCENARIOS, ScenarioError,
+                                  ScenarioRunner, list_scenarios,
+                                  load_spec, plan, run_named,
+                                  validate_spec)
 from tpumr.scale.simtracker import SimFleet, SimTracker
 
-__all__ = ["ScaleDriver", "SimFleet", "SimTracker"]
+__all__ = ["BUILTIN_SCENARIOS", "ScaleDriver", "ScenarioError",
+           "ScenarioRunner", "SimFleet", "SimTracker",
+           "list_scenarios", "load_spec", "plan", "run_named",
+           "validate_spec"]

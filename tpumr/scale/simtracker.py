@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from tpumr.core import confkeys
 from tpumr.ipc.rpc import RpcClient
 from tpumr.mapred.heartbeat import HeartbeatEncoder
 from tpumr.mapred.ids import TaskAttemptID
@@ -37,6 +38,7 @@ from tpumr.mapred.task import TaskPhase, TaskState, TaskStatus
 from tpumr.metrics.core import MetricsRegistry
 from tpumr.metrics.histogram import Histogram
 from tpumr.net import DEFAULT_RACK
+from tpumr.utils.fi import fires
 
 
 def default_task_time(rng: random.Random, is_map: bool,
@@ -75,8 +77,22 @@ class SimTracker:
                  piggyback_interval_s: float = 1.0,
                  handshake: bool = True,
                  delta: bool = True,
-                 rpc_timeout_s: float = 30.0) -> None:
+                 rpc_timeout_s: float = 30.0,
+                 index: int = -1,
+                 fi_conf: Any = None) -> None:
         self.name = name
+        #: fleet slot (the ``t<n>`` of the targeted ``tracker.crash.t<n>``
+        #: chaos seam) — -1 when driven outside a fleet
+        self.index = int(index)
+        #: conf consulted for fault-injection seams (``tracker.crash``,
+        #: ``task.slow``); None disables chaos entirely
+        self.fi_conf = fi_conf
+        self.crashed = False
+        #: monotonic deadline while "partitioned away" (scenario-lab
+        #: churn): the fleet skips this tracker's beats until then —
+        #: the process stays alive, tasks keep finishing locally, and
+        #: the master is left to expire it and adopt the rejoin
+        self.paused_until = 0.0
         self.cpu_slots = cpu_slots
         self.reduce_slots = reduce_slots
         self._task_time = task_time or (
@@ -184,6 +200,15 @@ class SimTracker:
             self._hb_encoder.reset()
             raise
         self._beat_ctx = (full, metrics, now)
+        if self.fi_conf is not None and (
+                fires(f"tracker.crash.t{self.index}", self.fi_conf)
+                or fires("tracker.crash", self.fi_conf)):
+            # BEHAVIORAL churn seam: hard-kill mid-beat — the request
+            # is already on the wire (the master may well fold it) but
+            # the response is never read and the socket just dies, like
+            # a tracker process SIGKILLed between send and receive
+            self.crash()
+            return False
         return True
 
     def heartbeat_finish(self) -> None:
@@ -231,6 +256,17 @@ class SimTracker:
 
     def close(self) -> None:
         self.stopped = True
+        self.master.close()
+
+    def crash(self) -> None:
+        """Hard kill: drop the connection with whatever was in flight,
+        no deregistration, no encoder flush — exactly what the master
+        sees when a tracker process dies. Master-side state (believed-
+        running attempts, the replay cache entry) is left for the
+        eviction sweep or the cold re-registration path to clean up."""
+        self.stopped = True
+        self.crashed = True
+        self._beat_ctx = None
         self.master.close()
 
     # ------------------------------------------------------------ fake work
@@ -401,9 +437,17 @@ class SimTracker:
                 phase=TaskPhase.MAP if is_map else TaskPhase.SHUFFLE,
                 run_on_tpu=bool(d.get("run_on_tpu", False)),
                 tpu_device_id=int(d.get("tpu_device_id", -1)))
+            duration = self._task_time(self._rng, is_map)
+            if self.fi_conf is not None and fires("task.slow",
+                                                  self.fi_conf):
+                # straggler phase (scenario lab): the fake task stays
+                # alive tpumr.fi.task.slow.ms longer — the sim twin of
+                # the real task.slow behavioral seam in map_task
+                duration += confkeys.get_int(
+                    self.fi_conf, "tpumr.fi.task.slow.ms") / 1000.0
             self._running[d["attempt_id"]] = _SimTask(
                 action["job_id"], int(d.get("num_maps", 0)),
-                self._task_time(self._rng, is_map), status)
+                duration, status)
         elif kind == "kill_task":
             self._kill_requested.add(action["attempt_id"])
         elif kind == "reinit":
@@ -447,6 +491,11 @@ class SimFleet:
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._threads: "list[threading.Thread]" = []
+        # churn accounting (scenario lab): crashes and cold respawns
+        self.trackers_crashed = 0
+        self.trackers_respawned = 0
+        self.trackers_partitioned = 0
+        self._respawn_timers: "list[threading.Timer]" = []
         # client-side observability (the harness's own view, independent
         # of the master's): round-trip latency, schedule overrun, errors
         self.registry = MetricsRegistry("simfleet")
@@ -458,7 +507,7 @@ class SimFleet:
         for i in range(self.n):
             self.trackers.append(SimTracker(
                 f"{self._prefix}_{i:04d}", self.master_host,
-                self.master_port, secret=self.secret,
+                self.master_port, secret=self.secret, index=i,
                 rng=random.Random(rng.randrange(1 << 30)),
                 **self._tracker_kwargs))
         now = time.monotonic()
@@ -507,6 +556,8 @@ class SimFleet:
                 tracker = self.trackers[idx]
                 if tracker.stopped:
                     continue
+                if now < tracker.paused_until:
+                    continue   # partitioned away; rescheduled below
                 t0 = time.monotonic()
                 try:
                     if tracker.heartbeat_begin():
@@ -533,17 +584,114 @@ class SimFleet:
                         nxt = due + iv
                         if nxt <= now:
                             nxt = now + iv
+                        if nxt < tracker.paused_until:
+                            nxt = tracker.paused_until
                         heapq.heappush(self._heap, (nxt, idx))
                 self._cv.notify()
 
     def stop(self) -> None:
         self._stop.set()
+        for timer in self._respawn_timers:
+            timer.cancel()
         with self._cv:
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=5.0)
         for tr in self.trackers:
             tr.close()
+
+    # ------------------------------------------------------------ churn
+
+    def crash(self, idx: int) -> str:
+        """Hard-kill tracker ``idx`` (scenario-lab churn): the socket
+        drops mid-schedule, nothing deregisters, the master is left to
+        notice. Returns the tracker's name."""
+        tracker = self.trackers[idx]
+        tracker.crash()
+        self.trackers_crashed += 1
+        return tracker.name
+
+    def respawn(self, idx: int) -> SimTracker:
+        """Cold-restart tracker ``idx`` under its old name: a brand-new
+        process image (fresh response id, initial-contact beat, empty
+        task table). The master either adopts it back through the
+        rejoin/adoption path (if the old incarnation was already
+        evicted) or takes the cold re-registration path (if not). The
+        replacement RNG is derived from (fleet seed, slot, generation)
+        so churn replays bit-identically under a pinned seed."""
+        self.trackers_respawned += 1
+        rng = random.Random(
+            f"{self._seed}:respawn:{idx}:{self.trackers_respawned}")
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                tracker = SimTracker(
+                    f"{self._prefix}_{idx:04d}", self.master_host,
+                    self.master_port, secret=self.secret, index=idx,
+                    rng=rng, **self._tracker_kwargs)
+                break
+            except OSError:
+                # master mid-restart: a real tracker would retry too
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        with self._cv:
+            self.trackers[idx] = tracker
+            heapq.heappush(self._heap, (time.monotonic(), idx))
+            self._cv.notify()
+        return tracker
+
+    def churn(self, idxs: "list[int] | None" = None, n: int = 1,
+              rejoin_after_s: "float | None" = None,
+              rng: "random.Random | None" = None) -> "list[str]":
+        """Crash ``idxs`` (or ``n`` slots drawn from ``rng``, defaulting
+        to a fleet-seed RNG) right now; when ``rejoin_after_s`` is set,
+        cold-respawn each slot after that delay on daemon timers
+        (cancelled by :meth:`stop`). Returns the crashed names."""
+        if idxs is None:
+            r = rng or random.Random(self._seed)
+            idxs = sorted(r.sample(range(self.n), min(int(n), self.n)))
+        names = [self.crash(i) for i in idxs]
+        if rejoin_after_s is not None:
+            for i in idxs:
+                timer = threading.Timer(rejoin_after_s,
+                                        self._respawn_quiet, args=(i,))
+                timer.daemon = True
+                timer.start()
+                self._respawn_timers.append(timer)
+        return names
+
+    def partition(self, idxs: "list[int] | None" = None, n: int = 1,
+                  duration_s: float = 2.5,
+                  rng: "random.Random | None" = None) -> "list[str]":
+        """Partition ``idxs`` (or ``n`` seed-drawn slots) away from the
+        master for ``duration_s``: beats stop but the PROCESS survives —
+        tasks keep finishing locally, state and response id intact.
+        When the silence outlives the expiry sweep the master evicts
+        the tracker, so the rejoin beat arrives from an \"unknown\"
+        name: delta → ``resend_full`` → a full NON-initial status, the
+        adoption path (``trackers_adopted``), in-flight work and all.
+        Returns the partitioned names."""
+        if idxs is None:
+            r = rng or random.Random(self._seed)
+            idxs = sorted(r.sample(range(self.n), min(int(n), self.n)))
+        until = time.monotonic() + float(duration_s)
+        names = []
+        with self._cv:
+            for i in idxs:
+                self.trackers[i].paused_until = until
+                names.append(self.trackers[i].name)
+            self.trackers_partitioned += len(idxs)
+            self._cv.notify()
+        return names
+
+    def _respawn_quiet(self, idx: int) -> None:
+        if self._stop.is_set():
+            return
+        try:
+            self.respawn(idx)
+        except Exception:  # noqa: BLE001 — fleet stopping under us
+            self.registry.incr("respawn_errors")
 
     # ------------------------------------------------------------ read side
 
@@ -556,6 +704,9 @@ class SimFleet:
             "tasks_completed": sum(t.tasks_completed
                                    for t in self.trackers),
             "hb_errors": snap.get("hb_errors", 0),
+            "trackers_crashed": self.trackers_crashed,
+            "trackers_respawned": self.trackers_respawned,
+            "trackers_partitioned": self.trackers_partitioned,
             "hb_rtt": snap.get("hb_rtt_seconds",
                                Histogram("x").snapshot()),
             "hb_lag": snap.get("hb_lag_seconds",

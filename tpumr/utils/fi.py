@@ -44,6 +44,18 @@ hung-task-reaping loop):
                                  runs; targeted speculation is the
                                  quarry's predator
 
+Churn seams (the scenario lab's tracker-churn / cold-rejoin chaos
+loop):
+  tracker.crash / tracker.crash.t<n>  BEHAVIORAL fault — a SimTracker
+                                 hard-kills itself mid-beat: the
+                                 request may be on the wire but the
+                                 response is never read and the socket
+                                 just dies, with no deregistration;
+                                 the master's eviction sweep plus the
+                                 adoption / cold re-registration
+                                 rejoin paths are the quarry's
+                                 predator
+
 Observability seams (the flight-recorder / continuous-profiler loop):
   jt.heartbeat.slow              BEHAVIORAL fault — master heartbeat
                                  handling stalls ``tpumr.fi.jt.
