@@ -1,0 +1,201 @@
+"""DFS saturation bench: where does the NameNode (and read path) melt?
+
+Ramps a simulated DFS-client fleet (``tpumr/scale/simdfs.py`` — real
+``DFSClient`` instances, real RPC, real DataNode block reads; the only
+synthetic thing is the op generator) against a FRESH in-process
+MiniDFSCluster per rung, and records both sides of every rung:
+
+- ``nn_op_p50_s`` / ``nn_op_p99_s`` — the NameNode's own per-op
+  handling latency (``nn_op_seconds{op=}`` merged across families,
+  with the per-op p99 map alongside);
+- ``lock_wait_p99_s`` / ``lock_hold_p99_s`` and the derived
+  ``lock_wait_share`` (lock wait p99 / op p99 — ~1.0 means the
+  namespace lock IS the latency, the signature the fine-grained-
+  locking roadmap item would have to move);
+- ``editlog_sync_p99_s``  — the fsync floor under every mutation;
+- ``read_mb_s`` / ``read_rtt_p99_s`` / ``dn_read_p99_s`` — data-plane
+  throughput and tails, client- and datanode-side;
+- ``hot_top1_share``      — the skew the SpaceSaving hot-block
+  pipeline (DN sketch → heartbeat piggyback → NN ``/hotblocks``)
+  surfaces: the designated hot file must dominate;
+- ``lag_p99_s``           — client schedule overrun: the first
+  externally visible saturation symptom.
+
+The report names the max sustainable client fleet at a DUAL SLO —
+NameNode op p99 (``tpumr.dfs.bench.op.slo.ms``) AND client read
+round-trip p99 (``tpumr.dfs.bench.read.slo.ms``) — the baseline every
+DFS-side change must move (or at least not regress).
+
+Output contract (same as ``bench_scale.py``): ONE JSON line on stdout
+{"metric", "value", "unit", "vs_baseline"}; per-rung rows go to stderr
+and ``bench_dfs.json``. env BENCH_SCALE=small (or --smoke) shrinks the
+ramp for CI; --assert-slo exits 3 when the smoke fleet can't hold the
+dual SLO. env TPUMR_DFS_PROM_OUT=PATH scrapes the last rung's live
+NameNode ``/metrics/prom`` into PATH (the CI artifact proving the
+exposition renders under load).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# measure the production configuration: the debug lock-order assertion
+# (metrics/locks.py) is a development aid a deployed namenode would run
+# without (python -O); honor an explicit override. Must be set before
+# any tpumr import (the flag is read at module load).
+os.environ.setdefault("TPUMR_LOCK_ORDER_CHECK", "0")
+
+
+def log(*a: object) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+SMALL = os.environ.get("BENCH_SCALE") == "small" or "--smoke" in sys.argv
+
+#: client-fleet ramp (≥ 4 rungs in every mode — the rows ARE the
+#: trajectory) and the per-client op cadence they schedule against
+FLEETS = [2, 4, 6, 8] if SMALL else [8, 16, 32, 64, 128]
+INTERVAL_S = 0.05
+MEASURE_S = 3.0 if SMALL else 8.0
+DATANODES = 2 if SMALL else 3
+N_FILES = 4 if SMALL else 8
+FILE_BYTES = 1 << 16 if SMALL else 1 << 18
+
+
+def _slos() -> "tuple[float, float]":
+    from tpumr.core import confkeys
+    from tpumr.mapred.jobconf import JobConf
+    conf = JobConf()
+    return (confkeys.get_int(conf, "tpumr.dfs.bench.op.slo.ms") / 1e3,
+            confkeys.get_int(conf, "tpumr.dfs.bench.read.slo.ms") / 1e3)
+
+
+def _log_row(row: dict) -> None:
+    log(f"[dfs] {row['clients']:4d} clients: nn op p50 "
+        f"{row['nn_op_p50_s'] * 1e3:.2f}ms p99 "
+        f"{row['nn_op_p99_s'] * 1e3:.2f}ms · lock wait p99 "
+        f"{row['lock_wait_p99_s'] * 1e3:.2f}ms hold "
+        f"{row['lock_hold_p99_s'] * 1e3:.2f}ms (share "
+        f"{row['lock_wait_share']:.2f}) · editlog sync p99 "
+        f"{row['editlog_sync_p99_s'] * 1e3:.2f}ms · read "
+        f"{row['read_mb_s']:.1f}MB/s rtt p99 "
+        f"{row['read_rtt_p99_s'] * 1e3:.2f}ms · lag p99 "
+        f"{row['lag_p99_s'] * 1e3:.2f}ms · hot top1 "
+        f"{row['hot_top1_share']:.0%} · {row['ops']} ops"
+        + ("" if row["completed"]
+           else f" · {row['errors']} ERRORS"))
+
+
+def run_bench(fleets: "list[int] | None" = None) -> dict:
+    from tpumr.scale.simdfs import run_dfs_step
+    op_slo_s, read_slo_s = _slos()
+    prom_out = os.environ.get("TPUMR_DFS_PROM_OUT")
+    fleets = fleets or FLEETS
+    rows = []
+    for i, n in enumerate(fleets):
+        row = run_dfs_step(
+            n, interval_s=INTERVAL_S, measure_s=MEASURE_S,
+            num_datanodes=DATANODES, n_files=N_FILES,
+            file_bytes=FILE_BYTES, seed=n,
+            # scrape the LAST (biggest) rung: the exposition artifact
+            # should show the NameNode at max load
+            prom_out=prom_out if i == len(fleets) - 1 else None)
+        rows.append(row)
+        _log_row(row)
+    # the DUAL SLO: the NameNode must handle ops inside op_slo AND the
+    # end-to-end read path (NN locate + DN fetch) must stay inside
+    # read_slo — a rung passing one while blowing the other is NOT
+    # sustainable (fast metadata is no comfort to a stalled reader)
+    sustainable = [r["clients"] for r in rows
+                   if r["completed"]
+                   and r["nn_op_p99_s"] <= op_slo_s
+                   and r["read_rtt_p99_s"] <= read_slo_s]
+    return {
+        "interval_s": INTERVAL_S,
+        "measure_s": MEASURE_S,
+        "datanodes": DATANODES,
+        "files": N_FILES,
+        "file_bytes": FILE_BYTES,
+        "op_slo_s": op_slo_s,
+        "read_slo_s": read_slo_s,
+        "slo_series": ["nn_op_p99_s", "read_rtt_p99_s"],
+        "max_sustainable_clients": max(sustainable, default=0),
+        "rows": rows,
+    }
+
+
+def compare_with_prior(prior: "dict | None", report: dict) -> None:
+    """One stderr line per common fleet size against a prior
+    bench_dfs.json — the before/after of a DFS change in one glance."""
+    if not prior or not prior.get("rows"):
+        return
+    old = {r["clients"]: r for r in prior["rows"]}
+    for row in report["rows"]:
+        o = old.get(row["clients"])
+        if o is None:
+            continue
+        log(f"[dfs] vs prior @ {row['clients']:4d} clients: nn op p99 "
+            f"{o.get('nn_op_p99_s', 0) * 1e3:.2f}"
+            f"->{row['nn_op_p99_s'] * 1e3:.2f}ms · read rtt p99 "
+            f"{o.get('read_rtt_p99_s', 0) * 1e3:.2f}"
+            f"->{row['read_rtt_p99_s'] * 1e3:.2f}ms · "
+            f"lock_wait_share {o.get('lock_wait_share', 0):.2f}"
+            f"->{row['lock_wait_share']:.2f}")
+    log(f"[dfs] vs prior: max sustainable "
+        f"{prior.get('max_sustainable_clients', 0)}"
+        f"->{report['max_sustainable_clients']} clients")
+
+
+def main() -> None:
+    prior = None
+    try:
+        with open("bench_dfs.json") as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        pass
+    report = run_bench()
+    with open("bench_dfs.json", "w") as f:
+        json.dump(report, f, sort_keys=True, indent=1)
+    log(f"detail rows -> bench_dfs.json: "
+        f"{json.dumps(report, sort_keys=True)}")
+    compare_with_prior(prior, report)
+    rows = report["rows"]
+    print(json.dumps({
+        "metric": f"dfs: max simulated-client fleet (of ramp "
+                  f"{[r['clients'] for r in rows]}, "
+                  f"{report['interval_s'] * 1000:.0f}ms op cadence, "
+                  f"{report['datanodes']} datanodes) the namenode "
+                  f"sustains with nn op p99 <= "
+                  f"{report['op_slo_s'] * 1000:.0f}ms AND read rtt "
+                  f"p99 <= {report['read_slo_s'] * 1000:.0f}ms",
+        "value": report["max_sustainable_clients"],
+        "unit": "clients",
+        # this bench IS the DFS baseline; nothing earlier exists
+        "vs_baseline": 1.0,
+    }))
+    if "--assert-slo" in sys.argv:
+        if report["max_sustainable_clients"] < max(FLEETS):
+            # CI regression gate (smoke sizes only — the full ramp is
+            # a measurement, not a gate): the whole smoke fleet must
+            # hold the dual SLO, or the DFS serving path regressed
+            log(f"[dfs] SLO FAILED: sustained "
+                f"{report['max_sustainable_clients']} of {max(FLEETS)} "
+                f"clients at the dual SLO (op "
+                f"{report['op_slo_s'] * 1000:.0f}ms / read "
+                f"{report['read_slo_s'] * 1000:.0f}ms p99)")
+            sys.exit(3)
+        # the skew pipeline is part of the contract: every gated row
+        # must show the hot file dominating the hot-block table (the
+        # DN sketch → heartbeat → NN fold path went through)
+        for row in rows:
+            if row["hot_top1_share"] < 0.25:
+                log(f"[dfs] HOT-BLOCK PIPELINE FAILED @ "
+                    f"{row['clients']} clients: top1 share "
+                    f"{row['hot_top1_share']:.2f} < 0.25")
+                sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

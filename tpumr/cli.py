@@ -904,12 +904,16 @@ def cmd_simulate(conf, argv: list[str]) -> int:
     includes the master-side saturation series (heartbeat p50/p99, lag
     p99, lock-wait p99, assign p99, RPC inflight peak); against a live
     master read those off its /metrics/prom. See docs/OPERATIONS.md
-    "Sizing the master"."""
+    "Sizing the master". ``-dfs N`` runs the storage twin instead: one
+    DFS saturation rung against a fresh in-process mini-DFS (see
+    "Monitoring the DFS")."""
     from tpumr.scale import ScaleDriver, SimFleet
     from tpumr.security import rpc_secret
     a = _kv_args(argv)
     if "scenario" in a:
         return _simulate_scenario(conf, a)
+    if "dfs" in a:
+        return _simulate_dfs(conf, a)
     n = int(a.get("trackers", 25))
     n_jobs = int(a.get("jobs", 4))
     maps = int(a.get("maps", 64))
@@ -980,6 +984,41 @@ def cmd_simulate(conf, argv: list[str]) -> int:
         driver.close()
         if master is not None:
             master.stop()
+
+
+def _simulate_dfs(conf, a: "dict[str, str]") -> int:
+    """``simulate -dfs N`` — one DFS saturation rung: a fresh
+    in-process MiniDFSCluster under a fleet of N real DFSClients on a
+    fixed op cadence (``tpumr/scale/simdfs.py``), reported as the same
+    joined row ``bench_dfs.py`` commits — NameNode op/lock/editlog
+    attribution plus client-side round trips and hot-block skew.
+    ``-seconds S`` measurement window, ``-interval MS`` per-client op
+    cadence, ``-datanodes N``, ``-files N`` working-set size,
+    ``-hot-p P`` hot-file read probability, ``-prom PATH`` scrapes the
+    live NameNode /metrics/prom into PATH. The row is judged against
+    the bench_dfs dual SLO (``tpumr.dfs.bench.op.slo.ms`` /
+    ``.read.slo.ms``); exit 1 when it fails."""
+    from tpumr.core import confkeys
+    from tpumr.scale.simdfs import run_dfs_step
+    row = run_dfs_step(
+        int(a["dfs"]), conf=conf,
+        interval_s=float(a.get("interval", 50)) / 1000.0,
+        measure_s=float(a.get("seconds", 6)),
+        num_datanodes=int(a.get("datanodes", 3)),
+        n_files=int(a.get("files", 8)),
+        hot_read_p=float(a.get("hot-p", 0.5)),
+        read_bytes=int(a.get("read-bytes", 1 << 16)),
+        seed=int(a.get("seed", 0)),
+        prom_out=a.get("prom"))
+    op_slo_s = confkeys.get_int(conf, "tpumr.dfs.bench.op.slo.ms") / 1e3
+    read_slo_s = confkeys.get_int(conf,
+                                  "tpumr.dfs.bench.read.slo.ms") / 1e3
+    row["slo"] = {
+        "op_slo_s": op_slo_s, "read_slo_s": read_slo_s,
+        "pass": row["completed"] and row["nn_op_p99_s"] <= op_slo_s
+                and row["read_rtt_p99_s"] <= read_slo_s}
+    print(json.dumps(row, indent=2, sort_keys=True))
+    return 0 if row["slo"]["pass"] else 1
 
 
 def _simulate_scenario(conf, a: "dict[str, str]") -> int:

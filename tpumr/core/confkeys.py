@@ -382,12 +382,27 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
     _K('tpumr.devcache.required.tags', 'str', '',
         "Comma list of device-cache tags this job's tasks want warm "
         "(empty = derived from the job's known side inputs)."),
+    _K('tpumr.dfs.bench.op.slo.ms', 'int', 100,
+        "bench_dfs: NameNode op-latency p99 SLO (merged nn_op_seconds) "
+        "a rung must hold to count as sustainable, ms."),
+    _K('tpumr.dfs.bench.read.slo.ms', 'int', 250,
+        "bench_dfs: client-side end-to-end read round-trip p99 SLO a "
+        "rung must hold to count as sustainable, ms."),
     _K('tpumr.distcp.preserve', 'bool', False,
         "distcp: preserve file attributes."),
     _K('tpumr.distcp.update', 'bool', False,
         "distcp: skip up-to-date targets."),
     _K('tpumr.distcp.work', 'str', None,
         "distcp work/staging directory."),
+    _K('tpumr.dn.hotblocks.k', 'int', 64,
+        "SpaceSaving counters per datanode read sketch (bounds hot-"
+        "block memory; any block read more than total/k times is "
+        "guaranteed tracked)."),
+    _K('tpumr.dn.hotblocks.top', 'int', 16,
+        "Top sketch entries a datanode piggybacks per heartbeat into "
+        "the namenode's cluster hot-block table."),
+    _K('tpumr.dn.http.port', 'int', -1,
+        "DataNode status/metrics HTTP port (0 = ephemeral, -1 = off)."),
     _K('tpumr.fairscheduler.preemption', 'bool', False,
         "Fair scheduler: enable preemption."),
     _K('tpumr.fairscheduler.preemption.interval.ms', 'int', 1000,
@@ -397,6 +412,9 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
     _K('tpumr.fi.jt.heartbeat.slow.ms', 'int', 400,
         "Ms the jt.heartbeat.slow fault seam stalls master heartbeat "
         "handling (drives the flight-recorder incident e2e)."),
+    _K('tpumr.fi.nn.op.slow.ms', 'int', 400,
+        "Ms the nn.op.slow fault seam stalls NameNode op handling "
+        "(drives the NN flight-recorder incident e2e)."),
     _K('tpumr.fi.rpc.delay.ms', 'int', 100,
         "Ms the rpc.delay fault seam stalls a call."),
     _K('tpumr.fi.seed', 'str', None,
@@ -464,6 +482,16 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "every beat)."),
     _K('tpumr.metrics.udp', 'str', None,
         "UDP sink HOST:PORT for metrics records."),
+    _K('tpumr.nn.audit.enabled', 'bool', False,
+        "NameNode audit log (logger 'tpumr.nn.audit'): one line per "
+        "mutating/metadata op with caller, cmd, src, dst, perm."),
+    _K('tpumr.nn.audit.rate.limit', 'int', 200,
+        "Max audit lines per second; the overflow is counted "
+        "(audit_suppressed) instead of written, so an op storm can't "
+        "turn the audit log into the bottleneck."),
+    _K('tpumr.nn.incident.slo.ms', 'int', 0,
+        "NameNode flight-recorder SLO: a windowed nn_op_seconds p99 "
+        "over this arms an incident snapshot (0 = recorder off)."),
     _K('tpumr.ops.device.cache.mb', 'int', 1024,
         "Ops-level device cache budget, MiB."),
     _K('tpumr.pipeline.conf.hooks.allowed', 'strings', 'tpumr.',

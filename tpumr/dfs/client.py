@@ -19,6 +19,7 @@ import threading
 import uuid
 from typing import Any
 
+from tpumr.core import tracing as _tracing
 from tpumr.ipc.rpc import RpcClient, RpcError
 
 
@@ -187,6 +188,11 @@ class _DFSOutputStream(io.RawIOBase):
         return len(data)
 
     def _flush_block(self, data: bytes) -> None:
+        with _tracing.span("dfs.write", path=self.path,
+                           bytes=len(data)):
+            self._flush_block_traced(data)
+
+    def _flush_block_traced(self, data: bytes) -> None:
         excluded: list[str] = []
         last_err: Exception | None = None
         chunk = 1 << 20
@@ -322,6 +328,12 @@ class _DFSInputStream(io.RawIOBase):
         return b"".join(chunks)
 
     def _read_replica(self, blk: dict, offset: int, length: int) -> bytes:
+        with _tracing.span("dfs.read", block_id=blk["block_id"],
+                           bytes=length):
+            return self._read_replica_traced(blk, offset, length)
+
+    def _read_replica_traced(self, blk: dict, offset: int,
+                             length: int) -> bytes:
         last_err: Exception | None = None
         chunk = 1 << 20
         if self.client.conf is not None:

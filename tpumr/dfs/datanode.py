@@ -182,7 +182,31 @@ class DataNode:
         self.capacity = int(conf.get("tdfs.datanode.capacity",
                                      1 << 40))
         self.heartbeat_s = float(conf.get("tdfs.datanode.heartbeat.s", 1.0))
+        # block read/write path metrics — byte + latency distributions
+        # and a live concurrent-reader gauge, the series the bench_dfs
+        # read-throughput SLO is judged against
+        from tpumr.metrics import MetricsSystem
+        from tpumr.metrics.histogram import BYTES
+        self.metrics = MetricsSystem("datanode")
+        self._mreg = self.metrics.new_registry("datanode")
+        self._read_bytes = self._mreg.histogram("dn_read_bytes",
+                                                bounds=BYTES)
+        self._read_seconds = self._mreg.histogram("dn_read_seconds")
+        self._write_bytes = self._mreg.histogram("dn_write_bytes",
+                                                 bounds=BYTES)
+        self._write_seconds = self._mreg.histogram("dn_write_seconds")
+        self._readers = 0
+        self._mreg.set_gauge("dn_readers", lambda: self._readers)
+        # bounded per-block read-frequency sketch (SpaceSaving), its
+        # top slice piggybacked on every heartbeat for the NameNode's
+        # cluster-wide hot-block table
+        from tpumr.dfs.hotblocks import SpaceSaving
+        self._hot = SpaceSaving(
+            k=int(conf.get("tpumr.dn.hotblocks.k", 64)))
+        self._hot_top = int(conf.get("tpumr.dn.hotblocks.top", 16))
+        self._hot_lock = threading.Lock()
         self._server = RpcServer(self, host=host, port=port, secret=self._secret)
+        self._server.metrics = self.metrics.new_registry("rpc")
         # Personal-credential callers (user keys, delegation tokens)
         # reach block data ONLY with a NameNode-minted per-block access
         # stamp (≈ the reference's BlockToken split): the frame is
@@ -205,6 +229,9 @@ class DataNode:
         self._scanner = threading.Thread(target=self._scan_loop,
                                          name="dn-block-scanner",
                                          daemon=True)
+        self._http: Any = None
+        self._http_port = int(conf.get("tpumr.dn.http.port", -1))
+        self.sampler: Any = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -214,11 +241,51 @@ class DataNode:
         self._hb.start()
         if self.scan_period_s > 0:
             self._scanner.start()
+        if self._http_port >= 0:
+            self._http = self._build_http(self._http_port).start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.sampler is not None:
+            self.sampler.stop()
+        if self._http is not None:
+            self._http.stop()
         self._server.stop()
+
+    @property
+    def http_url(self) -> "str | None":
+        return self._http.url if self._http is not None else None
+
+    def _build_http(self, port: int):
+        """Uniform daemon status surface (/metrics, /metrics/prom,
+        /stacks //flame under tpumr.prof.enabled) — the same scraper
+        config that covers the mapred daemons and the NN now covers
+        datanodes too; today the datanode served no status page at all."""
+        from tpumr.http import StatusHttpServer
+        srv = StatusHttpServer("datanode", port=port)
+        srv.attach_metrics(self.metrics)
+        from tpumr.metrics.sampler import StackSampler
+        self.sampler = StackSampler.from_conf(self.conf, self.metrics)
+        if self.sampler is not None:
+            self.sampler.start()
+            self.sampler.attach_http(srv)
+
+        def hotblocks(q: dict) -> dict:
+            with self._hot_lock:
+                return self._hot.to_wire(int(q.get("n", self._hot_top)))
+
+        srv.add_raw("hotblocks", hotblocks)
+
+        def summary(q: dict) -> dict:
+            blocks = self.store.blocks()
+            return {"addr": self.addr, "blocks": len(blocks),
+                    "used": sum(s for _, s in blocks),
+                    "capacity": self.capacity,
+                    "readers": self._readers}
+
+        srv.add_json("datanode", summary)
+        return srv
 
     @property
     def addr(self) -> str:
@@ -240,12 +307,20 @@ class DataNode:
 
     # ------------------------------------------------------------ heartbeat
 
+    def hot_wire(self) -> dict:
+        """The read-frequency slice piggybacked on each heartbeat: the
+        sketch's top entries + stream total, bounded by
+        tpumr.dn.hotblocks.top regardless of how hot the node runs."""
+        with self._hot_lock:
+            return self._hot.to_wire(self._hot_top)
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_s):
             try:
                 cmds = self.nn.call("dn_heartbeat", self.addr,
                                     self.store.used(), self.capacity,
-                                    len(self.store.blocks()))
+                                    len(self.store.blocks()),
+                                    self.hot_wire())
                 for cmd in cmds:
                     self._apply_command(cmd)
             except Exception:  # noqa: BLE001 — NN briefly unreachable
@@ -347,6 +422,12 @@ class DataNode:
 
     # ------------------------------------------------------------ transfer RPC
 
+    def _note_read(self, block_id: int, n: int, t0: float) -> None:
+        self._read_bytes.observe(n)
+        self._read_seconds.observe(time.monotonic() - t0)
+        with self._hot_lock:
+            self._hot.offer(str(block_id))
+
     def write_block(self, block_id: int, data: bytes,
                     downstream: list[str]) -> None:
         """Pipelined write: forward downstream FIRST, then store locally —
@@ -355,12 +436,22 @@ class DataNode:
         if downstream:
             self._peer(downstream[0]).call("write_block", block_id, data,
                                            downstream[1:])
+        t0 = time.monotonic()
         self.store.write(block_id, data)
+        self._write_bytes.observe(len(data))
+        self._write_seconds.observe(time.monotonic() - t0)
         self.nn.call("block_received", self.addr, block_id, len(data))
 
     def read_block(self, block_id: int, offset: int = 0,
                    length: int = -1) -> bytes:
-        return self.store.read(block_id, offset, length)
+        t0 = time.monotonic()
+        self._readers += 1
+        try:
+            data = self.store.read(block_id, offset, length)
+        finally:
+            self._readers -= 1
+        self._note_read(block_id, len(data), t0)
+        return data
 
     #: server-side cap per streamed-transfer RPC — bounds datanode
     #: memory per request regardless of client asks (the streaming
@@ -373,7 +464,13 @@ class DataNode:
         """One bounded chunk of a block + its total length; checksums
         verified for the covering CRC chunks only."""
         n = max(0, min(int(max_bytes), self.MAX_CHUNK_BYTES))
-        data, total = self.store.read_range(block_id, int(offset), n)
+        t0 = time.monotonic()
+        self._readers += 1
+        try:
+            data, total = self.store.read_range(block_id, int(offset), n)
+        finally:
+            self._readers -= 1
+        self._note_read(block_id, len(data), t0)
         return {"data": data, "total": total}
 
     # streamed pipelined write ≈ DataTransferProtocol op WRITE_BLOCK:
@@ -413,7 +510,10 @@ class DataNode:
         if up["downstream"]:
             self._peer(up["downstream"][0]).call("commit_block_stream",
                                                  block_id)
+        t0 = time.monotonic()
         size = self.store.finalize_stream(block_id)
+        self._write_bytes.observe(size)
+        self._write_seconds.observe(time.monotonic() - t0)
         self.nn.call("block_received", self.addr, block_id, size)
 
     def abort_block_stream(self, block_id: int) -> None:

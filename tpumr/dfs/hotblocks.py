@@ -1,0 +1,140 @@
+"""Hot-block heavy hitters: bounded SpaceSaving sketches on datanodes,
+folded into one cluster-wide table on the namenode.
+
+Millions of users hammer the same inputs — the devcache/replication
+policies the roadmap points at need to know WHICH blocks are hot, but
+counting every block read exactly would cost O(blocks) memory on a
+datanode that serves arbitrarily many. SpaceSaving (Metwally et al.,
+"Efficient Computation of Frequent and Top-k Elements in Data Streams")
+keeps exactly ``k`` counters and guarantees any block whose true count
+exceeds N/k is present, with per-entry overestimation bounded by the
+recorded ``err`` field. Datanodes piggyback their top entries on the
+heartbeats they already send; the namenode replaces (not accumulates)
+each datanode's slice, so a re-delivered heartbeat folds idempotently
+and a dead datanode's contribution vanishes with it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class SpaceSaving:
+    """Bounded top-K counter sketch (at most ``k`` tracked keys).
+
+    ``offer(key)``: if tracked, increment; else if there is room, admit
+    at count 1; else evict the current minimum and inherit its count
+    (the classic SpaceSaving replacement), recording that minimum as
+    the new entry's error bound. Estimates never undercount:
+    ``count - err <= true <= count``.
+    """
+
+    def __init__(self, k: int = 64) -> None:
+        self.k = max(1, int(k))
+        #: key -> [count, err]
+        self._counts: "dict[str, list[int]]" = {}
+        self.total = 0   # every offer, tracked or not
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def offer(self, key: str, by: int = 1) -> None:
+        self.total += by
+        ent = self._counts.get(key)
+        if ent is not None:
+            ent[0] += by
+            return
+        if len(self._counts) < self.k:
+            self._counts[key] = [by, 0]
+            return
+        victim = min(self._counts, key=lambda x: self._counts[x][0])
+        floor = self._counts.pop(victim)[0]
+        self._counts[key] = [floor + by, floor]
+
+    def estimate(self, key: str) -> int:
+        ent = self._counts.get(key)
+        return ent[0] if ent else 0
+
+    def topk(self, n: "int | None" = None) -> "list[tuple[str, int, int]]":
+        """(key, count, err) rows, highest count first."""
+        rows = sorted(((key, ent[0], ent[1])
+                       for key, ent in self._counts.items()),
+                      key=lambda r: (-r[1], r[0]))
+        return rows if n is None else rows[:n]
+
+    def to_wire(self, n: "int | None" = None) -> dict:
+        """JSON-safe snapshot for heartbeat piggybacking."""
+        return {"total": self.total,
+                "top": [list(r) for r in self.topk(n)]}
+
+    @staticmethod
+    def from_wire(doc: dict) -> "SpaceSaving":
+        sk = SpaceSaving(k=max(1, len(doc.get("top", [])) or 1))
+        sk.k = max(sk.k, len(doc.get("top", [])))
+        for key, count, err in doc.get("top", []):
+            sk._counts[str(key)] = [int(count), int(err)]
+        sk.total = int(doc.get("total", 0))
+        return sk
+
+    def merge(self, other: "SpaceSaving") -> "SpaceSaving":
+        """Fold another sketch in (union of streams). Counts add for
+        shared keys; the result is re-truncated to this sketch's ``k``
+        keeping the largest, so memory stays bounded after any number
+        of merges. Error bounds add conservatively."""
+        for key, (count, err) in other._counts.items():
+            ent = self._counts.get(key)
+            if ent is not None:
+                ent[0] += count
+                ent[1] += err
+            else:
+                self._counts[key] = [count, err]
+        self.total += other.total
+        if len(self._counts) > self.k:
+            keep = self.topk(self.k)
+            self._counts = {key: [count, err] for key, count, err in keep}
+        return self
+
+
+class HotBlockTable:
+    """Cluster-wide hot-block view: one sketch slice per datanode,
+    replaced wholesale on every heartbeat (idempotent fold), merged on
+    demand for ``/hotblocks`` and ``get_hot_blocks``. Thread-safe; its
+    own leaf lock is only ever held for dict ops, never while calling
+    out."""
+
+    def __init__(self, k: int = 64) -> None:
+        self.k = max(1, int(k))
+        self._mu = threading.Lock()
+        self._per_dn: "dict[str, dict]" = {}   # addr -> wire doc
+
+    def fold(self, addr: str, doc: "dict | None") -> None:
+        if not doc:
+            return
+        with self._mu:
+            self._per_dn[addr] = doc
+
+    def drop(self, addr: str) -> None:
+        """A dead datanode's reads stop counting the moment it does."""
+        with self._mu:
+            self._per_dn.pop(addr, None)
+
+    def top(self, n: int = 16) -> "list[dict[str, Any]]":
+        """Merged top-``n``: block_id, estimated cluster-wide reads,
+        error bound, and which datanodes reported it."""
+        with self._mu:
+            slices = dict(self._per_dn)
+        merged = SpaceSaving(k=self.k)
+        reporters: "dict[str, list[str]]" = {}
+        for addr, doc in sorted(slices.items()):
+            merged.merge(SpaceSaving.from_wire(doc))
+            for key, _count, _err in doc.get("top", []):
+                reporters.setdefault(str(key), []).append(addr)
+        return [{"block": key, "reads": count, "err": err,
+                 "datanodes": reporters.get(key, [])}
+                for key, count, err in merged.topk(n)]
+
+    def total_reads(self) -> int:
+        with self._mu:
+            return sum(int(doc.get("total", 0))
+                       for doc in self._per_dn.values())

@@ -23,13 +23,17 @@ reproduced:
 
 from __future__ import annotations
 
+import contextlib
+import logging
 import random
 import threading
 import time
 from typing import Any
 
-from tpumr.dfs.editlog import FSEditLog, FSImage, checkpoint
+from tpumr.dfs.editlog import FSEditLog, FSImage
+from tpumr.dfs.hotblocks import HotBlockTable
 from tpumr.ipc.rpc import RpcServer
+from tpumr.metrics.locks import RANK_NAMESPACE, InstrumentedRLock
 
 #: ≈ ClientProtocol.versionID (hdfs/protocol/ClientProtocol.java)
 PROTOCOL_VERSION = 61
@@ -57,7 +61,13 @@ class FSNamesystem:
     def __init__(self, name_dir: str, conf: Any) -> None:
         self.conf = conf
         self.name_dir = name_dir
-        self.lock = threading.RLock()
+        # every namespace op serializes here — instrumented so its wait
+        # (how long RPCs queue) and hold (how long the winner keeps them
+        # out) land in nn_lock_*_seconds{lock=namespace}; the histograms
+        # bind later (bind_metrics), the rank slots it into the one
+        # repo-wide order table
+        self.lock = InstrumentedRLock(name="namespace",
+                                      rank=RANK_NAMESPACE)
         self.default_replication = int(conf.get("dfs.replication", 3))
         self.default_block_size = int(conf.get("dfs.block.size",
                                                8 * 1024 * 1024))
@@ -83,6 +93,14 @@ class FSNamesystem:
         #: stale secondary upload can never purge segments its merged
         #: image does not cover
         self._ckpt_token = 0
+        #: serializes the checkpoint flows (save_namespace /
+        #: get_name_state / put_image) against each other so their
+        #: image + sealed-segment file I/O can run OUTSIDE the namespace
+        #: lock: the token protocol already refuses cross-process
+        #: staleness; this mutex removes the in-process interleavings
+        #: (two concurrent checkpoints double-applying sealed segments).
+        #: Always acquired BEFORE self.lock, never while holding it.
+        self._ckpt_mu = threading.Lock()
 
         # permission model ≈ FSNamesystem/FSPermissionChecker: owner/group/
         # mode per inode; the NN process user is the superuser; identity is
@@ -148,6 +166,26 @@ class FSNamesystem:
         # rack awareness ≈ FSNamesystem's clusterMap (NetworkTopology)
         from tpumr.net import NetworkTopology, resolver_from_conf
         self.topology = NetworkTopology(resolver_from_conf(conf))
+
+        #: cluster-wide hot-block view folded from the bounded
+        #: SpaceSaving slices datanodes piggyback on heartbeats
+        #: (hotblocks.py) — served at /hotblocks + get_hot_blocks
+        self.hot_blocks = HotBlockTable(
+            k=int(conf.get("tpumr.dn.hotblocks.k", 64)))
+
+        # audit log ≈ FSNamesystem.logAuditEvent: one line per namespace
+        # mutation on the dedicated "tpumr.nn.audit" logger, rate-capped
+        # per second so a create storm cannot turn the audit trail into
+        # the bottleneck it documents (suppressions are counted, never
+        # silent)
+        self._audit_enabled = conf.get_boolean("tpumr.nn.audit.enabled",
+                                               False)
+        self._audit_rate = int(conf.get("tpumr.nn.audit.rate.limit", 200))
+        self._audit_log = logging.getLogger("tpumr.nn.audit")
+        self._audit_window = -1
+        self._audit_in_window = 0
+        self.audit_emitted = 0
+        self.audit_suppressed = 0
 
     # ------------------------------------------------------------ journal
 
@@ -228,6 +266,40 @@ class FSNamesystem:
 
     def _log(self, op: dict) -> None:
         self.edits.log(op)
+
+    def _audit(self, cmd: str, src: str, dst: "str | None" = None,
+               perm: "str | None" = None) -> None:
+        """HDFS-style audit line (``ugi= ip= cmd= src= dst= perm=``) for
+        one SUCCESSFUL namespace mutation — called after the journal
+        append, so an audited op is always a durable op."""
+        if not self._audit_enabled:
+            return
+        window = int(time.monotonic())
+        if window != self._audit_window:
+            self._audit_window = window
+            self._audit_in_window = 0
+        self._audit_in_window += 1
+        if self._audit_rate and self._audit_in_window > self._audit_rate:
+            self.audit_suppressed += 1
+            return
+        self.audit_emitted += 1
+        self._audit_log.info(
+            "ugi=%s ip=- cmd=%s src=%s dst=%s perm=%s",
+            self._caller() or self.superuser, cmd, src,
+            "-" if dst is None else dst, "-" if perm is None else perm)
+
+    def bind_metrics(self, reg: Any) -> None:
+        """Attach the namespace-lock wait/hold and editlog histograms —
+        the lock and journal exist before the metrics registry does, so
+        they late-bind exactly like the master's lock classes."""
+        from tpumr.metrics.histogram import BYTES
+        self.lock.bind(
+            reg.histogram("nn_lock_wait_seconds|lock=namespace"),
+            reg.histogram("nn_lock_hold_seconds|lock=namespace"))
+        self.edits.bind_metrics(
+            reg.histogram("nn_editlog_append_seconds"),
+            reg.histogram("nn_editlog_sync_seconds"),
+            reg.histogram("nn_editlog_batch_bytes", bounds=BYTES))
 
     # ------------------------------------------------------------ helpers
 
@@ -460,6 +532,7 @@ class FSNamesystem:
                 op["spq"] = None if sp_quota < 0 else int(sp_quota)
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._audit("setQuota", path)
             if "ns_quota" in inode or "sp_quota" in inode:
                 # (re)derive this dir's counters at admin time — the one
                 # place a full subtree scan is acceptable
@@ -508,7 +581,12 @@ class FSNamesystem:
             lease = self.leases.setdefault(
                 client, {"paths": set(), "renewed": _now()})
             lease["paths"].add(path)
+            # wall-clock "renewed" stays for the report surface; expiry
+            # (lease_check) compares the monotonic twin so an NTP step
+            # can neither mass-expire nor immortalize leases
             lease["renewed"] = _now()
+            lease["renewed_mono"] = time.monotonic()
+            self._audit("create", path)
             return {"replication": r, "block_size": bs}
 
     def append(self, path: str, client: str) -> dict:
@@ -540,6 +618,8 @@ class FSNamesystem:
                 client, {"paths": set(), "renewed": _now()})
             lease["paths"].add(path)
             lease["renewed"] = _now()
+            lease["renewed_mono"] = time.monotonic()
+            self._audit("append", path)
             return {"block_size": inode["block_size"],
                     "replication": inode.get("replication", 1)}
 
@@ -641,6 +721,7 @@ class FSNamesystem:
             op = {"op": "close", "path": path, "sizes": sizes}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._audit("completeFile", path)
             if sizes:  # settle the last block's optimistic full charge
                 self._charge(path, 0,
                              (last_block_size - inode["block_size"])
@@ -656,6 +737,7 @@ class FSNamesystem:
             lease = self.leases.get(client)
             if lease:
                 lease["renewed"] = _now()
+                lease["renewed_mono"] = time.monotonic()
 
     def get_block_locations(self, path: str) -> list[dict]:
         with self.lock:
@@ -692,6 +774,7 @@ class FSNamesystem:
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
             self._charge(path, 1, 0)
+            self._audit("mkdirs", path)
             return True
 
     def delete(self, path: str, recursive: bool = True) -> bool:
@@ -700,7 +783,10 @@ class FSNamesystem:
             if path not in self.namespace:
                 return False
             self._check_access(self._parent_of(path), 2, self._caller())
-            return self._delete_impl(path, recursive)
+            out = self._delete_impl(path, recursive)
+            if out:
+                self._audit("delete", path)
+            return out
 
     def _delete_impl(self, path: str, recursive: bool) -> bool:
         """Delete body, no permission check — for callers that already
@@ -814,6 +900,7 @@ class FSNamesystem:
                     self._uc_counted.pop(k)
             self._charge(src, -(1 + sub_inodes), -sub_bytes)
             self._charge(dst, 1 + sub_inodes, sub_bytes)
+            self._audit("rename", src, dst=dst)
             return True
 
     def set_replication(self, path: str, replication: int) -> bool:
@@ -833,6 +920,7 @@ class FSNamesystem:
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
             self._charge(path, 0, size * (replication - old))
+            self._audit("setReplication", path, perm=str(replication))
             return True
 
     def set_permission(self, path: str, mode: int) -> None:
@@ -851,6 +939,8 @@ class FSNamesystem:
             op = {"op": "chmod", "path": path, "m": int(mode) & 0o7777}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._audit("setPermission", path,
+                        perm=oct(int(mode) & 0o7777))
 
     def set_owner(self, path: str, owner: "str | None" = None,
                   group: "str | None" = None) -> None:
@@ -875,6 +965,8 @@ class FSNamesystem:
                   "g": group or ""}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
+            self._audit("setOwner", path,
+                        perm=f"{owner or ''}:{group or ''}")
 
     def get_status(self, path: str) -> dict:
         with self.lock:
@@ -943,7 +1035,8 @@ class FSNamesystem:
                 self._log_decommission(addr, "decommissioning")
 
     def dn_heartbeat(self, addr: str, used: int, capacity: int,
-                     block_count: int) -> list[dict]:
+                     block_count: int,
+                     hot_blocks: "dict | None" = None) -> list[dict]:
         with self.lock:
             info = self.datanodes.get(addr)
             if info is None:
@@ -954,7 +1047,11 @@ class FSNamesystem:
                         seen_mono=time.monotonic(), blocks=block_count)
             cmds = self.commands.get(addr, [])
             self.commands[addr] = []
-            return cmds
+        # fold the piggybacked read-frequency slice OUTSIDE the
+        # namespace lock (the hot-block table has its own leaf mutex);
+        # a replace-fold means a re-delivered heartbeat is idempotent
+        self.hot_blocks.fold(addr, hot_blocks)
+        return cmds
 
     def block_report(self, addr: str, blocks: list[list[int]]) -> list[int]:
         """Full report: rebuild this node's locations; returns block ids the
@@ -1021,6 +1118,9 @@ class FSNamesystem:
                 self.commands.pop(addr, None)
                 for locs in self.block_locations.values():
                     locs.discard(addr)
+        for addr in dead:
+            # a dead node's read counts leave the hot-block view with it
+            self.hot_blocks.drop(addr)
 
     def replication_check(self) -> int:
         """One ReplicationMonitor sweep: schedule copies for
@@ -1186,9 +1286,12 @@ class FSNamesystem:
         """Expire hard-limit leases: finalize the file with whatever blocks
         were reported (lease recovery, simplified)."""
         with self.lock:
-            now = _now()
+            # expiry runs on the monotonic twin (renewed_mono): a
+            # wall-clock step must not mass-expire every writer's lease
+            now = time.monotonic()
             for client, lease in list(self.leases.items()):
-                if now - lease["renewed"] <= self.lease_hard_limit:
+                if now - lease.get("renewed_mono", now) \
+                        <= self.lease_hard_limit:
                     continue
                 for path in list(lease["paths"]):
                     inode = self.namespace.get(path)
@@ -1311,14 +1414,23 @@ class FSNamesystem:
 
     def save_namespace(self) -> None:
         """Checkpoint in place (image ∪ edits → image; purge merged
-        segments)."""
-        with self.lock:
-            self.edits.close()
-            checkpoint(self.name_dir, self.apply_op)
-            self.edits = FSEditLog(
-                self.name_dir, segment_bytes=self._edits_segment_bytes)
-            self._ckpt_token += 1  # invalidate any in-flight 2NN cycle
-            self._rebuild_quota_usage()  # self-heal conservative drift
+        segments). Only the roll and the quota rebuild run under the
+        namespace lock — the merge itself reads SEALED segments and the
+        image, both owned by ``_ckpt_mu``, so a multi-second replay no
+        longer stalls every client RPC (it used to run entirely under
+        the lock)."""
+        with self._ckpt_mu:
+            with self.lock:
+                sealed = self.edits.roll()
+                self._ckpt_token += 1  # invalidate any in-flight 2NN cycle
+                self._checkpoint_segments = []
+            namespace, counters = FSImage.load(self.name_dir)
+            for op in FSEditLog.replay(self.name_dir, sealed):
+                self.apply_op(namespace, counters, op)
+            FSImage.save(self.name_dir, namespace, counters)
+            FSEditLog.purge(sealed)
+            with self.lock:
+                self._rebuild_quota_usage()  # self-heal conservative drift
 
     def edits_bytes(self) -> int:
         """On-disk journal size (auto-checkpoint trigger input)."""
@@ -1334,13 +1446,20 @@ class FSNamesystem:
         this fetch's token (put_image)."""
         import os
         from tpumr.dfs.editlog import IMAGE_NAME
-        with self.lock:
+        with self._ckpt_mu:
+            with self.lock:
+                sealed = self.edits.roll()
+                self._checkpoint_segments = sealed
+                self._ckpt_token += 1  # fetch supersedes any earlier one
+                token = self._ckpt_token
+            # shipping the image + sealed segments is pure file I/O on
+            # state frozen by _ckpt_mu — reading it under the namespace
+            # lock would stall every client RPC for the transfer
             image = b"{}"
             img_path = os.path.join(self.name_dir, IMAGE_NAME)
             if os.path.exists(img_path):
                 with open(img_path, "rb") as f:
                     image = f.read()
-            sealed = self.edits.roll()
             segments = []
             for seg in sealed:
                 try:
@@ -1348,10 +1467,8 @@ class FSNamesystem:
                         segments.append(f.read())
                 except FileNotFoundError:
                     pass
-            self._checkpoint_segments = sealed
-            self._ckpt_token += 1  # this fetch supersedes any earlier one
             return {"image": image, "segments": segments,
-                    "token": self._ckpt_token}
+                    "token": token}
 
     def put_image(self, image: bytes, token: int = -1) -> None:
         """Secondary checkpoint upload (≈ putFSImage + rollFSImage): make
@@ -1362,19 +1479,26 @@ class FSNamesystem:
         purging would delete edits its image does not contain."""
         import os
         from tpumr.dfs.editlog import IMAGE_NAME
-        with self.lock:
-            if token != self._ckpt_token:
-                raise RuntimeError(
-                    "checkpoint signature mismatch: this merge is from a "
-                    "superseded get_name_state fetch — discarding it")
+        with self._ckpt_mu:
+            with self.lock:
+                # the token can't move while we hold _ckpt_mu (every
+                # bump happens under it), so checking here then writing
+                # outside the namespace lock is race-free in-process
+                if token != self._ckpt_token:
+                    raise RuntimeError(
+                        "checkpoint signature mismatch: this merge is "
+                        "from a superseded get_name_state fetch — "
+                        "discarding it")
+                segs = list(self._checkpoint_segments)
             tmp = os.path.join(self.name_dir, IMAGE_NAME + ".ckpt")
             with open(tmp, "wb") as f:
                 f.write(image)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.name_dir, IMAGE_NAME))
-            FSEditLog.purge(self._checkpoint_segments)
-            self._checkpoint_segments = []
+            FSEditLog.purge(segs)
+            with self.lock:
+                self._checkpoint_segments = []
 
     def get_blocks(self, addr: str, max_blocks: int = 16) -> list[dict]:
         """Blocks hosted on one DataNode (≈ NamenodeProtocol.getBlocks —
@@ -1411,6 +1535,21 @@ class FSNamesystem:
                     out.append({"addr": addr, "state": state})
             return out
 
+    def get_hot_blocks(self, n: int = 16) -> list[dict]:
+        """Cluster-wide hottest blocks (merged datanode sketches),
+        annotated with the owning path — the feed a future
+        replicate/devcache-pin policy consumes (ROADMAP "DFS at
+        production scale")."""
+        rows = self.hot_blocks.top(int(n))
+        with self.lock:
+            for r in rows:
+                try:
+                    r["path"] = self.block_to_path.get(
+                        int(r["block"]), "")
+                except (TypeError, ValueError):
+                    r["path"] = ""
+        return rows
+
 
 #: method → service keys ≈ HDFSPolicyProvider: client ops (incl. the
 #: dfsadmin surface, which rides ClientProtocol in the reference and is
@@ -1444,10 +1583,23 @@ class NameNode:
         self.conf = conf
         self.ns = FSNamesystem(name_dir, conf)
         self.dn_expiry_s = float(conf.get("tdfs.datanode.expiry.s", 10))
+        # metrics live on the daemon whether or not HTTP is enabled —
+        # the lock/editlog/op histograms must exist for bench_dfs and
+        # the flight recorder even on a headless NN
+        from tpumr.metrics import MetricsSystem
+        self.metrics = MetricsSystem("namenode")
+        self._mreg = self.metrics.new_registry("namenode")
+        self.ns.bind_metrics(self._mreg)
+        #: lazily-created per-op latency hists (nn_op_seconds{op=}) —
+        #: the flight recorder windows these
+        self._op_hists: dict[str, Any] = {}
         from tpumr.security import rpc_secret
         self._rpc_secret = rpc_secret(conf)
         self._server = RpcServer(self, host=host, port=port,
                                  secret=self._rpc_secret)
+        # per-method rpc_<method> latency/request-size hists + inflight
+        # gauges, same auto-instrumentation as the master's server
+        self._server.metrics = self.metrics.new_registry("rpc")
         # per-service delegation tokens (≈ ClientProtocol.
         # getDelegationToken / DelegationTokenSecretManager): the
         # NameNode issues + tracks liveness for ITS tokens; JobTracker
@@ -1469,16 +1621,26 @@ class NameNode:
         self._http: Any = None
         self._http_port = int(conf.get("tdfs.http.port", -1))
         self.sampler: Any = None  # set by _build_http when prof enabled
+        self.flightrec: Any = None  # armed in start() when SLO set
 
     def start(self) -> "NameNode":
         self._server.start()
         self._monitor.start()
         if self._http_port >= 0:
             self._http = self._build_http(self._http_port).start()
+        # armed AFTER http so breach bundles carry folded stacks when
+        # the profiler is on; tpumr.nn.incident.slo.ms=0 keeps it off
+        from tpumr.metrics.flightrec import NNFlightRecorder
+        self.flightrec = NNFlightRecorder.from_conf(self.conf, self,
+                                                    self.sampler)
+        if self.flightrec is not None:
+            self.flightrec.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.flightrec is not None:
+            self.flightrec.stop()
         if self.sampler is not None:
             self.sampler.stop()
         if self._http is not None:
@@ -1496,10 +1658,11 @@ class NameNode:
         srv = StatusHttpServer("namenode", port=port)
 
         # uniform /metrics (same payload shape as the mapred daemons —
-        # one scraper config covers the whole cluster)
-        from tpumr.metrics import MetricsSystem
-        ms = MetricsSystem("namenode")
-        reg = ms.new_registry("namenode")
+        # one scraper config covers the whole cluster); the system
+        # itself lives on the daemon (__init__) so the lock/op/editlog
+        # series exist even before/without HTTP
+        ms = self.metrics
+        reg = self._mreg
 
         def _ns_gauges() -> dict:
             with self.ns.lock:
@@ -1510,6 +1673,8 @@ class NameNode:
                                  if i.get("type") == "file"),
                     "blocks": sum(len(i.get("blocks", []))
                                   for i in self.ns.namespace.values()),
+                    "audit_emitted": self.ns.audit_emitted,
+                    "audit_suppressed": self.ns.audit_suppressed,
                 }
 
         reg.set_gauge("namespace", _ns_gauges)
@@ -1539,6 +1704,33 @@ class NameNode:
         srv.add_json("namenode", summary)
         srv.add_json("datanodes", lambda q: self.ns.datanode_report())
         srv.add_json("fsck", lambda q: self.ns.fsck(q.get("path", "/")))
+
+        # cluster-wide hot-block ranking (merged datanode SpaceSaving
+        # slices) — a TOP-LEVEL tool surface like /metrics: the future
+        # replicate/devcache-pin policy and operators read the same rows
+        def hotblocks(q: dict) -> dict:
+            n = int(q.get("n", 16))
+            return {"total_reads": self.ns.hot_blocks.total_reads(),
+                    "top": self.ns.get_hot_blocks(n)}
+
+        srv.add_raw("hotblocks", hotblocks)
+        srv.add_json("hotblocks", hotblocks)
+
+        # incident bundles, same endpoints as the master so one
+        # operator workflow covers both roles
+        def incidents_json(q: dict) -> list:
+            return (self.flightrec.list_incidents()
+                    if self.flightrec is not None else [])
+
+        def incident_raw(q: dict) -> dict:
+            if self.flightrec is None:
+                raise ValueError(
+                    "NN flight recorder disabled "
+                    "(tpumr.nn.incident.slo.ms is 0)")
+            return self.flightrec.read_incident(q["name"])
+
+        srv.add_json("incidents", incidents_json)
+        srv.add_raw("incident", incident_raw)
 
         # HTML view ≈ webapps/hdfs/dfshealth.jsp
         from tpumr.http import html_escape, html_table
@@ -1624,19 +1816,51 @@ class NameNode:
     # ------------------------------------------------------------ RPC surface
     # thin delegation so the RPC registry exposes exactly the protocol
 
+    def _op(self, name: str):
+        """Per-op latency timer (``nn_op_seconds{op=}``, the labeled-
+        family convention) wrapping each namespace RPC, plus the
+        ``nn.op.slow`` fault seam — the stall lands inside the timed
+        window but BEFORE the namespace lock, modelling a slow disk /
+        GC pause on the op path; because the histogram sees it, it
+        drives the NN incident e2e the way jt.heartbeat.slow drives
+        the master's."""
+        from tpumr.utils.fi import fires
+        delay_s = 0.0
+        if fires("nn.op.slow", self.conf):
+            from tpumr.core import confkeys
+            delay_s = confkeys.get_int(
+                self.conf, "tpumr.fi.nn.op.slow.ms") / 1000.0
+        h = self._op_hists.get(name)
+        if h is None:
+            h = self._mreg.histogram(f"nn_op_seconds|op={name}")
+            self._op_hists[name] = h
+        if not delay_s:
+            return h.time()
+        return self._op_stalled(h, delay_s)
+
+    @staticmethod
+    @contextlib.contextmanager
+    def _op_stalled(h, delay_s: float):
+        with h.time():
+            time.sleep(delay_s)
+            yield
+
     def get_protocol_version(self) -> int:
         return PROTOCOL_VERSION
 
     def create(self, path, client, replication=None, block_size=None,
                overwrite=True):
-        return self.ns.create(path, client, replication, block_size,
-                              overwrite)
+        with self._op("create"):
+            return self.ns.create(path, client, replication, block_size,
+                                  overwrite)
 
     def append(self, path, client):
-        return self.ns.append(path, client)
+        with self._op("append"):
+            return self.ns.append(path, client)
 
     def fsync(self, path, client, last_block_size):
-        return self.ns.fsync(path, client, last_block_size)
+        with self._op("fsync"):
+            return self.ns.fsync(path, client, last_block_size)
 
     def _mint_access(self, block_id, mode):
         """Short-lived per-block DataNode access stamp for the calling
@@ -1655,32 +1879,39 @@ class NameNode:
                                  block_id, mode, lifetime)
 
     def add_block(self, path, client, prev_block_size=-1, excluded=None):
-        out = self.ns.add_block(path, client, prev_block_size, excluded)
-        access = self._mint_access(out["block_id"], "rw")
-        if access is not None:
-            out["access"] = access
-        return out
+        with self._op("add_block"):
+            out = self.ns.add_block(path, client, prev_block_size,
+                                    excluded)
+            access = self._mint_access(out["block_id"], "rw")
+            if access is not None:
+                out["access"] = access
+            return out
 
     def abandon_block(self, path, client, block_id):
-        return self.ns.abandon_block(path, client, block_id)
+        with self._op("abandon_block"):
+            return self.ns.abandon_block(path, client, block_id)
 
     def complete(self, path, client, last_block_size):
-        return self.ns.complete(path, client, last_block_size)
+        with self._op("complete"):
+            return self.ns.complete(path, client, last_block_size)
 
     def renew_lease(self, client):
-        return self.ns.renew_lease(client)
+        with self._op("renew_lease"):
+            return self.ns.renew_lease(client)
 
     def get_block_locations(self, path):
-        out = self.ns.get_block_locations(path)
-        if self._rpc_secret is not None:
-            for b in out:
-                access = self._mint_access(b["block_id"], "r")
-                if access is not None:
-                    b["access"] = access
-        return out
+        with self._op("get_block_locations"):
+            out = self.ns.get_block_locations(path)
+            if self._rpc_secret is not None:
+                for b in out:
+                    access = self._mint_access(b["block_id"], "r")
+                    if access is not None:
+                        b["access"] = access
+            return out
 
     def mkdirs(self, path):
-        return self.ns.mkdirs(path)
+        with self._op("mkdirs"):
+            return self.ns.mkdirs(path)
 
     # per-service delegation tokens ≈ ClientProtocol.getDelegationToken/
     # renewDelegationToken/cancelDelegationToken (DFSClient token path)
@@ -1704,55 +1935,78 @@ class NameNode:
         return True
 
     def delete(self, path, recursive=True):
-        return self.ns.delete(path, recursive)
+        with self._op("delete"):
+            return self.ns.delete(path, recursive)
 
     def rename(self, src, dst):
-        return self.ns.rename(src, dst)
+        with self._op("rename"):
+            return self.ns.rename(src, dst)
 
     def set_replication(self, path, replication):
-        return self.ns.set_replication(path, replication)
+        with self._op("set_replication"):
+            return self.ns.set_replication(path, replication)
 
     def set_permission(self, path, mode):
-        return self.ns.set_permission(path, mode)
+        with self._op("set_permission"):
+            return self.ns.set_permission(path, mode)
 
     def set_owner(self, path, owner=None, group=None):
-        return self.ns.set_owner(path, owner, group)
+        with self._op("set_owner"):
+            return self.ns.set_owner(path, owner, group)
 
     def fsck(self, path="/"):
-        return self.ns.fsck(path)
+        with self._op("fsck"):
+            return self.ns.fsck(path)
 
     def report_bad_block(self, block_id, addr):
-        return self.ns.report_bad_block(block_id, addr)
+        with self._op("report_bad_block"):
+            return self.ns.report_bad_block(block_id, addr)
 
     def set_quota(self, path, ns_quota=None, sp_quota=None):
-        return self.ns.set_quota(path, ns_quota, sp_quota)
+        with self._op("set_quota"):
+            return self.ns.set_quota(path, ns_quota, sp_quota)
 
     def set_decommission(self, addr, action="start"):
-        return self.ns.set_decommission(addr, action)
+        with self._op("set_decommission"):
+            return self.ns.set_decommission(addr, action)
 
     def get_status(self, path):
-        return self.ns.get_status(path)
+        with self._op("get_status"):
+            return self.ns.get_status(path)
 
     def list_status(self, path):
-        return self.ns.list_status(path)
+        with self._op("list_status"):
+            return self.ns.list_status(path)
 
     def exists(self, path):
-        return self.ns.exists(path)
+        with self._op("exists"):
+            return self.ns.exists(path)
 
     def register_datanode(self, addr, capacity):
-        return self.ns.register_datanode(addr, capacity)
+        with self._op("register_datanode"):
+            return self.ns.register_datanode(addr, capacity)
 
-    def dn_heartbeat(self, addr, used, capacity, block_count):
-        return self.ns.dn_heartbeat(addr, used, capacity, block_count)
+    def dn_heartbeat(self, addr, used, capacity, block_count,
+                     hot_blocks=None):
+        with self._op("dn_heartbeat"):
+            return self.ns.dn_heartbeat(addr, used, capacity,
+                                        block_count, hot_blocks)
 
     def block_report(self, addr, blocks):
-        return self.ns.block_report(addr, blocks)
+        with self._op("block_report"):
+            return self.ns.block_report(addr, blocks)
 
     def block_received(self, addr, block_id, size):
-        return self.ns.block_received(addr, block_id, size)
+        with self._op("block_received"):
+            return self.ns.block_received(addr, block_id, size)
+
+    def get_hot_blocks(self, n=16):
+        with self._op("get_hot_blocks"):
+            return self.ns.get_hot_blocks(n)
 
     def refresh_nodes(self):
-        return self.ns.refresh_nodes()
+        with self._op("refresh_nodes"):
+            return self.ns.refresh_nodes()
 
     def refresh_service_acl(self) -> dict:
         """≈ RefreshAuthorizationPolicyProtocol.refreshServiceAcl
@@ -1779,19 +2033,25 @@ class NameNode:
         return self.ns.safemode
 
     def save_namespace(self):
-        return self.ns.save_namespace()
+        with self._op("save_namespace"):
+            return self.ns.save_namespace()
 
     def get_name_state(self):
-        return self.ns.get_name_state()
+        with self._op("get_name_state"):
+            return self.ns.get_name_state()
 
     def put_image(self, image, token=-1):
-        return self.ns.put_image(image, token)
+        with self._op("put_image"):
+            return self.ns.put_image(image, token)
 
     def get_blocks(self, addr, max_blocks=16):
-        return self.ns.get_blocks(addr, max_blocks)
+        with self._op("get_blocks"):
+            return self.ns.get_blocks(addr, max_blocks)
 
     def remove_replica(self, addr, block_id):
-        return self.ns.remove_replica(addr, block_id)
+        with self._op("remove_replica"):
+            return self.ns.remove_replica(addr, block_id)
 
     def datanode_report(self):
-        return self.ns.datanode_report()
+        with self._op("datanode_report"):
+            return self.ns.datanode_report()

@@ -432,6 +432,92 @@ class FlightRecorder:
             return json.load(f)
 
 
+class NNFlightRecorder(FlightRecorder):
+    """The NameNode's SLO watchdog — same tick/cooldown/bundle machinery
+    as the master's recorder, but the watched distributions are the
+    per-RPC-op latencies (``nn_op_seconds{op=}``) judged against
+    ``tpumr.nn.incident.slo.ms``. A breach bundle carries the namespace
+    lock's live holder/waiter row and wait/hold distributions plus every
+    op's cumulative latency — the "which op convoyed the namespace lock"
+    postmortem, cut at the breach."""
+
+    @classmethod
+    def from_conf(cls, conf: Any, namenode: Any,
+                  sampler: Any) -> "NNFlightRecorder | None":
+        """None unless ``tpumr.nn.incident.slo.ms`` > 0 (off by default —
+        unlike the master there is no committed-bench SLO to re-derive
+        yet; bench_dfs.py declares one explicitly). The incident dir
+        falls back to the name dir, which always exists."""
+        from tpumr.core import confkeys
+        slo_ms = confkeys.get_int(conf, "tpumr.nn.incident.slo.ms")
+        if slo_ms <= 0:
+            return None
+        d = conf.get("tpumr.prof.incident.dir") or namenode.ns.name_dir
+        return cls(
+            namenode, sampler, slo_ms=slo_ms,
+            cooldown_ms=confkeys.get_int(
+                conf, "tpumr.prof.incident.cooldown.ms"),
+            incident_dir=os.path.join(str(d), "incidents"),
+            conf=conf)
+
+    def _windowed_p99s(self) -> "list[tuple[str, float]]":
+        out = []
+        for op, hist in list(getattr(self.master,
+                                     "_op_hists", {}).items()):
+            metric = f"nn_op_seconds|op={op}"
+            cur = hist.typed()
+            delta = typed_delta(cur, self._prev.get(metric))
+            self._prev[metric] = cur
+            if delta and delta.get("count"):
+                out.append((metric, typed_p99(delta)))
+        return out
+
+    def bundle(self, breaches: "list[tuple]") -> dict:
+        from tpumr.metrics.histogram import Histogram
+        from tpumr.metrics.locks import lock_table
+        nn = self.master
+        snaps = nn.metrics.snapshot()
+        reg = snaps.get("namenode", {})
+        rpc = snaps.get("rpc", {})
+        wait_hold = {
+            name: val for name, val in reg.items()
+            if name.startswith(("nn_lock_wait_seconds|",
+                                "nn_lock_hold_seconds|"))}
+        ops = {name.split("op=", 1)[-1]: val
+               for name, val in reg.items()
+               if name.startswith("nn_op_seconds|")}
+        # one all-ops distribution (the master bundle's "seconds"
+        # slot); the per-op breakdown rides in "phases", mirroring the
+        # heartbeat-phase layout so bundle consumers read both roles
+        # the same way
+        merged = Histogram("nn_op_seconds")
+        for h in list(getattr(nn, "_op_hists", {}).values()):
+            merged.merge_typed(h.typed())
+        return {
+            "schema": SCHEMA,
+            "ts": time.time(),
+            "role": "namenode",
+            "slo_ms": int(self.slo_s * 1000),
+            "reason": [{"metric": b[0], "p99_s": round(b[1], 6),
+                        "slo_s": round(b[2] if len(b) > 2
+                                       else self.slo_s, 6)}
+                       for b in breaches],
+            "workload": {"scenario": "", "brownout": {"level": 0},
+                         "classes": {}},
+            "folded_stacks": self.sampler.folded(
+                max(2 * TICK_S, 5.0)) if self.sampler else "",
+            "subsystem_shares": self.sampler.subsystem_shares()
+            if self.sampler else {},
+            "locks": {"live": lock_table(), "wait_hold": wait_hold},
+            "rpc": {k: rpc.get(k) for k in
+                    ("rpc_inflight", "rpc_inflight_peak",
+                     "rpc_handler_threads") if k in rpc},
+            "heartbeat": {"seconds": merged.snapshot(), "phases": ops,
+                          "datanodes": len(nn.ns.datanodes)},
+            "spans": [],
+        }
+
+
 def validate_incident(doc: Any) -> "list[str]":
     """Schema check for one incident bundle — same stance as the trace
     module's ``validate_chrome_trace``: an empty list means the bundle

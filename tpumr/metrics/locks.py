@@ -14,10 +14,12 @@ grew. These are the first series the control-plane scale-out refactor
 is judged against (ROADMAP, bench_scale.py).
 
 Since the lock decomposition (PR 8) the master runs on SIX lock
-classes with a fixed acquisition order, ascending by rank::
+classes with a fixed acquisition order, ascending by rank (the
+``namespace`` class is the NameNode's — a separate process, slotted
+into the one table so tooling sees every ranked lock)::
 
     tracker-beat(5) -> scheduler(10) -> pipeline(15) -> global(20)
-        -> trackers(30) -> job(40)
+        -> namespace(25) -> trackers(30) -> job(40)
 
 The ``pipeline`` rank (the DAG engine's state lock) sits below
 ``global`` because recording a stage submission and reading member-job
@@ -51,11 +53,15 @@ RANK_TRACKER_BEAT = 5    # one tracker's heartbeat processing
 RANK_SCHEDULER = 10      # scheduler passes (before_heartbeat / assign)
 RANK_PIPELINE = 15       # DAG engine state (PipelineInProgress tables)
 RANK_GLOBAL = 20         # job table, commit grants, admin swaps
+RANK_NAMESPACE = 25      # the NameNode's FSNamesystem (DFS control
+#                          plane; its own process — co-held with no
+#                          master lock today, ranked so the analyzer
+#                          and /threads see it like any master class)
 RANK_TRACKERS = 30       # tracker registry stripes
 RANK_JOB = 40            # one JobInProgress's task bookkeeping
 
 _ORDER_NAMES = "tracker-beat(5) -> scheduler(10) -> pipeline(15) " \
-               "-> global(20) -> trackers(30) -> job(40)"
+               "-> global(20) -> namespace(25) -> trackers(30) -> job(40)"
 
 #: debug-mode ordering assertion: on under ``__debug__`` (plain
 #: ``python``), off under ``python -O`` or TPUMR_LOCK_ORDER_CHECK=0

@@ -15,6 +15,10 @@ same blind spot). This package supplies the load side:
   completion-event polls, fetch-failure reports — only task execution
   is faked, because task bytes are the data plane and this harness
   measures the control plane.
+- :mod:`tpumr.scale.simdfs` — ``SimDFSClient``/``SimDFSFleet``: the
+  storage twin — N real ``DFSClient`` instances generating a skewed
+  read-dominant op mix against a live NameNode + DataNodes, the load
+  side of ``bench_dfs.py`` and ``tpumr simulate -dfs``.
 - :mod:`tpumr.scale.driver` — ``ScaleDriver``: submits synthetic
   multi-job workloads over the client RPC surface and waits for them.
 - :mod:`tpumr.scale.scenario` — the scenario lab: named,
@@ -35,9 +39,10 @@ from tpumr.scale.scenario import (BUILTIN_SCENARIOS, ScenarioError,
                                   ScenarioRunner, list_scenarios,
                                   load_spec, plan, run_named,
                                   validate_spec)
+from tpumr.scale.simdfs import SimDFSClient, SimDFSFleet
 from tpumr.scale.simtracker import SimFleet, SimTracker
 
 __all__ = ["BUILTIN_SCENARIOS", "ScaleDriver", "ScenarioError",
-           "ScenarioRunner", "SimFleet", "SimTracker",
-           "list_scenarios", "load_spec", "plan", "run_named",
-           "validate_spec"]
+           "ScenarioRunner", "SimDFSClient", "SimDFSFleet", "SimFleet",
+           "SimTracker", "list_scenarios", "load_spec", "plan",
+           "run_named", "validate_spec"]

@@ -32,9 +32,14 @@ import hashlib
 import json
 import posixpath
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, unquote, urlparse
+
+from tpumr.metrics.core import MetricsSystem
+from tpumr.metrics.histogram import BYTES
+from tpumr.metrics.sampler import StackSampler
 
 PERMISSIONS_KEY = "tdfsproxy.permissions.file"
 SSL_CERT_KEY = "tdfsproxy.ssl.cert"
@@ -110,6 +115,17 @@ class TdfsProxy:
                 f"{PERMISSIONS_KEY} is required (fail-closed: a proxy "
                 f"with no permissions file would deny everyone anyway)")
         self.permissions = load_permissions(str(perm_path))
+        # the uniform daemon observability surface: the proxy has its
+        # own stdlib HTTP stack (not StatusHttpServer), so it serves
+        # /metrics, /metrics/prom, /stacks and /flame from the same
+        # port as the data routes — same payload shapes as every other
+        # daemon, so one scraper config covers the proxy too
+        self.metrics = MetricsSystem("tdfsproxy")
+        self._mreg = self.metrics.new_registry("tdfsproxy")
+        self._req_hists: "dict[str, Any]" = {}
+        self._data_bytes = self._mreg.histogram("proxy_data_bytes",
+                                                bounds=BYTES)
+        self.sampler = StackSampler.from_conf(conf, self.metrics)
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -176,12 +192,16 @@ class TdfsProxy:
         return f"{self.scheme}://{host}:{self.port}"
 
     def start(self) -> "TdfsProxy":
+        if self.sampler is not None:
+            self.sampler.start()
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         name="tdfsproxy", daemon=True)
         self._thread.start()
         return self
 
     def stop(self) -> None:
+        if self.sampler is not None:
+            self.sampler.stop()
         self.server.shutdown()
         self.server.server_close()
 
@@ -239,17 +259,72 @@ class TdfsProxy:
             rel = rel[len(base):]
         return "/" + rel.lstrip("/")
 
+    @staticmethod
+    def _send_body(req: BaseHTTPRequestHandler, body: bytes,
+                   content_type: str) -> None:
+        req.send_response(200)
+        req.send_header("Content-Type", content_type)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _serve_status(self, req: BaseHTTPRequestHandler, path: str,
+                      query: dict) -> None:
+        """Operator surfaces — unauthenticated like every other daemon's
+        status port; they expose counters and stacks, never file data."""
+        if path in ("metrics", "json/metrics"):
+            self._send_body(req, json.dumps(self.metrics.snapshot())
+                            .encode(), "application/json")
+            return
+        if path == "metrics/prom":
+            from tpumr.metrics.prometheus import render_exposition
+            self._send_body(req, render_exposition(
+                self.metrics.typed_snapshot()).encode(),
+                "text/plain; version=0.0.4")
+            return
+        # /stacks and /flame need the opt-in sampler
+        if self.sampler is None:
+            self._send_error(req, 404,
+                             "profiling is off (tpumr.prof.enabled)")
+            return
+        seconds = float(query["seconds"]) if "seconds" in query else None
+        if path == "stacks":
+            self._send_body(req, self.sampler.folded(seconds).encode(),
+                            "text/plain")
+        else:
+            self._send_body(req, self.sampler.flame_svg(
+                seconds, title="tdfsproxy flame graph").encode(),
+                "image/svg+xml")
+
     def _serve(self, req: BaseHTTPRequestHandler) -> None:
         parsed = urlparse(req.path)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         user = query.get("user.name", "")
+        status = parsed.path.strip("/")
+        if status in ("metrics", "json/metrics", "metrics/prom",
+                      "stacks", "flame"):
+            self._serve_status(req, status, query)
+            return
         route, _, rel = parsed.path.lstrip("/").partition("/")
         rel = unquote(rel)
         if route not in ("listPaths", "data", "fileChecksum"):
             self._send_error(req, 404,
                              "routes: /listPaths/<path>, /data/<path>, "
-                             "/fileChecksum/<path>")
+                             "/fileChecksum/<path> (+ /metrics, "
+                             "/metrics/prom, /stacks, /flame)")
             return
+        t0 = time.monotonic()
+        try:
+            self._serve_data(req, route, rel, user, query)
+        finally:
+            h = self._req_hists.get(route)
+            if h is None:
+                h = self._req_hists[route] = self._mreg.histogram(
+                    f"proxy_request_seconds|route={route}")
+            h.observe(time.monotonic() - t0)
+
+    def _serve_data(self, req: BaseHTTPRequestHandler, route: str,
+                    rel: str, user: str, query: dict) -> None:
         if not user:
             self._send_error(req, 401, "user.name query param required")
             return
@@ -312,6 +387,7 @@ class TdfsProxy:
         with fs.open(full) as f:
             for chunk in iter(lambda: f.read(1 << 20), b""):
                 req.wfile.write(chunk)
+        self._data_bytes.observe(st.length)
 
 
 def main(argv: "list[str]", conf: Any = None) -> int:

@@ -18,7 +18,8 @@ This pass re-derives the invariant from source:
     the Critical Path!").
 
 Scope: ``tpumr/mapred/`` + ``tpumr/ipc/`` + ``tpumr/metrics/`` (where
-the ranks live). Lock identity is derived from
+the ranks live) + ``tpumr/dfs/`` (the NameNode's ``namespace`` rank —
+PR 17). Lock identity is derived from
 ``InstrumentedRLock(..., rank=...)`` assignments; the rank constants
 are parsed out of ``tpumr/metrics/locks.py`` itself so this file never
 restates the order. Unranked locks (plain ``threading.Lock``/``RLock``)
@@ -39,6 +40,12 @@ Heuristics, stated plainly (a repo-native analyzer can afford them):
 - Code inside nested ``def``/``lambda`` is NOT considered to run under
   an enclosing ``with`` (it is deferred work); it is analyzed as its
   own function and charged at its call sites.
+- A ``# tpulint: disable=lock-blocking`` pragma ON THE BLOCKING CALL
+  ITSELF (not just at a locked call site) removes it as a blocking
+  SOURCE everywhere — direct and through transitive chains. This is
+  for invariant-documented blocking the design pins under a lock (the
+  edit log's write-ahead roll); the justification comment lives at the
+  one line that blocks, instead of a pragma at every caller.
 """
 
 from __future__ import annotations
@@ -66,7 +73,8 @@ TUPLE_LOCK_METHODS = {"shard_of": "RANK_TRACKERS"}
 #: actually declares when it is in the corpus
 DEFAULT_RANKS = {"RANK_TRACKER_BEAT": 5, "RANK_SCHEDULER": 10,
                  "RANK_PIPELINE": 15, "RANK_GLOBAL": 20,
-                 "RANK_TRACKERS": 30, "RANK_JOB": 40}
+                 "RANK_NAMESPACE": 25, "RANK_TRACKERS": 30,
+                 "RANK_JOB": 40}
 
 _SOCKETY = ("sock", "conn", "channel")
 _THREADY = ("thread", "worker", "pumper", "_t")
@@ -379,7 +387,8 @@ class _FuncScanner:
             if not isinstance(node, ast.Call):
                 continue
             kind = _blocking_kind(node)
-            if kind:
+            if kind and not self.m.pragmas.suppressed(
+                    "lock-blocking", node.lineno):
                 self.fi.blocking.append((kind, node.lineno))
                 if held:
                     top = max(held)
@@ -549,7 +558,7 @@ def _short(key: str) -> str:
 def check_locks(mods: "list[Module]") -> "list[Finding]":
     scope = [m for m in mods
              if "/mapred/" in f"/{m.rel}" or "/ipc/" in f"/{m.rel}"
-             or "/metrics/" in f"/{m.rel}"]
+             or "/metrics/" in f"/{m.rel}" or "/dfs/" in f"/{m.rel}"]
     world = LockWorld(scope)
     by_name = {m.name: m for m in scope}
     findings: "list[Finding]" = []

@@ -64,6 +64,13 @@ Observability seams (the flight-recorder / continuous-profiler loop):
                                  the windowed heartbeat p99 SLO; the
                                  flight recorder's incident bundle is
                                  the quarry's predator
+  nn.op.slow                     BEHAVIORAL fault — NameNode op
+                                 handling stalls ``tpumr.fi.nn.op.
+                                 slow.ms`` (default 400) before the
+                                 real op runs, breaching the windowed
+                                 nn_op_seconds p99 SLO; the NN flight
+                                 recorder's incident bundle is the
+                                 quarry's predator
 
 Control-plane partition seams (``RpcClient`` with ``fi_conf`` set —
 the master-restart / partition-tolerance chaos loop):
