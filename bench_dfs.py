@@ -18,6 +18,11 @@ MiniDFSCluster per rung, and records both sides of every rung:
 - ``hot_top1_share``      — the skew the SpaceSaving hot-block
   pipeline (DN sketch → heartbeat piggyback → NN ``/hotblocks``)
   surfaces: the designated hot file must dominate;
+- ``hot_top1_replicas`` / ``hot_top1_boost`` — the auto-replication
+  receipt: the seed files start at replication=2, so a boosted hot
+  block visibly spreads to a third datanode under sustained skew;
+- ``editlog_group_ops_mean`` — mutations absorbed per editlog fsync
+  (group commit coalescing; 1.0 means every mutation paid its own);
 - ``lag_p99_s``           — client schedule overrun: the first
   externally visible saturation symptom.
 
@@ -83,7 +88,11 @@ def _log_row(row: dict) -> None:
         f"{row['read_mb_s']:.1f}MB/s rtt p99 "
         f"{row['read_rtt_p99_s'] * 1e3:.2f}ms · lag p99 "
         f"{row['lag_p99_s'] * 1e3:.2f}ms · hot top1 "
-        f"{row['hot_top1_share']:.0%} · {row['ops']} ops"
+        f"{row['hot_top1_share']:.0%} "
+        f"({row.get('hot_top1_replicas', 0)} repl, boost "
+        f"{row.get('hot_top1_boost', 0)}) · grp "
+        f"{row.get('editlog_group_ops_mean', 0):.1f} · "
+        f"{row['ops']} ops"
         + ("" if row["completed"]
            else f" · {row['errors']} ERRORS"))
 
@@ -122,6 +131,11 @@ def run_bench(fleets: "list[int] | None" = None) -> dict:
         "read_slo_s": read_slo_s,
         "slo_series": ["nn_op_p99_s", "read_rtt_p99_s"],
         "max_sustainable_clients": max(sustainable, default=0),
+        # highest replica count the hot block reached across the ramp:
+        # seeds write at replication=2, so any value above 2 is the
+        # hot-block auto-replication policy demonstrably spreading load
+        "hot_max_replicas": max(
+            (r.get("hot_top1_replicas", 0) for r in rows), default=0),
         "rows": rows,
     }
 
@@ -145,7 +159,9 @@ def compare_with_prior(prior: "dict | None", report: dict) -> None:
             f"->{row['lock_wait_share']:.2f}")
     log(f"[dfs] vs prior: max sustainable "
         f"{prior.get('max_sustainable_clients', 0)}"
-        f"->{report['max_sustainable_clients']} clients")
+        f"->{report['max_sustainable_clients']} clients · hot max "
+        f"replicas {prior.get('hot_max_replicas', 0)}"
+        f"->{report['hot_max_replicas']}")
 
 
 def main() -> None:

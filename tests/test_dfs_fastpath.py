@@ -1,0 +1,409 @@
+"""DFS fast-path tests: editlog group commit (ordering / durability /
+batching), striped namespace locking under cross-stripe churn, and the
+hot-block boost/cool-down state machine (docs/DFS_FASTPATH.md)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpumr.dfs.editlog import FSEditLog
+from tpumr.dfs.hotblocks import SpaceSaving
+from tpumr.dfs.mini_cluster import MiniDFSCluster
+from tpumr.dfs.namenode import FSNamesystem
+from tpumr.dfs.nslock import NamespaceLocks
+from tpumr.mapred.jobconf import JobConf
+
+
+def small_conf(block_size=1024, replication=2):
+    conf = JobConf()
+    conf.set("dfs.block.size", block_size)
+    conf.set("dfs.replication", replication)
+    conf.set("tdfs.replication.interval.s", 0.2)
+    conf.set("tdfs.datanode.expiry.s", 1.5)
+    return conf
+
+
+# ------------------------------------------------------------ editlog
+
+
+class TestEditlogGroupCommit:
+    def test_concurrent_appends_durable_and_ordered(self, tmp_path):
+        """The WAL contract under concurrency: every log() that
+        returned is on disk, journal order is append order (each
+        writer's own records replay in its program order), and the
+        group-commit counters stay coherent."""
+        el = FSEditLog(str(tmp_path))
+        writers, per = 8, 25
+
+        def write(w):
+            for i in range(per):
+                el.log({"op": "t", "w": w, "i": i})
+
+        threads = [threading.Thread(target=write, args=(w,))
+                   for w in range(writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+        el.close()
+        seen = {w: [] for w in range(writers)}
+        n = 0
+        for op in FSEditLog.replay(str(tmp_path)):
+            seen[op["w"]].append(op["i"])
+            n += 1
+        assert n == writers * per
+        for w in range(writers):
+            assert seen[w] == list(range(per))   # per-writer order kept
+        assert el.records == writers * per
+        assert 1 <= el.syncs <= el.records
+
+    def test_slow_fsync_batches(self, tmp_path, monkeypatch):
+        """With fsync made slow, concurrent appenders MUST coalesce:
+        one leader's fsync covers the records appended while it was in
+        flight, so syncs << records and the group histogram sees
+        batches > 1."""
+        from tpumr.metrics.histogram import Histogram
+        real_fsync = os.fsync
+
+        def slow_fsync(fd):
+            time.sleep(0.01)
+            real_fsync(fd)
+
+        monkeypatch.setattr("tpumr.dfs.editlog.os.fsync", slow_fsync)
+        el = FSEditLog(str(tmp_path))
+        group = Histogram("nn_editlog_group_ops")
+        el.bind_metrics(Histogram("a"), Histogram("s"), Histogram("b"),
+                        group)
+        writers, per = 6, 10
+
+        def write(w):
+            for i in range(per):
+                el.log({"op": "t", "w": w, "i": i})
+
+        threads = [threading.Thread(target=write, args=(w,))
+                   for w in range(writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        el.close()
+        assert el.records == writers * per
+        assert el.syncs < el.records          # batching happened
+        snap = group.snapshot()
+        assert snap["count"] == el.syncs
+        assert snap["max"] > 1                # some fsync covered many
+        assert sum(1 for _ in FSEditLog.replay(str(tmp_path))) \
+            == writers * per
+
+    def test_failed_fsync_propagates_then_recovers(self, tmp_path,
+                                                   monkeypatch):
+        """A leader whose fsync fails must raise to ITS caller while
+        followers retry as leaders — a failed sync never silently
+        'covers' anyone."""
+        real_fsync = os.fsync
+        fail_once = {"armed": True}
+
+        def flaky_fsync(fd):
+            if fail_once["armed"]:
+                fail_once["armed"] = False
+                raise OSError("injected fsync failure")
+            real_fsync(fd)
+
+        el = FSEditLog(str(tmp_path))
+        monkeypatch.setattr("tpumr.dfs.editlog.os.fsync", flaky_fsync)
+        with pytest.raises(OSError):
+            el.log({"op": "t", "i": 0})
+        # the journal recovers: the next log() syncs for real and is
+        # durable (the failed record was appended, so it is covered too)
+        el.log({"op": "t", "i": 1})
+        el.close()
+        ops = list(FSEditLog.replay(str(tmp_path)))
+        assert [op["i"] for op in ops] == [0, 1]
+
+
+# ------------------------------------------------------------ stripe map
+
+
+class TestNamespaceLocks:
+    def test_stripe_map(self):
+        locks = NamespaceLocks(stripes=8, depth=2)
+        # shallower than the stripe depth: unstripable
+        assert locks.stripe_index("/") is None
+        assert locks.stripe_index("/user") is None
+        # same depth-2 prefix -> same stripe; deterministic
+        a = locks.stripe_index("/user/alice/out/part-0")
+        assert a is not None
+        assert locks.stripe_index("/user/alice/tmp") == a
+        assert locks.stripe_index("/user/alice") == a
+        # distinct prefixes spread over stripes (8 stripes, many users:
+        # at least two distinct stripes must appear)
+        idxs = {locks.stripe_index(f"/user/u{i}/f") for i in range(16)}
+        assert len(idxs) > 1
+
+    def test_striped_ctx_covers_and_structural_escalation(self):
+        locks = NamespaceLocks(stripes=4, depth=2)
+        with locks.for_paths("/user/alice/a", "/user/bob/b"):
+            assert locks.covers("/user/alice/x")
+            assert locks.covers("/user/bob/y")
+            assert not locks.structural_held()
+        # any shallow path escalates the whole op to structural
+        with locks.for_paths("/user/alice/a", "/user"):
+            assert locks.structural_held()
+            assert locks.covers("/anything/at/all")
+
+
+STRESS_WRITERS = 4
+STRESS_ROUNDS = 12
+
+
+class TestStripeStress:
+    """Concurrent rename/delete churn racing reads across stripe
+    boundaries on a live cluster: no lost updates, no deadlocks, and
+    readers always see whole files. Run with TPUMR_LOCK_ORDER_CHECK=1
+    to additionally assert the global->stripe->blocks acquisition
+    order on every op."""
+
+    def test_churn_across_stripes(self, tmp_path):
+        conf = small_conf()
+        payload = bytes(range(256)) * 8
+        with MiniDFSCluster(num_datanodes=3, conf=conf) as cluster:
+            seed = cluster.client()
+            with seed.create("/bench/data/shared.bin") as f:
+                f.write(payload)
+            seed.mkdirs("/xdst")
+            errors = []
+            stop = threading.Event()
+
+            def writer(w):
+                cli = cluster.client()
+                try:
+                    home = f"/user/w{w}"
+                    cli.mkdirs(home)
+                    for i in range(STRESS_ROUNDS):
+                        src = f"{home}/a_{i}"
+                        with cli.create(src) as f:
+                            f.write(b"x" * 512)
+                        if i % 3 == 0:
+                            # cross-stripe rename: /user/w* -> /xdst
+                            assert cli.rename(src, f"/xdst/w{w}_{i}")
+                        elif i % 3 == 1:
+                            # same-stripe rename then delete
+                            assert cli.rename(src, src + ".r")
+                            assert cli.delete(src + ".r")
+                        else:
+                            assert cli.delete(src)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("writer", w, e))
+                finally:
+                    cli.close()
+
+            def reader():
+                cli = cluster.client()
+                try:
+                    while not stop.is_set():
+                        with cli.open("/bench/data/shared.bin") as f:
+                            assert f.read() == payload
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("reader", 0, e))
+                finally:
+                    cli.close()
+
+            def lister():
+                cli = cluster.client()
+                try:
+                    while not stop.is_set():
+                        # structural (shallow) ops racing striped ones
+                        cli.list_status("/")
+                        cli.list_status("/xdst")
+                        cli.exists("/user")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("lister", 0, e))
+                finally:
+                    cli.close()
+
+            threads = [threading.Thread(target=writer, args=(w,))
+                       for w in range(STRESS_WRITERS)]
+            aux = [threading.Thread(target=reader),
+                   threading.Thread(target=lister)]
+            for t in threads + aux:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            stop.set()
+            for t in aux:
+                t.join(timeout=30)
+            # no deadlocks (every thread finished), no op failures
+            assert not any(t.is_alive() for t in threads + aux)
+            assert errors == []
+            # no lost updates: exactly the cross-stripe renames
+            # survive, everything else was deleted
+            verify = cluster.client()
+            try:
+                names = {st["path"].rsplit("/", 1)[-1]
+                         for st in verify.list_status("/xdst")}
+                want = {f"w{w}_{i}" for w in range(STRESS_WRITERS)
+                        for i in range(0, STRESS_ROUNDS, 3)}
+                assert names == want
+                for w in range(STRESS_WRITERS):
+                    assert verify.list_status(f"/user/w{w}") == []
+            finally:
+                verify.close()
+
+
+# ------------------------------------------------------------ hot blocks
+
+
+class TestSpaceSavingDecay:
+    def test_decay_halves_and_drops(self):
+        sk = SpaceSaving(k=8)
+        for _ in range(100):
+            sk.offer("hot")
+        sk.offer("cold")
+        sk.decay(0.5)
+        assert sk.estimate("hot") == 50
+        assert sk.estimate("cold") == 0     # decayed below one count
+        assert sk.total == pytest.approx(50.5)
+        sk.decay(1.0)                       # no-op at factor >= 1
+        assert sk.estimate("hot") == 50
+        # fractional aging: repeated gentle decay must NOT collapse a
+        # small count by a whole unit per round (the int-truncation
+        # failure mode this sketch explicitly avoids)
+        for _ in range(10):
+            sk.decay(0.99)
+        assert sk.estimate("hot") > 40
+
+    def test_decay_to_empty(self):
+        sk = SpaceSaving(k=4)
+        sk.offer("a", by=3)
+        sk.decay(0.0)
+        assert len(sk) == 0 and sk.total == 0
+
+
+def _hot_ns(tmp_path, **conf_kv):
+    conf = small_conf()
+    conf.set("tdfs.hotblocks.replicate.share", 0.2)
+    conf.set("tdfs.hotblocks.replicate.min.reads", 10)
+    conf.set("tdfs.hotblocks.replicate.cap", 3)
+    conf.set("tdfs.hotblocks.cool.s", 0.2)
+    for k, v in conf_kv.items():
+        conf.set(k, v)
+    ns = FSNamesystem(str(tmp_path / "name"), conf)
+    dns = [f"127.0.0.1:{7001 + i}" for i in range(3)]
+    for addr in dns:
+        ns.register_datanode(addr, 1 << 30)
+    return ns, dns
+
+
+def _make_block(ns, path="/hot.bin", replication=2):
+    ns.create(path, "cli", replication, 1024, True)
+    meta = ns.add_block(path, "cli")
+    bid = meta["block_id"]
+    for addr in meta["targets"]:
+        ns.block_received(addr, bid, 512)
+    ns.complete(path, "cli", 512)
+    return bid
+
+
+def _fold_hot(ns, addr, bid, reads, total):
+    ns.hot_blocks.fold(addr, {"total": total,
+                              "top": [[str(bid), reads, 0]]})
+
+
+class TestHotBlockPolicy:
+    def test_boost_replicate_cooldown_cycle(self, tmp_path):
+        """The full state machine: hot -> boosted -> extra replica
+        scheduled -> cools -> boost expires -> extra replica trimmed."""
+        ns, dns = _hot_ns(tmp_path)
+        bid = _make_block(ns)
+        assert len(ns.block_locations[bid]) == 2
+        _fold_hot(ns, dns[0], bid, reads=40, total=50)   # share 0.8
+        assert ns.hotblock_check() == 1
+        assert ns.hot_boost[bid]["boost"] == 3
+        # the ordinary replication sweep schedules the extra copy
+        assert ns.replication_check() == 1
+        cmds = [c for addr in dns for c in ns.commands.get(addr, [])
+                if c.get("type") == "replicate"
+                and c.get("block_id") == bid]
+        assert len(cmds) == 1
+        target = cmds[0]["targets"][0]
+        ns.block_received(target, bid, 512)              # copy lands
+        assert len(ns.block_locations[bid]) == 3
+        # still hot: steady state, nothing more to schedule
+        _fold_hot(ns, dns[0], bid, reads=40, total=50)
+        ns.hotblock_check()
+        assert ns.replication_check() == 0
+        # cools: the sketch decays away, the boost expires after
+        # cool.s, and the same sweep trims back to base replication
+        _fold_hot(ns, dns[0], bid, reads=1, total=50)
+        time.sleep(0.25)
+        assert ns.hotblock_check() == 1                  # expiry
+        assert bid not in ns.hot_boost
+        assert ns.replication_check() >= 1               # the trim
+        assert len(ns.block_locations[bid]) == 2
+
+    def test_cap_respected_under_sustained_skew(self, tmp_path):
+        """Sustained skew must not creep replicas past the cap: round
+        after round of hot folds, the boost pins at the cap and the
+        sweep schedules nothing once the cap-many replicas exist."""
+        ns, dns = _hot_ns(tmp_path,
+                          **{"tdfs.hotblocks.replicate.cap": 2})
+        bid = _make_block(ns, replication=1)
+        assert len(ns.block_locations[bid]) == 1
+        for round_no in range(6):
+            _fold_hot(ns, dns[0], bid, reads=90, total=100)
+            ns.hotblock_check()
+            assert ns.hot_boost[bid]["boost"] == 2       # never 3
+            scheduled = ns.replication_check()
+            for addr in dns:
+                for c in ns.commands.get(addr, []):
+                    if c.get("type") == "replicate" and \
+                            c.get("block_id") == bid:
+                        for t in c["targets"]:
+                            ns.block_received(t, bid, 512)
+                ns.commands[addr] = []
+            if round_no == 0:
+                assert scheduled == 1                    # 1 -> cap
+            else:
+                # cap-many replicas exist; sustained skew adds nothing
+                assert scheduled == 0
+                assert len(ns.block_locations[bid]) == 2
+        assert len(ns.block_locations[bid]) == 2
+
+    def test_min_reads_floor(self, tmp_path):
+        """100%-share on a near-idle cluster is NOT hot: the absolute
+        read floor keeps singleton blocks unboosted."""
+        ns, dns = _hot_ns(tmp_path)
+        bid = _make_block(ns)
+        _fold_hot(ns, dns[0], bid, reads=5, total=5)     # share 1.0
+        assert ns.hotblock_check() == 0
+        assert bid not in ns.hot_boost
+
+    def test_datanode_sketch_decays_per_heartbeat(self, tmp_path):
+        """The DN applies the halflife decay before each heartbeat so
+        the NN's view tracks the current mix (the cool-down driver)."""
+        conf = small_conf()
+        conf.set("tpumr.dn.hotblocks.halflife.s", 0.5)
+        conf.set("tdfs.datanode.heartbeat.s", 0.1)
+        conf.set("tdfs.http.port", -1)
+        with MiniDFSCluster(num_datanodes=1, conf=conf) as cluster:
+            cli = cluster.client()
+            try:
+                with cli.create("/d.bin") as f:
+                    f.write(b"y" * 2048)
+                for _ in range(20):
+                    with cli.open("/d.bin") as f:
+                        f.read()
+            finally:
+                cli.close()
+            dn = cluster.datanodes[0]
+            time.sleep(0.3)
+            peak = sum(c[0] for c in dn._hot._counts.values())
+            assert peak > 0
+            # several half-lives with no reads: counts must fall
+            time.sleep(1.5)
+            later = sum(c[0] for c in dn._hot._counts.values())
+            assert later < peak

@@ -279,12 +279,27 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Streaming reduce input serialization."),
     _K('stream.reduce.output', 'str', 'text',
         "Streaming reduce output serialization."),
+    _K('tdfs.client.dn.conns', 'int', 2,
+        "Pooled connections per datanode in the client's shared "
+        "RPC pool."),
+    _K('tdfs.client.dn.idle.s', 'float', 60.0,
+        "Seconds an idle pooled datanode connection survives before "
+        "the pool closes it."),
     _K('tdfs.client.read.chunk.bytes', 'str', None,
         "Client read chunk size, bytes."),
+    _K('tdfs.client.read.pipeline.depth', 'int', 4,
+        "Chunk reads kept in flight per replica connection "
+        "(pipelined read window)."),
     _K('tdfs.client.write.chunk.bytes', 'str', None,
         "Client write chunk size, bytes."),
+    _K('tdfs.client.write.pipeline.depth', 'int', 4,
+        "Chunk writes kept in flight while shipping a block "
+        "(pipelined write window)."),
     _K('tdfs.datanode.capacity', 'int', 1099511627776,
         "Advertised datanode capacity, bytes."),
+    _K('tdfs.datanode.fdcache.capacity', 'int', 64,
+        "Open block-file descriptors the datanode read path caches "
+        "(pinned LRU)."),
     _K('tdfs.datanode.expiry.s', 'int', 10,
         "Seconds without a heartbeat before a datanode is declared "
         "dead."),
@@ -297,11 +312,32 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Edit-log volume that triggers a self-checkpoint, MiB."),
     _K('tdfs.edits.segment.mb', 'int', 16,
         "Edit-log segment roll size, MiB."),
+    _K('tdfs.hotblocks.cool.s', 'float', 15.0,
+        "Seconds a block must stay below the hot threshold before "
+        "its replica boost expires (cool-down)."),
+    _K('tdfs.hotblocks.replicate.cap', 'int', 4,
+        "Max replicas the hot-block policy will boost a block to "
+        "(bounded by live datanodes)."),
+    _K('tdfs.hotblocks.replicate.min.reads', 'int', 200,
+        "Minimum sketched reads a block needs before the hot-block "
+        "policy considers boosting it."),
+    _K('tdfs.hotblocks.replicate.share', 'float', 0.3,
+        "Share of all sketched reads at which a block is declared "
+        "hot and gets extra replicas."),
     _K('tdfs.http.port', 'int', -1,
         "NameNode status HTTP port (-1 = auto)."),
     _K('tdfs.lease.hard.limit.s', 'int', 60,
         "Write-lease hard expiry, seconds (lease recovery fences dead "
         "writers)."),
+    _K('tdfs.namenode.lock.stripe.depth', 'int', 2,
+        "Path components that pick a namespace lock stripe; shorter "
+        "paths use the structural lock."),
+    _K('tdfs.namenode.lock.stripes', 'int', 8,
+        "Namespace lock stripes (per-subtree locks); cross-stripe "
+        "ops take the structural lock."),
+    _K('tdfs.read.wire.codec', 'str', 'tlz',
+        "Wire compression codec for chunked block reads "
+        "('none' disables)."),
     _K('tdfs.replication.interval.s', 'float', 1.0,
         "NameNode re-replication monitor period, seconds."),
     _K('tdfs.superuser', 'str', '',
@@ -394,6 +430,10 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "distcp: skip up-to-date targets."),
     _K('tpumr.distcp.work', 'str', None,
         "distcp work/staging directory."),
+    _K('tpumr.dn.hotblocks.halflife.s', 'float', 60.0,
+        "Half-life of the datanode read sketch's per-heartbeat "
+        "exponential decay, seconds (0 disables; keeps the hot-block "
+        "view current so replica boosts can cool down)."),
     _K('tpumr.dn.hotblocks.k', 'int', 64,
         "SpaceSaving counters per datanode read sketch (bounds hot-"
         "block memory; any block read more than total/k times is "

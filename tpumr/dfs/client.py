@@ -10,6 +10,15 @@
   IOError/checksum mismatch (DFSInputStream.chooseDataNode + seekToNewSource);
 - a background thread renews the client lease while files are open for
   write (LeaseRenewer).
+
+Transport: DataNode connections come from a shared ``RpcClientPool``
+(the shuffle copier's engine) — at most ``tdfs.client.dn.conns`` warm
+sockets per datanode, idle ones evicted after ``tdfs.client.dn.idle.s``
+(the old per-addr client cache grew one socket per datanode ever
+contacted and never closed any). A lease is exclusive, so the chunk
+streams PIPELINE: ``tdfs.client.read.pipeline.depth`` read requests ride
+the wire back-to-back and the datanode overlaps its pread+CRC work with
+the client's drain, instead of one ping-pong RTT per chunk.
 """
 
 from __future__ import annotations
@@ -20,7 +29,8 @@ import uuid
 from typing import Any
 
 from tpumr.core import tracing as _tracing
-from tpumr.ipc.rpc import RpcClient, RpcError
+from tpumr.io import compress as _compress
+from tpumr.ipc.rpc import RpcClient, RpcClientPool, RpcError
 
 
 class DFSClient:
@@ -31,7 +41,15 @@ class DFSClient:
         self.nn = RpcClient(host, int(port), secret=self._secret,
                             scope=self._scope)
         self.name = f"TDFSClient_{uuid.uuid4().hex[:12]}"
-        self._dn_clients: dict[str, RpcClient] = {}
+        self._dn_pool = RpcClientPool(
+            self._dn_factory,
+            conns_per_target=int(self._conf_get("tdfs.client.dn.conns",
+                                                2)),
+            idle_s=float(self._conf_get("tdfs.client.dn.idle.s", 60.0)))
+        #: wire codec OFFERED on chunk reads — resolved once to a codec
+        #: this process decodes at native speed, else "none"
+        self._read_wire = _compress.wire_codec_or_none(
+            str(self._conf_get("tdfs.read.wire.codec", "tlz")))
         #: block_id -> NameNode access stamp (≈ LocatedBlock.blockToken)
         self._block_access: dict[int, Any] = {}
         self._lock = threading.Lock()
@@ -39,18 +57,36 @@ class DFSClient:
         self._renewer: threading.Thread | None = None
         self._stop_renew = threading.Event()
 
+    def _conf_get(self, key: str, default: Any) -> Any:
+        return default if self.conf is None else self.conf.get(key,
+                                                               default)
+
     # ------------------------------------------------------------ dn plumbing
 
-    def _dn(self, addr: str) -> RpcClient:
-        with self._lock:
-            cli = self._dn_clients.get(addr)
-            if cli is None:
-                host, port = addr.rsplit(":", 1)
-                cli = self._dn_clients[addr] = RpcClient(
-                    host, int(port), secret=self._secret,
-                    scope=self._scope)
-                cli.envelope_provider = self._dn_envelope
-            return cli
+    def _dn_factory(self, host: str, port: int) -> RpcClient:
+        cli = RpcClient(host, int(port), secret=self._secret,
+                        scope=self._scope)
+        cli.envelope_provider = self._dn_envelope
+        return cli
+
+    def _dn_call(self, addr: str, method: str, *params: Any) -> Any:
+        """One plain call on a pooled lease (non-pipelined callers)."""
+        cli = self._dn_pool.acquire(addr)
+        try:
+            out = cli.call(method, *params)
+        except BaseException:
+            self._dn_pool.release(addr, cli, dead=True)
+            raise
+        self._dn_pool.release(addr, cli)
+        return out
+
+    def close(self) -> None:
+        """Release every pooled datanode socket and stop the renewer.
+        The client stays usable for NameNode ops afterwards only by
+        accident — treat it as closed."""
+        self._stop_renew.set()
+        self._dn_pool.close()
+        self.nn.close()
 
     def _dn_envelope(self, method: str, params: tuple) -> "dict | None":
         """Attach the NameNode-minted block-access stamp to DataNode
@@ -195,10 +231,10 @@ class _DFSOutputStream(io.RawIOBase):
     def _flush_block_traced(self, data: bytes) -> None:
         excluded: list[str] = []
         last_err: Exception | None = None
-        chunk = 1 << 20
-        if self.client.conf is not None:
-            chunk = int(self.client.conf.get(
-                "tdfs.client.write.chunk.bytes", chunk))
+        chunk = int(self.client._conf_get("tdfs.client.write.chunk.bytes",
+                                          1 << 20))
+        depth = max(1, int(self.client._conf_get(
+            "tdfs.client.write.pipeline.depth", 4)))
         for _ in range(self.MAX_BLOCK_RETRIES):
             alloc = self.client.nn.call("add_block", self.path,
                                         self.client.name,
@@ -207,27 +243,8 @@ class _DFSOutputStream(io.RawIOBase):
             self.client._remember_access(bid, alloc.get("access"))
             # prev size is journaled now; next add_block must not re-log it
             self._prev_block_size = -1
-            cli = self.client._dn(targets[0])
             try:
-                if len(data) <= chunk:
-                    # small blocks: the single-shot path (one RPC)
-                    cli.call("write_block", bid, data, targets[1:])
-                else:
-                    # streamed pipeline (≈ DataTransferProtocol
-                    # WRITE_BLOCK): bounded chunks relay DN→DN→DN; the
-                    # commit only returns once every replica installed
-                    cli.call("open_block_stream", bid, targets[1:])
-                    try:
-                        for lo in range(0, len(data), chunk):
-                            cli.call("write_block_chunk", bid,
-                                     data[lo:lo + chunk])
-                        cli.call("commit_block_stream", bid)
-                    except Exception:
-                        try:
-                            cli.call("abort_block_stream", bid)
-                        except Exception:  # noqa: BLE001 — best effort
-                            pass
-                        raise
+                self._ship_block(bid, targets, data, chunk, depth)
                 self._prev_block_size = len(data)
                 return
             except Exception as e:  # noqa: BLE001 — pipeline failure
@@ -237,6 +254,50 @@ class _DFSOutputStream(io.RawIOBase):
                                     self.client.name, bid)
         raise IOError(f"write pipeline failed for {self.path} after "
                       f"{self.MAX_BLOCK_RETRIES} attempts: {last_err}")
+
+    def _ship_block(self, bid: int, targets: "list[str]", data: bytes,
+                    chunk: int, depth: int) -> None:
+        """Ship one block to the pipeline head on a pooled lease. Small
+        blocks ride one RPC; larger ones stream as bounded chunks with
+        up to ``depth`` appends on the wire (each ack still means the
+        whole DN chain appended — commit is the durability barrier, so
+        overlapping the acks changes latency, not the contract)."""
+        pool = self.client._dn_pool
+        cli = pool.acquire(targets[0])
+        try:
+            if len(data) <= chunk:
+                # small blocks: the single-shot path (one RPC)
+                cli.call("write_block", bid, data, targets[1:])
+            else:
+                # streamed pipeline (≈ DataTransferProtocol
+                # WRITE_BLOCK): bounded chunks relay DN→DN→DN; the
+                # commit only returns once every replica installed
+                cli.call("open_block_stream", bid, targets[1:])
+                try:
+                    spans = list(range(0, len(data), chunk))
+                    sent = 0
+                    for _done in range(len(spans)):
+                        while sent < len(spans) and sent - _done < depth:
+                            lo = spans[sent]
+                            cli.call_begin("write_block_chunk", bid,
+                                           data[lo:lo + chunk])
+                            sent += 1
+                        cli.call_finish()
+                    cli.call("commit_block_stream", bid)
+                except Exception:
+                    # the lease is dead after a mid-window failure —
+                    # abort on a FRESH lease so the datanode's temp
+                    # state is cleaned even though this socket is gone
+                    try:
+                        self.client._dn_call(targets[0],
+                                             "abort_block_stream", bid)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                    raise
+        except BaseException:
+            pool.release(targets[0], cli, dead=True)
+            raise
+        pool.release(targets[0], cli)
 
     def hflush(self) -> None:
         """Make everything written so far visible to readers (≈
@@ -335,27 +396,17 @@ class _DFSInputStream(io.RawIOBase):
     def _read_replica_traced(self, blk: dict, offset: int,
                              length: int) -> bytes:
         last_err: Exception | None = None
-        chunk = 1 << 20
-        if self.client.conf is not None:
-            chunk = int(self.client.conf.get("tdfs.client.read.chunk.bytes",
-                                             chunk))
+        chunk = int(self.client._conf_get("tdfs.client.read.chunk.bytes",
+                                          1 << 20))
+        depth = max(1, int(self.client._conf_get(
+            "tdfs.client.read.pipeline.depth", 4)))
+        wire = self.client._read_wire
         for addr in blk["locations"]:
             try:
-                # streamed read (≈ BlockSender): bounded chunks per RPC,
-                # so neither side ever holds a whole block per response
-                cli = self.client._dn(addr)
-                parts: list[bytes] = []
-                got = 0
-                while got < length:
-                    r = cli.call("read_block_chunk", blk["block_id"],
-                                 offset + got, min(chunk, length - got))
-                    if not r["data"]:
-                        raise IOError(
-                            f"short read at {offset + got} of block "
-                            f"{blk['block_id']} (total {r['total']})")
-                    parts.append(r["data"])
-                    got += len(r["data"])
-                return b"".join(parts)
+                data = self._read_one_replica(addr, blk["block_id"],
+                                              offset, length, chunk,
+                                              depth, wire)
+                return data
             except Exception as e:  # noqa: BLE001 — dead/corrupt replica
                 last_err = e
                 if "checksum" in str(e).lower():
@@ -369,3 +420,42 @@ class _DFSInputStream(io.RawIOBase):
                 continue
         raise IOError(f"all replicas failed for block {blk['block_id']} "
                       f"(locations {blk['locations']}): {last_err}")
+
+    def _read_one_replica(self, addr: str, bid: int, offset: int,
+                          length: int, chunk: int, depth: int,
+                          wire: str) -> bytes:
+        """Streamed read off ONE replica (≈ BlockSender), pipelined:
+        chunk offsets are deterministic, so up to ``depth`` requests are
+        kept on the wire while responses drain FIFO. Each response must
+        return EXACTLY the bytes asked (the request offsets were
+        computed assuming so) — a short/empty chunk fails the replica
+        and the caller fails over. The pooled lease is exclusive for
+        the window; any error releases it dead (in-flight responses
+        would desync the next leaseholder)."""
+        spans = [(offset + lo, min(chunk, length - lo))
+                 for lo in range(0, length, chunk)]
+        cli = self.client._dn_pool.acquire(addr)
+        try:
+            parts: list[bytes] = []
+            sent = 0
+            for done in range(len(spans)):
+                while sent < len(spans) and sent - done < depth:
+                    off, n = spans[sent]
+                    cli.call_begin("read_block_chunk", bid, off, n, wire)
+                    sent += 1
+                r = cli.call_finish()
+                data = r["data"]
+                if "wire" in r:
+                    data = _compress.get_codec(r["wire"]).decompress(
+                        bytes(data))
+                if len(data) != spans[done][1]:
+                    raise IOError(
+                        f"short read at {spans[done][0]} of block "
+                        f"{bid}: got {len(data)}/{spans[done][1]} "
+                        f"(total {r.get('total')})")
+                parts.append(data)
+        except BaseException:
+            self.client._dn_pool.release(addr, cli, dead=True)
+            raise
+        self.client._dn_pool.release(addr, cli)
+        return b"".join(parts)

@@ -28,6 +28,8 @@ import time
 import zlib
 from typing import Any
 
+from tpumr.io import compress
+from tpumr.io.fdcache import FdCache
 from tpumr.ipc.rpc import RpcClient, RpcServer
 
 CHUNK = 64 * 1024
@@ -38,14 +40,47 @@ class ChecksumError(IOError):
 
 
 class BlockStore:
-    """On-disk block files + chunk checksums (≈ FSDataset)."""
+    """On-disk block files + chunk checksums (≈ FSDataset).
 
-    def __init__(self, data_dir: str) -> None:
+    The read path is served from a pinned-LRU fd cache (tpumr.io.fdcache,
+    the shuffle server's engine) plus an in-memory meta cache: a block
+    streamed out as N chunks used to cost N×(open block + open/parse
+    .meta) — now chunk 2..N is one ``pread`` and a dict hit. Every
+    mutation (write/finalize/abort/delete) invalidates both caches:
+    ``os.replace`` swaps the inode under the path, and a cached fd would
+    otherwise keep serving the OLD block's bytes forever."""
+
+    def __init__(self, data_dir: str, fd_capacity: int = 64) -> None:
         self.dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
+        self._fds = FdCache(capacity=fd_capacity)
+        #: block_id -> parsed .meta ({"len", "sums"}); bounded by the
+        #: same capacity as the fd cache (metas are ~the hot set)
+        self._meta: "dict[int, dict]" = {}
+        self._meta_mu = threading.Lock()
+        self._meta_cap = max(16, int(fd_capacity) * 4)
 
     def _path(self, block_id: int) -> str:
         return os.path.join(self.dir, f"blk_{block_id}")
+
+    def _invalidate(self, block_id: int) -> None:
+        """Drop cached fd + meta for one block (call on ANY mutation)."""
+        self._fds.invalidate(self._path(block_id))
+        with self._meta_mu:
+            self._meta.pop(block_id, None)
+
+    def _load_meta(self, block_id: int) -> dict:
+        with self._meta_mu:
+            meta = self._meta.get(block_id)
+        if meta is not None:
+            return meta
+        with open(self._path(block_id) + ".meta") as f:
+            meta = json.load(f)
+        with self._meta_mu:
+            while len(self._meta) >= self._meta_cap:
+                self._meta.pop(next(iter(self._meta)))
+            self._meta[block_id] = meta
+        return meta
 
     def write(self, block_id: int, data: bytes) -> None:
         sums = [zlib.crc32(data[i:i + CHUNK])
@@ -59,6 +94,7 @@ class BlockStore:
             json.dump({"len": len(data), "sums": sums}, f)
         os.replace(tmp + ".meta", self._path(block_id) + ".meta")
         os.replace(tmp, self._path(block_id))
+        self._invalidate(block_id)
 
     def read(self, block_id: int, offset: int = 0,
              length: int = -1) -> bytes:
@@ -82,12 +118,17 @@ class BlockStore:
         """Range read verifying ONLY the covering checksum chunks (the
         reference's chunk-aligned verification in BlockSender): a
         streaming reader never re-reads or re-hashes the whole block
-        per chunk. Returns (data, block_length)."""
+        per chunk. Served via the fd/meta caches — a multi-chunk stream
+        pays one open + one meta parse total, then a ``pread`` per
+        chunk (stateless, so the reactor's pool threads serve many
+        clients off the same fd concurrently). Returns
+        (data, block_length)."""
         path = self._path(block_id)
-        if not os.path.exists(path):
-            raise FileNotFoundError(f"block {block_id} not stored here")
-        with open(path + ".meta") as f:
-            meta = json.load(f)
+        try:
+            meta = self._load_meta(block_id)
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"block {block_id} not stored here") from None
         total = meta["len"]
         offset = max(0, offset)
         length = max(0, min(length, total - offset))
@@ -95,9 +136,14 @@ class BlockStore:
             return b"", total
         c0 = offset // CHUNK
         c1 = (offset + length - 1) // CHUNK
-        with open(path, "rb") as f:
-            f.seek(c0 * CHUNK)
-            covering = f.read((c1 - c0 + 1) * CHUNK)
+        try:
+            covering = self._fds.pread(
+                path, (c1 - c0 + 1) * CHUNK, c0 * CHUNK)
+        except FileNotFoundError:
+            # meta cached but block deleted under us: drop stale meta
+            self._invalidate(block_id)
+            raise FileNotFoundError(
+                f"block {block_id} not stored here") from None
         sums = [zlib.crc32(covering[i:i + CHUNK])
                 for i in range(0, len(covering), CHUNK)]
         if sums != meta["sums"][c0:c1 + 1]:
@@ -141,6 +187,7 @@ class BlockStore:
             json.dump({"len": total, "sums": sums}, f)
         os.replace(tmp + ".meta", self._path(block_id) + ".meta")
         os.replace(tmp, self._path(block_id))
+        self._invalidate(block_id)
         return total
 
     def abort_stream(self, block_id: int) -> None:
@@ -151,6 +198,7 @@ class BlockStore:
                 pass
 
     def delete(self, block_id: int) -> None:
+        self._invalidate(block_id)
         for suffix in ("", ".meta"):
             try:
                 os.remove(self._path(block_id) + suffix)
@@ -175,7 +223,10 @@ class DataNode:
     def __init__(self, nn_host: str, nn_port: int, data_dir: str,
                  conf: Any, host: str = "127.0.0.1", port: int = 0) -> None:
         self.conf = conf
-        self.store = BlockStore(data_dir)
+        self.store = BlockStore(
+            data_dir,
+            fd_capacity=int(conf.get("tdfs.datanode.fdcache.capacity",
+                                     64)))
         from tpumr.security import rpc_secret
         self._secret = rpc_secret(conf)
         self.nn = RpcClient(nn_host, nn_port, secret=self._secret)
@@ -205,7 +256,20 @@ class DataNode:
             k=int(conf.get("tpumr.dn.hotblocks.k", 64)))
         self._hot_top = int(conf.get("tpumr.dn.hotblocks.top", 16))
         self._hot_lock = threading.Lock()
+        # per-heartbeat exponential decay so the sketch follows the
+        # CURRENT read mix (the NN cool-down depends on hot shares
+        # actually falling); factor chosen so counts halve every
+        # halflife.s seconds of heartbeats; 0 disables
+        halflife = float(conf.get("tpumr.dn.hotblocks.halflife.s", 60.0))
+        self._hot_decay = (0.5 ** (self.heartbeat_s / halflife)
+                           if halflife > 0 else 1.0)
         self._server = RpcServer(self, host=host, port=port, secret=self._secret)
+        # block reads are read-only + idempotent: exempt them from the
+        # server's dedup/replay cache so re-sent reads never pin whole
+        # chunk payloads in the reply cache (same idiom as the shuffle
+        # server's get_map_output)
+        self._server.uncached_methods = {"read_block", "read_block_chunk",
+                                         "block_checksum"}
         self._server.metrics = self.metrics.new_registry("rpc")
         # Personal-credential callers (user keys, delegation tokens)
         # reach block data ONLY with a NameNode-minted per-block access
@@ -316,6 +380,9 @@ class DataNode:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_s):
+            if self._hot_decay < 1.0:
+                with self._hot_lock:
+                    self._hot.decay(self._hot_decay)
             try:
                 cmds = self.nn.call("dn_heartbeat", self.addr,
                                     self.store.used(), self.capacity,
@@ -460,9 +527,13 @@ class DataNode:
     MAX_CHUNK_BYTES = 4 << 20
 
     def read_block_chunk(self, block_id: int, offset: int,
-                         max_bytes: int) -> dict:
+                         max_bytes: int, wire: str = "none") -> dict:
         """One bounded chunk of a block + its total length; checksums
-        verified for the covering CRC chunks only."""
+        verified for the covering CRC chunks only. ``wire`` is a codec
+        the CLIENT offers (tdfs.read.wire.codec) — when it pays, the
+        payload ships compressed with ``wire`` set in the response and
+        the client decodes; sizes/offsets stay payload-relative. Old
+        clients omit the param and always get raw bytes."""
         n = max(0, min(int(max_bytes), self.MAX_CHUNK_BYTES))
         t0 = time.monotonic()
         self._readers += 1
@@ -471,7 +542,9 @@ class DataNode:
         finally:
             self._readers -= 1
         self._note_read(block_id, len(data), t0)
-        return {"data": data, "total": total}
+        out = {"data": data, "total": total}
+        compress.wire_compress(out, compress.wire_codec_or_none(wire))
+        return out
 
     # streamed pipelined write ≈ DataTransferProtocol op WRITE_BLOCK:
     # chunks relay downstream FIRST (same ordering as write_block), each

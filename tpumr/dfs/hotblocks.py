@@ -32,9 +32,10 @@ class SpaceSaving:
 
     def __init__(self, k: int = 64) -> None:
         self.k = max(1, int(k))
-        #: key -> [count, err]
-        self._counts: "dict[str, list[int]]" = {}
-        self.total = 0   # every offer, tracked or not
+        #: key -> [count, err]; counts are ints until decay() ages
+        #: them fractional (wire folds re-truncate at the boundary)
+        self._counts: "dict[str, list[float]]" = {}
+        self.total = 0.0   # every offer, tracked or not
 
     def __len__(self) -> int:
         return len(self._counts)
@@ -51,6 +52,27 @@ class SpaceSaving:
         victim = min(self._counts, key=lambda x: self._counts[x][0])
         floor = self._counts.pop(victim)[0]
         self._counts[key] = [floor + by, floor]
+
+    def decay(self, factor: float) -> None:
+        """Exponentially age every count (and ``total``) by ``factor``
+        in [0,1]; entries that decay below one count are dropped. The
+        datanode applies this each heartbeat so the sketch tracks the
+        CURRENT read mix — without it, yesterday's hot block keeps its
+        replica boost forever and the namenode's cool-down never fires.
+        Counts go fractional on purpose: truncating to int would turn a
+        gentle per-heartbeat factor into a flat -1/heartbeat for every
+        small count (int(15 * 0.99) = 14), emptying the sketch orders
+        of magnitude faster than the configured half-life."""
+        if factor >= 1.0:
+            return
+        factor = max(0.0, factor)
+        for key in list(self._counts):
+            ent = self._counts[key]
+            ent[0] *= factor
+            ent[1] *= factor
+            if ent[0] < 1.0:
+                del self._counts[key]
+        self.total *= factor
 
     def estimate(self, key: str) -> int:
         ent = self._counts.get(key)

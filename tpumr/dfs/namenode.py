@@ -32,8 +32,8 @@ from typing import Any
 
 from tpumr.dfs.editlog import FSEditLog, FSImage
 from tpumr.dfs.hotblocks import HotBlockTable
+from tpumr.dfs.nslock import NamespaceLocks
 from tpumr.ipc.rpc import RpcServer
-from tpumr.metrics.locks import RANK_NAMESPACE, InstrumentedRLock
 
 #: ≈ ClientProtocol.versionID (hdfs/protocol/ClientProtocol.java)
 PROTOCOL_VERSION = 61
@@ -61,13 +61,27 @@ class FSNamesystem:
     def __init__(self, name_dir: str, conf: Any) -> None:
         self.conf = conf
         self.name_dir = name_dir
-        # every namespace op serializes here — instrumented so its wait
-        # (how long RPCs queue) and hold (how long the winner keeps them
-        # out) land in nn_lock_*_seconds{lock=namespace}; the histograms
-        # bind later (bind_metrics), the rank slots it into the one
-        # repo-wide order table
-        self.lock = InstrumentedRLock(name="namespace",
-                                      rank=RANK_NAMESPACE)
+        # striped locking (nslock.py): path ops take only their
+        # subtree's stripe, datanode/block ops take only the blocks
+        # lock, and the global ``namespace`` lock is reserved for
+        # cross-stripe structural work — wait/hold land in
+        # nn_lock_*_seconds{lock=namespace|namespace-stripe|
+        # namespace-blocks}; histograms bind later (bind_metrics)
+        self.locks = NamespaceLocks(
+            stripes=int(conf.get("tdfs.namenode.lock.stripes", 8)),
+            depth=int(conf.get("tdfs.namenode.lock.stripe.depth", 2)))
+        #: back-compat alias: the structural/global lock, still named
+        #: "namespace" in the rank table and metric labels. Holding it
+        #: alone does NOT exclude striped ops — quiesced-state readers
+        #: (tests, status pages) are fine, mutators must go through
+        #: _locked()/locks.structural()
+        self.lock = self.locks.global_lock
+        #: the block/datanode-plane lock — short sections, no journaling
+        self._blk = self.locks.blocks
+        #: leaf mutex for the quota usage cache (_quota_usage): charged
+        #: from any stripe, so the per-entry += must not race; plain
+        #: unranked Lock because nothing ever blocks under it
+        self._quota_mu = threading.Lock()
         self.default_replication = int(conf.get("dfs.replication", 3))
         self.default_block_size = int(conf.get("dfs.block.size",
                                                8 * 1024 * 1024))
@@ -172,6 +186,20 @@ class FSNamesystem:
         #: (hotblocks.py) — served at /hotblocks + get_hot_blocks
         self.hot_blocks = HotBlockTable(
             k=int(conf.get("tpumr.dn.hotblocks.k", 64)))
+        # hot-block auto-replication policy (hotblock_check): when one
+        # block draws more than `share` of cluster reads, raise its
+        # replica target toward the cap; the boost decays back once the
+        # block cools (the DN sketches decay too, so share follows the
+        # CURRENT mix, not history)
+        self.hot_share = float(conf.get("tdfs.hotblocks.replicate.share",
+                                        0.3))
+        self.hot_min_reads = int(conf.get(
+            "tdfs.hotblocks.replicate.min.reads", 200))
+        self.hot_cap = int(conf.get("tdfs.hotblocks.replicate.cap", 4))
+        self.hot_cool_s = float(conf.get("tdfs.hotblocks.cool.s", 15.0))
+        #: bid -> {"boost": target_replicas, "hot_mono": last_hot_ts} —
+        #: consulted by replication_check, guarded by self._blk
+        self.hot_boost: dict[int, dict] = {}
 
         # audit log ≈ FSNamesystem.logAuditEvent: one line per namespace
         # mutation on the dedicated "tpumr.nn.audit" logger, rate-capped
@@ -262,7 +290,17 @@ class FSNamesystem:
             else:
                 d.pop(op["addr"], None)
         elif kind == "counters":
-            counters.update(op["values"])
+            # allocator counters apply as a MONOTONIC max: with striped
+            # locking two add_blocks in different stripes may journal
+            # their counter bumps out of allocation order, and replaying
+            # the smaller value last would re-issue a block id
+            for k, v in op["values"].items():
+                if k in ("next_block", "gen") and isinstance(v, int):
+                    old = counters.get(k)
+                    counters[k] = max(old, v) \
+                        if isinstance(old, int) else v
+                else:
+                    counters[k] = v
 
     def _log(self, op: dict) -> None:
         self.edits.log(op)
@@ -293,15 +331,39 @@ class FSNamesystem:
         the lock and journal exist before the metrics registry does, so
         they late-bind exactly like the master's lock classes."""
         from tpumr.metrics.histogram import BYTES
-        self.lock.bind(
-            reg.histogram("nn_lock_wait_seconds|lock=namespace"),
-            reg.histogram("nn_lock_hold_seconds|lock=namespace"))
+        self.locks.bind_metrics(reg)
         self.edits.bind_metrics(
             reg.histogram("nn_editlog_append_seconds"),
             reg.histogram("nn_editlog_sync_seconds"),
-            reg.histogram("nn_editlog_batch_bytes", bounds=BYTES))
+            reg.histogram("nn_editlog_batch_bytes", bounds=BYTES),
+            reg.histogram("nn_editlog_group_ops"))
 
     # ------------------------------------------------------------ helpers
+
+    def _locked(self, *paths: str, ensure: "str | None" = None):
+        """Lock context for an op on ``paths``: their stripes in index
+        order, or structural when any path is too shallow to stripe.
+        ``ensure``: the op will _ensure_parents this path — when a
+        MISSING ancestor is itself too shallow to stripe (a new
+        top-level dir), creating it is structural work, decided here
+        with lock-free point reads before anything is acquired."""
+        if ensure is not None:
+            p = self._parent_of(ensure)
+            while p != "/" and p not in self.namespace:
+                if self.locks.stripe_index(p) is None:
+                    return self.locks.structural()
+                p = self._parent_of(p)
+        return self.locks.for_paths(*paths)
+
+    def _ns_items(self) -> "list[tuple[str, dict]]":
+        """Point-in-time snapshot of the namespace dict for full scans
+        that don't hold a lock excluding all mutators (blocks-plane
+        sweeps, status pages). ``list(dict.items())`` is GIL-atomic in
+        CPython — same contract lock_table() relies on — so a scan can
+        never see a resize mid-iteration; individual inode dicts may
+        still be mutated concurrently, which these scans tolerate
+        (point-in-time staleness, never corruption)."""
+        return list(self.namespace.items())
 
     def _check_safemode(self) -> None:
         if self.safemode:
@@ -314,7 +376,7 @@ class FSNamesystem:
     def _reported_fraction(self) -> float:
         if self.total_known_blocks == 0:
             return 1.0
-        reported = sum(1 for i in self.namespace.values()
+        reported = sum(1 for _, i in self._ns_items()
                        if i.get("type") == "file"
                        for b in i.get("blocks", [])
                        if self.block_locations.get(b[0]))
@@ -333,6 +395,14 @@ class FSNamesystem:
             cur += "/" + part
             inode = self.namespace.get(cur)
             if inode is None:
+                if not self.locks.covers(cur):
+                    # striped context, missing ancestor OUTSIDE the held
+                    # stripes: _locked()'s pre-check saw it present, so
+                    # a structural delete won the race since — fail like
+                    # any create under a just-deleted tree (a retry
+                    # re-runs the pre-check and escalates)
+                    raise FileNotFoundError(
+                        f"{cur} (parent deleted concurrently)")
                 op = {"op": "mkdir", "path": cur, "t": _now(),
                       "o": user or self.superuser, "g": self.supergroup,
                       "m": 0o755}
@@ -437,7 +507,7 @@ class FSNamesystem:
         prefix = "/" if root == "/" else root.rstrip("/") + "/"
         inodes = 0
         consumed = 0
-        for p, ino in self.namespace.items():
+        for p, ino in self._ns_items():
             if p == root or p == "/" or not p.startswith(prefix):
                 continue
             inodes += 1
@@ -461,7 +531,7 @@ class FSNamesystem:
     def _rebuild_quota_usage(self) -> None:
         """One scan re-deriving every quota dir's cached counters."""
         usage: dict[str, list] = {}
-        for p, ino in self.namespace.items():
+        for p, ino in self._ns_items():
             if ino.get("type") == "dir" and ("ns_quota" in ino
                                              or "sp_quota" in ino):
                 usage[p] = None
@@ -471,18 +541,21 @@ class FSNamesystem:
 
     def _charge(self, path: str, d_inodes: int, d_bytes: int) -> None:
         """Apply a usage delta at ``path`` to every quota-carrying PROPER
-        ancestor's cached counters. No-op when no quotas exist."""
+        ancestor's cached counters. No-op when no quotas exist. A quota
+        dir's counters may be charged from ANY stripe (ancestors are
+        not covered by the op's stripe set), hence the leaf mutex."""
         if not self._quota_usage:
             return
-        p = self._parent_of(path)
-        while True:
-            u = self._quota_usage.get(p)
-            if u is not None:
-                u[0] += d_inodes
-                u[1] += d_bytes
-            if p == "/":
-                return
-            p = self._parent_of(p)
+        with self._quota_mu:
+            p = self._parent_of(path)
+            while True:
+                u = self._quota_usage.get(p)
+                if u is not None:
+                    u[0] += d_inodes
+                    u[1] += d_bytes
+                if p == "/":
+                    return
+                p = self._parent_of(p)
 
     def _check_quota(self, path: str, new_inodes: int,
                      new_bytes: int,
@@ -519,7 +592,7 @@ class FSNamesystem:
                   sp_quota: "int | None" = None) -> None:
         """≈ ClientProtocol.setQuota (dfsadmin -setQuota/-setSpaceQuota):
         superuser only; None leaves a dimension unchanged, -1 clears it."""
-        with self.lock:
+        with self._locked(path):
             self._check_safemode()
             self._check_superuser("set quotas")
             inode = self._inode(path)
@@ -536,15 +609,18 @@ class FSNamesystem:
             if "ns_quota" in inode or "sp_quota" in inode:
                 # (re)derive this dir's counters at admin time — the one
                 # place a full subtree scan is acceptable
-                self._quota_usage[path] = list(self._subtree_usage(path))
+                usage = list(self._subtree_usage(path))
+                with self._quota_mu:
+                    self._quota_usage[path] = usage
             else:
-                self._quota_usage.pop(path, None)
+                with self._quota_mu:
+                    self._quota_usage.pop(path, None)
 
     # ------------------------------------------------------------ client ops
 
     def create(self, path: str, client: str, replication: int | None,
                block_size: int | None, overwrite: bool) -> dict:
-        with self.lock:
+        with self._locked(path, ensure=path):
             self._check_safemode()
             user = self._caller()
             existing = self.namespace.get(path)
@@ -578,14 +654,15 @@ class FSNamesystem:
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
             self._charge(path, 1, 0)
-            lease = self.leases.setdefault(
-                client, {"paths": set(), "renewed": _now()})
-            lease["paths"].add(path)
-            # wall-clock "renewed" stays for the report surface; expiry
-            # (lease_check) compares the monotonic twin so an NTP step
-            # can neither mass-expire nor immortalize leases
-            lease["renewed"] = _now()
-            lease["renewed_mono"] = time.monotonic()
+            with self._blk:
+                lease = self.leases.setdefault(
+                    client, {"paths": set(), "renewed": _now()})
+                lease["paths"].add(path)
+                # wall-clock "renewed" stays for the report surface;
+                # expiry (lease_check) compares the monotonic twin so an
+                # NTP step can neither mass-expire nor immortalize
+                lease["renewed"] = _now()
+                lease["renewed_mono"] = time.monotonic()
             self._audit("create", path)
             return {"replication": r, "block_size": bs}
 
@@ -597,7 +674,7 @@ class FSNamesystem:
         generation stamp; immutable whole-block datanode storage here
         makes new-blocks the honest equivalent (divergence documented in
         docs/OPERATIONS.md)."""
-        with self.lock:
+        with self._locked(path):
             self._check_safemode()
             user = self._caller()
             inode = self._inode(path)
@@ -612,13 +689,14 @@ class FSNamesystem:
                   "t": _now()}
             self._log(op)
             self.apply_op(self.namespace, self.counters, op)
-            # pre-existing blocks are already in total_known_blocks
-            self._uc_counted[path] = len(inode.get("blocks", []))
-            lease = self.leases.setdefault(
-                client, {"paths": set(), "renewed": _now()})
-            lease["paths"].add(path)
-            lease["renewed"] = _now()
-            lease["renewed_mono"] = time.monotonic()
+            with self._blk:
+                # pre-existing blocks are already in total_known_blocks
+                self._uc_counted[path] = len(inode.get("blocks", []))
+                lease = self.leases.setdefault(
+                    client, {"paths": set(), "renewed": _now()})
+                lease["paths"].add(path)
+                lease["renewed"] = _now()
+                lease["renewed_mono"] = time.monotonic()
             self._audit("append", path)
             return {"block_size": inode["block_size"],
                     "replication": inode.get("replication", 1)}
@@ -628,7 +706,7 @@ class FSNamesystem:
         (≈ ClientProtocol.fsync — the hflush visibility point: readers
         see everything up to the last fsync'd block, never the writer's
         unflushed buffer)."""
-        with self.lock:
+        with self._locked(path):
             inode = self._inode(path)
             if not inode.get("uc") or inode.get("client") != client:
                 raise LeaseError(
@@ -649,7 +727,7 @@ class FSNamesystem:
     def add_block(self, path: str, client: str,
                   prev_block_size: int = -1,
                   excluded: list[str] | None = None) -> dict:
-        with self.lock:
+        with self._locked(path):
             self._check_safemode()
             inode = self._inode(path)
             if not inode.get("uc") or inode.get("client") != client:
@@ -670,13 +748,18 @@ class FSNamesystem:
             self._check_quota(path, new_inodes=0,
                               new_bytes=inode["block_size"]
                               * inode.get("replication", 1))
-            bid = self.counters["next_block"]
-            gen = self.counters["gen"]
-            self.counters["next_block"] = bid + 1
+            with self._blk:
+                # id allocation under the blocks lock (any stripe may
+                # allocate); journal order may differ from allocation
+                # order across stripes — apply_op's monotonic-max on
+                # these counters makes replay order-independent
+                bid = self.counters["next_block"]
+                gen = self.counters["gen"]
+                self.counters["next_block"] = bid + 1
+                targets = self._choose_targets(inode["replication"],
+                                               set(excluded or []))
             self._log({"op": "counters", "values":
                        {"next_block": bid + 1, "gen": gen}})
-            targets = self._choose_targets(inode["replication"],
-                                           set(excluded or []))
             if not targets:
                 raise IOError("no DataNodes available for replication")
             op = {"op": "add_block", "path": path, "bid": bid}
@@ -684,7 +767,8 @@ class FSNamesystem:
             self.apply_op(self.namespace, self.counters, op)
             self._charge(path, 0,
                          inode["block_size"] * inode.get("replication", 1))
-            self.block_to_path[bid] = path
+            with self._blk:
+                self.block_to_path[bid] = path
             return {"block_id": bid, "gen": gen, "targets": targets}
 
     def abandon_block(self, path: str, client: str, block_id: int) -> None:
@@ -694,7 +778,7 @@ class FSNamesystem:
         handling by design: a journaled op is a committed fact), and only
         the lease holder of an under-construction file may abandon, else
         any client could strip blocks from closed files."""
-        with self.lock:
+        with self._locked(path):
             inode = self.namespace.get(path)
             if inode is None or inode.get("type") != "file":
                 raise FileNotFoundError(path)
@@ -708,10 +792,11 @@ class FSNamesystem:
             self.apply_op(self.namespace, self.counters, op)
             self._charge(path, 0, -inode["block_size"]
                          * inode.get("replication", 1))
-            self.block_to_path.pop(block_id, None)
+            with self._blk:
+                self.block_to_path.pop(block_id, None)
 
     def complete(self, path: str, client: str, last_block_size: int) -> None:
-        with self.lock:
+        with self._locked(path):
             inode = self._inode(path)
             if not inode.get("uc") or inode.get("client") != client:
                 raise LeaseError(f"{client} does not hold the lease on {path}")
@@ -726,37 +811,44 @@ class FSNamesystem:
                 self._charge(path, 0,
                              (last_block_size - inode["block_size"])
                              * inode.get("replication", 1))
-            self.total_known_blocks += (len(inode["blocks"])
-                                        - self._uc_counted.pop(path, 0))
-            lease = self.leases.get(client)
-            if lease:
-                lease["paths"].discard(path)
+            with self._blk:
+                self.total_known_blocks += (len(inode["blocks"])
+                                            - self._uc_counted.pop(path, 0))
+                lease = self.leases.get(client)
+                if lease:
+                    lease["paths"].discard(path)
 
     def renew_lease(self, client: str) -> None:
-        with self.lock:
+        with self._blk:
             lease = self.leases.get(client)
             if lease:
                 lease["renewed"] = _now()
                 lease["renewed_mono"] = time.monotonic()
 
     def get_block_locations(self, path: str) -> list[dict]:
-        with self.lock:
+        with self._locked(path):
             inode = self._inode(path)
             if inode["type"] != "file":
                 raise IsADirectoryError(path)
             self._check_access(path, 4, self._caller())
             out = []
-            for bid, size in inode["blocks"]:
-                locs = sorted(self.block_locations.get(bid, ()))
-                out.append({"block_id": bid,
-                            "size": self.block_sizes.get(bid, size),
-                            "locations": locs})
+            with self._blk:
+                for bid, size in inode["blocks"]:
+                    # shuffled, not sorted: with hot-block auto-replication
+                    # adding replicas, clients that all read locations[0]
+                    # would keep hammering one datanode — randomizing the
+                    # order spreads a hot block's reads across its replicas
+                    locs = list(self.block_locations.get(bid, ()))
+                    random.shuffle(locs)
+                    out.append({"block_id": bid,
+                                "size": self.block_sizes.get(bid, size),
+                                "locations": locs})
             return out
 
     # ------------------------------------------------------------ namespace
 
     def mkdirs(self, path: str) -> bool:
-        with self.lock:
+        with self._locked(path, ensure=path):
             self._check_safemode()
             if path in self.namespace:
                 return self.namespace[path]["type"] == "dir"
@@ -778,7 +870,9 @@ class FSNamesystem:
             return True
 
     def delete(self, path: str, recursive: bool = True) -> bool:
-        with self.lock:
+        # _locked(path) covers the whole subtree: every descendant of a
+        # deep-enough path shares its stripe (see nslock.py)
+        with self._locked(path):
             self._check_safemode()
             if path not in self.namespace:
                 return False
@@ -796,7 +890,7 @@ class FSNamesystem:
         inode = self.namespace.get(path)
         if inode is None:
             return False
-        children = [k for k in self.namespace
+        children = [k for k in list(self.namespace)
                     if k.startswith(path.rstrip("/") + "/")]
         if inode["type"] == "dir" and children and not recursive:
             raise OSError(f"{path} is a non-empty directory")
@@ -805,48 +899,57 @@ class FSNamesystem:
         doomed: list[int] = []
         removed_bytes = 0
         counted_removed = 0
-        for k in children + [path]:
-            node = self.namespace.get(k, {})
-            if node.get("type") == "file":
-                blocks = node.get("blocks", [])
-                doomed.extend(b[0] for b in blocks)
-                repl = node.get("replication", 1)
-                # only blocks actually IN total_known_blocks leave it: a
-                # uc file's post-open blocks were never added (its
-                # pre-open count lives in _uc_counted), so decrementing
-                # per doomed block would drift the safemode denominator
-                counted_removed += (self._uc_counted.pop(k, 0)
-                                    if node.get("uc") else len(blocks))
-                if node.get("uc") and blocks:
-                    # the in-flight last block was charged a FULL block at
-                    # add_block and never settled — refund what was
-                    # charged, not its (still-zero) recorded size, or the
-                    # phantom charge outlives the file
-                    removed_bytes += (
-                        sum(self.block_sizes.get(b[0], b[1])
-                            for b in blocks[:-1])
-                        + node["block_size"]) * repl
-                else:
-                    removed_bytes += sum(
-                        self.block_sizes.get(b[0], b[1])
-                        for b in blocks) * repl
-            self._quota_usage.pop(k, None)
+        with self._blk:
+            for k in children + [path]:
+                node = self.namespace.get(k, {})
+                if node.get("type") == "file":
+                    blocks = node.get("blocks", [])
+                    doomed.extend(b[0] for b in blocks)
+                    repl = node.get("replication", 1)
+                    # only blocks actually IN total_known_blocks leave it:
+                    # a uc file's post-open blocks were never added (its
+                    # pre-open count lives in _uc_counted), so decrementing
+                    # per doomed block would drift the safemode denominator
+                    counted_removed += (self._uc_counted.pop(k, 0)
+                                        if node.get("uc") else len(blocks))
+                    if node.get("uc") and blocks:
+                        # the in-flight last block was charged a FULL block
+                        # at add_block and never settled — refund what was
+                        # charged, not its (still-zero) recorded size, or
+                        # the phantom charge outlives the file
+                        removed_bytes += (
+                            sum(self.block_sizes.get(b[0], b[1])
+                                for b in blocks[:-1])
+                            + node["block_size"]) * repl
+                    else:
+                        removed_bytes += sum(
+                            self.block_sizes.get(b[0], b[1])
+                            for b in blocks) * repl
+        with self._quota_mu:
+            for k in children + [path]:
+                self._quota_usage.pop(k, None)
         op = {"op": "delete", "path": path}
         self._log(op)
         self.apply_op(self.namespace, self.counters, op)
         self._charge(path, -(len(children) + 1), -removed_bytes)
-        for bid in doomed:
-            for addr in self.block_locations.pop(bid, set()):
-                self.commands.setdefault(addr, []).append(
-                    {"type": "delete", "block_id": bid})
-            self.block_sizes.pop(bid, None)
-            self.block_to_path.pop(bid, None)
-        self.total_known_blocks = max(
-            0, self.total_known_blocks - counted_removed)
+        with self._blk:
+            for bid in doomed:
+                for addr in self.block_locations.pop(bid, set()):
+                    self.commands.setdefault(addr, []).append(
+                        {"type": "delete", "block_id": bid})
+                self.block_sizes.pop(bid, None)
+                self.block_to_path.pop(bid, None)
+                self.hot_boost.pop(bid, None)
+            self.total_known_blocks = max(
+                0, self.total_known_blocks - counted_removed)
         return True
 
     def rename(self, src: str, dst: str) -> bool:
-        with self.lock:
+        # both subtrees' stripes, ascending (nslock sorts the union).
+        # The dir-target rewrite below only APPENDS a component, which
+        # never changes a >=depth path's stripe key, so locking the
+        # caller's dst up front stays correct.
+        with self._locked(src, dst, ensure=dst):
             self._check_safemode()
             if src not in self.namespace:
                 return False
@@ -876,35 +979,38 @@ class FSNamesystem:
             self.apply_op(self.namespace, self.counters, op)
             # blocks moved with their files: refresh the reverse index
             prefix = dst.rstrip("/") + "/"
-            for k, v in self.namespace.items():
-                if (k == dst or k.startswith(prefix)) \
-                        and v.get("type") == "file":
-                    for b in v.get("blocks", []):
-                        self.block_to_path[b[0]] = k
+            with self._blk:
+                for k, v in self._ns_items():
+                    if (k == dst or k.startswith(prefix)) \
+                            and v.get("type") == "file":
+                        for b in v.get("blocks", []):
+                            self.block_to_path[b[0]] = k
             # quota counters: the subtree's usage leaves src's ancestors
             # and lands under dst's; cached entries for quota dirs INSIDE
             # the subtree move key
             src_prefix = src.rstrip("/") + "/"
-            moved_q = [(k, v) for k, v in self._quota_usage.items()
-                       if k == src or k.startswith(src_prefix)]
-            for k, v in moved_q:
-                del self._quota_usage[k]
-                self._quota_usage[dst + k[len(src):]] = v
+            with self._quota_mu:
+                moved_q = [(k, v) for k, v in self._quota_usage.items()
+                           if k == src or k.startswith(src_prefix)]
+                for k, v in moved_q:
+                    del self._quota_usage[k]
+                    self._quota_usage[dst + k[len(src):]] = v
             # open-file counted-block entries move with their paths, or
             # a later close would pop a stale/absent key and corrupt the
             # safemode denominator
-            moved_uc = [k for k in self._uc_counted
-                        if k == src or k.startswith(src_prefix)]
-            for k in moved_uc:
-                self._uc_counted[dst + k[len(src):]] = \
-                    self._uc_counted.pop(k)
+            with self._blk:
+                moved_uc = [k for k in self._uc_counted
+                            if k == src or k.startswith(src_prefix)]
+                for k in moved_uc:
+                    self._uc_counted[dst + k[len(src):]] = \
+                        self._uc_counted.pop(k)
             self._charge(src, -(1 + sub_inodes), -sub_bytes)
             self._charge(dst, 1 + sub_inodes, sub_bytes)
             self._audit("rename", src, dst=dst)
             return True
 
     def set_replication(self, path: str, replication: int) -> bool:
-        with self.lock:
+        with self._locked(path):
             self._check_safemode()
             inode = self._inode(path)
             if inode["type"] != "file":
@@ -925,7 +1031,7 @@ class FSNamesystem:
 
     def set_permission(self, path: str, mode: int) -> None:
         """chmod ≈ FSNamesystem.setPermission: owner or superuser only."""
-        with self.lock:
+        with self._locked(path):
             self._check_safemode()
             inode = self._inode(path)
             user = self._caller()
@@ -946,7 +1052,7 @@ class FSNamesystem:
                   group: "str | None" = None) -> None:
         """chown ≈ FSNamesystem.setOwner: owner changes need the superuser;
         the file owner may change its group to one of their own groups."""
-        with self.lock:
+        with self._locked(path):
             self._check_safemode()
             inode = self._inode(path)
             user = self._caller()
@@ -969,7 +1075,7 @@ class FSNamesystem:
                         perm=f"{owner or ''}:{group or ''}")
 
     def get_status(self, path: str) -> dict:
-        with self.lock:
+        with self._locked(path):
             inode = self._inode(path)
             perms = {"owner": inode.get("owner", ""),
                      "group": inode.get("group", ""),
@@ -988,19 +1094,23 @@ class FSNamesystem:
                     "under_construction": bool(inode.get("uc")), **perms}
 
     def list_status(self, path: str) -> list[dict]:
-        with self.lock:
+        with self._locked(path):
             inode = self._inode(path)
             if inode["type"] != "dir":
                 return [self.get_status(path)]
             prefix = path.rstrip("/") + "/"
-            names = {k for k in self.namespace
+            # snapshot scan: a shallow dir's listing spans stripes this
+            # op does not hold — names from a GIL-atomic key snapshot,
+            # statuses re-validated per child by get_status
+            names = {k for k in list(self.namespace)
                      if k.startswith(prefix) and k != path
                      and "/" not in k[len(prefix):]}
             return [self.get_status(k) for k in sorted(names)]
 
     def exists(self, path: str) -> bool:
-        with self.lock:
-            return path in self.namespace
+        # lock-free: a single dict membership test is GIL-atomic, and
+        # any striped answer would be equally stale by return time
+        return path in self.namespace
 
     # ------------------------------------------------------------ datanodes
 
@@ -1012,7 +1122,7 @@ class FSNamesystem:
         # lock, like rack resolution above; the cached include/exclude
         # sets are replaced atomically by refresh_nodes
         admission = self._dn_admission(addr)
-        with self.lock:
+        with self._blk:
             if admission == "refuse":
                 # ≈ DisallowedDatanodeException: host absent from a
                 # configured dfs.hosts include list
@@ -1037,7 +1147,7 @@ class FSNamesystem:
     def dn_heartbeat(self, addr: str, used: int, capacity: int,
                      block_count: int,
                      hot_blocks: "dict | None" = None) -> list[dict]:
-        with self.lock:
+        with self._blk:
             info = self.datanodes.get(addr)
             if info is None:
                 # unknown (expired / NN restarted): tell it to re-register
@@ -1056,8 +1166,8 @@ class FSNamesystem:
     def block_report(self, addr: str, blocks: list[list[int]]) -> list[int]:
         """Full report: rebuild this node's locations; returns block ids the
         node should delete (orphans of deleted files)."""
-        with self.lock:
-            known = {bid for i in self.namespace.values()
+        with self._blk:
+            known = {bid for _, i in self._ns_items()
                      if i.get("type") == "file"
                      for bid, _ in i.get("blocks", [])}
             invalid: list[int] = []
@@ -1073,7 +1183,7 @@ class FSNamesystem:
             return invalid
 
     def block_received(self, addr: str, block_id: int, size: int) -> None:
-        with self.lock:
+        with self._blk:
             self.block_locations.setdefault(block_id, set()).add(addr)
             self.block_sizes[block_id] = size
             self._maybe_leave_safemode()
@@ -1109,7 +1219,7 @@ class FSNamesystem:
     def heartbeat_check(self, expiry_s: float) -> None:
         """Remove dead DataNodes; their replicas become under-replicated
         (≈ FSNamesystem.heartbeatCheck → removeDatanode)."""
-        with self.lock:
+        with self._blk:
             now = time.monotonic()
             dead = [a for a, d in self.datanodes.items()
                     if now - d.get("seen_mono", now) > expiry_s]
@@ -1125,19 +1235,25 @@ class FSNamesystem:
     def replication_check(self) -> int:
         """One ReplicationMonitor sweep: schedule copies for
         under-replicated finalized blocks, deletes for over-replicated.
-        Returns the number of commands scheduled."""
-        with self.lock:
+        Returns the number of commands scheduled. A hot-block boost
+        (hotblock_check) raises a block's target above the file's
+        replication; when the boost expires the same over-replication
+        branch that trims manual set_replication drops trims it back."""
+        with self._blk:
             if self.safemode or not self.datanodes:
                 return 0
             healthy_nodes = [a for a in self.datanodes
                              if a not in self.decommissioning]
             scheduled = 0
-            for path, inode in self.namespace.items():
+            for path, inode in self._ns_items():
                 if inode.get("type") != "file" or inode.get("uc"):
                     continue
-                want = min(inode["replication"],
-                           max(1, len(healthy_nodes)))
+                base_want = min(inode["replication"],
+                                max(1, len(healthy_nodes)))
                 for bid, _ in inode["blocks"]:
+                    boost = self.hot_boost.get(bid, {}).get("boost", 0)
+                    want = min(max(base_want, boost),
+                               max(1, len(healthy_nodes)))
                     locs = {a for a in self.block_locations.get(bid, set())
                             if a in self.datanodes}
                     # replicas on draining nodes don't count toward the
@@ -1162,11 +1278,49 @@ class FSNamesystem:
                             scheduled += 1
             return scheduled
 
+    def hotblock_check(self) -> int:
+        """One hot-block policy sweep: close the loop from the cluster
+        read-frequency view (datanode SpaceSaving sketches folded by
+        dn_heartbeat) to replica placement. A block whose share of all
+        tracked reads crosses ``tdfs.hotblocks.replicate.share`` (with a
+        minimum absolute read count, so an idle cluster's 100%-share
+        singleton block isn't "hot") gets a replication BOOST up to
+        ``tdfs.hotblocks.replicate.cap``; the next replication_check
+        sweep schedules the extra copies. A block that stops being hot
+        for ``tdfs.hotblocks.cool.s`` loses the boost and the same sweep
+        trims the extra replicas back. Returns boosted + expired count
+        (a "changed" tally for the monitor log)."""
+        rows = self.hot_blocks.top(32)
+        total = self.hot_blocks.total_reads()
+        now = time.monotonic()
+        changed = 0
+        with self._blk:
+            if self.safemode:
+                return 0
+            cap = min(self.hot_cap, max(1, len(self.datanodes)))
+            for r in rows:
+                try:
+                    bid = int(r["block"])
+                except (TypeError, ValueError):
+                    continue
+                share = (r["reads"] / total) if total else 0.0
+                if share >= self.hot_share and r["reads"] >= \
+                        self.hot_min_reads:
+                    if bid not in self.hot_boost:
+                        changed += 1
+                    self.hot_boost[bid] = {
+                        "boost": cap, "share": share, "hot_mono": now}
+            for bid in list(self.hot_boost):
+                if now - self.hot_boost[bid]["hot_mono"] > self.hot_cool_s:
+                    del self.hot_boost[bid]
+                    changed += 1
+        return changed
+
     def decommission_check(self) -> None:
         """Promote draining nodes to 'decommissioned' once every block
         they host has enough replicas elsewhere (≈ FSNamesystem.
         checkDecommissionStateInternal)."""
-        with self.lock:
+        with self._blk:
             for addr, state in list(self.decommissioning.items()):
                 if state != "decommissioning":
                     continue
@@ -1222,7 +1376,7 @@ class FSNamesystem:
         # file must not stall every namespace RPC)
         include, exclude = read_hosts_lists(
             self.conf, "dfs.hosts", "dfs.hosts.exclude")
-        with self.lock:
+        with self._blk:
             self._check_superuser("refresh datanode admission lists")
             self._dn_include, self._dn_exclude = include, exclude
             # "configured" = the operator manages admission via FILES
@@ -1274,7 +1428,7 @@ class FSNamesystem:
         """Admin: start/stop draining a DataNode (≈ dfsadmin exclude +
         refreshNodes). Journaled — the drain survives NN restarts.
         Returns the node's current state."""
-        with self.lock:
+        with self._blk:
             self._check_superuser("decommission datanodes")
             if action == "start" and addr not in self.decommissioning:
                 self._log_decommission(addr, "decommissioning")
@@ -1284,28 +1438,52 @@ class FSNamesystem:
 
     def lease_check(self) -> None:
         """Expire hard-limit leases: finalize the file with whatever blocks
-        were reported (lease recovery, simplified)."""
-        with self.lock:
-            # expiry runs on the monotonic twin (renewed_mono): a
-            # wall-clock step must not mass-expire every writer's lease
-            now = time.monotonic()
-            for client, lease in list(self.leases.items()):
-                if now - lease.get("renewed_mono", now) \
-                        <= self.lease_hard_limit:
-                    continue
-                for path in list(lease["paths"]):
-                    inode = self.namespace.get(path)
-                    if inode is None or not inode.get("uc"):
-                        continue
-                    op = {"op": "close", "path": path, "sizes": {
-                        str(bid): self.block_sizes.get(bid, size)
-                        for bid, size in inode["blocks"]}}
+        were reported (lease recovery, simplified). Two-phase under
+        striping: collect expired (client, paths) under the blocks lock,
+        then recover each path under ITS stripe (journaling needs the
+        stripe, and leases rank ABOVE stripes so the reverse nesting
+        would violate the rank order). Each path re-validates — a writer
+        renewing or completing between the phases wins."""
+        # expiry runs on the monotonic twin (renewed_mono): a
+        # wall-clock step must not mass-expire every writer's lease
+        now = time.monotonic()
+        with self._blk:
+            expired = [(client, sorted(lease["paths"]))
+                       for client, lease in self.leases.items()
+                       if now - lease.get("renewed_mono", now)
+                       > self.lease_hard_limit]
+        for client, paths in expired:
+            for path in paths:
+                with self._locked(path):
+                    with self._blk:
+                        lease = self.leases.get(client)
+                        if lease is None or now - lease.get(
+                                "renewed_mono", now) <= \
+                                self.lease_hard_limit:
+                            break  # renewed since phase 1: nothing to do
+                        inode = self.namespace.get(path)
+                        if inode is None or not inode.get("uc") \
+                                or inode.get("client") != client:
+                            lease["paths"].discard(path)
+                            continue
+                        sizes = {str(bid): self.block_sizes.get(bid, size)
+                                 for bid, size in inode["blocks"]}
+                    op = {"op": "close", "path": path, "sizes": sizes}
                     self._log(op)
                     self.apply_op(self.namespace, self.counters, op)
-                    self.total_known_blocks += (
-                        len(inode["blocks"])
-                        - self._uc_counted.pop(path, 0))
-                del self.leases[client]
+                    with self._blk:
+                        self.total_known_blocks += (
+                            len(inode["blocks"])
+                            - self._uc_counted.pop(path, 0))
+                        lease = self.leases.get(client)
+                        if lease is not None:
+                            lease["paths"].discard(path)
+            with self._blk:
+                lease = self.leases.get(client)
+                if lease is not None and not lease["paths"] \
+                        and now - lease.get("renewed_mono", now) \
+                        > self.lease_hard_limit:
+                    del self.leases[client]
 
     # ------------------------------------------------------------ fsck
 
@@ -1318,7 +1496,7 @@ class FSNamesystem:
         are ignored, and the LAST live replica is never invalidated — a
         spurious report (or a transport error mistaken for corruption)
         must not be able to destroy the only copy (the HDFS rule)."""
-        with self.lock:
+        with self._blk:
             locs = self.block_locations.get(block_id)
             if not locs or addr not in locs:
                 return
@@ -1334,8 +1512,11 @@ class FSNamesystem:
 
     def fsck(self, path: str = "/") -> dict:
         """Namespace health walk ≈ NamenodeFsck.check: per-file block
-        accounting against live replica locations."""
-        with self.lock:
+        accounting against live replica locations. Needs a CONSISTENT
+        namespace × block-map view, so it takes the structural lock
+        (all stripes) plus the blocks lock — the one reader that still
+        pays the full stop-the-world price, by design."""
+        with self.locks.structural(), self._blk:
             report: dict = {"path": path, "files": 0, "dirs": 0,
                             "blocks": 0, "size": 0,
                             "under_replicated": [], "missing": [],
@@ -1387,9 +1568,11 @@ class FSNamesystem:
         interval_s = float(self.conf.get("fs.trash.interval", 0)) * 60
         if interval_s <= 0:
             return 0
-        with self.lock:
-            roots = [p for p in self.namespace
-                     if _re.match(r"^/user/[^/]+/\.Trash$", p)]
+        # key-snapshot scans (GIL-atomic): the emptier only needs a
+        # candidate list — rename/delete below take their own stripes
+        # and re-validate, so a racing writer is handled there
+        roots = [p for p in list(self.namespace)
+                 if _re.match(r"^/user/[^/]+/\.Trash$", p)]
         expunged = 0
         now = _now()
         for root in roots:
@@ -1399,11 +1582,10 @@ class FSNamesystem:
                 while f"{root}/{ts}" in self.namespace:
                     ts += 1
                 self.rename(current, f"{root}/{ts}")
-            with self.lock:
-                stamps = [p for p in self.namespace
-                          if p.startswith(root + "/")
-                          and p[len(root) + 1:].isdigit()
-                          and "/" not in p[len(root) + 1:]]
+            stamps = [p for p in list(self.namespace)
+                      if p.startswith(root + "/")
+                      and p[len(root) + 1:].isdigit()
+                      and "/" not in p[len(root) + 1:]]
             for stamp in stamps:
                 if now - int(stamp.rsplit("/", 1)[1]) >= interval_s:
                     self.delete(stamp, recursive=True)
@@ -1420,7 +1602,7 @@ class FSNamesystem:
         longer stalls every client RPC (it used to run entirely under
         the lock)."""
         with self._ckpt_mu:
-            with self.lock:
+            with self.locks.structural():
                 sealed = self.edits.roll()
                 self._ckpt_token += 1  # invalidate any in-flight 2NN cycle
                 self._checkpoint_segments = []
@@ -1429,7 +1611,7 @@ class FSNamesystem:
                 self.apply_op(namespace, counters, op)
             FSImage.save(self.name_dir, namespace, counters)
             FSEditLog.purge(sealed)
-            with self.lock:
+            with self.locks.structural():
                 self._rebuild_quota_usage()  # self-heal conservative drift
 
     def edits_bytes(self) -> int:
@@ -1447,7 +1629,7 @@ class FSNamesystem:
         import os
         from tpumr.dfs.editlog import IMAGE_NAME
         with self._ckpt_mu:
-            with self.lock:
+            with self.locks.structural():
                 sealed = self.edits.roll()
                 self._checkpoint_segments = sealed
                 self._ckpt_token += 1  # fetch supersedes any earlier one
@@ -1503,7 +1685,7 @@ class FSNamesystem:
     def get_blocks(self, addr: str, max_blocks: int = 16) -> list[dict]:
         """Blocks hosted on one DataNode (≈ NamenodeProtocol.getBlocks —
         the balancer's feed)."""
-        with self.lock:
+        with self._blk:
             out = []
             for bid, locs in self.block_locations.items():
                 if addr in locs:
@@ -1517,13 +1699,13 @@ class FSNamesystem:
     def remove_replica(self, addr: str, block_id: int) -> None:
         """Drop one replica (balancer move completion): forget the location
         and tell the node to delete its copy."""
-        with self.lock:
+        with self._blk:
             self.block_locations.get(block_id, set()).discard(addr)
             self.commands.setdefault(addr, []).append(
                 {"type": "delete", "block_id": block_id})
 
     def datanode_report(self) -> list[dict]:
-        with self.lock:
+        with self._blk:
             out = []
             for addr, d in self.datanodes.items():
                 row = dict(d)
@@ -1541,13 +1723,16 @@ class FSNamesystem:
         replicate/devcache-pin policy consumes (ROADMAP "DFS at
         production scale")."""
         rows = self.hot_blocks.top(int(n))
-        with self.lock:
+        with self._blk:
             for r in rows:
                 try:
-                    r["path"] = self.block_to_path.get(
-                        int(r["block"]), "")
+                    bid = int(r["block"])
                 except (TypeError, ValueError):
                     r["path"] = ""
+                    continue
+                r["path"] = self.block_to_path.get(bid, "")
+                r["replicas"] = len(self.block_locations.get(bid, ()))
+                r["boost"] = self.hot_boost.get(bid, {}).get("boost", 0)
         return rows
 
 
@@ -1665,17 +1850,19 @@ class NameNode:
         reg = self._mreg
 
         def _ns_gauges() -> dict:
-            with self.ns.lock:
-                return {
-                    "datanodes": len(self.ns.datanodes),
-                    "safemode": int(self.ns.safemode),
-                    "files": sum(1 for i in self.ns.namespace.values()
-                                 if i.get("type") == "file"),
-                    "blocks": sum(len(i.get("blocks", []))
-                                  for i in self.ns.namespace.values()),
-                    "audit_emitted": self.ns.audit_emitted,
-                    "audit_suppressed": self.ns.audit_suppressed,
-                }
+            # lock-free snapshot scan (see FSNamesystem._ns_items): a
+            # scrape must never queue behind — or stall — client ops
+            items = self.ns._ns_items()
+            return {
+                "datanodes": len(self.ns.datanodes),
+                "safemode": int(self.ns.safemode),
+                "files": sum(1 for _, i in items
+                             if i.get("type") == "file"),
+                "blocks": sum(len(i.get("blocks", []))
+                              for _, i in items),
+                "audit_emitted": self.ns.audit_emitted,
+                "audit_suppressed": self.ns.audit_suppressed,
+            }
 
         reg.set_gauge("namespace", _ns_gauges)
         srv.attach_metrics(ms)
@@ -1690,13 +1877,13 @@ class NameNode:
 
         def summary(q: dict) -> dict:
             ns = self.ns
-            with ns.lock:
-                files = sum(1 for i in ns.namespace.values()
-                            if i.get("type") == "file")
-                dirs = sum(1 for i in ns.namespace.values()
-                           if i.get("type") == "dir")
-                blocks = sum(len(i.get("blocks", []))
-                             for i in ns.namespace.values())
+            items = ns._ns_items()  # lock-free snapshot, like _ns_gauges
+            files = sum(1 for _, i in items
+                        if i.get("type") == "file")
+            dirs = sum(1 for _, i in items
+                       if i.get("type") == "dir")
+            blocks = sum(len(i.get("blocks", []))
+                         for _, i in items)
             return {"files": files, "directories": dirs, "blocks": blocks,
                     "safemode": ns.safemode,
                     "datanodes": len(ns.datanodes)}
@@ -1801,6 +1988,8 @@ class NameNode:
         while not self._stop.wait(interval):
             try:
                 self.ns.heartbeat_check(self.dn_expiry_s)
+                # boosts must be set before the sweep that acts on them
+                self.ns.hotblock_check()
                 self.ns.replication_check()
                 self.ns.lease_check()
                 self.ns.decommission_check()

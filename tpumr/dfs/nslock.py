@@ -1,0 +1,180 @@
+"""Striped namespace locking for the NameNode.
+
+PR 17's bench rig showed the namesystem saturating at 32 clients with
+~0.6 of op p99 spent queueing on the ONE ``namespace`` RLock — every
+stat, read, create and datanode heartbeat serialized behind every
+other op's editlog fsync. This module replays the master's lock
+decomposition (PR 8) on the DFS control plane with THREE classes, all
+slotted into the repo-wide rank table (tpumr/metrics/locks.py):
+
+- ``namespace`` (rank 25) — the structural/global lock, held only for
+  cross-stripe ops: anything touching a SHALLOW path (fewer components
+  than the stripe depth, e.g. ``/user`` itself), fsck, checkpoints.
+  A structural op additionally acquires every stripe, so it excludes
+  all striped ops without those ops ever taking the global lock.
+- ``namespace-s<i>`` stripes (rank 26) — partition the path tree by a
+  stable hash of the first ``depth`` path components. An op on
+  ``/user/alice/f`` locks only alice's stripe; ops in other stripes
+  (other users' writes, the shared input tree's reads) proceed in
+  parallel, each paying only its OWN editlog group-commit wait.
+  Equal-rank acquisition is legal by the rank rule, so multi-path ops
+  (rename) take the union of their stripe sets in ascending stripe
+  index — a global total order that makes stripe deadlocks impossible.
+- ``namespace-blocks`` (rank 27) — the block/datanode plane: location
+  maps, datanode liveness, pending commands, leases, safemode
+  accounting. Short critical sections that NEVER journal, so datanode
+  heartbeats and block reports stop queueing behind namespace fsyncs
+  entirely. Ordering: stripe (26) -> blocks (27) is legal; the
+  reverse is a rank violation the debug assertion catches.
+
+Subtree coverage argument: a striped op's lock is the stripe of its
+path's first-``depth`` components. Every descendant of a path with
+>= depth components shares that prefix, hence that stripe — so a
+subtree delete/rename under its stripe excludes every op on every
+path inside the subtree. Paths with FEWER than depth components fall
+back to structural, which excludes everything.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import zlib
+from typing import Any, Iterator
+
+from tpumr.metrics.locks import (ORDER_CHECK, RANK_NAMESPACE,
+                                 RANK_NAMESPACE_BLOCKS,
+                                 RANK_NAMESPACE_STRIPE, InstrumentedRLock)
+
+
+class NamespaceLocks:
+    """The NameNode's three lock classes plus the stripe map.
+
+    Thread-local frames record which stripes the current thread holds
+    so (a) ``covers()`` lets _ensure_parents refuse to create an inode
+    outside the held stripe set (a racy fallback that would otherwise
+    silently bypass striping) and (b) nested striped contexts that
+    would acquire OUTSIDE the held set — an ordering hazard the rank
+    table cannot see because stripes share a rank — fail fast under
+    the same debug switch as the rank assertion."""
+
+    def __init__(self, stripes: int = 8, depth: int = 2) -> None:
+        self.n = max(1, int(stripes))
+        self.depth = max(1, int(depth))
+        self.global_lock = InstrumentedRLock(name="namespace",
+                                             rank=RANK_NAMESPACE)
+        self.stripes = [
+            InstrumentedRLock(name=f"namespace-s{i}",
+                              rank=RANK_NAMESPACE_STRIPE)
+            for i in range(self.n)]
+        self.blocks = InstrumentedRLock(name="namespace-blocks",
+                                        rank=RANK_NAMESPACE_BLOCKS)
+        self._all = frozenset(range(self.n))
+        self._tl = threading.local()
+
+    # ------------------------------------------------------------ map
+
+    def stripe_index(self, path: str) -> "int | None":
+        """Stripe owning ``path``, or None when the path is too shallow
+        to stripe (structural territory). Stable hash — must not vary
+        across processes/restarts the way ``hash()`` does."""
+        parts = [p for p in path.split("/") if p]
+        if len(parts) < self.depth:
+            return None
+        key = "/".join(parts[:self.depth])
+        return zlib.crc32(key.encode()) % self.n
+
+    # ------------------------------------------------------------ frames
+
+    def _frames(self) -> "list[frozenset]":
+        f = getattr(self._tl, "frames", None)
+        if f is None:
+            f = self._tl.frames = []
+        return f
+
+    def held_set(self) -> frozenset:
+        """Union of stripe indices held by this thread."""
+        out: frozenset = frozenset()
+        for f in self._frames():
+            out |= f
+        return out
+
+    def structural_held(self) -> bool:
+        return any(f is self._all or f == self._all
+                   for f in self._frames())
+
+    def covers(self, path: str) -> bool:
+        """Does this thread hold locks excluding all ops on ``path``?"""
+        if self.structural_held():
+            return True
+        i = self.stripe_index(path)
+        return i is not None and i in self.held_set()
+
+    # ------------------------------------------------------------ contexts
+
+    @contextlib.contextmanager
+    def for_paths(self, *paths: str) -> Iterator[None]:
+        """Lock context for an op touching exactly ``paths`` (and, for
+        subtree ops, everything under them). Escalates to structural
+        when any path is too shallow to stripe."""
+        idxs: "set[int]" = set()
+        for p in paths:
+            i = self.stripe_index(p)
+            if i is None:
+                with self.structural():
+                    yield
+                return
+            idxs.add(i)
+        order = sorted(idxs)
+        frames = self._frames()
+        if ORDER_CHECK and frames and not self.structural_held() \
+                and not idxs <= self.held_set():
+            # stripes share a rank, so the rank assertion cannot catch
+            # two threads acquiring overlapping stripe sets in opposite
+            # orders; forbid widening a held striped context instead
+            raise AssertionError(
+                f"nested stripe acquisition outside held set: "
+                f"want {order}, hold {sorted(self.held_set())}")
+        for i in order:
+            self.stripes[i].acquire()
+        frames.append(frozenset(idxs))
+        try:
+            yield
+        finally:
+            frames.pop()
+            for i in reversed(order):
+                self.stripes[i].release()
+
+    @contextlib.contextmanager
+    def structural(self) -> Iterator[None]:
+        """Global + every stripe, ascending — excludes all namespace
+        ops. Keep these sections short; every striped op queues."""
+        self.global_lock.acquire()
+        for lk in self.stripes:
+            lk.acquire()
+        frames = self._frames()
+        frames.append(self._all)
+        try:
+            yield
+        finally:
+            frames.pop()
+            for lk in reversed(self.stripes):
+                lk.release()
+            self.global_lock.release()
+
+    # ------------------------------------------------------------ metrics
+
+    def bind_metrics(self, reg: Any) -> None:
+        """One wait/hold family per lock CLASS (stripes share a pair —
+        per-stripe series would be 2·n mostly-idle histograms nobody
+        graphs; the class aggregate is what the bench SLO reads)."""
+        self.global_lock.bind(
+            reg.histogram("nn_lock_wait_seconds|lock=namespace"),
+            reg.histogram("nn_lock_hold_seconds|lock=namespace"))
+        sw = reg.histogram("nn_lock_wait_seconds|lock=namespace-stripe")
+        sh = reg.histogram("nn_lock_hold_seconds|lock=namespace-stripe")
+        for lk in self.stripes:
+            lk.bind(sw, sh)
+        self.blocks.bind(
+            reg.histogram("nn_lock_wait_seconds|lock=namespace-blocks"),
+            reg.histogram("nn_lock_hold_seconds|lock=namespace-blocks"))

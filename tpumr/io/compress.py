@@ -339,6 +339,32 @@ def wire_codec_or_none(name: "str | None") -> str:
     return name.lower()
 
 
+#: the tiniest chunk worth a compression attempt: below this the codec
+#: frame overhead eats the win and the CPU is pure waste
+WIRE_MIN_BYTES = 1024
+
+
+def wire_compress(out: dict, wire: str) -> None:
+    """Compress one served chunk's payload bytes for the wire, in
+    place, when it pays: the client OFFERED a codec, the payload itself
+    is uncompressed (re-compressing zlib'd bytes only burns CPU), and
+    the result actually shrank (pre-compressed/random data rides raw —
+    the response omits ``wire`` and the client skips the decode).
+    Shared by the shuffle server and the datanode block read path;
+    any size field the caller set stays payload-relative whatever the
+    wire carried."""
+    if (not wire or wire == "none" or out.get("codec", "none") != "none"
+            or len(out["data"]) < WIRE_MIN_BYTES):
+        return
+    try:
+        comp = get_codec(wire).compress(bytes(out["data"]))
+    except Exception:  # noqa: BLE001 — wire codec is best-effort
+        return
+    if len(comp) < len(out["data"]):
+        out["wire"] = wire
+        out["data"] = comp
+
+
 def codec_for_path(path: str) -> CompressionCodec | None:
     """Pick a codec by file extension (≈ CompressionCodecFactory)."""
     for cls in _REGISTRY.values():

@@ -1,0 +1,124 @@
+"""Pinned LRU cache of open read-only file descriptors.
+
+Grown out of the shuffle server (PR 13's ``SpillFdCache``) and now
+shared with the datanode's block read path: both serve a file that is
+read start-to-finish in ~1 MiB slices by many concurrent callers, and
+both used to pay O(chunks · open) syscalls and dentry walks for it.
+Here every chunk is one ``os.pread`` on a cached fd: stateless (no
+shared file position, so a reactor's pool threads read concurrently),
+exactly the payload slice is allocated (``pread`` returns the bytes
+the response frame ships — no staging buffer to copy out of), and the
+fd survives across chunks and callers until LRU pressure or an
+explicit invalidation closes it.
+
+Pinning: an fd being pread by one thread may be evicted by another;
+eviction under pin marks the entry dead and the LAST unpin closes it —
+never a read on a closed (possibly reused) fd number.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class FdCache:
+    """LRU of open read-only fds keyed by path, safe for concurrent
+    readers. ``invalidate(prefix)`` is the correctness lever for
+    writers: any path that was replaced/unlinked MUST be invalidated or
+    a cached fd keeps serving the old inode."""
+
+    class _Ent:
+        __slots__ = ("fd", "pins", "dead")
+
+        def __init__(self, fd: int) -> None:
+            self.fd = fd
+            self.pins = 0
+            self.dead = False
+
+    def __init__(self, capacity: int = 64) -> None:
+        self._cap = max(1, int(capacity))
+        # insertion order = recency order (re-inserted on every hit)
+        self._entries: "dict[str, FdCache._Ent]" = {}
+        self._lock = threading.Lock()
+        self.opens = 0
+        self.evictions = 0
+
+    def pread(self, path: str, n: int, offset: int) -> bytes:
+        ent = self._pin(path)
+        try:
+            return os.pread(ent.fd, n, offset)
+        finally:
+            self._unpin(ent)
+
+    def _pin(self, path: str) -> "FdCache._Ent":
+        with self._lock:
+            ent = self._entries.pop(path, None)
+            if ent is not None:
+                self._entries[path] = ent   # most-recently used again
+                ent.pins += 1
+                return ent
+        fd = os.open(path, os.O_RDONLY)
+        close_now = None
+        try:
+            with self._lock:
+                ent = self._entries.get(path)
+                if ent is not None:
+                    # lost an open race — use the cached fd, drop ours
+                    ent.pins += 1
+                    close_now = fd
+                    return ent
+                self.opens += 1
+                ent = FdCache._Ent(fd)
+                ent.pins = 1
+                self._entries[path] = ent
+                while len(self._entries) > self._cap:
+                    victim_path = next(iter(self._entries))
+                    victim = self._entries.pop(victim_path)
+                    self.evictions += 1
+                    if victim.pins:
+                        victim.dead = True   # last unpin closes it
+                    else:
+                        try:
+                            os.close(victim.fd)
+                        except OSError:
+                            pass
+                return ent
+        finally:
+            if close_now is not None:
+                try:
+                    os.close(close_now)
+                except OSError:
+                    pass
+
+    def _unpin(self, ent: "FdCache._Ent") -> None:
+        with self._lock:
+            ent.pins -= 1
+            if ent.dead and ent.pins == 0:
+                try:
+                    os.close(ent.fd)
+                except OSError:
+                    pass
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def invalidate(self, prefix: str = "") -> None:
+        """Drop (and close) every cached fd whose path starts with
+        ``prefix`` — callers unlink or atomically replace files, and a
+        cached fd would otherwise keep serving the OLD inode (shuffle:
+        pinning a purged job's disk blocks; datanode: returning stale
+        block bytes after a re-write). '' drops everything."""
+        with self._lock:
+            victims = [p for p in self._entries if p.startswith(prefix)] \
+                if prefix else list(self._entries)
+            for p in victims:
+                ent = self._entries.pop(p)
+                if ent.pins:
+                    ent.dead = True
+                else:
+                    try:
+                        os.close(ent.fd)
+                    except OSError:
+                        pass

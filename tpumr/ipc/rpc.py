@@ -1311,18 +1311,51 @@ class RpcClientPool:
     of reducers sees ``conns_per_target`` sockets per reduce, not
     ``parallel.copies``."""
 
-    def __init__(self, factory: Any, conns_per_target: int = 2) -> None:
+    def __init__(self, factory: Any, conns_per_target: int = 2,
+                 idle_s: float = 0.0) -> None:
         self._factory = factory
         self._cap = max(1, int(conns_per_target))
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        # addr -> [idle clients]; addr -> total live (leased + idle)
-        self._idle: "dict[str, list[RpcClient]]" = {}
+        # addr -> [(idle client, released_at)]; addr -> total live
+        # (leased + idle)
+        self._idle: "dict[str, list[tuple[RpcClient, float]]]" = {}
         self._count: "dict[str, int]" = {}
         self._closed = False
+        #: close idle connections older than this on the next pool
+        #: touch; 0 keeps them forever (the shuffle copier's choice —
+        #: its targets stay hot for a whole copy phase). Long-lived
+        #: clients with a drifting target set (a DFS client walking
+        #: many datanodes) set it so the pool cannot accrete one socket
+        #: per datanode ever contacted.
+        self.idle_s = float(idle_s)
         #: connections ever built (pool efficiency: a healthy copy
         #: phase reuses — this stays near targets * conns_per_target)
         self.connects = 0
+
+    def _prune_locked(self) -> "list[RpcClient]":
+        """Collect expired idle connections (caller holds the lock and
+        closes them OUTSIDE it)."""
+        if not self.idle_s:
+            return []
+        cutoff = time.monotonic() - self.idle_s
+        doomed: "list[RpcClient]" = []
+        for addr in list(self._idle):
+            fresh = []
+            for client, ts in self._idle[addr]:
+                if ts < cutoff:
+                    doomed.append(client)
+                    self._count[addr] = max(
+                        0, self._count.get(addr, 1) - 1)
+                else:
+                    fresh.append((client, ts))
+            if fresh:
+                self._idle[addr] = fresh
+            else:
+                del self._idle[addr]
+        if doomed:
+            self._cond.notify_all()
+        return doomed
 
     def acquire(self, addr: str, timeout_s: "float | None" = 30.0
                 ) -> RpcClient:
@@ -1331,16 +1364,19 @@ class RpcClientPool:
         for a release."""
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
         with self._cond:
+            doomed = self._prune_locked()
             while True:
                 if self._closed:
                     raise RpcError("client pool is closed")
                 idle = self._idle.get(addr)
                 if idle:
-                    return idle.pop()
+                    client = idle.pop()[0]
+                    break
                 if self._count.get(addr, 0) < self._cap:
                     # reserve the slot, build OUTSIDE the lock (a slow
                     # connect must not block other targets' leases)
                     self._count[addr] = self._count.get(addr, 0) + 1
+                    client = None
                     break
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
@@ -1349,6 +1385,13 @@ class RpcClientPool:
                         f"no shuffle connection to {addr} became free "
                         f"within {timeout_s:.0f}s")
                 self._cond.wait(timeout=remaining)
+        for c in doomed:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — already idle-expired
+                pass
+        if client is not None:
+            return client
         try:
             host, _, port = addr.rpartition(":")
             client = self._factory(host, int(port))
@@ -1376,18 +1419,24 @@ class RpcClientPool:
                 self._cond.notify()
             return
         with self._cond:
+            doomed = self._prune_locked()
             if self._closed:
                 self._count[addr] = max(0, self._count.get(addr, 1) - 1)
+                doomed.append(client)
             else:
-                self._idle.setdefault(addr, []).append(client)
+                self._idle.setdefault(addr, []).append(
+                    (client, time.monotonic()))
                 self._cond.notify()
-                return
-        client.close()
+        for c in doomed:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown/idle-expired
+                pass
 
     def close(self) -> None:
         with self._cond:
             self._closed = True
-            idle = [c for lst in self._idle.values() for c in lst]
+            idle = [c for lst in self._idle.values() for c, _ in lst]
             self._idle.clear()
             self._cond.notify_all()
         for c in idle:

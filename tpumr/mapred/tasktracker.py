@@ -36,6 +36,8 @@ import traceback
 from typing import Any
 
 from tpumr.core.counters import Counters
+from tpumr.io import compress
+from tpumr.io.fdcache import FdCache
 from tpumr.core import confkeys
 from tpumr.io import ifile
 from tpumr.ipc.rpc import RpcClient, RpcClientPool, RpcServer
@@ -299,141 +301,17 @@ def make_map_locator(events_fn: Any, secret: bytes | None,
                       conns_per_target=conns_per_target)
 
 
-class SpillFdCache:
-    """LRU of open spill-file descriptors on the SERVING side of the
-    shuffle. The original chunk path re-opened and re-seeked the spill
-    per chunk — O(chunks · open) syscalls and dentry walks for a
-    segment that is read start-to-finish in 1 MiB slices by design.
-    Here every chunk is one ``os.pread`` on a cached fd: stateless
-    (no shared file position, so the reactor's pool threads read
-    concurrently), exactly the payload slice is allocated (``pread``
-    returns the bytes the response frame ships — no staging buffer to
-    copy out of), and the fd survives across chunks, fetchers, and
-    reducers until LRU pressure or job cleanup closes it.
-
-    Pinning: an fd being pread by one thread may be evicted by another;
-    eviction under pin marks the entry dead and the LAST unpin closes
-    it — never a read on a closed (possibly reused) fd number."""
-
-    class _Ent:
-        __slots__ = ("fd", "pins", "dead")
-
-        def __init__(self, fd: int) -> None:
-            self.fd = fd
-            self.pins = 0
-            self.dead = False
-
-    def __init__(self, capacity: int = 64) -> None:
-        self._cap = max(1, int(capacity))
-        # insertion order = recency order (re-inserted on every hit)
-        self._entries: "dict[str, SpillFdCache._Ent]" = {}
-        self._lock = threading.Lock()
-        self.opens = 0
-        self.evictions = 0
-
-    def pread(self, path: str, n: int, offset: int) -> bytes:
-        ent = self._pin(path)
-        try:
-            return os.pread(ent.fd, n, offset)
-        finally:
-            self._unpin(ent)
-
-    def _pin(self, path: str) -> "SpillFdCache._Ent":
-        with self._lock:
-            ent = self._entries.pop(path, None)
-            if ent is not None:
-                self._entries[path] = ent   # most-recently used again
-                ent.pins += 1
-                return ent
-        fd = os.open(path, os.O_RDONLY)
-        close_now = None
-        try:
-            with self._lock:
-                ent = self._entries.get(path)
-                if ent is not None:
-                    # lost an open race — use the cached fd, drop ours
-                    ent.pins += 1
-                    close_now = fd
-                    return ent
-                self.opens += 1
-                ent = SpillFdCache._Ent(fd)
-                ent.pins = 1
-                self._entries[path] = ent
-                while len(self._entries) > self._cap:
-                    victim_path = next(iter(self._entries))
-                    victim = self._entries.pop(victim_path)
-                    self.evictions += 1
-                    if victim.pins:
-                        victim.dead = True   # last unpin closes it
-                    else:
-                        try:
-                            os.close(victim.fd)
-                        except OSError:
-                            pass
-                return ent
-        finally:
-            if close_now is not None:
-                try:
-                    os.close(close_now)
-                except OSError:
-                    pass
-
-    def _unpin(self, ent: "SpillFdCache._Ent") -> None:
-        with self._lock:
-            ent.pins -= 1
-            if ent.dead and ent.pins == 0:
-                try:
-                    os.close(ent.fd)
-                except OSError:
-                    pass
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._entries)
-
-    def invalidate(self, prefix: str = "") -> None:
-        """Drop (and close) every cached fd whose path starts with
-        ``prefix`` — job cleanup unlinks the spill tree, and a cached
-        fd would otherwise pin the disk blocks of a purged job until
-        LRU pressure got around to it. '' drops everything."""
-        with self._lock:
-            victims = [p for p in self._entries if p.startswith(prefix)] \
-                if prefix else list(self._entries)
-            for p in victims:
-                ent = self._entries.pop(p)
-                if ent.pins:
-                    ent.dead = True
-                else:
-                    try:
-                        os.close(ent.fd)
-                    except OSError:
-                        pass
+#: PR 13's shuffle-serving fd LRU, since promoted to the shared
+#: tpumr.io.fdcache engine (the datanode block read path uses the same
+#: cache); the name is kept for the existing shuffle call sites.
+SpillFdCache = FdCache
 
 
-#: the tiniest chunk worth a compression attempt: below this the codec
-#: frame overhead eats the win and the CPU is pure waste
-_WIRE_MIN_BYTES = 1024
-
-
-def _wire_compress(out: dict, wire: str) -> None:
-    """Compress one served chunk's payload bytes for the wire, in
-    place, when it pays: the client OFFERED a codec, the spill itself
-    is uncompressed (re-compressing zlib'd bytes only burns CPU), and
-    the result actually shrank (pre-compressed/random data rides raw —
-    the response omits ``wire`` and the client skips the decode).
-    ``n`` always reports the payload-space length covered, so chunk
-    offsets stay payload-relative whatever the wire carried."""
-    if (not wire or wire == "none" or out.get("codec", "none") != "none"
-            or len(out["data"]) < _WIRE_MIN_BYTES):
-        return
-    from tpumr.io.compress import get_codec
-    try:
-        comp = get_codec(wire).compress(bytes(out["data"]))
-    except Exception:  # noqa: BLE001 — wire codec is best-effort
-        return
-    if len(comp) < len(out["data"]):
-        out["wire"] = wire
-        out["data"] = comp
+#: wire compression for served chunks moved to tpumr.io.compress
+#: (shared with the datanode); aliases keep the shuffle call sites and
+#: tests unchanged
+_WIRE_MIN_BYTES = compress.WIRE_MIN_BYTES
+_wire_compress = compress.wire_compress
 
 
 def serve_chunk(fds: SpillFdCache, path: str, index: dict,

@@ -13,13 +13,22 @@ stays flat = more contenders; both climbing = the work under the lock
 grew. These are the first series the control-plane scale-out refactor
 is judged against (ROADMAP, bench_scale.py).
 
-Since the lock decomposition (PR 8) the master runs on SIX lock
-classes with a fixed acquisition order, ascending by rank (the
-``namespace`` class is the NameNode's — a separate process, slotted
-into the one table so tooling sees every ranked lock)::
+Since the lock decomposition (PR 8) the master runs on a fixed set of
+lock classes with a fixed acquisition order, ascending by rank (the
+``namespace*`` classes are the NameNode's — a separate process,
+slotted into the one table so tooling sees every ranked lock)::
 
     tracker-beat(5) -> scheduler(10) -> pipeline(15) -> global(20)
-        -> namespace(25) -> trackers(30) -> job(40)
+        -> namespace(25) -> namespace-stripe(26) -> namespace-blocks(27)
+        -> trackers(30) -> job(40)
+
+The NameNode's three classes mirror the master's decomposition: the
+``namespace`` global (25) is held only for cross-stripe structural
+ops (rename/delete on shallow paths, fsck, checkpoints), the
+``namespace-stripe`` stripes (26) partition the path tree so
+same-rank sorted-index multi-acquisition is legal, and
+``namespace-blocks`` (27) guards the block/datanode plane (locations,
+heartbeats, leases) in short critical sections that never journal.
 
 The ``pipeline`` rank (the DAG engine's state lock) sits below
 ``global`` because recording a stage submission and reading member-job
@@ -53,15 +62,23 @@ RANK_TRACKER_BEAT = 5    # one tracker's heartbeat processing
 RANK_SCHEDULER = 10      # scheduler passes (before_heartbeat / assign)
 RANK_PIPELINE = 15       # DAG engine state (PipelineInProgress tables)
 RANK_GLOBAL = 20         # job table, commit grants, admin swaps
-RANK_NAMESPACE = 25      # the NameNode's FSNamesystem (DFS control
-#                          plane; its own process — co-held with no
-#                          master lock today, ranked so the analyzer
-#                          and /threads see it like any master class)
+RANK_NAMESPACE = 25      # the NameNode's structural/global lock (DFS
+#                          control plane; its own process — co-held
+#                          with no master lock today, ranked so the
+#                          analyzer and /threads see it like any
+#                          master class)
+RANK_NAMESPACE_STRIPE = 26  # NameNode path-tree stripes (acquired in
+#                          ascending stripe-index order; equal-rank
+#                          multi-acquisition is legal by design)
+RANK_NAMESPACE_BLOCKS = 27  # NameNode block/datanode plane (locations,
+#                          heartbeats, leases, pending commands) —
+#                          short sections, never journals under it
 RANK_TRACKERS = 30       # tracker registry stripes
 RANK_JOB = 40            # one JobInProgress's task bookkeeping
 
 _ORDER_NAMES = "tracker-beat(5) -> scheduler(10) -> pipeline(15) " \
-               "-> global(20) -> namespace(25) -> trackers(30) -> job(40)"
+               "-> global(20) -> namespace(25) -> namespace-stripe(26) " \
+               "-> namespace-blocks(27) -> trackers(30) -> job(40)"
 
 #: debug-mode ordering assertion: on under ``__debug__`` (plain
 #: ``python``), off under ``python -O`` or TPUMR_LOCK_ORDER_CHECK=0

@@ -61,7 +61,10 @@ def seed_files(nn_host: str, nn_port: int, conf: Any = None,
         for i in range(n_files):
             path = f"{root}/f_{i}"
             if not cli.exists(path):
-                with cli.create(path) as out:
+                # replication=2 on a 3-DN rung leaves the hot-block
+                # policy headroom to prove itself: the hot file's
+                # replica count visibly climbs 2 -> 3 under skew
+                with cli.create(path, replication=2) as out:
                     out.write(payload[:file_bytes])
             paths.append(path)
         return paths
@@ -70,18 +73,12 @@ def seed_files(nn_host: str, nn_port: int, conf: Any = None,
 
 
 def close_client(cli: DFSClient) -> None:
-    """DFSClient has no close(); drop its sockets explicitly so a
+    """Drop the client's sockets (renewer, NN conn, DN pool) so a
     ramp's retired rungs don't leak fds into the next."""
-    cli._stop_renew.set()
     try:
-        cli.nn.close()
+        cli.close()
     except Exception:  # noqa: BLE001
         pass
-    for dn in cli._dn_clients.values():
-        try:
-            dn.close()
-        except Exception:  # noqa: BLE001
-            pass
 
 
 class SimDFSClient:
@@ -95,7 +92,7 @@ class SimDFSClient:
                  hot_read_p: float = 0.5,
                  read_bytes: int = 1 << 16,
                  mix: "tuple | None" = None,
-                 home: str = "/bench/clients",
+                 home: str = "/user",
                  rng: "random.Random | None" = None) -> None:
         self.name = name
         self.cli = DFSClient(nn_host, nn_port, conf)
@@ -105,6 +102,9 @@ class SimDFSClient:
         self.mix = tuple(mix or DEFAULT_MIX)
         self._weights = [w for _op, w in self.mix]
         self._rng = rng or random.Random(hash(name) & 0xFFFFFFFF)
+        # /user/<name>/... gives every client its own depth-2 stripe
+        # prefix, so write/rename/delete churn spreads across the
+        # namenode's striped locks instead of serializing on one
         self.home = f"{home}/{name}"
         self._made_home = False
         self._seq = 0
@@ -380,12 +380,29 @@ def run_dfs_step(n_clients: int, *, conf: Any = None,
             "nn_op_p99_by_op": {
                 op: round(_p(h.snapshot(), "p99"), 6)
                 for op, h in sorted(nn._op_hists.items())},
-            "lock_wait_p99_s": round(_p(reg.get(
-                "nn_lock_wait_seconds|lock=namespace"), "p99"), 6),
-            "lock_hold_p99_s": round(_p(reg.get(
-                "nn_lock_hold_seconds|lock=namespace"), "p99"), 6),
+            # the striped namenode reports three lock families
+            # (namespace = structural/global, namespace-stripe,
+            # namespace-blocks); the headline wait/hold p99 is the
+            # worst family — the one gating op latency at this rung
+            "lock_wait_p99_s": round(max(
+                (_p(h, "p99") for k, h in reg.items()
+                 if k.startswith("nn_lock_wait_seconds|")),
+                default=0.0), 6),
+            "lock_hold_p99_s": round(max(
+                (_p(h, "p99") for k, h in reg.items()
+                 if k.startswith("nn_lock_hold_seconds|")),
+                default=0.0), 6),
+            "lock_wait_p99_by_lock": {
+                k.split("lock=", 1)[1]: round(_p(h, "p99"), 6)
+                for k, h in sorted(reg.items())
+                if k.startswith("nn_lock_wait_seconds|")},
             "editlog_sync_p99_s": round(_p(reg.get(
                 "nn_editlog_sync_seconds"), "p99"), 6),
+            # fsyncs absorbed per group commit: mean ops covered by
+            # one sync (1.0 = no batching; >1 = the editlog is
+            # coalescing concurrent mutations into shared fsyncs)
+            "editlog_group_ops_mean": round(_p(reg.get(
+                "nn_editlog_group_ops"), "mean"), 3),
             # data-plane throughput + tails, both sides
             "read_mb_s": round(fl["bytes_read"] / wall / 1e6, 3),
             "read_rtt_p50_s": round(_p(fl["read_rtt"], "p50"), 6),
@@ -400,10 +417,19 @@ def run_dfs_step(n_clients: int, *, conf: Any = None,
             # the cluster-wide top block (the /hotblocks headline)
             "hot_total_reads": hot_total,
             "hot_top": [{"block": r["block"], "path": r.get("path", ""),
-                         "reads": r["reads"]} for r in hot_top[:3]],
+                         "reads": r["reads"],
+                         "replicas": r.get("replicas", 0),
+                         "boost": r.get("boost", 0)}
+                        for r in hot_top[:3]],
             "hot_top1_share": round(
                 hot_top[0]["reads"] / hot_total, 4)
                 if hot_top and hot_total else 0.0,
+            # the auto-replication receipt: the top block's live
+            # replica count and the boost the policy assigned it
+            "hot_top1_replicas": int(hot_top[0].get("replicas", 0))
+                if hot_top else 0,
+            "hot_top1_boost": int(hot_top[0].get("boost", 0))
+                if hot_top else 0,
         }
         # lock wait p99 as a share of op p99: ~1.0 means the namespace
         # lock IS the op latency (the saturation signature the

@@ -103,9 +103,12 @@ CONF_RECEIVERS = {"conf", "self", "_conf", "conf_dict", "jc", "jobconf",
                   "job_conf", "cfg", "site", "fi_conf", "confkeys"}
 
 #: helpers that read conf keys handed to them as string arguments —
-#: function name -> positional indexes carrying key names (e.g.
-#: ``read_hosts_lists(conf, "mapred.hosts", "mapred.hosts.exclude")``)
-INDIRECT_READERS = {"read_hosts_lists": (1, 2)}
+#: function name -> (key_idx, default_idx|None) pairs (e.g.
+#: ``read_hosts_lists(conf, "mapred.hosts", "mapred.hosts.exclude")``;
+#: ``self._conf_get("tdfs.client.dn.conns", 2)`` carries a call-site
+#: default at index 1 that conf-default checks against the registry)
+INDIRECT_READERS = {"read_hosts_lists": ((1, None), (2, None)),
+                    "_conf_get": ((0, 1),)}
 
 
 @dataclass
@@ -191,15 +194,18 @@ def collect_reads(mods: "list[Module]") -> "list[Read]":
                 continue
             getter = call_name(node)
             if getter in INDIRECT_READERS:
-                for idx in INDIRECT_READERS[getter]:
+                for idx, didx in INDIRECT_READERS[getter]:
                     if idx < len(node.args):
                         got = _key_of(node.args[idx], consts,
                                       global_consts)
                         if got is not None:
+                            default = _NO_DEFAULT
+                            if didx is not None and didx < len(node.args):
+                                default = _literal(node.args[didx])
                             reads.append(Read(
                                 rel=m.rel, line=node.lineno, key=got[0],
                                 dynamic=got[1], type="str",
-                                default=_NO_DEFAULT, typed=False))
+                                default=default, typed=False))
                 continue
             if getter not in GETTER_TYPES or \
                     not isinstance(node.func, ast.Attribute):

@@ -73,7 +73,8 @@ TUPLE_LOCK_METHODS = {"shard_of": "RANK_TRACKERS"}
 #: actually declares when it is in the corpus
 DEFAULT_RANKS = {"RANK_TRACKER_BEAT": 5, "RANK_SCHEDULER": 10,
                  "RANK_PIPELINE": 15, "RANK_GLOBAL": 20,
-                 "RANK_NAMESPACE": 25, "RANK_TRACKERS": 30,
+                 "RANK_NAMESPACE": 25, "RANK_NAMESPACE_STRIPE": 26,
+                 "RANK_NAMESPACE_BLOCKS": 27, "RANK_TRACKERS": 30,
                  "RANK_JOB": 40}
 
 _SOCKETY = ("sock", "conn", "channel")
