@@ -1,0 +1,593 @@
+"""DFS chaos certification: the storage-layer kill/corrupt/partition
+seams (fi.py "storage churn seams") against the PR-18 fast path — fd
+cache invalidation races, editlog group-commit crash handling, striped
+lock escalation, hot-boost state across an NN crash, and the
+dn_crash / dn_partition / nn_restart / block_corrupt recovery loops on
+a live MiniDFSCluster (docs/OPERATIONS.md "DFS failure runbook")."""
+
+import copy
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpumr.dfs.editlog import FSEditLog, list_segments
+from tpumr.dfs.mini_cluster import MiniDFSCluster
+from tpumr.dfs.namenode import FSNamesystem
+from tpumr.dfs.nslock import NamespaceLocks
+from tpumr.io.fdcache import FdCache
+from tpumr.mapred.jobconf import JobConf
+from tpumr.utils import fi
+
+
+def small_conf(block_size=1024, replication=2):
+    conf = JobConf()
+    conf.set("dfs.block.size", block_size)
+    conf.set("dfs.replication", replication)
+    conf.set("tdfs.replication.interval.s", 0.2)
+    conf.set("tdfs.datanode.expiry.s", 1.5)
+    conf.set("tdfs.http.port", -1)
+    return conf
+
+
+@pytest.fixture(autouse=True)
+def _fi_reset():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+# ------------------------------------------------------------ fd cache
+
+
+class TestFdCacheInvalidateRace:
+    def test_invalidate_during_open_is_not_cached(self, tmp_path,
+                                                  monkeypatch):
+        """The staleness hole: _pin opens OUTSIDE the lock, so an
+        invalidate() (delete + recreate of the same block id) landing
+        between the open and the insert must NOT leave the old inode's
+        fd cached — every later pread would serve the deleted bytes."""
+        path = str(tmp_path / "blk_7")
+        with open(path, "wb") as f:
+            f.write(b"OLD" * 10)
+        cache = FdCache(capacity=4)
+        real_open = os.open
+        raced = {"done": False}
+
+        def racing_open(p, flags, *a):
+            fd = real_open(p, flags, *a)
+            if p == path and not raced["done"]:
+                raced["done"] = True
+                # the re-replication race: block deleted and recreated
+                # with new contents while our open was in flight
+                os.unlink(path)
+                with open(path, "wb") as f:
+                    f.write(b"NEW" * 10)
+                cache.invalidate(path)
+            return fd
+
+        monkeypatch.setattr("tpumr.io.fdcache.os.open", racing_open)
+        assert cache.pread(path, 30, 0) == b"NEW" * 10
+        # and the cached entry serves the new inode from now on
+        assert cache.pread(path, 30, 0) == b"NEW" * 10
+
+    def test_storm_falls_back_to_locked_open(self, tmp_path, monkeypatch):
+        """An invalidation storm (epoch bumps on every attempt) must
+        still terminate: the fallback opens under the lock."""
+        path = str(tmp_path / "blk_9")
+        with open(path, "wb") as f:
+            f.write(b"x" * 8)
+        cache = FdCache(capacity=4)
+        real_open = os.open
+
+        calls = {"n": 0}
+
+        def stormy_open(p, flags, *a):
+            # every unlocked open attempt (the 8 retries) loses to a
+            # concurrent invalidate; the 9th open is the under-lock
+            # fallback, which an invalidate can no longer race
+            calls["n"] += 1
+            if calls["n"] <= 8:
+                cache.invalidate("")
+            return real_open(p, flags, *a)
+
+        monkeypatch.setattr("tpumr.io.fdcache.os.open", stormy_open)
+        assert cache.pread(path, 8, 0) == b"x" * 8
+
+
+# ------------------------------------------------------------ editlog
+
+
+class TestEditlogCrash:
+    def test_follower_never_acks_failed_leader_sync(self, tmp_path,
+                                                    monkeypatch):
+        """fsyncgate: when a leader's fsync fails, a follower whose
+        record that fsync would have covered must raise too — retrying
+        fsync on the same fd could report success for pages the kernel
+        already marked clean. Both callers error; later appends land on
+        a FRESH segment and commit for real."""
+        el = FSEditLog(str(tmp_path))
+        real_fsync = os.fsync
+        state = {"armed": True}
+
+        def wedged_fsync(fd):
+            if state["armed"]:
+                state["armed"] = False
+                # hold the leader's fsync open until the follower's
+                # record has been appended behind it (so the failed
+                # sync genuinely "covers" the follower)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and el._appended < 2:
+                    time.sleep(0.005)
+                assert el._appended >= 2
+                raise OSError("injected fsync failure")
+            real_fsync(fd)
+
+        monkeypatch.setattr("tpumr.dfs.editlog.os.fsync", wedged_fsync)
+        errors = {}
+
+        def leader():
+            try:
+                el.log({"op": "t", "who": "leader"})
+            except OSError as e:
+                errors["leader"] = e
+
+        def follower():
+            # appended while the leader's doomed fsync is in flight
+            try:
+                el.log({"op": "t", "who": "follower"})
+            except OSError as e:
+                errors["follower"] = e
+
+        t1 = threading.Thread(target=leader)
+        t1.start()
+        # wait for the leader to be mid-fsync (baton held)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not el._syncing:
+            time.sleep(0.005)
+        assert el._syncing
+        t2 = threading.Thread(target=follower)
+        t2.start()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert not t1.is_alive() and not t2.is_alive()
+        assert "leader" in errors
+        assert "follower" in errors          # never acked durability
+        seg_after_fail = el._seg_no
+        # the journal recovered onto a fresh segment: this append is
+        # durable and replays
+        el.log({"op": "t", "who": "after"})
+        el.close()
+        assert el._seg_no == seg_after_fail  # no further churn
+        replayed = [op["who"] for op in FSEditLog.replay(str(tmp_path))]
+        # the poisoned records may or may not have hit disk (durability
+        # UNKNOWN is the point) — but the post-recovery record must
+        assert replayed[-1] == "after"
+
+    def test_roll_fsync_failure_poisons_waiters(self, tmp_path,
+                                                monkeypatch):
+        """A roll that fsyncs an unsynced tail and fails must poison the
+        queued appenders (they raise, not hang) and re-raise to the
+        roller."""
+        el = FSEditLog(str(tmp_path))
+        el.log({"op": "t", "i": 0})
+
+        def bad_fsync(fd):
+            raise OSError("injected roll-fsync failure")
+
+        # append without syncing: grab the mutex ourselves so the
+        # appender thread parks pre-leadership with an unsynced record
+        with el._cond:
+            el._f.write(b'{"op":"t","i":1}\n')
+            el._f.flush()
+            el._appended += 1
+            el.records += 1
+        monkeypatch.setattr("tpumr.dfs.editlog.os.fsync", bad_fsync)
+        with pytest.raises(OSError):
+            el.roll()
+        assert el._failed >= el._appended
+        monkeypatch.undo()
+        el.close()
+
+    def test_torn_tail_out_of_order_counters_replay(self, tmp_path):
+        """Crash-replay of a group-committed segment: allocator counter
+        records journaled out of allocation order (striped creates) plus
+        a torn final line. Replay must stop at the tear AND apply
+        counters as a monotonic max — never rewinding next_block onto
+        already-issued ids."""
+        name_dir = tmp_path / "name"
+        name_dir.mkdir()
+        seg = name_dir / "edits-0000000001.jsonl"
+        recs = [
+            {"op": "mkdir", "path": "/a", "t": 1.0},
+            # out-of-order allocator bumps: 7 journaled before 5
+            {"op": "counters", "values": {"next_block": 7, "gen": 3}},
+            {"op": "counters", "values": {"next_block": 5, "gen": 1}},
+            {"op": "mkdir", "path": "/b", "t": 2.0},
+        ]
+        body = b"".join(json.dumps(r).encode() + b"\n" for r in recs)
+        # torn tail: a partial record with no newline (crash mid-write)
+        seg.write_bytes(body + b'{"op": "mkdir", "pa')
+        conf = small_conf()
+        ns = FSNamesystem(str(name_dir), conf)
+        try:
+            assert ns.counters["next_block"] == 7      # max, not last
+            assert ns.counters["gen"] == 3
+            assert "/a" in ns.namespace and "/b" in ns.namespace
+            # the torn record never applied
+            assert len([p for p in ns.namespace
+                        if p.startswith("/") and p != "/"]) == 2
+            # the writer sealed the torn segment: appends go to a new one
+            assert not ns.edits.path.endswith("edits-0000000001.jsonl")
+        finally:
+            ns.edits.close()
+
+
+# ------------------------------------------------------------ nslock
+
+
+class TestEscalationGuard:
+    def test_structural_after_stripe_raises(self):
+        """Escalating to the global lock while already holding stripes
+        acquires rank 25 after rank 26 — a real deadlock against a
+        concurrent structural() holder. The guard fails fast instead."""
+        locks = NamespaceLocks(stripes=4, depth=2)
+        with locks.for_paths("/user/alice/a"):
+            assert not locks.structural_held()
+            with pytest.raises(RuntimeError, match="escalation"):
+                with locks.structural():
+                    pass
+        # and the stripe frame unwound cleanly: structural works now
+        with locks.structural():
+            assert locks.structural_held()
+
+    def test_structural_reentry_still_allowed(self):
+        locks = NamespaceLocks(stripes=4, depth=2)
+        with locks.structural():
+            with locks.structural():
+                assert locks.structural_held()
+
+
+# ------------------------------------------------------------ hot boost
+
+
+class TestHotBoostAcrossRestart:
+    def test_boosted_block_trims_after_crash_restart(self, tmp_path):
+        """hot_boost is volatile (never journaled): after an NN crash
+        the restarted namesystem sees 3 replicas of a 2-replica file
+        with NO boost — the over-replication branch must trim back to
+        base instead of stranding the extra copy forever."""
+        conf = small_conf()
+        conf.set("tdfs.hotblocks.replicate.share", 0.2)
+        conf.set("tdfs.hotblocks.replicate.min.reads", 10)
+        conf.set("tdfs.hotblocks.replicate.cap", 3)
+        conf.set("tdfs.hotblocks.cool.s", 60)   # boost would NOT expire
+        name_dir = str(tmp_path / "name")
+        ns = FSNamesystem(name_dir, conf)
+        dns = [f"127.0.0.1:{7001 + i}" for i in range(3)]
+        for addr in dns:
+            ns.register_datanode(addr, 1 << 30)
+        ns.create("/hot.bin", "cli", 2, 1024, True)
+        meta = ns.add_block("/hot.bin", "cli")
+        bid = meta["block_id"]
+        for addr in meta["targets"]:
+            ns.block_received(addr, bid, 512)
+        ns.complete("/hot.bin", "cli", 512)
+        ns.hot_blocks.fold(dns[0], {"total": 50,
+                                    "top": [[str(bid), 40, 0]]})
+        assert ns.hotblock_check() == 1
+        assert ns.replication_check() == 1
+        third = {a for a in dns} - set(meta["targets"])
+        ns.block_received(third.pop(), bid, 512)
+        assert len(ns.block_locations[bid]) == 3
+        # crash: the journal fd is abandoned, nothing shuts down cleanly
+        ns2 = FSNamesystem(name_dir, conf)
+        try:
+            assert ns2.hot_boost == {}            # volatile, as designed
+            for addr in dns:
+                ns2.register_datanode(addr, 1 << 30)
+            for addr in ns.block_locations[bid]:
+                ns2.block_report(addr, [[bid, 512]])
+            assert not ns2.safemode
+            assert len(ns2.block_locations[bid]) == 3
+            assert ns2.replication_check() >= 1   # the trim
+            assert len(ns2.block_locations[bid]) == 2
+        finally:
+            ns2.edits.close()
+            ns.edits.close()
+
+
+# ------------------------------------------------------------ seams, live
+
+
+class TestDataNodeCrashSeam:
+    def test_dn_crash_failover_and_rereplication(self):
+        """dn.crash.d<n>: the targeted node hard-kills mid-beat; the
+        reader fails over to a surviving replica, the NN expires the
+        node, and re-replication restores the target count."""
+        conf = small_conf()
+        with MiniDFSCluster(num_datanodes=3, conf=conf) as c:
+            client = c.client()
+            payload = b"C" * 2500
+            with client.create("/chaos/f", replication=2) as f:
+                f.write(payload)
+            blocks = client.nn.call("get_block_locations", "/chaos/f")
+            dead_addr = blocks[0]["locations"][0]
+            idx = next(i for i, dn in enumerate(c.datanodes)
+                       if dn.addr == dead_addr)
+            conf.set(f"tpumr.fi.dn.crash.d{idx}.probability", 1.0)
+            conf.set(f"tpumr.fi.dn.crash.d{idx}.max.failures", 1)
+            deadline = time.time() + 10
+            while time.time() < deadline and not c.datanodes[idx].killed:
+                time.sleep(0.05)
+            assert c.datanodes[idx].killed
+            assert fi.fired(f"dn.crash.d{idx}") == 1
+            # reads keep working through surviving replicas the whole time
+            with client.open("/chaos/f") as f:
+                assert f.read() == payload
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                blocks = client.nn.call("get_block_locations", "/chaos/f")
+                if all(dead_addr not in b["locations"]
+                       and len(b["locations"]) >= 2 for b in blocks):
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail(f"not re-replicated: {blocks}")
+            with client.open("/chaos/f") as f:
+                assert f.read() == payload
+
+
+class TestDataNodePartitionSeam:
+    def test_partition_expires_then_rejoins(self):
+        """dn.partition: heartbeat silence without death — the NN
+        expires the node; when the partition heals the node rides
+        dn_heartbeat's "register" back in with a block report."""
+        conf = small_conf()
+        conf.set("tpumr.fi.dn.partition.ms", 2500)
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            client = c.client()
+            with client.create("/part/f", replication=2) as f:
+                f.write(b"P" * 900)
+            conf.set("tpumr.fi.dn.partition.probability", 1.0)
+            conf.set("tpumr.fi.dn.partition.max.failures", 1)
+            ns = c.namenode.ns
+            deadline = time.time() + 10
+            while time.time() < deadline and len(ns.datanodes) == 2:
+                time.sleep(0.05)
+            assert len(ns.datanodes) == 1        # expired, not dead
+            assert fi.fired("dn.partition") == 1
+            assert not any(dn.killed for dn in c.datanodes)
+            deadline = time.time() + 15
+            while time.time() < deadline and len(ns.datanodes) < 2:
+                time.sleep(0.1)
+            assert len(ns.datanodes) == 2        # rejoined
+            with client.open("/part/f") as f:
+                assert f.read() == b"P" * 900
+
+
+class TestBlockCorruptSeam:
+    def test_reader_never_sees_rot_and_replica_heals(self):
+        """block_corrupt end-to-end: a seeded dn.read.corrupt.b<id>
+        flips a byte on disk just before a read serves it. The CRC path
+        catches it, the reader fails over (bytes identical to the
+        no-fault control), the bad replica is dropped, and
+        re-replication restores the count."""
+        conf = small_conf()
+        with MiniDFSCluster(num_datanodes=3, conf=conf) as c:
+            client = c.client()
+            payload = bytes(range(256)) * 3       # single 768 B block
+            with client.create("/rot/f", replication=2) as f:
+                f.write(payload)
+            # no-fault control read
+            with client.open("/rot/f") as f:
+                control = f.read()
+            assert control == payload
+            blk = client.nn.call("get_block_locations", "/rot/f")[0]
+            bid = blk["block_id"]
+            assert len(blk["locations"]) == 2
+            conf.set(f"tpumr.fi.dn.read.corrupt.b{bid}.probability", 1.0)
+            conf.set(f"tpumr.fi.dn.read.corrupt.b{bid}.max.failures", 1)
+            # the faulted read: bytes must equal the control exactly
+            with client.open("/rot/f") as f:
+                assert f.read() == control
+            assert fi.fired(f"dn.read.corrupt.b{bid}") == 1
+            ns = c.namenode.ns
+            assert ns.corrupt_replicas.get(bid)   # reported, dropped
+            bad_addr = next(iter(ns.corrupt_replicas[bid]))
+            bad_dn = next(dn for dn in c.datanodes
+                          if dn.addr == bad_addr)
+
+            def bad_copy_resolved():
+                # either the delete command landed, or re-replication
+                # chose this node again and overwrote it with a CLEAN
+                # copy — both end the incident
+                if bid not in dict(bad_dn.store.blocks()):
+                    return True
+                try:
+                    bad_dn.store.read(bid)
+                    return True
+                except Exception:  # noqa: BLE001 — still corrupt
+                    return False
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                locs = client.nn.call("get_block_locations",
+                                      "/rot/f")[0]["locations"]
+                if len(locs) >= 2 and bad_copy_resolved():
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail("corrupt replica not dropped+re-replicated")
+            with client.open("/rot/f") as f:
+                assert f.read() == payload
+
+
+class TestNameNodeKillRecovery:
+    def test_client_rides_retries_across_nn_kill(self):
+        """nn_restart: SIGKILL the NN mid-fleet; a client configured
+        with RPC retries blocks through the outage and succeeds once
+        the restarted NN replays the journal and leaves safemode."""
+        conf = small_conf()
+        conf.set("tdfs.client.nn.retries", 60)
+        conf.set("tdfs.client.nn.backoff.ms", 100)
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            client = c.client()
+            payload = b"K" * 2500
+            with client.create("/kill/f") as f:
+                f.write(payload)
+            c.kill_namenode()
+            assert c.namenode.killed
+            result = {}
+
+            def read_through_outage():
+                # transport errors ride the RPC retry policy; a
+                # post-restart safemode refusal is an APPLICATION error
+                # the caller retries (the HDFS client's SafeModeException
+                # loop) — docs/OPERATIONS.md "safemode triage"
+                cli = c.client()
+                deadline = time.time() + 25
+                try:
+                    while time.time() < deadline:
+                        try:
+                            with cli.open("/kill/f") as f:
+                                result["data"] = f.read()
+                            return
+                        except Exception as e:  # noqa: BLE001
+                            if "safe mode" not in str(e):
+                                raise
+                            time.sleep(0.1)
+                    result["error"] = "timed out in safemode"
+                except Exception as e:  # noqa: BLE001
+                    result["error"] = e
+                finally:
+                    cli.close()
+
+            t = threading.Thread(target=read_through_outage)
+            t.start()
+            time.sleep(0.5)                       # a real outage window
+            nn2 = c.restart_killed_namenode()
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert result.get("data") == payload, result.get("error")
+            # the replayed namespace has the file; safemode was earned
+            # back out through the DNs' re-register + block reports
+            assert not nn2.ns.safemode
+            assert "/kill/f" in nn2.ns.namespace
+
+    def test_phantom_uc_block_does_not_wedge_safemode(self):
+        """A writer killed between add_block (journaled) and the first
+        byte reaching a DataNode leaves a block NO replica can ever
+        report. The restart denominator must exclude open files'
+        blocks — matching live accounting, where complete/close adds
+        them — or safemode never exits (the dfs_nn_failover wedge)."""
+        conf = small_conf()
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            client = c.client()
+            with client.create("/ph/closed") as f:
+                f.write(b"P" * 2500)
+            # journal an allocation the "writer" never ships: the
+            # crash window between add_block and the DN write
+            client.nn.call("create", "/ph/open", client.name,
+                           None, None, True)
+            client.nn.call("add_block", "/ph/open", client.name)
+            c.kill_namenode()
+            nn2 = c.restart_killed_namenode()
+            assert nn2.ns.namespace["/ph/open"].get("uc")
+            assert nn2.ns.namespace["/ph/open"]["blocks"]
+            deadline = time.time() + 10
+            while time.time() < deadline and nn2.ns.safemode:
+                time.sleep(0.05)
+            assert not nn2.ns.safemode, (
+                f"safemode wedged at "
+                f"{nn2.ns._reported_fraction():.3f} of "
+                f"{nn2.ns.total_known_blocks} blocks")
+            with client.open("/ph/closed") as f:
+                assert f.read() == b"P" * 2500
+
+    def test_reader_refetches_locations_when_replicas_vanish(self):
+        """A reader holding stale block locations (every cached
+        replica expired/dead, or the list empty — a restarted NN
+        still re-learning its datanodes) refetches from the NameNode
+        instead of failing the read (tdfs.client.read.acquire.*,
+        ≈ dfs.client.max.block.acquire.failures)."""
+        conf = small_conf()
+        conf.set("tdfs.client.read.acquire.backoff.ms", 50.0)
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            client = c.client()
+            payload = b"R" * 2500
+            with client.create("/stale/f") as f:
+                f.write(payload)
+            reader = client.open("/stale/f")
+            with reader:
+                # stomp the cached map: one empty list, one dead addr
+                reader.raw.blocks[0]["locations"] = []
+                for blk in reader.raw.blocks[1:]:
+                    blk["locations"] = ["127.0.0.1:1"]
+                assert reader.read() == payload
+
+    def test_nn_crash_seam_fires_from_monitor(self):
+        """The nn.crash seam: the monitor sweep kills the NN in-process
+        (the scenario engine's nn_restart trigger path)."""
+        conf = small_conf()
+        with MiniDFSCluster(num_datanodes=1, conf=conf) as c:
+            conf.set("tpumr.fi.nn.crash.probability", 1.0)
+            conf.set("tpumr.fi.nn.crash.max.failures", 1)
+            deadline = time.time() + 10
+            while time.time() < deadline and not c.namenode.killed:
+                time.sleep(0.05)
+            assert c.namenode.killed
+            assert fi.fired("nn.crash") == 1
+            conf.set("tpumr.fi.nn.crash.probability", 0)
+            nn2 = c.restart_killed_namenode()
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    (nn2.ns.safemode or not nn2.ns.datanodes):
+                time.sleep(0.05)
+            assert not nn2.ns.safemode
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+class TestCheckpointUnderChaos:
+    def test_kill_after_checkpoint_replays_image_plus_tail(self, tmp_path):
+        """Secondary checkpoint mid-fleet, then an NN SIGKILL: the
+        restart must come up from the merged image + ONLY the
+        post-checkpoint edits, with a namespace byte-identical to the
+        pre-kill truth (the uncheckpointed control)."""
+        from tpumr.dfs.secondary import SecondaryNameNode
+        conf = small_conf()
+        with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+            client = c.client()
+            for i in range(4):
+                with client.create(f"/pre/f{i}") as f:
+                    f.write(b"a" * 600)
+            name_dir = f"{c.root}/name"
+            merged = set(list_segments(name_dir))
+            sec = SecondaryNameNode(c.nn_host, c.nn_port,
+                                    str(tmp_path / "ckpt"), conf)
+            sec.do_checkpoint()
+            assert os.path.exists(os.path.join(name_dir, "fsimage.json"))
+            # every pre-checkpoint segment was merged into the image
+            # and purged: the journal on disk is the tail only
+            assert merged.isdisjoint(set(list_segments(name_dir)))
+            # post-checkpoint mutations: only these live in the journal
+            for i in range(3):
+                with client.create(f"/post/f{i}") as f:
+                    f.write(b"b" * 600)
+            client.mkdirs("/post/dir")
+            assert client.rename("/pre/f0", "/post/moved")
+            control = copy.deepcopy(c.namenode.ns.namespace)
+            c.kill_namenode()
+            nn2 = c.restart_killed_namenode()
+            assert json.dumps(nn2.ns.namespace, sort_keys=True) == \
+                json.dumps(control, sort_keys=True)
+            deadline = time.time() + 15
+            while time.time() < deadline and nn2.ns.safemode:
+                time.sleep(0.1)
+            assert not nn2.ns.safemode
+            with c.client().open("/post/moved") as f:
+                assert f.read() == b"a" * 600

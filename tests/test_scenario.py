@@ -449,6 +449,68 @@ class TestColdReRegistration:
             master.stop()
 
 
+# ------------------------------------------------------------ dfs specs
+
+
+def _dfs_spec(**over):
+    base = _spec(dfs={"datanodes": 3, "clients": 2, "files": 2,
+                      "file_kb": 16})
+    base.update(over)
+    return base
+
+
+class TestDFSSpecValidation:
+    def test_dfs_table_normalizes_with_defaults(self):
+        out = validate_spec(_dfs_spec())
+        assert out["dfs"]["datanodes"] == 3
+        assert out["dfs"]["replication_interval_ms"] == 200
+        assert out["dfs"]["max_error_fraction"] == 0.02
+        assert validate_spec(out) == out          # idempotent
+        assert validate_spec(_spec())["dfs"] is None
+
+    def test_storage_chaos_requires_dfs_table(self):
+        for kind in ("dn_crash", "dn_partition", "nn_restart",
+                     "block_corrupt"):
+            with pytest.raises(ScenarioError, match="dfs"):
+                validate_spec(_spec(
+                    chaos=[{"kind": kind, "at_ms": 0}]))
+            validate_spec(_dfs_spec(
+                chaos=[{"kind": kind, "at_ms": 0}]))
+
+    def test_out_of_range_targets_rejected(self):
+        with pytest.raises(ScenarioError, match="datanode indexes"):
+            validate_spec(_dfs_spec(chaos=[
+                {"kind": "dn_crash", "at_ms": 0, "targets": [3]}]))
+        with pytest.raises(ScenarioError, match="file_index"):
+            validate_spec(_dfs_spec(chaos=[
+                {"kind": "block_corrupt", "at_ms": 0,
+                 "file_index": 2}]))
+
+    def test_too_few_datanodes_rejected(self):
+        # the seeded working set writes at replication=2
+        with pytest.raises(ScenarioError, match="datanodes"):
+            validate_spec(_spec(dfs={"datanodes": 1}))
+
+
+class TestDFSPlanDeterminism:
+    def test_dn_crash_targets_and_corrupt_file_drawn_from_seed(self):
+        spec = _dfs_spec(chaos=[
+            {"kind": "dn_crash", "at_ms": 100, "count": 2},
+            {"kind": "block_corrupt", "at_ms": 200},
+            {"kind": "nn_restart", "at_ms": 300, "outage_ms": 250},
+            {"kind": "dn_partition", "at_ms": 400,
+             "duration_ms": 1500},
+        ])
+        p1 = plan(dict(spec, seed=7))
+        assert p1 == plan(dict(spec, seed=7))
+        rows = {e["kind"]: e for e in p1 if e["kind"] != "submit"}
+        assert len(rows["dn_crash"]["targets"]) == 2
+        assert all(0 <= t < 3 for t in rows["dn_crash"]["targets"])
+        assert 0 <= rows["block_corrupt"]["file_index"] < 2
+        assert rows["nn_restart"]["outage_s"] == pytest.approx(0.25)
+        assert rows["dn_partition"]["duration_s"] == pytest.approx(1.5)
+
+
 # ------------------------------------------------------------ e2e mixes
 
 
@@ -508,3 +570,52 @@ class TestScenarioEndToEnd:
         assert doc["workload"]["scenario"] == "overload_brownout"
         assert "classes" in doc["workload"]
         assert "level" in doc["workload"]["brownout"]
+
+    def test_dfs_churn_mix_heals_and_readers_never_see_rot(
+            self, tmp_path):
+        """Acceptance: a replica corrupted under live verified reads,
+        a datanode hard-killed with a cold rejoin, and a heartbeat
+        partition — the MapReduce classes all complete, the verifying
+        DFS fleet sees ZERO corrupt reads, and the cluster converges
+        to a clean fsck."""
+        rep = run_named("dfs_churn_storm", seed=20260804,
+                        artifacts_dir=str(tmp_path))
+        jobs = rep["jobs"]
+        assert jobs["failed"] == 0 and jobs["unfinished"] == 0
+        dfs = rep["dfs"]
+        assert dfs["ops"] > 0
+        assert dfs["corrupt_reads"] == 0
+        assert dfs["heal"]["healed"] is True
+        assert dfs["pass"] is True
+        chaos = rep["chaos"]
+        assert chaos["datanodes_killed"] == 1
+        assert chaos["fi_fired"]["dn.partition"] == 1
+        # the corrupted block's targeted seam fired exactly once
+        corrupt = [r for r in rep["chaos_log"]
+                   if r["kind"] == "block_corrupt"][0]
+        assert corrupt["block_id"] is not None
+        assert chaos["fi_fired"][
+            f"dn.read.corrupt.b{corrupt['block_id']}"] == 1
+        assert rep["pass"] is True
+        assert rep["plan"] == plan(
+            dict(BUILTIN_SCENARIOS["dfs_churn_storm"], seed=20260804))
+
+    def test_dfs_nn_failover_clients_ride_the_outage(self, tmp_path):
+        """Acceptance: NameNode SIGKILLed mid-mix and rebound on the
+        same port — editlog replay + safemode exit are timed into the
+        chaos log, the fleet's error budget holds (safemode refusals
+        budgeted separately), and every MapReduce job completes."""
+        rep = run_named("dfs_nn_failover", seed=20260804,
+                        artifacts_dir=str(tmp_path))
+        jobs = rep["jobs"]
+        assert jobs["failed"] == 0 and jobs["unfinished"] == 0
+        assert rep["chaos"]["nn_restarts"] == 1
+        restart = [r for r in rep["chaos_log"]
+                   if r["kind"] == "nn_restart"][0]
+        assert restart["safemode_exited"] is True
+        assert restart["safemode_exit_s"] < 10.0
+        dfs = rep["dfs"]
+        assert dfs["corrupt_reads"] == 0
+        assert dfs["verdicts"]["errors_ok"] is True
+        assert dfs["heal"]["healed"] is True
+        assert rep["pass"] is True

@@ -1052,6 +1052,14 @@ def _simulate_scenario(conf, a: "dict[str, str]") -> int:
         for cls_name, row in sorted(rep["verdicts"].items()):
             print(f"  class {cls_name}: "
                   f"{'PASS' if row.get('pass') else 'FAIL'}")
+        if rep.get("dfs"):
+            d = rep["dfs"]
+            heal = d.get("heal") or {}
+            print(f"  dfs: {'PASS' if d['pass'] else 'FAIL'} "
+                  f"({d['ops']} ops, {d['errors']} errors, "
+                  f"{d['corrupt_reads']} corrupt reads, "
+                  f"{d['safemode_refusals']} safemode refusals, "
+                  f"heal {heal.get('heal_s')}s)")
         print(f"  overall: {'PASS' if rep['pass'] else 'FAIL'}")
     else:
         print(doc)

@@ -285,6 +285,22 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
     _K('tdfs.client.dn.idle.s', 'float', 60.0,
         "Seconds an idle pooled datanode connection survives before "
         "the pool closes it."),
+    _K('tdfs.client.nn.backoff.ms', 'float', 200.0,
+        "Base backoff between NameNode RPC transport retries, ms "
+        "(jittered exponential)."),
+    _K('tdfs.client.nn.retries', 'int', 1,
+        "NameNode RPC transport retries per call — what carries a "
+        "client across a NameNode restart (resends replay from the "
+        "server response cache, never re-execute)."),
+    _K('tdfs.client.read.acquire.retries', 'int', 3,
+        "Block-location refetches a reader attempts when every cached "
+        "replica fails or the location list is empty (a restarted "
+        "NameNode re-learning its datanodes) before giving up — "
+        "HDFS's dfs.client.max.block.acquire.failures."),
+    _K('tdfs.client.read.acquire.backoff.ms', 'float', 300.0,
+        "Pause before each block-location refetch, giving datanodes "
+        "a heartbeat window to re-register with a restarted "
+        "NameNode."),
     _K('tdfs.client.read.chunk.bytes', 'str', None,
         "Client read chunk size, bytes."),
     _K('tdfs.client.read.pipeline.depth', 'int', 4,
@@ -424,6 +440,16 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
     _K('tpumr.dfs.bench.read.slo.ms', 'int', 250,
         "bench_dfs: client-side end-to-end read round-trip p99 SLO a "
         "rung must hold to count as sustainable, ms."),
+    _K('tpumr.dfs.bench.recovery.client.slo.s', 'float', 15.0,
+        "bench_dfs --recovery-only: nn-kill -> first client op success "
+        "SLO, seconds (clients riding tdfs.client.nn.retries across "
+        "the outage)."),
+    _K('tpumr.dfs.bench.recovery.replication.slo.s', 'float', 30.0,
+        "bench_dfs --recovery-only: dn-kill -> replication-restored "
+        "SLO, seconds (includes the datanode expiry window)."),
+    _K('tpumr.dfs.bench.recovery.safemode.slo.s', 'float', 10.0,
+        "bench_dfs --recovery-only: nn-kill -> safemode-exit SLO, "
+        "seconds (editlog replay + enough block reports)."),
     _K('tpumr.distcp.preserve', 'bool', False,
         "distcp: preserve file attributes."),
     _K('tpumr.distcp.update', 'bool', False,
@@ -449,6 +475,9 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Fair scheduler: preemption check period, ms."),
     _K('tpumr.fairscheduler.preemption.timeout.ms', 'int', 15000,
         "Fair scheduler: starvation window before preempting, ms."),
+    _K('tpumr.fi.dn.partition.ms', 'int', 3000,
+        "Ms the dn.partition fault seam silences a DataNode's "
+        "heartbeats (reads keep serving; NN expiry + rejoin follow)."),
     _K('tpumr.fi.jt.heartbeat.slow.ms', 'int', 400,
         "Ms the jt.heartbeat.slow fault seam stalls master heartbeat "
         "handling (drives the flight-recorder incident e2e)."),

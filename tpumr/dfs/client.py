@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import io
 import threading
+import time
 import uuid
 from typing import Any
 
@@ -38,8 +39,16 @@ class DFSClient:
         self.conf = conf
         from tpumr.security import client_credentials
         self._secret, self._scope = client_credentials(conf, "namenode")
-        self.nn = RpcClient(host, int(port), secret=self._secret,
-                            scope=self._scope)
+        # NN transport retries: resends carry the same (cid, id), so
+        # the server's replay cache makes them exact-once even for
+        # mutations. With backoff these are what carry a client ACROSS
+        # a NameNode restart (the nn_restart chaos contract) instead of
+        # surfacing every outage as an immediate IOError.
+        self.nn = RpcClient(
+            host, int(port), secret=self._secret, scope=self._scope,
+            retries=int(self._conf_get("tdfs.client.nn.retries", 1)),
+            backoff_ms=float(self._conf_get(
+                "tdfs.client.nn.backoff.ms", 200.0)))
         self.name = f"TDFSClient_{uuid.uuid4().hex[:12]}"
         self._dn_pool = RpcClientPool(
             self._dn_factory,
@@ -160,7 +169,7 @@ class DFSClient:
         blocks = self.nn.call("get_block_locations", path)
         for b in blocks:
             self._remember_access(b["block_id"], b.get("access"))
-        return io.BufferedReader(_DFSInputStream(self, blocks))
+        return io.BufferedReader(_DFSInputStream(self, blocks, path))
 
     # ------------------------------------------------------------ namespace
 
@@ -339,9 +348,11 @@ class _DFSInputStream(io.RawIOBase):
     """Positioned reads over the block map with replica failover
     (≈ DFSInputStream)."""
 
-    def __init__(self, client: DFSClient, blocks: list[dict]) -> None:
+    def __init__(self, client: DFSClient, blocks: list[dict],
+                 path: "str | None" = None) -> None:
         self.client = client
         self.blocks = blocks
+        self.path = path
         self.length = sum(b["size"] for b in blocks)
         self.pos = 0
 
@@ -391,7 +402,44 @@ class _DFSInputStream(io.RawIOBase):
     def _read_replica(self, blk: dict, offset: int, length: int) -> bytes:
         with _tracing.span("dfs.read", block_id=blk["block_id"],
                            bytes=length):
-            return self._read_replica_traced(blk, offset, length)
+            retries = max(0, int(self.client._conf_get(
+                "tdfs.client.read.acquire.retries", 3)))
+            backoff = float(self.client._conf_get(
+                "tdfs.client.read.acquire.backoff.ms", 300.0)) / 1000.0
+            last: "Exception | None" = None
+            for attempt in range(retries + 1):
+                if attempt:
+                    # cached locations are exhausted or EMPTY — a
+                    # restarted/expiring NameNode window, not a dead
+                    # block. Refetch from the NN and retry against the
+                    # fresh replica set (≈ DFSInputStream's
+                    # chooseDataNode refetch, bounded like
+                    # dfs.client.max.block.acquire.failures). A
+                    # safemode refusal propagates to the caller's own
+                    # retry policy.
+                    time.sleep(backoff)
+                    self._refetch_locations(blk)
+                try:
+                    return self._read_replica_traced(blk, offset,
+                                                     length)
+                except IOError as e:
+                    last = e
+                    if self.path is None:
+                        raise
+            raise IOError(
+                f"all replicas failed for block {blk['block_id']} "
+                f"after {retries} location refetches: {last}")
+
+    def _refetch_locations(self, blk: dict) -> None:
+        fresh = self.client.nn.call("get_block_locations", self.path)
+        for nb in fresh:
+            if nb["block_id"] == blk["block_id"]:
+                blk["locations"] = nb["locations"]
+                self.client._remember_access(nb["block_id"],
+                                             nb.get("access"))
+                return
+        raise IOError(f"block {blk['block_id']} no longer part of "
+                      f"{self.path} after location refetch")
 
     def _read_replica_traced(self, blk: dict, offset: int,
                              length: int) -> bytes:

@@ -205,6 +205,31 @@ class BlockStore:
             except FileNotFoundError:
                 pass
 
+    def corrupt_replica(self, block_id: int) -> bool:
+        """Flip one byte mid-file in the ON-DISK replica (chaos/test
+        hook — the ``block_corrupt`` scenario's bit-rot model). The
+        sidecar .meta is left intact, so the next read or scanner pass
+        fails CRC verification exactly like real disk rot. Caches are
+        invalidated so the flip is visible immediately, not after the
+        cached fd ages out. Returns False when the block isn't here."""
+        path = self._path(block_id)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False
+        off = size // 2
+        fd = os.open(path, os.O_RDWR)
+        try:
+            old = os.pread(fd, 1, off)
+            if not old:
+                return False
+            os.pwrite(fd, bytes([old[0] ^ 0xFF]), off)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        self._invalidate(block_id)
+        return True
+
     def blocks(self) -> list[tuple[int, int]]:
         out = []
         for name in os.listdir(self.dir):
@@ -296,6 +321,15 @@ class DataNode:
         self._http: Any = None
         self._http_port = int(conf.get("tpumr.dn.http.port", -1))
         self.sampler: Any = None
+        #: fleet slot (the ``d<n>`` of the targeted ``dn.crash.d<n>``
+        #: chaos seam) — -1 when not run under a mini cluster/scenario
+        self.fi_index = -1
+        #: monotonic deadline while "partitioned away" (``dn.partition``
+        #: seam): heartbeats are skipped until then — the process stays
+        #: alive and KEEPS SERVING reads, the NN is left to expire it
+        #: and fold the rejoin through the re-register + block report
+        self._partition_until = 0.0
+        self.killed = False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -315,6 +349,16 @@ class DataNode:
             self.sampler.stop()
         if self._http is not None:
             self._http.stop()
+        self._server.stop()
+
+    def kill(self) -> None:
+        """Hard-kill (≈ SIGKILL): the RPC server drops mid-request —
+        in-flight reads and pipeline writes fail on the wire, nothing
+        deregisters, and the NameNode is left to expire the node and
+        re-replicate. The storage dir survives, so a later DataNode on
+        the same dir rejoins with its old replicas via block report."""
+        self.killed = True
+        self._stop.set()
         self._server.stop()
 
     @property
@@ -358,8 +402,18 @@ class DataNode:
 
     def _register(self) -> None:
         self.nn.call("register_datanode", self.addr, self.capacity)
-        self.nn.call("block_report", self.addr,
-                     [list(b) for b in self.store.blocks()])
+        invalid = self.nn.call("block_report", self.addr,
+                               [list(b) for b in self.store.blocks()])
+        # the report's return is the NN-driven invalidation channel
+        # (orphans of files deleted while we were down, replicas the NN
+        # dropped): act on it, or the stale replicas — and any cached
+        # fds onto them — live here forever (delete() invalidates the
+        # fd/meta caches, closing the fd-cache staleness hole)
+        for bid in invalid or []:
+            try:
+                self.store.delete(int(bid))
+            except (TypeError, ValueError, OSError):
+                continue
 
     def _peer(self, addr: str) -> RpcClient:
         with self._lock:
@@ -379,10 +433,28 @@ class DataNode:
             return self._hot.to_wire(self._hot_top)
 
     def _heartbeat_loop(self) -> None:
+        from tpumr.utils.fi import fires
         while not self._stop.wait(self.heartbeat_s):
+            if fires(f"dn.crash.d{self.fi_index}", self.conf) \
+                    or fires("dn.crash", self.conf):
+                # BEHAVIORAL churn seam: hard-kill mid-beat — in-flight
+                # reads/pipeline writes die on the wire, nothing
+                # deregisters; NN expiry + re-replication (and client
+                # replica failover) are the quarry's predator
+                self.kill()
+                return
+            if fires("dn.partition", self.conf):
+                # heartbeat silence WITHOUT process death: reads keep
+                # being served while the NN expires us; the rejoin goes
+                # through dn_heartbeat's "register" → block report
+                self._partition_until = time.monotonic() + float(
+                    self.conf.get("tpumr.fi.dn.partition.ms", 3000)) \
+                    / 1000.0
             if self._hot_decay < 1.0:
                 with self._hot_lock:
                     self._hot.decay(self._hot_decay)
+            if time.monotonic() < self._partition_until:
+                continue
             try:
                 cmds = self.nn.call("dn_heartbeat", self.addr,
                                     self.store.used(), self.capacity,
@@ -489,6 +561,17 @@ class DataNode:
 
     # ------------------------------------------------------------ transfer RPC
 
+    def _maybe_rot(self, block_id: int) -> None:
+        """``dn.read.corrupt[.b<id>]`` chaos seam: model bit-rot by
+        flipping a byte in the on-disk replica just before serving it —
+        the UNMODIFIED read path must then fail CRC verification, the
+        client fails over and reports the bad block, and the NN drops
+        this replica and re-replicates. Readers never see the rot."""
+        from tpumr.utils.fi import fires
+        if fires(f"dn.read.corrupt.b{block_id}", self.conf) \
+                or fires("dn.read.corrupt", self.conf):
+            self.store.corrupt_replica(block_id)
+
     def _note_read(self, block_id: int, n: int, t0: float) -> None:
         self._read_bytes.observe(n)
         self._read_seconds.observe(time.monotonic() - t0)
@@ -511,6 +594,7 @@ class DataNode:
 
     def read_block(self, block_id: int, offset: int = 0,
                    length: int = -1) -> bytes:
+        self._maybe_rot(block_id)
         t0 = time.monotonic()
         self._readers += 1
         try:
@@ -534,6 +618,7 @@ class DataNode:
         payload ships compressed with ``wire`` set in the response and
         the client decodes; sizes/offsets stay payload-relative. Old
         clients omit the param and always get raw bytes."""
+        self._maybe_rot(block_id)
         n = max(0, min(int(max_bytes), self.MAX_CHUNK_BYTES))
         t0 = time.monotonic()
         self._readers += 1

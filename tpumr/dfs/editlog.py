@@ -132,6 +132,9 @@ class FSEditLog:
         self._appended = 0
         self._synced = 0
         self._syncing = False
+        # highest seq whose durability is UNKNOWN because a leader's
+        # fsync failed; waiters covered by it raise instead of acking
+        self._failed = 0
         #: records appended / fsyncs issued — syncs << records under
         #: concurrency is group commit working
         self.records = 0
@@ -168,6 +171,14 @@ class FSEditLog:
             self.records += 1
             my_seq = self._appended
             while self._synced < my_seq:
+                if self._failed >= my_seq:
+                    # a leader's fsync covering our record failed: its
+                    # durability is UNKNOWN (it sits in an abandoned
+                    # segment and may or may not replay after a crash)
+                    # — never tell the caller it committed
+                    raise IOError(
+                        f"editlog sync failed: durability unknown for "
+                        f"record {my_seq} (synced {self._synced})")
                 if self._syncing:
                     # a leader's fsync is in flight; if it began before
                     # our append it won't cover us — wait and re-check
@@ -194,8 +205,14 @@ class FSEditLog:
                             self._sync_hist.observe(t2 - t1)
                         if self._group_hist is not None:
                             self._group_hist.observe(float(batch_n))
-                    # on failure followers wake, see _synced unchanged,
-                    # and retry as leaders while our exception propagates
+                    else:
+                        # fsyncgate: after a failed fsync the kernel may
+                        # mark the dirty pages clean, so a FOLLOWER
+                        # retrying fsync on this fd could be told success
+                        # for records that were never made durable —
+                        # poison every record on this fd and abandon the
+                        # segment; our own exception propagates
+                        self._sync_failed_locked()
                     self._cond.notify_all()
             if self.segment_bytes and self._f.tell() >= self.segment_bytes:
                 roll_now = True
@@ -204,6 +221,29 @@ class FSEditLog:
             self._batch_hist.observe(len(rec))
         if roll_now:
             self._maybe_roll()
+
+    def _sync_failed_locked(self) -> None:
+        """Leader-fsync failure handling, under ``_cond``: record the
+        poisoned high-water seq and swap to a FRESH segment so later
+        appends (and their leaders' fsyncs) run on an fd with no
+        unsynced history. The abandoned segment keeps whatever the OS
+        persisted — the poisoned records may replay after a crash even
+        though their callers saw an error, the standard
+        committed-but-unacked WAL ambiguity (docs/OPERATIONS.md)."""
+        self._failed = max(self._failed, self._appended)
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        self._seg_no += 1
+        self.path = os.path.join(self.name_dir,
+                                 _segment_name(self._seg_no))
+        try:
+            self._f = open(self.path, "ab")  # tpulint: disable=lock-blocking
+        except OSError:
+            # journal is down hard; subsequent appends raise on the
+            # closed file, which is the honest surface for that state
+            pass
 
     def close(self) -> None:
         with self._cond:
@@ -233,7 +273,14 @@ class FSEditLog:
             # appended-but-unsynced records (their owners are queued on
             # the mutex to lead): seal durably covers them, and
             # advancing _synced releases those owners on wake
-            os.fsync(self._f.fileno())
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                # same poisoning as a failed group-commit leader: wake
+                # the queued owners so they raise instead of hanging
+                self._sync_failed_locked()
+                self._cond.notify_all()
+                raise
             self.syncs += 1
             self._synced = self._appended
             self._cond.notify_all()

@@ -30,9 +30,11 @@ class MiniDFSCluster:
         self.namenode = NameNode(f"{self.root}/name", self.conf).start()
         host, port = self.namenode.address
         self.nn_host, self.nn_port = host, port
-        self.datanodes = [
-            DataNode(host, port, f"{self.root}/data{i}", self.conf).start()
-            for i in range(num_datanodes)]
+        self.datanodes = []
+        for i in range(num_datanodes):
+            dn = DataNode(host, port, f"{self.root}/data{i}", self.conf)
+            dn.fi_index = i   # the d<n> of the dn.crash.d<n> chaos seam
+            self.datanodes.append(dn.start())
         self._wait_active(num_datanodes)
 
     def _wait_active(self, n: int, timeout: float = 20.0) -> None:
@@ -51,18 +53,68 @@ class MiniDFSCluster:
     def client(self) -> DFSClient:
         return DFSClient(self.nn_host, self.nn_port, self.conf)
 
-    def restart_namenode(self) -> None:
+    def restart_namenode(self, clean: bool = True) -> None:
         """Stop + start the NameNode over the same name dir (tests the
-        image/edits recovery path + safemode)."""
-        self.namenode.stop()
+        image/edits recovery path + safemode). ``clean=False`` kills
+        instead (no editlog close — the crash-recovery path)."""
+        if clean:
+            self.namenode.stop()
+        else:
+            self.namenode.kill()
         time.sleep(0.1)
-        self.namenode = NameNode(f"{self.root}/name", self.conf,
-                                 port=self.nn_port).start()
+        self.namenode = self._bind_namenode()
+
+    def kill_namenode(self) -> None:
+        """SIGKILL-equivalent on the NameNode, WITHOUT restarting it —
+        the chaos window where clients ride their RPC retry policy.
+        Call restart_killed_namenode() to bring it back on the port."""
+        self.namenode.kill()
+
+    def restart_killed_namenode(self) -> NameNode:
+        """Bring a killed NameNode back on the same port (editlog
+        replay + safemode until enough block reports arrive)."""
+        self.namenode = self._bind_namenode()
+        return self.namenode
+
+    def _bind_namenode(self) -> NameNode:
+        # the dying server's socket may linger briefly: retry the bind
+        # on the SAME port so clients' cached addresses stay valid
+        # (the master_restart rebind idiom)
+        last: Exception | None = None
+        for _ in range(250):
+            try:
+                return NameNode(f"{self.root}/name", self.conf,
+                                port=self.nn_port).start()
+            except OSError as e:
+                last = e
+                time.sleep(0.02)
+        raise OSError(f"could not rebind NameNode on port "
+                      f"{self.nn_port}: {last}")
 
     def stop_datanode(self, i: int) -> DataNode:
         dn = self.datanodes[i]
         dn.stop()
         return dn
+
+    def kill_datanode(self, i: int) -> DataNode:
+        """Hard-kill datanode ``i`` mid-whatever (no deregistration);
+        its storage dir survives for a later rejoin."""
+        dn = self.datanodes[i]
+        dn.kill()
+        return dn
+
+    def restart_datanode(self, i: int) -> DataNode:
+        """Cold-restart datanode ``i`` over its old storage dir: a new
+        process image that re-registers and block-reports its surviving
+        replicas (the dn churn rejoin path)."""
+        old = self.datanodes[i]
+        if not old.killed:
+            old.stop()
+        dn = DataNode(self.nn_host, self.nn_port,
+                      f"{self.root}/data{i}", self.conf)
+        dn.fi_index = i
+        self.datanodes[i] = dn.start()
+        return self.datanodes[i]
 
     def shutdown(self) -> None:
         for dn in self.datanodes:

@@ -127,9 +127,17 @@ class FSNamesystem:
                              or getpass.getuser())
         self.supergroup = str(conf.get("dfs.permissions.supergroup",
                                        "supergroup"))
-        # root inode: superuser-owned 0755 like a formatted HDFS namespace
-        root = self.namespace.setdefault("/", {"type": "dir",
-                                               "mtime": _now()})
+        # root inode: superuser-owned 0755 like a formatted HDFS
+        # namespace. JOURNALED like any mkdir (the "format" record) —
+        # an un-journaled root would be re-stamped with a fresh mtime
+        # by every restart that replays from a checkpoint image, so the
+        # namespace would never be byte-identical across a crash
+        if "/" not in self.namespace:
+            op = {"op": "mkdir", "path": "/", "t": _now(),
+                  "o": self.superuser, "g": self.supergroup, "m": 0o755}
+            self.edits.log(op)
+            self.apply_op(self.namespace, self.counters, op)
+        root = self.namespace["/"]
         root.setdefault("owner", self.superuser)
         root.setdefault("group", self.supergroup)
         root.setdefault("mode", 0o755)
@@ -163,18 +171,24 @@ class FSNamesystem:
         self._quota_usage: dict[str, list] = {}
         self._rebuild_quota_usage()
 
+        # The safemode denominator counts only CLOSED files' blocks —
+        # matching the live accounting, where blocks enter
+        # total_known_blocks at complete/close. A file open at the
+        # crash may hold a journaled add_block the writer never pushed
+        # to any DataNode; counting it would hold _reported_fraction
+        # below threshold FOREVER (no replica exists to report).
+        # HDFS likewise excludes under-construction blocks from
+        # SafeModeInfo's blockTotal.
         self.total_known_blocks = sum(
             len(i.get("blocks", [])) for i in self.namespace.values()
-            if i.get("type") == "file")
+            if i.get("type") == "file" and not i.get("uc"))
         self.safemode = self.total_known_blocks > 0
-        # Blocks of each open (uc) file ALREADY included in
-        # total_known_blocks — close adds only the delta, so an
-        # append→close cycle never re-counts pre-existing blocks into
-        # the safemode denominator. Files open at restart had all their
-        # blocks counted by the sum above.
+        # none of a restart-survivor uc file's blocks are in the
+        # denominator, so the eventual close/lease-recovery delta adds
+        # ALL of them (len(blocks) - 0) — same contract as create,
+        # where post-open blocks wait for complete to be counted
         self._uc_counted: dict[str, int] = {
-            p: len(i.get("blocks", []))
-            for p, i in self.namespace.items()
+            p: 0 for p, i in self.namespace.items()
             if i.get("type") == "file" and i.get("uc")}
 
         # rack awareness ≈ FSNamesystem's clusterMap (NetworkTopology)
@@ -376,8 +390,12 @@ class FSNamesystem:
     def _reported_fraction(self) -> float:
         if self.total_known_blocks == 0:
             return 1.0
+        # uc files mirror the denominator: their blocks are not in
+        # total_known_blocks until close, so counting their reported
+        # replicas here could push the fraction past threshold while
+        # CLOSED files' blocks are still dark
         reported = sum(1 for _, i in self._ns_items()
-                       if i.get("type") == "file"
+                       if i.get("type") == "file" and not i.get("uc")
                        for b in i.get("blocks", [])
                        if self.block_locations.get(b[0]))
         return reported / self.total_known_blocks
@@ -1801,6 +1819,7 @@ class NameNode:
         # the daemon conf; without this, doas frames are rejected
         self._server.proxy_conf = conf
         self._stop = threading.Event()
+        self.killed = False
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="nn-monitors", daemon=True)
         self._http: Any = None
@@ -1832,6 +1851,24 @@ class NameNode:
             self._http.stop()
         self._server.stop()
         self.ns.edits.close()
+
+    def kill(self) -> None:
+        """SIGKILL-equivalent (the ``nn.crash`` / ``nn_restart`` chaos
+        model): stop serving WITHOUT the clean-shutdown editlog close —
+        the journal fd is abandoned exactly as a dead process leaves
+        it, so the next NameNode on this name_dir must come up through
+        image load + editlog replay (with torn-tail sealing) and earn
+        its way out of safemode from block reports. In-flight client
+        RPCs fail on the wire and ride the client retry policy."""
+        self.killed = True
+        self._stop.set()
+        if self.flightrec is not None:
+            self.flightrec.stop()
+        if self.sampler is not None:
+            self.sampler.stop()
+        if self._http is not None:
+            self._http.stop()
+        self._server.stop()
 
     @property
     def http_url(self) -> "str | None":
@@ -1984,8 +2021,15 @@ class NameNode:
         trash_every = float(self.conf.get(
             "fs.trash.checkpoint.interval.s",
             max(60.0, float(self.conf.get("fs.trash.interval", 0)) * 60)))
+        from tpumr.utils.fi import fires
         last_trash = time.monotonic()
         while not self._stop.wait(interval):
+            if fires("nn.crash", self.conf):
+                # SIGKILL-equivalent chaos seam: the whole daemon dies
+                # between monitor sweeps — restart/replay/safemode (and
+                # clients riding RPC retries) are the quarry's predator
+                self.kill()
+                return
             try:
                 self.ns.heartbeat_check(self.dn_expiry_s)
                 # boosts must be set before the sweep that acts on them

@@ -121,6 +121,11 @@ class NamespaceLocks:
         for p in paths:
             i = self.stripe_index(p)
             if i is None:
+                # shallow path: escalate. Guarded in structural() — a
+                # thread already inside a striped frame must NOT widen
+                # to structural (global rank 25 after stripe rank 26
+                # deadlocks against a concurrent structural op, and the
+                # widening check below can't see this branch)
                 with self.structural():
                     yield
                 return
@@ -149,6 +154,21 @@ class NamespaceLocks:
     def structural(self) -> Iterator[None]:
         """Global + every stripe, ascending — excludes all namespace
         ops. Keep these sections short; every striped op queues."""
+        frames = self._frames()
+        if frames and not self.structural_held():
+            # escalating from a held STRIPED frame acquires the global
+            # lock after a stripe — the reverse of every other thread's
+            # order. Under concurrent load (trace replay) that deadlocks
+            # against an in-flight structural op: A holds stripe s and
+            # wants global, B holds global and wants s. The rank
+            # assertion only fires under ORDER_CHECK; production would
+            # hang, so this is a hard error either way. Callers must
+            # decide structural-vs-striped BEFORE acquiring anything
+            # (see FSNamesystem._locked's lock-free pre-check).
+            raise RuntimeError(
+                "structural escalation while holding stripes "
+                f"{sorted(self.held_set())} — decide escalation before "
+                "acquiring any stripe")
         self.global_lock.acquire()
         for lk in self.stripes:
             lk.acquire()

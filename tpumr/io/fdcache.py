@@ -41,6 +41,12 @@ class FdCache:
         # insertion order = recency order (re-inserted on every hit)
         self._entries: "dict[str, FdCache._Ent]" = {}
         self._lock = threading.Lock()
+        # bumped by every invalidate(): _pin's miss path opens OUTSIDE
+        # the lock, so an invalidation landing between its open and its
+        # insert would otherwise cache an fd of the just-replaced inode
+        # — serving the OLD bytes forever (the staleness bug chaos
+        # surfaces when re-replication deletes/recreates a block id)
+        self._epoch = 0
         self.opens = 0
         self.evictions = 0
 
@@ -52,44 +58,64 @@ class FdCache:
             self._unpin(ent)
 
     def _pin(self, path: str) -> "FdCache._Ent":
+        for _attempt in range(8):
+            with self._lock:
+                ent = self._entries.pop(path, None)
+                if ent is not None:
+                    self._entries[path] = ent   # most-recently used again
+                    ent.pins += 1
+                    return ent
+                epoch0 = self._epoch
+            fd = os.open(path, os.O_RDONLY)
+            close_now = None
+            try:
+                with self._lock:
+                    ent = self._entries.get(path)
+                    if ent is not None:
+                        # lost an open race — use the cached fd, drop ours
+                        ent.pins += 1
+                        close_now = fd
+                        return ent
+                    if self._epoch != epoch0:
+                        # an invalidate() ran while we were opening: our
+                        # fd may reference the replaced/unlinked inode —
+                        # caching it would serve stale bytes forever
+                        close_now = fd
+                        continue
+                    return self._insert_locked(path, fd)
+            finally:
+                if close_now is not None:
+                    try:
+                        os.close(close_now)
+                    except OSError:
+                        pass
+        # invalidation storm: open while HOLDING the lock, which excludes
+        # invalidate() entirely — pathological path, never the fast one
         with self._lock:
             ent = self._entries.pop(path, None)
             if ent is not None:
-                self._entries[path] = ent   # most-recently used again
+                self._entries[path] = ent
                 ent.pins += 1
                 return ent
-        fd = os.open(path, os.O_RDONLY)
-        close_now = None
-        try:
-            with self._lock:
-                ent = self._entries.get(path)
-                if ent is not None:
-                    # lost an open race — use the cached fd, drop ours
-                    ent.pins += 1
-                    close_now = fd
-                    return ent
-                self.opens += 1
-                ent = FdCache._Ent(fd)
-                ent.pins = 1
-                self._entries[path] = ent
-                while len(self._entries) > self._cap:
-                    victim_path = next(iter(self._entries))
-                    victim = self._entries.pop(victim_path)
-                    self.evictions += 1
-                    if victim.pins:
-                        victim.dead = True   # last unpin closes it
-                    else:
-                        try:
-                            os.close(victim.fd)
-                        except OSError:
-                            pass
-                return ent
-        finally:
-            if close_now is not None:
+            return self._insert_locked(path, os.open(path, os.O_RDONLY))
+
+    def _insert_locked(self, path: str, fd: int) -> "FdCache._Ent":
+        self.opens += 1
+        ent = FdCache._Ent(fd)
+        ent.pins = 1
+        self._entries[path] = ent
+        while len(self._entries) > self._cap:
+            victim_path = next(iter(self._entries))
+            victim = self._entries.pop(victim_path)
+            self.evictions += 1
+            if victim.pins:
+                victim.dead = True   # last unpin closes it
+            else:
                 try:
-                    os.close(close_now)
+                    os.close(victim.fd)
                 except OSError:
                     pass
+        return ent
 
     def _unpin(self, ent: "FdCache._Ent") -> None:
         with self._lock:
@@ -111,6 +137,7 @@ class FdCache:
         pinning a purged job's disk blocks; datanode: returning stale
         block bytes after a re-write). '' drops everything."""
         with self._lock:
+            self._epoch += 1
             victims = [p for p in self._entries if p.startswith(prefix)] \
                 if prefix else list(self._entries)
             for p in victims:

@@ -13,6 +13,24 @@ recorder windows per-class submit→first-assignment and submit→complete
 latency against per-class SLOs and the run emits a machine-readable
 pass/fail PER CLASS — with incident bundles as the failure artifact.
 
+A spec with a ``dfs`` table extends the lab to the STORAGE layer: a
+real ``MiniDFSCluster`` (NameNode + DataNodes over localhost RPC)
+carries a ``SimDFSFleet`` of verifying ``DFSClient``s alongside the
+MapReduce classes, and four storage chaos kinds drive its recovery
+machinery — ``dn_crash`` (hard-kill mid-read, optional cold rejoin:
+client replica failover + NN expiry + re-replication), ``dn_partition``
+(heartbeat silence WITHOUT process death via the fi ``dn.partition``
+seam: expiry, then rejoin through re-register + block report),
+``nn_restart`` (SIGKILL-equivalent + rebind on the same port: editlog
+replay, safemode entry/exit timed into the chaos log, clients riding
+RPC retries with safemode refusals budgeted separately from errors),
+and ``block_corrupt`` (flip bytes in one replica on disk via the fi
+``dn.read.corrupt.b<id>`` seam: checksum detection, bad-block report,
+drop + re-replicate — the fleet's verified reads prove readers NEVER
+see the rot). The report gains a ``dfs`` section with its own SLO
+verdicts (error fraction, corrupt reads == 0, read/meta p99, end-of-run
+fsck heal) that feeds the overall pass.
+
 Determinism: :func:`plan` expands a spec into a timestamped event list
 using only ``(spec, seed)`` — submissions (with per-class jitter) and
 chaos targets are all drawn from one seeded stream, the master's fault
@@ -33,6 +51,7 @@ import os
 import random
 import shutil
 import tempfile
+import threading
 import time
 from typing import Any
 
@@ -47,10 +66,15 @@ class ScenarioError(ValueError):
 
 _PRIORITIES = ("VERY_HIGH", "HIGH", "NORMAL", "LOW", "VERY_LOW")
 _CHAOS_KINDS = ("tracker_crash", "tracker_partition",
-                "master_restart", "fi")
+                "master_restart", "fi",
+                "dn_crash", "dn_partition", "nn_restart",
+                "block_corrupt")
+#: the storage chaos kinds — only valid when the spec has a [dfs] table
+_DFS_CHAOS_KINDS = ("dn_crash", "dn_partition", "nn_restart",
+                    "block_corrupt")
 
 _SPEC_KEYS = {"name", "seed", "fleet", "master", "classes", "chaos",
-              "timeout_s", "max_breach_fraction"}
+              "dfs", "timeout_s", "max_breach_fraction"}
 _FLEET_DEFAULTS = {"trackers": 8, "interval_ms": 100, "cpu_slots": 2,
                    "reduce_slots": 1, "task_mean_ms": 250,
                    "fetch_failure_rate": 0.0}
@@ -61,6 +85,15 @@ _CLASS_DEFAULTS = {"jobs": 1, "maps": 2, "reduces": 0, "start_ms": 0,
                    "period_ms": 500, "jitter_ms": 0, "rounds": 1,
                    "priority": "NORMAL", "slo_assign_ms": None,
                    "slo_complete_ms": None}
+#: the storage twin of the fleet table: datanode count, verifying
+#: client fleet shape, seeded working set, recovery-speed knobs, and
+#: the DFS-side SLO budgets the report's ``dfs`` verdict judges
+_DFS_DEFAULTS = {"datanodes": 3, "clients": 4, "interval_ms": 50,
+                 "files": 4, "file_kb": 64, "hot_read_p": 0.5,
+                 "read_kb": 48, "replication_interval_ms": 200,
+                 "expiry_ms": 1500, "slo_read_p99_ms": None,
+                 "slo_meta_p99_ms": None, "max_error_fraction": 0.02,
+                 "conf": {}}
 _CHAOS_DEFAULTS = {
     "tracker_crash": {"count": 1, "targets": None, "rejoin_ms": None},
     "tracker_partition": {"count": 1, "targets": None,
@@ -68,6 +101,21 @@ _CHAOS_DEFAULTS = {
     "master_restart": {},
     "fi": {"point": None, "probability": 0.0, "max_failures": 0,
            "ms": None},
+    # hard-kill datanode(s) mid-whatever; rejoin_ms=None means they
+    # never come back (re-replication alone must restore the targets)
+    "dn_crash": {"count": 1, "targets": None, "rejoin_ms": None},
+    # heartbeat silence without process death: the NN expires the
+    # node(s) while reads keep serving, then block reports rejoin them.
+    # Which datanodes fall silent is whoever draws the seam first —
+    # deterministic in COUNT, not in identity (the seam fires in the
+    # datanodes' own heartbeat threads)
+    "dn_partition": {"count": 1, "duration_ms": 2500},
+    # SIGKILL-equivalent on the NameNode, rebind on the same port after
+    # the outage: editlog replay + safemode, clients riding retries
+    "nn_restart": {"outage_ms": 300},
+    # flip bytes in ONE replica of the file's first block just before
+    # a read serves it; file_index=None draws from the seeded stream
+    "block_corrupt": {"file_index": None, "count": 1},
 }
 
 
@@ -151,6 +199,21 @@ def validate_spec(spec: Any) -> dict:
                 f"classes[{i}].priority {row['priority']!r} not in "
                 f"{_PRIORITIES}")
         out["classes"].append(row)
+    out["dfs"] = None
+    if spec.get("dfs") is not None:
+        d = _merged(_DFS_DEFAULTS, spec.get("dfs"), "dfs")
+        _non_negative(d, ("interval_ms", "file_kb", "hot_read_p",
+                          "read_kb", "replication_interval_ms",
+                          "expiry_ms", "slo_read_p99_ms",
+                          "slo_meta_p99_ms", "max_error_fraction"),
+                      "dfs")
+        # the seeded working set is written at replication=2, so a
+        # single datanode loss must leave a surviving replica
+        if int(d["datanodes"]) < 2:
+            raise ScenarioError("dfs.datanodes must be >= 2")
+        if int(d["clients"]) < 1 or int(d["files"]) < 1:
+            raise ScenarioError("dfs.clients/files must be >= 1")
+        out["dfs"] = d
     out["chaos"] = []
     for i, ev in enumerate(spec.get("chaos") or []):
         if not isinstance(ev, dict) or ev.get("kind") \
@@ -164,6 +227,24 @@ def validate_spec(spec: Any) -> dict:
                 or row["at_ms"] < 0:
             raise ScenarioError(f"chaos[{i}].at_ms must be a "
                                 "non-negative number")
+        if kind in _DFS_CHAOS_KINDS and out["dfs"] is None:
+            raise ScenarioError(
+                f"chaos[{i}].{kind} needs a [dfs] table (the storage "
+                "chaos kinds drive the mini-DFS cluster)")
+        if kind == "dn_crash" and row["targets"] is not None:
+            n_dn = int(out["dfs"]["datanodes"])
+            if any(not isinstance(t, int) or not 0 <= t < n_dn
+                   for t in row["targets"]):
+                raise ScenarioError(
+                    f"chaos[{i}].targets must be datanode indexes "
+                    f"in [0, {n_dn})")
+        if kind == "block_corrupt" and row["file_index"] is not None:
+            n_files = int(out["dfs"]["files"])
+            if not isinstance(row["file_index"], int) \
+                    or not 0 <= row["file_index"] < n_files:
+                raise ScenarioError(
+                    f"chaos[{i}].file_index must be in "
+                    f"[0, {n_files})")
         if kind == "fi":
             if not row["point"] or "tpumr" in str(row["point"]):
                 raise ScenarioError(
@@ -220,6 +301,26 @@ def plan(spec: dict) -> "list[dict]":
                        probability=float(ev["probability"]),
                        max_failures=int(ev["max_failures"]),
                        ms=ev["ms"])
+        elif ev["kind"] == "dn_crash":
+            targets = ev["targets"]
+            if targets is None:
+                n_dn = int(spec["dfs"]["datanodes"])
+                targets = sorted(rng.sample(
+                    range(n_dn), min(int(ev["count"]), n_dn)))
+            row["targets"] = [int(t) for t in targets]
+            row["rejoin_s"] = (ev["rejoin_ms"] / 1000.0
+                               if ev["rejoin_ms"] is not None else None)
+        elif ev["kind"] == "dn_partition":
+            row["count"] = int(ev["count"])
+            row["duration_s"] = ev["duration_ms"] / 1000.0
+        elif ev["kind"] == "nn_restart":
+            row["outage_s"] = ev["outage_ms"] / 1000.0
+        elif ev["kind"] == "block_corrupt":
+            idx = ev["file_index"]
+            if idx is None:
+                idx = rng.randrange(int(spec["dfs"]["files"]))
+            row["file_index"] = int(idx)
+            row["count"] = int(ev["count"])
         events.append(row)
     events.sort(key=lambda e: (e["t_s"], e["kind"],
                                e.get("name", "")))
@@ -325,6 +426,60 @@ BUILTIN_SCENARIOS: "dict[str, dict]" = {
         ],
         "timeout_s": 90,
     },
+    # the storage churn storm: a replica corrupted under a live
+    # verified-read mix (readers must NEVER see the rot), a datanode
+    # hard-kill with a cold rejoin (client failover + re-replication),
+    # and a heartbeat partition that outlives the expiry sweep (expire,
+    # then rejoin via block report) — while MapReduce classes keep
+    # completing on the same box
+    "dfs_churn_storm": {
+        "name": "dfs_churn_storm",
+        "fleet": {"trackers": 4, "task_mean_ms": 250},
+        "classes": [
+            {"name": "interactive", "jobs": 4, "maps": 2, "reduces": 0,
+             "period_ms": 1500, "jitter_ms": 300, "priority": "HIGH",
+             "slo_assign_ms": 2500, "slo_complete_ms": 15_000},
+            {"name": "batch", "jobs": 2, "maps": 8, "reduces": 1,
+             "period_ms": 2000, "slo_complete_ms": 60_000},
+        ],
+        "dfs": {"datanodes": 3, "clients": 4, "files": 4,
+                "file_kb": 64, "interval_ms": 50,
+                "slo_read_p99_ms": 2500, "max_error_fraction": 0.05,
+                # arm the NN flight recorder: a chaos-driven op-p99
+                # breach writes nn-* bundles into the artifacts dir
+                "conf": {"tpumr.nn.incident.slo.ms": 250}},
+        "chaos": [
+            {"kind": "block_corrupt", "at_ms": 1500},
+            {"kind": "dn_crash", "at_ms": 2500, "targets": [1],
+             "rejoin_ms": 3000},
+            {"kind": "dn_partition", "at_ms": 5500,
+             "duration_ms": 2500},
+        ],
+        "timeout_s": 90,
+    },
+    # the storage twin of master_failover: a NameNode SIGKILL mid-mix
+    # (no editlog close), rebind on the same port — editlog replay +
+    # safemode timed into the chaos log, DFS clients riding their RPC
+    # retry policy (safemode refusals budgeted separately from
+    # errors), MapReduce classes unaffected
+    "dfs_nn_failover": {
+        "name": "dfs_nn_failover",
+        "fleet": {"trackers": 4, "task_mean_ms": 250},
+        "classes": [
+            {"name": "interactive", "jobs": 4, "maps": 2, "reduces": 0,
+             "period_ms": 1200, "jitter_ms": 300, "priority": "HIGH",
+             "slo_assign_ms": 4000, "slo_complete_ms": 20_000},
+            {"name": "batch", "jobs": 2, "maps": 8, "reduces": 1,
+             "period_ms": 2000, "slo_complete_ms": 60_000},
+        ],
+        "dfs": {"datanodes": 3, "clients": 4, "files": 4,
+                "file_kb": 64, "interval_ms": 50,
+                "max_error_fraction": 0.05},
+        "chaos": [
+            {"kind": "nn_restart", "at_ms": 3000, "outage_ms": 300},
+        ],
+        "timeout_s": 90,
+    },
     # a mid-mix master kill/restart with journal recovery: the fleet
     # keeps beating, the driver keeps polling old job ids, every job
     # still completes
@@ -412,6 +567,7 @@ def list_scenarios(scenario_dir: "str | None" = None) -> "list[dict]":
                                    for c in spec["classes"]}),
                 "jobs": sum(int(c["jobs"]) for c in spec["classes"]),
                 "chaos": sorted({c["kind"] for c in spec["chaos"]}),
+                "dfs": spec.get("dfs") is not None,
                 "trace_s": events[-1]["t_s"] if events else 0.0,
             })
         except ScenarioError as e:
@@ -469,6 +625,25 @@ class ScenarioRunner:
                 if c[kind] is not None:
                     conf.set(f"tpumr.scenario.slo.{c['name']}."
                              f"{key}.ms", int(c[kind]))
+        dfs = spec.get("dfs")
+        if dfs:
+            # the storage lab shares THIS conf object with the master,
+            # the mini-DFS cluster, and every DFSClient — one
+            # tpumr.fi.seed, and chaos armed by conf.set is visible to
+            # all of them immediately
+            conf.set("tdfs.http.port", -1)
+            conf.set("dfs.replication", 2)
+            conf.set("tdfs.replication.interval.s",
+                     dfs["replication_interval_ms"] / 1000.0)
+            conf.set("tdfs.datanode.expiry.s",
+                     dfs["expiry_ms"] / 1000.0)
+            # clients must ride an nn_restart outage on transport-level
+            # retries (safemode refusals are application-level and
+            # counted separately by the fleet)
+            conf.set("tdfs.client.nn.retries", 60)
+            conf.set("tdfs.client.nn.backoff.ms", 100.0)
+            for k, v in (dfs["conf"] or {}).items():
+                conf.set(str(k), v)
         for k, v in (mast["conf"] or {}).items():
             conf.set(str(k), v)
         return conf
@@ -524,6 +699,37 @@ class ScenarioRunner:
                                     round=nxt_round)
 
     @staticmethod
+    def _dfs_heal_wait(cluster: Any, timeout_s: float = 20.0) -> dict:
+        """Bounded wait for the mini-DFS to converge after the chaos:
+        safemode exited, no missing/corrupt blocks, every block back at
+        its replication target (fsck clean, open files excepted — the
+        fleet's in-flight writes at stop time hold leases, which is not
+        damage). Returns the heal receipt for the report."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        last: dict = {}
+        while time.monotonic() < deadline:
+            try:
+                last = cluster.namenode.ns.fsck("/")
+            except Exception:  # noqa: BLE001 — safemode window
+                last = {}
+            else:
+                if not cluster.namenode.ns.safemode \
+                        and not last["missing"] \
+                        and not last["corrupt"] \
+                        and not last["under_replicated"]:
+                    return {"healed": True,
+                            "heal_s": round(time.monotonic() - t0, 3),
+                            "blocks": int(last["blocks"])}
+            time.sleep(0.1)
+        return {"healed": False, "heal_s": None,
+                "blocks": int(last.get("blocks", 0)),
+                "missing": len(last.get("missing", ())),
+                "corrupt": len(last.get("corrupt", ())),
+                "under_replicated": len(
+                    last.get("under_replicated", ()))}
+
+    @staticmethod
     def _class_typed(master: Any) -> "dict[tuple[str, str], dict]":
         return {key: h.typed()
                 for key, h in master._class_hists.items()}
@@ -577,6 +783,29 @@ class ScenarioRunner:
             fetch_failure_rate=fleet_spec["fetch_failure_rate"],
             fi_conf=conf).start()
         driver = ScaleDriver(host, port)
+        cluster = dfs_fleet = None
+        dfs_files: "list[str]" = []
+        dfs_timers: "list[threading.Timer]" = []
+        dfs_fi_points: "list[str]" = []
+        dfs_spec = spec.get("dfs")
+        if dfs_spec:
+            from tpumr.dfs.mini_cluster import MiniDFSCluster
+            from tpumr.scale.simdfs import SimDFSFleet, seed_files
+            cluster = MiniDFSCluster(int(dfs_spec["datanodes"]),
+                                     conf=conf)
+            dfs_files = seed_files(
+                cluster.nn_host, cluster.nn_port, conf,
+                n_files=int(dfs_spec["files"]),
+                file_bytes=int(dfs_spec["file_kb"]) * 1024,
+                root="/scenario/data")
+            dfs_fleet = SimDFSFleet(
+                cluster.nn_host, cluster.nn_port,
+                int(dfs_spec["clients"]), conf,
+                interval_s=dfs_spec["interval_ms"] / 1000.0,
+                seed=spec["seed"], files=dfs_files,
+                hot_read_p=dfs_spec["hot_read_p"],
+                read_bytes=int(dfs_spec["read_kb"]) * 1024,
+                verify=True).start()
         job_ids: "list[str]" = []
         states: "dict[str, str]" = {}
         pending: "set[str]" = set()
@@ -585,6 +814,7 @@ class ScenarioRunner:
         dead_class_states: "list[dict]" = []
         t0 = time.monotonic()
         ok = False
+        dfs_heal: "dict | None" = None
         try:
             for ev in events:
                 while time.monotonic() - t0 < ev["t_s"]:
@@ -641,6 +871,88 @@ class ScenarioRunner:
                         "t_s": round(time.monotonic() - t0, 3),
                         "kind": "fi", "point": ev["point"],
                         "probability": ev["probability"]})
+                elif ev["kind"] == "dn_crash":
+                    for t in ev["targets"]:
+                        cluster.kill_datanode(t)
+                        if ev["rejoin_s"] is not None:
+                            timer = threading.Timer(
+                                ev["rejoin_s"],
+                                cluster.restart_datanode, args=(t,))
+                            timer.daemon = True
+                            timer.start()
+                            dfs_timers.append(timer)
+                    chaos_log.append({
+                        "t_s": round(time.monotonic() - t0, 3),
+                        "kind": "dn_crash",
+                        "targets": list(ev["targets"]),
+                        "rejoin_s": ev["rejoin_s"]})
+                elif ev["kind"] == "dn_partition":
+                    # armed via conf, drawn by the datanodes' own
+                    # heartbeat threads: max.failures is cumulative
+                    # against the process-global fired counter so a
+                    # second partition event silences `count` MORE
+                    conf.set("tpumr.fi.dn.partition.ms",
+                             int(ev["duration_s"] * 1000))
+                    conf.set("tpumr.fi.dn.partition.probability", 1.0)
+                    conf.set("tpumr.fi.dn.partition.max.failures",
+                             fi.fired("dn.partition")
+                             + int(ev["count"]))
+                    dfs_fi_points.append("dn.partition")
+                    chaos_log.append({
+                        "t_s": round(time.monotonic() - t0, 3),
+                        "kind": "dn_partition",
+                        "count": int(ev["count"]),
+                        "duration_s": ev["duration_s"]})
+                elif ev["kind"] == "nn_restart":
+                    t_kill = time.monotonic()
+                    cluster.kill_namenode()
+                    until = t_kill + ev["outage_s"]
+                    while time.monotonic() < until:
+                        self._poll_jobs(driver, states, pending,
+                                        chains, job_ids)
+                        time.sleep(min(0.05, max(
+                            0.0, until - time.monotonic())))
+                    cluster.restart_killed_namenode()
+                    # time safemode exit (the recovery headline); the
+                    # fleet is retrying meanwhile, refusals counted
+                    # separately from errors
+                    sm_deadline = time.monotonic() + 30.0
+                    while cluster.namenode.ns.safemode \
+                            and time.monotonic() < sm_deadline:
+                        self._poll_jobs(driver, states, pending,
+                                        chains, job_ids)
+                        time.sleep(0.05)
+                    chaos_log.append({
+                        "t_s": round(time.monotonic() - t0, 3),
+                        "kind": "nn_restart",
+                        "outage_s": ev["outage_s"],
+                        "safemode_exit_s": round(
+                            time.monotonic() - t_kill, 3),
+                        "safemode_exited":
+                            not cluster.namenode.ns.safemode})
+                elif ev["kind"] == "block_corrupt":
+                    path = dfs_files[ev["file_index"]
+                                     % len(dfs_files)]
+                    inode = cluster.namenode.ns.namespace.get(
+                        path) or {}
+                    blocks = inode.get("blocks") or []
+                    if blocks:
+                        bid = int(blocks[0][0])
+                        point = f"dn.read.corrupt.b{bid}"
+                        conf.set(f"tpumr.fi.{point}.probability", 1.0)
+                        conf.set(f"tpumr.fi.{point}.max.failures",
+                                 int(ev["count"]))
+                        dfs_fi_points.append(point)
+                        chaos_log.append({
+                            "t_s": round(time.monotonic() - t0, 3),
+                            "kind": "block_corrupt", "path": path,
+                            "block_id": bid,
+                            "count": int(ev["count"])})
+                    else:
+                        chaos_log.append({
+                            "t_s": round(time.monotonic() - t0, 3),
+                            "kind": "block_corrupt", "path": path,
+                            "block_id": None, "skipped": True})
             trace_end = events[-1]["t_s"] if events else 0.0
             deadline = t0 + trace_end + spec["timeout_s"]
             while pending and time.monotonic() < deadline:
@@ -659,26 +971,91 @@ class ScenarioRunner:
                 while brown.level > 0 \
                         and time.monotonic() < step_down_cap:
                     time.sleep(0.25)
+            if cluster is not None:
+                # freeze DFS traffic, let pending rejoin timers land,
+                # then demand the cluster self-heal to a clean fsck —
+                # the chaos kinds all promise convergence, this is
+                # where the promise is checked
+                dfs_fleet.stop()
+                for timer in dfs_timers:
+                    timer.join(timeout=15.0)
+                dfs_heal = self._dfs_heal_wait(cluster)
             ok = True
         finally:
             fleet.stop()
+            if dfs_fleet is not None:
+                dfs_fleet.stop()
+            for timer in dfs_timers:
+                timer.cancel()
             driver.close()
             try:
                 masters[-1].stop()
             except Exception:  # noqa: BLE001
                 pass
+            if cluster is not None:
+                try:
+                    cluster.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
         report = self._report(spec, events, masters, fleet, states,
                               pending, chaos_log, dead_class_states,
-                              workdir, time.monotonic() - t0)
+                              workdir, time.monotonic() - t0,
+                              dfs_fleet=dfs_fleet, dfs_heal=dfs_heal,
+                              dfs_fi_points=dfs_fi_points)
         if own_workdir and ok and report["pass"]:
             shutil.rmtree(workdir, ignore_errors=True)
             report["artifacts_dir"] = None
         return report
 
+    @staticmethod
+    def _dfs_section(spec: dict, dfs_fleet: Any,
+                     dfs_heal: "dict | None") -> "dict | None":
+        """The storage layer's own verdict block: error budget,
+        corrupt-read invariant (== 0, always), optional client-side
+        p99 SLOs, and the end-of-run heal receipt."""
+        if dfs_fleet is None:
+            return None
+        d = spec["dfs"]
+        st = dfs_fleet.stats()
+        ops = sum(st["op_counts"].values()) or 1
+        err_frac = st["errors"] / ops
+        read_p99_ms = round(float(
+            (st["read_rtt"] or {}).get("p99", 0.0)) * 1000, 2)
+        meta_p99_ms = round(float(
+            (st["meta_rtt"] or {}).get("p99", 0.0)) * 1000, 2)
+        verdicts = {
+            "errors_ok": err_frac <= float(d["max_error_fraction"]),
+            "corrupt_reads_ok": int(st["corrupt_reads"]) == 0,
+            "read_p99_ok": (d["slo_read_p99_ms"] is None
+                            or read_p99_ms <= d["slo_read_p99_ms"]),
+            "meta_p99_ok": (d["slo_meta_p99_ms"] is None
+                            or meta_p99_ms <= d["slo_meta_p99_ms"]),
+            "healed": bool(dfs_heal and dfs_heal.get("healed")),
+        }
+        return {
+            "clients": int(d["clients"]),
+            "datanodes": int(d["datanodes"]),
+            "ops": int(st["ops"]),
+            "op_counts": st["op_counts"],
+            "bytes_read": int(st["bytes_read"]),
+            "errors": int(st["errors"]),
+            "error_fraction": round(err_frac, 4),
+            "corrupt_reads": int(st["corrupt_reads"]),
+            "safemode_refusals": int(st["safemode_refusals"]),
+            "read_p99_ms": read_p99_ms,
+            "meta_p99_ms": meta_p99_ms,
+            "heal": dfs_heal,
+            "verdicts": verdicts,
+            "pass": all(verdicts.values()),
+        }
+
     def _report(self, spec: dict, events: list, masters: list,
                 fleet: SimFleet, states: dict, pending: set,
                 chaos_log: list, dead_class_states: list,
-                workdir: str, wall_s: float) -> dict:
+                workdir: str, wall_s: float, *,
+                dfs_fleet: Any = None,
+                dfs_heal: "dict | None" = None,
+                dfs_fi_points: "list[str] | None" = None) -> dict:
         final = masters[-1]
         jt = final.metrics.snapshot().get("jobtracker", {})
         fr = final.flightrec
@@ -705,9 +1082,12 @@ class ScenarioRunner:
                         if s in ("FAILED", "KILLED"))
         chaos_points = sorted({ev["point"] for ev in spec["chaos"]
                                if ev["kind"] == "fi"}
-                              | {"tracker.crash"})
+                              | {"tracker.crash"}
+                              | set(dfs_fi_points or ()))
+        dfs_section = self._dfs_section(spec, dfs_fleet, dfs_heal)
         all_pass = (not failed and not pending
-                    and all(v.get("pass") for v in verdicts.values()))
+                    and all(v.get("pass") for v in verdicts.values())
+                    and (dfs_section is None or dfs_section["pass"]))
         return {
             "scenario": spec["name"],
             "seed": spec["seed"],
@@ -730,8 +1110,14 @@ class ScenarioRunner:
                 "attempts_adopted": int(
                     jt.get("attempts_adopted", 0)),
                 "master_restarts": len(masters) - 1,
+                "datanodes_killed": sum(
+                    len(r.get("targets", ())) for r in chaos_log
+                    if r["kind"] == "dn_crash"),
+                "nn_restarts": sum(1 for r in chaos_log
+                                   if r["kind"] == "nn_restart"),
                 "fi_fired": {p: fi.fired(p) for p in chaos_points},
             },
+            "dfs": dfs_section,
             "chaos_log": chaos_log,
             "brownout": (final.brownout.snapshot()
                          if final.brownout is not None

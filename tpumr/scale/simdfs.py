@@ -46,6 +46,18 @@ from tpumr.metrics.histogram import Histogram
 DEFAULT_MIX = (("read", 0.66), ("stat", 0.18), ("write", 0.10),
                ("rename", 0.03), ("delete", 0.03))
 
+#: the seeded working-set payload is ``bytes(range(256))`` repeated, so
+#: byte ``i`` of every file is ``i % 256`` — a read of any prefix is
+#: verifiable without shipping the expectation around
+_PAYLOAD_TEMPLATE = bytes(range(256))
+
+
+class CorruptReadError(IOError):
+    """A verified read returned bytes that differ from the seeded
+    payload — the checksum/bad-block-report defense FAILED and rot
+    reached an application. The one counter that must stay at zero
+    under ``block_corrupt`` chaos."""
+
 
 def seed_files(nn_host: str, nn_port: int, conf: Any = None,
                n_files: int = 8, file_bytes: int = 1 << 18,
@@ -93,12 +105,20 @@ class SimDFSClient:
                  read_bytes: int = 1 << 16,
                  mix: "tuple | None" = None,
                  home: str = "/user",
+                 verify: bool = False,
                  rng: "random.Random | None" = None) -> None:
         self.name = name
         self.cli = DFSClient(nn_host, nn_port, conf)
         self.files = list(files or [])
         self.hot_read_p = float(hot_read_p)
         self.read_bytes = int(read_bytes)
+        # verify=True checks every working-set read against the seeded
+        # seed_files payload (byte i == i % 256) and raises
+        # CorruptReadError on mismatch — the block_corrupt invariant
+        self.verify = bool(verify)
+        self._expected = (_PAYLOAD_TEMPLATE
+                          * (self.read_bytes // 256 + 1))[
+                              :self.read_bytes] if verify else b""
         self.mix = tuple(mix or DEFAULT_MIX)
         self._weights = [w for _op, w in self.mix]
         self._rng = rng or random.Random(hash(name) & 0xFFFFFFFF)
@@ -106,6 +126,11 @@ class SimDFSClient:
         # prefix, so write/rename/delete churn spreads across the
         # namenode's striped locks instead of serializing on one
         self.home = f"{home}/{name}"
+        # the directory the listing op sweeps: the working set's own
+        # parent (NOT a hardcoded root — the scenario lab seeds under a
+        # different tree than bench_dfs)
+        self._data_root = (self.files[0].rsplit("/", 1)[0] or "/") \
+            if self.files else "/"
         self._made_home = False
         self._seq = 0
         self._mine: "list[str]" = []   # my rolled files, oldest first
@@ -131,6 +156,10 @@ class SimDFSClient:
             path = self._rng.choice(self.files)
         with self.cli.open(path) as f:
             data = f.read(self.read_bytes)
+        if self.verify and data != self._expected[:len(data)]:
+            raise CorruptReadError(
+                f"{self.name}: {path} returned {len(data)} bytes that "
+                f"do not match the seeded payload")
         return len(data)
 
     def _op_stat(self) -> int:
@@ -140,7 +169,7 @@ class SimDFSClient:
         elif which == 1 and self.files:
             self.cli.get_status(self._rng.choice(self.files))
         else:
-            self.cli.list_status("/bench/data" if self.files else "/")
+            self.cli.list_status(self._data_root)
         return 0
 
     def _op_write(self) -> int:
@@ -253,9 +282,20 @@ class SimDFSFleet:
             t0 = time.monotonic()
             try:
                 op, nbytes = client.step()
-            except Exception:  # noqa: BLE001 — NN/DN down or overloaded
-                self.registry.incr("dfs_errors")
-                op, nbytes = "error", 0
+            except CorruptReadError:
+                self.registry.incr("dfs_corrupt_reads")
+                op, nbytes = "corrupt_read", 0
+            except Exception as e:  # noqa: BLE001 — NN/DN down or overloaded
+                if "safe mode" in str(e).lower():
+                    # a freshly restarted NameNode refusing ops until
+                    # block reports land: an availability event, not a
+                    # data error — budgeted separately (the SLO is
+                    # time-to-safemode-exit, judged by the scenario)
+                    self.registry.incr("dfs_safemode_refusals")
+                    op, nbytes = "safemode", 0
+                else:
+                    self.registry.incr("dfs_errors")
+                    op, nbytes = "error", 0
             else:
                 rtt = time.monotonic() - t0
                 (self._read_rtt if op == "read"
@@ -296,6 +336,8 @@ class SimDFSFleet:
             "op_counts": counts,
             "bytes_read": bytes_read,
             "errors": snap.get("dfs_errors", 0),
+            "corrupt_reads": snap.get("dfs_corrupt_reads", 0),
+            "safemode_refusals": snap.get("dfs_safemode_refusals", 0),
             "read_rtt": snap.get("dfs_read_rtt_seconds",
                                  Histogram("x").snapshot()),
             "meta_rtt": snap.get("dfs_meta_rtt_seconds",
@@ -445,3 +487,139 @@ def run_dfs_step(n_clients: int, *, conf: Any = None,
             with open(prom_out, "wb") as f:
                 f.write(body)
         return row
+
+
+# ------------------------------------------------------ recovery steps
+
+
+def _recovery_conf() -> "tuple[Any, dict]":
+    """One conf + the registered recovery SLOs for the timed kill
+    steps. Fast monitor/expiry cadences: the rows measure the
+    detection + repair MACHINERY, not production timer defaults."""
+    from tpumr.core import confkeys
+    from tpumr.mapred.jobconf import JobConf
+    conf = JobConf()
+    conf.set("tdfs.http.port", -1)
+    conf.set("dfs.replication", 2)
+    conf.set("tdfs.replication.interval.s", 0.2)
+    conf.set("tdfs.datanode.expiry.s", 1.5)
+    # clients ride a NameNode outage on transport retries; safemode
+    # refusals are application-retried by the probe
+    conf.set("tdfs.client.nn.retries", 60)
+    conf.set("tdfs.client.nn.backoff.ms", 100.0)
+    slos = {
+        "safemode": confkeys.get_float(
+            conf, "tpumr.dfs.bench.recovery.safemode.slo.s"),
+        "client": confkeys.get_float(
+            conf, "tpumr.dfs.bench.recovery.client.slo.s"),
+        "replication": confkeys.get_float(
+            conf, "tpumr.dfs.bench.recovery.replication.slo.s"),
+    }
+    return conf, slos
+
+
+def run_nn_kill_recovery(*, num_datanodes: int = 3, n_files: int = 8,
+                         file_bytes: int = 1 << 18,
+                         outage_s: float = 0.3) -> "list[dict]":
+    """SIGKILL the NameNode mid-traffic and time the two recovery
+    headlines from the moment of the kill: safemode exit (editlog
+    replay + enough block reports) and the first client op that
+    SUCCEEDS again (a probe riding transport retries across the
+    outage and application-retrying safemode refusals — the HDFS
+    SafeModeException loop). Returns the two bench rows with SLO
+    verdicts (``bench_dfs.py --recovery-only``)."""
+    from tpumr.dfs.mini_cluster import MiniDFSCluster
+
+    conf, slos = _recovery_conf()
+    base = {"kind": "", "datanodes": num_datanodes, "files": n_files,
+            "outage_s": outage_s}
+    with MiniDFSCluster(num_datanodes, conf=conf) as c:
+        files = seed_files(c.nn_host, c.nn_port, conf,
+                           n_files=n_files, file_bytes=file_bytes)
+        result: dict = {}
+
+        def probe() -> None:
+            cli = c.client()
+            try:
+                deadline = time.monotonic() + 25.0
+                while time.monotonic() < deadline:
+                    try:
+                        with cli.open(files[0]) as f:
+                            f.read(1024)
+                        result["t"] = time.monotonic()
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        if "safe mode" not in str(e).lower():
+                            result["error"] = str(e)
+                            return
+                        time.sleep(0.1)
+                result["error"] = "probe timed out"
+            finally:
+                close_client(cli)
+
+        t_kill = time.monotonic()
+        c.kill_namenode()
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        time.sleep(outage_s)
+        c.restart_killed_namenode()
+        sm_deadline = time.monotonic() + 30.0
+        while c.namenode.ns.safemode \
+                and time.monotonic() < sm_deadline:
+            time.sleep(0.02)
+        safemode_s = time.monotonic() - t_kill
+        t.join(timeout=30.0)
+        rows = [dict(base, kind="nn_kill_safemode_exit",
+                     recovery_s=round(safemode_s, 3),
+                     slo_s=slos["safemode"],
+                     ok=(not c.namenode.ns.safemode
+                         and safemode_s <= slos["safemode"]))]
+        if "t" in result:
+            client_s = result["t"] - t_kill
+            rows.append(dict(base,
+                             kind="nn_kill_first_client_success",
+                             recovery_s=round(client_s, 3),
+                             slo_s=slos["client"],
+                             ok=client_s <= slos["client"]))
+        else:
+            rows.append(dict(base,
+                             kind="nn_kill_first_client_success",
+                             error=result.get("error", "no result")))
+        return rows
+
+
+def run_dn_kill_recovery(*, num_datanodes: int = 4, n_files: int = 8,
+                         file_bytes: int = 1 << 18) -> dict:
+    """Hard-kill one datanode holding seeded replicas and time the
+    NameNode's expiry + re-replication loop restoring EVERY block to
+    its replication target on the survivors. Returns the bench row
+    with its SLO verdict."""
+    from tpumr.dfs.mini_cluster import MiniDFSCluster
+
+    conf, slos = _recovery_conf()
+    with MiniDFSCluster(num_datanodes, conf=conf) as c:
+        seed_files(c.nn_host, c.nn_port, conf,
+                   n_files=n_files, file_bytes=file_bytes)
+        ns = c.namenode.ns
+        dead = c.datanodes[0].addr
+        n_blocks = len(ns.block_locations)
+        t_kill = time.monotonic()
+        c.kill_datanode(0)
+
+        def restored() -> bool:
+            for locs in ns.block_locations.values():
+                if dead in locs or len(locs) < 2:
+                    return False
+            return True
+
+        deadline = time.monotonic() + slos["replication"] + 10.0
+        while not restored() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        recovery_s = time.monotonic() - t_kill
+        return {"kind": "dn_kill_replication_restored",
+                "datanodes": num_datanodes, "files": n_files,
+                "blocks": n_blocks,
+                "recovery_s": round(recovery_s, 3),
+                "slo_s": slos["replication"],
+                "ok": (restored()
+                       and recovery_s <= slos["replication"])}

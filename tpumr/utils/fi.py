@@ -56,6 +56,34 @@ loop):
                                  rejoin paths are the quarry's
                                  predator
 
+Storage churn seams (the DFS chaos-certification loop — scenario kinds
+``dn_crash`` / ``dn_partition`` / ``nn_restart`` / ``block_corrupt``):
+  dn.crash / dn.crash.d<n>       BEHAVIORAL fault — a DataNode
+                                 hard-kills itself mid-beat (no
+                                 deregistration, storage dir survives);
+                                 client replica failover, NN expiry and
+                                 re-replication are the quarry's
+                                 predator
+  dn.partition                   BEHAVIORAL fault — heartbeat silence
+                                 for ``tpumr.fi.dn.partition.ms``
+                                 (default 3000) WITHOUT process death:
+                                 reads keep serving while the NN
+                                 expires the node; the rejoin rides the
+                                 re-register + block report path
+  dn.read.corrupt / dn.read.corrupt.b<id>  BEHAVIORAL fault — flips a
+                                 byte in the on-disk replica just
+                                 before a read serves it; CRC
+                                 verification, bad-block reporting and
+                                 NN drop-and-re-replicate are the
+                                 quarry's predator (readers must never
+                                 see the rot)
+  nn.crash                       BEHAVIORAL fault — the NameNode dies
+                                 SIGKILL-style between monitor sweeps
+                                 (no editlog close); restart via
+                                 image + editlog replay, safemode
+                                 re-entry/exit and clients riding RPC
+                                 retries are the quarry's predator
+
 Observability seams (the flight-recorder / continuous-profiler loop):
   jt.heartbeat.slow              BEHAVIORAL fault — master heartbeat
                                  handling stalls ``tpumr.fi.jt.
