@@ -1,0 +1,381 @@
+"""Sharded master (perf tentpole PR: break the one-process ceiling).
+
+Four legs:
+
+- tracker→shard mapping is a pure, process-independent function (the
+  fleet, the shards, and the coordinator must all agree without
+  talking);
+- heartbeat batching preserves the per-tracker replay cache inside a
+  batch (a resent batch replays stored actions, never double-folds or
+  double-assigns a member) and isolates member failures;
+- the async history writer preserves ordering, read-your-writes (every
+  reader flushes first), bounded-queue drop accounting, and
+  synchronous fallback after stop();
+- shard failover mirrors test_master_restart's acceptance e2e scoped
+  to one shard: SIGKILL a shard mid-workload → the coordinator
+  respawns it on its pinned port, its trackers are ADOPTED (not
+  reinit), the job finishes with ZERO map re-executions — counters and
+  history both asserted — while the sibling shard never notices.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpumr.ipc.rpc import RpcClient
+from tpumr.mapred.history import JobHistory
+from tpumr.mapred.ids import JobID
+from tpumr.mapred.jobconf import JobConf
+from tpumr.mapred.jobtracker import JobMaster
+from tpumr.mapred.shardmaster import (ShardedMaster, make_master,
+                                      tracker_shard)
+from tpumr.scale.driver import ScaleDriver
+from tpumr.scale.scenario import ScenarioError, plan, validate_spec
+from tpumr.scale.simtracker import SimFleet, SimTracker
+from tpumr.security import rpc_secret
+
+
+# ------------------------------------------------------------ mapping
+
+
+class TestTrackerShard:
+    def test_deterministic_and_in_range(self):
+        for n in (1, 2, 3, 7):
+            for i in range(64):
+                name = f"sim_{i:04d}"
+                k = tracker_shard(name, n)
+                assert 0 <= k < n
+                assert k == tracker_shard(name, n), "must be stable"
+
+    def test_spreads_the_fleet(self):
+        counts = [0, 0]
+        for i in range(64):
+            counts[tracker_shard(f"sim_{i:04d}", 2)] += 1
+        assert min(counts) >= 16, counts   # crc32, not hash(): balanced
+
+    def test_fleet_endpoint_follows_the_map(self):
+        fleet = SimFleet("127.0.0.1", 1, 8,
+                         shard_map=[("127.0.0.1", 101),
+                                    ("127.0.0.1", 102)])
+        for i in range(8):
+            name = f"sim_{i:04d}"
+            host, port = fleet._endpoint(name)
+            assert port == 101 + tracker_shard(name, 2)
+
+
+# ------------------------------------------------------------ batching
+
+
+def _master_conf(tmp_path, **over):
+    conf = JobConf()
+    conf.set("tpumr.history.dir", str(tmp_path / "history"))
+    conf.set("tpumr.heartbeat.interval.ms", 50)
+    conf.set("tpumr.tracker.expiry.ms", 60_000)
+    for k, v in over.items():
+        conf.set(k, v)
+    return conf
+
+
+class TestHeartbeatBatch:
+    def test_resent_batch_replays_not_refolds(self, tmp_path):
+        """The satellite's contract: a resent batch must not
+        double-fold any member — each member rides the per-tracker
+        replay cache exactly like a lone resent heartbeat."""
+        master = JobMaster(_master_conf(tmp_path)).start()
+        try:
+            host, port = master.address
+            tr = SimTracker("batcher_00", host, port)
+            args = tr.heartbeat_build()
+            assert args is not None
+            tr.heartbeat_apply(master.heartbeat_batch([list(args)])[0])
+            # second beat (initial contact is over — the replay cache
+            # is armed now), delivered twice with the same response_id
+            args = tr.heartbeat_build()
+            first = master.heartbeat_batch([list(args)])
+            again = master.heartbeat_batch([list(args)])
+            assert first[0]["response_id"] == again[0]["response_id"]
+            assert first[0]["actions"] == again[0]["actions"]
+            snap = master.metrics.snapshot()["jobtracker"]
+            assert snap["heartbeat_batches"] == 3
+            replay = snap.get(
+                "heartbeat_phase_seconds|phase=replay", {})
+            assert replay.get("count") == 1, \
+                "second delivery must take the replay path"
+            tr.heartbeat_abort()
+            tr.close()
+        finally:
+            master.stop()
+
+    def test_member_failures_are_isolated(self, tmp_path):
+        master = JobMaster(_master_conf(tmp_path)).start()
+        try:
+            host, port = master.address
+            tr = SimTracker("batcher_01", host, port)
+            args = tr.heartbeat_build()
+            out = master.heartbeat_batch(
+                [["not-a-status", True, False, 0], list(args)])
+            assert "error" in out[0]
+            assert "response_id" in out[1], \
+                "a bad member must not poison the rest of the batch"
+            tr.heartbeat_abort()
+            tr.close()
+        finally:
+            master.stop()
+
+    def test_batched_fleet_drives_a_workload(self, tmp_path):
+        conf = _master_conf(tmp_path)
+        master = JobMaster(conf).start()
+        fleet = None
+        driver = None
+        try:
+            host, port = master.address
+            fleet = SimFleet(host, port, 6, interval_s=0.05,
+                             batch=4).start()
+            driver = ScaleDriver(host, port)
+            res = driver.run_workload(n_jobs=2, maps_per_job=4,
+                                      reduces_per_job=1, timeout_s=30)
+            assert len(res["succeeded"]) == 2, res
+            snap = master.metrics.snapshot()["jobtracker"]
+            assert snap.get("heartbeat_batches", 0) > 0
+            assert fleet.registry.snapshot().get("hb_errors", 0) == 0
+        finally:
+            if fleet is not None:
+                fleet.stop()
+            if driver is not None:
+                driver.close()
+            master.stop()
+
+
+# ------------------------------------------------------------ history
+
+
+class TestAsyncHistory:
+    def _history(self, tmp_path, **over):
+        conf = JobConf()
+        conf.set("tpumr.history.dir", str(tmp_path))
+        for k, v in over.items():
+            conf.set(k, v)
+        return JobHistory(conf)
+
+    def test_readers_see_queued_writes(self, tmp_path):
+        h = self._history(tmp_path)
+        h.task_event("job_a_0001", "TASK_STARTED",
+                     attempt_id="attempt_a_0001_m_000000_0")
+        # read-your-writes: every reader flushes the queue first
+        state = h.recovered_attempt_state("job_a_0001")
+        assert state == {"maps": {}, "reduces": {}}
+        assert h.queue_depth() == 0
+        assert h.writes_dropped == 0
+        h.stop()
+
+    def test_per_file_order_is_enqueue_order(self, tmp_path):
+        h = self._history(tmp_path)
+        for i in range(50):
+            h.task_event("job_b_0001", "E", seq=i)
+        assert h.flush()
+        events = h.read(os.path.join(str(tmp_path), "job_b_0001.jsonl"))
+        assert [e["seq"] for e in events] == list(range(50))
+        h.stop()
+
+    def test_bounded_queue_drops_and_counts(self, tmp_path):
+        h = self._history(tmp_path, **{"tpumr.history.queue.max": 8})
+        gate = threading.Event()
+        entered = threading.Event()
+        real = h._write_now
+
+        def slow(batch):
+            entered.set()
+            gate.wait(10.0)
+            real(batch)
+
+        h._write_now = slow
+        h.task_event("job_c_0001", "E", seq=-1)   # writer picks this up
+        assert entered.wait(5.0)
+        for i in range(8 + 5):                   # fills queue, 5 dropped
+            h.task_event("job_c_0001", "E", seq=i)
+        assert h.writes_dropped == 5
+        gate.set()
+        assert h.flush()
+        h.stop()
+        events = h.read(os.path.join(str(tmp_path), "job_c_0001.jsonl"))
+        assert len(events) == 1 + 8
+
+    def test_post_stop_writes_fall_through_synchronously(self, tmp_path):
+        h = self._history(tmp_path)
+        h.stop()
+        h.task_event("job_d_0001", "LATE")
+        events = h.read(os.path.join(str(tmp_path), "job_d_0001.jsonl"))
+        assert [e["event"] for e in events] == ["LATE"]
+
+    def test_sync_mode_still_works(self, tmp_path):
+        h = self._history(tmp_path, **{"tpumr.history.async": False})
+        h.task_event("job_e_0001", "E")
+        assert h.queue_depth() == 0
+        events = h.read(os.path.join(str(tmp_path), "job_e_0001.jsonl"))
+        assert len(events) == 1
+        h.stop()
+
+
+# ------------------------------------------------------------ spec/plan
+
+
+class TestShardKillSpec:
+    def _spec(self, **over):
+        spec = {"name": "t", "seed": 7,
+                "master": {"shards": 2},
+                "classes": [{"name": "c", "jobs": 1, "maps": 1}],
+                "chaos": [{"kind": "shard_kill", "at_ms": 100}]}
+        spec.update(over)
+        return spec
+
+    def test_shard_kill_needs_shards(self):
+        with pytest.raises(ScenarioError, match="master.shards"):
+            validate_spec(self._spec(master={}))
+
+    def test_shard_index_bounds(self):
+        with pytest.raises(ScenarioError, match="shard index"):
+            validate_spec(self._spec(
+                chaos=[{"kind": "shard_kill", "at_ms": 1, "shard": 2}]))
+
+    def test_master_restart_rejected_when_sharded(self):
+        with pytest.raises(ScenarioError, match="shard_kill"):
+            validate_spec(self._spec(
+                chaos=[{"kind": "master_restart", "at_ms": 1}]))
+
+    def test_plan_draws_victim_deterministically(self):
+        a = [e for e in plan(self._spec()) if e["kind"] == "shard_kill"]
+        b = [e for e in plan(self._spec()) if e["kind"] == "shard_kill"]
+        assert a == b
+        assert a[0]["shard"] in (0, 1)
+
+
+# ------------------------------------------------------------ failover
+
+
+def _sharded_conf(tmp_path, shards=2):
+    conf = JobConf()
+    conf.set("tpumr.history.dir", str(tmp_path / "history"))
+    conf.set("tpumr.master.shards", shards)
+    conf.set("tpumr.master.shards.poll.ms", 100)
+    conf.set("tpumr.heartbeat.interval.ms", 50)
+    conf.set("tpumr.tracker.expiry.ms", 60_000)
+    return conf
+
+
+class TestShardFailover:
+    def test_kill_mid_workload_zero_map_reruns(self, tmp_path):
+        """THE acceptance e2e, scoped to one shard: all of the victim
+        job's maps folded, reduces gated behind slowstart=1.0, shard
+        SIGKILLed → respawn on the pinned port, trackers adopted, job
+        finishes under its recovered id with ZERO map re-executions on
+        the respawned shard (counters + history both agree)."""
+        conf = _sharded_conf(tmp_path)
+        master = make_master(conf)
+        assert isinstance(master, ShardedMaster)
+        master.start()
+        fleet = None
+        driver = None
+        try:
+            host, port = master.address
+            shard_map = master.shard_map()
+            assert len(shard_map) == 2
+            shard1_trackers = [i for i in range(8) if tracker_shard(
+                f"sim_{i:04d}", 2) == 1]
+            assert shard1_trackers, "fleet must put trackers on shard 1"
+            fleet = SimFleet(host, port, 8, interval_s=0.05,
+                             secret=rpc_secret(conf), batch=4,
+                             shard_map=shard_map,
+                             task_time_mean_s=0.05).start()
+            driver = ScaleDriver(host, port, secret=rpc_secret(conf),
+                                 timeout_s=10)
+            # round-robin: job 0 → shard 0, job 1 → shard 1; the
+            # cluster-id suffix in the job id proves the routing
+            jids = driver.submit(
+                2, 6, 1,
+                **{"mapred.reduce.slowstart.completed.maps": 1.0})
+            by_suffix = {JobID.parse(j).cluster[-2:]: j for j in jids}
+            assert set(by_suffix) == {"s0", "s1"}
+            victim = by_suffix["s1"]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st = driver.client.call("get_job_status", victim)
+                if st["finished_maps"] >= 6:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("victim job's maps never finished")
+
+            master.kill_shard(1)
+            assert master.wait_shard_ready(1, 30.0)
+            res = driver.wait(jids, timeout_s=60)
+            # the driver polled the PRE-KILL id throughout; the
+            # coordinator routes it via the merged alias table
+            assert not res["failed"] and not res["unfinished"], res
+            recovered = master.get_recovered_jobs()
+            assert victim in recovered
+            new_id = recovered[victim]
+
+            # the respawned shard's OWN counters: adoption happened
+            # there, and it launched zero maps
+            snap = RpcClient(*shard_map[1],
+                             secret=rpc_secret(conf)).call(
+                "shard_snapshot")
+            counters = snap["metrics"]["jobtracker"]["counters"]
+            assert counters.get("jobs_recovered", 0) >= 1
+            assert counters.get("trackers_adopted", 0) \
+                >= len(shard1_trackers)
+            assert counters.get("maps_launched_cpu", 0) == 0
+            assert counters.get("maps_launched_tpu", 0) == 0
+            # …and the shard's history agrees: no post-respawn map
+            # TASK_STARTED under the recovered id
+            hist = JobHistory(conf)
+            events = hist.read(os.path.join(
+                str(tmp_path / "history"), "shard-1",
+                f"{new_id}.jsonl"))
+            started_maps = [e for e in events
+                            if e.get("event") == "TASK_STARTED"
+                            and "_m_" in str(e.get("attempt_id", ""))]
+            assert started_maps == []
+
+            # the sibling shard never restarted
+            stats = master.shard_stats()
+            assert stats["0"]["restarts"] == 0
+            assert stats["1"]["restarts"] == 1
+            # the merged metrics carry the failover counters the
+            # scenario report reads (wait out one coordinator poll)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                merged = master.metrics.snapshot()["jobtracker"]
+                if merged.get("trackers_adopted", 0) \
+                        >= len(shard1_trackers):
+                    break
+                time.sleep(0.05)
+            assert merged.get("shard_restarts", 0) == 1
+            assert merged.get("trackers_adopted", 0) \
+                >= len(shard1_trackers)
+        finally:
+            if fleet is not None:
+                fleet.stop()
+            if driver is not None:
+                driver.close()
+            master.stop()
+
+    def test_submissions_survive_a_dead_shard(self, tmp_path):
+        """Round-robin submission fails over to a live shard while the
+        victim is down — the client surface degrades, never breaks."""
+        conf = _sharded_conf(tmp_path)
+        master = ShardedMaster(conf).start()
+        driver = None
+        try:
+            driver = ScaleDriver(*master.address,
+                                 secret=rpc_secret(conf), timeout_s=10)
+            master.kill_shard(0)
+            jids = driver.submit(2, 1, 0)
+            assert len(jids) == 2
+            assert master.wait_shard_ready(0, 30.0)
+        finally:
+            if driver is not None:
+                driver.close()
+            master.stop()

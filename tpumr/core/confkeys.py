@@ -404,6 +404,10 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "ChainReducer: post-reduce mapper chain."),
     _K('tpumr.chain.reducer', 'str', None,
         "ChainReducer: the wrapped reducer."),
+    _K('tpumr.cluster.id.suffix', 'str', '',
+        "Suffix appended to the master's start-time cluster id (shard "
+        "workers set s<k> so same-millisecond shard boots can't mint "
+        "colliding job ids)."),
     _K('tpumr.cpu.batch.map', 'bool', True,
         "Vectorized CPU batch path for kernel maps."),
     _K('tpumr.datajoin.mappers', 'str', None,
@@ -495,6 +499,11 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Grep example: capture group."),
     _K('tpumr.grep.pattern', 'str', None,
         "Grep example: regex."),
+    _K('tpumr.heartbeat.batch', 'int', 0,
+        "Max co-located tracker beats coalesced into one heartbeat_batch "
+        "RPC by the scale fleet (0/1 = one pipelined RPC per beat). "
+        "Replay semantics hold per member — a resent batch never "
+        "double-folds a tracker."),
     _K('tpumr.heartbeat.beats.per.second', 'int', 0,
         "Target master-wide beat rate for adaptive cadence (0 = fixed "
         "cadence)."),
@@ -506,9 +515,16 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "Tracker heartbeat cadence floor, ms."),
     _K('tpumr.heartbeat.lostmaster.backoff.max.ms', 'int', 15000,
         "Cap on the tracker's lost-master heartbeat backoff, ms."),
+    _K('tpumr.history.async', 'bool', True,
+        "Write job-history events from a bounded background queue "
+        "instead of on the heartbeat's deferred phase (readers flush "
+        "first, so recovery and retired-status reads stay exact)."),
     _K('tpumr.history.dir', 'str', None,
         "Job history directory (events, per-job metrics rollups, "
         "traces)."),
+    _K('tpumr.history.queue.max', 'int', 10000,
+        "Bound on queued history events before new ones are dropped and "
+        "counted in history_writes_dropped (must stay 0 in bench runs)."),
     _K('tpumr.jax.cache.dir', 'str', None,
         "JAX persistent compilation cache directory."),
     _K('tpumr.jax.cache.min.compile.secs', 'float', 0.5,
@@ -538,6 +554,15 @@ _ENTRIES: "tuple[ConfKey, ...]" = (
         "New-API mapper class bridge key."),
     _K('tpumr.mapreduce.partitioner.class', 'class', None,
         "New-API partitioner class bridge key."),
+    _K('tpumr.master.shards', 'int', 0,
+        "Shard worker processes the master partitions its tracker fleet "
+        "across (0 = classic single-process master). Trackers hash to a "
+        "shard by crc32(name); each shard owns its trackers' full "
+        "heartbeat fast path and the jobs routed to it."),
+    _K('tpumr.master.shards.poll.ms', 'int', 250,
+        "Coordinator period for pulling per-shard metrics snapshots and "
+        "folding them into the merged /metrics and flight-recorder "
+        "view, ms."),
     _K('tpumr.matmul.b', 'str', None,
         "Matmul op: serialized B operand."),
     _K('tpumr.matmul.bf16', 'bool', True,

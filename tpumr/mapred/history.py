@@ -27,18 +27,118 @@ def _json_safe(v: Any) -> bool:
 
 
 class JobHistory:
+    """Event-log writer. By default (``tpumr.history.async``) events are
+    stamped at enqueue time and appended by one daemon writer thread off
+    a bounded queue — the heartbeat's deferred phase pays a list append,
+    never an fsync-adjacent ``open``/``write``. The queue is bounded
+    (``tpumr.history.queue.max``); past the bound events are DROPPED and
+    counted (``history_writes_dropped`` — a bench run must keep it 0).
+    Recovery readers call :meth:`flush` first, so replay always sees
+    every event the master logged before the read."""
+
     def __init__(self, conf: Any) -> None:
         self.dir = conf.get("tpumr.history.dir") if conf else None
         self._lock = threading.Lock()
+        self._async = bool(conf.get_boolean("tpumr.history.async", True)
+                           if conf else True)
+        self._queue_max = int(conf.get_int("tpumr.history.queue.max",
+                                           10_000) if conf else 10_000)
+        self._cv = threading.Condition()
+        self._queue: "list[tuple[str, dict]]" = []
+        self._writing = False     # drain batch in flight (flush waits)
+        self._stopped = False
+        self._writer: "threading.Thread | None" = None
+        self.writes_dropped = 0   # bound into metrics by the master
+
+    # ------------------------------------------------------ write path
 
     def _write(self, job_id: str, event: dict) -> None:
         if not self.dir:
             return
+        event["ts"] = time.time()   # stamped at ENQUEUE: event time,
+        #                             not whenever the writer drains
+        if not self._async:
+            self._write_now([(job_id, event)])
+            return
+        with self._cv:
+            if not self._stopped:
+                if len(self._queue) >= self._queue_max:
+                    self.writes_dropped += 1
+                    return
+                self._queue.append((job_id, event))
+                if self._writer is None:
+                    self._writer = threading.Thread(
+                        target=self._drain, name="history-writer",
+                        daemon=True)
+                    self._writer.start()
+                self._cv.notify_all()
+                return
+        # post-stop stragglers (late finalization racing shutdown)
+        # write synchronously so nothing is silently lost
+        self._write_now([(job_id, event)])
+
+    def _write_now(self, batch: "list[tuple[str, dict]]") -> None:
+        """Append a batch, one ``open`` per job file (per-file order is
+        the enqueue order; cross-file order carries no meaning)."""
+        by_job: "dict[str, list[str]]" = {}
+        for job_id, event in batch:
+            by_job.setdefault(job_id, []).append(
+                json.dumps(event) + "\n")
         os.makedirs(self.dir, exist_ok=True)
-        event["ts"] = time.time()
         with self._lock:
-            with open(os.path.join(self.dir, f"{job_id}.jsonl"), "a") as f:
-                f.write(json.dumps(event) + "\n")
+            for job_id, lines in by_job.items():
+                with open(os.path.join(self.dir,
+                                       f"{job_id}.jsonl"), "a") as f:
+                    f.write("".join(lines))
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait(0.5)
+                batch, self._queue = self._queue, []
+                stopped = self._stopped
+                self._writing = bool(batch)
+            if batch:
+                try:
+                    self._write_now(batch)
+                except OSError:
+                    self.writes_dropped += len(batch)
+                with self._cv:
+                    self._writing = False
+                    self._cv.notify_all()
+            if stopped and not batch:
+                return
+
+    def queue_depth(self) -> int:
+        return len(self._queue) + (1 if self._writing else 0)
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until every enqueued event is on disk (readers that
+        replay the log — recovery, retired-status serving — call this
+        first). True when the queue fully drained."""
+        if not self._async or not self.dir:
+            return True
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            self._cv.notify_all()
+            while self._queue or self._writing:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(0.05, left))
+        return True
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Flush and retire the writer thread (master shutdown). The
+        log must be complete on disk before ``stop()`` returns — a
+        restart immediately replays it."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+            writer = self._writer
+        if writer is not None:
+            writer.join(timeout=timeout_s)
 
     def job_submitted(self, jip: Any) -> None:
         self._write(str(jip.job_id), {
@@ -75,6 +175,7 @@ class JobHistory:
         import glob
         if not self.dir:
             return []
+        self.flush()
         out = []
         for path in sorted(glob.glob(os.path.join(self.dir, "*.jsonl"))):
             submitted = None
@@ -109,6 +210,7 @@ class JobHistory:
         import glob
         if not self.dir:
             return []
+        self.flush()
         out = []
         for path in sorted(glob.glob(os.path.join(self.dir,
                                                   "pipe_*.jsonl"))):
@@ -148,6 +250,7 @@ class JobHistory:
         reduces: dict[int, dict] = {}
         if not self.dir:
             return {"maps": maps, "reduces": reduces}
+        self.flush()
         path = os.path.join(self.dir, f"{job_id}.jsonl")
         if not os.path.exists(path):
             return {"maps": maps, "reduces": reduces}
@@ -194,6 +297,7 @@ class JobHistory:
         None when this job's history holds no outcome."""
         if not self.dir:
             return None
+        self.flush()
         path = os.path.join(self.dir, f"{job_id}.jsonl")
         if not os.path.exists(path):
             return None

@@ -276,8 +276,11 @@ class JobMaster:
         self._devcache_index_cache: "tuple[float, dict]" = (-1.0, {})
         # start-time-in-ms identifier ≈ JobTracker's trackerIdentifier —
         # must differ across restarts or recovered job ids collide with
-        # the original's history file
-        self.cluster_id = str(int(time.time() * 1000))
+        # the original's history file. The suffix keeps N shard masters
+        # booted in the same millisecond from minting colliding job ids
+        # (the cluster component of a JobID is a free string).
+        self.cluster_id = (str(int(time.time() * 1000))
+                           + str(conf.get("tpumr.cluster.id.suffix") or ""))
         self.expiry_s = conf.get_int("tpumr.tracker.expiry.ms", 10_000) / 1000.0
         self.blacklist_faults = conf.get_int("tpumr.tracker.max.faults", 4)
         sched_cls = conf.get_class("mapred.jobtracker.taskScheduler",
@@ -437,6 +440,14 @@ class JobMaster:
         # scheduler decision timing. These are the series the ROADMAP's
         # control-plane scale-out work reads first.
         self._hb_seconds = self._mreg.histogram("heartbeat_seconds")
+        self._hb_batch_size = self._mreg.histogram(
+            "heartbeat_batch_size")
+        # async history backpressure: queue depth + events dropped past
+        # the bound — a healthy run keeps the drop counter at exactly 0
+        self._mreg.set_gauge("history_queue_depth",
+                             self.history.queue_depth)
+        self._mreg.set_gauge("history_writes_dropped",
+                             lambda: self.history.writes_dropped)
         # master saturation series (the scale harness's read side, all
         # hoisted off the registry lookup path):
         # - lock wait/hold PER DECOMPOSED LOCK CLASS as one labeled
@@ -740,6 +751,10 @@ class JobMaster:
         if self._http is not None:
             self._http.stop()
         self._server.stop()
+        # history LAST (after the RPC server can no longer enqueue):
+        # the event log must be complete on disk before stop() returns —
+        # restart recovery replays it immediately
+        self.history.stop()
 
     @property
     def http_url(self) -> str | None:
@@ -2507,6 +2522,52 @@ class JobMaster:
             # this tracker's next heartbeat), so it is part of the
             # latency an operator must see
             self._hb_seconds.observe(time.monotonic() - t0)
+
+    def heartbeat_batch(self, beats: list) -> list:
+        """Many co-located trackers' beats in ONE RPC (satellite of the
+        sharded-master work: the syscall + dispatch overhead of a
+        round-trip per tracker was the measured single-process wall,
+        not the fold itself). Each member is ``[status,
+        initial_contact, ask_for_new_task, response_id]`` and is folded
+        through the normal :meth:`heartbeat` path — the per-tracker
+        replay cache, hb_lock, delta decode, and deferred phase all
+        apply PER MEMBER, so a resent batch replays stored actions
+        instead of double-folding any tracker. Members fail
+        independently: a bad member yields ``{"error": ...}`` in its
+        slot and the rest of the batch proceeds. Deliberately NOT a
+        reactor fast method — a batch does real work and belongs on
+        the handler pool."""
+        self._mreg.incr("heartbeat_batches")
+        self._hb_batch_size.observe(len(beats))
+        out = []
+        for member in beats:
+            try:
+                status, initial_contact, ask, response_id = member
+                out.append(self.heartbeat(status, bool(initial_contact),
+                                          bool(ask), int(response_id)))
+            except Exception as e:  # noqa: BLE001 — member-isolated
+                out.append({"error": f"{type(e).__name__}: {e}"})
+        return out
+
+    def shard_snapshot(self) -> dict:
+        """One coordinator poll's worth of this shard's state: the full
+        typed metrics snapshot (the coordinator folds counter deltas
+        reset-safely, so a respawned shard's counters restarting at zero
+        don't go negative), per-class latency histograms, and this
+        shard's own CPU shares from the always-on profiler — the
+        per-shard ``cpu_share`` columns the scale bench commits come
+        straight from here. Handler-pool method like any slow RPC."""
+        return {
+            "cluster_id": self.cluster_id,
+            "trackers": len(self.trackers),
+            "metrics": self.metrics.typed_snapshot(),
+            "class_hists": {f"{kind}|{cls}": h.typed()
+                            for (kind, cls), h
+                            in list(self._class_hists.items())},
+            "rpc_inflight_peak": self._server.inflight_peak(),
+            "cpu_shares": (self.sampler.subsystem_shares()
+                           if self.sampler is not None else None),
+        }
 
     def _phase_span(self, hb_trace: "dict | None", name: str,
                     start_wall: float, **attrs: Any) -> None:

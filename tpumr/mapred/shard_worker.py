@@ -1,0 +1,105 @@
+"""Entry point for ONE master shard process.
+
+``python -m tpumr.mapred.shard_worker`` reads a single JSON spec line
+from stdin, boots a full :class:`~tpumr.mapred.jobtracker.JobMaster`
+scoped to this shard (own history subdir, own cluster-id suffix, HTTP
+off — the coordinator serves the merged surface), registers with the
+coordinator, then blocks on stdin until EOF. Stdin doubles as the
+parent-death channel: if the coordinator dies, the pipe closes and the
+shard shuts itself down instead of orphaning — same trick as
+``subprocess`` daemons everywhere, no PID polling required.
+
+The spec::
+
+    {"index": 0, "host": "127.0.0.1", "port": 0,
+     "coordinator": ["127.0.0.1", 54321], "conf": {...}}
+
+``port`` is 0 on first spawn (the shard binds an ephemeral port and
+reports it via ``register_shard``) and PINNED on respawn: a re-joining
+tracker fleet keeps its shard map, so a respawned shard must come back
+on the address its trackers already know — exactly the master-restart
+contract from the adoption protocol, scoped to one shard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def build_shard_conf(spec: dict):
+    """The shard's JobConf: the coordinator's conf plus the shard
+    scoping overrides. Shared conf means shared RPC secret — the
+    coordinator, shards, and fleet all derive the same one."""
+    from tpumr.mapred.jobconf import JobConf
+    conf = JobConf()
+    for key, value in (spec.get("conf") or {}).items():
+        conf.set(key, value)
+    k = int(spec["index"])
+    base = conf.get("tpumr.history.dir") or ""
+    if base:
+        # each shard recovers from ITS OWN event log on respawn;
+        # sibling shards' histories must be invisible to it
+        conf.set("tpumr.history.dir", os.path.join(str(base), f"shard-{k}"))
+    # distinct cluster-id suffix per shard: two shards booting in the
+    # same millisecond must not mint colliding job ids
+    conf.set("tpumr.cluster.id.suffix", f"s{k}")
+    # a killed shard is a master restart scoped to its trackers —
+    # recovery is non-negotiable here, whatever the outer conf says
+    conf.set("mapred.jobtracker.restart.recover", True)
+    conf.set("tpumr.master.shards", 0)        # no recursive sharding
+    conf.set("mapred.job.tracker.http.port", -1)
+    return conf
+
+
+def serve(spec: dict) -> int:
+    from tpumr.ipc.rpc import RpcClient
+    from tpumr.mapred.jobtracker import JobMaster
+    from tpumr.security import rpc_secret
+
+    conf = build_shard_conf(spec)
+    host = str(spec.get("host") or "127.0.0.1")
+    port = int(spec.get("port") or 0)
+    master = None
+    if port:
+        # respawn on a pinned port: the dead shard's listener may
+        # linger in TIME_WAIT for a few hundred ms
+        for attempt in range(250):
+            try:
+                master = JobMaster(conf, host=host, port=port)
+                break
+            except OSError:
+                if attempt == 249:
+                    raise
+                time.sleep(0.02)
+    else:
+        master = JobMaster(conf, host=host, port=0)
+    assert master is not None
+    master.start()
+    try:
+        coord_host, coord_port = spec["coordinator"]
+        reg = RpcClient(str(coord_host), int(coord_port),
+                        secret=rpc_secret(conf))
+        try:
+            reg.call("register_shard", int(spec["index"]),
+                     master.address[0], master.address[1], os.getpid())
+        finally:
+            reg.close()
+        sys.stdin.buffer.read()   # parent-death watch: EOF = shut down
+        return 0
+    finally:
+        master.stop()
+
+
+def main() -> int:
+    line = sys.stdin.readline()
+    if not line.strip():
+        print("shard_worker: no spec on stdin", file=sys.stderr)
+        return 2
+    return serve(json.loads(line))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

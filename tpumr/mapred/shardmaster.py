@@ -1,0 +1,547 @@
+"""Sharded master runtime: N shard worker processes + thin coordinator.
+
+One Python process tops out folding ~400 trackers' heartbeats at the
+250ms dual-p99 SLO — after five PRs of lock work the profiler shows
+throughput (rpc dispatch + fold CPU on one core with one GIL), not
+locking, as the wall. This module breaks the ceiling the only way a
+GIL permits: **partition the fleet across processes**. Each shard is a
+complete :class:`~tpumr.mapred.jobtracker.JobMaster` (registry stripe,
+delta decode, status fold, try-lock scheduling, completion events,
+history, recovery) owning the trackers that hash to it AND the jobs the
+coordinator routes to it; the :class:`ShardedMaster` coordinator stays
+off every heartbeat and serves only the client surface (submit/status/
+kill routing), shard supervision, and the merged metrics/flight-record
+view.
+
+Design rules, in order of importance:
+
+* **The coordinator never sits on the heartbeat path.** Trackers talk
+  straight to their shard (``tracker_shard(name, n)`` is a pure
+  function of the tracker name, computable by any party with the shard
+  map). The coordinator's lock (rank ``coordinator``, 18) guards only
+  routing tables and shard records; every blocking edge — shard RPC,
+  ``Popen``, ``wait`` — runs OUTSIDE it, which ``tpumr lint`` proves.
+* **A dead shard is a master restart scoped to its trackers.** The
+  monitor respawns it on its PINNED port with recovery on; its
+  trackers re-join and their in-flight attempts are adopted by the
+  re-submitted jobs — the PR-9 protocol, unchanged. Sibling shards
+  never notice.
+* **Shards share nothing.** Separate history subdirs, distinct
+  cluster-id suffixes (job ids can't collide), no cross-shard RPC.
+  A job's splits, attempts, and completion events all live on one
+  shard, so the fast path stays exactly as profiled single-process.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from typing import Any
+
+from tpumr.core import confkeys
+from tpumr.ipc.rpc import RpcClient, RpcServer
+from tpumr.mapred.jobtracker import PROTOCOL_VERSION
+from tpumr.metrics.histogram import Histogram, typed_delta
+
+
+def tracker_shard(name: str, n: int) -> int:
+    """Which shard owns tracker ``name``. crc32, NOT ``hash()`` —
+    Python string hashing is per-process seed-randomized and the fleet,
+    the shards, and the coordinator must all agree."""
+    return zlib.crc32(str(name).encode("utf-8")) % max(1, int(n))
+
+
+def make_master(conf: Any, host: str = "127.0.0.1", port: int = 0):
+    """``tpumr.master.shards`` > 0 → a :class:`ShardedMaster`, else the
+    classic single-process :class:`JobMaster` — one construction seam
+    for the scenario lab, the bench, and the CLI."""
+    if confkeys.get_int(conf, "tpumr.master.shards") > 0:
+        return ShardedMaster(conf, host=host, port=port)
+    from tpumr.mapred.jobtracker import JobMaster
+    return JobMaster(conf, host=host, port=port)
+
+
+class _FleetSize:
+    """``len()``-able stand-in for the single master's tracker registry
+    (the flight recorder and dashboards only ever take ``len``)."""
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def __len__(self) -> int:
+        return self.n
+
+
+class _Shard:
+    """Coordinator-side record of one worker process."""
+
+    __slots__ = ("index", "host", "port", "pid", "proc", "client",
+                 "registered", "restarts", "trackers", "cpu_shares",
+                 "rpc_inflight_peak", "cluster_id", "gauges")
+
+    def __init__(self, index: int, host: str) -> None:
+        self.index = index
+        self.host = host
+        self.port = 0            # pinned after first registration
+        self.pid = 0
+        self.proc: Any = None
+        self.client: "RpcClient | None" = None
+        self.registered = threading.Event()
+        self.restarts = 0
+        self.trackers = 0
+        self.cpu_shares: "dict | None" = None
+        self.rpc_inflight_peak = 0
+        self.cluster_id = ""
+        #: last polled jobtracker gauges (instructed cadence, history
+        #: queue backpressure) — point-in-time truths that can't be
+        #: summed into the merged registries, so they stay per shard
+        self.gauges: dict = {}
+
+
+class ShardedMaster:
+    """Coordinator: spawn/supervise shards, route the client RPC
+    surface by job ownership, fold per-shard metrics into one merged
+    view. Exposes the :class:`JobMaster` attributes the scale harness,
+    scenario lab, and flight recorder consume (``address``, ``metrics``,
+    ``trackers``, ``_class_hists``, ``_hb_seconds``/``_hb_lag``,
+    ``brownout``, ``scenario_name``) so every consumer treats either
+    master shape uniformly."""
+
+    #: how long to wait for a (re)spawned shard to register
+    REGISTER_TIMEOUT_S = 30.0
+
+    def __init__(self, conf: Any, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.conf = conf
+        self.host = host
+        self.n = max(1, confkeys.get_int(conf, "tpumr.master.shards"))
+        self.poll_s = confkeys.get_int(
+            conf, "tpumr.master.shards.poll.ms") / 1000.0
+        from tpumr.metrics import MetricsSystem
+        self.metrics = MetricsSystem(
+            "jobtracker",
+            period_s=confkeys.get_int(conf, "tpumr.metrics.period.ms") / 1000)
+        self._mreg = self.metrics.new_registry("jobtracker")
+        #: per-source merged registries (shards ship typed snapshots;
+        #: counters fold as reset-safe deltas so a respawned shard's
+        #: zeros don't regress the totals)
+        self._regs = {"jobtracker": self._mreg}
+        from tpumr.metrics.locks import RANK_COORDINATOR, InstrumentedRLock
+        self._coord_lock = InstrumentedRLock(name="coordinator",
+                                       rank=RANK_COORDINATOR)
+        self._shards = [_Shard(k, host) for k in range(self.n)]
+        #: job id → owning shard index (insert-only, like the job table)
+        self._job_shard: "dict[str, int]" = {}
+        #: merged old→new recovered-job aliases from every shard respawn
+        self._recovered: "dict[str, str]" = {}
+        self._rr = 0
+        self._stop = threading.Event()
+        self._threads: "list[threading.Thread]" = []
+        #: thread-confined to the poll loop — previous typed states for
+        #: delta folding, keyed (shard, source, kind, name)
+        self._prev: "dict[tuple, dict]" = {}
+
+        # ---- JobMaster-compatible merged surface -------------------
+        self.trackers = _FleetSize()
+        self.brownout = None
+        self.scenario_name = str(confkeys.get(
+            conf, "tpumr.scenario.name") or "")
+        self._hb_seconds = self._mreg.histogram("heartbeat_seconds")
+        self._hb_lag = self._mreg.histogram("heartbeat_lag_seconds")
+        #: merged per-class latency hists, same shape the single master
+        #: keeps — the flight recorder's per-class verdicts read these
+        self._class_hists: "dict[tuple[str, str], Histogram]" = {}
+        #: per-shard heartbeat hists for the recorder's per-shard
+        #: breach windows: (shard index, metric name) → Histogram
+        self._shard_hists: "dict[tuple[int, str], Histogram]" = {}
+
+        self._mreg.set_gauge("shards", lambda: self.n)
+        self._mreg.set_gauge("shard_trackers_total",
+                             lambda: len(self.trackers))
+
+        from tpumr.security import rpc_secret
+        self._rpc_secret = rpc_secret(conf)
+        # client surface only — no fast methods: every handler here
+        # either blocks on a shard RPC or mutates routing tables, and
+        # belongs on the handler pool, never inline in a reactor loop
+        self._server = RpcServer(self, host=host, port=port,
+                                 secret=self._rpc_secret)
+        self._server.metrics = self.metrics.new_registry("rpc")
+        self._regs["rpc"] = self._server.metrics
+
+        from tpumr.metrics.flightrec import ShardFlightRecorder
+        self.flightrec = ShardFlightRecorder.from_conf(conf, self)
+        self._http: Any = None
+        self._http_port = conf.get_int("mapred.job.tracker.http.port", -1)
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def address(self) -> "tuple[str, int]":
+        return self._server.address
+
+    def start(self) -> "ShardedMaster":
+        self._server.start()
+        for shard in self._shards:
+            self._spawn(shard)
+        deadline = time.monotonic() + self.REGISTER_TIMEOUT_S
+        for shard in self._shards:
+            if not shard.registered.wait(
+                    max(0.1, deadline - time.monotonic())):
+                self.stop()   # don't leak half a fleet of workers
+                raise RuntimeError(
+                    f"shard {shard.index} failed to register within "
+                    f"{self.REGISTER_TIMEOUT_S:.0f}s")
+        for target, name in ((self._monitor_loop, "shard-monitor"),
+                             (self._poll_loop, "shard-poll")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.metrics.start()
+        if self.flightrec is not None:
+            self.flightrec.start()
+        if self._http_port >= 0:
+            self._http = self._build_http(self._http_port).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.flightrec is not None:
+            self.flightrec.stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+        for shard in self._shards:
+            proc = shard.proc
+            if proc is None:
+                continue
+            try:
+                if proc.stdin:
+                    proc.stdin.close()   # EOF = orderly shard shutdown
+                proc.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001 — escalate to SIGKILL
+                proc.kill()
+                try:
+                    proc.wait(timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    pass
+            if shard.client is not None:
+                shard.client.close()
+        self.metrics.stop()
+        if self._http is not None:
+            self._http.stop()
+        self._server.stop()
+
+    # ------------------------------------------------------------ spawning
+
+    def _spawn(self, shard: "_Shard") -> None:
+        """Launch one worker (never under the coordinator lock: Popen
+        forks). ``shard.port`` 0 = first boot on an ephemeral port;
+        non-zero = respawn pinned to the address its trackers know."""
+        spec = {
+            "index": shard.index,
+            "host": shard.host,
+            "port": shard.port,
+            "coordinator": list(self._server.address),
+            "conf": self.conf.to_dict(),
+        }
+        shard.registered.clear()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpumr.mapred.shard_worker"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.DEVNULL,
+            stderr=None,            # shard tracebacks surface on ours
+            close_fds=True)
+        assert proc.stdin is not None
+        proc.stdin.write((json.dumps(spec, default=str) + "\n").encode())
+        proc.stdin.flush()          # stdin stays OPEN: EOF = parent died
+        with self._coord_lock:
+            shard.proc = proc
+
+    def register_shard(self, index: int, host: str, port: int,
+                       pid: int) -> dict:
+        """Called by each worker once its JobMaster is serving. On a
+        RESPAWN registration the coordinator also pulls the shard's
+        recovered-job aliases so client polls on pre-kill job ids route
+        to the resubmitted jobs — the restart rebinding surface, merged
+        across shards."""
+        shard = self._shards[int(index)]
+        client = RpcClient(str(host), int(port), secret=self._rpc_secret)
+        respawn = shard.restarts > 0
+        with self._coord_lock:
+            old = shard.client
+            shard.host, shard.port, shard.pid = str(host), int(port), int(pid)
+            shard.client = client
+        if old is not None:
+            old.close()
+        if respawn:
+            self._pull_recovered(shard)
+        shard.registered.set()
+        return {"index": int(index), "shards": self.n}
+
+    def _pull_recovered(self, shard: "_Shard") -> None:
+        """Merge one shard's old→new recovered-job map into the
+        coordinator's alias table and ownership routing."""
+        try:
+            recovered = shard.client.call("get_recovered_jobs")
+        except Exception:  # noqa: BLE001 — poll loop retries routing
+            return
+        with self._coord_lock:
+            for old_id, new_id in (recovered or {}).items():
+                self._recovered[old_id] = new_id
+                self._job_shard[new_id] = shard.index
+                self._job_shard.setdefault(old_id, shard.index)
+
+    def _monitor_loop(self) -> None:
+        """Reap dead shard processes and respawn them on their pinned
+        ports. A kill -9'd shard comes back with recovery on; its
+        trackers re-join within one heartbeat interval and the adoption
+        protocol takes it from there."""
+        while not self._stop.wait(0.1):
+            for shard in self._shards:
+                proc = shard.proc
+                if proc is None or proc.poll() is None:
+                    continue
+                if self._stop.is_set():
+                    return
+                shard.restarts += 1
+                self._mreg.incr("shard_restarts")
+                self._mreg.incr(f"shard_restarts|shard={shard.index}")
+                self._spawn(shard)
+                shard.registered.wait(self.REGISTER_TIMEOUT_S)
+
+    # ------------------------------------------------------------ folding
+
+    def _poll_loop(self) -> None:
+        """Pull every shard's typed snapshot on a period and fold it
+        into the merged view. Histograms and counters arrive CUMULATIVE
+        per shard process generation; folding deltas (reset-safe on
+        count shrink) makes a respawn look like a flat spot, not a
+        regression. ``_prev`` is confined to this thread — the fold
+        needs no coordinator lock at all."""
+        while not self._stop.wait(self.poll_s):
+            total_trackers = 0
+            for shard in self._shards:
+                client = shard.client
+                if client is None or not shard.registered.is_set():
+                    continue
+                try:
+                    snap = client.call("shard_snapshot")
+                except Exception:  # noqa: BLE001 — dead shard; monitor acts
+                    continue
+                self._fold_shard(shard, snap)
+                total_trackers += shard.trackers
+            self.trackers.n = total_trackers
+
+    def _fold_shard(self, shard: "_Shard", snap: dict) -> None:
+        k = shard.index
+        shard.trackers = int(snap.get("trackers") or 0)
+        shard.cpu_shares = snap.get("cpu_shares")
+        shard.rpc_inflight_peak = int(snap.get("rpc_inflight_peak") or 0)
+        shard.cluster_id = str(snap.get("cluster_id") or "")
+        for source, typed in (snap.get("metrics") or {}).items():
+            if source == "jobtracker":
+                shard.gauges = dict(typed.get("gauges") or {})
+            reg = self._regs.get(source)
+            if reg is None:
+                reg = self._regs[source] = self.metrics.new_registry(source)
+            for name, val in (typed.get("counters") or {}).items():
+                key = (k, source, "c", name)
+                base = self._prev.get(key, 0)
+                try:
+                    inc = val - base if val >= base else val
+                except TypeError:
+                    continue
+                self._prev[key] = val  # type: ignore[assignment]
+                if inc:
+                    reg.incr(name, inc)
+            for name, cur in (typed.get("histograms") or {}).items():
+                key = (k, source, "h", name)
+                delta = typed_delta(cur, self._prev.get(key))
+                self._prev[key] = cur
+                if not delta or not delta.get("count"):
+                    continue
+                reg.histogram(name, delta.get("bounds") or None) \
+                    .merge_typed(delta)
+                if source == "jobtracker" and name in (
+                        "heartbeat_seconds", "heartbeat_lag_seconds"):
+                    h = self._shard_hists.get((k, name))
+                    if h is None:
+                        h = self._shard_hists[(k, name)] = Histogram(
+                            f"{name}|shard={k}",
+                            delta.get("bounds") or None)
+                    h.merge_typed(delta)
+        for label, cur in (snap.get("class_hists") or {}).items():
+            kind, _, cls = label.partition("|")
+            key = (k, "class", "h", label)
+            delta = typed_delta(cur, self._prev.get(key))
+            self._prev[key] = cur
+            if not delta or not delta.get("count"):
+                continue
+            h = self._class_hists.get((kind, cls))
+            if h is None:
+                h = self._class_hists[(kind, cls)] = Histogram(
+                    f"class_{kind}_seconds|class={cls}",
+                    delta.get("bounds") or None)
+            h.merge_typed(delta)
+
+    # ------------------------------------------------------------ routing
+
+    def get_protocol_version(self) -> int:
+        return PROTOCOL_VERSION
+
+    def shard_map(self) -> "list[tuple[str, int]]":
+        """Tracker-facing topology: index → (host, port). Position in
+        the list IS the shard index ``tracker_shard`` selects."""
+        with self._coord_lock:
+            return [(s.host, s.port) for s in self._shards]
+
+    def get_shard_map(self) -> "list[list]":
+        return [[h, p] for h, p in self.shard_map()]
+
+    def shard_stats(self) -> dict:
+        """Per-shard operational truth for dashboards, the bench's
+        per-shard ``cpu_share`` columns, and incident bundles."""
+        with self._coord_lock:
+            shards = list(self._shards)
+        return {
+            str(s.index): {
+                "address": [s.host, s.port],
+                "pid": s.pid,
+                "restarts": s.restarts,
+                "trackers": s.trackers,
+                "cluster_id": s.cluster_id,
+                "rpc_inflight_peak": s.rpc_inflight_peak,
+                "cpu_shares": s.cpu_shares,
+                "interval_instructed_ms": int(s.gauges.get(
+                    "heartbeat_interval_instructed_ms", 0) or 0),
+                "history_queue_depth": int(s.gauges.get(
+                    "history_queue_depth", 0) or 0),
+                "history_writes_dropped": int(s.gauges.get(
+                    "history_writes_dropped", 0) or 0),
+            } for s in shards}
+
+    def _owner(self, job_id: str) -> "int | None":
+        with self._coord_lock:
+            k = self._job_shard.get(job_id)
+            if k is None:
+                alias = self._recovered.get(job_id)
+                if alias is not None:
+                    k = self._job_shard.get(alias)
+            return k
+
+    def _call_owner(self, job_id: str, method: str, *args: Any) -> Any:
+        """Route a job-scoped client call to its owning shard; unknown
+        ids probe every shard (each shard serves its own retired and
+        recovered jobs from history) and cache the answer."""
+        k = self._owner(job_id)
+        if k is not None:
+            return self._shards[k].client.call(method, job_id, *args)
+        last_err: "Exception | None" = None
+        for shard in self._shards:
+            client = shard.client
+            if client is None:
+                continue
+            try:
+                out = client.call(method, job_id, *args)
+            except Exception as e:  # noqa: BLE001 — not this shard's job
+                last_err = e
+                continue
+            with self._coord_lock:
+                self._job_shard.setdefault(job_id, shard.index)
+            return out
+        raise last_err if last_err is not None \
+            else RuntimeError(f"unknown job {job_id}")
+
+    def submit_job(self, conf: dict, splits: list) -> str:
+        """Round-robin a new job onto a shard; the job's whole life
+        (splits, attempts, events, history) stays there. Falls over to
+        the next shard if the chosen one is mid-respawn — submission
+        availability degrades, never the whole surface."""
+        last_err: "Exception | None" = None
+        for _ in range(self.n):
+            with self._coord_lock:
+                k = self._rr % self.n
+                self._rr += 1
+            client = self._shards[k].client
+            if client is None:
+                continue
+            try:
+                job_id = client.call("submit_job", conf, splits)
+            except Exception as e:  # noqa: BLE001 — try next shard
+                last_err = e
+                continue
+            with self._coord_lock:
+                self._job_shard[str(job_id)] = k
+            self._mreg.incr("jobs_routed")
+            self._mreg.incr(f"jobs_routed|shard={k}")
+            return job_id
+        raise last_err if last_err is not None \
+            else RuntimeError("no shard accepted the job")
+
+    def get_job_status(self, job_id: str) -> dict:
+        return self._call_owner(str(job_id), "get_job_status")
+
+    def get_counters(self, job_id: str) -> dict:
+        return self._call_owner(str(job_id), "get_counters")
+
+    def get_task_reports(self, job_id: str, kind: str = "map") -> list:
+        return self._call_owner(str(job_id), "get_task_reports", kind)
+
+    def kill_job(self, job_id: str, user: str = "") -> Any:
+        return self._call_owner(str(job_id), "kill_job", user)
+
+    def get_recovered_jobs(self) -> dict:
+        with self._coord_lock:
+            return dict(self._recovered)
+
+    # ------------------------------------------------------------ chaos
+
+    def kill_shard(self, index: int) -> dict:
+        """SIGKILL one shard worker (the scenario engine's shard_kill
+        chaos and the failover tests call this in-process). The monitor
+        notices within ~100ms and respawns it on the pinned port."""
+        shard = self._shards[int(index)]
+        proc, pid = shard.proc, shard.pid
+        shard.registered.clear()
+        if proc is not None:
+            proc.kill()
+        self._mreg.incr("shards_killed")
+        return {"index": int(index), "pid": pid}
+
+    def wait_shard_ready(self, index: int,
+                         timeout_s: float = 30.0) -> bool:
+        """Block until shard ``index`` is registered and serving
+        (test/chaos convenience — NOT part of the client surface)."""
+        return self._shards[int(index)].registered.wait(timeout_s)
+
+    # ------------------------------------------------------------ http
+
+    def _build_http(self, port: int):
+        """Merged operator surface: /cluster over all shards, per-shard
+        stats, and the uniform /metrics + /metrics/prom exposition fed
+        by the folded registries."""
+        from tpumr.http import StatusHttpServer
+        srv = StatusHttpServer("coordinator", port=port)
+
+        def cluster_info(q: dict) -> dict:
+            with self._coord_lock:
+                jobs = len(self._job_shard)
+            return {
+                "shards": self.n,
+                "trackers": len(self.trackers),
+                "jobs_routed": jobs,
+                "shard_map": self.get_shard_map(),
+            }
+
+        srv.add_json("cluster", cluster_info)
+        srv.add_json("shards", lambda q: self.shard_stats())
+        srv.attach_metrics(self.metrics)
+        srv.add_page("index", lambda q: (
+            f"<h1>Coordinator — {self.n} shards, "
+            f"{len(self.trackers)} trackers</h1>"))
+        return srv

@@ -518,6 +518,59 @@ class NNFlightRecorder(FlightRecorder):
         }
 
 
+class ShardFlightRecorder(FlightRecorder):
+    """The sharded-master coordinator's SLO watchdog. The base tick
+    windows the COORDINATOR-MERGED ``heartbeat_seconds`` /
+    ``heartbeat_lag_seconds`` (folded from every shard's deltas) and
+    the merged per-class hists, so cluster-wide breach judgement is
+    unchanged; on top of that it windows each shard's own heartbeat
+    distributions, so a breach driven by ONE hot or dying shard shows
+    up as ``heartbeat_seconds|shard=k`` in the bundle's reason — the
+    incident names the breaching shard instead of blaming the whole
+    master. No sampler of its own: the coordinator does no fold work
+    worth profiling; per-shard CPU shares ride in the ``shards``
+    section instead."""
+
+    @classmethod
+    def from_conf(cls, conf: Any,
+                  coordinator: Any) -> "ShardFlightRecorder | None":
+        from tpumr.core import confkeys
+        if not (confkeys.get_boolean(conf, "tpumr.prof.enabled")
+                or confkeys.get_boolean(conf, "tpumr.brownout.enabled")):
+            return None
+        d = conf.get("tpumr.prof.incident.dir") \
+            or conf.get("tpumr.history.dir")
+        if not d:
+            return None
+        return cls(
+            coordinator, None,
+            slo_ms=confkeys.get_int(conf, "tpumr.prof.incident.slo.ms"),
+            cooldown_ms=confkeys.get_int(
+                conf, "tpumr.prof.incident.cooldown.ms"),
+            incident_dir=os.path.join(str(d), "incidents"),
+            conf=conf)
+
+    def _windowed_p99s(self) -> "list[tuple[str, float]]":
+        rows = super()._windowed_p99s()
+        hists = getattr(self.master, "_shard_hists", None) or {}
+        for (k, name), hist in sorted(hists.items()):
+            metric = f"{name}|shard={k}"
+            cur = hist.typed()
+            delta = typed_delta(cur, self._prev.get(metric))
+            self._prev[metric] = cur
+            if delta and delta.get("count"):
+                rows.append((metric, typed_p99(delta)))
+        return rows
+
+    def bundle(self, breaches: "list[tuple]") -> dict:
+        doc = super().bundle(breaches)
+        doc["role"] = "coordinator"
+        stats = self.master.shard_stats() \
+            if hasattr(self.master, "shard_stats") else {}
+        doc["shards"] = stats
+        return doc
+
+
 def validate_incident(doc: Any) -> "list[str]":
     """Schema check for one incident bundle — same stance as the trace
     module's ``validate_chrome_trace``: an empty list means the bundle

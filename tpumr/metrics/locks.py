@@ -61,6 +61,10 @@ from typing import Any
 RANK_TRACKER_BEAT = 5    # one tracker's heartbeat processing
 RANK_SCHEDULER = 10      # scheduler passes (before_heartbeat / assign)
 RANK_PIPELINE = 15       # DAG engine state (PipelineInProgress tables)
+RANK_COORDINATOR = 18    # sharded-master coordinator tables (job→shard
+#                          routing, shard records, merged snapshots) —
+#                          its own process; every blocking edge (shard
+#                          RPC, Popen, wait) runs OUTSIDE it by rule
 RANK_GLOBAL = 20         # job table, commit grants, admin swaps
 RANK_NAMESPACE = 25      # the NameNode's structural/global lock (DFS
 #                          control plane; its own process — co-held
@@ -77,8 +81,9 @@ RANK_TRACKERS = 30       # tracker registry stripes
 RANK_JOB = 40            # one JobInProgress's task bookkeeping
 
 _ORDER_NAMES = "tracker-beat(5) -> scheduler(10) -> pipeline(15) " \
-               "-> global(20) -> namespace(25) -> namespace-stripe(26) " \
-               "-> namespace-blocks(27) -> trackers(30) -> job(40)"
+               "-> coordinator(18) -> global(20) -> namespace(25) " \
+               "-> namespace-stripe(26) -> namespace-blocks(27) " \
+               "-> trackers(30) -> job(40)"
 
 #: debug-mode ordering assertion: on under ``__debug__`` (plain
 #: ``python``), off under ``python -O`` or TPUMR_LOCK_ORDER_CHECK=0

@@ -100,6 +100,9 @@ class SimTracker:
                                                 task_time_mean_s))
         self._rng = rng or random.Random(hash(name) & 0xFFFFFFFF)
         self._fetch_failure_rate = float(fetch_failure_rate)
+        #: where this tracker's beats go — under a sharded master the
+        #: fleet points each tracker at the shard that owns its name
+        self.endpoint = (master_host, int(master_port))
         self.master = RpcClient(master_host, master_port, secret=secret,
                                 timeout=rpc_timeout_s)
         if handshake:
@@ -164,16 +167,16 @@ class SimTracker:
         if self.heartbeat_begin():
             self.heartbeat_finish()
 
-    def heartbeat_begin(self) -> bool:
-        """First half of a beat: advance fake work, poll events, SEND
-        the status — without waiting for the response. Returns True
-        when a request is now outstanding (pair with
-        :meth:`heartbeat_finish`). The fleet pipelines many trackers'
-        begins back-to-back so the master's handling overlaps the
-        client side of other trackers instead of context-switching
-        once per beat."""
+    def heartbeat_build(self) -> "tuple | None":
+        """Build (but don't send) one beat: advance fake work, poll
+        events, encode the wire status. Returns the heartbeat RPC args
+        ``(status, initial_contact, ask, response_id)`` — the member
+        shape ``heartbeat_batch`` carries — or None when stopped. The
+        caller MUST follow with exactly one of :meth:`heartbeat_apply`
+        (response delivered) or :meth:`heartbeat_abort` (delivery
+        unknown)."""
         if self.stopped:
-            return False
+            return None
         self._poll_completion_events()
         self._advance_tasks()
         full = self._status_dict()
@@ -190,31 +193,51 @@ class SimTracker:
         status = self._hb_encoder.encode(wire, metrics)
         cpu, red = self._counts()
         ask = cpu < self.cpu_slots or red < self.reduce_slots
-        try:
-            self.master.call_begin("heartbeat", status,
-                                   self._initial_contact, ask,
-                                   self._response_id)
-        except Exception:
-            # delivery unknown — same contract as NodeRunner: the next
-            # beat re-ships the full status
-            self._hb_encoder.reset()
-            raise
         self._beat_ctx = (full, metrics, now)
+        return (status, self._initial_contact, ask, self._response_id)
+
+    def heartbeat_abort(self) -> None:
+        """The built/sent beat's delivery is unknown (transport error
+        anywhere between build and response) — same contract as
+        NodeRunner: the next beat re-ships the full status."""
+        self._beat_ctx = None
+        self._hb_encoder.reset()
+
+    def crash_seam_fired(self) -> bool:
+        """BEHAVIORAL churn seam, checked right after a beat went on
+        the wire: hard-kill mid-beat — the master may well fold the
+        request, but the response is never read and the socket just
+        dies, like a tracker SIGKILLed between send and receive."""
         if self.fi_conf is not None and (
                 fires(f"tracker.crash.t{self.index}", self.fi_conf)
                 or fires("tracker.crash", self.fi_conf)):
-            # BEHAVIORAL churn seam: hard-kill mid-beat — the request
-            # is already on the wire (the master may well fold it) but
-            # the response is never read and the socket just dies, like
-            # a tracker process SIGKILLed between send and receive
             self.crash()
+            return True
+        return False
+
+    def heartbeat_begin(self) -> bool:
+        """First half of a beat: advance fake work, poll events, SEND
+        the status — without waiting for the response. Returns True
+        when a request is now outstanding (pair with
+        :meth:`heartbeat_finish`). The fleet pipelines many trackers'
+        begins back-to-back so the master's handling overlaps the
+        client side of other trackers instead of context-switching
+        once per beat."""
+        args = self.heartbeat_build()
+        if args is None:
             return False
-        return True
+        try:
+            self.master.call_begin("heartbeat", *args)
+        except Exception:
+            # delivery unknown — same contract as NodeRunner: the next
+            # beat re-ships the full status
+            self.heartbeat_abort()
+            raise
+        return not self.crash_seam_fired()
 
     def heartbeat_finish(self) -> None:
         """Second half: receive the response of the outstanding
         :meth:`heartbeat_begin` and apply it."""
-        full, metrics, now = self._beat_ctx
         try:
             resp = self.master.call_finish()
         except Exception:
@@ -222,6 +245,20 @@ class SimTracker:
             # beat re-ships the full status
             self._hb_encoder.reset()
             raise
+        self.heartbeat_apply(resp)
+
+    def heartbeat_apply(self, resp: dict) -> None:
+        """Apply one delivered response to the beat built by
+        :meth:`heartbeat_build` — the shared receive half of the
+        pipelined and batched paths. A member-level error marker (a
+        batch isolates member failures server-side) counts as a failed
+        delivery: reset the encoder and raise."""
+        full, metrics, now = self._beat_ctx
+        self._beat_ctx = None
+        if "error" in resp:
+            self._hb_encoder.reset()
+            raise RuntimeError(f"heartbeat member failed: "
+                               f"{resp['error']}")
         self._hb_encoder.delivered()
         if metrics is not None:
             self._metrics_dirty = False
@@ -477,14 +514,33 @@ class SimFleet:
                  n_trackers: int, *, secret: "bytes | None" = None,
                  interval_s: float = 0.2, workers: "int | None" = None,
                  name_prefix: str = "sim", seed: int = 0,
+                 batch: int = 0,
+                 shard_map: "list[tuple[str, int]] | None" = None,
+                 stagger_s: "float | None" = None,
                  **tracker_kwargs: Any) -> None:
         self.master_host, self.master_port = master_host, master_port
         self.n = int(n_trackers)
         self.interval_s = float(interval_s)
+        #: window the first beats spread over (default: one configured
+        #: interval). Under adaptive cadence the steady schedule can be
+        #: much coarser than the floor — spreading joins over THAT
+        #: window keeps fleet start from being a synthetic herd whose
+        #: full-status registrations arrive at many times the rate the
+        #: master will ever instruct again.
+        self.stagger_s = float(stagger_s) if stagger_s else self.interval_s
         self.secret = secret
         self.workers = workers or min(64, max(4, self.n // 4))
         self._prefix = name_prefix
         self._seed = seed
+        #: members per coalesced ``heartbeat_batch`` RPC (0/1 keeps the
+        #: per-tracker pipelined path) — the client twin of the
+        #: master's ``tpumr.heartbeat.batch`` knob
+        self.batch = int(batch)
+        #: sharded master: each tracker beats the shard that owns its
+        #: name (the same crc32 mapping the coordinator serves from
+        #: ``get_shard_map``); None = one unsharded master
+        self.shard_map = ([(str(h), int(p)) for h, p in shard_map]
+                          if shard_map else None)
         self._tracker_kwargs = tracker_kwargs
         self.trackers: "list[SimTracker]" = []
         self._heap: "list[tuple[float, int]]" = []
@@ -502,19 +558,27 @@ class SimFleet:
         self._rtt = self.registry.histogram("hb_rtt_seconds")
         self._lag = self.registry.histogram("hb_lag_seconds")
 
+    def _endpoint(self, name: str) -> "tuple[str, int]":
+        if not self.shard_map:
+            return self.master_host, self.master_port
+        from tpumr.mapred.shardmaster import tracker_shard
+        return self.shard_map[tracker_shard(name,
+                                            len(self.shard_map))]
+
     def start(self) -> "SimFleet":
         rng = random.Random(self._seed)
         for i in range(self.n):
+            name = f"{self._prefix}_{i:04d}"
+            host, port = self._endpoint(name)
             self.trackers.append(SimTracker(
-                f"{self._prefix}_{i:04d}", self.master_host,
-                self.master_port, secret=self.secret, index=i,
+                name, host, port, secret=self.secret, index=i,
                 rng=random.Random(rng.randrange(1 << 30)),
                 **self._tracker_kwargs))
         now = time.monotonic()
         # stagger first beats across one interval so fleet start doesn't
         # land as one synchronized thundering herd (unless saturation
         # makes it one — which is then a real measurement)
-        self._heap = [(now + (i * self.interval_s) / max(1, self.n), i)
+        self._heap = [(now + (i * self.stagger_s) / max(1, self.n), i)
                       for i in range(self.n)]
         heapq.heapify(self._heap)
         for w in range(self.workers):
@@ -534,60 +598,149 @@ class SimFleet:
     BATCH = 16
 
     def _worker(self) -> None:
-        while not self._stop.is_set():
-            batch: "list[tuple[float, int]]" = []
-            with self._cv:
-                while not self._stop.is_set():
-                    now = time.monotonic()
-                    while self._heap and len(batch) < self.BATCH \
-                            and self._heap[0][0] <= now:
-                        batch.append(heapq.heappop(self._heap))
-                    if batch:
-                        break
-                    wait = (self._heap[0][0] - now) if self._heap \
-                        else 0.05
-                    self._cv.wait(min(max(wait, 0.0), 0.05))
+        #: per-worker, per-endpoint batch clients: the pipelined
+        #: RpcClient surface is single-threaded by contract
+        clients: "dict[tuple[str, int], RpcClient]" = {}
+        # a drain splits across shard endpoints (the heap orders by due
+        # time, not owner), so scale it by the shard count or each
+        # endpoint's RPC would only carry ~batch/shards members
+        cap = max(self.BATCH, self.batch * (len(self.shard_map)
+                                            if self.shard_map else 1))
+        try:
+            while not self._stop.is_set():
+                batch: "list[tuple[float, int]]" = []
+                with self._cv:
+                    while not self._stop.is_set():
+                        now = time.monotonic()
+                        while self._heap and len(batch) < cap \
+                                and self._heap[0][0] <= now:
+                            batch.append(heapq.heappop(self._heap))
+                        if batch:
+                            break
+                        wait = (self._heap[0][0] - now) if self._heap \
+                            else 0.05
+                        self._cv.wait(min(max(wait, 0.0), 0.05))
+                    else:
+                        return
+                if self.batch > 1:
+                    self._beat_batched(batch, clients)
                 else:
-                    return
-            now = time.monotonic()
-            begun: "list[tuple[float, int, float]]" = []
-            for due, idx in batch:
-                self._lag.observe(max(0.0, now - due))
-                tracker = self.trackers[idx]
-                if tracker.stopped:
+                    self._beat_pipelined(batch)
+                # fixed-rate schedule AGAINST THE INSTRUCTED CADENCE
+                # (the master's adaptive interval, once a response
+                # carried one); when more than a full interval behind,
+                # skip ahead (the lag was recorded — re-queueing a
+                # backlog of missed beats would only spiral the
+                # overload)
+                now = time.monotonic()
+                with self._cv:
+                    for due, idx in batch:
+                        tracker = self.trackers[idx]
+                        if not tracker.stopped \
+                                and not self._stop.is_set():
+                            iv = tracker.next_interval_s \
+                                or self.interval_s
+                            nxt = due + iv
+                            if nxt <= now:
+                                nxt = now + iv
+                            if nxt < tracker.paused_until:
+                                nxt = tracker.paused_until
+                            heapq.heappush(self._heap, (nxt, idx))
+                    self._cv.notify()
+        finally:
+            for client in clients.values():
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+    def _beat_pipelined(self, batch: "list[tuple[float, int]]") -> None:
+        now = time.monotonic()
+        begun: "list[tuple[float, int, float]]" = []
+        for due, idx in batch:
+            self._lag.observe(max(0.0, now - due))
+            tracker = self.trackers[idx]
+            if tracker.stopped:
+                continue
+            if now < tracker.paused_until:
+                continue   # partitioned away; rescheduled by caller
+            t0 = time.monotonic()
+            try:
+                if tracker.heartbeat_begin():
+                    begun.append((due, idx, t0))
+            except Exception:  # noqa: BLE001 — master down/overload
+                self.registry.incr("hb_errors")
+        for due, idx, t0 in begun:
+            try:
+                self.trackers[idx].heartbeat_finish()
+                self._rtt.observe(time.monotonic() - t0)
+            except Exception:  # noqa: BLE001 — master down/overload
+                self.registry.incr("hb_errors")
+
+    def _beat_batched(self, batch: "list[tuple[float, int]]",
+                      clients: "dict[tuple[str, int], RpcClient]") \
+            -> None:
+        """Coalesce this wakeup's due beats into ONE ``heartbeat_batch``
+        RPC per endpoint (per shard, under a sharded master): build all
+        members first, send every endpoint's batch back-to-back
+        (pipelined across endpoints), then collect and apply responses
+        member-by-member. One syscall round-trip now carries up to
+        ``batch`` beats — the client half of the batching win."""
+        now = time.monotonic()
+        by_ep: "dict[tuple[str, int], list[SimTracker]]" = {}
+        for due, idx in batch:
+            self._lag.observe(max(0.0, now - due))
+            tracker = self.trackers[idx]
+            if tracker.stopped or now < tracker.paused_until:
+                continue
+            by_ep.setdefault(tracker.endpoint, []).append(tracker)
+        sends = []
+        for ep, members in by_ep.items():
+            built: "list[tuple[SimTracker, tuple]]" = []
+            for tr in members:
+                try:
+                    args = tr.heartbeat_build()
+                except Exception:  # noqa: BLE001 — event-poll hiccup
+                    self.registry.incr("hb_errors")
                     continue
-                if now < tracker.paused_until:
-                    continue   # partitioned away; rescheduled below
-                t0 = time.monotonic()
+                if args is not None:
+                    built.append((tr, args))
+            if not built:
+                continue
+            client = clients.get(ep)
+            if client is None:
+                client = clients[ep] = RpcClient(
+                    ep[0], ep[1], secret=self.secret)
+            t0 = time.monotonic()
+            try:
+                client.call_begin("heartbeat_batch",
+                                  [list(a) for _, a in built])
+            except Exception:  # noqa: BLE001 — master down/overload
+                for tr, _ in built:
+                    tr.heartbeat_abort()
+                self.registry.incr("hb_errors")
+                continue
+            for tr, _ in built:
+                tr.crash_seam_fired()
+            sends.append((client, built, t0))
+        for client, built, t0 in sends:
+            try:
+                resps = client.call_finish()
+            except Exception:  # noqa: BLE001 — master down/overload
+                for tr, _ in built:
+                    if not tr.crashed:
+                        tr.heartbeat_abort()
+                self.registry.incr("hb_errors")
+                continue
+            self._rtt.observe(time.monotonic() - t0)
+            self.registry.incr("hb_batches")
+            for (tr, _), resp in zip(built, resps or []):
+                if tr.crashed or tr.stopped:
+                    continue
                 try:
-                    if tracker.heartbeat_begin():
-                        begun.append((due, idx, t0))
-                except Exception:  # noqa: BLE001 — master down/overload
+                    tr.heartbeat_apply(resp)
+                except Exception:  # noqa: BLE001 — member error
                     self.registry.incr("hb_errors")
-            for due, idx, t0 in begun:
-                try:
-                    self.trackers[idx].heartbeat_finish()
-                    self._rtt.observe(time.monotonic() - t0)
-                except Exception:  # noqa: BLE001 — master down/overload
-                    self.registry.incr("hb_errors")
-            # fixed-rate schedule AGAINST THE INSTRUCTED CADENCE (the
-            # master's adaptive interval, once a response carried one);
-            # when more than a full interval behind, skip ahead (the lag
-            # was recorded — re-queueing a backlog of missed beats would
-            # only spiral the overload)
-            now = time.monotonic()
-            with self._cv:
-                for due, idx in batch:
-                    tracker = self.trackers[idx]
-                    if not tracker.stopped and not self._stop.is_set():
-                        iv = tracker.next_interval_s or self.interval_s
-                        nxt = due + iv
-                        if nxt <= now:
-                            nxt = now + iv
-                        if nxt < tracker.paused_until:
-                            nxt = tracker.paused_until
-                        heapq.heappush(self._heap, (nxt, idx))
-                self._cv.notify()
 
     def stop(self) -> None:
         self._stop.set()
@@ -622,12 +775,13 @@ class SimFleet:
         self.trackers_respawned += 1
         rng = random.Random(
             f"{self._seed}:respawn:{idx}:{self.trackers_respawned}")
+        name = f"{self._prefix}_{idx:04d}"
+        host, port = self._endpoint(name)
         deadline = time.monotonic() + 15.0
         while True:
             try:
                 tracker = SimTracker(
-                    f"{self._prefix}_{idx:04d}", self.master_host,
-                    self.master_port, secret=self.secret, index=idx,
+                    name, host, port, secret=self.secret, index=idx,
                     rng=rng, **self._tracker_kwargs)
                 break
             except OSError:
